@@ -1,0 +1,32 @@
+"""opentsdb_tpu — a TPU-native time-series database framework.
+
+A from-scratch rebuild of the capabilities of OpenTSDB 2.4 (reference:
+neilmrp/opentsdb) designed TPU-first: the per-datapoint Java iterator
+pipeline (``src/core/AggregationIterator.java``) is replaced with batched,
+jit-compiled segmented reductions over ``[series x timebucket]`` arrays,
+sharded over a ``jax.sharding.Mesh`` where the reference used 20-way
+salt-bucket HBase scans and stateless TSD scale-out.
+
+Layer map (mirrors SURVEY.md section 1):
+
+- ``core``      storage model: byte codec, UID dictionary, host column store,
+                TSDB facade (ref: ``src/core``, ``src/uid``)
+- ``ops``       the compute kernels: aggregators, downsampling, rate,
+                interpolation, group-by (ref: ``src/core/Aggregators.java``,
+                ``Downsampler.java``, ``RateSpan.java``,
+                ``AggregationIterator.java``)
+- ``query``     query model, tag filters, planner, expressions
+                (ref: ``src/core/TsdbQuery.java``, ``src/query``)
+- ``parallel``  device-mesh sharding of the pipeline (ref: the salt-scanner
+                parallelism of ``src/core/SaltScanner.java``)
+- ``rollup``    pre-aggregation tiers (ref: ``src/rollup``)
+- ``tsd``       HTTP + telnet network server (ref: ``src/tsd``)
+- ``stats``     observability (ref: ``src/stats``)
+- ``meta``/``tree``/``search``/``auth``  metadata, hierarchies, lookup, auth
+- ``tools``     CLI tools (ref: ``src/tools``)
+"""
+
+__version__ = "0.1.0"
+
+from opentsdb_tpu.core.tsdb import TSDB  # noqa: F401
+from opentsdb_tpu.utils.config import Config  # noqa: F401

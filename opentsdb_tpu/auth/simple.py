@@ -1,0 +1,83 @@
+"""Authentication / authorization (ref: ``src/auth/``).
+
+ABI parity with ``Authentication.java:36`` / ``Authorization`` /
+``AuthState`` / ``Permissions.java:25``: a pluggable authenticator
+invoked as the first exchange on a connection (telnet ``auth`` command or
+HTTP), plus a permission enum gating each RPC. The built-in
+:class:`SimpleAuthentication` mirrors the reference's example
+``AllowAllAuthenticatingAuthorizer`` unless users are configured.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from enum import Enum, auto
+
+
+class Permissions(Enum):
+    """(ref: src/auth/Permissions.java:25)"""
+    TELNET_PUT = auto()
+    HTTP_PUT = auto()
+    HTTP_QUERY = auto()
+    CREATE_UID = auto()
+
+
+class AuthStatus(Enum):
+    SUCCESS = auto()
+    UNAUTHORIZED = auto()
+    FORBIDDEN = auto()
+    REDIRECTED = auto()
+    ERROR = auto()
+
+
+class AuthState:
+    """(ref: src/auth/AuthState.java)"""
+
+    def __init__(self, user: str, status: AuthStatus,
+                 message: str = "", roles: set[str] | None = None):
+        self.user = user
+        self.status = status
+        self.message = message
+        self.roles = roles or set()
+        self.token: bytes | None = None
+
+    def has_permission(self, perm: Permissions) -> bool:
+        return self.status == AuthStatus.SUCCESS
+
+
+class SimpleAuthentication:
+    """Username/password authenticator.
+
+    Users configured as ``tsd.core.authentication.users`` =
+    ``user1:sha256hex,user2:sha256hex``; with no users configured every
+    auth attempt succeeds (AllowAllAuthenticatingAuthorizer parity).
+    """
+
+    def __init__(self, config):
+        self._users: dict[str, str] = {}
+        spec = config.get_string("tsd.core.authentication.users", "")
+        for entry in filter(None, (e.strip() for e in spec.split(","))):
+            user, _, digest = entry.partition(":")
+            self._users[user] = digest.lower()
+
+    def authenticate(self, user: str, password: str) -> AuthState:
+        if not self._users:
+            return AuthState(user or "anonymous", AuthStatus.SUCCESS)
+        digest = hashlib.sha256(password.encode()).hexdigest()
+        expected = self._users.get(user)
+        if expected is not None and hmac.compare_digest(digest, expected):
+            state = AuthState(user, AuthStatus.SUCCESS)
+            state.token = secrets.token_bytes(16)
+            return state
+        return AuthState(user, AuthStatus.UNAUTHORIZED,
+                         "invalid credentials")
+
+    def authenticate_telnet(self, command: list[str]) -> AuthState:
+        """telnet: ``auth <user> <password>``
+        (ref: AuthenticationChannelHandler.java:50)."""
+        if len(command) < 3:
+            return AuthState("", AuthStatus.ERROR,
+                             "format: auth <user> <password>")
+        return self.authenticate(command[1], command[2])

@@ -1,0 +1,215 @@
+"""Histogram / sketch datapoints
+(ref: ``src/core/SimpleHistogram.java``, ``HistogramCodecManager.java``).
+
+Distribution-valued series: each datapoint is a bucketed histogram blob.
+Query-time aggregation merges histograms bucket-wise (SUM — the only
+aggregation the reference supports, ``HistogramAggregation.java:20``)
+then extracts percentiles (``SimpleHistogram.percentile`` :133). On the
+TPU path a column of histograms becomes a dense ``[series, buckets]``
+matrix so merge is a segment-sum and percentile extraction a vectorized
+cumsum-searchsorted — see :mod:`opentsdb_tpu.ops.percentile`.
+
+Wire format: first byte of the stored blob is the codec id (matching the
+reference's ``HistogramDataPointCodecManager`` contract); the built-in
+:class:`SimpleHistogramCodec` (id 0x01) encodes bucket bounds + counts
+with struct packing (the reference uses Kryo, a Java-only serde; the
+framing byte and semantics are preserved, the payload encoding is not
+Java-compatible by construction).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Sequence
+
+import numpy as np
+
+
+class SimpleHistogram:
+    """Explicit-bucket histogram (ref: SimpleHistogram.java:43).
+
+    Buckets are [lo, hi) pairs with counts, plus underflow/overflow
+    counters. Percentile uses linear interpolation position = rank
+    weighted into the bucket, matching the reference's midpoint
+    convention (SimpleHistogram.java:133-170: the bucket whose cumulative
+    count crosses the rank contributes its midpoint).
+    """
+
+    def __init__(self, bounds: Sequence[float] | None = None):
+        # bounds: ascending edges; bucket i = [bounds[i], bounds[i+1])
+        self.bounds: list[float] = list(bounds) if bounds is not None else []
+        n = max(0, len(self.bounds) - 1)
+        self.counts: list[int] = [0] * n
+        self.underflow = 0
+        self.overflow = 0
+
+    def add(self, value: float, count: int = 1) -> None:
+        if not self.bounds:
+            raise ValueError("histogram has no buckets")
+        if value < self.bounds[0]:
+            self.underflow += count
+            return
+        if value >= self.bounds[-1]:
+            self.overflow += count
+            return
+        idx = int(np.searchsorted(self.bounds, value, side="right")) - 1
+        self.counts[idx] += count
+
+    def set_bucket(self, lo: float, hi: float, count: int) -> None:
+        """Set a bucket count by its bounds, adding the bucket if new."""
+        if not self.bounds:
+            self.bounds = [lo, hi]
+            self.counts = [count]
+            return
+        for i in range(len(self.counts)):
+            if self.bounds[i] == lo and self.bounds[i + 1] == hi:
+                self.counts[i] = count
+                return
+        if lo >= self.bounds[-1]:
+            if lo != self.bounds[-1]:
+                self.bounds.append(lo)
+                self.counts.append(0)
+            self.bounds.append(hi)
+            self.counts.append(count)
+        elif hi <= self.bounds[0]:
+            if hi != self.bounds[0]:
+                self.bounds.insert(0, hi)
+                self.counts.insert(0, 0)
+            self.bounds.insert(0, lo)
+            self.counts.insert(0, count)
+        else:
+            raise ValueError(
+                f"bucket [{lo},{hi}) overlaps existing bounds {self.bounds}")
+
+    def total_count(self) -> int:
+        return sum(self.counts) + self.underflow + self.overflow
+
+    def merge(self, other: "SimpleHistogram") -> None:
+        """Bucket-wise SUM (ref: HistogramAggregation SUM)."""
+        if self.bounds and other.bounds and self.bounds != other.bounds:
+            raise ValueError("cannot merge histograms with different buckets")
+        if not self.bounds:
+            self.bounds = list(other.bounds)
+            self.counts = list(other.counts)
+        else:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+        self.underflow += other.underflow
+        self.overflow += other.overflow
+
+    def percentile(self, perc: float) -> float:
+        """(ref: SimpleHistogram.percentile :133) Returns the midpoint of
+        the bucket containing the requested rank; overflow returns the
+        top bound, underflow the bottom."""
+        if not 0 <= perc <= 100:
+            raise ValueError(f"invalid percentile {perc}")
+        total = self.total_count()
+        if total == 0:
+            return 0.0
+        target = total * perc / 100.0
+        acc = self.underflow
+        if acc >= target and self.underflow:
+            return float(self.bounds[0]) if self.bounds else 0.0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return (self.bounds[i] + self.bounds[i + 1]) / 2.0
+        return float(self.bounds[-1]) if self.bounds else 0.0
+
+    # -- vector form for the TPU path ----------------------------------
+
+    def counts_array(self) -> np.ndarray:
+        return np.asarray(self.counts, dtype=np.float64)
+
+    def to_json(self) -> dict:
+        return {
+            "buckets": {f"{self.bounds[i]},{self.bounds[i+1]}": c
+                        for i, c in enumerate(self.counts)},
+            "underflow": self.underflow,
+            "overflow": self.overflow,
+        }
+
+
+class HistogramCodec:
+    """Codec ABI (ref: ``HistogramDataPointCodec.java``)."""
+
+    id: int = 0
+
+    def encode(self, hist: SimpleHistogram, include_id: bool) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, data: bytes, includes_id: bool) -> SimpleHistogram:
+        raise NotImplementedError
+
+
+class SimpleHistogramCodec(HistogramCodec):
+    """Built-in codec, id 0x01. Payload: u16 n_edges, f64*edges,
+    u64*counts(n_edges-1), u64 underflow, u64 overflow."""
+
+    id = 0x01
+
+    def encode(self, hist: SimpleHistogram, include_id: bool = True) -> bytes:
+        n = len(hist.bounds)
+        out = bytearray()
+        if include_id:
+            out.append(self.id)
+        out += struct.pack(">H", n)
+        out += struct.pack(f">{n}d", *hist.bounds)
+        out += struct.pack(f">{max(0, n - 1)}Q", *hist.counts)
+        out += struct.pack(">QQ", hist.underflow, hist.overflow)
+        return bytes(out)
+
+    def decode(self, data: bytes, includes_id: bool = True) -> SimpleHistogram:
+        pos = 1 if includes_id else 0
+        (n,) = struct.unpack_from(">H", data, pos)
+        pos += 2
+        bounds = struct.unpack_from(f">{n}d", data, pos)
+        pos += 8 * n
+        counts = struct.unpack_from(f">{max(0, n - 1)}Q", data, pos)
+        pos += 8 * max(0, n - 1)
+        under, over = struct.unpack_from(">QQ", data, pos)
+        hist = SimpleHistogram(bounds)
+        hist.counts = list(counts)
+        hist.underflow = under
+        hist.overflow = over
+        return hist
+
+
+class HistogramCodecManager:
+    """id -> codec registry (ref: HistogramCodecManager.java:47).
+
+    Configured via ``tsd.core.histograms.config`` as a JSON map of
+    ``{"dotted.CodecClass": id}`` like the reference; the built-in simple
+    codec is always registered at id 1.
+    """
+
+    def __init__(self, config=None):
+        self._by_id: dict[int, HistogramCodec] = {}
+        self.register(SimpleHistogramCodec())
+        if config is not None:
+            spec = config.get_string("tsd.core.histograms.config", "")
+            if spec:
+                import json
+                from opentsdb_tpu.utils.plugin import load_class
+                mapping = json.loads(spec)
+                for path, codec_id in mapping.items():
+                    codec = load_class(path)()
+                    codec.id = int(codec_id)
+                    self.register(codec)
+
+    def register(self, codec: HistogramCodec) -> None:
+        self._by_id[codec.id] = codec
+
+    def codec(self, codec_id: int) -> HistogramCodec:
+        try:
+            return self._by_id[codec_id]
+        except KeyError:
+            raise ValueError(f"no histogram codec with id {codec_id}") from None
+
+    def decode(self, blob: bytes) -> SimpleHistogram:
+        if not blob:
+            raise ValueError("empty histogram blob")
+        return self.codec(blob[0]).decode(blob, includes_id=True)
+
+    def encode(self, hist: SimpleHistogram, codec_id: int = 1) -> bytes:
+        return self.codec(codec_id).encode(hist, include_id=True)

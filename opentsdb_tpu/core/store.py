@@ -1,0 +1,334 @@
+"""Host column store: the TPU build's storage engine.
+
+Replaces the reference's HBase tables + asynchbase client
+(ref: ``third_party/hbase``, ``src/core/SaltScanner.java``). Instead of
+byte-encoded rows scanned over TCP, series live in process memory as
+contiguous numpy columns — append is O(1) amortized, and query-time
+"scan" is a vectorized gather that materializes a flat point batch
+``(series_idx, timestamp, value)`` ready for device upload. The
+reference's scan→Span→SpanGroup assembly (Span.java, SpanGroup.java,
+SaltScanner.java) collapses into :meth:`TimeSeriesStore.materialize`.
+
+Sharding: each series is assigned ``shard = salt_hash % num_shards``
+exactly like the reference salts row keys (RowKey.java:141-165); the
+shard index is the device-mesh axis used by :mod:`opentsdb_tpu.parallel`.
+
+The ``StorageBackend`` protocol preserves the reference's swap point
+(asynchbase -> asyncbigtable -> asynccassandra, Makefile.am:267-279):
+`MemoryBackend` here, a C++ arena store in
+:mod:`opentsdb_tpu.native` as the second backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, NamedTuple, Protocol, Sequence
+
+import numpy as np
+
+from opentsdb_tpu.core import const
+
+_INITIAL_CAPACITY = 16
+
+
+class SeriesBuffer:
+    """One series' points: growable parallel numpy columns.
+
+    The reference materializes a series as compacted HBase cells parsed
+    into ``RowSeq`` objects (RowSeq.java:39); here the canonical form is
+    already columnar. Out-of-order and duplicate writes are accepted;
+    the buffer is lazily sorted + deduped (last write wins — matching
+    ``tsd.storage.fix_duplicates`` semantics, CompactionQueue.java) the
+    first time it is read after a write.
+    """
+
+    __slots__ = ("ts", "vals", "is_int", "n", "_sorted", "lock")
+
+    def __init__(self) -> None:
+        self.ts = np.empty(_INITIAL_CAPACITY, dtype=np.int64)
+        self.vals = np.empty(_INITIAL_CAPACITY, dtype=np.float64)
+        self.is_int = np.empty(_INITIAL_CAPACITY, dtype=bool)
+        self.n = 0
+        self._sorted = True
+        self.lock = threading.Lock()
+
+    def append(self, ts_ms: int, value: float, is_int: bool) -> None:
+        with self.lock:
+            if self.n == len(self.ts):
+                new_cap = self.n * 2
+                self.ts = np.resize(self.ts, new_cap)
+                self.vals = np.resize(self.vals, new_cap)
+                self.is_int = np.resize(self.is_int, new_cap)
+            i = self.n
+            self.ts[i] = ts_ms
+            self.vals[i] = value
+            self.is_int[i] = is_int
+            if self._sorted and i > 0 and ts_ms <= self.ts[i - 1]:
+                self._sorted = False
+            self.n = i + 1
+
+    def append_many(self, ts_ms: np.ndarray, values: np.ndarray,
+                    is_int: np.ndarray | bool = False) -> None:
+        """Bulk append (import path). Arrays must be 1-D, same length."""
+        k = len(ts_ms)
+        if k == 0:
+            return
+        with self.lock:
+            need = self.n + k
+            if need > len(self.ts):
+                new_cap = max(need, len(self.ts) * 2)
+                self.ts = np.resize(self.ts, new_cap)
+                self.vals = np.resize(self.vals, new_cap)
+                self.is_int = np.resize(self.is_int, new_cap)
+            self.ts[self.n:need] = ts_ms
+            self.vals[self.n:need] = values
+            self.is_int[self.n:need] = is_int
+            if self._sorted:
+                first = ts_ms[0]
+                if (self.n > 0 and first <= self.ts[self.n - 1]) or \
+                        k > 1 and bool(np.any(np.diff(ts_ms) <= 0)):
+                    self._sorted = False
+            self.n = need
+
+    def _ensure_sorted_locked(self) -> None:
+        if self._sorted:
+            return
+        ts = self.ts[:self.n]
+        order = np.argsort(ts, kind="stable")
+        ts_sorted = ts[order]
+        vals_sorted = self.vals[:self.n][order]
+        ints_sorted = self.is_int[:self.n][order]
+        # dedupe: last write wins (stable sort keeps write order per ts)
+        if self.n > 1:
+            keep = np.empty(self.n, dtype=bool)
+            keep[:-1] = ts_sorted[1:] != ts_sorted[:-1]
+            keep[-1] = True
+            if not keep.all():
+                ts_sorted = ts_sorted[keep]
+                vals_sorted = vals_sorted[keep]
+                ints_sorted = ints_sorted[keep]
+        m = len(ts_sorted)
+        self.ts[:m] = ts_sorted
+        self.vals[:m] = vals_sorted
+        self.is_int[:m] = ints_sorted
+        self.n = m
+        self._sorted = True
+
+    def view(self) -> tuple[np.ndarray, np.ndarray]:
+        """Sorted, deduped (ts, vals) views. Do not mutate."""
+        with self.lock:
+            self._ensure_sorted_locked()
+            return self.ts[:self.n], self.vals[:self.n]
+
+    def view_full(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        with self.lock:
+            self._ensure_sorted_locked()
+            return self.ts[:self.n], self.vals[:self.n], self.is_int[:self.n]
+
+    def slice_range(self, start_ms: int, end_ms: int) -> tuple[np.ndarray,
+                                                               np.ndarray]:
+        """Points with start_ms <= ts <= end_ms (inclusive ends, matching
+        the reference's getScanEndTimeSeconds semantics)."""
+        ts, vals = self.view()
+        lo = np.searchsorted(ts, start_ms, side="left")
+        hi = np.searchsorted(ts, end_ms, side="right")
+        return ts[lo:hi], vals[lo:hi]
+
+    def __len__(self) -> int:
+        return self.n
+
+
+class SeriesRecord(NamedTuple):
+    series_id: int
+    metric_id: int
+    tags: tuple[tuple[int, int], ...]  # ((tagk_id, tagv_id), ...) sorted
+    shard: int
+    buffer: SeriesBuffer
+
+
+class PointBatch(NamedTuple):
+    """Flat materialized points for a set of series — the device-upload
+    format consumed by :mod:`opentsdb_tpu.ops.pipeline`.
+
+    ``series_idx[i]`` indexes into ``series_ids`` (dense 0..S-1), NOT the
+    global series id — so the array program sees a compact series axis.
+    """
+    series_ids: np.ndarray    # int64 [S] global series ids
+    series_idx: np.ndarray    # int32 [N] dense position of each point
+    ts_ms: np.ndarray         # int64 [N]
+    values: np.ndarray        # float64 [N]
+
+    @property
+    def num_series(self) -> int:
+        return len(self.series_ids)
+
+    @property
+    def num_points(self) -> int:
+        return len(self.ts_ms)
+
+
+class StorageBackend(Protocol):
+    """The storage swap point (ref: build-bigtable.sh / build-cassandra.sh)."""
+
+    def get_or_create_series(self, metric_id: int,
+                             tags: Sequence[tuple[int, int]]) -> int: ...
+    def append(self, series_id: int, ts_ms: int, value: float,
+               is_int: bool) -> None: ...
+    def materialize(self, series_ids: Sequence[int], start_ms: int,
+                    end_ms: int) -> PointBatch: ...
+
+
+class MetricIndex:
+    """Per-metric vectorized tag index.
+
+    The reference filters series by compiling literal tag filters into
+    scanner row-key regexes and running the rest post-scan
+    (TsdbQuery.findSpans :804, SaltScanner:660). Here every metric keeps
+    columnar arrays (series_id, tagk_id, tagv_id triples) so a filter
+    evaluates as numpy set/mask operations over all series of the metric
+    at once.
+    """
+
+    def __init__(self, metric_id: int):
+        self.metric_id = metric_id
+        self.series_ids: list[int] = []
+        self._tag_rows: list[tuple[int, int, int]] = []  # (sid, tagk, tagv)
+        self._dirty = False
+        self._sid_arr = np.empty(0, dtype=np.int64)
+        self._tags_arr = np.empty((0, 3), dtype=np.int64)
+
+    def add(self, series_id: int, tags: Sequence[tuple[int, int]]) -> None:
+        self.series_ids.append(series_id)
+        for tagk, tagv in tags:
+            self._tag_rows.append((series_id, tagk, tagv))
+        self._dirty = True
+
+    def arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(sids[int64 S], tag_triples[int64 T x 3]) snapshot."""
+        if self._dirty:
+            self._sid_arr = np.asarray(self.series_ids, dtype=np.int64)
+            self._tags_arr = (np.asarray(self._tag_rows, dtype=np.int64)
+                              .reshape(-1, 3))
+            self._dirty = False
+        return self._sid_arr, self._tags_arr
+
+
+class TimeSeriesStore:
+    """In-memory storage engine: all series of all metrics.
+
+    Concurrency: a single writer lock guards series creation and index
+    updates; per-series appends take only the series' own lock. Readers
+    snapshot indices without blocking writes (numpy arrays are replaced,
+    never mutated in place once published).
+    """
+
+    def __init__(self, num_shards: int | None = None):
+        self.num_shards = num_shards or const.salt_buckets()
+        self._lock = threading.Lock()
+        self._series: list[SeriesRecord] = []
+        self._key_to_sid: dict[tuple, int] = {}
+        self._metric_index: dict[int, MetricIndex] = {}
+        self.points_written = 0
+
+    # -- write path -------------------------------------------------------
+
+    def get_or_create_series(self, metric_id: int,
+                             tags: Sequence[tuple[int, int]]) -> int:
+        key = (metric_id, tuple(sorted(tags)))
+        sid = self._key_to_sid.get(key)
+        if sid is not None:
+            return sid
+        with self._lock:
+            sid = self._key_to_sid.get(key)
+            if sid is not None:
+                return sid
+            sid = len(self._series)
+            shard = self._shard_for(metric_id, key[1])
+            rec = SeriesRecord(sid, metric_id, key[1], shard, SeriesBuffer())
+            self._series.append(rec)
+            idx = self._metric_index.get(metric_id)
+            if idx is None:
+                idx = self._metric_index[metric_id] = MetricIndex(metric_id)
+            idx.add(sid, key[1])
+            self._key_to_sid[key] = sid
+            return sid
+
+    def _shard_for(self, metric_id: int,
+                   tags: tuple[tuple[int, int], ...]) -> int:
+        # Same hash family as the salt bucket (RowKey.java:141): series of
+        # one metric+tags always land on the same shard/device.
+        h = hash((metric_id, tags))
+        return h % self.num_shards
+
+    def append(self, series_id: int, ts_ms: int, value: float,
+               is_int: bool = False) -> None:
+        self._series[series_id].buffer.append(ts_ms, value, is_int)
+        self.points_written += 1
+
+    def append_many(self, series_id: int, ts_ms: np.ndarray,
+                    values: np.ndarray,
+                    is_int: np.ndarray | bool = False) -> None:
+        self._series[series_id].buffer.append_many(ts_ms, values, is_int)
+        self.points_written += len(ts_ms)
+
+    # -- read path --------------------------------------------------------
+
+    def series(self, series_id: int) -> SeriesRecord:
+        return self._series[series_id]
+
+    def num_series(self) -> int:
+        return len(self._series)
+
+    def metric_ids(self) -> list[int]:
+        with self._lock:
+            return list(self._metric_index)
+
+    def metric_index(self, metric_id: int) -> MetricIndex | None:
+        return self._metric_index.get(metric_id)
+
+    def series_ids_for_metric(self, metric_id: int) -> np.ndarray:
+        idx = self._metric_index.get(metric_id)
+        if idx is None:
+            return np.empty(0, dtype=np.int64)
+        sids, _ = idx.arrays()
+        return sids
+
+    def materialize(self, series_ids: Sequence[int], start_ms: int,
+                    end_ms: int) -> PointBatch:
+        """Gather all points of ``series_ids`` in [start_ms, end_ms].
+
+        This is the moral equivalent of the reference's 20-way SaltScanner
+        fan-out + Span assembly (SaltScanner.java:269) — except the output
+        is a flat columnar batch, not a tree of iterators.
+        """
+        sids = np.asarray(series_ids, dtype=np.int64)
+        ts_parts: list[np.ndarray] = []
+        val_parts: list[np.ndarray] = []
+        counts = np.empty(len(sids), dtype=np.int64)
+        for i, sid in enumerate(sids):
+            ts, vals = self._series[sid].buffer.slice_range(start_ms, end_ms)
+            counts[i] = len(ts)
+            if len(ts):
+                ts_parts.append(ts)
+                val_parts.append(vals)
+        if ts_parts:
+            all_ts = np.concatenate(ts_parts)
+            all_vals = np.concatenate(val_parts)
+        else:
+            all_ts = np.empty(0, dtype=np.int64)
+            all_vals = np.empty(0, dtype=np.float64)
+        series_idx = np.repeat(
+            np.arange(len(sids), dtype=np.int32), counts)
+        return PointBatch(sids, series_idx, all_ts, all_vals)
+
+    def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
+        return np.asarray([self._series[s].shard for s in series_ids],
+                          dtype=np.int32)
+
+    def total_points(self) -> int:
+        return sum(len(rec.buffer) for rec in self._series)
+
+    def collect_stats(self, collector) -> None:
+        collector.record("storage.series.count", self.num_series())
+        collector.record("storage.points.written", self.points_written)
+        collector.record("storage.shards", self.num_shards)

@@ -1,0 +1,258 @@
+"""The TSDB facade (ref: ``src/core/TSDB.java:87``).
+
+Central object owning the UID registry, the storage backend, plugin
+slots, and rollup configuration. Mirrors the reference surface:
+``add_point`` (TSDB.java:1012-1097), ``add_aggregate_point`` (:1320),
+``new_query`` (:963), ``suggest_*`` (:1762-1816), ``assign_uid``
+(:1838), ``flush`` (:1603), ``shutdown`` (:1632), plus operating modes
+rw/ro/wo (:103).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+from opentsdb_tpu.core import codec, const, tags as tags_mod
+from opentsdb_tpu.core.store import PointBatch, TimeSeriesStore
+from opentsdb_tpu.core.uid import UidRegistry
+from opentsdb_tpu.utils.config import Config
+
+
+class TSDB:
+    """(ref: src/core/TSDB.java:87)"""
+
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        const.set_salt_width(self.config.get_int("tsd.storage.salt.width", 0))
+        const.set_salt_buckets(
+            self.config.get_int("tsd.storage.salt.buckets", 20))
+        self.uids = UidRegistry(
+            metric_width=self.config.get_int("tsd.storage.uid.width.metric", 3),
+            tagk_width=self.config.get_int("tsd.storage.uid.width.tagk", 3),
+            tagv_width=self.config.get_int("tsd.storage.uid.width.tagv", 3),
+            random_metrics=self.config.get_bool(
+                "tsd.core.uid.random_metrics"))
+        self.store = TimeSeriesStore(num_shards=const.salt_buckets())
+        self.mode = self.config.get_string("tsd.mode", "rw")
+        self.auto_metric = self.config.get_bool("tsd.core.auto_create_metrics")
+        self.auto_tagk = self.config.get_bool("tsd.core.auto_create_tagks",
+                                              True)
+        self.auto_tagv = self.config.get_bool("tsd.core.auto_create_tagvs",
+                                              True)
+        # plugin slots (ref: TSDB.java:146-167); populated by
+        # initialize_plugins()
+        self.rt_publisher = None
+        self.search_plugin = None
+        self.storage_exception_handler = None
+        self.write_filters: list[Callable[..., bool]] = []
+        self.meta_cache = None
+        self.authentication = None
+        # rollups (ref: TSDB.java:170-185)
+        self.rollup_config = None
+        self.agg_tag_key = self.config.get_string("tsd.rollups.agg_tag_key",
+                                                  "_aggregate")
+        if self.config.get_bool("tsd.rollups.enable"):
+            from opentsdb_tpu.rollup.config import RollupConfig
+            path = self.config.get_string("tsd.rollups.config", "")
+            self.rollup_config = (RollupConfig.from_file(path) if path
+                                  else RollupConfig.default())
+            from opentsdb_tpu.rollup.store import RollupStore
+            self.rollup_store = RollupStore(self.rollup_config)
+        else:
+            self.rollup_store = None
+        from opentsdb_tpu.core.histogram import HistogramCodecManager
+        self.histogram_manager = HistogramCodecManager(self.config)
+        self.histogram_store = TimeSeriesStore(num_shards=const.salt_buckets())
+        self._histogram_series: dict[int, list] = {}
+        from opentsdb_tpu.meta.annotation import AnnotationStore
+        self.annotations = AnnotationStore()
+        from opentsdb_tpu.meta.meta_store import MetaStore
+        self.meta = MetaStore(self)
+        from opentsdb_tpu.stats.stats import StatsCollectorRegistry
+        self.stats = StatsCollectorRegistry()
+        self.datapoints_added = 0
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------------
+    # plugins (ref: TSDB.java initializePlugins :390)
+    # ------------------------------------------------------------------
+
+    def initialize_plugins(self) -> None:
+        from opentsdb_tpu.utils.plugin import load_plugin_instances
+        cfg = self.config
+        if cfg.get_bool("tsd.core.plugins.enable", False) or True:
+            self.rt_publisher = load_plugin_instances(
+                cfg, "tsd.rtpublisher", single=True)
+            self.search_plugin = load_plugin_instances(
+                cfg, "tsd.search", single=True)
+            self.storage_exception_handler = load_plugin_instances(
+                cfg, "tsd.core.storage_exception_handler", single=True)
+            self.write_filters = load_plugin_instances(
+                cfg, "tsd.core.write_filter") or []
+        if cfg.get_bool("tsd.core.authentication.enable"):
+            from opentsdb_tpu.auth.simple import SimpleAuthentication
+            self.authentication = SimpleAuthentication(cfg)
+
+    # ------------------------------------------------------------------
+    # write path (ref: TSDB.java:1012-1291)
+    # ------------------------------------------------------------------
+
+    def add_point(self, metric: str, timestamp: int, value: int | float,
+                  tags: dict[str, str]) -> int:
+        """Write one datapoint; returns the series id.
+
+        (ref: TSDB.addPoint :1012/:1057/:1097 -> addPointInternal :1150)
+        """
+        if self.mode == "ro":
+            raise PermissionError("TSD is in read-only mode")
+        self._check_timestamp(timestamp)
+        tags_mod.check_metric_and_tags(metric, tags)
+        is_int = isinstance(value, int) and not isinstance(value, bool)
+        fval = float(value)
+        for filt in self.write_filters:
+            if not filt(metric, timestamp, value, tags):
+                return -1
+        metric_id, tag_ids = self._resolve_write_uids(metric, tags)
+        sid = self.store.get_or_create_series(metric_id, tag_ids)
+        ts_ms = codec.to_ms(timestamp)
+        self.store.append(sid, ts_ms, fval, is_int)
+        self.datapoints_added += 1
+        if self.meta is not None:
+            self.meta.on_datapoint(metric_id, tag_ids, sid)
+        if self.rt_publisher is not None:
+            self.rt_publisher.publish_data_point(
+                metric, timestamp, value, tags,
+                self.uids.tsuid(metric_id, tag_ids))
+        return sid
+
+    def _check_timestamp(self, timestamp: int) -> None:
+        # ref: TSDB.java:1274 checkTimestampAndTags
+        if timestamp <= 0:
+            raise ValueError(f"invalid timestamp {timestamp}")
+        if codec.is_ms_timestamp(timestamp) and timestamp > (1 << 47):
+            raise ValueError(f"timestamp out of range: {timestamp}")
+
+    def _resolve_write_uids(self, metric: str, tags: dict[str, str]
+                            ) -> tuple[int, list[tuple[int, int]]]:
+        from opentsdb_tpu.core.uid import NoSuchUniqueName
+        if self.auto_metric:
+            metric_id = self.uids.metrics.get_or_create_id(metric)
+        else:
+            metric_id = self.uids.metrics.get_id(metric)  # may raise
+        tag_ids = []
+        for k, v in tags.items():
+            kid = (self.uids.tag_names.get_or_create_id(k) if self.auto_tagk
+                   else self.uids.tag_names.get_id(k))
+            vid = (self.uids.tag_values.get_or_create_id(v) if self.auto_tagv
+                   else self.uids.tag_values.get_id(v))
+            tag_ids.append((kid, vid))
+        return metric_id, tag_ids
+
+    def add_aggregate_point(self, metric: str, timestamp: int,
+                            value: int | float, tags: dict[str, str],
+                            is_groupby: bool, interval: str | None,
+                            rollup_agg: str | None,
+                            groupby_agg: str | None = None) -> None:
+        """Write a rollup / pre-aggregated point (ref: TSDB.java:1320-1418).
+
+        Pre-aggregates (``is_groupby``) are tagged with the agg-tag
+        (``tsd.rollups.agg_tag_key``) exactly like the reference.
+        """
+        if self.rollup_store is None:
+            raise RuntimeError("rollups are not enabled "
+                               "(tsd.rollups.enable=false)")
+        tags = dict(tags)
+        if is_groupby:
+            agg = (groupby_agg or rollup_agg or "").upper()
+            if not agg:
+                raise ValueError("missing group-by aggregator")
+            tags[self.agg_tag_key] = agg
+        tags_mod.check_metric_and_tags(metric, tags)
+        metric_id, tag_ids = self._resolve_write_uids(metric, tags)
+        ts_ms = codec.to_ms(timestamp)
+        if interval is None:
+            # pure pre-agg point: store in the pre-agg ("groupby") table
+            self.rollup_store.add_preagg_point(
+                metric_id, tag_ids, ts_ms, float(value))
+        else:
+            if rollup_agg is None:
+                raise ValueError("missing rollup aggregator")
+            self.rollup_store.add_point(
+                interval, rollup_agg.lower(), metric_id, tag_ids, ts_ms,
+                float(value))
+        self.datapoints_added += 1
+
+    def add_histogram_point(self, metric: str, timestamp: int,
+                            raw_blob: bytes, tags: dict[str, str]) -> int:
+        """Write an encoded histogram datapoint (ref: TSDB.java:1132)."""
+        tags_mod.check_metric_and_tags(metric, tags)
+        self._check_timestamp(timestamp)
+        hist = self.histogram_manager.decode(raw_blob)
+        metric_id, tag_ids = self._resolve_write_uids(metric, tags)
+        sid = self.histogram_store.get_or_create_series(metric_id, tag_ids)
+        ts_ms = codec.to_ms(timestamp)
+        lst = self._histogram_series.setdefault(sid, [])
+        lst.append((ts_ms, hist))
+        self.datapoints_added += 1
+        return sid
+
+    # ------------------------------------------------------------------
+    # read path entry (ref: TSDB.java newQuery :963)
+    # ------------------------------------------------------------------
+
+    def new_query(self):
+        from opentsdb_tpu.query.engine import QueryEngine
+        return QueryEngine(self)
+
+    def execute_query(self, ts_query) -> list:
+        """Run a validated TSQuery end-to-end, returning result groups."""
+        return self.new_query().run(ts_query)
+
+    # ------------------------------------------------------------------
+    # suggest / uid surface (ref: TSDB.java:1762-1846)
+    # ------------------------------------------------------------------
+
+    def suggest_metrics(self, search: str = "", max_results: int = 25):
+        return self.uids.metrics.suggest(search, max_results)
+
+    def suggest_tag_names(self, search: str = "", max_results: int = 25):
+        return self.uids.tag_names.suggest(search, max_results)
+
+    def suggest_tag_values(self, search: str = "", max_results: int = 25):
+        return self.uids.tag_values.suggest(search, max_results)
+
+    def assign_uid(self, kind: str, name: str) -> int:
+        tags_mod.validate_string(f"{kind} name", name)
+        return self.uids.by_kind(kind).assign_id(name)
+
+    # ------------------------------------------------------------------
+    # lifecycle (ref: TSDB.java flush :1603, shutdown :1632)
+    # ------------------------------------------------------------------
+
+    def flush(self) -> None:
+        pass  # memory backend has nothing buffered
+
+    def shutdown(self) -> None:
+        self.flush()
+        if self.rt_publisher is not None:
+            self.rt_publisher.shutdown()
+        if self.search_plugin is not None:
+            self.search_plugin.shutdown()
+
+    def drop_caches(self) -> None:
+        """(ref: TSDB.dropCaches) UID caches are authoritative here, so
+        this is a no-op kept for API parity."""
+
+    # ------------------------------------------------------------------
+    # stats (ref: TSDB.collectStats :753)
+    # ------------------------------------------------------------------
+
+    def collect_stats(self, collector) -> None:
+        self.uids.metrics.collect_stats(collector)
+        self.uids.tag_names.collect_stats(collector)
+        self.uids.tag_values.collect_stats(collector)
+        self.store.collect_stats(collector)
+        collector.record("datapoints.added", self.datapoints_added)
+        collector.record("uptime.seconds",
+                         int(time.time() - self.start_time))

@@ -1,0 +1,102 @@
+"""Annotations: notes overlaid on series or global
+(ref: ``src/meta/Annotation.java:79``).
+
+The reference stores annotations as 0x01-prefixed cells in the data table
+next to the datapoints; here they live in a per-TSUID sorted dict. Global
+annotations use the empty TSUID, like the reference's empty-row-key
+convention.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+GLOBAL_TSUID = ""
+
+
+@dataclass
+class Annotation:
+    """(ref: Annotation.java:79) Times in seconds like the JSON API."""
+    tsuid: str = GLOBAL_TSUID
+    start_time: int = 0
+    end_time: int = 0
+    description: str = ""
+    notes: str = ""
+    custom: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "tsuid": self.tsuid,
+            "description": self.description,
+            "notes": self.notes,
+            "custom": self.custom or None,
+            "startTime": self.start_time,
+            "endTime": self.end_time,
+        }
+        if not self.tsuid:
+            out.pop("tsuid")
+        return out
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "Annotation":
+        return cls(
+            tsuid=obj.get("tsuid", "") or "",
+            start_time=int(obj.get("startTime", 0)),
+            end_time=int(obj.get("endTime", 0)),
+            description=obj.get("description", "") or "",
+            notes=obj.get("notes", "") or "",
+            custom=obj.get("custom") or {},
+        )
+
+
+class AnnotationStore:
+    """CRUD + range scan (ref: Annotation.java:156-266 + getGlobalAnnotations)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # tsuid -> {start_time_sec: Annotation}
+        self._by_tsuid: dict[str, dict[int, Annotation]] = {}
+
+    def store(self, note: Annotation) -> Annotation:
+        if not note.start_time:
+            raise ValueError("missing or invalid start time")
+        with self._lock:
+            self._by_tsuid.setdefault(note.tsuid, {})[note.start_time] = note
+        return note
+
+    def get(self, tsuid: str, start_time: int) -> Annotation | None:
+        with self._lock:
+            return self._by_tsuid.get(tsuid, {}).get(start_time)
+
+    def delete(self, tsuid: str, start_time: int) -> bool:
+        with self._lock:
+            d = self._by_tsuid.get(tsuid, {})
+            return d.pop(start_time, None) is not None
+
+    def delete_range(self, tsuids: list[str] | None, start_sec: int,
+                     end_sec: int) -> int:
+        """Bulk delete (ref: AnnotationRpc bulk delete)."""
+        count = 0
+        with self._lock:
+            keys = tsuids if tsuids is not None else list(self._by_tsuid)
+            for tsuid in keys:
+                d = self._by_tsuid.get(tsuid)
+                if not d:
+                    continue
+                doomed = [t for t in d if start_sec <= t <= end_sec]
+                for t in doomed:
+                    del d[t]
+                count += len(doomed)
+        return count
+
+    def global_range(self, start_sec: int, end_sec: int) -> list[Annotation]:
+        return self.range(GLOBAL_TSUID, start_sec, end_sec)
+
+    def range(self, tsuid: str, start_sec: int, end_sec: int
+              ) -> list[Annotation]:
+        with self._lock:
+            d = self._by_tsuid.get(tsuid, {})
+            return [a for t, a in sorted(d.items())
+                    if start_sec <= t <= end_sec]

@@ -1,0 +1,134 @@
+"""UIDMeta / TSMeta metadata documents
+(ref: ``src/meta/UIDMeta.java:71``, ``src/meta/TSMeta.java:75``).
+
+Created on first write when realtime-meta tracking is enabled (matching
+``tsd.core.meta.enable_realtime_ts`` / ``enable_tsuid_tracking``), kept
+in process dictionaries, and pushed to the search plugin when present.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class UIDMeta:
+    """(ref: UIDMeta.java:71)"""
+    uid: str = ""           # hex string form, like the JSON API
+    type: str = ""          # METRIC | TAGK | TAGV
+    name: str = ""
+    display_name: str = ""
+    description: str = ""
+    notes: str = ""
+    created: int = 0
+    custom: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "uid": self.uid, "type": self.type.upper(), "name": self.name,
+            "displayName": self.display_name, "description": self.description,
+            "notes": self.notes, "created": self.created,
+            "custom": self.custom or None,
+        }
+
+
+@dataclass
+class TSMeta:
+    """(ref: TSMeta.java:75)"""
+    tsuid: str = ""
+    display_name: str = ""
+    description: str = ""
+    notes: str = ""
+    created: int = 0
+    custom: dict[str, str] = field(default_factory=dict)
+    units: str = ""
+    data_type: str = ""
+    retention: int = 0
+    max_value: float = float("nan")
+    min_value: float = float("nan")
+    last_received: int = 0
+    total_dps: int = 0
+    metric: UIDMeta | None = None
+    tags: list[UIDMeta] = field(default_factory=list)
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "tsuid": self.tsuid, "displayName": self.display_name,
+            "description": self.description, "notes": self.notes,
+            "created": self.created, "custom": self.custom or None,
+            "units": self.units, "dataType": self.data_type,
+            "retention": self.retention,
+            "lastReceived": self.last_received, "totalDatapoints": self.total_dps,
+        }
+        if self.metric:
+            out["metric"] = self.metric.to_json()
+        if self.tags:
+            out["tags"] = [t.to_json() for t in self.tags]
+        return out
+
+
+class MetaStore:
+    """Realtime TSMeta/UIDMeta tracking (ref: TSDB.java:1225-1245)."""
+
+    def __init__(self, tsdb) -> None:
+        self._tsdb = tsdb
+        cfg = tsdb.config
+        self.track_ts = (cfg.get_bool("tsd.core.meta.enable_realtime_ts")
+                         or cfg.get_bool(
+                             "tsd.core.meta.enable_tsuid_tracking"))
+        self.track_uid = cfg.get_bool("tsd.core.meta.enable_realtime_uid")
+        self._lock = threading.Lock()
+        self.ts_meta: dict[str, TSMeta] = {}
+        self.uid_meta: dict[tuple[str, str], UIDMeta] = {}
+        self.ts_counters: dict[str, int] = {}
+
+    def on_datapoint(self, metric_id: int, tag_ids, series_id: int) -> None:
+        if not self.track_ts:
+            return
+        tsuid = self._tsdb.uids.tsuid(metric_id, tag_ids).hex().upper()
+        now = int(time.time())
+        with self._lock:
+            self.ts_counters[tsuid] = self.ts_counters.get(tsuid, 0) + 1
+            meta = self.ts_meta.get(tsuid)
+            if meta is None:
+                meta = TSMeta(tsuid=tsuid, created=now)
+                meta.metric = self._uid_meta_locked(
+                    "metric", metric_id, now)
+                for kid, vid in sorted(tag_ids):
+                    meta.tags.append(self._uid_meta_locked("tagk", kid, now))
+                    meta.tags.append(self._uid_meta_locked("tagv", vid, now))
+                self.ts_meta[tsuid] = meta
+                if self._tsdb.search_plugin is not None:
+                    self._tsdb.search_plugin.index_ts_meta(meta)
+            meta.last_received = now
+            meta.total_dps = self.ts_counters[tsuid]
+
+    def _uid_meta_locked(self, kind: str, uid_int: int,
+                         now: int) -> UIDMeta:
+        registry = self._tsdb.uids.by_kind(kind)
+        key = (kind, registry.int_to_uid(uid_int).hex().upper())
+        meta = self.uid_meta.get(key)
+        if meta is None:
+            meta = UIDMeta(uid=key[1],
+                           type={"metric": "METRIC", "tagk": "TAGK",
+                                 "tagv": "TAGV"}[kind],
+                           name=registry.get_name(uid_int), created=now)
+            self.uid_meta[key] = meta
+            if self.track_uid and self._tsdb.search_plugin is not None:
+                self._tsdb.search_plugin.index_uid_meta(meta)
+        return meta
+
+    def get_ts_meta(self, tsuid: str) -> TSMeta | None:
+        with self._lock:
+            return self.ts_meta.get(tsuid.upper())
+
+    def get_uid_meta(self, kind: str, uid_hex: str) -> UIDMeta | None:
+        with self._lock:
+            return self.uid_meta.get((kind, uid_hex.upper()))
+
+    def all_ts_meta(self) -> list[TSMeta]:
+        with self._lock:
+            return list(self.ts_meta.values())

@@ -1,0 +1,58 @@
+"""Rollup tier storage.
+
+One :class:`TimeSeriesStore` per (tier, aggregator), mirroring the
+reference's per-tier HBase tables with agg-prefixed qualifiers
+(ref: ``src/rollup/RollupUtils.java:120-178``). Written either by the
+external-job API (``TSDB.add_aggregate_point``, ref TSDB.java:1320) or
+by the in-framework rollup job (:mod:`opentsdb_tpu.rollup.job`) — which
+the reference lacks (SURVEY.md §2.3: "rollups are written by external
+jobs"); the TPU build ships one as a jitted segmented reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from opentsdb_tpu.core.store import PointBatch, TimeSeriesStore
+from opentsdb_tpu.rollup.config import RollupConfig
+
+
+class RollupStore:
+    def __init__(self, config: RollupConfig):
+        self.config = config
+        # (interval, agg) -> store
+        self._tiers: dict[tuple[str, str], TimeSeriesStore] = {}
+        self._preagg = TimeSeriesStore()
+
+    def tier(self, interval: str, agg: str) -> TimeSeriesStore:
+        agg = agg.lower()
+        if agg not in self.config.agg_ids:
+            raise ValueError(
+                f"unsupported rollup aggregator {agg!r} "
+                f"(supported: {sorted(self.config.agg_ids)})")
+        self.config.get_interval(interval)  # validate tier exists
+        key = (interval, agg)
+        store = self._tiers.get(key)
+        if store is None:
+            store = self._tiers[key] = TimeSeriesStore()
+        return store
+
+    def add_point(self, interval: str, agg: str, metric_id: int,
+                  tag_ids: Sequence[tuple[int, int]], ts_ms: int,
+                  value: float) -> None:
+        store = self.tier(interval, agg)
+        sid = store.get_or_create_series(metric_id, tag_ids)
+        store.append(sid, ts_ms, value)
+
+    def add_preagg_point(self, metric_id: int,
+                         tag_ids: Sequence[tuple[int, int]], ts_ms: int,
+                         value: float) -> None:
+        sid = self._preagg.get_or_create_series(metric_id, tag_ids)
+        self._preagg.append(sid, ts_ms, value)
+
+    def preagg_store(self) -> TimeSeriesStore:
+        return self._preagg
+
+    def has_data(self, interval: str, agg: str) -> bool:
+        store = self._tiers.get((interval, agg.lower()))
+        return store is not None and store.total_points() > 0

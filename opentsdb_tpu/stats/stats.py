@@ -1,0 +1,202 @@
+"""Observability (ref: ``src/stats/``).
+
+- :class:`StatsCollector` — push-style visitor every component implements
+  ``collect_stats(collector)`` against (ref: StatsCollector.java:35).
+- :class:`Histogram` — fixed-bucket latency histogram with percentile
+  extraction (ref: src/stats/Histogram.java:38).
+- :class:`QueryStats` — per-query trace threaded through the read path,
+  with a registry of running/completed queries for ``/api/stats/query``
+  (ref: src/stats/QueryStats.java:58).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from enum import Enum
+from typing import Any
+
+
+class StatsCollector:
+    """(ref: StatsCollector.java:35) Collects ``name value tags`` records."""
+
+    def __init__(self, prefix: str = "tsd"):
+        self.prefix = prefix
+        self.records: list[tuple[str, float, dict[str, str]]] = []
+        self._extra_tags: dict[str, str] = {}
+
+    def add_extra_tag(self, key: str, value: str) -> None:
+        self._extra_tags[key] = value
+
+    def clear_extra_tag(self, key: str) -> None:
+        self._extra_tags.pop(key, None)
+
+    def record(self, name: str, value: float, **tags: str) -> None:
+        all_tags = dict(self._extra_tags)
+        all_tags.update({k: str(v) for k, v in tags.items()})
+        self.records.append((f"{self.prefix}.{name}", float(value), all_tags))
+
+    def lines(self) -> list[str]:
+        """Telnet ``stats`` output format: ``name timestamp value k=v ...``"""
+        now = int(time.time())
+        out = []
+        for name, value, tags in self.records:
+            tag_str = " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            val = int(value) if float(value).is_integer() else value
+            out.append(f"{name} {now} {val}"
+                       + (f" {tag_str}" if tag_str else ""))
+        return out
+
+    def as_json(self) -> list[dict[str, Any]]:
+        now = int(time.time())
+        return [{"metric": name, "timestamp": now, "value": value,
+                 "tags": tags} for name, value, tags in self.records]
+
+
+class StatsCollectorRegistry:
+    """Aggregates collect_stats providers; owned by the TSDB."""
+
+    def __init__(self) -> None:
+        self._providers: list[Any] = []
+        self.latency_put = Histogram(16000, 2, 100)
+        self.latency_query = Histogram(16000, 2, 100)
+
+    def register(self, provider: Any) -> None:
+        self._providers.append(provider)
+
+    def collect(self, prefix: str = "tsd") -> StatsCollector:
+        collector = StatsCollector(prefix)
+        for p in self._providers:
+            p.collect_stats(collector)
+        return collector
+
+
+class Histogram:
+    """Exponentially-bucketed histogram (ref: src/stats/Histogram.java:38).
+
+    Buckets are linear (width ``interval``) up to ``cutoff``, then double
+    per bucket — same shape as the reference's constructor
+    ``Histogram(max, num_linear? , interval)`` usage for latencies.
+    """
+
+    def __init__(self, max_value: int = 16000, num_bands: int = 2,
+                 interval: int = 100):
+        self.interval = interval
+        self.max_value = max_value
+        n_linear = max(1, (max_value // (2 ** (num_bands - 1))) // interval)
+        self.bounds: list[int] = [interval * (i + 1) for i in range(n_linear)]
+        while self.bounds[-1] < max_value:
+            self.bounds.append(min(self.bounds[-1] * 2, max_value))
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            for i, b in enumerate(self.bounds):
+                if value <= b:
+                    self.buckets[i] += 1
+                    break
+            else:
+                self.buckets[-1] += 1
+            self.count += 1
+
+    def percentile(self, pct: float) -> float:
+        """(ref: Histogram.percentile)"""
+        if not 0 < pct <= 100:
+            raise ValueError(f"invalid percentile {pct}")
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            target = self.count * pct / 100.0
+            acc = 0
+            for i, c in enumerate(self.buckets):
+                acc += c
+                if acc >= target:
+                    return float(self.bounds[min(i, len(self.bounds) - 1)])
+            return float(self.bounds[-1])
+
+    def print_ascii(self) -> str:
+        lines = []
+        lo = 0
+        for i, c in enumerate(self.buckets[:-1]):
+            lines.append(f"[{lo}-{self.bounds[i]}): {c}")
+            lo = self.bounds[i]
+        lines.append(f"[{lo}-inf): {self.buckets[-1]}")
+        return "\n".join(lines)
+
+
+class QueryStat(Enum):
+    """Stat points recorded along the read path
+    (ref: QueryStats.java QueryStat enum :132)."""
+    COMPILATION_TIME = "compilationTime"
+    UID_TO_STRING_TIME = "uidToStringTime"
+    STRING_TO_UID_TIME = "stringToUidTime"
+    SCANNER_TIME = "scannerTime"
+    SCANNER_UID_TO_STRING_TIME = "scannerUidToStringTime"
+    MATERIALIZE_TIME = "materializeTime"
+    DEVICE_TRANSFER_TIME = "deviceTransferTime"
+    COMPUTE_TIME = "computeTime"
+    AGGREGATION_TIME = "aggregationTime"
+    GROUP_BY_TIME = "groupByTime"
+    SERIALIZATION_TIME = "serializationTime"
+    TOTAL_TIME = "totalTime"
+    ROWS_SCANNED = "rowsScanned"
+    DPS_PRE_FILTER = "dpsPreFilter"
+    DPS_POST_FILTER = "dpsPostFilter"
+    EMITTED_DPS = "emittedDPs"
+    MAX_HBM_BYTES = "maxHbmBytes"
+
+
+class QueryStats:
+    """Per-query trace (ref: QueryStats.java:58). Register on start,
+    mark complete on finish; recent queries are browsable at
+    ``/api/stats/query``."""
+
+    _running: "dict[int, QueryStats]" = {}
+    _completed: "deque[QueryStats]" = deque(maxlen=50)
+    _registry_lock = threading.Lock()
+    _next_id = 0
+
+    def __init__(self, remote: str = "", query: Any = None):
+        self.remote = remote
+        self.query = query
+        self.start_ns = time.monotonic_ns()
+        self.start_time = time.time()
+        self.stats: dict[str, float] = {}
+        self.executed = False
+        with QueryStats._registry_lock:
+            QueryStats._next_id += 1
+            self.query_id = QueryStats._next_id
+            QueryStats._running[self.query_id] = self
+
+    def add_stat(self, stat: QueryStat, value: float) -> None:
+        self.stats[stat.value] = self.stats.get(stat.value, 0.0) + value
+
+    def mark_serialization_successful(self) -> None:
+        self.executed = True
+        self.stats[QueryStat.TOTAL_TIME.value] = (
+            (time.monotonic_ns() - self.start_ns) / 1e6)
+        with QueryStats._registry_lock:
+            QueryStats._running.pop(self.query_id, None)
+            QueryStats._completed.append(self)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "queryId": self.query_id,
+            "remote": self.remote,
+            "queryStartTimestamp": int(self.start_time * 1000),
+            "executed": self.executed,
+            "stats": self.stats,
+            "query": (self.query.to_json()
+                      if hasattr(self.query, "to_json") else None),
+        }
+
+    @classmethod
+    def running_and_completed(cls) -> dict[str, list[dict[str, Any]]]:
+        with cls._registry_lock:
+            return {
+                "running": [q.to_json() for q in cls._running.values()],
+                "completed": [q.to_json() for q in cls._completed],
+            }

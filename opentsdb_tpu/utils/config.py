@@ -1,0 +1,171 @@
+"""Flat ``tsd.*`` configuration (ref: ``src/utils/Config.java``).
+
+Same shape as the reference: a flat string->string property map with typed
+getters, defaults, auto-discovered config file paths, and runtime
+overrides. Keys keep the reference's ``tsd.`` namespace so existing
+opentsdb.conf files parse unchanged; TPU-specific keys live under
+``tsd.tpu.*``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterator
+
+_DEFAULTS: dict[str, str] = {
+    # network (ref: Config.java defaults + src/opentsdb.conf)
+    "tsd.network.port": "4242",
+    "tsd.network.bind": "0.0.0.0",
+    "tsd.network.backlog": "3072",
+    "tsd.network.tcp_no_delay": "true",
+    "tsd.network.keep_alive": "true",
+    "tsd.network.reuse_address": "true",
+    # http
+    "tsd.http.request.enable_chunked": "true",
+    "tsd.http.request.max_chunk": "1048576",
+    "tsd.http.request.cors_domains": "",
+    "tsd.http.request.cors_headers": (
+        "Authorization, Content-Type, Accept, Origin, User-Agent, "
+        "DNT, Cache-Control, X-Mx-ReqToken, Keep-Alive, X-Requested-With, "
+        "If-Modified-Since"),
+    "tsd.http.cachedir": "/tmp/opentsdb_tpu",
+    "tsd.http.staticroot": "",
+    "tsd.http.show_stack_trace": "false",
+    # core
+    "tsd.core.auto_create_metrics": "false",
+    "tsd.core.auto_create_tagks": "true",
+    "tsd.core.auto_create_tagvs": "true",
+    "tsd.core.meta.enable_realtime_ts": "false",
+    "tsd.core.meta.enable_realtime_uid": "false",
+    "tsd.core.meta.enable_tsuid_incrementing": "false",
+    "tsd.core.meta.enable_tsuid_tracking": "false",
+    "tsd.core.tree.enable_processing": "false",
+    "tsd.core.preload_uid_cache": "false",
+    "tsd.core.timezone": "",
+    "tsd.mode": "rw",  # rw | ro | wo (ref: TSDB.java:103)
+    # uid
+    "tsd.core.uid.random_metrics": "false",
+    "tsd.storage.uid.width.metric": "3",
+    "tsd.storage.uid.width.tagk": "3",
+    "tsd.storage.uid.width.tagv": "3",
+    # storage
+    "tsd.storage.enable_compaction": "true",
+    "tsd.storage.enable_appends": "false",
+    "tsd.storage.fix_duplicates": "false",
+    "tsd.storage.salt.width": "0",
+    "tsd.storage.salt.buckets": "20",
+    "tsd.storage.flush_interval": "1000",
+    "tsd.storage.backend": "memory",  # memory | native (C++ arena store)
+    "tsd.storage.data_dir": "",       # non-empty => durable snapshots
+    # query
+    "tsd.query.timeout": "0",
+    "tsd.query.allow_simultaneous_duplicates": "true",
+    "tsd.query.limits.bytes.default": "0",
+    "tsd.query.limits.data_points.default": "0",
+    "tsd.query.skip_unresolved_tagvs": "false",
+    # rollups (ref: TSDB.java:170-185)
+    "tsd.rollups.enable": "false",
+    "tsd.rollups.config": "",
+    "tsd.rollups.tag_raw": "false",
+    "tsd.rollups.agg_tag_key": "_aggregate",
+    "tsd.rollups.raw_agg_tag_value": "RAW",
+    "tsd.rollups.block_derived": "true",
+    # auth
+    "tsd.core.authentication.enable": "false",
+    # stats
+    "tsd.stats.canonical": "false",
+    # TPU-native keys (no reference equivalent)
+    "tsd.tpu.dtype": "float32",
+    "tsd.tpu.mesh.series_axis": "8",
+    "tsd.tpu.mesh.time_axis": "1",
+    "tsd.tpu.time_block_points": "134217728",  # points per device block
+    "tsd.tpu.donate_buffers": "true",
+}
+
+_SEARCH_PATHS = (
+    "./opentsdb.conf",
+    "/etc/opentsdb.conf",
+    "/etc/opentsdb/opentsdb.conf",
+    "/opt/opentsdb/opentsdb.conf",
+)
+
+
+class Config:
+    """(ref: src/utils/Config.java:52)"""
+
+    def __init__(self, config_file: str | None = None,
+                 auto_load: bool = False, **overrides: Any):
+        self._props: dict[str, str] = dict(_DEFAULTS)
+        self.config_location: str | None = None
+        if config_file:
+            self.load_file(config_file)
+        elif auto_load:
+            for path in _SEARCH_PATHS:
+                if os.path.isfile(path):
+                    self.load_file(path)
+                    break
+        for key, val in overrides.items():
+            self._props[key.replace("__", ".")] = str(val)
+
+    def load_file(self, path: str) -> None:
+        """Parse a java-properties-style file (``key = value`` lines)."""
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith(("#", "!")):
+                    continue
+                for sep in ("=", ":"):
+                    idx = line.find(sep)
+                    if idx > 0:
+                        self._props[line[:idx].strip()] = line[idx + 1:].strip()
+                        break
+        self.config_location = path
+
+    # typed getters (ref: Config.java:328-429)
+
+    def get_string(self, key: str, default: str | None = None) -> str:
+        if key in self._props:
+            return self._props[key]
+        if default is not None:
+            return default
+        raise KeyError(key)
+
+    def get_int(self, key: str, default: int | None = None) -> int:
+        try:
+            return int(self._props[key])
+        except KeyError:
+            if default is not None:
+                return default
+            raise
+
+    def get_float(self, key: str, default: float | None = None) -> float:
+        try:
+            return float(self._props[key])
+        except KeyError:
+            if default is not None:
+                return default
+            raise
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        val = self._props.get(key)
+        if val is None:
+            return default
+        return val.strip().lower() in ("true", "1", "yes")
+
+    def has_property(self, key: str) -> bool:
+        return key in self._props
+
+    def override_config(self, key: str, value: Any) -> None:
+        """(ref: Config.java:317)"""
+        self._props[key] = str(value)
+
+    def dump_configuration(self) -> dict[str, str]:
+        """All properties for ``/api/config`` (secrets redacted like the
+        reference redacts passwords)."""
+        out = {}
+        for k, v in sorted(self._props.items()):
+            out[k] = "********" if "pass" in k.lower() else v
+        return out
+
+    def __iter__(self) -> Iterator[tuple[str, str]]:
+        return iter(self._props.items())

@@ -1,0 +1,52 @@
+"""Plugin loading (ref: ``src/utils/PluginLoader.java:66``).
+
+The reference loads plugin jars via ServiceLoader; here plugins are
+dotted-path Python classes named in config, e.g.::
+
+    tsd.rtpublisher.plugin = mypkg.mymod.MyPublisher
+    tsd.rtpublisher.enable = true
+
+Each plugin class is instantiated with no args, then ``initialize(tsdb)``
+is called if present. The 12 plugin ABIs of the reference (RTPublisher,
+SearchPlugin, StorageExceptionHandler, RpcPlugin, HttpRpcPlugin,
+HttpSerializer, WriteableDataPointFilterPlugin, UniqueIdFilterPlugin,
+MetaDataCache, StartupPlugin, Authentication, HistogramDataPointCodec)
+all load through this mechanism.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+def load_class(dotted_path: str) -> type:
+    module_name, _, class_name = dotted_path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"invalid plugin path {dotted_path!r}")
+    module = importlib.import_module(module_name)
+    try:
+        return getattr(module, class_name)
+    except AttributeError:
+        raise ImportError(
+            f"module {module_name!r} has no class {class_name!r}") from None
+
+
+def load_plugin_instances(config, prefix: str, single: bool = False) -> Any:
+    """Load plugins configured at ``<prefix>.plugin`` when
+    ``<prefix>.enable`` is true. Returns an instance, a list, or None."""
+    if not config.get_bool(f"{prefix}.enable", False):
+        return None if single else []
+    spec = config.get_string(f"{prefix}.plugin", "")
+    if not spec:
+        return None if single else []
+    instances = []
+    for path in spec.split(","):
+        cls = load_class(path.strip())
+        inst = cls()
+        if hasattr(inst, "initialize"):
+            inst.initialize(config)
+        instances.append(inst)
+    if single:
+        return instances[0] if instances else None
+    return instances

@@ -1,0 +1,56 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so the multi-chip sharding
+paths (shard_map over the series/salt axis) execute without TPU hardware —
+the TPU analogue of the reference's Salted/unsalted test-matrix trick
+(SURVEY.md §4: every TestTsdbQuery has a *Salted twin exercising the
+20-way parallel merge without a cluster).
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Tests compare against float64 golden values computed with numpy.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def tsdb():
+    """A TSDB with auto-create enabled — the BaseTsdbTest analogue
+    (ref: test/core/BaseTsdbTest.java:72)."""
+    from opentsdb_tpu import TSDB, Config
+    return TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.rollups.enable": "true",
+    }))
+
+
+@pytest.fixture
+def seeded_tsdb(tsdb):
+    """TSDB pre-loaded with the canonical two-series fixture used across
+    the reference query tests (sys.cpu.user on web01/web02)."""
+    base = 1356998400  # 2013-01-01 00:00:00 UTC, the reference's fixture time
+    for i in range(300):
+        tsdb.add_point("sys.cpu.user", base + i * 10, i,
+                       {"host": "web01"})
+        tsdb.add_point("sys.cpu.user", base + i * 10, 300 - i,
+                       {"host": "web02"})
+    return tsdb
+
+
+def make_regular_series(n_series: int, n_points: int, start_ms: int = 0,
+                        step_ms: int = 1000, seed: int = 42):
+    """Synthetic regular-cadence data: (ts[n_points], vals[n_series, n_points])."""
+    rng = np.random.default_rng(seed)
+    ts = start_ms + np.arange(n_points, dtype=np.int64) * step_ms
+    vals = rng.normal(100.0, 10.0, size=(n_series, n_points))
+    return ts, vals

@@ -1,0 +1,148 @@
+"""Storage codec tests (ref: test/core/TestInternal.java, TestRowKey.java)."""
+
+import pytest
+
+from opentsdb_tpu.core import codec, const
+
+
+class TestValueCodec:
+    @pytest.mark.parametrize("value,expected_len,expected_flags", [
+        (0, 1, 0), (127, 1, 0), (-128, 1, 0),
+        (128, 2, 1), (-129, 2, 1), (32767, 2, 1),
+        (32768, 4, 3), (2**31 - 1, 4, 3),
+        (2**31, 8, 7), (-2**63, 8, 7),
+        (4.2, 8, const.FLAG_FLOAT | 7),   # not exact in f32
+        (1.5, 4, const.FLAG_FLOAT | 3),   # exact in f32
+        (0.0, 4, const.FLAG_FLOAT | 3),
+    ])
+    def test_roundtrip(self, value, expected_len, expected_flags):
+        data, flags = codec.encode_value(value)
+        assert len(data) == expected_len
+        assert flags == expected_flags
+        out = codec.decode_value(data, flags)
+        assert out == value
+        assert isinstance(out, float) == isinstance(value, float)
+
+    def test_int64_overflow_rejected(self):
+        with pytest.raises(ValueError):
+            codec.encode_value(2**63)
+
+    def test_bad_length_rejected(self):
+        with pytest.raises(codec.IllegalDataError):
+            codec.decode_value(b"\x00\x00\x00", 3)  # flags say 4 bytes
+
+
+class TestQualifier:
+    def test_second_precision(self):
+        # ts 1356998430 = base 1356998400 + 30s; int 4-byte flags=3
+        q = codec.build_qualifier(1356998430, 0x3)
+        assert len(q) == 2
+        offset_ms, flags = codec.parse_qualifier(q)
+        assert offset_ms == 30000
+        assert flags == 0x3
+        assert not codec.qualifier_is_ms(q)
+
+    def test_ms_precision(self):
+        ts = 1356998430123
+        q = codec.build_qualifier(ts, const.FLAG_FLOAT | 0x3)
+        assert len(q) == 4
+        assert codec.qualifier_is_ms(q)
+        offset_ms, flags = codec.parse_qualifier(q)
+        assert offset_ms == 30123
+        assert flags == (const.FLAG_FLOAT | 0x3)
+
+    def test_max_second_delta(self):
+        q = codec.build_qualifier(1356998400 + 3599, 0x7)
+        offset_ms, flags = codec.parse_qualifier(q)
+        assert offset_ms == 3599000
+        assert flags == 0x7
+
+    def test_base_time_alignment(self):
+        assert codec.base_time(1356998430) == 1356998400
+        assert codec.base_time(1356998430123) == 1356998400
+        assert codec.base_time(3600) == 3600
+        assert codec.base_time(3599) == 0
+
+
+class TestRowKey:
+    METRIC = b"\x00\x00\x01"
+    TAGK = b"\x00\x00\x02"
+    TAGV = b"\x00\x00\x03"
+
+    def test_build_parse_roundtrip(self):
+        key = codec.build_row_key(self.METRIC, 1356998430,
+                                  {self.TAGK: self.TAGV}, salt_width=0)
+        assert key == (self.METRIC + (1356998400).to_bytes(4, "big")
+                       + self.TAGK + self.TAGV)
+        parsed = codec.parse_row_key(key, salt_width=0)
+        assert parsed.metric_uid == self.METRIC
+        assert parsed.base_time == 1356998400
+        assert parsed.tags == ((self.TAGK, self.TAGV),)
+
+    def test_tags_sorted_by_tagk(self):
+        k1, v1 = b"\x00\x00\x09", b"\x00\x00\x0a"
+        k2, v2 = b"\x00\x00\x02", b"\x00\x00\x0b"
+        key = codec.build_row_key(self.METRIC, 0, [(k1, v1), (k2, v2)],
+                                  salt_width=0)
+        parsed = codec.parse_row_key(key, salt_width=0)
+        assert parsed.tags == ((k2, v2), (k1, v1))
+
+    def test_salted_key(self):
+        key = codec.build_row_key(self.METRIC, 1356998430,
+                                  {self.TAGK: self.TAGV},
+                                  salt_width=1, salt_buckets=20)
+        assert len(key) == 1 + 3 + 4 + 6
+        assert 0 <= key[0] < 20
+        parsed = codec.parse_row_key(key, salt_width=1)
+        assert parsed.metric_uid == self.METRIC
+        # same series at a different hour lands in the same bucket
+        key2 = codec.build_row_key(self.METRIC, 1356998430 + 7200,
+                                   {self.TAGK: self.TAGV},
+                                   salt_width=1, salt_buckets=20)
+        assert key2[0] == key[0]
+
+    def test_tsuid_from_row_key(self):
+        key = codec.build_row_key(self.METRIC, 1356998430,
+                                  {self.TAGK: self.TAGV}, salt_width=0)
+        assert codec.tsuid_from_row_key(key, salt_width=0) == \
+            self.METRIC + self.TAGK + self.TAGV
+
+
+class TestCompaction:
+    """(ref: test/core/TestCompactionQueue.java)"""
+
+    def _cell(self, ts, value):
+        vbytes, flags = codec.encode_value(value)
+        return codec.Cell(codec.build_qualifier(ts, flags), vbytes)
+
+    def test_compact_and_iterate(self):
+        base = 1356998400
+        cells = [self._cell(base + 30, 42), self._cell(base + 10, 1.5),
+                 self._cell(base + 20, 7)]
+        compacted = codec.compact_cells(cells)
+        pts = list(compacted.datapoints(base))
+        assert pts == [(base * 1000 + 10000, 1.5),
+                       (base * 1000 + 20000, 7),
+                       (base * 1000 + 30000, 42)]
+
+    def test_mixed_precision_gets_flag_byte(self):
+        base = 1356998400
+        cells = [self._cell(base + 1, 1), self._cell(base * 1000 + 2500, 2)]
+        compacted = codec.compact_cells(cells)
+        assert compacted.value[-1] == const.MS_MIXED_COMPACT
+        pts = [v for _, v in compacted.datapoints(base)]
+        assert pts == [1, 2]
+
+    def test_duplicate_timestamp_last_wins(self):
+        base = 1356998400
+        cells = [self._cell(base + 5, 1), self._cell(base + 5, 99)]
+        compacted = codec.compact_cells(cells)
+        pts = list(compacted.datapoints(base))
+        assert pts == [(base * 1000 + 5000, 99)]
+
+    def test_compacted_roundtrip_through_iter_cell(self):
+        base = 1356998400
+        cells = [self._cell(base + i, i * 1.5) for i in range(10)]
+        compacted = codec.compact_cells(cells)
+        vals = [v for _, v in compacted.datapoints(base)]
+        assert vals == [i * 1.5 for i in range(10)]

@@ -1,0 +1,146 @@
+"""Date/time parsing tests (ref: test/utils/TestDateTime.java)."""
+
+import pytest
+
+from opentsdb_tpu.utils import datetime_util as dt
+
+
+class TestParseDuration:
+    @pytest.mark.parametrize("s,expected_ms", [
+        ("500ms", 500), ("60s", 60_000), ("10m", 600_000),
+        ("2h", 7_200_000), ("1d", 86_400_000), ("1w", 604_800_000),
+        ("1n", 2_592_000_000), ("1y", 31_536_000_000),
+    ])
+    def test_units(self, s, expected_ms):
+        assert dt.parse_duration_ms(s) == expected_ms
+
+    @pytest.mark.parametrize("bad", ["", "60", "s", "-1s", "0s", "1.5h", "1x"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            dt.parse_duration_ms(bad)
+
+    def test_unit_and_interval_extraction(self):
+        assert dt.duration_unit("15m") == "m"
+        assert dt.duration_interval("15m") == 15
+        assert dt.duration_unit("500ms") == "ms"
+
+
+class TestParseDateTime:
+    NOW = 1700000000000
+
+    def test_now(self):
+        assert dt.parse_datetime_ms("now", now_ms=self.NOW) == self.NOW
+
+    def test_relative_ago(self):
+        assert dt.parse_datetime_ms("1h-ago", now_ms=self.NOW) == \
+            self.NOW - 3_600_000
+        assert dt.parse_datetime_ms("30m-ago", now_ms=self.NOW) == \
+            self.NOW - 1_800_000
+
+    def test_unix_seconds(self):
+        assert dt.parse_datetime_ms("1356998400") == 1356998400000
+
+    def test_unix_ms(self):
+        assert dt.parse_datetime_ms("1356998400000") == 1356998400000
+
+    def test_unix_fractional(self):
+        assert dt.parse_datetime_ms("1356998400.123") == 1356998400123
+        assert dt.parse_datetime_ms("1356998400.5") == 1356998400500
+
+    def test_raw_ms_suffix(self):
+        assert dt.parse_datetime_ms("1356998400123ms") == 1356998400123
+
+    def test_absolute_formats_utc(self):
+        assert dt.parse_datetime_ms("2013/01/01", tz="UTC") == 1356998400000
+        assert dt.parse_datetime_ms("2013/01/01-00:30", tz="UTC") == \
+            1356998400000 + 1800_000
+        assert dt.parse_datetime_ms("2013/01/01 00:30:15", tz="UTC") == \
+            1356998400000 + 1815_000
+
+    def test_timezone(self):
+        utc = dt.parse_datetime_ms("2013/06/01-12:00", tz="UTC")
+        denver = dt.parse_datetime_ms("2013/06/01-12:00", tz="America/Denver")
+        assert denver - utc == 6 * 3_600_000  # MDT = UTC-6
+
+    def test_empty_returns_minus_one(self):
+        assert dt.parse_datetime_ms("") == -1
+
+    @pytest.mark.parametrize("bad", ["nope", "-5", "12345678901234567x"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            dt.parse_datetime_ms(bad)
+
+
+class TestCalendarIntervals:
+    """(ref: DateTime.previousInterval, DateTime.java:394-470)"""
+
+    # 2013-06-19 01:23:43.5 UTC (a Wednesday)
+    TS = dt.parse_datetime_ms("2013/06/19-01:23:43", tz="UTC") + 500
+
+    def test_minute_snap(self):
+        got = dt.previous_interval_ms(self.TS, 15, "m", tz="UTC")
+        assert got == dt.parse_datetime_ms("2013/06/19-01:15", tz="UTC")
+
+    def test_hour_snap(self):
+        got = dt.previous_interval_ms(self.TS, 1, "h", tz="UTC")
+        assert got == dt.parse_datetime_ms("2013/06/19-01:00", tz="UTC")
+
+    def test_day_snap(self):
+        got = dt.previous_interval_ms(self.TS, 1, "d", tz="UTC")
+        assert got == dt.parse_datetime_ms("2013/06/19", tz="UTC")
+
+    def test_week_snaps_to_sunday(self):
+        got = dt.previous_interval_ms(self.TS, 1, "w", tz="UTC")
+        assert got == dt.parse_datetime_ms("2013/06/16", tz="UTC")
+
+    def test_month_snap(self):
+        got = dt.previous_interval_ms(self.TS, 1, "n", tz="UTC")
+        assert got == dt.parse_datetime_ms("2013/06/01", tz="UTC")
+
+    def test_year_snap(self):
+        got = dt.previous_interval_ms(self.TS, 1, "y", tz="UTC")
+        assert got == dt.parse_datetime_ms("2013/01/01", tz="UTC")
+
+    def test_next_interval(self):
+        start = dt.previous_interval_ms(self.TS, 1, "n", tz="UTC")
+        nxt = dt.next_interval_ms(self.TS, 1, "n", tz="UTC")
+        assert nxt == dt.parse_datetime_ms("2013/07/01", tz="UTC")
+        assert nxt > start
+
+    def test_timezone_day_boundary(self):
+        # 01:23 UTC on Jun 19 is still Jun 18 in Denver
+        got = dt.previous_interval_ms(self.TS, 1, "d", tz="America/Denver")
+        assert got == dt.parse_datetime_ms("2013/06/18",
+                                           tz="America/Denver")
+
+
+class TestTags:
+    def test_validate(self):
+        from opentsdb_tpu.core import tags
+        tags.validate_string("metric", "sys.cpu-0_a/b")
+        with pytest.raises(ValueError):
+            tags.validate_string("metric", "bad metric")
+        with pytest.raises(ValueError):
+            tags.validate_string("metric", "")
+
+    def test_parse(self):
+        from opentsdb_tpu.core import tags
+        assert tags.parse("host=web01") == ("host", "web01")
+        for bad in ("hostweb01", "host=", "=web01", "a=b=c"):
+            with pytest.raises(ValueError):
+                tags.parse(bad)
+
+    def test_parse_with_metric(self):
+        from opentsdb_tpu.core import tags
+        m, t = tags.parse_with_metric("sys.cpu{host=a,dc=b}")
+        assert m == "sys.cpu" and t == {"host": "a", "dc": "b"}
+        m, t = tags.parse_with_metric("sys.cpu")
+        assert m == "sys.cpu" and t == {}
+
+    def test_max_tags(self):
+        from opentsdb_tpu.core import tags
+        many = {f"k{i}": "v" for i in range(9)}
+        with pytest.raises(ValueError):
+            tags.check_metric_and_tags("m", many)
+        with pytest.raises(ValueError):
+            tags.check_metric_and_tags("m", {})

@@ -1,0 +1,121 @@
+"""Column store tests (ref: test/core/TestRowSeq.java + scan tests)."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.store import SeriesBuffer, TimeSeriesStore
+
+
+class TestSeriesBuffer:
+    def test_append_and_view(self):
+        buf = SeriesBuffer()
+        for i in range(100):
+            buf.append(i * 1000, float(i), True)
+        ts, vals = buf.view()
+        assert len(buf) == 100
+        np.testing.assert_array_equal(ts, np.arange(100) * 1000)
+        np.testing.assert_array_equal(vals, np.arange(100.0))
+
+    def test_out_of_order_sorted_on_read(self):
+        buf = SeriesBuffer()
+        for t in (5000, 1000, 3000, 2000, 4000):
+            buf.append(t, t / 1000.0, False)
+        ts, vals = buf.view()
+        np.testing.assert_array_equal(ts, [1000, 2000, 3000, 4000, 5000])
+        np.testing.assert_array_equal(vals, [1.0, 2.0, 3.0, 4.0, 5.0])
+
+    def test_duplicate_last_write_wins(self):
+        buf = SeriesBuffer()
+        buf.append(1000, 1.0, False)
+        buf.append(1000, 99.0, False)
+        buf.append(2000, 2.0, False)
+        ts, vals = buf.view()
+        np.testing.assert_array_equal(ts, [1000, 2000])
+        np.testing.assert_array_equal(vals, [99.0, 2.0])
+
+    def test_slice_range_inclusive(self):
+        buf = SeriesBuffer()
+        for t in range(10):
+            buf.append(t * 1000, float(t), False)
+        ts, vals = buf.slice_range(2000, 5000)
+        np.testing.assert_array_equal(ts, [2000, 3000, 4000, 5000])
+
+    def test_append_many(self):
+        buf = SeriesBuffer()
+        buf.append_many(np.arange(5) * 1000, np.arange(5.0))
+        buf.append_many(np.arange(5, 1000) * 1000, np.arange(5.0, 1000.0))
+        ts, vals = buf.view()
+        assert len(ts) == 1000
+        np.testing.assert_array_equal(vals, np.arange(1000.0))
+
+    def test_append_many_unsorted_batch(self):
+        buf = SeriesBuffer()
+        buf.append_many(np.array([3000, 1000, 2000]),
+                        np.array([3.0, 1.0, 2.0]))
+        ts, vals = buf.view()
+        np.testing.assert_array_equal(ts, [1000, 2000, 3000])
+
+
+class TestTimeSeriesStore:
+    def test_series_identity(self):
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(1, 1)])
+        b = store.get_or_create_series(1, [(1, 2)])
+        a2 = store.get_or_create_series(1, [(1, 1)])
+        assert a == a2 and a != b
+        assert store.num_series() == 2
+
+    def test_tag_order_canonicalized(self):
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(2, 5), (1, 4)])
+        b = store.get_or_create_series(1, [(1, 4), (2, 5)])
+        assert a == b
+
+    def test_materialize(self):
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(1, 1)])
+        b = store.get_or_create_series(1, [(1, 2)])
+        for i in range(10):
+            store.append(a, i * 1000, float(i))
+        for i in range(5):
+            store.append(b, i * 2000, float(i * 10))
+        batch = store.materialize([a, b], 0, 100_000)
+        assert batch.num_series == 2
+        assert batch.num_points == 15
+        # series_idx is dense positions into series_ids
+        np.testing.assert_array_equal(np.unique(batch.series_idx), [0, 1])
+        sel = batch.series_idx == 1
+        np.testing.assert_array_equal(batch.values[sel],
+                                      [0.0, 10.0, 20.0, 30.0, 40.0])
+
+    def test_materialize_time_window(self):
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(1, 1)])
+        for i in range(100):
+            store.append(a, i * 1000, float(i))
+        batch = store.materialize([a], 10_000, 19_999)
+        assert batch.num_points == 10
+
+    def test_materialize_empty(self):
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(1, 1)])
+        batch = store.materialize([a], 0, 1000)
+        assert batch.num_points == 0
+        assert batch.num_series == 1
+
+    def test_metric_index(self):
+        store = TimeSeriesStore()
+        for v in range(10):
+            store.get_or_create_series(1, [(1, v)])
+        store.get_or_create_series(2, [(1, 0)])
+        sids = store.series_ids_for_metric(1)
+        assert len(sids) == 10
+        sids_arr, tag_arr = store.metric_index(1).arrays()
+        assert tag_arr.shape == (10, 3)
+        np.testing.assert_array_equal(tag_arr[:, 1], np.ones(10))  # tagk=1
+
+    def test_sharding_stable(self):
+        store = TimeSeriesStore(num_shards=8)
+        a = store.get_or_create_series(1, [(1, 1)])
+        shards = store.shards_of([a])
+        assert 0 <= shards[0] < 8

@@ -1,0 +1,114 @@
+"""UID service tests (ref: test/uid/TestUniqueId.java)."""
+
+import threading
+
+import pytest
+
+from opentsdb_tpu.core.uid import (FailedToAssignUniqueIdError, NoSuchUniqueId,
+                                   NoSuchUniqueName, UidRegistry, UniqueId)
+
+
+class TestUniqueId:
+    def test_assignment_is_monotonic(self):
+        uid = UniqueId("metric")
+        assert uid.get_or_create_id("a") == 1
+        assert uid.get_or_create_id("b") == 2
+        assert uid.get_or_create_id("a") == 1
+
+    def test_lookup_missing_raises(self):
+        uid = UniqueId("metric")
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("nope")
+        with pytest.raises(NoSuchUniqueId):
+            uid.get_name(42)
+
+    def test_bytes_codec(self):
+        uid = UniqueId("metric", width=3)
+        i = uid.get_or_create_id("m")
+        assert uid.int_to_uid(i) == b"\x00\x00\x01"
+        assert uid.uid_to_int(b"\x00\x00\x01") == 1
+        assert uid.get_name(b"\x00\x00\x01") == "m"
+
+    def test_width_exhaustion(self):
+        uid = UniqueId("metric", width=1)
+        for i in range(255):
+            uid.get_or_create_id(f"m{i}")
+        with pytest.raises(FailedToAssignUniqueIdError):
+            uid.get_or_create_id("one-too-many")
+
+    def test_explicit_assign_conflicts(self):
+        uid = UniqueId("metric")
+        uid.assign_id("m")
+        with pytest.raises(FailedToAssignUniqueIdError):
+            uid.assign_id("m")
+
+    def test_rename(self):
+        uid = UniqueId("metric")
+        i = uid.get_or_create_id("old")
+        uid.rename("old", "new")
+        assert uid.get_id("new") == i
+        assert uid.get_name(i) == "new"
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("old")
+
+    def test_random_ids(self):
+        uid = UniqueId("metric", random_ids=True)
+        ids = {uid.get_or_create_id(f"m{i}") for i in range(100)}
+        assert len(ids) == 100
+        assert all(1 <= i <= uid.max_possible_id for i in ids)
+
+    def test_filter_veto(self):
+        uid = UniqueId("metric",
+                       filter_fn=lambda kind, name: not name.startswith("x"))
+        uid.get_or_create_id("ok")
+        with pytest.raises(FailedToAssignUniqueIdError):
+            uid.get_or_create_id("xbad")
+
+    def test_suggest(self):
+        uid = UniqueId("metric")
+        for name in ("sys.cpu.user", "sys.cpu.sys", "sys.mem.free", "proc.x"):
+            uid.get_or_create_id(name)
+        assert uid.suggest("sys.cpu") == ["sys.cpu.sys", "sys.cpu.user"]
+        assert uid.suggest("sys", max_results=2) == \
+            ["sys.cpu.sys", "sys.cpu.user"]
+
+    def test_concurrent_assignment_no_duplicates(self):
+        """The atomic-increment + CAS dedupe contract
+        (ref: UniqueId.java:117 pending-assignment map)."""
+        uid = UniqueId("tagv")
+        results: list[int] = []
+
+        def worker():
+            for i in range(200):
+                results.append(uid.get_or_create_id(f"v{i % 50}"))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(uid) == 50
+        # every name resolved to exactly one id everywhere
+        by_name = {}
+        for i in range(50):
+            by_name[f"v{i}"] = uid.get_id(f"v{i}")
+        assert len(set(by_name.values())) == 50
+
+
+class TestUidRegistry:
+    def test_tsuid(self):
+        reg = UidRegistry()
+        m = reg.metrics.get_or_create_id("sys.cpu.user")
+        k = reg.tag_names.get_or_create_id("host")
+        v = reg.tag_values.get_or_create_id("web01")
+        tsuid = reg.tsuid(m, [(k, v)])
+        assert tsuid == b"\x00\x00\x01\x00\x00\x01\x00\x00\x01"
+        assert tsuid.hex().upper() == "000001000001000001"
+
+    def test_by_kind(self):
+        reg = UidRegistry()
+        assert reg.by_kind("metric") is reg.metrics
+        assert reg.by_kind("tagk") is reg.tag_names
+        assert reg.by_kind("tagv") is reg.tag_values
+        with pytest.raises(ValueError):
+            reg.by_kind("bogus")
