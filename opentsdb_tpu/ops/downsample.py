@@ -1,0 +1,250 @@
+"""Downsampling: time-bucket reduction ahead of aggregation.
+
+(ref: ``src/core/Downsampler.java``, ``FillingDownsampler.java``,
+``DownsamplingSpecification.java``, ``FillPolicy.java``)
+
+The reference walks each span with a ``ValuesInInterval`` window iterator
+(Downsampler.java:295), one datapoint at a time. Here the whole query
+downsamples in one shot: every point of every series carries a segment
+id ``series_idx * num_buckets + bucket_idx`` and a single segmented
+reduction produces the dense ``[series, bucket]`` grid. Buckets a series
+has no data for hold NaN; the fill policy decides what happens to them
+downstream (NONE -> interpolate at merge / skip at emission; ZERO/NAN/
+NULL/SCALAR -> substitute).
+
+Calendar-aligned buckets (``1dc``, month/year intervals, timezones) get
+their edges precomputed on the host (``DateTime.previousInterval``
+semantics) and points are assigned by searchsorted — the kernels never
+see calendar logic (SURVEY.md §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opentsdb_tpu.ops import segment
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.utils import datetime_util
+
+
+class FillPolicy(Enum):
+    """(ref: src/core/FillPolicy.java:22)"""
+    NONE = "none"
+    ZERO = "zero"
+    NOT_A_NUMBER = "nan"
+    NULL = "null"
+    SCALAR = "scalar"
+
+    @classmethod
+    def from_string(cls, name: str) -> "FillPolicy":
+        for p in cls:
+            if p.value == name.lower():
+                return p
+        raise ValueError(f"Unrecognized fill policy: {name}")
+
+
+@dataclass(frozen=True)
+class DownsamplingSpecification:
+    """Parsed ``interval-function[-fillpolicy]`` spec
+    (ref: DownsamplingSpecification.java:82-116).
+
+    ``interval`` may be ``0all`` (single bucket over the whole query,
+    "run-all" mode) or carry a ``c`` suffix for calendar alignment
+    (``1dc``). ``fill`` scalar policy is written ``scalar#<value>``.
+    """
+    interval_ms: int
+    function: str
+    fill_policy: FillPolicy = FillPolicy.NONE
+    fill_value: float = float("nan")
+    use_calendar: bool = False
+    run_all: bool = False
+    interval: int = 0
+    unit: str = ""
+    timezone: str | None = None
+    string_interval: str = ""
+
+    @classmethod
+    def parse(cls, spec: str, timezone: str | None = None
+              ) -> "DownsamplingSpecification":
+        parts = spec.split("-")
+        if len(parts) < 2:
+            raise ValueError(
+                f"Invalid downsampling specification: {spec}")
+        interval_str, function = parts[0], parts[1]
+        fill_policy = FillPolicy.NONE
+        fill_value = float("nan")
+        if len(parts) >= 3:
+            fp = parts[2]
+            if fp.startswith("scalar#"):
+                fill_policy = FillPolicy.SCALAR
+                fill_value = float(fp.split("#", 1)[1])
+            else:
+                fill_policy = FillPolicy.from_string(fp)
+                if fill_policy == FillPolicy.ZERO:
+                    fill_value = 0.0
+        if not aggs_mod.exists(function):
+            raise ValueError(f"No such downsampling function: {function}")
+        if interval_str in ("0all", "all"):
+            return cls(interval_ms=0, function=function,
+                       fill_policy=fill_policy, fill_value=fill_value,
+                       run_all=True, string_interval=interval_str,
+                       timezone=timezone)
+        use_calendar = interval_str.endswith("c")
+        if use_calendar:
+            interval_str = interval_str[:-1]
+        interval = datetime_util.duration_interval(interval_str)
+        unit = datetime_util.duration_unit(interval_str)
+        interval_ms = datetime_util.parse_duration_ms(interval_str)
+        return cls(interval_ms=interval_ms, function=function,
+                   fill_policy=fill_policy, fill_value=fill_value,
+                   use_calendar=use_calendar, interval=interval, unit=unit,
+                   timezone=timezone, string_interval=interval_str)
+
+
+# ---------------------------------------------------------------------------
+# bucket assignment
+# ---------------------------------------------------------------------------
+
+def fixed_bucket_edges(start_ms: int, end_ms: int,
+                       interval_ms: int) -> np.ndarray:
+    """Bucket start times for a fixed interval: aligned down to the
+    interval like the reference aligns output timestamps
+    (Downsampler timestamps are modulo-aligned)."""
+    first = start_ms - (start_ms % interval_ms)
+    return np.arange(first, end_ms + 1, interval_ms, dtype=np.int64)
+
+
+def calendar_bucket_edges(start_ms: int, end_ms: int, interval: int,
+                          unit: str, tz: str | None) -> np.ndarray:
+    """Host-computed calendar bucket starts (tz/DST-aware)."""
+    edges = [datetime_util.previous_interval_ms(start_ms, interval, unit, tz)]
+    while edges[-1] <= end_ms:
+        edges.append(datetime_util.next_interval_ms(edges[-1], interval,
+                                                    unit, tz))
+    return np.asarray(edges[:-1] if edges[-1] > end_ms else edges,
+                      dtype=np.int64)
+
+
+def assign_buckets(ts_ms: np.ndarray, spec: DownsamplingSpecification,
+                   start_ms: int, end_ms: int
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: map point timestamps to bucket indices.
+
+    Returns ``(bucket_idx int32[N], bucket_ts int64[B])``.
+    """
+    if spec.run_all:
+        bucket_ts = np.asarray([start_ms], dtype=np.int64)
+        return np.zeros(len(ts_ms), dtype=np.int32), bucket_ts
+    if spec.use_calendar or spec.unit in ("n", "y"):
+        edges = calendar_bucket_edges(start_ms, end_ms, spec.interval,
+                                      spec.unit, spec.timezone)
+        idx = np.searchsorted(edges, ts_ms, side="right") - 1
+        return idx.astype(np.int32), edges
+    edges = fixed_bucket_edges(start_ms, end_ms, spec.interval_ms)
+    idx = ((ts_ms - edges[0]) // spec.interval_ms).astype(np.int32)
+    return idx, edges
+
+
+# ---------------------------------------------------------------------------
+# the bucketize kernel
+# ---------------------------------------------------------------------------
+
+# downsample functions implementable from O(1) segment statistics
+_SIMPLE_FNS = frozenset((
+    "sum", "zimsum", "pfsum", "min", "mimmin", "max", "mimmax", "avg",
+    "count", "first", "last", "multiply", "squareSum", "dev", "diff"))
+
+
+@partial(jax.jit, static_argnames=("num_series", "num_buckets", "function"))
+def bucketize(values, series_idx, bucket_idx, num_series: int,
+              num_buckets: int, function: str):
+    """Downsample a flat point batch into a dense ``[S, B]`` grid.
+
+    Returns ``(grid[S,B] with NaN holes, count[S,B])``. This is the
+    reference's whole Downsampler/FillingDownsampler pass as one fused
+    XLA program over every series at once.
+    """
+    nseg = num_series * num_buckets
+    seg_ids = series_idx.astype(jnp.int32) * num_buckets + bucket_idx
+    cnt = segment.seg_count(values, seg_ids, nseg)
+    mask = cnt > 0
+
+    if function in ("sum", "zimsum", "pfsum"):
+        out = segment.seg_sum(values, seg_ids, nseg)
+    elif function in ("min", "mimmin"):
+        out = segment.seg_min(values, seg_ids, nseg)
+    elif function in ("max", "mimmax"):
+        out = segment.seg_max(values, seg_ids, nseg)
+    elif function == "avg":
+        out = segment.seg_sum(values, seg_ids, nseg) / jnp.maximum(cnt, 1)
+    elif function == "count":
+        out = cnt.astype(values.dtype)
+    elif function == "multiply":
+        out = segment.seg_prod(values, seg_ids, nseg)
+    elif function == "squareSum":
+        out = segment.seg_sumsq(values, seg_ids, nseg)
+    elif function == "first":
+        out, _ = segment.seg_first_last(values, seg_ids, nseg)
+    elif function == "last":
+        _, out = segment.seg_first_last(values, seg_ids, nseg)
+    elif function == "diff":
+        first, last = segment.seg_first_last(values, seg_ids, nseg)
+        out = jnp.where(cnt == 1, 0.0, last - first)
+    elif function == "dev":
+        s1 = segment.seg_sum(values, seg_ids, nseg)
+        s2 = segment.seg_sumsq(values, seg_ids, nseg)
+        safe = jnp.maximum(cnt, 1)
+        mean = s1 / safe
+        var = jnp.maximum(s2 / safe - mean * mean, 0.0) * (
+            safe / jnp.maximum(cnt - 1, 1))
+        out = jnp.where(cnt == 1, 0.0, jnp.sqrt(var))
+    elif function == "median":
+        out = _bucketize_rank(values, seg_ids, nseg, 50.0, "median")
+    else:
+        agg = aggs_mod.get(function)
+        if not agg.is_percentile:
+            raise ValueError(f"unsupported downsample function {function}")
+        out = _bucketize_rank(values, seg_ids, nseg, agg.percentile,
+                              agg.estimation)
+
+    grid = jnp.where(mask, out, jnp.nan).reshape(num_series, num_buckets)
+    return grid, cnt.reshape(num_series, num_buckets)
+
+
+def _bucketize_rank(values, seg_ids, nseg, q: float, estimation: str):
+    """Percentile/median per (series, bucket) via one lexicographic sort
+    (segment.segment_sort_ranks) — no ragged loops."""
+    sorted_vals, _, starts, counts = segment.segment_sort_ranks(
+        values, seg_ids, nseg)
+    n = counts.astype(values.dtype)
+    p = q / 100.0
+    if estimation == "median":
+        # upper median: 1-based rank n//2 + 1 (ref: Median sorted[n/2])
+        h = jnp.floor(n / 2) + 1
+    elif estimation == "legacy":
+        h = jnp.clip(p * (n + 1), 1.0, jnp.maximum(n, 1.0))
+    elif estimation == "r3":
+        h = jnp.clip(jnp.ceil(p * n - 0.5), 1.0, jnp.maximum(n, 1.0))
+    elif estimation == "r7":
+        h = jnp.clip((n - 1) * p + 1, 1.0, jnp.maximum(n, 1.0))
+    else:
+        raise ValueError(f"unknown estimation {estimation!r}")
+    if estimation in ("r3", "median"):
+        h = jnp.floor(h)  # pure rank select, no interpolation
+    return segment.select_rank(sorted_vals, starts, counts, h)
+
+
+def apply_fill(grid, spec: DownsamplingSpecification):
+    """Substitute NaN holes per fill policy (NONE leaves NaN for the
+    interpolation stage; NULL stays NaN and is handled at serialization)."""
+    if spec.fill_policy == FillPolicy.ZERO:
+        return jnp.where(jnp.isnan(grid), 0.0, grid)
+    if spec.fill_policy == FillPolicy.SCALAR:
+        return jnp.where(jnp.isnan(grid), spec.fill_value, grid)
+    return grid

@@ -1,0 +1,144 @@
+"""Group-by aggregation over the series axis.
+
+(ref: ``src/core/TsdbQuery.java:916-1045`` GroupByAndAggregateCB builds
+SpanGroups keyed by concatenated group-by tagv UIDs; each SpanGroup then
+runs the AggregationIterator merge loop lazily during serialization)
+
+Here a group is a segment id per series: after interpolation fill
+(:mod:`opentsdb_tpu.ops.interp`), one segment reduction over axis 0 of
+the ``[series, bucket]`` grid aggregates every group and every bucket at
+once. Order-statistic aggregators (median / percentiles) use a single
+lexicographic ``lax.sort`` keyed by (group, NaN-last, value) — the
+across-series analogue of the bucketize sort path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.ops.interp import fill_gaps
+
+
+def _seg(fn, data, ids, num, **kw):
+    return fn(data, ids, num_segments=num, indices_are_sorted=False, **kw)
+
+
+@partial(jax.jit, static_argnames=("num_groups", "agg_name"))
+def _group_reduce(filled, group_ids, num_groups: int, agg_name: str):
+    """Aggregate filled[S,B] into [G,B] per ``agg_name``. NaN = missing."""
+    valid = ~jnp.isnan(filled)
+    x0 = jnp.where(valid, filled, 0.0)
+    cnt = _seg(jax.ops.segment_sum, valid.astype(filled.dtype), group_ids,
+               num_groups)
+    any_valid = cnt > 0
+
+    if agg_name in ("sum", "zimsum", "pfsum"):
+        out = _seg(jax.ops.segment_sum, x0, group_ids, num_groups)
+    elif agg_name == "avg":
+        out = _seg(jax.ops.segment_sum, x0, group_ids, num_groups) \
+            / jnp.maximum(cnt, 1)
+    elif agg_name == "count":
+        out = cnt
+    elif agg_name in ("min", "mimmin"):
+        out = _seg(jax.ops.segment_min,
+                   jnp.where(valid, filled, jnp.inf), group_ids, num_groups)
+        out = jnp.where(jnp.isinf(out) & (out > 0), jnp.nan, out)
+        # mimmin holes filled with +inf are valid contributions; a group
+        # where *everything* is +inf has no real data
+        any_valid = any_valid & ~jnp.isnan(out)
+    elif agg_name in ("max", "mimmax"):
+        out = _seg(jax.ops.segment_max,
+                   jnp.where(valid, filled, -jnp.inf), group_ids, num_groups)
+        out = jnp.where(jnp.isinf(out) & (out < 0), jnp.nan, out)
+        any_valid = any_valid & ~jnp.isnan(out)
+    elif agg_name == "multiply":
+        out = _seg(jax.ops.segment_prod,
+                   jnp.where(valid, filled, 1.0), group_ids, num_groups)
+    elif agg_name == "squareSum":
+        out = _seg(jax.ops.segment_sum, x0 * x0, group_ids, num_groups)
+    elif agg_name == "dev":
+        s1 = _seg(jax.ops.segment_sum, x0, group_ids, num_groups)
+        mean = s1 / jnp.maximum(cnt, 1)
+        centered = jnp.where(valid, filled - mean[group_ids], 0.0)
+        m2 = _seg(jax.ops.segment_sum, centered * centered, group_ids,
+                  num_groups)
+        var = m2 / jnp.maximum(cnt - 1, 1)
+        out = jnp.where(cnt == 1, 0.0, jnp.sqrt(jnp.maximum(var, 0.0)))
+    elif agg_name in ("first", "last", "diff"):
+        s = filled.shape[0]
+        pos = jnp.arange(s, dtype=jnp.int32)[:, None]
+        first_pos = _seg(jax.ops.segment_min,
+                         jnp.where(valid, pos, s), group_ids, num_groups)
+        last_pos = _seg(jax.ops.segment_max,
+                        jnp.where(valid, pos, -1), group_ids, num_groups)
+        fsafe = jnp.clip(first_pos, 0, s - 1)
+        lsafe = jnp.clip(last_pos, 0, s - 1)
+        first_val = jnp.take_along_axis(filled, fsafe, axis=0)
+        last_val = jnp.take_along_axis(filled, lsafe, axis=0)
+        if agg_name == "first":
+            out = first_val
+        elif agg_name == "last":
+            out = last_val
+        else:  # diff: exactly one value -> 0 (ref: Aggregators.Diff)
+            out = jnp.where(cnt == 1, 0.0, last_val - first_val)
+    else:
+        agg = aggs_mod.get(agg_name)
+        if agg_name == "median":
+            q, est = 50.0, "median"
+        elif agg.is_percentile:
+            q, est = agg.percentile, agg.estimation
+        else:
+            raise ValueError(f"unsupported group aggregator {agg_name}")
+        out = _group_rank(filled, valid, cnt, group_ids, num_groups, q, est)
+    return jnp.where(any_valid, out, jnp.nan)
+
+
+def _group_rank(filled, valid, cnt, group_ids, num_groups, q: float,
+                est: str):
+    """Order statistics per (group, bucket) via one lax.sort along the
+    series axis keyed lexicographically by (group, NaN-last, value)."""
+    s, b = filled.shape
+    gkey = jnp.broadcast_to(group_ids[:, None], (s, b)).astype(jnp.int32)
+    nankey = (~valid).astype(jnp.int32)
+    _, _, sorted_vals = jax.lax.sort((gkey, nankey, filled), num_keys=3,
+                                     dimension=0)
+    sizes = jax.ops.segment_sum(jnp.ones_like(group_ids), group_ids,
+                                num_groups)
+    starts = jnp.cumsum(sizes) - sizes  # [G]
+    n = cnt  # [G,B] valid counts
+    p = q / 100.0
+    if est == "median":
+        h = jnp.floor(n / 2) + 1
+    elif est == "legacy":
+        h = jnp.clip(p * (n + 1), 1.0, jnp.maximum(n, 1.0))
+    elif est == "r3":
+        h = jnp.floor(jnp.clip(jnp.ceil(p * n - 0.5), 1.0,
+                               jnp.maximum(n, 1.0)))
+    elif est == "r7":
+        h = jnp.clip((n - 1) * p + 1, 1.0, jnp.maximum(n, 1.0))
+    else:
+        raise ValueError(f"unknown estimation {est!r}")
+    h_floor = jnp.floor(h)
+    frac = (h - h_floor) if est in ("legacy", "r7") else jnp.zeros_like(h)
+    lo_off = jnp.clip(h_floor.astype(jnp.int32) - 1, 0, None)
+    max_off = jnp.maximum(n.astype(jnp.int32) - 1, 0)
+    hi_off = jnp.minimum(lo_off + 1, max_off)
+    lo_row = jnp.clip(starts[:, None] + jnp.minimum(lo_off, max_off),
+                      0, s - 1)
+    hi_row = jnp.clip(starts[:, None] + hi_off, 0, s - 1)
+    lo = jnp.take_along_axis(sorted_vals, lo_row, axis=0)
+    hi = jnp.take_along_axis(sorted_vals, hi_row, axis=0)
+    return lo + frac * (hi - lo)
+
+
+def group_aggregate(grid, bucket_ts, group_ids, num_groups: int,
+                    agg: aggs_mod.Aggregator):
+    """The reference's SpanGroup.iterator + AggregationIterator pass:
+    interpolation fill per the aggregator's mode, then one segmented
+    reduction over the series axis. grid[S,B] -> [G,B]."""
+    filled = fill_gaps(grid, bucket_ts, agg.interpolation.value)
+    return _group_reduce(filled, group_ids, num_groups, agg.name)

@@ -1,0 +1,89 @@
+"""Merge-time interpolation as vectorized gap filling.
+
+(ref: ``src/core/AggregationIterator.java:27-119`` — the O(1)-space
+k-way merge that linearly interpolates each span at timestamps where
+other spans have data)
+
+On the ``[series, bucket]`` grid the same semantics become a masked fill
+along the time axis: for every NaN hole *between* a series' first and
+last values, substitute per the aggregator's interpolation mode; outside
+that range the series contributes nothing (stays NaN), exactly like a
+span that is exhausted or not yet started in the reference's merge loop.
+
+The prev/next-valid-index machinery is two cumulative scans — XLA
+compiles them to fast parallel prefix ops on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from opentsdb_tpu.ops.aggregators import Interpolation
+
+
+def _prev_valid_idx(mask):
+    """[S,B] -> per cell, index of the nearest valid cell at or before it
+    (-1 if none)."""
+    b = mask.shape[-1]
+    idx = jnp.where(mask, jnp.arange(b, dtype=jnp.int32), -1)
+    return jax.lax.cummax(idx, axis=mask.ndim - 1)
+
+
+def _next_valid_idx(mask):
+    """[S,B] -> per cell, index of nearest valid cell at or after it
+    (B if none)."""
+    b = mask.shape[-1]
+    idx = jnp.where(mask, jnp.arange(b, dtype=jnp.int32), b)
+    return jnp.flip(jax.lax.cummin(jnp.flip(idx, -1), axis=mask.ndim - 1), -1)
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def fill_gaps(grid, bucket_ts, mode: str):
+    """Fill NaN holes of ``grid[S,B]`` per interpolation ``mode``.
+
+    - ``lerp``: linear interpolation against ``bucket_ts`` between each
+      series' first and last valid cells; NaN outside.
+    - ``zim``: 0 for every hole (ZeroIfMissing, Aggregators ZIM).
+    - ``max`` / ``min``: +inf / -inf for holes *between* first and last
+      valid (type extremes, used by mimmin/mimmax); NaN outside.
+    - ``prev``: repeat previous valid value (PREV / pfsum); NaN before
+      the first valid cell.
+
+    Returns the filled grid (still [S,B]); cells a series can never
+    contribute to stay NaN so downstream reductions skip them.
+    """
+    mask = ~jnp.isnan(grid)
+    if mode == Interpolation.ZIM.value:
+        return jnp.where(mask, grid, 0.0)
+
+    nb = grid.shape[-1]
+    prev_idx = _prev_valid_idx(mask)
+    if mode == Interpolation.PREV.value:
+        safe_prev = jnp.clip(prev_idx, 0, nb - 1)
+        prev_val = jnp.take_along_axis(grid, safe_prev, axis=-1)
+        return jnp.where(mask, grid,
+                         jnp.where(prev_idx >= 0, prev_val, jnp.nan))
+
+    next_idx = _next_valid_idx(mask)
+    in_range = (prev_idx >= 0) & (next_idx < nb)
+    if mode in (Interpolation.MAX.value, Interpolation.MIN.value):
+        extreme = jnp.inf if mode == Interpolation.MAX.value else -jnp.inf
+        return jnp.where(mask, grid,
+                         jnp.where(in_range, extreme, jnp.nan))
+
+    if mode != Interpolation.LERP.value:
+        raise ValueError(f"unknown interpolation mode {mode!r}")
+    safe_prev = jnp.clip(prev_idx, 0, nb - 1)
+    safe_next = jnp.clip(next_idx, 0, nb - 1)
+    v0 = jnp.take_along_axis(grid, safe_prev, axis=-1)
+    v1 = jnp.take_along_axis(grid, safe_next, axis=-1)
+    ts = bucket_ts.astype(grid.dtype)
+    t = ts[None, :]
+    t0 = ts[safe_prev]
+    t1 = ts[safe_next]
+    dt = jnp.where(t1 > t0, t1 - t0, 1.0)
+    lerped = v0 + (v1 - v0) * (t - t0) / dt
+    return jnp.where(mask, grid, jnp.where(in_range, lerped, jnp.nan))

@@ -1,0 +1,123 @@
+"""The fused query pipeline: downsample -> rate -> interpolate ->
+aggregate -> group-by as ONE jit-compiled array program.
+
+This inverts the reference's architecture (SURVEY.md §7): OpenTSDB pulls
+one datapoint at a time through an iterator chain interleaved with
+serialization (``SpanGroup.iterator`` -> ``AggregationIterator`` ->
+``Downsampler`` -> ``RateSpan``, ref AggregationIterator.java:253-280);
+here the whole working set is materialized as a flat point batch and the
+entire chain compiles to a handful of fused XLA ops over a
+``[series, bucket]`` grid. The per-query shapes (S, B, G, N) are traced
+once per shape bucket and cached by XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.ops import groupby as gb_mod
+from opentsdb_tpu.ops.rate import RateOptions, _rate_kernel
+
+
+@dataclass(frozen=True)
+class PipelineSpec:
+    """Static (trace-time) configuration of one sub-query's compute."""
+    num_series: int
+    num_buckets: int
+    num_groups: int
+    ds_function: str          # downsample function ('sum', 'avg', ...)
+    agg_name: str             # group aggregator name ('sum', 'p99', ...)
+    fill_policy: ds_mod.FillPolicy = ds_mod.FillPolicy.NONE
+    fill_value: float = float("nan")
+    rate: bool = False
+    rate_counter: bool = False
+    rate_drop_resets: bool = False
+    emit_raw: bool = False    # agg 'none': emit per-series, skip group stage
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def run_pipeline(values, series_idx, bucket_idx, bucket_ts, group_ids,
+                 rate_params, fill_value, spec: PipelineSpec):
+    """values[N] f32/f64, series_idx[N] i32, bucket_idx[N] i32,
+    bucket_ts[B] i64, group_ids[S] i32, rate_params = (counter_max,
+    reset_value) -> (result[G,B] or [S,B], emit_mask same shape).
+
+    NaN in the result means "no value" (fill policy NONE/NULL);
+    ``emit_mask`` marks buckets that exist in the output per the
+    reference's emission rules (union of contributing series' buckets
+    for NONE, everything otherwise).
+    """
+    s, b, g = spec.num_series, spec.num_buckets, spec.num_groups
+
+    # 1. downsample: flat points -> [S,B] grid with NaN holes
+    grid, cnt = ds_mod.bucketize(values, series_idx, bucket_idx, s, b,
+                                 spec.ds_function)
+    has_data = cnt > 0
+
+    # 2. downsample fill policy (ZERO/SCALAR substitute before rate,
+    #    matching FillingDownsampler feeding RateSpan)
+    if spec.fill_policy == ds_mod.FillPolicy.ZERO:
+        grid = jnp.where(jnp.isnan(grid), 0.0, grid)
+        has_data = jnp.ones_like(has_data)
+    elif spec.fill_policy == ds_mod.FillPolicy.SCALAR:
+        grid = jnp.where(jnp.isnan(grid), fill_value, grid)
+        has_data = jnp.ones_like(has_data)
+
+    # 3. rate conversion per series (ref: Downsampler -> RateSpan order)
+    if spec.rate:
+        counter_max, reset_value = rate_params
+        grid = _rate_kernel(grid, bucket_ts, spec.rate_counter,
+                            counter_max, reset_value,
+                            spec.rate_drop_resets)
+        has_data = has_data & ~jnp.isnan(grid)
+
+    if spec.emit_raw:
+        return grid, has_data
+
+    # 4.+5. interpolate at merge + aggregate over series within groups
+    agg = aggs_mod.get(spec.agg_name)
+    result = gb_mod.group_aggregate(grid, bucket_ts, group_ids, g, agg)
+
+    # emission: fill NONE emits the union of the group's series' buckets
+    # (plain Downsampler skips empty buckets); any other policy emits
+    # every bucket (FillingDownsampler semantics)
+    if spec.fill_policy == ds_mod.FillPolicy.NONE:
+        emit = jax.ops.segment_max(has_data.astype(jnp.int32), group_ids,
+                                   num_segments=g) > 0
+    else:
+        emit = jnp.ones((g, b), dtype=bool)
+    return result, emit
+
+
+def execute(batch_values: np.ndarray, series_idx: np.ndarray,
+            bucket_idx: np.ndarray, bucket_ts: np.ndarray,
+            group_ids: np.ndarray, spec: PipelineSpec,
+            rate_options: RateOptions | None = None,
+            dtype=None, device=None) -> tuple[np.ndarray, np.ndarray]:
+    """Host entry: upload, run, download. Returns (result, emit_mask)."""
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
+            else jnp.float32
+    ro = rate_options or RateOptions()
+    put = partial(jax.device_put, device=device)
+    values = put(jnp.asarray(batch_values, dtype=dtype))
+    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
+                   jnp.asarray(ro.reset_value, dtype=dtype))
+    result, emit = run_pipeline(
+        values,
+        put(jnp.asarray(series_idx, dtype=jnp.int32)),
+        put(jnp.asarray(bucket_idx, dtype=jnp.int32)),
+        put(jnp.asarray(bucket_ts)),
+        put(jnp.asarray(group_ids, dtype=jnp.int32)),
+        rate_params,
+        jnp.asarray(spec.fill_value, dtype=dtype),
+        spec)
+    return np.asarray(result), np.asarray(emit)

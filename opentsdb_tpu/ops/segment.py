@@ -1,0 +1,102 @@
+"""Segmented-reduction primitives over flat point batches.
+
+The TPU build's replacement for the reference's pull-based iterator
+pipeline: a flat batch of points ``(values[N], seg_ids[N])`` is reduced
+into ``num_segments`` slots in one XLA scatter/segment op. Segment ids
+are ``series_idx * num_buckets + bucket_idx``, so one call downsamples
+every series of a query simultaneously (ref: the per-point inner loop in
+``src/core/Downsampler.java:295`` ValuesInInterval).
+
+Points arrive sorted by (series, time) from the column store, so
+``indices_are_sorted=True`` lets XLA lower to a faster segmented scan.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def seg_sum(values, seg_ids, num_segments, sorted_ids=True):
+    return jax.ops.segment_sum(values, seg_ids, num_segments,
+                               indices_are_sorted=sorted_ids)
+
+
+def seg_count(values, seg_ids, num_segments, sorted_ids=True):
+    return jax.ops.segment_sum(jnp.ones_like(values), seg_ids, num_segments,
+                               indices_are_sorted=sorted_ids)
+
+
+def seg_min(values, seg_ids, num_segments, sorted_ids=True):
+    return jax.ops.segment_min(values, seg_ids, num_segments,
+                               indices_are_sorted=sorted_ids)
+
+
+def seg_max(values, seg_ids, num_segments, sorted_ids=True):
+    return jax.ops.segment_max(values, seg_ids, num_segments,
+                               indices_are_sorted=sorted_ids)
+
+
+def seg_prod(values, seg_ids, num_segments, sorted_ids=True):
+    return jax.ops.segment_prod(values, seg_ids, num_segments,
+                                indices_are_sorted=sorted_ids)
+
+
+def seg_sumsq(values, seg_ids, num_segments, sorted_ids=True):
+    return jax.ops.segment_sum(values * values, seg_ids, num_segments,
+                               indices_are_sorted=sorted_ids)
+
+
+def seg_first_last(values, seg_ids, num_segments, sorted_ids=True):
+    """(first, last) value per segment, relying on within-segment time
+    order of the batch (the store materializes time-sorted points)."""
+    n = values.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    big = jnp.iinfo(jnp.int32).max
+    first_pos = jax.ops.segment_min(pos, seg_ids, num_segments,
+                                    indices_are_sorted=sorted_ids)
+    last_pos = jax.ops.segment_max(pos, seg_ids, num_segments,
+                                   indices_are_sorted=sorted_ids)
+    has_any = first_pos != big
+    safe_first = jnp.where(has_any, first_pos, 0)
+    safe_last = jnp.where(has_any, jnp.clip(last_pos, 0, max(n - 1, 0)), 0)
+    if n == 0:
+        z = jnp.zeros((num_segments,), dtype=values.dtype)
+        return z, z
+    return values[safe_first], values[safe_last]
+
+
+def segment_sort_ranks(values, seg_ids, num_segments):
+    """Sort ``values`` within segments, returning (sorted_values,
+    sorted_seg_ids, segment_starts, segment_counts).
+
+    Lowered as one ``lax.sort`` with (seg_id, value) lexicographic keys —
+    the TPU-friendly formulation of per-bucket percentile/median
+    downsampling (no ragged loops; one big bitonic sort on the MXU-adjacent
+    sort unit).
+    """
+    sorted_ids, sorted_vals = jax.lax.sort((seg_ids, values), num_keys=2)
+    counts = jax.ops.segment_sum(jnp.ones_like(seg_ids), seg_ids,
+                                 num_segments)
+    starts = jnp.cumsum(counts) - counts
+    return sorted_vals, sorted_ids, starts, counts
+
+
+def select_rank(sorted_vals, starts, counts, h):
+    """Gather per-segment order statistics at (1-based, fractional) rank
+    positions ``h[num_segments]`` with linear interpolation between
+    neighbors — the vectorized core of every percentile estimation type.
+    Segments with count 0 return NaN.
+    """
+    n = sorted_vals.shape[0]
+    h_floor = jnp.floor(h)
+    frac = h - h_floor
+    lo_idx = jnp.clip(h_floor.astype(jnp.int32) - 1, 0, None)
+    hi_idx = jnp.clip(lo_idx + 1, None, jnp.maximum(counts - 1, 0))
+    lo_idx = jnp.clip(lo_idx, 0, jnp.maximum(counts - 1, 0))
+    lo = sorted_vals[jnp.clip(starts + lo_idx, 0, max(n - 1, 0))]
+    hi = sorted_vals[jnp.clip(starts + hi_idx, 0, max(n - 1, 0))]
+    out = lo + frac * (hi - lo)
+    return jnp.where(counts > 0, out, jnp.nan)

@@ -11,13 +11,21 @@ Must set env vars before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may point at TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_ENABLE_X64"] = "1"
+
+# This image's sitecustomize imports jax at interpreter startup (axon TPU
+# registration), so the env vars above may be latched already — override
+# through the config API as well.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 # Tests compare against float64 golden values computed with numpy.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 import pytest

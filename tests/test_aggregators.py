@@ -1,0 +1,160 @@
+"""Aggregator golden tests (ref: test/core/TestAggregators.java).
+
+Each JAX aggregator is pinned against an independent numpy
+implementation of the reference semantics over random masked data.
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops import aggregators as aggs
+
+
+def masked(vals, mask):
+    out = np.asarray(vals, dtype=np.float64).copy()
+    out[~np.asarray(mask, dtype=bool)] = np.nan
+    return out
+
+
+def rand_grid(s=7, b=11, density=0.7, seed=0):
+    rng = np.random.default_rng(seed)
+    vals = rng.normal(50, 20, size=(s, b))
+    mask = rng.random((s, b)) < density
+    return masked(vals, mask)
+
+
+class TestScalarAggregators:
+    def test_registry_complete(self):
+        expected = {
+            "sum", "pfsum", "min", "max", "avg", "median", "none",
+            "multiply", "dev", "diff", "zimsum", "mimmin", "mimmax",
+            "squareSum", "count", "first", "last",
+            "p999", "p99", "p95", "p90", "p75", "p50",
+            "ep999r3", "ep99r3", "ep95r3", "ep90r3", "ep75r3", "ep50r3",
+            "ep999r7", "ep99r7", "ep95r7", "ep90r7", "ep75r7", "ep50r7",
+        }
+        assert set(aggs.names()) == expected
+
+    def test_interpolation_modes(self):
+        assert aggs.get("sum").interpolation is aggs.Interpolation.LERP
+        assert aggs.get("zimsum").interpolation is aggs.Interpolation.ZIM
+        assert aggs.get("mimmin").interpolation is aggs.Interpolation.MAX
+        assert aggs.get("mimmax").interpolation is aggs.Interpolation.MIN
+        assert aggs.get("pfsum").interpolation is aggs.Interpolation.PREV
+
+    @pytest.mark.parametrize("name,npfn", [
+        ("sum", lambda x: np.nansum(x, axis=0)),
+        ("min", lambda x: np.nanmin(x, axis=0)),
+        ("max", lambda x: np.nanmax(x, axis=0)),
+        ("avg", lambda x: np.nanmean(x, axis=0)),
+        ("count", lambda x: np.sum(~np.isnan(x), axis=0).astype(float)),
+        ("squareSum", lambda x: np.nansum(x * x, axis=0)),
+        ("multiply", lambda x: np.nanprod(x, axis=0)),
+    ])
+    def test_against_numpy(self, name, npfn):
+        x = rand_grid()
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # all-nan slices
+            expected = npfn(x)
+        got = np.asarray(aggs.get(name)(x, axis=0))
+        empty = ~np.any(~np.isnan(x), axis=0)
+        if name not in ("count",):
+            expected = np.where(empty, np.nan, expected)
+        np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+    def test_sum_all_nan_column_is_nan(self):
+        x = masked([[1.0, 1.0], [2.0, 3.0]], [[True, False], [True, False]])
+        got = np.asarray(aggs.get("sum")(x))
+        assert got[0] == 3.0 and np.isnan(got[1])
+
+    def test_dev_matches_welford(self):
+        x = rand_grid(seed=3)
+        got = np.asarray(aggs.get("dev")(x, axis=0))
+        for col in range(x.shape[1]):
+            vals = x[:, col][~np.isnan(x[:, col])]
+            if len(vals) == 0:
+                assert np.isnan(got[col])
+            elif len(vals) == 1:
+                assert got[col] == 0.0
+            else:
+                np.testing.assert_allclose(got[col], np.std(vals, ddof=1),
+                                           rtol=1e-10)
+
+    def test_median_upper(self):
+        # even count: reference takes sorted[n/2] (upper median)
+        x = np.array([[1.0], [2.0], [3.0], [4.0]])
+        assert np.asarray(aggs.get("median")(x))[0] == 3.0
+        x = np.array([[5.0], [1.0], [3.0]])
+        assert np.asarray(aggs.get("median")(x))[0] == 3.0
+
+    def test_diff(self):
+        # last valid - first valid, in series order
+        x = masked([[10.0, 1.0], [20.0, 5.0], [35.0, 7.0]],
+                   [[True, False], [True, True], [True, True]])
+        got = np.asarray(aggs.get("diff")(x))
+        assert got[0] == 25.0   # 35 - 10
+        assert got[1] == 2.0    # 7 - 5
+        single = masked([[9.0]], [[True]])
+        assert np.asarray(aggs.get("diff")(single))[0] == 0.0
+
+    def test_first_last(self):
+        x = masked([[np.nan, 1.0], [20.0, 2.0], [30.0, 3.0]],
+                   [[False, True], [True, True], [True, True]])
+        assert np.asarray(aggs.get("first")(x))[0] == 20.0
+        assert np.asarray(aggs.get("first")(x))[1] == 1.0
+        assert np.asarray(aggs.get("last")(x))[0] == 30.0
+        assert np.asarray(aggs.get("last")(x))[1] == 3.0
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            aggs.get("bogus")
+
+
+def commons_legacy_percentile(vals, q):
+    """Independent implementation of commons-math3 LEGACY estimation."""
+    vals = np.sort(vals)
+    n = len(vals)
+    if n == 0:
+        return np.nan
+    if n == 1:
+        return vals[0]
+    pos = q / 100.0 * (n + 1)
+    if pos < 1:
+        return vals[0]
+    if pos >= n:
+        return vals[-1]
+    lower = vals[int(np.floor(pos)) - 1]
+    upper = vals[int(np.floor(pos))]
+    return lower + (pos - np.floor(pos)) * (upper - lower)
+
+
+class TestPercentiles:
+    @pytest.mark.parametrize("name,q", [
+        ("p50", 50.0), ("p75", 75.0), ("p90", 90.0), ("p95", 95.0),
+        ("p99", 99.0), ("p999", 99.9),
+    ])
+    def test_legacy_matches_commons(self, name, q):
+        x = rand_grid(s=40, b=5, density=0.8, seed=int(q * 10))
+        got = np.asarray(aggs.get(name)(x, axis=0))
+        for col in range(x.shape[1]):
+            vals = x[:, col][~np.isnan(x[:, col])]
+            expected = commons_legacy_percentile(vals, q)
+            np.testing.assert_allclose(got[col], expected, rtol=1e-10,
+                                       err_msg=f"{name} col {col}")
+
+    def test_r7_matches_numpy_linear(self):
+        x = rand_grid(s=30, b=4, density=1.0, seed=9)
+        got = np.asarray(aggs.get("ep90r7")(x, axis=0))
+        expected = np.percentile(x, 90.0, axis=0)  # numpy default = R-7
+        np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+    def test_r3_nearest_rank(self):
+        x = np.arange(1.0, 11.0).reshape(10, 1)  # 1..10
+        # R_3: h = n*p = 10*0.5 = 5 -> ceil(5-0.5)=5 -> sorted[5-1] = 5
+        assert np.asarray(aggs.get("ep50r3")(x))[0] == 5.0
+
+    def test_p50_small(self):
+        x = np.array([[1.0], [2.0], [3.0]])
+        # LEGACY: pos = 0.5*4 = 2 -> sorted[1] = 2.0
+        assert np.asarray(aggs.get("p50")(x))[0] == 2.0

@@ -1,0 +1,169 @@
+"""Downsampler kernel tests (ref: test/core/TestDownsampler.java,
+TestFillingDownsampler.java, TestDownsamplingSpecification.java)."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops import downsample as ds
+from opentsdb_tpu.ops.downsample import DownsamplingSpecification, FillPolicy
+
+
+class TestSpecParsing:
+    def test_basic(self):
+        spec = DownsamplingSpecification.parse("1m-avg")
+        assert spec.interval_ms == 60_000
+        assert spec.function == "avg"
+        assert spec.fill_policy == FillPolicy.NONE
+        assert not spec.use_calendar
+
+    def test_fill_policies(self):
+        assert DownsamplingSpecification.parse("1m-sum-nan").fill_policy \
+            == FillPolicy.NOT_A_NUMBER
+        assert DownsamplingSpecification.parse("1m-sum-null").fill_policy \
+            == FillPolicy.NULL
+        spec = DownsamplingSpecification.parse("1m-sum-zero")
+        assert spec.fill_policy == FillPolicy.ZERO
+        assert spec.fill_value == 0.0
+        spec = DownsamplingSpecification.parse("1m-sum-scalar#5.5")
+        assert spec.fill_policy == FillPolicy.SCALAR
+        assert spec.fill_value == 5.5
+
+    def test_calendar_suffix(self):
+        spec = DownsamplingSpecification.parse("1dc-sum", timezone="UTC")
+        assert spec.use_calendar
+        assert spec.interval_ms == 86_400_000
+
+    def test_run_all(self):
+        spec = DownsamplingSpecification.parse("0all-sum")
+        assert spec.run_all
+
+    @pytest.mark.parametrize("bad", ["1m", "-avg", "1m-bogus", "xx-avg"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            DownsamplingSpecification.parse(bad)
+
+
+class TestBucketAssignment:
+    def test_fixed_edges_aligned(self):
+        edges = ds.fixed_bucket_edges(65_000, 250_000, 60_000)
+        np.testing.assert_array_equal(edges, [60_000, 120_000, 180_000,
+                                              240_000])
+
+    def test_assign_fixed(self):
+        spec = DownsamplingSpecification.parse("1m-sum")
+        ts = np.array([61_000, 119_000, 120_000, 200_000], dtype=np.int64)
+        idx, edges = ds.assign_buckets(ts, spec, 60_000, 239_999)
+        np.testing.assert_array_equal(idx, [0, 0, 1, 2])
+        assert edges[0] == 60_000
+
+    def test_assign_run_all(self):
+        spec = DownsamplingSpecification.parse("0all-sum")
+        ts = np.array([1, 2, 3], dtype=np.int64)
+        idx, edges = ds.assign_buckets(ts, spec, 0, 100)
+        np.testing.assert_array_equal(idx, [0, 0, 0])
+        assert len(edges) == 1
+
+    def test_assign_calendar_month(self):
+        spec = DownsamplingSpecification.parse("1nc-sum", timezone="UTC")
+        jan = 1356998400000 + 5 * 86400_000   # 2013-01-06
+        feb = 1359676800000 + 86400_000       # 2013-02-02
+        ts = np.array([jan, feb], dtype=np.int64)
+        idx, edges = ds.assign_buckets(ts, spec, 1356998400000,
+                                       1362000000000)
+        assert edges[0] == 1356998400000  # Jan 1
+        np.testing.assert_array_equal(idx, [0, 1])
+
+
+def run_bucketize(points, s, b, fn):
+    """points: list of (series, bucket, value)"""
+    arr = np.asarray(points, dtype=np.float64)
+    vals = arr[:, 2]
+    sidx = arr[:, 0].astype(np.int32)
+    bidx = arr[:, 1].astype(np.int32)
+    grid, cnt = ds.bucketize(vals, sidx, bidx, s, b, fn)
+    return np.asarray(grid), np.asarray(cnt)
+
+
+class TestBucketize:
+    POINTS = [(0, 0, 1.0), (0, 0, 3.0), (0, 1, 5.0),
+              (1, 0, 10.0), (1, 2, 2.0), (1, 2, 4.0), (1, 2, 6.0)]
+
+    def test_sum(self):
+        grid, cnt = run_bucketize(self.POINTS, 2, 3, "sum")
+        np.testing.assert_array_equal(cnt, [[2, 1, 0], [1, 0, 3]])
+        assert grid[0, 0] == 4.0 and grid[0, 1] == 5.0
+        assert np.isnan(grid[0, 2])
+        assert grid[1, 2] == 12.0
+
+    def test_avg(self):
+        grid, _ = run_bucketize(self.POINTS, 2, 3, "avg")
+        assert grid[0, 0] == 2.0
+        assert grid[1, 2] == 4.0
+
+    def test_min_max(self):
+        gmin, _ = run_bucketize(self.POINTS, 2, 3, "min")
+        gmax, _ = run_bucketize(self.POINTS, 2, 3, "max")
+        assert gmin[0, 0] == 1.0 and gmax[0, 0] == 3.0
+        assert gmin[1, 2] == 2.0 and gmax[1, 2] == 6.0
+
+    def test_count(self):
+        grid, _ = run_bucketize(self.POINTS, 2, 3, "count")
+        assert grid[0, 0] == 2.0 and np.isnan(grid[0, 2])
+
+    def test_first_last(self):
+        gfirst, _ = run_bucketize(self.POINTS, 2, 3, "first")
+        glast, _ = run_bucketize(self.POINTS, 2, 3, "last")
+        assert gfirst[0, 0] == 1.0 and glast[0, 0] == 3.0
+        assert gfirst[1, 2] == 2.0 and glast[1, 2] == 6.0
+
+    def test_dev(self):
+        grid, _ = run_bucketize(self.POINTS, 2, 3, "dev")
+        np.testing.assert_allclose(grid[1, 2], np.std([2, 4, 6], ddof=1),
+                                   rtol=1e-10)
+        assert grid[0, 1] == 0.0  # single value
+
+    def test_median(self):
+        grid, _ = run_bucketize(self.POINTS, 2, 3, "median")
+        assert grid[1, 2] == 4.0
+        # even count takes the upper of the two middles
+        pts = [(0, 0, 1.0), (0, 0, 2.0), (0, 0, 3.0), (0, 0, 4.0)]
+        grid, _ = run_bucketize(pts, 1, 1, "median")
+        assert grid[0, 0] == 3.0
+
+    def test_percentile_downsample(self):
+        pts = [(0, 0, float(v)) for v in range(1, 101)]
+        grid, _ = run_bucketize(pts, 1, 1, "p95")
+        # LEGACY: pos = .95*101 = 95.95 -> 95 + .95*(96-95)
+        np.testing.assert_allclose(grid[0, 0], 95.95, rtol=1e-10)
+
+    def test_multiply_squaresum(self):
+        pts = [(0, 0, 2.0), (0, 0, 3.0), (0, 0, 4.0)]
+        gp, _ = run_bucketize(pts, 1, 1, "multiply")
+        gs, _ = run_bucketize(pts, 1, 1, "squareSum")
+        assert gp[0, 0] == 24.0
+        assert gs[0, 0] == 4 + 9 + 16
+
+    def test_diff_downsample(self):
+        pts = [(0, 0, 10.0), (0, 0, 3.0), (0, 0, 7.5)]
+        grid, _ = run_bucketize(pts, 1, 1, "diff")
+        assert grid[0, 0] == -2.5  # last - first
+
+
+class TestApplyFill:
+    def test_zero_fill(self):
+        spec = DownsamplingSpecification.parse("1m-sum-zero")
+        grid = np.array([[1.0, np.nan]])
+        out = np.asarray(ds.apply_fill(grid, spec))
+        np.testing.assert_array_equal(out, [[1.0, 0.0]])
+
+    def test_scalar_fill(self):
+        spec = DownsamplingSpecification.parse("1m-sum-scalar#9")
+        grid = np.array([[1.0, np.nan]])
+        out = np.asarray(ds.apply_fill(grid, spec))
+        np.testing.assert_array_equal(out, [[1.0, 9.0]])
+
+    def test_none_keeps_nan(self):
+        spec = DownsamplingSpecification.parse("1m-sum")
+        grid = np.array([[1.0, np.nan]])
+        out = np.asarray(ds.apply_fill(grid, spec))
+        assert np.isnan(out[0, 1])

@@ -1,0 +1,120 @@
+"""Rate and interpolation kernel tests (ref: test/core/TestRateSpan.java,
+TestAggregationIterator.java interpolation cases)."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops.interp import fill_gaps
+from opentsdb_tpu.ops.rate import RateOptions, compute_rate
+
+
+def grid_of(*rows):
+    return np.asarray(rows, dtype=np.float64)
+
+
+class TestRate:
+    TS = np.arange(0, 5) * 10_000  # 10s buckets
+
+    def test_simple_rate(self):
+        g = grid_of([0.0, 10.0, 30.0, 60.0, 100.0])
+        out = np.asarray(compute_rate(g, self.TS, RateOptions()))
+        assert np.isnan(out[0, 0])  # first point has no rate
+        np.testing.assert_allclose(out[0, 1:], [1.0, 2.0, 3.0, 4.0])
+
+    def test_rate_skips_holes(self):
+        g = grid_of([0.0, np.nan, 30.0, np.nan, 100.0])
+        out = np.asarray(compute_rate(g, self.TS, RateOptions()))
+        assert np.isnan(out[0, 0]) and np.isnan(out[0, 1])
+        np.testing.assert_allclose(out[0, 2], 30.0 / 20.0)  # dt=20s
+        assert np.isnan(out[0, 3])
+        np.testing.assert_allclose(out[0, 4], 70.0 / 20.0)
+
+    def test_counter_rollover(self):
+        opts = RateOptions(counter=True, counter_max=100.0)
+        g = grid_of([90.0, 95.0, 5.0])  # rolls over 100
+        out = np.asarray(compute_rate(g, self.TS[:3], opts))
+        np.testing.assert_allclose(out[0, 1], 0.5)
+        # (100 - 95 + 5) / 10s = 1.0
+        np.testing.assert_allclose(out[0, 2], 1.0)
+
+    def test_counter_drop_resets(self):
+        opts = RateOptions(counter=True, counter_max=100.0,
+                           drop_resets=True)
+        g = grid_of([90.0, 95.0, 5.0, 15.0])
+        out = np.asarray(compute_rate(g, self.TS[:4], opts))
+        np.testing.assert_allclose(out[0, 1], 0.5)
+        assert np.isnan(out[0, 2])  # dropped reset
+        np.testing.assert_allclose(out[0, 3], 1.0)
+
+    def test_counter_reset_value(self):
+        # corrected rate above reset_value emits 0
+        opts = RateOptions(counter=True, counter_max=2**16,
+                           reset_value=10.0)
+        g = grid_of([60000.0, 20.0])  # huge rollover rate
+        out = np.asarray(compute_rate(g, self.TS[:2], opts))
+        assert out[0, 1] == 0.0
+
+    def test_multiseries_independent(self):
+        g = grid_of([0.0, 10.0, 20.0], [100.0, 80.0, 60.0])
+        out = np.asarray(compute_rate(g, self.TS[:3], RateOptions()))
+        np.testing.assert_allclose(out[0, 1:], [1.0, 1.0])
+        np.testing.assert_allclose(out[1, 1:], [-2.0, -2.0])
+
+    def test_rate_options_parse(self):
+        assert RateOptions.parse(None) == RateOptions()
+        opts = RateOptions.parse("rate{counter,100,10}")
+        assert opts.counter and opts.counter_max == 100.0 \
+            and opts.reset_value == 10.0
+        opts = RateOptions.parse("rate{dropcounter}")
+        assert opts.counter and opts.drop_resets
+        with pytest.raises(ValueError):
+            RateOptions.parse("rate{")
+
+
+class TestFillGaps:
+    TS = np.arange(4) * 1000
+
+    def test_lerp_interior(self):
+        g = grid_of([10.0, np.nan, np.nan, 40.0])
+        out = np.asarray(fill_gaps(g, self.TS, "lerp"))
+        np.testing.assert_allclose(out[0], [10.0, 20.0, 30.0, 40.0])
+
+    def test_lerp_edges_stay_nan(self):
+        g = grid_of([np.nan, 10.0, 20.0, np.nan])
+        out = np.asarray(fill_gaps(g, self.TS, "lerp"))
+        assert np.isnan(out[0, 0]) and np.isnan(out[0, 3])
+        np.testing.assert_allclose(out[0, 1:3], [10.0, 20.0])
+
+    def test_lerp_uneven_timestamps(self):
+        ts = np.array([0, 1000, 5000, 6000])
+        g = grid_of([0.0, np.nan, np.nan, 60.0])
+        out = np.asarray(fill_gaps(g, ts, "lerp"))
+        np.testing.assert_allclose(out[0], [0.0, 10.0, 50.0, 60.0])
+
+    def test_zim_fills_zero_everywhere(self):
+        g = grid_of([np.nan, 5.0, np.nan, np.nan])
+        out = np.asarray(fill_gaps(g, self.TS, "zim"))
+        np.testing.assert_array_equal(out[0], [0.0, 5.0, 0.0, 0.0])
+
+    def test_prev(self):
+        g = grid_of([np.nan, 5.0, np.nan, 7.0])
+        out = np.asarray(fill_gaps(g, self.TS, "prev"))
+        assert np.isnan(out[0, 0])
+        np.testing.assert_array_equal(out[0, 1:], [5.0, 5.0, 7.0])
+
+    def test_max_min_extremes(self):
+        g = grid_of([1.0, np.nan, 3.0])
+        out_max = np.asarray(fill_gaps(g, self.TS[:3], "max"))
+        out_min = np.asarray(fill_gaps(g, self.TS[:3], "min"))
+        assert out_max[0, 1] == np.inf
+        assert out_min[0, 1] == -np.inf
+        # outside the series range stays NaN
+        g2 = grid_of([np.nan, 2.0, 3.0])
+        assert np.isnan(np.asarray(fill_gaps(g2, self.TS[:3], "max"))[0, 0])
+
+    def test_multi_series(self):
+        g = grid_of([0.0, np.nan, 20.0], [np.nan, 1.0, np.nan])
+        out = np.asarray(fill_gaps(g, self.TS[:3], "lerp"))
+        np.testing.assert_allclose(out[0], [0.0, 10.0, 20.0])
+        assert np.isnan(out[1, 0]) and out[1, 1] == 1.0 \
+            and np.isnan(out[1, 2])
