@@ -1,0 +1,364 @@
+"""The query engine (ref: ``src/core/TsdbQuery.java:64``).
+
+Compiles one validated :class:`TSQuery` into the array pipeline:
+
+1. resolve metric + filters against the UID tables
+   (``configureFromQuery`` :434)
+2. vectorized series selection over the metric's tag index
+   (replaces scanner row-regex + post-scan filters, ``findSpans`` :795)
+3. group-key construction from group-by tagv ids
+   (``GroupByAndAggregateCB`` :916-1045)
+4. time-grid construction: downsample buckets, or the union of distinct
+   timestamps when no downsample is given (the reference's
+   AggregationIterator emits at the union of span timestamps)
+5. one fused device pipeline per sub-query
+   (:mod:`opentsdb_tpu.ops.pipeline`)
+6. result assembly with the reference's tags/aggregateTags semantics
+   (SpanGroup: tags = identical k=v across all series; aggregateTags =
+   keys present everywhere with differing values)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from opentsdb_tpu.core.store import TimeSeriesStore
+from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.ops.pipeline import PipelineSpec, execute
+from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
+from opentsdb_tpu.stats.stats import QueryStat, QueryStats
+
+
+@dataclass
+class QueryResult:
+    """One output group — the analogue of one ``DataPoints`` object."""
+    metric: str
+    tags: dict[str, str]
+    aggregated_tags: list[str]
+    dps: list[tuple[int, float]]          # (ts_ms, value)
+    tsuids: list[str] = field(default_factory=list)
+    annotations: list = field(default_factory=list)
+    global_annotations: list = field(default_factory=list)
+    sub_query_index: int = 0
+
+
+class NoSuchMetricError(BadRequestError):
+    pass
+
+
+class QueryEngine:
+    """(ref: TsdbQuery; one instance per TSQuery execution)"""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        self._filter_eval = filters_mod.FilterEvaluator(tsdb.uids)
+
+    # ------------------------------------------------------------------
+
+    def run(self, ts_query: TSQuery,
+            stats: QueryStats | None = None) -> list[QueryResult]:
+        results: list[QueryResult] = []
+        for sub in ts_query.queries:
+            results.extend(self._run_sub(ts_query, sub, stats))
+        return results
+
+    # ------------------------------------------------------------------
+
+    def _run_sub(self, tsq: TSQuery, sub: TSSubQuery,
+                 stats: QueryStats | None) -> list[QueryResult]:
+        t0 = time.monotonic()
+        uids = self.tsdb.uids
+        if sub.percentiles:
+            from opentsdb_tpu.query.histogram_engine import \
+                run_histogram_subquery
+            return run_histogram_subquery(self.tsdb, tsq, sub)
+        store, metric_name, sids, rollup_scale = self._select_store(sub)
+        if len(sids) == 0:
+            return []
+
+        # --- filters -> series mask (ref: findSpans post-scan filters)
+        sids, series_tags = self._apply_filters(store, sub, sids)
+        if len(sids) == 0:
+            return []
+        if stats:
+            stats.add_stat(QueryStat.STRING_TO_UID_TIME,
+                           (time.monotonic() - t0) * 1e3)
+
+        # --- group construction (ref: GroupByAndAggregateCB :916)
+        gb_tagks = sorted({f.tagk for f in sub.filters if f.group_by})
+        gb_kids = []
+        for k in gb_tagks:
+            try:
+                gb_kids.append(uids.tag_names.get_id(k))
+            except LookupError:
+                return []
+        group_ids, group_keys = self._group_ids(series_tags, gb_kids)
+        emit_raw = sub.agg.is_none
+        if emit_raw:
+            group_ids = np.arange(len(sids), dtype=np.int32)
+            group_keys = [(i,) for i in range(len(sids))]
+        num_groups = len(group_keys)
+
+        # --- materialize + time grid
+        t1 = time.monotonic()
+        batch = store.materialize(sids, tsq.start_ms, tsq.end_ms)
+        if stats:
+            stats.add_stat(QueryStat.MATERIALIZE_TIME,
+                           (time.monotonic() - t1) * 1e3)
+            stats.add_stat(QueryStat.DPS_POST_FILTER, batch.num_points)
+        if batch.num_points == 0:
+            return []
+        if sub.ds_spec is not None:
+            bucket_idx, bucket_ts = ds_mod.assign_buckets(
+                batch.ts_ms, sub.ds_spec, tsq.start_ms, tsq.end_ms)
+            ds_function = sub.ds_spec.function
+            fill_policy = sub.ds_spec.fill_policy
+            fill_value = sub.ds_spec.fill_value
+        else:
+            # union-of-timestamps grid: every distinct input timestamp
+            # is an output point, like the reference's merge iterator
+            bucket_ts, bucket_idx = np.unique(batch.ts_ms,
+                                              return_inverse=True)
+            bucket_idx = bucket_idx.astype(np.int32)
+            ds_function = "sum"  # one point per (series, ts) after dedupe
+            fill_policy = ds_mod.FillPolicy.NONE
+            fill_value = float("nan")
+
+        # --- device pipeline
+        t2 = time.monotonic()
+        spec = PipelineSpec(
+            num_series=batch.num_series, num_buckets=len(bucket_ts),
+            num_groups=num_groups, ds_function=ds_function,
+            agg_name=sub.agg.name, fill_policy=fill_policy,
+            fill_value=fill_value, rate=sub.rate,
+            rate_counter=sub.rate_options.counter,
+            rate_drop_resets=sub.rate_options.drop_resets,
+            emit_raw=emit_raw)
+        result, emit = execute(
+            batch.values * rollup_scale if rollup_scale != 1.0
+            else batch.values,
+            batch.series_idx, bucket_idx, bucket_ts, group_ids, spec,
+            sub.rate_options)
+        if stats:
+            stats.add_stat(QueryStat.COMPUTE_TIME,
+                           (time.monotonic() - t2) * 1e3)
+
+        # --- assemble output groups
+        return self._build_results(
+            tsq, sub, metric_name, sids, series_tags, group_ids,
+            group_keys, gb_kids, bucket_ts, result, emit)
+
+    # ------------------------------------------------------------------
+
+    def _select_store(self, sub: TSSubQuery
+                      ) -> tuple[TimeSeriesStore, str, np.ndarray, float]:
+        """Pick raw store or a rollup tier (ref: TsdbQuery rollup
+        best-match :143-150 with ROLLUP_USAGE fallback :750)."""
+        uids = self.tsdb.uids
+        if sub.tsuids:
+            return self._tsuid_store(sub)
+        try:
+            metric_id = uids.metrics.get_id(sub.metric)
+        except LookupError:
+            raise NoSuchMetricError(
+                f"No such name for 'metrics': '{sub.metric}'") from None
+        store = self.tsdb.store
+        rollup_scale = 1.0
+        usage = (sub.rollup_usage or "ROLLUP_NOFALLBACK").upper()
+        if (self.tsdb.rollup_store is not None and sub.ds_spec is not None
+                and not sub.ds_spec.run_all and usage != "ROLLUP_RAW"):
+            tier = self.tsdb.rollup_config.best_match(
+                sub.ds_spec.interval_ms)
+            agg_fn = sub.ds_spec.function
+            if tier is not None and agg_fn in ("sum", "count", "min",
+                                               "max"):
+                if self.tsdb.rollup_store.has_data(tier.interval, agg_fn):
+                    store = self.tsdb.rollup_store.tier(tier.interval,
+                                                        agg_fn)
+        sids = store.series_ids_for_metric(metric_id)
+        if store is not self.tsdb.store and len(sids) == 0 and \
+                usage in ("ROLLUP_FALLBACK", "ROLLUP_FALLBACK_RAW"):
+            store = self.tsdb.store
+            sids = store.series_ids_for_metric(metric_id)
+        return store, sub.metric, sids, rollup_scale
+
+    def _tsuid_store(self, sub: TSSubQuery):
+        """Resolve explicit TSUID hex strings to series ids
+        (ref: TsdbQuery tsuid query path)."""
+        uids = self.tsdb.uids
+        store = self.tsdb.store
+        mw = uids.metrics.width
+        kw = uids.tag_names.width
+        vw = uids.tag_values.width
+        sids = []
+        metric_name = None
+        for tsuid in sub.tsuids:
+            raw = bytes.fromhex(tsuid)
+            metric_id = int.from_bytes(raw[:mw], "big")
+            tags = []
+            pos = mw
+            while pos < len(raw):
+                kid = int.from_bytes(raw[pos:pos + kw], "big")
+                vid = int.from_bytes(raw[pos + kw:pos + kw + vw], "big")
+                tags.append((kid, vid))
+                pos += kw + vw
+            name = uids.metrics.get_name(metric_id)
+            if metric_name is None:
+                metric_name = name
+            elif name != metric_name:
+                raise BadRequestError(
+                    "Multiple metrics in the same tsuid query")
+            key = (metric_id, tuple(sorted(tags)))
+            sid = store._key_to_sid.get(key)
+            if sid is not None:
+                sids.append(sid)
+        return store, metric_name or "", np.asarray(sids,
+                                                    dtype=np.int64), 1.0
+
+    # ------------------------------------------------------------------
+
+    def _apply_filters(self, store: TimeSeriesStore, sub: TSSubQuery,
+                       sids: np.ndarray
+                       ) -> tuple[np.ndarray, list[dict[int, int]]]:
+        recs = [store.series(int(s)) for s in sids]
+        if sub.filters:
+            metric_id = recs[0].metric_id
+            idx = store.metric_index(metric_id)
+            if idx is not None and store is self.tsdb.store \
+                    and not sub.tsuids:
+                _, triples = idx.arrays()
+            else:
+                rows = []
+                for rec in recs:
+                    for kid, vid in rec.tags:
+                        rows.append((rec.series_id, kid, vid))
+                triples = (np.asarray(rows, dtype=np.int64).reshape(-1, 3)
+                           if rows else np.empty((0, 3), dtype=np.int64))
+            mask = self._filter_eval.apply(sub.filters, sids, triples)
+            sids = sids[mask]
+            recs = [r for r, m in zip(recs, mask) if m]
+        if sub.explicit_tags and sub.filters:
+            filter_keys = set()
+            for f in sub.filters:
+                try:
+                    filter_keys.add(
+                        self.tsdb.uids.tag_names.get_id(f.tagk))
+                except LookupError:
+                    pass
+            keep = [i for i, r in enumerate(recs)
+                    if {k for k, _ in r.tags} == filter_keys]
+            sids = sids[keep]
+            recs = [recs[i] for i in keep]
+        series_tags = [dict(r.tags) for r in recs]
+        return sids, series_tags
+
+    @staticmethod
+    def _group_ids(series_tags: list[dict[int, int]], gb_kids: list[int]
+                   ) -> tuple[np.ndarray, list[tuple]]:
+        """Group key = tuple of group-by tagv ids (ref: the concatenated
+        tagv UID group key, TsdbQuery.java:995-1036)."""
+        if not gb_kids:
+            return (np.zeros(len(series_tags), dtype=np.int32), [()])
+        keys: list[tuple] = []
+        key_to_gid: dict[tuple, int] = {}
+        gids = np.empty(len(series_tags), dtype=np.int32)
+        for i, tags in enumerate(series_tags):
+            key = tuple(tags.get(k, -1) for k in gb_kids)
+            gid = key_to_gid.get(key)
+            if gid is None:
+                gid = len(keys)
+                key_to_gid[key] = gid
+                keys.append(key)
+            gids[i] = gid
+        return gids, keys
+
+    # ------------------------------------------------------------------
+
+    def _build_results(self, tsq, sub, metric_name, sids, series_tags,
+                       group_ids, group_keys, gb_kids, bucket_ts,
+                       result, emit) -> list[QueryResult]:
+        uids = self.tsdb.uids
+        out: list[QueryResult] = []
+        ms_res = tsq.ms_resolution
+        fetch_annotations = not tsq.no_annotations
+        for gid in range(len(group_keys)):
+            members = np.nonzero(group_ids == gid)[0]
+            if len(members) == 0:
+                continue
+            row = result[gid]
+            erow = emit[gid]
+            dps = _emit_dps(bucket_ts, row, erow, ms_res)
+            if not dps:
+                continue
+            tags, agg_tags = _common_tags(
+                [series_tags[m] for m in members], uids)
+            tsuids = []
+            if tsq.show_tsuids or sub.tsuids:
+                for m in members:
+                    rec_tags = sorted(series_tags[m].items())
+                    metric_id = uids.metrics.get_id(metric_name)
+                    tsuids.append(
+                        uids.tsuid(metric_id, rec_tags).hex().upper())
+            annotations = []
+            if fetch_annotations:
+                start_s = tsq.start_ms // 1000
+                end_s = tsq.end_ms // 1000
+                try:
+                    metric_id = uids.metrics.get_id(metric_name)
+                    for m in members:
+                        tsuid_hex = uids.tsuid(
+                            metric_id,
+                            sorted(series_tags[m].items())).hex().upper()
+                        annotations.extend(
+                            self.tsdb.annotations.range(tsuid_hex,
+                                                        start_s, end_s))
+                except LookupError:
+                    pass
+            global_annotations = []
+            if tsq.global_annotations:
+                global_annotations = self.tsdb.annotations.global_range(
+                    tsq.start_ms // 1000, tsq.end_ms // 1000)
+            out.append(QueryResult(
+                metric=metric_name, tags=tags, aggregated_tags=agg_tags,
+                dps=dps, tsuids=tsuids, annotations=annotations,
+                global_annotations=global_annotations,
+                sub_query_index=sub.index))
+        return out
+
+
+def _emit_dps(bucket_ts, row, erow, ms_resolution: bool
+              ) -> list[tuple[int, float]]:
+    """Compress (value,emit) arrays into the output point list."""
+    emit_idx = np.nonzero(erow)[0]
+    dps = []
+    for b in emit_idx:
+        v = row[b]
+        ts = int(bucket_ts[b])
+        dps.append((ts if ms_resolution else (ts // 1000) * 1000,
+                    float(v)))
+    return dps
+
+
+def _common_tags(tag_dicts: list[dict[int, int]], uids
+                 ) -> tuple[dict[str, str], list[str]]:
+    """SpanGroup semantics: ``tags`` = k=v pairs identical across every
+    series; ``aggregateTags`` = keys present in every series with
+    differing values (keys missing from some series vanish)."""
+    common_keys = set(tag_dicts[0])
+    for t in tag_dicts[1:]:
+        common_keys &= set(t)
+    tags: dict[str, str] = {}
+    agg_tags: list[str] = []
+    for k in sorted(common_keys):
+        vals = {t[k] for t in tag_dicts}
+        kname = uids.tag_names.get_name(k)
+        if len(vals) == 1:
+            tags[kname] = uids.tag_values.get_name(next(iter(vals)))
+        else:
+            agg_tags.append(kname)
+    return tags, agg_tags

@@ -1,0 +1,104 @@
+"""Histogram / percentile query path.
+
+(ref: ``TsdbQuery.isHistogramQuery`` :776 routes queries with
+``percentiles`` set to the HistogramSpan/HistogramAggregationIterator
+pipeline; merge is bucket-wise SUM, then ``SimpleHistogram.percentile``)
+
+TPU formulation: the histogram points of all series in the window stack
+into a dense ``[points, buckets]`` count matrix; merge-by-timestamp and
+group-by are segment-sums over the leading axis, and percentile
+extraction is a vectorized cumsum + searchsorted over the bucket axis —
+see :func:`percentiles_from_counts`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
+
+
+def percentiles_from_counts(counts: np.ndarray, bounds: np.ndarray,
+                            qs: list[float]) -> np.ndarray:
+    """counts[T, nbuckets], bounds[nbuckets+1] -> [len(qs), T].
+
+    Midpoint convention matches SimpleHistogram.percentile (:133): the
+    bucket whose cumulative count crosses rank contributes its midpoint.
+    """
+    totals = counts.sum(axis=1)  # [T]
+    cum = np.cumsum(counts, axis=1)  # [T, B]
+    mids = (bounds[:-1] + bounds[1:]) / 2.0
+    out = np.empty((len(qs), counts.shape[0]), dtype=np.float64)
+    for qi, q in enumerate(qs):
+        target = totals * (q / 100.0)
+        idx = np.sum(cum < target[:, None], axis=1)
+        idx = np.clip(idx, 0, len(mids) - 1)
+        out[qi] = np.where(totals > 0, mids[idx], 0.0)
+    return out
+
+
+def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
+    """Execute a percentile sub-query over stored histogram datapoints."""
+    from opentsdb_tpu.query.engine import QueryResult, _common_tags
+    uids = tsdb.uids
+    try:
+        metric_id = uids.metrics.get_id(sub.metric)
+    except LookupError:
+        raise BadRequestError(
+            f"No such name for 'metrics': '{sub.metric}'") from None
+    store = tsdb.histogram_store
+    sids = store.series_ids_for_metric(metric_id)
+    if len(sids) == 0:
+        return []
+    # filters reuse the scalar evaluator over the histogram store's index
+    from opentsdb_tpu.query.filters import FilterEvaluator
+    if sub.filters:
+        idx = store.metric_index(metric_id)
+        _, triples = idx.arrays()
+        mask = FilterEvaluator(uids).apply(sub.filters, sids, triples)
+        sids = sids[mask]
+        if len(sids) == 0:
+            return []
+    series_tags = [dict(store.series(int(s)).tags) for s in sids]
+
+    gb_kids = sorted({uids.tag_names.get_id(f.tagk)
+                      for f in sub.filters if f.group_by
+                      and uids.tag_names.has_name(f.tagk)})
+    from opentsdb_tpu.query.engine import QueryEngine
+    group_ids, group_keys = QueryEngine._group_ids(series_tags, gb_kids)
+
+    out = []
+    for gid in range(len(group_keys)):
+        members = [i for i in range(len(sids)) if group_ids[i] == gid]
+        if not members:
+            continue
+        # merge member histograms by timestamp (bucket-wise SUM)
+        merged: dict[int, np.ndarray] = {}
+        bounds = None
+        for i in members:
+            for ts_ms, hist in tsdb._histogram_series.get(int(sids[i]), []):
+                if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
+                    continue
+                arr = hist.counts_array()
+                if bounds is None:
+                    bounds = np.asarray(hist.bounds, dtype=np.float64)
+                if ts_ms in merged:
+                    merged[ts_ms] = merged[ts_ms] + arr
+                else:
+                    merged[ts_ms] = arr
+        if not merged or bounds is None:
+            continue
+        ts_sorted = sorted(merged)
+        counts = np.stack([merged[t] for t in ts_sorted])
+        pcts = percentiles_from_counts(counts, bounds, sub.percentiles)
+        tags, agg_tags = _common_tags(
+            [series_tags[m] for m in members], uids)
+        for qi, q in enumerate(sub.percentiles):
+            dps = [((t // 1000) * 1000 if not tsq.ms_resolution else t,
+                    float(pcts[qi, ti]))
+                   for ti, t in enumerate(ts_sorted)]
+            out.append(QueryResult(
+                metric=f"{sub.metric}_pct_{q:g}", tags=tags,
+                aggregated_tags=agg_tags, dps=dps,
+                sub_query_index=sub.index))
+    return out

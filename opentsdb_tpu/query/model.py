@@ -1,0 +1,264 @@
+"""Query model: the public JSON/URI query surface
+(ref: ``src/core/TSQuery.java:44``, ``TSSubQuery.java:48``).
+
+Validation semantics follow ``TSQuery.validateAndSetQuery``: start time
+required, aggregator required per sub-query, one of metric|tsuids
+required, times normalized to ms, end defaulting to now.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.ops.downsample import DownsamplingSpecification
+from opentsdb_tpu.ops.rate import RateOptions
+from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.utils import datetime_util
+
+
+class BadRequestError(ValueError):
+    """400-level query errors (ref: src/tsd/BadRequestException.java)."""
+
+
+@dataclass
+class TSSubQuery:
+    """(ref: TSSubQuery.java:48-104)"""
+    aggregator: str = ""
+    metric: str | None = None
+    tsuids: list[str] = field(default_factory=list)
+    downsample: str | None = None
+    rate: bool = False
+    rate_options: RateOptions = field(default_factory=RateOptions)
+    filters: list[filters_mod.TagVFilter] = field(default_factory=list)
+    explicit_tags: bool = False
+    percentiles: list[float] = field(default_factory=list)
+    rollup_usage: str = "ROLLUP_NOFALLBACK"
+    index: int = 0
+    # populated during validation
+    agg: aggs_mod.Aggregator | None = None
+    ds_spec: DownsamplingSpecification | None = None
+
+    def validate(self, timezone: str | None = None) -> None:
+        if not self.aggregator:
+            raise BadRequestError(
+                "Missing the aggregation function")
+        try:
+            self.agg = aggs_mod.get(self.aggregator)
+        except KeyError as e:
+            raise BadRequestError(str(e)) from None
+        if not self.metric and not self.tsuids:
+            raise BadRequestError(
+                "Missing the metric or tsuids, provide at least one")
+        if self.downsample:
+            try:
+                self.ds_spec = DownsamplingSpecification.parse(
+                    self.downsample, timezone)
+            except ValueError as e:
+                raise BadRequestError(str(e)) from None
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any], index: int = 0) -> "TSSubQuery":
+        filters = [filters_mod.build_filter(f)
+                   for f in obj.get("filters", [])]
+        if obj.get("tags"):
+            filters.extend(filters_mod.tags_to_filters(obj["tags"]))
+        rate_opts = RateOptions()
+        if obj.get("rateOptions"):
+            ro = obj["rateOptions"]
+            rate_opts = RateOptions(
+                counter=bool(ro.get("counter", False)),
+                counter_max=float(ro.get("counterMax", 2**64 - 1)),
+                reset_value=float(ro.get("resetValue", 0)),
+                drop_resets=bool(ro.get("dropResets", False)))
+        return cls(
+            aggregator=obj.get("aggregator", ""),
+            metric=obj.get("metric"),
+            tsuids=list(obj.get("tsuids") or []),
+            downsample=obj.get("downsample"),
+            rate=bool(obj.get("rate", False)),
+            rate_options=rate_opts,
+            filters=filters,
+            explicit_tags=bool(obj.get("explicitTags", False)),
+            percentiles=[float(p) for p in obj.get("percentiles") or []],
+            rollup_usage=obj.get("rollupUsage", "ROLLUP_NOFALLBACK"),
+            index=index)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "aggregator": self.aggregator,
+            "metric": self.metric,
+            "tsuids": self.tsuids or None,
+            "downsample": self.downsample,
+            "rate": self.rate,
+            "rateOptions": (self.rate_options.to_json()
+                            if self.rate else None),
+            "filters": [f.to_json() for f in self.filters],
+            "explicitTags": self.explicit_tags,
+            "index": self.index,
+        }
+
+
+@dataclass
+class TSQuery:
+    """(ref: TSQuery.java:44)"""
+    start: str = ""
+    end: str | None = None
+    queries: list[TSSubQuery] = field(default_factory=list)
+    timezone: str | None = None
+    no_annotations: bool = False
+    global_annotations: bool = False
+    ms_resolution: bool = False
+    show_tsuids: bool = False
+    show_summary: bool = False
+    show_stats: bool = False
+    show_query: bool = False
+    delete: bool = False
+    use_calendar: bool = False
+    # populated during validation
+    start_ms: int = 0
+    end_ms: int = 0
+
+    def validate(self, now_ms: int | None = None) -> "TSQuery":
+        """(ref: TSQuery.validateAndSetQuery)"""
+        if not self.start:
+            raise BadRequestError("Missing start time")
+        self.start_ms = datetime_util.parse_datetime_ms(
+            self.start, self.timezone, now_ms)
+        if self.end:
+            self.end_ms = datetime_util.parse_datetime_ms(
+                self.end, self.timezone, now_ms)
+        else:
+            import time as _t
+            self.end_ms = (now_ms if now_ms is not None
+                           else int(_t.time() * 1000))
+        if self.end_ms <= self.start_ms:
+            raise BadRequestError(
+                "end time must be greater than the start time")
+        if not self.queries:
+            raise BadRequestError("Missing queries")
+        for i, sub in enumerate(self.queries):
+            sub.index = i
+            sub.validate(self.timezone)
+        return self
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "TSQuery":
+        if not isinstance(obj, dict):
+            raise BadRequestError("query must be a JSON object")
+        queries = [TSSubQuery.from_json(q, i)
+                   for i, q in enumerate(obj.get("queries") or [])]
+        return cls(
+            start=str(obj.get("start", "")),
+            end=(str(obj["end"]) if obj.get("end") not in (None, "")
+                 else None),
+            queries=queries,
+            timezone=obj.get("timezone"),
+            no_annotations=bool(obj.get("noAnnotations", False)),
+            global_annotations=bool(obj.get("globalAnnotations", False)),
+            ms_resolution=bool(obj.get("msResolution")
+                               or obj.get("ms", False)),
+            show_tsuids=bool(obj.get("showTSUIDs", False)),
+            show_summary=bool(obj.get("showSummary", False)),
+            show_stats=bool(obj.get("showStats", False)),
+            show_query=bool(obj.get("showQuery", False)),
+            delete=bool(obj.get("delete", False)),
+            use_calendar=bool(obj.get("useCalendar", False)),
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "start": self.start, "end": self.end,
+            "timezone": self.timezone,
+            "queries": [q.to_json() for q in self.queries],
+            "noAnnotations": self.no_annotations,
+            "globalAnnotations": self.global_annotations,
+            "msResolution": self.ms_resolution,
+            "showTSUIDs": self.show_tsuids,
+        }
+
+
+def parse_uri_subquery(spec: str, index: int = 0) -> TSSubQuery:
+    """Parse the URI form ``agg:[interval-ds:][rate[{...}]:]metric{tags}[{filters}]``
+    (ref: QueryRpc.parseMTypeSubQuery)."""
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise BadRequestError(f"Invalid parameter m={spec!r}")
+    aggregator = parts[0]
+    metric_part = parts[-1]
+    sub = TSSubQuery(aggregator=aggregator, index=index)
+    for middle in parts[1:-1]:
+        if middle.startswith("rate"):
+            sub.rate = True
+            sub.rate_options = RateOptions.parse(middle)
+        elif middle == "":
+            continue
+        else:
+            sub.downsample = middle
+    # metric{groupby-tags}{filter-tags}
+    import re as _re
+    m = _re.match(r"^([^{]+)(\{[^}]*\})?(\{[^}]*\})?$", metric_part)
+    if not m:
+        raise BadRequestError(f"Invalid metric: {metric_part!r}")
+    sub.metric = m.group(1)
+
+    def _parse_tagset(blob: str | None, group_by: bool):
+        if not blob:
+            return
+        body = blob[1:-1].strip()
+        if not body:
+            return
+        for pair in body.split(","):
+            k, _, v = pair.partition("=")
+            if not k or not v:
+                raise BadRequestError(f"Invalid tag spec: {pair!r}")
+            if group_by:
+                sub.filters.append(
+                    filters_mod.get_filter(k.strip(), v.strip(),
+                                           group_by=True))
+            else:
+                f = filters_mod.get_filter(k.strip(), v.strip())
+                f.group_by = False
+                sub.filters.append(f)
+
+    # first {...} groups by, second {...} filters only (2.2+ semantics)
+    if m.group(2) and m.group(3):
+        _parse_tagset(m.group(2), True)
+        _parse_tagset(m.group(3), False)
+    elif m.group(2):
+        # single tagset: old-style conversion decides group-by per value
+        body = m.group(2)[1:-1].strip()
+        if body:
+            tag_map = {}
+            for pair in body.split(","):
+                k, _, v = pair.partition("=")
+                if not k or not v:
+                    raise BadRequestError(f"Invalid tag spec: {pair!r}")
+                tag_map[k.strip()] = v.strip()
+            sub.filters.extend(filters_mod.tags_to_filters(tag_map))
+    return sub
+
+
+def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
+    """Parse ``/api/query?start=...&m=...`` URI params
+    (ref: QueryRpc.parseQuery)."""
+    def first(key, default=None):
+        vals = params.get(key)
+        return vals[0] if vals else default
+
+    queries = [parse_uri_subquery(spec, i)
+               for i, spec in enumerate(params.get("m", []))]
+    return TSQuery(
+        start=first("start", ""),
+        end=first("end"),
+        queries=queries,
+        timezone=first("tz"),
+        no_annotations=first("no_annotations", "false") == "true",
+        global_annotations=first("global_annotations", "false") == "true",
+        ms_resolution=first("ms", first("ms_resolution", "false"))
+        in ("true", ""),
+        show_tsuids=first("show_tsuids", "false") == "true",
+        show_summary=first("show_summary", "false") == "true",
+        show_query=first("show_query", "false") == "true",
+    )

@@ -1,0 +1,294 @@
+"""End-to-end query engine tests
+(ref: test/core/TestTsdbQuery*.java, TestTSQuery.java)."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.model import (BadRequestError, TSQuery, TSSubQuery,
+                                      parse_uri_query)
+
+BASE = 1356998400  # 2013-01-01 00:00:00 UTC
+
+
+def q(start, end, *subs, **kw):
+    tsq = TSQuery(start=str(start), end=str(end), queries=list(subs), **kw)
+    return tsq.validate()
+
+
+def sub(metric="sys.cpu.user", agg="sum", **kw):
+    d = {"aggregator": agg, "metric": metric}
+    d.update(kw)
+    return TSSubQuery.from_json(d)
+
+
+class TestTSQueryValidation:
+    def test_missing_start(self):
+        with pytest.raises(BadRequestError):
+            TSQuery(queries=[sub()]).validate()
+
+    def test_missing_queries(self):
+        with pytest.raises(BadRequestError):
+            TSQuery(start="1h-ago").validate()
+
+    def test_missing_aggregator(self):
+        with pytest.raises(BadRequestError):
+            q(BASE, BASE + 100, sub(agg=""))
+
+    def test_bad_aggregator(self):
+        with pytest.raises(BadRequestError):
+            q(BASE, BASE + 100, sub(agg="bogus"))
+
+    def test_missing_metric_and_tsuids(self):
+        s = TSSubQuery(aggregator="sum")
+        with pytest.raises(BadRequestError):
+            q(BASE, BASE + 100, s)
+
+    def test_end_before_start(self):
+        with pytest.raises(BadRequestError):
+            q(BASE + 100, BASE, sub())
+
+    def test_times_normalized_to_ms(self):
+        tsq = q(BASE, BASE + 3600, sub())
+        assert tsq.start_ms == BASE * 1000
+        assert tsq.end_ms == (BASE + 3600) * 1000
+
+    def test_from_json_roundtrip(self):
+        obj = {
+            "start": "1h-ago",
+            "queries": [{"aggregator": "sum", "metric": "m",
+                         "downsample": "1m-avg", "rate": True,
+                         "rateOptions": {"counter": True,
+                                         "counterMax": 100},
+                         "filters": [{"type": "wildcard", "tagk": "host",
+                                      "filter": "web*",
+                                      "groupBy": True}]}],
+        }
+        tsq = TSQuery.from_json(obj)
+        assert tsq.queries[0].rate
+        assert tsq.queries[0].rate_options.counter_max == 100
+        assert tsq.queries[0].filters[0].filter_name == "wildcard"
+
+
+class TestUriParsing:
+    def test_m_parse(self):
+        tsq = parse_uri_query({"start": ["1h-ago"],
+                               "m": ["sum:1m-avg:rate:sys.cpu{host=*}"]})
+        s = tsq.queries[0]
+        assert s.aggregator == "sum"
+        assert s.downsample == "1m-avg"
+        assert s.rate
+        assert s.metric == "sys.cpu"
+        assert s.filters[0].group_by
+
+    def test_m_filters_second_braces(self):
+        tsq = parse_uri_query(
+            {"start": ["1h-ago"],
+             "m": ["sum:sys.cpu{host=*}{dc=literal_or(lga)}"]})
+        s = tsq.queries[0]
+        gb = [f for f in s.filters if f.group_by]
+        ngb = [f for f in s.filters if not f.group_by]
+        assert len(gb) == 1 and gb[0].tagk == "host"
+        assert len(ngb) == 1 and ngb[0].tagk == "dc"
+
+    def test_exact_tag_does_not_group(self):
+        tsq = parse_uri_query({"start": ["1h-ago"],
+                               "m": ["sum:sys.cpu{host=web01}"]})
+        assert not tsq.queries[0].filters[0].group_by
+
+
+class TestQueryExecution:
+    """(ref: TestTsdbQuery run* tests over the MockBase fixture)"""
+
+    def test_simple_sum_two_series(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 3000, sub())
+        results = seeded_tsdb.execute_query(tsq)
+        assert len(results) == 1
+        r = results[0]
+        assert r.metric == "sys.cpu.user"
+        assert r.aggregated_tags == ["host"]
+        assert r.tags == {}
+        # i + (300 - i) = 300 at every aligned timestamp
+        assert all(v == 300.0 for _, v in r.dps)
+        assert len(r.dps) == 300
+
+    def test_group_by_host(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 3000,
+                sub(tags={"host": "*"}))
+        results = seeded_tsdb.execute_query(tsq)
+        assert len(results) == 2
+        by_host = {r.tags["host"]: r for r in results}
+        assert set(by_host) == {"web01", "web02"}
+        assert by_host["web01"].dps[0][1] == 0.0
+        assert by_host["web02"].dps[0][1] == 300.0
+        assert by_host["web01"].aggregated_tags == []
+
+    def test_filter_single_host(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 3000, sub(tags={"host": "web01"}))
+        results = seeded_tsdb.execute_query(tsq)
+        assert len(results) == 1
+        assert results[0].tags == {"host": "web01"}
+        vals = [v for _, v in results[0].dps]
+        assert vals[:3] == [0.0, 1.0, 2.0]
+
+    def test_downsample_avg(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 3599,
+                sub(downsample="1m-avg", tags={"host": "web01"}))
+        results = seeded_tsdb.execute_query(tsq)
+        vals = [v for _, v in results[0].dps]
+        # 6 points per minute: avg of (0..5) = 2.5, (6..11) = 8.5 ...
+        assert vals[0] == 2.5
+        assert vals[1] == 8.5
+        ts0 = results[0].dps[0][0]
+        assert ts0 == BASE * 1000  # aligned to bucket start
+
+    def test_downsample_max_groupby(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 3599,
+                sub(agg="max", downsample="1m-max", tags={"host": "*"}))
+        results = seeded_tsdb.execute_query(tsq)
+        assert len(results) == 2
+        by_host = {r.tags["host"]: r for r in results}
+        assert by_host["web01"].dps[0][1] == 5.0
+        assert by_host["web02"].dps[0][1] == 300.0
+
+    def test_rate(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 100,
+                sub(rate=True, tags={"host": "web01"}))
+        results = seeded_tsdb.execute_query(tsq)
+        vals = [v for _, v in results[0].dps]
+        np.testing.assert_allclose(vals, 0.1, rtol=1e-6)  # +1 per 10s
+
+    def test_no_such_metric(self, seeded_tsdb):
+        from opentsdb_tpu.query.engine import NoSuchMetricError
+        tsq = q(BASE, BASE + 100, sub(metric="no.such.metric"))
+        with pytest.raises(NoSuchMetricError):
+            seeded_tsdb.execute_query(tsq)
+
+    def test_empty_time_range(self, seeded_tsdb):
+        tsq = q(BASE + 100000, BASE + 100100, sub())
+        assert seeded_tsdb.execute_query(tsq) == []
+
+    def test_wildcard_filter(self, tsdb):
+        for host in ("web01", "web02", "db01"):
+            tsdb.add_point("m", BASE, 1, {"host": host})
+        tsq = q(BASE - 10, BASE + 10,
+                sub(metric="m",
+                    filters=[{"type": "wildcard", "tagk": "host",
+                              "filter": "web*", "groupBy": False}]))
+        results = tsdb.execute_query(tsq)
+        assert len(results) == 1
+        assert results[0].dps[0][1] == 2.0  # only the two web hosts
+
+    def test_not_literal_or(self, tsdb):
+        for host in ("a", "b", "c"):
+            tsdb.add_point("m", BASE, 1, {"host": host})
+        tsq = q(BASE - 10, BASE + 10,
+                sub(metric="m",
+                    filters=[{"type": "not_literal_or", "tagk": "host",
+                              "filter": "a", "groupBy": False}]))
+        results = tsdb.execute_query(tsq)
+        assert results[0].dps[0][1] == 2.0
+
+    def test_not_key_filter(self, tsdb):
+        tsdb.add_point("m", BASE, 1, {"host": "a"})
+        tsdb.add_point("m", BASE, 10, {"host": "b", "dc": "lga"})
+        tsq = q(BASE - 10, BASE + 10,
+                sub(metric="m",
+                    filters=[{"type": "not_key", "tagk": "dc",
+                              "filter": "", "groupBy": False}]))
+        results = tsdb.execute_query(tsq)
+        assert results[0].dps[0][1] == 1.0
+
+    def test_explicit_tags(self, tsdb):
+        tsdb.add_point("m", BASE, 1, {"host": "a"})
+        tsdb.add_point("m", BASE, 10, {"host": "a", "dc": "lga"})
+        tsq = q(BASE - 10, BASE + 10,
+                sub(metric="m", explicitTags=True,
+                    tags={"host": "a"}))
+        results = tsdb.execute_query(tsq)
+        assert results[0].dps[0][1] == 1.0
+
+    def test_none_aggregator_emits_raw(self, tsdb):
+        for host in ("a", "b"):
+            tsdb.add_point("m", BASE, 5, {"host": host})
+        tsq = q(BASE - 10, BASE + 10, sub(metric="m", agg="none"))
+        results = tsdb.execute_query(tsq)
+        assert len(results) == 2
+
+    def test_tsuid_query(self, seeded_tsdb):
+        uids = seeded_tsdb.uids
+        mid = uids.metrics.get_id("sys.cpu.user")
+        kid = uids.tag_names.get_id("host")
+        vid = uids.tag_values.get_id("web01")
+        tsuid = uids.tsuid(mid, [(kid, vid)]).hex().upper()
+        tsq = q(BASE, BASE + 100, sub(metric=None, tsuids=[tsuid]))
+        results = seeded_tsdb.execute_query(tsq)
+        assert len(results) == 1
+        assert results[0].tags == {"host": "web01"}
+        assert tsuid in results[0].tsuids
+
+    def test_interpolation_unaligned_series(self, tsdb):
+        # the doc example from AggregationIterator.java:27-119
+        tsdb.add_point("m", BASE + 0, 10, {"host": "a"})
+        tsdb.add_point("m", BASE + 20, 30, {"host": "a"})
+        tsdb.add_point("m", BASE + 10, 100, {"host": "b"})
+        tsdb.add_point("m", BASE + 30, 300, {"host": "b"})
+        tsq = q(BASE - 1, BASE + 40, sub(metric="m"))
+        results = tsdb.execute_query(tsq)
+        dps = dict((ts // 1000 - BASE, v) for ts, v in results[0].dps)
+        assert dps[0] == 10.0           # only a
+        assert dps[10] == 120.0         # a lerps to 20, b=100
+        assert dps[20] == 230.0         # a=30, b lerps to 200
+        assert dps[30] == 300.0         # only b (a exhausted)
+
+    def test_zimsum_no_interpolation(self, tsdb):
+        tsdb.add_point("m", BASE + 0, 10, {"host": "a"})
+        tsdb.add_point("m", BASE + 20, 30, {"host": "a"})
+        tsdb.add_point("m", BASE + 10, 100, {"host": "b"})
+        tsq = q(BASE - 1, BASE + 40, sub(metric="m", agg="zimsum"))
+        results = tsdb.execute_query(tsq)
+        dps = dict((ts // 1000 - BASE, v) for ts, v in results[0].dps)
+        assert dps == {0: 10.0, 10: 100.0, 20: 30.0}
+
+    def test_downsample_fill_zero(self, tsdb):
+        tsdb.add_point("m", BASE, 5, {"host": "a"})
+        tsdb.add_point("m", BASE + 120, 7, {"host": "a"})
+        tsq = q(BASE, BASE + 179, sub(metric="m",
+                                      downsample="1m-sum-zero"))
+        results = tsdb.execute_query(tsq)
+        vals = [v for _, v in results[0].dps]
+        assert vals == [5.0, 0.0, 7.0]
+
+    def test_multi_subquery(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 100, sub(agg="min"), sub(agg="max"))
+        results = seeded_tsdb.execute_query(tsq)
+        assert len(results) == 2
+        assert results[0].sub_query_index == 0
+        assert results[1].sub_query_index == 1
+
+    def test_ms_resolution(self, seeded_tsdb):
+        tsq = q(BASE, BASE + 100, sub(), ms_resolution=True)
+        r = seeded_tsdb.execute_query(tsq)[0]
+        assert r.dps[0][0] == BASE * 1000
+
+
+class TestRollupQuery:
+    def test_rollup_tier_used(self, tsdb):
+        # write rollup data at the 1h tier only
+        for i in range(4):
+            tsdb.add_aggregate_point("m", BASE + i * 3600, 100 + i,
+                                     {"host": "a"}, False, "1h", "sum")
+        tsq = q(BASE, BASE + 4 * 3600, sub(metric="m",
+                                           downsample="1h-sum"))
+        results = tsdb.execute_query(tsq)
+        vals = [v for _, v in results[0].dps]
+        assert vals == [100.0, 101.0, 102.0, 103.0]
+
+    def test_preagg_tag(self, tsdb):
+        tsdb.add_aggregate_point("m", BASE, 42, {"host": "a"}, True,
+                                 None, None, groupby_agg="SUM")
+        store = tsdb.rollup_store.preagg_store()
+        assert store.total_points() == 1
+        # the agg tag was added (ref: TSDB.java agg_tag_key)
+        rec = store.series(0)
+        kid = tsdb.uids.tag_names.get_id("_aggregate")
+        assert any(k == kid for k, _ in rec.tags)
