@@ -1,0 +1,102 @@
+"""Benchmark: datapoints aggregated per second per chip.
+
+Runs the fused query pipeline (downsample -> rate -> interpolate ->
+aggregate -> group-by, opentsdb_tpu.ops.pipeline) on one chip over a
+synthetic workload shaped like BASELINE.json config 3: 1M series, one
+hour window, per-minute samples, 5m avg downsample, rate conversion,
+group-by sum into 100 groups.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the reference's single-TSD Java
+iterator path. OpenTSDB publishes no numbers (BASELINE.md); the Java
+pipeline is a per-datapoint virtual-call chain
+(AggregationIterator.java:253-280, single-threaded per query), measured
+in public deployments at single-digit millions of dp/s per query
+thread. We use 10M dp/s as the comparison constant — generous to the
+reference — until a measured Java baseline lands in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+JAVA_BASELINE_DPS = 10_000_000.0  # see module docstring
+
+
+def make_batch(num_series: int, points_per: int, num_buckets: int,
+               num_groups: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    n = num_series * points_per
+    values = rng.normal(100.0, 15.0, size=n).astype(np.float32)
+    series_idx = np.repeat(np.arange(num_series, dtype=np.int32),
+                           points_per)
+    bucket_idx = np.tile(
+        (np.arange(points_per, dtype=np.int32) * num_buckets) // points_per,
+        num_series)
+    bucket_ts = np.arange(num_buckets, dtype=np.int64) * 300_000
+    group_ids = (np.arange(num_series, dtype=np.int32) % num_groups)
+    return values, series_idx, bucket_idx, bucket_ts, group_ids
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from opentsdb_tpu.ops.pipeline import PipelineSpec, run_pipeline
+
+    # config-3 shape: 1M series x 1h @ 1/min, 5m avg downsample + rate,
+    # sum group-by into 100 groups
+    num_series = 1_000_000
+    points_per = 60
+    num_buckets = 12
+    num_groups = 100
+    n_points = num_series * points_per
+
+    spec = PipelineSpec(
+        num_series=num_series, num_buckets=num_buckets,
+        num_groups=num_groups, ds_function="avg", agg_name="sum",
+        rate=True)
+
+    values, series_idx, bucket_idx, bucket_ts, group_ids = make_batch(
+        num_series, points_per, num_buckets, num_groups)
+
+    dtype = jnp.float32
+    dev_args = (
+        jax.device_put(jnp.asarray(values, dtype)),
+        jax.device_put(jnp.asarray(series_idx)),
+        jax.device_put(jnp.asarray(bucket_idx)),
+        jax.device_put(jnp.asarray(bucket_ts)),
+        jax.device_put(jnp.asarray(group_ids)),
+        (jnp.asarray(2.0**64 - 1, dtype), jnp.asarray(0.0, dtype)),
+        jnp.asarray(float("nan"), dtype),
+    )
+
+    def step():
+        result, emit = run_pipeline(*dev_args, spec)
+        return result
+
+    # warmup / compile
+    step().block_until_ready()
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        # block every iteration: async dispatch without a barrier
+        # under-reports wall time on this backend
+        step().block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    dps = n_points / dt
+    print(json.dumps({
+        "metric": "datapoints aggregated/sec/chip",
+        "value": round(dps),
+        "unit": "datapoints/s",
+        "vs_baseline": round(dps / JAVA_BASELINE_DPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -90,7 +90,7 @@ def run_pipeline(values, series_idx, bucket_idx, bucket_ts, group_ids,
     # (plain Downsampler skips empty buckets); any other policy emits
     # every bucket (FillingDownsampler semantics)
     if spec.fill_policy == ds_mod.FillPolicy.NONE:
-        emit = jax.ops.segment_max(has_data.astype(jnp.int32), group_ids,
+        emit = jax.ops.segment_sum(has_data.astype(jnp.int32), group_ids,
                                    num_segments=g) > 0
     else:
         emit = jnp.ones((g, b), dtype=bool)

@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+The reference scales by (a) 20-way salt-bucket scan fan-out inside one
+TSD (SaltScanner.java:70) and (b) stateless TSD scale-out behind a load
+balancer. The TPU build maps both onto one ``jax.sharding.Mesh``:
+
+- ``series`` axis — the salt axis: series are hashed onto devices the
+  same way row keys are hashed into salt buckets (RowKey.java:141).
+  Group-by reductions cross this axis via ``psum`` over ICI.
+- ``time`` axis — long time ranges split into blocks (the reference's
+  hourly-row streaming + rollup tiers, SURVEY.md §5.7); rate and
+  interpolation exchange boundary halos over this axis like sequence /
+  context parallelism exchanges activations.
+
+Multi-host deployments extend the same mesh over DCN: ``jax.devices()``
+spanning hosts needs no code changes (pjit/shard_map are SPMD-global).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_series: int | None = None, n_time: int = 1,
+              devices=None) -> Mesh:
+    """Build a ('series', 'time') mesh over the available devices."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    total = devs.size
+    if n_series is None:
+        n_series = total // n_time
+    if n_series * n_time != total:
+        raise ValueError(
+            f"mesh {n_series}x{n_time} != {total} devices")
+    return Mesh(devs.reshape(n_series, n_time), ("series", "time"))
+
+
+def series_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("series"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return int(math.ceil(n / k) * k) if n else k

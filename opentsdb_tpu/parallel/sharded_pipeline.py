@@ -1,0 +1,422 @@
+"""Multi-chip query pipeline: shard_map over a ('series', 'time') mesh.
+
+The distributed design (SURVEY.md §2.11, §5.8):
+
+- **series axis** — the salt axis. Each device owns a hash-bucket of
+  series (exactly the reference's SaltScanner partitioning,
+  RowKey.java:141) and bucketizes/rates/fills them locally. Group-by
+  aggregation crosses the axis with ``psum``/``pmin``/``pmax`` over ICI
+  — replacing the TreeMap merge of 20 scanner callbacks
+  (SaltScanner.java:463-536). Order-statistic aggregators (median/
+  percentiles/first/last/diff/multiply) ``all_gather`` the filled grid
+  instead, paying ICI bandwidth only when the math truly needs global
+  order.
+- **time axis** — long ranges split into bucket blocks (the analogue of
+  sequence/context parallelism). Rate conversion and LERP interpolation
+  need the nearest present value *across* block boundaries; these carries
+  propagate with a log-step ppermute prefix scan (Hillis-Steele over the
+  'time' axis), the TSDB version of ring-attention halo exchange.
+
+The kernels reuse the single-chip segment primitives unchanged — only
+the cross-device combines live here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.ops.aggregators import Interpolation
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.ops.interp import _next_valid_idx, _prev_valid_idx
+from opentsdb_tpu.ops.pipeline import PipelineSpec
+
+# aggregators whose group reduction crosses the series axis with
+# psum/pmin/pmax partials (everything else all_gathers)
+_REDUCIBLE = frozenset((
+    "sum", "zimsum", "pfsum", "avg", "count", "min", "max", "mimmin",
+    "mimmax", "squareSum", "dev"))
+
+
+# ---------------------------------------------------------------------------
+# cross-block carries (time axis)
+# ---------------------------------------------------------------------------
+
+def _scan_boundary(val, ts, present, axis_name: str, n_shards: int,
+                   reverse: bool):
+    """Exclusive 'nearest-present' scan across mesh axis ``axis_name``.
+
+    Every shard contributes its own boundary candidate (val, ts, present)
+    — the last present cell per series for a forward scan, first for a
+    reverse scan — and receives the nearest present candidate among all
+    shards strictly before (after, if reverse) it. log2(n) ppermute
+    rounds (Hillis-Steele).
+    """
+    if n_shards == 1:
+        absent = jnp.zeros_like(present)
+        return jnp.zeros_like(val), jnp.zeros_like(ts), absent
+
+    def shift(x, d):
+        if reverse:
+            perm = [(i, i - d) for i in range(d, n_shards)]
+        else:
+            perm = [(i, i + d) for i in range(n_shards - d)]
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    v, t, p = val, ts, present
+    d = 1
+    while d < n_shards:
+        vin, tin, pin = shift(v, d), shift(t, d), shift(p, d)
+        # keep own (nearer) when present, else take incoming (farther)
+        v = jnp.where(p, v, vin)
+        t = jnp.where(p, t, tin)
+        p = p | pin
+        d *= 2
+    # shift by one to make the scan exclusive
+    return shift(v, 1), shift(t, 1), shift(p, 1)
+
+
+def _block_boundaries(grid, bucket_ts):
+    """Per-series (last_val, last_ts, present) and (first_val, first_ts,
+    present) of this time block."""
+    mask = ~jnp.isnan(grid)
+    nb = grid.shape[-1]
+    prev_idx = _prev_valid_idx(mask)[:, -1]          # last present idx
+    next_idx = _next_valid_idx(mask)[:, 0]           # first present idx
+    has_last = prev_idx >= 0
+    has_first = next_idx < nb
+    lp = jnp.clip(prev_idx, 0, nb - 1)
+    fp = jnp.clip(next_idx, 0, nb - 1)
+    rows = jnp.arange(grid.shape[0])
+    ts = bucket_ts.astype(grid.dtype)
+    return ((grid[rows, lp], ts[lp], has_last),
+            (grid[rows, fp], ts[fp], has_first))
+
+
+def _fill_with_boundaries(grid, bucket_ts, mode: str,
+                          prev_v, prev_t, prev_p,
+                          next_v, next_t, next_p):
+    """fill_gaps with per-series cross-block boundary carries."""
+    mask = ~jnp.isnan(grid)
+    if mode == Interpolation.ZIM.value:
+        return jnp.where(mask, grid, 0.0)
+    nb = grid.shape[-1]
+    ts = bucket_ts.astype(grid.dtype)
+    pidx = _prev_valid_idx(mask)
+    has_lp = pidx >= 0
+    sp = jnp.clip(pidx, 0, nb - 1)
+    v0_local = jnp.take_along_axis(grid, sp, axis=-1)
+    t0_local = ts[sp]
+    v0 = jnp.where(has_lp, v0_local, prev_v[:, None])
+    t0 = jnp.where(has_lp, t0_local, prev_t[:, None])
+    has0 = has_lp | prev_p[:, None]
+    if mode == Interpolation.PREV.value:
+        return jnp.where(mask, grid, jnp.where(has0, v0, jnp.nan))
+    nidx = _next_valid_idx(mask)
+    has_ln = nidx < nb
+    sn = jnp.clip(nidx, 0, nb - 1)
+    v1_local = jnp.take_along_axis(grid, sn, axis=-1)
+    t1_local = ts[sn]
+    v1 = jnp.where(has_ln, v1_local, next_v[:, None])
+    t1 = jnp.where(has_ln, t1_local, next_t[:, None])
+    has1 = has_ln | next_p[:, None]
+    in_range = has0 & has1
+    if mode in (Interpolation.MAX.value, Interpolation.MIN.value):
+        extreme = jnp.inf if mode == Interpolation.MAX.value else -jnp.inf
+        return jnp.where(mask, grid, jnp.where(in_range, extreme, jnp.nan))
+    if mode != Interpolation.LERP.value:
+        raise ValueError(f"unknown interpolation mode {mode!r}")
+    t = ts[None, :]
+    dt = jnp.where(t1 > t0, t1 - t0, 1.0)
+    lerped = v0 + (v1 - v0) * (t - t0) / dt
+    return jnp.where(mask, grid, jnp.where(in_range, lerped, jnp.nan))
+
+
+def _rate_with_boundary(grid, bucket_ts, counter: bool, counter_max,
+                        reset_value, drop_resets: bool,
+                        carry_v, carry_t, carry_p):
+    """Rate kernel with the previous block's last-present carry."""
+    mask = ~jnp.isnan(grid)
+    nb = grid.shape[-1]
+    prev_at = _prev_valid_idx(mask)
+    shifted = jnp.concatenate(
+        [jnp.full(prev_at.shape[:-1] + (1,), -1, prev_at.dtype),
+         prev_at[..., :-1]], axis=-1)
+    has_local = shifted >= 0
+    sp = jnp.clip(shifted, 0, nb - 1)
+    ts = bucket_ts.astype(grid.dtype)
+    v_prev = jnp.where(has_local, jnp.take_along_axis(grid, sp, axis=-1),
+                       carry_v[:, None])
+    t_prev = jnp.where(has_local, ts[sp], carry_t[:, None])
+    has_prev = has_local | carry_p[:, None]
+    dt_sec = (ts[None, :] - t_prev) / 1000.0
+    dt_sec = jnp.where(dt_sec > 0, dt_sec, 1.0)
+    delta = grid - v_prev
+    rate = delta / dt_sec
+    if counter:
+        rolled = delta < 0
+        corrected = (counter_max - v_prev + grid) / dt_sec
+        rate = jnp.where(rolled, corrected, rate)
+        if drop_resets:
+            rate = jnp.where(rolled, jnp.nan, rate)
+        rate = jnp.where((reset_value > 0) & (rate > reset_value), 0.0,
+                         rate)
+    return jnp.where(mask & has_prev, rate, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# cross-shard group reduction (series axis)
+# ---------------------------------------------------------------------------
+
+def _group_reduce_psum(filled, group_ids, num_groups: int, agg_name: str,
+                       axis_name: str):
+    """Partial segment reduction per shard + collective combine."""
+    valid = ~jnp.isnan(filled)
+    x0 = jnp.where(valid, filled, 0.0)
+    seg = partial(jax.ops.segment_sum, num_segments=num_groups)
+    cnt = jax.lax.psum(seg(valid.astype(filled.dtype), group_ids),
+                       axis_name)
+    if agg_name in ("sum", "zimsum", "pfsum"):
+        out = jax.lax.psum(seg(x0, group_ids), axis_name)
+    elif agg_name == "avg":
+        out = jax.lax.psum(seg(x0, group_ids), axis_name) \
+            / jnp.maximum(cnt, 1)
+    elif agg_name == "count":
+        out = cnt
+    elif agg_name in ("min", "mimmin"):
+        part = jax.ops.segment_min(jnp.where(valid, filled, jnp.inf),
+                                   group_ids, num_segments=num_groups)
+        out = jax.lax.pmin(part, axis_name)
+        out = jnp.where(jnp.isinf(out) & (out > 0), jnp.nan, out)
+    elif agg_name in ("max", "mimmax"):
+        part = jax.ops.segment_max(jnp.where(valid, filled, -jnp.inf),
+                                   group_ids, num_segments=num_groups)
+        out = jax.lax.pmax(part, axis_name)
+        out = jnp.where(jnp.isinf(out) & (out < 0), jnp.nan, out)
+    elif agg_name == "squareSum":
+        out = jax.lax.psum(seg(x0 * x0, group_ids), axis_name)
+    elif agg_name == "dev":
+        s1 = jax.lax.psum(seg(x0, group_ids), axis_name)
+        s2 = jax.lax.psum(seg(x0 * x0, group_ids), axis_name)
+        mean = s1 / jnp.maximum(cnt, 1)
+        var = jnp.maximum(s2 / jnp.maximum(cnt, 1) - mean * mean, 0.0) \
+            * (jnp.maximum(cnt, 1) / jnp.maximum(cnt - 1, 1))
+        out = jnp.where(cnt == 1, 0.0, jnp.sqrt(var))
+    else:
+        raise ValueError(f"{agg_name} is not psum-reducible")
+    return jnp.where(cnt > 0, out, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# the sharded step
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ShardedBatch:
+    """Host-prepared, device-ready inputs for the sharded pipeline.
+
+    Shapes (Ds = series shards, Dt = time shards):
+    - values/series_idx/bucket_idx: [Ds, Dt, Npad] — per-shard point
+      lists, padded with bucket_idx == B_loc (a dummy bucket slot)
+    - bucket_ts: [B_pad] (split over 'time')
+    - group_ids: [Ds * S_loc] (split over 'series'), dummy group == G
+    """
+    values: np.ndarray
+    series_idx: np.ndarray
+    bucket_idx: np.ndarray
+    bucket_ts: np.ndarray
+    group_ids: np.ndarray
+    s_loc: int
+    b_loc: int
+    num_groups: int  # real groups (dummy excluded)
+
+
+def build_sharded_step(mesh: Mesh, spec: PipelineSpec, s_loc: int,
+                       b_loc: int):
+    """Compile the multi-chip query step for the given mesh and shapes.
+
+    Returns a jitted fn(values, series_idx, bucket_idx, bucket_ts,
+    group_ids, rate_params, fill_value) -> (result[G+1, B_pad],
+    emit[G+1, B_pad]) with result sharded over 'time'.
+    """
+    n_series_shards, n_time_shards = (mesh.shape["series"],
+                                      mesh.shape["time"])
+    agg = aggs_mod.get(spec.agg_name)
+    interp_mode = agg.interpolation.value
+    g_padded = spec.num_groups + 1  # trailing dummy group for padding
+
+    def step(values, series_idx, bucket_idx, bucket_ts, group_ids,
+             rate_params, fill_value):
+        # local blocks: [1, 1, Npad] / [B_loc] / [S_loc]
+        vals = values.reshape(-1)
+        sidx = series_idx.reshape(-1)
+        bidx = bucket_idx.reshape(-1)
+        bts = bucket_ts
+        gids = group_ids
+
+        # 1. local bucketize into [S_loc, B_loc + 1] (last = padding)
+        grid, cnt = ds_mod.bucketize(vals, sidx, bidx, s_loc, b_loc + 1,
+                                     spec.ds_function)
+        grid = grid[:, :b_loc]
+        cnt = cnt[:, :b_loc]
+        has_data = cnt > 0
+
+        if spec.fill_policy == ds_mod.FillPolicy.ZERO:
+            grid = jnp.where(jnp.isnan(grid), 0.0, grid)
+            has_data = jnp.ones_like(has_data)
+        elif spec.fill_policy == ds_mod.FillPolicy.SCALAR:
+            grid = jnp.where(jnp.isnan(grid), fill_value, grid)
+            has_data = jnp.ones_like(has_data)
+
+        # 2. rate with cross-block carry over the 'time' axis
+        if spec.rate:
+            (lv, lt, lp), _ = _block_boundaries(grid, bts)
+            cv, ct, cp = _scan_boundary(lv, lt, lp, "time",
+                                        n_time_shards, reverse=False)
+            counter_max, reset_value = rate_params
+            grid = _rate_with_boundary(
+                grid, bts, spec.rate_counter, counter_max, reset_value,
+                spec.rate_drop_resets, cv, ct, cp)
+            has_data = has_data & ~jnp.isnan(grid)
+
+        if spec.emit_raw:
+            return grid, has_data
+
+        # 3. interpolation fill with halo carries both directions
+        (lv, lt, lp), (fv, ft, fp) = _block_boundaries(grid, bts)
+        pv, pt, pp = _scan_boundary(lv, lt, lp, "time", n_time_shards,
+                                    reverse=False)
+        nv, nt, npp = _scan_boundary(fv, ft, fp, "time", n_time_shards,
+                                     reverse=True)
+        filled = _fill_with_boundaries(grid, bts, interp_mode,
+                                       pv, pt, pp, nv, nt, npp)
+
+        # 4. group aggregation across the 'series' axis
+        if spec.agg_name in _REDUCIBLE:
+            result = _group_reduce_psum(filled, gids, g_padded,
+                                        spec.agg_name, "series")
+        else:
+            full = jax.lax.all_gather(filled, "series", axis=0,
+                                      tiled=True)
+            gids_full = jax.lax.all_gather(gids, "series", axis=0,
+                                           tiled=True)
+            from opentsdb_tpu.ops.groupby import _group_reduce
+            result = _group_reduce(full, gids_full, g_padded,
+                                   spec.agg_name)
+
+        if spec.fill_policy == ds_mod.FillPolicy.NONE:
+            # segment_sum: empty segments give 0 (segment_max gives INT_MIN
+            # which breaks the cross-shard psum)
+            emit = jax.lax.psum(
+                jax.ops.segment_sum(has_data.astype(jnp.int32), gids,
+                                    num_segments=g_padded), "series") > 0
+        else:
+            emit = jnp.ones((g_padded, b_loc), dtype=bool)
+        return result, emit
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P("series", "time", None), P("series", "time", None),
+                  P("series", "time", None), P("time"), P("series"),
+                  P(), P()),
+        out_specs=(P(None, "time"), P(None, "time"))
+        if not spec.emit_raw else (P("series", "time"),
+                                   P("series", "time")),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+# ---------------------------------------------------------------------------
+# host-side sharding prep
+# ---------------------------------------------------------------------------
+
+def prepare_sharded_batch(values: np.ndarray, series_idx: np.ndarray,
+                          bucket_idx: np.ndarray, bucket_ts: np.ndarray,
+                          group_ids: np.ndarray, num_series: int,
+                          num_groups: int, n_series_shards: int,
+                          n_time_shards: int) -> ShardedBatch:
+    """Partition a flat point batch onto the mesh.
+
+    Series land on series-shards round-robin by index (the engine already
+    hashes series onto store shards; here the dense indices spread
+    evenly). Buckets split into contiguous time blocks. Point lists are
+    padded per (Ds, Dt) cell to the max cell population.
+    """
+    s_loc = -(-num_series // n_series_shards)
+    b = len(bucket_ts)
+    b_loc = -(-b // n_time_shards)
+    b_pad = b_loc * n_time_shards
+
+    # pad bucket_ts monotonically so halo timestamps stay ordered
+    if b_pad > b:
+        step = int(bucket_ts[-1] - bucket_ts[-2]) if b > 1 else 1000
+        extra = bucket_ts[-1] + step * np.arange(1, b_pad - b + 1)
+        bucket_ts = np.concatenate([bucket_ts, extra])
+
+    series_shard = series_idx % n_series_shards
+    local_series = series_idx // n_series_shards
+    time_shard = bucket_idx // b_loc
+    local_bucket = bucket_idx % b_loc
+
+    # per-cell padding
+    cell_id = series_shard.astype(np.int64) * n_time_shards + time_shard
+    order = np.argsort(cell_id, kind="stable")
+    counts = np.bincount(cell_id, minlength=n_series_shards * n_time_shards)
+    npad = max(int(counts.max()), 1) if len(cell_id) else 1
+    ds, dt = n_series_shards, n_time_shards
+    pvals = np.zeros((ds, dt, npad), dtype=values.dtype)
+    psidx = np.zeros((ds, dt, npad), dtype=np.int32)
+    pbidx = np.full((ds, dt, npad), b_loc, dtype=np.int32)  # dummy bucket
+    pos = 0
+    for cell in range(ds * dt):
+        c = counts[cell]
+        if c == 0:
+            continue
+        sel = order[pos:pos + c]
+        i, j = divmod(cell, dt)
+        pvals[i, j, :c] = values[sel]
+        psidx[i, j, :c] = local_series[sel]
+        pbidx[i, j, :c] = local_bucket[sel]
+        pos += c
+
+    # group ids: [Ds * S_loc] in shard-major order; padding -> dummy G
+    gids = np.full(ds * s_loc, num_groups, dtype=np.int32)
+    for sid in range(num_series):
+        shard, loc = sid % ds, sid // ds
+        gids[shard * s_loc + loc] = group_ids[sid]
+
+    return ShardedBatch(pvals, psidx, pbidx,
+                        bucket_ts.astype(np.int64), gids, s_loc, b_loc,
+                        num_groups)
+
+
+def run_sharded(mesh: Mesh, spec: PipelineSpec, batch: ShardedBatch,
+                rate_options=None, dtype=None):
+    """Execute the sharded step; returns host (result[G,B], emit[G,B])
+    trimmed of padding."""
+    from opentsdb_tpu.ops.rate import RateOptions
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
+            else jnp.float32
+    ro = rate_options or RateOptions()
+    step = build_sharded_step(mesh, spec, batch.s_loc, batch.b_loc)
+    rate_params = (jnp.asarray(ro.counter_max, dtype),
+                   jnp.asarray(ro.reset_value, dtype))
+    result, emit = step(jnp.asarray(batch.values, dtype),
+                        jnp.asarray(batch.series_idx),
+                        jnp.asarray(batch.bucket_idx),
+                        jnp.asarray(batch.bucket_ts),
+                        jnp.asarray(batch.group_ids),
+                        rate_params,
+                        jnp.asarray(spec.fill_value, dtype))
+    result = np.asarray(result)
+    emit = np.asarray(emit)
+    b = spec.num_buckets
+    return result[:batch.num_groups, :b], emit[:batch.num_groups, :b]
