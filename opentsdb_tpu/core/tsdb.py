@@ -24,6 +24,24 @@ class TSDB:
 
     def __init__(self, config: Config | None = None):
         self.config = config or Config()
+        # Force the JAX platform when configured (tsd.tpu.platform =
+        # cpu|tpu|axon|""). Needed because site customizations may pin
+        # JAX_PLATFORMS before our process can set env vars.
+        platform = self.config.get_string("tsd.tpu.platform", "")
+        if platform:
+            import jax
+            jax.config.update("jax_platforms", platform)
+            # config.update alone is ignored once backends are
+            # initialized — drop them so the override actually takes
+            try:
+                import jax.extend.backend
+                if jax.extend.backend.backends():
+                    jax.extend.backend.clear_backends()
+            except Exception:  # noqa: BLE001
+                import logging
+                logging.getLogger(__name__).warning(
+                    "could not reset JAX backends; tsd.tpu.platform=%s "
+                    "may not take effect", platform)
         const.set_salt_width(self.config.get_int("tsd.storage.salt.width", 0))
         const.set_salt_buckets(
             self.config.get_int("tsd.storage.salt.buckets", 20))

@@ -18,11 +18,9 @@ spanning hosts needs no code changes (pjit/shard_map are SPMD-global).
 
 from __future__ import annotations
 
-import math
-
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def make_mesh(n_series: int | None = None, n_time: int = 1,
@@ -36,15 +34,3 @@ def make_mesh(n_series: int | None = None, n_time: int = 1,
         raise ValueError(
             f"mesh {n_series}x{n_time} != {total} devices")
     return Mesh(devs.reshape(n_series, n_time), ("series", "time"))
-
-
-def series_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P("series"))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def pad_to_multiple(n: int, k: int) -> int:
-    return int(math.ceil(n / k) * k) if n else k

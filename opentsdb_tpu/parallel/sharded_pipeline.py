@@ -344,10 +344,13 @@ def prepare_sharded_batch(values: np.ndarray, series_idx: np.ndarray,
                           n_time_shards: int) -> ShardedBatch:
     """Partition a flat point batch onto the mesh.
 
-    Series land on series-shards round-robin by index (the engine already
-    hashes series onto store shards; here the dense indices spread
-    evenly). Buckets split into contiguous time blocks. Point lists are
-    padded per (Ds, Dt) cell to the max cell population.
+    Series land on series-shards in contiguous *blocks* (shard =
+    series_idx // s_loc): after an all_gather over the series axis the
+    rows come back in natural series order, which the order-sensitive
+    aggregators (first/last/diff pick the lowest/highest series index,
+    matching the reference's span order) depend on. Buckets split into
+    contiguous time blocks. Point lists are padded per (Ds, Dt) cell to
+    the max cell population.
     """
     s_loc = -(-num_series // n_series_shards)
     b = len(bucket_ts)
@@ -360,8 +363,8 @@ def prepare_sharded_batch(values: np.ndarray, series_idx: np.ndarray,
         extra = bucket_ts[-1] + step * np.arange(1, b_pad - b + 1)
         bucket_ts = np.concatenate([bucket_ts, extra])
 
-    series_shard = series_idx % n_series_shards
-    local_series = series_idx // n_series_shards
+    series_shard = series_idx // s_loc
+    local_series = series_idx % s_loc
     time_shard = bucket_idx // b_loc
     local_bucket = bucket_idx % b_loc
 
@@ -386,11 +389,10 @@ def prepare_sharded_batch(values: np.ndarray, series_idx: np.ndarray,
         pbidx[i, j, :c] = local_bucket[sel]
         pos += c
 
-    # group ids: [Ds * S_loc] in shard-major order; padding -> dummy G
+    # group ids: [Ds * S_loc]; block layout keeps natural series order
+    # (row shard*s_loc+loc == global sid); padding -> dummy group G
     gids = np.full(ds * s_loc, num_groups, dtype=np.int32)
-    for sid in range(num_series):
-        shard, loc = sid % ds, sid // ds
-        gids[shard * s_loc + loc] = group_ids[sid]
+    gids[:num_series] = group_ids
 
     return ShardedBatch(pvals, psidx, pbidx,
                         bucket_ts.astype(np.int64), gids, s_loc, b_loc,

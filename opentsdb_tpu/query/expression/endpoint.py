@@ -1,0 +1,203 @@
+"""The ``/api/query/exp`` and ``/api/query/gexp`` endpoints.
+
+(ref: ``src/tsd/QueryExecutor.java:85`` — topo-sorted ExpressionIterator
+DAG; ``QueryRpc.java:113`` gexp routing; the POJO request model
+``src/query/pojo/Query.java:33``)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.query.expression.core import (GEXP_FUNCTIONS,
+                                                SeriesFrame,
+                                                evaluate_expression)
+from opentsdb_tpu.query.model import (BadRequestError, TSQuery, TSSubQuery,
+                                      parse_uri_subquery)
+
+
+# ---------------------------------------------------------------------------
+# /api/query/gexp  (ref: QueryRpc gexp handling)
+# ---------------------------------------------------------------------------
+
+def handle_gexp(router, request):
+    from opentsdb_tpu.tsd.http_api import HttpResponse
+    exprs = request.params.get("exp", [])
+    if not exprs:
+        raise BadRequestError("Missing parameter exp")
+    start = request.param("start")
+    if not start:
+        raise BadRequestError("Missing start time")
+    end = request.param("end")
+
+    all_results = []
+    for i, expr in enumerate(exprs):
+        frame = _eval_gexp(router.tsdb, expr, start, end)
+        results = frame.to_results(sub_query_index=i)
+        all_results.extend(results)
+    tsq = TSQuery(start=start, end=end, queries=[])
+    tsq.start_ms, tsq.end_ms = 0, 1  # already applied per sub-eval
+    tsq.ms_resolution = request.flag("ms")
+    body = router.serializer.format_query(tsq, all_results)
+    return HttpResponse(200, body)
+
+
+def _eval_gexp(tsdb, expr: str, start: str, end: str | None
+               ) -> SeriesFrame:
+    """Recursively evaluate a gexp: ``func(args...)`` over m-type
+    sub-query leaves."""
+    expr = expr.strip()
+    m = re.match(r"^(\w+)\((.*)\)$", expr, re.DOTALL)
+    if m and m.group(1) in GEXP_FUNCTIONS:
+        fname = m.group(1)
+        args = _split_args(m.group(2))
+        fn = GEXP_FUNCTIONS[fname]
+        evaluated = []
+        for arg in args:
+            arg = arg.strip()
+            if re.fullmatch(r"-?\d+(\.\d+)?", arg):
+                evaluated.append(float(arg))
+            elif re.fullmatch(r"'[^']*'|\"[^\"]*\"", arg):
+                evaluated.append(arg[1:-1])
+            elif re.fullmatch(r"\d+[smhdwny]", arg):
+                evaluated.append(arg)
+            else:
+                evaluated.append(_eval_gexp(tsdb, arg, start, end))
+        return fn(*evaluated)
+    # leaf: an m-type sub-query
+    sub = parse_uri_subquery(expr)
+    tsq = TSQuery(start=start, end=end, queries=[sub])
+    tsq.validate()
+    results = tsdb.new_query().run(tsq)
+    return SeriesFrame.from_results(results)
+
+
+def _split_args(body: str) -> list[str]:
+    """Split on commas not inside parens/braces."""
+    args, depth, cur = [], 0, []
+    for c in body:
+        if c in "({[":
+            depth += 1
+        elif c in ")}]":
+            depth -= 1
+        if c == "," and depth == 0:
+            args.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    if cur or not args:
+        args.append("".join(cur))
+    return args
+
+
+# ---------------------------------------------------------------------------
+# /api/query/exp  (ref: QueryExecutor.java:222 + pojo model)
+# ---------------------------------------------------------------------------
+
+def handle_exp(router, request):
+    from opentsdb_tpu.tsd.http_api import HttpResponse
+    if request.method != "POST":
+        raise BadRequestError("/api/query/exp requires POST")
+    obj = json.loads(request.body or b"{}")
+    tsdb = router.tsdb
+
+    time_spec = obj.get("time") or {}
+    start = str(time_spec.get("start", ""))
+    end = time_spec.get("end")
+    aggregator = time_spec.get("aggregator", "sum")
+    downsampler = time_spec.get("downsampler")
+    ds_spec = None
+    if downsampler:
+        ds_spec = (f"{downsampler.get('interval')}-"
+                   f"{downsampler.get('aggregator', 'avg')}")
+        fp = (downsampler.get("fillPolicy") or {}).get("policy")
+        if fp:
+            ds_spec += f"-{fp}"
+
+    # named filter sets (ref: pojo/Filter.java)
+    filter_sets: dict[str, list] = {}
+    for f in obj.get("filters") or []:
+        filter_sets[f.get("id", "")] = [
+            filters_mod.build_filter(t) for t in f.get("tags", [])]
+
+    # metrics: id -> sub-query (ref: pojo/Metric.java)
+    variables: dict[str, SeriesFrame] = {}
+    metric_meta: dict[str, dict] = {}
+    for mspec in obj.get("metrics") or []:
+        mid = mspec.get("id")
+        if not mid:
+            raise BadRequestError("metric missing id")
+        sub = TSSubQuery(
+            aggregator=mspec.get("aggregator") or aggregator,
+            metric=mspec.get("metric"),
+            downsample=mspec.get("downsampler") or ds_spec,
+            filters=list(filter_sets.get(mspec.get("filter", ""), [])))
+        tsq = TSQuery(start=start, end=end, queries=[sub])
+        tsq.validate()
+        results = tsdb.new_query().run(tsq)
+        variables[mid] = SeriesFrame.from_results(results)
+        metric_meta[mid] = mspec
+
+    # expressions DAG: evaluate in dependency order
+    # (ref: QueryExecutor jgrapht topo sort :31-35)
+    exprs = {e.get("id"): e for e in obj.get("expressions") or []}
+    resolved: dict[str, SeriesFrame] = {}
+
+    def resolve(eid: str, seen: tuple = ()):
+        if eid in resolved:
+            return resolved[eid]
+        if eid in seen:
+            raise BadRequestError(f"circular expression reference: {eid}")
+        spec = exprs[eid]
+        scope = dict(variables)
+        for dep in exprs:
+            if dep != eid and dep in spec.get("expr", ""):
+                scope[dep] = resolve(dep, seen + (eid,))
+        frame = evaluate_expression(spec.get("expr", ""), scope)
+        resolved[eid] = frame
+        return frame
+
+    outputs = obj.get("outputs") or [{"id": eid} for eid in exprs]
+    out_results = []
+    for i, ospec in enumerate(outputs):
+        oid = ospec.get("id")
+        if oid in exprs:
+            frame = resolve(oid)
+        elif oid in variables:
+            frame = variables[oid]
+        else:
+            raise BadRequestError(f"unknown output id {oid!r}")
+        dps_rows = []
+        for t_idx, ts in enumerate(frame.ts):
+            row = [int(ts)]
+            row.extend(
+                None if (v != v) else (int(v) if float(v).is_integer()
+                                       else float(v))
+                for v in frame.values[:, t_idx])
+            dps_rows.append(row)
+        out_results.append({
+            "id": oid,
+            "alias": ospec.get("alias"),
+            "dps": dps_rows,
+            "dpsMeta": {
+                "firstTimestamp": int(frame.ts[0]) if len(frame.ts)
+                else 0,
+                "lastTimestamp": int(frame.ts[-1]) if len(frame.ts)
+                else 0,
+                "setCount": frame.num_series,
+                "series": frame.num_series,
+            },
+            "meta": [{"index": 0, "metrics": ["timestamp"]}] + [
+                {"index": s + 1,
+                 "metrics": [frame.metric],
+                 "commonTags": frame.tags[s]
+                 if s < len(frame.tags) else {},
+                 "aggregatedTags": (frame.agg_tags[s]
+                                    if s < len(frame.agg_tags) else [])}
+                for s in range(frame.num_series)],
+        })
+    body = json.dumps({"outputs": out_results, "query": obj},
+                      separators=(",", ":")).encode()
+    return HttpResponse(200, body)

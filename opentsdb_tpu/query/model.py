@@ -47,7 +47,7 @@ class TSSubQuery:
         try:
             self.agg = aggs_mod.get(self.aggregator)
         except KeyError as e:
-            raise BadRequestError(str(e)) from None
+            raise BadRequestError(e.args[0]) from None
         if not self.metric and not self.tsuids:
             raise BadRequestError(
                 "Missing the metric or tsuids, provide at least one")

@@ -1,0 +1,153 @@
+"""Time-series lookup + last-datapoint queries.
+
+(ref: ``src/search/TimeSeriesLookup.java:83`` — scan-based tsdb-meta
+lookup behind ``/api/search/lookup``; ``src/meta/TSUIDQuery.java:51`` —
+``getLastPoint``/``getLastWriteTimes`` behind ``/api/query/last``)
+
+Here the store's per-metric tag index makes both direct dictionary
+walks: no scans needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from opentsdb_tpu.core import tags as tags_mod
+
+
+def time_series_lookup(tsdb, metric: str, tags: list[tuple[str, str]],
+                       limit: int = 25, use_meta: bool = False
+                       ) -> dict[str, Any]:
+    """(ref: TimeSeriesLookup.lookup)"""
+    uids = tsdb.uids
+    results = []
+    metric_ids = []
+    if metric and metric != "*":
+        try:
+            metric_ids = [uids.metrics.get_id(metric)]
+        except LookupError:
+            metric_ids = []
+    else:
+        metric_ids = tsdb.store.metric_ids()
+    # resolve tag constraints
+    want: list[tuple[int | None, int | None]] = []
+    for k, v in tags:
+        try:
+            kid = uids.tag_names.get_id(k) if k and k != "*" else None
+            vid = uids.tag_values.get_id(v) if v and v != "*" else None
+        except LookupError:
+            return {"type": "LOOKUP", "metric": metric or "*",
+                    "limit": limit, "time": 0, "results": [],
+                    "totalResults": 0}
+        want.append((kid, vid))
+    total = 0
+    for mid in metric_ids:
+        for sid in tsdb.store.series_ids_for_metric(mid):
+            rec = tsdb.store.series(int(sid))
+            tag_map = dict(rec.tags)
+            ok = True
+            for kid, vid in want:
+                if kid is not None and vid is not None:
+                    if tag_map.get(kid) != vid:
+                        ok = False
+                        break
+                elif kid is not None:
+                    if kid not in tag_map:
+                        ok = False
+                        break
+                elif vid is not None:
+                    if vid not in tag_map.values():
+                        ok = False
+                        break
+            if not ok:
+                continue
+            total += 1
+            if len(results) < limit:
+                results.append({
+                    "tsuid": uids.tsuid(rec.metric_id,
+                                        rec.tags).hex().upper(),
+                    "metric": uids.metrics.get_name(rec.metric_id),
+                    "tags": {uids.tag_names.get_name(k):
+                             uids.tag_values.get_name(v)
+                             for k, v in rec.tags},
+                })
+    return {"type": "LOOKUP", "metric": metric or "*", "limit": limit,
+            "time": 0, "results": results, "totalResults": total}
+
+
+def last_data_points(tsdb, specs: list[dict], back_scan: int = 0,
+                     resolve: bool = True) -> list[dict]:
+    """(ref: TSUIDQuery.getLastPoint :161)"""
+    uids = tsdb.uids
+    out = []
+    for spec in specs:
+        sids = []
+        metric = ""
+        if spec.get("tsuids"):
+            for tsuid in spec["tsuids"]:
+                sid, metric = _sid_from_tsuid(tsdb, tsuid)
+                if sid is not None:
+                    sids.append(sid)
+        else:
+            m = spec.get("metric") or spec.get("uri") or ""
+            metric, tag_map = tags_mod.parse_with_metric(m)
+            try:
+                mid = uids.metrics.get_id(metric)
+            except LookupError:
+                continue
+            want = {}
+            skip = False
+            for k, v in tag_map.items():
+                try:
+                    want[uids.tag_names.get_id(k)] = \
+                        uids.tag_values.get_id(v)
+                except LookupError:
+                    skip = True
+                    break
+            if skip:
+                continue
+            for sid in tsdb.store.series_ids_for_metric(mid):
+                rec = tsdb.store.series(int(sid))
+                tag_map2 = dict(rec.tags)
+                if all(tag_map2.get(k) == v for k, v in want.items()):
+                    sids.append(int(sid))
+        for sid in sids:
+            rec = tsdb.store.series(sid)
+            ts, vals = rec.buffer.view()
+            if len(ts) == 0:
+                continue
+            v = float(vals[-1])
+            point: dict[str, Any] = {
+                "timestamp": int(ts[-1]),
+                "value": str(int(v)) if v.is_integer() else str(v),
+                "tsuid": uids.tsuid(rec.metric_id,
+                                    rec.tags).hex().upper(),
+            }
+            if resolve:
+                point["metric"] = uids.metrics.get_name(rec.metric_id)
+                point["tags"] = {uids.tag_names.get_name(k):
+                                 uids.tag_values.get_name(v2)
+                                 for k, v2 in rec.tags}
+            out.append(point)
+    return out
+
+
+def _sid_from_tsuid(tsdb, tsuid: str):
+    uids = tsdb.uids
+    raw = bytes.fromhex(tsuid)
+    mw, kw, vw = (uids.metrics.width, uids.tag_names.width,
+                  uids.tag_values.width)
+    mid = int.from_bytes(raw[:mw], "big")
+    tags = []
+    pos = mw
+    while pos < len(raw):
+        tags.append((int.from_bytes(raw[pos:pos + kw], "big"),
+                     int.from_bytes(raw[pos + kw:pos + kw + vw], "big")))
+        pos += kw + vw
+    key = (mid, tuple(sorted(tags)))
+    sid = tsdb.store._key_to_sid.get(key)
+    try:
+        metric = uids.metrics.get_name(mid)
+    except LookupError:
+        metric = ""
+    return sid, metric

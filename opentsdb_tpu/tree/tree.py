@@ -1,0 +1,387 @@
+"""Hierarchical browse trees (ref: ``src/tree/``).
+
+``Tree`` (Tree.java:73) + ``TreeRule`` (TreeRule.java:57) +
+``TreeBuilder`` (TreeBuilder.java:30-59) + ``Branch``/``Leaf``
+(Branch.java:88, Leaf.java:58): a rule pipeline that files every
+timeseries (TSMeta) into a browsable hierarchy. Rules are organized in
+levels; within a level, orders are tried until one produces a branch
+name. METRIC rules split the metric (optionally by separator), TAGK
+rules take a tag's value, *_CUSTOM rules read custom meta fields, and
+regexes extract capture group 1.
+
+Trees rebuild in realtime when ``tsd.core.tree.enable_processing`` is
+on (TSDB.processTSMetaThroughTrees :2033) or in batch via the
+``treesync`` CLI (TreeSync.java).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class TreeRule:
+    """(ref: TreeRule.java:57)"""
+    tree_id: int = 0
+    level: int = 0
+    order: int = 0
+    type: str = "METRIC"  # METRIC|METRIC_CUSTOM|TAGK|TAGK_CUSTOM|TAGV_CUSTOM
+    field: str = ""
+    custom_field: str = ""
+    regex: str = ""
+    separator: str = ""
+    description: str = ""
+    notes: str = ""
+    regex_group_idx: int = 0
+    display_format: str = ""
+
+    VALID_TYPES = ("METRIC", "METRIC_CUSTOM", "TAGK", "TAGK_CUSTOM",
+                   "TAGV_CUSTOM")
+
+    def __post_init__(self):
+        if self.type.upper() not in self.VALID_TYPES:
+            raise ValueError(f"Invalid rule type: {self.type}")
+        self.type = self.type.upper()
+        if self.regex:
+            self._compiled = re.compile(self.regex)
+        else:
+            self._compiled = None
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "TreeRule":
+        return cls(
+            tree_id=int(obj.get("treeId") or obj.get("treeid", 0)),
+            level=int(obj.get("level", 0)),
+            order=int(obj.get("order", 0)),
+            type=(obj.get("type") or "METRIC"),
+            field=obj.get("field", "") or "",
+            custom_field=obj.get("customField", "") or "",
+            regex=obj.get("regex", "") or "",
+            separator=obj.get("separator", "") or "",
+            description=obj.get("description", "") or "",
+            notes=obj.get("notes", "") or "",
+            regex_group_idx=int(obj.get("regexGroupIdx", 0)),
+            display_format=obj.get("displayFormat", "") or "",
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "treeId": self.tree_id, "level": self.level,
+            "order": self.order, "type": self.type, "field": self.field,
+            "customField": self.custom_field, "regex": self.regex,
+            "separator": self.separator, "description": self.description,
+            "notes": self.notes, "regexGroupIdx": self.regex_group_idx,
+            "displayFormat": self.display_format,
+        }
+
+    def extract(self, metric: str, tags: dict[str, str],
+                custom: dict[str, str]) -> list[str] | None:
+        """Branch name(s) this rule produces for a series, or None."""
+        value: str | None = None
+        if self.type == "METRIC":
+            value = metric
+        elif self.type == "TAGK":
+            value = tags.get(self.field)
+        elif self.type in ("METRIC_CUSTOM", "TAGK_CUSTOM",
+                           "TAGV_CUSTOM"):
+            value = custom.get(self.custom_field)
+        if not value:
+            return None
+        if self._compiled is not None:
+            m = self._compiled.search(value)
+            if not m or m.lastindex is None or \
+                    m.lastindex < self.regex_group_idx + 1:
+                return None
+            value = m.group(self.regex_group_idx + 1)
+            if not value:
+                return None
+        if self.separator:
+            parts = [p for p in value.split(self.separator) if p]
+            return parts or None
+        return [value]
+
+
+@dataclass
+class Leaf:
+    """(ref: Leaf.java:58)"""
+    display_name: str
+    tsuid: str
+    metric: str = ""
+    tags: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict[str, Any]:
+        return {"displayName": self.display_name, "tsuid": self.tsuid,
+                "metric": self.metric, "tags": self.tags}
+
+
+class Branch:
+    """(ref: Branch.java:88)"""
+
+    def __init__(self, tree_id: int, path: tuple[str, ...],
+                 display_name: str):
+        self.tree_id = tree_id
+        self.path = path
+        self.display_name = display_name
+        self.branches: dict[str, Branch] = {}
+        self.leaves: dict[str, Leaf] = {}
+
+    @property
+    def branch_id(self) -> str:
+        h = hashlib.md5("/".join(self.path).encode()).hexdigest()[:12]
+        return f"{self.tree_id:04x}{h}"
+
+    @property
+    def depth(self) -> int:
+        return len(self.path)
+
+    def to_json(self, recurse_leaves: bool = True) -> dict[str, Any]:
+        return {
+            "treeId": self.tree_id,
+            "branchId": self.branch_id,
+            "path": {str(i): p for i, p in enumerate(self.path)},
+            "displayName": self.display_name,
+            "depth": self.depth,
+            "branches": [b.to_json(False)
+                         for _, b in sorted(self.branches.items())] or None,
+            "leaves": ([leaf.to_json()
+                        for _, leaf in sorted(self.leaves.items())]
+                       if recurse_leaves else None) or None,
+        }
+
+
+class Tree:
+    """(ref: Tree.java:73)"""
+
+    def __init__(self, tree_id: int, name: str = "",
+                 description: str = ""):
+        self.tree_id = tree_id
+        self.name = name
+        self.description = description
+        self.notes = ""
+        self.strict_match = False
+        self.enabled = True
+        self.store_failures = True
+        self.created = int(time.time())
+        # level -> order -> rule
+        self.rules: dict[int, dict[int, TreeRule]] = {}
+        self.root = Branch(tree_id, (), name or "ROOT")
+        self.collisions: dict[str, str] = {}
+        self.not_matched: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def update(self, obj: dict[str, Any], overwrite: bool) -> None:
+        for attr, key in (("name", "name"), ("description", "description"),
+                          ("notes", "notes")):
+            if key in obj and (overwrite or obj[key]):
+                setattr(self, attr, obj[key])
+        if "strictMatch" in obj:
+            self.strict_match = bool(obj["strictMatch"])
+        if "enabled" in obj:
+            self.enabled = bool(obj["enabled"])
+        if "storeFailures" in obj:
+            self.store_failures = bool(obj["storeFailures"])
+
+    def set_rule(self, rule: TreeRule) -> None:
+        rule.tree_id = self.tree_id
+        with self._lock:
+            self.rules.setdefault(rule.level, {})[rule.order] = rule
+
+    def get_rule(self, level: int, order: int) -> TreeRule | None:
+        return self.rules.get(level, {}).get(order)
+
+    def delete_rule(self, level: int, order: int) -> bool:
+        with self._lock:
+            if self.get_rule(level, order) is None:
+                return False
+            del self.rules[level][order]
+            if not self.rules[level]:
+                del self.rules[level]
+            return True
+
+    def delete_all_rules(self) -> None:
+        with self._lock:
+            self.rules.clear()
+
+    def to_json(self) -> dict[str, Any]:
+        rules = [r.to_json() for level in sorted(self.rules)
+                 for _, r in sorted(self.rules[level].items())]
+        return {
+            "treeId": self.tree_id, "name": self.name,
+            "description": self.description, "notes": self.notes,
+            "strictMatch": self.strict_match, "enabled": self.enabled,
+            "storeFailures": self.store_failures,
+            "created": self.created, "rules": rules,
+        }
+
+
+class TreeBuilder:
+    """(ref: TreeBuilder.java:30-59) Files one series into a tree."""
+
+    def __init__(self, tree: Tree):
+        self.tree = tree
+
+    def process(self, tsuid: str, metric: str, tags: dict[str, str],
+                custom: dict[str, str] | None = None
+                ) -> list[str] | None:
+        """Returns the branch path, or None when unmatched."""
+        custom = custom or {}
+        path: list[str] = []
+        matched_any = False
+        for level in sorted(self.tree.rules):
+            parts = None
+            for order in sorted(self.tree.rules[level]):
+                rule = self.tree.rules[level][order]
+                parts = rule.extract(metric, tags, custom)
+                if parts:
+                    break
+            if parts:
+                matched_any = True
+                path.extend(parts)
+        if not path:
+            if self.tree.store_failures:
+                self.tree.not_matched[tsuid] = "no rules matched"
+            return None
+        if self.tree.strict_match and not matched_any:
+            return None
+        # build branches
+        node = self.tree.root
+        for i, part in enumerate(path[:-1]):
+            key = part
+            child = node.branches.get(key)
+            if child is None:
+                child = Branch(self.tree.tree_id,
+                               tuple(path[:i + 1]), part)
+                node.branches[key] = child
+            node = child
+        leaf_name = path[-1]
+        existing = node.leaves.get(leaf_name)
+        if existing is not None and existing.tsuid != tsuid:
+            if self.tree.store_failures:
+                self.tree.collisions[tsuid] = existing.tsuid
+            return None
+        node.leaves[leaf_name] = Leaf(leaf_name, tsuid, metric,
+                                      dict(tags))
+        return path
+
+
+class TreeManager:
+    """Registry of trees owned by a TSDB (the tsdb-tree table)."""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        self._lock = threading.Lock()
+        self.trees: dict[int, Tree] = {}
+        self._next_id = 0
+        self.enable_realtime = tsdb.config.get_bool(
+            "tsd.core.tree.enable_processing")
+
+    def create_tree(self, name: str, description: str = "") -> Tree:
+        with self._lock:
+            self._next_id += 1
+            tree = Tree(self._next_id, name, description)
+            self.trees[tree.tree_id] = tree
+            return tree
+
+    def get_tree(self, tree_id: int) -> Tree | None:
+        return self.trees.get(tree_id)
+
+    def all_trees(self) -> list[Tree]:
+        return [self.trees[i] for i in sorted(self.trees)]
+
+    def delete_tree(self, tree_id: int, definition: bool) -> bool:
+        with self._lock:
+            tree = self.trees.get(tree_id)
+            if tree is None:
+                return False
+            if definition:
+                del self.trees[tree_id]
+            else:
+                tree.root = Branch(tree_id, (), tree.name or "ROOT")
+                tree.collisions.clear()
+                tree.not_matched.clear()
+            return True
+
+    def get_branch(self, branch_id: str) -> Branch | None:
+        for tree in self.trees.values():
+            found = self._find_branch(tree.root, branch_id)
+            if found is not None:
+                return found
+        return None
+
+    def get_root_branch(self, tree_id: int) -> Branch | None:
+        tree = self.trees.get(tree_id)
+        return tree.root if tree else None
+
+    def _find_branch(self, node: Branch, branch_id: str
+                     ) -> Branch | None:
+        if node.branch_id == branch_id:
+            return node
+        for child in node.branches.values():
+            found = self._find_branch(child, branch_id)
+            if found is not None:
+                return found
+        return None
+
+    # -- series processing --------------------------------------------
+
+    def process_series(self, tsuid: str, metric: str,
+                       tags: dict[str, str]) -> None:
+        """Realtime hook (ref: TSDB.processTSMetaThroughTrees :2033)."""
+        for tree in self.trees.values():
+            if tree.enabled:
+                TreeBuilder(tree).process(tsuid, metric, tags)
+
+    def sync_all(self) -> int:
+        """Batch rebuild from the data store (ref: TreeSync.java)."""
+        uids = self.tsdb.uids
+        count = 0
+        for mid in self.tsdb.store.metric_ids():
+            metric = uids.metrics.get_name(mid)
+            for sid in self.tsdb.store.series_ids_for_metric(mid):
+                rec = self.tsdb.store.series(int(sid))
+                tags = {uids.tag_names.get_name(k):
+                        uids.tag_values.get_name(v) for k, v in rec.tags}
+                tsuid = uids.tsuid(rec.metric_id, rec.tags).hex().upper()
+                self.process_series(tsuid, metric, tags)
+                count += 1
+        return count
+
+    def test_tsuids(self, tree: Tree, tsuids: list[str]
+                    ) -> dict[str, Any]:
+        """(ref: TreeRpc test endpoint)"""
+        out: dict[str, Any] = {}
+        uids = self.tsdb.uids
+        from opentsdb_tpu.search.lookup import _sid_from_tsuid
+        for tsuid in tsuids:
+            try:
+                sid, metric = _sid_from_tsuid(self.tsdb, tsuid)
+                if sid is None:
+                    out[tsuid] = {"valid": False,
+                                  "error": "unknown timeseries"}
+                    continue
+                rec = self.tsdb.store.series(sid)
+                tags = {uids.tag_names.get_name(k):
+                        uids.tag_values.get_name(v) for k, v in rec.tags}
+                # dry run on a scratch tree copy
+                scratch = Tree(tree.tree_id, tree.name)
+                scratch.rules = tree.rules
+                path = TreeBuilder(scratch).process(tsuid.upper(), metric,
+                                                    tags)
+                out[tsuid] = {"valid": path is not None,
+                              "branch": path or []}
+            except Exception as e:  # noqa: BLE001
+                out[tsuid] = {"valid": False, "error": str(e)}
+        return out
+
+
+def tree_manager(tsdb) -> TreeManager:
+    mgr = getattr(tsdb, "_tree_manager", None)
+    if mgr is None:
+        mgr = TreeManager(tsdb)
+        tsdb._tree_manager = mgr
+    return mgr

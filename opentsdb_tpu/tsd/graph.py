@@ -1,0 +1,89 @@
+"""The ``/q`` graphing endpoint (ref: ``src/tsd/GraphHandler.java:61``).
+
+The reference shells out to gnuplot (:785) writing PNG files to a disk
+cache; here charts render with matplotlib (Agg backend) when available
+and the endpoint also serves the same ASCII/JSON outputs the reference
+supports (``ascii``, ``json`` query params). File caching honors
+``tsd.http.cachedir`` like the reference's ``/q`` cache (:517).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import time
+
+from opentsdb_tpu.query.model import parse_uri_query
+
+
+def handle_graph(router, request):
+    from opentsdb_tpu.tsd.http_api import HttpError, HttpResponse
+    tsq = parse_uri_query(request.params)
+    if not tsq.queries:
+        raise HttpError(400, "Missing 'm' parameter",
+                        "Nothing to graph without a metric query")
+    tsq.validate()
+    results = router.tsdb.new_query().run(tsq)
+
+    if request.flag("ascii"):
+        # one line per point: metric timestamp value tags (ref:
+        # GraphHandler ascii output == `tsdb query` format)
+        lines = []
+        for r in results:
+            tag_str = " ".join(f"{k}={v}" for k, v in sorted(r.tags.items()))
+            for ts, v in r.dps:
+                lines.append(f"{r.metric} {ts // 1000} {v:g} {tag_str}"
+                             .rstrip())
+        return HttpResponse(200, "\n".join(lines).encode(),
+                            content_type="text/plain")
+    if request.flag("json") or request.param("format") == "json":
+        body = router.serializer.format_query(tsq, results)
+        return HttpResponse(200, body)
+
+    # PNG rendering
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise HttpError(
+            501, "Graphing requires matplotlib",
+            "Install matplotlib or request ?json / ?ascii") from None
+
+    cache_dir = router.tsdb.config.get_string("tsd.http.cachedir",
+                                              "/tmp/opentsdb_tpu")
+    os.makedirs(cache_dir, exist_ok=True)
+    key = hashlib.sha1(repr(sorted(request.params.items()))
+                       .encode()).hexdigest()
+    cache_file = os.path.join(cache_dir, f"{key}.png")
+    max_age = int(request.param("max_age", "60"))
+    if os.path.isfile(cache_file) and \
+            time.time() - os.path.getmtime(cache_file) < max_age:
+        with open(cache_file, "rb") as fh:
+            return HttpResponse(200, fh.read(), content_type="image/png")
+
+    wxh = (request.param("wxh") or "1024x768").split("x")
+    fig, ax = plt.subplots(
+        figsize=(int(wxh[0]) / 100, int(wxh[1]) / 100), dpi=100)
+    for r in results:
+        label = r.metric
+        if r.tags:
+            label += "{" + ",".join(f"{k}={v}"
+                                    for k, v in sorted(r.tags.items())) + "}"
+        xs = [ts / 1000 for ts, _ in r.dps]
+        ys = [v for _, v in r.dps]
+        ax.plot(xs, ys, label=label, linewidth=1)
+    if request.param("ylabel"):
+        ax.set_ylabel(request.param("ylabel"))
+    if request.flag("nokey") is False and results:
+        ax.legend(loc="best", fontsize=8)
+    ax.grid(True, alpha=0.3)
+    fig.autofmt_xdate()
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png")
+    plt.close(fig)
+    png = buf.getvalue()
+    with open(cache_file, "wb") as fh:
+        fh.write(png)
+    return HttpResponse(200, png, content_type="image/png")

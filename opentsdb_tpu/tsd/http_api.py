@@ -1,0 +1,667 @@
+"""HTTP API: the full RPC surface of the TSD
+(ref: ``src/tsd/RpcManager.java:267-360`` routing table and the
+individual ``*Rpc.java`` handlers).
+
+Transport-independent: :class:`HttpRpcRouter` maps parsed requests to
+responses; :mod:`opentsdb_tpu.tsd.server` feeds it from asyncio sockets
+and tests call it directly (the NettyMocks strategy of the reference,
+test/tsd/NettyMocks.java).
+
+Endpoints (as in RpcManager, mode-gated rw/ro/wo like :274-327):
+``/api/put``, ``/api/rollup``, ``/api/histogram``, ``/api/query``
+(+``/last``, ``/exp``, ``/gexp``), ``/api/suggest``, ``/api/search/*``,
+``/api/annotation(s)`` (+bulk), ``/api/uid/*``, ``/api/tree/*``,
+``/api/stats/*``, ``/api/aggregators``, ``/api/config(+/filters)``,
+``/api/dropcaches``, ``/api/version``, ``/q``, ``/s``, ``/logs``, plus
+the legacy unversioned aliases.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from opentsdb_tpu import __version__
+from opentsdb_tpu.meta.annotation import Annotation
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.query.model import (BadRequestError, TSQuery,
+                                      parse_uri_query)
+from opentsdb_tpu.stats.stats import QueryStats
+from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+
+
+@dataclass
+class HttpRequest:
+    method: str
+    path: str
+    params: dict[str, list[str]] = field(default_factory=dict)
+    headers: dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    remote: str = ""
+
+    def param(self, key: str, default: str | None = None) -> str | None:
+        vals = self.params.get(key)
+        return vals[0] if vals else default
+
+    def has_param(self, key: str) -> bool:
+        return key in self.params
+
+    def flag(self, key: str) -> bool:
+        """true when ?key or ?key=true (ref: HttpQuery.parseBoolean)."""
+        if key not in self.params:
+            return False
+        v = self.params[key][0]
+        return v in ("", "true", "1", "yes")
+
+
+@dataclass
+class HttpResponse:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = "application/json; charset=UTF-8"
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str, details: str = ""):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.details = details
+
+
+class HttpRpcRouter:
+    """(ref: RpcManager + RpcHandler.java:46)"""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        self.serializer = HttpJsonSerializer()
+        mode = tsdb.mode
+        self._routes: dict[str, Callable] = {}
+        # read RPCs (not registered in write-only mode, RpcManager:274)
+        if mode in ("rw", "ro"):
+            self._routes.update({
+                "query": self._handle_query,
+                "suggest": self._handle_suggest,
+                "search": self._handle_search,
+                "uid": self._handle_uid,
+                "annotation": self._handle_annotation,
+                "annotations": self._handle_annotations,
+                "tree": self._handle_tree,
+            })
+        # write RPCs (not registered in read-only mode, RpcManager:327)
+        if mode in ("rw", "wo"):
+            self._routes["put"] = self._handle_put
+            self._routes["rollup"] = self._handle_rollup
+            self._routes["histogram"] = self._handle_histogram
+        self._routes.update({
+            "aggregators": self._handle_aggregators,
+            "config": self._handle_config,
+            "dropcaches": self._handle_dropcaches,
+            "stats": self._handle_stats,
+            "version": self._handle_version,
+        })
+        self.plugin_routes: dict[str, Callable] = {}
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------------
+
+    def handle(self, request: HttpRequest) -> HttpResponse:
+        try:
+            return self._dispatch(request)
+        except HttpError as e:
+            return HttpResponse(e.status, self.serializer.format_error(
+                e.status, e.message, e.details))
+        except BadRequestError as e:
+            return HttpResponse(400, self.serializer.format_error(
+                400, str(e)))
+        except ValueError as e:
+            return HttpResponse(400, self.serializer.format_error(
+                400, str(e)))
+        except NotImplementedError as e:
+            return HttpResponse(501, self.serializer.format_error(
+                501, str(e) or "not implemented"))
+        except Exception as e:  # noqa: BLE001 (ref: RpcHandler 500 path)
+            import traceback
+            details = traceback.format_exc() if self.tsdb.config.get_bool(
+                "tsd.http.show_stack_trace") else ""
+            return HttpResponse(500, self.serializer.format_error(
+                500, f"{type(e).__name__}: {e}", details))
+
+    def _dispatch(self, request: HttpRequest) -> HttpResponse:
+        path = urllib.parse.unquote(request.path.split("?", 1)[0])
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return self._homepage(request)
+        # /api[/vN]/endpoint/...  (ref: HttpQuery.explodeAPIPath)
+        if parts[0] == "api":
+            parts = parts[1:]
+            if parts and parts[0].startswith("v") and \
+                    parts[0][1:].isdigit():
+                parts = parts[1:]
+            if not parts:
+                raise HttpError(400, "Missing API endpoint")
+            endpoint, rest = parts[0], parts[1:]
+        elif parts[0] in ("q",):
+            return self._handle_graph(request)
+        elif parts[0] in ("s",):
+            return self._handle_static(request, parts[1:])
+        elif parts[0] == "logs":
+            return self._handle_logs(request)
+        elif parts[0] in ("aggregators", "version", "suggest", "stats",
+                          "dropcaches"):
+            # legacy unversioned aliases (ref: RpcManager deprecated map)
+            endpoint, rest = parts[0], parts[1:]
+        else:
+            raise HttpError(404, f"Endpoint not found: /{parts[0]}",
+                            "The requested endpoint was not found")
+        if endpoint in self.plugin_routes:
+            return self.plugin_routes[endpoint](request, rest)
+        handler = self._routes.get(endpoint)
+        if handler is None:
+            raise HttpError(404, f"Endpoint not found: /api/{endpoint}",
+                            "The requested endpoint was not found")
+        return handler(request, rest)
+
+    # -- write path ----------------------------------------------------
+
+    def _handle_put(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: PutDataPointRpc.java:272)"""
+        if request.method != "POST":
+            raise HttpError(405, "Method not allowed",
+                            "The HTTP method is not permitted")
+        points = self.serializer.parse_put(request.body)
+        details = request.flag("details")
+        summary = request.flag("summary")
+        success = 0
+        errors: list[dict] = []
+        for dp in points:
+            try:
+                metric = dp["metric"]
+                ts = int(dp["timestamp"])
+                value = dp["value"]
+                if isinstance(value, str):
+                    value = (float(value) if
+                             ("." in value or "e" in value.lower())
+                             else int(value))
+                tags = dp.get("tags") or {}
+                self.tsdb.add_point(metric, ts, value, tags)
+                success += 1
+            except (KeyError, TypeError) as e:
+                errors.append({"datapoint": dp,
+                               "error": f"missing field: {e}"})
+            except Exception as e:  # noqa: BLE001
+                errors.append({"datapoint": dp, "error": str(e)})
+        failed = len(errors)
+        if not details and not summary:
+            if failed:
+                raise HttpError(
+                    400,
+                    f"One or more data points had errors",
+                    f"{failed} error(s) storing datapoints")
+            return HttpResponse(204)
+        return HttpResponse(
+            400 if failed else 200,
+            self.serializer.format_put(success, failed, errors, details))
+
+    def _handle_rollup(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: RollupDataPointRpc.java:227)"""
+        if request.method != "POST":
+            raise HttpError(405, "Method not allowed")
+        points = self.serializer.parse_put(request.body)
+        success = 0
+        errors: list[dict] = []
+        for dp in points:
+            try:
+                value = dp["value"]
+                if isinstance(value, str):
+                    value = float(value)
+                self.tsdb.add_aggregate_point(
+                    dp["metric"], int(dp["timestamp"]), value,
+                    dp.get("tags") or {},
+                    bool(dp.get("groupByAggregator")
+                         or dp.get("isGroupBy")),
+                    dp.get("interval"),
+                    dp.get("aggregator"),
+                    dp.get("groupByAggregator"))
+                success += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append({"datapoint": dp, "error": str(e)})
+        if errors and not request.flag("details") \
+                and not request.flag("summary"):
+            raise HttpError(400, "One or more data points had errors",
+                            "; ".join(e["error"] for e in errors[:5]))
+        return HttpResponse(
+            400 if errors else 200,
+            self.serializer.format_put(success, len(errors), errors,
+                                       request.flag("details")))
+
+    def _handle_histogram(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: HistogramDataPointRpc.java) Value is the base64 codec
+        blob (HistogramPojo)."""
+        if request.method != "POST":
+            raise HttpError(405, "Method not allowed")
+        points = self.serializer.parse_put(request.body)
+        success = 0
+        errors: list[dict] = []
+        for dp in points:
+            try:
+                blob = base64.b64decode(dp["value"])
+                self.tsdb.add_histogram_point(
+                    dp["metric"], int(dp["timestamp"]), blob,
+                    dp.get("tags") or {})
+                success += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append({"datapoint": dp, "error": str(e)})
+        if errors and not request.flag("details") \
+                and not request.flag("summary"):
+            raise HttpError(400, "One or more data points had errors")
+        return HttpResponse(
+            400 if errors else 200,
+            self.serializer.format_put(success, len(errors), errors,
+                                       request.flag("details")))
+
+    # -- read path -----------------------------------------------------
+
+    def _handle_query(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: QueryRpc.java:89-128)"""
+        sub = rest[0] if rest else ""
+        if sub == "last":
+            return self._handle_query_last(request)
+        if sub in ("exp", "gexp"):
+            from opentsdb_tpu.query.expression.endpoint import (
+                handle_exp, handle_gexp)
+            if sub == "exp":
+                return handle_exp(self, request)
+            return handle_gexp(self, request)
+        if request.method == "POST":
+            obj = self.serializer.parse_query(request.body)
+            tsq = TSQuery.from_json(obj)
+        elif request.method in ("GET", "DELETE"):
+            tsq = parse_uri_query(request.params)
+        else:
+            raise HttpError(405, "Method not allowed")
+        tsq.validate()
+        if request.method == "DELETE" or tsq.delete:
+            raise HttpError(400, "Deleting data is not enabled",
+                            "set tsd.http.query.allow_delete")
+        stats = QueryStats(request.remote, tsq)
+        try:
+            results = self.tsdb.new_query().run(tsq, stats)
+        finally:
+            stats.mark_serialization_successful()
+        body = self.serializer.format_query(
+            tsq, results, as_arrays=request.flag("arrays"),
+            show_summary=tsq.show_summary or request.flag("show_summary"),
+            show_stats=tsq.show_stats or request.flag("show_stats"),
+            summary_extra=stats.stats)
+        return HttpResponse(200, body)
+
+    def _handle_query_last(self, request: HttpRequest) -> HttpResponse:
+        """(ref: QueryRpc.java:346 /api/query/last via TSUIDQuery)"""
+        from opentsdb_tpu.search.lookup import last_data_points
+        if request.method == "POST":
+            obj = json.loads(request.body or b"{}")
+            specs = obj.get("queries", [])
+            back_scan = int(obj.get("backScan", 0))
+            resolve = bool(obj.get("resolveNames", False))
+        else:
+            specs = [{"uri": m} for m in request.params.get(
+                "timeseries", [])]
+            back_scan = int(request.param("back_scan", "0"))
+            resolve = request.flag("resolve")
+        points = last_data_points(self.tsdb, specs, back_scan, resolve)
+        return HttpResponse(200,
+                            self.serializer.format_last_points(points))
+
+    def _handle_suggest(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: SuggestRpc.java:30)"""
+        if request.method == "POST":
+            obj = json.loads(request.body or b"{}")
+            stype = obj.get("type", "")
+            q = obj.get("q", "")
+            max_results = int(obj.get("max", 25))
+        else:
+            stype = request.param("type", "")
+            q = request.param("q", "") or ""
+            max_results = int(request.param("max", "25"))
+        if stype == "metrics":
+            names = self.tsdb.suggest_metrics(q, max_results)
+        elif stype == "tagk":
+            names = self.tsdb.suggest_tag_names(q, max_results)
+        elif stype == "tagv":
+            names = self.tsdb.suggest_tag_values(q, max_results)
+        else:
+            raise BadRequestError(f"Invalid 'type' parameter: {stype}")
+        return HttpResponse(200, self.serializer.format_suggest(names))
+
+    def _handle_search(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: SearchRpc.java; /api/search/lookup via
+        TimeSeriesLookup.java:83)"""
+        sub = rest[0] if rest else ""
+        from opentsdb_tpu.search.lookup import time_series_lookup
+        if sub == "lookup":
+            if request.method == "POST":
+                obj = json.loads(request.body or b"{}")
+                metric = obj.get("metric", "")
+                tags = [(t.get("key"), t.get("value"))
+                        for t in obj.get("tags", [])]
+                limit = int(obj.get("limit", 25))
+                use_meta = bool(obj.get("useMeta", False))
+            else:
+                m = request.param("m", "") or ""
+                from opentsdb_tpu.core import tags as tags_mod
+                metric, tag_map = tags_mod.parse_with_metric(m) \
+                    if m else ("", {})
+                tags = list(tag_map.items())
+                limit = int(request.param("limit", "25"))
+                use_meta = request.flag("use_meta")
+            results = time_series_lookup(self.tsdb, metric, tags, limit,
+                                         use_meta)
+            return HttpResponse(200, self.serializer.format_search(results))
+        if self.tsdb.search_plugin is None:
+            raise BadRequestError(
+                "Searching is not enabled on this TSD")
+        obj = json.loads(request.body or b"{}")
+        results = self.tsdb.search_plugin.execute_query(sub, obj)
+        return HttpResponse(200, self.serializer.format_search(results))
+
+    # -- annotations (ref: AnnotationRpc.java) -------------------------
+
+    def _handle_annotation(self, request: HttpRequest, rest
+                           ) -> HttpResponse:
+        if rest and rest[0] == "bulk":
+            return self._handle_annotation_bulk(request)
+        store = self.tsdb.annotations
+        if request.method == "GET":
+            tsuid = request.param("tsuid", "") or ""
+            start = int(request.param("start_time", "0"))
+            note = store.get(tsuid.upper() if tsuid else "", start)
+            if note is None:
+                raise HttpError(404, "Unable to locate annotation in storage")
+            return HttpResponse(200, self.serializer.format_annotation(note))
+        if request.method in ("POST", "PUT"):
+            obj = json.loads(request.body or b"{}")
+            note = Annotation.from_json(obj)
+            note.tsuid = note.tsuid.upper()
+            existing = store.get(note.tsuid, note.start_time)
+            if request.method == "POST" and existing is not None:
+                # POST merges into existing (ref: AnnotationRpc syncToStorage)
+                if not note.description:
+                    note.description = existing.description
+                if not note.notes:
+                    note.notes = existing.notes
+                if not note.end_time:
+                    note.end_time = existing.end_time
+                merged_custom = dict(existing.custom)
+                merged_custom.update(note.custom)
+                note.custom = merged_custom
+            store.store(note)
+            return HttpResponse(200, self.serializer.format_annotation(note))
+        if request.method == "DELETE":
+            tsuid = (request.param("tsuid", "") or "").upper()
+            start = int(request.param("start_time", "0"))
+            if not store.delete(tsuid, start):
+                raise HttpError(404, "Unable to locate annotation in storage")
+            return HttpResponse(204)
+        raise HttpError(405, "Method not allowed")
+
+    def _handle_annotation_bulk(self, request: HttpRequest) -> HttpResponse:
+        store = self.tsdb.annotations
+        if request.method in ("POST", "PUT"):
+            objs = json.loads(request.body or b"[]")
+            notes = []
+            for obj in objs:
+                note = Annotation.from_json(obj)
+                note.tsuid = note.tsuid.upper()
+                store.store(note)
+                notes.append(note)
+            return HttpResponse(200,
+                                self.serializer.format_annotations(notes))
+        if request.method == "DELETE":
+            obj = json.loads(request.body or b"{}")
+            tsuids = obj.get("tsuids")
+            if obj.get("global") or not tsuids:
+                tsuids = [""] if obj.get("global") else tsuids
+            start = int(obj.get("startTime", 0))
+            end = int(obj.get("endTime") or time.time())
+            count = store.delete_range(
+                [t.upper() for t in tsuids] if tsuids else None, start, end)
+            obj["totalDeleted"] = count
+            return HttpResponse(200, json.dumps(obj).encode())
+        raise HttpError(405, "Method not allowed")
+
+    def _handle_annotations(self, request: HttpRequest, rest
+                            ) -> HttpResponse:
+        """Global annotation range query (ref: AnnotationRpc)."""
+        start = int(request.param("start_time", "0"))
+        end = int(request.param("end_time") or time.time())
+        notes = self.tsdb.annotations.global_range(start, end)
+        return HttpResponse(200, self.serializer.format_annotations(notes))
+
+    # -- uid (ref: UniqueIdRpc.java) -----------------------------------
+
+    def _handle_uid(self, request: HttpRequest, rest) -> HttpResponse:
+        sub = rest[0] if rest else ""
+        if sub == "assign":
+            return self._uid_assign(request)
+        if sub == "rename":
+            return self._uid_rename(request)
+        if sub == "uidmeta":
+            return self._uid_meta(request)
+        if sub == "tsmeta":
+            return self._ts_meta(request)
+        raise HttpError(404, "Endpoint not found",
+                        f"/api/uid/{sub} is not a valid endpoint")
+
+    def _uid_assign(self, request: HttpRequest) -> HttpResponse:
+        if request.method == "POST":
+            obj = json.loads(request.body or b"{}")
+        else:
+            obj = {k: (request.param(k) or "").split(",")
+                   for k in ("metric", "tagk", "tagv")
+                   if request.has_param(k)}
+        response: dict[str, Any] = {}
+        had_error = False
+        for kind in ("metric", "tagk", "tagv"):
+            names = obj.get(kind) or []
+            if isinstance(names, str):
+                names = [names]
+            good: dict[str, str] = {}
+            bad: dict[str, str] = {}
+            registry = self.tsdb.uids.by_kind(kind)
+            for name in names:
+                try:
+                    uid = self.tsdb.assign_uid(kind, name)
+                    good[name] = registry.int_to_uid(uid).hex().upper()
+                except Exception as e:  # noqa: BLE001
+                    bad[name] = str(e)
+                    had_error = True
+            if names:
+                response[kind] = good
+                if bad:
+                    response[f"{kind}_errors"] = bad
+        return HttpResponse(400 if had_error else 200,
+                            self.serializer.format_uid_assign(response))
+
+    def _uid_rename(self, request: HttpRequest) -> HttpResponse:
+        obj = json.loads(request.body or b"{}") \
+            if request.method == "POST" else \
+            {k: request.param(k) for k in ("metric", "tagk", "tagv",
+                                           "name")}
+        new_name = obj.get("name") or ""
+        if not new_name:
+            raise BadRequestError("Missing 'name' parameter")
+        for kind in ("metric", "tagk", "tagv"):
+            old = obj.get(kind)
+            if old:
+                try:
+                    self.tsdb.uids.by_kind(kind).rename(old, new_name)
+                    return HttpResponse(200, json.dumps(
+                        {"result": "true"}).encode())
+                except Exception as e:  # noqa: BLE001
+                    return HttpResponse(400, json.dumps(
+                        {"result": "false", "error": str(e)}).encode())
+        raise BadRequestError("Missing uid type/name to rename")
+
+    def _uid_meta(self, request: HttpRequest) -> HttpResponse:
+        if request.method == "GET":
+            uid = (request.param("uid", "") or "").upper()
+            kind = (request.param("type", "") or "").lower()
+            meta = self.tsdb.meta.get_uid_meta(kind, uid)
+            if meta is None:
+                # fall back to a default doc for existing UIDs (ref:
+                # UIDMeta.getUIDMeta returning skeleton docs)
+                try:
+                    registry = self.tsdb.uids.by_kind(kind)
+                    name = registry.get_name(bytes.fromhex(uid))
+                except Exception:  # noqa: BLE001
+                    raise HttpError(
+                        404, "Could not find the requested UID") from None
+                from opentsdb_tpu.meta.meta_store import UIDMeta
+                meta = UIDMeta(uid=uid, type=kind.upper(), name=name)
+            return HttpResponse(200, json.dumps(meta.to_json()).encode())
+        raise HttpError(405, "Method not allowed",
+                        "uidmeta editing requires realtime meta tracking")
+
+    def _ts_meta(self, request: HttpRequest) -> HttpResponse:
+        if request.method == "GET":
+            tsuid = (request.param("tsuid", "") or "").upper()
+            meta = self.tsdb.meta.get_ts_meta(tsuid)
+            if meta is None:
+                raise HttpError(
+                    404, "Could not find Timeseries meta data")
+            return HttpResponse(200, json.dumps(meta.to_json()).encode())
+        raise HttpError(405, "Method not allowed")
+
+    # -- tree (ref: TreeRpc.java) --------------------------------------
+
+    def _handle_tree(self, request: HttpRequest, rest) -> HttpResponse:
+        from opentsdb_tpu.tree.rpc import handle_tree_request
+        return handle_tree_request(self, request, rest)
+
+    # -- monitoring ----------------------------------------------------
+
+    def _handle_aggregators(self, request: HttpRequest, rest
+                            ) -> HttpResponse:
+        return HttpResponse(
+            200, self.serializer.format_aggregators(aggs_mod.names()))
+
+    def _handle_config(self, request: HttpRequest, rest) -> HttpResponse:
+        if rest and rest[0] == "filters":
+            return HttpResponse(200, json.dumps(
+                filters_mod.filter_types()).encode())
+        return HttpResponse(200, self.serializer.format_config(
+            self.tsdb.config.dump_configuration()))
+
+    def _handle_dropcaches(self, request: HttpRequest, rest
+                           ) -> HttpResponse:
+        self.tsdb.drop_caches()
+        return HttpResponse(200, self.serializer.format_dropcaches(
+            {"status": "200", "message": "Caches dropped"}))
+
+    def _handle_stats(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: StatsRpc.java; /api/stats + /query /jvm /threads
+        /region_clients)"""
+        sub = rest[0] if rest else ""
+        if sub == "query":
+            return HttpResponse(200, self.serializer.format_query_stats(
+                QueryStats.running_and_completed()))
+        if sub == "jvm":
+            return HttpResponse(200, json.dumps(
+                self._runtime_stats()).encode())
+        if sub == "threads":
+            import threading
+            return HttpResponse(200, json.dumps([
+                {"name": t.name, "state": "ALIVE" if t.is_alive()
+                 else "DEAD", "daemon": t.daemon}
+                for t in threading.enumerate()]).encode())
+        if sub == "region_clients":
+            # storage is in-process: one logical "region client"
+            return HttpResponse(200, json.dumps([{
+                "id": 0, "backend": self.tsdb.config.get_string(
+                    "tsd.storage.backend", "memory"),
+                "pendingRPCs": 0, "dead": False,
+            }]).encode())
+        collector = self.tsdb.stats.collect()
+        self.tsdb.collect_stats(collector)
+        return HttpResponse(200, self.serializer.format_stats(
+            collector.as_json()))
+
+    def _runtime_stats(self) -> dict[str, Any]:
+        import gc
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "os": {"systemLoadAverage": __import__("os").getloadavg()[0]},
+            "runtime": {"uptime": int((time.time() - self.start_time)
+                                      * 1000)},
+            "memory": {"maxRssKb": ru.ru_maxrss},
+            "gc": {"collections": sum(s["collections"]
+                                      for s in gc.get_stats())},
+        }
+
+    def _handle_version(self, request: HttpRequest, rest) -> HttpResponse:
+        return HttpResponse(200, self.serializer.format_version(
+            version_info()))
+
+    # -- misc ----------------------------------------------------------
+
+    def _homepage(self, request: HttpRequest) -> HttpResponse:
+        body = (b"<html><head><title>opentsdb-tpu</title></head><body>"
+                b"<h1>opentsdb-tpu " + __version__.encode() +
+                b"</h1><p>TPU-native time series database.</p>"
+                b"<p>See /api/version, /api/aggregators, /api/query"
+                b"</p></body></html>")
+        return HttpResponse(200, body, content_type="text/html")
+
+    def _handle_graph(self, request: HttpRequest) -> HttpResponse:
+        from opentsdb_tpu.tsd.graph import handle_graph
+        return handle_graph(self, request)
+
+    def _handle_static(self, request: HttpRequest, rest) -> HttpResponse:
+        """(ref: StaticFileRpc.java:20)"""
+        import os
+        root = self.tsdb.config.get_string("tsd.http.staticroot", "")
+        if not root:
+            raise HttpError(404, "No static root configured")
+        rel = "/".join(rest)
+        full = os.path.realpath(os.path.join(root, rel))
+        if not full.startswith(os.path.realpath(root)) \
+                or not os.path.isfile(full):
+            raise HttpError(404, "File not found")
+        import mimetypes
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        with open(full, "rb") as fh:
+            return HttpResponse(200, fh.read(), content_type=ctype)
+
+    def _handle_logs(self, request: HttpRequest) -> HttpResponse:
+        """(ref: LogsRpc — logback ring buffer; here the in-process
+        logging ring)"""
+        from opentsdb_tpu.utils.logring import ring_buffer
+        lines = ring_buffer.lines()
+        if request.flag("json"):
+            return HttpResponse(200, json.dumps(lines).encode())
+        return HttpResponse(200, "\n".join(lines).encode(),
+                            content_type="text/plain")
+
+
+def version_info() -> dict[str, str]:
+    """(ref: BuildData emitted by VersionRpc)"""
+    import platform
+
+    return {
+        "version": __version__,
+        "short_revision": "tpu",
+        "full_revision": "opentsdb_tpu",
+        "timestamp": str(int(time.time())),
+        "repo_status": "MODIFIED",
+        "user": "tsd",
+        "host": platform.node(),
+        "repo": "opentsdb_tpu",
+    }

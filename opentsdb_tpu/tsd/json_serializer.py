@@ -1,0 +1,176 @@
+"""HTTP JSON serializer (ref: ``src/tsd/HttpJsonSerializer.java``).
+
+The default (and pluggable — see :class:`HttpSerializer`) wire format.
+Output shapes match the reference byte-for-byte in structure:
+query results are arrays of ``{metric, tags, aggregateTags, dps, ...}``
+with ``dps`` keyed by epoch-seconds strings (or ms when msResolution),
+errors wrap in ``{"error": {code, message, details}}``, put responses
+report ``{success, failed, errors[]}``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any
+
+from opentsdb_tpu.query.engine import QueryResult
+
+
+class HttpSerializer:
+    """Serializer plugin ABI (ref: HttpSerializer.java:93). Subclass and
+    register via ``tsd.http.serializer.plugin`` for other wire formats;
+    content negotiation keys off :attr:`shortname` in the request path
+    (``/api/query?serializer=<shortname>``)."""
+
+    shortname = "json"
+    request_content_type = "application/json"
+    response_content_type = "application/json; charset=UTF-8"
+
+    def parse_put(self, body: bytes) -> list[dict[str, Any]]:
+        raise NotImplementedError
+
+    def parse_query(self, body: bytes) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def format_query(self, ts_query, results) -> bytes:
+        raise NotImplementedError
+
+    def format_error(self, code: int, message: str,
+                     details: str = "") -> bytes:
+        raise NotImplementedError
+
+
+def _format_value(v: float):
+    """Match the reference's number emission: NaN/Inf literal strings,
+    integral floats written as ints."""
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return "NaN"
+    if isinstance(v, float) and math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if isinstance(v, float) and v.is_integer() and abs(v) < 2**53:
+        return int(v)
+    return v
+
+
+class HttpJsonSerializer(HttpSerializer):
+    """(ref: HttpJsonSerializer.java:69)"""
+
+    def parse_put(self, body: bytes) -> list[dict[str, Any]]:
+        """Accepts one datapoint object or an array of them
+        (ref: parsePutV1)."""
+        if not body:
+            raise ValueError("Missing request content")
+        data = json.loads(body)
+        if isinstance(data, dict):
+            return [data]
+        if isinstance(data, list):
+            return data
+        raise ValueError("Invalid datapoint content")
+
+    def parse_query(self, body: bytes) -> dict[str, Any]:
+        if not body:
+            raise ValueError("Missing request content")
+        data = json.loads(body)
+        if not isinstance(data, dict):
+            raise ValueError("Invalid query content")
+        return data
+
+    def format_query(self, ts_query, results: list[QueryResult],
+                     as_arrays: bool = False,
+                     show_summary: bool = False,
+                     show_stats: bool = False,
+                     summary_extra: dict | None = None) -> bytes:
+        """(ref: formatQueryAsyncV1) ``dps`` as {ts: value} maps, or
+        [[ts, value], ...] when the ``arrays`` query param is set."""
+        ms = ts_query.ms_resolution
+        out = []
+        for r in results:
+            dps: Any
+            if as_arrays:
+                dps = [[ts if ms else ts // 1000, _format_value(v)]
+                       for ts, v in r.dps]
+            else:
+                dps = {str(ts if ms else ts // 1000): _format_value(v)
+                       for ts, v in r.dps}
+            obj: dict[str, Any] = {
+                "metric": r.metric,
+                "tags": r.tags,
+                "aggregateTags": r.aggregated_tags,
+            }
+            if ts_query.show_query:
+                obj["query"] = ts_query.queries[r.sub_query_index].to_json()
+            if r.tsuids:
+                obj["tsuids"] = r.tsuids
+            if not ts_query.no_annotations and r.annotations:
+                obj["annotations"] = [a.to_json() for a in r.annotations]
+            if ts_query.global_annotations and r.global_annotations:
+                obj["globalAnnotations"] = [a.to_json()
+                                            for a in r.global_annotations]
+            obj["dps"] = dps
+            out.append(obj)
+        if show_summary or show_stats:
+            summary: dict[str, Any] = {"statsSummary": summary_extra or {}}
+            out.append(summary)
+        return self._dump(out)
+
+    def format_put(self, success: int, failed: int,
+                   errors: list[dict] | None = None,
+                   show_details: bool = False) -> bytes:
+        obj: dict[str, Any] = {"success": success, "failed": failed}
+        if show_details:
+            obj["errors"] = errors or []
+        return self._dump(obj)
+
+    def format_error(self, code: int, message: str,
+                     details: str = "") -> bytes:
+        err: dict[str, Any] = {"code": code, "message": message}
+        if details:
+            err["details"] = details
+        return self._dump({"error": err})
+
+    def format_suggest(self, suggestions: list[str]) -> bytes:
+        return self._dump(suggestions)
+
+    def format_aggregators(self, aggs: list[str]) -> bytes:
+        return self._dump(aggs)
+
+    def format_version(self, version: dict[str, str]) -> bytes:
+        return self._dump(version)
+
+    def format_config(self, config: dict[str, str]) -> bytes:
+        return self._dump(config)
+
+    def format_dropcaches(self, response: dict[str, str]) -> bytes:
+        return self._dump(response)
+
+    def format_annotation(self, note) -> bytes:
+        return self._dump(note.to_json())
+
+    def format_annotations(self, notes: list) -> bytes:
+        return self._dump([n.to_json() for n in notes])
+
+    def format_uid_assign(self, response: dict) -> bytes:
+        return self._dump(response)
+
+    def format_stats(self, stats: list[dict]) -> bytes:
+        return self._dump(stats)
+
+    def format_query_stats(self, obj: dict) -> bytes:
+        return self._dump(obj)
+
+    def format_search(self, results: dict) -> bytes:
+        return self._dump(results)
+
+    def format_last_points(self, points: list[dict]) -> bytes:
+        return self._dump(points)
+
+    def _dump(self, obj: Any) -> bytes:
+        return json.dumps(obj, separators=(",", ":"),
+                          default=_json_default).encode("utf-8")
+
+
+def _json_default(o):
+    if hasattr(o, "to_json"):
+        return o.to_json()
+    raise TypeError(f"not JSON serializable: {type(o)}")

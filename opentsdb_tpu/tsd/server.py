@@ -1,0 +1,282 @@
+"""The TSD network server (ref: ``src/tsd/PipelineFactory.java:44``,
+``src/tools/TSDMain.java:48``).
+
+One asyncio server on one port speaking both HTTP and the telnet line
+protocol, distinguished by sniffing the first bytes of a connection
+exactly like the reference's ``DetectHttpOrRpc`` handler
+(PipelineFactory.java:134-171): if the first token looks like an HTTP
+method, the connection is HTTP (with keep-alive); otherwise each line
+is a telnet command. Connection counting mirrors
+``ConnectionManager.java:37``; optional auth wraps the first exchange
+(AuthenticationChannelHandler.java:50).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import urllib.parse
+
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpResponse, \
+    HttpRpcRouter
+from opentsdb_tpu.tsd.telnet import (TelnetCloseConnection, TelnetRouter,
+                                     TelnetServerShutdown)
+
+LOG = logging.getLogger("tsd.server")
+
+_HTTP_METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"OPTI",
+                 b"PATC")
+
+
+class ConnectionManager:
+    """(ref: src/tsd/ConnectionManager.java:37)"""
+
+    def __init__(self, max_connections: int = 0):
+        self.max_connections = max_connections
+        self.open_connections = 0
+        self.total_connections = 0
+        self.rejected_connections = 0
+        self.exceptions_unknown = 0
+
+    def accept(self) -> bool:
+        if self.max_connections and \
+                self.open_connections >= self.max_connections:
+            self.rejected_connections += 1
+            return False
+        self.open_connections += 1
+        self.total_connections += 1
+        return True
+
+    def release(self) -> None:
+        self.open_connections -= 1
+
+    def collect_stats(self, collector) -> None:
+        collector.record("connectionmgr.connections",
+                         self.open_connections, type="open")
+        collector.record("connectionmgr.connections",
+                         self.total_connections, type="total")
+        collector.record("connectionmgr.exceptions",
+                         self.rejected_connections, type="rejected")
+
+
+class TSDServer:
+    """(ref: TSDMain.java:71)"""
+
+    def __init__(self, tsdb, host: str | None = None,
+                 port: int | None = None):
+        self.tsdb = tsdb
+        self.host = host or tsdb.config.get_string("tsd.network.bind",
+                                                   "0.0.0.0")
+        self.port = port if port is not None else \
+            tsdb.config.get_int("tsd.network.port", 4242)
+        self.http_router = HttpRpcRouter(tsdb)
+        self.telnet_router = TelnetRouter(tsdb, self)
+        self.connections = ConnectionManager(
+            tsdb.config.get_int("tsd.core.connections.limit", 0))
+        tsdb.stats.register(self.connections)
+        self._server: asyncio.AbstractServer | None = None
+        self._shutdown = asyncio.Event()
+        self.cors_domains = [
+            d.strip() for d in tsdb.config.get_string(
+                "tsd.http.request.cors_domains", "").split(",")
+            if d.strip()]
+
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port,
+            backlog=self.tsdb.config.get_int("tsd.network.backlog", 3072),
+            reuse_address=self.tsdb.config.get_bool(
+                "tsd.network.reuse_address", True))
+        addr = self._server.sockets[0].getsockname()
+        LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        await self._shutdown.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.tsdb.shutdown()
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    # ------------------------------------------------------------------
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        if not self.connections.accept():
+            writer.close()
+            return
+        try:
+            # protocol sniff (ref: DetectHttpOrRpc.decode :134)
+            first = await reader.read(4)
+            if not first:
+                return
+            if first in _HTTP_METHODS or first[:3] == b"GET":
+                await self._serve_http(first, reader, writer)
+            else:
+                await self._serve_telnet(first, reader, writer)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        except TelnetServerShutdown:
+            writer.write(b"Cleanup complete, shutting down.\n")
+            await writer.drain()
+            self.request_shutdown()
+        except Exception:  # noqa: BLE001
+            LOG.exception("connection handler error")
+            self.connections.exceptions_unknown += 1
+        finally:
+            self.connections.release()
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- telnet --------------------------------------------------------
+
+    async def _serve_telnet(self, first: bytes, reader, writer) -> None:
+        buffer = first
+        authed = self.tsdb.authentication is None
+        while True:
+            line_end = buffer.find(b"\n")
+            if line_end < 0:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    break
+                buffer += chunk
+                continue
+            line = buffer[:line_end].rstrip(b"\r").decode(
+                "utf-8", "replace")
+            buffer = buffer[line_end + 1:]
+            if not authed:
+                # first exchange must be auth
+                # (ref: AuthenticationChannelHandler.java:50)
+                words = line.split()
+                if words and words[0] == "auth":
+                    state = self.tsdb.authentication.authenticate_telnet(
+                        words)
+                    from opentsdb_tpu.auth.simple import AuthStatus
+                    if state.status == AuthStatus.SUCCESS:
+                        authed = True
+                        writer.write(b"auth_success\n")
+                    else:
+                        writer.write(b"auth_fail\n")
+                else:
+                    writer.write(b"auth_fail\n")
+                await writer.drain()
+                continue
+            try:
+                response = self.telnet_router.execute(line)
+            except TelnetCloseConnection:
+                return
+            if response:
+                writer.write(response.encode() + b"\n")
+                await writer.drain()
+
+    # -- http ----------------------------------------------------------
+
+    async def _serve_http(self, first: bytes, reader, writer) -> None:
+        buffer = first
+        keep_alive = True
+        while keep_alive:
+            # read until end of headers
+            while b"\r\n\r\n" not in buffer:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                buffer += chunk
+            head, _, buffer = buffer.partition(b"\r\n\r\n")
+            lines = head.decode("latin-1").split("\r\n")
+            try:
+                method, target, version = lines[0].split(" ", 2)
+            except ValueError:
+                return
+            headers = {}
+            for hline in lines[1:]:
+                name, _, val = hline.partition(":")
+                headers[name.strip().lower()] = val.strip()
+            length = int(headers.get("content-length", "0"))
+            max_chunk = self.tsdb.config.get_int(
+                "tsd.http.request.max_chunk", 1048576)
+            if length > max_chunk * 64:
+                await self._write_response(
+                    writer, HttpResponse(413, b"content too large"),
+                    "HTTP/1.1", False)
+                return
+            while len(buffer) < length:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                buffer += chunk
+            body, buffer = buffer[:length], buffer[length:]
+            parsed = urllib.parse.urlsplit(target)
+            params = urllib.parse.parse_qs(parsed.query,
+                                           keep_blank_values=True)
+            peer = writer.get_extra_info("peername")
+            request = HttpRequest(
+                method=method.upper(), path=parsed.path, params=params,
+                headers=headers, body=body,
+                remote=f"{peer[0]}:{peer[1]}" if peer else "")
+            keep_alive = (version == "HTTP/1.1" and
+                          headers.get("connection", "").lower() != "close")
+            if method.upper() == "OPTIONS":
+                response = self._cors_preflight(request)
+            else:
+                t0 = time.monotonic()
+                response = await asyncio.get_event_loop().run_in_executor(
+                    None, self.http_router.handle, request)
+                self.tsdb.stats.latency_query.add(
+                    (time.monotonic() - t0) * 1000)
+            self._apply_cors(request, response)
+            await self._write_response(writer, response, version,
+                                       keep_alive)
+
+    def _cors_preflight(self, request: HttpRequest) -> HttpResponse:
+        """(ref: RpcHandler CORS handling :46)"""
+        origin = request.headers.get("origin", "")
+        if not self.cors_domains:
+            return HttpResponse(405, b"")
+        resp = HttpResponse(200, b"")
+        resp.headers["Access-Control-Allow-Methods"] = \
+            "GET, POST, PUT, DELETE"
+        resp.headers["Access-Control-Allow-Headers"] = \
+            self.tsdb.config.get_string("tsd.http.request.cors_headers",
+                                        "")
+        return resp
+
+    def _apply_cors(self, request: HttpRequest,
+                    response: HttpResponse) -> None:
+        origin = request.headers.get("origin", "")
+        if not origin or not self.cors_domains:
+            return
+        if "*" in self.cors_domains or origin in self.cors_domains:
+            response.headers["Access-Control-Allow-Origin"] = origin
+
+    async def _write_response(self, writer, response: HttpResponse,
+                              version: str, keep_alive: bool) -> None:
+        reason = {200: "OK", 204: "No Content", 400: "Bad Request",
+                  404: "Not Found", 405: "Method Not Allowed",
+                  413: "Request Entity Too Large", 500:
+                  "Internal Server Error",
+                  501: "Not Implemented"}.get(response.status, "Unknown")
+        head = [f"{version} {response.status} {reason}"]
+        head.append(f"Content-Length: {len(response.body)}")
+        if response.body:
+            head.append(f"Content-Type: {response.content_type}")
+        head.append("Connection: " +
+                    ("keep-alive" if keep_alive else "close"))
+        for k, v in response.headers.items():
+            head.append(f"{k}: {v}")
+        writer.write("\r\n".join(head).encode("latin-1") + b"\r\n\r\n"
+                     + response.body)
+        await writer.drain()

@@ -1,0 +1,143 @@
+"""Telnet line protocol (ref: ``src/tsd/TelnetRpc.java`` +
+RpcManager's telnet command table: put, rollup, histogram, stats,
+version, dropcaches, help, exit, diediedie, auth).
+
+Commands return response text (possibly empty — successful ``put`` is
+silent, matching PutDataPointRpc.java:129's error-only write-back).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Callable
+
+from opentsdb_tpu.core import tags as tags_mod
+from opentsdb_tpu.tsd.http_api import version_info
+
+
+class TelnetServerShutdown(Exception):
+    """Raised by ``diediedie`` to stop the whole TSD."""
+
+
+class TelnetCloseConnection(Exception):
+    """Raised by ``exit`` to close this connection."""
+
+
+class TelnetRouter:
+    def __init__(self, tsdb, server=None):
+        self.tsdb = tsdb
+        self.server = server
+        self.commands: dict[str, Callable[[list[str]], str]] = {}
+        mode = tsdb.mode
+        if mode in ("rw", "wo"):
+            self.commands["put"] = self._cmd_put
+            self.commands["rollup"] = self._cmd_rollup
+            self.commands["histogram"] = self._cmd_histogram
+        self.commands.update({
+            "stats": self._cmd_stats,
+            "version": self._cmd_version,
+            "dropcaches": self._cmd_dropcaches,
+            "help": self._cmd_help,
+            "exit": self._cmd_exit,
+            "diediedie": self._cmd_die,
+        })
+
+    def execute(self, line: str) -> str:
+        words = line.split()
+        if not words:
+            return ""
+        cmd = self.commands.get(words[0])
+        if cmd is None:
+            return f"error: unknown command: {words[0]}"
+        return cmd(words)
+
+    # ------------------------------------------------------------------
+
+    def _parse_value(self, raw: str) -> int | float:
+        if "." in raw or "e" in raw.lower() or raw.lower() in (
+                "nan", "-nan", "inf", "-inf", "infinity", "-infinity"):
+            return float(raw)
+        return int(raw)
+
+    def _cmd_put(self, words: list[str]) -> str:
+        """``put <metric> <timestamp> <value> <tagk=tagv> [...]``
+        (ref: PutDataPointRpc.execute :129)"""
+        if len(words) < 5:
+            return ("put: illegal argument: not enough arguments "
+                    f"(need least 4, got {len(words) - 1})")
+        try:
+            metric = words[1]
+            ts = int(words[2])
+            value = self._parse_value(words[3])
+            tags = dict(tags_mod.parse(w) for w in words[4:])
+            self.tsdb.add_point(metric, ts, value, tags)
+            return ""  # silent on success
+        except Exception as e:  # noqa: BLE001
+            return f"put: {type(e).__name__}: {e}"
+
+    def _cmd_rollup(self, words: list[str]) -> str:
+        """``rollup <interval>:<agg>[:<groupby_agg>] <metric> <ts> <value>
+        <tagk=tagv> [...]`` (ref: RollupDataPointRpc telnet format)"""
+        if len(words) < 6:
+            return "rollup: illegal argument: not enough arguments"
+        try:
+            spec = words[1].split(":")
+            interval: str | None
+            if len(spec) == 1:
+                # pure group-by pre-agg: "sum" alone
+                interval, agg, gb_agg = None, None, spec[0]
+                is_gb = True
+            elif len(spec) == 2:
+                interval, agg, gb_agg = spec[0], spec[1], None
+                is_gb = False
+            else:
+                interval, agg, gb_agg = spec[0], spec[1], spec[2]
+                is_gb = True
+            metric = words[2]
+            ts = int(words[3])
+            value = self._parse_value(words[4])
+            tags = dict(tags_mod.parse(w) for w in words[5:])
+            self.tsdb.add_aggregate_point(metric, ts, value, tags, is_gb,
+                                          interval, agg, gb_agg)
+            return ""
+        except Exception as e:  # noqa: BLE001
+            return f"rollup: {type(e).__name__}: {e}"
+
+    def _cmd_histogram(self, words: list[str]) -> str:
+        """``histogram <metric> <timestamp> <base64-blob> <tagk=tagv>...``
+        (ref: HistogramDataPointRpc)"""
+        if len(words) < 5:
+            return "histogram: illegal argument: not enough arguments"
+        try:
+            metric = words[1]
+            ts = int(words[2])
+            blob = base64.b64decode(words[3])
+            tags = dict(tags_mod.parse(w) for w in words[4:])
+            self.tsdb.add_histogram_point(metric, ts, blob, tags)
+            return ""
+        except Exception as e:  # noqa: BLE001
+            return f"histogram: {type(e).__name__}: {e}"
+
+    def _cmd_stats(self, words: list[str]) -> str:
+        collector = self.tsdb.stats.collect()
+        self.tsdb.collect_stats(collector)
+        return "\n".join(collector.lines())
+
+    def _cmd_version(self, words: list[str]) -> str:
+        info = version_info()
+        return (f"opentsdb_tpu version [{info['version']}] built from "
+                f"revision {info['short_revision']}")
+
+    def _cmd_dropcaches(self, words: list[str]) -> str:
+        self.tsdb.drop_caches()
+        return "Caches dropped."
+
+    def _cmd_help(self, words: list[str]) -> str:
+        return "available commands: " + " ".join(sorted(self.commands))
+
+    def _cmd_exit(self, words: list[str]) -> str:
+        raise TelnetCloseConnection()
+
+    def _cmd_die(self, words: list[str]) -> str:
+        """(ref: RpcManager DieDieDie)"""
+        raise TelnetServerShutdown()

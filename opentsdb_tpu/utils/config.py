@@ -76,6 +76,7 @@ _DEFAULTS: dict[str, str] = {
     "tsd.stats.canonical": "false",
     # TPU-native keys (no reference equivalent)
     "tsd.tpu.dtype": "float32",
+    "tsd.tpu.platform": "",  # force jax platform (cpu|tpu|axon); "" = auto
     "tsd.tpu.mesh.series_axis": "8",
     "tsd.tpu.mesh.time_axis": "1",
     "tsd.tpu.time_block_points": "134217728",  # points per device block

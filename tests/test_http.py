@@ -1,0 +1,324 @@
+"""HTTP API tests (ref: test/tsd/Test*Rpc.java driven via NettyMocks;
+here the router is called directly)."""
+
+import base64
+import json
+
+import pytest
+
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+BASE = 1356998400
+
+
+@pytest.fixture
+def router(tsdb):
+    return HttpRpcRouter(tsdb)
+
+
+@pytest.fixture
+def seeded_router(seeded_tsdb):
+    return HttpRpcRouter(seeded_tsdb)
+
+
+def req(method, path, body=None, **params):
+    return HttpRequest(
+        method=method, path=path,
+        params={k: [str(v)] for k, v in params.items()},
+        body=json.dumps(body).encode() if body is not None else b"")
+
+
+def parse(resp):
+    return json.loads(resp.body) if resp.body else None
+
+
+class TestPut:
+    def test_single_put(self, router):
+        resp = router.handle(req("POST", "/api/put", {
+            "metric": "sys.cpu.user", "timestamp": BASE, "value": 42,
+            "tags": {"host": "web01"}}))
+        assert resp.status == 204
+        assert router.tsdb.store.total_points() == 1
+
+    def test_batch_put_details(self, router):
+        points = [{"metric": "m", "timestamp": BASE + i, "value": i,
+                   "tags": {"host": "a"}} for i in range(10)]
+        points.append({"metric": "bad metric!", "timestamp": BASE,
+                       "value": 1, "tags": {"host": "a"}})
+        resp = router.handle(req("POST", "/api/put", points,
+                                 details="true"))
+        out = parse(resp)
+        assert resp.status == 400
+        assert out["success"] == 10 and out["failed"] == 1
+        assert "error" in out["errors"][0]
+
+    def test_put_summary(self, router):
+        resp = router.handle(req("POST", "/api/put", [
+            {"metric": "m", "timestamp": BASE, "value": 1,
+             "tags": {"h": "a"}}], summary="true"))
+        assert parse(resp) == {"success": 1, "failed": 0}
+
+    def test_put_get_rejected(self, router):
+        resp = router.handle(req("GET", "/api/put"))
+        assert resp.status == 405
+
+    def test_put_string_value(self, router):
+        resp = router.handle(req("POST", "/api/put", {
+            "metric": "m", "timestamp": BASE, "value": "4.5",
+            "tags": {"h": "a"}}))
+        assert resp.status == 204
+
+
+class TestQueryHttp:
+    def test_post_query(self, seeded_router):
+        resp = seeded_router.handle(req("POST", "/api/query", {
+            "start": BASE, "end": BASE + 100,
+            "queries": [{"aggregator": "sum",
+                         "metric": "sys.cpu.user"}]}))
+        out = parse(resp)
+        assert resp.status == 200
+        assert len(out) == 1
+        assert out[0]["metric"] == "sys.cpu.user"
+        assert out[0]["aggregateTags"] == ["host"]
+        assert out[0]["dps"][str(BASE)] == 300
+
+    def test_get_query_uri(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query", start=BASE, end=BASE + 100,
+                m="sum:sys.cpu.user{host=*}"))
+        out = parse(resp)
+        assert len(out) == 2
+        hosts = {o["tags"]["host"] for o in out}
+        assert hosts == {"web01", "web02"}
+
+    def test_query_arrays_param(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query", start=BASE, end=BASE + 30,
+                m="sum:sys.cpu.user", arrays="true"))
+        out = parse(resp)
+        assert isinstance(out[0]["dps"], list)
+        assert out[0]["dps"][0] == [BASE, 300]
+
+    def test_query_no_such_metric_400(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query", start=BASE, m="sum:nope"))
+        assert resp.status == 400
+        assert "error" in parse(resp)
+
+    def test_query_missing_start(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query", m="sum:sys.cpu.user"))
+        assert resp.status == 400
+
+    def test_query_last(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query/last", timeseries="sys.cpu.user",
+                resolve="true"))
+        out = parse(resp)
+        assert resp.status == 200
+        assert len(out) == 2
+        assert out[0]["metric"] == "sys.cpu.user"
+
+    def test_gexp_scale(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query/gexp", start=BASE, end=BASE + 30,
+                exp="scale(sum:sys.cpu.user,2)"))
+        out = parse(resp)
+        assert resp.status == 200
+        assert out[0]["dps"][str(BASE)] == 600
+
+
+class TestSuggest:
+    def test_suggest_metrics(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/suggest", type="metrics", q="sys"))
+        assert parse(resp) == ["sys.cpu.user"]
+
+    def test_suggest_tagv_max(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/suggest", type="tagv", q="", max=1))
+        assert parse(resp) == ["web01"]
+
+    def test_suggest_bad_type(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/suggest", type="bogus"))
+        assert resp.status == 400
+
+    def test_suggest_post(self, seeded_router):
+        resp = seeded_router.handle(req("POST", "/api/suggest", {
+            "type": "tagk", "q": "h"}))
+        assert parse(resp) == ["host"]
+
+
+class TestMonitoring:
+    def test_aggregators(self, router):
+        out = parse(router.handle(req("GET", "/api/aggregators")))
+        assert "sum" in out and "p99" in out and "mimmax" in out
+
+    def test_version(self, router):
+        out = parse(router.handle(req("GET", "/api/version")))
+        assert out["version"] == "0.1.0"
+
+    def test_version_with_api_version_prefix(self, router):
+        out = parse(router.handle(req("GET", "/api/v1/version")))
+        assert out["version"] == "0.1.0"
+
+    def test_config(self, router):
+        out = parse(router.handle(req("GET", "/api/config")))
+        assert out["tsd.network.port"] == "4242"
+
+    def test_config_filters(self, router):
+        out = parse(router.handle(req("GET", "/api/config/filters")))
+        assert "wildcard" in out and "not_key" in out
+        assert "examples" in out["regexp"]
+
+    def test_stats(self, seeded_router):
+        out = parse(seeded_router.handle(req("GET", "/api/stats")))
+        names = {s["metric"] for s in out}
+        assert "tsd.uid.cache-size" in names
+        assert "tsd.storage.series.count" in names
+
+    def test_stats_query(self, router):
+        out = parse(router.handle(req("GET", "/api/stats/query")))
+        assert "running" in out and "completed" in out
+
+    def test_stats_jvm(self, router):
+        out = parse(router.handle(req("GET", "/api/stats/jvm")))
+        assert "runtime" in out
+
+    def test_dropcaches(self, router):
+        out = parse(router.handle(req("GET", "/api/dropcaches")))
+        assert out["status"] == "200"
+
+    def test_404(self, router):
+        resp = router.handle(req("GET", "/api/nonexistent"))
+        assert resp.status == 404
+
+    def test_homepage(self, router):
+        resp = router.handle(req("GET", "/"))
+        assert resp.status == 200
+        assert b"opentsdb-tpu" in resp.body
+
+
+class TestUidEndpoints:
+    def test_assign(self, router):
+        resp = router.handle(req("POST", "/api/uid/assign", {
+            "metric": ["new.metric"], "tagk": ["host"]}))
+        out = parse(resp)
+        assert out["metric"]["new.metric"] == "000001"
+        assert out["tagk"]["host"] == "000001"
+
+    def test_assign_conflict(self, router):
+        router.handle(req("POST", "/api/uid/assign",
+                          {"metric": ["m1"]}))
+        resp = router.handle(req("POST", "/api/uid/assign",
+                                 {"metric": ["m1"]}))
+        out = parse(resp)
+        assert resp.status == 400
+        assert "m1" in out["metric_errors"]
+
+    def test_rename(self, seeded_router):
+        resp = seeded_router.handle(req("POST", "/api/uid/rename", {
+            "metric": "sys.cpu.user", "name": "sys.cpu.renamed"}))
+        assert parse(resp) == {"result": "true"}
+        assert seeded_router.tsdb.uids.metrics.has_name("sys.cpu.renamed")
+
+    def test_uidmeta_get(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/uid/uidmeta", uid="000001", type="metric"))
+        out = parse(resp)
+        assert out["name"] == "sys.cpu.user"
+        assert out["type"] == "METRIC"
+
+
+class TestAnnotationHttp:
+    def test_crud(self, router):
+        resp = router.handle(req("POST", "/api/annotation", {
+            "startTime": BASE, "description": "deploy",
+            "notes": "v1.2"}))
+        assert resp.status == 200
+        resp = router.handle(req("GET", "/api/annotation",
+                                 start_time=BASE))
+        out = parse(resp)
+        assert out["description"] == "deploy"
+        # POST merge keeps old fields
+        resp = router.handle(req("POST", "/api/annotation", {
+            "startTime": BASE, "notes": "v1.3"}))
+        out = parse(resp)
+        assert out["description"] == "deploy" and out["notes"] == "v1.3"
+        resp = router.handle(req("DELETE", "/api/annotation",
+                                 start_time=BASE))
+        assert resp.status == 204
+        resp = router.handle(req("GET", "/api/annotation",
+                                 start_time=BASE))
+        assert resp.status == 404
+
+    def test_global_range(self, router):
+        for t in (BASE, BASE + 100, BASE + 10000):
+            router.handle(req("POST", "/api/annotation",
+                              {"startTime": t, "description": f"e{t}"}))
+        resp = router.handle(req("GET", "/api/annotations",
+                                 start_time=BASE, end_time=BASE + 200))
+        assert len(parse(resp)) == 2
+
+    def test_bulk(self, router):
+        resp = router.handle(req("POST", "/api/annotation/bulk", [
+            {"startTime": BASE + i, "description": f"a{i}"}
+            for i in range(3)]))
+        assert len(parse(resp)) == 3
+
+
+class TestSearchLookup:
+    def test_lookup_by_metric(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/search/lookup", m="sys.cpu.user"))
+        out = parse(resp)
+        assert out["totalResults"] == 2
+        assert out["results"][0]["metric"] == "sys.cpu.user"
+
+    def test_lookup_with_tag(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/search/lookup",
+                m="sys.cpu.user{host=web01}"))
+        out = parse(resp)
+        assert out["totalResults"] == 1
+        assert out["results"][0]["tags"] == {"host": "web01"}
+
+
+class TestHistogramHttp:
+    def test_put_and_percentile_query(self, router):
+        from opentsdb_tpu.core.histogram import (SimpleHistogram,
+                                                 SimpleHistogramCodec)
+        hist = SimpleHistogram([0.0, 10.0, 20.0, 30.0])
+        for v in (1, 5, 12, 15, 25):
+            hist.add(v)
+        blob = SimpleHistogramCodec().encode(hist)
+        resp = router.handle(req("POST", "/api/histogram", {
+            "metric": "latency", "timestamp": BASE,
+            "value": base64.b64encode(blob).decode(),
+            "tags": {"host": "a"}}))
+        assert resp.status == 200
+        resp = router.handle(req("POST", "/api/query", {
+            "start": BASE - 10, "end": BASE + 10,
+            "queries": [{"aggregator": "sum", "metric": "latency",
+                         "percentiles": [50.0]}]}))
+        out = parse(resp)
+        assert resp.status == 200
+        assert out[0]["metric"] == "latency_pct_50"
+
+
+class TestModeGating:
+    def test_readonly_rejects_put(self):
+        from opentsdb_tpu import TSDB, Config
+        ro = HttpRpcRouter(TSDB(Config(**{"tsd.mode": "ro"})))
+        resp = ro.handle(req("POST", "/api/put", {
+            "metric": "m", "timestamp": BASE, "value": 1,
+            "tags": {"h": "a"}}))
+        assert resp.status == 404
+
+    def test_writeonly_rejects_query(self):
+        from opentsdb_tpu import TSDB, Config
+        wo = HttpRpcRouter(TSDB(Config(**{"tsd.mode": "wo"})))
+        resp = wo.handle(req("GET", "/api/query", start=BASE,
+                             m="sum:x"))
+        assert resp.status == 404

@@ -56,7 +56,7 @@ MESHES = [(8, 1), (4, 2), (2, 4), (1, 8)]
 def test_reducible_aggs_match_single_chip(mesh_shape, agg):
     spec = PipelineSpec(num_series=24, num_buckets=40, num_groups=3,
                         ds_function="avg", agg_name=agg)
-    compare(mesh_shape, spec, 24, seed=hash(agg) % 1000)
+    compare(mesh_shape, spec, 24, seed=sum(map(ord, agg)) % 1000)
 
 
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
@@ -65,7 +65,7 @@ def test_reducible_aggs_match_single_chip(mesh_shape, agg):
 def test_gathered_aggs_match_single_chip(mesh_shape, agg):
     spec = PipelineSpec(num_series=16, num_buckets=24, num_groups=2,
                         ds_function="sum", agg_name=agg)
-    compare(mesh_shape, spec, 16, seed=hash(agg) % 1000, points_per=20)
+    compare(mesh_shape, spec, 16, seed=sum(map(ord, agg)) % 1000, points_per=20)
 
 
 @pytest.mark.parametrize("mesh_shape", MESHES)
@@ -109,3 +109,35 @@ def test_uneven_series_count():
     spec = PipelineSpec(num_series=13, num_buckets=17, num_groups=4,
                         ds_function="avg", agg_name="avg")
     compare((8, 1), spec, 13, seed=13, points_per=9)
+
+
+@pytest.mark.parametrize("agg,expected", [("first", 101.0),
+                                          ("last", 108.0),
+                                          ("diff", 7.0)])
+def test_series_order_preserved_across_shards(agg, expected):
+    """Regression: first/last/diff pick by *global* series index.
+
+    With a group of series {1, 8} on an (8,1) mesh, a shard-major
+    gather would put series 8 before series 1 and invert first/last.
+    Constant per-series values 100+s make the selection observable.
+    """
+    num_series, b = 16, 4
+    values, sidx, bidx = [], [], []
+    for s in range(num_series):
+        for bk in range(b):
+            values.append(100.0 + s)
+            sidx.append(s)
+            bidx.append(bk)
+    values = np.asarray(values)
+    sidx = np.asarray(sidx, dtype=np.int32)
+    bidx = np.asarray(bidx, dtype=np.int32)
+    bts = np.arange(b, dtype=np.int64) * 1000
+    group_ids = np.zeros(num_series, dtype=np.int32)
+    group_ids[1] = group_ids[8] = 1
+    spec = PipelineSpec(num_series=num_series, num_buckets=b,
+                        num_groups=2, ds_function="sum", agg_name=agg)
+    mesh = make_mesh(8, 1)
+    batch = prepare_sharded_batch(values, sidx, bidx, bts, group_ids,
+                                  num_series, 2, 8, 1)
+    got, _ = run_sharded(mesh, spec, batch)
+    np.testing.assert_allclose(got[1], expected)

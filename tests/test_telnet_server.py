@@ -1,0 +1,226 @@
+"""Telnet protocol + full server socket tests
+(ref: test/tsd/TestPutRpc telnet cases, TestRpcHandler)."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from opentsdb_tpu.tsd.telnet import (TelnetCloseConnection, TelnetRouter,
+                                     TelnetServerShutdown)
+
+BASE = 1356998400
+
+
+@pytest.fixture
+def telnet(tsdb):
+    return TelnetRouter(tsdb)
+
+
+class TestTelnetCommands:
+    def test_put_silent_success(self, telnet):
+        out = telnet.execute(f"put sys.cpu.user {BASE} 42 host=web01")
+        assert out == ""
+        assert telnet.tsdb.store.total_points() == 1
+
+    def test_put_float(self, telnet):
+        telnet.execute(f"put m {BASE} 4.25 host=a")
+        ts, vals = telnet.tsdb.store.series(0).buffer.view()
+        assert vals[0] == 4.25
+
+    def test_put_errors(self, telnet):
+        assert "not enough arguments" in telnet.execute("put m 123 1")
+        out = telnet.execute(f"put m {BASE} notanumber host=a")
+        assert out.startswith("put:")
+        out = telnet.execute(f"put m {BASE} 1 badtag")
+        assert out.startswith("put:")
+
+    def test_unknown_command(self, telnet):
+        assert "unknown command" in telnet.execute("frobnicate")
+
+    def test_version(self, telnet):
+        assert "opentsdb_tpu version" in telnet.execute("version")
+
+    def test_stats(self, telnet):
+        telnet.execute(f"put m {BASE} 1 host=a")
+        out = telnet.execute("stats")
+        assert "tsd.datapoints.added" in out
+
+    def test_help(self, telnet):
+        out = telnet.execute("help")
+        assert "put" in out and "stats" in out
+
+    def test_dropcaches(self, telnet):
+        assert "dropped" in telnet.execute("dropcaches")
+
+    def test_exit_raises(self, telnet):
+        with pytest.raises(TelnetCloseConnection):
+            telnet.execute("exit")
+
+    def test_diediedie_raises(self, telnet):
+        with pytest.raises(TelnetServerShutdown):
+            telnet.execute("diediedie")
+
+    def test_rollup(self, telnet):
+        out = telnet.execute(f"rollup 1h:sum m {BASE} 99 host=a")
+        assert out == ""
+        assert telnet.tsdb.rollup_store.has_data("1h", "sum")
+
+    def test_histogram(self, telnet):
+        from opentsdb_tpu.core.histogram import (SimpleHistogram,
+                                                 SimpleHistogramCodec)
+        h = SimpleHistogram([0.0, 10.0])
+        h.add(5)
+        blob = base64.b64encode(SimpleHistogramCodec().encode(h)).decode()
+        out = telnet.execute(f"histogram latency {BASE} {blob} host=a")
+        assert out == ""
+
+    def test_readonly_mode_no_put(self):
+        from opentsdb_tpu import TSDB, Config
+        router = TelnetRouter(TSDB(Config(**{"tsd.mode": "ro"})))
+        assert "unknown command" in router.execute(
+            f"put m {BASE} 1 host=a")
+
+
+class TestServerSockets:
+    """End-to-end over real sockets: both protocols on one port
+    (ref: PipelineFactory DetectHttpOrRpc)."""
+
+    @pytest.fixture
+    def server_port(self, tsdb, unused_tcp_port_factory=None):
+        return tsdb, 0
+
+    async def _start(self, tsdb):
+        from opentsdb_tpu.tsd.server import TSDServer
+        server = TSDServer(tsdb, host="127.0.0.1", port=0)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+        return server, port
+
+    def test_telnet_and_http_same_port(self, tsdb):
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                # telnet put + version
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(
+                    f"put sys.cpu.user {BASE} 1 host=web01\n".encode())
+                writer.write(b"version\n")
+                await writer.drain()
+                line = await asyncio.wait_for(reader.readline(), 5)
+                assert b"opentsdb_tpu version" in line
+                writer.write(b"exit\n")
+                await writer.drain()
+                writer.close()
+
+                # HTTP query on the same port
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                body = json.dumps({
+                    "start": BASE - 10, "end": BASE + 10,
+                    "queries": [{"aggregator": "sum",
+                                 "metric": "sys.cpu.user"}]}).encode()
+                writer.write(
+                    b"POST /api/query HTTP/1.1\r\n"
+                    b"Host: localhost\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\nConnection: close\r\n\r\n" + body)
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(), 5)
+                head, _, payload = raw.partition(b"\r\n\r\n")
+                assert b"200 OK" in head
+                out = json.loads(payload)
+                assert out[0]["dps"][str(BASE)] == 1
+                writer.close()
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
+    def test_http_keep_alive(self, tsdb):
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                for _ in range(2):
+                    writer.write(b"GET /api/version HTTP/1.1\r\n"
+                                 b"Host: x\r\n\r\n")
+                    await writer.drain()
+                    head = await asyncio.wait_for(
+                        reader.readuntil(b"\r\n\r\n"), 5)
+                    assert b"200 OK" in head
+                    clen = int([ln for ln in head.split(b"\r\n")
+                                if ln.lower().startswith(b"content-length")
+                                ][0].split(b":")[1])
+                    body = await asyncio.wait_for(
+                        reader.readexactly(clen), 5)
+                    assert json.loads(body)["version"] == "0.1.0"
+                writer.close()
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
+    def test_telnet_batched_lines(self, tsdb):
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                # many puts in one TCP segment
+                payload = "".join(
+                    f"put m {BASE + i} {i} host=a\n"
+                    for i in range(50)).encode()
+                writer.write(payload + b"exit\n")
+                await writer.drain()
+                await asyncio.wait_for(reader.read(), 5)
+                writer.close()
+            finally:
+                await server.stop()
+            assert tsdb.store.total_points() == 50
+
+        asyncio.run(scenario())
+
+
+class TestGexpAndExp:
+    def test_exp_endpoint(self, seeded_tsdb):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        router = HttpRpcRouter(seeded_tsdb)
+        body = {
+            "time": {"start": str(BASE), "end": str(BASE + 30),
+                     "aggregator": "sum"},
+            "filters": [{"id": "f1", "tags": [
+                {"type": "wildcard", "tagk": "host", "filter": "*",
+                 "groupBy": True}]}],
+            "metrics": [{"id": "a", "metric": "sys.cpu.user",
+                         "filter": "f1", "aggregator": "sum"}],
+            "expressions": [{"id": "e1", "expr": "a * 2 + 1"}],
+            "outputs": [{"id": "e1", "alias": "doubled"}],
+        }
+        resp = router.handle(HttpRequest(
+            "POST", "/api/query/exp", {},
+            body=json.dumps(body).encode()))
+        out = json.loads(resp.body)
+        assert resp.status == 200
+        result = out["outputs"][0]
+        assert result["id"] == "e1"
+        assert result["dpsMeta"]["series"] == 2
+        # first row: ts, web01 (0*2+1), web02 (300*2+1)
+        assert result["dps"][0][1:] == [1, 601]
+
+    def test_gexp_sumseries(self, seeded_tsdb):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        router = HttpRpcRouter(seeded_tsdb)
+        resp = router.handle(HttpRequest(
+            "GET", "/api/query/gexp",
+            {"start": [str(BASE)], "end": [str(BASE + 30)],
+             "exp": ["sumSeries(sum:sys.cpu.user,"
+                     "sum:sys.cpu.user)"]}))
+        out = json.loads(resp.body)
+        assert resp.status == 200
+        # each leaf aggregates both hosts (i + 300-i = 300); summed = 600
+        assert out[0]["dps"][str(BASE)] == 600
