@@ -1,0 +1,183 @@
+"""Storage durability: snapshot/restore of the host column store.
+
+The reference delegates durability to HBase's WAL and keeps the TSD
+stateless (SURVEY.md §5.4); this build's analogue is a persistent host
+store directory (``tsd.storage.data_dir``): UID tables as JSON, series
+index + point columns as ``.npy`` blobs, written atomically
+(tmp + rename) on ``flush``/``shutdown`` and loaded on startup. CLI
+tools (import/scan/fsck/uid) operate on the same directory the daemon
+serves from — the moral equivalent of tools talking to the same HBase
+tables.
+
+Snapshots are also the checkpoint/resume story: restart rebuilds
+device arrays lazily from the host store, exactly like the reference
+rebuilds UID caches lazily after a restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def save_store(tsdb, data_dir: str) -> None:
+    os.makedirs(data_dir, exist_ok=True)
+    _save_uids(tsdb.uids, data_dir)
+    _save_timeseries(tsdb.store, os.path.join(data_dir, "data"))
+    if tsdb.rollup_store is not None:
+        for (interval, agg), store in tsdb.rollup_store._tiers.items():
+            _save_timeseries(store, os.path.join(
+                data_dir, f"rollup-{interval}-{agg}"))
+        _save_timeseries(tsdb.rollup_store.preagg_store(),
+                         os.path.join(data_dir, "rollup-preagg"))
+    _save_annotations(tsdb.annotations, data_dir)
+    meta = {"format": _FORMAT_VERSION,
+            "points_written": tsdb.store.points_written}
+    _atomic_write(os.path.join(data_dir, "META.json"),
+                  json.dumps(meta).encode())
+
+
+def load_store(tsdb, data_dir: str) -> bool:
+    """Load a snapshot into a fresh TSDB. Returns False when absent."""
+    if not os.path.isfile(os.path.join(data_dir, "META.json")):
+        return False
+    with open(os.path.join(data_dir, "META.json"), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    if meta.get("format") != _FORMAT_VERSION:
+        raise ValueError(f"unsupported snapshot format {meta.get('format')}")
+    _load_uids(tsdb.uids, data_dir)
+    _load_timeseries(tsdb.store, os.path.join(data_dir, "data"))
+    if tsdb.rollup_store is not None:
+        prefix = "rollup-"
+        for name in os.listdir(data_dir):
+            full = os.path.join(data_dir, name)
+            if not (name.startswith(prefix) and os.path.isdir(full)):
+                continue
+            rest = name[len(prefix):]
+            if rest == "preagg":
+                _load_timeseries(tsdb.rollup_store.preagg_store(), full)
+            else:
+                interval, _, agg = rest.rpartition("-")
+                try:
+                    _load_timeseries(tsdb.rollup_store.tier(interval, agg),
+                                     full)
+                except ValueError:
+                    pass  # tier no longer configured
+    _load_annotations(tsdb.annotations, data_dir)
+    return True
+
+
+# ---------------------------------------------------------------------------
+
+def _atomic_write(path: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _save_uids(uids, data_dir: str) -> None:
+    doc = {}
+    for kind in ("metric", "tagk", "tagv"):
+        registry = uids.by_kind(kind)
+        doc[kind] = {"width": registry.width,
+                     "max_id": registry.max_id(),
+                     "names": dict(registry.items())}
+    _atomic_write(os.path.join(data_dir, "uids.json"),
+                  json.dumps(doc).encode())
+
+
+def _load_uids(uids, data_dir: str) -> None:
+    path = os.path.join(data_dir, "uids.json")
+    if not os.path.isfile(path):
+        return
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    for kind in ("metric", "tagk", "tagv"):
+        registry = uids.by_kind(kind)
+        entry = doc.get(kind, {})
+        with registry._lock:
+            registry._name_to_id = {n: int(i)
+                                    for n, i in entry.get("names",
+                                                          {}).items()}
+            registry._id_to_name = {i: n
+                                    for n, i in
+                                    registry._name_to_id.items()}
+            registry._max_id = int(entry.get("max_id", 0))
+
+
+def _save_timeseries(store, directory: str) -> None:
+    os.makedirs(directory, exist_ok=True)
+    index = []
+    ts_parts, val_parts, int_parts = [], [], []
+    offset = 0
+    for sid in range(store.num_series()):
+        rec = store.series(sid)
+        ts, vals, ints = rec.buffer.view_full()
+        index.append({"metric": rec.metric_id,
+                      "tags": [list(p) for p in rec.tags],
+                      "offset": offset, "count": len(ts)})
+        ts_parts.append(ts.copy())
+        val_parts.append(vals.copy())
+        int_parts.append(ints.copy())
+        offset += len(ts)
+    _atomic_write(os.path.join(directory, "series.json"),
+                  json.dumps(index).encode())
+    all_ts = (np.concatenate(ts_parts) if ts_parts
+              else np.empty(0, np.int64))
+    all_vals = (np.concatenate(val_parts) if val_parts
+                else np.empty(0, np.float64))
+    all_ints = (np.concatenate(int_parts) if int_parts
+                else np.empty(0, bool))
+    with open(os.path.join(directory, "points.npz"), "wb") as fh:
+        np.savez_compressed(fh, ts=all_ts, vals=all_vals, ints=all_ints)
+
+
+def _load_timeseries(store, directory: str) -> None:
+    index_path = os.path.join(directory, "series.json")
+    if not os.path.isfile(index_path):
+        return
+    with open(index_path, encoding="utf-8") as fh:
+        index = json.load(fh)
+    npz = np.load(os.path.join(directory, "points.npz"))
+    all_ts, all_vals, all_ints = npz["ts"], npz["vals"], npz["ints"]
+    for entry in index:
+        sid = store.get_or_create_series(
+            entry["metric"], [tuple(p) for p in entry["tags"]])
+        lo, n = entry["offset"], entry["count"]
+        if n:
+            store.append_many(sid, all_ts[lo:lo + n],
+                              all_vals[lo:lo + n])
+            # restore int-ness flags lost by append_many's default
+            buf = store.series(sid).buffer
+            buf.is_int[buf.n - n:buf.n] = all_ints[lo:lo + n]
+
+
+def _save_annotations(annotations, data_dir: str) -> None:
+    doc = []
+    with annotations._lock:
+        for tsuid, by_time in annotations._by_tsuid.items():
+            for note in by_time.values():
+                doc.append(note.to_json() | {"tsuid": tsuid})
+    _atomic_write(os.path.join(data_dir, "annotations.json"),
+                  json.dumps(doc).encode())
+
+
+def _load_annotations(annotations, data_dir: str) -> None:
+    path = os.path.join(data_dir, "annotations.json")
+    if not os.path.isfile(path):
+        return
+    from opentsdb_tpu.meta.annotation import Annotation
+    with open(path, encoding="utf-8") as fh:
+        for obj in json.load(fh):
+            annotations.store(Annotation.from_json(obj))

@@ -91,6 +91,12 @@ class TSDB:
         self.stats = StatsCollectorRegistry()
         self.datapoints_added = 0
         self.start_time = time.time()
+        # durable snapshots (ref-analogue of HBase-backed persistence;
+        # SURVEY.md §5.4): load on start, save on flush/shutdown
+        self.data_dir = self.config.get_string("tsd.storage.data_dir", "")
+        if self.data_dir:
+            from opentsdb_tpu.core import persist
+            persist.load_store(self, self.data_dir)
 
     # ------------------------------------------------------------------
     # plugins (ref: TSDB.java initializePlugins :390)
@@ -249,7 +255,9 @@ class TSDB:
     # ------------------------------------------------------------------
 
     def flush(self) -> None:
-        pass  # memory backend has nothing buffered
+        if self.data_dir:
+            from opentsdb_tpu.core import persist
+            persist.save_store(self, self.data_dir)
 
     def shutdown(self) -> None:
         self.flush()

@@ -1,0 +1,80 @@
+"""The rollup job: batch pre-aggregation of raw data into tiers.
+
+The reference has NO in-repo rollup compactor — rollups are written by
+external jobs through the TSD API (SURVEY.md §2.3, TSDB.java:1320).
+The TPU build ships one: for every series, the raw points of a time
+range are segment-reduced into each tier's buckets with the same
+bucketize kernel the query path uses (one fused XLA program per
+(tier, aggregator)), then written into the tier stores. This is
+BASELINE.json config 5 ("rollup compaction job: 24h@1s raw -> 1m/1h
+tiers").
+
+Batching: series are processed in chunks so the device working set
+stays bounded (time-blocking is inherited from the chunked
+materialize); all four standard rollup aggregations (sum/count/min/max
+— avg derives as sum/count at query time, ref RollupConfig) compute
+from ONE pass over the points.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.rollup.config import RollupConfig
+
+ROLLUP_AGGS = ("sum", "count", "min", "max")
+
+
+def run_rollup_job(tsdb, start_ms: int, end_ms: int,
+                   intervals: list[str] | None = None,
+                   series_chunk: int = 100_000,
+                   progress=None) -> dict[str, int]:
+    """Materialize rollup tiers for all raw data in [start_ms, end_ms].
+
+    Returns {interval: points_written}.
+    """
+    if tsdb.rollup_store is None:
+        raise RuntimeError("rollups are not enabled")
+    config: RollupConfig = tsdb.rollup_config
+    tiers = ([config.get_interval(iv) for iv in intervals]
+             if intervals else config.intervals)
+    written: dict[str, int] = {iv.interval: 0 for iv in tiers}
+
+    all_sids = np.concatenate(
+        [tsdb.store.series_ids_for_metric(mid)
+         for mid in tsdb.store.metric_ids()]
+        or [np.empty(0, dtype=np.int64)])
+    for lo in range(0, len(all_sids), series_chunk):
+        chunk = all_sids[lo:lo + series_chunk]
+        batch = tsdb.store.materialize(chunk, start_ms, end_ms)
+        if batch.num_points == 0:
+            continue
+        for tier in tiers:
+            spec = ds_mod.DownsamplingSpecification(
+                interval_ms=tier.interval_ms, function="sum")
+            bucket_idx, bucket_ts = ds_mod.assign_buckets(
+                batch.ts_ms, spec, start_ms, end_ms)
+            grids = {}
+            for agg in ROLLUP_AGGS:
+                grid, _ = ds_mod.bucketize(
+                    np.asarray(batch.values), batch.series_idx,
+                    bucket_idx, batch.num_series, len(bucket_ts), agg)
+                grids[agg] = np.asarray(grid)
+            for agg in ROLLUP_AGGS:
+                store = tsdb.rollup_store.tier(tier.interval, agg)
+                grid = grids[agg]
+                for si, sid in enumerate(chunk):
+                    rec = tsdb.store.series(int(sid))
+                    row = grid[si]
+                    mask = ~np.isnan(row)
+                    if not mask.any():
+                        continue
+                    rsid = store.get_or_create_series(rec.metric_id,
+                                                      rec.tags)
+                    store.append_many(rsid, bucket_ts[mask], row[mask])
+                    written[tier.interval] += int(mask.sum())
+        if progress is not None:
+            progress(min(lo + series_chunk, len(all_sids)),
+                     len(all_sids))
+    return written

