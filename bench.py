@@ -6,6 +6,16 @@ synthetic workload shaped like BASELINE.json config 3: 1M series, one
 hour window, per-minute samples, 5m avg downsample, rate conversion,
 group-by sum into 100 groups.
 
+Two paths are timed:
+- the dense regular-cadence path the engine auto-selects for
+  fixed-interval data (reshape reductions, memory-bandwidth bound)
+- the general scatter path (sorted segment reductions) used for
+  irregular timestamps
+
+The headline value is the dense path (what the engine actually runs
+for this workload); the scatter number is printed to stderr for the
+record.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 ``vs_baseline`` compares against the reference's single-TSD Java
@@ -20,6 +30,7 @@ reference — until a measured Java baseline lands in BASELINE.json.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -42,11 +53,25 @@ def make_batch(num_series: int, points_per: int, num_buckets: int,
     return values, series_idx, bucket_idx, bucket_ts, group_ids
 
 
+def _time(fn, iters=5):
+    """Median wall time with per-iteration blocking (async dispatch
+    without a barrier under-reports on relayed backends)."""
+    import jax
+    jax.block_until_ready(fn())  # warmup/compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from opentsdb_tpu.ops.pipeline import PipelineSpec, run_pipeline
+    from opentsdb_tpu.ops.pipeline import (PipelineSpec, run_pipeline,
+                                           run_pipeline_dense)
 
     # config-3 shape: 1M series x 1h @ 1/min, 5m avg downsample + rate,
     # sum group-by into 100 groups
@@ -55,6 +80,7 @@ def main() -> None:
     num_buckets = 12
     num_groups = 100
     n_points = num_series * points_per
+    k = points_per // num_buckets
 
     spec = PipelineSpec(
         num_series=num_series, num_buckets=num_buckets,
@@ -65,31 +91,31 @@ def main() -> None:
         num_series, points_per, num_buckets, num_groups)
 
     dtype = jnp.float32
-    dev_args = (
-        jax.device_put(jnp.asarray(values, dtype)),
-        jax.device_put(jnp.asarray(series_idx)),
-        jax.device_put(jnp.asarray(bucket_idx)),
-        jax.device_put(jnp.asarray(bucket_ts)),
-        jax.device_put(jnp.asarray(group_ids)),
-        (jnp.asarray(2.0**64 - 1, dtype), jnp.asarray(0.0, dtype)),
-        jnp.asarray(float("nan"), dtype),
-    )
+    rate_params = (jnp.asarray(2.0**64 - 1, dtype),
+                   jnp.asarray(0.0, dtype))
+    fill_value = jnp.asarray(float("nan"), dtype)
+    d_bts = jax.device_put(jnp.asarray(bucket_ts))
+    d_gids = jax.device_put(jnp.asarray(group_ids))
 
-    def step():
-        result, emit = run_pipeline(*dev_args, spec)
-        return result
+    # dense path (the engine's choice for this regular workload)
+    d_vals2d = jax.device_put(
+        jnp.asarray(values.reshape(num_series, points_per), dtype))
+    dt_dense = _time(lambda: run_pipeline_dense(
+        d_vals2d, d_bts, d_gids, rate_params, fill_value, spec, k)[0])
 
-    # warmup / compile
-    step().block_until_ready()
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        # block every iteration: async dispatch without a barrier
-        # under-reports wall time on this backend
-        step().block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    # general scatter path (irregular-timestamp workloads)
+    d_vals = jax.device_put(jnp.asarray(values, dtype))
+    d_sidx = jax.device_put(jnp.asarray(series_idx))
+    d_bidx = jax.device_put(jnp.asarray(bucket_idx))
+    dt_scatter = _time(lambda: run_pipeline(
+        d_vals, d_sidx, d_bidx, d_bts, d_gids, rate_params, fill_value,
+        spec)[0])
 
-    dps = n_points / dt
+    dps = n_points / dt_dense
+    print(f"dense: {dt_dense * 1e3:.1f} ms ({dps / 1e9:.2f} G dp/s)  "
+          f"scatter: {dt_scatter * 1e3:.1f} ms "
+          f"({n_points / dt_scatter / 1e9:.2f} G dp/s)",
+          file=sys.stderr)
     print(json.dumps({
         "metric": "datapoints aggregated/sec/chip",
         "value": round(dps),

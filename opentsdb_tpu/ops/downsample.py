@@ -172,33 +172,41 @@ def bucketize(values, series_idx, bucket_idx, num_series: int,
     """
     nseg = num_series * num_buckets
     seg_ids = series_idx.astype(jnp.int32) * num_buckets + bucket_idx
-    cnt = segment.seg_count(values, seg_ids, nseg)
+    # stored NaN values count as missing, like the reference's NaN
+    # skipping in Aggregators.runDouble
+    valid = ~jnp.isnan(values)
+    x0 = jnp.where(valid, values, 0.0)
+    cnt = segment.seg_sum(valid.astype(values.dtype), seg_ids, nseg)
     mask = cnt > 0
 
     if function in ("sum", "zimsum", "pfsum"):
-        out = segment.seg_sum(values, seg_ids, nseg)
+        out = segment.seg_sum(x0, seg_ids, nseg)
     elif function in ("min", "mimmin"):
-        out = segment.seg_min(values, seg_ids, nseg)
+        out = segment.seg_min(jnp.where(valid, values, jnp.inf),
+                              seg_ids, nseg)
     elif function in ("max", "mimmax"):
-        out = segment.seg_max(values, seg_ids, nseg)
+        out = segment.seg_max(jnp.where(valid, values, -jnp.inf),
+                              seg_ids, nseg)
     elif function == "avg":
-        out = segment.seg_sum(values, seg_ids, nseg) / jnp.maximum(cnt, 1)
+        out = segment.seg_sum(x0, seg_ids, nseg) / jnp.maximum(cnt, 1)
     elif function == "count":
         out = cnt.astype(values.dtype)
     elif function == "multiply":
-        out = segment.seg_prod(values, seg_ids, nseg)
+        out = segment.seg_prod(jnp.where(valid, values, 1.0),
+                               seg_ids, nseg)
     elif function == "squareSum":
-        out = segment.seg_sumsq(values, seg_ids, nseg)
+        out = segment.seg_sum(x0 * x0, seg_ids, nseg)
     elif function == "first":
-        out, _ = segment.seg_first_last(values, seg_ids, nseg)
+        out, _ = segment.seg_first_last(values, seg_ids, nseg, valid)
     elif function == "last":
-        _, out = segment.seg_first_last(values, seg_ids, nseg)
+        _, out = segment.seg_first_last(values, seg_ids, nseg, valid)
     elif function == "diff":
-        first, last = segment.seg_first_last(values, seg_ids, nseg)
+        first, last = segment.seg_first_last(values, seg_ids, nseg,
+                                             valid)
         out = jnp.where(cnt == 1, 0.0, last - first)
     elif function == "dev":
-        s1 = segment.seg_sum(values, seg_ids, nseg)
-        s2 = segment.seg_sumsq(values, seg_ids, nseg)
+        s1 = segment.seg_sum(x0, seg_ids, nseg)
+        s2 = segment.seg_sum(x0 * x0, seg_ids, nseg)
         safe = jnp.maximum(cnt, 1)
         mean = s1 / safe
         var = jnp.maximum(s2 / safe - mean * mean, 0.0) * (

@@ -49,19 +49,29 @@ def seg_sumsq(values, seg_ids, num_segments, sorted_ids=True):
                                indices_are_sorted=sorted_ids)
 
 
-def seg_first_last(values, seg_ids, num_segments, sorted_ids=True):
+def seg_first_last(values, seg_ids, num_segments, valid=None,
+                   sorted_ids=True):
     """(first, last) value per segment, relying on within-segment time
-    order of the batch (the store materializes time-sorted points)."""
+    order of the batch (the store materializes time-sorted points).
+    ``valid`` masks out NaN points (they are skipped, not selected)."""
     n = values.shape[0]
     pos = jnp.arange(n, dtype=jnp.int32)
     big = jnp.iinfo(jnp.int32).max
-    first_pos = jax.ops.segment_min(pos, seg_ids, num_segments,
+    if valid is not None:
+        first_cand = jnp.where(valid, pos, big)
+        last_cand = jnp.where(valid, pos, -1)
+    else:
+        first_cand = pos
+        last_cand = pos
+    first_pos = jax.ops.segment_min(first_cand, seg_ids, num_segments,
                                     indices_are_sorted=sorted_ids)
-    last_pos = jax.ops.segment_max(pos, seg_ids, num_segments,
+    last_pos = jax.ops.segment_max(last_cand, seg_ids, num_segments,
                                    indices_are_sorted=sorted_ids)
-    has_any = first_pos != big
-    safe_first = jnp.where(has_any, first_pos, 0)
-    safe_last = jnp.where(has_any, jnp.clip(last_pos, 0, max(n - 1, 0)), 0)
+    has_any = (first_pos != big) & (last_pos >= 0)
+    safe_first = jnp.where(has_any, jnp.clip(first_pos, 0,
+                                             max(n - 1, 0)), 0)
+    safe_last = jnp.where(has_any, jnp.clip(last_pos, 0, max(n - 1, 0)),
+                          0)
     if n == 0:
         z = jnp.zeros((num_segments,), dtype=values.dtype)
         return z, z
@@ -70,17 +80,20 @@ def seg_first_last(values, seg_ids, num_segments, sorted_ids=True):
 
 def segment_sort_ranks(values, seg_ids, num_segments):
     """Sort ``values`` within segments, returning (sorted_values,
-    sorted_seg_ids, segment_starts, segment_counts).
+    sorted_seg_ids, segment_starts, segment_valid_counts).
 
     Lowered as one ``lax.sort`` with (seg_id, value) lexicographic keys —
     the TPU-friendly formulation of per-bucket percentile/median
     downsampling (no ragged loops; one big bitonic sort on the MXU-adjacent
-    sort unit).
+    sort unit). NaN values sort to the end of their segment and are
+    excluded from the valid counts, so rank selection skips them.
     """
     sorted_ids, sorted_vals = jax.lax.sort((seg_ids, values), num_keys=2)
-    counts = jax.ops.segment_sum(jnp.ones_like(seg_ids), seg_ids,
+    valid = (~jnp.isnan(values)).astype(seg_ids.dtype)
+    counts = jax.ops.segment_sum(valid, seg_ids, num_segments)
+    totals = jax.ops.segment_sum(jnp.ones_like(seg_ids), seg_ids,
                                  num_segments)
-    starts = jnp.cumsum(counts) - counts
+    starts = jnp.cumsum(totals) - totals
     return sorted_vals, sorted_ids, starts, counts
 
 

@@ -1,0 +1,127 @@
+"""Dense regular-cadence fast path: must match the scatter path
+exactly on equivalent batches."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops.pipeline import (PipelineSpec, detect_dense,
+                                       execute, run_pipeline)
+from opentsdb_tpu.ops.rate import RateOptions
+
+
+def regular_batch(s=8, b=6, k=5, seed=0, with_nans=False):
+    rng = np.random.default_rng(seed)
+    p = b * k
+    values = rng.normal(100, 10, size=s * p)
+    if with_nans:
+        values[rng.random(s * p) < 0.1] = np.nan
+    series_idx = np.repeat(np.arange(s, dtype=np.int32), p)
+    bucket_idx = np.tile(np.repeat(np.arange(b, dtype=np.int32), k), s)
+    bucket_ts = np.arange(b, dtype=np.int64) * 60_000
+    return values, series_idx, bucket_idx, bucket_ts
+
+
+class TestDetect:
+    def test_detects_regular(self):
+        v, si, bi, _ = regular_batch()
+        assert detect_dense(8, 6, si, bi, "avg") == 5
+
+    def test_rejects_irregular_series(self):
+        v, si, bi, _ = regular_batch()
+        si = si.copy()
+        si[3] = 5  # out of order
+        assert detect_dense(8, 6, si, bi, "avg") is None
+
+    def test_rejects_uneven_buckets(self):
+        v, si, bi, _ = regular_batch()
+        bi = bi.copy()
+        bi[0] = 1
+        assert detect_dense(8, 6, si, bi, "avg") is None
+
+    def test_rejects_wrong_count(self):
+        v, si, bi, _ = regular_batch()
+        assert detect_dense(8, 6, si[:-1], bi[:-1], "avg") is None
+
+    def test_rejects_unsupported_fn(self):
+        v, si, bi, _ = regular_batch()
+        assert detect_dense(8, 6, si, bi, "p95") is None
+
+
+def scatter_reference(values, si, bi, bts, gids, spec, ro=None):
+    """Force the scatter path regardless of detection."""
+    import jax.numpy as jnp
+    import jax
+    dtype = jnp.float64
+    ro = ro or RateOptions()
+    rate_params = (jnp.asarray(ro.counter_max, dtype),
+                   jnp.asarray(ro.reset_value, dtype))
+    r, e = run_pipeline(jnp.asarray(values, dtype),
+                        jnp.asarray(si), jnp.asarray(bi),
+                        jnp.asarray(bts), jnp.asarray(gids),
+                        rate_params,
+                        jnp.asarray(spec.fill_value, dtype), spec)
+    return np.asarray(r), np.asarray(e)
+
+
+@pytest.mark.parametrize("fn", ["sum", "avg", "min", "max", "count",
+                                "first", "last"])
+@pytest.mark.parametrize("agg", ["sum", "avg", "max"])
+def test_dense_matches_scatter(fn, agg):
+    v, si, bi, bts = regular_batch(seed=hash((fn, agg)) % 100)
+    gids = (np.arange(8) % 3).astype(np.int32)
+    spec = PipelineSpec(num_series=8, num_buckets=6, num_groups=3,
+                        ds_function=fn, agg_name=agg)
+    ref, ref_e = scatter_reference(v, si, bi, bts, gids, spec)
+    got, got_e = execute(v, si, bi, bts, gids, spec)  # auto-dense
+    np.testing.assert_allclose(got, ref, rtol=1e-12, equal_nan=True)
+    np.testing.assert_array_equal(got_e, ref_e)
+
+
+def test_dense_with_nan_values():
+    """Stored NaN values act as missing points in BOTH paths, matching
+    the reference's NaN skipping (Aggregators.runDouble)."""
+    v, si, bi, bts = regular_batch(with_nans=True, seed=5)
+    gids = np.zeros(8, dtype=np.int32)
+    spec = PipelineSpec(num_series=8, num_buckets=6, num_groups=1,
+                        ds_function="avg", agg_name="sum")
+    got, _ = execute(v, si, bi, bts, gids, spec)
+    ref, _ = scatter_reference(v, si, bi, bts, gids, spec)
+    np.testing.assert_allclose(got, ref, rtol=1e-12, equal_nan=True)
+    v2 = v.reshape(8, 30)
+    expected = np.zeros(6)
+    for b in range(6):
+        seg = v2[:, b * 5:(b + 1) * 5]
+        per_series = np.array(
+            [np.nanmean(s) if np.any(~np.isnan(s)) else np.nan
+             for s in seg])
+        expected[b] = np.nansum(per_series)
+    np.testing.assert_allclose(got[0], expected, rtol=1e-12)
+
+
+@pytest.mark.parametrize("fn", ["min", "max", "first", "last", "dev",
+                                "median", "p95", "multiply", "diff"])
+def test_scatter_nan_skipping(fn):
+    """Every downsample fn skips stored-NaN points in the scatter path."""
+    from opentsdb_tpu.ops.downsample import bucketize
+    vals = np.array([1.0, np.nan, 3.0, np.nan])
+    si = np.zeros(4, dtype=np.int32)
+    bi = np.zeros(4, dtype=np.int32)
+    grid, cnt = bucketize(vals, si, bi, 1, 1, fn)
+    grid = np.asarray(grid)
+    assert np.asarray(cnt)[0, 0] == 2  # valid (non-NaN) points only
+    expected = {"min": 1.0, "max": 3.0, "first": 1.0, "last": 3.0,
+                "dev": np.std([1.0, 3.0], ddof=1), "median": 3.0,
+                "p95": 3.0, "multiply": 3.0, "diff": 2.0}[fn]
+    np.testing.assert_allclose(grid[0, 0], expected, rtol=1e-12)
+
+
+def test_dense_rate():
+    v, si, bi, bts = regular_batch(seed=9)
+    gids = np.zeros(8, dtype=np.int32)
+    spec = PipelineSpec(num_series=8, num_buckets=6, num_groups=1,
+                        ds_function="avg", agg_name="sum", rate=True)
+    ref, ref_e = scatter_reference(v, si, bi, bts, gids, spec,
+                                   RateOptions())
+    got, got_e = execute(v, si, bi, bts, gids, spec, RateOptions())
+    np.testing.assert_allclose(got, ref, rtol=1e-12, equal_nan=True)
+    np.testing.assert_array_equal(got_e, ref_e)
