@@ -51,7 +51,9 @@ class TSDB:
             tagv_width=self.config.get_int("tsd.storage.uid.width.tagv", 3),
             random_metrics=self.config.get_bool(
                 "tsd.core.uid.random_metrics"))
-        self.store = TimeSeriesStore(num_shards=const.salt_buckets())
+        from opentsdb_tpu.native.store_backend import make_store
+        self.store = make_store(self.config,
+                                num_shards=const.salt_buckets())
         self.mode = self.config.get_string("tsd.mode", "rw")
         self.auto_metric = self.config.get_bool("tsd.core.auto_create_metrics")
         self.auto_tagk = self.config.get_bool("tsd.core.auto_create_tagks",

@@ -1,0 +1,282 @@
+"""ctypes bindings for the native C++ column store.
+
+Drop-in storage backend (``tsd.storage.backend = native``): same
+interface as :class:`opentsdb_tpu.core.store.TimeSeriesStore`, with
+point columns living in the C++ arena (``tsdbstore.cc``) and series
+identity / tag indexing staying in Python (they need UID strings
+anyway). Built on demand with g++; transparently falls back to the
+Python backend when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from opentsdb_tpu.core import const
+from opentsdb_tpu.core.store import MetricIndex, PointBatch
+
+_SRC = os.path.join(os.path.dirname(__file__), "tsdbstore.cc")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libtsdbstore.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(force: bool = False) -> str:
+    """Compile libtsdbstore.so if needed; returns its path."""
+    if not force and os.path.isfile(_LIB_PATH) and \
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        return _LIB_PATH
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=180)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"g++ unavailable: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(f"native build failed:\n{proc.stderr}")
+    return _LIB_PATH
+
+
+def load_library():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build_library()
+        lib = ctypes.CDLL(path)
+        lib.tss_create.restype = ctypes.c_void_p
+        lib.tss_destroy.argtypes = [ctypes.c_void_p]
+        lib.tss_add_series.argtypes = [ctypes.c_void_p]
+        lib.tss_add_series.restype = ctypes.c_int64
+        lib.tss_series_count.argtypes = [ctypes.c_void_p]
+        lib.tss_series_count.restype = ctypes.c_int64
+        lib.tss_append.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.c_int64, ctypes.c_double,
+                                   ctypes.c_int]
+        lib.tss_append_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.tss_points_written.argtypes = [ctypes.c_void_p]
+        lib.tss_points_written.restype = ctypes.c_int64
+        lib.tss_series_length.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int64]
+        lib.tss_series_length.restype = ctypes.c_int64
+        lib.tss_read_series.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.tss_count_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int]
+        lib.tss_fill_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+class _NativeSeriesView:
+    """Buffer-compatible facade over one native series (read side)."""
+
+    def __init__(self, store: "NativeTimeSeriesStore", sid: int):
+        self._store = store
+        self._sid = sid
+
+    def view(self):
+        ts, vals, _ = self.view_full()
+        return ts, vals
+
+    def view_full(self):
+        lib = self._store._lib
+        n = lib.tss_series_length(self._store._h, self._sid)
+        ts = np.empty(n, dtype=np.int64)
+        vals = np.empty(n, dtype=np.float64)
+        ints = np.empty(n, dtype=np.uint8)
+        if n:
+            lib.tss_read_series(self._store._h, self._sid, _ptr(ts),
+                                _ptr(vals), _ptr(ints))
+        return ts, vals, ints.astype(bool)
+
+    def slice_range(self, start_ms: int, end_ms: int):
+        ts, vals = self.view()
+        lo = np.searchsorted(ts, start_ms, side="left")
+        hi = np.searchsorted(ts, end_ms, side="right")
+        return ts[lo:hi], vals[lo:hi]
+
+    def __len__(self):
+        return int(self._store._lib.tss_series_length(self._store._h,
+                                                      self._sid))
+
+
+class _NativeSeriesRecord:
+    __slots__ = ("series_id", "metric_id", "tags", "shard", "buffer")
+
+    def __init__(self, series_id, metric_id, tags, shard, buffer):
+        self.series_id = series_id
+        self.metric_id = metric_id
+        self.tags = tags
+        self.shard = shard
+        self.buffer = buffer
+
+
+class NativeTimeSeriesStore:
+    """C++-backed TimeSeriesStore (same duck-typed interface)."""
+
+    def __init__(self, num_shards: int | None = None,
+                 materialize_threads: int | None = None):
+        self._lib = load_library()
+        self._h = ctypes.c_void_p(self._lib.tss_create())
+        self.num_shards = num_shards or const.salt_buckets()
+        self.threads = materialize_threads or min(
+            16, os.cpu_count() or 4)
+        self._lock = threading.Lock()
+        self._records: list[_NativeSeriesRecord] = []
+        self._key_to_sid: dict[tuple, int] = {}
+        self._metric_index: dict[int, MetricIndex] = {}
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.tss_destroy(self._h)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- write path ---------------------------------------------------
+
+    def get_or_create_series(self, metric_id: int,
+                             tags: Sequence[tuple[int, int]]) -> int:
+        key = (metric_id, tuple(sorted(tags)))
+        sid = self._key_to_sid.get(key)
+        if sid is not None:
+            return sid
+        with self._lock:
+            sid = self._key_to_sid.get(key)
+            if sid is not None:
+                return sid
+            native_sid = self._lib.tss_add_series(self._h)
+            assert native_sid == len(self._records)
+            shard = hash((metric_id, key[1])) % self.num_shards
+            rec = _NativeSeriesRecord(
+                native_sid, metric_id, key[1], shard,
+                _NativeSeriesView(self, native_sid))
+            self._records.append(rec)
+            idx = self._metric_index.get(metric_id)
+            if idx is None:
+                idx = self._metric_index[metric_id] = MetricIndex(
+                    metric_id)
+            idx.add(native_sid, key[1])
+            self._key_to_sid[key] = native_sid
+            return native_sid
+
+    def append(self, series_id: int, ts_ms: int, value: float,
+               is_int: bool = False) -> None:
+        rc = self._lib.tss_append(self._h, series_id, ts_ms, value,
+                                  int(is_int))
+        if rc != 0:
+            raise IndexError(f"no such series {series_id}")
+
+    def append_many(self, series_id: int, ts_ms, values,
+                    is_int=False) -> None:
+        ts = np.ascontiguousarray(ts_ms, dtype=np.int64)
+        vals = np.ascontiguousarray(values, dtype=np.float64)
+        if isinstance(is_int, np.ndarray):
+            ints = np.ascontiguousarray(is_int, dtype=np.uint8)
+        else:
+            ints = np.full(len(ts), int(bool(is_int)), dtype=np.uint8)
+        rc = self._lib.tss_append_many(self._h, series_id, len(ts),
+                                       _ptr(ts), _ptr(vals), _ptr(ints))
+        if rc != 0:
+            raise IndexError(f"no such series {series_id}")
+
+    # -- read path ----------------------------------------------------
+
+    @property
+    def points_written(self) -> int:
+        return int(self._lib.tss_points_written(self._h))
+
+    def series(self, series_id: int) -> _NativeSeriesRecord:
+        return self._records[series_id]
+
+    def num_series(self) -> int:
+        return len(self._records)
+
+    def metric_ids(self) -> list[int]:
+        with self._lock:
+            return list(self._metric_index)
+
+    def metric_index(self, metric_id: int) -> MetricIndex | None:
+        return self._metric_index.get(metric_id)
+
+    def series_ids_for_metric(self, metric_id: int) -> np.ndarray:
+        idx = self._metric_index.get(metric_id)
+        if idx is None:
+            return np.empty(0, dtype=np.int64)
+        sids, _ = idx.arrays()
+        return sids
+
+    def materialize(self, series_ids: Sequence[int], start_ms: int,
+                    end_ms: int) -> PointBatch:
+        sids = np.ascontiguousarray(series_ids, dtype=np.int64)
+        counts = np.empty(len(sids), dtype=np.int64)
+        rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
+                                       start_ms, end_ms, _ptr(counts),
+                                       self.threads)
+        if rc != 0:
+            raise IndexError("invalid series id in materialize")
+        offsets = np.zeros(len(sids), dtype=np.int64)
+        np.cumsum(counts[:-1], out=offsets[1:]) if len(sids) > 1 else None
+        total = int(counts.sum())
+        ts_out = np.empty(total, dtype=np.int64)
+        vals_out = np.empty(total, dtype=np.float64)
+        sidx_out = np.empty(total, dtype=np.int32)
+        if total:
+            self._lib.tss_fill_range(
+                self._h, _ptr(sids), len(sids), start_ms, end_ms,
+                _ptr(offsets), _ptr(ts_out), _ptr(vals_out),
+                _ptr(sidx_out), self.threads)
+        return PointBatch(sids, sidx_out, ts_out, vals_out)
+
+    def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
+        return np.asarray([self._records[s].shard for s in series_ids],
+                          dtype=np.int32)
+
+    def total_points(self) -> int:
+        return sum(int(self._lib.tss_series_length(self._h, sid))
+                   for sid in range(len(self._records)))
+
+    def collect_stats(self, collector) -> None:
+        collector.record("storage.series.count", self.num_series())
+        collector.record("storage.points.written", self.points_written)
+        collector.record("storage.shards", self.num_shards)
+        collector.record("storage.backend", 1, backend="native")
+
+
+def make_store(config, num_shards: int | None = None):
+    """Storage backend factory honoring ``tsd.storage.backend``."""
+    backend = config.get_string("tsd.storage.backend", "memory")
+    if backend == "native":
+        try:
+            return NativeTimeSeriesStore(num_shards=num_shards)
+        except NativeBuildError as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "native store unavailable (%s); using memory backend", e)
+    from opentsdb_tpu.core.store import TimeSeriesStore
+    return TimeSeriesStore(num_shards=num_shards)

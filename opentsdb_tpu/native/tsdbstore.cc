@@ -1,0 +1,253 @@
+// Native host column store for opentsdb_tpu.
+//
+// The storage-engine role the reference delegates to HBase region
+// servers + the asynchbase client (SURVEY.md L0): append-optimized
+// per-series column buffers with lazy sort/dedupe and a parallel
+// range-materialize that fills flat (series_idx, ts, value) arrays
+// ready for device upload. The Python MemoryBackend is the portable
+// twin; this engine removes the per-series Python loop from the
+// query path (ref analogue: SaltScanner's 20-way parallel scan,
+// src/core/SaltScanner.java:70 — here a thread pool over series).
+//
+// C ABI (ctypes-friendly), no exceptions across the boundary.
+// Build: g++ -O3 -march=native -shared -fPIC -std=c++17 -pthread
+//        tsdbstore.cc -o libtsdbstore.so
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SeriesBuffer {
+  std::vector<int64_t> ts;
+  std::vector<double> vals;
+  std::vector<uint8_t> is_int;
+  bool sorted = true;
+  std::mutex mu;
+
+  void append(int64_t t, double v, uint8_t ii) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (sorted && !ts.empty() && t <= ts.back()) sorted = false;
+    ts.push_back(t);
+    vals.push_back(v);
+    is_int.push_back(ii);
+  }
+
+  void append_many(int64_t n, const int64_t* t, const double* v,
+                   const uint8_t* ii) {
+    std::lock_guard<std::mutex> lock(mu);
+    for (int64_t i = 0; i < n; ++i) {
+      if (sorted && !ts.empty() && t[i] <= ts.back()) sorted = false;
+      ts.push_back(t[i]);
+      vals.push_back(v[i]);
+      is_int.push_back(ii ? ii[i] : 0);
+    }
+  }
+
+  // Sort by timestamp, last-write-wins dedupe (matches the Python
+  // SeriesBuffer and the reference's fix_duplicates semantics).
+  void ensure_sorted_locked() {
+    if (sorted) return;
+    const size_t n = ts.size();
+    std::vector<uint32_t> order(n);
+    for (size_t i = 0; i < n; ++i) order[i] = (uint32_t)i;
+    std::stable_sort(order.begin(), order.end(),
+                     [&](uint32_t a, uint32_t b) { return ts[a] < ts[b]; });
+    std::vector<int64_t> nts;
+    std::vector<double> nvals;
+    std::vector<uint8_t> nint;
+    nts.reserve(n);
+    nvals.reserve(n);
+    nint.reserve(n);
+    for (size_t i = 0; i < n; ++i) {
+      uint32_t idx = order[i];
+      if (!nts.empty() && nts.back() == ts[idx]) {
+        nvals.back() = vals[idx];  // last write wins
+        nint.back() = is_int[idx];
+      } else {
+        nts.push_back(ts[idx]);
+        nvals.push_back(vals[idx]);
+        nint.push_back(is_int[idx]);
+      }
+    }
+    ts.swap(nts);
+    vals.swap(nvals);
+    is_int.swap(nint);
+    sorted = true;
+  }
+
+  // [lo, hi] inclusive range bounds after sorting.
+  void range_bounds(int64_t start_ms, int64_t end_ms, int64_t* lo,
+                    int64_t* hi) {
+    std::lock_guard<std::mutex> lock(mu);
+    ensure_sorted_locked();
+    *lo = std::lower_bound(ts.begin(), ts.end(), start_ms) - ts.begin();
+    *hi = std::upper_bound(ts.begin(), ts.end(), end_ms) - ts.begin();
+  }
+};
+
+struct Store {
+  std::vector<SeriesBuffer*> series;
+  std::mutex create_mu;
+  std::atomic<int64_t> points_written{0};
+
+  ~Store() {
+    for (auto* s : series) delete s;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tss_create() { return new Store(); }
+
+void tss_destroy(void* h) { delete static_cast<Store*>(h); }
+
+// Returns the new series id. Series identity (metric+tags -> sid) is
+// managed by the Python wrapper; this just allocates the buffer.
+int64_t tss_add_series(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->create_mu);
+  s->series.push_back(new SeriesBuffer());
+  return (int64_t)s->series.size() - 1;
+}
+
+int64_t tss_series_count(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->create_mu);
+  return (int64_t)s->series.size();
+}
+
+int tss_append(void* h, int64_t sid, int64_t ts_ms, double value,
+               int is_int) {
+  Store* s = static_cast<Store*>(h);
+  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
+  s->series[sid]->append(ts_ms, value, (uint8_t)is_int);
+  s->points_written.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+int tss_append_many(void* h, int64_t sid, int64_t n, const int64_t* ts,
+                    const double* vals, const uint8_t* is_int) {
+  Store* s = static_cast<Store*>(h);
+  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
+  s->series[sid]->append_many(n, ts, vals, is_int);
+  s->points_written.fetch_add(n, std::memory_order_relaxed);
+  return 0;
+}
+
+int64_t tss_points_written(void* h) {
+  return static_cast<Store*>(h)->points_written.load();
+}
+
+int64_t tss_series_length(void* h, int64_t sid) {
+  Store* s = static_cast<Store*>(h);
+  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
+  SeriesBuffer* buf = s->series[sid];
+  std::lock_guard<std::mutex> lock(buf->mu);
+  buf->ensure_sorted_locked();
+  return (int64_t)buf->ts.size();
+}
+
+// Copy one series' sorted columns into caller-provided arrays sized by
+// a prior tss_series_length call.
+int tss_read_series(void* h, int64_t sid, int64_t* ts_out,
+                    double* vals_out, uint8_t* int_out) {
+  Store* s = static_cast<Store*>(h);
+  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
+  SeriesBuffer* buf = s->series[sid];
+  std::lock_guard<std::mutex> lock(buf->mu);
+  buf->ensure_sorted_locked();
+  const size_t n = buf->ts.size();
+  if (n) {
+    std::memcpy(ts_out, buf->ts.data(), n * sizeof(int64_t));
+    std::memcpy(vals_out, buf->vals.data(), n * sizeof(double));
+    if (int_out) std::memcpy(int_out, buf->is_int.data(), n);
+  }
+  return 0;
+}
+
+// Phase 1 of materialize: per-series point counts within
+// [start_ms, end_ms] (inclusive). Parallel over a thread pool — the
+// reference's per-salt-bucket scanner fan-out.
+int tss_count_range(void* h, const int64_t* sids, int64_t nsids,
+                    int64_t start_ms, int64_t end_ms,
+                    int64_t* counts_out, int threads) {
+  Store* s = static_cast<Store*>(h);
+  if (threads < 1) threads = 1;
+  std::atomic<int64_t> next{0};
+  std::atomic<int> err{0};
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= nsids) break;
+      int64_t sid = sids[i];
+      if (sid < 0 || sid >= (int64_t)s->series.size()) {
+        err.store(1);
+        counts_out[i] = 0;
+        continue;
+      }
+      int64_t lo, hi;
+      s->series[sid]->range_bounds(start_ms, end_ms, &lo, &hi);
+      counts_out[i] = hi - lo;
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  return err.load() ? -1 : 0;
+}
+
+// Phase 2: fill flat output arrays. offsets_out[i] must hold the
+// exclusive prefix sum of counts from phase 1; series_idx_out gets the
+// *dense* position i (0..nsids-1), matching PointBatch.
+int tss_fill_range(void* h, const int64_t* sids, int64_t nsids,
+                   int64_t start_ms, int64_t end_ms,
+                   const int64_t* offsets, int64_t* ts_out,
+                   double* vals_out, int32_t* series_idx_out,
+                   int threads) {
+  Store* s = static_cast<Store*>(h);
+  if (threads < 1) threads = 1;
+  std::atomic<int64_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= nsids) break;
+      int64_t sid = sids[i];
+      if (sid < 0 || sid >= (int64_t)s->series.size()) continue;
+      SeriesBuffer* buf = s->series[sid];
+      std::lock_guard<std::mutex> lock(buf->mu);
+      buf->ensure_sorted_locked();
+      int64_t lo =
+          std::lower_bound(buf->ts.begin(), buf->ts.end(), start_ms) -
+          buf->ts.begin();
+      int64_t hi =
+          std::upper_bound(buf->ts.begin(), buf->ts.end(), end_ms) -
+          buf->ts.begin();
+      int64_t off = offsets[i];
+      int64_t n = hi - lo;
+      if (n > 0) {
+        std::memcpy(ts_out + off, buf->ts.data() + lo,
+                    n * sizeof(int64_t));
+        std::memcpy(vals_out + off, buf->vals.data() + lo,
+                    n * sizeof(double));
+        std::fill(series_idx_out + off, series_idx_out + off + n,
+                  (int32_t)i);
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -96,11 +96,19 @@ def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
                 report.error(f"{name}: unresolvable tagv UID {vid}")
 
         buf = rec.buffer
-        with buf.lock:
-            n = buf.n
-            raw_ts = buf.ts[:n].copy()
-            raw_vals = buf.vals[:n].copy()
-            was_sorted = buf._sorted
+        native = not hasattr(buf, "lock")
+        if native:
+            # native buffers sort/dedupe internally; inspect the
+            # canonical view (order/dupe violations are unobservable)
+            raw_ts, raw_vals, _ = buf.view_full()
+            n = len(raw_ts)
+            was_sorted = True
+        else:
+            with buf.lock:
+                n = buf.n
+                raw_ts = buf.ts[:n].copy()
+                raw_vals = buf.vals[:n].copy()
+                was_sorted = buf._sorted
         report.points_checked += n
         if n == 0:
             continue
@@ -122,8 +130,8 @@ def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
         bad_vals = int(np.sum(~np.isfinite(raw_vals)))
         if bad_vals:
             report.error(f"{name}: {bad_vals} non-finite value(s)",
-                         fixed=fix)
-            if fix:
+                         fixed=fix and not native)
+            if fix and not native:
                 with buf.lock:
                     m = buf.n
                     keep = np.isfinite(buf.vals[:m])
@@ -136,8 +144,8 @@ def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
         bad_ts = int(np.sum((raw_ts <= 0) | (raw_ts > MAX_VALID_MS)))
         if bad_ts:
             report.error(f"{name}: {bad_ts} timestamp(s) out of range",
-                         fixed=fix)
-            if fix:
+                         fixed=fix and not native)
+            if fix and not native:
                 with buf.lock:
                     m = buf.n
                     keep = (buf.ts[:m] > 0) & (buf.ts[:m] <= MAX_VALID_MS)
