@@ -66,6 +66,7 @@ class TSDB:
         self.search_plugin = None
         self.storage_exception_handler = None
         self.write_filters: list[Callable[..., bool]] = []
+        self.uid_filter = None
         self.meta_cache = None
         self.authentication = None
         # rollups (ref: TSDB.java:170-185)
@@ -109,13 +110,28 @@ class TSDB:
         cfg = self.config
         if cfg.get_bool("tsd.core.plugins.enable", False) or True:
             self.rt_publisher = load_plugin_instances(
-                cfg, "tsd.rtpublisher", single=True)
+                cfg, "tsd.rtpublisher", single=True, init_arg=self)
             self.search_plugin = load_plugin_instances(
-                cfg, "tsd.search", single=True)
+                cfg, "tsd.search", single=True, init_arg=self)
             self.storage_exception_handler = load_plugin_instances(
-                cfg, "tsd.core.storage_exception_handler", single=True)
-            self.write_filters = load_plugin_instances(
-                cfg, "tsd.core.write_filter") or []
+                cfg, "tsd.core.storage_exception_handler", single=True,
+                init_arg=self)
+            raw_filters = load_plugin_instances(
+                cfg, "tsd.core.write_filter", init_arg=self) or []
+            # honor the filter's opt-out gate
+            # (ref: WriteableDataPointFilterPlugin.filterDataPoints)
+            self.write_filters = [
+                f for f in raw_filters
+                if not hasattr(f, "filter_data_points")
+                or f.filter_data_points()]
+            # UID auto-assignment gate (ref: UniqueIdFilterPlugin,
+            # TSDB.java uid_filter slot)
+            self.uid_filter = load_plugin_instances(
+                cfg, "tsd.uid.filter", single=True, init_arg=self)
+            # external TSMeta counter cache (ref: MetaDataCache,
+            # TSDB.java:158)
+            self.meta_cache = load_plugin_instances(
+                cfg, "tsd.core.meta.cache", single=True, init_arg=self)
         if cfg.get_bool("tsd.core.authentication.enable"):
             from opentsdb_tpu.auth.simple import SimpleAuthentication
             self.authentication = SimpleAuthentication(cfg)
@@ -137,19 +153,26 @@ class TSDB:
         is_int = isinstance(value, int) and not isinstance(value, bool)
         fval = float(value)
         for filt in self.write_filters:
-            if not filt(metric, timestamp, value, tags):
+            allow = getattr(filt, "allow_data_point", filt)
+            if not allow(metric, timestamp, value, tags):
                 return -1
         metric_id, tag_ids = self._resolve_write_uids(metric, tags)
         sid = self.store.get_or_create_series(metric_id, tag_ids)
         ts_ms = codec.to_ms(timestamp)
         self.store.append(sid, ts_ms, fval, is_int)
         self.datapoints_added += 1
-        if self.meta is not None:
+        tsuid = (self.uids.tsuid(metric_id, tag_ids)
+                 if self.meta_cache is not None
+                 or self.rt_publisher is not None else None)
+        if self.meta_cache is not None:
+            # external counter service replaces built-in tracking
+            # (ref: TSDB.java:1225-1245 meta_cache branch)
+            self.meta_cache.increment_and_get_counter(tsuid)
+        elif self.meta is not None:
             self.meta.on_datapoint(metric_id, tag_ids, sid)
         if self.rt_publisher is not None:
             self.rt_publisher.publish_data_point(
-                metric, timestamp, value, tags,
-                self.uids.tsuid(metric_id, tag_ids))
+                metric, timestamp, value, tags, tsuid)
         return sid
 
     def _check_timestamp(self, timestamp: int) -> None:
@@ -161,17 +184,35 @@ class TSDB:
 
     def _resolve_write_uids(self, metric: str, tags: dict[str, str]
                             ) -> tuple[int, list[tuple[int, int]]]:
-        from opentsdb_tpu.core.uid import NoSuchUniqueName
-        if self.auto_metric:
-            metric_id = self.uids.metrics.get_or_create_id(metric)
-        else:
-            metric_id = self.uids.metrics.get_id(metric)  # may raise
+        from opentsdb_tpu.core.uid import (FailedToAssignUniqueIdError,
+                                           NoSuchUniqueName)
+
+        def create_allowed(kind: str, name: str) -> bool:
+            # ref: UniqueIdFilterPlugin.allowUIDAssignment consulted
+            # before any new UID is minted (UniqueId.getOrCreateIdAsync)
+            if self.uid_filter is None:
+                return True
+            return self.uid_filter.allow_uid_assignment(
+                kind, name, metric, tags)
+
+        def resolve(registry, kind: str, name: str, auto: bool) -> int:
+            if not auto:
+                return registry.get_id(name)  # may raise
+            try:
+                return registry.get_id(name)
+            except NoSuchUniqueName:
+                if not create_allowed(kind, name):
+                    raise FailedToAssignUniqueIdError(
+                        f"UID filter rejected assignment of {kind} "
+                        f"{name!r}") from None
+                return registry.get_or_create_id(name)
+
+        metric_id = resolve(self.uids.metrics, "metric", metric,
+                            self.auto_metric)
         tag_ids = []
         for k, v in tags.items():
-            kid = (self.uids.tag_names.get_or_create_id(k) if self.auto_tagk
-                   else self.uids.tag_names.get_id(k))
-            vid = (self.uids.tag_values.get_or_create_id(v) if self.auto_tagv
-                   else self.uids.tag_values.get_id(v))
+            kid = resolve(self.uids.tag_names, "tagk", k, self.auto_tagk)
+            vid = resolve(self.uids.tag_values, "tagv", v, self.auto_tagv)
             tag_ids.append((kid, vid))
         return metric_id, tag_ids
 

@@ -83,7 +83,7 @@ def load_library():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int]
+            ctypes.c_void_p, ctypes.c_int]
         _lib = lib
         return lib
 
@@ -249,8 +249,8 @@ class NativeTimeSeriesStore:
         if total:
             self._lib.tss_fill_range(
                 self._h, _ptr(sids), len(sids), start_ms, end_ms,
-                _ptr(offsets), _ptr(ts_out), _ptr(vals_out),
-                _ptr(sidx_out), self.threads)
+                _ptr(offsets), _ptr(counts), _ptr(ts_out),
+                _ptr(vals_out), _ptr(sidx_out), self.threads)
         return PointBatch(sids, sidx_out, ts_out, vals_out)
 
     def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:

@@ -17,6 +17,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -205,14 +206,17 @@ int tss_count_range(void* h, const int64_t* sids, int64_t nsids,
   return err.load() ? -1 : 0;
 }
 
-// Phase 2: fill flat output arrays. offsets_out[i] must hold the
-// exclusive prefix sum of counts from phase 1; series_idx_out gets the
-// *dense* position i (0..nsids-1), matching PointBatch.
+// Phase 2: fill flat output arrays. offsets[i] must hold the exclusive
+// prefix sum of the phase-1 counts and counts[i] the phase-1 count
+// itself: the copy is capped at counts[i] so appends that land between
+// the two phases can never overflow the caller's buffers (they are
+// picked up by the next query). series_idx_out gets the *dense*
+// position i (0..nsids-1), matching PointBatch.
 int tss_fill_range(void* h, const int64_t* sids, int64_t nsids,
                    int64_t start_ms, int64_t end_ms,
-                   const int64_t* offsets, int64_t* ts_out,
-                   double* vals_out, int32_t* series_idx_out,
-                   int threads) {
+                   const int64_t* offsets, const int64_t* counts,
+                   int64_t* ts_out, double* vals_out,
+                   int32_t* series_idx_out, int threads) {
   Store* s = static_cast<Store*>(h);
   if (threads < 1) threads = 1;
   std::atomic<int64_t> next{0};
@@ -233,6 +237,7 @@ int tss_fill_range(void* h, const int64_t* sids, int64_t nsids,
           buf->ts.begin();
       int64_t off = offsets[i];
       int64_t n = hi - lo;
+      if (n > counts[i]) n = counts[i];
       if (n > 0) {
         std::memcpy(ts_out + off, buf->ts.data() + lo,
                     n * sizeof(int64_t));
@@ -240,6 +245,13 @@ int tss_fill_range(void* h, const int64_t* sids, int64_t nsids,
                     n * sizeof(double));
         std::fill(series_idx_out + off, series_idx_out + off + n,
                   (int32_t)i);
+      }
+      // fewer points than counted (concurrent repair/delete): pad the
+      // remainder with NaN placeholders the compute path skips
+      for (int64_t j = n < 0 ? 0 : n; j < counts[i]; ++j) {
+        ts_out[off + j] = start_ms;
+        vals_out[off + j] = std::numeric_limits<double>::quiet_NaN();
+        series_idx_out[off + j] = (int32_t)i;
       }
     }
   };

@@ -228,6 +228,13 @@ AVG = _register(Aggregator("avg", Interpolation.LERP, agg_avg))
 MEDIAN = _register(Aggregator("median", Interpolation.LERP, agg_median))
 NONE = _register(Aggregator("none", Interpolation.ZIM, _agg_none))
 MULTIPLY = _register(Aggregator("multiply", Interpolation.LERP, agg_multiply))
+# the query-facing registry name is "mult" (Aggregators.java:183 puts
+# MULTIPLY under "mult"; its display name is "multiply")
+_REGISTRY["mult"] = MULTIPLY
+# MovingAverage (Aggregators.java:709) is NOT in the reference registry
+# either — it is only reachable through the movingAverage() expression
+# function (ExpressionFactory.java:36), provided here by
+# opentsdb_tpu.query.expression.core.
 DEV = _register(Aggregator("dev", Interpolation.LERP, agg_dev))
 DIFF = _register(Aggregator("diff", Interpolation.LERP, agg_diff))
 ZIMSUM = _register(Aggregator("zimsum", Interpolation.ZIM, agg_sum))

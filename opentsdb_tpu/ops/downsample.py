@@ -90,6 +90,8 @@ class DownsamplingSpecification:
                     fill_value = 0.0
         if not aggs_mod.exists(function):
             raise ValueError(f"No such downsampling function: {function}")
+        # canonicalize registry aliases ("mult" -> "multiply")
+        function = aggs_mod.get(function).name
         if interval_str in ("0all", "all"):
             return cls(interval_ms=0, function=function,
                        fill_policy=fill_policy, fill_value=fill_value,

@@ -1,0 +1,222 @@
+"""Pallas TPU kernel: the fused dense query pipeline in ONE pass.
+
+The XLA dense path (:func:`opentsdb_tpu.ops.pipeline.run_pipeline_dense`)
+compiles to a reshape-reduction followed by ``jax.ops.segment_sum`` for
+the group stage. On TPU the segment reduction lowers to a scatter-add —
+a serialized, VPU-hostile op. This kernel replaces the whole chain
+(downsample -> rate -> group reduce) with a single ``pallas_call`` in
+which EVERY reduction is a matmul on the **MXU** (the systolic array):
+
+- downsample: ``x[TILE_S, P] @ A[P, B]`` where ``A`` is the
+  host-precomputed bucket-membership matrix (1 or 1/k per cell; one-hot
+  columns for first/last);
+- rate: the first-difference operator is linear, so its shift matrix
+  ``R`` (I with -1 superdiagonal) and the 1/dt scaling are folded into
+  ``A`` / a per-bucket ``scale`` row on the host — no in-kernel shifts;
+- group-by: ``onehot(group_ids)[G, TILE_S] @ grid[TILE_S, B]``
+  accumulated across series tiles (one-hot segment-reduction-as-matmul).
+
+The ``[S, P]`` value matrix is streamed HBM -> VMEM one series tile at a
+time — a single full pass over the data, everything else rides the MXU.
+
+Scope: used for *complete* regular-cadence data (no NaN holes) — the
+monitoring-data common case and the benchmark shape (BASELINE.json
+configs). With no holes, merge interpolation
+(AggregationIterator.java:27-119) is a no-op, so the kernel is
+numerically identical to the general path; the caller
+(:func:`opentsdb_tpu.ops.pipeline.execute`) verifies completeness and
+falls back otherwise. Golden tests: ``tests/test_pallas_fused.py``.
+
+On non-TPU backends the kernel runs in interpreter mode so the CPU test
+matrix exercises the same code path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+# downsample functions expressible as a matmul against a membership
+# matrix on complete data (min/max need order statistics -> XLA path)
+_DS_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "count", "first",
+                     "last"))
+# group aggregators expressible as an accumulated matmul
+_AGG_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "count",
+                      "squareSum"))
+
+_VMEM_BUDGET = 6 * 1024 * 1024  # per-tile VMEM budget for the value block
+
+
+def supported(spec, dtype) -> bool:
+    """Can the kernel run this (ds_function, agg, rate) combination?"""
+    if spec.ds_function not in _DS_FNS or spec.agg_name not in _AGG_FNS:
+        return False
+    if spec.emit_raw:
+        return False
+    if spec.rate and (spec.rate_counter or spec.rate_drop_resets):
+        return False
+    if jnp.dtype(dtype) == jnp.float64 and \
+            jax.default_backend() == "tpu":
+        return False  # MXU has no f64
+    return True
+
+
+def _tile_s(s: int, p: int, itemsize: int) -> int:
+    tile = 256
+    while tile > 8 and tile * p * itemsize > _VMEM_BUDGET:
+        tile //= 2
+    return max(8, min(tile, -(-s // 8) * 8))
+
+
+def _build_operators(spec, k: int, bucket_ts: np.ndarray, dtype):
+    """Host-side: fold downsample + rate + dt scaling into
+    (A [P, B], scale [1, B], bias [1, B])."""
+    b = spec.num_buckets
+    p = b * k
+    fn = spec.ds_function
+    m = np.zeros((p, b), dtype=dtype)
+    bias = np.zeros((1, b), dtype=dtype)
+    cols = np.arange(b)
+    if fn in ("sum", "zimsum", "pfsum"):
+        for j in range(b):
+            m[j * k:(j + 1) * k, j] = 1.0
+    elif fn == "avg":
+        for j in range(b):
+            m[j * k:(j + 1) * k, j] = 1.0 / k
+    elif fn == "first":
+        m[cols * k, cols] = 1.0
+    elif fn == "last":
+        m[cols * k + k - 1, cols] = 1.0
+    elif fn == "count":
+        bias[0, :] = float(k)  # complete data: every bucket holds k pts
+    else:  # pragma: no cover - guarded by supported()
+        raise ValueError(fn)
+    scale = np.ones((1, b), dtype=dtype)
+    if spec.rate:
+        # rate[b] = (ds[b] - ds[b-1]) / dt[b]: fold the difference
+        # operator R (I with -1 on the superdiagonal) into A and the
+        # 1/dt into scale; scale[0]=0 stands in for the dropped first
+        # bucket (finalizer turns it into NaN / ZIM-zero).
+        r = np.eye(b, dtype=np.float64)
+        r[cols[:-1], cols[1:]] = -1.0
+        ts = np.asarray(bucket_ts, dtype=np.float64)
+        dt = np.ones(b, dtype=np.float64)
+        if b > 1:
+            d = (ts[1:] - ts[:-1]) / 1000.0  # ms -> s (RateSpan dv/dt)
+            d[d <= 0] = 1.0  # _rate_kernel clamps non-positive dt
+            dt[1:] = d
+        inv = 1.0 / dt
+        inv[0] = 0.0
+        m = (m.astype(np.float64) @ r * inv[None, :]).astype(dtype)
+        bias = (bias.astype(np.float64) @ r * inv[None, :]).astype(dtype)
+        scale = scale  # already folded into m/bias
+    return m, scale, bias
+
+
+def _kernel(vals_ref, gid_ref, a_ref, scale_ref, bias_ref, acc_ref, *,
+            g: int, square: bool):
+    """One series tile: (x @ A) * scale + bias, then one-hot matmul."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    tile_s = vals_ref.shape[0]
+    t = jnp.dot(vals_ref[:], a_ref[:],
+                preferred_element_type=acc_ref.dtype)
+    t = t * scale_ref[:] + bias_ref[:]
+    if square:
+        t = t * t
+    # one-hot [G, TILE_S]: padded rows carry gid -1 -> all-zero columns
+    gid = gid_ref[:].reshape(1, tile_s)
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (g, tile_s), 0)
+              == gid).astype(t.dtype)
+    acc_ref[:] += jnp.dot(onehot, t,
+                          preferred_element_type=acc_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("spec", "tile_s", "interpret"))
+def _run(values2d, group_ids_padded, a_mat, scale, bias, group_sizes,
+         spec, tile_s: int, interpret: bool):
+    s_pad, p = values2d.shape
+    b, g = spec.num_buckets, spec.num_groups
+    dtype = values2d.dtype
+    kern = partial(_kernel, g=g, square=(spec.agg_name == "squareSum"))
+    acc = pl.pallas_call(
+        kern,
+        grid=(s_pad // tile_s,),
+        in_specs=[
+            pl.BlockSpec((tile_s, p), lambda i: (i, 0)),
+            pl.BlockSpec((tile_s, 1), lambda i: (i, 0)),
+            pl.BlockSpec((p, b), lambda i: (0, 0)),
+            pl.BlockSpec((1, b), lambda i: (0, 0)),
+            pl.BlockSpec((1, b), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((g, b), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((g, b), dtype),
+        interpret=interpret,
+    )(values2d, group_ids_padded, a_mat, scale, bias)
+
+    # finalize [G,B] (cheap; stays in the same jit program)
+    sizes = group_sizes[:, None].astype(dtype)  # [G,1] series per group
+    full_cnt = jnp.broadcast_to(sizes, (g, b))
+    cnt = full_cnt
+    if spec.rate:
+        cnt = cnt.at[:, 0].set(0.0)
+    agg = spec.agg_name
+    # ZIM-interpolation aggregators (Aggregators.java:92-113) fill every
+    # hole — including the rate-dropped first bucket — with a *valid* 0,
+    # so their effective count never drops.
+    zim = agg in ("zimsum", "count", "squareSum")
+    eff_cnt = full_cnt if zim else cnt
+    if agg in ("sum", "zimsum", "pfsum", "squareSum"):
+        out = acc
+    elif agg == "avg":
+        out = acc / jnp.maximum(eff_cnt, 1.0)
+    elif agg == "count":
+        out = eff_cnt
+    else:  # pragma: no cover - guarded by supported()
+        raise ValueError(agg)
+    any_valid = eff_cnt > 0
+    result = jnp.where(any_valid, out, jnp.nan)
+    from opentsdb_tpu.ops import downsample as ds_mod
+    if spec.fill_policy == ds_mod.FillPolicy.NONE:
+        # emission follows pre-fill presence (has_data in
+        # _finish_pipeline): the rate-dropped bucket never emits even
+        # for ZIM aggregators
+        emit = cnt > 0
+    else:
+        emit = jnp.ones((g, b), dtype=bool)
+    return result, emit
+
+
+def fused_dense_pipeline(values2d: np.ndarray, bucket_ts: np.ndarray,
+                         group_ids: np.ndarray, spec, k: int,
+                         dtype=jnp.float32, device=None):
+    """Host entry mirroring :func:`pipeline.run_pipeline_dense` for
+    complete data. values2d [S, P] (no NaN), bucket_ts [B] ms,
+    group_ids [S] -> (result [G,B] np, emit [G,B] np)."""
+    np_dtype = np.dtype(dtype)
+    s, p = values2d.shape
+    tile_s = _tile_s(s, p, np_dtype.itemsize)
+    s_pad = -(-s // tile_s) * tile_s
+    vals = np.zeros((s_pad, p), dtype=np_dtype)
+    vals[:s] = values2d
+    gids = np.full((s_pad, 1), -1, dtype=np.int32)
+    gids[:s, 0] = group_ids
+    a_mat, scale, bias = _build_operators(spec, k, bucket_ts, np_dtype)
+    sizes = np.bincount(group_ids, minlength=spec.num_groups) \
+        .astype(np.int32)
+    put = partial(jax.device_put, device=device)
+    interpret = jax.default_backend() != "tpu"
+    result, emit = _run(put(jnp.asarray(vals)), put(jnp.asarray(gids)),
+                        put(jnp.asarray(a_mat)), put(jnp.asarray(scale)),
+                        put(jnp.asarray(bias)), put(jnp.asarray(sizes)),
+                        spec, tile_s, interpret)
+    return np.asarray(result), np.asarray(emit)

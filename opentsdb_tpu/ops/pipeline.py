@@ -181,11 +181,14 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
             bucket_idx: np.ndarray, bucket_ts: np.ndarray,
             group_ids: np.ndarray, spec: PipelineSpec,
             rate_options: RateOptions | None = None,
-            dtype=None, device=None) -> tuple[np.ndarray, np.ndarray]:
+            dtype=None, device=None,
+            use_pallas: bool = True) -> tuple[np.ndarray, np.ndarray]:
     """Host entry: upload, run, download. Returns (result, emit_mask).
 
     Automatically takes the dense reshape path when the batch is
-    regular-cadence (see :func:`detect_dense`)."""
+    regular-cadence (see :func:`detect_dense`), and within it the
+    fused Pallas kernel (:mod:`opentsdb_tpu.ops.pallas_fused`) when the
+    data is complete and the op combination is MXU-reducible."""
     if dtype is None:
         dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
             else jnp.float32
@@ -199,6 +202,14 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
                      spec.ds_function)
     if k is not None:
         values2d = np.asarray(batch_values).reshape(spec.num_series, -1)
+        if use_pallas and not (ro.counter or ro.drop_resets):
+            from opentsdb_tpu.ops import pallas_fused
+            if pallas_fused.supported(spec, dtype) \
+                    and not np.isnan(values2d).any():
+                return pallas_fused.fused_dense_pipeline(
+                    values2d, np.asarray(bucket_ts),
+                    np.asarray(group_ids), spec, k, dtype=dtype,
+                    device=device)
         result, emit = run_pipeline_dense(
             put(jnp.asarray(values2d, dtype=dtype)),
             put(jnp.asarray(bucket_ts)),

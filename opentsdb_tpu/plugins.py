@@ -1,0 +1,199 @@
+"""The 12 plugin extension points of the TSD (ref: SURVEY.md §2.4,
+``src/tsd/RTPublisher.java:39``, ``StorageExceptionHandler.java:31``,
+``RpcPlugin.java:36``, ``HttpRpcPlugin.java:40``,
+``HttpSerializer.java:93``, ``src/core/WriteableDataPointFilterPlugin``,
+``src/uid/UniqueIdFilterPlugin``, ``src/tsd/MetaDataCache.java:29``,
+``src/tools/StartupPlugin``, ``src/search/SearchPlugin.java:51``,
+``src/auth/Authentication.java:36``, ``HistogramDataPointCodec``).
+
+All plugins load through :mod:`opentsdb_tpu.utils.plugin` (dotted-path
+classes in config, the ServiceLoader analogue) and share the reference
+ABI lifecycle: no-arg construction, ``initialize(tsdb)``, ``shutdown()``,
+``version()``, ``collect_stats(collector)``.
+
+The histogram codec ABI lives in :mod:`opentsdb_tpu.core.histogram`
+(HistogramCodec) and the auth ABI in :mod:`opentsdb_tpu.auth.simple`;
+the other ten are defined here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Plugin:
+    """Shared lifecycle (every reference plugin ABI repeats these)."""
+
+    def initialize(self, tsdb) -> None:  # noqa: B027
+        """Called once after construction; raise to abort startup
+        (ref: each ABI's initialize contract)."""
+
+    def shutdown(self) -> None:  # noqa: B027
+        """Graceful shutdown hook."""
+
+    def version(self) -> str:
+        return "2.4.0"
+
+    def collect_stats(self, collector) -> None:  # noqa: B027
+        """Report plugin metrics into /api/stats."""
+
+
+class RTPublisher(Plugin):
+    """Real-time datapoint fan-out (ref: RTPublisher.java:39): every
+    stored point / annotation is offered to the publisher (e.g. to feed
+    a message bus). Failures must not block the write path."""
+
+    def publish_data_point(self, metric: str, timestamp: int,
+                           value, tags: dict[str, str],
+                           tsuid: str) -> None:
+        raise NotImplementedError
+
+    def publish_aggregate_point(self, metric: str, timestamp: int,
+                                value, tags: dict[str, str],
+                                tsuid: str) -> None:  # noqa: B027
+        """Rollup points (ref: RTPublisher.publishAggregatePoint)."""
+
+    def publish_histogram_point(self, metric: str, timestamp: int,
+                                raw_data: bytes,
+                                tags: dict[str, str],
+                                tsuid: str) -> None:  # noqa: B027
+        pass
+
+    def publish_annotation(self, annotation) -> None:  # noqa: B027
+        pass
+
+
+class StorageExceptionHandler(Plugin):
+    """Requeue datapoints dropped by storage errors
+    (ref: StorageExceptionHandler.java:31 handleError)."""
+
+    def handle_error(self, datapoint: dict, error: Exception) -> None:
+        raise NotImplementedError
+
+
+class WriteableDataPointFilterPlugin(Plugin):
+    """Gate/mutate incoming datapoints before storage
+    (ref: src/core/WriteableDataPointFilterPlugin.java;
+    TSDB.java:1262 allowDataPoint call site)."""
+
+    def filter_data_points(self) -> bool:
+        """Whether this filter wants the per-point callback."""
+        return True
+
+    def allow_data_point(self, metric: str, timestamp: int, value,
+                         tags: dict[str, str]) -> bool:
+        raise NotImplementedError
+
+
+class UniqueIdFilterPlugin(Plugin):
+    """Gate UID auto-assignment (ref: src/uid/UniqueIdFilterPlugin.java):
+    called before a never-seen metric/tagk/tagv is given a UID."""
+
+    def fill_uid_cache(self) -> bool:
+        return True
+
+    def allow_uid_assignment(self, kind: str, value: str, metric: str,
+                             tags: dict[str, str] | None) -> bool:
+        raise NotImplementedError
+
+
+class UniqueIdWhitelistFilter(UniqueIdFilterPlugin):
+    """Regex whitelist implementation
+    (ref: src/uid/UniqueIdWhitelistFilter.java:37): comma-separated
+    patterns per UID kind; a value must match at least one pattern."""
+
+    def initialize(self, tsdb) -> None:
+        import re
+        cfg = tsdb.config if hasattr(tsdb, "config") else tsdb
+        self._patterns = {}
+        for kind, key in (("metric", "tsd.uidfilter.metric_patterns"),
+                          ("tagk", "tsd.uidfilter.tagk_patterns"),
+                          ("tagv", "tsd.uidfilter.tagv_patterns")):
+            raw = cfg.get_string(key, "")
+            self._patterns[kind] = [re.compile(p.strip())
+                                    for p in raw.split(",") if p.strip()]
+
+    def allow_uid_assignment(self, kind: str, value: str, metric: str,
+                             tags: dict[str, str] | None) -> bool:
+        pats = self._patterns.get(kind) or []
+        if not pats:
+            return True
+        return any(p.search(value) for p in pats)
+
+
+class MetaDataCache(Plugin):
+    """External TSMeta counter/cache service bridge
+    (ref: src/tsd/MetaDataCache.java:29); called on every write instead
+    of the built-in meta tracking when configured."""
+
+    def increment_and_get_counter(self, tsuid: str) -> None:
+        raise NotImplementedError
+
+
+class StartupPlugin(Plugin):
+    """Hooks around daemon boot (ref: src/tools/StartupPlugin.java;
+    TSDMain.java:251): initialize(config) runs before the TSDB exists,
+    set_ready(tsdb) once the server socket is bound."""
+
+    def initialize(self, config) -> None:  # noqa: B027
+        pass
+
+    def set_ready(self, tsdb) -> None:  # noqa: B027
+        pass
+
+
+class RpcPlugin(Plugin):
+    """Arbitrary protocol servers sharing the TSD process
+    (ref: RpcPlugin.java:36) — e.g. a kafka consumer. Started after the
+    main server binds, stopped at shutdown."""
+
+
+class HttpRpcPlugin(Plugin):
+    """Extra HTTP endpoints under /plugin/<path>
+    (ref: HttpRpcPlugin.java:40, RpcManager tsd.http.rpc.plugins)."""
+
+    def path(self) -> str:
+        """Route under /plugin/ this handler owns."""
+        raise NotImplementedError
+
+    def execute(self, tsdb, request) -> Any:
+        """Return an HttpResponse for the request."""
+        raise NotImplementedError
+
+
+class SearchPlugin(Plugin):
+    """External index bridge (ref: SearchPlugin.java:51): receives
+    TSMeta/UIDMeta/annotation upserts and deletes, answers
+    /api/search queries, and may rewrite queries (resolveTSQuery)."""
+
+    def index_ts_meta(self, meta) -> None:  # noqa: B027
+        pass
+
+    def delete_ts_meta(self, tsuid: str) -> None:  # noqa: B027
+        pass
+
+    def index_uid_meta(self, meta) -> None:  # noqa: B027
+        pass
+
+    def delete_uid_meta(self, meta) -> None:  # noqa: B027
+        pass
+
+    def index_annotation(self, note) -> None:  # noqa: B027
+        pass
+
+    def delete_annotation(self, note) -> None:  # noqa: B027
+        pass
+
+    def execute_query(self, query_type: str, query: dict) -> dict:
+        raise NotImplementedError
+
+    def resolve_ts_query(self, ts_query):
+        """Optionally rewrite a TSQuery (ref: resolveTSQuery :152)."""
+        return ts_query
+
+
+class HttpSerializerPlugin:
+    """Alternate wire formats (ref: HttpSerializer.java:93). Subclass
+    :class:`opentsdb_tpu.tsd.json_serializer.HttpJsonSerializer` and
+    override the parse_*/format_* pairs; select with
+    ``tsd.http.serializer.plugin``."""

@@ -86,9 +86,18 @@ def cmd_tsd(config: Config, args: list[str]) -> int:
     import signal
 
     from opentsdb_tpu.tsd.server import TSDServer
+    from opentsdb_tpu.utils.plugin import load_plugin_instances
+
+    # StartupPlugin.initialize runs before the TSDB exists
+    # (ref: TSDMain.java:251)
+    startup = load_plugin_instances(config, "tsd.startup", single=True)
     tsdb = make_tsdb(config)
     tsdb.initialize_plugins()
     server = TSDServer(tsdb)
+    # protocol plugins sharing the process (ref: RpcPlugin.java:36,
+    # RpcManager tsd.rpc.plugins)
+    rpc_plugins = load_plugin_instances(config, "tsd.rpc",
+                                        init_arg=tsdb) or []
 
     async def main():
         loop = asyncio.get_event_loop()
@@ -97,9 +106,17 @@ def cmd_tsd(config: Config, args: list[str]) -> int:
                 loop.add_signal_handler(sig, server.request_shutdown)
             except NotImplementedError:
                 pass
+        await server.start()
+        if startup is not None:
+            # server socket is bound (ref: StartupPlugin.setReady)
+            startup.set_ready(tsdb)
         await server.serve_forever()
 
     asyncio.run(main())
+    for plugin in rpc_plugins:
+        plugin.shutdown()
+    if startup is not None:
+        startup.shutdown()
     return 0
 
 

@@ -80,7 +80,14 @@ class HttpRpcRouter:
 
     def __init__(self, tsdb):
         self.tsdb = tsdb
-        self.serializer = HttpJsonSerializer()
+        # pluggable wire format (ref: HttpSerializer.java:93,
+        # tsd.http.serializer selection in RpcManager)
+        ser_path = tsdb.config.get_string("tsd.http.serializer.plugin", "")
+        if ser_path:
+            from opentsdb_tpu.utils.plugin import load_class
+            self.serializer = load_class(ser_path)()
+        else:
+            self.serializer = HttpJsonSerializer()
         mode = tsdb.mode
         self._routes: dict[str, Callable] = {}
         # read RPCs (not registered in write-only mode, RpcManager:274)
@@ -107,6 +114,13 @@ class HttpRpcRouter:
             "version": self._handle_version,
         })
         self.plugin_routes: dict[str, Callable] = {}
+        # /plugin/<path> HTTP endpoints (ref: HttpRpcPlugin.java:40,
+        # RpcManager tsd.http.rpc.plugins :153)
+        self.http_rpc_plugins: dict[str, Any] = {}
+        from opentsdb_tpu.utils.plugin import load_plugin_instances
+        for plugin in load_plugin_instances(tsdb.config, "tsd.http.rpc",
+                                            init_arg=tsdb) or []:
+            self.http_rpc_plugins[plugin.path().strip("/")] = plugin
         self.start_time = time.time()
 
     # ------------------------------------------------------------------
@@ -153,6 +167,13 @@ class HttpRpcRouter:
             return self._handle_static(request, parts[1:])
         elif parts[0] == "logs":
             return self._handle_logs(request)
+        elif parts[0] == "plugin":
+            key = "/".join(parts[1:])
+            plugin = self.http_rpc_plugins.get(key)
+            if plugin is None:
+                raise HttpError(404, f"No HTTP RPC plugin at /{path}",
+                                "The requested endpoint was not found")
+            return plugin.execute(self.tsdb, request)
         elif parts[0] in ("aggregators", "version", "suggest", "stats",
                           "dropcaches"):
             # legacy unversioned aliases (ref: RpcManager deprecated map)
@@ -197,6 +218,17 @@ class HttpRpcRouter:
                                "error": f"missing field: {e}"})
             except Exception as e:  # noqa: BLE001
                 errors.append({"datapoint": dp, "error": str(e)})
+                seh = self.tsdb.storage_exception_handler
+                from opentsdb_tpu.core.uid import \
+                    FailedToAssignUniqueIdError
+                if seh is not None and not isinstance(
+                        e, (ValueError, LookupError,
+                            FailedToAssignUniqueIdError)):
+                    # spool only storage-layer failures for replay; a
+                    # bad datapoint (unknown UID, filter veto, bad
+                    # value) fails identically on every retry
+                    # (ref: PutDataPointRpc requeue via SEH plugin)
+                    seh.handle_error(dp, e)
         failed = len(errors)
         if not details and not summary:
             if failed:
@@ -402,12 +434,17 @@ class HttpRpcRouter:
                 merged_custom.update(note.custom)
                 note.custom = merged_custom
             store.store(note)
+            if self.tsdb.search_plugin is not None:
+                self.tsdb.search_plugin.index_annotation(note)
             return HttpResponse(200, self.serializer.format_annotation(note))
         if request.method == "DELETE":
             tsuid = (request.param("tsuid", "") or "").upper()
             start = int(request.param("start_time", "0"))
-            if not store.delete(tsuid, start):
+            note = store.get(tsuid, start)
+            if note is None or not store.delete(tsuid, start):
                 raise HttpError(404, "Unable to locate annotation in storage")
+            if self.tsdb.search_plugin is not None:
+                self.tsdb.search_plugin.delete_annotation(note)
             return HttpResponse(204)
         raise HttpError(405, "Method not allowed")
 

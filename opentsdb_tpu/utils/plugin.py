@@ -32,20 +32,31 @@ def load_class(dotted_path: str) -> type:
             f"module {module_name!r} has no class {class_name!r}") from None
 
 
-def load_plugin_instances(config, prefix: str, single: bool = False) -> Any:
+_MISSING = object()
+
+
+def load_plugin_instances(config, prefix: str, single: bool = False,
+                          init_arg: Any = _MISSING) -> Any:
     """Load plugins configured at ``<prefix>.plugin`` when
-    ``<prefix>.enable`` is true. Returns an instance, a list, or None."""
+    ``<prefix>.enable`` is true. Returns an instance, a list, or None.
+
+    ``initialize`` is called exactly once per instance with
+    ``init_arg`` — the TSDB for the 11 runtime ABIs, the Config for
+    StartupPlugin (which runs before the TSDB exists,
+    ref: TSDMain.java:251). Defaults to the config for callers that
+    have no TSDB yet."""
     if not config.get_bool(f"{prefix}.enable", False):
         return None if single else []
     spec = config.get_string(f"{prefix}.plugin", "")
     if not spec:
         return None if single else []
+    target = config if init_arg is _MISSING else init_arg
     instances = []
     for path in spec.split(","):
         cls = load_class(path.strip())
         inst = cls()
         if hasattr(inst, "initialize"):
-            inst.initialize(config)
+            inst.initialize(target)
         instances.append(inst)
     if single:
         return instances[0] if instances else None

@@ -1,0 +1,165 @@
+"""Plugin ABI tests (ref strategy: test/tsd/Dummy{RTPublisher,
+RpcPlugin,HttpRpcPlugin,HttpSerializer,SEHPlugin}.java +
+test/plugin/DummyPluginA/B loaded through PluginLoader)."""
+
+import json
+
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.plugins import (HttpRpcPlugin, RTPublisher,
+                                  StorageExceptionHandler,
+                                  UniqueIdWhitelistFilter,
+                                  WriteableDataPointFilterPlugin,
+                                  MetaDataCache)
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+
+
+# -- dummy plugin implementations (loaded by dotted path) --------------
+
+class DummyRTPublisher(RTPublisher):
+    published: list = []
+
+    def publish_data_point(self, metric, timestamp, value, tags, tsuid):
+        DummyRTPublisher.published.append((metric, timestamp, value,
+                                           tags, tsuid))
+
+
+class DummyWriteFilter(WriteableDataPointFilterPlugin):
+    def allow_data_point(self, metric, timestamp, value, tags):
+        return not metric.startswith("blocked.")
+
+
+class DummySEH(StorageExceptionHandler):
+    errors: list = []
+
+    def handle_error(self, datapoint, error):
+        DummySEH.errors.append((datapoint, error))
+
+
+class DummyHttpRpcPlugin(HttpRpcPlugin):
+    def path(self):
+        return "dummy"
+
+    def execute(self, tsdb, request):
+        from opentsdb_tpu.tsd.http_api import HttpResponse
+        return HttpResponse(200, b'{"hello":"plugin"}')
+
+
+class DummySerializer(HttpJsonSerializer):
+    def format_version(self, info):
+        info = dict(info)
+        info["serializer"] = "dummy"
+        return json.dumps(info).encode()
+
+
+class DummyMetaCache(MetaDataCache):
+    counters: dict = {}
+
+    def increment_and_get_counter(self, tsuid):
+        DummyMetaCache.counters[tsuid] = \
+            DummyMetaCache.counters.get(tsuid, 0) + 1
+
+
+def _tsdb(**overrides):
+    cfg = {"tsd.core.auto_create_metrics": "true"}
+    cfg.update(overrides)
+    tsdb = TSDB(Config(**cfg))
+    tsdb.initialize_plugins()
+    return tsdb
+
+
+# -- tests -------------------------------------------------------------
+
+def test_rtpublisher_receives_points():
+    DummyRTPublisher.published.clear()
+    tsdb = _tsdb(**{
+        "tsd.rtpublisher.enable": "true",
+        "tsd.rtpublisher.plugin": "test_plugins.DummyRTPublisher"})
+    tsdb.add_point("sys.cpu.user", 1356998400, 42, {"host": "web01"})
+    assert len(DummyRTPublisher.published) == 1
+    metric, ts, value, tags, tsuid = DummyRTPublisher.published[0]
+    assert metric == "sys.cpu.user" and value == 42
+    assert tsuid  # hex TSUID string
+
+
+def test_write_filter_blocks_points():
+    tsdb = _tsdb(**{
+        "tsd.core.write_filter.enable": "true",
+        "tsd.core.write_filter.plugin": "test_plugins.DummyWriteFilter"})
+    ok = tsdb.add_point("sys.ok", 1356998400, 1, {"host": "a"})
+    blocked = tsdb.add_point("blocked.metric", 1356998400, 1,
+                             {"host": "a"})
+    assert ok >= 0 and blocked == -1
+    assert tsdb.datapoints_added == 1
+
+
+def test_uid_whitelist_filter_vetoes_assignment():
+    from opentsdb_tpu.core.uid import FailedToAssignUniqueIdError
+    tsdb = _tsdb(**{
+        "tsd.uid.filter.enable": "true",
+        "tsd.uid.filter.plugin":
+            "opentsdb_tpu.plugins.UniqueIdWhitelistFilter",
+        "tsd.uidfilter.metric_patterns": r"^sys\..*,^net\..*"})
+    tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+    with pytest.raises(FailedToAssignUniqueIdError):
+        tsdb.add_point("evil.metric", 1356998400, 1, {"host": "a"})
+    # existing UIDs pass without filter consultation
+    tsdb.add_point("sys.cpu.user", 1356998401, 2, {"host": "a"})
+
+
+def test_storage_exception_handler_called(monkeypatch):
+    DummySEH.errors.clear()
+    tsdb = _tsdb(**{
+        "tsd.core.storage_exception_handler.enable": "true",
+        "tsd.core.storage_exception_handler.plugin":
+            "test_plugins.DummySEH"})
+    router = HttpRpcRouter(tsdb)
+
+    def boom(*a, **kw):
+        raise RuntimeError("storage down")
+    monkeypatch.setattr(tsdb, "add_point", boom)
+    body = json.dumps([{"metric": "m", "timestamp": 1356998400,
+                        "value": 1, "tags": {"h": "a"}}]).encode()
+    resp = router.handle(HttpRequest("POST", "/api/put?details",
+                                     {"details": [""]}, body=body))
+    assert resp.status == 400
+    assert len(DummySEH.errors) == 1
+    assert "storage down" in str(DummySEH.errors[0][1])
+
+
+def test_http_rpc_plugin_route():
+    tsdb = _tsdb(**{
+        "tsd.http.rpc.enable": "true",
+        "tsd.http.rpc.plugin": "test_plugins.DummyHttpRpcPlugin"})
+    router = HttpRpcRouter(tsdb)
+    resp = router.handle(HttpRequest("GET", "/plugin/dummy"))
+    assert resp.status == 200
+    assert json.loads(resp.body) == {"hello": "plugin"}
+    missing = router.handle(HttpRequest("GET", "/plugin/nope"))
+    assert missing.status == 404
+
+
+def test_serializer_plugin_slot():
+    tsdb = _tsdb(**{
+        "tsd.http.serializer.plugin": "test_plugins.DummySerializer"})
+    router = HttpRpcRouter(tsdb)
+    resp = router.handle(HttpRequest("GET", "/api/version"))
+    assert json.loads(resp.body)["serializer"] == "dummy"
+
+
+def test_meta_cache_replaces_builtin_tracking():
+    DummyMetaCache.counters.clear()
+    tsdb = _tsdb(**{
+        "tsd.core.meta.cache.enable": "true",
+        "tsd.core.meta.cache.plugin": "test_plugins.DummyMetaCache"})
+    tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+    tsdb.add_point("sys.cpu.user", 1356998410, 2, {"host": "a"})
+    assert list(DummyMetaCache.counters.values()) == [2]
+
+
+def test_uid_whitelist_empty_patterns_allow_all():
+    filt = UniqueIdWhitelistFilter()
+    filt.initialize(Config())
+    assert filt.allow_uid_assignment("metric", "anything", "m", {})
