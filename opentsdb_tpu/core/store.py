@@ -134,6 +134,24 @@ class SeriesBuffer:
         hi = np.searchsorted(ts, end_ms, side="right")
         return ts[lo:hi], vals[lo:hi]
 
+    def delete_range(self, start_ms: int, end_ms: int) -> int:
+        """Remove points with start_ms <= ts <= end_ms; returns how many
+        (ref: TsdbQuery delete=true issuing DeleteRequests per scanned
+        row)."""
+        with self.lock:
+            self._ensure_sorted_locked()
+            ts = self.ts[:self.n]
+            lo = int(np.searchsorted(ts, start_ms, side="left"))
+            hi = int(np.searchsorted(ts, end_ms, side="right"))
+            k = hi - lo
+            if k <= 0:
+                return 0
+            self.ts[lo:self.n - k] = self.ts[hi:self.n]
+            self.vals[lo:self.n - k] = self.vals[hi:self.n]
+            self.is_int[lo:self.n - k] = self.is_int[hi:self.n]
+            self.n -= k
+            return k
+
     def __len__(self) -> int:
         return self.n
 
@@ -270,6 +288,16 @@ class TimeSeriesStore:
                     is_int: np.ndarray | bool = False) -> None:
         self._series[series_id].buffer.append_many(ts_ms, values, is_int)
         self.points_written += len(ts_ms)
+
+    def delete_range(self, series_ids: Sequence[int], start_ms: int,
+                     end_ms: int) -> int:
+        """Delete all points of ``series_ids`` within the inclusive
+        range; returns the number removed."""
+        deleted = 0
+        for sid in series_ids:
+            deleted += self._series[int(sid)].buffer.delete_range(
+                start_ms, end_ms)
+        return deleted
 
     # -- read path --------------------------------------------------------
 

@@ -90,6 +90,8 @@ class TSDB:
         self.annotations = AnnotationStore()
         from opentsdb_tpu.meta.meta_store import MetaStore
         self.meta = MetaStore(self)
+        from opentsdb_tpu.query.limits import QueryLimitOverride
+        self.query_limits = QueryLimitOverride(self.config)
         from opentsdb_tpu.stats.stats import StatsCollectorRegistry
         self.stats = StatsCollectorRegistry()
         self.datapoints_added = 0

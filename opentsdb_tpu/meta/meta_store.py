@@ -132,3 +132,14 @@ class MetaStore:
     def all_ts_meta(self) -> list[TSMeta]:
         with self._lock:
             return list(self.ts_meta.values())
+
+    def purge(self) -> tuple[int, int]:
+        """Remove every TSMeta/UIDMeta doc and counter
+        (ref: src/tools/MetaPurge.java — the `uid metapurge` path).
+        Returns (n_tsmeta, n_uidmeta) purged."""
+        with self._lock:
+            n_ts, n_uid = len(self.ts_meta), len(self.uid_meta)
+            self.ts_meta.clear()
+            self.uid_meta.clear()
+            self.ts_counters.clear()
+        return n_ts, n_uid

@@ -69,6 +69,9 @@ def load_library():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.tss_points_written.argtypes = [ctypes.c_void_p]
         lib.tss_points_written.restype = ctypes.c_int64
+        lib.tss_delete_range.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_int64]
+        lib.tss_delete_range.restype = ctypes.c_int64
         lib.tss_series_length.argtypes = [ctypes.c_void_p,
                                           ctypes.c_int64]
         lib.tss_series_length.restype = ctypes.c_int64
@@ -256,6 +259,16 @@ class NativeTimeSeriesStore:
     def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
         return np.asarray([self._records[s].shard for s in series_ids],
                           dtype=np.int32)
+
+    def delete_range(self, series_ids, start_ms: int,
+                     end_ms: int) -> int:
+        deleted = 0
+        for sid in series_ids:
+            n = int(self._lib.tss_delete_range(self._h, int(sid),
+                                               start_ms, end_ms))
+            if n > 0:
+                deleted += n
+        return deleted
 
     def total_points(self) -> int:
         return sum(int(self._lib.tss_series_length(self._h, sid))

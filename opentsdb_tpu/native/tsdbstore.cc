@@ -156,6 +156,29 @@ int64_t tss_series_length(void* h, int64_t sid) {
   return (int64_t)buf->ts.size();
 }
 
+// Remove points with start_ms <= ts <= end_ms from one series; returns
+// the number deleted (ref: TsdbQuery delete=true issuing
+// DeleteRequests per scanned row). -1 on a bad sid.
+int64_t tss_delete_range(void* h, int64_t sid, int64_t start_ms,
+                         int64_t end_ms) {
+  Store* s = static_cast<Store*>(h);
+  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
+  SeriesBuffer* buf = s->series[sid];
+  std::lock_guard<std::mutex> lock(buf->mu);
+  buf->ensure_sorted_locked();
+  auto lo = std::lower_bound(buf->ts.begin(), buf->ts.end(), start_ms);
+  auto hi = std::upper_bound(buf->ts.begin(), buf->ts.end(), end_ms);
+  int64_t n = hi - lo;
+  if (n > 0) {
+    buf->vals.erase(buf->vals.begin() + (lo - buf->ts.begin()),
+                    buf->vals.begin() + (hi - buf->ts.begin()));
+    buf->is_int.erase(buf->is_int.begin() + (lo - buf->ts.begin()),
+                      buf->is_int.begin() + (hi - buf->ts.begin()));
+    buf->ts.erase(lo, hi);
+  }
+  return n;
+}
+
 // Copy one series' sorted columns into caller-provided arrays sized by
 // a prior tss_series_length call.
 int tss_read_series(void* h, int64_t sid, int64_t* ts_out,

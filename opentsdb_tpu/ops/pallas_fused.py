@@ -12,7 +12,7 @@ which EVERY reduction is a matmul on the **MXU** (the systolic array):
   columns for first/last);
 - rate: the first-difference operator is linear, so its shift matrix
   ``R`` (I with -1 superdiagonal) and the 1/dt scaling are folded into
-  ``A`` / a per-bucket ``scale`` row on the host — no in-kernel shifts;
+  ``A``/``bias`` on the host — no in-kernel shifts;
 - group-by: ``onehot(group_ids)[G, TILE_S] @ grid[TILE_S, B]``
   accumulated across series tiles (one-hot segment-reduction-as-matmul).
 
@@ -75,7 +75,7 @@ def _tile_s(s: int, p: int, itemsize: int) -> int:
 
 def _build_operators(spec, k: int, bucket_ts: np.ndarray, dtype):
     """Host-side: fold downsample + rate + dt scaling into
-    (A [P, B], scale [1, B], bias [1, B])."""
+    (A [P, B], bias [1, B])."""
     b = spec.num_buckets
     p = b * k
     fn = spec.ds_function
@@ -96,12 +96,12 @@ def _build_operators(spec, k: int, bucket_ts: np.ndarray, dtype):
         bias[0, :] = float(k)  # complete data: every bucket holds k pts
     else:  # pragma: no cover - guarded by supported()
         raise ValueError(fn)
-    scale = np.ones((1, b), dtype=dtype)
     if spec.rate:
         # rate[b] = (ds[b] - ds[b-1]) / dt[b]: fold the difference
-        # operator R (I with -1 on the superdiagonal) into A and the
-        # 1/dt into scale; scale[0]=0 stands in for the dropped first
-        # bucket (finalizer turns it into NaN / ZIM-zero).
+        # operator R (I with -1 on the superdiagonal) AND the 1/dt
+        # scaling into A/bias on the host; column 0 scales to 0 to
+        # stand in for the dropped first bucket (finalizer turns it
+        # into NaN / ZIM-zero).
         r = np.eye(b, dtype=np.float64)
         r[cols[:-1], cols[1:]] = -1.0
         ts = np.asarray(bucket_ts, dtype=np.float64)
@@ -114,13 +114,12 @@ def _build_operators(spec, k: int, bucket_ts: np.ndarray, dtype):
         inv[0] = 0.0
         m = (m.astype(np.float64) @ r * inv[None, :]).astype(dtype)
         bias = (bias.astype(np.float64) @ r * inv[None, :]).astype(dtype)
-        scale = scale  # already folded into m/bias
-    return m, scale, bias
+    return m, bias
 
 
-def _kernel(vals_ref, gid_ref, a_ref, scale_ref, bias_ref, acc_ref, *,
+def _kernel(vals_ref, gid_ref, a_ref, bias_ref, acc_ref, *,
             g: int, square: bool):
-    """One series tile: (x @ A) * scale + bias, then one-hot matmul."""
+    """One series tile: (x @ A) + bias, then one-hot matmul."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -130,7 +129,7 @@ def _kernel(vals_ref, gid_ref, a_ref, scale_ref, bias_ref, acc_ref, *,
     tile_s = vals_ref.shape[0]
     t = jnp.dot(vals_ref[:], a_ref[:],
                 preferred_element_type=acc_ref.dtype)
-    t = t * scale_ref[:] + bias_ref[:]
+    t = t + bias_ref[:]
     if square:
         t = t * t
     # one-hot [G, TILE_S]: padded rows carry gid -1 -> all-zero columns
@@ -142,7 +141,7 @@ def _kernel(vals_ref, gid_ref, a_ref, scale_ref, bias_ref, acc_ref, *,
 
 
 @partial(jax.jit, static_argnames=("spec", "tile_s", "interpret"))
-def _run(values2d, group_ids_padded, a_mat, scale, bias, group_sizes,
+def _run(values2d, group_ids_padded, a_mat, bias, group_sizes,
          spec, tile_s: int, interpret: bool):
     s_pad, p = values2d.shape
     b, g = spec.num_buckets, spec.num_groups
@@ -156,12 +155,11 @@ def _run(values2d, group_ids_padded, a_mat, scale, bias, group_sizes,
             pl.BlockSpec((tile_s, 1), lambda i: (i, 0)),
             pl.BlockSpec((p, b), lambda i: (0, 0)),
             pl.BlockSpec((1, b), lambda i: (0, 0)),
-            pl.BlockSpec((1, b), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((g, b), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((g, b), dtype),
         interpret=interpret,
-    )(values2d, group_ids_padded, a_mat, scale, bias)
+    )(values2d, group_ids_padded, a_mat, bias)
 
     # finalize [G,B] (cheap; stays in the same jit program)
     sizes = group_sizes[:, None].astype(dtype)  # [G,1] series per group
@@ -196,12 +194,12 @@ def _run(values2d, group_ids_padded, a_mat, scale, bias, group_sizes,
     return result, emit
 
 
-def fused_dense_pipeline(values2d: np.ndarray, bucket_ts: np.ndarray,
-                         group_ids: np.ndarray, spec, k: int,
-                         dtype=jnp.float32, device=None):
-    """Host entry mirroring :func:`pipeline.run_pipeline_dense` for
-    complete data. values2d [S, P] (no NaN), bucket_ts [B] ms,
-    group_ids [S] -> (result [G,B] np, emit [G,B] np)."""
+def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
+            group_ids: np.ndarray, spec, k: int, dtype=jnp.float32,
+            device=None):
+    """Host prep: pad, fold operators, upload. Returns
+    (device_args, tile_s, interpret) ready for :func:`_run` — split out
+    so callers timing steady-state compute can upload once."""
     np_dtype = np.dtype(dtype)
     s, p = values2d.shape
     tile_s = _tile_s(s, p, np_dtype.itemsize)
@@ -210,13 +208,24 @@ def fused_dense_pipeline(values2d: np.ndarray, bucket_ts: np.ndarray,
     vals[:s] = values2d
     gids = np.full((s_pad, 1), -1, dtype=np.int32)
     gids[:s, 0] = group_ids
-    a_mat, scale, bias = _build_operators(spec, k, bucket_ts, np_dtype)
+    a_mat, bias = _build_operators(spec, k, bucket_ts, np_dtype)
     sizes = np.bincount(group_ids, minlength=spec.num_groups) \
         .astype(np.int32)
     put = partial(jax.device_put, device=device)
+    args = (put(jnp.asarray(vals)), put(jnp.asarray(gids)),
+            put(jnp.asarray(a_mat)), put(jnp.asarray(bias)),
+            put(jnp.asarray(sizes)))
     interpret = jax.default_backend() != "tpu"
-    result, emit = _run(put(jnp.asarray(vals)), put(jnp.asarray(gids)),
-                        put(jnp.asarray(a_mat)), put(jnp.asarray(scale)),
-                        put(jnp.asarray(bias)), put(jnp.asarray(sizes)),
-                        spec, tile_s, interpret)
+    return args, tile_s, interpret
+
+
+def fused_dense_pipeline(values2d: np.ndarray, bucket_ts: np.ndarray,
+                         group_ids: np.ndarray, spec, k: int,
+                         dtype=jnp.float32, device=None):
+    """Host entry mirroring :func:`pipeline.run_pipeline_dense` for
+    complete data. values2d [S, P] (no NaN), bucket_ts [B] ms,
+    group_ids [S] -> (result [G,B] np, emit [G,B] np)."""
+    args, tile_s, interpret = prepare(values2d, bucket_ts, group_ids,
+                                      spec, k, dtype, device)
+    result, emit = _run(*args, spec, tile_s, interpret)
     return np.asarray(result), np.asarray(emit)

@@ -206,10 +206,18 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
             from opentsdb_tpu.ops import pallas_fused
             if pallas_fused.supported(spec, dtype) \
                     and not np.isnan(values2d).any():
-                return pallas_fused.fused_dense_pipeline(
-                    values2d, np.asarray(bucket_ts),
-                    np.asarray(group_ids), spec, k, dtype=dtype,
-                    device=device)
+                try:
+                    return pallas_fused.fused_dense_pipeline(
+                        values2d, np.asarray(bucket_ts),
+                        np.asarray(group_ids), spec, k, dtype=dtype,
+                        device=device)
+                except Exception:  # noqa: BLE001
+                    # Mosaic compile/runtime failure -> the XLA dense
+                    # path computes the same thing; log and degrade
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "pallas fused kernel failed; falling back to "
+                        "the XLA dense path", exc_info=True)
         result, emit = run_pipeline_dense(
             put(jnp.asarray(values2d, dtype=dtype)),
             put(jnp.asarray(bucket_ts)),

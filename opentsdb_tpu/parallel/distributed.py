@@ -1,0 +1,103 @@
+"""Multi-host (DCN) deployment of the query mesh.
+
+The reference scales beyond one JVM by running many stateless TSDs
+behind a load balancer, all talking to one HBase cluster over TCP
+(SURVEY.md §5.8). The TPU-native equivalent is multi-host JAX: one
+process per host, ``jax.distributed.initialize`` for rendezvous, and a
+single global ('series', 'time') mesh spanning every chip.
+
+Axis placement is deliberate (the scaling-book recipe — put the
+chatty collective on the fast interconnect):
+
+- the **series** axis (salt analogue) lays out over each host's LOCAL
+  chips: group-by reductions cross this axis with ``psum`` every query,
+  and those collectives ride **ICI**;
+- the **time** axis spans **hosts over DCN**: time blocks are almost
+  independent — only rate/interpolation boundary halos (two [S]-sized
+  vectors per block edge, ``sharded_pipeline._scan_boundary``) cross
+  it, so the slow link carries the least traffic.
+
+Write routing mirrors the reference's "any TSD accepts any write"
+model: every host ingests into its local shard of the series axis;
+:func:`series_home` tells a collector (or a fronting LB) which host
+owns a series so ingest can avoid cross-host forwarding entirely —
+the analogue of region-aware routing in asynchbase.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the multi-host rendezvous (no-op when single-process).
+
+    Mirrors ``jax.distributed.initialize``; on TPU pods the arguments
+    are auto-detected from the environment, so ``initialize()`` with no
+    arguments is the common call.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id)
+
+
+def multihost_device_grid(devices=None,
+                          num_hosts: int | None = None) -> np.ndarray:
+    """Arrange devices into a [local_chips, hosts] grid.
+
+    Rows (axis 0, 'series') hold chips of the same host — ICI
+    neighbors; columns (axis 1, 'time') cross hosts — DCN. On real
+    multi-process runs hosts are identified by ``device.process_index``;
+    for single-process testing (the 8-virtual-device CPU matrix)
+    ``num_hosts`` splits the flat device list into equal fake hosts.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    by_host: dict[int, list] = {}
+    if num_hosts is None:
+        for d in devs:
+            by_host.setdefault(getattr(d, "process_index", 0),
+                               []).append(d)
+        if len(by_host) == 1 and num_hosts is None:
+            # single process: one "host", all chips local
+            return np.asarray(devs).reshape(len(devs), 1)
+    else:
+        if len(devs) % num_hosts:
+            raise ValueError(
+                f"{len(devs)} devices do not split into {num_hosts} hosts")
+        per = len(devs) // num_hosts
+        for h in range(num_hosts):
+            by_host[h] = devs[h * per:(h + 1) * per]
+    counts = {len(v) for v in by_host.values()}
+    if len(counts) != 1:
+        raise ValueError(f"uneven chips per host: {by_host}")
+    hosts = sorted(by_host)
+    grid = np.empty((counts.pop(), len(hosts)), dtype=object)
+    for col, h in enumerate(hosts):
+        grid[:, col] = by_host[h]
+    return grid
+
+
+def make_multihost_mesh(devices=None,
+                        num_hosts: int | None = None) -> Mesh:
+    """A ('series', 'time') mesh with series=ICI-local, time=DCN."""
+    return Mesh(multihost_device_grid(devices, num_hosts),
+                ("series", "time"))
+
+
+def series_home(series_shard: int, mesh: Mesh) -> int:
+    """Which process/host owns a series shard's ingest
+    (ref-analogue: asynchbase region-aware write routing).
+
+    Series shards are distributed round-robin over the series axis;
+    every host holds the full series axis locally (the time axis is
+    what crosses hosts), so the owner is the process of the device at
+    ``[shard % series_size, 0]``.
+    """
+    series_size = mesh.shape["series"]
+    dev = np.asarray(mesh.devices)[series_shard % series_size, 0]
+    return getattr(dev, "process_index", 0)

@@ -111,6 +111,14 @@ class QueryEngine:
             stats.add_stat(QueryStat.MATERIALIZE_TIME,
                            (time.monotonic() - t1) * 1e3)
             stats.add_stat(QueryStat.DPS_POST_FILTER, batch.num_points)
+        # byte/dp guardrails (ref: SaltScanner budget enforcement via
+        # QueryLimitOverride)
+        self.tsdb.query_limits.check(metric_name, batch.num_points)
+        if tsq.delete and hasattr(store, "delete_range"):
+            # scanned-and-deleted semantics: the response still carries
+            # the data just removed (ref: TsdbQuery delete=true turning
+            # scans into DeleteRequests after collection)
+            store.delete_range(sids, tsq.start_ms, tsq.end_ms)
         if batch.num_points == 0:
             return []
         if sub.ds_spec is not None:

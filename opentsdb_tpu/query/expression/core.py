@@ -285,6 +285,14 @@ GEXP_FUNCTIONS: dict[str, Callable] = {
     "diffSeries": fn_diff_series,
     "multiplySeries": fn_multiply_series,
     "divideSeries": fn_divide_series,
+    # aliases registered by the reference factory
+    # (ExpressionFactory.java:37-57: shift, sum, difference, multiply,
+    # divide map to the same implementations)
+    "shift": fn_time_shift,
+    "sum": fn_sum_series,
+    "difference": fn_diff_series,
+    "multiply": fn_multiply_series,
+    "divide": fn_divide_series,
 }
 
 

@@ -24,6 +24,7 @@ a properties file; any ``--tsd.key=value`` flag overrides a config key.
 from __future__ import annotations
 
 import gzip
+import os
 import sys
 import time
 
@@ -33,7 +34,8 @@ from opentsdb_tpu.utils import datetime_util
 
 USAGE = """usage: tsdb <command> [args]
 Valid commands: fsck, import, mkmetric, query, tsd, scan, search,
-                treesync, rollup, uid, version
+                treesync, rollup, uid, version, drain, check,
+                cleancache
 """
 
 
@@ -269,7 +271,7 @@ def cmd_uid(config: Config, args: list[str]) -> int:
               "  assign <kind> <name>...\n"
               "  rename <kind> <name> <newname>\n"
               "  delete <kind> <name>\n"
-              "  fsck\n  metasync", file=sys.stderr)
+              "  fsck\n  metasync\n  metapurge", file=sys.stderr)
         return 2
     tsdb = make_tsdb(config)
     sub = args[0]
@@ -335,6 +337,12 @@ def cmd_uid(config: Config, args: list[str]) -> int:
                                        rec.series_id)
                 count += 1
         print(f"synced meta for {count} timeseries")
+        tsdb.flush()
+        return 0
+    if sub == "metapurge":
+        # (ref: UidManager.java:208 -> MetaPurge threads)
+        n_ts, n_uid = tsdb.meta.purge()
+        print(f"purged {n_ts} TSMeta and {n_uid} UIDMeta entries")
         tsdb.flush()
         return 0
     print(f"unknown uid subcommand: {sub}", file=sys.stderr)
@@ -439,6 +447,32 @@ def cmd_version(config: Config, args: list[str]) -> int:
     return 0
 
 
+def cmd_drain(config: Config, args: list[str]) -> int:
+    """(ref: tools/tsddrain.py — outage spooler)"""
+    from opentsdb_tpu.tools.drain import main as drain_main
+    return drain_main(args)
+
+
+def cmd_check(config: Config, args: list[str]) -> int:
+    """(ref: tools/check_tsd — Nagios threshold check)"""
+    from opentsdb_tpu.tools.check_tsd import main as check_main
+    return check_main(args)
+
+
+def cmd_cleancache(config: Config, args: list[str]) -> int:
+    """Purge the /q graph cache (ref: tools/clean_cache.sh)."""
+    import shutil
+    cache_dir = config.get_string("tsd.http.cachedir",
+                                  "/tmp/opentsdb_tpu")
+    if os.path.isdir(cache_dir):
+        n = len(os.listdir(cache_dir))
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        print(f"removed {n} cached entries from {cache_dir}")
+    else:
+        print(f"no cache at {cache_dir}")
+    return 0
+
+
 COMMANDS = {
     "tsd": cmd_tsd,
     "query": cmd_query,
@@ -451,6 +485,9 @@ COMMANDS = {
     "treesync": cmd_treesync,
     "rollup": cmd_rollup,
     "version": cmd_version,
+    "drain": cmd_drain,
+    "check": cmd_check,
+    "cleancache": cmd_cleancache,
 }
 
 

@@ -29,6 +29,7 @@ from opentsdb_tpu import __version__
 from opentsdb_tpu.meta.annotation import Annotation
 from opentsdb_tpu.ops import aggregators as aggs_mod
 from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.query.limits import QueryLimitExceeded
 from opentsdb_tpu.query.model import (BadRequestError, TSQuery,
                                       parse_uri_query)
 from opentsdb_tpu.stats.stats import QueryStats
@@ -137,6 +138,10 @@ class HttpRpcRouter:
         except ValueError as e:
             return HttpResponse(400, self.serializer.format_error(
                 400, str(e)))
+        except QueryLimitExceeded as e:
+            # over-budget scans are a client-fixable condition
+            return HttpResponse(413, self.serializer.format_error(
+                413, str(e)))
         except NotImplementedError as e:
             return HttpResponse(501, self.serializer.format_error(
                 501, str(e) or "not implemented"))
@@ -320,8 +325,11 @@ class HttpRpcRouter:
             raise HttpError(405, "Method not allowed")
         tsq.validate()
         if request.method == "DELETE" or tsq.delete:
-            raise HttpError(400, "Deleting data is not enabled",
-                            "set tsd.http.query.allow_delete")
+            if not self.tsdb.config.get_bool(
+                    "tsd.http.query.allow_delete"):
+                raise HttpError(400, "Deleting data is not enabled",
+                                "set tsd.http.query.allow_delete")
+            tsq.delete = True
         stats = QueryStats(request.remote, tsq)
         try:
             results = self.tsdb.new_query().run(tsq, stats)
@@ -650,12 +658,27 @@ class HttpRpcRouter:
     # -- misc ----------------------------------------------------------
 
     def _homepage(self, request: HttpRequest) -> HttpResponse:
+        """The dashboard (ref: HomePage in RpcManager serving the GWT
+        QueryUi; here a self-contained static page)."""
+        import os
+        page = os.path.join(self._static_root(), "index.html")
+        if os.path.isfile(page):
+            with open(page, "rb") as fh:
+                return HttpResponse(200, fh.read(),
+                                    content_type="text/html; charset=UTF-8")
         body = (b"<html><head><title>opentsdb-tpu</title></head><body>"
                 b"<h1>opentsdb-tpu " + __version__.encode() +
                 b"</h1><p>TPU-native time series database.</p>"
                 b"<p>See /api/version, /api/aggregators, /api/query"
                 b"</p></body></html>")
         return HttpResponse(200, body, content_type="text/html")
+
+    def _static_root(self) -> str:
+        import os
+        root = self.tsdb.config.get_string("tsd.http.staticroot", "")
+        if not root:
+            root = os.path.join(os.path.dirname(__file__), "static")
+        return root
 
     def _handle_graph(self, request: HttpRequest) -> HttpResponse:
         from opentsdb_tpu.tsd.graph import handle_graph
@@ -664,9 +687,7 @@ class HttpRpcRouter:
     def _handle_static(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: StaticFileRpc.java:20)"""
         import os
-        root = self.tsdb.config.get_string("tsd.http.staticroot", "")
-        if not root:
-            raise HttpError(404, "No static root configured")
+        root = self._static_root()
         rel = "/".join(rest)
         full = os.path.realpath(os.path.join(root, rel))
         if not full.startswith(os.path.realpath(root)) \

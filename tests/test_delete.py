@@ -1,0 +1,72 @@
+"""Data deletion via DELETE /api/query (ref: TsdbQuery delete=true +
+QueryRpc gating on tsd.http.query.allow_delete)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.core.store import SeriesBuffer
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+
+def test_series_buffer_delete_range():
+    buf = SeriesBuffer()
+    for i in range(10):
+        buf.append(1000 * i, float(i), False)
+    assert buf.delete_range(3000, 6000) == 4
+    ts, vals = buf.view()
+    assert list(ts) == [0, 1000, 2000, 7000, 8000, 9000]
+    assert buf.delete_range(50_000, 60_000) == 0
+
+
+def test_native_store_delete_range():
+    from opentsdb_tpu.native.store_backend import NativeTimeSeriesStore
+    store = NativeTimeSeriesStore(num_shards=4)
+    sid = store.get_or_create_series(1, [(1, 1)])
+    for i in range(10):
+        store.append(sid, 1000 * i, float(i), False)
+    assert store.delete_range([sid], 3000, 6000) == 4
+    batch = store.materialize([sid], 0, 10**9)
+    assert batch.num_points == 6
+    assert 3000 not in batch.ts_ms
+
+
+def _router(allow):
+    cfg = {"tsd.core.auto_create_metrics": "true"}
+    if allow:
+        cfg["tsd.http.query.allow_delete"] = "true"
+    tsdb = TSDB(Config(**cfg))
+    base = 1356998400
+    for i in range(30):
+        tsdb.add_point("del.metric", base + i, i, {"host": "a"})
+    return HttpRpcRouter(tsdb), tsdb, base
+
+
+def test_delete_disabled_by_default():
+    router, tsdb, base = _router(allow=False)
+    resp = router.handle(HttpRequest(
+        "DELETE", "/api/query",
+        {"start": [str(base)], "m": ["sum:del.metric"]}))
+    assert resp.status == 400
+    assert b"not enabled" in resp.body
+
+
+def test_delete_removes_range_and_returns_data():
+    router, tsdb, base = _router(allow=True)
+    resp = router.handle(HttpRequest(
+        "DELETE", "/api/query",
+        {"start": [str(base)], "end": [str(base + 9)],
+         "m": ["sum:del.metric"]}))
+    assert resp.status == 200
+    # the deleted data is still in the response (scan-then-delete)
+    dps = json.loads(resp.body)[0]["dps"]
+    assert len(dps) == 10
+    # ...but gone from storage
+    resp2 = router.handle(HttpRequest(
+        "GET", "/api/query",
+        {"start": [str(base - 10)], "m": ["sum:del.metric"]}))
+    dps2 = json.loads(resp2.body)[0]["dps"]
+    assert len(dps2) == 20
+    assert str(base) not in dps2

@@ -1,0 +1,70 @@
+"""Multi-host mesh layout tests on the 8-virtual-device CPU matrix
+(the Salted-twin strategy of SURVEY.md §4 applied to DCN layout)."""
+
+import jax
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops.pipeline import PipelineSpec, execute
+from opentsdb_tpu.parallel.distributed import (make_multihost_mesh,
+                                               multihost_device_grid,
+                                               series_home)
+from opentsdb_tpu.parallel.sharded_pipeline import (prepare_sharded_batch,
+                                                    run_sharded)
+
+
+def test_grid_single_process_all_local():
+    grid = multihost_device_grid()
+    assert grid.shape == (8, 1)  # 8 chips, one host
+
+
+def test_grid_fake_hosts_split():
+    grid = multihost_device_grid(num_hosts=4)
+    assert grid.shape == (2, 4)
+    # chips in one column must come from the same (fake) host chunk
+    devs = jax.devices()
+    assert grid[0, 0] is devs[0] and grid[1, 0] is devs[1]
+    assert grid[0, 3] is devs[6] and grid[1, 3] is devs[7]
+
+
+def test_grid_uneven_split_rejected():
+    with pytest.raises(ValueError):
+        multihost_device_grid(num_hosts=3)
+
+
+def test_mesh_axis_names():
+    mesh = make_multihost_mesh(num_hosts=2)
+    assert mesh.shape == {"series": 4, "time": 2}
+
+
+def test_series_home_round_robin():
+    mesh = make_multihost_mesh(num_hosts=2)
+    # single process: every shard homes to process 0, but the mapping
+    # must be total and stable
+    for shard in range(16):
+        assert series_home(shard, mesh) == 0
+
+
+def test_sharded_pipeline_runs_on_multihost_mesh():
+    """The full sharded query step must execute on the DCN-shaped mesh
+    (series=ICI-local, time=cross-host) and match the single-chip
+    pipeline bit for bit."""
+    mesh = make_multihost_mesh(num_hosts=2)  # series=4, time=2
+    s, b, g, points_per = 8, 6, 3, 18
+    rng = np.random.default_rng(5)
+    n = s * points_per
+    values = rng.normal(50.0, 10.0, size=n)
+    sidx = np.repeat(np.arange(s, dtype=np.int32), points_per)
+    bidx = np.tile((np.arange(points_per, dtype=np.int32) * b)
+                   // points_per, s)
+    bts = np.arange(b, dtype=np.int64) * 60_000
+    group_ids = (np.arange(s) % g).astype(np.int32)
+    spec = PipelineSpec(num_series=s, num_buckets=b, num_groups=g,
+                        ds_function="avg", agg_name="sum", rate=True)
+    ref, ref_emit = execute(values, sidx, bidx, bts, group_ids, spec)
+    batch = prepare_sharded_batch(values, sidx, bidx, bts, group_ids,
+                                  s, g, mesh.shape["series"],
+                                  mesh.shape["time"])
+    got, got_emit = run_sharded(mesh, spec, batch)
+    np.testing.assert_allclose(got, ref, rtol=1e-9, equal_nan=True)
+    np.testing.assert_array_equal(got_emit, ref_emit)

@@ -1,0 +1,99 @@
+"""Ops tool tests: the drain spooler (ref tools/tsddrain.py) and the
+Nagios check (ref tools/check_tsd), driven against in-process servers
+the way test/tools/* drives the reference tools against MockBase."""
+
+import asyncio
+import threading
+
+import pytest
+
+from opentsdb_tpu.tools.check_tsd import build_parser, build_url, main \
+    as check_main
+from opentsdb_tpu.tools.drain import DrainServer
+
+
+def test_drain_spools_put_lines(tmp_path):
+    async def scenario():
+        server = DrainServer(str(tmp_path), host="127.0.0.1", port=0)
+        await server.start()
+        port = server.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"put sys.cpu.user 1356998400 42 host=web01\n"
+                     b"version\n"
+                     b"put sys.cpu.user 1356998410 43 host=web01\n"
+                     b"exit\n")
+        await writer.drain()
+        banner = await asyncio.wait_for(reader.readline(), 5)
+        assert b"drain" in banner
+        await asyncio.wait_for(reader.read(), 5)  # connection closes
+        writer.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+    spool = tmp_path / "127.0.0.1"
+    lines = spool.read_text().splitlines()
+    # "put " stripped -> direct TextImporter format
+    assert lines == ["sys.cpu.user 1356998400 42 host=web01",
+                     "sys.cpu.user 1356998410 43 host=web01"]
+
+
+def test_check_tsd_url_building():
+    o = build_parser().parse_args([
+        "-m", "sys.cpu.user", "-t", "host=web01", "-d", "600",
+        "-a", "avg", "-D", "avg", "-W", "60", "-r", "-w", "50",
+        "-N", "1357000000"])
+    url = build_url(o)
+    assert url == ("http://localhost:4242/q?start=1356999400"
+                   "&m=avg:60s-avg-none:rate:sys.cpu.user{host=web01}"
+                   "&ascii&nagios")
+
+
+@pytest.fixture
+def live_tsd(tsdb):
+    """A real TSD server on an ephemeral port in a background loop."""
+    from opentsdb_tpu.tsd.server import TSDServer
+    import time as _time
+    now = int(_time.time())
+    for i in range(10):
+        tsdb.add_point("sys.load", now - 300 + i * 30, 10 * (i + 1),
+                       {"host": "web01"})
+    server = TSDServer(tsdb, host="127.0.0.1", port=0)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+        await server.serve_forever()
+
+    thread = threading.Thread(
+        target=lambda: loop.run_until_complete(run()), daemon=True)
+    thread.start()
+    assert started.wait(10)
+    port = server._server.sockets[0].getsockname()[1]
+    yield port
+    loop.call_soon_threadsafe(server.request_shutdown)
+    thread.join(timeout=10)
+    loop.close()
+
+
+def test_check_tsd_against_live_server(live_tsd, capsys):
+    port = str(live_tsd)
+    # values run 10..100; critical above 1000 -> OK
+    assert check_main(["-p", port, "-m", "sys.load", "-d", "600",
+                       "-c", "1000"]) == 0
+    assert "OK" in capsys.readouterr().out
+    # critical above 50 -> CRITICAL
+    assert check_main(["-p", port, "-m", "sys.load", "-d", "600",
+                       "-c", "50"]) == 2
+    assert "CRITICAL" in capsys.readouterr().out
+    # warning above 50, critical above 1000 -> WARNING
+    assert check_main(["-p", port, "-m", "sys.load", "-d", "600",
+                       "-w", "50", "-c", "1000"]) == 1
+    assert "WARNING" in capsys.readouterr().out
+    # unknown metric -> CRITICAL (error status from TSD)
+    assert check_main(["-p", port, "-m", "no.such.metric",
+                       "-c", "1"]) == 2
+    # no-result-ok on an empty range
+    assert check_main(["-p", port, "-m", "sys.load", "-d", "600",
+                       "-c", "1000", "-N", "900000000", "-E"]) == 0

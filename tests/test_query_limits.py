@@ -1,0 +1,106 @@
+"""QueryLimitOverride tests (ref: test/query/TestQueryLimitOverride.java
+strategy: defaults, regex overrides, reload)."""
+
+import json
+import time
+
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.limits import (QueryLimitExceeded,
+                                       QueryLimitOverride)
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+
+def _config(**kw):
+    return Config(**{str(k): str(v) for k, v in kw.items()})
+
+
+def test_defaults_disabled():
+    limits = QueryLimitOverride(_config())
+    assert limits.get_byte_limit("any.metric") == 0
+    assert limits.get_data_point_limit("any.metric") == 0
+    limits.check("any.metric", 10**9)  # no limit -> no raise
+
+
+def test_default_dp_limit_enforced():
+    limits = QueryLimitOverride(_config(**{
+        "tsd.query.limits.data_points.default": 100}))
+    limits.check("m", 100)
+    with pytest.raises(QueryLimitExceeded):
+        limits.check("m", 101)
+
+
+def test_byte_limit_estimation():
+    limits = QueryLimitOverride(_config(**{
+        "tsd.query.limits.bytes.default": 1600}))
+    limits.check("m", 100)  # 100 * 16 == 1600, at the cap
+    with pytest.raises(QueryLimitExceeded):
+        limits.check("m", 101)
+
+
+def test_negative_defaults_rejected():
+    with pytest.raises(ValueError):
+        QueryLimitOverride(_config(**{
+            "tsd.query.limits.bytes.default": -1}))
+
+
+def test_regex_override_file(tmp_path):
+    path = tmp_path / "limits.json"
+    path.write_text(json.dumps([
+        {"regex": r"^sys\.", "byteLimit": 0, "dataPointsLimit": 5},
+    ]))
+    limits = QueryLimitOverride(_config(**{
+        "tsd.query.limits.data_points.default": 100,
+        "tsd.query.limits.overrides.config": str(path)}))
+    assert limits.get_data_point_limit("sys.cpu.user") == 5
+    assert limits.get_data_point_limit("net.bytes") == 100
+    with pytest.raises(QueryLimitExceeded):
+        limits.check("sys.cpu.user", 6)
+    limits.check("net.bytes", 50)
+
+
+def test_override_file_hot_reload(tmp_path):
+    path = tmp_path / "limits.json"
+    path.write_text(json.dumps([
+        {"regex": "^a", "dataPointsLimit": 5}]))
+    limits = QueryLimitOverride(_config(**{
+        "tsd.query.limits.overrides.config": str(path),
+        "tsd.query.limits.overrides.interval": 1}))
+    assert limits.get_data_point_limit("abc") == 5
+    path.write_text(json.dumps([
+        {"regex": "^a", "dataPointsLimit": 9}]))
+    # force the mtime forward and the next-check window open
+    import os
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    limits._next_check = 0.0
+    assert limits.get_data_point_limit("abc") == 9
+
+
+def test_bad_override_file_keeps_previous(tmp_path):
+    path = tmp_path / "limits.json"
+    path.write_text(json.dumps([
+        {"regex": "^a", "dataPointsLimit": 5}]))
+    limits = QueryLimitOverride(_config(**{
+        "tsd.query.limits.overrides.config": str(path)}))
+    path.write_text("{ not json")
+    import os
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    limits._load()
+    assert limits.get_data_point_limit("abc") == 5
+
+
+def test_end_to_end_413_over_http():
+    tsdb = TSDB(_config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.query.limits.data_points.default": 10}))
+    base = 1356998400
+    for i in range(50):
+        tsdb.add_point("big.metric", base + i, i, {"host": "a"})
+    router = HttpRpcRouter(tsdb)
+    resp = router.handle(HttpRequest(
+        "GET", "/api/query",
+        {"start": [str(base - 10)], "m": ["sum:big.metric"]}))
+    assert resp.status == 413
+    assert b"limit" in resp.body
