@@ -1,0 +1,222 @@
+"""Time-blocked streaming execution for long ranges.
+
+The reference bounds long-time-range queries by streaming hourly rows
+and capping bytes (SURVEY.md §5.7); a materialize-everything array
+pipeline instead hits HBM: 1M series x a week of 1m buckets is 10k
+buckets — 40 GB of f32 cells. This executor streams the query in
+*time blocks* of ``block_buckets`` buckets so device memory stays at
+``O(S x block)`` regardless of range length — the single-chip
+"context parallelism" analogue (the multi-chip time axis of
+:mod:`opentsdb_tpu.parallel.sharded_pipeline` is the same idea across
+devices; this is the same math across a host loop).
+
+Rate and merge interpolation look across block edges; the carries reuse
+the sharded pipeline's boundary kernels:
+
+- pass 1 (forward): per block, bucketize -> fill-policy -> rate with
+  the running prev-carry, collecting each block's boundary summaries
+  ([S]-sized vectors) — grids are discarded;
+- a backward scan over the pass-1 summaries yields each block's
+  *next*-present carry (what LERP needs from future blocks);
+- pass 2 (forward): recompute each block (bucketize+rate are cheaper
+  than holding every grid), inject (prev, next) carries into
+  ``_fill_with_boundaries``, group-reduce, and append the ``[G, Bb]``
+  slab to the output.
+
+Two device passes = 2x FLOPs for unbounded range length at fixed HBM —
+the same trade ``jax.checkpoint`` makes for activations.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opentsdb_tpu.ops import aggregators as aggs_mod
+from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.ops import groupby as gb_mod
+from opentsdb_tpu.ops.pipeline import PipelineSpec
+from opentsdb_tpu.parallel.sharded_pipeline import (_block_boundaries,
+                                                    _fill_with_boundaries,
+                                                    _rate_with_boundary)
+
+# default device-cell budget per block (~256 MB of f32)
+DEFAULT_CELL_BUDGET = 1 << 26
+
+
+def _prep_block(values, series_idx, bucket_idx, num_series, num_buckets,
+                spec, fill_value):
+    """bucketize + downsample fill policy (pipeline steps 1-2)."""
+    from opentsdb_tpu.ops.pipeline import apply_fill_policy
+    grid, cnt = ds_mod.bucketize(values, series_idx, bucket_idx,
+                                 num_series, num_buckets,
+                                 spec.ds_function)
+    return apply_fill_policy(grid, cnt > 0, fill_value, spec)
+
+
+@partial(jax.jit, static_argnames=("spec", "num_buckets"))
+def _pass1_step(values, series_idx, bucket_idx, bucket_ts, rate_params,
+                fill_value, rate_carry, spec: PipelineSpec,
+                num_buckets: int):
+    """One forward-sweep block: returns this block's boundary package.
+
+    rate_carry = (v[S], t[S], p[S]) — the nearest present pre-rate cell
+    in any earlier block (consumed by rate); the returned summaries are
+    *post-rate* boundaries (consumed by interpolation fill).
+    """
+    grid, has_data = _prep_block(values, series_idx, bucket_idx,
+                                 spec.num_series, num_buckets, spec,
+                                 fill_value)
+    (pre_lv, pre_lt, pre_lp), _ = _block_boundaries(grid, bucket_ts)
+    if spec.rate:
+        counter_max, reset_value = rate_params
+        cv, ct, cp = rate_carry
+        grid = _rate_with_boundary(grid, bucket_ts, spec.rate_counter,
+                                   counter_max, reset_value,
+                                   spec.rate_drop_resets, cv, ct, cp)
+        has_data = has_data & ~jnp.isnan(grid)
+    (lv, lt, lp), (fv, ft, fp) = _block_boundaries(grid, bucket_ts)
+    return (pre_lv, pre_lt, pre_lp), (lv, lt, lp), (fv, ft, fp), \
+        grid, has_data
+
+
+@partial(jax.jit, static_argnames=("spec", "num_buckets"))
+def _pass2_step(grid, has_data, bucket_ts, group_ids, prev_carry,
+                next_carry, spec: PipelineSpec, num_buckets: int):
+    """Fill with carries + group reduce one block -> ([G,Bb], emit)."""
+    agg = aggs_mod.get(spec.agg_name)
+    pv, pt, pp = prev_carry
+    nv, nt, np_ = next_carry
+    filled = _fill_with_boundaries(grid, bucket_ts,
+                                   agg.interpolation.value,
+                                   pv, pt, pp, nv, nt, np_)
+    result = gb_mod._group_reduce(filled, group_ids, spec.num_groups,
+                                  agg.name)
+    if spec.fill_policy == ds_mod.FillPolicy.NONE:
+        emit = jax.ops.segment_sum(
+            has_data.astype(jnp.int32), group_ids,
+            num_segments=spec.num_groups) > 0
+    else:
+        emit = jnp.ones((spec.num_groups, grid.shape[-1]), dtype=bool)
+    return result, emit
+
+
+def _merge_carry(nearer, farther):
+    """Combine boundary candidates: keep the nearer block's when
+    present, else the farther carry (same rule as _scan_boundary)."""
+    (v0, t0, p0), (v1, t1, p1) = nearer, farther
+    return (np.where(p0, v0, v1), np.where(p0, t0, t1), p0 | p1)
+
+
+def _empty_carry(num_series, dtype):
+    return (np.zeros(num_series, dtype=dtype),
+            np.zeros(num_series, dtype=dtype),
+            np.zeros(num_series, dtype=bool))
+
+
+def pick_block_buckets(num_series: int, num_buckets: int,
+                       cell_budget: int = DEFAULT_CELL_BUDGET) -> int:
+    """Largest block size keeping S x Bb under the device budget."""
+    if num_series <= 0:
+        return num_buckets
+    return max(1, min(num_buckets, cell_budget // max(num_series, 1)))
+
+
+def execute_blocked(batch_values: np.ndarray, series_idx: np.ndarray,
+                    bucket_idx: np.ndarray, bucket_ts: np.ndarray,
+                    group_ids: np.ndarray, spec: PipelineSpec,
+                    rate_options=None, dtype=None, device=None,
+                    block_buckets: int | None = None
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Streaming equivalent of :func:`opentsdb_tpu.ops.pipeline.execute`
+    for ``emit_raw=False`` queries. Bit-identical results; device
+    memory bounded by ``num_series x block_buckets`` cells."""
+    from opentsdb_tpu.ops.rate import RateOptions
+    if spec.emit_raw:
+        raise ValueError("blocked execution aggregates; emit_raw "
+                         "queries stream per-series instead")
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
+            else jnp.float32
+    np_dtype = np.dtype(dtype)
+    ro = rate_options or RateOptions()
+    s, b, g = spec.num_series, spec.num_buckets, spec.num_groups
+    bb = block_buckets or pick_block_buckets(s, b)
+    rate_params = (jnp.asarray(ro.counter_max, dtype),
+                   jnp.asarray(ro.reset_value, dtype))
+    fv = jnp.asarray(spec.fill_value, dtype)
+
+    # host: order points by bucket so each block is one contiguous slice
+    bucket_idx = np.asarray(bucket_idx)
+    order = np.argsort(bucket_idx, kind="stable")
+    sv = np.asarray(batch_values, dtype=np_dtype)[order]
+    ssi = np.asarray(series_idx, dtype=np.int32)[order]
+    sbi = bucket_idx[order]
+    bucket_ts = np.asarray(bucket_ts)
+    starts = [np.searchsorted(sbi, b0) for b0 in range(0, b, bb)]
+    starts.append(len(sbi))
+    blocks = [(b0, min(b0 + bb, b), starts[i], starts[i + 1])
+              for i, b0 in enumerate(range(0, b, bb))]
+
+    agg = aggs_mod.get(spec.agg_name)
+    needs_next = agg.interpolation.value in ("lerp", "max", "min")
+    put = partial(jax.device_put, device=device)
+
+    def run_block_pass1(blk, rate_carry):
+        b0, b1, p0, p1 = blk
+        nb = b1 - b0
+        carry_dev = tuple(put(jnp.asarray(c)) for c in rate_carry)
+        return _pass1_step(
+            put(jnp.asarray(sv[p0:p1])), put(jnp.asarray(ssi[p0:p1])),
+            put(jnp.asarray(sbi[p0:p1] - b0)),
+            put(jnp.asarray(bucket_ts[b0:b1])), rate_params, fv,
+            carry_dev, spec, nb)
+
+    # pass 1: forward sweep collecting boundary summaries
+    firsts, lasts = [], []
+    rate_carry = _empty_carry(s, np_dtype)
+    for blk in blocks:
+        pre_last, post_last, post_first, _, _ = run_block_pass1(
+            blk, rate_carry)
+        firsts.append(tuple(np.asarray(x) for x in post_first))
+        lasts.append(tuple(np.asarray(x) for x in post_last))
+        if spec.rate:
+            rate_carry = _merge_carry(
+                tuple(np.asarray(x) for x in pre_last), rate_carry)
+
+    # backward scan: next-present carry per block
+    n_blocks = len(blocks)
+    next_carries = [None] * n_blocks
+    nc = _empty_carry(s, np_dtype)
+    for i in range(n_blocks - 1, -1, -1):
+        next_carries[i] = nc
+        if needs_next:
+            nc = _merge_carry(firsts[i], nc)
+
+    # pass 2: forward sweep computing [G, Bb] slabs
+    gids_dev = put(jnp.asarray(np.asarray(group_ids, dtype=np.int32)))
+    out = np.empty((g, b), dtype=np_dtype)
+    emit_out = np.empty((g, b), dtype=bool)
+    rate_carry = _empty_carry(s, np_dtype)
+    prev_carry = _empty_carry(s, np_dtype)
+    for i, blk in enumerate(blocks):
+        b0, b1 = blk[0], blk[1]
+        pre_last, post_last, _, grid, has_data = run_block_pass1(
+            blk, rate_carry)
+        result, emit = _pass2_step(
+            grid, has_data, put(jnp.asarray(bucket_ts[b0:b1])),
+            gids_dev,
+            tuple(put(jnp.asarray(c)) for c in prev_carry),
+            tuple(put(jnp.asarray(c)) for c in next_carries[i]),
+            spec, b1 - b0)
+        out[:, b0:b1] = np.asarray(result)
+        emit_out[:, b0:b1] = np.asarray(emit)
+        if spec.rate:
+            rate_carry = _merge_carry(
+                tuple(np.asarray(x) for x in pre_last), rate_carry)
+        prev_carry = _merge_carry(
+            tuple(np.asarray(x) for x in post_last), prev_carry)
+    return out, emit_out

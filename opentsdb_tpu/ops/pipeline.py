@@ -108,18 +108,25 @@ def run_pipeline_dense(values2d, bucket_ts, group_ids, rate_params,
                             rate_params, fill_value, spec)
 
 
-def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
-                     fill_value, spec: PipelineSpec):
-    g, b = spec.num_groups, spec.num_buckets
-
-    # 2. downsample fill policy (ZERO/SCALAR substitute before rate,
-    #    matching FillingDownsampler feeding RateSpan)
+def apply_fill_policy(grid, has_data, fill_value, spec: "PipelineSpec"):
+    """Downsample fill policy: ZERO/SCALAR substitute before rate,
+    matching FillingDownsampler feeding RateSpan. Shared by the full
+    and the time-blocked (ops.blocked) executors."""
     if spec.fill_policy == ds_mod.FillPolicy.ZERO:
         grid = jnp.where(jnp.isnan(grid), 0.0, grid)
         has_data = jnp.ones_like(has_data)
     elif spec.fill_policy == ds_mod.FillPolicy.SCALAR:
         grid = jnp.where(jnp.isnan(grid), fill_value, grid)
         has_data = jnp.ones_like(has_data)
+    return grid, has_data
+
+
+def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
+                     fill_value, spec: PipelineSpec):
+    g, b = spec.num_groups, spec.num_buckets
+
+    # 2. downsample fill policy
+    grid, has_data = apply_fill_policy(grid, has_data, fill_value, spec)
 
     # 3. rate conversion per series (ref: Downsampler -> RateSpan order)
     if spec.rate:

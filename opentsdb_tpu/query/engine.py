@@ -28,6 +28,9 @@ import numpy as np
 
 from opentsdb_tpu.core.store import TimeSeriesStore
 from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.ops.blocked import (DEFAULT_CELL_BUDGET,
+                                      execute_blocked,
+                                      pick_block_buckets)
 from opentsdb_tpu.ops.pipeline import PipelineSpec, execute
 from opentsdb_tpu.query import filters as filters_mod
 from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
@@ -147,11 +150,23 @@ class QueryEngine:
             rate_counter=sub.rate_options.counter,
             rate_drop_resets=sub.rate_options.drop_resets,
             emit_raw=emit_raw)
-        result, emit = execute(
-            batch.values * rollup_scale if rollup_scale != 1.0
-            else batch.values,
-            batch.series_idx, bucket_idx, bucket_ts, group_ids, spec,
-            sub.rate_options)
+        values = (batch.values * rollup_scale if rollup_scale != 1.0
+                  else batch.values)
+        budget = self.tsdb.config.get_int(
+            "tsd.query.max_device_cells", 0) or DEFAULT_CELL_BUDGET
+        if not emit_raw and \
+                batch.num_series * len(bucket_ts) > budget:
+            # long-range streaming: bound HBM at [S x block] cells
+            # (SURVEY.md §5.7 time-axis blocking)
+            result, emit = execute_blocked(
+                values, batch.series_idx, bucket_idx, bucket_ts,
+                group_ids, spec, sub.rate_options,
+                block_buckets=pick_block_buckets(
+                    batch.num_series, len(bucket_ts), budget))
+        else:
+            result, emit = execute(
+                values, batch.series_idx, bucket_idx, bucket_ts,
+                group_ids, spec, sub.rate_options)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)
