@@ -81,3 +81,27 @@ class SimpleAuthentication:
             return AuthState("", AuthStatus.ERROR,
                              "format: auth <user> <password>")
         return self.authenticate(command[1], command[2])
+
+    def authenticate_http(self, headers: dict[str, str]) -> AuthState:
+        """HTTP: Basic authorization header
+        (ref: AuthenticationChannelHandler HTTP branch)."""
+        import base64
+        if not self._users:
+            # AllowAllAuthenticatingAuthorizer parity: everything
+            # passes, regardless of what headers are attached
+            return AuthState("anonymous", AuthStatus.SUCCESS)
+        raw = headers.get("authorization", "")
+        if not raw:
+            return AuthState("", AuthStatus.UNAUTHORIZED,
+                             "missing Authorization header")
+        scheme, _, payload = raw.partition(" ")
+        if scheme.lower() != "basic":
+            return AuthState("", AuthStatus.UNAUTHORIZED,
+                             f"unsupported auth scheme {scheme!r}")
+        try:
+            user, _, password = base64.b64decode(payload.strip()) \
+                .decode("utf-8").partition(":")
+        except Exception:  # noqa: BLE001
+            return AuthState("", AuthStatus.ERROR,
+                             "malformed Basic credentials")
+        return self.authenticate(user, password)

@@ -44,6 +44,7 @@ class HttpRequest:
     headers: dict[str, str] = field(default_factory=dict)
     body: bytes = b""
     remote: str = ""
+    auth: Any = None  # AuthState when authentication is enabled
 
     def param(self, key: str, default: str | None = None) -> str | None:
         vals = self.params.get(key)
@@ -196,8 +197,17 @@ class HttpRpcRouter:
 
     # -- write path ----------------------------------------------------
 
+    def _check_permission(self, request: HttpRequest, perm) -> None:
+        """(ref: Permissions gating in the RPC handlers)"""
+        if request.auth is not None and \
+                not request.auth.has_permission(perm):
+            raise HttpError(403, "Permission denied",
+                            f"{perm.name} is not granted")
+
     def _handle_put(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: PutDataPointRpc.java:272)"""
+        from opentsdb_tpu.auth.simple import Permissions
+        self._check_permission(request, Permissions.HTTP_PUT)
         if request.method != "POST":
             raise HttpError(405, "Method not allowed",
                             "The HTTP method is not permitted")
@@ -307,6 +317,8 @@ class HttpRpcRouter:
 
     def _handle_query(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: QueryRpc.java:89-128)"""
+        from opentsdb_tpu.auth.simple import Permissions
+        self._check_permission(request, Permissions.HTTP_QUERY)
         sub = rest[0] if rest else ""
         if sub == "last":
             return self._handle_query_last(request)

@@ -18,6 +18,7 @@ import logging
 import time
 import urllib.parse
 
+from opentsdb_tpu.auth.simple import AuthStatus
 from opentsdb_tpu.tsd.http_api import HttpRequest, HttpResponse, \
     HttpRpcRouter
 from opentsdb_tpu.tsd.telnet import (TelnetCloseConnection, TelnetRouter,
@@ -165,7 +166,6 @@ class TSDServer:
                 if words and words[0] == "auth":
                     state = self.tsdb.authentication.authenticate_telnet(
                         words)
-                    from opentsdb_tpu.auth.simple import AuthStatus
                     if state.status == AuthStatus.SUCCESS:
                         authed = True
                         writer.write(b"auth_success\n")
@@ -223,15 +223,30 @@ class TSDServer:
             params = urllib.parse.parse_qs(parsed.query,
                                            keep_blank_values=True)
             peer = writer.get_extra_info("peername")
+            keep_alive = (version == "HTTP/1.1" and
+                          headers.get("connection", "").lower() != "close")
             request = HttpRequest(
                 method=method.upper(), path=parsed.path, params=params,
                 headers=headers, body=body,
                 remote=f"{peer[0]}:{peer[1]}" if peer else "")
-            keep_alive = (version == "HTTP/1.1" and
-                          headers.get("connection", "").lower() != "close")
             if method.upper() == "OPTIONS":
+                # preflight bypasses auth — browsers never attach
+                # Authorization to OPTIONS
                 response = self._cors_preflight(request)
+            elif self.tsdb.authentication is not None and \
+                    (auth_state := self.tsdb.authentication
+                     .authenticate_http(headers)).status \
+                    != AuthStatus.SUCCESS:
+                # first-exchange auth, HTTP flavor (ref:
+                # AuthenticationChannelHandler.java:50)
+                response = HttpResponse(
+                    401, b'{"error":{"code":401,"message":'
+                    b'"Authentication required"}}',
+                    headers={"WWW-Authenticate":
+                             'Basic realm="opentsdb"'})
             else:
+                if self.tsdb.authentication is not None:
+                    request.auth = auth_state
                 t0 = time.monotonic()
                 response = await asyncio.get_event_loop().run_in_executor(
                     None, self.http_router.handle, request)
@@ -265,6 +280,7 @@ class TSDServer:
     async def _write_response(self, writer, response: HttpResponse,
                               version: str, keep_alive: bool) -> None:
         reason = {200: "OK", 204: "No Content", 400: "Bad Request",
+                  401: "Unauthorized", 403: "Forbidden",
                   404: "Not Found", 405: "Method Not Allowed",
                   413: "Request Entity Too Large", 500:
                   "Internal Server Error",

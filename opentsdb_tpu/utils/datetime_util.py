@@ -166,10 +166,18 @@ def next_interval_ms(ts_ms: int, interval: int, unit: str,
     """The start of the calendar interval after the one containing ts_ms."""
     zone = ZoneInfo(tz) if tz else timezone.utc
     start = previous_interval_ms(ts_ms, interval, unit, tz)
-    if unit in ("ms", "s", "m", "h", "d", "w"):
+    if unit in ("ms", "s", "m", "h"):
         step = int(_MULTIPLIERS[unit] * 1000) * interval
         return start + step
     dt = datetime.fromtimestamp(start / 1000, zone)
+    if unit in ("d", "w"):
+        # advance by calendar days, re-anchoring at local midnight —
+        # a fixed 86400s step drifts an hour across DST transitions
+        days = interval * (7 if unit == "w" else 1)
+        target = (dt.date() + timedelta(days=days))
+        dt = datetime(target.year, target.month, target.day,
+                      tzinfo=zone)
+        return int(dt.timestamp() * 1000)
     if unit == "n":
         month = dt.month - 1 + interval
         dt = dt.replace(year=dt.year + month // 12, month=month % 12 + 1)

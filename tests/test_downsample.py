@@ -167,3 +167,50 @@ class TestApplyFill:
         grid = np.array([[1.0, np.nan]])
         out = np.asarray(ds.apply_fill(grid, spec))
         assert np.isnan(out[0, 1])
+
+
+class TestCalendarTimezones:
+    """DST-aware calendar buckets (ref: TestDownsampler calendar cases +
+    DateTime.previousInterval :416 timezone handling)."""
+
+    def test_daily_buckets_cross_spring_forward(self):
+        # US DST began 2013-03-10: March 10 has only 23 hours in
+        # America/New_York. Daily calendar buckets must start at local
+        # midnight on both sides of the transition.
+        from datetime import datetime
+        from zoneinfo import ZoneInfo
+        from opentsdb_tpu.ops.downsample import (
+            DownsamplingSpecification, assign_buckets)
+        tz = ZoneInfo("America/New_York")
+        start = int(datetime(2013, 3, 9, 0, 0, tzinfo=tz)
+                    .timestamp() * 1000)
+        end = int(datetime(2013, 3, 11, 23, 0, tzinfo=tz)
+                  .timestamp() * 1000)
+        spec = DownsamplingSpecification.parse(
+            "1dc-sum", timezone="America/New_York")
+        ts = np.asarray([start, start + 3600_000], dtype=np.int64)
+        _, edges = assign_buckets(ts, spec, start, end)
+        local_starts = [datetime.fromtimestamp(e / 1000, tz)
+                        for e in edges]
+        assert [d.hour for d in local_starts] == [0, 0, 0]
+        assert [d.day for d in local_starts] == [9, 10, 11]
+        # the DST day is 23h long
+        assert (edges[2] - edges[1]) == 23 * 3600_000
+        assert (edges[1] - edges[0]) == 24 * 3600_000
+
+    def test_monthly_buckets_local_midnight(self):
+        from datetime import datetime
+        from zoneinfo import ZoneInfo
+        from opentsdb_tpu.ops.downsample import (
+            DownsamplingSpecification, assign_buckets)
+        tz = ZoneInfo("Europe/Berlin")
+        start = int(datetime(2013, 1, 15, tzinfo=tz).timestamp() * 1000)
+        end = int(datetime(2013, 4, 2, tzinfo=tz).timestamp() * 1000)
+        spec = DownsamplingSpecification.parse(
+            "1nc-sum", timezone="Europe/Berlin")
+        ts = np.asarray([start], dtype=np.int64)
+        idx, edges = assign_buckets(ts, spec, start, end)
+        local = [datetime.fromtimestamp(e / 1000, tz) for e in edges]
+        assert [(d.month, d.day, d.hour) for d in local] == [
+            (1, 1, 0), (2, 1, 0), (3, 1, 0), (4, 1, 0)]
+        assert idx[0] == 0  # Jan 15 lands in the January bucket

@@ -163,3 +163,50 @@ def test_uid_whitelist_empty_patterns_allow_all():
     filt = UniqueIdWhitelistFilter()
     filt.initialize(Config())
     assert filt.allow_uid_assignment("metric", "anything", "m", {})
+
+
+class TestHttpAuth:
+    """HTTP Basic auth + Permissions gating (ref:
+    AuthenticationChannelHandler + Permissions.java:25)."""
+
+    def test_authenticate_http_basic(self):
+        import base64
+        import hashlib
+        from opentsdb_tpu.auth.simple import (AuthStatus,
+                                              SimpleAuthentication)
+        digest = hashlib.sha256(b"secret").hexdigest()
+        auth = SimpleAuthentication(Config(**{
+            "tsd.core.authentication.users": f"alice:{digest}"}))
+        ok = auth.authenticate_http({
+            "authorization": "Basic " + base64.b64encode(
+                b"alice:secret").decode()})
+        assert ok.status is AuthStatus.SUCCESS and ok.user == "alice"
+        bad = auth.authenticate_http({
+            "authorization": "Basic " + base64.b64encode(
+                b"alice:wrong").decode()})
+        assert bad.status is AuthStatus.UNAUTHORIZED
+        missing = auth.authenticate_http({})
+        assert missing.status is AuthStatus.UNAUTHORIZED
+
+    def test_allow_all_without_users(self):
+        from opentsdb_tpu.auth.simple import (AuthStatus,
+                                              SimpleAuthentication)
+        auth = SimpleAuthentication(Config())
+        state = auth.authenticate_http({})
+        assert state.status is AuthStatus.SUCCESS
+
+    def test_permission_denied_returns_403(self):
+        from opentsdb_tpu.auth.simple import (AuthState, AuthStatus,
+                                              Permissions)
+
+        class NoQueryState(AuthState):
+            def has_permission(self, perm):
+                return perm is not Permissions.HTTP_QUERY
+
+        tsdb = _tsdb()
+        router = HttpRpcRouter(tsdb)
+        req = HttpRequest("GET", "/api/query",
+                          {"start": ["1h-ago"], "m": ["sum:x"]},
+                          auth=NoQueryState("bob", AuthStatus.SUCCESS))
+        resp = router.handle(req)
+        assert resp.status == 403
