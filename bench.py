@@ -6,15 +6,25 @@ synthetic workload shaped like BASELINE.json config 3: 1M series, one
 hour window, per-minute samples, 5m avg downsample, rate conversion,
 group-by sum into 100 groups.
 
-Two paths are timed:
+Three paths are timed:
 - the dense regular-cadence path the engine auto-selects for
   fixed-interval data (reshape reductions, memory-bandwidth bound)
+- the fused Pallas kernel (downsample+groupby as two MXU matmuls)
 - the general scatter path (sorted segment reductions) used for
   irregular timestamps
 
-The headline value is the dense path (what the engine actually runs
-for this workload); the scatter number is printed to stderr for the
-record.
+The headline value is the best of dense/pallas (what the engine runs
+for this workload); the scatter number goes to stderr for the record.
+
+Timing method: the backend here may be a tunneled/relayed device where
+``jax.block_until_ready`` returns before the device finishes, so naive
+wall-clock timing reports pure dispatch latency (we measured 40us for a
+workload whose HBM traffic alone needs >250us). Instead each path is
+wrapped in an on-device ``lax.fori_loop`` whose carry perturbs the
+kernel's own input (so XLA cannot hoist the body as loop-invariant),
+the loop is run at two trip counts with a forced host fetch of the tiny
+result, and the per-iteration time is the slope -- cancelling the fixed
+RPC/dispatch overhead exactly.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -23,8 +33,8 @@ iterator path. OpenTSDB publishes no numbers (BASELINE.md); the Java
 pipeline is a per-datapoint virtual-call chain
 (AggregationIterator.java:253-280, single-threaded per query), measured
 in public deployments at single-digit millions of dp/s per query
-thread. We use 10M dp/s as the comparison constant — generous to the
-reference — until a measured Java baseline lands in BASELINE.json.
+thread. We use 10M dp/s as the comparison constant -- generous to the
+reference -- until a measured Java baseline lands in BASELINE.json.
 """
 
 from __future__ import annotations
@@ -53,17 +63,34 @@ def make_batch(num_series: int, points_per: int, num_buckets: int,
     return values, series_idx, bucket_idx, bucket_ts, group_ids
 
 
-def _time(fn, iters=5):
-    """Median wall time with per-iteration blocking (async dispatch
-    without a barrier under-reports on relayed backends)."""
+def _time_device(run_step, arrays, iters=24):
+    """True per-execution device time of ``run_step(eps, *arrays)``.
+
+    run_step must return a small array and must consume ``eps`` in the
+    input of its heavy computation. Returns seconds per execution.
+    """
     import jax
-    jax.block_until_ready(fn())  # warmup/compile
-    times = []
-    for _ in range(iters):
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def rep(n, *arrs):
+        def body(_, c):
+            out = run_step(c * 1e-30, *arrs)
+            return jnp.nan_to_num(out.astype(jnp.float32)).mean()
+        return lax.fori_loop(0, n, body, jnp.float32(0))
+
+    lo, hi = 1, 1 + iters
+    np.asarray(rep(lo, *arrays))  # compile + warm
+
+    def once(n):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+        np.asarray(rep(n, *arrays))
+        return time.perf_counter() - t0
+
+    tlo = min(once(lo) for _ in range(3))
+    thi = min(once(hi) for _ in range(3))
+    return max((thi - tlo) / (hi - lo), 1e-9)
 
 
 def main() -> None:
@@ -97,14 +124,21 @@ def main() -> None:
     d_bts = jax.device_put(jnp.asarray(bucket_ts))
     d_gids = jax.device_put(jnp.asarray(group_ids))
 
-    # dense path (the engine's choice for this regular workload)
+    # dense path (the engine's choice for this regular workload); eps
+    # rides on the values so the reduction re-executes every iteration
+    # (the add fuses into the reduction -- no extra HBM traffic)
     d_vals2d = jax.device_put(
         jnp.asarray(values.reshape(num_series, points_per), dtype))
-    dt_dense = _time(lambda: run_pipeline_dense(
-        d_vals2d, d_bts, d_gids, rate_params, fill_value, spec, k)[0])
+    dt_dense = _time_device(
+        lambda eps, v, bts, gids: run_pipeline_dense(
+            v + eps, bts, gids, rate_params, fill_value, spec, k)[0],
+        (d_vals2d, d_bts, d_gids))
 
-    # fused Pallas kernel (MXU one-hot group reduction); guarded — any
-    # Mosaic failure falls back to the dense XLA number
+    # fused Pallas kernel (MXU one-hot group reduction); eps rides on
+    # the tiny [P,B] operator matrix instead of the values -- perturbing
+    # the 240MB values input would add un-fusable HBM traffic ahead of
+    # the opaque pallas_call and mismeasure it. Guarded: any Mosaic
+    # failure falls back to the dense XLA number.
     dt_pallas = None
     try:
         from opentsdb_tpu.ops import pallas_fused
@@ -112,8 +146,10 @@ def main() -> None:
             vals2d = values.reshape(num_series, points_per)
             args, tile_s, interp = pallas_fused.prepare(
                 vals2d, bucket_ts, group_ids, spec, k, dtype=dtype)
-            dt_pallas = _time(lambda: pallas_fused._run(
-                *args, spec, tile_s, interp)[0])
+            dt_pallas = _time_device(
+                lambda eps, v, g, a, b_, sz: pallas_fused._run(
+                    v, g, a + eps, b_, sz, spec, tile_s, interp)[0],
+                args)
     except Exception as e:  # noqa: BLE001
         print(f"pallas path unavailable: {e}", file=sys.stderr)
 
@@ -121,19 +157,21 @@ def main() -> None:
     d_vals = jax.device_put(jnp.asarray(values, dtype))
     d_sidx = jax.device_put(jnp.asarray(series_idx))
     d_bidx = jax.device_put(jnp.asarray(bucket_idx))
-    dt_scatter = _time(lambda: run_pipeline(
-        d_vals, d_sidx, d_bidx, d_bts, d_gids, rate_params, fill_value,
-        spec)[0])
+    dt_scatter = _time_device(
+        lambda eps, v, si, bi, bts, gids: run_pipeline(
+            v + eps, si, bi, bts, gids, rate_params, fill_value,
+            spec)[0],
+        (d_vals, d_sidx, d_bidx, d_bts, d_gids), iters=8)
 
     dt_best = min(dt_dense, dt_pallas) if dt_pallas else dt_dense
     dps = n_points / dt_best
-    print(f"dense: {dt_dense * 1e3:.1f} ms ({n_points / dt_dense / 1e9:.2f}"
+    print(f"dense: {dt_dense * 1e3:.2f} ms ({n_points / dt_dense / 1e9:.1f}"
           f" G dp/s)  "
-          + (f"pallas: {dt_pallas * 1e3:.1f} ms "
-             f"({n_points / dt_pallas / 1e9:.2f} G dp/s)  "
+          + (f"pallas: {dt_pallas * 1e3:.2f} ms "
+             f"({n_points / dt_pallas / 1e9:.1f} G dp/s)  "
              if dt_pallas else "pallas: n/a  ")
-          + f"scatter: {dt_scatter * 1e3:.1f} ms "
-          f"({n_points / dt_scatter / 1e9:.2f} G dp/s)",
+          + f"scatter: {dt_scatter * 1e3:.2f} ms "
+          f"({n_points / dt_scatter / 1e9:.1f} G dp/s)",
           file=sys.stderr)
     print(json.dumps({
         "metric": "datapoints aggregated/sec/chip",
