@@ -97,8 +97,13 @@ def align_frames(a: SeriesFrame, b: SeriesFrame, operator: str = "union"
         keys = [k for k in a_keys if k in b_keys]
     else:  # union
         keys = list(dict.fromkeys(list(a_keys) + list(b_keys)))
-    # single-series frames broadcast against anything (scalar-like)
-    if a.num_series == 1 and b.num_series > 1:
+    # Only genuinely scalar-like single-series frames broadcast against
+    # the other side: a fully-aggregated result has an empty tag dict.
+    # A tagged single-series frame goes through the keyed join below so
+    # intersection honors tag-set semantics (ref IntersectionIterator).
+    a_scalar = a.num_series == 1 and not (a.tags and a.tags[0])
+    b_scalar = b.num_series == 1 and not (b.tags and b.tags[0])
+    if a_scalar and b.num_series > 1:
         keys = list(b_keys)
         a_rows = np.zeros(len(keys), dtype=int)
         b_rows = np.asarray([b_keys[k] for k in keys])
@@ -107,7 +112,7 @@ def align_frames(a: SeriesFrame, b: SeriesFrame, operator: str = "union"
                             a.metric),
                 SeriesFrame(all_ts, bv[b_rows], tags, b.agg_tags,
                             b.metric))
-    if b.num_series == 1 and a.num_series > 1:
+    if b_scalar and a.num_series > 1:
         keys = list(a_keys)
         b_rows = np.zeros(len(keys), dtype=int)
         av2 = np.stack([av[a_keys[k]] for k in keys]) if keys else av
@@ -117,14 +122,21 @@ def align_frames(a: SeriesFrame, b: SeriesFrame, operator: str = "union"
                             b.metric))
     an = np.full((len(keys), len(all_ts)), np.nan)
     bn = np.full((len(keys), len(all_ts)), np.nan)
+    agg_tags: list[list[str]] = []
     for i, k in enumerate(keys):
+        row_agg: list[str] = []
         if k in a_keys:
             an[i] = av[a_keys[k]]
+            if a_keys[k] < len(a.agg_tags):
+                row_agg = list(a.agg_tags[a_keys[k]])
         if k in b_keys:
             bn[i] = bv[b_keys[k]]
+            if not row_agg and b_keys[k] < len(b.agg_tags):
+                row_agg = list(b.agg_tags[b_keys[k]])
+        agg_tags.append(row_agg)
     tags = [dict(k) for k in keys]
-    return (SeriesFrame(all_ts, an, tags, a.agg_tags, a.metric),
-            SeriesFrame(all_ts, bn, tags, a.agg_tags, b.metric))
+    return (SeriesFrame(all_ts, an, tags, agg_tags, a.metric),
+            SeriesFrame(all_ts, bn, tags, agg_tags, b.metric))
 
 
 def binary_op(a: SeriesFrame, b: SeriesFrame, op: str,
@@ -195,7 +207,8 @@ def fn_moving_average(frame: SeriesFrame, window: str) -> SeriesFrame:
         win_ms = datetime_util.parse_duration_ms(window)
         ts = frame.ts
         for t in range(v.shape[1]):
-            lo = np.searchsorted(ts, ts[t] - win_ms, side="right")
+            # trailing window [t - win, t): inclusive lower edge
+            lo = np.searchsorted(ts, ts[t] - win_ms, side="left")
             if lo < t:
                 seg = v[:, lo:t]
                 with np.errstate(invalid="ignore"):

@@ -1,0 +1,276 @@
+"""Expression engine tests.
+
+Mirrors the reference suites under ``test/query/expression/``
+(TestExpressionIterator, TestIntersectionIterator, TestUnionIterator,
+TestExpressions, and the per-function tests TestAlias, TestScale,
+TestAbsolute, TestMovingAverage, TestHighestCurrent, TestHighestMax,
+TestTimeShift, TestSumSeries ...; ref: src/query/expression/,
+ExpressionFactory.java:32-38).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.engine import QueryResult
+from opentsdb_tpu.query.expression.core import (
+    GEXP_FUNCTIONS, InfixParser, SeriesFrame, align_frames, binary_op,
+    evaluate_expression, fn_highest_current, fn_highest_max,
+    fn_moving_average, fn_time_shift, scalar_op)
+
+
+def frame(ts, rows, tags=None, metric="m"):
+    ts = np.asarray(ts, dtype=np.int64)
+    vals = np.asarray(rows, dtype=float)
+    tags = tags or [{"host": f"web{i:02d}"} for i in
+                    range(vals.shape[0])]
+    return SeriesFrame(ts, vals, tags, [[] for _ in tags], metric)
+
+
+# ---------------------------------------------------------------------------
+# frame construction round-trip
+# ---------------------------------------------------------------------------
+
+class TestSeriesFrame:
+    def test_from_results_builds_union_grid(self):
+        r1 = QueryResult(metric="m", tags={"host": "a"},
+                         aggregated_tags=[], dps=[(0, 1.0), (2000, 3.0)])
+        r2 = QueryResult(metric="m", tags={"host": "b"},
+                         aggregated_tags=[], dps=[(1000, 2.0)])
+        f = SeriesFrame.from_results([r1, r2])
+        assert list(f.ts) == [0, 1000, 2000]
+        assert f.values.shape == (2, 3)
+        assert np.isnan(f.values[0, 1]) and f.values[0, 2] == 3.0
+        assert f.values[1, 1] == 2.0
+
+    def test_to_results_drops_nans(self):
+        f = frame([0, 1000], [[1.0, np.nan]])
+        out = f.to_results()
+        assert out[0].dps == [(0, 1.0)]
+
+    def test_empty(self):
+        f = SeriesFrame.from_results([])
+        assert f.num_series == 0
+
+
+# ---------------------------------------------------------------------------
+# joins (ref: TestIntersectionIterator / TestUnionIterator)
+# ---------------------------------------------------------------------------
+
+class TestJoins:
+    def test_union_keeps_all_series(self):
+        a = frame([0], [[1.0]], tags=[{"host": "a"}])
+        b = frame([0], [[2.0]], tags=[{"host": "b"}])
+        aa, bb = align_frames(a, b, "union")
+        assert aa.num_series == 2 and bb.num_series == 2
+
+    def test_intersection_keeps_common_series(self):
+        a = frame([0], [[1.0], [5.0]],
+                  tags=[{"host": "a"}, {"host": "b"}])
+        b = frame([0], [[2.0]], tags=[{"host": "b"}])
+        aa, bb = align_frames(a, b, "intersection")
+        assert aa.num_series == 1
+        assert aa.tags == [{"host": "b"}]
+        assert aa.values[0, 0] == 5.0 and bb.values[0, 0] == 2.0
+
+    def test_timestamp_union_grid(self):
+        a = frame([0, 2000], [[1.0, 3.0]], tags=[{"host": "a"}])
+        b = frame([1000], [[2.0]], tags=[{"host": "a"}])
+        aa, bb = align_frames(a, b)
+        assert list(aa.ts) == [0, 1000, 2000]
+        assert np.isnan(aa.values[0, 1])
+        assert bb.values[0, 1] == 2.0
+
+    def test_intersection_disjoint_tagged_is_empty(self):
+        # a tagged single-series frame must NOT broadcast: an
+        # intersection over disjoint tag sets is empty
+        a = frame([0], [[1.0]], tags=[{"host": "a"}])
+        b = frame([0], [[2.0], [3.0]],
+                  tags=[{"host": "b"}, {"host": "c"}])
+        aa, bb = align_frames(a, b, "intersection")
+        assert aa.num_series == 0 and bb.num_series == 0
+
+    def test_union_join_attributes_agg_tags_per_row(self):
+        a = SeriesFrame(np.asarray([0], dtype=np.int64),
+                        np.asarray([[1.0]]), [{"host": "x"}],
+                        [["dc"]], "m")
+        b = SeriesFrame(np.asarray([0], dtype=np.int64),
+                        np.asarray([[2.0]]), [{"host": "y"}],
+                        [["rack"]], "m")
+        aa, _ = align_frames(a, b, "union")
+        by_tag = {t["host"]: ag for t, ag in zip(aa.tags, aa.agg_tags)}
+        assert by_tag == {"x": ["dc"], "y": ["rack"]}
+
+    def test_empty_tags_list_does_not_crash(self):
+        a = SeriesFrame(np.asarray([0], dtype=np.int64),
+                        np.asarray([[1.0]]), [], [], "m")
+        b = frame([0], [[2.0]], tags=[{"host": "b"}])
+        align_frames(a, b, "union")   # must not raise
+
+    def test_single_series_broadcasts(self):
+        # a 1-series frame joins against every series of the other side
+        a = frame([0], [[10.0]], tags=[{}])
+        b = frame([0], [[1.0], [2.0]],
+                  tags=[{"host": "a"}, {"host": "b"}])
+        out = binary_op(a, b, "+")
+        assert out.num_series == 2
+        assert sorted(out.values[:, 0]) == [11.0, 12.0]
+
+
+# ---------------------------------------------------------------------------
+# arithmetic (ref: TestExpressionIterator fills + NumericFillPolicy ZERO)
+# ---------------------------------------------------------------------------
+
+class TestArithmetic:
+    def test_add_sub_mul(self):
+        a = frame([0, 1000], [[1.0, 2.0]], tags=[{"host": "a"}])
+        b = frame([0, 1000], [[10.0, 20.0]], tags=[{"host": "a"}])
+        assert list(binary_op(a, b, "+").values[0]) == [11.0, 22.0]
+        assert list(binary_op(a, b, "-").values[0]) == [-9.0, -18.0]
+        assert list(binary_op(a, b, "*").values[0]) == [10.0, 40.0]
+
+    def test_divide_by_zero_yields_zero(self):
+        # ref: expression division guards div-by-zero to 0
+        a = frame([0], [[5.0]], tags=[{"host": "a"}])
+        b = frame([0], [[0.0]], tags=[{"host": "a"}])
+        assert binary_op(a, b, "/").values[0, 0] == 0.0
+
+    def test_missing_fills_zero_one_sided(self):
+        a = frame([0, 1000], [[1.0, np.nan]], tags=[{"host": "a"}])
+        b = frame([0, 1000], [[10.0, 20.0]], tags=[{"host": "a"}])
+        out = binary_op(a, b, "+")
+        assert out.values[0, 1] == 20.0     # nan treated as fill=0
+
+    def test_both_missing_stays_nan(self):
+        a = frame([0], [[np.nan]], tags=[{"host": "a"}])
+        b = frame([0], [[np.nan]], tags=[{"host": "a"}])
+        assert np.isnan(binary_op(a, b, "+").values[0, 0])
+
+    def test_scalar_ops(self):
+        a = frame([0], [[4.0]])
+        assert scalar_op(a, 2.0, "*").values[0, 0] == 8.0
+        assert scalar_op(a, 2.0, "-").values[0, 0] == 2.0
+        assert scalar_op(a, 2.0, "-", scalar_left=True).values[0, 0] \
+            == -2.0
+        assert scalar_op(a, 8.0, "/", scalar_left=True).values[0, 0] \
+            == 2.0
+
+
+# ---------------------------------------------------------------------------
+# gexp function library (ref: ExpressionFactory.java:32-38 + per-fn tests)
+# ---------------------------------------------------------------------------
+
+class TestFunctions:
+    def test_registry_has_all_factory_names_and_aliases(self):
+        # ref: ExpressionFactory.java registers both long and short names
+        expected = {"absolute", "scale", "alias", "movingAverage",
+                    "highestCurrent", "highestMax", "timeShift",
+                    "sumSeries", "diffSeries", "multiplySeries",
+                    "divideSeries", "shift", "sum", "difference",
+                    "multiply", "divide"}
+        assert expected <= set(GEXP_FUNCTIONS)
+
+    def test_absolute(self):
+        f = GEXP_FUNCTIONS["absolute"](frame([0], [[-3.0]]))
+        assert f.values[0, 0] == 3.0
+
+    def test_scale(self):
+        f = GEXP_FUNCTIONS["scale"](frame([0], [[3.0]]), 10)
+        assert f.values[0, 0] == 30.0
+
+    def test_alias_renames_metric(self):
+        f = GEXP_FUNCTIONS["alias"](frame([0], [[1.0]]), "renamed")
+        assert f.metric == "renamed"
+
+    def test_moving_average_count_window(self):
+        f = fn_moving_average(
+            frame([0, 1000, 2000, 3000], [[1.0, 2.0, 3.0, 4.0]]), "2")
+        # window is the trailing n points EXCLUDING the current one
+        assert f.values[0, 2] == pytest.approx(1.5)
+        assert f.values[0, 3] == pytest.approx(2.5)
+
+    def test_moving_average_time_window(self):
+        f = fn_moving_average(
+            frame([0, 1000, 2000, 3000], [[2.0, 4.0, 6.0, 8.0]]), "2s")
+        assert f.values[0, 2] == pytest.approx(3.0)   # avg(2,4)
+        assert f.values[0, 3] == pytest.approx(5.0)   # avg(4,6)
+
+    def test_highest_current(self):
+        f = frame([0, 1000],
+                  [[1.0, 9.0], [2.0, 5.0], [3.0, np.nan]],
+                  tags=[{"h": "a"}, {"h": "b"}, {"h": "c"}])
+        top = fn_highest_current(f, 2)
+        # last values: a=9, b=5, c=3 → top2 = a, b
+        assert [t["h"] for t in top.tags] == ["a", "b"]
+
+    def test_highest_max(self):
+        f = frame([0, 1000],
+                  [[1.0, 4.0], [9.0, 0.0], [2.0, 2.0]],
+                  tags=[{"h": "a"}, {"h": "b"}, {"h": "c"}])
+        top = fn_highest_max(f, 1)
+        assert [t["h"] for t in top.tags] == ["b"]
+
+    def test_time_shift(self):
+        f = fn_time_shift(frame([0, 1000], [[1.0, 2.0]]), "1m")
+        assert list(f.ts) == [60000, 61000]
+
+    def test_sum_series(self):
+        a = frame([0], [[1.0]], tags=[{"host": "a"}])
+        b = frame([0], [[2.0]], tags=[{"host": "a"}])
+        c = frame([0], [[3.0]], tags=[{"host": "a"}])
+        assert GEXP_FUNCTIONS["sumSeries"](a, b, c).values[0, 0] == 6.0
+
+    def test_divide_series(self):
+        a = frame([0], [[8.0]], tags=[{"host": "a"}])
+        b = frame([0], [[2.0]], tags=[{"host": "a"}])
+        assert GEXP_FUNCTIONS["divideSeries"](a, b).values[0, 0] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# infix parser (ref: TestExpressions.java + parser.jj SyntaxChecker)
+# ---------------------------------------------------------------------------
+
+class TestInfixParser:
+    VARS = None
+
+    def setup_method(self):
+        self.vars = {
+            "a": frame([0, 1000], [[2.0, 4.0]], tags=[{"host": "x"}]),
+            "b": frame([0, 1000], [[3.0, 5.0]], tags=[{"host": "x"}]),
+        }
+
+    def test_variable_plus_variable(self):
+        out = evaluate_expression("a + b", self.vars)
+        assert list(out.values[0]) == [5.0, 9.0]
+
+    def test_precedence(self):
+        out = evaluate_expression("a + b * 2", self.vars)
+        assert list(out.values[0]) == [8.0, 14.0]
+
+    def test_parentheses(self):
+        out = evaluate_expression("(a + b) * 2", self.vars)
+        assert list(out.values[0]) == [10.0, 18.0]
+
+    def test_unary_minus(self):
+        out = evaluate_expression("-a", self.vars)
+        assert list(out.values[0]) == [-2.0, -4.0]
+
+    def test_scalar_left(self):
+        out = evaluate_expression("10 - a", self.vars)
+        assert list(out.values[0]) == [8.0, 6.0]
+
+    def test_scalar_only_expression_rejected(self):
+        with pytest.raises(ValueError):
+            evaluate_expression("1 + 2", self.vars)
+
+    def test_unknown_variable_rejected(self):
+        with pytest.raises(ValueError):
+            evaluate_expression("a + zz", self.vars)
+
+    def test_bad_syntax_rejected(self):
+        for expr in ("a +", "(a + b", "a ++ b", "a @ b"):
+            with pytest.raises(ValueError):
+                evaluate_expression(expr, self.vars)
+
+    def test_float_literals(self):
+        out = evaluate_expression("a * 0.5", self.vars)
+        assert list(out.values[0]) == [1.0, 2.0]

@@ -1,0 +1,262 @@
+"""Tree / TreeBuilder / TreeRule tests.
+
+Mirrors the reference suites ``test/tree/TestTree.java``,
+``TestTreeBuilder.java``, ``TestTreeRule.java``, ``TestBranch.java``
+(ref: src/tree/Tree.java:73, TreeBuilder.java:30-59, TreeRule.java:57,
+Branch.java:88).
+"""
+
+import pytest
+
+from opentsdb_tpu.tree.tree import (Branch, Leaf, Tree, TreeBuilder,
+                                    TreeRule, tree_manager)
+
+
+# ---------------------------------------------------------------------------
+# TreeRule (ref: test/tree/TestTreeRule.java)
+# ---------------------------------------------------------------------------
+
+class TestTreeRule:
+    def test_invalid_type_raises(self):
+        with pytest.raises(ValueError):
+            TreeRule(type="BOGUS")
+
+    def test_type_case_normalized(self):
+        assert TreeRule(type="metric").type == "METRIC"
+
+    def test_metric_rule_extracts_metric(self):
+        rule = TreeRule(type="METRIC")
+        assert rule.extract("sys.cpu.user", {}, {}) == ["sys.cpu.user"]
+
+    def test_metric_rule_with_separator_splits(self):
+        # ref: TreeRule separator splits the value into one branch per part
+        rule = TreeRule(type="METRIC", separator=".")
+        assert rule.extract("sys.cpu.user", {}, {}) == \
+            ["sys", "cpu", "user"]
+
+    def test_separator_drops_empty_parts(self):
+        rule = TreeRule(type="METRIC", separator=".")
+        assert rule.extract("sys..cpu", {}, {}) == ["sys", "cpu"]
+
+    def test_tagk_rule_reads_tag_value(self):
+        rule = TreeRule(type="TAGK", field="host")
+        assert rule.extract("m", {"host": "web01"}, {}) == ["web01"]
+
+    def test_tagk_rule_missing_tag_returns_none(self):
+        rule = TreeRule(type="TAGK", field="host")
+        assert rule.extract("m", {"dc": "lax"}, {}) is None
+
+    def test_custom_rules_read_custom_fields(self):
+        for t in ("METRIC_CUSTOM", "TAGK_CUSTOM", "TAGV_CUSTOM"):
+            rule = TreeRule(type=t, custom_field="owner")
+            assert rule.extract("m", {}, {"owner": "ops"}) == ["ops"]
+            assert rule.extract("m", {}, {}) is None
+
+    def test_regex_extracts_group_one(self):
+        # ref: TreeRule regex extraction uses capture group (idx+1)
+        rule = TreeRule(type="TAGK", field="host",
+                        regex=r"^(\w+)\.example\.com$")
+        assert rule.extract("m", {"host": "web01.example.com"}, {}) == \
+            ["web01"]
+
+    def test_regex_no_match_returns_none(self):
+        rule = TreeRule(type="TAGK", field="host", regex=r"^(\d+)$")
+        assert rule.extract("m", {"host": "web01"}, {}) is None
+
+    def test_regex_group_idx(self):
+        rule = TreeRule(type="METRIC", regex=r"^(\w+)\.(\w+)",
+                        regex_group_idx=1)
+        assert rule.extract("sys.cpu.user", {}, {}) == ["cpu"]
+
+    def test_json_round_trip(self):
+        rule = TreeRule(tree_id=1, level=2, order=3, type="TAGK",
+                        field="host", regex=r"(.*)", separator="",
+                        description="d", notes="n")
+        again = TreeRule.from_json(rule.to_json())
+        assert again.to_json() == rule.to_json()
+
+
+# ---------------------------------------------------------------------------
+# TreeBuilder (ref: test/tree/TestTreeBuilder.java)
+# ---------------------------------------------------------------------------
+
+def _metric_tree(separator="."):
+    tree = Tree(1, "test")
+    tree.set_rule(TreeRule(level=0, order=0, type="METRIC",
+                           separator=separator))
+    return tree
+
+
+class TestTreeBuilder:
+    def test_process_files_series_under_path(self):
+        tree = _metric_tree()
+        path = TreeBuilder(tree).process("0101", "sys.cpu.user",
+                                         {"host": "web01"})
+        assert path == ["sys", "cpu", "user"]
+        assert "sys" in tree.root.branches
+        assert "cpu" in tree.root.branches["sys"].branches
+        leaf = tree.root.branches["sys"].branches["cpu"].leaves["user"]
+        assert leaf.tsuid == "0101"
+        assert leaf.metric == "sys.cpu.user"
+
+    def test_level_order_fallback(self):
+        # within one level, orders are tried until a rule matches
+        tree = Tree(1)
+        tree.set_rule(TreeRule(level=0, order=0, type="TAGK",
+                               field="dc"))
+        tree.set_rule(TreeRule(level=0, order=1, type="TAGK",
+                               field="host"))
+        tree.set_rule(TreeRule(level=1, order=0, type="METRIC"))
+        path = TreeBuilder(tree).process("0202", "m",
+                                         {"host": "web01"})
+        assert path == ["web01", "m"]
+
+    def test_multi_level_path(self):
+        tree = Tree(1)
+        tree.set_rule(TreeRule(level=0, order=0, type="TAGK",
+                               field="dc"))
+        tree.set_rule(TreeRule(level=1, order=0, type="METRIC",
+                               separator="."))
+        path = TreeBuilder(tree).process(
+            "0303", "sys.cpu", {"dc": "lax", "host": "web01"})
+        assert path == ["lax", "sys", "cpu"]
+
+    def test_no_match_recorded_in_not_matched(self):
+        tree = Tree(1)
+        tree.set_rule(TreeRule(level=0, order=0, type="TAGK",
+                               field="absent"))
+        assert TreeBuilder(tree).process("0404", "m", {}) is None
+        assert "0404" in tree.not_matched
+
+    def test_store_failures_off_skips_recording(self):
+        tree = Tree(1)
+        tree.store_failures = False
+        tree.set_rule(TreeRule(level=0, order=0, type="TAGK",
+                               field="absent"))
+        TreeBuilder(tree).process("0505", "m", {})
+        assert tree.not_matched == {}
+
+    def test_leaf_collision_recorded(self):
+        # ref: TreeBuilder collision handling — same leaf name from a
+        # different tsuid is rejected and recorded
+        tree = _metric_tree(separator="")
+        assert TreeBuilder(tree).process("0A", "cpu", {}) == ["cpu"]
+        assert TreeBuilder(tree).process("0B", "cpu", {}) is None
+        assert tree.collisions.get("0B") == "0A"
+
+    def test_same_tsuid_reprocess_is_idempotent(self):
+        tree = _metric_tree(separator="")
+        assert TreeBuilder(tree).process("0A", "cpu", {}) == ["cpu"]
+        assert TreeBuilder(tree).process("0A", "cpu", {}) == ["cpu"]
+        assert tree.collisions == {}
+
+
+# ---------------------------------------------------------------------------
+# Tree CRUD + Branch (ref: TestTree.java / TestBranch.java)
+# ---------------------------------------------------------------------------
+
+class TestTree:
+    def test_set_get_delete_rule(self):
+        tree = Tree(1)
+        tree.set_rule(TreeRule(level=0, order=0, type="METRIC"))
+        assert tree.get_rule(0, 0) is not None
+        assert tree.delete_rule(0, 0)
+        assert tree.get_rule(0, 0) is None
+        assert not tree.delete_rule(0, 0)
+
+    def test_delete_all_rules(self):
+        tree = Tree(1)
+        tree.set_rule(TreeRule(level=0, order=0, type="METRIC"))
+        tree.set_rule(TreeRule(level=1, order=0, type="METRIC"))
+        tree.delete_all_rules()
+        assert tree.rules == {}
+
+    def test_update_respects_overwrite_flag(self):
+        tree = Tree(1, "orig", "desc")
+        tree.update({"name": "", "description": "new"}, overwrite=False)
+        assert tree.name == "orig"          # empty value ignored
+        assert tree.description == "new"
+        tree.update({"name": ""}, overwrite=True)
+        assert tree.name == ""
+
+    def test_to_json_shape(self):
+        tree = Tree(7, "n", "d")
+        tree.set_rule(TreeRule(level=0, order=0, type="METRIC"))
+        js = tree.to_json()
+        assert js["treeId"] == 7
+        assert js["rules"][0]["type"] == "METRIC"
+        assert set(js) >= {"name", "description", "strictMatch",
+                           "enabled", "storeFailures", "created"}
+
+    def test_branch_ids_stable_and_distinct(self):
+        a = Branch(1, ("sys",), "sys")
+        b = Branch(1, ("sys", "cpu"), "cpu")
+        assert a.branch_id != b.branch_id
+        assert a.branch_id == Branch(1, ("sys",), "sys").branch_id
+        assert a.depth == 1 and b.depth == 2
+
+    def test_branch_json_includes_children_and_leaves(self):
+        root = Branch(1, (), "ROOT")
+        child = Branch(1, ("sys",), "sys")
+        child.leaves["cpu"] = Leaf("cpu", "0101", "sys.cpu")
+        root.branches["sys"] = child
+        js = root.to_json()
+        assert js["branches"][0]["displayName"] == "sys"
+        assert child.to_json()["leaves"][0]["tsuid"] == "0101"
+
+
+# ---------------------------------------------------------------------------
+# TreeManager against a live TSDB (realtime + sync, ref: TreeSync.java,
+# TSDB.processTSMetaThroughTrees :2033)
+# ---------------------------------------------------------------------------
+
+class TestTreeManager:
+    def test_create_get_delete(self, tsdb):
+        mgr = tree_manager(tsdb)
+        tree = mgr.create_tree("t1")
+        assert mgr.get_tree(tree.tree_id) is tree
+        assert mgr.all_trees() == [tree]
+        # definition=False clears content but keeps the tree
+        tree.root.branches["x"] = Branch(tree.tree_id, ("x",), "x")
+        assert mgr.delete_tree(tree.tree_id, definition=False)
+        assert mgr.get_tree(tree.tree_id).root.branches == {}
+        assert mgr.delete_tree(tree.tree_id, definition=True)
+        assert mgr.get_tree(tree.tree_id) is None
+
+    def test_sync_all_files_written_series(self, tsdb):
+        mgr = tree_manager(tsdb)
+        tree = mgr.create_tree("by-host")
+        tree.set_rule(TreeRule(level=0, order=0, type="TAGK",
+                               field="host"))
+        tree.set_rule(TreeRule(level=1, order=0, type="METRIC"))
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "web01"})
+        tsdb.add_point("sys.cpu.user", 1356998400, 2, {"host": "web02"})
+        n = mgr.sync_all()
+        assert n == 2
+        assert set(tree.root.branches) == {"web01", "web02"}
+        assert "sys.cpu.user" in tree.root.branches["web01"].leaves
+
+    def test_get_branch_by_id(self, tsdb):
+        mgr = tree_manager(tsdb)
+        tree = mgr.create_tree("t")
+        tree.set_rule(TreeRule(level=0, order=0, type="METRIC",
+                               separator="."))
+        TreeBuilder(tree).process("0101", "sys.cpu", {})
+        sys_branch = tree.root.branches["sys"]
+        assert mgr.get_branch(sys_branch.branch_id) is sys_branch
+        assert mgr.get_root_branch(tree.tree_id) is tree.root
+        assert mgr.get_branch("ffffffffffffffff") is None
+
+    def test_test_tsuids_endpoint(self, tsdb):
+        mgr = tree_manager(tsdb)
+        tree = mgr.create_tree("t")
+        tree.set_rule(TreeRule(level=0, order=0, type="METRIC"))
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "web01"})
+        mid = tsdb.uids.metrics.get_id("sys.cpu.user")
+        kid = tsdb.uids.tag_names.get_id("host")
+        vid = tsdb.uids.tag_values.get_id("web01")
+        tsuid = tsdb.uids.tsuid(mid, [(kid, vid)]).hex().upper()
+        out = mgr.test_tsuids(tree, [tsuid, "DEADBEEF0000"])
+        assert out[tsuid]["valid"] is True
+        assert out[tsuid]["branch"] == ["sys.cpu.user"]
+        assert out["DEADBEEF0000"]["valid"] is False
