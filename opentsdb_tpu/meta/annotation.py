@@ -77,10 +77,12 @@ class AnnotationStore:
 
     def delete_range(self, tsuids: list[str] | None, start_sec: int,
                      end_sec: int) -> int:
-        """Bulk delete (ref: AnnotationRpc bulk delete)."""
+        """Bulk delete (ref: AnnotationRpc bulk delete). ``tsuids=None``
+        means global annotations only, matching the reference's
+        global-flag semantics."""
         count = 0
         with self._lock:
-            keys = tsuids if tsuids is not None else list(self._by_tsuid)
+            keys = tsuids if tsuids is not None else [GLOBAL_TSUID]
             for tsuid in keys:
                 d = self._by_tsuid.get(tsuid)
                 if not d:

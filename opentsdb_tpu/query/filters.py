@@ -276,8 +276,8 @@ class FilterEvaluator:
         """Return the boolean keep-mask over ``sids``.
 
         ``tag_triples`` is the metric index's [T,3] (sid, tagk, tagv).
-        Filters on the same tag key OR together; across keys AND
-        (ref: TsdbQuery filter application semantics).
+        Every filter must pass — same-key and cross-key filters all AND
+        together (ref: TsdbQuery/SaltScanner filter chain semantics).
         """
         if len(sids) == 0:
             return np.zeros(0, dtype=bool)

@@ -483,12 +483,16 @@ class HttpRpcRouter:
         if request.method == "DELETE":
             obj = json.loads(request.body or b"{}")
             tsuids = obj.get("tsuids")
-            if obj.get("global") or not tsuids:
-                tsuids = [""] if obj.get("global") else tsuids
+            if obj.get("global"):
+                tsuids = [""]
+            elif not tsuids:
+                # ref: Annotation.deleteRange requires tsuids or global
+                raise HttpError(
+                    400, "Please supply either the global flag or tsuids")
             start = int(obj.get("startTime", 0))
             end = int(obj.get("endTime") or time.time())
             count = store.delete_range(
-                [t.upper() for t in tsuids] if tsuids else None, start, end)
+                [t.upper() for t in tsuids], start, end)
             obj["totalDeleted"] = count
             return HttpResponse(200, json.dumps(obj).encode())
         raise HttpError(405, "Method not allowed")

@@ -1,0 +1,192 @@
+"""Histogram / sketch pipeline tests.
+
+Mirrors the reference suites ``test/core/TestSimpleHistogram.java``,
+``TestHistogramCodecManager.java``, ``TestHistogramAggregation*.java``
+and the histogram query routing of ``TestTsdbQueryHistogram*``
+(ref: src/core/SimpleHistogram.java:43, HistogramCodecManager.java:47,
+TsdbQuery.isHistogramQuery :776).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.histogram import (HistogramCodecManager,
+                                         SimpleHistogram,
+                                         SimpleHistogramCodec)
+
+
+def hist(bounds, counts, underflow=0, overflow=0):
+    h = SimpleHistogram(bounds)
+    h.counts = list(counts)
+    h.underflow = underflow
+    h.overflow = overflow
+    return h
+
+
+class TestSimpleHistogram:
+    def test_add_routes_to_bucket(self):
+        h = SimpleHistogram([0.0, 10.0, 20.0])
+        h.add(5.0)
+        h.add(15.0, count=3)
+        assert h.counts == [1, 3]
+
+    def test_add_under_over_flow(self):
+        h = SimpleHistogram([0.0, 10.0])
+        h.add(-1.0)
+        h.add(10.0)   # hi edge is exclusive -> overflow
+        h.add(99.0)
+        assert h.underflow == 1 and h.overflow == 2
+
+    def test_add_without_buckets_raises(self):
+        with pytest.raises(ValueError):
+            SimpleHistogram().add(1.0)
+
+    def test_total_count(self):
+        assert hist([0, 1, 2], [3, 4], 1, 2).total_count() == 10
+
+    def test_percentile_midpoint_convention(self):
+        # ref: SimpleHistogram.percentile :133 returns the midpoint of
+        # the bucket whose cumulative count crosses the rank
+        h = hist([0.0, 10.0, 20.0, 30.0], [10, 10, 10])
+        assert h.percentile(10) == 5.0
+        assert h.percentile(50) == 15.0
+        assert h.percentile(95) == 25.0
+
+    def test_percentile_overflow_returns_top_bound(self):
+        h = hist([0.0, 10.0], [1], overflow=99)
+        assert h.percentile(99) == 10.0
+
+    def test_percentile_empty_is_zero(self):
+        assert SimpleHistogram([0.0, 1.0]).percentile(50) == 0.0
+
+    def test_percentile_validates_range(self):
+        with pytest.raises(ValueError):
+            hist([0, 1], [1]).percentile(101)
+
+    def test_merge_bucket_wise_sum(self):
+        a = hist([0.0, 1.0, 2.0], [1, 2], 1, 0)
+        b = hist([0.0, 1.0, 2.0], [10, 20], 0, 5)
+        a.merge(b)
+        assert a.counts == [11, 22]
+        assert a.underflow == 1 and a.overflow == 5
+
+    def test_merge_mismatched_bounds_raises(self):
+        a = hist([0.0, 1.0], [1])
+        with pytest.raises(ValueError):
+            a.merge(hist([0.0, 2.0], [1]))
+
+    def test_merge_into_empty_adopts_bounds(self):
+        a = SimpleHistogram()
+        a.merge(hist([0.0, 1.0], [7]))
+        assert a.bounds == [0.0, 1.0] and a.counts == [7]
+
+    def test_set_bucket_append_and_prepend(self):
+        h = SimpleHistogram()
+        h.set_bucket(0.0, 1.0, 5)
+        h.set_bucket(1.0, 2.0, 6)       # append adjacent
+        h.set_bucket(-1.0, 0.0, 7)      # prepend adjacent
+        assert h.bounds == [-1.0, 0.0, 1.0, 2.0]
+        assert h.counts == [7, 5, 6]
+        h.set_bucket(0.0, 1.0, 9)       # overwrite existing
+        assert h.counts == [7, 9, 6]
+
+    def test_set_bucket_overlap_raises(self):
+        h = SimpleHistogram([0.0, 10.0])
+        with pytest.raises(ValueError):
+            h.set_bucket(5.0, 15.0, 1)
+
+    def test_json_shape(self):
+        js = hist([0.0, 1.0], [4], 1, 2).to_json()
+        assert js == {"buckets": {"0.0,1.0": 4}, "underflow": 1,
+                      "overflow": 2}
+
+
+class TestCodec:
+    def test_round_trip(self):
+        h = hist([0.0, 1.5, 3.0], [5, 9], 2, 7)
+        codec = SimpleHistogramCodec()
+        blob = codec.encode(h, include_id=True)
+        assert blob[0] == 0x01
+        back = codec.decode(blob, includes_id=True)
+        assert back.bounds == h.bounds
+        assert back.counts == h.counts
+        assert back.underflow == 2 and back.overflow == 7
+
+    def test_manager_dispatch_by_leading_byte(self):
+        mgr = HistogramCodecManager()
+        h = hist([0.0, 1.0], [3])
+        blob = mgr.encode(h, codec_id=1)
+        assert mgr.decode(blob).counts == [3]
+
+    def test_manager_unknown_codec(self):
+        mgr = HistogramCodecManager()
+        with pytest.raises(ValueError):
+            mgr.decode(b"\x7fjunk")
+        with pytest.raises(ValueError):
+            mgr.decode(b"")
+
+    def test_manager_config_registration(self):
+        # ref: HistogramCodecManager.java:70 JSON id<->class config map
+        from opentsdb_tpu.utils.config import Config
+        cfg = Config(**{
+            "tsd.core.histograms.config":
+                '{"opentsdb_tpu.core.histogram.SimpleHistogramCodec": 2}',
+        })
+        mgr = HistogramCodecManager(cfg)
+        h = hist([0.0, 1.0], [3])
+        blob = mgr.encode(h, codec_id=2)
+        assert blob[0] == 2
+        assert mgr.decode(blob).counts == [3]
+
+
+# ---------------------------------------------------------------------------
+# write + query path (ref: TestTsdbQueryHistogram*: /api/histogram
+# ingest, percentile extraction routed via TSSubQuery.percentiles)
+# ---------------------------------------------------------------------------
+
+class TestHistogramQueryPath:
+    BOUNDS = [0.0, 10.0, 20.0, 30.0]
+
+    def seed(self, tsdb):
+        for i, counts in enumerate(([10, 0, 0], [0, 10, 0])):
+            blob = tsdb.histogram_manager.encode(hist(self.BOUNDS, counts))
+            tsdb.add_histogram_point(
+                "req.latency", 1356998400 + i * 60, blob,
+                {"host": "web01"})
+
+    def test_add_and_query_percentile(self, tsdb):
+        from opentsdb_tpu.query.model import TSQuery
+        self.seed(tsdb)
+        q = TSQuery.from_json({
+            "start": 1356998000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "req.latency",
+                         "percentiles": [50.0]}],
+        })
+        results = tsdb.execute_query(q.validate())
+        assert len(results) == 1
+        dps = dict(results[0].dps)
+        # dp1: all mass in [0,10) -> p50 midpoint 5; dp2: [10,20) -> 15
+        assert dps[1356998400000] == 5.0
+        assert dps[1356998460000] == 15.0
+
+    def test_histogram_merge_across_series(self, tsdb):
+        from opentsdb_tpu.query.model import TSQuery
+        h1 = tsdb.histogram_manager.encode(hist(self.BOUNDS, [10, 0, 0]))
+        h2 = tsdb.histogram_manager.encode(hist(self.BOUNDS, [0, 0, 10]))
+        tsdb.add_histogram_point("req.latency", 1356998400, h1,
+                                 {"host": "a"})
+        tsdb.add_histogram_point("req.latency", 1356998400, h2,
+                                 {"host": "b"})
+        q = TSQuery.from_json({
+            "start": 1356998000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "req.latency",
+                         "percentiles": [50.0, 99.0]}],
+        })
+        results = tsdb.execute_query(q.validate())
+        # one output series per requested percentile
+        by_pct = {r.tags.get("_percentile") or r.metric: dict(r.dps)
+                  for r in results}
+        assert len(results) == 2
+        # merged: 10 in [0,10) + 10 in [20,30): p50 -> 5.0, p99 -> 25.0
+        vals = sorted(v[1356998400000] for v in by_pct.values())
+        assert vals == [5.0, 25.0]

@@ -1,0 +1,177 @@
+"""UIDMeta / TSMeta / Annotation tests.
+
+Mirrors the reference suites ``test/meta/TestUIDMeta.java``,
+``TestTSMeta.java``, ``TestAnnotation.java``
+(ref: src/meta/UIDMeta.java:71, TSMeta.java:75, Annotation.java:79).
+"""
+
+import pytest
+
+from opentsdb_tpu.meta.annotation import (Annotation, AnnotationStore,
+                                          GLOBAL_TSUID)
+from opentsdb_tpu.meta.meta_store import MetaStore
+
+
+# ---------------------------------------------------------------------------
+# realtime TSMeta/UIDMeta tracking (ref: TSDB.java:1225-1245,
+# tsd.core.meta.enable_realtime_ts)
+# ---------------------------------------------------------------------------
+
+def tracking_tsdb():
+    from opentsdb_tpu import TSDB, Config
+    return TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.core.meta.enable_realtime_ts": "true",
+        "tsd.core.meta.enable_realtime_uid": "true",
+    }))
+
+
+class TestMetaStore:
+    def test_disabled_by_default(self, tsdb):
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        assert tsdb.meta.all_ts_meta() == []
+
+    def test_tsmeta_created_on_first_write(self):
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        metas = tsdb.meta.all_ts_meta()
+        assert len(metas) == 1
+        meta = metas[0]
+        assert meta.metric.name == "sys.cpu.user"
+        assert [m.name for m in meta.tags] == ["host", "a"]
+        assert meta.total_dps == 1
+
+    def test_counter_increments_per_datapoint(self):
+        tsdb = tracking_tsdb()
+        for i in range(5):
+            tsdb.add_point("m", 1356998400 + i, i, {"host": "a"})
+        meta = tsdb.meta.all_ts_meta()[0]
+        assert meta.total_dps == 5
+        assert meta.last_received > 0
+
+    def test_distinct_series_distinct_tsmeta(self):
+        tsdb = tracking_tsdb()
+        tsdb.add_point("m", 1356998400, 1, {"host": "a"})
+        tsdb.add_point("m", 1356998400, 2, {"host": "b"})
+        assert len(tsdb.meta.all_ts_meta()) == 2
+
+    def test_get_by_tsuid_case_insensitive(self):
+        tsdb = tracking_tsdb()
+        tsdb.add_point("m", 1356998400, 1, {"host": "a"})
+        tsuid = tsdb.meta.all_ts_meta()[0].tsuid
+        assert tsdb.meta.get_ts_meta(tsuid.lower()) is not None
+
+    def test_uid_meta_tracked(self):
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        mid = tsdb.uids.metrics.get_id("sys.cpu.user")
+        hexid = tsdb.uids.metrics.int_to_uid(mid).hex().upper()
+        meta = tsdb.meta.get_uid_meta("metric", hexid)
+        assert meta is not None and meta.type == "METRIC"
+        assert meta.name == "sys.cpu.user"
+
+    def test_tsmeta_json_shape(self):
+        tsdb = tracking_tsdb()
+        tsdb.add_point("m", 1356998400, 1, {"host": "a"})
+        js = tsdb.meta.all_ts_meta()[0].to_json()
+        assert set(js) >= {"tsuid", "displayName", "description",
+                           "created", "units", "retention",
+                           "lastReceived", "totalDatapoints",
+                           "metric", "tags"}
+
+    def test_search_plugin_indexing(self):
+        tsdb = tracking_tsdb()
+        seen = []
+
+        class Plug:
+            def index_ts_meta(self, m):
+                seen.append(("ts", m.tsuid))
+
+            def index_uid_meta(self, m):
+                seen.append(("uid", m.name))
+
+        tsdb.search_plugin = Plug()
+        tsdb.meta._tsdb = tsdb
+        tsdb.add_point("m", 1356998400, 1, {"host": "a"})
+        kinds = {k for k, _ in seen}
+        assert kinds == {"ts", "uid"}
+
+    def test_purge(self):
+        tsdb = tracking_tsdb()
+        tsdb.add_point("m", 1356998400, 1, {"host": "a"})
+        n_ts, n_uid = tsdb.meta.purge()
+        assert n_ts == 1 and n_uid == 3  # metric + tagk + tagv
+        assert tsdb.meta.all_ts_meta() == []
+
+
+# ---------------------------------------------------------------------------
+# Annotations (ref: TestAnnotation.java, Annotation.java:156-266)
+# ---------------------------------------------------------------------------
+
+class TestAnnotationStore:
+    def make(self):
+        store = AnnotationStore()
+        store.store(Annotation(tsuid="0101", start_time=100,
+                               description="ts-note"))
+        store.store(Annotation(start_time=150, description="global-1"))
+        store.store(Annotation(start_time=250, description="global-2"))
+        return store
+
+    def test_store_and_get(self):
+        store = self.make()
+        note = store.get("0101", 100)
+        assert note is not None and note.description == "ts-note"
+        assert store.get("0101", 999) is None
+
+    def test_store_merges_on_same_key(self):
+        store = AnnotationStore()
+        store.store(Annotation(tsuid="01", start_time=5,
+                               description="a"))
+        updated = store.store(Annotation(tsuid="01", start_time=5,
+                                         description="b", notes="n"))
+        assert updated.description == "b"
+        assert store.get("01", 5).notes == "n"
+
+    def test_global_range(self):
+        store = self.make()
+        got = store.global_range(0, 200)
+        assert [a.description for a in got] == ["global-1"]
+        assert len(store.global_range(0, 300)) == 2
+
+    def test_per_tsuid_range(self):
+        store = self.make()
+        assert len(store.range("0101", 0, 200)) == 1
+        assert store.range("0101", 101, 200) == []
+
+    def test_delete(self):
+        store = self.make()
+        assert store.delete("0101", 100)
+        assert not store.delete("0101", 100)
+        assert store.get("0101", 100) is None
+
+    def test_delete_range_global(self):
+        store = self.make()
+        n = store.delete_range(None, 0, 200)
+        assert n == 1
+        assert [a.description for a in store.global_range(0, 300)] == \
+            ["global-2"]
+
+    def test_delete_range_tsuids(self):
+        store = self.make()
+        n = store.delete_range(["0101"], 0, 200)
+        assert n == 1
+        assert store.get("0101", 100) is None
+        # globals untouched
+        assert len(store.global_range(0, 300)) == 2
+
+    def test_json_round_trip(self):
+        note = Annotation(tsuid="0101", start_time=100, end_time=200,
+                          description="d", notes="n",
+                          custom={"k": "v"})
+        again = Annotation.from_json(note.to_json())
+        assert again == note
+
+    def test_global_json_omits_tsuid(self):
+        js = Annotation(start_time=1).to_json()
+        assert "tsuid" not in js
+        assert Annotation.from_json(js).tsuid == GLOBAL_TSUID
