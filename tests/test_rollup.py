@@ -1,0 +1,200 @@
+"""Rollup / pre-aggregation tests.
+
+Mirrors the reference suites ``test/rollup/TestRollupConfig.java``,
+``TestRollupInterval.java``, ``TestRollupQuery.java``,
+``TestRollupUtils.java`` and the query-side rollup routing of
+``TestTsdbQueryRollup*`` (ref: src/rollup/, TsdbQuery.java:143-150,:750,
+TSDB.java:1320).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.rollup.config import (DEFAULT_AGG_IDS, RollupConfig,
+                                        RollupInterval)
+from opentsdb_tpu.rollup.job import run_rollup_job
+
+
+def run_query(tsdb, obj):
+    return tsdb.execute_query(TSQuery.from_json(obj).validate())
+
+
+# ---------------------------------------------------------------------------
+# config (ref: TestRollupConfig / TestRollupInterval)
+# ---------------------------------------------------------------------------
+
+class TestRollupConfig:
+    def test_interval_parse(self):
+        iv = RollupInterval("t", "p", "10m", "1d")
+        assert iv.interval_ms == 600_000
+        assert iv.unit == "m"
+
+    def test_empty_config_rejected(self):
+        with pytest.raises(ValueError):
+            RollupConfig([])
+
+    def test_intervals_sorted_by_width(self):
+        cfg = RollupConfig([
+            RollupInterval("t1h", "p1h", "1h"),
+            RollupInterval("t1m", "p1m", "1m"),
+        ])
+        assert [iv.interval for iv in cfg.intervals] == ["1m", "1h"]
+
+    def test_get_interval(self):
+        cfg = RollupConfig.default()
+        assert cfg.get_interval("1m").table == "tsdb-rollup-1m"
+        with pytest.raises(ValueError):
+            cfg.get_interval("7m")
+
+    def test_best_match_picks_largest_dividing_tier(self):
+        cfg = RollupConfig.default()   # 1m + 1h tiers
+        assert cfg.best_match(3_600_000).interval == "1h"
+        assert cfg.best_match(600_000).interval == "1m"   # 10m: 1m divides
+        assert cfg.best_match(7_200_000).interval == "1h"  # 2h
+        assert cfg.best_match(30_000) is None              # 30s < 1m: raw
+        assert cfg.best_match(90_000) is None              # 1m doesn't divide 90s
+
+    def test_agg_id_mapping(self):
+        cfg = RollupConfig.default()
+        assert cfg.agg_ids == DEFAULT_AGG_IDS
+        assert cfg.id_to_agg[0] == "sum"
+
+    def test_json_round_trip(self):
+        cfg = RollupConfig.default()
+        again = RollupConfig.from_json(cfg.to_json())
+        assert again.to_json() == cfg.to_json()
+
+    def test_from_json_bare_list(self):
+        cfg = RollupConfig.from_json([{"interval": "5m"}])
+        assert cfg.intervals[0].table == "tsdb-rollup-5m"
+        assert cfg.intervals[0].interval_ms == 300_000
+
+
+# ---------------------------------------------------------------------------
+# write paths (ref: TSDB.addAggregatePoint :1320, the _aggregate tag)
+# ---------------------------------------------------------------------------
+
+class TestRollupWrites:
+    def test_add_aggregate_point_to_tier(self, tsdb):
+        tsdb.add_aggregate_point("m", 1356998400, 60.0, {"host": "a"},
+                                 is_groupby=False, interval="1m",
+                                 rollup_agg="SUM")
+        store = tsdb.rollup_store.tier("1m", "sum")
+        assert store.total_points() == 1
+
+    def test_add_aggregate_point_unknown_interval(self, tsdb):
+        with pytest.raises(ValueError):
+            tsdb.add_aggregate_point("m", 1356998400, 1.0, {"h": "a"},
+                                     is_groupby=False, interval="9m",
+                                     rollup_agg="sum")
+
+    def test_add_aggregate_point_missing_agg(self, tsdb):
+        with pytest.raises(ValueError):
+            tsdb.add_aggregate_point("m", 1356998400, 1.0, {"h": "a"},
+                                     is_groupby=False, interval="1m",
+                                     rollup_agg=None)
+
+    def test_preagg_point_without_interval(self, tsdb):
+        tsdb.add_aggregate_point("m", 1356998400, 5.0, {"host": "a"},
+                                 is_groupby=True, interval=None,
+                                 rollup_agg=None, groupby_agg="sum")
+        # no exception: stored in the pre-agg ("groupby") table
+
+
+# ---------------------------------------------------------------------------
+# rollup job (ref: BASELINE.json config 5; SURVEY §2.3 external jobs)
+# ---------------------------------------------------------------------------
+
+class TestRollupJob:
+    def seed(self, tsdb, n_points=120, step=10):
+        base = 1356998400
+        for i in range(n_points):
+            tsdb.add_point("m", base + i * step, 1.0, {"host": "a"})
+        return base
+
+    def test_job_writes_all_tiers_and_aggs(self, tsdb):
+        base = self.seed(tsdb)
+        written = run_rollup_job(tsdb, base * 1000,
+                                 (base + 1200) * 1000)
+        # 120 pts @10s over 20min -> 20 one-minute buckets per agg
+        assert written["1m"] == 20 * 4   # sum/count/min/max
+        assert written["1h"] == 1 * 4
+        tier = tsdb.rollup_store.tier("1m", "sum")
+        sid = tier.series_ids_for_metric(
+            tsdb.uids.metrics.get_id("m"))[0]
+        ts, vals = tier.series(int(sid)).buffer.view()
+        assert len(ts) == 20
+        assert np.allclose(vals, 6.0)    # 6 points of 1.0 per minute
+        cnt = tsdb.rollup_store.tier("1m", "count")
+        _, cvals = cnt.series(0).buffer.view()
+        assert np.allclose(cvals, 6.0)
+
+    def test_job_respects_interval_subset(self, tsdb):
+        base = self.seed(tsdb)
+        written = run_rollup_job(tsdb, base * 1000,
+                                 (base + 1200) * 1000,
+                                 intervals=["1m"])
+        assert set(written) == {"1m"}
+
+    def test_job_without_rollups_enabled(self):
+        from opentsdb_tpu import TSDB, Config
+        plain = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+        if plain.rollup_store is None:
+            with pytest.raises(RuntimeError):
+                run_rollup_job(plain, 0, 1000)
+
+
+# ---------------------------------------------------------------------------
+# query-side tier selection + fallback (ref: TsdbQuery rollup
+# best-match :143-150 and raw fallback :750, ROLLUP_USAGE :197)
+# ---------------------------------------------------------------------------
+
+class TestRollupQueryRouting:
+    def seed_and_roll(self, tsdb):
+        base = self.base = 1356998400
+        for i in range(120):
+            tsdb.add_point("m", base + i * 10, float(i), {"host": "a"})
+        run_rollup_job(tsdb, base * 1000, (base + 1200) * 1000)
+        return base
+
+    def test_downsample_1m_uses_rollup_tier(self, tsdb):
+        base = self.seed_and_roll(tsdb)
+        res = run_query(tsdb, {
+            "start": base - 60, "end": base + 1300,
+            "queries": [{"aggregator": "sum", "metric": "m",
+                         "downsample": "1m-sum"}]})
+        # values from the 1m sum tier: buckets of 6 raw points
+        dps = dict(res[0].dps)
+        first_minute = sum(range(6))
+        assert dps[base * 1000] == first_minute
+
+    def test_rollup_usage_raw_forces_raw(self, tsdb):
+        base = self.seed_and_roll(tsdb)
+        res = run_query(tsdb, {
+            "start": base - 60, "end": base + 1300,
+            "queries": [{"aggregator": "sum", "metric": "m",
+                         "downsample": "1m-sum",
+                         "rollupUsage": "ROLLUP_RAW"}]})
+        dps = dict(res[0].dps)
+        assert dps[base * 1000] == sum(range(6))
+
+    def test_unaligned_interval_falls_back_to_raw(self, tsdb):
+        base = self.seed_and_roll(tsdb)
+        # 90s downsample: 1m divides 90s? 90000 % 60000 != 0 -> raw...
+        # actually 90s isn't divisible by 60s, so raw path must serve
+        res = run_query(tsdb, {
+            "start": base - 60, "end": base + 1300,
+            "queries": [{"aggregator": "sum", "metric": "m",
+                         "downsample": "30s-sum"}]})
+        dps = dict(res[0].dps)
+        assert dps[base * 1000] == sum(range(3))
+
+    def test_avg_downsample_derives_from_sum_count(self, tsdb):
+        base = self.seed_and_roll(tsdb)
+        res = run_query(tsdb, {
+            "start": base - 60, "end": base + 1300,
+            "queries": [{"aggregator": "sum", "metric": "m",
+                         "downsample": "1m-avg"}]})
+        dps = dict(res[0].dps)
+        assert dps[base * 1000] == pytest.approx(sum(range(6)) / 6.0)

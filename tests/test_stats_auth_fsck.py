@@ -1,0 +1,251 @@
+"""Stats, auth, and fsck tests.
+
+Mirrors the reference suites ``test/stats/TestHistogram.java``,
+``TestQueryStats.java``, ``TestStatsCollector`` usage,
+``test/tsd/TestAuthenticationChannelHandler``-style auth checks, and
+the corruption-repair scenarios of ``test/tools/TestFsck.java``
+(ref: src/stats/, src/auth/, src/tools/Fsck.java:83).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.auth.simple import (AuthStatus, Permissions,
+                                      SimpleAuthentication)
+from opentsdb_tpu.stats.stats import (Histogram, QueryStat, QueryStats,
+                                      StatsCollector)
+from opentsdb_tpu.tools.fsck import run_fsck
+from opentsdb_tpu.utils.config import Config
+
+
+# ---------------------------------------------------------------------------
+# StatsCollector (ref: StatsCollector.java:35)
+# ---------------------------------------------------------------------------
+
+class TestStatsCollector:
+    def test_record_emits_telnet_lines(self):
+        c = StatsCollector("tsd")
+        c.record("uid.cache-hit", 5, kind="metrics")
+        lines = c.lines()
+        assert len(lines) == 1
+        assert lines[0].startswith("tsd.uid.cache-hit ")
+        assert lines[0].endswith(" 5 kind=metrics")
+
+    def test_extra_tags_apply_to_all(self):
+        c = StatsCollector("tsd")
+        c.add_extra_tag("host", "box1")
+        c.record("connections", 2)
+        assert "host=box1" in c.lines()[0]
+        c.clear_extra_tag("host")
+        c.record("connections", 3)
+        assert "host=box1" not in c.lines()[1]
+
+    def test_as_json(self):
+        c = StatsCollector("tsd")
+        c.record("rpc.received", 10, type="put")
+        js = c.as_json()
+        assert js[0]["metric"] == "tsd.rpc.received"
+        assert js[0]["value"] == 10
+        assert js[0]["tags"] == {"type": "put"}
+
+    def test_tsdb_collects_stats(self, seeded_tsdb):
+        c = StatsCollector("tsd")
+        seeded_tsdb.collect_stats(c)
+        metrics = {j["metric"] for j in c.as_json()}
+        assert any("uid.cache" in m for m in metrics)
+        assert any("datapoints" in m for m in metrics)
+
+
+# ---------------------------------------------------------------------------
+# latency histogram (ref: TestHistogram.java)
+# ---------------------------------------------------------------------------
+
+class TestLatencyHistogram:
+    def test_linear_then_exponential_bounds(self):
+        h = Histogram(max_value=16000, num_bands=2, interval=100)
+        assert h.bounds[0] == 100
+        diffs = np.diff(h.bounds)
+        assert (diffs[:10] == 100).all()       # linear region
+        assert h.bounds[-1] == 16000
+
+    def test_percentile(self):
+        h = Histogram(max_value=1000, num_bands=1, interval=100)
+        for v in (50, 150, 250, 350, 450, 550, 650, 750, 850, 950):
+            h.add(v)
+        assert h.percentile(10) == 100
+        assert h.percentile(50) == 500
+        assert h.percentile(100) == 1000
+
+    def test_percentile_empty_and_invalid(self):
+        h = Histogram()
+        assert h.percentile(50) == 0.0
+        with pytest.raises(ValueError):
+            h.percentile(0)
+        with pytest.raises(ValueError):
+            h.percentile(101)
+
+    def test_overflow_bucket(self):
+        h = Histogram(max_value=1000, num_bands=1, interval=100)
+        h.add(5000)
+        assert h.buckets[-1] == 1
+
+    def test_print_ascii(self):
+        h = Histogram(max_value=400, num_bands=1, interval=100)
+        h.add(50)
+        out = h.print_ascii()
+        assert "[0-100): 1" in out
+
+
+# ---------------------------------------------------------------------------
+# QueryStats registry (ref: TestQueryStats.java, /api/stats/query)
+# ---------------------------------------------------------------------------
+
+class TestQueryStats:
+    def test_lifecycle(self):
+        qs = QueryStats(remote="1.2.3.4")
+        assert not qs.executed
+        qs.add_stat(QueryStat.SCANNER_TIME, 12.5)
+        qs.add_stat(QueryStat.SCANNER_TIME, 2.5)
+        qs.mark_serialization_successful()
+        assert qs.executed
+        js = qs.to_json()
+        assert js["stats"]["scannerTime"] == 15.0
+        assert js["stats"]["totalTime"] >= 0
+
+    def test_registry_moves_running_to_completed(self):
+        qs = QueryStats(remote="9.9.9.9")
+        reg = QueryStats.running_and_completed()
+        assert any(q["queryId"] == qs.query_id for q in reg["running"])
+        qs.mark_serialization_successful()
+        reg = QueryStats.running_and_completed()
+        assert all(q["queryId"] != qs.query_id for q in reg["running"])
+        assert any(q["queryId"] == qs.query_id
+                   for q in reg["completed"])
+
+    def test_query_path_records_stats(self, seeded_tsdb):
+        from opentsdb_tpu.query.model import TSQuery
+        q = TSQuery.from_json({
+            "start": 1356998000, "end": 1357010000,
+            "queries": [{"aggregator": "sum",
+                         "metric": "sys.cpu.user"}]}).validate()
+        seeded_tsdb.execute_query(q)
+        reg = QueryStats.running_and_completed()
+        assert reg["completed"]
+
+
+# ---------------------------------------------------------------------------
+# auth (ref: src/auth/, AuthenticationChannelHandler.java:50)
+# ---------------------------------------------------------------------------
+
+def sha(pw: str) -> str:
+    import hashlib
+    return hashlib.sha256(pw.encode()).hexdigest()
+
+
+class TestAuth:
+    def make(self, users=""):
+        return SimpleAuthentication(Config(**{
+            "tsd.core.authentication.users": users}))
+
+    def test_allow_all_when_no_users(self):
+        auth = self.make()
+        state = auth.authenticate("whoever", "whatever")
+        assert state.status == AuthStatus.SUCCESS
+        assert state.has_permission(Permissions.HTTP_QUERY)
+
+    def test_password_check(self):
+        auth = self.make(f"admin:{sha('secret')}")
+        assert auth.authenticate("admin", "secret").status == \
+            AuthStatus.SUCCESS
+        assert auth.authenticate("admin", "wrong").status == \
+            AuthStatus.UNAUTHORIZED
+        assert auth.authenticate("nosuch", "x").status == \
+            AuthStatus.UNAUTHORIZED
+
+    def test_success_has_token_and_permissions(self):
+        auth = self.make(f"admin:{sha('s')}")
+        state = auth.authenticate("admin", "s")
+        assert state.token is not None
+        assert state.has_permission(Permissions.TELNET_PUT)
+        denied = auth.authenticate("admin", "no")
+        assert not denied.has_permission(Permissions.TELNET_PUT)
+
+    def test_telnet_command_form(self):
+        auth = self.make(f"bob:{sha('pw')}")
+        assert auth.authenticate_telnet(
+            ["auth", "bob", "pw"]).status == AuthStatus.SUCCESS
+        assert auth.authenticate_telnet(["auth"]).status == \
+            AuthStatus.ERROR
+
+    def test_http_basic_header(self):
+        import base64
+        auth = self.make(f"bob:{sha('pw')}")
+        tok = base64.b64encode(b"bob:pw").decode()
+        ok = auth.authenticate_http({"authorization": f"Basic {tok}"})
+        assert ok.status == AuthStatus.SUCCESS
+        assert auth.authenticate_http({}).status == \
+            AuthStatus.UNAUTHORIZED
+        assert auth.authenticate_http(
+            {"authorization": "Bearer zzz"}).status == \
+            AuthStatus.UNAUTHORIZED
+        assert auth.authenticate_http(
+            {"authorization": "Basic $$$not-b64$$$"}).status == \
+            AuthStatus.ERROR
+
+
+# ---------------------------------------------------------------------------
+# fsck (ref: TestFsck.java corruption-repair scenarios, Fsck.java:99-119)
+# ---------------------------------------------------------------------------
+
+class TestFsck:
+    def test_clean_store(self, seeded_tsdb):
+        report = run_fsck(seeded_tsdb)
+        assert report.errors == 0
+        assert report.series_checked == 2
+        assert report.points_checked == 600
+
+    def test_detects_nonfinite_values(self, tsdb):
+        tsdb.add_point("m", 1356998400, 1.0, {"host": "a"})
+        sid = int(tsdb.store.series_ids_for_metric(
+            tsdb.uids.metrics.get_id("m"))[0])
+        buf = tsdb.store.series(sid).buffer
+        if hasattr(buf, "lock"):
+            with buf.lock:
+                buf.vals[0] = float("nan")
+            report = run_fsck(tsdb)
+            assert report.errors == 1 and report.fixed == 0
+            # --fix removes the poisoned point
+            report = run_fsck(tsdb, fix=True)
+            assert report.fixed == 1
+            assert run_fsck(tsdb).errors == 0
+
+    def test_detects_duplicate_timestamps(self, tsdb):
+        tsdb.add_point("m", 1356998400, 1.0, {"host": "a"})
+        tsdb.add_point("m", 1356998400, 2.0, {"host": "a"})
+        report = run_fsck(tsdb)
+        assert report.errors >= 1
+        assert any("duplicate" in ln for ln in report.lines)
+        # fix forces last-write-wins resolution
+        report = run_fsck(tsdb, fix=True)
+        assert report.fixed >= 1
+        ts, vals = tsdb.store.series(0).buffer.view()
+        assert len(ts) == 1 and vals[0] == 2.0
+        assert run_fsck(tsdb).errors == 0
+
+    def test_detects_out_of_range_timestamp(self, tsdb):
+        tsdb.add_point("m", 1356998400, 1.0, {"host": "a"})
+        buf = tsdb.store.series(0).buffer
+        if hasattr(buf, "lock"):
+            with buf.lock:
+                buf.ts[0] = -5
+            report = run_fsck(tsdb)
+            assert any("out of range" in ln for ln in report.lines)
+            run_fsck(tsdb, fix=True)
+            assert run_fsck(tsdb).errors == 0
+
+    def test_detects_unresolvable_uid(self, tsdb):
+        tsdb.add_point("m", 1356998400, 1.0, {"host": "a"})
+        rec = tsdb.store.series(0)
+        tsdb.store._series[0] = rec._replace(metric_id=999)
+        report = run_fsck(tsdb)
+        assert any("unresolvable metric" in ln for ln in report.lines)
