@@ -185,6 +185,35 @@ class PointBatch(NamedTuple):
         return len(self.ts_ms)
 
 
+def pad_mask(counts: np.ndarray, pmax: int) -> np.ndarray:
+    """Boolean [S, Pmax] mask of PAD cells (col >= row count) — the one
+    place the padding convention is written down."""
+    return np.arange(pmax)[None, :] >= counts[:, None]
+
+
+class PaddedBatch(NamedTuple):
+    """Row-padded materialized points: series i's points occupy columns
+    ``0..counts[i]-1`` of row i, time-ascending; the rest is NaN padding.
+
+    This is the TPU-preferred layout — the ragged->dense transposition
+    happens during materialization (one contiguous write per series, no
+    extra pass), and downstream bucketization needs no scatter at all
+    (see :func:`opentsdb_tpu.ops.downsample.bucketize_padded`).
+    """
+    series_ids: np.ndarray    # int64 [S] global series ids
+    values2d: np.ndarray      # float64 [S, Pmax], NaN-padded
+    ts2d: np.ndarray          # int64 [S, Pmax], 0-padded
+    counts: np.ndarray        # int64 [S] points per row
+
+    @property
+    def num_series(self) -> int:
+        return len(self.series_ids)
+
+    @property
+    def num_points(self) -> int:
+        return int(self.counts.sum())
+
+
 class StorageBackend(Protocol):
     """The storage swap point (ref: build-bigtable.sh / build-cassandra.sh)."""
 
@@ -194,6 +223,10 @@ class StorageBackend(Protocol):
                is_int: bool) -> None: ...
     def materialize(self, series_ids: Sequence[int], start_ms: int,
                     end_ms: int) -> PointBatch: ...
+    def count_range(self, series_ids: Sequence[int], start_ms: int,
+                    end_ms: int) -> np.ndarray: ...
+    def materialize_padded(self, series_ids: Sequence[int],
+                           start_ms: int, end_ms: int) -> PaddedBatch: ...
 
 
 class MetricIndex:
@@ -348,6 +381,37 @@ class TimeSeriesStore:
         series_idx = np.repeat(
             np.arange(len(sids), dtype=np.int32), counts)
         return PointBatch(sids, series_idx, all_ts, all_vals)
+
+    def count_range(self, series_ids: Sequence[int], start_ms: int,
+                    end_ms: int) -> np.ndarray:
+        """Points per series in [start_ms, end_ms] without copying them
+        — lets the engine judge padding skew before materializing."""
+        out = np.empty(len(series_ids), dtype=np.int64)
+        for i, sid in enumerate(np.asarray(series_ids, dtype=np.int64)):
+            ts, _ = self._series[sid].buffer.view()
+            lo = np.searchsorted(ts, start_ms, side="left")
+            hi = np.searchsorted(ts, end_ms, side="right")
+            out[i] = hi - lo
+        return out
+
+    def materialize_padded(self, series_ids: Sequence[int],
+                           start_ms: int, end_ms: int) -> PaddedBatch:
+        """Row-padded variant of :meth:`materialize` — same per-series
+        slice cost, but each series lands in its own row."""
+        sids = np.asarray(series_ids, dtype=np.int64)
+        slices = [self._series[sid].buffer.slice_range(start_ms, end_ms)
+                  for sid in sids]
+        counts = np.asarray([len(ts) for ts, _ in slices],
+                            dtype=np.int64)
+        pmax = max(1, int(counts.max())) if len(counts) else 1
+        values2d = np.full((len(sids), pmax), np.nan)
+        ts2d = np.zeros((len(sids), pmax), dtype=np.int64)
+        for i, (ts, vals) in enumerate(slices):
+            n = len(ts)
+            if n:
+                ts2d[i, :n] = ts
+                values2d[i, :n] = vals
+        return PaddedBatch(sids, values2d, ts2d, counts)
 
     def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
         return np.asarray([self._series[s].shard for s in series_ids],

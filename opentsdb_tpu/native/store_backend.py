@@ -19,7 +19,7 @@ from typing import Iterable, Sequence
 import numpy as np
 
 from opentsdb_tpu.core import const
-from opentsdb_tpu.core.store import MetricIndex, PointBatch
+from opentsdb_tpu.core.store import MetricIndex, PaddedBatch, PointBatch
 
 _SRC = os.path.join(os.path.dirname(__file__), "tsdbstore.cc")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libtsdbstore.so")
@@ -255,6 +255,44 @@ class NativeTimeSeriesStore:
                 _ptr(offsets), _ptr(counts), _ptr(ts_out),
                 _ptr(vals_out), _ptr(sidx_out), self.threads)
         return PointBatch(sids, sidx_out, ts_out, vals_out)
+
+    def count_range(self, series_ids: Sequence[int], start_ms: int,
+                    end_ms: int) -> np.ndarray:
+        sids = np.ascontiguousarray(series_ids, dtype=np.int64)
+        counts = np.empty(len(sids), dtype=np.int64)
+        rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
+                                       start_ms, end_ms, _ptr(counts),
+                                       self.threads)
+        if rc != 0:
+            raise IndexError("invalid series id in count_range")
+        return counts
+
+    def materialize_padded(self, series_ids: Sequence[int],
+                           start_ms: int, end_ms: int) -> PaddedBatch:
+        """Row-padded materialize: reuses ``tss_fill_range`` by passing
+        per-row offsets ``i * Pmax`` — each series' contiguous run lands
+        in its own row of the padded buffers, no extra pass."""
+        sids = np.ascontiguousarray(series_ids, dtype=np.int64)
+        counts = np.empty(len(sids), dtype=np.int64)
+        rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
+                                       start_ms, end_ms, _ptr(counts),
+                                       self.threads)
+        if rc != 0:
+            raise IndexError("invalid series id in materialize")
+        pmax = max(1, int(counts.max())) if len(sids) else 1
+        values2d = np.full(len(sids) * pmax, np.nan)
+        ts2d = np.zeros(len(sids) * pmax, dtype=np.int64)
+        if counts.sum():
+            offsets = np.arange(len(sids), dtype=np.int64) * pmax
+            sidx_scratch = np.empty(len(sids) * pmax, dtype=np.int32)
+            # fill writes counts[i] elements at offsets[i]; sidx output
+            # is positional scratch we don't need in the padded layout
+            self._lib.tss_fill_range(
+                self._h, _ptr(sids), len(sids), start_ms, end_ms,
+                _ptr(offsets), _ptr(counts), _ptr(ts2d),
+                _ptr(values2d), _ptr(sidx_scratch), self.threads)
+        return PaddedBatch(sids, values2d.reshape(len(sids), pmax),
+                           ts2d.reshape(len(sids), pmax), counts)
 
     def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
         return np.asarray([self._records[s].shard for s in series_ids],

@@ -27,20 +27,40 @@ def _seg(fn, data, ids, num, **kw):
     return fn(data, ids, num_segments=num, indices_are_sorted=False, **kw)
 
 
+# One-hot matmul budget: the MXU contraction beats segment_sum's
+# scatter lowering by ~300x at query shapes (measured 0.03 ms vs
+# 9.4 ms on [1e6, 12] -> [100, 12]), but S*G must stay bounded so the
+# (fused, never materialized) one-hot contraction doesn't explode.
+_MATMUL_GROUP_MAX_ELEMS = 2 * 10**9
+
+
+def _group_sum(data, group_ids, num_groups: int):
+    """Segment-sum over the series axis: data[S,B] -> [G,B].
+
+    Lowered as a one-hot MXU contraction when S*G permits; TPU scatter
+    (segment_sum) otherwise.
+    """
+    s = data.shape[0]
+    if s * num_groups <= _MATMUL_GROUP_MAX_ELEMS:
+        onehot = jax.nn.one_hot(group_ids, num_groups, dtype=data.dtype)
+        return jax.lax.dot_general(
+            onehot, data, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)
+    return _seg(jax.ops.segment_sum, data, group_ids, num_groups)
+
+
 @partial(jax.jit, static_argnames=("num_groups", "agg_name"))
 def _group_reduce(filled, group_ids, num_groups: int, agg_name: str):
     """Aggregate filled[S,B] into [G,B] per ``agg_name``. NaN = missing."""
     valid = ~jnp.isnan(filled)
     x0 = jnp.where(valid, filled, 0.0)
-    cnt = _seg(jax.ops.segment_sum, valid.astype(filled.dtype), group_ids,
-               num_groups)
+    cnt = _group_sum(valid.astype(filled.dtype), group_ids, num_groups)
     any_valid = cnt > 0
 
     if agg_name in ("sum", "zimsum", "pfsum"):
-        out = _seg(jax.ops.segment_sum, x0, group_ids, num_groups)
+        out = _group_sum(x0, group_ids, num_groups)
     elif agg_name == "avg":
-        out = _seg(jax.ops.segment_sum, x0, group_ids, num_groups) \
-            / jnp.maximum(cnt, 1)
+        out = _group_sum(x0, group_ids, num_groups) / jnp.maximum(cnt, 1)
     elif agg_name == "count":
         out = cnt
     elif agg_name in ("min", "mimmin"):
@@ -59,13 +79,12 @@ def _group_reduce(filled, group_ids, num_groups: int, agg_name: str):
         out = _seg(jax.ops.segment_prod,
                    jnp.where(valid, filled, 1.0), group_ids, num_groups)
     elif agg_name == "squareSum":
-        out = _seg(jax.ops.segment_sum, x0 * x0, group_ids, num_groups)
+        out = _group_sum(x0 * x0, group_ids, num_groups)
     elif agg_name == "dev":
-        s1 = _seg(jax.ops.segment_sum, x0, group_ids, num_groups)
+        s1 = _group_sum(x0, group_ids, num_groups)
         mean = s1 / jnp.maximum(cnt, 1)
         centered = jnp.where(valid, filled - mean[group_ids], 0.0)
-        m2 = _seg(jax.ops.segment_sum, centered * centered, group_ids,
-                  num_groups)
+        m2 = _group_sum(centered * centered, group_ids, num_groups)
         var = m2 / jnp.maximum(cnt - 1, 1)
         out = jnp.where(cnt == 1, 0.0, jnp.sqrt(jnp.maximum(var, 0.0)))
     elif agg_name in ("first", "last", "diff"):

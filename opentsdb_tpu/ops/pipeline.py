@@ -108,6 +108,24 @@ def run_pipeline_dense(values2d, bucket_ts, group_ids, rate_params,
                             rate_params, fill_value, spec)
 
 
+@partial(jax.jit, static_argnames=("spec",))
+def run_pipeline_padded(values2d, bucket_idx2d, bucket_ts, group_ids,
+                        rate_params, fill_value, spec: PipelineSpec):
+    """Irregular-data fast path over the row-padded layout
+    (:class:`opentsdb_tpu.core.store.PaddedBatch`): scatter-free
+    bucketization (see :func:`opentsdb_tpu.ops.downsample.bucketize_padded`),
+    then the shared rate/interpolate/aggregate tail.
+
+    values2d: [S, Pmax] NaN-padded; bucket_idx2d: [S, Pmax] int32 with
+    -1 marking pads.
+    """
+    grid, cnt = ds_mod.bucketize_padded(values2d, bucket_idx2d,
+                                        spec.num_buckets,
+                                        spec.ds_function)
+    return _finish_pipeline(grid, cnt > 0, bucket_ts, group_ids,
+                            rate_params, fill_value, spec)
+
+
 def apply_fill_policy(grid, has_data, fill_value, spec: "PipelineSpec"):
     """Downsample fill policy: ZERO/SCALAR substitute before rate,
     matching FillingDownsampler feeding RateSpan. Shared by the full
@@ -147,8 +165,8 @@ def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
     # (plain Downsampler skips empty buckets); any other policy emits
     # every bucket (FillingDownsampler semantics)
     if spec.fill_policy == ds_mod.FillPolicy.NONE:
-        emit = jax.ops.segment_sum(has_data.astype(jnp.int32), group_ids,
-                                   num_segments=g) > 0
+        emit = gb_mod._group_sum(
+            has_data.astype(grid.dtype), group_ids, g) > 0
     else:
         emit = jnp.ones((g, b), dtype=bool)
     return result, emit
@@ -184,6 +202,119 @@ def detect_dense(num_series: int, num_buckets: int,
     return k
 
 
+# traffic budget for the padded einsum contraction: S * Pmax * B cells
+_PADDED_EINSUM_MAX_CELLS = 2 * 10**9
+
+
+def detect_regular_padded(counts: np.ndarray, bucket_idx2d: np.ndarray,
+                          num_buckets: int) -> int | None:
+    """Regular-cadence check on the padded layout: every row full to the
+    same P with the identical k-contiguous bucket pattern. Returns k
+    (points per bucket) or None."""
+    if len(counts) == 0:
+        return None
+    p = int(counts[0])
+    if p == 0 or not (counts == p).all() or \
+            bucket_idx2d.shape[1] != p or p % num_buckets != 0:
+        return None
+    k = p // num_buckets
+    expected = np.repeat(np.arange(num_buckets, dtype=bucket_idx2d.dtype),
+                         k)
+    if not (bucket_idx2d[0] == expected).all():
+        return None
+    if not (bucket_idx2d == bucket_idx2d[0]).all():
+        return None
+    return k
+
+
+def flatten_padded(values2d: np.ndarray, bucket_idx2d: np.ndarray,
+                   counts: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Padded -> flat (values, series_idx, bucket_idx) for the scatter
+    and blocked executors."""
+    from opentsdb_tpu.core.store import pad_mask
+    mask = ~pad_mask(counts, values2d.shape[1])
+    series_idx = np.repeat(
+        np.arange(values2d.shape[0], dtype=np.int32),
+        counts.astype(np.int64))
+    return (values2d[mask], series_idx,
+            bucket_idx2d[mask].astype(np.int32))
+
+
+def _run_dense_or_pallas(values2d, bucket_ts, group_ids, spec, k, ro,
+                         rate_params, fv, dtype, device,
+                         use_pallas: bool) -> tuple[np.ndarray, np.ndarray]:
+    """Regular-cadence execution: the fused Pallas kernel when the data
+    and op combination allow it, the XLA dense reshape path otherwise.
+    Shared by :func:`execute` and :func:`execute_auto`."""
+    if use_pallas and not (ro.counter or ro.drop_resets):
+        from opentsdb_tpu.ops import pallas_fused
+        if pallas_fused.supported(spec, dtype) \
+                and not np.isnan(values2d).any():
+            try:
+                return pallas_fused.fused_dense_pipeline(
+                    values2d, np.asarray(bucket_ts),
+                    np.asarray(group_ids), spec, k, dtype=dtype,
+                    device=device)
+            except Exception:  # noqa: BLE001
+                # Mosaic compile/runtime failure -> the XLA dense path
+                # computes the same thing; log and degrade
+                import logging
+                logging.getLogger(__name__).warning(
+                    "pallas fused kernel failed; falling back to "
+                    "the XLA dense path", exc_info=True)
+    put = partial(jax.device_put, device=device)
+    result, emit = run_pipeline_dense(
+        put(jnp.asarray(values2d, dtype=dtype)),
+        put(jnp.asarray(bucket_ts)),
+        put(jnp.asarray(group_ids, dtype=jnp.int32)),
+        rate_params, fv, spec, k)
+    return np.asarray(result), np.asarray(emit)
+
+
+def execute_auto(padded, bucket_idx2d: np.ndarray,
+                 bucket_ts: np.ndarray, group_ids: np.ndarray,
+                 spec: PipelineSpec,
+                 rate_options: RateOptions | None = None,
+                 dtype=None, device=None,
+                 use_pallas: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """Host entry over a :class:`~opentsdb_tpu.core.store.PaddedBatch`:
+    picks pallas/dense for regular data, the scatter-free padded kernel
+    for irregular data it supports, and the flat scatter path otherwise.
+    """
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
+            else jnp.float32
+    ro = rate_options or RateOptions()
+    values2d = np.asarray(padded.values2d)
+    counts = np.asarray(padded.counts)
+    k = detect_regular_padded(counts, np.asarray(bucket_idx2d),
+                              spec.num_buckets)
+    put = partial(jax.device_put, device=device)
+    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
+                   jnp.asarray(ro.reset_value, dtype=dtype))
+    fv = jnp.asarray(spec.fill_value, dtype=dtype)
+    if k is not None and spec.ds_function in _DENSE_FNS:
+        return _run_dense_or_pallas(values2d, bucket_ts, group_ids,
+                                    spec, k, ro, rate_params, fv,
+                                    dtype, device, use_pallas)
+    cells = values2d.shape[0] * values2d.shape[1] * spec.num_buckets
+    if ds_mod.padded_supported(spec.ds_function, spec.num_buckets) \
+            and cells <= _PADDED_EINSUM_MAX_CELLS:
+        result, emit = run_pipeline_padded(
+            put(jnp.asarray(values2d, dtype=dtype)),
+            put(jnp.asarray(bucket_idx2d, dtype=jnp.int32)),
+            put(jnp.asarray(bucket_ts)),
+            put(jnp.asarray(group_ids, dtype=jnp.int32)),
+            rate_params, fv, spec)
+        return np.asarray(result), np.asarray(emit)
+    values, series_idx, bucket_idx = flatten_padded(
+        values2d, np.asarray(bucket_idx2d), counts)
+    return execute(values, series_idx, bucket_idx, bucket_ts, group_ids,
+                   spec, rate_options, dtype=dtype, device=device,
+                   use_pallas=use_pallas)
+
+
 def execute(batch_values: np.ndarray, series_idx: np.ndarray,
             bucket_idx: np.ndarray, bucket_ts: np.ndarray,
             group_ids: np.ndarray, spec: PipelineSpec,
@@ -209,28 +340,9 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
                      spec.ds_function)
     if k is not None:
         values2d = np.asarray(batch_values).reshape(spec.num_series, -1)
-        if use_pallas and not (ro.counter or ro.drop_resets):
-            from opentsdb_tpu.ops import pallas_fused
-            if pallas_fused.supported(spec, dtype) \
-                    and not np.isnan(values2d).any():
-                try:
-                    return pallas_fused.fused_dense_pipeline(
-                        values2d, np.asarray(bucket_ts),
-                        np.asarray(group_ids), spec, k, dtype=dtype,
-                        device=device)
-                except Exception:  # noqa: BLE001
-                    # Mosaic compile/runtime failure -> the XLA dense
-                    # path computes the same thing; log and degrade
-                    import logging
-                    logging.getLogger(__name__).warning(
-                        "pallas fused kernel failed; falling back to "
-                        "the XLA dense path", exc_info=True)
-        result, emit = run_pipeline_dense(
-            put(jnp.asarray(values2d, dtype=dtype)),
-            put(jnp.asarray(bucket_ts)),
-            put(jnp.asarray(group_ids, dtype=jnp.int32)),
-            rate_params, fv, spec, k)
-        return np.asarray(result), np.asarray(emit)
+        return _run_dense_or_pallas(values2d, bucket_ts, group_ids,
+                                    spec, k, ro, rate_params, fv,
+                                    dtype, device, use_pallas)
     values = put(jnp.asarray(batch_values, dtype=dtype))
     result, emit = run_pipeline(
         values,

@@ -25,7 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from opentsdb_tpu.ops.interp import _prev_valid_idx
+from opentsdb_tpu.ops.interp import _gather_minor, _prev_valid_idx
 
 
 @dataclass(frozen=True)
@@ -74,7 +74,7 @@ def _rate_kernel(grid, bucket_ts, counter: bool, counter_max,
          prev_at[..., :-1]], axis=-1)
     has_prev = shifted >= 0
     safe_prev = jnp.clip(shifted, 0, nb - 1)
-    v_prev = jnp.take_along_axis(grid, safe_prev, axis=-1)
+    v_prev = _gather_minor(grid, safe_prev)
     ts = bucket_ts.astype(grid.dtype)
     t_cur = ts[None, :]
     t_prev = ts[safe_prev]

@@ -26,12 +26,14 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from opentsdb_tpu.core import store as store_mod
 from opentsdb_tpu.core.store import TimeSeriesStore
 from opentsdb_tpu.ops import downsample as ds_mod
 from opentsdb_tpu.ops.blocked import (DEFAULT_CELL_BUDGET,
                                       execute_blocked,
                                       pick_block_buckets)
-from opentsdb_tpu.ops.pipeline import PipelineSpec, execute
+from opentsdb_tpu.ops.pipeline import (PipelineSpec, execute,
+                                       execute_auto, flatten_padded)
 from opentsdb_tpu.query import filters as filters_mod
 from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
 from opentsdb_tpu.stats.stats import QueryStat, QueryStats
@@ -52,6 +54,14 @@ class QueryResult:
 
 class NoSuchMetricError(BadRequestError):
     pass
+
+
+# Padded-layout guards: padding inflation is bounded by the skew factor
+# (pad cells per real point) once batches are big enough to matter, and
+# by an absolute S*Pmax cell ceiling (host RAM).
+_PADDED_SKEW_FACTOR = 4
+_PADDED_MIN_CELLS = 10_000_000
+_PADDED_ABS_MAX_CELLS = 500_000_000
 
 
 class QueryEngine:
@@ -107,65 +117,121 @@ class QueryEngine:
             group_keys = [(i,) for i in range(len(sids))]
         num_groups = len(group_keys)
 
-        # --- materialize + time grid
+        # --- materialize + time grid (row-padded layout: the ragged ->
+        # dense transposition happens inside materialize, so the device
+        # path never needs a scatter; see PaddedBatch). Skewed batches
+        # (one dense series among many sparse ones would blow S * Pmax
+        # up quadratically) stay on the flat layout.
         t1 = time.monotonic()
-        batch = store.materialize(sids, tsq.start_ms, tsq.end_ms)
+        counts = store.count_range(sids, tsq.start_ms, tsq.end_ms)
+        total = int(counts.sum())
+        pmax = int(counts.max()) if len(counts) else 0
+        cells = len(sids) * pmax
+        use_padded = total > 0 and \
+            cells <= max(_PADDED_SKEW_FACTOR * total,
+                         _PADDED_MIN_CELLS) and \
+            cells <= _PADDED_ABS_MAX_CELLS
+        if use_padded:
+            padded = store.materialize_padded(sids, tsq.start_ms,
+                                              tsq.end_ms)
+            num_points = total
+        else:
+            padded = None
+            batch = store.materialize(sids, tsq.start_ms, tsq.end_ms)
+            num_points = batch.num_points
         if stats:
             stats.add_stat(QueryStat.MATERIALIZE_TIME,
                            (time.monotonic() - t1) * 1e3)
-            stats.add_stat(QueryStat.DPS_POST_FILTER, batch.num_points)
+            stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
         # byte/dp guardrails (ref: SaltScanner budget enforcement via
         # QueryLimitOverride)
-        self.tsdb.query_limits.check(metric_name, batch.num_points)
+        self.tsdb.query_limits.check(metric_name, num_points)
         if tsq.delete and hasattr(store, "delete_range"):
             # scanned-and-deleted semantics: the response still carries
             # the data just removed (ref: TsdbQuery delete=true turning
             # scans into DeleteRequests after collection)
             store.delete_range(sids, tsq.start_ms, tsq.end_ms)
-        if batch.num_points == 0:
+        if num_points == 0:
             return []
+        bucket_idx2d = bucket_idx = None
         if sub.ds_spec is not None:
-            bucket_idx, bucket_ts = ds_mod.assign_buckets(
-                batch.ts_ms, sub.ds_spec, tsq.start_ms, tsq.end_ms)
             ds_function = sub.ds_spec.function
             fill_policy = sub.ds_spec.fill_policy
             fill_value = sub.ds_spec.fill_value
+            if padded is not None:
+                bucket_idx2d, bucket_ts = ds_mod.assign_buckets_padded(
+                    padded.ts2d, padded.counts, sub.ds_spec,
+                    tsq.start_ms, tsq.end_ms)
+            else:
+                bucket_idx, bucket_ts = ds_mod.assign_buckets(
+                    batch.ts_ms, sub.ds_spec, tsq.start_ms, tsq.end_ms)
         else:
             # union-of-timestamps grid: every distinct input timestamp
             # is an output point, like the reference's merge iterator
-            bucket_ts, bucket_idx = np.unique(batch.ts_ms,
-                                              return_inverse=True)
-            bucket_idx = bucket_idx.astype(np.int32)
             ds_function = "sum"  # one point per (series, ts) after dedupe
             fill_policy = ds_mod.FillPolicy.NONE
             fill_value = float("nan")
+            if padded is not None:
+                pad = store_mod.pad_mask(padded.counts,
+                                         padded.ts2d.shape[1])
+                bucket_ts, inverse = np.unique(padded.ts2d.reshape(-1),
+                                               return_inverse=True)
+                bucket_idx2d = inverse.reshape(padded.ts2d.shape) \
+                    .astype(np.int32)
+                bucket_idx2d[pad] = -1
+                if pad.any():
+                    # drop union slots only pad sentinels produced
+                    used = np.zeros(len(bucket_ts), dtype=bool)
+                    used[bucket_idx2d[~pad]] = True
+                    remap = np.cumsum(used) - 1
+                    bucket_ts = bucket_ts[used]
+                    bucket_idx2d = np.where(
+                        bucket_idx2d >= 0, remap[bucket_idx2d], -1
+                    ).astype(np.int32)
+            else:
+                bucket_ts, bucket_idx = np.unique(batch.ts_ms,
+                                                  return_inverse=True)
+                bucket_idx = bucket_idx.astype(np.int32)
 
         # --- device pipeline
         t2 = time.monotonic()
         spec = PipelineSpec(
-            num_series=batch.num_series, num_buckets=len(bucket_ts),
+            num_series=len(sids), num_buckets=len(bucket_ts),
             num_groups=num_groups, ds_function=ds_function,
             agg_name=sub.agg.name, fill_policy=fill_policy,
             fill_value=fill_value, rate=sub.rate,
             rate_counter=sub.rate_options.counter,
             rate_drop_resets=sub.rate_options.drop_resets,
             emit_raw=emit_raw)
-        values = (batch.values * rollup_scale if rollup_scale != 1.0
-                  else batch.values)
+        if rollup_scale != 1.0:
+            if padded is not None:
+                padded = padded._replace(values2d=padded.values2d
+                                         * rollup_scale)
+            else:
+                batch = batch._replace(values=batch.values
+                                       * rollup_scale)
         budget = self.tsdb.config.get_int(
             "tsd.query.max_device_cells", 0) or DEFAULT_CELL_BUDGET
-        if not emit_raw and \
-                batch.num_series * len(bucket_ts) > budget:
+        if not emit_raw and len(sids) * len(bucket_ts) > budget:
             # long-range streaming: bound HBM at [S x block] cells
             # (SURVEY.md §5.7 time-axis blocking)
+            if padded is not None:
+                values, series_idx, bucket_idx = flatten_padded(
+                    padded.values2d, bucket_idx2d, padded.counts)
+            else:
+                values, series_idx = batch.values, batch.series_idx
             result, emit = execute_blocked(
-                values, batch.series_idx, bucket_idx, bucket_ts,
+                values, series_idx, bucket_idx, bucket_ts,
                 group_ids, spec, sub.rate_options,
                 block_buckets=pick_block_buckets(
-                    batch.num_series, len(bucket_ts), budget))
+                    len(sids), len(bucket_ts), budget))
+        elif padded is not None:
+            result, emit = execute_auto(
+                padded, bucket_idx2d, bucket_ts, group_ids, spec,
+                sub.rate_options)
         else:
             result, emit = execute(
-                values, batch.series_idx, bucket_idx, bucket_ts,
+                batch.values, batch.series_idx, bucket_idx, bucket_ts,
                 group_ids, spec, sub.rate_options)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
