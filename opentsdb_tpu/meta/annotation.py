@@ -66,6 +66,13 @@ class AnnotationStore:
             self._by_tsuid.setdefault(note.tsuid, {})[note.start_time] = note
         return note
 
+    def has_any(self) -> bool:
+        """Cheap emptiness probe so the query path can skip per-series
+        annotation scans entirely (1M-member groups otherwise pay a
+        tsuid-encode + lookup per series)."""
+        with self._lock:
+            return any(self._by_tsuid.values())
+
     def get(self, tsuid: str, start_time: int) -> Annotation | None:
         with self._lock:
             return self._by_tsuid.get(tsuid, {}).get(start_time)

@@ -155,7 +155,8 @@ def execute_blocked(batch_values: np.ndarray, series_idx: np.ndarray,
     sv = np.asarray(batch_values, dtype=np_dtype)[order]
     ssi = np.asarray(series_idx, dtype=np.int32)[order]
     sbi = bucket_idx[order]
-    bucket_ts = np.asarray(bucket_ts)
+    from opentsdb_tpu.ops.pipeline import device_bucket_ts
+    bucket_ts = device_bucket_ts(bucket_ts)
     starts = [np.searchsorted(sbi, b0) for b0 in range(0, b, bb)]
     starts.append(len(sbi))
     blocks = [(b0, min(b0 + bb, b), starts[i], starts[i + 1])

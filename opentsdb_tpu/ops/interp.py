@@ -104,10 +104,12 @@ def fill_gaps(grid, bucket_ts, mode: str):
     safe_next = jnp.clip(next_idx, 0, nb - 1)
     v0 = _gather_minor(grid, safe_prev)
     v1 = _gather_minor(grid, safe_next)
-    ts = bucket_ts.astype(grid.dtype)
-    t = ts[None, :]
-    t0 = ts[safe_prev]
-    t1 = ts[safe_next]
-    dt = jnp.where(t1 > t0, t1 - t0, 1.0)
-    lerped = v0 + (v1 - v0) * (t - t0) / dt
+    # integer ts diffs before the float cast (exact under int32
+    # relative offsets, see pipeline.device_bucket_ts)
+    t = bucket_ts[None, :]
+    t0 = bucket_ts[safe_prev]
+    t1 = bucket_ts[safe_next]
+    num = (t - t0).astype(grid.dtype)
+    den = (t1 - t0).astype(grid.dtype)
+    lerped = v0 + (v1 - v0) * num / jnp.where(den > 0, den, 1.0)
     return jnp.where(mask, grid, jnp.where(in_range, lerped, jnp.nan))

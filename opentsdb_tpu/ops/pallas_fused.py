@@ -127,8 +127,13 @@ def _kernel(vals_ref, gid_ref, a_ref, bias_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     tile_s = vals_ref.shape[0]
+    # HIGHEST precision: the MXU otherwise rounds f32 operands to bf16
+    # (measured 0.6% error on rate queries); 6-pass bf16 is f32-exact
+    # and the kernel is bandwidth-bound, so the extra MXU passes are
+    # hidden behind the HBM stream
     t = jnp.dot(vals_ref[:], a_ref[:],
-                preferred_element_type=acc_ref.dtype)
+                preferred_element_type=acc_ref.dtype,
+                precision=jax.lax.Precision.HIGHEST)
     t = t + bias_ref[:]
     if square:
         t = t * t
@@ -137,7 +142,8 @@ def _kernel(vals_ref, gid_ref, a_ref, bias_ref, acc_ref, *,
     onehot = (jax.lax.broadcasted_iota(jnp.int32, (g, tile_s), 0)
               == gid).astype(t.dtype)
     acc_ref[:] += jnp.dot(onehot, t,
-                          preferred_element_type=acc_ref.dtype)
+                          preferred_element_type=acc_ref.dtype,
+                          precision=jax.lax.Precision.HIGHEST)
 
 
 @partial(jax.jit, static_argnames=("spec", "tile_s", "interpret"))

@@ -241,6 +241,25 @@ def flatten_padded(values2d: np.ndarray, bucket_idx2d: np.ndarray,
             bucket_idx2d[mask].astype(np.int32))
 
 
+def device_bucket_ts(bucket_ts: np.ndarray) -> np.ndarray:
+    """Bucket timestamps in device form: relative int32 ms offsets.
+
+    Absolute epoch-ms values (~1.4e12) overflow int32, and TPU runtimes
+    have no int64/float64 — uploading raw int64 silently truncates and
+    corrupts every rate/lerp time delta. The kernels only ever use ts
+    DIFFERENCES, so relative offsets are exact. Spans too long for
+    int32 ms (> ~24 days) degrade to float (f32 on TPU: <= 128 ms
+    rounding at the far end, negligible against the wide buckets such
+    spans imply).
+    """
+    rel = np.asarray(bucket_ts, dtype=np.int64)
+    if len(rel):
+        rel = rel - rel[0]
+    if len(rel) == 0 or rel[-1] < 2**31:
+        return rel.astype(np.int32)
+    return rel.astype(np.float64)
+
+
 def _run_dense_or_pallas(values2d, bucket_ts, group_ids, spec, k, ro,
                          rate_params, fv, dtype, device,
                          use_pallas: bool) -> tuple[np.ndarray, np.ndarray]:
@@ -266,7 +285,7 @@ def _run_dense_or_pallas(values2d, bucket_ts, group_ids, spec, k, ro,
     put = partial(jax.device_put, device=device)
     result, emit = run_pipeline_dense(
         put(jnp.asarray(values2d, dtype=dtype)),
-        put(jnp.asarray(bucket_ts)),
+        put(jnp.asarray(device_bucket_ts(bucket_ts))),
         put(jnp.asarray(group_ids, dtype=jnp.int32)),
         rate_params, fv, spec, k)
     return np.asarray(result), np.asarray(emit)
@@ -304,7 +323,7 @@ def execute_auto(padded, bucket_idx2d: np.ndarray,
         result, emit = run_pipeline_padded(
             put(jnp.asarray(values2d, dtype=dtype)),
             put(jnp.asarray(bucket_idx2d, dtype=jnp.int32)),
-            put(jnp.asarray(bucket_ts)),
+            put(jnp.asarray(device_bucket_ts(bucket_ts))),
             put(jnp.asarray(group_ids, dtype=jnp.int32)),
             rate_params, fv, spec)
         return np.asarray(result), np.asarray(emit)
@@ -348,7 +367,7 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
         values,
         put(jnp.asarray(series_idx, dtype=jnp.int32)),
         put(jnp.asarray(bucket_idx, dtype=jnp.int32)),
-        put(jnp.asarray(bucket_ts)),
+        put(jnp.asarray(device_bucket_ts(bucket_ts))),
         put(jnp.asarray(group_ids, dtype=jnp.int32)),
         rate_params,
         jnp.asarray(spec.fill_value, dtype=dtype),

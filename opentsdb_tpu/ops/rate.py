@@ -75,10 +75,12 @@ def _rate_kernel(grid, bucket_ts, counter: bool, counter_max,
     has_prev = shifted >= 0
     safe_prev = jnp.clip(shifted, 0, nb - 1)
     v_prev = _gather_minor(grid, safe_prev)
-    ts = bucket_ts.astype(grid.dtype)
-    t_cur = ts[None, :]
-    t_prev = ts[safe_prev]
-    dt_sec = (t_cur - t_prev) / 1000.0
+    # difference timestamps BEFORE any float cast: bucket_ts arrives as
+    # small relative offsets (device_bucket_ts) so integer diffs are
+    # exact even on TPU where int64/float64 are unavailable
+    t_cur = bucket_ts[None, :]
+    t_prev = bucket_ts[safe_prev]
+    dt_sec = (t_cur - t_prev).astype(grid.dtype) / 1000.0
     dt_sec = jnp.where(dt_sec > 0, dt_sec, 1.0)
     delta = grid - v_prev
     rate = delta / dt_sec

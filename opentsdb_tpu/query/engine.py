@@ -374,7 +374,8 @@ class QueryEngine:
         uids = self.tsdb.uids
         out: list[QueryResult] = []
         ms_res = tsq.ms_resolution
-        fetch_annotations = not tsq.no_annotations
+        fetch_annotations = not tsq.no_annotations and \
+            self.tsdb.annotations.has_any()
         for gid in range(len(group_keys)):
             members = np.nonzero(group_ids == gid)[0]
             if len(members) == 0:
