@@ -1,0 +1,164 @@
+"""Search lookup, last-datapoint, and /q graph endpoint tests.
+
+Mirrors the reference suites ``test/search/TestTimeSeriesLookup.java``,
+``test/meta/TestTSUIDQuery.java`` and ``test/tsd/TestGraphHandler.java``
+(ref: src/search/TimeSeriesLookup.java:83, src/meta/TSUIDQuery.java:51,
+src/tsd/GraphHandler.java:61).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.search.lookup import last_data_points, time_series_lookup
+
+
+def seed(tsdb):
+    base = 1356998400
+    tsdb.add_point("sys.cpu", base, 1, {"host": "web01", "dc": "lax"})
+    tsdb.add_point("sys.cpu", base + 60, 2, {"host": "web01", "dc": "lax"})
+    tsdb.add_point("sys.cpu", base, 3, {"host": "web02", "dc": "sjc"})
+    tsdb.add_point("sys.mem", base, 4, {"host": "web01"})
+    return base
+
+
+class TestTimeSeriesLookup:
+    def test_by_metric(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "sys.cpu", [])
+        assert out["totalResults"] == 2
+        assert {r["tags"]["host"] for r in out["results"]} == \
+            {"web01", "web02"}
+
+    def test_all_metrics_star(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "*", [])
+        assert out["totalResults"] == 3
+
+    def test_tag_pair_constraint(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "*", [("host", "web01")])
+        assert out["totalResults"] == 2  # sys.cpu + sys.mem
+
+    def test_tagk_only(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "*", [("dc", "*")])
+        assert out["totalResults"] == 2
+
+    def test_tagv_only(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "*", [("*", "sjc")])
+        assert out["totalResults"] == 1
+        assert out["results"][0]["tags"]["host"] == "web02"
+
+    def test_limit_caps_results_not_total(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "*", [], limit=1)
+        assert len(out["results"]) == 1
+        assert out["totalResults"] == 3
+
+    def test_unknown_names_empty(self, tsdb):
+        seed(tsdb)
+        assert time_series_lookup(tsdb, "no.such", [])["totalResults"] == 0
+        out = time_series_lookup(tsdb, "*", [("nope", "x")])
+        assert out["totalResults"] == 0
+
+    def test_tsuid_resolvable(self, tsdb):
+        seed(tsdb)
+        out = time_series_lookup(tsdb, "sys.mem", [])
+        tsuid = out["results"][0]["tsuid"]
+        from opentsdb_tpu.search.lookup import _sid_from_tsuid
+        sid, metric = _sid_from_tsuid(tsdb, tsuid)
+        assert sid is not None and metric == "sys.mem"
+
+
+class TestLastDataPoints:
+    def test_by_metric_and_tags(self, tsdb):
+        base = seed(tsdb)
+        out = last_data_points(
+            tsdb, [{"metric": "sys.cpu{host=web01}"}])
+        assert len(out) == 1
+        assert out[0]["timestamp"] == (base + 60) * 1000
+        assert out[0]["value"] == "2"
+        assert out[0]["tags"] == {"host": "web01", "dc": "lax"}
+
+    def test_by_metric_all_series(self, tsdb):
+        seed(tsdb)
+        out = last_data_points(tsdb, [{"metric": "sys.cpu"}])
+        assert len(out) == 2
+
+    def test_by_tsuid(self, tsdb):
+        seed(tsdb)
+        t = time_series_lookup(tsdb, "sys.mem", [])["results"][0]["tsuid"]
+        out = last_data_points(tsdb, [{"tsuids": [t]}])
+        assert len(out) == 1 and out[0]["value"] == "4"
+
+    def test_no_resolve(self, tsdb):
+        seed(tsdb)
+        out = last_data_points(tsdb, [{"metric": "sys.mem"}],
+                               resolve=False)
+        assert "metric" not in out[0] and "tags" not in out[0]
+
+    def test_unknown_metric_skipped(self, tsdb):
+        seed(tsdb)
+        assert last_data_points(tsdb, [{"metric": "no.such"}]) == []
+
+    def test_float_value_string(self, tsdb):
+        tsdb.add_point("f.metric", 1356998400, 1.5, {"host": "a"})
+        out = last_data_points(tsdb, [{"metric": "f.metric"}])
+        assert out[0]["value"] == "1.5"
+
+
+class TestGraphEndpoint:
+    """Drive /q through the HTTP router (ref: GraphHandler)."""
+
+    def make_router(self, tsdb):
+        from opentsdb_tpu.tsd.http_api import HttpRpcRouter
+        return HttpRpcRouter(tsdb)
+
+    def request(self, router, path, params):
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        return router.handle(HttpRequest(
+            method="GET", path=path,
+            params={k: [v] for k, v in params.items()}, headers={},
+            body=b"", remote="t"))
+
+    def test_ascii_output(self, seeded_tsdb):
+        router = self.make_router(seeded_tsdb)
+        resp = self.request(router, "/q", {
+            "start": "2012/12/31-23:00:00", "m": "sum:sys.cpu.user",
+            "ascii": "true"})
+        assert resp.status == 200
+        lines = resp.body.decode().splitlines()
+        assert lines[0].startswith("sys.cpu.user 13569984")
+
+    def test_json_output(self, seeded_tsdb):
+        router = self.make_router(seeded_tsdb)
+        resp = self.request(router, "/q", {
+            "start": "2012/12/31-23:00:00", "m": "sum:sys.cpu.user",
+            "json": "true"})
+        assert resp.status == 200
+        import json
+        data = json.loads(resp.body)
+        assert data[0]["metric"] == "sys.cpu.user"
+
+    def test_png_output_and_cache(self, seeded_tsdb, tmp_path):
+        pytest.importorskip("matplotlib")
+        seeded_tsdb.config.override_config("tsd.http.cachedir",
+                                           str(tmp_path))
+        router = self.make_router(seeded_tsdb)
+        params = {"start": "2012/12/31-23:00:00",
+                  "m": "sum:sys.cpu.user", "wxh": "300x200"}
+        resp = self.request(router, "/q", params)
+        assert resp.status == 200
+        assert resp.body[:8] == b"\x89PNG\r\n\x1a\n"
+        cached = list(tmp_path.glob("*.png"))
+        assert len(cached) == 1
+        # second request serves the cached bytes
+        resp2 = self.request(router, "/q", params)
+        assert resp2.body == resp.body
+
+    def test_missing_metric_param(self, seeded_tsdb):
+        router = self.make_router(seeded_tsdb)
+        resp = self.request(router, "/q", {"start": "1356998000"})
+        assert resp.status == 400
+        assert b"Missing 'm' parameter" in resp.body
