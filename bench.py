@@ -163,6 +163,24 @@ def main() -> None:
             v + eps, bi, bts, gids, rate_params, fill_value, spec)[0],
         (d_vals2d, d_bidx2d, d_bts, d_gids), iters=8)
 
+    # config-4 shape for the record: 1M histogram series x 64 buckets,
+    # p99/p999 via the device merge+percentile kernel
+    from opentsdb_tpu.ops.histogram_kernels import (merge_histograms,
+                                                    percentiles_from_merged)
+    rng = np.random.default_rng(1)
+    h_counts = jax.device_put(jnp.asarray(
+        rng.integers(0, 50, (num_series, 64)).astype(np.float32)))
+    h_seg = jax.device_put(jnp.asarray(
+        (np.arange(num_series) % num_groups).astype(np.int32)))
+    h_mids = jax.device_put(jnp.arange(64, dtype=jnp.float32) + 0.5)
+    h_qs = jax.device_put(jnp.asarray([99.0, 99.9], dtype=jnp.float32))
+    dt_hist = _time_device(
+        lambda eps, c, s, m, q: percentiles_from_merged(
+            merge_histograms(c + eps, s, num_groups), m, q),
+        (h_counts, h_seg, h_mids, h_qs), iters=8)
+    print(f"hist p99/p999 (1Mx64 -> {num_groups} groups): "
+          f"{dt_hist * 1e3:.2f} ms", file=sys.stderr)
+
     dt_best = min(dt_dense, dt_pallas) if dt_pallas else dt_dense
     dps = n_points / dt_best
     print(f"dense: {dt_dense * 1e3:.2f} ms ({n_points / dt_dense / 1e9:.1f}"

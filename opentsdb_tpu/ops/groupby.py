@@ -122,9 +122,10 @@ def _group_rank(filled, valid, cnt, group_ids, num_groups, q: float,
     series axis keyed lexicographically by (group, NaN-last, value)."""
     s, b = filled.shape
     gkey = jnp.broadcast_to(group_ids[:, None], (s, b)).astype(jnp.int32)
-    nankey = (~valid).astype(jnp.int32)
-    _, _, sorted_vals = jax.lax.sort((gkey, nankey, filled), num_keys=3,
-                                     dimension=0)
+    # lax.sort's total order puts NaN after every number, so missing
+    # cells land at the end of their group without a separate NaN key
+    _, sorted_vals = jax.lax.sort((gkey, filled), num_keys=2,
+                                  dimension=0)
     sizes = jax.ops.segment_sum(jnp.ones_like(group_ids), group_ids,
                                 num_groups)
     starts = jnp.cumsum(sizes) - sizes  # [G]

@@ -1,0 +1,72 @@
+"""Device kernels for the histogram/sketch query path.
+
+(ref: ``src/core/HistogramAggregationIterator.java:319`` — query-time
+bucket-wise SUM merge — and ``SimpleHistogram.percentile`` :133)
+
+A batch of histogram datapoints becomes a dense ``[N, NB]`` count
+matrix. Merging histograms across series/timestamps is a segment-sum
+over the leading axis — lowered as a one-hot MXU contraction like the
+scalar group-by (:func:`opentsdb_tpu.ops.groupby._group_sum`) — and
+percentile extraction is a vectorized cumsum + rank compare over the
+bucket axis. This is BASELINE.json config 4 (p99/p999 over 1M series,
+histogram path) as one fused XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def merge_histograms(counts, seg_ids, num_segments: int):
+    """Bucket-wise SUM of histogram rows into segments.
+
+    counts [N, NB] f32, seg_ids [N] i32 -> [num_segments, NB].
+    """
+    onehot = jax.nn.one_hot(seg_ids, num_segments, dtype=counts.dtype)
+    return jax.lax.dot_general(
+        onehot, counts, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
+
+
+@partial(jax.jit, static_argnames=())
+def percentiles_from_merged(merged, mids, qs):
+    """merged [S, NB] counts, mids [NB] bucket midpoints, qs [Q]
+    (percentiles 0-100) -> [Q, S] values.
+
+    Midpoint convention of SimpleHistogram.percentile (:133): the
+    bucket whose cumulative count crosses ``total * q/100``
+    contributes its midpoint; empty segments produce 0.
+    """
+    totals = merged.sum(axis=1)                      # [S]
+    cum = jnp.cumsum(merged, axis=1)                 # [S, NB]
+    target = totals[None, :] * (qs[:, None] / 100.0)  # [Q, S]
+    # rank index per (q, segment): number of buckets with cum < target
+    idx = jnp.sum(cum[None, :, :] < target[:, :, None], axis=2)
+    idx = jnp.clip(idx, 0, mids.shape[0] - 1)
+    out = mids[idx]
+    return jnp.where(totals[None, :] > 0, out, 0.0)
+
+
+def histogram_percentile_pipeline(counts: np.ndarray,
+                                  seg_ids: np.ndarray,
+                                  num_segments: int,
+                                  bounds: np.ndarray,
+                                  qs: list[float]) -> np.ndarray:
+    """Host entry: merge + percentile in one device round-trip.
+
+    counts [N, NB] float, seg_ids [N] (group * T + ts_idx),
+    bounds [NB+1] -> [Q, num_segments].
+    """
+    mids = ((np.asarray(bounds[:-1]) + np.asarray(bounds[1:])) / 2.0)
+    merged = merge_histograms(
+        jnp.asarray(counts, dtype=jnp.float32),
+        jnp.asarray(seg_ids, dtype=jnp.int32), num_segments)
+    out = percentiles_from_merged(
+        merged, jnp.asarray(mids, dtype=jnp.float32),
+        jnp.asarray(np.asarray(qs, dtype=np.float32)))
+    return np.asarray(out)

@@ -88,6 +88,8 @@ def segment_sort_ranks(values, seg_ids, num_segments):
     sort unit). NaN values sort to the end of their segment and are
     excluded from the valid counts, so rank selection skips them.
     """
+    # lax.sort's total order puts NaN after every number, so NaN points
+    # sort to the end of their segment with no extra key
     sorted_ids, sorted_vals = jax.lax.sort((seg_ids, values), num_keys=2)
     valid = (~jnp.isnan(values)).astype(seg_ids.dtype)
     counts = jax.ops.segment_sum(valid, seg_ids, num_segments)

@@ -67,30 +67,106 @@ def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
     from opentsdb_tpu.query.engine import QueryEngine
     group_ids, group_keys = QueryEngine._group_ids(series_tags, gb_kids)
 
+    # collect the window's histogram points as one flat [N, NB] batch
+    point_counts: list[np.ndarray] = []
+    point_group: list[int] = []
+    point_ts: list[int] = []
+    bounds: tuple | None = None
+    uniform = True
+    for i in range(len(sids)):
+        for ts_ms, hist in tsdb._histogram_series.get(int(sids[i]), []):
+            if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
+                continue
+            b = tuple(hist.bounds)
+            if bounds is None:
+                bounds = b
+            elif b != bounds:
+                uniform = False
+            point_counts.append(hist.counts_array())
+            point_group.append(int(group_ids[i]))
+            point_ts.append(ts_ms)
+    if not point_counts or bounds is None:
+        return []
+    if not uniform:
+        return _run_mixed_bounds(tsdb, tsq, sub, sids, series_tags,
+                                 group_ids, group_keys)
+
+    # device path (uniform bounds): merge = one-hot MXU contraction,
+    # percentiles = cumsum + rank compare — ops.histogram_kernels
+    from opentsdb_tpu.ops.histogram_kernels import \
+        histogram_percentile_pipeline
+    ts_sorted, ts_idx = np.unique(np.asarray(point_ts, dtype=np.int64),
+                                  return_inverse=True)
+    num_ts = len(ts_sorted)
+    num_groups = len(group_keys)
+    gvec = np.asarray(point_group, dtype=np.int64)
+    seg = (gvec * num_ts + ts_idx).astype(np.int32)
+    counts = np.stack(point_counts)
+    pcts = histogram_percentile_pipeline(
+        counts, seg, num_groups * num_ts, np.asarray(bounds),
+        sub.percentiles)                       # [Q, G*T]
+    pcts = pcts.reshape(len(sub.percentiles), num_groups, num_ts)
+    present = np.bincount(seg, minlength=num_groups * num_ts) \
+        .reshape(num_groups, num_ts) > 0
+
+    out = []
+    for gid in range(num_groups):
+        members = [i for i in range(len(sids)) if group_ids[i] == gid]
+        if not members or not present[gid].any():
+            continue
+        tags, agg_tags = _common_tags(
+            [series_tags[m] for m in members], uids)
+        for qi, q in enumerate(sub.percentiles):
+            dps = [((int(t) // 1000) * 1000 if not tsq.ms_resolution
+                    else int(t), float(pcts[qi, gid, ti]))
+                   for ti, t in enumerate(ts_sorted)
+                   if present[gid, ti]]
+            out.append(QueryResult(
+                metric=f"{sub.metric}_pct_{q:g}", tags=tags,
+                aggregated_tags=agg_tags, dps=dps,
+                sub_query_index=sub.index))
+    return out
+
+
+def _run_mixed_bounds(tsdb, tsq, sub, sids, series_tags, group_ids,
+                      group_keys) -> list:
+    """Host fallback when histograms in the window disagree on bucket
+    bounds: per-group dict merge like the reference's iterator chain."""
+    from opentsdb_tpu.query.engine import QueryResult, _common_tags
+    uids = tsdb.uids
     out = []
     for gid in range(len(group_keys)):
         members = [i for i in range(len(sids)) if group_ids[i] == gid]
         if not members:
             continue
-        # merge member histograms by timestamp (bucket-wise SUM)
-        merged: dict[int, np.ndarray] = {}
-        bounds = None
+        # merge per timestamp, each timestamp keeping its own bucket
+        # bounds (the reference merges Histogram objects per emitted
+        # timestamp; bounds only need to agree across series AT one ts)
+        merged: dict[int, tuple[tuple, np.ndarray]] = {}
         for i in members:
             for ts_ms, hist in tsdb._histogram_series.get(int(sids[i]), []):
                 if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
                     continue
                 arr = hist.counts_array()
-                if bounds is None:
-                    bounds = np.asarray(hist.bounds, dtype=np.float64)
+                b = tuple(hist.bounds)
                 if ts_ms in merged:
-                    merged[ts_ms] = merged[ts_ms] + arr
+                    b0, acc = merged[ts_ms]
+                    if b0 != b:
+                        raise BadRequestError(
+                            "cannot merge histograms with different "
+                            f"buckets at timestamp {ts_ms}")
+                    merged[ts_ms] = (b0, acc + arr)
                 else:
-                    merged[ts_ms] = arr
-        if not merged or bounds is None:
+                    merged[ts_ms] = (b, arr)
+        if not merged:
             continue
         ts_sorted = sorted(merged)
-        counts = np.stack([merged[t] for t in ts_sorted])
-        pcts = percentiles_from_counts(counts, bounds, sub.percentiles)
+        pcts = np.stack([
+            percentiles_from_counts(
+                merged[t][1][None, :],
+                np.asarray(merged[t][0], dtype=np.float64),
+                sub.percentiles)[:, 0]
+            for t in ts_sorted], axis=1)       # [Q, T]
         tags, agg_tags = _common_tags(
             [series_tags[m] for m in members], uids)
         for qi, q in enumerate(sub.percentiles):

@@ -139,6 +139,74 @@ class TestCodec:
         assert mgr.decode(blob).counts == [3]
 
 
+class TestDeviceKernels:
+    """ops.histogram_kernels vs the host formulas (golden)."""
+
+    def test_merge_matches_manual_sum(self):
+        from opentsdb_tpu.ops.histogram_kernels import merge_histograms
+        rng = np.random.default_rng(0)
+        counts = rng.integers(0, 50, (40, 8)).astype(np.float64)
+        seg = rng.integers(0, 5, 40).astype(np.int32)
+        import jax.numpy as jnp
+        got = np.asarray(merge_histograms(jnp.asarray(counts),
+                                          jnp.asarray(seg), 5))
+        gold = np.zeros((5, 8))
+        for i, s in enumerate(seg):
+            gold[s] += counts[i]
+        np.testing.assert_allclose(got, gold)
+
+    def test_percentiles_match_host_path(self):
+        from opentsdb_tpu.query.histogram_engine import \
+            percentiles_from_counts
+        from opentsdb_tpu.ops.histogram_kernels import \
+            histogram_percentile_pipeline
+        rng = np.random.default_rng(1)
+        counts = rng.integers(0, 100, (7, 6)).astype(np.float64)
+        counts[3] = 0  # an empty segment
+        bounds = np.asarray([0.0, 1, 2, 4, 8, 16, 32])
+        qs = [50.0, 95.0, 99.9]
+        gold = percentiles_from_counts(counts, bounds, qs)
+        got = histogram_percentile_pipeline(
+            counts, np.arange(7, dtype=np.int32), 7, bounds, qs)
+        np.testing.assert_allclose(got, gold, rtol=1e-6)
+
+    def test_groupby_query_uses_device_path(self, tsdb):
+        from opentsdb_tpu.query.model import TSQuery
+        bounds = [0.0, 10.0, 20.0, 30.0]
+        for host, counts in (("a", [10, 0, 0]), ("b", [0, 0, 10])):
+            blob = tsdb.histogram_manager.encode(hist(bounds, counts))
+            tsdb.add_histogram_point("req.lat", 1356998400, blob,
+                                     {"host": host})
+        q = TSQuery.from_json({
+            "start": 1356998000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "req.lat",
+                         "percentiles": [50.0],
+                         "tags": {"host": "*"}}]})
+        results = tsdb.execute_query(q.validate())
+        by_host = {r.tags["host"]: dict(r.dps) for r in results}
+        assert by_host["a"][1356998400000] == 5.0
+        assert by_host["b"][1356998400000] == 25.0
+
+    def test_mixed_bounds_falls_back(self, tsdb):
+        from opentsdb_tpu.query.model import TSQuery
+        b1 = tsdb.histogram_manager.encode(
+            hist([0.0, 10.0, 20.0], [10, 0]))
+        b2 = tsdb.histogram_manager.encode(
+            hist([0.0, 5.0, 10.0], [0, 10]))
+        tsdb.add_histogram_point("req.lat", 1356998400, b1,
+                                 {"host": "a"})
+        tsdb.add_histogram_point("req.lat", 1356998460, b2,
+                                 {"host": "a"})
+        q = TSQuery.from_json({
+            "start": 1356998000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "req.lat",
+                         "percentiles": [50.0]}]})
+        results = tsdb.execute_query(q.validate())
+        dps = dict(results[0].dps)
+        assert dps[1356998400000] == 5.0    # [0,10) midpoint
+        assert dps[1356998460000] == 7.5    # [5,10) midpoint
+
+
 # ---------------------------------------------------------------------------
 # write + query path (ref: TestTsdbQueryHistogram*: /api/histogram
 # ingest, percentile extraction routed via TSSubQuery.percentiles)
