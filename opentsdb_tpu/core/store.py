@@ -322,6 +322,25 @@ class TimeSeriesStore:
         self._series[series_id].buffer.append_many(ts_ms, values, is_int)
         self.points_written += len(ts_ms)
 
+    def append_grid(self, series_ids, bucket_ts: np.ndarray,
+                    grid: np.ndarray, mask: np.ndarray) -> int:
+        """Bulk write one [S, B] grid: mask-selected cells of row i
+        append onto series_ids[i] (portable twin of the native store's
+        threaded ``tss_append_grid``)."""
+        sids = np.asarray(series_ids, dtype=np.int64)
+        if len(sids) and ((sids < 0) | (sids >= len(self._series))).any():
+            raise IndexError("invalid series id in append_grid")
+        written = 0
+        for i, sid in enumerate(sids):
+            m = mask[i]
+            if not m.any():
+                continue
+            self._series[sid].buffer.append_many(bucket_ts[m],
+                                                 grid[i][m])
+            written += int(m.sum())
+        self.points_written += written
+        return written
+
     def delete_range(self, series_ids: Sequence[int], start_ms: int,
                      end_ms: int) -> int:
         """Delete all points of ``series_ids`` within the inclusive

@@ -69,6 +69,11 @@ def load_library():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.tss_points_written.argtypes = [ctypes.c_void_p]
         lib.tss_points_written.restype = ctypes.c_int64
+        lib.tss_append_grid.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int]
+        lib.tss_append_grid.restype = ctypes.c_int64
         lib.tss_delete_range.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                          ctypes.c_int64, ctypes.c_int64]
         lib.tss_delete_range.restype = ctypes.c_int64
@@ -255,6 +260,22 @@ class NativeTimeSeriesStore:
                 _ptr(offsets), _ptr(counts), _ptr(ts_out),
                 _ptr(vals_out), _ptr(sidx_out), self.threads)
         return PointBatch(sids, sidx_out, ts_out, vals_out)
+
+    def append_grid(self, series_ids, bucket_ts: np.ndarray,
+                    grid: np.ndarray, mask: np.ndarray) -> int:
+        """Bulk write one [S, B] grid: mask-selected cells of row i
+        append onto series_ids[i]. C++ thread pool, one lock take per
+        row — the rollup job's output path."""
+        sids = np.ascontiguousarray(series_ids, dtype=np.int64)
+        bts = np.ascontiguousarray(bucket_ts, dtype=np.int64)
+        g = np.ascontiguousarray(grid, dtype=np.float64)
+        m = np.ascontiguousarray(mask, dtype=np.uint8)
+        n = self._lib.tss_append_grid(
+            self._h, _ptr(sids), len(sids), _ptr(bts), g.shape[1],
+            _ptr(g), _ptr(m), self.threads)
+        if n < 0:
+            raise IndexError("invalid series id in append_grid")
+        return int(n)
 
     def count_range(self, series_ids: Sequence[int], start_ms: int,
                     end_ms: int) -> np.ndarray:

@@ -147,6 +147,51 @@ int64_t tss_points_written(void* h) {
   return static_cast<Store*>(h)->points_written.load();
 }
 
+// Bulk grid write (the rollup job's output path): for every row i,
+// append the mask-selected cells of grid[i, :] (shared bucket_ts
+// columns) onto series sids[i]. Threaded over rows; one lock take per
+// row instead of per cell. Returns the number of points written, or
+// -1 on any invalid sid.
+int64_t tss_append_grid(void* h, const int64_t* sids, int64_t nsids,
+                        const int64_t* bucket_ts, int64_t nbuckets,
+                        const double* grid, const uint8_t* mask,
+                        int threads) {
+  Store* s = static_cast<Store*>(h);
+  for (int64_t i = 0; i < nsids; ++i)
+    if (sids[i] < 0 || sids[i] >= (int64_t)s->series.size()) return -1;
+  if (threads < 1) threads = 1;
+  std::atomic<int64_t> next{0};
+  std::atomic<int64_t> total{0};
+  auto worker = [&]() {
+    int64_t local = 0;
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= nsids) break;
+      SeriesBuffer* buf = s->series[sids[i]];
+      const double* row = grid + i * nbuckets;
+      const uint8_t* m = mask + i * nbuckets;
+      std::lock_guard<std::mutex> lock(buf->mu);
+      for (int64_t b = 0; b < nbuckets; ++b) {
+        if (!m[b]) continue;
+        if (buf->sorted && !buf->ts.empty() &&
+            bucket_ts[b] <= buf->ts.back())
+          buf->sorted = false;
+        buf->ts.push_back(bucket_ts[b]);
+        buf->vals.push_back(row[b]);
+        buf->is_int.push_back(0);
+        ++local;
+      }
+    }
+    total.fetch_add(local);
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& t : pool) t.join();
+  s->points_written.fetch_add(total.load());
+  return total.load();
+}
+
 int64_t tss_series_length(void* h, int64_t sid) {
   Store* s = static_cast<Store*>(h);
   if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;

@@ -2,33 +2,174 @@
 
 The reference has NO in-repo rollup compactor — rollups are written by
 external jobs through the TSD API (SURVEY.md §2.3, TSDB.java:1320).
-The TPU build ships one: for every series, the raw points of a time
-range are segment-reduced into each tier's buckets with the same
-bucketize kernel the query path uses (one fused XLA program per
-(tier, aggregator)), then written into the tier stores. This is
-BASELINE.json config 5 ("rollup compaction job: 24h@1s raw -> 1m/1h
-tiers").
+The TPU build ships one. This is BASELINE.json config 5 ("rollup
+compaction job: 24h@1s raw -> 1m/1h tiers across 10M series").
 
-Batching: series are processed in chunks so the device working set
-stays bounded (time-blocking is inherited from the chunked
-materialize); all four standard rollup aggregations (sum/count/min/max
-— avg derives as sum/count at query time, ref RollupConfig) compute
-from ONE pass over the points.
+Design (TPU-first):
+
+- the raw window is processed in (series_chunk x time_window) tiles so
+  the device working set stays bounded regardless of range length
+  (time windows are the job-side analogue of the query path's
+  ``ops.blocked`` streaming);
+- each tile computes all four rollup aggregations (sum/count/min/max —
+  avg derives as sum/count at query time, ref RollupConfig) in ONE
+  jitted program over one pass of the data, using the scatter-free
+  padded kernel (:func:`opentsdb_tpu.ops.downsample.bucketize_padded`);
+- coarser tiers whose interval is a small multiple of the finest
+  reduce the finest tier's grids hierarchically on device (1h sum =
+  sum of 1m sums, 1h min = min of 1m mins, ...) — no second pass over
+  the raw data. Non-nesting or very coarse tiers take their own pass.
 """
 
 from __future__ import annotations
 
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from opentsdb_tpu.ops import downsample as ds_mod
-from opentsdb_tpu.rollup.config import RollupConfig
+from opentsdb_tpu.rollup.config import RollupConfig, RollupInterval
 
 ROLLUP_AGGS = ("sum", "count", "min", "max")
+
+# device cell budget per tile and bucket cap per window (the min/max
+# kernels make one fused pass per bucket, so windows stay small)
+_TILE_CELL_BUDGET = 64_000_000
+_MAX_WINDOW_BUCKETS = 64
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def _rollup_tile(values2d, bucket_idx2d, num_buckets: int):
+    """One tile -> stacked [4, S, B] grids (sum/count/min/max order).
+    XLA dedupes the shared count contraction across the four calls."""
+    grids = [ds_mod.bucketize_padded(values2d, bucket_idx2d,
+                                     num_buckets, agg)[0]
+             for agg in ROLLUP_AGGS]
+    return jnp.stack(grids)
+
+
+@partial(jax.jit, static_argnames=("num_coarse",))
+def _coarsen(grids, coarse_idx, num_coarse: int):
+    """[4, S, Bf] + fine->coarse bucket map [Bf] -> [4, S, Bc].
+
+    Hierarchical reduction: coarse sum = sum of fine sums, count = sum
+    of counts, min = min of mins, max = max of maxes. The mapping is
+    host-computed from bucket timestamps, so coarse buckets stay
+    aligned to their own interval and partial buckets at the window
+    edges still materialize. NaN marks empty fine buckets.
+    """
+    onehot = jax.nn.one_hot(coarse_idx, num_coarse, dtype=grids.dtype)
+    hi = jax.lax.Precision.HIGHEST
+
+    def csum(x):
+        return jnp.einsum("sb,bc->sc", jnp.where(jnp.isnan(x), 0.0, x),
+                          onehot, precision=hi)
+
+    sums = csum(grids[0])
+    cnts = csum(grids[1])
+    mins_cols = []
+    maxs_cols = []
+    for c in range(num_coarse):
+        m = (coarse_idx == c)[None, :]
+        mins_cols.append(jnp.min(
+            jnp.where(m & ~jnp.isnan(grids[2]), grids[2], jnp.inf),
+            axis=1))
+        maxs_cols.append(jnp.max(
+            jnp.where(m & ~jnp.isnan(grids[3]), grids[3], -jnp.inf),
+            axis=1))
+    mins = jnp.stack(mins_cols, axis=1)
+    maxs = jnp.stack(maxs_cols, axis=1)
+    empty = cnts == 0
+    nan = jnp.nan
+    return jnp.stack([
+        jnp.where(empty, nan, sums),
+        jnp.where(empty, nan, cnts),
+        jnp.where(empty, nan, mins),
+        jnp.where(empty, nan, maxs),
+    ])
+
+
+def _chunk_tier_sids(tsdb, tiers: list[RollupInterval], chunk
+                     ) -> dict[tuple[str, str], np.ndarray]:
+    """Raw sid -> tier-store sid for every (tier, agg), computed ONCE
+    per series chunk (the mapping is window-invariant, so the window
+    loop must not pay per-series Python work)."""
+    recs = [tsdb.store.series(int(sid)) for sid in chunk]
+    out = {}
+    for tier in tiers:
+        for agg in ROLLUP_AGGS:
+            store = tsdb.rollup_store.tier(tier.interval, agg)
+            out[(tier.interval, agg)] = np.fromiter(
+                (store.get_or_create_series(r.metric_id, r.tags)
+                 for r in recs), dtype=np.int64, count=len(recs))
+    return out
+
+
+def _write_grids(tsdb, tier: RollupInterval, rsid_map, bucket_ts,
+                 grids: np.ndarray, written: dict[str, int]) -> None:
+    """Bulk-write all four aggregations (store.append_grid: one C++
+    threaded pass per agg on the native backend). All four grids share
+    one NaN pattern (a bucket is NaN iff its count is 0), so a single
+    [S, B] mask serves every agg."""
+    mask = ~np.isnan(grids[1])  # count grid
+    any_rows = mask.any(axis=1)
+    if not any_rows.any():
+        return
+    rows = np.nonzero(any_rows)[0]
+    sub_mask = mask[rows]
+    for ai, agg in enumerate(ROLLUP_AGGS):
+        store = tsdb.rollup_store.tier(tier.interval, agg)
+        rsids = rsid_map[(tier.interval, agg)][rows]
+        n = store.append_grid(rsids, np.asarray(bucket_ts),
+                              grids[ai][rows], sub_mask)
+        written[tier.interval] += n
+
+
+def _rollup_window(tsdb, chunk, rsid_map, start_ms: int, end_ms: int,
+                   base: RollupInterval, nested: list[RollupInterval],
+                   written: dict[str, int]) -> None:
+    """One (series chunk x time window) tile: base tier from raw, then
+    nested tiers by on-device coarsening."""
+    padded = tsdb.store.materialize_padded(chunk, start_ms, end_ms)
+    if padded.num_points == 0:
+        return
+    spec = ds_mod.DownsamplingSpecification(
+        interval_ms=base.interval_ms, function="sum")
+    bucket_idx2d, bucket_ts = ds_mod.assign_buckets_padded(
+        padded.ts2d, padded.counts, spec, start_ms, end_ms)
+    dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
+        else jnp.float32
+    grids = np.asarray(_rollup_tile(
+        jnp.asarray(padded.values2d, dtype=dtype),
+        jnp.asarray(bucket_idx2d, dtype=jnp.int32), len(bucket_ts)))
+    _write_grids(tsdb, base, rsid_map, bucket_ts, grids, written)
+    for tier in nested:
+        coarse_edges = ds_mod.fixed_bucket_edges(
+            int(bucket_ts[0]), int(bucket_ts[-1]), tier.interval_ms)
+        coarse_idx = ((bucket_ts - coarse_edges[0])
+                      // tier.interval_ms).astype(np.int32)
+        cg = np.asarray(_coarsen(jnp.asarray(grids),
+                                 jnp.asarray(coarse_idx),
+                                 len(coarse_edges)))
+        _write_grids(tsdb, tier, rsid_map, coarse_edges, cg, written)
+
+
+def _window_buckets(nested_factors: list[int]) -> int:
+    """Buckets of the base tier per window: a multiple of every nested
+    factor (so coarsening never straddles a window edge), capped.
+    Callers guarantee lcm(factors) <= _MAX_WINDOW_BUCKETS."""
+    lcm = 1
+    for f in nested_factors:
+        lcm = math.lcm(lcm, f)
+    return lcm * max(1, _MAX_WINDOW_BUCKETS // lcm)
 
 
 def run_rollup_job(tsdb, start_ms: int, end_ms: int,
                    intervals: list[str] | None = None,
-                   series_chunk: int = 100_000,
+                   series_chunk: int | None = None,
                    progress=None) -> dict[str, int]:
     """Materialize rollup tiers for all raw data in [start_ms, end_ms].
 
@@ -39,42 +180,61 @@ def run_rollup_job(tsdb, start_ms: int, end_ms: int,
     config: RollupConfig = tsdb.rollup_config
     tiers = ([config.get_interval(iv) for iv in intervals]
              if intervals else config.intervals)
+    tiers = sorted(tiers, key=lambda t: t.interval_ms)
     written: dict[str, int] = {iv.interval: 0 for iv in tiers}
+    if not tiers:
+        return written
+    finest = tiers[0]
+    # greedily nest coarser tiers under the finest pass while the LCM
+    # of their base-interval factors keeps one window within the
+    # bucket cap (the padded min/max kernel unrolls per bucket, and
+    # chunk sizing assumes the cap); the rest take their own raw pass
+    nested: list[RollupInterval] = []
+    lcm = 1
+    for t in tiers[1:]:
+        if t.interval_ms % finest.interval_ms:
+            continue
+        f = t.interval_ms // finest.interval_ms
+        if math.lcm(lcm, f) <= _MAX_WINDOW_BUCKETS:
+            nested.append(t)
+            lcm = math.lcm(lcm, f)
+    direct = [t for t in tiers[1:] if t not in nested]
 
     all_sids = np.concatenate(
         [tsdb.store.series_ids_for_metric(mid)
          for mid in tsdb.store.metric_ids()]
         or [np.empty(0, dtype=np.int64)])
-    for lo in range(0, len(all_sids), series_chunk):
-        chunk = all_sids[lo:lo + series_chunk]
-        batch = tsdb.store.materialize(chunk, start_ms, end_ms)
-        if batch.num_points == 0:
-            continue
-        for tier in tiers:
-            spec = ds_mod.DownsamplingSpecification(
-                interval_ms=tier.interval_ms, function="sum")
-            bucket_idx, bucket_ts = ds_mod.assign_buckets(
-                batch.ts_ms, spec, start_ms, end_ms)
-            grids = {}
-            for agg in ROLLUP_AGGS:
-                grid, _ = ds_mod.bucketize(
-                    np.asarray(batch.values), batch.series_idx,
-                    bucket_idx, batch.num_series, len(bucket_ts), agg)
-                grids[agg] = np.asarray(grid)
-            for agg in ROLLUP_AGGS:
-                store = tsdb.rollup_store.tier(tier.interval, agg)
-                grid = grids[agg]
-                for si, sid in enumerate(chunk):
-                    rec = tsdb.store.series(int(sid))
-                    row = grid[si]
-                    mask = ~np.isnan(row)
-                    if not mask.any():
-                        continue
-                    rsid = store.get_or_create_series(rec.metric_id,
-                                                      rec.tags)
-                    store.append_many(rsid, bucket_ts[mask], row[mask])
-                    written[tier.interval] += int(mask.sum())
-        if progress is not None:
-            progress(min(lo + series_chunk, len(all_sids)),
-                     len(all_sids))
+    # sweeps: finest pass feeds nested tiers by coarsening; each
+    # non-nesting tier scans the raw data itself
+    sweeps = [(finest, nested)] + [(t, []) for t in direct]
+    total_work = len(all_sids) * len(sweeps)
+    done = 0
+
+    for base, sub in sweeps:
+        factors = [t.interval_ms // base.interval_ms for t in sub]
+        win_ms = base.interval_ms * _window_buckets(factors)
+        if series_chunk is None:
+            # size the chunk for THIS sweep's window (direct tiers
+            # have wider windows), assuming up to 1s cadence
+            win_pts = max(1, win_ms // 1000)
+            chunk_sz = max(1, _TILE_CELL_BUDGET // win_pts)
+        else:
+            chunk_sz = series_chunk
+        for lo in range(0, len(all_sids), chunk_sz):
+            chunk = all_sids[lo:lo + chunk_sz]
+            rsid_map = _chunk_tier_sids(tsdb, [base] + sub, chunk)
+            # windows align to their own width (a multiple of every
+            # nested tier's interval) so no coarse bucket straddles
+            # two windows — a straddle would write the same coarse ts
+            # twice and lose one half to last-write-wins dedup
+            t0 = start_ms - (start_ms % win_ms)
+            while t0 <= end_ms:
+                _rollup_window(tsdb, chunk, rsid_map,
+                               max(t0, start_ms),
+                               min(t0 + win_ms - 1, end_ms), base,
+                               sub, written)
+                t0 += win_ms
+            done += len(chunk)
+            if progress is not None:
+                progress(done, total_work)
     return written

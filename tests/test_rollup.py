@@ -137,6 +137,37 @@ class TestRollupJob:
                                  intervals=["1m"])
         assert set(written) == {"1m"}
 
+    def test_lcm_capped_nesting_and_direct_tiers(self, tsdb):
+        # 1m finest with 9m (nests: factor 9), 10m (lcm(9,10)=90
+        # exceeds the 64-bucket window cap -> direct raw pass), and
+        # 2h (factor 120 -> direct). All tiers must still be exact.
+        from opentsdb_tpu.rollup.config import (RollupConfig,
+                                                RollupInterval)
+        from opentsdb_tpu.rollup.store import RollupStore
+        cfg = RollupConfig([
+            RollupInterval("t1m", "p1m", "1m"),
+            RollupInterval("t9m", "p9m", "9m"),
+            RollupInterval("t10m", "p10m", "10m"),
+            RollupInterval("t2h", "p2h", "2h"),
+        ])
+        tsdb.rollup_config = cfg
+        tsdb.rollup_store = RollupStore(cfg)
+        base = 1356998400  # 2h-aligned epoch
+        for i in range(360):  # 3h @ 30s
+            tsdb.add_point("m", base + i * 30, 1.0, {"host": "a"})
+        written = run_rollup_job(tsdb, base * 1000,
+                                 (base + 10800) * 1000 - 1)
+        assert written["1m"] == 180 * 4
+        assert written["9m"] == 20 * 4
+        assert written["10m"] == 18 * 4
+        assert written["2h"] == 2 * 4
+        _, vals = (tsdb.rollup_store.tier("10m", "sum")
+                   .series(0).buffer.view())
+        assert np.allclose(vals, 20.0)   # 20 pts of 1.0 per 10m
+        _, cvals = (tsdb.rollup_store.tier("2h", "count")
+                    .series(0).buffer.view())
+        assert sorted(cvals.tolist()) == [120.0, 240.0]
+
     def test_job_without_rollups_enabled(self):
         from opentsdb_tpu import TSDB, Config
         plain = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))

@@ -103,6 +103,32 @@ class TestTimeSeriesStore:
         assert batch.num_points == 0
         assert batch.num_series == 1
 
+    def test_append_grid(self):
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(1, 1)])
+        b = store.get_or_create_series(1, [(1, 2)])
+        grid = np.array([[1.0, 2.0], [3.0, 4.0]])
+        mask = np.array([[True, False], [True, True]])
+        n = store.append_grid([a, b], np.array([1000, 2000]),
+                              grid, mask)
+        assert n == 3
+        ts, vals = store.series(b).buffer.view()
+        assert ts.tolist() == [1000, 2000]
+        assert vals.tolist() == [3.0, 4.0]
+
+    def test_append_grid_rejects_bad_sid(self):
+        # must reject up-front (no partial write, no negative-index
+        # wraparound onto the last-created series)
+        store = TimeSeriesStore()
+        a = store.get_or_create_series(1, [(1, 1)])
+        grid = np.ones((2, 1))
+        mask = np.ones((2, 1), dtype=bool)
+        for bad in (-1, a + 1):
+            with pytest.raises(IndexError):
+                store.append_grid([a, bad], np.array([1000]),
+                                  grid, mask)
+        assert store.series(a).buffer.view()[0].size == 0
+
     def test_metric_index(self):
         store = TimeSeriesStore()
         for v in range(10):
