@@ -240,6 +240,26 @@ def parse_uri_subquery(spec: str, index: int = 0) -> TSSubQuery:
     return sub
 
 
+def parse_uri_tsuid_subquery(spec: str, index: int = 0) -> TSSubQuery:
+    """Parse the URI form ``agg:[interval-ds:][rate:]tsuid1,tsuid2``
+    (ref: QueryRpc.parseTsuidTypeSubQuery)."""
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise BadRequestError(f"Invalid parameter tsuids={spec!r}")
+    sub = TSSubQuery(aggregator=parts[0], index=index)
+    for middle in parts[1:-1]:
+        if middle.startswith("rate"):
+            sub.rate = True
+            sub.rate_options = RateOptions.parse(middle)
+        elif middle:
+            sub.downsample = middle
+    sub.tsuids = [t.strip().upper() for t in parts[-1].split(",")
+                  if t.strip()]
+    if not sub.tsuids:
+        raise BadRequestError(f"Invalid parameter tsuids={spec!r}")
+    return sub
+
+
 def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
     """Parse ``/api/query?start=...&m=...`` URI params
     (ref: QueryRpc.parseQuery)."""
@@ -249,6 +269,8 @@ def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
 
     queries = [parse_uri_subquery(spec, i)
                for i, spec in enumerate(params.get("m", []))]
+    queries += [parse_uri_tsuid_subquery(spec, len(queries) + i)
+                for i, spec in enumerate(params.get("tsuids", []))]
     return TSQuery(
         start=first("start", ""),
         end=first("end"),

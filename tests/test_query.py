@@ -95,6 +95,20 @@ class TestUriParsing:
                                "m": ["sum:sys.cpu{host=web01}"]})
         assert not tsq.queries[0].filters[0].group_by
 
+    def test_tsuids_parse(self):
+        # ref: QueryRpc.parseTsuidTypeSubQuery
+        tsq = parse_uri_query(
+            {"start": ["1h-ago"],
+             "m": ["sum:sys.cpu"],
+             "tsuids": ["max:1m-avg:rate:000001000001000001,"
+                        "000001000001000002"]})
+        s = tsq.queries[1]
+        assert s.aggregator == "max"
+        assert s.downsample == "1m-avg"
+        assert s.rate
+        assert s.tsuids == ["000001000001000001", "000001000001000002"]
+        assert s.index == 1
+
 
 class TestQueryExecution:
     """(ref: TestTsdbQuery run* tests over the MockBase fixture)"""
