@@ -34,10 +34,12 @@ def _prev_valid_idx(mask):
 
 def _next_valid_idx(mask):
     """[S,B] -> per cell, index of nearest valid cell at or after it
-    (B if none)."""
+    (B if none). ``reverse=True`` scans right-to-left in place — the
+    flip/scan/flip spelling materializes two reversed copies of the
+    grid (measured 4.6 ms vs 0.8 ms at [1M, 12])."""
     b = mask.shape[-1]
     idx = jnp.where(mask, jnp.arange(b, dtype=jnp.int32), b)
-    return jnp.flip(jax.lax.cummin(jnp.flip(idx, -1), axis=mask.ndim - 1), -1)
+    return jax.lax.cummin(idx, axis=mask.ndim - 1, reverse=True)
 
 
 # Unrolled-select budget: reading the grid B times (one fused pass per
@@ -105,10 +107,14 @@ def fill_gaps(grid, bucket_ts, mode: str):
     v0 = _gather_minor(grid, safe_prev)
     v1 = _gather_minor(grid, safe_next)
     # integer ts diffs before the float cast (exact under int32
-    # relative offsets, see pipeline.device_bucket_ts)
+    # relative offsets, see pipeline.device_bucket_ts). The ts lookups
+    # ride the same fused select chain as the value gathers —
+    # bucket_ts[safe_prev] is a per-element TPU gather (measured ~5 ms
+    # of the 5.4 ms lerp total at [1M, 12]).
     t = bucket_ts[None, :]
-    t0 = bucket_ts[safe_prev]
-    t1 = bucket_ts[safe_next]
+    ts_row = jnp.broadcast_to(t, grid.shape)
+    t0 = _gather_minor(ts_row, safe_prev)
+    t1 = _gather_minor(ts_row, safe_next)
     num = (t - t0).astype(grid.dtype)
     den = (t1 - t0).astype(grid.dtype)
     lerped = v0 + (v1 - v0) * num / jnp.where(den > 0, den, 1.0)

@@ -79,7 +79,10 @@ def _rate_kernel(grid, bucket_ts, counter: bool, counter_max,
     # small relative offsets (device_bucket_ts) so integer diffs are
     # exact even on TPU where int64/float64 are unavailable
     t_cur = bucket_ts[None, :]
-    t_prev = bucket_ts[safe_prev]
+    # fused select chain, not a per-element TPU gather (see
+    # interp._gather_minor)
+    t_prev = _gather_minor(jnp.broadcast_to(t_cur, grid.shape),
+                           safe_prev)
     dt_sec = (t_cur - t_prev).astype(grid.dtype) / 1000.0
     dt_sec = jnp.where(dt_sec > 0, dt_sec, 1.0)
     delta = grid - v_prev
