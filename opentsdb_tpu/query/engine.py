@@ -353,18 +353,19 @@ class QueryEngine:
         tagv UID group key, TsdbQuery.java:995-1036)."""
         if not gb_kids:
             return (np.zeros(len(series_tags), dtype=np.int32), [()])
-        keys: list[tuple] = []
-        key_to_gid: dict[tuple, int] = {}
-        gids = np.empty(len(series_tags), dtype=np.int32)
-        for i, tags in enumerate(series_tags):
-            key = tuple(tags.get(k, -1) for k in gb_kids)
-            gid = key_to_gid.get(key)
-            if gid is None:
-                gid = len(keys)
-                key_to_gid[key] = gid
-                keys.append(key)
-            gids[i] = gid
-        return gids, keys
+        # columnar [S, K] key matrix + one sort-based unique: group ids
+        # come out ordered by concatenated tagv id, matching the
+        # reference's ByteMap ordering of group keys
+        # (TsdbQuery.java:995-1036); a per-series tuple/dict walk costs
+        # ~0.4 s at 200k series
+        mat = np.empty((len(series_tags), len(gb_kids)), dtype=np.int64)
+        for j, k in enumerate(gb_kids):
+            mat[:, j] = np.fromiter((t.get(k, -1) for t in series_tags),
+                                    dtype=np.int64,
+                                    count=len(series_tags))
+        uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
+        keys = [tuple(int(v) for v in row) for row in uniq]
+        return inverse.astype(np.int32), keys
 
     # ------------------------------------------------------------------
 

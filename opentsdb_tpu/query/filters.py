@@ -282,7 +282,10 @@ class FilterEvaluator:
         if len(sids) == 0:
             return np.zeros(0, dtype=bool)
         keep = np.ones(len(sids), dtype=bool)
-        sid_pos = {int(s): i for i, s in enumerate(sids)}
+        # vectorized sid -> position mapping (a Python dict walk over
+        # the triples costs ~0.4 s at 200k series)
+        order = np.argsort(sids, kind="stable")
+        sorted_sids = sids[order]
         by_key: dict[str, list[TagVFilter]] = {}
         for f in filters:
             by_key.setdefault(f.tagk, []).append(f)
@@ -297,11 +300,12 @@ class FilterEvaluator:
             rows = tag_triples[tag_triples[:, 1] == kid]
             has_key = np.zeros(len(sids), dtype=bool)
             series_tagv = np.full(len(sids), -1, dtype=np.int64)
-            for sid, _, vid in rows:
-                pos = sid_pos.get(int(sid))
-                if pos is not None:
-                    has_key[pos] = True
-                    series_tagv[pos] = vid
+            ins = np.searchsorted(sorted_sids, rows[:, 0])
+            ins_c = np.minimum(ins, len(sids) - 1)
+            valid = sorted_sids[ins_c] == rows[:, 0]
+            pos = order[ins_c[valid]]
+            has_key[pos] = True
+            series_tagv[pos] = rows[valid, 2]
             key_mask = np.ones(len(sids), dtype=bool)
             for f in flist:
                 if f.match_absent and not f.includes_present:
