@@ -13,6 +13,8 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Sequence
 
+import numpy as np
+
 from opentsdb_tpu.core import codec, const, tags as tags_mod
 from opentsdb_tpu.core.store import PointBatch, TimeSeriesStore
 from opentsdb_tpu.core.uid import UidRegistry
@@ -217,6 +219,108 @@ class TSDB:
             vid = resolve(self.uids.tag_values, "tagv", v, self.auto_tagv)
             tag_ids.append((kid, vid))
         return metric_id, tag_ids
+
+    def add_points(self, metric: str, timestamps, values,
+                   tags: dict[str, str], is_int=None) -> int:
+        """Bulk write many points of ONE series; returns the series id.
+
+        Vectorized twin of :meth:`add_point` — validation and UID
+        resolution happen once, timestamps normalize in numpy, and the
+        store takes one ``append_many``. The WHOLE batch is validated
+        before anything is written, so a raise never leaves a partial
+        batch behind. Per-point plugin hooks (write filters, realtime
+        publisher, external meta counter) fall back to the per-point
+        path after validation, matching the reference where those
+        hooks are inherently per-datapoint (TSDB.java:1225-1253).
+
+        ``is_int`` optionally carries per-point integer flags (bool
+        [N]); by default the flag derives from the values' dtype.
+        (ref: WritableDataPoints batching, IncomingDataPoints.java:36)
+        """
+        ts = np.asarray(timestamps, dtype=np.int64)
+        vals = np.asarray(values)
+        if ts.shape != vals.shape or ts.ndim != 1:
+            raise ValueError("timestamps/values must be equal-length 1-D")
+        if self.mode == "ro":
+            raise PermissionError("TSD is in read-only mode")
+        if len(ts) == 0:
+            raise ValueError("empty point batch")
+        if int(ts.min()) <= 0:
+            raise ValueError(f"invalid timestamp {int(ts.min())}")
+        # positive ts & SECOND_MASK != 0 <=> ts >= 2^32 (the mask
+        # itself overflows signed int64 in numpy)
+        is_ms = ts >= (1 << 32)
+        if int(ts[is_ms].max(initial=0)) > (1 << 47):
+            raise ValueError("timestamp out of range")
+        tags_mod.check_metric_and_tags(metric, tags)
+        if is_int is None:
+            flags = np.full(len(ts),
+                            np.issubdtype(vals.dtype, np.integer))
+        else:
+            flags = np.asarray(is_int, dtype=bool)
+        if (self.write_filters or self.rt_publisher is not None
+                or self.meta_cache is not None):
+            # inherently per-point hooks; batch already validated
+            sid = -1
+            for t, v, f in zip(ts.tolist(), vals.tolist(),
+                               flags.tolist()):
+                sid = self.add_point(metric, t,
+                                     int(v) if f else float(v), tags)
+            return sid
+        metric_id, tag_ids = self._resolve_write_uids(metric, tags)
+        sid = self.store.get_or_create_series(metric_id, tag_ids)
+        ts_ms = np.where(is_ms, ts, ts * 1000)
+        self.store.append_many(sid, ts_ms, vals.astype(np.float64),
+                               flags)
+        self.datapoints_added += len(ts)
+        if self.meta is not None:
+            self.meta.on_datapoint(metric_id, tag_ids, sid,
+                                   count=len(ts))
+        return sid
+
+    def add_point_batch(self, points, on_error=None
+                        ) -> tuple[int, list[str]]:
+        """Bulk write a mixed batch of ``(metric, ts, value, tags)``
+        tuples, grouping by series so UID resolution and store locking
+        amortize. A group whose bulk write fails is replayed per point
+        so every valid point still lands and errors stay per-point.
+        Returns (points_written, error strings); ``on_error(i, exc)``
+        additionally receives the input index of each failing point.
+        """
+        groups: dict[tuple, list] = {}
+        errors: list[str] = []
+        written = 0
+
+        def fail(idx: int, metric: str, ts, e: Exception) -> None:
+            errors.append(f"{metric} @{ts}: {e}")
+            if on_error is not None:
+                on_error(idx, e)
+
+        for i, (metric, ts, value, tags) in enumerate(points):
+            key = (metric, tuple(sorted(tags.items())))
+            groups.setdefault(key, []).append((i, ts, value, tags))
+        for (metric, _), items in groups.items():
+            try:
+                n = len(items)
+                ts_arr = np.asarray([it[1] for it in items],
+                                    dtype=np.int64)
+                raw = [it[2] for it in items]
+                vals = np.asarray(raw, dtype=np.float64)
+                # type(v) is int: excludes bool, one pass
+                flags = np.fromiter((type(v) is int for v in raw),
+                                    dtype=bool, count=n)
+                self.add_points(metric, ts_arr, vals, items[0][3],
+                                is_int=flags)
+                written += n
+            except Exception:  # noqa: BLE001
+                # per-point replay: valid points land, errors map back
+                for idx, t, v, tg in items:
+                    try:
+                        self.add_point(metric, t, v, tg)
+                        written += 1
+                    except Exception as e:  # noqa: BLE001
+                        fail(idx, metric, t, e)
+        return written, errors
 
     def add_aggregate_point(self, metric: str, timestamp: int,
                             value: int | float, tags: dict[str, str],

@@ -85,13 +85,17 @@ class MetaStore:
         self.uid_meta: dict[tuple[str, str], UIDMeta] = {}
         self.ts_counters: dict[str, int] = {}
 
-    def on_datapoint(self, metric_id: int, tag_ids, series_id: int) -> None:
+    def on_datapoint(self, metric_id: int, tag_ids, series_id: int,
+                     count: int = 1) -> None:
+        """Realtime TSMeta tracking; ``count`` lets the bulk write path
+        account a whole per-series batch in one call."""
         if not self.track_ts:
             return
         tsuid = self._tsdb.uids.tsuid(metric_id, tag_ids).hex().upper()
         now = int(time.time())
         with self._lock:
-            self.ts_counters[tsuid] = self.ts_counters.get(tsuid, 0) + 1
+            self.ts_counters[tsuid] = (self.ts_counters.get(tsuid, 0)
+                                       + count)
             meta = self.ts_meta.get(tsuid)
             if meta is None:
                 meta = TSMeta(tsuid=tsuid, created=now)

@@ -214,8 +214,12 @@ class HttpRpcRouter:
         points = self.serializer.parse_put(request.body)
         details = request.flag("details")
         summary = request.flag("summary")
-        success = 0
         errors: list[dict] = []
+        # parse every point, then write through the series-grouped bulk
+        # path; failed groups replay per point inside add_point_batch so
+        # error reporting and SEH spooling stay per-datapoint
+        parsed: list[tuple] = []
+        dps: list[dict] = []
         for dp in points:
             try:
                 metric = dp["metric"]
@@ -226,24 +230,29 @@ class HttpRpcRouter:
                              ("." in value or "e" in value.lower())
                              else int(value))
                 tags = dp.get("tags") or {}
-                self.tsdb.add_point(metric, ts, value, tags)
-                success += 1
+                parsed.append((metric, ts, value, tags))
+                dps.append(dp)
             except (KeyError, TypeError) as e:
                 errors.append({"datapoint": dp,
                                "error": f"missing field: {e}"})
-            except Exception as e:  # noqa: BLE001
+            except ValueError as e:
                 errors.append({"datapoint": dp, "error": str(e)})
-                seh = self.tsdb.storage_exception_handler
-                from opentsdb_tpu.core.uid import \
-                    FailedToAssignUniqueIdError
-                if seh is not None and not isinstance(
-                        e, (ValueError, LookupError,
-                            FailedToAssignUniqueIdError)):
-                    # spool only storage-layer failures for replay; a
-                    # bad datapoint (unknown UID, filter veto, bad
-                    # value) fails identically on every retry
-                    # (ref: PutDataPointRpc requeue via SEH plugin)
-                    seh.handle_error(dp, e)
+
+        def on_error(i: int, e: Exception) -> None:
+            dp = dps[i]
+            errors.append({"datapoint": dp, "error": str(e)})
+            seh = self.tsdb.storage_exception_handler
+            from opentsdb_tpu.core.uid import FailedToAssignUniqueIdError
+            if seh is not None and not isinstance(
+                    e, (ValueError, LookupError,
+                        FailedToAssignUniqueIdError)):
+                # spool only storage-layer failures for replay; a bad
+                # datapoint (unknown UID, filter veto, bad value) fails
+                # identically on every retry
+                # (ref: PutDataPointRpc requeue via SEH plugin)
+                seh.handle_error(dp, e)
+
+        success, _ = self.tsdb.add_point_batch(parsed, on_error=on_error)
         failed = len(errors)
         if not details and not summary:
             if failed:

@@ -119,7 +119,10 @@ def test_storage_exception_handler_called(monkeypatch):
 
     def boom(*a, **kw):
         raise RuntimeError("storage down")
-    monkeypatch.setattr(tsdb, "add_point", boom)
+    # fail at the storage layer: the bulk write fails, then the
+    # per-point replay fails, and the replay's error routes to the SEH
+    monkeypatch.setattr(tsdb.store, "append_many", boom)
+    monkeypatch.setattr(tsdb.store, "append", boom)
     body = json.dumps([{"metric": "m", "timestamp": 1356998400,
                         "value": 1, "tags": {"h": "a"}}]).encode()
     resp = router.handle(HttpRequest("POST", "/api/put?details",

@@ -145,3 +145,114 @@ class TestTimeSeriesStore:
         a = store.get_or_create_series(1, [(1, 1)])
         shards = store.shards_of([a])
         assert 0 <= shards[0] < 8
+
+
+class TestBulkWrite:
+    """Bulk twin of the per-point write path (TSDB.add_points /
+    add_point_batch)."""
+
+    def _tsdb(self):
+        from opentsdb_tpu import TSDB, Config
+        return TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+
+    def test_add_points_matches_add_point(self):
+        a, b = self._tsdb(), self._tsdb()
+        ts = np.array([1356998400, 1356998410, 1356998420000])  # s+ms mix
+        vals = np.array([1.5, 2.5, 3.5])
+        sid_bulk = a.add_points("m", ts, vals, {"host": "x"})
+        for t, v in zip(ts.tolist(), vals.tolist()):
+            sid_one = b.add_point("m", t, v, {"host": "x"})
+        ta, va = a.store.series(sid_bulk).buffer.view()
+        tb, vb = b.store.series(sid_one).buffer.view()
+        assert ta.tolist() == tb.tolist()
+        assert va.tolist() == vb.tolist()
+        assert a.datapoints_added == 3
+
+    def test_add_points_int_dtype_preserved(self):
+        t = self._tsdb()
+        sid = t.add_points("m", np.array([1356998400]),
+                           np.array([7], dtype=np.int64), {"h": "a"})
+        rec = t.store.series(sid)
+        assert rec.buffer.view()[1][0] == 7.0
+
+    def test_add_points_rejects_bad_ts(self):
+        t = self._tsdb()
+        with pytest.raises(ValueError):
+            t.add_points("m", np.array([0]), np.array([1.0]), {"h": "a"})
+        with pytest.raises(ValueError):
+            t.add_points("m", np.array([], dtype=np.int64),
+                         np.array([]), {"h": "a"})
+
+    def test_add_points_readonly_mode(self):
+        from opentsdb_tpu import TSDB, Config
+        t = TSDB(Config(**{"tsd.mode": "ro"}))
+        with pytest.raises(PermissionError):
+            t.add_points("m", np.array([1356998400]),
+                         np.array([1.0]), {"h": "a"})
+
+    def test_add_points_write_filter_fallback(self):
+        # per-point hooks must still see every point
+        t = self._tsdb()
+        seen = []
+
+        class Filt:
+            def allow_data_point(self, metric, ts, value, tags):
+                seen.append(ts)
+                return value != 2.0
+
+        t.write_filters.append(Filt())
+        t.add_points("m", np.array([1356998400, 1356998410]),
+                     np.array([1.0, 2.0]), {"h": "a"})
+        assert len(seen) == 2
+        sid = t.store.get_or_create_series(
+            t.uids.metrics.get_id("m"),
+            [(t.uids.tag_names.get_id("h"), t.uids.tag_values.get_id("a"))])
+        assert len(t.store.series(sid).buffer.view()[0]) == 1
+
+    def test_add_point_batch_groups_series(self):
+        t = self._tsdb()
+        written, errors = t.add_point_batch([
+            ("m", 1356998400, 1.0, {"h": "a"}),
+            ("m", 1356998410, 2.0, {"h": "a"}),
+            ("m", 1356998400, 3.0, {"h": "b"}),
+            ("bad metric!", 1356998400, 1.0, {}),
+        ])
+        assert written == 3
+        assert len(errors) == 1
+
+    def test_add_point_batch_partial_group_replays(self):
+        # a bad point must not sink its whole series group, and the
+        # error callback gets the ORIGINAL input index
+        t = self._tsdb()
+        bad_idx = []
+        written, errors = t.add_point_batch([
+            ("m", 1356998400, 1.0, {"h": "a"}),
+            ("m", 0, 2.0, {"h": "a"}),          # invalid ts
+            ("m", 1356998420, 3.0, {"h": "a"}),
+        ], on_error=lambda i, e: bad_idx.append(i))
+        assert written == 2
+        assert len(errors) == 1
+        assert bad_idx == [1]
+        sid = t.store.get_or_create_series(
+            t.uids.metrics.get_id("m"),
+            [(t.uids.tag_names.get_id("h"),
+              t.uids.tag_values.get_id("a"))])
+        assert t.store.series(sid).buffer.view()[0].tolist() == \
+            [1356998400000, 1356998420000]
+
+    def test_add_point_batch_mixed_int_float_flags(self):
+        # per-point integer flags survive the bulk path (the storage
+        # codec renders 3 vs 3.0 differently on export)
+        t = self._tsdb()
+        t.add_point_batch([
+            ("m", 1356998400, 3, {"h": "a"}),
+            ("m", 1356998410, 2.5, {"h": "a"}),
+        ])
+        sid = t.store.get_or_create_series(
+            t.uids.metrics.get_id("m"),
+            [(t.uids.tag_names.get_id("h"),
+              t.uids.tag_values.get_id("a"))])
+        buf = t.store.series(sid).buffer
+        flags = (buf.flags_view() if hasattr(buf, "flags_view")
+                 else buf.is_int[:len(buf)])
+        assert list(np.asarray(flags, dtype=bool)) == [True, False]
