@@ -341,8 +341,15 @@ class NativeTimeSeriesStore:
 
 
 def make_store(config, num_shards: int | None = None):
-    """Storage backend factory honoring ``tsd.storage.backend``."""
-    backend = config.get_string("tsd.storage.backend", "memory")
+    """Storage backend factory honoring ``tsd.storage.backend``.
+
+    Defaults to the C++ engine (libtsdbstore) — the production path,
+    preserving the reference's swappable-storage-client shape
+    (asynchbase/asyncbigtable/asynccassandra, SURVEY.md §5.8); set
+    ``tsd.storage.backend=memory`` for the pure-Python twin, e.g. where
+    no compiler exists. Falls back automatically if the build fails.
+    """
+    backend = config.get_string("tsd.storage.backend", "native")
     if backend == "native":
         try:
             return NativeTimeSeriesStore(num_shards=num_shards)
