@@ -172,6 +172,44 @@ def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
     return result, emit
 
 
+@partial(jax.jit, static_argnames=("spec",))
+def run_pipeline_avg_div(grid_sum, grid_cnt, bucket_ts, group_ids,
+                         rate_params, fill_value, spec: PipelineSpec):
+    """Tail entry for the avg-rollup derivation: divides a bucketized
+    SUM-tier grid by a bucketized COUNT-tier grid in-trace (no host
+    round-trip for the [S,B] grids), then runs the shared
+    rate/interpolate/aggregate chain."""
+    valid = (~jnp.isnan(grid_sum)) & (~jnp.isnan(grid_cnt)) \
+        & (grid_cnt > 0)
+    grid = jnp.where(valid, grid_sum / jnp.where(valid, grid_cnt, 1.0),
+                     jnp.nan)
+    return _finish_pipeline(grid, valid, bucket_ts, group_ids,
+                            rate_params, fill_value, spec)
+
+
+def execute_avg_divide(grid_sum, grid_cnt, bucket_ts: np.ndarray,
+                       group_ids: np.ndarray, spec: PipelineSpec,
+                       rate_options: RateOptions | None = None,
+                       dtype=None, device=None
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Host entry: sum/count tier grids (device arrays straight from
+    ``bucketize`` are fine) -> (result, emit)."""
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
+            else jnp.float32
+    ro = rate_options or RateOptions()
+    put = partial(jax.device_put, device=device)
+    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
+                   jnp.asarray(ro.reset_value, dtype=dtype))
+    result, emit = run_pipeline_avg_div(
+        jnp.asarray(grid_sum, dtype=dtype),
+        jnp.asarray(grid_cnt, dtype=dtype),
+        put(jnp.asarray(device_bucket_ts(bucket_ts))),
+        put(jnp.asarray(group_ids, dtype=jnp.int32)),
+        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), spec)
+    return np.asarray(result), np.asarray(emit)
+
+
 _DENSE_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "min", "mimmin",
                         "max", "mimmax", "count", "first", "last"))
 

@@ -33,7 +33,8 @@ from opentsdb_tpu.ops.blocked import (DEFAULT_CELL_BUDGET,
                                       execute_blocked,
                                       pick_block_buckets)
 from opentsdb_tpu.ops.pipeline import (PipelineSpec, execute,
-                                       execute_auto, flatten_padded)
+                                       execute_auto, execute_avg_divide,
+                                       flatten_padded)
 from opentsdb_tpu.query import filters as filters_mod
 from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
 from opentsdb_tpu.stats.stats import QueryStat, QueryStats
@@ -90,7 +91,25 @@ class QueryEngine:
             from opentsdb_tpu.query.histogram_engine import \
                 run_histogram_subquery
             return run_histogram_subquery(self.tsdb, tsq, sub)
-        store, metric_name, sids, rollup_scale = self._select_store(sub)
+        (store, metric_name, sids, rollup_scale,
+         avg_count_store) = self._select_store(sub)
+        budget = self.tsdb.config.get_int(
+            "tsd.query.max_device_cells", 0) or DEFAULT_CELL_BUDGET
+        if avg_count_store is not None:
+            # the sum/count grid division materializes [S, B] whole;
+            # oversized ranges go to the raw streaming path instead —
+            # but only when raw data actually exists (rolled-up data
+            # may outlive its raw source), else an expensive exact
+            # answer beats a cheap empty one
+            b_est = ((tsq.end_ms - tsq.start_ms)
+                     // max(sub.ds_spec.interval_ms, 1)) + 2
+            if len(sids) * b_est > budget:
+                raw_sids = self.tsdb.store.series_ids_for_metric(
+                    uids.metrics.get_id(sub.metric))
+                if len(raw_sids):
+                    avg_count_store = None
+                    store = self.tsdb.store
+                    sids = raw_sids
         if len(sids) == 0:
             return []
 
@@ -116,6 +135,17 @@ class QueryEngine:
             group_ids = np.arange(len(sids), dtype=np.int32)
             group_keys = [(i,) for i in range(len(sids))]
         num_groups = len(group_keys)
+
+        if avg_count_store is not None:
+            out = self._avg_rollup_pipeline(
+                store, avg_count_store, sids, tsq, sub, metric_name,
+                group_ids, num_groups, emit_raw, stats)
+            if out is None:
+                return []
+            result, emit, bucket_ts = out
+            return self._build_results(
+                tsq, sub, metric_name, sids, series_tags, group_ids,
+                group_keys, gb_kids, bucket_ts, result, emit)
 
         # --- materialize + time grid (row-padded layout: the ragged ->
         # dense transposition happens inside materialize, so the device
@@ -210,8 +240,6 @@ class QueryEngine:
             else:
                 batch = batch._replace(values=batch.values
                                        * rollup_scale)
-        budget = self.tsdb.config.get_int(
-            "tsd.query.max_device_cells", 0) or DEFAULT_CELL_BUDGET
         if not emit_raw and len(sids) * len(bucket_ts) > budget:
             # long-range streaming: bound HBM at [S x block] cells
             # (SURVEY.md §5.7 time-axis blocking)
@@ -245,9 +273,18 @@ class QueryEngine:
     # ------------------------------------------------------------------
 
     def _select_store(self, sub: TSSubQuery
-                      ) -> tuple[TimeSeriesStore, str, np.ndarray, float]:
+                      ) -> tuple[TimeSeriesStore, str, np.ndarray, float,
+                                 TimeSeriesStore | None]:
         """Pick raw store or a rollup tier (ref: TsdbQuery rollup
-        best-match :143-150 with ROLLUP_USAGE fallback :750)."""
+        best-match :143-150 with ROLLUP_USAGE fallback :750).
+
+        The last element is the COUNT-tier store when an ``avg``
+        downsample is being answered from rollups: the reference
+        derives rollup averages as SUM cells / COUNT cells
+        (RollupConfig, RollupSpan agg-prefixed qualifiers); here the
+        sum tier is the primary store and the count tier rides along
+        for the grid division (``_avg_rollup_grid``).
+        """
         uids = self.tsdb.uids
         if sub.tsuids:
             return self._tsuid_store(sub)
@@ -258,23 +295,93 @@ class QueryEngine:
                 f"No such name for 'metrics': '{sub.metric}'") from None
         store = self.tsdb.store
         rollup_scale = 1.0
+        avg_count_store = None
         usage = (sub.rollup_usage or "ROLLUP_NOFALLBACK").upper()
         if (self.tsdb.rollup_store is not None and sub.ds_spec is not None
                 and not sub.ds_spec.run_all and usage != "ROLLUP_RAW"):
             tier = self.tsdb.rollup_config.best_match(
                 sub.ds_spec.interval_ms)
             agg_fn = sub.ds_spec.function
+            rs = self.tsdb.rollup_store
             if tier is not None and agg_fn in ("sum", "count", "min",
                                                "max"):
-                if self.tsdb.rollup_store.has_data(tier.interval, agg_fn):
-                    store = self.tsdb.rollup_store.tier(tier.interval,
-                                                        agg_fn)
+                if rs.has_data(tier.interval, agg_fn):
+                    store = rs.tier(tier.interval, agg_fn)
+            elif tier is not None and agg_fn == "avg" \
+                    and rs.has_data(tier.interval, "sum") \
+                    and rs.has_data(tier.interval, "count"):
+                store = rs.tier(tier.interval, "sum")
+                avg_count_store = rs.tier(tier.interval, "count")
         sids = store.series_ids_for_metric(metric_id)
         if store is not self.tsdb.store and len(sids) == 0 and \
                 usage in ("ROLLUP_FALLBACK", "ROLLUP_FALLBACK_RAW"):
             store = self.tsdb.store
             sids = store.series_ids_for_metric(metric_id)
-        return store, sub.metric, sids, rollup_scale
+            avg_count_store = None
+        return store, sub.metric, sids, rollup_scale, avg_count_store
+
+    def _avg_rollup_pipeline(self, sum_store, cnt_store,
+                             sids: np.ndarray, tsq: TSQuery,
+                             sub: TSSubQuery, metric_name: str,
+                             group_ids: np.ndarray, num_groups: int,
+                             emit_raw: bool, stats):
+        """Answer an ``avg`` downsample from rollup tiers: bucketized
+        SUM cells divided by bucketized COUNT cells — the true weighted
+        average, not a mean of per-tier-point averages (ref: RollupSpan
+        reading agg-prefixed sum+count qualifiers from one row).
+        Returns (result, emit, bucket_ts) or None for no data."""
+        t1 = time.monotonic()
+        batch_s = sum_store.materialize(sids, tsq.start_ms, tsq.end_ms)
+        # count series aligned to sum series by (metric, tags) identity
+        csids = np.full(len(sids), -1, dtype=np.int64)
+        for i, sid in enumerate(sids):
+            rec = sum_store.series(int(sid))
+            c = cnt_store._key_to_sid.get(
+                (rec.metric_id, tuple(sorted(rec.tags))))
+            if c is not None:
+                csids[i] = c
+        present = np.nonzero(csids >= 0)[0]
+        batch_c = cnt_store.materialize(csids[present], tsq.start_ms,
+                                        tsq.end_ms)
+        num_points = batch_s.num_points + batch_c.num_points
+        if stats:
+            stats.add_stat(QueryStat.MATERIALIZE_TIME,
+                           (time.monotonic() - t1) * 1e3)
+            stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
+        self.tsdb.query_limits.check(metric_name, num_points)
+        if tsq.delete:
+            sum_store.delete_range(sids, tsq.start_ms, tsq.end_ms)
+            cnt_store.delete_range(csids[present], tsq.start_ms,
+                                   tsq.end_ms)
+        if batch_s.num_points == 0:
+            return None
+        t2 = time.monotonic()
+        bidx_s, bucket_ts = ds_mod.assign_buckets(
+            batch_s.ts_ms, sub.ds_spec, tsq.start_ms, tsq.end_ms)
+        bidx_c, _ = ds_mod.assign_buckets(
+            batch_c.ts_ms, sub.ds_spec, tsq.start_ms, tsq.end_ms)
+        s, b = len(sids), len(bucket_ts)
+        # both grids stay on device: bucketize returns device arrays
+        # and the division happens in the same trace as the tail
+        gs, _ = ds_mod.bucketize(batch_s.values, batch_s.series_idx,
+                                 bidx_s, s, b, "sum")
+        sidx_c = present[batch_c.series_idx].astype(np.int32)
+        gc, _ = ds_mod.bucketize(batch_c.values, sidx_c, bidx_c, s, b,
+                                 "sum")
+        spec = PipelineSpec(
+            num_series=s, num_buckets=b, num_groups=num_groups,
+            ds_function="avg", agg_name=sub.agg.name,
+            fill_policy=sub.ds_spec.fill_policy,
+            fill_value=sub.ds_spec.fill_value, rate=sub.rate,
+            rate_counter=sub.rate_options.counter,
+            rate_drop_resets=sub.rate_options.drop_resets,
+            emit_raw=emit_raw)
+        result, emit = execute_avg_divide(gs, gc, bucket_ts, group_ids,
+                                          spec, sub.rate_options)
+        if stats:
+            stats.add_stat(QueryStat.COMPUTE_TIME,
+                           (time.monotonic() - t2) * 1e3)
+        return result, emit, bucket_ts
 
     def _tsuid_store(self, sub: TSSubQuery):
         """Resolve explicit TSUID hex strings to series ids
@@ -306,8 +413,8 @@ class QueryEngine:
             sid = store._key_to_sid.get(key)
             if sid is not None:
                 sids.append(sid)
-        return store, metric_name or "", np.asarray(sids,
-                                                    dtype=np.int64), 1.0
+        return store, metric_name or "", np.asarray(
+            sids, dtype=np.int64), 1.0, None
 
     # ------------------------------------------------------------------
 

@@ -229,3 +229,37 @@ class TestRollupQueryRouting:
                          "downsample": "1m-avg"}]})
         dps = dict(res[0].dps)
         assert dps[base * 1000] == pytest.approx(sum(range(6)) / 6.0)
+
+    def test_avg_served_from_tiers_after_raw_delete(self, tsdb):
+        # prove avg really reads the sum/count tiers: drop the raw data
+        # after the rollup job and the avg query must still answer
+        base = self.seed_and_roll(tsdb)
+        raw_sids = tsdb.store.series_ids_for_metric(
+            tsdb.uids.metrics.get_id("m"))
+        tsdb.store.delete_range(raw_sids, 0, (base + 10_000) * 1000)
+        res = run_query(tsdb, {
+            "start": base - 60, "end": base + 1300,
+            "queries": [{"aggregator": "sum", "metric": "m",
+                         "downsample": "1m-avg"}]})
+        dps = dict(res[0].dps)
+        assert dps[base * 1000] == pytest.approx(sum(range(6)) / 6.0)
+
+    def test_avg_rollup_is_weighted_not_mean_of_means(self, tsdb):
+        # coarser-than-tier avg: 2m bucket spanning one 1m cell of 6
+        # points and one of 2 -> true avg weights by count
+        base = 1356998400
+        for i in range(6):
+            tsdb.add_point("w", base + i * 10, 12.0, {"host": "a"})
+        for i in range(2):
+            tsdb.add_point("w", base + 60 + i * 10, 24.0, {"host": "a"})
+        run_rollup_job(tsdb, base * 1000, (base + 120) * 1000 - 1)
+        raw_sids = tsdb.store.series_ids_for_metric(
+            tsdb.uids.metrics.get_id("w"))
+        tsdb.store.delete_range(raw_sids, 0, (base + 10_000) * 1000)
+        res = run_query(tsdb, {
+            "start": base - 60, "end": base + 1300,
+            "queries": [{"aggregator": "sum", "metric": "w",
+                         "downsample": "2m-avg"}]})
+        dps = dict(res[0].dps)
+        want = (6 * 12.0 + 2 * 24.0) / 8.0   # 15.0, not (12+24)/2=18
+        assert dps[base * 1000] == pytest.approx(want)
