@@ -157,10 +157,8 @@ def _load_timeseries(store, directory: str) -> None:
         lo, n = entry["offset"], entry["count"]
         if n:
             store.append_many(sid, all_ts[lo:lo + n],
-                              all_vals[lo:lo + n])
-            # restore int-ness flags lost by append_many's default
-            buf = store.series(sid).buffer
-            buf.is_int[buf.n - n:buf.n] = all_ints[lo:lo + n]
+                              all_vals[lo:lo + n],
+                              is_int=all_ints[lo:lo + n])
 
 
 def _save_annotations(annotations, data_dir: str) -> None:

@@ -81,7 +81,9 @@ class TSDB:
             self.rollup_config = (RollupConfig.from_file(path) if path
                                   else RollupConfig.default())
             from opentsdb_tpu.rollup.store import RollupStore
-            self.rollup_store = RollupStore(self.rollup_config)
+            self.rollup_store = RollupStore(
+                self.rollup_config,
+                store_factory=lambda: make_store(self.config))
         else:
             self.rollup_store = None
         from opentsdb_tpu.core.histogram import HistogramCodecManager

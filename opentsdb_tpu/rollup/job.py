@@ -35,10 +35,11 @@ from opentsdb_tpu.rollup.config import RollupConfig, RollupInterval
 
 ROLLUP_AGGS = ("sum", "count", "min", "max")
 
-# device cell budget per tile and bucket cap per window (the min/max
-# kernels make one fused pass per bucket, so windows stay small)
+# device cell budget per tile and bucket cap per window. Wider windows
+# amortize per-dispatch latency (the dominant cost on relayed devices);
+# the cap bounds the [S, B] output grids and the coarsen one-hot.
 _TILE_CELL_BUDGET = 64_000_000
-_MAX_WINDOW_BUCKETS = 64
+_MAX_WINDOW_BUCKETS = 360
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))
@@ -49,6 +50,29 @@ def _rollup_tile(values2d, bucket_idx2d, num_buckets: int):
                                      num_buckets, agg)[0]
              for agg in ROLLUP_AGGS]
     return jnp.stack(grids)
+
+
+@partial(jax.jit, static_argnames=("num_buckets", "k"))
+def _rollup_tile_dense(values2d, num_buckets: int, k: int):
+    """Regular-cadence tile (every row full, k points per bucket): all
+    four aggregations from [S, B, k] reshape reductions — no bucket
+    compare tensor, one pass over the data per statistic. This is the
+    fixed-collection-interval common case and the BASELINE config-5
+    shape."""
+    s = values2d.shape[0]
+    x = values2d.reshape(s, num_buckets, k)
+    valid = ~jnp.isnan(x)
+    cnt = jnp.sum(valid, axis=-1).astype(values2d.dtype)
+    sums = jnp.nansum(x, axis=-1)
+    mins = jnp.min(jnp.where(valid, x, jnp.inf), axis=-1)
+    maxs = jnp.max(jnp.where(valid, x, -jnp.inf), axis=-1)
+    empty = cnt == 0
+    return jnp.stack([
+        jnp.where(empty, jnp.nan, sums),
+        jnp.where(empty, jnp.nan, cnt),
+        jnp.where(empty, jnp.nan, mins),
+        jnp.where(empty, jnp.nan, maxs),
+    ])
 
 
 @partial(jax.jit, static_argnames=("num_coarse",))
@@ -70,18 +94,16 @@ def _coarsen(grids, coarse_idx, num_coarse: int):
 
     sums = csum(grids[0])
     cnts = csum(grids[1])
-    mins_cols = []
-    maxs_cols = []
-    for c in range(num_coarse):
-        m = (coarse_idx == c)[None, :]
-        mins_cols.append(jnp.min(
-            jnp.where(m & ~jnp.isnan(grids[2]), grids[2], jnp.inf),
-            axis=1))
-        maxs_cols.append(jnp.max(
-            jnp.where(m & ~jnp.isnan(grids[3]), grids[3], -jnp.inf),
-            axis=1))
-    mins = jnp.stack(mins_cols, axis=1)
-    maxs = jnp.stack(maxs_cols, axis=1)
+    # broadcast membership [Bf, Bc] -> one fused reduce per extremum
+    # (a per-coarse-bucket Python loop unrolls Bc passes)
+    eq = coarse_idx[:, None] == jnp.arange(num_coarse,
+                                           dtype=coarse_idx.dtype)[None, :]
+    m_min = eq[None, :, :] & ~jnp.isnan(grids[2])[:, :, None]
+    mins = jnp.min(jnp.where(m_min, grids[2][:, :, None], jnp.inf),
+                   axis=1)
+    m_max = eq[None, :, :] & ~jnp.isnan(grids[3])[:, :, None]
+    maxs = jnp.max(jnp.where(m_max, grids[3][:, :, None], -jnp.inf),
+                   axis=1)
     empty = cnts == 0
     nan = jnp.nan
     return jnp.stack([
@@ -108,12 +130,24 @@ def _chunk_tier_sids(tsdb, tiers: list[RollupInterval], chunk
     return out
 
 
+def _write_outs(tsdb, rsid_map, outs, written: dict[str, int]) -> None:
+    """Fetch dispatched device grids and write them to the tier
+    stores. Kept separate from dispatch so the NEXT window's device
+    work is already in flight while this one's results download and
+    write (the fetch is the only blocking step)."""
+    for tier, bucket_ts, g_dev, row_off in outs:
+        _write_grids(tsdb, tier, rsid_map, bucket_ts,
+                     np.asarray(g_dev), row_off, written)
+
+
 def _write_grids(tsdb, tier: RollupInterval, rsid_map, bucket_ts,
-                 grids: np.ndarray, written: dict[str, int]) -> None:
+                 grids: np.ndarray, row_off: int,
+                 written: dict[str, int]) -> None:
     """Bulk-write all four aggregations (store.append_grid: one C++
     threaded pass per agg on the native backend). All four grids share
     one NaN pattern (a bucket is NaN iff its count is 0), so a single
-    [S, B] mask serves every agg."""
+    [S, B] mask serves every agg. ``row_off`` positions grid row 0
+    within the sweep's chunk (series-split tiles cover a sub-range)."""
     mask = ~np.isnan(grids[1])  # count grid
     any_rows = mask.any(axis=1)
     if not any_rows.any():
@@ -122,49 +156,121 @@ def _write_grids(tsdb, tier: RollupInterval, rsid_map, bucket_ts,
     sub_mask = mask[rows]
     for ai, agg in enumerate(ROLLUP_AGGS):
         store = tsdb.rollup_store.tier(tier.interval, agg)
-        rsids = rsid_map[(tier.interval, agg)][rows]
+        rsids = rsid_map[(tier.interval, agg)][row_off + rows]
         n = store.append_grid(rsids, np.asarray(bucket_ts),
                               grids[ai][rows], sub_mask)
         written[tier.interval] += n
 
 
-def _rollup_window(tsdb, chunk, rsid_map, start_ms: int, end_ms: int,
-                   base: RollupInterval, nested: list[RollupInterval],
-                   written: dict[str, int]) -> None:
+# the irregular tile reduces a broadcast [S, P, B] membership tensor,
+# so its cell count stays bounded by splitting wide windows (or, when
+# the nested-tier lcm forbids narrower windows, the series axis)
+_PADDED_TILE_MAX_CELLS = 500_000_000
+# sub-window bucket cap used when re-tiling an oversized irregular tile
+_SPLIT_WINDOW_BUCKETS = 64
+
+
+def _split_window(tsdb, chunk, row_off: int, start_ms: int,
+                  end_ms: int, base: RollupInterval,
+                  nested: list[RollupInterval]) -> list:
+    """Re-tile an oversized irregular window: narrower coarse-aligned
+    sub-windows when the nested-tier lcm allows, else halve the series
+    axis (each half may split further)."""
+    factors = [t.interval_ms // base.interval_ms for t in nested]
+    sub_buckets = _window_buckets(factors, cap=_SPLIT_WINDOW_BUCKETS)
+    cur_buckets = (end_ms - start_ms) // base.interval_ms + 1
+    outs = []
+    if sub_buckets < cur_buckets:
+        sub_ms = base.interval_ms * sub_buckets
+        t0 = start_ms - (start_ms % sub_ms)
+        while t0 <= end_ms:
+            outs.extend(_rollup_window(
+                tsdb, chunk, row_off, max(t0, start_ms),
+                min(t0 + sub_ms - 1, end_ms), base, nested,
+                can_split=False))
+            t0 += sub_ms
+        return outs
+    half = len(chunk) // 2
+    if half == 0:
+        # single series still over the cap: dispatch as-is
+        return _rollup_window(tsdb, chunk, row_off, start_ms, end_ms,
+                              base, nested, can_split=False)
+    outs.extend(_rollup_window(tsdb, chunk[:half], row_off, start_ms,
+                               end_ms, base, nested))
+    outs.extend(_rollup_window(tsdb, chunk[half:], row_off + half,
+                               start_ms, end_ms, base, nested))
+    return outs
+
+
+def _rollup_window(tsdb, chunk, row_off: int, start_ms: int,
+                   end_ms: int, base: RollupInterval,
+                   nested: list[RollupInterval],
+                   can_split: bool = True) -> list:
     """One (series chunk x time window) tile: base tier from raw, then
-    nested tiers by on-device coarsening."""
+    nested tiers by on-device coarsening. DISPATCHES the device work
+    and returns ``[(tier, bucket_ts, device_grids, row_off), ...]``
+    without blocking — the tile grids never round-trip to the host
+    between bucketize and coarsen."""
+    if can_split:
+        # pre-split clearly-irregular oversized windows from counts
+        # alone, BEFORE paying the big materialize (equal counts are
+        # near-certainly the regular fast path, which builds no
+        # membership tensor; the post-detect check below backstops the
+        # equal-but-irregular edge)
+        counts = tsdb.store.count_range(chunk, start_ms, end_ms)
+        pmax = int(counts.max()) if len(counts) else 0
+        nb_est = (end_ms - start_ms) // base.interval_ms + 1
+        if pmax and int(counts.min()) != pmax and \
+                len(chunk) * pmax * nb_est > _PADDED_TILE_MAX_CELLS:
+            return _split_window(tsdb, chunk, row_off, start_ms,
+                                 end_ms, base, nested)
     padded = tsdb.store.materialize_padded(chunk, start_ms, end_ms)
     if padded.num_points == 0:
-        return
+        return []
     spec = ds_mod.DownsamplingSpecification(
         interval_ms=base.interval_ms, function="sum")
     bucket_idx2d, bucket_ts = ds_mod.assign_buckets_padded(
         padded.ts2d, padded.counts, spec, start_ms, end_ms)
     dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
         else jnp.float32
-    grids = np.asarray(_rollup_tile(
-        jnp.asarray(padded.values2d, dtype=dtype),
-        jnp.asarray(bucket_idx2d, dtype=jnp.int32), len(bucket_ts)))
-    _write_grids(tsdb, base, rsid_map, bucket_ts, grids, written)
+    from opentsdb_tpu.ops.pipeline import detect_regular_padded
+    k = detect_regular_padded(np.asarray(padded.counts),
+                              np.asarray(bucket_idx2d), len(bucket_ts))
+    if k is not None:
+        g_dev = _rollup_tile_dense(
+            jnp.asarray(padded.values2d, dtype=dtype),
+            len(bucket_ts), k)
+    else:
+        cells = (padded.values2d.shape[0] * padded.values2d.shape[1]
+                 * len(bucket_ts))
+        if can_split and cells > _PADDED_TILE_MAX_CELLS:
+            return _split_window(tsdb, chunk, row_off, start_ms,
+                                 end_ms, base, nested)
+        g_dev = _rollup_tile(
+            jnp.asarray(padded.values2d, dtype=dtype),
+            jnp.asarray(bucket_idx2d, dtype=jnp.int32), len(bucket_ts))
+    outs = [(base, bucket_ts, g_dev, row_off)]
     for tier in nested:
         coarse_edges = ds_mod.fixed_bucket_edges(
             int(bucket_ts[0]), int(bucket_ts[-1]), tier.interval_ms)
         coarse_idx = ((bucket_ts - coarse_edges[0])
                       // tier.interval_ms).astype(np.int32)
-        cg = np.asarray(_coarsen(jnp.asarray(grids),
-                                 jnp.asarray(coarse_idx),
-                                 len(coarse_edges)))
-        _write_grids(tsdb, tier, rsid_map, coarse_edges, cg, written)
+        cg_dev = _coarsen(g_dev, jnp.asarray(coarse_idx),
+                          len(coarse_edges))
+        outs.append((tier, coarse_edges, cg_dev, row_off))
+    return outs
 
 
-def _window_buckets(nested_factors: list[int]) -> int:
+def _window_buckets(nested_factors: list[int],
+                    cap: int = _MAX_WINDOW_BUCKETS) -> int:
     """Buckets of the base tier per window: a multiple of every nested
     factor (so coarsening never straddles a window edge), capped.
-    Callers guarantee lcm(factors) <= _MAX_WINDOW_BUCKETS."""
+    Sweep callers guarantee lcm(factors) <= _MAX_WINDOW_BUCKETS; with
+    a smaller cap (the irregular split) the result may exceed it."""
     lcm = 1
     for f in nested_factors:
         lcm = math.lcm(lcm, f)
-    return lcm * max(1, _MAX_WINDOW_BUCKETS // lcm)
+    return lcm * max(1, cap // lcm)
 
 
 def run_rollup_job(tsdb, start_ms: int, end_ms: int,
@@ -226,14 +332,22 @@ def run_rollup_job(tsdb, start_ms: int, end_ms: int,
             # windows align to their own width (a multiple of every
             # nested tier's interval) so no coarse bucket straddles
             # two windows — a straddle would write the same coarse ts
-            # twice and lose one half to last-write-wins dedup
+            # twice and lose one half to last-write-wins dedup.
+            # One window's device work stays in flight while the
+            # previous window's results download and write.
+            pending = None
             t0 = start_ms - (start_ms % win_ms)
             while t0 <= end_ms:
-                _rollup_window(tsdb, chunk, rsid_map,
-                               max(t0, start_ms),
-                               min(t0 + win_ms - 1, end_ms), base,
-                               sub, written)
+                outs = _rollup_window(tsdb, chunk, 0,
+                                      max(t0, start_ms),
+                                      min(t0 + win_ms - 1, end_ms),
+                                      base, sub)
+                if pending:
+                    _write_outs(tsdb, rsid_map, pending, written)
+                pending = outs
                 t0 += win_ms
+            if pending:
+                _write_outs(tsdb, rsid_map, pending, written)
             done += len(chunk)
             if progress is not None:
                 progress(done, total_work)

@@ -18,11 +18,15 @@ from opentsdb_tpu.rollup.config import RollupConfig
 
 
 class RollupStore:
-    def __init__(self, config: RollupConfig):
+    def __init__(self, config: RollupConfig, store_factory=None):
         self.config = config
+        # tier stores come from the same backend factory as the raw
+        # store (native C++ by default) — the rollup job's bulk grid
+        # writes were 15x slower through the portable Python store
+        self._factory = store_factory or TimeSeriesStore
         # (interval, agg) -> store
         self._tiers: dict[tuple[str, str], TimeSeriesStore] = {}
-        self._preagg = TimeSeriesStore()
+        self._preagg = self._factory()
 
     def tier(self, interval: str, agg: str) -> TimeSeriesStore:
         agg = agg.lower()
@@ -34,7 +38,7 @@ class RollupStore:
         key = (interval, agg)
         store = self._tiers.get(key)
         if store is None:
-            store = self._tiers[key] = TimeSeriesStore()
+            store = self._tiers[key] = self._factory()
         return store
 
     def add_point(self, interval: str, agg: str, metric_id: int,

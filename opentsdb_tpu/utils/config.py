@@ -55,7 +55,7 @@ _DEFAULTS: dict[str, str] = {
     "tsd.storage.salt.width": "0",
     "tsd.storage.salt.buckets": "20",
     "tsd.storage.flush_interval": "1000",
-    "tsd.storage.backend": "memory",  # memory | native (C++ arena store)
+    "tsd.storage.backend": "native",  # native (C++ arena store) | memory
     "tsd.storage.data_dir": "",       # non-empty => durable snapshots
     # query
     "tsd.query.timeout": "0",

@@ -198,6 +198,15 @@ class TestAuth:
 # ---------------------------------------------------------------------------
 
 class TestFsck:
+    @pytest.fixture
+    def tsdb(self):
+        # white-box corruption injection needs the PORTABLE store's raw
+        # buffers (the native store resolves the same violations
+        # internally on read — covered in test_tools.py)
+        from opentsdb_tpu import TSDB, Config
+        return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                              "tsd.storage.backend": "memory"}))
+
     def test_clean_store(self, seeded_tsdb):
         report = run_fsck(seeded_tsdb)
         assert report.errors == 0

@@ -252,7 +252,5 @@ class TestBulkWrite:
             t.uids.metrics.get_id("m"),
             [(t.uids.tag_names.get_id("h"),
               t.uids.tag_values.get_id("a"))])
-        buf = t.store.series(sid).buffer
-        flags = (buf.flags_view() if hasattr(buf, "flags_view")
-                 else buf.is_int[:len(buf)])
+        flags = t.store.series(sid).buffer.view_full()[2]
         assert list(np.asarray(flags, dtype=bool)) == [True, False]
