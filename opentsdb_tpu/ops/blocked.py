@@ -90,9 +90,14 @@ def _pass2_step(grid, has_data, bucket_ts, group_ids, prev_carry,
     agg = aggs_mod.get(spec.agg_name)
     pv, pt, pp = prev_carry
     nv, nt, np_ = next_carry
-    filled = _fill_with_boundaries(grid, bucket_ts,
-                                   agg.interpolation.value,
-                                   pv, pt, pp, nv, nt, np_)
+    if spec.fill_policy == ds_mod.FillPolicy.NONE:
+        filled = _fill_with_boundaries(grid, bucket_ts,
+                                       agg.interpolation.value,
+                                       pv, pt, pp, nv, nt, np_)
+    else:
+        # NAN/NULL fills emit explicit NaN points: the merge skips
+        # them without interpolating (see pipeline._finish_pipeline)
+        filled = grid
     result = gb_mod._group_reduce(filled, group_ids, spec.num_groups,
                                   agg.name)
     if spec.fill_policy == ds_mod.FillPolicy.NONE:

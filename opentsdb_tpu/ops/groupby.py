@@ -200,9 +200,15 @@ def _group_rank(filled, valid, cnt, group_ids, num_groups, q: float,
 
 
 def group_aggregate(grid, bucket_ts, group_ids, num_groups: int,
-                    agg: aggs_mod.Aggregator):
+                    agg: aggs_mod.Aggregator, interpolate: bool = True):
     """The reference's SpanGroup.iterator + AggregationIterator pass:
     interpolation fill per the aggregator's mode, then one segmented
-    reduction over the series axis. grid[S,B] -> [G,B]."""
-    filled = fill_gaps(grid, bucket_ts, agg.interpolation.value)
+    reduction over the series axis. grid[S,B] -> [G,B].
+
+    ``interpolate=False`` for NAN/NULL downsample fill policies: the
+    reference's FillingDownsampler emits explicit NaN points there, so
+    the merge loop sees a point (and skips its NaN value) instead of a
+    gap — cross-series interpolation never triggers."""
+    filled = (fill_gaps(grid, bucket_ts, agg.interpolation.value)
+              if interpolate else grid)
     return _group_reduce(filled, group_ids, num_groups, agg.name)

@@ -157,9 +157,14 @@ def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
     if spec.emit_raw:
         return grid, has_data
 
-    # 4.+5. interpolate at merge + aggregate over series within groups
+    # 4.+5. interpolate at merge + aggregate over series within groups.
+    # NAN/NULL fill policies emit explicit NaN points, which the
+    # reference's merge loop skips WITHOUT interpolating (runDouble NaN
+    # guard); only fill NONE leaves true gaps that interpolate.
     agg = aggs_mod.get(spec.agg_name)
-    result = gb_mod.group_aggregate(grid, bucket_ts, group_ids, g, agg)
+    interpolate = spec.fill_policy == ds_mod.FillPolicy.NONE
+    result = gb_mod.group_aggregate(grid, bucket_ts, group_ids, g, agg,
+                                    interpolate=interpolate)
 
     # emission: fill NONE emits the union of the group's series' buckets
     # (plain Downsampler skips empty buckets); any other policy emits

@@ -1,0 +1,109 @@
+"""Differential conformance: the device pipeline vs the independent
+pure-Python oracle (tests/oracle.py) on randomized irregular data.
+
+Every other golden test compares one device path against another; the
+oracle shares NO code with the kernels, so this matrix can catch bugs
+in the shared XLA tail (fills, interpolation, rate, emission) itself.
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+from oracle import run_oracle
+
+BASE = 1356998400
+
+
+def _seed(tsdb, num_series=7, seed=0):
+    """Irregular per-series timestamps on a 10s lattice (lattice keeps
+    the oracle's bucket math exact), one group per host tag."""
+    rng = np.random.default_rng(seed)
+    series = []
+    for i in range(num_series):
+        n = int(rng.integers(5, 60))
+        offs = np.sort(rng.choice(600, size=n, replace=False))
+        ts_s = BASE + offs * 10
+        vals = np.round(rng.normal(50, 20, n), 3)
+        sid = tsdb.add_point("m", int(ts_s[0]), float(vals[0]),
+                             {"host": f"h{i % 3}", "id": str(i)})
+        if n > 1:
+            tsdb.store.append_many(sid, ts_s[1:] * 1000, vals[1:],
+                                   False)
+        series.append((i % 3, ts_s * 1000, vals))
+    return series
+
+
+def _query(tsdb, agg, downsample, rate=False):
+    obj = {"start": BASE * 1000, "end": (BASE + 6000) * 1000,
+           "queries": [{"metric": "m", "aggregator": agg,
+                        "downsample": downsample, "rate": rate,
+                        "filters": [{"type": "wildcard", "tagk": "host",
+                                     "filter": "*", "groupBy": True}]}]}
+    return tsdb.execute_query(TSQuery.from_json(obj).validate())
+
+
+def _check(tsdb, series, agg, ds_interval_ms, ds_fn, ds_spec,
+           rate=False, fill_policy="none", fill_value=float("nan")):
+    results = _query(tsdb, agg, ds_spec, rate=rate)
+    got = {}
+    for r in results:
+        host = r.tags.get("host")
+        gid = int(host[1:])
+        got[gid] = {int(t): float(v) for t, v in r.dps
+                    if not np.isnan(v)}
+    for gid in range(3):
+        members = [(ts, vals) for g, ts, vals in series if g == gid]
+        want = run_oracle(members, agg, ds_interval_ms, ds_fn,
+                          BASE * 1000, (BASE + 6000) * 1000, rate=rate,
+                          fill_policy=fill_policy,
+                          fill_value=fill_value)
+        want = {t: v for t, v in want.items() if not np.isnan(v)}
+        g = got.get(gid, {})
+        assert set(g) == set(want), (
+            f"group {gid} timestamps differ: only-engine="
+            f"{sorted(set(g) - set(want))[:5]} only-oracle="
+            f"{sorted(set(want) - set(g))[:5]}")
+        for t in want:
+            assert g[t] == pytest.approx(want[t], rel=1e-4, abs=1e-4), \
+                f"group {gid} @{t}: engine {g[t]} oracle {want[t]}"
+
+
+AGGS = ["sum", "avg", "min", "max", "count", "dev", "zimsum", "mimmin",
+        "mimmax", "pfsum", "squareSum", "multiply"]
+
+
+@pytest.mark.parametrize("agg", AGGS)
+def test_agg_matrix_downsampled(agg):
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    series = _seed(tsdb, seed=sum(map(ord, agg)))
+    _check(tsdb, series, agg, 60_000, "avg", "1m-avg")
+
+
+@pytest.mark.parametrize("ds_fn", ["sum", "avg", "min", "max", "count",
+                                   "first", "last"])
+def test_downsample_fn_matrix(ds_fn):
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    series = _seed(tsdb, seed=sum(map(ord, ds_fn)))
+    _check(tsdb, series, "sum", 120_000, ds_fn, f"2m-{ds_fn}")
+
+
+@pytest.mark.parametrize("agg", ["sum", "avg", "max"])
+def test_rate_matrix(agg):
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    series = _seed(tsdb, seed=42)
+    _check(tsdb, series, agg, 60_000, "sum", "1m-sum", rate=True)
+
+
+@pytest.mark.parametrize("fill,policy,value", [
+    ("1m-avg-zero", "zero", 0.0),
+    ("1m-avg-nan", "nan", float("nan")),
+    ("1m-avg-scalar#7.5", "scalar", 7.5),
+])
+def test_fill_policy_matrix(fill, policy, value):
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    series = _seed(tsdb, seed=7)
+    _check(tsdb, series, "sum", 60_000, "avg", fill,
+           fill_policy=policy, fill_value=value)
