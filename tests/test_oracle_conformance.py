@@ -107,3 +107,100 @@ def test_fill_policy_matrix(fill, policy, value):
     series = _seed(tsdb, seed=7)
     _check(tsdb, series, "sum", 60_000, "avg", fill,
            fill_policy=policy, fill_value=value)
+
+
+def _pts_of(ts_ms, vals):
+    return {int(t): float(v) for t, v in zip(ts_ms, vals)}
+
+
+@pytest.mark.parametrize("agg", ["sum", "avg", "max", "zimsum",
+                                 "mimmin", "pfsum"])
+def test_raw_union_merge_matrix(agg):
+    """No downsample: the classic AggregationIterator k-way merge at
+    the union of raw timestamps with per-aggregator interpolation."""
+    from oracle import aggregate_group
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    series = _seed(tsdb, seed=sum(map(ord, agg)) + 500)
+    obj = {"start": BASE * 1000, "end": (BASE + 6000) * 1000,
+           "queries": [{"metric": "m", "aggregator": agg,
+                        "filters": [{"type": "wildcard", "tagk": "host",
+                                     "filter": "*", "groupBy": True}]}]}
+    results = tsdb.execute_query(TSQuery.from_json(obj).validate())
+    got = {int(r.tags["host"][1:]): {int(t): float(v) for t, v in r.dps
+                                     if not np.isnan(v)}
+           for r in results}
+    for gid in range(3):
+        members = [_pts_of(ts, vals) for g, ts, vals in series
+                   if g == gid]
+        want = {t: v for t, v in aggregate_group(members, agg).items()
+                if not np.isnan(v)}
+        g = got.get(gid, {})
+        assert set(g) == set(want), (
+            f"group {gid}: only-engine={sorted(set(g)-set(want))[:4]} "
+            f"only-oracle={sorted(set(want)-set(g))[:4]}")
+        for t in want:
+            assert g[t] == pytest.approx(want[t], rel=1e-4, abs=1e-4), \
+                f"group {gid} @{t}: engine {g[t]} oracle {want[t]}"
+
+
+@pytest.mark.parametrize("drop", [False, True])
+def test_counter_rate_matrix(drop):
+    """Counter rollover correction + drop_resets against the oracle."""
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    rng = np.random.default_rng(3)
+    series = []
+    for i in range(4):
+        n = 40
+        offs = np.sort(rng.choice(300, size=n, replace=False))
+        ts_s = BASE + offs * 10
+        # counter that wraps at 1000 a few times
+        vals = np.cumsum(rng.integers(1, 60, n)).astype(float) % 1000
+        sid = tsdb.add_point("m", int(ts_s[0]), float(vals[0]),
+                             {"host": f"h{i % 2}", "id": str(i)})
+        tsdb.store.append_many(sid, ts_s[1:] * 1000, vals[1:], False)
+        series.append((i % 2, ts_s * 1000, vals))
+    obj = {"start": BASE * 1000, "end": (BASE + 3000) * 1000,
+           "queries": [{"metric": "m", "aggregator": "sum",
+                        "downsample": "1m-sum", "rate": True,
+                        "rateOptions": {"counter": True,
+                                        "counterMax": 1000,
+                                        "dropResets": drop},
+                        "filters": [{"type": "wildcard", "tagk": "host",
+                                     "filter": "*", "groupBy": True}]}]}
+    results = tsdb.execute_query(TSQuery.from_json(obj).validate())
+    got = {int(r.tags["host"][1:]): {int(t): float(v) for t, v in r.dps
+                                     if not np.isnan(v)}
+           for r in results}
+    for gid in range(2):
+        members = [(ts, vals) for g, ts, vals in series if g == gid]
+        want = run_oracle(
+            members, "sum", 60_000, "sum", BASE * 1000,
+            (BASE + 3000) * 1000, rate=True,
+            rate_kwargs={"counter": True, "counter_max": 1000.0,
+                         "drop_resets": drop})
+        want = {t: v for t, v in want.items() if not np.isnan(v)}
+        g = got.get(gid, {})
+        assert set(g) == set(want)
+        for t in want:
+            assert g[t] == pytest.approx(want[t], rel=1e-4, abs=1e-4), \
+                f"group {gid} @{t}: engine {g[t]} oracle {want[t]}"
+
+
+def test_run_all_matrix():
+    """0all downsample: one bucket spanning the whole query."""
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    series = _seed(tsdb, seed=99)
+    obj = {"start": BASE * 1000, "end": (BASE + 6000) * 1000,
+           "queries": [{"metric": "m", "aggregator": "sum",
+                        "downsample": "0all-sum",
+                        "filters": [{"type": "wildcard", "tagk": "host",
+                                     "filter": "*", "groupBy": True}]}]}
+    results = tsdb.execute_query(TSQuery.from_json(obj).validate())
+    got = {int(r.tags["host"][1:]): {int(t): float(v) for t, v in r.dps}
+           for r in results}
+    for gid in range(3):
+        members = [(ts, vals) for g, ts, vals in series if g == gid]
+        want = sum(float(np.nansum(v)) for _, v in members)
+        g = got.get(gid, {})
+        assert len(g) == 1
+        assert list(g.values())[0] == pytest.approx(want, rel=1e-4)
