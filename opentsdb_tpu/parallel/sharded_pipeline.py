@@ -35,7 +35,8 @@ from jax import shard_map
 from opentsdb_tpu.ops import downsample as ds_mod
 from opentsdb_tpu.ops.aggregators import Interpolation
 from opentsdb_tpu.ops import aggregators as aggs_mod
-from opentsdb_tpu.ops.interp import _next_valid_idx, _prev_valid_idx
+from opentsdb_tpu.ops.interp import (_gather_minor, _next_valid_idx,
+                                     _prev_valid_idx)
 from opentsdb_tpu.ops.pipeline import PipelineSpec
 
 # aggregators whose group reduction crosses the series axis with
@@ -94,10 +95,13 @@ def _block_boundaries(grid, bucket_ts):
     has_first = next_idx < nb
     lp = jnp.clip(prev_idx, 0, nb - 1)
     fp = jnp.clip(next_idx, 0, nb - 1)
-    rows = jnp.arange(grid.shape[0])
     ts = bucket_ts.astype(grid.dtype)
-    return ((grid[rows, lp], ts[lp], has_last),
-            (grid[rows, fp], ts[fp], has_first))
+    ts_row = jnp.broadcast_to(ts[None, :], grid.shape)
+    # fused select chains, not per-element TPU gathers (interp._gather_minor)
+    return ((_gather_minor(grid, lp[:, None])[:, 0],
+             _gather_minor(ts_row, lp[:, None])[:, 0], has_last),
+            (_gather_minor(grid, fp[:, None])[:, 0],
+             _gather_minor(ts_row, fp[:, None])[:, 0], has_first))
 
 
 def _fill_with_boundaries(grid, bucket_ts, mode: str,
@@ -109,11 +113,12 @@ def _fill_with_boundaries(grid, bucket_ts, mode: str,
         return jnp.where(mask, grid, 0.0)
     nb = grid.shape[-1]
     ts = bucket_ts.astype(grid.dtype)
+    ts_row = jnp.broadcast_to(ts[None, :], grid.shape)
     pidx = _prev_valid_idx(mask)
     has_lp = pidx >= 0
     sp = jnp.clip(pidx, 0, nb - 1)
-    v0_local = jnp.take_along_axis(grid, sp, axis=-1)
-    t0_local = ts[sp]
+    v0_local = _gather_minor(grid, sp)
+    t0_local = _gather_minor(ts_row, sp)
     v0 = jnp.where(has_lp, v0_local, prev_v[:, None])
     t0 = jnp.where(has_lp, t0_local, prev_t[:, None])
     has0 = has_lp | prev_p[:, None]
@@ -122,8 +127,8 @@ def _fill_with_boundaries(grid, bucket_ts, mode: str,
     nidx = _next_valid_idx(mask)
     has_ln = nidx < nb
     sn = jnp.clip(nidx, 0, nb - 1)
-    v1_local = jnp.take_along_axis(grid, sn, axis=-1)
-    t1_local = ts[sn]
+    v1_local = _gather_minor(grid, sn)
+    t1_local = _gather_minor(ts_row, sn)
     v1 = jnp.where(has_ln, v1_local, next_v[:, None])
     t1 = jnp.where(has_ln, t1_local, next_t[:, None])
     has1 = has_ln | next_p[:, None]
@@ -152,9 +157,11 @@ def _rate_with_boundary(grid, bucket_ts, counter: bool, counter_max,
     has_local = shifted >= 0
     sp = jnp.clip(shifted, 0, nb - 1)
     ts = bucket_ts.astype(grid.dtype)
-    v_prev = jnp.where(has_local, jnp.take_along_axis(grid, sp, axis=-1),
+    ts_row = jnp.broadcast_to(ts[None, :], grid.shape)
+    v_prev = jnp.where(has_local, _gather_minor(grid, sp),
                        carry_v[:, None])
-    t_prev = jnp.where(has_local, ts[sp], carry_t[:, None])
+    t_prev = jnp.where(has_local, _gather_minor(ts_row, sp),
+                       carry_t[:, None])
     has_prev = has_local | carry_p[:, None]
     dt_sec = (ts[None, :] - t_prev) / 1000.0
     dt_sec = jnp.where(dt_sec > 0, dt_sec, 1.0)
@@ -177,34 +184,41 @@ def _rate_with_boundary(grid, bucket_ts, counter: bool, counter_max,
 
 def _group_reduce_psum(filled, group_ids, num_groups: int, agg_name: str,
                        axis_name: str):
-    """Partial segment reduction per shard + collective combine."""
+    """Partial segment reduction per shard + collective combine.
+
+    Per-shard reductions use the single-chip primitives (one-hot MXU
+    contraction for sums, chunked broadcast for extrema — both measured
+    ~3-40x faster than TPU scatter, see ops.groupby); only the
+    psum/pmin/pmax combine is collective."""
+    from opentsdb_tpu.ops.groupby import _group_extremum, _group_sum
     valid = ~jnp.isnan(filled)
     x0 = jnp.where(valid, filled, 0.0)
-    seg = partial(jax.ops.segment_sum, num_segments=num_groups)
-    cnt = jax.lax.psum(seg(valid.astype(filled.dtype), group_ids),
-                       axis_name)
+
+    def seg(x):
+        return _group_sum(x, group_ids, num_groups)
+
+    cnt = jax.lax.psum(seg(valid.astype(filled.dtype)), axis_name)
     if agg_name in ("sum", "zimsum", "pfsum"):
-        out = jax.lax.psum(seg(x0, group_ids), axis_name)
+        out = jax.lax.psum(seg(x0), axis_name)
     elif agg_name == "avg":
-        out = jax.lax.psum(seg(x0, group_ids), axis_name) \
-            / jnp.maximum(cnt, 1)
+        out = jax.lax.psum(seg(x0), axis_name) / jnp.maximum(cnt, 1)
     elif agg_name == "count":
         out = cnt
     elif agg_name in ("min", "mimmin"):
-        part = jax.ops.segment_min(jnp.where(valid, filled, jnp.inf),
-                                   group_ids, num_segments=num_groups)
+        part = _group_extremum(jnp.where(valid, filled, jnp.inf),
+                               group_ids, num_groups, "min")
         out = jax.lax.pmin(part, axis_name)
         out = jnp.where(jnp.isinf(out) & (out > 0), jnp.nan, out)
     elif agg_name in ("max", "mimmax"):
-        part = jax.ops.segment_max(jnp.where(valid, filled, -jnp.inf),
-                                   group_ids, num_segments=num_groups)
+        part = _group_extremum(jnp.where(valid, filled, -jnp.inf),
+                               group_ids, num_groups, "max")
         out = jax.lax.pmax(part, axis_name)
         out = jnp.where(jnp.isinf(out) & (out < 0), jnp.nan, out)
     elif agg_name == "squareSum":
-        out = jax.lax.psum(seg(x0 * x0, group_ids), axis_name)
+        out = jax.lax.psum(seg(x0 * x0), axis_name)
     elif agg_name == "dev":
-        s1 = jax.lax.psum(seg(x0, group_ids), axis_name)
-        s2 = jax.lax.psum(seg(x0 * x0, group_ids), axis_name)
+        s1 = jax.lax.psum(seg(x0), axis_name)
+        s2 = jax.lax.psum(seg(x0 * x0), axis_name)
         mean = s1 / jnp.maximum(cnt, 1)
         var = jnp.maximum(s2 / jnp.maximum(cnt, 1) - mean * mean, 0.0) \
             * (jnp.maximum(cnt, 1) / jnp.maximum(cnt - 1, 1))
