@@ -16,6 +16,7 @@ rebuilds UID caches lazily after a restart.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import tempfile
@@ -36,6 +37,7 @@ def save_store(tsdb, data_dir: str) -> None:
         _save_timeseries(tsdb.rollup_store.preagg_store(),
                          os.path.join(data_dir, "rollup-preagg"))
     _save_annotations(tsdb.annotations, data_dir)
+    _save_histograms(tsdb, data_dir)
     meta = {"format": _FORMAT_VERSION,
             "points_written": tsdb.store.points_written}
     _atomic_write(os.path.join(data_dir, "META.json"),
@@ -69,7 +71,41 @@ def load_store(tsdb, data_dir: str) -> bool:
                 except ValueError:
                     pass  # tier no longer configured
     _load_annotations(tsdb.annotations, data_dir)
+    _load_histograms(tsdb, data_dir)
     return True
+
+
+def _save_histograms(tsdb, data_dir: str) -> None:
+    """Distribution-valued series: identity + re-encoded blobs
+    (ref: histogram cells beside scalar cells in the data table)."""
+    doc = []
+    for sid, pts in tsdb._histogram_series.items():
+        rec = tsdb.histogram_store.series(sid)
+        doc.append({
+            "metric": rec.metric_id,
+            "tags": [list(p) for p in rec.tags],
+            "points": [
+                [ts, base64.b64encode(
+                    tsdb.histogram_manager.encode(h)).decode()]
+                for ts, h in pts],
+        })
+    _atomic_write(os.path.join(data_dir, "histograms.json"),
+                  json.dumps(doc).encode())
+
+
+def _load_histograms(tsdb, data_dir: str) -> None:
+    path = os.path.join(data_dir, "histograms.json")
+    if not os.path.isfile(path):
+        return
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    for entry in doc:
+        sid = tsdb.histogram_store.get_or_create_series(
+            entry["metric"], [tuple(p) for p in entry["tags"]])
+        lst = tsdb._histogram_series.setdefault(sid, [])
+        for ts, blob in entry["points"]:
+            lst.append((int(ts), tsdb.histogram_manager.decode(
+                base64.b64decode(blob))))
 
 
 # ---------------------------------------------------------------------------

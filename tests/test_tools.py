@@ -55,6 +55,27 @@ class TestPersistence:
         assert t2.annotations.global_range(BASE, BASE)[0].description \
             == "note"
 
+    def test_snapshot_histograms(self, data_dir):
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        t1 = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                            "tsd.storage.data_dir": data_dir}))
+        h = SimpleHistogram()
+        h.set_bucket(0.0, 10.0, 5)
+        h.set_bucket(10.0, 20.0, 15)
+        blob = t1.histogram_manager.encode(h)
+        t1.add_histogram_point("lat", BASE, blob, {"host": "a"})
+        t1.flush()
+
+        t2 = TSDB(Config(**{"tsd.storage.data_dir": data_dir}))
+        assert len(t2._histogram_series) == 1
+        (sid, pts), = t2._histogram_series.items()
+        ts, h2 = pts[0]
+        assert ts == BASE * 1000
+        assert h2.percentile(99.0) == h.percentile(99.0)
+        rec = t2.histogram_store.series(sid)
+        assert t2.uids.metrics.get_name(rec.metric_id) == "lat"
+
     def test_load_missing_dir_is_noop(self, data_dir):
         from opentsdb_tpu import TSDB, Config
         t = TSDB(Config(**{"tsd.storage.data_dir": data_dir}))
