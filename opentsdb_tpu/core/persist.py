@@ -38,6 +38,7 @@ def save_store(tsdb, data_dir: str) -> None:
                          os.path.join(data_dir, "rollup-preagg"))
     _save_annotations(tsdb.annotations, data_dir)
     _save_histograms(tsdb, data_dir)
+    _save_meta(tsdb, data_dir)
     meta = {"format": _FORMAT_VERSION,
             "points_written": tsdb.store.points_written}
     _atomic_write(os.path.join(data_dir, "META.json"),
@@ -72,7 +73,49 @@ def load_store(tsdb, data_dir: str) -> bool:
                     pass  # tier no longer configured
     _load_annotations(tsdb.annotations, data_dir)
     _load_histograms(tsdb, data_dir)
+    _load_meta(tsdb, data_dir)
     return True
+
+
+def _save_meta(tsdb, data_dir: str) -> None:
+    """TSMeta/UIDMeta documents + counters (ref: tsdb-meta/tsdb-uid
+    meta rows — user edits like displayName must survive restarts)."""
+    import dataclasses
+    m = tsdb.meta
+    if m is None:
+        return
+    with m._lock:
+        doc = {
+            "ts_counters": dict(m.ts_counters),
+            "uid_meta": [dataclasses.asdict(v) | {"_key": list(k)}
+                         for k, v in m.uid_meta.items()],
+            "ts_meta": [dataclasses.asdict(v)
+                        for v in m.ts_meta.values()],
+        }
+    _atomic_write(os.path.join(data_dir, "meta.json"),
+                  json.dumps(doc).encode())
+
+
+def _load_meta(tsdb, data_dir: str) -> None:
+    path = os.path.join(data_dir, "meta.json")
+    m = tsdb.meta
+    if m is None or not os.path.isfile(path):
+        return
+    from opentsdb_tpu.meta.meta_store import TSMeta, UIDMeta
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    with m._lock:
+        for e in doc.get("uid_meta", []):
+            key = tuple(e.pop("_key"))
+            m.uid_meta[key] = UIDMeta(**e)
+        for e in doc.get("ts_meta", []):
+            metric = e.pop("metric", None)
+            tags = e.pop("tags", None) or []
+            t = TSMeta(**e)
+            t.metric = UIDMeta(**metric) if metric else None
+            t.tags = [UIDMeta(**x) for x in tags]
+            m.ts_meta[t.tsuid] = t
+        m.ts_counters.update(doc.get("ts_counters", {}))
 
 
 def _save_histograms(tsdb, data_dir: str) -> None:

@@ -76,6 +76,23 @@ class TestPersistence:
         rec = t2.histogram_store.series(sid)
         assert t2.uids.metrics.get_name(rec.metric_id) == "lat"
 
+    def test_snapshot_meta(self, data_dir):
+        from opentsdb_tpu import TSDB, Config
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.core.meta.enable_realtime_ts": "true",
+               "tsd.storage.data_dir": data_dir}
+        t1 = TSDB(Config(**cfg))
+        t1.add_point("m", BASE, 1, {"host": "a"})
+        t1.add_point("m", BASE + 10, 2, {"host": "a"})
+        (tsuid, meta), = t1.meta.ts_meta.items()
+        meta.display_name = "edited by a human"
+        t1.flush()
+
+        t2 = TSDB(Config(**cfg))
+        assert t2.meta.ts_meta[tsuid].display_name == \
+            "edited by a human"
+        assert t2.meta.ts_counters[tsuid] == 2
+
     def test_load_missing_dir_is_noop(self, data_dir):
         from opentsdb_tpu import TSDB, Config
         t = TSDB(Config(**{"tsd.storage.data_dir": data_dir}))
