@@ -39,6 +39,7 @@ def save_store(tsdb, data_dir: str) -> None:
     _save_annotations(tsdb.annotations, data_dir)
     _save_histograms(tsdb, data_dir)
     _save_meta(tsdb, data_dir)
+    _save_trees(tsdb, data_dir)
     meta = {"format": _FORMAT_VERSION,
             "points_written": tsdb.store.points_written}
     _atomic_write(os.path.join(data_dir, "META.json"),
@@ -74,7 +75,40 @@ def load_store(tsdb, data_dir: str) -> bool:
     _load_annotations(tsdb.annotations, data_dir)
     _load_histograms(tsdb, data_dir)
     _load_meta(tsdb, data_dir)
+    _load_trees(tsdb, data_dir)
     return True
+
+
+def _save_trees(tsdb, data_dir: str) -> None:
+    """Tree DEFINITIONS (name + rules; ref: tsdb-tree table rows).
+    Branches are materialized views — rebuilt by realtime processing or
+    `tsdb treesync`, like the reference's TreeSync."""
+    mgr = getattr(tsdb, "_tree_manager", None)
+    if mgr is None:
+        return
+    _atomic_write(os.path.join(data_dir, "trees.json"),
+                  json.dumps([t.to_json()
+                              for t in mgr.all_trees()]).encode())
+
+
+def _load_trees(tsdb, data_dir: str) -> None:
+    path = os.path.join(data_dir, "trees.json")
+    if not os.path.isfile(path):
+        return
+    from opentsdb_tpu.tree.tree import tree_manager
+    mgr = tree_manager(tsdb)
+    from opentsdb_tpu.tree.tree import Tree, TreeRule
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    with mgr._lock:
+        for obj in doc:
+            tree = Tree(int(obj["treeId"]))
+            tree.update(obj, overwrite=True)
+            tree.created = int(obj.get("created", 0))
+            for robj in obj.get("rules", []):
+                tree.set_rule(TreeRule.from_json(robj))
+            mgr.trees[tree.tree_id] = tree
+            mgr._next_id = max(mgr._next_id, tree.tree_id)
 
 
 def _save_meta(tsdb, data_dir: str) -> None:

@@ -330,3 +330,30 @@ class TestSearchAndVersionCli:
     def test_usage(self, capsys):
         code, _, err = run_cli([], capsys)
         assert code == 2 and "Valid commands" in err
+
+
+class TestTreePersistence:
+    def test_snapshot_trees(self, data_dir):
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tree.tree import TreeRule, tree_manager
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.storage.data_dir": data_dir}
+        t1 = TSDB(Config(**cfg))
+        mgr = tree_manager(t1)
+        tree = mgr.create_tree("prod", "production namespace")
+        tree.set_rule(TreeRule.from_json(
+            {"type": "METRIC", "level": 0, "order": 0}))
+        tree.set_rule(TreeRule.from_json(
+            {"type": "TAGK", "field": "host", "level": 1, "order": 0}))
+        t1.add_point("m", BASE, 1, {"host": "a"})
+        t1.flush()
+
+        t2 = TSDB(Config(**cfg))
+        mgr2 = tree_manager(t2)
+        restored = mgr2.get_tree(tree.tree_id)
+        assert restored is not None
+        assert restored.name == "prod"
+        assert len(restored.rules) == 2
+        assert restored.rules[1][0].field == "host"
+        # ids keep advancing past restored trees
+        assert mgr2.create_tree("x").tree_id == tree.tree_id + 1
