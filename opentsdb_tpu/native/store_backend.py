@@ -81,8 +81,9 @@ def load_library():
                                           ctypes.c_int64]
         lib.tss_series_length.restype = ctypes.c_int64
         lib.tss_read_series.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p]
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.tss_read_series.restype = ctypes.c_int64
         lib.tss_count_range.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
@@ -118,8 +119,14 @@ class _NativeSeriesView:
         vals = np.empty(n, dtype=np.float64)
         ints = np.empty(n, dtype=np.uint8)
         if n:
-            lib.tss_read_series(self._store._h, self._sid, _ptr(ts),
-                                _ptr(vals), _ptr(ints))
+            # the copy is capped at n and returns the actual count:
+            # concurrent appends/deletes between the length call and
+            # the read can change the buffer (trim to what was copied)
+            got = lib.tss_read_series(self._store._h, self._sid, n,
+                                      _ptr(ts), _ptr(vals), _ptr(ints))
+            if got < n:
+                got = max(got, 0)
+                ts, vals, ints = ts[:got], vals[:got], ints[:got]
         return ts, vals, ints.astype(bool)
 
     def slice_range(self, start_ms: int, end_ms: int):

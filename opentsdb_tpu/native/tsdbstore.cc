@@ -19,6 +19,7 @@
 #include <cstring>
 #include <limits>
 #include <mutex>
+#include <shared_mutex>
 #include <thread>
 #include <vector>
 
@@ -93,9 +94,34 @@ struct SeriesBuffer {
 };
 
 struct Store {
+  // The directory vector REALLOCATES on growth, so every indexing
+  // access holds the shared lock; the SeriesBuffer objects themselves
+  // are heap-stable for the store's lifetime, so captured pointers
+  // stay valid after the lock drops (each buffer has its own mutex).
   std::vector<SeriesBuffer*> series;
-  std::mutex create_mu;
+  std::shared_mutex dir_mu;
   std::atomic<int64_t> points_written{0};
+
+  // nullptr on a bad sid.
+  SeriesBuffer* lookup(int64_t sid) {
+    std::shared_lock<std::shared_mutex> lock(dir_mu);
+    if (sid < 0 || sid >= (int64_t)series.size()) return nullptr;
+    return series[sid];
+  }
+
+  // Validate + capture all pointers under ONE shared lock (the
+  // threaded bulk paths). Returns false on any bad sid.
+  bool snapshot(const int64_t* sids, int64_t n,
+                std::vector<SeriesBuffer*>* out) {
+    std::shared_lock<std::shared_mutex> lock(dir_mu);
+    out->resize(n);
+    for (int64_t i = 0; i < n; ++i) {
+      if (sids[i] < 0 || sids[i] >= (int64_t)series.size())
+        return false;
+      (*out)[i] = series[sids[i]];
+    }
+    return true;
+  }
 
   ~Store() {
     for (auto* s : series) delete s;
@@ -114,22 +140,23 @@ void tss_destroy(void* h) { delete static_cast<Store*>(h); }
 // managed by the Python wrapper; this just allocates the buffer.
 int64_t tss_add_series(void* h) {
   Store* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> lock(s->create_mu);
+  std::unique_lock<std::shared_mutex> lock(s->dir_mu);
   s->series.push_back(new SeriesBuffer());
   return (int64_t)s->series.size() - 1;
 }
 
 int64_t tss_series_count(void* h) {
   Store* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> lock(s->create_mu);
+  std::shared_lock<std::shared_mutex> lock(s->dir_mu);
   return (int64_t)s->series.size();
 }
 
 int tss_append(void* h, int64_t sid, int64_t ts_ms, double value,
                int is_int) {
   Store* s = static_cast<Store*>(h);
-  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
-  s->series[sid]->append(ts_ms, value, (uint8_t)is_int);
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
+  buf->append(ts_ms, value, (uint8_t)is_int);
   s->points_written.fetch_add(1, std::memory_order_relaxed);
   return 0;
 }
@@ -137,8 +164,9 @@ int tss_append(void* h, int64_t sid, int64_t ts_ms, double value,
 int tss_append_many(void* h, int64_t sid, int64_t n, const int64_t* ts,
                     const double* vals, const uint8_t* is_int) {
   Store* s = static_cast<Store*>(h);
-  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
-  s->series[sid]->append_many(n, ts, vals, is_int);
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
+  buf->append_many(n, ts, vals, is_int);
   s->points_written.fetch_add(n, std::memory_order_relaxed);
   return 0;
 }
@@ -157,8 +185,8 @@ int64_t tss_append_grid(void* h, const int64_t* sids, int64_t nsids,
                         const double* grid, const uint8_t* mask,
                         int threads) {
   Store* s = static_cast<Store*>(h);
-  for (int64_t i = 0; i < nsids; ++i)
-    if (sids[i] < 0 || sids[i] >= (int64_t)s->series.size()) return -1;
+  std::vector<SeriesBuffer*> bufs;
+  if (!s->snapshot(sids, nsids, &bufs)) return -1;
   if (threads < 1) threads = 1;
   std::atomic<int64_t> next{0};
   std::atomic<int64_t> total{0};
@@ -167,7 +195,7 @@ int64_t tss_append_grid(void* h, const int64_t* sids, int64_t nsids,
     for (;;) {
       int64_t i = next.fetch_add(1);
       if (i >= nsids) break;
-      SeriesBuffer* buf = s->series[sids[i]];
+      SeriesBuffer* buf = bufs[i];
       const double* row = grid + i * nbuckets;
       const uint8_t* m = mask + i * nbuckets;
       std::lock_guard<std::mutex> lock(buf->mu);
@@ -194,8 +222,8 @@ int64_t tss_append_grid(void* h, const int64_t* sids, int64_t nsids,
 
 int64_t tss_series_length(void* h, int64_t sid) {
   Store* s = static_cast<Store*>(h);
-  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
-  SeriesBuffer* buf = s->series[sid];
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
   std::lock_guard<std::mutex> lock(buf->mu);
   buf->ensure_sorted_locked();
   return (int64_t)buf->ts.size();
@@ -207,8 +235,8 @@ int64_t tss_series_length(void* h, int64_t sid) {
 int64_t tss_delete_range(void* h, int64_t sid, int64_t start_ms,
                          int64_t end_ms) {
   Store* s = static_cast<Store*>(h);
-  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
-  SeriesBuffer* buf = s->series[sid];
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
   std::lock_guard<std::mutex> lock(buf->mu);
   buf->ensure_sorted_locked();
   auto lo = std::lower_bound(buf->ts.begin(), buf->ts.end(), start_ms);
@@ -224,22 +252,28 @@ int64_t tss_delete_range(void* h, int64_t sid, int64_t start_ms,
   return n;
 }
 
-// Copy one series' sorted columns into caller-provided arrays sized by
-// a prior tss_series_length call.
-int tss_read_series(void* h, int64_t sid, int64_t* ts_out,
-                    double* vals_out, uint8_t* int_out) {
+// Copy one series' sorted columns into caller-provided arrays of
+// capacity `cap` (from a prior tss_series_length call). Returns the
+// number of elements actually copied — concurrent appends between the
+// two calls can grow the buffer past cap (copy truncates) and
+// concurrent deletes/dedupes can shrink it (caller trims to the
+// return value); never writes past cap. -1 on a bad sid.
+int64_t tss_read_series(void* h, int64_t sid, int64_t cap,
+                        int64_t* ts_out, double* vals_out,
+                        uint8_t* int_out) {
   Store* s = static_cast<Store*>(h);
-  if (sid < 0 || sid >= (int64_t)s->series.size()) return -1;
-  SeriesBuffer* buf = s->series[sid];
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
   std::lock_guard<std::mutex> lock(buf->mu);
   buf->ensure_sorted_locked();
-  const size_t n = buf->ts.size();
-  if (n) {
+  int64_t n = (int64_t)buf->ts.size();
+  if (n > cap) n = cap;
+  if (n > 0) {
     std::memcpy(ts_out, buf->ts.data(), n * sizeof(int64_t));
     std::memcpy(vals_out, buf->vals.data(), n * sizeof(double));
     if (int_out) std::memcpy(int_out, buf->is_int.data(), n);
   }
-  return 0;
+  return n;
 }
 
 // Phase 1 of materialize: per-series point counts within
@@ -249,21 +283,16 @@ int tss_count_range(void* h, const int64_t* sids, int64_t nsids,
                     int64_t start_ms, int64_t end_ms,
                     int64_t* counts_out, int threads) {
   Store* s = static_cast<Store*>(h);
+  std::vector<SeriesBuffer*> bufs;
+  if (!s->snapshot(sids, nsids, &bufs)) return -1;
   if (threads < 1) threads = 1;
   std::atomic<int64_t> next{0};
-  std::atomic<int> err{0};
   auto worker = [&]() {
     for (;;) {
       int64_t i = next.fetch_add(1);
       if (i >= nsids) break;
-      int64_t sid = sids[i];
-      if (sid < 0 || sid >= (int64_t)s->series.size()) {
-        err.store(1);
-        counts_out[i] = 0;
-        continue;
-      }
       int64_t lo, hi;
-      s->series[sid]->range_bounds(start_ms, end_ms, &lo, &hi);
+      bufs[i]->range_bounds(start_ms, end_ms, &lo, &hi);
       counts_out[i] = hi - lo;
     }
   };
@@ -271,7 +300,7 @@ int tss_count_range(void* h, const int64_t* sids, int64_t nsids,
   for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
   worker();
   for (auto& th : pool) th.join();
-  return err.load() ? -1 : 0;
+  return 0;
 }
 
 // Phase 2: fill flat output arrays. offsets[i] must hold the exclusive
@@ -286,15 +315,15 @@ int tss_fill_range(void* h, const int64_t* sids, int64_t nsids,
                    int64_t* ts_out, double* vals_out,
                    int32_t* series_idx_out, int threads) {
   Store* s = static_cast<Store*>(h);
+  std::vector<SeriesBuffer*> bufs;
+  if (!s->snapshot(sids, nsids, &bufs)) return -1;
   if (threads < 1) threads = 1;
   std::atomic<int64_t> next{0};
   auto worker = [&]() {
     for (;;) {
       int64_t i = next.fetch_add(1);
       if (i >= nsids) break;
-      int64_t sid = sids[i];
-      if (sid < 0 || sid >= (int64_t)s->series.size()) continue;
-      SeriesBuffer* buf = s->series[sid];
+      SeriesBuffer* buf = bufs[i];
       std::lock_guard<std::mutex> lock(buf->mu);
       buf->ensure_sorted_locked();
       int64_t lo =

@@ -122,3 +122,62 @@ class TestNativeEndToEnd:
         # native buffers are opaque to the buffer-internals checks, but
         # UID resolution and the walk itself must work
         assert report.series_checked == 1
+
+
+class TestConcurrency:
+    """SURVEY.md §5.2: the reference has no sanitizers; host-side
+    ingest/query concurrency needs explicit tests. The directory
+    vector reallocates on growth, so concurrent create + read/write
+    must be exercised."""
+
+    def test_concurrent_create_write_read(self):
+        import threading
+        store = store_backend.NativeTimeSeriesStore(num_shards=8)
+        stop = threading.Event()
+        errors = []
+
+        def creator():
+            try:
+                for i in range(2000):
+                    store.get_or_create_series(1, [(1, i)])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                stop.set()
+
+        def writer():
+            rng = np.random.default_rng(1)
+            try:
+                while not stop.is_set():
+                    n = store.num_series()
+                    if n == 0:
+                        continue
+                    sid = int(rng.integers(0, n))
+                    store.append_many(
+                        sid, np.arange(50, dtype=np.int64) * 1000,
+                        rng.normal(size=50), False)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    n = store.num_series()
+                    if n == 0:
+                        continue
+                    sids = np.arange(n, dtype=np.int64)
+                    store.count_range(sids, 0, 10**15)
+                    store.materialize(sids[: max(1, n // 2)], 0, 10**15)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = ([threading.Thread(target=creator)]
+                   + [threading.Thread(target=writer) for _ in range(2)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "thread hung (deadlock?)"
+        assert not errors, errors
+        assert store.num_series() == 2000
