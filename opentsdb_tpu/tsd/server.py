@@ -82,6 +82,9 @@ class TSDServer:
             d.strip() for d in tsdb.config.get_string(
                 "tsd.http.request.cors_domains", "").split(",")
             if d.strip()]
+        # ms; 0 = no limit (ref: tsd.query.timeout expiring queries)
+        self.query_timeout_ms = tsdb.config.get_int("tsd.query.timeout",
+                                                    0)
 
     # ------------------------------------------------------------------
 
@@ -248,8 +251,23 @@ class TSDServer:
                 if self.tsdb.authentication is not None:
                     request.auth = auth_state
                 t0 = time.monotonic()
-                response = await asyncio.get_event_loop().run_in_executor(
+                fut = asyncio.get_event_loop().run_in_executor(
                     None, self.http_router.handle, request)
+                if self.query_timeout_ms > 0:
+                    try:
+                        response = await asyncio.wait_for(
+                            fut, self.query_timeout_ms / 1000.0)
+                    except asyncio.TimeoutError:
+                        # the worker thread finishes in the background;
+                        # the client gets the reference's expiry error
+                        response = HttpResponse(
+                            504,
+                            ('{"error":{"code":504,"message":'
+                             '"Query timeout exceeded ('
+                             f'{self.query_timeout_ms}ms)"}}}}')
+                            .encode())
+                else:
+                    response = await fut
                 self.tsdb.stats.latency_query.add(
                     (time.monotonic() - t0) * 1000)
             self._apply_cors(request, response)

@@ -224,3 +224,52 @@ class TestGexpAndExp:
         assert resp.status == 200
         # each leaf aggregates both hosts (i + 300-i = 300); summed = 600
         assert out[0]["dps"][str(BASE)] == 600
+
+
+def test_query_timeout_expires():
+    """tsd.query.timeout expires slow requests with a structured 504
+    (ref: query expiry), while fast requests still succeed."""
+    import json as _json
+    import time as _t
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          "tsd.query.timeout": "200",
+                          "tsd.tpu.platform": "cpu"}))
+
+    async def scenario():
+        server = TSDServer(tsdb, host="127.0.0.1", port=0)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+        try:
+            orig = server.http_router.handle
+
+            def slow_handle(request):
+                if "slow" in request.path:
+                    _t.sleep(1.0)
+                return orig(request)
+
+            server.http_router.handle = slow_handle
+
+            async def fetch(path):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(), 10)
+                writer.close()
+                head, _, body = data.partition(b"\r\n\r\n")
+                status = int(head.split(b" ")[1])
+                return status, body
+
+            status, _ = await fetch("/api/version")
+            assert status == 200
+            status, body = await fetch("/api/slow")
+            assert status == 504
+            assert _json.loads(body)["error"]["code"] == 504
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
