@@ -26,6 +26,7 @@ INTERP = {
     "zimsum": "zim", "count": "zim", "squareSum": "zim",
     "mimmin": "max", "mimmax": "min",
     "pfsum": "prev",
+    "diff": "lerp", "first": "zim", "last": "zim",
 }
 
 

@@ -114,7 +114,8 @@ def _pts_of(ts_ms, vals):
 
 
 @pytest.mark.parametrize("agg", ["sum", "avg", "max", "zimsum",
-                                 "mimmin", "pfsum"])
+                                 "mimmin", "pfsum", "first", "last",
+                                 "diff"])
 def test_raw_union_merge_matrix(agg):
     """No downsample: the classic AggregationIterator k-way merge at
     the union of raw timestamps with per-aggregator interpolation."""
