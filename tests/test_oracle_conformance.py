@@ -205,3 +205,85 @@ def test_run_all_matrix():
         g = got.get(gid, {})
         assert len(g) == 1
         assert list(g.values())[0] == pytest.approx(want, rel=1e-4)
+
+
+def test_two_key_groupby():
+    """Group key = concatenated tagv ids across TWO group-by tags
+    (ref: TsdbQuery.java:995-1036)."""
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    rng = np.random.default_rng(17)
+    series = {}
+    for i in range(8):
+        host, dc = f"h{i % 2}", f"d{(i // 2) % 2}"
+        n = int(rng.integers(10, 40))
+        offs = np.sort(rng.choice(600, size=n, replace=False))
+        ts_s = BASE + offs * 10
+        vals = np.round(rng.normal(50, 20, n), 3)
+        tsdb.add_point("m", int(ts_s[0]), float(vals[0]),
+                       {"host": host, "dc": dc, "id": str(i)})
+        sid = tsdb.store.get_or_create_series(
+            tsdb.uids.metrics.get_id("m"),
+            [(tsdb.uids.tag_names.get_id(k),
+              tsdb.uids.tag_values.get_id(v))
+             for k, v in {"host": host, "dc": dc,
+                          "id": str(i)}.items()])
+        if n > 1:
+            tsdb.store.append_many(sid, ts_s[1:] * 1000, vals[1:],
+                                   False)
+        series.setdefault((host, dc), []).append((ts_s * 1000, vals))
+    obj = {"start": BASE * 1000, "end": (BASE + 6000) * 1000,
+           "queries": [{"metric": "m", "aggregator": "sum",
+                        "downsample": "1m-avg",
+                        "filters": [
+                            {"type": "wildcard", "tagk": "host",
+                             "filter": "*", "groupBy": True},
+                            {"type": "wildcard", "tagk": "dc",
+                             "filter": "*", "groupBy": True}]}]}
+    results = tsdb.execute_query(TSQuery.from_json(obj).validate())
+    assert len(results) == 4
+    for r in results:
+        key = (r.tags["host"], r.tags["dc"])
+        want = run_oracle(series[key], "sum", 60_000, "avg",
+                          BASE * 1000, (BASE + 6000) * 1000)
+        got = {int(t): float(v) for t, v in r.dps if not np.isnan(v)}
+        want = {t: v for t, v in want.items() if not np.isnan(v)}
+        assert set(got) == set(want), key
+        for t in want:
+            assert got[t] == pytest.approx(want[t], rel=1e-4), (key, t)
+
+
+def test_filter_restricts_group_members():
+    """Non-group-by literal filter ANDs with the group-by wildcard
+    (ref: SaltScanner post-scan filter chain)."""
+    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    kept, dropped = [], []
+    for i in range(6):
+        dc = "lga" if i % 2 == 0 else "sjc"
+        ts = (BASE + np.arange(20) * 30) * 1000
+        vals = np.full(20, float(i + 1))
+        tsdb.add_point("m", BASE, float(i + 1),
+                       {"host": "a", "dc": dc, "id": str(i)})
+        sid = tsdb.store.get_or_create_series(
+            tsdb.uids.metrics.get_id("m"),
+            [(tsdb.uids.tag_names.get_id(k),
+              tsdb.uids.tag_values.get_id(v))
+             for k, v in {"host": "a", "dc": dc,
+                          "id": str(i)}.items()])
+        tsdb.store.append_many(sid, ts[1:], vals[1:], False)
+        (kept if dc == "lga" else dropped).append((ts, vals))
+    obj = {"start": BASE * 1000, "end": (BASE + 600) * 1000,
+           "queries": [{"metric": "m", "aggregator": "sum",
+                        "downsample": "1m-sum",
+                        "filters": [
+                            {"type": "wildcard", "tagk": "host",
+                             "filter": "*", "groupBy": True},
+                            {"type": "literal_or", "tagk": "dc",
+                             "filter": "lga", "groupBy": False}]}]}
+    results = tsdb.execute_query(TSQuery.from_json(obj).validate())
+    assert len(results) == 1
+    want = run_oracle(kept, "sum", 60_000, "sum", BASE * 1000,
+                      (BASE + 600) * 1000)
+    got = {int(t): float(v) for t, v in results[0].dps}
+    assert set(got) == set(want)
+    for t in want:
+        assert got[t] == pytest.approx(want[t], rel=1e-6)
