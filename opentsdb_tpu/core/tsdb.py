@@ -96,6 +96,15 @@ class TSDB:
         self.meta = MetaStore(self)
         from opentsdb_tpu.query.limits import QueryLimitOverride
         self.query_limits = QueryLimitOverride(self.config)
+        # multi-chip query execution (SURVEY §2.11: the reference's
+        # 20-way salt-bucket scan fan-out, SaltScanner.java:70, mapped
+        # onto a ('series','time') device mesh). Lazy: building the
+        # mesh touches jax.devices().
+        self._query_mesh_spec = self.config.get_string(
+            "tsd.query.mesh", "")
+        from opentsdb_tpu.parallel.mesh import parse_mesh_spec
+        parse_mesh_spec(self._query_mesh_spec)  # fail fast on typos
+        self._query_mesh = None
         from opentsdb_tpu.stats.stats import StatsCollectorRegistry
         self.stats = StatsCollectorRegistry()
         self.datapoints_added = 0
@@ -375,6 +384,19 @@ class TSDB:
     # ------------------------------------------------------------------
     # read path entry (ref: TSDB.java newQuery :963)
     # ------------------------------------------------------------------
+
+    @property
+    def query_mesh(self):
+        """The ('series','time') device mesh ``/api/query`` executes
+        over, or None for single-device execution. Configured with
+        ``tsd.query.mesh`` (ref: SaltScanner.java:70 — the fixed 20-way
+        scan fan-out this replaces with a device-mesh shard_map)."""
+        if self._query_mesh is None and self._query_mesh_spec:
+            from opentsdb_tpu.parallel.mesh import mesh_from_spec
+            self._query_mesh = mesh_from_spec(self._query_mesh_spec)
+            if self._query_mesh is None:  # single device: stop retrying
+                self._query_mesh_spec = ""
+        return self._query_mesh
 
     def new_query(self):
         from opentsdb_tpu.query.engine import QueryEngine

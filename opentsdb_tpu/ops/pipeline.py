@@ -27,6 +27,9 @@ from opentsdb_tpu.ops import groupby as gb_mod
 from opentsdb_tpu.ops.rate import RateOptions, _rate_kernel
 
 
+_SHARED_NAN = float("nan")
+
+
 @dataclass(frozen=True)
 class PipelineSpec:
     """Static (trace-time) configuration of one sub-query's compute."""
@@ -36,11 +39,20 @@ class PipelineSpec:
     ds_function: str          # downsample function ('sum', 'avg', ...)
     agg_name: str             # group aggregator name ('sum', 'p99', ...)
     fill_policy: ds_mod.FillPolicy = ds_mod.FillPolicy.NONE
-    fill_value: float = float("nan")
+    fill_value: float = _SHARED_NAN
     rate: bool = False
     rate_counter: bool = False
     rate_drop_resets: bool = False
     emit_raw: bool = False    # agg 'none': emit per-series, skip group stage
+
+    def __post_init__(self):
+        # CPython >= 3.10 hashes each NaN object by identity, so a spec
+        # built with a fresh float("nan") never compares/hashes equal to
+        # the previous query's spec and the jit cache (static arg) would
+        # recompile on EVERY query. Canonicalize to one shared NaN.
+        if isinstance(self.fill_value, float) and \
+                self.fill_value != self.fill_value:
+            object.__setattr__(self, "fill_value", _SHARED_NAN)
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -177,6 +189,19 @@ def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
     return result, emit
 
 
+def avg_divide_grid(grid_sum, grid_cnt, xp=jnp):
+    """The rollup-average derivation shared by the single-device trace
+    (:func:`run_pipeline_avg_div`) and the mesh path's host-side
+    divide (engine._avg_rollup_pipeline): SUM-tier cells / COUNT-tier
+    cells where both tiers have data (ref: RollupSpan agg-prefixed
+    sum+count qualifiers). Returns (grid, valid_mask)."""
+    valid = (~xp.isnan(grid_sum)) & (~xp.isnan(grid_cnt)) \
+        & (grid_cnt > 0)
+    grid = xp.where(valid, grid_sum / xp.where(valid, grid_cnt, 1.0),
+                    xp.nan)
+    return grid, valid
+
+
 @partial(jax.jit, static_argnames=("spec",))
 def run_pipeline_avg_div(grid_sum, grid_cnt, bucket_ts, group_ids,
                          rate_params, fill_value, spec: PipelineSpec):
@@ -184,10 +209,7 @@ def run_pipeline_avg_div(grid_sum, grid_cnt, bucket_ts, group_ids,
     SUM-tier grid by a bucketized COUNT-tier grid in-trace (no host
     round-trip for the [S,B] grids), then runs the shared
     rate/interpolate/aggregate chain."""
-    valid = (~jnp.isnan(grid_sum)) & (~jnp.isnan(grid_cnt)) \
-        & (grid_cnt > 0)
-    grid = jnp.where(valid, grid_sum / jnp.where(valid, grid_cnt, 1.0),
-                     jnp.nan)
+    grid, valid = avg_divide_grid(grid_sum, grid_cnt, xp=jnp)
     return _finish_pipeline(grid, valid, bucket_ts, group_ids,
                             rate_params, fill_value, spec)
 

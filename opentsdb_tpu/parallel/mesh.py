@@ -34,3 +34,70 @@ def make_mesh(n_series: int | None = None, n_time: int = 1,
         raise ValueError(
             f"mesh {n_series}x{n_time} != {total} devices")
     return Mesh(devs.reshape(n_series, n_time), ("series", "time"))
+
+
+def mesh_from_spec(spec: str, devices=None) -> Mesh | None:
+    """Parse the ``tsd.query.mesh`` config value into a query mesh.
+
+    Accepted forms:
+
+    - ``""`` — multi-chip execution off (single-device pipeline)
+    - ``"auto"`` — every visible device on the series axis (None when
+      only one device exists: shard_map overhead buys nothing there)
+    - ``"series:N"`` / ``"series:N,time:M"`` — explicit shape; uses the
+      first N*M devices
+
+    This is the TSD's knob for the reference's fixed 20-way salt
+    fan-out (Const.java:127 SALT_BUCKETS): the device mesh replaces the
+    salt-bucket scanner pool.
+    """
+    shape = parse_mesh_spec(spec)
+    if shape is None:
+        return None
+    devs = list(devices if devices is not None else jax.devices())
+    if shape == "auto":
+        if len(devs) <= 1:
+            return None
+        return make_mesh(len(devs), 1, devices=devs)
+    n_series, n_time = shape
+    need = n_series * n_time
+    if need > len(devs):
+        raise ValueError(
+            f"tsd.query.mesh={spec!r} wants {need} devices, "
+            f"{len(devs)} available")
+    return make_mesh(n_series, n_time, devices=devs[:need])
+
+
+def parse_mesh_spec(spec: str) -> tuple[int, int] | str | None:
+    """Validate a ``tsd.query.mesh`` string WITHOUT touching devices:
+    returns (n_series, n_time), the string ``"auto"``, or None for
+    off. Called eagerly at TSDB construction so a typo fails at boot,
+    not as an HTTP 500 on the first query."""
+    spec = (spec or "").strip().lower()
+    if not spec:
+        return None
+    if spec == "auto":
+        return "auto"
+    n_series = n_time = 1
+    for part in spec.split(","):
+        axis, _, n = part.partition(":")
+        axis = axis.strip()
+        if axis not in ("series", "time"):
+            raise ValueError(
+                f"unknown mesh axis {axis!r} in tsd.query.mesh={spec!r} "
+                "(expected 'auto' or 'series:N[,time:M]')")
+        try:
+            count = int(n)
+        except ValueError:
+            raise ValueError(
+                f"bad device count {n!r} for axis {axis!r} in "
+                f"tsd.query.mesh={spec!r}") from None
+        if count < 1:
+            raise ValueError(
+                f"axis {axis!r} needs >= 1 device in "
+                f"tsd.query.mesh={spec!r}")
+        if axis == "series":
+            n_series = count
+        else:
+            n_time = count
+    return n_series, n_time

@@ -24,7 +24,7 @@ the cross-device combines live here.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -40,10 +40,13 @@ from opentsdb_tpu.ops.interp import (_gather_minor, _next_valid_idx,
 from opentsdb_tpu.ops.pipeline import PipelineSpec
 
 # aggregators whose group reduction crosses the series axis with
-# psum/pmin/pmax partials (everything else all_gathers)
-_REDUCIBLE = frozenset((
+# psum/pmin/pmax partials and so keep per-device memory at
+# [S_loc, B_loc]; everything else all_gathers the full series axis
+# (engine sizing decisions key off this too)
+REDUCIBLE_AGGS = frozenset((
     "sum", "zimsum", "pfsum", "avg", "count", "min", "max", "mimmin",
     "mimmax", "squareSum", "dev"))
+_REDUCIBLE = REDUCIBLE_AGGS
 
 
 # ---------------------------------------------------------------------------
@@ -303,14 +306,21 @@ def build_sharded_step(mesh: Mesh, spec: PipelineSpec, s_loc: int,
         if spec.emit_raw:
             return grid, has_data
 
-        # 3. interpolation fill with halo carries both directions
-        (lv, lt, lp), (fv, ft, fp) = _block_boundaries(grid, bts)
-        pv, pt, pp = _scan_boundary(lv, lt, lp, "time", n_time_shards,
-                                    reverse=False)
-        nv, nt, npp = _scan_boundary(fv, ft, fp, "time", n_time_shards,
-                                     reverse=True)
-        filled = _fill_with_boundaries(grid, bts, interp_mode,
-                                       pv, pt, pp, nv, nt, npp)
+        # 3. interpolation fill with halo carries both directions.
+        # Only fill NONE leaves true gaps that interpolate at merge;
+        # NAN/NULL emit explicit NaN points that the reference's merge
+        # loop skips WITHOUT interpolating, and ZERO/SCALAR were
+        # substituted in step 1 (mirrors pipeline._finish_pipeline).
+        if spec.fill_policy == ds_mod.FillPolicy.NONE:
+            (lv, lt, lp), (fv, ft, fp) = _block_boundaries(grid, bts)
+            pv, pt, pp = _scan_boundary(lv, lt, lp, "time",
+                                        n_time_shards, reverse=False)
+            nv, nt, npp = _scan_boundary(fv, ft, fp, "time",
+                                         n_time_shards, reverse=True)
+            filled = _fill_with_boundaries(grid, bts, interp_mode,
+                                           pv, pt, pp, nv, nt, npp)
+        else:
+            filled = grid
 
         # 4. group aggregation across the 'series' axis
         if spec.agg_name in _REDUCIBLE:
@@ -413,22 +423,33 @@ def prepare_sharded_batch(values: np.ndarray, series_idx: np.ndarray,
                         num_groups)
 
 
+@lru_cache(maxsize=128)
+def _compiled_step(mesh: Mesh, spec: PipelineSpec, s_loc: int,
+                   b_loc: int):
+    """Per-(mesh, spec, shape) cache: build_sharded_step returns a new
+    closure every call, so jax.jit alone would re-trace every query."""
+    return build_sharded_step(mesh, spec, s_loc, b_loc)
+
+
 def run_sharded(mesh: Mesh, spec: PipelineSpec, batch: ShardedBatch,
                 rate_options=None, dtype=None):
     """Execute the sharded step; returns host (result[G,B], emit[G,B])
     trimmed of padding."""
+    from opentsdb_tpu.ops.pipeline import device_bucket_ts
     from opentsdb_tpu.ops.rate import RateOptions
     if dtype is None:
         dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
             else jnp.float32
     ro = rate_options or RateOptions()
-    step = build_sharded_step(mesh, spec, batch.s_loc, batch.b_loc)
+    step = _compiled_step(mesh, spec, batch.s_loc, batch.b_loc)
     rate_params = (jnp.asarray(ro.counter_max, dtype),
                    jnp.asarray(ro.reset_value, dtype))
+    # relative ms offsets: absolute epoch-ms int64 would truncate on
+    # TPU (no device int64); the kernels only use ts differences
     result, emit = step(jnp.asarray(batch.values, dtype),
                         jnp.asarray(batch.series_idx),
                         jnp.asarray(batch.bucket_idx),
-                        jnp.asarray(batch.bucket_ts),
+                        jnp.asarray(device_bucket_ts(batch.bucket_ts)),
                         jnp.asarray(batch.group_ids),
                         rate_params,
                         jnp.asarray(spec.fill_value, dtype))

@@ -240,19 +240,38 @@ class QueryEngine:
             else:
                 batch = batch._replace(values=batch.values
                                        * rollup_scale)
-        if not emit_raw and len(sids) * len(bucket_ts) > budget:
+        mesh = self.tsdb.query_mesh
+        # the mesh raises the streaming threshold only when every
+        # device truly holds S_loc x B_loc cells: non-psum-reducible
+        # aggregators all_gather the full series axis (sharded step),
+        # so their per-device footprint stays [S, B] and the budget
+        # must not scale
+        from opentsdb_tpu.parallel.sharded_pipeline import REDUCIBLE_AGGS
+        n_mesh = int(np.prod(list(mesh.shape.values()))) \
+            if mesh is not None else 1
+        mesh_scale = n_mesh if sub.agg.name in REDUCIBLE_AGGS else 1
+        use_blocked = not emit_raw and \
+            len(sids) * len(bucket_ts) > budget * mesh_scale
+        if padded is not None and (use_blocked or mesh is not None):
+            values, series_idx, bucket_idx = flatten_padded(
+                padded.values2d, bucket_idx2d, padded.counts)
+        elif use_blocked or mesh is not None:
+            values, series_idx = batch.values, batch.series_idx
+        if use_blocked:
             # long-range streaming: bound HBM at [S x block] cells
             # (SURVEY.md §5.7 time-axis blocking)
-            if padded is not None:
-                values, series_idx, bucket_idx = flatten_padded(
-                    padded.values2d, bucket_idx2d, padded.counts)
-            else:
-                values, series_idx = batch.values, batch.series_idx
             result, emit = execute_blocked(
                 values, series_idx, bucket_idx, bucket_ts,
                 group_ids, spec, sub.rate_options,
                 block_buckets=pick_block_buckets(
                     len(sids), len(bucket_ts), budget))
+        elif mesh is not None:
+            # multi-chip: shard the point batch over the
+            # ('series','time') mesh — the salt-scanner fan-out/merge
+            # as XLA collectives (SaltScanner.java:70, SURVEY §2.11)
+            result, emit = self._mesh_execute(
+                mesh, spec, values, series_idx, bucket_idx, bucket_ts,
+                group_ids, sub.rate_options)
         elif padded is not None:
             result, emit = execute_auto(
                 padded, bucket_idx2d, bucket_ts, group_ids, spec,
@@ -376,12 +395,40 @@ class QueryEngine:
             rate_counter=sub.rate_options.counter,
             rate_drop_resets=sub.rate_options.drop_resets,
             emit_raw=emit_raw)
-        result, emit = execute_avg_divide(gs, gc, bucket_ts, group_ids,
-                                          spec, sub.rate_options)
+        mesh = self.tsdb.query_mesh
+        if mesh is not None:
+            # divide host-side, then run the rate/fill/agg tail over
+            # the mesh with one point per present grid cell (bucketize
+            # of a single-point cell reproduces the cell exactly)
+            from opentsdb_tpu.ops.pipeline import avg_divide_grid
+            avg, valid = avg_divide_grid(np.asarray(gs), np.asarray(gc),
+                                         xp=np)
+            valid = np.asarray(valid)
+            sidx2, bidx2 = np.nonzero(valid)
+            result, emit = self._mesh_execute(
+                mesh, spec, avg[valid], sidx2.astype(np.int32),
+                bidx2.astype(np.int32), bucket_ts, group_ids,
+                sub.rate_options)
+        else:
+            result, emit = execute_avg_divide(
+                gs, gc, bucket_ts, group_ids, spec, sub.rate_options)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)
         return result, emit, bucket_ts
+
+    def _mesh_execute(self, mesh, spec, values, series_idx, bucket_idx,
+                      bucket_ts, group_ids, rate_options):
+        """Run one sub-query's compute over the configured device mesh
+        (series axis ≙ salt buckets, time axis ≙ long-range blocking;
+        ref: SaltScanner.java:70, TsdbQuery.java:795)."""
+        from opentsdb_tpu.parallel.sharded_pipeline import (
+            prepare_sharded_batch, run_sharded)
+        batch = prepare_sharded_batch(
+            values, series_idx, bucket_idx, bucket_ts, group_ids,
+            spec.num_series, spec.num_groups, mesh.shape["series"],
+            mesh.shape["time"])
+        return run_sharded(mesh, spec, batch, rate_options)
 
     def _tsuid_store(self, sub: TSSubQuery):
         """Resolve explicit TSUID hex strings to series ids

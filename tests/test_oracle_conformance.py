@@ -16,6 +16,15 @@ from oracle import run_oracle
 
 BASE = 1356998400
 
+# Overridden by the mesh twin module (test_oracle_conformance_mesh.py)
+# to run this whole matrix through the multi-chip engine path.
+EXTRA_CONFIG: dict = {}
+
+
+def make_tsdb():
+    return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          **EXTRA_CONFIG}))
+
 
 def _seed(tsdb, num_series=7, seed=0):
     """Irregular per-series timestamps on a 10s lattice (lattice keeps
@@ -77,7 +86,7 @@ AGGS = ["sum", "avg", "min", "max", "count", "dev", "zimsum", "mimmin",
 
 @pytest.mark.parametrize("agg", AGGS)
 def test_agg_matrix_downsampled(agg):
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     series = _seed(tsdb, seed=sum(map(ord, agg)))
     _check(tsdb, series, agg, 60_000, "avg", "1m-avg")
 
@@ -85,14 +94,14 @@ def test_agg_matrix_downsampled(agg):
 @pytest.mark.parametrize("ds_fn", ["sum", "avg", "min", "max", "count",
                                    "first", "last"])
 def test_downsample_fn_matrix(ds_fn):
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     series = _seed(tsdb, seed=sum(map(ord, ds_fn)))
     _check(tsdb, series, "sum", 120_000, ds_fn, f"2m-{ds_fn}")
 
 
 @pytest.mark.parametrize("agg", ["sum", "avg", "max"])
 def test_rate_matrix(agg):
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     series = _seed(tsdb, seed=42)
     _check(tsdb, series, agg, 60_000, "sum", "1m-sum", rate=True)
 
@@ -103,7 +112,7 @@ def test_rate_matrix(agg):
     ("1m-avg-scalar#7.5", "scalar", 7.5),
 ])
 def test_fill_policy_matrix(fill, policy, value):
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     series = _seed(tsdb, seed=7)
     _check(tsdb, series, "sum", 60_000, "avg", fill,
            fill_policy=policy, fill_value=value)
@@ -120,7 +129,7 @@ def test_raw_union_merge_matrix(agg):
     """No downsample: the classic AggregationIterator k-way merge at
     the union of raw timestamps with per-aggregator interpolation."""
     from oracle import aggregate_group
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     series = _seed(tsdb, seed=sum(map(ord, agg)) + 500)
     obj = {"start": BASE * 1000, "end": (BASE + 6000) * 1000,
            "queries": [{"metric": "m", "aggregator": agg,
@@ -147,7 +156,7 @@ def test_raw_union_merge_matrix(agg):
 @pytest.mark.parametrize("drop", [False, True])
 def test_counter_rate_matrix(drop):
     """Counter rollover correction + drop_resets against the oracle."""
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     rng = np.random.default_rng(3)
     series = []
     for i in range(4):
@@ -189,7 +198,7 @@ def test_counter_rate_matrix(drop):
 
 def test_run_all_matrix():
     """0all downsample: one bucket spanning the whole query."""
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     series = _seed(tsdb, seed=99)
     obj = {"start": BASE * 1000, "end": (BASE + 6000) * 1000,
            "queries": [{"metric": "m", "aggregator": "sum",
@@ -210,7 +219,7 @@ def test_run_all_matrix():
 def test_two_key_groupby():
     """Group key = concatenated tagv ids across TWO group-by tags
     (ref: TsdbQuery.java:995-1036)."""
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     rng = np.random.default_rng(17)
     series = {}
     for i in range(8):
@@ -255,7 +264,7 @@ def test_two_key_groupby():
 def test_filter_restricts_group_members():
     """Non-group-by literal filter ANDs with the group-by wildcard
     (ref: SaltScanner post-scan filter chain)."""
-    tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    tsdb = make_tsdb()
     kept, dropped = [], []
     for i in range(6):
         dc = "lga" if i % 2 == 0 else "sjc"

@@ -1,0 +1,96 @@
+"""The full oracle conformance matrix through the MULTI-CHIP engine
+path — the TPU analogue of the reference's ``*Salted`` twin tests
+(TestTsdbQuerySalted.java flips salt buckets to force the 20-way
+parallel merge; here ``tsd.query.mesh`` puts ``/api/query`` on an
+8-device ('series','time') mesh and every result must still match the
+independent per-datapoint oracle).
+
+Collects every test from test_oracle_conformance via ``import *`` and
+flips the engine to mesh execution with an autouse fixture.
+"""
+
+import numpy as np
+import pytest
+
+import test_oracle_conformance as base
+from test_oracle_conformance import *  # noqa: F401,F403 — collect the matrix
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+
+@pytest.fixture(autouse=True)
+def _mesh_engine(monkeypatch):
+    monkeypatch.setattr(base, "EXTRA_CONFIG",
+                        {"tsd.query.mesh": "series:4,time:2"})
+
+
+MESH_SHAPES = ["series:1,time:1", "series:2", "series:1,time:2",
+               "series:2,time:2", "series:8", "series:2,time:4"]
+
+
+@pytest.mark.parametrize("mesh_spec", MESH_SHAPES)
+def test_mesh_shape_sweep(mesh_spec, monkeypatch):
+    """A representative downsample+rate+groupby query across every mesh
+    factorization of 1/2/4/8 devices (the salted-matrix dimension)."""
+    monkeypatch.setattr(base, "EXTRA_CONFIG",
+                        {"tsd.query.mesh": mesh_spec})
+    tsdb = base.make_tsdb()
+    series = base._seed(tsdb, seed=13)
+    base._check(tsdb, series, "avg", 60_000, "sum", "1m-sum", rate=True)
+
+
+@pytest.mark.parametrize("mesh_spec", ["series:4,time:2", "series:8"])
+def test_mesh_matches_single_device_avg_rollup(mesh_spec, monkeypatch):
+    """The avg-from-rollup (sum tier / count tier) path over the mesh
+    must equal the single-device division path."""
+    def build(extra):
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           "tsd.rollups.enable": "true", **extra}))
+        for i in range(12):
+            for j in range(40):
+                ts = base.BASE + j * 60
+                t.add_aggregate_point("m", ts, float(i + j),
+                                      {"host": f"h{i % 3}"}, False,
+                                      "1m", "sum")
+                t.add_aggregate_point("m", ts, 2.0, {"host": f"h{i % 3}"},
+                                      False, "1m", "count")
+        obj = {"start": base.BASE * 1000,
+               "end": (base.BASE + 3000) * 1000,
+               "queries": [{"metric": "m", "aggregator": "sum",
+                            "downsample": "5m-avg",
+                            "filters": [{"type": "wildcard",
+                                         "tagk": "host", "filter": "*",
+                                         "groupBy": True}]}]}
+        return t.execute_query(TSQuery.from_json(obj).validate())
+
+    ref = build({})
+    got = build({"tsd.query.mesh": mesh_spec})
+    assert len(ref) == len(got) > 0
+    for r, g in zip(sorted(ref, key=lambda x: sorted(x.tags.items())),
+                    sorted(got, key=lambda x: sorted(x.tags.items()))):
+        assert r.tags == g.tags
+        np.testing.assert_allclose(
+            [v for _, v in g.dps], [v for _, v in r.dps], rtol=1e-9)
+        assert [t for t, _ in g.dps] == [t for t, _ in r.dps]
+
+
+def test_mesh_matches_single_device_agg_none(monkeypatch):
+    """emit_raw (aggregator 'none') over the mesh: per-series output."""
+    def build(extra):
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           **extra}))
+        base._seed(t, seed=5)
+        obj = {"start": base.BASE * 1000,
+               "end": (base.BASE + 6000) * 1000,
+               "queries": [{"metric": "m", "aggregator": "none",
+                            "downsample": "1m-avg"}]}
+        return t.execute_query(TSQuery.from_json(obj).validate())
+
+    ref = build({})
+    got = build({"tsd.query.mesh": "series:4,time:2"})
+    key = lambda r: sorted(r.tags.items())
+    assert len(ref) == len(got) > 1
+    for r, g in zip(sorted(ref, key=key), sorted(got, key=key)):
+        assert r.tags == g.tags
+        assert g.dps == pytest.approx(r.dps, rel=1e-9)
