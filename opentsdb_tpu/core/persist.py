@@ -156,7 +156,12 @@ def _save_histograms(tsdb, data_dir: str) -> None:
     """Distribution-valued series: identity + re-encoded blobs
     (ref: histogram cells beside scalar cells in the data table)."""
     doc = []
-    for sid, pts in tsdb._histogram_series.items():
+    with tsdb._histogram_lock:
+        # materialize under the write lock: a concurrent
+        # add_histogram_point must not resize the dict mid-iteration
+        items = [(sid, list(pts))
+                 for sid, pts in tsdb._histogram_series.items()]
+    for sid, pts in items:
         rec = tsdb.histogram_store.series(sid)
         doc.append({
             "metric": rec.metric_id,

@@ -10,6 +10,7 @@ rw/ro/wo (:103).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Sequence
 
@@ -19,6 +20,21 @@ from opentsdb_tpu.core import codec, const, tags as tags_mod
 from opentsdb_tpu.core.store import PointBatch, TimeSeriesStore
 from opentsdb_tpu.core.uid import UidRegistry
 from opentsdb_tpu.utils.config import Config
+
+
+class PartialWriteError(Exception):
+    """A bulk write landed ``written`` points before one failed.
+
+    Raised by the per-point hook fallback in :meth:`TSDB.add_points` so
+    batch callers replay only the remainder — re-running already-landed
+    points would double realtime-publisher events and meta counters
+    (the store itself dedupes the cells, but the hooks are not
+    idempotent)."""
+
+    def __init__(self, written: int, cause: Exception):
+        super().__init__(str(cause))
+        self.written = written
+        self.cause = cause
 
 
 class TSDB:
@@ -90,6 +106,8 @@ class TSDB:
         self.histogram_manager = HistogramCodecManager(self.config)
         self.histogram_store = TimeSeriesStore(num_shards=const.salt_buckets())
         self._histogram_series: dict[int, list] = {}
+        # guards _histogram_series shape for snapshot-vs-write races
+        self._histogram_lock = threading.Lock()
         from opentsdb_tpu.meta.annotation import AnnotationStore
         self.annotations = AnnotationStore()
         from opentsdb_tpu.meta.meta_store import MetaStore
@@ -273,10 +291,16 @@ class TSDB:
                 or self.meta_cache is not None):
             # inherently per-point hooks; batch already validated
             sid = -1
+            done = 0
             for t, v, f in zip(ts.tolist(), vals.tolist(),
                                flags.tolist()):
-                sid = self.add_point(metric, t,
-                                     int(v) if f else float(v), tags)
+                try:
+                    sid = self.add_point(metric, t,
+                                         int(v) if f else float(v),
+                                         tags)
+                except Exception as e:  # noqa: BLE001
+                    raise PartialWriteError(done, e) from e
+                done += 1
             return sid
         metric_id, tag_ids = self._resolve_write_uids(metric, tags)
         sid = self.store.get_or_create_series(metric_id, tag_ids)
@@ -323,8 +347,22 @@ class TSDB:
                 self.add_points(metric, ts_arr, vals, items[0][3],
                                 is_int=flags)
                 written += n
+            except PartialWriteError as pe:
+                # the hook-fallback loop landed pe.written points; the
+                # next one failed mid-hooks (don't retry it — hooks are
+                # not idempotent); the rest replay per point
+                written += pe.written
+                idx, t, _v, _tg = items[pe.written]
+                fail(idx, metric, t, pe.cause)
+                for idx, t, v, tg in items[pe.written + 1:]:
+                    try:
+                        self.add_point(metric, t, v, tg)
+                        written += 1
+                    except Exception as e:  # noqa: BLE001
+                        fail(idx, metric, t, e)
             except Exception:  # noqa: BLE001
-                # per-point replay: valid points land, errors map back
+                # bulk path failed before anything landed: per-point
+                # replay so valid points land and errors map back
                 for idx, t, v, tg in items:
                     try:
                         self.add_point(metric, t, v, tg)
@@ -376,8 +414,9 @@ class TSDB:
         metric_id, tag_ids = self._resolve_write_uids(metric, tags)
         sid = self.histogram_store.get_or_create_series(metric_id, tag_ids)
         ts_ms = codec.to_ms(timestamp)
-        lst = self._histogram_series.setdefault(sid, [])
-        lst.append((ts_ms, hist))
+        with self._histogram_lock:
+            lst = self._histogram_series.setdefault(sid, [])
+            lst.append((ts_ms, hist))
         self.datapoints_added += 1
         return sid
 
@@ -393,7 +432,15 @@ class TSDB:
         scan fan-out this replaces with a device-mesh shard_map)."""
         if self._query_mesh is None and self._query_mesh_spec:
             from opentsdb_tpu.parallel.mesh import mesh_from_spec
-            self._query_mesh = mesh_from_spec(self._query_mesh_spec)
+            try:
+                self._query_mesh = mesh_from_spec(self._query_mesh_spec)
+            except ValueError:
+                # e.g. spec wants more devices than exist: degrade to
+                # single-device once, loudly — NOT a 500 on every query
+                import logging
+                logging.getLogger("tsdb").exception(
+                    "tsd.query.mesh=%r unusable; queries run "
+                    "single-device", self._query_mesh_spec)
             if self._query_mesh is None:  # single device: stop retrying
                 self._query_mesh_spec = ""
         return self._query_mesh

@@ -46,7 +46,6 @@ from opentsdb_tpu.ops.pipeline import PipelineSpec
 REDUCIBLE_AGGS = frozenset((
     "sum", "zimsum", "pfsum", "avg", "count", "min", "max", "mimmin",
     "mimmax", "squareSum", "dev"))
-_REDUCIBLE = REDUCIBLE_AGGS
 
 
 # ---------------------------------------------------------------------------
@@ -323,7 +322,7 @@ def build_sharded_step(mesh: Mesh, spec: PipelineSpec, s_loc: int,
             filled = grid
 
         # 4. group aggregation across the 'series' axis
-        if spec.agg_name in _REDUCIBLE:
+        if spec.agg_name in REDUCIBLE_AGGS:
             result = _group_reduce_psum(filled, gids, g_padded,
                                         spec.agg_name, "series")
         else:

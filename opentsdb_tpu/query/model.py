@@ -244,7 +244,7 @@ def parse_uri_tsuid_subquery(spec: str, index: int = 0) -> TSSubQuery:
     """Parse the URI form ``agg:[interval-ds:][rate:]tsuid1,tsuid2``
     (ref: QueryRpc.parseTsuidTypeSubQuery)."""
     parts = spec.split(":")
-    if len(parts) < 2:
+    if len(parts) < 2 or len(parts) > 5:
         raise BadRequestError(f"Invalid parameter tsuids={spec!r}")
     sub = TSSubQuery(aggregator=parts[0], index=index)
     for middle in parts[1:-1]:
@@ -267,10 +267,12 @@ def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
         vals = params.get(key)
         return vals[0] if vals else default
 
-    queries = [parse_uri_subquery(spec, i)
-               for i, spec in enumerate(params.get("m", []))]
-    queries += [parse_uri_tsuid_subquery(spec, len(queries) + i)
-                for i, spec in enumerate(params.get("tsuids", []))]
+    # tsuid sub-queries come FIRST, like the reference's parseQuery,
+    # so mixed tsuids+m requests keep the same output indices
+    queries = [parse_uri_tsuid_subquery(spec, i)
+               for i, spec in enumerate(params.get("tsuids", []))]
+    queries += [parse_uri_subquery(spec, len(queries) + i)
+                for i, spec in enumerate(params.get("m", []))]
     return TSQuery(
         start=first("start", ""),
         end=first("end"),

@@ -310,6 +310,14 @@ def run_rollup_job(tsdb, start_ms: int, end_ms: int,
         [tsdb.store.series_ids_for_metric(mid)
          for mid in tsdb.store.metric_ids()]
         or [np.empty(0, dtype=np.int64)])
+    if len(all_sids):
+        # skip series with no raw data in the job window up front:
+        # _chunk_tier_sids get_or_creates a tier series per (tier, agg)
+        # per raw series, so a sparse range would otherwise permanently
+        # allocate empty tier series (memory + snapshot growth)
+        counts = np.asarray(
+            tsdb.store.count_range(all_sids, start_ms, end_ms))
+        all_sids = all_sids[counts > 0]
     # sweeps: finest pass feeds nested tiers by coarsening; each
     # non-nesting tier scans the raw data itself
     sweeps = [(finest, nested)] + [(t, []) for t in direct]

@@ -14,6 +14,7 @@ is a telnet command. Connection counting mirrors
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import time
 import urllib.parse
@@ -28,6 +29,20 @@ LOG = logging.getLogger("tsd.server")
 
 _HTTP_METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"OPTI",
                  b"PATC")
+
+
+def _is_query_path(path: str) -> bool:
+    """True for the endpoints ``tsd.query.timeout`` governs — the data
+    query surface only (ref: the reference expires *queries*, not
+    writes; a timed-out /api/put would 504 while the write still
+    commits, making client retries duplicate side effects)."""
+    parts = [p for p in path.split("/") if p]
+    if parts and parts[0] == "api":
+        parts = parts[1:]
+        if parts and len(parts[0]) > 1 and parts[0][0] == "v" \
+                and parts[0][1:].isdigit():
+            parts = parts[1:]
+    return bool(parts) and parts[0] in ("query", "q")
 
 
 class ConnectionManager:
@@ -85,6 +100,11 @@ class TSDServer:
         # ms; 0 = no limit (ref: tsd.query.timeout expiring queries)
         self.query_timeout_ms = tsdb.config.get_int("tsd.query.timeout",
                                                     0)
+        # queries run on their own bounded pool so abandoned (timed-out)
+        # query threads can't starve puts and admin endpoints
+        self._query_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=tsdb.config.get_int("tsd.query.workers", 8),
+            thread_name_prefix="tsd-query")
 
     # ------------------------------------------------------------------
 
@@ -108,6 +128,7 @@ class TSDServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        self._query_pool.shutdown(wait=False)
         self.tsdb.shutdown()
 
     def request_shutdown(self) -> None:
@@ -251,9 +272,11 @@ class TSDServer:
                 if self.tsdb.authentication is not None:
                     request.auth = auth_state
                 t0 = time.monotonic()
+                is_query = _is_query_path(parsed.path)
                 fut = asyncio.get_event_loop().run_in_executor(
-                    None, self.http_router.handle, request)
-                if self.query_timeout_ms > 0:
+                    self._query_pool if is_query else None,
+                    self.http_router.handle, request)
+                if is_query and self.query_timeout_ms > 0:
                     try:
                         response = await asyncio.wait_for(
                             fut, self.query_timeout_ms / 1000.0)

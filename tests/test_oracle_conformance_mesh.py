@@ -75,6 +75,19 @@ def test_mesh_matches_single_device_avg_rollup(mesh_spec, monkeypatch):
         assert [t for t, _ in g.dps] == [t for t, _ in r.dps]
 
 
+def test_oversized_mesh_degrades_to_single_device():
+    """A mesh spec wanting more devices than exist must not 500 every
+    query — it logs once and the engine runs single-device."""
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                       "tsd.query.mesh": "series:64"}))
+    base._seed(t, seed=3)
+    assert t.query_mesh is None  # degraded, not raised
+    obj = {"start": base.BASE * 1000, "end": (base.BASE + 3000) * 1000,
+           "queries": [{"metric": "m", "aggregator": "sum"}]}
+    res = t.execute_query(TSQuery.from_json(obj).validate())
+    assert len(res) == 1 and len(res[0].dps) > 0
+
+
 def test_mesh_matches_single_device_agg_none(monkeypatch):
     """emit_raw (aggregator 'none') over the mesh: per-series output."""
     def build(extra):

@@ -96,18 +96,30 @@ class TestUriParsing:
         assert not tsq.queries[0].filters[0].group_by
 
     def test_tsuids_parse(self):
-        # ref: QueryRpc.parseTsuidTypeSubQuery
+        # ref: QueryRpc.parseTsuidTypeSubQuery; tsuid sub-queries are
+        # parsed BEFORE m= ones, so mixed requests index tsuids first
         tsq = parse_uri_query(
             {"start": ["1h-ago"],
              "m": ["sum:sys.cpu"],
              "tsuids": ["max:1m-avg:rate:000001000001000001,"
                         "000001000001000002"]})
-        s = tsq.queries[1]
+        s = tsq.queries[0]
         assert s.aggregator == "max"
         assert s.downsample == "1m-avg"
         assert s.rate
         assert s.tsuids == ["000001000001000001", "000001000001000002"]
-        assert s.index == 1
+        assert s.index == 0
+        assert tsq.queries[1].metric == "sys.cpu"
+        assert tsq.queries[1].index == 1
+
+    def test_tsuids_too_many_parts_rejected(self):
+        # the reference bounds the colon-separated parts to 5
+        from opentsdb_tpu.query.model import BadRequestError
+        with pytest.raises(BadRequestError):
+            parse_uri_query(
+                {"start": ["1h-ago"],
+                 "tsuids": ["max:1m-avg:rate:extra:junk:000001000001"
+                            "000001"]})
 
 
 class TestQueryExecution:

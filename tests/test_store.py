@@ -240,6 +240,35 @@ class TestBulkWrite:
         assert t.store.series(sid).buffer.view()[0].tolist() == \
             [1356998400000, 1356998420000]
 
+    def test_add_point_batch_no_double_publish_on_hook_failure(self):
+        # a realtime publisher raising mid-batch must not make the
+        # replay re-publish points that already landed (the store
+        # dedupes cells, but hooks are not idempotent)
+        t = self._tsdb()
+        published = []
+
+        class Pub:
+            def publish_data_point(self, metric, ts, value, tags,
+                                   tsuid):
+                if ts == 1356998410:
+                    raise RuntimeError("publisher hiccup")
+                published.append(ts)
+
+            def shutdown(self):
+                pass
+
+        t.rt_publisher = Pub()
+        bad_idx = []
+        written, errors = t.add_point_batch([
+            ("m", 1356998400, 1.0, {"h": "a"}),
+            ("m", 1356998410, 2.0, {"h": "a"}),   # hook raises
+            ("m", 1356998420, 3.0, {"h": "a"}),
+        ], on_error=lambda i, e: bad_idx.append(i))
+        assert published == [1356998400, 1356998420]  # no replays
+        assert written == 2
+        assert bad_idx == [1]
+        assert "hiccup" in errors[0]
+
     def test_add_point_batch_mixed_int_float_flags(self):
         # per-point integer flags survive the bulk path (the storage
         # codec renders 3 vs 3.0 differently on export)

@@ -227,8 +227,10 @@ class TestGexpAndExp:
 
 
 def test_query_timeout_expires():
-    """tsd.query.timeout expires slow requests with a structured 504
-    (ref: query expiry), while fast requests still succeed."""
+    """tsd.query.timeout expires slow QUERY requests with a structured
+    504 (ref: query expiry), while fast requests still succeed and slow
+    non-query requests (e.g. a put) are never expired — a 504'd write
+    that still commits would make client retries duplicate points."""
     import json as _json
     import time as _t
 
@@ -266,9 +268,12 @@ def test_query_timeout_expires():
 
             status, _ = await fetch("/api/version")
             assert status == 200
-            status, body = await fetch("/api/slow")
+            status, body = await fetch("/api/query/slow")
             assert status == 504
             assert _json.loads(body)["error"]["code"] == 504
+            # non-query endpoints are exempt from the query timeout
+            status, _ = await fetch("/api/slow")
+            assert status != 504
         finally:
             await server.stop()
 
