@@ -1,0 +1,249 @@
+"""End-to-end /api/query benchmark over the BASELINE.json configs.
+
+Times the FULL query path the TSD server runs — store materialize ->
+filter/group construction -> device pipeline -> result assembly ->
+HTTP JSON serialization — not just the device kernels (bench.py).
+This is the north-star measurement: p50 latency of config 3
+(1M series x 1h@1s, 5m avg downsample + rate) answered from the 1m
+rollup tier, target < 2 s (BASELINE.json "north_star";
+ref: the single-threaded Java iterator chain behind
+/root/reference/src/core/TsdbQuery.java:742).
+
+Data setup writes the rollup tiers directly through the store layer —
+in the reference, rollups are also produced by external jobs and
+written through the API (SURVEY.md §2.3), so a query benchmark may
+legitimately start from populated tiers. Raw configs (1, 2) ingest
+through ``tsdb.add_points``.
+
+Usage: python bench_e2e.py [--cpu] [--configs 1,2,3,4] [--repeats N]
+Prints one JSON line per config plus a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASE_S = 1356998400
+BASE_MS = BASE_S * 1000
+
+
+def _percentile(times: list[float], q: float) -> float:
+    return float(np.percentile(np.asarray(times), q))
+
+
+def _run_query(tsdb, serializer, query_obj, repeats: int
+               ) -> tuple[dict, bytes]:
+    """Execute + serialize `repeats` times; returns timing stats and
+    the last response body."""
+    from opentsdb_tpu.query.model import TSQuery
+    times = []
+    body = b""
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        tsq = TSQuery.from_json(query_obj).validate()
+        results = tsdb.execute_query(tsq)
+        body = serializer.format_query(tsq, results)
+        times.append(time.perf_counter() - t0)
+    return {
+        "p50_ms": round(_percentile(times, 50) * 1e3, 1),
+        "min_ms": round(min(times) * 1e3, 1),
+        "max_ms": round(max(times) * 1e3, 1),
+        "runs": repeats,
+    }, body
+
+
+def _mk_tsdb(rollups: bool = False):
+    from opentsdb_tpu import TSDB, Config
+    cfg = {
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "native",
+    }
+    if rollups:
+        cfg["tsd.rollups.enable"] = "true"
+    return TSDB(Config(**cfg))
+
+
+def bench_config1(repeats: int) -> dict:
+    """1k series x 1h @ 10s, avg downsample 1m (ref: CliQuery path)."""
+    tsdb = _mk_tsdb()
+    ts = np.arange(BASE_S, BASE_S + 3600, 10, dtype=np.int64)
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    for i in range(1000):
+        tsdb.add_points("sys.bench1", ts,
+                        rng.normal(100, 10, len(ts)),
+                        {"host": f"h{i:04d}"})
+    ingest_s = time.perf_counter() - t0
+    n = 1000 * len(ts)
+    stats, body = _run_query(
+        tsdb, _serializer(), {
+            "start": BASE_MS, "end": BASE_MS + 3_600_000,
+            "queries": [{"metric": "sys.bench1", "aggregator": "avg",
+                         "downsample": "1m-avg"}]}, repeats)
+    return {"config": 1, "series": 1000, "points": n,
+            "ingest_mpps": round(n / ingest_s / 1e6, 2),
+            "resp_bytes": len(body), **stats}
+
+
+def bench_config2(repeats: int) -> dict:
+    """100k series, sum+max multi-aggregator, wildcard tagv group-by
+    (ref: GroupByAndAggregateCB + TagVWildcardFilter)."""
+    tsdb = _mk_tsdb()
+    n_series = 100_000
+    pts_per = 30  # 30m @ 1/min
+    ts = np.arange(BASE_S, BASE_S + pts_per * 60, 60, dtype=np.int64)
+    rng = np.random.default_rng(1)
+    vals = rng.normal(50, 5, (n_series, pts_per))
+    t0 = time.perf_counter()
+    for i in range(n_series):
+        tsdb.add_points("sys.bench2", ts, vals[i],
+                        {"host": f"h{i % 1000:04d}",
+                         "task": f"t{i // 1000:03d}"})
+    ingest_s = time.perf_counter() - t0
+    n = n_series * pts_per
+    stats, body = _run_query(
+        tsdb, _serializer(), {
+            "start": BASE_MS, "end": BASE_MS + pts_per * 60_000,
+            "queries": [
+                {"metric": "sys.bench2", "aggregator": "sum",
+                 "filters": [{"type": "wildcard", "tagk": "host",
+                              "filter": "*", "groupBy": True}]},
+                {"metric": "sys.bench2", "aggregator": "max",
+                 "filters": [{"type": "wildcard", "tagk": "host",
+                              "filter": "*", "groupBy": True}]},
+            ]}, repeats)
+    return {"config": 2, "series": n_series, "points": n,
+            "groups": 1000, "ingest_mpps": round(n / ingest_s / 1e6, 2),
+            "resp_bytes": len(body), **stats}
+
+
+def _populate_tier(tsdb, metric: str, n_series: int, n_buckets: int,
+                   interval_ms: int, chunk: int = 50_000) -> float:
+    """Write 1m rollup tiers (sum/count/min/max) for n_series, each
+    with n_buckets aligned points — the state an external rollup job
+    leaves behind (ref: TSDB.addAggregatePoint writers)."""
+    from opentsdb_tpu.rollup.job import ROLLUP_AGGS
+    mid = tsdb.uids.metrics.get_or_create_id(metric)
+    kid = tsdb.uids.tag_names.get_or_create_id("host")
+    bucket_ts = BASE_MS + np.arange(n_buckets, dtype=np.int64) \
+        * interval_ms
+    rng = np.random.default_rng(2)
+    t0 = time.perf_counter()
+    mask = np.ones((0, n_buckets), dtype=bool)
+    for lo in range(0, n_series, chunk):
+        hi = min(lo + chunk, n_series)
+        sids = {}
+        for agg in ROLLUP_AGGS:
+            store = tsdb.rollup_store.tier("1m", agg)
+            sids[agg] = np.asarray([
+                store.get_or_create_series(
+                    mid, [(kid,
+                           tsdb.uids.tag_values.get_or_create_id(
+                               f"h{i:07d}"))])
+                for i in range(lo, hi)], dtype=np.int64)
+        m = hi - lo
+        if mask.shape[0] != m:
+            mask = np.ones((m, n_buckets), dtype=bool)
+        base_vals = rng.normal(100, 10, (m, n_buckets))
+        grids = {"sum": base_vals * 60.0,
+                 "count": np.full((m, n_buckets), 60.0),
+                 "min": base_vals - 3.0, "max": base_vals + 3.0}
+        for agg in ROLLUP_AGGS:
+            tsdb.rollup_store.tier(agg=agg, interval="1m") \
+                .append_grid(sids[agg], bucket_ts, grids[agg], mask)
+    return time.perf_counter() - t0
+
+
+def bench_config3(repeats: int, n_series: int = 1_000_000) -> dict:
+    """North star: 1M series x 1h@1s, 5m avg downsample + rate,
+    answered from the 1m rollup tier (sum/count division) — the only
+    tier-correct way to satisfy the < 2 s budget; the raw window is
+    3.6e9 points (ref: TsdbQuery rollup best-match :143, RollupSpan
+    sum/count qualifiers)."""
+    tsdb = _mk_tsdb(rollups=True)
+    setup_s = _populate_tier(tsdb, "sys.bench3", n_series, 60, 60_000)
+    raw_equiv = n_series * 3600          # 1h @ 1s
+    tier_pts = n_series * 60 * 2         # sum + count read by the query
+    stats, body = _run_query(
+        tsdb, _serializer(), {
+            "start": BASE_MS, "end": BASE_MS + 3_600_000,
+            "queries": [{"metric": "sys.bench3", "aggregator": "sum",
+                         "downsample": "5m-avg", "rate": True}]},
+        repeats)
+    return {"config": 3, "series": n_series,
+            "raw_equiv_points": raw_equiv, "tier_points": tier_pts,
+            "setup_s": round(setup_s, 1), "resp_bytes": len(body),
+            **stats, "north_star_pass": stats["p50_ms"] < 2000.0}
+
+
+def bench_config4(repeats: int, n_series: int = 200_000) -> dict:
+    """p99/p999 percentiles over histogram series (ref:
+    SimpleHistogram.percentile via the device merge kernel)."""
+    from opentsdb_tpu.core.histogram import SimpleHistogram
+    tsdb = _mk_tsdb()
+    bounds = [float(b) for b in np.logspace(0, 4, 65)]
+    rng = np.random.default_rng(3)
+    all_counts = rng.integers(0, 50, (n_series, 64))
+    t0 = time.perf_counter()
+    for i in range(n_series):
+        h = SimpleHistogram(bounds)
+        h.counts = all_counts[i].tolist()
+        blob = tsdb.histogram_manager.encode(h)
+        tsdb.add_histogram_point("sys.bench4", BASE_S, blob,
+                                 {"host": f"h{i:07d}"})
+    ingest_s = time.perf_counter() - t0
+    stats, body = _run_query(
+        tsdb, _serializer(), {
+            "start": BASE_MS, "end": BASE_MS + 60_000,
+            "queries": [{"metric": "sys.bench4", "aggregator": "sum",
+                         "percentiles": [99.0, 99.9]}]}, repeats)
+    return {"config": 4, "series": n_series,
+            "ingest_s": round(ingest_s, 1), "resp_bytes": len(body),
+            **stats}
+
+
+def _serializer():
+    from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+    return HttpJsonSerializer()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU (debug; bench runs on TPU)")
+    ap.add_argument("--configs", default="1,2,3,4")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--series3", type=int, default=1_000_000)
+    args = ap.parse_args()
+    if args.cpu:
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    runners = {1: bench_config1, 2: bench_config2,
+               3: lambda r: bench_config3(r, args.series3),
+               4: bench_config4}
+    out = []
+    for c in (int(x) for x in args.configs.split(",")):
+        t0 = time.perf_counter()
+        res = runners[c](args.repeats)
+        res["total_s"] = round(time.perf_counter() - t0, 1)
+        out.append(res)
+        print(json.dumps(res), flush=True)
+    ns = [r for r in out if r.get("config") == 3]
+    if ns:
+        print(json.dumps({
+            "metric": "p50 /api/query e2e latency, north-star config",
+            "value": ns[0]["p50_ms"], "unit": "ms",
+            "north_star_pass": ns[0]["north_star_pass"]}),
+            file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -280,6 +280,9 @@ class TimeSeriesStore:
         self._key_to_sid: dict[tuple, int] = {}
         self._metric_index: dict[int, MetricIndex] = {}
         self.points_written = 0
+        # bumped on destructive ops (delete_range); together with
+        # points_written it versions the store for read-side caches
+        self.mutation_epoch = 0
 
     # -- write path -------------------------------------------------------
 
@@ -349,6 +352,8 @@ class TimeSeriesStore:
         for sid in series_ids:
             deleted += self._series[int(sid)].buffer.delete_range(
                 start_ms, end_ms)
+        if deleted:
+            self.mutation_epoch += 1
         return deleted
 
     # -- read path --------------------------------------------------------
@@ -431,6 +436,34 @@ class TimeSeriesStore:
                 ts2d[i, :n] = ts
                 values2d[i, :n] = vals
         return PaddedBatch(sids, values2d, ts2d, counts)
+
+    def bucket_reduce(self, series_ids, start_ms: int, end_ms: int,
+                      t0: int, interval_ms: int, nbuckets: int,
+                      want_minmax: bool = False):
+        """Portable twin of the native store's fused range-scan +
+        fixed-interval pre-reduction: [S, B] sum/count (+min/max)
+        grids over [start_ms, end_ms], bucket = (ts - t0)//interval_ms.
+        NaN stored values are skipped like the device bucketize."""
+        batch = self.materialize(series_ids, start_ms, end_ms)
+        s = len(batch.series_ids)
+        b = (batch.ts_ms - t0) // interval_ms
+        ok = (b >= 0) & (b < nbuckets) & ~np.isnan(batch.values)
+        seg = batch.series_idx[ok].astype(np.int64) * nbuckets + b[ok]
+        vals = batch.values[ok]
+        n = s * nbuckets
+        sums = np.bincount(seg, weights=vals, minlength=n).reshape(
+            s, nbuckets)
+        cnts = np.bincount(seg, minlength=n).astype(np.float64) \
+            .reshape(s, nbuckets)
+        mins = maxs = None
+        if want_minmax:
+            mins = np.full(n, np.inf)
+            np.minimum.at(mins, seg, vals)
+            maxs = np.full(n, -np.inf)
+            np.maximum.at(maxs, seg, vals)
+            mins = mins.reshape(s, nbuckets)
+            maxs = maxs.reshape(s, nbuckets)
+        return sums, cnts, mins, maxs
 
     def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
         return np.asarray([self._series[s].shard for s in series_ids],

@@ -108,6 +108,8 @@ class TSDB:
         self._histogram_series: dict[int, list] = {}
         # guards _histogram_series shape for snapshot-vs-write races
         self._histogram_lock = threading.Lock()
+        # write version for read-side caches of histogram batches
+        self._histogram_version = 0
         from opentsdb_tpu.meta.annotation import AnnotationStore
         self.annotations = AnnotationStore()
         from opentsdb_tpu.meta.meta_store import MetaStore
@@ -123,6 +125,14 @@ class TSDB:
         from opentsdb_tpu.parallel.mesh import parse_mesh_spec
         parse_mesh_spec(self._query_mesh_spec)  # fail fast on typos
         self._query_mesh = None
+        # device-resident grid cache (HBM ≙ HBase block cache); lazy
+        self._device_grid_cache = None
+        self._device_cache_lock = threading.Lock()
+        self._device_cache_mb = self.config.get_int(
+            "tsd.query.device_cache_mb", 1024)
+        # host-side per-(store, metric) TagMatrix cache, invalidated by
+        # series count (the metric index is append-only)
+        self._tagmat_cache: dict = {}
         from opentsdb_tpu.stats.stats import StatsCollectorRegistry
         self.stats = StatsCollectorRegistry()
         self.datapoints_added = 0
@@ -417,6 +427,7 @@ class TSDB:
         with self._histogram_lock:
             lst = self._histogram_series.setdefault(sid, [])
             lst.append((ts_ms, hist))
+            self._histogram_version += 1
         self.datapoints_added += 1
         return sid
 
@@ -444,6 +455,22 @@ class TSDB:
             if self._query_mesh is None:  # single device: stop retrying
                 self._query_mesh_spec = ""
         return self._query_mesh
+
+    @property
+    def device_grid_cache(self):
+        """Device-resident [S, B] grid cache (see
+        :mod:`opentsdb_tpu.query.device_cache`), or None when disabled
+        (``tsd.query.device_cache_mb = 0``)."""
+        if self._device_grid_cache is None and self._device_cache_mb:
+            with self._device_cache_lock:
+                if self._device_grid_cache is None:
+                    from opentsdb_tpu.query.device_cache import \
+                        DeviceGridCache
+                    cache = DeviceGridCache(
+                        self._device_cache_mb * (1 << 20))
+                    self.stats.register(cache)
+                    self._device_grid_cache = cache
+        return self._device_grid_cache
 
     def new_query(self):
         from opentsdb_tpu.query.engine import QueryEngine
@@ -487,8 +514,10 @@ class TSDB:
             self.search_plugin.shutdown()
 
     def drop_caches(self) -> None:
-        """(ref: TSDB.dropCaches) UID caches are authoritative here, so
-        this is a no-op kept for API parity."""
+        """(ref: TSDB.dropCaches) UID caches are authoritative here;
+        the device-resident grid cache is droppable."""
+        if self._device_grid_cache is not None:
+            self._device_grid_cache.clear()
 
     # ------------------------------------------------------------------
     # stats (ref: TSDB.collectStats :753)

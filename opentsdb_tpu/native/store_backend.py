@@ -32,12 +32,17 @@ class NativeBuildError(RuntimeError):
 
 
 def build_library(force: bool = False) -> str:
-    """Compile libtsdbstore.so if needed; returns its path."""
+    """Compile libtsdbstore.so if needed; returns its path.
+
+    Built on demand on the host that uses it (-march=native is safe
+    because the .so never ships to another machine); staleness checks
+    both the C++ source and THIS file (the build flags live here)."""
+    newest_src = max(os.path.getmtime(_SRC), os.path.getmtime(__file__))
     if not force and os.path.isfile(_LIB_PATH) and \
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+            os.path.getmtime(_LIB_PATH) >= newest_src:
         return _LIB_PATH
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _LIB_PATH]
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-std=c++17", "-pthread", _SRC, "-o", _LIB_PATH]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=180)
@@ -93,6 +98,13 @@ def load_library():
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_int]
+        lib.tss_bucket_reduce.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int]
+        lib.tss_bucket_reduce.restype = ctypes.c_int
         _lib = lib
         return lib
 
@@ -165,6 +177,9 @@ class NativeTimeSeriesStore:
         self._records: list[_NativeSeriesRecord] = []
         self._key_to_sid: dict[tuple, int] = {}
         self._metric_index: dict[int, MetricIndex] = {}
+        # destructive-op version for read-side caches (cf. the Python
+        # backend's counterpart)
+        self.mutation_epoch = 0
 
     def __del__(self):
         try:
@@ -322,6 +337,31 @@ class NativeTimeSeriesStore:
         return PaddedBatch(sids, values2d.reshape(len(sids), pmax),
                            ts2d.reshape(len(sids), pmax), counts)
 
+    def bucket_reduce(self, series_ids, start_ms: int, end_ms: int,
+                      t0: int, interval_ms: int, nbuckets: int,
+                      want_minmax: bool = False):
+        """Fused range-scan + fixed-interval pre-reduction: one C++
+        pass returns [S, B] sum/count (and min/max on request) grids —
+        the device then starts at the grid stage of the pipeline
+        instead of receiving every point (SURVEY §7: HBM bandwidth is
+        the bottleneck; don't ship what the host can pre-reduce 60x)."""
+        sids = np.ascontiguousarray(series_ids, dtype=np.int64)
+        s = len(sids)
+        sums = np.empty((s, nbuckets), dtype=np.float64)
+        cnts = np.empty((s, nbuckets), dtype=np.float64)
+        mins = maxs = None
+        pmin = pmax = None
+        if want_minmax:
+            mins = np.empty((s, nbuckets), dtype=np.float64)
+            maxs = np.empty((s, nbuckets), dtype=np.float64)
+            pmin, pmax = _ptr(mins), _ptr(maxs)
+        rc = self._lib.tss_bucket_reduce(
+            self._h, _ptr(sids), s, start_ms, end_ms, t0, interval_ms,
+            nbuckets, _ptr(sums), _ptr(cnts), pmin, pmax, self.threads)
+        if rc != 0:
+            raise IndexError("invalid series id in bucket_reduce")
+        return sums, cnts, mins, maxs
+
     def shards_of(self, series_ids: Iterable[int]) -> np.ndarray:
         return np.asarray([self._records[s].shard for s in series_ids],
                           dtype=np.int32)
@@ -334,6 +374,8 @@ class NativeTimeSeriesStore:
                                                start_ms, end_ms))
             if n > 0:
                 deleted += n
+        if deleted:
+            self.mutation_epoch += 1
         return deleted
 
     def total_points(self) -> int:

@@ -359,4 +359,109 @@ int tss_fill_range(void* h, const int64_t* sids, int64_t nsids,
   return 0;
 }
 
+// Fused range-scan + fixed-interval downsample pre-reduction: for
+// each series i, every point with start_ms <= ts <= end_ms lands in
+// bucket b = (ts - t0) / interval_ms (caller guarantees t0 <= start_ms
+// and the last bucket covers end_ms), accumulating sum / count / min /
+// max. Outputs are [nsids, nbuckets] row-major; cells with count 0
+// hold sum 0, min +inf, max -inf (the Python wrapper NaN-fills).
+// NaN stored values are skipped, matching the device bucketize's NaN
+// guard (ref: Aggregators.runDouble skipping NaN). min_out/max_out may
+// be null when the caller only needs sum/count. Threaded over series.
+// Returns -1 on a bad sid, else 0.
+//
+// This removes the [N]-point materialize + host->device upload for
+// simple-function downsamples: the device receives S*B cells instead
+// of N points (60x smaller for 1m data in 1h buckets) and starts at
+// the grid stage of the pipeline.
+int tss_bucket_reduce(void* h, const int64_t* sids, int64_t nsids,
+                      int64_t start_ms, int64_t end_ms, int64_t t0,
+                      int64_t interval_ms, int64_t nbuckets,
+                      double* sum_out, double* cnt_out, double* min_out,
+                      double* max_out, int threads) {
+  Store* s = static_cast<Store*>(h);
+  std::vector<SeriesBuffer*> bufs;
+  if (!s->snapshot(sids, nsids, &bufs)) return -1;
+  if (interval_ms <= 0 || nbuckets <= 0) return -1;
+  if (threads < 1) threads = 1;
+  std::atomic<int64_t> next{0};
+  const double inf = std::numeric_limits<double>::infinity();
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= nsids) break;
+      double* srow = sum_out + i * nbuckets;
+      double* crow = cnt_out + i * nbuckets;
+      double* mnrow = min_out ? min_out + i * nbuckets : nullptr;
+      double* mxrow = max_out ? max_out + i * nbuckets : nullptr;
+      for (int64_t b = 0; b < nbuckets; ++b) {
+        srow[b] = 0.0;
+        crow[b] = 0.0;
+        if (mnrow) mnrow[b] = inf;
+        if (mxrow) mxrow[b] = -inf;
+      }
+      SeriesBuffer* buf = bufs[i];
+      std::lock_guard<std::mutex> lock(buf->mu);
+      buf->ensure_sorted_locked();
+      int64_t lo =
+          std::lower_bound(buf->ts.begin(), buf->ts.end(), start_ms) -
+          buf->ts.begin();
+      int64_t hi =
+          std::upper_bound(buf->ts.begin(), buf->ts.end(), end_ms) -
+          buf->ts.begin();
+      // timestamps are sorted: resolve each bucket's point range with
+      // a binary search, then accumulate over a fixed-bound inner loop
+      // the compiler can vectorize (no per-point divide or
+      // data-dependent exit). The NaN guard is a branchless blend.
+      const int64_t* tsd = buf->ts.data();
+      const double* vd = buf->vals.data();
+      int64_t p = lo;
+      while (p < hi) {
+        // floor division (C++ '/' truncates toward zero): a point just
+        // below t0 must be DROPPED like the Python twin's '//' does,
+        // not folded into bucket 0
+        int64_t d = tsd[p] - t0;
+        int64_t b = d >= 0 ? d / interval_ms : -1;
+        if (b < 0) {  // cannot happen when t0 <= start_ms; be safe
+          ++p;
+          continue;
+        }
+        if (b >= nbuckets) break;
+        int64_t bucket_end = t0 + (b + 1) * interval_ms;
+        int64_t pe =
+            std::lower_bound(tsd + p, tsd + hi, bucket_end) - tsd;
+        double sum = 0.0, cnt = 0.0;
+        if (mnrow) {
+          double mn = inf, mx = -inf;
+          for (int64_t q = p; q < pe; ++q) {
+            double v = vd[q];
+            bool ok = v == v;
+            sum += ok ? v : 0.0;
+            cnt += ok ? 1.0 : 0.0;
+            mn = (ok && v < mn) ? v : mn;
+            mx = (ok && v > mx) ? v : mx;
+          }
+          mnrow[b] = mn;
+          mxrow[b] = mx;
+        } else {
+          for (int64_t q = p; q < pe; ++q) {
+            double v = vd[q];
+            bool ok = v == v;
+            sum += ok ? v : 0.0;
+            cnt += ok ? 1.0 : 0.0;
+          }
+        }
+        srow[b] = sum;
+        crow[b] = cnt;
+        p = pe;
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
 }  // extern "C"

@@ -189,6 +189,56 @@ def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
     return result, emit
 
 
+@partial(jax.jit, static_argnames=("spec",))
+def run_pipeline_grid(grid, has_data, bucket_ts, group_ids, rate_params,
+                      fill_value, spec: PipelineSpec):
+    """Tail entry for host-pre-bucketized data: the storage engine's
+    fused range-scan already produced the ``[S, B]`` downsample grid
+    (NaN holes), so the trace starts at the fill/rate/aggregate chain —
+    no per-point upload at all."""
+    return _finish_pipeline(grid, has_data, bucket_ts, group_ids,
+                            rate_params, fill_value, spec)
+
+
+def pipeline_dtype():
+    """The compute dtype every host entry uses (f64 only under x64)."""
+    return jnp.float64 if jax.config.read("jax_enable_x64") \
+        else jnp.float32
+
+
+def put_grid(grid, has_data, device=None):
+    """Upload a [S, B] grid + presence mask once, in the compute dtype
+    — callers cache the returned DEVICE arrays so repeated queries
+    skip the host scan and the transfer entirely."""
+    dtype = pipeline_dtype()
+    return (jax.device_put(jnp.asarray(grid, dtype=dtype),
+                           device=device),
+            jax.device_put(jnp.asarray(has_data, dtype=bool),
+                           device=device))
+
+
+def execute_grid(grid: np.ndarray, has_data: np.ndarray,
+                 bucket_ts: np.ndarray, group_ids: np.ndarray,
+                 spec: PipelineSpec,
+                 rate_options: RateOptions | None = None,
+                 dtype=None, device=None
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Host entry over a pre-bucketized [S, B] grid -> (result, emit)."""
+    if dtype is None:
+        dtype = pipeline_dtype()
+    ro = rate_options or RateOptions()
+    put = partial(jax.device_put, device=device)
+    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
+                   jnp.asarray(ro.reset_value, dtype=dtype))
+    result, emit = run_pipeline_grid(
+        put(jnp.asarray(grid, dtype=dtype)),
+        put(jnp.asarray(has_data, dtype=bool)),
+        put(jnp.asarray(device_bucket_ts(bucket_ts))),
+        put(jnp.asarray(group_ids, dtype=jnp.int32)),
+        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), spec)
+    return np.asarray(result), np.asarray(emit)
+
+
 def avg_divide_grid(grid_sum, grid_cnt, xp=jnp):
     """The rollup-average derivation shared by the single-device trace
     (:func:`run_pipeline_avg_div`) and the mesh path's host-side
@@ -222,8 +272,7 @@ def execute_avg_divide(grid_sum, grid_cnt, bucket_ts: np.ndarray,
     """Host entry: sum/count tier grids (device arrays straight from
     ``bucketize`` are fine) -> (result, emit)."""
     if dtype is None:
-        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
-            else jnp.float32
+        dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
     put = partial(jax.device_put, device=device)
     rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
@@ -367,8 +416,7 @@ def execute_auto(padded, bucket_idx2d: np.ndarray,
     for irregular data it supports, and the flat scatter path otherwise.
     """
     if dtype is None:
-        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
-            else jnp.float32
+        dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
     values2d = np.asarray(padded.values2d)
     counts = np.asarray(padded.counts)
@@ -399,6 +447,102 @@ def execute_auto(padded, bucket_idx2d: np.ndarray,
                    use_pallas=use_pallas)
 
 
+@dataclass(frozen=True)
+class PreparedBatch:
+    """Device-resident upload of one sub-query's point data, ready to
+    execute repeatedly — the engine caches these so a warm query pays
+    neither the host materialize nor the transfer (which dominates on
+    shared/tunneled devices).
+
+    kind 'dense': arrays = (values2d,), k = points per bucket;
+    kind 'padded': arrays = (values2d, bucket_idx2d);
+    kind 'flat': arrays = (values, series_idx, bucket_idx).
+    """
+    kind: str
+    arrays: tuple
+    k: int | None = None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(getattr(a, "nbytes", 0) for a in self.arrays)
+
+
+def prepare_auto(padded, bucket_idx2d: np.ndarray, spec: PipelineSpec,
+                 dtype=None, device=None) -> PreparedBatch:
+    """Layout-detect + upload a PaddedBatch (the same dispatch rules as
+    :func:`execute_auto`, minus the pallas micro-path)."""
+    if dtype is None:
+        dtype = pipeline_dtype()
+    put = partial(jax.device_put, device=device)
+    values2d = np.asarray(padded.values2d)
+    counts = np.asarray(padded.counts)
+    bucket_idx2d = np.asarray(bucket_idx2d)
+    k = detect_regular_padded(counts, bucket_idx2d, spec.num_buckets)
+    if k is not None and spec.ds_function in _DENSE_FNS:
+        return PreparedBatch(
+            "dense", (put(jnp.asarray(values2d, dtype=dtype)),), k)
+    cells = values2d.shape[0] * values2d.shape[1] * spec.num_buckets
+    if ds_mod.padded_supported(spec.ds_function, spec.num_buckets) \
+            and cells <= _PADDED_EINSUM_MAX_CELLS:
+        return PreparedBatch(
+            "padded", (put(jnp.asarray(values2d, dtype=dtype)),
+                       put(jnp.asarray(bucket_idx2d,
+                                       dtype=jnp.int32))))
+    values, series_idx, bucket_idx = flatten_padded(
+        values2d, bucket_idx2d, counts)
+    return prepare_flat(values, series_idx, bucket_idx, spec,
+                        dtype=dtype, device=device)
+
+
+def prepare_flat(values: np.ndarray, series_idx: np.ndarray,
+                 bucket_idx: np.ndarray, spec: PipelineSpec,
+                 dtype=None, device=None) -> PreparedBatch:
+    """Layout-detect + upload a flat point batch."""
+    if dtype is None:
+        dtype = pipeline_dtype()
+    put = partial(jax.device_put, device=device)
+    k = detect_dense(spec.num_series, spec.num_buckets,
+                     np.asarray(series_idx), np.asarray(bucket_idx),
+                     spec.ds_function)
+    if k is not None:
+        values2d = np.asarray(values).reshape(spec.num_series, -1)
+        return PreparedBatch(
+            "dense", (put(jnp.asarray(values2d, dtype=dtype)),), k)
+    return PreparedBatch(
+        "flat", (put(jnp.asarray(values, dtype=dtype)),
+                 put(jnp.asarray(series_idx, dtype=jnp.int32)),
+                 put(jnp.asarray(bucket_idx, dtype=jnp.int32))))
+
+
+def run_prepared(prep: PreparedBatch, bucket_ts: np.ndarray,
+                 group_ids: np.ndarray, spec: PipelineSpec,
+                 rate_options: RateOptions | None = None,
+                 dtype=None, device=None
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Execute a (possibly cached) PreparedBatch -> (result, emit)."""
+    if dtype is None:
+        dtype = pipeline_dtype()
+    ro = rate_options or RateOptions()
+    put = partial(jax.device_put, device=device)
+    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
+                   jnp.asarray(ro.reset_value, dtype=dtype))
+    fv = jnp.asarray(spec.fill_value, dtype=dtype)
+    bts = put(jnp.asarray(device_bucket_ts(bucket_ts)))
+    gids = put(jnp.asarray(group_ids, dtype=jnp.int32))
+    if prep.kind == "dense":
+        result, emit = run_pipeline_dense(
+            prep.arrays[0], bts, gids, rate_params, fv, spec, prep.k)
+    elif prep.kind == "padded":
+        result, emit = run_pipeline_padded(
+            prep.arrays[0], prep.arrays[1], bts, gids, rate_params,
+            fv, spec)
+    else:
+        result, emit = run_pipeline(
+            prep.arrays[0], prep.arrays[1], prep.arrays[2], bts, gids,
+            rate_params, fv, spec)
+    return np.asarray(result), np.asarray(emit)
+
+
 def execute(batch_values: np.ndarray, series_idx: np.ndarray,
             bucket_idx: np.ndarray, bucket_ts: np.ndarray,
             group_ids: np.ndarray, spec: PipelineSpec,
@@ -412,8 +556,7 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
     fused Pallas kernel (:mod:`opentsdb_tpu.ops.pallas_fused`) when the
     data is complete and the op combination is MXU-reducible."""
     if dtype is None:
-        dtype = jnp.float64 if jax.config.read("jax_enable_x64") \
-            else jnp.float32
+        dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
     put = partial(jax.device_put, device=device)
     rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),

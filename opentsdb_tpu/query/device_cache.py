@@ -1,0 +1,80 @@
+"""Device-resident grid cache: HBM as the tier/block cache.
+
+The reference keeps hot HBase blocks in the region server's block
+cache so repeated scans don't touch disk; the TPU-native analogue
+keeps the query's pre-bucketized ``[S, B]`` grids resident in device
+HBM so repeated queries over the same window don't re-scan the host
+store or re-upload (host->device transfer is the dominant cost of a
+warm query — on shared/tunneled devices by an order of magnitude).
+
+Entries are keyed by the exact reduction parameters and invalidated by
+the store's mutation version (every write or delete bumps it), so a
+hit is always bit-identical to a fresh scan. Bounded LRU by device
+bytes (``tsd.query.device_cache_mb``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any
+
+
+def array_digest(arr) -> bytes:
+    """Content fingerprint of an index array (sids, group_ids)."""
+    return hashlib.blake2b(memoryview(arr), digest_size=16).digest()
+
+
+class DeviceGridCache:
+    """LRU of device arrays keyed by (reduction params, store version)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        # key -> (version, arrays: tuple, meta: dict, nbytes: int)
+        self._entries: OrderedDict[Any, tuple] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, version):
+        """(arrays, meta) on hit with a matching version, else None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[0] != version:
+                if entry is not None:  # stale: the store changed
+                    self._bytes -= entry[3]
+                    del self._entries[key]
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[1], entry[2]
+
+    def put(self, key, version, arrays: tuple, meta: dict) -> None:
+        nbytes = sum(getattr(a, "nbytes", 0) for a in arrays
+                     if a is not None)
+        if nbytes > self.max_bytes:
+            return  # larger than the whole cache: don't thrash
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[3]
+            self._entries[key] = (version, arrays, meta, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, _, _, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def collect_stats(self, collector) -> None:
+        collector.record("query.devicecache.bytes", self._bytes)
+        collector.record("query.devicecache.entries",
+                         len(self._entries))
+        collector.record("query.devicecache.hits", self.hits)
+        collector.record("query.devicecache.misses", self.misses)

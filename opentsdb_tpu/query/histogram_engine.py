@@ -9,6 +9,13 @@ into a dense ``[points, buckets]`` count matrix; merge-by-timestamp and
 group-by are segment-sums over the leading axis, and percentile
 extraction is a vectorized cumsum + searchsorted over the bucket axis —
 see :func:`percentiles_from_counts`.
+
+Downsampling (ref: ``HistogramDownsampler.java`` wrapping each span
+before the group merge): histogram aggregation is bucket-wise SUM both
+across series and across time (``HistogramAggregation.java:20`` — SUM is
+the only defined merge), so downsample-then-merge collapses into ONE
+segment-sum keyed by (group, time-bucket) — the time axis just uses
+downsample bucket indices instead of distinct-timestamp indices.
 """
 
 from __future__ import annotations
@@ -37,9 +44,29 @@ def percentiles_from_counts(counts: np.ndarray, bounds: np.ndarray,
     return out
 
 
+def _time_axis(point_ts: np.ndarray, tsq: TSQuery, sub: TSSubQuery
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(time_idx[N], ts_out[T], in_range[N]) for the histogram batch:
+    downsample bucket indices when the sub-query has a downsample spec
+    (ref: HistogramDownsampler), else one slot per distinct timestamp
+    (ref: the raw HistogramAggregationIterator union merge)."""
+    if sub.ds_spec is not None:
+        from opentsdb_tpu.ops import downsample as ds_mod
+        bucket_idx, bucket_ts = ds_mod.assign_buckets(
+            point_ts, sub.ds_spec, tsq.start_ms, tsq.end_ms)
+        bucket_idx = np.asarray(bucket_idx)
+        bucket_ts = np.asarray(bucket_ts)
+        # points are pre-filtered to the window, but guard the bucket
+        # range anyway (assign_buckets assumes in-range input)
+        return (bucket_idx, bucket_ts,
+                (bucket_idx >= 0) & (bucket_idx < len(bucket_ts)))
+    ts_sorted, ts_idx = np.unique(point_ts, return_inverse=True)
+    return ts_idx, ts_sorted, np.ones(len(point_ts), dtype=bool)
+
+
 def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
     """Execute a percentile sub-query over stored histogram datapoints."""
-    from opentsdb_tpu.query.engine import QueryResult, _common_tags
+    from opentsdb_tpu.query.engine import QueryEngine, TagMatrix
     uids = tsdb.uids
     try:
         metric_id = uids.metrics.get_id(sub.metric)
@@ -52,56 +79,93 @@ def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
         return []
     # filters reuse the scalar evaluator over the histogram store's index
     from opentsdb_tpu.query.filters import FilterEvaluator
+    idx = store.metric_index(metric_id)
+    _, triples = idx.arrays()
+    tag_mat = TagMatrix.from_triples(sids, triples)
     if sub.filters:
-        idx = store.metric_index(metric_id)
-        _, triples = idx.arrays()
         mask = FilterEvaluator(uids).apply(sub.filters, sids, triples)
         sids = sids[mask]
+        tag_mat = tag_mat.select(mask)
         if len(sids) == 0:
             return []
-    series_tags = [dict(store.series(int(s)).tags) for s in sids]
 
     gb_kids = sorted({uids.tag_names.get_id(f.tagk)
                       for f in sub.filters if f.group_by
                       and uids.tag_names.has_name(f.tagk)})
-    from opentsdb_tpu.query.engine import QueryEngine
-    group_ids, group_keys = QueryEngine._group_ids(series_tags, gb_kids)
+    group_ids, num_groups = QueryEngine._group_ids(tag_mat, gb_kids)
 
-    # collect the window's histogram points as one flat [N, NB] batch
-    point_counts: list[np.ndarray] = []
-    point_group: list[int] = []
-    point_ts: list[int] = []
+    # collect the window's histogram points as one flat [N, NB] batch.
+    # The collected batch (counts matrix device-resident) is cached by
+    # write version: the per-series object walk and the upload are the
+    # whole cost at scale (ref analogue: scan result block caching).
+    cache = tsdb.device_grid_cache
+    ckey = cver = None
+    counts = point_sidx = point_ts_arr = None
     bounds: tuple | None = None
-    uniform = True
-    for i in range(len(sids)):
-        for ts_ms, hist in tsdb._histogram_series.get(int(sids[i]), []):
-            if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
-                continue
-            b = tuple(hist.bounds)
-            if bounds is None:
-                bounds = b
-            elif b != bounds:
-                uniform = False
-            point_counts.append(hist.counts_array())
-            point_group.append(int(group_ids[i]))
-            point_ts.append(ts_ms)
-    if not point_counts or bounds is None:
-        return []
-    if not uniform:
-        return _run_mixed_bounds(tsdb, tsq, sub, sids, series_tags,
-                                 group_ids, group_keys)
+    if cache is not None:
+        from opentsdb_tpu.query.device_cache import array_digest
+        ckey = ("hist", array_digest(np.ascontiguousarray(sids)),
+                tsq.start_ms, tsq.end_ms)
+        cver = tsdb._histogram_version
+        hit = cache.get(ckey, cver)
+        if hit is not None:
+            (counts,), meta = hit
+            point_sidx = meta["point_sidx"]
+            point_ts_arr = meta["point_ts"]
+            bounds = meta["bounds"]
+    if counts is None:
+        point_counts: list[np.ndarray] = []
+        point_sidx_l: list[int] = []
+        point_ts_l: list[int] = []
+        uniform = True
+        with tsdb._histogram_lock:
+            series_pts = [list(tsdb._histogram_series.get(int(s), []))
+                          for s in sids]
+        for i in range(len(sids)):
+            for ts_ms, hist in series_pts[i]:
+                if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
+                    continue
+                b = tuple(hist.bounds)
+                if bounds is None:
+                    bounds = b
+                elif b != bounds:
+                    uniform = False
+                point_counts.append(hist.counts_array())
+                point_sidx_l.append(i)
+                point_ts_l.append(ts_ms)
+        if not point_counts or bounds is None:
+            return []
+        if not uniform:
+            return _run_mixed_bounds(tsdb, tsq, sub, series_pts,
+                                     tag_mat, group_ids, num_groups)
+        counts = np.stack(point_counts)
+        point_sidx = np.asarray(point_sidx_l, dtype=np.int64)
+        point_ts_arr = np.asarray(point_ts_l, dtype=np.int64)
+        if cache is not None:
+            import jax
+            import jax.numpy as jnp
+            counts = jax.device_put(
+                jnp.asarray(counts, dtype=jnp.float32))
+            cache.put(ckey, cver, (counts,), {
+                "point_sidx": point_sidx, "point_ts": point_ts_arr,
+                "bounds": bounds})
 
     # device path (uniform bounds): merge = one-hot MXU contraction,
-    # percentiles = cumsum + rank compare — ops.histogram_kernels
+    # percentiles = cumsum + rank compare — ops.histogram_kernels.
+    # The time axis is downsample buckets when ds_spec is set
+    # (HistogramDownsampler parity), else the distinct-timestamp union.
     from opentsdb_tpu.ops.histogram_kernels import \
         histogram_percentile_pipeline
-    ts_sorted, ts_idx = np.unique(np.asarray(point_ts, dtype=np.int64),
-                                  return_inverse=True)
-    num_ts = len(ts_sorted)
-    num_groups = len(group_keys)
-    gvec = np.asarray(point_group, dtype=np.int64)
-    seg = (gvec * num_ts + ts_idx).astype(np.int32)
-    counts = np.stack(point_counts)
+    time_idx, ts_out_arr, in_range = _time_axis(point_ts_arr, tsq, sub)
+    gvec = np.asarray(group_ids, dtype=np.int64)[point_sidx]
+    if not in_range.all():
+        counts = np.asarray(counts)[in_range]
+        gvec = gvec[in_range]
+        time_idx = time_idx[in_range]
+    if counts.shape[0] == 0:
+        return []
+    num_ts = len(ts_out_arr)
+    seg = (gvec * num_ts + time_idx).astype(np.int32)
     pcts = histogram_percentile_pipeline(
         counts, seg, num_groups * num_ts, np.asarray(bounds),
         sub.percentiles)                       # [Q, G*T]
@@ -109,18 +173,32 @@ def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
     present = np.bincount(seg, minlength=num_groups * num_ts) \
         .reshape(num_groups, num_ts) > 0
 
+    return _emit_groups(tsdb, tsq, sub, tag_mat, group_ids, num_groups,
+                        ts_out_arr, present, pcts)
+
+
+def _emit_groups(tsdb, tsq, sub, tag_mat, group_ids, num_groups,
+                 ts_arr, present, pcts) -> list:
+    """Shared emission: one QueryResult per (group, percentile)."""
+    from opentsdb_tpu.query.engine import QueryResult, _common_tags
+    uids = tsdb.uids
+    order = np.argsort(group_ids, kind="stable")
+    sorted_gids = group_ids[order]
+    gid_range = np.arange(num_groups, dtype=group_ids.dtype)
+    starts = np.searchsorted(sorted_gids, gid_range, side="left")
+    ends = np.searchsorted(sorted_gids, gid_range, side="right")
+    ts_list = (ts_arr if tsq.ms_resolution
+               else (ts_arr // 1000) * 1000).tolist()
     out = []
     for gid in range(num_groups):
-        members = [i for i in range(len(sids)) if group_ids[i] == gid]
-        if not members or not present[gid].any():
+        members = order[starts[gid]:ends[gid]]
+        if len(members) == 0 or not present[gid].any():
             continue
-        tags, agg_tags = _common_tags(
-            [series_tags[m] for m in members], uids)
+        tags, agg_tags = _common_tags(tag_mat, members, uids)
+        sel = np.nonzero(present[gid])[0]
         for qi, q in enumerate(sub.percentiles):
-            dps = [((int(t) // 1000) * 1000 if not tsq.ms_resolution
-                    else int(t), float(pcts[qi, gid, ti]))
-                   for ti, t in enumerate(ts_sorted)
-                   if present[gid, ti]]
+            vals = pcts[qi, gid, sel].tolist()
+            dps = [(ts_list[t], v) for t, v in zip(sel.tolist(), vals)]
             out.append(QueryResult(
                 metric=f"{sub.metric}_pct_{q:g}", tags=tags,
                 aggregated_tags=agg_tags, dps=dps,
@@ -128,36 +206,60 @@ def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
     return out
 
 
-def _run_mixed_bounds(tsdb, tsq, sub, sids, series_tags, group_ids,
-                      group_keys) -> list:
+def _run_mixed_bounds(tsdb, tsq, sub, series_pts, tag_mat, group_ids,
+                      num_groups) -> list:
     """Host fallback when histograms in the window disagree on bucket
-    bounds: per-group dict merge like the reference's iterator chain."""
+    bounds: per-group dict merge like the reference's iterator chain.
+    With a downsample spec, points merge into their downsample bucket
+    (bounds must agree within a bucket, like the reference's
+    HistogramDownsampler SUM over one interval)."""
     from opentsdb_tpu.query.engine import QueryResult, _common_tags
+    from opentsdb_tpu.ops import downsample as ds_mod
     uids = tsdb.uids
+    order = np.argsort(group_ids, kind="stable")
+    sorted_gids = group_ids[order]
+    gid_range = np.arange(num_groups, dtype=group_ids.dtype)
+    starts = np.searchsorted(sorted_gids, gid_range, side="left")
+    ends = np.searchsorted(sorted_gids, gid_range, side="right")
     out = []
-    for gid in range(len(group_keys)):
-        members = [i for i in range(len(sids)) if group_ids[i] == gid]
-        if not members:
+    for gid in range(num_groups):
+        members = order[starts[gid]:ends[gid]]
+        if len(members) == 0:
             continue
-        # merge per timestamp, each timestamp keeping its own bucket
+        # merge per output timestamp, each keeping its own bucket
         # bounds (the reference merges Histogram objects per emitted
         # timestamp; bounds only need to agree across series AT one ts)
         merged: dict[int, tuple[tuple, np.ndarray]] = {}
         for i in members:
-            for ts_ms, hist in tsdb._histogram_series.get(int(sids[i]), []):
-                if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
+            pts = series_pts[int(i)]
+            if not pts:
+                continue
+            ts_arr = np.asarray([t for t, _ in pts], dtype=np.int64)
+            ok = (ts_arr >= tsq.start_ms) & (ts_arr <= tsq.end_ms)
+            if sub.ds_spec is not None:
+                bidx, bts = ds_mod.assign_buckets(
+                    ts_arr, sub.ds_spec, tsq.start_ms, tsq.end_ms)
+                bidx = np.asarray(bidx)
+                bts = np.asarray(bts)
+                ok &= (bidx >= 0) & (bidx < len(bts))
+                slot_ts = np.where(ok, bts[np.clip(bidx, 0,
+                                                   len(bts) - 1)], -1)
+            else:
+                slot_ts = np.where(ok, ts_arr, -1)
+            for (_, hist), slot in zip(pts, slot_ts.tolist()):
+                if slot < 0:
                     continue
                 arr = hist.counts_array()
                 b = tuple(hist.bounds)
-                if ts_ms in merged:
-                    b0, acc = merged[ts_ms]
+                if slot in merged:
+                    b0, acc = merged[slot]
                     if b0 != b:
                         raise BadRequestError(
                             "cannot merge histograms with different "
-                            f"buckets at timestamp {ts_ms}")
-                    merged[ts_ms] = (b0, acc + arr)
+                            f"buckets at timestamp {slot}")
+                    merged[slot] = (b0, acc + arr)
                 else:
-                    merged[ts_ms] = (b, arr)
+                    merged[slot] = (b, arr)
         if not merged:
             continue
         ts_sorted = sorted(merged)
@@ -167,8 +269,7 @@ def _run_mixed_bounds(tsdb, tsq, sub, sids, series_tags, group_ids,
                 np.asarray(merged[t][0], dtype=np.float64),
                 sub.percentiles)[:, 0]
             for t in ts_sorted], axis=1)       # [Q, T]
-        tags, agg_tags = _common_tags(
-            [series_tags[m] for m in members], uids)
+        tags, agg_tags = _common_tags(tag_mat, members, uids)
         for qi, q in enumerate(sub.percentiles):
             dps = [((t // 1000) * 1000 if not tsq.ms_resolution else t,
                     float(pcts[qi, ti]))

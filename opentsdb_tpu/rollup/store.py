@@ -27,6 +27,8 @@ class RollupStore:
         # (interval, agg) -> store
         self._tiers: dict[tuple[str, str], TimeSeriesStore] = {}
         self._preagg = self._factory()
+        # (interval, agg) -> (mutation_epoch, points_written, result)
+        self._has_data_cache: dict[tuple[str, str], tuple] = {}
 
     def tier(self, interval: str, agg: str) -> TimeSeriesStore:
         agg = agg.lower()
@@ -58,5 +60,30 @@ class RollupStore:
         return self._preagg
 
     def has_data(self, interval: str, agg: str) -> bool:
-        store = self._tiers.get((interval, agg.lower()))
-        return store is not None and store.total_points() > 0
+        """O(1) in steady state: points_written is a cheap counter on
+        both backends while total_points() walks every series (seconds
+        at 1M series) and this check runs on EVERY query's tier
+        selection. Writes only ever add data, so a True verdict stays
+        valid until a destructive op bumps mutation_epoch — only then
+        does the expensive emptiness walk rerun (a tier fully emptied
+        by delete=true must stop winning tier selection)."""
+        key = (interval, agg.lower())
+        store = self._tiers.get(key)
+        if store is None:
+            return False
+        pw = store.points_written
+        if pw == 0:
+            return False
+        ep = getattr(store, "mutation_epoch", 0)
+        cached = self._has_data_cache.get(key)
+        if cached is not None and cached[0] == ep:
+            if cached[2]:
+                return True
+            if pw == cached[1]:
+                return False
+            # writes landed since the False verdict: data exists now
+            self._has_data_cache[key] = (ep, pw, True)
+            return True
+        res = store.total_points() > 0
+        self._has_data_cache[key] = (ep, pw, res)
+        return res

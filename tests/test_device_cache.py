@@ -1,0 +1,242 @@
+"""Device-resident grid/batch cache (query.device_cache) and the
+storage-side bucket pre-reduction: correctness of invalidation (a hit
+must be bit-identical to a fresh scan) and backend equivalence."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+BASE = 1356998400
+
+
+def _tsdb(**extra):
+    return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          **extra}))
+
+
+def _q(agg="sum", ds="1m-avg", start=BASE, end=BASE + 3000):
+    return TSQuery.from_json({
+        "start": start * 1000, "end": end * 1000,
+        "queries": [{"metric": "m", "aggregator": agg,
+                     "downsample": ds}]}).validate()
+
+
+def _seed(t, n=5, pts=50):
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        ts = BASE + np.sort(rng.choice(3000, pts, replace=False))
+        t.add_points("m", ts, rng.normal(10, 3, pts),
+                     {"host": f"h{i}"})
+
+
+class TestDeviceCacheInvalidation:
+    def test_write_invalidates(self):
+        t = _tsdb()
+        _seed(t)
+        r1 = t.execute_query(_q())
+        r1b = t.execute_query(_q())        # warm hit
+        assert [x.dps for x in r1] == [x.dps for x in r1b]
+        cache = t.device_grid_cache
+        assert cache.hits >= 1
+        # a new point must change the answer (no stale grid)
+        t.add_point("m", BASE + 10, 1000.0, {"host": "h0"})
+        r2 = t.execute_query(_q())
+        assert [x.dps for x in r2] != [x.dps for x in r1]
+
+    def test_delete_invalidates(self):
+        t = _tsdb()
+        _seed(t)
+        r1 = t.execute_query(_q())
+        mid = t.uids.metrics.get_id("m")
+        sids = t.store.series_ids_for_metric(mid)
+        t.store.delete_range(sids, BASE * 1000, (BASE + 100) * 1000)
+        r2 = t.execute_query(_q())
+        assert [x.dps for x in r2] != [x.dps for x in r1]
+
+    def test_union_grid_path_cached_and_invalidated(self):
+        t = _tsdb()
+        _seed(t)
+        q = TSQuery.from_json({
+            "start": BASE * 1000, "end": (BASE + 3000) * 1000,
+            "queries": [{"metric": "m", "aggregator": "sum"}]}) \
+            .validate()
+        r1 = t.execute_query(q)
+        r1b = t.execute_query(q)
+        assert [x.dps for x in r1] == [x.dps for x in r1b]
+        t.add_point("m", BASE + 7, 77.0, {"host": "h1"})
+        r2 = t.execute_query(q)
+        assert [x.dps for x in r2] != [x.dps for x in r1]
+
+    def test_different_agg_reuses_prepared_batch(self):
+        # the prepared-batch key excludes the aggregator: sum and max
+        # over the same window share the upload
+        t = _tsdb()
+        _seed(t)
+        q_sum = TSQuery.from_json({
+            "start": BASE * 1000, "end": (BASE + 3000) * 1000,
+            "queries": [{"metric": "m", "aggregator": "sum"}]}) \
+            .validate()
+        q_max = TSQuery.from_json({
+            "start": BASE * 1000, "end": (BASE + 3000) * 1000,
+            "queries": [{"metric": "m", "aggregator": "max"}]}) \
+            .validate()
+        t.execute_query(q_sum)
+        h0 = t.device_grid_cache.hits
+        t.execute_query(q_max)
+        assert t.device_grid_cache.hits == h0 + 1
+
+    def test_drop_caches_clears(self):
+        t = _tsdb()
+        _seed(t)
+        t.execute_query(_q())
+        t.drop_caches()
+        m0 = t.device_grid_cache.misses
+        t.execute_query(_q())
+        assert t.device_grid_cache.misses > m0
+
+    def test_disabled_by_config(self):
+        t = _tsdb(**{"tsd.query.device_cache_mb": "0"})
+        _seed(t)
+        assert t.device_grid_cache is None
+        r1 = t.execute_query(_q())
+        assert r1 and r1[0].dps
+
+    def test_cache_matches_uncached_results(self):
+        a = _tsdb()
+        b = _tsdb(**{"tsd.query.device_cache_mb": "0"})
+        _seed(a)
+        _seed(b)
+        for agg, ds in (("sum", "1m-avg"), ("avg", "5m-max"),
+                        ("max", "1m-count"), ("dev", "2m-min")):
+            ra = a.execute_query(_q(agg, ds))
+            ra2 = a.execute_query(_q(agg, ds))  # warm
+            rb = b.execute_query(_q(agg, ds))
+            assert [x.dps for x in ra] == [x.dps for x in rb]
+            assert [x.dps for x in ra2] == [x.dps for x in rb]
+
+
+class TestAvgRollupCache:
+    def test_avg_tier_warm_matches_cold(self):
+        t = _tsdb(**{"tsd.rollups.enable": "true"})
+        for i in range(6):
+            for j in range(30):
+                ts = BASE + j * 60
+                t.add_aggregate_point("m", ts, float(i + j),
+                                      {"host": f"h{i}"}, False, "1m",
+                                      "sum")
+                t.add_aggregate_point("m", ts, 3.0, {"host": f"h{i}"},
+                                      False, "1m", "count")
+        q = _q("sum", "5m-avg", end=BASE + 1800)
+        cold = t.execute_query(q)
+        warm = t.execute_query(q)
+        assert cold and [x.dps for x in cold] == [x.dps for x in warm]
+        # more tier data invalidates
+        t.add_aggregate_point("m", BASE, 500.0, {"host": "h0"}, False,
+                              "1m", "sum")
+        r3 = t.execute_query(q)
+        assert [x.dps for x in r3] != [x.dps for x in cold]
+
+
+class TestTierHasData:
+    def test_emptied_tier_stops_winning_selection(self):
+        """A rollup tier whose points were all deleted must stop
+        winning tier selection (points_written never decrements, so
+        has_data must consult the mutation epoch)."""
+        t = _tsdb(**{"tsd.rollups.enable": "true"})
+        # raw data AND tier data
+        _seed(t, n=2)
+        for j in range(30):
+            t.add_aggregate_point("m", BASE + j * 60, 42.0,
+                                  {"host": "h0"}, False, "1m", "sum")
+        q = _q("sum", "1m-sum")
+        r1 = t.execute_query(q)
+        assert r1
+        # empty the tier by deleting its whole range
+        store = t.rollup_store.tier("1m", "sum")
+        sids = store.series_ids_for_metric(t.uids.metrics.get_id("m"))
+        store.delete_range(sids, 0, 2 ** 60)
+        assert not t.rollup_store.has_data("1m", "sum")
+        # the query now answers from raw data instead of returning []
+        r2 = t.execute_query(q)
+        assert r2 and r2[0].dps
+        # and new tier writes flip it back
+        t.add_aggregate_point("m", BASE, 7.0, {"host": "h0"}, False,
+                              "1m", "sum")
+        assert t.rollup_store.has_data("1m", "sum")
+
+
+class TestBucketReduceBackends:
+    @pytest.mark.parametrize("backend", ["memory", "native"])
+    def test_matches_manual(self, backend):
+        t = _tsdb(**{"tsd.storage.backend": backend})
+        rng = np.random.default_rng(1)
+        ts = BASE * 1000 + np.sort(
+            rng.choice(600_000, 200, replace=False)).astype(np.int64)
+        vals = rng.normal(5, 2, 200)
+        vals[7] = np.nan  # stored NaN must be skipped
+        sid = t.add_points("m", ts // 1000 * 0 + ts, vals,
+                           {"host": "a"})  # ms timestamps
+        start, end = BASE * 1000, BASE * 1000 + 599_999
+        t0, iv, nb = BASE * 1000, 60_000, 10
+        sums, cnts, mins, maxs = t.store.bucket_reduce(
+            [sid], start, end, t0, iv, nb, want_minmax=True)
+        for b in range(nb):
+            sel = (ts >= t0 + b * iv) & (ts < t0 + (b + 1) * iv) & \
+                ~np.isnan(vals)
+            assert cnts[0, b] == sel.sum()
+            if sel.any():
+                np.testing.assert_allclose(sums[0, b], vals[sel].sum())
+                np.testing.assert_allclose(mins[0, b], vals[sel].min())
+                np.testing.assert_allclose(maxs[0, b], vals[sel].max())
+
+
+class TestCompactRowLabels:
+    def test_matches_numpy_unique_axis0(self):
+        from opentsdb_tpu.query.engine import compact_row_labels
+        rng = np.random.default_rng(2)
+        for cols in (1, 2, 4):
+            mat = rng.integers(-1, 5, (300, cols)).astype(np.int64)
+            labels, n = compact_row_labels(mat)
+            uniq, inv = np.unique(mat, axis=0, return_inverse=True)
+            assert n == len(uniq)
+            np.testing.assert_array_equal(labels, inv)
+
+    def test_empty(self):
+        from opentsdb_tpu.query.engine import compact_row_labels
+        labels, n = compact_row_labels(np.empty((0, 3), dtype=np.int64))
+        assert n == 0 and len(labels) == 0
+        labels, n = compact_row_labels(np.empty((4, 0), dtype=np.int64))
+        assert n == 1 and list(labels) == [0, 0, 0, 0]
+
+
+class TestMatchSeriesByTags:
+    def test_alignment(self):
+        from opentsdb_tpu.query.engine import _match_series_by_tags
+        a = _tsdb()
+        # two stores with the same metric/tag universe, different order
+        s1, s2 = a.store, type(a.store)()
+        mid = 1
+        keys = [[(1, i)] for i in range(10)]
+        sids1 = [s1.get_or_create_series(mid, k) for k in keys]
+        sids2 = [s2.get_or_create_series(mid, k)
+                 for k in reversed(keys)]
+        out = _match_series_by_tags(
+            s1, s2, np.asarray(sids1, dtype=np.int64), mid)
+        for i, dst in enumerate(out):
+            assert s2.series(int(dst)).tags == s1.series(
+                int(sids1[i])).tags
+
+    def test_missing_marked(self):
+        from opentsdb_tpu.query.engine import _match_series_by_tags
+        a = _tsdb()
+        s1, s2 = a.store, type(a.store)()
+        mid = 1
+        sids1 = [s1.get_or_create_series(mid, [(1, i)])
+                 for i in range(4)]
+        s2.get_or_create_series(mid, [(1, 2)])
+        out = _match_series_by_tags(
+            s1, s2, np.asarray(sids1, dtype=np.int64), mid)
+        assert (out >= 0).sum() == 1
+        assert out[2] >= 0

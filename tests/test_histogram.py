@@ -258,3 +258,93 @@ class TestHistogramQueryPath:
         # merged: 10 in [0,10) + 10 in [20,30): p50 -> 5.0, p99 -> 25.0
         vals = sorted(v[1356998400000] for v in by_pct.values())
         assert vals == [5.0, 25.0]
+
+
+class TestHistogramDownsample:
+    """``percentiles`` + ``downsample`` (ref: HistogramDownsampler.java
+    wrapping each span before the HistogramSpanGroup merge — merge is
+    bucket-wise SUM across both time and series)."""
+
+    BOUNDS = [0.0, 10.0, 20.0, 30.0]
+    BASE = 1356998400
+
+    def _put(self, tsdb, ts_s, counts, host="web01"):
+        blob = tsdb.histogram_manager.encode(hist(self.BOUNDS, counts))
+        tsdb.add_histogram_point("req.latency", ts_s, blob,
+                                 {"host": host})
+
+    def test_downsample_merges_within_bucket(self, tsdb):
+        from opentsdb_tpu.query.model import TSQuery
+        # two points inside one 5m bucket, one in the next
+        self._put(tsdb, self.BASE, [10, 0, 0])
+        self._put(tsdb, self.BASE + 60, [0, 0, 10])
+        self._put(tsdb, self.BASE + 300, [0, 10, 0])
+        q = TSQuery.from_json({
+            "start": self.BASE - 100, "end": self.BASE + 900,
+            "queries": [{"aggregator": "sum", "metric": "req.latency",
+                         "downsample": "5m-sum",
+                         "percentiles": [50.0]}],
+        })
+        results = tsdb.execute_query(q.validate())
+        assert len(results) == 1
+        dps = dict(results[0].dps)
+        assert len(dps) == 2
+        # bucket 1 merged: 10@[0,10) + 10@[20,30): p50 -> 5.0 (rank 10
+        # crosses in the first bucket); bucket 2: [10,20) -> 15
+        b1 = (self.BASE - (self.BASE % 300)) * 1000
+        assert dps[b1] == 5.0
+        assert dps[b1 + 300_000] == 15.0
+
+    def test_downsample_matches_per_point_oracle(self, tsdb):
+        """Irregular data: device path == SimpleHistogram merge+
+        percentile done per bucket by hand."""
+        import numpy as np
+        from opentsdb_tpu.query.model import TSQuery
+        rng = np.random.default_rng(7)
+        pts = []
+        for host in ("a", "b"):
+            for _ in range(40):
+                ts = self.BASE + int(rng.integers(0, 1800))
+                counts = rng.integers(0, 20, 3).tolist()
+                pts.append((ts, counts))
+                self._put(tsdb, ts, counts, host=host)
+        q = TSQuery.from_json({
+            "start": self.BASE - 100, "end": self.BASE + 2000,
+            "queries": [{"aggregator": "sum", "metric": "req.latency",
+                         "downsample": "5m-sum",
+                         "percentiles": [50.0, 95.0]}],
+        })
+        results = tsdb.execute_query(q.validate())
+        assert len(results) == 2
+        # oracle: SimpleHistogram merge per 5m bucket, then percentile
+        buckets: dict[int, "SimpleHistogram"] = {}
+        for ts, counts in pts:
+            b = (ts * 1000) // 300_000 * 300_000
+            h = buckets.setdefault(b, hist(self.BOUNDS, [0, 0, 0]))
+            h.merge(hist(self.BOUNDS, counts))
+        for r in results:
+            qv = 50.0 if r.metric.endswith("50") else 95.0
+            dps = dict(r.dps)
+            assert set(dps) == set(buckets)
+            for b, h in buckets.items():
+                assert dps[b] == h.percentile(qv), (qv, b)
+
+    def test_downsample_mixed_bounds_fallback(self, tsdb):
+        """Bounds that differ across buckets but agree within one."""
+        from opentsdb_tpu.query.model import TSQuery
+        self._put(tsdb, self.BASE, [10, 0, 0])
+        blob = tsdb.histogram_manager.encode(
+            hist([0.0, 4.0, 8.0], [0, 10]))
+        tsdb.add_histogram_point("req.latency", self.BASE + 300, blob,
+                                 {"host": "web01"})
+        q = TSQuery.from_json({
+            "start": self.BASE - 100, "end": self.BASE + 900,
+            "queries": [{"aggregator": "sum", "metric": "req.latency",
+                         "downsample": "5m-sum",
+                         "percentiles": [50.0]}],
+        })
+        results = tsdb.execute_query(q.validate())
+        dps = dict(results[0].dps)
+        b1 = (self.BASE - (self.BASE % 300)) * 1000
+        assert dps[b1] == 5.0          # [0,10) midpoint
+        assert dps[b1 + 300_000] == 6.0  # [4,8) midpoint

@@ -1,0 +1,17 @@
+"""The oracle conformance matrix with the storage-side grid
+pre-reduction DISABLED (``tsd.query.grid_reduce=false``), so the
+point-batch paths (flat scatter / padded / dense) keep full
+differential coverage — they still serve calendar downsamples, union
+grids, and oversized (blocked) queries when the grid path is on.
+"""
+
+import pytest
+
+import test_oracle_conformance as base
+from test_oracle_conformance import *  # noqa: F401,F403 — collect the matrix
+
+
+@pytest.fixture(autouse=True)
+def _nogrid_engine(monkeypatch):
+    monkeypatch.setattr(base, "EXTRA_CONFIG",
+                        {"tsd.query.grid_reduce": "false"})

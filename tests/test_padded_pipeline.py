@@ -116,9 +116,17 @@ class TestSkewGuard:
             sids, 1356998400_000, 1356998400_000 + 3_000_000)
         assert list(counts) == [300, 300]
 
-    def test_skewed_batch_stays_flat(self, tsdb, monkeypatch):
+    def test_skewed_batch_stays_flat(self, monkeypatch):
         """One dense series among many sparse ones must not trigger the
-        quadratic padded materialization."""
+        quadratic padded materialization. (Runs with the storage-side
+        grid pre-reduction off — the skew guard belongs to the
+        point-batch paths.)"""
+        from opentsdb_tpu import TSDB, Config
+        tsdb = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                              "tsd.query.grid_reduce": "false",
+                              # materialize must run on every query for
+                              # the call-counting below
+                              "tsd.query.device_cache_mb": "0"}))
         base = 1356998400
         for i in range(2000):
             tsdb.add_point("m", base + i, float(i), {"host": "big"})
