@@ -437,6 +437,28 @@ class TimeSeriesStore:
                 values2d[i, :n] = vals
         return PaddedBatch(sids, values2d, ts2d, counts)
 
+    def append_lines(self, sids, ts_ms, values, is_int) -> int:
+        """Portable twin of the native scatter-append: element i lands
+        on series ``sids[i]`` (negative skips)."""
+        sid_arr = np.asarray(sids, dtype=np.int64)
+        ts_arr = np.asarray(ts_ms, dtype=np.int64)
+        val_arr = np.asarray(values, dtype=np.float64)
+        int_arr = np.asarray(is_int, dtype=bool)
+        # one kept-and-sorted index, applied once per array
+        kept = np.flatnonzero(sid_arr >= 0)
+        idx = kept[np.argsort(sid_arr[kept], kind="stable")]
+        sid_s = sid_arr[idx]
+        ts_s, val_s, int_s = ts_arr[idx], val_arr[idx], int_arr[idx]
+        bounds = np.nonzero(np.diff(sid_s))[0] + 1
+        written = 0
+        for lo, hi in zip(np.r_[0, bounds], np.r_[bounds, len(sid_s)]):
+            if lo == hi:
+                continue
+            self.append_many(int(sid_s[lo]), ts_s[lo:hi], val_s[lo:hi],
+                             int_s[lo:hi])
+            written += hi - lo
+        return written
+
     def bucket_reduce(self, series_ids, start_ms: int, end_ms: int,
                       t0: int, interval_ms: int, nbuckets: int,
                       want_minmax: bool = False):

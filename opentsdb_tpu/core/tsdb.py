@@ -381,6 +381,117 @@ class TSDB:
                         fail(idx, metric, t, e)
         return written, errors
 
+    def import_buffer(self, buf: bytes, on_error=None
+                      ) -> tuple[int, list[str]]:
+        """Columnar bulk import of the reference's text line format
+        (``metric ts value tagk=tagv ...``; ref: TextImporter.java:40).
+
+        One native pass parses the whole buffer and labels every line
+        with its distinct (metric, sorted tags) key, so UID resolution
+        and series lookup run once per distinct SERIES and the points
+        land via per-group ``append_many`` — the per-point Python loop
+        only runs when per-point plugin hooks (write filters, realtime
+        publisher, external meta counters) are active.
+
+        Returns (points_written, error strings); ``on_error(lineno,
+        exc)`` gets each failing 1-based line number.
+        """
+        if self.mode == "ro":
+            raise PermissionError("TSD is in read-only mode")
+        from opentsdb_tpu.native.store_backend import (IMPORT_ERRORS,
+                                                       parse_import_buffer)
+        parsed = parse_import_buffer(buf)
+        errors: list[str] = []
+
+        def fail(lineno: int, msg: str) -> None:
+            errors.append(f"line {lineno}: {msg}")
+            if on_error is not None:
+                on_error(lineno, ValueError(msg))
+
+        for i in np.nonzero(parsed.errors > 0)[0].tolist():
+            fail(i + 1, IMPORT_ERRORS.get(int(parsed.errors[i]),
+                                          "parse error"))
+        # resolve each distinct series once. The parser already
+        # enforced the reference's charset/shape rules (code 5), so no
+        # per-name re-validation here.
+        use_hooks = (bool(self.write_filters)
+                     or self.rt_publisher is not None
+                     or self.meta_cache is not None)
+        gsid = np.full(parsed.num_groups, -1, dtype=np.int64)
+        ginfo: list = [None] * parsed.num_groups
+        for g, line in enumerate(parsed.rep_lines):
+            try:
+                text = line.decode("utf-8")
+                words = text.split()
+                metric = words[0]
+                tags = {}
+                for w in words[3:]:
+                    k, _, v = w.partition("=")
+                    tags[k] = v
+                if not text.isascii():
+                    # the native parser passes UTF-8 bytes through;
+                    # precise unicode-letter validation happens here
+                    # (rare path — once per distinct non-ASCII series)
+                    tags_mod.check_metric_and_tags(metric, tags)
+                if use_hooks:
+                    ginfo[g] = (metric, tags, None, None)
+                else:
+                    metric_id, tag_ids = self._resolve_write_uids(
+                        metric, tags)
+                    gsid[g] = self.store.get_or_create_series(
+                        metric_id, tag_ids)
+                    ginfo[g] = (metric, tags, metric_id, tag_ids)
+            except Exception as e:  # noqa: BLE001
+                ginfo[g] = e
+
+        failed = [g for g in range(parsed.num_groups)
+                  if isinstance(ginfo[g], Exception)]
+        for g in failed:
+            for i in np.nonzero(parsed.group_ids == g)[0].tolist():
+                fail(i + 1, str(ginfo[g]))
+        written = 0
+        if use_hooks:
+            # per-point hooks are inherently per-datapoint: group runs
+            # still amortize the metric/tag resolution
+            for g in range(parsed.num_groups):
+                if isinstance(ginfo[g], Exception):
+                    continue
+                metric, tags, _, _ = ginfo[g]
+                members = np.nonzero(parsed.group_ids == g)[0]
+                for i, t, v, f in zip(
+                        members.tolist(),
+                        parsed.ts[members].tolist(),
+                        parsed.values[members].tolist(),
+                        parsed.is_int[members].tolist()):
+                    try:
+                        self.add_point(metric, t,
+                                       int(v) if f else v, tags)
+                        written += 1
+                    except Exception as e:  # noqa: BLE001
+                        fail(i + 1, str(e))
+            return written, errors
+        if parsed.num_groups == 0:
+            return 0, errors
+        # one scatter-append call lands every line on its series
+        gids = parsed.group_ids
+        line_sids = np.where(gids >= 0,
+                             gsid[np.maximum(gids, 0)], -1)
+        ts_ms = np.where(parsed.ts >= (1 << 32), parsed.ts,
+                         parsed.ts * 1000)
+        written = self.store.append_lines(line_sids, ts_ms,
+                                          parsed.values, parsed.is_int)
+        self.datapoints_added += written
+        if self.meta is not None and written:
+            counts = np.bincount(gids[gids >= 0],
+                                 minlength=parsed.num_groups)
+            for g in range(parsed.num_groups):
+                info = ginfo[g]
+                if isinstance(info, Exception) or not counts[g]:
+                    continue
+                self.meta.on_datapoint(info[2], info[3], int(gsid[g]),
+                                       count=int(counts[g]))
+        return written, errors
+
     def add_aggregate_point(self, metric: str, timestamp: int,
                             value: int | float, tags: dict[str, str],
                             is_groupby: bool, interval: str | None,

@@ -16,11 +16,14 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <limits>
 #include <mutex>
 #include <shared_mutex>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -462,6 +465,400 @@ int tss_bucket_reduce(void* h, const int64_t* sids, int64_t nsids,
   worker();
   for (auto& th : pool) th.join();
   return 0;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Charset the reference allows in metric/tag names and values
+// (Tags.validateString: alphanumerics plus -_./ and unicode letters
+// via Character.isLetter). Bytes >= 0x80 (UTF-8 sequences) pass here;
+// the Python side re-validates non-ASCII names precisely.
+inline bool valid_name_char(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '-' || c == '_' || c == '.' ||
+         c == '/' || c >= 0x80;
+}
+
+inline bool valid_name(const char* p, int64_t n) {
+  if (n <= 0) return false;
+  for (int64_t i = 0; i < n; ++i)
+    if (!valid_name_char((unsigned char)p[i])) return false;
+  return true;
+}
+
+// One thread's share of the import parse: lines in [pos, limit) of
+// the buffer, writing per-line outputs at global line index
+// line_base.., building a LOCAL group table (keys + first-line byte
+// ranges). Local group ids are remapped to global ids after the merge.
+struct LocalGroups {
+  std::unordered_map<std::string, int64_t> map;
+  std::vector<int64_t> rep_off, rep_len;
+};
+
+void parse_import_range(const char* buf, int64_t pos, int64_t limit,
+                        int64_t line_base, int64_t* ts_out,
+                        double* val_out, uint8_t* int_out,
+                        int64_t* group_out, int32_t* err_out,
+                        LocalGroups* lg) {
+  std::string key;
+  key.reserve(256);
+  std::string prev_key;
+  int64_t prev_gid = -1;
+  struct Tok {
+    const char* p;
+    int64_t n;
+  };
+  int64_t line = line_base;
+  const int64_t kMaxTs = (int64_t)1 << 47;
+  while (pos < limit) {
+    int64_t eol = pos;
+    while (eol < limit && buf[eol] != '\n') ++eol;
+    int64_t lstart = pos;
+    int64_t lend = eol;
+    if (lend > lstart && buf[lend - 1] == '\r') --lend;
+    pos = eol + 1;
+    int64_t i = line++;
+    ts_out[i] = 0;
+    val_out[i] = 0.0;
+    int_out[i] = 0;
+    group_out[i] = -1;
+    err_out[i] = 0;
+    // tokenize on runs of space/tab
+    Tok toks[16];
+    int ntok = 0;
+    int64_t q = lstart;
+    bool overflow = false;
+    while (q < lend) {
+      while (q < lend && (buf[q] == ' ' || buf[q] == '\t')) ++q;
+      if (q >= lend) break;
+      int64_t t0 = q;
+      while (q < lend && buf[q] != ' ' && buf[q] != '\t') ++q;
+      if (ntok < 16) {
+        toks[ntok].p = buf + t0;
+        toks[ntok].n = q - t0;
+        ++ntok;
+      } else {
+        overflow = true;
+      }
+    }
+    // blank or comment: first NON-SPACE char decides, so indented
+    // comments skip like the line.strip().startswith('#') fallback
+    {
+      int64_t fs = lstart;
+      while (fs < lend && (buf[fs] == ' ' || buf[fs] == '\t')) ++fs;
+      if (fs >= lend || buf[fs] == '#') {
+        err_out[i] = -1;
+        continue;
+      }
+    }
+    if (ntok == 0) {
+      err_out[i] = -1;
+      continue;
+    }
+    if (ntok < 4 || overflow) {
+      err_out[i] = ntok < 4 ? 1 : 4;
+      continue;
+    }
+    if (!valid_name(toks[0].p, toks[0].n)) {
+      err_out[i] = 5;
+      continue;
+    }
+    // timestamp: plain digits (seconds or epoch-ms)
+    {
+      int64_t ts = 0;
+      bool ok = toks[1].n > 0 && toks[1].n < 15;
+      for (int64_t c = 0; ok && c < toks[1].n; ++c) {
+        char ch = toks[1].p[c];
+        if (ch < '0' || ch > '9') ok = false;
+        else ts = ts * 10 + (ch - '0');
+      }
+      if (!ok || ts <= 0 || ts > kMaxTs) {
+        err_out[i] = 2;
+        continue;
+      }
+      ts_out[i] = ts;
+    }
+    // value: inline integer fast path, strtod for the rest
+    {
+      const char* vp = toks[2].p;
+      int64_t vn = toks[2].n;
+      int64_t st = (vn && (vp[0] == '-' || vp[0] == '+')) ? 1 : 0;
+      bool neg = vn && vp[0] == '-';
+      bool isint = vn - st > 0 && vn - st < 19;
+      int64_t acc = 0;
+      for (int64_t c = st; isint && c < vn; ++c) {
+        char ch = vp[c];
+        if (ch < '0' || ch > '9') isint = false;
+        else acc = acc * 10 + (ch - '0');
+      }
+      if (isint) {
+        val_out[i] = neg ? -(double)acc : (double)acc;
+        int_out[i] = 1;
+      } else {
+        // decimal float shape only: strtod alone would accept 'nan',
+        // 'inf', and hex floats, which the reference (and the NaN-as-
+        // missing engine sentinel) must reject
+        bool shape_ok = vn > 0 && vn < 64;
+        for (int64_t c = 0; shape_ok && c < vn; ++c) {
+          char ch = vp[c];
+          if (!((ch >= '0' && ch <= '9') || ch == '.' || ch == '+' ||
+                ch == '-' || ch == 'e' || ch == 'E'))
+            shape_ok = false;
+        }
+        if (!shape_ok) {
+          err_out[i] = 3;
+          continue;
+        }
+        char tmp[64];
+        std::memcpy(tmp, vp, vn);
+        tmp[vn] = 0;
+        char* end = nullptr;
+        double v = std::strtod(tmp, &end);
+        if (end != tmp + vn || v != v) {
+          err_out[i] = 3;
+          continue;
+        }
+        val_out[i] = v;
+        int_out[i] = 0;
+      }
+    }
+    // tags: validate k=v, sort for a canonical key
+    int ntags = ntok - 3;
+    if (ntags > 8) {  // the reference's hard tag cap (Const.java:28)
+      err_out[i] = 4;
+      continue;
+    }
+    Tok* tags = toks + 3;
+    bool bad = false;
+    for (int t = 0; t < ntags && !bad; ++t) {
+      const char* eq =
+          (const char*)memchr(tags[t].p, '=', (size_t)tags[t].n);
+      if (!eq || eq == tags[t].p ||
+          eq == tags[t].p + tags[t].n - 1) {
+        err_out[i] = 4;
+        bad = true;
+        break;
+      }
+      if (!valid_name(tags[t].p, eq - tags[t].p) ||
+          !valid_name(eq + 1, tags[t].p + tags[t].n - eq - 1)) {
+        err_out[i] = 5;
+        bad = true;
+      }
+    }
+    if (bad) continue;
+    std::sort(tags, tags + ntags, [](const Tok& a, const Tok& b) {
+      int c = std::memcmp(a.p, b.p, (size_t)std::min(a.n, b.n));
+      return c < 0 || (c == 0 && a.n < b.n);
+    });
+    key.assign(toks[0].p, (size_t)toks[0].n);
+    for (int t = 0; t < ntags; ++t) {
+      key.push_back(' ');
+      key.append(tags[t].p, (size_t)tags[t].n);
+    }
+    // import files overwhelmingly write one series' points in runs
+    // (scan --import emits them that way): the previous line's key
+    // skips the hash lookup for the common case
+    int64_t gid;
+    if (prev_gid >= 0 && key == prev_key) {
+      gid = prev_gid;
+    } else {
+      auto it = lg->map.find(key);
+      if (it == lg->map.end()) {
+        gid = (int64_t)lg->map.size();
+        lg->map.emplace(key, gid);
+        lg->rep_off.push_back(lstart);
+        lg->rep_len.push_back(lend - lstart);
+      } else {
+        gid = it->second;
+      }
+      prev_key = key;
+      prev_gid = gid;
+    }
+    group_out[i] = gid;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count '\n' + 1 (array sizing for tss_parse_import without a Python
+// bytes.count pass).
+int64_t tss_count_lines(const char* buf, int64_t len) {
+  int64_t n = 1;
+  const char* p = buf;
+  const char* end = buf + len;
+  while ((p = (const char*)memchr(p, '\n', end - p)) != nullptr) {
+    ++n;
+    ++p;
+  }
+  return n;
+}
+
+// Scatter-append: line i appends (ts_ms[i], vals[i], ints[i]) onto
+// series sids[i]; sids[i] < 0 skips the line (parse errors / rejected
+// groups). One call lands a whole parsed import buffer — the per-group
+// Python loop with one ctypes call per series cost ~3 s per 10M points
+// at 50k series. Returns the number appended, -1 on a bad sid.
+int64_t tss_append_lines(void* h, const int64_t* sids, int64_t n,
+                         const int64_t* ts_ms, const double* vals,
+                         const uint8_t* ints) {
+  Store* s = static_cast<Store*>(h);
+  int64_t written = 0;
+  SeriesBuffer* buf = nullptr;
+  int64_t cur = -2;  // current locked-in sid (runs are the common case)
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t sid = sids[i];
+    if (sid < 0) continue;
+    if (sid != cur) {
+      SeriesBuffer* nb = s->lookup(sid);
+      if (buf) buf->mu.unlock();
+      if (!nb) {
+        s->points_written.fetch_add(written);
+        return -1;
+      }
+      nb->mu.lock();
+      buf = nb;
+      cur = sid;
+    }
+    if (buf->sorted && !buf->ts.empty() && ts_ms[i] <= buf->ts.back())
+      buf->sorted = false;
+    buf->ts.push_back(ts_ms[i]);
+    buf->vals.push_back(vals[i]);
+    buf->is_int.push_back(ints ? ints[i] : 0);
+    ++written;
+  }
+  if (buf) buf->mu.unlock();
+  s->points_written.fetch_add(written);
+  return written;
+}
+
+// Bulk text-import parser (the reference's TextImporter line format:
+// "metric ts value tagk=tagv [tagk=tagv ...]"). Parallel over
+// newline-aligned byte chunks:
+//   per line i: ts_out[i] (raw, seconds or ms as written), val_out[i],
+//   int_out[i] (the value token had integer form), err_out[i]
+//   (0 = ok, -1 = blank/comment, >0 = error code), group_out[i] =
+//   id of the line's distinct (metric, sorted tags) key or -1.
+// rep_off/rep_len[g] give the byte range of group g's first line so
+// the caller can parse metric/tag STRINGS once per distinct series
+// (UID resolution is per-series, not per-point).
+// Error codes: 1 too few fields (a tag is required, like the
+// reference), 2 bad timestamp, 3 bad value, 4 malformed tag or too
+// many tags, 5 invalid character.
+// Returns the number of distinct groups, or -1 if group capacity
+// (max_groups) was exceeded. nlines_out gets the number of lines seen
+// (caller sizes arrays by tss_count_lines, which is always enough).
+int64_t tss_parse_import(const char* buf, int64_t len, int64_t* ts_out,
+                         double* val_out, uint8_t* int_out,
+                         int64_t* group_out, int32_t* err_out,
+                         int64_t* rep_off, int64_t* rep_len,
+                         int64_t max_groups, int64_t* nlines_out,
+                         int threads) {
+  if (threads < 1) threads = 1;
+  // chunk boundaries aligned to line starts
+  std::vector<int64_t> starts;
+  starts.push_back(0);
+  for (int t = 1; t < threads; ++t) {
+    int64_t pos = len * t / threads;
+    const char* nl =
+        (const char*)memchr(buf + pos, '\n', (size_t)(len - pos));
+    int64_t aligned = nl ? (nl - buf) + 1 : len;
+    if (aligned > starts.back()) starts.push_back(aligned);
+  }
+  starts.push_back(len);
+  int nchunks = (int)starts.size() - 1;
+  // per-chunk line counts -> global line bases
+  std::vector<int64_t> nlines(nchunks), base(nchunks);
+  {
+    std::atomic<int> next{0};
+    auto worker = [&]() {
+      for (;;) {
+        int c = next.fetch_add(1);
+        if (c >= nchunks) break;
+        int64_t cnt = 0;
+        const char* p = buf + starts[c];
+        const char* e = buf + starts[c + 1];
+        // each line ends with '\n' except possibly the buffer's last
+        while ((p = (const char*)memchr(p, '\n', e - p)) != nullptr) {
+          ++cnt;
+          ++p;
+        }
+        if (c == nchunks - 1 && len > 0 && buf[len - 1] != '\n')
+          ++cnt;  // trailing line without newline
+        nlines[c] = cnt;
+      }
+    };
+    std::vector<std::thread> pool;
+    for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+    worker();
+    for (auto& th : pool) th.join();
+  }
+  int64_t total_lines = 0;
+  for (int c = 0; c < nchunks; ++c) {
+    base[c] = total_lines;
+    total_lines += nlines[c];
+  }
+  *nlines_out = total_lines;
+  // parse each chunk with a local group table
+  std::vector<LocalGroups> locals(nchunks);
+  {
+    std::atomic<int> next{0};
+    auto worker = [&]() {
+      for (;;) {
+        int c = next.fetch_add(1);
+        if (c >= nchunks) break;
+        parse_import_range(buf, starts[c], starts[c + 1], base[c],
+                           ts_out, val_out, int_out, group_out,
+                           err_out, &locals[c]);
+      }
+    };
+    std::vector<std::thread> pool;
+    for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+    worker();
+    for (auto& th : pool) th.join();
+  }
+  // merge local tables into the global numbering and remap gids
+  std::unordered_map<std::string, int64_t> global;
+  std::vector<std::vector<int64_t>> remap(nchunks);
+  for (int c = 0; c < nchunks; ++c) {
+    remap[c].resize(locals[c].map.size());
+    for (auto& kv : locals[c].map) {
+      auto it = global.find(kv.first);
+      int64_t gid;
+      if (it == global.end()) {
+        gid = (int64_t)global.size();
+        if (gid >= max_groups) return -1;
+        global.emplace(kv.first, gid);
+        rep_off[gid] = locals[c].rep_off[kv.second];
+        rep_len[gid] = locals[c].rep_len[kv.second];
+      } else {
+        gid = it->second;
+      }
+      remap[c][kv.second] = gid;
+    }
+  }
+  {
+    // local gid -> global gid, every chunk (the merge renumbers in
+    // hash-iteration order even for a single chunk)
+    std::atomic<int> next{0};
+    auto worker = [&]() {
+      for (;;) {
+        int c = next.fetch_add(1);
+        if (c >= nchunks) break;
+        for (int64_t i = base[c]; i < base[c] + nlines[c]; ++i)
+          if (group_out[i] >= 0)
+            group_out[i] = remap[c][group_out[i]];
+      }
+    };
+    std::vector<std::thread> pool;
+    for (int t = 1; t < threads; ++t) pool.emplace_back(worker);
+    worker();
+    for (auto& th : pool) th.join();
+  }
+  return (int64_t)global.size();
 }
 
 }  // extern "C"

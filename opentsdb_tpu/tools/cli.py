@@ -163,7 +163,13 @@ def cmd_query(config: Config, args: list[str]) -> int:
 
 def cmd_import(config: Config, args: list[str]) -> int:
     """(ref: TextImporter.java:40) Lines: ``metric ts value tagk=tagv...``
-    Gzip files auto-detected by extension."""
+    Gzip files auto-detected by extension.
+
+    Files stream through the native columnar import
+    (``TSDB.import_buffer``): one C++ pass parses each chunk, UID
+    resolution runs once per distinct series, and points land via bulk
+    appends — falling back to the per-line path if the native library
+    is unavailable."""
     if not args:
         print("usage: tsdb import path [more paths]", file=sys.stderr)
         return 2
@@ -171,57 +177,115 @@ def cmd_import(config: Config, args: list[str]) -> int:
     total = 0
     errors = 0
     start = time.monotonic()
-    # parse in line order but write series-grouped chunks through the
-    # vectorized bulk path (ref: TextImporter batches per series via
-    # WritableDataPoints); a failing chunk replays per point so the
-    # line-accurate error cap is preserved
-    chunk: list = []
-    CHUNK = 100_000
+    CHUNK_BYTES = 64 << 20
 
-    def flush_chunk() -> int:
-        nonlocal total, errors
-        refs = [item[0] for item in chunk]
+    def native_available() -> bool:
+        try:
+            from opentsdb_tpu.native.store_backend import load_library
+            load_library()
+            return True
+        except Exception:  # noqa: BLE001
+            return False
 
-        def on_error(i: int, e: Exception) -> None:
-            nonlocal errors
-            errors += 1
-            print(f"error: {refs[i]}: {e}", file=sys.stderr)
+    class _TooManyErrors(Exception):
+        pass
 
-        written, _ = tsdb.add_point_batch(
-            [item[1:] for item in chunk], on_error=on_error)
-        total += written
-        chunk.clear()
-        if errors > 100:
-            print("too many errors, aborting", file=sys.stderr)
-            return 1
-        return 0
+    if native_available():
+        for path in args:
+            opener = gzip.open if path.endswith(".gz") else open
+            base_line = 0
 
-    for path in args:
-        opener = gzip.open if path.endswith(".gz") else open
-        with opener(path, "rt", encoding="utf-8") as fh:
-            for lineno, line in enumerate(fh, 1):
-                line = line.strip()
-                if not line or line.startswith("#"):
-                    continue
-                try:
-                    words = line.split()
-                    metric, ts_raw, val_raw = words[0], words[1], words[2]
-                    value = (float(val_raw) if "." in val_raw
-                             or "e" in val_raw.lower() else int(val_raw))
-                    tags = dict(tags_mod.parse(w) for w in words[3:])
-                    chunk.append((f"{path}:{lineno}", metric,
-                                  int(ts_raw), value, tags))
-                except Exception as e:  # noqa: BLE001
-                    errors += 1
-                    print(f"error: {path}:{lineno}: {e}", file=sys.stderr)
-                    if errors > 100:
+            def on_error(i: int, e: Exception) -> None:
+                # stop printing (and abort) promptly at the cap — a
+                # binary/wrong-format chunk can hold millions of bad
+                # lines
+                nonlocal errors
+                errors += 1
+                if errors <= 100:
+                    print(f"error: {path}:{base_line + i}: {e}",
+                          file=sys.stderr)
+                else:
+                    raise _TooManyErrors
+
+            with opener(path, "rb") as fh:
+                tail = b""
+                while True:
+                    block = fh.read(CHUNK_BYTES)
+                    if not block:
+                        buf, tail = tail, b""
+                        if not buf:
+                            break
+                    else:
+                        block = tail + block
+                        cut = block.rfind(b"\n")
+                        if cut < 0:
+                            tail = block
+                            continue
+                        buf, tail = block[:cut + 1], block[cut + 1:]
+                    try:
+                        written, _ = tsdb.import_buffer(
+                            buf, on_error=on_error)
+                    except _TooManyErrors:
                         print("too many errors, aborting",
                               file=sys.stderr)
                         return 1
-                if len(chunk) >= CHUNK and flush_chunk():
-                    return 1
-    if flush_chunk():
-        return 1
+                    total += written
+                    base_line += buf.count(b"\n")
+                    if not block:
+                        break
+    else:
+        # portable fallback: per-line parse into the batched write path
+        chunk: list = []
+        CHUNK = 100_000
+
+        def flush_chunk() -> int:
+            nonlocal total, errors
+            refs = [item[0] for item in chunk]
+
+            def on_error(i: int, e: Exception) -> None:
+                nonlocal errors
+                errors += 1
+                print(f"error: {refs[i]}: {e}", file=sys.stderr)
+
+            written, _ = tsdb.add_point_batch(
+                [item[1:] for item in chunk], on_error=on_error)
+            total += written
+            chunk.clear()
+            if errors > 100:
+                print("too many errors, aborting", file=sys.stderr)
+                return 1
+            return 0
+
+        for path in args:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt", encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    try:
+                        words = line.split()
+                        metric, ts_raw, val_raw = (words[0], words[1],
+                                                   words[2])
+                        value = (float(val_raw) if "." in val_raw
+                                 or "e" in val_raw.lower()
+                                 else int(val_raw))
+                        tags = dict(tags_mod.parse(w)
+                                    for w in words[3:])
+                        chunk.append((f"{path}:{lineno}", metric,
+                                      int(ts_raw), value, tags))
+                    except Exception as e:  # noqa: BLE001
+                        errors += 1
+                        print(f"error: {path}:{lineno}: {e}",
+                              file=sys.stderr)
+                        if errors > 100:
+                            print("too many errors, aborting",
+                                  file=sys.stderr)
+                            return 1
+                    if len(chunk) >= CHUNK and flush_chunk():
+                        return 1
+        if flush_chunk():
+            return 1
     tsdb.flush()
     dt = time.monotonic() - start
     rate = total / dt if dt > 0 else 0

@@ -1,0 +1,217 @@
+"""Columnar bulk import (TSDB.import_buffer + the native parser;
+ref: TextImporter.java:40 and its TestTextImporter error cases)."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+
+BASE = 1356998400
+
+
+def _tsdb(**extra):
+    return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          **extra}))
+
+
+def _series_values(t, metric, tags):
+    sid = t.store.get_or_create_series(
+        t.uids.metrics.get_id(metric),
+        [(t.uids.tag_names.get_id(k), t.uids.tag_values.get_id(v))
+         for k, v in tags.items()])
+    return t.store.series(sid).buffer.view()
+
+
+class TestImportBuffer:
+    def test_basic_round_trip(self):
+        t = _tsdb()
+        buf = (f"sys.cpu {BASE} 1 host=a\n"
+               f"sys.cpu {BASE + 10} 2.5 host=a\n"
+               f"sys.cpu {BASE} 7 host=b\n").encode()
+        written, errors = t.import_buffer(buf)
+        assert written == 3 and not errors
+        ts, vals = _series_values(t, "sys.cpu", {"host": "a"})
+        assert vals.tolist() == [1.0, 2.5]
+        assert ts.tolist() == [BASE * 1000, BASE * 1000 + 10_000]
+
+    def test_int_float_flags_preserved(self):
+        t = _tsdb()
+        t.import_buffer(
+            f"m {BASE} 3 h=a\nm {BASE + 1} 2.5 h=a\n".encode())
+        sid = t.store.get_or_create_series(
+            t.uids.metrics.get_id("m"),
+            [(t.uids.tag_names.get_id("h"),
+              t.uids.tag_values.get_id("a"))])
+        flags = t.store.series(sid).buffer.view_full()[2]
+        assert list(np.asarray(flags, dtype=bool)) == [True, False]
+
+    def test_per_line_errors_reported(self):
+        t = _tsdb()
+        buf = (f"m {BASE} 1 h=a\n"
+               "# a comment\n"
+               "\n"
+               f"m notatime 2 h=a\n"          # bad ts
+               f"m {BASE} notanumber h=a\n"   # bad value
+               f"m {BASE} 3\n"                # no tags
+               f"m {BASE} 4 hnoequals\n"      # malformed tag
+               f"bad!metric {BASE} 5 h=a\n"   # charset
+               f"m {BASE + 1} 6 h=a\n").encode()
+        seen = []
+        written, errors = t.import_buffer(
+            buf, on_error=lambda lineno, e: seen.append(lineno))
+        assert written == 2
+        assert sorted(seen) == [4, 5, 6, 7, 8]
+        assert len(errors) == 5
+
+    def test_tag_order_same_series(self):
+        # differently-ordered tags are the same series identity
+        t = _tsdb()
+        written, errors = t.import_buffer(
+            (f"m {BASE} 1 a=1 b=2\n"
+             f"m {BASE + 1} 2 b=2 a=1\n").encode())
+        assert written == 2 and not errors
+        mid = t.uids.metrics.get_id("m")
+        assert len(t.store.series_ids_for_metric(mid)) == 1
+
+    def test_uid_filter_rejects_whole_group(self):
+        t = _tsdb()
+
+        class Filt:
+            def allow_uid_assignment(self, kind, name, metric, tags):
+                return name != "forbidden.metric"
+
+        t.uid_filter = Filt()
+        seen = []
+        written, errors = t.import_buffer(
+            (f"ok.metric {BASE} 1 h=a\n"
+             f"forbidden.metric {BASE} 2 h=a\n"
+             f"forbidden.metric {BASE + 1} 3 h=a\n").encode(),
+            on_error=lambda lineno, e: seen.append(lineno))
+        assert written == 1
+        assert sorted(seen) == [2, 3]
+
+    def test_hooks_fall_back_to_per_point(self):
+        t = _tsdb()
+        published = []
+
+        class Pub:
+            def publish_data_point(self, metric, ts, value, tags,
+                                   tsuid):
+                published.append((metric, ts, value))
+
+            def shutdown(self):
+                pass
+
+        t.rt_publisher = Pub()
+        written, errors = t.import_buffer(
+            (f"m {BASE} 1 h=a\nm {BASE + 1} 2 h=a\n").encode())
+        assert written == 2
+        assert published == [("m", BASE, 1), ("m", BASE + 1, 2)]
+
+    def test_readonly_mode_rejected(self):
+        t = TSDB(Config(**{"tsd.mode": "ro"}))
+        with pytest.raises(PermissionError):
+            t.import_buffer(b"m 1 1 h=a\n")
+
+    def test_ms_timestamps(self):
+        t = _tsdb()
+        t.import_buffer(f"m {BASE * 1000 + 250} 5 h=a\n".encode())
+        ts, vals = _series_values(t, "m", {"h": "a"})
+        assert ts.tolist() == [BASE * 1000 + 250]
+
+    def test_matches_per_point_path(self):
+        """Differential: import_buffer == add_point line by line."""
+        rng = np.random.default_rng(3)
+        lines = []
+        pts = []
+        for i in range(500):
+            m = f"m{i % 3}"
+            ts = BASE + int(rng.integers(0, 10_000))
+            v = round(float(rng.normal(10, 5)), 3)
+            tags = {"host": f"h{i % 7}", "dc": f"d{i % 2}"}
+            lines.append(
+                f"{m} {ts} {v} host={tags['host']} dc={tags['dc']}")
+            pts.append((m, ts, v, tags))
+        a, b = _tsdb(), _tsdb()
+        written, errors = a.import_buffer(
+            ("\n".join(lines) + "\n").encode())
+        assert written == 500 and not errors
+        for m, ts, v, tags in pts:
+            b.add_point(m, ts, v, tags)
+        for i in range(3):
+            for h in range(7):
+                for d in range(2):
+                    try:
+                        ta, va = _series_values(
+                            a, f"m{i}", {"host": f"h{h}",
+                                         "dc": f"d{d}"})
+                    except LookupError:
+                        continue
+                    tb, vb = _series_values(
+                        b, f"m{i}", {"host": f"h{h}", "dc": f"d{d}"})
+                    assert ta.tolist() == tb.tolist()
+                    assert va.tolist() == vb.tolist()
+
+    @pytest.mark.parametrize("threads", [1, 3])
+    def test_parser_thread_equivalence(self, threads):
+        from opentsdb_tpu.native.store_backend import \
+            parse_import_buffer
+        rng = np.random.default_rng(4)
+        lines = []
+        for i in range(2000):
+            lines.append(f"m{i % 5} {BASE + i} {i} host=h{i % 11}")
+        lines.insert(500, "bad line")
+        buf = ("\n".join(lines) + "\n").encode()
+        p = parse_import_buffer(buf, threads=threads)
+        assert p.num_groups == 55
+        assert (p.errors > 0).sum() == 1
+        assert int(np.nonzero(p.errors > 0)[0][0]) == 500
+
+    def test_empty_buffer(self):
+        t = _tsdb()
+        assert t.import_buffer(b"") == (0, [])
+        assert t.import_buffer(b"\n\n") == (0, [])
+
+    def test_nan_inf_hex_values_rejected(self):
+        # strtod alone would accept these; the reference (and the
+        # NaN-as-missing engine sentinel) must not
+        t = _tsdb()
+        seen = []
+        written, errors = t.import_buffer(
+            (f"m {BASE} nan h=a\nm {BASE} inf h=a\n"
+             f"m {BASE} 0x10 h=a\nm {BASE} 1.5e2 h=a\n").encode(),
+            on_error=lambda i, e: seen.append(i))
+        assert written == 1          # only 1.5e2
+        assert sorted(seen) == [1, 2, 3]
+        ts, vals = _series_values(t, "m", {"h": "a"})
+        assert vals.tolist() == [150.0]
+
+    def test_indented_comments_skipped(self):
+        t = _tsdb()
+        written, errors = t.import_buffer(
+            (f"  # indented comment\n\t#tabbed\n"
+             f"m {BASE} 1 h=a\n").encode())
+        assert written == 1 and not errors
+
+    def test_unicode_names_validated_python_side(self):
+        # UTF-8 letters pass the native charset scan and get the
+        # precise Python validation per distinct series
+        t = _tsdb()
+        written, errors = t.import_buffer(
+            f"métric {BASE} 1 h=café\n".encode())
+        assert written == 1 and not errors
+        assert t.uids.metrics.has_name("métric")
+
+    def test_import_matches_memory_backend(self):
+        a = _tsdb()
+        b = _tsdb(**{"tsd.storage.backend": "memory"})
+        buf = (f"m {BASE} 1 h=a\nm {BASE + 5} 2 h=a\n"
+               f"m {BASE} 3 h=b\n").encode()
+        for t in (a, b):
+            written, errors = t.import_buffer(buf)
+            assert written == 3 and not errors
+        for tags in ({"h": "a"}, {"h": "b"}):
+            ta, va = _series_values(a, "m", tags)
+            tb, vb = _series_values(b, "m", tags)
+            assert ta.tolist() == tb.tolist()
+            assert va.tolist() == vb.tolist()
