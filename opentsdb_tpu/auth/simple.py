@@ -17,11 +17,17 @@ from enum import Enum, auto
 
 
 class Permissions(Enum):
-    """(ref: src/auth/Permissions.java:25)"""
+    """The full reference permission set
+    (ref: src/auth/Permissions.java:25-27)."""
     TELNET_PUT = auto()
     HTTP_PUT = auto()
     HTTP_QUERY = auto()
-    CREATE_UID = auto()
+    CREATE_TAGK = auto()
+    CREATE_TAGV = auto()
+    CREATE_METRIC = auto()
+
+
+ALL_PERMISSIONS = frozenset(Permissions)
 
 
 class AuthStatus(Enum):
@@ -36,39 +42,90 @@ class AuthState:
     """(ref: src/auth/AuthState.java)"""
 
     def __init__(self, user: str, status: AuthStatus,
-                 message: str = "", roles: set[str] | None = None):
+                 message: str = "", roles: set[str] | None = None,
+                 permissions: frozenset | None = None):
         self.user = user
         self.status = status
         self.message = message
         self.roles = roles or set()
+        # None = no role config: every authenticated user gets
+        # everything (AllowAllAuthenticatingAuthorizer parity)
+        self.permissions = (ALL_PERMISSIONS if permissions is None
+                            else permissions)
         self.token: bytes | None = None
 
     def has_permission(self, perm: Permissions) -> bool:
-        return self.status == AuthStatus.SUCCESS
+        """(ref: Permissions.java gating HTTP_QUERY/HTTP_PUT/
+        TELNET_PUT/CREATE_* per role)"""
+        return self.status == AuthStatus.SUCCESS and \
+            perm in self.permissions
 
 
 class SimpleAuthentication:
-    """Username/password authenticator.
+    """Username/password authenticator with role-based authorization.
 
-    Users configured as ``tsd.core.authentication.users`` =
-    ``user1:sha256hex,user2:sha256hex``; with no users configured every
-    auth attempt succeeds (AllowAllAuthenticatingAuthorizer parity).
+    - ``tsd.core.authentication.users`` =
+      ``user1:sha256hex[:role1|role2],user2:sha256hex`` — with no
+      users configured every auth attempt succeeds
+      (AllowAllAuthenticatingAuthorizer parity).
+    - ``tsd.core.authentication.roles`` =
+      ``reader:http_query,writer:http_put|telnet_put,admin:all`` —
+      maps role names to granted :class:`Permissions`; with no roles
+      configured every authenticated user holds every permission.
+      A user with no roles (while roles ARE configured) holds none.
     """
 
     def __init__(self, config):
-        self._users: dict[str, str] = {}
+        self._users: dict[str, tuple[str, set[str]]] = {}
         spec = config.get_string("tsd.core.authentication.users", "")
         for entry in filter(None, (e.strip() for e in spec.split(","))):
-            user, _, digest = entry.partition(":")
-            self._users[user] = digest.lower()
+            parts = entry.split(":")
+            user = parts[0]
+            digest = parts[1].lower() if len(parts) > 1 else ""
+            roles = set(filter(None, parts[2].split("|"))) \
+                if len(parts) > 2 else set()
+            self._users[user] = (digest, roles)
+        self._role_grants: dict[str, frozenset] = {}
+        rspec = config.get_string("tsd.core.authentication.roles", "")
+        for entry in filter(None, (e.strip()
+                                   for e in rspec.split(","))):
+            role, _, perms = entry.partition(":")
+            granted = set()
+            for p in filter(None, perms.split("|")):
+                if p.strip().lower() in ("all", "*"):
+                    granted |= ALL_PERMISSIONS
+                else:
+                    try:
+                        granted.add(Permissions[p.strip().upper()])
+                    except KeyError:
+                        valid = ", ".join(
+                            x.name.lower() for x in Permissions)
+                        raise ValueError(
+                            "invalid permission "
+                            f"{p.strip()!r} in tsd.core."
+                            f"authentication.roles entry "
+                            f"{entry!r} (valid: {valid}, 'all')"
+                        ) from None
+            self._role_grants[role.strip()] = frozenset(granted)
+
+    def _permissions_for(self, roles: set[str]) -> frozenset | None:
+        if not self._role_grants:
+            return None  # no role config: everything
+        granted: set = set()
+        for r in roles:
+            granted |= self._role_grants.get(r, frozenset())
+        return frozenset(granted)
 
     def authenticate(self, user: str, password: str) -> AuthState:
         if not self._users:
             return AuthState(user or "anonymous", AuthStatus.SUCCESS)
         digest = hashlib.sha256(password.encode()).hexdigest()
-        expected = self._users.get(user)
-        if expected is not None and hmac.compare_digest(digest, expected):
-            state = AuthState(user, AuthStatus.SUCCESS)
+        entry = self._users.get(user)
+        if entry is not None and hmac.compare_digest(digest, entry[0]):
+            state = AuthState(user, AuthStatus.SUCCESS,
+                              roles=set(entry[1]),
+                              permissions=self._permissions_for(
+                                  entry[1]))
             state.token = secrets.token_bytes(16)
             return state
         return AuthState(user, AuthStatus.UNAUTHORIZED,

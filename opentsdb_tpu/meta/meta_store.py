@@ -125,6 +125,128 @@ class MetaStore:
                 self._tsdb.search_plugin.index_uid_meta(meta)
         return meta
 
+    # -- editing RPC surface (ref: UniqueIdRpc.java:179-226,314;
+    # merge-on-POST / replace-on-PUT via syncToStorage's overwrite
+    # flag, TSMeta.java:222 / UIDMeta CAS sync) ----------------------
+
+    # JSON field -> attribute, the reference's editable field set
+    _UID_FIELDS = {"displayName": "display_name",
+                   "description": "description", "notes": "notes",
+                   "custom": "custom"}
+    _TS_FIELDS = {"displayName": "display_name",
+                  "description": "description", "notes": "notes",
+                  "custom": "custom", "units": "units",
+                  "dataType": "data_type", "retention": "retention",
+                  "max": "max_value", "min": "min_value"}
+
+    @staticmethod
+    def _apply_fields(meta, fields: dict, field_map: dict,
+                      overwrite: bool) -> bool:
+        """POST merges only the provided fields; PUT resets every
+        editable field then applies the provided ones (ref:
+        syncToStorage(overwrite)). Returns True when anything
+        changed."""
+
+        def same(a, b) -> bool:
+            if isinstance(a, float) and isinstance(b, float):
+                return a == b or (a != a and b != b)  # NaN == NaN here
+            return a == b
+
+        changed = False
+        defaults = {"custom": {}, "retention": 0,
+                    "max_value": float("nan"),
+                    "min_value": float("nan")}
+        for json_key, attr in field_map.items():
+            if json_key in fields:
+                val = fields[json_key]
+                if val is None:
+                    val = defaults.get(attr, "")
+                if attr == "retention":
+                    val = int(val)
+                elif attr in ("max_value", "min_value"):
+                    val = float(val)
+                elif attr == "custom":
+                    val = dict(val or {})
+            elif overwrite:
+                val = defaults.get(attr, "")
+            else:
+                continue
+            if not same(getattr(meta, attr), val):
+                setattr(meta, attr, val)
+                changed = True
+        return changed
+
+    class NotModified(Exception):
+        """Raised when a sync carries no actual change (ref: the 304
+        NOT_MODIFIED reply on IllegalStateException)."""
+
+    def sync_uid_meta(self, kind: str, uid_hex: str, fields: dict,
+                      overwrite: bool) -> UIDMeta:
+        """Merge (POST) or replace (PUT) a UIDMeta document. The UID
+        must exist in the UID table; a missing doc starts from the
+        skeleton (ref: UIDMeta.getUIDMeta default docs)."""
+        uid_hex = uid_hex.upper()
+        registry = self._tsdb.uids.by_kind(kind)
+        name = registry.get_name(bytes.fromhex(uid_hex))  # may raise
+        with self._lock:
+            key = (kind, uid_hex)
+            meta = self.uid_meta.get(key)
+            if meta is None:
+                meta = UIDMeta(uid=uid_hex,
+                               type={"metric": "METRIC",
+                                     "tagk": "TAGK",
+                                     "tagv": "TAGV"}[kind],
+                               name=name, created=int(time.time()))
+                created = True
+            else:
+                created = False
+            changed = self._apply_fields(meta, fields,
+                                         self._UID_FIELDS, overwrite)
+            if not changed and not created:
+                raise MetaStore.NotModified()
+            self.uid_meta[key] = meta
+        if self._tsdb.search_plugin is not None:
+            self._tsdb.search_plugin.index_uid_meta(meta)
+        return meta
+
+    def delete_uid_meta(self, kind: str, uid_hex: str) -> None:
+        with self._lock:
+            meta = self.uid_meta.pop((kind, uid_hex.upper()), None)
+        if meta is not None and self._tsdb.search_plugin is not None:
+            self._tsdb.search_plugin.delete_uid_meta(meta)
+
+    def sync_ts_meta(self, tsuid: str, fields: dict, overwrite: bool,
+                     create: bool = False) -> TSMeta:
+        """Merge/replace a TSMeta document; ``create`` materializes a
+        new doc for a known-but-untracked timeseries (ref: the
+        create=true counter bootstrap in UniqueIdRpc tsmeta POST)."""
+        tsuid = tsuid.upper()
+        with self._lock:
+            meta = self.ts_meta.get(tsuid)
+            created = False
+            if meta is None:
+                if not create:
+                    raise LookupError(
+                        f"Could not find Timeseries meta data "
+                        f"for {tsuid}")
+                meta = TSMeta(tsuid=tsuid, created=int(time.time()))
+                self.ts_counters.setdefault(tsuid, 0)
+                created = True
+            changed = self._apply_fields(meta, fields, self._TS_FIELDS,
+                                         overwrite)
+            if not changed and not created:
+                raise MetaStore.NotModified()
+            self.ts_meta[tsuid] = meta
+        if self._tsdb.search_plugin is not None:
+            self._tsdb.search_plugin.index_ts_meta(meta)
+        return meta
+
+    def delete_ts_meta(self, tsuid: str) -> None:
+        with self._lock:
+            meta = self.ts_meta.pop(tsuid.upper(), None)
+        if meta is not None and self._tsdb.search_plugin is not None:
+            self._tsdb.search_plugin.delete_ts_meta(meta.tsuid)
+
     def get_ts_meta(self, tsuid: str) -> TSMeta | None:
         with self._lock:
             return self.ts_meta.get(tsuid.upper())

@@ -226,6 +226,8 @@ class QueryEngine:
                     sids = raw_sids
         if len(sids) == 0:
             return []
+        if stats:
+            stats.add_stat(QueryStat.ROWS_PRE_FILTER, len(sids))
 
         # --- filters -> series mask (ref: findSpans post-scan filters)
         sids, tag_mat = self._apply_filters(store, sub, sids)
@@ -234,6 +236,9 @@ class QueryEngine:
         if stats:
             stats.add_stat(QueryStat.STRING_TO_UID_TIME,
                            (time.monotonic() - t0) * 1e3)
+            stats.add_stat(QueryStat.ROWS_POST_FILTER, len(sids))
+            stats.add_stat(QueryStat.UID_PAIRS_RESOLVED,
+                           int((tag_mat.vids >= 0).sum()))
 
         # --- group construction (ref: GroupByAndAggregateCB :916)
         gb_tagks = sorted({f.tagk for f in sub.filters if f.group_by})
@@ -347,10 +352,8 @@ class QueryEngine:
             padded = None
             batch = store.materialize(sids, tsq.start_ms, tsq.end_ms)
             num_points = batch.num_points
-        if stats:
-            stats.add_stat(QueryStat.MATERIALIZE_TIME,
-                           (time.monotonic() - t1) * 1e3)
-            stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
+        self._record_scan(stats, (time.monotonic() - t1) * 1e3,
+                          num_points, len(sids))
         # byte/dp guardrails (ref: SaltScanner budget enforcement via
         # QueryLimitOverride)
         self.tsdb.query_limits.check(metric_name, num_points)
@@ -532,6 +535,24 @@ class QueryEngine:
             avg_count_store = None
         return store, sub.metric, sids, rollup_scale, avg_count_store
 
+    @staticmethod
+    def _record_scan(stats, ms: float, num_points: int,
+                     n_rows: int) -> None:
+        """Storage-scan stat points (ref: the per-scanner stats block,
+        QueryStats.java:137-151 — 'storage' here is the host column
+        store, a column ≙ a stored point, a row ≙ a series)."""
+        if not stats:
+            return
+        stats.add_stat(QueryStat.MATERIALIZE_TIME, ms)
+        stats.add_stat(QueryStat.QUERY_SCAN_TIME, ms)
+        stats.add_stat(QueryStat.HBASE_TIME, ms)
+        stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
+        stats.add_stat(QueryStat.COLUMNS_FROM_STORAGE, num_points)
+        stats.add_stat(QueryStat.ROWS_FROM_STORAGE, n_rows)
+        # 17 bytes per stored point: int64 ts + float64 value + flag
+        stats.add_stat(QueryStat.BYTES_FROM_STORAGE, num_points * 17)
+        stats.add_stat(QueryStat.SUCCESSFUL_SCAN, 1)
+
     # downsample functions the native pre-reduction can serve: linear
     # bucket statistics (sum/count/min/max; avg is sum over count)
     _GRID_FNS = frozenset(("sum", "zimsum", "pfsum", "count", "min",
@@ -591,10 +612,8 @@ class QueryEngine:
                 sids, tsq.start_ms, tsq.end_ms, int(bucket_ts[0]),
                 ds_spec.interval_ms, b, want_minmax=want_minmax)
             num_points = int(cnts.sum())
-        if stats:
-            stats.add_stat(QueryStat.MATERIALIZE_TIME,
-                           (time.monotonic() - t1) * 1e3)
-            stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
+        self._record_scan(stats, (time.monotonic() - t1) * 1e3,
+                          num_points, len(sids))
         self.tsdb.query_limits.check(metric_name, num_points)
         if tsq.delete and hasattr(store, "delete_range"):
             store.delete_range(sids, tsq.start_ms, tsq.end_ms)
@@ -743,10 +762,8 @@ class QueryEngine:
             batch_c = cnt_store.materialize(csids[present],
                                             tsq.start_ms, tsq.end_ms)
             num_points = batch_s.num_points + batch_c.num_points
-        if stats:
-            stats.add_stat(QueryStat.MATERIALIZE_TIME,
-                           (time.monotonic() - t1) * 1e3)
-            stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
+        self._record_scan(stats, (time.monotonic() - t1) * 1e3,
+                          num_points, len(sids))
         self.tsdb.query_limits.check(metric_name, num_points)
         if tsq.delete:
             csids, present = align()

@@ -321,11 +321,17 @@ _TOKEN_RE = re.compile(
 
 class InfixParser:
     """Tiny recursive-descent parser for ``a + b * 2`` style expressions
-    over named variables."""
+    over named variables. ``join_operator`` and ``fill_missing`` carry
+    the expression's pojo Join / NumericFillPolicy settings into every
+    binary join (ref: pojo/Join.java SetOperator,
+    expression/NumericFillPolicy.java)."""
 
-    def __init__(self, text: str):
+    def __init__(self, text: str, join_operator: str = "union",
+                 fill_missing: float = 0.0):
         self.tokens = self._tokenize(text)
         self.pos = 0
+        self.join_operator = join_operator
+        self.fill_missing = fill_missing
 
     @staticmethod
     def _tokenize(text: str):
@@ -402,8 +408,7 @@ class InfixParser:
             return variables[val]
         raise ValueError(f"unexpected token {val!r}")
 
-    @staticmethod
-    def _apply(left, right, op):
+    def _apply(self, left, right, op):
         if isinstance(left, float) and isinstance(right, float):
             return {"+": left + right, "-": left - right,
                     "*": left * right,
@@ -412,9 +417,14 @@ class InfixParser:
             return scalar_op(right, left, op, scalar_left=True)
         if isinstance(right, float):
             return scalar_op(left, right, op)
-        return binary_op(left, right, op)
+        return binary_op(left, right, op,
+                         operator=self.join_operator,
+                         fill_missing=self.fill_missing)
 
 
 def evaluate_expression(text: str,
-                        variables: dict[str, SeriesFrame]) -> SeriesFrame:
-    return InfixParser(text).parse(variables)
+                        variables: dict[str, SeriesFrame],
+                        join_operator: str = "union",
+                        fill_missing: float = 0.0) -> SeriesFrame:
+    return InfixParser(text, join_operator,
+                       fill_missing).parse(variables)

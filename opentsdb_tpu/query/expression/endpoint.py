@@ -122,18 +122,28 @@ def handle_exp(router, request):
         filter_sets[f.get("id", "")] = [
             filters_mod.build_filter(t) for t in f.get("tags", [])]
 
-    # metrics: id -> sub-query (ref: pojo/Metric.java)
+    # time-spec rate applies to every metric unless overridden
+    time_rate = bool(time_spec.get("rate", False))
+    time_rate_options = time_spec.get("rateOptions")
+
+    # metrics: id -> sub-query (ref: pojo/Metric.java incl. per-metric
+    # rate/rateOptions)
     variables: dict[str, SeriesFrame] = {}
     metric_meta: dict[str, dict] = {}
     for mspec in obj.get("metrics") or []:
         mid = mspec.get("id")
         if not mid:
             raise BadRequestError("metric missing id")
-        sub = TSSubQuery(
-            aggregator=mspec.get("aggregator") or aggregator,
-            metric=mspec.get("metric"),
-            downsample=mspec.get("downsampler") or ds_spec,
-            filters=list(filter_sets.get(mspec.get("filter", ""), [])))
+        sub = TSSubQuery.from_json({
+            "metric": mspec.get("metric"),
+            "aggregator": mspec.get("aggregator") or aggregator,
+            "downsample": mspec.get("downsampler") or ds_spec,
+            "rate": mspec.get("rate", time_rate),
+            "rateOptions": (mspec.get("rateOptions")
+                            or time_rate_options),
+        })
+        sub.filters = list(filter_sets.get(mspec.get("filter", ""),
+                                           []))
         tsq = TSQuery(start=start, end=end, queries=[sub])
         tsq.validate()
         results = tsdb.new_query().run(tsq)
@@ -155,7 +165,30 @@ def handle_exp(router, request):
         for dep in exprs:
             if dep != eid and dep in spec.get("expr", ""):
                 scope[dep] = resolve(dep, seen + (eid,))
-        frame = evaluate_expression(spec.get("expr", ""), scope)
+        # per-expression join + fill (ref: pojo/Join.java SetOperator,
+        # pojo/Expression.java fillPolicy -> NumericFillPolicy)
+        join = spec.get("join") or {}
+        operator = str(join.get("operator") or "union").lower()
+        if operator not in ("union", "intersection"):
+            raise BadRequestError(
+                f"unknown join operator {operator!r}")
+        fp = spec.get("fillPolicy") or {}
+        policy = str(fp.get("policy") or "zero").lower()
+        if policy in ("nan", "null"):
+            fill = float("nan")
+        elif policy == "scalar":
+            fill = float(fp.get("value", 0))
+        elif policy == "zero":
+            fill = 0.0
+        else:
+            raise BadRequestError(f"unknown fill policy {policy!r}")
+        frame = evaluate_expression(spec.get("expr", ""), scope,
+                                    join_operator=operator,
+                                    fill_missing=fill)
+        if not bool(join.get("includeAggTags", True)):
+            frame = SeriesFrame(frame.ts, frame.values, frame.tags,
+                                [[] for _ in range(frame.num_series)],
+                                frame.metric)
         resolved[eid] = frame
         return frame
 
@@ -177,9 +210,12 @@ def handle_exp(router, request):
                                        else float(v))
                 for v in frame.values[:, t_idx])
             dps_rows.append(row)
+        # the output alias renames the emitted series metric (ref:
+        # pojo/Output.java alias consumed by QueryExecutor's serdes)
+        alias = ospec.get("alias")
         out_results.append({
             "id": oid,
-            "alias": ospec.get("alias"),
+            "alias": alias,
             "dps": dps_rows,
             "dpsMeta": {
                 "firstTimestamp": int(frame.ts[0]) if len(frame.ts)
@@ -191,7 +227,7 @@ def handle_exp(router, request):
             },
             "meta": [{"index": 0, "metrics": ["timestamp"]}] + [
                 {"index": s + 1,
-                 "metrics": [frame.metric],
+                 "metrics": [alias or frame.metric],
                  "commonTags": frame.tags[s]
                  if s < len(frame.tags) else {},
                  "aggregatedTags": (frame.agg_tags[s]

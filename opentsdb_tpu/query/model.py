@@ -40,7 +40,8 @@ class TSSubQuery:
     agg: aggs_mod.Aggregator | None = None
     ds_spec: DownsamplingSpecification | None = None
 
-    def validate(self, timezone: str | None = None) -> None:
+    def validate(self, timezone: str | None = None,
+                 use_calendar: bool = False) -> None:
         if not self.aggregator:
             raise BadRequestError(
                 "Missing the aggregation function")
@@ -57,6 +58,14 @@ class TSSubQuery:
                     self.downsample, timezone)
             except ValueError as e:
                 raise BadRequestError(str(e)) from None
+            if use_calendar and not self.ds_spec.run_all:
+                # the query-level useCalendar flag aligns every
+                # downsample to calendar boundaries, like the 'c'
+                # interval suffix (ref: TSQuery useCalendar ->
+                # DownsamplingSpecification.useCalendar)
+                import dataclasses
+                self.ds_spec = dataclasses.replace(
+                    self.ds_spec, use_calendar=True)
 
     @classmethod
     def from_json(cls, obj: dict[str, Any], index: int = 0) -> "TSSubQuery":
@@ -140,7 +149,7 @@ class TSQuery:
             raise BadRequestError("Missing queries")
         for i, sub in enumerate(self.queries):
             sub.index = i
-            sub.validate(self.timezone)
+            sub.validate(self.timezone, self.use_calendar)
         return self
 
     @classmethod
@@ -278,6 +287,9 @@ def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
         end=first("end"),
         queries=queries,
         timezone=first("tz"),
+        use_calendar=first("use_calendar",
+                           first("useCalendar", "false"))
+        in ("true", ""),
         no_annotations=first("no_annotations", "false") == "true",
         global_annotations=first("global_annotations", "false") == "true",
         ms_resolution=first("ms", first("ms_resolution", "false"))

@@ -147,6 +147,40 @@ class QueryStat(Enum):
     DPS_POST_FILTER = "dpsPostFilter"
     EMITTED_DPS = "emittedDPs"
     MAX_HBM_BYTES = "maxHbmBytes"
+    # storage stats — TPU mapping: "storage" is the host column store,
+    # a column ≙ a stored point, a row ≙ a series
+    COLUMNS_FROM_STORAGE = "columnsFromStorage"
+    ROWS_FROM_STORAGE = "rowsFromStorage"
+    BYTES_FROM_STORAGE = "bytesFromStorage"
+    SUCCESSFUL_SCAN = "successfulScan"
+    ROWS_PRE_FILTER = "rowsPreFilter"
+    ROWS_POST_FILTER = "rowsPostFilter"
+    COMPACTION_TIME = "compactionTime"      # lazy sort/dedupe (N/A: 0)
+    HBASE_TIME = "hbaseTime"                # storage engine wait
+    UID_PAIRS_RESOLVED = "uidPairsResolved"
+    SCANNER_MERGE_TIME = "saltScannerMergeTime"
+    QUERY_SCAN_TIME = "queryScanTime"
+    NAN_DPS = "nanDPs"
+    PROCESSING_PRE_WRITE_TIME = "processingPreWriteTime"
+
+
+# time-based stats that get the reference's derived max*/avg* twins in
+# /api/stats/query output (one logical scanner here, so max == avg ==
+# the base value; consumers of the reference's schema still find them)
+_DERIVED_TIMES = {
+    "hbaseTime": ("maxHBaseTime", "avgHBaseTime"),
+    "scannerTime": ("maxScannerTime", "avgScannerTime"),
+    "uidToStringTime": ("maxUidToStringTime", "avgUidToStringTime"),
+    "compactionTime": ("maxCompactionTime", "avgCompactionTime"),
+    "scannerUidToStringTime": ("maxScannerUidtoStringTime",
+                               "avgScannerUidToStringTime"),
+    "saltScannerMergeTime": ("maxSaltScannerMergeTime",
+                             "avgSaltScannerMergeTime"),
+    "queryScanTime": ("maxQueryScanTime", "avgQueryScanTime"),
+    "aggregationTime": ("maxAggregationTime", "avgAggregationTime"),
+    "serializationTime": ("maxSerializationTime",
+                          "avgSerializationTime"),
+}
 
 
 class QueryStats:
@@ -183,12 +217,17 @@ class QueryStats:
             QueryStats._completed.append(self)
 
     def to_json(self) -> dict[str, Any]:
+        stats = dict(self.stats)
+        for base, (mx, avg) in _DERIVED_TIMES.items():
+            if base in stats:
+                stats.setdefault(mx, stats[base])
+                stats.setdefault(avg, stats[base])
         return {
             "queryId": self.query_id,
             "remote": self.remote,
             "queryStartTimestamp": int(self.start_time * 1000),
             "executed": self.executed,
-            "stats": self.stats,
+            "stats": stats,
             "query": (self.query.to_json()
                       if hasattr(self.query, "to_json") else None),
         }

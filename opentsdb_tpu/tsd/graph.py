@@ -5,6 +5,15 @@ cache; here charts render with matplotlib (Agg backend) when available
 and the endpoint also serves the same ASCII/JSON outputs the reference
 supports (``ascii``, ``json`` query params). File caching honors
 ``tsd.http.cachedir`` like the reference's ``/q`` cache (:517).
+
+Plot option surface (ref: ``src/graph/Plot.java:40`` setParams and the
+query params GraphHandler forwards): ``wxh``, ``title``, ``ylabel`` /
+``y2label``, ``yrange`` / ``y2range`` (gnuplot ``[lo:hi]`` form),
+``ylog`` / ``y2log``, ``yformat`` / ``y2format``, ``key`` (position
+words) / ``nokey``, ``bgcolor`` / ``fgcolor`` (gnuplot ``xRRGGBB``),
+``style`` (linespoint/points/circles/dots), ``smooth``, and per-metric
+``o`` options where ``axis x1y2`` routes that sub-query to the right
+axis (ref: GraphHandler parsing the per-metric options list).
 """
 
 from __future__ import annotations
@@ -12,9 +21,55 @@ from __future__ import annotations
 import hashlib
 import io
 import os
+import re
 import time
 
+import numpy as np
+
 from opentsdb_tpu.query.model import parse_uri_query
+
+
+def _parse_range(spec: str) -> tuple[float | None, float | None]:
+    """gnuplot ``[lo:hi]`` (either side may be empty)."""
+    m = re.match(r"^\[([^:\]]*):([^:\]]*)\]$", spec.strip())
+    if not m:
+        raise ValueError(f"invalid range {spec!r} (want [lo:hi])")
+    lo = float(m.group(1)) if m.group(1).strip() else None
+    hi = float(m.group(2)) if m.group(2).strip() else None
+    return lo, hi
+
+
+def _color(spec: str) -> str:
+    """gnuplot ``xRRGGBB`` -> matplotlib ``#RRGGBB``."""
+    s = spec.strip()
+    return "#" + s[1:] if s.lower().startswith("x") else s
+
+
+_KEY_LOC = {
+    # gnuplot key position words -> matplotlib legend loc
+    "top right": "upper right", "top left": "upper left",
+    "bottom right": "lower right", "bottom left": "lower left",
+    "center": "center",
+}
+
+_STYLES = {
+    # ref: Plot.java style parameter values
+    "linespoint": {"linestyle": "-", "marker": "o", "markersize": 3},
+    "points": {"linestyle": "", "marker": "o", "markersize": 3},
+    "circles": {"linestyle": "", "marker": "o", "markersize": 5,
+                "fillstyle": "none"},
+    "dots": {"linestyle": "", "marker": ",", "markersize": 1},
+}
+
+
+def _smooth(xs: np.ndarray, ys: np.ndarray
+            ) -> tuple[np.ndarray, np.ndarray]:
+    """gnuplot ``smooth csplines`` analogue: dense monotone
+    interpolation through the points (numpy-only)."""
+    if len(xs) < 3:
+        return xs, ys
+    dense = np.linspace(xs[0], xs[-1], max(len(xs) * 8, 256))
+    return dense, np.interp(dense, xs, ys)
 
 
 def handle_graph(router, request):
@@ -26,7 +81,7 @@ def handle_graph(router, request):
     tsq.validate()
     results = router.tsdb.new_query().run(tsq)
 
-    if request.flag("ascii"):
+    if request.flag("ascii") or request.param("format") == "ascii":
         # one line per point: metric timestamp value tags (ref:
         # GraphHandler ascii output == `tsdb query` format)
         lines = []
@@ -38,7 +93,7 @@ def handle_graph(router, request):
         return HttpResponse(200, "\n".join(lines).encode(),
                             content_type="text/plain")
     if request.flag("json") or request.param("format") == "json":
-        body = router.serializer.format_query(tsq, results)
+        body = request.serializer.format_query(tsq, results)
         return HttpResponse(200, body)
 
     # PNG rendering
@@ -66,22 +121,83 @@ def handle_graph(router, request):
     wxh = (request.param("wxh") or "1024x768").split("x")
     fig, ax = plt.subplots(
         figsize=(int(wxh[0]) / 100, int(wxh[1]) / 100), dpi=100)
+    fg = request.param("fgcolor")
+    bg = request.param("bgcolor")
+    if bg:
+        fig.patch.set_facecolor(_color(bg))
+        ax.set_facecolor(_color(bg))
+    if fg:
+        for spine in ax.spines.values():
+            spine.set_color(_color(fg))
+        ax.tick_params(colors=_color(fg))
+        ax.xaxis.label.set_color(_color(fg))
+        ax.yaxis.label.set_color(_color(fg))
+        ax.title.set_color(_color(fg))
+
+    # per-metric option strings align with the m= sub-queries; the one
+    # recognized directive routes a sub-query to the right-hand axis
+    # (ref: GraphHandler "o" parameter, gnuplot "axis x1y2")
+    opts = request.params.get("o", [])
+    ax2 = None
+    if any("x1y2" in o for o in opts):
+        ax2 = ax.twinx()
+    style_kw = _STYLES.get(request.param("style", ""), {})
+    smooth = request.flag("smooth") or request.param("smooth")
+
     for r in results:
         label = r.metric
         if r.tags:
             label += "{" + ",".join(f"{k}={v}"
                                     for k, v in sorted(r.tags.items())) + "}"
-        xs = [ts / 1000 for ts, _ in r.dps]
-        ys = [v for _, v in r.dps]
-        ax.plot(xs, ys, label=label, linewidth=1)
+        xs = np.asarray([ts / 1000 for ts, _ in r.dps])
+        ys = np.asarray([v for _, v in r.dps], dtype=float)
+        if smooth and not style_kw.get("linestyle") == "":
+            xs, ys = _smooth(xs, ys)
+        target = ax
+        if ax2 is not None and r.sub_query_index < len(opts) and \
+                "x1y2" in opts[r.sub_query_index]:
+            target = ax2
+        target.plot(xs, ys, label=label, linewidth=1, **style_kw)
+
+    if request.param("title"):
+        ax.set_title(request.param("title"))
     if request.param("ylabel"):
         ax.set_ylabel(request.param("ylabel"))
-    if request.flag("nokey") is False and results:
-        ax.legend(loc="best", fontsize=8)
+    if ax2 is not None and request.param("y2label"):
+        ax2.set_ylabel(request.param("y2label"))
+    if request.param("yrange"):
+        lo, hi = _parse_range(request.param("yrange"))
+        ax.set_ylim(bottom=lo, top=hi)
+    if ax2 is not None and request.param("y2range"):
+        lo, hi = _parse_range(request.param("y2range"))
+        ax2.set_ylim(bottom=lo, top=hi)
+    if request.flag("ylog"):
+        ax.set_yscale("log")
+    if ax2 is not None and request.flag("y2log"):
+        ax2.set_yscale("log")
+    if request.param("yformat"):
+        from matplotlib.ticker import FormatStrFormatter
+        ax.yaxis.set_major_formatter(
+            FormatStrFormatter(request.param("yformat")))
+    if ax2 is not None and request.param("y2format"):
+        from matplotlib.ticker import FormatStrFormatter
+        ax2.yaxis.set_major_formatter(
+            FormatStrFormatter(request.param("y2format")))
+    if not request.flag("nokey") and results:
+        loc = _KEY_LOC.get(" ".join(
+            (request.param("key") or "").replace("out", "")
+            .split()), "best")
+        handles, labels = ax.get_legend_handles_labels()
+        if ax2 is not None:
+            h2, l2 = ax2.get_legend_handles_labels()
+            handles += h2
+            labels += l2
+        ax.legend(handles, labels, loc=loc, fontsize=8)
     ax.grid(True, alpha=0.3)
     fig.autofmt_xdate()
     buf = io.BytesIO()
-    fig.savefig(buf, format="png")
+    fig.savefig(buf, format="png",
+                facecolor=fig.get_facecolor() if bg else "white")
     plt.close(fig)
     png = buf.getvalue()
     with open(cache_file, "wb") as fh:

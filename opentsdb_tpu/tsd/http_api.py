@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import time
 import urllib.parse
 from dataclasses import dataclass, field
@@ -45,6 +46,7 @@ class HttpRequest:
     body: bytes = b""
     remote: str = ""
     auth: Any = None  # AuthState when authentication is enabled
+    serializer: Any = None  # set by the router (?serializer= choice)
 
     def param(self, key: str, default: str | None = None) -> str | None:
         vals = self.params.get(key)
@@ -84,12 +86,19 @@ class HttpRpcRouter:
         self.tsdb = tsdb
         # pluggable wire format (ref: HttpSerializer.java:93,
         # tsd.http.serializer selection in RpcManager)
+        self.serializers: dict[str, Any] = {}
+        default_json = HttpJsonSerializer()
+        self.serializers[default_json.shortname] = default_json
         ser_path = tsdb.config.get_string("tsd.http.serializer.plugin", "")
         if ser_path:
             from opentsdb_tpu.utils.plugin import load_class
-            self.serializer = load_class(ser_path)()
+            plugin_ser = load_class(ser_path)()
+            # registered under its shortname AND made the default
+            # (ref: the shortname registry, HttpSerializer.java:93)
+            self.serializers[plugin_ser.shortname] = plugin_ser
+            self.serializer = plugin_ser
         else:
-            self.serializer = HttpJsonSerializer()
+            self.serializer = default_json
         mode = tsdb.mode
         self._routes: dict[str, Callable] = {}
         # read RPCs (not registered in write-only mode, RpcManager:274)
@@ -128,29 +137,48 @@ class HttpRpcRouter:
     # ------------------------------------------------------------------
 
     def handle(self, request: HttpRequest) -> HttpResponse:
+        # content negotiation: ?serializer=<shortname> picks a
+        # registered wire format (ref: HttpSerializer.java:93)
+        request.serializer = self.serializer
+        name = request.param("serializer")
+        if name:
+            chosen = self.serializers.get(name)
+            if chosen is None:
+                return HttpResponse(
+                    400, self.serializer.format_error(
+                        400, f"Unable to find serializer "
+                        f"with name '{name}'"))
+            request.serializer = chosen
         try:
-            return self._dispatch(request)
+            resp = self._dispatch(request)
+            if (request.serializer is not None
+                    and resp.content_type
+                    == HttpResponse.__dataclass_fields__[
+                        "content_type"].default):
+                resp.content_type = \
+                    request.serializer.response_content_type
+            return resp
         except HttpError as e:
-            return HttpResponse(e.status, self.serializer.format_error(
+            return HttpResponse(e.status, request.serializer.format_error(
                 e.status, e.message, e.details))
         except BadRequestError as e:
-            return HttpResponse(400, self.serializer.format_error(
+            return HttpResponse(400, request.serializer.format_error(
                 400, str(e)))
         except ValueError as e:
-            return HttpResponse(400, self.serializer.format_error(
+            return HttpResponse(400, request.serializer.format_error(
                 400, str(e)))
         except QueryLimitExceeded as e:
             # over-budget scans are a client-fixable condition
-            return HttpResponse(413, self.serializer.format_error(
+            return HttpResponse(413, request.serializer.format_error(
                 413, str(e)))
         except NotImplementedError as e:
-            return HttpResponse(501, self.serializer.format_error(
+            return HttpResponse(501, request.serializer.format_error(
                 501, str(e) or "not implemented"))
         except Exception as e:  # noqa: BLE001 (ref: RpcHandler 500 path)
             import traceback
             details = traceback.format_exc() if self.tsdb.config.get_bool(
                 "tsd.http.show_stack_trace") else ""
-            return HttpResponse(500, self.serializer.format_error(
+            return HttpResponse(500, request.serializer.format_error(
                 500, f"{type(e).__name__}: {e}", details))
 
     def _dispatch(self, request: HttpRequest) -> HttpResponse:
@@ -211,7 +239,7 @@ class HttpRpcRouter:
         if request.method != "POST":
             raise HttpError(405, "Method not allowed",
                             "The HTTP method is not permitted")
-        points = self.serializer.parse_put(request.body)
+        points = request.serializer.parse_put(request.body)
         details = request.flag("details")
         summary = request.flag("summary")
         errors: list[dict] = []
@@ -263,13 +291,13 @@ class HttpRpcRouter:
             return HttpResponse(204)
         return HttpResponse(
             400 if failed else 200,
-            self.serializer.format_put(success, failed, errors, details))
+            request.serializer.format_put(success, failed, errors, details))
 
     def _handle_rollup(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: RollupDataPointRpc.java:227)"""
         if request.method != "POST":
             raise HttpError(405, "Method not allowed")
-        points = self.serializer.parse_put(request.body)
+        points = request.serializer.parse_put(request.body)
         success = 0
         errors: list[dict] = []
         for dp in points:
@@ -294,7 +322,7 @@ class HttpRpcRouter:
                             "; ".join(e["error"] for e in errors[:5]))
         return HttpResponse(
             400 if errors else 200,
-            self.serializer.format_put(success, len(errors), errors,
+            request.serializer.format_put(success, len(errors), errors,
                                        request.flag("details")))
 
     def _handle_histogram(self, request: HttpRequest, rest) -> HttpResponse:
@@ -302,7 +330,7 @@ class HttpRpcRouter:
         blob (HistogramPojo)."""
         if request.method != "POST":
             raise HttpError(405, "Method not allowed")
-        points = self.serializer.parse_put(request.body)
+        points = request.serializer.parse_put(request.body)
         success = 0
         errors: list[dict] = []
         for dp in points:
@@ -319,7 +347,7 @@ class HttpRpcRouter:
             raise HttpError(400, "One or more data points had errors")
         return HttpResponse(
             400 if errors else 200,
-            self.serializer.format_put(success, len(errors), errors,
+            request.serializer.format_put(success, len(errors), errors,
                                        request.flag("details")))
 
     # -- read path -----------------------------------------------------
@@ -338,7 +366,7 @@ class HttpRpcRouter:
                 return handle_exp(self, request)
             return handle_gexp(self, request)
         if request.method == "POST":
-            obj = self.serializer.parse_query(request.body)
+            obj = request.serializer.parse_query(request.body)
             tsq = TSQuery.from_json(obj)
         elif request.method in ("GET", "DELETE"):
             tsq = parse_uri_query(request.params)
@@ -354,13 +382,28 @@ class HttpRpcRouter:
         stats = QueryStats(request.remote, tsq)
         try:
             results = self.tsdb.new_query().run(tsq, stats)
+            from opentsdb_tpu.stats.stats import QueryStat
+            t_ser = time.monotonic()
+            stats.add_stat(
+                QueryStat.EMITTED_DPS,
+                sum(len(r.dps) for r in results))
+            if tsq.show_stats or request.flag("show_stats"):
+                # the NaN census walks every emitted point: only when
+                # the caller asked for stats (ref: nanDPs)
+                stats.add_stat(QueryStat.NAN_DPS, sum(
+                    1 for r in results for _, v in r.dps if v != v))
+            body = request.serializer.format_query(
+                tsq, results, as_arrays=request.flag("arrays"),
+                show_summary=tsq.show_summary
+                or request.flag("show_summary"),
+                show_stats=tsq.show_stats or request.flag("show_stats"),
+                summary_extra=stats.stats)
+            stats.add_stat(QueryStat.SERIALIZATION_TIME,
+                           (time.monotonic() - t_ser) * 1e3)
+            stats.add_stat(QueryStat.PROCESSING_PRE_WRITE_TIME,
+                           (time.monotonic_ns() - stats.start_ns) / 1e6)
         finally:
             stats.mark_serialization_successful()
-        body = self.serializer.format_query(
-            tsq, results, as_arrays=request.flag("arrays"),
-            show_summary=tsq.show_summary or request.flag("show_summary"),
-            show_stats=tsq.show_stats or request.flag("show_stats"),
-            summary_extra=stats.stats)
         return HttpResponse(200, body)
 
     def _handle_query_last(self, request: HttpRequest) -> HttpResponse:
@@ -378,7 +421,7 @@ class HttpRpcRouter:
             resolve = request.flag("resolve")
         points = last_data_points(self.tsdb, specs, back_scan, resolve)
         return HttpResponse(200,
-                            self.serializer.format_last_points(points))
+                            request.serializer.format_last_points(points))
 
     def _handle_suggest(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: SuggestRpc.java:30)"""
@@ -399,7 +442,7 @@ class HttpRpcRouter:
             names = self.tsdb.suggest_tag_values(q, max_results)
         else:
             raise BadRequestError(f"Invalid 'type' parameter: {stype}")
-        return HttpResponse(200, self.serializer.format_suggest(names))
+        return HttpResponse(200, request.serializer.format_suggest(names))
 
     def _handle_search(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: SearchRpc.java; /api/search/lookup via
@@ -424,13 +467,13 @@ class HttpRpcRouter:
                 use_meta = request.flag("use_meta")
             results = time_series_lookup(self.tsdb, metric, tags, limit,
                                          use_meta)
-            return HttpResponse(200, self.serializer.format_search(results))
+            return HttpResponse(200, request.serializer.format_search(results))
         if self.tsdb.search_plugin is None:
             raise BadRequestError(
                 "Searching is not enabled on this TSD")
         obj = json.loads(request.body or b"{}")
         results = self.tsdb.search_plugin.execute_query(sub, obj)
-        return HttpResponse(200, self.serializer.format_search(results))
+        return HttpResponse(200, request.serializer.format_search(results))
 
     # -- annotations (ref: AnnotationRpc.java) -------------------------
 
@@ -445,7 +488,7 @@ class HttpRpcRouter:
             note = store.get(tsuid.upper() if tsuid else "", start)
             if note is None:
                 raise HttpError(404, "Unable to locate annotation in storage")
-            return HttpResponse(200, self.serializer.format_annotation(note))
+            return HttpResponse(200, request.serializer.format_annotation(note))
         if request.method in ("POST", "PUT"):
             obj = json.loads(request.body or b"{}")
             note = Annotation.from_json(obj)
@@ -465,7 +508,7 @@ class HttpRpcRouter:
             store.store(note)
             if self.tsdb.search_plugin is not None:
                 self.tsdb.search_plugin.index_annotation(note)
-            return HttpResponse(200, self.serializer.format_annotation(note))
+            return HttpResponse(200, request.serializer.format_annotation(note))
         if request.method == "DELETE":
             tsuid = (request.param("tsuid", "") or "").upper()
             start = int(request.param("start_time", "0"))
@@ -488,7 +531,7 @@ class HttpRpcRouter:
                 store.store(note)
                 notes.append(note)
             return HttpResponse(200,
-                                self.serializer.format_annotations(notes))
+                                request.serializer.format_annotations(notes))
         if request.method == "DELETE":
             obj = json.loads(request.body or b"{}")
             tsuids = obj.get("tsuids")
@@ -512,7 +555,7 @@ class HttpRpcRouter:
         start = int(request.param("start_time", "0"))
         end = int(request.param("end_time") or time.time())
         notes = self.tsdb.annotations.global_range(start, end)
-        return HttpResponse(200, self.serializer.format_annotations(notes))
+        return HttpResponse(200, request.serializer.format_annotations(notes))
 
     # -- uid (ref: UniqueIdRpc.java) -----------------------------------
 
@@ -538,6 +581,16 @@ class HttpRpcRouter:
                    if request.has_param(k)}
         response: dict[str, Any] = {}
         had_error = False
+        from opentsdb_tpu.auth.simple import Permissions
+        create_perm = {"metric": Permissions.CREATE_METRIC,
+                       "tagk": Permissions.CREATE_TAGK,
+                       "tagv": Permissions.CREATE_TAGV}
+        # every requested kind's creation permission is checked BEFORE
+        # any assignment commits, so a 403 can't discard partial work
+        # (ref: Permissions.java:27 CREATE_TAGK/TAGV/METRIC)
+        for kind in ("metric", "tagk", "tagv"):
+            if obj.get(kind):
+                self._check_permission(request, create_perm[kind])
         for kind in ("metric", "tagk", "tagv"):
             names = obj.get(kind) or []
             if isinstance(names, str):
@@ -557,7 +610,7 @@ class HttpRpcRouter:
                 if bad:
                     response[f"{kind}_errors"] = bad
         return HttpResponse(400 if had_error else 200,
-                            self.serializer.format_uid_assign(response))
+                            request.serializer.format_uid_assign(response))
 
     def _uid_rename(self, request: HttpRequest) -> HttpResponse:
         obj = json.loads(request.body or b"{}") \
@@ -596,10 +649,53 @@ class HttpRpcRouter:
                 from opentsdb_tpu.meta.meta_store import UIDMeta
                 meta = UIDMeta(uid=uid, type=kind.upper(), name=name)
             return HttpResponse(200, json.dumps(meta.to_json()).encode())
-        raise HttpError(405, "Method not allowed",
-                        "uidmeta editing requires realtime meta tracking")
+        from opentsdb_tpu.meta.meta_store import MetaStore
+        fields = self._meta_request_fields(request)
+        uid = (fields.get("uid") or request.param("uid", "")
+               or "").upper()
+        kind = (fields.get("type") or request.param("type", "")
+                or "").lower()
+        if not uid or kind not in ("metric", "tagk", "tagv"):
+            raise BadRequestError("Missing/invalid uid or type")
+        if request.method in ("POST", "PUT"):
+            # merge-on-POST, replace-on-PUT
+            # (ref: UniqueIdRpc.java:179-226 syncToStorage overwrite)
+            try:
+                meta = self.tsdb.meta.sync_uid_meta(
+                    kind, uid, fields, request.method == "PUT")
+            except MetaStore.NotModified:
+                return HttpResponse(304, b"")
+            except LookupError:
+                raise HttpError(
+                    404, "Could not find the requested UID") from None
+            return HttpResponse(200,
+                                json.dumps(meta.to_json()).encode())
+        if request.method == "DELETE":
+            self.tsdb.meta.delete_uid_meta(kind, uid)
+            return HttpResponse(204, b"")
+        raise HttpError(405, "Method not allowed")
+
+    @staticmethod
+    def _meta_request_fields(request: HttpRequest) -> dict:
+        """Body JSON, or the query-string form of the same fields
+        (ref: parseUIDMetaQS / parseTSMetaQS)."""
+        if request.body:
+            obj = json.loads(request.body)
+            if not isinstance(obj, dict):
+                raise BadRequestError("Invalid meta content")
+            return obj
+        out = {}
+        for key in ("uid", "type", "tsuid", "m", "displayName",
+                    "display_name", "description", "notes", "units",
+                    "dataType", "retention", "max", "min"):
+            val = request.param(key)
+            if val is not None:
+                out["displayName" if key == "display_name"
+                    else key] = val
+        return out
 
     def _ts_meta(self, request: HttpRequest) -> HttpResponse:
+        from opentsdb_tpu.meta.meta_store import MetaStore
         if request.method == "GET":
             tsuid = (request.param("tsuid", "") or "").upper()
             meta = self.tsdb.meta.get_ts_meta(tsuid)
@@ -607,7 +703,55 @@ class HttpRpcRouter:
                 raise HttpError(
                     404, "Could not find Timeseries meta data")
             return HttpResponse(200, json.dumps(meta.to_json()).encode())
+        fields = self._meta_request_fields(request)
+        tsuid = (fields.get("tsuid") or request.param("tsuid", "")
+                 or "").upper()
+        create = False
+        if not tsuid:
+            # "m=metric{tagk=tagv,...}" spec form; create=true
+            # materializes the doc (ref: UniqueIdRpc getTSUIDForMetric)
+            mspec = fields.get("m") or request.param("m")
+            if not mspec:
+                raise BadRequestError("Missing tsuid or m parameter")
+            try:
+                tsuid = self._tsuid_for_metric(mspec)
+            except LookupError as e:
+                # unknown metric/tag name in the spec is a client error
+                raise HttpError(404, str(e)) from None
+            create = (fields.get("create") or request.param(
+                "create", "") or "") in ("true", True)
+        if request.method in ("POST", "PUT"):
+            try:
+                meta = self.tsdb.meta.sync_ts_meta(
+                    tsuid, fields, request.method == "PUT",
+                    create=create)
+            except MetaStore.NotModified:
+                return HttpResponse(304, b"")
+            except LookupError as e:
+                raise HttpError(404, str(e)) from None
+            return HttpResponse(200,
+                                json.dumps(meta.to_json()).encode())
+        if request.method == "DELETE":
+            self.tsdb.meta.delete_ts_meta(tsuid)
+            return HttpResponse(204, b"")
         raise HttpError(405, "Method not allowed")
+
+    def _tsuid_for_metric(self, mspec: str) -> str:
+        """``metric{tagk=tagv,...}`` -> tsuid hex
+        (ref: UniqueIdRpc.getTSUIDForMetric)."""
+        m = re.match(r"^([^{]+)(?:\{([^}]*)\})?$", mspec.strip())
+        if not m:
+            raise BadRequestError(f"Invalid metric spec {mspec!r}")
+        uids = self.tsdb.uids
+        metric_id = uids.metrics.get_id(m.group(1))
+        tag_ids = []
+        for pair in (m.group(2) or "").split(","):
+            if not pair:
+                continue
+            k, _, v = pair.partition("=")
+            tag_ids.append((uids.tag_names.get_id(k.strip()),
+                            uids.tag_values.get_id(v.strip())))
+        return uids.tsuid(metric_id, sorted(tag_ids)).hex().upper()
 
     # -- tree (ref: TreeRpc.java) --------------------------------------
 
@@ -620,19 +764,19 @@ class HttpRpcRouter:
     def _handle_aggregators(self, request: HttpRequest, rest
                             ) -> HttpResponse:
         return HttpResponse(
-            200, self.serializer.format_aggregators(aggs_mod.names()))
+            200, request.serializer.format_aggregators(aggs_mod.names()))
 
     def _handle_config(self, request: HttpRequest, rest) -> HttpResponse:
         if rest and rest[0] == "filters":
             return HttpResponse(200, json.dumps(
                 filters_mod.filter_types()).encode())
-        return HttpResponse(200, self.serializer.format_config(
+        return HttpResponse(200, request.serializer.format_config(
             self.tsdb.config.dump_configuration()))
 
     def _handle_dropcaches(self, request: HttpRequest, rest
                            ) -> HttpResponse:
         self.tsdb.drop_caches()
-        return HttpResponse(200, self.serializer.format_dropcaches(
+        return HttpResponse(200, request.serializer.format_dropcaches(
             {"status": "200", "message": "Caches dropped"}))
 
     def _handle_stats(self, request: HttpRequest, rest) -> HttpResponse:
@@ -640,7 +784,7 @@ class HttpRpcRouter:
         /region_clients)"""
         sub = rest[0] if rest else ""
         if sub == "query":
-            return HttpResponse(200, self.serializer.format_query_stats(
+            return HttpResponse(200, request.serializer.format_query_stats(
                 QueryStats.running_and_completed()))
         if sub == "jvm":
             return HttpResponse(200, json.dumps(
@@ -660,7 +804,7 @@ class HttpRpcRouter:
             }]).encode())
         collector = self.tsdb.stats.collect()
         self.tsdb.collect_stats(collector)
-        return HttpResponse(200, self.serializer.format_stats(
+        return HttpResponse(200, request.serializer.format_stats(
             collector.as_json()))
 
     def _runtime_stats(self) -> dict[str, Any]:
@@ -677,7 +821,7 @@ class HttpRpcRouter:
         }
 
     def _handle_version(self, request: HttpRequest, rest) -> HttpResponse:
-        return HttpResponse(200, self.serializer.format_version(
+        return HttpResponse(200, request.serializer.format_version(
             version_info()))
 
     # -- misc ----------------------------------------------------------

@@ -172,6 +172,7 @@ class TSDServer:
     async def _serve_telnet(self, first: bytes, reader, writer) -> None:
         buffer = first
         authed = self.tsdb.authentication is None
+        auth_state = None
         while True:
             line_end = buffer.find(b"\n")
             if line_end < 0:
@@ -192,6 +193,7 @@ class TSDServer:
                         words)
                     if state.status == AuthStatus.SUCCESS:
                         authed = True
+                        auth_state = state
                         writer.write(b"auth_success\n")
                     else:
                         writer.write(b"auth_fail\n")
@@ -200,7 +202,8 @@ class TSDServer:
                 await writer.drain()
                 continue
             try:
-                response = self.telnet_router.execute(line)
+                response = self.telnet_router.execute(line,
+                                                      auth=auth_state)
             except TelnetCloseConnection:
                 return
             if response:
@@ -294,6 +297,7 @@ class TSDServer:
                 self.tsdb.stats.latency_query.add(
                     (time.monotonic() - t0) * 1000)
             self._apply_cors(request, response)
+            await self._apply_gzip(request, response)
             await self._write_response(writer, response, version,
                                        keep_alive)
 
@@ -318,9 +322,35 @@ class TSDServer:
         if "*" in self.cors_domains or origin in self.cors_domains:
             response.headers["Access-Control-Allow-Origin"] = origin
 
+    # responses below this size aren't worth the deflate round trip
+    _GZIP_MIN_BYTES = 1024
+
+    async def _apply_gzip(self, request: HttpRequest,
+                          response: HttpResponse) -> None:
+        """Compress large response bodies when the client advertises
+        gzip support (ref: the reference's Netty HttpContentCompressor
+        in PipelineFactory — responses compress per Accept-Encoding).
+        The deflate runs on a worker thread: compressing a multi-MB
+        body inline would stall every connection on the event loop."""
+        if len(response.body) < self._GZIP_MIN_BYTES:
+            return
+        if "Content-Encoding" in response.headers:
+            return
+        accept = request.headers.get("accept-encoding", "")
+        if "gzip" not in accept.lower():
+            return
+        import gzip as _gzip
+        response.body = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: _gzip.compress(response.body,
+                                         compresslevel=6))
+        response.headers["Content-Encoding"] = "gzip"
+        # shared caches must key on the encoding
+        response.headers["Vary"] = "Accept-Encoding"
+
     async def _write_response(self, writer, response: HttpResponse,
                               version: str, keep_alive: bool) -> None:
-        reason = {200: "OK", 204: "No Content", 400: "Bad Request",
+        reason = {200: "OK", 204: "No Content", 304: "Not Modified",
+                  400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden",
                   404: "Not Found", 405: "Method Not Allowed",
                   413: "Request Entity Too Large", 500:

@@ -42,13 +42,21 @@ class TelnetRouter:
             "diediedie": self._cmd_die,
         })
 
-    def execute(self, line: str) -> str:
+    def execute(self, line: str, auth=None) -> str:
         words = line.split()
         if not words:
             return ""
         cmd = self.commands.get(words[0])
         if cmd is None:
             return f"error: unknown command: {words[0]}"
+        if auth is not None and words[0] in ("put", "rollup",
+                                             "histogram"):
+            # telnet writes are gated per role
+            # (ref: Permissions.TELNET_PUT, Permissions.java:26)
+            from opentsdb_tpu.auth.simple import Permissions
+            if not auth.has_permission(Permissions.TELNET_PUT):
+                return (f"{words[0]}: permission denied "
+                        "(TELNET_PUT not granted)")
         return cmd(words)
 
     # ------------------------------------------------------------------

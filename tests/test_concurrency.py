@@ -1,0 +1,142 @@
+"""Concurrent ingest / query / snapshot / delete stress
+(SURVEY.md §5.2 — the reference relies on convention + Deferred
+confinement; the TPU build's host store claims lock-based safety and
+this suite hammers it on both backends)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+BASE = 1356998400
+
+
+def _query(t, metric="m.stress"):
+    q = TSQuery.from_json({
+        "start": BASE * 1000, "end": (BASE + 100_000) * 1000,
+        "queries": [{"metric": metric, "aggregator": "sum",
+                     "downsample": "1m-sum"}]})
+    try:
+        return t.execute_query(q.validate())
+    except Exception as e:  # noqa: BLE001
+        # an unknown metric early in the race is fine; anything else
+        # is a real failure
+        if "No such name" in str(e):
+            return []
+        raise
+
+
+@pytest.mark.parametrize("backend", ["memory", "native"])
+def test_concurrent_put_query_snapshot_delete(tmp_path, backend):
+    t = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": backend,
+        "tsd.storage.data_dir": str(tmp_path / backend),
+    }))
+    stop = threading.Event()
+    failures: list[BaseException] = []
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except BaseException as e:  # noqa: BLE001
+                failures.append(e)
+                stop.set()
+        return run
+
+    counter = {"n": 0}
+
+    def writer():
+        i = counter["n"]
+        counter["n"] += 1
+        ts = BASE + (i % 50_000)
+        t.add_point("m.stress", ts, float(i),
+                    {"host": f"h{i % 23:02d}"})
+        if i % 97 == 0:
+            t.add_points("m.stress",
+                         np.arange(BASE, BASE + 300, 10,
+                                   dtype=np.int64) + (i % 7),
+                         np.full(30, float(i)),
+                         {"host": f"hb{i % 5}"})
+
+    def hist_writer():
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        h = SimpleHistogram([0.0, 10.0, 20.0])
+        h.add(5.0, 3)
+        blob = t.histogram_manager.encode(h)
+        t.add_histogram_point("m.hist", BASE, blob, {"host": "a"})
+
+    def reader():
+        _query(t)
+
+    def snapshotter():
+        t.flush()
+        time.sleep(0.005)
+
+    def deleter():
+        try:
+            mid = t.uids.metrics.get_id("m.stress")
+        except LookupError:
+            return
+        sids = t.store.series_ids_for_metric(mid)
+        if len(sids):
+            t.store.delete_range(sids[:3], BASE * 1000,
+                                 (BASE + 100) * 1000)
+        time.sleep(0.002)
+
+    threads = [threading.Thread(target=guard(fn), daemon=True)
+               for fn in (writer, writer, hist_writer, reader, reader,
+                          snapshotter, deleter)]
+    for th in threads:
+        th.start()
+    time.sleep(3.0)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive(), "stress thread wedged"
+    assert not failures, failures[:1]
+    # the store must still answer coherently after the storm
+    res = _query(t)
+    assert isinstance(res, list)
+    # and a final snapshot must round-trip
+    t.flush()
+    t2 = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": backend,
+        "tsd.storage.data_dir": str(tmp_path / backend),
+    }))
+    assert t2.store.total_points() > 0
+
+
+def test_concurrent_uid_assignment_unique():
+    """Parallel auto-creation of the same names must converge to one
+    UID per name (ref: UniqueId CAS assignment, UniqueId.java:596)."""
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    results: dict[int, list[int]] = {}
+    barrier = threading.Barrier(8)
+
+    def worker(slot):
+        barrier.wait()
+        out = []
+        for i in range(200):
+            out.append(t.uids.metrics.get_or_create_id(f"m{i % 50}"))
+        results[slot] = out
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    # every thread resolved each name to the same id
+    for i in range(50):
+        ids = {results[s][j] for s in results
+               for j in range(i, 200, 50)}
+        assert len(ids) == 1
+    assert t.uids.metrics.get_or_create_id("m0") == results[0][0]

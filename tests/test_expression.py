@@ -274,3 +274,159 @@ class TestInfixParser:
     def test_float_literals(self):
         out = evaluate_expression("a * 0.5", self.vars)
         assert list(out.values[0]) == [1.0, 2.0]
+
+
+class TestPojoJoinAndFill:
+    """pojo Join operator + NumericFillPolicy threading
+    (ref: pojo/Join.java, expression/NumericFillPolicy.java,
+    QueryExecutor.java:222)."""
+
+    def setup_method(self):
+        self.vars = {
+            "a": frame([0, 1000], [[2.0, 4.0], [10.0, 20.0]],
+                       tags=[{"host": "x"}, {"host": "y"}]),
+            "b": frame([0, 1000], [[3.0, 5.0]],
+                       tags=[{"host": "x"}]),
+        }
+
+    def test_intersection_drops_disjoint_series(self):
+        out = evaluate_expression("a + b", self.vars,
+                                  join_operator="intersection")
+        assert out.num_series == 1
+        assert out.tags == [{"host": "x"}]
+        assert list(out.values[0]) == [5.0, 9.0]
+
+    def test_union_keeps_disjoint_with_fill(self):
+        out = evaluate_expression("a + b", self.vars,
+                                  join_operator="union",
+                                  fill_missing=0.0)
+        assert out.num_series == 2
+        by_host = {t["host"]: i for i, t in enumerate(out.tags)}
+        assert list(out.values[by_host["y"]]) == [10.0, 20.0]
+
+    def test_nan_fill_leaves_holes(self):
+        import numpy as np
+        out = evaluate_expression("a + b", self.vars,
+                                  join_operator="union",
+                                  fill_missing=float("nan"))
+        by_host = {t["host"]: i for i, t in enumerate(out.tags)}
+        assert np.isnan(out.values[by_host["y"]]).all()
+
+
+class TestExpEndpointPojo:
+    """/api/query/exp with join/fillPolicy/rate/alias
+    (ref: TestQueryExecutor scenarios)."""
+
+    BASE = 1356998400
+
+    def _router(self):
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tsd.http_api import HttpRpcRouter
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+        for i in range(4):
+            t.add_point("m.a", self.BASE + i * 60, 10 * (i + 1),
+                        {"host": "x"})
+            t.add_point("m.b", self.BASE + i * 60, i + 1,
+                        {"host": "x"})
+        # m.a also has a host the b-side lacks
+        for i in range(4):
+            t.add_point("m.a", self.BASE + i * 60, 5.0, {"host": "y"})
+        return t, HttpRpcRouter(t)
+
+    def _exp_body(self, expr_spec, outputs=None):
+        return {
+            "time": {"start": str(self.BASE),
+                     "end": str(self.BASE + 300),
+                     "aggregator": "sum"},
+            "filters": [{"id": "f1", "tags": [
+                {"type": "wildcard", "tagk": "host", "filter": "*",
+                 "groupBy": True}]}],
+            "metrics": [
+                {"id": "A", "metric": "m.a", "filter": "f1"},
+                {"id": "B", "metric": "m.b", "filter": "f1"}],
+            "expressions": [expr_spec],
+            "outputs": outputs or [{"id": expr_spec["id"]}],
+        }
+
+    def _post(self, router, body):
+        import json as _json
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        resp = router.handle(HttpRequest(
+            "POST", "/api/query/exp", {}, {},
+            _json.dumps(body).encode()))
+        assert resp.status == 200, resp.body
+        return _json.loads(resp.body)
+
+    def test_join_intersection(self):
+        t, router = self._router()
+        out = self._post(router, self._exp_body(
+            {"id": "e", "expr": "A + B",
+             "join": {"operator": "intersection"}}))
+        o = out["outputs"][0]
+        # host=y exists only on the A side: intersection drops it
+        assert o["dpsMeta"]["series"] == 1
+        assert o["meta"][1]["commonTags"] == {"host": "x"}
+
+    def test_union_with_scalar_fill(self):
+        t, router = self._router()
+        out = self._post(router, self._exp_body(
+            {"id": "e", "expr": "A + B",
+             "join": {"operator": "union"},
+             "fillPolicy": {"policy": "scalar", "value": 100}}))
+        o = out["outputs"][0]
+        assert o["dpsMeta"]["series"] == 2
+        hosts = {tuple(m["commonTags"].items()): m["index"]
+                 for m in o["meta"][1:]}
+        y_col = hosts[(("host", "y"),)]
+        # B missing on host=y fills with 100: 5 + 100
+        assert o["dps"][0][y_col] == 105
+
+    def test_rate_in_pojo_metric(self):
+        t, router = self._router()
+        body = self._exp_body({"id": "e", "expr": "A + 0"})
+        body["metrics"][0]["rate"] = True
+        out = self._post(router, body)
+        o = out["outputs"][0]
+        # m.a host=x climbs 10 per 60s -> rate 1/6; host=y flat -> 0
+        vals = sorted(v for v in o["dps"][0][1:])
+        assert vals[0] == 0
+        assert abs(vals[1] - 10 / 60) < 1e-9
+
+    def test_output_alias_applied_to_meta(self):
+        t, router = self._router()
+        out = self._post(router, self._exp_body(
+            {"id": "e", "expr": "A + B"},
+            outputs=[{"id": "e", "alias": "my-output"}]))
+        o = out["outputs"][0]
+        assert o["alias"] == "my-output"
+        assert o["meta"][1]["metrics"] == ["my-output"]
+
+    def test_include_agg_tags_false(self):
+        t, router = self._router()
+        body = {
+            "time": {"start": str(self.BASE),
+                     "end": str(self.BASE + 300),
+                     "aggregator": "sum"},
+            "metrics": [
+                {"id": "A", "metric": "m.a"},
+                {"id": "B", "metric": "m.b"}],
+            "expressions": [
+                {"id": "e", "expr": "A + B",
+                 "join": {"operator": "union",
+                          "includeAggTags": False}}],
+            "outputs": [{"id": "e"}],
+        }
+        out = self._post(router, body)
+        assert out["outputs"][0]["meta"][1]["aggregatedTags"] == []
+
+    def test_bad_join_operator_400(self):
+        import json as _json
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        t, router = self._router()
+        body = self._exp_body(
+            {"id": "e", "expr": "A + B",
+             "join": {"operator": "cross"}})
+        resp = router.handle(HttpRequest(
+            "POST", "/api/query/exp", {}, {},
+            _json.dumps(body).encode()))
+        assert resp.status == 400

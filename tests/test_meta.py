@@ -175,3 +175,196 @@ class TestAnnotationStore:
         js = Annotation(start_time=1).to_json()
         assert "tsuid" not in js
         assert Annotation.from_json(js).tsuid == GLOBAL_TSUID
+
+
+# ---------------------------------------------------------------------------
+# editing RPCs (ref: TestUniqueIdRpc uidmeta/tsmeta POST/PUT/DELETE,
+# UniqueIdRpc.java:179-226,314; TSMeta.java:222 syncToStorage)
+# ---------------------------------------------------------------------------
+
+class TestMetaEditingRpc:
+    def _router(self, tsdb):
+        from opentsdb_tpu.tsd.http_api import HttpRpcRouter
+        return HttpRpcRouter(tsdb)
+
+    def _req(self, method, path, params=None, body=b""):
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        return HttpRequest(method, path,
+                           {k: [v] for k, v in (params or {}).items()},
+                           {}, body)
+
+    def _uid_hex(self, tsdb, name="sys.cpu.user"):
+        mid = tsdb.uids.metrics.get_id(name)
+        return tsdb.uids.metrics.int_to_uid(mid).hex().upper()
+
+    def test_uidmeta_post_merges(self):
+        import json
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        uid = self._uid_hex(tsdb)
+        r = router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": uid, "type": "metric",
+                 "displayName": "CPU"}).encode()))
+        assert r.status == 200
+        out = json.loads(r.body)
+        assert out["displayName"] == "CPU"
+        # merge: a second POST changing only notes keeps displayName
+        r = router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": uid, "type": "metric",
+                 "notes": "hello"}).encode()))
+        out = json.loads(r.body)
+        assert out["displayName"] == "CPU" and out["notes"] == "hello"
+
+    def test_uidmeta_put_replaces(self):
+        import json
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        uid = self._uid_hex(tsdb)
+        router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": uid, "type": "metric", "displayName": "CPU",
+                 "notes": "keepme?"}).encode()))
+        r = router.handle(self._req(
+            "PUT", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": uid, "type": "metric",
+                 "description": "replaced"}).encode()))
+        out = json.loads(r.body)
+        # PUT resets unspecified editable fields
+        assert out["description"] == "replaced"
+        assert out["displayName"] == "" and out["notes"] == ""
+
+    def test_uidmeta_unchanged_post_304(self):
+        import json
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        uid = self._uid_hex(tsdb)
+        body = json.dumps({"uid": uid, "type": "metric",
+                           "displayName": "X"}).encode()
+        assert router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=body)).status == 200
+        assert router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=body)).status == 304
+
+    def test_uidmeta_unknown_uid_404(self):
+        import json
+        tsdb = tracking_tsdb()
+        r = self._router(tsdb).handle(self._req(
+            "POST", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": "FFFFFF", "type": "metric",
+                 "displayName": "X"}).encode()))
+        assert r.status == 404
+
+    def test_uidmeta_delete(self):
+        import json
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        uid = self._uid_hex(tsdb)
+        router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": uid, "type": "metric",
+                 "displayName": "X"}).encode()))
+        r = router.handle(self._req(
+            "DELETE", "/api/uid/uidmeta",
+            params={"uid": uid, "type": "metric"}))
+        assert r.status == 204
+        assert tsdb.meta.get_uid_meta("metric", uid) is None
+
+    def test_tsmeta_post_put_delete_roundtrip(self):
+        import json
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        tsuid = tsdb.meta.all_ts_meta()[0].tsuid
+        r = router.handle(self._req(
+            "POST", "/api/uid/tsmeta", body=json.dumps(
+                {"tsuid": tsuid, "units": "ms",
+                 "retention": 30}).encode()))
+        assert r.status == 200
+        out = json.loads(r.body)
+        assert out["units"] == "ms" and out["retention"] == 30
+        r = router.handle(self._req(
+            "PUT", "/api/uid/tsmeta", body=json.dumps(
+                {"tsuid": tsuid, "description": "d"}).encode()))
+        out = json.loads(r.body)
+        assert out["description"] == "d" and out["units"] == ""
+        r = router.handle(self._req(
+            "DELETE", "/api/uid/tsmeta", params={"tsuid": tsuid}))
+        assert r.status == 204
+        assert tsdb.meta.get_ts_meta(tsuid) is None
+
+    def test_tsmeta_unknown_tsuid_404(self):
+        import json
+        tsdb = tracking_tsdb()
+        r = self._router(tsdb).handle(self._req(
+            "POST", "/api/uid/tsmeta", body=json.dumps(
+                {"tsuid": "00000100000100AAAA",
+                 "units": "x"}).encode()))
+        assert r.status == 404
+
+    def test_tsmeta_metric_spec_create(self):
+        import json
+        tsdb = tracking_tsdb()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        # target an UNTRACKED series written before tracking: use a
+        # spec with create=true
+        r = router.handle(self._req(
+            "POST", "/api/uid/tsmeta",
+            params={"m": "sys.cpu.user{host=a}", "create": "true"},
+            body=json.dumps({"m": "sys.cpu.user{host=a}",
+                             "create": "true",
+                             "displayName": "via-spec"}).encode()))
+        assert r.status == 200
+        assert json.loads(r.body)["displayName"] == "via-spec"
+
+    def test_search_plugin_hooks_fire(self):
+        import json
+        tsdb = tracking_tsdb()
+        events = []
+
+        class SP:
+            def index_ts_meta(self, m):
+                events.append(("its", m.tsuid))
+
+            def delete_ts_meta(self, tsuid):
+                events.append(("dts", tsuid))
+
+            def index_uid_meta(self, m):
+                events.append(("iuid", m.uid))
+
+            def delete_uid_meta(self, m):
+                events.append(("duid", m.uid))
+
+            def index_annotation(self, n):
+                pass
+
+            def shutdown(self):
+                pass
+
+        tsdb.search_plugin = SP()
+        tsdb.add_point("sys.cpu.user", 1356998400, 1, {"host": "a"})
+        router = self._router(tsdb)
+        uid = self._uid_hex(tsdb)
+        router.handle(self._req(
+            "POST", "/api/uid/uidmeta", body=json.dumps(
+                {"uid": uid, "type": "metric",
+                 "displayName": "X"}).encode()))
+        router.handle(self._req(
+            "DELETE", "/api/uid/uidmeta",
+            params={"uid": uid, "type": "metric"}))
+        assert ("iuid", uid) in events
+        assert ("duid", uid) in events
+
+    def test_tsmeta_unknown_metric_spec_404(self):
+        tsdb = tracking_tsdb()
+        r = self._router(tsdb).handle(self._req(
+            "POST", "/api/uid/tsmeta",
+            params={"m": "no.such{host=a}", "create": "true"},
+            body=b""))
+        assert r.status == 404

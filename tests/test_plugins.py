@@ -48,6 +48,8 @@ class DummyHttpRpcPlugin(HttpRpcPlugin):
 
 
 class DummySerializer(HttpJsonSerializer):
+    shortname = "dummy"
+
     def format_version(self, info):
         info = dict(info)
         info["serializer"] = "dummy"
@@ -150,6 +152,27 @@ def test_serializer_plugin_slot():
     router = HttpRpcRouter(tsdb)
     resp = router.handle(HttpRequest("GET", "/api/version"))
     assert json.loads(resp.body)["serializer"] == "dummy"
+
+
+def test_serializer_negotiation():
+    """?serializer=<shortname> picks a registered wire format
+    (ref: HttpSerializer.java:93 shortname registry)."""
+    tsdb = _tsdb(**{
+        "tsd.http.serializer.plugin": "test_plugins.DummySerializer"})
+    router = HttpRpcRouter(tsdb)
+    # explicit selection of the built-in json serializer
+    resp = router.handle(HttpRequest(
+        "GET", "/api/version", {"serializer": ["json"]}))
+    assert "serializer" not in json.loads(resp.body)
+    # explicit selection of the plugin by shortname
+    resp = router.handle(HttpRequest(
+        "GET", "/api/version", {"serializer": ["dummy"]}))
+    assert json.loads(resp.body)["serializer"] == "dummy"
+    # unknown shortname -> 400 with a structured error
+    resp = router.handle(HttpRequest(
+        "GET", "/api/version", {"serializer": ["nope"]}))
+    assert resp.status == 400
+    assert "nope" in json.loads(resp.body)["error"]["message"]
 
 
 def test_meta_cache_replaces_builtin_tracking():

@@ -162,3 +162,56 @@ class TestGraphEndpoint:
         resp = self.request(router, "/q", {"start": "1356998000"})
         assert resp.status == 400
         assert b"Missing 'm' parameter" in resp.body
+
+    def test_plot_option_surface(self, seeded_tsdb, tmp_path):
+        """style/smooth/title/yrange/ylog/key/bgcolor render without
+        error and produce distinct images (ref: Plot.java:40 params)."""
+        pytest.importorskip("matplotlib")
+        seeded_tsdb.config.override_config("tsd.http.cachedir",
+                                           str(tmp_path))
+        router = self.make_router(seeded_tsdb)
+        base = {"start": "2012/12/31-23:00:00",
+                "m": "sum:sys.cpu.user", "wxh": "300x200"}
+        plain = self.request(router, "/q", base)
+        assert plain.status == 200
+        bodies = {plain.body}
+        for extra in ({"style": "linespoint"}, {"smooth": "csplines"},
+                      {"title": "hello", "ylabel": "ms"},
+                      {"yrange": "[0:500]", "ylog": "true"},
+                      {"key": "out top left"},
+                      {"bgcolor": "x333333", "fgcolor": "xffffff"},
+                      {"nokey": "true"},
+                      {"yformat": "%.1f"}):
+            resp = self.request(router, "/q", {**base, **extra})
+            assert resp.status == 200, (extra, resp.body[:200])
+            assert resp.body[:8] == b"\x89PNG\r\n\x1a\n", extra
+            bodies.add(resp.body)
+        # every option changed the rendering
+        assert len(bodies) == 9
+
+    def test_y2_axis_per_metric_options(self, seeded_tsdb, tmp_path):
+        """o=axis x1y2 routes the second sub-query to the right axis
+        (ref: GraphHandler per-metric options, gnuplot x1y2)."""
+        pytest.importorskip("matplotlib")
+        seeded_tsdb.config.override_config("tsd.http.cachedir",
+                                           str(tmp_path))
+        router = self.make_router(seeded_tsdb)
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        resp = router.handle(HttpRequest(
+            method="GET", path="/q",
+            params={"start": ["2012/12/31-23:00:00"],
+                    "m": ["sum:sys.cpu.user", "max:sys.cpu.user"],
+                    "o": ["", "axis x1y2"],
+                    "y2label": ["right"], "y2range": ["[0:1000]"],
+                    "y2log": [""],
+                    "wxh": ["300x200"]}, headers={}, body=b""))
+        assert resp.status == 200
+        assert resp.body[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_bad_yrange_400(self, seeded_tsdb):
+        pytest.importorskip("matplotlib")
+        router = self.make_router(seeded_tsdb)
+        resp = self.request(router, "/q", {
+            "start": "2012/12/31-23:00:00", "m": "sum:sys.cpu.user",
+            "yrange": "0:500"})
+        assert resp.status == 400

@@ -193,6 +193,124 @@ class TestAuth:
             AuthStatus.ERROR
 
 
+class TestRoleAuthorization:
+    """Per-role permission grants (ref: Permissions.java:25-27 —
+    TELNET_PUT, HTTP_PUT, HTTP_QUERY, CREATE_TAGK/TAGV/METRIC)."""
+
+    def make(self):
+        return SimpleAuthentication(Config(**{
+            "tsd.core.authentication.users":
+                f"reader:{sha('r')}:ro,writer:{sha('w')}:rw,"
+                f"admin:{sha('a')}:root,norole:{sha('n')}",
+            "tsd.core.authentication.roles":
+                "ro:http_query,rw:http_query|http_put|telnet_put,"
+                "root:all"}))
+
+    def test_full_reference_permission_set(self):
+        assert {p.name for p in Permissions} == {
+            "TELNET_PUT", "HTTP_PUT", "HTTP_QUERY", "CREATE_TAGK",
+            "CREATE_TAGV", "CREATE_METRIC"}
+
+    def test_role_grants(self):
+        auth = self.make()
+        reader = auth.authenticate("reader", "r")
+        assert reader.has_permission(Permissions.HTTP_QUERY)
+        assert not reader.has_permission(Permissions.HTTP_PUT)
+        assert not reader.has_permission(Permissions.CREATE_METRIC)
+        writer = auth.authenticate("writer", "w")
+        assert writer.has_permission(Permissions.HTTP_PUT)
+        assert writer.has_permission(Permissions.TELNET_PUT)
+        assert not writer.has_permission(Permissions.CREATE_METRIC)
+        admin = auth.authenticate("admin", "a")
+        assert all(admin.has_permission(p) for p in Permissions)
+
+    def test_user_without_roles_has_none(self):
+        auth = self.make()
+        state = auth.authenticate("norole", "n")
+        assert state.status == AuthStatus.SUCCESS
+        assert not any(state.has_permission(p) for p in Permissions)
+
+    def _tsdb_with_auth(self):
+        from opentsdb_tpu import TSDB
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+        t.authentication = self.make()
+        return t
+
+    def _req(self, t, method, path, user, pw, params=None, body=b""):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        req = HttpRequest(method, path,
+                          {k: [v] for k, v in (params or {}).items()},
+                          {}, body)
+        req.auth = t.authentication.authenticate(user, pw)
+        return HttpRpcRouter(t).handle(req)
+
+    def test_http_put_403_for_reader(self):
+        t = self._tsdb_with_auth()
+        body = (b'[{"metric":"m","timestamp":1356998400,'
+                b'"value":1,"tags":{"h":"a"}}]')
+        r = self._req(t, "POST", "/api/put", "reader", "r", body=body)
+        assert r.status == 403
+        r = self._req(t, "POST", "/api/put", "writer", "w", body=body)
+        assert r.status in (200, 204)
+
+    def test_http_query_403_without_grant(self):
+        t = self._tsdb_with_auth()
+        t.add_point("m", 1356998400, 1, {"h": "a"})
+        params = {"start": "1356998300", "m": "sum:m"}
+        r = self._req(t, "GET", "/api/query", "norole", "n",
+                      params=params)
+        assert r.status == 403
+        r = self._req(t, "GET", "/api/query", "reader", "r",
+                      params=params)
+        assert r.status == 200
+
+    def test_uid_assign_403_without_create(self):
+        import json as _json
+        t = self._tsdb_with_auth()
+        body = _json.dumps({"metric": ["new.metric"]}).encode()
+        r = self._req(t, "POST", "/api/uid/assign", "writer", "w",
+                      body=body)
+        assert r.status == 403
+        r = self._req(t, "POST", "/api/uid/assign", "admin", "a",
+                      body=body)
+        assert r.status == 200
+
+    def test_uid_assign_checks_all_kinds_before_committing(self):
+        """A 403 on ANY requested kind must fire before any UID is
+        assigned, so partial results are never silently dropped."""
+        import json as _json
+        t = self._tsdb_with_auth()
+        t.authentication._role_grants["rw"] = frozenset(
+            t.authentication._role_grants["rw"]
+            | {Permissions.CREATE_METRIC})
+        body = _json.dumps({"metric": ["brand.new"],
+                            "tagk": ["brand_tag"]}).encode()
+        r = self._req(t, "POST", "/api/uid/assign", "writer", "w",
+                      body=body)
+        assert r.status == 403
+        # nothing committed: the metric was NOT assigned
+        assert not t.uids.metrics.has_name("brand.new")
+
+    def test_telnet_put_gated(self):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = self._tsdb_with_auth()
+        router = TelnetRouter(t, None)
+        reader = t.authentication.authenticate("reader", "r")
+        out = router.execute("put m 1356998400 1 h=a", auth=reader)
+        assert "permission denied" in out
+        writer = t.authentication.authenticate("writer", "w")
+        assert router.execute("put m 1356998400 1 h=a",
+                              auth=writer) == ""
+        # non-write verbs unaffected
+        assert "version" in router.execute("version", auth=reader)
+
+    def test_bad_role_permission_name_fails_fast(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="not_a_perm"):
+            SimpleAuthentication(Config(**{
+                "tsd.core.authentication.roles": "r:not_a_perm"}))
+
+
 # ---------------------------------------------------------------------------
 # fsck (ref: TestFsck.java corruption-repair scenarios, Fsck.java:99-119)
 # ---------------------------------------------------------------------------

@@ -278,3 +278,86 @@ def test_query_timeout_expires():
             await server.stop()
 
     asyncio.run(scenario())
+
+
+def test_gzip_and_cors():
+    """Accept-Encoding: gzip compresses large responses; CORS headers
+    honor tsd.http.request.cors_domains with preflight
+    (ref: HttpContentCompressor in the Netty pipeline;
+    RpcHandler.java:46 CORS handling)."""
+    import gzip as _gzip
+    import json as _json
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    tsdb = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.http.request.cors_domains": "http://ok.example",
+        "tsd.tpu.platform": "cpu"}))
+    # a response comfortably above the gzip threshold
+    for i in range(300):
+        tsdb.add_point("m", 1356998400 + i, i, {"host": f"h{i % 40:02d}"})
+
+    async def scenario():
+        server = TSDServer(tsdb, host="127.0.0.1", port=0)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+        try:
+            async def fetch(path, headers=None, method="GET"):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                hdrs = "".join(f"{k}: {v}\r\n"
+                               for k, v in (headers or {}).items())
+                writer.write(
+                    f"{method} {path} HTTP/1.0\r\n{hdrs}\r\n".encode())
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(), 30)
+                writer.close()
+                head, _, body = data.partition(b"\r\n\r\n")
+                status = int(head.split(b" ")[1])
+                hmap = {}
+                for line in head.split(b"\r\n")[1:]:
+                    k, _, v = line.decode().partition(":")
+                    hmap[k.strip().lower()] = v.strip()
+                return status, hmap, body
+
+            qpath = ("/api/query?start=1356998300&end=1356999000"
+                     "&m=none:m")
+            # no Accept-Encoding: plain body
+            status, hdrs, body = await fetch(qpath)
+            assert status == 200 and "content-encoding" not in hdrs
+            plain = body
+            # gzip negotiated
+            status, hdrs, body = await fetch(
+                qpath, {"Accept-Encoding": "gzip, deflate"})
+            assert status == 200
+            assert hdrs.get("content-encoding") == "gzip"
+            assert int(hdrs["content-length"]) == len(body)
+            assert _gzip.decompress(body) == plain
+            assert len(body) < len(plain)
+            # small responses stay uncompressed
+            status, hdrs, _ = await fetch(
+                "/api/version", {"Accept-Encoding": "gzip"})
+            assert "content-encoding" not in hdrs
+            # CORS: allowed origin echoed, others not
+            status, hdrs, _ = await fetch(
+                "/api/version", {"Origin": "http://ok.example"})
+            assert hdrs.get("access-control-allow-origin") == \
+                "http://ok.example"
+            status, hdrs, _ = await fetch(
+                "/api/version", {"Origin": "http://evil.example"})
+            assert "access-control-allow-origin" not in hdrs
+            # preflight
+            status, hdrs, _ = await fetch(
+                "/api/put", {"Origin": "http://ok.example"},
+                method="OPTIONS")
+            assert status == 200
+            assert "POST" in hdrs.get("access-control-allow-methods",
+                                      "")
+            assert hdrs.get("access-control-allow-origin") == \
+                "http://ok.example"
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
