@@ -440,6 +440,16 @@ class QueryEngine:
         if use_blocked:
             # long-range streaming: bound HBM at [S x block] cells
             # (SURVEY.md §5.7 time-axis blocking)
+            if mesh is not None:
+                # the carry-chained block scan runs single-device; an
+                # over-budget query on a mesh deliberately trades the
+                # fan-out for bounded HBM — make that visible
+                import logging
+                logging.getLogger(__name__).info(
+                    "query exceeds the device cell budget "
+                    "(%d series x %d buckets): streaming on one "
+                    "device; the %d-device mesh is bypassed",
+                    len(sids), len(bucket_ts), n_mesh)
             result, emit = execute_blocked(
                 values, series_idx, bucket_idx, bucket_ts,
                 group_ids, spec, sub.rate_options,
