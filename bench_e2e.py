@@ -207,6 +207,56 @@ def bench_config4(repeats: int, n_series: int = 200_000) -> dict:
             **stats}
 
 
+def bench_config5(repeats: int, n_series: int = 100_000,
+                  hours: int = 2) -> dict:
+    """Rollup job: raw @1s -> 1m/1h tiers (ref: BASELINE config 5;
+    RollupUtils.java:27, TSDB.java:1320). Sized to the bench host's
+    RAM; the reported rate is raw points processed per second, which
+    scales linearly in series count (the job streams fixed-size
+    series-chunk x window tiles)."""
+    from opentsdb_tpu.rollup.job import run_rollup_job
+    tsdb = _mk_tsdb(rollups=True)
+    span = hours * 3600
+    # ingest raw @1s via bulk grids: [chunk, span] per chunk
+    rng = np.random.default_rng(5)
+    t0 = time.perf_counter()
+    ts_grid = BASE_MS + np.arange(span, dtype=np.int64) * 1000
+    chunk = max(1, 20_000_000 // span)
+    mid = tsdb.uids.metrics.get_or_create_id("sys.bench5")
+    kid = tsdb.uids.tag_names.get_or_create_id("host")
+    mask = np.ones((chunk, span), dtype=bool)
+    for lo in range(0, n_series, chunk):
+        hi = min(lo + chunk, n_series)
+        sids = np.asarray([
+            tsdb.store.get_or_create_series(
+                mid, [(kid, tsdb.uids.tag_values.get_or_create_id(
+                    f"h{i:07d}"))])
+            for i in range(lo, hi)], dtype=np.int64)
+        vals = rng.normal(100, 10, (hi - lo, span))
+        tsdb.store.append_grid(sids, ts_grid,
+                               vals, mask[:hi - lo])
+    n_raw = n_series * span
+    ingest_s = time.perf_counter() - t0
+    times = []
+    written = None
+    for _ in range(max(1, repeats)):
+        # fresh tier stores per run so repeats measure the same work
+        tsdb.rollup_store._tiers.clear()
+        tsdb.rollup_store._has_data_cache.clear()
+        t0 = time.perf_counter()
+        written = run_rollup_job(tsdb, BASE_MS,
+                                 BASE_MS + span * 1000 - 1,
+                                 intervals=["1m", "1h"])
+        times.append(time.perf_counter() - t0)
+    job_s = min(times)
+    return {"config": 5, "series": n_series, "raw_points": n_raw,
+            "hours": hours,
+            "ingest_mpps": round(n_raw / ingest_s / 1e6, 1),
+            "rollup_written": written,
+            "job_s": round(job_s, 1), "runs": len(times),
+            "job_raw_mpps": round(n_raw / job_s / 1e6, 1)}
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -228,7 +278,7 @@ def main() -> None:
 
     runners = {1: bench_config1, 2: bench_config2,
                3: lambda r: bench_config3(r, args.series3),
-               4: bench_config4}
+               4: bench_config4, 5: bench_config5}
     out = []
     for c in (int(x) for x in args.configs.split(",")):
         t0 = time.perf_counter()

@@ -206,7 +206,7 @@ class QueryEngine:
                 run_histogram_subquery
             return run_histogram_subquery(self.tsdb, tsq, sub)
         (store, metric_name, sids, rollup_scale,
-         avg_count_store) = self._select_store(sub)
+         avg_count_store, ds_fn_override) = self._select_store(sub)
         budget = self.tsdb.config.get_int(
             "tsd.query.max_device_cells", 0) or DEFAULT_CELL_BUDGET
         if avg_count_store is not None:
@@ -272,7 +272,8 @@ class QueryEngine:
         # bottleneck; here the "scan" IS the downsample)
         out = self._grid_pipeline(store, sids, tsq, sub, metric_name,
                                   group_ids, num_groups, emit_raw,
-                                  rollup_scale, budget, stats)
+                                  rollup_scale, budget, stats,
+                                  ds_fn_override)
         if out is not None:
             result, emit, bucket_ts = out
             if result is None:
@@ -366,7 +367,7 @@ class QueryEngine:
             return []
         bucket_idx2d = bucket_idx = None
         if sub.ds_spec is not None:
-            ds_function = sub.ds_spec.function
+            ds_function = ds_fn_override or sub.ds_spec.function
             fill_policy = sub.ds_spec.fill_policy
             fill_value = sub.ds_spec.fill_value
             if padded is not None:
@@ -497,22 +498,28 @@ class QueryEngine:
 
     # ------------------------------------------------------------------
 
-    def _select_store(self, sub: TSSubQuery
-                      ) -> tuple[TimeSeriesStore, str, np.ndarray, float,
-                                 TimeSeriesStore | None]:
+    def _select_store(self, sub: TSSubQuery):
         """Pick raw store or a rollup tier (ref: TsdbQuery rollup
         best-match :143-150 with ROLLUP_USAGE fallback :750).
+        Returns (store, metric_name, sids, rollup_scale,
+        avg_count_store, ds_fn_override).
 
-        The last element is the COUNT-tier store when an ``avg``
+        ``avg_count_store`` is the COUNT-tier store when an ``avg``
         downsample is being answered from rollups: the reference
         derives rollup averages as SUM cells / COUNT cells
         (RollupConfig, RollupSpan agg-prefixed qualifiers); here the
         sum tier is the primary store and the count tier rides along
         for the grid division (``_avg_rollup_grid``).
+
+        ``ds_fn_override`` replaces the downsample function when the
+        tier's cells already carry the statistic: a ``count``
+        downsample over the COUNT tier must SUM the stored counts,
+        not count cells (ref: Downsampler.java:213 — the rollup-query
+        COUNT branch accumulates nextValueCount()).
         """
         uids = self.tsdb.uids
         if sub.tsuids:
-            return self._tsuid_store(sub)
+            return self._tsuid_store(sub)  # 6-tuple
         try:
             metric_id = uids.metrics.get_id(sub.metric)
         except LookupError:
@@ -521,6 +528,7 @@ class QueryEngine:
         store = self.tsdb.store
         rollup_scale = 1.0
         avg_count_store = None
+        ds_fn_override = None
         usage = (sub.rollup_usage or "ROLLUP_NOFALLBACK").upper()
         if (self.tsdb.rollup_store is not None and sub.ds_spec is not None
                 and not sub.ds_spec.run_all and usage != "ROLLUP_RAW"):
@@ -532,6 +540,8 @@ class QueryEngine:
                                                "max"):
                 if rs.has_data(tier.interval, agg_fn):
                     store = rs.tier(tier.interval, agg_fn)
+                    if agg_fn == "count":
+                        ds_fn_override = "sum"
             elif tier is not None and agg_fn == "avg" \
                     and rs.has_data(tier.interval, "sum") \
                     and rs.has_data(tier.interval, "count"):
@@ -543,7 +553,9 @@ class QueryEngine:
             store = self.tsdb.store
             sids = store.series_ids_for_metric(metric_id)
             avg_count_store = None
-        return store, sub.metric, sids, rollup_scale, avg_count_store
+            ds_fn_override = None
+        return (store, sub.metric, sids, rollup_scale, avg_count_store,
+                ds_fn_override)
 
     @staticmethod
     def _record_scan(stats, ms: float, num_points: int,
@@ -581,7 +593,7 @@ class QueryEngine:
                        sub: TSSubQuery, metric_name: str,
                        group_ids: np.ndarray, num_groups: int,
                        emit_raw: bool, rollup_scale: float, budget: int,
-                       stats):
+                       stats, ds_fn_override: str | None = None):
         """Storage-side downsample: one fused native pass produces the
         [S, B] grid (ref analogue: the scan + Downsampler stages of
         TsdbQuery.java:795 + Downsampler.java:28 collapsed into the
@@ -598,7 +610,7 @@ class QueryEngine:
         mesh = self.tsdb.query_mesh
         if len(sids) * b > budget:
             return None  # blocked streaming handles the oversized case
-        fn = ds_spec.function
+        fn = ds_fn_override or ds_spec.function
         want_minmax = fn in ("min", "mimmin", "max", "mimmax")
         # device-resident cache: a warm repeat of this reduction skips
         # the host scan AND the upload (HBM ≙ HBase block cache)
@@ -871,8 +883,8 @@ class QueryEngine:
             sid = store._key_to_sid.get(key)
             if sid is not None:
                 sids.append(sid)
-        return store, metric_name or "", np.asarray(
-            sids, dtype=np.int64), 1.0, None
+        return (store, metric_name or "", np.asarray(
+            sids, dtype=np.int64), 1.0, None, None)
 
     # ------------------------------------------------------------------
 

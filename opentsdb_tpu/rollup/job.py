@@ -261,6 +261,59 @@ def _rollup_window(tsdb, chunk, row_off: int, start_ms: int,
     return outs
 
 
+def _rollup_window_native(tsdb, chunk, row_off: int, start_ms: int,
+                          end_ms: int, base: RollupInterval,
+                          nested: list[RollupInterval]) -> list:
+    """Storage-side tile: the C++ fused range-scan produces the base
+    tier's sum/count/min/max grids directly (``tss_bucket_reduce``),
+    and nested tiers coarsen by reshape reductions on the host — the
+    raw points never leave the storage arena. On hosts feeding a
+    remote/tunneled device this beats the device tiles by the full
+    transfer cost (the job is a pure reduction; there is no reuse to
+    amortize an upload against). Same output contract as
+    :func:`_rollup_window`."""
+    bucket_ts = ds_mod.fixed_bucket_edges(start_ms, end_ms,
+                                          base.interval_ms)
+    b = len(bucket_ts)
+    sums, cnts, mins, maxs = tsdb.store.bucket_reduce(
+        chunk, start_ms, end_ms, int(bucket_ts[0]), base.interval_ms,
+        b, want_minmax=True)
+    if not cnts.any():
+        return []
+    outs = []
+
+    def finalize(s_, c_, mn_, mx_, tier, bts):
+        empty = c_ == 0
+        outs.append((tier, bts, np.stack([
+            np.where(empty, np.nan, s_), np.where(empty, np.nan, c_),
+            np.where(empty, np.nan, mn_),
+            np.where(empty, np.nan, mx_)]), row_off))
+
+    finalize(sums, cnts, mins, maxs, base, bucket_ts)
+    for tier in nested:
+        f = tier.interval_ms // base.interval_ms
+        coarse_edges = ds_mod.fixed_bucket_edges(
+            int(bucket_ts[0]), int(bucket_ts[-1]), tier.interval_ms)
+        # align the base-bucket axis to the coarse grid, pad the tail,
+        # and reduce [S, Bc, f]; empty raw cells carry the reduction
+        # identities (0 for sum/count, +/-inf for min/max) so they
+        # vanish in the coarse cells
+        off = int((bucket_ts[0] - coarse_edges[0]) // base.interval_ms)
+        pad_hi = len(coarse_edges) * f - (off + b)
+        s = len(chunk)
+
+        def pad(a, fill):
+            return np.pad(a, ((0, 0), (off, pad_hi)),
+                          constant_values=fill)
+
+        finalize(pad(sums, 0.0).reshape(s, -1, f).sum(axis=2),
+                 pad(cnts, 0.0).reshape(s, -1, f).sum(axis=2),
+                 pad(mins, np.inf).reshape(s, -1, f).min(axis=2),
+                 pad(maxs, -np.inf).reshape(s, -1, f).max(axis=2),
+                 tier, coarse_edges)
+    return outs
+
+
 def _window_buckets(nested_factors: list[int],
                     cap: int = _MAX_WINDOW_BUCKETS) -> int:
     """Buckets of the base tier per window: a multiple of every nested
@@ -323,6 +376,10 @@ def run_rollup_job(tsdb, start_ms: int, end_ms: int,
     sweeps = [(finest, nested)] + [(t, []) for t in direct]
     total_work = len(all_sids) * len(sweeps)
     done = 0
+    # storage-side reduction by default (tss_bucket_reduce — no
+    # device transfer); tsd.rollups.job.device forces the device tiles
+    use_native = (hasattr(tsdb.store, "bucket_reduce") and not
+                  tsdb.config.get_bool("tsd.rollups.job.device"))
 
     for base, sub in sweeps:
         factors = [t.interval_ms // base.interval_ms for t in sub]
@@ -346,10 +403,15 @@ def run_rollup_job(tsdb, start_ms: int, end_ms: int,
             pending = None
             t0 = start_ms - (start_ms % win_ms)
             while t0 <= end_ms:
-                outs = _rollup_window(tsdb, chunk, 0,
-                                      max(t0, start_ms),
-                                      min(t0 + win_ms - 1, end_ms),
-                                      base, sub)
+                if use_native:
+                    outs = _rollup_window_native(
+                        tsdb, chunk, 0, max(t0, start_ms),
+                        min(t0 + win_ms - 1, end_ms), base, sub)
+                else:
+                    outs = _rollup_window(tsdb, chunk, 0,
+                                          max(t0, start_ms),
+                                          min(t0 + win_ms - 1, end_ms),
+                                          base, sub)
                 if pending:
                     _write_outs(tsdb, rsid_map, pending, written)
                 pending = outs

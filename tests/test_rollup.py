@@ -263,3 +263,74 @@ class TestRollupQueryRouting:
         dps = dict(res[0].dps)
         want = (6 * 12.0 + 2 * 24.0) / 8.0   # 15.0, not (12+24)/2=18
         assert dps[base * 1000] == pytest.approx(want)
+
+
+class TestNativeJobPath:
+    """The storage-side rollup window (tss_bucket_reduce + host
+    coarsening) must produce bit-identical tiers to the device tiles
+    (ref: the same sum/count/min/max per RollupUtils bucket)."""
+
+    def _run(self, device: bool):
+        import numpy as np
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.rollup.job import run_rollup_job
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.rollups.enable": "true"}
+        if device:
+            cfg["tsd.rollups.job.device"] = "true"
+        t = TSDB(Config(**cfg))
+        rng = np.random.default_rng(11)
+        base = 1356998400
+        for i in range(9):
+            n = int(rng.integers(20, 300))
+            ts = base + np.sort(rng.choice(7200, n, replace=False))
+            t.add_points("m.njob", ts.astype(np.int64),
+                         rng.normal(50, 20, n), {"host": f"h{i}"})
+        written = run_rollup_job(t, (base - 30) * 1000,
+                                 (base + 7200) * 1000)
+        out = {}
+        mid = t.uids.metrics.get_id("m.njob")
+        for iv in ("1m", "1h"):
+            for agg in ("sum", "count", "min", "max"):
+                store = t.rollup_store.tier(iv, agg)
+                for sid in store.series_ids_for_metric(mid):
+                    rec = store.series(int(sid))
+                    ts_arr, vals = rec.buffer.view()
+                    out[(iv, agg, rec.tags)] = (ts_arr.tolist(),
+                                                vals.tolist())
+        return written, out
+
+    def test_native_matches_device_tiles(self):
+        import numpy as np
+        w_native, native = self._run(device=False)
+        w_device, device = self._run(device=True)
+        assert w_native == w_device
+        assert set(native) == set(device)
+        for key in native:
+            assert native[key][0] == device[key][0], key
+            np.testing.assert_allclose(native[key][1], device[key][1],
+                                       rtol=1e-9, err_msg=str(key))
+
+    def test_count_tier_sums_stored_counts(self):
+        """1h-count answered from the COUNT tier must SUM the stored
+        counts, not count cells (ref: Downsampler.java:213 — the
+        rollup COUNT branch accumulates nextValueCount())."""
+        import numpy as np
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.query.model import parse_uri_query
+        from opentsdb_tpu.rollup.job import run_rollup_job
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           "tsd.rollups.enable": "true"}))
+        base = 1356998400
+        ts = np.arange(base, base + 3600, 10, dtype=np.int64)
+        t.add_points("m.cnt", ts, np.ones(len(ts)), {"h": "a"})
+        run_rollup_job(t, base * 1000, (base + 3600) * 1000)
+        t.store.delete_range(t.store.series_ids_for_metric(
+            t.uids.metrics.get_id("m.cnt")), 0, 2 ** 60)
+        tsq = parse_uri_query({"start": [str(base)],
+                               "end": [str(base + 3599)],
+                               "m": ["sum:1h-count:m.cnt"]})
+        tsq.validate()
+        r = t.execute_query(tsq)[0]
+        # 360 raw points in the hour, stored as 60 1m-count cells of 6
+        assert dict(r.dps)[base * 1000] == 360.0
