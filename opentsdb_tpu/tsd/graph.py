@@ -74,12 +74,17 @@ def _smooth(xs: np.ndarray, ys: np.ndarray
 
 def handle_graph(router, request):
     from opentsdb_tpu.tsd.http_api import HttpError, HttpResponse
+    from opentsdb_tpu.stats.stats import QueryStats
     tsq = parse_uri_query(request.params)
     if not tsq.queries:
         raise HttpError(400, "Missing 'm' parameter",
                         "Nothing to graph without a metric query")
     tsq.validate()
-    results = router.tsdb.new_query().run(tsq)
+    stats = QueryStats(request.remote, tsq)
+    try:
+        results = router.tsdb.new_query().run(tsq, stats)
+    finally:
+        stats.mark_serialization_successful()
 
     if request.flag("ascii") or request.param("format") == "ascii":
         # one line per point: metric timestamp value tags (ref:

@@ -69,6 +69,11 @@ class HttpResponse:
     body: bytes = b""
     content_type: str = "application/json; charset=UTF-8"
     headers: dict[str, str] = field(default_factory=dict)
+    # generator of bytes chunks: set for very large responses so the
+    # server streams with Transfer-Encoding: chunked instead of
+    # materializing one giant body (ref: formatQueryAsyncV1 writing
+    # the response incrementally through Netty)
+    body_iter: Any = None
 
 
 class HttpError(Exception):
@@ -392,6 +397,23 @@ class HttpRpcRouter:
                 # the caller asked for stats (ref: nanDPs)
                 stats.add_stat(QueryStat.NAN_DPS, sum(
                     1 for r in results for _, v in r.dps if v != v))
+            # very large responses stream per-series with chunked
+            # transfer encoding instead of materializing one body
+            # (ref: formatQueryAsyncV1 incremental writes)
+            stream_after = self.tsdb.config.get_int(
+                "tsd.http.query.stream_threshold_dps", 1_000_000)
+            total_dps = sum(len(r.dps) for r in results)
+            if stream_after and total_dps > stream_after \
+                    and not (tsq.show_summary or tsq.show_stats
+                             or request.flag("show_summary")
+                             or request.flag("show_stats")) \
+                    and hasattr(request.serializer, "stream_query"):
+                body_iter = request.serializer.stream_query(
+                    tsq, results, as_arrays=request.flag("arrays"))
+                stats.add_stat(
+                    QueryStat.PROCESSING_PRE_WRITE_TIME,
+                    (time.monotonic_ns() - stats.start_ns) / 1e6)
+                return HttpResponse(200, b"", body_iter=body_iter)
             body = request.serializer.format_query(
                 tsq, results, as_arrays=request.flag("arrays"),
                 show_summary=tsq.show_summary

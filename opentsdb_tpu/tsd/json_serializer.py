@@ -114,6 +114,51 @@ class HttpJsonSerializer(HttpSerializer):
             out.append(summary)
         return self._dump(out)
 
+    # dps entries per streamed chunk: bounds the largest in-memory
+    # piece even when ONE aggregated series carries millions of points
+    _STREAM_SLAB_DPS = 50_000
+
+    def stream_query(self, ts_query, results: list[QueryResult],
+                     as_arrays: bool = False):
+        """Generator twin of :meth:`format_query`: yields bounded
+        bytes chunks (slicing WITHIN a series' dps) so
+        multi-hundred-MB responses stream through chunked transfer
+        encoding instead of materializing (ref: formatQueryAsyncV1's
+        incremental channel writes). Output bytes are identical to
+        format_query's."""
+        ms = ts_query.ms_resolution
+        yield b"["
+        for ri, r in enumerate(results):
+            # header: everything format_query emits before "dps"
+            head = self.format_query(
+                ts_query, [QueryResult(
+                    metric=r.metric, tags=r.tags,
+                    aggregated_tags=r.aggregated_tags, dps=[],
+                    tsuids=r.tsuids, annotations=r.annotations,
+                    global_annotations=r.global_annotations,
+                    sub_query_index=r.sub_query_index)],
+                as_arrays=as_arrays)
+            # '[{... "dps":{}}]' -> '{... "dps":' + our own dps body
+            head = head[1:-1]
+            head = head[:head.rindex(b"{}" if not as_arrays
+                                     else b"[]")]
+            yield (b"," if ri else b"") + head
+            open_c, close_c = (b"[", b"]") if as_arrays else \
+                (b"{", b"}")
+            yield open_c
+            for lo in range(0, len(r.dps), self._STREAM_SLAB_DPS):
+                slab = r.dps[lo:lo + self._STREAM_SLAB_DPS]
+                parts = []
+                for ts, v in slab:
+                    t = ts if ms else ts // 1000
+                    fv = json.dumps(_format_value(v))
+                    parts.append(f"[{t},{fv}]" if as_arrays
+                                 else f'"{t}":{fv}')
+                prefix = b"" if lo == 0 else b","
+                yield prefix + ",".join(parts).encode()
+            yield close_c + b"}"
+        yield b"]"
+
     def format_put(self, success: int, failed: int,
                    errors: list[dict] | None = None,
                    show_details: bool = False) -> bytes:

@@ -356,14 +356,45 @@ class TSDServer:
                   413: "Request Entity Too Large", 500:
                   "Internal Server Error",
                   501: "Not Implemented"}.get(response.status, "Unknown")
+        loop = asyncio.get_event_loop()
+        if response.body_iter is not None and version != "HTTP/1.1":
+            # chunked TE needs 1.1; older clients get one body
+            # (joined on a worker thread — serialization is CPU work)
+            response.body = await loop.run_in_executor(
+                None, lambda: b"".join(response.body_iter))
+            response.body_iter = None
         head = [f"{version} {response.status} {reason}"]
-        head.append(f"Content-Length: {len(response.body)}")
-        if response.body:
+        if response.body_iter is not None:
+            head.append("Transfer-Encoding: chunked")
             head.append(f"Content-Type: {response.content_type}")
+        else:
+            head.append(f"Content-Length: {len(response.body)}")
+            if response.body:
+                head.append(f"Content-Type: {response.content_type}")
         head.append("Connection: " +
                     ("keep-alive" if keep_alive else "close"))
         for k, v in response.headers.items():
             head.append(f"{k}: {v}")
-        writer.write("\r\n".join(head).encode("latin-1") + b"\r\n\r\n"
-                     + response.body)
+        writer.write("\r\n".join(head).encode("latin-1") + b"\r\n\r\n")
+        if response.body_iter is not None:
+            # stream bounded chunks; the generator (CPU-heavy JSON
+            # serialization) advances on a worker thread so other
+            # connections keep being served, and drain applies
+            # backpressure so a slow client never forces the whole
+            # body into memory
+            it = iter(response.body_iter)
+            sentinel = object()
+            while True:
+                chunk = await loop.run_in_executor(
+                    None, next, it, sentinel)
+                if chunk is sentinel:
+                    break
+                if not chunk:
+                    continue
+                writer.write(f"{len(chunk):x}\r\n".encode()
+                             + chunk + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+        else:
+            writer.write(response.body)
         await writer.drain()

@@ -215,3 +215,13 @@ class TestGraphEndpoint:
             "start": "2012/12/31-23:00:00", "m": "sum:sys.cpu.user",
             "yrange": "0:500"})
         assert resp.status == 400
+
+    def test_graph_records_query_stats(self, seeded_tsdb):
+        from opentsdb_tpu.stats.stats import QueryStats
+        router = self.make_router(seeded_tsdb)
+        resp = self.request(router, "/q", {
+            "start": "2012/12/31-23:00:00", "m": "sum:sys.cpu.user",
+            "ascii": "true"})
+        assert resp.status == 200
+        done = QueryStats.running_and_completed()["completed"]
+        assert done and done[-1]["executed"]

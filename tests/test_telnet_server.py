@@ -361,3 +361,91 @@ def test_gzip_and_cors():
             await server.stop()
 
     asyncio.run(scenario())
+
+
+def test_chunked_streaming_large_query():
+    """Responses above tsd.http.query.stream_threshold_dps stream with
+    Transfer-Encoding: chunked, byte-identical to the materialized
+    body (ref: formatQueryAsyncV1 incremental writes)."""
+    import json as _json
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    tsdb = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.http.query.stream_threshold_dps": "100",
+        "tsd.tpu.platform": "cpu"}))
+    for i in range(300):
+        tsdb.add_point("m", BASE + i, i, {"host": f"h{i % 20:02d}"})
+
+    async def scenario():
+        server = TSDServer(tsdb, host="127.0.0.1", port=0)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+        try:
+            async def fetch(version):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(
+                    f"GET /api/query?start={BASE - 10}&end={BASE + 900}"
+                    f"&m=none:m HTTP/{version}\r\n"
+                    f"Connection: close\r\n\r\n".encode())
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(), 30)
+                writer.close()
+                head, _, body = data.partition(b"\r\n\r\n")
+                return head, body
+
+            head, body = await fetch("1.1")
+            assert b"Transfer-Encoding: chunked" in head
+            # de-chunk
+            out, pos = b"", 0
+            while True:
+                eol = body.index(b"\r\n", pos)
+                n = int(body[pos:eol], 16)
+                if n == 0:
+                    break
+                out += body[eol + 2:eol + 2 + n]
+                pos = eol + 2 + n + 2
+            # HTTP/1.0 gets the materialized body; must be identical
+            head10, body10 = await fetch("1.0")
+            assert b"Content-Length" in head10
+            assert out == body10
+            parsed = _json.loads(out)
+            assert len(parsed) == 20
+            assert sum(len(r["dps"]) for r in parsed) == 300
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_stream_query_byte_identical_to_format_query():
+    """stream_query output (incl. intra-series slabs and NaN points)
+    must concatenate to exactly format_query's bytes."""
+    import math
+
+    from opentsdb_tpu.query.engine import QueryResult
+    from opentsdb_tpu.query.model import TSQuery
+    from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+
+    ser = HttpJsonSerializer()
+    ser2 = HttpJsonSerializer()
+    ser2._STREAM_SLAB_DPS = 7  # force many intra-series slabs
+    tsq = TSQuery(start="1h-ago")
+    tsq.ms_resolution = False
+    results = [
+        QueryResult("m.a", {"host": "x"}, ["dc"],
+                    [(BASE * 1000 + i * 1000,
+                      float("nan") if i == 5 else i + 0.5)
+                     for i in range(40)]),
+        QueryResult("m.b", {}, [],
+                    [(BASE * 1000, 7.0), (BASE * 1000 + 1000, 8)]),
+        QueryResult("m.empty", {"host": "y"}, [], []),
+    ]
+    for as_arrays in (False, True):
+        want = ser.format_query(tsq, results, as_arrays=as_arrays)
+        got = b"".join(ser2.stream_query(tsq, results,
+                                         as_arrays=as_arrays))
+        assert got == want, (as_arrays, got[:200], want[:200])
