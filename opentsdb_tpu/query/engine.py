@@ -51,6 +51,9 @@ class QueryResult:
     annotations: list = field(default_factory=list)
     global_annotations: list = field(default_factory=list)
     sub_query_index: int = 0
+    # columnar twin of dps (ts int64[N], values float64[N]) when the
+    # engine produced it — serializers use it for native formatting
+    dps_arrays: Any = None
 
 
 class NoSuchMetricError(BadRequestError):
@@ -1007,7 +1010,7 @@ class QueryEngine:
             members = order[starts[gid]:ends[gid]]
             if len(members) == 0:
                 continue
-            dps = _emit_dps(ts_out, result[gid], emit[gid])
+            dps, dps_arrays = _emit_dps(ts_out, result[gid], emit[gid])
             if not dps:
                 continue
             g_tags: dict[str, str] = {}
@@ -1044,7 +1047,7 @@ class QueryEngine:
                 aggregated_tags=agg_tags,
                 dps=dps, tsuids=tsuids, annotations=annotations,
                 global_annotations=global_annotations,
-                sub_query_index=sub.index))
+                sub_query_index=sub.index, dps_arrays=dps_arrays))
         return out
 
 
@@ -1081,14 +1084,17 @@ def _match_series_by_tags(src_store, dst_store, sids: np.ndarray,
     return np.where(hit, dst_sids[order[pos_c]], -1)
 
 
-def _emit_dps(ts_out: np.ndarray, row: np.ndarray, erow: np.ndarray
-              ) -> list[tuple[int, float]]:
-    """Compress (value, emit) arrays into the output point list.
-    ``ts_out`` already carries the ms/seconds resolution choice."""
+def _emit_dps(ts_out: np.ndarray, row: np.ndarray, erow: np.ndarray):
+    """Compress (value, emit) arrays into the output point list plus
+    its columnar twin (for native serialization). ``ts_out`` already
+    carries the ms/seconds resolution choice."""
     idx = np.nonzero(erow)[0]
     if not len(idx):
-        return []
-    return list(zip(ts_out[idx].tolist(), row[idx].tolist()))
+        return [], None
+    ts_sel = ts_out[idx]
+    val_sel = np.asarray(row[idx], dtype=np.float64)
+    return list(zip(ts_sel.tolist(), val_sel.tolist())), \
+        (ts_sel, val_sel)
 
 
 def _common_tags(tags: TagMatrix, members: np.ndarray, uids

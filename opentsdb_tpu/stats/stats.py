@@ -209,11 +209,23 @@ class QueryStats:
         self.stats[stat.value] = self.stats.get(stat.value, 0.0) + value
 
     def mark_serialization_successful(self) -> None:
+        """The query produced a response (ref: the reference flips
+        ``executed`` only on serialization success)."""
         self.executed = True
-        self.stats[QueryStat.TOTAL_TIME.value] = (
-            (time.monotonic_ns() - self.start_ns) / 1e6)
+        self._complete()
+
+    def mark_complete(self) -> None:
+        """Move to the completed registry WITHOUT claiming success —
+        the finally-path for failed queries (``executed`` stays
+        False so /api/stats/query shows the failure)."""
+        self._complete()
+
+    def _complete(self) -> None:
         with QueryStats._registry_lock:
-            QueryStats._running.pop(self.query_id, None)
+            if QueryStats._running.pop(self.query_id, None) is None:
+                return  # already completed
+            self.stats[QueryStat.TOTAL_TIME.value] = (
+                (time.monotonic_ns() - self.start_ns) / 1e6)
             QueryStats._completed.append(self)
 
     def to_json(self) -> dict[str, Any]:

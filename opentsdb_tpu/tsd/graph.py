@@ -83,8 +83,9 @@ def handle_graph(router, request):
     stats = QueryStats(request.remote, tsq)
     try:
         results = router.tsdb.new_query().run(tsq, stats)
-    finally:
         stats.mark_serialization_successful()
+    finally:
+        stats.mark_complete()  # failures stay executed=False
 
     if request.flag("ascii") or request.param("format") == "ascii":
         # one line per point: metric timestamp value tags (ref:

@@ -389,9 +389,8 @@ class HttpRpcRouter:
             results = self.tsdb.new_query().run(tsq, stats)
             from opentsdb_tpu.stats.stats import QueryStat
             t_ser = time.monotonic()
-            stats.add_stat(
-                QueryStat.EMITTED_DPS,
-                sum(len(r.dps) for r in results))
+            total_dps = sum(len(r.dps) for r in results)
+            stats.add_stat(QueryStat.EMITTED_DPS, total_dps)
             if tsq.show_stats or request.flag("show_stats"):
                 # the NaN census walks every emitted point: only when
                 # the caller asked for stats (ref: nanDPs)
@@ -402,7 +401,6 @@ class HttpRpcRouter:
             # (ref: formatQueryAsyncV1 incremental writes)
             stream_after = self.tsdb.config.get_int(
                 "tsd.http.query.stream_threshold_dps", 1_000_000)
-            total_dps = sum(len(r.dps) for r in results)
             if stream_after and total_dps > stream_after \
                     and not (tsq.show_summary or tsq.show_stats
                              or request.flag("show_summary")
@@ -424,8 +422,10 @@ class HttpRpcRouter:
                            (time.monotonic() - t_ser) * 1e3)
             stats.add_stat(QueryStat.PROCESSING_PRE_WRITE_TIME,
                            (time.monotonic_ns() - stats.start_ns) / 1e6)
-        finally:
             stats.mark_serialization_successful()
+        finally:
+            # a raise above lands here with executed still False
+            stats.mark_complete()
         return HttpResponse(200, body)
 
     def _handle_query_last(self, request: HttpRequest) -> HttpResponse:

@@ -76,6 +76,57 @@ class HttpJsonSerializer(HttpSerializer):
             raise ValueError("Invalid query content")
         return data
 
+    # results with at least this many points format their dps through
+    # the native C++ formatter (the ctypes call overhead amortizes
+    # within a few dozen points)
+    _NATIVE_FMT_MIN_DPS = 32
+
+    def _result_head(self, ts_query, r: QueryResult) -> bytes:
+        """Everything before "dps", serialized — ends with ``b'}'``."""
+        obj: dict[str, Any] = {
+            "metric": r.metric,
+            "tags": r.tags,
+            "aggregateTags": r.aggregated_tags,
+        }
+        if ts_query.show_query:
+            obj["query"] = ts_query.queries[r.sub_query_index].to_json()
+        if r.tsuids:
+            obj["tsuids"] = r.tsuids
+        if not ts_query.no_annotations and r.annotations:
+            obj["annotations"] = [a.to_json() for a in r.annotations]
+        if ts_query.global_annotations and r.global_annotations:
+            obj["globalAnnotations"] = [a.to_json()
+                                        for a in r.global_annotations]
+        return self._dump(obj)
+
+    @staticmethod
+    def _native_fmt():
+        """The C++ dps formatter, or None without a compiler."""
+        try:
+            from opentsdb_tpu.native.store_backend import format_dps
+            return format_dps
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _dps_body(self, r: QueryResult, ms: bool,
+                  as_arrays: bool) -> bytes:
+        """The dps map/array body, natively formatted when large."""
+        if r.dps_arrays is not None and \
+                len(r.dps) >= self._NATIVE_FMT_MIN_DPS:
+            fmt = self._native_fmt()
+            if fmt is not None:
+                inner = fmt(r.dps_arrays[0], r.dps_arrays[1], not ms,
+                            as_arrays)
+                return (b"[" + inner + b"]") if as_arrays else \
+                    (b"{" + inner + b"}")
+        if as_arrays:
+            dps: Any = [[ts if ms else ts // 1000, _format_value(v)]
+                        for ts, v in r.dps]
+        else:
+            dps = {str(ts if ms else ts // 1000): _format_value(v)
+                   for ts, v in r.dps}
+        return self._dump(dps)
+
     def format_query(self, ts_query, results: list[QueryResult],
                      as_arrays: bool = False,
                      show_summary: bool = False,
@@ -84,35 +135,15 @@ class HttpJsonSerializer(HttpSerializer):
         """(ref: formatQueryAsyncV1) ``dps`` as {ts: value} maps, or
         [[ts, value], ...] when the ``arrays`` query param is set."""
         ms = ts_query.ms_resolution
-        out = []
+        pieces = []
         for r in results:
-            dps: Any
-            if as_arrays:
-                dps = [[ts if ms else ts // 1000, _format_value(v)]
-                       for ts, v in r.dps]
-            else:
-                dps = {str(ts if ms else ts // 1000): _format_value(v)
-                       for ts, v in r.dps}
-            obj: dict[str, Any] = {
-                "metric": r.metric,
-                "tags": r.tags,
-                "aggregateTags": r.aggregated_tags,
-            }
-            if ts_query.show_query:
-                obj["query"] = ts_query.queries[r.sub_query_index].to_json()
-            if r.tsuids:
-                obj["tsuids"] = r.tsuids
-            if not ts_query.no_annotations and r.annotations:
-                obj["annotations"] = [a.to_json() for a in r.annotations]
-            if ts_query.global_annotations and r.global_annotations:
-                obj["globalAnnotations"] = [a.to_json()
-                                            for a in r.global_annotations]
-            obj["dps"] = dps
-            out.append(obj)
+            head = self._result_head(ts_query, r)
+            pieces.append(head[:-1] + b',"dps":'
+                          + self._dps_body(r, ms, as_arrays) + b"}")
         if show_summary or show_stats:
-            summary: dict[str, Any] = {"statsSummary": summary_extra or {}}
-            out.append(summary)
-        return self._dump(out)
+            pieces.append(self._dump(
+                {"statsSummary": summary_extra or {}}))
+        return b"[" + b",".join(pieces) + b"]"
 
     # dps entries per streamed chunk: bounds the largest in-memory
     # piece even when ONE aggregated series carries millions of points
@@ -127,34 +158,33 @@ class HttpJsonSerializer(HttpSerializer):
         incremental channel writes). Output bytes are identical to
         format_query's."""
         ms = ts_query.ms_resolution
+        fmt = self._native_fmt()
         yield b"["
         for ri, r in enumerate(results):
-            # header: everything format_query emits before "dps"
-            head = self.format_query(
-                ts_query, [QueryResult(
-                    metric=r.metric, tags=r.tags,
-                    aggregated_tags=r.aggregated_tags, dps=[],
-                    tsuids=r.tsuids, annotations=r.annotations,
-                    global_annotations=r.global_annotations,
-                    sub_query_index=r.sub_query_index)],
-                as_arrays=as_arrays)
-            # '[{... "dps":{}}]' -> '{... "dps":' + our own dps body
-            head = head[1:-1]
-            head = head[:head.rindex(b"{}" if not as_arrays
-                                     else b"[]")]
-            yield (b"," if ri else b"") + head
+            head = self._result_head(ts_query, r)
+            yield (b"," if ri else b"") + head[:-1] + b',"dps":'
             open_c, close_c = (b"[", b"]") if as_arrays else \
                 (b"{", b"}")
             yield open_c
+            # same native threshold as format_query so streamed and
+            # materialized responses stay byte-identical per series
+            use_native = (fmt is not None
+                          and r.dps_arrays is not None
+                          and len(r.dps) >= self._NATIVE_FMT_MIN_DPS)
             for lo in range(0, len(r.dps), self._STREAM_SLAB_DPS):
-                slab = r.dps[lo:lo + self._STREAM_SLAB_DPS]
+                prefix = b"" if lo == 0 else b","
+                hi = lo + self._STREAM_SLAB_DPS
+                if use_native:
+                    yield prefix + fmt(r.dps_arrays[0][lo:hi],
+                                       r.dps_arrays[1][lo:hi],
+                                       not ms, as_arrays)
+                    continue
                 parts = []
-                for ts, v in slab:
+                for ts, v in r.dps[lo:hi]:
                     t = ts if ms else ts // 1000
                     fv = json.dumps(_format_value(v))
                     parts.append(f"[{t},{fv}]" if as_arrays
                                  else f'"{t}":{fv}')
-                prefix = b"" if lo == 0 else b","
                 yield prefix + ",".join(parts).encode()
             yield close_c + b"}"
         yield b"]"

@@ -381,3 +381,17 @@ class TestQueryStatsSurface:
         # seeded series cover [BASE, BASE+3000) at 10s; the window
         # [BASE-100, BASE+600] holds 61 points per series
         assert stats["columnsFromStorage"] == 122
+
+    def test_failed_query_not_marked_executed(self, seeded_tsdb):
+        """A query that raises must land in /api/stats/query with
+        executed=false, not as a successful completion."""
+        from opentsdb_tpu.stats.stats import QueryStats
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        router = HttpRpcRouter(seeded_tsdb)
+        resp = router.handle(HttpRequest(
+            "GET", "/api/query",
+            {"start": ["1356998300"], "m": ["sum:no.such.metric"]}))
+        assert resp.status == 400
+        done = QueryStats.running_and_completed()["completed"]
+        assert done and done[-1]["executed"] is False
+        assert not QueryStats.running_and_completed()["running"]

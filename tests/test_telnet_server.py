@@ -449,3 +449,42 @@ def test_stream_query_byte_identical_to_format_query():
         got = b"".join(ser2.stream_query(tsq, results,
                                          as_arrays=as_arrays))
         assert got == want, (as_arrays, got[:200], want[:200])
+
+
+def test_native_dps_formatter_matches_python():
+    """tss_format_dps output must be byte-identical to the Python
+    per-point formatting for realistic values (ints, floats, NaN,
+    infinities, ms and second resolution, both dps shapes)."""
+    import json as _json
+
+    import numpy as np
+    import pytest as _pytest
+
+    from opentsdb_tpu.tsd.json_serializer import _format_value
+    try:
+        from opentsdb_tpu.native.store_backend import format_dps
+    except Exception:
+        _pytest.skip("no native lib")
+    rng = np.random.default_rng(9)
+    ts = BASE * 1000 + np.arange(5000, dtype=np.int64) * 1000
+    vals = rng.normal(0, 1e4, 5000)
+    vals[::7] = np.round(vals[::7])          # integral floats
+    vals[3] = float("nan")
+    vals[4] = float("inf")
+    vals[5] = float("-inf")
+    vals[6] = 0.1
+    vals[7] = -12345.0
+    vals[8] = float(2 ** 53)        # integral but stays a float
+    vals[9] = float(2 ** 53 + 2)    # above the int fast-path range
+    vals[10] = float(-(2 ** 53))
+    for seconds in (True, False):
+        for as_arrays in (True, False):
+            got = format_dps(ts, vals, seconds, as_arrays)
+            parts = []
+            for t, v in zip(ts.tolist(), vals.tolist()):
+                tt = t // 1000 if seconds else t
+                fv = _json.dumps(_format_value(v))
+                parts.append(f"[{tt},{fv}]" if as_arrays
+                             else f'"{tt}":{fv}')
+            assert got == ",".join(parts).encode(), (seconds,
+                                                     as_arrays)
