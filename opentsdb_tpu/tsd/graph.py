@@ -83,9 +83,16 @@ def handle_graph(router, request):
     stats = QueryStats(request.remote, tsq)
     try:
         results = router.tsdb.new_query().run(tsq, stats)
+        response = _render(router, request, tsq, results)
         stats.mark_serialization_successful()
+        return response
     finally:
-        stats.mark_complete()  # failures stay executed=False
+        # query OR render failures stay executed=False
+        stats.mark_complete()
+
+
+def _render(router, request, tsq, results):
+    from opentsdb_tpu.tsd.http_api import HttpError, HttpResponse
 
     if request.flag("ascii") or request.param("format") == "ascii":
         # one line per point: metric timestamp value tags (ref:

@@ -406,12 +406,22 @@ class HttpRpcRouter:
                              or request.flag("show_summary")
                              or request.flag("show_stats")) \
                     and hasattr(request.serializer, "stream_query"):
-                body_iter = request.serializer.stream_query(
+                inner = request.serializer.stream_query(
                     tsq, results, as_arrays=request.flag("arrays"))
+
+                def body_iter(inner=inner, stats=stats, t_ser=t_ser):
+                    # the stream IS the serialization: success and
+                    # timing are marked when it exhausts, so a query
+                    # that streamed fully shows executed=true
+                    yield from inner
+                    stats.add_stat(QueryStat.SERIALIZATION_TIME,
+                                   (time.monotonic() - t_ser) * 1e3)
+                    stats.mark_serialization_successful()
+
                 stats.add_stat(
                     QueryStat.PROCESSING_PRE_WRITE_TIME,
                     (time.monotonic_ns() - stats.start_ns) / 1e6)
-                return HttpResponse(200, b"", body_iter=body_iter)
+                return HttpResponse(200, b"", body_iter=body_iter())
             body = request.serializer.format_query(
                 tsq, results, as_arrays=request.flag("arrays"),
                 show_summary=tsq.show_summary

@@ -108,6 +108,21 @@ class HttpJsonSerializer(HttpSerializer):
         except Exception:  # noqa: BLE001
             return None
 
+    @staticmethod
+    def _dedupe_seconds(ts_arr, vals):
+        """Map-form output keyed on seconds collapses ms points that
+        floor to the same second, LAST one winning (the dict-comp
+        path's behavior) — the native path must match."""
+        import numpy as np
+        secs = ts_arr // 1000
+        if len(np.unique(secs)) == len(secs):
+            return ts_arr, vals
+        # keep the last entry of each run of equal seconds
+        keep = np.empty(len(secs), dtype=bool)
+        keep[:-1] = secs[1:] != secs[:-1]
+        keep[-1] = True
+        return ts_arr[keep], vals[keep]
+
     def _dps_body(self, r: QueryResult, ms: bool,
                   as_arrays: bool) -> bytes:
         """The dps map/array body, natively formatted when large."""
@@ -115,8 +130,10 @@ class HttpJsonSerializer(HttpSerializer):
                 len(r.dps) >= self._NATIVE_FMT_MIN_DPS:
             fmt = self._native_fmt()
             if fmt is not None:
-                inner = fmt(r.dps_arrays[0], r.dps_arrays[1], not ms,
-                            as_arrays)
+                ts_arr, vals = r.dps_arrays
+                if not as_arrays and not ms:
+                    ts_arr, vals = self._dedupe_seconds(ts_arr, vals)
+                inner = fmt(ts_arr, vals, not ms, as_arrays)
                 return (b"[" + inner + b"]") if as_arrays else \
                     (b"{" + inner + b"}")
         if as_arrays:
@@ -171,21 +188,34 @@ class HttpJsonSerializer(HttpSerializer):
             use_native = (fmt is not None
                           and r.dps_arrays is not None
                           and len(r.dps) >= self._NATIVE_FMT_MIN_DPS)
-            for lo in range(0, len(r.dps), self._STREAM_SLAB_DPS):
-                prefix = b"" if lo == 0 else b","
-                hi = lo + self._STREAM_SLAB_DPS
-                if use_native:
-                    yield prefix + fmt(r.dps_arrays[0][lo:hi],
-                                       r.dps_arrays[1][lo:hi],
-                                       not ms, as_arrays)
-                    continue
+            if use_native:
+                ts_all, val_all = r.dps_arrays
+                if not as_arrays and not ms:
+                    ts_all, val_all = self._dedupe_seconds(ts_all,
+                                                           val_all)
+                for lo in range(0, len(ts_all),
+                                self._STREAM_SLAB_DPS):
+                    hi = lo + self._STREAM_SLAB_DPS
+                    yield (b"" if lo == 0 else b",") + \
+                        fmt(ts_all[lo:hi], val_all[lo:hi], not ms,
+                            as_arrays)
+                yield close_c + b"}"
+                continue
+            if not as_arrays:
+                # the dict collapses same-second duplicates last-wins
+                entries = list({(ts if ms else ts // 1000): v
+                                for ts, v in r.dps}.items())
+            else:
+                entries = [(ts if ms else ts // 1000, v)
+                           for ts, v in r.dps]
+            for lo in range(0, len(entries), self._STREAM_SLAB_DPS):
                 parts = []
-                for ts, v in r.dps[lo:hi]:
-                    t = ts if ms else ts // 1000
+                for t, v in entries[lo:lo + self._STREAM_SLAB_DPS]:
                     fv = json.dumps(_format_value(v))
                     parts.append(f"[{t},{fv}]" if as_arrays
                                  else f'"{t}":{fv}')
-                yield prefix + ",".join(parts).encode()
+                yield (b"" if lo == 0 else b",") + \
+                    ",".join(parts).encode()
             yield close_c + b"}"
         yield b"]"
 

@@ -256,6 +256,8 @@ class TSDServer:
                 method=method.upper(), path=parsed.path, params=params,
                 headers=headers, body=body,
                 remote=f"{peer[0]}:{peer[1]}" if peer else "")
+            t0 = time.monotonic()
+            is_query = False
             if method.upper() == "OPTIONS":
                 # preflight bypasses auth — browsers never attach
                 # Authorization to OPTIONS
@@ -274,7 +276,6 @@ class TSDServer:
             else:
                 if self.tsdb.authentication is not None:
                     request.auth = auth_state
-                t0 = time.monotonic()
                 is_query = _is_query_path(parsed.path)
                 fut = asyncio.get_event_loop().run_in_executor(
                     self._query_pool if is_query else None,
@@ -298,8 +299,14 @@ class TSDServer:
                     (time.monotonic() - t0) * 1000)
             self._apply_cors(request, response)
             await self._apply_gzip(request, response)
+            # streamed serialization must honor the query timeout too:
+            # the handler returned promptly with a lazy generator, so
+            # the clock keeps running through the chunk writes
+            deadline = (t0 + self.query_timeout_ms / 1000.0
+                        if is_query and self.query_timeout_ms > 0
+                        and response.body_iter is not None else None)
             await self._write_response(writer, response, version,
-                                       keep_alive)
+                                       keep_alive, deadline=deadline)
 
     def _cors_preflight(self, request: HttpRequest) -> HttpResponse:
         """(ref: RpcHandler CORS handling :46)"""
@@ -331,13 +338,31 @@ class TSDServer:
         gzip support (ref: the reference's Netty HttpContentCompressor
         in PipelineFactory — responses compress per Accept-Encoding).
         The deflate runs on a worker thread: compressing a multi-MB
-        body inline would stall every connection on the event loop."""
-        if len(response.body) < self._GZIP_MIN_BYTES:
-            return
+        body inline would stall every connection on the event loop.
+        Streamed responses compress incrementally per chunk — the
+        biggest responses are exactly the ones that need it."""
         if "Content-Encoding" in response.headers:
             return
         accept = request.headers.get("accept-encoding", "")
         if "gzip" not in accept.lower():
+            return
+        if response.body_iter is not None:
+            import zlib
+            inner = response.body_iter
+
+            def gz_iter():
+                co = zlib.compressobj(6, zlib.DEFLATED, 31)  # gzip hdr
+                for chunk in inner:
+                    out = co.compress(chunk)
+                    if out:
+                        yield out
+                yield co.flush()
+
+            response.body_iter = gz_iter()
+            response.headers["Content-Encoding"] = "gzip"
+            response.headers["Vary"] = "Accept-Encoding"
+            return
+        if len(response.body) < self._GZIP_MIN_BYTES:
             return
         import gzip as _gzip
         response.body = await asyncio.get_event_loop().run_in_executor(
@@ -348,7 +373,8 @@ class TSDServer:
         response.headers["Vary"] = "Accept-Encoding"
 
     async def _write_response(self, writer, response: HttpResponse,
-                              version: str, keep_alive: bool) -> None:
+                              version: str, keep_alive: bool,
+                              deadline: float | None = None) -> None:
         reason = {200: "OK", 204: "No Content", 304: "Not Modified",
                   400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden",
@@ -385,6 +411,14 @@ class TSDServer:
             it = iter(response.body_iter)
             sentinel = object()
             while True:
+                if deadline is not None and \
+                        time.monotonic() > deadline:
+                    # past the query timeout mid-stream: abort the
+                    # connection (headers are sent; an unterminated
+                    # chunked body is the truncation signal)
+                    LOG.warning("query stream exceeded "
+                                "tsd.query.timeout; aborting")
+                    raise ConnectionResetError("stream timeout")
                 chunk = await loop.run_in_executor(
                     None, next, it, sentinel)
                 if chunk is sentinel:

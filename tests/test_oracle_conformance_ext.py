@@ -1,0 +1,184 @@
+"""Conformance extensions beyond the core matrix:
+
+- raw-vs-rollup-tier differential: the SAME query answered from raw
+  data and from job-produced tiers must agree (pins tier selection,
+  the storage-side rollup job, and the avg sum/count division
+  end-to-end; ref: TsdbQuery rollup best-match :143 + RollupSpan).
+- calendar downsampling vs a per-datapoint calendar oracle
+  (ref: DownsamplingSpecification 'c' suffix, DateTime.java:416).
+- filter-type matrix: the engine's vectorized filters must restrict
+  group membership exactly like filtering the oracle's input set
+  (ref: TagVFilter post-scan match, SaltScanner:660).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+from oracle import run_oracle
+
+BASE = 1356998400
+
+
+def make_tsdb(**extra):
+    return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          **extra}))
+
+
+# ---------------------------------------------------------------------------
+# raw vs tier differential
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ds_fn", ["sum", "count", "min", "max", "avg"])
+def test_tier_query_matches_raw_query(ds_fn):
+    """With a 1m downsample, answering from the 1m tiers (written by
+    the rollup job from this very raw data) must equal answering from
+    raw — for every tier-servable function including the avg
+    sum/count division."""
+    def build():
+        t = make_tsdb(**{"tsd.rollups.enable": "true"})
+        rng = np.random.default_rng(31)
+        for i in range(8):
+            n = int(rng.integers(30, 200))
+            ts = BASE + np.sort(rng.choice(7200, n, replace=False))
+            t.add_points("m.diff", ts.astype(np.int64),
+                         np.round(rng.normal(40, 15, n), 3),
+                         {"host": f"h{i % 3}"})
+        return t
+
+    def query(t, usage):
+        obj = {"start": BASE * 1000, "end": (BASE + 7200) * 1000,
+               "queries": [{"metric": "m.diff", "aggregator": "sum",
+                            "downsample": f"1m-{ds_fn}",
+                            "rollupUsage": usage,
+                            "filters": [{"type": "wildcard",
+                                         "tagk": "host", "filter": "*",
+                                         "groupBy": True}]}]}
+        res = t.execute_query(TSQuery.from_json(obj).validate())
+        return {tuple(sorted(r.tags.items())):
+                {t_: v for t_, v in r.dps} for r in res}
+
+    t = build()
+    raw = query(t, "ROLLUP_RAW")
+    from opentsdb_tpu.rollup.job import run_rollup_job
+    run_rollup_job(t, BASE * 1000, (BASE + 7200) * 1000,
+                   intervals=["1m"])
+    # delete raw so the tier MUST answer
+    t.store.delete_range(t.store.series_ids_for_metric(
+        t.uids.metrics.get_id("m.diff")), 0, 2 ** 60)
+    tier = query(t, "ROLLUP_NOFALLBACK")
+    assert set(tier) == set(raw)
+    for k in raw:
+        assert set(tier[k]) == set(raw[k]), k
+        for ts_ in raw[k]:
+            assert tier[k][ts_] == pytest.approx(raw[k][ts_],
+                                                 rel=1e-9), (k, ts_)
+
+
+# ---------------------------------------------------------------------------
+# calendar downsampling
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("tz", ["UTC", "America/New_York"])
+def test_calendar_daily_downsample_matches_oracle(tz):
+    """'1dc' buckets align to local-midnight edges; the differential
+    oracle reduces per edge-assigned bucket independently."""
+    from opentsdb_tpu.ops.downsample import calendar_bucket_edges
+    t = make_tsdb()
+    rng = np.random.default_rng(17)
+    start_s = BASE - 3600 * 30
+    span_s = 3600 * 24 * 4
+    series = []
+    for i in range(4):
+        n = int(rng.integers(100, 300))
+        ts = start_s + np.sort(rng.choice(span_s, n, replace=False))
+        vals = np.round(rng.normal(10, 4, n), 3)
+        # unique id tag: same-tag series would merge into one identity
+        t.add_points("m.cal", ts.astype(np.int64), vals,
+                     {"host": f"h{i % 2}", "id": str(i)})
+        series.append((i % 2, ts * 1000, vals))
+    start_ms = (start_s - 100) * 1000
+    end_ms = (start_s + span_s) * 1000
+    obj = {"start": start_ms, "end": end_ms, "timezone": tz,
+           "queries": [{"metric": "m.cal", "aggregator": "sum",
+                        "downsample": "1dc-sum",
+                        "filters": [{"type": "wildcard", "tagk": "host",
+                                     "filter": "*", "groupBy": True}]}]}
+    res = t.execute_query(TSQuery.from_json(obj).validate())
+    edges = calendar_bucket_edges(start_ms, end_ms, 1, "d", tz)
+    got = {r.tags["host"]: {t_: v for t_, v in r.dps} for r in res}
+    for g in range(2):
+        # oracle: assign each point to its calendar bucket, then sum
+        # buckets per series, then sum across series per bucket (the
+        # engine interpolates only at true gaps; aligned buckets here)
+        want: dict[int, float] = {}
+        for gg, ts_ms, vals in series:
+            if gg != g:
+                continue
+            idx = np.searchsorted(edges, ts_ms, side="right") - 1
+            for j, b in enumerate(idx):
+                if start_ms <= ts_ms[j] <= end_ms:
+                    key = int(edges[b])
+                    want[key] = want.get(key, 0.0) + float(vals[j])
+        gk = f"h{g}"
+        assert set(got[gk]) == set(want)
+        for b in want:
+            assert got[gk][b] == pytest.approx(want[b], rel=1e-6), \
+                (tz, g, b)
+
+
+# ---------------------------------------------------------------------------
+# filter-type matrix
+# ---------------------------------------------------------------------------
+
+FILTER_CASES = [
+    ({"type": "literal_or", "tagk": "host", "filter": "h0|h2"},
+     lambda tags: tags.get("host") in ("h0", "h2")),
+    ({"type": "iliteral_or", "tagk": "host", "filter": "H1"},
+     lambda tags: tags.get("host", "").lower() == "h1"),
+    ({"type": "wildcard", "tagk": "host", "filter": "h*"},
+     lambda tags: tags.get("host", "").startswith("h")),
+    ({"type": "regexp", "tagk": "host", "filter": "h[01]"},
+     lambda tags: tags.get("host") in ("h0", "h1")),
+    ({"type": "not_literal_or", "tagk": "host", "filter": "h0"},
+     lambda tags: tags.get("host") != "h0"),
+    ({"type": "not_key", "tagk": "dc", "filter": ""},
+     lambda tags: "dc" not in tags),
+]
+
+
+@pytest.mark.parametrize("fspec,predicate", FILTER_CASES,
+                         ids=[c[0]["type"] for c in FILTER_CASES])
+def test_filter_matrix_matches_oracle_subset(fspec, predicate):
+    t = make_tsdb()
+    rng = np.random.default_rng(23)
+    series = []
+    for i in range(9):
+        # unique id tag: same-tag series would merge into one identity
+        tags = {"host": f"h{i % 4}", "id": str(i)}
+        if i % 3 == 0:
+            tags["dc"] = "east"
+        n = int(rng.integers(20, 80))
+        ts = BASE + np.sort(rng.choice(3000, n, replace=False)) * 1
+        vals = np.round(rng.normal(5, 2, n), 3)
+        t.add_points("m.filt", ts.astype(np.int64), vals, tags)
+        series.append((tags, ts * 1000, vals))
+    obj = {"start": BASE * 1000, "end": (BASE + 3000) * 1000,
+           "queries": [{"metric": "m.filt", "aggregator": "sum",
+                        "downsample": "1m-sum",
+                        "filters": [dict(fspec, groupBy=False)]}]}
+    res = t.execute_query(TSQuery.from_json(obj).validate())
+    members = [(ts, vals) for tags, ts, vals in series
+               if predicate(tags)]
+    want = run_oracle(members, "sum", 60_000, "sum", BASE * 1000,
+                      (BASE + 3000) * 1000)
+    want = {k: v for k, v in want.items() if not np.isnan(v)}
+    if not members:
+        assert res == []
+        return
+    got = {t_: v for t_, v in res[0].dps}
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-6), k

@@ -225,3 +225,13 @@ class TestGraphEndpoint:
         assert resp.status == 200
         done = QueryStats.running_and_completed()["completed"]
         assert done and done[-1]["executed"]
+
+    def test_graph_render_failure_not_executed(self, seeded_tsdb):
+        from opentsdb_tpu.stats.stats import QueryStats
+        router = self.make_router(seeded_tsdb)
+        resp = self.request(router, "/q", {
+            "start": "2012/12/31-23:00:00", "m": "sum:sys.cpu.user",
+            "yrange": "not-a-range"})
+        assert resp.status == 400
+        done = QueryStats.running_and_completed()["completed"]
+        assert done and done[-1]["executed"] is False

@@ -415,10 +415,74 @@ def test_chunked_streaming_large_query():
             parsed = _json.loads(out)
             assert len(parsed) == 20
             assert sum(len(r["dps"]) for r in parsed) == 300
+            # a fully-streamed query records as a SUCCESS
+            from opentsdb_tpu.stats.stats import QueryStats
+            done = QueryStats.running_and_completed()["completed"]
+            assert done and done[-1]["executed"] is True
+
+            # gzip negotiation applies to the stream too
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(
+                f"GET /api/query?start={BASE - 10}&end={BASE + 900}"
+                f"&m=none:m HTTP/1.1\r\n"
+                f"Accept-Encoding: gzip\r\n"
+                f"Connection: close\r\n\r\n".encode())
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(), 30)
+            writer.close()
+            head, _, body = data.partition(b"\r\n\r\n")
+            assert b"Transfer-Encoding: chunked" in head
+            assert b"Content-Encoding: gzip" in head
+            gz, pos = b"", 0
+            while True:
+                eol = body.index(b"\r\n", pos)
+                n = int(body[pos:eol], 16)
+                if n == 0:
+                    break
+                gz += body[eol + 2:eol + 2 + n]
+                pos = eol + 2 + n + 2
+            import gzip as _gz
+            assert _gz.decompress(gz) == out
         finally:
             await server.stop()
 
     asyncio.run(scenario())
+
+
+def test_stream_map_form_collapses_same_second_duplicates():
+    """Second-resolution output over ms data: the map form collapses
+    same-second points last-wins on EVERY path (python dict, native,
+    streamed), while the arrays form keeps all points."""
+    from opentsdb_tpu.query.engine import QueryResult
+    from opentsdb_tpu.query.model import TSQuery
+    from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+    import numpy as np
+    import json as _json
+
+    ser = HttpJsonSerializer()
+    ser._NATIVE_FMT_MIN_DPS = 1
+    ser._STREAM_SLAB_DPS = 3
+    tsq = TSQuery(start="1h-ago")
+    tsq.ms_resolution = False
+    ts = np.asarray([BASE * 1000, BASE * 1000 + 250,
+                     BASE * 1000 + 500, BASE * 1000 + 1000],
+                    dtype=np.int64)
+    vals = np.asarray([1.0, 2.0, 3.0, 4.0])
+    r = QueryResult("m", {}, [], list(zip(ts.tolist(), vals.tolist())),
+                    dps_arrays=(ts, vals))
+    r_py = QueryResult("m", {}, [], list(zip(ts.tolist(),
+                                             vals.tolist())))
+    for as_arrays in (False, True):
+        native = ser.format_query(tsq, [r], as_arrays=as_arrays)
+        python = ser.format_query(tsq, [r_py], as_arrays=as_arrays)
+        streamed = b"".join(ser.stream_query(tsq, [r],
+                                             as_arrays=as_arrays))
+        assert native == python == streamed, as_arrays
+    d = _json.loads(ser.format_query(tsq, [r]))
+    assert d[0]["dps"] == {str(BASE): 3.0, str(BASE + 1): 4.0}
+    d = _json.loads(ser.format_query(tsq, [r], as_arrays=True))
+    assert len(d[0]["dps"]) == 4
 
 
 def test_stream_query_byte_identical_to_format_query():
