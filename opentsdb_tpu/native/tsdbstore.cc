@@ -838,7 +838,10 @@ int64_t tss_parse_import(const char* buf, int64_t len, int64_t* ts_out,
     const char* nl =
         (const char*)memchr(buf + pos, '\n', (size_t)(len - pos));
     int64_t aligned = nl ? (nl - buf) + 1 : len;
-    if (aligned > starts.back()) starts.push_back(aligned);
+    // aligned == len would create an empty final chunk whose
+    // "trailing line without newline" credit (below) belongs to the
+    // chunk that actually owns the final bytes — skip it.
+    if (aligned > starts.back() && aligned < len) starts.push_back(aligned);
   }
   starts.push_back(len);
   int nchunks = (int)starts.size() - 1;

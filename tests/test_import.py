@@ -167,6 +167,41 @@ class TestImportBuffer:
         assert (p.errors > 0).sum() == 1
         assert int(np.nonzero(p.errors > 0)[0][0]) == 500
 
+    @pytest.mark.parametrize("threads", [2, 3, 8])
+    def test_no_trailing_newline_multithread(self, threads):
+        # Regression: a chunk boundary past the last newline used to
+        # create an empty final chunk that was credited with the
+        # unterminated last line while the previous chunk parsed it —
+        # heap OOB in the remap pass / corrupted group ids.
+        from opentsdb_tpu.native.store_backend import \
+            parse_import_buffer
+        # single unterminated line (the reported crash shape)
+        p = parse_import_buffer(
+            b"sys.cpu 1600000000 1 host=a", threads=threads)
+        assert p.num_lines == 1 and p.num_groups == 1
+        assert p.errors.tolist() == [0]
+        assert p.group_ids.tolist() == [0]
+        # multi-line buffer without a trailing newline: per-line
+        # outputs must match the single-threaded parse exactly
+        lines = [f"m{i % 4} {BASE + i} {i}.5 host=h{i % 3}"
+                 for i in range(1001)]
+        buf = "\n".join(lines).encode()  # no trailing newline
+        p1 = parse_import_buffer(buf, threads=1)
+        pn = parse_import_buffer(buf, threads=threads)
+        assert pn.num_lines == p1.num_lines == 1001
+        assert pn.num_groups == p1.num_groups == 12
+        assert pn.ts.tolist() == p1.ts.tolist()
+        assert pn.values.tolist() == p1.values.tolist()
+        # group numbering may differ between thread counts; compare
+        # via the representative line of each group
+        rep1 = {g: p1.rep_lines[g] for g in range(p1.num_groups)}
+        repn = {g: pn.rep_lines[g] for g in range(pn.num_groups)}
+        for i in range(1001):
+            assert (repn[int(pn.group_ids[i])].split()[0:1] +
+                    repn[int(pn.group_ids[i])].split()[3:]) == \
+                   (rep1[int(p1.group_ids[i])].split()[0:1] +
+                    rep1[int(p1.group_ids[i])].split()[3:])
+
     def test_empty_buffer(self):
         t = _tsdb()
         assert t.import_buffer(b"") == (0, [])
