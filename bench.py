@@ -145,10 +145,10 @@ def main() -> None:
         (d_vals2d, d_bts, d_gids))
 
     # fused Pallas kernel (MXU one-hot group reduction); eps rides on
-    # the tiny [P,B] operator matrix instead of the values -- perturbing
-    # the 240MB values input would add un-fusable HBM traffic ahead of
-    # the opaque pallas_call and mismeasure it. Guarded: any Mosaic
-    # failure falls back to the dense XLA number.
+    # the tiny [B,1] inverse-dt vector instead of the values --
+    # perturbing the 240MB values input would add un-fusable HBM
+    # traffic ahead of the opaque pallas_call and mismeasure it.
+    # Guarded: any Mosaic failure falls back to the dense XLA number.
     dt_pallas = None
     try:
         from opentsdb_tpu.ops import pallas_fused
@@ -157,8 +157,8 @@ def main() -> None:
             args, tile_s, interp = pallas_fused.prepare(
                 vals2d, bucket_ts, group_ids, spec, k, dtype=dtype)
             dt_pallas = _time_device(
-                lambda eps, v, g, a, b_, sz: pallas_fused._run(
-                    v, g, a + eps, b_, sz, spec, tile_s, interp)[0],
+                lambda eps, v, g, a, iv, sz: pallas_fused._run(
+                    v, g, a, iv + eps, sz, spec, tile_s, interp)[0],
                 args)
     except Exception as e:  # noqa: BLE001
         print(f"pallas path unavailable: {e}", file=sys.stderr)

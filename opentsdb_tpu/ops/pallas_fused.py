@@ -4,20 +4,36 @@ The XLA dense path (:func:`opentsdb_tpu.ops.pipeline.run_pipeline_dense`)
 compiles to a reshape-reduction followed by ``jax.ops.segment_sum`` for
 the group stage. On TPU the segment reduction lowers to a scatter-add —
 a serialized, VPU-hostile op. This kernel replaces the whole chain
-(downsample -> rate -> group reduce) with a single ``pallas_call`` in
-which EVERY reduction is a matmul on the **MXU** (the systolic array):
+(downsample -> rate -> group reduce) in a single ``pallas_call``:
 
-- downsample: ``x[TILE_S, P] @ A[P, B]`` where ``A`` is the
-  host-precomputed bucket-membership matrix (1 or 1/k per cell; one-hot
-  columns for first/last);
-- rate: the first-difference operator is linear, so its shift matrix
-  ``R`` (I with -1 superdiagonal) and the 1/dt scaling are folded into
-  ``A``/``bias`` on the host — no in-kernel shifts;
-- group-by: ``onehot(group_ids)[G, TILE_S] @ grid[TILE_S, B]``
-  accumulated across series tiles (one-hot segment-reduction-as-matmul).
+- **layout**: the value matrix is streamed as ``[P, S]`` (time-major),
+  NOT ``[S, P]``. XLA stores TPU arrays (8, 128)-lane-tiled, so an
+  ``[S, P]`` f32 array with P = 60 pads 60 -> 128 lanes in HBM and the
+  kernel would stream ~2x the logical bytes. Time-major puts the huge
+  series axis on the 128-lane dimension (near-zero padding) and was
+  measured at the HBM roofline (~750 GB/s on v5e vs ~380 GB/s for the
+  row-major layout).
+- downsample: ``A01[B, P] @ x[P, TILE]`` where ``A01`` is the
+  host-built bucket-membership matrix with entries in {0, 1} (one-hot
+  rows for first/last); the 1/k average scale is applied afterwards on
+  the VPU so ``A01`` stays *exactly representable in bfloat16*;
+  min/max downsample runs as a VPU reshape-reduction instead.
+- rate: explicit first-difference on the ``[B, TILE]`` downsampled
+  block (sublane shift + multiply by host-precomputed 1/dt), which also
+  supports counter rollover correction + reset_value — nonlinear ops a
+  folded matmul cannot express.
+- group-by: ``onehot(group_ids)[G, TILE] @ t[B, TILE]^T`` accumulated
+  across series tiles (one-hot segment-reduction-as-matmul).
 
-The ``[S, P]`` value matrix is streamed HBM -> VMEM one series tile at a
-time — a single full pass over the data, everything else rides the MXU.
+**Precision**: the MXU rounds f32 operands to bf16 (measured 0.6%
+error). ``Precision.HIGHEST`` fixes that at 6 passes per dot and cost
+r02 23% of throughput. Instead, since one operand of every dot (A01 /
+onehot) is exact in bf16, only the value operand needs splitting:
+``x = hi + mid + lo`` with three bf16 terms carries all 24 f32 mantissa
+bits, so three 1-pass dots accumulated in f32 are f32-exact — half the
+MXU passes of HIGHEST, and the MXU work is negligible against the HBM
+stream. On non-TPU backends (interpreter mode, the CPU test matrix) the
+dots run unsplit in the compute dtype, keeping golden tests exact.
 
 Scope: used for *complete* regular-cadence data (no NaN holes) — the
 monitoring-data common case and the benchmark shape (BASELINE.json
@@ -25,10 +41,9 @@ configs). With no holes, merge interpolation
 (AggregationIterator.java:27-119) is a no-op, so the kernel is
 numerically identical to the general path; the caller
 (:func:`opentsdb_tpu.ops.pipeline.execute`) verifies completeness and
-falls back otherwise. Golden tests: ``tests/test_pallas_fused.py``.
-
-On non-TPU backends the kernel runs in interpreter mode so the CPU test
-matrix exercises the same code path.
+falls back otherwise. ``rate_drop_resets`` stays on the XLA path: the
+dropped points re-open NaN holes mid-pipeline. Golden tests:
+``tests/test_pallas_fused.py``.
 """
 
 from __future__ import annotations
@@ -41,136 +56,201 @@ import numpy as np
 
 from jax.experimental import pallas as pl
 
-# downsample functions expressible as a matmul against a membership
-# matrix on complete data (min/max need order statistics -> XLA path)
-_DS_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "count", "first",
-                     "last"))
+# downsample functions the kernel computes on complete data: matmul
+# against an exact {0,1} membership matrix, VPU reshape-reductions for
+# min/max, or a constant for count
+_MATMUL_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "first",
+                         "last"))
+_MINMAX_FNS = frozenset(("min", "mimmin", "max", "mimmax"))
+_DS_FNS = _MATMUL_FNS | _MINMAX_FNS | {"count"}
 # group aggregators expressible as an accumulated matmul
 _AGG_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "count",
                       "squareSum"))
 
-_VMEM_BUDGET = 6 * 1024 * 1024  # per-tile VMEM budget for the value block
+_VMEM_BUDGET = 10 * 1024 * 1024  # working-set budget per grid step
+_MAX_GROUPS = 4096               # onehot [G, TILE] VMEM guard
 
 
 def supported(spec, dtype) -> bool:
     """Can the kernel run this (ds_function, agg, rate) combination?"""
     if spec.ds_function not in _DS_FNS or spec.agg_name not in _AGG_FNS:
         return False
-    if spec.emit_raw:
+    if spec.emit_raw or spec.num_groups > _MAX_GROUPS:
         return False
-    if spec.rate and (spec.rate_counter or spec.rate_drop_resets):
-        return False
+    if spec.rate and spec.rate_drop_resets:
+        return False  # re-opens NaN holes mid-pipeline
     if jnp.dtype(dtype) == jnp.float64 and \
             jax.default_backend() == "tpu":
         return False  # MXU has no f64
     return True
 
 
-def _tile_s(s: int, p: int, itemsize: int) -> int:
-    # 1024 measured fastest on v5e for the benchmark shape (P=64):
-    # fewer grid steps than 256 (amortizes per-step overhead ~3x),
-    # while 2048+ degrades (VMEM pressure from the [G, TILE_S] one-hot
-    # and worse MXU scheduling). Halve only to respect the VMEM budget
-    # for long point axes.
-    tile = 1024
-    while tile > 8 and tile * p * itemsize > _VMEM_BUDGET:
+def _tile_s(s: int, p: int, g: int, itemsize: int) -> int:
+    """Lane-dim series tile. 8192 measured fastest on v5e for the
+    benchmark shape (P=60): the [P, TILE] stream block + its three bf16
+    split terms + the [G, TILE] one-hot must fit the VMEM working set
+    alongside the double-buffered input."""
+    tile = 8192
+    while tile > 128 and \
+            (p * tile * (2 * itemsize + 3 * 2) + g * tile * 2) \
+            > _VMEM_BUDGET:
         tile //= 2
-    return max(8, min(tile, -(-s // 8) * 8))
+    return max(128, min(tile, -(-s // 128) * 128))
 
 
-def _build_operators(spec, k: int, bucket_ts: np.ndarray, dtype):
-    """Host-side: fold downsample + rate + dt scaling into
-    (A [P, B], bias [1, B])."""
+def _build_membership(spec, k: int, dtype):
+    """Host-side: the {0,1} bucket-membership matrix A01 [B, P], exact
+    in bf16. (The 1/k average post-scale lives in ``_kernel``: it must
+    apply AFTER the split dots so the matrix stays exact.)"""
     b = spec.num_buckets
     p = b * k
     fn = spec.ds_function
-    m = np.zeros((p, b), dtype=dtype)
-    bias = np.zeros((1, b), dtype=dtype)
+    m = np.zeros((b, p), dtype=dtype)
     cols = np.arange(b)
-    if fn in ("sum", "zimsum", "pfsum"):
+    if fn in ("sum", "zimsum", "pfsum", "avg"):
         for j in range(b):
-            m[j * k:(j + 1) * k, j] = 1.0
-    elif fn == "avg":
-        for j in range(b):
-            m[j * k:(j + 1) * k, j] = 1.0 / k
+            m[j, j * k:(j + 1) * k] = 1.0
     elif fn == "first":
-        m[cols * k, cols] = 1.0
+        m[cols, cols * k] = 1.0
     elif fn == "last":
-        m[cols * k + k - 1, cols] = 1.0
-    elif fn == "count":
-        bias[0, :] = float(k)  # complete data: every bucket holds k pts
-    else:  # pragma: no cover - guarded by supported()
-        raise ValueError(fn)
-    if spec.rate:
-        # rate[b] = (ds[b] - ds[b-1]) / dt[b]: fold the difference
-        # operator R (I with -1 on the superdiagonal) AND the 1/dt
-        # scaling into A/bias on the host; column 0 scales to 0 to
-        # stand in for the dropped first bucket (finalizer turns it
-        # into NaN / ZIM-zero).
-        r = np.eye(b, dtype=np.float64)
-        r[cols[:-1], cols[1:]] = -1.0
-        ts = np.asarray(bucket_ts, dtype=np.float64)
-        dt = np.ones(b, dtype=np.float64)
-        if b > 1:
-            d = (ts[1:] - ts[:-1]) / 1000.0  # ms -> s (RateSpan dv/dt)
-            d[d <= 0] = 1.0  # _rate_kernel clamps non-positive dt
-            dt[1:] = d
-        inv = 1.0 / dt
-        inv[0] = 0.0
-        m = (m.astype(np.float64) @ r * inv[None, :]).astype(dtype)
-        bias = (bias.astype(np.float64) @ r * inv[None, :]).astype(dtype)
-    return m, bias
+        m[cols, cols * k + k - 1] = 1.0
+    # count / min / max: matrix unused
+    return m
 
 
-def _kernel(vals_ref, gid_ref, a_ref, bias_ref, acc_ref, *,
-            g: int, square: bool):
-    """One series tile: (x @ A) + bias, then one-hot matmul."""
+def _build_inv_dt(spec, bucket_ts: np.ndarray, dtype) -> np.ndarray:
+    """Host-side: 1/dt seconds per bucket for the rate stage, column 0
+    zeroed (the dropped first bucket; finalizer masks it)."""
+    b = spec.num_buckets
+    ts = np.asarray(bucket_ts, dtype=np.float64)
+    dt = np.ones(b, dtype=np.float64)
+    if b > 1:
+        d = (ts[1:] - ts[:-1]) / 1000.0  # ms -> s (RateSpan dv/dt)
+        d[d <= 0] = 1.0  # _rate_kernel clamps non-positive dt
+        dt[1:] = d
+    inv = 1.0 / dt
+    inv[0] = 0.0
+    return inv.reshape(b, 1).astype(dtype)
+
+
+def _split3(x, acc_dtype):
+    """x (f32) -> three bf16 terms carrying all 24 mantissa bits."""
+    hi = x.astype(jnp.bfloat16)
+    r = x - hi.astype(acc_dtype)
+    mid = r.astype(jnp.bfloat16)
+    lo = (r - mid.astype(acc_dtype)).astype(jnp.bfloat16)
+    return hi, mid, lo
+
+
+def _dot_exact(exact_operand, x, split: bool, acc_dtype,
+               dims=(((1,), (0,)), ((), ()))):
+    """exact_operand . x (dot_general ``dims``, default plain matmul)
+    with f32-class accuracy: ``exact_operand`` is exactly representable
+    in bf16 (0/1 entries), so only ``x`` needs the 3-term bf16 split on
+    the MXU (3 single-pass dots vs HIGHEST's 6). Unsplit in interpreter
+    mode / f64."""
+    if not split:
+        return jax.lax.dot_general(exact_operand, x, dims,
+                                   preferred_element_type=acc_dtype)
+    out = None
+    for part in _split3(x, acc_dtype):
+        d = jax.lax.dot_general(exact_operand, part, dims,
+                                preferred_element_type=acc_dtype)
+        out = d if out is None else out + d
+    return out
+
+
+def _kernel(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, acc_ref, *,
+            spec, k: int, g: int, split: bool):
+    """One series tile: downsample [P,T] -> [B,T], optional rate,
+    optional square, then one-hot group matmul into acc [G, B].
+    rp_ref [1, 2] carries (counter_max, reset_value) as traced values
+    so per-query rate options never force a Mosaic recompile."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    tile_s = vals_ref.shape[0]
-    # HIGHEST precision: the MXU otherwise rounds f32 operands to bf16
-    # (measured 0.6% error on rate queries); 6-pass bf16 is f32-exact
-    # and the kernel is bandwidth-bound, so the extra MXU passes are
-    # hidden behind the HBM stream
-    t = jnp.dot(vals_ref[:], a_ref[:],
-                preferred_element_type=acc_ref.dtype,
-                precision=jax.lax.Precision.HIGHEST)
-    t = t + bias_ref[:]
-    if square:
+    x = vals_ref[:]                              # [P, TILE]
+    tile = x.shape[1]
+    b = spec.num_buckets
+    dtype = acc_ref.dtype
+    fn = spec.ds_function
+
+    # 1. downsample -> t [B, TILE]
+    if fn in _MATMUL_FNS:
+        t = _dot_exact(a_ref[:], x, split, dtype)
+        if fn == "avg":
+            t = t * dtype.type(1.0 / k)
+    elif fn == "count":
+        t = jnp.full((b, tile), float(k), dtype)
+    else:  # min / max family: VPU reshape-reduction over k sub-rows
+        xr = x.reshape(b, k, tile)
+        if fn in ("min", "mimmin"):
+            t = jnp.min(xr, axis=1)
+        else:
+            t = jnp.max(xr, axis=1)
+
+    # 2. rate: explicit first difference over the bucket (sublane) axis;
+    # complete data means the previous present point is always the
+    # previous bucket. inv_ref[0] == 0 kills the dropped first bucket.
+    if spec.rate:
+        t_prev = jnp.concatenate([t[0:1], t[:-1]], axis=0)
+        delta = t - t_prev
+        if spec.rate_counter:
+            # RateSpan.java:150-170 rollover correction
+            counter_max = rp_ref[0, 0]
+            delta = jnp.where(delta < 0, counter_max - t_prev + t,
+                              delta)
+        t = delta * inv_ref[:]
+        if spec.rate_counter:
+            # reset_value: corrected rates above threshold emit 0
+            reset_value = rp_ref[0, 1]
+            t = jnp.where((reset_value > 0) & (t > reset_value),
+                          dtype.type(0.0), t)
+
+    if spec.agg_name == "squareSum":
         t = t * t
-    # one-hot [G, TILE_S]: padded rows carry gid -1 -> all-zero columns
-    gid = gid_ref[:].reshape(1, tile_s)
-    onehot = (jax.lax.broadcasted_iota(jnp.int32, (g, tile_s), 0)
-              == gid).astype(t.dtype)
-    acc_ref[:] += jnp.dot(onehot, t,
-                          preferred_element_type=acc_ref.dtype,
-                          precision=jax.lax.Precision.HIGHEST)
+
+    # 3. group reduce: onehot [G, TILE] (exact in bf16; padded series
+    # carry gid -1 -> all-zero columns) against t^T
+    gid = gid_ref[:]                             # [1, TILE]
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (g, tile), 0)
+              == gid)
+    onehot = onehot.astype(jnp.bfloat16 if split else dtype)
+    # onehot [G, T] . t [B, T] contracting T -> [G, B]
+    acc_ref[:] += _dot_exact(onehot, t, split, dtype,
+                             dims=(((1,), (1,)), ((), ())))
 
 
-@partial(jax.jit, static_argnames=("spec", "tile_s", "interpret"))
-def _run(values2d, group_ids_padded, a_mat, bias, group_sizes,
-         spec, tile_s: int, interpret: bool):
-    s_pad, p = values2d.shape
+@partial(jax.jit, static_argnames=("spec", "tile_s", "interpret",
+                                   "force_split"))
+def _run(values_t, group_ids_row, a_mat, inv_dt, group_sizes,
+         spec, tile_s: int, interpret: bool, rate_params=None,
+         force_split: bool = False):
+    p, s_pad = values_t.shape
     b, g = spec.num_buckets, spec.num_groups
-    dtype = values2d.dtype
-    kern = partial(_kernel, g=g, square=(spec.agg_name == "squareSum"))
+    k = p // b
+    dtype = values_t.dtype
+    split = (force_split or not interpret) and dtype == jnp.float32
+    if rate_params is None:
+        rate_params = jnp.asarray([[float(2**64 - 1), 0.0]], dtype)
+    kern = partial(_kernel, spec=spec, k=k, g=g, split=split)
     acc = pl.pallas_call(
         kern,
         grid=(s_pad // tile_s,),
         in_specs=[
-            pl.BlockSpec((tile_s, p), lambda i: (i, 0)),
-            pl.BlockSpec((tile_s, 1), lambda i: (i, 0)),
-            pl.BlockSpec((p, b), lambda i: (0, 0)),
-            pl.BlockSpec((1, b), lambda i: (0, 0)),
+            pl.BlockSpec((p, tile_s), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_s), lambda i: (0, i)),
+            pl.BlockSpec((b, p), lambda i: (0, 0)),
+            pl.BlockSpec((b, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((g, b), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((g, b), dtype),
         interpret=interpret,
-    )(values2d, group_ids_padded, a_mat, bias)
+    )(values_t, group_ids_row, a_mat, inv_dt, rate_params)
 
     # finalize [G,B] (cheap; stays in the same jit program)
     sizes = group_sizes[:, None].astype(dtype)  # [G,1] series per group
@@ -205,38 +285,55 @@ def _run(values2d, group_ids_padded, a_mat, bias, group_sizes,
     return result, emit
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _transpose(values2d):
+    """[S_pad, P] -> [P, S_pad] on device: one HBM round trip, vs the
+    2x stream penalty every query execution would otherwise pay (see
+    module docstring on lane tiling)."""
+    return values2d.T
+
+
 def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
             group_ids: np.ndarray, spec, k: int, dtype=jnp.float32,
-            device=None):
-    """Host prep: pad, fold operators, upload. Returns
-    (device_args, tile_s, interpret) ready for :func:`_run` — split out
-    so callers timing steady-state compute can upload once."""
+            device=None, force_split: bool = False):
+    """Host prep: pad, build operators, upload, transpose on device.
+    Returns (device_args, tile_s, interpret) ready for :func:`_run` —
+    split out so callers timing steady-state compute can upload once."""
     np_dtype = np.dtype(dtype)
     s, p = values2d.shape
-    tile_s = _tile_s(s, p, np_dtype.itemsize)
+    tile_s = _tile_s(s, p, spec.num_groups, np_dtype.itemsize)
     s_pad = -(-s // tile_s) * tile_s
     vals = np.zeros((s_pad, p), dtype=np_dtype)
     vals[:s] = values2d
-    gids = np.full((s_pad, 1), -1, dtype=np.int32)
-    gids[:s, 0] = group_ids
-    a_mat, bias = _build_operators(spec, k, bucket_ts, np_dtype)
+    gids = np.full((1, s_pad), -1, dtype=np.int32)
+    gids[0, :s] = group_ids
+    interpret = jax.default_backend() != "tpu"
+    split = (force_split or not interpret) and np_dtype == np.float32
+    a_mat = _build_membership(
+        spec, k, np.float32 if split else np_dtype)
+    a_dev = jnp.asarray(a_mat, dtype=jnp.bfloat16 if split else dtype)
+    inv_dt = _build_inv_dt(spec, bucket_ts, np_dtype)
     sizes = np.bincount(group_ids, minlength=spec.num_groups) \
         .astype(np.int32)
     put = partial(jax.device_put, device=device)
-    args = (put(jnp.asarray(vals)), put(jnp.asarray(gids)),
-            put(jnp.asarray(a_mat)), put(jnp.asarray(bias)),
-            put(jnp.asarray(sizes)))
-    interpret = jax.default_backend() != "tpu"
+    vals_t = _transpose(put(jnp.asarray(vals)))
+    args = (vals_t, put(jnp.asarray(gids)), put(a_dev),
+            put(jnp.asarray(inv_dt)), put(jnp.asarray(sizes)))
     return args, tile_s, interpret
 
 
 def fused_dense_pipeline(values2d: np.ndarray, bucket_ts: np.ndarray,
                          group_ids: np.ndarray, spec, k: int,
-                         dtype=jnp.float32, device=None):
+                         dtype=jnp.float32, device=None,
+                         rate_options=None):
     """Host entry mirroring :func:`pipeline.run_pipeline_dense` for
     complete data. values2d [S, P] (no NaN), bucket_ts [B] ms,
     group_ids [S] -> (result [G,B] np, emit [G,B] np)."""
     args, tile_s, interpret = prepare(values2d, bucket_ts, group_ids,
                                       spec, k, dtype, device)
-    result, emit = _run(*args, spec, tile_s, interpret)
+    cm = float(rate_options.counter_max) if rate_options else \
+        float(2**64 - 1)
+    rv = float(rate_options.reset_value) if rate_options else 0.0
+    rp = jnp.asarray([[cm, rv]], dtype)
+    result, emit = _run(*args, spec, tile_s, interpret, rate_params=rp)
     return np.asarray(result), np.asarray(emit)

@@ -380,7 +380,7 @@ def _run_dense_or_pallas(values2d, bucket_ts, group_ids, spec, k, ro,
     """Regular-cadence execution: the fused Pallas kernel when the data
     and op combination allow it, the XLA dense reshape path otherwise.
     Shared by :func:`execute` and :func:`execute_auto`."""
-    if use_pallas and not (ro.counter or ro.drop_resets):
+    if use_pallas and not ro.drop_resets:
         from opentsdb_tpu.ops import pallas_fused
         if pallas_fused.supported(spec, dtype) \
                 and not np.isnan(values2d).any():
@@ -388,7 +388,7 @@ def _run_dense_or_pallas(values2d, bucket_ts, group_ids, spec, k, ro,
                 return pallas_fused.fused_dense_pipeline(
                     values2d, np.asarray(bucket_ts),
                     np.asarray(group_ids), spec, k, dtype=dtype,
-                    device=device)
+                    device=device, rate_options=ro)
             except Exception:  # noqa: BLE001
                 # Mosaic compile/runtime failure -> the XLA dense path
                 # computes the same thing; log and degrade

@@ -72,10 +72,89 @@ def test_pallas_declines_unsupported_agg():
     spec = PipelineSpec(num_series=10, num_buckets=6, num_groups=3,
                         ds_function="sum", agg_name="p99")
     assert not pallas_fused.supported(spec, np.float32)
+    # drop_resets re-opens NaN holes mid-pipeline -> XLA path
     spec2 = PipelineSpec(num_series=10, num_buckets=6, num_groups=3,
                          ds_function="sum", agg_name="sum",
-                         rate=True, rate_counter=True)
+                         rate=True, rate_counter=True,
+                         rate_drop_resets=True)
     assert not pallas_fused.supported(spec2, np.float32)
+    # plain counter rollover IS kernel-supported (in-kernel VPU diff)
+    spec3 = PipelineSpec(num_series=10, num_buckets=6, num_groups=3,
+                         ds_function="sum", agg_name="sum",
+                         rate=True, rate_counter=True)
+    assert pallas_fused.supported(spec3, np.float32)
+
+
+def test_pallas_counter_rate_matches_xla():
+    """Counter rollover correction + reset_value in-kernel vs the XLA
+    rate kernel (ref RateSpan.java:150-170). drop_resets stays
+    kernel-unsupported (asserted above), so only drop=False is a real
+    pallas-vs-XLA differential."""
+    drop = False
+    rng = np.random.default_rng(21)
+    s, b, k, g = 9, 7, 3, 4
+    p = b * k
+    # monotone counters with injected rollovers
+    base = np.cumsum(rng.uniform(1, 50, size=(s, p)), axis=1)
+    base[3, 10:] -= base[3, 10] * 0.9  # rollover mid-series
+    base[6, 5:] -= base[6, 5] * 0.7
+    values = base.reshape(-1)
+    si = np.repeat(np.arange(s, dtype=np.int32), p)
+    bi = np.tile(np.repeat(np.arange(b, dtype=np.int32), k), s)
+    ts = np.arange(b, dtype=np.int64) * 60_000 + 1_356_998_400_000
+    gids = (np.arange(s) % g).astype(np.int32)
+    for reset in (0.0, 5.0):
+        spec = PipelineSpec(num_series=s, num_buckets=b, num_groups=g,
+                            ds_function="last", agg_name="sum",
+                            rate=True, rate_counter=True,
+                            rate_drop_resets=drop)
+        ro = RateOptions(counter=True, counter_max=2**32,
+                         reset_value=reset, drop_resets=drop)
+        got, got_emit = execute(values, si, bi, ts, gids, spec,
+                                rate_options=ro, use_pallas=True)
+        want, want_emit = execute(values, si, bi, ts, gids, spec,
+                                  rate_options=ro, use_pallas=False)
+        np.testing.assert_allclose(got, want, rtol=1e-9,
+                                   equal_nan=True)
+        np.testing.assert_array_equal(got_emit, want_emit)
+
+
+@pytest.mark.parametrize("kw,ro", [
+    (dict(ds_function="avg", agg_name="sum", rate=True), None),
+    (dict(ds_function="sum", agg_name="avg"), None),
+    (dict(ds_function="last", agg_name="sum", rate=True,
+          rate_counter=True),
+     RateOptions(counter=True, counter_max=2**32, reset_value=7.0)),
+])
+def test_split_precision_path(kw, ro):
+    """The TPU 3-term bf16 split (split=True) is OFF in interpreter
+    mode; force it on so the split dots themselves are covered by the
+    CPU matrix. The split carries all 24 f32 mantissa bits, so results
+    must agree with the unsplit run to ~f32 rounding."""
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops import pallas_fused as pf
+    rng = np.random.default_rng(5)
+    s, b, k, g = 300, 8, 4, 5
+    p = b * k
+    vals = np.cumsum(rng.uniform(1, 40, size=(s, p)), axis=1) \
+        .astype(np.float32) if kw.get("rate_counter") else \
+        rng.normal(100.0, 15.0, size=(s, p)).astype(np.float32)
+    ts = np.arange(b, dtype=np.int64) * 60_000 + 1_356_998_400_000
+    gids = (np.arange(s) % g).astype(np.int32)
+    spec = PipelineSpec(num_series=s, num_buckets=b, num_groups=g, **kw)
+    cm = float(ro.counter_max) if ro else float(2**64 - 1)
+    rv = float(ro.reset_value) if ro else 0.0
+    outs = {}
+    for force in (False, True):
+        args, tile_s, interp = pf.prepare(vals, ts, gids, spec, k,
+                                          dtype=jnp.float32,
+                                          force_split=force)
+        rp = jnp.asarray([[cm, rv]], jnp.float32)
+        res, _ = pf._run(*args, spec, tile_s, interp, rate_params=rp,
+                         force_split=force)
+        outs[force] = np.asarray(res)
+    np.testing.assert_allclose(outs[True], outs[False], rtol=2e-5,
+                               equal_nan=True)
 
 
 def test_pallas_odd_sizes_padding():
