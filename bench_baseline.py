@@ -1,0 +1,70 @@
+"""Measure the single-TSD reference-architecture baseline.
+
+The image ships no JVM, so OpenTSDB's actual Java iterator chain cannot
+run here. Instead ``opentsdb_tpu/native/baseline_ref.cc`` replicates
+its query hot loop faithfully — per-datapoint pull through virtual
+SeekableView chains (RowSeq -> Downsampler -> RateSpan) merged k-way by
+an AggregationIterator with LERP, single-threaded per query (SURVEY.md
+§3.3) — in C++. An -O2 C++ build of the same per-point virtual-dispatch
+architecture is an upper bound on the JIT'd Java original, so the
+resulting ``vs_baseline`` figures are conservative (generous to the
+reference).
+
+Writes BASELINE_MEASURED.json; bench.py picks the headline-shape value
+up from there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "opentsdb_tpu", "native", "baseline_ref.cc")
+OUT = os.path.join(HERE, "BASELINE_MEASURED.json")
+
+# (name, S, P, B, G, rate, reps) — BASELINE.json config shapes
+SHAPES = [
+    ("config1_1k_series_1h_at_10s_1m_avg", 1000, 360, 60, 1, 0, 5),
+    ("config2_100k_series_groupby", 100_000, 60, 12, 1000, 0, 3),
+    ("config3_1M_series_rate_5m_avg_groupby", 1_000_000, 60, 12, 100,
+     1, 3),
+]
+HEADLINE = "config3_1M_series_rate_5m_avg_groupby"
+
+
+def main() -> None:
+    exe = os.path.join("/tmp", "baseline_ref")
+    subprocess.run(["g++", "-O2", "-o", exe, SRC], check=True)
+    results = {}
+    for name, s, p, b, g, rate, reps in SHAPES:
+        proc = subprocess.run(
+            [exe, str(s), str(p), str(b), str(g), str(rate),
+             str(reps)],
+            check=True, capture_output=True, text=True)
+        r = json.loads(proc.stdout)
+        results[name] = r
+        print(f"{name}: {r['dps'] / 1e6:.1f} M dp/s "
+              f"({r['seconds'] * 1e3:.1f} ms)", file=sys.stderr)
+    doc = {
+        "methodology": (
+            "C++ -O2 replica of the reference's per-datapoint virtual "
+            "iterator chain (RowSeq -> Downsampler -> RateSpan -> "
+            "AggregationIterator k-way LERP merge), single-threaded "
+            "per query like the reference; no JVM exists in this "
+            "image, and C++ >= JIT'd Java for this architecture, so "
+            "these numbers are an upper bound on the Java baseline."),
+        "source": "opentsdb_tpu/native/baseline_ref.cc",
+        "headline": HEADLINE,
+        "java_baseline_dps": results[HEADLINE]["dps"],
+        "results": results,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"java_baseline_dps": results[HEADLINE]["dps"]}))
+
+
+if __name__ == "__main__":
+    main()

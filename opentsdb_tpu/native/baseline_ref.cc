@@ -1,0 +1,317 @@
+// Reference-architecture baseline: a faithful C++ replica of
+// OpenTSDB's per-datapoint query hot loop, used to MEASURE the
+// "single-TSD iterator chain" baseline that bench.py compares against
+// (BASELINE.md; the image ships no JVM, so the Java path cannot run —
+// a C++ replica with the same per-point virtual-dispatch architecture
+// is an upper bound on the Java chain's throughput, i.e. GENEROUS to
+// the reference).
+//
+// Architecture mirrored (semantics only, written from the documented
+// behavior — see SURVEY.md §3.3):
+//   per series: RowSeq iterator -> Downsampler (window aggregate per
+//   time bucket, ref src/core/Downsampler.java:28) -> optional
+//   RateSpan (dv/dt between successive points, ref RateSpan.java:21)
+//   per group: AggregationIterator k-way timestamp-ordered merge with
+//   linear interpolation at unaligned timestamps feeding
+//   Aggregator.runDouble through a values-iterator virtual interface
+//   (ref AggregationIterator.java:27-119, Aggregators.java:95-102).
+// Everything is pull-based per datapoint through virtual calls, and
+// single-threaded per query, exactly like the reference.
+//
+// Build: g++ -O2 -o baseline_ref baseline_ref.cc   (bench_baseline.py)
+// Usage: baseline_ref S P B G rate reps
+//   S series, P points/series, B downsample buckets, G groups,
+//   rate 0/1, reps repetitions; prints seconds-per-run minimum.
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <memory>
+#include <random>
+#include <vector>
+
+struct DataPoint {
+  int64_t ts;  // ms
+  double val;
+};
+
+// ref: src/core/SeekableView.java:37 — the per-datapoint pull ABI
+struct SeekableView {
+  virtual bool hasNext() = 0;
+  virtual DataPoint next() = 0;
+  virtual ~SeekableView() = default;
+};
+
+// ref: src/core/RowSeq.java:527 — iterate one series' stored points
+struct RowSeqView : SeekableView {
+  const int64_t* ts;
+  const double* vals;
+  int n;
+  int i = 0;
+  RowSeqView(const int64_t* t, const double* v, int n_)
+      : ts(t), vals(v), n(n_) {}
+  bool hasNext() override { return i < n; }
+  DataPoint next() override {
+    DataPoint dp{ts[i], vals[i]};
+    ++i;
+    return dp;
+  }
+};
+
+// ref: src/core/Downsampler.java:28 + ValuesInInterval :295 — average
+// of each fixed interval window, emitted at the window start
+struct DownsamplerView : SeekableView {
+  SeekableView* src;
+  int64_t interval_ms;
+  DataPoint pending{0, 0};
+  bool has_pending = false;
+  bool done = false;
+  DownsamplerView(SeekableView* s, int64_t iv)
+      : src(s), interval_ms(iv) {}
+  bool hasNext() override { return has_pending || !done || prime(); }
+  // fill one window starting at ``seed``; sets pending and
+  // carry/done for the point that overran the window
+  void fill(DataPoint seed) {
+    int64_t b = seed.ts - (seed.ts % interval_ms);
+    double sum = seed.val;
+    int cnt = 1;
+    has_carry = false;
+    while (src->hasNext()) {
+      DataPoint nx = src->next();
+      int64_t nb = nx.ts - (nx.ts % interval_ms);
+      if (nb != b) {
+        carry = nx;
+        has_carry = true;
+        break;
+      }
+      sum += nx.val;
+      ++cnt;
+    }
+    if (!has_carry) done = true;
+    pending = DataPoint{b, sum / cnt};
+    has_pending = true;
+  }
+  bool prime() {
+    if (done) return false;
+    if (!src->hasNext()) {
+      done = true;
+      return false;
+    }
+    fill(src->next());
+    return true;
+  }
+  DataPoint next() override {
+    if (!has_pending) prime();
+    has_pending = false;
+    DataPoint out = pending;
+    if (has_carry) fill(carry);
+    return out;
+  }
+
+ private:
+  DataPoint carry{0, 0};
+  bool has_carry = false;
+};
+
+// ref: src/core/RateSpan.java:21 — dv/dt between successive points
+struct RateSpanView : SeekableView {
+  SeekableView* src;
+  DataPoint prev{0, 0};
+  bool has_prev = false;
+  RateSpanView(SeekableView* s) : src(s) {}
+  bool hasNext() override {
+    if (!has_prev) {
+      if (!src->hasNext()) return false;
+      prev = src->next();
+      has_prev = true;
+    }
+    return src->hasNext();
+  }
+  DataPoint next() override {
+    DataPoint cur = src->next();
+    double dt = (cur.ts - prev.ts) / 1000.0;
+    if (dt <= 0) dt = 1.0;
+    DataPoint out{cur.ts, (cur.val - prev.val) / dt};
+    prev = cur;
+    return out;
+  }
+};
+
+// ref: src/core/Aggregator.java:73 — the values-iterator fed to
+// runDouble at each output timestamp
+struct Doubles {
+  virtual bool hasNextValue() = 0;
+  virtual double nextDoubleValue() = 0;
+  virtual ~Doubles() = default;
+};
+
+struct Aggregator {
+  virtual double runDouble(Doubles& d) = 0;
+  virtual ~Aggregator() = default;
+};
+
+struct SumAgg : Aggregator {
+  double runDouble(Doubles& d) override {
+    double acc = 0;
+    while (d.hasNextValue()) acc += d.nextDoubleValue();
+    return acc;
+  }
+};
+
+// ref: src/core/AggregationIterator.java:27-119 — k-way merge across a
+// group's spans with linear interpolation at unaligned timestamps.
+// Keeps per-iterator (current, next) pairs; each emitted timestamp
+// scans every member iterator through the Doubles virtual interface.
+struct AggregationIterator : Doubles {
+  std::vector<SeekableView*> its;
+  std::vector<DataPoint> cur, nxt;
+  std::vector<uint8_t> has_cur, has_nxt;
+  int64_t emit_ts = 0;
+  size_t scan_i = 0;
+  Aggregator* agg;
+
+  AggregationIterator(std::vector<SeekableView*> members, Aggregator* a)
+      : its(std::move(members)), agg(a) {
+    size_t k = its.size();
+    cur.resize(k);
+    nxt.resize(k);
+    has_cur.assign(k, 0);
+    has_nxt.assign(k, 0);
+    for (size_t j = 0; j < k; ++j)
+      if (its[j]->hasNext()) {
+        nxt[j] = its[j]->next();
+        has_nxt[j] = 1;
+      }
+  }
+
+  bool hasNextTimestamp(int64_t* out) {
+    int64_t best = std::numeric_limits<int64_t>::max();
+    bool any = false;
+    for (size_t j = 0; j < its.size(); ++j)
+      if (has_nxt[j] && nxt[j].ts < best) {
+        best = nxt[j].ts;
+        any = true;
+      }
+    if (any) *out = best;
+    return any;
+  }
+
+  void advanceTo(int64_t ts) {
+    for (size_t j = 0; j < its.size(); ++j)
+      if (has_nxt[j] && nxt[j].ts == ts) {
+        cur[j] = nxt[j];
+        has_cur[j] = 1;
+        if (its[j]->hasNext()) {
+          nxt[j] = its[j]->next();
+        } else {
+          has_nxt[j] = 0;
+        }
+      }
+    emit_ts = ts;
+    scan_i = 0;
+  }
+
+  // Doubles over the group members at emit_ts: exact value when the
+  // member has a point here, LERP between its neighbors otherwise
+  bool hasNextValue() override {
+    while (scan_i < its.size()) {
+      if (has_cur[scan_i]) return true;
+      ++scan_i;
+    }
+    return false;
+  }
+  double nextDoubleValue() override {
+    size_t j = scan_i++;
+    if (cur[j].ts == emit_ts) return cur[j].val;
+    if (has_nxt[j]) {  // lerp (ref AggregationIterator.java:99-113)
+      double span = double(nxt[j].ts - cur[j].ts);
+      double w = span > 0 ? double(emit_ts - cur[j].ts) / span : 0.0;
+      return cur[j].val + w * (nxt[j].val - cur[j].val);
+    }
+    return cur[j].val;
+  }
+
+  // run the merge to exhaustion; returns checksum + count of emitted
+  // group datapoints
+  std::pair<double, long> run() {
+    double checksum = 0;
+    long emitted = 0;
+    int64_t ts;
+    while (hasNextTimestamp(&ts)) {
+      advanceTo(ts);
+      checksum += agg->runDouble(*this);
+      ++emitted;
+    }
+    return {checksum, emitted};
+  }
+};
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    std::fprintf(stderr,
+                 "usage: baseline_ref S P B G rate reps\n");
+    return 2;
+  }
+  long S = atol(argv[1]);
+  long P = atol(argv[2]);
+  long B = atol(argv[3]);
+  long G = atol(argv[4]);
+  int rate = atoi(argv[5]);
+  int reps = atoi(argv[6]);
+
+  // regular-cadence synthetic data shaped like the bench workloads
+  std::vector<int64_t> ts(P);
+  int64_t span_ms = 3'600'000;
+  int64_t step = span_ms / P;
+  for (long i = 0; i < P; ++i) ts[i] = 1'356'998'400'000 + i * step;
+  int64_t interval = span_ms / B;
+  std::vector<double> vals((size_t)S * P);
+  std::mt19937_64 rng(0);
+  std::normal_distribution<double> nd(100.0, 15.0);
+  for (auto& v : vals) v = nd(rng);
+
+  double best = 1e100;
+  double checksum = 0;
+  long emitted = 0;
+  for (int r = 0; r < reps; ++r) {
+    auto t0 = std::chrono::steady_clock::now();
+    checksum = 0;
+    emitted = 0;
+    SumAgg agg;
+    // one SpanGroup per group, exactly like GroupByAndAggregateCB
+    for (long g = 0; g < G; ++g) {
+      std::vector<std::unique_ptr<SeekableView>> owned;
+      std::vector<SeekableView*> members;
+      for (long s = g; s < S; s += G) {
+        auto row = std::make_unique<RowSeqView>(
+            ts.data(), &vals[(size_t)s * P], (int)P);
+        SeekableView* tip = row.get();
+        owned.push_back(std::move(row));
+        auto dsv = std::make_unique<DownsamplerView>(tip, interval);
+        tip = dsv.get();
+        owned.push_back(std::move(dsv));
+        if (rate) {
+          auto rv = std::make_unique<RateSpanView>(tip);
+          tip = rv.get();
+          owned.push_back(std::move(rv));
+        }
+        members.push_back(tip);
+      }
+      AggregationIterator merge(std::move(members), &agg);
+      auto res = merge.run();
+      checksum += res.first;
+      emitted += res.second;
+    }
+    auto t1 = std::chrono::steady_clock::now();
+    double dt = std::chrono::duration<double>(t1 - t0).count();
+    if (dt < best) best = dt;
+  }
+  std::printf("{\"seconds\": %.6f, \"datapoints\": %ld, "
+              "\"dps\": %.0f, \"emitted\": %ld, \"checksum\": %.3f}\n",
+              best, S * P, (double)(S * P) / best, emitted, checksum);
+  return 0;
+}
