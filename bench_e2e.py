@@ -257,6 +257,45 @@ def bench_config5(repeats: int, n_series: int = 100_000,
             "job_raw_mpps": round(n_raw / job_s / 1e6, 1)}
 
 
+def bench_wal(repeats: int, n_series: int = 500,
+              pts_per: int = 4000) -> dict:
+    """Ingest throughput with the write-ahead log off / on. 'on'
+    fsyncs per write call (group commit), the acked-means-durable
+    default; 'on_nosync' appends but never fsyncs (the OS flushes) —
+    the reference's setDurable(false) class of durability."""
+    import shutil
+    import tempfile
+    from opentsdb_tpu import TSDB, Config
+    ts = np.arange(BASE_S, BASE_S + pts_per, dtype=np.int64)
+    rng = np.random.default_rng(7)
+    vals = rng.normal(100, 10, (n_series, pts_per))
+    out = {"config": "wal", "series": n_series,
+           "points": n_series * pts_per}
+    for label, cfg in (
+            ("off", {"tsd.storage.wal.enable": "false"}),
+            ("on", {"tsd.storage.wal.fsync": "always"}),
+            ("on_nosync", {"tsd.storage.wal.fsync": "never"})):
+        best = float("inf")
+        for _ in range(max(1, repeats // 2)):
+            d = tempfile.mkdtemp(prefix="walbench-")
+            try:
+                tsdb = TSDB(Config(**{
+                    "tsd.core.auto_create_metrics": "true",
+                    "tsd.storage.data_dir": d, **cfg}))
+                t0 = time.perf_counter()
+                for i in range(n_series):
+                    tsdb.add_points("sys.walbench", ts, vals[i],
+                                    {"host": f"h{i:04d}"})
+                best = min(best, time.perf_counter() - t0)
+                if tsdb.wal is not None:
+                    tsdb.wal.close()
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+        out[f"ingest_mpps_{label}"] = round(
+            n_series * pts_per / best / 1e6, 2)
+    return out
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -278,9 +317,11 @@ def main() -> None:
 
     runners = {1: bench_config1, 2: bench_config2,
                3: lambda r: bench_config3(r, args.series3),
-               4: bench_config4, 5: bench_config5}
+               4: bench_config4, 5: bench_config5,
+               "wal": bench_wal}
     out = []
-    for c in (int(x) for x in args.configs.split(",")):
+    for c in ((int(x) if x.isdigit() else x)
+              for x in args.configs.split(",")):
         t0 = time.perf_counter()
         res = runners[c](args.repeats)
         res["total_s"] = round(time.perf_counter() - t0, 1)

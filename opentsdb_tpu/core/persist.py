@@ -26,7 +26,13 @@ import numpy as np
 _FORMAT_VERSION = 1
 
 
-def save_store(tsdb, data_dir: str) -> None:
+def save_store(tsdb, data_dir: str) -> int:
+    """Write a full snapshot. Returns the WAL sequence the snapshot
+    covers (captured BEFORE content capture, so a concurrent write can
+    only be double-covered — replay duplicates are dedupe-tolerant —
+    never lost)."""
+    wal = getattr(tsdb, "wal", None)
+    wal_seq = wal.last_seq() if wal is not None else 0
     os.makedirs(data_dir, exist_ok=True)
     _save_uids(tsdb.uids, data_dir)
     _save_timeseries(tsdb.store, os.path.join(data_dir, "data"))
@@ -41,9 +47,11 @@ def save_store(tsdb, data_dir: str) -> None:
     _save_meta(tsdb, data_dir)
     _save_trees(tsdb, data_dir)
     meta = {"format": _FORMAT_VERSION,
-            "points_written": tsdb.store.points_written}
+            "points_written": tsdb.store.points_written,
+            "wal_applied_seq": wal_seq}
     _atomic_write(os.path.join(data_dir, "META.json"),
                   json.dumps(meta).encode())
+    return wal_seq
 
 
 def load_store(tsdb, data_dir: str) -> bool:
@@ -54,6 +62,7 @@ def load_store(tsdb, data_dir: str) -> bool:
         meta = json.load(fh)
     if meta.get("format") != _FORMAT_VERSION:
         raise ValueError(f"unsupported snapshot format {meta.get('format')}")
+    tsdb._wal_applied_seq = int(meta.get("wal_applied_seq", 0))
     _load_uids(tsdb.uids, data_dir)
     _load_timeseries(tsdb.store, os.path.join(data_dir, "data"))
     if tsdb.rollup_store is not None:

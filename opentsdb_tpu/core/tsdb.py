@@ -10,6 +10,8 @@ rw/ro/wo (:103).
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Sequence
@@ -56,7 +58,6 @@ class TSDB:
                 if jax.extend.backend.backends():
                     jax.extend.backend.clear_backends()
             except Exception:  # noqa: BLE001
-                import logging
                 logging.getLogger(__name__).warning(
                     "could not reset JAX backends; tsd.tpu.platform=%s "
                     "may not take effect", platform)
@@ -138,11 +139,43 @@ class TSDB:
         self.datapoints_added = 0
         self.start_time = time.time()
         # durable snapshots (ref-analogue of HBase-backed persistence;
-        # SURVEY.md §5.4): load on start, save on flush/shutdown
+        # SURVEY.md §5.4): load on start, save on flush/shutdown.
+        # The WAL on top makes every ACKNOWLEDGED write crash-durable,
+        # like HBase's WAL does for the reference (IncomingDataPoints
+        # .java:355-360); snapshot + replay-since-snapshot on startup.
         self.data_dir = self.config.get_string("tsd.storage.data_dir", "")
+        self.wal = None
+        self._wal_applied_seq = 0
         if self.data_dir:
             from opentsdb_tpu.core import persist
             persist.load_store(self, self.data_dir)
+            if self.config.get_bool("tsd.storage.wal.enable", True):
+                from opentsdb_tpu.core.wal import WriteAheadLog
+                wal = WriteAheadLog(
+                    os.path.join(self.data_dir, "wal"),
+                    fsync_mode=self.config.get_string(
+                        "tsd.storage.wal.fsync", "always"),
+                    segment_bytes=self.config.get_int(
+                        "tsd.storage.wal.segment_mb", 64) << 20,
+                    interval_ms=self.config.get_int(
+                        "tsd.storage.wal.fsync_interval_ms", 200))
+                # snapshot-covered sids keep their numbering on load
+                # (histograms WAL by name, not sid — nothing to seed)
+                wal.seed_known("data", self.store.num_series())
+                if self.rollup_store is not None:
+                    wal.seed_known(
+                        "preagg",
+                        self.rollup_store.preagg_store().num_series())
+                    for (iv, agg), st in \
+                            self.rollup_store._tiers.items():
+                        wal.seed_known(f"tier:{iv}:{agg}",
+                                       st.num_series())
+                recovered = wal.replay(self, self._wal_applied_seq)
+                if recovered:
+                    logging.getLogger("tsdb").info(
+                        "WAL replay recovered %d points", recovered)
+                self.wal = wal
+                self.annotations.wal = wal
 
     # ------------------------------------------------------------------
     # plugins (ref: TSDB.java initializePlugins :390)
@@ -184,8 +217,9 @@ class TSDB:
     # ------------------------------------------------------------------
 
     def add_point(self, metric: str, timestamp: int, value: int | float,
-                  tags: dict[str, str]) -> int:
-        """Write one datapoint; returns the series id.
+                  tags: dict[str, str], durable: bool = True) -> int:
+        """Write one datapoint; returns the series id. ``durable=False``
+        skips write-ahead logging (setDurable(false) parity).
 
         (ref: TSDB.addPoint :1012/:1057/:1097 -> addPointInternal :1150)
         """
@@ -203,6 +237,10 @@ class TSDB:
         sid = self.store.get_or_create_series(metric_id, tag_ids)
         ts_ms = codec.to_ms(timestamp)
         self.store.append(sid, ts_ms, fval, is_int)
+        if self.wal is not None and durable:
+            self.wal.ensure_series("data", sid, metric, tags)
+            self.wal.log_point("data", sid, ts_ms, fval, is_int)
+            self.wal.sync()
         self.datapoints_added += 1
         tsuid = (self.uids.tsuid(metric_id, tag_ids)
                  if self.meta_cache is not None
@@ -315,8 +353,12 @@ class TSDB:
         metric_id, tag_ids = self._resolve_write_uids(metric, tags)
         sid = self.store.get_or_create_series(metric_id, tag_ids)
         ts_ms = np.where(is_ms, ts, ts * 1000)
-        self.store.append_many(sid, ts_ms, vals.astype(np.float64),
-                               flags)
+        fvals = vals.astype(np.float64)
+        self.store.append_many(sid, ts_ms, fvals, flags)
+        if self.wal is not None:
+            self.wal.ensure_series("data", sid, metric, tags)
+            self.wal.log_points("data", sid, ts_ms, fvals, flags)
+            self.wal.sync()
         self.datapoints_added += len(ts)
         if self.meta is not None:
             self.meta.on_datapoint(metric_id, tag_ids, sid,
@@ -381,8 +423,8 @@ class TSDB:
                         fail(idx, metric, t, e)
         return written, errors
 
-    def import_buffer(self, buf: bytes, on_error=None
-                      ) -> tuple[int, list[str]]:
+    def import_buffer(self, buf: bytes, on_error=None,
+                      durable: bool = True) -> tuple[int, list[str]]:
         """Columnar bulk import of the reference's text line format
         (``metric ts value tagk=tagv ...``; ref: TextImporter.java:40).
 
@@ -465,7 +507,8 @@ class TSDB:
                         parsed.is_int[members].tolist()):
                     try:
                         self.add_point(metric, t,
-                                       int(v) if f else v, tags)
+                                       int(v) if f else v, tags,
+                                       durable=durable)
                         written += 1
                     except Exception as e:  # noqa: BLE001
                         fail(i + 1, str(e))
@@ -480,6 +523,18 @@ class TSDB:
                          parsed.ts * 1000)
         written = self.store.append_lines(line_sids, ts_ms,
                                           parsed.values, parsed.is_int)
+        if self.wal is not None and durable:
+            # durable=False ≙ the reference's batch-import WAL opt-out
+            # (PutRequest.setDurable(false), IncomingDataPoints:355-360)
+            for g in range(parsed.num_groups):
+                info = ginfo[g]
+                if isinstance(info, Exception):
+                    continue
+                self.wal.ensure_series("data", int(gsid[g]), info[0],
+                                       info[1])
+            self.wal.log_lines("data", line_sids, ts_ms,
+                               parsed.values, parsed.is_int)
+            self.wal.sync()
         self.datapoints_added += written
         if self.meta is not None and written:
             counts = np.bincount(gids[gids >= 0],
@@ -516,18 +571,25 @@ class TSDB:
         ts_ms = codec.to_ms(timestamp)
         if interval is None:
             # pure pre-agg point: store in the pre-agg ("groupby") table
-            self.rollup_store.add_preagg_point(
-                metric_id, tag_ids, ts_ms, float(value))
+            kind = "preagg"
+            store_obj = self.rollup_store.preagg_store()
         else:
             if rollup_agg is None:
                 raise ValueError("missing rollup aggregator")
-            self.rollup_store.add_point(
-                interval, rollup_agg.lower(), metric_id, tag_ids, ts_ms,
-                float(value))
+            kind = f"tier:{interval}:{rollup_agg.lower()}"
+            store_obj = self.rollup_store.tier(interval,
+                                               rollup_agg.lower())
+        sid = store_obj.get_or_create_series(metric_id, tag_ids)
+        store_obj.append(sid, ts_ms, float(value))
+        if self.wal is not None:
+            self.wal.ensure_series(kind, sid, metric, tags)
+            self.wal.log_point(kind, sid, ts_ms, float(value), False)
+            self.wal.sync()
         self.datapoints_added += 1
 
     def add_histogram_point(self, metric: str, timestamp: int,
-                            raw_blob: bytes, tags: dict[str, str]) -> int:
+                            raw_blob: bytes, tags: dict[str, str],
+                            _wal: bool = True) -> int:
         """Write an encoded histogram datapoint (ref: TSDB.java:1132)."""
         tags_mod.check_metric_and_tags(metric, tags)
         self._check_timestamp(timestamp)
@@ -539,6 +601,9 @@ class TSDB:
             lst = self._histogram_series.setdefault(sid, [])
             lst.append((ts_ms, hist))
             self._histogram_version += 1
+        if _wal and self.wal is not None:
+            self.wal.log_histogram(metric, tags, timestamp, raw_blob)
+            self.wal.sync()
         self.datapoints_added += 1
         return sid
 
@@ -606,7 +671,11 @@ class TSDB:
 
     def assign_uid(self, kind: str, name: str) -> int:
         tags_mod.validate_string(f"{kind} name", name)
-        return self.uids.by_kind(kind).assign_id(name)
+        uid = self.uids.by_kind(kind).assign_id(name)
+        if self.wal is not None:
+            self.wal.log_uid(kind, name)
+            self.wal.sync()
+        return uid
 
     # ------------------------------------------------------------------
     # lifecycle (ref: TSDB.java flush :1603, shutdown :1632)
@@ -615,10 +684,15 @@ class TSDB:
     def flush(self) -> None:
         if self.data_dir:
             from opentsdb_tpu.core import persist
-            persist.save_store(self, self.data_dir)
+            wal_seq = persist.save_store(self, self.data_dir)
+            if self.wal is not None:
+                # snapshot covers seq <= wal_seq: those segments are done
+                self.wal.truncate(wal_seq)
 
     def shutdown(self) -> None:
         self.flush()
+        if self.wal is not None:
+            self.wal.close()
         if self.rt_publisher is not None:
             self.rt_publisher.shutdown()
         if self.search_plugin is not None:

@@ -1,0 +1,430 @@
+"""Write-ahead log: acknowledged writes survive a crash.
+
+The reference delegates ingest durability to HBase's WAL — every
+acknowledged ``put`` is in the RegionServer's log before the Deferred
+completes, and batch imports may opt out per-request
+(``PutRequest.setDurable(false)``, ref IncomingDataPoints.java:355-360).
+Snapshots (:mod:`opentsdb_tpu.core.persist`) alone lose everything
+acknowledged since the last ``flush``; this module closes that gap:
+
+- append-only segment files under ``<data_dir>/wal/``, records framed
+  ``[type u8 | len u32 | seq u64 | crc32 u32 | payload]``; a torn tail
+  (crash mid-write) fails the CRC and replay stops there — exactly the
+  acknowledged prefix survives.
+- **group-commit fsync**: writers block on one shared fsync; whoever
+  holds the sync lock covers everyone whose bytes are already buffered
+  (``tsd.storage.wal.fsync`` = ``always`` | ``interval`` | ``never``;
+  ``never`` ≙ the reference's ``setDurable(false)``).
+- hot point records are columnar binary (one record per store append —
+  the same batch shape the native store takes); series/UID identity
+  records carry *names* so replay is self-contained: it re-resolves
+  through ``get_or_create`` and remaps sids, immune to sid-numbering
+  drift between runs.
+- ``truncate()`` after a successful snapshot deletes fully-covered
+  segments; the snapshot's ``wal_applied_seq`` (persist.META.json)
+  makes replay skip anything the snapshot already contains. Replaying
+  a record twice is harmless by construction: point stores dedupe
+  (ts, value) last-write-wins on materialize, ``get_or_create`` is
+  idempotent, annotation store is keyed.
+
+Single-writer by design (like the snapshot store): the TSD daemon owns
+the WAL; CLI tools against a *live* daemon's data_dir are not
+coordinated (the reference relies on HBase for that).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+log = logging.getLogger("wal")
+
+_HDR = struct.Struct("<BIQI")  # type, payload_len, seq, crc32
+MAGIC = b"OTSDBWAL1\n"
+
+T_SERIES = 1      # json {"k": kind, "sid": int, "m": name, "t": [[k,v]..]}
+T_POINTS = 2      # bin: kind | sid i64 | n i32 | ts i64[n] f64[n] u8[n]
+T_LINES = 3       # bin: kind | n i32 | sids i64[n] ts i64[n] f64[n] u8[n]
+T_UID = 4         # json {"kind", "name"}
+T_ANNOT = 5       # json annotation doc (+"tsuid")
+T_ANNOT_DEL = 6   # json {"tsuid", "start"}
+T_HIST = 7        # json {"m", "t", "ts"} \n blob bytes
+
+_KIND = struct.Struct("<B")     # kind string length prefix
+_SID_N = struct.Struct("<qi")   # sid, count
+_N = struct.Struct("<i")        # count
+
+
+def _pack_kind(kind: str) -> bytes:
+    kb = kind.encode()
+    return _KIND.pack(len(kb)) + kb
+
+
+def _unpack_kind(buf: bytes, off: int) -> tuple[str, int]:
+    (n,) = _KIND.unpack_from(buf, off)
+    off += _KIND.size
+    return buf[off:off + n].decode(), off + n
+
+
+def _pack_cols(ts, vals, flags) -> bytes:
+    return (np.ascontiguousarray(ts, dtype=np.int64).tobytes()
+            + np.ascontiguousarray(vals, dtype=np.float64).tobytes()
+            + np.ascontiguousarray(flags, dtype=np.uint8).tobytes())
+
+
+def _unpack_cols(buf: bytes, off: int, n: int):
+    ts = np.frombuffer(buf, np.int64, n, off)
+    off += 8 * n
+    vals = np.frombuffer(buf, np.float64, n, off)
+    off += 8 * n
+    flags = np.frombuffer(buf, np.uint8, n, off)
+    return ts, vals, flags
+
+
+class WriteAheadLog:
+    def __init__(self, wal_dir: str, fsync_mode: str = "always",
+                 segment_bytes: int = 64 << 20,
+                 interval_ms: int = 200):
+        if fsync_mode not in ("always", "interval", "never"):
+            raise ValueError(f"bad wal fsync mode {fsync_mode!r}")
+        self.dir = wal_dir
+        self.fsync_mode = fsync_mode
+        self.segment_bytes = segment_bytes
+        os.makedirs(wal_dir, exist_ok=True)
+        self._lock = threading.Lock()       # append framing + seq
+        self._sync_lock = threading.Lock()  # one fsync at a time
+        self._fh = None
+        self._seq = 0
+        self._written = 0   # bytes appended to current segment
+        self._synced_seq = 0
+        self._known: set[tuple[str, int]] = set()
+        self._closed = False
+        self._interval_thread = None
+        if fsync_mode == "interval":
+            self._interval_s = interval_ms / 1000.0
+            t = threading.Thread(target=self._interval_loop,
+                                 name="wal-fsync", daemon=True)
+            self._interval_thread = t
+            t.start()
+
+    # ---------------- segments ----------------
+
+    def _segments(self) -> list[str]:
+        names = [n for n in os.listdir(self.dir)
+                 if n.startswith("wal-") and n.endswith(".log")]
+        # wal-<firstseq 20 digits>-<pid>.log sorts by first seq
+        return [os.path.join(self.dir, n) for n in sorted(names)]
+
+    def _open_segment(self) -> None:
+        name = f"wal-{self._seq + 1:020d}-{os.getpid()}.log"
+        path = os.path.join(self.dir, name)
+        self._fh = open(path, "ab", buffering=0)
+        if self._fh.tell() == 0:
+            self._fh.write(MAGIC)
+        self._written = self._fh.tell()
+
+    # ---------------- append side ----------------
+
+    def _append(self, rtype: int, payload: bytes) -> int:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("WAL is closed")
+            if self._fh is None or self._written >= self.segment_bytes:
+                if self._fh is not None:
+                    # rotation must not lose durability: sync() after
+                    # this append only fsyncs the NEW segment, so the
+                    # old one's unsynced tail must hit disk now
+                    os.fsync(self._fh.fileno())
+                    self._fh.close()
+                self._open_segment()
+            self._seq += 1
+            rec = _HDR.pack(rtype, len(payload), self._seq,
+                            zlib.crc32(payload)) + payload
+            self._fh.write(rec)
+            self._written += len(rec)
+            return self._seq
+
+    def _append_json(self, rtype: int, doc: dict) -> int:
+        return self._append(rtype, json.dumps(doc).encode())
+
+    def ensure_series(self, kind: str, sid: int, metric: str,
+                      tags: dict[str, str]) -> None:
+        """Log the (kind, sid) -> name mapping once per WAL lifetime so
+        point records can reference bare sids."""
+        key = (kind, sid)
+        if key in self._known:
+            return
+        self._append_json(T_SERIES, {
+            "k": kind, "sid": sid, "m": metric,
+            "t": sorted(tags.items())})
+        self._known.add(key)
+
+    def seed_known(self, kind: str, num_series: int) -> None:
+        """Mark sids already covered by the loaded snapshot (their
+        numbering is reproduced by snapshot load order)."""
+        self._known.update((kind, s) for s in range(num_series))
+
+    def log_points(self, kind: str, sid: int, ts_ms, vals, flags
+                   ) -> None:
+        n = len(ts_ms)
+        self._append(T_POINTS, _pack_kind(kind) + _SID_N.pack(sid, n)
+                     + _pack_cols(ts_ms, vals, flags))
+
+    def log_point(self, kind: str, sid: int, ts_ms: int, value: float,
+                  is_int: bool) -> None:
+        self._append(T_POINTS, _pack_kind(kind) + _SID_N.pack(sid, 1)
+                     + struct.pack("<qdB", ts_ms, value, is_int))
+
+    def log_lines(self, kind: str, sids, ts_ms, vals, flags) -> None:
+        n = len(sids)
+        self._append(T_LINES, _pack_kind(kind) + _N.pack(n)
+                     + np.ascontiguousarray(sids, np.int64).tobytes()
+                     + _pack_cols(ts_ms, vals, flags))
+
+    def log_uid(self, kind: str, name: str) -> None:
+        self._append_json(T_UID, {"kind": kind, "name": name})
+
+    def log_annotation(self, doc: dict) -> None:
+        self._append_json(T_ANNOT, doc)
+
+    def log_annotation_delete(self, tsuid: str, start: int) -> None:
+        self._append_json(T_ANNOT_DEL, {"tsuid": tsuid, "start": start})
+
+    def log_histogram(self, metric: str, tags: dict[str, str],
+                      ts_ms: int, blob: bytes) -> None:
+        head = json.dumps({"m": metric, "t": sorted(tags.items()),
+                           "ts": ts_ms}).encode()
+        self._append(T_HIST, head + b"\n" + blob)
+
+    def sync(self) -> None:
+        """Block until everything appended so far is on disk (group
+        commit: one fsync covers every waiter)."""
+        if self.fsync_mode != "always":
+            return
+        self._sync()
+
+    def _sync(self) -> None:
+        if self._synced_seq >= self.last_seq():
+            return
+        with self._sync_lock:
+            with self._lock:
+                target = self._seq
+                fh = self._fh
+            if fh is None or self._synced_seq >= target:
+                # fh None => a concurrent truncate fsync'd + closed the
+                # segment, so everything appended before it is durable
+                self._synced_seq = max(self._synced_seq, target)
+                return
+            try:
+                os.fsync(fh.fileno())
+            except ValueError:
+                # segment closed mid-sync by truncate — which fsyncs
+                # before closing, so target is already durable
+                pass
+            self._synced_seq = target
+
+    def _interval_loop(self) -> None:
+        import time
+        while not self._closed:
+            time.sleep(self._interval_s)
+            try:
+                self._sync()
+            except (OSError, ValueError):  # pragma: no cover
+                if self._closed:
+                    return
+                log.exception("wal interval fsync failed")
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def truncate(self, upto_seq: int) -> None:
+        """Drop segments fully covered by a snapshot that recorded
+        ``wal_applied_seq = upto_seq``. The current segment is rotated
+        so it can be dropped by the next truncate."""
+        with self._lock:
+            if self._fh is not None:
+                # records > upto_seq may live in this segment and must
+                # stay durable across the close (see _sync)
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None  # reopened on next append
+                self._synced_seq = self._seq
+            for path in self._segments():
+                last = _segment_last_seq(path)
+                if last is not None and last <= upto_seq:
+                    os.unlink(path)
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:  # pragma: no cover
+                    pass
+                self._fh.close()
+                self._fh = None
+
+    # ---------------- replay side ----------------
+
+    def replay(self, tsdb, applied_seq: int) -> int:
+        """Apply records with seq > applied_seq. Returns points
+        recovered. Resumes ``self._seq`` past everything seen so new
+        appends never reuse sequence numbers."""
+        recovered = 0
+        sid_maps: dict[str, dict[int, int]] = {}
+        max_seq = applied_seq
+        for path in self._segments():
+            for rtype, seq, payload in _read_segment(path):
+                if seq > max_seq:
+                    max_seq = seq
+                if seq <= applied_seq:
+                    continue
+                try:
+                    recovered += self._apply(tsdb, rtype, payload,
+                                             sid_maps)
+                except Exception:  # noqa: BLE001  pragma: no cover
+                    log.exception("wal: failed applying record "
+                                  "seq=%d type=%d", seq, rtype)
+        with self._lock:
+            self._seq = max(self._seq, max_seq)
+            self._synced_seq = self._seq
+        return recovered
+
+    def _store_for(self, tsdb, kind: str):
+        if kind == "data":
+            return tsdb.store
+        if kind == "preagg":
+            return tsdb.rollup_store.preagg_store()
+        if kind.startswith("tier:"):
+            _, interval, agg = kind.split(":", 2)
+            return tsdb.rollup_store.tier(interval, agg)
+        raise ValueError(f"unknown wal store kind {kind!r}")
+
+    def _map_sid(self, tsdb, kind: str, wal_sid: int,
+                 sid_maps: dict) -> int:
+        m = sid_maps.get(kind)
+        if m is not None and wal_sid in m:
+            return m[wal_sid]
+        # no T_SERIES record: the sid predates this WAL, so snapshot
+        # load already recreated it under the same number
+        return wal_sid
+
+    def _apply(self, tsdb, rtype: int, payload: bytes,
+               sid_maps: dict) -> int:
+        if rtype == T_SERIES:
+            doc = json.loads(payload)
+            kind = doc["k"]
+            tags = dict(doc["t"])
+            metric_id, tag_ids = tsdb._resolve_write_uids(
+                doc["m"], tags)
+            store = self._store_for(tsdb, kind)
+            real = store.get_or_create_series(metric_id, tag_ids)
+            sid_maps.setdefault(kind, {})[doc["sid"]] = real
+            if real == doc["sid"]:
+                # drifted sids stay un-known: a future series reusing
+                # the wal sid must get its own fresh T_SERIES record
+                self._known.add((kind, real))
+            return 0
+        if rtype == T_POINTS:
+            kind, off = _unpack_kind(payload, 0)
+            wal_sid, n = _SID_N.unpack_from(payload, off)
+            off += _SID_N.size
+            if n == 1:
+                ts, val, flag = struct.unpack_from("<qdB", payload, off)
+                ts_arr = np.asarray([ts], np.int64)
+                vals = np.asarray([val])
+                flags = np.asarray([flag], np.uint8)
+            else:
+                ts_arr, vals, flags = _unpack_cols(payload, off, n)
+            store = self._store_for(tsdb, kind)
+            sid = self._map_sid(tsdb, kind, wal_sid, sid_maps)
+            store.append_many(sid, ts_arr, vals,
+                              flags.astype(bool))
+            return n
+        if rtype == T_LINES:
+            kind, off = _unpack_kind(payload, 0)
+            (n,) = _N.unpack_from(payload, off)
+            off += _N.size
+            sids = np.frombuffer(payload, np.int64, n, off).copy()
+            off += 8 * n
+            ts_arr, vals, flags = _unpack_cols(payload, off, n)
+            m = sid_maps.get(kind)
+            if m:
+                # remap through a lookup into a FRESH array: sequential
+                # in-place substitution corrupts chained maps like
+                # {6:5, 5:6} (the second pass re-remaps converted rows)
+                keys = np.asarray(sorted(m.keys()), np.int64)
+                vals_lut = np.asarray([m[k] for k in keys], np.int64)
+                pos = np.searchsorted(keys, sids)
+                pos_ok = (pos < len(keys)) & \
+                    (keys[np.minimum(pos, len(keys) - 1)] == sids)
+                sids = np.where(pos_ok,
+                                vals_lut[np.minimum(pos,
+                                                    len(keys) - 1)],
+                                sids)
+            store = self._store_for(tsdb, kind)
+            return store.append_lines(sids, ts_arr, vals,
+                                      flags.astype(bool))
+        if rtype == T_UID:
+            doc = json.loads(payload)
+            tsdb.uids.by_kind(doc["kind"]).get_or_create_id(
+                doc["name"])
+            return 0
+        if rtype == T_ANNOT:
+            from opentsdb_tpu.meta.annotation import Annotation
+            tsdb.annotations.store(
+                Annotation.from_json(json.loads(payload)),
+                _wal=False)
+            return 0
+        if rtype == T_ANNOT_DEL:
+            doc = json.loads(payload)
+            tsdb.annotations.delete(doc["tsuid"], doc["start"],
+                                    _wal=False)
+            return 0
+        if rtype == T_HIST:
+            head, _, blob = payload.partition(b"\n")
+            doc = json.loads(head)
+            tsdb.add_histogram_point(
+                doc["m"], doc["ts"],
+                blob, dict(doc["t"]), _wal=False)
+            return 1
+        log.warning("wal: unknown record type %d skipped", rtype)
+        return 0
+
+
+def _read_segment(path: str):
+    """Yield (type, seq, payload) until EOF or the first corrupt/torn
+    record (normal after a crash — only the fsynced prefix counts)."""
+    try:
+        with open(path, "rb") as fh:
+            if fh.read(len(MAGIC)) != MAGIC:
+                log.warning("wal: %s has bad magic; skipped", path)
+                return
+            while True:
+                hdr = fh.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return
+                rtype, plen, seq, crc = _HDR.unpack(hdr)
+                payload = fh.read(plen)
+                if len(payload) < plen or zlib.crc32(payload) != crc:
+                    log.warning("wal: torn/corrupt record in %s at "
+                                "seq=%d; replay stops here", path, seq)
+                    return
+                yield rtype, seq, payload
+    except OSError:  # pragma: no cover
+        log.exception("wal: cannot read %s", path)
+
+
+def _segment_last_seq(path: str) -> int | None:
+    last = None
+    for _, seq, _ in _read_segment(path):
+        last = seq
+    return last

@@ -58,12 +58,18 @@ class AnnotationStore:
         self._lock = threading.Lock()
         # tsuid -> {start_time_sec: Annotation}
         self._by_tsuid: dict[str, dict[int, Annotation]] = {}
+        # set by TSDB when a write-ahead log is active; edits are
+        # crash-durable like the reference's HBase-backed annotations
+        self.wal = None
 
-    def store(self, note: Annotation) -> Annotation:
+    def store(self, note: Annotation, _wal: bool = True) -> Annotation:
         if not note.start_time:
             raise ValueError("missing or invalid start time")
         with self._lock:
             self._by_tsuid.setdefault(note.tsuid, {})[note.start_time] = note
+        if _wal and self.wal is not None:
+            self.wal.log_annotation(note.to_json() | {"tsuid": note.tsuid})
+            self.wal.sync()
         return note
 
     def has_any(self) -> bool:
@@ -77,10 +83,15 @@ class AnnotationStore:
         with self._lock:
             return self._by_tsuid.get(tsuid, {}).get(start_time)
 
-    def delete(self, tsuid: str, start_time: int) -> bool:
+    def delete(self, tsuid: str, start_time: int,
+               _wal: bool = True) -> bool:
         with self._lock:
             d = self._by_tsuid.get(tsuid, {})
-            return d.pop(start_time, None) is not None
+            removed = d.pop(start_time, None) is not None
+        if removed and _wal and self.wal is not None:
+            self.wal.log_annotation_delete(tsuid, start_time)
+            self.wal.sync()
+        return removed
 
     def delete_range(self, tsuids: list[str] | None, start_sec: int,
                      end_sec: int) -> int:
@@ -88,6 +99,7 @@ class AnnotationStore:
         means global annotations only, matching the reference's
         global-flag semantics."""
         count = 0
+        removed: list[tuple[str, int]] = []
         with self._lock:
             keys = tsuids if tsuids is not None else [GLOBAL_TSUID]
             for tsuid in keys:
@@ -97,7 +109,12 @@ class AnnotationStore:
                 doomed = [t for t in d if start_sec <= t <= end_sec]
                 for t in doomed:
                     del d[t]
+                    removed.append((tsuid, t))
                 count += len(doomed)
+        if removed and self.wal is not None:
+            for tsuid, t in removed:
+                self.wal.log_annotation_delete(tsuid, t)
+            self.wal.sync()
         return count
 
     def global_range(self, start_sec: int, end_sec: int) -> list[Annotation]:

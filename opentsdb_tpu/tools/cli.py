@@ -169,9 +169,17 @@ def cmd_import(config: Config, args: list[str]) -> int:
     (``TSDB.import_buffer``): one C++ pass parses each chunk, UID
     resolution runs once per distinct series, and points land via bulk
     appends — falling back to the per-line path if the native library
-    is unavailable."""
+    is unavailable.
+
+    ``--no-wal`` skips write-ahead logging for the bulk load (parity
+    with the reference batch import's ``setDurable(false)``,
+    IncomingDataPoints.java:355-360) — run ``flush``/let the daemon
+    snapshot afterwards."""
+    durable = "--no-wal" not in args
+    args = [a for a in args if a != "--no-wal"]
     if not args:
-        print("usage: tsdb import path [more paths]", file=sys.stderr)
+        print("usage: tsdb import [--no-wal] path [more paths]",
+              file=sys.stderr)
         return 2
     tsdb = make_tsdb(config)
     total = 0
@@ -224,7 +232,7 @@ def cmd_import(config: Config, args: list[str]) -> int:
                         buf, tail = block[:cut + 1], block[cut + 1:]
                     try:
                         written, _ = tsdb.import_buffer(
-                            buf, on_error=on_error)
+                            buf, on_error=on_error, durable=durable)
                     except _TooManyErrors:
                         print("too many errors, aborting",
                               file=sys.stderr)

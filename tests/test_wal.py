@@ -1,0 +1,244 @@
+"""Write-ahead log: acknowledged writes survive a crash.
+
+(Reference durability contract: HBase WAL; batch-import opt-out parity
+with PutRequest.setDurable(false), IncomingDataPoints.java:355-360.)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+
+BASE = 1356998400
+
+
+def _tsdb(tmp_path, **extra):
+    return TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.data_dir": str(tmp_path),
+        "tsd.rollups.enable": "true",
+        **extra}))
+
+
+def _query_sum(t, metric, start=BASE - 10, end=BASE + 100000):
+    from opentsdb_tpu.query.model import TSQuery
+    q = TSQuery.from_json({
+        "start": start, "end": end,
+        "queries": [{"aggregator": "sum", "metric": metric}]}).validate()
+    groups = t.execute_query(q)
+    out = {}
+    for g in groups:
+        for ts, v in g.dps:
+            out[int(ts) // 1000] = out.get(int(ts) // 1000, 0) + float(v)
+    return out
+
+
+class TestWalRecovery:
+    def test_unflushed_points_survive_restart(self, tmp_path):
+        t = _tsdb(tmp_path)
+        t.add_point("m", BASE, 5, {"h": "a"})
+        t.add_point("m", BASE + 10, 7, {"h": "a"})
+        t.add_points("m", np.asarray([BASE + 20, BASE + 30]),
+                     np.asarray([1.5, 2.5]), {"h": "b"})
+        # NO flush — simulate a crash by dropping the object
+        t2 = _tsdb(tmp_path)
+        vals = _query_sum(t2, "m")
+        assert vals == {BASE: 5.0, BASE + 10: 7.0,
+                        BASE + 20: 1.5, BASE + 30: 2.5}
+
+    def test_snapshot_plus_wal_tail(self, tmp_path):
+        t = _tsdb(tmp_path)
+        t.add_point("m", BASE, 1, {"h": "a"})
+        t.flush()  # snapshot covers this point; WAL truncated
+        t.add_point("m", BASE + 10, 2, {"h": "a"})   # wal only
+        t.add_point("m2", BASE, 9, {"h": "x"})       # new series in wal
+        t2 = _tsdb(tmp_path)
+        assert _query_sum(t2, "m") == {BASE: 1.0, BASE + 10: 2.0}
+        assert _query_sum(t2, "m2") == {BASE: 9.0}
+        # no double-replay after another snapshotless restart
+        t3 = _tsdb(tmp_path)
+        assert _query_sum(t3, "m") == {BASE: 1.0, BASE + 10: 2.0}
+
+    def test_truncate_removes_covered_segments(self, tmp_path):
+        t = _tsdb(tmp_path)
+        for i in range(10):
+            t.add_point("m", BASE + i, i, {"h": "a"})
+        wal_dir = os.path.join(str(tmp_path), "wal")
+        assert any(n.endswith(".log") for n in os.listdir(wal_dir))
+        t.flush()
+        # every record is snapshot-covered: all segments gone
+        assert not [n for n in os.listdir(wal_dir)
+                    if n.endswith(".log")]
+
+    def test_import_buffer_durable_and_opt_out(self, tmp_path):
+        t = _tsdb(tmp_path)
+        buf = (f"m {BASE} 1 h=a\nm {BASE + 1} 2 h=b\n").encode()
+        t.import_buffer(buf)
+        t2 = _tsdb(tmp_path)
+        assert _query_sum(t2, "m") == {BASE: 1.0, BASE + 1: 2.0}
+        # opt-out (setDurable(false) parity): not replayed
+        t3 = _tsdb(tmp_path / "nodur")
+        t3.import_buffer(buf, durable=False)
+        t4 = _tsdb(tmp_path / "nodur")
+        with pytest.raises(Exception):
+            _query_sum(t4, "m")
+
+    def test_rollup_and_histogram_and_annotation_replay(self, tmp_path):
+        t = _tsdb(tmp_path)
+        t.add_aggregate_point("m", BASE, 60.0, {"h": "a"}, False,
+                              "1m", "sum")
+        t.add_aggregate_point("m", BASE, 3.0, {"h": "a"}, True,
+                              None, None, groupby_agg="SUM")
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        h = SimpleHistogram([0.0, 10.0, 20.0])
+        h.counts = [4, 6]
+        blob = t.histogram_manager.encode(h)
+        t.add_histogram_point("hm", BASE, blob, {"h": "a"})
+        from opentsdb_tpu.meta.annotation import Annotation
+        t.annotations.store(Annotation(
+            tsuid="", start_time=BASE, description="deploy"))
+        t2 = _tsdb(tmp_path)
+        tier = t2.rollup_store.tier("1m", "sum")
+        assert tier.points_written == 1
+        assert t2.rollup_store.preagg_store().points_written == 1
+        assert len(t2._histogram_series) == 1
+        assert t2.annotations.global_range(BASE - 5, BASE + 5)
+
+    def test_uid_assignment_replay(self, tmp_path):
+        t = _tsdb(tmp_path)
+        uid = t.assign_uid("metric", "pre.created")
+        t2 = _tsdb(tmp_path)
+        assert t2.uids.metrics.get_id("pre.created") == uid
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        t = _tsdb(tmp_path)
+        t.add_point("m", BASE, 1, {"h": "a"})
+        t.add_point("m", BASE + 1, 2, {"h": "a"})
+        wal_dir = os.path.join(str(tmp_path), "wal")
+        seg = [os.path.join(wal_dir, n) for n in os.listdir(wal_dir)
+               if n.endswith(".log")][0]
+        with open(seg, "ab") as fh:  # torn partial record
+            fh.write(b"\x02\xff\xff\xff")
+        t2 = _tsdb(tmp_path)
+        assert _query_sum(t2, "m") == {BASE: 1.0, BASE + 1: 2.0}
+
+    def test_wal_disabled(self, tmp_path):
+        t = _tsdb(tmp_path, **{"tsd.storage.wal.enable": "false"})
+        assert t.wal is None
+        t.add_point("m", BASE, 1, {"h": "a"})
+        t2 = _tsdb(tmp_path, **{"tsd.storage.wal.enable": "false"})
+        with pytest.raises(Exception):
+            _query_sum(t2, "m")  # snapshot-only behavior preserved
+
+
+class TestWalReplayEdge:
+    def test_replay_sid_drift_chained_remap(self, tmp_path):
+        """T_SERIES order can differ from store sid order (concurrent
+        writers); replay must remap via lookup, not sequential in-place
+        substitution (chained maps like {6:5, 5:6} corrupt)."""
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        datadir = tmp_path / "drift"
+        wal_dir = str(datadir / "wal")
+        w = WriteAheadLog(wal_dir, fsync_mode="never")
+        # wal sids deliberately NOT starting at 0 -> drift vs a fresh
+        # store, with a chain (6 -> real 0, 5 -> real 1)
+        w._append_json(1, {"k": "data", "sid": 6, "m": "m",
+                           "t": [["h", "b"]]})
+        w._append_json(1, {"k": "data", "sid": 5, "m": "m",
+                           "t": [["h", "a"]]})
+        w.log_lines("data", np.asarray([5, 6, 5]),
+                    np.asarray([BASE, BASE, BASE + 1]) * 1000,
+                    np.asarray([10.0, 20.0, 11.0]),
+                    np.asarray([0, 0, 0], np.uint8))
+        # a single-point record for a drifted sid resolves via the map
+        w.log_point("data", 6, (BASE + 2) * 1000, 21.0, False)
+        w.close()
+        t = _tsdb(datadir)
+        mid = t.uids.metrics.get_id("m")
+        by_host = {}
+        for sid in t.store.series_ids_for_metric(mid):
+            rec = t.store.series(sid)
+            host = t.uids.tag_values.get_name(rec.tags[0][1])
+            ts, vals = rec.buffer.view()
+            by_host[host] = sorted(vals.tolist())
+        assert by_host == {"a": [10.0, 11.0], "b": [20.0, 21.0]}
+
+    def test_segment_rotation_replay(self, tmp_path):
+        """Records spread across many rotated segments all replay."""
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        datadir = tmp_path / "rot"
+        w = WriteAheadLog(str(datadir / "wal"), fsync_mode="never",
+                          segment_bytes=512)
+        for i in range(50):
+            w._append_json(1, {"k": "data", "sid": i,
+                               "m": "m", "t": [["h", f"x{i}"]]})
+            w.log_point("data", i, (BASE + i) * 1000, float(i), False)
+        assert len(w._segments()) > 3
+        w.close()
+        t = _tsdb(datadir)
+        assert t.store.num_series() == 50
+        assert t.store.points_written == 50
+
+
+KILLER = textwrap.dedent("""\
+    import os, sys, numpy as np
+    sys.path.insert(0, %(repo)r)
+    from opentsdb_tpu import TSDB, Config
+    t = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.data_dir": %(datadir)r,
+        "tsd.tpu.platform": "cpu"}))
+    base = 1356998400
+    i = 0
+    out = os.fdopen(1, "w", buffering=1)
+    while True:
+        n = 50
+        ts = np.arange(base + i * n, base + (i + 1) * n)
+        t.add_points("km", ts, np.full(n, float(i)), {"h": "h%%d" %% (i %% 7)})
+        out.write("%%d\\n" %% ((i + 1) * n))   # ACK after durable write
+        i += 1
+""")
+
+
+class TestKillNine:
+    def test_sigkill_loses_zero_acked_points(self, tmp_path):
+        """The contract: every point acknowledged (ACK printed AFTER
+        add_points returned, i.e. after fsync) is present after
+        SIGKILL + restart."""
+        datadir = str(tmp_path / "kill9")
+        script = KILLER % {"repo": "/root/repo", "datadir": datadir}
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen([sys.executable, "-c", script],
+                                stdout=subprocess.PIPE, env=env)
+        acked = 0
+        deadline = time.time() + 60
+        try:
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                acked = int(line)
+                if acked >= 1000:
+                    break
+            assert acked >= 1000, "writer never reached 1000 points"
+        finally:
+            proc.kill()
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.storage.data_dir": datadir}))
+        total = 0
+        for sid in range(t.store.num_series()):
+            ts, vals = t.store.series(sid).buffer.view()
+            total += len(ts)
+        assert total >= acked, (
+            f"lost acknowledged points: acked={acked} found={total}")
