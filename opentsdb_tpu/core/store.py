@@ -264,6 +264,14 @@ class MetricIndex:
         return self._sid_arr, self._tags_arr
 
 
+# process-wide monotonic store instance ids (shared with the native
+# backend): cache keys built from them can never alias a freed store
+# the way id(store) could after address reuse
+import itertools as _itertools
+
+STORE_INSTANCE_IDS = _itertools.count()
+
+
 class TimeSeriesStore:
     """In-memory storage engine: all series of all metrics.
 
@@ -274,6 +282,7 @@ class TimeSeriesStore:
     """
 
     def __init__(self, num_shards: int | None = None):
+        self.instance_id = next(STORE_INSTANCE_IDS)
         self.num_shards = num_shards or const.salt_buckets()
         self._lock = threading.Lock()
         self._series: list[SeriesRecord] = []

@@ -186,6 +186,8 @@ class NativeTimeSeriesStore:
 
     def __init__(self, num_shards: int | None = None,
                  materialize_threads: int | None = None):
+        from opentsdb_tpu.core.store import STORE_INSTANCE_IDS
+        self.instance_id = next(STORE_INSTANCE_IDS)
         self._lib = load_library()
         self._h = ctypes.c_void_p(self._lib.tss_create())
         self.num_shards = num_shards or const.salt_buckets()

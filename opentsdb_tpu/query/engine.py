@@ -134,6 +134,13 @@ class TagMatrix:
                 if v >= 0]
 
 
+def _store_id(store) -> int:
+    """Monotonic per-process store identity for cache keys. id(store)
+    could alias a freed store whose address was reused with a
+    coincidentally equal (points_written, mutation_epoch)."""
+    return getattr(store, "instance_id", id(store))
+
+
 def compact_row_labels(mat: np.ndarray) -> tuple[np.ndarray, int]:
     """``np.unique(mat, axis=0, return_inverse=True)`` equivalent via
     per-column factorization — the void-dtype row sort behind
@@ -291,19 +298,19 @@ class QueryEngine:
         # twin of _grid_pipeline's resident grids)
         mesh = self.tsdb.query_mesh
         prep_cache = (self.tsdb.device_grid_cache
-                      if mesh is None and rollup_scale == 1.0 else None)
+                      if rollup_scale == 1.0 else None)
         prep = pkey = pver = None
         if prep_cache is not None:
             from opentsdb_tpu.query.device_cache import array_digest
-            pkey = ("prep", id(store),
+            pkey = ("prep", _store_id(store),
                     array_digest(np.ascontiguousarray(sids)),
                     tsq.start_ms, tsq.end_ms, sub.downsample or "union",
-                    getattr(sub.ds_spec, "timezone", None))
+                    getattr(sub.ds_spec, "timezone", None), mesh)
             pver = (store.points_written,
                     getattr(store, "mutation_epoch", 0))
             hit = prep_cache.get(pkey, pver)
             if hit is not None:
-                (prep,), pmeta = hit
+                cached_args, pmeta = hit
                 bucket_ts = pmeta["bucket_ts"]
                 num_points = pmeta["num_points"]
                 ds_function = pmeta["ds_function"]
@@ -324,9 +331,23 @@ class QueryEngine:
                     rate_counter=sub.rate_options.counter,
                     rate_drop_resets=sub.rate_options.drop_resets,
                     emit_raw=emit_raw)
-                from opentsdb_tpu.ops.pipeline import run_prepared
-                result, emit = run_prepared(prep, bucket_ts, group_ids,
-                                            spec, sub.rate_options)
+                if mesh is not None:
+                    # HBM-resident pre-sharded batch: only the tiny
+                    # per-query group-id vector uploads
+                    from opentsdb_tpu.parallel.sharded_pipeline \
+                        import run_sharded_device, sharded_grid_gids
+                    gids_dev = sharded_grid_gids(
+                        mesh, group_ids, pmeta["s_pad"], num_groups)
+                    result, emit = run_sharded_device(
+                        mesh, spec, cached_args + (gids_dev,),
+                        pmeta["s_loc"], pmeta["b_loc"], num_groups,
+                        sub.rate_options)
+                else:
+                    (prep,) = cached_args
+                    from opentsdb_tpu.ops.pipeline import run_prepared
+                    result, emit = run_prepared(prep, bucket_ts,
+                                                group_ids, spec,
+                                                sub.rate_options)
                 if stats:
                     stats.add_stat(QueryStat.COMPUTE_TIME,
                                    (time.monotonic() - t2) * 1e3)
@@ -426,14 +447,15 @@ class QueryEngine:
                 batch = batch._replace(values=batch.values
                                        * rollup_scale)
         # the mesh raises the streaming threshold only when every
-        # device truly holds S_loc x B_loc cells: non-psum-reducible
-        # aggregators all_gather the full series axis (sharded step),
-        # so their per-device footprint stays [S, B] and the budget
-        # must not scale
-        from opentsdb_tpu.parallel.sharded_pipeline import REDUCIBLE_AGGS
+        # device truly holds S_loc x B_loc cells: psum-reducible,
+        # percentile-histogram, and edge-pick reductions all do; only
+        # diff/multiply still all_gather the full series axis, so their
+        # budget must not scale
+        from opentsdb_tpu.parallel.sharded_pipeline import \
+            mesh_memory_safe
         n_mesh = int(np.prod(list(mesh.shape.values()))) \
             if mesh is not None else 1
-        mesh_scale = n_mesh if sub.agg.name in REDUCIBLE_AGGS else 1
+        mesh_scale = n_mesh if mesh_memory_safe(sub.agg.name) else 1
         use_blocked = not emit_raw and \
             len(sids) * len(bucket_ts) > budget * mesh_scale
         if padded is not None and (use_blocked or mesh is not None):
@@ -442,30 +464,55 @@ class QueryEngine:
         elif use_blocked or mesh is not None:
             values, series_idx = batch.values, batch.series_idx
         if use_blocked:
-            # long-range streaming: bound HBM at [S x block] cells
+            # long-range streaming: bound memory at [S x block] cells
             # (SURVEY.md §5.7 time-axis blocking)
             if mesh is not None:
-                # the carry-chained block scan runs single-device; an
-                # over-budget query on a mesh deliberately trades the
-                # fan-out for bounded HBM — make that visible
-                import logging
-                logging.getLogger(__name__).info(
-                    "query exceeds the device cell budget "
-                    "(%d series x %d buckets): streaming on one "
-                    "device; the %d-device mesh is bypassed",
-                    len(sids), len(bucket_ts), n_mesh)
-            result, emit = execute_blocked(
-                values, series_idx, bucket_idx, bucket_ts,
-                group_ids, spec, sub.rate_options,
-                block_buckets=pick_block_buckets(
-                    len(sids), len(bucket_ts), budget))
+                # the carry-chained block scan runs AS a shard_map
+                # program: each block keeps the mesh fan-out and the
+                # per-DEVICE budget is O(S_loc x block) — the analogue
+                # of the 20 SaltScanners streaming concurrently
+                # (SaltScanner.java:463-536)
+                from opentsdb_tpu.parallel.sharded_pipeline import \
+                    execute_blocked_sharded
+                result, emit = execute_blocked_sharded(
+                    mesh, values, series_idx, bucket_idx, bucket_ts,
+                    group_ids, spec, sub.rate_options,
+                    block_buckets=pick_block_buckets(
+                        len(sids), len(bucket_ts),
+                        budget * mesh_scale))
+            else:
+                result, emit = execute_blocked(
+                    values, series_idx, bucket_idx, bucket_ts,
+                    group_ids, spec, sub.rate_options,
+                    block_buckets=pick_block_buckets(
+                        len(sids), len(bucket_ts), budget))
         elif mesh is not None:
             # multi-chip: shard the point batch over the
             # ('series','time') mesh — the salt-scanner fan-out/merge
-            # as XLA collectives (SaltScanner.java:70, SURVEY §2.11)
-            result, emit = self._mesh_execute(
-                mesh, spec, values, series_idx, bucket_idx, bucket_ts,
-                group_ids, sub.rate_options)
+            # as XLA collectives (SaltScanner.java:70, SURVEY §2.11).
+            # The sharded device arrays are cached (minus the per-query
+            # group ids) so a warm repeat skips materialize AND upload.
+            from opentsdb_tpu.ops.pipeline import pipeline_dtype
+            from opentsdb_tpu.parallel.sharded_pipeline import (
+                prepare_sharded_batch, run_sharded_device,
+                sharded_device_args)
+            sbatch = prepare_sharded_batch(
+                values, series_idx, bucket_idx, bucket_ts, group_ids,
+                spec.num_series, spec.num_groups,
+                mesh.shape["series"], mesh.shape["time"])
+            margs = sharded_device_args(mesh, sbatch, pipeline_dtype())
+            if prep_cache is not None and pkey is not None:
+                prep_cache.put(
+                    pkey, pver, margs[:4],
+                    {"num_points": num_points, "bucket_ts": bucket_ts,
+                     "ds_function": ds_function,
+                     "fill_policy": fill_policy,
+                     "fill_value": fill_value, "s_loc": sbatch.s_loc,
+                     "b_loc": sbatch.b_loc,
+                     "s_pad": sbatch.s_loc * mesh.shape["series"]})
+            result, emit = run_sharded_device(
+                mesh, spec, margs, sbatch.s_loc, sbatch.b_loc,
+                num_groups, sub.rate_options)
         elif prep_cache is not None:
             # upload once, cache the device-resident batch, execute
             from opentsdb_tpu.ops.pipeline import (prepare_auto,
@@ -616,21 +663,29 @@ class QueryEngine:
         fn = ds_fn_override or ds_spec.function
         want_minmax = fn in ("min", "mimmin", "max", "mimmax")
         # device-resident cache: a warm repeat of this reduction skips
-        # the host scan AND the upload (HBM ≙ HBase block cache)
-        cache = self.tsdb.device_grid_cache if mesh is None else None
+        # the host scan AND the upload (HBM ≙ HBase block cache).
+        # Under a mesh the cached value is the pre-SHARDED device args
+        # (grid + mask + bucket_ts + gids placed per the mesh specs).
+        cache = self.tsdb.device_grid_cache
         ckey = cver = None
         grid = has_data = None
+        mesh_args = mesh_meta = None
         if cache is not None:
             from opentsdb_tpu.query.device_cache import array_digest
-            ckey = ("grid", id(store), array_digest(
+            ckey = ("grid", _store_id(store), array_digest(
                 np.ascontiguousarray(sids)), tsq.start_ms, tsq.end_ms,
-                int(bucket_ts[0]), ds_spec.interval_ms, b, fn)
+                int(bucket_ts[0]), ds_spec.interval_ms, b, fn, mesh)
             cver = (store.points_written,
                     getattr(store, "mutation_epoch", 0))
             hit = cache.get(ckey, cver)
             if hit is not None:
-                (grid, has_data), meta = hit
-                num_points = meta["num_points"]
+                if mesh is not None:
+                    mesh_args, mesh_meta = hit
+                    num_points = mesh_meta["num_points"]
+                    grid = True  # skip the host scan below
+                else:
+                    (grid, has_data), meta = hit
+                    num_points = meta["num_points"]
         t1 = time.monotonic()
         if grid is None:
             sums, cnts, mins, maxs = store.bucket_reduce(
@@ -658,7 +713,7 @@ class QueryEngine:
             else:  # max, mimmax
                 grid = np.where(present, maxs, np.nan)
             has_data = present
-            if cache is not None:
+            if cache is not None and mesh is None:
                 from opentsdb_tpu.ops.pipeline import put_grid
                 grid, has_data = put_grid(grid, has_data)
                 cache.put(ckey, cver, (grid, has_data),
@@ -673,15 +728,32 @@ class QueryEngine:
             rate_drop_resets=sub.rate_options.drop_resets,
             emit_raw=emit_raw)
         if mesh is not None:
-            # flatten present cells: one point per cell reproduces the
-            # cell under ds 'sum' in the sharded re-bucketize
-            sidx, bidx = np.nonzero(has_data)
-            from dataclasses import replace as _dc_replace
-            result, emit = self._mesh_execute(
-                mesh, _dc_replace(spec, ds_function="sum"),
-                grid[has_data], sidx.astype(np.int32),
-                bidx.astype(np.int32), bucket_ts, group_ids,
-                sub.rate_options)
+            # the grid-TAIL step runs straight on the mesh (no
+            # flatten-to-points re-bucketize), and the pre-sharded
+            # device grids are cached — mesh queries get the same
+            # warm-repeat behavior as single-device ones
+            from opentsdb_tpu.parallel.sharded_pipeline import (
+                prepare_sharded_grid, run_sharded_grid,
+                sharded_grid_gids)
+            if mesh_args is None:
+                data_args, s_loc, b_loc, s_pad = prepare_sharded_grid(
+                    mesh, np.asarray(grid), np.asarray(has_data),
+                    bucket_ts)
+                if cache is not None:
+                    cache.put(ckey, cver, data_args,
+                              {"num_points": num_points,
+                               "s_loc": s_loc, "b_loc": b_loc,
+                               "s_pad": s_pad})
+            else:
+                data_args = mesh_args
+                s_loc = mesh_meta["s_loc"]
+                b_loc = mesh_meta["b_loc"]
+                s_pad = mesh_meta["s_pad"]
+            gids_dev = sharded_grid_gids(mesh, group_ids, s_pad,
+                                         num_groups)
+            result, emit = run_sharded_grid(
+                mesh, spec, data_args + (gids_dev,), s_loc, b_loc,
+                num_groups, sub.rate_options)
         else:
             from opentsdb_tpu.ops.pipeline import execute_grid
             result, emit = execute_grid(grid, has_data, bucket_ts,
@@ -901,7 +973,7 @@ class QueryEngine:
             # per-(store, metric) matrix cache: the index is
             # append-only, so the series count versions it
             tm_cache = self.tsdb._tagmat_cache
-            tm_key = (id(store), metric_id)
+            tm_key = (_store_id(store), metric_id)
             hit = tm_cache.get(tm_key)
             if hit is not None and hit[0] == len(idx_sids) \
                     and sids is idx_sids:

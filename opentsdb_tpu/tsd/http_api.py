@@ -385,6 +385,7 @@ class HttpRpcRouter:
                                 "set tsd.http.query.allow_delete")
             tsq.delete = True
         stats = QueryStats(request.remote, tsq)
+        streamed = False
         try:
             results = self.tsdb.new_query().run(tsq, stats)
             from opentsdb_tpu.stats.stats import QueryStat
@@ -410,17 +411,23 @@ class HttpRpcRouter:
                     tsq, results, as_arrays=request.flag("arrays"))
 
                 def body_iter(inner=inner, stats=stats, t_ser=t_ser):
-                    # the stream IS the serialization: success and
-                    # timing are marked when it exhausts, so a query
-                    # that streamed fully shows executed=true
-                    yield from inner
-                    stats.add_stat(QueryStat.SERIALIZATION_TIME,
-                                   (time.monotonic() - t_ser) * 1e3)
-                    stats.mark_serialization_successful()
+                    # the stream IS the serialization: success, timing
+                    # AND completion are marked when it exhausts (or
+                    # aborts), so /api/stats/query reports the real
+                    # totalTime of streamed queries, not the
+                    # pre-serialization slice
+                    try:
+                        yield from inner
+                        stats.add_stat(QueryStat.SERIALIZATION_TIME,
+                                       (time.monotonic() - t_ser) * 1e3)
+                        stats.mark_serialization_successful()
+                    finally:
+                        stats.mark_complete()
 
                 stats.add_stat(
                     QueryStat.PROCESSING_PRE_WRITE_TIME,
                     (time.monotonic_ns() - stats.start_ns) / 1e6)
+                streamed = True
                 return HttpResponse(200, b"", body_iter=body_iter())
             body = request.serializer.format_query(
                 tsq, results, as_arrays=request.flag("arrays"),
@@ -434,8 +441,10 @@ class HttpRpcRouter:
                            (time.monotonic_ns() - stats.start_ns) / 1e6)
             stats.mark_serialization_successful()
         finally:
-            # a raise above lands here with executed still False
-            stats.mark_complete()
+            # a raise above lands here with executed still False; the
+            # streaming path completes inside its body iterator instead
+            if not streamed:
+                stats.mark_complete()
         return HttpResponse(200, body)
 
     def _handle_query_last(self, request: HttpRequest) -> HttpResponse:

@@ -125,7 +125,14 @@ class HttpJsonSerializer(HttpSerializer):
 
     def _dps_body(self, r: QueryResult, ms: bool,
                   as_arrays: bool) -> bytes:
-        """The dps map/array body, natively formatted when large."""
+        """The dps map/array body, natively formatted when large.
+
+        Known, accepted divergence: float TEXT from the native
+        formatter (std::to_chars) can differ from json.dumps in
+        exponent style around its threshold, so the same query's bytes
+        depend on response size and compiler availability; the values
+        parse to identical doubles either way (clients consume JSON
+        numbers, not bytes)."""
         if r.dps_arrays is not None and \
                 len(r.dps) >= self._NATIVE_FMT_MIN_DPS:
             fmt = self._native_fmt()

@@ -60,12 +60,114 @@ def test_reducible_aggs_match_single_chip(mesh_shape, agg):
 
 
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
-@pytest.mark.parametrize("agg", ["p95", "median", "first", "last",
-                                 "multiply", "diff"])
+@pytest.mark.parametrize("agg", ["first", "last", "multiply", "diff"])
 def test_gathered_aggs_match_single_chip(mesh_shape, agg):
+    # first/last: distributed edge-candidate merge (exact);
+    # multiply/diff: the remaining all_gather fallbacks (exact)
     spec = PipelineSpec(num_series=16, num_buckets=24, num_groups=2,
                         ds_function="sum", agg_name=agg)
     compare(mesh_shape, spec, 16, seed=sum(map(ord, agg)) % 1000, points_per=20)
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+@pytest.mark.parametrize("agg", ["p95", "p50", "median", "ep99r7"])
+def test_distributed_percentiles_within_estimator_error(mesh_shape,
+                                                        agg):
+    """Percentiles on the mesh use bucketed-histogram psum partials
+    (VERDICT r02 #5) — per-device memory O(S_loc x B) instead of an
+    all_gather of the series axis. Conformance bar: within the
+    documented estimator error (group value range / PERCENTILE_BINS)
+    of the exact single-device answer."""
+    from opentsdb_tpu.parallel.sharded_pipeline import PERCENTILE_BINS
+    num_series, g = 32, 2
+    spec = PipelineSpec(num_series=num_series, num_buckets=24,
+                        num_groups=g, ds_function="sum", agg_name=agg)
+    values, sidx, bidx, bts = random_batch(num_series, 24, 20,
+                                           seed=sum(map(ord, agg)))
+    group_ids = (np.arange(num_series) % g).astype(np.int32)
+    ref, ref_emit = execute(values, sidx, bidx, bts, group_ids, spec)
+    mesh = make_mesh(*mesh_shape)
+    batch = prepare_sharded_batch(values, sidx, bidx, bts, group_ids,
+                                  num_series, g, mesh_shape[0],
+                                  mesh_shape[1])
+    got, got_emit = run_sharded(mesh, spec, batch)
+    np.testing.assert_array_equal(got_emit, ref_emit)
+    # same NaN pattern; values within the documented bin error
+    assert np.array_equal(np.isnan(got), np.isnan(ref))
+    # the per-(g,b) INPUT value range bounds the bin width; the global
+    # input range bounds every cell's
+    rng_ = values.max() - values.min() + 1e-9
+    tol = 2.0 * rng_ / PERCENTILE_BINS
+    m = ~np.isnan(ref)
+    assert np.max(np.abs(got[m] - ref[m])) <= tol, \
+        f"estimator error {np.max(np.abs(got[m] - ref[m]))} > {tol}"
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4)])
+def test_blocked_sharded_gap_spans_whole_block(mesh_shape):
+    """A series with points in blocks 0 and 2 but NONE in block 1 must
+    still LERP across the empty middle block: next-carries accumulate
+    over ALL later blocks, not just the adjacent one."""
+    from opentsdb_tpu.parallel.sharded_pipeline import \
+        execute_blocked_sharded
+    num_series, g, b = 8, 2, 48
+    spec = PipelineSpec(num_series=num_series, num_buckets=b,
+                        num_groups=g, ds_function="avg",
+                        agg_name="sum")
+    rows = []
+    for s in range(num_series):
+        if s == 3:
+            # block 0 (buckets 0-15) and block 2 (32-47) only
+            rows += [(s, 2, 10.0), (s, 40, 90.0)]
+        else:
+            rows += [(s, bb, float(100 + s + bb)) for bb in range(48)]
+    arr = np.asarray(rows)
+    values = arr[:, 2].astype(np.float64)
+    sidx = arr[:, 0].astype(np.int32)
+    bidx = arr[:, 1].astype(np.int32)
+    bts = np.arange(b, dtype=np.int64) * 60_000
+    group_ids = (np.arange(num_series) % g).astype(np.int32)
+    ref, ref_emit = execute(values, sidx, bidx, bts, group_ids, spec)
+    mesh = make_mesh(*mesh_shape)
+    got, got_emit = execute_blocked_sharded(
+        mesh, values, sidx, bidx, bts, group_ids, spec,
+        block_buckets=16)  # 3 blocks; series 3 empty in block 1
+    np.testing.assert_array_equal(got_emit, ref_emit)
+    np.testing.assert_allclose(got, ref, rtol=1e-9, equal_nan=True)
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize("agg,rate", [("sum", False), ("avg", True),
+                                      ("p95", False)])
+def test_blocked_sharded_matches_single_chip(mesh_shape, agg, rate):
+    """Over-budget long ranges stream time blocks while KEEPING the
+    mesh (VERDICT r02 #4): the carry-chained block scan as a shard_map
+    program must match the unblocked single-device pipeline."""
+    from opentsdb_tpu.parallel.sharded_pipeline import (
+        PERCENTILE_BINS, execute_blocked_sharded)
+    num_series, g, b = 24, 3, 48
+    spec = PipelineSpec(num_series=num_series, num_buckets=b,
+                        num_groups=g, ds_function="avg", agg_name=agg,
+                        rate=rate)
+    values, sidx, bidx, bts = random_batch(num_series, b, 30, seed=11)
+    group_ids = (np.arange(num_series) % g).astype(np.int32)
+    ro = RateOptions() if rate else None
+    ref, ref_emit = execute(values, sidx, bidx, bts, group_ids, spec,
+                            ro)
+    mesh = make_mesh(*mesh_shape)
+    got, got_emit = execute_blocked_sharded(
+        mesh, values, sidx, bidx, bts, group_ids, spec, ro,
+        block_buckets=16)  # forces 3 blocks
+    np.testing.assert_array_equal(got_emit, ref_emit)
+    if agg.startswith("p"):
+        assert np.array_equal(np.isnan(got), np.isnan(ref))
+        rng_ = values.max() - values.min() + 1e-9
+        m = ~np.isnan(ref)
+        assert np.max(np.abs(got[m] - ref[m])) <= 2 * rng_ / \
+            PERCENTILE_BINS
+    else:
+        np.testing.assert_allclose(got, ref, rtol=1e-9,
+                                   equal_nan=True)
 
 
 @pytest.mark.parametrize("mesh_shape", MESHES)
