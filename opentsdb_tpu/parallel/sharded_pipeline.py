@@ -47,17 +47,46 @@ REDUCIBLE_AGGS = frozenset((
     "mimmax", "squareSum", "dev"))
 
 
-def mesh_memory_safe(agg_name: str) -> bool:
+# [G, B, BINS] histogram cell cap for the distributed percentile path
+# (f32: 2^25 cells = 128 MB per device); beyond it the reduction falls
+# back to all_gather — with that many groups each group holds few
+# series, which is exactly when gathering is the cheaper shape
+PERCENTILE_HIST_MAX_CELLS = 1 << 25
+
+
+def _hist_eligible(num_groups: int, num_buckets: int) -> bool:
+    return (num_groups * num_buckets * PERCENTILE_BINS
+            <= PERCENTILE_HIST_MAX_CELLS)
+
+
+def agg_mesh_class(agg_name: str) -> str:
+    """Memory class of an aggregator's cross-shard reduction:
+    'safe' — per-device O(S_loc x B) (psum partials / edge candidates);
+    'pct' — histogram psum, safe iff the [G, B, BINS] partial fits
+    (:func:`_hist_eligible` — the per-query shape decides);
+    'gather' — all_gathers the series axis (diff/multiply)."""
+    if agg_name in REDUCIBLE_AGGS or agg_name in ("first", "last"):
+        return "safe"
+    if agg_name == "median" or \
+            aggs_mod.get(agg_name).percentile is not None:
+        return "pct"
+    return "gather"
+
+
+def mesh_memory_safe(agg_name: str, num_groups: int | None = None,
+                     num_buckets: int | None = None) -> bool:
     """True when the mesh reduction keeps per-device memory at
-    O(S_loc x B): the psum-reducible set, plus percentiles/median
-    (bucketed-histogram psum partials) and first/last (edge-candidate
-    merge). Only diff/multiply still all_gather the full series axis —
-    engine sizing decisions (device-cell budgets) key off this."""
-    if agg_name in REDUCIBLE_AGGS or agg_name in ("first", "last",
-                                                  "median"):
+    O(S_loc x B) — engine sizing (device-cell budgets) keys off this.
+    Percentiles qualify only while their [G, B, BINS] histogram
+    partial fits :data:`PERCENTILE_HIST_MAX_CELLS`."""
+    cls = agg_mesh_class(agg_name)
+    if cls == "safe":
         return True
-    agg = aggs_mod.get(agg_name)
-    return agg.percentile is not None
+    if cls == "pct":
+        if num_groups is None or num_buckets is None:
+            return False  # unknown shape: be conservative
+        return _hist_eligible(num_groups + 1, num_buckets)
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -392,7 +421,8 @@ def _group_reduce_distributed(filled, group_ids, num_groups: int,
       full-axis gathers.
     """
     agg = aggs_mod.get(agg_name)
-    if agg.percentile is not None or agg_name == "median":
+    if (agg.percentile is not None or agg_name == "median") and \
+            _hist_eligible(num_groups, filled.shape[-1]):
         q = agg.percentile if agg.percentile is not None else 50.0
         est = ("upper-median" if agg_name == "median"
                else getattr(agg, "estimation", None) or "r7")
@@ -838,18 +868,26 @@ def execute_blocked_sharded(mesh: Mesh, batch_values: np.ndarray,
     gids_full = np.full(s_pad, g, dtype=np.int32)
     gids_full[:s] = group_ids
 
-    def shard_block(blk):
-        b0, b1, p0, p1 = blk
-        return prepare_sharded_batch(
-            sv_[p0:p1], ssi[p0:p1], sbi[p0:p1] - b0,
-            _pad_bts_tail(dev_bts[b0:b1], bb),
-            gids_full, s_pad, g, ds_shards, dt_shards)
+    # memoized per-block batches: the two-pass sweep (needs_next) must
+    # not repeat the host-side per-cell packing loop — the memo is the
+    # same order of memory as the already-resident sorted point arrays
+    _block_memo: dict[int, ShardedBatch] = {}
+
+    def shard_block(i, blk):
+        sb = _block_memo.get(i)
+        if sb is None:
+            b0, b1, p0, p1 = blk
+            sb = _block_memo[i] = prepare_sharded_batch(
+                sv_[p0:p1], ssi[p0:p1], sbi[p0:p1] - b0,
+                _pad_bts_tail(dev_bts[b0:b1], bb),
+                gids_full, s_pad, g, ds_shards, dt_shards)
+        return sb
 
     def carry_dev(c):
         return tuple(jnp.asarray(np.asarray(x)) for x in c)
 
-    def run(blk, which, rate_carry, prev_carry, next_carry):
-        sb = shard_block(blk)
+    def run(i, blk, which, rate_carry, prev_carry, next_carry):
+        sb = shard_block(i, blk)
         return which(
             jnp.asarray(sb.values, dtype), jnp.asarray(sb.series_idx),
             jnp.asarray(sb.bucket_idx), jnp.asarray(sb.bucket_ts),
@@ -869,9 +907,10 @@ def execute_blocked_sharded(mesh: Mesh, batch_values: np.ndarray,
                                        summary_only=True)
         firsts = []
         rate_carry = empty
-        for blk in blocks:
-            _, _, pre_last, _, post_first = run(blk, sstep, rate_carry,
-                                                empty, empty)
+        for i, blk in enumerate(blocks):
+            _, _, pre_last, _, post_first = run(i, blk, sstep,
+                                                rate_carry, empty,
+                                                empty)
             firsts.append(tuple(np.asarray(x) for x in post_first))
             if spec.rate:
                 rate_carry = _merge_carry(
@@ -888,7 +927,7 @@ def execute_blocked_sharded(mesh: Mesh, batch_values: np.ndarray,
     prev_carry = empty
     for i, blk in enumerate(blocks):
         res, emit, pre_last, post_last, _ = run(
-            blk, step, rate_carry, prev_carry, next_carries[i])
+            i, blk, step, rate_carry, prev_carry, next_carries[i])
         b0, b1 = blk[0], blk[1]
         nb = b1 - b0
         out[:, b0:b1] = np.asarray(res)[:g, :nb]

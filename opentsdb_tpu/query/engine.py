@@ -302,10 +302,24 @@ class QueryEngine:
         prep = pkey = pver = None
         if prep_cache is not None:
             from opentsdb_tpu.query.device_cache import array_digest
+            from opentsdb_tpu.parallel.sharded_pipeline import \
+                agg_mesh_class
+            # the aggregator's memory CLASS is part of the key: the
+            # use_blocked verdict depends on it (mesh_scale), and a hit
+            # must imply the cold path would have taken the same
+            # (non-blocked) branch — an entry cached by a psum-safe
+            # aggregator must not serve an all_gather one past its
+            # unscaled budget
+            acls = agg_mesh_class(sub.agg.name)
+            if acls == "pct":
+                # histogram eligibility (and so the budget verdict)
+                # depends on the group count too
+                acls = ("pct", num_groups)
             pkey = ("prep", _store_id(store),
                     array_digest(np.ascontiguousarray(sids)),
                     tsq.start_ms, tsq.end_ms, sub.downsample or "union",
-                    getattr(sub.ds_spec, "timezone", None), mesh)
+                    getattr(sub.ds_spec, "timezone", None), mesh,
+                    acls if mesh is not None else None)
             pver = (store.points_written,
                     getattr(store, "mutation_epoch", 0))
             hit = prep_cache.get(pkey, pver)
@@ -448,14 +462,16 @@ class QueryEngine:
                                        * rollup_scale)
         # the mesh raises the streaming threshold only when every
         # device truly holds S_loc x B_loc cells: psum-reducible,
-        # percentile-histogram, and edge-pick reductions all do; only
-        # diff/multiply still all_gather the full series axis, so their
-        # budget must not scale
+        # edge-pick, and (shape-permitting) percentile-histogram
+        # reductions all do; diff/multiply — and percentiles whose
+        # [G, B, BINS] partial would not fit — all_gather the series
+        # axis, so their budget must not scale
         from opentsdb_tpu.parallel.sharded_pipeline import \
             mesh_memory_safe
         n_mesh = int(np.prod(list(mesh.shape.values()))) \
             if mesh is not None else 1
-        mesh_scale = n_mesh if mesh_memory_safe(sub.agg.name) else 1
+        mesh_scale = n_mesh if mesh_memory_safe(
+            sub.agg.name, num_groups, len(bucket_ts)) else 1
         use_blocked = not emit_raw and \
             len(sids) * len(bucket_ts) > budget * mesh_scale
         if padded is not None and (use_blocked or mesh is not None):
