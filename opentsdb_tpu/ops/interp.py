@@ -56,6 +56,12 @@ def _gather_minor(grid, idx):
     134 ms on a [1e6, 12] grid vs 8 ms for B fused selects), so small
     bucket counts use an unrolled select chain instead; XLA fuses it
     into one pass over the grid per bucket.
+
+    NOTE: only suitable for cheap lookups (e.g. single-column boundary
+    summaries). The hot fill/rate kernels use
+    :func:`carry_prev`/:func:`carry_next` instead — the select chain
+    stops fusing around B=14 on TPU and falls off a 15x cliff
+    (measured 88 ms -> 1.5 s at [1M, 13] -> [1M, 14]).
     """
     s, b = grid.shape
     if b <= _SELECT_GATHER_MAX_B and s * b * b <= _SELECT_GATHER_MAX_ELEMS:
@@ -64,6 +70,51 @@ def _gather_minor(grid, idx):
             out = jnp.where(idx == k, grid[:, k:k + 1], out)
         return out
     return jnp.take_along_axis(grid, idx, axis=-1)
+
+
+def _nearest_present_scan(arrays, mask, reverse: bool):
+    """'Nearest present wins' associative scan along the minor axis.
+
+    The combiner is direction-independent: jax flips the sequence for
+    ``reverse=True``, so in SCAN order the right/newer segment always
+    holds the nearer candidates and wins where present.
+    """
+    def combine(a, b):
+        bp = b[-1]
+        out = tuple(jnp.where(bp, xb, xa)
+                    for xa, xb in zip(a[:-1], b[:-1]))
+        return out + (a[-1] | bp,)
+
+    # associative_scan's reverse path requires a non-negative axis
+    return jax.lax.associative_scan(combine, tuple(arrays) + (mask,),
+                                    axis=mask.ndim - 1,
+                                    reverse=reverse)
+
+
+def carry_prev(arrays, mask):
+    """For each cell along the minor axis: the values of ``arrays`` at
+    the nearest PRESENT cell at-or-before it, plus that presence flag.
+
+    A log2(B)-step ``lax.associative_scan`` — no gathers at all, so
+    the cost is O(S B log B) instead of the select chain's O(S B^2)
+    with its B>=14 fusion cliff (measured 88 ms -> 1.5 s at [1M, 13]
+    -> [1M, 14])."""
+    return _nearest_present_scan(arrays, mask, reverse=False)
+
+
+def carry_next(arrays, mask):
+    """Reverse twin of :func:`carry_prev`: nearest present cell
+    at-or-after."""
+    return _nearest_present_scan(arrays, mask, reverse=True)
+
+
+def shift_prev(arrays, fill_values):
+    """Shift each [S, B] array one column right (making an inclusive
+    prev-carry exclusive: 'strictly before'), filling column 0."""
+    return tuple(
+        jnp.concatenate([jnp.full_like(a[:, :1], fv), a[:, :-1]],
+                        axis=-1)
+        for a, fv in zip(arrays, fill_values))
 
 
 @partial(jax.jit, static_argnames=("mode",))
@@ -85,16 +136,16 @@ def fill_gaps(grid, bucket_ts, mode: str):
     if mode == Interpolation.ZIM.value:
         return jnp.where(mask, grid, 0.0)
 
-    nb = grid.shape[-1]
-    prev_idx = _prev_valid_idx(mask)
+    gz = jnp.where(mask, grid, 0.0)  # scans must not propagate NaN
     if mode == Interpolation.PREV.value:
-        safe_prev = jnp.clip(prev_idx, 0, nb - 1)
-        prev_val = _gather_minor(grid, safe_prev)
+        prev_val, has_prev = carry_prev((gz,), mask)
         return jnp.where(mask, grid,
-                         jnp.where(prev_idx >= 0, prev_val, jnp.nan))
+                         jnp.where(has_prev, prev_val, jnp.nan))
 
-    next_idx = _next_valid_idx(mask)
-    in_range = (prev_idx >= 0) & (next_idx < nb)
+    ts_row = jnp.broadcast_to(bucket_ts[None, :], grid.shape)
+    v0, t0, has0 = carry_prev((gz, ts_row), mask)
+    v1, t1, has1 = carry_next((gz, ts_row), mask)
+    in_range = has0 & has1
     if mode in (Interpolation.MAX.value, Interpolation.MIN.value):
         extreme = jnp.inf if mode == Interpolation.MAX.value else -jnp.inf
         return jnp.where(mask, grid,
@@ -102,19 +153,9 @@ def fill_gaps(grid, bucket_ts, mode: str):
 
     if mode != Interpolation.LERP.value:
         raise ValueError(f"unknown interpolation mode {mode!r}")
-    safe_prev = jnp.clip(prev_idx, 0, nb - 1)
-    safe_next = jnp.clip(next_idx, 0, nb - 1)
-    v0 = _gather_minor(grid, safe_prev)
-    v1 = _gather_minor(grid, safe_next)
     # integer ts diffs before the float cast (exact under int32
-    # relative offsets, see pipeline.device_bucket_ts). The ts lookups
-    # ride the same fused select chain as the value gathers —
-    # bucket_ts[safe_prev] is a per-element TPU gather (measured ~5 ms
-    # of the 5.4 ms lerp total at [1M, 12]).
+    # relative offsets, see pipeline.device_bucket_ts)
     t = bucket_ts[None, :]
-    ts_row = jnp.broadcast_to(t, grid.shape)
-    t0 = _gather_minor(ts_row, safe_prev)
-    t1 = _gather_minor(ts_row, safe_next)
     num = (t - t0).astype(grid.dtype)
     den = (t1 - t0).astype(grid.dtype)
     lerped = v0 + (v1 - v0) * num / jnp.where(den > 0, den, 1.0)

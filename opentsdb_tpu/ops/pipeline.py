@@ -217,16 +217,68 @@ def put_grid(grid, has_data, device=None):
                            device=device))
 
 
+def _pad_2d(arr, s_pad: int, b_pad: int, fill):
+    """Pad a [S, B] array to [s_pad, b_pad]. DEVICE arrays pad on
+    device (an eager jnp.pad — never a host round trip: the engine's
+    grids are often HBM-resident from the native reduce or the device
+    cache, and pulling 1M-series grids through a tunneled host costs
+    seconds); host arrays pad in numpy."""
+    from opentsdb_tpu.ops import shapes
+    s, b = arr.shape
+    if (s_pad, b_pad) == (s, b):
+        return arr
+    if isinstance(arr, jax.Array):
+        return jnp.pad(arr, ((0, s_pad - s), (0, b_pad - b)),
+                       constant_values=fill)
+    return shapes.pad_2d_host(arr, s_pad, b_pad, fill)
+
+
+def _bucket_dims_and_aux(bucket_ts, group_ids, spec: PipelineSpec,
+                         s: int, b: int):
+    """Shared shape-bucketing of one grid query: returns
+    (s_pad, b_pad, padded bucket_ts, padded group_ids, padded spec)."""
+    from opentsdb_tpu.ops import shapes
+    from dataclasses import replace
+    g = spec.num_groups
+    s_pad = shapes.shape_bucket(s)
+    b_pad = shapes.shape_bucket(b)
+    g_pad = shapes.shape_bucket(g + 1)  # room for the dummy group
+    bts = shapes.pad_bucket_ts(np.asarray(bucket_ts), b_pad)
+    gids = shapes.pad_group_ids(np.asarray(group_ids), s_pad, g)
+    return s_pad, b_pad, bts, gids, replace(
+        spec, num_series=s_pad, num_buckets=b_pad, num_groups=g_pad)
+
+
+def bucket_grid_shapes(grid, has_data, bucket_ts, group_ids,
+                       spec: PipelineSpec):
+    """Pad (S, B, G) up to geometric shape buckets (ops.shapes) so
+    repeat traffic with drifting shapes hits a bounded jit-program
+    set. Returns (grid, has_data, bucket_ts, group_ids, spec_padded);
+    callers trim the result back to the true (G, B) / (S, B)."""
+    s, b = grid.shape
+    s_pad, b_pad, bts, gids, pspec = _bucket_dims_and_aux(
+        bucket_ts, group_ids, spec, s, b)
+    gp = _pad_2d(grid, s_pad, b_pad, np.nan)
+    hp = _pad_2d(has_data, s_pad, b_pad, False)
+    return gp, hp, bts, gids, pspec
+
+
 def execute_grid(grid: np.ndarray, has_data: np.ndarray,
                  bucket_ts: np.ndarray, group_ids: np.ndarray,
                  spec: PipelineSpec,
                  rate_options: RateOptions | None = None,
                  dtype=None, device=None
                  ) -> tuple[np.ndarray, np.ndarray]:
-    """Host entry over a pre-bucketized [S, B] grid -> (result, emit)."""
+    """Host entry over a pre-bucketized [S, B] grid -> (result, emit).
+    Shapes are geometrically bucketed (ops.shapes) before jit."""
     if dtype is None:
         dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
+    s, b, g = spec.num_series, spec.num_buckets, spec.num_groups
+    grid, has_data, bucket_ts, group_ids, pspec = bucket_grid_shapes(
+        grid if isinstance(grid, jax.Array) else np.asarray(grid),
+        has_data if isinstance(has_data, jax.Array)
+        else np.asarray(has_data), bucket_ts, group_ids, spec)
     put = partial(jax.device_put, device=device)
     rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
                    jnp.asarray(ro.reset_value, dtype=dtype))
@@ -235,8 +287,10 @@ def execute_grid(grid: np.ndarray, has_data: np.ndarray,
         put(jnp.asarray(has_data, dtype=bool)),
         put(jnp.asarray(device_bucket_ts(bucket_ts))),
         put(jnp.asarray(group_ids, dtype=jnp.int32)),
-        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), spec)
-    return np.asarray(result), np.asarray(emit)
+        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), pspec)
+    rows = s if spec.emit_raw else g
+    return (np.asarray(result)[:rows, :b],
+            np.asarray(emit)[:rows, :b])
 
 
 def avg_divide_grid(grid_sum, grid_cnt, xp=jnp):
@@ -270,20 +324,29 @@ def execute_avg_divide(grid_sum, grid_cnt, bucket_ts: np.ndarray,
                        dtype=None, device=None
                        ) -> tuple[np.ndarray, np.ndarray]:
     """Host entry: sum/count tier grids (device arrays straight from
-    ``bucketize`` are fine) -> (result, emit)."""
+    ``bucketize`` are fine) -> (result, emit). Shapes are geometrically
+    bucketed (ops.shapes) before jit."""
     if dtype is None:
         dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
+    s, b, g = spec.num_series, spec.num_buckets, spec.num_groups
+    s_pad, b_pad, bts_p, gids_p, pspec = _bucket_dims_and_aux(
+        bucket_ts, group_ids, spec, grid_sum.shape[0],
+        grid_sum.shape[1])
+    gsum = _pad_2d(grid_sum, s_pad, b_pad, np.nan)
+    gcnt = _pad_2d(grid_cnt, s_pad, b_pad, np.nan)
     put = partial(jax.device_put, device=device)
     rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
                    jnp.asarray(ro.reset_value, dtype=dtype))
     result, emit = run_pipeline_avg_div(
-        jnp.asarray(grid_sum, dtype=dtype),
-        jnp.asarray(grid_cnt, dtype=dtype),
-        put(jnp.asarray(device_bucket_ts(bucket_ts))),
-        put(jnp.asarray(group_ids, dtype=jnp.int32)),
-        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), spec)
-    return np.asarray(result), np.asarray(emit)
+        jnp.asarray(gsum, dtype=dtype),
+        jnp.asarray(gcnt, dtype=dtype),
+        put(jnp.asarray(device_bucket_ts(bts_p))),
+        put(jnp.asarray(gids_p, dtype=jnp.int32)),
+        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), pspec)
+    rows = s if spec.emit_raw else g
+    return (np.asarray(result)[:rows, :b],
+            np.asarray(emit)[:rows, :b])
 
 
 _DENSE_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "min", "mimmin",
@@ -457,37 +520,62 @@ class PreparedBatch:
     kind 'dense': arrays = (values2d,), k = points per bucket;
     kind 'padded': arrays = (values2d, bucket_idx2d);
     kind 'flat': arrays = (values, series_idx, bucket_idx).
+
+    ``pad`` = (s_pad, b_pad): the geometric shape buckets the arrays
+    were padded to at upload (ops.shapes) — run_prepared swaps them
+    into the spec and trims the result, bounding the compile space.
     """
     kind: str
     arrays: tuple
     k: int | None = None
+    pad: tuple | None = None
 
     @property
     def nbytes(self) -> int:
         return sum(getattr(a, "nbytes", 0) for a in self.arrays)
 
 
+def _pad_rows(arr2d: np.ndarray, s_pad: int, fill) -> np.ndarray:
+    s, p = arr2d.shape
+    if s_pad == s:
+        return arr2d
+    out = np.full((s_pad, p), fill, dtype=arr2d.dtype)
+    out[:s] = arr2d
+    return out
+
+
 def prepare_auto(padded, bucket_idx2d: np.ndarray, spec: PipelineSpec,
                  dtype=None, device=None) -> PreparedBatch:
     """Layout-detect + upload a PaddedBatch (the same dispatch rules as
-    :func:`execute_auto`, minus the pallas micro-path)."""
+    :func:`execute_auto`, minus the pallas micro-path). Shapes pad to
+    geometric buckets (ops.shapes): NaN rows for extra series, -1
+    bucket sentinels for extra point columns."""
+    from opentsdb_tpu.ops import shapes
     if dtype is None:
         dtype = pipeline_dtype()
     put = partial(jax.device_put, device=device)
     values2d = np.asarray(padded.values2d)
     counts = np.asarray(padded.counts)
     bucket_idx2d = np.asarray(bucket_idx2d)
+    s, b = spec.num_series, spec.num_buckets
+    s_pad = shapes.shape_bucket(s)
     k = detect_regular_padded(counts, bucket_idx2d, spec.num_buckets)
     if k is not None and spec.ds_function in _DENSE_FNS:
         return PreparedBatch(
-            "dense", (put(jnp.asarray(values2d, dtype=dtype)),), k)
-    cells = values2d.shape[0] * values2d.shape[1] * spec.num_buckets
+            "dense",
+            (put(jnp.asarray(_pad_rows(values2d, s_pad, np.nan),
+                             dtype=dtype)),),
+            k, pad=(s_pad, b))
+    cells = s_pad * values2d.shape[1] * spec.num_buckets
     if ds_mod.padded_supported(spec.ds_function, spec.num_buckets) \
             and cells <= _PADDED_EINSUM_MAX_CELLS:
         return PreparedBatch(
-            "padded", (put(jnp.asarray(values2d, dtype=dtype)),
-                       put(jnp.asarray(bucket_idx2d,
-                                       dtype=jnp.int32))))
+            "padded",
+            (put(jnp.asarray(_pad_rows(values2d, s_pad, np.nan),
+                             dtype=dtype)),
+             put(jnp.asarray(_pad_rows(bucket_idx2d, s_pad, -1),
+                             dtype=jnp.int32))),
+            pad=(s_pad, b))
     values, series_idx, bucket_idx = flatten_padded(
         values2d, bucket_idx2d, counts)
     return prepare_flat(values, series_idx, bucket_idx, spec,
@@ -497,21 +585,39 @@ def prepare_auto(padded, bucket_idx2d: np.ndarray, spec: PipelineSpec,
 def prepare_flat(values: np.ndarray, series_idx: np.ndarray,
                  bucket_idx: np.ndarray, spec: PipelineSpec,
                  dtype=None, device=None) -> PreparedBatch:
-    """Layout-detect + upload a flat point batch."""
+    """Layout-detect + upload a flat point batch, padded to geometric
+    shape buckets (dummy points land on a padded series row and a
+    padded bucket column, both trimmed by run_prepared)."""
+    from opentsdb_tpu.ops import shapes
     if dtype is None:
         dtype = pipeline_dtype()
     put = partial(jax.device_put, device=device)
+    s, b = spec.num_series, spec.num_buckets
+    s_pad = shapes.shape_bucket(s)
     k = detect_dense(spec.num_series, spec.num_buckets,
                      np.asarray(series_idx), np.asarray(bucket_idx),
                      spec.ds_function)
     if k is not None:
         values2d = np.asarray(values).reshape(spec.num_series, -1)
         return PreparedBatch(
-            "dense", (put(jnp.asarray(values2d, dtype=dtype)),), k)
+            "dense",
+            (put(jnp.asarray(_pad_rows(values2d, s_pad, np.nan),
+                             dtype=dtype)),),
+            k, pad=(s_pad, b))
+    n = len(values)
+    s_pad = shapes.shape_bucket(s + 1)
+    b_pad = shapes.shape_bucket(b + 1)
+    n_pad = shapes.shape_bucket(n)
+    v = np.zeros(n_pad, dtype=np.asarray(values).dtype)
+    v[:n] = values
+    si = np.full(n_pad, s_pad - 1, dtype=np.int32)
+    si[:n] = series_idx
+    bi = np.full(n_pad, b_pad - 1, dtype=np.int32)
+    bi[:n] = bucket_idx
     return PreparedBatch(
-        "flat", (put(jnp.asarray(values, dtype=dtype)),
-                 put(jnp.asarray(series_idx, dtype=jnp.int32)),
-                 put(jnp.asarray(bucket_idx, dtype=jnp.int32))))
+        "flat", (put(jnp.asarray(v, dtype=dtype)),
+                 put(jnp.asarray(si)), put(jnp.asarray(bi))),
+        pad=(s_pad, b_pad))
 
 
 def run_prepared(prep: PreparedBatch, bucket_ts: np.ndarray,
@@ -519,10 +625,23 @@ def run_prepared(prep: PreparedBatch, bucket_ts: np.ndarray,
                  rate_options: RateOptions | None = None,
                  dtype=None, device=None
                  ) -> tuple[np.ndarray, np.ndarray]:
-    """Execute a (possibly cached) PreparedBatch -> (result, emit)."""
+    """Execute a (possibly cached) PreparedBatch -> (result, emit),
+    trimming off the shape-bucket padding the prepare step added."""
+    from dataclasses import replace
+    from opentsdb_tpu.ops import shapes
     if dtype is None:
         dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
+    s, b, g = spec.num_series, spec.num_buckets, spec.num_groups
+    if prep.pad is not None:
+        s_pad, b_pad = prep.pad
+        g_pad = shapes.shape_bucket(g + 1)
+        bucket_ts = shapes.pad_bucket_ts(
+            np.asarray(bucket_ts), b_pad)
+        group_ids = shapes.pad_group_ids(np.asarray(group_ids),
+                                         s_pad, g)
+        spec = replace(spec, num_series=s_pad, num_buckets=b_pad,
+                       num_groups=g_pad)
     put = partial(jax.device_put, device=device)
     rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
                    jnp.asarray(ro.reset_value, dtype=dtype))
@@ -540,7 +659,8 @@ def run_prepared(prep: PreparedBatch, bucket_ts: np.ndarray,
         result, emit = run_pipeline(
             prep.arrays[0], prep.arrays[1], prep.arrays[2], bts, gids,
             rate_params, fv, spec)
-    return np.asarray(result), np.asarray(emit)
+    rows = s if spec.emit_raw else g
+    return np.asarray(result)[:rows, :b], np.asarray(emit)[:rows, :b]
 
 
 def execute(batch_values: np.ndarray, series_idx: np.ndarray,

@@ -25,7 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from opentsdb_tpu.ops.interp import _gather_minor, _prev_valid_idx
+from opentsdb_tpu.ops.interp import carry_prev, shift_prev
 
 
 @dataclass(frozen=True)
@@ -66,23 +66,18 @@ class RateOptions:
 def _rate_kernel(grid, bucket_ts, counter: bool, counter_max,
                  reset_value, drop_resets: bool):
     mask = ~jnp.isnan(grid)
-    nb = grid.shape[-1]
-    # index of previous present cell, *strictly* before each cell
-    prev_at = _prev_valid_idx(mask)
-    shifted = jnp.concatenate(
-        [jnp.full(prev_at.shape[:-1] + (1,), -1, prev_at.dtype),
-         prev_at[..., :-1]], axis=-1)
-    has_prev = shifted >= 0
-    safe_prev = jnp.clip(shifted, 0, nb - 1)
-    v_prev = _gather_minor(grid, safe_prev)
+    # previous present cell, *strictly* before each cell: an inclusive
+    # 'nearest present' associative scan shifted one column right (no
+    # gathers — see interp.carry_prev on the B>=14 select-chain cliff)
+    t_cur = bucket_ts[None, :]
+    ts_row = jnp.broadcast_to(t_cur, grid.shape)
+    gz = jnp.where(mask, grid, 0.0)
+    pv, pt, pp = carry_prev((gz, ts_row), mask)
+    v_prev, t_prev, has_prev = shift_prev(
+        (pv, pt, pp), (0.0, 0, False))
     # difference timestamps BEFORE any float cast: bucket_ts arrives as
     # small relative offsets (device_bucket_ts) so integer diffs are
     # exact even on TPU where int64/float64 are unavailable
-    t_cur = bucket_ts[None, :]
-    # fused select chain, not a per-element TPU gather (see
-    # interp._gather_minor)
-    t_prev = _gather_minor(jnp.broadcast_to(t_cur, grid.shape),
-                           safe_prev)
     dt_sec = (t_cur - t_prev).astype(grid.dtype) / 1000.0
     dt_sec = jnp.where(dt_sec > 0, dt_sec, 1.0)
     delta = grid - v_prev

@@ -162,30 +162,25 @@ def _block_boundaries(grid, bucket_ts):
 def _fill_with_boundaries(grid, bucket_ts, mode: str,
                           prev_v, prev_t, prev_p,
                           next_v, next_t, next_p):
-    """fill_gaps with per-series cross-block boundary carries."""
+    """fill_gaps with per-series cross-block boundary carries
+    (associative nearest-present scans — no gathers; see
+    interp.carry_prev on the select-chain cliff)."""
+    from opentsdb_tpu.ops.interp import carry_next, carry_prev
     mask = ~jnp.isnan(grid)
     if mode == Interpolation.ZIM.value:
         return jnp.where(mask, grid, 0.0)
-    nb = grid.shape[-1]
     ts = bucket_ts.astype(grid.dtype)
     ts_row = jnp.broadcast_to(ts[None, :], grid.shape)
-    pidx = _prev_valid_idx(mask)
-    has_lp = pidx >= 0
-    sp = jnp.clip(pidx, 0, nb - 1)
-    v0_local = _gather_minor(grid, sp)
-    t0_local = _gather_minor(ts_row, sp)
-    v0 = jnp.where(has_lp, v0_local, prev_v[:, None])
-    t0 = jnp.where(has_lp, t0_local, prev_t[:, None])
+    gz = jnp.where(mask, grid, 0.0)
+    v0_l, t0_l, has_lp = carry_prev((gz, ts_row), mask)
+    v0 = jnp.where(has_lp, v0_l, prev_v[:, None])
+    t0 = jnp.where(has_lp, t0_l, prev_t[:, None])
     has0 = has_lp | prev_p[:, None]
     if mode == Interpolation.PREV.value:
         return jnp.where(mask, grid, jnp.where(has0, v0, jnp.nan))
-    nidx = _next_valid_idx(mask)
-    has_ln = nidx < nb
-    sn = jnp.clip(nidx, 0, nb - 1)
-    v1_local = _gather_minor(grid, sn)
-    t1_local = _gather_minor(ts_row, sn)
-    v1 = jnp.where(has_ln, v1_local, next_v[:, None])
-    t1 = jnp.where(has_ln, t1_local, next_t[:, None])
+    v1_l, t1_l, has_ln = carry_next((gz, ts_row), mask)
+    v1 = jnp.where(has_ln, v1_l, next_v[:, None])
+    t1 = jnp.where(has_ln, t1_l, next_t[:, None])
     has1 = has_ln | next_p[:, None]
     in_range = has0 & has1
     if mode in (Interpolation.MAX.value, Interpolation.MIN.value):
@@ -202,21 +197,18 @@ def _fill_with_boundaries(grid, bucket_ts, mode: str,
 def _rate_with_boundary(grid, bucket_ts, counter: bool, counter_max,
                         reset_value, drop_resets: bool,
                         carry_v, carry_t, carry_p):
-    """Rate kernel with the previous block's last-present carry."""
+    """Rate kernel with the previous block's last-present carry
+    (associative scans, no gathers)."""
+    from opentsdb_tpu.ops.interp import carry_prev, shift_prev
     mask = ~jnp.isnan(grid)
-    nb = grid.shape[-1]
-    prev_at = _prev_valid_idx(mask)
-    shifted = jnp.concatenate(
-        [jnp.full(prev_at.shape[:-1] + (1,), -1, prev_at.dtype),
-         prev_at[..., :-1]], axis=-1)
-    has_local = shifted >= 0
-    sp = jnp.clip(shifted, 0, nb - 1)
     ts = bucket_ts.astype(grid.dtype)
     ts_row = jnp.broadcast_to(ts[None, :], grid.shape)
-    v_prev = jnp.where(has_local, _gather_minor(grid, sp),
-                       carry_v[:, None])
-    t_prev = jnp.where(has_local, _gather_minor(ts_row, sp),
-                       carry_t[:, None])
+    gz = jnp.where(mask, grid, 0.0)
+    pv, pt, pp = carry_prev((gz, ts_row), mask)
+    v_loc, t_loc, has_local = shift_prev((pv, pt, pp),
+                                         (0.0, 0.0, False))
+    v_prev = jnp.where(has_local, v_loc, carry_v[:, None])
+    t_prev = jnp.where(has_local, t_loc, carry_t[:, None])
     has_prev = has_local | carry_p[:, None]
     dt_sec = (ts[None, :] - t_prev) / 1000.0
     dt_sec = jnp.where(dt_sec > 0, dt_sec, 1.0)

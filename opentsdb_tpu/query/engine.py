@@ -729,6 +729,16 @@ class QueryEngine:
             else:  # max, mimmax
                 grid = np.where(present, maxs, np.nan)
             has_data = present
+            if mesh is None:
+                # pad to the geometric shape buckets NOW (host numpy,
+                # once) so the cached device grids are pre-padded and
+                # warm queries never pay a per-query device pad
+                from opentsdb_tpu.ops import shapes
+                s0, b0 = grid.shape
+                sp = shapes.shape_bucket(s0)
+                bp = shapes.shape_bucket(b0)
+                grid = shapes.pad_2d_host(grid, sp, bp, np.nan)
+                has_data = shapes.pad_2d_host(has_data, sp, bp, False)
             if cache is not None and mesh is None:
                 from opentsdb_tpu.ops.pipeline import put_grid
                 grid, has_data = put_grid(grid, has_data)
@@ -737,7 +747,12 @@ class QueryEngine:
         t2 = time.monotonic()
         spec = PipelineSpec(
             num_series=len(sids), num_buckets=b, num_groups=num_groups,
-            ds_function=fn, agg_name=sub.agg.name,
+            # the grid TAIL never reads ds_function (downsampling
+            # already happened storage-side) but it IS part of the jit
+            # static key — normalize it so sum/avg/min/... grid queries
+            # share one compiled program per shape bucket (and the
+            # server warmup covers them all)
+            ds_function="avg", agg_name=sub.agg.name,
             fill_policy=ds_spec.fill_policy,
             fill_value=ds_spec.fill_value, rate=sub.rate,
             rate_counter=sub.rate_options.counter,
@@ -859,6 +874,15 @@ class QueryEngine:
                 sum_s[cnt_s == 0] = np.nan
                 sum_c[cnt_c == 0] = np.nan
                 gs, gc = sum_s, sum_c
+                if self.tsdb.query_mesh is None:
+                    # pre-pad to the shape buckets (host, once; the
+                    # cache then holds padded device grids — no
+                    # per-query device pads on the warm path)
+                    from opentsdb_tpu.ops import shapes
+                    sp = shapes.shape_bucket(s)
+                    bp = shapes.shape_bucket(b)
+                    gs = shapes.pad_2d_host(gs, sp, bp, np.nan)
+                    gc = shapes.pad_2d_host(gc, sp, bp, np.nan)
                 if cache is not None and num_points:
                     from opentsdb_tpu.ops.pipeline import pipeline_dtype
                     import jax

@@ -114,6 +114,10 @@ class TSDServer:
             backlog=self.tsdb.config.get_int("tsd.network.backlog", 3072),
             reuse_address=self.tsdb.config.get_bool(
                 "tsd.network.reuse_address", True))
+        # pre-compile the common query shape buckets in the background
+        # so first queries of each class run warm (tsd.tpu.warmup)
+        from opentsdb_tpu.tsd.warmup import start_warmup_thread
+        start_warmup_thread(self.tsdb)
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 

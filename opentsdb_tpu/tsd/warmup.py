@@ -1,0 +1,92 @@
+"""Server-start AOT warmup of the common query shape buckets.
+
+First-query latency was r02's worst tail: every new (S, B, G) shape
+pays a multi-second XLA compile mid-query. Shape bucketing
+(ops.shapes) bounds the program space; this module pre-compiles the
+buckets production traffic is most likely to hit — keyed off the
+RESIDENT STORE's actual series count — in a background thread at
+server start, so the first real query of each common class runs warm.
+
+Warmed programs per series bucket: {sum, avg} group aggregation x
+{plain, rate} over an avg downsample at two window sizes (the 1h@1m
+and 24h@5m classes), plus an all-in-one-group variant — the classes
+Grafana-style dashboards issue constantly. Config:
+``tsd.tpu.warmup`` (default true), ``tsd.tpu.warmup.buckets`` (extra
+comma-separated series counts to warm, e.g. for expected growth).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+log = logging.getLogger("warmup")
+
+
+def warmup_shapes(tsdb) -> list[tuple]:
+    """The (S, B, G) bucket combos to pre-compile for this store."""
+    from opentsdb_tpu.ops import shapes
+    counts = {max(tsdb.store.num_series(), 1)}
+    extra = tsdb.config.get_string("tsd.tpu.warmup.buckets", "")
+    for tok in extra.split(","):
+        tok = tok.strip()
+        if tok:
+            counts.add(int(tok))
+    combos = []
+    for s in counts:
+        s_pad = shapes.shape_bucket(s)
+        for b in (shapes.shape_bucket(60), shapes.shape_bucket(288)):
+            for g in (shapes.shape_bucket(2),
+                      shapes.shape_bucket(min(s, 128) + 1)):
+                combos.append((s_pad, b, g))
+    return sorted(set(combos))
+
+
+def run_warmup(tsdb) -> int:
+    """Compile the warm set through the real grid-tail entry (the path
+    every fixed-interval dashboard query takes). Returns the number of
+    programs compiled."""
+    from opentsdb_tpu.ops.pipeline import (PipelineSpec,
+                                           run_pipeline_grid,
+                                           pipeline_dtype)
+    import jax.numpy as jnp
+
+    dtype = pipeline_dtype()
+    compiled = 0
+    t0 = time.monotonic()
+    for s, b, g in warmup_shapes(tsdb):
+        grid = jnp.zeros((s, b), dtype)
+        has = jnp.zeros((s, b), dtype=bool)
+        bts = jnp.arange(b, dtype=jnp.int32) * 60_000
+        gids = jnp.zeros(s, dtype=jnp.int32)
+        rp = (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype))
+        fv = jnp.asarray(float("nan"), dtype)
+        for agg in ("sum", "avg"):
+            for rate in (False, True):
+                spec = PipelineSpec(
+                    num_series=s, num_buckets=b, num_groups=g,
+                    ds_function="avg", agg_name=agg, rate=rate)
+                try:
+                    run_pipeline_grid(grid, has, bts, gids, rp, fv,
+                                      spec)
+                    compiled += 1
+                except Exception:  # noqa: BLE001  pragma: no cover
+                    log.exception("warmup compile failed for "
+                                  "(%d, %d, %d, %s)", s, b, g, agg)
+    log.info("warmup: %d programs in %.1fs", compiled,
+             time.monotonic() - t0)
+    return compiled
+
+
+def start_warmup_thread(tsdb) -> threading.Thread | None:
+    """Kick the warmup off in the background (server start must not
+    block on compiles)."""
+    if not tsdb.config.get_bool("tsd.tpu.warmup", True):
+        return None
+    t = threading.Thread(target=run_warmup, args=(tsdb,),
+                         name="shape-warmup", daemon=True)
+    t.start()
+    return t
