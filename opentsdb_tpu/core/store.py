@@ -254,6 +254,16 @@ class MetricIndex:
             self._tag_rows.append((series_id, tagk, tagv))
         self._dirty = True
 
+    def add_bulk(self, series_ids: Sequence[int],
+                 tags_list: Sequence[Sequence[tuple[int, int]]]) -> None:
+        """Bulk twin of :meth:`add`: one list extend instead of N calls."""
+        self.series_ids.extend(series_ids)
+        self._tag_rows.extend(
+            (sid, tagk, tagv)
+            for sid, tags in zip(series_ids, tags_list)
+            for tagk, tagv in tags)
+        self._dirty = True
+
     def arrays(self) -> tuple[np.ndarray, np.ndarray]:
         """(sids[int64 S], tag_triples[int64 T x 3]) snapshot."""
         if self._dirty:
@@ -315,6 +325,52 @@ class TimeSeriesStore:
             idx.add(sid, key[1])
             self._key_to_sid[key] = sid
             return sid
+
+    def get_or_create_series_bulk(
+            self, metric_id: int,
+            tags_list: Sequence[Sequence[tuple[int, int]]]) -> np.ndarray:
+        """Vectorized get_or_create_series for N series of one metric.
+
+        One lock take and one index update for the whole batch instead
+        of N — the write-path analogue of the reference's batched
+        ``IncomingDataPoints`` row-template reuse
+        (src/core/BatchedDataPoints.java:34). Essential on a 1-CPU host
+        where 100k+ per-series Python calls dominate bulk ingest.
+        """
+        keys = [(metric_id, tuple(sorted(t))) for t in tags_list]
+        out = np.empty(len(keys), dtype=np.int64)
+        missing: list[int] = []
+        get = self._key_to_sid.get
+        for i, key in enumerate(keys):
+            sid = get(key)
+            if sid is None:
+                missing.append(i)
+                out[i] = -1
+            else:
+                out[i] = sid
+        if not missing:
+            return out
+        with self._lock:
+            new_sids: list[int] = []
+            new_tags: list[tuple[tuple[int, int], ...]] = []
+            idx = self._metric_index.get(metric_id)
+            if idx is None:
+                idx = self._metric_index[metric_id] = MetricIndex(metric_id)
+            for i in missing:
+                key = keys[i]
+                sid = self._key_to_sid.get(key)
+                if sid is None:
+                    sid = len(self._series)
+                    shard = self._shard_for(metric_id, key[1])
+                    self._series.append(SeriesRecord(
+                        sid, metric_id, key[1], shard, SeriesBuffer()))
+                    self._key_to_sid[key] = sid
+                    new_sids.append(sid)
+                    new_tags.append(key[1])
+                out[i] = sid
+            if new_sids:
+                idx.add_bulk(new_sids, new_tags)
+        return out
 
     def _shard_for(self, metric_id: int,
                    tags: tuple[tuple[int, int], ...]) -> int:

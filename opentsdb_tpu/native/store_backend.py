@@ -64,6 +64,8 @@ def load_library():
         lib.tss_destroy.argtypes = [ctypes.c_void_p]
         lib.tss_add_series.argtypes = [ctypes.c_void_p]
         lib.tss_add_series.restype = ctypes.c_int64
+        lib.tss_add_series_n.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.tss_add_series_n.restype = ctypes.c_int64
         lib.tss_series_count.argtypes = [ctypes.c_void_p]
         lib.tss_series_count.restype = ctypes.c_int64
         lib.tss_append.argtypes = [ctypes.c_void_p, ctypes.c_int64,
@@ -234,6 +236,60 @@ class NativeTimeSeriesStore:
             idx.add(native_sid, key[1])
             self._key_to_sid[key] = native_sid
             return native_sid
+
+    def get_or_create_series_bulk(self, metric_id: int,
+                                  tags_list) -> np.ndarray:
+        """Vectorized get_or_create_series: one native bulk allocation
+        (``tss_add_series_n``) + one directory/index update per batch
+        (see the Python backend's docstring for rationale)."""
+        keys = [(metric_id, tuple(sorted(t))) for t in tags_list]
+        out = np.empty(len(keys), dtype=np.int64)
+        missing: list[int] = []
+        get = self._key_to_sid.get
+        for i, key in enumerate(keys):
+            sid = get(key)
+            if sid is None:
+                missing.append(i)
+                out[i] = -1
+            else:
+                out[i] = sid
+        if not missing:
+            return out
+        with self._lock:
+            # re-check under the lock, then allocate the still-missing
+            # contiguously in one native call
+            fresh = [i for i in missing
+                     if self._key_to_sid.get(keys[i]) is None]
+            # dedupe identical keys inside the batch (first wins)
+            seen: dict[tuple, int] = {}
+            alloc: list[int] = []
+            for i in fresh:
+                if keys[i] not in seen:
+                    seen[keys[i]] = -1
+                    alloc.append(i)
+            if alloc:
+                first = self._lib.tss_add_series_n(self._h, len(alloc))
+                assert first == len(self._records)
+                idx = self._metric_index.get(metric_id)
+                if idx is None:
+                    idx = self._metric_index[metric_id] = MetricIndex(
+                        metric_id)
+                new_sids: list[int] = []
+                new_tags: list[tuple[tuple[int, int], ...]] = []
+                for j, i in enumerate(alloc):
+                    sid = first + j
+                    key = keys[i]
+                    self._records.append(_NativeSeriesRecord(
+                        sid, metric_id, key[1],
+                        hash((metric_id, key[1])) % self.num_shards,
+                        _NativeSeriesView(self, sid)))
+                    self._key_to_sid[key] = sid
+                    new_sids.append(sid)
+                    new_tags.append(key[1])
+                idx.add_bulk(new_sids, new_tags)
+            for i in missing:
+                out[i] = self._key_to_sid[keys[i]]
+        return out
 
     def append(self, series_id: int, ts_ms: int, value: float,
                is_int: bool = False) -> None:

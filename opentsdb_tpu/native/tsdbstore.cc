@@ -149,6 +149,17 @@ int64_t tss_add_series(void* h) {
   return (int64_t)s->series.size() - 1;
 }
 
+// Bulk allocation: n new contiguous series ids, one lock take.
+// Returns the first new id.
+int64_t tss_add_series_n(void* h, int64_t n) {
+  Store* s = static_cast<Store*>(h);
+  std::unique_lock<std::shared_mutex> lock(s->dir_mu);
+  int64_t first = (int64_t)s->series.size();
+  s->series.reserve(s->series.size() + (size_t)n);
+  for (int64_t i = 0; i < n; ++i) s->series.push_back(new SeriesBuffer());
+  return first;
+}
+
 int64_t tss_series_count(void* h) {
   Store* s = static_cast<Store*>(h);
   std::shared_lock<std::shared_mutex> lock(s->dir_mu);

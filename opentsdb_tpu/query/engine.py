@@ -838,7 +838,8 @@ class QueryEngine:
             if cache is not None:
                 from opentsdb_tpu.query.device_cache import \
                     array_digest
-                ckey = ("avgdiv", id(sum_store), id(cnt_store),
+                ckey = ("avgdiv", _store_id(sum_store),
+                        _store_id(cnt_store),
                         array_digest(np.ascontiguousarray(sids)),
                         tsq.start_ms, tsq.end_ms, t0_ms,
                         ds_spec.interval_ms, b)

@@ -45,6 +45,10 @@ def _is_query_path(path: str) -> bool:
     return bool(parts) and parts[0] in ("query", "q")
 
 
+class IdleTimeout(Exception):
+    """A connection sat idle past ``tsd.core.socket.timeout``."""
+
+
 class ConnectionManager:
     """(ref: src/tsd/ConnectionManager.java:37)"""
 
@@ -54,6 +58,7 @@ class ConnectionManager:
         self.total_connections = 0
         self.rejected_connections = 0
         self.exceptions_unknown = 0
+        self.idle_closed = 0
 
     def accept(self) -> bool:
         if self.max_connections and \
@@ -74,6 +79,8 @@ class ConnectionManager:
                          self.total_connections, type="total")
         collector.record("connectionmgr.exceptions",
                          self.rejected_connections, type="rejected")
+        collector.record("connectionmgr.connections", self.idle_closed,
+                         type="idle_closed")
 
 
 class TSDServer:
@@ -105,6 +112,24 @@ class TSDServer:
         self._query_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=tsdb.config.get_int("tsd.query.workers", 8),
             thread_name_prefix="tsd-query")
+        # idle-connection reaper (ref: PipelineFactory.java:169 installs
+        # an IdleStateHandler with tsd.core.socket.timeout seconds of
+        # all-idle): every await on the client — reads AND backpressure
+        # drains — carries this deadline, so a stalled or wedged client
+        # cannot hold a connection (or a streaming worker) forever.
+        # 0 (the reference default) disables reaping.
+        self.socket_timeout_s = tsdb.config.get_int(
+            "tsd.core.socket.timeout", 0)
+
+    async def _on_client(self, coro):
+        """Await a client-facing read/drain under the idle deadline."""
+        if self.socket_timeout_s <= 0:
+            return await coro
+        try:
+            return await asyncio.wait_for(coro, self.socket_timeout_s)
+        except asyncio.TimeoutError:
+            self.connections.idle_closed += 1
+            raise IdleTimeout() from None
 
     # ------------------------------------------------------------------
 
@@ -147,7 +172,7 @@ class TSDServer:
             return
         try:
             # protocol sniff (ref: DetectHttpOrRpc.decode :134)
-            first = await reader.read(4)
+            first = await self._on_client(reader.read(4))
             if not first:
                 return
             if first in _HTTP_METHODS or first[:3] == b"GET":
@@ -156,6 +181,9 @@ class TSDServer:
                 await self._serve_telnet(first, reader, writer)
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
+        except IdleTimeout:
+            LOG.info("closing idle connection (tsd.core.socket.timeout="
+                     "%ds)", self.socket_timeout_s)
         except TelnetServerShutdown:
             writer.write(b"Cleanup complete, shutting down.\n")
             await writer.drain()
@@ -180,7 +208,7 @@ class TSDServer:
         while True:
             line_end = buffer.find(b"\n")
             if line_end < 0:
-                chunk = await reader.read(4096)
+                chunk = await self._on_client(reader.read(4096))
                 if not chunk:
                     break
                 buffer += chunk
@@ -203,7 +231,7 @@ class TSDServer:
                         writer.write(b"auth_fail\n")
                 else:
                     writer.write(b"auth_fail\n")
-                await writer.drain()
+                await self._on_client(writer.drain())
                 continue
             try:
                 response = self.telnet_router.execute(line,
@@ -212,7 +240,7 @@ class TSDServer:
                 return
             if response:
                 writer.write(response.encode() + b"\n")
-                await writer.drain()
+                await self._on_client(writer.drain())
 
     # -- http ----------------------------------------------------------
 
@@ -222,7 +250,7 @@ class TSDServer:
         while keep_alive:
             # read until end of headers
             while b"\r\n\r\n" not in buffer:
-                chunk = await reader.read(65536)
+                chunk = await self._on_client(reader.read(65536))
                 if not chunk:
                     return
                 buffer += chunk
@@ -245,7 +273,7 @@ class TSDServer:
                     "HTTP/1.1", False)
                 return
             while len(buffer) < length:
-                chunk = await reader.read(65536)
+                chunk = await self._on_client(reader.read(65536))
                 if not chunk:
                     return
                 buffer += chunk
@@ -431,8 +459,8 @@ class TSDServer:
                     continue
                 writer.write(f"{len(chunk):x}\r\n".encode()
                              + chunk + b"\r\n")
-                await writer.drain()
+                await self._on_client(writer.drain())
             writer.write(b"0\r\n\r\n")
         else:
             writer.write(response.body)
-        await writer.drain()
+        await self._on_client(writer.drain())

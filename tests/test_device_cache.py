@@ -138,6 +138,37 @@ class TestAvgRollupCache:
         r3 = t.execute_query(q)
         assert [x.dps for x in r3] != [x.dps for x in cold]
 
+    def test_avgdiv_key_uses_instance_id_not_address(self):
+        """Regression: the avgdiv cache key must be built from the
+        stores' monotonic instance_ids (_store_id), not id(store) —
+        id() can alias a freed store whose address was reused with a
+        coincidentally equal (points_written, mutation_epoch)."""
+        t = _tsdb(**{"tsd.rollups.enable": "true"})
+        for j in range(30):
+            t.add_aggregate_point("m", BASE + j * 60, float(j),
+                                  {"host": "h0"}, False, "1m", "sum")
+            t.add_aggregate_point("m", BASE + j * 60, 3.0,
+                                  {"host": "h0"}, False, "1m", "count")
+        cache = t.device_grid_cache
+        seen = []
+        orig_get = cache.get
+
+        def spy(key, version):
+            if key[0] == "avgdiv":
+                seen.append(key)
+            return orig_get(key, version)
+
+        cache.get = spy
+        try:
+            t.execute_query(_q("sum", "5m-avg", end=BASE + 1800))
+        finally:
+            cache.get = orig_get
+        assert seen, "avg-tier query did not consult the avgdiv cache"
+        sum_store = t.rollup_store.tier("1m", "sum")
+        cnt_store = t.rollup_store.tier("1m", "count")
+        assert seen[0][1] == sum_store.instance_id
+        assert seen[0][2] == cnt_store.instance_id
+
 
 class TestTierHasData:
     def test_emptied_tier_stops_winning_selection(self):

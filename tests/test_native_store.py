@@ -58,6 +58,30 @@ class TestNativeStore:
                           np.arange(1000.0))
         assert len(store.series(sid).buffer) == 1000
 
+    @pytest.mark.parametrize("backend", ["native", "python"])
+    def test_bulk_series_creation(self, backend):
+        from opentsdb_tpu.core.store import TimeSeriesStore
+        store = (store_backend.NativeTimeSeriesStore(num_shards=8)
+                 if backend == "native" else
+                 TimeSeriesStore(num_shards=8))
+        # pre-create one so the bulk path mixes hits and misses; also
+        # include an in-batch duplicate (must resolve to one sid)
+        pre = store.get_or_create_series(7, [(1, 3)])
+        tags_list = [((1, 3),), ((1, 4),), ((2, 5), (1, 4)),
+                     ((1, 4),), ((1, 6),)]
+        sids = store.get_or_create_series_bulk(7, tags_list)
+        assert sids[0] == pre
+        assert sids[1] == sids[3]
+        assert len(set(sids.tolist())) == 4
+        # identity agrees with the scalar path, tag order normalized
+        assert store.get_or_create_series(7, [(1, 4), (2, 5)]) == sids[2]
+        # index sees every new series exactly once
+        assert sorted(store.series_ids_for_metric(7).tolist()) == \
+            sorted(set(sids.tolist()))
+        # a second bulk call is all hits
+        np.testing.assert_array_equal(
+            store.get_or_create_series_bulk(7, tags_list), sids)
+
     def test_materialize_matches_python(self, store):
         from opentsdb_tpu.core.store import TimeSeriesStore
         pystore = TimeSeriesStore(num_shards=8)

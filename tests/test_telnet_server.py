@@ -165,6 +165,47 @@ class TestServerSockets:
 
         asyncio.run(scenario())
 
+    def test_idle_connection_reaped(self, tsdb):
+        """A stalled client is disconnected after
+        tsd.core.socket.timeout seconds (ref: the IdleStateHandler
+        installed at PipelineFactory.java:169)."""
+        tsdb.config.override_config("tsd.core.socket.timeout", "1")
+
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                # stalled mid-request: sends a partial HTTP head, then
+                # nothing — without the reaper this holds the
+                # connection (and a handler task) forever
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(b"GET /api/version HTT")
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(), 5)
+                assert raw == b""  # server closed on us
+                assert server.connections.idle_closed == 1
+                assert server.connections.open_connections == 0
+
+                # a connection that never sends a byte is reaped too
+                reader2, writer2 = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                raw2 = await asyncio.wait_for(reader2.read(), 5)
+                assert raw2 == b""
+                assert server.connections.idle_closed == 2
+
+                # an active client on the same server is unaffected
+                reader3, writer3 = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer3.write(b"version\n")
+                await writer3.drain()
+                line = await asyncio.wait_for(reader3.readline(), 5)
+                assert b"opentsdb_tpu version" in line
+                writer3.close()
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
     def test_telnet_batched_lines(self, tsdb):
         async def scenario():
             server, port = await self._start(tsdb)
