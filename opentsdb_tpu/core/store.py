@@ -421,6 +421,44 @@ class TimeSeriesStore:
             self.mutation_epoch += 1
         return deleted
 
+    def repair_series(self, series_id: int, min_ts: int, max_ts: int,
+                      drop_nonfinite: bool = True) -> int:
+        """fsck in-place repair (ref: Fsck.java:99-119): drop points
+        with out-of-range timestamps and (optionally) non-finite
+        values. Returns points removed."""
+        buf = self._series[series_id].buffer
+        with buf.lock:
+            buf._ensure_sorted_locked()
+            m = buf.n
+            keep = (buf.ts[:m] >= min_ts) & (buf.ts[:m] <= max_ts)
+            if drop_nonfinite:
+                keep &= np.isfinite(buf.vals[:m])
+            kept = int(keep.sum())
+            if kept != m:
+                buf.ts[:kept] = buf.ts[:m][keep]
+                buf.vals[:kept] = buf.vals[:m][keep]
+                buf.is_int[:kept] = buf.is_int[:m][keep]
+                buf.n = kept
+        removed = m - kept
+        if removed:
+            self.mutation_epoch += 1
+        return removed
+
+    def patch_value(self, series_id: int, ts_ms: int, value: float,
+                    is_int: bool = False) -> None:
+        """fsck in-place repair: overwrite the value at an exact
+        timestamp (raises KeyError when absent)."""
+        buf = self._series[series_id].buffer
+        with buf.lock:
+            buf._ensure_sorted_locked()
+            i = int(np.searchsorted(buf.ts[:buf.n], ts_ms))
+            if i >= buf.n or buf.ts[i] != ts_ms:
+                raise KeyError(f"series {series_id} has no point at "
+                               f"{ts_ms}")
+            buf.vals[i] = value
+            buf.is_int[i] = is_int
+        self.mutation_epoch += 1
+
     # -- read path --------------------------------------------------------
 
     def series(self, series_id: int) -> SeriesRecord:

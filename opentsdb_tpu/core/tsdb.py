@@ -61,6 +61,12 @@ class TSDB:
                 logging.getLogger(__name__).warning(
                     "could not reset JAX backends; tsd.tpu.platform=%s "
                     "may not take effect", platform)
+        # multi-host (DCN) rendezvous must precede any backend touch
+        # (ref-analogue: multi-TSD scale-out, RpcManager.java:274-327)
+        if self.config.get_string("tsd.mesh.coordinator", ""):
+            from opentsdb_tpu.parallel.distributed import \
+                initialize_from_config
+            initialize_from_config(self.config)
         const.set_salt_width(self.config.get_int("tsd.storage.salt.width", 0))
         const.set_salt_buckets(
             self.config.get_int("tsd.storage.salt.buckets", 20))

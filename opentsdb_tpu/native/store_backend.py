@@ -76,6 +76,14 @@ def load_library():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.tss_points_written.argtypes = [ctypes.c_void_p]
         lib.tss_points_written.restype = ctypes.c_int64
+        lib.tss_repair_series.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int64, ctypes.c_int64,
+                                          ctypes.c_int64, ctypes.c_int]
+        lib.tss_repair_series.restype = ctypes.c_int64
+        lib.tss_patch_value.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_int64, ctypes.c_double,
+                                        ctypes.c_int]
+        lib.tss_patch_value.restype = ctypes.c_int
         lib.tss_append_grid.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -374,6 +382,31 @@ class NativeTimeSeriesStore:
         if n < 0:
             raise IndexError("invalid series id in append_grid")
         return int(n)
+
+    def repair_series(self, series_id: int, min_ts: int, max_ts: int,
+                      drop_nonfinite: bool = True) -> int:
+        """fsck in-place repair: drop out-of-range timestamps and
+        (optionally) non-finite values. Returns points removed."""
+        n = self._lib.tss_repair_series(self._h, series_id, min_ts,
+                                        max_ts, int(drop_nonfinite))
+        if n < 0:
+            raise IndexError(f"no such series {series_id}")
+        if n:
+            self.mutation_epoch += 1
+        return int(n)
+
+    def patch_value(self, series_id: int, ts_ms: int, value: float,
+                    is_int: bool = False) -> None:
+        """fsck in-place repair: overwrite the value at an exact
+        timestamp (raises KeyError when absent)."""
+        rc = self._lib.tss_patch_value(self._h, series_id, ts_ms,
+                                       float(value), int(is_int))
+        if rc == -1:
+            raise IndexError(f"no such series {series_id}")
+        if rc == -2:
+            raise KeyError(f"series {series_id} has no point at "
+                           f"{ts_ms}")
+        self.mutation_epoch += 1
 
     def count_range(self, series_ids: Sequence[int], start_ms: int,
                     end_ms: int) -> np.ndarray:

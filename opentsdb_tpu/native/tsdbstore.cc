@@ -16,6 +16,7 @@
 #include <algorithm>
 #include <atomic>
 #include <charconv>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -188,6 +189,55 @@ int tss_append_many(void* h, int64_t sid, int64_t n, const int64_t* ts,
 
 int64_t tss_points_written(void* h) {
   return static_cast<Store*>(h)->points_written.load();
+}
+
+// fsck in-place repair (ref: Fsck.java:99-119 repairing bad values /
+// timestamps in storage): drop points whose timestamp falls outside
+// [min_ts, max_ts], and — when drop_nonfinite — points whose value is
+// NaN/Inf. Returns the number of points removed, or -1 on a bad sid.
+int64_t tss_repair_series(void* h, int64_t sid, int64_t min_ts,
+                          int64_t max_ts, int drop_nonfinite) {
+  Store* s = static_cast<Store*>(h);
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
+  std::lock_guard<std::mutex> lock(buf->mu);
+  buf->ensure_sorted_locked();
+  const size_t n = buf->ts.size();
+  size_t w = 0;
+  for (size_t i = 0; i < n; ++i) {
+    bool ok = buf->ts[i] >= min_ts && buf->ts[i] <= max_ts;
+    if (ok && drop_nonfinite && !std::isfinite(buf->vals[i])) ok = false;
+    if (ok) {
+      if (w != i) {
+        buf->ts[w] = buf->ts[i];
+        buf->vals[w] = buf->vals[i];
+        buf->is_int[w] = buf->is_int[i];
+      }
+      ++w;
+    }
+  }
+  buf->ts.resize(w);
+  buf->vals.resize(w);
+  buf->is_int.resize(w);
+  return (int64_t)(n - w);
+}
+
+// fsck in-place repair: overwrite the value stored at an exact
+// timestamp. Returns 0 on success, -1 on a bad sid, -2 when no point
+// has that timestamp.
+int tss_patch_value(void* h, int64_t sid, int64_t ts_ms, double value,
+                    int is_int) {
+  Store* s = static_cast<Store*>(h);
+  SeriesBuffer* buf = s->lookup(sid);
+  if (!buf) return -1;
+  std::lock_guard<std::mutex> lock(buf->mu);
+  buf->ensure_sorted_locked();
+  auto it = std::lower_bound(buf->ts.begin(), buf->ts.end(), ts_ms);
+  if (it == buf->ts.end() || *it != ts_ms) return -2;
+  size_t i = it - buf->ts.begin();
+  buf->vals[i] = value;
+  buf->is_int[i] = (uint8_t)is_int;
+  return 0;
 }
 
 // Bulk grid write (the rollup job's output path): for every row i,

@@ -26,9 +26,15 @@ the analogue of region-aware routing in asynchbase.
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+LOG = logging.getLogger(__name__)
+
+_initialized = False
 
 
 def initialize(coordinator_address: str | None = None,
@@ -40,10 +46,85 @@ def initialize(coordinator_address: str | None = None,
     are auto-detected from the environment, so ``initialize()`` with no
     arguments is the common call.
     """
+    global _initialized
     if num_processes is not None and num_processes <= 1:
         return
     jax.distributed.initialize(coordinator_address, num_processes,
                                process_id)
+    _initialized = True
+
+
+def initialize_from_config(config) -> bool:
+    """The TSD launcher's DCN entry point: when
+    ``tsd.mesh.coordinator`` is configured, join the multi-process
+    rendezvous before any JAX backend touch. Idempotent; returns True
+    when running multi-process.
+
+    Launch (one line per host, ref-analogue: many stateless TSDs
+    behind one LB, RpcManager.java:274-327)::
+
+        tsdb tsd --tsd.mesh.coordinator=host0:9255 \\
+                 --tsd.mesh.num_processes=2 --tsd.mesh.process_id=0 \\
+                 --tsd.query.mesh=auto
+
+    On TPU pods num_processes/process_id may be omitted (the TPU
+    runtime provides them); on CPU/GPU fleets both are required.
+    """
+    global _initialized
+    coordinator = config.get_string("tsd.mesh.coordinator", "")
+    if not coordinator:
+        return False
+    if _initialized:
+        return True
+    kwargs: dict = {"coordinator_address": coordinator}
+    num_processes = config.get_int("tsd.mesh.num_processes", 0)
+    process_id = config.get_int("tsd.mesh.process_id", -1)
+    if num_processes > 0:
+        kwargs["num_processes"] = num_processes
+    if process_id >= 0:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    LOG.info("jax.distributed up: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(),
+             len(jax.devices()))
+    return True
+
+
+def is_distributed() -> bool:
+    return _initialized
+
+
+def put_global(x, sharding):
+    """Upload a host array onto a (possibly multi-process) sharding.
+
+    Single-process shardings take the plain ``jax.device_put`` fast
+    path. Multi-process shardings use ``jax.make_array_from_callback``
+    — each process supplies its addressable shards from its own
+    (identical, SPMD) host copy. device_put would instead run a
+    cross-process value-equality check that (a) allgathers every upload
+    over DCN and (b) rejects NaN padding (NaN != NaN), which the
+    query grids are full of.
+    """
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    xnp = np.asarray(x)
+    return jax.make_array_from_callback(xnp.shape, sharding,
+                                        lambda idx: xnp[idx])
+
+
+def to_host(x) -> np.ndarray:
+    """Bring a device array to host numpy, gathering across processes
+    when its shards span hosts (single-process: plain np.asarray).
+    Every process receives the full array — the SPMD analogue of each
+    TSD serializing the complete query response."""
+    if hasattr(x, "is_fully_addressable") and \
+            not x.is_fully_addressable and \
+            not x.sharding.is_fully_replicated:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x,
+                                                            tiled=True))
+    return np.asarray(x)
 
 
 def multihost_device_grid(devices=None,

@@ -32,6 +32,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from opentsdb_tpu.parallel.distributed import to_host as _to_host
+
 from opentsdb_tpu.ops import downsample as ds_mod
 from opentsdb_tpu.ops.aggregators import Interpolation
 from opentsdb_tpu.ops import aggregators as aggs_mod
@@ -875,15 +877,26 @@ def execute_blocked_sharded(mesh: Mesh, batch_values: np.ndarray,
                 gids_full, s_pad, g, ds_shards, dt_shards)
         return sb
 
+    # explicit global uploads so the path works when the mesh spans
+    # processes (plain jnp.asarray/jit auto-put would hit device_put's
+    # cross-process value check — see distributed.put_global)
+    from jax.sharding import NamedSharding
+    from opentsdb_tpu.parallel.distributed import put_global
+    sh3 = NamedSharding(mesh, P("series", "time", None))
+    sht = NamedSharding(mesh, P("time"))
+    shs = NamedSharding(mesh, P("series"))
+
     def carry_dev(c):
-        return tuple(jnp.asarray(np.asarray(x)) for x in c)
+        return tuple(put_global(np.asarray(x), shs) for x in c)
 
     def run(i, blk, which, rate_carry, prev_carry, next_carry):
         sb = shard_block(i, blk)
         return which(
-            jnp.asarray(sb.values, dtype), jnp.asarray(sb.series_idx),
-            jnp.asarray(sb.bucket_idx), jnp.asarray(sb.bucket_ts),
-            jnp.asarray(gids_full), rate_params, fv,
+            put_global(np.asarray(sb.values, np_dtype), sh3),
+            put_global(sb.series_idx, sh3),
+            put_global(sb.bucket_idx, sh3),
+            put_global(sb.bucket_ts, sht),
+            put_global(gids_full, shs), rate_params, fv,
             carry_dev(rate_carry), carry_dev(prev_carry),
             carry_dev(next_carry))
 
@@ -903,10 +916,10 @@ def execute_blocked_sharded(mesh: Mesh, batch_values: np.ndarray,
             _, _, pre_last, _, post_first = run(i, blk, sstep,
                                                 rate_carry, empty,
                                                 empty)
-            firsts.append(tuple(np.asarray(x) for x in post_first))
+            firsts.append(tuple(_to_host(x) for x in post_first))
             if spec.rate:
                 rate_carry = _merge_carry(
-                    tuple(np.asarray(x) for x in pre_last), rate_carry)
+                    tuple(_to_host(x) for x in pre_last), rate_carry)
         nc = empty
         for i in range(n_blocks - 1, -1, -1):
             next_carries[i] = nc
@@ -922,13 +935,13 @@ def execute_blocked_sharded(mesh: Mesh, batch_values: np.ndarray,
             i, blk, step, rate_carry, prev_carry, next_carries[i])
         b0, b1 = blk[0], blk[1]
         nb = b1 - b0
-        out[:, b0:b1] = np.asarray(res)[:g, :nb]
-        emit_out[:, b0:b1] = np.asarray(emit)[:g, :nb]
+        out[:, b0:b1] = _to_host(res)[:g, :nb]
+        emit_out[:, b0:b1] = _to_host(emit)[:g, :nb]
         if spec.rate:
             rate_carry = _merge_carry(
-                tuple(np.asarray(x) for x in pre_last), rate_carry)
+                tuple(_to_host(x) for x in pre_last), rate_carry)
         prev_carry = _merge_carry(
-            tuple(np.asarray(x) for x in post_last), prev_carry)
+            tuple(_to_host(x) for x in post_last), prev_carry)
     return out, emit_out
 
 
@@ -942,14 +955,14 @@ def sharded_device_args(mesh: Mesh, batch: ShardedBatch, dtype):
     the single-device prepared-batch cache)."""
     from jax.sharding import NamedSharding
     from opentsdb_tpu.ops.pipeline import device_bucket_ts
-    put = jax.device_put
+    from opentsdb_tpu.parallel.distributed import put_global as put
     s3 = NamedSharding(mesh, P("series", "time", None))
-    return (put(jnp.asarray(batch.values, dtype), s3),
-            put(jnp.asarray(batch.series_idx), s3),
-            put(jnp.asarray(batch.bucket_idx), s3),
-            put(jnp.asarray(device_bucket_ts(batch.bucket_ts)),
+    return (put(np.asarray(batch.values, np.dtype(dtype)), s3),
+            put(batch.series_idx, s3),
+            put(batch.bucket_idx, s3),
+            put(device_bucket_ts(batch.bucket_ts),
                 NamedSharding(mesh, P("time"))),
-            put(jnp.asarray(batch.group_ids),
+            put(batch.group_ids,
                 NamedSharding(mesh, P("series"))))
 
 
@@ -967,8 +980,8 @@ def run_sharded_device(mesh: Mesh, spec: PipelineSpec, device_args,
                    jnp.asarray(ro.reset_value, dtype))
     result, emit = step(*device_args, rate_params,
                         jnp.asarray(spec.fill_value, dtype))
-    result = np.asarray(result)
-    emit = np.asarray(emit)
+    result = _to_host(result)
+    emit = _to_host(emit)
     b = spec.num_buckets
     return result[:num_groups, :b], emit[:num_groups, :b]
 
@@ -1093,10 +1106,10 @@ def prepare_sharded_grid(mesh: Mesh, grid: np.ndarray,
     h = np.zeros((s_pad, b_pad), dtype=bool)
     h[:s, :b] = has_data
     bts = _pad_bts_tail(np.asarray(bucket_ts, dtype=np.int64), b_pad)
-    put = jax.device_put
+    from opentsdb_tpu.parallel.distributed import put_global as put
     s2 = NamedSharding(mesh, P("series", "time"))
-    args = (put(jnp.asarray(g), s2), put(jnp.asarray(h), s2),
-            put(jnp.asarray(device_bucket_ts(bts)),
+    args = (put(g, s2), put(h, s2),
+            put(device_bucket_ts(bts),
                 NamedSharding(mesh, P("time"))))
     return args, s_loc, b_loc, s_pad
 
@@ -1105,10 +1118,10 @@ def sharded_grid_gids(mesh: Mesh, group_ids: np.ndarray, s_pad: int,
                       num_groups: int):
     """Per-query group-id upload (tiny [S_pad] vector)."""
     from jax.sharding import NamedSharding
+    from opentsdb_tpu.parallel.distributed import put_global
     gids = np.full(s_pad, num_groups, dtype=np.int32)
     gids[:len(group_ids)] = group_ids
-    return jax.device_put(jnp.asarray(gids),
-                          NamedSharding(mesh, P("series")))
+    return put_global(gids, NamedSharding(mesh, P("series")))
 
 
 def run_sharded_grid(mesh: Mesh, spec: PipelineSpec, device_args,
@@ -1125,8 +1138,8 @@ def run_sharded_grid(mesh: Mesh, spec: PipelineSpec, device_args,
                    jnp.asarray(ro.reset_value, dtype))
     result, emit = step(*device_args, rate_params,
                         jnp.asarray(spec.fill_value, dtype))
-    result = np.asarray(result)
-    emit = np.asarray(emit)
+    result = _to_host(result)
+    emit = _to_host(emit)
     b = spec.num_buckets
     rows = spec.num_series if spec.emit_raw else num_groups
     return result[:rows, :b], emit[:rows, :b]

@@ -67,6 +67,11 @@ def run_fsck(tsdb, fix: bool = False, workers: int = 8) -> FsckReport:
                    for sids in shards.values()]
         for fut in futures:
             report.merge(fut.result())
+    if fix and report.fixed and getattr(tsdb, "data_dir", ""):
+        # make repairs durable (ref: Fsck writes repairs back to
+        # HBase): snapshot the repaired store and truncate the WAL so
+        # replay-on-restart cannot resurrect the dropped points
+        tsdb.flush()
     return report
 
 
@@ -130,28 +135,15 @@ def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
         bad_vals = int(np.sum(~np.isfinite(raw_vals)))
         if bad_vals:
             report.error(f"{name}: {bad_vals} non-finite value(s)",
-                         fixed=fix and not native)
-            if fix and not native:
-                with buf.lock:
-                    m = buf.n
-                    keep = np.isfinite(buf.vals[:m])
-                    kept = int(keep.sum())
-                    buf.ts[:kept] = buf.ts[:m][keep]
-                    buf.vals[:kept] = buf.vals[:m][keep]
-                    buf.is_int[:kept] = buf.is_int[:m][keep]
-                    buf.n = kept
+                         fixed=fix)
         # timestamp range (ref: bad row keys / timestamps)
         bad_ts = int(np.sum((raw_ts <= 0) | (raw_ts > MAX_VALID_MS)))
         if bad_ts:
             report.error(f"{name}: {bad_ts} timestamp(s) out of range",
-                         fixed=fix and not native)
-            if fix and not native:
-                with buf.lock:
-                    m = buf.n
-                    keep = (buf.ts[:m] > 0) & (buf.ts[:m] <= MAX_VALID_MS)
-                    kept = int(keep.sum())
-                    buf.ts[:kept] = buf.ts[:m][keep]
-                    buf.vals[:kept] = buf.vals[:m][keep]
-                    buf.is_int[:kept] = buf.is_int[:m][keep]
-                    buf.n = kept
+                         fixed=fix)
+        if fix and (bad_vals or bad_ts):
+            # unified in-place repair on either backend (native:
+            # tss_repair_series; ref: Fsck.java:99-119)
+            tsdb.store.repair_series(sid, 1, MAX_VALID_MS,
+                                     drop_nonfinite=True)
     return report

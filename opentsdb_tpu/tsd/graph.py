@@ -172,6 +172,25 @@ def _render(router, request, tsq, results):
             target = ax2
         target.plot(xs, ys, label=label, linewidth=1, **style_kw)
 
+    # annotation markers: dashed vertical lines at each note's start
+    # (ref: Plot.java renders annotations as gnuplot arrows/labels on
+    # the legacy UI's charts)
+    seen_notes = set()
+    for r in results:
+        for a in list(getattr(r, "annotations", [])) + \
+                list(getattr(r, "global_annotations", [])):
+            key = (a.tsuid, a.start_time)
+            if key in seen_notes:
+                continue
+            seen_notes.add(key)
+            ax.axvline(a.start_time, color="#996515", linestyle="--",
+                       linewidth=0.9, alpha=0.8)
+            if a.description:
+                ax.annotate(a.description[:24], xy=(a.start_time, 1.0),
+                            xycoords=("data", "axes fraction"),
+                            fontsize=7, color="#996515", rotation=90,
+                            va="top", ha="right")
+
     if request.param("title"):
         ax.set_title(request.param("title"))
     if request.param("ylabel"):

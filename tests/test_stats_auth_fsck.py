@@ -370,6 +370,70 @@ class TestFsck:
             run_fsck(tsdb, fix=True)
             assert run_fsck(tsdb).errors == 0
 
+    @pytest.mark.parametrize("backend", ["native", "memory"])
+    def test_repairs_corruption_in_place_both_backends(self, backend):
+        """--fix repairs non-finite values and out-of-range timestamps
+        in storage on EITHER backend (native: tss_repair_series; ref:
+        Fsck.java:99-119). Good points survive the repair."""
+        from opentsdb_tpu import TSDB, Config
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           "tsd.storage.backend": backend}))
+        t.add_point("m", 1356998400, 1.0, {"host": "a"})
+        t.add_point("m", 1356998460, 2.0, {"host": "a"})
+        sid = int(t.store.series_ids_for_metric(
+            t.uids.metrics.get_id("m"))[0])
+        # corruption injection below the validation layer (the write
+        # RPC would reject these)
+        t.store.append(sid, 1356998520_000, float("nan"))
+        t.store.append(sid, 1356998580_000, float("inf"))
+        t.store.append(sid, -5, 7.0)
+        report = run_fsck(t)
+        assert any("non-finite" in ln for ln in report.lines)
+        assert any("out of range" in ln for ln in report.lines)
+        assert report.fixed == 0
+        report = run_fsck(t, fix=True)
+        assert report.fixed >= 2
+        assert run_fsck(t).errors == 0
+        ts, vals = t.store.series(sid).buffer.view()
+        np.testing.assert_array_equal(
+            ts, [1356998400_000, 1356998460_000])
+        np.testing.assert_array_equal(vals, [1.0, 2.0])
+
+    def test_repair_survives_restart(self, tmp_path):
+        """--fix repairs must be durable: a restart (snapshot load +
+        WAL replay) must not resurrect dropped corruption (ref: Fsck
+        writes repairs back to storage, not to a cache)."""
+        from opentsdb_tpu import TSDB, Config
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.storage.data_dir": str(tmp_path)}
+        t = TSDB(Config(**cfg))
+        t.add_point("m", 1356998400, 1.0, {"host": "a"})
+        sid = int(t.store.series_ids_for_metric(
+            t.uids.metrics.get_id("m"))[0])
+        t.store.append(sid, 1356998460_000, float("nan"))
+        t.flush()  # the corruption lands in a durable snapshot
+        assert run_fsck(t, fix=True).fixed >= 1
+        t2 = TSDB(Config(**cfg))
+        assert run_fsck(t2).errors == 0
+        sid2 = int(t2.store.series_ids_for_metric(
+            t2.uids.metrics.get_id("m"))[0])
+        ts, vals = t2.store.series(sid2).buffer.view()
+        np.testing.assert_array_equal(ts, [1356998400_000])
+
+    @pytest.mark.parametrize("backend", ["native", "memory"])
+    def test_patch_value_both_backends(self, backend):
+        from opentsdb_tpu import TSDB, Config
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           "tsd.storage.backend": backend}))
+        t.add_point("m", 1356998400, 1.0, {"host": "a"})
+        sid = int(t.store.series_ids_for_metric(
+            t.uids.metrics.get_id("m"))[0])
+        t.store.patch_value(sid, 1356998400_000, 42.0)
+        _, vals = t.store.series(sid).buffer.view()
+        assert vals[0] == 42.0
+        with pytest.raises(KeyError):
+            t.store.patch_value(sid, 999, 0.0)
+
     def test_detects_unresolvable_uid(self, tsdb):
         tsdb.add_point("m", 1356998400, 1.0, {"host": "a"})
         rec = tsdb.store.series(0)
