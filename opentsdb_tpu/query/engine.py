@@ -729,16 +729,18 @@ class QueryEngine:
             else:  # max, mimmax
                 grid = np.where(present, maxs, np.nan)
             has_data = present
-            if mesh is None:
-                # pad to the geometric shape buckets NOW (host numpy,
-                # once) so the cached device grids are pre-padded and
-                # warm queries never pay a per-query device pad
-                from opentsdb_tpu.ops import shapes
-                s0, b0 = grid.shape
-                sp = shapes.shape_bucket(s0)
-                bp = shapes.shape_bucket(b0)
-                grid = shapes.pad_2d_host(grid, sp, bp, np.nan)
-                has_data = shapes.pad_2d_host(has_data, sp, bp, False)
+            # pad to the geometric shape buckets NOW (host numpy,
+            # once): cached device grids are pre-padded, warm queries
+            # never pay a per-query device pad, and — on BOTH the
+            # single-device and mesh paths — compiled programs are
+            # keyed on bucketed shapes, so warmup's pre-compiles and
+            # repeat queries of the same class actually hit
+            from opentsdb_tpu.ops import shapes
+            s0, b0 = grid.shape
+            sp = shapes.shape_bucket(s0)
+            bp = shapes.shape_bucket(b0)
+            grid = shapes.pad_2d_host(grid, sp, bp, np.nan)
+            has_data = shapes.pad_2d_host(has_data, sp, bp, False)
             if cache is not None and mesh is None:
                 from opentsdb_tpu.ops.pipeline import put_grid
                 grid, has_data = put_grid(grid, has_data)
@@ -762,14 +764,27 @@ class QueryEngine:
             # the grid-TAIL step runs straight on the mesh (no
             # flatten-to-points re-bucketize), and the pre-sharded
             # device grids are cached — mesh queries get the same
-            # warm-repeat behavior as single-device ones
+            # warm-repeat behavior as single-device ones. Shapes are
+            # geometrically bucketed exactly like execute_grid does
+            # (bucket_grid_shapes), so the compiled shard_map program
+            # set is bounded and tsd.tpu.warmup's mesh pre-compiles
+            # are the programs real queries hit.
+            from opentsdb_tpu.ops import shapes
+            from opentsdb_tpu.ops.pipeline import _bucket_dims_and_aux
             from opentsdb_tpu.parallel.sharded_pipeline import (
                 prepare_sharded_grid, run_sharded_grid,
                 sharded_grid_gids)
+            # dims from the RAW query shape (grid may be `True` on a
+            # mesh-cache hit): identical to the fresh-grid pad above,
+            # since shape_bucket is idempotent
+            s_bk, b_bk, bts_bk, gids_bk, pspec = _bucket_dims_and_aux(
+                bucket_ts, group_ids, spec,
+                shapes.shape_bucket(len(sids)),
+                shapes.shape_bucket(len(bucket_ts)))
             if mesh_args is None:
                 data_args, s_loc, b_loc, s_pad = prepare_sharded_grid(
                     mesh, np.asarray(grid), np.asarray(has_data),
-                    bucket_ts)
+                    bts_bk)
                 if cache is not None:
                     cache.put(ckey, cver, data_args,
                               {"num_points": num_points,
@@ -780,11 +795,14 @@ class QueryEngine:
                 s_loc = mesh_meta["s_loc"]
                 b_loc = mesh_meta["b_loc"]
                 s_pad = mesh_meta["s_pad"]
-            gids_dev = sharded_grid_gids(mesh, group_ids, s_pad,
-                                         num_groups)
+            gids_dev = sharded_grid_gids(mesh, gids_bk, s_pad,
+                                         pspec.num_groups)
             result, emit = run_sharded_grid(
-                mesh, spec, data_args + (gids_dev,), s_loc, b_loc,
+                mesh, pspec, data_args + (gids_dev,), s_loc, b_loc,
                 num_groups, sub.rate_options)
+            rows = len(sids) if emit_raw else num_groups
+            result = result[:rows, :len(bucket_ts)]
+            emit = emit[:rows, :len(bucket_ts)]
         else:
             from opentsdb_tpu.ops.pipeline import execute_grid
             result, emit = execute_grid(grid, has_data, bucket_ts,

@@ -201,6 +201,34 @@ def parse_uri_subquery(spec: str, index: int = 0) -> TSSubQuery:
         if middle.startswith("rate"):
             sub.rate = True
             sub.rate_options = RateOptions.parse(middle)
+        elif middle == "explicit_tags":
+            # (ref: QueryRpc.parseQueryMTypeWExplicit — the URI form
+            # agg:explicit_tags:[ds:][rate:]metric)
+            sub.explicit_tags = True
+        elif middle.lower().startswith("percentile"):
+            # percentile[98,99.9] histogram-query section (ref:
+            # QueryRpc.parsePercentiles :887-903, tolerant of spaces)
+            import re as _re2
+            pm = _re2.match(r"^percentiles?\s*\[\s*([^\]]*?)\s*\]$",
+                            middle, _re2.IGNORECASE)
+            if not pm:
+                raise BadRequestError(
+                    f"Malformatted percentile query parameter: "
+                    f"{middle!r}")
+            try:
+                sub.percentiles = [float(p)
+                                   for p in pm.group(1).split(",") if
+                                   p.strip()]
+            except ValueError:
+                raise BadRequestError(
+                    f"Malformatted percentile query parameter: "
+                    f"{middle!r}") from None
+            if not sub.percentiles:
+                # 'percentile[]' must not silently degrade to a
+                # non-histogram query (ref: parsePercentiles rejects)
+                raise BadRequestError(
+                    f"Malformatted percentile query parameter: "
+                    f"{middle!r}")
         elif middle == "":
             continue
         else:

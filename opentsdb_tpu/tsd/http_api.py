@@ -126,6 +126,7 @@ class HttpRpcRouter:
             "aggregators": self._handle_aggregators,
             "config": self._handle_config,
             "dropcaches": self._handle_dropcaches,
+            "serializers": self._handle_serializers,
             "stats": self._handle_stats,
             "version": self._handle_version,
         })
@@ -262,6 +263,11 @@ class HttpRpcRouter:
                     value = (float(value) if
                              ("." in value or "e" in value.lower())
                              else int(value))
+                elif value is None or isinstance(value, bool) or \
+                        not isinstance(value, (int, float)):
+                    # (ref: PutDataPointRpc rejects null/empty values
+                    # per datapoint)
+                    raise ValueError(f"invalid value: {value!r}")
                 tags = dp.get("tags") or {}
                 parsed.append((metric, ts, value, tags))
                 dps.append(dp)
@@ -517,6 +523,22 @@ class HttpRpcRouter:
         return HttpResponse(200, request.serializer.format_search(results))
 
     # -- annotations (ref: AnnotationRpc.java) -------------------------
+
+    def _handle_serializers(self, request: HttpRequest, rest
+                            ) -> HttpResponse:
+        """Registered wire formats (ref: HttpSerializer listing,
+        TestHttpJsonSerializer.formatSerializersV1)."""
+        out = [{
+            "serializer": s.shortname,
+            "class": type(s).__name__,
+            "version": getattr(s, "version", "2.0.0"),
+            "request_content_type": getattr(
+                s, "request_content_type", "application/json"),
+            "response_content_type": getattr(
+                s, "response_content_type",
+                "application/json; charset=UTF-8"),
+        } for s in self.serializers.values()]
+        return HttpResponse(200, json.dumps(out).encode())
 
     def _handle_annotation(self, request: HttpRequest, rest
                            ) -> HttpResponse:

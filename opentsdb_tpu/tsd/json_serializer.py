@@ -160,11 +160,18 @@ class HttpJsonSerializer(HttpSerializer):
         [[ts, value], ...] when the ``arrays`` query param is set."""
         ms = ts_query.ms_resolution
         pieces = []
+        # showStats: a per-result "stats" map (ref:
+        # formatQueryAsyncV1wStats — each DataPoints row carries the
+        # query's stat points), plus the trailing statsSummary row
+        stats_blob = (b',"stats":' + self._dump(summary_extra or {})
+                      if show_stats else b"")
         for r in results:
             head = self._result_head(ts_query, r)
-            pieces.append(head[:-1] + b',"dps":'
+            pieces.append(head[:-1] + stats_blob + b',"dps":'
                           + self._dps_body(r, ms, as_arrays) + b"}")
-        if show_summary or show_stats:
+        if show_summary:
+            # trailing summary row only for showSummary (ref:
+            # formatQueryAsyncV1wStatsWoSummary has row stats, no tail)
             pieces.append(self._dump(
                 {"statsSummary": summary_extra or {}}))
         return b"[" + b",".join(pieces) + b"]"

@@ -39,7 +39,8 @@ HEAVY_MODULES = {
     "test_distributed", "test_blocked", "test_pallas_fused",
     "test_dense_pipeline", "test_padded_pipeline",
     "test_oracle_conformance", "test_oracle_conformance_ext",
-    "test_oracle_conformance_nogrid",
+    "test_oracle_conformance_nogrid", "test_shapes", "test_tools",
+    "test_wal", "test_import",
 }
 
 

@@ -1,0 +1,396 @@
+"""Table-driven RPC / serializer edge matrices.
+
+Ports the cheapest-coverage-per-line cases from the reference's
+per-RPC test files (SURVEY.md §4; VERDICT r03 #10):
+
+- ``test/tsd/TestPutRpc.java`` — telnet + HTTP put value/shape edges
+  (scientific notation, precision, missing fields, malformed JSON,
+  details/summary counters)
+- ``test/tsd/TestQueryRpc.java`` — the m= URI parse matrix (rate, ds,
+  fills, filter grammar errors, explicit_tags, percentiles) and the
+  query error paths
+- ``test/tsd/TestHttpJsonSerializer.java`` — parse/format edges
+  (empty/not-JSON bodies, show_query/show_summary/show_stats shapes,
+  suggest round-trips)
+
+Each case is a table row; the harness drives the REAL router/telnet
+objects (no mocks), matching how NettyMocks fabricated channels.
+"""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+from opentsdb_tpu.tsd.telnet import TelnetRouter
+
+BASE = 1356998400
+
+
+@pytest.fixture
+def router(tsdb):
+    return HttpRpcRouter(tsdb)
+
+
+@pytest.fixture
+def telnet(tsdb):
+    return TelnetRouter(tsdb, server=None)
+
+
+@pytest.fixture
+def seeded_router(seeded_tsdb):
+    return HttpRpcRouter(seeded_tsdb)
+
+
+def req(method, path, body=None, raw_body=None, **params):
+    if raw_body is not None:
+        b = raw_body
+    elif body is not None:
+        b = json.dumps(body).encode()
+    else:
+        b = b""
+    return HttpRequest(method=method, path=path,
+                       params={k: [str(v)] for k, v in params.items()},
+                       body=b)
+
+
+def parse(resp):
+    return json.loads(resp.body) if resp.body else None
+
+
+# ---------------------------------------------------------------------------
+# telnet put value edges (ref: TestPutRpc putSingle..putNegativeSECaseTiny)
+# ---------------------------------------------------------------------------
+
+TELNET_PUT_VALUES = [
+    # (value literal, expected stored float)  — sci-notation big/tiny,
+    # upper/lower case E, signs, double precision
+    ("42", 42.0),
+    ("-42", -42.0),
+    ("4.2", 4.2),
+    ("-4.2", -4.2),
+    ("4220.0", 4220.0),
+    ("4.2e4", 42000.0),
+    ("4.2E4", 42000.0),
+    ("-4.2e4", -42000.0),
+    ("-4.2E4", -42000.0),
+    ("4.2e-4", 0.00042),
+    ("4.2E-4", 0.00042),
+    ("-4.2e-4", -0.00042),
+    ("-4.2E-4", -0.00042),
+    ("2147483647", 2147483647.0),
+    ("-2147483648", -2147483648.0),
+    ("9.8234459e8", 982344590.0),
+    ("-9.8234459E8", -982344590.0),
+]
+
+
+class TestTelnetPutValues:
+    @pytest.mark.parametrize("literal,expected", TELNET_PUT_VALUES)
+    def test_value_literal(self, tsdb, telnet, literal, expected):
+        out = telnet.execute(
+            f"put sys.edge {BASE} {literal} host=a")
+        assert not out  # success is silent (reference semantics)
+        sid = int(tsdb.store.series_ids_for_metric(
+            tsdb.uids.metrics.get_id("sys.edge"))[0])
+        _, vals = tsdb.store.series(sid).buffer.view()
+        assert vals[-1] == pytest.approx(expected, rel=1e-12)
+
+    @pytest.mark.parametrize("line,frag", [
+        ("put", "put: illegal argument: not enough arguments"),
+        (f"put sys.edge {BASE}", "not enough arguments"),
+        (f"put sys.edge {BASE} notanumber host=a", "ValueError"),
+        (f"put sys.edge {BASE} 4a2 host=a", "ValueError"),
+        (f"put sys.edge notatime 42 host=a", "ValueError"),
+        (f"put sys.edge {BASE} 42", "not enough arguments"),  # no tags
+        (f"put sys.edge {BASE} 42 host", "tag"),  # malformed tag
+    ])
+    def test_bad_lines_report_errors(self, telnet, line, frag):
+        out = telnet.execute(line)
+        assert out and frag.lower() in out.lower()
+
+    def test_unknown_metric_without_autocreate(self):
+        from opentsdb_tpu import TSDB, Config
+        t = TSDB(Config())  # auto-create off
+        tn = TelnetRouter(t, server=None)
+        out = tn.execute(f"put no.such.metric {BASE} 1 host=a")
+        assert out and "no.such.metric" in out
+
+
+# ---------------------------------------------------------------------------
+# HTTP put edges (ref: TestPutRpc HTTP half)
+# ---------------------------------------------------------------------------
+
+def dp(metric="sys.edge", ts=BASE, value=42, tags=None):
+    return {"metric": metric, "timestamp": ts, "value": value,
+            "tags": tags if tags is not None else {"host": "a"}}
+
+
+class TestHttpPutEdges:
+    def test_single_and_array_forms(self, router):
+        assert router.handle(req("POST", "/api/put",
+                                 body=dp())).status == 204
+        assert router.handle(req("POST", "/api/put",
+                                 body=[dp(ts=BASE + 1),
+                                       dp(ts=BASE + 2)])).status == 204
+
+    @pytest.mark.parametrize("body,frag", [
+        ([dp(metric=None)], "metric"),
+        ([dp(metric="")], "metric"),
+        ([{"timestamp": BASE, "value": 1, "tags": {"h": "a"}}],
+         "metric"),
+        ([dp(ts=None)], "timestamp"),
+        ([{"metric": "m", "value": 1, "tags": {"h": "a"}}],
+         "timestamp"),
+        ([dp(ts=-5)], "timestamp"),
+        ([dp(value=None)], "value"),
+        ([{"metric": "m", "timestamp": BASE, "tags": {"h": "a"}}],
+         "value"),
+        ([dp(value="notanumber")], "value"),
+        ([dp(tags={})], "tag"),
+        ([{"metric": "m", "timestamp": BASE, "value": 1}], "tag"),
+    ])
+    def test_bad_datapoint_details(self, router, body, frag):
+        # ?details surfaces per-datapoint errors; good points land
+        resp = router.handle(req("POST", "/api/put", body=body,
+                                 details=""))
+        out = parse(resp)
+        assert out["failed"] == 1 and out["success"] == 0
+        assert frag in json.dumps(out["errors"]).lower()
+
+    def test_mixed_batch_partial_success(self, router):
+        resp = router.handle(req(
+            "POST", "/api/put",
+            body=[dp(), dp(metric=""), dp(ts=BASE + 9)], details=""))
+        out = parse(resp)
+        assert out["success"] == 2 and out["failed"] == 1
+
+    def test_summary_only_counts(self, router):
+        resp = router.handle(req("POST", "/api/put",
+                                 body=[dp(), dp(metric="")],
+                                 summary=""))
+        out = parse(resp)
+        assert out == {"success": 1, "failed": 1}
+
+    @pytest.mark.parametrize("raw", [b"not json", b"", b"{", b"[{]"])
+    def test_malformed_bodies_400(self, router, raw):
+        resp = router.handle(req("POST", "/api/put", raw_body=raw))
+        assert resp.status == 400
+
+    def test_object_not_datapoint_400(self, router):
+        resp = router.handle(req("POST", "/api/put",
+                                 body={"bogus": True}))
+        assert resp.status == 400
+
+    def test_get_method_rejected(self, router):
+        assert router.handle(req("GET", "/api/put")).status in (400,
+                                                                405)
+
+
+# ---------------------------------------------------------------------------
+# query m= URI parse matrix (ref: TestQueryRpc.parseQuery*)
+# ---------------------------------------------------------------------------
+
+def uri_query(seeded_router, m, **extra):
+    return seeded_router.handle(
+        req("GET", "/api/query", start=BASE - 10, end=BASE + 3000,
+            m=m, **extra))
+
+
+M_PARSE_OK = [
+    # (m spec, check(result rows))
+    ("sum:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("max:10s-avg:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:10s-avg-nan:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:10s-avg-zero:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:rate:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:rate{counter}:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:rate{counter,100,50}:sys.cpu.user",
+     lambda rows: len(rows) == 1),
+    ("sum:10s-avg:rate:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:rate:10s-avg:sys.cpu.user", lambda rows: len(rows) == 1),
+    ("sum:sys.cpu.user{host=web01}",
+     lambda rows: rows[0]["tags"].get("host") == "web01"),
+    ("sum:sys.cpu.user{host=*}", lambda rows: len(rows) == 2),
+    ("sum:sys.cpu.user{host=wildcard(web*)}",
+     lambda rows: len(rows) == 2),
+    ("sum:sys.cpu.user{host=regexp(web0[12])}",
+     lambda rows: len(rows) == 2),
+    ("sum:sys.cpu.user{host=literal_or(web01|web02)}",
+     lambda rows: len(rows) == 2),
+    # filter-only braces (no group-by): aggregated into one row
+    ("sum:sys.cpu.user{}{host=wildcard(web*)}",
+     lambda rows: len(rows) == 1 and "host" in rows[0]["aggregateTags"]),
+    # group-by AND post-filter on the same tagk
+    ("sum:sys.cpu.user{host=*}{host=literal_or(web01)}",
+     lambda rows: len(rows) == 1 and
+     rows[0]["tags"].get("host") == "web01"),
+]
+
+
+class TestQueryUriParseMatrix:
+    @pytest.mark.parametrize("m,check", M_PARSE_OK,
+                             ids=[m for m, _ in M_PARSE_OK])
+    def test_parse_ok(self, seeded_router, m, check):
+        resp = uri_query(seeded_router, m)
+        assert resp.status == 200, resp.body[:200]
+        assert check(parse(resp))
+
+    @pytest.mark.parametrize("m", [
+        "sum",                                   # no metric
+        "nosuchagg:sys.cpu.user",                # unknown aggregator
+        "sum:sys.cpu.user{host=web01",           # missing close
+        "sum:sys.cpu.user{host}",                # missing equals
+        "sum:sys.cpu.user{host=nosuchfilter(x)}",  # unknown filter fn
+        "sum:no.such.metric",                    # NSU metric
+        "sum:bad-ds:sys.cpu.user",               # bad downsample
+        "sum:10s-avg-bogusfill:sys.cpu.user",    # bad fill policy
+    ])
+    def test_parse_errors_400(self, seeded_router, m):
+        resp = uri_query(seeded_router, m)
+        assert resp.status == 400
+        assert "error" in (parse(resp) or {})
+
+    def test_missing_start_400(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query", m="sum:sys.cpu.user"))
+        assert resp.status == 400
+
+    def test_no_subquery_400(self, seeded_router):
+        resp = seeded_router.handle(
+            req("GET", "/api/query", start=BASE))
+        assert resp.status == 400
+
+    def test_duplicate_m_params_deduped_rows(self, seeded_router):
+        # two identical m= specs produce two result sets (the
+        # reference keeps both sub-queries)
+        r = seeded_router.handle(HttpRequest(
+            method="GET", path="/api/query",
+            params={"start": [str(BASE - 10)], "end": [str(BASE + 3000)],
+                    "m": ["sum:sys.cpu.user", "sum:sys.cpu.user"]},
+            body=b""))
+        assert r.status == 200 and len(parse(r)) == 2
+
+    def test_explicit_tags_narrowing(self, tsdb):
+        # explicit_tags: series with EXTRA tags are excluded
+        tsdb.add_point("em", BASE, 1.0, {"host": "a"})
+        tsdb.add_point("em", BASE, 2.0, {"host": "a", "core": "0"})
+        rr = HttpRpcRouter(tsdb)
+        both = parse(rr.handle(req(
+            "GET", "/api/query", start=BASE - 10, end=BASE + 10,
+            m="sum:em{host=a}")))
+        assert len(both) == 1  # aggregated across both series
+        only = parse(rr.handle(req(
+            "GET", "/api/query", start=BASE - 10, end=BASE + 10,
+            m="sum:explicit_tags:em{host=a}")))
+        assert only[0]["dps"][str(BASE)] == 1
+
+    def test_percentile_parse_histogram_route(self, tsdb):
+        # percentiles route m= queries to the histogram engine (ref:
+        # testParsePercentile; isHistogramQuery :776)
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        h = SimpleHistogram([0.0, 10.0, 20.0])
+        h.add(5.0, 3)
+        h.add(15.0, 1)
+        blob = tsdb.histogram_manager.encode(h)
+        tsdb.add_histogram_point("hm", BASE, blob, {"host": "a"})
+        rr = HttpRpcRouter(tsdb)
+        # percentile[..] section in the m= spec, spaces tolerated
+        # (ref: testParsePercentile's five spacing variants)
+        for spec in ("sum:percentile[95]:hm{host=a}",
+                     "sum:percentile[ 95 ]:hm{host=a}",
+                     "sum:percentile[95, 99]:hm{host=a}"):
+            resp = rr.handle(req(
+                "GET", "/api/query", start=BASE - 10, end=BASE + 10,
+                m=spec))
+            assert resp.status == 200, resp.body[:200]
+            rows = parse(resp)
+            assert rows and rows[0]["dps"]
+        for bad in ("percentile[bogus]", "percentile[]",
+                    "percentile[ , ]"):
+            assert rr.handle(req(
+                "GET", "/api/query", start=BASE - 10, end=BASE + 10,
+                m=f"sum:{bad}:hm{{host=a}}")).status == 400
+
+
+# ---------------------------------------------------------------------------
+# serializer edges (ref: TestHttpJsonSerializer)
+# ---------------------------------------------------------------------------
+
+class TestSerializerEdges:
+    def test_suggest_post_parse_variants(self, seeded_router):
+        ok = seeded_router.handle(req(
+            "POST", "/api/suggest", body={"type": "metrics", "q": "sys"}))
+        assert parse(ok) == ["sys.cpu.user"]
+        # empty body object -> defaults (type required -> 400)
+        assert seeded_router.handle(req(
+            "POST", "/api/suggest", body={})).status == 400
+        # not JSON -> 400
+        assert seeded_router.handle(req(
+            "POST", "/api/suggest",
+            raw_body=b"this is not json")).status == 400
+
+    def test_format_query_show_query_echo(self, seeded_router):
+        resp = seeded_router.handle(req(
+            "POST", "/api/query",
+            body={"start": BASE - 10, "end": BASE + 3000,
+                  "showQuery": True,
+                  "queries": [{"metric": "sys.cpu.user",
+                               "aggregator": "sum"}]}))
+        rows = parse(resp)
+        assert all("query" in r for r in rows)
+        assert rows[0]["query"]["metric"] == "sys.cpu.user"
+
+    def test_format_query_show_summary_and_stats(self, seeded_router):
+        for flags, keys, absent in (
+                ({"showSummary": True}, {"statsSummary"}, {"stats"}),
+                ({"showStats": True}, {"stats"}, {"statsSummary"}),
+                ({"showSummary": True, "showStats": True},
+                 {"statsSummary", "stats"}, set())):
+            resp = seeded_router.handle(req(
+                "POST", "/api/query",
+                body={"start": BASE - 10, "end": BASE + 3000,
+                      **flags,
+                      "queries": [{"metric": "sys.cpu.user",
+                                   "aggregator": "sum"}]}))
+            rows = parse(resp)
+            # per-row "stats" maps; trailing statsSummary row only for
+            # showSummary (ref: the four wStats/wSummary variants)
+            present = {k for r in rows for k in r}
+            assert keys <= present, (flags, present)
+            assert not (absent & present), (flags, present)
+
+    def test_empty_result_is_empty_array(self, seeded_router):
+        resp = seeded_router.handle(req(
+            "GET", "/api/query", start=BASE + 900000,
+            end=BASE + 900010, m="sum:sys.cpu.user"))
+        assert resp.status == 200 and parse(resp) == []
+
+    def test_ms_resolution_flag(self, seeded_router):
+        resp = seeded_router.handle(req(
+            "POST", "/api/query",
+            body={"start": BASE - 10, "end": BASE + 3000,
+                  "msResolution": True,
+                  "queries": [{"metric": "sys.cpu.user",
+                               "aggregator": "sum"}]}))
+        rows = parse(resp)
+        # ms resolution: 13-digit epoch keys
+        assert all(len(k) == 13 for k in rows[0]["dps"])
+
+    def test_arrays_output(self, seeded_router):
+        resp = seeded_router.handle(req(
+            "GET", "/api/query", start=BASE - 10, end=BASE + 3000,
+            m="sum:sys.cpu.user", arrays="true"))
+        rows = parse(resp)
+        assert isinstance(rows[0]["dps"], list)
+        assert all(len(p) == 2 for p in rows[0]["dps"])
+
+    def test_serializers_listing(self, router):
+        resp = router.handle(req("GET", "/api/serializers"))
+        out = parse(resp)
+        assert any(s.get("serializer") == "json" for s in out)
+
+    def test_unknown_serializer_400(self, seeded_router):
+        resp = seeded_router.handle(req(
+            "GET", "/api/version", serializer="nope"))
+        assert resp.status == 400

@@ -139,4 +139,37 @@ def test_warmup_compiles_resident_buckets():
                     {"host": f"h{i % 3}"})
     combos = warmup_shapes(t)
     assert all(s >= 8 and b >= 8 and g >= 8 for s, b, g in combos)
-    assert run_warmup(t) == len(combos) * 4
+    # {sum,avg}x{plain,rate} + {p95,p99} grid programs per combo
+    assert run_warmup(t) == len(combos) * 6
+
+
+@pytest.mark.slow
+def test_warmup_compiles_mesh_programs():
+    """With tsd.query.mesh configured, warmup must pre-compile the
+    SHARDED grid programs (the mesh first query otherwise pays the
+    shard_map compile mid-request)."""
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.warmup import run_warmup, warmup_shapes
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                       "tsd.query.mesh": "series:4,time:2"}))
+    for i in range(30):
+        t.add_point("w.m", 1356998400 + i, float(i),
+                    {"host": f"h{i % 3}"})
+    assert run_warmup(t) == len(warmup_shapes(t)) * 6
+    # the warm programs must be the engine's own jit keys: a real
+    # query immediately after must add NO new compiled program (the
+    # r04 review caught warmup compiling bucketed shapes the engine
+    # never produced)
+    from opentsdb_tpu.parallel import sharded_pipeline as sp
+    warm_entries = sp._compiled_grid_step.cache_info().currsize
+    from opentsdb_tpu.query.model import TSQuery
+    # a 1h @ 1m-avg query: B=60 -> bucket 64, one of the warmed
+    # classes (a 60s window would bucket to B=8, which warmup does
+    # not cover by design)
+    res = t.execute_query(TSQuery.from_json({
+        "start": 1356998400000, "end": 1356998400000 + 3_600_000,
+        "queries": [{"metric": "w.m", "aggregator": "sum",
+                     "downsample": "1m-avg"}]}).validate())
+    assert res and res[0].dps
+    assert sp._compiled_grid_step.cache_info().currsize == \
+        warm_entries, "real mesh query missed the warmed program set"
