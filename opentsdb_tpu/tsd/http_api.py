@@ -143,6 +143,9 @@ class HttpRpcRouter:
     # ------------------------------------------------------------------
 
     def handle(self, request: HttpRequest) -> HttpResponse:
+        return self._apply_jsonp(request, self._handle_inner(request))
+
+    def _handle_inner(self, request: HttpRequest) -> HttpResponse:
         # content negotiation: ?serializer=<shortname> picks a
         # registered wire format (ref: HttpSerializer.java:93)
         request.serializer = self.serializer
@@ -186,6 +189,25 @@ class HttpRpcRouter:
                 "tsd.http.show_stack_trace") else ""
             return HttpResponse(500, request.serializer.format_error(
                 500, f"{type(e).__name__}: {e}", details))
+
+    _JSONP_RE = re.compile(r"^[A-Za-z_$][A-Za-z0-9_$.]*$")
+
+    def _apply_jsonp(self, request: HttpRequest,
+                     resp: HttpResponse) -> HttpResponse:
+        """``?jsonp=cb`` wraps JSON bodies in ``cb(...)`` (ref:
+        HttpQuery.serializeJSONP :647-658 — applied to every JSON
+        endpoint, errors included). Streamed responses are exempt
+        (script tags can't consume chunked JSONP usefully)."""
+        cb = request.param("jsonp")
+        if not cb or resp.body_iter is not None or not resp.body \
+                or "json" not in (resp.content_type or ""):
+            return resp
+        if not self._JSONP_RE.match(cb):
+            # a hostile callback name is script injection, drop it
+            return resp
+        resp.body = cb.encode() + b"(" + resp.body + b")"
+        resp.content_type = "application/javascript; charset=UTF-8"
+        return resp
 
     def _dispatch(self, request: HttpRequest) -> HttpResponse:
         path = urllib.parse.unquote(request.path.split("?", 1)[0])
@@ -642,6 +664,19 @@ class HttpRpcRouter:
             obj = {k: (request.param(k) or "").split(",")
                    for k in ("metric", "tagk", "tagv")
                    if request.has_param(k)}
+            unknown = [k for k in request.params
+                       if k not in ("metric", "tagk", "tagv",
+                                    "serializer", "jsonp")]
+            if unknown:
+                # a typo'd type silently assigning nothing is how UIDs
+                # get lost (ref: TestUniqueIdRpc.assignQsTypo -> 400)
+                raise HttpError(
+                    400, f"Unknown parameter(s): {unknown}",
+                    "Recognized types: metric, tagk, tagv")
+        if not any(obj.get(k) for k in ("metric", "tagk", "tagv")):
+            raise HttpError(
+                400, "Missing values to assign UIDs",
+                "Supply metric, tagk and/or tagv name lists")
         response: dict[str, Any] = {}
         had_error = False
         from opentsdb_tpu.auth.simple import Permissions

@@ -390,7 +390,141 @@ class TestSerializerEdges:
         out = parse(resp)
         assert any(s.get("serializer") == "json" for s in out)
 
+    def test_jsonp_wrapping(self, seeded_router):
+        # (ref: HttpQuery.serializeJSONP + formatSuggestV1JSONP)
+        resp = seeded_router.handle(req(
+            "GET", "/api/suggest", type="metrics", q="sys",
+            jsonp="cb"))
+        assert resp.body == b'cb(["sys.cpu.user"])'
+        assert "javascript" in resp.content_type
+        # errors wrap too
+        resp = seeded_router.handle(req(
+            "GET", "/api/query", start=BASE, m="sum:no.such.metric",
+            jsonp="cb"))
+        assert resp.status == 400 and resp.body.startswith(b"cb(")
+        # hostile callback names are not reflected
+        resp = seeded_router.handle(req(
+            "GET", "/api/suggest", type="metrics", q="sys",
+            jsonp="alert(1);//"))
+        assert resp.body == b'["sys.cpu.user"]'
+
     def test_unknown_serializer_400(self, seeded_router):
         resp = seeded_router.handle(req(
             "GET", "/api/version", serializer="nope"))
         assert resp.status == 400
+
+
+# ---------------------------------------------------------------------------
+# annotation RPC edges (ref: TestAnnotationRpc)
+# ---------------------------------------------------------------------------
+
+class TestAnnotationRpcEdges:
+    def _post(self, router, body):
+        return router.handle(req("POST", "/api/annotation", body=body))
+
+    def test_get_not_found_404(self, router):
+        assert router.handle(req("GET", "/api/annotation",
+                                 start_time=123)).status == 404
+
+    def test_post_merge_then_put_reset(self, router):
+        # POST merges unset fields into the existing note; PUT replaces
+        # (ref: modify vs modifyPut)
+        a = parse(self._post(router, {"startTime": BASE,
+                                      "description": "d1",
+                                      "notes": "n1"}))
+        assert (a["description"], a["notes"]) == ("d1", "n1")
+        a = parse(self._post(router, {"startTime": BASE,
+                                      "description": "d2"}))
+        assert (a["description"], a["notes"]) == ("d2", "n1")  # merged
+        a = parse(router.handle(req(
+            "PUT", "/api/annotation",
+            body={"startTime": BASE, "description": "d3"})))
+        assert a["description"] == "d3"
+
+    def test_delete_then_404(self, router):
+        self._post(router, {"startTime": BASE, "description": "x"})
+        assert router.handle(req(
+            "DELETE", "/api/annotation",
+            start_time=BASE, tsuid="")).status == 204
+        assert router.handle(req(
+            "DELETE", "/api/annotation",
+            start_time=BASE, tsuid="")).status == 404
+
+    def test_bulk_get_rejected(self, router):
+        assert router.handle(req(
+            "GET", "/api/annotation/bulk")).status == 405
+
+    def test_bulk_delete_requires_scope(self, router):
+        # neither tsuids nor global -> 400 (ref: deleteRange contract)
+        resp = router.handle(req(
+            "DELETE", "/api/annotation/bulk",
+            body={"startTime": BASE, "endTime": BASE + 10}))
+        assert resp.status == 400
+
+    def test_per_tsuid_note_in_query_response(self, seeded_router,
+                                              seeded_tsdb):
+        mid = seeded_tsdb.uids.metrics.get_id("sys.cpu.user")
+        sid = int(seeded_tsdb.store.series_ids_for_metric(mid)[0])
+        rec = seeded_tsdb.store.series(sid)
+        tsuid = seeded_tsdb.uids.tsuid(rec.metric_id,
+                                       rec.tags).hex().upper()
+        seeded_router.handle(req(
+            "POST", "/api/annotation",
+            body={"startTime": BASE + 5, "tsuid": tsuid,
+                  "description": "spike"}))
+        rows = parse(seeded_router.handle(req(
+            "GET", "/api/query", start=BASE - 10, end=BASE + 3000,
+            m="sum:sys.cpu.user{host=*}")))
+        noted = [r for r in rows if r.get("annotations")]
+        assert noted and \
+            noted[0]["annotations"][0]["description"] == "spike"
+
+
+# ---------------------------------------------------------------------------
+# uid assign RPC edges (ref: TestUniqueIdRpc assignQs*/assignPost*)
+# ---------------------------------------------------------------------------
+
+class TestUidAssignEdges:
+    def test_qs_single_and_double(self, router):
+        out = parse(router.handle(req(
+            "GET", "/api/uid/assign", metric="one.metric")))
+        assert "one.metric" in out["metric"]
+        out = parse(router.handle(HttpRequest(
+            method="GET", path="/api/uid/assign",
+            params={"metric": ["a.b,c.d"]}, body=b"")))
+        assert set(out["metric"]) == {"a.b", "c.d"}
+
+    def test_qs_mixed_good_and_conflict(self, router):
+        router.handle(req("GET", "/api/uid/assign", metric="dup.m"))
+        out = parse(router.handle(HttpRequest(
+            method="GET", path="/api/uid/assign",
+            params={"metric": ["dup.m,fresh.m"]}, body=b"")))
+        # existing name -> per-name error, fresh one still assigned
+        assert "fresh.m" in out["metric"]
+        assert "dup.m" in out.get("metric_errors", {})
+
+    def test_post_forms(self, router):
+        out = parse(router.handle(req(
+            "POST", "/api/uid/assign",
+            body={"metric": ["pm"], "tagk": ["pk"], "tagv": ["pv"]})))
+        assert "pm" in out["metric"] and "pk" in out["tagk"] \
+            and "pv" in out["tagv"]
+
+    @pytest.mark.parametrize("raw", [b"not json", b"{",
+                                     b"", b"{}"])
+    def test_post_bad_bodies(self, router, raw):
+        resp = router.handle(req("POST", "/api/uid/assign",
+                                 raw_body=raw))
+        # {} = no types given -> 400; malformed JSON -> 400
+        assert resp.status == 400
+
+    def test_unknown_type_param_400(self, router):
+        assert router.handle(req(
+            "GET", "/api/uid/assign", bogus="x")).status == 400
+
+    def test_jsonp_not_rejected_as_unknown(self, router):
+        # the router-level jsonp param must pass the assign endpoint's
+        # unknown-parameter check
+        resp = router.handle(req("GET", "/api/uid/assign",
+                                 metric="jp.m", jsonp="cb"))
+        assert resp.status == 200 and resp.body.startswith(b"cb(")

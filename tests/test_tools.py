@@ -152,6 +152,117 @@ class TestImportQueryScan:
         assert code in (0, 2)
 
 
+class TestImportEdgeMatrix:
+    """Line-format value/timestamp edge matrix (ref:
+    test/tools/TestTextImporter.java's importFile* scenarios)."""
+
+    def _import_lines(self, data_dir, tmp_path, capsys, lines):
+        f = tmp_path / "m.txt"
+        f.write_text("\n".join(lines) + "\n")
+        return run_cli(["import", *datadir_args(data_dir), str(f)],
+                       capsys)
+
+    @pytest.mark.parametrize("literal,expected", [
+        ("1", 1.0), ("-1", -1.0),                      # 1-byte ints
+        ("257", 257.0), ("-257", -257.0),              # 2-byte
+        ("65537", 65537.0), ("-65537", -65537.0),      # 4-byte
+        ("4294967296", 4294967296.0),                  # 8-byte
+        ("-4294967296", -4294967296.0),
+        ("0.0001", 0.0001), ("-0.0001", -0.0001),      # floats
+        ("4.2e3", 4200.0),
+    ])
+    def test_good_values(self, data_dir, tmp_path, capsys, literal,
+                         expected):
+        code, out, _ = self._import_lines(
+            data_dir, tmp_path, capsys,
+            [f"im.m {BASE} {literal} host=a"])
+        assert code == 0 and "imported 1" in out
+        code, out, _ = run_cli(
+            ["query", *datadir_args(data_dir), str(BASE - 5),
+             str(BASE + 5), "sum:im.m"], capsys)
+        val = float(out.split()[2])
+        assert val == pytest.approx(expected, rel=1e-12)
+
+    def test_ms_timestamp(self, data_dir, tmp_path, capsys):
+        code, out, _ = self._import_lines(
+            data_dir, tmp_path, capsys,
+            [f"im.ms {BASE * 1000 + 250} 1 host=a"])
+        assert code == 0 and "imported 1" in out
+
+    def test_max_second_timestamp(self, data_dir, tmp_path, capsys):
+        # 4294967295 = the reference's max 4-byte-second row time
+        code, out, _ = self._import_lines(
+            data_dir, tmp_path, capsys, ["im.max 4294967295 1 host=a"])
+        assert code == 0 and "imported 1" in out
+
+    @pytest.mark.parametrize("line", [
+        f"im.bad 0 1 host=a",            # timestamp zero
+        f"im.bad -100 1 host=a",         # negative timestamp
+        f"im.bad notatime 1 host=a",     # timestamp NFE
+        f"im.bad {BASE} 1",              # no tags
+        f" {BASE} 1 host=a",             # empty metric
+    ])
+    def test_bad_lines_error_but_continue(self, data_dir, tmp_path,
+                                          capsys, line):
+        # a bad line fails with its line number, good lines still land
+        # (ref: the importFile*Skip variants; here skip is the default
+        # with a 100-error budget)
+        code, out, err = self._import_lines(
+            data_dir, tmp_path, capsys,
+            [f"im.good {BASE} 5 host=a", line,
+             f"im.good {BASE + 10} 6 host=a"])
+        assert code == 1
+        assert ":2" in err  # path:lineno of the bad line
+        code, out, _ = run_cli(
+            ["query", *datadir_args(data_dir), str(BASE - 5),
+             str(BASE + 15), "sum:im.good"], capsys)
+        assert len(out.strip().split("\n")) == 2
+
+    def test_nsu_without_autocreate(self, tmp_path, capsys):
+        # unknown metric with auto-create off: line errors, rc=1
+        # (ref: importFileNSUMetric)
+        f = tmp_path / "n.txt"
+        f.write_text(f"never.seen {BASE} 1 host=a\n")
+        code, _, err = run_cli(
+            ["import", f"--tsd.storage.data_dir={tmp_path}/d",
+             str(f)], capsys)
+        assert code == 1 and "never.seen" in err
+
+
+class TestDumpRoundTrip:
+    """scan --import output re-imports losslessly (ref:
+    test/tools/TestDumpSeries.java dumpImport*)."""
+
+    def test_dump_import_roundtrip(self, data_dir, tmp_path, capsys):
+        f = tmp_path / "seed.txt"
+        lines = [f"rt.m {BASE + i * 10} {i * 1.5} host=web01"
+                 for i in range(5)] + \
+                [f"rt.m {BASE + i * 10} {i * 7} host=web02"
+                 for i in range(5)]
+        f.write_text("\n".join(lines) + "\n")
+        code, _, _ = run_cli(
+            ["import", *datadir_args(data_dir), str(f)], capsys)
+        assert code == 0
+        code, dump, _ = run_cli(
+            ["scan", *datadir_args(data_dir), "--import",
+             str(BASE - 10), str(BASE + 100), "none:rt.m"], capsys)
+        assert code == 0
+        # re-import the dump into a FRESH store; re-dump must match
+        f2 = tmp_path / "redump.txt"
+        f2.write_text(dump)
+        d2 = tmp_path / "d2"
+        code, _, _ = run_cli(
+            ["import", f"--tsd.storage.data_dir={d2}",
+             "--tsd.core.auto_create_metrics=true", str(f2)], capsys)
+        assert code == 0
+        code, dump2, _ = run_cli(
+            ["scan", f"--tsd.storage.data_dir={d2}", "--import",
+             str(BASE - 10), str(BASE + 100), "none:rt.m"], capsys)
+        assert code == 0
+        assert sorted(dump.strip().split("\n")) == \
+            sorted(dump2.strip().split("\n"))
+
+
 class TestUidTool:
     def test_assign_grep_rename_delete(self, data_dir, capsys):
         code, out, _ = run_cli(
