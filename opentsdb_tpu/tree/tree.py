@@ -177,14 +177,25 @@ class Tree:
     def update(self, obj: dict[str, Any], overwrite: bool) -> None:
         for attr, key in (("name", "name"), ("description", "description"),
                           ("notes", "notes")):
-            if key in obj and (overwrite or obj[key]):
+            if overwrite:
+                # PUT replaces the definition: unspecified fields reset
+                # (ref: TestTreeRpc.handleTreeQSPut expects name:"")
+                setattr(self, attr, obj.get(key, ""))
+            elif obj.get(key):
                 setattr(self, attr, obj[key])
-        if "strictMatch" in obj:
-            self.strict_match = bool(obj["strictMatch"])
-        if "enabled" in obj:
-            self.enabled = bool(obj["enabled"])
-        if "storeFailures" in obj:
-            self.store_failures = bool(obj["storeFailures"])
+        if overwrite:
+            # full replace: unspecified booleans reset to their
+            # defaults too (ref: Tree.copyChanges(tree, true))
+            self.strict_match = bool(obj.get("strictMatch", False))
+            self.enabled = bool(obj.get("enabled", False))
+            self.store_failures = bool(obj.get("storeFailures", False))
+        else:
+            if "strictMatch" in obj:
+                self.strict_match = bool(obj["strictMatch"])
+            if "enabled" in obj:
+                self.enabled = bool(obj["enabled"])
+            if "storeFailures" in obj:
+                self.store_failures = bool(obj["storeFailures"])
 
     def set_rule(self, rule: TreeRule) -> None:
         rule.tree_id = self.tree_id

@@ -202,7 +202,7 @@ class HttpRpcRouter:
         if not cb or resp.body_iter is not None or not resp.body \
                 or "json" not in (resp.content_type or ""):
             return resp
-        if not self._JSONP_RE.match(cb):
+        if not self._JSONP_RE.fullmatch(cb):
             # a hostile callback name is script injection, drop it
             return resp
         resp.body = cb.encode() + b"(" + resp.body + b")"
@@ -660,6 +660,10 @@ class HttpRpcRouter:
     def _uid_assign(self, request: HttpRequest) -> HttpResponse:
         if request.method == "POST":
             obj = json.loads(request.body or b"{}")
+            if not isinstance(obj, dict):
+                raise HttpError(
+                    400, "Expected a JSON object",
+                    '{"metric": [...], "tagk": [...], "tagv": [...]}')
         else:
             obj = {k: (request.param(k) or "").split(",")
                    for k in ("metric", "tagk", "tagv")

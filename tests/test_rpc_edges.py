@@ -402,11 +402,13 @@ class TestSerializerEdges:
             "GET", "/api/query", start=BASE, m="sum:no.such.metric",
             jsonp="cb"))
         assert resp.status == 400 and resp.body.startswith(b"cb(")
-        # hostile callback names are not reflected
-        resp = seeded_router.handle(req(
-            "GET", "/api/suggest", type="metrics", q="sys",
-            jsonp="alert(1);//"))
-        assert resp.body == b'["sys.cpu.user"]'
+        # hostile callback names are not reflected (incl. a trailing
+        # newline, which bare '$' would let through)
+        for evil in ("alert(1);//", "cb\n"):
+            resp = seeded_router.handle(req(
+                "GET", "/api/suggest", type="metrics", q="sys",
+                jsonp=evil))
+            assert resp.body == b'["sys.cpu.user"]'
 
     def test_unknown_serializer_400(self, seeded_router):
         resp = seeded_router.handle(req(
@@ -481,6 +483,128 @@ class TestAnnotationRpcEdges:
 
 
 # ---------------------------------------------------------------------------
+# tree RPC edges (ref: TestTreeRpc)
+# ---------------------------------------------------------------------------
+
+class TestTreeRpcEdges:
+    def _create(self, router, name="t1"):
+        return parse(router.handle(req(
+            "POST", "/api/tree", body={"name": name,
+                                       "description": "d"})))
+
+    def test_get_all_and_single(self, router):
+        t = self._create(router)
+        all_trees = parse(router.handle(req("GET", "/api/tree")))
+        assert any(x["treeId"] == t["treeId"] for x in all_trees)
+        one = parse(router.handle(req("GET", "/api/tree",
+                                      treeid=t["treeId"])))
+        assert one["name"] == "t1"
+
+    def test_get_not_found_404(self, router):
+        assert router.handle(req("GET", "/api/tree",
+                                 treeid=65536)).status == 404
+
+    def test_create_requires_name(self, router):
+        assert router.handle(req("POST", "/api/tree",
+                                 body={"description": "x"})) \
+            .status == 400
+
+    def test_modify_post_vs_put(self, router):
+        t = self._create(router)
+        m = parse(router.handle(req(
+            "POST", "/api/tree",
+            body={"treeId": t["treeId"], "description": "new"})))
+        assert m["description"] == "new" and m["name"] == "t1"
+        m = parse(router.handle(req(
+            "PUT", "/api/tree",
+            body={"treeId": t["treeId"], "description": "only"})))
+        # PUT resets unspecified fields — booleans included
+        # (ref: handleTreeQSPut; Tree.copyChanges(tree, true))
+        assert m["description"] == "only" and m["name"] == ""
+        t2 = self._create(router, "tb")
+        router.handle(req("POST", "/api/tree",
+                          body={"treeId": t2["treeId"],
+                                "strictMatch": True}))
+        m2 = parse(router.handle(req(
+            "PUT", "/api/tree", body={"treeId": t2["treeId"],
+                                      "name": "tb"})))
+        assert m2["strictMatch"] is False
+
+    def test_modify_not_found_404(self, router):
+        assert router.handle(req(
+            "POST", "/api/tree",
+            body={"treeId": 4242, "description": "x"})).status == 404
+
+    def test_delete_default_keeps_definition(self, router):
+        # default DELETE clears branches but keeps the tree definition
+        # (ref: handleTreeQSDeleteDefault)
+        t = self._create(router)
+        assert router.handle(req("DELETE", "/api/tree",
+                                 treeid=t["treeId"])).status == 204
+        assert router.handle(req("GET", "/api/tree",
+                                 treeid=t["treeId"])).status == 200
+
+    def test_delete_definition_then_404(self, router):
+        # definition=true removes the tree entirely
+        # (ref: handleTreeQSDeleteDefinition)
+        t = self._create(router)
+        assert router.handle(req("DELETE", "/api/tree",
+                                 treeid=t["treeId"],
+                                 definition="true")).status == 204
+        assert router.handle(req("GET", "/api/tree",
+                                 treeid=t["treeId"])).status == 404
+        assert router.handle(req("DELETE", "/api/tree",
+                                 treeid=t["treeId"],
+                                 definition="true")).status == 404
+
+    def test_rule_crud(self, router):
+        t = self._create(router)
+        r = parse(router.handle(req(
+            "POST", "/api/tree/rule",
+            body={"treeId": t["treeId"], "type": "METRIC",
+                  "level": 0, "order": 0})))
+        assert r["type"].lower() == "metric"
+        got = parse(router.handle(req(
+            "GET", "/api/tree/rule", treeid=t["treeId"], level=0,
+            order=0)))
+        assert got["type"].lower() == "metric"
+        assert router.handle(req(
+            "DELETE", "/api/tree/rule", treeid=t["treeId"], level=0,
+            order=0)).status == 204
+        assert router.handle(req(
+            "GET", "/api/tree/rule", treeid=t["treeId"], level=0,
+            order=0)).status == 404
+
+    def test_rule_unknown_tree_404(self, router):
+        assert router.handle(req(
+            "POST", "/api/tree/rule",
+            body={"treeId": 999, "type": "METRIC"})).status == 404
+
+    def test_branch_missing_params_400_and_404(self, router):
+        assert router.handle(req("GET", "/api/tree/branch")) \
+            .status == 400
+        assert router.handle(req("GET", "/api/tree/branch",
+                                 treeid=999)).status == 404
+
+    def test_branch_root_after_sync(self, tsdb, router):
+        tsdb.add_point("sys.cpu.user", BASE, 1.0, {"host": "web01"})
+        t = self._create(router, "live")
+        router.handle(req(
+            "POST", "/api/tree/rule",
+            body={"treeId": t["treeId"], "type": "METRIC",
+                  "level": 0, "order": 0}))
+        from opentsdb_tpu.tree.tree import tree_manager
+        tree_manager(tsdb).sync_all()
+        root = parse(router.handle(req("GET", "/api/tree/branch",
+                                       treeid=t["treeId"])))
+        assert root.get("branches") or root.get("leaves")
+
+    def test_unknown_subroute_404(self, router):
+        assert router.handle(req("GET", "/api/tree/bogus")) \
+            .status == 404
+
+
+# ---------------------------------------------------------------------------
 # uid assign RPC edges (ref: TestUniqueIdRpc assignQs*/assignPost*)
 # ---------------------------------------------------------------------------
 
@@ -511,7 +635,8 @@ class TestUidAssignEdges:
             and "pv" in out["tagv"]
 
     @pytest.mark.parametrize("raw", [b"not json", b"{",
-                                     b"", b"{}"])
+                                     b"", b"{}", b'["metric"]',
+                                     b'"metric"', b"42"])
     def test_post_bad_bodies(self, router, raw):
         resp = router.handle(req("POST", "/api/uid/assign",
                                  raw_body=raw))
