@@ -28,6 +28,10 @@ from typing import Any, Callable
 
 from opentsdb_tpu import __version__
 from opentsdb_tpu.meta.annotation import Annotation
+# importing logring attaches the /logs ring buffer as early as the
+# HTTP layer loads, so boot-time records are already captured (ref:
+# the logback CyclicBufferAppender is configured at startup)
+from opentsdb_tpu.utils.logring import ring_buffer
 from opentsdb_tpu.ops import aggregators as aggs_mod
 from opentsdb_tpu.query import filters as filters_mod
 from opentsdb_tpu.query.limits import QueryLimitExceeded
@@ -74,6 +78,9 @@ class HttpResponse:
     # materializing one giant body (ref: formatQueryAsyncV1 writing
     # the response incrementally through Netty)
     body_iter: Any = None
+    # force Connection: close after this response (diediedie must not
+    # leave a keep-alive handler pinning server shutdown)
+    close_connection: bool = False
 
 
 class HttpError(Exception):
@@ -130,6 +137,8 @@ class HttpRpcRouter:
             "stats": self._handle_stats,
             "version": self._handle_version,
         })
+        # set by TSDServer so HTTP diediedie can request shutdown
+        self.server = None
         self.plugin_routes: dict[str, Callable] = {}
         # /plugin/<path> HTTP endpoints (ref: HttpRpcPlugin.java:40,
         # RpcManager tsd.http.rpc.plugins :153)
@@ -159,6 +168,22 @@ class HttpRpcRouter:
                         f"with name '{name}'"))
             request.serializer = chosen
         try:
+            # GET-only verb override for clients that cannot send
+            # PUT/DELETE — API calls only, like the reference
+            # (HttpQuery.getAPIMethod :259-287 is consulted from the
+            # api-path handlers; /q, /s etc. ignore the param)
+            if request.method == "GET" and \
+                    request.path.lstrip("/").startswith("api") and \
+                    request.has_param("method_override"):
+                override = (request.param("method_override")
+                            or "").lower()
+                if not override:
+                    raise HttpError(405, "Missing method override value")
+                if override not in ("get", "post", "put", "delete"):
+                    raise HttpError(
+                        405,
+                        "Unknown or unsupported method override value")
+                request.method = override.upper()
             resp = self._dispatch(request)
             if (request.serializer is not None
                     and resp.content_type
@@ -227,6 +252,24 @@ class HttpRpcRouter:
             return self._handle_graph(request)
         elif parts[0] in ("s",):
             return self._handle_static(request, parts[1:])
+        elif parts[0] == "favicon.ico":
+            # (ref: RpcManager http.put("favicon.ico", staticfile))
+            try:
+                return self._handle_static(request, ["favicon.ico"])
+            except HttpError:
+                return HttpResponse(204)
+        elif parts[0] == "diediedie":
+            # graceful shutdown over HTTP (ref: RpcManager
+            # enableDieDieDie http map; DieDieDie.execute)
+            if self.server is not None:
+                body = b"<html><body>Cleanup complete, shutting down" \
+                       b"</body></html>"
+                self.server.request_shutdown()
+                return HttpResponse(200, body,
+                                    content_type="text/html",
+                                    close_connection=True)
+            raise HttpError(404, "Endpoint not found: /diediedie",
+                            "No server attached")
         elif parts[0] == "logs":
             return self._handle_logs(request)
         elif parts[0] == "plugin":
@@ -972,7 +1015,6 @@ class HttpRpcRouter:
     def _handle_logs(self, request: HttpRequest) -> HttpResponse:
         """(ref: LogsRpc — logback ring buffer; here the in-process
         logging ring)"""
-        from opentsdb_tpu.utils.logring import ring_buffer
         lines = ring_buffer.lines()
         if request.flag("json"):
             return HttpResponse(200, json.dumps(lines).encode())

@@ -94,12 +94,14 @@ class TSDServer:
         self.port = port if port is not None else \
             tsdb.config.get_int("tsd.network.port", 4242)
         self.http_router = HttpRpcRouter(tsdb)
+        self.http_router.server = self
         self.telnet_router = TelnetRouter(tsdb, self)
         self.connections = ConnectionManager(
             tsdb.config.get_int("tsd.core.connections.limit", 0))
         tsdb.stats.register(self.connections)
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
         self.cors_domains = [
             d.strip() for d in tsdb.config.get_string(
                 "tsd.http.request.cors_domains", "").split(",")
@@ -134,6 +136,7 @@ class TSDServer:
     # ------------------------------------------------------------------
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(
             self._handle_connection, self.host, self.port,
             backlog=self.tsdb.config.get_int("tsd.network.backlog", 3072),
@@ -142,7 +145,7 @@ class TSDServer:
         # pre-compile the common query shape buckets in the background
         # so first queries of each class run warm (tsd.tpu.warmup)
         from opentsdb_tpu.tsd.warmup import start_warmup_thread
-        start_warmup_thread(self.tsdb)
+        self._warmup_thread = start_warmup_thread(self.tsdb)
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 
@@ -153,15 +156,38 @@ class TSDServer:
         await self.stop()
 
     async def stop(self) -> None:
+        # signal the warmup thread to stop between compiles; joined
+        # AFTER the listener closes (a thread mid-JIT at interpreter
+        # teardown can crash inside XLA, but new connections must stop
+        # being accepted immediately)
+        stop_ev = getattr(self.tsdb, "_warmup_stop", None)
+        if stop_ev is not None:
+            stop_ev.set()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # wait_closed (3.12+) waits for every live handler:
+                # a keep-alive client that never disconnects must not
+                # wedge shutdown forever
+                await asyncio.wait_for(self._server.wait_closed(), 10)
+            except asyncio.TimeoutError:
+                LOG.warning("connections still open after 10s; "
+                            "forcing shutdown")
             self._server = None
+        th = getattr(self, "_warmup_thread", None)
+        if th is not None and th.is_alive():
+            await asyncio.get_event_loop().run_in_executor(
+                None, th.join, 30)
         self._query_pool.shutdown(wait=False)
         self.tsdb.shutdown()
 
     def request_shutdown(self) -> None:
-        self._shutdown.set()
+        # callable from executor threads (HTTP diediedie runs on the
+        # request worker pool): asyncio.Event.set is not thread-safe
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._shutdown.set)
+        else:
+            self._shutdown.set()
 
     # ------------------------------------------------------------------
 
@@ -331,6 +357,8 @@ class TSDServer:
                     (time.monotonic() - t0) * 1000)
             self._apply_cors(request, response)
             await self._apply_gzip(request, response)
+            if getattr(response, "close_connection", False):
+                keep_alive = False
             # streamed serialization must honor the query timeout too:
             # the handler returned promptly with a lazy generator, so
             # the clock keeps running through the chunk writes

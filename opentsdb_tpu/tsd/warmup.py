@@ -79,6 +79,7 @@ def run_warmup(tsdb) -> int:
     t0 = time.monotonic()
     mesh = tsdb.query_mesh
     combos = warmup_shapes(tsdb)
+    stop = getattr(tsdb, "_warmup_stop", None)
 
     def agg_specs(s, b, g):
         for agg in ("sum", "avg"):
@@ -112,6 +113,10 @@ def run_warmup(tsdb) -> int:
             dgids = sharded_grid_gids(
                 mesh, np.zeros(s, dtype=np.int32), s_pad, g)
         for spec in agg_specs(s, b, g):
+            if stop is not None and stop.is_set():
+                log.info("warmup stopped early after %d programs",
+                         compiled)
+                return compiled
             try:
                 if mesh is None:
                     run_pipeline_grid(grid, has, bts, gids, rp, fv,
@@ -134,9 +139,11 @@ def run_warmup(tsdb) -> int:
 
 def start_warmup_thread(tsdb) -> threading.Thread | None:
     """Kick the warmup off in the background (server start must not
-    block on compiles)."""
+    block on compiles). ``tsdb._warmup_stop.set()`` (checked between
+    compiles) lets a shutting-down server stop it promptly."""
     if not tsdb.config.get_bool("tsd.tpu.warmup", True):
         return None
+    tsdb._warmup_stop = threading.Event()
     t = threading.Thread(target=run_warmup, args=(tsdb,),
                          name="shape-warmup", daemon=True)
     t.start()

@@ -76,6 +76,10 @@ def tsdb():
     return TSDB(Config(**{
         "tsd.core.auto_create_metrics": "true",
         "tsd.rollups.enable": "true",
+        # tests construct many TSDServers; their background warmup
+        # threads would otherwise still be JIT-compiling at interpreter
+        # exit, racing XLA teardown (observed exit-time segfaults)
+        "tsd.tpu.warmup": "false",
     }))
 
 

@@ -604,6 +604,68 @@ class TestTreeRpcEdges:
             .status == 404
 
 
+class TestLogsEndpoint:
+    """(ref: LogsRpc reading the logback ring buffer)"""
+
+    def test_logs_plain_and_json(self, router):
+        import logging
+        logging.getLogger("edge.test").warning("ring-probe-%d", 42)
+        resp = router.handle(req("GET", "/logs"))
+        assert resp.status == 200
+        assert b"ring-probe-42" in resp.body
+        resp = router.handle(req("GET", "/logs", json=""))
+        lines = parse(resp)
+        assert isinstance(lines, list)
+        assert any("ring-probe-42" in ln for ln in lines)
+        # newest-first ordering
+        logging.getLogger("edge.test").warning("ring-probe-newer")
+        lines = parse(router.handle(req("GET", "/logs", json="")))
+        older = next(i for i, ln in enumerate(lines)
+                     if "ring-probe-42" in ln)
+        newer = next(i for i, ln in enumerate(lines)
+                     if "ring-probe-newer" in ln)
+        assert newer < older
+
+
+class TestMethodOverride:
+    """GET ?method_override=X verb tunneling (ref:
+    HttpQuery.getAPIMethod :259-287, used throughout TestTreeRpc)."""
+
+    def test_delete_via_get(self, router):
+        t = parse(router.handle(req(
+            "POST", "/api/tree", body={"name": "mo"})))
+        resp = router.handle(req(
+            "GET", "/api/tree", treeid=t["treeId"],
+            definition="true", method_override="delete"))
+        assert resp.status == 204
+        assert router.handle(req("GET", "/api/tree",
+                                 treeid=t["treeId"])).status == 404
+
+    def test_bad_values_405(self, router):
+        assert router.handle(req("GET", "/api/version",
+                                 method_override="")).status == 405
+        assert router.handle(req("GET", "/api/version",
+                                 method_override="patch")).status == 405
+
+    def test_only_applies_to_get(self, router):
+        # a real POST keeps its verb even with an override param
+        resp = router.handle(req(
+            "POST", "/api/tree", body={"name": "keep"},
+            method_override="delete"))
+        assert resp.status == 200 and parse(resp)["name"] == "keep"
+
+    def test_get_override_noop(self, router):
+        assert router.handle(req("GET", "/api/version",
+                                 method_override="get")).status == 200
+
+    def test_non_api_paths_ignore_override(self, router):
+        # /logs, /s etc. serve normally even with a bogus override
+        # (the reference consults getAPIMethod only from api handlers)
+        assert router.handle(req("GET", "/logs",
+                                 method_override="refresh")) \
+            .status == 200
+
+
 # ---------------------------------------------------------------------------
 # uid assign RPC edges (ref: TestUniqueIdRpc assignQs*/assignPost*)
 # ---------------------------------------------------------------------------

@@ -206,6 +206,39 @@ class TestServerSockets:
 
         asyncio.run(scenario())
 
+    def test_http_diediedie_shuts_down(self, tsdb):
+        """(ref: RpcManager's HTTP diediedie map entry)"""
+        async def scenario():
+            server, port = await self._start(tsdb)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(b"GET /diediedie HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            head = await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), 5)
+            assert b"200 OK" in head
+            await asyncio.wait_for(server._shutdown.wait(), 5)
+            await server.stop()
+
+        asyncio.run(scenario())
+
+    def test_favicon_no_404(self, tsdb):
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(b"GET /favicon.ico HTTP/1.1\r\n"
+                             b"Host: x\r\nConnection: close\r\n\r\n")
+                await writer.drain()
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), 5)
+                assert b"404" not in head.split(b"\r\n")[0]
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
     def test_telnet_batched_lines(self, tsdb):
         async def scenario():
             server, port = await self._start(tsdb)
