@@ -1,0 +1,119 @@
+"""Config system edge matrix (ref: ``test/utils/TestConfig.java``):
+typed getters with spaces/negatives/NFE, overrides, properties-file
+parsing, auto-discovery, and the /api/config redaction contract."""
+
+import pytest
+
+from opentsdb_tpu.utils.config import Config
+
+
+class TestTypedGetters:
+    @pytest.fixture
+    def cfg(self):
+        c = Config()
+        c.override_config("x.int", "42")
+        c.override_config("x.int.spaced", "  42  ")
+        c.override_config("x.int.neg", "-42")
+        c.override_config("x.float", "4.2")
+        c.override_config("x.float.neg", "-4.2")
+        c.override_config("x.float.nan", "NaN")
+        c.override_config("x.float.pinf", "Infinity")
+        c.override_config("x.float.ninf", "-Infinity")
+        c.override_config("x.nfe", "not a number")
+        c.override_config("x.str", "hello")
+        return c
+
+    def test_get_int(self, cfg):
+        assert cfg.get_int("x.int") == 42
+        assert cfg.get_int("x.int.spaced") == 42   # getIntWithSpaces
+        assert cfg.get_int("x.int.neg") == -42     # getIntNegative
+
+    def test_get_int_missing_and_nfe(self, cfg):
+        with pytest.raises(KeyError):
+            cfg.get_int("no.such.key")             # getIntDoesNotExist
+        assert cfg.get_int("no.such.key", 7) == 7
+        with pytest.raises(ValueError):
+            cfg.get_int("x.nfe")                   # getIntNFE
+
+    def test_get_float(self, cfg):
+        assert cfg.get_float("x.float") == pytest.approx(4.2)
+        assert cfg.get_float("x.float.neg") == pytest.approx(-4.2)
+        # java Float.parseFloat accepts NaN/Infinity literals; so does
+        # python float()
+        assert cfg.get_float("x.float.nan") != cfg.get_float(
+            "x.float.nan")                         # getFloatNaN
+        assert cfg.get_float("x.float.pinf") == float("inf")
+        assert cfg.get_float("x.float.ninf") == float("-inf")
+        with pytest.raises(ValueError):
+            cfg.get_float("x.nfe")                 # getFloatNFE
+
+    def test_get_string_and_default(self, cfg):
+        assert cfg.get_string("x.str") == "hello"
+        assert cfg.get_string("no.key", "dflt") == "dflt"
+        with pytest.raises(KeyError):
+            cfg.get_string("no.key")
+
+    @pytest.mark.parametrize("literal,expected", [
+        ("true", True), ("True", True), ("TRUE", True),
+        ("1", True), ("yes", True),
+        ("false", False), ("0", False), ("no", False),
+        ("bogus", False), ("", False),
+    ])
+    def test_get_bool_literals(self, literal, expected):
+        c = Config()
+        c.override_config("b", literal)
+        assert c.get_bool("b") is expected
+
+    def test_override_config(self, cfg):           # overrideConfig
+        cfg.override_config("x.int", "7")
+        assert cfg.get_int("x.int") == 7
+
+    def test_has_property(self, cfg):
+        assert cfg.has_property("x.int")
+        assert not cfg.has_property("nope")
+
+
+class TestFileLoading:
+    def test_properties_file(self, tmp_path):      # constructorWithFile
+        f = tmp_path / "opentsdb.conf"
+        f.write_text(
+            "# comment\n"
+            "! also a comment\n"
+            "\n"
+            "tsd.network.port = 9999\n"
+            "tsd.core.auto_create_metrics: true\n"
+            "tsd.custom.key=a=b\n")                # value contains '='
+        c = Config(config_file=str(f))
+        assert c.get_int("tsd.network.port") == 9999
+        assert c.get_bool("tsd.core.auto_create_metrics")
+        assert c.get_string("tsd.custom.key") == "a=b"
+        assert c.config_location == str(f)
+
+    def test_file_not_found(self):                 # constructorFileNotFound
+        with pytest.raises(OSError):
+            Config(config_file="/no/such/file.conf")
+
+    def test_empty_file_keeps_defaults(self, tmp_path):
+        f = tmp_path / "empty.conf"
+        f.write_text("")
+        c = Config(config_file=str(f))
+        assert c.get_int("tsd.network.port") == 4242
+
+    def test_kwargs_override_defaults(self):
+        c = Config(**{"tsd.network.port": "7777"})
+        assert c.get_int("tsd.network.port") == 7777
+        # identifier-style kwargs mangle __ to . (the documented form)
+        c = Config(tsd__network__port="8888")
+        assert c.get_int("tsd.network.port") == 8888
+
+
+class TestDumpRedaction:
+    def test_password_keys_redacted(self):
+        # (ref: ShowConfig redacting tsd...password keys)
+        c = Config()
+        c.override_config("tsd.auth.password", "hunter2")
+        c.override_config("tsd.some.passkey", "alsosecret")
+        dump = c.dump_configuration()
+        assert dump["tsd.auth.password"] == "********"
+        assert dump["tsd.some.passkey"] == "********"
+        assert "hunter2" not in str(dump)
