@@ -162,41 +162,99 @@ def _load_meta(tsdb, data_dir: str) -> None:
 
 
 def _save_histograms(tsdb, data_dir: str) -> None:
-    """Distribution-valued series: identity + re-encoded blobs
+    """Distribution-valued series: identity + columnar arena arrays
+    (v2 format — base64 of the raw ts/sid/rows buffers; the v1 format
+    re-encoded one blob per point, which walked every stored point).
     (ref: histogram cells beside scalar cells in the data table)."""
-    doc = []
     with tsdb._histogram_lock:
-        # materialize under the write lock: a concurrent
-        # add_histogram_point must not resize the dict mid-iteration
-        items = [(sid, list(pts))
-                 for sid, pts in tsdb._histogram_series.items()]
-    for sid, pts in items:
-        rec = tsdb.histogram_store.series(sid)
-        doc.append({
-            "metric": rec.metric_id,
-            "tags": [list(p) for p in rec.tags],
-            "points": [
-                [ts, base64.b64encode(
-                    tsdb.histogram_manager.encode(h)).decode()]
-                for ts, h in pts],
+        # under the lock: only capture stable snapshot views (see
+        # _Sub.snapshot) — the O(total bytes) base64 work runs outside
+        # so ingestion never stalls on a flush
+        raw = [(mid, sub.bounds, *sub.snapshot(),
+                sub.under[:sub.n], sub.over[:sub.n])
+               for mid, arena in tsdb._histogram_arenas.items()
+               for sub in arena.groups.values()]
+    arenas = []
+    seen_sids: set[int] = set()
+    for mid, bounds, ts, sid, rows, under, over in raw:
+        arenas.append({
+            "metric": mid,
+            "bounds": list(bounds),
+            "n": int(len(ts)),
+            "ts": base64.b64encode(
+                np.ascontiguousarray(ts).tobytes()).decode(),
+            "sid": base64.b64encode(
+                np.ascontiguousarray(sid).tobytes()).decode(),
+            "rows": base64.b64encode(
+                np.ascontiguousarray(rows).tobytes()).decode(),
+            "under": base64.b64encode(
+                np.ascontiguousarray(under).tobytes()).decode(),
+            "over": base64.b64encode(
+                np.ascontiguousarray(over).tobytes()).decode(),
         })
+        seen_sids.update(int(s) for s in np.unique(sid))
+    series = {}
+    for s in sorted(seen_sids):
+        rec = tsdb.histogram_store.series(s)
+        series[str(s)] = {"metric": rec.metric_id,
+                          "tags": [list(p) for p in rec.tags]}
+    doc = {"v": 2, "series": series, "arenas": arenas}
     _atomic_write(os.path.join(data_dir, "histograms.json"),
                   json.dumps(doc).encode())
 
 
 def _load_histograms(tsdb, data_dir: str) -> None:
+    from opentsdb_tpu.core.histogram import HistogramArena
     path = os.path.join(data_dir, "histograms.json")
     if not os.path.isfile(path):
         return
     with open(path, encoding="utf-8") as fh:
         doc = json.load(fh)
-    for entry in doc:
-        sid = tsdb.histogram_store.get_or_create_series(
-            entry["metric"], [tuple(p) for p in entry["tags"]])
-        lst = tsdb._histogram_series.setdefault(sid, [])
-        for ts, blob in entry["points"]:
-            lst.append((int(ts), tsdb.histogram_manager.decode(
-                base64.b64decode(blob))))
+    if isinstance(doc, list):
+        # v1 legacy: per-series blob lists
+        for entry in doc:
+            for ts, blob in entry["points"]:
+                hist = tsdb.histogram_manager.decode(
+                    base64.b64decode(blob))
+                sid = tsdb.histogram_store.get_or_create_series(
+                    entry["metric"], [tuple(p) for p in entry["tags"]])
+                arena = tsdb._histogram_arenas.setdefault(
+                    entry["metric"], HistogramArena())
+                arena.append(int(ts), sid, hist)
+        return
+    # v2: rebuild series ids first (old sid -> new sid remap), then
+    # bulk-append the columnar arrays
+    sid_map: dict[int, int] = {}
+    for old_sid, ident in doc.get("series", {}).items():
+        sid_map[int(old_sid)] = tsdb.histogram_store \
+            .get_or_create_series(ident["metric"],
+                                  [tuple(p) for p in ident["tags"]])
+    for entry in doc.get("arenas", []):
+        n = int(entry["n"])
+        nb = max(1, len(entry["bounds"]) - 1)
+        ts = np.frombuffer(base64.b64decode(entry["ts"]),
+                           dtype=np.int64)[:n]
+        sid = np.frombuffer(base64.b64decode(entry["sid"]),
+                            dtype=np.int64)[:n]
+        rows = np.frombuffer(base64.b64decode(entry["rows"]),
+                             dtype=np.float64).reshape(-1, nb)[:n]
+        under = np.frombuffer(base64.b64decode(entry.get("under", "")),
+                              dtype=np.int64)[:n] \
+            if entry.get("under") else None
+        over = np.frombuffer(base64.b64decode(entry.get("over", "")),
+                             dtype=np.int64)[:n] \
+            if entry.get("over") else None
+        arena = tsdb._histogram_arenas.setdefault(
+            entry["metric"], HistogramArena())
+        key = tuple(entry["bounds"])
+        sub = arena.groups.get(key)
+        if sub is None:
+            sub = arena.groups[key] = HistogramArena._Sub(key, nb)
+        remap = np.vectorize(sid_map.__getitem__,
+                             otypes=[np.int64])(sid) \
+            if len(sid) else sid
+        sub.append_many(ts, remap, rows, under, over)
+        arena.total_points += n
 
 
 # ---------------------------------------------------------------------------

@@ -112,8 +112,11 @@ class TSDB:
         from opentsdb_tpu.core.histogram import HistogramCodecManager
         self.histogram_manager = HistogramCodecManager(self.config)
         self.histogram_store = TimeSeriesStore(num_shards=const.salt_buckets())
-        self._histogram_series: dict[int, list] = {}
-        # guards _histogram_series shape for snapshot-vs-write races
+        # columnar per-metric histogram arenas (HistogramArena): flat
+        # (ts, sid, counts-row) arrays grouped by bounds — queries
+        # slice with vectorized masks instead of walking objects
+        self._histogram_arenas: dict[int, Any] = {}
+        # guards _histogram_arenas shape for snapshot-vs-write races
         self._histogram_lock = threading.Lock()
         # write version for read-side caches of histogram batches
         self._histogram_version = 0
@@ -604,8 +607,12 @@ class TSDB:
         sid = self.histogram_store.get_or_create_series(metric_id, tag_ids)
         ts_ms = codec.to_ms(timestamp)
         with self._histogram_lock:
-            lst = self._histogram_series.setdefault(sid, [])
-            lst.append((ts_ms, hist))
+            from opentsdb_tpu.core.histogram import HistogramArena
+            arena = self._histogram_arenas.get(metric_id)
+            if arena is None:
+                arena = self._histogram_arenas[metric_id] = \
+                    HistogramArena()
+            arena.append(ts_ms, sid, hist)
             self._histogram_version += 1
         if _wal and self.wal is not None:
             self.wal.log_histogram(metric, tags, timestamp, raw_blob)

@@ -61,12 +61,37 @@ def histogram_percentile_pipeline(counts: np.ndarray,
 
     counts [N, NB] float, seg_ids [N] (group * T + ts_idx),
     bounds [NB+1] -> [Q, num_segments].
+
+    N and num_segments are geometrically shape-bucketed (ops.shapes)
+    before jit: point counts and group*T products drift query to
+    query, and an unbucketed first histogram query pays a multi-second
+    compile (r4 bench_e2e config-4 cold was 2.5s). Zero-count pad rows
+    route to a dummy segment that is trimmed from the output.
     """
+    from opentsdb_tpu.ops import shapes
+    rows, nb = counts.shape
+    target = shapes.shape_bucket(rows)
+    seg_pad = shapes.shape_bucket(num_segments + 1)
+    if target != rows:
+        if isinstance(counts, jax.Array):
+            # device-resident (HBM cache hit): pad on device, never a
+            # host round trip
+            counts = jnp.pad(counts, ((0, target - rows), (0, 0)))
+        else:
+            counts = shapes.pad_2d_host(np.asarray(counts), target,
+                                        nb, 0.0)
+    n_seg = len(seg_ids)
+    if n_seg != target:
+        # pad rows (pre-padded cached counts, or the pad above) route
+        # to a dummy segment trimmed from the output
+        seg_ids = np.concatenate(
+            [np.asarray(seg_ids),
+             np.full(target - n_seg, num_segments, dtype=np.int32)])
     mids = ((np.asarray(bounds[:-1]) + np.asarray(bounds[1:])) / 2.0)
     merged = merge_histograms(
         jnp.asarray(counts, dtype=jnp.float32),
-        jnp.asarray(seg_ids, dtype=jnp.int32), num_segments)
+        jnp.asarray(seg_ids, dtype=jnp.int32), seg_pad)
     out = percentiles_from_merged(
         merged, jnp.asarray(mids, dtype=jnp.float32),
         jnp.asarray(np.asarray(qs, dtype=np.float32)))
-    return np.asarray(out)
+    return np.asarray(out)[:, :num_segments]

@@ -114,36 +114,59 @@ def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
             point_ts_arr = meta["point_ts"]
             bounds = meta["bounds"]
     if counts is None:
-        point_counts: list[np.ndarray] = []
-        point_sidx_l: list[int] = []
-        point_ts_l: list[int] = []
-        uniform = True
+        # columnar arena slice (no per-point or per-series Python):
+        # membership + window masks over flat arrays, one fancy-index
+        # gather for the rows (ref analogue: SaltScanner streaming
+        # histogram cells; HistogramSpan assembly collapses into this).
+        # Snapshots are captured under the lock (the append-side lock);
+        # see HistogramArena._Sub.snapshot for why the views stay
+        # stable afterwards.
         with tsdb._histogram_lock:
-            series_pts = [list(tsdb._histogram_series.get(int(s), []))
-                          for s in sids]
-        for i in range(len(sids)):
-            for ts_ms, hist in series_pts[i]:
-                if not (tsq.start_ms <= ts_ms <= tsq.end_ms):
-                    continue
-                b = tuple(hist.bounds)
-                if bounds is None:
-                    bounds = b
-                elif b != bounds:
-                    uniform = False
-                point_counts.append(hist.counts_array())
-                point_sidx_l.append(i)
-                point_ts_l.append(ts_ms)
-        if not point_counts or bounds is None:
+            arena = tsdb._histogram_arenas.get(metric_id)
+            snaps = [(s.bounds, *s.snapshot())
+                     for s in arena.groups.values()] if arena else []
+        if not snaps:
             return []
-        if not uniform:
-            return _run_mixed_bounds(tsdb, tsq, sub, series_pts,
+        order = np.argsort(sids, kind="stable")
+        sorted_sids = np.asarray(sids)[order]
+
+        def member_mask(ts_a, sid_a):
+            pos = np.searchsorted(sorted_sids, sid_a)
+            pos = np.clip(pos, 0, len(sorted_sids) - 1)
+            return pos, ((sorted_sids[pos] == sid_a)
+                         & (ts_a >= tsq.start_ms)
+                         & (ts_a <= tsq.end_ms))
+
+        masked = [(snap, *member_mask(snap[1], snap[2]))
+                  for snap in snaps]
+        active = [(snap, pos, m) for snap, pos, m in masked
+                  if m.any()]
+        if not active:
+            return []
+        if len(active) > 1:
+            # bounds genuinely disagree INSIDE the window: host merge
+            # path with per-slot bounds checks. A bounds class with no
+            # points in the window must not disable the device path
+            # (a single stray historic migration would otherwise
+            # penalize every future query).
+            return _run_mixed_bounds(tsdb, tsq, sub, active, sids,
                                      tag_mat, group_ids, num_groups)
-        counts = np.stack(point_counts)
-        point_sidx = np.asarray(point_sidx_l, dtype=np.int64)
-        point_ts_arr = np.asarray(point_ts_l, dtype=np.int64)
+        (bounds, ts_a, sid_a, rows), pos, member = active[0]
+        counts = rows[member]
+        # index into the caller's sids array (group_ids aligns to it)
+        point_sidx = order[pos[member]].astype(np.int64)
+        point_ts_arr = ts_a[member]
         if cache is not None:
             import jax
             import jax.numpy as jnp
+            from opentsdb_tpu.ops import shapes
+            # cache the counts matrix PRE-PADDED to its shape bucket:
+            # warm queries then skip both the pad alloc and the
+            # re-upload (histogram_percentile_pipeline pads seg_ids to
+            # the row count)
+            n_pad = shapes.shape_bucket(len(counts))
+            counts = shapes.pad_2d_host(counts, n_pad,
+                                        counts.shape[1], 0.0)
             counts = jax.device_put(
                 jnp.asarray(counts, dtype=jnp.float32))
             cache.put(ckey, cver, (counts,), {
@@ -159,10 +182,12 @@ def run_histogram_subquery(tsdb, tsq: TSQuery, sub: TSSubQuery) -> list:
     time_idx, ts_out_arr, in_range = _time_axis(point_ts_arr, tsq, sub)
     gvec = np.asarray(group_ids, dtype=np.int64)[point_sidx]
     if not in_range.all():
-        counts = np.asarray(counts)[in_range]
+        # partial-range: filter the REAL rows (cached counts may carry
+        # shape-bucket padding past len(point_sidx))
+        counts = np.asarray(counts)[:len(point_sidx)][in_range]
         gvec = gvec[in_range]
         time_idx = time_idx[in_range]
-    if counts.shape[0] == 0:
+    if len(gvec) == 0:
         return []
     num_ts = len(ts_out_arr)
     seg = (gvec * num_ts + time_idx).astype(np.int32)
@@ -206,62 +231,72 @@ def _emit_groups(tsdb, tsq, sub, tag_mat, group_ids, num_groups,
     return out
 
 
-def _run_mixed_bounds(tsdb, tsq, sub, series_pts, tag_mat, group_ids,
+def _run_mixed_bounds(tsdb, tsq, sub, active, sids, tag_mat, group_ids,
                       num_groups) -> list:
-    """Host fallback when histograms in the window disagree on bucket
-    bounds: per-group dict merge like the reference's iterator chain.
-    With a downsample spec, points merge into their downsample bucket
-    (bounds must agree within a bucket, like the reference's
-    HistogramDownsampler SUM over one interval)."""
+    """Host fallback when the window's histograms disagree on bucket
+    bounds: per-group merge keyed on the output timestamp, each slot
+    keeping its own bounds (the reference merges Histogram objects per
+    emitted timestamp; bounds must agree across series AT one ts — ref
+    HistogramAggregationIterator). Slot assignment and per-point group
+    ids are computed ONCE per bounds-class; the per-group work is a
+    mask + segment-sum, no per-point Python.
+
+    ``active`` carries pre-masked snapshots:
+    [((bounds, ts, sid, rows), pos, window_member_mask), ...].
+    """
     from opentsdb_tpu.query.engine import QueryResult, _common_tags
     from opentsdb_tpu.ops import downsample as ds_mod
     uids = tsdb.uids
-    order = np.argsort(group_ids, kind="stable")
-    sorted_gids = group_ids[order]
-    gid_range = np.arange(num_groups, dtype=group_ids.dtype)
-    starts = np.searchsorted(sorted_gids, gid_range, side="left")
-    ends = np.searchsorted(sorted_gids, gid_range, side="right")
+    sids = np.asarray(sids)
+    sid_order = np.argsort(sids, kind="stable")
+    sorted_sids = sids[sid_order]
+    gids_sorted = np.asarray(group_ids)[sid_order]
+
+    # per bounds-class precompute: filtered points, their group ids,
+    # and their output slot (group-independent)
+    pre = []
+    for (bounds, ts_a, sid_a, rows), _pos, m in active:
+        ts_f, sid_f, rows_f = ts_a[m], sid_a[m], rows[m]
+        pos = np.searchsorted(sorted_sids, sid_f)
+        point_gid = gids_sorted[np.clip(pos, 0, len(sorted_sids) - 1)]
+        if sub.ds_spec is not None:
+            bidx, bts = ds_mod.assign_buckets(
+                ts_f, sub.ds_spec, tsq.start_ms, tsq.end_ms)
+            bidx = np.asarray(bidx)
+            bts = np.asarray(bts)
+            ok = (bidx >= 0) & (bidx < len(bts))
+            slots = bts[np.clip(bidx, 0, len(bts) - 1)]
+            ts_f, rows_f = ts_f[ok], rows_f[ok]
+            point_gid, slots = point_gid[ok], slots[ok]
+        else:
+            slots = ts_f
+        pre.append((bounds, point_gid, slots, rows_f))
+
     out = []
     for gid in range(num_groups):
-        members = order[starts[gid]:ends[gid]]
-        if len(members) == 0:
-            continue
-        # merge per output timestamp, each keeping its own bucket
-        # bounds (the reference merges Histogram objects per emitted
-        # timestamp; bounds only need to agree across series AT one ts)
         merged: dict[int, tuple[tuple, np.ndarray]] = {}
-        for i in members:
-            pts = series_pts[int(i)]
-            if not pts:
+        for b, point_gid, slots_all, rows_f in pre:
+            gmask = point_gid == gid
+            if not gmask.any():
                 continue
-            ts_arr = np.asarray([t for t, _ in pts], dtype=np.int64)
-            ok = (ts_arr >= tsq.start_ms) & (ts_arr <= tsq.end_ms)
-            if sub.ds_spec is not None:
-                bidx, bts = ds_mod.assign_buckets(
-                    ts_arr, sub.ds_spec, tsq.start_ms, tsq.end_ms)
-                bidx = np.asarray(bidx)
-                bts = np.asarray(bts)
-                ok &= (bidx >= 0) & (bidx < len(bts))
-                slot_ts = np.where(ok, bts[np.clip(bidx, 0,
-                                                   len(bts) - 1)], -1)
-            else:
-                slot_ts = np.where(ok, ts_arr, -1)
-            for (_, hist), slot in zip(pts, slot_ts.tolist()):
-                if slot < 0:
-                    continue
-                arr = hist.counts_array()
-                b = tuple(hist.bounds)
+            slots = slots_all[gmask]
+            uniq, inv = np.unique(slots, return_inverse=True)
+            acc = np.zeros((len(uniq), rows_f.shape[1]),
+                           dtype=np.float64)
+            np.add.at(acc, inv, rows_f[gmask])
+            for k, slot in enumerate(uniq.tolist()):
                 if slot in merged:
-                    b0, acc = merged[slot]
+                    b0, prev = merged[slot]
                     if b0 != b:
                         raise BadRequestError(
                             "cannot merge histograms with different "
                             f"buckets at timestamp {slot}")
-                    merged[slot] = (b0, acc + arr)
+                    merged[slot] = (b0, prev + acc[k])
                 else:
-                    merged[slot] = (b, arr)
+                    merged[slot] = (b, acc[k])
         if not merged:
             continue
+        members = np.nonzero(np.asarray(group_ids) == gid)[0]
         ts_sorted = sorted(merged)
         pcts = np.stack([
             percentiles_from_counts(

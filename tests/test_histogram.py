@@ -206,6 +206,38 @@ class TestDeviceKernels:
         assert dps[1356998400000] == 5.0    # [0,10) midpoint
         assert dps[1356998460000] == 7.5    # [5,10) midpoint
 
+    def test_uniform_window_keeps_device_path(self, tsdb):
+        """A stray historic bounds class outside the window must NOT
+        route a bounds-uniform window to the host fallback (r4 review:
+        one bounds migration would otherwise disable the device path
+        for every future query)."""
+        from opentsdb_tpu.query.model import TSQuery
+        old = tsdb.histogram_manager.encode(
+            hist([0.0, 5.0, 10.0], [3, 3]))
+        tsdb.add_histogram_point("u.lat", 1356990000, old,
+                                 {"host": "a"})
+        for i in range(3):
+            blob = tsdb.histogram_manager.encode(
+                hist([0.0, 10.0, 20.0], [10, 0]))
+            tsdb.add_histogram_point("u.lat", 1356998400 + i * 60,
+                                     blob, {"host": "a"})
+        q = TSQuery.from_json({
+            "start": 1356998000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "u.lat",
+                         "percentiles": [50.0]}]})
+        results = tsdb.execute_query(q.validate())
+        dps = dict(results[0].dps)
+        assert len(dps) == 3
+        assert all(v == 5.0 for v in dps.values())
+        # the full span INCLUDING the old bounds class still answers
+        # (host merge path, per-slot bounds)
+        q2 = TSQuery.from_json({
+            "start": 1356980000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "u.lat",
+                         "percentiles": [50.0]}]})
+        r2 = tsdb.execute_query(q2.validate())
+        assert len(dict(r2[0].dps)) == 4
+
 
 # ---------------------------------------------------------------------------
 # write + query path (ref: TestTsdbQueryHistogram*: /api/histogram

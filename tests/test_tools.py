@@ -68,12 +68,14 @@ class TestPersistence:
         t1.flush()
 
         t2 = TSDB(Config(**{"tsd.storage.data_dir": data_dir}))
-        assert len(t2._histogram_series) == 1
-        (sid, pts), = t2._histogram_series.items()
-        ts, h2 = pts[0]
+        (mid, arena), = t2._histogram_arenas.items()
+        assert arena.total_points == 1
+        (ts, sid, bounds, row), = arena.iter_points()
         assert ts == BASE * 1000
-        assert h2.percentile(99.0) == h.percentile(99.0)
+        assert bounds == (0.0, 10.0, 20.0)
+        np.testing.assert_array_equal(row, [5.0, 15.0])
         rec = t2.histogram_store.series(sid)
+        assert rec.metric_id == mid
         assert t2.uids.metrics.get_name(rec.metric_id) == "lat"
 
     def test_snapshot_meta(self, data_dir):

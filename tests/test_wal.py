@@ -109,7 +109,8 @@ class TestWalRecovery:
         tier = t2.rollup_store.tier("1m", "sum")
         assert tier.points_written == 1
         assert t2.rollup_store.preagg_store().points_written == 1
-        assert len(t2._histogram_series) == 1
+        assert sum(a.total_points
+                   for a in t2._histogram_arenas.values()) == 1
         assert t2.annotations.global_range(BASE - 5, BASE + 5)
 
     def test_uid_assignment_replay(self, tmp_path):
