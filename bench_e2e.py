@@ -204,12 +204,18 @@ def bench_config4(repeats: int, n_series: int = 200_000) -> dict:
     rng = np.random.default_rng(3)
     all_counts = rng.integers(0, 50, (n_series, 64))
     t0 = time.perf_counter()
+    batch = []
     for i in range(n_series):
         h = SimpleHistogram(bounds)
         h.counts = all_counts[i].tolist()
-        blob = tsdb.histogram_manager.encode(h)
-        tsdb.add_histogram_point("sys.bench4", BASE_S, blob,
-                                 {"host": f"h{i:07d}"})
+        batch.append(("sys.bench4", BASE_S,
+                      tsdb.histogram_manager.encode(h),
+                      {"host": f"h{i:07d}"}))
+        if len(batch) == 25_000:
+            tsdb.add_histogram_batch(batch)
+            batch = []
+    if batch:
+        tsdb.add_histogram_batch(batch)
     ingest_s = time.perf_counter() - t0
     stats, body = _run_query(
         tsdb, _serializer(), {

@@ -229,6 +229,14 @@ def _load_histograms(tsdb, data_dir: str) -> None:
         sid_map[int(old_sid)] = tsdb.histogram_store \
             .get_or_create_series(ident["metric"],
                                   [tuple(p) for p in ident["tags"]])
+    # dense LUT remap, built once (vectorized; a per-element dict call
+    # would re-add the per-point Python walk this layout removed)
+    if sid_map:
+        old_ids = np.fromiter(sid_map, dtype=np.int64,
+                              count=len(sid_map))
+        lut = np.zeros(int(old_ids.max()) + 1, dtype=np.int64)
+        lut[old_ids] = np.fromiter(sid_map.values(), dtype=np.int64,
+                                   count=len(sid_map))
     for entry in doc.get("arenas", []):
         n = int(entry["n"])
         nb = max(1, len(entry["bounds"]) - 1)
@@ -250,9 +258,7 @@ def _load_histograms(tsdb, data_dir: str) -> None:
         sub = arena.groups.get(key)
         if sub is None:
             sub = arena.groups[key] = HistogramArena._Sub(key, nb)
-        remap = np.vectorize(sid_map.__getitem__,
-                             otypes=[np.int64])(sid) \
-            if len(sid) else sid
+        remap = lut[sid] if len(sid) else sid
         sub.append_many(ts, remap, rows, under, over)
         arena.total_points += n
 

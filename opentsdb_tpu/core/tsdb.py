@@ -596,6 +596,77 @@ class TSDB:
             self.wal.sync()
         self.datapoints_added += 1
 
+    def add_histogram_batch(self, points, on_error=None
+                            ) -> tuple[int, list[str]]:
+        """Bulk write ``(metric, timestamp, raw_blob, tags)`` histogram
+        tuples, grouping by series so validation + UID resolution run
+        once per series instead of once per point (the histogram twin
+        of :meth:`add_point_batch`; per-point work that remains —
+        codec decode + arena append — is inherent). WAL-synced once
+        per batch. Returns (written, error strings)."""
+        from opentsdb_tpu.core.histogram import HistogramArena
+        groups: dict[tuple, list] = {}
+        errors: list[str] = []
+        written = 0
+
+        def fail(idx: int, metric: str, ts, e: Exception) -> None:
+            errors.append(f"{metric} @{ts}: {e}")
+            if on_error is not None:
+                on_error(idx, e)
+
+        for i, (metric, ts, blob, tags) in enumerate(points):
+            key = (metric, tuple(sorted(tags.items())))
+            groups.setdefault(key, []).append((i, ts, blob, tags))
+        for (metric, _), items in groups.items():
+            tags = items[0][3]
+            try:
+                tags_mod.check_metric_and_tags(metric, tags)
+            except Exception as e:  # noqa: BLE001
+                for idx, ts, _b, _t in items:
+                    fail(idx, metric, ts, e)
+                continue
+            # validate + decode every point BEFORE touching the UID
+            # tables: a fully-invalid group must not pollute UID space
+            # or create an empty series (matches add_histogram_point,
+            # which validates first and creates nothing on failure)
+            valid: list[tuple] = []
+            for idx, ts, blob, _t in items:
+                try:
+                    self._check_timestamp(ts)
+                    hist = self.histogram_manager.decode(blob)
+                    valid.append((idx, ts, blob,
+                                  codec.to_ms(ts), hist))
+                except Exception as e:  # noqa: BLE001
+                    fail(idx, metric, ts, e)
+            if not valid:
+                continue
+            try:
+                metric_id, tag_ids = self._resolve_write_uids(metric,
+                                                              tags)
+                sid = self.histogram_store.get_or_create_series(
+                    metric_id, tag_ids)
+            except Exception as e:  # noqa: BLE001
+                for idx, ts, _b, _tm, _h in valid:
+                    fail(idx, metric, ts, e)
+                continue
+            # one lock take for the whole group's appends
+            with self._histogram_lock:
+                arena = self._histogram_arenas.get(metric_id)
+                if arena is None:
+                    arena = self._histogram_arenas[metric_id] = \
+                        HistogramArena()
+                for _idx, _ts, _b, ts_ms, hist in valid:
+                    arena.append(ts_ms, sid, hist)
+                self._histogram_version += 1
+            if self.wal is not None:
+                for _idx, ts, blob, _tm, _h in valid:
+                    self.wal.log_histogram(metric, tags, ts, blob)
+            self.datapoints_added += len(valid)
+            written += len(valid)
+        if written and self.wal is not None:
+            self.wal.sync()
+        return written, errors
+
     def add_histogram_point(self, metric: str, timestamp: int,
                             raw_blob: bytes, tags: dict[str, str],
                             _wal: bool = True) -> int:

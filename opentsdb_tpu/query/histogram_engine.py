@@ -272,6 +272,14 @@ def _run_mixed_bounds(tsdb, tsq, sub, active, sids, tag_mat, group_ids,
             slots = ts_f
         pre.append((bounds, point_gid, slots, rows_f))
 
+    # one argsort for per-group member recovery (same pattern as
+    # _emit_groups; an == scan per group would be O(G x S))
+    gid_order = np.argsort(group_ids, kind="stable")
+    gids_in_order = np.asarray(group_ids)[gid_order]
+    gid_range = np.arange(num_groups, dtype=np.asarray(group_ids).dtype)
+    g_starts = np.searchsorted(gids_in_order, gid_range, side="left")
+    g_ends = np.searchsorted(gids_in_order, gid_range, side="right")
+
     out = []
     for gid in range(num_groups):
         merged: dict[int, tuple[tuple, np.ndarray]] = {}
@@ -296,7 +304,7 @@ def _run_mixed_bounds(tsdb, tsq, sub, active, sids, tag_mat, group_ids,
                     merged[slot] = (b, acc[k])
         if not merged:
             continue
-        members = np.nonzero(np.asarray(group_ids) == gid)[0]
+        members = gid_order[g_starts[gid]:g_ends[gid]]
         ts_sorted = sorted(merged)
         pcts = np.stack([
             percentiles_from_counts(

@@ -407,17 +407,23 @@ class HttpRpcRouter:
         if request.method != "POST":
             raise HttpError(405, "Method not allowed")
         points = request.serializer.parse_put(request.body)
-        success = 0
         errors: list[dict] = []
+        parsed: list[tuple] = []
+        dps: list[dict] = []
         for dp in points:
             try:
-                blob = base64.b64decode(dp["value"])
-                self.tsdb.add_histogram_point(
-                    dp["metric"], int(dp["timestamp"]), blob,
-                    dp.get("tags") or {})
-                success += 1
+                parsed.append((dp["metric"], int(dp["timestamp"]),
+                               base64.b64decode(dp["value"]),
+                               dp.get("tags") or {}))
+                dps.append(dp)
             except Exception as e:  # noqa: BLE001
                 errors.append({"datapoint": dp, "error": str(e)})
+
+        def on_error(i: int, e: Exception) -> None:
+            errors.append({"datapoint": dps[i], "error": str(e)})
+
+        success, _ = self.tsdb.add_histogram_batch(parsed,
+                                                   on_error=on_error)
         if errors and not request.flag("details") \
                 and not request.flag("summary"):
             raise HttpError(400, "One or more data points had errors")

@@ -206,6 +206,98 @@ class TestDeviceKernels:
         assert dps[1356998400000] == 5.0    # [0,10) midpoint
         assert dps[1356998460000] == 7.5    # [5,10) midpoint
 
+    def test_add_histogram_batch(self, tsdb):
+        """Batch twin of add_histogram_point: per-series UID
+        amortization, per-point errors, good points land."""
+        blob = tsdb.histogram_manager.encode(
+            hist([0.0, 10.0, 20.0], [10, 0]))
+        seen = []
+        written, errors = tsdb.add_histogram_batch([
+            ("hb.m", 1356998400, blob, {"host": "a"}),
+            ("hb.m", 1356998460, blob, {"host": "a"}),
+            ("hb.m", -5, blob, {"host": "a"}),         # bad ts
+            ("hb.m", 1356998400, b"", {"host": "a"}),  # bad blob
+            ("hb.m", 1356998400, blob, {}),            # no tags
+            ("hb.m", 1356998520, blob, {"host": "b"}),
+        ], on_error=lambda i, e: seen.append(i))
+        assert written == 3
+        assert len(errors) == 3 and sorted(seen) == [2, 3, 4]
+        # a fully-invalid batch must not pollute the UID table or
+        # create empty series (r4 review finding)
+        w2, e2 = tsdb.add_histogram_batch(
+            [("never.metric", -5, blob, {"h": "a"})])
+        assert w2 == 0 and len(e2) == 1
+        assert not tsdb.uids.metrics.has_name("never.metric")
+        arena = tsdb._histogram_arenas[
+            tsdb.uids.metrics.get_id("hb.m")]
+        assert arena.total_points == 3
+        from opentsdb_tpu.query.model import TSQuery
+        r = tsdb.execute_query(TSQuery.from_json({
+            "start": 1356998000, "end": 1356999000,
+            "queries": [{"aggregator": "sum", "metric": "hb.m",
+                         "percentiles": [50.0]}]}).validate())
+        assert len(dict(r[0].dps)) == 3
+
+    def test_batch_matches_per_point_results(self, tsdb):
+        blob = tsdb.histogram_manager.encode(
+            hist([0.0, 10.0], [4], underflow=1))
+        tsdb.add_histogram_batch(
+            [("bm.a", 1356998400 + i, blob, {"h": "x"})
+             for i in range(5)])
+        for i in range(5):
+            tsdb.add_histogram_point("bm.b", 1356998400 + i, blob,
+                                     {"h": "x"})
+        from opentsdb_tpu.query.model import TSQuery
+
+        def q(metric):
+            return tsdb.execute_query(TSQuery.from_json({
+                "start": 1356998000, "end": 1356999000,
+                "queries": [{"aggregator": "sum", "metric": metric,
+                             "percentiles": [95.0]}]}).validate())
+
+        assert [v for _, v in q("bm.a")[0].dps] == \
+            [v for _, v in q("bm.b")[0].dps]
+
+    def test_arena_growth_and_snapshot_stability(self):
+        """Snapshots captured before a growth-resize must stay valid:
+        np.resize REPLACES the arrays, so earlier views keep their
+        [0, n) contents (the lock-free read contract)."""
+        from opentsdb_tpu.core.histogram import (HistogramArena,
+                                                 SimpleHistogram)
+        arena = HistogramArena()
+        h = SimpleHistogram([0.0, 1.0, 2.0])
+        h.counts = [1, 2]
+        for i in range(10):
+            arena.append(i, i % 3, h)
+        (sub,) = arena.groups.values()
+        ts0, sid0, rows0 = sub.snapshot()
+        # force growth past the initial capacity
+        for i in range(3000):
+            arena.append(100 + i, 0, h)
+        np.testing.assert_array_equal(ts0, np.arange(10))
+        np.testing.assert_array_equal(sid0, np.arange(10) % 3)
+        np.testing.assert_array_equal(rows0, [[1.0, 2.0]] * 10)
+        assert arena.total_points == 3010
+        ts1, _, rows1 = sub.snapshot()
+        assert len(ts1) == 3010 and rows1.shape == (3010, 2)
+
+    def test_arena_preserves_underflow_overflow(self, tsdb, tmp_path):
+        """under/overflow counters survive the columnar snapshot
+        round trip (the v1 object store preserved them; v2 must too).
+        """
+        from opentsdb_tpu import TSDB, Config
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.storage.data_dir": str(tmp_path)}
+        t = TSDB(Config(**cfg))
+        blob = t.histogram_manager.encode(
+            hist([0.0, 10.0], [5], underflow=7, overflow=9))
+        t.add_histogram_point("uo.m", 1356998400, blob, {"h": "a"})
+        t.flush()
+        t2 = TSDB(Config(**cfg))
+        (arena,) = t2._histogram_arenas.values()
+        (sub,) = arena.groups.values()
+        assert sub.under[0] == 7 and sub.over[0] == 9
+
     def test_uniform_window_keeps_device_path(self, tsdb):
         """A stray historic bounds class outside the window must NOT
         route a bounds-uniform window to the host fallback (r4 review:
