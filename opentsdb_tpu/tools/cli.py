@@ -123,12 +123,23 @@ def cmd_tsd(config: Config, args: list[str]) -> int:
 
 
 def cmd_query(config: Config, args: list[str]) -> int:
-    """``tsdb query START [END] <aggregator:[ds:][rate:]metric tagk=v...>``
-    (ref: CliQuery.java:34). Output: ``metric timestamp value tags``."""
+    """``tsdb query [--graph PATH] START [END]
+    <aggregator:[ds:][rate:]metric tagk=v...>`` (ref: CliQuery.java:34,
+    incl. its --graph basepath chart output — matplotlib PNG here
+    instead of gnuplot files). Output: ``metric timestamp value tags``.
+    """
     from opentsdb_tpu.query.model import TSQuery, parse_uri_subquery
+    graph_path = None
+    if "--graph" in args:
+        i = args.index("--graph")
+        if i + 1 >= len(args):
+            print("--graph needs a PATH", file=sys.stderr)
+            return 2
+        graph_path = args[i + 1]
+        del args[i:i + 2]
     if len(args) < 2:
-        print("usage: tsdb query START-DATE [END-DATE] [queries...]",
-              file=sys.stderr)
+        print("usage: tsdb query [--graph PATH] START-DATE [END-DATE] "
+              "[queries...]", file=sys.stderr)
         return 2
     start = args[0]
     pos = 1
@@ -151,8 +162,30 @@ def cmd_query(config: Config, args: list[str]) -> int:
         subs.append(parse_uri_subquery(spec, len(subs)))
     tsq = TSQuery(start=start, end=end, queries=subs)
     tsq.validate()
+    if graph_path:
+        # fail fast BEFORE running the query: scanning a large range
+        # only to discard the results on a missing optional dep is
+        # wasted work
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            print("--graph requires matplotlib", file=sys.stderr)
+            return 2
     tsdb = make_tsdb(config)
     results = tsdb.new_query().run(tsq)
+    if graph_path:
+        from opentsdb_tpu.tsd.graph import plot_results_basic
+        fig, ax = plt.subplots(figsize=(10, 6), dpi=100)
+        plot_results_basic(ax, results)
+        if results:
+            ax.legend(fontsize=8)
+        fig.autofmt_xdate()
+        fig.savefig(graph_path)
+        plt.close(fig)
+        print(f"wrote {graph_path}")
+        return 0
     for r in results:
         tag_str = " ".join(f"{k}={v}" for k, v in sorted(r.tags.items()))
         for ts, v in r.dps:

@@ -72,6 +72,27 @@ def _smooth(xs: np.ndarray, ys: np.ndarray
     return dense, np.interp(dense, xs, ys)
 
 
+def series_label(r) -> str:
+    """Legend label for one result series: ``metric{k=v,...}``."""
+    label = r.metric
+    if r.tags:
+        label += "{" + ",".join(f"{k}={v}"
+                                for k, v in sorted(r.tags.items())) + "}"
+    return label
+
+
+def plot_results_basic(ax, results, smooth=None, style_kw=None) -> None:
+    """Plot each result series onto ``ax`` (shared by the /q renderer
+    and the CLI ``tsdb query --graph`` output)."""
+    style_kw = style_kw or {}
+    for r in results:
+        xs = np.asarray([ts / 1000 for ts, _ in r.dps])
+        ys = np.asarray([v for _, v in r.dps], dtype=float)
+        if smooth and not style_kw.get("linestyle") == "":
+            xs, ys = _smooth(xs, ys)
+        ax.plot(xs, ys, label=series_label(r), linewidth=1, **style_kw)
+
+
 def handle_graph(router, request):
     from opentsdb_tpu.tsd.http_api import HttpError, HttpResponse
     from opentsdb_tpu.stats.stats import QueryStats
@@ -158,10 +179,7 @@ def _render(router, request, tsq, results):
     smooth = request.flag("smooth") or request.param("smooth")
 
     for r in results:
-        label = r.metric
-        if r.tags:
-            label += "{" + ",".join(f"{k}={v}"
-                                    for k, v in sorted(r.tags.items())) + "}"
+        label = series_label(r)
         xs = np.asarray([ts / 1000 for ts, _ in r.dps])
         ys = np.asarray([v for _, v in r.dps], dtype=float)
         if smooth and not style_kw.get("linestyle") == "":

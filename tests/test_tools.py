@@ -154,6 +154,22 @@ class TestImportQueryScan:
         assert code in (0, 2)
 
 
+class TestCliQueryGraph:
+    def test_graph_writes_png(self, data_dir, tmp_path, capsys):
+        """(ref: CliQuery --graph basepath chart output)"""
+        pytest.importorskip("matplotlib")
+        f = tmp_path / "g.txt"
+        f.write_text("\n".join(
+            f"gm {BASE + i * 10} {i} host=a" for i in range(10)) + "\n")
+        run_cli(["import", *datadir_args(data_dir), str(f)], capsys)
+        png = tmp_path / "chart.png"
+        code, out, _ = run_cli(
+            ["query", *datadir_args(data_dir), "--graph", str(png),
+             str(BASE), str(BASE + 200), "sum:gm"], capsys)
+        assert code == 0 and "wrote" in out
+        assert png.read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
 class TestImportEdgeMatrix:
     """Line-format value/timestamp edge matrix (ref:
     test/tools/TestTextImporter.java's importFile* scenarios)."""
