@@ -67,6 +67,19 @@ class TSSubQuery:
                 self.ds_spec = dataclasses.replace(
                     self.ds_spec, use_calendar=True)
 
+    def identity_key(self) -> tuple:
+        """Value identity excluding ``index`` (ref: TSSubQuery
+        equals/hashCode, used by parseQuery's duplicate filter)."""
+        return (self.aggregator, self.metric, tuple(self.tsuids),
+                self.downsample, self.rate,
+                (self.rate_options.counter,
+                 self.rate_options.counter_max,
+                 self.rate_options.reset_value,
+                 self.rate_options.drop_resets),
+                tuple(repr(f.to_json()) for f in self.filters),
+                self.explicit_tags, tuple(self.percentiles),
+                self.rollup_usage)
+
     @classmethod
     def from_json(cls, obj: dict[str, Any], index: int = 0) -> "TSSubQuery":
         filters = [filters_mod.build_filter(f)
@@ -150,6 +163,24 @@ class TSQuery:
         for i, sub in enumerate(self.queries):
             sub.index = i
             sub.validate(self.timezone, self.use_calendar)
+        return self
+
+    def dedupe_queries(self) -> "TSQuery":
+        """Collapse duplicate sub-queries, first occurrence wins.
+
+        Applied by the /api/query URI handler ONLY (ref:
+        QueryRpc.parseQuery :617 rebuilds through a LinkedHashSet;
+        POST bodies keep duplicates — parseQueryV1 has no such filter
+        — and /q must keep them so per-index ``o=`` options align)."""
+        seen: set = set()
+        deduped = []
+        for sub in self.queries:
+            key = sub.identity_key()
+            if key in seen:
+                continue
+            seen.add(key)
+            deduped.append(sub)
+        self.queries = deduped
         return self
 
     @classmethod

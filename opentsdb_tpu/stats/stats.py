@@ -18,6 +18,12 @@ from enum import Enum
 from typing import Any
 
 
+class DuplicateQueryError(ValueError):
+    """An identical query is already in flight from the same endpoint
+    and ``tsd.query.allow_simultaneous_duplicates`` is off (ref:
+    QueryException from QueryStats.java:263)."""
+
+
 class StatsCollector:
     """(ref: StatsCollector.java:35) Collects ``name value tags`` records."""
 
@@ -193,14 +199,35 @@ class QueryStats:
     _registry_lock = threading.Lock()
     _next_id = 0
 
-    def __init__(self, remote: str = "", query: Any = None):
+    def __init__(self, remote: str = "", query: Any = None,
+                 allow_duplicates: bool = True):
         self.remote = remote
         self.query = query
         self.start_ns = time.monotonic_ns()
         self.start_time = time.time()
         self.stats: dict[str, float] = {}
         self.executed = False
+        # identity for the duplicate check: endpoint + query content
+        # (ref: QueryStats.java:70-73 — "hash is the remote + query").
+        # Computed only when duplicates are restricted — serializing
+        # the whole TSQuery per request would tax the default hot path
+        # for a comparison nothing performs.
+        self.dup_key = None
+        if not allow_duplicates:
+            try:
+                qjson = query.to_json() if query is not None else None
+            except Exception:  # noqa: BLE001
+                qjson = repr(query)
+            self.dup_key = (remote, repr(qjson))
         with QueryStats._registry_lock:
+            if not allow_duplicates and any(
+                    r.dup_key == self.dup_key
+                    for r in QueryStats._running.values()):
+                # (ref: QueryStats ctor :263 throws QueryException when
+                # ENABLE_DUPLICATES is off — surfaced as a 400)
+                raise DuplicateQueryError(
+                    "Query is already executing for endpoint: "
+                    f"{remote}")
             QueryStats._next_id += 1
             self.query_id = QueryStats._next_id
             QueryStats._running[self.query_id] = self

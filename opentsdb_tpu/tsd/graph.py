@@ -81,16 +81,20 @@ def series_label(r) -> str:
     return label
 
 
-def plot_results_basic(ax, results, smooth=None, style_kw=None) -> None:
-    """Plot each result series onto ``ax`` (shared by the /q renderer
-    and the CLI ``tsdb query --graph`` output)."""
+def plot_results_basic(ax, results, smooth=None, style_kw=None,
+                       axis_for=None) -> None:
+    """Plot each result series (shared by the /q renderer and the CLI
+    ``tsdb query --graph`` output). ``axis_for(r)`` may route a series
+    to another axes (the /q per-metric ``o=axis x1y2`` option)."""
     style_kw = style_kw or {}
     for r in results:
         xs = np.asarray([ts / 1000 for ts, _ in r.dps])
         ys = np.asarray([v for _, v in r.dps], dtype=float)
         if smooth and not style_kw.get("linestyle") == "":
             xs, ys = _smooth(xs, ys)
-        ax.plot(xs, ys, label=series_label(r), linewidth=1, **style_kw)
+        target = axis_for(r) if axis_for is not None else ax
+        target.plot(xs, ys, label=series_label(r), linewidth=1,
+                    **style_kw)
 
 
 def handle_graph(router, request):
@@ -101,7 +105,10 @@ def handle_graph(router, request):
         raise HttpError(400, "Missing 'm' parameter",
                         "Nothing to graph without a metric query")
     tsq.validate()
-    stats = QueryStats(request.remote, tsq)
+    stats = QueryStats(
+        request.remote, tsq,
+        allow_duplicates=router.tsdb.config.get_bool(
+            "tsd.query.allow_simultaneous_duplicates", True))
     try:
         results = router.tsdb.new_query().run(tsq, stats)
         response = _render(router, request, tsq, results)
@@ -178,17 +185,14 @@ def _render(router, request, tsq, results):
     style_kw = _STYLES.get(request.param("style", ""), {})
     smooth = request.flag("smooth") or request.param("smooth")
 
-    for r in results:
-        label = series_label(r)
-        xs = np.asarray([ts / 1000 for ts, _ in r.dps])
-        ys = np.asarray([v for _, v in r.dps], dtype=float)
-        if smooth and not style_kw.get("linestyle") == "":
-            xs, ys = _smooth(xs, ys)
-        target = ax
+    def axis_for(r):
         if ax2 is not None and r.sub_query_index < len(opts) and \
                 "x1y2" in opts[r.sub_query_index]:
-            target = ax2
-        target.plot(xs, ys, label=label, linewidth=1, **style_kw)
+            return ax2
+        return ax
+
+    plot_results_basic(ax, results, smooth=smooth, style_kw=style_kw,
+                       axis_for=axis_for)
 
     # annotation markers: dashed vertical lines at each note's start
     # (ref: Plot.java renders annotations as gnuplot arrows/labels on

@@ -451,7 +451,9 @@ class HttpRpcRouter:
             obj = request.serializer.parse_query(request.body)
             tsq = TSQuery.from_json(obj)
         elif request.method in ("GET", "DELETE"):
-            tsq = parse_uri_query(request.params)
+            # URI form dedups identical m= specs (ref:
+            # QueryRpc.parseQuery :617); POST keeps duplicates
+            tsq = parse_uri_query(request.params).dedupe_queries()
         else:
             raise HttpError(405, "Method not allowed")
         tsq.validate()
@@ -461,7 +463,10 @@ class HttpRpcRouter:
                 raise HttpError(400, "Deleting data is not enabled",
                                 "set tsd.http.query.allow_delete")
             tsq.delete = True
-        stats = QueryStats(request.remote, tsq)
+        stats = QueryStats(
+            request.remote, tsq,
+            allow_duplicates=self.tsdb.config.get_bool(
+                "tsd.query.allow_simultaneous_duplicates", True))
         streamed = False
         try:
             results = self.tsdb.new_query().run(tsq, stats)

@@ -261,15 +261,59 @@ class TestQueryUriParseMatrix:
             req("GET", "/api/query", start=BASE))
         assert resp.status == 400
 
-    def test_duplicate_m_params_deduped_rows(self, seeded_router):
-        # two identical m= specs produce two result sets (the
-        # reference keeps both sub-queries)
+    def test_duplicate_m_params_collapse(self, seeded_router):
+        # identical m= specs collapse to ONE sub-query (ref:
+        # QueryRpc.parseQuery :617 LinkedHashSet rebuild); differing
+        # specs stay separate
         r = seeded_router.handle(HttpRequest(
             method="GET", path="/api/query",
             params={"start": [str(BASE - 10)], "end": [str(BASE + 3000)],
                     "m": ["sum:sys.cpu.user", "sum:sys.cpu.user"]},
             body=b""))
+        assert r.status == 200 and len(parse(r)) == 1
+        r = seeded_router.handle(HttpRequest(
+            method="GET", path="/api/query",
+            params={"start": [str(BASE - 10)], "end": [str(BASE + 3000)],
+                    "m": ["sum:sys.cpu.user", "max:sys.cpu.user"]},
+            body=b""))
         assert r.status == 200 and len(parse(r)) == 2
+
+    def test_post_keeps_duplicate_subqueries(self, seeded_router):
+        # the dedup is URI-only (parseQueryV1 has no LinkedHashSet
+        # filter): POST bodies keep position-aligned duplicates
+        r = seeded_router.handle(req(
+            "POST", "/api/query",
+            body={"start": BASE - 10, "end": BASE + 3000,
+                  "queries": [
+                      {"metric": "sys.cpu.user", "aggregator": "sum"},
+                      {"metric": "sys.cpu.user", "aggregator": "sum"},
+                  ]}))
+        assert r.status == 200 and len(parse(r)) == 2
+
+    def test_simultaneous_duplicate_rejection(self, seeded_tsdb):
+        """tsd.query.allow_simultaneous_duplicates=false rejects an
+        identical in-flight query (ref: QueryStats.java:263)."""
+        from opentsdb_tpu.stats.stats import (DuplicateQueryError,
+                                              QueryStats)
+        from opentsdb_tpu.query.model import TSQuery
+        tsq = TSQuery.from_json({
+            "start": BASE - 10, "end": BASE + 3000,
+            "queries": [{"metric": "sys.cpu.user",
+                         "aggregator": "sum"}]}).validate()
+        s1 = QueryStats("1.2.3.4:1", tsq, allow_duplicates=False)
+        try:
+            with pytest.raises(DuplicateQueryError):
+                QueryStats("1.2.3.4:1", tsq, allow_duplicates=False)
+            # a different endpoint or allow_duplicates=True is fine
+            s2 = QueryStats("5.6.7.8:1", tsq, allow_duplicates=False)
+            s2.mark_complete()
+            s3 = QueryStats("1.2.3.4:1", tsq, allow_duplicates=True)
+            s3.mark_complete()
+        finally:
+            s1.mark_complete()
+        # once completed, the same query runs again
+        s4 = QueryStats("1.2.3.4:1", tsq, allow_duplicates=False)
+        s4.mark_complete()
 
     def test_explicit_tags_narrowing(self, tsdb):
         # explicit_tags: series with EXTRA tags are excluded
