@@ -26,19 +26,21 @@ def make_tsdb():
                           **EXTRA_CONFIG}))
 
 
-def _seed(tsdb, num_series=7, seed=0):
+def _seed(tsdb, num_series=7, seed=0, n_range=(5, 60),
+          mean=50.0, std=20.0):
     """Irregular per-series timestamps on a 10s lattice (lattice keeps
     the oracle's bucket math exact), one group per host tag."""
     rng = np.random.default_rng(seed)
     series = []
     for i in range(num_series):
-        n = int(rng.integers(5, 60))
-        offs = np.sort(rng.choice(600, size=n, replace=False))
+        n = int(rng.integers(*n_range))
+        offs = np.sort(rng.choice(600, size=min(n, 600),
+                                  replace=False))
         ts_s = BASE + offs * 10
-        vals = np.round(rng.normal(50, 20, n), 3)
+        vals = np.round(rng.normal(mean, std, len(offs)), 3)
         sid = tsdb.add_point("m", int(ts_s[0]), float(vals[0]),
                              {"host": f"h{i % 3}", "id": str(i)})
-        if n > 1:
+        if len(offs) > 1:
             tsdb.store.append_many(sid, ts_s[1:] * 1000, vals[1:],
                                    False)
         series.append((i % 3, ts_s * 1000, vals))
@@ -116,6 +118,43 @@ def test_fill_policy_matrix(fill, policy, value):
     series = _seed(tsdb, seed=7)
     _check(tsdb, series, "sum", 60_000, "avg", fill,
            fill_policy=policy, fill_value=value)
+
+
+@pytest.mark.parametrize("fill,policy,value", [
+    ("1m-avg-zero", "zero", 0.0),
+    ("1m-avg-nan", "nan", float("nan")),
+])
+def test_rate_with_fill_policy(fill, policy, value):
+    """rate composed with explicit fill policies — the emission mask
+    and the rate mask interact here (a filled bucket has no prior
+    point, so its rate must still be a gap/NaN)."""
+    tsdb = make_tsdb()
+    series = _seed(tsdb, seed=13)
+    _check(tsdb, series, "sum", 60_000, "avg", fill, rate=True,
+           fill_policy=policy, fill_value=value)
+
+
+@pytest.mark.parametrize("ds_fn", ["first", "last", "min"])
+def test_rate_over_downsample_fns(ds_fn):
+    """rate consumes the downsampler's OUTPUT series — edge-pick
+    downsample functions feed it different adjacent deltas."""
+    tsdb = make_tsdb()
+    series = _seed(tsdb, seed=sum(map(ord, ds_fn)) + 77)
+    _check(tsdb, series, "avg", 120_000, ds_fn, f"2m-{ds_fn}",
+           rate=True)
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404])
+def test_fuzz_seed_sweep(seed):
+    """Same checks, fresh random shapes: sparse/dense mixes the fixed
+    seeds above never produce (more series, wider density range,
+    zero-centered values)."""
+    tsdb = make_tsdb()
+    series = _seed(tsdb, num_series=11, seed=seed, n_range=(2, 120),
+                   mean=0.0, std=1000.0)
+    agg = ["sum", "avg", "dev", "mimmax"][seed % 4]
+    _check(tsdb, series, agg, 60_000, "avg", "1m-avg",
+           rate=bool(seed % 2))
 
 
 def _pts_of(ts_ms, vals):
