@@ -1,0 +1,41 @@
+#!/bin/bash
+# One-shot TPU measurement capture, for when the axon tunnel recovers.
+# Runs the headline kernel bench and the full e2e latency matrix, and
+# rewrites BENCH_E2E.json from the fresh results on success.
+set -u -o pipefail
+cd "$(dirname "$0")"
+echo "=== bench.py (headline dp/s) ==="
+python bench.py | tee /tmp/tpu_bench.json || {
+  echo "bench.py failed; aborting" >&2; exit 1; }
+if grep -q '"error"' /tmp/tpu_bench.json; then
+  echo "tunnel still unavailable; aborting e2e capture" >&2
+  exit 1
+fi
+echo "=== bench_e2e.py configs 1,2,3,4,5 ==="
+python bench_e2e.py --configs 1,2,3,4,5 --repeats 5 \
+  | tee /tmp/tpu_e2e.txt || {
+  echo "bench_e2e failed; NOT touching BENCH_E2E.json" >&2; exit 1; }
+python - <<'EOF'
+import json
+import sys
+rows = []
+for line in open("/tmp/tpu_e2e.txt"):
+    line = line.strip()
+    if line.startswith("{"):
+        rows.append(json.loads(line))
+configs = [r for r in rows if "config" in r]
+if len(configs) < 5:
+    # partial run must never clobber the existing full measurement
+    sys.exit(f"only {len(configs)}/5 configs captured; aborting")
+doc = {
+    "description": ("end-to-end /api/query latency over BASELINE "
+                    "configs (bench_e2e.py), TPU v5e single chip, "
+                    "p50 of 5 runs after server warmup "
+                    "(tsd.tpu.warmup pre-compiles; cold_ms is the "
+                    "first query of a warmed server)"),
+    "configs": configs,
+}
+with open("BENCH_E2E.json", "w") as f:
+    json.dump(doc, f, indent=1)
+print("BENCH_E2E.json refreshed with", len(configs), "configs")
+EOF
