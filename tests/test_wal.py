@@ -113,6 +113,26 @@ class TestWalRecovery:
                    for a in t2._histogram_arenas.values()) == 1
         assert t2.annotations.global_range(BASE - 5, BASE + 5)
 
+    def test_histogram_batch_replay(self, tmp_path):
+        """add_histogram_batch WAL-logs per point (one sync per
+        batch); an unflushed batch must fully replay on restart."""
+        t = _tsdb(tmp_path)
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        h = SimpleHistogram([0.0, 10.0, 20.0])
+        h.counts = [4, 6]
+        blob = t.histogram_manager.encode(h)
+        written, errors = t.add_histogram_batch([
+            ("hb", BASE + i, blob, {"h": "a"}) for i in range(5)])
+        assert written == 5 and not errors
+        t2 = _tsdb(tmp_path)  # no flush: arena rebuilt from the WAL
+        (arena,) = t2._histogram_arenas.values()
+        assert arena.total_points == 5
+        (sub,) = arena.groups.values()
+        ts, _, rows = sub.snapshot()
+        np.testing.assert_array_equal(
+            np.sort(ts), (BASE + np.arange(5)) * 1000)
+        np.testing.assert_array_equal(rows, [[4.0, 6.0]] * 5)
+
     def test_uid_assignment_replay(self, tmp_path):
         t = _tsdb(tmp_path)
         uid = t.assign_uid("metric", "pre.created")
