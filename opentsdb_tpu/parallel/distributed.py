@@ -39,18 +39,22 @@ _initialized = False
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> None:
+               process_id: int | None = None,
+               initialization_timeout: int = 120) -> None:
     """Join the multi-host rendezvous (no-op when single-process).
 
     Mirrors ``jax.distributed.initialize``; on TPU pods the arguments
     are auto-detected from the environment, so ``initialize()`` with no
-    arguments is the common call.
+    arguments is the common call. A dead coordinator fails the boot
+    within ``initialization_timeout`` seconds (same bounded-failure
+    posture as :func:`initialize_from_config`).
     """
     global _initialized
     if num_processes is not None and num_processes <= 1:
         return
-    jax.distributed.initialize(coordinator_address, num_processes,
-                               process_id)
+    jax.distributed.initialize(
+        coordinator_address, num_processes, process_id,
+        initialization_timeout=initialization_timeout)
     _initialized = True
 
 
@@ -83,6 +87,11 @@ def initialize_from_config(config) -> bool:
         kwargs["num_processes"] = num_processes
     if process_id >= 0:
         kwargs["process_id"] = process_id
+    # a dead coordinator must fail the boot loudly within a bounded
+    # window, not hang it (the same handled-failure posture as the
+    # bench watchdog; jax default is 300s)
+    kwargs["initialization_timeout"] = config.get_int(
+        "tsd.mesh.init_timeout", 120)
     jax.distributed.initialize(**kwargs)
     _initialized = True
     LOG.info("jax.distributed up: process %d/%d, %d global devices",
