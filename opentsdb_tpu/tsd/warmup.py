@@ -1,5 +1,12 @@
 """Server-start AOT warmup of the common query shape buckets.
 
+No reference equivalent (the JVM JIT warms up organically;
+ref-analogue: GraphHandler's gnuplot subprocess pool pre-spawn,
+src/tsd/GraphHandler.java:85-99, is the closest "pay startup cost to
+cut first-request latency" pattern). On TPU the first XLA compile of a
+query shape is multi-second, so the TSD pre-compiles the shape-bucket
+classes at boot.
+
 First-query latency was r02's worst tail: every new (S, B, G) shape
 pays a multi-second XLA compile mid-query. Shape bucketing
 (ops.shapes) bounds the program space; this module pre-compiles the
