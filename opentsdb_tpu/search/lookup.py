@@ -80,6 +80,13 @@ def last_data_points(tsdb, specs: list[dict], back_scan: int = 0,
     """(ref: TSUIDQuery.getLastPoint :161)"""
     uids = tsdb.uids
     out = []
+    # back_scan bounds how far back the "last" point may be (ref:
+    # TSUIDQuery back_scan hours — a series whose newest point is
+    # older than the window reports nothing); one cutoff per request
+    min_ts = 0
+    if back_scan > 0:
+        import time as _t
+        min_ts = int((_t.time() - back_scan * 3600) * 1000)
     for spec in specs:
         sids = []
         metric = ""
@@ -114,7 +121,7 @@ def last_data_points(tsdb, specs: list[dict], back_scan: int = 0,
         for sid in sids:
             rec = tsdb.store.series(sid)
             ts, vals = rec.buffer.view()
-            if len(ts) == 0:
+            if len(ts) == 0 or int(ts[-1]) < min_ts:
                 continue
             v = float(vals[-1])
             point: dict[str, Any] = {

@@ -648,6 +648,52 @@ class TestTreeRpcEdges:
             .status == 404
 
 
+class TestQueryLastEdges:
+    """(ref: QueryRpc /api/query/last via TSUIDQuery :346)"""
+
+    def test_tsuid_form(self, seeded_router, seeded_tsdb):
+        mid = seeded_tsdb.uids.metrics.get_id("sys.cpu.user")
+        sid = int(seeded_tsdb.store.series_ids_for_metric(mid)[0])
+        rec = seeded_tsdb.store.series(sid)
+        tsuid = seeded_tsdb.uids.tsuid(rec.metric_id,
+                                       rec.tags).hex().upper()
+        out = parse(seeded_router.handle(req(
+            "POST", "/api/query/last",
+            body={"queries": [{"tsuids": [tsuid]}],
+                  "resolveNames": True})))
+        assert len(out) == 1
+        assert out[0]["tsuid"] == tsuid
+        assert out[0]["metric"] == "sys.cpu.user"
+
+    def test_back_scan_excludes_stale_series(self, router, tsdb):
+        import time as _t
+        now = int(_t.time())
+        tsdb.add_point("bs.m", now - 10, 1.0, {"host": "fresh"})
+        tsdb.add_point("bs.m", now - 8 * 3600, 2.0, {"host": "stale"})
+        # no back_scan: both series report their last point
+        out = parse(router.handle(req("GET", "/api/query/last",
+                                      timeseries="bs.m",
+                                      resolve="true")))
+        assert len(out) == 2
+        # back_scan=1 hour: only the fresh series remains
+        out = parse(router.handle(req("GET", "/api/query/last",
+                                      timeseries="bs.m",
+                                      resolve="true", back_scan=1)))
+        assert len(out) == 1 and out[0]["tags"]["host"] == "fresh"
+
+    def test_unknown_metric_is_empty(self, seeded_router):
+        out = parse(seeded_router.handle(req(
+            "GET", "/api/query/last", timeseries="no.such.metric")))
+        assert out == []
+
+    def test_tag_filtered_form(self, seeded_router):
+        out = parse(seeded_router.handle(req(
+            "GET", "/api/query/last",
+            timeseries="sys.cpu.user{host=web01}", resolve="true")))
+        assert len(out) == 1
+        assert out[0]["tags"] == {"host": "web01"}
+
+
 class TestLogsEndpoint:
     """(ref: LogsRpc reading the logback ring buffer)"""
 
