@@ -140,3 +140,69 @@ def test_concurrent_uid_assignment_unique():
                for j in range(i, 200, 50)}
         assert len(ids) == 1
     assert t.uids.metrics.get_or_create_id("m0") == results[0][0]
+
+
+def test_concurrent_histogram_ingest_and_query():
+    """Writers hammer add_histogram_point/batch while readers run
+    percentile queries: validates the arena snapshot contract (views
+    captured under the lock stay coherent across growth resizes)."""
+    from opentsdb_tpu.core.histogram import SimpleHistogram
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    h = SimpleHistogram([0.0, 10.0, 20.0])
+    h.counts = [4, 6]
+    blob = t.histogram_manager.encode(h)
+    t.add_histogram_point("hc.m", BASE, blob, {"host": "seed"})
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def writer(slot):
+        i = 0
+        while not stop.is_set():
+            try:
+                if i % 3 == 0:
+                    t.add_histogram_batch([
+                        ("hc.m", BASE + slot * 100_000 + i * 10 + k,
+                         blob, {"host": f"w{slot}"})
+                        for k in range(5)])
+                else:
+                    t.add_histogram_point(
+                        "hc.m", BASE + slot * 100_000 + i * 10, blob,
+                        {"host": f"w{slot}"})
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"writer{slot}: {e!r}")
+                return
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                q = TSQuery.from_json({
+                    "start": BASE * 1000,
+                    "end": (BASE + 1_000_000) * 1000,
+                    "queries": [{"metric": "hc.m",
+                                 "aggregator": "sum",
+                                 "percentiles": [50.0, 99.0]}]})
+                res = t.execute_query(q.validate())
+                # every emitted percentile of identical histograms is
+                # a bucket midpoint: 5.0 or 15.0
+                for r in res:
+                    for _, v in r.dps:
+                        if not np.isnan(v) and v not in (5.0, 15.0):
+                            failures.append(f"bad value {v}")
+                            return
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"reader: {e!r}")
+                return
+
+    threads = [threading.Thread(target=writer, args=(s,))
+               for s in range(3)] + \
+              [threading.Thread(target=reader) for _ in range(2)]
+    for th in threads:
+        th.start()
+    time.sleep(4)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+    assert not failures, failures[:2]
+    arena = t._histogram_arenas[t.uids.metrics.get_id("hc.m")]
+    assert arena.total_points > 1
