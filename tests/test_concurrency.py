@@ -160,10 +160,14 @@ def test_concurrent_histogram_ingest_and_query():
         while not stop.is_set():
             try:
                 if i % 3 == 0:
-                    t.add_histogram_batch([
+                    written, errs = t.add_histogram_batch([
                         ("hc.m", BASE + slot * 100_000 + i * 10 + k,
                          blob, {"host": f"w{slot}"})
                         for k in range(5)])
+                    if errs or written != 5:
+                        failures.append(
+                            f"writer{slot} batch: {errs[:1]}")
+                        return
                 else:
                     t.add_histogram_point(
                         "hc.m", BASE + slot * 100_000 + i * 10, blob,
@@ -194,15 +198,18 @@ def test_concurrent_histogram_ingest_and_query():
                 failures.append(f"reader: {e!r}")
                 return
 
-    threads = [threading.Thread(target=writer, args=(s,))
+    threads = [threading.Thread(target=writer, args=(s,),
+                                daemon=True)
                for s in range(3)] + \
-              [threading.Thread(target=reader) for _ in range(2)]
+              [threading.Thread(target=reader, daemon=True)
+               for _ in range(2)]
     for th in threads:
         th.start()
     time.sleep(4)
     stop.set()
     for th in threads:
         th.join(timeout=30)
+        assert not th.is_alive(), "stress thread wedged"
     assert not failures, failures[:2]
     arena = t._histogram_arenas[t.uids.metrics.get_id("hc.m")]
     assert arena.total_points > 1
