@@ -212,22 +212,34 @@ def main() -> None:
         (d_vals2d, d_bts, d_gids))
     _elog(f"dense path: {dt_dense * 1e3:.2f} ms; timing pallas path")
 
-    # fused Pallas kernel (MXU one-hot group reduction); eps rides on
-    # the tiny [B,1] inverse-dt vector instead of the values --
-    # perturbing the 240MB values input would add un-fusable HBM
-    # traffic ahead of the opaque pallas_call and mismeasure it.
-    # Guarded: any Mosaic failure falls back to the dense XLA number.
+    # fused Pallas kernel; eps rides on the tiny [B,1] inverse-dt
+    # vector instead of the values -- perturbing the 240MB values input
+    # would add un-fusable HBM traffic ahead of the opaque pallas_call
+    # and mismeasure it. Both group-reduce layouts are timed (the span
+    # kernel is the roofline design, but the tunneled device's
+    # multi-tenant weather can distort either reading; best-of is
+    # robust). Guarded: any Mosaic failure falls back to the dense XLA
+    # number.
     dt_pallas = None
     try:
         from opentsdb_tpu.ops import pallas_fused
         if pallas_fused.supported(spec, dtype):
             vals2d = values.reshape(num_series, points_per)
-            args, tile_s, interp = pallas_fused.prepare(
-                vals2d, bucket_ts, group_ids, spec, k, dtype=dtype)
-            dt_pallas = _time_device(
-                lambda eps, v, g, a, iv, sz: pallas_fused._run(
-                    v, g, a, iv + eps, sz, spec, tile_s, interp)[0],
-                args)
+            for allow_span in (True, False):
+                args, tile_s, interp = pallas_fused.prepare(
+                    vals2d, bucket_ts, group_ids, spec, k,
+                    dtype=dtype, allow_span=allow_span)
+                layout = "span" if len(args) == 6 else "one-hot"
+                dt = _time_device(
+                    lambda eps, *a: pallas_fused._run(
+                        a[0], a[1], a[2], a[3] + eps, *a[4:],
+                        spec=spec, tile_s=tile_s, interpret=interp)[0],
+                    args)
+                _elog(f"pallas[{layout}]: {dt * 1e3:.2f} ms")
+                dt_pallas = dt if dt_pallas is None \
+                    else min(dt_pallas, dt)
+                if layout == "one-hot":
+                    break  # span layout unavailable; don't time twice
     except Exception as e:  # noqa: BLE001
         print(f"pallas path unavailable: {e}", file=sys.stderr)
 

@@ -10,9 +10,7 @@ a serialized, VPU-hostile op. This kernel replaces the whole chain
   NOT ``[S, P]``. XLA stores TPU arrays (8, 128)-lane-tiled, so an
   ``[S, P]`` f32 array with P = 60 pads 60 -> 128 lanes in HBM and the
   kernel would stream ~2x the logical bytes. Time-major puts the huge
-  series axis on the 128-lane dimension (near-zero padding) and was
-  measured at the HBM roofline (~750 GB/s on v5e vs ~380 GB/s for the
-  row-major layout).
+  series axis on the 128-lane dimension (near-zero padding).
 - downsample: ``A01[B, P] @ x[P, TILE]`` where ``A01`` is the
   host-built bucket-membership matrix with entries in {0, 1} (one-hot
   rows for first/last); the 1/k average scale is applied afterwards on
@@ -22,8 +20,23 @@ a serialized, VPU-hostile op. This kernel replaces the whole chain
   block (sublane shift + multiply by host-precomputed 1/dt), which also
   supports counter rollover correction + reset_value — nonlinear ops a
   folded matmul cannot express.
-- group-by: ``onehot(group_ids)[G, TILE] @ t[B, TILE]^T`` accumulated
-  across series tiles (one-hot segment-reduction-as-matmul).
+- group-by, **span path** (default): series are sorted by group id at
+  prepare time (a one-time device gather), so each TILE covers at most
+  ``_SPAN_MAX`` distinct groups. The kernel computes one masked VPU
+  *lane* reduction per span slot — no matmul at all — and accumulates
+  each partial into its ``[G, B]`` VMEM accumulator row via a masked
+  iota broadcast, so the whole execution stays one device launch.
+  Measured v5e roofline: the one-hot alternative is MXU-*load*-bound
+  (the ``[G, TILE]`` one-hot is the loaded operand; only B=12 columns
+  stream per loaded tile, so each exact pass costs ~0.18 ms on the
+  1M-series benchmark shape — 3 passes ≈ the whole HBM stream budget),
+  while the span kernel runs at the HBM roofline (~850 GB/s effective,
+  2x the one-hot kernel) and is f32-exact end to end (no bf16 anywhere
+  in the group stage).
+- group-by, **one-hot fallback**: when the sorted layout still puts
+  more than ``_SPAN_MAX`` groups in one tile (many tiny groups),
+  ``onehot(group_ids)[G, TILE] @ t[B, TILE]^T`` accumulated across
+  series tiles (one-hot segment-reduction-as-matmul).
 
 **Precision**: the MXU rounds f32 operands to bf16 (measured 0.6%
 error). ``Precision.HIGHEST`` fixes that at 6 passes per dot and cost
@@ -31,9 +44,9 @@ r02 23% of throughput. Instead, since one operand of every dot (A01 /
 onehot) is exact in bf16, only the value operand needs splitting:
 ``x = hi + mid + lo`` with three bf16 terms carries all 24 f32 mantissa
 bits, so three 1-pass dots accumulated in f32 are f32-exact — half the
-MXU passes of HIGHEST, and the MXU work is negligible against the HBM
-stream. On non-TPU backends (interpreter mode, the CPU test matrix) the
-dots run unsplit in the compute dtype, keeping golden tests exact.
+MXU passes of HIGHEST. On non-TPU backends (interpreter mode, the CPU
+test matrix) the dots run unsplit in the compute dtype, keeping golden
+tests exact.
 
 Scope: used for *complete* regular-cadence data (no NaN holes) — the
 monitoring-data common case and the benchmark shape (BASELINE.json
@@ -63,12 +76,18 @@ _MATMUL_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "first",
                          "last"))
 _MINMAX_FNS = frozenset(("min", "mimmin", "max", "mimmax"))
 _DS_FNS = _MATMUL_FNS | _MINMAX_FNS | {"count"}
-# group aggregators expressible as an accumulated matmul
+# group aggregators expressible as an accumulated sum
 _AGG_FNS = frozenset(("sum", "zimsum", "pfsum", "avg", "count",
                       "squareSum"))
 
 _VMEM_BUDGET = 10 * 1024 * 1024  # working-set budget per grid step
 _MAX_GROUPS = 4096               # onehot [G, TILE] VMEM guard
+# span path: max distinct groups one tile of the group-sorted layout
+# may cover; above this the one-hot kernel takes over
+_SPAN_MAX = 8
+# span path: per-tile accumulate does _SPAN_MAX masked [G, B] row
+# broadcasts on the VPU — gate the group count so that stays trivial
+_SPAN_GROUP_MAX = 1024
 
 
 def supported(spec, dtype) -> bool:
@@ -100,7 +119,7 @@ def _tile_s(s: int, p: int, g: int, itemsize: int) -> int:
 
 def _build_membership(spec, k: int, dtype):
     """Host-side: the {0,1} bucket-membership matrix A01 [B, P], exact
-    in bf16. (The 1/k average post-scale lives in ``_kernel``: it must
+    in bf16. (The 1/k average post-scale lives in the kernel: it must
     apply AFTER the split dots so the matrix stays exact.)"""
     b = spec.num_buckets
     p = b * k
@@ -160,25 +179,15 @@ def _dot_exact(exact_operand, x, split: bool, acc_dtype,
     return out
 
 
-def _kernel(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, acc_ref, *,
-            spec, k: int, g: int, split: bool):
-    """One series tile: downsample [P,T] -> [B,T], optional rate,
-    optional square, then one-hot group matmul into acc [G, B].
-    rp_ref [1, 2] carries (counter_max, reset_value) as traced values
-    so per-query rate options never force a Mosaic recompile."""
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    x = vals_ref[:]                              # [P, TILE]
+def _tile_transform(x, a_ref, inv_ref, rp_ref, *, spec, k: int,
+                    split: bool, dtype):
+    """Shared per-tile chain: downsample [P,T] -> t [B,T], optional
+    rate (incl. counter rollover / reset_value), optional square.
+    Identical op order in both kernels so their t agrees bitwise."""
     tile = x.shape[1]
     b = spec.num_buckets
-    dtype = acc_ref.dtype
     fn = spec.ds_function
 
-    # 1. downsample -> t [B, TILE]
     if fn in _MATMUL_FNS:
         t = _dot_exact(a_ref[:], x, split, dtype)
         if fn == "avg":
@@ -192,7 +201,7 @@ def _kernel(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, acc_ref, *,
         else:
             t = jnp.max(xr, axis=1)
 
-    # 2. rate: explicit first difference over the bucket (sublane) axis;
+    # rate: explicit first difference over the bucket (sublane) axis;
     # complete data means the previous present point is always the
     # previous bucket. inv_ref[0] == 0 kills the dropped first bucket.
     if spec.rate:
@@ -212,10 +221,30 @@ def _kernel(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, acc_ref, *,
 
     if spec.agg_name == "squareSum":
         t = t * t
+    return t
 
-    # 3. group reduce: onehot [G, TILE] (exact in bf16; padded series
+
+def _kernel(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, acc_ref, *,
+            spec, k: int, g: int, split: bool):
+    """One-hot fallback kernel: transform the series tile, then a
+    one-hot group matmul accumulated into acc [G, B]. rp_ref [1, 2]
+    carries (counter_max, reset_value) as traced values so per-query
+    rate options never force a Mosaic recompile."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = vals_ref[:]                              # [P, TILE]
+    dtype = acc_ref.dtype
+    t = _tile_transform(x, a_ref, inv_ref, rp_ref, spec=spec, k=k,
+                        split=split, dtype=dtype)
+
+    # group reduce: onehot [G, TILE] (exact in bf16; padded series
     # carry gid -1 -> all-zero columns) against t^T
     gid = gid_ref[:]                             # [1, TILE]
+    tile = x.shape[1]
     onehot = (jax.lax.broadcasted_iota(jnp.int32, (g, tile), 0)
               == gid)
     onehot = onehot.astype(jnp.bfloat16 if split else dtype)
@@ -224,35 +253,43 @@ def _kernel(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, acc_ref, *,
                              dims=(((1,), (1,)), ((), ())))
 
 
-@partial(jax.jit, static_argnames=("spec", "tile_s", "interpret",
-                                   "force_split"))
-def _run(values_t, group_ids_row, a_mat, inv_dt, group_sizes,
-         spec, tile_s: int, interpret: bool, rate_params=None,
-         force_split: bool = False):
-    p, s_pad = values_t.shape
-    b, g = spec.num_buckets, spec.num_groups
-    k = p // b
-    dtype = values_t.dtype
-    split = (force_split or not interpret) and dtype == jnp.float32
-    if rate_params is None:
-        rate_params = jnp.asarray([[float(2**64 - 1), 0.0]], dtype)
-    kern = partial(_kernel, spec=spec, k=k, g=g, split=split)
-    acc = pl.pallas_call(
-        kern,
-        grid=(s_pad // tile_s,),
-        in_specs=[
-            pl.BlockSpec((p, tile_s), lambda i: (0, i)),
-            pl.BlockSpec((1, tile_s), lambda i: (0, i)),
-            pl.BlockSpec((b, p), lambda i: (0, 0)),
-            pl.BlockSpec((b, 1), lambda i: (0, 0)),
-            pl.BlockSpec((1, 2), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((g, b), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((g, b), dtype),
-        interpret=interpret,
-    )(values_t, group_ids_row, a_mat, inv_dt, rate_params)
+def _kernel_span(vals_ref, gid_ref, a_ref, inv_ref, rp_ref, sp_ref,
+                 acc_ref, *, spec, k: int, g: int, split: bool):
+    """Span kernel (group-sorted layout): transform the series tile,
+    then one masked VPU lane-reduction per span slot, accumulated
+    straight into the [G, B] VMEM accumulator via a masked row
+    broadcast (iota == span_gid). No group matmul, no separate
+    segment-sum kernel — one device launch per execution, which also
+    minimizes the inter-kernel gaps a multi-tenant device can steal."""
+    i = pl.program_id(0)
 
-    # finalize [G,B] (cheap; stays in the same jit program)
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = vals_ref[:]                              # [P, TILE]
+    dtype = acc_ref.dtype
+    b = spec.num_buckets
+    t = _tile_transform(x, a_ref, inv_ref, rp_ref, spec=spec, k=k,
+                        split=split, dtype=dtype)
+    gid = gid_ref[:]                             # [1, TILE]
+    sp = sp_ref[0]                               # [1, _SPAN_MAX]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (g, 1), 0)
+    upd = jnp.zeros((g, b), dtype)
+    for j in range(_SPAN_MAX):
+        spj = sp[0:1, j:j + 1]                   # [1, 1]
+        m = (gid == spj)                         # [1, TILE]
+        part = jnp.sum(jnp.where(m, t, dtype.type(0.0)),
+                       axis=1)[None, :]          # [1, B]
+        # sentinel id g (empty slot / padded series) matches no row
+        upd = upd + jnp.where(rows == spj, part, dtype.type(0.0))
+    acc_ref[:] += upd
+
+
+def _finalize(acc, group_sizes, spec, dtype):
+    """Shared [G, B] finalizer: aggregator division / counts and the
+    emission mask (fill-policy NONE follows pre-fill presence)."""
+    g, b = spec.num_groups, spec.num_buckets
     sizes = group_sizes[:, None].astype(dtype)  # [G,1] series per group
     full_cnt = jnp.broadcast_to(sizes, (g, b))
     cnt = full_cnt
@@ -285,6 +322,54 @@ def _run(values_t, group_ids_row, a_mat, inv_dt, group_sizes,
     return result, emit
 
 
+@partial(jax.jit,
+         static_argnames=("spec", "tile_s", "interpret", "force_split"))
+def _run(*arrays, spec, tile_s: int, interpret: bool,
+         rate_params=None, force_split: bool = False):
+    """Execute prepared device arrays -> (result [G,B], emit [G,B]).
+
+    ``arrays`` comes from :func:`prepare`:
+      5 elements (values_t, gids_row, a_mat, inv_dt, group_sizes)
+        -> one-hot kernel;
+      6 elements (+ spans [NT, 1, _SPAN_MAX])
+        -> span kernel (group-sorted layout).
+    """
+    span = len(arrays) == 6
+    values_t, group_ids_row, a_mat, inv_dt, group_sizes = arrays[:5]
+    p, s_pad = values_t.shape
+    b, g = spec.num_buckets, spec.num_groups
+    k = p // b
+    dtype = values_t.dtype
+    split = (force_split or not interpret) and dtype == jnp.float32
+    if rate_params is None:
+        rate_params = jnp.asarray([[float(2**64 - 1), 0.0]], dtype)
+    nt = s_pad // tile_s
+    in_specs = [
+        pl.BlockSpec((p, tile_s), lambda i: (0, i)),
+        pl.BlockSpec((1, tile_s), lambda i: (0, i)),
+        pl.BlockSpec((b, p), lambda i: (0, 0)),
+        pl.BlockSpec((b, 1), lambda i: (0, 0)),
+        pl.BlockSpec((1, 2), lambda i: (0, 0)),
+    ]
+    operands = (values_t, group_ids_row, a_mat, inv_dt, rate_params)
+    if span:
+        kern = partial(_kernel_span, spec=spec, k=k, g=g, split=split)
+        in_specs.append(
+            pl.BlockSpec((1, 1, _SPAN_MAX), lambda i: (i, 0, 0)))
+        operands = operands + (arrays[5],)
+    else:
+        kern = partial(_kernel, spec=spec, k=k, g=g, split=split)
+    acc = pl.pallas_call(
+        kern,
+        grid=(nt,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((g, b), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((g, b), dtype),
+        interpret=interpret,
+    )(*operands)
+    return _finalize(acc, group_sizes, spec, dtype)
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _transpose(values2d):
     """[S_pad, P] -> [P, S_pad] on device: one HBM round trip, vs the
@@ -293,20 +378,61 @@ def _transpose(values2d):
     return values2d.T
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _gather_transpose(values2d, order):
+    """[S_pad, P] -> sorted [P, S_pad] on device: the group-sort gather
+    fused with the transpose (one extra HBM round trip at prepare time;
+    steady-state executions then stream the sorted layout for free)."""
+    return values2d[order].T
+
+
+def _span_layout(group_ids: np.ndarray, s_pad: int, tile_s: int,
+                 g: int):
+    """Try the group-sorted span layout. Returns (order | None,
+    spans [NT, 1, _SPAN_MAX] i32, gids_sorted_padded [s_pad] i32) or
+    None when some tile would cover more than ``_SPAN_MAX`` distinct
+    groups or the group count exceeds ``_SPAN_GROUP_MAX`` (many tiny
+    groups — the one-hot kernel handles those better). Empty span
+    slots and padded series carry the sentinel id ``g``, which matches
+    no accumulator row."""
+    if g > _SPAN_GROUP_MAX:
+        return None
+    gids = np.asarray(group_ids, dtype=np.int32)
+    s = len(gids)
+    nt = s_pad // tile_s
+    if s and np.all(gids[1:] >= gids[:-1]):
+        order = None
+        gsorted = gids
+    else:
+        order = np.argsort(gids, kind="stable").astype(np.int32)
+        gsorted = gids[order]
+    gpad = np.full(s_pad, g, np.int32)
+    gpad[:s] = gsorted
+    gt = gpad.reshape(nt, tile_s)
+    spans = np.full((nt, _SPAN_MAX), g, np.int32)
+    for i in range(nt):
+        u = np.unique(gt[i])
+        u = u[u != g]  # padded series need no slot: the sentinel id
+        #               already matches no accumulator row
+        if len(u) > _SPAN_MAX:
+            return None
+        spans[i, :len(u)] = u
+    return order, spans.reshape(nt, 1, _SPAN_MAX), gpad
+
+
 def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
             group_ids: np.ndarray, spec, k: int, dtype=jnp.float32,
-            device=None, force_split: bool = False):
-    """Host prep: pad, build operators, upload, transpose on device.
-    Returns (device_args, tile_s, interpret) ready for :func:`_run` —
-    split out so callers timing steady-state compute can upload once."""
+            device=None, force_split: bool = False,
+            allow_span: bool = True):
+    """Host prep: pad, build operators, upload, sort+transpose on
+    device. Returns (device_args, tile_s, interpret) ready for
+    :func:`_run` — split out so callers timing steady-state compute can
+    upload once. ``len(device_args) == 6`` means the span layout was
+    selected (see :func:`_run`)."""
     np_dtype = np.dtype(dtype)
     s, p = values2d.shape
     tile_s = _tile_s(s, p, spec.num_groups, np_dtype.itemsize)
     s_pad = -(-s // tile_s) * tile_s
-    vals = np.zeros((s_pad, p), dtype=np_dtype)
-    vals[:s] = values2d
-    gids = np.full((1, s_pad), -1, dtype=np.int32)
-    gids[0, :s] = group_ids
     interpret = jax.default_backend() != "tpu"
     split = (force_split or not interpret) and np_dtype == np.float32
     a_mat = _build_membership(
@@ -316,6 +442,30 @@ def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
     sizes = np.bincount(group_ids, minlength=spec.num_groups) \
         .astype(np.int32)
     put = partial(jax.device_put, device=device)
+
+    vals = np.zeros((s_pad, p), dtype=np_dtype)
+    vals[:s] = values2d
+
+    span = _span_layout(group_ids, s_pad, tile_s, spec.num_groups) \
+        if allow_span else None
+    if span is not None:
+        order, spans, gpad = span
+        if order is None:
+            vals_t = _transpose(put(jnp.asarray(vals)))
+        else:
+            # padded rows already sit past every real series; the
+            # gather only permutes the first s rows
+            order_full = np.concatenate(
+                [order, np.arange(s, s_pad, dtype=np.int32)])
+            vals_t = _gather_transpose(put(jnp.asarray(vals)),
+                                       put(jnp.asarray(order_full)))
+        args = (vals_t, put(jnp.asarray(gpad.reshape(1, s_pad))),
+                put(a_dev), put(jnp.asarray(inv_dt)),
+                put(jnp.asarray(sizes)), put(jnp.asarray(spans)))
+        return args, tile_s, interpret
+
+    gids = np.full((1, s_pad), -1, dtype=np.int32)
+    gids[0, :s] = group_ids
     vals_t = _transpose(put(jnp.asarray(vals)))
     args = (vals_t, put(jnp.asarray(gids)), put(a_dev),
             put(jnp.asarray(inv_dt)), put(jnp.asarray(sizes)))
@@ -335,5 +485,6 @@ def fused_dense_pipeline(values2d: np.ndarray, bucket_ts: np.ndarray,
         float(2**64 - 1)
     rv = float(rate_options.reset_value) if rate_options else 0.0
     rp = jnp.asarray([[cm, rv]], dtype)
-    result, emit = _run(*args, spec, tile_s, interpret, rate_params=rp)
+    result, emit = _run(*args, spec=spec, tile_s=tile_s,
+                        interpret=interpret, rate_params=rp)
     return np.asarray(result), np.asarray(emit)

@@ -150,7 +150,8 @@ def test_split_precision_path(kw, ro):
                                           dtype=jnp.float32,
                                           force_split=force)
         rp = jnp.asarray([[cm, rv]], jnp.float32)
-        res, _ = pf._run(*args, spec, tile_s, interp, rate_params=rp,
+        res, _ = pf._run(*args, spec=spec, tile_s=tile_s,
+                         interpret=interp, rate_params=rp,
                          force_split=force)
         outs[force] = np.asarray(res)
     np.testing.assert_allclose(outs[True], outs[False], rtol=2e-5,
@@ -168,3 +169,108 @@ def test_pallas_odd_sizes_padding():
     want, _ = execute(values, si, bi, ts, gids, spec,
                       rate_options=RateOptions(), use_pallas=False)
     np.testing.assert_allclose(got, want, rtol=1e-9, equal_nan=True)
+
+
+def _prep_for(s, g, seed=0, **kw):
+    """Build a complete regular batch + spec directly in 2D form."""
+    rng = np.random.default_rng(seed)
+    b, k = 6, 4
+    p = b * k
+    vals = rng.normal(100.0, 15.0, size=(s, p))
+    ts = np.arange(b, dtype=np.int64) * 60_000 + 1_356_998_400_000
+    gids = ((np.arange(s) * 7) % g).astype(np.int32)  # unsorted
+    spec = PipelineSpec(num_series=s, num_buckets=b, num_groups=g,
+                        **kw)
+    return vals, ts, gids, spec, k
+
+
+def test_span_layout_selection():
+    """Few groups -> span layout (6 args); more distinct groups than
+    _SPAN_MAX in one sorted tile -> one-hot fallback (5 args)."""
+    vals, ts, gids, spec, k = _prep_for(
+        40, 4, ds_function="avg", agg_name="sum")
+    args, _, _ = pallas_fused.prepare(vals, ts, gids, spec, k)
+    assert len(args) == 6
+    vals, ts, gids, spec, k = _prep_for(
+        40, 20, ds_function="avg", agg_name="sum")
+    assert 20 > pallas_fused._SPAN_MAX
+    args, _, _ = pallas_fused.prepare(vals, ts, gids, spec, k)
+    assert len(args) == 5
+    # allow_span=False forces the one-hot layout
+    vals, ts, gids, spec, k = _prep_for(
+        40, 4, ds_function="avg", agg_name="sum")
+    args, _, _ = pallas_fused.prepare(vals, ts, gids, spec, k,
+                                      allow_span=False)
+    assert len(args) == 5
+
+
+@pytest.mark.parametrize("ds_fn", DS_FNS)
+@pytest.mark.parametrize("agg", ["sum", "avg", "squareSum"])
+def test_span_matches_onehot(ds_fn, agg):
+    """The span kernel and the one-hot kernel must agree on identical
+    (group-sortable) data across the ds x agg matrix, with rate on."""
+    import jax.numpy as jnp
+    vals, ts, gids, spec, k = _prep_for(
+        37, 5, seed=13, ds_function=ds_fn, agg_name=agg, rate=True)
+    outs = {}
+    for allow in (True, False):
+        args, tile_s, interp = pallas_fused.prepare(
+            vals, ts, gids, spec, k, dtype=np.float64,
+            allow_span=allow)
+        assert (len(args) == 6) == allow
+        res, emit = pallas_fused._run(*args, spec=spec, tile_s=tile_s,
+                                      interpret=interp)
+        outs[allow] = (np.asarray(res), np.asarray(emit))
+    np.testing.assert_allclose(outs[True][0], outs[False][0],
+                               rtol=1e-9, equal_nan=True)
+    np.testing.assert_array_equal(outs[True][1], outs[False][1])
+
+
+def test_span_counter_rate_matches_xla():
+    """Counter rollover + reset_value through the span path (per-series
+    nonlinearity happens before the group reduce, so the span layout
+    supports it) vs the XLA path."""
+    rng = np.random.default_rng(29)
+    s, b, k, g = 33, 7, 3, 3
+    p = b * k
+    base = np.cumsum(rng.uniform(1, 50, size=(s, p)), axis=1)
+    base[3, 10:] -= base[3, 10] * 0.9
+    values = base.reshape(-1)
+    si = np.repeat(np.arange(s, dtype=np.int32), p)
+    bi = np.tile(np.repeat(np.arange(b, dtype=np.int32), k), s)
+    ts = np.arange(b, dtype=np.int64) * 60_000 + 1_356_998_400_000
+    gids = ((np.arange(s) * 5) % g).astype(np.int32)
+    spec = PipelineSpec(num_series=s, num_buckets=b, num_groups=g,
+                        ds_function="last", agg_name="sum",
+                        rate=True, rate_counter=True)
+    ro = RateOptions(counter=True, counter_max=2**32, reset_value=4.0)
+    got, got_emit = execute(values, si, bi, ts, gids, spec,
+                            rate_options=ro, use_pallas=True)
+    want, want_emit = execute(values, si, bi, ts, gids, spec,
+                              rate_options=ro, use_pallas=False)
+    np.testing.assert_allclose(got, want, rtol=1e-9, equal_nan=True)
+    np.testing.assert_array_equal(got_emit, want_emit)
+
+
+def test_span_multi_tile_spans(monkeypatch):
+    """Series count above one tile with group runs crossing tile
+    boundaries: the per-tile spans index map and the cross-grid-step
+    accumulator must stitch partial group sums correctly. The tile
+    size is pinned to 128 so 300 series genuinely span 3 grid steps
+    (the default _tile_s would cover them in one)."""
+    monkeypatch.setattr(pallas_fused, "_tile_s",
+                        lambda s, p, g, itemsize: 128)
+    vals, ts, gids, spec, k = _prep_for(
+        300, 3, seed=17, ds_function="sum", agg_name="sum")
+    args, tile_s, interp = pallas_fused.prepare(vals, ts, gids, spec, k,
+                                                dtype=np.float64)
+    assert tile_s == 128 and args[0].shape[1] == 384  # 3 grid steps
+    assert len(args) == 6
+    res, _ = pallas_fused._run(*args, spec=spec, tile_s=tile_s,
+                               interpret=interp)
+    # independent reference: plain numpy group sums of the downsample
+    ds = vals.reshape(300, spec.num_buckets, k).sum(axis=2)
+    want = np.zeros((3, spec.num_buckets))
+    for gid in range(3):
+        want[gid] = ds[gids == gid].sum(axis=0)
+    np.testing.assert_allclose(np.asarray(res), want, rtol=1e-9)
