@@ -339,8 +339,8 @@ def execute_avg_divide(grid_sum, grid_cnt, bucket_ts: np.ndarray,
     rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
                    jnp.asarray(ro.reset_value, dtype=dtype))
     result, emit = run_pipeline_avg_div(
-        jnp.asarray(gsum, dtype=dtype),
-        jnp.asarray(gcnt, dtype=dtype),
+        put(jnp.asarray(gsum, dtype=dtype)),
+        put(jnp.asarray(gcnt, dtype=dtype)),
         put(jnp.asarray(device_bucket_ts(bts_p))),
         put(jnp.asarray(gids_p, dtype=jnp.int32)),
         rate_params, jnp.asarray(spec.fill_value, dtype=dtype), pspec)

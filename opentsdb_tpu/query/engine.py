@@ -141,6 +141,37 @@ def _store_id(store) -> int:
     return getattr(store, "instance_id", id(store))
 
 
+# default padded [S, B] cell count below which the pipeline tail runs
+# on the host CPU backend instead of the accelerator
+HOST_TAIL_DEFAULT_CELLS = 1 << 20
+
+
+def host_tail_device(config, padded_cells: int):
+    """Device override for small-query tails.
+
+    Below ``tsd.query.host_tail_max_cells`` (compared against the
+    shape-bucket-PADDED [S, B] cell count, so the decision is
+    deterministic per compiled-shape class and warmup can pre-compile
+    the same programs) the fill/rate/aggregate tail runs on the host
+    CPU backend. A dashboard-sized query's wall time on a remote or
+    tunneled accelerator is dominated by per-query RPC round trips,
+    not compute — the reference serves this class straight from the
+    local JVM heap (ref: QueryRpc.java:128 -> TsdbQuery compute
+    in-process). Set the key to -1 to disable; 0 means the default.
+    Mesh queries never take this path (sharded data is already
+    device-resident). Returns a committed CPU ``jax.Device`` or None
+    (= use the default device)."""
+    limit = config.get_int("tsd.query.host_tail_max_cells", 0) \
+        or HOST_TAIL_DEFAULT_CELLS
+    if limit < 0 or padded_cells > limit:
+        return None
+    import jax
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:  # pragma: no cover - cpu platform disabled
+        return None
+
+
 def compact_row_labels(mat: np.ndarray) -> tuple[np.ndarray, int]:
     """``np.unique(mat, axis=0, return_inverse=True)`` equivalent via
     per-column factorization — the void-dtype row sort behind
@@ -678,11 +709,25 @@ class QueryEngine:
             return None  # blocked streaming handles the oversized case
         fn = ds_fn_override or ds_spec.function
         want_minmax = fn in ("min", "mimmin", "max", "mimmax")
+        # small grids run the tail on the host CPU backend; decision is
+        # per padded-shape class, matching warmup's pre-compiles
+        host_dev = None
+        if mesh is None:
+            from opentsdb_tpu.ops import shapes as _shapes
+            host_dev = host_tail_device(
+                self.tsdb.config,
+                _shapes.shape_bucket(len(sids))
+                * _shapes.shape_bucket(b))
         # device-resident cache: a warm repeat of this reduction skips
         # the host scan AND the upload (HBM ≙ HBase block cache).
         # Under a mesh the cached value is the pre-SHARDED device args
         # (grid + mask + bucket_ts + gids placed per the mesh specs).
-        cache = self.tsdb.device_grid_cache
+        # Host-tail queries skip it: their native re-scan costs
+        # milliseconds, and host-RAM entries must not evict
+        # HBM-resident grids whose re-upload the cache exists to avoid
+        # (nor report host bytes as device bytes).
+        cache = self.tsdb.device_grid_cache if host_dev is None \
+            else None
         ckey = cver = None
         grid = has_data = None
         mesh_args = mesh_meta = None
@@ -807,7 +852,8 @@ class QueryEngine:
             from opentsdb_tpu.ops.pipeline import execute_grid
             result, emit = execute_grid(grid, has_data, bucket_ts,
                                         group_ids, spec,
-                                        sub.rate_options)
+                                        sub.rate_options,
+                                        device=host_dev)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)
@@ -841,6 +887,7 @@ class QueryEngine:
         fixed = (not ds_spec.run_all and not ds_spec.use_calendar
                  and ds_spec.unit not in ("n", "y")
                  and ds_spec.interval_ms > 0)
+        host_dev = None
         if fixed:
             # native pre-reduction: both tiers collapse to [S, B] sums
             # in one storage pass each — no per-point upload
@@ -849,8 +896,16 @@ class QueryEngine:
             s, b = len(sids), len(bucket_ts)
             t0_ms = int(bucket_ts[0])
             mesh = self.tsdb.query_mesh
-            cache = self.tsdb.device_grid_cache if mesh is None \
-                else None
+            if mesh is None:
+                from opentsdb_tpu.ops import shapes as _shapes
+                host_dev = host_tail_device(
+                    self.tsdb.config,
+                    _shapes.shape_bucket(s) * _shapes.shape_bucket(b))
+            # host-tail queries skip the device cache (see
+            # _grid_pipeline: cheap native re-scan; host RAM must not
+            # evict HBM-resident grids)
+            cache = self.tsdb.device_grid_cache \
+                if mesh is None and host_dev is None else None
             ckey = cver = None
             gs = gc = None
             if cache is not None:
@@ -968,7 +1023,8 @@ class QueryEngine:
                 sub.rate_options)
         else:
             result, emit = execute_avg_divide(
-                gs, gc, bucket_ts, group_ids, spec, sub.rate_options)
+                gs, gc, bucket_ts, group_ids, spec, sub.rate_options,
+                device=host_dev)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)

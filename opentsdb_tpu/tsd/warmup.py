@@ -102,10 +102,19 @@ def run_warmup(tsdb) -> int:
 
     for s, b, g in combos:
         if mesh is None:
-            grid = jnp.zeros((s, b), dtype)
-            has = jnp.zeros((s, b), dtype=bool)
-            bts = jnp.arange(b, dtype=jnp.int32) * 60_000
-            gids = jnp.zeros(s, dtype=jnp.int32)
+            # small shape classes run their tail on the host CPU
+            # backend (engine.host_tail_device) — warm the SAME
+            # device placement so the pre-compiled program is the one
+            # real queries hit
+            import jax
+            from functools import partial as _partial
+            from opentsdb_tpu.query.engine import host_tail_device
+            put = _partial(jax.device_put,
+                           device=host_tail_device(tsdb.config, s * b))
+            grid = put(jnp.zeros((s, b), dtype))
+            has = put(jnp.zeros((s, b), dtype=bool))
+            bts = put(jnp.arange(b, dtype=jnp.int32) * 60_000)
+            gids = put(jnp.zeros(s, dtype=jnp.int32))
             rp = (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype))
             fv = jnp.asarray(float("nan"), dtype)
             args = None

@@ -12,7 +12,11 @@ BASE = 1356998400
 
 
 def _tsdb(**extra):
+    # the small fixtures here would otherwise take the host-tail path,
+    # which bypasses the device cache by design — disable it so these
+    # tests keep pinning the cache machinery itself
     return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          "tsd.query.host_tail_max_cells": "-1",
                           **extra}))
 
 

@@ -1,0 +1,93 @@
+"""Host-tail fast path: small [S, B] grids run the fill/rate/aggregate
+tail on the host CPU backend instead of the (possibly remote/tunneled)
+accelerator — engine.host_tail_device. On the CPU test matrix the
+default backend IS cpu, so these tests pin the decision logic and the
+committed-device plumbing (cache placement + execute), and the
+equivalence of results with the path forced off."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.engine import (HOST_TAIL_DEFAULT_CELLS,
+                                       host_tail_device)
+from opentsdb_tpu.query.model import TSQuery
+
+
+def _cfg(**over):
+    from opentsdb_tpu import Config
+    return Config(**{k: str(v) for k, v in over.items()})
+
+
+def test_host_tail_decision_thresholds():
+    # under default threshold -> a committed cpu device
+    dev = host_tail_device(_cfg(), 64 * 1024)
+    assert dev is not None and dev.platform == "cpu"
+    # above the default threshold -> accelerator (None)
+    assert host_tail_device(_cfg(), HOST_TAIL_DEFAULT_CELLS + 1) is None
+    # custom threshold
+    cfg = _cfg(**{"tsd.query.host_tail_max_cells": 1000})
+    assert host_tail_device(cfg, 999) is not None
+    assert host_tail_device(cfg, 1001) is None
+    # -1 disables the path entirely
+    off = _cfg(**{"tsd.query.host_tail_max_cells": -1})
+    assert host_tail_device(off, 1) is None
+
+
+def _query(tsdb, m):
+    q = TSQuery.from_json({
+        "start": 1356998400000, "end": 1356998400000 + 300 * 10_000,
+        "queries": [{"aggregator": "sum", "metric": "sys.cpu.user",
+                     "downsample": m,
+                     "filters": [{"type": "wildcard", "tagk": "host",
+                                  "filter": "*", "groupBy": True}]}],
+    })
+    return tsdb.new_query().run(q.validate())
+
+
+@pytest.mark.parametrize("ds", ["1m-avg", "30s-sum", "1m-max"])
+def test_small_query_host_tail_matches_device_path(seeded_tsdb, ds):
+    """The same small query answered with the host-tail path on vs
+    forced off must produce identical series (both run on CPU in the
+    test matrix; this pins the committed-device plumbing end to end).
+    Host-tail queries bypass the device grid cache (host RAM must not
+    evict HBM-resident grids), so the warm repeat re-scans natively —
+    results must still be identical."""
+    on = _query(seeded_tsdb, ds)
+    # warm repeat: exercises the cache-hit path with committed arrays
+    on_warm = _query(seeded_tsdb, ds)
+    seeded_tsdb.config.override_config("tsd.query.host_tail_max_cells", "-1")
+    seeded_tsdb.drop_caches()
+    off = _query(seeded_tsdb, ds)
+    seeded_tsdb.config.override_config("tsd.query.host_tail_max_cells", "0")
+    assert len(on) == len(off) == len(on_warm) == 2
+    for a, w, b in zip(on, on_warm, off):
+        assert a.tags == b.tags
+        assert [t for t, _ in a.dps] == [t for t, _ in w.dps] \
+            == [t for t, _ in b.dps]
+        np.testing.assert_allclose([v for _, v in a.dps],
+                                   [v for _, v in b.dps], rtol=1e-12)
+        np.testing.assert_allclose([v for _, v in a.dps],
+                                   [v for _, v in w.dps], rtol=1e-12)
+
+
+def test_rollup_avg_host_tail(tsdb):
+    """The avg-rollup division tail also takes the host device for
+    small grids: write raw, roll up, delete raw, query 1m-avg."""
+    base_ms = 1356998400000
+    for i in range(120):
+        tsdb.add_point("r.m", 1356998400 + i * 10, float(i % 7),
+                       {"host": "a"})
+    from opentsdb_tpu.rollup.job import run_rollup_job
+    run_rollup_job(tsdb, base_ms, base_ms + 1200_000)
+    q = TSQuery.from_json({
+        "start": base_ms, "end": base_ms + 1200_000,
+        "queries": [{"aggregator": "sum", "metric": "r.m",
+                     "downsample": "1m-avg"}]})
+    want = tsdb.new_query().run(q.validate())
+    tsdb.config.override_config("tsd.query.host_tail_max_cells", "-1")
+    tsdb.drop_caches()
+    off = tsdb.new_query().run(q.validate())
+    assert len(want) == len(off) == 1
+    assert [t for t, _ in want[0].dps] == [t for t, _ in off[0].dps]
+    np.testing.assert_allclose([v for _, v in want[0].dps],
+                               [v for _, v in off[0].dps], rtol=1e-12)
