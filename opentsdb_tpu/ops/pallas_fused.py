@@ -104,14 +104,18 @@ def supported(spec, dtype) -> bool:
     return True
 
 
-def _tile_s(s: int, p: int, g: int, itemsize: int) -> int:
+def _tile_s(s: int, p: int, g: int, itemsize: int,
+            span: bool = False) -> int:
     """Lane-dim series tile. 8192 measured fastest on v5e for the
     benchmark shape (P=60): the [P, TILE] stream block + its three bf16
-    split terms + the [G, TILE] one-hot must fit the VMEM working set
-    alongside the double-buffered input."""
+    split terms must fit the VMEM working set alongside the
+    double-buffered input — plus, for the one-hot kernel only, the
+    [G, TILE] one-hot (the span kernel's group state is just the tiny
+    [G, B] accumulator, so its tile never shrinks with G)."""
     tile = 8192
+    onehot_bytes = 0 if span else g * 2
     while tile > 128 and \
-            (p * tile * (2 * itemsize + 3 * 2) + g * tile * 2) \
+            tile * (p * (2 * itemsize + 3 * 2) + onehot_bytes) \
             > _VMEM_BUDGET:
         tile //= 2
     return max(128, min(tile, -(-s // 128) * 128))
@@ -431,7 +435,10 @@ def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
     selected (see :func:`_run`)."""
     np_dtype = np.dtype(dtype)
     s, p = values2d.shape
-    tile_s = _tile_s(s, p, spec.num_groups, np_dtype.itemsize)
+    # try the span layout at its own (larger) VMEM-budget tile first;
+    # recompute with the one-hot term only on fallback
+    tile_s = _tile_s(s, p, spec.num_groups, np_dtype.itemsize,
+                     span=allow_span)
     s_pad = -(-s // tile_s) * tile_s
     interpret = jax.default_backend() != "tpu"
     split = (force_split or not interpret) and np_dtype == np.float32
@@ -464,6 +471,14 @@ def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
                 put(jnp.asarray(sizes)), put(jnp.asarray(spans)))
         return args, tile_s, interpret
 
+    if allow_span:
+        # span layout unavailable: redo the tile budget with the
+        # one-hot [G, TILE] term the fallback kernel materializes
+        tile_s = _tile_s(s, p, spec.num_groups, np_dtype.itemsize,
+                         span=False)
+        s_pad = -(-s // tile_s) * tile_s
+        vals = np.zeros((s_pad, p), dtype=np_dtype)
+        vals[:s] = values2d
     gids = np.full((1, s_pad), -1, dtype=np.int32)
     gids[0, :s] = group_ids
     vals_t = _transpose(put(jnp.asarray(vals)))

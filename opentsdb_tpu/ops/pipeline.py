@@ -206,15 +206,30 @@ def pipeline_dtype():
         else jnp.float32
 
 
+def as_operand(x, dtype=None):
+    """Prepare one jit operand without touching the default device.
+
+    Host values are numpy-cast and handed to jit as-is — jax places
+    them WITH the call's committed operands, so they never materialize
+    on the default device first. (``jnp.asarray`` would: on a
+    tunneled/remote accelerator that eager materialization costs a
+    per-operand round trip, and when the computation is bound for the
+    host CPU backend the data would travel host -> accelerator -> host
+    for nothing.) Device arrays pass through, cast on their own
+    device."""
+    if isinstance(x, jax.Array):
+        return x if dtype is None or x.dtype == jnp.dtype(dtype) \
+            else x.astype(dtype)
+    return np.asarray(x, dtype=dtype)
+
+
 def put_grid(grid, has_data, device=None):
     """Upload a [S, B] grid + presence mask once, in the compute dtype
     — callers cache the returned DEVICE arrays so repeated queries
     skip the host scan and the transfer entirely."""
     dtype = pipeline_dtype()
-    return (jax.device_put(jnp.asarray(grid, dtype=dtype),
-                           device=device),
-            jax.device_put(jnp.asarray(has_data, dtype=bool),
-                           device=device))
+    return (jax.device_put(as_operand(grid, dtype), device=device),
+            jax.device_put(as_operand(has_data, bool), device=device))
 
 
 def _pad_2d(arr, s_pad: int, b_pad: int, fill):
@@ -280,14 +295,16 @@ def execute_grid(grid: np.ndarray, has_data: np.ndarray,
         has_data if isinstance(has_data, jax.Array)
         else np.asarray(has_data), bucket_ts, group_ids, spec)
     put = partial(jax.device_put, device=device)
-    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
-                   jnp.asarray(ro.reset_value, dtype=dtype))
+    rate_params = (as_operand(ro.counter_max, dtype),
+                   as_operand(ro.reset_value, dtype))
+    # the grid is the committed operand deciding placement; everything
+    # else rides along as numpy (no eager default-device round trips)
     result, emit = run_pipeline_grid(
-        put(jnp.asarray(grid, dtype=dtype)),
-        put(jnp.asarray(has_data, dtype=bool)),
-        put(jnp.asarray(device_bucket_ts(bucket_ts))),
-        put(jnp.asarray(group_ids, dtype=jnp.int32)),
-        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), pspec)
+        put(as_operand(grid, dtype)),
+        put(as_operand(has_data, bool)),
+        as_operand(device_bucket_ts(bucket_ts)),
+        as_operand(group_ids, np.int32),
+        rate_params, as_operand(spec.fill_value, dtype), pspec)
     rows = s if spec.emit_raw else g
     return (np.asarray(result)[:rows, :b],
             np.asarray(emit)[:rows, :b])
@@ -336,14 +353,14 @@ def execute_avg_divide(grid_sum, grid_cnt, bucket_ts: np.ndarray,
     gsum = _pad_2d(grid_sum, s_pad, b_pad, np.nan)
     gcnt = _pad_2d(grid_cnt, s_pad, b_pad, np.nan)
     put = partial(jax.device_put, device=device)
-    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
-                   jnp.asarray(ro.reset_value, dtype=dtype))
+    rate_params = (as_operand(ro.counter_max, dtype),
+                   as_operand(ro.reset_value, dtype))
     result, emit = run_pipeline_avg_div(
-        put(jnp.asarray(gsum, dtype=dtype)),
-        put(jnp.asarray(gcnt, dtype=dtype)),
-        put(jnp.asarray(device_bucket_ts(bts_p))),
-        put(jnp.asarray(gids_p, dtype=jnp.int32)),
-        rate_params, jnp.asarray(spec.fill_value, dtype=dtype), pspec)
+        put(as_operand(gsum, dtype)),
+        put(as_operand(gcnt, dtype)),
+        as_operand(device_bucket_ts(bts_p)),
+        as_operand(gids_p, np.int32),
+        rate_params, as_operand(spec.fill_value, dtype), pspec)
     rows = s if spec.emit_raw else g
     return (np.asarray(result)[:rows, :b],
             np.asarray(emit)[:rows, :b])
@@ -461,9 +478,9 @@ def _run_dense_or_pallas(values2d, bucket_ts, group_ids, spec, k, ro,
                     "the XLA dense path", exc_info=True)
     put = partial(jax.device_put, device=device)
     result, emit = run_pipeline_dense(
-        put(jnp.asarray(values2d, dtype=dtype)),
-        put(jnp.asarray(device_bucket_ts(bucket_ts))),
-        put(jnp.asarray(group_ids, dtype=jnp.int32)),
+        put(as_operand(values2d, dtype)),
+        as_operand(device_bucket_ts(bucket_ts)),
+        as_operand(group_ids, np.int32),
         rate_params, fv, spec, k)
     return np.asarray(result), np.asarray(emit)
 
@@ -486,9 +503,9 @@ def execute_auto(padded, bucket_idx2d: np.ndarray,
     k = detect_regular_padded(counts, np.asarray(bucket_idx2d),
                               spec.num_buckets)
     put = partial(jax.device_put, device=device)
-    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
-                   jnp.asarray(ro.reset_value, dtype=dtype))
-    fv = jnp.asarray(spec.fill_value, dtype=dtype)
+    rate_params = (as_operand(ro.counter_max, dtype),
+                   as_operand(ro.reset_value, dtype))
+    fv = as_operand(spec.fill_value, dtype)
     if k is not None and spec.ds_function in _DENSE_FNS:
         return _run_dense_or_pallas(values2d, bucket_ts, group_ids,
                                     spec, k, ro, rate_params, fv,
@@ -497,10 +514,10 @@ def execute_auto(padded, bucket_idx2d: np.ndarray,
     if ds_mod.padded_supported(spec.ds_function, spec.num_buckets) \
             and cells <= _PADDED_EINSUM_MAX_CELLS:
         result, emit = run_pipeline_padded(
-            put(jnp.asarray(values2d, dtype=dtype)),
-            put(jnp.asarray(bucket_idx2d, dtype=jnp.int32)),
-            put(jnp.asarray(device_bucket_ts(bucket_ts))),
-            put(jnp.asarray(group_ids, dtype=jnp.int32)),
+            put(as_operand(values2d, dtype)),
+            as_operand(bucket_idx2d, np.int32),
+            as_operand(device_bucket_ts(bucket_ts)),
+            as_operand(group_ids, np.int32),
             rate_params, fv, spec)
         return np.asarray(result), np.asarray(emit)
     values, series_idx, bucket_idx = flatten_padded(
@@ -623,10 +640,12 @@ def prepare_flat(values: np.ndarray, series_idx: np.ndarray,
 def run_prepared(prep: PreparedBatch, bucket_ts: np.ndarray,
                  group_ids: np.ndarray, spec: PipelineSpec,
                  rate_options: RateOptions | None = None,
-                 dtype=None, device=None
-                 ) -> tuple[np.ndarray, np.ndarray]:
+                 dtype=None) -> tuple[np.ndarray, np.ndarray]:
     """Execute a (possibly cached) PreparedBatch -> (result, emit),
-    trimming off the shape-bucket padding the prepare step added."""
+    trimming off the shape-bucket padding the prepare step added.
+    Placement follows the PreparedBatch's committed device arrays
+    (decided by prepare_* at upload); the small per-query operands
+    ride along as numpy."""
     from dataclasses import replace
     from opentsdb_tpu.ops import shapes
     if dtype is None:
@@ -642,12 +661,13 @@ def run_prepared(prep: PreparedBatch, bucket_ts: np.ndarray,
                                          s_pad, g)
         spec = replace(spec, num_series=s_pad, num_buckets=b_pad,
                        num_groups=g_pad)
-    put = partial(jax.device_put, device=device)
-    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
-                   jnp.asarray(ro.reset_value, dtype=dtype))
-    fv = jnp.asarray(spec.fill_value, dtype=dtype)
-    bts = put(jnp.asarray(device_bucket_ts(bucket_ts)))
-    gids = put(jnp.asarray(group_ids, dtype=jnp.int32))
+    rate_params = (as_operand(ro.counter_max, dtype),
+                   as_operand(ro.reset_value, dtype))
+    fv = as_operand(spec.fill_value, dtype)
+    # numpy operands ride with the committed prepared arrays — no
+    # eager default-device materialization per query
+    bts = as_operand(device_bucket_ts(bucket_ts))
+    gids = as_operand(group_ids, np.int32)
     if prep.kind == "dense":
         result, emit = run_pipeline_dense(
             prep.arrays[0], bts, gids, rate_params, fv, spec, prep.k)
@@ -679,9 +699,9 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
         dtype = pipeline_dtype()
     ro = rate_options or RateOptions()
     put = partial(jax.device_put, device=device)
-    rate_params = (jnp.asarray(ro.counter_max, dtype=dtype),
-                   jnp.asarray(ro.reset_value, dtype=dtype))
-    fv = jnp.asarray(spec.fill_value, dtype=dtype)
+    rate_params = (as_operand(ro.counter_max, dtype),
+                   as_operand(ro.reset_value, dtype))
+    fv = as_operand(spec.fill_value, dtype)
     k = detect_dense(spec.num_series, spec.num_buckets,
                      np.asarray(series_idx), np.asarray(bucket_idx),
                      spec.ds_function)
@@ -690,14 +710,12 @@ def execute(batch_values: np.ndarray, series_idx: np.ndarray,
         return _run_dense_or_pallas(values2d, bucket_ts, group_ids,
                                     spec, k, ro, rate_params, fv,
                                     dtype, device, use_pallas)
-    values = put(jnp.asarray(batch_values, dtype=dtype))
+    values = put(as_operand(batch_values, dtype))
     result, emit = run_pipeline(
         values,
-        put(jnp.asarray(series_idx, dtype=jnp.int32)),
-        put(jnp.asarray(bucket_idx, dtype=jnp.int32)),
-        put(jnp.asarray(device_bucket_ts(bucket_ts))),
-        put(jnp.asarray(group_ids, dtype=jnp.int32)),
-        rate_params,
-        jnp.asarray(spec.fill_value, dtype=dtype),
-        spec)
+        as_operand(series_idx, np.int32),
+        as_operand(bucket_idx, np.int32),
+        as_operand(device_bucket_ts(bucket_ts)),
+        as_operand(group_ids, np.int32),
+        rate_params, fv, spec)
     return np.asarray(result), np.asarray(emit)

@@ -105,18 +105,19 @@ def run_warmup(tsdb) -> int:
             # small shape classes run their tail on the host CPU
             # backend (engine.host_tail_device) — warm the SAME
             # device placement so the pre-compiled program is the one
-            # real queries hit
+            # real queries hit. Arrays are built as numpy and
+            # device_put once (mirroring pipeline.as_operand: eager
+            # jnp allocation would round-trip the default device)
             import jax
-            from functools import partial as _partial
             from opentsdb_tpu.query.engine import host_tail_device
-            put = _partial(jax.device_put,
-                           device=host_tail_device(tsdb.config, s * b))
-            grid = put(jnp.zeros((s, b), dtype))
-            has = put(jnp.zeros((s, b), dtype=bool))
-            bts = put(jnp.arange(b, dtype=jnp.int32) * 60_000)
-            gids = put(jnp.zeros(s, dtype=jnp.int32))
-            rp = (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype))
-            fv = jnp.asarray(float("nan"), dtype)
+            dev = host_tail_device(tsdb.config, s * b)
+            grid = jax.device_put(np.zeros((s, b), dtype), device=dev)
+            has = jax.device_put(np.zeros((s, b), dtype=bool),
+                                 device=dev)
+            bts = np.arange(b, dtype=np.int32) * 60_000
+            gids = np.zeros(s, dtype=np.int32)
+            rp = (np.asarray(0.0, dtype), np.asarray(0.0, dtype))
+            fv = np.asarray(float("nan"), dtype)
             args = None
         else:
             # one upload per combo, shared by every spec below (the

@@ -214,3 +214,112 @@ class TestCalendarTimezones:
         assert [(d.month, d.day, d.hour) for d in local] == [
             (1, 1, 0), (2, 1, 0), (3, 1, 0), (4, 1, 0)]
         assert idx[0] == 0  # Jan 15 lands in the January bucket
+
+    def test_weekly_calendar_buckets(self):
+        # ref: TestDownsampler.testDownsampler_calendarWeek (:593) /
+        # _1week (:897): calendar weeks snap to the week start; every
+        # edge is 7 local days apart outside DST transitions
+        from datetime import datetime, timezone
+        from opentsdb_tpu.ops.downsample import (
+            DownsamplingSpecification, assign_buckets)
+        start = int(datetime(2013, 1, 2, tzinfo=timezone.utc)
+                    .timestamp() * 1000)   # a Wednesday
+        end = int(datetime(2013, 1, 25, tzinfo=timezone.utc)
+                  .timestamp() * 1000)
+        spec = DownsamplingSpecification.parse("1wc-sum",
+                                               timezone="UTC")
+        ts = np.asarray([start, start + 10 * 86400_000],
+                        dtype=np.int64)
+        idx, edges = assign_buckets(ts, spec, start, end)
+        # first edge is the week start at/before Jan 2; spacing 7 days
+        assert edges[0] <= start
+        diffs = np.diff(np.asarray(edges))
+        assert (diffs == 7 * 86400_000).all()
+        # Jan 2 and Jan 12 land in adjacent weeks (10 days apart)
+        assert idx[1] - idx[0] in (1, 2)
+
+    def test_yearly_calendar_buckets_timezone(self):
+        # ref: TestDownsampler.testDownsampler_1year_timezone (:1143):
+        # year buckets start at LOCAL Jan 1 midnight
+        from datetime import datetime
+        from zoneinfo import ZoneInfo
+        from opentsdb_tpu.ops.downsample import (
+            DownsamplingSpecification, assign_buckets)
+        tz = ZoneInfo("Australia/Sydney")
+        start = int(datetime(2012, 6, 1, tzinfo=tz).timestamp() * 1000)
+        end = int(datetime(2014, 2, 1, tzinfo=tz).timestamp() * 1000)
+        spec = DownsamplingSpecification.parse(
+            "1yc-sum", timezone="Australia/Sydney")
+        ts = np.asarray([start], dtype=np.int64)
+        _, edges = assign_buckets(ts, spec, start, end)
+        local = [datetime.fromtimestamp(e / 1000, tz) for e in edges]
+        assert [(d.month, d.day, d.hour) for d in local] == [
+            (1, 1, 0)] * len(local)
+        assert [d.year for d in local] == [2012, 2013, 2014]
+
+    def test_two_month_calendar_buckets(self):
+        # ref: TestDownsampler.testDownsampler_2months (:1033):
+        # multi-count calendar intervals group N calendar units per
+        # bucket (Jan+Feb, Mar+Apr, ...)
+        from datetime import datetime, timezone
+        from opentsdb_tpu.ops.downsample import (
+            DownsamplingSpecification, assign_buckets)
+        utc = timezone.utc
+        start = int(datetime(2013, 1, 5, tzinfo=utc).timestamp() * 1000)
+        end = int(datetime(2013, 6, 20, tzinfo=utc).timestamp() * 1000)
+        spec = DownsamplingSpecification.parse("2nc-sum",
+                                               timezone="UTC")
+        jan = int(datetime(2013, 1, 10, tzinfo=utc).timestamp() * 1000)
+        feb = int(datetime(2013, 2, 10, tzinfo=utc).timestamp() * 1000)
+        mar = int(datetime(2013, 3, 10, tzinfo=utc).timestamp() * 1000)
+        may = int(datetime(2013, 5, 10, tzinfo=utc).timestamp() * 1000)
+        ts = np.asarray([jan, feb, mar, may], dtype=np.int64)
+        idx, edges = assign_buckets(ts, spec, start, end)
+        # Jan+Feb share a bucket; Mar starts the next; May the third
+        assert idx[0] == idx[1]
+        assert idx[2] == idx[0] + 1
+        assert idx[3] == idx[0] + 2
+        local = [datetime.fromtimestamp(e / 1000, utc) for e in edges]
+        assert [d.month for d in local[:3]] == [1, 3, 5]
+
+    def test_fall_back_dst_day_has_25_hours(self):
+        # complement of the spring-forward test: US DST ended
+        # 2013-11-03, so that local day is 25 hours long
+        from datetime import datetime
+        from zoneinfo import ZoneInfo
+        from opentsdb_tpu.ops.downsample import (
+            DownsamplingSpecification, assign_buckets)
+        tz = ZoneInfo("America/New_York")
+        start = int(datetime(2013, 11, 2, 0, 0, tzinfo=tz)
+                    .timestamp() * 1000)
+        end = int(datetime(2013, 11, 4, 23, 0, tzinfo=tz)
+                  .timestamp() * 1000)
+        spec = DownsamplingSpecification.parse(
+            "1dc-sum", timezone="America/New_York")
+        ts = np.asarray([start], dtype=np.int64)
+        _, edges = assign_buckets(ts, spec, start, end)
+        assert (edges[1] - edges[0]) == 24 * 3600_000
+        assert (edges[2] - edges[1]) == 25 * 3600_000
+
+    def test_run_all_filters_out_of_range(self):
+        # ref: testDownsampler_allFilterOnQueryOutOfRangeEarly/-Late
+        # (:338, :364): 0all aggregates only points inside the query
+        # window. assign_buckets assumes pre-filtered input (the store
+        # materialize applies the window), so this pins the semantics
+        # END TO END through a real TSDB query.
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.query.model import TSQuery
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           "tsd.tpu.warmup": "false"}))
+        base = 1356998400
+        for i, v in [(0, 100.0), (60, 1.0), (120, 2.0), (600, 500.0)]:
+            t.add_point("ra.m", base + i, v, {"host": "a"})
+        q = TSQuery.from_json({
+            "start": (base + 30) * 1000, "end": (base + 300) * 1000,
+            "queries": [{"aggregator": "sum", "metric": "ra.m",
+                         "downsample": "0all-sum"}]}).validate()
+        res = t.new_query().run(q)
+        assert len(res) == 1
+        vals = [v for _, v in res[0].dps]
+        # only the 60s and 120s points are in-window: 1 + 2
+        assert vals == [3.0]

@@ -259,7 +259,7 @@ def test_span_multi_tile_spans(monkeypatch):
     size is pinned to 128 so 300 series genuinely span 3 grid steps
     (the default _tile_s would cover them in one)."""
     monkeypatch.setattr(pallas_fused, "_tile_s",
-                        lambda s, p, g, itemsize: 128)
+                        lambda s, p, g, itemsize, span=False: 128)
     vals, ts, gids, spec, k = _prep_for(
         300, 3, seed=17, ds_function="sum", agg_name="sum")
     args, tile_s, interp = pallas_fused.prepare(vals, ts, gids, spec, k,

@@ -1,0 +1,121 @@
+"""Tag parsing/validation edge matrix, pinned to the reference's
+TestTags.java scenarios (ref: test/core/TestTags.java:80-395) — the
+table-driven port of its parseWithMetric / parse / validateString
+cases. Each row cites the reference test it mirrors."""
+
+import pytest
+
+from opentsdb_tpu.core import const
+from opentsdb_tpu.core import tags as tags_mod
+
+
+# (input, expected_metric, expected_tags) — parseWithMetric accepts
+GOOD_PARSES = [
+    # parseWithMetricWTag :80
+    ("sys.cpu.user{host=web01}", "sys.cpu.user", {"host": "web01"}),
+    # parseWithMetricWTags :89
+    ("sys.cpu.user{host=web01,dc=lga}", "sys.cpu.user",
+     {"host": "web01", "dc": "lga"}),
+    # parseWithMetricMetricOnly :100
+    ("sys.cpu.user", "sys.cpu.user", {}),
+    # parseWithMetricMetricEmptyCurlies :108
+    ("sys.cpu.user{}", "sys.cpu.user", {}),
+    # parseWithMetricEmpty :164 (empty in, empty metric out, no raise)
+    ("", "", {}),
+    # parseWithMetricMissingOpeningCurly :178 — documented reference
+    # quirk: no '{' means the WHOLE string is the metric (the UID
+    # lookup rejects it later)
+    ("sys.cpu.user host=web01}", "sys.cpu.user host=web01}", {}),
+]
+
+# inputs parseWithMetric must reject (IllegalArgumentException rows)
+BAD_PARSES = [
+    "sys.cpu.user{host=}",             # NullTagv :122
+    "sys.cpu.user{=web01}",            # NullTagk :128
+    "sys.cpu.user{host=web01,dc=}",    # NullTagv2 :134
+    "sys.cpu.user{host=web01,=lga}",   # NullTagk2 :140
+    "sys.cpu.user{host=web01,dc=,=root}",   # NullTagv3 :146
+    "sys.cpu.user{host=web01,=lga,owner=}",  # NullTagk3 :152
+    "sys.cpu.user{host=web01",         # MissingClosingCurly :170
+    "sys.cpu.user{hostweb01}",         # MissingEquals :185
+    "sys.cpu.user{host=web01 dc=lga}",  # MissingComma :191
+    "sys.cpu.user{host=web01,}",       # TrailingComma :197
+    "sys.cpu.user{,host=web01}",       # ForwardComma :203
+    "sys.cpu.user{=}",                 # OnlyEquals :389
+]
+
+
+@pytest.mark.parametrize("arg,metric,tags", GOOD_PARSES)
+def test_parse_with_metric_accepts(arg, metric, tags):
+    got_metric, got_tags = tags_mod.parse_with_metric(arg)
+    assert got_metric == metric
+    assert got_tags == tags
+
+
+@pytest.mark.parametrize("arg", BAD_PARSES)
+def test_parse_with_metric_rejects(arg):
+    with pytest.raises(ValueError):
+        tags_mod.parse_with_metric(arg)
+
+
+def test_parse_with_metric_none_raises():
+    # parseWithMetricNull :158 (NPE in the reference; any raise here)
+    with pytest.raises((ValueError, AttributeError, TypeError)):
+        tags_mod.parse_with_metric(None)
+
+
+# single-tag parse (ref: Tags.parse, exercised via TestTags parse rows)
+@pytest.mark.parametrize("tag,kv", [
+    ("host=web01", ("host", "web01")),
+    ("a=b", ("a", "b")),
+])
+def test_parse_tag_accepts(tag, kv):
+    assert tags_mod.parse(tag) == kv
+
+
+@pytest.mark.parametrize("tag", [
+    "host=",        # empty value
+    "=web01",       # empty key
+    "hostweb01",    # no equals
+    "a=b=c",        # two equals
+    "=",
+    "",
+])
+def test_parse_tag_rejects(tag):
+    with pytest.raises(ValueError):
+        tags_mod.parse(tag)
+
+
+# validateString (ref: Tags.java:549-566): ASCII alphanumerics,
+# - _ . / and any Unicode letter
+@pytest.mark.parametrize("s", [
+    "simple", "with-dash", "under_score", "dotted.name", "a/b",
+    "MixedCase123", "héllo", "メトリック",  # unicode letters allowed
+])
+def test_validate_string_accepts(s):
+    tags_mod.validate_string("tag name", s)
+
+
+@pytest.mark.parametrize("s", [
+    "with space", "tab\tchar", "new\nline", "per%cent", "a=b",
+    "curly{", "comma,", "", "emoji\U0001f600",  # emoji is not a letter
+])
+def test_validate_string_rejects(s):
+    with pytest.raises(ValueError):
+        tags_mod.validate_string("tag name", s)
+
+
+def test_check_metric_and_tags_bounds():
+    # ref: IncomingDataPoints.checkMetricAndTags — at least one tag,
+    # at most Const.MAX_NUM_TAGS (Const.java:28-36)
+    with pytest.raises(ValueError):
+        tags_mod.check_metric_and_tags("m", {})
+    at_max = {f"k{i}": "v" for i in range(const.MAX_NUM_TAGS)}
+    tags_mod.check_metric_and_tags("m", at_max)  # exactly max: ok
+    over = dict(at_max, extra="v")
+    with pytest.raises(ValueError):
+        tags_mod.check_metric_and_tags("m", over)
+    with pytest.raises(ValueError):
+        tags_mod.check_metric_and_tags("bad metric", {"host": "a"})
+    with pytest.raises(ValueError):
+        tags_mod.check_metric_and_tags("m", {"host": "bad value!"})
