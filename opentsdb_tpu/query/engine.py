@@ -144,26 +144,38 @@ def _store_id(store) -> int:
 # default padded [S, B] cell count below which the pipeline tail runs
 # on the host CPU backend instead of the accelerator
 HOST_TAIL_DEFAULT_CELLS = 1 << 20
+# and the [S, B] x G work-product cap: the tail's group stage is a
+# one-hot contraction whose flops scale with cells * groups — a
+# single-core host grinds through ~10 GFLOP/s, so a many-group query
+# that fits the cell budget can still be seconds on the host while the
+# accelerator does it in microseconds (measured: [114688, 8] x 1024
+# groups = 2.5 s on one CPU core)
+HOST_TAIL_DEFAULT_CELLGROUPS = 1 << 25
 
 
-def host_tail_device(config, padded_cells: int):
+def host_tail_device(config, padded_cells: int,
+                     padded_groups: int = 1):
     """Device override for small-query tails.
 
-    Below ``tsd.query.host_tail_max_cells`` (compared against the
-    shape-bucket-PADDED [S, B] cell count, so the decision is
-    deterministic per compiled-shape class and warmup can pre-compile
-    the same programs) the fill/rate/aggregate tail runs on the host
-    CPU backend. A dashboard-sized query's wall time on a remote or
-    tunneled accelerator is dominated by per-query RPC round trips,
-    not compute — the reference serves this class straight from the
-    local JVM heap (ref: QueryRpc.java:128 -> TsdbQuery compute
-    in-process). Set the key to -1 to disable; 0 means the default.
+    Below ``tsd.query.host_tail_max_cells`` AND with
+    ``cells * groups`` below ``tsd.query.host_tail_max_cellgroups``
+    (both compared against shape-bucket-PADDED dims, so the decision
+    is deterministic per compiled-shape class and warmup can
+    pre-compile the same programs) the fill/rate/aggregate tail runs
+    on the host CPU backend. A dashboard-sized query's wall time on a
+    remote or tunneled accelerator is dominated by per-query RPC round
+    trips, not compute — the reference serves this class straight from
+    the local JVM heap (ref: QueryRpc.java:128 -> TsdbQuery compute
+    in-process). Set either key to -1 to disable; 0 means the default.
     Mesh queries never take this path (sharded data is already
     device-resident). Returns a committed CPU ``jax.Device`` or None
     (= use the default device)."""
     limit = config.get_int("tsd.query.host_tail_max_cells", 0) \
         or HOST_TAIL_DEFAULT_CELLS
-    if limit < 0 or padded_cells > limit:
+    glimit = config.get_int("tsd.query.host_tail_max_cellgroups", 0) \
+        or HOST_TAIL_DEFAULT_CELLGROUPS
+    if limit < 0 or glimit < 0 or padded_cells > limit \
+            or padded_cells * max(padded_groups, 1) > glimit:
         return None
     import jax
     try:
@@ -717,7 +729,9 @@ class QueryEngine:
             host_dev = host_tail_device(
                 self.tsdb.config,
                 _shapes.shape_bucket(len(sids))
-                * _shapes.shape_bucket(b))
+                * _shapes.shape_bucket(b),
+                len(sids) if emit_raw
+                else _shapes.shape_bucket(num_groups + 1))
         # device-resident cache: a warm repeat of this reduction skips
         # the host scan AND the upload (HBM ≙ HBase block cache).
         # Under a mesh the cached value is the pre-SHARDED device args
@@ -900,7 +914,9 @@ class QueryEngine:
                 from opentsdb_tpu.ops import shapes as _shapes
                 host_dev = host_tail_device(
                     self.tsdb.config,
-                    _shapes.shape_bucket(s) * _shapes.shape_bucket(b))
+                    _shapes.shape_bucket(s) * _shapes.shape_bucket(b),
+                    s if emit_raw
+                    else _shapes.shape_bucket(num_groups + 1))
             # host-tail queries skip the device cache (see
             # _grid_pipeline: cheap native re-scan; host RAM must not
             # evict HBM-resident grids)

@@ -110,7 +110,7 @@ def run_warmup(tsdb) -> int:
             # jnp allocation would round-trip the default device)
             import jax
             from opentsdb_tpu.query.engine import host_tail_device
-            dev = host_tail_device(tsdb.config, s * b)
+            dev = host_tail_device(tsdb.config, s * b, g)
             grid = jax.device_put(np.zeros((s, b), dtype), device=dev)
             has = jax.device_put(np.zeros((s, b), dtype=bool),
                                  device=dev)
