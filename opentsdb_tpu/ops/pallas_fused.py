@@ -61,6 +61,7 @@ dropped points re-open NaN holes mid-pipeline. Golden tests:
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 
 import jax
@@ -104,18 +105,27 @@ def supported(spec, dtype) -> bool:
     return True
 
 
+def _span_fixed_bytes(g: int, b: int, itemsize: int) -> int:
+    """Tile-independent VMEM the span kernel holds: the [G, B]
+    accumulator plus the masked [G, B] update temp."""
+    return g * b * itemsize * 2
+
+
 def _tile_s(s: int, p: int, g: int, itemsize: int,
-            span: bool = False) -> int:
+            span: bool = False, b: int = 0) -> int:
     """Lane-dim series tile. 8192 measured fastest on v5e for the
     benchmark shape (P=60): the [P, TILE] stream block + its three bf16
     split terms must fit the VMEM working set alongside the
     double-buffered input — plus, for the one-hot kernel only, the
-    [G, TILE] one-hot (the span kernel's group state is just the tiny
-    [G, B] accumulator, so its tile never shrinks with G)."""
+    [G, TILE] one-hot. The span kernel instead holds a tile-INDEPENDENT
+    [G, B] accumulator + update temp, budgeted as a fixed subtraction
+    (prepare() gates the span path off entirely when that fixed cost
+    crowds out the stream tiles)."""
     tile = 8192
     onehot_bytes = 0 if span else g * 2
+    fixed = _span_fixed_bytes(g, b, itemsize) if span else 0
     while tile > 128 and \
-            tile * (p * (2 * itemsize + 3 * 2) + onehot_bytes) \
+            fixed + tile * (p * (2 * itemsize + 3 * 2) + onehot_bytes) \
             > _VMEM_BUDGET:
         tile //= 2
     return max(128, min(tile, -(-s // 128) * 128))
@@ -390,6 +400,41 @@ def _gather_transpose(values2d, order):
     return values2d[order].T
 
 
+# sort orders keyed by group-id content: fused_dense_pipeline runs
+# prepare() per query, and a repeated dashboard query re-sorting the
+# same (often 1M-long) group vector pays an O(S log S) host argsort
+# each time for an identical permutation. Byte-bounded + locked: the
+# TSD's query thread pool calls prepare() concurrently, and a 1M-series
+# permutation is ~4 MB of host RAM per entry.
+_ORDER_CACHE: "dict[tuple, np.ndarray | None]" = {}
+_ORDER_CACHE_MAX_BYTES = 32 * 1024 * 1024
+_ORDER_CACHE_LOCK = threading.Lock()
+_order_cache_bytes = 0
+
+
+def _sort_order(gids: np.ndarray):
+    """Stable group-sort permutation (None = already sorted), memoized
+    on the group-id content digest."""
+    global _order_cache_bytes
+    from opentsdb_tpu.query.device_cache import array_digest
+    key = (array_digest(np.ascontiguousarray(gids)), len(gids))
+    with _ORDER_CACHE_LOCK:
+        if key in _ORDER_CACHE:
+            return _ORDER_CACHE[key]
+    order = None if np.all(gids[1:] >= gids[:-1]) else \
+        np.argsort(gids, kind="stable").astype(np.int32)
+    nbytes = 0 if order is None else order.nbytes
+    with _ORDER_CACHE_LOCK:
+        while _ORDER_CACHE and \
+                _order_cache_bytes + nbytes > _ORDER_CACHE_MAX_BYTES:
+            _, old = _ORDER_CACHE.popitem()
+            _order_cache_bytes -= 0 if old is None else old.nbytes
+        if key not in _ORDER_CACHE:
+            _ORDER_CACHE[key] = order
+            _order_cache_bytes += nbytes
+    return order
+
+
 def _span_layout(group_ids: np.ndarray, s_pad: int, tile_s: int,
                  g: int):
     """Try the group-sorted span layout. Returns (order | None,
@@ -404,12 +449,8 @@ def _span_layout(group_ids: np.ndarray, s_pad: int, tile_s: int,
     gids = np.asarray(group_ids, dtype=np.int32)
     s = len(gids)
     nt = s_pad // tile_s
-    if s and np.all(gids[1:] >= gids[:-1]):
-        order = None
-        gsorted = gids
-    else:
-        order = np.argsort(gids, kind="stable").astype(np.int32)
-        gsorted = gids[order]
+    order = _sort_order(gids) if s else np.zeros(0, dtype=np.int32)
+    gsorted = gids if order is None else gids[order]
     gpad = np.full(s_pad, g, np.int32)
     gpad[:s] = gsorted
     gt = gpad.reshape(nt, tile_s)
@@ -435,10 +476,16 @@ def prepare(values2d: np.ndarray, bucket_ts: np.ndarray,
     selected (see :func:`_run`)."""
     np_dtype = np.dtype(dtype)
     s, p = values2d.shape
+    # span viability: its [G, B] accumulator + update temp are
+    # tile-independent, so a many-bucket query near the group cap must
+    # fall to one-hot BEFORE Mosaic hits the VMEM wall at runtime
+    if _span_fixed_bytes(spec.num_groups, spec.num_buckets,
+                         np_dtype.itemsize) > _VMEM_BUDGET // 2:
+        allow_span = False
     # try the span layout at its own (larger) VMEM-budget tile first;
     # recompute with the one-hot term only on fallback
     tile_s = _tile_s(s, p, spec.num_groups, np_dtype.itemsize,
-                     span=allow_span)
+                     span=allow_span, b=spec.num_buckets)
     s_pad = -(-s // tile_s) * tile_s
     interpret = jax.default_backend() != "tpu"
     split = (force_split or not interpret) and np_dtype == np.float32

@@ -266,12 +266,18 @@ def _group_reduce_psum(filled, group_ids, num_groups: int, agg_name: str,
     elif agg_name == "squareSum":
         out = jax.lax.psum(seg(x0 * x0), axis_name)
     elif agg_name == "dev":
+        # Two-pass mean-shifted variance, matching the single-chip
+        # agg_dev exactly (ops/aggregators.py agg_dev): psum the raw
+        # sums for the GLOBAL mean, then psum the locally centered
+        # squares.  The one-pass E[x^2]-E[x]^2 form cancels
+        # catastrophically in f32 when mean >> std (e.g. counters near
+        # 1e7) and diverged from the single-device path.
         s1 = jax.lax.psum(seg(x0), axis_name)
-        s2 = jax.lax.psum(seg(x0 * x0), axis_name)
-        mean = s1 / jnp.maximum(cnt, 1)
-        var = jnp.maximum(s2 / jnp.maximum(cnt, 1) - mean * mean, 0.0) \
-            * (jnp.maximum(cnt, 1) / jnp.maximum(cnt - 1, 1))
-        out = jnp.where(cnt == 1, 0.0, jnp.sqrt(var))
+        mean = s1 / jnp.maximum(cnt, 1)                     # [G, B]
+        centered = jnp.where(valid, filled - mean[group_ids, :], 0.0)
+        m2 = jax.lax.psum(seg(centered * centered), axis_name)
+        var = m2 / jnp.maximum(cnt - 1, 1)
+        out = jnp.where(cnt == 1, 0.0, jnp.sqrt(jnp.maximum(var, 0.0)))
     else:
         raise ValueError(f"{agg_name} is not psum-reducible")
     return jnp.where(cnt > 0, out, jnp.nan)

@@ -184,6 +184,19 @@ def host_tail_device(config, padded_cells: int,
         return None
 
 
+def host_tail_for_dims(config, s: int, b: int, num_groups: int,
+                       emit_raw: bool = False):
+    """:func:`host_tail_device` from RAW query dims — the ONE place the
+    decision inputs are shape-bucketed, shared by the engine paths and
+    tsd.warmup so a warmed placement cannot drift from the engine's
+    (ADVICE r04). emit_raw has no group contraction: group factor 1."""
+    from opentsdb_tpu.ops import shapes as _shapes
+    return host_tail_device(
+        config,
+        _shapes.shape_bucket(s) * _shapes.shape_bucket(b),
+        1 if emit_raw else _shapes.shape_bucket(num_groups + 1))
+
+
 def compact_row_labels(mat: np.ndarray) -> tuple[np.ndarray, int]:
     """``np.unique(mat, axis=0, return_inverse=True)`` equivalent via
     per-column factorization — the void-dtype row sort behind
@@ -725,13 +738,8 @@ class QueryEngine:
         # per padded-shape class, matching warmup's pre-compiles
         host_dev = None
         if mesh is None:
-            from opentsdb_tpu.ops import shapes as _shapes
-            host_dev = host_tail_device(
-                self.tsdb.config,
-                _shapes.shape_bucket(len(sids))
-                * _shapes.shape_bucket(b),
-                len(sids) if emit_raw
-                else _shapes.shape_bucket(num_groups + 1))
+            host_dev = host_tail_for_dims(self.tsdb.config, len(sids),
+                                          b, num_groups, emit_raw)
         # device-resident cache: a warm repeat of this reduction skips
         # the host scan AND the upload (HBM ≙ HBase block cache).
         # Under a mesh the cached value is the pre-SHARDED device args
@@ -911,12 +919,8 @@ class QueryEngine:
             t0_ms = int(bucket_ts[0])
             mesh = self.tsdb.query_mesh
             if mesh is None:
-                from opentsdb_tpu.ops import shapes as _shapes
-                host_dev = host_tail_device(
-                    self.tsdb.config,
-                    _shapes.shape_bucket(s) * _shapes.shape_bucket(b),
-                    s if emit_raw
-                    else _shapes.shape_bucket(num_groups + 1))
+                host_dev = host_tail_for_dims(self.tsdb.config, s, b,
+                                              num_groups, emit_raw)
             # host-tail queries skip the device cache (see
             # _grid_pipeline: cheap native re-scan; host RAM must not
             # evict HBM-resident grids)

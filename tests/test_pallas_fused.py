@@ -204,6 +204,60 @@ def test_span_layout_selection():
     assert len(args) == 5
 
 
+def test_span_declines_when_gxb_exceeds_vmem():
+    """ADVICE r04: the span kernel's [G, B] accumulator + update temp
+    are tile-independent VMEM; a many-bucket query near the group cap
+    must fall back to one-hot at prepare time instead of failing
+    Mosaic at runtime. 1024 groups x 800 buckets x f32 x 2 = 6.6 MB
+    > half the 10 MB budget."""
+    g, b, k, s = 1024, 800, 1, 2048
+    rng = np.random.default_rng(3)
+    vals = rng.normal(size=(s, b * k))
+    ts = np.arange(b, dtype=np.int64) * 60_000
+    gids = np.repeat(np.arange(g, dtype=np.int32), s // g)
+    spec = PipelineSpec(num_series=s, num_buckets=b, num_groups=g,
+                        ds_function="sum", agg_name="sum")
+    assert pallas_fused._span_fixed_bytes(g, b, 4) \
+        > pallas_fused._VMEM_BUDGET // 2
+    args, _, _ = pallas_fused.prepare(vals, ts, gids, spec, k)
+    assert len(args) == 5  # one-hot layout selected
+    # control: the same many-bucket shape with few groups (tiny fixed
+    # [G, B] state) stays on the span path
+    g2 = 4
+    gids2 = np.repeat(np.arange(g2, dtype=np.int32), s // g2)
+    spec2 = PipelineSpec(num_series=s, num_buckets=b, num_groups=g2,
+                         ds_function="sum", agg_name="sum")
+    args2, _, _ = pallas_fused.prepare(vals, ts, gids2, spec2, k)
+    assert len(args2) == 6
+
+
+def test_sort_order_cache_reused_across_prepares():
+    """ADVICE r04: fused_dense_pipeline runs prepare() per query; the
+    group-sort permutation must be memoized on the group-id digest so
+    a repeated dashboard query skips the O(S log S) host argsort."""
+    pallas_fused._ORDER_CACHE.clear()
+    vals, ts, gids, spec, k = _prep_for(
+        40, 4, seed=11, ds_function="avg", agg_name="sum")
+    args1, _, _ = pallas_fused.prepare(vals, ts, gids, spec, k)
+    assert len(pallas_fused._ORDER_CACHE) == 1
+    calls = []
+    orig = np.argsort
+
+    def counting_argsort(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    np.argsort = counting_argsort
+    try:
+        args2, _, _ = pallas_fused.prepare(vals, ts, gids, spec, k)
+    finally:
+        np.argsort = orig
+    assert not calls, "repeat prepare re-ran the argsort"
+    # and the cached order produces the identical layout
+    np.testing.assert_array_equal(np.asarray(args1[1]),
+                                  np.asarray(args2[1]))
+
+
 @pytest.mark.parametrize("ds_fn", DS_FNS)
 @pytest.mark.parametrize("agg", ["sum", "avg", "squareSum"])
 def test_span_matches_onehot(ds_fn, agg):
@@ -259,7 +313,7 @@ def test_span_multi_tile_spans(monkeypatch):
     size is pinned to 128 so 300 series genuinely span 3 grid steps
     (the default _tile_s would cover them in one)."""
     monkeypatch.setattr(pallas_fused, "_tile_s",
-                        lambda s, p, g, itemsize, span=False: 128)
+                        lambda s, p, g, itemsize, span=False, b=0: 128)
     vals, ts, gids, spec, k = _prep_for(
         300, 3, seed=17, ds_function="sum", agg_name="sum")
     args, tile_s, interp = pallas_fused.prepare(vals, ts, gids, spec, k,

@@ -136,11 +136,17 @@ def test_warmup_compiles_resident_buckets():
     t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
     for i in range(30):
         t.add_point("w.m", 1356998400 + i, float(i),
-                    {"host": f"h{i % 3}"})
+                    {"host": f"h{i}"})
     combos = warmup_shapes(t)
-    assert all(s >= 8 and b >= 8 and g >= 8 for s, b, g in combos)
-    # {sum,avg}x{plain,rate} + {p95,p99} grid programs per combo
-    assert run_warmup(t) == len(combos) * 6
+    # S/B are padded shape buckets; G stays RAW (run_warmup routes it
+    # through the engine's own shape_bucket(G+1) helper)
+    assert all(s >= 8 and b >= 8 and g >= 1 for s, b, g in combos)
+    # the real tag cardinality class (30 hosts -> G bucket 32, distinct
+    # from the 1-group bucket 8) must be represented
+    assert any(g == 30 for _, _, g in combos)
+    # {sum,avg}x{plain,rate} + {p95,p99} grid programs + the emit_raw
+    # class per combo (no rollup tiers resident -> no avg-div warms)
+    assert run_warmup(t) == len(combos) * 7
 
 
 @pytest.mark.slow
