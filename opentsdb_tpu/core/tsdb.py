@@ -140,6 +140,12 @@ class TSDB:
         self._device_cache_lock = threading.Lock()
         self._device_cache_mb = self.config.get_int(
             "tsd.query.device_cache_mb", 1024)
+        # host-RAM twin for host-tail prepared batches: deliberately a
+        # SEPARATE pool so host entries can never evict HBM-resident
+        # grids (whose re-upload is the cost the device cache avoids)
+        self._host_prep_cache = None
+        self._host_cache_mb = self.config.get_int(
+            "tsd.query.host_cache_mb", 512)
         # host-side per-(store, metric) TagMatrix cache, invalidated by
         # series count (the metric index is append-only)
         self._tagmat_cache: dict = {}
@@ -738,6 +744,23 @@ class TSDB:
                     self._device_grid_cache = cache
         return self._device_grid_cache
 
+    @property
+    def host_prep_cache(self):
+        """Host-RAM prepared-batch cache for host-tail queries (warm
+        repeats skip materialize + union-grid construction), or None
+        when disabled (``tsd.query.host_cache_mb = 0``)."""
+        if self._host_prep_cache is None and self._host_cache_mb:
+            with self._device_cache_lock:
+                if self._host_prep_cache is None:
+                    from opentsdb_tpu.query.device_cache import \
+                        DeviceGridCache
+                    cache = DeviceGridCache(
+                        self._host_cache_mb * (1 << 20),
+                        stat_prefix="query.hostcache")
+                    self.stats.register(cache)
+                    self._host_prep_cache = cache
+        return self._host_prep_cache
+
     def new_query(self):
         from opentsdb_tpu.query.engine import QueryEngine
         return QueryEngine(self)
@@ -790,9 +813,12 @@ class TSDB:
 
     def drop_caches(self) -> None:
         """(ref: TSDB.dropCaches) UID caches are authoritative here;
-        the device-resident grid cache is droppable."""
+        the device-resident grid cache and its host-RAM prepared-batch
+        twin are droppable."""
         if self._device_grid_cache is not None:
             self._device_grid_cache.clear()
+        if self._host_prep_cache is not None:
+            self._host_prep_cache.clear()
 
     # ------------------------------------------------------------------
     # stats (ref: TSDB.collectStats :753)

@@ -84,16 +84,20 @@ def agg_squaresum(x, axis=0):
 
 
 def agg_dev(x, axis=0):
-    """Sample standard deviation, matching Welford-with-(n-1)
-    (ref: Aggregators.StdDev :498): 0 for a single value, NaN for none.
-    Computed as the mean-shifted two-pass formula — algebraically equal
-    to Welford and vectorizable; clamped at 0 against rounding."""
+    """POPULATION standard deviation (divisor n), matching the
+    reference exactly: its Welford loop over-increments n by one and
+    divides M2 by that, which lands on sigma = sqrt(M2/n) — pinned by
+    its own unit tests (TestAggregators.java:82-122 expects
+    numpy.std(range(10000)) and {1,2} -> 0.5, both population forms).
+    0 for a single value, NaN for none (ref: Aggregators.StdDev :498).
+    Computed as the mean-shifted two-pass formula — vectorizable and
+    cancellation-safe; clamped at 0 against rounding."""
     cnt = jnp.sum(_valid(x), axis=axis)
     safe_cnt = jnp.maximum(cnt, 1)
     mean = jnp.nansum(x, axis=axis) / safe_cnt
     centered = jnp.where(_valid(x), x - jnp.expand_dims(mean, axis), 0.0)
     m2 = jnp.sum(centered * centered, axis=axis)
-    var = m2 / jnp.maximum(cnt - 1, 1)
+    var = m2 / safe_cnt
     dev = jnp.sqrt(jnp.maximum(var, 0.0))
     return jnp.where(cnt == 0, jnp.nan, jnp.where(cnt == 1, 0.0, dev))
 

@@ -226,8 +226,9 @@ def bucketize(values, series_idx, bucket_idx, num_series: int,
         s2 = segment.seg_sum(x0 * x0, seg_ids, nseg)
         safe = jnp.maximum(cnt, 1)
         mean = s1 / safe
-        var = jnp.maximum(s2 / safe - mean * mean, 0.0) * (
-            safe / jnp.maximum(cnt - 1, 1))
+        # population variance (divisor n): matches agg_dev and the
+        # reference's own TestAggregators expectations
+        var = jnp.maximum(s2 / safe - mean * mean, 0.0)
         out = jnp.where(cnt == 1, 0.0, jnp.sqrt(var))
     elif function == "median":
         out = _bucketize_rank(values, seg_ids, nseg, 50.0, "median")
@@ -294,8 +295,9 @@ def bucketize_padded(values2d, bucket_idx2d, num_buckets: int,
         s2 = csum(x0 * x0)
         safe = jnp.maximum(cnt, 1)
         mean = s1 / safe
-        var = jnp.maximum(s2 / safe - mean * mean, 0.0) * (
-            safe / jnp.maximum(cnt - 1, 1))
+        # population variance (divisor n): matches agg_dev and the
+        # reference's own TestAggregators expectations
+        var = jnp.maximum(s2 / safe - mean * mean, 0.0)
         out = jnp.where(cnt == 1, 0.0, jnp.sqrt(var))
     elif function in ("min", "mimmin"):
         out = jnp.min(jnp.where(veq, values2d[:, :, None], jnp.inf),

@@ -34,12 +34,18 @@ def _seg(fn, data, ids, num, **kw):
 _MATMUL_GROUP_MAX_ELEMS = 2 * 10**9
 
 
-def _group_sum(data, group_ids, num_groups: int):
+def _group_sum(data, group_ids, num_groups: int,
+               prefer_segment: bool = False):
     """Segment-sum over the series axis: data[S,B] -> [G,B].
 
     Lowered as a one-hot MXU contraction when S*G permits; TPU scatter
-    (segment_sum) otherwise.
+    (segment_sum) otherwise. ``prefer_segment`` (host-CPU placement)
+    forces the scatter lowering: XLA:CPU grinds the one-hot dot at
+    cells*groups flops (~1 s at [114688, 32] x 1024) while its
+    segment_sum is a linear pass (~3 ms at the same shape).
     """
+    if prefer_segment:
+        return _seg(jax.ops.segment_sum, data, group_ids, num_groups)
     s = data.shape[0]
     if s * num_groups <= _MATMUL_GROUP_MAX_ELEMS:
         onehot = jax.nn.one_hot(group_ids, num_groups, dtype=data.dtype)
@@ -57,7 +63,8 @@ _CHUNK_REDUCERS = {"min": (jnp.min, jnp.inf),
                    "prod": (jnp.prod, 1.0)}
 
 
-def _group_extremum(data, group_ids, num_groups: int, mode: str):
+def _group_extremum(data, group_ids, num_groups: int, mode: str,
+                    prefer_segment: bool = False):
     """Non-linear segment reduction (min/max/prod) over the series
     axis: data[S,B] -> [G,B], with missing cells pre-filled by the
     caller with the reduction's identity.
@@ -71,7 +78,7 @@ def _group_extremum(data, group_ids, num_groups: int, mode: str):
     """
     red, fill = _CHUNK_REDUCERS[mode]
     s, b = data.shape
-    if s * num_groups * b > _MATMUL_GROUP_MAX_ELEMS:
+    if prefer_segment or s * num_groups * b > _MATMUL_GROUP_MAX_ELEMS:
         segf = {"min": jax.ops.segment_min,
                 "max": jax.ops.segment_max,
                 "prod": jax.ops.segment_prod}[mode]
@@ -93,43 +100,51 @@ def _group_extremum(data, group_ids, num_groups: int, mode: str):
     return red(red(masked, axis=1), axis=0)
 
 
-@partial(jax.jit, static_argnames=("num_groups", "agg_name"))
-def _group_reduce(filled, group_ids, num_groups: int, agg_name: str):
-    """Aggregate filled[S,B] into [G,B] per ``agg_name``. NaN = missing."""
+@partial(jax.jit, static_argnames=("num_groups", "agg_name",
+                                   "prefer_segment"))
+def _group_reduce(filled, group_ids, num_groups: int, agg_name: str,
+                  prefer_segment: bool = False):
+    """Aggregate filled[S,B] into [G,B] per ``agg_name``. NaN = missing.
+
+    ``prefer_segment`` routes every segmented reduction through scatter
+    lowering (host-CPU placement; see _group_sum)."""
+    gsum = partial(_group_sum, prefer_segment=prefer_segment)
+    gext = partial(_group_extremum, prefer_segment=prefer_segment)
     valid = ~jnp.isnan(filled)
     x0 = jnp.where(valid, filled, 0.0)
-    cnt = _group_sum(valid.astype(filled.dtype), group_ids, num_groups)
+    cnt = gsum(valid.astype(filled.dtype), group_ids, num_groups)
     any_valid = cnt > 0
 
     if agg_name in ("sum", "zimsum", "pfsum"):
-        out = _group_sum(x0, group_ids, num_groups)
+        out = gsum(x0, group_ids, num_groups)
     elif agg_name == "avg":
-        out = _group_sum(x0, group_ids, num_groups) / jnp.maximum(cnt, 1)
+        out = gsum(x0, group_ids, num_groups) / jnp.maximum(cnt, 1)
     elif agg_name == "count":
         out = cnt
     elif agg_name in ("min", "mimmin"):
-        out = _group_extremum(jnp.where(valid, filled, jnp.inf),
-                              group_ids, num_groups, "min")
+        out = gext(jnp.where(valid, filled, jnp.inf),
+                   group_ids, num_groups, "min")
         out = jnp.where(jnp.isinf(out) & (out > 0), jnp.nan, out)
         # mimmin holes filled with +inf are valid contributions; a group
         # where *everything* is +inf has no real data
         any_valid = any_valid & ~jnp.isnan(out)
     elif agg_name in ("max", "mimmax"):
-        out = _group_extremum(jnp.where(valid, filled, -jnp.inf),
-                              group_ids, num_groups, "max")
+        out = gext(jnp.where(valid, filled, -jnp.inf),
+                   group_ids, num_groups, "max")
         out = jnp.where(jnp.isinf(out) & (out < 0), jnp.nan, out)
         any_valid = any_valid & ~jnp.isnan(out)
     elif agg_name == "multiply":
-        out = _group_extremum(jnp.where(valid, filled, 1.0),
-                              group_ids, num_groups, "prod")
+        out = gext(jnp.where(valid, filled, 1.0),
+                   group_ids, num_groups, "prod")
     elif agg_name == "squareSum":
-        out = _group_sum(x0 * x0, group_ids, num_groups)
+        out = gsum(x0 * x0, group_ids, num_groups)
     elif agg_name == "dev":
-        s1 = _group_sum(x0, group_ids, num_groups)
+        s1 = gsum(x0, group_ids, num_groups)
         mean = s1 / jnp.maximum(cnt, 1)
         centered = jnp.where(valid, filled - mean[group_ids], 0.0)
-        m2 = _group_sum(centered * centered, group_ids, num_groups)
-        var = m2 / jnp.maximum(cnt - 1, 1)
+        m2 = gsum(centered * centered, group_ids, num_groups)
+        # population variance (divisor n) — see agg_dev
+        var = m2 / jnp.maximum(cnt, 1)
         out = jnp.where(cnt == 1, 0.0, jnp.sqrt(jnp.maximum(var, 0.0)))
     elif agg_name in ("first", "last", "diff"):
         s = filled.shape[0]
@@ -200,7 +215,8 @@ def _group_rank(filled, valid, cnt, group_ids, num_groups, q: float,
 
 
 def group_aggregate(grid, bucket_ts, group_ids, num_groups: int,
-                    agg: aggs_mod.Aggregator, interpolate: bool = True):
+                    agg: aggs_mod.Aggregator, interpolate: bool = True,
+                    prefer_segment: bool = False):
     """The reference's SpanGroup.iterator + AggregationIterator pass:
     interpolation fill per the aggregator's mode, then one segmented
     reduction over the series axis. grid[S,B] -> [G,B].
@@ -211,4 +227,5 @@ def group_aggregate(grid, bucket_ts, group_ids, num_groups: int,
     gap — cross-series interpolation never triggers."""
     filled = (fill_gaps(grid, bucket_ts, agg.interpolation.value)
               if interpolate else grid)
-    return _group_reduce(filled, group_ids, num_groups, agg.name)
+    return _group_reduce(filled, group_ids, num_groups, agg.name,
+                         prefer_segment=prefer_segment)

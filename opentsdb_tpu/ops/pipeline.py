@@ -44,6 +44,19 @@ class PipelineSpec:
     rate_counter: bool = False
     rate_drop_resets: bool = False
     emit_raw: bool = False    # agg 'none': emit per-series, skip group stage
+    # True when this program is placed on the host CPU backend (the
+    # host-tail path): the group stage then lowers to segment ops
+    # instead of the one-hot MXU contraction — measured 3 ms vs 1.0 s
+    # at [114688, 32] x 1024 groups on one CPU core, while on TPU the
+    # MXU contraction wins by ~300x. Static, so host and device
+    # programs compile separately.
+    host: bool = False
+    # True when the CALLER verified every (series, bucket) cell holds
+    # a real value (no pads, no NaNs — the regular-cadence dashboard
+    # case): cross-series interpolation and the per-group emission
+    # reduction are provably no-ops and are skipped (fill_gaps alone
+    # is ~190 ms of a [114688, 30] host-tail query on one core).
+    complete: bool = False
 
     def __post_init__(self):
         # CPython >= 3.10 hashes each NaN object by identity, so a spec
@@ -174,16 +187,23 @@ def _finish_pipeline(grid, has_data, bucket_ts, group_ids, rate_params,
     # reference's merge loop skips WITHOUT interpolating (runDouble NaN
     # guard); only fill NONE leaves true gaps that interpolate.
     agg = aggs_mod.get(spec.agg_name)
-    interpolate = spec.fill_policy == ds_mod.FillPolicy.NONE
+    interpolate = spec.fill_policy == ds_mod.FillPolicy.NONE \
+        and not spec.complete
     result = gb_mod.group_aggregate(grid, bucket_ts, group_ids, g, agg,
-                                    interpolate=interpolate)
+                                    interpolate=interpolate,
+                                    prefer_segment=spec.host)
 
     # emission: fill NONE emits the union of the group's series' buckets
     # (plain Downsampler skips empty buckets); any other policy emits
-    # every bucket (FillingDownsampler semantics)
-    if spec.fill_policy == ds_mod.FillPolicy.NONE:
+    # every bucket (FillingDownsampler semantics). A verified-complete
+    # grid emits everywhere by construction (every group has >= 1
+    # member series and every cell is filled).
+    if spec.complete and not spec.rate:
+        emit = jnp.ones((g, b), dtype=bool)
+    elif spec.fill_policy == ds_mod.FillPolicy.NONE:
         emit = gb_mod._group_sum(
-            has_data.astype(grid.dtype), group_ids, g) > 0
+            has_data.astype(grid.dtype), group_ids, g,
+            prefer_segment=spec.host) > 0
     else:
         emit = jnp.ones((g, b), dtype=bool)
     return result, emit

@@ -276,7 +276,9 @@ def _group_reduce_psum(filled, group_ids, num_groups: int, agg_name: str,
         mean = s1 / jnp.maximum(cnt, 1)                     # [G, B]
         centered = jnp.where(valid, filled - mean[group_ids, :], 0.0)
         m2 = jax.lax.psum(seg(centered * centered), axis_name)
-        var = m2 / jnp.maximum(cnt - 1, 1)
+        # population variance (divisor n) to match agg_dev / the
+        # reference's own TestAggregators expectations
+        var = m2 / jnp.maximum(cnt, 1)
         out = jnp.where(cnt == 1, 0.0, jnp.sqrt(jnp.maximum(var, 0.0)))
     else:
         raise ValueError(f"{agg_name} is not psum-reducible")

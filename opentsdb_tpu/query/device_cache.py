@@ -27,9 +27,15 @@ def array_digest(arr) -> bytes:
 
 
 class DeviceGridCache:
-    """LRU of device arrays keyed by (reduction params, store version)."""
+    """LRU of device arrays keyed by (reduction params, store version).
 
-    def __init__(self, max_bytes: int):
+    Also reused (with ``stat_prefix``) as the host-RAM prepared-batch
+    cache for host-tail queries — same keying/invalidations, separate
+    byte pool."""
+
+    def __init__(self, max_bytes: int, stat_prefix: str =
+                 "query.devicecache"):
+        self.stat_prefix = stat_prefix
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
         # key -> (version, arrays: tuple, meta: dict, nbytes: int)
@@ -52,9 +58,17 @@ class DeviceGridCache:
             self.hits += 1
             return entry[1], entry[2]
 
+    @staticmethod
+    def _entry_nbytes(a) -> int:
+        if a is None:
+            return 0
+        inner = getattr(a, "arrays", None)  # PreparedBatch
+        if inner is not None:
+            return sum(getattr(x, "nbytes", 0) for x in inner)
+        return getattr(a, "nbytes", 0)
+
     def put(self, key, version, arrays: tuple, meta: dict) -> None:
-        nbytes = sum(getattr(a, "nbytes", 0) for a in arrays
-                     if a is not None)
+        nbytes = sum(self._entry_nbytes(a) for a in arrays)
         if nbytes > self.max_bytes:
             return  # larger than the whole cache: don't thrash
         with self._lock:
@@ -73,8 +87,8 @@ class DeviceGridCache:
             self._bytes = 0
 
     def collect_stats(self, collector) -> None:
-        collector.record("query.devicecache.bytes", self._bytes)
-        collector.record("query.devicecache.entries",
+        collector.record(f"{self.stat_prefix}.bytes", self._bytes)
+        collector.record(f"{self.stat_prefix}.entries",
                          len(self._entries))
-        collector.record("query.devicecache.hits", self.hits)
-        collector.record("query.devicecache.misses", self.misses)
+        collector.record(f"{self.stat_prefix}.hits", self.hits)
+        collector.record(f"{self.stat_prefix}.misses", self.misses)

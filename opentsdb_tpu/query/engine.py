@@ -21,7 +21,6 @@ Compiles one validated :class:`TSQuery` into the array pipeline:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
 from typing import Any, Sequence
 
 import numpy as np
@@ -40,20 +39,65 @@ from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
 from opentsdb_tpu.stats.stats import QueryStat, QueryStats
 
 
-@dataclass
 class QueryResult:
-    """One output group — the analogue of one ``DataPoints`` object."""
-    metric: str
-    tags: dict[str, str]
-    aggregated_tags: list[str]
-    dps: list[tuple[int, float]]          # (ts_ms, value)
-    tsuids: list[str] = field(default_factory=list)
-    annotations: list = field(default_factory=list)
-    global_annotations: list = field(default_factory=list)
-    sub_query_index: int = 0
-    # columnar twin of dps (ts int64[N], values float64[N]) when the
-    # engine produced it — serializers use it for native formatting
-    dps_arrays: Any = None
+    """One output group — the analogue of one ``DataPoints`` object.
+
+    ``dps`` (the (ts_ms, value) tuple list) is LAZY when the engine
+    produced the columnar ``dps_arrays`` twin: a wildcard group-by
+    response has thousands of groups and the serializer formats
+    straight from the arrays, so eagerly zipping per-group Python
+    tuple lists taxed every large query for a list most consumers
+    never read. Reading ``.dps`` materializes on first touch;
+    size checks should use :attr:`num_dps` (doesn't materialize)."""
+
+    __slots__ = ("metric", "tags", "aggregated_tags", "tsuids",
+                 "annotations", "global_annotations",
+                 "sub_query_index", "dps_arrays", "_dps")
+
+    def __init__(self, metric: str, tags: dict, aggregated_tags: list,
+                 dps: list | None = None, tsuids: list | None = None,
+                 annotations: list | None = None,
+                 global_annotations: list | None = None,
+                 sub_query_index: int = 0, dps_arrays: Any = None):
+        self.metric = metric
+        self.tags = tags
+        self.aggregated_tags = aggregated_tags
+        self._dps = dps
+        self.tsuids = tsuids if tsuids is not None else []
+        self.annotations = annotations if annotations is not None \
+            else []
+        self.global_annotations = global_annotations \
+            if global_annotations is not None else []
+        self.sub_query_index = sub_query_index
+        self.dps_arrays = dps_arrays
+
+    @property
+    def dps(self) -> list:
+        if self._dps is None:
+            if self.dps_arrays is None:
+                self._dps = []
+            else:
+                ts_arr, vals = self.dps_arrays
+                self._dps = list(zip(ts_arr.tolist(), vals.tolist()))
+        return self._dps
+
+    @dps.setter
+    def dps(self, value: list) -> None:
+        self._dps = value
+
+    @property
+    def num_dps(self) -> int:
+        if self._dps is not None:
+            return len(self._dps)
+        if self.dps_arrays is None:
+            return 0
+        return len(self.dps_arrays[0])
+
+    def __repr__(self) -> str:  # debugging/test output only
+        return (f"QueryResult(metric={self.metric!r}, "
+                f"tags={self.tags!r}, "
+                f"aggregated_tags={self.aggregated_tags!r}, "
+                f"num_dps={self.num_dps})")
 
 
 class NoSuchMetricError(BadRequestError):
@@ -144,39 +188,69 @@ def _store_id(store) -> int:
 # default padded [S, B] cell count below which the pipeline tail runs
 # on the host CPU backend instead of the accelerator
 HOST_TAIL_DEFAULT_CELLS = 1 << 20
-# and the [S, B] x G work-product cap: the tail's group stage is a
-# one-hot contraction whose flops scale with cells * groups — a
-# single-core host grinds through ~10 GFLOP/s, so a many-group query
-# that fits the cell budget can still be seconds on the host while the
-# accelerator does it in microseconds (measured: [114688, 8] x 1024
-# groups = 2.5 s on one CPU core)
+# and the [S, B] x G work-product cap for RANK-class aggregators
+# (median/percentiles): their group stage sorts/broadcasts with a
+# G-factor that a single-core host grinds through slowly.
 HOST_TAIL_DEFAULT_CELLGROUPS = 1 << 25
+# LINEAR aggregators (sum/min/max/avg/dev/count/... — everything the
+# pipeline reduces with segment ops) get a larger cells-only budget:
+# with PipelineSpec.host=True the group stage lowers to segment
+# scatter, an O(cells) pass (measured 3 ms at [114688, 32] x 1024
+# groups on one CPU core, vs 1.0 s for the one-hot contraction the
+# old cells*groups cap modeled). A 1000-group dashboard over 100k
+# series is host-served: its wall time on a tunneled accelerator is
+# two RPC round trips (~0.5 s), not compute.
+HOST_TAIL_DEFAULT_CELLS_LINEAR = 1 << 23
+
+
+def _rank_class_agg(agg_name: str) -> bool:
+    """median / exact & estimated percentiles: the group stage is a
+    sort with a G-broadcast, not a segment reduction."""
+    if agg_name == "median":
+        return True
+    try:
+        from opentsdb_tpu.ops import aggregators as _aggs
+        return bool(_aggs.get(agg_name).is_percentile)
+    except Exception:  # noqa: BLE001 - unknown agg: be conservative
+        return True
 
 
 def host_tail_device(config, padded_cells: int,
-                     padded_groups: int = 1):
+                     padded_groups: int = 1,
+                     linear_agg: bool = False):
     """Device override for small-query tails.
 
-    Below ``tsd.query.host_tail_max_cells`` AND with
-    ``cells * groups`` below ``tsd.query.host_tail_max_cellgroups``
-    (both compared against shape-bucket-PADDED dims, so the decision
-    is deterministic per compiled-shape class and warmup can
-    pre-compile the same programs) the fill/rate/aggregate tail runs
-    on the host CPU backend. A dashboard-sized query's wall time on a
-    remote or tunneled accelerator is dominated by per-query RPC round
-    trips, not compute — the reference serves this class straight from
-    the local JVM heap (ref: QueryRpc.java:128 -> TsdbQuery compute
-    in-process). Set either key to -1 to disable; 0 means the default.
-    Mesh queries never take this path (sharded data is already
-    device-resident). Returns a committed CPU ``jax.Device`` or None
-    (= use the default device)."""
-    limit = config.get_int("tsd.query.host_tail_max_cells", 0) \
-        or HOST_TAIL_DEFAULT_CELLS
-    glimit = config.get_int("tsd.query.host_tail_max_cellgroups", 0) \
-        or HOST_TAIL_DEFAULT_CELLGROUPS
-    if limit < 0 or glimit < 0 or padded_cells > limit \
-            or padded_cells * max(padded_groups, 1) > glimit:
-        return None
+    For rank-class aggregators: below ``tsd.query.host_tail_max_cells``
+    AND ``cells * groups`` below ``tsd.query.host_tail_max_cellgroups``.
+    For linear (segment-reducible) aggregators: below
+    ``tsd.query.host_tail_max_cells_linear`` — no group factor, the
+    host group stage is O(cells) segment scatter (see
+    HOST_TAIL_DEFAULT_CELLS_LINEAR). All dims are shape-bucket-PADDED,
+    so the decision is deterministic per compiled-shape class and
+    warmup can pre-compile the same programs.
+
+    A dashboard-sized query's wall time on a remote or tunneled
+    accelerator is dominated by per-query RPC round trips, not compute
+    — the reference serves this class straight from the local JVM heap
+    (ref: QueryRpc.java:128 -> TsdbQuery compute in-process). Set a key
+    to -1 to disable; 0 means the default. Mesh queries never take
+    this path (sharded data is already device-resident). Returns a
+    committed CPU ``jax.Device`` or None (= use the default device)."""
+    if linear_agg:
+        limit = config.get_int(
+            "tsd.query.host_tail_max_cells_linear", 0) \
+            or HOST_TAIL_DEFAULT_CELLS_LINEAR
+        if limit < 0 or padded_cells > limit:
+            return None
+    else:
+        limit = config.get_int("tsd.query.host_tail_max_cells", 0) \
+            or HOST_TAIL_DEFAULT_CELLS
+        glimit = config.get_int(
+            "tsd.query.host_tail_max_cellgroups", 0) \
+            or HOST_TAIL_DEFAULT_CELLGROUPS
+        if limit < 0 or glimit < 0 or padded_cells > limit \
+                or padded_cells * max(padded_groups, 1) > glimit:
+            return None
     import jax
     try:
         return jax.devices("cpu")[0]
@@ -185,16 +259,20 @@ def host_tail_device(config, padded_cells: int,
 
 
 def host_tail_for_dims(config, s: int, b: int, num_groups: int,
-                       emit_raw: bool = False):
+                       emit_raw: bool = False,
+                       agg_name: str = "p99"):
     """:func:`host_tail_device` from RAW query dims — the ONE place the
     decision inputs are shape-bucketed, shared by the engine paths and
     tsd.warmup so a warmed placement cannot drift from the engine's
-    (ADVICE r04). emit_raw has no group contraction: group factor 1."""
+    (ADVICE r04). emit_raw has no group contraction: group factor 1.
+    ``agg_name`` picks the linear vs rank-class budget; the default is
+    a rank-class name so legacy callers keep the conservative rule."""
     from opentsdb_tpu.ops import shapes as _shapes
     return host_tail_device(
         config,
         _shapes.shape_bucket(s) * _shapes.shape_bucket(b),
-        1 if emit_raw else _shapes.shape_bucket(num_groups + 1))
+        1 if emit_raw else _shapes.shape_bucket(num_groups + 1),
+        linear_agg=not _rank_class_agg(agg_name))
 
 
 def compact_row_labels(mat: np.ndarray) -> tuple[np.ndarray, int]:
@@ -371,14 +449,28 @@ class QueryEngine:
                 # histogram eligibility (and so the budget verdict)
                 # depends on the group count too
                 acls = ("pct", num_groups)
+            if mesh is None:
+                # single-device: the linear-vs-rank PLACEMENT class is
+                # the key dimension — a host-pool entry cached by a
+                # linear agg must not serve a rank-class query whose
+                # budget would have placed it on the accelerator
+                # (their group stages differ by orders of magnitude on
+                # one CPU core)
+                acls = "lin" if not _rank_class_agg(sub.agg.name) \
+                    else "rank"
             pkey = ("prep", _store_id(store),
                     array_digest(np.ascontiguousarray(sids)),
                     tsq.start_ms, tsq.end_ms, sub.downsample or "union",
                     getattr(sub.ds_spec, "timezone", None), mesh,
-                    acls if mesh is not None else None)
+                    acls)
             pver = (store.points_written,
                     getattr(store, "mutation_epoch", 0))
             hit = prep_cache.get(pkey, pver)
+            if hit is None:
+                # host-tail twin: same key space, host-RAM pool
+                hcache = self.tsdb.host_prep_cache
+                if hcache is not None:
+                    hit = hcache.get(pkey, pver)
             if hit is not None:
                 cached_args, pmeta = hit
                 bucket_ts = pmeta["bucket_ts"]
@@ -400,7 +492,11 @@ class QueryEngine:
                     fill_value=fill_value, rate=sub.rate,
                     rate_counter=sub.rate_options.counter,
                     rate_drop_resets=sub.rate_options.drop_resets,
-                    emit_raw=emit_raw)
+                    emit_raw=emit_raw,
+                    host=pmeta.get("host", False),
+                    complete=pmeta.get("complete", False)
+                    and not (sub.rate
+                             and sub.rate_options.drop_resets))
                 if mesh is not None:
                     # HBM-resident pre-sharded batch: only the tiny
                     # per-query group-id vector uploads
@@ -460,6 +556,7 @@ class QueryEngine:
         if num_points == 0:
             return []
         bucket_idx2d = bucket_idx = None
+        grid_complete = False
         if sub.ds_spec is not None:
             ds_function = ds_fn_override or sub.ds_spec.function
             fill_policy = sub.ds_spec.fill_policy
@@ -480,11 +577,39 @@ class QueryEngine:
             if padded is not None:
                 pad = store_mod.pad_mask(padded.counts,
                                          padded.ts2d.shape[1])
-                bucket_ts, inverse = np.unique(padded.ts2d.reshape(-1),
-                                               return_inverse=True)
-                bucket_idx2d = inverse.reshape(padded.ts2d.shape) \
-                    .astype(np.int32)
-                bucket_idx2d[pad] = -1
+                # regular-cadence fast path: when every series carries
+                # the SAME timestamp row (the monitoring-data common
+                # case), the union IS row 0 — one vectorized equality
+                # check replaces the 3M-element sort np.unique costs
+                # (~160 ms at 100k x 30)
+                if not pad.any() and len(padded.ts2d) and \
+                        (padded.ts2d == padded.ts2d[0]).all():
+                    row0 = padded.ts2d[0]
+                    # strictly increasing => no duplicate timestamps,
+                    # exactly what np.unique would have produced
+                    if (np.diff(row0) > 0).all():
+                        bucket_ts = row0.copy()
+                        bucket_idx2d = np.broadcast_to(
+                            np.arange(len(row0), dtype=np.int32),
+                            padded.ts2d.shape).copy()
+                        # every cell verified present: the pipeline
+                        # may skip interpolation/emission no-ops
+                        # (PipelineSpec.complete). Pure DATA property
+                        # here; the per-QUERY carve-out (drop_resets
+                        # punches per-series holes) applies at spec
+                        # build so cached entries stay query-agnostic.
+                        grid_complete = not np.isnan(
+                            padded.values2d).any()
+                    else:
+                        bucket_ts = None
+                else:
+                    bucket_ts = None
+                if bucket_ts is None:
+                    bucket_ts, inverse = np.unique(
+                        padded.ts2d.reshape(-1), return_inverse=True)
+                    bucket_idx2d = inverse.reshape(padded.ts2d.shape) \
+                        .astype(np.int32)
+                    bucket_idx2d[pad] = -1
                 if pad.any():
                     # drop union slots only pad sentinels produced
                     used = np.zeros(len(bucket_ts), dtype=bool)
@@ -501,27 +626,10 @@ class QueryEngine:
 
         # --- device pipeline
         t2 = time.monotonic()
-        spec = PipelineSpec(
-            num_series=len(sids), num_buckets=len(bucket_ts),
-            num_groups=num_groups, ds_function=ds_function,
-            agg_name=sub.agg.name, fill_policy=fill_policy,
-            fill_value=fill_value, rate=sub.rate,
-            rate_counter=sub.rate_options.counter,
-            rate_drop_resets=sub.rate_options.drop_resets,
-            emit_raw=emit_raw)
-        if rollup_scale != 1.0:
-            if padded is not None:
-                padded = padded._replace(values2d=padded.values2d
-                                         * rollup_scale)
-            else:
-                batch = batch._replace(values=batch.values
-                                       * rollup_scale)
         # the mesh raises the streaming threshold only when every
-        # device truly holds S_loc x B_loc cells: psum-reducible,
-        # edge-pick, and (shape-permitting) percentile-histogram
-        # reductions all do; diff/multiply — and percentiles whose
-        # [G, B, BINS] partial would not fit — all_gather the series
-        # axis, so their budget must not scale
+        # device truly holds S_loc x B_loc cells (see mesh_scale use
+        # below); the blocked verdict must precede the host-tail
+        # placement so an over-budget range never lands on the host
         from opentsdb_tpu.parallel.sharded_pipeline import \
             mesh_memory_safe
         n_mesh = int(np.prod(list(mesh.shape.values()))) \
@@ -530,6 +638,35 @@ class QueryEngine:
             sub.agg.name, num_groups, len(bucket_ts)) else 1
         use_blocked = not emit_raw and \
             len(sids) * len(bucket_ts) > budget * mesh_scale
+        # host-tail placement for the point/union path: the same
+        # tunneled-RPC argument as _grid_pipeline's (a group-by
+        # dashboard's warm latency on a tunneled device is two RPC
+        # round trips, not compute). B for union queries is the
+        # distinct-timestamp count — data-dependent, so unlike the
+        # grid path this placement class is not warmup-predictable;
+        # the persistent compile cache absorbs the one-off compiles.
+        host_dev = None
+        if mesh is None and not use_blocked:
+            host_dev = host_tail_for_dims(
+                self.tsdb.config, len(sids), len(bucket_ts),
+                num_groups, emit_raw, sub.agg.name)
+        spec = PipelineSpec(
+            num_series=len(sids), num_buckets=len(bucket_ts),
+            num_groups=num_groups, ds_function=ds_function,
+            agg_name=sub.agg.name, fill_policy=fill_policy,
+            fill_value=fill_value, rate=sub.rate,
+            rate_counter=sub.rate_options.counter,
+            rate_drop_resets=sub.rate_options.drop_resets,
+            emit_raw=emit_raw, host=host_dev is not None,
+            complete=grid_complete
+            and not (sub.rate and sub.rate_options.drop_resets))
+        if rollup_scale != 1.0:
+            if padded is not None:
+                padded = padded._replace(values2d=padded.values2d
+                                         * rollup_scale)
+            else:
+                batch = batch._replace(values=batch.values
+                                       * rollup_scale)
         if padded is not None and (use_blocked or mesh is not None):
             values, series_idx, bucket_idx = flatten_padded(
                 padded.values2d, bucket_idx2d, padded.counts)
@@ -585,6 +722,31 @@ class QueryEngine:
             result, emit = run_sharded_device(
                 mesh, spec, margs, sbatch.s_loc, sbatch.b_loc,
                 num_groups, sub.rate_options)
+        elif host_dev is not None:
+            # host tail: place on the CPU backend; cached in the
+            # host-RAM pool (NOT the device cache — host entries must
+            # never evict HBM-resident grids) so warm repeats skip
+            # materialize + union-grid construction
+            from opentsdb_tpu.ops.pipeline import (prepare_auto,
+                                                   prepare_flat,
+                                                   run_prepared)
+            if padded is not None:
+                prep = prepare_auto(padded, bucket_idx2d, spec,
+                                    device=host_dev)
+            else:
+                prep = prepare_flat(batch.values, batch.series_idx,
+                                    bucket_idx, spec, device=host_dev)
+            hcache = self.tsdb.host_prep_cache \
+                if rollup_scale == 1.0 else None
+            if hcache is not None and pkey is not None:
+                hcache.put(pkey, pver, (prep,), {
+                    "num_points": num_points, "bucket_ts": bucket_ts,
+                    "ds_function": ds_function,
+                    "fill_policy": fill_policy,
+                    "fill_value": fill_value, "host": True,
+                    "complete": grid_complete})
+            result, emit = run_prepared(prep, bucket_ts, group_ids,
+                                        spec, sub.rate_options)
         elif prep_cache is not None:
             # upload once, cache the device-resident batch, execute
             from opentsdb_tpu.ops.pipeline import (prepare_auto,
@@ -739,7 +901,8 @@ class QueryEngine:
         host_dev = None
         if mesh is None:
             host_dev = host_tail_for_dims(self.tsdb.config, len(sids),
-                                          b, num_groups, emit_raw)
+                                          b, num_groups, emit_raw,
+                                          sub.agg.name)
         # device-resident cache: a warm repeat of this reduction skips
         # the host scan AND the upload (HBM ≙ HBase block cache).
         # Under a mesh the cached value is the pre-SHARDED device args
@@ -826,7 +989,7 @@ class QueryEngine:
             fill_value=ds_spec.fill_value, rate=sub.rate,
             rate_counter=sub.rate_options.counter,
             rate_drop_resets=sub.rate_options.drop_resets,
-            emit_raw=emit_raw)
+            emit_raw=emit_raw, host=host_dev is not None)
         if mesh is not None:
             # the grid-TAIL step runs straight on the mesh (no
             # flatten-to-points re-bucketize), and the pre-sharded
@@ -920,7 +1083,8 @@ class QueryEngine:
             mesh = self.tsdb.query_mesh
             if mesh is None:
                 host_dev = host_tail_for_dims(self.tsdb.config, s, b,
-                                              num_groups, emit_raw)
+                                              num_groups, emit_raw,
+                                              sub.agg.name)
             # host-tail queries skip the device cache (see
             # _grid_pipeline: cheap native re-scan; host RAM must not
             # evict HBM-resident grids)
@@ -1026,7 +1190,7 @@ class QueryEngine:
             fill_value=sub.ds_spec.fill_value, rate=sub.rate,
             rate_counter=sub.rate_options.counter,
             rate_drop_resets=sub.rate_options.drop_resets,
-            emit_raw=emit_raw)
+            emit_raw=emit_raw, host=host_dev is not None)
         mesh = self.tsdb.query_mesh
         if mesh is not None:
             # divide host-side, then run the rate/fill/agg tail over
@@ -1213,13 +1377,23 @@ class QueryEngine:
                 metric_id = uids.metrics.get_id(metric_name)
             except LookupError:
                 metric_id = None
+        # emit extraction for ALL groups in one nonzero pass: under
+        # wildcard group-by (1000+ groups) the per-group
+        # nonzero/slice/asarray loop was the second-largest host cost
+        # of the whole query after serialization
+        e_gidx, e_bidx = np.nonzero(emit)
+        e_starts = np.searchsorted(e_gidx, gid_range, side="left")
+        e_ends = np.searchsorted(e_gidx, gid_range, side="right")
+        e_ts = ts_out[e_bidx]
+        e_vals = np.asarray(result[e_gidx, e_bidx], dtype=np.float64)
         for gid in range(num_groups):
             members = order[starts[gid]:ends[gid]]
             if len(members) == 0:
                 continue
-            dps, dps_arrays = _emit_dps(ts_out, result[gid], emit[gid])
-            if not dps:
+            lo_e, hi_e = e_starts[gid], e_ends[gid]
+            if lo_e == hi_e:
                 continue
+            dps_arrays = (e_ts[lo_e:hi_e], e_vals[lo_e:hi_e])
             g_tags: dict[str, str] = {}
             agg_tags: list[str] = []
             for j in range(k_cnt):
@@ -1252,7 +1426,7 @@ class QueryEngine:
             out.append(QueryResult(
                 metric=metric_name, tags=g_tags,
                 aggregated_tags=agg_tags,
-                dps=dps, tsuids=tsuids, annotations=annotations,
+                tsuids=tsuids, annotations=annotations,
                 global_annotations=global_annotations,
                 sub_query_index=sub.index, dps_arrays=dps_arrays))
         return out
@@ -1289,19 +1463,6 @@ def _match_series_by_tags(src_store, dst_store, sids: np.ndarray,
     pos_c = np.minimum(pos, len(lb_sorted) - 1)
     hit = lb_sorted[pos_c] == la
     return np.where(hit, dst_sids[order[pos_c]], -1)
-
-
-def _emit_dps(ts_out: np.ndarray, row: np.ndarray, erow: np.ndarray):
-    """Compress (value, emit) arrays into the output point list plus
-    its columnar twin (for native serialization). ``ts_out`` already
-    carries the ms/seconds resolution choice."""
-    idx = np.nonzero(erow)[0]
-    if not len(idx):
-        return [], None
-    ts_sel = ts_out[idx]
-    val_sel = np.asarray(row[idx], dtype=np.float64)
-    return list(zip(ts_sel.tolist(), val_sel.tolist())), \
-        (ts_sel, val_sel)
 
 
 def _common_tags(tags: TagMatrix, members: np.ndarray, uids

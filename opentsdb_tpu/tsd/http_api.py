@@ -472,13 +472,22 @@ class HttpRpcRouter:
             results = self.tsdb.new_query().run(tsq, stats)
             from opentsdb_tpu.stats.stats import QueryStat
             t_ser = time.monotonic()
-            total_dps = sum(len(r.dps) for r in results)
+            total_dps = sum(r.num_dps if hasattr(r, "num_dps")
+                            else len(r.dps) for r in results)
             stats.add_stat(QueryStat.EMITTED_DPS, total_dps)
             if tsq.show_stats or request.flag("show_stats"):
                 # the NaN census walks every emitted point: only when
-                # the caller asked for stats (ref: nanDPs)
-                stats.add_stat(QueryStat.NAN_DPS, sum(
-                    1 for r in results for _, v in r.dps if v != v))
+                # the caller asked for stats (ref: nanDPs). Columnar
+                # results count vectorized; only list-backed ones walk
+                import numpy as _np
+                nan_dps = 0
+                for r in results:
+                    if getattr(r, "dps_arrays", None) is not None:
+                        nan_dps += int(
+                            _np.isnan(r.dps_arrays[1]).sum())
+                    else:
+                        nan_dps += sum(1 for _, v in r.dps if v != v)
+                stats.add_stat(QueryStat.NAN_DPS, nan_dps)
             # very large responses stream per-series with chunked
             # transfer encoding instead of materializing one body
             # (ref: formatQueryAsyncV1 incremental writes)

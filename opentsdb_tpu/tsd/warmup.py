@@ -152,17 +152,18 @@ def run_warmup(tsdb) -> int:
         and rs._tiers[(iv, "sum")].num_series()
         for iv, agg in rs._tiers)
 
-    def agg_specs(s, b, g):
+    def agg_specs(s, b, g, host_lin=False, host_pct=False):
         for agg in ("sum", "avg"):
             for rate in (False, True):
                 yield PipelineSpec(num_series=s, num_buckets=b,
                                    num_groups=g, ds_function="avg",
-                                   agg_name=agg, rate=rate)
+                                   agg_name=agg, rate=rate,
+                                   host=host_lin)
         if pct:
             for agg in ("p95", "p99"):
                 yield PipelineSpec(num_series=s, num_buckets=b,
                                    num_groups=g, ds_function="avg",
-                                   agg_name=agg)
+                                   agg_name=agg, host=host_pct)
 
     for s, b, g_raw in combos:
         # the engine's group-dim bucketing + host-tail placement,
@@ -178,10 +179,24 @@ def run_warmup(tsdb) -> int:
             # jnp allocation would round-trip the default device)
             import jax
             from opentsdb_tpu.query.engine import host_tail_for_dims
-            dev = host_tail_for_dims(tsdb.config, s, b, g_raw)
-            grid = jax.device_put(np.zeros((s, b), dtype), device=dev)
+            # placement is aggregator-class dependent (linear aggs get
+            # the larger segment-reduction budget) — warm each class on
+            # the device the engine would pick for it
+            dev_lin = host_tail_for_dims(tsdb.config, s, b, g_raw,
+                                         agg_name="sum")
+            dev_pct = host_tail_for_dims(tsdb.config, s, b, g_raw,
+                                         agg_name="p99")
+            grid = jax.device_put(np.zeros((s, b), dtype),
+                                  device=dev_lin)
             has = jax.device_put(np.zeros((s, b), dtype=bool),
-                                 device=dev)
+                                 device=dev_lin)
+            if dev_pct is dev_lin or dev_pct == dev_lin:
+                grid_pct, has_pct = grid, has
+            else:
+                grid_pct = jax.device_put(np.zeros((s, b), dtype),
+                                          device=dev_pct)
+                has_pct = jax.device_put(np.zeros((s, b), dtype=bool),
+                                         device=dev_pct)
             bts = np.arange(b, dtype=np.int32) * 60_000
             gids = np.zeros(s, dtype=np.int32)
             rp = (np.asarray(0.0, dtype), np.asarray(0.0, dtype))
@@ -197,15 +212,21 @@ def run_warmup(tsdb) -> int:
                 np.arange(b, dtype=np.int64) * 60_000, dtype=dtype)
             dgids = sharded_grid_gids(
                 mesh, np.zeros(s, dtype=np.int32), s_pad, g)
-        for spec in agg_specs(s, b, g):
+        host_kw = {}
+        if mesh is None:
+            host_kw = {"host_lin": dev_lin is not None,
+                       "host_pct": dev_pct is not None}
+        for spec in agg_specs(s, b, g, **host_kw):
             if stop is not None and stop.is_set():
                 log.info("warmup stopped early after %d programs",
                          compiled)
                 return compiled
             try:
                 if mesh is None:
-                    run_pipeline_grid(grid, has, bts, gids, rp, fv,
-                                      spec)
+                    is_pct = spec.agg_name.startswith("p")
+                    run_pipeline_grid(grid_pct if is_pct else grid,
+                                      has_pct if is_pct else has,
+                                      bts, gids, rp, fv, spec)
                 else:
                     from opentsdb_tpu.parallel.sharded_pipeline import \
                         run_sharded_grid
@@ -226,10 +247,12 @@ def run_warmup(tsdb) -> int:
             import jax
             from opentsdb_tpu.query.engine import host_tail_for_dims
             dev_raw = host_tail_for_dims(tsdb.config, s, b, g_raw,
-                                         emit_raw=True)
+                                         emit_raw=True,
+                                         agg_name="sum")
             spec_raw = PipelineSpec(num_series=s, num_buckets=b,
                                     num_groups=g, ds_function="avg",
-                                    agg_name="sum", emit_raw=True)
+                                    agg_name="sum", emit_raw=True,
+                                    host=dev_raw is not None)
             run_pipeline_grid(
                 jax.device_put(np.zeros((s, b), dtype), device=dev_raw),
                 jax.device_put(np.zeros((s, b), dtype=bool),
@@ -240,7 +263,8 @@ def run_warmup(tsdb) -> int:
                 for agg in ("sum", "avg"):
                     spec_div = PipelineSpec(
                         num_series=s, num_buckets=b, num_groups=g,
-                        ds_function="avg", agg_name=agg)
+                        ds_function="avg", agg_name=agg,
+                        host=dev_lin is not None)
                     run_pipeline_avg_div(grid, grid, bts, gids, rp,
                                          fv, spec_div)
                     compiled += 1

@@ -64,6 +64,21 @@ def enable_compile_cache(cache_dir: str) -> bool:
     return True
 
 
+def _platform_tag(config) -> str:
+    """Cache partition key: entries compiled for/by different backends
+    must not share a directory. A tunneled backend's CPU-AOT stubs are
+    compiled on the REMOTE host with its machine features — loading
+    them into a local CPU process warns (and can SIGILL), so 'axon'
+    and 'cpu' (and any other platform) each get their own subdir."""
+    plat = ""
+    try:
+        plat = config.get_string("tsd.tpu.platform", "")
+    except Exception:  # noqa: BLE001
+        pass
+    plat = plat or os.environ.get("JAX_PLATFORMS", "") or "default"
+    return "".join(c if c.isalnum() else "_" for c in plat.lower())
+
+
 def enable_from_config(config, data_dir: str = "") -> bool:
     """Resolve the cache dir from config and enable it.
 
@@ -71,14 +86,17 @@ def enable_from_config(config, data_dir: str = "") -> bool:
     ``<data_dir>/xla_cache`` when the server is durable; otherwise a
     stable per-user default so even ephemeral servers and benches
     share compiles across runs. Set the key to ``"off"`` to disable.
+    All resolved paths are partitioned per backend platform.
     """
     explicit = config.get_string("tsd.query.compile_cache_dir", "")
     if explicit.lower() in ("off", "none", "disabled"):
         return False
+    tag = _platform_tag(config)
     if explicit:
-        return enable_compile_cache(explicit)
+        return enable_compile_cache(os.path.join(explicit, tag))
     if data_dir:
-        return enable_compile_cache(os.path.join(data_dir, "xla_cache"))
+        return enable_compile_cache(
+            os.path.join(data_dir, "xla_cache", tag))
     default = os.path.join(
         os.path.expanduser("~"), ".cache", "opentsdb_tpu", "xla_cache")
-    return enable_compile_cache(default)
+    return enable_compile_cache(os.path.join(default, tag))

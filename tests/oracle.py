@@ -149,9 +149,12 @@ def aggregate_group(series_points, agg, interpolate=True):
             if len(xs) == 1:
                 out[t] = 0.0
             else:
+                # population std (divisor n): the reference's Welford
+                # over-increments n and its own tests expect numpy.std
+                # (TestAggregators.java:82-122)
                 m = sum(xs) / len(xs)
                 out[t] = math.sqrt(
-                    sum((x - m) ** 2 for x in xs) / (len(xs) - 1))
+                    sum((x - m) ** 2 for x in xs) / len(xs))
         elif agg == "first":
             out[t] = xs[0]
         elif agg == "last":

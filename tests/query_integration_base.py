@@ -1,0 +1,180 @@
+"""Shared fixtures for the query-integration matrix — the analogue of
+the reference's ``BaseTsdbTest`` data generators (ref:
+test/core/BaseTsdbTest.java:610-800) used by the ``TestTsdbQuery*``
+integration files (SURVEY.md §4).
+
+Every generator reproduces the reference's canonical series shapes:
+
+- ``store_long_seconds``: web01 = 1..300 ascending @30s starting
+  1356998430; web02 = 300..1 descending (optionally offset +15s).
+- ``store_long_ms``: same values @500ms cadence.
+- ``store_float_seconds``: 1.25..76.0 by 0.25 / 75.0..0.25 descending.
+- ``store_long_missing``: web01 skips every 3rd point, web02 every
+  2nd (ref: storeLongTimeSeriesWithMissingData).
+
+The matrix runs each scenario single-device AND on an 8-virtual-device
+('series','time') mesh — the TPU analogue of the reference's
+``*Salted`` twin files (TestTsdbQuerySalted.java flips salt buckets to
+exercise the 20-way parallel merge without a cluster).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+METRIC = "sys.cpu.user"
+METRIC_B = "sys.cpu.system"
+
+# engine modes: the mesh param is the Salted-twin analogue. Files
+# using these helpers parametrize over ENGINE_MODES via the
+# ``engine_mode`` fixture below.
+ENGINE_MODES = ["single", "mesh"]
+MESH_SPEC = "series:4,time:2"
+
+
+@pytest.fixture(params=ENGINE_MODES)
+def engine_mode(request):
+    return request.param
+
+
+def make_tsdb(engine_mode: str = "single", **extra) -> TSDB:
+    cfg = {"tsd.core.auto_create_metrics": "true"}
+    if engine_mode == "mesh":
+        cfg["tsd.query.mesh"] = MESH_SPEC
+    cfg.update(extra)
+    return TSDB(Config(**cfg))
+
+
+# ---------------------------------------------------------------------------
+# data generators (ref: BaseTsdbTest.java:610-800)
+# ---------------------------------------------------------------------------
+
+def _bulk(tsdb, metric: str, ts_s: np.ndarray, vals: np.ndarray,
+          tags: dict) -> None:
+    """Seed one series efficiently (first point through add_point to
+    create the series, remainder via the columnar append)."""
+    sid = tsdb.add_point(metric, int(ts_s[0]), float(vals[0]), tags)
+    if len(ts_s) > 1:
+        tsdb.store.append_many(sid, ts_s[1:].astype(np.int64) * 1000,
+                               np.asarray(vals[1:], dtype=np.float64),
+                               False)
+
+
+def store_long_seconds(tsdb, two_metrics=False, offset=False):
+    """web01 ascending 1..300 @30s from BASE+30; web02 descending
+    300..1 (offset shifts web02 +15s)
+    (ref: storeLongTimeSeriesSeconds)."""
+    asc = np.arange(1, 301, dtype=np.float64)
+    ts1 = BASE + 30 * np.arange(1, 301, dtype=np.int64)
+    _bulk(tsdb, METRIC, ts1, asc, {"host": "web01"})
+    if two_metrics:
+        _bulk(tsdb, METRIC_B, ts1, asc, {"host": "web01"})
+    desc = asc[::-1].copy()
+    ts2 = ts1 + 15 if offset else ts1
+    _bulk(tsdb, METRIC, ts2, desc, {"host": "web02"})
+    if two_metrics:
+        _bulk(tsdb, METRIC_B, ts2, desc, {"host": "web02"})
+    return ts1, asc, ts2, desc
+
+
+def store_long_ms(tsdb, two_metrics=False):
+    """Same series at 500 ms cadence (ref: storeLongTimeSeriesMs)."""
+    asc = np.arange(1, 301, dtype=np.float64)
+    ts_ms = BASE_MS + 500 * np.arange(1, 301, dtype=np.int64)
+    sid = tsdb.add_point(METRIC, int(ts_ms[0]), float(asc[0]),
+                         {"host": "web01"})
+    tsdb.store.append_many(sid, ts_ms[1:], asc[1:], False)
+    desc = asc[::-1].copy()
+    sid = tsdb.add_point(METRIC, int(ts_ms[0]), float(desc[0]),
+                         {"host": "web02"})
+    tsdb.store.append_many(sid, ts_ms[1:], desc[1:], False)
+    if two_metrics:
+        for tags, vals in (({"host": "web01"}, asc),
+                           ({"host": "web02"}, desc)):
+            sid = tsdb.add_point(METRIC_B, int(ts_ms[0]),
+                                 float(vals[0]), tags)
+            tsdb.store.append_many(sid, ts_ms[1:], vals[1:], False)
+    return ts_ms, asc, desc
+
+
+def store_float_seconds(tsdb, two_metrics=False, offset=False):
+    """web01 = 1.25..76.0 step .25; web02 = 75.0..0.25 descending
+    (ref: storeFloatTimeSeriesSeconds)."""
+    asc = 1.25 + 0.25 * np.arange(300, dtype=np.float64)
+    ts1 = BASE + 30 * np.arange(1, 301, dtype=np.int64)
+    _bulk(tsdb, METRIC, ts1, asc, {"host": "web01"})
+    if two_metrics:
+        _bulk(tsdb, METRIC_B, ts1, asc, {"host": "web01"})
+    desc = 75.0 - 0.25 * np.arange(300, dtype=np.float64)
+    ts2 = ts1 + 15 if offset else ts1
+    _bulk(tsdb, METRIC, ts2, desc, {"host": "web02"})
+    if two_metrics:
+        _bulk(tsdb, METRIC_B, ts2, desc, {"host": "web02"})
+    return ts1, asc, ts2, desc
+
+
+def store_long_missing(tsdb):
+    """web01 skips every 3rd point, web02 every other, @10s from BASE
+    (ref: storeLongTimeSeriesWithMissingData)."""
+    ts = BASE + 10 * np.arange(300, dtype=np.int64)
+    keep1 = np.arange(300) % 3 != 0
+    vals1 = np.arange(1, 301, dtype=np.float64)
+    _bulk(tsdb, METRIC, ts[keep1], vals1[keep1], {"host": "web01"})
+    keep2 = (np.arange(300, 0, -1) % 2) != 0
+    vals2 = np.arange(300, 0, -1, dtype=np.float64)
+    _bulk(tsdb, METRIC, ts[keep2], vals2[keep2], {"host": "web02"})
+    return ts, vals1, keep1, vals2, keep2
+
+
+# ---------------------------------------------------------------------------
+# query helpers
+# ---------------------------------------------------------------------------
+
+def run_query(tsdb, sub: dict, start_s=BASE, end_s=BASE + 43200,
+              ms_resolution=False, **top):
+    obj = {"start": start_s * 1000, "end": end_s * 1000,
+           "queries": [sub]}
+    if ms_resolution:
+        obj["msResolution"] = True
+    obj.update(top)
+    return tsdb.execute_query(TSQuery.from_json(obj).validate())
+
+
+def sub_query(aggregator="sum", metric=METRIC, tags=None, **kw) -> dict:
+    """Reference setTimeSeries(metric, tags, agg) analogue: tags map
+    with literal values filter+groupby; '*' value = wildcard groupby
+    (ref: Tags.parseWithMetric pipe/wildcard semantics)."""
+    sub = {"aggregator": aggregator, "metric": metric, **kw}
+    if tags:
+        sub["tags"] = dict(tags)
+    return sub
+
+
+def dps_of(results, tags: dict | None = None):
+    """The (ts_ms, value) list of the result whose tags match, or the
+    single result when tags is None."""
+    if tags is None:
+        assert len(results) == 1, \
+            f"expected 1 result, got {[r.tags for r in results]}"
+        return results[0].dps
+    for r in results:
+        if r.tags == tags:
+            return r.dps
+    raise AssertionError(
+        f"no result with tags {tags}: {[r.tags for r in results]}")
+
+
+def assert_points(dps, want_ts_ms, want_vals, rel=1e-6):
+    got_ts = [t for t, _ in dps]
+    got_vals = [v for _, v in dps]
+    assert got_ts == [int(t) for t in want_ts_ms], (
+        f"timestamps differ: got {got_ts[:5]}..{got_ts[-3:]} "
+        f"want {[int(t) for t in want_ts_ms][:5]}..")
+    np.testing.assert_allclose(got_vals, want_vals, rtol=rel,
+                               atol=1e-9)

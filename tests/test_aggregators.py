@@ -69,6 +69,9 @@ class TestScalarAggregators:
         assert got[0] == 3.0 and np.isnan(got[1])
 
     def test_dev_matches_welford(self):
+        # population std: the reference's Welford over-increments n,
+        # and its tests pin numpy.std (ddof=0) semantics
+        # (TestAggregators.java:82-122, {1,2} -> 0.5)
         x = rand_grid(seed=3)
         got = np.asarray(aggs.get("dev")(x, axis=0))
         for col in range(x.shape[1]):
@@ -78,8 +81,21 @@ class TestScalarAggregators:
             elif len(vals) == 1:
                 assert got[col] == 0.0
             else:
-                np.testing.assert_allclose(got[col], np.std(vals, ddof=1),
+                np.testing.assert_allclose(got[col], np.std(vals),
                                            rtol=1e-10)
+
+    def test_dev_reference_known_values(self):
+        # the reference's own expectations, verbatim
+        # (TestAggregators.java:82-122)
+        x = np.arange(10000, dtype=np.float64)[:, None]
+        np.testing.assert_allclose(
+            float(np.asarray(aggs.get("dev")(x, axis=0))[0]),
+            2886.7513315143719, rtol=1e-9)
+        pair = np.asarray([[1.0], [2.0]])
+        assert float(np.asarray(aggs.get("dev")(pair, axis=0))[0]) \
+            == pytest.approx(0.5)
+        flat = np.asarray([[3.0], [3.0], [3.0]])
+        assert float(np.asarray(aggs.get("dev")(flat, axis=0))[0]) == 0.0
 
     def test_median_upper(self):
         # even count: reference takes sorted[n/2] (upper median)

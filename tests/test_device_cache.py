@@ -17,6 +17,7 @@ def _tsdb(**extra):
     # tests keep pinning the cache machinery itself
     return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
                           "tsd.query.host_tail_max_cells": "-1",
+                          "tsd.query.host_tail_max_cells_linear": "-1",
                           **extra}))
 
 

@@ -78,7 +78,7 @@ class TestGroupAggregate:
         out = np.asarray(group_aggregate(g, self.TS[:1], gids, 1,
                                          aggs.get("dev")))
         np.testing.assert_allclose(out[0, 0],
-                                   np.std([2, 4, 6, 8], ddof=1), rtol=1e-10)
+                                   np.std([2, 4, 6, 8]), rtol=1e-10)
 
     def test_percentile_group(self):
         vals = np.arange(1.0, 101.0)

@@ -1,0 +1,295 @@
+"""Downsample query-integration matrix — the analogue of
+``TestTsdbQueryDownsample.java`` (30 scenarios: aligned/unaligned
+intervals, ms cadence, ds+rate, count, run-all, the WNulls
+fill-policy matrix, missing data), each run single-device AND on the
+8-device mesh via ``engine_mode`` (the *Salted twin).
+
+Expected values are computed independently in numpy from the fixture
+closed forms, mirroring the Java tests' inline loops (e.g.
+runLongSingleTSDownsample expects 1, i*2+0.5, ..., 300 for 1m-avg over
+the 30s-cadence ascending series).
+
+Known deliberate divergence from the reference (asserted around, not
+against): the reference emits one extra HOUR of trailing fill-policy
+buckets because its scan window extends end+3600s
+(TsdbQuery#getScanEndTimeSeconds) — a storage-row artifact, not query
+semantics. Our fill-policy emission covers [start, end] exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from query_integration_base import (BASE, METRIC, assert_points, dps_of,
+                                    engine_mode, make_tsdb, run_query,
+                                    store_float_seconds, store_long_ms,
+                                    store_long_missing,
+                                    store_long_seconds, sub_query)
+
+_ = engine_mode
+
+END = BASE + 43200
+
+
+def _bucket(ts_s, vals, interval_s, fn, start=BASE, end=END):
+    """Per-series downsample on second timestamps -> (bucket_ts_s,
+    values, count) with NaN for empty buckets."""
+    edges = np.arange(start - start % interval_s, end + 1, interval_s)
+    idx = (ts_s - edges[0]) // interval_s
+    nb = len(edges)
+    out = np.full(nb, np.nan)
+    cnt = np.zeros(nb)
+    for j in range(len(ts_s)):
+        b = int(idx[j])
+        v = vals[j]
+        if np.isnan(out[b]):
+            out[b] = 0.0 if fn in ("sum", "avg", "count") else v
+        if fn in ("sum", "avg"):
+            out[b] += v
+        elif fn == "min":
+            out[b] = min(out[b], v)
+        elif fn == "max":
+            out[b] = max(out[b], v)
+        cnt[b] += 1
+    if fn == "avg":
+        out = out / np.maximum(cnt, 1)
+    elif fn == "count":
+        out = cnt.astype(float)
+        out[cnt == 0] = np.nan
+    return edges, out, cnt
+
+
+# ---------------------------------------------------------------------------
+# single-series fixed-interval downsampling
+# ---------------------------------------------------------------------------
+
+def test_1m_avg_long(engine_mode):
+    """(ref: runLongSingleTSDownsample) intervals (1), (2,3), (4,5)...
+    (300): values 1, 2.5, 4.5, ..., 298.5, 300; aligned timestamps."""
+    t = make_tsdb(engine_mode)
+    store_long_seconds(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="1m-avg"))
+    dps = dps_of(r)
+    want_vals = [1.0] + [i * 2 + 0.5 for i in range(1, 150)] + [300.0]
+    want_ts = [(BASE + 60 * i) * 1000 for i in range(151)]
+    assert_points(dps, want_ts, want_vals)
+
+
+def test_1m_sum_and_count_long(engine_mode):
+    """(ref: runLongSingleTSDownsampleCount) same buckets, sum/count."""
+    t = make_tsdb(engine_mode)
+    store_long_seconds(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="1m-sum"))
+    want = [1.0] + [i * 2 + (i * 2 + 1) for i in range(1, 150)] \
+        + [300.0]
+    assert_points(dps_of(r), [(BASE + 60 * i) * 1000
+                              for i in range(151)], want)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="1m-count"))
+    want_c = [1.0] + [2.0] * 149 + [1.0]
+    assert_points(dps_of(r), [(BASE + 60 * i) * 1000
+                              for i in range(151)], want_c)
+
+
+@pytest.mark.parametrize("interval,label", [(90, "90s"), (420, "7m")])
+def test_weird_intervals(engine_mode, interval, label):
+    """(ref: downsampleWeirdly/downsampleUnaligned) non-divisor
+    intervals bucket by floor(ts/interval)."""
+    t = make_tsdb(engine_mode)
+    ts1, asc, _, _ = store_long_seconds(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample=f"{label}-avg"))
+    edges, want, cnt = _bucket(ts1, asc, interval, "avg")
+    keep = cnt > 0
+    assert_points(dps_of(r), edges[keep] * 1000, want[keep])
+
+
+def test_ms_downsample(engine_mode):
+    """(ref: runLongSingleTSDownsampleMs) 500ms cadence, 1s-avg:
+    pairs (1,2), (3,4)... -> 1.5, 3.5, ..., 299.5."""
+    t = make_tsdb(engine_mode)
+    store_long_ms(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="1s-avg"), ms_resolution=True)
+    dps = dps_of(r)
+    # points at BASE_MS+500..BASE_MS+150000; buckets of 1s hold pairs
+    # (value 2k-1 at +500k ms lands in bucket k... compute directly:
+    ts_ms = BASE * 1000 + 500 * np.arange(1, 301, dtype=np.int64)
+    vals = np.arange(1, 301, dtype=np.float64)
+    edges, want, cnt = _bucket(ts_ms // 1000, vals, 1,
+                               "avg", start=BASE, end=END)
+    keep = cnt > 0
+    assert_points(dps, edges[keep] * 1000, want[keep])
+
+
+def test_downsample_and_rate(engine_mode):
+    """(ref: runLongSingleTSDownsampleAndRate) 1m-avg then rate:
+    constant slope 1 per 30s -> 2 per minute -> 2/60 per second...
+    exactly 1/30 between interior bucket averages."""
+    t = make_tsdb(engine_mode)
+    store_long_seconds(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="1m-avg", rate=True))
+    dps = dps_of(r)
+    # bucket avgs: 1, 2.5, 4.5, ..., 298.5, 300 at 60s spacing
+    avgs = np.asarray([1.0] + [i * 2 + 0.5 for i in range(1, 150)]
+                      + [300.0])
+    want = np.diff(avgs) / 60.0
+    want_ts = [(BASE + 60 * i) * 1000 for i in range(1, 151)]
+    assert_points(dps, want_ts, want)
+
+
+def test_downsample_and_rate_float(engine_mode):
+    """(ref: runFloatSingleTSDownsampleAndRate)."""
+    t = make_tsdb(engine_mode)
+    ts1, asc, _, _ = store_float_seconds(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="1m-avg", rate=True))
+    edges, bavg, cnt = _bucket(ts1, asc, 60, "avg")
+    keep = cnt > 0
+    b_ts, b_v = edges[keep], bavg[keep]
+    want = np.diff(b_v) / np.diff(b_ts)
+    assert_points(dps_of(r), b_ts[1:] * 1000, want, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# run-all ("0all-")
+# ---------------------------------------------------------------------------
+
+def test_downsample_all(engine_mode):
+    """(ref: runLongSingleTSDownsampleAll) 0all-sum collapses the
+    whole window to one point at the QUERY START time: sum 1..300 =
+    45150 at start_time."""
+    t = make_tsdb(engine_mode)
+    store_long_seconds(t)
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="0all-sum"))
+    dps = dps_of(r)
+    assert len(dps) == 1
+    assert dps[0][0] == BASE * 1000
+    assert dps[0][1] == pytest.approx(45150.0)
+
+
+def test_downsample_all_subset(engine_mode):
+    """(ref: runLongSingleTSDownsampleAllSubSet) a narrower window
+    run-alls only the covered points."""
+    t = make_tsdb(engine_mode)
+    ts1, asc, _, _ = store_long_seconds(t)
+    start, end = BASE + 3600, BASE + 7200
+    r = run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample="0all-sum"),
+                  start_s=start, end_s=end)
+    dps = dps_of(r)
+    inside = (ts1 >= start) & (ts1 <= end)
+    assert len(dps) == 1
+    assert dps[0][1] == pytest.approx(float(asc[inside].sum()))
+
+
+# ---------------------------------------------------------------------------
+# the WNulls fill-policy matrix (ref: run{Sum,Avg,Min}x{...}WNulls)
+# ---------------------------------------------------------------------------
+
+def _missing_expected(agg, ds_fn):
+    """Expected [bucket] values for the missing-data fixture at 30s
+    buckets with NaN fill: per-series ds (web01 keeps 2 of 3 slots,
+    web02 alternates), then NaN-skipping aggregation (NaN fill means
+    the merge skips missing values WITHOUT interpolating)."""
+    ts = BASE + 10 * np.arange(300, dtype=np.int64)
+    keep1 = np.arange(300) % 3 != 0
+    vals1 = np.arange(1, 301, dtype=np.float64)
+    keep2 = (np.arange(300, 0, -1) % 2) != 0
+    vals2 = np.arange(300, 0, -1, dtype=np.float64)
+    _, b1, c1 = _bucket(ts[keep1], vals1[keep1], 30, ds_fn,
+                        end=BASE + 3000)
+    edges, b2, c2 = _bucket(ts[keep2], vals2[keep2], 30, ds_fn,
+                            end=BASE + 3000)
+    both = np.vstack([b1, b2])
+    with np.errstate(invalid="ignore"):
+        if agg == "sum":
+            out = np.nansum(both, axis=0)
+        elif agg == "avg":
+            out = np.nanmean(both, axis=0)
+        elif agg == "min":
+            out = np.nanmin(both, axis=0)
+    out[np.isnan(b1) & np.isnan(b2)] = np.nan
+    return edges, out
+
+
+WNULLS = [("sum", "avg"), ("avg", "sum"), ("avg", "avg"),
+          ("sum", "sum"), ("min", "min"), ("min", "sum"),
+          ("sum", "min")]
+
+
+@pytest.mark.parametrize("agg,ds_fn", WNULLS,
+                         ids=[f"{a}-{d}" for a, d in WNULLS])
+def test_wnulls_matrix(engine_mode, agg, ds_fn):
+    t = make_tsdb(engine_mode)
+    store_long_missing(t)
+    r = run_query(t, sub_query(agg, downsample=f"30s-{ds_fn}-nan"),
+                  end_s=BASE + 3000)
+    dps = dps_of(r)
+    edges, want = _missing_expected(agg, ds_fn)
+    got_map = {tt: v for tt, v in dps}
+    # NaN fill emits every bucket in [start, end]
+    assert len(dps) == len(edges), (len(dps), len(edges))
+    for e, w in zip(edges, want):
+        g = got_map[int(e) * 1000]
+        if np.isnan(w):
+            assert np.isnan(g), (e, g)
+        else:
+            assert g == pytest.approx(w, rel=1e-6), (e, g, w)
+
+
+@pytest.mark.parametrize("policy,sub_val", [("zero", 0.0),
+                                            ("null", None)])
+def test_fill_policies_zero_null(engine_mode, policy, sub_val):
+    """zero fill substitutes 0.0 (emitted as real points); null emits
+    the bucket with a null/NaN marker (ref: FillPolicy.ZERO/NULL)."""
+    t = make_tsdb(engine_mode)
+    store_long_missing(t)
+    r = run_query(t, sub_query(
+        "sum", tags={"host": "web01"},
+        downsample=f"30s-sum-{policy}"), end_s=BASE + 3000)
+    dps = dps_of(r)
+    edges = np.arange(BASE, BASE + 3000 + 1, 30)
+    assert len(dps) == len(edges)
+    ts = BASE + 10 * np.arange(300, dtype=np.int64)
+    keep1 = np.arange(300) % 3 != 0
+    vals1 = np.arange(1, 301, dtype=np.float64)
+    _, want, cnt = _bucket(ts[keep1], vals1[keep1], 30, "sum",
+                           end=BASE + 3000)
+    for (tt, g), e, w, c in zip(dps, edges, want, cnt):
+        assert tt == int(e) * 1000
+        if c > 0:
+            assert g == pytest.approx(w)
+        elif policy == "zero":
+            assert g == 0.0
+        else:
+            assert g is None or np.isnan(g)
+
+
+# ---------------------------------------------------------------------------
+# validation errors (ref: downsampleNullAgg / downsampleInvalidInterval)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bad", ["1m", "-60s-avg", "1m-nosuchfn",
+                                 "xyz-avg"])
+def test_invalid_downsample_rejected(engine_mode, bad):
+    from opentsdb_tpu.query.model import BadRequestError
+    t = make_tsdb(engine_mode)
+    store_long_seconds(t)
+    with pytest.raises((BadRequestError, ValueError)):
+        run_query(t, sub_query("sum", tags={"host": "web01"},
+                               downsample=bad))
+
+
+def test_downsample_none_passthrough(engine_mode):
+    """(ref: runLongSingleTSDownsampleNone) 'none' aggregator with no
+    downsample emits raw points untouched."""
+    t = make_tsdb(engine_mode)
+    ts1, asc, _, _ = store_long_seconds(t)
+    r = run_query(t, sub_query("none", tags={"host": "web01"}))
+    assert_points(dps_of(r), ts1 * 1000, asc)

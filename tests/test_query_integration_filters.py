@@ -1,0 +1,243 @@
+"""Filter query-integration matrix — the analogue of
+``TestTsdbQuery.java``'s configureFromQuery* scenarios plus the
+``TagVFilter`` family semantics (literal_or/iliteral_or/wildcard/
+iwildcard/regexp/not_literal_or/not_key, explicit tags, NSU
+handling, query limits), each run single-device AND on the mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.model import BadRequestError, TSQuery
+from query_integration_base import (BASE, METRIC, assert_points, dps_of,
+                                    engine_mode, make_tsdb, run_query,
+                                    store_long_seconds, sub_query)
+
+_ = engine_mode
+
+END = BASE + 43200
+
+
+def _seed_hosts(t, hosts=("web01", "web02", "Web03", "db01"),
+                extra_tag=None):
+    """One series per host, constant value = index+1 @30s x 10."""
+    ts = BASE + 30 * np.arange(1, 11, dtype=np.int64)
+    for i, h in enumerate(hosts):
+        tags = {"host": h}
+        if extra_tag:
+            tags.update(extra_tag)
+        t.add_points("f.m", ts, np.full(10, float(i + 1)), tags)
+    return ts
+
+
+def _filter_q(t, ftype, expr, group_by=False, metric="f.m"):
+    return run_query(t, {
+        "metric": metric, "aggregator": "sum",
+        "filters": [{"type": ftype, "tagk": "host", "filter": expr,
+                     "groupBy": group_by}]})
+
+
+class TestFilterTypes:
+    def test_literal_or(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "literal_or", "web01|web02")
+        # 1 + 2 summed, host becomes an aggregate tag
+        assert_points(dps_of(r), ts * 1000, np.full(10, 3.0))
+        assert r[0].aggregated_tags == ["host"]
+
+    def test_literal_or_case_sensitive(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "literal_or", "web03")  # wrong case
+        assert r == [] or all(x.num_dps == 0 for x in r)
+
+    def test_iliteral_or(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "iliteral_or", "WEB03")
+        assert_points(dps_of(r), ts * 1000, np.full(10, 3.0))
+
+    def test_wildcard(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "wildcard", "web*")
+        assert_points(dps_of(r), ts * 1000, np.full(10, 3.0))
+
+    def test_iwildcard(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "iwildcard", "web*")
+        assert_points(dps_of(r), ts * 1000, np.full(10, 6.0))
+
+    def test_regexp(self, engine_mode):
+        """(ref: runRegexp)"""
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "regexp", "web0[12]")
+        assert_points(dps_of(r), ts * 1000, np.full(10, 3.0))
+
+    def test_regexp_no_match(self, engine_mode):
+        """(ref: runRegexpNoMatch)"""
+        t = make_tsdb(engine_mode)
+        _seed_hosts(t)
+        r = _filter_q(t, "regexp", "nothing-matches-this")
+        assert r == [] or all(x.num_dps == 0 for x in r)
+
+    def test_not_literal_or(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "not_literal_or", "web01|web02")
+        # Web03 (3) + db01 (4)
+        assert_points(dps_of(r), ts * 1000, np.full(10, 7.0))
+
+    def test_not_key(self, engine_mode):
+        """not_key excludes series carrying the tag key at all."""
+        t = make_tsdb(engine_mode)
+        ts = BASE + 30 * np.arange(1, 11, dtype=np.int64)
+        t.add_points("f.m", ts, np.full(10, 1.0), {"host": "a"})
+        t.add_points("f.m", ts, np.full(10, 10.0), {"dc": "east"})
+        r = run_query(t, {
+            "metric": "f.m", "aggregator": "sum",
+            "filters": [{"type": "not_key", "tagk": "host",
+                         "filter": ""}]})
+        assert_points(dps_of(r), ts * 1000, np.full(10, 10.0))
+
+    def test_groupby_literal_or(self, engine_mode):
+        """(ref: configureFromQueryGroupByPipe) pipe-groupby yields one
+        result per listed value."""
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "literal_or", "web01|web02", group_by=True)
+        assert len(r) == 2
+        by = {x.tags["host"]: x for x in r}
+        assert_points(by["web01"].dps, ts * 1000, np.full(10, 1.0))
+        assert_points(by["web02"].dps, ts * 1000, np.full(10, 2.0))
+
+    def test_groupby_wildcard_all(self, engine_mode):
+        """(ref: configureFromQueryGroupByAll) host=* groups every
+        distinct value."""
+        t = make_tsdb(engine_mode)
+        _seed_hosts(t)
+        r = _filter_q(t, "wildcard", "*", group_by=True)
+        assert {x.tags["host"] for x in r} == \
+            {"web01", "web02", "Web03", "db01"}
+
+    def test_multiple_filters_intersect(self, engine_mode):
+        """(ref: configureFromQueryWithGroupByAndRegularFilters)"""
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t, extra_tag=None)
+        # same metric, two tags: host + dc
+        t.add_points("f.m", ts, np.full(10, 100.0),
+                     {"host": "web01", "dc": "east"})
+        r = run_query(t, {
+            "metric": "f.m", "aggregator": "sum",
+            "filters": [
+                {"type": "literal_or", "tagk": "host",
+                 "filter": "web01", "groupBy": True},
+                {"type": "literal_or", "tagk": "dc",
+                 "filter": "east", "groupBy": False}]})
+        assert_points(dps_of(r), ts * 1000, np.full(10, 100.0))
+
+    def test_unknown_filter_type_rejected(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        _seed_hosts(t)
+        with pytest.raises((BadRequestError, ValueError)):
+            _filter_q(t, "no_such_filter", "x")
+
+
+class TestExplicitTags:
+    def test_explicit_tags_ok(self, engine_mode):
+        """(ref: filterExplicitTagsOK) only series whose tag SET is
+        exactly the filter keys match."""
+        t = make_tsdb(engine_mode)
+        ts = BASE + 30 * np.arange(1, 11, dtype=np.int64)
+        t.add_points("e.m", ts, np.full(10, 1.0), {"host": "w1"})
+        t.add_points("e.m", ts, np.full(10, 10.0),
+                     {"host": "w1", "dc": "east"})
+        r = run_query(t, {
+            "metric": "e.m", "aggregator": "sum",
+            "explicitTags": True,
+            "filters": [{"type": "literal_or", "tagk": "host",
+                         "filter": "w1", "groupBy": False}]})
+        assert_points(dps_of(r), ts * 1000, np.full(10, 1.0))
+
+    def test_explicit_tags_missing(self, engine_mode):
+        """(ref: filterExplicitTagsMissing)"""
+        t = make_tsdb(engine_mode)
+        ts = BASE + 30 * np.arange(1, 11, dtype=np.int64)
+        t.add_points("e.m", ts, np.full(10, 1.0),
+                     {"host": "w1", "dc": "east"})
+        r = run_query(t, {
+            "metric": "e.m", "aggregator": "sum",
+            "explicitTags": True,
+            "filters": [{"type": "literal_or", "tagk": "host",
+                         "filter": "w1", "groupBy": False}]})
+        assert r == [] or all(x.num_dps == 0 for x in r)
+
+    def test_explicit_tags_groupby(self, engine_mode):
+        """(ref: filterExplicitTagsGroupByOK)"""
+        t = make_tsdb(engine_mode)
+        ts = BASE + 30 * np.arange(1, 11, dtype=np.int64)
+        t.add_points("e.m", ts, np.full(10, 1.0), {"host": "w1"})
+        t.add_points("e.m", ts, np.full(10, 2.0), {"host": "w2"})
+        t.add_points("e.m", ts, np.full(10, 50.0),
+                     {"host": "w1", "dc": "east"})
+        r = run_query(t, {
+            "metric": "e.m", "aggregator": "sum",
+            "explicitTags": True,
+            "filters": [{"type": "wildcard", "tagk": "host",
+                         "filter": "*", "groupBy": True}]})
+        assert {x.tags["host"] for x in r} == {"w1", "w2"}
+
+
+class TestNSUAndLimits:
+    def test_nsu_tagv_rejected(self, engine_mode):
+        """(ref: configureFromQueryNSUTagv) literal filter naming an
+        unknown tag value -> no matches (or clean 400), never a 500."""
+        t = make_tsdb(engine_mode)
+        _seed_hosts(t)
+        try:
+            r = _filter_q(t, "literal_or", "never-written")
+            assert r == [] or all(x.num_dps == 0 for x in r)
+        except (BadRequestError, LookupError):
+            pass
+
+    def test_max_data_points_enforced(self, engine_mode):
+        """(ref: configureFromQueryMaxDataPoints -> QueryLimits)."""
+        from opentsdb_tpu.query.limits import QueryLimitExceeded
+        t = make_tsdb(engine_mode, **{
+            "tsd.query.limits.data_points.default": "5"})
+        _seed_hosts(t)
+        with pytest.raises(QueryLimitExceeded):
+            _filter_q(t, "wildcard", "*")
+
+    def test_skip_unresolved_tagvs(self, engine_mode):
+        """(ref: configureFromQueryGroupByPipeNSUTagvSkipUnresolved)"""
+        t = make_tsdb(engine_mode,
+                      **{"tsd.query.skip_unresolved_tagvs": "true"})
+        ts = _seed_hosts(t)
+        r = _filter_q(t, "literal_or", "web01|never-written",
+                      group_by=True)
+        assert len(r) == 1
+        assert r[0].tags["host"] == "web01"
+
+
+class TestV1TagsForm:
+    """The old tags-map query surface (ref: Tags.parseWithMetric)."""
+
+    def test_pipe_in_tags_groups(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = run_query(t, sub_query("sum", metric="f.m",
+                                   tags={"host": "web01|web02"}))
+        assert len(r) == 2
+
+    def test_empty_tags_aggregates_all(self, engine_mode):
+        t = make_tsdb(engine_mode)
+        ts = _seed_hosts(t)
+        r = run_query(t, sub_query("sum", metric="f.m"))
+        assert_points(dps_of(r), ts * 1000, np.full(10, 10.0))
+        assert r[0].aggregated_tags == ["host"]
