@@ -141,6 +141,22 @@ def run_warmup(tsdb) -> int:
     pct = tsdb.config.get_bool("tsd.tpu.warmup.percentiles", True)
     compiled = 0
     t0 = time.monotonic()
+    # wall budget: on a tunneled device each remote_compile can take
+    # 30-90 s in bad weather, and the full class set can multiply
+    # into tens of minutes. Warmup is an optimization — a server must
+    # come up serving (cold queries still work, and with the
+    # persistent compile cache the next boot resumes where this one
+    # stopped). 0 disables the budget.
+    budget_s = tsdb.config.get_int("tsd.tpu.warmup.budget_s", 600)
+
+    def over_budget() -> bool:
+        if budget_s and time.monotonic() - t0 > budget_s:
+            log.warning(
+                "warmup budget (%ds) exhausted after %d programs; "
+                "remaining classes compile on first use (persisted "
+                "thereafter)", budget_s, compiled)
+            return True
+        return False
     mesh = tsdb.query_mesh
     combos = warmup_shapes(tsdb)
     stop = getattr(tsdb, "_warmup_stop", None)
@@ -166,6 +182,8 @@ def run_warmup(tsdb) -> int:
                                    agg_name=agg, host=host_pct)
 
     for s, b, g_raw in combos:
+        if over_budget():
+            return compiled
         # the engine's group-dim bucketing + host-tail placement,
         # via the SAME helpers (host_tail_for_dims routes through
         # shapes.shape_bucket exactly like _grid_pipeline)
@@ -221,6 +239,8 @@ def run_warmup(tsdb) -> int:
                 log.info("warmup stopped early after %d programs",
                          compiled)
                 return compiled
+            if over_budget():
+                return compiled
             try:
                 if mesh is None:
                     is_pct = spec.agg_name.startswith("p")
@@ -237,7 +257,8 @@ def run_warmup(tsdb) -> int:
                 log.exception("warmup compile failed for "
                               "(%d, %d, %d, %s)", s, b, g,
                               spec.agg_name)
-        if mesh is not None or (stop is not None and stop.is_set()):
+        if mesh is not None or (stop is not None and stop.is_set()) \
+                or over_budget():
             continue
         # single-device extras ADVICE r04 flagged as unwarmed:
         # the emit_raw class (aggregator 'none' dashboards; its
@@ -276,6 +297,8 @@ def run_warmup(tsdb) -> int:
     # resident (the kernels' N / segment dims are bucketed by
     # histogram_percentile_pipeline, so these pre-compiles are the
     # keys real percentile queries hit; r4 config-4 cold was 2.5s)
+    if over_budget():
+        return compiled
     try:
         with tsdb._histogram_lock:
             some = next(

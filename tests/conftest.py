@@ -48,7 +48,12 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         mod = item.module.__name__.rsplit(".", 1)[-1] \
             if item.module else ""
-        if mod in HEAVY_MODULES or "slow" in item.keywords:
+        # mesh-mode twins of the query-integration matrix compile
+        # shard_map programs — the expensive class on a 1-CPU host
+        mesh_param = getattr(getattr(item, "callspec", None),
+                             "params", {}).get("engine_mode") == "mesh"
+        if mod in HEAVY_MODULES or "slow" in item.keywords \
+                or mesh_param:
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.quick)

@@ -144,3 +144,78 @@ class TestTags:
             tags.check_metric_and_tags("m", many)
         with pytest.raises(ValueError):
             tags.check_metric_and_tags("m", {})
+
+
+class TestReferenceDateTimeMatrix:
+    """The remaining TestDateTime.java scenario matrix, table-driven.
+
+    Documented deliberate divergences from the reference:
+    - dot forms with fewer than 3 fractional digits: the reference
+      just deletes the dot ("1355961603.41" -> 135596160341, a
+      nonsense timestamp; TestDateTime.java
+      parseDateTimeStringUnixMSDotShorter) — here they scale as
+      fractional seconds (.5 -> 500 ms).
+    - "1355961603587168438418" (too big): reference accepts silently;
+      here out-of-range absurd strings raise.
+    """
+
+    OK = [
+        ("1355961600", 1355961600000),
+        ("1355961600500", 1355961600500),      # raw ms
+        ("1355961600.500", 1355961600500),     # dot ms
+        ("1355961600.5", 1355961600500),       # fractional seconds
+        ("0", 0),
+        ("2012/12/20", 1355961600000),
+        ("2012/12/20-12:42:42", 1356007362000),
+        ("2012/12/20 12:42:42", 1356007362000),
+    ]
+
+    @pytest.mark.parametrize("text,want", OK, ids=[c[0] for c in OK])
+    def test_valid_forms(self, text, want):
+        assert dt.parse_datetime_ms(text) == want
+
+    BAD = [
+        "135596160.0.5.0",      # multiple dots
+        "-1355961600",          # negative
+        "2012/12/2",            # short date
+        "2012-12-20 12:42:42",  # dash date (reference rejects too)
+        "1.3559616005E12",      # scientific notation
+        "1z-ago",               # bad relative unit
+        "hello-ago",
+    ]
+
+    @pytest.mark.parametrize("bad", BAD)
+    def test_invalid_forms(self, bad):
+        with pytest.raises(ValueError):
+            dt.parse_datetime_ms(bad)
+
+    def test_null_and_empty_mean_unset(self):
+        # (ref: parseDateTimeStringNull/Empty -> -1)
+        assert dt.parse_datetime_ms(None) == -1
+        assert dt.parse_datetime_ms("") == -1
+
+    def test_relative_all_units(self):
+        import time
+        now_ms = int(time.time() * 1000)
+        for unit, sec in (("s", 1), ("m", 60), ("h", 3600),
+                          ("d", 86400), ("w", 604800),
+                          ("n", 30 * 86400), ("y", 365 * 86400)):
+            got = dt.parse_datetime_ms(f"2{unit}-ago")
+            assert abs((now_ms - got) - 2 * sec * 1000) < 5000, unit
+
+    DURATIONS = [
+        ("500ms", 500), ("1s", 1000), ("2m", 120000),
+        ("4h", 14400000), ("5d", 432000000), ("6w", 3628800000),
+        ("7n", 18144000000), ("8y", 252288000000),
+    ]
+
+    @pytest.mark.parametrize("text,want", DURATIONS,
+                             ids=[c[0] for c in DURATIONS])
+    def test_durations(self, text, want):
+        assert dt.parse_duration_ms(text) == want
+
+    @pytest.mark.parametrize("bad", ["1S", "bad", "-5s", "", "5",
+                                     "ms", "1.5h"])
+    def test_bad_durations(self, bad):
+        with pytest.raises(ValueError):
+            dt.parse_duration_ms(bad)

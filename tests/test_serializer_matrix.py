@@ -1,0 +1,198 @@
+"""HTTP JSON serializer formatting matrix — the analogue of
+``TestHttpJsonSerializer.java`` plus the native-formatter
+equivalence contract (bytes from the C++ dps formatter must parse to
+the identical JSON values as the pure-Python fallback).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.engine import QueryResult
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+
+BASE_MS = 1356998400000
+
+
+def _tsq(**top):
+    return TSQuery.from_json({
+        "start": BASE_MS, "end": BASE_MS + 3_600_000,
+        "queries": [{"metric": "m", "aggregator": "sum"}], **top
+    }).validate()
+
+
+def _result(ts, vals, tags=None, agg_tags=None, **kw):
+    ts = np.asarray(ts, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float64)
+    return QueryResult("m", tags or {}, agg_tags or [],
+                       dps_arrays=(ts, vals), **kw)
+
+
+class TestFormatQuery:
+    def test_basic_map_form(self):
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS, BASE_MS + 60_000], [1.0, 2.5],
+                    tags={"host": "a"})
+        out = json.loads(ser.format_query(_tsq(), [r]))
+        assert out == [{"metric": "m", "tags": {"host": "a"},
+                        "aggregateTags": [],
+                        "dps": {"1356998400": 1, "1356998460": 2.5}}]
+
+    def test_arrays_form(self):
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS], [3.0])
+        out = json.loads(ser.format_query(_tsq(), [r],
+                                          as_arrays=True))
+        assert out[0]["dps"] == [[1356998400, 3]]
+
+    def test_ms_resolution_keys(self):
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS + 500], [1.0])
+        out = json.loads(ser.format_query(_tsq(msResolution=True),
+                                          [r]))
+        assert out[0]["dps"] == {"1356998400500": 1}
+
+    def test_seconds_collapse_last_wins(self):
+        """ms points flooring to one second collapse, LAST wins —
+        identically on the native and python paths."""
+        ser = HttpJsonSerializer()
+        ts = [BASE_MS + 100, BASE_MS + 900] + \
+            [BASE_MS + 60_000 + i for i in range(20)]
+        vals = [1.0, 2.0] + [float(i) for i in range(20)]
+        out = json.loads(ser.format_query(_tsq(), [_result(ts, vals)]))
+        dps = out[0]["dps"]
+        assert dps["1356998400"] == 2          # last of the pair
+        assert dps["1356998460"] == 19         # last of the run
+
+    def test_nan_and_infinity_literals(self):
+        """(ref: the reference emits NaN/Infinity literals)"""
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS, BASE_MS + 1000, BASE_MS + 2000],
+                    [float("nan"), float("inf"), float("-inf")])
+        body = ser.format_query(_tsq(), [r]).decode()
+        assert "NaN" in body and "Infinity" in body \
+            and "-Infinity" in body
+
+    def test_show_query_echo(self):
+        """(ref: formatQueryAsyncV1wQuery)"""
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS], [1.0])
+        out = json.loads(ser.format_query(_tsq(showQuery=True), [r]))
+        assert out[0]["query"]["metric"] == "m"
+
+    def test_stats_summary_variants(self):
+        """(ref: formatQueryAsyncV1wStatsSummary / woSummary /
+        woStatsWSummary)"""
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS], [1.0])
+        stats = {"totalTime": 5.0}
+        both = json.loads(ser.format_query(
+            _tsq(), [r], show_summary=True, show_stats=True,
+            summary_extra=stats))
+        assert both[0]["stats"] == stats
+        assert both[-1] == {"statsSummary": stats}
+        only_stats = json.loads(ser.format_query(
+            _tsq(), [r], show_stats=True, summary_extra=stats))
+        assert only_stats[0]["stats"] == stats
+        assert all("statsSummary" not in x for x in only_stats)
+        only_summary = json.loads(ser.format_query(
+            _tsq(), [r], show_summary=True, summary_extra=stats))
+        assert "stats" not in only_summary[0]
+        assert only_summary[-1] == {"statsSummary": stats}
+
+    def test_empty_dps(self):
+        """(ref: formatQueryAsyncV1EmptyDPs)"""
+        ser = HttpJsonSerializer()
+        r = QueryResult("m", {}, [])
+        out = json.loads(ser.format_query(_tsq(), [r]))
+        assert out[0]["dps"] == {}
+
+    def test_empty_results(self):
+        ser = HttpJsonSerializer()
+        assert ser.format_query(_tsq(), []) == b"[]"
+
+    def test_tsuids_included(self):
+        ser = HttpJsonSerializer()
+        r = _result([BASE_MS], [1.0])
+        r.tsuids = ["000001000001000001"]
+        out = json.loads(ser.format_query(_tsq(), [r]))
+        assert out[0]["tsuids"] == ["000001000001000001"]
+
+
+class TestNativePythonEquivalence:
+    """The native C++ formatter and the python fallback must produce
+    byte streams that parse to IDENTICAL values (text may differ in
+    exponent style — a documented, accepted divergence)."""
+
+    @pytest.mark.parametrize("as_arrays", [False, True],
+                             ids=["map", "arrays"])
+    @pytest.mark.parametrize("ms", [False, True],
+                             ids=["sec", "ms"])
+    def test_parse_identical(self, as_arrays, ms):
+        ser = HttpJsonSerializer()
+        rng = np.random.default_rng(5)
+        n = 400
+        ts = BASE_MS + np.arange(n, dtype=np.int64) * 1500
+        vals = np.concatenate([
+            rng.normal(0, 1e6, n - 6),
+            [0.0, -0.0, 1e-300, 1e300, 42.0, float("nan")]])
+        tsq = _tsq(msResolution=ms)
+        native = json.loads(ser.format_query(
+            tsq, [_result(ts, vals)], as_arrays=as_arrays))
+        # force the python path by hiding the columnar twin
+        r_py = QueryResult(
+            "m", {}, [],
+            dps=list(zip(ts.tolist(), vals.tolist())))
+        python = json.loads(ser.format_query(
+            tsq, [r_py], as_arrays=as_arrays))
+
+        def norm(d):
+            if as_arrays:
+                return [(t, None if isinstance(v, float)
+                         and math.isnan(v) else v)
+                        for t, v in d[0]["dps"]]
+            return {t: (None if isinstance(v, float) and math.isnan(v)
+                        else v) for t, v in d[0]["dps"].items()}
+        assert norm(native) == norm(python)
+
+    def test_stream_equals_format(self):
+        """stream_query chunks concatenate to format_query's bytes."""
+        ser = HttpJsonSerializer()
+        ts = BASE_MS + np.arange(100, dtype=np.int64) * 1000
+        vals = np.arange(100, dtype=np.float64) * 1.5
+        r = _result(ts, vals, tags={"host": "x"})
+        tsq = _tsq()
+        whole = ser.format_query(tsq, [r])
+        streamed = b"".join(ser.stream_query(tsq, [r]))
+        assert streamed == whole
+
+
+class TestErrorsAndNegotiation:
+    def test_format_error_shape(self):
+        ser = HttpJsonSerializer()
+        out = json.loads(ser.format_error(400, "bad", "details"))
+        assert out["error"]["code"] == 400
+        assert out["error"]["message"] == "bad"
+
+    @pytest.mark.parametrize("body,ok", [
+        (b"[]", True), (b"{}", True),  # object = single-dp form
+        (b"", False), (b"not json", False), (b"[{}]", True),
+        (b"42", False), (b'"str"', False)])
+    def test_parse_put_bodies(self, body, ok):
+        ser = HttpJsonSerializer()
+        if ok:
+            assert isinstance(ser.parse_put(body), list)
+        else:
+            with pytest.raises(ValueError):
+                ser.parse_put(body)
+
+    def test_parse_put_single_object(self):
+        ser = HttpJsonSerializer()
+        out = ser.parse_put(b'{"metric":"m","timestamp":1,'
+                            b'"value":2,"tags":{}}')
+        assert isinstance(out, list) and len(out) == 1

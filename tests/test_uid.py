@@ -112,3 +112,115 @@ class TestUidRegistry:
         assert reg.by_kind("tagv") is reg.tag_values
         with pytest.raises(ValueError):
             reg.by_kind("bogus")
+
+
+class TestUidReferenceMatrix:
+    """The remaining TestUniqueId.java scenario classes, table-driven
+    (ctor validation, codec edges, filter/race/overflow behavior)."""
+
+    def test_ctor_validation(self):
+        # (ref: testCtorZeroWidth/NegativeWidth/EmptyKind/LargeWidth)
+        with pytest.raises(ValueError):
+            UniqueId("metric", 0)
+        with pytest.raises(ValueError):
+            UniqueId("metric", -1)
+        with pytest.raises(ValueError):
+            UniqueId("metric", 9)
+        with pytest.raises(ValueError):
+            UniqueId("", 3)
+
+    def test_kind_and_width_accessors(self):
+        u = UniqueId("tagk", 3)
+        assert u.kind == "tagk" and u.width == 3
+
+    def test_uid_bytes_roundtrip_edges(self):
+        # (ref: uidToString/uidToString255/uidToStringZeros)
+        u = UniqueId("metric", 3)
+        for v in (0, 1, 255, 256, 65535, 2 ** 24 - 1):
+            b = u.int_to_uid(v)
+            assert len(b) == 3
+            assert u.uid_to_int(b) == v
+        assert u.int_to_uid(0) == b"\x00\x00\x00"
+        assert u.int_to_uid(2 ** 24 - 1) == b"\xff\xff\xff"
+
+    def test_uid_wrong_length_rejected(self):
+        # (ref: stringToUidWidth/stringToUidWidth2)
+        u = UniqueId("metric", 3)
+        with pytest.raises(ValueError):
+            u.uid_to_int(b"\x00")
+        with pytest.raises(ValueError):
+            u.uid_to_int(b"\x00\x00\x00\x00")
+
+    def test_get_name_nonexistent(self):
+        # (ref: getNameForNonexistentId)
+        u = UniqueId("metric", 3)
+        with pytest.raises(LookupError):
+            u.get_name(12345)
+
+    def test_get_id_nonexistent(self):
+        # (ref: getIdForNonexistentName)
+        u = UniqueId("metric", 3)
+        with pytest.raises(LookupError):
+            u.get_id("nosuch")
+
+    def test_get_or_create_idempotent(self):
+        # (ref: getOrCreateIdWithExistingId)
+        u = UniqueId("metric", 3)
+        a = u.get_or_create_id("m")
+        assert u.get_or_create_id("m") == a
+        assert u.max_id() == a
+
+    def test_overflow_exhaustion(self):
+        # (ref: getOrCreateIdWithOverflow) width-1 space has 255 ids
+        u = UniqueId("metric", 1)
+        for i in range(255):
+            u.get_or_create_id(f"m{i}")
+        with pytest.raises(FailedToAssignUniqueIdError):
+            u.get_or_create_id("one-too-many")
+
+    def test_random_collision_retries(self):
+        # (ref: getOrCreateIdRandomCollision) small space forces
+        # collisions; every id must still be unique
+        u = UniqueId("metric", 1, random_ids=True)
+        ids = {u.get_or_create_id(f"m{i}") for i in range(100)}
+        assert len(ids) == 100
+
+    def test_suggest_no_match_and_matches(self):
+        # (ref: suggestWithNoMatch/suggestWithMatches)
+        u = UniqueId("metric", 3)
+        for n in ("sys.cpu.user", "sys.cpu.system", "net.bytes"):
+            u.get_or_create_id(n)
+        assert u.suggest("zz") == []
+        assert u.suggest("sys.cpu") == ["sys.cpu.system",
+                                        "sys.cpu.user"]
+        assert u.suggest("", max_results=2) == ["net.bytes",
+                                                "sys.cpu.system"]
+
+    def test_rename_collision_rejected(self):
+        # (ref: renameIdTakenName analogue)
+        u = UniqueId("metric", 3)
+        u.get_or_create_id("a")
+        u.get_or_create_id("b")
+        with pytest.raises(FailedToAssignUniqueIdError):
+            u.rename("a", "b")
+
+    def test_rename_missing_rejected(self):
+        u = UniqueId("metric", 3)
+        with pytest.raises(LookupError):
+            u.rename("ghost", "x")
+
+    def test_tsuid_tagk_sort_order(self):
+        # (ref: TSUID layout: metric + sorted (tagk, tagv) pairs)
+        from opentsdb_tpu.core.uid import UidRegistry
+        reg = UidRegistry()
+        m = reg.metrics.get_or_create_id("m")
+        k1 = reg.tag_names.get_or_create_id("zz")
+        k2 = reg.tag_names.get_or_create_id("aa")
+        v = reg.tag_values.get_or_create_id("x")
+        t = reg.tsuid(m, [(k1, v), (k2, v)])
+        # k2 ("aa", assigned second => id 2) sorts by tagk ID
+        assert t == (reg.metrics.int_to_uid(m)
+                     + reg.tag_names.int_to_uid(min(k1, k2))
+                     + reg.tag_values.int_to_uid(v)
+                     + reg.tag_names.int_to_uid(max(k1, k2))
+                     + reg.tag_values.int_to_uid(v))

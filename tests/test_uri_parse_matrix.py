@@ -1,0 +1,225 @@
+"""URI ``m=`` sub-query grammar matrix — the analogue of
+``TestQueryRpc.java``'s parseQueryMType* scenarios (28 parse cases)
+and ``TestPutRpc.java``'s value-form matrix (scientific notation,
+precision, sign, malformed), table-driven against the real parsers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.model import (BadRequestError, TSSubQuery,
+                                      parse_uri_query,
+                                      parse_uri_subquery)
+
+BASE = 1356998400
+
+
+def _parse(m: str) -> TSSubQuery:
+    """Parse + validate, like the HTTP path does (aggregator and
+    downsample resolution happen at validate; ref: TSSubQuery
+    .validateAndSetQuery)."""
+    sub = parse_uri_subquery(m)
+    sub.validate()
+    return sub
+
+
+class TestMTypeGrammar:
+    """(ref: TestQueryRpc.parseQueryMType*)"""
+
+    def test_plain(self):
+        sub = _parse("sum:sys.cpu.0")
+        assert sub.aggregator == "sum" and sub.metric == "sys.cpu.0"
+        assert not sub.rate and not sub.downsample
+
+    def test_with_rate(self):
+        sub = _parse("sum:rate:sys.cpu.0")
+        assert sub.rate and not sub.rate_options.counter
+
+    def test_with_ds(self):
+        sub = _parse("sum:1h-avg:sys.cpu.0")
+        assert sub.downsample == "1h-avg"
+        assert sub.ds_spec.interval_ms == 3600_000
+        assert sub.ds_spec.function == "avg"
+
+    def test_with_ds_and_fill(self):
+        sub = _parse("sum:1h-avg-nan:sys.cpu.0")
+        assert sub.ds_spec.fill_policy.value == "nan"
+
+    def test_rate_and_ds_either_order(self):
+        a = _parse("sum:rate:1h-avg:sys.cpu.0")
+        b = _parse("sum:1h-avg:rate:sys.cpu.0")
+        for sub in (a, b):
+            assert sub.rate and sub.downsample == "1h-avg"
+
+    def test_with_tag(self):
+        sub = _parse("sum:sys.cpu.0{host=web01}")
+        assert len(sub.filters) == 1
+        f = sub.filters[0]
+        assert f.tagk == "host" and not f.group_by is None
+
+    def test_groupby_regex(self):
+        sub = _parse("sum:sys.cpu.0{host=regexp(web[0-9]+)}")
+        (f,) = sub.filters
+        assert type(f).__name__.lower().startswith("tagvregex")
+        assert f.group_by
+
+    def test_groupby_wildcard_explicit(self):
+        sub = _parse("sum:sys.cpu.0{host=wildcard(web*)}")
+        (f,) = sub.filters
+        assert f.group_by
+
+    def test_groupby_wildcard_implicit(self):
+        sub = _parse("sum:sys.cpu.0{host=web*}")
+        (f,) = sub.filters
+        assert f.group_by
+
+    def test_filter_brackets_non_grouping(self):
+        """The second {} block filters WITHOUT grouping
+        (ref: parseQueryMTypeWWildcardFilterExplicit)."""
+        sub = _parse("sum:sys.cpu.0{}{host=wildcard(web*)}")
+        (f,) = sub.filters
+        assert not f.group_by
+
+    def test_groupby_and_filter_same_tagk(self):
+        sub = _parse(
+            "sum:sys.cpu.0{host=web01}{host=wildcard(web*)}")
+        assert len(sub.filters) == 2
+        gb = [f for f in sub.filters if f.group_by]
+        ngb = [f for f in sub.filters if not f.group_by]
+        assert len(gb) == 1 and len(ngb) == 1
+
+    def test_empty_filter_brackets_ok(self):
+        sub = _parse("sum:sys.cpu.0{}{}")
+        assert sub.filters == []
+
+    @pytest.mark.parametrize("bad", [
+        "sum:sys.cpu.0{host=web01",          # missing close
+        "sum:sys.cpu.0{host}",               # missing equals
+        "sum:sys.cpu.0{host=nosuchfn(x)}",   # unknown filter fn
+        "nosuchagg:sys.cpu.0",               # unknown aggregator
+        "sum:nosuchds-avg:rate:sys.cpu.0",   # bad ds interval
+        "",                                  # empty
+        "sum:",                              # no metric
+    ])
+    def test_malformed_rejected(self, bad):
+        with pytest.raises((BadRequestError, ValueError)):
+            _parse(bad)
+
+    def test_explicit_variants(self):
+        """(ref: parseQueryMTypeWExplicitAndRateAndDS) rate options +
+        downsample + counter in one spec."""
+        sub = _parse("sum:rate{counter,16,2}:1m-sum:sys.cpu.0")
+        assert sub.rate and sub.rate_options.counter
+        assert sub.rate_options.counter_max == 16
+        assert sub.rate_options.reset_value == 2
+        assert sub.downsample == "1m-sum"
+
+    def test_rate_counter_empty_max(self):
+        """rate{counter,,20}: empty max keeps the default
+        (ref: RateOptions.parse)."""
+        sub = _parse("sum:rate{counter,,20}:sys.cpu.0")
+        assert sub.rate_options.counter
+        assert sub.rate_options.counter_max == float(2 ** 64 - 1)
+        assert sub.rate_options.reset_value == 20
+
+    def test_dropcounter(self):
+        sub = _parse("sum:rate{dropcounter}:sys.cpu.0")
+        assert sub.rate_options.counter
+        assert sub.rate_options.drop_resets
+
+
+class TestFullUriQuery:
+    """(ref: parseQuery* top-level forms)"""
+
+    def test_m_and_window(self):
+        tsq = parse_uri_query({"start": ["1h-ago"],
+                               "m": ["sum:sys.cpu.0"]})
+        assert len(tsq.queries) == 1
+
+    def test_two_m(self):
+        tsq = parse_uri_query({"start": ["1h-ago"],
+                               "m": ["sum:a.b", "max:c.d"]})
+        assert [q.metric for q in tsq.queries] == ["a.b", "c.d"]
+
+    def test_tsuids_form(self):
+        tsq = parse_uri_query({"start": ["1h-ago"],
+                               "tsuids": ["sum:000001000001000001"]})
+        assert tsq.queries[0].tsuids == ["000001000001000001"]
+
+    def test_tsuids_multi(self):
+        tsq = parse_uri_query({
+            "start": ["1h-ago"],
+            "tsuids": ["sum:000001000001000001,000002000002000002"]})
+        assert len(tsq.queries[0].tsuids) == 2
+
+    def test_start_missing_400(self):
+        with pytest.raises((BadRequestError, ValueError)):
+            parse_uri_query({"m": ["sum:a.b"]}).validate()
+
+    def test_no_subquery_400(self):
+        with pytest.raises((BadRequestError, ValueError)):
+            parse_uri_query({"start": ["1h-ago"]}).validate()
+
+
+class TestPutValueForms:
+    """(ref: TestPutRpc.put* value matrix) through the real telnet/
+    HTTP parse + storage round trip."""
+
+    @pytest.fixture()
+    def tsdb(self):
+        from opentsdb_tpu import TSDB, Config
+        return TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+
+    VALUES = [
+        ("42", 42.0), ("-42", -42.0),
+        ("4242424242424242", 4242424242424242.0),
+        ("42.5", 42.5), ("-42.5", -42.5),
+        ("4.2e1", 42.0), ("4.2E1", 42.0),        # SE big
+        ("-4.2e1", -42.0), ("-4.2E1", -42.0),
+        ("4.2e-2", 0.042), ("4.2E-2", 0.042),    # SE tiny
+        ("-4.2e-2", -0.042), ("-4.2E-2", -0.042),
+        ("0.00000013", 1.3e-7),
+        ("-0.00000013", -1.3e-7),
+    ]
+
+    @pytest.mark.parametrize("text,want", VALUES,
+                             ids=[v[0] for v in VALUES])
+    def test_telnet_value_forms(self, tsdb, text, want):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        out = TelnetRouter(tsdb).execute(
+            f"put pv.m {BASE} {text} host=a")
+        assert out == "", out  # silent success (reference semantics)
+        r = tsdb.execute_query(_q("pv.m"))
+        assert r[0].dps[0][1] == pytest.approx(want, rel=1e-9)
+
+    @pytest.mark.parametrize("bad", ["notanumber", "4..2", "NaN2",
+                                     "--5", "0x12"])
+    def test_telnet_bad_values(self, tsdb, bad):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        out = TelnetRouter(tsdb).execute(
+            f"put pv.m {BASE} {bad} host=a")
+        assert out.startswith("put:"), out
+
+    def test_put_missing_args(self, tsdb):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        assert TelnetRouter(tsdb).execute("put").startswith("put:")
+
+    def test_put_bad_timestamp(self, tsdb):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        out = TelnetRouter(tsdb).execute("put pv.m -5 1 host=a")
+        assert out.startswith("put:")
+
+    def test_put_no_tags(self, tsdb):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        out = TelnetRouter(tsdb).execute(f"put pv.m {BASE} 1")
+        assert out.startswith("put:")
+
+
+def _q(metric):
+    from opentsdb_tpu.query.model import TSQuery
+    return TSQuery.from_json({
+        "start": BASE * 1000, "end": (BASE + 60) * 1000,
+        "queries": [{"metric": metric, "aggregator": "sum"}]
+    }).validate()
