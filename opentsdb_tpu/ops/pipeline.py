@@ -600,18 +600,18 @@ def prepare_auto(padded, bucket_idx2d: np.ndarray, spec: PipelineSpec,
     if k is not None and spec.ds_function in _DENSE_FNS:
         return PreparedBatch(
             "dense",
-            (put(jnp.asarray(_pad_rows(values2d, s_pad, np.nan),
-                             dtype=dtype)),),
+            (put(as_operand(_pad_rows(values2d, s_pad, np.nan),
+                            dtype)),),
             k, pad=(s_pad, b))
     cells = s_pad * values2d.shape[1] * spec.num_buckets
     if ds_mod.padded_supported(spec.ds_function, spec.num_buckets) \
             and cells <= _PADDED_EINSUM_MAX_CELLS:
         return PreparedBatch(
             "padded",
-            (put(jnp.asarray(_pad_rows(values2d, s_pad, np.nan),
-                             dtype=dtype)),
-             put(jnp.asarray(_pad_rows(bucket_idx2d, s_pad, -1),
-                             dtype=jnp.int32))),
+            (put(as_operand(_pad_rows(values2d, s_pad, np.nan),
+                            dtype)),
+             put(as_operand(_pad_rows(bucket_idx2d, s_pad, -1),
+                            np.int32))),
             pad=(s_pad, b))
     values, series_idx, bucket_idx = flatten_padded(
         values2d, bucket_idx2d, counts)
@@ -638,8 +638,8 @@ def prepare_flat(values: np.ndarray, series_idx: np.ndarray,
         values2d = np.asarray(values).reshape(spec.num_series, -1)
         return PreparedBatch(
             "dense",
-            (put(jnp.asarray(_pad_rows(values2d, s_pad, np.nan),
-                             dtype=dtype)),),
+            (put(as_operand(_pad_rows(values2d, s_pad, np.nan),
+                            dtype)),),
             k, pad=(s_pad, b))
     n = len(values)
     s_pad = shapes.shape_bucket(s + 1)
@@ -652,8 +652,8 @@ def prepare_flat(values: np.ndarray, series_idx: np.ndarray,
     bi = np.full(n_pad, b_pad - 1, dtype=np.int32)
     bi[:n] = bucket_idx
     return PreparedBatch(
-        "flat", (put(jnp.asarray(v, dtype=dtype)),
-                 put(jnp.asarray(si)), put(jnp.asarray(bi))),
+        "flat", (put(as_operand(v, dtype)),
+                 put(si), put(bi)),
         pad=(s_pad, b_pad))
 
 

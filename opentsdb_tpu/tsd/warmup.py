@@ -130,12 +130,13 @@ def run_warmup(tsdb) -> int:
 
     Returns the number of programs compiled.
     """
+    import jax
+
     from opentsdb_tpu.ops import shapes
     from opentsdb_tpu.ops.pipeline import (PipelineSpec,
                                            run_pipeline_avg_div,
                                            run_pipeline_grid,
                                            pipeline_dtype)
-    import jax.numpy as jnp
 
     dtype = pipeline_dtype()
     pct = tsdb.config.get_bool("tsd.tpu.warmup.percentiles", True)
@@ -195,7 +196,6 @@ def run_warmup(tsdb) -> int:
             # real queries hit. Arrays are built as numpy and
             # device_put once (mirroring pipeline.as_operand: eager
             # jnp allocation would round-trip the default device)
-            import jax
             from opentsdb_tpu.query.engine import host_tail_for_dims
             # placement is aggregator-class dependent (linear aggs get
             # the larger segment-reduction budget) — warm each class on
@@ -244,14 +244,23 @@ def run_warmup(tsdb) -> int:
             try:
                 if mesh is None:
                     is_pct = spec.agg_name.startswith("p")
-                    run_pipeline_grid(grid_pct if is_pct else grid,
-                                      has_pct if is_pct else has,
-                                      bts, gids, rp, fv, spec)
+                    out = run_pipeline_grid(
+                        grid_pct if is_pct else grid,
+                        has_pct if is_pct else has,
+                        bts, gids, rp, fv, spec)
                 else:
                     from opentsdb_tpu.parallel.sharded_pipeline import \
                         run_sharded_grid
-                    run_sharded_grid(mesh, spec, (*args, dgids),
-                                     s_loc, b_loc, spec.num_groups)
+                    out = run_sharded_grid(mesh, spec, (*args, dgids),
+                                           s_loc, b_loc,
+                                           spec.num_groups)
+                # BLOCK per program: jit dispatch is async, and ~100
+                # unawaited device executions queue up on the (possibly
+                # tunneled) device — the first REAL query then stalls
+                # minutes draining them (measured: config-2 cold was
+                # ~200 s after warmup vs 5.7 s without). Blocking also
+                # makes the wall budget see true compile+run cost.
+                jax.block_until_ready(out)
                 compiled += 1
             except Exception:  # noqa: BLE001  pragma: no cover
                 log.exception("warmup compile failed for "
@@ -265,7 +274,6 @@ def run_warmup(tsdb) -> int:
         # host-tail placement uses group factor 1) and the
         # avg-rollup-division tail
         try:
-            import jax
             from opentsdb_tpu.query.engine import host_tail_for_dims
             dev_raw = host_tail_for_dims(tsdb.config, s, b, g_raw,
                                          emit_raw=True,
@@ -274,11 +282,11 @@ def run_warmup(tsdb) -> int:
                                     num_groups=g, ds_function="avg",
                                     agg_name="sum", emit_raw=True,
                                     host=dev_raw is not None)
-            run_pipeline_grid(
+            jax.block_until_ready(run_pipeline_grid(
                 jax.device_put(np.zeros((s, b), dtype), device=dev_raw),
                 jax.device_put(np.zeros((s, b), dtype=bool),
                                device=dev_raw),
-                bts, gids, rp, fv, spec_raw)
+                bts, gids, rp, fv, spec_raw))
             compiled += 1
             if warm_avgdiv:
                 for agg in ("sum", "avg"):
@@ -286,8 +294,8 @@ def run_warmup(tsdb) -> int:
                         num_series=s, num_buckets=b, num_groups=g,
                         ds_function="avg", agg_name=agg,
                         host=dev_lin is not None)
-                    run_pipeline_avg_div(grid, grid, bts, gids, rp,
-                                         fv, spec_div)
+                    jax.block_until_ready(run_pipeline_avg_div(
+                        grid, grid, bts, gids, rp, fv, spec_div))
                     compiled += 1
         except Exception:  # noqa: BLE001  pragma: no cover
             log.exception("warmup extras failed for (%d, %d, %d)",
