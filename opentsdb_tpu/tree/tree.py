@@ -79,17 +79,22 @@ class TreeRule:
             "displayFormat": self.display_format,
         }
 
+    def _source_value(self, metric: str, tags: dict[str, str],
+                      custom: dict[str, str]) -> str | None:
+        """The raw value this rule reads, before regex/split."""
+        if self.type == "METRIC":
+            return metric
+        if self.type == "TAGK":
+            return tags.get(self.field)
+        if self.type in ("METRIC_CUSTOM", "TAGK_CUSTOM",
+                         "TAGV_CUSTOM"):
+            return custom.get(self.custom_field)
+        return None
+
     def extract(self, metric: str, tags: dict[str, str],
                 custom: dict[str, str]) -> list[str] | None:
         """Branch name(s) this rule produces for a series, or None."""
-        value: str | None = None
-        if self.type == "METRIC":
-            value = metric
-        elif self.type == "TAGK":
-            value = tags.get(self.field)
-        elif self.type in ("METRIC_CUSTOM", "TAGK_CUSTOM",
-                           "TAGV_CUSTOM"):
-            value = custom.get(self.custom_field)
+        value = self._source_value(metric, tags, custom)
         if not value:
             return None
         if self._compiled is not None:
@@ -104,6 +109,49 @@ class TreeRule:
             parts = [p for p in value.split(self.separator) if p]
             return parts or None
         return [value]
+
+    def format_name(self, original: str, extracted: str,
+                    tsuid: str) -> str:
+        """Branch display name via the rule's display formatter
+        (ref: TreeBuilder.setCurrentName): ``{ovalue}`` = the value
+        before regex/split, ``{value}`` = the extracted token,
+        ``{tsuid}`` = the series id, ``{tag_name}`` = the rule's
+        field (TAGK) or custom field (*_CUSTOM; blanked for other
+        types, matching the reference's warning path)."""
+        fmt = self.display_format
+        if not fmt:
+            return extracted
+        out = fmt.replace("{ovalue}", original) \
+                 .replace("{value}", extracted) \
+                 .replace("{tsuid}", tsuid)
+        if "{tag_name}" in out:
+            if self.type == "TAGK":
+                out = out.replace("{tag_name}", self.field)
+            elif self.type in ("METRIC_CUSTOM", "TAGK_CUSTOM",
+                               "TAGV_CUSTOM"):
+                out = out.replace("{tag_name}", self.custom_field)
+            else:
+                out = out.replace("{tag_name}", "")
+        return out
+
+    def extract_named(self, metric: str, tags: dict[str, str],
+                      custom: dict[str, str], tsuid: str
+                      ) -> list[str] | None:
+        """:meth:`extract` with the display formatter applied per
+        token. ``{ovalue}`` is the whole pre-split value, mirroring
+        the reference's processSplit -> setCurrentName flow."""
+        original = self._source_value(metric, tags, custom)
+        parts = self.extract(metric, tags, custom)
+        if parts is None:
+            return None
+        named = [self.format_name(original or "", p, tsuid)
+                 for p in parts]
+        # a formatter can blank a name (e.g. {tag_name} on a METRIC
+        # rule); empty branch names are dropped like extract() drops
+        # empty split tokens, and an all-empty result is no match so
+        # later-order fallback rules still get their turn
+        named = [n for n in named if n]
+        return named or None
 
 
 @dataclass
@@ -242,22 +290,30 @@ class TreeBuilder:
         """Returns the branch path, or None when unmatched."""
         custom = custom or {}
         path: list[str] = []
-        matched_any = False
+        missed_levels = False
         for level in sorted(self.tree.rules):
             parts = None
             for order in sorted(self.tree.rules[level]):
                 rule = self.tree.rules[level][order]
-                parts = rule.extract(metric, tags, custom)
+                parts = rule.extract_named(metric, tags, custom,
+                                           tsuid)
                 if parts:
                     break
             if parts:
-                matched_any = True
                 path.extend(parts)
+            else:
+                missed_levels = True
         if not path:
             if self.tree.store_failures:
                 self.tree.not_matched[tsuid] = "no rules matched"
             return None
-        if self.tree.strict_match and not matched_any:
+        if self.tree.strict_match and missed_levels:
+            # strict mode requires EVERY rule level to contribute
+            # (ref: TreeBuilder strict_match — a series missing any
+            # level is not filed)
+            if self.tree.store_failures:
+                self.tree.not_matched[tsuid] = \
+                    "strict match: not all rule levels matched"
             return None
         # build branches
         node = self.tree.root

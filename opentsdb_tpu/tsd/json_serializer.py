@@ -84,6 +84,26 @@ class HttpJsonSerializer(HttpSerializer):
 
     def _result_head(self, ts_query, r: QueryResult) -> bytes:
         """Everything before "dps", serialized — ends with ``b'}'``."""
+        if not (ts_query.show_query or r.tsuids
+                or (not ts_query.no_annotations and r.annotations)
+                or (ts_query.global_annotations
+                    and r.global_annotations)):
+            # fast path for the common head: metric/tag names pass
+            # tags.validate_string (alnum + "-_./"), so no JSON
+            # escaping can ever be needed — a wildcard group-by
+            # response has thousands of heads and json.dumps per head
+            # was ~1/3 of serialization time. Expression aliases can
+            # carry arbitrary text, so anything needing escapes falls
+            # back to json.dumps.
+            strings = [r.metric, *r.tags.keys(), *r.tags.values(),
+                       *r.aggregated_tags]
+            if all('"' not in s and "\\" not in s and s.isprintable()
+                   for s in strings):
+                tags = ",".join(f'"{k}":"{v}"'
+                                for k, v in r.tags.items())
+                aggs = ",".join(f'"{a}"' for a in r.aggregated_tags)
+                return (f'{{"metric":"{r.metric}","tags":{{{tags}}},'
+                        f'"aggregateTags":[{aggs}]}}').encode()
         obj: dict[str, Any] = {
             "metric": r.metric,
             "tags": r.tags,

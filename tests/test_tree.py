@@ -260,3 +260,165 @@ class TestTreeManager:
         assert out[tsuid]["valid"] is True
         assert out[tsuid]["branch"] == ["sys.cpu.user"]
         assert out["DEADBEEF0000"]["valid"] is False
+
+
+class TestDisplayFormatter:
+    """(ref: TestTreeBuilder.processTimeseriesMetaFormat* — the
+    TreeRule display formatter: {ovalue}/{value}/{tsuid}/{tag_name})"""
+
+    def _tree_with_rule(self, **rule_kw):
+        t = Tree(1, "t")
+        r = TreeRule(**{"type": "TAGK", "field": "host", "level": 0,
+                        "order": 0, **rule_kw})
+        t.rules.setdefault(r.level, {})[r.order] = r
+        return t, r
+
+    def _process(self, t, tsuid="0101"):
+        return TreeBuilder(t).process(
+            tsuid, "sys.cpu.user", {"host": "web01.lga.mysite.com"},
+            {"owner": "ops"})
+
+    def test_format_value(self):
+        t, _ = self._tree_with_rule(display_format="name: {value}")
+        path = self._process(t)
+        assert path == ["name: web01.lga.mysite.com"]
+
+    def test_format_ovalue_vs_value_with_split(self):
+        t, _ = self._tree_with_rule(separator=".",
+                                    display_format="{value}@{ovalue}")
+        path = self._process(t)
+        assert path[0] == "web01@web01.lga.mysite.com"
+        assert path[1] == "lga@web01.lga.mysite.com"
+
+    def test_format_tsuid(self):
+        t, _ = self._tree_with_rule(display_format="{tsuid}")
+        assert self._process(t, tsuid="0A0B") == ["0A0B"]
+
+    def test_format_tag_name_tagk(self):
+        t, _ = self._tree_with_rule(display_format="{tag_name}={value}")
+        assert self._process(t) == ["host=web01.lga.mysite.com"]
+
+    def test_format_tag_name_custom(self):
+        t = Tree(1, "t")
+        r = TreeRule(type="TAGK_CUSTOM", custom_field="owner",
+                     level=0, order=0,
+                     display_format="{tag_name}:{value}")
+        t.rules.setdefault(0, {})[0] = r
+        path = TreeBuilder(t).process("01", "m", {"host": "h"},
+                                      {"owner": "ops"})
+        assert path == ["owner:ops"]
+
+    def test_format_tag_name_wrong_type_blanked(self):
+        """(ref: setCurrentName blanks {tag_name} for METRIC rules
+        with a warning)"""
+        t = Tree(1, "t")
+        r = TreeRule(type="METRIC", level=0, order=0,
+                     display_format="pre{tag_name}post")
+        t.rules.setdefault(0, {})[0] = r
+        path = TreeBuilder(t).process("01", "m", {}, {})
+        assert path == ["prepost"]
+
+    def test_format_multi_tokens(self):
+        t, _ = self._tree_with_rule(
+            display_format="{tag_name} | {value} | {tsuid}")
+        assert self._process(t, tsuid="FF") == \
+            ["host | web01.lga.mysite.com | FF"]
+
+    def test_empty_format_uses_extracted(self):
+        t, _ = self._tree_with_rule(display_format="")
+        assert self._process(t) == ["web01.lga.mysite.com"]
+
+    def test_format_with_regex_extraction(self):
+        t = Tree(1, "t")
+        r = TreeRule(type="TAGK", field="host", level=0, order=0,
+                     regex=r"^(\w+)\.", display_format="dc:{value}")
+        t.rules.setdefault(0, {})[0] = r
+        assert self._process(t) == ["dc:web01"]
+
+    def test_format_survives_json_round_trip(self):
+        t, r = self._tree_with_rule(display_format="x{value}")
+        r2 = TreeRule.from_json(r.to_json())
+        assert r2.display_format == "x{value}"
+
+
+class TestStrictAndTestingModes:
+    """(ref: processTimeseriesMetaStrict / MetaTesting)"""
+
+    def _tree(self, strict=False, levels=2):
+        t = Tree(1, "t")
+        t.strict_match = strict
+        t.rules.setdefault(0, {})[0] = TreeRule(
+            type="TAGK", field="dc", level=0, order=0)
+        t.rules.setdefault(1, {})[0] = TreeRule(
+            type="METRIC", level=1, order=0)
+        return t
+
+    def test_non_strict_files_partial_match(self):
+        t = self._tree(strict=False)
+        # no "dc" tag: level 0 misses, metric level still matches
+        path = TreeBuilder(t).process("01", "sys.m", {"host": "h"}, {})
+        assert path == ["sys.m"]
+
+    def test_levels_all_match(self):
+        t = self._tree()
+        path = TreeBuilder(t).process(
+            "01", "sys.m", {"dc": "lga", "host": "h"}, {})
+        assert path == ["lga", "sys.m"]
+
+    def test_custom_rule_empty_value_skipped(self):
+        """(ref: processTimeseriesMetaTagkCustomEmptyValue)"""
+        t = Tree(1, "t")
+        t.rules.setdefault(0, {})[0] = TreeRule(
+            type="TAGK_CUSTOM", custom_field="owner", level=0, order=0)
+        t.rules.setdefault(1, {})[0] = TreeRule(
+            type="METRIC", level=1, order=0)
+        path = TreeBuilder(t).process("01", "m", {}, {"owner": ""})
+        assert path == ["m"]
+
+    def test_second_order_rule_tried_on_miss(self):
+        """(ref: rule ORDER within a level: first match wins, later
+        orders are fallbacks)"""
+        t = Tree(1, "t")
+        t.rules.setdefault(0, {})[0] = TreeRule(
+            type="TAGK", field="nope", level=0, order=0)
+        t.rules.setdefault(0, {})[1] = TreeRule(
+            type="TAGK", field="host", level=0, order=1)
+        path = TreeBuilder(t).process("01", "m", {"host": "web"}, {})
+        assert path == ["web"]
+
+
+class TestStrictMatchEnforced:
+    """strict_match requires EVERY rule level to contribute
+    (ref: processTimeseriesMetaStrict / StrictNoMatch)."""
+
+    def _tree(self, strict):
+        t = Tree(1, "t")
+        t.strict_match = strict
+        t.rules.setdefault(0, {})[0] = TreeRule(
+            type="TAGK", field="dc", level=0, order=0)
+        t.rules.setdefault(1, {})[0] = TreeRule(
+            type="METRIC", level=1, order=0)
+        return t
+
+    def test_strict_partial_match_rejected(self):
+        t = self._tree(strict=True)
+        assert TreeBuilder(t).process(
+            "01", "sys.m", {"host": "h"}, {}) is None
+        assert "01" in t.not_matched
+
+    def test_strict_full_match_filed(self):
+        t = self._tree(strict=True)
+        assert TreeBuilder(t).process(
+            "01", "sys.m", {"dc": "lga"}, {}) == ["lga", "sys.m"]
+
+    def test_blanked_format_is_no_match_and_falls_back(self):
+        """A formatter that blanks every name is no match; the next
+        ORDER rule in the level gets its turn."""
+        t = Tree(1, "t")
+        t.rules.setdefault(0, {})[0] = TreeRule(
+            type="METRIC", level=0, order=0,
+            display_format="{tag_name}")   # blanked for METRIC
+        t.rules.setdefault(0, {})[1] = TreeRule(
+            type="TAGK", field="host", level=0, order=1)
+        path = TreeBuilder(t).process("01", "m", {"host": "web"}, {})
+        assert path == ["web"]
