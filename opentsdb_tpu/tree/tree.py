@@ -121,18 +121,21 @@ class TreeRule:
         fmt = self.display_format
         if not fmt:
             return extracted
-        out = fmt.replace("{ovalue}", original) \
-                 .replace("{value}", extracted) \
-                 .replace("{tsuid}", tsuid)
-        if "{tag_name}" in out:
-            if self.type == "TAGK":
-                out = out.replace("{tag_name}", self.field)
-            elif self.type in ("METRIC_CUSTOM", "TAGK_CUSTOM",
-                               "TAGV_CUSTOM"):
-                out = out.replace("{tag_name}", self.custom_field)
-            else:
-                out = out.replace("{tag_name}", "")
-        return out
+        if self.type == "TAGK":
+            tag_name = self.field
+        elif self.type in ("METRIC_CUSTOM", "TAGK_CUSTOM",
+                           "TAGV_CUSTOM"):
+            tag_name = self.custom_field
+        else:
+            tag_name = ""  # (ref: setCurrentName blanks + warns)
+        # single pass over the FORMAT string: placeholder-looking text
+        # inside substituted DATA (custom meta is arbitrary) must not
+        # be re-substituted
+        subs = {"{ovalue}": original, "{value}": extracted,
+                "{tsuid}": tsuid, "{tag_name}": tag_name}
+        return re.sub(
+            r"\{(?:ovalue|value|tsuid|tag_name)\}",
+            lambda m: subs[m.group(0)], fmt)
 
     def extract_named(self, metric: str, tags: dict[str, str],
                       custom: dict[str, str], tsuid: str

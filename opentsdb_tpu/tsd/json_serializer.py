@@ -97,8 +97,8 @@ class HttpJsonSerializer(HttpSerializer):
             # back to json.dumps.
             strings = [r.metric, *r.tags.keys(), *r.tags.values(),
                        *r.aggregated_tags]
-            if all('"' not in s and "\\" not in s and s.isprintable()
-                   for s in strings):
+            if all(s.isascii() and '"' not in s and "\\" not in s
+                   and s.isprintable() for s in strings):
                 tags = ",".join(f'"{k}":"{v}"'
                                 for k, v in r.tags.items())
                 aggs = ",".join(f'"{a}"' for a in r.aggregated_tags)

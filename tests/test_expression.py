@@ -430,3 +430,125 @@ class TestExpEndpointPojo:
             "POST", "/api/query/exp", {}, {},
             _json.dumps(body).encode()))
         assert resp.status == 400
+
+
+class TestQueryExecutorMatrix:
+    """The remaining TestQueryExecutor.java scenarios: nesting,
+    multi-output ordering, error classes (circular/self reference,
+    unknown metric/variable, empty results)."""
+
+    BASE = 1356998400
+
+    def _router(self, points=True):
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tsd.http_api import HttpRpcRouter
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+        if points:
+            for i in range(4):
+                t.add_point("m.a", self.BASE + i * 60, 10.0,
+                            {"host": "x"})
+                t.add_point("m.b", self.BASE + i * 60, 2.0,
+                            {"host": "x"})
+        else:
+            t.uids.metrics.get_or_create_id("m.a")
+            t.uids.metrics.get_or_create_id("m.b")
+        return t, HttpRpcRouter(t)
+
+    def _body(self, exprs, outputs=None):
+        return {
+            "time": {"start": str(self.BASE),
+                     "end": str(self.BASE + 300),
+                     "aggregator": "sum"},
+            "metrics": [{"id": "A", "metric": "m.a"},
+                        {"id": "B", "metric": "m.b"}],
+            "expressions": exprs,
+            **({"outputs": outputs} if outputs else {}),
+        }
+
+    def _post(self, router, body, expect=200):
+        import json as _json
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        resp = router.handle(HttpRequest(
+            "POST", "/api/query/exp", {}, {},
+            _json.dumps(body).encode()))
+        assert resp.status == expect, (resp.status, resp.body[:200])
+        return _json.loads(resp.body)
+
+    def test_nested_one_level(self):
+        """(ref: nestedExpressionsOneLevelDefaultOutput)"""
+        _, r = self._router()
+        out = self._post(r, self._body([
+            {"id": "e1", "expr": "A + B"},
+            {"id": "e2", "expr": "e1 * 2"}], [{"id": "e2"}]))
+        dps = out["outputs"][0]["dps"]
+        got = [v for _, v in (dps.items() if isinstance(dps, dict)
+                              else dps)]
+        assert all(abs(v - 24.0) < 1e-6 for v in got)
+
+    def test_nested_two_levels_ordering(self):
+        """(ref: nestedExpressionsTwoLevelsDefaultOutputOrdering) —
+        resolution must follow dependencies regardless of declaration
+        order."""
+        _, r = self._router()
+        out = self._post(r, self._body([
+            {"id": "e3", "expr": "e2 + 1"},
+            {"id": "e2", "expr": "e1 * 2"},
+            {"id": "e1", "expr": "A + B"}], [{"id": "e3"}]))
+        dps = out["outputs"][0]["dps"]
+        got = [v for _, v in (dps.items() if isinstance(dps, dict)
+                              else dps)]
+        assert all(abs(v - 25.0) < 1e-6 for v in got)
+
+    def test_multi_expressions_one_output(self):
+        """(ref: multiExpressionsOneOutput) only the requested output
+        is emitted."""
+        _, r = self._router()
+        out = self._post(r, self._body([
+            {"id": "e1", "expr": "A + B"},
+            {"id": "e2", "expr": "A - B"}], [{"id": "e2"}]))
+        assert len(out["outputs"]) == 1
+        assert out["outputs"][0]["id"] == "e2"
+
+    def test_two_expressions_default_output(self):
+        """(ref: twoExpressionsDefaultOutput) no outputs spec = all
+        expressions emitted."""
+        _, r = self._router()
+        out = self._post(r, self._body([
+            {"id": "e1", "expr": "A + B"},
+            {"id": "e2", "expr": "A - B"}]))
+        assert {o["id"] for o in out["outputs"]} == {"e1", "e2"}
+
+    def test_self_reference_rejected(self):
+        """(ref: selfReferencingExpression)"""
+        _, r = self._router()
+        self._post(r, self._body([
+            {"id": "e1", "expr": "e1 + A"}]), expect=400)
+
+    def test_circular_reference_rejected(self):
+        """(ref: circularReferenceExpression)"""
+        _, r = self._router()
+        self._post(r, self._body([
+            {"id": "e1", "expr": "e2 + A"},
+            {"id": "e2", "expr": "e1 + B"}]), expect=400)
+
+    def test_unknown_metric_rejected(self):
+        """(ref: nsunMetric)"""
+        _, r = self._router()
+        body = self._body([{"id": "e1", "expr": "A + B"}])
+        body["metrics"][0]["metric"] = "no.such.metric"
+        self._post(r, body, expect=400)
+
+    def test_empty_result_set(self):
+        """(ref: emptyResultSet) metrics exist but hold no points in
+        the window — clean empty output, not a 500."""
+        _, r = self._router(points=False)
+        out = self._post(r, self._body([
+            {"id": "e1", "expr": "A + B"}]))
+        for o in out["outputs"]:
+            assert o["dps"] in ({}, []) or all(
+                False for _ in o["dps"])
+
+    def test_unknown_variable_rejected(self):
+        _, r = self._router()
+        self._post(r, self._body([
+            {"id": "e1", "expr": "A + NOPE"}]), expect=400)

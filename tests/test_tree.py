@@ -387,18 +387,10 @@ class TestStrictAndTestingModes:
         assert path == ["web"]
 
 
-class TestStrictMatchEnforced:
+class TestStrictMatchEnforced(TestStrictAndTestingModes):
     """strict_match requires EVERY rule level to contribute
-    (ref: processTimeseriesMetaStrict / StrictNoMatch)."""
-
-    def _tree(self, strict):
-        t = Tree(1, "t")
-        t.strict_match = strict
-        t.rules.setdefault(0, {})[0] = TreeRule(
-            type="TAGK", field="dc", level=0, order=0)
-        t.rules.setdefault(1, {})[0] = TreeRule(
-            type="METRIC", level=1, order=0)
-        return t
+    (ref: processTimeseriesMetaStrict / StrictNoMatch). Reuses the
+    two-level dc/METRIC fixture from the base class."""
 
     def test_strict_partial_match_rejected(self):
         t = self._tree(strict=True)
