@@ -486,3 +486,28 @@ class TestTreePersistence:
         assert restored.rules[1][0].field == "host"
         # ids keep advancing past restored trees
         assert mgr2.create_tree("x").tree_id == tree.tree_id + 1
+
+
+class TestCleanCacheCli:
+    """(ref: tools/clean_cache.sh via the tsdb dispatcher)"""
+
+    def test_cleancache_removes_cache_dir(self, tmp_path, capsys):
+        from opentsdb_tpu.tools.cli import cmd_cleancache
+        from opentsdb_tpu.utils.config import Config
+        cache = tmp_path / "qcache"
+        cache.mkdir()
+        (cache / "a.png").write_bytes(b"x")
+        (cache / "b.json").write_bytes(b"y")
+        cfg = Config(**{"tsd.http.cachedir": str(cache)})
+        assert cmd_cleancache(cfg, []) == 0
+        out = capsys.readouterr().out
+        assert "removed 2" in out
+        assert not cache.exists()
+
+    def test_cleancache_missing_dir_ok(self, tmp_path, capsys):
+        from opentsdb_tpu.tools.cli import cmd_cleancache
+        from opentsdb_tpu.utils.config import Config
+        cfg = Config(**{"tsd.http.cachedir":
+                        str(tmp_path / "nothere")})
+        assert cmd_cleancache(cfg, []) == 0
+        assert "no cache" in capsys.readouterr().out

@@ -341,8 +341,8 @@ class TestDisplayFormatter:
         assert r2.display_format == "x{value}"
 
 
-class TestStrictAndTestingModes:
-    """(ref: processTimeseriesMetaStrict / MetaTesting)"""
+class _TwoLevelTreeMixin:
+    """Shared dc/METRIC two-level fixture."""
 
     def _tree(self, strict=False, levels=2):
         t = Tree(1, "t")
@@ -352,6 +352,9 @@ class TestStrictAndTestingModes:
         t.rules.setdefault(1, {})[0] = TreeRule(
             type="METRIC", level=1, order=0)
         return t
+
+class TestStrictAndTestingModes(_TwoLevelTreeMixin):
+    """(ref: processTimeseriesMetaStrict / MetaTesting)"""
 
     def test_non_strict_files_partial_match(self):
         t = self._tree(strict=False)
@@ -387,7 +390,7 @@ class TestStrictAndTestingModes:
         assert path == ["web"]
 
 
-class TestStrictMatchEnforced(TestStrictAndTestingModes):
+class TestStrictMatchEnforced(_TwoLevelTreeMixin):
     """strict_match requires EVERY rule level to contribute
     (ref: processTimeseriesMetaStrict / StrictNoMatch). Reuses the
     two-level dc/METRIC fixture from the base class."""
