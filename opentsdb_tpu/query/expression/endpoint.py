@@ -107,14 +107,27 @@ def handle_exp(router, request):
     start = str(time_spec.get("start", ""))
     end = time_spec.get("end")
     aggregator = time_spec.get("aggregator", "sum")
-    downsampler = time_spec.get("downsampler")
-    ds_spec = None
-    if downsampler:
-        ds_spec = (f"{downsampler.get('interval')}-"
-                   f"{downsampler.get('aggregator', 'avg')}")
+    def _ds_string(downsampler, where: str) -> str | None:
+        """pojo Downsampler object -> "interval-agg[-fill]" string
+        (ref: pojo/Downsampler.java). Strings pass through for the
+        convenience form; anything else is a clean 400."""
+        if not downsampler:
+            return None
+        if isinstance(downsampler, str):
+            return downsampler
+        if not isinstance(downsampler, dict):
+            raise BadRequestError(
+                f"{where} must be an object with "
+                "interval/aggregator (ref: pojo/Downsampler.java)")
+        spec = (f"{downsampler.get('interval')}-"
+                f"{downsampler.get('aggregator', 'avg')}")
         fp = (downsampler.get("fillPolicy") or {}).get("policy")
         if fp:
-            ds_spec += f"-{fp}"
+            spec += f"-{fp}"
+        return spec
+
+    ds_spec = _ds_string(time_spec.get("downsampler"),
+                         "time.downsampler")
 
     # named filter sets (ref: pojo/Filter.java)
     filter_sets: dict[str, list] = {}
@@ -137,7 +150,9 @@ def handle_exp(router, request):
         sub = TSSubQuery.from_json({
             "metric": mspec.get("metric"),
             "aggregator": mspec.get("aggregator") or aggregator,
-            "downsample": mspec.get("downsampler") or ds_spec,
+            "downsample": _ds_string(
+                mspec.get("downsampler"),
+                f"metrics[{mid}].downsampler") or ds_spec,
             "rate": mspec.get("rate", time_rate),
             "rateOptions": (mspec.get("rateOptions")
                             or time_rate_options),

@@ -552,3 +552,128 @@ class TestQueryExecutorMatrix:
         _, r = self._router()
         self._post(r, self._body([
             {"id": "e1", "expr": "A + NOPE"}]), expect=400)
+
+
+class TestExpEndpointOnMesh:
+    """/api/query/exp with the engine on an 8-device mesh must match
+    single-device results (the Salted-twin analogue for the
+    expression DAG: sub-queries run through the sharded engine,
+    expression arithmetic runs host-side on the frames)."""
+
+    BASE = 1356998400
+
+    def _run(self, mesh):
+        import json as _json
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tsd.http_api import HttpRpcRouter, HttpRequest
+        cfg = {"tsd.core.auto_create_metrics": "true"}
+        if mesh:
+            cfg["tsd.query.mesh"] = "series:4,time:2"
+        t = TSDB(Config(**cfg))
+        import numpy as np
+        ts = np.arange(self.BASE, self.BASE + 40 * 60, 60,
+                       dtype=np.int64)
+        rng = np.random.default_rng(11)
+        for i in range(60):
+            t.add_points("m.a", ts, rng.normal(100, 10, len(ts)),
+                         {"host": f"h{i % 5}"})
+            t.add_points("m.b", ts, rng.normal(10, 2, len(ts)),
+                         {"host": f"h{i % 5}"})
+        body = {
+            "time": {"start": str(self.BASE),
+                     "end": str(self.BASE + 2400),
+                     "aggregator": "sum",
+                     "downsampler": {"interval": "5m",
+                                     "aggregator": "avg"}},
+            "filters": [{"id": "f1", "tags": [
+                {"type": "wildcard", "tagk": "host", "filter": "*",
+                 "groupBy": True}]}],
+            "metrics": [
+                {"id": "A", "metric": "m.a", "filter": "f1"},
+                {"id": "B", "metric": "m.b", "filter": "f1"}],
+            "expressions": [
+                {"id": "e1", "expr": "A / B",
+                 "join": {"operator": "intersection"}}],
+        }
+        resp = HttpRpcRouter(t).handle(HttpRequest(
+            "POST", "/api/query/exp", {}, {},
+            _json.dumps(body).encode()))
+        assert resp.status == 200, resp.body[:200]
+        return _json.loads(resp.body)
+
+    @staticmethod
+    def _by_series(out):
+        """{(tags-tuple): {ts: value}} from the exp output format
+        (dps rows = [timestamp, v1, v2, ...], series identities in
+        meta[1:].commonTags) — series order may differ across engine
+        modes."""
+        series = {}
+        metas = out["meta"][1:]
+        for si, m in enumerate(metas):
+            key = tuple(sorted(m["commonTags"].items()))
+            series[key] = {
+                int(row[0]): row[1 + si] for row in out["dps"]}
+        return series
+
+    def test_mesh_matches_single(self):
+        import math
+        single = self._run(mesh=False)
+        mesh = self._run(mesh=True)
+        s_out = {o["id"]: o for o in single["outputs"]}
+        m_out = {o["id"]: o for o in mesh["outputs"]}
+        assert set(s_out) == set(m_out)
+        for oid in s_out:
+            sn = self._by_series(s_out[oid])
+            mn = self._by_series(m_out[oid])
+            assert set(sn) == set(mn)
+            for key in sn:
+                assert set(sn[key]) == set(mn[key]), key
+                for ts, sv in sn[key].items():
+                    mv = mn[key][ts]
+                    s_nan = isinstance(sv, float) and math.isnan(sv)
+                    m_nan = isinstance(mv, float) and math.isnan(mv)
+                    assert s_nan == m_nan, (key, ts, sv, mv)
+                    if not s_nan:
+                        assert abs(sv - mv) <= 1e-4 * max(
+                            1.0, abs(sv)), (oid, key, ts, sv, mv)
+
+
+def _exp_post(body):
+    import json as _json
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRpcRouter, HttpRequest
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    t.add_point("m.a", 1356998410, 1.0, {"host": "x"})
+    return HttpRpcRouter(t).handle(HttpRequest(
+        "POST", "/api/query/exp", {}, {},
+        _json.dumps(body).encode()))
+
+
+def test_downsampler_forms():
+    """time/metric downsampler: POJO object form and the string
+    convenience form both work; other types are a clean 400, never an
+    AttributeError 500 (both the time-level and per-metric fields)."""
+    base = {"time": {"start": "1356998400", "end": "1356999400",
+                     "aggregator": "sum"},
+            "metrics": [{"id": "A", "metric": "m.a"}],
+            "expressions": [{"id": "e1", "expr": "A + 0"}]}
+    import copy
+    ok_obj = copy.deepcopy(base)
+    ok_obj["time"]["downsampler"] = {"interval": "5m",
+                                    "aggregator": "avg"}
+    assert _exp_post(ok_obj).status == 200
+    ok_str = copy.deepcopy(base)
+    ok_str["time"]["downsampler"] = "5m-avg"
+    assert _exp_post(ok_str).status == 200
+    bad = copy.deepcopy(base)
+    bad["time"]["downsampler"] = 300
+    resp = _exp_post(bad)
+    assert resp.status == 400 and b"downsampler" in resp.body
+    per_metric = copy.deepcopy(base)
+    per_metric["metrics"][0]["downsampler"] = {"interval": "5m",
+                                               "aggregator": "max"}
+    assert _exp_post(per_metric).status == 200
+    per_metric_bad = copy.deepcopy(base)
+    per_metric_bad["metrics"][0]["downsampler"] = ["5m-avg"]
+    resp = _exp_post(per_metric_bad)
+    assert resp.status == 400 and b"downsampler" in resp.body
