@@ -41,12 +41,23 @@ class Annotation:
 
     @classmethod
     def from_json(cls, obj: dict[str, Any]) -> "Annotation":
+        def ts(key: str) -> int:
+            v = obj.get(key, 0)
+            if v is None:
+                return 0
+            if isinstance(v, bool) or not isinstance(v, (int, float,
+                                                         str)):
+                # surfaces as a 400 through the router's ValueError
+                # mapping instead of a TypeError 500
+                raise ValueError(f"{key} must be a unix timestamp")
+            return int(v)
+
         return cls(
-            tsuid=obj.get("tsuid", "") or "",
-            start_time=int(obj.get("startTime", 0)),
-            end_time=int(obj.get("endTime", 0)),
-            description=obj.get("description", "") or "",
-            notes=obj.get("notes", "") or "",
+            tsuid=str(obj.get("tsuid", "") or ""),
+            start_time=ts("startTime"),
+            end_time=ts("endTime"),
+            description=str(obj.get("description", "") or ""),
+            notes=str(obj.get("notes", "") or ""),
             custom=obj.get("custom") or {},
         )
 

@@ -100,7 +100,7 @@ def handle_exp(router, request):
     from opentsdb_tpu.tsd.http_api import HttpResponse
     if request.method != "POST":
         raise BadRequestError("/api/query/exp requires POST")
-    obj = json.loads(request.body or b"{}")
+    obj = request.json_object(default={})
     tsdb = router.tsdb
 
     time_spec = obj.get("time") or {}
@@ -121,7 +121,11 @@ def handle_exp(router, request):
                 "interval/aggregator (ref: pojo/Downsampler.java)")
         spec = (f"{downsampler.get('interval')}-"
                 f"{downsampler.get('aggregator', 'avg')}")
-        fp = (downsampler.get("fillPolicy") or {}).get("policy")
+        fp_obj = downsampler.get("fillPolicy") or {}
+        if not isinstance(fp_obj, dict):
+            raise BadRequestError(
+                f"{where}.fillPolicy must be an object")
+        fp = fp_obj.get("policy")
         if fp:
             spec += f"-{fp}"
         return spec
@@ -132,8 +136,15 @@ def handle_exp(router, request):
     # named filter sets (ref: pojo/Filter.java)
     filter_sets: dict[str, list] = {}
     for f in obj.get("filters") or []:
+        if not isinstance(f, dict):
+            raise BadRequestError("each filter must be an object")
+        tags = f.get("tags") or []
+        if not isinstance(tags, list) or not all(
+                isinstance(t, dict) for t in tags):
+            raise BadRequestError(
+                "filter tags must be an array of objects")
         filter_sets[f.get("id", "")] = [
-            filters_mod.build_filter(t) for t in f.get("tags", [])]
+            filters_mod.build_filter(t) for t in tags]
 
     # time-spec rate applies to every metric unless overridden
     time_rate = bool(time_spec.get("rate", False))
@@ -144,6 +155,8 @@ def handle_exp(router, request):
     variables: dict[str, SeriesFrame] = {}
     metric_meta: dict[str, dict] = {}
     for mspec in obj.get("metrics") or []:
+        if not isinstance(mspec, dict):
+            raise BadRequestError("each metric must be an object")
         mid = mspec.get("id")
         if not mid:
             raise BadRequestError("metric missing id")
@@ -188,6 +201,9 @@ def handle_exp(router, request):
             raise BadRequestError(
                 f"unknown join operator {operator!r}")
         fp = spec.get("fillPolicy") or {}
+        if not isinstance(fp, dict):
+            raise BadRequestError(
+                f"expression {eid} fillPolicy must be an object")
         policy = str(fp.get("policy") or "zero").lower()
         if policy in ("nan", "null"):
             fill = float("nan")

@@ -187,8 +187,13 @@ class TSQuery:
     def from_json(cls, obj: dict[str, Any]) -> "TSQuery":
         if not isinstance(obj, dict):
             raise BadRequestError("query must be a JSON object")
+        raw_queries = obj.get("queries") or []
+        if not isinstance(raw_queries, list) or not all(
+                isinstance(q, dict) for q in raw_queries):
+            raise BadRequestError(
+                "queries must be an array of sub-query objects")
         queries = [TSSubQuery.from_json(q, i)
-                   for i, q in enumerate(obj.get("queries") or [])]
+                   for i, q in enumerate(raw_queries)]
         return cls(
             start=str(obj.get("start", "")),
             end=(str(obj["end"]) if obj.get("end") not in (None, "")

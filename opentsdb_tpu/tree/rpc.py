@@ -28,7 +28,7 @@ def handle_tree_request(router, request, rest):
             return HttpResponse(200, json.dumps(
                 [t.to_json() for t in mgr.all_trees()]).encode())
         if request.method in ("POST", "PUT"):
-            obj = json.loads(request.body or b"{}") if request.body else {
+            obj = request.json_object(default={}) if request.body else {
                 k: request.param(k) for k in ("treeId", "name",
                                               "description")
                 if request.has_param(k)}
@@ -46,9 +46,11 @@ def handle_tree_request(router, request, rest):
                 tree.update(obj, overwrite=False)
             return HttpResponse(200, json.dumps(tree.to_json()).encode())
         if request.method == "DELETE":
-            tree_id = int(request.param("treeid", "0") or
-                          json.loads(request.body or b"{}")
-                          .get("treeId", 0))
+            from opentsdb_tpu.tsd.http_api import as_int
+            tree_id = as_int(
+                request.param("treeid")
+                or request.json_object(default={}).get("treeId"),
+                "treeId")
             if not mgr.delete_tree(tree_id,
                                    request.flag("definition")):
                 raise HttpError(404, "Unable to locate tree")
@@ -70,23 +72,35 @@ def handle_tree_request(router, request, rest):
 
     if sub in ("rule", "rules"):
         if request.method in ("POST", "PUT"):
-            objs = json.loads(request.body or b"[]")
-            if isinstance(objs, dict):
-                objs = [objs]
+            # single rule = object body, bulk /rules = array body;
+            # reuse the strict array parse, accepting the single-
+            # object convenience form first
+            if request.body and request.body.strip().startswith(b"{"):
+                objs = [request.json_object()]
+            else:
+                objs = request.json_array(default=[])
+            if not all(isinstance(o, dict) for o in objs):
+                raise HttpError(400, "Each rule must be an object")
             if sub == "rule" and not objs and request.has_param("treeid"):
                 objs = [{k: request.param(k)
                          for k in ("treeid", "type", "field", "level",
                                    "order", "regex", "separator")
                          if request.has_param(k)}]
             out = []
+            from opentsdb_tpu.tsd.http_api import as_int
             for obj in objs:
-                tree_id = int(obj.get("treeId") or obj.get("treeid", 0))
+                # or-chain (not dict-default) so an explicit
+                # treeId: null still falls through to "treeid"
+                tree_id = as_int(obj.get("treeId")
+                                 or obj.get("treeid"), "treeId")
                 tree = mgr.get_tree(tree_id)
                 if tree is None:
                     raise HttpError(404, "Unable to locate tree")
                 rule = TreeRule.from_json(obj)
                 tree.set_rule(rule)
                 out.append(rule.to_json())
+            if not out:
+                raise HttpError(400, "Missing rule content")
             return HttpResponse(200, json.dumps(
                 out if sub == "rules" else out[0]).encode())
         if request.method == "GET" and sub == "rule":
@@ -117,7 +131,7 @@ def handle_tree_request(router, request, rest):
             raise HttpError(404, "Unable to locate tree")
         tsuids = request.params.get("tsuids", [])
         if request.body:
-            tsuids = json.loads(request.body).get("tsuids", tsuids)
+            tsuids = request.json_object().get("tsuids", tsuids)
         results = mgr.test_tsuids(tree, tsuids)
         return HttpResponse(200, json.dumps(results).encode())
 

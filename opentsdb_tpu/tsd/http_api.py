@@ -66,6 +66,47 @@ class HttpRequest:
         v = self.params[key][0]
         return v in ("", "true", "1", "yes")
 
+    def _json_body(self, expected: type, noun: str, default):
+        """Body as JSON of one expected container type; anything else
+        — including valid-JSON scalars like ``null`` or ``42`` that
+        would crash handlers calling ``.get()`` — is a clean 400
+        (ref: the reference wraps every body-parse failure in
+        BadRequestException)."""
+        if not self.body:
+            if default is not None:
+                return default
+            raise BadRequestError("Missing request content")
+        try:
+            obj = json.loads(self.body)
+        except Exception as exc:  # noqa: BLE001
+            raise BadRequestError(
+                f"Unable to parse JSON body: {exc}") from None
+        if not isinstance(obj, expected):
+            raise BadRequestError(
+                f"Request body must be a JSON {noun}, got "
+                f"{type(obj).__name__}")
+        return obj
+
+    def json_object(self, default: dict | None = None) -> dict:
+        return self._json_body(dict, "object", default)
+
+    def json_array(self, default: list | None = None) -> list:
+        return self._json_body(list, "array", default)
+
+
+def as_int(value, name: str, default: int = 0) -> int:
+    """Coerce a JSON/query value to int with a clean 400 — bare
+    ``int()`` raises TypeError on null/list/bool inputs, which the
+    router maps to 500."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        raise BadRequestError(f"{name} must be an integer")
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise BadRequestError(f"{name} must be an integer") from None
+
 
 @dataclass
 class HttpResponse:
@@ -542,9 +583,20 @@ class HttpRpcRouter:
         """(ref: QueryRpc.java:346 /api/query/last via TSUIDQuery)"""
         from opentsdb_tpu.search.lookup import last_data_points
         if request.method == "POST":
-            obj = json.loads(request.body or b"{}")
+            obj = request.json_object(default={})
             specs = obj.get("queries", [])
-            back_scan = int(obj.get("backScan", 0))
+            if not isinstance(specs, list) or not all(
+                    isinstance(q, dict) for q in specs):
+                raise HttpError(
+                    400, "queries must be an array of objects")
+            for q in specs:
+                ts = q.get("tsuids")
+                if ts is not None and (not isinstance(ts, list)
+                                       or not all(isinstance(x, str)
+                                                  for x in ts)):
+                    raise HttpError(
+                        400, "tsuids must be a list of strings")
+            back_scan = as_int(obj.get("backScan"), "backScan")
             resolve = bool(obj.get("resolveNames", False))
         else:
             specs = [{"uri": m} for m in request.params.get(
@@ -558,10 +610,10 @@ class HttpRpcRouter:
     def _handle_suggest(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: SuggestRpc.java:30)"""
         if request.method == "POST":
-            obj = json.loads(request.body or b"{}")
+            obj = request.json_object(default={})
             stype = obj.get("type", "")
             q = obj.get("q", "")
-            max_results = int(obj.get("max", 25))
+            max_results = as_int(obj.get("max"), "max", 25)
         else:
             stype = request.param("type", "")
             q = request.param("q", "") or ""
@@ -583,11 +635,18 @@ class HttpRpcRouter:
         from opentsdb_tpu.search.lookup import time_series_lookup
         if sub == "lookup":
             if request.method == "POST":
-                obj = json.loads(request.body or b"{}")
-                metric = obj.get("metric", "")
+                obj = request.json_object(default={})
+                metric = obj.get("metric") or ""
+                if not isinstance(metric, str):
+                    raise HttpError(400, "metric must be a string")
+                raw_tags = obj.get("tags") or []
+                if not isinstance(raw_tags, list) or not all(
+                        isinstance(t, dict) for t in raw_tags):
+                    raise HttpError(
+                        400, "tags must be a list of {key, value}")
                 tags = [(t.get("key"), t.get("value"))
-                        for t in obj.get("tags", [])]
-                limit = int(obj.get("limit", 25))
+                        for t in raw_tags]
+                limit = as_int(obj.get("limit"), "limit", 25)
                 use_meta = bool(obj.get("useMeta", False))
             else:
                 m = request.param("m", "") or ""
@@ -603,7 +662,7 @@ class HttpRpcRouter:
         if self.tsdb.search_plugin is None:
             raise BadRequestError(
                 "Searching is not enabled on this TSD")
-        obj = json.loads(request.body or b"{}")
+        obj = request.json_object(default={})
         results = self.tsdb.search_plugin.execute_query(sub, obj)
         return HttpResponse(200, request.serializer.format_search(results))
 
@@ -638,7 +697,7 @@ class HttpRpcRouter:
                 raise HttpError(404, "Unable to locate annotation in storage")
             return HttpResponse(200, request.serializer.format_annotation(note))
         if request.method in ("POST", "PUT"):
-            obj = json.loads(request.body or b"{}")
+            obj = request.json_object(default={})
             note = Annotation.from_json(obj)
             note.tsuid = note.tsuid.upper()
             existing = store.get(note.tsuid, note.start_time)
@@ -671,7 +730,10 @@ class HttpRpcRouter:
     def _handle_annotation_bulk(self, request: HttpRequest) -> HttpResponse:
         store = self.tsdb.annotations
         if request.method in ("POST", "PUT"):
-            objs = json.loads(request.body or b"[]")
+            objs = request.json_array(default=[])
+            if not all(isinstance(o, dict) for o in objs):
+                raise HttpError(
+                    400, "Each annotation must be an object")
             notes = []
             for obj in objs:
                 note = Annotation.from_json(obj)
@@ -681,7 +743,7 @@ class HttpRpcRouter:
             return HttpResponse(200,
                                 request.serializer.format_annotations(notes))
         if request.method == "DELETE":
-            obj = json.loads(request.body or b"{}")
+            obj = request.json_object(default={})
             tsuids = obj.get("tsuids")
             if obj.get("global"):
                 tsuids = [""]
@@ -689,8 +751,12 @@ class HttpRpcRouter:
                 # ref: Annotation.deleteRange requires tsuids or global
                 raise HttpError(
                     400, "Please supply either the global flag or tsuids")
-            start = int(obj.get("startTime", 0))
-            end = int(obj.get("endTime") or time.time())
+            if not isinstance(tsuids, list) or not all(
+                    isinstance(t, str) for t in tsuids):
+                raise HttpError(400, "tsuids must be a list of strings")
+            start = as_int(obj.get("startTime"), "startTime")
+            end = as_int(obj.get("endTime"), "endTime",
+                         int(time.time()))
             count = store.delete_range(
                 [t.upper() for t in tsuids], start, end)
             obj["totalDeleted"] = count
@@ -699,9 +765,15 @@ class HttpRpcRouter:
 
     def _handle_annotations(self, request: HttpRequest, rest
                             ) -> HttpResponse:
-        """Global annotation range query (ref: AnnotationRpc)."""
-        start = int(request.param("start_time", "0"))
-        end = int(request.param("end_time") or time.time())
+        """Global annotation range query (ref: AnnotationRpc). Bulk
+        edits live at /api/annotation/bulk; a write-verb here would
+        otherwise silently run the GET range query."""
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed",
+                            "Use /api/annotation/bulk for bulk edits")
+        start = as_int(request.param("start_time"), "start_time")
+        end = as_int(request.param("end_time"), "end_time",
+                     int(time.time()))
         notes = self.tsdb.annotations.global_range(start, end)
         return HttpResponse(200, request.serializer.format_annotations(notes))
 
@@ -722,11 +794,7 @@ class HttpRpcRouter:
 
     def _uid_assign(self, request: HttpRequest) -> HttpResponse:
         if request.method == "POST":
-            obj = json.loads(request.body or b"{}")
-            if not isinstance(obj, dict):
-                raise HttpError(
-                    400, "Expected a JSON object",
-                    '{"metric": [...], "tagk": [...], "tagv": [...]}')
+            obj = request.json_object(default={})
         else:
             obj = {k: (request.param(k) or "").split(",")
                    for k in ("metric", "tagk", "tagv")
@@ -760,6 +828,10 @@ class HttpRpcRouter:
             names = obj.get(kind) or []
             if isinstance(names, str):
                 names = [names]
+            if not isinstance(names, list) or not all(
+                    isinstance(n, str) for n in names):
+                raise HttpError(
+                    400, f"{kind} must be a name or list of names")
             good: dict[str, str] = {}
             bad: dict[str, str] = {}
             registry = self.tsdb.uids.by_kind(kind)
@@ -778,7 +850,7 @@ class HttpRpcRouter:
                             request.serializer.format_uid_assign(response))
 
     def _uid_rename(self, request: HttpRequest) -> HttpResponse:
-        obj = json.loads(request.body or b"{}") \
+        obj = request.json_object(default={}) \
             if request.method == "POST" else \
             {k: request.param(k) for k in ("metric", "tagk", "tagv",
                                            "name")}
@@ -845,10 +917,7 @@ class HttpRpcRouter:
         """Body JSON, or the query-string form of the same fields
         (ref: parseUIDMetaQS / parseTSMetaQS)."""
         if request.body:
-            obj = json.loads(request.body)
-            if not isinstance(obj, dict):
-                raise BadRequestError("Invalid meta content")
-            return obj
+            return request.json_object()
         out = {}
         for key in ("uid", "type", "tsuid", "m", "displayName",
                     "display_name", "description", "notes", "units",

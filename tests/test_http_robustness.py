@@ -1,0 +1,109 @@
+"""HTTP-surface robustness sweep: every route x a battery of junk
+inputs must answer with a STRUCTURED 4xx/2xx — never a 500 and never
+an unhandled exception (ref: BadRequestException discipline across
+``test/tsd/Test*Rpc.java``; RpcHandler turns user errors into 400s).
+
+A 500 is only legitimate for genuine server faults, so any junk input
+that produces one is a bug: the reference's HTTP layer wraps all
+parse/validation failures in BadRequestException.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+BASE = 1356998400
+
+
+@pytest.fixture(scope="module")
+def router():
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                       "tsd.rollups.enable": "true",
+                       "tsd.http.query.allow_delete": "true"}))
+    t.add_point("r.m", BASE + 30, 1.0, {"host": "a"})
+    return HttpRpcRouter(t)
+
+
+ROUTES = ["query", "query/last", "query/exp", "query/gexp", "suggest",
+          "annotation/bulk",
+          "search/lookup", "uid/assign", "uid/uidmeta", "uid/tsmeta",
+          "uid/rename", "annotation", "annotations", "tree",
+          "tree/rule", "tree/branch", "tree/test", "put", "rollup",
+          "histogram", "aggregators", "config", "config/filters",
+          "dropcaches", "serializers", "stats", "stats/query",
+          "stats/jvm", "stats/threads", "stats/region_clients",
+          "version"]
+
+JUNK_BODIES = [
+    b"", b"not json", b"{", b"[1,2,", b"null", b"42", b'"str"',
+    b"[]", b"{}", b'{"a":', b"\x00\x01\x02",
+    # element-shape junk: arrays of scalars, wrong-typed fields
+    b"[1]", b'["x"]', b"[null]", b"[true, {}]",
+    json.dumps({"backScan": None, "max": [], "limit": False,
+                "treeId": True, "tsuids": 5, "queries": "x",
+                "metric": 0, "tags": 3}).encode(),
+    json.dumps({"tsuids": "ABCDEF", "global": 0,
+                "startTime": [], "endTime": {}}).encode(),
+    json.dumps({"metric": 5, "timestamp": "x", "value": {},
+                "tags": 7}).encode(),
+    json.dumps([{"deeply": {"nested": [1, {"junk": None}]}}]).encode(),
+]
+
+JUNK_PARAMS = [
+    {},
+    {"start": ["never-ago"]},
+    {"start": ["1h-ago"], "m": ["sum"]},
+    {"start": ["1h-ago"], "m": ["sum:nosuch.metric{bad"]},
+    {"treeid": ["notanint"]},
+    {"uid": ["ZZZZ"], "type": ["metric"]},
+    {"type": ["nosuchtype"], "q": ["x"]},
+    {"tsuids": ["nothex!"]},
+    {"exp": ["scale(sum:r.m"]},
+    {"serializer": ["nosuch"]},
+    {"max": ["notanint"], "type": ["metrics"], "q": [""]},
+]
+
+ACCEPTABLE = set(range(200, 500)) - {500}
+
+
+@pytest.mark.parametrize("route", ROUTES)
+@pytest.mark.parametrize("method", ["GET", "POST", "DELETE", "PUT"])
+def test_junk_never_500s(router, route, method):
+    for body in (JUNK_BODIES if method in ("POST", "PUT")
+                 else [b""]):
+        for params in JUNK_PARAMS:
+            resp = router.handle(HttpRequest(
+                method, f"/api/{route}", params, {}, body))
+            assert resp.status != 500, (
+                route, method, body[:30], params, resp.body[:200])
+            assert 200 <= resp.status < 500, (route, method,
+                                              resp.status)
+            if resp.status >= 400 and resp.body:
+                # errors are structured (ref: {"error":{code,message}})
+                err = json.loads(resp.body)
+                assert "error" in err, (route, resp.body[:100])
+
+
+def test_unknown_route_404(router):
+    resp = router.handle(HttpRequest("GET", "/api/nosuch", {}, {},
+                                     b""))
+    assert resp.status == 404
+
+
+def test_server_faults_still_500(router, monkeypatch):
+    """A genuine internal fault (not user input) must still surface
+    as a 500 — the sweep above must not be satisfied by swallowing
+    everything."""
+    def boom(*a, **k):
+        raise RuntimeError("internal fault")
+    monkeypatch.setattr(router.tsdb, "execute_query", boom)
+    monkeypatch.setattr(router.tsdb, "new_query", boom)
+    resp = router.handle(HttpRequest(
+        "GET", "/api/query",
+        {"start": ["1h-ago"], "m": ["sum:r.m"]}, {}, b""))
+    assert resp.status == 500
