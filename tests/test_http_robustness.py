@@ -107,3 +107,49 @@ def test_server_faults_still_500(router, monkeypatch):
         "GET", "/api/query",
         {"start": ["1h-ago"], "m": ["sum:r.m"]}, {}, b""))
     assert resp.status == 500
+
+
+class TestTelnetRobustness:
+    """Telnet verb sweep: junk lines answer with an error string (or
+    the documented silent success), never raise out of the router
+    (ref: the telnet RPC error write-back, PutDataPointRpc:158)."""
+
+    @pytest.fixture(scope="class")
+    def tel(self):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                           "tsd.rollups.enable": "true"}))
+        return TelnetRouter(t)
+
+    LINES = [
+        "", " ", "nosuchcmd a b", "put", "put m", "put m ts",
+        "put m 1356998400", "put m 1356998400 1",
+        "put m notatime 1 host=a", "put m 1356998400 xx host=a",
+        "put m 1356998400 1 nothostpair", "put m 1356998400 1 =",
+        "put m 1356998400 1 host=", "put m 1356998400 1 =v",
+        "put \x00\x01 1356998400 1 host=a",
+        "put m -1 1 host=a", "put m 99999999999999999999 1 host=a",
+        "rollup", "rollup 1m", "rollup bad:spec:extra:parts m 1 1 h=a",
+        "rollup 1m:sum m notatime 1 host=a",
+        "histogram", "histogram m", "histogram m 1356998400",
+        "histogram m 1356998400 nothex host=a",
+        "stats extra args here", "version extra",
+        "dropcaches noise", "help unknown",
+    ]
+
+    @pytest.mark.parametrize("line", LINES, ids=[repr(x) for x in LINES])
+    def test_junk_lines_never_raise(self, tel, line):
+        from opentsdb_tpu.tsd.telnet import (TelnetCloseConnection,
+                                             TelnetServerShutdown)
+        try:
+            out = tel.execute(line)
+        except (TelnetCloseConnection, TelnetServerShutdown):
+            return  # exit/diediedie control flow is fine
+        assert isinstance(out, str)
+        words = line.split()
+        if words and words[0] in ("put", "rollup", "histogram") and \
+                len(words) < 5:
+            assert out.startswith(words[0]), (line, out)
+
+    def test_good_put_still_silent(self, tel):
+        assert tel.execute("put t.m 1356998400 1 host=a") == ""
