@@ -153,3 +153,24 @@ class TestTelnetRobustness:
 
     def test_good_put_still_silent(self, tel):
         assert tel.execute("put t.m 1356998400 1 host=a") == ""
+
+
+class TestStaticPathTraversal:
+    """/s must never serve files outside the static root
+    (ref: StaticFileRpc.java staticroot containment)."""
+
+    TRAVERSALS = ["/s/../../../etc/passwd", "/s/..%2f..%2fetc/passwd",
+                  "/s/subdir/../../../../etc/hostname",
+                  "/s//etc/passwd", "/s/%2e%2e/%2e%2e/etc/passwd",
+                  "/s/....//....//etc/passwd"]
+
+    @pytest.mark.parametrize("path", TRAVERSALS)
+    def test_router_rejects(self, router, path):
+        resp = router.handle(HttpRequest("GET", path, {}, {}, b""))
+        assert resp.status == 404
+        assert b"root:" not in (resp.body or b"")
+
+    def test_valid_static_serves(self, router):
+        resp = router.handle(HttpRequest("GET", "/s/index.html", {},
+                                         {}, b""))
+        assert resp.status == 200 and b"<!DOCTYPE html>" in resp.body
