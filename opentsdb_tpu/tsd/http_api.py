@@ -283,8 +283,14 @@ class HttpRpcRouter:
         # /api[/vN]/endpoint/...  (ref: HttpQuery.explodeAPIPath)
         if parts[0] == "api":
             parts = parts[1:]
-            if parts and parts[0].startswith("v") and \
-                    parts[0][1:].isdigit():
+            if parts and re.fullmatch(r"v[0-9]+", parts[0]):
+                # only v1 exists; an unsupported version is a clear
+                # client error (ref: HttpQuery.apiVersion rejects
+                # versions above MAX_API_VERSION=1, HttpQuery.java:67)
+                if int(parts[0][1:]) != 1:
+                    raise HttpError(
+                        400, f"Unsupported API version {parts[0]}",
+                        "This TSD implements API v1")
                 parts = parts[1:]
             if not parts:
                 raise HttpError(400, "Missing API endpoint")

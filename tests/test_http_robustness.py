@@ -174,3 +174,29 @@ class TestStaticPathTraversal:
         resp = router.handle(HttpRequest("GET", "/s/index.html", {},
                                          {}, b""))
         assert resp.status == 200 and b"<!DOCTYPE html>" in resp.body
+
+
+class TestApiVersionNegotiation:
+    """(ref: HttpQuery.apiVersion, MAX_API_VERSION=1 — unknown
+    versions are a 400, not silently treated as v1)."""
+
+    def test_v1_and_unversioned_ok(self, router):
+        for path in ("/api/version", "/api/v1/version"):
+            assert router.handle(HttpRequest("GET", path, {}, {},
+                                             b"")).status == 200
+
+    @pytest.mark.parametrize("ver", ["v2", "v9", "v0", "v999"])
+    def test_unsupported_version_400(self, router, ver):
+        resp = router.handle(HttpRequest(
+            "GET", f"/api/{ver}/version", {}, {}, b""))
+        assert resp.status == 400
+        assert b"API version" in resp.body
+
+    def test_non_ascii_version_digits_not_accepted(self, router):
+        # str.isdigit() is true for non-ASCII digits; the matcher is
+        # ASCII-only so these fall through to (and 404 as) unknown
+        # endpoints rather than parsing as versions
+        for seg in ("v\u00b2", "v\u0661"):
+            resp = router.handle(HttpRequest(
+                "GET", f"/api/{seg}/version", {}, {}, b""))
+            assert resp.status == 404, (seg, resp.status)
