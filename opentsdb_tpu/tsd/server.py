@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import logging
+import re
 import time
 import urllib.parse
 
@@ -39,8 +40,9 @@ def _is_query_path(path: str) -> bool:
     parts = [p for p in path.split("/") if p]
     if parts and parts[0] == "api":
         parts = parts[1:]
-        if parts and len(parts[0]) > 1 and parts[0][0] == "v" \
-                and parts[0][1:].isdigit():
+        # ASCII-only, matching HttpRpcRouter._dispatch's matcher —
+        # the two parses must agree on what counts as a version
+        if parts and re.fullmatch(r"v[0-9]+", parts[0]):
             parts = parts[1:]
     return bool(parts) and parts[0] in ("query", "q")
 
@@ -132,6 +134,65 @@ class TSDServer:
         except asyncio.TimeoutError:
             self.connections.idle_closed += 1
             raise IdleTimeout() from None
+
+    async def _read_chunked(self, reader, buffer: bytes,
+                            max_bytes: int):
+        """Dechunk a Transfer-Encoding: chunked request body
+        (ref: Netty's HttpChunkAggregator behind
+        tsd.http.request_enable_chunked). Returns (body, remainder)
+        or (None, b"") on a malformed/oversized stream (the caller
+        drops the connection — framing is unrecoverable)."""
+        body = bytearray()
+        while True:
+            while b"\r\n" not in buffer:
+                if len(buffer) > 8192:
+                    # a size line is a few hex digits; a stream that
+                    # never sends CRLF is hostile, don't buffer it
+                    return None, b""
+                chunk = await self._on_client(reader.read(65536))
+                if not chunk:
+                    return None, b""
+                buffer += chunk
+            size_line, _, buffer = buffer.partition(b"\r\n")
+            # chunk extensions after ';' are ignored per RFC 9112;
+            # strict ASCII hex only — python's int() leniency
+            # (underscores, signs, unicode digits) is a framing-
+            # disagreement / request-smuggling precondition
+            hex_part = size_line.split(b";")[0].strip()
+            if not re.fullmatch(rb"[0-9A-Fa-f]{1,16}", hex_part):
+                return None, b""
+            size = int(hex_part, 16)
+            if len(body) + size > max_bytes:
+                return None, b""
+            if size == 0:
+                # terminal chunk: consume optional trailer fields up
+                # to the blank line so keep-alive framing stays in
+                # sync (ref: RFC 9112 trailer section)
+                while b"\r\n" not in buffer or not (
+                        buffer.startswith(b"\r\n")
+                        or b"\r\n\r\n" in buffer):
+                    if len(buffer) > 8192:
+                        return None, b""
+                    chunk = await self._on_client(reader.read(65536))
+                    if not chunk:
+                        return None, b""
+                    buffer += chunk
+                if buffer.startswith(b"\r\n"):
+                    buffer = buffer[2:]
+                else:
+                    buffer = buffer.split(b"\r\n\r\n", 1)[1]
+                return bytes(body), bytes(buffer)
+            while len(buffer) < size + 2:  # data + trailing CRLF
+                chunk = await self._on_client(reader.read(65536))
+                if not chunk:
+                    return None, b""
+                buffer += chunk
+            if buffer[size:size + 2] != b"\r\n":
+                # declared size disagrees with actual framing: fail
+                # fast instead of splicing attacker-chosen bytes
+                return None, b""
+            body += buffer[:size]
+            buffer = buffer[size + 2:]
 
     # ------------------------------------------------------------------
 
@@ -290,20 +351,50 @@ class TSDServer:
             for hline in lines[1:]:
                 name, _, val = hline.partition(":")
                 headers[name.strip().lower()] = val.strip()
-            length = int(headers.get("content-length", "0"))
             max_chunk = self.tsdb.config.get_int(
                 "tsd.http.request.max_chunk", 1048576)
-            if length > max_chunk * 64:
-                await self._write_response(
-                    writer, HttpResponse(413, b"content too large"),
-                    "HTTP/1.1", False)
-                return
-            while len(buffer) < length:
-                chunk = await self._on_client(reader.read(65536))
-                if not chunk:
+            te = headers.get("transfer-encoding", "").lower()
+            if "chunked" in te:
+                # (ref: tsd.http.request_enable_chunked — default off,
+                # HttpQuery rejects chunked requests with a 400)
+                if not self.tsdb.config.get_bool(
+                        "tsd.http.request_enable_chunked", False):
+                    await self._write_response(
+                        writer, HttpResponse(
+                            400, b'{"error":{"code":400,"message":'
+                            b'"Chunked request not supported; set '
+                            b'tsd.http.request_enable_chunked"}}'),
+                        "HTTP/1.1", False)
                     return
-                buffer += chunk
-            body, buffer = buffer[:length], buffer[length:]
+                body, buffer = await self._read_chunked(
+                    reader, buffer, max_chunk * 64)
+                if body is None:
+                    return
+            else:
+                cl = headers.get("content-length", "0")
+                if not re.fullmatch(r"[0-9]{1,18}", cl):
+                    cl = None
+                try:
+                    length = int(cl)
+                except (TypeError, ValueError):
+                    await self._write_response(
+                        writer, HttpResponse(
+                            400, b'{"error":{"code":400,"message":'
+                            b'"Invalid Content-Length"}}'),
+                        "HTTP/1.1", False)
+                    return
+                if length > max_chunk * 64 or length < 0:
+                    await self._write_response(
+                        writer,
+                        HttpResponse(413, b"content too large"),
+                        "HTTP/1.1", False)
+                    return
+                while len(buffer) < length:
+                    chunk = await self._on_client(reader.read(65536))
+                    if not chunk:
+                        return
+                    buffer += chunk
+                body, buffer = buffer[:length], buffer[length:]
             parsed = urllib.parse.urlsplit(target)
             params = urllib.parse.parse_qs(parsed.query,
                                            keep_blank_values=True)

@@ -74,6 +74,9 @@ _DEFAULTS: dict[str, str] = {
     # host-RAM prepared-batch cache for host-tail queries (separate
     # pool from device_cache_mb so host entries never evict HBM grids)
     "tsd.query.host_cache_mb": "512",
+    # chunked Transfer-Encoding request bodies (ref: the reference's
+    # tsd.http.request_enable_chunked, default off -> 400)
+    "tsd.http.request_enable_chunked": "false",
     "tsd.query.timeout": "0",
     "tsd.query.allow_simultaneous_duplicates": "true",
     "tsd.query.limits.bytes.default": "0",

@@ -626,3 +626,161 @@ def test_native_dps_formatter_matches_python():
                              else f'"{tt}":{fv}')
             assert got == ",".join(parts).encode(), (seconds,
                                                      as_arrays)
+
+
+class TestChunkedRequests:
+    """Transfer-Encoding: chunked request bodies (ref:
+    tsd.http.request_enable_chunked — default off answers 400;
+    enabled dechunks and processes normally)."""
+
+    def _serve(self, enable: bool):
+        import asyncio
+        import threading
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tsd.server import TSDServer
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.tpu.warmup": "false",
+            "tsd.http.request_enable_chunked":
+                "true" if enable else "false"}))
+        srv = TSDServer(t, host="127.0.0.1", port=0)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        async def run():
+            await srv.start()
+            started.set()
+            while not getattr(srv, "_test_stop", False):
+                await asyncio.sleep(0.02)
+            await srv.stop()
+
+        th = threading.Thread(target=loop.run_until_complete,
+                              args=(run(),), daemon=True)
+        th.start()
+        started.wait(10)
+        port = srv._server.sockets[0].getsockname()[1]
+        return t, srv, loop, th, port
+
+    def _chunked_put(self, port):
+        import socket
+        payload = (b'{"metric":"ch.m","timestamp":1356998400,'
+                   b'"value":7,"tags":{"host":"a"}}')
+        half = len(payload) // 2
+        req = (b"POST /api/put HTTP/1.1\r\n"
+               b"Host: x\r\nTransfer-Encoding: chunked\r\n\r\n"
+               + format(half, "x").encode() + b"\r\n"
+               + payload[:half] + b"\r\n"
+               + format(len(payload) - half, "x").encode() + b"\r\n"
+               + payload[half:] + b"\r\n0\r\n\r\n")
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as sk:
+            sk.sendall(req)
+            sk.settimeout(30)
+            out = b""
+            while b"\r\n\r\n" not in out:
+                out += sk.recv(65536)
+        return out
+
+    def test_disabled_answers_400(self):
+        t, srv, loop, th, port = self._serve(enable=False)
+        try:
+            out = self._chunked_put(port)
+            assert b"400" in out.split(b"\r\n", 1)[0]
+            assert b"Chunked request not supported" in out
+        finally:
+            srv._test_stop = True
+            th.join(10)
+
+    def _raw(self, port, req: bytes, want_statuses):
+        import re as _re
+        import socket
+        import time as _time
+        sk = socket.create_connection(("127.0.0.1", port), timeout=10)
+        sk.sendall(req)
+        out = b""
+        t0 = _time.time()
+        deadline = 20 if want_statuses else 2
+        sk.settimeout(deadline)
+        # for an empty expectation we still LISTEN until the server
+        # closes (or a short grace passes) and assert silence
+        while _time.time() - t0 < deadline:
+            if want_statuses and \
+                    out.count(b"HTTP/1.1") >= len(want_statuses):
+                break
+            try:
+                d = sk.recv(65536)
+            except socket.timeout:
+                break
+            if not d:
+                break
+            out += d
+        sk.close()
+        got = _re.findall(rb"HTTP/1.1 (\d+)", out)
+        assert got == want_statuses, (got, out[:200])
+
+    def test_trailers_keep_framing(self):
+        """Trailer fields after the 0-chunk must be consumed so a
+        pipelined request on the same connection still parses."""
+        t, srv, loop, th, port = self._serve(enable=True)
+        try:
+            payload = (b'{"metric":"ct.m","timestamp":1356998400,'
+                       b'"value":1,"tags":{"host":"a"}}')
+            req = (b"POST /api/put HTTP/1.1\r\nHost: x\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n"
+                   + format(len(payload), "x").encode() + b"\r\n"
+                   + payload + b"\r\n"
+                   b"0\r\nX-Trailer: v\r\n\r\n"
+                   b"GET /api/version HTTP/1.1\r\nHost: x\r\n\r\n")
+            self._raw(port, req, [b"204", b"200"])
+        finally:
+            srv._test_stop = True
+            th.join(10)
+
+    def test_malformed_chunk_framing_drops_connection(self):
+        """A chunk whose data does not end in CRLF (size lie) must
+        fail fast, not splice bytes into the body."""
+        t, srv, loop, th, port = self._serve(enable=True)
+        try:
+            req = (b"POST /api/put HTTP/1.1\r\nHost: x\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n"
+                   b"5\r\nABCDEFG\r\n0\r\n\r\n")
+            self._raw(port, req, [])  # dropped, no response
+        finally:
+            srv._test_stop = True
+            th.join(10)
+
+    def test_nonhex_chunk_size_drops_connection(self):
+        t, srv, loop, th, port = self._serve(enable=True)
+        try:
+            req = (b"POST /api/put HTTP/1.1\r\nHost: x\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n"
+                   b"1_0\r\nx\r\n0\r\n\r\n")
+            self._raw(port, req, [])
+        finally:
+            srv._test_stop = True
+            th.join(10)
+
+    def test_bad_content_length_400(self):
+        t, srv, loop, th, port = self._serve(enable=False)
+        try:
+            req = (b"POST /api/put HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: 1_0\r\n\r\n0123456789")
+            self._raw(port, req, [b"400"])
+        finally:
+            srv._test_stop = True
+            th.join(10)
+
+    def test_enabled_dechunks_and_stores(self):
+        from opentsdb_tpu.query.model import TSQuery
+        t, srv, loop, th, port = self._serve(enable=True)
+        try:
+            out = self._chunked_put(port)
+            assert b"204" in out.split(b"\r\n", 1)[0], out[:200]
+            r = t.execute_query(TSQuery.from_json({
+                "start": 1356998000000, "end": 1356999000000,
+                "queries": [{"metric": "ch.m", "aggregator": "sum"}]
+            }).validate())
+            assert r[0].dps == [(1356998400000, 7.0)]
+        finally:
+            srv._test_stop = True
+            th.join(10)
