@@ -135,6 +135,22 @@ class TSDServer:
             self.connections.idle_closed += 1
             raise IdleTimeout() from None
 
+    async def _refuse(self, reader, writer, response,
+                      version="HTTP/1.1"):
+        """Answer an early protocol error and drain briefly before the
+        connection closes: closing with unread request-body bytes in
+        the kernel buffer sends RST, which can destroy the response
+        in flight (the client then sees a dropped connection instead
+        of the 4xx)."""
+        await self._write_response(writer, response, version, False)
+        try:
+            for _ in range(16):
+                chunk = await asyncio.wait_for(reader.read(65536), 0.2)
+                if not chunk:
+                    break
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+
     async def _read_chunked(self, reader, buffer: bytes,
                             max_bytes: int):
         """Dechunk a Transfer-Encoding: chunked request body
@@ -143,27 +159,31 @@ class TSDServer:
         or (None, b"") on a malformed/oversized stream (the caller
         drops the connection — framing is unrecoverable)."""
         body = bytearray()
+        buffer = bytearray(buffer)  # immutable += is quadratic
         while True:
             while b"\r\n" not in buffer:
                 if len(buffer) > 8192:
                     # a size line is a few hex digits; a stream that
                     # never sends CRLF is hostile, don't buffer it
-                    return None, b""
+                    return None, b"", "framing"
                 chunk = await self._on_client(reader.read(65536))
                 if not chunk:
-                    return None, b""
+                    return None, b"", "framing"
                 buffer += chunk
-            size_line, _, buffer = buffer.partition(b"\r\n")
+            size_line, _, rest = bytes(buffer).partition(b"\r\n")
+            buffer = bytearray(rest)
             # chunk extensions after ';' are ignored per RFC 9112;
             # strict ASCII hex only — python's int() leniency
             # (underscores, signs, unicode digits) is a framing-
             # disagreement / request-smuggling precondition
             hex_part = size_line.split(b";")[0].strip()
             if not re.fullmatch(rb"[0-9A-Fa-f]{1,16}", hex_part):
-                return None, b""
+                return None, b"", "framing"
             size = int(hex_part, 16)
             if len(body) + size > max_bytes:
-                return None, b""
+                # framing is still intact here: the caller can answer
+                # 413 like the Content-Length path does
+                return None, b"", "too_large"
             if size == 0:
                 # terminal chunk: consume optional trailer fields up
                 # to the blank line so keep-alive framing stays in
@@ -172,27 +192,28 @@ class TSDServer:
                         buffer.startswith(b"\r\n")
                         or b"\r\n\r\n" in buffer):
                     if len(buffer) > 8192:
-                        return None, b""
+                        return None, b"", "framing"
                     chunk = await self._on_client(reader.read(65536))
                     if not chunk:
-                        return None, b""
+                        return None, b"", "framing"
                     buffer += chunk
                 if buffer.startswith(b"\r\n"):
-                    buffer = buffer[2:]
+                    del buffer[:2]
                 else:
-                    buffer = buffer.split(b"\r\n\r\n", 1)[1]
-                return bytes(body), bytes(buffer)
+                    buffer = bytearray(
+                        bytes(buffer).split(b"\r\n\r\n", 1)[1])
+                return bytes(body), bytes(buffer), ""
             while len(buffer) < size + 2:  # data + trailing CRLF
                 chunk = await self._on_client(reader.read(65536))
                 if not chunk:
-                    return None, b""
+                    return None, b"", "framing"
                 buffer += chunk
             if buffer[size:size + 2] != b"\r\n":
                 # declared size disagrees with actual framing: fail
                 # fast instead of splicing attacker-chosen bytes
-                return None, b""
+                return None, b"", "framing"
             body += buffer[:size]
-            buffer = buffer[size + 2:]
+            del buffer[:size + 2]
 
     # ------------------------------------------------------------------
 
@@ -353,22 +374,29 @@ class TSDServer:
                 headers[name.strip().lower()] = val.strip()
             max_chunk = self.tsdb.config.get_int(
                 "tsd.http.request.max_chunk", 1048576)
-            te = headers.get("transfer-encoding", "").lower()
-            if "chunked" in te:
+            te_tokens = [t.strip() for t in
+                         headers.get("transfer-encoding", "")
+                         .lower().split(",") if t.strip()]
+            if te_tokens and te_tokens[-1] == "chunked":
                 # (ref: tsd.http.request_enable_chunked — default off,
                 # HttpQuery rejects chunked requests with a 400)
                 if not self.tsdb.config.get_bool(
                         "tsd.http.request_enable_chunked", False):
-                    await self._write_response(
-                        writer, HttpResponse(
+                    await self._refuse(
+                        reader, writer, HttpResponse(
                             400, b'{"error":{"code":400,"message":'
                             b'"Chunked request not supported; set '
-                            b'tsd.http.request_enable_chunked"}}'),
-                        "HTTP/1.1", False)
+                            b'tsd.http.request_enable_chunked"}}'))
                     return
-                body, buffer = await self._read_chunked(
+                body, buffer, err = await self._read_chunked(
                     reader, buffer, max_chunk * 64)
                 if body is None:
+                    if err == "too_large":
+                        # framing intact: answer like the
+                        # Content-Length path instead of a silent drop
+                        await self._refuse(
+                            reader, writer,
+                            HttpResponse(413, b"content too large"))
                     return
             else:
                 cl = headers.get("content-length", "0")
@@ -377,17 +405,15 @@ class TSDServer:
                 try:
                     length = int(cl)
                 except (TypeError, ValueError):
-                    await self._write_response(
-                        writer, HttpResponse(
+                    await self._refuse(
+                        reader, writer, HttpResponse(
                             400, b'{"error":{"code":400,"message":'
-                            b'"Invalid Content-Length"}}'),
-                        "HTTP/1.1", False)
+                            b'"Invalid Content-Length"}}'))
                     return
                 if length > max_chunk * 64 or length < 0:
-                    await self._write_response(
-                        writer,
-                        HttpResponse(413, b"content too large"),
-                        "HTTP/1.1", False)
+                    await self._refuse(
+                        reader, writer,
+                        HttpResponse(413, b"content too large"))
                     return
                 while len(buffer) < length:
                     chunk = await self._on_client(reader.read(65536))
@@ -425,7 +451,8 @@ class TSDServer:
             else:
                 if self.tsdb.authentication is not None:
                     request.auth = auth_state
-                is_query = _is_query_path(parsed.path)
+                is_query = _is_query_path(
+                    urllib.parse.unquote(parsed.path))
                 fut = asyncio.get_event_loop().run_in_executor(
                     self._query_pool if is_query else None,
                     self.http_router.handle, request)
