@@ -657,7 +657,9 @@ class TestChunkedRequests:
         th = threading.Thread(target=loop.run_until_complete,
                               args=(run(),), daemon=True)
         th.start()
-        started.wait(10)
+        # generous: on the loaded 1-core suite host thread scheduling
+        # can starve the server loop well past 10s
+        assert started.wait(60), "server thread failed to start"
         port = srv._server.sockets[0].getsockname()[1]
         return t, srv, loop, th, port
 
