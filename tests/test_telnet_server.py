@@ -664,6 +664,7 @@ class TestChunkedRequests:
         return t, srv, loop, th, port
 
     def _chunked_put(self, port):
+        import re as _re
         import socket
         import time as _time
         payload = (b'{"metric":"ch.m","timestamp":1356998400,'
@@ -690,6 +691,19 @@ class TestChunkedRequests:
                         if not d:
                             break
                         out += d
+                    # headers complete; the body may arrive in later
+                    # segments — honor Content-Length
+                    if b"\r\n\r\n" in out:
+                        head, body = out.split(b"\r\n\r\n", 1)
+                        m = _re.search(rb"content-length:\s*(\d+)",
+                                       head, _re.I)
+                        want = int(m.group(1)) if m else 0
+                        while len(body) < want:
+                            d = sk.recv(65536)
+                            if not d:
+                                break
+                            body += d
+                        out = head + b"\r\n\r\n" + body
                 if out:
                     return out
                 last = AssertionError("connection closed, no data")
