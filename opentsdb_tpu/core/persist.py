@@ -31,6 +31,11 @@ def save_store(tsdb, data_dir: str) -> int:
     covers (captured BEFORE content capture, so a concurrent write can
     only be double-covered — replay duplicates are dedupe-tolerant —
     never lost)."""
+    faults = getattr(tsdb, "faults", None)
+    if faults is not None:
+        # fault-injection point for the snapshot flush path
+        # (tsd.faults.store.flush_*); TSDB.flush retries around this
+        faults.check("store.flush")
     wal = getattr(tsdb, "wal", None)
     wal_seq = wal.last_seq() if wal is not None else 0
     os.makedirs(data_dir, exist_ok=True)

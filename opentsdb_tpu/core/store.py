@@ -291,6 +291,10 @@ class TimeSeriesStore:
     never mutated in place once published).
     """
 
+    # fault-injection hook for the scan path (tsd.faults.store_*);
+    # set by the owning TSDB, None everywhere else
+    fault_injector = None
+
     def __init__(self, num_shards: int | None = None):
         self.instance_id = next(STORE_INSTANCE_IDS)
         self.num_shards = num_shards or const.salt_buckets()
@@ -489,6 +493,8 @@ class TimeSeriesStore:
         fan-out + Span assembly (SaltScanner.java:269) — except the output
         is a flat columnar batch, not a tree of iterators.
         """
+        if self.fault_injector is not None:
+            self.fault_injector.check("store")
         sids = np.asarray(series_ids, dtype=np.int64)
         ts_parts: list[np.ndarray] = []
         val_parts: list[np.ndarray] = []
@@ -525,6 +531,8 @@ class TimeSeriesStore:
                            start_ms: int, end_ms: int) -> PaddedBatch:
         """Row-padded variant of :meth:`materialize` — same per-series
         slice cost, but each series lands in its own row."""
+        if self.fault_injector is not None:
+            self.fault_injector.check("store")
         sids = np.asarray(series_ids, dtype=np.int64)
         slices = [self._series[sid].buffer.slice_range(start_ms, end_ms)
                   for sid in sids]

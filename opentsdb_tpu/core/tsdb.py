@@ -76,9 +76,15 @@ class TSDB:
             tagv_width=self.config.get_int("tsd.storage.uid.width.tagv", 3),
             random_metrics=self.config.get_bool(
                 "tsd.core.uid.random_metrics"))
+        # deterministic fault-injection layer (armed via tsd.faults.*
+        # keys; a no-op dict miss per injection point when disarmed)
+        from opentsdb_tpu.utils.faults import (CircuitBreaker,
+                                               FaultInjector)
+        self.faults = FaultInjector(self.config)
         from opentsdb_tpu.native.store_backend import make_store
         self.store = make_store(self.config,
                                 num_shards=const.salt_buckets())
+        self.store.fault_injector = self.faults
         self.mode = self.config.get_string("tsd.mode", "rw")
         self.auto_metric = self.config.get_bool("tsd.core.auto_create_metrics")
         self.auto_tagk = self.config.get_bool("tsd.core.auto_create_tagks",
@@ -151,6 +157,22 @@ class TSDB:
         self._tagmat_cache: dict = {}
         from opentsdb_tpu.stats.stats import StatsCollectorRegistry
         self.stats = StatsCollectorRegistry()
+        self.stats.register(self.faults)
+        # device-pipeline circuit breaker: repeated accelerator
+        # failures (compile errors, OOM) trip it and queries route to
+        # the host CPU fallback instead of 500ing per request;
+        # tsd.query.breaker.failure_threshold = 0 disables it
+        breaker_threshold = self.config.get_int(
+            "tsd.query.breaker.failure_threshold")
+        if breaker_threshold > 0:
+            self.device_breaker = CircuitBreaker(
+                "device.pipeline",
+                failure_threshold=breaker_threshold,
+                reset_timeout_ms=self.config.get_int(
+                    "tsd.query.breaker.reset_timeout_ms"))
+            self.stats.register(self.device_breaker)
+        else:
+            self.device_breaker = None
         self.datapoints_added = 0
         self.start_time = time.time()
         # durable snapshots (ref-analogue of HBase-backed persistence;
@@ -172,6 +194,7 @@ class TSDB:
             persist.load_store(self, self.data_dir)
             if self.config.get_bool("tsd.storage.wal.enable", True):
                 from opentsdb_tpu.core.wal import WriteAheadLog
+                from opentsdb_tpu.utils.faults import RetryPolicy
                 wal = WriteAheadLog(
                     os.path.join(self.data_dir, "wal"),
                     fsync_mode=self.config.get_string(
@@ -179,7 +202,13 @@ class TSDB:
                     segment_bytes=self.config.get_int(
                         "tsd.storage.wal.segment_mb", 64) << 20,
                     interval_ms=self.config.get_int(
-                        "tsd.storage.wal.fsync_interval_ms", 200))
+                        "tsd.storage.wal.fsync_interval_ms", 200),
+                    faults=self.faults,
+                    retry=RetryPolicy.from_config(
+                        self.config, "tsd.storage.wal.retry"),
+                    resync_ms=self.config.get_int(
+                        "tsd.storage.wal.resync_interval_ms"))
+                self.stats.register(wal)
                 # snapshot-covered sids keep their numbering on load
                 # (histograms WAL by name, not sid — nothing to seed)
                 wal.seed_known("data", self.store.num_series())
@@ -797,7 +826,19 @@ class TSDB:
     def flush(self) -> None:
         if self.data_dir:
             from opentsdb_tpu.core import persist
-            wal_seq = persist.save_store(self, self.data_dir)
+            from opentsdb_tpu.utils.faults import (RetryPolicy,
+                                                   call_with_retries)
+            # a slow/flaky disk under the snapshot directory gets the
+            # same retry-with-backoff discipline as the WAL fsync path
+            wal_seq = call_with_retries(
+                lambda: persist.save_store(self, self.data_dir),
+                RetryPolicy.from_config(self.config,
+                                        "tsd.storage.flush.retry"),
+                retryable=(OSError,),
+                on_retry=lambda attempt, exc: logging.getLogger(
+                    "tsdb").warning(
+                        "snapshot flush failed (attempt %d: %s); "
+                        "retrying", attempt, exc))
             if self.wal is not None:
                 # snapshot covers seq <= wal_seq: those segments are done
                 self.wal.truncate(wal_seq)

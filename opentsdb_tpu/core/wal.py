@@ -39,9 +39,12 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 
 import numpy as np
+
+from opentsdb_tpu.utils.faults import call_with_retries
 
 log = logging.getLogger("wal")
 
@@ -90,7 +93,8 @@ def _unpack_cols(buf: bytes, off: int, n: int):
 class WriteAheadLog:
     def __init__(self, wal_dir: str, fsync_mode: str = "always",
                  segment_bytes: int = 64 << 20,
-                 interval_ms: int = 200):
+                 interval_ms: int = 200, faults=None, retry=None,
+                 resync_ms: int = 1000):
         if fsync_mode not in ("always", "interval", "never"):
             raise ValueError(f"bad wal fsync mode {fsync_mode!r}")
         self.dir = wal_dir
@@ -106,6 +110,30 @@ class WriteAheadLog:
         self._known: set[tuple[str, int]] = set()
         self._closed = False
         self._interval_thread = None
+        # graceful degradation on persistent fsync failure: appends
+        # keep being accepted (availability over durability — loudly:
+        # the flag is exported via /api/health and stats) and a
+        # resync probe retries every resync_ms instead of paying the
+        # full retry ladder on every write
+        self._faults = faults          # FaultInjector or None
+        self._retry = retry            # RetryPolicy or None (= no retry)
+        self._resync_s = max(resync_ms, 0) / 1000.0
+        self.degraded = False
+        self._degraded_until = 0.0
+        # append health is tracked separately from fsync health: an
+        # fsync-only outage must NOT shed appends (the buffered writes
+        # are re-covered by the next successful fsync), while a write
+        # outage must not pay the retry ladder per record
+        self._append_failing = False
+        # a segment was closed (rotation) without a successful fsync:
+        # those records stay non-durable until a snapshot covers them
+        # (truncate clears the flag); surfaced via health
+        self.durability_hole = False
+        self.sync_failures = 0    # fsync retry-ladder exhaustions
+        self.sync_retries = 0     # individual retried fsyncs
+        self.append_failures = 0  # write retry-ladder exhaustions
+        self.append_dropped = 0   # records shed while WAL is offline
+        self.last_sync_error = ""
         if fsync_mode == "interval":
             self._interval_s = interval_ms / 1000.0
             t = threading.Thread(target=self._interval_loop,
@@ -132,22 +160,80 @@ class WriteAheadLog:
     # ---------------- append side ----------------
 
     def _append(self, rtype: int, payload: bytes) -> int:
+        """Frame + write one record. Returns the record's sequence
+        number, or -1 when the record was shed/lost because the WAL
+        write path is degraded (callers whose bookkeeping depends on
+        the record actually being in the log — ``ensure_series`` —
+        must check)."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("WAL is closed")
+            if self._append_failing and \
+                    time.monotonic() < self._degraded_until:
+                # write path offline: shed the record entirely — the
+                # caller's store write already happened and is
+                # acknowledged; durability is what's degraded, and
+                # paying the retry ladder (or re-probing segment open)
+                # per append would turn the disk outage into a
+                # latency outage
+                self.append_dropped += 1
+                return -1
             if self._fh is None or self._written >= self.segment_bytes:
                 if self._fh is not None:
                     # rotation must not lose durability: sync() after
                     # this append only fsyncs the NEW segment, so the
-                    # old one's unsynced tail must hit disk now
-                    os.fsync(self._fh.fileno())
-                    self._fh.close()
-                self._open_segment()
+                    # old one's unsynced tail must hit disk now.
+                    # On a broken disk this degrades (tail may be
+                    # lost on crash — recorded as a durability hole
+                    # until a snapshot covers it) rather than failing
+                    # the write.
+                    if not self._fsync_or_degrade(self._fh,
+                                                  "rotation fsync"):
+                        self.durability_hole = True
+                    try:
+                        self._fh.close()
+                    except OSError as exc:
+                        log.warning("wal segment close failed (%s); "
+                                    "abandoning handle", exc)
+                    self._fh = None
+                try:
+                    self._open_segment()
+                except OSError as exc:
+                    # can't even open a new segment: the write path is
+                    # offline — shed this record, probe again after
+                    # the resync window
+                    self.append_failures += 1
+                    self._append_failing = True
+                    self._note_degraded(exc, "segment open")
+                    return -1
             self._seq += 1
             rec = _HDR.pack(rtype, len(payload), self._seq,
                             zlib.crc32(payload)) + payload
-            self._fh.write(rec)
+
+            def write_rec():
+                if self._faults is not None:
+                    self._faults.check("wal.append")
+                self._fh.write(rec)
+
+            try:
+                call_with_retries(write_rec, self._retry,
+                                  retryable=(OSError,))
+            except OSError as exc:
+                # availability over durability, loudly (the record is
+                # lost from the log; /api/health carries the flag)
+                self.append_failures += 1
+                self._append_failing = True
+                self._note_degraded(exc, "append")
+                return -1
             self._written += len(rec)
+            if self._append_failing:
+                self._append_failing = False
+                log.info("wal append recovered; records are being "
+                         "logged again")
+                if self.fsync_mode == "never":
+                    # no fsync path exists to clear the flag in this
+                    # mode; append health IS the WAL's health
+                    self.degraded = False
             return self._seq
 
     def _append_json(self, rtype: int, doc: dict) -> int:
@@ -160,9 +246,16 @@ class WriteAheadLog:
         key = (kind, sid)
         if key in self._known:
             return
-        self._append_json(T_SERIES, {
+        seq = self._append_json(T_SERIES, {
             "k": kind, "sid": sid, "m": metric,
             "t": sorted(tags.items())})
+        if seq < 0:
+            # record shed/lost (degraded write path): stay un-known so
+            # the mapping is re-attempted before this series' next
+            # point — marking it known would leave durable point
+            # records with no T_SERIES entry, which replay would
+            # misattribute through the identity-sid fallback
+            return
         self._known.add(key)
 
     def seed_known(self, kind: str, num_series: int) -> None:
@@ -209,8 +302,53 @@ class WriteAheadLog:
             return
         self._sync()
 
+    def _note_degraded(self, exc: Exception, context: str) -> None:
+        """Flip (or extend) degraded mode after a retry-ladder
+        exhaustion: acknowledged writes may not be durable until the
+        disk recovers; probes retry every ``resync_ms``."""
+        self.last_sync_error = f"{context}: {type(exc).__name__}: {exc}"
+        if not self.degraded:
+            log.error("wal %s failing persistently (%s); running "
+                      "DEGRADED — acknowledged writes may not be "
+                      "durable until the disk recovers", context, exc)
+        self.degraded = True
+        self._degraded_until = time.monotonic() + self._resync_s
+
+    def _fsync_or_degrade(self, fh, context: str) -> bool:
+        """fsync under the retry ladder; exhaustion degrades (counted,
+        logged, flagged) instead of raising. Returns True when the
+        data is known durable."""
+
+        def do_fsync():
+            if self._faults is not None:
+                self._faults.check("wal.fsync")
+            os.fsync(fh.fileno())
+
+        def on_retry(attempt, exc):
+            self.sync_retries += 1
+            log.warning("wal fsync failed (attempt %d: %s); "
+                        "retrying", attempt, exc)
+
+        try:
+            call_with_retries(do_fsync, self._retry,
+                              retryable=(OSError,), on_retry=on_retry)
+        except ValueError:
+            # segment closed mid-sync by truncate — which fsyncs
+            # before closing, so the target is already durable
+            return True
+        except OSError as exc:
+            self.sync_failures += 1
+            self._note_degraded(exc, context)
+            return False
+        return True
+
     def _sync(self) -> None:
         if self._synced_seq >= self.last_seq():
+            return
+        if self.degraded and time.monotonic() < self._degraded_until:
+            # shed durability work until the next resync probe: paying
+            # the full retry ladder on every write while the disk is
+            # down would turn a durability loss into a latency outage
             return
         with self._sync_lock:
             with self._lock:
@@ -219,15 +357,23 @@ class WriteAheadLog:
             if fh is None or self._synced_seq >= target:
                 # fh None => a concurrent truncate fsync'd + closed the
                 # segment, so everything appended before it is durable
-                self._synced_seq = max(self._synced_seq, target)
+                # — unless a rotation closed a segment WITHOUT a
+                # successful fsync (durability_hole): then the claim
+                # would be a lie; the hole stands until a snapshot
+                # covers it (truncate clears it)
+                if not self.durability_hole:
+                    self._synced_seq = max(self._synced_seq, target)
                 return
-            try:
-                os.fsync(fh.fileno())
-            except ValueError:
-                # segment closed mid-sync by truncate — which fsyncs
-                # before closing, so target is already durable
-                pass
+            if not self._fsync_or_degrade(fh, "fsync"):
+                # records stay buffered in the segment; the next
+                # successful probe re-covers them (one fsync syncs
+                # the whole file)
+                return
             self._synced_seq = target
+            if self.degraded:
+                log.info("wal fsync recovered after %d failure(s); "
+                         "durability restored", self.sync_failures)
+                self.degraded = False
 
     def _interval_loop(self) -> None:
         import time
@@ -244,6 +390,36 @@ class WriteAheadLog:
         with self._lock:
             return self._seq
 
+    def sync_lag(self) -> int:
+        """Appended-but-not-yet-fsynced record count (0 when healthy
+        in ``always`` mode; the group-commit window in ``interval``
+        mode; grows unboundedly while degraded)."""
+        with self._lock:
+            return max(self._seq - self._synced_seq, 0)
+
+    def health_info(self) -> dict:
+        return {
+            "fsync_mode": self.fsync_mode,
+            "last_seq": self.last_seq(),
+            "synced_seq": self._synced_seq,
+            "sync_lag": self.sync_lag(),
+            "degraded": self.degraded,
+            "durability_hole": self.durability_hole,
+            "sync_failures": self.sync_failures,
+            "sync_retries": self.sync_retries,
+            "append_failures": self.append_failures,
+            "append_dropped": self.append_dropped,
+            "last_sync_error": self.last_sync_error,
+        }
+
+    def collect_stats(self, collector) -> None:
+        collector.record("wal.sync_lag", self.sync_lag())
+        collector.record("wal.sync_failures", self.sync_failures)
+        collector.record("wal.sync_retries", self.sync_retries)
+        collector.record("wal.append_failures", self.append_failures)
+        collector.record("wal.append_dropped", self.append_dropped)
+        collector.record("wal.degraded", int(self.degraded))
+
     def truncate(self, upto_seq: int) -> None:
         """Drop segments fully covered by a snapshot that recorded
         ``wal_applied_seq = upto_seq``. The current segment is rotated
@@ -251,12 +427,25 @@ class WriteAheadLog:
         with self._lock:
             if self._fh is not None:
                 # records > upto_seq may live in this segment and must
-                # stay durable across the close (see _sync)
-                os.fsync(self._fh.fileno())
-                self._fh.close()
-                self._fh = None  # reopened on next append
-                self._synced_seq = self._seq
+                # stay durable across the close (see _sync). On a
+                # broken disk the segment stays OPEN and active so
+                # later sync probes can still fsync its tail — closing
+                # it would let _sync's fh-None branch ("closed =>
+                # durably closed") overstate durability forever. The
+                # flush itself still completes: the snapshot that
+                # triggered this truncate IS durable, and segments it
+                # fully covers are safe to unlink either way.
+                if self._fsync_or_degrade(self._fh, "truncate fsync"):
+                    self._fh.close()
+                    self._fh = None  # reopened on next append
+                    self._synced_seq = self._seq
+                    # the snapshot covers every earlier record: any
+                    # rotation-era durability hole is now irrelevant
+                    self.durability_hole = False
+            active = self._fh.name if self._fh is not None else None
             for path in self._segments():
+                if path == active:
+                    continue  # never unlink the live segment
                 last = _segment_last_seq(path)
                 if last is not None and last <= upto_seq:
                     os.unlink(path)
@@ -281,8 +470,10 @@ class WriteAheadLog:
         recovered = 0
         sid_maps: dict[str, dict[int, int]] = {}
         max_seq = applied_seq
-        for path in self._segments():
-            for rtype, seq, payload in _read_segment(path):
+        segments = self._segments()
+        for i, path in enumerate(segments):
+            tail: dict = {}
+            for rtype, seq, payload in _read_segment(path, tail=tail):
                 if seq > max_seq:
                     max_seq = seq
                 if seq <= applied_seq:
@@ -293,10 +484,37 @@ class WriteAheadLog:
                 except Exception:  # noqa: BLE001  pragma: no cover
                     log.exception("wal: failed applying record "
                                   "seq=%d type=%d", seq, rtype)
+            if i == len(segments) - 1:
+                self._truncate_torn_tail(path, tail)
         with self._lock:
             self._seq = max(self._seq, max_seq)
             self._synced_seq = self._seq
         return recovered
+
+    @staticmethod
+    def _truncate_torn_tail(path: str, tail: dict) -> None:
+        """Physically truncate a crash's partial final record off the
+        last segment so the file ends at the last intact record —
+        otherwise the torn bytes linger forever and every future
+        replay re-reports them. Never raises: replay must come up on
+        whatever disk state exists."""
+        if not tail.get("torn"):
+            return
+        good_end = tail.get("good_end", 0)
+        if good_end < len(MAGIC):
+            # bad/partial magic: nothing recoverable to keep; leave
+            # the segment for manual inspection (it is skipped anyway)
+            return
+        try:
+            size = os.path.getsize(path)
+            if good_end < size:
+                os.truncate(path, good_end)
+                log.warning(
+                    "wal: truncated torn tail of %s (%d -> %d bytes)",
+                    path, size, good_end)
+        except OSError:  # pragma: no cover - best-effort repair
+            log.exception("wal: could not truncate torn tail of %s",
+                          path)
 
     def _store_for(self, tsdb, kind: str):
         if kind == "data":
@@ -400,24 +618,43 @@ class WriteAheadLog:
         return 0
 
 
-def _read_segment(path: str):
+def _read_segment(path: str, tail: dict | None = None):
     """Yield (type, seq, payload) until EOF or the first corrupt/torn
-    record (normal after a crash — only the fsynced prefix counts)."""
+    record (normal after a crash — only the fsynced prefix counts).
+
+    When ``tail`` is given it is filled with ``good_end`` (byte offset
+    just past the last intact record) and ``torn`` (True when bytes
+    beyond ``good_end`` exist but don't form a complete valid record)
+    so the caller can repair the file (:meth:`WriteAheadLog.replay`).
+    """
+    if tail is None:
+        tail = {}
+    tail.update(good_end=0, torn=False)
     try:
         with open(path, "rb") as fh:
-            if fh.read(len(MAGIC)) != MAGIC:
+            magic = fh.read(len(MAGIC))
+            if magic != MAGIC:
                 log.warning("wal: %s has bad magic; skipped", path)
+                tail["torn"] = bool(magic)
                 return
+            tail["good_end"] = len(MAGIC)
             while True:
                 hdr = fh.read(_HDR.size)
+                if not hdr:
+                    return
                 if len(hdr) < _HDR.size:
+                    log.warning("wal: partial record header at end of "
+                                "%s; replay stops here", path)
+                    tail["torn"] = True
                     return
                 rtype, plen, seq, crc = _HDR.unpack(hdr)
                 payload = fh.read(plen)
                 if len(payload) < plen or zlib.crc32(payload) != crc:
                     log.warning("wal: torn/corrupt record in %s at "
                                 "seq=%d; replay stops here", path, seq)
+                    tail["torn"] = True
                     return
+                tail["good_end"] += _HDR.size + plen
                 yield rtype, seq, payload
     except OSError:  # pragma: no cover
         log.exception("wal: cannot read %s", path)

@@ -24,6 +24,7 @@ from opentsdb_tpu.core.store import MetricIndex, PaddedBatch, PointBatch
 _SRC = os.path.join(os.path.dirname(__file__), "tsdbstore.cc")
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libtsdbstore.so")
 _lib = None
+_build_error: str | None = None  # negative cache for failed builds
 _lib_lock = threading.Lock()
 
 
@@ -54,11 +55,19 @@ def build_library(force: bool = False) -> str:
 
 
 def load_library():
-    global _lib
+    global _lib, _build_error
     with _lib_lock:
         if _lib is not None:
             return _lib
-        path = build_library()
+        if _build_error is not None:
+            # negative cache: without it every probe re-runs g++ —
+            # seconds per call on a toolchain-less host
+            raise NativeBuildError(_build_error)
+        try:
+            path = build_library()
+        except NativeBuildError as e:
+            _build_error = str(e)
+            raise
         lib = ctypes.CDLL(path)
         lib.tss_create.restype = ctypes.c_void_p
         lib.tss_destroy.argtypes = [ctypes.c_void_p]
@@ -193,6 +202,10 @@ class _NativeSeriesRecord:
 
 class NativeTimeSeriesStore:
     """C++-backed TimeSeriesStore (same duck-typed interface)."""
+
+    # fault-injection hook for the scan path (tsd.faults.store_*);
+    # set by the owning TSDB, None everywhere else
+    fault_injector = None
 
     def __init__(self, num_shards: int | None = None,
                  materialize_threads: int | None = None):
@@ -347,6 +360,8 @@ class NativeTimeSeriesStore:
 
     def materialize(self, series_ids: Sequence[int], start_ms: int,
                     end_ms: int) -> PointBatch:
+        if self.fault_injector is not None:
+            self.fault_injector.check("store")
         sids = np.ascontiguousarray(series_ids, dtype=np.int64)
         counts = np.empty(len(sids), dtype=np.int64)
         rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
@@ -424,6 +439,8 @@ class NativeTimeSeriesStore:
         """Row-padded materialize: reuses ``tss_fill_range`` by passing
         per-row offsets ``i * Pmax`` — each series' contiguous run lands
         in its own row of the padded buffers, no extra pass."""
+        if self.fault_injector is not None:
+            self.fault_injector.check("store")
         sids = np.ascontiguousarray(series_ids, dtype=np.int64)
         counts = np.empty(len(sids), dtype=np.int64)
         rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
@@ -468,6 +485,8 @@ class NativeTimeSeriesStore:
         the device then starts at the grid stage of the pipeline
         instead of receiving every point (SURVEY §7: HBM bandwidth is
         the bottleneck; don't ship what the host can pre-reduce 60x)."""
+        if self.fault_injector is not None:
+            self.fault_injector.check("store")
         sids = np.ascontiguousarray(series_ids, dtype=np.int64)
         s = len(sids)
         sums = np.empty((s, nbuckets), dtype=np.float64)

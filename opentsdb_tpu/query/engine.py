@@ -20,7 +20,9 @@ Compiles one validated :class:`TSQuery` into the array pipeline:
 
 from __future__ import annotations
 
+import logging
 import time
+from dataclasses import replace
 from typing import Any, Sequence
 
 import numpy as np
@@ -35,8 +37,12 @@ from opentsdb_tpu.ops.pipeline import (PipelineSpec, execute,
                                        execute_auto, execute_avg_divide,
                                        flatten_padded)
 from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.query.limits import QueryLimitExceeded
 from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
 from opentsdb_tpu.stats.stats import QueryStat, QueryStats
+from opentsdb_tpu.utils.faults import DegradedError
+
+LOG = logging.getLogger("query.engine")
 
 
 class QueryResult:
@@ -331,6 +337,102 @@ class QueryEngine:
         self._filter_eval = filters_mod.FilterEvaluator(tsdb.uids)
 
     # ------------------------------------------------------------------
+    # graceful degradation: the device circuit breaker
+    # ------------------------------------------------------------------
+
+    def _device_degraded(self) -> bool:
+        """True while the device-pipeline breaker is OPEN (inside its
+        reset window): tails must not dispatch to the accelerator.
+        Read-only (``blocking``) — the half-open probe transition
+        belongs to :meth:`_run_device`'s dispatch gate alone."""
+        breaker = self.tsdb.device_breaker
+        return breaker is not None and breaker.blocking()
+
+    @staticmethod
+    def _host_cpu():
+        """The committed host CPU device every degraded fallback pins
+        to (one definition so the fallback discipline cannot drift
+        between the point/grid/avg paths)."""
+        import jax
+        return jax.devices("cpu")[0]
+
+    def _tail_device(self, s: int, b: int, num_groups: int,
+                     emit_raw: bool, agg_name: str):
+        """:func:`host_tail_for_dims` + the degraded override: an OPEN
+        breaker pins the tail to the host CPU backend (the
+        always-available in-process compute path — the analogue of the
+        reference answering straight from the JVM heap)."""
+        if self._device_degraded():
+            if not self.tsdb.config.get_bool(
+                    "tsd.query.degraded.host_fallback", True):
+                raise DegradedError(
+                    "device pipeline circuit breaker is open and "
+                    "host fallback is disabled "
+                    "(tsd.query.degraded.host_fallback)")
+            try:
+                return self._host_cpu()
+            except RuntimeError:  # pragma: no cover - no cpu backend
+                return None
+        return host_tail_for_dims(self.tsdb.config, s, b, num_groups,
+                                  emit_raw, agg_name)
+
+    def _run_device(self, compute, host_retry=None,
+                    on_device: bool = True):
+        """Run a pipeline tail under the device circuit breaker.
+
+        ``compute`` is the already-placed dispatch; accelerator
+        failures count toward ``tsd.query.breaker.*`` and — when a
+        ``host_retry`` twin exists — the query is re-answered on the
+        host CPU backend instead of surfacing a 500. ``on_device=False``
+        (tail already pinned to the host) bypasses the breaker
+        entirely: a host success says nothing about accelerator
+        health, so it must not close an open breaker.
+
+        Failure classification is deliberately coarse: any exception
+        from the dispatch (including prepare/cache code, which can
+        fail for data-shaped reasons) counts toward the breaker. A
+        repeatable non-device error can therefore trip it spuriously —
+        the half-open probe bounds that cost to one reset window, and
+        the fallback answer is still correct (same kernels, host
+        placement)."""
+        if not on_device:
+            return compute()
+        faults = getattr(self.tsdb, "faults", None)
+        breaker = self.tsdb.device_breaker
+        if breaker is not None and not breaker.allow():
+            # OPEN breaker: never touch the failing device. Paths
+            # whose placement happens up front (_tail_device) don't
+            # reach here; this guards the mesh/blocked/cache-hit
+            # dispatches, which otherwise would hammer the device for
+            # the whole reset window.
+            if host_retry is not None and self.tsdb.config.get_bool(
+                    "tsd.query.degraded.host_fallback", True):
+                breaker.fallbacks += 1
+                return host_retry()
+            raise DegradedError(
+                "device pipeline circuit breaker is open and this "
+                "query has no host fallback")
+        try:
+            if faults is not None:
+                faults.check("device.compile")
+            out = compute()
+        except Exception as exc:  # noqa: BLE001
+            if breaker is not None:
+                breaker.record_failure()
+            if host_retry is None or not self.tsdb.config.get_bool(
+                    "tsd.query.degraded.host_fallback", True):
+                raise
+            LOG.warning("device pipeline failed (%s: %s); answering "
+                        "on the host CPU backend",
+                        type(exc).__name__, exc)
+            if breaker is not None:
+                breaker.fallbacks += 1
+            return host_retry()
+        if breaker is not None:
+            breaker.record_success()
+        return out
+
+    # ------------------------------------------------------------------
 
     def run(self, ts_query: TSQuery,
             stats: QueryStats | None = None) -> list[QueryResult]:
@@ -465,61 +567,33 @@ class QueryEngine:
                     acls)
             pver = (store.points_written,
                     getattr(store, "mutation_epoch", 0))
-            hit = prep_cache.get(pkey, pver)
+            # degraded (breaker open): skip the DEVICE pool — a hit
+            # would re-dispatch to the failing accelerator; host-pool
+            # hits below remain valid
+            hit = None if self._device_degraded() \
+                else prep_cache.get(pkey, pver)
             if hit is None:
                 # host-tail twin: same key space, host-RAM pool
                 hcache = self.tsdb.host_prep_cache
                 if hcache is not None:
                     hit = hcache.get(pkey, pver)
             if hit is not None:
-                cached_args, pmeta = hit
-                bucket_ts = pmeta["bucket_ts"]
-                num_points = pmeta["num_points"]
-                ds_function = pmeta["ds_function"]
-                fill_policy = pmeta["fill_policy"]
-                fill_value = pmeta["fill_value"]
-                if stats:
-                    stats.add_stat(QueryStat.DPS_POST_FILTER,
-                                   num_points)
-                self.tsdb.query_limits.check(metric_name, num_points)
-                if tsq.delete and hasattr(store, "delete_range"):
-                    store.delete_range(sids, tsq.start_ms, tsq.end_ms)
-                t2 = time.monotonic()
-                spec = PipelineSpec(
-                    num_series=len(sids), num_buckets=len(bucket_ts),
-                    num_groups=num_groups, ds_function=ds_function,
-                    agg_name=sub.agg.name, fill_policy=fill_policy,
-                    fill_value=fill_value, rate=sub.rate,
-                    rate_counter=sub.rate_options.counter,
-                    rate_drop_resets=sub.rate_options.drop_resets,
-                    emit_raw=emit_raw,
-                    host=pmeta.get("host", False),
-                    complete=pmeta.get("complete", False)
-                    and not (sub.rate
-                             and sub.rate_options.drop_resets))
-                if mesh is not None:
-                    # HBM-resident pre-sharded batch: only the tiny
-                    # per-query group-id vector uploads
-                    from opentsdb_tpu.parallel.sharded_pipeline \
-                        import run_sharded_device, sharded_grid_gids
-                    gids_dev = sharded_grid_gids(
-                        mesh, group_ids, pmeta["s_pad"], num_groups)
-                    result, emit = run_sharded_device(
-                        mesh, spec, cached_args + (gids_dev,),
-                        pmeta["s_loc"], pmeta["b_loc"], num_groups,
-                        sub.rate_options)
-                else:
-                    (prep,) = cached_args
-                    from opentsdb_tpu.ops.pipeline import run_prepared
-                    result, emit = run_prepared(prep, bucket_ts,
-                                                group_ids, spec,
-                                                sub.rate_options)
-                if stats:
-                    stats.add_stat(QueryStat.COMPUTE_TIME,
-                                   (time.monotonic() - t2) * 1e3)
-                return self._build_results(
-                    tsq, sub, metric_name, sids, tag_mat, group_ids,
-                    num_groups, gb_kids, bucket_ts, result, emit)
+                try:
+                    return self._run_prep_hit(
+                        hit, mesh, store, sids, tsq, sub, metric_name,
+                        tag_mat, group_ids, num_groups, gb_kids,
+                        emit_raw, stats)
+                except (BadRequestError, QueryLimitExceeded):
+                    raise
+                except Exception as exc:  # noqa: BLE001
+                    # a warm entry failing on the device must not make
+                    # warm queries 500 while cold ones fall back:
+                    # breaker bookkeeping already happened inside
+                    # _run_device — drop to the cold path below, which
+                    # carries the full host-fallback discipline
+                    LOG.warning("cached device batch failed (%s: %s); "
+                                "re-running the query cold",
+                                type(exc).__name__, exc)
 
         # --- materialize + time grid (row-padded layout: the ragged ->
         # dense transposition happens inside materialize, so the device
@@ -647,9 +721,9 @@ class QueryEngine:
         # the persistent compile cache absorbs the one-off compiles.
         host_dev = None
         if mesh is None and not use_blocked:
-            host_dev = host_tail_for_dims(
-                self.tsdb.config, len(sids), len(bucket_ts),
-                num_groups, emit_raw, sub.agg.name)
+            host_dev = self._tail_device(
+                len(sids), len(bucket_ts), num_groups, emit_raw,
+                sub.agg.name)
         spec = PipelineSpec(
             num_series=len(sids), num_buckets=len(bucket_ts),
             num_groups=num_groups, ds_function=ds_function,
@@ -672,6 +746,28 @@ class QueryEngine:
                 padded.values2d, bucket_idx2d, padded.counts)
         elif use_blocked or mesh is not None:
             values, series_idx = batch.values, batch.series_idx
+        # the host-retry twin for the single-device paths below: on a
+        # device-pipeline failure (or an armed device fault) the same
+        # tail re-runs pinned to the host CPU backend — a degraded
+        # answer instead of a 500. Mesh and blocked executions have no
+        # in-process twin; their failures count toward the breaker and
+        # propagate.
+        host_retry = None
+        if mesh is None and not use_blocked:
+            def host_retry():
+                from opentsdb_tpu.ops.pipeline import (prepare_auto,
+                                                       prepare_flat,
+                                                       run_prepared)
+                cpu = self._host_cpu()
+                hspec = replace(spec, host=True)
+                if padded is not None:
+                    prep = prepare_auto(padded, bucket_idx2d, hspec,
+                                        device=cpu)
+                else:
+                    prep = prepare_flat(batch.values, batch.series_idx,
+                                        bucket_idx, hspec, device=cpu)
+                return run_prepared(prep, bucket_ts, group_ids, hspec,
+                                    sub.rate_options)
         if use_blocked:
             # long-range streaming: bound memory at [S x block] cells
             # (SURVEY.md §5.7 time-axis blocking)
@@ -683,18 +779,20 @@ class QueryEngine:
                 # (SaltScanner.java:463-536)
                 from opentsdb_tpu.parallel.sharded_pipeline import \
                     execute_blocked_sharded
-                result, emit = execute_blocked_sharded(
-                    mesh, values, series_idx, bucket_idx, bucket_ts,
-                    group_ids, spec, sub.rate_options,
-                    block_buckets=pick_block_buckets(
-                        len(sids), len(bucket_ts),
-                        budget * mesh_scale))
+                result, emit = self._run_device(
+                    lambda: execute_blocked_sharded(
+                        mesh, values, series_idx, bucket_idx,
+                        bucket_ts, group_ids, spec, sub.rate_options,
+                        block_buckets=pick_block_buckets(
+                            len(sids), len(bucket_ts),
+                            budget * mesh_scale)))
             else:
-                result, emit = execute_blocked(
-                    values, series_idx, bucket_idx, bucket_ts,
-                    group_ids, spec, sub.rate_options,
-                    block_buckets=pick_block_buckets(
-                        len(sids), len(bucket_ts), budget))
+                result, emit = self._run_device(
+                    lambda: execute_blocked(
+                        values, series_idx, bucket_idx, bucket_ts,
+                        group_ids, spec, sub.rate_options,
+                        block_buckets=pick_block_buckets(
+                            len(sids), len(bucket_ts), budget)))
         elif mesh is not None:
             # multi-chip: shard the point batch over the
             # ('series','time') mesh — the salt-scanner fan-out/merge
@@ -705,23 +803,28 @@ class QueryEngine:
             from opentsdb_tpu.parallel.sharded_pipeline import (
                 prepare_sharded_batch, run_sharded_device,
                 sharded_device_args)
-            sbatch = prepare_sharded_batch(
-                values, series_idx, bucket_idx, bucket_ts, group_ids,
-                spec.num_series, spec.num_groups,
-                mesh.shape["series"], mesh.shape["time"])
-            margs = sharded_device_args(mesh, sbatch, pipeline_dtype())
-            if prep_cache is not None and pkey is not None:
-                prep_cache.put(
-                    pkey, pver, margs[:4],
-                    {"num_points": num_points, "bucket_ts": bucket_ts,
-                     "ds_function": ds_function,
-                     "fill_policy": fill_policy,
-                     "fill_value": fill_value, "s_loc": sbatch.s_loc,
-                     "b_loc": sbatch.b_loc,
-                     "s_pad": sbatch.s_loc * mesh.shape["series"]})
-            result, emit = run_sharded_device(
-                mesh, spec, margs, sbatch.s_loc, sbatch.b_loc,
-                num_groups, sub.rate_options)
+            def mesh_compute():
+                sbatch = prepare_sharded_batch(
+                    values, series_idx, bucket_idx, bucket_ts,
+                    group_ids, spec.num_series, spec.num_groups,
+                    mesh.shape["series"], mesh.shape["time"])
+                margs = sharded_device_args(mesh, sbatch,
+                                            pipeline_dtype())
+                if prep_cache is not None and pkey is not None:
+                    prep_cache.put(
+                        pkey, pver, margs[:4],
+                        {"num_points": num_points,
+                         "bucket_ts": bucket_ts,
+                         "ds_function": ds_function,
+                         "fill_policy": fill_policy,
+                         "fill_value": fill_value,
+                         "s_loc": sbatch.s_loc, "b_loc": sbatch.b_loc,
+                         "s_pad": sbatch.s_loc * mesh.shape["series"]})
+                return run_sharded_device(
+                    mesh, spec, margs, sbatch.s_loc, sbatch.b_loc,
+                    num_groups, sub.rate_options)
+
+            result, emit = self._run_device(mesh_compute)
         elif host_dev is not None:
             # host tail: place on the CPU backend; cached in the
             # host-RAM pool (NOT the device cache — host entries must
@@ -745,32 +848,43 @@ class QueryEngine:
                     "fill_policy": fill_policy,
                     "fill_value": fill_value, "host": True,
                     "complete": grid_complete})
-            result, emit = run_prepared(prep, bucket_ts, group_ids,
-                                        spec, sub.rate_options)
+            result, emit = self._run_device(
+                lambda: run_prepared(prep, bucket_ts, group_ids,
+                                     spec, sub.rate_options),
+                on_device=False)
         elif prep_cache is not None:
             # upload once, cache the device-resident batch, execute
             from opentsdb_tpu.ops.pipeline import (prepare_auto,
                                                    prepare_flat,
                                                    run_prepared)
-            if padded is not None:
-                prep = prepare_auto(padded, bucket_idx2d, spec)
-            else:
-                prep = prepare_flat(batch.values, batch.series_idx,
-                                    bucket_idx, spec)
-            prep_cache.put(pkey, pver, (prep,), {
-                "num_points": num_points, "bucket_ts": bucket_ts,
-                "ds_function": ds_function,
-                "fill_policy": fill_policy, "fill_value": fill_value})
-            result, emit = run_prepared(prep, bucket_ts, group_ids,
-                                        spec, sub.rate_options)
+
+            def cached_compute():
+                if padded is not None:
+                    prep = prepare_auto(padded, bucket_idx2d, spec)
+                else:
+                    prep = prepare_flat(batch.values,
+                                        batch.series_idx,
+                                        bucket_idx, spec)
+                prep_cache.put(pkey, pver, (prep,), {
+                    "num_points": num_points, "bucket_ts": bucket_ts,
+                    "ds_function": ds_function,
+                    "fill_policy": fill_policy,
+                    "fill_value": fill_value})
+                return run_prepared(prep, bucket_ts, group_ids, spec,
+                                    sub.rate_options)
+
+            result, emit = self._run_device(cached_compute, host_retry)
         elif padded is not None:
-            result, emit = execute_auto(
-                padded, bucket_idx2d, bucket_ts, group_ids, spec,
-                sub.rate_options)
+            result, emit = self._run_device(
+                lambda: execute_auto(
+                    padded, bucket_idx2d, bucket_ts, group_ids, spec,
+                    sub.rate_options), host_retry)
         else:
-            result, emit = execute(
-                batch.values, batch.series_idx, bucket_idx, bucket_ts,
-                group_ids, spec, sub.rate_options)
+            result, emit = self._run_device(
+                lambda: execute(
+                    batch.values, batch.series_idx, bucket_idx,
+                    bucket_ts, group_ids, spec, sub.rate_options),
+                host_retry)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)
@@ -781,6 +895,61 @@ class QueryEngine:
             num_groups, gb_kids, bucket_ts, result, emit)
 
     # ------------------------------------------------------------------
+
+    def _run_prep_hit(self, hit, mesh, store, sids, tsq, sub,
+                      metric_name, tag_mat, group_ids, num_groups,
+                      gb_kids, emit_raw, stats) -> list[QueryResult]:
+        """Serve one sub-query from a warm prepared-batch cache entry
+        (device pool or its host-RAM twin). Raising is allowed: the
+        caller falls back to the cold path on device failure."""
+        cached_args, pmeta = hit
+        bucket_ts = pmeta["bucket_ts"]
+        num_points = pmeta["num_points"]
+        self.tsdb.query_limits.check(metric_name, num_points)
+        t2 = time.monotonic()
+        spec = PipelineSpec(
+            num_series=len(sids), num_buckets=len(bucket_ts),
+            num_groups=num_groups, ds_function=pmeta["ds_function"],
+            agg_name=sub.agg.name, fill_policy=pmeta["fill_policy"],
+            fill_value=pmeta["fill_value"], rate=sub.rate,
+            rate_counter=sub.rate_options.counter,
+            rate_drop_resets=sub.rate_options.drop_resets,
+            emit_raw=emit_raw,
+            host=pmeta.get("host", False),
+            complete=pmeta.get("complete", False)
+            and not (sub.rate and sub.rate_options.drop_resets))
+        if mesh is not None:
+            # HBM-resident pre-sharded batch: only the tiny per-query
+            # group-id vector uploads
+            from opentsdb_tpu.parallel.sharded_pipeline import (
+                run_sharded_device, sharded_grid_gids)
+            gids_dev = sharded_grid_gids(
+                mesh, group_ids, pmeta["s_pad"], num_groups)
+            result, emit = self._run_device(
+                lambda: run_sharded_device(
+                    mesh, spec, cached_args + (gids_dev,),
+                    pmeta["s_loc"], pmeta["b_loc"], num_groups,
+                    sub.rate_options))
+        else:
+            (prep,) = cached_args
+            from opentsdb_tpu.ops.pipeline import run_prepared
+            result, emit = self._run_device(
+                lambda: run_prepared(prep, bucket_ts, group_ids,
+                                     spec, sub.rate_options),
+                on_device=not spec.host)
+        # stats and delete only after the dispatch succeeded: a device
+        # failure falls back to the COLD path, which must still find
+        # the data (scanned-and-deleted semantics) and must not see
+        # DPS_POST_FILTER double-counted
+        if stats:
+            stats.add_stat(QueryStat.DPS_POST_FILTER, num_points)
+            stats.add_stat(QueryStat.COMPUTE_TIME,
+                           (time.monotonic() - t2) * 1e3)
+        if tsq.delete and hasattr(store, "delete_range"):
+            store.delete_range(sids, tsq.start_ms, tsq.end_ms)
+        return self._build_results(
+            tsq, sub, metric_name, sids, tag_mat, group_ids,
+            num_groups, gb_kids, bucket_ts, result, emit)
 
     def _select_store(self, sub: TSSubQuery):
         """Pick raw store or a rollup tier (ref: TsdbQuery rollup
@@ -900,9 +1069,8 @@ class QueryEngine:
         # per padded-shape class, matching warmup's pre-compiles
         host_dev = None
         if mesh is None:
-            host_dev = host_tail_for_dims(self.tsdb.config, len(sids),
-                                          b, num_groups, emit_raw,
-                                          sub.agg.name)
+            host_dev = self._tail_device(len(sids), b, num_groups,
+                                         emit_raw, sub.agg.name)
         # device-resident cache: a warm repeat of this reduction skips
         # the host scan AND the upload (HBM ≙ HBase block cache).
         # Under a mesh the cached value is the pre-SHARDED device args
@@ -1027,18 +1195,39 @@ class QueryEngine:
                 s_pad = mesh_meta["s_pad"]
             gids_dev = sharded_grid_gids(mesh, gids_bk, s_pad,
                                          pspec.num_groups)
-            result, emit = run_sharded_grid(
-                mesh, pspec, data_args + (gids_dev,), s_loc, b_loc,
-                num_groups, sub.rate_options)
+            host_retry = None
+            if isinstance(grid, np.ndarray):
+                # fresh (non-cache-hit) grid: the single-device host
+                # tail can re-answer the same reduction on failure
+                def host_retry():
+                    from opentsdb_tpu.ops.pipeline import execute_grid
+                    return execute_grid(
+                        grid, has_data, bucket_ts, group_ids,
+                        replace(spec, host=True), sub.rate_options,
+                        device=self._host_cpu())
+            result, emit = self._run_device(
+                lambda: run_sharded_grid(
+                    mesh, pspec, data_args + (gids_dev,), s_loc,
+                    b_loc, num_groups, sub.rate_options), host_retry)
             rows = len(sids) if emit_raw else num_groups
             result = result[:rows, :len(bucket_ts)]
             emit = emit[:rows, :len(bucket_ts)]
         else:
             from opentsdb_tpu.ops.pipeline import execute_grid
-            result, emit = execute_grid(grid, has_data, bucket_ts,
-                                        group_ids, spec,
-                                        sub.rate_options,
-                                        device=host_dev)
+
+            def host_retry():
+                return execute_grid(grid, has_data, bucket_ts,
+                                    group_ids,
+                                    replace(spec, host=True),
+                                    sub.rate_options,
+                                    device=self._host_cpu())
+
+            result, emit = self._run_device(
+                lambda: execute_grid(grid, has_data, bucket_ts,
+                                     group_ids, spec,
+                                     sub.rate_options,
+                                     device=host_dev),
+                host_retry, on_device=host_dev is None)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)
@@ -1082,9 +1271,8 @@ class QueryEngine:
             t0_ms = int(bucket_ts[0])
             mesh = self.tsdb.query_mesh
             if mesh is None:
-                host_dev = host_tail_for_dims(self.tsdb.config, s, b,
-                                              num_groups, emit_raw,
-                                              sub.agg.name)
+                host_dev = self._tail_device(s, b, num_groups,
+                                             emit_raw, sub.agg.name)
             # host-tail queries skip the device cache (see
             # _grid_pipeline: cheap native re-scan; host RAM must not
             # evict HBM-resident grids)
@@ -1201,14 +1389,23 @@ class QueryEngine:
                                          xp=np)
             valid = np.asarray(valid)
             sidx2, bidx2 = np.nonzero(valid)
-            result, emit = self._mesh_execute(
-                mesh, spec, avg[valid], sidx2.astype(np.int32),
-                bidx2.astype(np.int32), bucket_ts, group_ids,
-                sub.rate_options)
+            result, emit = self._run_device(
+                lambda: self._mesh_execute(
+                    mesh, spec, avg[valid], sidx2.astype(np.int32),
+                    bidx2.astype(np.int32), bucket_ts, group_ids,
+                    sub.rate_options))
         else:
-            result, emit = execute_avg_divide(
-                gs, gc, bucket_ts, group_ids, spec, sub.rate_options,
-                device=host_dev)
+            def host_retry():
+                return execute_avg_divide(
+                    gs, gc, bucket_ts, group_ids,
+                    replace(spec, host=True), sub.rate_options,
+                    device=self._host_cpu())
+
+            result, emit = self._run_device(
+                lambda: execute_avg_divide(
+                    gs, gc, bucket_ts, group_ids, spec,
+                    sub.rate_options, device=host_dev),
+                host_retry, on_device=host_dev is None)
         if stats:
             stats.add_stat(QueryStat.COMPUTE_TIME,
                            (time.monotonic() - t2) * 1e3)

@@ -39,6 +39,7 @@ from opentsdb_tpu.query.model import (BadRequestError, TSQuery,
                                       parse_uri_query)
 from opentsdb_tpu.stats.stats import QueryStats
 from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
+from opentsdb_tpu.utils.faults import DegradedError
 
 
 @dataclass
@@ -174,6 +175,7 @@ class HttpRpcRouter:
             "aggregators": self._handle_aggregators,
             "config": self._handle_config,
             "dropcaches": self._handle_dropcaches,
+            "health": self._handle_health,
             "serializers": self._handle_serializers,
             "stats": self._handle_stats,
             "version": self._handle_version,
@@ -246,6 +248,15 @@ class HttpRpcRouter:
             # over-budget scans are a client-fixable condition
             return HttpResponse(413, request.serializer.format_error(
                 413, str(e)))
+        except DegradedError as e:
+            # a deliberate degraded-mode refusal (e.g. device breaker
+            # open with host fallback disabled): structured 503 +
+            # Retry-After, never a 500
+            resp = HttpResponse(503, request.serializer.format_error(
+                503, str(e)))
+            resp.headers["Retry-After"] = str(
+                getattr(e, "retry_after_s", 1))
+            return resp
         except NotImplementedError as e:
             return HttpResponse(501, request.serializer.format_error(
                 501, str(e) or "not implemented"))
@@ -1046,6 +1057,56 @@ class HttpRpcRouter:
         self.tsdb.collect_stats(collector)
         return HttpResponse(200, request.serializer.format_stats(
             collector.as_json()))
+
+    def _handle_health(self, request: HttpRequest, rest) -> HttpResponse:
+        """Operator-facing degradation report (``/api/health``): WAL
+        durability lag + degraded flag, circuit-breaker states,
+        connection/admission/shed counters and armed fault sites —
+        every graceful-degradation decision the serve path can take is
+        observable here (and asserted by the ``robustness`` suite).
+        Always 200: a degraded TSD is still serving; the ``status``
+        field carries the verdict so health checks don't eject a node
+        that is answering queries from the host fallback."""
+        t = self.tsdb
+        causes: list[str] = []
+        wal = getattr(t, "wal", None)
+        wal_info: dict[str, Any] = {"enabled": wal is not None}
+        if wal is not None:
+            wal_info.update(wal.health_info())
+            if wal_info.get("degraded"):
+                causes.append("wal_sync")
+            if wal_info.get("durability_hole"):
+                causes.append("wal_durability_hole")
+        breakers: dict[str, Any] = {}
+        breaker = getattr(t, "device_breaker", None)
+        if breaker is not None:
+            breakers[breaker.name] = breaker.health_info()
+            if breaker.state != breaker.CLOSED:
+                causes.append(f"breaker:{breaker.name}")
+        faults = getattr(t, "faults", None)
+        doc: dict[str, Any] = {
+            "status": "degraded" if causes else "ok",
+            "degraded": bool(causes),
+            "causes": causes,
+            "uptime_seconds": int(time.time() - t.start_time),
+            "wal": wal_info,
+            "breakers": breakers,
+            "faults": (faults.health_info() if faults is not None
+                       else {"armed": False, "sites": {}}),
+        }
+        server = self.server
+        if server is not None:
+            cm = server.connections
+            doc["connections"] = {
+                "open": cm.open_connections,
+                "total": cm.total_connections,
+                "refused": cm.rejected_connections,
+                "idle_closed": cm.idle_closed,
+                "limit": cm.max_connections,
+            }
+            doc["admission"] = server.admission.health_info(
+                server.query_queue_depth())
+        return HttpResponse(200, json.dumps(doc).encode())
 
     def _runtime_stats(self) -> dict[str, Any]:
         import gc

@@ -122,9 +122,18 @@ class HttpJsonSerializer(HttpSerializer):
 
     @staticmethod
     def _native_fmt():
-        """The C++ dps formatter, or None without a compiler."""
+        """The C++ dps formatter, or None without a compiler.
+
+        Probes ``load_library()`` too: the import alone always
+        succeeds — NativeBuildError surfaces at CALL time, which used
+        to turn every large query into a 500 on hosts without a
+        working toolchain instead of falling back to the Python
+        formatter (the library handle is cached, so the probe is one
+        lock acquisition on the warm path)."""
         try:
-            from opentsdb_tpu.native.store_backend import format_dps
+            from opentsdb_tpu.native.store_backend import (format_dps,
+                                                           load_library)
+            load_library()
             return format_dps
         except Exception:  # noqa: BLE001
             return None

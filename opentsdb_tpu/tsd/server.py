@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import json
 import logging
 import re
+import threading
 import time
 import urllib.parse
 
@@ -83,6 +85,76 @@ class ConnectionManager:
                          self.rejected_connections, type="rejected")
         collector.record("connectionmgr.connections", self.idle_closed,
                          type="idle_closed")
+        # refusal counter under its own name so dashboards can alert
+        # on it without parsing the connectionmgr.exceptions tag
+        collector.record("connections.refused",
+                         self.rejected_connections)
+
+
+class AdmissionController:
+    """Query-surface load shedding (the graceful twin of the hard
+    ``tsd.core.connections.limit`` refusal): once in-flight queries or
+    the worker-pool queue depth cross their thresholds, new queries
+    are answered with a structured 503 + ``Retry-After`` instead of
+    queueing without bound. Writes and admin endpoints are never shed
+    — during overload, operators still need /api/health and clients
+    still need their puts acknowledged."""
+
+    CAUSES = ("inflight", "queue")
+
+    def __init__(self, max_inflight: int = 0, max_queue: int = 0,
+                 retry_after_s: int = 1):
+        self.max_inflight = max_inflight
+        self.max_queue = max_queue
+        self.retry_after_s = max(retry_after_s, 1)
+        # started() runs on the event loop, finished() on the worker
+        # thread (a timed-out query's asyncio future is cancelled
+        # while the thread keeps running — only the THREAD finishing
+        # frees the slot, or retrying clients would be admitted onto
+        # an already-saturated pool)
+        self._lock = threading.Lock()
+        self.inflight = 0
+        self.shed_counts = {cause: 0 for cause in self.CAUSES}
+
+    def try_admit(self, queue_depth: int) -> str | None:
+        """The shed cause, or None when admitted (caller must then
+        pair the admit with :meth:`started`)."""
+        with self._lock:
+            if self.max_inflight and self.inflight >= self.max_inflight:
+                self.shed_counts["inflight"] += 1
+                return "inflight"
+            if self.max_queue and queue_depth >= self.max_queue:
+                self.shed_counts["queue"] += 1
+                return "queue"
+            return None
+
+    def started(self) -> None:
+        with self._lock:
+            self.inflight += 1
+
+    def finished(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+    @property
+    def total_shed(self) -> int:
+        return sum(self.shed_counts.values())
+
+    def collect_stats(self, collector) -> None:
+        collector.record("admission.inflight", self.inflight)
+        for cause, n in self.shed_counts.items():
+            collector.record("admission.shed", n, cause=cause)
+
+    def health_info(self, queue_depth: int) -> dict:
+        return {
+            "inflight_queries": self.inflight,
+            "queue_depth": queue_depth,
+            "max_inflight": self.max_inflight,
+            "max_queue": self.max_queue,
+            "retry_after_s": self.retry_after_s,
+            "shed": dict(self.shed_counts),
+            "shed_total": self.total_shed,
+        }
 
 
 class TSDServer:
@@ -101,6 +173,32 @@ class TSDServer:
         self.connections = ConnectionManager(
             tsdb.config.get_int("tsd.core.connections.limit", 0))
         tsdb.stats.register(self.connections)
+        # query admission control (load shedding): structured 503 +
+        # Retry-After once in-flight queries / queue depth cross the
+        # configured thresholds (0 = unlimited, the old behavior)
+        self.admission = AdmissionController(
+            max_inflight=tsdb.config.get_int(
+                "tsd.query.admission.max_inflight"),
+            max_queue=tsdb.config.get_int(
+                "tsd.query.admission.max_queue"),
+            retry_after_s=tsdb.config.get_int(
+                "tsd.query.admission.retry_after_s"))
+        tsdb.stats.register(self.admission)
+        # canned refusal for over-limit connections: a structured 503
+        # beats a silent close (the reference just drops the channel,
+        # ConnectionManager.java:87 — clients saw a reset and could
+        # not tell overload from outage)
+        refusal_body = json.dumps({"error": {
+            "code": 503, "message": "Connection limit exceeded",
+            "details": "tsd.core.connections.limit reached; "
+                       "retry later"}}).encode()
+        self._refusal_bytes = (
+            b"HTTP/1.1 503 Service Unavailable\r\n"
+            b"Content-Type: application/json; charset=UTF-8\r\n"
+            b"Retry-After: " +
+            str(self.admission.retry_after_s).encode() +
+            b"\r\nContent-Length: " + str(len(refusal_body)).encode() +
+            b"\r\nConnection: close\r\n\r\n" + refusal_body)
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -273,9 +371,29 @@ class TSDServer:
 
     # ------------------------------------------------------------------
 
+    def query_queue_depth(self) -> int:
+        """Pending (unstarted) tasks in the query worker pool.
+        ``_work_queue`` is a private CPython attribute; report 0 if a
+        future runtime hides it — admission then falls back to the
+        in-flight limit alone instead of 500ing every query."""
+        queue = getattr(self._query_pool, "_work_queue", None)
+        try:
+            return queue.qsize() if queue is not None else 0
+        except Exception:  # noqa: BLE001 - runtime-specific queue
+            return 0
+
     async def _handle_connection(self, reader: asyncio.StreamReader,
                                  writer: asyncio.StreamWriter) -> None:
         if not self.connections.accept():
+            # shed with a structured body; the protocol is unknown at
+            # this point (nothing read yet) so speak HTTP — a telnet
+            # client sees one junk line before the close, an HTTP
+            # client sees a proper 503 + Retry-After
+            try:
+                writer.write(self._refusal_bytes)
+                await asyncio.wait_for(writer.drain(), 1)
+            except Exception:  # noqa: BLE001
+                pass
             writer.close()
             return
         try:
@@ -453,24 +571,47 @@ class TSDServer:
                     request.auth = auth_state
                 is_query = _is_query_path(
                     urllib.parse.unquote(parsed.path))
-                fut = asyncio.get_event_loop().run_in_executor(
-                    self._query_pool if is_query else None,
-                    self.http_router.handle, request)
-                if is_query and self.query_timeout_ms > 0:
-                    try:
-                        response = await asyncio.wait_for(
-                            fut, self.query_timeout_ms / 1000.0)
-                    except asyncio.TimeoutError:
-                        # the worker thread finishes in the background;
-                        # the client gets the reference's expiry error
-                        response = HttpResponse(
-                            504,
-                            ('{"error":{"code":504,"message":'
-                             '"Query timeout exceeded ('
-                             f'{self.query_timeout_ms}ms)"}}}}')
-                            .encode())
+                shed_cause = self.admission.try_admit(
+                    self.query_queue_depth()) if is_query else None
+                if shed_cause is not None:
+                    response = self._overload_response(shed_cause)
+                    LOG.warning("shedding query %s (%s; %d in flight)",
+                                parsed.path, shed_cause,
+                                self.admission.inflight)
                 else:
-                    response = await fut
+                    if is_query:
+                        # the slot is freed by the WORKER finishing,
+                        # not the response: a 504'd query still holds
+                        # its thread (see AdmissionController)
+                        self.admission.started()
+
+                        def tracked(req=request):
+                            try:
+                                return self.http_router.handle(req)
+                            finally:
+                                self.admission.finished()
+
+                        fut = asyncio.get_event_loop() \
+                            .run_in_executor(self._query_pool, tracked)
+                    else:
+                        fut = asyncio.get_event_loop().run_in_executor(
+                            None, self.http_router.handle, request)
+                    if is_query and self.query_timeout_ms > 0:
+                        try:
+                            response = await asyncio.wait_for(
+                                fut, self.query_timeout_ms / 1000.0)
+                        except asyncio.TimeoutError:
+                            # the worker thread finishes in the
+                            # background; the client gets the
+                            # reference's expiry error
+                            response = HttpResponse(
+                                504,
+                                ('{"error":{"code":504,"message":'
+                                 '"Query timeout exceeded ('
+                                 f'{self.query_timeout_ms}ms)"}}}}')
+                                .encode())
+                    else:
+                        response = await fut
                 self.tsdb.stats.latency_query.add(
                     (time.monotonic() - t0) * 1000)
             self._apply_cors(request, response)
@@ -485,6 +626,23 @@ class TSDServer:
                         and response.body_iter is not None else None)
             await self._write_response(writer, response, version,
                                        keep_alive, deadline=deadline)
+
+    def _overload_response(self, cause: str) -> HttpResponse:
+        """Structured load-shed answer (503 + Retry-After), one
+        counter per cause so operators can tell WHICH limit sheds."""
+        message = {
+            "inflight": "too many in-flight queries",
+            "queue": "query queue is full",
+        }.get(cause, cause)
+        body = json.dumps({"error": {
+            "code": 503,
+            "message": f"Service overloaded: {message}",
+            "details": f"shed cause: {cause}; retry after "
+                       f"{self.admission.retry_after_s}s"}}).encode()
+        return HttpResponse(
+            503, body,
+            headers={"Retry-After":
+                     str(self.admission.retry_after_s)})
 
     def _cors_preflight(self, request: HttpRequest) -> HttpResponse:
         """(ref: RpcHandler CORS handling :46)"""
@@ -557,9 +715,13 @@ class TSDServer:
                   400: "Bad Request",
                   401: "Unauthorized", 403: "Forbidden",
                   404: "Not Found", 405: "Method Not Allowed",
-                  413: "Request Entity Too Large", 500:
+                  413: "Request Entity Too Large",
+                  429: "Too Many Requests", 500:
                   "Internal Server Error",
-                  501: "Not Implemented"}.get(response.status, "Unknown")
+                  501: "Not Implemented",
+                  503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(response.status,
+                                              "Unknown")
         loop = asyncio.get_event_loop()
         if response.body_iter is not None and version != "HTTP/1.1":
             # chunked TE needs 1.1; older clients get one body

@@ -89,6 +89,32 @@ _DEFAULTS: dict[str, str] = {
     "tsd.rollups.agg_tag_key": "_aggregate",
     "tsd.rollups.raw_agg_tag_value": "RAW",
     "tsd.rollups.block_derived": "true",
+    # robustness / graceful degradation. NOTE: tsd.faults.* injection
+    # keys (tsd.faults.<site>_<error_rate|error_count|error_once|
+    # latency_ms>) deliberately have NO defaults here — any present
+    # key arms its fault point (utils/faults.py).
+    #   WAL fsync/append retry ladder; exhaustion degrades durability
+    #   (loudly: /api/health wal.degraded) instead of failing writes
+    "tsd.storage.wal.retry.attempts": "4",
+    "tsd.storage.wal.retry.base_ms": "5",
+    "tsd.storage.wal.retry.deadline_ms": "2000",
+    "tsd.storage.wal.resync_interval_ms": "1000",
+    #   snapshot flush retry (tsd.storage.data_dir writes)
+    "tsd.storage.flush.retry.attempts": "3",
+    "tsd.storage.flush.retry.base_ms": "20",
+    "tsd.storage.flush.retry.deadline_ms": "10000",
+    #   device-pipeline circuit breaker: consecutive failures before
+    #   tripping to the host CPU fallback (0 disables the breaker)
+    "tsd.query.breaker.failure_threshold": "5",
+    "tsd.query.breaker.reset_timeout_ms": "30000",
+    #   re-answer failed device tails on the host CPU backend; off =
+    #   surface the failure (breaker-open queries then shed with 503)
+    "tsd.query.degraded.host_fallback": "true",
+    #   query admission control (0 = unlimited): shed with 503 +
+    #   Retry-After past these in-flight / queue-depth thresholds
+    "tsd.query.admission.max_inflight": "0",
+    "tsd.query.admission.max_queue": "0",
+    "tsd.query.admission.retry_after_s": "1",
     # auth
     "tsd.core.authentication.enable": "false",
     # stats

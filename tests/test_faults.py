@@ -1,0 +1,476 @@
+"""Fault-injection harness + graceful-degradation battery.
+
+Every scenario here arms a deterministic fault (utils/faults.py) and
+asserts the serve path DEGRADES instead of failing: WAL fsync faults
+retry then trade durability for availability (loudly), store flush
+faults retry within deadline, device-pipeline faults trip the circuit
+breaker and re-answer on the host CPU backend, and /api/health reports
+each decision. Select the whole battery with ``-m robustness``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+from opentsdb_tpu.utils.faults import (CircuitBreaker, FaultInjector,
+                                       InjectedFault, RetryPolicy,
+                                       call_with_retries)
+
+pytestmark = pytest.mark.robustness
+
+BASE = 1356998400
+
+
+def _cfg(**extra):
+    base = {"tsd.core.auto_create_metrics": "true",
+            "tsd.tpu.warmup": "false"}
+    base.update(extra)
+    return Config(**base)
+
+
+def _seed(t, n=50):
+    for i in range(n):
+        t.add_point("f.m", BASE + i * 10, float(i), {"host": "a"})
+        t.add_point("f.m", BASE + i * 10, float(2 * i), {"host": "b"})
+
+
+def _query(t, agg="sum", downsample=None):
+    spec = {"metric": "f.m", "aggregator": agg}
+    if downsample:
+        spec["downsample"] = downsample
+    return t.execute_query(TSQuery.from_json({
+        "start": BASE * 1000, "end": (BASE + 3600) * 1000,
+        "queries": [spec]}).validate())
+
+
+class TestFaultInjector:
+    def test_rate_schedule_is_deterministic(self):
+        fi = FaultInjector()
+        fi.arm("x", error_rate=0.5)
+        outcomes = []
+        for _ in range(6):
+            try:
+                fi.check("x")
+                outcomes.append(False)
+            except InjectedFault:
+                outcomes.append(True)
+        # floor(i*0.5) advances exactly on even calls
+        assert outcomes == [False, True, False, True, False, True]
+
+    def test_error_count_fails_first_n_then_recovers(self):
+        fi = FaultInjector()
+        fi.arm("x", error_count=2)
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                fi.check("x")
+        fi.check("x")  # third call clean
+
+    def test_config_key_grammar(self):
+        fi = FaultInjector(Config(**{
+            "tsd.faults.wal.fsync_error_rate": "1.0",
+            "tsd.faults.device.compile_error_once": "true",
+            "tsd.faults.store.latency_ms": "0.1",
+            "tsd.faults.store.flush_error_count": "3"}))
+        info = fi.health_info()
+        assert info["armed"]
+        assert info["sites"]["wal.fsync"]["error_rate"] == 1.0
+        assert info["sites"]["device.compile"]["error_count"] == 1
+        assert info["sites"]["store"]["latency_ms"] == 0.1
+        assert info["sites"]["store.flush"]["error_count"] == 3
+
+    def test_unarmed_site_is_noop_and_disarm(self):
+        fi = FaultInjector()
+        fi.check("anything")  # no raise
+        fi.arm("x", error_rate=1.0)
+        fi.disarm("x")
+        fi.check("x")
+        assert not fi.armed
+
+    def test_counters_and_stats(self):
+        from opentsdb_tpu.stats.stats import StatsCollector
+        fi = FaultInjector()
+        fi.arm("x", error_rate=1.0)
+        with pytest.raises(InjectedFault):
+            fi.check("x")
+        c = StatsCollector()
+        fi.collect_stats(c)
+        recs = {(n, tags.get("site")): v for n, v, tags in c.records}
+        assert recs[("tsd.faults.injected", "x")] == 1
+        assert recs[("tsd.faults.calls", "x")] == 1
+
+
+class TestRetry:
+    def test_transient_fault_recovers_within_attempts(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("disk says no")
+            return "ok"
+
+        out = call_with_retries(fn, RetryPolicy(attempts=4, base_ms=0.1),
+                                sleep=lambda s: None)
+        assert out == "ok" and len(calls) == 3
+
+    def test_attempts_exhausted_raises_last_error(self):
+        def fn():
+            raise OSError("still broken")
+
+        with pytest.raises(OSError, match="still broken"):
+            call_with_retries(fn, RetryPolicy(attempts=3, base_ms=0.1),
+                              sleep=lambda s: None)
+
+    def test_deadline_cuts_retries_short(self):
+        clock = [0.0]
+        calls = []
+
+        def fn():
+            calls.append(1)
+            clock[0] += 1.0  # each attempt burns a simulated second
+            raise OSError("slow disk")
+
+        with pytest.raises(OSError):
+            call_with_retries(fn, RetryPolicy(attempts=100, base_ms=1,
+                                              deadline_ms=2500),
+                              sleep=lambda s: None,
+                              clock=lambda: clock[0])
+        assert len(calls) < 100  # the deadline, not attempts, stopped it
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("not a disk fault")
+
+        with pytest.raises(ValueError):
+            call_with_retries(fn, RetryPolicy(attempts=5, base_ms=0.1),
+                              sleep=lambda s: None)
+        assert len(calls) == 1
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock):
+        return CircuitBreaker("dev", failure_threshold=2,
+                              reset_timeout_ms=1000,
+                              clock=lambda: clock[0])
+
+    def test_trip_open_halfopen_close(self):
+        clock = [0.0]
+        br = self._breaker(clock)
+        assert br.allow() and br.state == br.CLOSED
+        br.record_failure()
+        assert br.state == br.CLOSED
+        br.record_failure()
+        assert br.state == br.OPEN and br.trips == 1
+        assert not br.allow()          # inside the reset window
+        clock[0] += 1.1                # past reset_timeout
+        assert br.allow() and br.state == br.HALF_OPEN
+        br.record_success()
+        assert br.state == br.CLOSED and br.recoveries == 1
+
+    def test_halfopen_failure_reopens(self):
+        clock = [0.0]
+        br = self._breaker(clock)
+        br.record_failure()
+        br.record_failure()
+        clock[0] += 1.1
+        assert br.allow()
+        br.record_failure()            # probe failed
+        assert br.state == br.OPEN and br.trips == 2
+        assert not br.allow()
+
+    def test_halfopen_admits_exactly_one_probe(self):
+        clock = [0.0]
+        br = self._breaker(clock)
+        br.record_failure()
+        br.record_failure()
+        clock[0] += 1.1
+        assert br.allow()          # the probe
+        assert not br.allow()      # concurrent dispatch refused
+        br.record_success()
+        assert br.allow()          # closed again
+
+    def test_blocking_is_read_only(self):
+        clock = [0.0]
+        br = self._breaker(clock)
+        br.record_failure()
+        br.record_failure()
+        assert br.blocking()       # open, inside the window
+        clock[0] += 1.1
+        assert not br.blocking()   # window elapsed...
+        assert br.state == br.OPEN  # ...but the read didn't transition
+
+    def test_success_resets_consecutive_count(self):
+        clock = [0.0]
+        br = self._breaker(clock)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == br.CLOSED  # never two consecutive
+
+
+class TestWalDegradation:
+    def test_transient_fsync_fault_retried_no_degradation(self, tmp_path):
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": str(tmp_path / "d"),
+            "tsd.storage.wal.retry.base_ms": "1",
+            "tsd.faults.wal.fsync_error_count": "2"}))
+        t.add_point("f.m", BASE, 1.0, {"host": "a"})
+        assert not t.wal.degraded
+        assert t.wal.sync_lag() == 0
+        assert t.wal.sync_retries >= 2
+
+    def test_persistent_fsync_fault_degrades_then_recovers(self, tmp_path):
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": str(tmp_path / "d"),
+            "tsd.storage.wal.retry.attempts": "2",
+            "tsd.storage.wal.retry.base_ms": "1",
+            "tsd.storage.wal.resync_interval_ms": "0",
+            "tsd.faults.wal.fsync_error_rate": "1.0"}))
+        # writes are still ACKED while fsync fails — availability over
+        # durability, loudly
+        sid = t.add_point("f.m", BASE, 1.0, {"host": "a"})
+        assert sid >= 0
+        assert t.wal.degraded
+        assert t.wal.sync_failures >= 1
+        assert t.wal.sync_lag() > 0
+        info = t.wal.health_info()
+        assert info["degraded"] and "InjectedFault" in \
+            info["last_sync_error"]
+        # health endpoint reflects the degradation
+        router = HttpRpcRouter(t)
+        h = json.loads(router.handle(HttpRequest(
+            "GET", "/api/health", {}, {}, b"")).body)
+        assert h["status"] == "degraded" and "wal_sync" in h["causes"]
+        # disk recovers: next write's sync clears the flag and covers
+        # the whole backlog (one fsync syncs the file)
+        t.faults.disarm("wal.fsync")
+        t.add_point("f.m", BASE + 10, 2.0, {"host": "a"})
+        assert not t.wal.degraded
+        assert t.wal.sync_lag() == 0
+
+    def test_persistent_append_fault_degrades_not_raises(self, tmp_path):
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": str(tmp_path / "d"),
+            "tsd.storage.wal.retry.attempts": "2",
+            "tsd.storage.wal.retry.base_ms": "1",
+            "tsd.storage.wal.resync_interval_ms": "60000",
+            "tsd.faults.wal.append_error_rate": "1.0"}))
+        # the store write already happened; the WAL going offline must
+        # degrade durability, not fail the (acknowledged) writes
+        for i in range(3):
+            assert t.add_point("f.m", BASE + i * 10, 1.0,
+                               {"host": "a"}) >= 0
+        assert t.wal.degraded
+        assert t.wal.append_failures >= 1
+        assert t.wal.append_dropped >= 1  # offline writes shed, not retried
+        assert t.store.total_points() == 3
+
+    def test_rotation_fsync_fault_degrades_not_raises(self, tmp_path):
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        fi = FaultInjector()
+        fi.arm("wal.fsync", error_rate=1.0)
+        wal = WriteAheadLog(str(tmp_path / "w"), segment_bytes=64,
+                            faults=fi,
+                            retry=RetryPolicy(attempts=2, base_ms=0.1),
+                            resync_ms=0)
+        for i in range(5):  # every record overflows the 64-byte segment
+            wal.log_uid("metric", f"m{i}")
+        assert wal.degraded and wal.sync_failures >= 1
+        wal.close()
+
+    def test_truncate_fsync_fault_flush_still_completes(self, tmp_path):
+        d = str(tmp_path / "d")
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": d,
+            "tsd.storage.wal.retry.attempts": "1",
+            "tsd.storage.wal.retry.base_ms": "1"}))
+        t.add_point("f.m", BASE, 1.0, {"host": "a"})
+        t.faults.arm("wal.fsync", error_rate=1.0)
+        t.flush()  # snapshot + truncate must complete, not raise
+        assert os.path.isfile(os.path.join(d, "META.json"))
+        assert t.wal.degraded
+
+    def test_append_fault_retried_and_record_durable(self, tmp_path):
+        d = str(tmp_path / "d")
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": d,
+            "tsd.storage.wal.retry.base_ms": "1",
+            "tsd.faults.wal.append_error_count": "1"}))
+        t.add_point("f.m", BASE, 7.0, {"host": "a"})
+        t.wal.close()
+        # replay into a fresh TSDB without the fault: the retried
+        # append must have landed a valid record
+        t2 = TSDB(_cfg(**{"tsd.storage.data_dir": d}))
+        assert [v for _, v in _query(t2)[0].dps] == [7.0]
+        t2.wal.close()
+
+
+class TestStoreFaults:
+    def test_flush_fault_retried_within_deadline(self, tmp_path):
+        d = str(tmp_path / "d")
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": d,
+            "tsd.storage.flush.retry.base_ms": "1",
+            "tsd.faults.store.flush_error_count": "2"}))
+        t.add_point("f.m", BASE, 1.0, {"host": "a"})
+        t.flush()  # two injected failures, third attempt lands
+        assert os.path.isfile(os.path.join(d, "META.json"))
+        assert t.faults.health_info()["sites"]["store.flush"][
+            "injected"] == 2
+
+    def test_flush_fault_exhaustion_raises_osError(self, tmp_path):
+        t = TSDB(_cfg(**{
+            "tsd.storage.data_dir": str(tmp_path / "d"),
+            "tsd.storage.flush.retry.attempts": "2",
+            "tsd.storage.flush.retry.base_ms": "1",
+            "tsd.faults.store.flush_error_rate": "1.0"}))
+        t.add_point("f.m", BASE, 1.0, {"host": "a"})
+        with pytest.raises(OSError):
+            t.flush()
+
+    def test_store_read_latency_injection(self):
+        t = TSDB(_cfg(**{"tsd.faults.store.latency_ms": "1"}))
+        _seed(t, 5)
+        out = _query(t)
+        assert len(out) == 1
+        assert t.faults.health_info()["sites"]["store"]["calls"] >= 1
+
+
+class TestDeviceBreakerFallback:
+    CFG = {
+        # force device placement (host-tail would bypass the breaker)
+        "tsd.query.host_tail_max_cells": "-1",
+        "tsd.query.host_tail_max_cells_linear": "-1",
+        "tsd.query.breaker.failure_threshold": "2",
+        "tsd.query.breaker.reset_timeout_ms": "60000",
+    }
+
+    def test_fallback_answers_match_unfaulted(self):
+        t_ok = TSDB(_cfg(**self.CFG))
+        _seed(t_ok)
+        expected = _query(t_ok)[0].dps
+
+        t = TSDB(_cfg(**self.CFG,
+                      **{"tsd.faults.device.compile_error_count": "3"}))
+        _seed(t)
+        for _ in range(3):
+            got = _query(t)[0].dps
+            assert got == expected  # degraded answer, same numbers
+        assert t.device_breaker.state == t.device_breaker.OPEN
+        assert t.device_breaker.fallbacks >= 2
+
+    def test_grid_path_fallback(self):
+        cfg = dict(self.CFG)
+        t_ok = TSDB(_cfg(**cfg))
+        _seed(t_ok)
+        expected = _query(t_ok, downsample="1m-avg")[0].dps
+        t = TSDB(_cfg(**cfg,
+                      **{"tsd.faults.device.compile_error_count": "1"}))
+        _seed(t)
+        assert _query(t, downsample="1m-avg")[0].dps == expected
+        assert t.device_breaker.fallbacks == 1
+
+    def test_open_breaker_serves_from_host_without_device_calls(self):
+        t = TSDB(_cfg(**self.CFG,
+                      **{"tsd.faults.device.compile_error_rate": "1.0"}))
+        _seed(t)
+        _query(t)
+        _query(t)
+        assert t.device_breaker.state == t.device_breaker.OPEN
+        calls_when_open = t.faults.health_info()[
+            "sites"]["device.compile"]["calls"]
+        # degraded: placed on host up front — the device fault point
+        # is never consulted again while the breaker is open
+        out = _query(t)
+        assert len(out) == 1
+        assert t.faults.health_info()["sites"]["device.compile"][
+            "calls"] == calls_when_open
+
+    def test_fallback_disabled_sheds_structured_503(self):
+        t = TSDB(_cfg(**self.CFG,
+                      **{"tsd.query.degraded.host_fallback": "false",
+                         "tsd.faults.device.compile_error_rate": "1.0"}))
+        _seed(t)
+        router = HttpRpcRouter(t)
+
+        def q():
+            return router.handle(HttpRequest(
+                "GET", "/api/query",
+                {"start": [str(BASE * 1000)],
+                 "end": [str((BASE + 3600) * 1000)],
+                 "m": ["sum:f.m"]}, {}, b""))
+
+        # failures surface until the breaker trips...
+        assert q().status == 500
+        assert q().status == 500
+        assert t.device_breaker.state == t.device_breaker.OPEN
+        # ...then the open breaker sheds with a structured 503
+        resp = q()
+        assert resp.status == 503
+        assert resp.headers.get("Retry-After")
+        assert json.loads(resp.body)["error"]["code"] == 503
+
+    def test_open_breaker_without_host_twin_sheds_structured(self):
+        """Dispatches with no host twin (mesh/blocked shapes) must
+        shed with DegradedError while the breaker is open — not keep
+        hammering the failing device."""
+        from opentsdb_tpu.utils.faults import DegradedError
+        t = TSDB(_cfg(**self.CFG))
+        engine = t.new_query()
+        t.device_breaker.record_failure()
+        t.device_breaker.record_failure()
+        assert t.device_breaker.state == t.device_breaker.OPEN
+        with pytest.raises(DegradedError):
+            engine._run_device(lambda: 1, host_retry=None)
+        # with a host twin the open breaker routes straight to it
+        assert engine._run_device(lambda: 1 / 0,
+                                  host_retry=lambda: "host") == "host"
+
+    def test_breaker_probe_recovers_after_reset_window(self):
+        t = TSDB(_cfg(**self.CFG,
+                      **{"tsd.faults.device.compile_error_count": "2"}))
+        _seed(t)
+        _query(t)
+        _query(t)
+        assert t.device_breaker.state == t.device_breaker.OPEN
+        # roll past the reset window; drop caches so the probe query
+        # actually dispatches to the device (a host-cache hit would
+        # bypass the breaker bookkeeping, by design)
+        t.device_breaker._opened_at -= 61
+        t.drop_caches()
+        _query(t)
+        assert t.device_breaker.state == t.device_breaker.CLOSED
+        assert t.device_breaker.recoveries == 1
+
+
+class TestHealthRoute:
+    def test_schema_and_ok_status(self, tmp_path):
+        t = TSDB(_cfg(**{"tsd.storage.data_dir": str(tmp_path / "d")}))
+        _seed(t, 3)
+        router = HttpRpcRouter(t)
+        resp = router.handle(HttpRequest("GET", "/api/health", {}, {},
+                                         b""))
+        assert resp.status == 200
+        h = json.loads(resp.body)
+        assert h["status"] == "ok" and h["causes"] == []
+        assert h["wal"]["enabled"] and h["wal"]["sync_lag"] == 0
+        assert h["breakers"]["device.pipeline"]["state"] == "closed"
+        assert h["faults"] == {"armed": False, "sites": {}}
+        t.wal.close()
+
+    def test_breaker_state_exported_via_stats(self):
+        t = TSDB(_cfg())
+        collector = t.stats.collect()
+        names = {n for n, _, _ in collector.records}
+        assert "tsd.breaker.state" in names
+        assert "tsd.breaker.trips" in names

@@ -176,6 +176,222 @@ class TestStaticPathTraversal:
         assert resp.status == 200 and b"<!DOCTYPE html>" in resp.body
 
 
+@pytest.mark.robustness
+class TestOverloadShedding:
+    """Admission-control + connection-flood sweep over REAL sockets:
+    past the configured thresholds the server sheds with a structured
+    503 + ``Retry-After`` — never a 500, never a silent close, never a
+    hang — and /api/health accounts for every shed decision."""
+
+    BASE_CFG = {
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.tpu.warmup": "false",
+        "tsd.tpu.platform": "cpu",
+    }
+
+    @staticmethod
+    async def _start(tsdb):
+        from opentsdb_tpu.tsd.server import TSDServer
+        server = TSDServer(tsdb, host="127.0.0.1", port=0)
+        await server.start()
+        return server, server._server.sockets[0].getsockname()[1]
+
+    @staticmethod
+    async def _fetch(port, path):
+        import asyncio
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       port)
+        writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), 15)
+        writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ")[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        return status, headers, body
+
+    def test_query_flood_sheds_structured_503(self):
+        import asyncio
+        import time as _t
+        from opentsdb_tpu import TSDB, Config
+        tsdb = TSDB(Config(**self.BASE_CFG, **{
+            "tsd.query.admission.max_inflight": "1",
+            "tsd.query.admission.retry_after_s": "2"}))
+        tsdb.add_point("o.m", BASE + 30, 1.0, {"host": "a"})
+
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                orig = server.http_router.handle
+
+                def slow_handle(request):
+                    if "query" in request.path:
+                        _t.sleep(0.5)
+                    return orig(request)
+
+                server.http_router.handle = slow_handle
+                results = await asyncio.gather(*[
+                    self._fetch(port,
+                                "/api/query?start=1h-ago&m=sum:o.m")
+                    for _ in range(5)])
+                statuses = [s for s, _, _ in results]
+                assert 500 not in statuses
+                assert statuses.count(200) >= 1   # someone was served
+                sheds = [(s, h, b) for s, h, b in results if s == 503]
+                assert sheds                      # someone was shed
+                for s, h, b in sheds:
+                    assert h.get("retry-after") == "2"
+                    err = json.loads(b)["error"]
+                    assert err["code"] == 503
+                    assert "overloaded" in err["message"]
+                # writes and admin endpoints are never shed
+                st, _, _ = await self._fetch(port, "/api/version")
+                assert st == 200
+                st, _, body = await self._fetch(port, "/api/health")
+                assert st == 200
+                health = json.loads(body)
+                assert health["admission"]["shed_total"] == len(sheds)
+                assert health["admission"]["shed"]["inflight"] \
+                    == len(sheds)
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
+    def test_connection_flood_structured_refusal(self):
+        import asyncio
+        from opentsdb_tpu import TSDB, Config
+        tsdb = TSDB(Config(**self.BASE_CFG, **{
+            "tsd.core.connections.limit": "2"}))
+
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                held = []
+                for _ in range(2):
+                    held.append(await asyncio.open_connection(
+                        "127.0.0.1", port))
+                # the third connection is refused with a STRUCTURED
+                # body before the close, not a silent reset
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                raw = await asyncio.wait_for(reader.read(), 10)
+                writer.close()
+                assert b"503" in raw.split(b"\r\n", 1)[0]
+                body = raw.partition(b"\r\n\r\n")[2]
+                err = json.loads(body)["error"]
+                assert err["code"] == 503
+                assert "Connection limit" in err["message"]
+                assert tsdb.config  # server still alive
+                # the refusal shows up in stats AND health
+                collector = tsdb.stats.collect()
+                refused = [v for n, v, _ in collector.records
+                           if n == "tsd.connections.refused"]
+                assert refused and refused[0] >= 1
+                for _, w in held:
+                    w.close()
+                await asyncio.sleep(0.1)
+                st, _, body = await self._fetch(port, "/api/health")
+                assert st == 200
+                assert json.loads(body)["connections"]["refused"] >= 1
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
+    def test_armed_fault_sweep_never_500s(self):
+        """Overload sweep with faults armed everywhere at once: WAL
+        fsync down, device pipeline failing — puts stay acknowledged
+        (degraded durability), queries answer from the host fallback,
+        health reports every degradation, and NOTHING 500s or hangs."""
+        import asyncio
+        from opentsdb_tpu import TSDB, Config
+        tsdb = TSDB(Config(**self.BASE_CFG, **{
+            "tsd.query.host_tail_max_cells": "-1",
+            "tsd.query.host_tail_max_cells_linear": "-1",
+            "tsd.query.breaker.failure_threshold": "1",
+            "tsd.storage.wal.retry.attempts": "2",
+            "tsd.storage.wal.retry.base_ms": "1",
+            "tsd.faults.wal.fsync_error_rate": "1.0",
+            "tsd.faults.device.compile_error_rate": "1.0"},
+            **{"tsd.storage.data_dir": ""}))
+        tsdb.add_point("o.m", BASE + 30, 1.0, {"host": "a"})
+
+        async def scenario():
+            server, port = await self._start(tsdb)
+            try:
+                window = f"start={BASE * 1000}&end={(BASE + 60) * 1000}"
+                paths = [
+                    f"/api/query?{window}&m=sum:o.m",
+                    f"/api/query?{window}&m=max:o.m",
+                    "/api/health", "/api/version", "/api/stats",
+                ]
+                for path in paths:
+                    status, _, _ = await self._fetch(port, path)
+                    assert status == 200, (path, status)
+                assert tsdb.device_breaker.state == "open"
+                _, _, body = await self._fetch(port, "/api/health")
+                health = json.loads(body)
+                assert health["status"] == "degraded"
+                assert "breaker:device.pipeline" in health["causes"]
+                assert health["faults"]["armed"]
+            finally:
+                await server.stop()
+
+        asyncio.run(scenario())
+
+
+@pytest.mark.robustness
+class TestBreakerTripFallbackRecovery:
+    """Breaker lifecycle through the HTTP router: trip on injected
+    device failures (clients still get 200s from the host fallback),
+    serve degraded while open, recover through the half-open probe."""
+
+    def test_full_lifecycle(self):
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.tpu.warmup": "false",
+            "tsd.query.host_tail_max_cells": "-1",
+            "tsd.query.host_tail_max_cells_linear": "-1",
+            "tsd.query.breaker.failure_threshold": "2",
+            "tsd.query.breaker.reset_timeout_ms": "60000",
+            "tsd.faults.device.compile_error_count": "2"}))
+        for i in range(20):
+            t.add_point("b.m", BASE + i * 10, float(i), {"host": "a"})
+        router = HttpRpcRouter(t)
+
+        def q():
+            return router.handle(HttpRequest(
+                "GET", "/api/query",
+                {"start": [str(BASE * 1000)],
+                 "end": [str((BASE + 3600) * 1000)],
+                 "m": ["sum:b.m"]}, {}, b""))
+
+        def health():
+            return json.loads(router.handle(HttpRequest(
+                "GET", "/api/health", {}, {}, b"")).body)
+
+        # trip: both injected failures answered by the host fallback
+        assert q().status == 200
+        assert q().status == 200
+        assert t.device_breaker.state == "open"
+        assert health()["breakers"]["device.pipeline"]["fallbacks"] == 2
+        # degraded serving while open
+        assert q().status == 200
+        assert health()["status"] == "degraded"
+        # recovery: past the reset window the probe runs on the device
+        # (fault exhausted) and closes the breaker
+        t.device_breaker._opened_at -= 61
+        t.drop_caches()
+        assert q().status == 200
+        assert t.device_breaker.state == "closed"
+        assert health()["status"] == "ok"
+
+
 class TestApiVersionNegotiation:
     """(ref: HttpQuery.apiVersion, MAX_API_VERSION=1 — unknown
     versions are a 400, not silently treated as v1)."""

@@ -1,0 +1,128 @@
+"""WAL torn-tail hardening (regression suite beside
+``tests/test_fsck_corruption.py``): a crash mid-write leaves a partial
+final record in the last segment. Replay must apply exactly the intact
+prefix, physically truncate the torn bytes (logged, never raised), and
+leave the log appendable — every corruption shape below reopens the
+same data_dir through the full TSDB startup path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+pytestmark = pytest.mark.robustness
+
+BASE = 1356998400
+
+
+def _cfg(d):
+    return Config(**{"tsd.core.auto_create_metrics": "true",
+                     "tsd.tpu.warmup": "false",
+                     "tsd.storage.data_dir": d})
+
+
+def _write(d, n=5):
+    t = TSDB(_cfg(d))
+    for i in range(n):
+        t.add_point("w.m", BASE + i * 10, float(i), {"host": "a"})
+    t.wal.close()
+
+
+def _segments(d):
+    wal_dir = os.path.join(d, "wal")
+    return sorted(os.path.join(wal_dir, f)
+                  for f in os.listdir(wal_dir) if f.endswith(".log"))
+
+
+def _values(t):
+    out = t.execute_query(TSQuery.from_json({
+        "start": BASE * 1000, "end": (BASE + 3600) * 1000,
+        "queries": [{"metric": "w.m", "aggregator": "sum"}]
+    }).validate())
+    return [v for _, v in out[0].dps] if out else []
+
+
+def test_truncated_payload_keeps_prefix_and_repairs_file(tmp_path):
+    d = str(tmp_path / "d")
+    _write(d, 5)
+    (seg,) = _segments(d)
+    size = os.path.getsize(seg)
+    os.truncate(seg, size - 3)  # crash tore the last record's payload
+
+    t = TSDB(_cfg(d))
+    assert _values(t) == [0.0, 1.0, 2.0, 3.0]  # intact prefix only
+    # the torn bytes are gone: the file now ends at the last good record
+    repaired = os.path.getsize(seg)
+    assert repaired < size - 3
+    t.wal.close()
+
+    # idempotent: a second startup sees a clean file and the same data
+    t2 = TSDB(_cfg(d))
+    assert _values(t2) == [0.0, 1.0, 2.0, 3.0]
+    assert os.path.getsize(seg) == repaired
+    t2.wal.close()
+
+
+def test_partial_header_fragment_truncated(tmp_path):
+    d = str(tmp_path / "d")
+    _write(d, 3)
+    (seg,) = _segments(d)
+    size = os.path.getsize(seg)
+    with open(seg, "ab") as fh:
+        fh.write(b"\x02\x10\x00")  # 3 bytes of a 17-byte header
+
+    t = TSDB(_cfg(d))
+    assert _values(t) == [0.0, 1.0, 2.0]  # nothing lost, nothing extra
+    assert os.path.getsize(seg) == size   # fragment removed
+    t.wal.close()
+
+
+def test_corrupt_crc_garbage_truncated(tmp_path):
+    d = str(tmp_path / "d")
+    _write(d, 3)
+    (seg,) = _segments(d)
+    size = os.path.getsize(seg)
+    with open(seg, "ab") as fh:
+        # a full-sized fake record whose CRC cannot match
+        fh.write(b"\x02" + b"\xde\xad\xbe\xef" * 8)
+
+    t = TSDB(_cfg(d))
+    assert _values(t) == [0.0, 1.0, 2.0]
+    assert os.path.getsize(seg) == size
+    t.wal.close()
+
+
+def test_bad_magic_segment_skipped_never_raises(tmp_path):
+    d = str(tmp_path / "d")
+    _write(d, 3)
+    (seg,) = _segments(d)
+    with open(seg, "wb") as fh:
+        fh.write(b"NOTAWAL!")  # whole file is junk
+
+    t = TSDB(_cfg(d))  # must come up, not raise
+    # nothing recovered: the metric UID itself is gone
+    assert t.store.total_points() == 0
+    # unrecoverable segment left for inspection, not half-truncated
+    assert os.path.getsize(seg) == 8
+    t.wal.close()
+
+
+def test_log_stays_appendable_after_repair(tmp_path):
+    d = str(tmp_path / "d")
+    _write(d, 4)
+    (seg,) = _segments(d)
+    os.truncate(seg, os.path.getsize(seg) - 2)
+
+    t = TSDB(_cfg(d))
+    assert _values(t) == [0.0, 1.0, 2.0]
+    t.add_point("w.m", BASE + 100, 9.0, {"host": "a"})
+    t.wal.close()
+
+    t2 = TSDB(_cfg(d))
+    assert _values(t2) == [0.0, 1.0, 2.0, 9.0]
+    t2.wal.close()
