@@ -47,6 +47,11 @@ def _run_query(tsdb, serializer, query_obj, repeats: int
     from opentsdb_tpu.query.model import TSQuery
     times = []
     body = b""
+    # the serve-path RESULT cache is disabled for the warm loop so
+    # p50 stays comparable with earlier rounds (it measures the real
+    # scan -> pipeline -> serialize chain); the repeat-query loop at
+    # the end re-enables it and reports the cache-hit numbers
+    tsdb.config.override_config("tsd.query.cache.enable", "false")
     # server-start warmup first (tsd.tpu.warmup): cold_ms below then
     # measures the first query of a WARMED server — the production
     # number (VERDICT r03 #3: cold tails were 14-16s unwarmed)
@@ -83,7 +88,37 @@ def _run_query(tsdb, serializer, query_obj, repeats: int
                                      1)
     stages["serializeMedianMs"] = round(
         _percentile(ser_times, 50) * 1e3, 1)
+    # repeat-query (cache-hit) metric: the same dashboard refresh
+    # answered from the serve-path result cache — one populating run,
+    # then timed hits. repeat_exec is the engine-only number (what the
+    # cache removes); repeat_p50 includes serialization, which a hit
+    # still pays.
+    tsdb.config.override_config("tsd.query.cache.enable", "true")
+    tsq = TSQuery.from_json(query_obj).validate()
+    tsdb.execute_query(tsq)  # populate
+    hit_full, hit_exec = [], []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        tsq = TSQuery.from_json(query_obj).validate()
+        results = tsdb.execute_query(tsq)
+        t1 = time.perf_counter()
+        serializer.format_query(tsq, results)
+        t2 = time.perf_counter()
+        hit_exec.append(t1 - t0)
+        hit_full.append(t2 - t0)
+    rcache = tsdb.result_cache
+    assert rcache is not None and rcache.hits >= repeats, \
+        "repeat loop did not hit the result cache"
+    repeat_exec_p50 = _percentile(hit_exec, 50) * 1e3
+    warm_exec_p50 = _percentile(exec_times, 50) * 1e3
+    out_extra = {
+        "repeat_p50_ms": round(_percentile(hit_full, 50) * 1e3, 1),
+        "repeat_exec_p50_ms": round(repeat_exec_p50, 2),
+        "cache_speedup": round(
+            warm_exec_p50 / max(repeat_exec_p50, 1e-3), 1),
+    }
     return {
+        **out_extra,
         "p50_ms": round(_percentile(times, 50) * 1e3, 1),
         "min_ms": round(min(times) * 1e3, 1),
         "max_ms": round(max(times) * 1e3, 1),

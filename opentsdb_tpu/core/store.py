@@ -292,8 +292,12 @@ class TimeSeriesStore:
     """
 
     # fault-injection hook for the scan path (tsd.faults.store_*);
-    # set by the owning TSDB, None everywhere else
+    # set by the owning TSDB, None everywhere else. Rollup tier /
+    # preagg stores override fault_site with "rollup.store" so a
+    # degraded tier is armable/observable independently of the raw
+    # store (tsd.faults.rollup.store_*).
     fault_injector = None
+    fault_site = "store"
 
     def __init__(self, num_shards: int | None = None):
         self.instance_id = next(STORE_INSTANCE_IDS)
@@ -494,7 +498,7 @@ class TimeSeriesStore:
         is a flat columnar batch, not a tree of iterators.
         """
         if self.fault_injector is not None:
-            self.fault_injector.check("store")
+            self.fault_injector.check(self.fault_site)
         sids = np.asarray(series_ids, dtype=np.int64)
         ts_parts: list[np.ndarray] = []
         val_parts: list[np.ndarray] = []
@@ -532,7 +536,7 @@ class TimeSeriesStore:
         """Row-padded variant of :meth:`materialize` — same per-series
         slice cost, but each series lands in its own row."""
         if self.fault_injector is not None:
-            self.fault_injector.check("store")
+            self.fault_injector.check(self.fault_site)
         sids = np.asarray(series_ids, dtype=np.int64)
         slices = [self._series[sid].buffer.slice_range(start_ms, end_ms)
                   for sid in sids]

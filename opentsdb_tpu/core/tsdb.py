@@ -112,7 +112,8 @@ class TSDB:
             from opentsdb_tpu.rollup.store import RollupStore
             self.rollup_store = RollupStore(
                 self.rollup_config,
-                store_factory=lambda: make_store(self.config))
+                store_factory=lambda: make_store(self.config),
+                fault_injector=self.faults)
         else:
             self.rollup_store = None
         from opentsdb_tpu.core.histogram import HistogramCodecManager
@@ -152,6 +153,20 @@ class TSDB:
         self._host_prep_cache = None
         self._host_cache_mb = self.config.get_int(
             "tsd.query.host_cache_mb", 512)
+        # serve-path query RESULT cache (epoch-invalidated, single-
+        # flight coalescing; opentsdb_tpu/query/result_cache.py); lazy
+        self._result_cache = None
+        self._result_cache_mb = self.config.get_int(
+            "tsd.query.cache.mb", 256)
+        # parallel sub-query fan-out pool: a DEDICATED executor, not
+        # the server's _query_pool — parent queries RUN on that pool,
+        # so fanning sub-queries back onto it deadlocks the moment
+        # every worker holds a parent waiting on children that can
+        # never be scheduled. Admission control still counts the whole
+        # TSQuery once (per HTTP request, at the server); lazy.
+        self._fanout_pool = None
+        self._fanout_workers = self.config.get_int(
+            "tsd.query.fanout.workers", 4)
         # host-side per-(store, metric) TagMatrix cache, invalidated by
         # series count (the metric index is append-only)
         self._tagmat_cache: dict = {}
@@ -790,6 +805,64 @@ class TSDB:
                     self._host_prep_cache = cache
         return self._host_prep_cache
 
+    @property
+    def result_cache(self):
+        """Serve-path query result cache
+        (:mod:`opentsdb_tpu.query.result_cache`), or None when
+        disabled. ``tsd.query.cache.enable`` is consulted per call so
+        operators (and the bench) can toggle it at runtime without
+        losing the populated cache."""
+        if self._result_cache_mb <= 0 or not self.config.get_bool(
+                "tsd.query.cache.enable", True):
+            return None
+        if self._result_cache is None:
+            with self._device_cache_lock:
+                if self._result_cache is None:
+                    from opentsdb_tpu.query.result_cache import \
+                        QueryResultCache
+                    cache = QueryResultCache(
+                        self._result_cache_mb * (1 << 20),
+                        shards=self.config.get_int(
+                            "tsd.query.cache.shards", 8))
+                    self.stats.register(cache)
+                    self._result_cache = cache
+        return self._result_cache
+
+    @property
+    def query_fanout_pool(self):
+        """Executor independent sub-queries of one TSQuery fan out
+        onto (None = serial; ``tsd.query.fanout.workers``). See the
+        constructor comment for why this is NOT the server's
+        _query_pool."""
+        if self._fanout_pool is None and self._fanout_workers > 0:
+            with self._device_cache_lock:
+                if self._fanout_pool is None:
+                    import concurrent.futures
+                    self._fanout_pool = \
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=self._fanout_workers,
+                            thread_name_prefix="tsd-subq")
+        return self._fanout_pool
+
+    def serve_version(self) -> tuple:
+        """Version tuple over every store the query surface can read
+        (raw + rollup tiers + preagg + histograms + annotations):
+        cheap counter reads, bumped by every write and every
+        destructive op. Read-side caches key their entries on it, so
+        a version mismatch <=> the data MAY have changed — no cached
+        result can ever outlive a write it should reflect."""
+        s = self.store
+        parts: list = [
+            s.points_written, getattr(s, "mutation_epoch", 0),
+            self._histogram_version,
+            self.histogram_store.points_written,
+            self.histogram_store.mutation_epoch,
+            getattr(self.annotations, "version", 0),
+        ]
+        if self.rollup_store is not None:
+            parts.append(self.rollup_store.version())
+        return tuple(parts)
+
     def new_query(self):
         from opentsdb_tpu.query.engine import QueryEngine
         return QueryEngine(self)
@@ -845,6 +918,8 @@ class TSDB:
 
     def shutdown(self) -> None:
         self.flush()
+        if self._fanout_pool is not None:
+            self._fanout_pool.shutdown(wait=False)
         if self.wal is not None:
             self.wal.close()
         if self.rt_publisher is not None:
@@ -860,6 +935,8 @@ class TSDB:
             self._device_grid_cache.clear()
         if self._host_prep_cache is not None:
             self._host_prep_cache.clear()
+        if self._result_cache is not None:
+            self._result_cache.clear()
 
     # ------------------------------------------------------------------
     # stats (ref: TSDB.collectStats :753)

@@ -72,12 +72,17 @@ class AnnotationStore:
         # set by TSDB when a write-ahead log is active; edits are
         # crash-durable like the reference's HBase-backed annotations
         self.wal = None
+        # bumped on every mutation: annotations ride inside query
+        # results, so the serve-path result cache folds this into its
+        # invalidation version (TSDB.serve_version)
+        self.version = 0
 
     def store(self, note: Annotation, _wal: bool = True) -> Annotation:
         if not note.start_time:
             raise ValueError("missing or invalid start time")
         with self._lock:
             self._by_tsuid.setdefault(note.tsuid, {})[note.start_time] = note
+            self.version += 1
         if _wal and self.wal is not None:
             self.wal.log_annotation(note.to_json() | {"tsuid": note.tsuid})
             self.wal.sync()
@@ -99,6 +104,8 @@ class AnnotationStore:
         with self._lock:
             d = self._by_tsuid.get(tsuid, {})
             removed = d.pop(start_time, None) is not None
+            if removed:
+                self.version += 1
         if removed and _wal and self.wal is not None:
             self.wal.log_annotation_delete(tsuid, start_time)
             self.wal.sync()
@@ -122,6 +129,8 @@ class AnnotationStore:
                     del d[t]
                     removed.append((tsuid, t))
                 count += len(doomed)
+            if count:
+                self.version += 1
         if removed and self.wal is not None:
             for tsuid, t in removed:
                 self.wal.log_annotation_delete(tsuid, t)

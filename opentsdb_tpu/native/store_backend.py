@@ -204,8 +204,10 @@ class NativeTimeSeriesStore:
     """C++-backed TimeSeriesStore (same duck-typed interface)."""
 
     # fault-injection hook for the scan path (tsd.faults.store_*);
-    # set by the owning TSDB, None everywhere else
+    # set by the owning TSDB, None everywhere else; rollup tier /
+    # preagg instances override fault_site with "rollup.store"
     fault_injector = None
+    fault_site = "store"
 
     def __init__(self, num_shards: int | None = None,
                  materialize_threads: int | None = None):
@@ -361,7 +363,7 @@ class NativeTimeSeriesStore:
     def materialize(self, series_ids: Sequence[int], start_ms: int,
                     end_ms: int) -> PointBatch:
         if self.fault_injector is not None:
-            self.fault_injector.check("store")
+            self.fault_injector.check(self.fault_site)
         sids = np.ascontiguousarray(series_ids, dtype=np.int64)
         counts = np.empty(len(sids), dtype=np.int64)
         rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
@@ -440,7 +442,7 @@ class NativeTimeSeriesStore:
         per-row offsets ``i * Pmax`` — each series' contiguous run lands
         in its own row of the padded buffers, no extra pass."""
         if self.fault_injector is not None:
-            self.fault_injector.check("store")
+            self.fault_injector.check(self.fault_site)
         sids = np.ascontiguousarray(series_ids, dtype=np.int64)
         counts = np.empty(len(sids), dtype=np.int64)
         rc = self._lib.tss_count_range(self._h, _ptr(sids), len(sids),
@@ -486,7 +488,7 @@ class NativeTimeSeriesStore:
         instead of receiving every point (SURVEY §7: HBM bandwidth is
         the bottleneck; don't ship what the host can pre-reduce 60x)."""
         if self.fault_injector is not None:
-            self.fault_injector.check("store")
+            self.fault_injector.check(self.fault_site)
         sids = np.ascontiguousarray(series_ids, dtype=np.int64)
         s = len(sids)
         sums = np.empty((s, nbuckets), dtype=np.float64)

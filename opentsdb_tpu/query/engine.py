@@ -99,6 +99,36 @@ class QueryResult:
             return 0
         return len(self.dps_arrays[0])
 
+    def with_sub_index(self, index: int) -> "QueryResult":
+        """A shallow twin carrying a different ``sub_query_index`` —
+        the result-cache hit path re-labels shared results when the
+        same sub-query content sits at a different position in the
+        requesting TSQuery (the cache key excludes the index)."""
+        if self.sub_query_index == index:
+            return self
+        return QueryResult(
+            self.metric, self.tags, self.aggregated_tags,
+            dps=self._dps, tsuids=self.tsuids,
+            annotations=self.annotations,
+            global_annotations=self.global_annotations,
+            sub_query_index=index, dps_arrays=self.dps_arrays)
+
+    def cache_copy(self) -> "QueryResult":
+        """Detached twin for the result cache: shares the immutable
+        columnar payload but NOT the lazily-materialized ``_dps``
+        tuple list — a consumer touching ``.dps`` (~100 bytes/point)
+        fattens only its own request-scoped copy, so a cached entry's
+        real footprint stays what ``results_nbytes`` charged against
+        the byte budget. ``_dps`` is kept only when it IS the payload
+        (no columnar twin)."""
+        return QueryResult(
+            self.metric, self.tags, self.aggregated_tags,
+            dps=self._dps if self.dps_arrays is None else None,
+            tsuids=self.tsuids, annotations=self.annotations,
+            global_annotations=self.global_annotations,
+            sub_query_index=self.sub_query_index,
+            dps_arrays=self.dps_arrays)
+
     def __repr__(self) -> str:  # debugging/test output only
         return (f"QueryResult(metric={self.metric!r}, "
                 f"tags={self.tags!r}, "
@@ -436,10 +466,119 @@ class QueryEngine:
 
     def run(self, ts_query: TSQuery,
             stats: QueryStats | None = None) -> list[QueryResult]:
+        subs = ts_query.queries
+        if len(subs) > 1 and not ts_query.delete:
+            # delete=true stays serial: a sub's delete_range shifts
+            # the per-series buffers IN PLACE while a parallel sibling
+            # may still hold live views into them (scanned-and-deleted
+            # semantics make the order matter too)
+            pool = self.tsdb.query_fanout_pool
+            if pool is not None:
+                return self._run_fanout(ts_query, subs, stats, pool)
         results: list[QueryResult] = []
-        for sub in ts_query.queries:
-            results.extend(self._run_sub(ts_query, sub, stats))
+        for sub in subs:
+            results.extend(self._run_sub_cached(ts_query, sub, stats))
         return results
+
+    def _run_fanout(self, tsq: TSQuery, subs, stats,
+                    pool) -> list[QueryResult]:
+        """Dispatch independent sub-queries in parallel and join.
+
+        Per-sub result ordering is preserved (results concatenate in
+        sub order regardless of completion order) and per-sub
+        QueryStats attribution is intact: every sub records into the
+        shared (now lock-guarded) QueryStats. The first sub runs on
+        the calling thread — it already holds a worker slot of the
+        server's _query_pool, and idling it while children queue
+        would waste exactly one unit of the fan-out budget. On error,
+        the earliest failing sub (in sub order) wins after every
+        in-flight sibling has been joined — a still-running future
+        must not outlive its TSQuery."""
+        futures = [pool.submit(self._run_sub_cached, tsq, sub, stats)
+                   for sub in subs[1:]]
+        results: list[QueryResult] = []
+        first_err: BaseException | None = None
+        try:
+            results.extend(self._run_sub_cached(tsq, subs[0], stats))
+        except BaseException as exc:  # noqa: BLE001 - joined below
+            first_err = exc
+        for fut in futures:
+            try:
+                out = fut.result()
+            except BaseException as exc:  # noqa: BLE001
+                if first_err is None:
+                    first_err = exc
+            else:
+                if first_err is None:
+                    results.extend(out)
+        if first_err is not None:
+            raise first_err
+        return results
+
+    def _run_sub_cached(self, tsq: TSQuery, sub: TSSubQuery,
+                        stats: QueryStats | None) -> list[QueryResult]:
+        """One sub-query through the serve-path result cache: hits
+        skip the engine entirely, misses single-flight (concurrent
+        identical queries share ONE execution and a failed leader
+        poisons nothing)."""
+        from opentsdb_tpu.query import result_cache as rc_mod
+        cache = self.tsdb.result_cache
+        if cache is None:
+            return self._run_sub(tsq, sub, stats)
+        plan = rc_mod.cache_plan(tsq, sub, self.tsdb.config)
+        if plan is None:
+            cache.count_bypass()
+            return self._run_sub(tsq, sub, stats)
+        key, ttl_ms = plan
+        # the version MUST be captured before compute: a write landing
+        # mid-execution then leaves the entry already-stale instead of
+        # wrongly fresh (see QueryResultCache.get_or_compute)
+        version = self._sub_version(sub)
+        value, outcome = cache.get_or_compute(
+            key, version, lambda: self._run_sub(tsq, sub, stats),
+            ttl_ms)
+        if stats and outcome != rc_mod.MISS:
+            stats.add_stat(
+                QueryStat.RESULT_CACHE_HIT
+                if outcome == rc_mod.HIT
+                else QueryStat.RESULT_CACHE_COALESCED, 1)
+        if value and value[0].sub_query_index != sub.index:
+            value = [r.with_sub_index(sub.index) for r in value]
+        return value
+
+    def _sub_version(self, sub: TSSubQuery) -> tuple:
+        """Invalidation version over the stores THIS sub-query's plan
+        reads — not the whole TSDB — so dashboards answered from a
+        rollup tier keep hitting while raw ingest streams in (and vice
+        versa). Tier selection is re-derived per lookup, so a write
+        that flips the selection (e.g. the first point landing in a
+        previously-empty tier) changes the selected store identity and
+        misses naturally. Falls back to the conservative whole-TSDB
+        :meth:`TSDB.serve_version` when selection itself fails (the
+        engine will surface the same error to the caller)."""
+        t = self.tsdb
+        ann = getattr(t.annotations, "version", 0)
+        if sub.percentiles:
+            return ("hist", t._histogram_version,
+                    t.histogram_store.points_written,
+                    t.histogram_store.mutation_epoch, ann)
+        try:
+            (store, _metric, _sids, _scale, avg_count_store,
+             _ds) = self._select_store(sub)
+        except Exception:  # noqa: BLE001 - compute re-raises for real
+            return ("all", t.serve_version(), ann)
+        parts = ["sel", ann, _store_id(store), store.points_written,
+                 getattr(store, "mutation_epoch", 0)]
+        if avg_count_store is not None:
+            # the avg-over-budget branch in _run_sub may still swap to
+            # the RAW store mid-plan; cover both outcomes
+            raw = t.store
+            parts += [_store_id(avg_count_store),
+                      avg_count_store.points_written,
+                      getattr(avg_count_store, "mutation_epoch", 0),
+                      _store_id(raw), raw.points_written,
+                      getattr(raw, "mutation_epoch", 0)]
+        return tuple(parts)
 
     # ------------------------------------------------------------------
 
@@ -557,9 +696,17 @@ class QueryEngine:
                 # linear agg must not serve a rank-class query whose
                 # budget would have placed it on the accelerator
                 # (their group stages differ by orders of magnitude on
-                # one CPU core)
-                acls = "lin" if not _rank_class_agg(sub.agg.name) \
-                    else "rank"
+                # one CPU core). The rank-class budget is
+                # cells * groups, so the bucketed group count is part
+                # of the key — two group-by cardinalities of the same
+                # series set must not share a placement (mirrors the
+                # mesh ('pct', num_groups) key above)
+                if not _rank_class_agg(sub.agg.name):
+                    acls = "lin"
+                else:
+                    from opentsdb_tpu.ops import shapes as _shapes
+                    acls = ("rank",
+                            _shapes.shape_bucket(num_groups + 1))
             pkey = ("prep", _store_id(store),
                     array_digest(np.ascontiguousarray(sids)),
                     tsq.start_ms, tsq.end_ms, sub.downsample or "union",

@@ -11,6 +11,7 @@ jobs"); the TPU build ships one as a jitted segmented reduction.
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 from opentsdb_tpu.core.store import PointBatch, TimeSeriesStore
@@ -18,17 +19,32 @@ from opentsdb_tpu.rollup.config import RollupConfig
 
 
 class RollupStore:
-    def __init__(self, config: RollupConfig, store_factory=None):
+    def __init__(self, config: RollupConfig, store_factory=None,
+                 fault_injector=None):
         self.config = config
         # tier stores come from the same backend factory as the raw
         # store (native C++ by default) — the rollup job's bulk grid
         # writes were 15x slower through the portable Python store
         self._factory = store_factory or TimeSeriesStore
+        # scans of tier/preagg stores carry their own fault site
+        # ("rollup.store") so a degraded rollup tier is distinguishable
+        # from a degraded raw store; lazily-created tiers are wired the
+        # moment they exist (ROADMAP open item)
+        self.fault_injector = fault_injector
+        # guards _tiers shape: writers create tiers lazily while query
+        # threads snapshot the dict for the serve version
+        self._tiers_lock = threading.Lock()
         # (interval, agg) -> store
         self._tiers: dict[tuple[str, str], TimeSeriesStore] = {}
-        self._preagg = self._factory()
+        self._preagg = self._new_store()
         # (interval, agg) -> (mutation_epoch, points_written, result)
         self._has_data_cache: dict[tuple[str, str], tuple] = {}
+
+    def _new_store(self) -> TimeSeriesStore:
+        store = self._factory()
+        store.fault_injector = self.fault_injector
+        store.fault_site = "rollup.store"
+        return store
 
     def tier(self, interval: str, agg: str) -> TimeSeriesStore:
         agg = agg.lower()
@@ -40,8 +56,26 @@ class RollupStore:
         key = (interval, agg)
         store = self._tiers.get(key)
         if store is None:
-            store = self._tiers[key] = self._factory()
+            with self._tiers_lock:
+                store = self._tiers.get(key)
+                if store is None:
+                    store = self._tiers[key] = self._new_store()
         return store
+
+    def version(self) -> tuple:
+        """Write/delete version over every tier + the preagg store,
+        including the tier COUNT (a tier springing into existence can
+        flip tier selection for queries that previously read raw).
+        Consumed by the serve-path result cache via
+        :meth:`TSDB.serve_version`."""
+        with self._tiers_lock:
+            tiers = list(self._tiers.items())
+        parts: list = [len(tiers), self._preagg.points_written,
+                       getattr(self._preagg, "mutation_epoch", 0)]
+        for key, store in sorted(tiers):
+            parts.append((key, store.points_written,
+                          getattr(store, "mutation_epoch", 0)))
+        return tuple(parts)
 
     def add_point(self, interval: str, agg: str, metric_id: int,
                   tag_ids: Sequence[tuple[int, int]], ts_ms: int,

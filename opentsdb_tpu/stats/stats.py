@@ -168,6 +168,10 @@ class QueryStat(Enum):
     QUERY_SCAN_TIME = "queryScanTime"
     NAN_DPS = "nanDPs"
     PROCESSING_PRE_WRITE_TIME = "processingPreWriteTime"
+    # serve-path result cache outcomes (no reference equivalent: the
+    # reference's graph cache lives outside QueryStats entirely)
+    RESULT_CACHE_HIT = "resultCacheHit"
+    RESULT_CACHE_COALESCED = "resultCacheCoalesced"
 
 
 # time-based stats that get the reference's derived max*/avg* twins in
@@ -206,6 +210,10 @@ class QueryStats:
         self.start_ns = time.monotonic_ns()
         self.start_time = time.time()
         self.stats: dict[str, float] = {}
+        # sub-queries of one TSQuery may record concurrently (the
+        # engine's parallel fan-out); the dict read-modify-write in
+        # add_stat must not lose updates
+        self._stats_lock = threading.Lock()
         self.executed = False
         # identity for the duplicate check: endpoint + query content
         # (ref: QueryStats.java:70-73 — "hash is the remote + query").
@@ -233,7 +241,9 @@ class QueryStats:
             QueryStats._running[self.query_id] = self
 
     def add_stat(self, stat: QueryStat, value: float) -> None:
-        self.stats[stat.value] = self.stats.get(stat.value, 0.0) + value
+        with self._stats_lock:
+            self.stats[stat.value] = \
+                self.stats.get(stat.value, 0.0) + value
 
     def mark_serialization_successful(self) -> None:
         """The query produced a response (ref: the reference flips

@@ -1084,6 +1084,17 @@ class HttpRpcRouter:
             if breaker.state != breaker.CLOSED:
                 causes.append(f"breaker:{breaker.name}")
         faults = getattr(t, "faults", None)
+        # the raw attribute, not the property: health must not force
+        # the lazy cache into existence just to report on it
+        rcache = getattr(t, "_result_cache", None)
+        if rcache is not None:
+            cache_info = rcache.health_info()
+            cache_info["enabled"] = t.config.get_bool(
+                "tsd.query.cache.enable", True)
+        else:
+            cache_info = {"enabled": t.config.get_bool(
+                "tsd.query.cache.enable", True)
+                and t.config.get_int("tsd.query.cache.mb", 256) > 0}
         doc: dict[str, Any] = {
             "status": "degraded" if causes else "ok",
             "degraded": bool(causes),
@@ -1093,6 +1104,7 @@ class HttpRpcRouter:
             "breakers": breakers,
             "faults": (faults.health_info() if faults is not None
                        else {"armed": False, "sites": {}}),
+            "query_cache": cache_info,
         }
         server = self.server
         if server is not None:
@@ -1159,8 +1171,13 @@ class HttpRpcRouter:
         import os
         root = self._static_root()
         rel = "/".join(rest)
+        root_real = os.path.realpath(root)
         full = os.path.realpath(os.path.join(root, rel))
-        if not full.startswith(os.path.realpath(root)) \
+        # containment needs the separator: a bare prefix check lets a
+        # SIBLING directory sharing the root's name prefix through
+        # (static_private passes startswith(".../static"))
+        if (full != root_real
+                and not full.startswith(root_real + os.sep)) \
                 or not os.path.isfile(full):
             raise HttpError(404, "File not found")
         import mimetypes

@@ -495,9 +495,23 @@ class TSDServer:
             te_tokens = [t.strip() for t in
                          headers.get("transfer-encoding", "")
                          .lower().split(",") if t.strip()]
-            if te_tokens and te_tokens[-1] == "chunked":
-                # (ref: tsd.http.request_enable_chunked — default off,
-                # HttpQuery rejects chunked requests with a 400)
+            if te_tokens and te_tokens[-1] != "chunked":
+                # RFC 7230 §3.3.3: when Transfer-Encoding is present
+                # and its FINAL coding is not chunked, the body length
+                # is unknowable — falling through to Content-Length
+                # framing is a request-smuggling precondition behind
+                # intermediaries. 400 and close; the connection's
+                # framing cannot be resynchronized.
+                await self._refuse(
+                    reader, writer, HttpResponse(
+                        400, b'{"error":{"code":400,"message":'
+                        b'"Unsupported Transfer-Encoding: final '
+                        b'coding must be chunked"}}'))
+                return
+            if te_tokens:
+                # final coding is chunked (anything else was refused
+                # above). (ref: tsd.http.request_enable_chunked —
+                # default off, HttpQuery rejects chunked with a 400)
                 if not self.tsdb.config.get_bool(
                         "tsd.http.request_enable_chunked", False):
                     await self._refuse(

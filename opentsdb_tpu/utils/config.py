@@ -79,6 +79,27 @@ _DEFAULTS: dict[str, str] = {
     "tsd.http.request_enable_chunked": "false",
     "tsd.query.timeout": "0",
     "tsd.query.allow_simultaneous_duplicates": "true",
+    # serve-path query RESULT cache (query/result_cache.py): sharded
+    # LRU of engine result groups keyed on the normalized query +
+    # the mutation epoch of every store read, so writes invalidate
+    # implicitly; concurrent identical queries single-flight onto one
+    # execution. enable is consulted per query (runtime-togglable);
+    # mb = 0 disables permanently.
+    "tsd.query.cache.enable": "true",
+    "tsd.query.cache.mb": "256",
+    "tsd.query.cache.shards": "8",
+    #   relative-time (end=now) queries may be served up to one
+    #   downsample interval stale, clamped to ttl_max_s (the
+    #   reference's GraphHandler staleness rule); relative queries
+    #   WITHOUT a downsample are cached for ttl_relative_s (0 = not
+    #   cached at all, the conservative default)
+    "tsd.query.cache.ttl_max_s": "300",
+    "tsd.query.cache.ttl_relative_s": "0",
+    # parallel sub-query fan-out: independent sub-queries of one
+    # TSQuery dispatch onto a dedicated worker pool and join (0 =
+    # serial). Deliberately NOT the server's query pool — parents run
+    # there and would deadlock waiting on unschedulable children.
+    "tsd.query.fanout.workers": "4",
     "tsd.query.limits.bytes.default": "0",
     "tsd.query.limits.data_points.default": "0",
     "tsd.query.skip_unresolved_tagvs": "false",

@@ -18,6 +18,10 @@ def _tsdb(**extra):
     return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
                           "tsd.query.host_tail_max_cells": "-1",
                           "tsd.query.host_tail_max_cells_linear": "-1",
+                          # warm repeats must actually REACH the
+                          # device cache under test, not the serve-
+                          # path result cache in front of it
+                          "tsd.query.cache.enable": "false",
                           **extra}))
 
 
@@ -276,3 +280,51 @@ class TestMatchSeriesByTags:
             s1, s2, np.asarray(sids1, dtype=np.int64), mid)
         assert (out >= 0).sum() == 1
         assert out[2] >= 0
+
+
+class TestRankPrepKeyGroupCount:
+    """Single-device prep-cache key regression (ADVICE r05 medium):
+    the rank-class budget is cells * groups, so two group-by
+    cardinalities over the same series set must NOT share a
+    PreparedBatch placement — the bucketed group count is part of the
+    key, mirroring the mesh ('pct', num_groups) key."""
+
+    def _seed_two_cardinalities(self):
+        t = _tsdb()
+        rng = np.random.default_rng(4)
+        ts = BASE + np.arange(0, 1200, 60)
+        for i in range(40):
+            t.add_points("rank.m", ts, rng.normal(10, 2, len(ts)),
+                         {"host": f"h{i:02d}", "dc": f"d{i % 2}"})
+        return t
+
+    def _pq(self, gb_tagk):
+        filters = []
+        if gb_tagk:
+            filters = [{"type": "wildcard", "tagk": gb_tagk,
+                        "filter": "*", "groupBy": True}]
+        return TSQuery.from_json({
+            "start": BASE * 1000, "end": (BASE + 1200) * 1000,
+            "queries": [{"metric": "rank.m", "aggregator": "p95",
+                         "filters": filters}]}).validate()
+
+    def test_cardinalities_get_distinct_prep_entries(self):
+        t = self._seed_two_cardinalities()
+        t.execute_query(self._pq("host"))   # 40 groups
+        t.execute_query(self._pq("dc"))     # 2 groups
+        cache = t.device_grid_cache
+        prep_keys = [k for k in cache._entries if k[0] == "prep"]
+        assert len(prep_keys) == 2, prep_keys
+        # both carry the rank class WITH a bucketed group count
+        classes = {k[-1] for k in prep_keys}
+        assert all(isinstance(c, tuple) and c[0] == "rank"
+                   for c in classes)
+        assert len(classes) == 2  # distinct group-count buckets
+
+    def test_no_groupby_vs_groupby_distinct(self):
+        t = self._seed_two_cardinalities()
+        t.execute_query(self._pq(None))     # 1 group
+        t.execute_query(self._pq("host"))   # 40 groups
+        cache = t.device_grid_cache
+        prep_keys = [k for k in cache._entries if k[0] == "prep"]
+        assert len(prep_keys) == 2, prep_keys

@@ -351,6 +351,10 @@ class TestDeviceBreakerFallback:
         # force device placement (host-tail would bypass the breaker)
         "tsd.query.host_tail_max_cells": "-1",
         "tsd.query.host_tail_max_cells_linear": "-1",
+        # repeated identical queries must keep REACHING the device so
+        # each consumes an armed fault — the serve-path result cache
+        # would answer them before the breaker machinery under test
+        "tsd.query.cache.enable": "false",
         "tsd.query.breaker.failure_threshold": "2",
         "tsd.query.breaker.reset_timeout_ms": "60000",
     }

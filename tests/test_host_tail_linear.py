@@ -74,6 +74,10 @@ class TestDecision:
 
 def _seed_groupby(n_series=3000, pts=20, groups=50, **extra):
     t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                       # pin the host PREP cache itself: the serve-
+                       # path result cache would answer warm repeats
+                       # before they reach it
+                       "tsd.query.cache.enable": "false",
                        **{str(k): str(v) for k, v in extra.items()}}))
     ts = np.arange(BASE, BASE + pts * 60, 60, dtype=np.int64)
     rng = np.random.default_rng(9)

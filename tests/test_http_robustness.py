@@ -359,6 +359,9 @@ class TestBreakerTripFallbackRecovery:
             "tsd.query.host_tail_max_cells_linear": "-1",
             "tsd.query.breaker.failure_threshold": "2",
             "tsd.query.breaker.reset_timeout_ms": "60000",
+            # repeats must reach the device each time, not the
+            # serve-path result cache in front of the breaker
+            "tsd.query.cache.enable": "false",
             "tsd.faults.device.compile_error_count": "2"}))
         for i in range(20):
             t.add_point("b.m", BASE + i * 10, float(i), {"host": "a"})
@@ -416,3 +419,98 @@ class TestApiVersionNegotiation:
             resp = router.handle(HttpRequest(
                 "GET", f"/api/{seg}/version", {}, {}, b""))
             assert resp.status == 404, (seg, resp.status)
+
+
+class TestSiblingPrefixStaticContainment:
+    """Static containment must compare with a trailing separator: a
+    SIBLING directory sharing the root's name prefix (static_private
+    next to static) defeats a bare startswith check (RFC-agnostic
+    path-traversal hardening; ADVICE r05)."""
+
+    @pytest.fixture()
+    def sibling_router(self, tmp_path):
+        root = tmp_path / "static"
+        root.mkdir()
+        (root / "ok.txt").write_text("public")
+        sibling = tmp_path / "static_private"
+        sibling.mkdir()
+        (sibling / "secret.txt").write_text("SECRET")
+        t = TSDB(Config(**{"tsd.http.staticroot": str(root)}))
+        return HttpRpcRouter(t)
+
+    def test_sibling_prefix_dir_is_404(self, sibling_router):
+        resp = sibling_router.handle(HttpRequest(
+            "GET", "/s/../static_private/secret.txt", {}, {}, b""))
+        assert resp.status == 404
+        assert b"SECRET" not in (resp.body or b"")
+
+    def test_root_files_still_serve(self, sibling_router):
+        resp = sibling_router.handle(HttpRequest(
+            "GET", "/s/ok.txt", {}, {}, b""))
+        assert resp.status == 200 and resp.body == b"public"
+
+
+@pytest.mark.robustness
+class TestTransferEncodingFraming:
+    """RFC 7230 §3.3.3: a Transfer-Encoding whose FINAL coding is not
+    chunked leaves the body length unknowable — the server must answer
+    400 and close instead of falling through to Content-Length
+    framing (request-smuggling precondition)."""
+
+    @staticmethod
+    async def _raw_request(port, raw: bytes):
+        import asyncio
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       port)
+        writer.write(raw)
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(), 15)
+        writer.close()
+        return data
+
+    def _run(self, raw: bytes, cfg=None):
+        import asyncio
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tsd.server import TSDServer
+        tsdb = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.tpu.warmup": "false", "tsd.tpu.platform": "cpu",
+            **(cfg or {})}))
+
+        async def scenario():
+            server = TSDServer(tsdb, host="127.0.0.1", port=0)
+            await server.start()
+            try:
+                port = server._server.sockets[0].getsockname()[1]
+                return await self._raw_request(port, raw)
+            finally:
+                await server.stop()
+
+        return asyncio.run(scenario())
+
+    def test_non_chunked_final_coding_400_and_close(self):
+        raw = (b"POST /api/put HTTP/1.1\r\n"
+               b"Host: x\r\nTransfer-Encoding: gzip\r\n"
+               b"Content-Length: 5\r\n\r\nhello")
+        data = self._run(raw)
+        head = data.split(b"\r\n", 1)[0]
+        assert b"400" in head
+        # the connection was closed (read() returned EOF after the
+        # response) and the refusal names the framing problem
+        assert b"Transfer-Encoding" in data
+        assert b"Connection: close" in data
+
+    def test_gzip_then_chunked_still_allowed_when_enabled(self):
+        # final coding chunked: legal per RFC 7230; the server already
+        # dechunks (it does not decompress, but framing is sound)
+        body = b"5\r\nhello\r\n0\r\n\r\n"
+        raw = (b"POST /api/put HTTP/1.1\r\n"
+               b"Host: x\r\nConnection: close\r\n"
+               b"Transfer-Encoding: chunked\r\n\r\n" + body)
+        data = self._run(raw, {
+            "tsd.http.request_enable_chunked": "true"})
+        head = data.split(b"\r\n", 1)[0]
+        # "hello" is not valid JSON -> a 400 from the HANDLER, but the
+        # framing was accepted (not the TE refusal)
+        assert b"400" in head
+        assert b"Transfer-Encoding" not in data

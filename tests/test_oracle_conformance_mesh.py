@@ -179,6 +179,9 @@ def test_mesh_warm_repeat_uses_device_cache():
     repeats (the three r02 `mesh is None` gates are gone) and
     invalidate on writes."""
     t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                       # bypass the result cache: this test pins the
+                       # DEVICE cache behind it
+                       "tsd.query.cache.enable": "false",
                        "tsd.query.mesh": "series:4,time:2"}))
     base._seed(t, seed=21)
     first = _run_query(t)
