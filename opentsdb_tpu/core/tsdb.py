@@ -167,6 +167,14 @@ class TSDB:
         self._fanout_pool = None
         self._fanout_workers = self.config.get_int(
             "tsd.query.fanout.workers", 4)
+        # continuous-query subsystem (opentsdb_tpu/streaming/): lazy —
+        # created on first registration; the write path checks the raw
+        # attribute so an idle TSD pays nothing
+        self._streaming = None
+        # per-hook swallowed-error counters: post-write hooks (meta,
+        # realtime publisher, external meta cache, stream tap) can
+        # never fail an ACKNOWLEDGED write — see _run_hook
+        self.hook_errors: dict[str, int] = {}
         # host-side per-(store, metric) TagMatrix cache, invalidated by
         # series count (the metric index is append-only)
         self._tagmat_cache: dict = {}
@@ -281,6 +289,27 @@ class TSDB:
     # write path (ref: TSDB.java:1012-1291)
     # ------------------------------------------------------------------
 
+    def _run_hook(self, name: str, fn, *args) -> None:
+        """Run one post-write hook (realtime publisher, meta tracking,
+        external meta cache, streaming ingest tap) so that a
+        misbehaving plugin can NEVER fail an acknowledged write: the
+        point is already durable in the store (and WAL) when hooks
+        run, so propagating a hook error would report a failure for a
+        write that actually happened — clients would retry and
+        double-write. Errors are swallowed with a per-hook counter
+        (``hooks.errors`` in /api/stats) and a logring entry."""
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001 - deliberate firewall
+            n = self.hook_errors.get(name, 0) + 1
+            self.hook_errors[name] = n
+            # first few at full traceback, then sampled — a hook
+            # failing on every point must not flood the log ring
+            if n <= 5 or n % 1000 == 0:
+                logging.getLogger("tsdb").exception(
+                    "%s hook failed (swallowed; %d total) — the "
+                    "write itself succeeded", name, n)
+
     def add_point(self, metric: str, timestamp: int, value: int | float,
                   tags: dict[str, str], durable: bool = True) -> int:
         """Write one datapoint; returns the series id. ``durable=False``
@@ -307,18 +336,25 @@ class TSDB:
             self.wal.log_point("data", sid, ts_ms, fval, is_int)
             self.wal.sync()
         self.datapoints_added += 1
+        if self._streaming is not None:
+            self._run_hook("stream.tap", self._streaming.offer,
+                           metric_id, sid, ts_ms, fval)
         tsuid = (self.uids.tsuid(metric_id, tag_ids)
                  if self.meta_cache is not None
                  or self.rt_publisher is not None else None)
         if self.meta_cache is not None:
             # external counter service replaces built-in tracking
             # (ref: TSDB.java:1225-1245 meta_cache branch)
-            self.meta_cache.increment_and_get_counter(tsuid)
+            self._run_hook("meta_cache",
+                           self.meta_cache.increment_and_get_counter,
+                           tsuid)
         elif self.meta is not None:
-            self.meta.on_datapoint(metric_id, tag_ids, sid)
+            self._run_hook("meta", self.meta.on_datapoint, metric_id,
+                           tag_ids, sid)
         if self.rt_publisher is not None:
-            self.rt_publisher.publish_data_point(
-                metric, timestamp, value, tags, tsuid)
+            self._run_hook("rt_publisher",
+                           self.rt_publisher.publish_data_point,
+                           metric, timestamp, value, tags, tsuid)
         return sid
 
     def _check_timestamp(self, timestamp: int) -> None:
@@ -425,9 +461,12 @@ class TSDB:
             self.wal.log_points("data", sid, ts_ms, fvals, flags)
             self.wal.sync()
         self.datapoints_added += len(ts)
+        if self._streaming is not None:
+            self._run_hook("stream.tap", self._streaming.offer_many,
+                           metric_id, sid, ts_ms, fvals)
         if self.meta is not None:
-            self.meta.on_datapoint(metric_id, tag_ids, sid,
-                                   count=len(ts))
+            self._run_hook("meta", self.meta.on_datapoint, metric_id,
+                           tag_ids, sid, len(ts))
         return sid
 
     def add_point_batch(self, points, on_error=None
@@ -601,6 +640,17 @@ class TSDB:
                                parsed.values, parsed.is_int)
             self.wal.sync()
         self.datapoints_added += written
+        if self._streaming is not None and written:
+            for g in range(parsed.num_groups):
+                info = ginfo[g]
+                if isinstance(info, Exception):
+                    continue
+                m = parsed.group_ids == g
+                if m.any():
+                    self._run_hook("stream.tap",
+                                   self._streaming.offer_many,
+                                   info[2], int(gsid[g]), ts_ms[m],
+                                   parsed.values[m])
         if self.meta is not None and written:
             counts = np.bincount(gids[gids >= 0],
                                  minlength=parsed.num_groups)
@@ -608,8 +658,9 @@ class TSDB:
                 info = ginfo[g]
                 if isinstance(info, Exception) or not counts[g]:
                     continue
-                self.meta.on_datapoint(info[2], info[3], int(gsid[g]),
-                                       count=int(counts[g]))
+                self._run_hook("meta", self.meta.on_datapoint,
+                               info[2], info[3], int(gsid[g]),
+                               int(counts[g]))
         return written, errors
 
     def add_aggregate_point(self, metric: str, timestamp: int,
@@ -829,6 +880,26 @@ class TSDB:
         return self._result_cache
 
     @property
+    def streaming(self):
+        """Continuous-query registry
+        (:mod:`opentsdb_tpu.streaming.registry`), or None when
+        disabled (``tsd.streaming.enable = false``). Lazy: the write
+        path's tap check reads the raw ``_streaming`` attribute, so a
+        TSD with no registered continuous queries pays one attribute
+        read per write."""
+        if not self.config.get_bool("tsd.streaming.enable", True):
+            return None
+        if self._streaming is None:
+            with self._device_cache_lock:
+                if self._streaming is None:
+                    from opentsdb_tpu.streaming.registry import \
+                        ContinuousQueryRegistry
+                    reg = ContinuousQueryRegistry(self)
+                    self.stats.register(reg)
+                    self._streaming = reg
+        return self._streaming
+
+    @property
     def query_fanout_pool(self):
         """Executor independent sub-queries of one TSQuery fan out
         onto (None = serial; ``tsd.query.fanout.workers``). See the
@@ -918,6 +989,8 @@ class TSDB:
 
     def shutdown(self) -> None:
         self.flush()
+        if self._streaming is not None:
+            self._streaming.shutdown()
         if self._fanout_pool is not None:
             self._fanout_pool.shutdown(wait=False)
         if self.wal is not None:
@@ -937,6 +1010,10 @@ class TSDB:
             self._host_prep_cache.clear()
         if self._result_cache is not None:
             self._result_cache.clear()
+        if self._streaming is not None:
+            # continuous-query plans re-seed from the store on their
+            # next serve/pump (operator escape hatch)
+            self._streaming.invalidate()
 
     # ------------------------------------------------------------------
     # stats (ref: TSDB.collectStats :753)
@@ -948,5 +1025,7 @@ class TSDB:
         self.uids.tag_values.collect_stats(collector)
         self.store.collect_stats(collector)
         collector.record("datapoints.added", self.datapoints_added)
+        for hook, n in sorted(self.hook_errors.items()):
+            collector.record("hooks.errors", n, hook=hook)
         collector.record("uptime.seconds",
                          int(time.time() - self.start_time))

@@ -85,19 +85,34 @@ class MetaStore:
         self.uid_meta: dict[tuple[str, str], UIDMeta] = {}
         self.ts_counters: dict[str, int] = {}
 
+    def _check_fault(self) -> None:
+        """``meta.store`` fault-injection site: every meta WRITE path
+        runs it (realtime tracking + the HTTP sync edits). Ingest is
+        insulated by the TSDB hook guard — an armed meta fault counts
+        a hook error and the point write still acknowledges."""
+        faults = getattr(self._tsdb, "faults", None)
+        if faults is not None:
+            faults.check("meta.store")
+
     def on_datapoint(self, metric_id: int, tag_ids, series_id: int,
                      count: int = 1) -> None:
         """Realtime TSMeta tracking; ``count`` lets the bulk write path
-        account a whole per-series batch in one call."""
+        account a whole per-series batch in one call. A newly-created
+        TSMeta is also filed through the realtime tree processor when
+        ``tsd.core.tree.enable_processing`` is set (ref:
+        TSDB.processTSMetaThroughTrees :2033)."""
         if not self.track_ts:
             return
+        self._check_fault()
         tsuid = self._tsdb.uids.tsuid(metric_id, tag_ids).hex().upper()
         now = int(time.time())
+        created = False
         with self._lock:
             self.ts_counters[tsuid] = (self.ts_counters.get(tsuid, 0)
                                        + count)
             meta = self.ts_meta.get(tsuid)
             if meta is None:
+                created = True
                 meta = TSMeta(tsuid=tsuid, created=now)
                 meta.metric = self._uid_meta_locked(
                     "metric", metric_id, now)
@@ -109,6 +124,20 @@ class MetaStore:
                     self._tsdb.search_plugin.index_ts_meta(meta)
             meta.last_received = now
             meta.total_dps = self.ts_counters[tsuid]
+        if created and self._tsdb.config.get_bool(
+                "tsd.core.tree.enable_processing"):
+            # outside the meta lock (the tree manager has its own);
+            # guarded so a tree failure can neither fail the write nor
+            # unwind the meta update above
+            from opentsdb_tpu.tree.tree import tree_manager
+            mgr = tree_manager(self._tsdb)
+            uids = self._tsdb.uids
+            tags = {uids.tag_names.get_name(k):
+                    uids.tag_values.get_name(v)
+                    for k, v in sorted(tag_ids)}
+            self._tsdb._run_hook(
+                "tree.rt", mgr.process_series, tsuid,
+                uids.metrics.get_name(metric_id), tags)
 
     def _uid_meta_locked(self, kind: str, uid_int: int,
                          now: int) -> UIDMeta:
@@ -186,6 +215,7 @@ class MetaStore:
         must exist in the UID table; a missing doc starts from the
         skeleton (ref: UIDMeta.getUIDMeta default docs)."""
         uid_hex = uid_hex.upper()
+        self._check_fault()
         registry = self._tsdb.uids.by_kind(kind)
         name = registry.get_name(bytes.fromhex(uid_hex))  # may raise
         with self._lock:
@@ -221,6 +251,7 @@ class MetaStore:
         new doc for a known-but-untracked timeseries (ref: the
         create=true counter bootstrap in UniqueIdRpc tsmeta POST)."""
         tsuid = tsuid.upper()
+        self._check_fault()
         with self._lock:
             meta = self.ts_meta.get(tsuid)
             created = False

@@ -522,6 +522,28 @@ class QueryEngine:
         identical queries share ONE execution and a failed leader
         poisons nothing)."""
         from opentsdb_tpu.query import result_cache as rc_mod
+        # continuous-query live windows come FIRST: a registered
+        # standing query answers its dashboard window from maintained
+        # partial aggregates — fresher than any cache entry (it
+        # reflects every acknowledged write) and immune to the
+        # epoch-invalidation that evicts cached live queries under
+        # ingest. Streaming failures always fall through to the
+        # batch path — the feeder can shed, never 500.
+        streaming = self.tsdb._streaming
+        if streaming is not None and not tsq.delete:
+            try:
+                served = streaming.try_serve(tsq, sub, self)
+            except (BadRequestError, QueryLimitExceeded):
+                raise  # semantic errors the batch path would raise too
+            except Exception as exc:  # noqa: BLE001 - shed to batch
+                LOG.warning("streaming serve failed (%s: %s); "
+                            "answering from the batch engine",
+                            type(exc).__name__, exc)
+                served = None
+            if served is not None:
+                if stats:
+                    stats.add_stat(QueryStat.STREAMING_HIT, 1)
+                return served
         cache = self.tsdb.result_cache
         if cache is None:
             return self._run_sub(tsq, sub, stats)

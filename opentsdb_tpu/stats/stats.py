@@ -172,6 +172,9 @@ class QueryStat(Enum):
     # reference's graph cache lives outside QueryStats entirely)
     RESULT_CACHE_HIT = "resultCacheHit"
     RESULT_CACHE_COALESCED = "resultCacheCoalesced"
+    # served from a continuous query's maintained live windows
+    # (opentsdb_tpu/streaming/) — no store scan, tail-only compute
+    STREAMING_HIT = "streamingHit"
 
 
 # time-based stats that get the reference's derived max*/avg* twins in

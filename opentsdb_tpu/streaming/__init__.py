@@ -1,0 +1,8 @@
+"""Continuous-query subsystem: standing TSQueries maintained
+incrementally under ingest (registry + incremental window folds + SSE
+push transport). See :mod:`opentsdb_tpu.streaming.registry`."""
+
+from opentsdb_tpu.streaming.registry import (ContinuousQuery,
+                                             ContinuousQueryRegistry)
+
+__all__ = ["ContinuousQuery", "ContinuousQueryRegistry"]

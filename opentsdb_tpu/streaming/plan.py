@@ -1,0 +1,454 @@
+"""Incremental window state for one continuous sub-query.
+
+A standing sub-query compiles into tumbling windows aligned to its
+downsample interval. Each window keeps per-series PARTIAL aggregates —
+sum/count/min/max, with ``avg`` derived as sum/count at read time —
+the same decomposition the rollup tiers use (``rollup/job.py``,
+ref: RollupConfig sum+count qualifiers). Ingest folds new points into
+the partials with vectorized scatters, so maintaining the query costs
+O(new points); a refresh then derives the [S, B] downsampled grid from
+the partials and runs ONLY the existing fill/rate/interpolate/
+aggregate tail (:func:`opentsdb_tpu.ops.pipeline.execute_grid`) — the
+store is never re-scanned. Because the tail is the same compiled
+kernel chain the batch engine's grid path runs, maintained results are
+value-identical to a cold ``/api/query`` over the same bucket-aligned
+range (asserted by the streaming oracle battery).
+
+Windows live in a ring of ``n_windows`` columns keyed by
+``(bucket_ts // interval) % n_windows``; a point landing in a newer
+bucket than a column holds tumbles that column (reset + re-key), and
+points older than the ring's horizon are dropped and counted (they can
+no longer affect any servable window).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.query import filters as filters_mod
+from opentsdb_tpu.query.model import TSSubQuery
+
+# downsample functions whose bucket statistic decomposes into the
+# sum/count/min/max partials this plan maintains (avg = sum / count) —
+# mirrors the rollup tier decomposition AND the engine's _GRID_FNS, so
+# every continuous query is also batch-grid-eligible
+DECOMPOSABLE_DS = frozenset(("sum", "zimsum", "pfsum", "count", "min",
+                             "mimmin", "max", "mimmax", "avg"))
+
+_GROW = 64  # initial / doubling row capacity for the partial arrays
+
+
+class IncrementalSubPlan:
+    """Partial-aggregate window ring for one sub-query (see module
+    docstring). Thread-safe: every mutation happens under ``lock``."""
+
+    def __init__(self, tsdb, sub: TSSubQuery, n_windows: int):
+        self.tsdb = tsdb
+        self.sub = sub
+        self.metric: str = sub.metric
+        self.metric_id: int | None = None
+        self.interval_ms = int(sub.ds_spec.interval_ms)
+        self.n_windows = int(n_windows)
+        self.lock = threading.RLock()
+        self._filter_eval = filters_mod.FilterEvaluator(tsdb.uids)
+        # membership: sid -> row slot (-1 = evaluated, not a member)
+        self._slots: dict[int, int] = {}
+        self._sids: list[int] = []
+        self._tag_pairs: list[tuple] = []  # row -> ((kid, vid), ...)
+        w = self.n_windows
+        cap = _GROW
+        self._sum = np.zeros((cap, w))
+        self._cnt = np.zeros((cap, w))
+        self._min = np.full((cap, w), np.inf)
+        self._max = np.full((cap, w), -np.inf)
+        self.win_ts = np.full(w, -1, dtype=np.int64)
+        # the oldest bucket edge every ring column still covers; a
+        # request starting before it cannot be served incrementally
+        self.covered_from_ms = 0
+        # newest folded timestamp: absolute-range serves past it are
+        # exact (nothing newer exists to diverge on)
+        self.max_ts_ms = 0
+        # versions: folds invalidate the tail cache, membership
+        # changes invalidate the group structures
+        self.fold_seq = 0
+        self.member_seq = 0
+        # counters (read by the registry's stats/health export)
+        self.points_folded = 0
+        self.folds = 0
+        self.late_dropped = 0
+        self.bootstrap_points = 0
+        # buckets touched since the last SSE publish
+        self.changed_ts: set[int] = set()
+        # pending (sids, ts_ms, values) chunks offered by the ingest
+        # tap; folded in batches so the hot write path stays O(1)
+        self._pending: list[tuple] = []
+        self.pending_points = 0
+        self.needs_rebuild = False
+        self._tail_cache: tuple | None = None
+        self._groups_cache: tuple | None = None
+        # the raw store's mutation epoch at bootstrap: deletes/repairs
+        # bump it, and partials cannot "unfold" removed points — the
+        # registry forces a rebuild on mismatch before serving.
+        # Known limitation (documented): DUPLICATE writes (same
+        # series+timestamp rewritten) fold additively while the store
+        # dedupes last-write-wins; they do not bump the epoch, so the
+        # divergence persists until a tumble or rebuild. The reference
+        # treats duplicate writes as an error condition
+        # (tsd.storage.fix_duplicates), so this trades exactness on an
+        # abnormal workload for an O(1) write path.
+        self.store_epoch = -1
+
+    # ------------------------------------------------------------------
+    # bootstrap: one batch scan seeds the partials, then folds keep up
+    # ------------------------------------------------------------------
+
+    def bootstrap(self, now_ms: int) -> None:
+        """Seed the window ring from the store: one fused
+        ``bucket_reduce`` pass over the horizon produces exactly the
+        sum/count/min/max partials the folds maintain afterwards."""
+        with self.lock:
+            iv, w = self.interval_ms, self.n_windows
+            last_edge = now_ms - now_ms % iv
+            start_edge = last_edge - (w - 1) * iv
+            edges = start_edge + np.arange(w, dtype=np.int64) * iv
+            cols = ((edges // iv) % w).astype(np.int64)
+            self.win_ts = np.full(w, -1, dtype=np.int64)
+            self.win_ts[cols] = edges
+            self._slots.clear()
+            self._sids = []
+            self._tag_pairs = []
+            self._sum[:] = 0.0
+            self._cnt[:] = 0.0
+            self._min[:] = np.inf
+            self._max[:] = -np.inf
+            self._pending = []
+            self.pending_points = 0
+            self._tail_cache = None
+            self._groups_cache = None
+            self.covered_from_ms = int(start_edge)
+            self.max_ts_ms = int(now_ms)
+            self.store_epoch = getattr(self.tsdb.store,
+                                       "mutation_epoch", 0)
+            uids = self.tsdb.uids
+            try:
+                self.metric_id = uids.metrics.get_id(self.metric)
+            except LookupError:
+                self.metric_id = None  # metric not written yet
+                self.member_seq += 1
+                self.fold_seq += 1
+                return
+            store = self.tsdb.store
+            sids = store.series_ids_for_metric(self.metric_id)
+            if len(sids) and self.sub.filters:
+                idx = store.metric_index(self.metric_id)
+                _, triples = idx.arrays()
+                mask = self._filter_eval.apply(self.sub.filters, sids,
+                                               triples)
+                sids = sids[mask]
+            for sid in np.asarray(sids).tolist():
+                self._admit_locked(int(sid), check_filters=False)
+            if len(self._sids):
+                sid_arr = np.asarray(self._sids, dtype=np.int64)
+                sums, cnts, mins, maxs = store.bucket_reduce(
+                    sid_arr, int(start_edge), int(start_edge + w * iv - 1),
+                    int(start_edge), iv, w, want_minmax=True)
+                s = len(sid_arr)
+                self._grow_to(s)
+                self._sum[:s, cols] = sums
+                self._cnt[:s, cols] = cnts
+                present = cnts > 0
+                self._min[:s, cols] = np.where(present, mins, np.inf)
+                self._max[:s, cols] = np.where(present, maxs, -np.inf)
+                self.bootstrap_points += int(cnts.sum())
+            self.member_seq += 1
+            self.fold_seq += 1
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+
+    def _grow_to(self, rows: int) -> None:
+        cap = self._sum.shape[0]
+        if rows <= cap:
+            return
+        new_cap = cap
+        while new_cap < rows:
+            new_cap *= 2
+        w = self.n_windows
+
+        def grow(arr, fill):
+            out = np.full((new_cap, w), fill, dtype=arr.dtype)
+            out[:cap] = arr
+            return out
+
+        self._sum = grow(self._sum, 0.0)
+        self._cnt = grow(self._cnt, 0.0)
+        self._min = grow(self._min, np.inf)
+        self._max = grow(self._max, -np.inf)
+
+    def _admit_locked(self, sid: int, check_filters: bool = True) -> int:
+        """Slot for ``sid``, admitting it when it matches the plan's
+        filters (a series first seen by a WRITE is brand new — its
+        points arrive through the very fold that admits it, so no
+        backfill is needed). Returns -1 for non-members."""
+        slot = self._slots.get(sid)
+        if slot is not None:
+            return slot
+        rec = self.tsdb.store.series(sid)
+        if self.metric_id is None:
+            # the metric materialized after registration: latch its id
+            try:
+                self.metric_id = self.tsdb.uids.metrics.get_id(
+                    self.metric)
+            except LookupError:
+                return -1
+        if rec.metric_id != self.metric_id:
+            self._slots[sid] = -1
+            return -1
+        if check_filters and self.sub.filters:
+            triples = (np.asarray(
+                [(sid, k, v) for k, v in rec.tags],
+                dtype=np.int64).reshape(-1, 3)
+                if rec.tags else np.empty((0, 3), dtype=np.int64))
+            mask = self._filter_eval.apply(
+                self.sub.filters, np.asarray([sid], dtype=np.int64),
+                triples)
+            if not bool(mask[0]):
+                self._slots[sid] = -1
+                return -1
+        slot = len(self._sids)
+        self._grow_to(slot + 1)
+        self._slots[sid] = slot
+        self._sids.append(sid)
+        self._tag_pairs.append(tuple(rec.tags))
+        self.member_seq += 1
+        return slot
+
+    # ------------------------------------------------------------------
+    # ingest folds
+    # ------------------------------------------------------------------
+
+    def offer(self, sids: np.ndarray, ts_ms: np.ndarray,
+              values: np.ndarray) -> int:
+        """Buffer a chunk from the ingest tap (O(1) append); returns
+        the pending-point total so the registry can decide to drain."""
+        with self.lock:
+            self._pending.append((sids, ts_ms, values))
+            self.pending_points += len(ts_ms)
+            return self.pending_points
+
+    def take_pending(self) -> list[tuple]:
+        with self.lock:
+            out, self._pending = self._pending, []
+            self.pending_points = 0
+            return out
+
+    def fold(self, sids: np.ndarray, ts_ms: np.ndarray,
+             values: np.ndarray) -> None:
+        """Fold one chunk of points into the window partials."""
+        with self.lock:
+            iv, w = self.interval_ms, self.n_windows
+            sids = np.asarray(sids, dtype=np.int64).reshape(-1)
+            ts_ms = np.asarray(ts_ms, dtype=np.int64).reshape(-1)
+            values = np.asarray(values, dtype=np.float64).reshape(-1)
+            slots = np.empty(len(sids), dtype=np.int64)
+            slot_map = self._slots
+            for i, sid in enumerate(sids.tolist()):
+                s = slot_map.get(sid)
+                if s is None:
+                    s = self._admit_locked(sid)
+                slots[i] = s
+            keep = (slots >= 0) & ~np.isnan(values)
+            if not keep.any():
+                self.folds += 1
+                return
+            slots = slots[keep]
+            ts = ts_ms[keep]
+            vals = values[keep]
+            bucket = ts - ts % iv
+            col = ((bucket // iv) % w).astype(np.int64)
+            # tumble columns whose newest incoming bucket is newer
+            for c in np.unique(col).tolist():
+                nb = int(bucket[col == c].max())
+                if nb > self.win_ts[c]:
+                    self._sum[:, c] = 0.0
+                    self._cnt[:, c] = 0.0
+                    self._min[:, c] = np.inf
+                    self._max[:, c] = -np.inf
+                    self.win_ts[c] = nb
+                    self.covered_from_ms = max(
+                        self.covered_from_ms, nb - (w - 1) * iv)
+            live = bucket == self.win_ts[col]
+            self.late_dropped += int((~live).sum())
+            if live.any():
+                slots, col = slots[live], col[live]
+                vals, bucket = vals[live], bucket[live]
+                np.add.at(self._sum, (slots, col), vals)
+                np.add.at(self._cnt, (slots, col), 1.0)
+                np.minimum.at(self._min, (slots, col), vals)
+                np.maximum.at(self._max, (slots, col), vals)
+                self.changed_ts.update(
+                    int(b) for b in np.unique(bucket).tolist())
+                if len(self.changed_ts) > 4 * w:
+                    # nobody is draining the changed-set (no
+                    # subscriber): keep it bounded by the horizon
+                    cutoff = self.covered_from_ms
+                    self.changed_ts = {c for c in self.changed_ts
+                                       if c >= cutoff}
+                self.points_folded += len(vals)
+                self.max_ts_ms = max(self.max_ts_ms, int(ts.max()))
+                self.fold_seq += 1
+                self._tail_cache = None
+            self.folds += 1
+
+    # ------------------------------------------------------------------
+    # read side: derive the downsampled grid + run the pipeline tail
+    # ------------------------------------------------------------------
+
+    def grid_for(self, start_ms: int, end_ms: int):
+        """[S, B] downsampled grid over the requested range derived
+        from the partials, or None when the range is outside the
+        maintained horizon. Caller holds ``lock``."""
+        iv, w = self.interval_ms, self.n_windows
+        edges = ds_mod.fixed_bucket_edges(start_ms, end_ms, iv)
+        if len(edges) == 0 or len(edges) > w:
+            return None
+        if int(edges[0]) < self.covered_from_ms:
+            return None
+        cols = ((edges // iv) % w).astype(np.int64)
+        live = self.win_ts[cols] == edges
+        s = len(self._sids)
+        sums = np.where(live[None, :], self._sum[:s][:, cols], 0.0)
+        cnts = np.where(live[None, :], self._cnt[:s][:, cols], 0.0)
+        present = cnts > 0
+        fn = self.sub.ds_spec.function
+        if fn in ("sum", "zimsum", "pfsum"):
+            grid = np.where(present, sums, np.nan)
+        elif fn == "count":
+            grid = np.where(present, cnts, np.nan)
+        elif fn == "avg":
+            grid = np.where(present, sums / np.maximum(cnts, 1.0),
+                            np.nan)
+        elif fn in ("min", "mimmin"):
+            mins = np.where(live[None, :], self._min[:s][:, cols],
+                            np.inf)
+            grid = np.where(present, mins, np.nan)
+        else:  # max, mimmax
+            maxs = np.where(live[None, :], self._max[:s][:, cols],
+                            -np.inf)
+            grid = np.where(present, maxs, np.nan)
+        return grid, present, edges, int(cnts.sum())
+
+    def _groups_locked(self):
+        """(tag_mat, group_ids, num_groups, gb_kids) over the current
+        members, rebuilt only when membership changed. None when a
+        group-by key has no UID yet (batch returns [] there too)."""
+        cached = self._groups_cache
+        if cached is not None and cached[0] == self.member_seq:
+            return cached[1]
+        from opentsdb_tpu.query.engine import QueryEngine, TagMatrix
+        uids = self.tsdb.uids
+        tag_mat = TagMatrix.from_pairs(self._tag_pairs)
+        gb_tagks = sorted({f.tagk for f in self.sub.filters
+                           if f.group_by})
+        gb_kids = []
+        for k in gb_tagks:
+            try:
+                gb_kids.append(uids.tag_names.get_id(k))
+            except LookupError:
+                self._groups_cache = (self.member_seq, None)
+                return None
+        group_ids, num_groups = QueryEngine._group_ids(tag_mat, gb_kids)
+        out = (tag_mat, group_ids, num_groups, gb_kids)
+        self._groups_cache = (self.member_seq, out)
+        return out
+
+    def serve(self, tsq, sub: TSSubQuery, engine) -> list | None:
+        """Answer one request from the maintained windows: drain is the
+        caller's job (registry), here the grid derives from partials
+        and ONLY the pipeline tail runs (host CPU — dashboard-sized,
+        and consistent with the degraded-fallback placement idiom).
+        Returns result groups, [] for genuinely-empty, or None when
+        this plan cannot serve the window."""
+        with self.lock:
+            g = self.grid_for(tsq.start_ms, tsq.end_ms)
+            if g is None:
+                return None
+            grid, present, edges, num_points = g
+            self.tsdb.query_limits.check(self.metric, num_points)
+            if num_points == 0 or not len(self._sids):
+                return []
+            groups = self._groups_locked()
+            if groups is None:
+                return []
+            tag_mat, group_ids, num_groups, gb_kids = groups
+            emit_raw = self.sub.agg.is_none
+            if emit_raw:
+                group_ids = np.arange(len(self._sids), dtype=np.int32)
+                num_groups = len(self._sids)
+            result, emit = self._tail_locked(edges, grid, present,
+                                             group_ids, num_groups,
+                                             emit_raw)
+            sid_arr = np.asarray(self._sids, dtype=np.int64)
+            return engine._build_results(
+                tsq, sub, self.metric, sid_arr, tag_mat, group_ids,
+                num_groups, gb_kids, edges, result, emit)
+
+    def _tail_locked(self, edges, grid, present, group_ids,
+                     num_groups: int, emit_raw: bool):
+        """fill/rate/interpolate/aggregate over the derived grid — the
+        exact kernel chain of the batch engine's grid path, pinned to
+        the host CPU backend. Cached per (fold, membership, window)."""
+        key = (self.fold_seq, self.member_seq, int(edges[0]),
+               len(edges))
+        cached = self._tail_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from opentsdb_tpu.ops.pipeline import PipelineSpec, execute_grid
+        sub = self.sub
+        spec = PipelineSpec(
+            num_series=grid.shape[0], num_buckets=len(edges),
+            num_groups=num_groups,
+            # normalized like the engine's grid tail: downsampling
+            # already happened (partials), the tail never reads it
+            ds_function="avg", agg_name=sub.agg.name,
+            fill_policy=sub.ds_spec.fill_policy,
+            fill_value=sub.ds_spec.fill_value, rate=sub.rate,
+            rate_counter=sub.rate_options.counter,
+            rate_drop_resets=sub.rate_options.drop_resets,
+            emit_raw=emit_raw, host=True)
+        import jax
+        cpu = jax.devices("cpu")[0]
+        result, emit = execute_grid(grid, present, edges, group_ids,
+                                    spec, sub.rate_options, device=cpu)
+        out = (np.asarray(result), np.asarray(emit, dtype=bool))
+        self._tail_cache = (key, out)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def take_changed(self) -> list[int]:
+        with self.lock:
+            out = sorted(self.changed_ts)
+            self.changed_ts = set()
+            return out
+
+    def info(self) -> dict[str, Any]:
+        with self.lock:
+            return {
+                "metric": self.metric,
+                "intervalMs": self.interval_ms,
+                "windows": self.n_windows,
+                "series": len(self._sids),
+                "coveredFromMs": self.covered_from_ms,
+                "pointsFolded": self.points_folded,
+                "folds": self.folds,
+                "pendingPoints": self.pending_points,
+                "lateDropped": self.late_dropped,
+                "bootstrapPoints": self.bootstrap_points,
+                "needsRebuild": self.needs_rebuild,
+            }

@@ -1,0 +1,652 @@
+"""Continuous-query registry: standing TSQueries maintained under
+ingest and served two ways.
+
+Clients register a standing TSQuery (``POST /api/query/continuous``);
+the registry compiles each sub-query into an
+:class:`~opentsdb_tpu.streaming.plan.IncrementalSubPlan` (tumbling
+windows of per-series partial aggregates) and taps
+``TSDB.add_point`` / ``add_points`` / ``import_buffer`` through
+:meth:`offer` — a buffered O(1) append on the hot write path, folded
+in batches.
+
+Results serve two ways:
+
+- **pull** — the query engine consults :meth:`try_serve` before the
+  result cache: a live-window request matching a registered query is
+  answered from the maintained partials (fold pending + pipeline
+  tail, never a store scan). This is the non-invalidating feeder that
+  closes the result cache's live-query gap: ingest to the read store
+  no longer evicts the dashboard's answer, it *updates* it.
+- **push** — Server-Sent Events (``GET /api/query/continuous/<id>/
+  stream``) emitting incremental window updates, with bounded
+  per-subscription queues and slow-consumer shedding
+  (:mod:`opentsdb_tpu.streaming.sse`).
+
+Degradation follows the PR-1 idiom: the ``stream.fold`` fault site
+runs every fold and rebuild under a :class:`CircuitBreaker`; a failed
+fold marks the plan for rebuild (one batch re-scan), a tripped breaker
+routes pulls back to the batch engine (shed to the always-correct
+path, never a 500) until the reset-window probe heals it. Counters
+export through /api/stats and /api/health.
+
+Knobs (``tsd.streaming.*``): ``enable``, ``serve``, ``max_queries``,
+``max_windows``, ``buffer_points``, ``queue_events``, ``heartbeat_s``,
+``publish_min_interval_ms``, ``breaker.failure_threshold``,
+``breaker.reset_timeout_ms``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from opentsdb_tpu.query.model import BadRequestError, TSQuery
+from opentsdb_tpu.query.result_cache import _is_relative
+from opentsdb_tpu.streaming.plan import (DECOMPOSABLE_DS,
+                                         IncrementalSubPlan)
+from opentsdb_tpu.utils.faults import CircuitBreaker
+
+LOG = logging.getLogger("streaming.registry")
+
+
+class ContinuousQuery:
+    """One registered standing query: the validated TSQuery plus one
+    incremental plan per sub-query and the SSE subscriber set."""
+
+    def __init__(self, cid: str, raw: dict, tsq: TSQuery,
+                 plans: list[IncrementalSubPlan]):
+        self.id = cid
+        self.raw = raw          # original JSON body (re-resolved per emit)
+        self.tsq = tsq
+        self.plans = plans
+        self.created = time.time()
+        self.lock = threading.Lock()
+        self.subscribers: list = []
+        self.emit_seq = 0
+        self.last_publish = 0.0
+        self.closed = False
+
+    def describe(self, verbose: bool = False) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "id": self.id,
+            "query": self.tsq.to_json(),
+            "intervalMs": [p.interval_ms for p in self.plans],
+            "windows": [p.n_windows for p in self.plans],
+            "series": sum(len(p._sids) for p in self.plans),
+            "subscribers": len(self.subscribers),
+            "emitSeq": self.emit_seq,
+        }
+        if verbose:
+            out["plans"] = [p.info() for p in self.plans]
+        return out
+
+
+class ContinuousQueryRegistry:
+    """(see module docstring)"""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        cfg = tsdb.config
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._queries: dict[str, ContinuousQuery] = {}
+        # metric_id -> plans watching it (the tap's fast path); plans
+        # whose metric has no UID yet park in _unresolved until a
+        # write materializes the metric
+        self._by_mid: dict[int, list[IncrementalSubPlan]] = {}
+        self._unresolved: list[IncrementalSubPlan] = []
+        # (metric, sub identity) -> plan for the pull path
+        self._by_identity: dict[tuple, IncrementalSubPlan] = {}
+        self.max_queries = cfg.get_int("tsd.streaming.max_queries", 64)
+        self.max_windows = cfg.get_int("tsd.streaming.max_windows",
+                                       2880)
+        self.buffer_points = cfg.get_int("tsd.streaming.buffer_points",
+                                         4096)
+        self.queue_events = cfg.get_int("tsd.streaming.queue_events",
+                                        256)
+        self.heartbeat_s = cfg.get_float("tsd.streaming.heartbeat_s",
+                                         5.0)
+        self.publish_min_interval_ms = cfg.get_float(
+            "tsd.streaming.publish_min_interval_ms", 200.0)
+        threshold = cfg.get_int(
+            "tsd.streaming.breaker.failure_threshold", 3)
+        self.breaker = CircuitBreaker(
+            "stream.fold", failure_threshold=threshold,
+            reset_timeout_ms=cfg.get_float(
+                "tsd.streaming.breaker.reset_timeout_ms", 30000.0)) \
+            if threshold > 0 else None
+        if self.breaker is not None:
+            tsdb.stats.register(self.breaker)
+        # live SSE subscriber count, maintained so the ingest tap's
+        # publish check is one integer read (never a registry walk)
+        self._active_subs = 0
+        # counters
+        self.serve_hits = 0
+        self.serve_fallbacks = 0
+        self.fold_errors = 0
+        self.rebuilds = 0
+        self.sse_shed = 0
+        self.sse_events = 0
+        self.publishes = 0
+
+    # ------------------------------------------------------------------
+    # registration surface
+    # ------------------------------------------------------------------
+
+    def register(self, obj: dict, now_ms: int | None = None
+                 ) -> ContinuousQuery:
+        """Validate + compile one standing TSQuery; raises
+        :class:`BadRequestError` on anything the incremental engine
+        cannot maintain (the client should run it as a plain query)."""
+        if not isinstance(obj, dict):
+            raise BadRequestError("continuous query must be an object")
+        cid = obj.get("id")
+        body = {k: v for k, v in obj.items() if k != "id"}
+        tsq = TSQuery.from_json(body).validate(now_ms)
+        if tsq.delete:
+            raise BadRequestError(
+                "delete=true cannot be a continuous query")
+        if tsq.timezone or tsq.use_calendar:
+            raise BadRequestError(
+                "continuous queries do not support timezone/calendar "
+                "downsampling")
+        plans = []
+        for sub in tsq.queries:
+            if sub.percentiles:
+                raise BadRequestError(
+                    "continuous queries do not support percentiles")
+            if sub.tsuids or not sub.metric:
+                raise BadRequestError(
+                    "continuous queries require a metric (tsuids are "
+                    "not supported)")
+            if sub.explicit_tags:
+                raise BadRequestError(
+                    "continuous queries do not support explicitTags")
+            spec = sub.ds_spec
+            if spec is None or spec.run_all or spec.use_calendar \
+                    or spec.unit in ("n", "y") or spec.interval_ms <= 0:
+                raise BadRequestError(
+                    "continuous queries require a fixed-interval "
+                    "downsample (e.g. 1m-avg)")
+            if spec.function not in DECOMPOSABLE_DS:
+                raise BadRequestError(
+                    f"downsample function {spec.function!r} is not "
+                    f"decomposable into streaming partials "
+                    f"(supported: {', '.join(sorted(DECOMPOSABLE_DS))})")
+            windows = int((tsq.end_ms - tsq.start_ms)
+                          // spec.interval_ms) + 2
+            if windows > self.max_windows:
+                raise BadRequestError(
+                    f"window range needs {windows} tumbling windows; "
+                    f"tsd.streaming.max_windows={self.max_windows}")
+            plans.append(IncrementalSubPlan(self.tsdb, sub, windows))
+        # the horizon anchors at the query's RESOLVED end: now for the
+        # live-dashboard shape (end=now), the window's own end for an
+        # absolute registration — either way the ring covers exactly
+        # the window the standing query answers, and tumbles forward
+        # with ingest from there
+        anchor_ms = tsq.end_ms
+        with self._lock:
+            if len(self._queries) >= self.max_queries:
+                raise BadRequestError(
+                    f"too many continuous queries (tsd.streaming."
+                    f"max_queries={self.max_queries})")
+            if cid is None:
+                cid = f"cq{next(self._ids)}"
+            cid = str(cid)
+            if cid in self._queries:
+                raise BadRequestError(
+                    f"continuous query {cid!r} already exists")
+            # reserve the id so a concurrent same-id register fails
+            # fast; the bootstrap scan runs OUTSIDE the registry lock
+            # (the ingest tap and _maybe_publish take it — a wide
+            # bootstrap must not stall every write for seconds)
+            self._queries[cid] = cq = ContinuousQuery(
+                cid, body, tsq, plans)
+        try:
+            for plan in plans:
+                plan.bootstrap(anchor_ms)
+        except BaseException:
+            with self._lock:
+                self._queries.pop(cid, None)
+            raise
+        with self._lock:
+            for plan in plans:
+                self._index_plan_locked(plan)
+                key = (plan.metric, plan.sub.identity_key())
+                self._by_identity.setdefault(key, plan)
+        LOG.info("registered continuous query %s (%d sub-plans)",
+                 cid, len(plans))
+        return cq
+
+    def _index_plan_locked(self, plan: IncrementalSubPlan) -> None:
+        if plan.metric_id is not None:
+            self._by_mid.setdefault(plan.metric_id, []).append(plan)
+        else:
+            self._unresolved.append(plan)
+
+    def delete(self, cid: str) -> bool:
+        with self._lock:
+            cq = self._queries.pop(cid, None)
+            if cq is None:
+                return False
+            cq.closed = True
+            for plan in cq.plans:
+                if plan.metric_id is not None:
+                    lst = self._by_mid.get(plan.metric_id, [])
+                    if plan in lst:
+                        lst.remove(plan)
+                    if not lst:
+                        self._by_mid.pop(plan.metric_id, None)
+                if plan in self._unresolved:
+                    self._unresolved.remove(plan)
+                key = (plan.metric, plan.sub.identity_key())
+                if self._by_identity.get(key) is plan:
+                    del self._by_identity[key]
+                    # a surviving query with the same identity takes
+                    # over the pull path instead of silently falling
+                    # back to batch scans
+                    for other in self._queries.values():
+                        for p in other.plans:
+                            if (p.metric,
+                                    p.sub.identity_key()) == key:
+                                self._by_identity[key] = p
+                                break
+                        if key in self._by_identity:
+                            break
+            subs = list(cq.subscribers)
+        from opentsdb_tpu.streaming import sse
+        for sub in subs:
+            sse.offer_frame(sub, sse.frame(
+                "deleted", {"id": cid}))
+        return True
+
+    def get(self, cid: str) -> ContinuousQuery | None:
+        with self._lock:
+            return self._queries.get(cid)
+
+    def list(self) -> list[ContinuousQuery]:
+        with self._lock:
+            return [self._queries[k] for k in sorted(self._queries)]
+
+    def invalidate(self) -> None:
+        """Mark every plan for rebuild (the ``/api/dropcaches``
+        escape hatch: the next serve/pump re-seeds from the store)."""
+        for cq in self.list():
+            for plan in cq.plans:
+                plan.needs_rebuild = True
+
+    def shutdown(self) -> None:
+        for cq in self.list():
+            self.delete(cq.id)
+
+    # ------------------------------------------------------------------
+    # ingest tap (called from TSDB under the write-hook guard)
+    # ------------------------------------------------------------------
+
+    def _plans_for(self, metric_id: int
+                   ) -> list[IncrementalSubPlan] | None:
+        plans = self._by_mid.get(metric_id)
+        if plans is not None or not self._unresolved:
+            return plans
+        # a parked plan's metric may have just been minted by this
+        # very write: resolve by name once, then the fast path hits
+        with self._lock:
+            if not self._unresolved:
+                return self._by_mid.get(metric_id)
+            try:
+                name = self.tsdb.uids.metrics.get_name(metric_id)
+            except LookupError:
+                return None
+            for plan in list(self._unresolved):
+                if plan.metric == name:
+                    plan.metric_id = metric_id
+                    self._unresolved.remove(plan)
+                    self._by_mid.setdefault(metric_id, []).append(plan)
+            return self._by_mid.get(metric_id)
+
+    def offer(self, metric_id: int, sid: int, ts_ms: int,
+              value: float) -> None:
+        plans = self._plans_for(metric_id)
+        if not plans:
+            return
+        sid_a = np.asarray([sid], dtype=np.int64)
+        ts_a = np.asarray([ts_ms], dtype=np.int64)
+        val_a = np.asarray([value], dtype=np.float64)
+        for plan in plans:
+            if plan.offer(sid_a, ts_a, val_a) >= self.buffer_points:
+                self._drain_plan(plan)
+        self._maybe_publish()
+
+    def offer_many(self, metric_id: int, sid: int, ts_ms: np.ndarray,
+                   values: np.ndarray) -> None:
+        plans = self._plans_for(metric_id)
+        if not plans:
+            return
+        n = len(ts_ms)
+        sid_a = np.full(n, sid, dtype=np.int64)
+        for plan in plans:
+            if plan.offer(sid_a, ts_ms, values) >= self.buffer_points:
+                self._drain_plan(plan)
+        self._maybe_publish()
+
+    def _drain_plan(self, plan: IncrementalSubPlan) -> None:
+        """Fold a plan's pending chunks under the ``stream.fold``
+        fault site + breaker. A failed fold loses the chunks, so the
+        plan is marked for rebuild (one batch re-scan) — correctness
+        is restored by the rebuild, availability by the batch-engine
+        fallback in the meantime."""
+        pending = plan.take_pending()
+        if not pending:
+            return
+        br = self.breaker
+        if br is not None and br.blocking():
+            # folds while open would be wasted against a failing
+            # dependency; the rebuild after reset covers the gap
+            plan.needs_rebuild = True
+            return
+        try:
+            faults = getattr(self.tsdb, "faults", None)
+            if faults is not None:
+                faults.check("stream.fold")
+            for sids, ts, vals in pending:
+                plan.fold(sids, ts, vals)
+        except Exception as exc:  # noqa: BLE001 - degrade, never fail
+            self.fold_errors += 1
+            plan.needs_rebuild = True
+            if br is not None:
+                br.record_failure()
+            LOG.warning("stream.fold failed for %s (%s: %s); plan "
+                        "will rebuild", plan.metric,
+                        type(exc).__name__, exc)
+        else:
+            if br is not None and br.state != br.CLOSED:
+                br.record_success()
+
+    def _rebuild_plan(self, plan: IncrementalSubPlan,
+                      now_ms: int) -> bool:
+        """Re-seed a failed plan from the store, gated by the breaker
+        (a rebuild IS the half-open probe when the breaker is open)."""
+        br = self.breaker
+        if br is not None and not br.allow():
+            return False
+        try:
+            faults = getattr(self.tsdb, "faults", None)
+            if faults is not None:
+                faults.check("stream.fold")
+            plan.bootstrap(now_ms)
+        except Exception as exc:  # noqa: BLE001
+            if br is not None:
+                br.record_failure()
+            LOG.warning("stream rebuild failed for %s (%s: %s)",
+                        plan.metric, type(exc).__name__, exc)
+            return False
+        plan.needs_rebuild = False
+        self.rebuilds += 1
+        if br is not None:
+            br.record_success()
+        return True
+
+    # ------------------------------------------------------------------
+    # pull path: serve /api/query from the maintained windows
+    # ------------------------------------------------------------------
+
+    def try_serve(self, tsq: TSQuery, sub, engine) -> list | None:
+        """Results for one sub-query when a registered plan covers the
+        requested window, else None (caller falls through to the
+        result cache / batch engine).
+
+        Exactness contract: bucket-aligned absolute windows (and any
+        window whose end is past the newest folded point) are
+        value-identical to the batch engine; relative dashboard
+        windows (``1h-ago`` .. now) share the result cache's
+        GraphHandler staleness rule — the first bucket may cover up to
+        one extra downsample interval."""
+        if not self.tsdb.config.get_bool("tsd.streaming.serve", True):
+            return None
+        if tsq.delete or sub.percentiles or tsq.timezone \
+                or tsq.use_calendar:
+            return None
+        plan = self._by_identity.get((sub.metric, sub.identity_key()))
+        if plan is None:
+            return None
+        iv = plan.interval_ms
+        relative = _is_relative(tsq.start) or _is_relative(tsq.end)
+        if not relative and tsq.start_ms % iv:
+            return None
+        # deletes/repairs bump the store's mutation epoch; partials
+        # cannot unfold removed points, so a mismatch forces a rebuild
+        # before anything is served (this also covers delete=true
+        # queries and fsck repairs the registry never sees directly)
+        if plan.store_epoch != getattr(self.tsdb.store,
+                                       "mutation_epoch", 0):
+            plan.needs_rebuild = True
+        if plan.needs_rebuild and not self._rebuild_plan(
+                plan, tsq.end_ms):
+            self.serve_fallbacks += 1
+            return None
+        self._drain_plan(plan)
+        if plan.needs_rebuild:  # the drain itself just failed
+            self.serve_fallbacks += 1
+            return None
+        if not relative and (tsq.end_ms + 1) % iv \
+                and tsq.end_ms < plan.max_ts_ms:
+            # checked AFTER the drain: points past the unaligned end
+            # may have just folded into the final bucket — the batch
+            # engine would exclude them, so exactness is gone
+            self.serve_fallbacks += 1
+            return None
+        out = plan.serve(tsq, sub, engine)
+        if out is None:
+            self.serve_fallbacks += 1
+            return None
+        self.serve_hits += 1
+        return out
+
+    # ------------------------------------------------------------------
+    # push path: SSE publication
+    # ------------------------------------------------------------------
+
+    def subscribe(self, cq: ContinuousQuery):
+        from opentsdb_tpu.streaming.sse import Subscription
+        sub = Subscription(self.queue_events)
+        with cq.lock:
+            cq.subscribers.append(sub)
+            self._active_subs += 1
+        # initial snapshot so a dashboard renders before the first
+        # incremental update arrives
+        try:
+            self._publish(cq, snapshot=True, only=[sub])
+        except Exception:  # noqa: BLE001 - snapshot is best-effort
+            LOG.exception("initial snapshot failed for %s", cq.id)
+        return sub
+
+    def unsubscribe(self, cq: ContinuousQuery, sub) -> None:
+        with cq.lock:
+            if sub in cq.subscribers:
+                cq.subscribers.remove(sub)
+                self._active_subs -= 1
+
+    def _maybe_publish(self) -> None:
+        """Rate-limited push after ingest drains: at most one publish
+        per ``tsd.streaming.publish_min_interval_ms`` per query, and
+        only when someone is listening (one integer read on the hot
+        write path when nobody is)."""
+        if self._active_subs <= 0:
+            return
+        now = time.monotonic()
+        for cq in self.list():
+            if not cq.subscribers or cq.closed:
+                continue
+            if (now - cq.last_publish) * 1000.0 \
+                    < self.publish_min_interval_ms:
+                continue
+            if any(p.changed_ts or p.pending_points
+                   for p in cq.plans):
+                self.pump(cq)
+
+    def pump(self, cq: ContinuousQuery, force: bool = False) -> bool:
+        """Drain + publish one query's incremental updates to every
+        subscriber. Returns True when an event was published. Called
+        from the SSE generator's heartbeat loop and from the ingest
+        drain path (rate-limited)."""
+        anchor = None
+        epoch = getattr(self.tsdb.store, "mutation_epoch", 0)
+        for plan in cq.plans:
+            if plan.store_epoch != epoch:
+                # a delete/repair happened: partials cannot unfold
+                # removed points — re-seed before publishing
+                plan.needs_rebuild = True
+            if plan.needs_rebuild:
+                if anchor is None:
+                    try:
+                        anchor = self._emit_tsq(
+                            cq, int(time.time() * 1000)).end_ms
+                    except BadRequestError:
+                        anchor = int(time.time() * 1000)
+                self._rebuild_plan(plan, anchor)
+            self._drain_plan(plan)
+        if not force and not any(p.changed_ts for p in cq.plans):
+            return False
+        return self._publish(cq, snapshot=False)
+
+    def flush(self) -> None:
+        """Drain + publish everything now (tests, benchmarks, and the
+        admin surface)."""
+        for cq in self.list():
+            self.pump(cq, force=True)
+
+    def _emit_tsq(self, cq: ContinuousQuery, now_ms: int) -> TSQuery:
+        """The registration query re-resolved against *now* so emitted
+        windows track the live horizon."""
+        tsq = TSQuery.from_json(cq.raw)
+        return tsq.validate(now_ms)
+
+    def _publish(self, cq: ContinuousQuery, snapshot: bool,
+                 only: list | None = None) -> bool:
+        from opentsdb_tpu.streaming import sse
+        from opentsdb_tpu.query.engine import QueryEngine
+        now_ms = int(time.time() * 1000)
+        try:
+            tsq = self._emit_tsq(cq, now_ms)
+        except BadRequestError:
+            return False
+        engine = QueryEngine(self.tsdb)
+        updates = []
+        for plan, sub in zip(cq.plans, tsq.queries):
+            changed = None if snapshot else set(plan.take_changed())
+            if changed is not None and not changed:
+                continue
+            if changed is not None:
+                # result timestamps are second-rounded unless
+                # ms_resolution; changed buckets are ms edges
+                changed |= {c // 1000 * 1000 for c in changed}
+            results = plan.serve(tsq, sub, engine)
+            if not results:
+                continue
+            for r in results:
+                dps = {str(ts): (None if v != v else v)
+                       for ts, v in r.dps
+                       if changed is None or ts in changed}
+                if not dps:
+                    continue
+                updates.append({
+                    "metric": r.metric, "tags": r.tags,
+                    "aggregateTags": r.aggregated_tags,
+                    "index": r.sub_query_index, "dps": dps})
+        with cq.lock:
+            cq.emit_seq += 1
+            seq = cq.emit_seq
+            targets = list(only if only is not None
+                           else cq.subscribers)
+        if not updates and not snapshot:
+            return False
+        payload = {"id": cq.id, "seq": seq, "ts": now_ms,
+                   "updates": updates}
+        fr = sse.frame("snapshot" if snapshot else "windows", payload)
+        shed = 0
+        for s in targets:
+            if not sse.offer_frame(s, fr):
+                shed += 1
+                with cq.lock:
+                    if s in cq.subscribers:
+                        cq.subscribers.remove(s)
+                        self._active_subs -= 1
+        self.sse_shed += shed
+        self.sse_events += len(targets) - shed
+        self.publishes += 1
+        cq.last_publish = time.monotonic()
+        return True
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def _totals(self) -> dict[str, int]:
+        t = {"points_folded": 0, "folds": 0, "late_dropped": 0,
+             "pending_points": 0, "series": 0, "plans": 0}
+        for cq in self.list():
+            for p in cq.plans:
+                t["points_folded"] += p.points_folded
+                t["folds"] += p.folds
+                t["late_dropped"] += p.late_dropped
+                t["pending_points"] += p.pending_points
+                t["series"] += len(p._sids)
+                t["plans"] += 1
+        return t
+
+    def collect_stats(self, collector) -> None:
+        t = self._totals()
+        with self._lock:
+            n = len(self._queries)
+            subs = sum(len(cq.subscribers)
+                       for cq in self._queries.values())
+        collector.record("streaming.queries", n)
+        collector.record("streaming.plans", t["plans"])
+        collector.record("streaming.series", t["series"])
+        collector.record("streaming.points.folded", t["points_folded"])
+        collector.record("streaming.folds", t["folds"])
+        collector.record("streaming.points.pending",
+                         t["pending_points"])
+        collector.record("streaming.points.late_dropped",
+                         t["late_dropped"])
+        collector.record("streaming.serve.hits", self.serve_hits)
+        collector.record("streaming.serve.fallbacks",
+                         self.serve_fallbacks)
+        collector.record("streaming.fold.errors", self.fold_errors)
+        collector.record("streaming.rebuilds", self.rebuilds)
+        collector.record("streaming.sse.subscribers", subs)
+        collector.record("streaming.sse.events", self.sse_events)
+        collector.record("streaming.sse.shed", self.sse_shed)
+        collector.record("streaming.publishes", self.publishes)
+
+    def health_info(self) -> dict[str, Any]:
+        t = self._totals()
+        with self._lock:
+            n = len(self._queries)
+            subs = sum(len(cq.subscribers)
+                       for cq in self._queries.values())
+        out = {
+            "enabled": True,
+            "queries": n,
+            "plans": t["plans"],
+            "series": t["series"],
+            "points_folded": t["points_folded"],
+            "pending_points": t["pending_points"],
+            "late_dropped": t["late_dropped"],
+            "serve_hits": self.serve_hits,
+            "serve_fallbacks": self.serve_fallbacks,
+            "fold_errors": self.fold_errors,
+            "rebuilds": self.rebuilds,
+            "subscribers": subs,
+            "sse_events": self.sse_events,
+            "sse_shed": self.sse_shed,
+        }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.health_info()
+        return out

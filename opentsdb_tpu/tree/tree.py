@@ -401,7 +401,16 @@ class TreeManager:
 
     def process_series(self, tsuid: str, metric: str,
                        tags: dict[str, str]) -> None:
-        """Realtime hook (ref: TSDB.processTSMetaThroughTrees :2033)."""
+        """Realtime hook (ref: TSDB.processTSMetaThroughTrees :2033).
+
+        Runs the ``tree.store`` fault-injection site: filing a series
+        into tree branches is the tree WRITE path (realtime from
+        ingest via MetaStore.on_datapoint, and batch via
+        :meth:`sync_all`). On the ingest side the TSDB hook guard
+        swallows an armed fault — tree failures never fail a write."""
+        faults = getattr(self.tsdb, "faults", None)
+        if faults is not None:
+            faults.check("tree.store")
         for tree in self.trees.values():
             if tree.enabled:
                 TreeBuilder(tree).process(tsuid, metric, tags)

@@ -499,6 +499,8 @@ class HttpRpcRouter:
         sub = rest[0] if rest else ""
         if sub == "last":
             return self._handle_query_last(request)
+        if sub == "continuous":
+            return self._handle_query_continuous(request, rest[1:])
         if sub in ("exp", "gexp"):
             from opentsdb_tpu.query.expression.endpoint import (
                 handle_exp, handle_gexp)
@@ -595,6 +597,70 @@ class HttpRpcRouter:
             if not streamed:
                 stats.mark_complete()
         return HttpResponse(200, body)
+
+    def _handle_query_continuous(self, request: HttpRequest,
+                                 rest) -> HttpResponse:
+        """Continuous (standing) queries
+        (:mod:`opentsdb_tpu.streaming`): register / list / inspect /
+        delete standing TSQueries and attach SSE push streams.
+
+        - ``POST /api/query/continuous`` — register (body: TSQuery
+          JSON + optional ``id``); 400 when the query is not
+          incrementally maintainable.
+        - ``GET /api/query/continuous`` — list registered queries.
+        - ``GET /api/query/continuous/<id>`` — one query + plan stats.
+        - ``DELETE /api/query/continuous/<id>`` — deregister.
+        - ``GET /api/query/continuous/<id>/stream`` — Server-Sent
+          Events: an initial ``snapshot`` event, then incremental
+          ``windows`` events; slow consumers are shed with a terminal
+          ``shed`` event (bounded queues, never backpressure into
+          ingest)."""
+        registry = self.tsdb.streaming
+        if registry is None:
+            raise HttpError(400, "Continuous queries are disabled",
+                            "set tsd.streaming.enable = true")
+        if not rest:
+            if request.method == "POST":
+                cq = registry.register(request.json_object())
+                return HttpResponse(
+                    200, json.dumps(cq.describe()).encode())
+            if request.method == "GET":
+                return HttpResponse(200, json.dumps(
+                    [cq.describe() for cq in registry.list()]).encode())
+            raise HttpError(405, "Method not allowed")
+        cid = rest[0]
+        if len(rest) > 1 and rest[1] == "stream":
+            if request.method != "GET":
+                raise HttpError(405, "Method not allowed")
+            cq = registry.get(cid)
+            if cq is None:
+                raise HttpError(
+                    404, f"No continuous query with id {cid!r}")
+            from opentsdb_tpu.streaming.sse import sse_stream
+            resp = HttpResponse(
+                200, b"",
+                body_iter=sse_stream(
+                    registry, cq,
+                    max_lifetime_s=self.tsdb.config.get_float(
+                        "tsd.streaming.sse.max_lifetime_s", 0.0)),
+                content_type="text/event-stream; charset=UTF-8")
+            resp.headers["Cache-Control"] = "no-cache"
+            # an SSE stream is single-use by construction
+            resp.close_connection = True
+            return resp
+        if request.method == "GET":
+            cq = registry.get(cid)
+            if cq is None:
+                raise HttpError(
+                    404, f"No continuous query with id {cid!r}")
+            return HttpResponse(
+                200, json.dumps(cq.describe(verbose=True)).encode())
+        if request.method == "DELETE":
+            if not registry.delete(cid):
+                raise HttpError(
+                    404, f"No continuous query with id {cid!r}")
+            return HttpResponse(204)
+        raise HttpError(405, "Method not allowed")
 
     def _handle_query_last(self, request: HttpRequest) -> HttpResponse:
         """(ref: QueryRpc.java:346 /api/query/last via TSUIDQuery)"""
@@ -1095,6 +1161,20 @@ class HttpRpcRouter:
             cache_info = {"enabled": t.config.get_bool(
                 "tsd.query.cache.enable", True)
                 and t.config.get_int("tsd.query.cache.mb", 256) > 0}
+        # the raw attribute again: health must not instantiate the
+        # continuous-query registry just to report it absent
+        streaming = getattr(t, "_streaming", None)
+        if streaming is not None:
+            streaming_info = streaming.health_info()
+            sbreaker = streaming.breaker
+            if sbreaker is not None:
+                breakers[sbreaker.name] = sbreaker.health_info()
+                if sbreaker.state != sbreaker.CLOSED:
+                    causes.append(f"breaker:{sbreaker.name}")
+        else:
+            streaming_info = {"enabled": t.config.get_bool(
+                "tsd.streaming.enable", True), "queries": 0}
+        hook_errors = dict(getattr(t, "hook_errors", {}))
         doc: dict[str, Any] = {
             "status": "degraded" if causes else "ok",
             "degraded": bool(causes),
@@ -1105,6 +1185,8 @@ class HttpRpcRouter:
             "faults": (faults.health_info() if faults is not None
                        else {"armed": False, "sites": {}}),
             "query_cache": cache_info,
+            "streaming": streaming_info,
+            "hook_errors": hook_errors,
         }
         server = self.server
         if server is not None:

@@ -634,9 +634,15 @@ class TSDServer:
                 keep_alive = False
             # streamed serialization must honor the query timeout too:
             # the handler returned promptly with a lazy generator, so
-            # the clock keeps running through the chunk writes
+            # the clock keeps running through the chunk writes. SSE
+            # push streams (continuous queries) are exempt — they are
+            # long-lived BY DESIGN and carry their own shedding +
+            # lifetime bounds (tsd.streaming.*).
+            is_sse = (response.content_type or "").startswith(
+                "text/event-stream")
             deadline = (t0 + self.query_timeout_ms / 1000.0
                         if is_query and self.query_timeout_ms > 0
+                        and not is_sse
                         and response.body_iter is not None else None)
             await self._write_response(writer, response, version,
                                        keep_alive, deadline=deadline)
@@ -693,6 +699,12 @@ class TSDServer:
         biggest responses are exactly the ones that need it."""
         if "Content-Encoding" in response.headers:
             return
+        if (response.content_type or "").startswith(
+                "text/event-stream"):
+            # SSE must not buffer: zlib without per-chunk sync flushes
+            # would hold every event in the compressor until KBs
+            # accumulate — a browser EventSource would see nothing
+            return
         accept = request.headers.get("accept-encoding", "")
         if "gzip" not in accept.lower():
             return
@@ -738,11 +750,28 @@ class TSDServer:
                                               "Unknown")
         loop = asyncio.get_event_loop()
         if response.body_iter is not None and version != "HTTP/1.1":
-            # chunked TE needs 1.1; older clients get one body
-            # (joined on a worker thread — serialization is CPU work)
-            response.body = await loop.run_in_executor(
-                None, lambda: b"".join(response.body_iter))
-            response.body_iter = None
+            if (response.content_type or "").startswith(
+                    "text/event-stream"):
+                # an SSE generator is unbounded by design — joining it
+                # would pin a worker thread and memory forever. SSE
+                # needs chunked TE, so non-1.1 clients get a clean
+                # error instead.
+                try:
+                    response.body_iter.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                response = HttpResponse(
+                    400, b'{"error":{"code":400,"message":'
+                    b'"Event streams require HTTP/1.1"}}',
+                    close_connection=True)
+                keep_alive = False
+            else:
+                # chunked TE needs 1.1; older clients get one body
+                # (joined on a worker thread — serialization is CPU
+                # work)
+                response.body = await loop.run_in_executor(
+                    None, lambda: b"".join(response.body_iter))
+                response.body_iter = None
         head = [f"{version} {response.status} {reason}"]
         if response.body_iter is not None:
             head.append("Transfer-Encoding: chunked")

@@ -9,10 +9,14 @@ pieces live here, shared by the whole serve path:
 - :class:`FaultInjector` — injection points armed through ``Config``
   keys (``tsd.faults.<site>_<knob>``), wired into the WAL
   (``wal.fsync``, ``wal.append``), the store read path (``store``),
-  snapshot flush (``store.flush``) and the device pipeline entry
-  (``device.compile``). Scheduling is DETERMINISTIC — an error *rate*
-  is a counted schedule (fail call ``i`` iff ``floor(i*r)`` advances),
-  never a coin flip — so every fault battery failure reproduces.
+  snapshot flush (``store.flush``), the device pipeline entry
+  (``device.compile``), lazily-created rollup tier/preagg stores
+  (``rollup.store``), the tree filing path (``tree.store``), the meta
+  write paths (``meta.store``) and the continuous-query incremental
+  fold/rebuild path (``stream.fold``). Scheduling is DETERMINISTIC —
+  an error *rate* is a counted schedule (fail call ``i`` iff
+  ``floor(i*r)`` advances), never a coin flip — so every fault
+  battery failure reproduces.
 - :class:`RetryPolicy` / :func:`call_with_retries` — bounded
   exponential backoff with a wall-clock deadline, used by WAL
   fsync/append and the snapshot flush path.

@@ -240,10 +240,11 @@ class TestBulkWrite:
         assert t.store.series(sid).buffer.view()[0].tolist() == \
             [1356998400000, 1356998420000]
 
-    def test_add_point_batch_no_double_publish_on_hook_failure(self):
-        # a realtime publisher raising mid-batch must not make the
-        # replay re-publish points that already landed (the store
-        # dedupes cells, but hooks are not idempotent)
+    def test_add_point_batch_hook_failure_never_fails_write(self):
+        # a realtime publisher raising mid-batch must not fail the
+        # ACKNOWLEDGED writes (the points are already durable when
+        # hooks run): the error is swallowed with a per-hook counter,
+        # nothing is re-published, and every point lands exactly once
         t = self._tsdb()
         published = []
 
@@ -265,9 +266,15 @@ class TestBulkWrite:
             ("m", 1356998420, 3.0, {"h": "a"}),
         ], on_error=lambda i, e: bad_idx.append(i))
         assert published == [1356998400, 1356998420]  # no replays
-        assert written == 2
-        assert bad_idx == [1]
-        assert "hiccup" in errors[0]
+        assert written == 3                           # all acked
+        assert bad_idx == [] and errors == []
+        assert t.hook_errors["rt_publisher"] == 1
+        sid = t.store.get_or_create_series(
+            t.uids.metrics.get_id("m"),
+            [(t.uids.tag_names.get_id("h"),
+              t.uids.tag_values.get_id("a"))])
+        assert t.store.series(sid).buffer.view()[0].tolist() == \
+            [1356998400000, 1356998410000, 1356998420000]
 
     def test_add_point_batch_mixed_int_float_flags(self):
         # per-point integer flags survive the bulk path (the storage

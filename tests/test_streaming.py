@@ -1,0 +1,572 @@
+"""Continuous-query subsystem battery.
+
+Covers the registry surface (register/list/delete over HTTP), the
+pull path (streaming serve hits with freshness under ingest — the
+live-query gap PR 2's result cache could not close), the SSE push
+transport (snapshot + incremental events, slow-consumer shedding),
+and the streaming/batch equivalence oracle battery: incrementally
+maintained window results must be value-identical to a cold batch
+``/api/query`` over the same bucket-aligned range, across
+aggregators, downsample specs, rate, and group-by — with an
+independent cross-check against ``tests/oracle.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+pytestmark = pytest.mark.streaming
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+IV_MS = 60_000               # 1m downsample interval
+RANGE_S = 1800               # 30m window
+END_MS = BASE_MS + RANGE_S * 1000
+
+
+def _tsdb(**extra):
+    cfg = {"tsd.core.auto_create_metrics": "true"}
+    cfg.update(extra)
+    return TSDB(Config(**cfg))
+
+
+def _qobj(agg="sum", ds="1m-sum", rate=False, gb=None,
+          start=BASE_MS, end=END_MS, metric="s.m"):
+    sub = {"metric": metric, "aggregator": agg, "downsample": ds}
+    if rate:
+        sub["rate"] = True
+    if gb:
+        sub["filters"] = [{"type": "wildcard", "tagk": gb,
+                           "filter": "*", "groupBy": True}]
+    q = {"start": start, "queries": [sub]}
+    if end is not None:
+        q["end"] = end
+    return q
+
+
+SERIES = [
+    {"host": "h0", "dc": "east"},
+    {"host": "h1", "dc": "east"},
+    {"host": "h2", "dc": "west"},
+    {"host": "h3", "dc": "west"},
+]
+
+
+def _ingest(t, tags_list, t0_s, n, step_s=20, seed=0):
+    rng = np.random.default_rng(seed)
+    for i, tags in enumerate(tags_list):
+        ts = np.arange(t0_s, t0_s + n * step_s, step_s,
+                       dtype=np.int64) + (i % 3)
+        vals = rng.normal(50.0 + 10 * i, 5.0, len(ts))
+        if i == 1:
+            # one gappy series exercises interpolation / fill
+            ts, vals = ts[::2], vals[::2]
+        t.add_points("s.m", ts, vals, tags)
+
+
+def _register(t, qobj, now_ms=END_MS, cid=None):
+    obj = dict(qobj)
+    if cid:
+        obj["id"] = cid
+    return t.streaming.register(obj, now_ms=now_ms)
+
+
+def _run(t, qobj):
+    tsq = TSQuery.from_json(qobj).validate()
+    return t.execute_query(tsq)
+
+
+def _run_batch(t, qobj):
+    """Reference execution with the streaming feeder AND the result
+    cache disabled — the cold scan -> pipeline chain."""
+    t.config.override_config("tsd.streaming.serve", "false")
+    t.config.override_config("tsd.query.cache.enable", "false")
+    try:
+        return _run(t, qobj)
+    finally:
+        t.config.override_config("tsd.streaming.serve", "true")
+        t.config.override_config("tsd.query.cache.enable", "true")
+
+
+def _as_map(results):
+    out = {}
+    for r in results:
+        key = (r.metric, tuple(sorted(r.tags.items())),
+               tuple(sorted(r.aggregated_tags)))
+        assert key not in out
+        out[key] = dict(r.dps)
+    return out
+
+
+def _assert_value_identical(streamed, batch):
+    sm, bm = _as_map(streamed), _as_map(batch)
+    assert sm.keys() == bm.keys()
+    for key in sm:
+        ds_, db_ = sm[key], bm[key]
+        assert set(ds_) == set(db_), key
+        for ts in ds_:
+            va, vb = ds_[ts], db_[ts]
+            if va != va and vb != vb:
+                continue  # NaN == NaN here
+            assert va == pytest.approx(vb, rel=1e-9, abs=1e-9), \
+                (key, ts, va, vb)
+
+
+# ---------------------------------------------------------------------------
+# oracle-conformance battery: streaming == batch, value for value
+# ---------------------------------------------------------------------------
+
+CASES = [
+    ("sum", "1m-avg", False, None),
+    ("avg", "1m-sum", False, "host"),
+    ("min", "1m-max", False, None),
+    ("max", "1m-min", False, "host"),
+    ("count", "1m-count", False, None),
+    ("dev", "1m-avg", False, "host"),
+    ("sum", "2m-sum", False, "dc"),
+    ("sum", "1m-sum", True, None),
+    ("avg", "1m-avg", True, "host"),
+    ("mimmax", "1m-max", False, None),
+    ("zimsum", "1m-sum", False, "host"),
+    ("none", "1m-avg", False, None),
+]
+
+
+class TestStreamingBatchEquivalence:
+    @pytest.mark.parametrize("agg,ds,rate,gb", CASES)
+    def test_matches_batch(self, agg, ds, rate, gb):
+        t = _tsdb()
+        # half the data exists before registration (bootstrap scan)...
+        _ingest(t, SERIES[:3], BASE, 40, seed=1)
+        qobj = _qobj(agg=agg, ds=ds, rate=rate, gb=gb)
+        _register(t, qobj)
+        # ...half streams in after, including a brand-new series the
+        # plan has never seen (membership growth through the tap)
+        _ingest(t, SERIES, BASE + 900, 40, seed=2)
+        reg = t.streaming
+        hits0 = reg.serve_hits
+        streamed = _run(t, qobj)
+        assert reg.serve_hits == hits0 + 1, \
+            "query was not served from the maintained windows"
+        batch = _run_batch(t, qobj)
+        assert streamed, "empty result would be a vacuous pass"
+        _assert_value_identical(streamed, batch)
+
+    def test_matches_independent_oracle(self):
+        """Cross-check against tests/oracle.py — shared-bug insurance
+        the batch-vs-streaming comparison cannot provide."""
+        from tests.oracle import run_oracle
+        t = _tsdb()
+        _ingest(t, SERIES[:2], BASE, 40, seed=3)
+        qobj = _qobj(agg="sum", ds="1m-avg")
+        _register(t, qobj)
+        _ingest(t, SERIES[:2], BASE + 900, 40, seed=4)
+        streamed = _run(t, qobj)
+        series = []
+        for tags in SERIES[:2]:
+            sid = t.store.get_or_create_series(
+                t.uids.metrics.get_id("s.m"),
+                [(t.uids.tag_names.get_id(k),
+                  t.uids.tag_values.get_id(v))
+                 for k, v in sorted(tags.items())])
+            ts_ms, vals = t.store.series(sid).buffer.view()
+            series.append((np.asarray(ts_ms), np.asarray(vals)))
+        expected = run_oracle(series, "sum", IV_MS, "avg",
+                              BASE_MS, END_MS)
+        got = dict(streamed[0].dps)
+        assert set(got) == set(expected)
+        for ts, v in expected.items():
+            assert got[ts] == pytest.approx(v, rel=1e-9), ts
+
+    def test_fold_batches_equal_point_writes(self):
+        """add_points bulk taps and add_point single-point taps fold
+        to the same partials."""
+        t = _tsdb()
+        qobj = _qobj()
+        _register(t, qobj, now_ms=END_MS)
+        ts = np.arange(BASE, BASE + 600, 30, dtype=np.int64)
+        vals = np.linspace(1.0, 20.0, len(ts))
+        t.add_points("s.m", ts, vals, {"host": "bulk"})
+        for ts_i, v in zip(ts.tolist(), vals.tolist()):
+            t.add_point("s.m", int(ts_i), float(v), {"host": "single"})
+        streamed = _run(t, qobj)
+        batch = _run_batch(t, qobj)
+        _assert_value_identical(streamed, batch)
+
+
+# ---------------------------------------------------------------------------
+# pull path: live freshness under ingest (the PR-2 gap)
+# ---------------------------------------------------------------------------
+
+class TestPullPath:
+    def test_fresh_under_sustained_ingest(self):
+        """Repeated dashboard refreshes keep hitting the maintained
+        windows while ingest streams in — and every refresh reflects
+        the writes (the epoch-invalidated cache alone could only
+        miss here)."""
+        t = _tsdb()
+        qobj = _qobj(agg="sum", ds="1m-sum")
+        _ingest(t, SERIES[:2], BASE, 20, seed=5)
+        _register(t, qobj)
+        reg = t.streaming
+        last = None
+        for round_i in range(5):
+            t.add_point("s.m", BASE + 1000 + round_i, 100.0,
+                        {"host": "h0"})
+            res = _run(t, qobj)
+            total = sum(v for _, v in res[0].dps if v == v)
+            if last is not None:
+                assert total == pytest.approx(last + 100.0), \
+                    "refresh did not observe the acknowledged write"
+            last = total
+        assert reg.serve_hits == 5
+
+    def test_relative_window_serves(self):
+        """The live-dashboard shape: start=30m-ago, end=now."""
+        t = _tsdb()
+        now_s = int(time.time())
+        t0 = now_s - 1500
+        ts = np.arange(t0, now_s - 10, 30, dtype=np.int64)
+        t.add_points("s.m", ts, np.ones(len(ts)), {"host": "h0"})
+        qobj = _qobj(start="30m-ago", end=None)
+        _register(t, qobj, now_ms=int(time.time() * 1000))
+        reg = t.streaming
+        res = _run(t, qobj)
+        assert reg.serve_hits == 1
+        assert res and res[0].num_dps > 0
+        t.add_point("s.m", now_s, 1.0, {"host": "h0"})
+        res2 = _run(t, qobj)
+        assert reg.serve_hits == 2
+        assert sum(v for _, v in res2[0].dps) == \
+            pytest.approx(sum(v for _, v in res[0].dps) + 1.0)
+
+    def test_unaligned_absolute_window_falls_back(self):
+        t = _tsdb()
+        _ingest(t, SERIES[:1], BASE, 20, seed=6)
+        _register(t, _qobj())
+        reg = t.streaming
+        off = _qobj(start=BASE_MS + 1, end=END_MS - IV_MS)
+        res = _run(t, off)  # mid-bucket start: must NOT stream-serve
+        assert reg.serve_hits == 0
+        assert res  # batch still answers
+
+    def test_window_outside_horizon_falls_back(self):
+        t = _tsdb()
+        _ingest(t, SERIES[:1], BASE, 20, seed=7)
+        _register(t, _qobj())
+        reg = t.streaming
+        old = _qobj(start=BASE_MS - 86_400_000,
+                    end=BASE_MS - 82_800_000)
+        _run(t, old)
+        assert reg.serve_hits == 0
+
+    def test_delete_invalidates_maintained_windows(self):
+        """Partials cannot unfold removed points: a delete=true query
+        bumps the store's mutation epoch and the next pull must
+        rebuild before serving (never re-serve deleted data)."""
+        t = _tsdb()
+        _ingest(t, SERIES[:1], BASE, 20, seed=12)
+        qobj = _qobj(agg="sum", ds="1m-sum")
+        _register(t, qobj)
+        before = _run(t, qobj)
+        assert t.streaming.serve_hits == 1
+        dq = _qobj(start=BASE_MS, end=BASE_MS + 300_000)
+        dq["delete"] = True
+        t.execute_query(TSQuery.from_json(dq).validate())
+        after = _run(t, qobj)
+        assert t.streaming.rebuilds == 1
+        assert t.streaming.serve_hits == 2
+        _assert_value_identical(after, _run_batch(t, qobj))
+        assert sum(v for _, v in after[0].dps) < \
+            sum(v for _, v in before[0].dps)
+
+    def test_drop_caches_forces_rebuild(self):
+        t = _tsdb()
+        _ingest(t, SERIES[:1], BASE, 20, seed=13)
+        qobj = _qobj()
+        _register(t, qobj)
+        t.drop_caches()
+        _run(t, qobj)
+        assert t.streaming.rebuilds == 1
+        assert t.streaming.serve_hits == 1
+
+    def test_same_identity_survivor_keeps_serving_after_delete(self):
+        t = _tsdb()
+        _ingest(t, SERIES[:1], BASE, 10, seed=14)
+        qobj = _qobj()
+        _register(t, qobj, cid="a")
+        _register(t, qobj, cid="b")
+        reg = t.streaming
+        _run(t, qobj)
+        assert reg.serve_hits == 1
+        assert reg.delete("a")
+        _run(t, qobj)
+        assert reg.serve_hits == 2, \
+            "surviving same-identity query lost the pull path"
+
+    def test_delete_query_bypasses_streaming(self):
+        t = _tsdb()
+        _ingest(t, SERIES[:1], BASE, 20, seed=8)
+        _register(t, _qobj())
+        qobj = dict(_qobj())
+        qobj["delete"] = True
+        tsq = TSQuery.from_json(qobj).validate()
+        t.execute_query(tsq)
+        assert t.streaming.serve_hits == 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+class TestContinuousHttp:
+    def _router(self, t):
+        return HttpRpcRouter(t)
+
+    def _post(self, router, obj, path="/api/query/continuous"):
+        return router.handle(HttpRequest(
+            method="POST", path=path, body=json.dumps(obj).encode()))
+
+    def test_register_list_get_delete(self):
+        t = _tsdb()
+        router = self._router(t)
+        resp = self._post(router, _qobj())
+        assert resp.status == 200
+        cid = json.loads(resp.body)["id"]
+        resp = router.handle(HttpRequest(
+            method="GET", path="/api/query/continuous"))
+        assert resp.status == 200
+        listed = json.loads(resp.body)
+        assert [c["id"] for c in listed] == [cid]
+        resp = router.handle(HttpRequest(
+            method="GET", path=f"/api/query/continuous/{cid}"))
+        assert resp.status == 200
+        doc = json.loads(resp.body)
+        assert doc["intervalMs"] == [IV_MS] and "plans" in doc
+        resp = router.handle(HttpRequest(
+            method="DELETE", path=f"/api/query/continuous/{cid}"))
+        assert resp.status == 204
+        resp = router.handle(HttpRequest(
+            method="DELETE", path=f"/api/query/continuous/{cid}"))
+        assert resp.status == 404
+
+    @pytest.mark.parametrize("breakage", [
+        lambda q: q["queries"][0].pop("downsample"),
+        lambda q: q["queries"][0].update(downsample="0all-sum"),
+        lambda q: q["queries"][0].update(downsample="1m-p95"),
+        lambda q: q["queries"][0].update(percentiles=[99.0]),
+        lambda q: q["queries"][0].update(explicitTags=True),
+        lambda q: q.update(delete=True),
+    ])
+    def test_unmaintainable_queries_400(self, breakage):
+        t = _tsdb()
+        router = self._router(t)
+        q = _qobj()
+        breakage(q)
+        resp = self._post(router, q)
+        assert resp.status == 400
+
+    def test_stats_and_health_export(self):
+        t = _tsdb()
+        router = self._router(t)
+        self._post(router, _qobj())
+        _ingest(t, SERIES[:1], BASE, 10, seed=9)
+        _run(t, _qobj())
+        resp = router.handle(HttpRequest(method="GET",
+                                         path="/api/stats"))
+        names = {s["metric"] for s in json.loads(resp.body)}
+        assert "tsd.streaming.queries" in names
+        assert "tsd.streaming.serve.hits" in names
+        resp = router.handle(HttpRequest(method="GET",
+                                         path="/api/health"))
+        doc = json.loads(resp.body)
+        assert doc["streaming"]["queries"] == 1
+        assert doc["streaming"]["serve_hits"] >= 1
+        assert doc["status"] == "ok"
+
+    def test_disabled_registry_400(self):
+        t = _tsdb(**{"tsd.streaming.enable": "false"})
+        router = self._router(t)
+        resp = self._post(router, _qobj())
+        assert resp.status == 400
+
+
+# ---------------------------------------------------------------------------
+# SSE push transport
+# ---------------------------------------------------------------------------
+
+def _events(frames: bytes) -> list[tuple[str, dict]]:
+    out = []
+    for block in frames.decode().split("\n\n"):
+        lines = [ln for ln in block.strip().splitlines()
+                 if ln and not ln.startswith(":")]
+        ev = data = None
+        for ln in lines:
+            if ln.startswith("event: "):
+                ev = ln[7:]
+            elif ln.startswith("data: "):
+                data = json.loads(ln[6:])
+        if ev:
+            out.append((ev, data))
+    return out
+
+
+class TestSsePush:
+    def _setup(self, **extra):
+        t = _tsdb(**{"tsd.streaming.heartbeat_s": "0.05", **extra})
+        _ingest(t, SERIES[:2], BASE, 10, seed=10)
+        cq = _register(t, _qobj(agg="sum", ds="1m-sum"))
+        return t, t.streaming, cq
+
+    def test_snapshot_then_incremental_updates(self):
+        t, reg, cq = self._setup()
+        from opentsdb_tpu.streaming.sse import sse_stream
+        gen = sse_stream(reg, cq)
+        assert next(gen).startswith(b"retry:")
+        ev, data = _events(next(gen))[0]
+        assert ev == "snapshot"
+        assert data["id"] == cq.id and data["updates"]
+        # an ingest tick + flush produces exactly the changed windows
+        t.add_point("s.m", BASE + 700, 123.0, {"host": "h0"})
+        reg.flush()
+        ev, data = _events(next(gen))[0]
+        assert ev == "windows"
+        bucket = (BASE + 700) * 1000 // IV_MS * IV_MS // 1000 * 1000
+        dps = data["updates"][0]["dps"]
+        assert str(bucket) in dps
+        assert len(dps) == 1, "emitted more than the dirty window"
+        gen.close()
+        assert cq.subscribers == []
+
+    def test_slow_consumer_is_shed(self):
+        t, reg, cq = self._setup(
+            **{"tsd.streaming.queue_events": "2",
+               "tsd.streaming.publish_min_interval_ms": "0"})
+        from opentsdb_tpu.streaming.sse import sse_stream
+        gen = sse_stream(reg, cq)
+        next(gen)  # subscribe (retry frame); consumer now stalls
+        for i in range(6):
+            t.add_point("s.m", BASE + 700 + i, 1.0, {"host": "h0"})
+            reg.flush()
+        assert reg.sse_shed >= 1
+        assert cq.subscribers == []  # removed from the publish set
+        seen = []
+        for fr in gen:
+            seen.extend(e for e, _ in _events(fr))
+            if "shed" in seen:
+                break
+        assert "shed" in seen, "stream did not end with a shed event"
+
+    def test_delete_ends_stream(self):
+        t, reg, cq = self._setup()
+        from opentsdb_tpu.streaming.sse import sse_stream
+        gen = sse_stream(reg, cq)
+        next(gen)
+        reg.delete(cq.id)
+        seen = []
+        for fr in gen:
+            seen.extend(e for e, _ in _events(fr))
+            if any(e in ("deleted", "end") for e in seen):
+                break
+        assert any(e in ("deleted", "end") for e in seen)
+
+    def test_http_stream_endpoint(self):
+        t, reg, cq = self._setup()
+        router = HttpRpcRouter(t)
+        resp = router.handle(HttpRequest(
+            method="GET",
+            path=f"/api/query/continuous/{cq.id}/stream"))
+        assert resp.status == 200
+        assert resp.content_type.startswith("text/event-stream")
+        assert resp.body_iter is not None
+        it = iter(resp.body_iter)
+        assert next(it).startswith(b"retry:")
+        ev, _ = _events(next(it))[0]
+        assert ev == "snapshot"
+        it.close()
+
+    def test_http_stream_unknown_id_404(self):
+        t, reg, cq = self._setup()
+        router = HttpRpcRouter(t)
+        resp = router.handle(HttpRequest(
+            method="GET", path="/api/query/continuous/nope/stream"))
+        assert resp.status == 404
+
+
+# ---------------------------------------------------------------------------
+# window ring mechanics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestStreamingSoak:
+    def test_hour_of_sustained_ingest_stays_equivalent(self):
+        """Soak: an hour of simulated ingest tumbles the ring ~5x
+        over; a sliding dashboard window must keep streaming-serving
+        and stay value-identical to the batch engine throughout."""
+        t = _tsdb()
+        qobj = _qobj(start=BASE_MS, end=BASE_MS + 600_000)  # 10m
+        cq = _register(t, qobj, now_ms=BASE_MS + 600_000)
+        checks = 0
+        for k in range(60):
+            ts_s = BASE + 600 + k * 60  # the advancing live front
+            t.add_point("s.m", ts_s, float(k), {"host": "h0"})
+            t.add_point("s.m", ts_s + 10, 2.0 * k, {"host": "h1"})
+            if k % 10 == 9:
+                front_edge = ts_s * 1000 // IV_MS * IV_MS
+                q = _qobj(start=front_edge - 540_000,
+                          end=front_edge + 59_999)
+                hits0 = t.streaming.serve_hits
+                streamed = _run(t, q)
+                assert t.streaming.serve_hits == hits0 + 1
+                _assert_value_identical(streamed, _run_batch(t, q))
+                checks += 1
+        assert checks == 6
+        assert cq.plans[0].covered_from_ms > BASE_MS  # ring tumbled
+
+
+class TestWindowRing:
+    def test_tumbling_evicts_and_late_points_drop(self):
+        t = _tsdb()
+        qobj = _qobj(start=BASE_MS, end=BASE_MS + 300_000)  # 5m -> 7 W
+        cq = _register(t, qobj, now_ms=BASE_MS + 300_000)
+        plan = cq.plans[0]
+        w = plan.n_windows
+        t.add_point("s.m", BASE + 60, 1.0, {"host": "h0"})
+        # jump far past the horizon: every old window tumbles out
+        far = BASE + 60 + w * 60 * 3
+        t.add_point("s.m", far, 2.0, {"host": "h0"})
+        t.streaming.flush()
+        # the original point's window is gone; a late write there drops
+        t.add_point("s.m", BASE + 61, 5.0, {"host": "h0"})
+        t.streaming.flush()
+        assert plan.late_dropped >= 1
+        assert plan.covered_from_ms > BASE_MS
+
+    def test_new_series_join_and_filters_apply(self):
+        t = _tsdb()
+        qobj = _qobj(gb="host")
+        qobj["queries"][0]["filters"].append(
+            {"type": "literal_or", "tagk": "dc", "filter": "east",
+             "groupBy": False})
+        _ingest(t, SERIES[:1], BASE, 10, seed=11)
+        cq = _register(t, qobj)
+        plan = cq.plans[0]
+        assert len(plan._sids) == 1
+        # east joins, west is filtered out at admission
+        t.add_point("s.m", BASE + 700, 1.0,
+                    {"host": "hx", "dc": "east"})
+        t.add_point("s.m", BASE + 700, 1.0,
+                    {"host": "hy", "dc": "west"})
+        t.streaming.flush()
+        assert len(plan._sids) == 2
+        streamed = _run(t, qobj)
+        batch = _run_batch(t, qobj)
+        _assert_value_identical(streamed, batch)

@@ -1,0 +1,186 @@
+"""Degradation battery for the streaming subsystem and the new
+tree/meta fault sites.
+
+Asserts the PR-1 idiom end-to-end: an armed ``stream.fold`` fault can
+never fail ingest or a query (pulls shed to the batch engine, the
+plan heals by rebuild once the breaker allows a probe), and armed
+``tree.store`` / ``meta.store`` faults can never fail an acknowledged
+point write (the TSDB hook guard swallows them with counters).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+pytestmark = [pytest.mark.streaming, pytest.mark.robustness]
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+END_MS = BASE_MS + 1800 * 1000
+
+
+def _tsdb(**extra):
+    cfg = {"tsd.core.auto_create_metrics": "true"}
+    cfg.update(extra)
+    return TSDB(Config(**cfg))
+
+
+def _qobj():
+    return {"start": BASE_MS, "end": END_MS,
+            "queries": [{"metric": "s.m", "aggregator": "sum",
+                         "downsample": "1m-sum"}]}
+
+
+def _run(t):
+    return t.execute_query(TSQuery.from_json(_qobj()).validate())
+
+
+def _seed(t, n=20):
+    ts = np.arange(BASE, BASE + n * 30, 30, dtype=np.int64)
+    t.add_points("s.m", ts, np.ones(n), {"host": "h0"})
+
+
+def _total(results):
+    return sum(v for _, v in results[0].dps if v == v)
+
+
+class TestStreamFoldDegradation:
+    def test_transient_fold_fault_rebuilds_and_recovers(self):
+        t = _tsdb()
+        _seed(t)
+        t.streaming.register(_qobj(), now_ms=END_MS)
+        reg = t.streaming
+        t.faults.arm("stream.fold", error_count=1)
+        # ingest NEVER fails while the fold is faulting
+        t.add_point("s.m", BASE + 700, 5.0, {"host": "h0"})
+        # first query: the drain fails -> shed to the batch engine,
+        # still a correct answer
+        r1 = _run(t)
+        assert reg.fold_errors == 1 and reg.serve_fallbacks >= 1
+        assert _total(r1) == pytest.approx(25.0)
+        # second query: the rebuild probe succeeds (one batch re-scan
+        # recovers the folds the failure lost) and serving resumes
+        r2 = _run(t)
+        assert reg.rebuilds == 1
+        assert reg.serve_hits == 1
+        assert _total(r2) == pytest.approx(25.0)
+
+    def test_persistent_fold_faults_trip_breaker_never_500(self):
+        t = _tsdb(**{
+            "tsd.streaming.breaker.failure_threshold": "2",
+            "tsd.faults.stream.fold_error_rate": "1.0"})
+        _seed(t)
+        t.streaming.register(_qobj(), now_ms=END_MS)
+        reg = t.streaming
+        router = HttpRpcRouter(t)
+        for i in range(4):
+            t.add_point("s.m", BASE + 700 + i, 5.0, {"host": "h0"})
+            resp = router.handle(HttpRequest(
+                method="POST", path="/api/query",
+                body=json.dumps(_qobj()).encode()))
+            assert resp.status == 200, resp.body
+        assert reg.serve_hits == 0
+        assert reg.serve_fallbacks >= 2
+        assert reg.breaker.state == reg.breaker.OPEN
+        # the last response still carries every acknowledged write
+        out = json.loads(resp.body)
+        assert sum(out[0]["dps"].values()) == pytest.approx(40.0)
+        health = json.loads(router.handle(HttpRequest(
+            method="GET", path="/api/health")).body)
+        assert "breaker:stream.fold" in health["causes"]
+        assert health["streaming"]["fold_errors"] >= 1
+        assert health["breakers"]["stream.fold"]["state"] == "open"
+
+    def test_ingest_unaffected_by_fold_faults(self):
+        t = _tsdb(**{"tsd.faults.stream.fold_error_rate": "1.0",
+                     "tsd.streaming.buffer_points": "1"})
+        t.streaming.register(_qobj(), now_ms=END_MS)
+        # buffer_points=1 forces a (failing) drain on every write —
+        # the write path must stay clean regardless
+        for i in range(10):
+            t.add_point("s.m", BASE + i, 1.0, {"host": "h0"})
+        assert t.datapoints_added == 10
+        assert t.store.points_written == 10
+
+
+class TestTreeMetaFaultSites:
+    def test_meta_store_fault_never_fails_ingest(self):
+        t = _tsdb(**{
+            "tsd.core.meta.enable_realtime_ts": "true",
+            "tsd.faults.meta.store_error_rate": "1.0"})
+        sid = t.add_point("s.m", BASE, 1.0, {"host": "h0"})
+        assert sid >= 0
+        assert t.store.points_written == 1
+        assert t.hook_errors["meta"] == 1
+        assert t.meta.ts_meta == {}  # the meta write really failed
+        # and the point is fully readable
+        res = _run(t)
+        assert _total(res) == pytest.approx(1.0)
+
+    def test_meta_sync_paths_run_the_fault_site(self):
+        t = _tsdb(**{"tsd.core.meta.enable_realtime_ts": "true"})
+        t.add_point("s.m", BASE, 1.0, {"host": "h0"})
+        t.faults.arm("meta.store", error_count=10)
+        from opentsdb_tpu.utils.faults import InjectedFault
+        uid = t.uids.metrics.int_to_uid(
+            t.uids.metrics.get_id("s.m")).hex().upper()
+        with pytest.raises(InjectedFault):
+            t.meta.sync_uid_meta("metric", uid,
+                                 {"description": "x"}, False)
+        tsuid = next(iter(t.meta.ts_meta))
+        with pytest.raises(InjectedFault):
+            t.meta.sync_ts_meta(tsuid, {"description": "x"}, False)
+
+    def _tree_tsdb(self, **extra):
+        t = _tsdb(**{
+            "tsd.core.meta.enable_realtime_ts": "true",
+            "tsd.core.tree.enable_processing": "true", **extra})
+        from opentsdb_tpu.tree.tree import TreeRule, tree_manager
+        mgr = tree_manager(t)
+        tree = mgr.create_tree("by-metric")
+        tree.enabled = True
+        tree.set_rule(TreeRule(tree_id=tree.tree_id, level=0, order=0,
+                               type="METRIC", separator="."))
+        return t, mgr, tree
+
+    def test_realtime_tree_files_series_from_ingest(self):
+        t, mgr, tree = self._tree_tsdb()
+        t.add_point("s.m", BASE, 1.0, {"host": "h0"})
+        assert "s" in tree.root.branches
+        assert "m" in tree.root.branches["s"].leaves
+
+    def test_tree_store_fault_never_fails_ingest(self):
+        t, mgr, tree = self._tree_tsdb(
+            **{"tsd.faults.tree.store_error_rate": "1.0"})
+        sid = t.add_point("s.m", BASE, 1.0, {"host": "h0"})
+        assert sid >= 0 and t.store.points_written == 1
+        assert t.hook_errors["tree.rt"] == 1
+        assert tree.root.branches == {}  # the filing really failed
+        res = _run(t)
+        assert _total(res) == pytest.approx(1.0)
+
+    def test_fault_sites_visible_in_health(self):
+        t = _tsdb(**{
+            "tsd.core.meta.enable_realtime_ts": "true",
+            "tsd.faults.meta.store_error_rate": "1.0",
+            "tsd.faults.tree.store_latency_ms": "1"})
+        t.add_point("s.m", BASE, 1.0, {"host": "h0"})
+        router = HttpRpcRouter(t)
+        health = json.loads(router.handle(HttpRequest(
+            method="GET", path="/api/health")).body)
+        assert health["faults"]["armed"]
+        assert "meta.store" in health["faults"]["sites"]
+        assert health["faults"]["sites"]["meta.store"]["injected"] >= 1
+        assert health["hook_errors"].get("meta", 0) >= 1
+        # counters also flow through /api/stats
+        stats = json.loads(router.handle(HttpRequest(
+            method="GET", path="/api/stats")).body)
+        names = {s["metric"] for s in stats}
+        assert "tsd.hooks.errors" in names
