@@ -430,6 +430,84 @@ def bench_live(repeats: int, n_series: int = 5_000,
             "criterion_pass": bool(speedup >= 10.0)}
 
 
+def bench_lifecycle(repeats: int, n_series: int = 2000,
+                    span_s: int = 7200) -> dict:
+    """Aged-store lifecycle config: n_series x span @1s raw, a
+    demote_after=30m policy folding everything older into the 1m
+    rollup tiers (sum/count/min/max) and compacting the tail. Reports
+    resident bytes before/after the sweep (criterion: >= 2x reduction)
+    and the p50 of a boundary-spanning 1m-avg query on the swept
+    store vs an identical all-raw baseline store (criterion: within
+    1.5x — the stitched tier+tail read must not tax the dashboard).
+    Sanity-checks the stitched result against the all-raw answer."""
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.query.model import TSQuery
+
+    def mk(lifecycle: bool):
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.storage.backend": "memory",
+               "tsd.rollups.enable": "true"}
+        if lifecycle:
+            cfg.update({"tsd.lifecycle.enable": "true",
+                        "tsd.lifecycle.demote_after": "30m",
+                        "tsd.lifecycle.demote_tiers": "1m"})
+        return TSDB(Config(**cfg))
+
+    t_raw, t_lc = mk(False), mk(True)
+    ts = np.arange(BASE_S, BASE_S + span_s, dtype=np.int64)
+    rng = np.random.default_rng(13)
+    t0 = time.perf_counter()
+    for i in range(n_series):
+        vals = rng.normal(100, 10, span_s)
+        for t in (t_raw, t_lc):
+            t.add_points("sys.aged", ts, vals, {"host": f"h{i:05d}"})
+    ingest_s = time.perf_counter() - t0
+    now_ms = BASE_MS + span_s * 1000
+    before = t_lc.storage_memory_info()["total"]["resident_bytes"]
+    t0 = time.perf_counter()
+    rep = t_lc.lifecycle.sweep(now_ms=now_ms)
+    sweep_s = time.perf_counter() - t0
+    after = t_lc.storage_memory_info()["total"]["resident_bytes"]
+    qobj = {"start": BASE_MS, "end": now_ms,
+            "queries": [{"metric": "sys.aged", "aggregator": "sum",
+                         "downsample": "1m-avg"}]}
+
+    def p50(tsdb):
+        tsdb.config.override_config("tsd.query.cache.enable", "false")
+        times = []
+        tsdb.execute_query(TSQuery.from_json(qobj).validate())  # warm
+        for _ in range(max(repeats, 3)):
+            t0 = time.perf_counter()
+            out = tsdb.execute_query(TSQuery.from_json(qobj).validate())
+            times.append(time.perf_counter() - t0)
+        return _percentile(times, 50) * 1e3, out
+
+    lc_p50, lc_out = p50(t_lc)
+    raw_p50, raw_out = p50(t_raw)
+    d_lc, d_raw = dict(lc_out[0].dps), dict(raw_out[0].dps)
+    assert d_lc.keys() == d_raw.keys(), "stitched dropped buckets"
+    worst = max(abs(d_lc[k] - d_raw[k]) / max(abs(d_raw[k]), 1e-12)
+                for k in d_raw)
+    bytes_ratio = before / max(after, 1)
+    p50_ratio = lc_p50 / max(raw_p50, 1e-3)
+    return {"config": "lifecycle", "series": n_series,
+            "points": n_series * span_s,
+            "ingest_mpps": round(n_series * span_s / ingest_s / 1e6,
+                                 1),
+            "sweep_s": round(sweep_s, 1),
+            "points_demoted": rep.get("demoted", 0),
+            "tier_points_written": rep.get("tierPointsWritten", 0),
+            "resident_bytes_before": before,
+            "resident_bytes_after": after,
+            "bytes_ratio": round(bytes_ratio, 1),
+            "boundary_p50_ms": round(lc_p50, 1),
+            "all_raw_p50_ms": round(raw_p50, 1),
+            "p50_ratio": round(p50_ratio, 2),
+            "stitch_worst_rel_err": float(f"{worst:.2e}"),
+            "criterion_pass": bool(bytes_ratio >= 2.0
+                                   and p50_ratio <= 1.5)}
+
+
 def bench_wal(repeats: int, n_series: int = 500,
               pts_per: int = 4000) -> dict:
     """Ingest throughput with the write-ahead log off / on. 'on'
@@ -491,7 +569,8 @@ def main() -> None:
     runners = {1: bench_config1, 2: bench_config2,
                3: lambda r: bench_config3(r, args.series3),
                4: bench_config4, 5: bench_config5,
-               "wal": bench_wal, "live": bench_live}
+               "wal": bench_wal, "live": bench_live,
+               "lifecycle": bench_lifecycle}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

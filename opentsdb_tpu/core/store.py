@@ -42,7 +42,8 @@ class SeriesBuffer:
     first time it is read after a write.
     """
 
-    __slots__ = ("ts", "vals", "is_int", "n", "_sorted", "lock")
+    __slots__ = ("ts", "vals", "is_int", "n", "_sorted", "lock",
+                 "_ts_base", "_ts_scale")
 
     def __init__(self) -> None:
         self.ts = np.empty(_INITIAL_CAPACITY, dtype=np.int64)
@@ -51,11 +52,19 @@ class SeriesBuffer:
         self.n = 0
         self._sorted = True
         self.lock = threading.Lock()
+        # packed-timestamp compaction state (see compact()): when
+        # _ts_scale > 0, ``ts`` holds int32 offsets and the true ms
+        # value is _ts_base + ts[i] * _ts_scale. Readers materialize
+        # int64 through _ts64_locked(); writers unpack first.
+        self._ts_base = 0
+        self._ts_scale = 0
 
     def append(self, ts_ms: int, value: float, is_int: bool) -> None:
         with self.lock:
+            self._unpack_locked()
             if self.n == len(self.ts):
-                new_cap = self.n * 2
+                # max() guards a compacted-empty buffer (capacity 0)
+                new_cap = max(self.n * 2, _INITIAL_CAPACITY)
                 self.ts = np.resize(self.ts, new_cap)
                 self.vals = np.resize(self.vals, new_cap)
                 self.is_int = np.resize(self.is_int, new_cap)
@@ -74,6 +83,7 @@ class SeriesBuffer:
         if k == 0:
             return
         with self.lock:
+            self._unpack_locked()
             need = self.n + k
             if need > len(self.ts):
                 new_cap = max(need, len(self.ts) * 2)
@@ -89,6 +99,25 @@ class SeriesBuffer:
                         k > 1 and bool(np.any(np.diff(ts_ms) <= 0)):
                     self._sorted = False
             self.n = need
+
+    def _unpack_locked(self) -> None:
+        """Restore the plain int64 timestamp column before a mutation
+        (packed buffers are immutable snapshots of compacted data)."""
+        if self._ts_scale:
+            self.ts = (self._ts_base
+                       + self.ts[:self.n].astype(np.int64)
+                       * self._ts_scale)
+            self._ts_base = 0
+            self._ts_scale = 0
+
+    def _ts64_locked(self) -> np.ndarray:
+        """The live timestamps as int64 ms (materialized when packed;
+        a view otherwise). Caller holds ``lock``."""
+        if self._ts_scale:
+            return (self._ts_base
+                    + self.ts[:self.n].astype(np.int64)
+                    * self._ts_scale)
+        return self.ts[:self.n]
 
     def _ensure_sorted_locked(self) -> None:
         if self._sorted:
@@ -118,12 +147,13 @@ class SeriesBuffer:
         """Sorted, deduped (ts, vals) views. Do not mutate."""
         with self.lock:
             self._ensure_sorted_locked()
-            return self.ts[:self.n], self.vals[:self.n]
+            return self._ts64_locked(), self.vals[:self.n]
 
     def view_full(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         with self.lock:
             self._ensure_sorted_locked()
-            return self.ts[:self.n], self.vals[:self.n], self.is_int[:self.n]
+            return (self._ts64_locked(), self.vals[:self.n],
+                    self.is_int[:self.n])
 
     def slice_range(self, start_ms: int, end_ms: int) -> tuple[np.ndarray,
                                                                np.ndarray]:
@@ -140,17 +170,83 @@ class SeriesBuffer:
         row)."""
         with self.lock:
             self._ensure_sorted_locked()
-            ts = self.ts[:self.n]
+            ts = self._ts64_locked()
             lo = int(np.searchsorted(ts, start_ms, side="left"))
             hi = int(np.searchsorted(ts, end_ms, side="right"))
             k = hi - lo
             if k <= 0:
                 return 0
+            self._unpack_locked()
             self.ts[lo:self.n - k] = self.ts[hi:self.n]
             self.vals[lo:self.n - k] = self.vals[hi:self.n]
             self.is_int[lo:self.n - k] = self.is_int[hi:self.n]
             self.n -= k
             return k
+
+    def compact(self, pack_ts: bool = True,
+                pack_before_ms: int | None = None) -> int:
+        """Lifecycle compaction: sort/dedupe, shrink the columns to
+        exactly ``n`` elements (growth doubling can strand ~2x dead
+        capacity), and — when ``pack_ts`` and lossless — pack the
+        timestamp column to int32 offsets from the first timestamp
+        (scale 1000 when every ts is second-aligned, else 1), halving
+        its resident bytes. Packing is transparent: readers
+        materialize int64 on access, the first write unpacks.
+
+        ``pack_before_ms`` restricts packing to COLD buffers (newest
+        point older than the horizon): packing a buffer that is still
+        being written just buys a full unpack copy on its next append.
+        A buffer that is already exact-capacity and either packed or
+        ineligible for packing returns 0 without copying anything —
+        repeat sweeps over compacted data are free. Returns bytes
+        reclaimed."""
+        with self.lock:
+            before = (self.ts.nbytes + self.vals.nbytes
+                      + self.is_int.nbytes)
+            self._ensure_sorted_locked()
+            n = self.n
+            want_pack = (pack_ts and n > 0 and self._ts_scale == 0)
+            if want_pack and pack_before_ms is not None:
+                # self.ts is plain int64 here (_ts_scale == 0)
+                want_pack = int(self.ts[n - 1]) < pack_before_ms
+            if want_pack and (int(self.ts[n - 1]) - int(self.ts[0])
+                              > np.iinfo(np.int32).max * 1000):
+                want_pack = False  # unpackable at any scale
+            if not want_pack and len(self.vals) == n:
+                return 0  # already compact: no copies
+            self.vals = self.vals[:n].copy()
+            self.is_int = self.is_int[:n].copy()
+            ts = self._ts64_locked()
+            packed = self._ts_scale > 0
+            if want_pack:
+                base = int(ts[0])
+                scale = 1000 if (base % 1000 == 0
+                                 and not (ts % 1000).any()) else 1
+                span = (int(ts[-1]) - base) // scale
+                if span <= np.iinfo(np.int32).max:
+                    self.ts = ((ts - base) // scale).astype(np.int32)
+                    self._ts_base = base
+                    self._ts_scale = scale
+                    packed = True
+            if not packed:
+                self.ts = ts[:n].copy()
+                self._ts_base = 0
+                self._ts_scale = 0
+            after = (self.ts.nbytes + self.vals.nbytes
+                     + self.is_int.nbytes)
+            return max(before - after, 0)
+
+    @property
+    def resident_bytes(self) -> int:
+        """Allocated column bytes (capacity-based, all three columns)."""
+        return self.ts.nbytes + self.vals.nbytes + self.is_int.nbytes
+
+    @property
+    def live_bytes(self) -> int:
+        """Bytes the ``n`` live points occupy in the CURRENT
+        representation (packed timestamps count their packed width)."""
+        return self.n * (self.ts.itemsize + self.vals.itemsize
+                         + self.is_int.itemsize)
 
     def __len__(self) -> int:
         return self.n
@@ -310,6 +406,10 @@ class TimeSeriesStore:
         # bumped on destructive ops (delete_range); together with
         # points_written it versions the store for read-side caches
         self.mutation_epoch = 0
+        # bumped by compact_series (resident bytes changed without a
+        # data change — versions the memory_info cache only)
+        self.compactions = 0
+        self._memory_info_cache: tuple | None = None
 
     # -- write path -------------------------------------------------------
 
@@ -437,6 +537,7 @@ class TimeSeriesStore:
         buf = self._series[series_id].buffer
         with buf.lock:
             buf._ensure_sorted_locked()
+            buf._unpack_locked()
             m = buf.n
             keep = (buf.ts[:m] >= min_ts) & (buf.ts[:m] <= max_ts)
             if drop_nonfinite:
@@ -459,6 +560,7 @@ class TimeSeriesStore:
         buf = self._series[series_id].buffer
         with buf.lock:
             buf._ensure_sorted_locked()
+            buf._unpack_locked()
             i = int(np.searchsorted(buf.ts[:buf.n], ts_ms))
             if i >= buf.n or buf.ts[i] != ts_ms:
                 raise KeyError(f"series {series_id} has no point at "
@@ -609,7 +711,62 @@ class TimeSeriesStore:
     def total_points(self) -> int:
         return sum(len(rec.buffer) for rec in self._series)
 
+    # -- lifecycle surface -------------------------------------------------
+
+    def compact_series(self, series_ids: Sequence[int] | None = None,
+                       pack_ts: bool = True,
+                       pack_before_ms: int | None = None
+                       ) -> tuple[int, int]:
+        """Compact the given series' buffers (all series when None):
+        sort/dedupe/shrink-to-fit + lossless timestamp packing (see
+        :meth:`SeriesBuffer.compact`; ``pack_before_ms`` limits
+        packing to cold buffers). Returns (bytes reclaimed, series
+        released) where released = buffers that compacted down to
+        zero live points (ghost series keep their sid — numbering is
+        positional — but their columns are freed)."""
+        if series_ids is None:
+            series_ids = range(len(self._series))
+        reclaimed = 0
+        released = 0
+        for sid in series_ids:
+            buf = self._series[int(sid)].buffer
+            got = buf.compact(pack_ts=pack_ts,
+                              pack_before_ms=pack_before_ms)
+            reclaimed += got
+            if got and buf.n == 0:
+                released += 1
+        if reclaimed:
+            self.compactions += 1
+        return reclaimed, released
+
+    def memory_info(self) -> dict:
+        """Resident/live/dead column bytes + series/point counts for
+        the /api/health and /api/stats memory-footprint report. Cached
+        on the store's write/delete/compaction counters so health
+        polls do not re-walk a million buffers."""
+        key = (self.points_written, self.mutation_epoch,
+               len(self._series), self.compactions)
+        cached = self._memory_info_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        resident = live = points = 0
+        for rec in self._series:
+            buf = rec.buffer
+            resident += buf.resident_bytes
+            live += buf.live_bytes
+            points += buf.n
+        info = {"series": len(self._series), "points": points,
+                "resident_bytes": resident, "live_bytes": live,
+                "dead_bytes": max(resident - live, 0)}
+        self._memory_info_cache = (key, info)
+        return info
+
     def collect_stats(self, collector) -> None:
         collector.record("storage.series.count", self.num_series())
         collector.record("storage.points.written", self.points_written)
         collector.record("storage.shards", self.num_shards)
+        mi = self.memory_info()
+        collector.record("storage.resident_bytes",
+                         mi["resident_bytes"])
+        collector.record("storage.live_bytes", mi["live_bytes"])
+        collector.record("storage.dead_bytes", mi["dead_bytes"])

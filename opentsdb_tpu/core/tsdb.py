@@ -171,6 +171,10 @@ class TSDB:
         # created on first registration; the write path checks the raw
         # attribute so an idle TSD pays nothing
         self._streaming = None
+        # data-lifecycle subsystem (opentsdb_tpu/lifecycle/): lazy —
+        # the serve path reads the raw attribute, the `lifecycle`
+        # property instantiates only when tsd.lifecycle.enable is set
+        self._lifecycle = None
         # per-hook swallowed-error counters: post-write hooks (meta,
         # realtime publisher, external meta cache, stream tap) can
         # never fail an ACKNOWLEDGED write — see _run_hook
@@ -900,6 +904,25 @@ class TSDB:
         return self._streaming
 
     @property
+    def lifecycle(self):
+        """Data-lifecycle manager
+        (:mod:`opentsdb_tpu.lifecycle.manager`), or None when disabled
+        (``tsd.lifecycle.enable = false``, the default). The query
+        engine consults it per sub-query for demotion-boundary
+        stitching; the server starts its sweeper thread."""
+        if not self.config.get_bool("tsd.lifecycle.enable", False):
+            return None
+        if self._lifecycle is None:
+            with self._device_cache_lock:
+                if self._lifecycle is None:
+                    from opentsdb_tpu.lifecycle.manager import \
+                        LifecycleManager
+                    lc = LifecycleManager(self)
+                    self.stats.register(lc)
+                    self._lifecycle = lc
+        return self._lifecycle
+
+    @property
     def query_fanout_pool(self):
         """Executor independent sub-queries of one TSQuery fan out
         onto (None = serial; ``tsd.query.fanout.workers``). See the
@@ -914,6 +937,36 @@ class TSDB:
                             max_workers=self._fanout_workers,
                             thread_name_prefix="tsd-subq")
         return self._fanout_pool
+
+    def storage_memory_info(self) -> dict:
+        """Per-store memory footprint (resident/live/dead bytes,
+        series and point counts) for /api/health and /api/stats —
+        makes lifecycle reclamation observable before/after sweeps.
+        Per-store entries are cached inside each store; totals sum
+        whatever stores exist."""
+        out: dict = {}
+        if hasattr(self.store, "memory_info"):
+            out["raw"] = self.store.memory_info()
+        if hasattr(self.histogram_store, "memory_info"):
+            out["histogram"] = self.histogram_store.memory_info()
+        if self.rollup_store is not None:
+            rs = self.rollup_store
+            preagg = rs.preagg_store()
+            if hasattr(preagg, "memory_info"):
+                out["rollup:preagg"] = preagg.memory_info()
+            with rs._tiers_lock:
+                tiers = list(rs._tiers.items())
+            for (interval, agg), store in sorted(tiers):
+                if hasattr(store, "memory_info"):
+                    out[f"rollup:{interval}:{agg}"] = \
+                        store.memory_info()
+        totals = {"resident_bytes": 0, "live_bytes": 0,
+                  "dead_bytes": 0, "series": 0, "points": 0}
+        for info in out.values():
+            for k in totals:
+                totals[k] += info.get(k, 0)
+        out["total"] = totals
+        return out
 
     def serve_version(self) -> tuple:
         """Version tuple over every store the query surface can read
@@ -988,6 +1041,8 @@ class TSDB:
                 self.wal.truncate(wal_seq)
 
     def shutdown(self) -> None:
+        if self._lifecycle is not None:
+            self._lifecycle.stop()
         self.flush()
         if self._streaming is not None:
             self._streaming.shutdown()

@@ -1,0 +1,18 @@
+"""Data-lifecycle subsystem: retention, age-based rollup demotion and
+store compaction (no reference equivalent — the reference delegates all
+of this to HBase TTLs and region compaction, SURVEY.md §5.4).
+
+- :mod:`opentsdb_tpu.lifecycle.policy` — per-metric policies
+  (``tsd.lifecycle.*`` keys + the ``/api/lifecycle`` admin surface)
+- :mod:`opentsdb_tpu.lifecycle.manager` — the background sweeper:
+  retention purge, age-based demotion into rollup tiers, buffer
+  compaction, post-sweep snapshot + WAL truncation
+- :mod:`opentsdb_tpu.lifecycle.stitch` — the read-side stitched store
+  that serves tier history before the demotion boundary and the raw
+  tail after it through one `TimeSeriesStore`-shaped view
+"""
+
+from opentsdb_tpu.lifecycle.policy import LifecyclePolicy, PolicySet
+from opentsdb_tpu.lifecycle.manager import LifecycleManager
+
+__all__ = ["LifecyclePolicy", "PolicySet", "LifecycleManager"]

@@ -1,0 +1,595 @@
+"""The lifecycle manager: background sweeper + compactor.
+
+One sweep, per metric with a policy (:mod:`.policy`):
+
+1. **retention** — points older than the TTL are purged from the raw
+   store AND every rollup tier/preagg store (the reference delegates
+   this to HBase table TTLs, SURVEY.md §5.4).
+2. **age-based demotion** — raw points older than the demotion
+   boundary are folded into the configured rollup tiers by the
+   existing tiled rollup job (:func:`opentsdb_tpu.rollup.job.
+   run_rollup_job`, restricted to the metric's series), then dropped
+   from raw. The boundary aligns down to the coarsest demoted tier's
+   interval so every demoted tier cell is complete; the query engine
+   stitches tier history + raw tail transparently
+   (:mod:`.stitch`). Boundary publication is ordered so no
+   intermediate state double-counts: tiers are written first, the
+   boundary moves second (stitched reads clip raw to the tail while
+   the stale raw points still exist), the raw purge runs last.
+3. **compaction** — swept series buffers are sorted/deduped/
+   shrunk-to-fit with timestamps packed to int32 offsets where
+   lossless (:meth:`opentsdb_tpu.core.store.SeriesBuffer.compact`),
+   and fully-expired (ghost) series release their buffers.
+
+Every sweep that removed or demoted data bumps the raw store's
+``mutation_epoch`` (the PR-2 result cache and PR-3 streaming plans
+rebuild instead of serving purged points) and — when a data dir is
+configured — flushes a snapshot + truncates the WAL so replay can
+never resurrect expired points (the WAL has no delete record type;
+the snapshot IS the delete's durability).
+
+Degradation follows the PR-1 idiom: the sweep runs under the
+``lifecycle.sweep`` fault site (demotion additionally under
+``lifecycle.demote``) and its own circuit breaker
+(``tsd.lifecycle.breaker.*``); a failing sweep is counted, logged and
+retried next interval — it can NEVER fail or block ingest/queries
+(they only share per-buffer locks). Counters export via /api/stats
+and /api/health; the ``POST /api/lifecycle/sweep`` admin endpoint
+runs one sweep synchronously.
+
+Demotion boundaries persist to ``<data_dir>/lifecycle.json`` so a
+restarted TSD keeps stitching tier history + raw tail (without it, a
+tier-eligible query after restart would serve tier-only and silently
+drop the raw tail).
+
+Known limitation (documented): a write BACKFILLED behind the demotion
+boundary is never re-demoted (re-running the rollup job over a purged
+range would *replace* complete tier cells with cells computed from
+the backfill alone) and stitched reads do not see it; demotion sweeps
+leave it alone (the raw purge starts at the fold window, never
+before the previous boundary), so it stays visible to
+``rollupUsage=ROLLUP_RAW`` queries until retention purges it. The
+reference has the same shape: external rollup jobs do not re-run on
+backfills either.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from opentsdb_tpu.lifecycle.policy import LifecyclePolicy, PolicySet
+from opentsdb_tpu.lifecycle.stitch import StitchedStore
+from opentsdb_tpu.utils.faults import CircuitBreaker
+
+LOG = logging.getLogger("lifecycle")
+
+# the four per-statistic tier stores one demoted tier interval spans
+# (rollup/job.py ROLLUP_AGGS — avg derives as sum/count at query time)
+_TIER_AGGS = ("sum", "count", "min", "max")
+
+
+class LifecycleManager:
+    """(see module docstring)"""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        cfg = tsdb.config
+        self.policies = PolicySet.from_config(cfg)
+        self.interval_s = cfg.get_float("tsd.lifecycle.interval_s", 0.0)
+        self.compact_enabled = cfg.get_bool("tsd.lifecycle.compact",
+                                            True)
+        self.pack_timestamps = cfg.get_bool(
+            "tsd.lifecycle.pack_timestamps", True)
+        self.flush_after_sweep = cfg.get_bool(
+            "tsd.lifecycle.flush_after_sweep", True)
+        threshold = cfg.get_int(
+            "tsd.lifecycle.breaker.failure_threshold", 3)
+        self.breaker = CircuitBreaker(
+            "lifecycle.sweep", failure_threshold=threshold,
+            reset_timeout_ms=cfg.get_float(
+                "tsd.lifecycle.breaker.reset_timeout_ms", 60000.0)) \
+            if threshold > 0 else None
+        if self.breaker is not None:
+            tsdb.stats.register(self.breaker)
+        # one sweep at a time (admin POST vs the interval thread)
+        self._sweep_lock = threading.Lock()
+        self._lock = threading.Lock()
+        # metric_id -> demotion boundary (ms, exclusive): raw points
+        # BEFORE it have been folded into tiers and purged from raw
+        self._boundaries: dict[int, int] = {}
+        # (metric_id, interval, agg) -> StitchedStore for the current
+        # boundary; rebuilt when the boundary moves so cache keys
+        # derived from instance_id can never alias across boundaries
+        self._stitched: dict[tuple, StitchedStore] = {}
+        # metrics whose FIRST demotion is in flight: the rollup job
+        # has started writing tier cells (has_data flips true) but no
+        # boundary exists yet, so tier selection would serve
+        # tier-only results missing the raw tail — the engine pins
+        # these metrics to raw until the boundary publishes. Stays
+        # set across a failed first demotion (partial tier data with
+        # no boundary must not be selected).
+        self._first_demotions: set[int] = set()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # counters
+        self.sweeps = 0
+        self.sweep_errors = 0
+        self.points_purged = 0
+        self.points_demoted = 0
+        self.tier_points_written = 0
+        self.bytes_reclaimed = 0
+        self.series_released = 0
+        self.last_sweep_duration_ms = 0.0
+        self.last_sweep_time = 0.0
+        self.last_error = ""
+        self._boundary_path = ""
+        data_dir = getattr(tsdb, "data_dir", "")
+        if data_dir:
+            import os
+            self._boundary_path = os.path.join(data_dir,
+                                               "lifecycle.json")
+            self._load_boundaries()
+
+    # ------------------------------------------------------------------
+    # scheduler surface (started by TSDServer, stopped on shutdown)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+        t = threading.Thread(target=self._loop, name="tsd-lifecycle",
+                             daemon=True)
+        self._thread = t
+        t.start()
+        LOG.info("lifecycle sweeper running every %.0fs",
+                 self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sweep()  # never raises
+
+    # ------------------------------------------------------------------
+    # read-side surface (query engine / streaming registry)
+    # ------------------------------------------------------------------
+
+    def demote_boundary(self, metric_id: int) -> int:
+        """The metric's demotion boundary (ms, exclusive), 0 = none."""
+        with self._lock:
+            return self._boundaries.get(metric_id, 0)
+
+    def demote_boundary_for(self, metric: str) -> int:
+        try:
+            mid = self.tsdb.uids.metrics.get_id(metric)
+        except LookupError:
+            return 0
+        return self.demote_boundary(mid)
+
+    def first_demotion_in_flight(self, metric_id: int) -> bool:
+        """True while this metric's tiers hold (possibly partial)
+        demoted cells but no boundary exists yet — tier selection
+        must stay on raw (which still has every point)."""
+        with self._lock:
+            return metric_id in self._first_demotions
+
+    def stitched(self, metric_id: int, interval: str, agg: str,
+                 tier_store) -> StitchedStore | None:
+        """The cached stitched view for one (metric, tier, agg), or
+        None when the metric has no demotion boundary (plain tier
+        serving stays untouched)."""
+        with self._lock:
+            boundary = self._boundaries.get(metric_id, 0)
+            if not boundary:
+                return None
+            key = (metric_id, interval, agg)
+            st = self._stitched.get(key)
+            if st is None or st.boundary_ms != boundary \
+                    or st.tier is not tier_store:
+                st = StitchedStore(self.tsdb.store, tier_store,
+                                   metric_id, boundary, agg)
+                self._stitched[key] = st
+            return st
+
+    # ------------------------------------------------------------------
+    # the sweep
+    # ------------------------------------------------------------------
+
+    def sweep(self, now_ms: int | None = None) -> dict[str, Any]:
+        """Run one full sweep; returns a report. Never raises — a
+        failure is counted, trips the breaker, and the serve path is
+        untouched (this is maintenance, not the request path)."""
+        if not self._sweep_lock.acquire(blocking=False):
+            return {"skipped": "sweep already running"}
+        t0 = time.monotonic()
+        report: dict[str, Any] = {
+            "purged": 0, "demoted": 0, "tierPointsWritten": 0,
+            "bytesReclaimed": 0, "seriesReleased": 0, "metrics": 0,
+        }
+        try:
+            if self.breaker is not None and not self.breaker.allow():
+                report["skipped"] = "breaker open"
+                return report
+            try:
+                self._sweep_inner(
+                    int(now_ms if now_ms is not None
+                        else time.time() * 1000), report)
+            except Exception as exc:  # noqa: BLE001 - degrade loudly
+                self.sweep_errors += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                LOG.warning("lifecycle sweep failed (%s); ingest and "
+                            "queries are unaffected", self.last_error)
+                report["error"] = self.last_error
+                return report
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return report
+        finally:
+            self.sweeps += 1
+            self.last_sweep_time = time.time()
+            self.last_sweep_duration_ms = \
+                (time.monotonic() - t0) * 1e3
+            report["durationMs"] = round(self.last_sweep_duration_ms,
+                                         1)
+            self._sweep_lock.release()
+
+    def _sweep_inner(self, now_ms: int, report: dict) -> None:
+        t = self.tsdb
+        faults = getattr(t, "faults", None)
+        if faults is not None:
+            faults.check("lifecycle.sweep")
+        store = t.store
+        changed = False
+        uids = t.uids
+        # the work list covers every metric ANY store knows: a metric
+        # written only through the rollup API (external jobs, no raw
+        # series) still needs its tier retention applied
+        mids = set(store.metric_ids())
+        if t.rollup_store is not None:
+            rs = t.rollup_store
+            with rs._tiers_lock:
+                tier_stores = list(rs._tiers.values())
+            tier_stores.append(rs.preagg_store())
+            for ts_store in tier_stores:
+                mids.update(ts_store.metric_ids())
+        name_of = {}
+        for mid in mids:
+            try:
+                name_of[mid] = uids.metrics.get_name(mid)
+            except LookupError:
+                continue  # orphan metric id: fsck's problem
+        work = self.policies.metrics_with_policies(name_of.values())
+        by_name = {v: k for k, v in name_of.items()}
+        for metric, pol in work:
+            mid = by_name[metric]
+            sids = np.asarray(store.series_ids_for_metric(mid),
+                              dtype=np.int64)
+            report["metrics"] += 1
+            if pol.retention_ms:
+                changed |= self._retention(mid, sids, pol, now_ms,
+                                           report)
+            if pol.demote_after_ms and t.rollup_store is not None:
+                changed |= self._demote(mid, metric, sids, pol,
+                                        now_ms, report)
+            # pack only COLD buffers (newest point behind the
+            # metric's lifecycle horizon): packing a live tail just
+            # buys an unpack copy on the next append
+            horizon = now_ms - (pol.demote_after_ms
+                                or pol.retention_ms)
+            changed |= self._release_and_compact(sids, horizon,
+                                                 report)
+        if changed:
+            # belt over the per-op epoch bumps: one extra bump per
+            # sweep guarantees every read-side cache (result cache,
+            # grid/prep pools, streaming plans) rebuilds rather than
+            # serving purged points
+            store.mutation_epoch += 1
+            if self.flush_after_sweep and getattr(t, "data_dir", ""):
+                # the WAL has no delete records: the snapshot (+ WAL
+                # truncation inside flush) is what makes the purge
+                # durable — without it, replay-on-restart would
+                # resurrect expired points
+                t.flush()
+
+    def _retention(self, mid: int, sids: np.ndarray,
+                   pol: LifecyclePolicy, now_ms: int,
+                   report: dict) -> bool:
+        cutoff = now_ms - pol.retention_ms
+        if cutoff <= 0:
+            return False
+        store = self.tsdb.store
+        purged = store.delete_range(sids, 1, cutoff - 1)
+        rs = self.tsdb.rollup_store
+        if rs is not None:
+            config = self.tsdb.rollup_config
+            tiers: list[tuple] = [(rs.preagg_store(), 0)]
+            with rs._tiers_lock:
+                items = list(rs._tiers.items())
+            for (interval, _agg), ts_store in items:
+                try:
+                    iv_ms = config.get_interval(interval).interval_ms
+                except ValueError:
+                    iv_ms = 0
+                tiers.append((ts_store, iv_ms))
+            for ts_store, iv_ms in tiers:
+                tsids = ts_store.series_ids_for_metric(mid)
+                if len(tsids) == 0:
+                    continue
+                # a tier cell stamped T aggregates [T, T+iv): purge
+                # only cells whose WHOLE window expired (T+iv <=
+                # cutoff), or unexpired aggregated history would be
+                # lost with its cell
+                end = cutoff - 1 - iv_ms
+                if end >= 1:
+                    purged += ts_store.delete_range(tsids, 1, end)
+        if purged:
+            self.points_purged += purged
+            report["purged"] += purged
+        return purged > 0
+
+    def _demote(self, mid: int, metric: str, sids: np.ndarray,
+                pol: LifecyclePolicy, now_ms: int,
+                report: dict) -> bool:
+        t = self.tsdb
+        config = t.rollup_config
+        tiers = [config.get_interval(iv) for iv in pol.demote_tiers] \
+            if pol.demote_tiers else list(config.intervals)
+        if not tiers:
+            return False
+        coarse_ms = max(iv.interval_ms for iv in tiers)
+        target = now_ms - pol.demote_after_ms
+        boundary = target - target % coarse_ms
+        prev = self.demote_boundary(mid)
+        if boundary <= prev:
+            return False
+        counts = t.store.count_range(sids, 1, boundary - 1)
+        old_sids = sids[counts > 0]
+        total_old = int(counts.sum())
+        if total_old == 0:
+            # nothing raw to fold: leave the boundary where it is —
+            # publishing a boundary no demotion backs would flip
+            # externally-rolled-up metrics from plain tier serving to
+            # a stitched view whose tier half is clipped for no reason
+            return False
+        faults = getattr(t, "faults", None)
+        if faults is not None:
+            faults.check("lifecycle.demote")
+        start_ms = self._oldest_ts(t.store, old_sids, max(prev, 1))
+        if prev == 0:
+            # first demotion: tier cells are about to appear with no
+            # boundary to stitch against — pin tier selection to raw
+            # until the boundary publishes (cleared only on success;
+            # a failed first demotion leaves partial tier data that
+            # must keep losing tier selection)
+            with self._lock:
+                self._first_demotions.add(mid)
+        from opentsdb_tpu.rollup.job import run_rollup_job
+        written = run_rollup_job(
+            t, start_ms, boundary - 1,
+            intervals=[iv.interval for iv in tiers],
+            series_ids=old_sids)
+        wrote = sum(written.values())
+        self.tier_points_written += wrote
+        report["tierPointsWritten"] += wrote
+        # tiers hold the history now: move the boundary BEFORE the raw
+        # purge so stitched reads clip raw to the tail (no double
+        # count while the stale raw points still exist), THEN purge.
+        # The purge starts at the FOLD window, never before the
+        # previous boundary: points backfilled behind it were not
+        # re-folded, so deleting them would lose data the tiers never
+        # received (they age out via retention instead).
+        self._publish_boundary(mid, boundary)
+        with self._lock:
+            self._first_demotions.discard(mid)
+        dropped = t.store.delete_range(old_sids, start_ms,
+                                       boundary - 1)
+        self.points_demoted += dropped
+        report["demoted"] += dropped
+        LOG.info("demoted %d raw points of %s into %s (boundary %d)",
+                 dropped, metric,
+                 "/".join(iv.interval for iv in tiers), boundary)
+        return True
+
+    def _publish_boundary(self, mid: int, boundary: int) -> None:
+        with self._lock:
+            self._boundaries[mid] = boundary
+            # stale stitched views die here; the next query mints
+            # fresh instances (new instance_id => new cache keys)
+            for key in [k for k in self._stitched if k[0] == mid]:
+                del self._stitched[key]
+        self._save_boundaries()
+
+    def _save_boundaries(self) -> None:
+        """Persist metric-name -> boundary so restarts keep stitching
+        (names, not ids: they are stable across UID reloads).
+        Best-effort — a failed save means one sweep's boundary move is
+        re-derived by the next sweep, never a serve-path error."""
+        if not self._boundary_path:
+            return
+        import json
+        with self._lock:
+            boundaries = dict(self._boundaries)
+        doc: dict[str, int] = {}
+        for mid, b in boundaries.items():
+            try:
+                doc[self.tsdb.uids.metrics.get_name(mid)] = b
+            except LookupError:
+                continue
+        try:
+            from opentsdb_tpu.core.persist import _atomic_write
+            _atomic_write(self._boundary_path,
+                          json.dumps({"boundaries": doc}).encode())
+        except OSError as exc:  # pragma: no cover - disk trouble
+            LOG.warning("could not persist lifecycle boundaries: %s",
+                        exc)
+
+    def _load_boundaries(self) -> None:
+        import json
+        import os
+        if not os.path.isfile(self._boundary_path):
+            return
+        try:
+            with open(self._boundary_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            LOG.warning("could not load lifecycle boundaries: %s", exc)
+            return
+        for name, b in (doc.get("boundaries") or {}).items():
+            try:
+                mid = self.tsdb.uids.metrics.get_id(name)
+            except LookupError:
+                continue
+            self._boundaries[mid] = int(b)
+
+    @staticmethod
+    def _oldest_ts(store, sids: np.ndarray, floor_ms: int) -> int:
+        """Oldest live timestamp across ``sids`` (but never below
+        ``floor_ms``) — bounds the rollup job's window so its bucket
+        grid doesn't span from epoch zero. Buffer-view walk on the
+        memory backend; the native arena materializes per series
+        (sweeps are background work)."""
+        oldest = None
+        for sid in sids.tolist():
+            ts, _ = store.series(int(sid)).buffer.view()
+            if len(ts):
+                first = int(ts[0])
+                if oldest is None or first < oldest:
+                    oldest = first
+        if oldest is None:
+            return floor_ms
+        return max(oldest, floor_ms)
+
+    def _release_and_compact(self, sids: np.ndarray, horizon_ms: int,
+                             report: dict) -> bool:
+        store = self.tsdb.store
+        if not self.compact_enabled or \
+                not hasattr(store, "compact_series"):
+            return False
+        reclaimed, released = store.compact_series(
+            sids, pack_ts=self.pack_timestamps,
+            pack_before_ms=horizon_ms)
+        if reclaimed:
+            self.bytes_reclaimed += reclaimed
+            report["bytesReclaimed"] += reclaimed
+        if released:
+            self.series_released += released
+            report["seriesReleased"] += released
+        return False  # compaction changes no visible data
+
+    # ------------------------------------------------------------------
+    # fsck surface
+    # ------------------------------------------------------------------
+
+    def scan_expired(self, now_ms: int | None = None
+                     ) -> dict[str, int]:
+        """Expired-but-present raw point counts per metric (read-only;
+        fsck reports these and ``--fix`` purges them through
+        :meth:`sweep` so epochs/WAL stay consistent)."""
+        now_ms = int(now_ms if now_ms is not None
+                     else time.time() * 1000)
+        t = self.tsdb
+        out: dict[str, int] = {}
+        store = t.store
+        for mid in store.metric_ids():
+            try:
+                metric = t.uids.metrics.get_name(mid)
+            except LookupError:
+                continue
+            pol = self.policies.for_metric(metric)
+            if pol is None or not pol.retention_ms:
+                continue
+            cutoff = now_ms - pol.retention_ms
+            if cutoff <= 0:
+                continue
+            sids = store.series_ids_for_metric(mid)
+            if len(sids) == 0:
+                continue
+            n = int(store.count_range(sids, 1, cutoff - 1).sum())
+            if n:
+                out[metric] = n
+        return out
+
+    # ------------------------------------------------------------------
+    # admin / observability
+    # ------------------------------------------------------------------
+
+    def update_policies(self, obj: dict) -> None:
+        """``POST /api/lifecycle`` body: wholesale policy replacement
+        (``{"policies": [...]}``; validation failures leave the table
+        untouched)."""
+        from opentsdb_tpu.query.model import BadRequestError
+        if not isinstance(obj, dict):
+            raise BadRequestError("body must be an object")
+        raw = obj.get("policies")
+        if not isinstance(raw, list):
+            raise BadRequestError("body needs a 'policies' array")
+        self.policies.replace(
+            [LifecyclePolicy.from_json(p) for p in raw])
+
+    def describe(self) -> dict[str, Any]:
+        with self._lock:
+            boundaries = dict(self._boundaries)
+        names = {}
+        for mid, b in boundaries.items():
+            try:
+                names[self.tsdb.uids.metrics.get_name(mid)] = b
+            except LookupError:
+                names[f"#{mid}"] = b
+        doc = {
+            "enabled": True,
+            "intervalS": self.interval_s,
+            "policies": self.policies.to_json(),
+            "demoteBoundaries": names,
+            "counters": self._counters(),
+        }
+        if self.breaker is not None:
+            doc["breaker"] = self.breaker.health_info()
+        return doc
+
+    def _counters(self) -> dict[str, Any]:
+        return {
+            "sweeps": self.sweeps,
+            "sweepErrors": self.sweep_errors,
+            "pointsPurged": self.points_purged,
+            "pointsDemoted": self.points_demoted,
+            "tierPointsWritten": self.tier_points_written,
+            "bytesReclaimed": self.bytes_reclaimed,
+            "seriesReleased": self.series_released,
+            "lastSweepDurationMs": round(self.last_sweep_duration_ms,
+                                         1),
+            "lastSweepTime": int(self.last_sweep_time),
+            "lastError": self.last_error,
+        }
+
+    def health_info(self) -> dict[str, Any]:
+        doc = {"enabled": True, **self._counters()}
+        if self.breaker is not None:
+            doc["breaker"] = self.breaker.health_info()
+        return doc
+
+    def collect_stats(self, collector) -> None:
+        collector.record("lifecycle.sweeps", self.sweeps)
+        collector.record("lifecycle.sweep_errors", self.sweep_errors)
+        collector.record("lifecycle.points.purged", self.points_purged)
+        collector.record("lifecycle.points.demoted",
+                         self.points_demoted)
+        collector.record("lifecycle.tier_points.written",
+                         self.tier_points_written)
+        collector.record("lifecycle.bytes.reclaimed",
+                         self.bytes_reclaimed)
+        collector.record("lifecycle.series.released",
+                         self.series_released)
+        collector.record("lifecycle.sweep.duration_ms",
+                         self.last_sweep_duration_ms)

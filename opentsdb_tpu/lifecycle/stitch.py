@@ -1,0 +1,270 @@
+"""Stitched read view: rollup-tier history + raw tail across the
+demotion boundary.
+
+After age-based demotion, raw points older than a metric's demotion
+boundary exist only in the rollup tiers; the raw store keeps the tail.
+A query spanning the boundary must read BOTH — this module exposes one
+``TimeSeriesStore``-shaped object the query engine can select exactly
+like a plain tier store:
+
+- series identity (sids, metric index, tag matrices, shards) is the
+  RAW store's: every live series has a raw record even when all its
+  points were demoted, so filters/group-by/result assembly are
+  unchanged;
+- reads split at ``boundary_ms``: the tier serves ``[start,
+  boundary)`` (raw sids mapped to tier sids by (metric, tags)
+  identity) and the raw store serves ``[boundary, end]``;
+- ``bucket_reduce`` combines the two halves channel-wise so the
+  engine's grid path (and the avg sum/count division) is
+  value-identical to an undemoted store for decomposable
+  downsample functions — each query bucket receives tier cells whose
+  source points it fully contains plus raw tail points, and sums of
+  sums / mins of mins / counts of counts are exact (the same
+  decomposition ``rollup/job.py`` writes). Queries whose start is not
+  tier-aligned inherit the pre-existing rollup divergence (a tier
+  cell is attributed to the bucket holding its edge).
+
+``tail_stat`` names the statistic the tier's point VALUES carry, so
+the raw tail contributes the matching channel: a ``count`` tier's
+stitched view materializes tail points with value 1.0 (summing them
+counts them) and adds raw bucket counts into the sums channel of
+``bucket_reduce``.
+
+Versioning: ``points_written`` / ``mutation_epoch`` are the sums of
+both halves, so every read-side cache (result cache, device grid
+cache, prepared-batch pools) invalidates on a write or sweep to either
+store. Instances are cached per (metric, tier, boundary) by the
+lifecycle manager — a moved boundary mints a fresh ``instance_id``,
+orphaning stale cache entries instead of aliasing them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from opentsdb_tpu.core.store import (PaddedBatch, PointBatch,
+                                     STORE_INSTANCE_IDS)
+
+_TAIL_STATS = ("sum", "count", "min", "max")
+
+
+class StitchedStore:
+    """(see module docstring)"""
+
+    fault_site = "store"
+
+    def __init__(self, raw_store, tier_store, metric_id: int,
+                 boundary_ms: int, tail_stat: str):
+        if tail_stat not in _TAIL_STATS:
+            raise ValueError(f"bad tail_stat {tail_stat!r}")
+        self.instance_id = next(STORE_INSTANCE_IDS)
+        self.raw = raw_store
+        self.tier = tier_store
+        self.metric_id = metric_id
+        self.boundary_ms = int(boundary_ms)
+        self.tail_stat = tail_stat
+        self.num_shards = raw_store.num_shards
+        self._map_lock = threading.Lock()
+        # raw sid -> tier sid map, versioned by both stores' series
+        # counts (identity indexes are append-only)
+        self._sid_map: tuple | None = None
+
+    # -- identity surface: the RAW store's ---------------------------------
+
+    @property
+    def fault_injector(self):
+        return self.raw.fault_injector
+
+    @property
+    def points_written(self) -> int:
+        return self.raw.points_written + self.tier.points_written
+
+    @property
+    def mutation_epoch(self) -> int:
+        return (getattr(self.raw, "mutation_epoch", 0)
+                + getattr(self.tier, "mutation_epoch", 0))
+
+    def series(self, series_id: int):
+        return self.raw.series(series_id)
+
+    def num_series(self) -> int:
+        return self.raw.num_series()
+
+    def metric_ids(self):
+        return self.raw.metric_ids()
+
+    def metric_index(self, metric_id: int):
+        return self.raw.metric_index(metric_id)
+
+    def series_ids_for_metric(self, metric_id: int) -> np.ndarray:
+        return self.raw.series_ids_for_metric(metric_id)
+
+    def shards_of(self, series_ids):
+        return self.raw.shards_of(series_ids)
+
+    def total_points(self) -> int:
+        return self.raw.total_points() + self.tier.total_points()
+
+    # -- sid mapping --------------------------------------------------------
+
+    def _tier_sids(self, sids: np.ndarray) -> np.ndarray:
+        """Tier sid per raw sid (-1 when the tier never saw the
+        series). Cached over the full metric, invalidated by either
+        index growing."""
+        from opentsdb_tpu.query.engine import _match_series_by_tags
+        key = (self.raw.num_series(), self.tier.num_series())
+        with self._map_lock:
+            cached = self._sid_map
+            if cached is None or cached[0] != key:
+                all_raw = self.raw.series_ids_for_metric(self.metric_id)
+                mapped = _match_series_by_tags(
+                    self.raw, self.tier, all_raw, self.metric_id)
+                order = np.argsort(all_raw, kind="stable")
+                cached = (key, all_raw[order], mapped[order])
+                self._sid_map = cached
+        _, sorted_raw, sorted_tier = cached
+        sids = np.asarray(sids, dtype=np.int64)
+        if len(sorted_raw) == 0:
+            return np.full(len(sids), -1, dtype=np.int64)
+        pos = np.searchsorted(sorted_raw, sids)
+        pos_c = np.minimum(pos, len(sorted_raw) - 1)
+        hit = sorted_raw[pos_c] == sids
+        return np.where(hit, sorted_tier[pos_c], -1)
+
+    def _split(self, start_ms: int, end_ms: int):
+        """(tier_range | None, raw_range | None) for one request."""
+        b = self.boundary_ms
+        tier_rng = (start_ms, min(end_ms, b - 1)) if start_ms < b \
+            else None
+        raw_rng = (max(start_ms, b), end_ms) if end_ms >= b else None
+        return tier_rng, raw_rng
+
+    # -- reads --------------------------------------------------------------
+
+    def count_range(self, series_ids, start_ms: int,
+                    end_ms: int) -> np.ndarray:
+        sids = np.asarray(series_ids, dtype=np.int64)
+        out = np.zeros(len(sids), dtype=np.int64)
+        tier_rng, raw_rng = self._split(start_ms, end_ms)
+        if raw_rng is not None:
+            out += self.raw.count_range(sids, *raw_rng)
+        if tier_rng is not None:
+            tsids = self._tier_sids(sids)
+            present = np.nonzero(tsids >= 0)[0]
+            if len(present):
+                out[present] += self.tier.count_range(
+                    tsids[present], *tier_rng)
+        return out
+
+    def bucket_reduce(self, series_ids, start_ms: int, end_ms: int,
+                      t0: int, interval_ms: int, nbuckets: int,
+                      want_minmax: bool = False):
+        """Channel-wise combination of the tier half and the raw tail
+        over ONE shared bucket grid (same t0/interval/nbuckets for
+        both, so a bucket straddling the boundary sums exactly)."""
+        sids = np.asarray(series_ids, dtype=np.int64)
+        s = len(sids)
+        sums = np.zeros((s, nbuckets))
+        cnts = np.zeros((s, nbuckets))
+        mins = maxs = None
+        if want_minmax:
+            mins = np.full((s, nbuckets), np.inf)
+            maxs = np.full((s, nbuckets), -np.inf)
+        tier_rng, raw_rng = self._split(start_ms, end_ms)
+        if tier_rng is not None:
+            tsids = self._tier_sids(sids)
+            present = np.nonzero(tsids >= 0)[0]
+            if len(present):
+                t_sums, t_cnts, t_mins, t_maxs = \
+                    self.tier.bucket_reduce(
+                        tsids[present], tier_rng[0], tier_rng[1], t0,
+                        interval_ms, nbuckets, want_minmax=want_minmax)
+                sums[present] += t_sums
+                cnts[present] += t_cnts
+                if want_minmax:
+                    # fancy indexing copies: assign back, don't `out=`
+                    mins[present] = np.minimum(mins[present], t_mins)
+                    maxs[present] = np.maximum(maxs[present], t_maxs)
+        if raw_rng is not None:
+            r_sums, r_cnts, r_mins, r_maxs = self.raw.bucket_reduce(
+                sids, raw_rng[0], raw_rng[1], t0, interval_ms,
+                nbuckets, want_minmax=want_minmax)
+            # the raw tail contributes the statistic this tier's point
+            # values carry: counting a count-tier's tail means adding
+            # raw bucket COUNTS into the sums channel
+            sums += r_cnts if self.tail_stat == "count" else r_sums
+            cnts += r_cnts
+            if want_minmax:
+                np.minimum(mins, r_mins, out=mins)
+                np.maximum(maxs, r_maxs, out=maxs)
+        return sums, cnts, mins, maxs
+
+    def materialize(self, series_ids, start_ms: int,
+                    end_ms: int) -> PointBatch:
+        """Flat merged batch: per series, tier points (all before the
+        boundary) precede raw tail points, so per-series time order is
+        preserved by one stable sort on the series index."""
+        sids = np.asarray(series_ids, dtype=np.int64)
+        parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        tier_rng, raw_rng = self._split(start_ms, end_ms)
+        if tier_rng is not None:
+            tsids = self._tier_sids(sids)
+            present = np.nonzero(tsids >= 0)[0]
+            if len(present):
+                tb = self.tier.materialize(tsids[present], *tier_rng)
+                parts.append((present[tb.series_idx].astype(np.int32),
+                              tb.ts_ms, tb.values))
+        if raw_rng is not None:
+            rb = self.raw.materialize(sids, *raw_rng)
+            vals = rb.values
+            if self.tail_stat == "count" and len(vals):
+                # summing the tail must COUNT it (count-tier cells
+                # hold counts; see module docstring)
+                vals = np.ones_like(vals)
+            parts.append((rb.series_idx, rb.ts_ms, vals))
+        if not parts:
+            return PointBatch(sids,
+                              np.empty(0, dtype=np.int32),
+                              np.empty(0, dtype=np.int64),
+                              np.empty(0, dtype=np.float64))
+        series_idx = np.concatenate([p[0] for p in parts])
+        ts_ms = np.concatenate([p[1] for p in parts])
+        values = np.concatenate([p[2] for p in parts])
+        order = np.argsort(series_idx, kind="stable")
+        return PointBatch(sids, series_idx[order], ts_ms[order],
+                          values[order])
+
+    def materialize_padded(self, series_ids, start_ms: int,
+                           end_ms: int) -> PaddedBatch:
+        batch = self.materialize(series_ids, start_ms, end_ms)
+        s = len(batch.series_ids)
+        counts = np.bincount(batch.series_idx, minlength=s) \
+            .astype(np.int64) if s else np.empty(0, dtype=np.int64)
+        pmax = max(1, int(counts.max())) if s else 1
+        values2d = np.full((s, pmax), np.nan)
+        ts2d = np.zeros((s, pmax), dtype=np.int64)
+        if batch.num_points:
+            row_starts = np.zeros(s, dtype=np.int64)
+            np.cumsum(counts[:-1], out=row_starts[1:])
+            col = np.arange(batch.num_points, dtype=np.int64) \
+                - np.repeat(row_starts, counts)
+            values2d[batch.series_idx, col] = batch.values
+            ts2d[batch.series_idx, col] = batch.ts_ms
+        return PaddedBatch(batch.series_ids, values2d, ts2d, counts)
+
+    # -- destructive ops (delete=true queries) ------------------------------
+
+    def delete_range(self, series_ids, start_ms: int,
+                     end_ms: int) -> int:
+        """delete=true over a stitched view removes the range from
+        BOTH halves (tier history and raw tail)."""
+        sids = np.asarray(series_ids, dtype=np.int64)
+        deleted = self.raw.delete_range(sids, start_ms, end_ms)
+        tsids = self._tier_sids(sids)
+        present = tsids[tsids >= 0]
+        if len(present):
+            deleted += self.tier.delete_range(present, start_ms,
+                                              end_ms)
+        return deleted

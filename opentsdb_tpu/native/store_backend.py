@@ -526,11 +526,33 @@ class NativeTimeSeriesStore:
         return sum(int(self._lib.tss_series_length(self._h, sid))
                    for sid in range(len(self._records)))
 
+    def memory_info(self) -> dict:
+        """Memory-footprint report (health/stats). The C++ arena does
+        not expose per-series capacity, so resident is estimated as
+        live bytes (17 bytes/point: int64 ts + float64 value + flag);
+        cached on the write/delete counters like the Python twin."""
+        key = (self.points_written, self.mutation_epoch,
+               len(self._records))
+        cached = getattr(self, "_memory_info_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        points = self.total_points()
+        info = {"series": len(self._records), "points": points,
+                "resident_bytes": points * 17, "live_bytes": points * 17,
+                "dead_bytes": 0, "estimated": True}
+        self._memory_info_cache = (key, info)
+        return info
+
     def collect_stats(self, collector) -> None:
         collector.record("storage.series.count", self.num_series())
         collector.record("storage.points.written", self.points_written)
         collector.record("storage.shards", self.num_shards)
         collector.record("storage.backend", 1, backend="native")
+        mi = self.memory_info()
+        collector.record("storage.resident_bytes",
+                         mi["resident_bytes"])
+        collector.record("storage.live_bytes", mi["live_bytes"])
+        collector.record("storage.dead_bytes", mi["dead_bytes"])
 
 
 IMPORT_ERRORS = {

@@ -1152,8 +1152,16 @@ class QueryEngine:
         avg_count_store = None
         ds_fn_override = None
         usage = (sub.rollup_usage or "ROLLUP_NOFALLBACK").upper()
+        # a metric whose FIRST lifecycle demotion is in flight has
+        # partial tier cells but no boundary yet: raw still holds
+        # every point, so it is the only fully-correct source until
+        # the boundary publishes and stitching takes over
+        lc = self.tsdb.lifecycle
+        lc_pin_raw = lc is not None and \
+            lc.first_demotion_in_flight(metric_id)
         if (self.tsdb.rollup_store is not None and sub.ds_spec is not None
-                and not sub.ds_spec.run_all and usage != "ROLLUP_RAW"):
+                and not sub.ds_spec.run_all and usage != "ROLLUP_RAW"
+                and not lc_pin_raw):
             tier = self.tsdb.rollup_config.best_match(
                 sub.ds_spec.interval_ms)
             agg_fn = sub.ds_spec.function
@@ -1161,14 +1169,20 @@ class QueryEngine:
             if tier is not None and agg_fn in ("sum", "count", "min",
                                                "max"):
                 if rs.has_data(tier.interval, agg_fn):
-                    store = rs.tier(tier.interval, agg_fn)
+                    store = self._maybe_stitch(
+                        rs.tier(tier.interval, agg_fn), metric_id,
+                        tier.interval, agg_fn)
                     if agg_fn == "count":
                         ds_fn_override = "sum"
             elif tier is not None and agg_fn == "avg" \
                     and rs.has_data(tier.interval, "sum") \
                     and rs.has_data(tier.interval, "count"):
-                store = rs.tier(tier.interval, "sum")
-                avg_count_store = rs.tier(tier.interval, "count")
+                store = self._maybe_stitch(
+                    rs.tier(tier.interval, "sum"), metric_id,
+                    tier.interval, "sum")
+                avg_count_store = self._maybe_stitch(
+                    rs.tier(tier.interval, "count"), metric_id,
+                    tier.interval, "count")
         sids = store.series_ids_for_metric(metric_id)
         if store is not self.tsdb.store and len(sids) == 0 and \
                 usage in ("ROLLUP_FALLBACK", "ROLLUP_FALLBACK_RAW"):
@@ -1178,6 +1192,18 @@ class QueryEngine:
             ds_fn_override = None
         return (store, sub.metric, sids, rollup_scale, avg_count_store,
                 ds_fn_override)
+
+    def _maybe_stitch(self, tier_store, metric_id: int, interval: str,
+                      agg: str):
+        """Replace a selected tier store with the lifecycle manager's
+        stitched view (tier history before the demotion boundary +
+        raw tail after it) when the metric has a boundary; a metric
+        that was never demoted keeps plain tier serving."""
+        lc = self.tsdb.lifecycle
+        if lc is None:
+            return tier_store
+        return lc.stitched(metric_id, interval, agg, tier_store) \
+            or tier_store
 
     @staticmethod
     def _record_scan(stats, ms: float, num_points: int,

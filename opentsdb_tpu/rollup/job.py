@@ -329,8 +329,13 @@ def _window_buckets(nested_factors: list[int],
 def run_rollup_job(tsdb, start_ms: int, end_ms: int,
                    intervals: list[str] | None = None,
                    series_chunk: int | None = None,
-                   progress=None) -> dict[str, int]:
+                   progress=None,
+                   series_ids=None) -> dict[str, int]:
     """Materialize rollup tiers for all raw data in [start_ms, end_ms].
+
+    ``series_ids`` optionally restricts the job to a subset of raw
+    series (the lifecycle manager demotes one metric at a time);
+    default is every series of every metric.
 
     Returns {interval: points_written}.
     """
@@ -359,10 +364,13 @@ def run_rollup_job(tsdb, start_ms: int, end_ms: int,
             lcm = math.lcm(lcm, f)
     direct = [t for t in tiers[1:] if t not in nested]
 
-    all_sids = np.concatenate(
-        [tsdb.store.series_ids_for_metric(mid)
-         for mid in tsdb.store.metric_ids()]
-        or [np.empty(0, dtype=np.int64)])
+    if series_ids is not None:
+        all_sids = np.asarray(series_ids, dtype=np.int64)
+    else:
+        all_sids = np.concatenate(
+            [tsdb.store.series_ids_for_metric(mid)
+             for mid in tsdb.store.metric_ids()]
+            or [np.empty(0, dtype=np.int64)])
     if len(all_sids):
         # skip series with no raw data in the job window up front:
         # _chunk_tier_sids get_or_creates a tier series per (tier, agg)

@@ -70,6 +70,13 @@ class ContinuousQuery:
         self.emit_seq = 0
         self.last_publish = 0.0
         self.closed = False
+        # bounded replay history for SSE resume (Last-Event-ID): the
+        # last N published `windows` frames, each tagged with its emit
+        # seq. evicted_seq = the newest frame pushed out — a reconnect
+        # older than it has missed un-replayable events and falls back
+        # to a snapshot.
+        self.history: list[tuple[int, bytes]] = []
+        self.evicted_seq = 0
 
     def describe(self, verbose: bool = False) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -113,6 +120,9 @@ class ContinuousQueryRegistry:
                                          5.0)
         self.publish_min_interval_ms = cfg.get_float(
             "tsd.streaming.publish_min_interval_ms", 200.0)
+        # SSE resume replay depth (0 disables Last-Event-ID resume)
+        self.resume_events = cfg.get_int(
+            "tsd.streaming.resume_events", 64)
         threshold = cfg.get_int(
             "tsd.streaming.breaker.failure_threshold", 3)
         self.breaker = CircuitBreaker(
@@ -132,6 +142,8 @@ class ContinuousQueryRegistry:
         self.rebuilds = 0
         self.sse_shed = 0
         self.sse_events = 0
+        self.sse_resumes = 0
+        self.sse_resume_snapshots = 0
         self.publishes = 0
 
     # ------------------------------------------------------------------
@@ -419,6 +431,15 @@ class ContinuousQueryRegistry:
         relative = _is_relative(tsq.start) or _is_relative(tsq.end)
         if not relative and tsq.start_ms % iv:
             return None
+        # lifecycle demotion: windows that reach behind the metric's
+        # demotion boundary need tier history the partials never saw
+        # (plans fold raw writes only; a rebuild scans raw only) — shed
+        # those to the batch engine, whose stitched store serves them
+        lc = getattr(self.tsdb, "lifecycle", None)
+        if lc is not None and \
+                tsq.start_ms < lc.demote_boundary_for(sub.metric):
+            self.serve_fallbacks += 1
+            return None
         # deletes/repairs bump the store's mutation epoch; partials
         # cannot unfold removed points, so a mismatch forces a rebuild
         # before anything is served (this also covers delete=true
@@ -452,12 +473,28 @@ class ContinuousQueryRegistry:
     # push path: SSE publication
     # ------------------------------------------------------------------
 
-    def subscribe(self, cq: ContinuousQuery):
+    def subscribe(self, cq: ContinuousQuery,
+                  last_event_id: int | None = None):
         from opentsdb_tpu.streaming.sse import Subscription
         sub = Subscription(self.queue_events)
+        # resume (Last-Event-ID): replay only the `windows` frames
+        # published since the client's last seen event instead of the
+        # full snapshot; an id that aged out of the bounded history
+        # (or is unknown) falls back to the snapshot. Registration +
+        # replay happen in ONE cq.lock section so a concurrent
+        # publish (which snapshots targets and appends history under
+        # the same lock) can neither interleave a newer frame ahead
+        # of the replay nor slip a frame past both paths.
+        resumed = False
         with cq.lock:
             cq.subscribers.append(sub)
             self._active_subs += 1
+            if last_event_id is not None:
+                resumed = self._resume_locked(cq, sub,
+                                              int(last_event_id))
+        if resumed:
+            self.sse_resumes += 1
+            return sub
         # initial snapshot so a dashboard renders before the first
         # incremental update arrives
         try:
@@ -465,6 +502,25 @@ class ContinuousQueryRegistry:
         except Exception:  # noqa: BLE001 - snapshot is best-effort
             LOG.exception("initial snapshot failed for %s", cq.id)
         return sub
+
+    def _resume_locked(self, cq: ContinuousQuery, sub,
+                       last_id: int) -> bool:
+        """Replay the frames the reconnecting client missed (caller
+        holds ``cq.lock``); False when only a snapshot can catch it
+        up."""
+        from opentsdb_tpu.streaming import sse
+        if self.resume_events <= 0:
+            return False
+        if last_id > cq.emit_seq or last_id < cq.evicted_seq:
+            # future/bogus id, or a `windows` frame newer than the
+            # client's position was already evicted: the gap is not
+            # replayable
+            self.sse_resume_snapshots += 1
+            return False
+        for seq, fr in cq.history:
+            if seq > last_id and not sse.offer_frame(sub, fr):
+                return False  # overflowed mid-replay: sub is shed
+        return True
 
     def unsubscribe(self, cq: ContinuousQuery, sub) -> None:
         with cq.lock:
@@ -559,16 +615,27 @@ class ContinuousQueryRegistry:
                     "metric": r.metric, "tags": r.tags,
                     "aggregateTags": r.aggregated_tags,
                     "index": r.sub_query_index, "dps": dps})
+        # ONE critical section for seq + target snapshot + history
+        # append: a subscriber resuming concurrently either appears in
+        # `targets` (gets the frame live) or subscribes after — and
+        # then its replay reads a history that already holds this
+        # frame. Split sections would let a frame slip between its
+        # target snapshot and its history append, lost to both paths.
         with cq.lock:
             cq.emit_seq += 1
             seq = cq.emit_seq
             targets = list(only if only is not None
                            else cq.subscribers)
-        if not updates and not snapshot:
-            return False
-        payload = {"id": cq.id, "seq": seq, "ts": now_ms,
-                   "updates": updates}
-        fr = sse.frame("snapshot" if snapshot else "windows", payload)
+            if not updates and not snapshot:
+                return False
+            payload = {"id": cq.id, "seq": seq, "ts": now_ms,
+                       "updates": updates}
+            fr = sse.frame("snapshot" if snapshot else "windows",
+                           payload, event_id=seq)
+            if not snapshot and self.resume_events > 0:
+                cq.history.append((seq, fr))
+                while len(cq.history) > self.resume_events:
+                    cq.evicted_seq = cq.history.pop(0)[0]
         shed = 0
         for s in targets:
             if not sse.offer_frame(s, fr):
@@ -623,6 +690,9 @@ class ContinuousQueryRegistry:
         collector.record("streaming.sse.subscribers", subs)
         collector.record("streaming.sse.events", self.sse_events)
         collector.record("streaming.sse.shed", self.sse_shed)
+        collector.record("streaming.sse.resumes", self.sse_resumes)
+        collector.record("streaming.sse.resume_snapshots",
+                         self.sse_resume_snapshots)
         collector.record("streaming.publishes", self.publishes)
 
     def health_info(self) -> dict[str, Any]:
@@ -646,6 +716,8 @@ class ContinuousQueryRegistry:
             "subscribers": subs,
             "sse_events": self.sse_events,
             "sse_shed": self.sse_shed,
+            "sse_resumes": self.sse_resumes,
+            "sse_resume_snapshots": self.sse_resume_snapshots,
         }
         if self.breaker is not None:
             out["breaker"] = self.breaker.health_info()

@@ -35,10 +35,14 @@ class Subscription:
         self.events = 0
 
 
-def frame(event: str, payload: dict) -> bytes:
-    """One SSE frame: ``event: <type>`` + one JSON ``data:`` line."""
+def frame(event: str, payload: dict,
+          event_id: int | None = None) -> bytes:
+    """One SSE frame: optional ``id:`` (the per-query emit sequence —
+    browsers echo the last one back as ``Last-Event-ID`` on
+    reconnect), ``event: <type>`` + one JSON ``data:`` line."""
     body = json.dumps(payload, allow_nan=False, separators=(",", ":"))
-    return (f"event: {event}\ndata: {body}\n\n").encode()
+    head = f"id: {event_id}\n" if event_id is not None else ""
+    return (f"{head}event: {event}\ndata: {body}\n\n").encode()
 
 
 def offer_frame(sub: Subscription, fr: bytes) -> bool:
@@ -54,10 +58,17 @@ def offer_frame(sub: Subscription, fr: bytes) -> bool:
     return True
 
 
-def sse_stream(registry, cq, max_lifetime_s: float = 0.0):
+def sse_stream(registry, cq, max_lifetime_s: float = 0.0,
+               last_event_id: int | None = None):
     """Generator of SSE byte chunks for one subscriber (consumed by
-    the server's chunked writer on a worker thread)."""
-    sub = registry.subscribe(cq)
+    the server's chunked writer on a worker thread).
+
+    ``last_event_id`` (the browser's ``Last-Event-ID`` reconnect
+    header) resumes the stream: the registry replays only the
+    ``windows`` events published since that id instead of the full
+    snapshot, falling back to a snapshot when the id has aged out of
+    the bounded replay history."""
+    sub = registry.subscribe(cq, last_event_id=last_event_id)
     heartbeat = max(registry.heartbeat_s, 0.05)
     started = time.monotonic()
     try:

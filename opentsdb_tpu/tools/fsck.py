@@ -17,6 +17,15 @@ the store's own invariants:
   second range used by the row-key format
 - **value-length integrity** — buffer length bookkeeping
 
+When the data-lifecycle subsystem is enabled
+(:mod:`opentsdb_tpu.lifecycle`), fsck additionally reports
+**expired-but-present points** (raw points older than their metric's
+retention TTL — a sweep should have purged them) and **ghost series**
+(UID assigned, zero live points); ``--fix`` purges both through the
+lifecycle sweep so mutation epochs, the snapshot and the WAL stay
+consistent (an out-of-band delete would leave caches/replay able to
+resurrect them).
+
 The checker fans out per shard like the reference's per-salt-bucket
 FsckWorker threads (Fsck.java:257), via a thread pool.
 """
@@ -67,12 +76,51 @@ def run_fsck(tsdb, fix: bool = False, workers: int = 8) -> FsckReport:
                    for sids in shards.values()]
         for fut in futures:
             report.merge(fut.result())
+    _fsck_lifecycle(tsdb, fix, report)
     if fix and report.fixed and getattr(tsdb, "data_dir", ""):
         # make repairs durable (ref: Fsck writes repairs back to
         # HBase): snapshot the repaired store and truncate the WAL so
         # replay-on-restart cannot resurrect the dropped points
         tsdb.flush()
     return report
+
+
+def _fsck_lifecycle(tsdb, fix: bool, report: FsckReport) -> None:
+    """Lifecycle-policy checks: expired-but-present points and ghost
+    series. Active only when the subsystem is enabled — repairs go
+    through the lifecycle purge path (manager.sweep), never an
+    out-of-band delete, so epochs/snapshot/WAL stay consistent."""
+    lc = getattr(tsdb, "lifecycle", None)
+    if lc is None:
+        return
+    store = tsdb.store
+    expired = lc.scan_expired()
+    for metric in sorted(expired):
+        report.error(
+            f"metric {metric}: {expired[metric]} expired-but-present "
+            f"point(s) past the retention TTL", fixed=fix)
+    # ghost = zero live points but still-allocated columns: the sweep
+    # releases those buffers, so --fix converges (a re-run is clean).
+    # Fully-released ghosts are the designed end state — the sid/UID
+    # survives by construction (numbering is positional; reclamation
+    # is a ROADMAP item) and is NOT re-reported as an error forever.
+    ghosts = [sid for sid in range(store.num_series())
+              if len(store.series(sid).buffer) == 0
+              and getattr(store.series(sid).buffer, "resident_bytes",
+                          0) > 0]
+    if ghosts:
+        report.error(
+            f"{len(ghosts)} ghost series (UID assigned, zero live "
+            f"points, buffers not released): "
+            f"{ghosts[:16]}{'...' if len(ghosts) > 16 else ''}",
+            fixed=fix)
+    if fix and (expired or ghosts):
+        lc.sweep()
+        if ghosts and hasattr(store, "compact_series"):
+            # the sweep compacts policied metrics only; release the
+            # remaining ghosts' columns directly (no data changes —
+            # the buffers are empty — so no epoch/WAL work needed)
+            store.compact_series(ghosts, pack_ts=False)
 
 
 def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
@@ -111,7 +159,7 @@ def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:
         else:
             with buf.lock:
                 n = buf.n
-                raw_ts = buf.ts[:n].copy()
+                raw_ts = buf._ts64_locked().copy()
                 raw_vals = buf.vals[:n].copy()
                 was_sorted = buf._sorted
         report.points_checked += n

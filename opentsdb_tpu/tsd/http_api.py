@@ -176,6 +176,7 @@ class HttpRpcRouter:
             "config": self._handle_config,
             "dropcaches": self._handle_dropcaches,
             "health": self._handle_health,
+            "lifecycle": self._handle_lifecycle,
             "serializers": self._handle_serializers,
             "stats": self._handle_stats,
             "version": self._handle_version,
@@ -637,12 +638,25 @@ class HttpRpcRouter:
                 raise HttpError(
                     404, f"No continuous query with id {cid!r}")
             from opentsdb_tpu.streaming.sse import sse_stream
+            # SSE resume: browsers send Last-Event-ID on reconnect;
+            # ?last_event_id= is the curl/test convenience. A
+            # non-integer id is ignored (full snapshot), not a 400 —
+            # refusing the reconnect would strand the dashboard.
+            raw_id = request.headers.get(
+                "last-event-id", request.param("last_event_id"))
+            last_event_id = None
+            if raw_id:
+                try:
+                    last_event_id = int(raw_id)
+                except ValueError:
+                    last_event_id = None
             resp = HttpResponse(
                 200, b"",
                 body_iter=sse_stream(
                     registry, cq,
                     max_lifetime_s=self.tsdb.config.get_float(
-                        "tsd.streaming.sse.max_lifetime_s", 0.0)),
+                        "tsd.streaming.sse.max_lifetime_s", 0.0),
+                    last_event_id=last_event_id),
                 content_type="text/event-stream; charset=UTF-8")
             resp.headers["Cache-Control"] = "no-cache"
             # an SSE stream is single-use by construction
@@ -1124,6 +1138,38 @@ class HttpRpcRouter:
         return HttpResponse(200, request.serializer.format_stats(
             collector.as_json()))
 
+    def _handle_lifecycle(self, request: HttpRequest, rest
+                          ) -> HttpResponse:
+        """Data-lifecycle admin surface
+        (:mod:`opentsdb_tpu.lifecycle`):
+
+        - ``GET /api/lifecycle`` — policies, demotion boundaries and
+          sweep counters;
+        - ``POST/PUT /api/lifecycle`` — replace the policy table
+          (body: ``{"policies": [{"metric": "*", "retention": "90d",
+          "demoteAfter": "6h", "demoteTiers": ["1m"]}, ...]}``);
+        - ``POST /api/lifecycle/sweep`` — run one sweep synchronously
+          and return its report (operators and tests; the background
+          sweeper runs on ``tsd.lifecycle.interval_s``)."""
+        lc = self.tsdb.lifecycle
+        if lc is None:
+            raise HttpError(400, "Data lifecycle is disabled",
+                            "set tsd.lifecycle.enable = true")
+        if rest and rest[0] == "sweep":
+            if request.method != "POST":
+                raise HttpError(405, "Method not allowed",
+                                "POST runs one sweep")
+            return HttpResponse(200, json.dumps(lc.sweep()).encode())
+        if rest:
+            raise HttpError(404, f"Endpoint not found: "
+                            f"/api/lifecycle/{rest[0]}")
+        if request.method == "GET":
+            return HttpResponse(200, json.dumps(lc.describe()).encode())
+        if request.method in ("POST", "PUT"):
+            lc.update_policies(request.json_object())
+            return HttpResponse(200, json.dumps(lc.describe()).encode())
+        raise HttpError(405, "Method not allowed")
+
     def _handle_health(self, request: HttpRequest, rest) -> HttpResponse:
         """Operator-facing degradation report (``/api/health``): WAL
         durability lag + degraded flag, circuit-breaker states,
@@ -1174,6 +1220,19 @@ class HttpRpcRouter:
         else:
             streaming_info = {"enabled": t.config.get_bool(
                 "tsd.streaming.enable", True), "queries": 0}
+        # the raw attribute: health must not instantiate the lifecycle
+        # manager just to report it absent
+        lifecycle = getattr(t, "_lifecycle", None)
+        if lifecycle is not None:
+            lifecycle_info = lifecycle.health_info()
+            lbreaker = lifecycle.breaker
+            if lbreaker is not None:
+                breakers[lbreaker.name] = lbreaker.health_info()
+                if lbreaker.state != lbreaker.CLOSED:
+                    causes.append(f"breaker:{lbreaker.name}")
+        else:
+            lifecycle_info = {"enabled": t.config.get_bool(
+                "tsd.lifecycle.enable", False)}
         hook_errors = dict(getattr(t, "hook_errors", {}))
         doc: dict[str, Any] = {
             "status": "degraded" if causes else "ok",
@@ -1186,6 +1245,11 @@ class HttpRpcRouter:
                        else {"armed": False, "sites": {}}),
             "query_cache": cache_info,
             "streaming": streaming_info,
+            "lifecycle": lifecycle_info,
+            # per-store memory footprint (resident vs live vs dead
+            # capacity) so lifecycle reclamation is observable
+            # before/after sweeps
+            "storage": t.storage_memory_info(),
             "hook_errors": hook_errors,
         }
         server = self.server

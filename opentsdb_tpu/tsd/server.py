@@ -326,6 +326,14 @@ class TSDServer:
         # so first queries of each class run warm (tsd.tpu.warmup)
         from opentsdb_tpu.tsd.warmup import start_warmup_thread
         self._warmup_thread = start_warmup_thread(self.tsdb)
+        # the data-lifecycle sweeper (retention / demotion /
+        # compaction, opentsdb_tpu/lifecycle/) runs on its own
+        # background thread; no-op when tsd.lifecycle.enable is off
+        # or tsd.lifecycle.interval_s <= 0 (manual sweeps only, via
+        # POST /api/lifecycle/sweep). Stopped by TSDB.shutdown.
+        lifecycle = self.tsdb.lifecycle
+        if lifecycle is not None:
+            lifecycle.start()
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 

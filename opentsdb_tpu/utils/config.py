@@ -136,6 +136,26 @@ _DEFAULTS: dict[str, str] = {
     "tsd.query.admission.max_inflight": "0",
     "tsd.query.admission.max_queue": "0",
     "tsd.query.admission.retry_after_s": "1",
+    # data lifecycle (opentsdb_tpu/lifecycle/): retention, age-based
+    # rollup demotion, store compaction. Per-metric overrides:
+    # tsd.lifecycle.policy.<metric>.<retention|demote_after|
+    # demote_tiers>. Durations are reference duration strings (30d,
+    # 6h, ...); "" disables the mechanism.
+    "tsd.lifecycle.enable": "false",
+    "tsd.lifecycle.interval_s": "0",     # 0 = manual sweeps only
+    "tsd.lifecycle.retention": "",       # default policy: keep forever
+    "tsd.lifecycle.demote_after": "",    # default policy: never demote
+    "tsd.lifecycle.demote_tiers": "",    # "" = every configured tier
+    "tsd.lifecycle.compact": "true",
+    "tsd.lifecycle.pack_timestamps": "true",
+    #   snapshot + WAL-truncate after a sweep that purged/demoted:
+    #   the WAL has no delete records, so without this a restart's
+    #   replay would resurrect expired points
+    "tsd.lifecycle.flush_after_sweep": "true",
+    "tsd.lifecycle.breaker.failure_threshold": "3",
+    "tsd.lifecycle.breaker.reset_timeout_ms": "60000",
+    # SSE resume replay depth (Last-Event-ID; 0 disables resume)
+    "tsd.streaming.resume_events": "64",
     # auth
     "tsd.core.authentication.enable": "false",
     # stats

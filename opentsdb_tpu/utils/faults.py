@@ -12,8 +12,10 @@ pieces live here, shared by the whole serve path:
   snapshot flush (``store.flush``), the device pipeline entry
   (``device.compile``), lazily-created rollup tier/preagg stores
   (``rollup.store``), the tree filing path (``tree.store``), the meta
-  write paths (``meta.store``) and the continuous-query incremental
-  fold/rebuild path (``stream.fold``). Scheduling is DETERMINISTIC —
+  write paths (``meta.store``), the continuous-query incremental
+  fold/rebuild path (``stream.fold``) and the data-lifecycle sweeper
+  (``lifecycle.sweep`` around the whole sweep, ``lifecycle.demote``
+  around the demotion fold). Scheduling is DETERMINISTIC —
   an error *rate* is a counted schedule (fail call ``i`` iff
   ``floor(i*r)`` advances), never a coin flip — so every fault
   battery failure reproduces.

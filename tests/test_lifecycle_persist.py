@@ -1,0 +1,172 @@
+"""Lifecycle x persist/WAL interaction (``-m lifecycle``).
+
+The WAL has no delete record type, so a sweep's purge is made durable
+by the post-sweep snapshot + WAL truncation inside ``TSDB.flush``
+(``tsd.lifecycle.flush_after_sweep``). These tests prove the
+acceptance contract: snapshot -> restart -> replay after a sweep must
+NOT resurrect purged points — including when the WAL tail is torn by
+a crash and when the WAL write path is degraded during the sweep —
+and demotion boundaries survive restarts so stitched serving keeps
+working.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+pytestmark = pytest.mark.lifecycle
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+SPAN_S = 7200
+NOW_MS = BASE_MS + SPAN_S * 1000
+CUTOFF_MS = NOW_MS - 3600_000   # 1h retention
+
+
+def _cfg(d, **extra):
+    cfg = {
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "memory",
+        "tsd.rollups.enable": "true",
+        "tsd.storage.data_dir": d,
+        "tsd.lifecycle.enable": "true",
+        "tsd.lifecycle.retention": "1h",
+        "tsd.lifecycle.demote_after": "30m",
+        "tsd.lifecycle.demote_tiers": "1m",
+    }
+    cfg.update(extra)
+    return Config(**cfg)
+
+
+def _ingest(t, n_series=2):
+    ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+    rng = np.random.default_rng(5)
+    for i in range(n_series):
+        t.add_points("p.m", ts, rng.normal(100, 10, SPAN_S),
+                     {"host": f"h{i}"})
+
+
+def _served(t, start=BASE_MS, end=NOW_MS, ds="1m-sum"):
+    out = t.execute_query(TSQuery.from_json({
+        "start": start, "end": end,
+        "queries": [{"metric": "p.m", "aggregator": "sum",
+                     "downsample": ds}]}).validate())
+    return dict(out[0].dps) if out else {}
+
+
+def _raw_count(t, start=1, end=NOW_MS):
+    sids = t.store.series_ids_for_metric(
+        t.uids.metrics.get_id("p.m"))
+    return int(t.store.count_range(sids, start, end).sum())
+
+
+def test_replay_after_sweep_does_not_resurrect(tmp_path):
+    d = str(tmp_path / "d")
+    t = TSDB(_cfg(d))
+    _ingest(t)
+    assert _raw_count(t, 1, CUTOFF_MS - 1) > 0
+    t.lifecycle.sweep(now_ms=NOW_MS)
+    assert _raw_count(t, 1, CUTOFF_MS - 1) == 0
+    served = _served(t)
+    t.wal.close()
+
+    # restart: snapshot + WAL replay must reproduce the SWEPT state —
+    # the pre-sweep WAL records were truncated by the post-sweep flush
+    t2 = TSDB(_cfg(d))
+    assert _raw_count(t2, 1, CUTOFF_MS - 1) == 0
+    assert _served(t2) == served
+    t2.wal.close()
+
+
+def test_boundary_survives_restart_and_stitching_still_serves(
+        tmp_path):
+    d = str(tmp_path / "d")
+    t = TSDB(_cfg(d, **{"tsd.lifecycle.retention": ""}))
+    _ingest(t)
+    t.lifecycle.sweep(now_ms=NOW_MS)
+    mid = t.uids.metrics.get_id("p.m")
+    boundary = t.lifecycle.demote_boundary(mid)
+    assert boundary > BASE_MS
+    served = _served(t)
+    assert min(served) < boundary, "history must be tier-served"
+    t.wal.close()
+
+    t2 = TSDB(_cfg(d, **{"tsd.lifecycle.retention": ""}))
+    mid2 = t2.uids.metrics.get_id("p.m")
+    assert t2.lifecycle.demote_boundary(mid2) == boundary
+    assert _served(t2) == served
+    t2.wal.close()
+
+
+def test_post_sweep_writes_and_torn_tail_replay(tmp_path):
+    """Writes after the sweep land in a fresh WAL; a crash tearing
+    that tail must replay the intact prefix and STILL not resurrect
+    purged points."""
+    d = str(tmp_path / "d")
+    t = TSDB(_cfg(d))
+    _ingest(t, n_series=1)
+    t.lifecycle.sweep(now_ms=NOW_MS)
+    # post-sweep writes (not covered by the sweep snapshot)
+    for i in range(5):
+        t.add_point("p.m", BASE + SPAN_S + i, float(i), {"host": "h0"})
+    t.wal.close()
+    wal_dir = os.path.join(d, "wal")
+    segs = sorted(os.path.join(wal_dir, f)
+                  for f in os.listdir(wal_dir) if f.endswith(".log"))
+    assert segs, "post-sweep writes must have re-opened a segment"
+    os.truncate(segs[-1], os.path.getsize(segs[-1]) - 3)
+
+    t2 = TSDB(_cfg(d))
+    assert _raw_count(t2, 1, CUTOFF_MS - 1) == 0, \
+        "torn-tail replay resurrected purged points"
+    # the intact prefix of the post-sweep writes is back (the torn
+    # final record is gone)
+    tail = _raw_count(t2, NOW_MS, NOW_MS + 60_000)
+    assert tail == 4
+    t2.wal.close()
+
+
+def test_degraded_wal_during_sweep_still_purges_durably(tmp_path):
+    """WAL append path offline while the sweep runs: the sweep's
+    durability comes from the snapshot, not the WAL, so a restart
+    still reflects the purge (and the degradation is visible on the
+    WAL flags, not as an error)."""
+    d = str(tmp_path / "d")
+    t = TSDB(_cfg(d, **{"tsd.storage.wal.retry.attempts": "1"}))
+    _ingest(t, n_series=1)
+    t.faults.arm("wal.append", error_rate=1.0)
+    # shed a write so the WAL is actually degraded during the sweep
+    t.add_point("p.m", BASE + SPAN_S, 1.0, {"host": "h0"})
+    assert t.wal.degraded or t.wal.append_failures > 0
+    rep = t.lifecycle.sweep(now_ms=NOW_MS)
+    assert "error" not in rep and rep["purged"] > 0
+    t.faults.disarm()
+    t.wal.close()
+
+    t2 = TSDB(_cfg(d))
+    assert _raw_count(t2, 1, CUTOFF_MS - 1) == 0
+    t2.wal.close()
+
+
+def test_flush_after_sweep_off_documents_resurrection(tmp_path):
+    """The knob exists for operators who snapshot on their own
+    cadence: with flush_after_sweep=false the purge is NOT durable
+    until the next flush — replay resurrects. This pins the
+    documented semantics so a regression in either direction is
+    caught."""
+    d = str(tmp_path / "d")
+    t = TSDB(_cfg(d, **{"tsd.lifecycle.flush_after_sweep": "false",
+                        "tsd.lifecycle.demote_after": ""}))
+    _ingest(t, n_series=1)
+    t.lifecycle.sweep(now_ms=NOW_MS)
+    assert _raw_count(t, 1, CUTOFF_MS - 1) == 0
+    t.wal.close()
+    t2 = TSDB(_cfg(d))
+    assert _raw_count(t2, 1, CUTOFF_MS - 1) == SPAN_S - 3600
+    t2.wal.close()

@@ -570,3 +570,117 @@ class TestWindowRing:
         streamed = _run(t, qobj)
         batch = _run_batch(t, qobj)
         _assert_value_identical(streamed, batch)
+
+
+# ---------------------------------------------------------------------------
+# SSE resume (Last-Event-ID)
+# ---------------------------------------------------------------------------
+
+def _events_with_ids(frames: bytes):
+    out = []
+    for block in frames.decode().split("\n\n"):
+        ev = data = eid = None
+        for ln in block.strip().splitlines():
+            if ln.startswith("event: "):
+                ev = ln[7:]
+            elif ln.startswith("data: "):
+                data = json.loads(ln[6:])
+            elif ln.startswith("id: "):
+                eid = int(ln[4:])
+        if ev:
+            out.append((ev, eid, data))
+    return out
+
+
+class TestSseResume:
+    def _setup(self, **extra):
+        t = _tsdb(**{"tsd.streaming.heartbeat_s": "0.05",
+                     "tsd.streaming.publish_min_interval_ms": "0",
+                     **extra})
+        _ingest(t, SERIES[:2], BASE, 10, seed=21)
+        cq = _register(t, _qobj(agg="sum", ds="1m-sum"))
+        return t, t.streaming, cq
+
+    def test_reconnect_replays_only_missed_windows(self):
+        from opentsdb_tpu.streaming.sse import sse_stream
+        t, reg, cq = self._setup()
+        g1 = sse_stream(reg, cq)
+        assert next(g1).startswith(b"retry:")
+        ev, eid0, _ = _events_with_ids(next(g1))[0]
+        assert ev == "snapshot" and eid0 is not None
+        t.add_point("s.m", BASE + 700, 3.0, {"host": "h0"})
+        reg.flush()
+        _, id1, _ = _events_with_ids(next(g1))[0]
+        t.add_point("s.m", BASE + 760, 4.0, {"host": "h0"})
+        reg.flush()
+        ev2, id2, d2 = _events_with_ids(next(g1))[0]
+        g1.close()
+        # reconnect at id1: exactly the id2 windows frame replays —
+        # no snapshot, nothing already-seen
+        g2 = sse_stream(reg, cq, last_event_id=id1)
+        assert next(g2).startswith(b"retry:")
+        ev, eid, data = _events_with_ids(next(g2))[0]
+        assert (ev, eid, data) == ("windows", id2, d2)
+        assert reg.sse_resumes == 1
+        g2.close()
+        # reconnect fully caught up: no replay, stream stays live
+        g3 = sse_stream(reg, cq, last_event_id=id2)
+        assert next(g3).startswith(b"retry:")
+        t.add_point("s.m", BASE + 820, 5.0, {"host": "h0"})
+        reg.flush()
+        ev, eid, _ = _events_with_ids(next(g3))[0]
+        assert ev == "windows" and eid > id2
+        g3.close()
+
+    def test_aged_out_id_falls_back_to_snapshot(self):
+        from opentsdb_tpu.streaming.sse import sse_stream
+        t, reg, cq = self._setup(
+            **{"tsd.streaming.resume_events": "1"})
+        g1 = sse_stream(reg, cq)
+        next(g1)
+        _, first_id, _ = _events_with_ids(next(g1))[0]
+        for i in range(3):
+            t.add_point("s.m", BASE + 700 + i * 60, 1.0,
+                        {"host": "h0"})
+            reg.flush()
+        g1.close()
+        g2 = sse_stream(reg, cq, last_event_id=first_id)
+        next(g2)
+        ev, _, _ = _events_with_ids(next(g2))[0]
+        assert ev == "snapshot"
+        assert reg.sse_resume_snapshots >= 1
+        g2.close()
+
+    def test_http_stream_honors_last_event_id_header(self):
+        t, reg, cq = self._setup()
+        from opentsdb_tpu.streaming.sse import sse_stream
+        g1 = sse_stream(reg, cq)
+        next(g1)
+        next(g1)  # snapshot
+        t.add_point("s.m", BASE + 700, 3.0, {"host": "h0"})
+        reg.flush()
+        _, id1, _ = _events_with_ids(next(g1))[0]
+        t.add_point("s.m", BASE + 760, 4.0, {"host": "h0"})
+        reg.flush()
+        _, id2, d2 = _events_with_ids(next(g1))[0]
+        g1.close()
+        router = HttpRpcRouter(t)
+        resp = router.handle(HttpRequest(
+            "GET", f"/api/query/continuous/{cq.id}/stream",
+            headers={"last-event-id": str(id1)}))
+        assert resp.status == 200 and resp.body_iter is not None
+        it = iter(resp.body_iter)
+        assert next(it).startswith(b"retry:")
+        ev, eid, data = _events_with_ids(next(it))[0]
+        assert (ev, eid, data) == ("windows", id2, d2)
+        resp.body_iter.close()
+        # a bogus id is ignored (snapshot), never a 400
+        resp = router.handle(HttpRequest(
+            "GET", f"/api/query/continuous/{cq.id}/stream",
+            headers={"last-event-id": "not-a-number"}))
+        assert resp.status == 200
+        it = iter(resp.body_iter)
+        next(it)
+        ev, _, _ = _events_with_ids(next(it))[0]
+        assert ev == "snapshot"
+        resp.body_iter.close()
