@@ -508,6 +508,111 @@ def bench_lifecycle(repeats: int, n_series: int = 2000,
                                    and p50_ratio <= 1.5)}
 
 
+def bench_cold(repeats: int, n_series: int = 2000,
+               span_s: int = 7200) -> dict:
+    """Aged-spilled cold-tier config: n_series x span @1s raw, a
+    demote_after=30m policy folding aged raw into the 1m tiers, then
+    spill_after=32m moving all but the freshest tier band into
+    mmap-backed cold segments (opentsdb_tpu/coldstore/) and releasing
+    the tier RAM. Compares against an identical no-spill store (tiers
+    stay in RAM). Criteria: resident RAM for AGED history (the rollup
+    tier stores) >= 5x lower than no-spill, and the p50 of a
+    boundary-spanning 1m-avg query over cold+tier+raw within 2x of
+    the all-RAM store. Sanity-checks the stitched result against the
+    no-spill answer."""
+    import shutil
+    import tempfile
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.query.model import TSQuery
+
+    cold_dir = tempfile.mkdtemp(prefix="coldbench-")
+
+    def mk(spill: bool):
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.storage.backend": "memory",
+               "tsd.rollups.enable": "true",
+               "tsd.lifecycle.enable": "true",
+               "tsd.lifecycle.demote_after": "30m",
+               "tsd.lifecycle.demote_tiers": "1m"}
+        if spill:
+            cfg.update({"tsd.lifecycle.spill_after": "32m",
+                        "tsd.coldstore.dir": cold_dir})
+        return TSDB(Config(**cfg))
+
+    def aged_bytes(tsdb):
+        """Resident bytes of the rollup tier stores — where aged
+        (demoted) history lives in RAM."""
+        info = tsdb.storage_memory_info()
+        return sum(v["resident_bytes"] for k, v in info.items()
+                   if k.startswith("rollup:"))
+
+    t_ram, t_cold = mk(False), mk(True)
+    ts = np.arange(BASE_S, BASE_S + span_s, dtype=np.int64)
+    rng = np.random.default_rng(17)
+    t0 = time.perf_counter()
+    for i in range(n_series):
+        vals = rng.normal(100, 10, span_s)
+        for t in (t_ram, t_cold):
+            t.add_points("sys.aged", ts, vals, {"host": f"h{i:05d}"})
+    ingest_s = time.perf_counter() - t0
+    now_ms = BASE_MS + span_s * 1000
+    for t in (t_ram, t_cold):
+        rep = t.lifecycle.sweep(now_ms=now_ms)
+        assert rep.get("demoted", 0) > 0, rep
+    spilled = rep.get("spilled", 0)
+    cold = t_cold.lifecycle.coldstore
+    aged_ram = aged_bytes(t_ram)
+    aged_spill = aged_bytes(t_cold)
+    total_ram = t_ram.storage_memory_info()["total"]["resident_bytes"]
+    total_spill = t_cold.storage_memory_info()["total"][
+        "resident_bytes"]
+    qobj = {"start": BASE_MS, "end": now_ms,
+            "queries": [{"metric": "sys.aged", "aggregator": "sum",
+                         "downsample": "1m-avg"}]}
+
+    def p50(tsdb):
+        tsdb.config.override_config("tsd.query.cache.enable", "false")
+        times = []
+        tsdb.execute_query(TSQuery.from_json(qobj).validate())  # warm
+        for _ in range(max(repeats, 3)):
+            t0 = time.perf_counter()
+            out = tsdb.execute_query(
+                TSQuery.from_json(qobj).validate())
+            times.append(time.perf_counter() - t0)
+        return _percentile(times, 50) * 1e3, out
+
+    cold_p50, cold_out = p50(t_cold)
+    ram_p50, ram_out = p50(t_ram)
+    d_cold, d_ram = dict(cold_out[0].dps), dict(ram_out[0].dps)
+    assert d_cold.keys() == d_ram.keys(), "stitch dropped buckets"
+    worst = max(abs(d_cold[k] - d_ram[k]) / max(abs(d_ram[k]), 1e-12)
+                for k in d_ram)
+    aged_ratio = aged_ram / max(aged_spill, 1)
+    p50_ratio = cold_p50 / max(ram_p50, 1e-3)
+    out = {"config": "cold", "series": n_series,
+           "points": n_series * span_s,
+           "ingest_mpps": round(n_series * span_s / ingest_s / 1e6,
+                                1),
+           "points_spilled": spilled,
+           "cold_segments": cold.segments_written,
+           "cold_disk_bytes": cold.cold_bytes(),
+           "aged_resident_bytes_nospill": aged_ram,
+           "aged_resident_bytes_spill": aged_spill,
+           "aged_bytes_ratio": round(aged_ratio, 1),
+           "total_resident_bytes_nospill": total_ram,
+           "total_resident_bytes_spill": total_spill,
+           "total_bytes_ratio": round(
+               total_ram / max(total_spill, 1), 2),
+           "boundary_p50_ms": round(cold_p50, 1),
+           "all_ram_p50_ms": round(ram_p50, 1),
+           "p50_ratio": round(p50_ratio, 2),
+           "stitch_worst_rel_err": float(f"{worst:.2e}"),
+           "criterion_pass": bool(aged_ratio >= 5.0
+                                  and p50_ratio <= 2.0)}
+    shutil.rmtree(cold_dir, ignore_errors=True)
+    return out
+
+
 def bench_wal(repeats: int, n_series: int = 500,
               pts_per: int = 4000) -> dict:
     """Ingest throughput with the write-ahead log off / on. 'on'
@@ -570,7 +675,7 @@ def main() -> None:
                3: lambda r: bench_config3(r, args.series3),
                4: bench_config4, 5: bench_config5,
                "wal": bench_wal, "live": bench_live,
-               "lifecycle": bench_lifecycle}
+               "lifecycle": bench_lifecycle, "cold": bench_cold}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

@@ -1,0 +1,21 @@
+"""Cold-tier columnar store: mmap-backed disk spill of demoted,
+retained history (no reference equivalent — the reference's HBase
+tables ARE its disk tier; this build owns its storage engine, so aged
+history must be spilled explicitly or RAM caps the horizon).
+
+- :mod:`opentsdb_tpu.coldstore.format` — the checksummed segment file
+  format (int32-packed timestamp column + per-stat value columns) and
+  its mmap reader
+- :mod:`opentsdb_tpu.coldstore.store` — the segment/manifest owner
+  (:class:`ColdStore`) plus the ``TimeSeriesStore``-shaped read view
+  (:class:`ColdStatView`) the three-way stitched store consumes
+
+Spilling is the lifecycle sweeper's fourth mechanism (after retention,
+demotion and compaction — :mod:`opentsdb_tpu.lifecycle.manager`);
+reads join the serve path through
+:class:`opentsdb_tpu.lifecycle.stitch.StitchedStore`.
+"""
+
+from opentsdb_tpu.coldstore.store import ColdStatView, ColdStore
+
+__all__ = ["ColdStore", "ColdStatView"]

@@ -1,0 +1,222 @@
+"""Cold-tier segment file format.
+
+One segment = one spill of one metric's demoted history for one rollup
+tier interval, written once and never mutated in place (rewrites go
+through tmpfile + atomic rename, like every other persist path in this
+build). Layout::
+
+    magic   "TSDBCOLD"                      8 bytes
+    version u32 LE                          4 bytes
+    hdr_len u32 LE                          4 bytes
+    hdr_crc u32 LE (crc32 of header json)   4 bytes
+    header  json (hdr_len bytes)
+    ts      int32 [rows]  (or int64 when header["scale"] == 0)
+    <stat>  float64 [rows]   for each stat in header["stats"]
+                             (sum / count / min / max)
+
+The header json carries the series table (sorted tag NAME pairs with
+row offsets — names, not UID ids, so a segment outlives any UID
+renumbering), the timestamp packing (``ts = base_ms + ts[i] * scale``,
+the same int32-offset scheme ``SeriesBuffer.compact`` uses; scale 0 is
+the >int32-span escape hatch and stores raw int64), and the crc32 of
+the data section (``data_crc``) so fsck can verify the columns without
+trusting the file length.
+
+Readers ``np.memmap`` the columns — a segment's resident cost is the
+pages a query actually touches, not the file. The header crc is
+verified on every open; the data crc is verified by fsck (a full
+sequential read, deliberately not paid at query time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zlib
+
+import numpy as np
+
+MAGIC = b"TSDBCOLD"
+FORMAT_VERSION = 1
+STATS = ("sum", "count", "min", "max")
+
+_PREAMBLE = len(MAGIC) + 4 + 4 + 4
+
+
+class SegmentError(ValueError):
+    """A segment file failed validation (bad magic/version/crc/shape).
+    Readers treat this as a degraded-serve condition, never a crash."""
+
+
+def pack_timestamps(ts_ms: np.ndarray) -> tuple[np.ndarray, int, int]:
+    """(column, base_ms, scale): int32 offsets at second (1000) or ms
+    (1) resolution when the span fits, raw int64 (scale 0) otherwise.
+    ``ts_ms`` need not be globally sorted (rows are per-series runs)."""
+    ts_ms = np.asarray(ts_ms, dtype=np.int64)
+    if len(ts_ms) == 0:
+        return ts_ms.astype(np.int32), 0, 1
+    base = int(ts_ms.min())
+    scale = 1000 if (base % 1000 == 0 and not (ts_ms % 1000).any()) \
+        else 1
+    span = (int(ts_ms.max()) - base) // scale
+    if span > np.iinfo(np.int32).max:
+        return ts_ms.copy(), 0, 0
+    return ((ts_ms - base) // scale).astype(np.int32), base, scale
+
+
+def write_segment(directory: str, name: str, header: dict,
+                  ts_col: np.ndarray, cols: dict[str, np.ndarray]
+                  ) -> dict:
+    """Write one segment durably (tmpfile + fsync + atomic rename).
+    ``header`` is completed in place with format/crc fields; returns
+    the manifest entry for the segment."""
+    os.makedirs(directory, exist_ok=True)
+    n = len(ts_col)
+    data_parts = [np.ascontiguousarray(ts_col).tobytes()]
+    for stat in header["stats"]:
+        col = np.ascontiguousarray(cols[stat], dtype=np.float64)
+        if len(col) != n:
+            raise SegmentError(f"stat column {stat!r} length {len(col)}"
+                               f" != {n} rows")
+        data_parts.append(col.tobytes())
+    data = b"".join(data_parts)
+    header = dict(header)
+    header["format"] = FORMAT_VERSION
+    header["rows"] = n
+    header["data_crc"] = zlib.crc32(data) & 0xFFFFFFFF
+    hdr_json = json.dumps(header, sort_keys=True).encode()
+    hdr_crc = zlib.crc32(hdr_json) & 0xFFFFFFFF
+    blob = (MAGIC
+            + FORMAT_VERSION.to_bytes(4, "little")
+            + len(hdr_json).to_bytes(4, "little")
+            + hdr_crc.to_bytes(4, "little")
+            + hdr_json + data)
+    path = os.path.join(directory, name)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".seg-")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return {"file": name, "interval": header["interval"],
+            "start_ms": header["start_ms"], "end_ms": header["end_ms"],
+            "rows": n, "bytes": len(blob),
+            "data_crc": header["data_crc"], "header_crc": hdr_crc}
+
+
+def read_header(path: str) -> tuple[dict, int]:
+    """(header, data_offset). Raises :class:`SegmentError` on any
+    structural problem — including a bad header crc."""
+    try:
+        with open(path, "rb") as fh:
+            pre = fh.read(_PREAMBLE)
+            if len(pre) < _PREAMBLE or pre[:len(MAGIC)] != MAGIC:
+                raise SegmentError(f"{path}: bad magic")
+            version = int.from_bytes(pre[8:12], "little")
+            if version != FORMAT_VERSION:
+                raise SegmentError(f"{path}: unsupported segment "
+                                   f"format {version}")
+            hdr_len = int.from_bytes(pre[12:16], "little")
+            hdr_crc = int.from_bytes(pre[16:20], "little")
+            hdr_json = fh.read(hdr_len)
+    except OSError as exc:
+        raise SegmentError(f"{path}: {exc}") from exc
+    if len(hdr_json) != hdr_len or \
+            (zlib.crc32(hdr_json) & 0xFFFFFFFF) != hdr_crc:
+        raise SegmentError(f"{path}: header checksum mismatch")
+    try:
+        header = json.loads(hdr_json)
+    except ValueError as exc:
+        raise SegmentError(f"{path}: header not json ({exc})") from exc
+    return header, _PREAMBLE + hdr_len
+
+
+class Segment:
+    """One mmapped segment: the ts column plus per-stat value columns,
+    opened read-only. Columns are ``np.memmap`` views — touching a row
+    faults in that page only."""
+
+    __slots__ = ("path", "header", "ts", "cols", "series")
+
+    def __init__(self, path: str):
+        header, off = read_header(path)
+        n = int(header["rows"])
+        ts_dtype = np.int64 if header.get("scale", 1) == 0 else np.int32
+        try:
+            size = os.path.getsize(path)
+            ts_bytes = n * np.dtype(ts_dtype).itemsize
+            need = off + ts_bytes + 8 * n * len(header["stats"])
+            if size < need:
+                raise SegmentError(
+                    f"{path}: truncated ({size} < {need} bytes)")
+            if n:
+                self.ts = np.memmap(path, dtype=ts_dtype, mode="r",
+                                    offset=off, shape=(n,))
+            else:
+                self.ts = np.empty(0, dtype=ts_dtype)
+            self.cols = {}
+            pos = off + ts_bytes
+            for stat in header["stats"]:
+                if n:
+                    self.cols[stat] = np.memmap(
+                        path, dtype=np.float64, mode="r", offset=pos,
+                        shape=(n,))
+                else:
+                    self.cols[stat] = np.empty(0, dtype=np.float64)
+                pos += 8 * n
+        except OSError as exc:
+            raise SegmentError(f"{path}: {exc}") from exc
+        self.path = path
+        self.header = header
+        # [(sorted ((tagk_name, tagv_name), ...), off, cnt)]
+        self.series = [(tuple(tuple(p) for p in e["tags"]),
+                        int(e["off"]), int(e["cnt"]))
+                       for e in header["series"]]
+
+    def ts64(self, lo: int, hi: int) -> np.ndarray:
+        """Row slice materialized as int64 ms."""
+        scale = self.header.get("scale", 1)
+        if scale == 0:
+            return np.asarray(self.ts[lo:hi], dtype=np.int64)
+        return (int(self.header["base_ms"])
+                + self.ts[lo:hi].astype(np.int64) * scale)
+
+    def row_bounds(self, off: int, cnt: int, start_ms: int,
+                   end_ms: int) -> tuple[int, int]:
+        """(lo, hi) absolute row range of one series' points within the
+        inclusive [start_ms, end_ms] window — searched in the packed
+        domain, no column materialization."""
+        scale = self.header.get("scale", 1)
+        run = self.ts[off:off + cnt]
+        if scale == 0:
+            lo = int(np.searchsorted(run, start_ms, side="left"))
+            hi = int(np.searchsorted(run, end_ms, side="right"))
+        else:
+            base = int(self.header["base_ms"])
+            # ts >= start <=> packed >= ceil((start-base)/scale)
+            lo = int(np.searchsorted(run, -((base - start_ms) // scale),
+                                     side="left"))
+            hi = int(np.searchsorted(run, (end_ms - base) // scale,
+                                     side="right"))
+        return off + lo, off + hi
+
+
+def verify_data_crc(path: str) -> bool:
+    """Full sequential read of the data section vs the header's
+    ``data_crc`` (the fsck check; query reads never pay this)."""
+    header, off = read_header(path)
+    crc = 0
+    with open(path, "rb") as fh:
+        fh.seek(off)
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return (crc & 0xFFFFFFFF) == header.get("data_crc")

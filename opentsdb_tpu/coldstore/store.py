@@ -1,0 +1,764 @@
+"""Cold-tier columnar store: mmap-backed disk spill of demoted history.
+
+The lifecycle subsystem (PR 4) bounds RAM by demoting aged raw points
+into rollup tiers — but the tiers themselves still live in process
+memory, so retained history is capped by host RAM, not disk. This
+module is the disk backend the sweeper spills COLD tier history into:
+per-metric segment files (:mod:`.format`) holding the four per-stat
+tier columns (sum/count/min/max) over an int32-packed timestamp
+column, plus a json manifest tracking every segment and each metric's
+**spill boundary** (ms, exclusive: tier cells before it live on disk,
+not in RAM).
+
+Reads go through :class:`ColdStatView` — a ``TimeSeriesStore``-shaped
+object (``bucket_reduce`` / ``materialize`` / ``materialize_padded`` /
+``count_range`` / ``delete_range``, the ``StorageBackend`` surface)
+over the mmapped columns, consumed by the three-way
+:class:`~opentsdb_tpu.lifecycle.stitch.StitchedStore` (cold segments <
+spill boundary < in-RAM tier < demotion boundary < raw tail). Series
+identity inside a segment is stored as sorted tag NAME pairs and
+resolved back to the raw store's sids at read time, so segments
+survive UID renumbering and restarts.
+
+Durability/crash ordering mirrors the demotion sweep: the segment file
+is fsynced and renamed into place first, the manifest (segment list +
+moved spill boundary) commits second in ONE atomic write, and only
+then is the spilled range deleted from the in-RAM tier stores. A crash
+at any point leaves either (a) an orphan segment file invisible to
+reads (fsck reports it) or (b) RAM duplicates of spilled cells that
+the stitched read CLIPS at the spill boundary — never a double-serve,
+never a lost range — and the next sweep's reconciliation purge
+removes them.
+
+Degradation follows the PR-1 idiom: segment writes run under the
+``coldstore.write`` fault site (a failed spill leaves the RAM copies
+authoritative), reads under ``coldstore.read`` with their own circuit
+breaker — a failed or breaker-blocked cold read degrades that query to
+tier/raw serving (partial history, never a 500) and bumps the cold
+``mutation_epoch`` so the degraded result can never be re-served from
+the result cache (entries are stored under the pre-read version).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import zlib
+from typing import Any, Sequence
+
+import numpy as np
+
+from opentsdb_tpu.coldstore import format as fmt
+from opentsdb_tpu.core.store import (PaddedBatch, PointBatch,
+                                     STORE_INSTANCE_IDS,
+                                     padded_from_batch)
+
+LOG = logging.getLogger("coldstore")
+
+MANIFEST = "manifest.json"
+SEGMENT_SUFFIX = ".cold"
+QUARANTINE_SUFFIX = ".quarantine"
+
+
+def _metric_slug(metric: str) -> str:
+    """Filesystem-safe, collision-safe metric tag for segment names."""
+    safe = re.sub(r"[^A-Za-z0-9_.\-]", "_", metric)[:80]
+    return f"{safe}-{zlib.crc32(metric.encode()) & 0xFFFFFFFF:08x}"
+
+
+class _SegmentHandle:
+    """Manifest entry + lazily-opened mmap + cached identity maps."""
+
+    __slots__ = ("entry", "_seg", "_ids", "_lock")
+
+    def __init__(self, entry: dict):
+        self.entry = entry
+        self._seg: fmt.Segment | None = None
+        # per-series sorted (tagk_id, tagv_id) tuple (or None when a
+        # tag name no longer resolves), aligned with segment.series
+        self._ids: list | None = None
+        self._lock = threading.Lock()
+
+    def open(self, directory: str) -> fmt.Segment:
+        seg = self._seg
+        if seg is None:
+            with self._lock:
+                seg = self._seg
+                if seg is None:
+                    seg = fmt.Segment(
+                        os.path.join(directory, self.entry["file"]))
+                    self._seg = seg
+        return seg
+
+    def id_map(self, directory: str, uids) -> dict:
+        """{sorted tag-id tuple: (off, cnt)} for this segment. UID
+        tables are append-only, so one resolution is cached forever."""
+        seg = self.open(directory)
+        with self._lock:
+            if self._ids is None:
+                out = {}
+                for tags, off, cnt in seg.series:
+                    try:
+                        key = tuple(sorted(
+                            (uids.tag_names.get_id(k),
+                             uids.tag_values.get_id(v))
+                            for k, v in tags))
+                    except LookupError:
+                        continue  # unresolvable identity: fsck's find
+                    out[key] = (off, cnt)
+                self._ids = out
+            return self._ids
+
+
+class ColdStatView:
+    """Read surface over one (metric, tier interval, stat): the cold
+    third of the stitched store. Takes RAW-store series ids and maps
+    them to segment rows by (metric, tags) identity, exactly like the
+    stitched store maps raw sids to tier sids.
+
+    Raises on any segment problem (missing file, bad checksum, armed
+    ``coldstore.read`` fault) — the stitched store's cold guard
+    converts that into a degraded tier/raw-only serve."""
+
+    fault_site = "coldstore.read"
+
+    def __init__(self, cold: "ColdStore", metric: str, interval: str,
+                 stat: str, raw_store):
+        self.instance_id = next(STORE_INSTANCE_IDS)
+        self.cold = cold
+        self.metric = metric
+        self.interval = interval
+        self.stat = stat
+        self.raw = raw_store
+
+    @property
+    def handles(self) -> list[_SegmentHandle]:
+        # resolved per call (cached on the ColdStore, cleared by every
+        # manifest mutation) so a long-lived stitched view never holds
+        # handles onto rewritten or quarantined segment files
+        return self.cold._handles(self.metric, self.interval)
+
+    # version surface consumed by StitchedStore / result-cache keys
+    @property
+    def points_written(self) -> int:
+        return self.cold.points_spilled
+
+    @property
+    def mutation_epoch(self) -> int:
+        return self.cold.mutation_epoch
+
+    def total_points(self) -> int:
+        return sum(h.entry["rows"] for h in self.handles)
+
+    def _check(self) -> None:
+        faults = self.cold.faults
+        if faults is not None:
+            faults.check(self.fault_site)
+
+    def _rows_for(self, handle: _SegmentHandle,
+                  sids: np.ndarray) -> list[tuple[int, int, int]]:
+        """[(position-in-sids, off, cnt)] of the requested raw series
+        present in this segment."""
+        id_map = handle.id_map(self.cold.directory, self.cold.uids)
+        out = []
+        for i, sid in enumerate(sids):
+            rec = self.raw.series(int(sid))
+            hit = id_map.get(rec.tags)
+            if hit is not None:
+                out.append((i, hit[0], hit[1]))
+        return out
+
+    def _overlapping(self, start_ms: int, end_ms: int
+                     ) -> list[_SegmentHandle]:
+        return [h for h in self.handles
+                if h.entry["start_ms"] <= end_ms
+                and h.entry["end_ms"] >= start_ms]
+
+    # -- StorageBackend read surface ------------------------------------
+
+    def count_range(self, series_ids, start_ms: int,
+                    end_ms: int) -> np.ndarray:
+        self._check()
+        sids = np.asarray(series_ids, dtype=np.int64)
+        out = np.zeros(len(sids), dtype=np.int64)
+        for h in self._overlapping(start_ms, end_ms):
+            seg = h.open(self.cold.directory)
+            for i, off, cnt in self._rows_for(h, sids):
+                lo, hi = seg.row_bounds(off, cnt, start_ms, end_ms)
+                out[i] += hi - lo
+        return out
+
+    def materialize(self, series_ids, start_ms: int,
+                    end_ms: int) -> PointBatch:
+        """Flat batch of the stat column. Segments of one metric are
+        time-disjoint and visited oldest-first, so after the stable
+        sort on the series index each series' points are
+        time-ascending (the PointBatch contract)."""
+        self._check()
+        sids = np.asarray(series_ids, dtype=np.int64)
+        idx_parts: list[np.ndarray] = []
+        ts_parts: list[np.ndarray] = []
+        val_parts: list[np.ndarray] = []
+        for h in sorted(self._overlapping(start_ms, end_ms),
+                        key=lambda h: h.entry["start_ms"]):
+            seg = h.open(self.cold.directory)
+            col = seg.cols[self.stat]
+            for i, off, cnt in self._rows_for(h, sids):
+                lo, hi = seg.row_bounds(off, cnt, start_ms, end_ms)
+                if hi > lo:
+                    idx_parts.append(np.full(hi - lo, i,
+                                             dtype=np.int32))
+                    ts_parts.append(seg.ts64(lo, hi))
+                    val_parts.append(np.asarray(col[lo:hi]))
+        if not ts_parts:
+            return PointBatch(sids, np.empty(0, dtype=np.int32),
+                              np.empty(0, dtype=np.int64),
+                              np.empty(0, dtype=np.float64))
+        series_idx = np.concatenate(idx_parts)
+        ts_ms = np.concatenate(ts_parts)
+        values = np.concatenate(val_parts)
+        order = np.argsort(series_idx, kind="stable")
+        return PointBatch(sids, series_idx[order], ts_ms[order],
+                          values[order])
+
+    def bucket_reduce(self, series_ids, start_ms: int, end_ms: int,
+                      t0: int, interval_ms: int, nbuckets: int,
+                      want_minmax: bool = False):
+        """Same fused shape as ``TimeSeriesStore.bucket_reduce``: [S,B]
+        sum/count (+min/max) grids over the stat column."""
+        batch = self.materialize(series_ids, start_ms, end_ms)
+        s = len(batch.series_ids)
+        b = (batch.ts_ms - t0) // interval_ms
+        ok = (b >= 0) & (b < nbuckets) & ~np.isnan(batch.values)
+        seg = batch.series_idx[ok].astype(np.int64) * nbuckets + b[ok]
+        vals = batch.values[ok]
+        n = s * nbuckets
+        sums = np.bincount(seg, weights=vals, minlength=n).reshape(
+            s, nbuckets)
+        cnts = np.bincount(seg, minlength=n).astype(np.float64) \
+            .reshape(s, nbuckets)
+        mins = maxs = None
+        if want_minmax:
+            mins = np.full(n, np.inf)
+            np.minimum.at(mins, seg, vals)
+            maxs = np.full(n, -np.inf)
+            np.maximum.at(maxs, seg, vals)
+            mins = mins.reshape(s, nbuckets)
+            maxs = maxs.reshape(s, nbuckets)
+        return sums, cnts, mins, maxs
+
+    def materialize_padded(self, series_ids, start_ms: int,
+                           end_ms: int) -> PaddedBatch:
+        return padded_from_batch(
+            self.materialize(series_ids, start_ms, end_ms))
+
+    def delete_range(self, series_ids, start_ms: int,
+                     end_ms: int) -> int:
+        """delete=true over cold history: segment rewrite. A cold row
+        holds ALL four stat columns of one tier cell, so deleting it
+        removes the point from every stat — the point is gone, which
+        is what delete means. Raises on failure (a delete must never
+        silently not happen)."""
+        sids = np.asarray(series_ids, dtype=np.int64)
+        identities = set()
+        for sid in sids:
+            identities.add(self.raw.series(int(sid)).tags)
+        return self.cold.delete_rows(self.metric, self.interval,
+                                     identities, start_ms, end_ms)
+
+
+class ColdStore:
+    """Segment + manifest owner for one cold directory (see module
+    docstring). Owned by the :class:`~opentsdb_tpu.lifecycle.manager.
+    LifecycleManager`; all mutation goes through the sweep or fsck."""
+
+    def __init__(self, directory: str, faults=None, uids=None,
+                 read_breaker=None):
+        self.directory = directory
+        self.faults = faults
+        self.uids = uids
+        self.read_breaker = read_breaker
+        self._lock = threading.Lock()
+        # metric -> {"spill_boundary_ms": int, "segments": [entry]}
+        self._metrics: dict[str, dict] = {}
+        # (metric, interval) -> [_SegmentHandle] (sorted by start_ms)
+        self._handle_cache: dict[tuple[str, str],
+                                 list[_SegmentHandle]] = {}
+        self.mutation_epoch = 0
+        self.points_spilled = 0
+        self.segments_written = 0
+        self.bytes_spilled = 0
+        self.spill_errors = 0
+        self.read_errors = 0
+        self.degraded_serves = 0
+        self.segments_quarantined = 0
+        self.segments_dropped = 0       # retention
+        self.points_deleted = 0         # delete=true rewrites
+        self.last_error = ""
+        self._load_manifest()
+
+    # ------------------------------------------------------------------
+    # manifest
+    # ------------------------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST)
+
+    def _load_manifest(self) -> None:
+        import json
+        path = self.manifest_path
+        if not os.path.isfile(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            # a corrupt manifest degrades to "no cold data": tier/raw
+            # serving continues, fsck reports the segments as orphans
+            LOG.warning("could not load cold manifest %s: %s", path,
+                        exc)
+            self.last_error = f"manifest: {exc}"
+            return
+        self._metrics = doc.get("metrics") or {}
+        self.points_spilled = sum(
+            e["rows"] for m in self._metrics.values()
+            for e in m.get("segments", ()))
+
+    def _save_manifest_locked(self) -> None:
+        import json
+        from opentsdb_tpu.core.persist import _atomic_write
+        os.makedirs(self.directory, exist_ok=True)
+        _atomic_write(self.manifest_path, json.dumps(
+            {"version": 1, "metrics": self._metrics},
+            sort_keys=True).encode())
+
+    # ------------------------------------------------------------------
+    # read-side lookups
+    # ------------------------------------------------------------------
+
+    def spill_boundary(self, metric: str) -> int:
+        with self._lock:
+            rec = self._metrics.get(metric)
+            return int(rec["spill_boundary_ms"]) if rec else 0
+
+    def spill_boundaries(self) -> dict[str, int]:
+        """Locked snapshot for the admin surface (a sweep may insert
+        a metric mid-request)."""
+        with self._lock:
+            return {m: int(rec["spill_boundary_ms"])
+                    for m, rec in self._metrics.items()}
+
+    def has_segments(self, metric: str, interval: str) -> bool:
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return False
+            return any(e["interval"] == interval
+                       for e in rec.get("segments", ()))
+
+    def _handles(self, metric: str, interval: str
+                 ) -> list[_SegmentHandle]:
+        key = (metric, interval)
+        with self._lock:
+            cached = self._handle_cache.get(key)
+            if cached is None:
+                rec = self._metrics.get(metric) or {}
+                cached = sorted(
+                    (_SegmentHandle(e) for e in rec.get("segments", ())
+                     if e["interval"] == interval),
+                    key=lambda h: h.entry["start_ms"])
+                self._handle_cache[key] = cached
+            return cached
+
+    def stat_view(self, metric: str, interval: str, stat: str,
+                  raw_store) -> ColdStatView:
+        return ColdStatView(self, metric, interval, stat, raw_store)
+
+    # ------------------------------------------------------------------
+    # spill (called by the lifecycle sweep, under coldstore.write)
+    # ------------------------------------------------------------------
+
+    def write_segment(self, metric: str, interval: str,
+                      series_entries: Sequence[dict],
+                      ts_ms: np.ndarray,
+                      cols: dict[str, np.ndarray]) -> dict:
+        """Write one durable segment file (NOT yet visible: the caller
+        commits it to the manifest via :meth:`commit_spill` once every
+        tier's segment of the sweep is on disk)."""
+        if self.faults is not None:
+            self.faults.check("coldstore.write")
+        ts_col, base, scale = fmt.pack_timestamps(ts_ms)
+        start = int(ts_ms.min()) if len(ts_ms) else 0
+        end = int(ts_ms.max()) if len(ts_ms) else 0
+        name = (f"{_metric_slug(metric)}-{interval}-{start}-{end}"
+                f"{SEGMENT_SUFFIX}")
+        header = {
+            "metric": metric, "interval": interval,
+            "base_ms": base, "scale": scale,
+            "start_ms": start, "end_ms": end,
+            "stats": list(fmt.STATS),
+            "series": list(series_entries),
+        }
+        return fmt.write_segment(self.directory, name, header, ts_col,
+                                 cols)
+
+    def commit_spill(self, metric: str, boundary_ms: int,
+                     entries: Sequence[dict]) -> None:
+        """Publish freshly-written segments + the moved spill boundary
+        in one atomic manifest write. After this returns, stitched
+        reads clip the in-RAM tier at the new boundary — the caller
+        may then safely purge the spilled range from RAM."""
+        with self._lock:
+            rec = self._metrics.setdefault(
+                metric, {"spill_boundary_ms": 0, "segments": []})
+            existing = {e["file"] for e in rec["segments"]}
+            for e in entries:
+                if e["file"] in existing:   # re-spill after a crash:
+                    rec["segments"] = [     # newest write wins
+                        x for x in rec["segments"]
+                        if x["file"] != e["file"]]
+                rec["segments"].append(dict(e))
+                self.segments_written += 1
+                self.points_spilled += int(e["rows"])
+                self.bytes_spilled += int(e["bytes"])
+            rec["spill_boundary_ms"] = max(
+                int(rec["spill_boundary_ms"]), int(boundary_ms))
+            self._handle_cache.clear()
+            self._save_manifest_locked()
+            self.mutation_epoch += 1
+
+    # ------------------------------------------------------------------
+    # destructive ops (delete=true, retention, fsck quarantine)
+    # ------------------------------------------------------------------
+
+    def delete_rows(self, metric: str, interval: str,
+                    identities: set, start_ms: int,
+                    end_ms: int) -> int:
+        """Remove the given series' rows within [start_ms, end_ms] by
+        rewriting every overlapping segment (cold deletes are rare
+        admin ops; a rewrite keeps the format append-only)."""
+        deleted = 0
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return 0
+            keep_entries = []
+            obsolete: list[str] = []
+            changed = False
+            for entry in rec["segments"]:
+                if entry["interval"] != interval or \
+                        entry["start_ms"] > end_ms or \
+                        entry["end_ms"] < start_ms:
+                    keep_entries.append(entry)
+                    continue
+                seg = fmt.Segment(os.path.join(self.directory,
+                                               entry["file"]))
+                removed, new_entry = self._rewrite_segment(
+                    seg, entry, identities, start_ms, end_ms)
+                deleted += removed
+                if removed == 0:
+                    keep_entries.append(entry)
+                    continue
+                if new_entry is not None:
+                    keep_entries.append(new_entry)
+                obsolete.append(entry["file"])
+                changed = True
+            if changed:
+                rec["segments"] = keep_entries
+                self._handle_cache.clear()
+                self.points_deleted += deleted
+                self.mutation_epoch += 1
+                self._save_manifest_locked()
+                # unlink the replaced files only AFTER the manifest
+                # commit: a crash before this point leaves both files
+                # on disk with the manifest still authoritative (the
+                # old rows readable, the .rw file an fsck-visible
+                # orphan) — never a referenced-but-missing segment
+                for name in obsolete:
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:  # pragma: no cover
+                        pass
+        return deleted
+
+    def _rewrite_segment(self, seg: fmt.Segment, entry: dict,
+                         identities: set, start_ms: int,
+                         end_ms: int) -> tuple[int, dict | None]:
+        """(rows removed, replacement manifest entry or None when the
+        whole segment emptied). Writes the replacement file but does
+        NOT touch the old one — the caller unlinks it after the
+        manifest commit. Caller holds the lock."""
+        uids = self.uids
+        n = int(entry["rows"])
+        keep = np.ones(n, dtype=bool)
+        for tags, off, cnt in seg.series:
+            try:
+                key = tuple(sorted((uids.tag_names.get_id(k),
+                                    uids.tag_values.get_id(v))
+                                   for k, v in tags))
+            except LookupError:
+                continue
+            if key not in identities:
+                continue
+            lo, hi = seg.row_bounds(off, cnt, start_ms, end_ms)
+            keep[lo:hi] = False
+        removed = int(n - keep.sum())
+        if removed == 0:
+            return 0, entry
+        if removed == n:
+            return removed, None
+        ts64 = seg.ts64(0, n)[keep]
+        cols = {stat: np.asarray(seg.cols[stat])[keep]
+                for stat in seg.header["stats"]}
+        series_entries = []
+        pos = np.cumsum(keep) - keep  # new row index of each old row
+        for tags, off, cnt in seg.series:
+            cnt_new = int(keep[off:off + cnt].sum())
+            if cnt_new:
+                series_entries.append({
+                    "tags": [list(p) for p in tags],
+                    "off": int(pos[off]), "cnt": cnt_new})
+        ts_col, base, scale = fmt.pack_timestamps(ts64)
+        header = {
+            "metric": entry.get("metric", seg.header["metric"]),
+            "interval": entry["interval"],
+            "base_ms": base, "scale": scale,
+            "start_ms": int(ts64.min()), "end_ms": int(ts64.max()),
+            "stats": list(seg.header["stats"]),
+            "series": series_entries,
+        }
+        # the replacement keeps the SEGMENT_SUFFIX (fsck's orphan scan
+        # matches on it) and carries a monotonic nonce so repeated
+        # rewrites never collide or accrete suffixes
+        base = entry["file"]
+        if base.endswith(SEGMENT_SUFFIX):
+            base = base[:-len(SEGMENT_SUFFIX)]
+        base = re.sub(r"-rw\d+$", "", base)
+        name = (f"{base}-rw{self.points_deleted + removed}"
+                f"{SEGMENT_SUFFIX}")
+        new_entry = fmt.write_segment(self.directory, name, header,
+                                      ts_col, cols)
+        return removed, new_entry
+
+    def drop_segments_before(self, metric: str, cutoff_ms: int) -> int:
+        """Retention for the cold tier, segment-granular: drop every
+        segment whose WHOLE range expired. Returns rows dropped."""
+        dropped = 0
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return 0
+            keep = []
+            for entry in rec["segments"]:
+                if entry["end_ms"] < cutoff_ms:
+                    dropped += int(entry["rows"])
+                    self.segments_dropped += 1
+                    path = os.path.join(self.directory, entry["file"])
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass  # already gone; manifest is authoritative
+                else:
+                    keep.append(entry)
+            if dropped:
+                rec["segments"] = keep
+                self._handle_cache.clear()
+                self.mutation_epoch += 1
+                self._save_manifest_locked()
+        return dropped
+
+    def quarantine(self, metric: str, file: str) -> bool:
+        """fsck --fix: move a corrupt segment out of the manifest (and
+        aside on disk) so reads degrade to tier/raw serving instead of
+        failing on every query."""
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return False
+            hit = [e for e in rec["segments"] if e["file"] == file]
+            if not hit:
+                return False
+            rec["segments"] = [e for e in rec["segments"]
+                               if e["file"] != file]
+            path = os.path.join(self.directory, file)
+            try:
+                if os.path.exists(path):
+                    os.replace(path, path + QUARANTINE_SUFFIX)
+            except OSError as exc:  # pragma: no cover - disk trouble
+                LOG.warning("could not quarantine %s: %s", path, exc)
+            self.segments_quarantined += 1
+            self._handle_cache.clear()
+            self.mutation_epoch += 1
+            self._save_manifest_locked()
+            return True
+
+    def clamp_boundary(self, metric: str, boundary_ms: int) -> bool:
+        """fsck --fix for a spill boundary past the demotion boundary
+        (would double-serve [demote, spill) from both cold and raw)."""
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec or rec["spill_boundary_ms"] <= boundary_ms:
+                return False
+            rec["spill_boundary_ms"] = int(boundary_ms)
+            self.mutation_epoch += 1
+            self._save_manifest_locked()
+            return True
+
+    # ------------------------------------------------------------------
+    # degradation bookkeeping (called by the stitched store's guard)
+    # ------------------------------------------------------------------
+
+    def note_read_error(self, exc: Exception) -> None:
+        self.read_errors += 1
+        self.last_error = f"{type(exc).__name__}: {exc}"
+        # the epoch bump makes any result computed during this failure
+        # stale for every later cache lookup (entries store the
+        # pre-read version) — a degraded serve can never linger
+        self.mutation_epoch += 1
+        if self.read_errors <= 5 or self.read_errors % 1000 == 0:
+            LOG.warning("cold read failed (%s); serving tier/raw only",
+                        self.last_error)
+
+    def note_degraded_serve(self) -> None:
+        self.degraded_serves += 1
+        self.mutation_epoch += 1
+
+    # ------------------------------------------------------------------
+    # fsck surface
+    # ------------------------------------------------------------------
+
+    def fsck_scan(self, demote_boundaries: dict[str, int]
+                  ) -> list[dict]:
+        """Integrity findings: [{metric, file|None, problem,
+        fixable}]. ``demote_boundaries`` maps metric name -> lifecycle
+        demotion boundary (from ``lifecycle.json``)."""
+        findings: list[dict] = []
+        with self._lock:
+            metrics = {m: dict(rec, segments=list(rec["segments"]))
+                       for m, rec in self._metrics.items()}
+        listed: set[str] = set()
+        for metric, rec in metrics.items():
+            spill_b = int(rec["spill_boundary_ms"])
+            demote_b = demote_boundaries.get(metric)
+            if spill_b and demote_b is None:
+                # lifecycle.json lost or the metric UID unresolvable:
+                # clamping to 0 here would cascade into quarantining
+                # every (healthy) segment — report only, the operator
+                # restores lifecycle.json (serving already clamps the
+                # stitch, so nothing double-serves meanwhile)
+                findings.append({
+                    "metric": metric, "file": None, "fix": "report",
+                    "problem": (
+                        "spill boundary set but the metric has no "
+                        "demotion boundary (lifecycle.json missing "
+                        "or stale?) — cold history is unreachable "
+                        "until it is restored")})
+            elif demote_b is not None and spill_b > int(demote_b):
+                findings.append({
+                    "metric": metric, "file": None, "fix": "clamp",
+                    "problem": (
+                        f"spill boundary {spill_b} is past the "
+                        f"demotion boundary {demote_b} — the range "
+                        "between them would be double-served"),
+                    "boundary": int(demote_b)})
+            for entry in rec["segments"]:
+                listed.add(entry["file"])
+                path = os.path.join(self.directory, entry["file"])
+                problem = None
+                if not os.path.isfile(path):
+                    problem = "segment file missing"
+                else:
+                    try:
+                        fmt.Segment(path)
+                        if not fmt.verify_data_crc(path):
+                            problem = "data checksum mismatch"
+                    except fmt.SegmentError as exc:
+                        problem = str(exc)
+                if problem is None and entry["end_ms"] >= spill_b:
+                    problem = (f"segment range ends at "
+                               f"{entry['end_ms']} >= spill boundary "
+                               f"{spill_b}")
+                if problem is not None:
+                    findings.append({"metric": metric,
+                                     "file": entry["file"],
+                                     "fix": "quarantine",
+                                     "problem": problem})
+        try:
+            on_disk = os.listdir(self.directory)
+        except OSError:
+            on_disk = []
+        for name in on_disk:
+            if name.endswith(SEGMENT_SUFFIX) and name not in listed:
+                findings.append({
+                    "metric": "", "file": name, "fix": "orphan",
+                    "problem": "segment file not in manifest "
+                               "(interrupted spill)"})
+        return findings
+
+    def remove_orphan(self, file: str) -> None:
+        path = os.path.join(self.directory, file)
+        try:
+            os.replace(path, path + QUARANTINE_SUFFIX)
+        except OSError:  # pragma: no cover - disk trouble
+            pass
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def cold_bytes(self) -> int:
+        with self._lock:
+            return sum(int(e["bytes"])
+                       for rec in self._metrics.values()
+                       for e in rec.get("segments", ()))
+
+    def memory_info(self) -> dict:
+        with self._lock:
+            segs = [e for rec in self._metrics.values()
+                    for e in rec.get("segments", ())]
+            return {
+                "series": 0,  # identity lives in the raw store
+                "points": sum(int(e["rows"]) for e in segs),
+                "segments": len(segs),
+                "disk_bytes": sum(int(e["bytes"]) for e in segs),
+                "resident_bytes": 0,  # mmap: pages are reclaimable
+                "live_bytes": 0, "dead_bytes": 0,
+            }
+
+    def counters(self) -> dict[str, Any]:
+        return {
+            "segmentsWritten": self.segments_written,
+            "segmentsQuarantined": self.segments_quarantined,
+            "segmentsDropped": self.segments_dropped,
+            "pointsSpilled": self.points_spilled,
+            "pointsDeleted": self.points_deleted,
+            "bytesSpilled": self.bytes_spilled,
+            "coldBytes": self.cold_bytes(),
+            "spillErrors": self.spill_errors,
+            "readErrors": self.read_errors,
+            "degradedServes": self.degraded_serves,
+            "lastError": self.last_error,
+        }
+
+    def health_info(self) -> dict[str, Any]:
+        doc = {"enabled": True, "dir": self.directory,
+               **self.counters()}
+        if self.read_breaker is not None:
+            doc["breaker"] = self.read_breaker.health_info()
+        return doc
+
+    def collect_stats(self, collector) -> None:
+        collector.record("coldstore.segments.written",
+                         self.segments_written)
+        collector.record("coldstore.segments.quarantined",
+                         self.segments_quarantined)
+        collector.record("coldstore.points.spilled",
+                         self.points_spilled)
+        collector.record("coldstore.bytes", self.cold_bytes())
+        collector.record("coldstore.spill_errors", self.spill_errors)
+        collector.record("coldstore.read_errors", self.read_errors)
+        collector.record("coldstore.degraded_serves",
+                         self.degraded_serves)

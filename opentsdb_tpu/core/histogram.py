@@ -251,6 +251,32 @@ class HistogramArena:
             for i in range(sub.n):
                 yield int(ts[i]), int(sid[i]), sub.bounds, rows[i]
 
+    def purge_before(self, cutoff_ms: int) -> int:
+        """Lifecycle retention: drop every point with ts < cutoff_ms,
+        shrinking the arrays to fit. Returns points removed. MUST run
+        under the owning TSDB's ``_histogram_lock`` (the same contract
+        as append/snapshot); the filtered arrays REPLACE the old ones,
+        so previously captured snapshot views stay intact."""
+        removed = 0
+        for key in list(self.groups):
+            sub = self.groups[key]
+            keep = sub.ts[:sub.n] >= cutoff_ms
+            kept = int(keep.sum())
+            if kept == sub.n:
+                continue
+            removed += sub.n - kept
+            if kept == 0:
+                del self.groups[key]
+                continue
+            sub.ts = sub.ts[:sub.n][keep].copy()
+            sub.sid = sub.sid[:sub.n][keep].copy()
+            sub.rows = sub.rows[:sub.n][keep].copy()
+            sub.under = sub.under[:sub.n][keep].copy()
+            sub.over = sub.over[:sub.n][keep].copy()
+            sub.n = kept
+        self.total_points -= removed
+        return removed
+
 
 class HistogramCodec:
     """Codec ABI (ref: ``HistogramDataPointCodec.java``)."""

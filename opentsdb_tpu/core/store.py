@@ -310,6 +310,27 @@ class PaddedBatch(NamedTuple):
         return int(self.counts.sum())
 
 
+def padded_from_batch(batch: PointBatch) -> PaddedBatch:
+    """Row-pad a flat :class:`PointBatch` (series_idx grouped,
+    per-series time-ascending — the materialize contract). Shared by
+    the read views that build their padded form from a merged flat
+    batch (stitched store, cold stat view)."""
+    s = len(batch.series_ids)
+    counts = np.bincount(batch.series_idx, minlength=s) \
+        .astype(np.int64) if s else np.empty(0, dtype=np.int64)
+    pmax = max(1, int(counts.max())) if s else 1
+    values2d = np.full((s, pmax), np.nan)
+    ts2d = np.zeros((s, pmax), dtype=np.int64)
+    if batch.num_points:
+        row_starts = np.zeros(s, dtype=np.int64)
+        np.cumsum(counts[:-1], out=row_starts[1:])
+        col = np.arange(batch.num_points, dtype=np.int64) \
+            - np.repeat(row_starts, counts)
+        values2d[batch.series_idx, col] = batch.values
+        ts2d[batch.series_idx, col] = batch.ts_ms
+    return PaddedBatch(batch.series_ids, values2d, ts2d, counts)
+
+
 class StorageBackend(Protocol):
     """The storage swap point (ref: build-bigtable.sh / build-cassandra.sh)."""
 

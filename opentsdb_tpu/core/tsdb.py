@@ -802,6 +802,24 @@ class TSDB:
         self.datapoints_added += 1
         return sid
 
+    def purge_histograms_before(self, metric_id: int,
+                                cutoff_ms: int) -> int:
+        """Lifecycle retention for histogram arenas: drop one metric's
+        histogram points older than the cutoff and bump the histogram
+        version + store epoch so every read-side cache (result cache,
+        streaming plans) invalidates. Returns points removed."""
+        with self._histogram_lock:
+            arena = self._histogram_arenas.get(metric_id)
+            if arena is None:
+                return 0
+            removed = arena.purge_before(cutoff_ms)
+            if removed:
+                if not arena.groups:
+                    del self._histogram_arenas[metric_id]
+                self._histogram_version += 1
+                self.histogram_store.mutation_epoch += 1
+        return removed
+
     # ------------------------------------------------------------------
     # read path entry (ref: TSDB.java newQuery :963)
     # ------------------------------------------------------------------
@@ -960,11 +978,20 @@ class TSDB:
                 if hasattr(store, "memory_info"):
                     out[f"rollup:{interval}:{agg}"] = \
                         store.memory_info()
+        # cold tier: disk-resident mmap segments, reported separately
+        # from RAM (the whole point is that they are NOT resident)
+        lc = self._lifecycle
+        cold = getattr(lc, "coldstore", None) if lc is not None \
+            else None
+        if cold is not None:
+            out["cold"] = cold.memory_info()
         totals = {"resident_bytes": 0, "live_bytes": 0,
                   "dead_bytes": 0, "series": 0, "points": 0}
         for info in out.values():
             for k in totals:
                 totals[k] += info.get(k, 0)
+        totals["cold_bytes"] = (out["cold"]["disk_bytes"]
+                                if cold is not None else 0)
         out["total"] = totals
         return out
 
@@ -1079,6 +1106,11 @@ class TSDB:
         self.uids.tag_names.collect_stats(collector)
         self.uids.tag_values.collect_stats(collector)
         self.store.collect_stats(collector)
+        lc = self._lifecycle
+        cold = getattr(lc, "coldstore", None) if lc is not None \
+            else None
+        collector.record("storage.cold_bytes",
+                         cold.cold_bytes() if cold is not None else 0)
         collector.record("datapoints.added", self.datapoints_added)
         for hook, n in sorted(self.hook_errors.items()):
             collector.record("hooks.errors", n, hook=hook)

@@ -1,15 +1,19 @@
-"""Data-lifecycle subsystem: retention, age-based rollup demotion and
-store compaction (no reference equivalent — the reference delegates all
-of this to HBase TTLs and region compaction, SURVEY.md §5.4).
+"""Data-lifecycle subsystem: retention, age-based rollup demotion,
+store compaction and cold-tier disk spill (no reference equivalent —
+the reference delegates all of this to HBase TTLs and region
+compaction, SURVEY.md §5.4).
 
 - :mod:`opentsdb_tpu.lifecycle.policy` — per-metric policies
   (``tsd.lifecycle.*`` keys + the ``/api/lifecycle`` admin surface)
 - :mod:`opentsdb_tpu.lifecycle.manager` — the background sweeper:
-  retention purge, age-based demotion into rollup tiers, buffer
-  compaction, post-sweep snapshot + WAL truncation
+  retention purge (raw + tiers + histogram arenas + cold segments),
+  age-based demotion into rollup tiers, buffer compaction, cold-tier
+  spill (:mod:`opentsdb_tpu.coldstore`), post-sweep snapshot + WAL
+  truncation
 - :mod:`opentsdb_tpu.lifecycle.stitch` — the read-side stitched store
-  that serves tier history before the demotion boundary and the raw
-  tail after it through one `TimeSeriesStore`-shaped view
+  that serves cold mmap segments before the spill boundary, tier
+  history before the demotion boundary and the raw tail after it
+  through one `TimeSeriesStore`-shaped view
 """
 
 from opentsdb_tpu.lifecycle.policy import LifecyclePolicy, PolicySet

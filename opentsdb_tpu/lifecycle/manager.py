@@ -20,6 +20,24 @@ One sweep, per metric with a policy (:mod:`.policy`):
    shrunk-to-fit with timestamps packed to int32 offsets where
    lossless (:meth:`opentsdb_tpu.core.store.SeriesBuffer.compact`),
    and fully-expired (ghost) series release their buffers.
+4. **cold spill** — demoted tier history older than the per-metric
+   ``spill_after`` horizon is written into mmap-backed columnar
+   segment files (:mod:`opentsdb_tpu.coldstore`) and the spilled
+   range is deleted from the in-RAM tier stores. Ordering mirrors
+   demotion: the segment files are made durable first, the manifest
+   (segment list + moved spill boundary) commits atomically second —
+   from that moment stitched reads clip the RAM tier at the new
+   boundary — and the RAM purge runs last, so a crash anywhere
+   leaves either an invisible orphan file or clipped RAM duplicates
+   that the next sweep's reconciliation purge removes; never a
+   double-serve or a lost range. Segment writes run under the
+   ``coldstore.write`` fault site: a failed spill leaves the RAM
+   copies authoritative.
+
+Retention (1) also covers histogram arenas (points past the TTL are
+purged from the columnar arenas under the ``lifecycle.histogram``
+fault site) and the cold store (whole segments whose range fully
+expired are dropped).
 
 Every sweep that removed or demoted data bumps the raw store's
 ``mutation_epoch`` (the PR-2 result cache and PR-3 streaming plans
@@ -96,6 +114,30 @@ class LifecycleManager:
             if threshold > 0 else None
         if self.breaker is not None:
             tsdb.stats.register(self.breaker)
+        # cold-tier disk store (opentsdb_tpu/coldstore/): the manifest
+        # lives next to lifecycle.json by default; tsd.coldstore.dir
+        # overrides, tsd.coldstore.enable=false opts out. With no
+        # directory at all there is nowhere to spill — the spill
+        # mechanism stays off and everything else works as before.
+        self.coldstore = None
+        cold_dir = cfg.get_string("tsd.coldstore.dir", "")
+        if not cold_dir and getattr(tsdb, "data_dir", ""):
+            import os
+            cold_dir = os.path.join(tsdb.data_dir, "coldstore")
+        if cold_dir and cfg.get_bool("tsd.coldstore.enable", True):
+            from opentsdb_tpu.coldstore import ColdStore
+            cb_threshold = cfg.get_int(
+                "tsd.coldstore.breaker.failure_threshold", 3)
+            read_breaker = CircuitBreaker(
+                "coldstore.read", failure_threshold=cb_threshold,
+                reset_timeout_ms=cfg.get_float(
+                    "tsd.coldstore.breaker.reset_timeout_ms",
+                    60000.0)) if cb_threshold > 0 else None
+            if read_breaker is not None:
+                tsdb.stats.register(read_breaker)
+            self.coldstore = ColdStore(
+                cold_dir, faults=getattr(tsdb, "faults", None),
+                uids=tsdb.uids, read_breaker=read_breaker)
         # one sweep at a time (admin POST vs the interval thread)
         self._sweep_lock = threading.Lock()
         self._lock = threading.Lock()
@@ -124,6 +166,8 @@ class LifecycleManager:
         self.tier_points_written = 0
         self.bytes_reclaimed = 0
         self.series_released = 0
+        self.points_spilled = 0
+        self.histogram_points_purged = 0
         self.last_sweep_duration_ms = 0.0
         self.last_sweep_time = 0.0
         self.last_error = ""
@@ -183,22 +227,67 @@ class LifecycleManager:
         with self._lock:
             return metric_id in self._first_demotions
 
+    def has_cold(self, metric_id: int, interval: str) -> bool:
+        """Whether cold segments exist for this (metric, tier) — tier
+        selection must treat that as tier data even when the in-RAM
+        tier store was fully spilled and emptied."""
+        cold = self.coldstore
+        if cold is None:
+            return False
+        try:
+            name = self.tsdb.uids.metrics.get_name(metric_id)
+        except LookupError:
+            return False
+        return cold.has_segments(name, interval)
+
     def stitched(self, metric_id: int, interval: str, agg: str,
                  tier_store) -> StitchedStore | None:
         """The cached stitched view for one (metric, tier, agg), or
         None when the metric has no demotion boundary (plain tier
-        serving stays untouched)."""
+        serving stays untouched). When the metric has cold segments
+        for this tier, the view gets the cold third (spill boundary +
+        mmap read view). The cache revalidates on ONE cold
+        mutation-epoch read — every cold mutation (spill commit,
+        quarantine, delete rewrite, boundary clamp) bumps it, so the
+        full name-resolve + boundary lookup only runs when something
+        actually changed."""
+        cold = self.coldstore
+        cold_epoch = cold.mutation_epoch if cold is not None else 0
         with self._lock:
             boundary = self._boundaries.get(metric_id, 0)
             if not boundary:
                 return None
             key = (metric_id, interval, agg)
             st = self._stitched.get(key)
-            if st is None or st.boundary_ms != boundary \
-                    or st.tier is not tier_store:
-                st = StitchedStore(self.tsdb.store, tier_store,
-                                   metric_id, boundary, agg)
-                self._stitched[key] = st
+            if st is not None and st.boundary_ms == boundary \
+                    and st.tier is tier_store \
+                    and getattr(st, "cold_epoch", 0) == cold_epoch:
+                return st
+        spill_b = 0
+        cold_view = None
+        if cold is not None:
+            try:
+                name = self.tsdb.uids.metrics.get_name(metric_id)
+            except LookupError:
+                name = None
+            if name is not None:
+                spill_b = cold.spill_boundary(name)
+                if spill_b and cold.has_segments(name, interval):
+                    cold_view = cold.stat_view(name, interval, agg,
+                                               self.tsdb.store)
+                else:
+                    spill_b = 0
+        with self._lock:
+            boundary = self._boundaries.get(metric_id, 0)
+            if not boundary:
+                return None
+            st = StitchedStore(self.tsdb.store, tier_store,
+                               metric_id, boundary, agg,
+                               cold=cold_view,
+                               spill_boundary_ms=spill_b,
+                               cold_store=cold)
+            st.cold_epoch = cold_epoch
+            self._stitched[key] = st
             return st
 
     # ------------------------------------------------------------------
@@ -215,6 +304,7 @@ class LifecycleManager:
         report: dict[str, Any] = {
             "purged": 0, "demoted": 0, "tierPointsWritten": 0,
             "bytesReclaimed": 0, "seriesReleased": 0, "metrics": 0,
+            "spilled": 0, "histogramPurged": 0,
         }
         try:
             if self.breaker is not None and not self.breaker.allow():
@@ -264,6 +354,9 @@ class LifecycleManager:
             tier_stores.append(rs.preagg_store())
             for ts_store in tier_stores:
                 mids.update(ts_store.metric_ids())
+        # histogram-only metrics need their arena TTL applied too
+        with t._histogram_lock:
+            mids.update(t._histogram_arenas.keys())
         name_of = {}
         for mid in mids:
             try:
@@ -278,11 +371,14 @@ class LifecycleManager:
                               dtype=np.int64)
             report["metrics"] += 1
             if pol.retention_ms:
-                changed |= self._retention(mid, sids, pol, now_ms,
-                                           report)
+                changed |= self._retention(mid, metric, sids, pol,
+                                           now_ms, report)
             if pol.demote_after_ms and t.rollup_store is not None:
                 changed |= self._demote(mid, metric, sids, pol,
                                         now_ms, report)
+            if pol.spill_after_ms and t.rollup_store is not None:
+                changed |= self._spill(mid, metric, pol, now_ms,
+                                       report)
             # pack only COLD buffers (newest point behind the
             # metric's lifecycle horizon): packing a live tail just
             # buys an unpack copy on the next append
@@ -303,14 +399,32 @@ class LifecycleManager:
                 # resurrect expired points
                 t.flush()
 
-    def _retention(self, mid: int, sids: np.ndarray,
+    def _retention(self, mid: int, metric: str, sids: np.ndarray,
                    pol: LifecyclePolicy, now_ms: int,
                    report: dict) -> bool:
         cutoff = now_ms - pol.retention_ms
         if cutoff <= 0:
             return False
-        store = self.tsdb.store
+        t = self.tsdb
+        store = t.store
         purged = store.delete_range(sids, 1, cutoff - 1)
+        # histogram arenas share the metric's TTL (ROADMAP item);
+        # own fault site so a broken arena purge is observable —
+        # the sweep's never-raise contract keeps ingest unaffected
+        faults = getattr(t, "faults", None)
+        if faults is not None:
+            faults.check("lifecycle.histogram")
+        hist_purged = t.purge_histograms_before(mid, cutoff)
+        if hist_purged:
+            self.histogram_points_purged += hist_purged
+            report["histogramPurged"] += hist_purged
+        # cold segments are retention-managed too, whole-segment
+        # granular: drop only segments whose entire range expired
+        # (end_ms < cutoff matches the inclusive raw purge of
+        # [1, cutoff-1])
+        if self.coldstore is not None:
+            purged += self.coldstore.drop_segments_before(metric,
+                                                          cutoff)
         rs = self.tsdb.rollup_store
         if rs is not None:
             config = self.tsdb.rollup_config
@@ -337,7 +451,7 @@ class LifecycleManager:
         if purged:
             self.points_purged += purged
             report["purged"] += purged
-        return purged > 0
+        return purged > 0 or hist_purged > 0
 
     def _demote(self, mid: int, metric: str, sids: np.ndarray,
                 pol: LifecyclePolicy, now_ms: int,
@@ -401,6 +515,207 @@ class LifecycleManager:
                  dropped, metric,
                  "/".join(iv.interval for iv in tiers), boundary)
         return True
+
+    def _spill(self, mid: int, metric: str, pol: LifecyclePolicy,
+               now_ms: int, report: dict) -> bool:
+        """Mechanism 4: spill demoted tier history older than the
+        spill horizon into cold segment files, then release the RAM
+        (see module docstring for the crash ordering)."""
+        cold = self.coldstore
+        t = self.tsdb
+        if cold is None:
+            return False
+        boundary = self.demote_boundary(mid)
+        if not boundary:
+            return False  # only demoted history spills
+        config = t.rollup_config
+        tiers = [config.get_interval(iv) for iv in pol.demote_tiers] \
+            if pol.demote_tiers else list(config.intervals)
+        if not tiers:
+            return False
+        prev = cold.spill_boundary(metric)
+        changed = False
+        if prev:
+            # reconciliation: RAM duplicates of already-spilled ranges
+            # (crash between manifest commit and tier purge, or WAL
+            # replay resurrection) are invisible to stitched reads —
+            # the clip at the spill boundary hides them — but still
+            # hold RAM; purge them here so restarts converge. Only
+            # ranges COVERED by cold segments are purged: a tier
+            # newly added to the policy has un-spilled history below
+            # the boundary that must not be deleted without a disk
+            # copy.
+            changed = self._purge_spilled_ranges(mid, metric,
+                                                 tiers) > 0
+        coarse_ms = max(iv.interval_ms for iv in tiers)
+        target = now_ms - pol.spill_after_ms
+        new_b = min(target - target % coarse_ms, boundary)
+        if new_b <= prev:
+            return changed
+        entries: list[dict] = []
+        spilled_rows = 0
+        for iv in tiers:
+            # a tier with no cold segments yet (first spill, or newly
+            # added to the policy after spills began) spills its WHOLE
+            # history below the new boundary — starting at prev would
+            # strand its older cells behind the clip, unservable and
+            # never written to disk
+            lo = max(prev, 1) \
+                if cold.has_segments(metric, iv.interval) else 1
+            data = self._gather_tier_history(mid, iv.interval, lo,
+                                             new_b - 1)
+            if data is None:
+                continue
+            series_entries, ts_ms, cols = data
+            try:
+                # runs under the coldstore.write fault site; a raise
+                # here aborts the spill with the RAM copies intact
+                # (nothing committed to the manifest yet) and is
+                # counted by the sweep's error handler
+                entry = cold.write_segment(metric, iv.interval,
+                                           series_entries, ts_ms,
+                                           cols)
+            except Exception:
+                cold.spill_errors += 1
+                raise
+            entries.append(entry)
+            spilled_rows += len(ts_ms)
+        if not entries:
+            # nothing cold yet: leave the boundary so a later backlog
+            # spill isn't clipped away by an empty range
+            return changed
+        # segments are durable: publish them + the moved boundary in
+        # one atomic manifest write, THEN release the RAM copies
+        cold.commit_spill(metric, new_b, entries)
+        with self._lock:
+            for key in [k for k in self._stitched if k[0] == mid]:
+                del self._stitched[key]
+        # release the RAM copies — only of ranges the (now committed)
+        # segments actually cover
+        self._purge_spilled_ranges(mid, metric, tiers)
+        # the purge only drops the points: the tier buffers keep their
+        # grown capacity until compacted — and releasing that RAM is
+        # the whole point of the spill
+        self._compact_tiers(mid, tiers, new_b, report)
+        self.points_spilled += spilled_rows
+        report["spilled"] += spilled_rows
+        LOG.info("spilled %d tier points of %s to cold segments "
+                 "(spill boundary %d)", spilled_rows, metric, new_b)
+        return True
+
+    def _purge_spilled_ranges(self, mid: int, metric: str,
+                              tiers) -> int:
+        """Delete one metric's in-RAM tier cells wherever cold
+        segments cover them: per tier interval, [1, max segment
+        end_ms]. Strictly safe — only RAM that is duplicated on disk
+        is ever released (a tier backfilled after its spill loses the
+        backfill here, the same documented divergence as writes
+        backfilled behind the demotion boundary: the clip already
+        hides them). Returns points removed."""
+        cold = self.coldstore
+        rs = self.tsdb.rollup_store
+        purged = 0
+        for iv in tiers:
+            handles = cold._handles(metric, iv.interval)
+            if not handles:
+                continue
+            hi = max(h.entry["end_ms"] for h in handles)
+            for agg in _TIER_AGGS:
+                st = rs._tiers.get((iv.interval, agg))
+                if st is None:
+                    continue
+                tsids = st.series_ids_for_metric(mid)
+                if len(tsids):
+                    purged += st.delete_range(tsids, 1, hi)
+        return purged
+
+    def _compact_tiers(self, mid: int, tiers, spill_b: int,
+                       report: dict) -> None:
+        """Shrink-to-fit the spilled metric's tier buffers (capacity
+        survives delete_range). ``pack_before_ms=spill_b`` keeps the
+        still-growing tier band unpacked — the next demotion appends
+        to it."""
+        if not self.compact_enabled:
+            return
+        rs = self.tsdb.rollup_store
+        for iv in tiers:
+            for agg in _TIER_AGGS:
+                st = rs._tiers.get((iv.interval, agg))
+                if st is None or not hasattr(st, "compact_series"):
+                    continue
+                tsids = st.series_ids_for_metric(mid)
+                if len(tsids) == 0:
+                    continue
+                reclaimed, released = st.compact_series(
+                    tsids, pack_ts=self.pack_timestamps,
+                    pack_before_ms=spill_b)
+                if reclaimed:
+                    self.bytes_reclaimed += reclaimed
+                    report["bytesReclaimed"] += reclaimed
+                if released:
+                    self.series_released += released
+                    report["seriesReleased"] += released
+
+    def _gather_tier_history(self, mid: int, interval: str,
+                             start_ms: int, end_ms: int):
+        """Columnar spill payload for one (metric, tier interval):
+        ``(series_entries, ts_ms, {stat: column})`` over
+        [start_ms, end_ms], or None when the window holds nothing.
+        Per series, the timestamp set is the union across the four
+        stat stores (the rollup job writes all four for every cell,
+        but external writers may not) with missing stats as NaN —
+        which every read path already skips."""
+        t = self.tsdb
+        rs = t.rollup_store
+        uids = t.uids
+        stores = {agg: st for agg in _TIER_AGGS
+                  if (st := rs._tiers.get((interval, agg)))
+                  is not None}
+        if not stores:
+            return None
+        per_series: dict[tuple, dict] = {}
+        for agg, st in stores.items():
+            for sid in np.asarray(
+                    st.series_ids_for_metric(mid)).tolist():
+                rec = st.series(int(sid))
+                ts, vals = rec.buffer.slice_range(start_ms, end_ms)
+                if len(ts):
+                    per_series.setdefault(rec.tags, {})[agg] = \
+                        (ts.copy(), vals.copy())
+        if not per_series:
+            return None
+        series_entries: list[dict] = []
+        ts_parts: list[np.ndarray] = []
+        col_parts: dict[str, list] = {agg: [] for agg in _TIER_AGGS}
+        off = 0
+        for tags in sorted(per_series):
+            try:
+                names = sorted((uids.tag_names.get_name(k),
+                                uids.tag_values.get_name(v))
+                               for k, v in tags)
+            except LookupError:
+                continue  # unresolvable identity stays in RAM
+            stats = per_series[tags]
+            ts_u = stats[next(iter(stats))][0]
+            for agg, (ts_a, _vals) in stats.items():
+                if not np.array_equal(ts_a, ts_u):
+                    ts_u = np.union1d(ts_u, ts_a)
+            n = len(ts_u)
+            for agg in _TIER_AGGS:
+                col = np.full(n, np.nan)
+                if agg in stats:
+                    ts_a, vals_a = stats[agg]
+                    col[np.searchsorted(ts_u, ts_a)] = vals_a
+                col_parts[agg].append(col)
+            ts_parts.append(ts_u)
+            series_entries.append({"tags": [list(p) for p in names],
+                                   "off": off, "cnt": n})
+            off += n
+        if not series_entries:
+            return None
+        return (series_entries, np.concatenate(ts_parts),
+                {agg: np.concatenate(col_parts[agg])
+                 for agg in _TIER_AGGS})
 
     def _publish_boundary(self, mid: int, boundary: int) -> None:
         with self._lock:
@@ -556,6 +871,9 @@ class LifecycleManager:
         }
         if self.breaker is not None:
             doc["breaker"] = self.breaker.health_info()
+        if self.coldstore is not None:
+            doc["coldstore"] = self.coldstore.health_info()
+            doc["spillBoundaries"] = self.coldstore.spill_boundaries()
         return doc
 
     def _counters(self) -> dict[str, Any]:
@@ -567,6 +885,8 @@ class LifecycleManager:
             "tierPointsWritten": self.tier_points_written,
             "bytesReclaimed": self.bytes_reclaimed,
             "seriesReleased": self.series_released,
+            "pointsSpilled": self.points_spilled,
+            "histogramPointsPurged": self.histogram_points_purged,
             "lastSweepDurationMs": round(self.last_sweep_duration_ms,
                                          1),
             "lastSweepTime": int(self.last_sweep_time),
@@ -577,6 +897,8 @@ class LifecycleManager:
         doc = {"enabled": True, **self._counters()}
         if self.breaker is not None:
             doc["breaker"] = self.breaker.health_info()
+        if self.coldstore is not None:
+            doc["coldstore"] = self.coldstore.health_info()
         return doc
 
     def collect_stats(self, collector) -> None:
@@ -591,5 +913,11 @@ class LifecycleManager:
                          self.bytes_reclaimed)
         collector.record("lifecycle.series.released",
                          self.series_released)
+        collector.record("lifecycle.points.spilled",
+                         self.points_spilled)
+        collector.record("lifecycle.histogram_points.purged",
+                         self.histogram_points_purged)
         collector.record("lifecycle.sweep.duration_ms",
                          self.last_sweep_duration_ms)
+        if self.coldstore is not None:
+            self.coldstore.collect_stats(collector)

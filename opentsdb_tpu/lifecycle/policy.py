@@ -1,15 +1,18 @@
 """Per-metric data-lifecycle policies.
 
-A policy says how long a metric's raw points live (``retention``), when
-raw history is demoted into the configured rollup tiers
-(``demote_after``) and which tiers receive it (``demote_tiers``).
-Policies come from two places, lowest precedence first:
+A policy says how long a metric's raw points live (``retention``),
+when raw history is demoted into the configured rollup tiers
+(``demote_after``, ``demote_tiers``) and when demoted tier history is
+spilled from RAM into the mmap-backed cold store (``spill_after``,
+:mod:`opentsdb_tpu.coldstore`). Policies come from two places, lowest
+precedence first:
 
 1. config keys (read once at manager construction)::
 
        tsd.lifecycle.retention       = 90d        # default policy
        tsd.lifecycle.demote_after    = 6h
        tsd.lifecycle.demote_tiers    = 1m,1h
+       tsd.lifecycle.spill_after     = 7d
        tsd.lifecycle.policy.sys.cpu.retention    = 30d   # per metric
        tsd.lifecycle.policy.sys.cpu.demote_after = 1h
 
@@ -17,7 +20,7 @@ Policies come from two places, lowest precedence first:
 
        {"policies": [{"metric": "*", "retention": "90d"},
                      {"metric": "sys.cpu", "demoteAfter": "1h",
-                      "demoteTiers": ["1m"]}]}
+                      "demoteTiers": ["1m"], "spillAfter": "2d"}]}
 
 The metric name ``*`` is the default policy; an exact metric name
 overrides it wholesale (no field-level merging — the resolved policy is
@@ -34,7 +37,8 @@ from typing import Any, Iterable
 from opentsdb_tpu.query.model import BadRequestError
 from opentsdb_tpu.utils import datetime_util
 
-_KNOBS = ("retention", "demote_after", "demote_tiers")
+_KNOBS = ("retention", "demote_after", "demote_tiers",
+          "spill_after")
 
 
 def _parse_duration(value: str, what: str) -> int:
@@ -54,12 +58,14 @@ class LifecyclePolicy:
     """One metric's lifecycle rules (``metric == '*'`` is the
     default). ``retention_ms == 0`` keeps points forever;
     ``demote_after_ms == 0`` never demotes; empty ``demote_tiers``
-    means every configured rollup tier."""
+    means every configured rollup tier; ``spill_after_ms == 0`` keeps
+    demoted history in RAM forever."""
 
     metric: str
     retention_ms: int = 0
     demote_after_ms: int = 0
     demote_tiers: tuple[str, ...] = field(default_factory=tuple)
+    spill_after_ms: int = 0
 
     @property
     def active(self) -> bool:
@@ -73,6 +79,27 @@ class LifecyclePolicy:
                 f"({self.demote_after_ms} ms) must be shorter than "
                 f"retention ({self.retention_ms} ms) — demoted history "
                 "would be purged the moment it lands in the tiers")
+        if self.spill_after_ms:
+            if not self.demote_after_ms:
+                raise BadRequestError(
+                    f"policy for {self.metric!r}: spill_after needs "
+                    "demote_after — only demoted tier history spills "
+                    "to the cold store")
+            if self.spill_after_ms <= self.demote_after_ms:
+                raise BadRequestError(
+                    f"policy for {self.metric!r}: spill_after "
+                    f"({self.spill_after_ms} ms) must be longer than "
+                    f"demote_after ({self.demote_after_ms} ms) — "
+                    "history demotes to RAM tiers first, spills to "
+                    "disk later")
+            if self.retention_ms and \
+                    self.spill_after_ms >= self.retention_ms:
+                raise BadRequestError(
+                    f"policy for {self.metric!r}: spill_after "
+                    f"({self.spill_after_ms} ms) must be shorter than "
+                    f"retention ({self.retention_ms} ms) — spilled "
+                    "history would be dropped the moment it lands on "
+                    "disk")
 
     def to_json(self) -> dict[str, Any]:
         return {
@@ -80,6 +107,7 @@ class LifecyclePolicy:
             "retention": _fmt_ms(self.retention_ms),
             "demoteAfter": _fmt_ms(self.demote_after_ms),
             "demoteTiers": list(self.demote_tiers),
+            "spillAfter": _fmt_ms(self.spill_after_ms),
         }
 
     @classmethod
@@ -105,6 +133,9 @@ class LifecyclePolicy:
                 str(obj.get("demoteAfter")
                     or obj.get("demote_after") or ""), "demoteAfter"),
             demote_tiers=tuple(t.strip() for t in tiers),
+            spill_after_ms=_parse_duration(
+                str(obj.get("spillAfter")
+                    or obj.get("spill_after") or ""), "spillAfter"),
         )
         pol.validate()
         return pol
@@ -157,6 +188,8 @@ class PolicySet:
                 "tsd.lifecycle.demote_after", ""),
             "demote_tiers": config.get_string(
                 "tsd.lifecycle.demote_tiers", ""),
+            "spill_after": config.get_string(
+                "tsd.lifecycle.spill_after", ""),
         }
         if any(v.strip() for v in default_fields.values()):
             policies.append(_policy_from_fields("*", default_fields))
@@ -211,6 +244,8 @@ def _policy_from_fields(metric: str, fld: dict[str, str]
                                      "retention"),
         demote_after_ms=_parse_duration(fld.get("demote_after", ""),
                                         "demote_after"),
-        demote_tiers=tiers)
+        demote_tiers=tiers,
+        spill_after_ms=_parse_duration(fld.get("spill_after", ""),
+                                       "spill_after"))
     pol.validate()
     return pol

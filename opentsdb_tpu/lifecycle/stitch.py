@@ -1,9 +1,11 @@
-"""Stitched read view: rollup-tier history + raw tail across the
-demotion boundary.
+"""Stitched read view: cold disk segments + rollup-tier history + raw
+tail across the spill and demotion boundaries.
 
 After age-based demotion, raw points older than a metric's demotion
 boundary exist only in the rollup tiers; the raw store keeps the tail.
-A query spanning the boundary must read BOTH — this module exposes one
+After a cold spill, the oldest tier history lives in mmap-backed disk
+segments (:mod:`opentsdb_tpu.coldstore`) instead of RAM. A query
+spanning the boundaries must read all three — this module exposes one
 ``TimeSeriesStore``-shaped object the query engine can select exactly
 like a plain tier store:
 
@@ -11,9 +13,11 @@ like a plain tier store:
   RAW store's: every live series has a raw record even when all its
   points were demoted, so filters/group-by/result assembly are
   unchanged;
-- reads split at ``boundary_ms``: the tier serves ``[start,
-  boundary)`` (raw sids mapped to tier sids by (metric, tags)
-  identity) and the raw store serves ``[boundary, end]``;
+- reads split at ``spill_boundary_ms`` and ``boundary_ms``: cold
+  segments serve ``[start, spill)``, the in-RAM tier serves
+  ``[spill, boundary)`` (raw sids mapped to tier sids by (metric,
+  tags) identity; the cold view does its own identity mapping) and
+  the raw store serves ``[boundary, end]``;
 - ``bucket_reduce`` combines the two halves channel-wise so the
   engine's grid path (and the avg sum/count division) is
   value-identical to an undemoted store for decomposable
@@ -31,11 +35,20 @@ counts them) and adds raw bucket counts into the sums channel of
 ``bucket_reduce``.
 
 Versioning: ``points_written`` / ``mutation_epoch`` are the sums of
-both halves, so every read-side cache (result cache, device grid
-cache, prepared-batch pools) invalidates on a write or sweep to either
-store. Instances are cached per (metric, tier, boundary) by the
+all stitched parts, so every read-side cache (result cache, device
+grid cache, prepared-batch pools) invalidates on a write or sweep to
+any of them. Instances are cached per (metric, tier, boundary) by the
 lifecycle manager — a moved boundary mints a fresh ``instance_id``,
 orphaning stale cache entries instead of aliasing them.
+
+Degradation: the cold third runs behind :meth:`StitchedStore._cold`
+— a failed cold read (corrupt segment, disk error, armed
+``coldstore.read`` fault) or an open cold read breaker degrades that
+request to tier/raw serving (partial history, 200) instead of a 500,
+and bumps the cold ``mutation_epoch`` so the degraded result is
+already stale for every later result-cache lookup. ``delete_range``
+deliberately does NOT degrade — a delete that silently skipped the
+cold rows would report success for points still on disk.
 """
 
 from __future__ import annotations
@@ -45,7 +58,8 @@ import threading
 import numpy as np
 
 from opentsdb_tpu.core.store import (PaddedBatch, PointBatch,
-                                     STORE_INSTANCE_IDS)
+                                     STORE_INSTANCE_IDS,
+                                     padded_from_batch)
 
 _TAIL_STATS = ("sum", "count", "min", "max")
 
@@ -56,7 +70,8 @@ class StitchedStore:
     fault_site = "store"
 
     def __init__(self, raw_store, tier_store, metric_id: int,
-                 boundary_ms: int, tail_stat: str):
+                 boundary_ms: int, tail_stat: str, cold=None,
+                 spill_boundary_ms: int = 0, cold_store=None):
         if tail_stat not in _TAIL_STATS:
             raise ValueError(f"bad tail_stat {tail_stat!r}")
         self.instance_id = next(STORE_INSTANCE_IDS)
@@ -65,6 +80,16 @@ class StitchedStore:
         self.metric_id = metric_id
         self.boundary_ms = int(boundary_ms)
         self.tail_stat = tail_stat
+        # cold third (ColdStatView) + its owning ColdStore (breaker,
+        # degradation counters). The spill boundary is CLAMPED to the
+        # demotion boundary: a manifest claiming more would make cold
+        # and raw both serve [boundary, spill) — the one invariant a
+        # corrupt manifest must not break (fsck reports the excess).
+        self.cold = cold
+        self.cold_store = cold_store
+        self.spill_boundary_ms = min(int(spill_boundary_ms),
+                                     self.boundary_ms) \
+            if cold is not None else 0
         self.num_shards = raw_store.num_shards
         self._map_lock = threading.Lock()
         # raw sid -> tier sid map, versioned by both stores' series
@@ -79,12 +104,18 @@ class StitchedStore:
 
     @property
     def points_written(self) -> int:
-        return self.raw.points_written + self.tier.points_written
+        n = self.raw.points_written + self.tier.points_written
+        if self.cold is not None:
+            n += self.cold.points_written
+        return n
 
     @property
     def mutation_epoch(self) -> int:
-        return (getattr(self.raw, "mutation_epoch", 0)
-                + getattr(self.tier, "mutation_epoch", 0))
+        e = (getattr(self.raw, "mutation_epoch", 0)
+             + getattr(self.tier, "mutation_epoch", 0))
+        if self.cold is not None:
+            e += self.cold.mutation_epoch
+        return e
 
     def series(self, series_id: int):
         return self.raw.series(series_id)
@@ -105,7 +136,10 @@ class StitchedStore:
         return self.raw.shards_of(series_ids)
 
     def total_points(self) -> int:
-        return self.raw.total_points() + self.tier.total_points()
+        n = self.raw.total_points() + self.tier.total_points()
+        if self.cold is not None:
+            n += self.cold.total_points()
+        return n
 
     # -- sid mapping --------------------------------------------------------
 
@@ -134,12 +168,42 @@ class StitchedStore:
         return np.where(hit, sorted_tier[pos_c], -1)
 
     def _split(self, start_ms: int, end_ms: int):
-        """(tier_range | None, raw_range | None) for one request."""
+        """(cold_range | None, tier_range | None, raw_range | None)
+        for one request. With no cold third the spill boundary is 0
+        and the cold range is always None."""
         b = self.boundary_ms
-        tier_rng = (start_ms, min(end_ms, b - 1)) if start_ms < b \
-            else None
+        s = self.spill_boundary_ms
+        cold_rng = (start_ms, min(end_ms, s - 1)) \
+            if s and start_ms < s else None
+        tier_lo = max(start_ms, s)
+        tier_rng = (tier_lo, min(end_ms, b - 1)) \
+            if tier_lo < b and tier_lo <= end_ms else None
         raw_rng = (max(start_ms, b), end_ms) if end_ms >= b else None
-        return tier_rng, raw_rng
+        return cold_rng, tier_rng, raw_rng
+
+    def _cold(self, fn_name: str, *args):
+        """Run one cold read behind the degradation guard: an open
+        read breaker skips the call, a failure records it — either way
+        the caller serves tier/raw only (None return). The cold
+        mutation epoch bump inside the notes makes the partial result
+        stale for every later result-cache lookup."""
+        cs = self.cold_store
+        breaker = getattr(cs, "read_breaker", None) \
+            if cs is not None else None
+        if breaker is not None and not breaker.allow():
+            cs.note_degraded_serve()
+            return None
+        try:
+            out = getattr(self.cold, fn_name)(*args)
+        except Exception as exc:  # noqa: BLE001 - degrade, never 500
+            if breaker is not None:
+                breaker.record_failure()
+            if cs is not None:
+                cs.note_read_error(exc)
+            return None
+        if breaker is not None:
+            breaker.record_success()
+        return out
 
     # -- reads --------------------------------------------------------------
 
@@ -147,7 +211,7 @@ class StitchedStore:
                     end_ms: int) -> np.ndarray:
         sids = np.asarray(series_ids, dtype=np.int64)
         out = np.zeros(len(sids), dtype=np.int64)
-        tier_rng, raw_rng = self._split(start_ms, end_ms)
+        cold_rng, tier_rng, raw_rng = self._split(start_ms, end_ms)
         if raw_rng is not None:
             out += self.raw.count_range(sids, *raw_rng)
         if tier_rng is not None:
@@ -156,14 +220,19 @@ class StitchedStore:
             if len(present):
                 out[present] += self.tier.count_range(
                     tsids[present], *tier_rng)
+        if cold_rng is not None:
+            got = self._cold("count_range", sids, *cold_rng)
+            if got is not None:
+                out += got
         return out
 
     def bucket_reduce(self, series_ids, start_ms: int, end_ms: int,
                       t0: int, interval_ms: int, nbuckets: int,
                       want_minmax: bool = False):
-        """Channel-wise combination of the tier half and the raw tail
-        over ONE shared bucket grid (same t0/interval/nbuckets for
-        both, so a bucket straddling the boundary sums exactly)."""
+        """Channel-wise combination of the cold segments, the tier
+        part and the raw tail over ONE shared bucket grid (same
+        t0/interval/nbuckets for all, so a bucket straddling a
+        boundary sums exactly)."""
         sids = np.asarray(series_ids, dtype=np.int64)
         s = len(sids)
         sums = np.zeros((s, nbuckets))
@@ -172,7 +241,22 @@ class StitchedStore:
         if want_minmax:
             mins = np.full((s, nbuckets), np.inf)
             maxs = np.full((s, nbuckets), -np.inf)
-        tier_rng, raw_rng = self._split(start_ms, end_ms)
+        cold_rng, tier_rng, raw_rng = self._split(start_ms, end_ms)
+        if cold_rng is not None:
+            # cold cells carry the same statistic as the tier's (the
+            # segment stores all four stat columns; this view reads
+            # the matching one), so they combine exactly like tier
+            # cells — no tail_stat conversion
+            got = self._cold("bucket_reduce", sids, cold_rng[0],
+                             cold_rng[1], t0, interval_ms, nbuckets,
+                             want_minmax)
+            if got is not None:
+                c_sums, c_cnts, c_mins, c_maxs = got
+                sums += c_sums
+                cnts += c_cnts
+                if want_minmax:
+                    np.minimum(mins, c_mins, out=mins)
+                    np.maximum(maxs, c_maxs, out=maxs)
         if tier_rng is not None:
             tsids = self._tier_sids(sids)
             present = np.nonzero(tsids >= 0)[0]
@@ -203,12 +287,16 @@ class StitchedStore:
 
     def materialize(self, series_ids, start_ms: int,
                     end_ms: int) -> PointBatch:
-        """Flat merged batch: per series, tier points (all before the
-        boundary) precede raw tail points, so per-series time order is
-        preserved by one stable sort on the series index."""
+        """Flat merged batch: per series, cold points (oldest) precede
+        tier points precede raw tail points, so per-series time order
+        is preserved by one stable sort on the series index."""
         sids = np.asarray(series_ids, dtype=np.int64)
         parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-        tier_rng, raw_rng = self._split(start_ms, end_ms)
+        cold_rng, tier_rng, raw_rng = self._split(start_ms, end_ms)
+        if cold_rng is not None:
+            cb = self._cold("materialize", sids, *cold_rng)
+            if cb is not None and cb.num_points:
+                parts.append((cb.series_idx, cb.ts_ms, cb.values))
         if tier_rng is not None:
             tsids = self._tier_sids(sids)
             present = np.nonzero(tsids >= 0)[0]
@@ -238,28 +326,17 @@ class StitchedStore:
 
     def materialize_padded(self, series_ids, start_ms: int,
                            end_ms: int) -> PaddedBatch:
-        batch = self.materialize(series_ids, start_ms, end_ms)
-        s = len(batch.series_ids)
-        counts = np.bincount(batch.series_idx, minlength=s) \
-            .astype(np.int64) if s else np.empty(0, dtype=np.int64)
-        pmax = max(1, int(counts.max())) if s else 1
-        values2d = np.full((s, pmax), np.nan)
-        ts2d = np.zeros((s, pmax), dtype=np.int64)
-        if batch.num_points:
-            row_starts = np.zeros(s, dtype=np.int64)
-            np.cumsum(counts[:-1], out=row_starts[1:])
-            col = np.arange(batch.num_points, dtype=np.int64) \
-                - np.repeat(row_starts, counts)
-            values2d[batch.series_idx, col] = batch.values
-            ts2d[batch.series_idx, col] = batch.ts_ms
-        return PaddedBatch(batch.series_ids, values2d, ts2d, counts)
+        return padded_from_batch(
+            self.materialize(series_ids, start_ms, end_ms))
 
     # -- destructive ops (delete=true queries) ------------------------------
 
     def delete_range(self, series_ids, start_ms: int,
                      end_ms: int) -> int:
-        """delete=true over a stitched view removes the range from
-        BOTH halves (tier history and raw tail)."""
+        """delete=true over a stitched view removes the range from ALL
+        parts (cold segments, tier history, raw tail). The cold delete
+        is NOT behind the degradation guard: silently skipping it
+        would report success for points still on disk."""
         sids = np.asarray(series_ids, dtype=np.int64)
         deleted = self.raw.delete_range(sids, start_ms, end_ms)
         tsids = self._tier_sids(sids)
@@ -267,4 +344,7 @@ class StitchedStore:
         if len(present):
             deleted += self.tier.delete_range(present, start_ms,
                                               end_ms)
+        if self.cold is not None and self.spill_boundary_ms \
+                and start_ms < self.spill_boundary_ms:
+            deleted += self.cold.delete_range(sids, start_ms, end_ms)
         return deleted

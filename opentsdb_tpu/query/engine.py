@@ -1166,17 +1166,27 @@ class QueryEngine:
                 sub.ds_spec.interval_ms)
             agg_fn = sub.ds_spec.function
             rs = self.tsdb.rollup_store
+            # cold segments ARE tier data: a tier whose RAM store was
+            # fully spilled (and emptied) must still win selection, or
+            # the on-disk history becomes unreachable. Lazy — the
+            # common has_data()=True case never pays the name resolve
+            # + segment-list scan (short-circuiting `or`).
+            def has_cold():
+                return (tier is not None and lc is not None
+                        and lc.has_cold(metric_id, tier.interval))
             if tier is not None and agg_fn in ("sum", "count", "min",
                                                "max"):
-                if rs.has_data(tier.interval, agg_fn):
+                if rs.has_data(tier.interval, agg_fn) or has_cold():
                     store = self._maybe_stitch(
                         rs.tier(tier.interval, agg_fn), metric_id,
                         tier.interval, agg_fn)
                     if agg_fn == "count":
                         ds_fn_override = "sum"
             elif tier is not None and agg_fn == "avg" \
-                    and rs.has_data(tier.interval, "sum") \
-                    and rs.has_data(tier.interval, "count"):
+                    and (rs.has_data(tier.interval, "sum")
+                         or has_cold()) \
+                    and (rs.has_data(tier.interval, "count")
+                         or has_cold()):
                 store = self._maybe_stitch(
                     rs.tier(tier.interval, "sum"), metric_id,
                     tier.interval, "sum")

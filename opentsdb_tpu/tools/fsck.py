@@ -26,6 +26,16 @@ lifecycle sweep so mutation epochs, the snapshot and the WAL stay
 consistent (an out-of-band delete would leave caches/replay able to
 resurrect them).
 
+When the cold tier is active (:mod:`opentsdb_tpu.coldstore`), fsck
+verifies every manifest segment: header and data checksums, range
+consistency against the metric's spill boundary, spill-vs-demotion
+boundary ordering (a spill boundary past the demotion boundary would
+double-serve the range between them), and orphan segment files left
+by an interrupted spill. ``--fix`` quarantines corrupt segments
+(renamed aside, dropped from the manifest) so queries degrade to
+tier/raw serving instead of the TSD failing cold reads forever, and
+clamps inconsistent boundaries.
+
 The checker fans out per shard like the reference's per-salt-bucket
 FsckWorker threads (Fsck.java:257), via a thread pool.
 """
@@ -77,6 +87,7 @@ def run_fsck(tsdb, fix: bool = False, workers: int = 8) -> FsckReport:
         for fut in futures:
             report.merge(fut.result())
     _fsck_lifecycle(tsdb, fix, report)
+    _fsck_coldstore(tsdb, fix, report)
     if fix and report.fixed and getattr(tsdb, "data_dir", ""):
         # make repairs durable (ref: Fsck writes repairs back to
         # HBase): snapshot the repaired store and truncate the WAL so
@@ -121,6 +132,43 @@ def _fsck_lifecycle(tsdb, fix: bool, report: FsckReport) -> None:
             # remaining ghosts' columns directly (no data changes —
             # the buffers are empty — so no epoch/WAL work needed)
             store.compact_series(ghosts, pack_ts=False)
+
+
+def _fsck_coldstore(tsdb, fix: bool, report: FsckReport) -> None:
+    """Cold-tier segment integrity (see module docstring). Repairs go
+    through the ColdStore's own quarantine/clamp paths so the manifest
+    stays atomic and the cold mutation epoch bumps — queries fall back
+    to tier/raw serving, the TSD never crashes on a bad segment."""
+    lc = getattr(tsdb, "lifecycle", None)
+    cold = getattr(lc, "coldstore", None) if lc is not None else None
+    if cold is None:
+        return
+    boundaries: dict[str, int] = {}
+    with lc._lock:
+        mids = dict(lc._boundaries)
+    for mid, b in mids.items():
+        try:
+            boundaries[tsdb.uids.metrics.get_name(mid)] = b
+        except LookupError:
+            continue
+    for finding in cold.fsck_scan(boundaries):
+        what = finding["file"] or "manifest"
+        msg = f"cold segment {what}: {finding['problem']}"
+        if not fix or finding["fix"] == "report":
+            # "report" findings have no safe automated repair (e.g. a
+            # lost lifecycle.json — quarantining healthy segments
+            # would destroy servable history)
+            report.error(msg)
+            continue
+        if finding["fix"] == "quarantine":
+            fixed = cold.quarantine(finding["metric"], finding["file"])
+        elif finding["fix"] == "clamp":
+            fixed = cold.clamp_boundary(finding["metric"],
+                                        finding["boundary"])
+        else:  # orphan file from an interrupted spill
+            cold.remove_orphan(finding["file"])
+            fixed = True
+        report.error(msg, fixed=fixed)
 
 
 def _fsck_shard(tsdb, sids: list[int], fix: bool) -> FsckReport:

@@ -1230,6 +1230,14 @@ class HttpRpcRouter:
                 breakers[lbreaker.name] = lbreaker.health_info()
                 if lbreaker.state != lbreaker.CLOSED:
                     causes.append(f"breaker:{lbreaker.name}")
+            cold = getattr(lifecycle, "coldstore", None)
+            cbreaker = getattr(cold, "read_breaker", None) \
+                if cold is not None else None
+            if cbreaker is not None:
+                breakers[cbreaker.name] = cbreaker.health_info()
+                if cbreaker.state != cbreaker.CLOSED:
+                    # cold reads are degrading to tier/raw serving
+                    causes.append(f"breaker:{cbreaker.name}")
         else:
             lifecycle_info = {"enabled": t.config.get_bool(
                 "tsd.lifecycle.enable", False)}

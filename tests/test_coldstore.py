@@ -1,0 +1,834 @@
+"""Cold-tier disk-spill battery (``-m coldstore``).
+
+Covers the segment file format (packing, checksums, mmap reads), the
+spill sweep mechanism (RAM release, manifest/boundary publication,
+fault-aborted spills leaving RAM authoritative), the three-way
+stitched-serving oracle (queries spanning cold/tier/raw boundaries
+value-identical to an unspilled store for decomposable downsamples,
+including group-by and rate), read degradation (cold faults + breaker
+degrade to tier/raw serving — never a 500 — and degraded results are
+never re-served from the result cache), delete=true across all three
+zones, the crash-safety battery (fault mid-spill, torn WAL tail,
+resurrection reconciliation, orphan segments, degraded WAL), the
+lifecycle-aware fsck cold checks, and observability.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+
+pytestmark = pytest.mark.coldstore
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+SPAN_S = 7200                       # 2h of raw data @1s
+NOW_MS = BASE_MS + SPAN_S * 1000    # the sweep's "now"
+# demote_after=30m, spill_after=60m => with 1m tiers:
+# cold [BASE, NOW-60m) | tier [NOW-60m, NOW-30m) | raw [NOW-30m, NOW]
+DEMOTE_B = NOW_MS - 1800_000
+SPILL_B = NOW_MS - 3600_000
+
+
+def _cfg(tmp_path, lifecycle=True, spill=True, data_dir=False,
+         **extra):
+    cfg = {
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "memory",
+        "tsd.rollups.enable": "true",
+        "tsd.tpu.warmup": "false",
+    }
+    if data_dir:
+        cfg["tsd.storage.data_dir"] = str(tmp_path / "data")
+    if lifecycle:
+        cfg.update({
+            "tsd.lifecycle.enable": "true",
+            "tsd.lifecycle.demote_after": "30m",
+            "tsd.lifecycle.demote_tiers": "1m",
+        })
+        if spill:
+            cfg["tsd.lifecycle.spill_after"] = "60m"
+            if not data_dir:
+                cfg["tsd.coldstore.dir"] = str(tmp_path / "cold")
+    cfg.update(extra)
+    return Config(**cfg)
+
+
+def _ingest(t, n_series=4, span_s=SPAN_S, seed=7, metric="sys.cpu"):
+    ts = np.arange(BASE, BASE + span_s, 1, dtype=np.int64)
+    rng = np.random.default_rng(seed)
+    for i in range(n_series):
+        t.add_points(metric, ts, rng.normal(100, 10, span_s),
+                     {"host": f"h{i:02d}"})
+
+
+def _query(t, qspec, start=BASE_MS, end=NOW_MS, delete=False):
+    tsq = TSQuery.from_json({"start": start, "end": end,
+                             "delete": delete,
+                             "queries": [qspec]}).validate()
+    return t.execute_query(tsq)
+
+
+def _dps(results):
+    return {(r.metric, tuple(sorted(r.tags.items()))): dict(r.dps)
+            for r in results}
+
+
+def _spilled_pair(tmp_path, n_series=4):
+    """(unspilled oracle TSDB, spilled TSDB with identical data)."""
+    t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+    t1 = TSDB(_cfg(tmp_path))
+    ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+    rng = np.random.default_rng(7)
+    for i in range(n_series):
+        vals = rng.normal(100, 10, SPAN_S)
+        for t in (t0, t1):
+            t.add_points("sys.cpu", ts, vals, {"host": f"h{i:02d}"})
+    rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+    assert rep["demoted"] > 0 and rep["spilled"] > 0, rep
+    return t0, t1
+
+
+def _assert_identical(got, want, context=""):
+    assert got.keys() == want.keys(), context
+    for key in want:
+        assert got[key].keys() == want[key].keys(), (context, key)
+        for ts_ms, v in want[key].items():
+            assert got[key][ts_ms] == pytest.approx(
+                v, rel=1e-9, abs=1e-9), (context, key, ts_ms)
+
+
+# ---------------------------------------------------------------------------
+# segment format
+# ---------------------------------------------------------------------------
+
+class TestSegmentFormat:
+    def test_pack_timestamps_scales(self):
+        from opentsdb_tpu.coldstore.format import pack_timestamps
+        sec = BASE_MS + np.arange(100, dtype=np.int64) * 60_000
+        col, base, scale = pack_timestamps(sec)
+        assert scale == 1000 and col.dtype == np.int32
+        assert base == BASE_MS
+        ms = sec + 1
+        col, base, scale = pack_timestamps(ms)
+        assert scale == 1 and col.dtype == np.int32
+        # second-aligned but spanning > int32 seconds: raw int64
+        wide = np.asarray([BASE_MS,
+                           BASE_MS + (np.iinfo(np.int32).max + 10)
+                           * 1000], dtype=np.int64)
+        col, base, scale = pack_timestamps(wide)
+        assert scale == 0 and col.dtype == np.int64
+        assert col.tolist() == wide.tolist()
+
+    def test_roundtrip_and_mmap(self, tmp_path):
+        from opentsdb_tpu.coldstore import format as fmt
+        n = 50
+        ts = BASE_MS + np.arange(n, dtype=np.int64) * 60_000
+        cols = {s: np.arange(n, dtype=np.float64) + i
+                for i, s in enumerate(fmt.STATS)}
+        col, base, scale = fmt.pack_timestamps(ts)
+        entry = fmt.write_segment(
+            str(tmp_path), "x.cold",
+            {"metric": "m", "interval": "1m", "base_ms": base,
+             "scale": scale, "start_ms": int(ts[0]),
+             "end_ms": int(ts[-1]), "stats": list(fmt.STATS),
+             "series": [{"tags": [["host", "a"]], "off": 0,
+                         "cnt": n}]},
+            col, cols)
+        assert entry["rows"] == n
+        seg = fmt.Segment(str(tmp_path / "x.cold"))
+        assert isinstance(seg.ts, np.memmap)
+        assert seg.ts64(0, n).tolist() == ts.tolist()
+        for s in fmt.STATS:
+            assert np.array_equal(np.asarray(seg.cols[s]), cols[s])
+        lo, hi = seg.row_bounds(0, n, int(ts[10]), int(ts[19]))
+        assert (lo, hi) == (10, 20)
+        assert fmt.verify_data_crc(str(tmp_path / "x.cold"))
+
+    def test_corruption_detected(self, tmp_path):
+        from opentsdb_tpu.coldstore import format as fmt
+        ts = BASE_MS + np.arange(8, dtype=np.int64) * 60_000
+        col, base, scale = fmt.pack_timestamps(ts)
+        fmt.write_segment(
+            str(tmp_path), "x.cold",
+            {"metric": "m", "interval": "1m", "base_ms": base,
+             "scale": scale, "start_ms": int(ts[0]),
+             "end_ms": int(ts[-1]), "stats": list(fmt.STATS),
+             "series": [{"tags": [], "off": 0, "cnt": 8}]},
+            col, {s: np.zeros(8) for s in fmt.STATS})
+        path = str(tmp_path / "x.cold")
+        # data corruption: header still fine, data crc mismatch
+        with open(path, "r+b") as fh:
+            fh.seek(os.path.getsize(path) - 4)
+            fh.write(b"\xff\xff\xff\xff")
+        fmt.Segment(path)  # opens fine (lazy data validation)
+        assert not fmt.verify_data_crc(path)
+        # header corruption: refuses to open
+        with open(path, "r+b") as fh:
+            fh.seek(24)
+            fh.write(b"\xff")
+        with pytest.raises(fmt.SegmentError):
+            fmt.Segment(path)
+        # truncation below the declared columns
+        with open(path, "r+b") as fh:
+            fh.truncate(os.path.getsize(path) - 64)
+        with pytest.raises(fmt.SegmentError):
+            fmt.Segment(path)
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+class TestSpillPolicy:
+    def test_config_and_json_roundtrip(self):
+        from opentsdb_tpu.lifecycle.policy import (LifecyclePolicy,
+                                                   PolicySet)
+        ps = PolicySet.from_config(Config(**{
+            "tsd.lifecycle.demote_after": "6h",
+            "tsd.lifecycle.spill_after": "2d",
+            "tsd.lifecycle.policy.sys.cpu.demote_after": "1h",
+            "tsd.lifecycle.policy.sys.cpu.spill_after": "12h",
+        }))
+        assert ps.for_metric("other").spill_after_ms == 2 * 86400_000
+        assert ps.for_metric("sys.cpu").spill_after_ms == 12 * 3600_000
+        pol = LifecyclePolicy.from_json(
+            {"metric": "m", "demoteAfter": "1h", "spillAfter": "4h"})
+        assert pol.spill_after_ms == 4 * 3600_000
+        assert pol.to_json()["spillAfter"] == "4h"
+
+    def test_validation(self):
+        from opentsdb_tpu.lifecycle.policy import LifecyclePolicy
+        from opentsdb_tpu.query.model import BadRequestError
+        with pytest.raises(BadRequestError):  # spill needs demote
+            LifecyclePolicy.from_json(
+                {"metric": "m", "spillAfter": "1h"})
+        with pytest.raises(BadRequestError):  # spill after demote
+            LifecyclePolicy.from_json(
+                {"metric": "m", "demoteAfter": "2h",
+                 "spillAfter": "1h"})
+        with pytest.raises(BadRequestError):  # spill before retention
+            LifecyclePolicy.from_json(
+                {"metric": "m", "demoteAfter": "1h",
+                 "spillAfter": "3h", "retention": "2h"})
+
+
+# ---------------------------------------------------------------------------
+# the spill sweep
+# ---------------------------------------------------------------------------
+
+class TestSpillSweep:
+    def test_spill_releases_tier_ram_and_publishes_boundary(
+            self, tmp_path):
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        assert cold.spill_boundary("sys.cpu") == SPILL_B
+        assert t1.lifecycle.demote_boundary(mid) == DEMOTE_B
+        assert cold.segments_written == 1 and cold.cold_bytes() > 0
+        # every stat tier's RAM below the spill boundary is released
+        for agg in ("sum", "count", "min", "max"):
+            tier = t1.rollup_store.tier("1m", agg)
+            tsids = tier.series_ids_for_metric(mid)
+            assert int(tier.count_range(tsids, 1,
+                                        SPILL_B - 1).sum()) == 0, agg
+            # the unspilled band [spill, demote) stays in RAM
+            assert int(tier.count_range(tsids, SPILL_B,
+                                        DEMOTE_B - 1).sum()) > 0, agg
+
+    def test_spill_is_idempotent_across_sweeps(self, tmp_path):
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["spilled"] == 0 and cold.segments_written == 1
+        # advancing time moves the boundary and spills the backlog
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS + 600_000)
+        assert rep["spilled"] > 0 and cold.segments_written >= 2
+        segs = cold._handles("sys.cpu", "1m")
+        ranges = [(h.entry["start_ms"], h.entry["end_ms"])
+                  for h in segs]
+        for (s1, e1), (s2, e2) in zip(ranges, ranges[1:]):
+            assert e1 < s2, "segments must be time-disjoint"
+
+    def test_write_fault_leaves_ram_authoritative(self, tmp_path):
+        t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+        t1 = TSDB(_cfg(tmp_path))
+        ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+        rng = np.random.default_rng(3)
+        for i in range(2):
+            vals = rng.normal(100, 10, SPAN_S)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals, {"host": f"h{i}"})
+        t1.faults.arm("coldstore.write", error_rate=1.0)
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert "error" in rep
+        cold = t1.lifecycle.coldstore
+        assert cold.spill_boundary("sys.cpu") == 0
+        assert cold.spill_errors >= 1 and cold.segments_written == 0
+        # demotion (before the failed spill) happened; queries stay
+        # value-identical — RAM copies are authoritative
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        _assert_identical(_dps(_query(t1, q)), _dps(_query(t0, q)))
+        t1.faults.disarm()
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["spilled"] > 0
+        _assert_identical(_dps(_query(t1, q)), _dps(_query(t0, q)))
+
+    def test_late_added_tier_history_never_purged_unspilled(
+            self, tmp_path):
+        """A tier added to the policy AFTER spills began has un-
+        spilled history below the spill boundary: reconciliation must
+        not purge it (no disk copy exists), and the next spill must
+        write its FULL history, not just the [prev, new) window."""
+        t1 = TSDB(_cfg(tmp_path))
+        _ingest(t1, n_series=1)
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        cold = t1.lifecycle.coldstore
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        assert cold.has_segments("sys.cpu", "1m")
+        # an external rollup writer populated the 1h tier with cells
+        # far below the spill boundary (1h is not in the policy yet)
+        t1.add_aggregate_point("sys.cpu", BASE, 5.0, {"host": "h00"},
+                               False, "1h", "SUM")
+        t1.add_aggregate_point("sys.cpu", BASE + 3600, 7.0,
+                               {"host": "h00"}, False, "1h", "SUM")
+        tier_h = t1.rollup_store.tier("1h", "sum")
+        hsids = tier_h.series_ids_for_metric(mid)
+        # reconciliation sweeps leave tiers without cold coverage alone
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert int(tier_h.count_range(hsids, 1, NOW_MS).sum()) == 2
+        # the operator widens the policy to demote+spill 1h too
+        t1.lifecycle.update_policies({"policies": [
+            {"metric": "*", "demoteAfter": "30m",
+             "demoteTiers": ["1m", "1h"], "spillAfter": "60m"}]})
+        t1.lifecycle.sweep(now_ms=NOW_MS + 3600_000)
+        assert cold.has_segments("sys.cpu", "1h")
+        handles = cold._handles("sys.cpu", "1h")
+        assert min(h.entry["start_ms"] for h in handles) == BASE_MS, \
+            "pre-boundary 1h history must spill, not strand"
+        # and it still serves through the stitch
+        got = _dps(_query(t1, {"metric": "sys.cpu",
+                               "aggregator": "sum",
+                               "downsample": "1h-sum"},
+                          end=NOW_MS + 3600_000))
+        vals = next(iter(got.values()))
+        assert vals[BASE_MS] == 5.0
+        # the BASE+1h cell additionally received the second sweep's
+        # demotion fold (policy coarsening creates a partial 1h cell —
+        # pre-existing demotion semantics); the external 7.0 must
+        # still be in there, not purged
+        assert vals[BASE_MS + 3600_000] >= 7.0
+
+    def test_no_spill_without_demotion_boundary(self, tmp_path):
+        t = TSDB(_cfg(tmp_path))
+        _ingest(t, n_series=1, span_s=600)  # all data inside 30m
+        rep = t.lifecycle.sweep(now_ms=BASE_MS + 600_000)
+        assert rep["spilled"] == 0
+        assert t.lifecycle.coldstore.spill_boundary("sys.cpu") == 0
+
+
+# ---------------------------------------------------------------------------
+# three-way stitched serving oracle
+# ---------------------------------------------------------------------------
+
+class TestColdOracle:
+    """Boundary-spanning queries on a spilled store must be
+    value-identical to an unspilled all-RAM store for decomposable
+    downsamples (sum/count/min/max exact, avg within float eps),
+    including group-by and rate."""
+
+    @pytest.mark.parametrize("ds_fn", ["sum", "count", "min", "max",
+                                       "avg"])
+    @pytest.mark.parametrize("agg", ["sum", "max"])
+    def test_full_span_value_identical(self, tmp_path, ds_fn, agg):
+        t0, t1 = _spilled_pair(tmp_path)
+        q = {"metric": "sys.cpu", "aggregator": agg,
+             "downsample": f"1m-{ds_fn}"}
+        _assert_identical(_dps(_query(t1, q)), _dps(_query(t0, q)),
+                          (ds_fn, agg))
+
+    def test_groupby_and_rate_and_coarser_interval(self, tmp_path):
+        t0, t1 = _spilled_pair(tmp_path)
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "5m-sum", "rate": True,
+             "filters": [{"type": "wildcard", "tagk": "host",
+                          "filter": "*", "groupBy": True}]}
+        got, want = _dps(_query(t1, q)), _dps(_query(t0, q))
+        assert len(got) == 4
+        _assert_identical(got, want)
+
+    def test_window_subsets(self, tmp_path):
+        """Every zone combination: cold-only, tier-only, raw-only,
+        cold+tier, tier+raw, and buckets straddling each boundary."""
+        t0, t1 = _spilled_pair(tmp_path)
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        windows = [
+            (BASE_MS, SPILL_B - 1),              # cold only
+            (SPILL_B, DEMOTE_B - 1),             # tier only
+            (DEMOTE_B, NOW_MS),                  # raw only
+            (BASE_MS, DEMOTE_B - 1),             # cold + tier
+            (SPILL_B, NOW_MS),                   # tier + raw
+            # tier-aligned starts (an unaligned start inherits the
+            # pre-existing rollup edge-attribution divergence)
+            (SPILL_B - 120_000, SPILL_B + 119_999),    # straddle spill
+            (DEMOTE_B - 120_000, DEMOTE_B + 119_999),  # straddle demote
+        ]
+        for start, end in windows:
+            _assert_identical(
+                _dps(_query(t1, q, start=start, end=end)),
+                _dps(_query(t0, q, start=start, end=end)),
+                (start, end))
+
+    def test_multi_tier_spill(self, tmp_path):
+        """demote_tiers 1m,1h: both tiers spill, and a 1h-downsample
+        query served from the coarse tier's cold segments is exact."""
+        t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+        t1 = TSDB(_cfg(tmp_path, **{
+            "tsd.lifecycle.demote_tiers": "1m,1h",
+            "tsd.lifecycle.demote_after": "30m",
+            "tsd.lifecycle.spill_after": "60m"}))
+        ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+        rng = np.random.default_rng(5)
+        for i in range(2):
+            vals = rng.normal(100, 10, SPAN_S)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals, {"host": f"h{i}"})
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["spilled"] > 0
+        cold = t1.lifecycle.coldstore
+        assert cold.has_segments("sys.cpu", "1m")
+        assert cold.has_segments("sys.cpu", "1h")
+        for ds in ("1m-sum", "1h-sum", "1h-avg"):
+            q = {"metric": "sys.cpu", "aggregator": "sum",
+                 "downsample": ds}
+            _assert_identical(_dps(_query(t1, q)),
+                              _dps(_query(t0, q)), ds)
+
+    def test_fully_spilled_tier_still_selected(self, tmp_path):
+        """A metric whose data is ALL old: every demoted cell spills,
+        the RAM tier empties (``has_data`` goes False) — yet tier
+        selection must still pick the stitched view, or the on-disk
+        history becomes unreachable."""
+        t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+        t1 = TSDB(_cfg(tmp_path))
+        ts = np.arange(BASE, BASE + 1800, 1, dtype=np.int64)
+        rng = np.random.default_rng(6)
+        for i in range(2):
+            vals = rng.normal(100, 10, 1800)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals, {"host": f"h{i}"})
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["spilled"] > 0
+        cold = t1.lifecycle.coldstore
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        tier = t1.rollup_store.tier("1m", "sum")
+        assert tier.total_points() == 0, "everything should be cold"
+        assert not t1.rollup_store.has_data("1m", "sum")
+        assert t1.lifecycle.has_cold(mid, "1m")
+        assert cold.spill_boundary("sys.cpu") == SPILL_B
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        _assert_identical(_dps(_query(t1, q)), _dps(_query(t0, q)))
+
+    def test_ingest_and_new_series_after_spill(self, tmp_path):
+        t0, t1 = _spilled_pair(tmp_path)
+        late = np.arange(BASE + SPAN_S - 300, BASE + SPAN_S, 1,
+                         dtype=np.int64)
+        for t in (t0, t1):
+            t.add_points("sys.cpu", late, np.full(300, 5.0),
+                         {"host": "late"})
+            t.add_point("sys.cpu", BASE + SPAN_S, 9.0,
+                        {"host": "h00"})
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        _assert_identical(
+            _dps(_query(t1, q, end=NOW_MS + 60_000)),
+            _dps(_query(t0, q, end=NOW_MS + 60_000)))
+
+
+# ---------------------------------------------------------------------------
+# delete=true across all three zones
+# ---------------------------------------------------------------------------
+
+class TestColdDelete:
+    def test_delete_spanning_all_zones(self, tmp_path):
+        _, t1 = _spilled_pair(tmp_path, n_series=2)
+        cold = t1.lifecycle.coldstore
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        before = _dps(_query(t1, q))
+        win = (SPILL_B - 600_000, SPILL_B + 120_000 - 1)
+        _query(t1, q, start=win[0], end=win[1], delete=True)
+        after = _dps(_query(t1, q))
+        for key, dps in after.items():
+            for ts_ms in dps:
+                assert ts_ms < win[0] or ts_ms > win[1]
+            # outside the window nothing changed
+            for ts_ms, v in before[key].items():
+                if ts_ms < win[0] - 60_000 or ts_ms > win[1]:
+                    assert dps[ts_ms] == v
+        assert cold.points_deleted > 0
+        # the rewrite produced a manifest-referenced, fsck-visible
+        # replacement (keeps the .cold suffix) and removed the old
+        # file; no orphans left behind
+        on_disk = {f for f in os.listdir(cold.directory)
+                   if f.endswith(".cold")}
+        listed = {e["file"]
+                  for e in cold._metrics["sys.cpu"]["segments"]}
+        assert listed == on_disk and listed
+        assert all(f.endswith(".cold") for f in listed)
+
+    def test_full_delete_drops_segments(self, tmp_path):
+        _, t1 = _spilled_pair(tmp_path, n_series=2)
+        cold = t1.lifecycle.coldstore
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        _query(t1, q, delete=True)
+        assert not _query(t1, q)
+        assert not cold.has_segments("sys.cpu", "1m")
+        # the rewrite removed the files, not just the manifest rows
+        left = [f for f in os.listdir(cold.directory)
+                if f.endswith(".cold")]
+        assert not left
+
+
+# ---------------------------------------------------------------------------
+# degradation: cold read failures never 500, never poison the cache
+# ---------------------------------------------------------------------------
+
+class TestColdDegradation:
+    def test_read_fault_degrades_to_tier_raw(self, tmp_path):
+        t0, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        t1.faults.arm("coldstore.read", error_rate=1.0)
+        got = _dps(_query(t1, q))
+        # served, partial: nothing before the spill boundary, the
+        # tier band and raw tail intact (value-identical there)
+        want = _dps(_query(t0, q, start=SPILL_B))
+        _assert_identical(got, want)
+        assert cold.read_errors >= 1
+        # repeat queries trip the breaker; still 200s, counted
+        for _ in range(6):
+            _dps(_query(t1, q))
+        assert cold.read_breaker.state == "open"
+        assert cold.degraded_serves >= 1
+        t1.faults.disarm()
+
+    def test_degraded_result_never_cached(self, tmp_path):
+        t0, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        assert t1.result_cache is not None
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        t1.faults.arm("coldstore.read", error_count=1)
+        degraded = _dps(_query(t1, q))
+        assert min(min(d) for d in degraded.values()) >= SPILL_B \
+            - 60_000
+        t1.faults.disarm()
+        cold.read_breaker.record_success()
+        # the VERY NEXT identical query recomputes (the failure bumped
+        # the cold epoch, so the cached degraded entry is stale) and
+        # serves the full history again
+        full = _dps(_query(t1, q))
+        _assert_identical(full, _dps(_query(t0, q)))
+
+    def test_open_breaker_skips_cold_reads(self, tmp_path):
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        for _ in range(cold.read_breaker.failure_threshold):
+            cold.read_breaker.record_failure()
+        assert cold.read_breaker.state == "open"
+        # an open cold breaker is a health degradation cause
+        from opentsdb_tpu.tsd.http_api import HttpRequest, \
+            HttpRpcRouter
+        health = json.loads(HttpRpcRouter(t1).handle(
+            HttpRequest("GET", "/api/health")).body)
+        assert health["degraded"]
+        assert "breaker:coldstore.read" in health["causes"]
+        before = cold.degraded_serves
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        got = _dps(_query(t1, q))
+        assert cold.degraded_serves > before
+        for dps in got.values():
+            assert min(dps) >= SPILL_B - 60_000
+        cold.read_breaker.record_success()
+        got = _dps(_query(t1, q))
+        assert min(min(d) for d in got.values()) == BASE_MS
+
+
+# ---------------------------------------------------------------------------
+# crash safety
+# ---------------------------------------------------------------------------
+
+class TestCrashSafety:
+    def _mk(self, tmp_path, **extra):
+        return TSDB(_cfg(tmp_path, data_dir=True, **extra))
+
+    def test_restart_serves_identically(self, tmp_path):
+        t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+        t1 = self._mk(tmp_path)
+        ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+        rng = np.random.default_rng(9)
+        for i in range(2):
+            vals = rng.normal(100, 10, SPAN_S)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals, {"host": f"h{i}"})
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        served = _dps(_query(t1, q))
+        t1.wal.close()
+        t2 = self._mk(tmp_path)
+        cold2 = t2.lifecycle.coldstore
+        assert cold2.spill_boundary("sys.cpu") == SPILL_B
+        _assert_identical(_dps(_query(t2, q)), served)
+        _assert_identical(_dps(_query(t2, q)), _dps(_query(t0, q)))
+        t2.wal.close()
+
+    def test_torn_wal_tail_no_resurrection_no_double_serve(
+            self, tmp_path):
+        t1 = self._mk(tmp_path)
+        _ingest(t1, n_series=1, metric="p.m")
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        q = {"metric": "p.m", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        # pre-crash window only: the post-sweep writes land at NOW_MS
+        served = _dps(_query(t1, q, end=NOW_MS - 1))
+        for i in range(5):
+            t1.add_point("p.m", BASE + SPAN_S + i, float(i),
+                         {"host": "h00"})
+        t1.wal.close()
+        wal_dir = str(tmp_path / "data" / "wal")
+        segs = sorted(os.path.join(wal_dir, f)
+                      for f in os.listdir(wal_dir)
+                      if f.endswith(".log"))
+        os.truncate(segs[-1], os.path.getsize(segs[-1]) - 3)
+        t2 = self._mk(tmp_path)
+        # the old window is served EXACTLY once (no resurrected RAM
+        # duplicates double-counting against cold segments)
+        _assert_identical(_dps(_query(t2, q, end=NOW_MS - 1)), served)
+        # the intact prefix of post-sweep writes survived
+        mid = t2.uids.metrics.get_id("p.m")
+        sids = t2.store.series_ids_for_metric(mid)
+        assert int(t2.store.count_range(sids, NOW_MS,
+                                        NOW_MS + 60_000).sum()) == 4
+        t2.wal.close()
+
+    def test_resurrected_tier_duplicates_clipped_then_reconciled(
+            self, tmp_path):
+        """Crash between manifest commit and the RAM purge leaves the
+        spilled cells in BOTH cold and the tier store. Stitched reads
+        must clip them (no double count); the next sweep purges them."""
+        t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+        t1 = TSDB(_cfg(tmp_path))
+        ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+        rng = np.random.default_rng(4)
+        for i in range(2):
+            vals = rng.normal(100, 10, SPAN_S)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals, {"host": f"h{i}"})
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        # simulate the resurrection: re-fold the spilled window into
+        # the tier stores (what an un-truncated WAL replay would do)
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        # raw below the demote boundary is purged, so rebuild tier
+        # cells from the oracle's raw store through the tier API
+        tier = t1.rollup_store.tier("1m", "sum")
+        t0_mid = t0.uids.metrics.get_id("sys.cpu")
+        t0_sids = t0.store.series_ids_for_metric(t0_mid)
+        sums, cnts, _, _ = t0.store.bucket_reduce(
+            t0_sids, BASE_MS, SPILL_B - 1, BASE_MS, 60_000,
+            (SPILL_B - BASE_MS) // 60_000)
+        bucket_ts = BASE_MS + np.arange(sums.shape[1],
+                                        dtype=np.int64) * 60_000
+        tsids = tier.series_ids_for_metric(mid)
+        tier.append_grid(tsids, bucket_ts, sums,
+                         np.ones_like(sums, dtype=bool))
+        assert int(tier.count_range(tsids, 1, SPILL_B - 1).sum()) > 0
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        # no double-serve: identical to the unspilled oracle
+        _assert_identical(_dps(_query(t1, q)), _dps(_query(t0, q)))
+        # reconciliation: the next sweep purges the RAM duplicates
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert int(tier.count_range(tsids, 1, SPILL_B - 1).sum()) == 0
+        _assert_identical(_dps(_query(t1, q)), _dps(_query(t0, q)))
+
+    def test_orphan_segment_invisible_and_fsck_flagged(
+            self, tmp_path):
+        """Crash between the segment file write and the manifest
+        commit leaves an orphan file: invisible to reads, reported by
+        fsck, quarantined by --fix."""
+        from opentsdb_tpu.tools.fsck import run_fsck
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        served = _dps(_query(t1, {"metric": "sys.cpu",
+                                  "aggregator": "sum",
+                                  "downsample": "1m-sum"}))
+        # an interrupted second spill: file on disk, no manifest row
+        entry = cold.write_segment(
+            "sys.cpu", "1m",
+            [{"tags": [["host", "h00"]], "off": 0, "cnt": 1}],
+            np.asarray([SPILL_B], dtype=np.int64),
+            {s: np.ones(1) for s in
+             ("sum", "count", "min", "max")})
+        assert not any(
+            e["file"] == entry["file"]
+            for e in cold._metrics["sys.cpu"]["segments"])
+        got = _dps(_query(t1, {"metric": "sys.cpu",
+                               "aggregator": "sum",
+                               "downsample": "1m-sum"}))
+        _assert_identical(got, served)
+        report = run_fsck(t1)
+        assert any("not in manifest" in ln for ln in report.lines)
+        report = run_fsck(t1, fix=True)
+        assert report.fixed > 0
+        report = run_fsck(t1)
+        assert not any("not in manifest" in ln
+                       for ln in report.lines)
+
+    def test_degraded_wal_during_spill_still_durable(self, tmp_path):
+        """WAL append path offline while the sweep spills: durability
+        comes from the segment fsync + manifest + snapshot, so a
+        restart still reflects the spill with no resurrection."""
+        t1 = self._mk(tmp_path,
+                      **{"tsd.storage.wal.retry.attempts": "1"})
+        _ingest(t1, n_series=1, metric="p.m")
+        t1.faults.arm("wal.append", error_rate=1.0)
+        t1.add_point("p.m", BASE + SPAN_S, 1.0, {"host": "h00"})
+        assert t1.wal.degraded or t1.wal.append_failures > 0
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert "error" not in rep and rep["spilled"] > 0
+        q = {"metric": "p.m", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        served = _dps(_query(t1, q))
+        t1.faults.disarm()
+        t1.wal.close()
+        t2 = self._mk(tmp_path)
+        assert t2.lifecycle.coldstore.spill_boundary("p.m") == SPILL_B
+        _assert_identical(_dps(_query(t2, q)), served)
+        mid = t2.uids.metrics.get_id("p.m")
+        tier = t2.rollup_store.tier("1m", "sum")
+        tsids = tier.series_ids_for_metric(mid)
+        # a leftover RAM duplicate below the spill boundary would be
+        # clipped anyway, but the post-sweep snapshot should have
+        # carried the purged state
+        assert int(tier.count_range(tsids, 1,
+                                    SPILL_B - 1).sum()) == 0
+        t2.wal.close()
+
+
+# ---------------------------------------------------------------------------
+# fsck
+# ---------------------------------------------------------------------------
+
+class TestColdFsck:
+    def test_corrupt_segment_quarantined_and_serving_degrades(
+            self, tmp_path):
+        from opentsdb_tpu.tools.fsck import run_fsck
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        seg = [f for f in os.listdir(cold.directory)
+               if f.endswith(".cold")][0]
+        path = os.path.join(cold.directory, seg)
+        with open(path, "r+b") as fh:
+            fh.seek(os.path.getsize(path) - 10)
+            fh.write(b"\xff\xff\xff")
+        report = run_fsck(t1)
+        assert any("checksum mismatch" in ln for ln in report.lines)
+        report = run_fsck(t1, fix=True)
+        assert report.fixed > 0
+        assert os.path.exists(path + ".quarantine")
+        # serving falls back to tier/raw — never a crash
+        got = _dps(_query(t1, {"metric": "sys.cpu",
+                               "aggregator": "sum",
+                               "downsample": "1m-sum"}))
+        assert got and min(min(d) for d in got.values()) >= SPILL_B
+        # --fix converges
+        report = run_fsck(t1)
+        assert not any("cold" in ln for ln in report.lines)
+
+    def test_missing_demote_boundary_report_only(self, tmp_path):
+        """A lost lifecycle.json must NOT cascade into quarantining
+        healthy segments: fsck reports, --fix changes nothing."""
+        from opentsdb_tpu.tools.fsck import run_fsck
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        with t1.lifecycle._lock:
+            t1.lifecycle._boundaries.pop(mid)
+        report = run_fsck(t1)
+        assert any("no demotion boundary" in ln
+                   for ln in report.lines)
+        report = run_fsck(t1, fix=True)
+        # not "fixed": there is no safe automated repair
+        assert any("ERROR: cold segment" in ln
+                   for ln in report.lines)
+        assert cold.spill_boundary("sys.cpu") == SPILL_B
+        assert cold.segments_quarantined == 0
+        assert cold.has_segments("sys.cpu", "1m")
+
+    def test_boundary_inconsistency_reported_and_clamped(
+            self, tmp_path):
+        from opentsdb_tpu.tools.fsck import run_fsck
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        with cold._lock:
+            cold._metrics["sys.cpu"]["spill_boundary_ms"] = \
+                DEMOTE_B + 3600_000
+            cold._save_manifest_locked()
+        # serving ALREADY clamps (the stitch can never double-serve);
+        # fsck reports and --fix repairs the manifest
+        report = run_fsck(t1)
+        assert any("double-served" in ln for ln in report.lines)
+        run_fsck(t1, fix=True)
+        assert cold.spill_boundary("sys.cpu") == DEMOTE_B
+        report = run_fsck(t1)
+        assert not any("double-served" in ln for ln in report.lines)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+class TestColdObservability:
+    def test_health_and_stats_expose_cold_counters(self, tmp_path):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, \
+            HttpRpcRouter
+        _, t1 = _spilled_pair(tmp_path)
+        router = HttpRpcRouter(t1)
+        health = json.loads(router.handle(
+            HttpRequest("GET", "/api/health")).body)
+        assert health["storage"]["total"]["cold_bytes"] > 0
+        assert health["storage"]["cold"]["segments"] == 1
+        cs = health["lifecycle"]["coldstore"]
+        assert cs["pointsSpilled"] > 0 and cs["coldBytes"] > 0
+        assert health["breakers"]["coldstore.read"]["state"] \
+            == "closed"
+        names = {e["metric"] for e in json.loads(router.handle(
+            HttpRequest("GET", "/api/stats")).body)}
+        assert {"tsd.storage.cold_bytes", "tsd.coldstore.bytes",
+                "tsd.coldstore.points.spilled",
+                "tsd.lifecycle.points.spilled"} <= names
+
+    def test_lifecycle_endpoint_reports_spill(self, tmp_path):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, \
+            HttpRpcRouter
+        _, t1 = _spilled_pair(tmp_path)
+        router = HttpRpcRouter(t1)
+        doc = json.loads(router.handle(
+            HttpRequest("GET", "/api/lifecycle")).body)
+        assert doc["spillBoundaries"]["sys.cpu"] == SPILL_B
+        assert doc["coldstore"]["segmentsWritten"] == 1
+        assert doc["policies"][0]["spillAfter"] == "1h"

@@ -418,6 +418,203 @@ class TestCompaction:
 
 
 # ---------------------------------------------------------------------------
+# histogram-arena retention
+# ---------------------------------------------------------------------------
+
+class TestHistogramRetention:
+    def _hist_tsdb(self, n=120, **extra):
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        t = _tsdb(**{"tsd.lifecycle.retention": "1h",
+                     "tsd.lifecycle.demote_after": "", **extra})
+        bounds = [0.0, 1.0, 2.0, 4.0]
+        for i in range(n):
+            h = SimpleHistogram(bounds)
+            h.add(1.5, i + 1)
+            t.add_histogram_point("lat.h", BASE + i * 60,
+                                  t.histogram_manager.encode(h),
+                                  {"host": "a"})
+        return t
+
+    def test_ttl_purges_histogram_arena(self):
+        t = self._hist_tsdb()
+        mid = t.uids.metrics.get_id("lat.h")
+        arena = t._histogram_arenas[mid]
+        assert arena.total_points == 120
+        ver0 = t._histogram_version
+        rep = t.lifecycle.sweep(now_ms=NOW_MS)
+        # 120 minutes of points, 1h TTL vs NOW: the first hour purges
+        assert rep["histogramPurged"] == 60
+        assert arena.total_points == 60
+        assert t._histogram_version > ver0, \
+            "read-side caches must invalidate"
+        cutoff = NOW_MS - 3600_000
+        sub = next(iter(arena.groups.values()))
+        assert int(sub.ts[:sub.n].min()) >= cutoff
+        # a percentile query sees only retained points
+        res = _query(t, {"metric": "lat.h", "aggregator": "sum",
+                         "percentiles": [99.0]})
+        for r in res:
+            assert min(dict(r.dps)) >= cutoff
+
+    def test_fully_expired_arena_released(self):
+        t = self._hist_tsdb(n=10)  # all 10 points far behind the TTL
+        mid = t.uids.metrics.get_id("lat.h")
+        t.lifecycle.sweep(now_ms=NOW_MS)
+        assert mid not in t._histogram_arenas
+
+    def test_histogram_purge_fault_never_fails_ingest(self):
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        t = self._hist_tsdb()
+        t.faults.arm("lifecycle.histogram", error_rate=1.0)
+        rep = t.lifecycle.sweep(now_ms=NOW_MS)
+        assert "error" in rep and t.lifecycle.sweep_errors == 1
+        # histogram AND scalar ingest are untouched by the failure
+        h = SimpleHistogram([0.0, 1.0])
+        h.add(0.5, 2)
+        t.add_histogram_point("lat.h", BASE + SPAN_S,
+                              t.histogram_manager.encode(h),
+                              {"host": "a"})
+        t.add_point("sys.other", BASE + SPAN_S, 1.0, {"host": "a"})
+        t.faults.disarm()
+        rep = t.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["histogramPurged"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SeriesBuffer.compact() packing edges + stitched delete_range
+# ---------------------------------------------------------------------------
+
+class TestCompactEdges:
+    def test_offset_span_past_int32_never_packs(self):
+        from opentsdb_tpu.core.store import SeriesBuffer
+        buf = SeriesBuffer()
+        # second-aligned but the SECOND span exceeds int32: compact
+        # must bail before even attempting the offset subtraction
+        buf.append(BASE_MS, 1.0, False)
+        buf.append(BASE_MS + (np.iinfo(np.int32).max + 100) * 1000,
+                   2.0, False)
+        reclaimed = buf.compact()
+        assert buf._ts_scale == 0 and buf.ts.dtype == np.int64
+        assert reclaimed > 0  # shrink-to-fit still happened
+        assert buf.view()[0].tolist() == [
+            BASE_MS, BASE_MS + (np.iinfo(np.int32).max + 100) * 1000]
+
+    def test_duplicate_and_unsorted_tail_packs_after_dedupe(self):
+        from opentsdb_tpu.core.store import SeriesBuffer
+        buf = SeriesBuffer()
+        # unsorted with duplicates: compact must sort + last-write-
+        # wins dedupe BEFORE deciding packability
+        buf.append(BASE_MS + 2000, 1.0, False)
+        buf.append(BASE_MS, 2.0, False)
+        buf.append(BASE_MS + 2000, 3.0, False)  # dupe, last wins
+        buf.append(BASE_MS + 1000, 4.0, False)
+        buf.compact()
+        assert buf._ts_scale == 1000 and buf.ts.dtype == np.int32
+        ts, vals = buf.view()
+        assert ts.tolist() == [BASE_MS, BASE_MS + 1000,
+                               BASE_MS + 2000]
+        assert vals.tolist() == [2.0, 4.0, 3.0]
+
+    def test_first_write_after_pack_unpacks_once(self):
+        from opentsdb_tpu.core.store import SeriesBuffer
+        buf = SeriesBuffer()
+        buf.append_many(BASE_MS + np.arange(10, dtype=np.int64) * 1000,
+                        np.arange(10, dtype=np.float64))
+        buf.compact()
+        assert buf._ts_scale == 1000
+        buf.append(BASE_MS + 10_000, 10.0, False)
+        assert buf._ts_scale == 0 and buf._ts_base == 0
+        assert buf.ts.dtype == np.int64
+        ts, vals = buf.view()
+        assert len(ts) == 11 and ts[-1] == BASE_MS + 10_000
+        # repeated compact on already-compact data is free
+        buf.compact()
+        assert buf.compact(pack_ts=True) == 0
+
+    def test_pack_before_ms_keeps_live_tail_unpacked(self):
+        from opentsdb_tpu.core.store import SeriesBuffer
+        buf = SeriesBuffer()
+        buf.append_many(BASE_MS + np.arange(10, dtype=np.int64) * 1000,
+                        np.arange(10, dtype=np.float64))
+        buf.compact(pack_before_ms=BASE_MS + 5000)
+        assert buf._ts_scale == 0, "live buffer must not pack"
+        buf.compact(pack_before_ms=BASE_MS + 60_000)
+        assert buf._ts_scale == 1000, "cold buffer packs"
+
+    def test_compacted_empty_buffer_accepts_writes(self):
+        from opentsdb_tpu.core.store import SeriesBuffer
+        buf = SeriesBuffer()
+        buf.append(BASE_MS, 1.0, False)
+        buf.delete_range(1, NOW_MS)
+        assert buf.compact() > 0 and buf.resident_bytes == 0
+        buf.append(BASE_MS + 1000, 2.0, False)  # re-grows from zero
+        assert buf.view()[0].tolist() == [BASE_MS + 1000]
+
+
+class TestStitchedDelete:
+    def test_delete_range_spanning_demotion_boundary(self):
+        t0 = _tsdb(lifecycle=False)
+        t1 = _tsdb()
+        ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+        rng = np.random.default_rng(11)
+        for i in range(3):
+            vals = rng.normal(100, 10, SPAN_S)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals,
+                             {"host": f"h{i:02d}"})
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        boundary = t1.lifecycle.demote_boundary(mid)
+        q = {"metric": "sys.cpu", "aggregator": "sum",
+             "downsample": "1m-sum"}
+        # delete a window straddling the demotion boundary via the
+        # engine's delete=true path (serial, scanned-and-deleted)
+        win = (boundary - 300_000, boundary + 300_000 - 1)
+        tsq = TSQuery.from_json({
+            "start": win[0], "end": win[1], "delete": True,
+            "queries": [q]}).validate()
+        t1.execute_query(tsq)
+        # both halves are gone: tier history AND raw tail
+        tier = t1.rollup_store.tier("1m", "sum")
+        tsids = tier.series_ids_for_metric(mid)
+        assert int(tier.count_range(tsids, *win).sum()) == 0
+        sids = t1.store.series_ids_for_metric(mid)
+        assert int(t1.store.count_range(sids, *win).sum()) == 0
+        # outside the window the stitched view still matches the
+        # oracle with the same window deleted from raw
+        t0.store.delete_range(
+            t0.store.series_ids_for_metric(
+                t0.uids.metrics.get_id("sys.cpu")), *win)
+        got, want = _dps(_query(t1, q)), _dps(_query(t0, q))
+        assert got.keys() == want.keys()
+        for key in want:
+            assert got[key].keys() == want[key].keys()
+            for ts_ms, v in want[key].items():
+                assert got[key][ts_ms] == pytest.approx(
+                    v, rel=1e-9, abs=1e-9)
+
+    def test_delete_entirely_within_tier_half(self):
+        t1 = _tsdb()
+        _ingest(t1, n_series=2)
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        mid = t1.uids.metrics.get_id("sys.cpu")
+        boundary = t1.lifecycle.demote_boundary(mid)
+        win = (BASE_MS + 600_000, BASE_MS + 1200_000 - 1)
+        assert win[1] < boundary
+        tsq = TSQuery.from_json({
+            "start": win[0], "end": win[1], "delete": True,
+            "queries": [{"metric": "sys.cpu", "aggregator": "sum",
+                         "downsample": "1m-sum"}]}).validate()
+        t1.execute_query(tsq)
+        got = _dps(_query(t1, {"metric": "sys.cpu",
+                               "aggregator": "sum",
+                               "downsample": "1m-sum"}))
+        for dps in got.values():
+            for ts_ms in dps:
+                assert ts_ms < win[0] or ts_ms > win[1]
+
+
+# ---------------------------------------------------------------------------
 # degradation: sweep failures never touch the serve path
 # ---------------------------------------------------------------------------
 
