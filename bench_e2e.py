@@ -652,6 +652,139 @@ def bench_wal(repeats: int, n_series: int = 500,
     return out
 
 
+def bench_ingest(repeats: int, n_points: int = 120_000,
+                 n_series: int = 200) -> dict:
+    """Durable ingest raw speed through the three front doors —
+    telnet ``put`` line bursts (columnar batch decode), HTTP
+    ``/api/put`` JSON bodies, and the import buffer — with the WAL
+    off vs ``fsync=always`` (acked => fsynced). Also measures the
+    PER-REQUEST durable rate (one point per telnet line / HTTP body,
+    one fsync each — the pre-group-commit behavior) as the baseline
+    the batch path must beat.
+
+    Criteria: durable batch ingest >= 1/3 of the WAL-off rate on the
+    import path (the 10x durability tax collapses to <= 3x), and the
+    batched telnet/HTTP durable rates >= 3x their per-request rates.
+    """
+    import json as _json
+    import shutil
+    import tempfile
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+    from opentsdb_tpu.tsd.telnet import TelnetRouter
+
+    rng = np.random.default_rng(23)
+    ts = BASE_S + np.arange(n_points, dtype=np.int64) % 7200
+    hosts = np.arange(n_points) % n_series
+    vals = np.round(rng.normal(100, 10, n_points), 2)
+    telnet_lines = [f"put sys.ing {ts[i]} {vals[i]} host=h{hosts[i]:04d}"
+                    for i in range(n_points)]
+    import_buf = "".join(
+        f"sys.ing {ts[i]} {vals[i]} host=h{hosts[i]:04d}\n"
+        for i in range(n_points)).encode()
+    put_dicts = [{"metric": "sys.ing", "timestamp": int(ts[i]),
+                  "value": float(vals[i]),
+                  "tags": {"host": f"h{hosts[i]:04d}"}}
+                 for i in range(n_points)]
+
+    def mk(cfg):
+        d = tempfile.mkdtemp(prefix="ingbench-")
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.storage.backend": "memory",
+            "tsd.storage.data_dir": d, **cfg}))
+        return d, t
+
+    def run(door, cfg, points) -> float:
+        """Best-of-repeats Mpps for one front door x WAL config."""
+        best = float("inf")
+        for _ in range(max(1, repeats // 2)):
+            d, t = mk(cfg)
+            try:
+                if door == "import":
+                    t0 = time.perf_counter()
+                    written, errs = t.import_buffer(import_buf)
+                    dt = time.perf_counter() - t0
+                elif door == "telnet":
+                    router = TelnetRouter(t)
+                    burst = 4096  # ~one socket read's worth of lines
+                    t0 = time.perf_counter()
+                    for lo in range(0, points, burst):
+                        resp, _exc = router.execute_lines(
+                            telnet_lines[lo:lo + burst])
+                        assert not resp, resp
+                    dt = time.perf_counter() - t0
+                elif door == "http":
+                    router = HttpRpcRouter(t)
+                    body_pts = 2000  # one /api/put body
+                    bodies = [
+                        _json.dumps(put_dicts[lo:lo + body_pts])
+                        .encode()
+                        for lo in range(0, points, body_pts)]
+                    t0 = time.perf_counter()
+                    for body in bodies:
+                        r = router.handle(HttpRequest(
+                            "POST", "/api/put", {}, body=body))
+                        assert r.status == 204, r.body
+                    dt = time.perf_counter() - t0
+                elif door == "telnet_scalar":
+                    router = TelnetRouter(t)
+                    t0 = time.perf_counter()
+                    for ln in telnet_lines[:points]:
+                        out = router.execute(ln)
+                        assert not out, out
+                    dt = time.perf_counter() - t0
+                else:  # http_scalar: one point per request body
+                    router = HttpRpcRouter(t)
+                    bodies = [_json.dumps([dp]).encode()
+                              for dp in put_dicts[:points]]
+                    t0 = time.perf_counter()
+                    for body in bodies:
+                        r = router.handle(HttpRequest(
+                            "POST", "/api/put", {}, body=body))
+                        assert r.status == 204, r.body
+                    dt = time.perf_counter() - t0
+                assert t.store.total_points() > 0
+                best = min(best, dt)
+                if t.wal is not None:
+                    t.wal.close()
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+        return best
+
+    wal_off = {"tsd.storage.wal.enable": "false"}
+    wal_on = {"tsd.storage.wal.fsync": "always"}
+    out = {"config": "ingest", "points": n_points,
+           "series": n_series}
+    for door in ("import", "telnet", "http"):
+        n = n_points
+        out[f"{door}_mpps_off"] = round(n / run(door, wal_off, n) / 1e6,
+                                        3)
+        out[f"{door}_mpps_durable"] = round(
+            n / run(door, wal_on, n) / 1e6, 3)
+    # per-request (pre-overhaul) durable baselines: one fsync per
+    # point — sized down, these are the slow paths being replaced
+    scalar_n = 3000
+    out["telnet_scalar_kpps_durable"] = round(
+        scalar_n / run("telnet_scalar", wal_on, scalar_n) / 1e3, 2)
+    out["http_scalar_kpps_durable"] = round(
+        scalar_n / run("http_scalar", wal_on, scalar_n) / 1e3, 2)
+    out["durability_tax"] = round(
+        out["import_mpps_off"] / max(out["import_mpps_durable"], 1e-9),
+        2)
+    out["telnet_batch_vs_scalar"] = round(
+        out["telnet_mpps_durable"] * 1e3
+        / max(out["telnet_scalar_kpps_durable"], 1e-9), 1)
+    out["http_batch_vs_scalar"] = round(
+        out["http_mpps_durable"] * 1e3
+        / max(out["http_scalar_kpps_durable"], 1e-9), 1)
+    out["criterion_pass"] = bool(
+        out["durability_tax"] <= 3.0
+        and out["telnet_batch_vs_scalar"] >= 3.0
+        and out["http_batch_vs_scalar"] >= 3.0)
+    return out
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -675,7 +808,8 @@ def main() -> None:
                3: lambda r: bench_config3(r, args.series3),
                4: bench_config4, 5: bench_config5,
                "wal": bench_wal, "live": bench_live,
-               "lifecycle": bench_lifecycle, "cold": bench_cold}
+               "lifecycle": bench_lifecycle, "cold": bench_cold,
+               "ingest": bench_ingest}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

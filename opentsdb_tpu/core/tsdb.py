@@ -10,6 +10,7 @@ rw/ro/wo (:103).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -234,7 +235,13 @@ class TSDB:
                     retry=RetryPolicy.from_config(
                         self.config, "tsd.storage.wal.retry"),
                     resync_ms=self.config.get_int(
-                        "tsd.storage.wal.resync_interval_ms"))
+                        "tsd.storage.wal.resync_interval_ms"),
+                    group_window_ms=self.config.get_int(
+                        "tsd.storage.wal.group_window_ms", 0),
+                    group_max_records=self.config.get_int(
+                        "tsd.storage.wal.group_max_records", 4096),
+                    group_max_bytes=self.config.get_int(
+                        "tsd.storage.wal.group_max_bytes", 4 << 20))
                 self.stats.register(wal)
                 # snapshot-covered sids keep their numbering on load
                 # (histograms WAL by name, not sid — nothing to seed)
@@ -292,6 +299,17 @@ class TSDB:
     # ------------------------------------------------------------------
     # write path (ref: TSDB.java:1012-1291)
     # ------------------------------------------------------------------
+
+    def _wal_scope(self):
+        """One ingest request's WAL batch scope: every record appended
+        inside lands as a single framed write, and all the deferred
+        ``sync()`` calls collapse into at most one group-committed
+        fsync at scope exit (see :meth:`WriteAheadLog.batch`). No-op
+        when the WAL is off. Callers must not acknowledge
+        durability-requiring writes until the scope exits."""
+        if self.wal is None:
+            return contextlib.nullcontext()
+        return self.wal.batch()
 
     def _run_hook(self, name: str, fn, *args) -> None:
         """Run one post-write hook (realtime publisher, meta tracking,
@@ -442,18 +460,23 @@ class TSDB:
             flags = np.asarray(is_int, dtype=bool)
         if (self.write_filters or self.rt_publisher is not None
                 or self.meta_cache is not None):
-            # inherently per-point hooks; batch already validated
+            # inherently per-point hooks; batch already validated.
+            # The WAL scope commits durability ONCE at batch end
+            # instead of one fsync per fallback point — and still
+            # commits on a raise (PartialWriteError reports already-
+            # landed points, so they must be on the durability path)
             sid = -1
             done = 0
-            for t, v, f in zip(ts.tolist(), vals.tolist(),
-                               flags.tolist()):
-                try:
-                    sid = self.add_point(metric, t,
-                                         int(v) if f else float(v),
-                                         tags)
-                except Exception as e:  # noqa: BLE001
-                    raise PartialWriteError(done, e) from e
-                done += 1
+            with self._wal_scope():
+                for t, v, f in zip(ts.tolist(), vals.tolist(),
+                                   flags.tolist()):
+                    try:
+                        sid = self.add_point(metric, t,
+                                             int(v) if f else float(v),
+                                             tags)
+                    except Exception as e:  # noqa: BLE001
+                        raise PartialWriteError(done, e) from e
+                    done += 1
             return sid
         metric_id, tag_ids = self._resolve_write_uids(metric, tags)
         sid = self.store.get_or_create_series(metric_id, tag_ids)
@@ -461,9 +484,13 @@ class TSDB:
         fvals = vals.astype(np.float64)
         self.store.append_many(sid, ts_ms, fvals, flags)
         if self.wal is not None:
-            self.wal.ensure_series("data", sid, metric, tags)
-            self.wal.log_points("data", sid, ts_ms, fvals, flags)
-            self.wal.sync()
+            # batch scope: identity + points + sync land as one framed
+            # write under one lock take (joins any enclosing request
+            # scope, e.g. add_point_groups')
+            with self.wal.batch():
+                self.wal.ensure_series("data", sid, metric, tags)
+                self.wal.log_points("data", sid, ts_ms, fvals, flags)
+                self.wal.sync()
         self.datapoints_added += len(ts)
         if self._streaming is not None:
             self._run_hook("stream.tap", self._streaming.offer_many,
@@ -482,53 +509,75 @@ class TSDB:
         Returns (points_written, error strings); ``on_error(i, exc)``
         additionally receives the input index of each failing point.
         """
-        groups: dict[tuple, list] = {}
+        groups: dict[tuple, tuple] = {}
+        for i, (metric, ts, value, tags) in enumerate(points):
+            key = (metric, tuple(sorted(tags.items())))
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = (metric, tags, [], [], [])
+            g[2].append(i)
+            g[3].append(ts)
+            g[4].append(value)
+        return self.add_point_groups(groups.values(),
+                                     on_error=on_error)
+
+    def add_point_groups(self, groups, on_error=None
+                         ) -> tuple[int, list[str]]:
+        """Columnar bulk write of points already grouped by series:
+        ``groups`` yields ``(metric, tags, refs, timestamps, values)``
+        where ``refs[i]`` is an opaque per-point handle handed back to
+        ``on_error(ref, exc)`` for failing points. The whole request
+        runs under ONE WAL batch scope — an N-group put body commits
+        as a single framed WAL write and a single group-committed
+        fsync instead of one sync per series-group. A group whose
+        bulk write fails replays per point so every valid point still
+        lands and errors stay per-point."""
         errors: list[str] = []
         written = 0
 
-        def fail(idx: int, metric: str, ts, e: Exception) -> None:
+        def fail(ref, metric: str, ts, e: Exception) -> None:
             errors.append(f"{metric} @{ts}: {e}")
             if on_error is not None:
-                on_error(idx, e)
+                on_error(ref, e)
 
-        for i, (metric, ts, value, tags) in enumerate(points):
-            key = (metric, tuple(sorted(tags.items())))
-            groups.setdefault(key, []).append((i, ts, value, tags))
-        for (metric, _), items in groups.items():
-            try:
-                n = len(items)
-                ts_arr = np.asarray([it[1] for it in items],
-                                    dtype=np.int64)
-                raw = [it[2] for it in items]
-                vals = np.asarray(raw, dtype=np.float64)
-                # type(v) is int: excludes bool, one pass
-                flags = np.fromiter((type(v) is int for v in raw),
-                                    dtype=bool, count=n)
-                self.add_points(metric, ts_arr, vals, items[0][3],
-                                is_int=flags)
-                written += n
-            except PartialWriteError as pe:
-                # the hook-fallback loop landed pe.written points; the
-                # next one failed mid-hooks (don't retry it — hooks are
-                # not idempotent); the rest replay per point
-                written += pe.written
-                idx, t, _v, _tg = items[pe.written]
-                fail(idx, metric, t, pe.cause)
-                for idx, t, v, tg in items[pe.written + 1:]:
-                    try:
-                        self.add_point(metric, t, v, tg)
-                        written += 1
-                    except Exception as e:  # noqa: BLE001
-                        fail(idx, metric, t, e)
-            except Exception:  # noqa: BLE001
-                # bulk path failed before anything landed: per-point
-                # replay so valid points land and errors map back
-                for idx, t, v, tg in items:
-                    try:
-                        self.add_point(metric, t, v, tg)
-                        written += 1
-                    except Exception as e:  # noqa: BLE001
-                        fail(idx, metric, t, e)
+        with self._wal_scope():
+            for metric, tags, refs, ts_list, raw in groups:
+                try:
+                    n = len(ts_list)
+                    ts_arr = np.asarray(ts_list, dtype=np.int64)
+                    vals = np.asarray(raw, dtype=np.float64)
+                    # type(v) is int: excludes bool, one pass
+                    flags = np.fromiter((type(v) is int for v in raw),
+                                        dtype=bool, count=n)
+                    self.add_points(metric, ts_arr, vals, tags,
+                                    is_int=flags)
+                    written += n
+                except PartialWriteError as pe:
+                    # the hook-fallback loop landed pe.written points;
+                    # the next one failed mid-hooks (don't retry it —
+                    # hooks are not idempotent); the rest replay per
+                    # point
+                    written += pe.written
+                    k = pe.written
+                    fail(refs[k], metric, ts_list[k], pe.cause)
+                    for j in range(k + 1, len(ts_list)):
+                        try:
+                            self.add_point(metric, ts_list[j], raw[j],
+                                           tags)
+                            written += 1
+                        except Exception as e:  # noqa: BLE001
+                            fail(refs[j], metric, ts_list[j], e)
+                except Exception:  # noqa: BLE001
+                    # bulk path failed before anything landed: per-
+                    # point replay so valid points land and errors map
+                    # back
+                    for j in range(len(ts_list)):
+                        try:
+                            self.add_point(metric, ts_list[j], raw[j],
+                                           tags)
+                            written += 1
+                        except Exception as e:  # noqa: BLE001
+                            fail(refs[j], metric, ts_list[j], e)
         return written, errors
 
     def import_buffer(self, buf: bytes, on_error=None,
@@ -602,24 +651,27 @@ class TSDB:
         written = 0
         if use_hooks:
             # per-point hooks are inherently per-datapoint: group runs
-            # still amortize the metric/tag resolution
-            for g in range(parsed.num_groups):
-                if isinstance(ginfo[g], Exception):
-                    continue
-                metric, tags, _, _ = ginfo[g]
-                members = np.nonzero(parsed.group_ids == g)[0]
-                for i, t, v, f in zip(
-                        members.tolist(),
-                        parsed.ts[members].tolist(),
-                        parsed.values[members].tolist(),
-                        parsed.is_int[members].tolist()):
-                    try:
-                        self.add_point(metric, t,
-                                       int(v) if f else v, tags,
-                                       durable=durable)
-                        written += 1
-                    except Exception as e:  # noqa: BLE001
-                        fail(i + 1, str(e))
+            # still amortize the metric/tag resolution, and the WAL
+            # scope commits ONE fsync for the whole buffer instead of
+            # one per point
+            with self._wal_scope():
+                for g in range(parsed.num_groups):
+                    if isinstance(ginfo[g], Exception):
+                        continue
+                    metric, tags, _, _ = ginfo[g]
+                    members = np.nonzero(parsed.group_ids == g)[0]
+                    for i, t, v, f in zip(
+                            members.tolist(),
+                            parsed.ts[members].tolist(),
+                            parsed.values[members].tolist(),
+                            parsed.is_int[members].tolist()):
+                        try:
+                            self.add_point(metric, t,
+                                           int(v) if f else v, tags,
+                                           durable=durable)
+                            written += 1
+                        except Exception as e:  # noqa: BLE001
+                            fail(i + 1, str(e))
             return written, errors
         if parsed.num_groups == 0:
             return 0, errors
@@ -634,15 +686,18 @@ class TSDB:
         if self.wal is not None and durable:
             # durable=False ≙ the reference's batch-import WAL opt-out
             # (PutRequest.setDurable(false), IncomingDataPoints:355-360)
-            for g in range(parsed.num_groups):
-                info = ginfo[g]
-                if isinstance(info, Exception):
-                    continue
-                self.wal.ensure_series("data", int(gsid[g]), info[0],
-                                       info[1])
-            self.wal.log_lines("data", line_sids, ts_ms,
-                               parsed.values, parsed.is_int)
-            self.wal.sync()
+            # batch scope: N ensure_series + the lines record land as
+            # one framed write under one lock take, one fsync
+            with self.wal.batch():
+                for g in range(parsed.num_groups):
+                    info = ginfo[g]
+                    if isinstance(info, Exception):
+                        continue
+                    self.wal.ensure_series("data", int(gsid[g]),
+                                           info[0], info[1])
+                self.wal.log_lines("data", line_sids, ts_ms,
+                                   parsed.values, parsed.is_int)
+                self.wal.sync()
         self.datapoints_added += written
         if self._streaming is not None and written:
             for g in range(parsed.num_groups):
@@ -728,54 +783,56 @@ class TSDB:
         for i, (metric, ts, blob, tags) in enumerate(points):
             key = (metric, tuple(sorted(tags.items())))
             groups.setdefault(key, []).append((i, ts, blob, tags))
-        for (metric, _), items in groups.items():
-            tags = items[0][3]
-            try:
-                tags_mod.check_metric_and_tags(metric, tags)
-            except Exception as e:  # noqa: BLE001
-                for idx, ts, _b, _t in items:
-                    fail(idx, metric, ts, e)
-                continue
-            # validate + decode every point BEFORE touching the UID
-            # tables: a fully-invalid group must not pollute UID space
-            # or create an empty series (matches add_histogram_point,
-            # which validates first and creates nothing on failure)
-            valid: list[tuple] = []
-            for idx, ts, blob, _t in items:
+        with self._wal_scope():
+            for (metric, _), items in groups.items():
+                tags = items[0][3]
                 try:
-                    self._check_timestamp(ts)
-                    hist = self.histogram_manager.decode(blob)
-                    valid.append((idx, ts, blob,
-                                  codec.to_ms(ts), hist))
+                    tags_mod.check_metric_and_tags(metric, tags)
                 except Exception as e:  # noqa: BLE001
-                    fail(idx, metric, ts, e)
-            if not valid:
-                continue
-            try:
-                metric_id, tag_ids = self._resolve_write_uids(metric,
-                                                              tags)
-                sid = self.histogram_store.get_or_create_series(
-                    metric_id, tag_ids)
-            except Exception as e:  # noqa: BLE001
-                for idx, ts, _b, _tm, _h in valid:
-                    fail(idx, metric, ts, e)
-                continue
-            # one lock take for the whole group's appends
-            with self._histogram_lock:
-                arena = self._histogram_arenas.get(metric_id)
-                if arena is None:
-                    arena = self._histogram_arenas[metric_id] = \
-                        HistogramArena()
-                for _idx, _ts, _b, ts_ms, hist in valid:
-                    arena.append(ts_ms, sid, hist)
-                self._histogram_version += 1
-            if self.wal is not None:
-                for _idx, ts, blob, _tm, _h in valid:
-                    self.wal.log_histogram(metric, tags, ts, blob)
-            self.datapoints_added += len(valid)
-            written += len(valid)
-        if written and self.wal is not None:
-            self.wal.sync()
+                    for idx, ts, _b, _t in items:
+                        fail(idx, metric, ts, e)
+                    continue
+                # validate + decode every point BEFORE touching the
+                # UID tables: a fully-invalid group must not pollute
+                # UID space or create an empty series (matches
+                # add_histogram_point, which validates first and
+                # creates nothing on failure)
+                valid: list[tuple] = []
+                for idx, ts, blob, _t in items:
+                    try:
+                        self._check_timestamp(ts)
+                        hist = self.histogram_manager.decode(blob)
+                        valid.append((idx, ts, blob,
+                                      codec.to_ms(ts), hist))
+                    except Exception as e:  # noqa: BLE001
+                        fail(idx, metric, ts, e)
+                if not valid:
+                    continue
+                try:
+                    metric_id, tag_ids = self._resolve_write_uids(
+                        metric, tags)
+                    sid = self.histogram_store.get_or_create_series(
+                        metric_id, tag_ids)
+                except Exception as e:  # noqa: BLE001
+                    for idx, ts, _b, _tm, _h in valid:
+                        fail(idx, metric, ts, e)
+                    continue
+                # one lock take for the whole group's appends
+                with self._histogram_lock:
+                    arena = self._histogram_arenas.get(metric_id)
+                    if arena is None:
+                        arena = self._histogram_arenas[metric_id] = \
+                            HistogramArena()
+                    for _idx, _ts, _b, ts_ms, hist in valid:
+                        arena.append(ts_ms, sid, hist)
+                    self._histogram_version += 1
+                if self.wal is not None:
+                    for _idx, ts, blob, _tm, _h in valid:
+                        self.wal.log_histogram(metric, tags, ts, blob)
+                self.datapoints_added += len(valid)
+                written += len(valid)
+            if written and self.wal is not None:
+                self.wal.sync()
         return written, errors
 
     def add_histogram_point(self, metric: str, timestamp: int,

@@ -68,7 +68,15 @@ def load_library():
         except NativeBuildError as e:
             _build_error = str(e)
             raise
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            # a stale/corrupt/ABI-incompatible cached .so must behave
+            # exactly like a failed build: negative-cached (CDLL is
+            # retried per call otherwise) and surfaced as
+            # NativeBuildError so every caller's fallback engages
+            _build_error = f"cannot load {path}: {e}"
+            raise NativeBuildError(_build_error) from e
         lib.tss_create.restype = ctypes.c_void_p
         lib.tss_destroy.argtypes = [ctypes.c_void_p]
         lib.tss_add_series.argtypes = [ctypes.c_void_p]
@@ -589,15 +597,133 @@ class ParsedImport:
         self.num_lines = num_lines
 
 
+# byte classes mirrored from tsdbstore.cc's parser: names allow the
+# reference's charset (alnum -_./ plus UTF-8 lead/continuation bytes,
+# re-validated python-side for non-ASCII); values allow the decimal
+# float shape ONLY — strtod leniency (nan/inf/hex) and python
+# int()/float() leniency (underscores, unicode digits) must both be
+# rejected or a malformed value silently stores the wrong number
+_NAME_BYTES = frozenset(
+    b"abcdefghijklmnopqrstuvwxyz"
+    b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_./")
+_FLOAT_BYTES = frozenset(b"0123456789.+-eE")
+
+
+def _py_valid_name(tok: bytes) -> bool:
+    return bool(tok) and all(c in _NAME_BYTES or c >= 0x80
+                             for c in tok)
+
+
+def _parse_import_py(buf: bytes) -> ParsedImport:
+    """Pure-Python twin of ``tss_parse_import`` for toolchain-less
+    hosts (numpy column outputs, same error codes / strict value
+    shape / grouping semantics) — the columnar ingest decode must not
+    depend on a C++ compiler being present."""
+    lines = buf.split(b"\n")
+    if buf.endswith(b"\n"):
+        lines.pop()
+    n = len(lines)
+    ts = np.zeros(n, dtype=np.int64)
+    vals = np.zeros(n, dtype=np.float64)
+    ints = np.zeros(n, dtype=np.uint8)
+    gids = np.full(n, -1, dtype=np.int64)
+    errs = np.zeros(n, dtype=np.int32)
+    group_map: dict[bytes, int] = {}
+    reps: list[bytes] = []
+    prev_key = None
+    prev_gid = -1
+    max_ts = 1 << 47
+    for i, line in enumerate(lines):
+        if line.endswith(b"\r"):
+            line = line[:-1]
+        stripped = line.strip()
+        if not stripped or stripped.startswith(b"#"):
+            errs[i] = -1
+            continue
+        toks = line.replace(b"\t", b" ").split()
+        if len(toks) < 4:
+            errs[i] = 1
+            continue
+        if len(toks) > 16:
+            errs[i] = 4
+            continue
+        if not _py_valid_name(toks[0]):
+            errs[i] = 5
+            continue
+        t = toks[1]
+        if not (0 < len(t) < 15 and t.isdigit()):
+            errs[i] = 2
+            continue
+        tval = int(t)
+        if tval <= 0 or tval > max_ts:
+            errs[i] = 2
+            continue
+        ts[i] = tval
+        v = toks[2]
+        st = 1 if v[:1] in (b"-", b"+") else 0
+        digits = v[st:]
+        if digits and len(digits) < 19 and digits.isdigit():
+            acc = int(digits)
+            vals[i] = -float(acc) if v[:1] == b"-" else float(acc)
+            ints[i] = 1
+        else:
+            ok = 0 < len(v) < 64 and all(c in _FLOAT_BYTES for c in v)
+            if ok:
+                try:
+                    fv = float(v)
+                    ok = fv == fv  # strtod parity: NaN rejected
+                except ValueError:
+                    ok = False
+            if not ok:
+                errs[i] = 3
+                continue
+            vals[i] = fv
+            ints[i] = 0
+        tags = toks[3:]
+        if len(tags) > 8:  # the reference's hard tag cap
+            errs[i] = 4
+            continue
+        bad = 0
+        for tag in tags:
+            eq = tag.find(b"=")
+            if eq <= 0 or eq == len(tag) - 1:
+                bad = 4
+                break
+            if not _py_valid_name(tag[:eq]) or \
+                    not _py_valid_name(tag[eq + 1:]):
+                bad = 5
+                break
+        if bad:
+            errs[i] = bad
+            continue
+        key = toks[0] + b" " + b" ".join(sorted(tags))
+        if prev_gid >= 0 and key == prev_key:
+            gid = prev_gid
+        else:
+            gid = group_map.get(key)
+            if gid is None:
+                gid = len(group_map)
+                group_map[key] = gid
+                reps.append(line)
+            prev_key, prev_gid = key, gid
+        gids[i] = gid
+    return ParsedImport(ts, vals, ints, gids, errs, reps,
+                        len(group_map), n)
+
+
 def parse_import_buffer(buf: bytes,
                         threads: int | None = None) -> ParsedImport:
     """Parse a whole import text buffer in one native pass, parallel
-    over newline-aligned chunks."""
-    lib = load_library()
+    over newline-aligned chunks (pure-Python columnar fallback when
+    the native library cannot build)."""
     if not buf:
         e = np.empty(0, dtype=np.int64)
         return ParsedImport(e, np.empty(0), np.empty(0, np.uint8),
                             e.copy(), np.empty(0, np.int32), [], 0, 0)
+    try:
+        lib = load_library()
+    except NativeBuildError:
+        return _parse_import_py(buf)
     if threads is None:
         threads = min(16, os.cpu_count() or 1)
     nl = lib.tss_count_lines(buf, len(buf))

@@ -28,7 +28,6 @@ import os
 import sys
 import time
 
-from opentsdb_tpu.core import tags as tags_mod
 from opentsdb_tpu.utils.config import Config
 from opentsdb_tpu.utils import datetime_util
 
@@ -198,11 +197,11 @@ def cmd_import(config: Config, args: list[str]) -> int:
     """(ref: TextImporter.java:40) Lines: ``metric ts value tagk=tagv...``
     Gzip files auto-detected by extension.
 
-    Files stream through the native columnar import
-    (``TSDB.import_buffer``): one C++ pass parses each chunk, UID
-    resolution runs once per distinct series, and points land via bulk
-    appends — falling back to the per-line path if the native library
-    is unavailable.
+    Files stream through the columnar import
+    (``TSDB.import_buffer``): one pass parses each chunk (native C++
+    when the toolchain exists, the strict pure-Python twin otherwise),
+    UID resolution runs once per distinct series, points land via bulk
+    appends, and each chunk commits as one WAL write + one fsync.
 
     ``--no-wal`` skips write-ahead logging for the bulk load (parity
     with the reference batch import's ``setDurable(false)``,
@@ -220,113 +219,54 @@ def cmd_import(config: Config, args: list[str]) -> int:
     start = time.monotonic()
     CHUNK_BYTES = 64 << 20
 
-    def native_available() -> bool:
-        try:
-            from opentsdb_tpu.native.store_backend import load_library
-            load_library()
-            return True
-        except Exception:  # noqa: BLE001
-            return False
-
     class _TooManyErrors(Exception):
         pass
 
-    if native_available():
-        for path in args:
-            opener = gzip.open if path.endswith(".gz") else open
-            base_line = 0
+    # the columnar import path no longer needs the native library —
+    # parse_import_buffer carries a strict pure-Python twin, so every
+    # host gets the one-pass decode + batched WAL commit per chunk
+    for path in args:
+        opener = gzip.open if path.endswith(".gz") else open
+        base_line = 0
 
-            def on_error(i: int, e: Exception) -> None:
-                # stop printing (and abort) promptly at the cap — a
-                # binary/wrong-format chunk can hold millions of bad
-                # lines
-                nonlocal errors
-                errors += 1
-                if errors <= 100:
-                    print(f"error: {path}:{base_line + i}: {e}",
-                          file=sys.stderr)
-                else:
-                    raise _TooManyErrors
+        def on_error(i: int, e: Exception) -> None:
+            # stop printing (and abort) promptly at the cap — a
+            # binary/wrong-format chunk can hold millions of bad
+            # lines
+            nonlocal errors
+            errors += 1
+            if errors <= 100:
+                print(f"error: {path}:{base_line + i}: {e}",
+                      file=sys.stderr)
+            else:
+                raise _TooManyErrors
 
-            with opener(path, "rb") as fh:
-                tail = b""
-                while True:
-                    block = fh.read(CHUNK_BYTES)
-                    if not block:
-                        buf, tail = tail, b""
-                        if not buf:
-                            break
-                    else:
-                        block = tail + block
-                        cut = block.rfind(b"\n")
-                        if cut < 0:
-                            tail = block
-                            continue
-                        buf, tail = block[:cut + 1], block[cut + 1:]
-                    try:
-                        written, _ = tsdb.import_buffer(
-                            buf, on_error=on_error, durable=durable)
-                    except _TooManyErrors:
-                        print("too many errors, aborting",
-                              file=sys.stderr)
-                        return 1
-                    total += written
-                    base_line += buf.count(b"\n")
-                    if not block:
+        with opener(path, "rb") as fh:
+            tail = b""
+            while True:
+                block = fh.read(CHUNK_BYTES)
+                if not block:
+                    buf, tail = tail, b""
+                    if not buf:
                         break
-    else:
-        # portable fallback: per-line parse into the batched write path
-        chunk: list = []
-        CHUNK = 100_000
-
-        def flush_chunk() -> int:
-            nonlocal total, errors
-            refs = [item[0] for item in chunk]
-
-            def on_error(i: int, e: Exception) -> None:
-                nonlocal errors
-                errors += 1
-                print(f"error: {refs[i]}: {e}", file=sys.stderr)
-
-            written, _ = tsdb.add_point_batch(
-                [item[1:] for item in chunk], on_error=on_error)
-            total += written
-            chunk.clear()
-            if errors > 100:
-                print("too many errors, aborting", file=sys.stderr)
-                return 1
-            return 0
-
-        for path in args:
-            opener = gzip.open if path.endswith(".gz") else open
-            with opener(path, "rt", encoding="utf-8") as fh:
-                for lineno, line in enumerate(fh, 1):
-                    line = line.strip()
-                    if not line or line.startswith("#"):
+                else:
+                    block = tail + block
+                    cut = block.rfind(b"\n")
+                    if cut < 0:
+                        tail = block
                         continue
-                    try:
-                        words = line.split()
-                        metric, ts_raw, val_raw = (words[0], words[1],
-                                                   words[2])
-                        value = (float(val_raw) if "." in val_raw
-                                 or "e" in val_raw.lower()
-                                 else int(val_raw))
-                        tags = dict(tags_mod.parse(w)
-                                    for w in words[3:])
-                        chunk.append((f"{path}:{lineno}", metric,
-                                      int(ts_raw), value, tags))
-                    except Exception as e:  # noqa: BLE001
-                        errors += 1
-                        print(f"error: {path}:{lineno}: {e}",
-                              file=sys.stderr)
-                        if errors > 100:
-                            print("too many errors, aborting",
-                                  file=sys.stderr)
-                            return 1
-                    if len(chunk) >= CHUNK and flush_chunk():
-                        return 1
-        if flush_chunk():
-            return 1
+                    buf, tail = block[:cut + 1], block[cut + 1:]
+                try:
+                    written, _ = tsdb.import_buffer(
+                        buf, on_error=on_error, durable=durable)
+                except _TooManyErrors:
+                    print("too many errors, aborting",
+                          file=sys.stderr)
+                    return 1
+                total += written
+                base_line += buf.count(b"\n")
+                if not block:
+                    break
     tsdb.flush()
     dt = time.monotonic() - start
     rate = total / dt if dt > 0 else 0

@@ -27,6 +27,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from opentsdb_tpu import __version__
+from opentsdb_tpu.core.tags import parse_put_value as \
+    tags_parse_put_value
 from opentsdb_tpu.meta.annotation import Annotation
 # importing logring attaches the /logs ring buffer as early as the
 # HTTP layer loads, so boot-time records are already captured (ref:
@@ -373,36 +375,8 @@ class HttpRpcRouter:
         details = request.flag("details")
         summary = request.flag("summary")
         errors: list[dict] = []
-        # parse every point, then write through the series-grouped bulk
-        # path; failed groups replay per point inside add_point_batch so
-        # error reporting and SEH spooling stay per-datapoint
-        parsed: list[tuple] = []
-        dps: list[dict] = []
-        for dp in points:
-            try:
-                metric = dp["metric"]
-                ts = int(dp["timestamp"])
-                value = dp["value"]
-                if isinstance(value, str):
-                    value = (float(value) if
-                             ("." in value or "e" in value.lower())
-                             else int(value))
-                elif value is None or isinstance(value, bool) or \
-                        not isinstance(value, (int, float)):
-                    # (ref: PutDataPointRpc rejects null/empty values
-                    # per datapoint)
-                    raise ValueError(f"invalid value: {value!r}")
-                tags = dp.get("tags") or {}
-                parsed.append((metric, ts, value, tags))
-                dps.append(dp)
-            except (KeyError, TypeError) as e:
-                errors.append({"datapoint": dp,
-                               "error": f"missing field: {e}"})
-            except ValueError as e:
-                errors.append({"datapoint": dp, "error": str(e)})
 
-        def on_error(i: int, e: Exception) -> None:
-            dp = dps[i]
+        def spool(dp: dict, e: Exception) -> None:
             errors.append({"datapoint": dp, "error": str(e)})
             seh = self.tsdb.storage_exception_handler
             from opentsdb_tpu.core.uid import FailedToAssignUniqueIdError
@@ -415,7 +389,55 @@ class HttpRpcRouter:
                 # (ref: PutDataPointRpc requeue via SEH plugin)
                 seh.handle_error(dp, e)
 
-        success, _ = self.tsdb.add_point_batch(parsed, on_error=on_error)
+        t = self.tsdb
+        use_hooks = (bool(t.write_filters) or t.rt_publisher is not None
+                     or t.meta_cache is not None)
+        # validate + group in ONE pass straight into per-series
+        # columns: no per-point tuple materialization, and the grouped
+        # write commits the whole body as a single WAL write + fsync
+        # (add_point_groups). Per-point hook plugins force the tuple
+        # path below instead — those hooks are inherently per-point.
+        groups: dict[tuple, tuple] = {}
+        parsed: list[tuple] = []
+        dps: list[dict] = []
+        for dp in points:
+            try:
+                metric = dp["metric"]
+                ts = int(dp["timestamp"])
+                value = dp["value"]
+                if isinstance(value, str):
+                    # strict parse: int()/float() leniency would store
+                    # e.g. "1_0" as 10 instead of erroring
+                    value = tags_parse_put_value(value)
+                elif value is None or isinstance(value, bool) or \
+                        not isinstance(value, (int, float)):
+                    # (ref: PutDataPointRpc rejects null/empty values
+                    # per datapoint)
+                    raise ValueError(f"invalid value: {value!r}")
+                tags = dp.get("tags") or {}
+                if use_hooks:
+                    parsed.append((metric, ts, value, tags))
+                    dps.append(dp)
+                else:
+                    key = (metric, tuple(sorted(tags.items())))
+                    g = groups.get(key)
+                    if g is None:
+                        g = groups[key] = (metric, tags, [], [], [])
+                    g[2].append(dp)
+                    g[3].append(ts)
+                    g[4].append(value)
+            except (KeyError, TypeError) as e:
+                errors.append({"datapoint": dp,
+                               "error": f"missing field: {e}"})
+            except ValueError as e:
+                errors.append({"datapoint": dp, "error": str(e)})
+
+        if use_hooks:
+            success, _ = self.tsdb.add_point_batch(
+                parsed, on_error=lambda i, e: spool(dps[i], e))
+        else:
+            success, _ = self.tsdb.add_point_groups(
+                groups.values(), on_error=spool)
         failed = len(errors)
         if not details and not summary:
             if failed:
@@ -439,7 +461,12 @@ class HttpRpcRouter:
             try:
                 value = dp["value"]
                 if isinstance(value, str):
-                    value = float(value)
+                    # same strict rule as /api/put: reject underscore/
+                    # whitespace forms float() would silently accept
+                    # (allow_special keeps the NaN/Infinity spellings
+                    # float() always took on this endpoint)
+                    value = float(tags_parse_put_value(
+                        value, allow_special=True))
                 self.tsdb.add_aggregate_point(
                     dp["metric"], int(dp["timestamp"]), value,
                     dp.get("tags") or {},

@@ -440,20 +440,25 @@ class TSDServer:
         authed = self.tsdb.authentication is None
         auth_state = None
         while True:
-            line_end = buffer.find(b"\n")
-            if line_end < 0:
-                chunk = await self._on_client(reader.read(4096))
+            if buffer.find(b"\n") < 0:
+                chunk = await self._on_client(reader.read(65536))
                 if not chunk:
                     break
                 buffer += chunk
                 continue
-            line = buffer[:line_end].rstrip(b"\r").decode(
-                "utf-8", "replace")
-            buffer = buffer[line_end + 1:]
-            if not authed:
+            # drain EVERY complete line already buffered: a pipelined
+            # put burst decodes as ONE columnar batch (one WAL write +
+            # one group-committed fsync) instead of one command — and
+            # one fsync — per loop turn (TelnetRouter.execute_lines)
+            raw, _, buffer = buffer.rpartition(b"\n")
+            lines = [ln.rstrip(b"\r").decode("utf-8", "replace")
+                     for ln in raw.split(b"\n")]
+            idx = 0
+            while not authed and idx < len(lines):
                 # first exchange must be auth
                 # (ref: AuthenticationChannelHandler.java:50)
-                words = line.split()
+                words = lines[idx].split()
+                idx += 1
                 if words and words[0] == "auth":
                     state = self.tsdb.authentication.authenticate_telnet(
                         words)
@@ -466,15 +471,17 @@ class TSDServer:
                 else:
                     writer.write(b"auth_fail\n")
                 await self._on_client(writer.drain())
+            if idx >= len(lines):
                 continue
-            try:
-                response = self.telnet_router.execute(line,
-                                                      auth=auth_state)
-            except TelnetCloseConnection:
-                return
-            if response:
-                writer.write(response.encode() + b"\n")
+            responses, deferred = self.telnet_router.execute_lines(
+                lines[idx:], auth=auth_state)
+            if responses:
+                writer.write("\n".join(responses).encode() + b"\n")
                 await self._on_client(writer.drain())
+            if isinstance(deferred, TelnetCloseConnection):
+                return
+            if deferred is not None:
+                raise deferred
 
     # -- http ----------------------------------------------------------
 

@@ -59,13 +59,104 @@ class TelnetRouter:
                         "(TELNET_PUT not granted)")
         return cmd(words)
 
+    def execute_lines(self, lines: list[str], auth=None
+                      ) -> tuple[list[str], Exception | None]:
+        """Process a burst of complete telnet lines: consecutive
+        ``put`` commands decode as ONE columnar batch (one WAL write,
+        one group-committed fsync — see :meth:`put_lines`), everything
+        else executes in input order. Returns ``(responses,
+        deferred_exc)`` where ``deferred_exc`` is a close/shutdown
+        raised by a line in the burst — the caller must write the
+        responses for the EARLIER lines before honoring it."""
+        responses: list[str] = []
+        run: list[str] = []
+
+        def flush_run() -> None:
+            if run:
+                responses.extend(self.put_lines(run, auth=auth))
+                run.clear()
+
+        batch_put = "put" in self.commands
+        for line in lines:
+            words = line.split()
+            if batch_put and words and words[0] == "put":
+                run.append(line)
+                continue
+            flush_run()
+            try:
+                r = self.execute(line, auth=auth)
+            except (TelnetCloseConnection, TelnetServerShutdown) as e:
+                return responses, e
+            if r:
+                responses.append(r)
+        flush_run()
+        return responses, None
+
+    def put_lines(self, lines: list[str], auth=None) -> list[str]:
+        """Columnar decode of a run of ``put`` lines: the payloads
+        (identical to the import line format once the command word is
+        stripped) parse in one :func:`parse_import_buffer` pass and
+        land via the grouped bulk path — one WAL write + one fsync for
+        the whole burst instead of one per line. Lines the columnar
+        parser rejects replay through the scalar ``put`` path, so
+        every error message, special value (nan/inf), and acceptance
+        quirk stays EXACTLY what a line-at-a-time client sees.
+        Returns the error responses (successes are silent)."""
+        if auth is not None:
+            from opentsdb_tpu.auth.simple import Permissions
+            if not auth.has_permission(Permissions.TELNET_PUT):
+                return ["put: permission denied "
+                        "(TELNET_PUT not granted)"] * len(lines)
+        if len(lines) == 1:
+            r = self._cmd_put(lines[0].split())
+            return [r] if r else []
+        failed: set[int] = set()
+        bodies = []
+        for i, ln in enumerate(lines):
+            parts = ln.split(None, 1)
+            body = parts[1] if len(parts) > 1 else ""
+            if not body.strip() or body.lstrip().startswith("#"):
+                # the import parser treats an empty/'#' body as a
+                # skippable blank/comment line and reports NO error —
+                # but 'put' with no args (or a '#' metric) must error
+                # like the scalar path. Blank the body (keeps line
+                # numbering aligned, writes nothing) and pre-mark the
+                # line for scalar replay.
+                failed.add(i)
+                body = ""
+            bodies.append(body)
+        buf = ("\n".join(bodies) + "\n").encode("utf-8", "replace")
+
+        def on_error(lineno: int, exc: Exception) -> None:
+            failed.add(lineno - 1)
+
+        try:
+            self.tsdb.import_buffer(buf, on_error=on_error)
+        except Exception as e:  # noqa: BLE001 - decode must not 500
+            # unexpected bulk-path failure: report once, loudly — per-
+            # line replay here could double-write lines that landed
+            import logging
+            logging.getLogger("tsd.telnet").exception(
+                "columnar put decode failed")
+            return [f"put: {type(e).__name__}: {e}"]
+        out: list[str] = []
+        for i in sorted(failed):
+            # scalar replay: the failing line wrote nothing, so this
+            # cannot double-write; its response text (and any telnet-
+            # only acceptance, e.g. nan/inf values) matches the
+            # line-at-a-time path byte for byte
+            r = self._cmd_put(lines[i].split())
+            if r:
+                out.append(r)
+        return out
+
     # ------------------------------------------------------------------
 
     def _parse_value(self, raw: str) -> int | float:
-        if "." in raw or "e" in raw.lower() or raw.lower() in (
-                "nan", "-nan", "inf", "-inf", "infinity", "-infinity"):
-            return float(raw)
-        return int(raw)
+        # strict parse: int()/float() leniency (underscores,
+        # whitespace, unicode digits) would silently store a DIFFERENT
+        # number than the client sent (e.g. "1_0" -> 10)
+        return tags_mod.parse_put_value(raw, allow_special=True)
 
     def _cmd_put(self, words: list[str]) -> str:
         """``put <metric> <timestamp> <value> <tagk=tagv> [...]``

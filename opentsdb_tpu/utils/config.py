@@ -120,6 +120,13 @@ _DEFAULTS: dict[str, str] = {
     "tsd.storage.wal.retry.base_ms": "5",
     "tsd.storage.wal.retry.deadline_ms": "2000",
     "tsd.storage.wal.resync_interval_ms": "1000",
+    #   group commit v2: bounded commit window the fsync leader holds
+    #   to absorb concurrent writers' buffered bytes (0 = commit
+    #   immediately; the window never delays a lone writer — it ends
+    #   at the first quiet poll slice), cut short by the caps below
+    "tsd.storage.wal.group_window_ms": "0",
+    "tsd.storage.wal.group_max_records": "4096",
+    "tsd.storage.wal.group_max_bytes": "4194304",
     #   snapshot flush retry (tsd.storage.data_dir writes)
     "tsd.storage.flush.retry.attempts": "3",
     "tsd.storage.flush.retry.base_ms": "20",

@@ -1,0 +1,549 @@
+"""Group-commit v2 + request-scoped WAL batching battery.
+
+Covers the ingest raw-speed overhaul's durability mechanics: bounded
+commit window (``tsd.storage.wal.group_window_*``), sequence-based
+acknowledgment, the per-request batch scope (one framed write + one
+fsync per put body / telnet burst / import buffer), strict put-value
+parsing, and the crash contract — every ACKNOWLEDGED point survives a
+torn tail, no unacknowledged point is required to.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+
+BASE = 1356998400
+
+
+def _tsdb(tmp_path, **extra):
+    return TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "memory",
+        "tsd.storage.data_dir": str(tmp_path),
+        **extra}))
+
+
+def _fsync_calls(t):
+    """Physical fsync attempts observed at the wal.fsync fault site
+    (armed with a never-failing schedule = a pure call counter)."""
+    return t.faults._sites["wal.fsync"].calls
+
+
+class TestGroupCommitWindow:
+    def test_concurrent_writers_amortize_fsyncs(self, tmp_path):
+        """N threads x M durable points: the commit window + sequence
+        ack make the physical fsync count ≪ the point count."""
+        t = _tsdb(tmp_path,
+                  **{"tsd.storage.wal.group_window_ms": "25"})
+        t.faults.arm("wal.fsync")  # pure counter, never fails
+        threads, per = 6, 40
+
+        def writer(k):
+            for i in range(per):
+                t.add_point("gc.m", BASE + k * 10_000 + i, i,
+                            {"h": f"w{k}"})
+
+        ths = [threading.Thread(target=writer, args=(k,))
+               for k in range(threads)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(60)
+        total = threads * per
+        assert t.store.total_points() == total
+        assert t.wal.group_syncs > 0
+        # the amortization claim: far fewer fsyncs than points
+        assert t.wal.group_syncs <= total // 3, t.wal.health_info()
+        assert _fsync_calls(t) <= total // 3 + 5
+        assert t.wal.piggybacked_syncs > 0
+        assert t.wal.records_per_sync() > 1.0
+        # every acknowledged point is on disk: nothing unsynced
+        assert t.wal.sync_lag() == 0
+        t.shutdown()
+
+    def test_lone_writer_never_delayed_past_window(self, tmp_path):
+        """A lone writer must not pay the commit window: the leader
+        breaks out as soon as the log is quiet, and is in any case
+        bounded by group_window_ms."""
+        window_s = 0.4
+        t = _tsdb(tmp_path,
+                  **{"tsd.storage.wal.group_window_ms":
+                     str(int(window_s * 1000))})
+        n = 5
+        t0 = time.monotonic()
+        for i in range(n):
+            t.add_point("lone.m", BASE + i, i, {"h": "a"})
+        elapsed = time.monotonic() - t0
+        # hard bound first (the contract), then the sharper claim:
+        # a quiet log ends each window immediately, so the average
+        # put is far below one full window
+        assert elapsed < n * (window_s + 0.5)
+        assert elapsed / n < window_s, (elapsed, t.wal.health_info())
+        assert t.wal.idle_breaks >= 1
+        assert t.wal.sync_lag() == 0
+        t.shutdown()
+
+    def test_blocked_waiters_do_not_hold_window_open(self, tmp_path):
+        """Writers blocked in sync() must not keep the leader's
+        window open: their records are already appended, so a quiet
+        log ends the window — the tail commit of a burst must not pay
+        the full group_window_ms."""
+        window_s = 1.0
+        t = _tsdb(tmp_path, **{"tsd.storage.wal.group_window_ms":
+                               str(int(window_s * 1000))})
+        ths = [threading.Thread(
+            target=lambda k=k: t.add_point("w.m", BASE + k, k,
+                                           {"h": f"w{k}"}))
+            for k in range(2)]
+        t0 = time.monotonic()
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(30)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.9 * window_s, (elapsed, t.wal.health_info())
+        assert t.wal.sync_lag() == 0
+        t.shutdown()
+
+    def test_size_cap_cuts_window_short(self, tmp_path):
+        """A pending backlog >= group_max_records triggers the fsync
+        immediately instead of waiting out the window."""
+        t = _tsdb(tmp_path, **{
+            "tsd.storage.wal.group_window_ms": "3000",
+            "tsd.storage.wal.group_max_records": "5"})
+        w = t.wal
+        for i in range(10):  # appended, not yet synced
+            w.log_point("data", 0, (BASE + i) * 1000, float(i), False)
+        t0 = time.monotonic()
+        w.sync()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, "size cap did not cut the window short"
+        assert w.size_triggers == 1
+        assert w.sync_lag() == 0
+        t.shutdown()
+
+    def test_fsync_failure_never_strands_waiters(self, tmp_path):
+        """Window expiry / fsync failure can never strand a waiter:
+        with the disk hard-down every durable put still RETURNS
+        (degraded, loudly), and nothing deadlocks."""
+        t = _tsdb(tmp_path, **{
+            "tsd.storage.wal.group_window_ms": "50",
+            "tsd.faults.wal.fsync_error_rate": "1.0",
+            "tsd.storage.wal.resync_interval_ms": "100"})
+        done = []
+
+        def writer(k):
+            for i in range(10):
+                t.add_point("strand.m", BASE + k * 100 + i, i,
+                            {"h": f"w{k}"})
+            done.append(k)
+
+        ths = [threading.Thread(target=writer, args=(k,))
+               for k in range(4)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(30)
+        assert len(done) == 4, "a durable put stranded on a dead disk"
+        assert t.wal.degraded
+        assert t.store.total_points() == 40  # acked (degraded) writes
+        t.shutdown()
+
+
+class TestBatchScope:
+    def test_put_body_is_one_fsync(self, tmp_path):
+        """An N-group add_point_batch body commits as ONE fsync (it
+        used to be one per series-group)."""
+        t = _tsdb(tmp_path)
+        t.faults.arm("wal.fsync")
+        pts = [("b.m", BASE + i, i, {"h": f"h{i % 6}"})
+               for i in range(30)]
+        before = _fsync_calls(t)
+        written, errors = t.add_point_batch(pts)
+        assert written == 30 and not errors
+        assert _fsync_calls(t) - before == 1
+        t.shutdown()
+        t2 = _tsdb(tmp_path)  # crash-replay: all acked points survive
+        assert t2.store.total_points() == 30
+        t2.shutdown()
+
+    def test_import_buffer_is_one_fsync(self, tmp_path):
+        t = _tsdb(tmp_path)
+        t.faults.arm("wal.fsync")
+        buf = "".join(f"i.m {BASE + i} {i} h=h{i % 4}\n"
+                      for i in range(40)).encode()
+        before = _fsync_calls(t)
+        written, errors = t.import_buffer(buf)
+        assert written == 40 and not errors
+        assert _fsync_calls(t) - before == 1
+        t.shutdown()
+        t2 = _tsdb(tmp_path)
+        assert t2.store.total_points() == 40
+        t2.shutdown()
+
+    def test_hook_fallback_commits_once_at_batch_end(self, tmp_path):
+        """With a per-point hook active, add_points degrades to the
+        per-point loop — but durability still commits ONCE at batch
+        end, not one fsync per point."""
+        t = _tsdb(tmp_path)
+
+        class Publisher:
+            seen = 0
+
+            def publish_data_point(self, *a, **k):
+                Publisher.seen += 1
+
+            def shutdown(self):
+                pass
+
+        t.rt_publisher = Publisher()
+        t.faults.arm("wal.fsync")
+        before = _fsync_calls(t)
+        ts = np.arange(BASE, BASE + 20, dtype=np.int64)
+        t.add_points("hook.m", ts, np.arange(20.0), {"h": "a"})
+        assert Publisher.seen == 20
+        assert _fsync_calls(t) - before == 1
+        t.rt_publisher = None
+        t.shutdown()
+        t2 = _tsdb(tmp_path)
+        assert t2.store.total_points() == 20
+        t2.shutdown()
+
+    def test_batch_commits_on_exception(self, tmp_path):
+        """A raise inside the scope still flushes + syncs what was
+        appended: points already acked per-point (PartialWriteError
+        semantics) stay on the durability path."""
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        w = WriteAheadLog(str(tmp_path / "wal"))
+        with pytest.raises(RuntimeError, match="boom"):
+            with w.batch():
+                w.log_point("data", 0, BASE * 1000, 1.0, False)
+                w.sync()
+                raise RuntimeError("boom")
+        assert w.last_seq() == 1
+        assert w.sync_lag() == 0
+        w.close()
+
+    def test_close_mid_scope_sheds_instead_of_raising(self, tmp_path):
+        """A WAL closed while a request scope is open (shutdown race)
+        must shed the batch loudly, not raise from the scope's exit —
+        the caller's store writes already landed and raising would
+        mask the request's own outcome."""
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        w = WriteAheadLog(str(tmp_path / "wal"))
+        with w.batch():
+            w.log_point("data", 0, BASE * 1000, 1.0, False)
+            w.sync()
+            w.close()  # no raise at scope exit:
+        assert w.append_dropped == 1
+        assert w.last_seq() == 0
+
+    def test_degraded_batch_keeps_known_unmarked(self, tmp_path):
+        """A shed batched write must not mark its T_SERIES identities
+        known — the mapping would be missing from the log forever."""
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        from opentsdb_tpu.utils.faults import FaultInjector
+        fi = FaultInjector()
+        fi.arm("wal.append", error_rate=1.0)
+        w = WriteAheadLog(str(tmp_path / "wal"), faults=fi,
+                          resync_ms=60_000)
+        with w.batch():
+            w.ensure_series("data", 0, "m", {"h": "a"})
+            w.log_point("data", 0, BASE * 1000, 1.0, False)
+            w.sync()
+        assert ("data", 0) not in w._known
+        assert w.append_failures == 1
+        fi.disarm()
+        # next write re-attempts the identity record
+        w._append_failing = False
+        w.ensure_series("data", 0, "m", {"h": "a"})
+        assert ("data", 0) in w._known
+        w.close()
+
+    def test_torn_tail_acked_prefix_survives_exactly(self, tmp_path):
+        """Crash contract: a batch acknowledged before the crash fully
+        survives a torn tail; bytes of an in-flight (never-acked)
+        batch are dropped cleanly."""
+        t = _tsdb(tmp_path)
+        pts = [("t.m", BASE + i, i + 1, {"h": f"h{i % 3}"})
+               for i in range(12)]
+        written, errors = t.add_point_batch(pts)  # ACKED here
+        assert written == 12 and not errors
+        wal_dir = os.path.join(str(tmp_path), "wal")
+        seg = [os.path.join(wal_dir, n) for n in os.listdir(wal_dir)
+               if n.endswith(".log")][0]
+        acked_size = os.path.getsize(seg)
+        # a second batch whose WAL write the crash tears mid-record:
+        # the client never got an ack for it
+        t.add_point_batch([("t.m", BASE + 100 + i, 1.0, {"h": "x"})
+                           for i in range(5)])
+        with open(seg, "r+b") as fh:
+            fh.truncate(acked_size + 7)  # mid-header of the 2nd batch
+        t2 = _tsdb(tmp_path)
+        total = t2.store.total_points()
+        assert total == 12, f"acked prefix must survive exactly, {total}"
+        t2.shutdown()
+
+
+class TestStrictPutValues:
+    """Satellite: int()/float() leniency (underscores, whitespace,
+    unicode digits) must not silently store the wrong number."""
+
+    def test_telnet_scalar_rejects_underscores(self, tmp_path):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = _tsdb(tmp_path)
+        r = TelnetRouter(t)
+        out = r.execute(f"put u.m {BASE} 1_0 h=a")
+        assert out.startswith("put:") and "invalid value" in out
+        assert t.store.total_points() == 0
+        # sanity: plain values still land, nan/inf stay accepted
+        assert r.execute(f"put u.m {BASE} 10 h=a") == ""
+        assert r.execute(f"put u.m {BASE + 1} nan h=a") == ""
+        assert r.execute(f"put u.m {BASE + 2} -Infinity h=a") == ""
+        assert t.store.total_points() == 3
+        t.shutdown()
+
+    def test_telnet_batch_rejects_underscores(self, tmp_path):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = _tsdb(tmp_path)
+        r = TelnetRouter(t)
+        lines = [f"put u.m {BASE} 1 h=a",
+                 f"put u.m {BASE + 1} 1_0 h=a",
+                 f"put u.m {BASE + 2} 2 h=a"]
+        responses, exc = r.execute_lines(lines)
+        assert exc is None
+        assert len(responses) == 1 and "invalid value" in responses[0]
+        assert t.store.total_points() == 2
+        t.shutdown()
+
+    def test_http_put_rejects_underscores_and_whitespace(self,
+                                                         tmp_path):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        t = _tsdb(tmp_path)
+        router = HttpRpcRouter(t)
+        body = json.dumps([
+            {"metric": "h.m", "timestamp": BASE, "value": "1_0",
+             "tags": {"h": "a"}},
+            {"metric": "h.m", "timestamp": BASE + 1, "value": " 10",
+             "tags": {"h": "a"}},
+            {"metric": "h.m", "timestamp": BASE + 2, "value": "10",
+             "tags": {"h": "a"}},
+        ]).encode()
+        resp = router.handle(HttpRequest(
+            "POST", "/api/put", {"details": ["true"]}, body=body))
+        out = json.loads(resp.body)
+        assert resp.status == 400
+        assert out["success"] == 1 and out["failed"] == 2
+        assert t.store.total_points() == 1
+        ts, vals = t.store.series(0).buffer.view()
+        assert vals[0] == 10.0 and ts[0] == (BASE + 2) * 1000
+        t.shutdown()
+
+    def test_http_rollup_rejects_underscores(self, tmp_path):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        t = _tsdb(tmp_path, **{"tsd.rollups.enable": "true"})
+        router = HttpRpcRouter(t)
+        body = json.dumps([{"metric": "r.m", "timestamp": BASE,
+                            "value": "6_0", "interval": "1m",
+                            "aggregator": "sum",
+                            "tags": {"h": "a"}}]).encode()
+        resp = router.handle(HttpRequest(
+            "POST", "/api/rollup", {"details": ["true"]}, body=body))
+        assert resp.status == 400
+        assert json.loads(resp.body)["failed"] == 1
+        # float(value) on this endpoint always accepted the special
+        # spellings; the strict parse must not regress that
+        body = json.dumps([{"metric": "r.m", "timestamp": BASE,
+                            "value": "NaN", "interval": "1m",
+                            "aggregator": "sum",
+                            "tags": {"h": "a"}}]).encode()
+        resp = router.handle(HttpRequest(
+            "POST", "/api/rollup", {"details": ["true"]}, body=body))
+        assert resp.status == 200, resp.body
+        t.shutdown()
+
+
+class TestTelnetBatchDecode:
+    def test_mixed_burst_order_and_responses(self, tmp_path):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = _tsdb(tmp_path)
+        r = TelnetRouter(t)
+        lines = ([f"put b.m {BASE + i} {i} h=a" for i in range(8)]
+                 + ["version"]
+                 + [f"put b.m {BASE + 100 + i} {i} h=b"
+                    for i in range(8)]
+                 + ["put b.m bad-ts 1 h=a", "nosuchcmd"])
+        responses, exc = r.execute_lines(lines)
+        assert exc is None
+        assert t.store.total_points() == 16
+        assert "version" in responses[0]
+        assert responses[1].startswith("put:")
+        assert "unknown command" in responses[2]
+        t.shutdown()
+
+    def test_argless_and_comment_puts_error_in_burst(self, tmp_path):
+        """'put' with no args (or a '#' metric) inside a burst must
+        error exactly like the scalar path — the import parser would
+        otherwise skip them as blank/comment lines."""
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = _tsdb(tmp_path)
+        r = TelnetRouter(t)
+        lines = [f"put c.m {BASE} 1 h=a",
+                 "put",
+                 f"put # {BASE} 1 h=a",
+                 f"put c.m {BASE + 1} 2 h=a"]
+        responses, exc = r.execute_lines(lines)
+        assert exc is None
+        assert len(responses) == 2, responses
+        assert "not enough arguments" in responses[0]
+        assert responses[1].startswith("put:")
+        assert t.store.total_points() == 2
+        # parity with the scalar path, byte for byte
+        assert responses[0] == r.execute("put")
+        t.shutdown()
+
+    def test_exit_mid_burst_lands_earlier_puts(self, tmp_path):
+        from opentsdb_tpu.tsd.telnet import (TelnetCloseConnection,
+                                             TelnetRouter)
+        t = _tsdb(tmp_path)
+        r = TelnetRouter(t)
+        lines = [f"put e.m {BASE + i} {i} h=a" for i in range(5)]
+        lines += ["exit", f"put e.m {BASE + 99} 9 h=a"]
+        responses, exc = r.execute_lines(lines)
+        assert isinstance(exc, TelnetCloseConnection)
+        # puts before the exit landed; the one after did not run
+        assert t.store.total_points() == 5
+        t.shutdown()
+
+    def test_burst_is_single_fsync_and_taps_stream(self, tmp_path):
+        """The telnet burst commits as one fsync and feeds the
+        streaming ingest tap columnar (offer_many)."""
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = _tsdb(tmp_path)
+        offered = []
+
+        class Tap:
+            def offer_many(self, metric_id, sid, ts_ms, values):
+                offered.append(len(ts_ms))
+
+            def offer(self, *a):
+                offered.append(1)
+
+        t._streaming = Tap()
+        t.faults.arm("wal.fsync")
+        r = TelnetRouter(t)
+        before = _fsync_calls(t)
+        lines = [f"put s.m {BASE + i} {i} h=a" for i in range(20)]
+        responses, exc = r.execute_lines(lines)
+        assert responses == [] and exc is None
+        assert _fsync_calls(t) - before == 1
+        assert sum(offered) == 20 and max(offered) == 20
+        t._streaming = None
+        t.shutdown()
+
+
+class TestObservability:
+    def test_health_and_stats_carry_group_commit_counters(self,
+                                                          tmp_path):
+        from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+        t = _tsdb(tmp_path)
+        t.add_point_batch([("o.m", BASE + i, i, {"h": "a"})
+                           for i in range(10)])
+        router = HttpRpcRouter(t)
+        health = json.loads(router.handle(
+            HttpRequest("GET", "/api/health", {})).body)
+        wal = health["wal"]
+        for key in ("group_syncs", "records_per_sync",
+                    "piggybacked_syncs", "window_expiries",
+                    "size_triggers", "group_window_ms"):
+            assert key in wal, key
+        assert wal["group_syncs"] >= 1
+        assert wal["records_per_sync"] > 1  # the batch amortized
+        stats = router.handle(
+            HttpRequest("GET", "/api/stats", {})).body.decode()
+        assert "wal.records_per_sync" in stats
+        assert "wal.group_syncs" in stats
+        t.shutdown()
+
+
+class TestImportParserFallback:
+    """The pure-Python columnar line parser must enforce the native
+    parser's strict shape rules (same error codes)."""
+
+    def test_strict_value_and_ts_shapes(self):
+        from opentsdb_tpu.native.store_backend import _parse_import_py
+        buf = (b"m 100 5 h=a\n"          # ok int
+               b"m 100 +5 h=a\n"         # ok signed int
+               b"m 100 5.5e2 h=a\n"      # ok float
+               b"m 100 1_0 h=a\n"        # underscore value -> 3
+               b"m 100 nan h=a\n"        # nan -> 3
+               b"m 100 0x10 h=a\n"       # hex -> 3
+               b"m 1_0 5 h=a\n"          # underscore ts -> 2
+               b"m -100 5 h=a\n"         # signed ts -> 2
+               b"# comment\n"
+               b"\n"
+               b"m 100 5\n"              # no tags -> 1
+               b"m 100 5 h=a b\n"        # bad tag -> 4
+               b"m 100 5 h=\xc3\xa9\n"   # utf-8 tagv passes here
+               b"m* 100 5 h=a\n")        # bad metric charset -> 5
+        p = _parse_import_py(buf)
+        assert p.errors.tolist() == [0, 0, 0, 3, 3, 3, 2, 2, -1, -1,
+                                     1, 4, 0, 5]
+        assert p.values[:3].tolist() == [5.0, 5.0, 550.0]
+        assert p.is_int[:3].tolist() == [1, 1, 0]
+        # 19+ digit integers fall to the float path like strtod
+        p2 = _parse_import_py(b"m 100 1234567890123456789012 h=a\n")
+        assert p2.errors[0] == 0 and p2.is_int[0] == 0
+
+    def test_grouping_matches_key_semantics(self):
+        from opentsdb_tpu.native.store_backend import _parse_import_py
+        buf = (b"m 100 1 a=1 b=2\n"
+               b"m 101 2 b=2 a=1\n"      # same series, reordered tags
+               b"m 102 3 a=1\n"          # different series
+               b"n 100 4 a=1 b=2\n")     # different metric
+        p = _parse_import_py(buf)
+        assert p.num_groups == 3
+        assert p.group_ids.tolist() == [0, 0, 1, 2]
+        assert p.rep_lines[0] == b"m 100 1 a=1 b=2"
+
+    def test_corrupt_native_lib_negative_cached_fallback(
+            self, tmp_path, monkeypatch):
+        """A cached .so that exists but cannot load (corrupt / ABI
+        drift) must behave like a failed build: NativeBuildError,
+        negative-cached, and the columnar parse falls back to the
+        Python twin instead of crashing imports / telnet bursts."""
+        from opentsdb_tpu.native import store_backend as sb
+        bad = tmp_path / "bad.so"
+        bad.write_bytes(b"this is not a shared library")
+        monkeypatch.setattr(sb, "_lib", None)
+        monkeypatch.setattr(sb, "_build_error", None)
+        monkeypatch.setattr(sb, "build_library",
+                            lambda force=False: str(bad))
+        with pytest.raises(sb.NativeBuildError):
+            sb.load_library()
+        assert sb._build_error  # negative-cached
+        with pytest.raises(sb.NativeBuildError):
+            sb.load_library()
+        p = sb.parse_import_buffer(b"m 100 5 h=a\n")
+        assert p.num_groups == 1 and p.errors[0] == 0
+
+    def test_import_buffer_roundtrip_via_fallback(self, tmp_path):
+        """Whole-path check on whatever parser this host resolves:
+        written points match, per-line errors map back 1-based."""
+        t = _tsdb(tmp_path)
+        errs = []
+        buf = (f"f.m {BASE} 1 h=a\n"
+               f"f.m {BASE + 1} bad h=a\n"
+               f"f.m {BASE + 2} 3 h=b\n").encode()
+        written, errors = t.import_buffer(
+            buf, on_error=lambda ln, e: errs.append(ln))
+        assert written == 2
+        assert errs == [2]
+        assert len(errors) == 1 and errors[0].startswith("line 2:")
+        t.shutdown()
