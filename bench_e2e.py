@@ -785,6 +785,127 @@ def bench_ingest(repeats: int, n_points: int = 120_000,
     return out
 
 
+def bench_viz(repeats: int, n_hosts: int = 8, per_host: int = 5,
+              span_s: int = 172_800) -> dict:
+    """Pixel-aware serve-path downsampling config: a config2-style
+    wildcard group-by dashboard query over a DENSE window (48h @ 1s
+    per series — the response class where serialization dominates the
+    warm p50), answered at full resolution and with
+    ``downsample=1500px`` (M4). Criteria: response bytes reduced
+    >= 20x and e2e p50 (engine + serialize) reduced >= 2x, with
+    identical per-pixel min/max/first/last guaranteed by the oracle
+    battery (tests/test_visual_downsample.py). Also records the SSE
+    frame-size delta for a live continuous query carrying a pixel
+    budget."""
+    import json as _json
+    from opentsdb_tpu.query.model import TSQuery
+    tsdb = _mk_tsdb()
+    serializer = _serializer()
+    rng = np.random.default_rng(29)
+    mid = tsdb.uids.metrics.get_or_create_id("sys.viz")
+    kid_h = tsdb.uids.tag_names.get_or_create_id("host")
+    kid_t = tsdb.uids.tag_names.get_or_create_id("task")
+    ts_grid = BASE_MS + np.arange(span_s, dtype=np.int64) * 1000
+    n_series = n_hosts * per_host
+    t0 = time.perf_counter()
+    mask = np.ones((per_host, span_s), dtype=bool)
+    for h in range(n_hosts):
+        hv = tsdb.uids.tag_values.get_or_create_id(f"h{h:04d}")
+        sids = np.asarray([
+            tsdb.store.get_or_create_series(
+                mid, [(kid_h, hv),
+                      (kid_t, tsdb.uids.tag_values.get_or_create_id(
+                          f"t{j}"))])
+            for j in range(per_host)], dtype=np.int64)
+        tsdb.store.append_grid(
+            sids, ts_grid, rng.normal(100, 10, (per_host, span_s)),
+            mask)
+    ingest_s = time.perf_counter() - t0
+    end_ms = BASE_MS + span_s * 1000
+    base_q = {"start": BASE_MS, "end": end_ms,
+              "queries": [{"metric": "sys.viz", "aggregator": "sum",
+                           "downsample": "1s-avg",
+                           "filters": [{"type": "wildcard",
+                                        "tagk": "host", "filter": "*",
+                                        "groupBy": True}]}]}
+    px_q = _json.loads(_json.dumps(base_q))
+    px_q["pixels"] = 1500
+
+    tsdb.config.override_config("tsd.query.cache.enable", "false")
+
+    def measure(qobj):
+        tsq = TSQuery.from_json(qobj).validate()
+        results = tsdb.execute_query(tsq)          # warm compile
+        serializer.format_query(tsq, results)
+        tot, ex, ser = [], [], []
+        body = b""
+        for _ in range(max(repeats, 3)):
+            t0 = time.perf_counter()
+            tsq = TSQuery.from_json(qobj).validate()
+            results = tsdb.execute_query(tsq)
+            t1 = time.perf_counter()
+            body = serializer.format_query(tsq, results)
+            t2 = time.perf_counter()
+            tot.append(t2 - t0)
+            ex.append(t1 - t0)
+            ser.append(t2 - t1)
+        dps = sum(r.num_dps for r in results)
+        return {"p50_ms": _percentile(tot, 50) * 1e3,
+                "exec_p50_ms": _percentile(ex, 50) * 1e3,
+                "serialize_p50_ms": _percentile(ser, 50) * 1e3,
+                "resp_bytes": len(body), "dps": dps}
+
+    full = measure(base_q)
+    px = measure(px_q)
+    bytes_ratio = full["resp_bytes"] / max(px["resp_bytes"], 1)
+    p50_ratio = full["p50_ms"] / max(px["p50_ms"], 1e-3)
+
+    # SSE frame-size delta: the same live standing query registered
+    # with and without a pixel budget (40min @ 1s-avg windows)
+    tsdb.config.override_config(
+        "tsd.streaming.publish_min_interval_ms", "1000000000")
+    reg = tsdb.streaming
+    live_start = end_ms - 2400 * 1000
+    cq_body = {"start": live_start, "end": end_ms,
+               "queries": [{"metric": "sys.viz", "aggregator": "sum",
+                            "downsample": "1s-avg",
+                            "filters": [{"type": "wildcard",
+                                         "tagk": "host",
+                                         "filter": "*",
+                                         "groupBy": True}]}]}
+    px_body = _json.loads(_json.dumps(cq_body))
+    px_body["queries"][0]["pixels"] = 150
+    cq_f = reg.register(dict(cq_body, id="vizfull"), now_ms=end_ms)
+    cq_p = reg.register(dict(px_body, id="vizpx"), now_ms=end_ms)
+    sub_f = reg.subscribe(cq_f)
+    sub_p = reg.subscribe(cq_p)
+    snap_f = sub_f.queue.get(timeout=30)
+    snap_p = sub_p.queue.get(timeout=30)
+
+    out = {"config": "viz", "series": n_series, "groups": n_hosts,
+           "points": n_series * span_s,
+           "ingest_mpps": round(n_series * span_s / ingest_s / 1e6, 1),
+           "pixels": 1500,
+           "resp_bytes_full": full["resp_bytes"],
+           "resp_bytes_px": px["resp_bytes"],
+           "bytes_ratio": round(bytes_ratio, 1),
+           "dps_full": full["dps"], "dps_px": px["dps"],
+           "p50_full_ms": round(full["p50_ms"], 1),
+           "p50_px_ms": round(px["p50_ms"], 1),
+           "p50_ratio": round(p50_ratio, 2),
+           "exec_p50_full_ms": round(full["exec_p50_ms"], 1),
+           "exec_p50_px_ms": round(px["exec_p50_ms"], 1),
+           "serialize_p50_full_ms": round(full["serialize_p50_ms"], 1),
+           "serialize_p50_px_ms": round(px["serialize_p50_ms"], 1),
+           "sse_snapshot_bytes_full": len(snap_f),
+           "sse_snapshot_bytes_px": len(snap_p),
+           "sse_frame_ratio": round(len(snap_f)
+                                    / max(len(snap_p), 1), 1),
+           "criterion_pass": bool(bytes_ratio >= 20.0
+                                  and p50_ratio >= 2.0)}
+    return out
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -809,7 +930,7 @@ def main() -> None:
                4: bench_config4, 5: bench_config5,
                "wal": bench_wal, "live": bench_live,
                "lifecycle": bench_lifecycle, "cold": bench_cold,
-               "ingest": bench_ingest}
+               "ingest": bench_ingest, "viz": bench_viz}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

@@ -183,9 +183,14 @@ class TSDB:
         # host-side per-(store, metric) TagMatrix cache, invalidated by
         # series count (the metric index is append-only)
         self._tagmat_cache: dict = {}
-        from opentsdb_tpu.stats.stats import StatsCollectorRegistry
+        from opentsdb_tpu.stats.stats import (ServePayloadStats,
+                                              StatsCollectorRegistry)
         self.stats = StatsCollectorRegistry()
         self.stats.register(self.faults)
+        # serve-path payload aggregates (response bytes +
+        # serialization time), fed by the /api/query handler
+        self.payload_stats = ServePayloadStats()
+        self.stats.register(self.payload_stats)
         # device-pipeline circuit breaker: repeated accelerator
         # failures (compile errors, OOM) trip it and queries route to
         # the host CPU fallback instead of 500ing per request;

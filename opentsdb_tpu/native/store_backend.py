@@ -150,6 +150,8 @@ def load_library():
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_int64]
         lib.tss_format_dps.restype = ctypes.c_int64
+        lib.tss_fmt_fast.argtypes = []
+        lib.tss_fmt_fast.restype = ctypes.c_int64
         _lib = lib
         return lib
 
@@ -746,6 +748,16 @@ def parse_import_buffer(buf: bytes,
             for g in range(ng)]
     return ParsedImport(ts[:n], vals[:n], ints[:n], gids[:n], errs[:n],
                         reps, int(ng), n)
+
+
+def format_dps_is_fast() -> bool:
+    """True when the native dps formatter writes doubles through real
+    ``std::to_chars`` (libstdc++ >= 11). On gcc-10 hosts the library
+    builds (the formatter falls back to a verified %g precision walk,
+    value-identical output) but that walk is SLOWER than the Python
+    columnar bulk formatter, so serializers should skip native
+    formatting there. Raises NativeBuildError when no library."""
+    return bool(load_library().tss_fmt_fast())
 
 
 def format_dps(ts_ms: np.ndarray, vals: np.ndarray, seconds: bool,

@@ -18,6 +18,7 @@
 #include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <limits>
@@ -742,9 +743,59 @@ void parse_import_range(const char* buf, int64_t pos, int64_t limit,
   }
 }
 
+// Shortest-round-trip double formatting, portable to libstdc++ < 11:
+// gcc-10 hosts ship INTEGER std::to_chars only, so the double call is
+// ambiguous among the integer overloads (the build failed outright
+// there until this guard). Feature-test the floating-point overload;
+// without it, walk %.*g precisions until strtod round-trips — the
+// same shortest-digits contract to_chars guarantees by construction,
+// so the emitted text parses to the identical double either way (the
+// exponent spelling may differ: "1e16" vs "1e+16" — both valid JSON).
+inline char* fmt_double_chars(char* p, char* end, double v) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  return std::to_chars(p, end, v).ptr;
+#else
+  char tmp[40];
+  // three-step walk, not 1..17 (this path serves every large
+  // response on gcc-10 hosts, so it must stay near to_chars speed):
+  // %g strips trailing zeros, so %.15g already prints "human" values
+  // (0.1, 42.5) at their shortest and round-trips most doubles; 16
+  // covers the next band; 17 round-trips everything by construction
+  // (no verify needed). A precision-p print that round-trips implies
+  // the shortest form needs <= p digits, so this walk reproduces the
+  // shortest text (and Python repr) for practical value populations.
+  int n = 0;
+  for (int prec = 15; prec <= 17; ++prec) {
+    n = std::snprintf(tmp, sizeof tmp, "%.*g", prec, v);
+    if (prec == 17 || (n > 0 && n < (int)sizeof tmp &&
+                       std::strtod(tmp, nullptr) == v))
+      break;
+  }
+  if (n <= 0 || n > end - p) return p;  // caller reserves headroom
+  for (int i = 0; i < n; ++i)  // locale hardening: ',' decimal point
+    if (tmp[i] == ',') tmp[i] = '.';
+  std::memcpy(p, tmp, n);
+  return p + n;
+#endif
+}
+
 }  // namespace
 
 extern "C" {
+
+// 1 when doubles format through real std::to_chars (libstdc++ >= 11),
+// 0 on the snprintf round-trip fallback (gcc-10 hosts). The Python
+// serializer prefers its own columnar bulk formatter over a slow
+// native one — the fallback's strtod verification makes it ~2x the
+// cost of the pure-Python path, inverting the reason the native
+// formatter exists.
+int64_t tss_fmt_fast() {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  return 1;
+#else
+  return 0;
+#endif
+}
 
 // JSON-format a series' datapoints: entries joined by ',' with no
 // surrounding braces (the Python serializer owns the envelope).
@@ -799,9 +850,8 @@ int64_t tss_format_dps(const int64_t* ts_ms, const double* vals,
       auto r = std::to_chars(p, end, (int64_t)v);
       p = r.ptr;
     } else {
-      auto r = std::to_chars(p, end, v);
       char* start = p;
-      p = r.ptr;
+      p = fmt_double_chars(p, end, v);
       // Python repr always marks floats (".0" or an exponent);
       // integral doubles >= 2^53 would otherwise print bare digits
       bool marked = false;

@@ -1745,6 +1745,22 @@ class QueryEngine:
         # array would round-trip per group
         result = np.asarray(result)
         emit = np.asarray(emit, dtype=bool)
+        # pixel-aware output reduction (ops/visual_downsample): the
+        # FINAL serve-path stage, after downsample/fill/rate/
+        # interpolate/aggregate — a keep-mask intersection, so every
+        # emitted point below is a real computed point. Applies to
+        # every producer funneling through here (grid / point / avg /
+        # prep-hit / streaming plan.serve), keyed off the REQUESTING
+        # sub-query, so a pixel-less standing plan still serves a
+        # pixel-budgeted pull correctly.
+        from opentsdb_tpu.query.model import effective_pixels
+        px, px_fn = effective_pixels(tsq, sub)
+        if px and not tsq.delete:
+            from opentsdb_tpu.ops import visual_downsample as vd
+            keep = vd.keep_mask(result, emit, np.asarray(bucket_ts),
+                                tsq.start_ms, tsq.end_ms, px, px_fn)
+            if keep is not None:
+                emit = emit & keep
         fetch_annotations = not tsq.no_annotations and \
             self.tsdb.annotations.has_any()
         # output timestamps precomputed once for every group

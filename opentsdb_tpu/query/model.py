@@ -22,6 +22,58 @@ class BadRequestError(ValueError):
     """400-level query errors (ref: src/tsd/BadRequestException.java)."""
 
 
+def _validate_pixels(raw, where: str) -> int:
+    """Strict pixel-budget validation: a positive integer up to
+    MAX_PIXELS, 400 on anything else (no reference equivalent — the
+    pixel-aware serve-path operator is new surface, so nonsense must
+    not silently pass through as 'no reduction')."""
+    from opentsdb_tpu.ops.visual_downsample import MAX_PIXELS
+    if raw is None or raw == 0:
+        return 0
+    if isinstance(raw, bool) or isinstance(raw, float) or \
+            not isinstance(raw, (int, str)):
+        raise BadRequestError(f"Invalid {where}: {raw!r} "
+                              "(want a positive integer pixel count)")
+    if isinstance(raw, str):
+        # same strict digit rule as put-value parsing (PR 6): int()
+        # silently accepts underscores and unicode digits; leading
+        # zeros ("0800") are rejected as probable typos, not parsed
+        if not (raw.isascii() and raw.isdigit()) or \
+                (len(raw) > 1 and raw[0] == "0"):
+            raise BadRequestError(
+                f"Invalid {where}: {raw!r} "
+                "(want a positive integer pixel count)")
+    px = int(raw)
+    if px == 0:
+        return 0  # an explicit 0 turns the reduction off
+    if px < 0 or px > MAX_PIXELS:
+        raise BadRequestError(
+            f"Invalid {where}: {raw!r} (want 0..{MAX_PIXELS})")
+    return px
+
+
+def _validate_pixel_fn(raw, where: str) -> str:
+    from opentsdb_tpu.ops.visual_downsample import PIXEL_FNS
+    if not raw:
+        return ""
+    fn = str(raw).lower()
+    if fn not in PIXEL_FNS:
+        raise BadRequestError(
+            f"Invalid {where}: {raw!r} "
+            f"(supported: {', '.join(PIXEL_FNS)})")
+    return fn
+
+
+def effective_pixels(tsq, sub) -> tuple[int, str]:
+    """The pixel budget one sub-query's output is reduced under: the
+    per-sub option wins over the query-level one; the operator
+    defaults to M4 (error-free for line rendering). (0, ...) = off."""
+    from opentsdb_tpu.ops.visual_downsample import DEFAULT_PIXEL_FN
+    px = sub.pixels or tsq.pixels
+    fn = sub.pixel_fn or tsq.pixel_fn or DEFAULT_PIXEL_FN
+    return (px, fn) if px else (0, fn)
+
+
 @dataclass
 class TSSubQuery:
     """(ref: TSSubQuery.java:48-104)"""
@@ -36,6 +88,10 @@ class TSSubQuery:
     percentiles: list[float] = field(default_factory=list)
     rollup_usage: str = "ROLLUP_NOFALLBACK"
     index: int = 0
+    # pixel-aware output reduction (ops/visual_downsample): 0 = off /
+    # inherit the query-level budget; fn "" = inherit / default (m4)
+    pixels: int = 0
+    pixel_fn: str = ""
     # populated during validation
     agg: aggs_mod.Aggregator | None = None
     ds_spec: DownsamplingSpecification | None = None
@@ -45,6 +101,14 @@ class TSSubQuery:
         if not self.aggregator:
             raise BadRequestError(
                 "Missing the aggregation function")
+        self.pixels = _validate_pixels(self.pixels, "pixels")
+        self.pixel_fn = _validate_pixel_fn(self.pixel_fn, "pixelFn")
+        if self.pixels and self.percentiles:
+            # histogram percentile results bypass the grid-shaped
+            # result assembly the pixel reduction operates on
+            raise BadRequestError(
+                "pixels is not supported on histogram percentile "
+                "queries")
         try:
             self.agg = aggs_mod.get(self.aggregator)
         except KeyError as e:
@@ -105,6 +169,8 @@ class TSSubQuery:
             explicit_tags=bool(obj.get("explicitTags", False)),
             percentiles=[float(p) for p in obj.get("percentiles") or []],
             rollup_usage=obj.get("rollupUsage", "ROLLUP_NOFALLBACK"),
+            pixels=obj.get("pixels") or 0,
+            pixel_fn=obj.get("pixelFn") or "",
             index=index)
 
     def to_json(self) -> dict[str, Any]:
@@ -119,6 +185,8 @@ class TSSubQuery:
             "filters": [f.to_json() for f in self.filters],
             "explicitTags": self.explicit_tags,
             "index": self.index,
+            **({"pixels": self.pixels} if self.pixels else {}),
+            **({"pixelFn": self.pixel_fn} if self.pixel_fn else {}),
         }
 
 
@@ -138,6 +206,10 @@ class TSQuery:
     show_query: bool = False
     delete: bool = False
     use_calendar: bool = False
+    # query-level pixel budget (``downsample=<N>px[-<fn>]`` URI param /
+    # top-level ``pixels``/``pixelFn`` JSON keys); per-sub options win
+    pixels: int = 0
+    pixel_fn: str = ""
     # populated during validation
     start_ms: int = 0
     end_ms: int = 0
@@ -160,9 +232,15 @@ class TSQuery:
                 "end time must be greater than the start time")
         if not self.queries:
             raise BadRequestError("Missing queries")
+        self.pixels = _validate_pixels(self.pixels, "downsample pixels")
+        self.pixel_fn = _validate_pixel_fn(self.pixel_fn, "pixelFn")
         for i, sub in enumerate(self.queries):
             sub.index = i
             sub.validate(self.timezone, self.use_calendar)
+            if self.pixels and sub.percentiles:
+                raise BadRequestError(
+                    "pixels is not supported on histogram percentile "
+                    "queries")
         return self
 
     def dedupe_queries(self) -> "TSQuery":
@@ -175,7 +253,12 @@ class TSQuery:
         seen: set = set()
         deduped = []
         for sub in self.queries:
-            key = sub.identity_key()
+            # pixels ride along OUTSIDE identity_key (the streaming
+            # registry matches registered plans on content identity —
+            # the same maintained partials serve any pixel budget, the
+            # reduction applies at result assembly) but two subs that
+            # differ only in pixel budget are NOT duplicates here
+            key = (sub.identity_key(), sub.pixels, sub.pixel_fn)
             if key in seen:
                 continue
             seen.add(key)
@@ -210,6 +293,8 @@ class TSQuery:
             show_query=bool(obj.get("showQuery", False)),
             delete=bool(obj.get("delete", False)),
             use_calendar=bool(obj.get("useCalendar", False)),
+            pixels=obj.get("pixels") or 0,
+            pixel_fn=obj.get("pixelFn") or "",
         )
 
     def to_json(self) -> dict[str, Any]:
@@ -221,6 +306,8 @@ class TSQuery:
             "globalAnnotations": self.global_annotations,
             "msResolution": self.ms_resolution,
             "showTSUIDs": self.show_tsuids,
+            **({"pixels": self.pixels} if self.pixels else {}),
+            **({"pixelFn": self.pixel_fn} if self.pixel_fn else {}),
         }
 
 
@@ -333,6 +420,21 @@ def parse_uri_tsuid_subquery(spec: str, index: int = 0) -> TSSubQuery:
     return sub
 
 
+def parse_uri_pixels(spec: str) -> tuple[int, str]:
+    """Parse the ``downsample=<N>px[-<fn>]`` URI form (e.g.
+    ``1500px``, ``800px-minmaxlttb``); strict — anything that is not a
+    pixel spec is a 400, not a silent no-op."""
+    import re as _re
+    m = _re.match(r"^(\d+)px(?:-([a-z0-9]+))?$", spec.strip().lower())
+    if not m:
+        raise BadRequestError(
+            f"Invalid downsample parameter: {spec!r} "
+            "(want <pixels>px or <pixels>px-<m4|minmaxlttb>)")
+    px = _validate_pixels(m.group(1), "downsample pixels")
+    fn = _validate_pixel_fn(m.group(2), "downsample pixel fn")
+    return px, fn
+
+
 def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
     """Parse ``/api/query?start=...&m=...`` URI params
     (ref: QueryRpc.parseQuery)."""
@@ -346,6 +448,8 @@ def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
                for i, spec in enumerate(params.get("tsuids", []))]
     queries += [parse_uri_subquery(spec, len(queries) + i)
                 for i, spec in enumerate(params.get("m", []))]
+    pixels, pixel_fn = (parse_uri_pixels(first("downsample"))
+                        if first("downsample") is not None else (0, ""))
     return TSQuery(
         start=first("start", ""),
         end=first("end"),
@@ -361,4 +465,6 @@ def parse_uri_query(params: dict[str, list[str]]) -> TSQuery:
         show_tsuids=first("show_tsuids", "false") == "true",
         show_summary=first("show_summary", "false") == "true",
         show_query=first("show_query", "false") == "true",
+        pixels=pixels,
+        pixel_fn=pixel_fn,
     )

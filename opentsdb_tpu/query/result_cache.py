@@ -94,9 +94,14 @@ def cache_plan(tsq, sub, config) -> tuple[tuple, float] | None:
                   int(tsq.end_ms // ttl_ms))
     else:
         window = (tsq.start_ms, tsq.end_ms)
+    # the pixel budget shapes the cached result groups (the keep-mask
+    # intersection happens before assembly), so it is part of the key:
+    # cached and fresh answers for the same budget agree, and a
+    # full-resolution entry can never serve a pixel-budgeted request
+    from opentsdb_tpu.query.model import effective_pixels
     key = (window, tsq.timezone, tsq.use_calendar, tsq.ms_resolution,
            tsq.show_tsuids, tsq.no_annotations, tsq.global_annotations,
-           sub.identity_key())
+           sub.identity_key(), effective_pixels(tsq, sub))
     return key, ttl_ms
 
 

@@ -175,6 +175,11 @@ class QueryStat(Enum):
     # served from a continuous query's maintained live windows
     # (opentsdb_tpu/streaming/) — no store scan, tail-only compute
     STREAMING_HIT = "streamingHit"
+    # serve-path payload observability: response body bytes actually
+    # written for this query (materialized or streamed), and the
+    # pixel budget its output was reduced under (0 = full resolution)
+    PAYLOAD_BYTES = "payloadBytes"
+    DOWNSAMPLE_PIXELS = "downsamplePixels"
 
 
 # time-based stats that get the reference's derived max*/avg* twins in
@@ -194,6 +199,54 @@ _DERIVED_TIMES = {
     "serializationTime": ("maxSerializationTime",
                           "avgSerializationTime"),
 }
+
+
+class ServePayloadStats:
+    """Aggregate serve-path payload counters: total response bytes,
+    serialization milliseconds and response count across every
+    /api/query answered by this process, so the wire-size effect of
+    pixel-aware downsampling is measurable in production (not just in
+    bench) — exported at ``/api/stats`` and ``/api/health``."""
+
+    __slots__ = ("_lock", "payload_bytes", "serialization_ms",
+                 "responses", "pixel_responses")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.payload_bytes = 0
+        self.serialization_ms = 0.0
+        self.responses = 0
+        self.pixel_responses = 0
+
+    def record(self, nbytes: int, ser_ms: float,
+               pixels: int = 0) -> None:
+        with self._lock:
+            self.payload_bytes += int(nbytes)
+            self.serialization_ms += float(ser_ms)
+            self.responses += 1
+            if pixels:
+                self.pixel_responses += 1
+
+    def collect_stats(self, collector) -> None:
+        collector.record("query.payload.bytes_total",
+                         self.payload_bytes)
+        collector.record("query.payload.serialization_ms_total",
+                         self.serialization_ms)
+        collector.record("query.payload.responses", self.responses)
+        collector.record("query.payload.pixel_responses",
+                         self.pixel_responses)
+
+    def health_info(self) -> dict[str, Any]:
+        n = max(self.responses, 1)
+        return {
+            "responses": self.responses,
+            "pixel_responses": self.pixel_responses,
+            "payload_bytes_total": self.payload_bytes,
+            "payload_bytes_avg": round(self.payload_bytes / n, 1),
+            "serialization_ms_total": round(self.serialization_ms, 1),
+            "serialization_ms_avg": round(
+                self.serialization_ms / n, 3),
+        }
 
 
 class QueryStats:

@@ -593,11 +593,21 @@ class ContinuousQueryRegistry:
         except BadRequestError:
             return False
         engine = QueryEngine(self.tsdb)
+        from opentsdb_tpu.query.model import effective_pixels
         updates = []
         for plan, sub in zip(cq.plans, tsq.queries):
             changed = None if snapshot else set(plan.take_changed())
             if changed is not None and not changed:
                 continue
+            if changed is not None and effective_pixels(tsq, sub)[0]:
+                # pixel-budgeted standing query: the M4/LTTB selection
+                # can move with every fold (a new point displaces a
+                # pixel's min/max), so dirty-window deltas cannot
+                # describe the reduced series — publish the WHOLE
+                # reduced frame instead. It is <= ~4 points/pixel by
+                # construction, i.e. already smaller than one dirty
+                # window of a dense full-resolution plan.
+                changed = None
             if changed is not None:
                 # result timestamps are second-rounded unless
                 # ms_resolution; changed buckets are ms edges

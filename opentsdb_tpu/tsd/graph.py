@@ -104,6 +104,26 @@ def handle_graph(router, request):
     if not tsq.queries:
         raise HttpError(400, "Missing 'm' parameter",
                         "Nothing to graph without a metric query")
+    # PNG renders know their own pixel budget: the chart is `wxh` wide,
+    # so M4-reduce the query output to that width unless the caller
+    # set an explicit `downsample=<N>px` (or opted out with `0px`).
+    # Visually lossless by construction — the renderer rasterizes onto
+    # exactly those columns — and it caps the points matplotlib has to
+    # draw. ascii/json outputs are data exports: never auto-reduced.
+    render_png = not (request.flag("ascii")
+                      or request.param("format") == "ascii"
+                      or request.flag("json")
+                      or request.param("format") == "json")
+    if render_png and request.param("downsample") is None \
+            and not any(q.pixels or q.percentiles
+                        for q in tsq.queries) \
+            and router.tsdb.config.get_bool(
+                "tsd.http.graph.auto_pixels", True):
+        try:
+            tsq.pixels = int((request.param("wxh")
+                              or "1024x768").split("x")[0])
+        except (ValueError, IndexError):
+            pass  # a malformed wxh fails below in the renderer
     tsq.validate()
     stats = QueryStats(
         request.remote, tsq,

@@ -555,10 +555,15 @@ class HttpRpcRouter:
             request.remote, tsq,
             allow_duplicates=self.tsdb.config.get_bool(
                 "tsd.query.allow_simultaneous_duplicates", True))
+        from opentsdb_tpu.query.model import effective_pixels
+        px = max((effective_pixels(tsq, s)[0] for s in tsq.queries),
+                 default=0)
         streamed = False
         try:
             results = self.tsdb.new_query().run(tsq, stats)
             from opentsdb_tpu.stats.stats import QueryStat
+            if px:
+                stats.add_stat(QueryStat.DOWNSAMPLE_PIXELS, px)
             t_ser = time.monotonic()
             total_dps = sum(r.num_dps if hasattr(r, "num_dps")
                             else len(r.dps) for r in results)
@@ -589,16 +594,24 @@ class HttpRpcRouter:
                 inner = request.serializer.stream_query(
                     tsq, results, as_arrays=request.flag("arrays"))
 
-                def body_iter(inner=inner, stats=stats, t_ser=t_ser):
+                def body_iter(inner=inner, stats=stats, t_ser=t_ser,
+                              px=px):
                     # the stream IS the serialization: success, timing
                     # AND completion are marked when it exhausts (or
                     # aborts), so /api/stats/query reports the real
                     # totalTime of streamed queries, not the
                     # pre-serialization slice
+                    nbytes = 0
                     try:
-                        yield from inner
+                        for chunk in inner:
+                            nbytes += len(chunk)
+                            yield chunk
+                        ser_ms = (time.monotonic() - t_ser) * 1e3
                         stats.add_stat(QueryStat.SERIALIZATION_TIME,
-                                       (time.monotonic() - t_ser) * 1e3)
+                                       ser_ms)
+                        stats.add_stat(QueryStat.PAYLOAD_BYTES, nbytes)
+                        self.tsdb.payload_stats.record(nbytes, ser_ms,
+                                                       px)
                         stats.mark_serialization_successful()
                     finally:
                         stats.mark_complete()
@@ -614,8 +627,10 @@ class HttpRpcRouter:
                 or request.flag("show_summary"),
                 show_stats=tsq.show_stats or request.flag("show_stats"),
                 summary_extra=stats.stats)
-            stats.add_stat(QueryStat.SERIALIZATION_TIME,
-                           (time.monotonic() - t_ser) * 1e3)
+            ser_ms = (time.monotonic() - t_ser) * 1e3
+            stats.add_stat(QueryStat.SERIALIZATION_TIME, ser_ms)
+            stats.add_stat(QueryStat.PAYLOAD_BYTES, len(body))
+            self.tsdb.payload_stats.record(len(body), ser_ms, px)
             stats.add_stat(QueryStat.PROCESSING_PRE_WRITE_TIME,
                            (time.monotonic_ns() - stats.start_ns) / 1e6)
             stats.mark_serialization_successful()
@@ -1285,6 +1300,10 @@ class HttpRpcRouter:
             # capacity) so lifecycle reclamation is observable
             # before/after sweeps
             "storage": t.storage_memory_info(),
+            # serve-path payload aggregates: response bytes +
+            # serialization time, so the pixel-downsampling bytes win
+            # is measurable in production
+            "query_payload": t.payload_stats.health_info(),
             "hook_errors": hook_errors,
         }
         server = self.server

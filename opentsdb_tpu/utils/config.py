@@ -31,6 +31,9 @@ _DEFAULTS: dict[str, str] = {
     "tsd.http.cachedir": "/tmp/opentsdb_tpu",
     "tsd.http.staticroot": "",
     "tsd.http.show_stack_trace": "false",
+    # /q PNG renders auto-apply an M4 pixel budget equal to the chart
+    # width (visually lossless; opt out per-request with downsample=0px)
+    "tsd.http.graph.auto_pixels": "true",
     # core
     "tsd.core.auto_create_metrics": "false",
     "tsd.core.auto_create_tagks": "true",

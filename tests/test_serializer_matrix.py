@@ -196,3 +196,144 @@ class TestErrorsAndNegotiation:
         out = ser.parse_put(b'{"metric":"m","timestamp":1,'
                             b'"value":2,"tags":{}}')
         assert isinstance(out, list) and len(out) == 1
+
+
+class TestFormatValueBoundaries:
+    """_format_value's integral-float emission boundary: ints below
+    2^53, floats at and beyond it — a double >= 2^53 cannot
+    distinguish adjacent integers, so bare integer digits would claim
+    precision the value does not carry."""
+
+    @pytest.mark.parametrize("v,expect", [
+        (float(2 ** 53 - 1), 2 ** 53 - 1),      # last exact int
+        (float(-(2 ** 53 - 1)), -(2 ** 53 - 1)),
+        (float(2 ** 53), float(2 ** 53)),       # boundary: stays float
+        (float(-(2 ** 53)), float(-(2 ** 53))),
+        (float(2 ** 53 + 2), float(2 ** 53 + 2)),
+        (1e300, 1e300),                          # integral, way past
+        (42.0, 42), (-0.0, 0), (2.5, 2.5),
+    ])
+    def test_boundary(self, v, expect):
+        from opentsdb_tpu.tsd.json_serializer import _format_value
+        got = _format_value(v)
+        assert got == expect and type(got) is type(expect)
+
+    def test_boundary_through_wire(self):
+        """The emitted JSON text: int digits below 2^53, a float
+        marker at/after (both the columnar and dict paths)."""
+        ser = HttpJsonSerializer()
+        ts = BASE_MS + np.arange(10, dtype=np.int64) * 1000
+        vals = np.array([float(2 ** 53 - 1), float(2 ** 53),
+                         float(2 ** 53 + 2), float(-(2 ** 53)),
+                         42.0, 2.5, 0.0, -0.0, 1.0, 3.0])
+        body = ser.format_query(_tsq(), [_result(ts, vals)])
+        txt = body.decode()
+        assert ":9007199254740991," in txt           # int digits
+        assert ":9007199254740992.0," in txt or \
+            ":9.007199254740992e+15," in txt          # float marker
+        assert ":42," in txt and ":2.5," in txt
+
+
+class TestColumnarFormatter:
+    """format_dps_columnar: byte parity with the per-point dict path
+    across value classes, shapes and resolutions."""
+
+    @pytest.mark.parametrize("as_arrays", [False, True],
+                             ids=["map", "arrays"])
+    @pytest.mark.parametrize("seconds", [True, False],
+                             ids=["sec", "ms"])
+    def test_byte_parity_with_dict_path(self, seconds, as_arrays):
+        from opentsdb_tpu.tsd.json_serializer import (
+            _format_value, format_dps_columnar)
+        rng = np.random.default_rng(11)
+        n = 3000
+        ts = BASE_MS + np.arange(n, dtype=np.int64) * 1500
+        vals = rng.normal(0, 1e4, n)
+        vals[::7] = np.round(vals[::7])     # integral floats
+        vals[0] = float("nan")
+        vals[1] = float("inf")
+        vals[2] = float("-inf")
+        vals[3] = float(2 ** 53)
+        vals[4] = float(2 ** 53 - 1)
+        vals[5] = -0.0
+        got = format_dps_columnar(ts, vals, seconds, as_arrays)
+        tt = ts // 1000 if seconds else ts
+        if as_arrays:
+            ref = json.dumps(
+                [[int(t), _format_value(float(v))]
+                 for t, v in zip(tt, vals)],
+                separators=(",", ":")).encode()[1:-1]
+        else:
+            ref = json.dumps(
+                {str(int(t)): _format_value(float(v))
+                 for t, v in zip(tt, vals)},
+                separators=(",", ":")).encode()[1:-1]
+        assert got == ref
+
+    def test_all_integral_fast_path(self):
+        from opentsdb_tpu.tsd.json_serializer import \
+            format_dps_columnar
+        ts = BASE_MS + np.arange(64, dtype=np.int64) * 1000
+        vals = np.arange(64, dtype=np.float64) - 32
+        out = format_dps_columnar(ts, vals, True, False)
+        assert b":-32," in out and b"." not in out.split(b",")[0]
+
+    def test_columnar_used_without_native(self, monkeypatch):
+        """With the native formatter unavailable, large columnar
+        results format through format_dps_columnar — and the bytes
+        still equal the per-point path's."""
+        import opentsdb_tpu.tsd.json_serializer as js
+        monkeypatch.setattr(js.HttpJsonSerializer, "_native_fmt",
+                            staticmethod(lambda: None))
+        ser = js.HttpJsonSerializer()
+        ts = BASE_MS + np.arange(500, dtype=np.int64) * 1000
+        vals = np.random.default_rng(12).normal(0, 10, 500)
+        tsq = _tsq()
+        cols = ser.format_query(tsq, [_result(ts, vals)])
+        r_py = QueryResult("m", {}, [],
+                           dps=list(zip(ts.tolist(), vals.tolist())))
+        assert cols == ser.format_query(tsq, [r_py])
+        # streamed output identical too
+        assert b"".join(ser.stream_query(
+            tsq, [_result(ts, vals)])) == cols
+
+    def test_dedupe_seconds_parity(self, monkeypatch):
+        """ms points collapsing to one second: columnar map form
+        dedupes last-wins exactly like the dict path."""
+        import opentsdb_tpu.tsd.json_serializer as js
+        monkeypatch.setattr(js.HttpJsonSerializer, "_native_fmt",
+                            staticmethod(lambda: None))
+        ser = js.HttpJsonSerializer()
+        ts = BASE_MS + np.asarray([0, 250, 500, 1000, 1250, 2000],
+                                  dtype=np.int64)
+        vals = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+        out = json.loads(ser.format_query(_tsq(),
+                                          [_result(ts, vals)]))
+        assert out[0]["dps"] == {str(BASE_MS // 1000): 3,
+                                 str(BASE_MS // 1000 + 1): 5,
+                                 str(BASE_MS // 1000 + 2): 6}
+
+
+class TestNativeBuildRegression:
+    def test_library_builds_when_compiler_present(self):
+        """Regression guard (carried ROADMAP follow-up, fixed in this
+        PR): gcc-10's libstdc++ ships integer std::to_chars ONLY, so a
+        bare std::to_chars(p, end, <double>) is ambiguous there and
+        broke the whole native build — every native-backend test
+        silently skipped and the serve path ran on the pure-Python
+        fallbacks. Double formatting must go through fmt_double_chars
+        (feature-tested on __cpp_lib_to_chars with a verified %g
+        fallback). If a compiler exists on this host, the build MUST
+        succeed; a skip here is only ever 'no g++ at all'."""
+        import shutil
+        from opentsdb_tpu.native import store_backend
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ on this host")
+        store_backend.load_library()  # raises NativeBuildError on
+        # regression — the pure-Python parser/formatter fallbacks
+        # still exist (see parse_import_buffer / format_dps_columnar)
+        # but must never again be the best a compiler-equipped host
+        # can do
+        src = open(store_backend._SRC).read()
+        assert "fmt_double_chars" in src
+        assert "__cpp_lib_to_chars" in src

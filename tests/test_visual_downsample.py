@@ -1,0 +1,480 @@
+"""Pixel-aware serve-path downsampling battery (``-m viz``).
+
+Oracle contract: the vectorized M4 kernel (ops/visual_downsample.py)
+must select EXACTLY the per-pixel first/last/min/max point set a naive
+per-pixel scan selects, across edge shapes — NaN gaps, single-point
+buckets, ms resolution, bucket-straddling windows, ties, infinities.
+Plus: MinMaxLTTB's bounded-points property, the end-to-end subset
+/extremes guarantees through /api/query, pixel/result-cache key
+interaction, the strict 400 matrix, SSE pixel frames and the /q
+auto-pixel budget.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.ops import visual_downsample as vd
+from opentsdb_tpu.query.model import (BadRequestError, TSQuery,
+                                      effective_pixels,
+                                      parse_uri_pixels,
+                                      parse_uri_query)
+
+pytestmark = pytest.mark.viz
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+
+
+def _tsdb(**extra):
+    return TSDB(Config(**{"tsd.core.auto_create_metrics": "true",
+                          "tsd.storage.backend": "memory", **extra}))
+
+
+def _check_oracle(ts, vals2d, emit2d, start_ms, end_ms, px):
+    """Vectorized kernel vs the naive per-series reference."""
+    keep = vd.keep_mask(vals2d, emit2d, ts, start_ms, end_ms, px,
+                        "m4")
+    if keep is None:  # guaranteed no-op: everything kept
+        keep = emit2d
+    for s in range(vals2d.shape[0]):
+        ref = vd.naive_m4_reference(ts, vals2d[s], emit2d[s],
+                                    start_ms, end_ms, px)
+        got = set(np.nonzero(keep[s])[0].tolist())
+        assert got == ref, (s, sorted(got ^ ref))
+    return keep
+
+
+class TestM4Oracle:
+    def test_dense_random(self):
+        rng = np.random.default_rng(0)
+        ts = BASE_MS + np.arange(4000, dtype=np.int64) * 1000
+        vals = rng.normal(0, 1, (5, 4000))
+        emit = np.ones((5, 4000), dtype=bool)
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 4_000_000, 137)
+        # bounded: <= 4 points per pixel column per series
+        pidx = vd.assign_pixels(ts, BASE_MS, BASE_MS + 4_000_000, 137)
+        for s in range(5):
+            assert np.bincount(pidx[keep[s]],
+                               minlength=137).max() <= 4
+
+    def test_nan_gaps(self):
+        """NaN-valued emitted points (fill-policy holes) keep their
+        per-pixel first/last so gap boundaries survive; all-NaN pixels
+        emit no min/max."""
+        rng = np.random.default_rng(1)
+        ts = BASE_MS + np.arange(2000, dtype=np.int64) * 500
+        vals = rng.normal(0, 1, (3, 2000))
+        vals[0, 100:400] = np.nan
+        vals[1, :] = np.nan          # an all-NaN series
+        vals[2, ::2] = np.nan
+        emit = np.ones((3, 2000), dtype=bool)
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 1_000_000, 50)
+        assert keep[1].sum() > 0     # gaps still draw first/last
+
+    def test_sparse_emit_and_single_point_buckets(self):
+        rng = np.random.default_rng(2)
+        ts = BASE_MS + np.sort(rng.choice(
+            np.arange(0, 10_000_000, 250), 800,
+            replace=False)).astype(np.int64)
+        vals = rng.normal(0, 1, (4, 800))
+        emit = rng.random((4, 800)) > 0.6
+        emit[2] = False                      # empty series
+        emit[3, :] = False
+        emit[3, 417] = True                  # single emitted point
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 10_000_000, 300)
+        assert keep[2].sum() == 0
+        assert keep[3].sum() == 1 and keep[3, 417]
+        # selection never invents points outside the emit mask
+        assert not (keep & ~emit).any()
+
+    def test_ms_resolution_buckets(self):
+        """Sub-second timestamps: pixel assignment is pure int64 ms
+        arithmetic, no second-rounding."""
+        rng = np.random.default_rng(3)
+        ts = BASE_MS + np.arange(5000, dtype=np.int64)  # 1ms cadence
+        vals = rng.normal(0, 1, (2, 5000))
+        emit = np.ones((2, 5000), dtype=bool)
+        _check_oracle(ts, vals, emit, BASE_MS, BASE_MS + 5000, 64)
+
+    def test_bucket_straddling_window(self):
+        """The aligned-down first bucket starts BEFORE the query
+        window (downsample alignment): clips into pixel 0 instead of
+        a negative column."""
+        ts = (BASE_MS - 60_000) + np.arange(200, dtype=np.int64) \
+            * 60_000
+        rng = np.random.default_rng(4)
+        vals = rng.normal(0, 1, (2, 200))
+        emit = np.ones((2, 200), dtype=bool)
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 199 * 60_000, 10)
+        assert keep[:, 0].all()  # the straddling bucket is pixel 0's
+        # first point and must survive
+
+    def test_ties_and_infinities(self):
+        """Equal values tie-break to the earliest column; +/-inf are
+        legal extremes."""
+        ts = BASE_MS + np.arange(100, dtype=np.int64) * 1000
+        vals = np.zeros((1, 100))
+        vals[0, 7] = np.inf
+        vals[0, 13] = -np.inf
+        emit = np.ones((1, 100), dtype=bool)
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 100_000, 2)
+        assert keep[0, 7] and keep[0, 13]
+
+    def test_constant_series_collapses_to_ends(self):
+        """All-equal values: min == max == first per pixel, so each
+        pixel keeps exactly first+last (2 points)."""
+        ts = BASE_MS + np.arange(1000, dtype=np.int64) * 1000
+        vals = np.full((1, 1000), 5.0)
+        emit = np.ones((1, 1000), dtype=bool)
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 1_000_000, 10)
+        pidx = vd.assign_pixels(ts, BASE_MS, BASE_MS + 1_000_000, 10)
+        assert np.bincount(pidx[keep[0]], minlength=10).max() <= 2
+
+    def test_noop_below_budget(self):
+        ts = BASE_MS + np.arange(50, dtype=np.int64) * 1000
+        vals = np.zeros((1, 50))
+        emit = np.ones((1, 50), dtype=bool)
+        assert vd.keep_mask(vals, emit, ts, BASE_MS, BASE_MS + 50_000,
+                            100, "m4") is None
+
+    def test_trailing_empty_window(self):
+        """Data ends long before the query window does (end in the
+        future / a series that stopped reporting): every pixel past
+        the last data column is empty, and searchsorted emits segment
+        starts == B for them — regression: reduceat rejects a start
+        == B and the kernel crashed instead of invalidating the
+        pixels."""
+        rng = np.random.default_rng(6)
+        ts = BASE_MS + np.arange(600, dtype=np.int64) * 1000
+        vals = rng.normal(0, 1, (3, 600))
+        emit = np.ones((3, 600), dtype=bool)
+        # 1h window, data covers only the first 10 minutes
+        keep = _check_oracle(ts, vals, emit, BASE_MS,
+                             BASE_MS + 3_600_000, 100)
+        pidx = vd.assign_pixels(ts, BASE_MS, BASE_MS + 3_600_000, 100)
+        assert not (keep & ~emit).any()
+        assert np.bincount(pidx[keep[0]], minlength=100).max() <= 4
+
+
+class TestMinMaxLTTB:
+    def test_bounded_points(self):
+        rng = np.random.default_rng(5)
+        ts = BASE_MS + np.arange(20_000, dtype=np.int64) * 500
+        vals = rng.normal(0, 1, (6, 20_000))
+        emit = rng.random((6, 20_000)) > 0.05
+        px = 250
+        keep = vd.keep_mask(vals, emit, ts, BASE_MS,
+                            BASE_MS + 10_000_000, px, "minmaxlttb")
+        assert (keep.sum(axis=1) <= px).all()
+        assert not (keep & ~emit).any()
+        # anchors: global first/last emitted point always kept
+        for s in range(6):
+            cols = np.nonzero(emit[s])[0]
+            if len(cols):
+                assert keep[s, cols[0]] and keep[s, cols[-1]]
+
+    def test_under_budget_is_identity(self):
+        rng = np.random.default_rng(6)
+        ts = BASE_MS + np.arange(100, dtype=np.int64) * 1000
+        vals = rng.normal(0, 1, (2, 100))
+        emit = rng.random((2, 100)) > 0.3
+        keep = vd.keep_mask(vals, emit, ts, BASE_MS, BASE_MS + 100_000,
+                            500, "minmaxlttb")
+        np.testing.assert_array_equal(keep, emit)
+
+    def test_never_selects_nan(self):
+        ts = BASE_MS + np.arange(5000, dtype=np.int64) * 1000
+        vals = np.random.default_rng(7).normal(0, 1, (1, 5000))
+        vals[0, ::3] = np.nan
+        emit = np.ones((1, 5000), dtype=bool)
+        keep = vd.keep_mask(vals, emit, ts, BASE_MS,
+                            BASE_MS + 5_000_000, 100, "minmaxlttb")
+        inner = keep[0].copy()
+        cols = np.nonzero(emit[0])[0]
+        inner[cols[0]] = inner[cols[-1]] = False  # anchors may be NaN
+        assert not np.isnan(vals[0][inner]).any()
+
+    def test_trailing_empty_window(self):
+        """Same regression as the M4 twin: bins past the last data
+        column must be invalidated, not crash reduceat."""
+        rng = np.random.default_rng(9)
+        ts = BASE_MS + np.arange(600, dtype=np.int64) * 1000
+        vals = rng.normal(0, 1, (2, 600))
+        emit = np.ones((2, 600), dtype=bool)
+        keep = vd.keep_mask(vals, emit, ts, BASE_MS,
+                            BASE_MS + 3_600_000, 100, "minmaxlttb")
+        assert (keep.sum(axis=1) <= 100).all()
+        assert keep[:, 0].all() and keep[:, -1].all()  # anchors
+        assert not (keep & ~emit).any()
+
+
+def _serve(tsdb, qobj) -> list:
+    return tsdb.execute_query(TSQuery.from_json(qobj).validate())
+
+
+class TestQuerySurface:
+    """End-to-end /api/query semantics of the pixels option."""
+
+    @pytest.fixture()
+    def t(self):
+        t = _tsdb()
+        rng = np.random.default_rng(8)
+        ts = np.arange(BASE, BASE + 7200, 2, dtype=np.int64)
+        for i in range(4):
+            t.add_points("sys.viz", ts, rng.normal(100, 10, len(ts)),
+                         {"host": f"h{i}", "task": f"t{i % 2}"})
+        return t
+
+    def _q(self, px=None, fn=None, **over):
+        sub = {"metric": "sys.viz", "aggregator": "sum",
+               "filters": [{"type": "wildcard", "tagk": "host",
+                            "filter": "*", "groupBy": True}]}
+        if px is not None:
+            sub["pixels"] = px
+        if fn is not None:
+            sub["pixelFn"] = fn
+        return {"start": BASE_MS, "end": (BASE + 7200) * 1000,
+                "queries": [sub], **over}
+
+    def test_subset_and_extremes(self, t):
+        full = _serve(t, self._q())
+        red = _serve(t, self._q(px=300))
+        assert len(full) == len(red) == 4
+        for f, r in zip(full, red):
+            df, dr = dict(f.dps), dict(r.dps)
+            assert set(dr).issubset(df)
+            assert all(df[k] == v for k, v in dr.items())
+            assert min(df.values()) == min(dr.values())
+            assert max(df.values()) == max(dr.values())
+            assert len(dr) < len(df) / 2
+
+    def test_query_level_pixels_and_per_sub_override(self, t):
+        q = self._q()
+        q["pixels"] = 100
+        red = _serve(t, q)
+        q2 = self._q(px=300)
+        q2["pixels"] = 100  # per-sub wins
+        red2 = _serve(t, q2)
+        assert max(len(dict(r.dps)) for r in red) < \
+            max(len(dict(r.dps)) for r in red2)
+
+    def test_m4_vs_lttb_budgets(self, t):
+        m4 = _serve(t, self._q(px=200, fn="m4"))
+        lt = _serve(t, self._q(px=200, fn="minmaxlttb"))
+        for r in lt:
+            assert len(dict(r.dps)) <= 200
+        for r in m4:
+            assert len(dict(r.dps)) <= 4 * 200
+
+    def test_rate_then_reduce(self, t):
+        """Reduction applies AFTER rate: reduced rate values are a
+        subset of the full rate output."""
+        full = _serve(t, self._q(rate=True))
+
+        def q():
+            obj = self._q(px=150)
+            obj["queries"][0]["rate"] = True
+            return obj
+        red = _serve(t, q())
+        for f, r in zip(full, red):
+            df, dr = dict(f.dps), dict(r.dps)
+            assert set(dr).issubset(df)
+
+    def test_cache_key_pixel_interaction(self, t):
+        """Full-resolution and pixel-budgeted requests of the same
+        sub-query occupy DISTINCT result-cache entries; repeats hit."""
+        cache = t.result_cache
+        _serve(t, self._q())
+        _serve(t, self._q(px=300))
+        assert cache.misses == 2 and cache.hits == 0
+        full2 = _serve(t, self._q())
+        red2 = _serve(t, self._q(px=300))
+        assert cache.hits == 2
+        assert len(dict(red2[0].dps)) < len(dict(full2[0].dps))
+        # a different budget is a different entry
+        _serve(t, self._q(px=100))
+        assert cache.misses == 3
+
+    def test_emit_raw_per_series(self, t):
+        """agg=none (per-series emission) reduces each series row."""
+        q = self._q(px=120)
+        q["queries"][0]["aggregator"] = "none"
+        red = _serve(t, q)
+        full = self._q()
+        full["queries"][0]["aggregator"] = "none"
+        fr = _serve(t, full)
+        assert len(red) == len(fr) == 4
+        for f, r in zip(fr, red):
+            assert set(dict(r.dps)).issubset(dict(f.dps))
+
+
+class Test400Matrix:
+    """Strict validation: nonsense never silently degrades to
+    'no reduction'."""
+
+    @pytest.mark.parametrize("spec", [
+        "abcpx", "px", "12pxx", "-5px", "1.5px", "1500px-", "1500px-x",
+        "1500px-lttbx", "70000px", "1_500px", "1500 px", "0800px",
+        "00px"])
+    def test_uri_rejects(self, spec):
+        with pytest.raises(BadRequestError):
+            parse_uri_pixels(spec)
+
+    @pytest.mark.parametrize("spec,px,fn", [
+        ("1500px", 1500, ""), ("800px-m4", 800, "m4"),
+        ("640px-minmaxlttb", 640, "minmaxlttb"), ("0px", 0, "")])
+    def test_uri_accepts(self, spec, px, fn):
+        assert parse_uri_pixels(spec) == (px, fn)
+
+    @pytest.mark.parametrize("px", [
+        -1, 70000, "abc", "1_5", "١٥", "0800", 1.5, True, [5],
+        {"a": 1}])
+    def test_json_pixels_rejects(self, px):
+        q = TSQuery.from_json({
+            "start": BASE_MS, "end": BASE_MS + 1000,
+            "queries": [{"metric": "m", "aggregator": "sum",
+                         "pixels": px}]})
+        with pytest.raises(BadRequestError):
+            q.validate()
+
+    def test_json_pixel_fn_rejects(self):
+        q = TSQuery.from_json({
+            "start": BASE_MS, "end": BASE_MS + 1000,
+            "queries": [{"metric": "m", "aggregator": "sum",
+                         "pixels": 100, "pixelFn": "bogus"}]})
+        with pytest.raises(BadRequestError):
+            q.validate()
+
+    def test_percentiles_reject_pixels(self):
+        q = TSQuery.from_json({
+            "start": BASE_MS, "end": BASE_MS + 1000, "pixels": 100,
+            "queries": [{"metric": "m", "aggregator": "sum",
+                         "percentiles": [99.0]}]})
+        with pytest.raises(BadRequestError):
+            q.validate()
+
+    def test_uri_query_carries_pixels(self):
+        tsq = parse_uri_query({"start": [str(BASE_MS)],
+                               "m": ["sum:m"],
+                               "downsample": ["1500px-minmaxlttb"]})
+        assert tsq.pixels == 1500 and tsq.pixel_fn == "minmaxlttb"
+        sub = tsq.queries[0]
+        assert effective_pixels(tsq, sub) == (1500, "minmaxlttb")
+
+    def test_dedupe_keeps_distinct_budgets(self):
+        tsq = parse_uri_query({"start": [str(BASE_MS)],
+                               "m": ["sum:m", "sum:m"]})
+        tsq.queries[1].pixels = 99
+        assert len(tsq.dedupe_queries().queries) == 2
+
+
+class TestStreamingPixels:
+    """SSE: a pixel-budgeted standing query publishes whole reduced
+    frames; the pull path reduces regardless of how the plan was
+    registered."""
+
+    def _live_tsdb(self):
+        t = _tsdb(**{"tsd.streaming.publish_min_interval_ms": "0"})
+        rng = np.random.default_rng(9)
+        ts = np.arange(BASE, BASE + 3600, dtype=np.int64)
+        for i in range(2):
+            t.add_points("sys.live", ts, rng.normal(100, 10, len(ts)),
+                         {"host": f"h{i}"})
+        return t, (BASE + 3600) * 1000
+
+    def test_pixel_frames_bounded(self):
+        t, end_ms = self._live_tsdb()
+        reg = t.streaming
+        cq = reg.register({
+            "id": "px", "start": BASE_MS, "end": end_ms,
+            "queries": [{"metric": "sys.live", "aggregator": "sum",
+                         "downsample": "10s-avg", "pixels": 50}]},
+            now_ms=end_ms)
+        sub = reg.subscribe(cq)
+        snap = sub.queue.get(timeout=5)
+        d = json.loads(snap.decode().split("data: ")[1])
+        assert sum(len(u["dps"]) for u in d["updates"]) <= 4 * 50
+        # a fold publishes the WHOLE reduced frame (windows event)
+        t.add_point("sys.live", BASE + 3500, 1e6, {"host": "h0"})
+        reg.flush()
+        w = sub.queue.get(timeout=5)
+        assert b"event: windows" in w
+        dw = json.loads(w.decode().split("data: ")[1])
+        n = sum(len(u["dps"]) for u in dw["updates"])
+        assert 2 <= n <= 4 * 50
+        # the spike's bucket average must be present (a pixel max now)
+        allv = [v for u in dw["updates"] for v in u["dps"].values()]
+        assert any(v is not None and v >= 5e4 for v in allv)
+
+    def test_pull_path_reduces_unregistered_budget(self):
+        """A plan registered WITHOUT pixels serves a pixel-budgeted
+        pull: reduction applies at result assembly."""
+        t, end_ms = self._live_tsdb()
+        reg = t.streaming
+        reg.register({"id": "full", "start": BASE_MS, "end": end_ms,
+                      "queries": [{"metric": "sys.live",
+                                   "aggregator": "sum",
+                                   "downsample": "10s-avg"}]},
+                     now_ms=end_ms)
+        qobj = {"start": BASE_MS, "end": end_ms,
+                "queries": [{"metric": "sys.live", "aggregator": "sum",
+                             "downsample": "10s-avg", "pixels": 40}]}
+        hits0 = reg.serve_hits
+        out = _serve(t, qobj)
+        assert reg.serve_hits == hits0 + 1
+        assert len(dict(out[0].dps)) <= 4 * 40
+        full = _serve(t, {"start": BASE_MS, "end": end_ms,
+                          "queries": [{"metric": "sys.live",
+                                       "aggregator": "sum",
+                                       "downsample": "10s-avg"}]})
+        assert set(dict(out[0].dps)).issubset(dict(full[0].dps))
+
+
+class TestGraphAutoPixels:
+    def test_png_auto_budget_and_optout(self):
+        from urllib.parse import parse_qs, urlsplit
+        from opentsdb_tpu.tsd.http_api import HttpRequest, \
+            HttpRpcRouter
+        pytest.importorskip("matplotlib")
+        t = _tsdb()
+        rng = np.random.default_rng(10)
+        ts = np.arange(BASE, BASE + 3600, dtype=np.int64)
+        t.add_points("sys.g", ts, rng.normal(1, 1, len(ts)),
+                     {"host": "a"})
+        router = HttpRpcRouter(t)
+
+        def q(url):
+            u = urlsplit(url)
+            return router.handle(HttpRequest(
+                "GET", u.path, parse_qs(u.query,
+                                        keep_blank_values=True)))
+        end_ms = (BASE + 3600) * 1000
+        # json export: never auto-reduced
+        r = q(f"/q?start={BASE_MS}&end={end_ms}&m=sum:sys.g&json")
+        assert sum(len(x["dps"]) for x in json.loads(r.body)) == 3600
+        # png: reduced to the chart width (observable via the result
+        # cache keying on the effective budget)
+        cache = t.result_cache
+        m0 = cache.misses
+        r = q(f"/q?start={BASE_MS}&end={end_ms}&m=sum:sys.g"
+              f"&wxh=320x240&max_age=0")
+        assert r.status == 200 and cache.misses == m0 + 1
+        # explicit 0px opts out: resolves to the FULL-RES cache entry
+        # (already populated by the json export above), not the
+        # 320px-budget one
+        h0 = cache.hits
+        r = q(f"/q?start={BASE_MS}&end={end_ms}&m=sum:sys.g"
+              f"&wxh=320x240&downsample=0px&max_age=0")
+        assert r.status == 200 and cache.misses == m0 + 1 \
+            and cache.hits == h0 + 1
