@@ -906,6 +906,176 @@ def bench_viz(repeats: int, n_hosts: int = 8, per_host: int = 5,
     return out
 
 
+def bench_cluster(repeats: int, n_hosts: int = 120,
+                  span_s: int = 600) -> dict:
+    """Sharded cluster tier config: 3 shard TSDs on real sockets
+    behind a consistent-hash router, vs a single-node TSD holding the
+    same points. Records router-ingest and scatter-gather read p50
+    against the single-node baseline, plus the degraded read p50 with
+    one shard killed (the answer must stay 200 + ``shardsDegraded``,
+    merged rows on survivors identical to the oracle — the chaos
+    battery in tests/test_cluster.py proves the values; this config
+    prices the transport)."""
+    import asyncio
+    import json as _json
+    import threading
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    peer_cfg = {"tsd.core.auto_create_metrics": "true",
+                "tsd.tpu.warmup": "false"}
+
+    class Peer:
+        def __init__(self, name):
+            self.name = name
+            self.tsdb = TSDB(Config(**peer_cfg))
+            self.loop = asyncio.new_event_loop()
+            self.server = TSDServer(self.tsdb, host="127.0.0.1",
+                                    port=0)
+            started = threading.Event()
+
+            def run():
+                asyncio.set_event_loop(self.loop)
+                self.loop.run_until_complete(self.server.start())
+                started.set()
+                self.loop.run_forever()
+
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+            assert started.wait(30)
+            self.port = (self.server._server.sockets[0]
+                         .getsockname()[1])
+
+        def _call(self, coro):
+            return asyncio.run_coroutine_threadsafe(
+                coro, self.loop).result(20)
+
+        def kill(self):
+            async def _close():
+                srv = self.server._server
+                if srv is not None:
+                    srv.close()
+                    await srv.wait_closed()
+                    self.server._server = None
+            self._call(_close())
+
+        def stop(self):
+            try:
+                self._call(self.server.stop())
+            except Exception:  # noqa: BLE001
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+
+    def req(method, path, body=None, **params):
+        return HttpRequest(
+            method=method, path=path,
+            params={k: [str(v)] for k, v in params.items()},
+            body=_json.dumps(body).encode()
+            if body is not None else b"")
+
+    peers = [Peer(f"s{i}") for i in range(3)]
+    spec = ",".join(f"{p.name}=127.0.0.1:{p.port}" for p in peers)
+    router = TSDB(Config(**{
+        "tsd.cluster.role": "router", "tsd.cluster.peers": spec,
+        "tsd.query.cache.enable": "false",
+        "tsd.tpu.warmup": "false"}))
+    http = HttpRpcRouter(router)
+    router.cluster.start()
+    single = TSDB(Config(**{**peer_cfg,
+                            "tsd.query.cache.enable": "false"}))
+    single_http = HttpRpcRouter(single)
+
+    points = [{"metric": "bench.cluster",
+               "timestamp": BASE_S + i,
+               "value": (h * 37 + i) % 1000,
+               "tags": {"host": f"h{h:03d}"}}
+              for h in range(n_hosts) for i in range(span_s)]
+    batches = [points[i:i + 4000]
+               for i in range(0, len(points), 4000)]
+
+    def ingest(target):
+        t0 = time.perf_counter()
+        for b in batches:
+            resp = target.handle(req("POST", "/api/put", b,
+                                     summary="true"))
+            assert resp.status == 200
+            assert _json.loads(resp.body)["failed"] == 0
+        return time.perf_counter() - t0
+
+    router_ingest_s = ingest(http)
+    single_ingest_s = ingest(single_http)
+
+    qbody = {"start": BASE_MS - 1000,
+             "end": BASE_MS + span_s * 1000,
+             "queries": [{"metric": "bench.cluster",
+                          "aggregator": "sum",
+                          "downsample": "10s-sum",
+                          "filters": [{"type": "wildcard",
+                                       "tagk": "host", "filter": "*",
+                                       "groupBy": True}]}]}
+
+    def read_p50(target, reps):
+        target.handle(req("POST", "/api/query", qbody))  # warm
+        times = []
+        body = b""
+        for _ in range(max(reps, 3)):
+            t0 = time.perf_counter()
+            resp = target.handle(req("POST", "/api/query", qbody))
+            times.append(time.perf_counter() - t0)
+            assert resp.status == 200
+            body = resp.body
+        return _percentile(times, 50) * 1e3, body
+
+    cluster_p50, cluster_body = read_p50(http, repeats)
+    single_p50, single_body = read_p50(single_http, repeats)
+
+    def rows(body):
+        doc = _json.loads(body)
+        if doc and isinstance(doc[-1], dict) and "shardsDegraded" \
+                in doc[-1]:
+            doc = doc[:-1]
+        return sorted(((r["tags"].get("host", ""), r["dps"])
+                       for r in doc))
+
+    merged_identical = rows(cluster_body) == rows(single_body)
+
+    # degraded reads: one shard killed, answers must stay 200 with
+    # the marker — never a 5xx
+    peers[1].kill()
+    degraded_times, degraded_ok = [], True
+    for _ in range(max(repeats, 3)):
+        t0 = time.perf_counter()
+        resp = http.handle(req("POST", "/api/query", qbody))
+        degraded_times.append(time.perf_counter() - t0)
+        doc = _json.loads(resp.body)
+        degraded_ok &= (resp.status == 200 and bool(doc)
+                        and isinstance(doc[-1], dict)
+                        and doc[-1].get("shardsDegraded") == ["s1"])
+    degraded_p50 = _percentile(degraded_times, 50) * 1e3
+
+    out = {"config": "cluster", "shards": 3,
+           "series": n_hosts, "points": len(points),
+           "router_ingest_kpps":
+               round(len(points) / router_ingest_s / 1e3, 1),
+           "single_ingest_kpps":
+               round(len(points) / single_ingest_s / 1e3, 1),
+           "read_p50_cluster_ms": round(cluster_p50, 1),
+           "read_p50_single_ms": round(single_p50, 1),
+           "scatter_gather_overhead":
+               round(cluster_p50 / max(single_p50, 1e-3), 2),
+           "read_p50_degraded_ms": round(degraded_p50, 1),
+           "merged_identical_to_single_node": merged_identical,
+           "degraded_always_200_with_marker": degraded_ok,
+           "criterion_pass": bool(merged_identical and degraded_ok)}
+    router.shutdown()
+    single.shutdown()
+    for p in peers:
+        p.stop()
+    return out
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -930,7 +1100,8 @@ def main() -> None:
                4: bench_config4, 5: bench_config5,
                "wal": bench_wal, "live": bench_live,
                "lifecycle": bench_lifecycle, "cold": bench_cold,
-               "ingest": bench_ingest, "viz": bench_viz}
+               "ingest": bench_ingest, "viz": bench_viz,
+               "cluster": bench_cluster}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

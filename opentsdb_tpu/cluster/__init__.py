@@ -1,0 +1,34 @@
+"""Fault-tolerant sharded cluster tier.
+
+The reference scales horizontally as many stateless TSDs behind a load
+balancer (SURVEY §L4, ``RpcManager``); its storage layer spreads row
+keys over 20 salt buckets so scans fan out (``SaltScanner.java:70``).
+This package builds the missing serving tier on the same idea: a
+**router** mode of the TSDServer owns a consistent-hash series→shard
+map (the salt computation lifted from the row key to the network), so
+
+- writes forward as series-grouped columnar batches to the owning
+  shard (one client body stays one WAL write + one fsync per shard,
+  via the peer's ``/api/put`` → ``TSDB.add_point_groups`` path), and
+- queries scatter to every shard and gather per-shard group
+  *partials*, which merge exactly because sum/count/min/max decompose
+  across shards like the rollup tiers (``avg`` = merged sum / merged
+  count).
+
+The headline is the failure semantics (Monarch's partial-result
+pushdown, PAPERS.md): a dead, hanging or flapping peer never turns
+into a 5xx. Reads get per-peer timeouts, circuit breakers
+(:mod:`opentsdb_tpu.utils.faults`, fault site ``cluster.peer``) and
+optional tail-latency hedging; a failed shard yields a 200 partial
+carrying a ``shardsDegraded`` marker that the result cache refuses to
+retain. Writes to an unreachable shard land in a per-peer durable
+spool (framed like the WAL) that replays when the peer's breaker
+half-opens — an acknowledged point is never lost to a peer outage.
+"""
+
+from opentsdb_tpu.cluster.hashring import HashRing, series_shard_key
+from opentsdb_tpu.cluster.router import ClusterRouter
+from opentsdb_tpu.cluster.spool import PeerSpool
+
+__all__ = ["ClusterRouter", "HashRing", "PeerSpool",
+           "series_shard_key"]

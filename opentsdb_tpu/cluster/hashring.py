@@ -1,0 +1,70 @@
+"""Consistent-hash series→shard map.
+
+The reference spreads series over storage by prefixing the row key
+with ``hash(metric+tags) % 20`` salt buckets (``RowKey.java``,
+``Const.SALT_BUCKETS``); this ring lifts the same key to the network
+tier. Consistent hashing (vnodes on a ring) instead of plain modulo so
+adding or removing a shard remaps only ``~1/N`` of the series — the
+property that makes rolling a new shard into a live cluster sane.
+
+Hashes are MD5 of the key bytes: deterministic across processes and
+restarts (Python's ``hash()`` is seed-randomized per process, which
+would scatter a router restart's writes onto different shards than
+the data it already routed).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+
+def _hash64(data: bytes) -> int:
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
+
+def series_shard_key(metric: str, tags: dict[str, str]) -> bytes:
+    """The shard key of one series: the reference's salt input —
+    metric + sorted tag pairs (``RowKey.prefixKeyWithSalt`` hashes the
+    metric+tags portion of the row key). Sorted so ``{a:1,b:2}`` and
+    ``{b:2,a:1}`` land on the same shard."""
+    parts = [metric]
+    for k in sorted(tags):
+        parts.append(f"{k}={tags[k]}")
+    return "\x00".join(parts).encode("utf-8", "surrogatepass")
+
+
+class HashRing:
+    """Consistent-hash ring over named shards with ``vnodes`` virtual
+    points per shard (more vnodes = smoother key distribution)."""
+
+    def __init__(self, names: list[str], vnodes: int = 64):
+        if not names:
+            raise ValueError("hash ring needs at least one shard")
+        self.names = list(names)
+        self.vnodes = max(int(vnodes), 1)
+        points: list[tuple[int, str]] = []
+        for name in self.names:
+            for i in range(self.vnodes):
+                points.append((_hash64(f"{name}#{i}".encode()), name))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [n for _, n in points]
+
+    def shard_for_key(self, key: bytes) -> str:
+        """Owning shard of one pre-computed series key."""
+        h = _hash64(key)
+        idx = bisect.bisect_right(self._points, h)
+        if idx == len(self._points):
+            idx = 0  # wrap: the ring is circular
+        return self._owners[idx]
+
+    def shard_for(self, metric: str, tags: dict[str, str]) -> str:
+        return self.shard_for_key(series_shard_key(metric, tags))
+
+    def distribution(self, keys) -> dict[str, int]:
+        """Shard -> key count for a sample of keys (tests/ops)."""
+        out = {n: 0 for n in self.names}
+        for key in keys:
+            out[self.shard_for_key(key)] += 1
+        return out

@@ -1,0 +1,267 @@
+"""Scatter-gather partial merging.
+
+A SERIES lives wholly on one shard (the hash key is the series
+identity), so everything per-series — downsampling, rate, counter
+resets, interpolation fills — is computed exactly by the owning shard.
+Only the cross-series *group aggregation* spans shards, and it merges
+exactly when the aggregator decomposes, the same property the rollup
+tiers rely on (``rollup/job.py``): sum/count partials add, min/max
+partials min/max, and ``avg`` = merged sum / merged count (the
+``RollupSpan`` sum+count qualifier trick lifted to the network).
+Non-decomposable aggregators (dev, median, percentiles) are a clean
+400 at the router — a silently-wrong merge would be worse than no
+answer.
+
+Timestamp grids: peers are queried with ``msResolution`` forced and an
+ABSOLUTE window (the router resolves relative times once), so
+downsampled sub-queries produce identical bucket timestamps on every
+shard and partials align exactly. No-downsample (union-grid) queries
+merge at the union of the shards' emitted timestamps — documented as
+value-equal only when series don't need cross-shard interpolation
+(each timestamp's merged value combines the shards that emitted it).
+
+Group identity across shards is the group-by tag values: every member
+of a group shares them, so each shard's partial carries them in its
+``tags`` map. SpanGroup tag semantics compose across partials the
+same way they compose across series: common tags survive only where
+every partial agrees, keys that differ (or were already aggregated on
+some shard) become ``aggregateTags``, keys missing from a partial's
+tags+aggregateTags vanish.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable
+
+from opentsdb_tpu.query.model import BadRequestError
+
+
+def _add(a: float, b: float) -> float:
+    return a + b
+
+
+def _min(a: float, b: float) -> float:
+    return a if a <= b else b
+
+
+def _max(a: float, b: float) -> float:
+    return a if a >= b else b
+
+
+# group aggregators whose per-shard partials merge exactly; count
+# partials are per-shard COUNTS, so they add
+_COMBINE: dict[str, Callable[[float, float], float]] = {
+    "sum": _add, "zimsum": _add, "pfsum": _add, "count": _add,
+    "min": _min, "mimmin": _min, "max": _max, "mimmax": _max,
+}
+
+
+def decompose_plan(sub) -> str:
+    """How one sub-query's partials merge across shards:
+    ``"direct"`` (combine op exists), ``"concat"`` (emit-raw: groups
+    are single series, no cross-shard combining), or ``"avg"``
+    (rewritten into sum+count twins). Raises ``BadRequestError`` for
+    aggregators that do not decompose."""
+    if sub.percentiles:
+        raise BadRequestError(
+            "histogram percentile queries are not supported through "
+            "a cluster router (mergeable sketches are ROADMAP item 2)")
+    name = (sub.aggregator or "").lower()
+    if name == "none":
+        return "concat"
+    if name in _COMBINE:
+        return "direct"
+    if name == "avg":
+        return "avg"
+    raise BadRequestError(
+        f"aggregator {sub.aggregator!r} does not decompose across "
+        "shards (supported: sum, count, min, max, zimsum, mimmin, "
+        "mimmax, avg, none)")
+
+
+def group_key(result: dict, gb_keys: list[str]) -> tuple:
+    """Cross-shard identity of one partial group."""
+    tags = result.get("tags") or {}
+    return (result.get("metric"),
+            tuple((k, tags.get(k)) for k in gb_keys))
+
+
+class MergedGroup:
+    """One logical group being accumulated across shard partials."""
+
+    __slots__ = ("metric", "tags", "agg_tags", "dps", "tsuids",
+                 "annotations", "global_annotations")
+
+    def __init__(self, result: dict):
+        self.metric = result.get("metric", "")
+        self.tags = dict(result.get("tags") or {})
+        self.agg_tags = set(result.get("aggregateTags") or ())
+        self.dps: dict[int, float] = {}
+        self.tsuids: list[str] = list(result.get("tsuids") or ())
+        self.annotations: list[dict] = list(
+            result.get("annotations") or ())
+        self.global_annotations: list[dict] = list(
+            result.get("globalAnnotations") or ())
+
+    def fold_tags(self, result: dict) -> None:
+        """SpanGroup semantics across partials (module docstring)."""
+        r_tags = result.get("tags") or {}
+        r_agg = set(result.get("aggregateTags") or ())
+        new_tags: dict[str, str] = {}
+        new_agg: set[str] = set()
+        for k, v in self.tags.items():
+            if k in r_tags:
+                if r_tags[k] == v:
+                    new_tags[k] = v
+                else:
+                    new_agg.add(k)
+            elif k in r_agg:
+                new_agg.add(k)
+            # else: absent on some member of that shard -> vanishes
+        for k in self.agg_tags:
+            if k in r_tags or k in r_agg:
+                new_agg.add(k)
+        self.tags = new_tags
+        self.agg_tags = new_agg
+        self.tsuids.extend(result.get("tsuids") or ())
+        self.annotations.extend(result.get("annotations") or ())
+        self.global_annotations.extend(
+            result.get("globalAnnotations") or ())
+
+    def fold_dps(self, dps: Iterable, combine) -> None:
+        """Combine one partial's ``[[ts, value], ...]`` rows. NaN is
+        "this shard's members contributed nothing here" (fill-policy
+        emission), so it is the combine identity; both sides NaN
+        keeps the NaN — all members absent emits a gap, exactly what
+        the single-node grid does."""
+        mine = self.dps
+        for ts, val in dps:
+            v = float(val)
+            cur = mine.get(ts)
+            if cur is None:
+                mine[ts] = v
+            elif math.isnan(cur):
+                mine[ts] = v
+            elif not math.isnan(v):
+                mine[ts] = combine(cur, v)
+
+    def to_query_result(self, sub_index: int):
+        import numpy as np
+
+        from opentsdb_tpu.query.engine import QueryResult
+        ts_sorted = sorted(self.dps)
+        ts_arr = np.asarray(ts_sorted, dtype=np.int64)
+        vals = np.asarray([self.dps[t] for t in ts_sorted],
+                          dtype=np.float64)
+        return QueryResult(
+            metric=self.metric, tags=self.tags,
+            aggregated_tags=sorted(self.agg_tags),
+            tsuids=self.tsuids,
+            annotations=_to_annotations(self.annotations),
+            global_annotations=_to_annotations(
+                self.global_annotations),
+            sub_query_index=sub_index,
+            dps_arrays=(ts_arr, vals))
+
+
+def _to_annotations(docs: list[dict]) -> list:
+    """Peer-JSON annotation docs -> Annotation objects, deduped on
+    (tsuid, start) so overlapping global ranges don't double-emit."""
+    if not docs:
+        return []
+    from opentsdb_tpu.meta.annotation import Annotation
+    seen: set[tuple] = set()
+    out = []
+    for doc in docs:
+        note = Annotation.from_json(doc)
+        key = (note.tsuid, note.start_time)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(note)
+    return out
+
+
+def merge_partials(peer_results: list[list[dict]], gb_keys: list[str],
+                   combine) -> dict[tuple, MergedGroup]:
+    """Fold every shard's partial groups into merged groups keyed by
+    cross-shard group identity. Insertion order follows the first
+    shard that reported each group (then ring order), stable for
+    tests."""
+    groups: dict[tuple, MergedGroup] = {}
+    for results in peer_results:
+        for r in results:
+            key = group_key(r, gb_keys)
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = MergedGroup(r)
+            else:
+                g.fold_tags(r)
+            g.fold_dps(r.get("dps") or (), combine)
+    return groups
+
+
+def merge_direct(peer_results: list[list[dict]], sub,
+                 gb_keys: list[str]) -> list:
+    combine = _COMBINE[(sub.aggregator or "").lower()]
+    groups = merge_partials(peer_results, gb_keys, combine)
+    return [g.to_query_result(sub.index) for g in groups.values()]
+
+
+def merge_concat(peer_results: list[list[dict]], sub) -> list:
+    """Emit-raw ("none" aggregator): every partial is one whole series
+    (series never span shards) — concatenate, no combining."""
+    out = []
+    for results in peer_results:
+        for r in results:
+            g = MergedGroup(r)
+            g.fold_dps(r.get("dps") or (), _add)
+            out.append(g.to_query_result(sub.index))
+    return out
+
+
+def merge_avg(sum_peer_results: list[list[dict]],
+              count_peer_results: list[list[dict]], sub,
+              gb_keys: list[str]) -> list:
+    """``avg`` across shards: merged group sums / merged group counts
+    (the rollup-tier avg decomposition; engine
+    ``_avg_rollup_pipeline`` is the storage-side twin)."""
+    sums = merge_partials(sum_peer_results, gb_keys, _add)
+    counts = merge_partials(count_peer_results, gb_keys, _add)
+    out = []
+    for key, gs in sums.items():
+        gc = counts.get(key)
+        if gc is None:
+            continue
+        dps: dict[int, float] = {}
+        for ts, s in gs.dps.items():
+            c = gc.dps.get(ts)
+            if c is None or math.isnan(c) or c == 0:
+                if math.isnan(s):
+                    dps[ts] = s  # all-absent gap survives
+                continue
+            dps[ts] = s / c
+        gs.dps = dps
+        out.append(gs.to_query_result(sub.index))
+    return out
+
+
+def merge_sub(sub, gb_keys: list[str], plan: str,
+              primary: list[list[dict]],
+              secondary: list[list[dict]] | None = None) -> list:
+    if plan == "concat":
+        return merge_concat(primary, sub)
+    if plan == "avg":
+        return merge_avg(primary, secondary or [], sub, gb_keys)
+    return merge_direct(primary, sub, gb_keys)
+
+
+def gb_tag_keys(sub) -> list[str]:
+    """The group-by tag keys of one sub-query, sorted — the engine
+    groups on exactly this set (``QueryEngine._run_sub``)."""
+    return sorted({f.tagk for f in sub.filters if f.group_by})
+
+
+__all__ = ["decompose_plan", "gb_tag_keys", "group_key",
+           "merge_partials", "merge_sub", "MergedGroup"]

@@ -1,0 +1,894 @@
+"""The cluster router: consistent-hash writes, scatter-gather reads.
+
+``tsd.cluster.role = router`` turns a TSDServer into a stateless
+serving tier in front of ``tsd.cluster.peers`` shard TSDs (the
+reference's "many TSDs behind a load balancer", SURVEY §L4, with the
+salt-bucket fan-out of ``SaltScanner.java:70`` lifted to the network):
+
+- **writes** partition by the consistent-hash series key and forward
+  one series-grouped body per shard — the peer's ``/api/put`` commits
+  it through ``TSDB.add_point_groups`` as ONE WAL write + one
+  group-committed fsync (PR 6), so a client body costs one fsync per
+  shard, not per point. An unreachable shard's batches land in its
+  durable spool (:mod:`opentsdb_tpu.cluster.spool`) and the client is
+  still acknowledged: no acknowledged point is ever lost to a peer
+  outage. Replay drains in FIFO order when the peer's breaker lets a
+  probe through.
+- **reads** scatter the (absolutized, ms-resolution) TSQuery to every
+  shard and merge per-shard group partials
+  (:mod:`opentsdb_tpu.cluster.merge`). Failures flow through the
+  PR-1 idiom — per-peer :class:`CircuitBreaker`, per-peer timeouts,
+  the ``cluster.peer`` fault site, optional tail-latency hedging —
+  and a dead/hung/tripped peer yields a **200 partial** carrying a
+  ``shardsDegraded`` marker (never a 5xx). Degraded partials are
+  never retained by the result cache; a later complete answer
+  repopulates the entry.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import logging
+import queue as queue_mod
+import threading
+import time
+from typing import Any
+
+from opentsdb_tpu.cluster import merge as merge_mod
+from opentsdb_tpu.cluster.client import (PeerClient, PeerError,
+                                         parse_peer_spec)
+from opentsdb_tpu.cluster.hashring import HashRing
+from opentsdb_tpu.cluster.spool import PeerSpool, SpoolFull
+from opentsdb_tpu.core.tags import check_metric_and_tags, parse_put_value
+from opentsdb_tpu.query.model import BadRequestError
+from opentsdb_tpu.utils.faults import (CircuitBreaker, DegradedError,
+                                       RetryPolicy, call_with_retries)
+
+LOG = logging.getLogger("cluster.router")
+
+
+class PeerUnavailable(OSError):
+    """The peer's breaker refused the dispatch (open, or half-open
+    with the probe already in flight): degrade WITHOUT touching the
+    peer — and without recording a failure the peer didn't commit."""
+
+
+class Peer:
+    """One shard TSD: address, health machinery, handoff spool."""
+
+    def __init__(self, name: str, host: str, port: int, config,
+                 spool_dir: str | None):
+        self.name = name
+        self.client = PeerClient(
+            host, port,
+            timeout_ms=config.get_float("tsd.cluster.timeout_ms",
+                                        5000.0))
+        self.breaker = CircuitBreaker(
+            f"cluster.peer.{name}",
+            failure_threshold=config.get_int(
+                "tsd.cluster.breaker.failure_threshold", 3),
+            reset_timeout_ms=config.get_float(
+                "tsd.cluster.breaker.reset_timeout_ms", 5000.0))
+        self.spool = PeerSpool(
+            spool_dir, name,
+            max_bytes=config.get_int("tsd.cluster.spool.max_mb",
+                                     256) << 20,
+            compact_bytes=config.get_int(
+                "tsd.cluster.spool.compact_mb", 4) << 20)
+        self.lock = threading.Lock()  # FIFO spool-vs-forward decision
+        # counters (exported via /api/stats + /api/health)
+        self.forwarded_batches = 0
+        self.forwarded_points = 0
+        self.spooled_batches = 0
+        self.spooled_points = 0
+        self.replayed_batches = 0
+        self.replay_point_errors = 0
+        self.query_failures = 0
+        self.hedges = 0
+
+    def health_info(self) -> dict[str, Any]:
+        return {
+            "address": self.client.address,
+            "breaker": self.breaker.health_info(),
+            "spool": self.spool.health_info(),
+            "forwarded_batches": self.forwarded_batches,
+            "forwarded_points": self.forwarded_points,
+            "spooled_batches": self.spooled_batches,
+            "spooled_points": self.spooled_points,
+            "replayed_batches": self.replayed_batches,
+            "replay_point_errors": self.replay_point_errors,
+            "query_failures": self.query_failures,
+            "hedges": self.hedges,
+        }
+
+
+class ClusterRouter:
+    """Owns the shard map, the peers and the failure machinery."""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        config = tsdb.config
+        self.config = config
+        specs = parse_peer_spec(
+            config.get_string("tsd.cluster.peers", ""))
+        if not specs:
+            raise ValueError(
+                "tsd.cluster.role=router needs tsd.cluster.peers")
+        spool_dir = config.get_string("tsd.cluster.spool.dir", "")
+        if not spool_dir and getattr(tsdb, "data_dir", ""):
+            import os
+            spool_dir = os.path.join(tsdb.data_dir, "cluster_spool")
+        self.peers: dict[str, Peer] = {}
+        for name, host, port in specs:
+            self.peers[name] = Peer(name, host, port, config,
+                                    spool_dir or None)
+        self.ring = HashRing(
+            [name for name, _, _ in specs],
+            vnodes=config.get_int("tsd.cluster.vnodes", 64))
+        workers = config.get_int("tsd.cluster.fanout_workers", 0) \
+            or max(2 * len(self.peers), 4)
+        self.pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="tsd-cluster")
+        self.retry = RetryPolicy.from_config(
+            config, "tsd.cluster.retry", attempts=2, base_ms=25,
+            deadline_ms=2000)
+        self.timeout_s = config.get_float("tsd.cluster.timeout_ms",
+                                          5000.0) / 1000.0
+        self.hedge_after_s = config.get_float(
+            "tsd.cluster.hedge_after_ms", 0.0) / 1000.0
+        self.replay_interval_s = config.get_float(
+            "tsd.cluster.spool.replay_interval_ms", 500.0) / 1000.0
+        self.replay_batch = config.get_int(
+            "tsd.cluster.spool.replay_batch", 64)
+        # router-level counters
+        self.queries = 0
+        self.degraded_queries = 0
+        self.cache_hits = 0
+        self.cache_stores = 0
+        self.cache_degraded_skips = 0
+        # per-metric invalidation versions for the result cache (see
+        # write_version): bumped AFTER a write/delete lands so a
+        # racing query can never cache pre-write data under the
+        # post-write version
+        self._version_lock = threading.Lock()
+        self._metric_versions: dict[str, int] = {}
+        self._global_version = 0
+        self._stop = threading.Event()
+        self._replay_thread: threading.Thread | None = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the spool replay thread (idempotent)."""
+        if self._started:
+            return
+        self._started = True
+        t = threading.Thread(target=self._replay_loop,
+                             name="cluster-replay", daemon=True)
+        self._replay_thread = t
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._replay_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self.pool.shutdown(wait=False)
+        for peer in self.peers.values():
+            peer.spool.close()
+
+    # ------------------------------------------------------------------
+    # shared peer dispatch (fault site + breaker + retry)
+    # ------------------------------------------------------------------
+
+    def _check_faults(self, peer: Peer) -> None:
+        faults = getattr(self.tsdb, "faults", None)
+        if faults is not None:
+            faults.check("cluster.peer")
+            faults.check(f"cluster.peer.{peer.name}")
+
+    def _fetch(self, peer: Peer, method: str, path: str,
+               body: bytes | None) -> tuple[int, bytes]:
+        """One request with optional tail-latency hedging: after
+        ``tsd.cluster.hedge_after_ms`` without an answer, a duplicate
+        request races the first — first completion wins (Monarch /
+        Dean & Barroso "The Tail at Scale"). Hedge threads are
+        bounded by the peer socket timeout."""
+        if self.hedge_after_s <= 0:
+            return peer.client.request(method, path, body)
+        results: queue_mod.Queue = queue_mod.Queue()
+
+        def attempt() -> None:
+            try:
+                results.put(("ok",
+                             peer.client.request(method, path, body)))
+            except Exception as exc:  # noqa: BLE001 - carried across
+                results.put(("err", exc))
+
+        threading.Thread(target=attempt, daemon=True).start()
+        deadline = time.monotonic() + self.timeout_s + 1.0
+        launched = 1
+        errors = 0
+        first_err: Exception | None = None
+        wait_s = self.hedge_after_s
+        while True:
+            try:
+                kind, payload = results.get(
+                    timeout=max(min(wait_s,
+                                    deadline - time.monotonic()),
+                                0.001))
+            except queue_mod.Empty:
+                if launched == 1 and time.monotonic() < deadline:
+                    peer.hedges += 1
+                    threading.Thread(target=attempt,
+                                     daemon=True).start()
+                    launched = 2
+                    wait_s = deadline - time.monotonic()
+                    continue
+                raise PeerError(
+                    f"peer {peer.name}: hedged request timed out"
+                ) from first_err
+            if kind == "ok":
+                return payload
+            errors += 1
+            first_err = first_err or payload
+            if errors >= launched and launched == 2:
+                raise payload
+            if errors >= launched:
+                # primary failed before the hedge fired: launch the
+                # backup immediately, it is the only hope left
+                peer.hedges += 1
+                threading.Thread(target=attempt, daemon=True).start()
+                launched = 2
+                wait_s = deadline - time.monotonic()
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+
+    def partition_points(self, points: list[dict]
+                         ) -> tuple[dict[str, list[dict]], list[dict]]:
+        """Shard each datapoint by its series key. Returns
+        (shard -> points, local error entries for unshardable dps)."""
+        batches: dict[str, list[dict]] = {}
+        errors: list[dict] = []
+        for dp in points:
+            if not isinstance(dp, dict):
+                errors.append({"datapoint": dp,
+                               "error": "not a datapoint object"})
+                continue
+            metric = dp.get("metric")
+            tags = dp.get("tags") or {}
+            if not isinstance(metric, str) or not metric or \
+                    not isinstance(tags, dict):
+                errors.append({"datapoint": dp,
+                               "error": "missing metric or tags"})
+                continue
+            # mirror the peer's per-point validation BEFORE acking: a
+            # bad point bound for a dead shard would be acked into
+            # the spool now and rejected at replay — the same body a
+            # HEALTHY shard 400s, so ack semantics would depend on
+            # peer liveness. Same helpers the shard's write path
+            # calls, so the accept sets cannot drift.
+            try:
+                self.tsdb._check_timestamp(int(dp["timestamp"]))
+                check_metric_and_tags(metric, tags)
+                value = dp.get("value")
+                if isinstance(value, str):
+                    parse_put_value(value)
+                elif value is None or isinstance(value, bool) or \
+                        not isinstance(value, (int, float)):
+                    raise ValueError(f"invalid value: {value!r}")
+            except (KeyError, TypeError, ValueError) as exc:
+                errors.append({"datapoint": dp, "error": str(exc)})
+                continue
+            shard = self.ring.shard_for(metric, tags)
+            batches.setdefault(shard, []).append(dp)
+        return batches, errors
+
+    def forward_writes(self, points: list[dict]
+                       ) -> tuple[int, int, list[dict]]:
+        """Partition + deliver one put body. Returns
+        (success, failed, error entries). Spooled points count as
+        success — they are durably accepted and will replay.
+
+        At-least-once, never at-most-once: a delivery that outlives
+        the ``fut.result`` cap below is reported failed even though
+        the in-flight worker may still land (or spool) it — the safe
+        direction, since a re-sent point dedupes last-write-wins on
+        the shard, while the reverse (acking a loss) cannot be
+        repaired."""
+        batches, errors = self.partition_points(points)
+        failed = len(errors)
+        success = 0
+        futures = {
+            self.pool.submit(self._deliver, self.peers[name], dps):
+            (name, dps) for name, dps in batches.items()}
+        for fut, (name, dps) in futures.items():
+            try:
+                ok, bad, errs = fut.result(
+                    timeout=self.timeout_s * 4 + 5)
+            except Exception as exc:  # noqa: BLE001 - per-shard
+                LOG.exception("forward to %s failed unexpectedly",
+                              name)
+                ok, bad = 0, len(dps)
+                errs = [{"datapoint": dp, "error": str(exc)}
+                        for dp in dps]
+            success += ok
+            failed += bad
+            errors.extend(errs)
+        # AFTER delivery/spool: a racing query that read the new
+        # version has already seen (or will re-read) the landed data
+        self._bump_versions(
+            dp["metric"] for dps in batches.values() for dp in dps)
+        return success, failed, errors
+
+    def _deliver(self, peer: Peer, dps: list[dict]
+                 ) -> tuple[int, int, list[dict]]:
+        """One shard's batch: forward, or spool when the peer is
+        backlogged/unhealthy (FIFO: a non-empty spool means new
+        writes enqueue BEHIND it, so replayed history and causally
+        LATER traffic keep arrival order — an ack always precedes
+        the next dependent write's dispatch; batches concurrently in
+        flight during the failure window are unordered, as
+        concurrent writes always are)."""
+        body = json.dumps(dps).encode()
+        with peer.lock:
+            direct = (peer.spool.pending_records == 0
+                      and peer.breaker.state == CircuitBreaker.CLOSED)
+            if not direct:
+                return self._spool_batch(peer, body, dps)
+        try:
+            self._check_faults(peer)
+            status, data = call_with_retries(
+                lambda: self._fetch(
+                    peer, "POST",
+                    "/api/put?summary=true&details=true", body),
+                self.retry, retryable=(OSError,))
+        except OSError as exc:
+            peer.breaker.record_failure()
+            LOG.warning("shard %s unreachable (%s); spooling %d "
+                        "point(s)", peer.name, exc, len(dps))
+            with peer.lock:
+                return self._spool_batch(peer, body, dps)
+        doc = self._put_summary_doc(data)
+        if doc is None and not 200 <= status < 300:
+            # a 4xx with no put summary did NOT come from a TSD put
+            # handler (reverse proxy, auth wall, wrong address):
+            # nothing was stored, so acking here would lose the batch
+            peer.breaker.record_failure()
+            LOG.warning("shard %s answered %d without a put summary; "
+                        "spooling %d point(s)", peer.name, status,
+                        len(dps))
+            with peer.lock:
+                return self._spool_batch(peer, body, dps)
+        peer.breaker.record_success()
+        peer.forwarded_batches += 1
+        if doc is None:  # 2xx with an odd body: stored per the status
+            ok, bad, errs = len(dps), 0, []
+        else:
+            ok = int(doc.get("success", 0))
+            bad = int(doc.get("failed", 0))
+            errs = list(doc.get("errors") or ())
+        peer.forwarded_points += ok
+        return ok, bad, errs
+
+    @staticmethod
+    def _put_summary_doc(data: bytes) -> dict | None:
+        """The peer's ``/api/put?summary`` body, or None when the
+        response is not a put summary at all."""
+        try:
+            doc = json.loads(data)
+        except Exception:  # noqa: BLE001 - defensive: odd peer body
+            return None
+        if isinstance(doc, dict) and ("success" in doc
+                                      or "failed" in doc):
+            return doc
+        return None
+
+    def _spool_batch(self, peer: Peer, body: bytes, dps: list[dict]
+                     ) -> tuple[int, int, list[dict]]:
+        """Durable handoff (caller holds ``peer.lock``): the ack
+        rides on the spool fsync. A FULL spool refuses the points
+        loudly (per-point errors) — dropping the oldest record would
+        break the no-loss guarantee."""
+        try:
+            peer.spool.append(body)
+        except SpoolFull as exc:
+            return 0, len(dps), [
+                {"datapoint": dp,
+                 "error": f"shard {peer.name} unreachable and its "
+                          f"spool is full: {exc}"} for dp in dps]
+        peer.spooled_batches += 1
+        peer.spooled_points += len(dps)
+        return len(dps), 0, []
+
+    # ------------------------------------------------------------------
+    # spool replay
+    # ------------------------------------------------------------------
+
+    def _replay_loop(self) -> None:
+        while not self._stop.wait(self.replay_interval_s):
+            for peer in list(self.peers.values()):
+                try:
+                    self.drain_spool(peer)
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    LOG.exception("spool replay for %s failed",
+                                  peer.name)
+
+    def drain_spool(self, peer: Peer) -> int:
+        """Catch-up drain: keep replaying batches while progress is
+        made. One fixed-size batch per wake would cap the drain at
+        replay_batch/interval records per second — sustained ingest
+        above that rate (new writes enqueue FIFO behind a non-empty
+        spool) would grow a recovering peer's backlog to SpoolFull
+        even though the peer is healthy. Stops on the first
+        zero-progress pass (drained, breaker refused, or a failure
+        re-opened the breaker)."""
+        total = 0
+        while not self._stop.is_set():
+            n = self.try_replay(peer)
+            total += n
+            if n == 0:
+                break
+        return total
+
+    def try_replay(self, peer: Peer, max_records: int = 0) -> int:
+        """Drain up to ``max_records`` (0 = one configured batch) of
+        the peer's spool if its breaker admits a dispatch. The replay
+        IS the half-open probe: first success closes the breaker,
+        failure re-opens it and keeps the spool position."""
+        if peer.spool.pending_records == 0:
+            return 0
+        if not peer.breaker.allow():
+            return 0
+        limit = max_records or self.replay_batch
+        before = peer.spool.replayed_records
+        try:
+            n = peer.spool.replay(
+                lambda body: self._replay_one(peer, body), limit)
+        except OSError as exc:
+            if peer.spool.replayed_records > before:
+                # the records applied BEFORE the failure are readable
+                # on the shard now: cached entries must go stale even
+                # though this pass did not finish
+                self._bump_global_version()
+            peer.breaker.record_failure()
+            LOG.info("spool replay to %s stopped (%s); %d record(s) "
+                     "still pending", peer.name, exc,
+                     peer.spool.pending_records)
+            return 0
+        if n:
+            peer.breaker.record_success()
+            # replayed history just LANDED on the shard, long after
+            # its ack: a complete answer cached while the backlog was
+            # pending is stale NOW (the write-time bump happened at
+            # spool time, before this data was readable)
+            self._bump_global_version()
+            LOG.info("replayed %d spooled batch(es) to %s (%d "
+                     "pending)", n, peer.name,
+                     peer.spool.pending_records)
+        elif peer.breaker.state != CircuitBreaker.CLOSED:
+            # zero records applied WITHOUT touching the peer (the
+            # spool head was unreadable and got dropped): no evidence
+            # of peer health, so the half-open probe this call
+            # consumed must not close the breaker — release it as a
+            # failure and let the next reset window retry
+            peer.breaker.record_failure()
+        return n
+
+    def _replay_one(self, peer: Peer, body: bytes) -> None:
+        self._check_faults(peer)
+        status, data = self._fetch(
+            peer, "POST", "/api/put?summary=true&details=true", body)
+        doc = self._put_summary_doc(data)
+        if doc is None and not 200 <= status < 300:
+            # not a TSD put answer: the record was NOT applied — keep
+            # it spooled (raising stops the replay pass and records a
+            # breaker failure in try_replay)
+            raise PeerUnavailable(
+                f"peer {peer.name} answered {status} without a put "
+                f"summary during replay")
+        peer.replayed_batches += 1
+        bad = int(doc.get("failed", 0)) if doc else 0
+        if bad:
+            # per-point rejections (bad data) are terminal: the peer
+            # is healthy and will reject them identically forever —
+            # count them loudly instead of wedging the spool
+            peer.replay_point_errors += bad
+            LOG.warning("spool replay to %s: peer rejected %d "
+                        "point(s): %s", peer.name, bad, data[:200])
+
+    # ------------------------------------------------------------------
+    # read path
+    # ------------------------------------------------------------------
+
+    def execute_query(self, tsq) -> tuple[list, list[str]]:
+        """Scatter one validated TSQuery, merge partials. Returns
+        (results, degraded shard names). Raises ``BadRequestError``
+        for non-decomposable aggregators; peer failures NEVER raise —
+        they degrade."""
+        self.queries += 1
+        for sub in tsq.queries:
+            if sub.tsuids:
+                # UIDs are assigned independently per shard: the same
+                # TSUID bytes name a DIFFERENT series on each shard,
+                # so a scattered tsuid sub would merge unrelated
+                # series into one plausible-looking answer
+                raise BadRequestError(
+                    "tsuid sub-queries are not supported in router "
+                    "mode: UIDs are assigned per shard — query by "
+                    "metric and tags instead")
+        plans = [merge_mod.decompose_plan(sub) for sub in tsq.queries]
+        # expanded peer-side sub list: avg fans out as sum+count twins
+        peer_subs: list[dict] = []
+        slots: list[tuple[int, int | None]] = []  # (primary, secondary)
+        for sub, plan in zip(tsq.queries, plans):
+            sj = sub.to_json()
+            sj.pop("pixels", None)  # reduce AFTER the merge
+            sj.pop("pixelFn", None)
+            sj.pop("index", None)
+            if plan == "avg":
+                s1 = dict(sj, aggregator="sum")
+                s2 = dict(sj, aggregator="count")
+                slots.append((len(peer_subs), len(peer_subs) + 1))
+                peer_subs.extend([s1, s2])
+            else:
+                slots.append((len(peer_subs), None))
+                peer_subs.append(sj)
+        peer_obj = {
+            # absolute window: every shard must grid the SAME range,
+            # or downsample buckets stop aligning across partials
+            "start": str(tsq.start_ms), "end": str(tsq.end_ms),
+            "msResolution": True, "showQuery": True,
+            "queries": peer_subs,
+            "showTSUIDs": tsq.show_tsuids,
+            "noAnnotations": tsq.no_annotations,
+            "globalAnnotations": tsq.global_annotations,
+            "timezone": tsq.timezone,
+            "useCalendar": tsq.use_calendar,
+            "delete": tsq.delete,
+        }
+        body = json.dumps(peer_obj).encode()
+        futures = {
+            name: self.pool.submit(self._query_peer, peer, body)
+            for name, peer in self.peers.items()}
+        per_peer: dict[str, list[dict]] = {}
+        degraded: list[str] = []
+        # expanded-sub index -> 4xx bodies, one per rejecting peer
+        sub_400: dict[int, list[bytes]] = {}
+        for name, fut in futures.items():
+            peer = self.peers[name]
+            try:
+                status, data = fut.result(
+                    timeout=self.timeout_s * 2 + 5)
+            except (OSError, concurrent.futures.TimeoutError) as exc:
+                peer.query_failures += 1
+                degraded.append(name)
+                LOG.warning("shard %s degraded for this query (%s: "
+                            "%s)", name, type(exc).__name__, exc)
+                continue
+            if status == 200:
+                try:
+                    per_peer[name] = json.loads(data)
+                except ValueError:
+                    peer.query_failures += 1
+                    degraded.append(name)
+                continue
+            if status != 400:
+                # 413 (scan budget), 404/405 (not a TSD query
+                # endpoint — proxy / auth wall / misroute), 5xx
+                # passed through: NOT the no-such-name empty
+                # partial. Treating it as one would silently blank
+                # this shard's series in a cacheable "complete"
+                # answer; degrade loudly instead (marker, never
+                # cached).
+                peer.query_failures += 1
+                degraded.append(name)
+                LOG.warning("shard %s answered %d to the scatter; "
+                            "degrading it for this query", name,
+                            status)
+                continue
+            # 400 from a HEALTHY peer: a shard that owns no series of
+            # the metric 400s with "no such name" — an empty partial,
+            # not peer damage and not a client error (other shards
+            # may own it). Kept for the all-shards-agree check below.
+            if len(peer_subs) == 1:
+                sub_400.setdefault(0, []).append(data)
+                per_peer[name] = []
+                continue
+            # multi-sub scatter: the request-level 400 hides WHICH
+            # sub the peer rejected — and blanks subs it DOES own
+            # series for. Re-issue each expanded sub alone and keep
+            # the ones that answer.
+            rows, died = self._per_sub_retry(peer, peer_obj,
+                                             peer_subs, sub_400)
+            per_peer[name] = rows
+            if died:
+                peer.query_failures += 1
+                degraded.append(name)
+        if tsq.delete:
+            # the shards already purged whatever rows they own during
+            # the scatter (and per-sub retries): any cached entry
+            # over these metrics is stale NOW, on EVERY exit path
+            # below — including the all-shards-agree 400 (a multi-sub
+            # delete can purge one sub's metric everywhere and still
+            # 400 on a nowhere-known sibling sub)
+            metrics = [s.metric for s in tsq.queries if s.metric]
+            if len(metrics) < len(tsq.queries):
+                self._bump_global_version()
+            self._bump_versions(metrics)
+        for idx, errs in sorted(sub_400.items()):
+            if len(errs) == len(self.peers):
+                # every shard rejected this sub: surface the real
+                # client error (single-node parity: an unknown metric
+                # in ANY sub fails the whole query)
+                try:
+                    msg = json.loads(errs[0])["error"]["message"]
+                except Exception:  # noqa: BLE001
+                    msg = errs[0].decode("utf-8", "replace")[:200]
+                raise BadRequestError(msg)
+        if degraded:
+            self.degraded_queries += 1
+        if tsq.delete and degraded:
+            # unlike writes, deletes have no spool/replay story (only
+            # put bodies replay): a 200 here would ack a purge the
+            # degraded shard never saw, and its rows would survive
+            # FOREVER. Loud structured 503 instead — delete is
+            # idempotent, so retrying once the shard returns
+            # completes the purge.
+            raise DegradedError(
+                "delete partially applied: shard(s) "
+                f"{', '.join(sorted(degraded))} unreachable — "
+                "retry to complete the purge")
+        ordered = [per_peer[n] for n in sorted(per_peer)]
+        results: list = []
+        for sub, plan, (p_idx, s_idx) in zip(tsq.queries, plans,
+                                             slots):
+            primary = [self._sub_results(r, p_idx) for r in ordered]
+            secondary = ([self._sub_results(r, s_idx)
+                          for r in ordered]
+                         if s_idx is not None else None)
+            gb_keys = merge_mod.gb_tag_keys(sub)
+            results.extend(merge_mod.merge_sub(
+                sub, gb_keys, plan, primary, secondary))
+        return self._apply_pixels(tsq, results), sorted(degraded)
+
+    def _per_sub_retry(self, peer: Peer, peer_obj: dict,
+                       peer_subs: list[dict],
+                       sub_400: dict[int, list[bytes]]
+                       ) -> tuple[list[dict], bool]:
+        """Re-scatter each expanded sub alone to a peer that 400'd
+        the combined request. Returns (result rows with their sub
+        index restored, peer-died flag). Per-sub 4xx bodies land in
+        ``sub_400`` for the all-shards-agree check.
+
+        A peer that dies partway contributes NOTHING — not the rows
+        it already answered: an avg expands to sum+count twins, and
+        merging a shard's sum partial without its count twin would
+        make every merged value WRONG (inflated), not merely
+        incomplete. Missing beats wrong; the degraded marker tells
+        the truth either way."""
+        futs = [(k, self.pool.submit(
+                    self._query_peer, peer,
+                    json.dumps(dict(peer_obj, queries=[sj])).encode()))
+                for k, sj in enumerate(peer_subs)]
+        rows: list[dict] = []
+        died = False
+        for k, fut in futs:
+            try:
+                status, data = fut.result(
+                    timeout=self.timeout_s * 2 + 5)
+            except (OSError, concurrent.futures.TimeoutError):
+                died = True
+                continue  # keep draining the in-flight futures
+            if died:
+                continue
+            if status == 400:
+                sub_400.setdefault(k, []).append(data)
+                continue
+            if status != 200:
+                # same rule as the combined scatter: a non-400
+                # rejection is peer damage, not an empty partial
+                died = True
+                continue
+            try:
+                part = json.loads(data)
+            except ValueError:
+                died = True
+                continue
+            for r in part:
+                q = r.get("query")
+                if isinstance(q, dict):
+                    q["index"] = k  # single-sub answers say index 0
+            rows.extend(part)
+        return ([], True) if died else (rows, False)
+
+    @staticmethod
+    def _sub_results(peer_results: list[dict], sub_idx: int
+                     ) -> list[dict]:
+        """One peer's partials for one expanded sub: the scatter sets
+        ``showQuery`` so every result row names its sub index."""
+        return [r for r in peer_results
+                if (r.get("query") or {}).get("index") == sub_idx]
+
+    def _query_peer(self, peer: Peer, body: bytes
+                    ) -> tuple[int, bytes]:
+        if not peer.breaker.allow():
+            raise PeerUnavailable(
+                f"breaker for {peer.name} is "
+                f"{peer.breaker.state}")
+        try:
+            # fault site inside the recorded section: an injected
+            # cluster.peer fault must trip the breaker exactly like a
+            # real peer failure, or the chaos battery could not drive
+            # the breaker deterministically
+            self._check_faults(peer)
+            status, data = self._fetch(peer, "POST",
+                                       "/api/query?arrays=true", body)
+        except OSError:
+            peer.breaker.record_failure()
+            raise
+        peer.breaker.record_success()
+        return status, data
+
+    def _apply_pixels(self, tsq, results: list) -> list:
+        """Pixel budgets apply AFTER the merge (a per-shard reduction
+        would select subset points before partials combine — wrong
+        values, wrong extremes). Same kernels, same semantics as
+        ``QueryEngine._build_results``."""
+        import numpy as np
+
+        from opentsdb_tpu.ops import visual_downsample as vd
+        from opentsdb_tpu.query.model import effective_pixels
+        if tsq.delete:
+            return results
+        by_sub: dict[int, tuple[int, str]] = {}
+        for sub in tsq.queries:
+            by_sub[sub.index] = effective_pixels(tsq, sub)
+        out = []
+        for r in results:
+            px, fn = by_sub.get(r.sub_query_index, (0, ""))
+            arrays = getattr(r, "dps_arrays", None)
+            if not px or arrays is None or not len(arrays[0]):
+                out.append(r)
+                continue
+            ts_arr, vals = arrays
+            # merged rows carry only EMITTED points (NaN = an emitted
+            # fill gap), so the emit mask is all-True — matching the
+            # engine, where NaN fill points are emitted too
+            emit = np.ones((1, len(ts_arr)), dtype=bool)
+            keep = vd.keep_mask(vals[None, :], emit, ts_arr,
+                                tsq.start_ms, tsq.end_ms, px, fn)
+            if keep is not None:
+                sel = keep[0]
+                r.dps_arrays = (ts_arr[sel], vals[sel])
+                r.dps = None
+            out.append(r)
+        return out
+
+    # ------------------------------------------------------------------
+    # result cache integration
+    # ------------------------------------------------------------------
+
+    def _bump_versions(self, metrics) -> None:
+        with self._version_lock:
+            for m in set(metrics):
+                self._metric_versions[m] = \
+                    self._metric_versions.get(m, 0) + 1
+
+    def _bump_global_version(self) -> None:
+        with self._version_lock:
+            self._global_version += 1
+
+    def write_version(self, tsq=None) -> tuple:
+        """Invalidation version of the router's view of the cluster
+        as ``tsq`` reads it: per-METRIC write/delete counters (so
+        steady ingest of unrelated metrics leaves dashboard entries
+        hitting — the cluster twin of the engine's per-sub store
+        versions) plus a global component bumped by spool replays
+        (replayed history lands on shards long after its ack; any
+        entry could be affected). Without ``tsq`` (or for tsuid subs
+        that name no metric) the conservative whole-cluster version.
+        Writes landing on shards directly (bypassing the router) are
+        invisible — relative-window entries stay bounded by the same
+        TTL rule as single-node serving; absolute-window dashboards
+        behind a multi-router deployment should disable the router
+        cache (``tsd.query.cache.enable=false``)."""
+        with self._version_lock:
+            whole = (self._global_version,
+                     sum(self._metric_versions.values()))
+            if tsq is None:
+                return whole
+            metrics = set()
+            for sub in tsq.queries:
+                if not sub.metric:
+                    return whole
+                metrics.add(sub.metric)
+            return (self._global_version,) + tuple(
+                self._metric_versions.get(m, 0)
+                for m in sorted(metrics))
+
+    def cache_plan(self, tsq) -> tuple[tuple, float] | None:
+        from opentsdb_tpu.query import result_cache as rc_mod
+        if tsq.delete:
+            return None
+        keys = []
+        ttl_ms = 0.0
+        for sub in tsq.queries:
+            plan = rc_mod.cache_plan(tsq, sub, self.config)
+            if plan is None:
+                return None
+            key, ttl = plan
+            keys.append(key)
+            if ttl:
+                ttl_ms = ttl if ttl_ms == 0 else min(ttl_ms, ttl)
+        return ("cluster", tuple(keys)), ttl_ms
+
+    def run_cached(self, tsq) -> tuple[list, list[str]]:
+        """Execute through the serve-path result cache. A degraded
+        partial is NEVER retained (the marker must never outlive the
+        outage it reports); a later complete answer repopulates."""
+        cache = self.tsdb.result_cache
+        plan = self.cache_plan(tsq) if cache is not None else None
+        if plan is None:
+            if cache is not None:
+                cache.count_bypass()
+            return self.execute_query(tsq)
+        key, ttl_ms = plan
+        version = self.write_version(tsq)
+        hit = cache.lookup(key, version, ttl_ms)
+        if hit is not None:
+            self.cache_hits += 1
+            return hit, []
+        results, degraded = self.execute_query(tsq)
+        if degraded:
+            self.cache_degraded_skips += 1
+        else:
+            cache.store(key, version, results)
+            self.cache_stores += 1
+        return results, degraded
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def health_info(self) -> dict[str, Any]:
+        return {
+            "role": "router",
+            "shards": len(self.peers),
+            "vnodes": self.ring.vnodes,
+            "queries": self.queries,
+            "degraded_queries": self.degraded_queries,
+            "cache_hits": self.cache_hits,
+            "cache_stores": self.cache_stores,
+            "cache_degraded_skips": self.cache_degraded_skips,
+            "spool_backlog_records": sum(
+                p.spool.pending_records for p in self.peers.values()),
+            "peers": {name: peer.health_info()
+                      for name, peer in sorted(self.peers.items())},
+        }
+
+    def collect_stats(self, collector) -> None:
+        collector.record("cluster.queries", self.queries)
+        collector.record("cluster.queries_degraded",
+                         self.degraded_queries)
+        collector.record("cluster.cache_degraded_skips",
+                         self.cache_degraded_skips)
+        for name, p in sorted(self.peers.items()):
+            collector.record("cluster.forwarded_points",
+                             p.forwarded_points, peer=name)
+            collector.record("cluster.spooled_points",
+                             p.spooled_points, peer=name)
+            collector.record("cluster.spool_pending",
+                             p.spool.pending_records, peer=name)
+            collector.record("cluster.replayed_batches",
+                             p.replayed_batches, peer=name)
+            collector.record("cluster.query_failures",
+                             p.query_failures, peer=name)
+            collector.record("cluster.hedges", p.hedges, peer=name)
+            p.breaker.collect_stats(collector)

@@ -485,24 +485,27 @@ class ColdStore:
         return deleted
 
     def _rewrite_segment(self, seg: fmt.Segment, entry: dict,
-                         identities: set, start_ms: int,
+                         identities: set | None, start_ms: int,
                          end_ms: int) -> tuple[int, dict | None]:
         """(rows removed, replacement manifest entry or None when the
-        whole segment emptied). Writes the replacement file but does
+        whole segment emptied). ``identities`` of None means EVERY
+        series (retention trim); a set restricts to those tag-id
+        identities (delete=true). Writes the replacement file but does
         NOT touch the old one — the caller unlinks it after the
         manifest commit. Caller holds the lock."""
         uids = self.uids
         n = int(entry["rows"])
         keep = np.ones(n, dtype=bool)
         for tags, off, cnt in seg.series:
-            try:
-                key = tuple(sorted((uids.tag_names.get_id(k),
-                                    uids.tag_values.get_id(v))
-                                   for k, v in tags))
-            except LookupError:
-                continue
-            if key not in identities:
-                continue
+            if identities is not None:
+                try:
+                    key = tuple(sorted((uids.tag_names.get_id(k),
+                                        uids.tag_values.get_id(v))
+                                       for k, v in tags))
+                except LookupError:
+                    continue
+                if key not in identities:
+                    continue
             lo, hi = seg.row_bounds(off, cnt, start_ms, end_ms)
             keep[lo:hi] = False
         removed = int(n - keep.sum())
@@ -543,9 +546,26 @@ class ColdStore:
                                       ts_col, cols)
         return removed, new_entry
 
-    def drop_segments_before(self, metric: str, cutoff_ms: int) -> int:
+    @staticmethod
+    def _entry_interval_ms(entry: dict, interval_ms_of) -> int:
+        """One segment's cell-window span in ms: the shared expiry
+        rule for the drop and trim paths (unknown/absent tier maps
+        conservatively to 0)."""
+        if interval_ms_of is None:
+            return 0
+        try:
+            return max(int(interval_ms_of(entry["interval"])), 0)
+        except Exception:  # noqa: BLE001 - unknown tier
+            return 0
+
+    def drop_segments_before(self, metric: str, cutoff_ms: int,
+                             interval_ms_of=None) -> int:
         """Retention for the cold tier, segment-granular: drop every
-        segment whose WHOLE range expired. Returns rows dropped."""
+        segment whose WHOLE range expired — including the last cell's
+        aggregation window ``[end_ms, end_ms + interval)``, the same
+        cell rule the partial trim and the RAM-tier purge use (a cell
+        stamped just before the cutoff still aggregates unexpired
+        history). Returns rows dropped."""
         dropped = 0
         with self._lock:
             rec = self._metrics.get(metric)
@@ -553,7 +573,8 @@ class ColdStore:
                 return 0
             keep = []
             for entry in rec["segments"]:
-                if entry["end_ms"] < cutoff_ms:
+                iv_ms = self._entry_interval_ms(entry, interval_ms_of)
+                if entry["end_ms"] + iv_ms < cutoff_ms:
                     dropped += int(entry["rows"])
                     self.segments_dropped += 1
                     path = os.path.join(self.directory, entry["file"])
@@ -569,6 +590,87 @@ class ColdStore:
                 self.mutation_epoch += 1
                 self._save_manifest_locked()
         return dropped
+
+    # a straddling segment is only rewritten once its expired prefix
+    # is worth the copy: the rewrite is O(segment) regardless of how
+    # little expired, so trimming every sweep would re-copy a huge
+    # long-lived segment per cycle for a sliver. 25% bounds the
+    # amortized write amplification at ~4x while whole-expired
+    # segments keep dropping for free via drop_segments_before.
+    TRIM_MIN_EXPIRED_FRACTION = 0.25
+
+    def trim_segments_before(self, metric: str, cutoff_ms: int,
+                             interval_ms_of=None) -> int:
+        """Partial-segment retention trim: rewrite still-live segments
+        whose RANGE straddles the cutoff, dropping the expired prefix
+        through the delete-rewrite path (same crash ordering:
+        replacement written + manifest committed BEFORE the old file
+        unlinks). :meth:`drop_segments_before` handles whole-expired
+        segments cheaply (unlink, no rewrite) — this covers the long
+        tail a single huge segment would otherwise pin on disk until
+        its newest cell expired.
+
+        A cold cell stamped T aggregates ``[T, T+interval)``: like the
+        RAM-tier purge rule, only cells whose WHOLE window expired are
+        trimmed (``T + interval <= cutoff``), so unexpired aggregated
+        history is never lost with its cell. ``interval_ms_of``
+        maps a tier interval string ("1m") to its ms span; absent
+        (or unknown interval), the trim conservatively assumes 0.
+        Segments whose expired prefix is under
+        :data:`TRIM_MIN_EXPIRED_FRACTION` of their range are left for
+        a later sweep (write-amplification gate). Returns rows
+        removed."""
+        trimmed = 0
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return 0
+            keep_entries: list[dict] = []
+            obsolete: list[str] = []
+            changed = False
+            for entry in rec["segments"]:
+                iv_ms = self._entry_interval_ms(entry, interval_ms_of)
+                # inclusive delete end: mirrors the RAM tier's
+                # ``cutoff - 1 - iv`` purge bound
+                cut_end = cutoff_ms - 1 - iv_ms
+                if cut_end < 1 or entry["start_ms"] > cut_end:
+                    keep_entries.append(entry)
+                    continue
+                span = max(entry["end_ms"] - entry["start_ms"], 1)
+                frac = (cut_end - entry["start_ms"]) / span
+                if frac < self.TRIM_MIN_EXPIRED_FRACTION:
+                    keep_entries.append(entry)
+                    continue
+                seg = fmt.Segment(os.path.join(self.directory,
+                                               entry["file"]))
+                removed, new_entry = self._rewrite_segment(
+                    seg, entry, None, 1, cut_end)
+                trimmed += removed
+                if removed == 0:
+                    keep_entries.append(entry)
+                    continue
+                if new_entry is not None:
+                    keep_entries.append(new_entry)
+                else:
+                    self.segments_dropped += 1
+                obsolete.append(entry["file"])
+                changed = True
+            if changed:
+                rec["segments"] = keep_entries
+                self._handle_cache.clear()
+                self.points_deleted += trimmed
+                self.mutation_epoch += 1
+                self._save_manifest_locked()
+                # unlink replaced files only AFTER the manifest commit
+                # (delete_rows crash ordering: an orphan is
+                # fsck-visible, a referenced-but-missing segment is
+                # data loss)
+                for name in obsolete:
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:  # pragma: no cover
+                        pass
+        return trimmed
 
     def quarantine(self, metric: str, file: str) -> bool:
         """fsck --fix: move a corrupt segment out of the manifest (and

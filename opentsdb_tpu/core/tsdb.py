@@ -176,6 +176,10 @@ class TSDB:
         # the serve path reads the raw attribute, the `lifecycle`
         # property instantiates only when tsd.lifecycle.enable is set
         self._lifecycle = None
+        # sharded cluster tier (opentsdb_tpu/cluster/): lazy — the
+        # HTTP layer reads the `cluster` property per request; only a
+        # tsd.cluster.role=router TSD instantiates the router
+        self._cluster = None
         # per-hook swallowed-error counters: post-write hooks (meta,
         # realtime publisher, external meta cache, stream tap) can
         # never fail an ACKNOWLEDGED write — see _run_hook
@@ -241,8 +245,7 @@ class TSDB:
                         self.config, "tsd.storage.wal.retry"),
                     resync_ms=self.config.get_int(
                         "tsd.storage.wal.resync_interval_ms"),
-                    group_window_ms=self.config.get_int(
-                        "tsd.storage.wal.group_window_ms", 0),
+                    group_window_ms=self._wal_group_window_ms(),
                     group_max_records=self.config.get_int(
                         "tsd.storage.wal.group_max_records", 4096),
                     group_max_bytes=self.config.get_int(
@@ -265,6 +268,22 @@ class TSDB:
                         "WAL replay recovered %d points", recovered)
                 self.wal = wal
                 self.annotations.wal = wal
+
+    def _wal_group_window_ms(self) -> int:
+        """``tsd.storage.wal.group_window_ms`` with the role-aware
+        auto default: "" (unset) means 0 standalone but 2 ms when
+        running as a cluster SHARD — behind a router every shard sees
+        genuinely concurrent writers (one connection per client), so
+        an opportunistic commit window amortizes fsyncs, while the
+        window's quiet-log early exit (``idle_breaks``) keeps a lone
+        writer's added latency at ~one poll slice. An explicit value
+        (including 0) always wins."""
+        raw = self.config.get_string("tsd.storage.wal.group_window_ms",
+                                     "").strip()
+        if raw:
+            return int(raw)
+        role = self.config.get_string("tsd.cluster.role", "").strip()
+        return 2 if role == "shard" else 0
 
     # ------------------------------------------------------------------
     # plugins (ref: TSDB.java initializePlugins :390)
@@ -1003,6 +1022,24 @@ class TSDB:
         return self._lifecycle
 
     @property
+    def cluster(self):
+        """Cluster router (:mod:`opentsdb_tpu.cluster.router`), or
+        None unless this TSD runs as ``tsd.cluster.role = router``.
+        The HTTP layer branches ``/api/put`` and ``/api/query``
+        through it; shards and standalone TSDs serve locally."""
+        if self.config.get_string("tsd.cluster.role", "") != "router":
+            return None
+        if self._cluster is None:
+            with self._device_cache_lock:
+                if self._cluster is None:
+                    from opentsdb_tpu.cluster.router import \
+                        ClusterRouter
+                    router = ClusterRouter(self)
+                    self.stats.register(router)
+                    self._cluster = router
+        return self._cluster
+
+    @property
     def query_fanout_pool(self):
         """Executor independent sub-queries of one TSQuery fan out
         onto (None = serial; ``tsd.query.fanout.workers``). See the
@@ -1130,6 +1167,8 @@ class TSDB:
                 self.wal.truncate(wal_seq)
 
     def shutdown(self) -> None:
+        if self._cluster is not None:
+            self._cluster.stop()
         if self._lifecycle is not None:
             self._lifecycle.stop()
         self.flush()

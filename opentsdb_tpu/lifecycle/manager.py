@@ -399,6 +399,16 @@ class LifecycleManager:
                 # resurrect expired points
                 t.flush()
 
+    def _tier_interval_ms(self, interval: str) -> int:
+        """Tier interval string -> ms span (0 when unknown): the cold
+        trim keeps cells whose aggregation window spans the cutoff,
+        same rule as the RAM tier purge below."""
+        try:
+            return self.tsdb.rollup_config.get_interval(
+                interval).interval_ms
+        except ValueError:
+            return 0
+
     def _retention(self, mid: int, metric: str, sids: np.ndarray,
                    pol: LifecyclePolicy, now_ms: int,
                    report: dict) -> bool:
@@ -418,13 +428,18 @@ class LifecycleManager:
         if hist_purged:
             self.histogram_points_purged += hist_purged
             report["histogramPurged"] += hist_purged
-        # cold segments are retention-managed too, whole-segment
-        # granular: drop only segments whose entire range expired
-        # (end_ms < cutoff matches the inclusive raw purge of
-        # [1, cutoff-1])
+        # cold segments are retention-managed too: whole-expired
+        # segments drop cheaply (end_ms < cutoff matches the inclusive
+        # raw purge of [1, cutoff-1]), then still-live segments
+        # STRADDLING the cutoff get their expired prefix trimmed off
+        # through the delete-rewrite path — without the trim a single
+        # long-lived segment pins its whole range on disk until its
+        # newest cell expires
         if self.coldstore is not None:
-            purged += self.coldstore.drop_segments_before(metric,
-                                                          cutoff)
+            purged += self.coldstore.drop_segments_before(
+                metric, cutoff, self._tier_interval_ms)
+            purged += self.coldstore.trim_segments_before(
+                metric, cutoff, self._tier_interval_ms)
         rs = self.tsdb.rollup_store
         if rs is not None:
             config = self.tsdb.rollup_config

@@ -15,6 +15,8 @@ from opentsdb_tpu.query.expression.core import (GEXP_FUNCTIONS,
                                                 SeriesFrame,
                                                 evaluate_expression)
 from opentsdb_tpu.query.model import (BadRequestError, TSQuery, TSSubQuery,
+                                      _validate_pixel_fn,
+                                      _validate_pixels,
                                       parse_uri_subquery)
 
 
@@ -92,6 +94,28 @@ def _split_args(body: str) -> list[str]:
     return args
 
 
+def _reduce_frame(frame: SeriesFrame, window_ms: tuple[int, int],
+                  px: int, fn: str) -> SeriesFrame:
+    """Pixel-budget selection over one output frame: per-series keep
+    masks from the shared kernels (``ops/visual_downsample``), then a
+    timestamp column survives when ANY series keeps it — exp emits
+    row-per-timestamp union rows, so column selection is the only
+    shape-preserving reduction. Bounded by ~4·px kept columns per
+    series for M4 (px per series for minmaxlttb)."""
+    import numpy as np
+
+    from opentsdb_tpu.ops import visual_downsample as vd
+    emit = np.ones(frame.values.shape, dtype=bool)
+    keep = vd.keep_mask(frame.values, emit, frame.ts,
+                        window_ms[0], window_ms[1], px,
+                        fn or vd.DEFAULT_PIXEL_FN)
+    if keep is None:
+        return frame
+    col = keep.any(axis=0)
+    return SeriesFrame(frame.ts[col], frame.values[:, col],
+                       frame.tags, frame.agg_tags, frame.metric)
+
+
 # ---------------------------------------------------------------------------
 # /api/query/exp  (ref: QueryExecutor.java:222 + pojo model)
 # ---------------------------------------------------------------------------
@@ -107,6 +131,15 @@ def handle_exp(router, request):
     start = str(time_spec.get("start", ""))
     end = time_spec.get("end")
     aggregator = time_spec.get("aggregator", "sum")
+    # pixel-aware output reduction (PR 7 follow-up): exp assembles its
+    # own rows, bypassing the engine's _build_results, so the budget
+    # applies HERE — after the expression DAG evaluates. Reducing the
+    # metric INPUTS instead would change the arithmetic (an expression
+    # over M4-selected subsets is not the M4 selection of the
+    # expression). Query-level ``pixels``/``pixelFn`` ride at the top
+    # of the body; a per-output override wins (the per-sub rule).
+    q_px = _validate_pixels(obj.get("pixels") or 0, "pixels")
+    q_fn = _validate_pixel_fn(obj.get("pixelFn") or "", "pixelFn")
     def _ds_string(downsampler, where: str) -> str | None:
         """pojo Downsampler object -> "interval-agg[-fill]" string
         (ref: pojo/Downsampler.java). Strings pass through for the
@@ -154,6 +187,7 @@ def handle_exp(router, request):
     # rate/rateOptions)
     variables: dict[str, SeriesFrame] = {}
     metric_meta: dict[str, dict] = {}
+    window_ms: tuple[int, int] | None = None
     for mspec in obj.get("metrics") or []:
         if not isinstance(mspec, dict):
             raise BadRequestError("each metric must be an object")
@@ -174,6 +208,7 @@ def handle_exp(router, request):
                                            []))
         tsq = TSQuery(start=start, end=end, queries=[sub])
         tsq.validate()
+        window_ms = (tsq.start_ms, tsq.end_ms)
         results = tsdb.new_query().run(tsq)
         variables[mid] = SeriesFrame.from_results(results)
         metric_meta[mid] = mspec
@@ -233,6 +268,13 @@ def handle_exp(router, request):
             frame = variables[oid]
         else:
             raise BadRequestError(f"unknown output id {oid!r}")
+        opx = _validate_pixels(ospec.get("pixels") or 0,
+                               f"outputs[{oid}].pixels")
+        ofn = _validate_pixel_fn(ospec.get("pixelFn") or "",
+                                 f"outputs[{oid}].pixelFn")
+        px = opx or q_px
+        if px and window_ms is not None and len(frame.ts):
+            frame = _reduce_frame(frame, window_ms, px, ofn or q_fn)
         dps_rows = []
         for t_idx, ts in enumerate(frame.ts):
             row = [int(ts)]

@@ -185,6 +185,8 @@ class TSSubQuery:
             "filters": [f.to_json() for f in self.filters],
             "explicitTags": self.explicit_tags,
             "index": self.index,
+            **({"rollupUsage": self.rollup_usage}
+               if self.rollup_usage != "ROLLUP_NOFALLBACK" else {}),
             **({"pixels": self.pixels} if self.pixels else {}),
             **({"pixelFn": self.pixel_fn} if self.pixel_fn else {}),
         }

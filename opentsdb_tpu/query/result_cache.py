@@ -336,6 +336,35 @@ class QueryResultCache:
             flight.event.set()
 
     # ------------------------------------------------------------------
+    # explicit probe/populate pair — the cluster router's seam
+    # ------------------------------------------------------------------
+
+    def lookup(self, key, version, ttl_ms: float = 0.0):
+        """Plain probe without single-flight: a detached copy of the
+        entry, or ``None``. The cluster router gathers results from
+        the NETWORK, where an answer can come back *degraded* (a shard
+        was dead/hung/tripped) — :meth:`get_or_compute` caches every
+        successful compute unconditionally, which cannot express "this
+        succeeded but must not be retained". The router probes here
+        and populates via :meth:`store` only for complete answers, so
+        a ``shardsDegraded`` partial never outlives the outage it
+        reports and the next complete answer repopulates the entry."""
+        value = self._get(key, version, ttl_ms)
+        if value is _MISSING:
+            self._count("misses")
+            return None
+        return detach(value)
+
+    def store(self, key, version, value) -> None:
+        """Populate for :meth:`lookup` users (detached exactly like
+        the :meth:`get_or_compute` put; best-effort — bookkeeping
+        trouble must never fail the query that computed ``value``)."""
+        try:
+            self._put(key, version, detach(value))
+        except Exception:  # noqa: BLE001 - put is best-effort
+            pass
+
+    # ------------------------------------------------------------------
 
     def clear(self) -> None:
         for shard in self._shards:

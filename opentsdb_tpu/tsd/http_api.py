@@ -349,6 +349,21 @@ class HttpRpcRouter:
                             "The requested endpoint was not found")
         if endpoint in self.plugin_routes:
             return self.plugin_routes[endpoint](request, rest)
+        if self.tsdb.cluster is not None and endpoint in (
+                "suggest", "search", "uid", "annotation",
+                "annotations", "tree", "rollup", "histogram"):
+            # the router owns no data: these endpoints would silently
+            # serve from (or write into) its EMPTY local store —
+            # suggest would answer [] for metrics the shards hold,
+            # an annotation put would be acked somewhere no scattered
+            # read ever merges. Refuse loudly until they learn to
+            # scatter (ROADMAP follow-up); /api/put forwards and
+            # /api/query merges shards.
+            raise HttpError(
+                400,
+                f"/api/{endpoint} is not supported in router mode",
+                "point this request at a shard TSD, or use "
+                "/api/put and /api/query")
         handler = self._routes.get(endpoint)
         if handler is None:
             raise HttpError(404, f"Endpoint not found: /api/{endpoint}",
@@ -374,6 +389,24 @@ class HttpRpcRouter:
         points = request.serializer.parse_put(request.body)
         details = request.flag("details")
         summary = request.flag("summary")
+        cluster = self.tsdb.cluster
+        if cluster is not None:
+            # router mode: partition by the consistent-hash series key
+            # and forward one series-grouped body per shard (each
+            # lands as ONE WAL write + fsync via add_point_groups on
+            # the peer); an unreachable shard's batch is durably
+            # spooled and still acknowledged — never lost, never a 5xx
+            success, failed, errors = cluster.forward_writes(points)
+            if not details and not summary:
+                if failed:
+                    raise HttpError(
+                        400, "One or more data points had errors",
+                        f"{failed} error(s) storing datapoints")
+                return HttpResponse(204)
+            return HttpResponse(
+                400 if failed else 200,
+                request.serializer.format_put(success, failed, errors,
+                                              details))
         errors: list[dict] = []
 
         def spool(dp: dict, e: Exception) -> None:
@@ -525,6 +558,17 @@ class HttpRpcRouter:
         from opentsdb_tpu.auth.simple import Permissions
         self._check_permission(request, Permissions.HTTP_QUERY)
         sub = rest[0] if rest else ""
+        if sub in ("last", "continuous", "exp", "gexp") \
+                and self.tsdb.cluster is not None:
+            # the router owns no data: these endpoints would silently
+            # run against its EMPTY local store and answer "no such
+            # name" / empty streams for series that exist in the
+            # cluster. Refuse loudly until they learn to scatter
+            # (ROADMAP follow-up); plain /api/query merges shards.
+            raise HttpError(
+                400,
+                f"/api/query/{sub} is not supported in router mode",
+                "point this request at a shard TSD, or use /api/query")
         if sub == "last":
             return self._handle_query_last(request)
         if sub == "continuous":
@@ -559,8 +603,19 @@ class HttpRpcRouter:
         px = max((effective_pixels(tsq, s)[0] for s in tsq.queries),
                  default=0)
         streamed = False
+        cluster = self.tsdb.cluster
+        degraded_shards: list[str] = []
         try:
-            results = self.tsdb.new_query().run(tsq, stats)
+            if cluster is not None:
+                # router mode: scatter to every shard, merge group
+                # partials. A dead/hung/tripped peer yields a 200
+                # PARTIAL carrying the shardsDegraded marker (appended
+                # by the serializer below) — never a 5xx — and a
+                # degraded answer is never retained by the result
+                # cache (ClusterRouter.run_cached).
+                results, degraded_shards = cluster.run_cached(tsq)
+            else:
+                results = self.tsdb.new_query().run(tsq, stats)
             from opentsdb_tpu.stats.stats import QueryStat
             if px:
                 stats.add_stat(QueryStat.DOWNSAMPLE_PIXELS, px)
@@ -587,6 +642,7 @@ class HttpRpcRouter:
             stream_after = self.tsdb.config.get_int(
                 "tsd.http.query.stream_threshold_dps", 1_000_000)
             if stream_after and total_dps > stream_after \
+                    and cluster is None \
                     and not (tsq.show_summary or tsq.show_stats
                              or request.flag("show_summary")
                              or request.flag("show_stats")) \
@@ -626,7 +682,8 @@ class HttpRpcRouter:
                 show_summary=tsq.show_summary
                 or request.flag("show_summary"),
                 show_stats=tsq.show_stats or request.flag("show_stats"),
-                summary_extra=stats.stats)
+                summary_extra=stats.stats,
+                degraded_shards=degraded_shards)
             ser_ms = (time.monotonic() - t_ser) * 1e3
             stats.add_stat(QueryStat.SERIALIZATION_TIME, ser_ms)
             stats.add_stat(QueryStat.PAYLOAD_BYTES, len(body))
@@ -639,7 +696,13 @@ class HttpRpcRouter:
             # streaming path completes inside its body iterator instead
             if not streamed:
                 stats.mark_complete()
-        return HttpResponse(200, body)
+        resp = HttpResponse(200, body)
+        if degraded_shards:
+            # header twin of the body marker so load balancers and
+            # probes can spot partials without parsing the body
+            resp.headers["X-OpenTSDB-Shards-Degraded"] = \
+                ",".join(degraded_shards)
+        return resp
 
     def _handle_query_continuous(self, request: HttpRequest,
                                  rest) -> HttpResponse:
@@ -1283,6 +1346,23 @@ class HttpRpcRouter:
         else:
             lifecycle_info = {"enabled": t.config.get_bool(
                 "tsd.lifecycle.enable", False)}
+        # the raw attribute: health must not instantiate the cluster
+        # router just to report it absent
+        clus = getattr(t, "_cluster", None)
+        if clus is not None:
+            cluster_info = clus.health_info()
+            for _pname, peer in sorted(clus.peers.items()):
+                pb = peer.breaker
+                breakers[pb.name] = pb.health_info()
+                if pb.state != pb.CLOSED:
+                    # the shard is being served around (degraded
+                    # partials + spooled writes), not failed
+                    causes.append(f"breaker:{pb.name}")
+            if cluster_info.get("spool_backlog_records"):
+                causes.append("cluster_spool_backlog")
+        else:
+            cluster_info = {"role": t.config.get_string(
+                "tsd.cluster.role", "") or "standalone"}
         hook_errors = dict(getattr(t, "hook_errors", {}))
         doc: dict[str, Any] = {
             "status": "degraded" if causes else "ok",
@@ -1304,6 +1384,9 @@ class HttpRpcRouter:
             # serialization time, so the pixel-downsampling bytes win
             # is measurable in production
             "query_payload": t.payload_stats.health_info(),
+            # sharded cluster tier: per-peer breaker/spool state,
+            # degraded-query and handoff counters (router role only)
+            "cluster": cluster_info,
             "hook_errors": hook_errors,
         }
         server = self.server

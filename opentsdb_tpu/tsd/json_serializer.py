@@ -241,9 +241,16 @@ class HttpJsonSerializer(HttpSerializer):
                      as_arrays: bool = False,
                      show_summary: bool = False,
                      show_stats: bool = False,
-                     summary_extra: dict | None = None) -> bytes:
+                     summary_extra: dict | None = None,
+                     degraded_shards: list | None = None) -> bytes:
         """(ref: formatQueryAsyncV1) ``dps`` as {ts: value} maps, or
-        [[ts, value], ...] when the ``arrays`` query param is set."""
+        [[ts, value], ...] when the ``arrays`` query param is set.
+
+        ``degraded_shards`` names cluster shards that could not
+        contribute to this answer: the response is a 200 PARTIAL and a
+        trailing ``{"shardsDegraded": [...]}`` row (the statsSummary
+        idiom) marks it so clients and caches can tell a partial from
+        a complete answer (Monarch's explicit staleness markers)."""
         ms = ts_query.ms_resolution
         pieces = []
         # showStats: a per-result "stats" map (ref:
@@ -260,6 +267,9 @@ class HttpJsonSerializer(HttpSerializer):
             # formatQueryAsyncV1wStatsWoSummary has row stats, no tail)
             pieces.append(self._dump(
                 {"statsSummary": summary_extra or {}}))
+        if degraded_shards:
+            pieces.append(self._dump(
+                {"shardsDegraded": sorted(degraded_shards)}))
         return b"[" + b",".join(pieces) + b"]"
 
     # dps entries per streamed chunk: bounds the largest in-memory

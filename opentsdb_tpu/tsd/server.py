@@ -334,6 +334,15 @@ class TSDServer:
         lifecycle = self.tsdb.lifecycle
         if lifecycle is not None:
             lifecycle.start()
+        # cluster router (opentsdb_tpu/cluster/): a tsd.cluster.role =
+        # router TSD owns the shard map. Instantiating it here (the
+        # TSDB property is lazy) validates tsd.cluster.peers at
+        # startup instead of on the first request, and starts the
+        # spool replay thread so handoff drains even with no traffic.
+        # Stopped by TSDB.shutdown.
+        cluster = self.tsdb.cluster
+        if cluster is not None:
+            cluster.start()
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 

@@ -126,8 +126,13 @@ _DEFAULTS: dict[str, str] = {
     #   group commit v2: bounded commit window the fsync leader holds
     #   to absorb concurrent writers' buffered bytes (0 = commit
     #   immediately; the window never delays a lone writer — it ends
-    #   at the first quiet poll slice), cut short by the caps below
-    "tsd.storage.wal.group_window_ms": "0",
+    #   at the first quiet poll slice), cut short by the caps below.
+    #   "" = auto: 0 standalone, 2 ms when tsd.cluster.role=shard —
+    #   a routed shard sees genuinely concurrent writers (one router
+    #   connection per client), so the window amortizes fsyncs while
+    #   the quiet-log early exit keeps a lone writer at ~one poll
+    #   slice of added latency
+    "tsd.storage.wal.group_window_ms": "",
     "tsd.storage.wal.group_max_records": "4096",
     "tsd.storage.wal.group_max_bytes": "4194304",
     #   snapshot flush retry (tsd.storage.data_dir writes)
@@ -166,6 +171,40 @@ _DEFAULTS: dict[str, str] = {
     "tsd.lifecycle.breaker.reset_timeout_ms": "60000",
     # SSE resume replay depth (Last-Event-ID; 0 disables resume)
     "tsd.streaming.resume_events": "64",
+    # sharded cluster tier (opentsdb_tpu/cluster/): role "" =
+    # standalone, "router" = stateless consistent-hash scatter-gather
+    # tier over tsd.cluster.peers ("[name=]host:port,..."), "shard" =
+    # a peer TSD behind a router (flips the WAL group-commit window
+    # default; see tsd.storage.wal.group_window_ms)
+    "tsd.cluster.role": "",
+    "tsd.cluster.peers": "",
+    "tsd.cluster.vnodes": "64",
+    #   per-peer connect+read deadline; a hung shard becomes a
+    #   degraded partial after this, never a stuck request
+    "tsd.cluster.timeout_ms": "5000",
+    #   tail-latency hedging: duplicate a peer request that hasn't
+    #   answered after this many ms, first completion wins (0 = off)
+    "tsd.cluster.hedge_after_ms": "0",
+    #   write-forward retry ladder (reads never retry — they degrade)
+    "tsd.cluster.retry.attempts": "2",
+    "tsd.cluster.retry.base_ms": "25",
+    "tsd.cluster.retry.deadline_ms": "2000",
+    #   per-peer circuit breaker (utils/faults.py CircuitBreaker)
+    "tsd.cluster.breaker.failure_threshold": "3",
+    "tsd.cluster.breaker.reset_timeout_ms": "5000",
+    #   durable per-peer write spool: dir "" = <data_dir>/cluster_spool
+    #   (in-memory fallback without a data_dir); a FULL spool refuses
+    #   writes loudly instead of dropping acknowledged points
+    "tsd.cluster.spool.dir": "",
+    "tsd.cluster.spool.max_mb": "256",
+    # replayed-prefix bytes beyond which a partially drained spool
+    # file is compacted (the drained-at-zero truncate alone would let
+    # an oscillating spool grow without bound)
+    "tsd.cluster.spool.compact_mb": "4",
+    "tsd.cluster.spool.replay_interval_ms": "500",
+    "tsd.cluster.spool.replay_batch": "64",
+    #   scatter/forward worker pool (0 = 2x peer count)
+    "tsd.cluster.fanout_workers": "0",
     # auth
     "tsd.core.authentication.enable": "false",
     # stats

@@ -832,3 +832,144 @@ class TestColdObservability:
         assert doc["spillBoundaries"]["sys.cpu"] == SPILL_B
         assert doc["coldstore"]["segmentsWritten"] == 1
         assert doc["policies"][0]["spillAfter"] == "1h"
+
+
+# ---------------------------------------------------------------------------
+# partial-segment retention trim (PR 8 satellite: retention previously
+# dropped only WHOLE-expired segments; a straddling segment now gets
+# its expired prefix rewritten off through the delete-rewrite path)
+# ---------------------------------------------------------------------------
+
+class TestPartialSegmentTrim:
+    def test_trim_straddling_segment(self, tmp_path):
+        t0, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        seg0 = [dict(e) for e in
+                cold._metrics["sys.cpu"]["segments"]]
+        assert len(seg0) == 1  # one segment straddling the cutoff
+        # cutoff INSIDE the cold range: [BASE, NOW-60m) vs NOW-90m
+        cutoff = NOW_MS - 5400_000
+        assert seg0[0]["start_ms"] < cutoff <= seg0[0]["end_ms"]
+        assert cold.drop_segments_before("sys.cpu", cutoff) == 0
+        trimmed = cold.trim_segments_before(
+            "sys.cpu", cutoff, lambda iv: 60_000)
+        assert trimmed > 0
+        seg1 = cold._metrics["sys.cpu"]["segments"]
+        assert len(seg1) == 1
+        # kept cells' aggregation windows span or postdate the cutoff
+        # (the RAM tier's conservative cutoff-1-iv purge rule)
+        assert seg1[0]["start_ms"] + 60_000 >= cutoff
+        assert seg1[0]["rows"] == seg0[0]["rows"] - trimmed
+        # rewrite names keep the .cold suffix with the -rw nonce so
+        # the fsck orphan scan still matches them
+        assert "-rw" in seg1[0]["file"]
+        assert seg1[0]["file"].endswith(".cold")
+        # the unexpired remainder still answers identically to the
+        # unspilled oracle (float32 tier folding tolerance)
+        got = _dps(_query(t1, {"metric": "sys.cpu",
+                               "aggregator": "sum",
+                               "downsample": "1m-avg"},
+                          start=cutoff))
+        want = _dps(_query(t0, {"metric": "sys.cpu",
+                                "aggregator": "sum",
+                                "downsample": "1m-avg"},
+                           start=cutoff))
+        assert got.keys() == want.keys()
+        for key in want:
+            for ts_ms, v in want[key].items():
+                assert got[key][ts_ms] == pytest.approx(
+                    v, rel=1e-6), (key, ts_ms)
+        # trimmed rows are GONE: nothing before the cutoff's window
+        early = _dps(_query(t1, {"metric": "sys.cpu",
+                                 "aggregator": "none"},
+                            end=cutoff - 60_000 - 1))
+        assert not any(early.values())
+
+    def test_trim_noop_when_nothing_expired(self, tmp_path):
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        before = [dict(e) for e in
+                  cold._metrics["sys.cpu"]["segments"]]
+        assert cold.trim_segments_before(
+            "sys.cpu", BASE_MS, lambda iv: 60_000) == 0
+        assert cold.trim_segments_before(
+            "unknown.metric", NOW_MS, lambda iv: 60_000) == 0
+        assert [dict(e) for e in
+                cold._metrics["sys.cpu"]["segments"]] == before
+
+    def test_trim_fraction_gate_defers_sliver(self, tmp_path):
+        """A cutoff that expires only a sliver of a straddling
+        segment defers the O(segment) rewrite to a later sweep
+        (write-amplification gate); whole-expired segments still
+        drop for free."""
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        seg = cold._metrics["sys.cpu"]["segments"][0]
+        span = seg["end_ms"] - seg["start_ms"]
+        # expired prefix ~10% of the range: below the 25% gate
+        cutoff = seg["start_ms"] + span // 10 + 60_000 + 1
+        assert cold.trim_segments_before(
+            "sys.cpu", cutoff, lambda iv: 60_000) == 0
+        assert cold._metrics["sys.cpu"]["segments"][0] == seg
+
+    def test_whole_drop_keeps_unexpired_last_cell_window(
+            self, tmp_path):
+        """drop_segments_before honors the cell rule: a segment whose
+        last cell is stamped just before the cutoff still aggregates
+        unexpired history [end_ms, end_ms+interval) — it must trim,
+        not drop whole."""
+        _, t1 = _spilled_pair(tmp_path)
+        cold = t1.lifecycle.coldstore
+        seg = cold._metrics["sys.cpu"]["segments"][0]
+        # cutoff just past the segment end: without the interval
+        # allowance the whole segment (incl. its last, partly
+        # unexpired cell) would unlink
+        cutoff = seg["end_ms"] + 30_000  # < end_ms + 60s interval
+        assert cold.drop_segments_before(
+            "sys.cpu", cutoff, lambda iv: 60_000) == 0
+        assert cold.drop_segments_before(
+            "sys.cpu", seg["end_ms"] + 60_001,
+            lambda iv: 60_000) == seg["rows"]
+
+    def test_retention_sweep_trims_through_manager(self, tmp_path):
+        """The lifecycle sweeper drives the trim: a 90m retention
+        leaves the cold segment straddling the cutoff; after the next
+        sweep the expired prefix is gone, the remainder serves, and
+        fsck stays clean."""
+        from opentsdb_tpu.tools.fsck import run_fsck
+        t1 = TSDB(_cfg(tmp_path))
+        _ingest(t1)
+        # spill everything below NOW-60m first (no retention yet —
+        # retention runs BEFORE spill inside one sweep, so a policy
+        # present from the start would purge the raw prefix instead
+        # of ever spilling it)
+        t1.lifecycle.sweep(now_ms=NOW_MS)
+        seg0 = [dict(e) for e in
+                t1.lifecycle.coldstore._metrics["sys.cpu"]
+                ["segments"]]
+        assert seg0, "expected a spilled segment"
+        # now age the data past a 100m retention: cutoff NOW-100m
+        # lands INSIDE the cold range [NOW-120m, NOW-60m)
+        t1.lifecycle.update_policies({"policies": [{
+            "metric": "*", "retention": "100m",
+            "demoteAfter": "30m", "demoteTiers": ["1m"],
+            "spillAfter": "60m"}]})
+        rep = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["purged"] > 0
+        seg1 = t1.lifecycle.coldstore._metrics["sys.cpu"]["segments"]
+        assert seg1 and seg1[0]["start_ms"] > seg0[0]["start_ms"]
+        cutoff = NOW_MS - 6000_000
+        assert seg1[0]["start_ms"] + 60_000 >= cutoff
+        # cold-tier integrity is clean after the rewrite (fsck ALSO
+        # reports expired-but-present points against wall-clock now —
+        # the fixture's 2013 data is all "expired" there, not a trim
+        # defect)
+        report = run_fsck(t1)
+        assert not any("ERROR: cold" in ln for ln in report.lines), \
+            report.lines
+        # restart: the trimmed manifest persisted
+        t2 = TSDB(_cfg(tmp_path))
+        cold2 = t2.lifecycle.coldstore
+        assert [e["file"] for e in
+                cold2._metrics["sys.cpu"]["segments"]] == \
+            [e["file"] for e in seg1]

@@ -432,6 +432,93 @@ class TestExpEndpointPojo:
         assert resp.status == 400
 
 
+class TestExpPixels:
+    """/api/query/exp pixel budgets (PR 8 satellite: exp assembles
+    rows outside _build_results, so ``pixels`` must be applied in the
+    endpoint itself — to the evaluated OUTPUT frames, not the metric
+    inputs)."""
+
+    BASE = 1356998400
+    N = 600
+
+    def _router(self):
+        from opentsdb_tpu import TSDB, Config
+        from opentsdb_tpu.tsd.http_api import HttpRpcRouter
+        t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+        import math
+        for i in range(self.N):
+            t.add_point("px.a", self.BASE + i, 100 + 10 * math.sin(i / 7),
+                        {"host": "x"})
+        return t, HttpRpcRouter(t)
+
+    def _body(self, **top):
+        body = {
+            "time": {"start": str(self.BASE),
+                     "end": str(self.BASE + self.N),
+                     "aggregator": "sum"},
+            "metrics": [{"id": "A", "metric": "px.a"}],
+            "expressions": [{"id": "e", "expr": "A * 2"}],
+            "outputs": [{"id": "e"}],
+        }
+        body.update(top)
+        return body
+
+    def _post(self, router, body, want=200):
+        import json as _json
+        from opentsdb_tpu.tsd.http_api import HttpRequest
+        resp = router.handle(HttpRequest(
+            "POST", "/api/query/exp", {}, {},
+            _json.dumps(body).encode()))
+        assert resp.status == want, resp.body
+        return _json.loads(resp.body)
+
+    def _rows(self, out):
+        return {r[0]: r[1] for r in out["outputs"][0]["dps"]}
+
+    def test_query_level_pixels_bounds_and_subsets(self):
+        t, router = self._router()
+        full = self._rows(self._post(router, self._body()))
+        assert len(full) == self.N
+        red = self._rows(self._post(router, self._body(pixels=20)))
+        # M4 keeps <= 4 points per pixel for a single series
+        assert 0 < len(red) <= 4 * 20
+        # a SELECTION of the full answer: same value at every kept ts
+        assert all(full[ts] == v for ts, v in red.items())
+        # global first/last survive (M4 anchors every pixel edge)
+        assert min(full) in red and max(full) in red
+
+    def test_per_output_override_wins(self):
+        t, router = self._router()
+        body = self._body(pixels=300)
+        body["outputs"] = [{"id": "e", "pixels": 10}]
+        red = self._rows(self._post(router, body))
+        assert 0 < len(red) <= 4 * 10
+
+    def test_minmaxlttb_fn(self):
+        t, router = self._router()
+        red = self._rows(self._post(
+            router, self._body(pixels=25, pixelFn="minmaxlttb")))
+        assert 0 < len(red) <= 25
+        full = self._rows(self._post(router, self._body()))
+        assert all(full[ts] == v for ts, v in red.items())
+
+    def test_zero_pixels_is_off(self):
+        t, router = self._router()
+        full = self._rows(self._post(router, self._body()))
+        off = self._rows(self._post(router, self._body(pixels=0)))
+        assert off == full
+
+    def test_invalid_pixels_400(self):
+        t, router = self._router()
+        for bad in (-1, "0800", "abc", 1.5, True):
+            self._post(router, self._body(pixels=bad), want=400)
+        self._post(router, self._body(pixels=10, pixelFn="nope"),
+                   want=400)
+        body = self._body()
+        body["outputs"] = [{"id": "e", "pixels": "12_0"}]
+        self._post(router, body, want=400)
+
+
 class TestQueryExecutorMatrix:
     """The remaining TestQueryExecutor.java scenarios: nesting,
     multi-output ordering, error classes (circular/self reference,
