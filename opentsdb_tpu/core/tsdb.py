@@ -45,6 +45,11 @@ class TSDB:
 
     def __init__(self, config: Config | None = None):
         self.config = config or Config()
+        # startup hygiene: a typo'd tsd.* knob used to be silently
+        # ignored — warn about every configured key nothing reads
+        # (the declared-key registry in utils/config.py is enforced
+        # by tsdlint, so "undeclared" really means "unread")
+        self.config.warn_unknown_keys()
         # Force the JAX platform when configured (tsd.tpu.platform =
         # cpu|tpu|axon|""). Needed because site customizations may pin
         # JAX_PLATFORMS before our process can set env vars.

@@ -230,6 +230,11 @@ class UniqueId:
     def collect_stats(self, collector) -> None:
         collector.record("uid.cache-hit", self.cache_hits, kind=self.kind)
         collector.record("uid.cache-miss", self.cache_misses, kind=self.kind)
+        # (ref: UniqueId.java random_id_collisions stat — bumped here
+        # since the random-metric path landed but never exported until
+        # tsdlint's counter-export pass flagged it)
+        collector.record("uid.random-id-collisions",
+                         self.random_id_collisions, kind=self.kind)
         collector.record("uid.cache-size", len(self), kind=self.kind)
         collector.record("uid.ids-used", self.max_id(), kind=self.kind)
         collector.record("uid.ids-available",

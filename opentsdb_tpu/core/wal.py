@@ -146,7 +146,6 @@ class WriteAheadLog:
         # immediately so a lone writer never pays the window.
         self._commit_cond = threading.Condition()
         self._commit_leader = False
-        self._commit_waiters = 0
         self.group_window_s = max(group_window_ms, 0) / 1000.0
         self.group_max_records = max(int(group_max_records), 1)
         self.group_max_bytes = max(int(group_max_bytes), 1)
@@ -252,6 +251,9 @@ class WriteAheadLog:
             self._fh.write(blob)
 
         try:
+            # tsdlint: allow[lock-blocking] append framing IS the
+            # lock's critical section (single-writer log); the retry
+            # ladder is deadline-bounded and exhaustion degrades
             call_with_retries(write_rec, self._retry,
                               retryable=(OSError,))
         except OSError as exc:
@@ -536,11 +538,7 @@ class WriteAheadLog:
                 if not self._commit_leader:
                     self._commit_leader = True
                     break
-                self._commit_waiters += 1
-                try:
-                    self._commit_cond.wait(0.05)
-                finally:
-                    self._commit_waiters -= 1
+                self._commit_cond.wait(0.05)
         try:
             self._commit_once()
         finally:
@@ -722,6 +720,8 @@ class WriteAheadLog:
         with self._lock:
             if self._fh is not None:
                 try:
+                    # tsdlint: allow[lock-blocking] final shutdown
+                    # fsync; _closed is already set, nothing contends
                     os.fsync(self._fh.fileno())
                 except OSError:  # pragma: no cover
                     pass

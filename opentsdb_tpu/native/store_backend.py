@@ -241,6 +241,8 @@ class NativeTimeSeriesStore:
             if self._h:
                 self._lib.tss_destroy(self._h)
         except Exception:  # noqa: BLE001
+            # tsdlint: allow[swallow] a destructor must never raise
+            # (interpreter teardown may have torn the lib down first)
             pass
 
     # -- write path ---------------------------------------------------

@@ -324,8 +324,8 @@ class QueryResultCache:
             try:
                 self._put(key, version, detach(value))
             except Exception:  # noqa: BLE001 - put is best-effort
-                # cache bookkeeping must never fail the query; the
-                # waiters still share flight.value
+                # tsdlint: allow[swallow] cache bookkeeping must never
+                # fail the query; the waiters still share flight.value
                 pass
             return value, MISS
         finally:
@@ -362,6 +362,9 @@ class QueryResultCache:
         try:
             self._put(key, version, detach(value))
         except Exception:  # noqa: BLE001 - put is best-effort
+            # tsdlint: allow[swallow] populate must never fail the
+            # query that computed the value (same rule as the
+            # single-flight put above)
             pass
 
     # ------------------------------------------------------------------

@@ -144,6 +144,7 @@ class ContinuousQueryRegistry:
         self.sse_events = 0
         self.sse_resumes = 0
         self.sse_resume_snapshots = 0
+        self.sse_events_delivered = 0  # frames on CLOSED streams
         self.publishes = 0
 
     # ------------------------------------------------------------------
@@ -527,6 +528,9 @@ class ContinuousQueryRegistry:
             if sub in cq.subscribers:
                 cq.subscribers.remove(sub)
                 self._active_subs -= 1
+                # fold the stream's delivered-frame count into the
+                # registry total (per-sub counts die with the sub)
+                self.sse_events_delivered += sub.events
 
     def _maybe_publish(self) -> None:
         """Rate-limited push after ingest drains: at most one publish
@@ -654,6 +658,9 @@ class ContinuousQueryRegistry:
                     if s in cq.subscribers:
                         cq.subscribers.remove(s)
                         self._active_subs -= 1
+                        # shed bypasses unsubscribe: fold the
+                        # stream's delivered-frame count here too
+                        self.sse_events_delivered += s.events
         self.sse_shed += shed
         self.sse_events += len(targets) - shed
         self.publishes += 1
@@ -699,6 +706,12 @@ class ContinuousQueryRegistry:
         collector.record("streaming.rebuilds", self.rebuilds)
         collector.record("streaming.sse.subscribers", subs)
         collector.record("streaming.sse.events", self.sse_events)
+        # delivery-side twin of sse.events: frames that actually
+        # landed in subscriber queues (resume replays + snapshots
+        # included, queue-full sheds excluded); live streams' counts
+        # fold in when they unsubscribe
+        collector.record("streaming.sse.events_delivered",
+                         self.sse_events_delivered)
         collector.record("streaming.sse.shed", self.sse_shed)
         collector.record("streaming.sse.resumes", self.sse_resumes)
         collector.record("streaming.sse.resume_snapshots",
@@ -725,6 +738,7 @@ class ContinuousQueryRegistry:
             "rebuilds": self.rebuilds,
             "subscribers": subs,
             "sse_events": self.sse_events,
+            "sse_events_delivered": self.sse_events_delivered,
             "sse_shed": self.sse_shed,
             "sse_resumes": self.sse_resumes,
             "sse_resume_snapshots": self.sse_resume_snapshots,

@@ -98,6 +98,10 @@ def sse_stream(registry, cq, max_lifetime_s: float = 0.0,
             try:
                 registry.pump(cq)
             except Exception:  # noqa: BLE001 - never kill the stream
+                # tsdlint: allow[swallow] a pump hiccup must not kill
+                # a long-lived dashboard stream; fold failures are
+                # counted by the registry (fold_errors) and the next
+                # pump retries
                 pass
             try:
                 yield sub.queue.get_nowait()

@@ -100,4 +100,7 @@ def main(argv: list[str]) -> int:
         asyncio.run(run())
     except KeyboardInterrupt:
         pass
+    # the operator's import checklist: how much landed in the spool
+    print(f"drained {server.lines_received} line(s) into {args.dir}",
+          flush=True)
     return 0

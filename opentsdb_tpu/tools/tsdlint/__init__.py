@@ -1,0 +1,136 @@
+"""tsdlint — invariant static analysis for the opentsdb_tpu tree.
+
+Eight PRs of review hardening kept finding the same defect classes by
+hand; tsdlint makes each one a checked artifact. Five AST passes over
+the package (plus the fault-arming side of the tests):
+
+==============  ==========================================================
+pass id         invariant
+==============  ==========================================================
+lock-blocking   no blocking call (fsync/sleep/socket/subprocess/HTTP/
+                waits) while holding a lock, unless annotated
+lock-cycle      the static lock-acquisition graph has no cycles and no
+                same-lock re-entry on plain Locks
+config-keys     every ``config.get_*("tsd...")`` literal resolves to the
+                declared-key registry (utils/config.py)
+fault-sites     every fault site used in code or armed in tests resolves
+                to utils/faults.py KNOWN_SITES
+counter-export  every counter incremented is read somewhere (else it can
+                never reach /api/stats)
+swallow         no bare ``except:``; no broad ``except Exception: pass``
+==============  ==========================================================
+
+Suppression is two-level: an inline ``# tsdlint: allow[pass-id] why``
+on the offending (or enclosing ``with``/``except``) line for
+deliberate, documented violations, and a baseline file of
+line-independent fingerprints for bulk grandfathering. The CLI
+(``python -m opentsdb_tpu.tools.tsdlint``) exits non-zero on any
+unsuppressed finding; ``tests/test_tsdlint.py`` gates the clean tree
+in tier-1. The runtime complement for lock ordering is
+:mod:`opentsdb_tpu.tools.tsdlint.witness`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from opentsdb_tpu.tools.tsdlint import (config_keys, counters,
+                                        fault_sites, lock_discipline,
+                                        swallow)
+from opentsdb_tpu.tools.tsdlint.base import (Finding, Source,
+                                             iter_py_files)
+
+#: pass-id -> module; lock_discipline owns two ids
+PASS_MODULES = (lock_discipline, config_keys, fault_sites, counters,
+                swallow)
+ALL_PASS_IDS = (lock_discipline.PASS_BLOCKING,
+                lock_discipline.PASS_CYCLE,
+                config_keys.PASS_ID, fault_sites.PASS_ID,
+                counters.PASS_ID, swallow.PASS_ID)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))          # .../opentsdb_tpu
+DEFAULT_ROOT = os.path.dirname(_PKG_ROOT)  # repo root
+DEFAULT_BASELINE = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "baseline.txt")
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    unsuppressed: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.unsuppressed
+
+
+def load_baseline(path: str | None) -> set[str]:
+    if not path or not os.path.isfile(path):
+        return set()
+    out = set()
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def run_tsdlint(package_paths=None, test_paths=None,
+                baseline_path: str | None = DEFAULT_BASELINE,
+                pass_ids=None, root: str = DEFAULT_ROOT) -> Report:
+    """Run the selected passes; returns a :class:`Report`.
+
+    ``package_paths`` default to the installed ``opentsdb_tpu``
+    package; ``test_paths`` default to a sibling ``tests/`` directory
+    when one exists (only the fault-sites pass reads tests).
+    """
+    if package_paths is None:
+        package_paths = [_PKG_ROOT]
+    if test_paths is None:
+        cand = os.path.join(root, "tests")
+        test_paths = [cand] if os.path.isdir(cand) else []
+    selected = set(pass_ids) if pass_ids else set(ALL_PASS_IDS)
+
+    pkg_sources = [Source.load(p, root)
+                   for p in iter_py_files(package_paths)]
+    test_sources = [Source.load(p, root)
+                    for p in iter_py_files(test_paths)]
+
+    report = Report()
+    ctx: dict = {}
+    for mod in PASS_MODULES:
+        mod_ids = {getattr(mod, a) for a in dir(mod)
+                   if a.startswith("PASS")}
+        if not (mod_ids & selected):
+            continue
+        for f in mod.run(pkg_sources, test_sources, ctx):
+            if f.pass_id in selected:
+                report.findings.append(f)
+    report.findings.sort(key=lambda f: (f.rel, f.line, f.pass_id))
+
+    baseline = load_baseline(baseline_path)
+    seen = set()
+    for f in report.findings:
+        seen.add(f.fingerprint)
+        if f.fingerprint in baseline:
+            report.suppressed.append(f)
+        else:
+            report.unsuppressed.append(f)
+    report.stale_baseline = sorted(baseline - seen)
+    return report
+
+
+def write_baseline(report: Report, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# tsdlint baseline — grandfathered findings, one\n"
+                 "# line-independent fingerprint per line. Prefer an\n"
+                 "# inline `# tsdlint: allow[pass] why` for sites\n"
+                 "# that are deliberate; keep this file for bulk\n"
+                 "# suppressions only.\n")
+        for fp in sorted({f.fingerprint for f in report.findings}):
+            fh.write(fp + "\n")

@@ -1,0 +1,86 @@
+"""CLI: ``python -m opentsdb_tpu.tools.tsdlint`` (see package doc).
+
+Exit status: 0 = clean (no unsuppressed findings), 1 = findings,
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from opentsdb_tpu.tools.tsdlint import (ALL_PASS_IDS,
+                                        DEFAULT_BASELINE,
+                                        DEFAULT_ROOT, run_tsdlint,
+                                        write_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m opentsdb_tpu.tools.tsdlint",
+        description="invariant static analysis for the opentsdb_tpu "
+                    "tree")
+    parser.add_argument("paths", nargs="*",
+                        help="package files/dirs to lint (default: "
+                             "the opentsdb_tpu package)")
+    parser.add_argument("--tests", action="append", default=None,
+                        metavar="DIR",
+                        help="test tree(s) for the fault-sites pass "
+                             "(default: <root>/tests)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline suppression file "
+                             "(default: %(default)s)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline with every current "
+                             "finding, then exit 0")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated pass ids (default: all "
+                             f"of {','.join(ALL_PASS_IDS)})")
+    parser.add_argument("--root", default=DEFAULT_ROOT,
+                        help="path fingerprints are made relative to")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="only print the summary line")
+    args = parser.parse_args(argv)
+
+    pass_ids = None
+    if args.passes:
+        pass_ids = [p.strip() for p in args.passes.split(",")
+                    if p.strip()]
+        unknown = set(pass_ids) - set(ALL_PASS_IDS)
+        if unknown:
+            parser.error(f"unknown pass id(s): {sorted(unknown)}")
+
+    report = run_tsdlint(
+        package_paths=args.paths or None,
+        test_paths=args.tests,
+        baseline_path=None if args.no_baseline else args.baseline,
+        pass_ids=pass_ids, root=args.root)
+
+    if args.write_baseline:
+        if args.paths or args.tests or pass_ids:
+            # the baseline file is shared by every pass and path:
+            # rewriting it from a subset run would silently drop all
+            # the other entries and fail the next full-tree gate
+            parser.error("--write-baseline only makes sense on a "
+                         "full run (no paths, --tests or --passes)")
+        write_baseline(report, args.baseline)
+        print(f"wrote {len(report.findings)} fingerprint(s) to "
+              f"{args.baseline}")
+        return 0
+
+    if not args.quiet:
+        for f in report.unsuppressed:
+            print(f)
+        for fp in report.stale_baseline:
+            print(f"stale baseline entry (no longer fires): {fp}")
+    print(f"tsdlint: {len(report.unsuppressed)} unsuppressed, "
+          f"{len(report.suppressed)} baseline-suppressed, "
+          f"{len(report.stale_baseline)} stale baseline entr"
+          f"{'y' if len(report.stale_baseline) == 1 else 'ies'}")
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""tsdlint core model: sources, findings, inline suppressions.
+
+A :class:`Source` is one parsed Python file plus its ``# tsdlint:
+allow[...]`` inline annotations. A :class:`Finding` is one invariant
+violation with a LINE-INDEPENDENT fingerprint (``pass:relpath:detail``)
+so baseline suppressions survive unrelated edits to the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+# ``# tsdlint: allow[pass-id, pass-id2] reason`` — the reason is part
+# of the grammar on purpose: every suppression documents WHY the
+# invariant is deliberately violated at that site
+_ALLOW_RE = re.compile(
+    r"#\s*tsdlint:\s*allow\[([a-z0-9_,\- ]+)\]\s*(.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str          # absolute file path
+    rel: str           # stable display/fingerprint path
+    line: int
+    message: str
+    detail: str        # stable fingerprint component (key/site/lock…)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.pass_id}:{self.rel}:{self.detail}"
+
+    def __str__(self) -> str:
+        return (f"{self.rel}:{self.line}: [{self.pass_id}] "
+                f"{self.message}")
+
+
+@dataclass
+class Source:
+    path: str
+    rel: str
+    text: str
+    tree: ast.Module
+    # line -> set of allowed pass ids ("*" = every pass)
+    allows: dict[int, set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str, root: str) -> "Source":
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            rel = os.path.relpath(path, root)
+        except ValueError:  # different drive (windows)
+            rel = os.path.basename(path)
+        if rel.startswith(".."):
+            rel = os.path.basename(path)
+        rel = rel.replace(os.sep, "/")
+        src = cls(path=path, rel=rel, text=text,
+                  tree=ast.parse(text, filename=path))
+        # an allow may trail the offending line, or live in the pure-
+        # comment block immediately above it (the codebase keeps
+        # ~72-col lines, so multi-line reasons are the norm): comment-
+        # line allows propagate down to the next code line
+        pending: set[str] = set()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                ids = {p.strip() for p in m.group(1).split(",")
+                       if p.strip()}
+                src.allows.setdefault(lineno, set()).update(ids)
+                if line.lstrip().startswith("#"):
+                    pending |= ids
+                continue
+            if line.lstrip().startswith("#"):
+                continue  # reason continuation / unrelated comment
+            if pending:
+                if line.strip():
+                    src.allows.setdefault(lineno, set()).update(
+                        pending)
+                    pending = set()
+                # blank lines keep the pending block alive
+        return src
+
+    def allowed(self, pass_id: str, *lines: int) -> bool:
+        """Whether any of ``lines`` carries an inline allow for
+        ``pass_id`` (passes probe the violation line plus its
+        enclosing ``with``/``except`` line)."""
+        for line in lines:
+            ids = self.allows.get(line)
+            if ids and (pass_id in ids or "*" in ids):
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Attribute/Name chains; ``?`` marks non-name
+    links (calls, subscripts) so ``x[0].lock`` -> ``?.lock``."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def iter_py_files(paths, exclude_dirs=("__pycache__", "tsdlint",
+                                       "tsdlint_fixtures")):
+    """Yield .py files under each path (files pass through directly —
+    fixture tests lint single files). ``tsdlint`` itself and the test
+    fixture corpus are excluded from directory walks: the linter's own
+    pattern tables and the deliberately-broken fixtures would
+    otherwise self-flag."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in exclude_dirs]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)

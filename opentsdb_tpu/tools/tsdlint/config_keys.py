@@ -1,0 +1,74 @@
+"""Pass ``config-keys``: every ``tsd.*`` key the code reads must be
+declared.
+
+Config keys are string-scattered across ~20 modules; a typo'd
+``config.get_bool("tsd.htpp...")`` compiles, runs, and silently
+returns the call-site default forever. This pass resolves every
+literal (and literal-headed f-string) key passed to a ``Config``
+getter against the central declared-key registry
+(:func:`opentsdb_tpu.utils.config.declared_keys` +
+:data:`~opentsdb_tpu.utils.config.DYNAMIC_KEY_PREFIXES`). The runtime
+twin is ``Config.warn_unknown_keys`` — startup warns about configured
+keys nothing reads.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "config-keys"
+
+_GETTERS = {"get_string", "get_int", "get_float", "get_bool",
+            "has_property"}
+
+
+def _key_of(arg: ast.AST) -> tuple[str, bool] | None:
+    """(key-or-literal-head, is_exact) for a getter's first arg, or
+    None when the key is fully dynamic (a variable — unverifiable
+    statically, covered by the startup warning instead)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    if isinstance(arg, ast.JoinedStr) and arg.values and \
+            isinstance(arg.values[0], ast.Constant):
+        return str(arg.values[0].value), False
+    return None
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    from opentsdb_tpu.utils.config import (DYNAMIC_KEY_PREFIXES,
+                                           declared_keys,
+                                           is_declared_key)
+    declared = declared_keys()
+    findings: list[Finding] = []
+    for src in package_sources:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GETTERS and node.args):
+                continue
+            got = _key_of(node.args[0])
+            if got is None:
+                continue
+            key, exact = got
+            if not key.startswith("tsd."):
+                continue  # not a tsd.* namespace read (plugin tables)
+            if exact:
+                ok = is_declared_key(key)
+            else:
+                # f-string: the literal head must sit inside a
+                # declared dynamic family, or be the prefix of at
+                # least one declared key
+                ok = any(key.startswith(p) or p.startswith(key)
+                         for p in DYNAMIC_KEY_PREFIXES) or \
+                    any(k.startswith(key) for k in declared)
+            if ok or src.allowed(PASS_ID, node.lineno):
+                continue
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, node.lineno,
+                f"config key {key!r} is not in the declared-key "
+                f"registry (utils/config.py) — a typo here is "
+                f"silently ignored at runtime",
+                detail=key))
+    return findings

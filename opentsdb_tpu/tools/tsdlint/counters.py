@@ -1,0 +1,55 @@
+"""Pass ``counter-export``: every counter bumped must be readable
+somewhere.
+
+The stats surface is push-style (``collect_stats(collector)``), so a
+counter attribute that is incremented but never *read* anywhere in the
+package can never reach ``/api/stats`` or ``/api/health`` — it is
+either an unexported metric (the bump was the whole point) or dead
+state. The rule is whole-package: an attribute name incremented via
+``x.attr += n`` / ``-= n`` must appear as an attribute LOAD (or a
+``getattr`` literal) somewhere in the tree. Reads in other classes
+count — several counters are exported by their owner's parent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "counter-export"
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    bumps: dict[str, list] = {}   # attr -> [(src, line)]
+    loads: set[str] = set()
+    for src in package_sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Attribute) and \
+                    isinstance(node.op, (ast.Add, ast.Sub)):
+                bumps.setdefault(node.target.attr, []).append(
+                    (src, node.lineno))
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                loads.add(node.attr)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "getattr" and \
+                    len(node.args) > 1 and \
+                    isinstance(node.args[1], ast.Constant):
+                loads.add(str(node.args[1].value))
+    findings: list[Finding] = []
+    for attr, sites in sorted(bumps.items()):
+        if attr in loads:
+            continue
+        for src, line in sites:
+            if src.allowed(PASS_ID, line):
+                continue
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, line,
+                f"counter {attr!r} is incremented here but never "
+                f"read anywhere in the package — unexported metric "
+                f"or dead state",
+                detail=attr))
+    return findings

@@ -1,0 +1,79 @@
+"""Pass ``fault-sites``: every fault-injection site must be
+registered.
+
+A fault site armed in a test but misspelled (or orphaned by a rename)
+makes the battery silently test nothing; a ``faults.check`` on an
+unregistered site can never be armed through config. This pass
+resolves every site string — ``.check("...")``/``.arm("...")``/
+``.disarm("...")`` literals on fault-injector receivers, ``fault_site
+= "..."`` assignments, and ``tsd.faults.<site>_<knob>`` key literals —
+against :data:`opentsdb_tpu.utils.faults.KNOWN_SITES`. Tests are
+scanned too (the arming side lives there).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from opentsdb_tpu.tools.tsdlint.base import Finding, dotted_name
+
+PASS_ID = "fault-sites"
+
+_CALLS = {"check", "arm", "disarm"}
+_KNOB_RE = re.compile(
+    r"^tsd\.faults\.(?P<site>.+?)[._]"
+    r"(error_rate|error_count|error_once|latency_ms)$")
+
+
+def _faultish_receiver(func: ast.AST) -> bool:
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = dotted_name(func.value).rsplit(".", 1)[-1]
+    return "fault" in recv or recv in ("fi", "injector")
+
+
+def _sites_in(src) -> list[tuple[str, int, str]]:
+    """(site, line, how) for every site usage in one source."""
+    out: list[tuple[str, int, str]] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CALLS and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str) and \
+                _faultish_receiver(node.func):
+            out.append((node.args[0].value, node.lineno,
+                        f".{node.func.attr}()"))
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for tgt in node.targets:
+                name = tgt.attr if isinstance(tgt, ast.Attribute) \
+                    else tgt.id if isinstance(tgt, ast.Name) else ""
+                if name == "fault_site":
+                    out.append((node.value.value, node.lineno,
+                                "fault_site ="))
+        elif isinstance(node, ast.Constant) and \
+                isinstance(node.value, str):
+            m = _KNOB_RE.match(node.value)
+            if m:
+                out.append((m.group("site"), node.lineno,
+                            "tsd.faults.* key"))
+    return out
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    from opentsdb_tpu.utils.faults import is_known_site
+    findings: list[Finding] = []
+    for src in list(package_sources) + list(test_sources):
+        for site, line, how in _sites_in(src):
+            if is_known_site(site) or src.allowed(PASS_ID, line):
+                continue
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, line,
+                f"fault site {site!r} ({how}) is not registered in "
+                f"utils/faults.py KNOWN_SITES — arming it tests "
+                f"nothing",
+                detail=site))
+    return findings

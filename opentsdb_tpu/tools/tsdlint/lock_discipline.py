@@ -1,0 +1,276 @@
+"""Pass ``lock-blocking`` / ``lock-cycle``: lock discipline.
+
+Two related checks over every ``with <lock>:`` body (and, by the
+codebase's naming convention, every ``*_locked`` method body — those
+run with the caller's lock held):
+
+- **lock-blocking** — a call that can block on I/O or scheduling
+  (``fsync``, ``time.sleep``, socket send/recv/connect, subprocess,
+  HTTP, future/queue/condition waits, the retry-ladder helper) while
+  a lock is held turns every sibling of that lock into a convoy.
+  Deliberate sites (a WAL whose ack rides on the fsync) carry a
+  ``# tsdlint: allow[lock-blocking] <why>`` annotation.
+- **lock-cycle** — the static lock-acquisition graph: every lexically
+  nested acquisition adds an edge ``outer -> inner``; any cycle in
+  the whole-package graph is a potential ABBA deadlock. Nesting
+  itself is fine (the spool's replay->append order is load-bearing);
+  only cycles and re-acquiring the same non-reentrant lock are
+  findings. The runtime complement is the lock-order witness
+  (:mod:`opentsdb_tpu.tools.tsdlint.witness`), which sees dynamic
+  orders this lexical pass cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from opentsdb_tpu.tools.tsdlint.base import Finding, dotted_name
+
+PASS_BLOCKING = "lock-blocking"
+PASS_CYCLE = "lock-cycle"
+
+_LOCKISH = re.compile(r"(^|_)(lock|cond|mutex)", re.I)
+
+# fully-dotted callables that block
+_BLOCK_EXACT = {
+    "time.sleep", "os.fsync", "os.fdatasync", "os.sync",
+    "socket.create_connection", "urllib.request.urlopen",
+    "call_with_retries",  # sleeps between attempts by design
+}
+# terminal attribute names that block regardless of receiver
+_BLOCK_ATTR = {
+    "fsync", "sendall", "recv", "recv_into", "connect", "accept",
+    "wait", "wait_for", "result", "urlopen", "getresponse",
+}
+# module prefixes whose every call blocks
+_BLOCK_PREFIX = ("subprocess.", "requests.", "http.client.")
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = dotted_name(expr).rsplit(".", 1)[-1]
+    return bool(_LOCKISH.search(name))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, source, modname, edges, reentrant, findings):
+        self.src = source
+        self.mod = modname
+        self.edges = edges          # (a, b) -> (source, line)
+        self.reentrant = reentrant  # set of lock ids that are RLocks
+        self.findings = findings
+        self.class_stack: list[str] = []
+        self.func_stack: list[str] = []
+        # held locks: (lock_id, raw_expr, with_line); the pseudo
+        # entry for *_locked methods has lock_id None
+        self.held: list[tuple[str | None, str, int]] = []
+
+    # -- naming ------------------------------------------------------
+
+    def _qual(self) -> str:
+        return ".".join(self.class_stack + self.func_stack) or \
+            "<module>"
+
+    def _lock_id(self, expr: ast.AST) -> str:
+        raw = dotted_name(expr)
+        if raw.startswith("self.") and self.class_stack:
+            return f"{self.mod}.{self.class_stack[-1]}" \
+                   f".{raw[len('self.'):]}"
+        return f"{self.mod}.{raw}"
+
+    # -- structure ---------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        # RLock discovery: self.X = threading.RLock() in any method
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    dotted_name(sub.value.func) in (
+                        "threading.RLock", "RLock"):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        self.reentrant.add(self._lock_id(tgt))
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        outer_held = self.held
+        self.held = []
+        if node.name.endswith("_locked"):
+            # convention: the caller holds a lock for the whole body
+            self.held = [(None, "<caller-held>", node.lineno)]
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.held = outer_held
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- acquisitions ------------------------------------------------
+
+    def _enter_lock(self, expr: ast.AST, line: int) -> bool:
+        lock_id = self._lock_id(expr)
+        raw = dotted_name(expr)
+        for held_id, _raw, held_line in self.held:
+            if held_id is None:
+                continue
+            if held_id == lock_id:
+                if lock_id not in self.reentrant and not \
+                        self.src.allowed(PASS_CYCLE, line, held_line):
+                    self.findings.append(Finding(
+                        PASS_CYCLE, self.src.path, self.src.rel, line,
+                        f"nested acquisition of the same "
+                        f"non-reentrant lock {lock_id} "
+                        f"(outer at line {held_line}) — self-deadlock",
+                        detail=f"{lock_id}->{lock_id}"))
+            else:
+                self.edges.setdefault((held_id, lock_id),
+                                      (self.src, line))
+        self.held.append((lock_id, raw, line))
+        return True
+
+    def visit_With(self, node) -> None:
+        entered = 0
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            if _is_lockish(expr):
+                entered += self._enter_lock(expr, node.lineno)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(entered):
+            self.held.pop()
+        # context expressions themselves still need visiting
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    visit_AsyncWith = visit_With
+
+    # -- blocking calls ----------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            d = dotted_name(node.func)
+            last = d.rsplit(".", 1)[-1]
+            receiver = d.rsplit(".", 1)[0] if "." in d else ""
+            held_raws = {raw for _id, raw, _ln in self.held}
+            blocking = (d in _BLOCK_EXACT
+                        or last in _BLOCK_ATTR
+                        or d.startswith(_BLOCK_PREFIX))
+            if last == "wait" and receiver in held_raws:
+                # Condition.wait on the HELD condition releases it
+                # while sleeping — the correct idiom, not a convoy
+                blocking = False
+            if last == "acquire":
+                # nested acquisition, not a blocking call: feed the
+                # graph instead (non-blocking probes excluded)
+                blocking = False
+                if _is_lockish(node.func.value) if isinstance(
+                        node.func, ast.Attribute) else False:
+                    nonblock = any(
+                        (isinstance(a, ast.Constant)
+                         and a.value in (False, 0))
+                        for a in list(node.args)[:1]) or any(
+                        kw.arg == "blocking"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value in (False, 0)
+                        for kw in node.keywords)
+                    if not nonblock:
+                        self._enter_lock(node.func.value, node.lineno)
+                        self.held.pop()  # acquire() alone: edge only
+            if blocking:
+                with_lines = [ln for _id, _raw, ln in self.held]
+                if not self.src.allowed(PASS_BLOCKING, node.lineno,
+                                        *with_lines):
+                    where = ", ".join(
+                        _id or raw for _id, raw, _ln in self.held)
+                    self.findings.append(Finding(
+                        PASS_BLOCKING, self.src.path, self.src.rel,
+                        node.lineno,
+                        f"blocking call {d}() while holding {where}",
+                        detail=f"{self._qual()}:{d}"))
+        self.generic_visit(node)
+
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    edges: dict[tuple[str, str], tuple] = {}
+    reentrant: set[str] = set()
+    for src in package_sources:
+        _Visitor(src, _module_name(src.rel), edges, reentrant,
+                 findings).visit(src.tree)
+    # cycle detection over the whole-package acquisition graph
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    for scc in _sccs(graph):
+        if len(scc) < 2:
+            continue
+        cycle = sorted(scc)
+        for (a, b), (src, line) in sorted(edges.items(),
+                                          key=lambda kv: kv[0]):
+            if a in scc and b in scc:
+                if not src.allowed(PASS_CYCLE, line):
+                    findings.append(Finding(
+                        PASS_CYCLE, src.path, src.rel, line,
+                        f"lock-order cycle through {' <-> '.join(cycle)}"
+                        f" (this edge: {a} -> {b})",
+                        detail=f"{a}->{b}"))
+    return findings
+
+
+def _sccs(graph: dict[str, set[str]]):
+    """Tarjan strongly-connected components (iterative)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[set[str]] = []
+    counter = [0]
+
+    for start in graph:
+        if start in index:
+            continue
+        work = [(start, iter(sorted(graph[start])))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out

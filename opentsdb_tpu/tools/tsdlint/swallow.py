@@ -1,0 +1,82 @@
+"""Pass ``swallow``: silently-discarded broad exceptions.
+
+A ``except Exception: pass`` on a serve or ingest path converts a
+real defect into a silent wrong answer; a bare ``except:``
+additionally eats ``KeyboardInterrupt``/``SystemExit``. Two rules:
+
+- bare ``except:`` — flagged regardless of body;
+- ``except Exception`` / ``except BaseException`` (alone or in a
+  tuple) whose body is only ``pass``/``continue``/``...`` — flagged.
+
+Narrow excepts with trivial bodies (``except queue.Empty: pass``) are
+idiomatic and stay clean. Deliberate broad swallows (a close() race
+during connection teardown) carry ``# tsdlint: allow[swallow] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "swallow"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return True
+    names = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _trivial_body(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue  # docstring-ish or `...`
+        return False
+    return True
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in package_sources:
+        funcs: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                # BFS walk order: inner defs come later and overwrite,
+                # so a handler maps to its INNERMOST enclosing function
+                for sub in ast.walk(node):
+                    funcs[id(sub)] = node.name
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            bare = node.type is None
+            if not bare and not (_is_broad(node.type)
+                                 and _trivial_body(node.body)):
+                continue
+            body_line = node.body[0].lineno if node.body \
+                else node.lineno
+            if src.allowed(PASS_ID, node.lineno, body_line):
+                continue
+            where = funcs.get(id(node), "<module>")
+            what = "bare except:" if bare else \
+                f"broad except {ast.unparse(node.type)} " \
+                f"with an empty body"
+            exc = ast.unparse(node.type) if node.type else "bare"
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, node.lineno,
+                f"{what} in {where}() silently swallows failures",
+                detail=f"{where}:{exc}"))
+    return findings

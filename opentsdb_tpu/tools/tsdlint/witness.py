@@ -1,0 +1,320 @@
+"""Runtime lock-order witness: the dynamic half of lock discipline.
+
+The static ``lock-cycle`` pass only sees LEXICALLY nested
+acquisitions; an ABBA deadlock assembled across method calls (thread
+1: ``store.lock`` then ``uid.lock``; thread 2 the reverse) is
+invisible to it. This witness wraps ``threading.Lock``/``RLock`` so
+every lock records, per thread, which locks were already held when it
+was acquired — an edge ``A -> B`` in the global acquisition-order
+graph, remembered with BOTH stacks the first time it is seen. A cycle
+in that graph is a potential deadlock even if the run never actually
+deadlocked (the interleaving just didn't happen this time), which is
+exactly why the concurrency and cluster batteries run under it.
+
+Opt-in twice over: ``install()`` monkeypatches the factories (tests
+use the ``lock_witness`` fixture), and setting ``TSD_LOCK_WITNESS=1``
+installs at import for ad-hoc runs. Locks created BEFORE install are
+invisible — install before constructing the objects under test.
+
+Wrapper compatibility: ``threading.Condition`` and ``queue.Queue``
+duck-type their lock (``_is_owned``/``_release_save``/
+``_acquire_restore``); the wrapper forwards them with held-stack
+bookkeeping so condition waits don't corrupt the ledger.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderWitness:
+    """Global acquisition-order ledger + cycle detector."""
+
+    def __init__(self, max_stack: int = 12):
+        self.max_stack = max_stack
+        self._guard = _REAL_LOCK()
+        self._tls = threading.local()
+        # (held_site, acquired_site) -> (held_stack, acquire_stack)
+        self.edges: dict[tuple[str, str], tuple[str, str]] = {}
+        self.locks_created = 0
+        self.acquisitions = 0
+
+    # -- per-thread held stack ---------------------------------------
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _capture(self) -> tuple:
+        """Cheap stack summary: raw (file, line, func) tuples, no
+        string building — this runs on EVERY acquisition of every
+        witnessed lock during the stress batteries; formatting is
+        deferred to :meth:`explain` (cycles are rare, acquisitions
+        are not)."""
+        out = []
+        f = sys._getframe(2)
+        while f is not None and len(out) < self.max_stack:
+            code = f.f_code
+            if "tsdlint/witness" not in code.co_filename:
+                out.append((code.co_filename, f.f_lineno,
+                            code.co_name))
+            f = f.f_back
+        return tuple(out)
+
+    @staticmethod
+    def _fmt(stack) -> str:
+        if isinstance(stack, str):
+            return stack
+        return "\n".join(f"  {fn}:{ln} in {name}"
+                         for fn, ln, name in stack)
+
+    def note_acquired(self, site: str, reentrant_depth: int) -> None:
+        held = self._held()
+        self.acquisitions += 1
+        if reentrant_depth > 1:
+            # re-entering an RLock adds no ordering information
+            held.append((site, True))
+            return
+        if held:
+            stack = self._capture()
+            with self._guard:
+                # an edge from EVERY held lock (not just the
+                # innermost): A->B->C must also record A->C, or a
+                # later lone C->A inversion would look consistent.
+                # Same-site edges are skipped: locks of one allocation
+                # site (per-peer locks, queue mutexes) are routinely
+                # taken in instance order, which is not a hierarchy
+                # violation.
+                for held_site, nested in held:
+                    if nested or held_site == site:
+                        continue
+                    key = (held_site, site)
+                    if key not in self.edges:
+                        self.edges[key] = (
+                            self._held_stack_of(held_site), stack)
+        self._remember_stack(site)
+        held.append((site, False))
+
+    def note_released(self, site: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == site:
+                del held[i]
+                return
+
+    def _remember_stack(self, site: str) -> None:
+        stacks = getattr(self._tls, "stacks", None)
+        if stacks is None:
+            stacks = self._tls.stacks = {}
+        stacks[site] = self._capture()
+
+    def _held_stack_of(self, site: str) -> str:
+        return getattr(self._tls, "stacks", {}).get(site, "<unknown>")
+
+    # -- analysis ----------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles in the order graph (as site lists);
+        empty when every observed acquisition order is consistent."""
+        with self._guard:
+            graph: dict[str, set[str]] = {}
+            for a, b in self.edges:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        out: list[list[str]] = []
+        seen_cycles: set[frozenset] = set()
+        for start in sorted(graph):
+            path = [start]
+            on_path = {start}
+
+            def dfs(node):
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start and len(path) > 1:
+                        key = frozenset(path)
+                        if key not in seen_cycles:
+                            seen_cycles.add(key)
+                            out.append(path + [start])
+                    elif nxt not in on_path and nxt > start:
+                        path.append(nxt)
+                        on_path.add(nxt)
+                        dfs(nxt)
+                        on_path.discard(nxt)
+                        path.pop()
+
+            dfs(start)
+        return out
+
+    def explain(self, cycle: list[str]) -> str:
+        """Human report for one cycle: each edge with both stacks."""
+        lines = [f"lock-order cycle: {' -> '.join(cycle)}"]
+        with self._guard:
+            for a, b in zip(cycle, cycle[1:]):
+                held_stack, acq_stack = self.edges.get(
+                    (a, b), ("<unseen>", "<unseen>"))
+                lines.append(f"\nedge {a} -> {b}:")
+                lines.append(f"  {a} acquired at:\n"
+                             f"{self._fmt(held_stack)}")
+                lines.append(
+                    f"  then {b} acquired (holding {a}) at:\n"
+                    f"{self._fmt(acq_stack)}")
+        return "\n".join(lines)
+
+    def assert_clean(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            raise AssertionError(
+                "lock-order witness found potential deadlock "
+                "cycle(s):\n\n"
+                + "\n\n".join(self.explain(c) for c in cycles))
+
+
+class _WitnessLock:
+    """Wraps one real Lock/RLock; identity is the allocation site."""
+
+    def __init__(self, witness: LockOrderWitness, real, site: str,
+                 reentrant: bool):
+        self._witness = witness
+        self._real = real
+        self._site = site
+        self._reentrant = reentrant
+        self._tls = threading.local()
+
+    # allocation-site identity; shown in cycle reports
+    @property
+    def site(self) -> str:
+        return self._site
+
+    def _depth(self, delta: int = 0) -> int:
+        d = getattr(self._tls, "depth", 0) + delta
+        self._tls.depth = d
+        return d
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._witness.note_acquired(self._site, self._depth(+1))
+        return got
+
+    def release(self) -> None:
+        self._real.release()
+        self._depth(-1)
+        self._witness.note_released(self._site)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._real!r} from {self._site}>"
+
+    # -- Condition/Queue duck-type surface ---------------------------
+
+    def _is_owned(self):
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        # plain Lock: Condition's fallback probe
+        if self._real.acquire(False):
+            self._real.release()
+            return False
+        return True
+
+    def _release_save(self):
+        state = self._real._release_save() \
+            if hasattr(self._real, "_release_save") else \
+            (self._real.release() or None)
+        self._tls.depth = 0
+        self._witness.note_released(self._site)
+        return state
+
+    def _acquire_restore(self, state):
+        if hasattr(self._real, "_acquire_restore"):
+            self._real._acquire_restore(state)
+        else:
+            self._real.acquire()
+        self._witness.note_acquired(self._site, self._depth(+1))
+
+    def _at_fork_reinit(self):  # pragma: no cover - fork safety
+        self._real._at_fork_reinit()
+        self._tls = threading.local()
+
+
+def _allocation_site(skip: int = 2) -> str:
+    for frame in reversed(traceback.extract_stack()[:-skip]):
+        fn = frame.filename
+        if "tools/tsdlint/witness" in fn.replace(os.sep, "/"):
+            continue
+        short = fn.replace(os.sep, "/")
+        idx = short.rfind("opentsdb_tpu/")
+        if idx >= 0:
+            short = short[idx:]
+        else:
+            short = os.path.basename(short)
+        return f"{short}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _Installed:
+    """Handle returned by :func:`install`; also a context manager."""
+
+    def __init__(self, witness: LockOrderWitness,
+                 prev_lock, prev_rlock):
+        self.witness = witness
+        # restore what was in place when install() ran — NOT the
+        # import-time originals, or a nested install (a battery
+        # fixture inside a TSD_LOCK_WITNESS=1 run) would permanently
+        # strip the outer witness on teardown
+        self._prev_lock = prev_lock
+        self._prev_rlock = prev_rlock
+
+    def uninstall(self) -> None:
+        threading.Lock = self._prev_lock
+        threading.RLock = self._prev_rlock
+
+    def __enter__(self) -> LockOrderWitness:
+        return self.witness
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def install(witness: LockOrderWitness | None = None) -> _Installed:
+    """Monkeypatch ``threading.Lock``/``RLock`` to produce witnessed
+    locks named by allocation site. Returns a handle whose
+    ``uninstall()`` (or context-manager exit) restores the real
+    factories. Locks created while installed keep reporting to the
+    witness after uninstall — only creation is patched."""
+    witness = witness or LockOrderWitness()
+    prev_lock, prev_rlock = threading.Lock, threading.RLock
+
+    def make_lock():
+        witness.locks_created += 1
+        return _WitnessLock(witness, _REAL_LOCK(),
+                            _allocation_site(), reentrant=False)
+
+    def make_rlock():
+        witness.locks_created += 1
+        return _WitnessLock(witness, _REAL_RLOCK(),
+                            _allocation_site(), reentrant=True)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    return _Installed(witness, prev_lock, prev_rlock)
+
+
+# env-gated opt-in for ad-hoc runs (the batteries install explicitly)
+if os.environ.get("TSD_LOCK_WITNESS", "") not in ("", "0", "false"):
+    _AMBIENT = install()  # pragma: no cover - env-driven

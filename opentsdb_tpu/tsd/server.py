@@ -85,6 +85,11 @@ class ConnectionManager:
                          self.rejected_connections, type="rejected")
         collector.record("connectionmgr.connections", self.idle_closed,
                          type="idle_closed")
+        # handler errors (the reference's ConnectionManager exports
+        # exceptions_unknown; this counter was bumped but never
+        # exported until tsdlint's counter-export pass flagged it)
+        collector.record("connectionmgr.exceptions",
+                         self.exceptions_unknown, type="unknown")
         # refusal counter under its own name so dashboards can alert
         # on it without parsing the connectionmgr.exceptions tag
         collector.record("connections.refused",
@@ -410,6 +415,9 @@ class TSDServer:
                 writer.write(self._refusal_bytes)
                 await asyncio.wait_for(writer.drain(), 1)
             except Exception:  # noqa: BLE001
+                # tsdlint: allow[swallow] best-effort refusal body on
+                # an over-limit connection; the close below is the
+                # real answer and the refusal is already counted
                 pass
             writer.close()
             return
@@ -440,6 +448,9 @@ class TSDServer:
                 writer.close()
                 await writer.wait_closed()
             except Exception:  # noqa: BLE001
+                # tsdlint: allow[swallow] teardown race on an already-
+                # reset connection; the handler's real errors were
+                # logged and counted above
                 pass
 
     # -- telnet --------------------------------------------------------
@@ -536,13 +547,18 @@ class TSDServer:
                 # final coding is chunked (anything else was refused
                 # above). (ref: tsd.http.request_enable_chunked —
                 # default off, HttpQuery rejects chunked with a 400)
-                if not self.tsdb.config.get_bool(
-                        "tsd.http.request_enable_chunked", False):
+                # the reference's dotted spelling, with the old
+                # underscore form as a legacy alias (either enables)
+                if not (self.tsdb.config.get_bool(
+                            "tsd.http.request.enable_chunked", False)
+                        or self.tsdb.config.get_bool(
+                            "tsd.http.request_enable_chunked",
+                            False)):
                     await self._refuse(
                         reader, writer, HttpResponse(
                             400, b'{"error":{"code":400,"message":'
                             b'"Chunked request not supported; set '
-                            b'tsd.http.request_enable_chunked"}}'))
+                            b'tsd.http.request.enable_chunked"}}'))
                     return
                 body, buffer, err = await self._read_chunked(
                     reader, buffer, max_chunk * 64)
@@ -783,6 +799,8 @@ class TSDServer:
                 try:
                     response.body_iter.close()
                 except Exception:  # noqa: BLE001
+                    # tsdlint: allow[swallow] generator close on the
+                    # refused-SSE path; the 400 below is the answer
                     pass
                 response = HttpResponse(
                     400, b'{"error":{"code":400,"message":'

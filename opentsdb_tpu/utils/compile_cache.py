@@ -41,6 +41,22 @@ def enable_compile_cache(cache_dir: str) -> bool:
         os.makedirs(cache_dir, exist_ok=True)
         import jax
 
+        current = getattr(jax.config, "jax_compilation_cache_dir",
+                          None)
+        if current != cache_dir:
+            # jax initializes its cache object lazily on first use
+            # and then IGNORES later jax_compilation_cache_dir
+            # updates — without a reset, entries keep landing in the
+            # first directory ever configured in this process (jax's
+            # CONFIG is the truth here, not our module global: tests
+            # restore the config behind our back)
+            try:
+                from jax._src import compilation_cache as _jax_cc
+                _jax_cc.reset_cache()
+            except Exception as exc:  # noqa: BLE001
+                _log.warning("could not reset jax compilation cache "
+                             "handle (%s); entries may keep writing "
+                             "to %s", exc, current)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_enable_compilation_cache", True)
         # cache everything: on the tunneled TPU even sub-second
@@ -55,6 +71,8 @@ def enable_compile_cache(cache_dir: str) -> bool:
             jax.config.update("jax_persistent_cache_enable_xla_caches",
                               "all")
         except Exception:  # noqa: BLE001 - older jax: knob absent
+            # tsdlint: allow[swallow] optional knob on older jax; the
+            # primary compilation cache is already enabled above
             pass
     except Exception as exc:  # noqa: BLE001
         _log.warning("compile cache disabled: %s", exc)
@@ -74,6 +92,8 @@ def _platform_tag(config) -> str:
     try:
         plat = config.get_string("tsd.tpu.platform", "")
     except Exception:  # noqa: BLE001
+        # tsdlint: allow[swallow] duck-typed config objects in tests
+        # may lack the getter; the env/default fallback below applies
         pass
     plat = plat or os.environ.get("JAX_PLATFORMS", "") or "default"
     return "".join(c if c.isalnum() else "_" for c in plat.lower())

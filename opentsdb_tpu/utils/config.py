@@ -9,8 +9,11 @@ opentsdb.conf files parse unchanged; TPU-specific keys live under
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Iterator
+
+log = logging.getLogger("config")
 
 _DEFAULTS: dict[str, str] = {
     # network (ref: Config.java defaults + src/opentsdb.conf)
@@ -21,7 +24,10 @@ _DEFAULTS: dict[str, str] = {
     "tsd.network.keep_alive": "true",
     "tsd.network.reuse_address": "true",
     # http
-    "tsd.http.request.enable_chunked": "true",
+    # chunked Transfer-Encoding request bodies, the reference's
+    # documented spelling (default off -> 400); the underscore
+    # variant below is read as a legacy alias
+    "tsd.http.request.enable_chunked": "false",
     "tsd.http.request.max_chunk": "1048576",
     "tsd.http.request.cors_domains": "",
     "tsd.http.request.cors_headers": (
@@ -77,8 +83,8 @@ _DEFAULTS: dict[str, str] = {
     # host-RAM prepared-batch cache for host-tail queries (separate
     # pool from device_cache_mb so host entries never evict HBM grids)
     "tsd.query.host_cache_mb": "512",
-    # chunked Transfer-Encoding request bodies (ref: the reference's
-    # tsd.http.request_enable_chunked, default off -> 400)
+    # legacy alias of tsd.http.request.enable_chunked (kept: existing
+    # conf files and tests set it; either spelling enables)
     "tsd.http.request_enable_chunked": "false",
     "tsd.query.timeout": "0",
     "tsd.query.allow_simultaneous_duplicates": "true",
@@ -225,6 +231,128 @@ _SEARCH_PATHS = (
     "/opt/opentsdb/opentsdb.conf",
 )
 
+# ---------------------------------------------------------------------------
+# declared-key registry
+# ---------------------------------------------------------------------------
+# Every ``tsd.*`` key the codebase reads must be DECLARED: either in
+# ``_DEFAULTS`` above, or here (keys whose default lives at the call
+# site), or under a dynamic prefix. The registry is machine-checked
+# two ways: tsdlint's ``config-keys`` pass verifies every
+# ``config.get_*("tsd...")`` literal in the tree resolves here, and
+# ``Config.warn_unknown_keys`` (called at TSDB startup) warns about
+# configured keys nothing will ever read — a typo'd knob used to be
+# silently ignored.
+
+# keys read with a call-site default only (no entry in _DEFAULTS)
+_DECLARED_EXTRA: frozenset[str] = frozenset({
+    # cold tier (opentsdb_tpu/coldstore/)
+    "tsd.coldstore.breaker.failure_threshold",
+    "tsd.coldstore.breaker.reset_timeout_ms",
+    "tsd.coldstore.dir",
+    "tsd.coldstore.enable",
+    # auth / plugins / server
+    "tsd.core.authentication.roles",
+    "tsd.core.authentication.users",
+    "tsd.core.histograms.config",
+    "tsd.core.plugins.enable",
+    "tsd.core.connections.limit",
+    "tsd.core.socket.timeout",
+    "tsd.http.query.allow_delete",
+    "tsd.http.query.stream_threshold_dps",
+    "tsd.http.serializer.plugin",
+    # lifecycle spill knob (read alongside the tsd.lifecycle.* defaults)
+    "tsd.lifecycle.spill_after",
+    # multi-host mesh rendezvous
+    "tsd.mesh.coordinator",
+    "tsd.mesh.init_timeout",
+    "tsd.mesh.num_processes",
+    "tsd.mesh.process_id",
+    # query engine placement / budgets
+    "tsd.query.device_cache_mb",
+    "tsd.query.grid_reduce",
+    "tsd.query.limits.overrides.config",
+    "tsd.query.limits.overrides.interval",
+    "tsd.query.max_device_cells",
+    "tsd.query.mesh",
+    "tsd.query.workers",
+    "tsd.rollups.job.device",
+    # WAL enable/tuning (mode default lives in core/persist.py)
+    "tsd.storage.wal.enable",
+    "tsd.storage.wal.fsync",
+    "tsd.storage.wal.fsync_interval_ms",
+    "tsd.storage.wal.segment_mb",
+    # streaming / continuous queries
+    "tsd.streaming.breaker.failure_threshold",
+    "tsd.streaming.breaker.reset_timeout_ms",
+    "tsd.streaming.buffer_points",
+    "tsd.streaming.enable",
+    "tsd.streaming.heartbeat_s",
+    "tsd.streaming.max_queries",
+    "tsd.streaming.max_windows",
+    "tsd.streaming.publish_min_interval_ms",
+    "tsd.streaming.queue_events",
+    "tsd.streaming.serve",
+    "tsd.streaming.sse.max_lifetime_s",
+    # warmup
+    "tsd.tpu.warmup",
+    "tsd.tpu.warmup.buckets",
+    "tsd.tpu.warmup.budget_s",
+    "tsd.tpu.warmup.percentiles",
+    # plugin slots (read as f"{prefix}.enable"/f"{prefix}.plugin" by
+    # utils/plugin.py for the prefixes TSDB.initialize_plugins and
+    # the HTTP router pass in)
+    "tsd.rtpublisher.enable", "tsd.rtpublisher.plugin",
+    "tsd.search.enable", "tsd.search.plugin",
+    "tsd.core.storage_exception_handler.enable",
+    "tsd.core.storage_exception_handler.plugin",
+    "tsd.core.write_filter.enable", "tsd.core.write_filter.plugin",
+    "tsd.uid.filter.enable", "tsd.uid.filter.plugin",
+    "tsd.core.meta.cache.enable", "tsd.core.meta.cache.plugin",
+    "tsd.http.rpc.enable", "tsd.http.rpc.plugin",
+    # UID auto-assignment allow-patterns (plugins.py DefaultUidFilter)
+    "tsd.uidfilter.metric_patterns",
+    "tsd.uidfilter.tagk_patterns",
+    "tsd.uidfilter.tagv_patterns",
+})
+
+# key families with config-driven tails: any key under these prefixes
+# is declared by construction
+DYNAMIC_KEY_PREFIXES: tuple[str, ...] = (
+    # fault arming: tsd.faults.<site>_<knob> (utils/faults.py — the
+    # SITE half is validated against faults.KNOWN_SITES separately)
+    "tsd.faults.",
+    # per-metric lifecycle overrides:
+    # tsd.lifecycle.policy.<metric>.<retention|demote_after|...>
+    "tsd.lifecycle.policy.",
+)
+
+
+# runtime-registered families: dynamically loaded plugins own their
+# config namespaces (tsd.search.es.host, ...) which no static scan
+# can enumerate — the loader registers each enabled slot's prefix
+_RUNTIME_KEY_PREFIXES: set[str] = set()
+
+
+def register_dynamic_key_prefix(prefix: str) -> None:
+    """Declare a runtime key family (e.g. a plugin's own knobs under
+    its slot prefix) so startup hygiene doesn't flag keys the plugin
+    reads at runtime."""
+    _RUNTIME_KEY_PREFIXES.add(prefix)
+
+
+def declared_keys() -> frozenset[str]:
+    """Every statically-declared ``tsd.*`` key (defaults + call-site
+    defaulted keys). Dynamic families are in
+    :data:`DYNAMIC_KEY_PREFIXES` and the runtime-registered set."""
+    return frozenset(_DEFAULTS) | _DECLARED_EXTRA
+
+
+def is_declared_key(key: str) -> bool:
+    if key in _DEFAULTS or key in _DECLARED_EXTRA:
+        return True
+    return any(key.startswith(p) for p in DYNAMIC_KEY_PREFIXES) or \
+        any(key.startswith(p) for p in _RUNTIME_KEY_PREFIXES)
+
 
 class Config:
     """(ref: src/utils/Config.java:52)"""
@@ -290,6 +418,45 @@ class Config:
 
     def has_property(self, key: str) -> bool:
         return key in self._props
+
+    def _enabled_plugin_prefixes(self) -> list[str]:
+        """Key families owned by plugins THIS config enables: a
+        loaded plugin reads its own knobs at runtime (no static scan
+        can enumerate them), so ``tsd.search.*`` is fair game once
+        ``tsd.search.enable`` is on."""
+        out = []
+        for key in declared_keys():
+            if key.endswith(".plugin"):
+                slot = key[: -len(".plugin")]
+                if self.get_bool(f"{slot}.enable", False):
+                    out.append(slot + ".")
+        return out
+
+    def unknown_keys(self) -> list[str]:
+        """Configured ``tsd.*`` keys nothing in the codebase reads —
+        almost always a typo'd knob (the declared-key registry above
+        is enforced by tsdlint, so an undeclared key really is
+        unread). Keys under an ENABLED plugin slot's prefix are
+        exempt — the plugin owns that namespace."""
+        plugin_prefixes = self._enabled_plugin_prefixes()
+        return sorted(
+            k for k in self._props
+            if k.startswith("tsd.") and not is_declared_key(k)
+            and not any(k.startswith(p) for p in plugin_prefixes))
+
+    def warn_unknown_keys(self, logger: logging.Logger | None = None
+                          ) -> list[str]:
+        """Startup hygiene: log one warning per unknown/misspelled
+        ``tsd.*`` key instead of silently ignoring it. Returns the
+        offending keys (tests assert on it)."""
+        logger = logger or log
+        unknown = self.unknown_keys()
+        for key in unknown:
+            logger.warning(
+                "unknown config key %r is not read by anything and "
+                "will be IGNORED — check for a typo (see "
+                "utils/config.py declared-key registry)", key)
+        return unknown
 
     def override_config(self, key: str, value: Any) -> None:
         """(ref: Config.java:317)"""

@@ -37,11 +37,48 @@ Example arming (config file or ``--tsd.faults...`` flags)::
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+
+# ---------------------------------------------------------------------------
+# fault-site registry
+# ---------------------------------------------------------------------------
+# Every injection site string — ``faults.check("...")`` in code,
+# ``faults.arm("...")`` in tests, ``tsd.faults.<site>_<knob>`` config
+# keys — must resolve here. tsdlint's ``fault-sites`` pass enforces it
+# statically; :meth:`FaultInjector.arm` enforces it at runtime, so a
+# test arming a typo'd site fails instead of silently testing nothing.
+
+KNOWN_SITES: frozenset[str] = frozenset({
+    "wal.fsync",          # core/wal.py fsync leader
+    "wal.append",         # core/wal.py framed write
+    "store",              # store scan path (core + native backends)
+    "store.flush",        # core/persist.py snapshot flush
+    "device.compile",     # query/engine.py device-pipeline entry
+    "rollup.store",       # rollup tier/preagg store scan override
+    "coldstore.read",     # coldstore/store.py segment reads
+    "coldstore.write",    # coldstore/store.py segment spill
+    "tree.store",         # tree/tree.py filing path
+    "meta.store",         # meta/meta_store.py write paths
+    "stream.fold",        # streaming/registry.py incremental fold
+    "lifecycle.sweep",    # lifecycle/manager.py whole sweep
+    "lifecycle.demote",   # lifecycle/manager.py demotion fold
+    "lifecycle.histogram",  # lifecycle/manager.py histogram demotion
+    "cluster.peer",       # cluster/router.py any-peer exchange
+})
+
+# site families with runtime-named tails (per-peer arming)
+DYNAMIC_SITE_PREFIXES: tuple[str, ...] = ("cluster.peer.",)
+
+
+def is_known_site(site: str) -> bool:
+    return site in KNOWN_SITES or \
+        any(site.startswith(p) for p in DYNAMIC_SITE_PREFIXES)
 
 
 class InjectedFault(OSError):
@@ -114,6 +151,14 @@ class FaultInjector:
                     break
             else:
                 continue
+            if not is_known_site(site):
+                # a typo'd site would arm nothing and the fault
+                # battery would silently test nothing — warn loudly
+                # (startup must still come up, so never raise here)
+                logging.getLogger("faults").warning(
+                    "config key %r arms unknown fault site %r — "
+                    "known sites: %s", key, site,
+                    ", ".join(sorted(KNOWN_SITES)))
             point = self._sites.setdefault(site, FaultPoint(site))
             if knob == "error_rate":
                 point.error_rate = float(val)
@@ -127,7 +172,13 @@ class FaultInjector:
 
     def arm(self, site: str, *, error_rate: float = 0.0,
             error_count: int = 0, latency_ms: float = 0.0) -> FaultPoint:
-        """Programmatic arming (tests)."""
+        """Programmatic arming (tests). Unknown sites raise — a test
+        arming a typo'd site would otherwise pass while testing
+        nothing."""
+        if not is_known_site(site):
+            raise ValueError(
+                f"unknown fault site {site!r}; register it in "
+                f"utils/faults.py KNOWN_SITES")
         with self._lock:
             point = FaultPoint(site, error_rate=error_rate,
                                error_count=error_count,

@@ -50,6 +50,10 @@ def load_plugin_instances(config, prefix: str, single: bool = False,
     spec = config.get_string(f"{prefix}.plugin", "")
     if not spec:
         return None if single else []
+    # the plugin owns its slot's config namespace (knobs it reads at
+    # runtime) — register it so startup hygiene never flags them
+    from opentsdb_tpu.utils.config import register_dynamic_key_prefix
+    register_dynamic_key_prefix(f"{prefix}.")
     target = config if init_arg is _MISSING else init_arg
     instances = []
     for path in spec.split(","):

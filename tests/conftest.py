@@ -73,6 +73,25 @@ def _jax_cache_hygiene():
     jax.clear_caches()
 
 
+@pytest.fixture(scope="module")
+def lock_witness():
+    """Runtime lock-order witness (tools/tsdlint/witness.py): every
+    ``threading.Lock``/``RLock`` created while a battery module runs
+    records per-thread acquisition-order pairs; teardown fails the
+    module on any cycle, with both stacks. Opted into by the
+    concurrency and cluster batteries via a module-level autouse
+    fixture — the object graphs under test are built inside tests, so
+    installing at test setup catches every lock that matters."""
+    from opentsdb_tpu.tools.tsdlint import witness as witness_mod
+    handle = witness_mod.install()
+    try:
+        yield handle.witness
+    finally:
+        handle.uninstall()
+        # raises AssertionError with the full two-stack cycle report
+        handle.witness.assert_clean()
+
+
 @pytest.fixture
 def tsdb():
     """A TSDB with auto-create enabled — the BaseTsdbTest analogue

@@ -42,6 +42,16 @@ from opentsdb_tpu.tsd.http_api import (HttpRequest, HttpResponse,
 
 pytestmark = pytest.mark.cluster
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _witnessed(lock_witness):
+    """The chaos battery runs under the runtime lock-order witness:
+    router + spool + breaker + in-process shard locks all record
+    acquisition-order pairs; a cycle fails the module at teardown
+    with both stacks (see conftest)."""
+    return lock_witness
+
+
 BASE = 1356998400
 BASE_MS = BASE * 1000
 

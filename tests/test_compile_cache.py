@@ -42,6 +42,26 @@ def test_cache_writes_entries(tmp_path):
     assert len(glob.glob(os.path.join(d, "*"))) >= 1
 
 
+def test_repointing_cache_dir_takes_effect(tmp_path):
+    """Order-dependence regression: jax initializes its cache object
+    lazily and ignores later dir updates, so before the reset-on-
+    repoint fix a SECOND enable_compile_cache silently kept writing
+    entries into the FIRST directory (surfaced as an order-dependent
+    failure of test_cache_writes_entries after any battery that
+    created a TSDB with a data_dir)."""
+    d1 = str(tmp_path / "one")
+    d2 = str(tmp_path / "two")
+    assert enable_compile_cache(d1)
+    f1 = jax.jit(lambda x: (x * 5.0 - 2.0).sum())
+    f1(jnp.ones((32, 32))).block_until_ready()
+    assert len(glob.glob(os.path.join(d1, "*"))) >= 1
+    assert enable_compile_cache(d2)
+    f2 = jax.jit(lambda x: (x * 7.0 + 3.0).sum())
+    f2(jnp.ones((32, 32))).block_until_ready()
+    assert len(glob.glob(os.path.join(d2, "*"))) >= 1, \
+        "entries kept landing in the first-configured dir"
+
+
 def test_cache_idempotent_and_empty_dir_rejected(tmp_path):
     d = str(tmp_path / "xla2")
     assert enable_compile_cache(d)

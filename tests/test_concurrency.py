@@ -15,6 +15,14 @@ from opentsdb_tpu.query.model import TSQuery
 BASE = 1356998400
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _witnessed(lock_witness):
+    """Run the whole stress battery under the lock-order witness:
+    any inconsistent acquisition order across these threads fails the
+    module at teardown with both stacks (see conftest)."""
+    return lock_witness
+
+
 def _query(t, metric="m.stress"):
     q = TSQuery.from_json({
         "start": BASE * 1000, "end": (BASE + 100_000) * 1000,

@@ -641,7 +641,9 @@ class TestChunkedRequests:
         t = TSDB(Config(**{
             "tsd.core.auto_create_metrics": "true",
             "tsd.tpu.warmup": "false",
-            "tsd.http.request_enable_chunked":
+            # the reference's dotted spelling; the underscore legacy
+            # alias path is covered by test_http_robustness.py
+            "tsd.http.request.enable_chunked":
                 "true" if enable else "false"}))
         srv = TSDServer(t, host="127.0.0.1", port=0)
         loop = asyncio.new_event_loop()

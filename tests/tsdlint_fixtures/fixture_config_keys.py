@@ -1,0 +1,11 @@
+"""tsdlint fixture: one undeclared config key read (line 7); a
+declared key and a dynamic-prefix f-string must stay clean."""
+
+
+class Thing:
+    def read(self, config, metric):
+        bogus = config.get_bool("tsd.htpp.bogus_knob")
+        ok = config.get_int("tsd.network.port", 4242)
+        dyn = config.get_string(
+            f"tsd.lifecycle.policy.{metric}.retention", "")
+        return bogus, ok, dyn

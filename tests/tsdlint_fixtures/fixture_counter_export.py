@@ -1,0 +1,18 @@
+"""tsdlint fixture: one counter bumped but never read (line 12);
+the exported twin (bumped AND read in collect_stats) must stay
+clean."""
+
+
+class Thing:
+    def __init__(self):
+        self.dropped_writes = 0
+        self.exported_writes = 0
+
+    def on_drop(self):
+        self.dropped_writes += 1
+
+    def on_write(self):
+        self.exported_writes += 1
+
+    def collect_stats(self, collector):
+        collector.record("thing.writes", self.exported_writes)

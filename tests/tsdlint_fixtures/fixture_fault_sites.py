@@ -1,0 +1,18 @@
+"""tsdlint fixture: three unregistered fault-site usages — a
+``.check`` literal (line 8), a ``fault_site =`` assignment (line 12)
+and a ``tsd.faults.*`` knob key (line 15); registered sites and the
+dynamic per-peer prefix must stay clean."""
+
+
+def exercise(faults, config):
+    faults.check("bogus.site")
+    faults.check("wal.fsync")
+    faults.check("cluster.peer.shard-7")
+
+    fault_site = "bogus.other"
+
+    config.override_config(
+        "tsd.faults.bogus.third_error_rate", "1.0")
+    config.override_config(
+        "tsd.faults.store.flush_error_count", "2")
+    return fault_site

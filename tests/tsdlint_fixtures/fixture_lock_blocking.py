@@ -1,0 +1,23 @@
+"""tsdlint fixture: exactly one lock-blocking violation (line 12)."""
+import threading
+import time
+
+
+class Thing:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def fine_outside(self):
+        time.sleep(0.1)
+        with self._lock:
+            pass
+
+    def fine_annotated(self):
+        with self._lock:
+            # tsdlint: allow[lock-blocking] fixture: annotated sites
+            # must not fire
+            time.sleep(0.1)

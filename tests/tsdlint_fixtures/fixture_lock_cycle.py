@@ -1,0 +1,31 @@
+"""tsdlint fixture: a lexical ABBA lock cycle (both edges flagged)
+plus one same-lock re-entry on a plain Lock (line 25); the RLock
+re-entry (line 29) must stay clean."""
+import threading
+
+
+class Thing:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._r_lock = threading.RLock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def other(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+
+    def rentry(self):
+        with self._a_lock:
+            with self._a_lock:
+                pass
+
+    def rentry_rlock_ok(self):
+        with self._r_lock:
+            with self._r_lock:
+                pass

@@ -1,0 +1,33 @@
+"""tsdlint fixture: one broad swallow (line 9) and one bare except
+(line 16); a narrow trivial except and an annotated broad one must
+stay clean."""
+
+
+def broad_swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+def bare(fn):
+    try:
+        fn()
+    except:  # noqa: E722
+        return None
+
+
+def narrow_ok(fn):
+    try:
+        fn()
+    except KeyError:
+        pass
+
+
+def annotated_ok(fn):
+    try:
+        fn()
+    except Exception:
+        # tsdlint: allow[swallow] fixture: annotated sites must not
+        # fire
+        pass
