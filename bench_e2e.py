@@ -430,6 +430,171 @@ def bench_live(repeats: int, n_series: int = 5_000,
             "criterion_pass": bool(speedup >= 10.0)}
 
 
+def bench_streamv2(repeats: int, n_ticks: int = 400,
+                   n_points_fold: int = 240_000) -> dict:
+    """Streaming engine v2: (1) durable per-point ingest p50 with
+    0 / 10 / 50 standing tumbling CQs over the ingested metric — the
+    tap is an O(1) enqueue into shared partials and folds run on the
+    worker pool, so the 50-CQ tax must stay <= 1.25x the zero-CQ
+    p50; (2) shared-plan fold scaling — total fold time for 16 CQs
+    sharing one (metric, downsample) <= 2x a single CQ's (one
+    partial array serves all 16); (3) sliding-window serve p50 from
+    the maintained partials; (4) a tier-seeded bootstrap serving a
+    pre-demotion-boundary window incrementally (no batch fallback)."""
+    import shutil
+    import tempfile
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.query.model import TSQuery
+
+    end_ms = BASE_MS + 1800 * 1000
+    fns = ["1m-sum", "1m-avg", "1m-max", "1m-min", "1m-count",
+           "2m-sum", "2m-avg", "2m-max", "2m-min", "2m-count"]
+    aggs = ["sum", "avg", "max", "min", "sum"]
+
+    def qobj(i=0, ds=None):
+        return {"start": BASE_MS, "end": end_ms, "queries": [
+            {"metric": "sys.sv2", "aggregator": aggs[i % len(aggs)],
+             "downsample": ds or fns[i % len(fns)]}]}
+
+    # --- (1) durable ingest tax at 0 / 10 / 50 standing CQs
+    def ingest_p50_us(n_cqs: int) -> float:
+        d = tempfile.mkdtemp(prefix="sv2bench-")
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.storage.backend": "memory",
+            "tsd.storage.data_dir": d}))
+        try:
+            for i in range(n_cqs):
+                t.streaming.register(qobj(i), now_ms=end_ms)
+            best = None
+            for _ in range(max(repeats, 3)):
+                times = []
+                for i in range(n_ticks):
+                    t0 = time.perf_counter()
+                    t.add_point("sys.sv2", BASE_S + i, 1.0,
+                                {"host": f"h{i % 8:02d}"})
+                    times.append(time.perf_counter() - t0)
+                p50 = _percentile(times, 50) * 1e6
+                best = p50 if best is None else min(best, p50)
+            return best
+        finally:
+            t.shutdown()
+            shutil.rmtree(d, ignore_errors=True)
+
+    p50_0 = ingest_p50_us(0)
+    p50_10 = ingest_p50_us(10)
+    p50_50 = ingest_p50_us(50)
+    tax_10 = p50_10 / max(p50_0, 1e-3)
+    tax_50 = p50_50 / max(p50_0, 1e-3)
+
+    # --- (2) shared-plan fold scaling: 1 CQ vs 16 CQs, same
+    # (metric, downsample) — workers off so the drain is timed
+    # deterministically on this thread
+    def fold_time_s(n_cqs: int) -> float:
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.streaming.workers.count": "0",
+            "tsd.streaming.buffer_points": str(1 << 30),
+            "tsd.streaming.workers.max_pending_points":
+                str(1 << 30)}))
+        reg = t.streaming
+        for i in range(n_cqs):
+            obj = qobj(0)
+            obj["id"] = f"f{i}"
+            reg.register(obj, now_ms=end_ms)
+        rng = np.random.default_rng(3)
+        n_series = 64
+        per = n_points_fold // n_series
+        ts = BASE_MS + (np.arange(per, dtype=np.int64) * 1800_000
+                        // per)
+        best = None
+        for _ in range(max(repeats, 3)):
+            for g in reg._partials:
+                g.take_pending()
+            for i in range(n_series):
+                t.add_points("sys.sv2", ts + i % 7,
+                             rng.normal(100, 10, per),
+                             {"host": f"h{i:03d}"})
+            groups = list(reg._partials)
+            t0 = time.perf_counter()
+            for g in groups:
+                reg._drain_group(g)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        folded = sum(g.points_folded for g in reg._partials)
+        assert folded >= n_points_fold, folded
+        return best
+
+    fold_1 = fold_time_s(1)
+    fold_16 = fold_time_s(16)
+    fold_ratio = fold_16 / max(fold_1, 1e-9)
+
+    # --- (3) sliding-window serve p50 from maintained partials
+    t = TSDB(Config(**{"tsd.core.auto_create_metrics": "true"}))
+    rng = np.random.default_rng(5)
+    ts = np.arange(BASE_S, BASE_S + 1800, 2, dtype=np.int64)
+    for i in range(200):
+        t.add_points("sys.sv2", ts, rng.normal(100, 10, len(ts)),
+                     {"host": f"h{i:03d}"})
+    cq = t.streaming.register(
+        dict(qobj(0, ds="1m-sum"),
+             window={"type": "sliding", "size": "5m"}),
+        now_ms=end_ms)
+    t.streaming.current_results(cq, now_ms=end_ms)  # warm the tail
+    sliding = []
+    for r in range(max(repeats, 5)):
+        t.add_point("sys.sv2", BASE_S + 1700 + r, 1.0,
+                    {"host": "h000"})
+        t0 = time.perf_counter()
+        rows = t.streaming.current_results(cq, now_ms=end_ms)
+        sliding.append(time.perf_counter() - t0)
+        assert rows and rows[0]["dps"]
+    sliding_p50 = _percentile(sliding, 50) * 1e3
+
+    # --- (4) tier-seeded bootstrap: pre-boundary window serves
+    # incrementally (no batch fallback)
+    tl = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.rollups.enable": "true",
+        "tsd.lifecycle.enable": "true",
+        "tsd.lifecycle.demote_after": "30m",
+        "tsd.lifecycle.demote_tiers": "1m"}))
+    span = 7200
+    now_ms = BASE_MS + span * 1000
+    ts = np.arange(BASE_S, BASE_S + span, 5, dtype=np.int64)
+    for i in range(4):
+        tl.add_points("sys.sv2", ts, rng.normal(100, 10, len(ts)),
+                      {"host": f"h{i}"})
+    tl.lifecycle.sweep(now_ms=now_ms)
+    reg = tl.streaming
+    reg.register({"start": BASE_MS, "end": now_ms, "queries": [
+        {"metric": "sys.sv2", "aggregator": "sum",
+         "downsample": "5m-avg"}]}, now_ms=now_ms)
+    tsq = TSQuery.from_json({
+        "start": BASE_MS, "end": now_ms, "queries": [
+            {"metric": "sys.sv2", "aggregator": "sum",
+             "downsample": "5m-avg"}]}).validate()
+    tl.execute_query(tsq)
+    tier_ok = bool(reg.serve_hits == 1 and reg.serve_fallbacks == 0
+                   and reg._partials[0].tier_seeded)
+
+    return {"config": "streamv2",
+            "ingest_p50_us_0cq": round(p50_0, 1),
+            "ingest_p50_us_10cq": round(p50_10, 1),
+            "ingest_p50_us_50cq": round(p50_50, 1),
+            "ingest_tax_10cq": round(tax_10, 3),
+            "ingest_tax_50cq": round(tax_50, 3),
+            "fold_s_1cq": round(fold_1, 4),
+            "fold_s_16cq_shared": round(fold_16, 4),
+            "fold_scaling_16cq": round(fold_ratio, 2),
+            "fold_points": n_points_fold,
+            "sliding_serve_p50_ms": round(sliding_p50, 2),
+            "tier_seeded_preboundary_serve": tier_ok,
+            "criterion_pass": bool(tax_50 <= 1.25
+                                   and fold_ratio <= 2.0
+                                   and tier_ok)}
+
+
 def bench_lifecycle(repeats: int, n_series: int = 2000,
                     span_s: int = 7200) -> dict:
     """Aged-store lifecycle config: n_series x span @1s raw, a
@@ -1101,7 +1266,7 @@ def main() -> None:
                "wal": bench_wal, "live": bench_live,
                "lifecycle": bench_lifecycle, "cold": bench_cold,
                "ingest": bench_ingest, "viz": bench_viz,
-               "cluster": bench_cluster}
+               "cluster": bench_cluster, "streamv2": bench_streamv2}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

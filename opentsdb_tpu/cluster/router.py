@@ -147,6 +147,26 @@ class ClusterRouter:
         self.cache_hits = 0
         self.cache_stores = 0
         self.cache_degraded_skips = 0
+        # per-(peer, metric) known/unknown memo: a shard that 400'd
+        # "no such name" for a metric is not re-asked about it on
+        # every later query — its sub is pre-filtered out of the
+        # scatter (and of the per-sub retry), so the steady state for
+        # a multi-sub query over partially-known shards is ONE
+        # request per shard. Invalidated when a write for the metric
+        # is forwarded to that peer (UID creation happens on the
+        # shard's write path) and peer-wide when a spool replay lands
+        # (spooled writes create UIDs long after their ack); a TTL
+        # knob covers deployments where writes can bypass this router.
+        self._sub_memo_lock = threading.Lock()
+        # (peer, metric) -> (cached no-such-name 400 body, stamp);
+        # holds ONLY unknown outcomes — absence means "known or
+        # never asked", so the dict is bounded by actual negative
+        # knowledge, not by peers x all metrics
+        self._sub_memo: dict[tuple[str, str], tuple] = {}
+        self.sub_memo_ttl_s = config.get_float(
+            "tsd.cluster.sub_memo.ttl_ms", 0.0) / 1000.0
+        self.sub_memo_skips = 0        # subs pre-filtered from scatters
+        self.sub_memo_invalidations = 0
         # per-metric invalidation versions for the result cache (see
         # write_version): bumped AFTER a write/delete lands so a
         # racing query can never cache pre-write data under the
@@ -247,6 +267,69 @@ class ClusterRouter:
                 wait_s = deadline - time.monotonic()
 
     # ------------------------------------------------------------------
+    # per-(peer, metric) known/unknown memo (see __init__)
+    # ------------------------------------------------------------------
+
+    def _memo_lookup(self, peer_name: str, metric: str):
+        """The cached no-such-name 400 body for (peer, metric), or
+        None when the peer is not known-unknown for it. The memo
+        holds ONLY unknown entries (a known metric simply has no
+        entry — storing positives would grow the dict by peers x
+        all-metrics with nothing ever reading them); expired entries
+        evict on read when a TTL is configured."""
+        key = (peer_name, metric)
+        with self._sub_memo_lock:
+            ent = self._sub_memo.get(key)
+            if ent is None:
+                return None
+            body, stamp = ent
+            if self.sub_memo_ttl_s > 0 and \
+                    time.monotonic() - stamp > self.sub_memo_ttl_s:
+                del self._sub_memo[key]
+                return None
+            return body
+
+    def _memo_known(self, peer_name: str, metrics) -> None:
+        """A definite 200 disproves any cached unknown — drop it
+        (no positive entry is stored; absence IS 'known')."""
+        with self._sub_memo_lock:
+            for m in metrics:
+                self._sub_memo.pop((peer_name, m), None)
+
+    def _memo_unknown(self, peer_name: str, metric: str,
+                      body: bytes) -> None:
+        """Cache one peer's metric-unknown 400 — ONLY when the body
+        is the engine's no-such-name rejection: any other 400 is a
+        query-shape error that must not poison later,
+        differently-shaped queries over the same metric."""
+        if not metric or b"no such name" not in body.lower():
+            return
+        with self._sub_memo_lock:
+            self._sub_memo[(peer_name, metric)] = \
+                (body, time.monotonic())
+
+    def invalidate_sub_memo(self, peer_name: str,
+                            metrics=None) -> None:
+        """Drop UNKNOWN entries for a peer (all of them, or just the
+        given metrics): called when a write batch is dispatched to
+        the peer (the shard's write path mints the UID — the metric
+        is about to be known) and peer-wide when a spool replay
+        lands (spooled writes create UIDs long after their ack).
+        Known entries never invalidate — a metric that vanishes
+        server-side (UID reclamation) re-404s through the normal
+        per-sub retry and re-memoizes."""
+        with self._sub_memo_lock:
+            if metrics is not None:
+                stale = [(peer_name, m) for m in set(metrics)
+                         if (peer_name, m) in self._sub_memo]
+            else:
+                stale = [k for k in self._sub_memo
+                         if k[0] == peer_name]
+            for k in stale:
+                del self._sub_memo[k]
+            self.sub_memo_invalidations += len(stale)
+
+    # ------------------------------------------------------------------
     # write path
     # ------------------------------------------------------------------
 
@@ -336,6 +419,12 @@ class ClusterRouter:
         the next dependent write's dispatch; batches concurrently in
         flight during the failure window are unordered, as
         concurrent writes always are)."""
+        # whether this batch forwards or spools, the shard's write
+        # path will mint these metrics' UIDs (now, or at replay —
+        # which invalidates peer-wide again): the scatter may ask
+        # about them from here on
+        self.invalidate_sub_memo(peer.name,
+                                 {dp["metric"] for dp in dps})
         body = json.dumps(dps).encode()
         with peer.lock:
             direct = (peer.spool.pending_records == 0
@@ -467,8 +556,11 @@ class ClusterRouter:
             # replayed history just LANDED on the shard, long after
             # its ack: a complete answer cached while the backlog was
             # pending is stale NOW (the write-time bump happened at
-            # spool time, before this data was readable)
+            # spool time, before this data was readable) — and the
+            # shard may know metrics it 400'd while the backlog was
+            # pending (replay-created UIDs), so unknown memos go too
             self._bump_global_version()
+            self.invalidate_sub_memo(peer.name)
             LOG.info("replayed %d spooled batch(es) to %s (%d "
                      "pending)", n, peer.name,
                      peer.spool.pending_records)
@@ -553,16 +645,47 @@ class ClusterRouter:
             "useCalendar": tsq.use_calendar,
             "delete": tsq.delete,
         }
+        # per-peer scatter plan through the known/unknown memo: subs
+        # whose metric a peer has already 400'd "no such name" for
+        # are pre-filtered out of that peer's request (their cached
+        # 400 still joins the all-shards-agree check), so the steady
+        # state over partially-known shards is one request per shard.
+        # Deletes bypass the memo: a stale unknown entry must never
+        # silently skip a purge.
+        use_memo = not tsq.delete
         body = json.dumps(peer_obj).encode()
-        futures = {
-            name: self.pool.submit(self._query_peer, peer, body)
-            for name, peer in self.peers.items()}
+        peer_sent: dict[str, list[int]] = {}
         per_peer: dict[str, list[dict]] = {}
         degraded: list[str] = []
         # expanded-sub index -> 4xx bodies, one per rejecting peer
         sub_400: dict[int, list[bytes]] = {}
+        futures = {}
+        for name, peer in self.peers.items():
+            skip: dict[int, bytes] = {}
+            if use_memo:
+                for k, sj in enumerate(peer_subs):
+                    cached = self._memo_lookup(
+                        name, sj.get("metric") or "")
+                    if cached is not None:
+                        skip[k] = cached
+            sent = [k for k in range(len(peer_subs)) if k not in skip]
+            peer_sent[name] = sent
+            if skip:
+                self.sub_memo_skips += len(skip)
+                for k, cached in skip.items():
+                    sub_400.setdefault(k, []).append(cached)
+            if not sent:
+                per_peer[name] = []  # nothing this shard knows
+                continue
+            pbody = body if len(sent) == len(peer_subs) \
+                else json.dumps(dict(
+                    peer_obj,
+                    queries=[peer_subs[k] for k in sent])).encode()
+            futures[name] = self.pool.submit(self._query_peer, peer,
+                                             pbody)
         for name, fut in futures.items():
             peer = self.peers[name]
+            sent = peer_sent[name]
             try:
                 status, data = fut.result(
                     timeout=self.timeout_s * 2 + 5)
@@ -574,10 +697,25 @@ class ClusterRouter:
                 continue
             if status == 200:
                 try:
-                    per_peer[name] = json.loads(data)
+                    rows = json.loads(data)
                 except ValueError:
                     peer.query_failures += 1
                     degraded.append(name)
+                    continue
+                if len(sent) != len(peer_subs):
+                    # trimmed request: peer-local sub indexes map
+                    # back to the expanded scatter's
+                    for r in rows:
+                        q = r.get("query")
+                        if isinstance(q, dict) and \
+                                isinstance(q.get("index"), int) \
+                                and 0 <= q["index"] < len(sent):
+                            q["index"] = sent[q["index"]]
+                per_peer[name] = rows
+                if use_memo:
+                    self._memo_known(
+                        name, {peer_subs[k].get("metric")
+                               for k in sent})
                 continue
             if status != 400:
                 # 413 (scan budget), 404/405 (not a TSD query
@@ -597,16 +735,23 @@ class ClusterRouter:
             # the metric 400s with "no such name" — an empty partial,
             # not peer damage and not a client error (other shards
             # may own it). Kept for the all-shards-agree check below.
-            if len(peer_subs) == 1:
-                sub_400.setdefault(0, []).append(data)
+            if len(sent) == 1:
+                sub_400.setdefault(sent[0], []).append(data)
                 per_peer[name] = []
+                if use_memo:
+                    self._memo_unknown(
+                        name, peer_subs[sent[0]].get("metric") or "",
+                        data)
                 continue
             # multi-sub scatter: the request-level 400 hides WHICH
             # sub the peer rejected — and blanks subs it DOES own
-            # series for. Re-issue each expanded sub alone and keep
-            # the ones that answer.
-            rows, died = self._per_sub_retry(peer, peer_obj,
-                                             peer_subs, sub_400)
+            # series for. Re-issue each still-unmemoized expanded
+            # sub alone, keep the ones that answer, and memoize
+            # every definite outcome so the NEXT query scatters once.
+            rows, died = self._per_sub_retry(
+                peer, peer_obj,
+                [(k, peer_subs[k]) for k in sent], sub_400,
+                memoize=use_memo)
             per_peer[name] = rows
             if died:
                 peer.query_failures += 1
@@ -659,13 +804,18 @@ class ClusterRouter:
         return self._apply_pixels(tsq, results), sorted(degraded)
 
     def _per_sub_retry(self, peer: Peer, peer_obj: dict,
-                       peer_subs: list[dict],
-                       sub_400: dict[int, list[bytes]]
+                       indexed_subs: list[tuple[int, dict]],
+                       sub_400: dict[int, list[bytes]],
+                       memoize: bool = True
                        ) -> tuple[list[dict], bool]:
         """Re-scatter each expanded sub alone to a peer that 400'd
-        the combined request. Returns (result rows with their sub
-        index restored, peer-died flag). Per-sub 4xx bodies land in
-        ``sub_400`` for the all-shards-agree check.
+        the combined request. ``indexed_subs`` carries each sub with
+        its expanded-scatter index (memo pre-filtering may have
+        trimmed the set). Returns (result rows with their sub index
+        restored, peer-died flag). Per-sub 4xx bodies land in
+        ``sub_400`` for the all-shards-agree check, and every
+        definite outcome (200 / no-such-name 400) is memoized so the
+        next query's scatter pre-filters instead of re-asking.
 
         A peer that dies partway contributes NOTHING — not the rows
         it already answered: an avg expands to sum+count twins, and
@@ -673,13 +823,13 @@ class ClusterRouter:
         make every merged value WRONG (inflated), not merely
         incomplete. Missing beats wrong; the degraded marker tells
         the truth either way."""
-        futs = [(k, self.pool.submit(
+        futs = [(k, sj, self.pool.submit(
                     self._query_peer, peer,
                     json.dumps(dict(peer_obj, queries=[sj])).encode()))
-                for k, sj in enumerate(peer_subs)]
+                for k, sj in indexed_subs]
         rows: list[dict] = []
         died = False
-        for k, fut in futs:
+        for k, sj, fut in futs:
             try:
                 status, data = fut.result(
                     timeout=self.timeout_s * 2 + 5)
@@ -690,6 +840,9 @@ class ClusterRouter:
                 continue
             if status == 400:
                 sub_400.setdefault(k, []).append(data)
+                if memoize:
+                    self._memo_unknown(peer.name,
+                                       sj.get("metric") or "", data)
                 continue
             if status != 200:
                 # same rule as the combined scatter: a non-400
@@ -701,6 +854,8 @@ class ClusterRouter:
             except ValueError:
                 died = True
                 continue
+            if memoize:
+                self._memo_known(peer.name, {sj.get("metric")})
             for r in part:
                 q = r.get("query")
                 if isinstance(q, dict):
@@ -867,6 +1022,9 @@ class ClusterRouter:
             "cache_hits": self.cache_hits,
             "cache_stores": self.cache_stores,
             "cache_degraded_skips": self.cache_degraded_skips,
+            "sub_memo_entries": len(self._sub_memo),
+            "sub_memo_skips": self.sub_memo_skips,
+            "sub_memo_invalidations": self.sub_memo_invalidations,
             "spool_backlog_records": sum(
                 p.spool.pending_records for p in self.peers.values()),
             "peers": {name: peer.health_info()
@@ -879,6 +1037,10 @@ class ClusterRouter:
                          self.degraded_queries)
         collector.record("cluster.cache_degraded_skips",
                          self.cache_degraded_skips)
+        collector.record("cluster.sub_memo.skips",
+                         self.sub_memo_skips)
+        collector.record("cluster.sub_memo.invalidations",
+                         self.sub_memo_invalidations)
         for name, p in sorted(self.peers.items()):
             collector.record("cluster.forwarded_points",
                              p.forwarded_points, peer=name)

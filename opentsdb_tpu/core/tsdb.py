@@ -388,6 +388,10 @@ class TSDB:
             self.wal.sync()
         self.datapoints_added += 1
         if self._streaming is not None:
+            # streaming v2 tap: an O(1) columnar enqueue into the
+            # metric's shared partial buffers — folds run on the
+            # shared worker pool, never here (a lagging plan degrades
+            # to rebuild-on-serve instead of slowing this path)
             self._run_hook("stream.tap", self._streaming.offer,
                            metric_id, sid, ts_ms, fval)
         tsuid = (self.uids.tsuid(metric_id, tag_ids)

@@ -1,0 +1,116 @@
+"""Batched fold / window-combine kernels for the streaming engine v2.
+
+One shared partial array (sum/count/min/max per (series, bucket) cell,
+:mod:`opentsdb_tpu.streaming.plan`) is maintained by ONE vectorized
+scatter fold per ingest batch and then serves every continuous query
+attached to it — the multi-query plan-sharing core: fold cost is per
+*partial array*, not per standing query, so N same-metric dashboards
+cost one fold.
+
+The window combines layer on the same decomposition rule the rollup
+tiers use (``rollup/job.py``: sums of sums, counts of counts, mins of
+mins, maxs of maxs; ``avg`` derives as sum/count at read time):
+
+- :func:`combine_stride` — a view whose downsample interval is a
+  multiple of the shared base interval derives its buckets by
+  combining ``stride`` contiguous base buckets (downsample-divisible
+  plan sharing).
+- :func:`combine_sliding` — sliding windows: each output bucket
+  aggregates the ``k`` trailing buckets ending at it (window size =
+  k x interval, slide = interval). Windowed sums use an explicit
+  window view (not cumsum differences) so summation order matches a
+  direct per-window fold bit for bit.
+- :func:`session_grid` — session-gap windows: consecutive non-empty
+  buckets whose edge distance is <= ``gap_ms`` merge into one
+  session; the session aggregate lands on the session's FIRST bucket
+  edge, other buckets are empty.
+
+All kernels are host-side numpy by design: they run off the ingest
+path on the shared fold workers (or in a dashboard-sized serve tail),
+matching the placement idiom of the v1 incremental plans — the
+device pipeline stays reserved for the batch engine's large scans.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from numpy.lib.stride_tricks import sliding_window_view
+
+STATS = ("sum", "count", "min", "max")
+
+
+def scatter_fold(sums: np.ndarray, cnts: np.ndarray, mins: np.ndarray,
+                 maxs: np.ndarray, slots: np.ndarray, cols: np.ndarray,
+                 vals: np.ndarray) -> None:
+    """Fold one batch of points into the shared partial ring IN
+    PLACE: one unbuffered scatter per stat channel. ``slots`` are
+    member row indices, ``cols`` ring columns, ``vals`` the values —
+    all filtered to live buckets by the caller."""
+    np.add.at(sums, (slots, cols), vals)
+    np.add.at(cnts, (slots, cols), 1.0)
+    np.minimum.at(mins, (slots, cols), vals)
+    np.maximum.at(maxs, (slots, cols), vals)
+
+
+def combine_stride(sums: np.ndarray, cnts: np.ndarray,
+                   mins: np.ndarray, maxs: np.ndarray, stride: int):
+    """[S, B*stride] base-bucket channels -> [S, B] view-bucket
+    channels by combining each run of ``stride`` contiguous base
+    buckets (sum/sum/min/max — exact for the decomposable stats)."""
+    if stride <= 1:
+        return sums, cnts, mins, maxs
+    s, n = sums.shape
+    b = n // stride
+
+    def rs(a):
+        return a.reshape(s, b, stride)
+
+    return (rs(sums).sum(axis=2), rs(cnts).sum(axis=2),
+            rs(mins).min(axis=2), rs(maxs).max(axis=2))
+
+
+def combine_sliding(sums: np.ndarray, cnts: np.ndarray,
+                    mins: np.ndarray, maxs: np.ndarray, k: int):
+    """Trailing-window combine: output bucket ``j`` aggregates input
+    buckets ``max(0, j-k+1) .. j`` (leading outputs see a clipped
+    window). Identity channels pad with 0 / +-inf so a clipped window
+    equals a direct fold over its available buckets."""
+    if k <= 1:
+        return sums, cnts, mins, maxs
+    s = sums.shape[0]
+
+    def trail(a, fill, reduce):
+        pad = np.concatenate(
+            [np.full((s, k - 1), fill, dtype=a.dtype), a], axis=1)
+        return reduce(sliding_window_view(pad, k, axis=1), -1)
+
+    return (trail(sums, 0.0, np.sum), trail(cnts, 0.0, np.sum),
+            trail(mins, np.inf, np.min), trail(maxs, -np.inf, np.max))
+
+
+def session_grid(sums: np.ndarray, cnts: np.ndarray, mins: np.ndarray,
+                 maxs: np.ndarray, edges: np.ndarray, gap_ms: int):
+    """Session-gap combine: per series, runs of non-empty buckets
+    whose consecutive edge distance is <= ``gap_ms`` merge into one
+    session whose aggregate lands on the run's FIRST bucket; every
+    other bucket comes back empty. Sessions are delimited within the
+    supplied range (a session truncated by the range edge aggregates
+    its visible part)."""
+    out_s = np.zeros_like(sums)
+    out_c = np.zeros_like(cnts)
+    out_min = np.full_like(mins, np.inf)
+    out_max = np.full_like(maxs, -np.inf)
+    present = cnts > 0
+    for s in range(sums.shape[0]):
+        idx = np.nonzero(present[s])[0]
+        if not len(idx):
+            continue
+        # a new session starts where the edge gap exceeds gap_ms
+        breaks = np.diff(edges[idx]) > gap_ms
+        starts = np.concatenate([[0], np.nonzero(breaks)[0] + 1])
+        first = idx[starts]
+        out_s[s, first] = np.add.reduceat(sums[s, idx], starts)
+        out_c[s, first] = np.add.reduceat(cnts[s, idx], starts)
+        out_min[s, first] = np.minimum.reduceat(mins[s, idx], starts)
+        out_max[s, first] = np.maximum.reduceat(maxs[s, idx], starts)
+    return out_s, out_c, out_min, out_max

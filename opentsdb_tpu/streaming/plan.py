@@ -1,24 +1,44 @@
-"""Incremental window state for one continuous sub-query.
+"""Shared incremental window state + per-query views (streaming v2).
 
-A standing sub-query compiles into tumbling windows aligned to its
-downsample interval. Each window keeps per-series PARTIAL aggregates —
-sum/count/min/max, with ``avg`` derived as sum/count at read time —
-the same decomposition the rollup tiers use (``rollup/job.py``,
-ref: RollupConfig sum+count qualifiers). Ingest folds new points into
-the partials with vectorized scatters, so maintaining the query costs
-O(new points); a refresh then derives the [S, B] downsampled grid from
-the partials and runs ONLY the existing fill/rate/interpolate/
-aggregate tail (:func:`opentsdb_tpu.ops.pipeline.execute_grid`) — the
-store is never re-scanned. Because the tail is the same compiled
-kernel chain the batch engine's grid path runs, maintained results are
-value-identical to a cold ``/api/query`` over the same bucket-aligned
-range (asserted by the streaming oracle battery).
+v1 compiled every continuous sub-query into its own independent
+partial array and folded it inline on the write path. v2 splits that
+into two layers:
+
+- :class:`SharedPartial` — ONE ring of per-series sum/count/min/max
+  partials per canonical sub-plan identity ``(metric, membership
+  filters, base downsample interval)``. Every continuous query over
+  the same metric whose filters match and whose downsample interval
+  is a multiple of the base attaches to the same array, so one
+  vectorized scatter fold (:mod:`opentsdb_tpu.ops.stream_fold`)
+  serves N dashboards. The ingest tap is an O(1) columnar append
+  into the partial's pending buffer (its own small lock, never the
+  fold lock); folding happens off-path on the shared worker pool
+  (:mod:`opentsdb_tpu.streaming.workers`) or lazily at serve time.
+- :class:`PlanView` — one per registered sub-query: derives its
+  downsampled grid from the shared channels (stride combine for
+  divisible intervals), applies its window type (tumbling, sliding,
+  session-gap — view-time combines over the tumbling partials, the
+  same sum/count/min/max decomposition the rollup tiers use), then
+  runs ONLY the existing fill/rate/interpolate/aggregate tail
+  (:func:`opentsdb_tpu.ops.pipeline.execute_grid`). Tumbling views
+  stay value-identical to a cold batch ``/api/query`` over the same
+  bucket-aligned range; sliding/session views are push/fetch
+  surfaces (they are not expressible as a plain TSQuery).
+
+Bootstrap seeds the ring with one ``bucket_reduce`` pass. When the
+metric has a lifecycle demotion boundary inside the ring's horizon,
+the pre-boundary part seeds from the rollup/cold tiers through the
+four per-stat :class:`~opentsdb_tpu.lifecycle.stitch.StitchedStore`
+views (sums from the sum tier, counts from the count tier, extremes
+from min/max) instead of declining those windows to the batch engine
+— tier cells nest exactly inside the plan's buckets when the tier
+interval divides the base interval and the boundary is tier-aligned.
 
 Windows live in a ring of ``n_windows`` columns keyed by
 ``(bucket_ts // interval) % n_windows``; a point landing in a newer
 bucket than a column holds tumbles that column (reset + re-key), and
-points older than the ring's horizon are dropped and counted (they can
-no longer affect any servable window).
+points older than the ring's horizon are dropped and counted (they
+can no longer affect any servable window).
 """
 
 from __future__ import annotations
@@ -29,8 +49,10 @@ from typing import Any
 import numpy as np
 
 from opentsdb_tpu.ops import downsample as ds_mod
+from opentsdb_tpu.ops import stream_fold
 from opentsdb_tpu.query import filters as filters_mod
-from opentsdb_tpu.query.model import TSSubQuery
+from opentsdb_tpu.query.model import BadRequestError, TSSubQuery
+from opentsdb_tpu.utils import datetime_util
 
 # downsample functions whose bucket statistic decomposes into the
 # sum/count/min/max partials this plan maintains (avg = sum / count) —
@@ -41,20 +63,120 @@ DECOMPOSABLE_DS = frozenset(("sum", "zimsum", "pfsum", "count", "min",
 
 _GROW = 64  # initial / doubling row capacity for the partial arrays
 
+# per-statistic tier stores one demoted interval spans (rollup/job.py)
+_TIER_AGGS = ("sum", "count", "min", "max")
 
-class IncrementalSubPlan:
-    """Partial-aggregate window ring for one sub-query (see module
-    docstring). Thread-safe: every mutation happens under ``lock``."""
+WINDOW_KINDS = ("tumbling", "sliding", "session")
 
-    def __init__(self, tsdb, sub: TSSubQuery, n_windows: int):
+
+class WindowSpec:
+    """Window type of one continuous query: tumbling (default),
+    sliding (``{"type": "sliding", "size": "5m"}`` — size must be a
+    multiple of the downsample interval; each emitted bucket
+    aggregates the trailing ``size`` of history, sliding by one
+    interval) or session-gap (``{"type": "session", "gap": "2m"}`` —
+    gap must be a multiple of the interval; buckets closer than the
+    gap merge into one session stamped at its first bucket)."""
+
+    __slots__ = ("kind", "size_ms", "gap_ms")
+
+    def __init__(self, kind: str = "tumbling", size_ms: int = 0,
+                 gap_ms: int = 0):
+        self.kind = kind
+        self.size_ms = int(size_ms)
+        self.gap_ms = int(gap_ms)
+
+    @classmethod
+    def from_json(cls, obj, interval_ms: int) -> "WindowSpec":
+        """Validate one ``window`` object against a sub-query's
+        downsample interval; raises :class:`BadRequestError`."""
+        if obj in (None, {}):
+            return cls()
+        if not isinstance(obj, dict):
+            raise BadRequestError("window must be an object")
+        kind = str(obj.get("type", "tumbling"))
+        if kind not in WINDOW_KINDS:
+            raise BadRequestError(
+                f"unknown window type {kind!r} "
+                f"(supported: {', '.join(WINDOW_KINDS)})")
+
+        def duration(key: str) -> int:
+            raw = obj.get(key)
+            if not raw:
+                raise BadRequestError(
+                    f"{kind} window requires {key!r} (e.g. \"5m\")")
+            try:
+                ms = datetime_util.parse_duration_ms(str(raw))
+            except ValueError as e:
+                raise BadRequestError(str(e)) from None
+            if ms <= 0 or ms % interval_ms:
+                raise BadRequestError(
+                    f"window {key} {raw!r} must be a positive "
+                    f"multiple of the downsample interval "
+                    f"({interval_ms} ms)")
+            return ms
+
+        if kind == "sliding":
+            size = duration("size")
+            if size <= interval_ms:
+                raise BadRequestError(
+                    "sliding window size must exceed the downsample "
+                    "interval (equal would be tumbling)")
+            return cls("sliding", size_ms=size)
+        if kind == "session":
+            return cls("session", gap_ms=duration("gap"))
+        return cls()
+
+    def lead_for(self, interval_ms: int) -> int:
+        """Extra trailing-history buckets a full leading window
+        needs (sliding only)."""
+        return (self.size_ms // interval_ms - 1) \
+            if self.kind == "sliding" else 0
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"type": self.kind}
+        if self.size_ms:
+            out["sizeMs"] = self.size_ms
+        if self.gap_ms:
+            out["gapMs"] = self.gap_ms
+        return out
+
+
+def filter_identity(sub: TSSubQuery) -> tuple:
+    """Canonical MEMBERSHIP identity of a sub-query's filter set: the
+    ``groupBy`` flag only affects result grouping (a view-time
+    concern), not which series belong to the partial array — so two
+    queries differing only in groupBy share one fold."""
+    keys = []
+    for f in sub.filters:
+        j = dict(f.to_json())
+        j.pop("groupBy", None)
+        keys.append(repr(sorted(j.items())))
+    return tuple(sorted(keys))
+
+
+class SharedPartial:
+    """One shared partial-aggregate window ring (see module
+    docstring). Thread-safe: fold/serve state mutates under ``lock``;
+    the ingest tap's pending buffer has its own ``_pending_lock`` so
+    an O(1) enqueue never waits on a fold in progress; drains are
+    serialized by ``_drain_lock`` so chunks fold in arrival order."""
+
+    def __init__(self, tsdb, metric: str, filters: list,
+                 interval_ms: int, n_windows: int):
         self.tsdb = tsdb
-        self.sub = sub
-        self.metric: str = sub.metric
+        self.metric = metric
+        self.filters = filters
         self.metric_id: int | None = None
-        self.interval_ms = int(sub.ds_spec.interval_ms)
+        self.interval_ms = int(interval_ms)
         self.n_windows = int(n_windows)
         self.lock = threading.RLock()
+        self._pending_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
         self._filter_eval = filters_mod.FilterEvaluator(tsdb.uids)
+        # views attached to this partial (mutated under ``lock``);
+        # folds push dirty buckets to every view's changed-set
+        self.views: list[PlanView] = []
         # membership: sid -> row slot (-1 = evaluated, not a member)
         self._slots: dict[int, int] = {}
         self._sids: list[int] = []
@@ -72,7 +194,7 @@ class IncrementalSubPlan:
         # newest folded timestamp: absolute-range serves past it are
         # exact (nothing newer exists to diverge on)
         self.max_ts_ms = 0
-        # versions: folds invalidate the tail cache, membership
+        # versions: folds invalidate view tail caches, membership
         # changes invalidate the group structures
         self.fold_seq = 0
         self.member_seq = 0
@@ -80,37 +202,134 @@ class IncrementalSubPlan:
         self.points_folded = 0
         self.folds = 0
         self.late_dropped = 0
+        self.preboundary_dropped = 0
         self.bootstrap_points = 0
-        # buckets touched since the last SSE publish
-        self.changed_ts: set[int] = set()
+        self.backpressure_dropped = 0
         # pending (sids, ts_ms, values) chunks offered by the ingest
-        # tap; folded in batches so the hot write path stays O(1)
+        # tap; folded in batches off the hot write path
         self._pending: list[tuple] = []
         self.pending_points = 0
         self.needs_rebuild = False
-        self._tail_cache: tuple | None = None
-        self._groups_cache: tuple | None = None
-        # the raw store's mutation epoch at bootstrap: deletes/repairs
-        # bump it, and partials cannot "unfold" removed points — the
-        # registry forces a rebuild on mismatch before serving.
-        # Known limitation (documented): DUPLICATE writes (same
-        # series+timestamp rewritten) fold additively while the store
-        # dedupes last-write-wins; they do not bump the epoch, so the
-        # divergence persists until a tumble or rebuild. The reference
-        # treats duplicate writes as an error condition
-        # (tsd.storage.fix_duplicates), so this trades exactness on an
-        # abnormal workload for an O(1) write path.
-        self.store_epoch = -1
+        # tier-seeded bootstrap state: when the ring's horizon reaches
+        # behind the metric's demotion boundary AND a tier interval
+        # nests in the base interval, bootstrap seeds the pre-boundary
+        # part from the stitched rollup/cold tiers; folds then drop
+        # pre-boundary backfills (stitched batch reads ignore them
+        # too — the documented backfill-behind-boundary divergence)
+        self.tier_seeded = False
+        self.seed_boundary_ms = 0
+        self._seed_interval: str | None = None
+        # the read-set's mutation epochs at bootstrap: deletes,
+        # repairs and lifecycle sweeps bump them, and partials cannot
+        # "unfold" removed points — the registry forces a rebuild on
+        # mismatch before serving. Known limitation (documented):
+        # DUPLICATE writes (same series+timestamp rewritten) fold
+        # additively while the store dedupes last-write-wins; they do
+        # not bump the epoch, so the divergence persists until a
+        # tumble or rebuild. The reference treats duplicate writes as
+        # an error condition (tsd.storage.fix_duplicates), so this
+        # trades exactness on an abnormal workload for an O(1) write
+        # path.
+        self.store_epoch: tuple = (-1,)
+
+    # ------------------------------------------------------------------
+    # identity / attachment
+    # ------------------------------------------------------------------
+
+    def compatible_with(self, interval_ms: int) -> bool:
+        """Downsample-divisible: a view whose interval is a multiple
+        of the base derives its buckets by stride combine."""
+        return interval_ms % self.interval_ms == 0
+
+    def attach(self, view: "PlanView") -> None:
+        with self.lock:
+            self.views.append(view)
+
+    def detach(self, view: "PlanView") -> bool:
+        """Remove one view; returns True when no views remain (the
+        registry then drops the whole partial)."""
+        with self.lock:
+            if view in self.views:
+                self.views.remove(view)
+            return not self.views
+
+    # ------------------------------------------------------------------
+    # epochs
+    # ------------------------------------------------------------------
+
+    def _epoch_now(self) -> tuple:
+        """Mutation epochs of everything this partial was seeded
+        from: the raw store always; plus the cold store and the four
+        per-stat tier stores when tier-seeded (a cold quarantine or a
+        tier delete must force a rebuild exactly like a raw one)."""
+        parts = [getattr(self.tsdb.store, "mutation_epoch", 0)]
+        if self.tier_seeded and self._seed_interval is not None:
+            lc = getattr(self.tsdb, "lifecycle", None)
+            cold = getattr(lc, "coldstore", None) \
+                if lc is not None else None
+            parts.append(cold.mutation_epoch if cold is not None else 0)
+            rs = self.tsdb.rollup_store
+            if rs is not None:
+                for agg in _TIER_AGGS:
+                    parts.append(getattr(
+                        rs.tier(self._seed_interval, agg),
+                        "mutation_epoch", 0))
+        return tuple(parts)
+
+    def epoch_changed(self) -> bool:
+        return self.store_epoch != self._epoch_now()
 
     # ------------------------------------------------------------------
     # bootstrap: one batch scan seeds the partials, then folds keep up
     # ------------------------------------------------------------------
 
-    def bootstrap(self, now_ms: int) -> None:
+    def _seed_tier_views(self):
+        """The four per-stat stitched views to seed from, or None
+        when the horizon holds no demoted history (or no configured
+        tier nests in the base interval: those windows keep shedding
+        to the batch engine, the v1 behavior)."""
+        t = self.tsdb
+        lc = getattr(t, "lifecycle", None)
+        rs = getattr(t, "rollup_store", None)
+        if lc is None or rs is None or self.metric_id is None:
+            return None
+        boundary = lc.demote_boundary(self.metric_id)
+        if not boundary or self.covered_from_ms >= boundary:
+            return None
+        best = None
+        for iv in t.rollup_config.intervals:
+            if iv.interval_ms <= self.interval_ms \
+                    and self.interval_ms % iv.interval_ms == 0 \
+                    and boundary % iv.interval_ms == 0:
+                # coarsest nesting tier: fewest cells to reduce
+                if best is None or iv.interval_ms > best.interval_ms:
+                    best = iv
+        if best is None:
+            return None
+        views = {}
+        for agg in _TIER_AGGS:
+            st = lc.stitched(self.metric_id, best.interval, agg,
+                             rs.tier(best.interval, agg))
+            if st is None:
+                return None
+            views[agg] = st
+        return views, boundary, best.interval
+
+    def bootstrap(self, now_ms: int,
+                  n_windows: int | None = None) -> None:
         """Seed the window ring from the store: one fused
         ``bucket_reduce`` pass over the horizon produces exactly the
-        sum/count/min/max partials the folds maintain afterwards."""
-        with self.lock:
+        sum/count/min/max partials the folds maintain afterwards.
+        When demoted history falls inside the horizon, the stitched
+        tier views supply it channel-wise (see module docstring).
+
+        Takes ``_drain_lock`` BEFORE ``lock`` (the drain path's
+        order): a drainer holding taken-but-unfolded chunks must
+        finish before the re-scan, or its late folds would
+        double-count points the scan already seeded."""
+        with self._drain_lock, self.lock:
+            if n_windows is not None:
+                self.n_windows = int(n_windows)
             iv, w = self.interval_ms, self.n_windows
             last_edge = now_ms - now_ms % iv
             start_edge = last_edge - (w - 1) * iv
@@ -121,41 +340,80 @@ class IncrementalSubPlan:
             self._slots.clear()
             self._sids = []
             self._tag_pairs = []
-            self._sum[:] = 0.0
-            self._cnt[:] = 0.0
-            self._min[:] = np.inf
-            self._max[:] = -np.inf
-            self._pending = []
-            self.pending_points = 0
-            self._tail_cache = None
-            self._groups_cache = None
+            if self._sum.shape[1] != w:
+                cap = self._sum.shape[0]
+                self._sum = np.zeros((cap, w))
+                self._cnt = np.zeros((cap, w))
+                self._min = np.full((cap, w), np.inf)
+                self._max = np.full((cap, w), -np.inf)
+            else:
+                self._sum[:] = 0.0
+                self._cnt[:] = 0.0
+                self._min[:] = np.inf
+                self._max[:] = -np.inf
+            with self._pending_lock:
+                self._pending = []
+                self.pending_points = 0
+            for v in self.views:
+                v.invalidate_caches()
             self.covered_from_ms = int(start_edge)
             self.max_ts_ms = int(now_ms)
-            self.store_epoch = getattr(self.tsdb.store,
-                                       "mutation_epoch", 0)
+            self.tier_seeded = False
+            self.seed_boundary_ms = 0
+            self._seed_interval = None
             uids = self.tsdb.uids
             try:
                 self.metric_id = uids.metrics.get_id(self.metric)
             except LookupError:
                 self.metric_id = None  # metric not written yet
+                self.store_epoch = self._epoch_now()
                 self.member_seq += 1
                 self.fold_seq += 1
                 return
+            # epochs BEFORE the scan: a concurrent mutation during the
+            # scan leaves the partial already-stale, never wrongly
+            # fresh
+            seeded = self._seed_tier_views()
+            if seeded is not None:
+                self.tier_seeded = True
+                self.seed_boundary_ms = seeded[1]
+                self._seed_interval = seeded[2]
+            self.store_epoch = self._epoch_now()
             store = self.tsdb.store
             sids = store.series_ids_for_metric(self.metric_id)
-            if len(sids) and self.sub.filters:
+            if len(sids) and self.filters:
                 idx = store.metric_index(self.metric_id)
                 _, triples = idx.arrays()
-                mask = self._filter_eval.apply(self.sub.filters, sids,
+                mask = self._filter_eval.apply(self.filters, sids,
                                                triples)
                 sids = sids[mask]
             for sid in np.asarray(sids).tolist():
                 self._admit_locked(int(sid), check_filters=False)
             if len(self._sids):
                 sid_arr = np.asarray(self._sids, dtype=np.int64)
-                sums, cnts, mins, maxs = store.bucket_reduce(
-                    sid_arr, int(start_edge), int(start_edge + w * iv - 1),
-                    int(start_edge), iv, w, want_minmax=True)
+                span_end = int(start_edge + w * iv - 1)
+                if seeded is not None:
+                    # channel-wise tier seed: each stitched view
+                    # combines its cold + tier + raw-tail parts over
+                    # the SAME bucket grid, so sums of sums / counts
+                    # of counts / extremes of extremes are exact
+                    views = seeded[0]
+                    sums = views["sum"].bucket_reduce(
+                        sid_arr, int(start_edge), span_end,
+                        int(start_edge), iv, w)[0]
+                    cnts = views["count"].bucket_reduce(
+                        sid_arr, int(start_edge), span_end,
+                        int(start_edge), iv, w)[0]
+                    mins = views["min"].bucket_reduce(
+                        sid_arr, int(start_edge), span_end,
+                        int(start_edge), iv, w, want_minmax=True)[2]
+                    maxs = views["max"].bucket_reduce(
+                        sid_arr, int(start_edge), span_end,
+                        int(start_edge), iv, w, want_minmax=True)[3]
+                else:
+                    sums, cnts, mins, maxs = store.bucket_reduce(
+                        sid_arr, int(start_edge), span_end,
+                        int(start_edge), iv, w, want_minmax=True)
                 s = len(sid_arr)
                 self._grow_to(s)
                 self._sum[:s, cols] = sums
@@ -166,6 +424,25 @@ class IncrementalSubPlan:
                 self.bootstrap_points += int(cnts.sum())
             self.member_seq += 1
             self.fold_seq += 1
+
+    def ensure_horizon(self, n_windows: int, anchor_ms: int) -> bool:
+        """Grow the ring to at least ``n_windows`` columns (a newly
+        attached view needs a longer horizon) and re-seed. Returns
+        True when a re-bootstrap ran. Caller handles exceptions (a
+        failed re-seed leaves ``needs_rebuild`` set). The size change
+        applies INSIDE the re-bootstrap (under the drain+fold locks):
+        a fold must never see a ring size its arrays don't match."""
+        with self.lock:
+            newest = int(self.win_ts.max())
+            anchor = max(anchor_ms, newest if newest > 0 else 0)
+            if n_windows <= self.n_windows:
+                return False
+        try:
+            self.bootstrap(anchor, n_windows=n_windows)
+        except BaseException:
+            self.needs_rebuild = True
+            raise
+        return True
 
     # ------------------------------------------------------------------
     # membership
@@ -209,13 +486,13 @@ class IncrementalSubPlan:
         if rec.metric_id != self.metric_id:
             self._slots[sid] = -1
             return -1
-        if check_filters and self.sub.filters:
+        if check_filters and self.filters:
             triples = (np.asarray(
                 [(sid, k, v) for k, v in rec.tags],
                 dtype=np.int64).reshape(-1, 3)
                 if rec.tags else np.empty((0, 3), dtype=np.int64))
             mask = self._filter_eval.apply(
-                self.sub.filters, np.asarray([sid], dtype=np.int64),
+                self.filters, np.asarray([sid], dtype=np.int64),
                 triples)
             if not bool(mask[0]):
                 self._slots[sid] = -1
@@ -229,27 +506,45 @@ class IncrementalSubPlan:
         return slot
 
     # ------------------------------------------------------------------
-    # ingest folds
+    # ingest tap: O(1) columnar enqueue
     # ------------------------------------------------------------------
 
     def offer(self, sids: np.ndarray, ts_ms: np.ndarray,
               values: np.ndarray) -> int:
-        """Buffer a chunk from the ingest tap (O(1) append); returns
-        the pending-point total so the registry can decide to drain."""
-        with self.lock:
+        """Buffer a chunk from the ingest tap (O(1) append under the
+        small pending lock — never the fold lock); returns the
+        pending-point total so the registry can decide to hand the
+        partial to a worker or degrade it."""
+        with self._pending_lock:
             self._pending.append((sids, ts_ms, values))
             self.pending_points += len(ts_ms)
             return self.pending_points
 
     def take_pending(self) -> list[tuple]:
-        with self.lock:
+        with self._pending_lock:
             out, self._pending = self._pending, []
             self.pending_points = 0
             return out
 
+    def drop_pending(self) -> int:
+        """Backpressure degrade: throw the backlog away (the partial
+        is marked for rebuild-on-serve by the registry) and return
+        the dropped point count. Never blocks the write path."""
+        with self._pending_lock:
+            dropped = self.pending_points
+            self._pending = []
+            self.pending_points = 0
+        self.backpressure_dropped += dropped
+        return dropped
+
+    # ------------------------------------------------------------------
+    # folds (run by workers / serve-path drains, never the tap)
+    # ------------------------------------------------------------------
+
     def fold(self, sids: np.ndarray, ts_ms: np.ndarray,
              values: np.ndarray) -> None:
-        """Fold one chunk of points into the window partials."""
+        """Fold one chunk of points into the window partials — ONE
+        scatter per stat channel serving every attached view."""
         with self.lock:
             iv, w = self.interval_ms, self.n_windows
             sids = np.asarray(sids, dtype=np.int64).reshape(-1)
@@ -270,6 +565,19 @@ class IncrementalSubPlan:
             ts = ts_ms[keep]
             vals = values[keep]
             bucket = ts - ts % iv
+            if self.tier_seeded and self.seed_boundary_ms:
+                # pre-boundary backfills are invisible to stitched
+                # batch reads (documented divergence); folding them
+                # additively would double-serve once — drop + count
+                pre = bucket < self.seed_boundary_ms
+                if pre.any():
+                    self.preboundary_dropped += int(pre.sum())
+                    live0 = ~pre
+                    slots, ts = slots[live0], ts[live0]
+                    vals, bucket = vals[live0], bucket[live0]
+                    if not len(bucket):
+                        self.folds += 1
+                        return
             col = ((bucket // iv) % w).astype(np.int64)
             # tumble columns whose newest incoming bucket is newer
             for c in np.unique(col).tolist():
@@ -287,43 +595,220 @@ class IncrementalSubPlan:
             if live.any():
                 slots, col = slots[live], col[live]
                 vals, bucket = vals[live], bucket[live]
-                np.add.at(self._sum, (slots, col), vals)
-                np.add.at(self._cnt, (slots, col), 1.0)
-                np.minimum.at(self._min, (slots, col), vals)
-                np.maximum.at(self._max, (slots, col), vals)
-                self.changed_ts.update(
-                    int(b) for b in np.unique(bucket).tolist())
-                if len(self.changed_ts) > 4 * w:
-                    # nobody is draining the changed-set (no
-                    # subscriber): keep it bounded by the horizon
-                    cutoff = self.covered_from_ms
-                    self.changed_ts = {c for c in self.changed_ts
-                                       if c >= cutoff}
+                stream_fold.scatter_fold(self._sum, self._cnt,
+                                         self._min, self._max,
+                                         slots, col, vals)
+                changed = [int(b) for b in np.unique(bucket).tolist()]
+                for view in self.views:
+                    view.note_changed(changed, self.covered_from_ms)
                 self.points_folded += len(vals)
                 self.max_ts_ms = max(self.max_ts_ms, int(ts.max()))
                 self.fold_seq += 1
-                self._tail_cache = None
             self.folds += 1
 
     # ------------------------------------------------------------------
-    # read side: derive the downsampled grid + run the pipeline tail
+    # read side: derive per-view channel grids from the shared ring
     # ------------------------------------------------------------------
 
-    def grid_for(self, start_ms: int, end_ms: int):
-        """[S, B] downsampled grid over the requested range derived
-        from the partials, or None when the range is outside the
+    def channels_for(self, start_ms: int, end_ms: int,
+                     view_interval_ms: int):
+        """(sums, cnts, mins, maxs, view_edges) over the requested
+        range at the VIEW's bucket granularity (stride combine over
+        the base ring), or None when the range is outside the
         maintained horizon. Caller holds ``lock``."""
-        iv, w = self.interval_ms, self.n_windows
-        edges = ds_mod.fixed_bucket_edges(start_ms, end_ms, iv)
-        if len(edges) == 0 or len(edges) > w:
+        base_iv, w = self.interval_ms, self.n_windows
+        stride = view_interval_ms // base_iv
+        edges = ds_mod.fixed_bucket_edges(start_ms, end_ms,
+                                          view_interval_ms)
+        if len(edges) == 0:
             return None
-        if int(edges[0]) < self.covered_from_ms:
+        base = (edges[:, None]
+                + np.arange(stride, dtype=np.int64)
+                * base_iv).reshape(-1)
+        if len(base) > w or int(base[0]) < self.covered_from_ms:
             return None
-        cols = ((edges // iv) % w).astype(np.int64)
-        live = self.win_ts[cols] == edges
+        cols = ((base // base_iv) % w).astype(np.int64)
+        live = self.win_ts[cols] == base
         s = len(self._sids)
         sums = np.where(live[None, :], self._sum[:s][:, cols], 0.0)
         cnts = np.where(live[None, :], self._cnt[:s][:, cols], 0.0)
+        mins = np.where(live[None, :], self._min[:s][:, cols], np.inf)
+        maxs = np.where(live[None, :], self._max[:s][:, cols], -np.inf)
+        sums, cnts, mins, maxs = stream_fold.combine_stride(
+            sums, cnts, mins, maxs, stride)
+        return sums, cnts, mins, maxs, edges
+
+    def info(self) -> dict[str, Any]:
+        with self.lock:
+            return {
+                "metric": self.metric,
+                "intervalMs": self.interval_ms,
+                "windows": self.n_windows,
+                "series": len(self._sids),
+                "views": len(self.views),
+                "coveredFromMs": self.covered_from_ms,
+                "pointsFolded": self.points_folded,
+                "folds": self.folds,
+                "pendingPoints": self.pending_points,
+                "lateDropped": self.late_dropped,
+                "preboundaryDropped": self.preboundary_dropped,
+                "backpressureDropped": self.backpressure_dropped,
+                "bootstrapPoints": self.bootstrap_points,
+                "tierSeeded": self.tier_seeded,
+                "seedBoundaryMs": self.seed_boundary_ms,
+                "needsRebuild": self.needs_rebuild,
+            }
+
+
+class PlanView:
+    """One registered sub-query's view over a :class:`SharedPartial`:
+    stride-derived grid + window combine + the pipeline tail. All
+    fold/coverage state lives on the shared partial; the view owns
+    only its caches, its window spec and its dirty-bucket set."""
+
+    def __init__(self, shared: SharedPartial, sub: TSSubQuery,
+                 n_windows: int, window: WindowSpec | None = None):
+        self.shared = shared
+        self.sub = sub
+        self.window = window or WindowSpec()
+        self.interval_ms = int(sub.ds_spec.interval_ms)
+        self.n_windows = int(n_windows)
+        # buckets touched since the last SSE publish (base-interval
+        # edges; mutated under shared.lock by folds, drained by
+        # take_changed)
+        self.changed_ts: set[int] = set()
+        self._tail_cache: tuple | None = None
+        self._groups_cache: tuple | None = None
+
+    # -- properties delegated to the shared partial (registry + test
+    # surface compatibility: ``cq.plans[0].covered_from_ms`` etc.) ----
+
+    @property
+    def metric(self) -> str:
+        return self.shared.metric
+
+    @property
+    def metric_id(self) -> int | None:
+        return self.shared.metric_id
+
+    @property
+    def covered_from_ms(self) -> int:
+        return self.shared.covered_from_ms
+
+    @property
+    def max_ts_ms(self) -> int:
+        return self.shared.max_ts_ms
+
+    @property
+    def late_dropped(self) -> int:
+        return self.shared.late_dropped
+
+    @property
+    def pending_points(self) -> int:
+        return self.shared.pending_points
+
+    @property
+    def needs_rebuild(self) -> bool:
+        return self.shared.needs_rebuild
+
+    @property
+    def _sids(self) -> list[int]:
+        return self.shared._sids
+
+    @property
+    def stride(self) -> int:
+        return self.interval_ms // self.shared.interval_ms
+
+    # ------------------------------------------------------------------
+
+    def invalidate_caches(self) -> None:
+        self._tail_cache = None
+        self._groups_cache = None
+
+    def note_changed(self, buckets: list[int],
+                     covered_from_ms: int) -> None:
+        """Record fold-dirty base buckets (called under
+        ``shared.lock`` by the fold)."""
+        self.changed_ts.update(buckets)
+        self._tail_cache = None
+        if len(self.changed_ts) > 4 * max(
+                self.n_windows * self.stride, 1):
+            # nobody is draining the changed-set (no subscriber):
+            # keep it bounded by the horizon
+            self.changed_ts = {c for c in self.changed_ts
+                               if c >= covered_from_ms}
+
+    def take_changed(self) -> list[int]:
+        with self.shared.lock:
+            out = sorted(self.changed_ts)
+            self.changed_ts = set()
+            return out
+
+    def publish_buckets(self, changed: set[int]) -> set[int] | None:
+        """Map fold-dirty BASE buckets to the output buckets an SSE
+        delta frame must re-emit: the enclosing view bucket for
+        tumbling, the trailing-window fan-out for sliding, None
+        (whole frame) for session windows — a fold anywhere can move
+        a session's start bucket."""
+        if self.window.kind == "session":
+            return None
+        iv = self.interval_ms
+        out = {c - c % iv for c in changed}
+        if self.window.kind == "sliding":
+            k = self.window.size_ms // iv
+            out = {c + i * iv for c in out for i in range(k)}
+        return out
+
+    # ------------------------------------------------------------------
+    # serve: grid derivation + window combine + pipeline tail
+    # ------------------------------------------------------------------
+
+    def _windowed_channels(self, start_ms: int, end_ms: int):
+        """Channels over [start, end] at view granularity with the
+        window combine applied. Sliding windows extend the derivation
+        ``k-1`` buckets into trailing history when the ring covers it
+        (leading outputs otherwise aggregate their clipped window).
+        Caller holds ``shared.lock``."""
+        iv = self.interval_ms
+        ch = None
+        lead = 0
+        if self.window.kind == "sliding":
+            k = self.window.size_ms // iv
+            ext = start_ms - (k - 1) * iv
+            if ext > 0:
+                ch = self.shared.channels_for(ext, end_ms, iv)
+                if ch is not None:
+                    lead = k - 1
+        if ch is None:
+            ch = self.shared.channels_for(start_ms, end_ms, iv)
+            if ch is None:
+                return None
+        sums, cnts, mins, maxs, edges = ch
+        # the REAL point count, before any window combine: a sliding
+        # combine sums the count channel across k overlapping
+        # windows, which would k-fold overcount against query limits
+        num_points = int(cnts.sum())
+        if self.window.kind == "sliding":
+            k = self.window.size_ms // iv
+            sums, cnts, mins, maxs = stream_fold.combine_sliding(
+                sums, cnts, mins, maxs, k)
+            if lead:
+                sums, cnts = sums[:, lead:], cnts[:, lead:]
+                mins, maxs = mins[:, lead:], maxs[:, lead:]
+                edges = edges[lead:]
+        elif self.window.kind == "session":
+            sums, cnts, mins, maxs = stream_fold.session_grid(
+                sums, cnts, mins, maxs, edges, self.window.gap_ms)
+        return sums, cnts, mins, maxs, edges, num_points
+
+    def grid_for(self, start_ms: int, end_ms: int):
+        """[S, B] downsampled+windowed grid over the requested range,
+        or None when outside the horizon. Caller holds
+        ``shared.lock``."""
+        ch = self._windowed_channels(start_ms, end_ms)
+        if ch is None:
+            return None
+        sums, cnts, mins, maxs, edges, num_points = ch
         present = cnts > 0
         fn = self.sub.ds_spec.function
         if fn in ("sum", "zimsum", "pfsum"):
@@ -334,25 +819,21 @@ class IncrementalSubPlan:
             grid = np.where(present, sums / np.maximum(cnts, 1.0),
                             np.nan)
         elif fn in ("min", "mimmin"):
-            mins = np.where(live[None, :], self._min[:s][:, cols],
-                            np.inf)
             grid = np.where(present, mins, np.nan)
         else:  # max, mimmax
-            maxs = np.where(live[None, :], self._max[:s][:, cols],
-                            -np.inf)
             grid = np.where(present, maxs, np.nan)
-        return grid, present, edges, int(cnts.sum())
+        return grid, present, edges, num_points
 
     def _groups_locked(self):
         """(tag_mat, group_ids, num_groups, gb_kids) over the current
         members, rebuilt only when membership changed. None when a
         group-by key has no UID yet (batch returns [] there too)."""
         cached = self._groups_cache
-        if cached is not None and cached[0] == self.member_seq:
+        if cached is not None and cached[0] == self.shared.member_seq:
             return cached[1]
         from opentsdb_tpu.query.engine import QueryEngine, TagMatrix
-        uids = self.tsdb.uids
-        tag_mat = TagMatrix.from_pairs(self._tag_pairs)
+        uids = self.shared.tsdb.uids
+        tag_mat = TagMatrix.from_pairs(self.shared._tag_pairs)
         gb_tagks = sorted({f.tagk for f in self.sub.filters
                            if f.group_by})
         gb_kids = []
@@ -360,27 +841,29 @@ class IncrementalSubPlan:
             try:
                 gb_kids.append(uids.tag_names.get_id(k))
             except LookupError:
-                self._groups_cache = (self.member_seq, None)
+                self._groups_cache = (self.shared.member_seq, None)
                 return None
         group_ids, num_groups = QueryEngine._group_ids(tag_mat, gb_kids)
         out = (tag_mat, group_ids, num_groups, gb_kids)
-        self._groups_cache = (self.member_seq, out)
+        self._groups_cache = (self.shared.member_seq, out)
         return out
 
     def serve(self, tsq, sub: TSSubQuery, engine) -> list | None:
-        """Answer one request from the maintained windows: drain is the
-        caller's job (registry), here the grid derives from partials
-        and ONLY the pipeline tail runs (host CPU — dashboard-sized,
-        and consistent with the degraded-fallback placement idiom).
-        Returns result groups, [] for genuinely-empty, or None when
-        this plan cannot serve the window."""
-        with self.lock:
+        """Answer one request from the maintained windows: drain is
+        the caller's job (registry), here the grid derives from the
+        shared partials and ONLY the pipeline tail runs (host CPU —
+        dashboard-sized, and consistent with the degraded-fallback
+        placement idiom). Returns result groups, [] for
+        genuinely-empty, or None when this view cannot serve the
+        window."""
+        shared = self.shared
+        with shared.lock:
             g = self.grid_for(tsq.start_ms, tsq.end_ms)
             if g is None:
                 return None
             grid, present, edges, num_points = g
-            self.tsdb.query_limits.check(self.metric, num_points)
-            if num_points == 0 or not len(self._sids):
+            shared.tsdb.query_limits.check(shared.metric, num_points)
+            if num_points == 0 or not len(shared._sids):
                 return []
             groups = self._groups_locked()
             if groups is None:
@@ -388,14 +871,15 @@ class IncrementalSubPlan:
             tag_mat, group_ids, num_groups, gb_kids = groups
             emit_raw = self.sub.agg.is_none
             if emit_raw:
-                group_ids = np.arange(len(self._sids), dtype=np.int32)
-                num_groups = len(self._sids)
+                group_ids = np.arange(len(shared._sids),
+                                      dtype=np.int32)
+                num_groups = len(shared._sids)
             result, emit = self._tail_locked(edges, grid, present,
                                              group_ids, num_groups,
                                              emit_raw)
-            sid_arr = np.asarray(self._sids, dtype=np.int64)
+            sid_arr = np.asarray(shared._sids, dtype=np.int64)
             return engine._build_results(
-                tsq, sub, self.metric, sid_arr, tag_mat, group_ids,
+                tsq, sub, shared.metric, sid_arr, tag_mat, group_ids,
                 num_groups, gb_kids, edges, result, emit)
 
     def _tail_locked(self, edges, grid, present, group_ids,
@@ -403,7 +887,8 @@ class IncrementalSubPlan:
         """fill/rate/interpolate/aggregate over the derived grid — the
         exact kernel chain of the batch engine's grid path, pinned to
         the host CPU backend. Cached per (fold, membership, window)."""
-        key = (self.fold_seq, self.member_seq, int(edges[0]),
+        shared = self.shared
+        key = (shared.fold_seq, shared.member_seq, int(edges[0]),
                len(edges))
         cached = self._tail_cache
         if cached is not None and cached[0] == key:
@@ -431,24 +916,12 @@ class IncrementalSubPlan:
 
     # ------------------------------------------------------------------
 
-    def take_changed(self) -> list[int]:
-        with self.lock:
-            out = sorted(self.changed_ts)
-            self.changed_ts = set()
-            return out
-
     def info(self) -> dict[str, Any]:
-        with self.lock:
-            return {
-                "metric": self.metric,
-                "intervalMs": self.interval_ms,
-                "windows": self.n_windows,
-                "series": len(self._sids),
-                "coveredFromMs": self.covered_from_ms,
-                "pointsFolded": self.points_folded,
-                "folds": self.folds,
-                "pendingPoints": self.pending_points,
-                "lateDropped": self.late_dropped,
-                "bootstrapPoints": self.bootstrap_points,
-                "needsRebuild": self.needs_rebuild,
-            }
+        out = self.shared.info()
+        out.update({
+            "viewIntervalMs": self.interval_ms,
+            "viewWindows": self.n_windows,
+            "window": self.window.to_json(),
+            "stride": self.stride,
+        })
+        return out

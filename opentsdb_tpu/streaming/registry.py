@@ -1,38 +1,59 @@
-"""Continuous-query registry: standing TSQueries maintained under
-ingest and served two ways.
+"""Continuous-query registry v2: standing TSQueries maintained by
+shared off-path fold workers and served three ways.
 
-Clients register a standing TSQuery (``POST /api/query/continuous``);
-the registry compiles each sub-query into an
-:class:`~opentsdb_tpu.streaming.plan.IncrementalSubPlan` (tumbling
-windows of per-series partial aggregates) and taps
-``TSDB.add_point`` / ``add_points`` / ``import_buffer`` through
-:meth:`offer` — a buffered O(1) append on the hot write path, folded
-in batches.
+Clients register a standing TSQuery (``POST /api/query/continuous``,
+optionally with a ``window`` object — tumbling by default, sliding or
+session-gap). Each sub-query compiles into a
+:class:`~opentsdb_tpu.streaming.plan.PlanView` attached to a
+:class:`~opentsdb_tpu.streaming.plan.SharedPartial` keyed by the
+canonical sub-plan identity ``(metric, membership filters, base
+downsample interval)`` — N continuous queries over the same
+sub-expression share ONE partial array and one fold
+(multi-query plan sharing; a divisible coarser interval derives by
+stride combine).
 
-Results serve two ways:
+The ingest tap (``TSDB.add_point`` / ``add_points`` /
+``import_buffer`` through :meth:`offer`) is an O(1) columnar append
+per partial — folds NEVER run on the write path. When a partial's
+backlog crosses ``tsd.streaming.buffer_points`` it is handed to the
+shared fold-worker pool (:mod:`opentsdb_tpu.streaming.workers`); a
+backlog past ``tsd.streaming.workers.max_pending_points`` degrades
+the lagging partial to rebuild-on-serve (backlog dropped, counted)
+instead of blocking or failing the acknowledged write.
+
+Results serve three ways:
 
 - **pull** — the query engine consults :meth:`try_serve` before the
-  result cache: a live-window request matching a registered query is
-  answered from the maintained partials (fold pending + pipeline
-  tail, never a store scan). This is the non-invalidating feeder that
-  closes the result cache's live-query gap: ingest to the read store
-  no longer evicts the dashboard's answer, it *updates* it.
+  result cache: a live-window request matching a registered tumbling
+  query is answered from the maintained partials (synchronous drain +
+  pipeline tail, never a store scan — and never stale: the serve path
+  drains pending folds itself, whatever the workers' lag).
 - **push** — Server-Sent Events (``GET /api/query/continuous/<id>/
   stream``) emitting incremental window updates, with bounded
   per-subscription queues and slow-consumer shedding
   (:mod:`opentsdb_tpu.streaming.sse`).
+- **fetch** — ``GET /api/query/continuous/<id>/result`` returns the
+  current windowed results (the only pull surface for sliding /
+  session windows, which no plain TSQuery can express).
 
-Degradation follows the PR-1 idiom: the ``stream.fold`` fault site
-runs every fold and rebuild under a :class:`CircuitBreaker`; a failed
-fold marks the plan for rebuild (one batch re-scan), a tripped breaker
-routes pulls back to the batch engine (shed to the always-correct
-path, never a 500) until the reset-window probe heals it. Counters
-export through /api/stats and /api/health.
+Bootstrap seeds partials from the raw store — and, when the window
+reaches behind the metric's demotion boundary, from the rollup/cold
+tiers through the stitched per-stat views, so pre-boundary windows
+serve incrementally instead of shedding to the batch engine.
+
+Degradation follows the PR-1 idiom: serve-path folds/rebuilds run
+under the ``stream.fold`` fault site, worker drains additionally
+under ``stream.worker``, both behind one :class:`CircuitBreaker`; a
+failed fold marks the partial for rebuild (one batch re-scan), a
+tripped breaker routes pulls back to the batch engine (shed to the
+always-correct path, never a 500) until the reset-window probe heals
+it. Counters export through /api/stats and /api/health.
 
 Knobs (``tsd.streaming.*``): ``enable``, ``serve``, ``max_queries``,
-``max_windows``, ``buffer_points``, ``queue_events``, ``heartbeat_s``,
-``publish_min_interval_ms``, ``breaker.failure_threshold``,
-``breaker.reset_timeout_ms``.
+``max_windows``, ``buffer_points``, ``queue_events``,
+``heartbeat_s``, ``publish_min_interval_ms``, ``resume_events``,
+``workers.count``, ``workers.max_pending_points``,
+``breaker.failure_threshold``, ``breaker.reset_timeout_ms``.
 """
 
 from __future__ import annotations
@@ -47,19 +68,21 @@ import numpy as np
 
 from opentsdb_tpu.query.model import BadRequestError, TSQuery
 from opentsdb_tpu.query.result_cache import _is_relative
-from opentsdb_tpu.streaming.plan import (DECOMPOSABLE_DS,
-                                         IncrementalSubPlan)
-from opentsdb_tpu.utils.faults import CircuitBreaker
+from opentsdb_tpu.streaming.plan import (DECOMPOSABLE_DS, PlanView,
+                                         SharedPartial, WindowSpec,
+                                         filter_identity)
+from opentsdb_tpu.streaming.workers import FoldWorkerPool
+from opentsdb_tpu.utils.faults import CircuitBreaker, DegradedError
 
 LOG = logging.getLogger("streaming.registry")
 
 
 class ContinuousQuery:
     """One registered standing query: the validated TSQuery plus one
-    incremental plan per sub-query and the SSE subscriber set."""
+    plan view per sub-query and the SSE subscriber set."""
 
     def __init__(self, cid: str, raw: dict, tsq: TSQuery,
-                 plans: list[IncrementalSubPlan]):
+                 plans: list[PlanView]):
         self.id = cid
         self.raw = raw          # original JSON body (re-resolved per emit)
         self.tsq = tsq
@@ -88,6 +111,10 @@ class ContinuousQuery:
             "subscribers": len(self.subscribers),
             "emitSeq": self.emit_seq,
         }
+        if self.plans:
+            out["windowSpec"] = self.plans[0].window.to_json()
+            out["sharedPlan"] = [len(p.shared.views) > 1
+                                 for p in self.plans]
         if verbose:
             out["plans"] = [p.info() for p in self.plans]
         return out
@@ -100,20 +127,30 @@ class ContinuousQueryRegistry:
         self.tsdb = tsdb
         cfg = tsdb.config
         self._lock = threading.Lock()
+        # registrations serialize here (control-plane; the ingest tap
+        # and publish paths never take it) so two concurrent registers
+        # cannot mint duplicate shared partials for one identity
+        self._register_lock = threading.Lock()
         self._ids = itertools.count(1)
         self._queries: dict[str, ContinuousQuery] = {}
-        # metric_id -> plans watching it (the tap's fast path); plans
-        # whose metric has no UID yet park in _unresolved until a
-        # write materializes the metric
-        self._by_mid: dict[int, list[IncrementalSubPlan]] = {}
-        self._unresolved: list[IncrementalSubPlan] = []
-        # (metric, sub identity) -> plan for the pull path
-        self._by_identity: dict[tuple, IncrementalSubPlan] = {}
+        # every live shared partial (fold state the tap feeds)
+        self._partials: list[SharedPartial] = []
+        # metric_id -> partials watching it (the tap's fast path);
+        # partials whose metric has no UID yet park in _unresolved
+        # until a write materializes the metric
+        self._by_mid: dict[int, list[SharedPartial]] = {}
+        self._unresolved: list[SharedPartial] = []
+        # (metric, sub identity) -> tumbling view for the pull path
+        # (sliding/session views are push/fetch-only: a plain TSQuery
+        # cannot express their combine)
+        self._by_identity: dict[tuple, PlanView] = {}
         self.max_queries = cfg.get_int("tsd.streaming.max_queries", 64)
         self.max_windows = cfg.get_int("tsd.streaming.max_windows",
                                        2880)
         self.buffer_points = cfg.get_int("tsd.streaming.buffer_points",
                                          4096)
+        self.max_pending_points = cfg.get_int(
+            "tsd.streaming.workers.max_pending_points", 262144)
         self.queue_events = cfg.get_int("tsd.streaming.queue_events",
                                         256)
         self.heartbeat_s = cfg.get_float("tsd.streaming.heartbeat_s",
@@ -132,6 +169,8 @@ class ContinuousQueryRegistry:
             if threshold > 0 else None
         if self.breaker is not None:
             tsdb.stats.register(self.breaker)
+        self.workers = FoldWorkerPool(
+            self, cfg.get_int("tsd.streaming.workers.count", 2))
         # live SSE subscriber count, maintained so the ingest tap's
         # publish check is one integer read (never a registry walk)
         self._active_subs = 0
@@ -140,6 +179,9 @@ class ContinuousQueryRegistry:
         self.serve_fallbacks = 0
         self.fold_errors = 0
         self.rebuilds = 0
+        self.backpressure_drops = 0
+        self.backpressure_events = 0
+        self.tier_seeded_bootstraps = 0
         self.sse_shed = 0
         self.sse_events = 0
         self.sse_resumes = 0
@@ -159,6 +201,7 @@ class ContinuousQueryRegistry:
         if not isinstance(obj, dict):
             raise BadRequestError("continuous query must be an object")
         cid = obj.get("id")
+        window_obj = obj.get("window")
         body = {k: v for k, v in obj.items() if k != "id"}
         tsq = TSQuery.from_json(body).validate(now_ms)
         if tsq.delete:
@@ -168,7 +211,7 @@ class ContinuousQueryRegistry:
             raise BadRequestError(
                 "continuous queries do not support timezone/calendar "
                 "downsampling")
-        plans = []
+        specs: list[tuple] = []
         for sub in tsq.queries:
             if sub.percentiles:
                 raise BadRequestError(
@@ -191,57 +234,141 @@ class ContinuousQueryRegistry:
                     f"downsample function {spec.function!r} is not "
                     f"decomposable into streaming partials "
                     f"(supported: {', '.join(sorted(DECOMPOSABLE_DS))})")
+            window = WindowSpec.from_json(window_obj, spec.interval_ms)
             windows = int((tsq.end_ms - tsq.start_ms)
-                          // spec.interval_ms) + 2
+                          // spec.interval_ms) + 2 \
+                + window.lead_for(spec.interval_ms)
             if windows > self.max_windows:
                 raise BadRequestError(
                     f"window range needs {windows} tumbling windows; "
                     f"tsd.streaming.max_windows={self.max_windows}")
-            plans.append(IncrementalSubPlan(self.tsdb, sub, windows))
+            specs.append((sub, window, windows))
         # the horizon anchors at the query's RESOLVED end: now for the
         # live-dashboard shape (end=now), the window's own end for an
         # absolute registration — either way the ring covers exactly
         # the window the standing query answers, and tumbles forward
         # with ingest from there
         anchor_ms = tsq.end_ms
-        with self._lock:
-            if len(self._queries) >= self.max_queries:
-                raise BadRequestError(
-                    f"too many continuous queries (tsd.streaming."
-                    f"max_queries={self.max_queries})")
-            if cid is None:
-                cid = f"cq{next(self._ids)}"
-            cid = str(cid)
-            if cid in self._queries:
-                raise BadRequestError(
-                    f"continuous query {cid!r} already exists")
-            # reserve the id so a concurrent same-id register fails
-            # fast; the bootstrap scan runs OUTSIDE the registry lock
-            # (the ingest tap and _maybe_publish take it — a wide
-            # bootstrap must not stall every write for seconds)
-            self._queries[cid] = cq = ContinuousQuery(
-                cid, body, tsq, plans)
-        try:
-            for plan in plans:
-                plan.bootstrap(anchor_ms)
-        except BaseException:
+        with self._register_lock:
             with self._lock:
-                self._queries.pop(cid, None)
-            raise
-        with self._lock:
-            for plan in plans:
-                self._index_plan_locked(plan)
-                key = (plan.metric, plan.sub.identity_key())
-                self._by_identity.setdefault(key, plan)
-        LOG.info("registered continuous query %s (%d sub-plans)",
-                 cid, len(plans))
+                if len(self._queries) >= self.max_queries:
+                    raise BadRequestError(
+                        f"too many continuous queries (tsd.streaming."
+                        f"max_queries={self.max_queries})")
+                if cid is None:
+                    cid = f"cq{next(self._ids)}"
+                cid = str(cid)
+                if cid in self._queries:
+                    raise BadRequestError(
+                        f"continuous query {cid!r} already exists")
+                # reserve the id; the bootstrap scans below run
+                # OUTSIDE the registry lock (the ingest tap takes it —
+                # a wide bootstrap must not stall every write)
+                self._queries[cid] = cq = ContinuousQuery(
+                    cid, body, tsq, [])
+            new_groups: list[SharedPartial] = []
+            views: list[PlanView] = []
+            try:
+                for sub, window, need_w in specs:
+                    fid = filter_identity(sub)
+                    view_iv = int(sub.ds_spec.interval_ms)
+                    with self._lock:
+                        group = self._find_group_locked(
+                            sub.metric, fid, view_iv)
+                    if group is not None:
+                        # the shared ring must cover BOTH its current
+                        # span and this view's (lead-extended) range
+                        # from the joint anchor; if stretching over
+                        # both would exceed max_windows (e.g. a live
+                        # dashboard attaching to a partial anchored
+                        # on an old absolute range), the view gets
+                        # its own partial instead of silently never
+                        # being covered
+                        base_iv = group.interval_ms
+                        with group.lock:
+                            newest = int(group.win_ts.max())
+                            covered = group.covered_from_ms
+                        anchor = max(anchor_ms,
+                                     newest if newest > 0 else 0)
+                        anchor_edge = anchor - anchor % base_iv
+                        start_edge = (
+                            tsq.start_ms - tsq.start_ms % view_iv
+                            - window.lead_for(view_iv) * view_iv)
+                        floor = min(start_edge, covered) \
+                            if covered else start_edge
+                        needed = int(
+                            (anchor_edge - floor) // base_iv) + 2
+                        if needed > self.max_windows:
+                            group = None
+                        elif group.ensure_horizon(needed, anchor_ms):
+                            if group.tier_seeded:
+                                self.tier_seeded_bootstraps += 1
+                    if group is None:
+                        group = SharedPartial(
+                            self.tsdb, sub.metric, sub.filters,
+                            view_iv, need_w)
+                        group.filter_key = fid
+                        group.bootstrap(anchor_ms)
+                        if group.tier_seeded:
+                            self.tier_seeded_bootstraps += 1
+                        new_groups.append(group)
+                    view = PlanView(group, sub, need_w, window)
+                    views.append(view)
+                for view in views:
+                    view.shared.attach(view)
+                cq.plans = views
+                with self._lock:
+                    for group in new_groups:
+                        self._partials.append(group)
+                        self._index_group_locked(group)
+                    for view in views:
+                        if view.window.kind == "tumbling":
+                            key = (view.metric,
+                                   view.sub.identity_key())
+                            self._by_identity.setdefault(key, view)
+            except BaseException:
+                for view in views:
+                    view.shared.detach(view)
+                with self._lock:
+                    self._queries.pop(cid, None)
+                raise
+        LOG.info("registered continuous query %s (%d sub-plans, "
+                 "%d new shared partials)", cid, len(views),
+                 len(new_groups))
         return cq
 
-    def _index_plan_locked(self, plan: IncrementalSubPlan) -> None:
-        if plan.metric_id is not None:
-            self._by_mid.setdefault(plan.metric_id, []).append(plan)
+    def _find_group_locked(self, metric: str, fid: tuple,
+                           interval_ms: int) -> SharedPartial | None:
+        """The best existing shared partial this sub-expression can
+        attach to: same metric, same membership filters, base
+        interval dividing the sub's interval (coarsest such base
+        wins — least stride work per serve)."""
+        best = None
+        for g in self._partials:
+            if g.metric == metric \
+                    and getattr(g, "filter_key", None) == fid \
+                    and interval_ms % g.interval_ms == 0:
+                if best is None or g.interval_ms > best.interval_ms:
+                    best = g
+        return best
+
+    def _index_group_locked(self, group: SharedPartial) -> None:
+        if group.metric_id is not None:
+            self._by_mid.setdefault(group.metric_id, []).append(group)
         else:
-            self._unresolved.append(plan)
+            self._unresolved.append(group)
+
+    def _drop_group_locked(self, group: SharedPartial) -> None:
+        if group in self._partials:
+            self._partials.remove(group)
+        if group.metric_id is not None:
+            lst = self._by_mid.get(group.metric_id, [])
+            if group in lst:
+                lst.remove(group)
+            if not lst:
+                self._by_mid.pop(group.metric_id, None)
+        if group in self._unresolved:
+            self._unresolved.remove(group)
 
     def delete(self, cid: str) -> bool:
         with self._lock:
@@ -249,25 +376,22 @@ class ContinuousQueryRegistry:
             if cq is None:
                 return False
             cq.closed = True
-            for plan in cq.plans:
-                if plan.metric_id is not None:
-                    lst = self._by_mid.get(plan.metric_id, [])
-                    if plan in lst:
-                        lst.remove(plan)
-                    if not lst:
-                        self._by_mid.pop(plan.metric_id, None)
-                if plan in self._unresolved:
-                    self._unresolved.remove(plan)
-                key = (plan.metric, plan.sub.identity_key())
-                if self._by_identity.get(key) is plan:
+            for view in cq.plans:
+                if view.shared.detach(view):
+                    self._drop_group_locked(view.shared)
+                if view.window.kind != "tumbling":
+                    continue
+                key = (view.metric, view.sub.identity_key())
+                if self._by_identity.get(key) is view:
                     del self._by_identity[key]
                     # a surviving query with the same identity takes
                     # over the pull path instead of silently falling
                     # back to batch scans
                     for other in self._queries.values():
                         for p in other.plans:
-                            if (p.metric,
-                                    p.sub.identity_key()) == key:
+                            if p.window.kind == "tumbling" and \
+                                    (p.metric,
+                                     p.sub.identity_key()) == key:
                                 self._by_identity[key] = p
                                 break
                         if key in self._by_identity:
@@ -288,26 +412,29 @@ class ContinuousQueryRegistry:
             return [self._queries[k] for k in sorted(self._queries)]
 
     def invalidate(self) -> None:
-        """Mark every plan for rebuild (the ``/api/dropcaches``
+        """Mark every partial for rebuild (the ``/api/dropcaches``
         escape hatch: the next serve/pump re-seeds from the store)."""
-        for cq in self.list():
-            for plan in cq.plans:
-                plan.needs_rebuild = True
+        with self._lock:
+            groups = list(self._partials)
+        for group in groups:
+            group.needs_rebuild = True
 
     def shutdown(self) -> None:
         for cq in self.list():
             self.delete(cq.id)
+        self.workers.stop()
 
     # ------------------------------------------------------------------
-    # ingest tap (called from TSDB under the write-hook guard)
+    # ingest tap (called from TSDB under the write-hook guard):
+    # O(1) columnar enqueue per shared partial — never a fold
     # ------------------------------------------------------------------
 
-    def _plans_for(self, metric_id: int
-                   ) -> list[IncrementalSubPlan] | None:
-        plans = self._by_mid.get(metric_id)
-        if plans is not None or not self._unresolved:
-            return plans
-        # a parked plan's metric may have just been minted by this
+    def _groups_for(self, metric_id: int
+                    ) -> list[SharedPartial] | None:
+        groups = self._by_mid.get(metric_id)
+        if groups is not None or not self._unresolved:
+            return groups
+        # a parked partial's metric may have just been minted by this
         # very write: resolve by name once, then the fast path hits
         with self._lock:
             if not self._unresolved:
@@ -316,75 +443,134 @@ class ContinuousQueryRegistry:
                 name = self.tsdb.uids.metrics.get_name(metric_id)
             except LookupError:
                 return None
-            for plan in list(self._unresolved):
-                if plan.metric == name:
-                    plan.metric_id = metric_id
-                    self._unresolved.remove(plan)
-                    self._by_mid.setdefault(metric_id, []).append(plan)
+            for group in list(self._unresolved):
+                if group.metric == name:
+                    group.metric_id = metric_id
+                    self._unresolved.remove(group)
+                    self._by_mid.setdefault(metric_id,
+                                            []).append(group)
             return self._by_mid.get(metric_id)
 
     def offer(self, metric_id: int, sid: int, ts_ms: int,
               value: float) -> None:
-        plans = self._plans_for(metric_id)
-        if not plans:
+        groups = self._groups_for(metric_id)
+        if not groups:
             return
         sid_a = np.asarray([sid], dtype=np.int64)
         ts_a = np.asarray([ts_ms], dtype=np.int64)
         val_a = np.asarray([value], dtype=np.float64)
-        for plan in plans:
-            if plan.offer(sid_a, ts_a, val_a) >= self.buffer_points:
-                self._drain_plan(plan)
-        self._maybe_publish()
+        for group in groups:
+            self._post_offer(group, group.offer(sid_a, ts_a, val_a))
+        self._notify_publish()
 
     def offer_many(self, metric_id: int, sid: int, ts_ms: np.ndarray,
                    values: np.ndarray) -> None:
-        plans = self._plans_for(metric_id)
-        if not plans:
+        groups = self._groups_for(metric_id)
+        if not groups:
             return
         n = len(ts_ms)
         sid_a = np.full(n, sid, dtype=np.int64)
-        for plan in plans:
-            if plan.offer(sid_a, ts_ms, values) >= self.buffer_points:
-                self._drain_plan(plan)
-        self._maybe_publish()
+        for group in groups:
+            self._post_offer(group,
+                             group.offer(sid_a, ts_ms, values))
+        self._notify_publish()
 
-    def _drain_plan(self, plan: IncrementalSubPlan) -> None:
-        """Fold a plan's pending chunks under the ``stream.fold``
-        fault site + breaker. A failed fold loses the chunks, so the
-        plan is marked for rebuild (one batch re-scan) — correctness
-        is restored by the rebuild, availability by the batch-engine
-        fallback in the meantime."""
-        pending = plan.take_pending()
-        if not pending:
+    def _post_offer(self, group: SharedPartial, pending: int) -> None:
+        """Post-enqueue policy, still on the write path so it must be
+        O(1): hand a full buffer to the workers; DEGRADE a partial
+        whose backlog says the workers cannot keep up — drop the
+        backlog, rebuild on the next serve, never block the write."""
+        if pending > self.max_pending_points:
+            dropped = group.drop_pending()
+            group.needs_rebuild = True
+            self.backpressure_drops += dropped
+            self.backpressure_events += 1
+            LOG.warning(
+                "streaming partial for %s lagging (%d pending "
+                "points > tsd.streaming.workers.max_pending_points);"
+                " degraded to rebuild-on-serve", group.metric,
+                dropped)
+        elif pending >= self.buffer_points:
+            if self.workers.enabled:
+                self.workers.submit(group)
+            else:
+                # workers disabled (tsd.streaming.workers.count=0):
+                # the v1 inline drain is the explicit opt-back-in
+                self._drain_group(group)
+
+    def _notify_publish(self) -> None:
+        if self._active_subs <= 0:
             return
-        br = self.breaker
-        if br is not None and br.blocking():
-            # folds while open would be wasted against a failing
-            # dependency; the rebuild after reset covers the gap
-            plan.needs_rebuild = True
-            return
+        if self.workers.enabled:
+            self.workers.notify_publish()
+        else:
+            self._maybe_publish()
+
+    # ------------------------------------------------------------------
+    # folds: off-path (workers) or serve-path (synchronous freshness)
+    # ------------------------------------------------------------------
+
+    def _drain_group(self, group: SharedPartial) -> None:
+        """Fold a partial's pending chunks under the ``stream.fold``
+        fault site + breaker. Drains serialize per partial
+        (``_drain_lock``) so worker and serve-path drains fold chunks
+        in arrival order. A failed fold loses the chunks, so the
+        partial is marked for rebuild (one batch re-scan) —
+        correctness is restored by the rebuild, availability by the
+        batch-engine fallback in the meantime."""
+        with group._drain_lock:
+            pending = group.take_pending()
+            if not pending:
+                return
+            br = self.breaker
+            if br is not None and br.blocking():
+                # folds while open would be wasted against a failing
+                # dependency; the rebuild after reset covers the gap
+                group.needs_rebuild = True
+                return
+            try:
+                faults = getattr(self.tsdb, "faults", None)
+                if faults is not None:
+                    faults.check("stream.fold")
+                for sids, ts, vals in pending:
+                    group.fold(sids, ts, vals)
+            except Exception as exc:  # noqa: BLE001 - degrade
+                self.fold_errors += 1
+                group.needs_rebuild = True
+                if br is not None:
+                    br.record_failure()
+                LOG.warning("stream.fold failed for %s (%s: %s); "
+                            "partial will rebuild", group.metric,
+                            type(exc).__name__, exc)
+            else:
+                if br is not None and br.state != br.CLOSED:
+                    br.record_success()
+
+    def worker_drain(self, group: SharedPartial) -> None:
+        """One worker-pool drain: the ``stream.worker`` fault site
+        wraps the hand-off so worker faults degrade exactly like fold
+        faults (rebuild-on-serve, breaker, counters) without ever
+        touching the write path or a serve."""
         try:
             faults = getattr(self.tsdb, "faults", None)
             if faults is not None:
-                faults.check("stream.fold")
-            for sids, ts, vals in pending:
-                plan.fold(sids, ts, vals)
-        except Exception as exc:  # noqa: BLE001 - degrade, never fail
+                faults.check("stream.worker")
+        except Exception as exc:  # noqa: BLE001 - degrade
             self.fold_errors += 1
-            plan.needs_rebuild = True
-            if br is not None:
-                br.record_failure()
-            LOG.warning("stream.fold failed for %s (%s: %s); plan "
-                        "will rebuild", plan.metric,
+            group.needs_rebuild = True
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            LOG.warning("stream.worker failed for %s (%s: %s); "
+                        "partial will rebuild", group.metric,
                         type(exc).__name__, exc)
-        else:
-            if br is not None and br.state != br.CLOSED:
-                br.record_success()
+            return
+        self._drain_group(group)
 
-    def _rebuild_plan(self, plan: IncrementalSubPlan,
-                      now_ms: int) -> bool:
-        """Re-seed a failed plan from the store, gated by the breaker
-        (a rebuild IS the half-open probe when the breaker is open)."""
+    def _rebuild_group(self, group: SharedPartial,
+                       now_ms: int) -> bool:
+        """Re-seed a failed partial from the store, gated by the
+        breaker (a rebuild IS the half-open probe when the breaker
+        is open)."""
         br = self.breaker
         if br is not None and not br.allow():
             return False
@@ -392,15 +578,17 @@ class ContinuousQueryRegistry:
             faults = getattr(self.tsdb, "faults", None)
             if faults is not None:
                 faults.check("stream.fold")
-            plan.bootstrap(now_ms)
+            group.bootstrap(now_ms)
         except Exception as exc:  # noqa: BLE001
             if br is not None:
                 br.record_failure()
             LOG.warning("stream rebuild failed for %s (%s: %s)",
-                        plan.metric, type(exc).__name__, exc)
+                        group.metric, type(exc).__name__, exc)
             return False
-        plan.needs_rebuild = False
+        group.needs_rebuild = False
         self.rebuilds += 1
+        if group.tier_seeded:
+            self.tier_seeded_bootstraps += 1
         if br is not None:
             br.record_success()
         return True
@@ -410,9 +598,9 @@ class ContinuousQueryRegistry:
     # ------------------------------------------------------------------
 
     def try_serve(self, tsq: TSQuery, sub, engine) -> list | None:
-        """Results for one sub-query when a registered plan covers the
-        requested window, else None (caller falls through to the
-        result cache / batch engine).
+        """Results for one sub-query when a registered tumbling view
+        covers the requested window, else None (caller falls through
+        to the result cache / batch engine).
 
         Exactness contract: bucket-aligned absolute windows (and any
         window whose end is past the newest folded point) are
@@ -425,45 +613,48 @@ class ContinuousQueryRegistry:
         if tsq.delete or sub.percentiles or tsq.timezone \
                 or tsq.use_calendar:
             return None
-        plan = self._by_identity.get((sub.metric, sub.identity_key()))
-        if plan is None:
+        view = self._by_identity.get((sub.metric, sub.identity_key()))
+        if view is None:
             return None
-        iv = plan.interval_ms
+        group = view.shared
+        iv = view.interval_ms
         relative = _is_relative(tsq.start) or _is_relative(tsq.end)
         if not relative and tsq.start_ms % iv:
             return None
-        # lifecycle demotion: windows that reach behind the metric's
-        # demotion boundary need tier history the partials never saw
-        # (plans fold raw writes only; a rebuild scans raw only) — shed
-        # those to the batch engine, whose stitched store serves them
+        # lifecycle demotion: a partial seeded from the stitched
+        # rollup/cold tiers covers pre-boundary history exactly;
+        # one that is NOT tier-seeded (no nesting tier configured)
+        # never saw it — shed those windows to the batch engine,
+        # whose stitched store serves them
         lc = getattr(self.tsdb, "lifecycle", None)
-        if lc is not None and \
+        if lc is not None and not group.tier_seeded and \
                 tsq.start_ms < lc.demote_boundary_for(sub.metric):
             self.serve_fallbacks += 1
             return None
-        # deletes/repairs bump the store's mutation epoch; partials
-        # cannot unfold removed points, so a mismatch forces a rebuild
-        # before anything is served (this also covers delete=true
-        # queries and fsck repairs the registry never sees directly)
-        if plan.store_epoch != getattr(self.tsdb.store,
-                                       "mutation_epoch", 0):
-            plan.needs_rebuild = True
-        if plan.needs_rebuild and not self._rebuild_plan(
-                plan, tsq.end_ms):
+        # deletes/repairs/sweeps bump the read-set's mutation epochs;
+        # partials cannot unfold removed points, so a mismatch forces
+        # a rebuild before anything is served (this also covers
+        # delete=true queries and fsck repairs the registry never
+        # sees directly)
+        if group.epoch_changed():
+            group.needs_rebuild = True
+        if group.needs_rebuild and not self._rebuild_group(
+                group, tsq.end_ms):
             self.serve_fallbacks += 1
             return None
-        self._drain_plan(plan)
-        if plan.needs_rebuild:  # the drain itself just failed
+        # synchronous drain: freshness never depends on worker lag
+        self._drain_group(group)
+        if group.needs_rebuild:  # the drain itself just failed
             self.serve_fallbacks += 1
             return None
         if not relative and (tsq.end_ms + 1) % iv \
-                and tsq.end_ms < plan.max_ts_ms:
+                and tsq.end_ms < group.max_ts_ms:
             # checked AFTER the drain: points past the unaligned end
             # may have just folded into the final bucket — the batch
             # engine would exclude them, so exactness is gone
             self.serve_fallbacks += 1
             return None
-        out = plan.serve(tsq, sub, engine)
+        out = view.serve(tsq, sub, engine)
         if out is None:
             self.serve_fallbacks += 1
             return None
@@ -533,10 +724,10 @@ class ContinuousQueryRegistry:
                 self.sse_events_delivered += sub.events
 
     def _maybe_publish(self) -> None:
-        """Rate-limited push after ingest drains: at most one publish
-        per ``tsd.streaming.publish_min_interval_ms`` per query, and
-        only when someone is listening (one integer read on the hot
-        write path when nobody is)."""
+        """Rate-limited push pass: at most one publish per
+        ``tsd.streaming.publish_min_interval_ms`` per query, and only
+        when someone is listening. v1 ran this on the write path; v2
+        runs it on the worker pool (the tap just sets a flag)."""
         if self._active_subs <= 0:
             return
         now = time.monotonic()
@@ -546,31 +737,47 @@ class ContinuousQueryRegistry:
             if (now - cq.last_publish) * 1000.0 \
                     < self.publish_min_interval_ms:
                 continue
-            if any(p.changed_ts or p.pending_points
+            if any(p.changed_ts or p.shared.pending_points
                    for p in cq.plans):
                 self.pump(cq)
 
-    def pump(self, cq: ContinuousQuery, force: bool = False) -> bool:
-        """Drain + publish one query's incremental updates to every
-        subscriber. Returns True when an event was published. Called
-        from the SSE generator's heartbeat loop and from the ingest
-        drain path (rate-limited)."""
+    def _pump_groups(self, cq: ContinuousQuery) -> bool:
+        """Rebuild-if-needed + drain every distinct partial under one
+        query (shared partials drain once however many views ride
+        them). Returns False when any partial is STILL marked for
+        rebuild afterwards — its state is known-stale (breaker open,
+        rebuild/drain failure) and exactness-requiring callers must
+        not serve from it."""
         anchor = None
-        epoch = getattr(self.tsdb.store, "mutation_epoch", 0)
-        for plan in cq.plans:
-            if plan.store_epoch != epoch:
-                # a delete/repair happened: partials cannot unfold
-                # removed points — re-seed before publishing
-                plan.needs_rebuild = True
-            if plan.needs_rebuild:
+        seen: set[int] = set()
+        clean = True
+        for view in cq.plans:
+            group = view.shared
+            if id(group) in seen:
+                continue
+            seen.add(id(group))
+            if group.epoch_changed():
+                # a delete/repair/sweep happened: partials cannot
+                # unfold removed points — re-seed before publishing
+                group.needs_rebuild = True
+            if group.needs_rebuild:
                 if anchor is None:
                     try:
                         anchor = self._emit_tsq(
                             cq, int(time.time() * 1000)).end_ms
                     except BadRequestError:
                         anchor = int(time.time() * 1000)
-                self._rebuild_plan(plan, anchor)
-            self._drain_plan(plan)
+                self._rebuild_group(group, anchor)
+            self._drain_group(group)
+            clean &= not group.needs_rebuild
+        return clean
+
+    def pump(self, cq: ContinuousQuery, force: bool = False) -> bool:
+        """Drain + publish one query's incremental updates to every
+        subscriber. Returns True when an event was published. Called
+        from the SSE generator's heartbeat loop and from the worker
+        pool's publish pass (rate-limited)."""
+        self._pump_groups(cq)
         if not force and not any(p.changed_ts for p in cq.plans):
             return False
         return self._publish(cq, snapshot=False)
@@ -587,6 +794,37 @@ class ContinuousQueryRegistry:
         tsq = TSQuery.from_json(cq.raw)
         return tsq.validate(now_ms)
 
+    def current_results(self, cq: ContinuousQuery,
+                        now_ms: int | None = None) -> list[dict]:
+        """The query's CURRENT windowed results as row dicts (the
+        ``GET .../result`` fetch surface — the only pull path for
+        sliding/session windows). Drains pending folds first, so the
+        answer reflects every acknowledged write — and REFUSES with a
+        structured 503 (DegradedError) when a partial is known-stale
+        (rebuild failed / breaker open): unlike /api/query there is
+        no batch engine to shed a windowed result to, and serving
+        stale data silently would break the freshness contract."""
+        from opentsdb_tpu.query.engine import QueryEngine
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        tsq = self._emit_tsq(cq, now_ms)
+        if not self._pump_groups(cq):
+            raise DegradedError(
+                f"continuous query {cq.id!r}: partials are "
+                f"rebuilding (fold failure or open stream.fold "
+                f"breaker); retry shortly")
+        engine = QueryEngine(self.tsdb)
+        rows: list[dict] = []
+        for view, sub in zip(cq.plans, tsq.queries):
+            results = view.serve(tsq, sub, engine) or []
+            for r in results:
+                rows.append({
+                    "metric": r.metric, "tags": r.tags,
+                    "aggregateTags": r.aggregated_tags,
+                    "index": r.sub_query_index,
+                    "dps": {str(ts): (None if v != v else v)
+                            for ts, v in r.dps}})
+        return rows
+
     def _publish(self, cq: ContinuousQuery, snapshot: bool,
                  only: list | None = None) -> bool:
         from opentsdb_tpu.streaming import sse
@@ -599,8 +837,8 @@ class ContinuousQueryRegistry:
         engine = QueryEngine(self.tsdb)
         from opentsdb_tpu.query.model import effective_pixels
         updates = []
-        for plan, sub in zip(cq.plans, tsq.queries):
-            changed = None if snapshot else set(plan.take_changed())
+        for view, sub in zip(cq.plans, tsq.queries):
+            changed = None if snapshot else set(view.take_changed())
             if changed is not None and not changed:
                 continue
             if changed is not None and effective_pixels(tsq, sub)[0]:
@@ -613,10 +851,16 @@ class ContinuousQueryRegistry:
                 # window of a dense full-resolution plan.
                 changed = None
             if changed is not None:
+                # map fold-dirty base buckets to the output buckets
+                # this view's window re-emits (sliding fans each fold
+                # into its k trailing outputs; session publishes the
+                # whole frame — a fold can move a session's start)
+                changed = view.publish_buckets(changed)
+            if changed is not None:
                 # result timestamps are second-rounded unless
                 # ms_resolution; changed buckets are ms edges
                 changed |= {c // 1000 * 1000 for c in changed}
-            results = plan.serve(tsq, sub, engine)
+            results = view.serve(tsq, sub, engine)
             if not results:
                 continue
             for r in results:
@@ -673,15 +917,20 @@ class ContinuousQueryRegistry:
 
     def _totals(self) -> dict[str, int]:
         t = {"points_folded": 0, "folds": 0, "late_dropped": 0,
-             "pending_points": 0, "series": 0, "plans": 0}
-        for cq in self.list():
-            for p in cq.plans:
-                t["points_folded"] += p.points_folded
-                t["folds"] += p.folds
-                t["late_dropped"] += p.late_dropped
-                t["pending_points"] += p.pending_points
-                t["series"] += len(p._sids)
-                t["plans"] += 1
+             "preboundary_dropped": 0, "pending_points": 0,
+             "series": 0, "plans": 0, "groups": 0}
+        with self._lock:
+            groups = list(self._partials)
+            t["plans"] = sum(len(cq.plans)
+                             for cq in self._queries.values())
+        for g in groups:
+            t["points_folded"] += g.points_folded
+            t["folds"] += g.folds
+            t["late_dropped"] += g.late_dropped
+            t["preboundary_dropped"] += g.preboundary_dropped
+            t["pending_points"] += g.pending_points
+            t["series"] += len(g._sids)
+            t["groups"] += 1
         return t
 
     def collect_stats(self, collector) -> None:
@@ -692,6 +941,9 @@ class ContinuousQueryRegistry:
                        for cq in self._queries.values())
         collector.record("streaming.queries", n)
         collector.record("streaming.plans", t["plans"])
+        # shared partials actually folding: plans/groups is the plan-
+        # sharing ratio (N dashboards per fold)
+        collector.record("streaming.groups", t["groups"])
         collector.record("streaming.series", t["series"])
         collector.record("streaming.points.folded", t["points_folded"])
         collector.record("streaming.folds", t["folds"])
@@ -699,11 +951,25 @@ class ContinuousQueryRegistry:
                          t["pending_points"])
         collector.record("streaming.points.late_dropped",
                          t["late_dropped"])
+        collector.record("streaming.points.preboundary_dropped",
+                         t["preboundary_dropped"])
         collector.record("streaming.serve.hits", self.serve_hits)
         collector.record("streaming.serve.fallbacks",
                          self.serve_fallbacks)
         collector.record("streaming.fold.errors", self.fold_errors)
         collector.record("streaming.rebuilds", self.rebuilds)
+        collector.record("streaming.rebuilds.tier_seeded",
+                         self.tier_seeded_bootstraps)
+        collector.record("streaming.backpressure.dropped_points",
+                         self.backpressure_drops)
+        collector.record("streaming.backpressure.events",
+                         self.backpressure_events)
+        collector.record("streaming.worker.drains",
+                         self.workers.drains)
+        collector.record("streaming.worker.errors",
+                         self.workers.errors)
+        collector.record("streaming.worker.publish_runs",
+                         self.workers.publish_runs)
         collector.record("streaming.sse.subscribers", subs)
         collector.record("streaming.sse.events", self.sse_events)
         # delivery-side twin of sse.events: frames that actually
@@ -728,14 +994,20 @@ class ContinuousQueryRegistry:
             "enabled": True,
             "queries": n,
             "plans": t["plans"],
+            "groups": t["groups"],
             "series": t["series"],
             "points_folded": t["points_folded"],
             "pending_points": t["pending_points"],
             "late_dropped": t["late_dropped"],
+            "preboundary_dropped": t["preboundary_dropped"],
             "serve_hits": self.serve_hits,
             "serve_fallbacks": self.serve_fallbacks,
             "fold_errors": self.fold_errors,
             "rebuilds": self.rebuilds,
+            "tier_seeded_bootstraps": self.tier_seeded_bootstraps,
+            "backpressure_dropped_points": self.backpressure_drops,
+            "backpressure_events": self.backpressure_events,
+            "workers": self.workers.health_info(),
             "subscribers": subs,
             "sse_events": self.sse_events,
             "sse_events_delivered": self.sse_events_delivered,

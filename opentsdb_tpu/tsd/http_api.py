@@ -711,10 +711,17 @@ class HttpRpcRouter:
         delete standing TSQueries and attach SSE push streams.
 
         - ``POST /api/query/continuous`` — register (body: TSQuery
-          JSON + optional ``id``); 400 when the query is not
-          incrementally maintainable.
+          JSON + optional ``id`` + optional ``window`` object:
+          ``{"type": "tumbling"}`` (default), ``{"type": "sliding",
+          "size": "5m"}`` or ``{"type": "session", "gap": "2m"}`` —
+          size/gap must be multiples of the downsample interval);
+          400 when the query is not incrementally maintainable.
         - ``GET /api/query/continuous`` — list registered queries.
         - ``GET /api/query/continuous/<id>`` — one query + plan stats.
+        - ``GET /api/query/continuous/<id>/result`` — the current
+          windowed results (drains pending folds first; the only
+          pull surface for sliding/session windows, which a plain
+          TSQuery cannot express).
         - ``DELETE /api/query/continuous/<id>`` — deregister.
         - ``GET /api/query/continuous/<id>/stream`` — Server-Sent
           Events: an initial ``snapshot`` event, then incremental
@@ -735,6 +742,15 @@ class HttpRpcRouter:
                     [cq.describe() for cq in registry.list()]).encode())
             raise HttpError(405, "Method not allowed")
         cid = rest[0]
+        if len(rest) > 1 and rest[1] == "result":
+            if request.method != "GET":
+                raise HttpError(405, "Method not allowed")
+            cq = registry.get(cid)
+            if cq is None:
+                raise HttpError(
+                    404, f"No continuous query with id {cid!r}")
+            return HttpResponse(200, json.dumps(
+                registry.current_results(cq)).encode())
         if len(rest) > 1 and rest[1] == "stream":
             if request.method != "GET":
                 raise HttpError(405, "Method not allowed")

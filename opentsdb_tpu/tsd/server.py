@@ -348,6 +348,15 @@ class TSDServer:
         cluster = self.tsdb.cluster
         if cluster is not None:
             cluster.start()
+        # streaming fold workers (opentsdb_tpu/streaming/workers.py):
+        # the registry is lazy and the pool self-starts on first
+        # hand-off, but a serving TSD pays worker-thread creation at
+        # startup, not inside the first ingest burst that crosses the
+        # drain threshold. Stopped by TSDB.shutdown ->
+        # ContinuousQueryRegistry.shutdown.
+        streaming = self.tsdb.streaming
+        if streaming is not None and streaming.workers.enabled:
+            streaming.workers.start()
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 

@@ -177,6 +177,13 @@ _DEFAULTS: dict[str, str] = {
     "tsd.lifecycle.breaker.reset_timeout_ms": "60000",
     # SSE resume replay depth (Last-Event-ID; 0 disables resume)
     "tsd.streaming.resume_events": "64",
+    # shared fold-worker pool (streaming/workers.py): folds run off
+    # the ingest path on this many threads; 0 = inline drains (v1)
+    "tsd.streaming.workers.count": "2",
+    #   backlog cap per shared partial: past it the lagging partial
+    #   is DEGRADED to rebuild-on-serve (backlog dropped, counted)
+    #   instead of buffering unboundedly or blocking the write path
+    "tsd.streaming.workers.max_pending_points": "262144",
     # sharded cluster tier (opentsdb_tpu/cluster/): role "" =
     # standalone, "router" = stateless consistent-hash scatter-gather
     # tier over tsd.cluster.peers ("[name=]host:port,..."), "shard" =
@@ -191,6 +198,12 @@ _DEFAULTS: dict[str, str] = {
     #   tail-latency hedging: duplicate a peer request that hasn't
     #   answered after this many ms, first completion wins (0 = off)
     "tsd.cluster.hedge_after_ms": "0",
+    #   per-(peer, metric) known/unknown memo for the scatter path:
+    #   a shard that 400'd "no such name" for a metric is not re-asked
+    #   about it until a write for that metric is forwarded/replayed
+    #   to it (0 = cache forever until invalidated; >0 adds a TTL for
+    #   deployments where writes can bypass this router)
+    "tsd.cluster.sub_memo.ttl_ms": "0",
     #   write-forward retry ladder (reads never retry — they degrade)
     "tsd.cluster.retry.attempts": "2",
     "tsd.cluster.retry.base_ms": "25",

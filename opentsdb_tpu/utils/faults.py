@@ -66,6 +66,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "tree.store",         # tree/tree.py filing path
     "meta.store",         # meta/meta_store.py write paths
     "stream.fold",        # streaming/registry.py incremental fold
+    "stream.worker",      # streaming/workers.py off-path drain
     "lifecycle.sweep",    # lifecycle/manager.py whole sweep
     "lifecycle.demote",   # lifecycle/manager.py demotion fold
     "lifecycle.histogram",  # lifecycle/manager.py histogram demotion

@@ -928,6 +928,123 @@ class TestPerSubRetryPeerDeath:
         assert _sorted_rows(got) == _sorted_rows(want)
 
 
+@pytest.mark.usefixtures("cluster3")
+class TestPerSubRetryMemoization:
+    """The per-(peer, metric) known/unknown memo: a shard that 400'd
+    "no such name" for a metric is not re-asked about it on every
+    query — the steady state for a multi-sub query over
+    partially-known shards is ONE request per shard — and a write
+    forwarded to that shard invalidates the memo (UID creation
+    happens on the shard's write path)."""
+
+    cluster: LiveCluster
+    points: list
+
+    def _body(self, salt):
+        return {"start": BASE_MS - 10_000,
+                "end": BASE_MS + 200_000 + salt,
+                "queries": [
+                    {"metric": "c.m", "aggregator": "sum",
+                     "downsample": "10s-sum"},
+                    {"metric": "c.single", "aggregator": "sum",
+                     "downsample": "10s-sum"}]}
+
+    def test_steady_state_one_request_per_shard(self):
+        c = self.cluster
+        single = [{"metric": "c.single", "timestamp": BASE + i,
+                   "value": 5, "tags": {"host": "only"}}
+                  for i in range(60)]
+        resp = c.put(single, summary="true")
+        assert json.loads(resp.body)["failed"] == 0
+        router = c.router
+        calls: dict[str, int] = {}
+        calls_lock = threading.Lock()
+        orig = router._query_peer
+
+        def wrapper(peer, req_body):
+            with calls_lock:
+                calls[peer.name] = calls.get(peer.name, 0) + 1
+            return orig(peer, req_body)
+
+        router._query_peer = wrapper
+        try:
+            # first query: the non-owner shards 400 the combined
+            # request and take the per-sub retry (1 combined + 2
+            # per-sub requests each) — and the memo learns
+            resp, got = c.query(self._body(0))
+            assert resp.status == 200, resp.body
+            first = dict(calls)
+            assert any(n > 1 for n in first.values()), first
+            calls.clear()
+            # steady state: every shard gets exactly ONE request
+            # (the unknown sub is pre-filtered from the scatter)
+            resp, got = c.query(self._body(1))
+            assert resp.status == 200, resp.body
+            second = dict(calls)
+        finally:
+            router._query_peer = orig
+        assert all(n == 1 for n in second.values()), second
+        assert router.sub_memo_skips >= 1
+        got, degraded = _strip_marker(got)
+        assert degraded == []
+        oracle = _oracle(self.points + single)
+        want = json.loads(oracle.handle(
+            req("POST", "/api/query", self._body(1))).body)
+        assert _sorted_rows(got) == _sorted_rows(want)
+
+    def test_metric_unknown_everywhere_still_400_from_memo(self):
+        c = self.cluster
+        body = _tsq({"aggregator": "sum", "metric": "no.such.m2"},
+                    end=BASE_MS + 200_000)
+        resp, _ = c.query(body)
+        assert resp.status == 400
+        # second ask is answered from the memo (still a 400, cached
+        # no-such-name bodies join the all-shards-agree check)
+        body = _tsq({"aggregator": "sum", "metric": "no.such.m2"},
+                    end=BASE_MS + 200_001)
+        resp, out = c.query(body)
+        assert resp.status == 400
+        assert "no.such.m2" in out["error"]["message"]
+
+    def test_write_invalidates_unknown_memo(self):
+        c = self.cluster
+        router = c.router
+        # learn the memo (test order within the class is fixed, but
+        # re-learning here keeps the test self-contained)
+        resp, _ = c.query(self._body(2))
+        assert resp.status == 200
+        owner = c.shard_of("c.single", {"host": "only"})
+        others = [n for n in sorted(router.peers) if n != owner]
+        assert any(router._memo_lookup(n, "c.single") is not None
+                   for n in others), "memo never learned unknown"
+        # route new c.single series to a previously-unknown shard:
+        # the write invalidates its memo, the next scatter re-asks
+        # it and the merged answer includes the new series
+        extra = []
+        for h in range(40):
+            tags = {"host": f"inv{h:02d}"}
+            if c.shard_of("c.single", tags) != owner:
+                extra = [{"metric": "c.single",
+                          "timestamp": BASE + i, "value": 7,
+                          "tags": tags} for i in range(30)]
+                break
+        assert extra, "no tag routed off the owner shard"
+        resp = c.put(extra, summary="true")
+        assert json.loads(resp.body)["failed"] == 0
+        assert router.sub_memo_invalidations >= 1
+        resp, got = c.query(self._body(3))
+        assert resp.status == 200, resp.body
+        got, degraded = _strip_marker(got)
+        assert degraded == []
+        single = [{"metric": "c.single", "timestamp": BASE + i,
+                   "value": 5, "tags": {"host": "only"}}
+                  for i in range(60)]
+        oracle = _oracle(self.points + single + extra)
+        want = json.loads(oracle.handle(
+            req("POST", "/api/query", self._body(3))).body)
+        assert _sorted_rows(got) == _sorted_rows(want)
+
+
 class TestScatterPreservesRollupUsage:
     def test_to_json_round_trips_non_default(self):
         sub = TSSubQuery.from_json(

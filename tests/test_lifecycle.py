@@ -260,20 +260,33 @@ class TestDemotionOracle:
                           "filter": "late", "groupBy": False}]}
         assert _dps(_query(t1, q)) == _dps(_query(t0, q))
 
-    def test_streaming_declines_pre_boundary_windows(self):
-        _, t1 = self._pair()
-        mid = t1.uids.metrics.get_id("sys.cpu")
-        boundary = t1.lifecycle.demote_boundary(mid)
+    def test_streaming_preboundary_windows_tier_seed_or_decline(self):
+        """Streaming v2: a CQ whose buckets nest the demoted tier
+        (1m tier | 1m plan) seeds from the stitched tiers and serves
+        the pre-boundary window incrementally, value-identical to
+        the batch engine; a non-nesting plan (90s) keeps the v1
+        decline-to-batch behavior."""
+        t0, t1 = self._pair()
         qobj = {"start": BASE_MS, "end": NOW_MS,
                 "queries": [{"metric": "sys.cpu", "aggregator": "sum",
                              "downsample": "1m-sum"}]}
         reg = t1.streaming
-        reg.register(qobj, now_ms=NOW_MS)
+        cq = reg.register(qobj, now_ms=NOW_MS)
+        assert cq.plans[0].shared.tier_seeded
         res = _query(t1, qobj["queries"][0])
-        assert res and reg.serve_hits == 0 and reg.serve_fallbacks >= 1
-        # a tail-only window IS served from the plan
-        res = _query(t1, qobj["queries"][0], start=boundary)
-        assert res and reg.serve_hits == 1
+        assert res and reg.serve_hits == 1 \
+            and reg.serve_fallbacks == 0, \
+            "tier-seeded plan fell back to the batch engine"
+        assert _dps(res) == _dps(_query(t0, qobj["queries"][0]))
+        # no nesting tier (90s % 60s != 0): pre-boundary windows
+        # still decline to the (stitched) batch engine
+        q90 = {"start": BASE_MS, "end": NOW_MS,
+               "queries": [{"metric": "sys.cpu", "aggregator": "sum",
+                            "downsample": "90s-sum"}]}
+        reg.register(q90, now_ms=NOW_MS)
+        res = _query(t1, q90["queries"][0])
+        assert res and reg.serve_hits == 1 \
+            and reg.serve_fallbacks >= 1
 
     def test_backfill_behind_boundary_survives_next_sweep(self):
         """A point backfilled behind the demotion boundary is never

@@ -25,6 +25,15 @@ from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
 
 pytestmark = pytest.mark.streaming
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _streaming_lock_witness(lock_witness):
+    """Whole battery under the runtime lock-order witness (PR 9
+    rule: write-path concurrency — here the shared partials' fold /
+    pending / drain locks — is machine-checked, not hand-reviewed)."""
+    yield lock_witness
+
+
 BASE = 1356998400
 BASE_MS = BASE * 1000
 IV_MS = 60_000               # 1m downsample interval

@@ -21,6 +21,14 @@ from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
 
 pytestmark = [pytest.mark.streaming, pytest.mark.robustness]
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _streaming_lock_witness(lock_witness):
+    """Degradation battery under the runtime lock-order witness too:
+    the fault paths take the same fold/drain/pending locks."""
+    yield lock_witness
+
+
 BASE = 1356998400
 BASE_MS = BASE * 1000
 END_MS = BASE_MS + 1800 * 1000
