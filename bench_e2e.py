@@ -950,6 +950,133 @@ def bench_ingest(repeats: int, n_points: int = 120_000,
     return out
 
 
+def bench_obs(repeats: int, n_points: int = 60_000,
+              n_series: int = 200) -> dict:
+    """Tracing overhead config: the ``ingest`` (HTTP /api/put door)
+    and ``viz`` (dense dashboard query) workloads with tracing ON at
+    default sampling (tsd.trace.enable=true, sample=64) vs OFF.
+    Requests route through HttpRpcRouter.handle so they pay the real
+    root-trace + stage-span cost. WAL off and result cache off — the
+    strictest (least-amortized) setting for relative overhead.
+    Criterion: p50 overhead <= 5% on both workloads."""
+    import json as _json
+    import shutil
+    import tempfile
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+    rng = np.random.default_rng(31)
+    ts = BASE_S + np.arange(n_points, dtype=np.int64) % 7200
+    hosts = np.arange(n_points) % n_series
+    vals = np.round(rng.normal(100, 10, n_points), 2)
+    body_pts = 2000
+    put_dicts = [{"metric": "sys.obs", "timestamp": int(ts[i]),
+                  "value": float(vals[i]),
+                  "tags": {"host": f"h{hosts[i]:04d}"}}
+                 for i in range(n_points)]
+    bodies = [_json.dumps(put_dicts[lo:lo + body_pts]).encode()
+              for lo in range(0, n_points, body_pts)]
+
+    def mk(trace_on: bool):
+        d = tempfile.mkdtemp(prefix="obsbench-")
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.storage.backend": "memory",
+            "tsd.storage.data_dir": d,
+            "tsd.storage.wal.enable": "false",
+            "tsd.query.cache.enable": "false",
+            "tsd.tpu.warmup": "false",
+            "tsd.trace.enable": "true" if trace_on else "false",
+        }))
+        return d, t, HttpRpcRouter(t)
+
+    def ingest_pass(trace_on: bool) -> float:
+        d, t, router = mk(trace_on)
+        try:
+            t0 = time.perf_counter()
+            for body in bodies:
+                r = router.handle(HttpRequest(
+                    "POST", "/api/put", {}, body=body))
+                assert r.status == 204, r.body
+            return time.perf_counter() - t0
+        finally:
+            t.shutdown()
+            shutil.rmtree(d, ignore_errors=True)
+
+    # interleave off/on passes (host noise on a shared box swings
+    # single-config timings by +-30% — far more than the effect under
+    # test; alternation distributes it fairly) and compare best-of
+    ing = {False: [], True: []}
+    for _ in range(max(repeats, 4)):
+        for mode in (False, True):
+            ing[mode].append(ingest_pass(mode))
+
+    span_s = 4 * 3600  # 4h @ 1s x 12 series: serialization-heavy
+    ts_grid = BASE_MS + np.arange(span_s, dtype=np.int64) * 1000
+
+    def mk_viz(trace_on: bool):
+        d, t, router = mk(trace_on)
+        mid = t.uids.metrics.get_or_create_id("sys.viz")
+        kid = t.uids.tag_names.get_or_create_id("host")
+        sids = np.asarray([
+            t.store.get_or_create_series(
+                mid, [(kid,
+                       t.uids.tag_values.get_or_create_id(
+                           f"h{j}"))])
+            for j in range(12)], dtype=np.int64)
+        t.store.append_grid(
+            sids, ts_grid, rng.normal(100, 10, (12, span_s)),
+            np.ones((12, span_s), dtype=bool))
+        return d, t, router
+
+    qb = _json.dumps({
+        "start": BASE_MS, "end": BASE_MS + span_s * 1000,
+        "queries": [{"metric": "sys.viz", "aggregator": "sum",
+                     "downsample": "1s-avg",
+                     "filters": [{"type": "wildcard", "tagk": "host",
+                                  "filter": "*",
+                                  "groupBy": True}]}],
+        "pixels": 1500}).encode()
+    viz = {False: mk_viz(False), True: mk_viz(True)}
+    times = {False: [], True: []}
+    try:
+        for mode in (False, True):  # warm compiles (shared cache)
+            r = viz[mode][2].handle(HttpRequest(
+                "POST", "/api/query", {}, body=qb))
+            assert r.status == 200, r.body
+        for _ in range(max(repeats, 9)):
+            for mode in (False, True):
+                t0 = time.perf_counter()
+                r = viz[mode][2].handle(HttpRequest(
+                    "POST", "/api/query", {}, body=qb))
+                times[mode].append(time.perf_counter() - t0)
+                assert r.status == 200
+        trace_counters = viz[True][1].tracer.health_info()
+    finally:
+        for mode in (False, True):
+            viz[mode][1].shutdown()
+            shutil.rmtree(viz[mode][0], ignore_errors=True)
+
+    out = {
+        "config": "obs", "points": n_points,
+        "ingest_s_trace_off": round(min(ing[False]), 4),
+        "ingest_s_trace_on": round(min(ing[True]), 4),
+        "ingest_overhead": round(
+            min(ing[True]) / max(min(ing[False]), 1e-9), 4),
+        "viz_p50_ms_trace_off": round(
+            _percentile(times[False], 50) * 1e3, 2),
+        "viz_p50_ms_trace_on": round(
+            _percentile(times[True], 50) * 1e3, 2),
+        "viz_overhead": round(
+            _percentile(times[True], 50)
+            / max(_percentile(times[False], 50), 1e-9), 4),
+        "trace_counters_on": trace_counters,
+    }
+    out["criterion_pass"] = bool(out["ingest_overhead"] <= 1.05
+                                 and out["viz_overhead"] <= 1.05)
+    return out
+
+
 def bench_viz(repeats: int, n_hosts: int = 8, per_host: int = 5,
               span_s: int = 172_800) -> dict:
     """Pixel-aware serve-path downsampling config: a config2-style
@@ -1266,7 +1393,8 @@ def main() -> None:
                "wal": bench_wal, "live": bench_live,
                "lifecycle": bench_lifecycle, "cold": bench_cold,
                "ingest": bench_ingest, "viz": bench_viz,
-               "cluster": bench_cluster, "streamv2": bench_streamv2}
+               "cluster": bench_cluster, "streamv2": bench_streamv2,
+               "obs": bench_obs}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

@@ -36,19 +36,24 @@ class PeerClient:
 
     def request(self, method: str, path: str,
                 body: bytes | None = None,
-                timeout_s: float | None = None
+                timeout_s: float | None = None,
+                headers: dict[str, str] | None = None
                 ) -> tuple[int, bytes]:
         """One request; returns ``(status, body)``. 5xx and every
         transport failure raise :class:`PeerError`; 2xx-4xx return —
-        a 400 from a healthy peer is not peer damage."""
+        a 400 from a healthy peer is not peer damage. ``headers``
+        are extras (e.g. the ``X-TSD-Trace`` propagation header)."""
         conn = http.client.HTTPConnection(
             self.host, self.port,
             timeout=timeout_s if timeout_s is not None
             else self.timeout_s)
         try:
-            headers = {"Content-Type": "application/json",
-                       "Connection": "close"}
-            conn.request(method, path, body=body, headers=headers)
+            all_headers = {"Content-Type": "application/json",
+                           "Connection": "close"}
+            if headers:
+                all_headers.update(headers)
+            conn.request(method, path, body=body,
+                         headers=all_headers)
             resp = conn.getresponse()
             data = resp.read()
             status = resp.status

@@ -27,6 +27,7 @@ salt-bucket fan-out of ``SaltScanner.java:70`` lifted to the network):
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import json
 import logging
@@ -36,6 +37,9 @@ import time
 from typing import Any
 
 from opentsdb_tpu.cluster import merge as merge_mod
+from opentsdb_tpu.obs import trace as trace_mod
+from opentsdb_tpu.obs.trace import (TRACE_HEADER, trace_begin,
+                                    trace_end)
 from opentsdb_tpu.cluster.client import (PeerClient, PeerError,
                                          parse_peer_spec)
 from opentsdb_tpu.cluster.hashring import HashRing
@@ -86,6 +90,12 @@ class Peer:
         self.replay_point_errors = 0
         self.query_failures = 0
         self.hedges = 0
+        # (best-effort, in-memory) trace ids of recently spooled
+        # batches, FIFO-aligned with the spool: a later replay root
+        # links back to the writes it finally delivered. Lost on
+        # restart — the durable spool format stays trace-agnostic.
+        self.spool_trace_links: collections.deque = \
+            collections.deque(maxlen=512)
 
     def health_info(self) -> dict[str, Any]:
         return {
@@ -212,20 +222,24 @@ class ClusterRouter:
             faults.check(f"cluster.peer.{peer.name}")
 
     def _fetch(self, peer: Peer, method: str, path: str,
-               body: bytes | None) -> tuple[int, bytes]:
+               body: bytes | None,
+               headers: dict[str, str] | None = None
+               ) -> tuple[int, bytes]:
         """One request with optional tail-latency hedging: after
         ``tsd.cluster.hedge_after_ms`` without an answer, a duplicate
         request races the first — first completion wins (Monarch /
         Dean & Barroso "The Tail at Scale"). Hedge threads are
         bounded by the peer socket timeout."""
         if self.hedge_after_s <= 0:
-            return peer.client.request(method, path, body)
+            return peer.client.request(method, path, body,
+                                       headers=headers)
         results: queue_mod.Queue = queue_mod.Queue()
 
         def attempt() -> None:
             try:
                 results.put(("ok",
-                             peer.client.request(method, path, body)))
+                             peer.client.request(method, path, body,
+                                                 headers=headers)))
             except Exception as exc:  # noqa: BLE001 - carried across
                 results.put(("err", exc))
 
@@ -388,8 +402,10 @@ class ClusterRouter:
         batches, errors = self.partition_points(points)
         failed = len(errors)
         success = 0
+        tctx = trace_mod.current()
         futures = {
-            self.pool.submit(self._deliver, self.peers[name], dps):
+            self.pool.submit(self._deliver_traced, tctx,
+                             self.peers[name], dps):
             (name, dps) for name, dps in batches.items()}
         for fut, (name, dps) in futures.items():
             try:
@@ -410,7 +426,30 @@ class ClusterRouter:
             dp["metric"] for dps in batches.values() for dp in dps)
         return success, failed, errors
 
-    def _deliver(self, peer: Peer, dps: list[dict]
+    def _deliver_traced(self, tctx, peer: Peer, dps: list[dict]
+                        ) -> tuple[int, int, list[dict]]:
+        """One shard's write leg under its ``cluster.forward`` span
+        (pool thread): the context re-binds thread-locally so the
+        spool handoff inside records its ``cluster.spool.append``
+        span, and the trace header lets the shard root its ingest
+        subtree under this leg."""
+        if tctx is None:
+            return self._deliver(peer, dps)
+        sp = trace_begin("cluster.forward", ctx=tctx,
+                         peer=peer.name, points=len(dps))
+        headers = {TRACE_HEADER: tctx.tracer.header_for(tctx, sp)} \
+            if sp is not None else None
+        try:
+            with trace_mod.use(tctx):
+                out = self._deliver(peer, dps, headers=headers)
+        except BaseException as exc:
+            trace_end(sp, error=exc)
+            raise
+        trace_end(sp)
+        return out
+
+    def _deliver(self, peer: Peer, dps: list[dict],
+                 headers: dict[str, str] | None = None
                  ) -> tuple[int, int, list[dict]]:
         """One shard's batch: forward, or spool when the peer is
         backlogged/unhealthy (FIFO: a non-empty spool means new
@@ -436,7 +475,8 @@ class ClusterRouter:
             status, data = call_with_retries(
                 lambda: self._fetch(
                     peer, "POST",
-                    "/api/put?summary=true&details=true", body),
+                    "/api/put?summary=true&details=true", body,
+                    headers=headers),
                 self.retry, retryable=(OSError,))
         except OSError as exc:
             peer.breaker.record_failure()
@@ -484,14 +524,23 @@ class ClusterRouter:
         """Durable handoff (caller holds ``peer.lock``): the ack
         rides on the spool fsync. A FULL spool refuses the points
         loudly (per-point errors) — dropping the oldest record would
-        break the no-loss guarantee."""
+        break the no-loss guarantee. The trace records the handoff
+        as a ``cluster.spool.append`` span, and the trace id is
+        remembered so the eventual replay root links back to it."""
+        sp = trace_begin("cluster.spool.append", peer=peer.name,
+                         points=len(dps))
         try:
             peer.spool.append(body)
         except SpoolFull as exc:
+            trace_end(sp, error=exc)
             return 0, len(dps), [
                 {"datapoint": dp,
                  "error": f"shard {peer.name} unreachable and its "
                           f"spool is full: {exc}"} for dp in dps]
+        tctx = trace_mod.current()
+        if tctx is not None:
+            peer.spool_trace_links.append(tctx.trace_id)
+        trace_end(sp)
         peer.spooled_batches += 1
         peer.spooled_points += len(dps)
         return len(dps), 0, []
@@ -518,28 +567,68 @@ class ClusterRouter:
         even though the peer is healthy. Stops on the first
         zero-progress pass (drained, breaker refused, or a failure
         re-opened the breaker)."""
+        if peer.spool.pending_records == 0:
+            return 0
+        # one background trace roots the catch-up drain; it links
+        # back to the (still-remembered) traces whose writes were
+        # spooled, so "where did my acked write actually land" is
+        # answerable end to end
+        tracer = getattr(self.tsdb, "tracer", None)
+        tctx = tracer.start_background("cluster.spool.replay",
+                                       peer=peer.name) \
+            if tracer is not None and tracer.enabled else None
         total = 0
-        while not self._stop.is_set():
-            n = self.try_replay(peer)
-            total += n
-            if n == 0:
-                break
+        links: list[str] = []
+        try:
+            with trace_mod.use(tctx):
+                while not self._stop.is_set():
+                    n = self.try_replay(peer, links_out=links)
+                    total += n
+                    if n == 0:
+                        break
+            if tctx is not None:
+                tctx.tag(batches=total,
+                         pending=peer.spool.pending_records,
+                         trace_links=links)
+        finally:
+            if tracer is not None and tctx is not None:
+                if total == 0:
+                    # a zero-progress probe is not worth a retained
+                    # trace; mark it sampled-out
+                    tctx.sampled = False
+                tracer.finish(tctx)
         return total
 
-    def try_replay(self, peer: Peer, max_records: int = 0) -> int:
+    def try_replay(self, peer: Peer, max_records: int = 0,
+                   links_out: list | None = None) -> int:
         """Drain up to ``max_records`` (0 = one configured batch) of
         the peer's spool if its breaker admits a dispatch. The replay
         IS the half-open probe: first success closes the breaker,
-        failure re-opens it and keeps the spool position."""
+        failure re-opens it and keeps the spool position.
+
+        Trace links are consumed per APPLIED record (inside the
+        callback) so a pass that fails partway keeps the unapplied
+        records' links aligned with the spool — popping by the pass
+        total would desynchronize forever after one partial failure.
+        """
         if peer.spool.pending_records == 0:
             return 0
         if not peer.breaker.allow():
             return 0
         limit = max_records or self.replay_batch
+
+        def apply(body: bytes) -> None:
+            self._replay_one(peer, body)
+            # this record is delivered: retire its (best-effort,
+            # FIFO-aligned) trace link
+            if peer.spool_trace_links:
+                link = peer.spool_trace_links.popleft()
+                if links_out is not None:
+                    links_out.append(link)
+
         before = peer.spool.replayed_records
         try:
-            n = peer.spool.replay(
-                lambda body: self._replay_one(peer, body), limit)
+            n = peer.spool.replay(apply, limit)
         except OSError as exc:
             if peer.spool.replayed_records > before:
                 # the records applied BEFORE the failure are readable
@@ -653,6 +742,13 @@ class ClusterRouter:
         # Deletes bypass the memo: a stale unknown entry must never
         # silently skip a purge.
         use_memo = not tsq.delete
+        # trace the fan-out: one cluster.scatter stage, one
+        # cluster.peer leg per shard (error-tagged when degraded)
+        tctx = trace_mod.current()
+        sp_scatter = trace_begin("cluster.scatter", ctx=tctx,
+                                 shards=len(self.peers))
+        scatter_id = sp_scatter.span_id if sp_scatter is not None \
+            else None
         body = json.dumps(peer_obj).encode()
         peer_sent: dict[str, list[int]] = {}
         per_peer: dict[str, list[dict]] = {}
@@ -681,8 +777,22 @@ class ClusterRouter:
                 else json.dumps(dict(
                     peer_obj,
                     queries=[peer_subs[k] for k in sent])).encode()
-            futures[name] = self.pool.submit(self._query_peer, peer,
-                                             pbody)
+            futures[name] = self.pool.submit(
+                self._query_peer_traced, tctx, scatter_id, peer,
+                pbody)
+        def mark_degraded(peer_name: str) -> None:
+            degraded.append(peer_name)
+            if tctx is not None:
+                # force retention the moment degradation is KNOWN —
+                # before the per-sub retries stamp their headers, so
+                # those legs (header_for reads ctx.forced at call
+                # time) carry keep=1 and their shard subtrees
+                # survive sampling. Legs already dispatched with
+                # keep=0 cannot be retro-retained; full shard-side
+                # fidelity for degraded traces needs sample=1 or a
+                # slowlog (which propagates keep=1 up front).
+                tctx.forced = True
+
         for name, fut in futures.items():
             peer = self.peers[name]
             sent = peer_sent[name]
@@ -691,7 +801,7 @@ class ClusterRouter:
                     timeout=self.timeout_s * 2 + 5)
             except (OSError, concurrent.futures.TimeoutError) as exc:
                 peer.query_failures += 1
-                degraded.append(name)
+                mark_degraded(name)
                 LOG.warning("shard %s degraded for this query (%s: "
                             "%s)", name, type(exc).__name__, exc)
                 continue
@@ -700,7 +810,7 @@ class ClusterRouter:
                     rows = json.loads(data)
                 except ValueError:
                     peer.query_failures += 1
-                    degraded.append(name)
+                    mark_degraded(name)
                     continue
                 if len(sent) != len(peer_subs):
                     # trimmed request: peer-local sub indexes map
@@ -726,7 +836,7 @@ class ClusterRouter:
                 # answer; degrade loudly instead (marker, never
                 # cached).
                 peer.query_failures += 1
-                degraded.append(name)
+                mark_degraded(name)
                 LOG.warning("shard %s answered %d to the scatter; "
                             "degrading it for this query", name,
                             status)
@@ -751,11 +861,11 @@ class ClusterRouter:
             rows, died = self._per_sub_retry(
                 peer, peer_obj,
                 [(k, peer_subs[k]) for k in sent], sub_400,
-                memoize=use_memo)
+                memoize=use_memo, tctx=tctx, parent_id=scatter_id)
             per_peer[name] = rows
             if died:
                 peer.query_failures += 1
-                degraded.append(name)
+                mark_degraded(name)
         if tsq.delete:
             # the shards already purged whatever rows they own during
             # the scatter (and per-sub retries): any cached entry
@@ -767,6 +877,10 @@ class ClusterRouter:
             if len(metrics) < len(tsq.queries):
                 self._bump_global_version()
             self._bump_versions(metrics)
+        if sp_scatter is not None:
+            if degraded:
+                sp_scatter.tag(degraded=",".join(sorted(degraded)))
+            trace_end(sp_scatter)
         for idx, errs in sorted(sub_400.items()):
             if len(errs) == len(self.peers):
                 # every shard rejected this sub: surface the real
@@ -779,6 +893,12 @@ class ClusterRouter:
                 raise BadRequestError(msg)
         if degraded:
             self.degraded_queries += 1
+            if tctx is not None:
+                # a degraded partial IS what an operator goes looking
+                # for after seeing the shardsDegraded marker: force
+                # retention so 1-in-N sampling can never discard the
+                # trace carrying the error-tagged peer span
+                tctx.forced = True
         if tsq.delete and degraded:
             # unlike writes, deletes have no spool/replay story (only
             # put bodies replay): a 200 here would ack a purge the
@@ -792,22 +912,26 @@ class ClusterRouter:
                 "retry to complete the purge")
         ordered = [per_peer[n] for n in sorted(per_peer)]
         results: list = []
-        for sub, plan, (p_idx, s_idx) in zip(tsq.queries, plans,
-                                             slots):
-            primary = [self._sub_results(r, p_idx) for r in ordered]
-            secondary = ([self._sub_results(r, s_idx)
-                          for r in ordered]
-                         if s_idx is not None else None)
-            gb_keys = merge_mod.gb_tag_keys(sub)
-            results.extend(merge_mod.merge_sub(
-                sub, gb_keys, plan, primary, secondary))
-        return self._apply_pixels(tsq, results), sorted(degraded)
+        with trace_mod.trace_span("cluster.merge", ctx=tctx,
+                                  shards=len(ordered)):
+            for sub, plan, (p_idx, s_idx) in zip(tsq.queries, plans,
+                                                 slots):
+                primary = [self._sub_results(r, p_idx)
+                           for r in ordered]
+                secondary = ([self._sub_results(r, s_idx)
+                              for r in ordered]
+                             if s_idx is not None else None)
+                gb_keys = merge_mod.gb_tag_keys(sub)
+                results.extend(merge_mod.merge_sub(
+                    sub, gb_keys, plan, primary, secondary))
+            results = self._apply_pixels(tsq, results)
+        return results, sorted(degraded)
 
     def _per_sub_retry(self, peer: Peer, peer_obj: dict,
                        indexed_subs: list[tuple[int, dict]],
                        sub_400: dict[int, list[bytes]],
-                       memoize: bool = True
-                       ) -> tuple[list[dict], bool]:
+                       memoize: bool = True, tctx=None,
+                       parent_id=None) -> tuple[list[dict], bool]:
         """Re-scatter each expanded sub alone to a peer that 400'd
         the combined request. ``indexed_subs`` carries each sub with
         its expanded-scatter index (memo pre-filtering may have
@@ -824,7 +948,7 @@ class ClusterRouter:
         incomplete. Missing beats wrong; the degraded marker tells
         the truth either way."""
         futs = [(k, sj, self.pool.submit(
-                    self._query_peer, peer,
+                    self._query_peer_traced, tctx, parent_id, peer,
                     json.dumps(dict(peer_obj, queries=[sj])).encode()))
                 for k, sj in indexed_subs]
         rows: list[dict] = []
@@ -871,7 +995,8 @@ class ClusterRouter:
         return [r for r in peer_results
                 if (r.get("query") or {}).get("index") == sub_idx]
 
-    def _query_peer(self, peer: Peer, body: bytes
+    def _query_peer(self, peer: Peer, body: bytes,
+                    headers: dict[str, str] | None = None
                     ) -> tuple[int, bytes]:
         if not peer.breaker.allow():
             raise PeerUnavailable(
@@ -884,11 +1009,36 @@ class ClusterRouter:
             # the breaker deterministically
             self._check_faults(peer)
             status, data = self._fetch(peer, "POST",
-                                       "/api/query?arrays=true", body)
+                                       "/api/query?arrays=true", body,
+                                       headers=headers)
         except OSError:
             peer.breaker.record_failure()
             raise
         peer.breaker.record_success()
+        return status, data
+
+    def _query_peer_traced(self, tctx, parent_id, peer: Peer,
+                           body: bytes) -> tuple[int, bytes]:
+        """One scatter leg under its ``cluster.peer`` span (runs on a
+        pool thread): the span id rides the ``X-TSD-Trace`` header so
+        the shard roots its subtree under THIS leg, and a failed leg
+        — dead, hung, tripped — is the error-tagged span the stitched
+        tree shows for a degraded shard."""
+        if tctx is None:
+            return self._query_peer(peer, body)
+        sp = trace_begin("cluster.peer", ctx=tctx, parent=parent_id,
+                         peer=peer.name)
+        headers = {TRACE_HEADER: tctx.tracer.header_for(tctx, sp)} \
+            if sp is not None else None
+        try:
+            status, data = self._query_peer(peer, body,
+                                            headers=headers)
+        except BaseException as exc:
+            trace_end(sp, error=exc)
+            raise
+        if sp is not None:
+            sp.tag(status=status)
+        trace_end(sp)
         return status, data
 
     def _apply_pixels(self, tsq, results: list) -> list:
@@ -1011,6 +1161,54 @@ class ClusterRouter:
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+
+    def fetch_peer_trace(self, trace_id: str
+                         ) -> tuple[list[dict], list[str]]:
+        """Stitch support for ``GET /api/trace/<id>``: ask every
+        shard for its subtree of the trace (``?local=true`` so the
+        request can never recurse). Returns (flat span docs from all
+        reachable shards, names of shards that could not answer) —
+        an unreachable shard's scatter leg already carries the error
+        span from query time, so the stitched tree stays truthful
+        without it."""
+        spans: list[dict] = []
+        incomplete: list[str] = []
+        futs = {}
+        for name, peer in self.peers.items():
+            if peer.breaker.blocking():
+                # known-dead peer: don't burn a scatter-pool thread
+                # on a guaranteed socket timeout per poll of this
+                # endpoint (the error-tagged leg from query time
+                # already tells the tree's story)
+                incomplete.append(name)
+                continue
+            futs[name] = self.pool.submit(
+                peer.client.request, "GET",
+                f"/api/trace/{trace_id}?local=true")
+        for name, fut in futs.items():
+            try:
+                status, data = fut.result(
+                    timeout=self.timeout_s + 2)
+            except (OSError, concurrent.futures.TimeoutError):
+                incomplete.append(name)
+                continue
+            if status == 404:
+                # the shard never saw (or already evicted) the
+                # trace: nothing to stitch, not an outage
+                continue
+            if status != 200:
+                # 400 (shard tracing disabled), 5xx, ...: the shard
+                # could not answer — the tree is INCOMPLETE, not
+                # "this shard recorded nothing"
+                incomplete.append(name)
+                continue
+            try:
+                doc = json.loads(data)
+            except ValueError:
+                incomplete.append(name)
+                continue
+            spans.extend(doc.get("spans") or [])
+        return spans, sorted(incomplete)
 
     def health_info(self) -> dict[str, Any]:
         return {

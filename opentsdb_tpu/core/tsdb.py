@@ -223,6 +223,22 @@ class TSDB:
         # like HBase's WAL does for the reference (IncomingDataPoints
         # .java:355-360); snapshot + replay-since-snapshot on startup.
         self.data_dir = self.config.get_string("tsd.storage.data_dir", "")
+        # request tracing (opentsdb_tpu/obs/): ring-buffered sampled
+        # span records over the ingest/query/background hot paths +
+        # the query-shape log; feeds the per-stage latency histograms
+        # in the stats registry. tsd.trace.enable=false makes every
+        # instrumentation site a thread-local read returning None.
+        from opentsdb_tpu.obs.trace import Tracer
+        self.tracer = Tracer(self.config, data_dir=self.data_dir,
+                             stats=self.stats)
+        self.stats.register(self.tracer)
+        # self-telemetry (obs/telemetry.py): the tsd.stats.self_interval
+        # loop ingesting this TSD's own counters/gauges/percentiles as
+        # tsd.* series through the normal write path (started by
+        # TSDServer; pump() is directly callable for tests/operators)
+        from opentsdb_tpu.obs.telemetry import SelfTelemetry
+        self.telemetry = SelfTelemetry(self)
+        self.stats.register(self.telemetry)
         # persistent XLA compilation cache: every jitted query program
         # survives restarts (before this, a restarted server re-paid
         # minutes of tunnel remote_compiles the reference's warm JVM
@@ -632,6 +648,8 @@ class TSDB:
             raise PermissionError("TSD is in read-only mode")
         from opentsdb_tpu.native.store_backend import (IMPORT_ERRORS,
                                                        parse_import_buffer)
+        from opentsdb_tpu.obs.trace import trace_begin, trace_end
+        _h_dec = trace_begin("ingest.decode")
         parsed = parse_import_buffer(buf)
         errors: list[str] = []
 
@@ -681,6 +699,12 @@ class TSDB:
         for g in failed:
             for i in np.nonzero(parsed.group_ids == g)[0].tolist():
                 fail(i + 1, str(ginfo[g]))
+        if _h_dec is not None:
+            _h_dec.tag(lines=int(parsed.num_lines)
+                       if hasattr(parsed, "num_lines")
+                       else len(parsed.ts),
+                       groups=int(parsed.num_groups))
+        trace_end(_h_dec)
         written = 0
         if use_hooks:
             # per-point hooks are inherently per-datapoint: group runs
@@ -714,8 +738,10 @@ class TSDB:
                              gsid[np.maximum(gids, 0)], -1)
         ts_ms = np.where(parsed.ts >= (1 << 32), parsed.ts,
                          parsed.ts * 1000)
+        _h_sc = trace_begin("store.scatter")
         written = self.store.append_lines(line_sids, ts_ms,
                                           parsed.values, parsed.is_int)
+        trace_end(_h_sc)
         if self.wal is not None and durable:
             # durable=False ≙ the reference's batch-import WAL opt-out
             # (PutRequest.setDurable(false), IncomingDataPoints:355-360)
@@ -733,6 +759,7 @@ class TSDB:
                 self.wal.sync()
         self.datapoints_added += written
         if self._streaming is not None and written:
+            _h_tap = trace_begin("stream.tap")
             for g in range(parsed.num_groups):
                 info = ginfo[g]
                 if isinstance(info, Exception):
@@ -743,6 +770,7 @@ class TSDB:
                                    self._streaming.offer_many,
                                    info[2], int(gsid[g]), ts_ms[m],
                                    parsed.values[m])
+            trace_end(_h_tap)
         if self.meta is not None and written:
             counts = np.bincount(gids[gids >= 0],
                                  minlength=parsed.num_groups)
@@ -1176,10 +1204,12 @@ class TSDB:
                 self.wal.truncate(wal_seq)
 
     def shutdown(self) -> None:
+        self.telemetry.stop()
         if self._cluster is not None:
             self._cluster.stop()
         if self._lifecycle is not None:
             self._lifecycle.stop()
+        self.tracer.close()
         self.flush()
         if self._streaming is not None:
             self._streaming.shutdown()

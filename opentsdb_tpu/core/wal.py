@@ -513,6 +513,17 @@ class WriteAheadLog:
             target = self._seq if upto is None else min(upto, self._seq)
         if self._synced_seq >= target:
             return
+        # trace the group-commit wait (the durability tax one request
+        # actually pays — leader fsync or piggyback alike); a no-op
+        # thread-local read outside a traced request
+        from opentsdb_tpu.obs.trace import trace_begin, trace_end
+        _h = trace_begin("wal.commit_wait")
+        try:
+            self._sync_inner(target)
+        finally:
+            trace_end(_h)
+
+    def _sync_inner(self, target: int) -> None:
         if self.degraded and time.monotonic() < self._degraded_until:
             # shed durability work until the next resync probe: paying
             # the full retry ladder on every write while the disk is

@@ -306,19 +306,29 @@ class LifecycleManager:
             "bytesReclaimed": 0, "seriesReleased": 0, "metrics": 0,
             "spilled": 0, "histogramPurged": 0,
         }
+        # every sweep is a background trace root (the coldstore spill
+        # records its own child span), so maintenance time shows up
+        # at /api/trace alongside the requests it competes with
+        from opentsdb_tpu.obs import trace as trace_mod
+        tracer = getattr(self.tsdb, "tracer", None)
+        tctx = tracer.start_background("lifecycle.sweep") \
+            if tracer is not None and tracer.enabled else None
         try:
             if self.breaker is not None and not self.breaker.allow():
                 report["skipped"] = "breaker open"
                 return report
             try:
-                self._sweep_inner(
-                    int(now_ms if now_ms is not None
-                        else time.time() * 1000), report)
+                with trace_mod.use(tctx):
+                    self._sweep_inner(
+                        int(now_ms if now_ms is not None
+                            else time.time() * 1000), report)
             except Exception as exc:  # noqa: BLE001 - degrade loudly
                 self.sweep_errors += 1
                 self.last_error = f"{type(exc).__name__}: {exc}"
                 if self.breaker is not None:
                     self.breaker.record_failure()
+                if tctx is not None:
+                    tctx.set_error(exc)
                 LOG.warning("lifecycle sweep failed (%s); ingest and "
                             "queries are unaffected", self.last_error)
                 report["error"] = self.last_error
@@ -333,6 +343,17 @@ class LifecycleManager:
                 (time.monotonic() - t0) * 1e3
             report["durationMs"] = round(self.last_sweep_duration_ms,
                                          1)
+            if tctx is not None:
+                if report.get("skipped"):
+                    # a breaker-open no-op sweep each interval is not
+                    # worth a retained trace — it would churn real
+                    # request traces out of the ring (same rule as
+                    # the zero-progress spool-replay probe)
+                    tctx.sampled = False
+                tctx.tag(purged=report.get("purged", 0),
+                         demoted=report.get("demoted", 0),
+                         spilled=report.get("spilled", 0))
+                tracer.finish(tctx)
             self._sweep_lock.release()
 
     def _sweep_inner(self, now_ms: int, report: dict) -> None:
@@ -377,8 +398,10 @@ class LifecycleManager:
                 changed |= self._demote(mid, metric, sids, pol,
                                         now_ms, report)
             if pol.spill_after_ms and t.rollup_store is not None:
-                changed |= self._spill(mid, metric, pol, now_ms,
-                                       report)
+                from opentsdb_tpu.obs.trace import trace_span
+                with trace_span("coldstore.spill", metric=metric):
+                    changed |= self._spill(mid, metric, pol, now_ms,
+                                           report)
             # pack only COLD buffers (newest point behind the
             # metric's lifecycle horizon): packing a live tail just
             # buys an unpack copy on the next append

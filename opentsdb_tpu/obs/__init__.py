@@ -1,0 +1,26 @@
+"""Observability subsystem: end-to-end request tracing and
+self-telemetry.
+
+- :mod:`opentsdb_tpu.obs.trace` — low-overhead ring-buffered, sampled
+  span records wrapping every stage of the three hot paths (ingest,
+  query, background maintenance), with cluster trace-id propagation
+  (router scatter/forward headers stitch one trace across shards), a
+  slow-request log, and a persisted query-shape log for offline
+  workload mining.
+- :mod:`opentsdb_tpu.obs.telemetry` — the ``tsd.stats.self_interval``
+  loop that ingests the TSD's own counters, gauges and stage-latency
+  percentiles into its *own* store as ``tsd.*`` series, so dashboards,
+  continuous queries, lifecycle policies and the cluster tier all
+  apply to the TSD monitoring itself.
+
+Surfaces: ``GET /api/trace`` (recent roots), ``GET /api/trace/<id>``
+(full span tree, cluster-stitched on a router), per-stage latency
+percentiles at ``/api/stats`` + ``/api/health``.
+"""
+
+from opentsdb_tpu.obs.trace import (KNOWN_SPANS, Tracer, current,
+                                    record_span, trace_begin,
+                                    trace_end, trace_span, use)
+
+__all__ = ["KNOWN_SPANS", "Tracer", "current", "record_span",
+           "trace_begin", "trace_end", "trace_span", "use"]

@@ -1,0 +1,190 @@
+"""Self-telemetry: the TSD ingests its own stats as ``tsd.*`` series.
+
+A ``tsd.stats.self_interval`` loop snapshots everything the stats
+registry knows — every component counter, the gauges (WAL sync lag,
+fold-worker backlog, spool depth, cache bytes — all registered
+providers), and the per-stage latency percentiles — and writes them
+into the TSD's *own* store through the normal
+:meth:`TSDB.add_point_groups` ingest path. The payoff is that every
+serving feature applies to the TSD monitoring itself: dashboards and
+``/api/query`` work on ``tsd.*`` metrics, continuous queries maintain
+live windows over them, lifecycle policies age them out, and on a
+cluster **router** the pump forwards through the consistent-hash ring
+like any other write, so the fleet's self-metrics live in the fleet.
+
+Metric names are the collector's (already ``tsd.``-prefixed); tag
+values are sanitized to the storage charset (``:`` in peer addresses
+becomes ``_``). UIDs for self-metrics are minted directly — an
+operator's ``tsd.core.auto_create_metrics=false`` policy governs
+client traffic, not the TSD's own heartbeat.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+
+from opentsdb_tpu.obs import trace as trace_mod
+
+LOG = logging.getLogger("obs.telemetry")
+
+_ALLOWED_PUNCT = set("-_./")
+
+
+def _sanitize(value: str) -> str:
+    """Map an arbitrary label onto the tag-value charset."""
+    out = "".join(c if (c.isascii() and c.isalnum())
+                  or c in _ALLOWED_PUNCT else "_"
+                  for c in str(value))
+    return out or "_"
+
+
+class SelfTelemetry:
+    """The pump + its background loop. ``tsd.stats.self_interval``
+    seconds between pumps; <= 0 disables the loop (``pump()`` stays
+    callable — tests and operators can drive it manually)."""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+        self.interval_s = tsdb.config.get_float(
+            "tsd.stats.self_interval", 0.0)
+        # node identity tag: every record carries host=<this node> so
+        # a fleet of shards' self-series stay distinguishable when a
+        # router-side query merges them (a constant tag would fold
+        # every node into one series). tsd.stats.self_tag overrides;
+        # default = hostname-port.
+        tag = tsdb.config.get_string("tsd.stats.self_tag", "")
+        if not tag:
+            import platform
+            tag = (f"{platform.node() or 'tsd'}-"
+                   f"{tsdb.config.get_int('tsd.network.port', 4242)}")
+        self.host_tag = _sanitize(tag)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.pumps = 0
+        self.points_written = 0
+        self.point_errors = 0
+        self.pump_errors = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+        t = threading.Thread(target=self._loop, name="tsd-telemetry",
+                             daemon=True)
+        self._thread = t
+        t.start()
+        LOG.info("self-telemetry pumping every %.0fs",
+                 self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.pump()
+            except Exception:  # noqa: BLE001 - heartbeat must survive
+                # tsdlint: allow[swallow] the loop outlives any pump
+                # failure; pump() already counted and logged it
+                LOG.exception("self-telemetry pump failed")
+
+    # -- the pump ------------------------------------------------------
+
+    def snapshot(self) -> list[tuple[str, float, dict[str, str]]]:
+        """One collector pass over every registered provider (the
+        same records ``/api/stats`` serves), values filtered to
+        finite floats and tags sanitized to the storage charset."""
+        t = self.tsdb
+        collector = t.stats.collect()
+        t.collect_stats(collector)
+        out = []
+        for name, value, tags in collector.records:
+            if not math.isfinite(value):
+                continue
+            clean = {_sanitize(k): _sanitize(v)
+                     for k, v in tags.items()}
+            clean.setdefault("host", self.host_tag)
+            out.append((name, float(value), clean))
+        return out
+
+    def pump(self, now_s: int | None = None) -> int:
+        """Ingest one snapshot; returns points written. On a router
+        the points forward through the ring (the router's own store
+        serves no queries); standalone/shard TSDs take the normal
+        columnar group write — WAL, stream taps, lifecycle and the
+        result-cache invalidation all see it like any client put."""
+        t = self.tsdb
+        tracer = getattr(t, "tracer", None)
+        tctx = tracer.start_background("telemetry.pump") \
+            if tracer is not None else None
+        now = int(now_s if now_s is not None else time.time())
+        written = 0
+        try:
+            with trace_mod.use(tctx):
+                records = self.snapshot()
+                cluster = t.cluster
+                if cluster is not None:
+                    points = [{"metric": m, "timestamp": now,
+                               "value": v, "tags": tg}
+                              for m, v, tg in records]
+                    written, failed, _errs = \
+                        cluster.forward_writes(points)
+                    self.point_errors += failed
+                else:
+                    groups = []
+                    for metric, value, tags in records:
+                        # self-metrics mint their own UIDs: the
+                        # auto-create policy gates CLIENT traffic,
+                        # not the TSD's heartbeat
+                        t.uids.metrics.get_or_create_id(metric)
+                        for k, v in tags.items():
+                            t.uids.tag_names.get_or_create_id(k)
+                            t.uids.tag_values.get_or_create_id(v)
+                        groups.append((metric, tags, [None], [now],
+                                       [value]))
+
+                    def on_error(_ref, _exc) -> None:
+                        self.point_errors += 1
+
+                    written, _errs = t.add_point_groups(
+                        groups, on_error=on_error)
+            self.pumps += 1
+            self.points_written += written
+            if tctx is not None:
+                tctx.tag(points=written)
+        except Exception as exc:
+            self.pump_errors += 1
+            if tctx is not None:
+                tctx.set_error(exc)
+            raise
+        finally:
+            if tracer is not None:
+                tracer.finish(tctx)
+        return written
+
+    # -- observability -------------------------------------------------
+
+    def collect_stats(self, collector) -> None:
+        collector.record("telemetry.pumps", self.pumps)
+        collector.record("telemetry.points", self.points_written)
+        collector.record("telemetry.point_errors", self.point_errors)
+        collector.record("telemetry.pump_errors", self.pump_errors)
+
+    def health_info(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "running": self._thread is not None
+            and self._thread.is_alive(),
+            "pumps": self.pumps,
+            "points_written": self.points_written,
+            "point_errors": self.point_errors,
+            "pump_errors": self.pump_errors,
+        }

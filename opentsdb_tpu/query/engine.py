@@ -29,6 +29,8 @@ import numpy as np
 
 from opentsdb_tpu.core import store as store_mod
 from opentsdb_tpu.core.store import TimeSeriesStore
+from opentsdb_tpu.obs import trace as trace_mod
+from opentsdb_tpu.obs.trace import trace_begin, trace_end, trace_span
 from opentsdb_tpu.ops import downsample as ds_mod
 from opentsdb_tpu.ops.blocked import (DEFAULT_CELL_BUDGET,
                                       execute_blocked,
@@ -494,7 +496,11 @@ class QueryEngine:
         the earliest failing sub (in sub order) wins after every
         in-flight sibling has been joined — a still-running future
         must not outlive its TSQuery."""
-        futures = [pool.submit(self._run_sub_cached, tsq, sub, stats)
+        # fan-out workers run on other threads: re-bind the parent
+        # request's trace context so sub-query spans land in the trace
+        tctx = trace_mod.current()
+        futures = [pool.submit(self._run_sub_traced, tctx, tsq, sub,
+                               stats)
                    for sub in subs[1:]]
         results: list[QueryResult] = []
         first_err: BaseException | None = None
@@ -515,6 +521,23 @@ class QueryEngine:
             raise first_err
         return results
 
+    def _run_sub_traced(self, tctx, tsq: TSQuery, sub: TSSubQuery,
+                        stats: QueryStats | None) -> list[QueryResult]:
+        """Fan-out entry: bind the parent request's trace context on
+        this worker thread, then run the sub normally."""
+        with trace_mod.use(tctx):
+            return self._run_sub_cached(tsq, sub, stats)
+
+    def _run_sub_timed(self, tsq: TSQuery, sub: TSSubQuery,
+                       stats: QueryStats | None) -> list[QueryResult]:
+        """One real engine execution under the ``query.execute`` span
+        (scan + device pipeline + assembly; cache hits never get
+        here) — the span feeds the ``query.execute`` stage histogram
+        exported with percentiles at /api/stats."""
+        with trace_span("query.execute", sub=sub.index,
+                        metric=sub.metric or "<tsuid>"):
+            return self._run_sub(tsq, sub, stats)
+
     def _run_sub_cached(self, tsq: TSQuery, sub: TSSubQuery,
                         stats: QueryStats | None) -> list[QueryResult]:
         """One sub-query through the serve-path result cache: hits
@@ -532,7 +555,9 @@ class QueryEngine:
         streaming = self.tsdb._streaming
         if streaming is not None and not tsq.delete:
             try:
-                served = streaming.try_serve(tsq, sub, self)
+                with trace_span("query.streaming_lookup",
+                                sub=sub.index):
+                    served = streaming.try_serve(tsq, sub, self)
             except (BadRequestError, QueryLimitExceeded):
                 raise  # semantic errors the batch path would raise too
             except Exception as exc:  # noqa: BLE001 - shed to batch
@@ -546,18 +571,19 @@ class QueryEngine:
                 return served
         cache = self.tsdb.result_cache
         if cache is None:
-            return self._run_sub(tsq, sub, stats)
+            return self._run_sub_timed(tsq, sub, stats)
         plan = rc_mod.cache_plan(tsq, sub, self.tsdb.config)
         if plan is None:
             cache.count_bypass()
-            return self._run_sub(tsq, sub, stats)
+            return self._run_sub_timed(tsq, sub, stats)
         key, ttl_ms = plan
         # the version MUST be captured before compute: a write landing
         # mid-execution then leaves the entry already-stale instead of
         # wrongly fresh (see QueryResultCache.get_or_compute)
         version = self._sub_version(sub)
         value, outcome = cache.get_or_compute(
-            key, version, lambda: self._run_sub(tsq, sub, stats),
+            key, version,
+            lambda: self._run_sub_timed(tsq, sub, stats),
             ttl_ms)
         if stats and outcome != rc_mod.MISS:
             stats.add_stat(
@@ -612,6 +638,11 @@ class QueryEngine:
             from opentsdb_tpu.query.histogram_engine import \
                 run_histogram_subquery
             return run_histogram_subquery(self.tsdb, tsq, sub)
+        # planning stage span: tier selection, filter evaluation,
+        # group construction (ended at every exit of the stage — an
+        # unfinished handle on an error path simply isn't recorded;
+        # the enclosing query.execute span still carries the error)
+        _h_plan = trace_begin("query.plan", sub=sub.index)
         (store, metric_name, sids, rollup_scale,
          avg_count_store, ds_fn_override) = self._select_store(sub)
         budget = self.tsdb.config.get_int(
@@ -632,6 +663,7 @@ class QueryEngine:
                     store = self.tsdb.store
                     sids = raw_sids
         if len(sids) == 0:
+            trace_end(_h_plan)
             return []
         if stats:
             stats.add_stat(QueryStat.ROWS_PRE_FILTER, len(sids))
@@ -639,6 +671,7 @@ class QueryEngine:
         # --- filters -> series mask (ref: findSpans post-scan filters)
         sids, tag_mat = self._apply_filters(store, sub, sids)
         if len(sids) == 0:
+            trace_end(_h_plan)
             return []
         if stats:
             stats.add_stat(QueryStat.STRING_TO_UID_TIME,
@@ -654,12 +687,16 @@ class QueryEngine:
             try:
                 gb_kids.append(uids.tag_names.get_id(k))
             except LookupError:
+                trace_end(_h_plan)
                 return []
         group_ids, num_groups = self._group_ids(tag_mat, gb_kids)
         emit_raw = sub.agg.is_none
         if emit_raw:
             group_ids = np.arange(len(sids), dtype=np.int32)
             num_groups = len(sids)
+        if _h_plan is not None:
+            _h_plan.tag(series=len(sids), groups=num_groups)
+        trace_end(_h_plan)
 
         if avg_count_store is not None:
             out = self._avg_rollup_pipeline(
@@ -1739,6 +1776,18 @@ class QueryEngine:
     def _build_results(self, tsq, sub, metric_name, sids, tags,
                        group_ids, num_groups, gb_kids, bucket_ts,
                        result, emit) -> list[QueryResult]:
+        from opentsdb_tpu.query.model import effective_pixels as _epx
+        with trace_span("query.assemble", sub=sub.index,
+                        groups=num_groups,
+                        pixels=_epx(tsq, sub)[0]):
+            return self._build_results_inner(
+                tsq, sub, metric_name, sids, tags, group_ids,
+                num_groups, gb_kids, bucket_ts, result, emit)
+
+    def _build_results_inner(self, tsq, sub, metric_name, sids, tags,
+                             group_ids, num_groups, gb_kids,
+                             bucket_ts, result, emit
+                             ) -> list[QueryResult]:
         uids = self.tsdb.uids
         out: list[QueryResult] = []
         # one device->host fetch; per-group row indexing of a device

@@ -11,6 +11,7 @@
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -60,21 +61,88 @@ class StatsCollector:
                  "tags": tags} for name, value, tags in self.records]
 
 
+#: percentile points exported for every latency histogram
+LATENCY_PCTS = (("p50", 50.0), ("p95", 95.0), ("p99", 99.0),
+                ("p999", 99.9))
+
+
 class StatsCollectorRegistry:
-    """Aggregates collect_stats providers; owned by the TSDB."""
+    """Aggregates collect_stats providers; owned by the TSDB.
+
+    Also owns the latency histograms: the request-level
+    ``latency_put``/``latency_query`` pair (fed by the server per
+    request) and the per-STAGE map fed by the tracer for every traced
+    request (``wal.commit_wait``, ``query.execute``,
+    ``cluster.merge``, ``query.serialize``, ... — one histogram per
+    registered span name that actually fires). All export
+    p50/p95/p99/p999 at ``/api/stats`` (``tsd.latency.*``) and
+    ``/api/health``."""
 
     def __init__(self) -> None:
         self._providers: list[Any] = []
-        self.latency_put = Histogram(16000, 2, 100)
-        self.latency_query = Histogram(16000, 2, 100)
+        # 1ms linear buckets (not the reference's 100ms): these now
+        # EXPORT percentiles, and a bucket-upper-bound percentile
+        # over 100ms buckets would report p50=100 for every
+        # single-digit-ms workload — a 30x misreading
+        self.latency_put = Histogram(16000, 2, 1)
+        self.latency_query = Histogram(16000, 2, 1)
+        self._stage_lock = threading.Lock()
+        self.stage_latency: dict[str, Histogram] = {}
 
     def register(self, provider: Any) -> None:
         self._providers.append(provider)
+
+    def observe_stage(self, stage: str, ms: float) -> None:
+        """Record one stage latency (ms). Histograms are created on
+        first observation; the population is bounded by the closed
+        span-name registry (obs/trace.py KNOWN_SPANS)."""
+        h = self.stage_latency.get(stage)
+        if h is None:
+            with self._stage_lock:
+                h = self.stage_latency.setdefault(
+                    stage, Histogram(16000, 2, 1))
+        h.add(ms)
+
+    def _stage_snapshot(self) -> dict[str, Histogram]:
+        """Iteration-safe copy: observe_stage inserts first-seen
+        stages concurrently, and iterating the live dict would raise
+        'dictionary changed size during iteration' mid-/api/stats."""
+        with self._stage_lock:
+            return dict(self.stage_latency)
+
+    def latency_summary(self) -> dict[str, Any]:
+        """Percentile summaries for /api/health."""
+        out: dict[str, Any] = {
+            "put": self.latency_put.percentiles(),
+            "query": self.latency_query.percentiles(),
+        }
+        stages = {}
+        for name, h in sorted(self._stage_snapshot().items()):
+            if h.count:
+                stages[name] = h.percentiles()
+        out["stages"] = stages
+        return out
 
     def collect(self, prefix: str = "tsd") -> StatsCollector:
         collector = StatsCollector(prefix)
         for p in self._providers:
             p.collect_stats(collector)
+        # latency percentiles ride the same record stream so
+        # /api/stats, telnet `stats` and the self-telemetry pump all
+        # see them without extra plumbing
+        named = [("latency.put", self.latency_put),
+                 ("latency.query", self.latency_query)]
+        named += [(f"latency.{name}", h)
+                  for name, h in sorted(
+                      self._stage_snapshot().items())]
+        for name, hist in named:
+            if not hist.count:
+                continue
+            vals = hist.percentile_many(
+                [q for _l, q in LATENCY_PCTS])
+            for (label, _q), v in zip(LATENCY_PCTS, vals):
+                collector.record(name, v, pct=label)
+            collector.record(f"{name}.count", hist.count)
         return collector
 
 
@@ -99,29 +167,55 @@ class Histogram:
         self._lock = threading.Lock()
 
     def add(self, value: float) -> None:
+        # bisect_left: first bound >= value, i.e. the first bucket
+        # whose `value <= bound` test passes — identical placement to
+        # a linear scan at O(log n) per observation
+        idx = bisect.bisect_left(self.bounds, value)
         with self._lock:
-            for i, b in enumerate(self.bounds):
-                if value <= b:
-                    self.buckets[i] += 1
-                    break
-            else:
-                self.buckets[-1] += 1
+            self.buckets[min(idx, len(self.buckets) - 1)] += 1
             self.count += 1
 
     def percentile(self, pct: float) -> float:
         """(ref: Histogram.percentile)"""
         if not 0 < pct <= 100:
             raise ValueError(f"invalid percentile {pct}")
+        return self.percentile_many([pct])[0]
+
+    def percentile_many(self, pcts: "list[float]") -> "list[float]":
+        """All requested percentiles from ONE cumulative pass over a
+        snapshot of the buckets — the scan runs OUTSIDE the lock (a
+        stats/health collection walking thousands of 1ms buckets
+        per-percentile under the lock would repeatedly block
+        hot-path ``add()`` calls)."""
         with self._lock:
-            if self.count == 0:
-                return 0.0
-            target = self.count * pct / 100.0
-            acc = 0
-            for i, c in enumerate(self.buckets):
-                acc += c
-                if acc >= target:
-                    return float(self.bounds[min(i, len(self.bounds) - 1)])
-            return float(self.bounds[-1])
+            count = self.count
+            buckets = list(self.buckets)  # C-level copy
+        if count == 0:
+            return [0.0] * len(pcts)
+        targets = sorted((count * p / 100.0, j)
+                         for j, p in enumerate(pcts))
+        out = [0.0] * len(pcts)
+        acc = 0
+        t = 0
+        last_bound = len(self.bounds) - 1
+        for i, c in enumerate(buckets):
+            acc += c
+            while t < len(targets) and acc >= targets[t][0]:
+                out[targets[t][1]] = float(
+                    self.bounds[min(i, last_bound)])
+                t += 1
+            if t >= len(targets):
+                break
+        for k in range(t, len(targets)):
+            out[targets[k][1]] = float(self.bounds[-1])
+        return out
+
+    def percentiles(self) -> dict[str, float]:
+        """The standard export points + the sample count."""
+        vals = self.percentile_many([q for _l, q in LATENCY_PCTS])
+        out = {label: v for (label, _q), v in zip(LATENCY_PCTS, vals)}
+        out["count"] = self.count
+        return out
 
     def print_ascii(self) -> str:
         lines = []

@@ -138,6 +138,8 @@ class FoldWorkerPool:
 
     def _loop(self) -> None:
         registry = self.registry
+        from opentsdb_tpu.obs import trace as trace_mod
+        tracer = getattr(registry.tsdb, "tracer", None)
         while not self._stop.is_set():
             self._event.wait(timeout=_IDLE_WAKE_S)
             self._event.clear()
@@ -145,16 +147,28 @@ class FoldWorkerPool:
                 partial = self._take()
                 if partial is None:
                     break
+                # each off-path drain is a (sampled) background trace
+                # root, so fold-worker time shows up in /api/trace
+                # and the streaming.drain latency histogram
+                tctx = tracer.start_background(
+                    "streaming.drain", sample=True) \
+                    if tracer is not None and tracer.enabled else None
                 try:
-                    registry.worker_drain(partial)
+                    with trace_mod.use(tctx):
+                        registry.worker_drain(partial)
                     self.drains += 1
-                except Exception:  # noqa: BLE001 - degrade, never die
+                except Exception as exc:  # noqa: BLE001 - never die
                     # tsdlint: allow[swallow] a worker must outlive any
                     # fold failure; the drain already counted the
                     # error and marked the partial for rebuild
                     self.errors += 1
+                    if tctx is not None:
+                        tctx.set_error(exc)
                     LOG.exception("fold worker drain failed; partial "
                                   "will rebuild on serve")
+                finally:
+                    if tracer is not None and tctx is not None:
+                        tracer.finish(tctx)
             if self._publish_pending and not self._stop.is_set():
                 self._publish_pending = False
                 try:

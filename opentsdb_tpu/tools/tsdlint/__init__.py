@@ -18,6 +18,9 @@ fault-sites     every fault site used in code or armed in tests resolves
 counter-export  every counter incremented is read somewhere (else it can
                 never reach /api/stats)
 swallow         no bare ``except:``; no broad ``except Exception: pass``
+trace-sites     every span name started resolves to the closed registry
+                in obs/trace.py KNOWN_SPANS; registered-but-never-started
+                names are reported stale
 ==============  ==========================================================
 
 Suppression is two-level: an inline ``# tsdlint: allow[pass-id] why``
@@ -37,17 +40,18 @@ from dataclasses import dataclass, field
 
 from opentsdb_tpu.tools.tsdlint import (config_keys, counters,
                                         fault_sites, lock_discipline,
-                                        swallow)
+                                        swallow, trace_sites)
 from opentsdb_tpu.tools.tsdlint.base import (Finding, Source,
                                              iter_py_files)
 
 #: pass-id -> module; lock_discipline owns two ids
 PASS_MODULES = (lock_discipline, config_keys, fault_sites, counters,
-                swallow)
+                swallow, trace_sites)
 ALL_PASS_IDS = (lock_discipline.PASS_BLOCKING,
                 lock_discipline.PASS_CYCLE,
                 config_keys.PASS_ID, fault_sites.PASS_ID,
-                counters.PASS_ID, swallow.PASS_ID)
+                counters.PASS_ID, swallow.PASS_ID,
+                trace_sites.PASS_ID)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))          # .../opentsdb_tpu

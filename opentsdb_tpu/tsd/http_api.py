@@ -37,6 +37,8 @@ from opentsdb_tpu.utils.logring import ring_buffer
 from opentsdb_tpu.ops import aggregators as aggs_mod
 from opentsdb_tpu.query import filters as filters_mod
 from opentsdb_tpu.query.limits import QueryLimitExceeded
+from opentsdb_tpu.obs import trace as trace_mod
+from opentsdb_tpu.obs.trace import trace_begin, trace_end
 from opentsdb_tpu.query.model import (BadRequestError, TSQuery,
                                       parse_uri_query)
 from opentsdb_tpu.stats.stats import QueryStats
@@ -54,6 +56,11 @@ class HttpRequest:
     remote: str = ""
     auth: Any = None  # AuthState when authentication is enabled
     serializer: Any = None  # set by the router (?serializer= choice)
+    # time.monotonic() when the server finished parsing the request —
+    # the trace's query.admission span measures the queue/admission
+    # wait from here to handler start (0.0 = unknown, e.g. direct
+    # router.handle calls in tests)
+    received_at: float = 0.0
 
     def param(self, key: str, default: str | None = None) -> str | None:
         vals = self.params.get(key)
@@ -181,6 +188,7 @@ class HttpRpcRouter:
             "lifecycle": self._handle_lifecycle,
             "serializers": self._handle_serializers,
             "stats": self._handle_stats,
+            "trace": self._handle_trace,
             "version": self._handle_version,
         })
         # set by TSDServer so HTTP diediedie can request shutdown
@@ -198,7 +206,15 @@ class HttpRpcRouter:
     # ------------------------------------------------------------------
 
     def handle(self, request: HttpRequest) -> HttpResponse:
-        return self._apply_jsonp(request, self._handle_inner(request))
+        resp = self._apply_jsonp(request, self._handle_inner(request))
+        # stamped by _trace_request when the request's trace was
+        # retained — set here so ERROR responses (built by
+        # _handle_inner's exception mapping, after the trace wrapper
+        # unwound) carry the cross-reference too
+        tid = getattr(request, "trace_id_hint", None)
+        if tid:
+            resp.headers.setdefault("X-TSD-Trace-Id", tid)
+        return resp
 
     def _handle_inner(self, request: HttpRequest) -> HttpResponse:
         # content negotiation: ?serializer=<shortname> picks a
@@ -370,6 +386,34 @@ class HttpRpcRouter:
                             "The requested endpoint was not found")
         return handler(request, rest)
 
+    # -- tracing -------------------------------------------------------
+
+    def _trace_request(self, name: str, request: HttpRequest, fn):
+        """Root one traced request (``ingest.put`` / ``query.http``):
+        bind the context for the handler's whole synchronous stack
+        (deep layers — WAL, engine, router — pick it up thread-
+        locally), mark errors, and stamp the retained trace's id on
+        the response as ``X-TSD-Trace-Id``."""
+        tracer = self.tsdb.tracer
+        ctx = tracer.start_request(name, request) \
+            if tracer.enabled else None
+        if ctx is None:
+            return fn()
+        error: BaseException | None = None
+        try:
+            with trace_mod.use(ctx):
+                resp = fn()
+        except BaseException as exc:
+            error = exc
+            raise
+        finally:
+            if error is not None:
+                ctx.set_error(error)
+            tracer.finish(ctx)
+            if ctx.committed:
+                request.trace_id_hint = ctx.trace_id
+        return resp
+
     # -- write path ----------------------------------------------------
 
     def _check_permission(self, request: HttpRequest, perm) -> None:
@@ -380,17 +424,31 @@ class HttpRpcRouter:
                             f"{perm.name} is not granted")
 
     def _handle_put(self, request: HttpRequest, rest) -> HttpResponse:
-        """(ref: PutDataPointRpc.java:272)"""
+        """(ref: PutDataPointRpc.java:272) Traced as an
+        ``ingest.put`` root: decode → store scatter (or cluster
+        forward) → WAL group-commit wait."""
         from opentsdb_tpu.auth.simple import Permissions
         self._check_permission(request, Permissions.HTTP_PUT)
         if request.method != "POST":
             raise HttpError(405, "Method not allowed",
                             "The HTTP method is not permitted")
+        return self._trace_request(
+            "ingest.put", request,
+            lambda: self._handle_put_run(request))
+
+    def _handle_put_run(self, request: HttpRequest) -> HttpResponse:
+        # ONE decode span: body parse through validate/group (router
+        # bodies end it after the parse — forwarding re-validates on
+        # the shard, which records its own decode)
+        _h = trace_begin("ingest.decode")
         points = request.serializer.parse_put(request.body)
+        if _h is not None:
+            _h.tag(points=len(points))
         details = request.flag("details")
         summary = request.flag("summary")
         cluster = self.tsdb.cluster
         if cluster is not None:
+            trace_end(_h)
             # router mode: partition by the consistent-hash series key
             # and forward one series-grouped body per shard (each
             # lands as ONE WAL write + fsync via add_point_groups on
@@ -465,12 +523,15 @@ class HttpRpcRouter:
             except ValueError as e:
                 errors.append({"datapoint": dp, "error": str(e)})
 
+        trace_end(_h)
+        _h = trace_begin("store.scatter", groups=len(groups))
         if use_hooks:
             success, _ = self.tsdb.add_point_batch(
                 parsed, on_error=lambda i, e: spool(dps[i], e))
         else:
             success, _ = self.tsdb.add_point_groups(
                 groups.values(), on_error=spool)
+        trace_end(_h)
         failed = len(errors)
         if not details and not summary:
             if failed:
@@ -579,6 +640,11 @@ class HttpRpcRouter:
             if sub == "exp":
                 return handle_exp(self, request)
             return handle_gexp(self, request)
+        return self._trace_request(
+            "query.http", request,
+            lambda: self._handle_query_run(request))
+
+    def _handle_query_run(self, request: HttpRequest) -> HttpResponse:
         if request.method == "POST":
             obj = request.serializer.parse_query(request.body)
             tsq = TSQuery.from_json(obj)
@@ -602,6 +668,22 @@ class HttpRpcRouter:
         from opentsdb_tpu.query.model import effective_pixels
         px = max((effective_pixels(tsq, s)[0] for s in tsq.queries),
                  default=0)
+        tctx = trace_mod.current()
+        if tctx is not None:
+            # query-shape tags: what the offline workload miner
+            # (ROADMAP item 5 / Storyboard) slices on
+            s0 = tsq.queries[0] if tsq.queries else None
+            tctx.tag(
+                metrics=",".join(sorted({s.metric or "<tsuid>"
+                                         for s in tsq.queries})),
+                subs=len(tsq.queries),
+                aggregator=s0.aggregator if s0 is not None else "",
+                downsample=(s0.downsample or "")
+                if s0 is not None else "",
+                filters=sum(len(s.filters) for s in tsq.queries),
+                pixels=px,
+                start=tsq.start_ms, end=tsq.end_ms,
+                delete=bool(tsq.delete))
         streamed = False
         cluster = self.tsdb.cluster
         degraded_shards: list[str] = []
@@ -619,6 +701,13 @@ class HttpRpcRouter:
             from opentsdb_tpu.stats.stats import QueryStat
             if px:
                 stats.add_stat(QueryStat.DOWNSAMPLE_PIXELS, px)
+            if tctx is not None:
+                s = stats.stats
+                tctx.tag(cache=(
+                    "streaming" if s.get("streamingHit")
+                    else "hit" if s.get("resultCacheHit")
+                    else "coalesced" if s.get("resultCacheCoalesced")
+                    else "miss"))
             t_ser = time.monotonic()
             total_dps = sum(r.num_dps if hasattr(r, "num_dps")
                             else len(r.dps) for r in results)
@@ -677,6 +766,7 @@ class HttpRpcRouter:
                     (time.monotonic_ns() - stats.start_ns) / 1e6)
                 streamed = True
                 return HttpResponse(200, b"", body_iter=body_iter())
+            _h = trace_begin("query.serialize")
             body = request.serializer.format_query(
                 tsq, results, as_arrays=request.flag("arrays"),
                 show_summary=tsq.show_summary
@@ -684,6 +774,7 @@ class HttpRpcRouter:
                 show_stats=tsq.show_stats or request.flag("show_stats"),
                 summary_extra=stats.stats,
                 degraded_shards=degraded_shards)
+            trace_end(_h)
             ser_ms = (time.monotonic() - t_ser) * 1e3
             stats.add_stat(QueryStat.SERIALIZATION_TIME, ser_ms)
             stats.add_stat(QueryStat.PAYLOAD_BYTES, len(body))
@@ -1259,6 +1350,64 @@ class HttpRpcRouter:
         return HttpResponse(200, request.serializer.format_stats(
             collector.as_json()))
 
+    def _handle_trace(self, request: HttpRequest, rest
+                      ) -> HttpResponse:
+        """Request-trace surface (:mod:`opentsdb_tpu.obs.trace`):
+
+        - ``GET /api/trace`` — recent retained roots, newest first;
+          filters: ``?status=ok|error``, ``?min_duration_ms=N``,
+          ``?slow=true`` (the slow-request ring only), ``?limit=N``.
+        - ``GET /api/trace/<id>`` — one trace's full span tree. On a
+          cluster router the shards' subtrees are fetched and
+          stitched under their ``cluster.peer`` spans; unreachable
+          peers are listed in ``stitchIncomplete`` (their scatter
+          legs already carry the error span from query time).
+          ``?local=true`` skips stitching (what the router sends to
+          shards, so stitching can never recurse)."""
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed")
+        tracer = self.tsdb.tracer
+        if not tracer.enabled:
+            raise HttpError(400, "Tracing is disabled",
+                            "set tsd.trace.enable = true")
+        if not rest:
+            limit = as_int(request.param("limit"), "limit", 50)
+            min_ms = float(request.param("min_duration_ms", "0")
+                           or "0")
+            status = request.param("status", "") or ""
+            if status not in ("", "ok", "error"):
+                raise HttpError(400, "status must be ok or error")
+            return HttpResponse(200, json.dumps(tracer.recent(
+                status=status, min_duration_ms=min_ms,
+                slow_only=request.flag("slow"),
+                limit=limit)).encode())
+        trace_id = rest[0]
+        from opentsdb_tpu.obs.trace import SpanRecord, build_tree
+        data = tracer.get(trace_id)
+        spans = list(data.spans) if data is not None else []
+        incomplete: list[str] = []
+        cluster = self.tsdb.cluster
+        if cluster is not None and not request.flag("local"):
+            # ask the shards even when the router's own copy was
+            # evicted: their subtrees may survive longer (build_tree
+            # renders them as orphan roots)
+            extra, incomplete = cluster.fetch_peer_trace(trace_id)
+            spans.extend(SpanRecord.from_json(d) for d in extra)
+        if not spans:
+            raise HttpError(404, f"No trace with id {trace_id!r}",
+                            "evicted from the ring, or never "
+                            "retained (see tsd.trace.sample)")
+        doc: dict[str, Any] = {
+            "traceId": trace_id,
+            "slow": bool(data is not None and data.slow),
+            "spanCount": len(spans),
+            "spans": [s.to_json() for s in spans],
+            "tree": build_tree(spans),
+        }
+        if incomplete:
+            doc["stitchIncomplete"] = incomplete
+        return HttpResponse(200, json.dumps(doc).encode())
+
     def _handle_lifecycle(self, request: HttpRequest, rest
                           ) -> HttpResponse:
         """Data-lifecycle admin surface
@@ -1400,6 +1549,14 @@ class HttpRpcRouter:
             # serialization time, so the pixel-downsampling bytes win
             # is measurable in production
             "query_payload": t.payload_stats.health_info(),
+            # request-level + per-stage latency percentiles
+            # (p50/p95/p99/p999; stages fed by the tracer)
+            "latency": t.stats.latency_summary(),
+            # tracing subsystem state (ring depths, sampling,
+            # slowlog, query-shape log)
+            "trace": t.tracer.health_info(),
+            # self-telemetry pump (tsd.stats.self_interval)
+            "telemetry": t.telemetry.health_info(),
             # sharded cluster tier: per-peer breaker/spool state,
             # degraded-query and handoff counters (router role only)
             "cluster": cluster_info,

@@ -34,19 +34,29 @@ _HTTP_METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"OPTI",
                  b"PATC")
 
 
+def _api_endpoint(path: str) -> str:
+    """The path's first endpoint segment with the ``/api[/vN]``
+    prefix stripped (ASCII-only version match, agreeing with
+    HttpRpcRouter._dispatch's parse)."""
+    parts = [p for p in path.split("/") if p]
+    if parts and parts[0] == "api":
+        parts = parts[1:]
+        if parts and re.fullmatch(r"v[0-9]+", parts[0]):
+            parts = parts[1:]
+    return parts[0] if parts else ""
+
+
 def _is_query_path(path: str) -> bool:
     """True for the endpoints ``tsd.query.timeout`` governs — the data
     query surface only (ref: the reference expires *queries*, not
     writes; a timed-out /api/put would 504 while the write still
     commits, making client retries duplicate side effects)."""
-    parts = [p for p in path.split("/") if p]
-    if parts and parts[0] == "api":
-        parts = parts[1:]
-        # ASCII-only, matching HttpRpcRouter._dispatch's matcher —
-        # the two parses must agree on what counts as a version
-        if parts and re.fullmatch(r"v[0-9]+", parts[0]):
-            parts = parts[1:]
-    return bool(parts) and parts[0] in ("query", "q")
+    return _api_endpoint(path) in ("query", "q")
+
+
+def _is_put_path(path: str) -> bool:
+    """The write front door (``/api/put``) — feeds latency_put."""
+    return _api_endpoint(path) == "put"
 
 
 class IdleTimeout(Exception):
@@ -357,6 +367,9 @@ class TSDServer:
         streaming = self.tsdb.streaming
         if streaming is not None and streaming.workers.enabled:
             streaming.workers.start()
+        # self-telemetry pump (obs/telemetry.py): no-op unless
+        # tsd.stats.self_interval > 0. Stopped by TSDB.shutdown.
+        self.tsdb.telemetry.start()
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 
@@ -608,11 +621,12 @@ class TSDServer:
             peer = writer.get_extra_info("peername")
             keep_alive = (version == "HTTP/1.1" and
                           headers.get("connection", "").lower() != "close")
+            t0 = time.monotonic()
             request = HttpRequest(
                 method=method.upper(), path=parsed.path, params=params,
                 headers=headers, body=body,
-                remote=f"{peer[0]}:{peer[1]}" if peer else "")
-            t0 = time.monotonic()
+                remote=f"{peer[0]}:{peer[1]}" if peer else "",
+                received_at=t0)
             is_query = False
             if method.upper() == "OPTIONS":
                 # preflight bypasses auth — browsers never attach
@@ -675,8 +689,16 @@ class TSDServer:
                                 .encode())
                     else:
                         response = await fut
-                self.tsdb.stats.latency_query.add(
-                    (time.monotonic() - t0) * 1000)
+                # request-level latency histograms (exported with
+                # percentiles at /api/stats + /api/health): queries
+                # and puts each feed their own histogram — mixing
+                # them buried put latency in the query distribution
+                # and left latency_put empty since the seed
+                elapsed_ms = (time.monotonic() - t0) * 1000
+                if is_query:
+                    self.tsdb.stats.latency_query.add(elapsed_ms)
+                elif _is_put_path(urllib.parse.unquote(parsed.path)):
+                    self.tsdb.stats.latency_put.add(elapsed_ms)
             self._apply_cors(request, response)
             await self._apply_gzip(request, response)
             if getattr(response, "close_connection", False):

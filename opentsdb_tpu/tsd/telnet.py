@@ -110,6 +110,27 @@ class TelnetRouter:
         if len(lines) == 1:
             r = self._cmd_put(lines[0].split())
             return [r] if r else []
+        # one ingest.telnet trace roots the whole burst (per-line
+        # roots would tax the hot loop); stages recorded inside
+        # import_buffer (decode / store.scatter / wal.commit_wait /
+        # stream.tap)
+        from opentsdb_tpu.obs import trace as trace_mod
+        tracer = getattr(self.tsdb, "tracer", None)
+        tctx = tracer.start_request("ingest.telnet") \
+            if tracer is not None and tracer.enabled else None
+        if tctx is not None:
+            tctx.tag(lines=len(lines))
+            try:
+                with trace_mod.use(tctx):
+                    return self._put_lines_run(lines)
+            except Exception as exc:
+                tctx.set_error(exc)
+                raise
+            finally:
+                tracer.finish(tctx)
+        return self._put_lines_run(lines)
+
+    def _put_lines_run(self, lines: list[str]) -> list[str]:
         failed: set[int] = set()
         bodies = []
         for i, ln in enumerate(lines):

@@ -228,6 +228,34 @@ _DEFAULTS: dict[str, str] = {
     "tsd.core.authentication.enable": "false",
     # stats
     "tsd.stats.canonical": "false",
+    # self-telemetry (obs/telemetry.py): every interval the TSD
+    # ingests its own counters/gauges/stage-latency percentiles as
+    # tsd.* series through the normal write path (0 = off)
+    "tsd.stats.self_interval": "0",
+    #   node identity tag on every self-telemetry record (host=...);
+    #   "" = auto: hostname-port, so a fleet's per-shard tsd.* series
+    #   stay distinguishable through a router-side merge
+    "tsd.stats.self_tag": "",
+    # request tracing (obs/trace.py): ring-buffered sampled span
+    # records over ingest/query/background hot paths. sample = keep
+    # 1 in N request roots (slow/error traces are always kept); ring/
+    # slow_ring bound retained roots; max_spans bounds one trace.
+    "tsd.trace.enable": "true",
+    "tsd.trace.sample": "64",
+    "tsd.trace.ring": "256",
+    "tsd.trace.slow_ring": "64",
+    "tsd.trace.max_spans": "512",
+    #   query-shape log: one JSONL line per retained query trace
+    #   (metric/filters/downsample/pixels/cache outcome/stage
+    #   breakdown) in <data_dir>/query_shapes.jsonl, rotated past
+    #   max_kb — the offline mining input for workload-adaptive
+    #   summaries (ROADMAP item 5)
+    "tsd.trace.shapes.enable": "true",
+    "tsd.trace.shapes.max_kb": "1024",
+    # slow-request log: a query root slower than this is retained at
+    # full fidelity regardless of sampling + WARNed into /logs with
+    # its trace id (0 = off)
+    "tsd.query.slowlog.threshold_ms": "0",
     # TPU-native keys (no reference equivalent)
     "tsd.tpu.dtype": "float32",
     "tsd.tpu.platform": "",  # force jax platform (cpu|tpu|axon); "" = auto

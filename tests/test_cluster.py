@@ -899,7 +899,7 @@ class TestPerSubRetryPeerDeath:
         calls = {"n": 0}
         calls_lock = threading.Lock()
 
-        def wrapper(peer, req_body):
+        def wrapper(peer, req_body, headers=None):
             if peer.name == target:
                 with calls_lock:
                     calls["n"] += 1
@@ -908,7 +908,7 @@ class TestPerSubRetryPeerDeath:
                 # the (concurrent) per-sub retries — exactly one dies
                 if n == 3:
                     raise OSError("peer died mid per-sub retry")
-            return orig(peer, req_body)
+            return orig(peer, req_body, headers=headers)
 
         router._query_peer = wrapper
         try:
@@ -961,10 +961,10 @@ class TestPerSubRetryMemoization:
         calls_lock = threading.Lock()
         orig = router._query_peer
 
-        def wrapper(peer, req_body):
+        def wrapper(peer, req_body, headers=None):
             with calls_lock:
                 calls[peer.name] = calls.get(peer.name, 0) + 1
-            return orig(peer, req_body)
+            return orig(peer, req_body, headers=headers)
 
         router._query_peer = wrapper
         try:

@@ -1,0 +1,766 @@
+"""Observability battery: request tracing + self-telemetry.
+
+- tracer core: deterministic 1-in-N sampling, closed span-name
+  registry, bounded rings with index eviction, span caps
+- HTTP surfaces: ingest.put / query.http roots with stage spans,
+  ``X-TSD-Trace-Id`` response header, ``GET /api/trace`` filters,
+  ``GET /api/trace/<id>`` tree, latency percentiles at /api/stats +
+  /api/health
+- slow-request log: an unsampled-but-slow query is retained at full
+  fidelity + WARN'd into the log ring with its trace id
+- query-shape log: bounded JSONL ring with shape tags + stage
+  breakdown, cache-outcome transitions, rotation
+- self-telemetry: the pump's tsd.* series are queryable, feed a
+  standing continuous query, and age out under lifecycle policies
+  like any other data
+- cluster: a chaos-degraded 3-shard scatter yields ONE retrievable
+  trace tree spanning router + surviving shards, with the dead peer
+  as an error span; a write spooled during the outage links to the
+  later replay trace
+
+The whole module runs under the runtime lock-order witness (the PR 9
+note: new worker/loop concurrency must prove ordering-clean).
+"""
+
+import json
+import time
+
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.obs.trace import (KNOWN_SPANS, Tracer, build_tree,
+                                    parse_trace_header)
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+pytestmark = pytest.mark.obs
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _witnessed(lock_witness):
+    """Every tracer/telemetry lock created in this module records its
+    acquisition order; teardown fails the module on any cycle."""
+    yield
+
+
+def mk_tsdb(**cfg):
+    return TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "memory",
+        "tsd.tpu.warmup": "false",
+        "tsd.trace.sample": "1",
+        **cfg,
+    }))
+
+
+def put_body(metric="sys.obs", n=10, host="a", base=BASE):
+    return json.dumps([
+        {"metric": metric, "timestamp": base + i, "value": i,
+         "tags": {"host": host}} for i in range(n)]).encode()
+
+
+def query_obj(metric="sys.obs", ds="10s-avg"):
+    q = {"start": BASE_MS - 10_000, "end": BASE_MS + 600_000,
+         "queries": [{"metric": metric, "aggregator": "sum"}]}
+    if ds:
+        q["queries"][0]["downsample"] = ds
+    return q
+
+
+def span_names(tree_node, acc=None):
+    acc = acc if acc is not None else []
+    acc.append(tree_node["name"])
+    for c in tree_node["children"]:
+        span_names(c, acc)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+class TestTracerCore:
+    def _cfg(self, **over):
+        return Config(**{"tsd.tpu.warmup": "false", **over})
+
+    def test_sampling_is_deterministic(self):
+        tracer = Tracer(self._cfg(**{"tsd.trace.sample": "4"}))
+        pattern = []
+        for _ in range(8):
+            ctx = tracer.start_request("query.http")
+            pattern.append(tracer.finish(ctx))
+        assert pattern == [True, False, False, False,
+                           True, False, False, False]
+        assert tracer.traces_committed == 2
+        assert tracer.traces_sampled_out == 6
+
+    def test_unknown_span_name_raises(self):
+        tracer = Tracer(self._cfg())
+        with pytest.raises(ValueError, match="KNOWN_SPANS"):
+            # tsdlint: allow[trace-sites] deliberately unregistered —
+            # this test proves the runtime side of the registry
+            tracer.start_request("not.a.span")
+        ctx = tracer.start_request("query.http")
+        with pytest.raises(ValueError, match="KNOWN_SPANS"):
+            ctx.begin("also.not.a.span")
+        tracer.finish(ctx)
+
+    def test_ring_bound_and_index_eviction(self):
+        tracer = Tracer(self._cfg(**{"tsd.trace.sample": "1",
+                                     "tsd.trace.ring": "4"}))
+        ids = []
+        for _ in range(10):
+            ctx = tracer.start_request("query.http")
+            tracer.finish(ctx)
+            ids.append(ctx.trace_id)
+        recent = tracer.recent(limit=100)
+        assert len(recent) == 4
+        kept = {r["traceId"] for r in recent}
+        assert kept == set(ids[-4:])
+        # evicted ids are gone from the index too (no leak)
+        for tid in ids[:-4]:
+            assert tracer.get(tid) is None
+        for tid in ids[-4:]:
+            assert tracer.get(tid) is not None
+
+    def test_span_cap_drops_and_counts(self):
+        tracer = Tracer(self._cfg(**{"tsd.trace.sample": "1",
+                                     "tsd.trace.max_spans": "16"}))
+        ctx = tracer.start_request("query.http")
+        for _ in range(40):
+            h = ctx.begin("query.plan")
+            if h is not None:
+                h.finish()
+        tracer.finish(ctx)
+        data = tracer.get(ctx.trace_id)
+        assert len(data.spans) <= 17  # root + max_spans
+        assert tracer.spans_dropped > 0
+
+    def test_disabled_tracer_is_noop(self):
+        tracer = Tracer(self._cfg(**{"tsd.trace.enable": "false"}))
+        assert tracer.start_request("query.http") is None
+        assert tracer.finish(None) is False
+
+    def test_error_trace_always_retained(self):
+        tracer = Tracer(self._cfg(**{"tsd.trace.sample": "1000000"}))
+        # the 1st root is always the sampled one: burn it so the
+        # roots under test are deterministically sampled OUT
+        tracer.finish(tracer.start_request("ingest.put"))
+        ctx = tracer.start_request("query.http")
+        tracer.finish(ctx)
+        assert not ctx.committed  # sampled out
+        ctx = tracer.start_request("query.http")
+        ctx.set_error(ValueError("boom"))
+        assert tracer.finish(ctx)
+        assert tracer.get(ctx.trace_id).root.status == "error"
+
+    def test_header_round_trip(self):
+        tracer = Tracer(self._cfg(**{"tsd.trace.sample": "1"}))
+        ctx = tracer.start_request("query.http")
+        h = ctx.begin("cluster.peer")
+        val = tracer.header_for(ctx, h)
+        parsed = parse_trace_header(val)
+        assert parsed == (ctx.trace_id, h.span_id, True)
+        # malformed headers never raise
+        for bad in ("", "a:b", "x" * 200, "id:parent:1:extra",
+                    "../../x:p:1"):
+            assert parse_trace_header(bad) is None or \
+                bad == f"{parsed[0]}:{parsed[1]}:1"
+        h.finish()
+        tracer.finish(ctx)
+
+    def test_propagated_header_forces_retention(self):
+        # the header is honored in SHARD role only (it is the
+        # router→shard channel, not a client surface)
+        tracer = Tracer(self._cfg(**{
+            "tsd.trace.sample": "1000000",
+            "tsd.cluster.role": "shard"}))
+
+        class Req:
+            headers = {"x-tsd-trace": "cafe1234cafe1234:abc-1:1"}
+            remote = ""
+            received_at = 0.0
+
+        ctx = tracer.start_request("query.http", Req())
+        assert ctx.trace_id == "cafe1234cafe1234"
+        assert ctx.parent_id == "abc-1"
+        assert tracer.finish(ctx) is True
+        # flag 0 = upstream sampled it out: this node must agree
+        class Req0:
+            headers = {"x-tsd-trace": "cafe1234cafe1234:abc-1:0"}
+            remote = ""
+            received_at = 0.0
+
+        ctx = tracer.start_request("query.http", Req0())
+        assert tracer.finish(ctx) is False
+
+    def test_header_ignored_outside_shard_role(self):
+        # a forged client header on a standalone/router TSD must not
+        # bypass sampling or pick the trace id
+        tracer = Tracer(self._cfg(**{"tsd.trace.sample": "1000000"}))
+        tracer.finish(tracer.start_request("ingest.put"))  # burn #1
+
+        class Req:
+            headers = {"x-tsd-trace": "cafe1234cafe1234:abc-1:1"}
+            remote = ""
+            received_at = 0.0
+
+        ctx = tracer.start_request("query.http", Req())
+        assert ctx.trace_id != "cafe1234cafe1234"
+        assert tracer.finish(ctx) is False
+
+    def test_same_trace_id_legs_merge(self):
+        # one shard can serve several legs of one trace (per-sub
+        # retries, hedged duplicates): later legs must MERGE, not
+        # overwrite — last-write-wins lost earlier subtrees from the
+        # stitched tree
+        tracer = Tracer(self._cfg(**{
+            "tsd.trace.sample": "1", "tsd.cluster.role": "shard"}))
+
+        def leg(parent):
+            class Req:
+                headers = {"x-tsd-trace":
+                           f"feedc0defeedc0de:{parent}:1"}
+                remote = ""
+                received_at = 0.0
+            ctx = tracer.start_request("query.http", Req())
+            h = ctx.begin("query.plan")
+            h.finish()
+            tracer.finish(ctx)
+            return ctx
+
+        c1 = leg("leg-1")
+        c2 = leg("leg-2")
+        data = tracer.get("feedc0defeedc0de")
+        roots = {s.parent_id for s in data.spans
+                 if s.name == "query.http"}
+        assert roots == {"leg-1", "leg-2"}
+        assert sum(1 for s in data.spans
+                   if s.name == "query.plan") == 2
+        # both legs' roots are retrievable; only one ring slot used
+        assert len(tracer.recent(limit=100)) == 1
+        assert c1.committed and c2.committed
+
+    def test_slowlog_propagates_retention_to_hops(self):
+        # slow-retention is decided at FINISH, after downstream hops
+        # already chose: with a slowlog configured, query hops must
+        # carry flag=1 so a later-slow trace stitches fully
+        tracer = Tracer(self._cfg(**{
+            "tsd.trace.sample": "1000000",
+            "tsd.query.slowlog.threshold_ms": "200"}))
+        tracer.finish(tracer.start_request("ingest.put"))  # burn #1
+        ctx = tracer.start_request("query.http")
+        assert not ctx.sampled
+        assert tracer.header_for(ctx).endswith(":1")
+        tracer.finish(ctx)
+        # without a slowlog the unsampled flag propagates as 0
+        tracer2 = Tracer(self._cfg(**{
+            "tsd.trace.sample": "1000000"}))
+        tracer2.finish(tracer2.start_request("ingest.put"))
+        ctx2 = tracer2.start_request("query.http")
+        assert tracer2.header_for(ctx2).endswith(":0")
+        tracer2.finish(ctx2)
+
+    def test_build_tree_orphans_become_roots(self):
+        from opentsdb_tpu.obs.trace import SpanRecord
+        spans = [SpanRecord("a-0", "", "query.http", 0.0, 5.0),
+                 SpanRecord("a-1", "a-0", "query.plan", 1.0, 1.0),
+                 SpanRecord("b-0", "missing", "query.execute",
+                            2.0, 1.0)]
+        roots = build_tree(spans)
+        assert [r["name"] for r in roots] == ["query.http",
+                                              "query.execute"]
+        assert roots[0]["children"][0]["name"] == "query.plan"
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces
+# ---------------------------------------------------------------------------
+
+class TestHttpTracing:
+    def test_put_and_query_roots_with_stages(self):
+        t = mk_tsdb()
+        r = HttpRpcRouter(t)
+        resp = r.handle(HttpRequest("POST", "/api/put", {},
+                                    body=put_body()))
+        assert resp.status == 204
+        put_tid = resp.headers.get("X-TSD-Trace-Id")
+        assert put_tid
+        resp = r.handle(HttpRequest(
+            "POST", "/api/query", {},
+            body=json.dumps(query_obj()).encode()))
+        assert resp.status == 200
+        q_tid = resp.headers.get("X-TSD-Trace-Id")
+        assert q_tid and q_tid != put_tid
+
+        doc = json.loads(r.handle(HttpRequest(
+            "GET", f"/api/trace/{put_tid}", {})).body)
+        names = set(span_names(doc["tree"][0]))
+        assert "ingest.put" in names
+        assert "ingest.decode" in names
+        assert "store.scatter" in names
+
+        doc = json.loads(r.handle(HttpRequest(
+            "GET", f"/api/trace/{q_tid}", {})).body)
+        names = set(span_names(doc["tree"][0]))
+        for expected in ("query.http", "query.plan", "query.execute",
+                         "query.assemble", "query.serialize"):
+            assert expected in names, names
+        # shape tags ride the root span
+        root = doc["tree"][0]
+        assert root["tags"]["metrics"] == "sys.obs"
+        assert root["tags"]["cache"] in ("miss", "hit")
+        # every registered span name the trace used is registered
+        assert set(span_names(doc["tree"][0])) <= KNOWN_SPANS
+
+    def test_trace_list_filters_and_404(self):
+        t = mk_tsdb()
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body()))
+        # an unknown metric 400s AND retains an error trace
+        resp = r.handle(HttpRequest(
+            "POST", "/api/query", {},
+            body=json.dumps(query_obj("no.such.metric")).encode()))
+        assert resp.status == 400
+        err_tid = resp.headers.get("X-TSD-Trace-Id")
+        assert err_tid
+        rows = json.loads(r.handle(HttpRequest(
+            "GET", "/api/trace", {"status": ["error"]})).body)
+        assert [row["traceId"] for row in rows] == [err_tid]
+        assert rows[0]["status"] == "error"
+        rows = json.loads(r.handle(HttpRequest(
+            "GET", "/api/trace", {"status": ["ok"]})).body)
+        assert err_tid not in {row["traceId"] for row in rows}
+        resp = r.handle(HttpRequest("GET",
+                                    "/api/trace/deadbeef00000000", {}))
+        assert resp.status == 404
+        resp = r.handle(HttpRequest("GET", "/api/trace",
+                                    {"status": ["bogus"]}))
+        assert resp.status == 400
+
+    def test_latency_percentile_surfaces(self):
+        t = mk_tsdb()
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body()))
+        r.handle(HttpRequest("POST", "/api/query", {},
+                             body=json.dumps(query_obj()).encode()))
+        stats = json.loads(r.handle(HttpRequest(
+            "GET", "/api/stats", {})).body)
+        by_name = {}
+        for row in stats:
+            by_name.setdefault(row["metric"], []).append(row)
+        assert "tsd.latency.query.execute" in by_name
+        pcts = {row["tags"]["pct"] for row in
+                by_name["tsd.latency.query.execute"]
+                if "pct" in row["tags"]}
+        assert pcts == {"p50", "p95", "p99", "p999"}
+        assert "tsd.latency.ingest.put" in by_name
+        health = json.loads(r.handle(HttpRequest(
+            "GET", "/api/health", {})).body)
+        stages = health["latency"]["stages"]
+        assert "query.execute" in stages
+        assert stages["query.execute"]["count"] >= 1
+        assert {"p50", "p95", "p99", "p999", "count"} <= \
+            set(stages["query.execute"])
+        assert health["trace"]["enabled"] is True
+        assert health["trace"]["committed"] >= 2
+        assert health["telemetry"]["interval_s"] == 0.0
+
+    def test_wal_commit_wait_span(self, tmp_path):
+        t = mk_tsdb(**{"tsd.storage.data_dir": str(tmp_path),
+                       "tsd.storage.wal.fsync": "always"})
+        r = HttpRpcRouter(t)
+        resp = r.handle(HttpRequest("POST", "/api/put", {},
+                                    body=put_body()))
+        tid = resp.headers.get("X-TSD-Trace-Id")
+        doc = json.loads(r.handle(HttpRequest(
+            "GET", f"/api/trace/{tid}", {})).body)
+        names = set(span_names(doc["tree"][0]))
+        assert "wal.commit_wait" in names
+        t.shutdown()
+
+    def test_telnet_burst_root(self):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        t = mk_tsdb()
+        router = TelnetRouter(t)
+        lines = [f"put sys.tn {BASE + i} {i} host=a"
+                 for i in range(8)]
+        responses, _exc = router.execute_lines(lines)
+        assert not responses
+        rows = t.tracer.recent(limit=10)
+        assert any(row["name"] == "ingest.telnet" for row in rows)
+        tid = next(row["traceId"] for row in rows
+                   if row["name"] == "ingest.telnet")
+        spans = {s.name for s in t.tracer.get(tid).spans}
+        assert "store.scatter" in spans
+        assert "ingest.decode" in spans
+
+
+# ---------------------------------------------------------------------------
+# slow-request log
+# ---------------------------------------------------------------------------
+
+class TestSlowlog:
+    def test_slow_query_survives_sampling(self):
+        from opentsdb_tpu.utils.logring import ring_buffer
+        t = mk_tsdb(**{
+            # sampling would drop everything...
+            "tsd.trace.sample": "1000000",
+            # ...but any query root over 0.001ms is forced through
+            "tsd.query.slowlog.threshold_ms": "0.001",
+        })
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body()))
+        resp = r.handle(HttpRequest(
+            "POST", "/api/query", {},
+            body=json.dumps(query_obj()).encode()))
+        tid = resp.headers.get("X-TSD-Trace-Id")
+        assert tid, "slow trace must be retained despite sampling"
+        rows = json.loads(r.handle(HttpRequest(
+            "GET", "/api/trace", {"slow": ["true"]})).body)
+        assert tid in {row["traceId"] for row in rows}
+        assert all(row["slow"] for row in rows)
+        # the put root is NOT slow-eligible (ingest path): sampled out
+        assert all(row["name"].startswith("query") for row in rows)
+        # WARN carrying the trace id landed in the log ring
+        assert any("slow query trace=" + tid in ln
+                   for ln in ring_buffer.lines())
+        assert t.tracer.slow_traces >= 1
+
+    def test_threshold_zero_disables(self):
+        t = mk_tsdb(**{"tsd.trace.sample": "1000000"})
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body()))
+        resp = r.handle(HttpRequest(
+            "POST", "/api/query", {},
+            body=json.dumps(query_obj()).encode()))
+        assert "X-TSD-Trace-Id" not in resp.headers
+        assert t.tracer.slow_traces == 0
+
+
+# ---------------------------------------------------------------------------
+# query-shape log
+# ---------------------------------------------------------------------------
+
+class TestShapeLog:
+    def test_shape_lines_and_cache_outcomes(self, tmp_path):
+        t = mk_tsdb(**{"tsd.storage.data_dir": str(tmp_path),
+                       "tsd.storage.wal.enable": "false"})
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body()))
+        qb = json.dumps(query_obj()).encode()
+        r.handle(HttpRequest("POST", "/api/query", {}, body=qb))
+        r.handle(HttpRequest("POST", "/api/query", {}, body=qb))
+        path = tmp_path / "query_shapes.jsonl"
+        lines = [json.loads(ln) for ln in
+                 path.read_text().splitlines()]
+        assert len(lines) == 2
+        first, second = lines
+        assert first["metrics"] == "sys.obs"
+        assert first["downsample"] == "10s-avg"
+        assert first["aggregator"] == "sum"
+        assert first["cache"] == "miss"
+        assert second["cache"] == "hit"
+        assert "query.execute" in first["stages"]
+        # a cache hit never ran the engine
+        assert "query.execute" not in second["stages"]
+        assert first["durationMs"] > 0
+        assert first["traceId"]
+        t.shutdown()
+
+    def test_shape_log_rotation_bounds_size(self, tmp_path):
+        t = mk_tsdb(**{"tsd.storage.data_dir": str(tmp_path),
+                       "tsd.storage.wal.enable": "false",
+                       "tsd.query.cache.enable": "false",
+                       "tsd.trace.shapes.max_kb": "1"})
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body()))
+        qb = json.dumps(query_obj()).encode()
+        for _ in range(12):
+            r.handle(HttpRequest("POST", "/api/query", {}, body=qb))
+        path = tmp_path / "query_shapes.jsonl"
+        rotated = tmp_path / "query_shapes.jsonl.1"
+        assert rotated.exists()
+        # the live file may have just rotated away; whatever exists
+        # stays bounded by ~one line past the cap
+        if path.exists():
+            assert path.stat().st_size <= 2048
+        assert rotated.stat().st_size <= 2048
+        t.shutdown()
+
+    def test_pixels_recorded(self, tmp_path):
+        t = mk_tsdb(**{"tsd.storage.data_dir": str(tmp_path),
+                       "tsd.storage.wal.enable": "false"})
+        r = HttpRpcRouter(t)
+        r.handle(HttpRequest("POST", "/api/put", {},
+                             body=put_body(n=50)))
+        q = query_obj()
+        q["pixels"] = 10
+        r.handle(HttpRequest("POST", "/api/query", {},
+                             body=json.dumps(q).encode()))
+        path = tmp_path / "query_shapes.jsonl"
+        line = json.loads(path.read_text().splitlines()[-1])
+        assert line["pixels"] == 10
+        t.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# self-telemetry
+# ---------------------------------------------------------------------------
+
+class TestSelfTelemetry:
+    def test_pump_series_queryable(self):
+        from opentsdb_tpu.query.model import TSQuery
+        t = mk_tsdb()
+        n1 = t.telemetry.pump(now_s=BASE)
+        n2 = t.telemetry.pump(now_s=BASE + 60)
+        assert n1 > 10 and n2 >= n1
+        assert t.telemetry.point_errors == 0
+        tsq = TSQuery.from_json({
+            "start": BASE_MS - 1000, "end": BASE_MS + 120_000,
+            "queries": [{"metric": "tsd.datapoints.added",
+                         "aggregator": "sum"}]}).validate()
+        res = t.execute_query(tsq)
+        assert len(res) == 1
+        assert len(res[0].dps) == 2
+        # stage-latency percentile series land too (pct tag intact)
+        tsq = TSQuery.from_json({
+            "start": BASE_MS - 1000, "end": BASE_MS + 120_000,
+            "queries": [{"metric": "tsd.latency.telemetry.pump",
+                         "aggregator": "max",
+                         "filters": [{"type": "literal_or",
+                                      "tagk": "pct",
+                                      "filter": "p99",
+                                      "groupBy": False}]}]}).validate()
+        res = t.execute_query(tsq)
+        assert len(res) == 1 and res[0].num_dps >= 1
+
+    def test_pump_respects_no_auto_create(self):
+        # the operator's auto-create gate governs clients, not the
+        # heartbeat: pumping must work with auto-create off
+        t = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "false",
+            "tsd.storage.backend": "memory",
+            "tsd.tpu.warmup": "false",
+        }))
+        assert t.telemetry.pump(now_s=BASE) > 0
+        assert t.telemetry.point_errors == 0
+
+    def test_standing_cq_over_self_metrics(self):
+        t = mk_tsdb()
+        t.telemetry.pump(now_s=BASE)
+        reg = t.streaming
+        cq = reg.register({
+            "id": "selfcq",
+            "start": BASE_MS - 3600_000, "end": BASE_MS + 3600_000,
+            "queries": [{"metric": "tsd.datapoints.added",
+                         "aggregator": "sum",
+                         "downsample": "1m-sum"}]},
+            now_ms=BASE_MS)
+        t.telemetry.pump(now_s=BASE + 60)
+        t.telemetry.pump(now_s=BASE + 120)
+        res = reg.current_results(cq)
+        payload = json.dumps(res)
+        assert "tsd.datapoints.added" in payload
+        reg.delete("selfcq")
+
+    def test_lifecycle_applies_to_self_series(self):
+        from opentsdb_tpu.query.model import TSQuery
+        t = mk_tsdb(**{"tsd.lifecycle.enable": "true",
+                       "tsd.lifecycle.retention": "30d"})
+        t.telemetry.pump(now_s=BASE)
+
+        def count_dps():
+            tsq = TSQuery.from_json({
+                "start": BASE_MS - 1000,
+                "end": BASE_MS + 100_000,
+                "queries": [{"metric": "tsd.uptime.seconds",
+                             "aggregator": "sum"}]}).validate()
+            res = t.execute_query(tsq)
+            return sum(r.num_dps for r in res)
+
+        assert count_dps() == 1
+        # a sweep inside the retention window keeps the points...
+        report = t.lifecycle.sweep(now_ms=BASE_MS + 3600_000)
+        assert "error" not in report
+        assert count_dps() == 1
+        # ...and one past it ages them out like any other series
+        t.lifecycle.sweep(now_ms=BASE_MS + 40 * 86400_000)
+        assert count_dps() == 0
+        # the sweep itself left a background trace
+        assert any(row["name"] == "lifecycle.sweep"
+                   for row in t.tracer.recent(limit=50))
+
+    def test_pump_trace_root(self):
+        t = mk_tsdb()
+        t.telemetry.pump(now_s=BASE)
+        rows = [row for row in t.tracer.recent(limit=50)
+                if row["name"] == "telemetry.pump"]
+        assert rows and rows[0]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# cluster: chaos trace stitching + spool/replay linkage
+# ---------------------------------------------------------------------------
+
+@pytest.mark.cluster
+class TestClusterTracing:
+    def _mk_cluster(self, tmp_path, **router_cfg):
+        from test_cluster import LiveCluster
+        # shard role on the peers: trace headers are honored (and
+        # subtrees retained) only behind a router, by design
+        return LiveCluster(tmp_path, durable=True,
+                           peer_cfg={"tsd.cluster.role": "shard"},
+                           **{"tsd.trace.sample": "1", **router_cfg})
+
+    def test_killed_shard_yields_one_stitched_trace(self, tmp_path):
+        from test_cluster import _mkpoints
+        c = self._mk_cluster(tmp_path)
+        try:
+            pts = _mkpoints(n_hosts=12, n_sec=30, metric="o.m")
+            resp = c.put(pts, summary="true")
+            assert resp.status == 200, resp.body
+            assert json.loads(resp.body)["failed"] == 0
+            qbody = {"start": BASE_MS - 10_000,
+                     "end": BASE_MS + 200_000,
+                     "queries": [{"metric": "o.m",
+                                  "aggregator": "sum",
+                                  "downsample": "10s-sum"}]}
+            # warm the shards DIRECTLY (compile caches) — warming
+            # through the router would populate its result cache and
+            # the chaos query would hit it instead of scattering
+            from opentsdb_tpu.query.model import TSQuery
+            for p in c.peers:
+                p.tsdb.execute_query(
+                    TSQuery.from_json(qbody).validate())
+            dead = "s1"
+            c.peer(dead).kill()
+            resp, doc = c.query(qbody)
+            assert resp.status == 200
+            assert resp.headers.get(
+                "X-OpenTSDB-Shards-Degraded") == dead
+            tid = resp.headers.get("X-TSD-Trace-Id")
+            assert tid
+            tresp = c.http.handle(HttpRequest(
+                "GET", f"/api/trace/{tid}", {}))
+            assert tresp.status == 200
+            tdoc = json.loads(tresp.body)
+            # one tree, rooted at the router's query.http
+            assert len(tdoc["tree"]) == 1
+            root = tdoc["tree"][0]
+            assert root["name"] == "query.http"
+            flat = {}
+            def walk(n, parent=None):
+                flat.setdefault(n["name"], []).append((n, parent))
+                for ch in n["children"]:
+                    walk(ch, n)
+            walk(root)
+            peers = flat["cluster.peer"]
+            assert len(peers) == 3
+            by_peer = {n["tags"]["peer"]: n for n, _p in peers}
+            # the dead shard is an ERROR span; survivors are ok
+            assert by_peer[dead]["status"] == "error"
+            assert by_peer[dead]["error"]
+            for name in ("s0", "s2"):
+                assert by_peer[name]["status"] == "ok"
+                # the surviving shard's own query.http subtree is
+                # stitched UNDER its scatter leg
+                subtree = [ch["name"]
+                           for ch in by_peer[name]["children"]]
+                assert "query.http" in subtree, (name, subtree)
+            # shard subtrees carry shard-side stages
+            shard_roots = [n for n, p in flat.get("query.http", [])
+                           if p is not None]
+            assert len(shard_roots) == 2
+            for n in shard_roots:
+                assert "query.execute" in span_names(n)
+            # the dead peer could not answer the stitch fetch
+            assert tdoc.get("stitchIncomplete") == [dead]
+            # scatter + merge stages present on the router side
+            assert "cluster.scatter" in flat
+            assert "cluster.merge" in flat
+        finally:
+            c.close()
+
+    def test_degraded_query_forces_trace_retention(self, tmp_path):
+        # 1-in-N sampling must never discard the trace carrying a
+        # degradation's error-tagged peer span — it is exactly what
+        # an operator goes looking for after the marker
+        from test_cluster import _mkpoints
+        from opentsdb_tpu.query.model import TSQuery
+        c = self._mk_cluster(tmp_path,
+                             **{"tsd.trace.sample": "1000000"})
+        try:
+            pts = _mkpoints(n_hosts=12, n_sec=10, metric="o.f")
+            assert json.loads(
+                c.put(pts, summary="true").body)["failed"] == 0
+            qbody = {"start": BASE_MS - 10_000,
+                     "end": BASE_MS + 200_000,
+                     "queries": [{"metric": "o.f",
+                                  "aggregator": "sum",
+                                  "downsample": "10s-sum"}]}
+            for p in c.peers:
+                p.tsdb.execute_query(
+                    TSQuery.from_json(qbody).validate())
+            c.peer("s2").kill()
+            resp, _ = c.query(qbody)
+            assert resp.status == 200
+            assert resp.headers.get(
+                "X-OpenTSDB-Shards-Degraded") == "s2"
+            tid = resp.headers.get("X-TSD-Trace-Id")
+            assert tid, "degraded trace must survive sampling"
+            data = c.tsdb.tracer.get(tid)
+            assert any(s.name == "cluster.peer"
+                       and s.status == "error"
+                       for s in data.spans)
+        finally:
+            c.close()
+
+    def test_spooled_write_links_to_replay_trace(self, tmp_path):
+        c = self._mk_cluster(tmp_path)
+        try:
+            # find a series owned by s0, then take s0 down
+            host = next(f"h{i:02d}" for i in range(40)
+                        if c.shard_of("o.sp", {"host": f"h{i:02d}"})
+                        == "s0")
+            c.peer("s0").kill()
+            pt = [{"metric": "o.sp", "timestamp": BASE,
+                   "value": 1, "tags": {"host": host}}]
+            resp = c.put(pt, summary="true")
+            assert resp.status == 200, resp.body
+            assert json.loads(resp.body)["failed"] == 0  # acked
+            tid_w = resp.headers.get("X-TSD-Trace-Id")
+            assert tid_w
+            wdoc = json.loads(c.http.handle(HttpRequest(
+                "GET", f"/api/trace/{tid_w}", {})).body)
+            wnames = {s["name"] for s in wdoc["spans"]}
+            assert "cluster.forward" in wnames
+            assert "cluster.spool.append" in wnames
+            # the shard returns; the spool drains; the replay trace
+            # links back to the write trace it finally delivered
+            c.peer("s0").restart()
+            assert c.wait_spool_drained("s0")
+            deadline = time.monotonic() + 10
+            links = []
+            while time.monotonic() < deadline:
+                replays = [row for row in
+                           c.tsdb.tracer.recent(limit=100)
+                           if row["name"] == "cluster.spool.replay"]
+                for row in replays:
+                    data = c.tsdb.tracer.get(row["traceId"])
+                    links.extend(
+                        data.root.tags.get("trace_links") or [])
+                if tid_w in links:
+                    break
+                time.sleep(0.1)
+            assert tid_w in links
+        finally:
+            c.close()

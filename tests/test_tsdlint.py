@@ -95,6 +95,27 @@ class TestPassFixtures:
             ("swallow", 9), ("swallow", 16)]
         assert "bare except" in rep.unsuppressed[1].message
 
+    def test_trace_sites(self):
+        rep = lint_fixture("fixture_trace_sites.py")
+        assert [(f.pass_id, f.line, f.detail)
+                for f in rep.unsuppressed] == [
+            ("trace-sites", 10, "bogus.stage"),
+            ("trace-sites", 12, "bogus.root")]
+
+    def test_trace_sites_stale_registry(self):
+        # linting ONLY the registry module: every registered span
+        # name except the ones trace.py itself starts is unused in
+        # that scan, so the stale mechanism must flag them — and the
+        # full-tree gate proves the real registry has no stale names
+        import opentsdb_tpu.obs.trace as trace_module
+        rep = run_tsdlint(package_paths=[trace_module.__file__],
+                          test_paths=[], baseline_path=None,
+                          root=REPO, pass_ids=["trace-sites"])
+        details = {f.detail for f in rep.unsuppressed}
+        assert "stale:query.plan" in details
+        # query.admission is synthesized inside trace.py itself
+        assert "stale:query.admission" not in details
+
     def test_pass_selection(self):
         rep = lint_fixture("fixture_swallow.py",
                            pass_ids=["config-keys"])

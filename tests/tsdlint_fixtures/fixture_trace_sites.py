@@ -1,0 +1,17 @@
+"""tsdlint fixture: two unregistered span literals — a
+``trace_begin`` stage (line 10) and a tracer root (line 12);
+registered names (``query.plan``, ``query.http``) and non-tracer
+``start_background`` receivers must stay clean."""
+
+
+def exercise(tracer, scheduler, router, request):
+    from opentsdb_tpu.obs.trace import trace_begin, trace_span
+
+    h = trace_begin("bogus.stage")
+    with trace_span("query.plan"):
+        tracer.start_background("bogus.root")
+        router._trace_request("query.http", request, lambda: None)
+
+    # a start_background on a non-tracer receiver is not a span site
+    scheduler.start_background("whatever.this.is")
+    return h
