@@ -1368,6 +1368,215 @@ def bench_cluster(repeats: int, n_hosts: int = 120,
     return out
 
 
+def bench_cluster_rf(repeats: int, n_hosts: int = 60,
+                     span_s: int = 300) -> dict:
+    """Replicated cluster config (``tsd.cluster.rf = 2``): two
+    3-shard clusters ingest the same points at RF=1 and RF=2
+    (interleaved batches — host noise on a shared box swings
+    single-config timings far more than the effect under test), then
+    reads interleave healthy passes, then one RF=2 replica dies and
+    the read-fallback p50 is measured (answers must stay COMPLETE
+    marker-less 200s). Finally the RF=1 cluster resizes online to 4
+    shards and the cutover-window read overhead is recorded.
+    Criteria: RF=2 write amplification ~2x (1.8-2.2), every
+    one-dead-replica read complete + marker-less, every
+    reshard-window read complete."""
+    import asyncio
+    import json as _json
+    import threading
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    peer_cfg = {"tsd.core.auto_create_metrics": "true",
+                "tsd.tpu.warmup": "false"}
+
+    class Peer:
+        def __init__(self, name):
+            self.name = name
+            self.tsdb = TSDB(Config(**peer_cfg))
+            self.loop = asyncio.new_event_loop()
+            self.server = TSDServer(self.tsdb, host="127.0.0.1",
+                                    port=0)
+            started = threading.Event()
+
+            def run():
+                asyncio.set_event_loop(self.loop)
+                self.loop.run_until_complete(self.server.start())
+                started.set()
+                self.loop.run_forever()
+
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+            assert started.wait(30)
+            self.port = (self.server._server.sockets[0]
+                         .getsockname()[1])
+
+        def _call(self, coro):
+            return asyncio.run_coroutine_threadsafe(
+                coro, self.loop).result(20)
+
+        def kill(self):
+            async def _close():
+                srv = self.server._server
+                if srv is not None:
+                    srv.close()
+                    await srv.wait_closed()
+                    self.server._server = None
+            self._call(_close())
+
+        def stop(self):
+            try:
+                self._call(self.server.stop())
+            except Exception:  # noqa: BLE001
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+
+    def req(method, path, body=None, **params):
+        return HttpRequest(
+            method=method, path=path,
+            params={k: [str(v)] for k, v in params.items()},
+            body=_json.dumps(body).encode()
+            if body is not None else b"")
+
+    def mk_router(peers, rf):
+        spec = ",".join(f"{p.name}=127.0.0.1:{p.port}"
+                        for p in peers)
+        t = TSDB(Config(**{
+            "tsd.cluster.role": "router",
+            "tsd.cluster.peers": spec,
+            "tsd.cluster.rf": str(rf),
+            "tsd.cluster.breaker.reset_timeout_ms": "300",
+            "tsd.cluster.reshard.interval_ms": "3600000",
+            "tsd.query.cache.enable": "false",
+            "tsd.tpu.warmup": "false"}))
+        t.cluster.start()
+        return t, HttpRpcRouter(t)
+
+    fleets = {1: [Peer(f"a{i}") for i in range(3)],
+              2: [Peer(f"b{i}") for i in range(3)]}
+    routers = {rf: mk_router(peers, rf)
+               for rf, peers in fleets.items()}
+
+    points = [{"metric": "bench.rf",
+               "timestamp": BASE_S + i,
+               "value": (h * 37 + i) % 1000,
+               "tags": {"host": f"h{h:03d}"}}
+              for h in range(n_hosts) for i in range(span_s)]
+    batches = [points[i:i + 4000]
+               for i in range(0, len(points), 4000)]
+
+    ingest_s = {1: 0.0, 2: 0.0}
+    for b in batches:  # interleaved per batch
+        for rf in (1, 2):
+            t0 = time.perf_counter()
+            resp = routers[rf][1].handle(
+                req("POST", "/api/put", b, summary="true"))
+            ingest_s[rf] += time.perf_counter() - t0
+            assert resp.status == 200
+            assert _json.loads(resp.body)["failed"] == 0
+
+    def delivered(rf):
+        return sum(p.forwarded_points + p.spooled_points
+                   for p in routers[rf][0].cluster.peers.values())
+
+    amplification = round(delivered(2) / max(delivered(1), 1), 2)
+
+    qbody = {"start": BASE_MS - 1000,
+             "end": BASE_MS + span_s * 1000,
+             "queries": [{"metric": "bench.rf",
+                          "aggregator": "sum",
+                          "downsample": "10s-sum",
+                          "filters": [{"type": "wildcard",
+                                       "tagk": "host", "filter": "*",
+                                       "groupBy": True}]}]}
+
+    def read_pass(rf):
+        t0 = time.perf_counter()
+        resp = routers[rf][1].handle(req("POST", "/api/query",
+                                         qbody))
+        dt = time.perf_counter() - t0
+        assert resp.status == 200
+        doc = _json.loads(resp.body)
+        degraded = doc and isinstance(doc[-1], dict) and \
+            "shardsDegraded" in doc[-1]
+        return dt, degraded
+
+    for rf in (1, 2):
+        read_pass(rf)  # warm
+    healthy = {1: [], 2: []}
+    for _ in range(max(repeats, 5)):
+        for rf in (1, 2):
+            dt, degraded = read_pass(rf)
+            assert not degraded
+            healthy[rf].append(dt)
+
+    # one RF=2 replica dies: reads must stay complete + marker-less
+    fleets[2][1].kill()
+    fallback_times, fallback_ok = [], True
+    for _ in range(max(repeats, 5)):
+        dt, degraded = read_pass(2)
+        fallback_times.append(dt)
+        fallback_ok &= not degraded
+    fallbacks = routers[2][0].cluster.read_fallbacks
+
+    # online reshard of the RF=1 cluster: 3 -> 4 shards
+    joiner = Peer("a3")
+    rt1, http1 = routers[1]
+    resp = http1.handle(req(
+        "POST", "/api/cluster/reshard",
+        {"peers": rt1.config.get_string("tsd.cluster.peers", "")
+         + f",a3=127.0.0.1:{joiner.port}"}))
+    assert resp.status == 200, resp.body
+    window_times, window_ok = [], True
+    for _ in range(max(repeats, 5)):
+        dt, degraded = read_pass(1)
+        window_times.append(dt)
+        window_ok &= not degraded
+    while rt1.cluster.resharding:
+        info = rt1.cluster.backfill_step()
+        assert info.get("phase") != "blocked", info
+    post_times = []
+    for _ in range(max(repeats, 5)):
+        dt, degraded = read_pass(1)
+        assert not degraded
+        post_times.append(dt)
+
+    h1 = _percentile(healthy[1], 50) * 1e3
+    h2 = _percentile(healthy[2], 50) * 1e3
+    fb = _percentile(fallback_times, 50) * 1e3
+    win = _percentile(window_times, 50) * 1e3
+    post = _percentile(post_times, 50) * 1e3
+    out = {"config": "cluster_rf", "shards": 3, "rf": 2,
+           "series": n_hosts, "points": len(points),
+           "write_amplification_rf2": amplification,
+           "ingest_kpps_rf1":
+               round(len(points) / ingest_s[1] / 1e3, 1),
+           "ingest_kpps_rf2":
+               round(len(points) / ingest_s[2] / 1e3, 1),
+           "read_p50_rf1_ms": round(h1, 1),
+           "read_p50_rf2_ms": round(h2, 1),
+           "read_p50_rf2_one_dead_ms": round(fb, 1),
+           "read_fallbacks": fallbacks,
+           "one_dead_reads_complete_markerless": fallback_ok,
+           "reshard_window_read_p50_ms": round(win, 1),
+           "reshard_window_overhead":
+               round(win / max(h1, 1e-3), 2),
+           "post_reshard_read_p50_ms": round(post, 1),
+           "reshard_window_reads_complete": window_ok,
+           "criterion_pass": bool(
+               1.8 <= amplification <= 2.2 and fallback_ok
+               and window_ok)}
+    for rf in (1, 2):
+        routers[rf][0].shutdown()
+    for peers in fleets.values():
+        for p in peers:
+            p.stop()
+    joiner.stop()
+    return out
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -1393,8 +1602,9 @@ def main() -> None:
                "wal": bench_wal, "live": bench_live,
                "lifecycle": bench_lifecycle, "cold": bench_cold,
                "ingest": bench_ingest, "viz": bench_viz,
-               "cluster": bench_cluster, "streamv2": bench_streamv2,
-               "obs": bench_obs}
+               "cluster": bench_cluster,
+               "cluster_rf": bench_cluster_rf,
+               "streamv2": bench_streamv2, "obs": bench_obs}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

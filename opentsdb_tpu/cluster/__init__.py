@@ -24,11 +24,21 @@ carrying a ``shardsDegraded`` marker that the result cache refuses to
 retain. Writes to an unreachable shard land in a per-peer durable
 spool (framed like the WAL) that replays when the peer's breaker
 half-opens — an acknowledged point is never lost to a peer outage.
+
+With replication (``tsd.cluster.rf`` = 2/3,
+:mod:`opentsdb_tpu.cluster.replica`) the tier survives outright:
+writes fan out to every replica owner, reads take one replica per
+set and fall back to the next (a single shard death answers a
+COMPLETE marker-less 200), anti-entropy re-copies divergence windows
+the spool lost, and :mod:`opentsdb_tpu.cluster.reshard` grows or
+shrinks the ring online behind a fenced, persisted epoch.
 """
 
 from opentsdb_tpu.cluster.hashring import HashRing, series_shard_key
+from opentsdb_tpu.cluster.replica import DirtyTracker
+from opentsdb_tpu.cluster.reshard import ReshardState
 from opentsdb_tpu.cluster.router import ClusterRouter
 from opentsdb_tpu.cluster.spool import PeerSpool
 
-__all__ = ["ClusterRouter", "HashRing", "PeerSpool",
-           "series_shard_key"]
+__all__ = ["ClusterRouter", "DirtyTracker", "HashRing", "PeerSpool",
+           "ReshardState", "series_shard_key"]

@@ -36,7 +36,15 @@ def series_shard_key(metric: str, tags: dict[str, str]) -> bytes:
 
 class HashRing:
     """Consistent-hash ring over named shards with ``vnodes`` virtual
-    points per shard (more vnodes = smoother key distribution)."""
+    points per shard (more vnodes = smoother key distribution).
+
+    Replication (RF ≥ 2) walks the ring clockwise from the key's hash
+    point and collects the next R *distinct* shards — the Dynamo
+    preference-list construction, which Monarch mirrors by assigning
+    each target to 2-3 leaves. The ordered tuple is a series'
+    **replica set**: ``[0]`` is the primary, the rest are fallbacks,
+    and the set changes for only ``~1/N`` of series when a shard
+    joins or leaves (the same property single ownership had)."""
 
     def __init__(self, names: list[str], vnodes: int = 64):
         if not names:
@@ -50,17 +58,59 @@ class HashRing:
         points.sort()
         self._points = [p for p, _ in points]
         self._owners = [n for _, n in points]
+        # replica tuples are pure functions of (segment start, rf):
+        # memoized per rf because reads recompute them per series
+        self._sets_cache: dict[int, tuple] = {}
 
-    def shard_for_key(self, key: bytes) -> str:
-        """Owning shard of one pre-computed series key."""
+    def _walk(self, idx: int, rf: int) -> tuple[str, ...]:
+        """Ordered next-``rf``-distinct owners clockwise from vnode
+        position ``idx`` (the key's successor point)."""
+        out: list[str] = []
+        n = len(self._owners)
+        for step in range(n):
+            owner = self._owners[(idx + step) % n]
+            if owner not in out:
+                out.append(owner)
+                if len(out) == rf:
+                    break
+        return tuple(out)
+
+    def shards_for_key(self, key: bytes, rf: int = 1
+                       ) -> tuple[str, ...]:
+        """Ordered replica set (primary first) of one series key,
+        clamped to the shard count."""
+        rf = max(1, min(int(rf), len(self.names)))
         h = _hash64(key)
         idx = bisect.bisect_right(self._points, h)
         if idx == len(self._points):
             idx = 0  # wrap: the ring is circular
-        return self._owners[idx]
+        return self._walk(idx, rf)
+
+    def shard_for_key(self, key: bytes) -> str:
+        """Owning (primary) shard of one pre-computed series key."""
+        return self.shards_for_key(key, 1)[0]
 
     def shard_for(self, metric: str, tags: dict[str, str]) -> str:
         return self.shard_for_key(series_shard_key(metric, tags))
+
+    def shards_for(self, metric: str, tags: dict[str, str],
+                   rf: int = 1) -> tuple[str, ...]:
+        return self.shards_for_key(series_shard_key(metric, tags), rf)
+
+    def replica_sets(self, rf: int) -> tuple[tuple[str, ...], ...]:
+        """Every distinct ordered replica set this ring can assign at
+        ``rf`` — one candidate per vnode segment, deduplicated. The
+        router's read plan assigns each set to exactly one member, so
+        a scatter covers every series exactly once."""
+        rf = max(1, min(int(rf), len(self.names)))
+        cached = self._sets_cache.get(rf)
+        if cached is None:
+            seen: dict[tuple[str, ...], None] = {}
+            for idx in range(len(self._points)):
+                seen.setdefault(self._walk(idx, rf))
+            cached = tuple(seen)
+            self._sets_cache[rf] = cached
+        return cached
 
     def distribution(self, keys) -> dict[str, int]:
         """Shard -> key count for a sample of keys (tests/ops)."""

@@ -1,0 +1,242 @@
+"""Replica selection + anti-entropy for the replicated cluster tier.
+
+Two halves of the RF ≥ 2 story live here, shared by the router (which
+*builds* read plans) and the shard engine (which *applies* them):
+
+**Replica selection** (``replicaSel``). With replication, a plain
+scatter would double-count: every series exists on RF shards, and each
+shard's group partial folds every series it holds. The router instead
+assigns each distinct ordered replica set (:meth:`HashRing.
+replica_sets`) to exactly ONE member and sends that member the
+assignment inside the query body::
+
+    "replicaSel": {"peers": [...], "vnodes": 64, "rf": 2,
+                   "sets": [["s0", "s1"], ["s2", "s0"]]}
+
+The shard rebuilds the same ring (``peers``/``vnodes`` pin it — MD5
+hashing makes it identical across processes), computes each candidate
+series' replica set, and keeps the series only when its set is among
+the ones assigned to this request. Every series is therefore read
+exactly once cluster-wide, and a failed reader's sets re-assign to the
+next replica (the router's fallback rounds) without re-reading what
+already answered.
+
+**Anti-entropy** (:class:`DirtyTracker`). The durable spool already
+replays every acked write to a returned peer — it IS the first line of
+anti-entropy. What it cannot cover is the window where the spool
+itself failed (append error, ``SpoolFull`` refusal after a replica
+already stored the point, an in-memory spool lost to a router
+restart): the replicas have then *diverged* — one holds points the
+other will never receive. The tracker records a per-(peer, metric)
+dirty-epoch (earliest wall-clock ms the divergence could have begun,
+persisted next to the spool) and, when the peer returns, the router
+re-reads the dirty window from a surviving replica and re-forwards
+the healed peer's share (duplicates dedupe last-write-wins on the
+shard, so repair is idempotent).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Any, Iterable
+
+from opentsdb_tpu.cluster.hashring import HashRing, series_shard_key
+from opentsdb_tpu.query.model import BadRequestError
+
+LOG = logging.getLogger("cluster.replica")
+
+# process-wide ring memo: shards rebuild the router's ring from the
+# replicaSel spec on every filtered query — construction hashes
+# names x vnodes, so identical specs share one instance
+_ring_lock = threading.Lock()
+_ring_cache: dict[tuple, HashRing] = {}
+
+
+def ring_for(peers: Iterable[str], vnodes: int) -> HashRing:
+    key = (tuple(peers), int(vnodes))
+    with _ring_lock:
+        ring = _ring_cache.get(key)
+        if ring is None:
+            if len(_ring_cache) > 64:
+                # reshards retire specs; don't hoard dead rings
+                _ring_cache.clear()
+            ring = _ring_cache[key] = HashRing(list(key[0]), key[1])
+        return ring
+
+
+def sel_doc(peers: list[str], vnodes: int, rf: int,
+            sets: Iterable[tuple[str, ...]]) -> dict[str, Any]:
+    """The wire form of one request's replica assignment."""
+    return {"peers": list(peers), "vnodes": int(vnodes),
+            "rf": int(rf), "sets": [list(t) for t in sets]}
+
+
+def parse_sel(obj: Any) -> dict[str, Any] | None:
+    """Validate a ``replicaSel`` body value (the shard side of the
+    contract). Returns the normalized dict, or raises
+    ``BadRequestError`` — a malformed selector must 400, not 500."""
+    if obj is None:
+        return None
+    if not isinstance(obj, dict):
+        raise BadRequestError("replicaSel must be an object")
+    peers = obj.get("peers")
+    sets = obj.get("sets")
+    if not isinstance(peers, list) or not peers or not all(
+            isinstance(p, str) and p for p in peers):
+        raise BadRequestError(
+            "replicaSel.peers must be a list of shard names")
+    if not isinstance(sets, list) or not all(
+            isinstance(t, list) and t and all(
+                isinstance(n, str) for n in t) for t in sets):
+        raise BadRequestError(
+            "replicaSel.sets must be a list of shard-name lists")
+    try:
+        vnodes = int(obj.get("vnodes", 64))
+        rf = int(obj.get("rf", 1))
+    except (TypeError, ValueError):
+        raise BadRequestError(
+            "replicaSel.vnodes/rf must be integers") from None
+    if rf < 1 or vnodes < 1:
+        raise BadRequestError("replicaSel.vnodes/rf must be >= 1")
+    unknown = {n for t in sets for n in t} - set(peers)
+    if unknown:
+        raise BadRequestError(
+            f"replicaSel.sets name shards not in peers: "
+            f"{sorted(unknown)}")
+    return {"peers": [str(p) for p in peers], "vnodes": vnodes,
+            "rf": rf, "sets": [tuple(t) for t in sets]}
+
+
+def sel_cache_key(sel: dict[str, Any] | None) -> tuple:
+    """Canonical tuple of one selector for result-cache keys: two
+    requests reading DIFFERENT replica assignments of the same query
+    return different partials and must never share an entry."""
+    if not sel:
+        return ()
+    return (tuple(sel["peers"]), sel["vnodes"], sel["rf"],
+            tuple(sorted(tuple(t) for t in sel["sets"])))
+
+
+def series_mask(sel: dict[str, Any], metric: str, series_tags,
+                name_of_kid, name_of_vid):
+    """Shard-side filter: which of this store's candidate series this
+    request is assigned to read. ``series_tags`` yields one
+    ``[(kid, vid), ...]`` list per series; the name resolvers map tag
+    UID ints to strings (the ring hashes NAMES, the one spelling that
+    is identical on every shard — UID ints are per-shard)."""
+    ring = ring_for(sel["peers"], sel["vnodes"])
+    assigned = {tuple(t) for t in sel["sets"]}
+    rf = sel["rf"]
+    out = []
+    for pairs in series_tags:
+        tags = {name_of_kid(int(k)): name_of_vid(int(v))
+                for k, v in pairs}
+        key = series_shard_key(metric, tags)
+        out.append(ring.shards_for_key(key, rf) in assigned)
+    return out
+
+
+class DirtyTracker:
+    """Per-(peer, metric) divergence windows, persisted as one JSON
+    sidecar per router (``<dir>/replica_dirty.json``). An entry maps
+    ``peer -> metric -> earliest-dirty wall-clock ms``; repair reads
+    the surviving replica from that stamp forward (minus a safety
+    margin) and clears the entry on success."""
+
+    def __init__(self, directory: str | None):
+        self._lock = threading.Lock()
+        self._dirty: dict[str, dict[str, int]] = {}
+        self.path = os.path.join(directory, "replica_dirty.json") \
+            if directory else ""
+        self.marks = 0
+        if self.path:
+            try:
+                with open(self.path, "r", encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                if isinstance(doc, dict):
+                    self._dirty = {
+                        str(p): {str(m): int(s)
+                                 for m, s in v.items()}
+                        for p, v in doc.items()
+                        if isinstance(v, dict)}
+            except (OSError, ValueError):
+                self._dirty = {}
+
+    def _save_locked(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self._dirty, fh)
+                fh.flush()
+                # tsdlint: allow[lock-blocking] the dirty mark must be
+                # durable before the divergence window it names can be
+                # forgotten; the lock serializes mark-vs-clear and the
+                # doc is a few KB
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except OSError:  # pragma: no cover - disk trouble
+            LOG.exception("cannot persist dirty marks to %s",
+                          self.path)
+
+    def mark(self, peer: str, metrics: Iterable[str],
+             since_ms: int) -> None:
+        """Record that ``peer`` may be missing writes of ``metrics``
+        from ``since_ms`` on (earliest stamp wins)."""
+        with self._lock:
+            per = self._dirty.setdefault(peer, {})
+            changed = False
+            for m in metrics:
+                cur = per.get(m)
+                if cur is None or since_ms < cur:
+                    per[m] = int(since_ms)
+                    changed = True
+            if changed:
+                self.marks += 1
+                self._save_locked()
+
+    def peek(self, peer: str) -> dict[str, int]:
+        with self._lock:
+            return dict(self._dirty.get(peer, ()))
+
+    def clear(self, peer: str, metrics: Iterable[str] | None = None
+              ) -> None:
+        with self._lock:
+            per = self._dirty.get(peer)
+            if per is None:
+                return
+            if metrics is None:
+                per.clear()
+            else:
+                for m in metrics:
+                    per.pop(m, None)
+            if not per:
+                self._dirty.pop(peer, None)
+            self._save_locked()
+
+    def drop_peer(self, peer: str) -> None:
+        """A peer left the ring (reshard finalize): its debt is void."""
+        with self._lock:
+            if self._dirty.pop(peer, None) is not None:
+                self._save_locked()
+
+    @property
+    def total_entries(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._dirty.values())
+
+    def health_info(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": sum(len(v) for v in self._dirty.values()),
+                "peers": sorted(self._dirty),
+                "marks": self.marks,
+            }
+
+
+__all__ = ["DirtyTracker", "parse_sel", "ring_for", "sel_cache_key",
+           "sel_doc", "series_mask"]

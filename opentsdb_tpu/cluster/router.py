@@ -32,17 +32,21 @@ import concurrent.futures
 import json
 import logging
 import queue as queue_mod
+import re
 import threading
 import time
 from typing import Any
 
 from opentsdb_tpu.cluster import merge as merge_mod
+from opentsdb_tpu.cluster import replica as replica_mod
 from opentsdb_tpu.obs import trace as trace_mod
 from opentsdb_tpu.obs.trace import (TRACE_HEADER, trace_begin,
                                     trace_end)
 from opentsdb_tpu.cluster.client import (PeerClient, PeerError,
                                          parse_peer_spec)
 from opentsdb_tpu.cluster.hashring import HashRing
+from opentsdb_tpu.cluster.reshard import (HORIZON_MS, Backfiller,
+                                          ReshardState)
 from opentsdb_tpu.cluster.spool import PeerSpool, SpoolFull
 from opentsdb_tpu.core.tags import check_metric_and_tags, parse_put_value
 from opentsdb_tpu.query.model import BadRequestError
@@ -120,22 +124,52 @@ class ClusterRouter:
         self.tsdb = tsdb
         config = tsdb.config
         self.config = config
-        specs = parse_peer_spec(
-            config.get_string("tsd.cluster.peers", ""))
-        if not specs:
+        config_spec = config.get_string("tsd.cluster.peers", "")
+        if not parse_peer_spec(config_spec):
             raise ValueError(
                 "tsd.cluster.role=router needs tsd.cluster.peers")
         spool_dir = config.get_string("tsd.cluster.spool.dir", "")
         if not spool_dir and getattr(tsdb, "data_dir", ""):
             import os
             spool_dir = os.path.join(tsdb.data_dir, "cluster_spool")
+        # persisted topology: after a finalized reshard the INSTALLED
+        # ring differs from config (which still names the boot ring);
+        # a mid-reshard kill additionally restores the old ring +
+        # backfill progress so recovery resumes the cutover
+        self.state = ReshardState(spool_dir or None)
+        spec_str = self.state.peers_spec or config_spec
+        vnodes = self.state.vnodes \
+            or config.get_int("tsd.cluster.vnodes", 64)
+        specs = parse_peer_spec(spec_str)
+        self.rf = max(config.get_int("tsd.cluster.rf", 1), 1)
         self.peers: dict[str, Peer] = {}
         for name, host, port in specs:
             self.peers[name] = Peer(name, host, port, config,
                                     spool_dir or None)
-        self.ring = HashRing(
-            [name for name, _, _ in specs],
-            vnodes=config.get_int("tsd.cluster.vnodes", 64))
+        self.ring = HashRing([name for name, _, _ in specs],
+                             vnodes=vnodes)
+        self.old_ring: HashRing | None = None
+        if self.state.active:
+            old_specs = parse_peer_spec(self.state.old_spec)
+            for name, host, port in old_specs:
+                if name not in self.peers:
+                    self.peers[name] = Peer(name, host, port, config,
+                                            spool_dir or None)
+            self.old_ring = HashRing(
+                [name for name, _, _ in old_specs],
+                vnodes=self.state.old_vnodes or vnodes)
+        # anti-entropy: per-(peer, metric) divergence windows the
+        # spool cannot replay (lost/refused records) — repaired from a
+        # surviving replica when the peer returns
+        self.dirty = replica_mod.DirtyTracker(spool_dir or None)
+        self.repair_enabled = config.get_bool(
+            "tsd.cluster.replica.repair", True)
+        self.backfiller = Backfiller(self)
+        self.backfill_batch = config.get_int(
+            "tsd.cluster.reshard.backfill_batch", 4000)
+        self.reshard_interval_s = config.get_float(
+            "tsd.cluster.reshard.interval_ms", 250.0) / 1000.0
+        self._spool_dir = spool_dir or None
         workers = config.get_int("tsd.cluster.fanout_workers", 0) \
             or max(2 * len(self.peers), 4)
         self.pool = concurrent.futures.ThreadPoolExecutor(
@@ -157,6 +191,10 @@ class ClusterRouter:
         self.cache_hits = 0
         self.cache_stores = 0
         self.cache_degraded_skips = 0
+        self.read_fallbacks = 0      # tuples re-read from a fallback
+        self.repairs = 0             # completed anti-entropy passes
+        self.repair_points = 0       # points re-forwarded by repair
+        self.scatter_name_queries = 0  # suggest/search fan-outs
         # per-(peer, metric) known/unknown memo: a shard that 400'd
         # "no such name" for a metric is not re-asked about it on
         # every later query — its sub is pre-filtered out of the
@@ -186,6 +224,8 @@ class ClusterRouter:
         self._global_version = 0
         self._stop = threading.Event()
         self._replay_thread: threading.Thread | None = None
+        self._backfill_thread: threading.Thread | None = None
+        self._reshard_lock = threading.Lock()  # begin/finalize fence
         self._started = False
 
     # ------------------------------------------------------------------
@@ -193,7 +233,8 @@ class ClusterRouter:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
-        """Start the spool replay thread (idempotent)."""
+        """Start the spool replay thread, and — when a persisted
+        cutover is still open — resume its backfill (idempotent)."""
         if self._started:
             return
         self._started = True
@@ -201,12 +242,23 @@ class ClusterRouter:
                              name="cluster-replay", daemon=True)
         self._replay_thread = t
         t.start()
+        if self.state.active:
+            self._start_backfill()
+
+    def _start_backfill(self) -> None:
+        t = self._backfill_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=self._backfill_loop,
+                             name="cluster-backfill", daemon=True)
+        self._backfill_thread = t
+        t.start()
 
     def stop(self) -> None:
         self._stop.set()
-        t = self._replay_thread
-        if t is not None and t.is_alive():
-            t.join(timeout=5)
+        for t in (self._replay_thread, self._backfill_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=5)
         self.pool.shutdown(wait=False)
         for peer in self.peers.values():
             peer.spool.close()
@@ -280,6 +332,90 @@ class ClusterRouter:
                 launched = 2
                 wait_s = deadline - time.monotonic()
 
+    def fetch_guarded(self, peer: Peer, method: str, path: str,
+                      body: bytes | None = None) -> tuple[int, bytes]:
+        """One breaker-guarded exchange on an arbitrary path (suggest/
+        search scatter, backfill enumeration): same failure accounting
+        as a query leg — a refusal or transport failure raises."""
+        if not peer.breaker.allow():
+            raise PeerUnavailable(
+                f"breaker for {peer.name} is {peer.breaker.state}")
+        try:
+            self._check_faults(peer)
+            status, data = self._fetch(peer, method, path, body)
+        except OSError:
+            peer.breaker.record_failure()
+            raise
+        peer.breaker.record_success()
+        return status, data
+
+    def scan_series_pages(self, peer: Peer, metric: str,
+                          start_ms: int, end_ms: int,
+                          sel: dict | None = None,
+                          _depth: int = 0):
+        """Yield pages of raw per-series rows (aggregator ``none``,
+        ms resolution) of one metric's window on one peer — the
+        backfill/repair copy source, with the same breaker +
+        fault-site discipline as a scatter leg. A **413 scan-budget
+        refusal bisects the window**: a budgeted shard refuses a
+        whole history in one piece, and without paging the copy
+        would retry the identical over-budget query forever. A
+        generator so callers forward each slice as it arrives
+        instead of materializing the whole history (one page is
+        bounded by the shard's scan budget when one is configured).
+        Unknown metric yields nothing; any other failure raises
+        ``OSError`` (the caller retries the unit later)."""
+        obj = {
+            # explicit ms suffix: a bare sub-13-digit number parses
+            # as SECONDS (reference numeric heuristic), which would
+            # silently widen early bisect slices to contain all data
+            "start": f"{max(start_ms, 1)}ms", "end": f"{end_ms}ms",
+            "msResolution": True,
+            "queries": [{"metric": metric, "aggregator": "none"}],
+        }
+        if sel is not None:
+            obj["replicaSel"] = sel
+        status, data = self._query_peer(peer,
+                                        json.dumps(obj).encode())
+        if status == 400 and b"no such name" in data.lower():
+            return
+        # depth 48 halves any ms window down to ~1s slices — the
+        # copy scans start at epoch-begin, so ~25 levels are routine
+        if status == 413 and _depth < 48 \
+                and end_ms - max(start_ms, 1) > 1000:
+            mid = (max(start_ms, 1) + end_ms) // 2
+            yield from self.scan_series_pages(peer, metric, start_ms,
+                                              mid, sel, _depth + 1)
+            yield from self.scan_series_pages(peer, metric, mid + 1,
+                                              end_ms, sel,
+                                              _depth + 1)
+            return
+        if status != 200:
+            raise PeerUnavailable(
+                f"peer {peer.name} answered {status} to a "
+                f"{metric!r} copy scan")
+        try:
+            yield json.loads(data)
+        except ValueError as exc:
+            raise PeerUnavailable(
+                f"peer {peer.name} sent an unparseable copy-scan "
+                f"body") from exc
+
+    def scan_series_rows(self, peer: Peer, metric: str,
+                         start_ms: int, end_ms: int,
+                         sel: dict | None = None) -> list[dict]:
+        """All pages of :meth:`scan_series_pages` concatenated (small
+        windows / tests; the copy paths iterate pages)."""
+        return [row for page in self.scan_series_pages(
+                    peer, metric, start_ms, end_ms, sel)
+                for row in page]
+
+    def deliver_backfill(self, peer: Peer, dps: list[dict]) -> None:
+        """Forward one backfill batch through the normal deliver/spool
+        path: an unreachable new owner spools and the moved keyspace
+        still lands — kill-during-reshard loses nothing."""
+        self._deliver(peer, dps)
+
     # ------------------------------------------------------------------
     # per-(peer, metric) known/unknown memo (see __init__)
     # ------------------------------------------------------------------
@@ -347,12 +483,45 @@ class ClusterRouter:
     # write path
     # ------------------------------------------------------------------
 
+    def write_owners(self, metric: str, tags: dict[str, str]
+                     ) -> tuple[str, ...]:
+        """Every shard one point must reach: the current ring's
+        replica set (RF distinct owners), plus — while a reshard
+        cutover is open — the OLD ring's set (dual-write: reads stay
+        on the old ring during the window, so its owners must keep
+        seeing every accepted write; unmoved series resolve to the
+        same set and pay nothing)."""
+        owners = list(self.ring.shards_for(metric, tags, self.rf))
+        old_ring = self.old_ring
+        if old_ring is not None:
+            for n in old_ring.shards_for(metric, tags, self.rf):
+                if n not in owners:
+                    owners.append(n)
+        return tuple(owners)
+
+    @staticmethod
+    def _dp_key(dp: dict) -> tuple:
+        """Content identity of one datapoint, stable across the JSON
+        round-trip through a peer's error echo — replica deliveries
+        report per-point outcomes against parsed copies, not the
+        router's original objects."""
+        tags = dp.get("tags") or {}
+        return (dp.get("metric"), str(dp.get("timestamp")),
+                str(dp.get("value")),
+                tuple(sorted((str(k), str(v))
+                             for k, v in tags.items())))
+
     def partition_points(self, points: list[dict]
-                         ) -> tuple[dict[str, list[dict]], list[dict]]:
-        """Shard each datapoint by its series key. Returns
-        (shard -> points, local error entries for unshardable dps)."""
+                         ) -> tuple[dict[str, list[dict]],
+                                    list[dict], list[dict]]:
+        """Shard each datapoint by its series key onto EVERY replica
+        owner. Returns (shard -> points, local error entries for
+        unshardable dps, valid dps in input order) — at RF > 1 (or
+        during a reshard window) the same dp object appears in
+        several shards' batches."""
         batches: dict[str, list[dict]] = {}
         errors: list[dict] = []
+        valid: list[dict] = []
         for dp in points:
             if not isinstance(dp, dict):
                 errors.append({"datapoint": dp,
@@ -383,47 +552,87 @@ class ClusterRouter:
             except (KeyError, TypeError, ValueError) as exc:
                 errors.append({"datapoint": dp, "error": str(exc)})
                 continue
-            shard = self.ring.shard_for(metric, tags)
-            batches.setdefault(shard, []).append(dp)
-        return batches, errors
+            valid.append(dp)
+            for shard in self.write_owners(metric, tags):
+                batches.setdefault(shard, []).append(dp)
+        return batches, errors, valid
 
     def forward_writes(self, points: list[dict]
                        ) -> tuple[int, int, list[dict]]:
-        """Partition + deliver one put body. Returns
-        (success, failed, error entries). Spooled points count as
-        success — they are durably accepted and will replay.
+        """Partition + deliver one put body to every replica owner.
+        Returns (success, failed, error entries). Spooled points count
+        as success — they are durably accepted and will replay; a
+        point is acked only when EVERY owner accepted (forwarded or
+        spooled) its copy, so an ack always implies eventual presence
+        on the full replica set.
 
         At-least-once, never at-most-once: a delivery that outlives
         the ``fut.result`` cap below is reported failed even though
         the in-flight worker may still land (or spool) it — the safe
         direction, since a re-sent point dedupes last-write-wins on
         the shard, while the reverse (acking a loss) cannot be
-        repaired."""
-        batches, errors = self.partition_points(points)
-        failed = len(errors)
-        success = 0
+        repaired. The same rule covers a replica split (one owner
+        stored, another refused): reported failed, and the divergence
+        is marked dirty for anti-entropy."""
+        batches, errors, valid = self.partition_points(points)
         tctx = trace_mod.current()
+        # .get: a reshard finalize may pop a departed old owner
+        # between partitioning and here — skipping its batch IS the
+        # post-finalize write plan (the union included the new
+        # owners, which still receive their copies)
         futures = {
-            self.pool.submit(self._deliver_traced, tctx,
-                             self.peers[name], dps):
-            (name, dps) for name, dps in batches.items()}
+            self.pool.submit(self._deliver_traced, tctx, peer, dps):
+            (name, dps) for name, dps in batches.items()
+            if (peer := self.peers.get(name)) is not None}
+        # per-point outcomes merge across replica deliveries by
+        # CONTENT key: the first error entry per failed point is
+        # reported; a point missing from every delivery's error set
+        # was accepted by all its owners
+        failed_entries: dict[tuple, dict] = {}
+        unattributed = 0
         for fut, (name, dps) in futures.items():
             try:
-                ok, bad, errs = fut.result(
+                _ok, bad, errs = fut.result(
                     timeout=self.timeout_s * 4 + 5)
             except Exception as exc:  # noqa: BLE001 - per-shard
                 LOG.exception("forward to %s failed unexpectedly",
                               name)
-                ok, bad = 0, len(dps)
+                bad = len(dps)
                 errs = [{"datapoint": dp, "error": str(exc)}
                         for dp in dps]
-            success += ok
-            failed += bad
-            errors.extend(errs)
+            attributed = 0
+            refused_dps: list[dict] = []
+            for e in errs:
+                dp = e.get("datapoint")
+                if isinstance(dp, dict):
+                    failed_entries.setdefault(self._dp_key(dp), e)
+                    refused_dps.append(dp)
+                    attributed += 1
+            if refused_dps and (self.rf > 1
+                                or self.old_ring is not None):
+                # a point one replica refused may have landed on its
+                # siblings (a replica SPLIT): mark the window dirty so
+                # anti-entropy re-levels it when the peer is willing —
+                # a refusal that was identical everywhere repairs to a
+                # no-op and clears
+                self.dirty.mark(
+                    name,
+                    {dp.get("metric") for dp in refused_dps
+                     if dp.get("metric")},
+                    self._min_ts_ms(refused_dps))
+            # a peer that counted failures it did not echo (odd
+            # summary body): charge them without attribution — the
+            # over-report direction is the safe one
+            unattributed += max(int(bad) - attributed, 0)
+        failed_keys = set(failed_entries)
+        success = sum(1 for dp in valid
+                      if self._dp_key(dp) not in failed_keys)
+        success = max(success - unattributed, 0)
+        failed = len(errors) + (len(valid) - success)
+        errors.extend(failed_entries.values())
         # AFTER delivery/spool: a racing query that read the new
         # version has already seen (or will re-read) the landed data
-        self._bump_versions(
-            dp["metric"] for dps in batches.values() for dp in dps)
+        self._bump_versions(dp["metric"] for dp in valid)
         return success, failed, errors
 
     def _deliver_traced(self, tctx, peer: Peer, dps: list[dict]
@@ -519,6 +728,21 @@ class ClusterRouter:
             return doc
         return None
 
+    @staticmethod
+    def _min_ts_ms(dps: list[dict]) -> int:
+        """Earliest DATA timestamp of one batch in ms (the dirty-epoch
+        a later anti-entropy repair reads the replica from)."""
+        out = 0
+        for dp in dps:
+            try:
+                ts = int(dp["timestamp"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            ms = ts * 1000 if ts < 10 ** 11 else ts
+            if out == 0 or ms < out:
+                out = ms
+        return out
+
     def _spool_batch(self, peer: Peer, body: bytes, dps: list[dict]
                      ) -> tuple[int, int, list[dict]]:
         """Durable handoff (caller holds ``peer.lock``): the ack
@@ -526,13 +750,21 @@ class ClusterRouter:
         loudly (per-point errors) — dropping the oldest record would
         break the no-loss guarantee. The trace records the handoff
         as a ``cluster.spool.append`` span, and the trace id is
-        remembered so the eventual replay root links back to it."""
+        remembered so the eventual replay root links back to it.
+
+        Divergence bookkeeping: a handoff the spool cannot replay
+        durably (refused full, or an in-memory spool a router restart
+        would lose) marks the (peer, metric) window dirty — when the
+        peer returns, anti-entropy re-copies it from a surviving
+        replica instead of trusting records that may be gone."""
         sp = trace_begin("cluster.spool.append", peer=peer.name,
                          points=len(dps))
         try:
             peer.spool.append(body)
         except SpoolFull as exc:
             trace_end(sp, error=exc)
+            self.dirty.mark(peer.name, {dp["metric"] for dp in dps},
+                            self._min_ts_ms(dps))
             return 0, len(dps), [
                 {"datapoint": dp,
                  "error": f"shard {peer.name} unreachable and its "
@@ -541,6 +773,11 @@ class ClusterRouter:
         if tctx is not None:
             peer.spool_trace_links.append(tctx.trace_id)
         trace_end(sp)
+        if not peer.spool.durable:
+            # the ack is only as durable as this process: mark the
+            # window so a restart that loses the queue still heals
+            self.dirty.mark(peer.name, {dp["metric"] for dp in dps},
+                            self._min_ts_ms(dps))
         peer.spooled_batches += 1
         peer.spooled_points += len(dps)
         return len(dps), 0, []
@@ -556,6 +793,11 @@ class ClusterRouter:
                     self.drain_spool(peer)
                 except Exception:  # noqa: BLE001 - keep the loop alive
                     LOG.exception("spool replay for %s failed",
+                                  peer.name)
+                try:
+                    self.maybe_repair(peer)
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    LOG.exception("replica repair for %s failed",
                                   peer.name)
 
     def drain_spool(self, peer: Peer) -> int:
@@ -685,14 +927,211 @@ class ClusterRouter:
                         "point(s): %s", peer.name, bad, data[:200])
 
     # ------------------------------------------------------------------
+    # anti-entropy: repair a returned replica from a surviving one
+    # ------------------------------------------------------------------
+
+    def maybe_repair(self, peer: Peer) -> bool:
+        """Run one anti-entropy pass for a peer with dirty windows,
+        once its spool is drained (replay covers everything the spool
+        still holds — repair exists for what it lost). Gated by the
+        peer's breaker like any dispatch: on a non-closed breaker the
+        repair IS the half-open probe. Returns True when the peer has
+        no remaining debt."""
+        if not self.repair_enabled:
+            return False
+        if not self.dirty.peek(peer.name):
+            return True
+        if peer.spool.pending_records:
+            return False  # replay first; repair covers the remainder
+        if peer.breaker.state != CircuitBreaker.CLOSED:
+            if not peer.breaker.allow():
+                return False
+            probe = True
+        else:
+            probe = False
+        tracer = getattr(self.tsdb, "tracer", None)
+        tctx = tracer.start_background("cluster.replica.repair",
+                                       peer=peer.name) \
+            if tracer is not None and tracer.enabled else None
+        try:
+            with trace_mod.use(tctx):
+                done = self.repair_peer(peer)
+            if probe:
+                if done:
+                    peer.breaker.record_success()
+                else:
+                    # the remaining debt is SOURCE-side trouble (a
+                    # sibling was down or refused the scan) — the
+                    # peer under probe may be perfectly healthy, and
+                    # punishing it would quarantine it for as long as
+                    # the source stays down. Decide the probe by
+                    # touching the peer itself.
+                    try:
+                        self._check_faults(peer)
+                        self._fetch(peer, "GET", "/api/version",
+                                    None)
+                        peer.breaker.record_success()
+                    except OSError:
+                        peer.breaker.record_failure()
+            return done
+        except OSError as exc:
+            if tctx is not None:
+                tctx.set_error(exc)
+            peer.breaker.record_failure()
+            LOG.info("replica repair for %s stopped (%s)",
+                     peer.name, exc)
+            return False
+        finally:
+            if tracer is not None and tctx is not None:
+                tracer.finish(tctx)
+
+    def repair_peer(self, peer: Peer) -> bool:
+        """Re-copy every dirty (peer, metric) window from a surviving
+        replica: for each replica set containing the peer, ONE alive
+        sibling is asked for the window (``replicaSel``-filtered to
+        exactly those sets, so nothing is copied twice) and the rows
+        re-forward through the normal deliver path. Duplicates dedupe
+        last-write-wins on the shard — repair is idempotent. Returns
+        True when every dirty metric was repaired (False leaves the
+        remaining debt for the next pass)."""
+        faults = getattr(self.tsdb, "faults", None)
+        if faults is not None:
+            faults.check("cluster.replica")
+        dirty = self.dirty.peek(peer.name)
+        if not dirty:
+            return True
+        ring = self.ring
+        rf = min(self.rf, len(ring.names))
+        sets_with = [t for t in ring.replica_sets(rf)
+                     if peer.name in t]
+        if rf <= 1 or not sets_with:
+            # no second copy exists (RF=1), or the peer no longer
+            # owns anything on this ring: there is nothing to repair
+            # FROM (or for) — the debt is void
+            self.dirty.clear(peer.name)
+            return True
+        now_ms = int(time.time() * 1000)
+        all_done = True
+        for metric, since_ms in sorted(dirty.items()):
+            per_source: dict[str, list[tuple]] = {}
+            uncovered = False
+            for t in sets_with:
+                src = next(
+                    (n for n in t if n != peer.name
+                     and not self.peers[n].breaker.blocking()), None)
+                if src is None:
+                    uncovered = True  # no alive sibling: retry later
+                else:
+                    per_source.setdefault(src, []).append(t)
+            copied = 0
+            metric_ok = not uncovered
+            for src, sets in per_source.items():
+                pages = self.scan_series_pages(
+                    self.peers[src], metric,
+                    max(since_ms - 1, 1), now_ms + HORIZON_MS,
+                    sel=replica_mod.sel_doc(
+                        ring.names, ring.vnodes, rf, sets))
+                while True:
+                    # SOURCE failures (advancing the scan) only keep
+                    # the metric dirty; PEER-side delivery failures
+                    # propagate out of repair_peer — the debt stays
+                    # (the data still lives on the source, so there
+                    # is no ack to protect) and maybe_repair's
+                    # breaker accounting sees a failure the peer
+                    # actually caused
+                    try:
+                        rows = next(pages)
+                    except StopIteration:
+                        break
+                    except OSError:
+                        metric_ok = False
+                        break
+                    dps: list[dict] = []
+                    for row in rows:
+                        tags = row.get("tags") or {}
+                        for ts, val in (row.get("dps") or ()):
+                            dps.append({"metric": metric,
+                                        "timestamp": int(ts),
+                                        "value": val, "tags": tags})
+                    for i in range(0, len(dps), self.backfill_batch):
+                        copied += self._repair_deliver(
+                            peer, dps[i:i + self.backfill_batch])
+            if metric_ok:
+                self.repair_points += copied
+                self.dirty.clear(peer.name, [metric])
+            else:
+                all_done = False
+        if all_done:
+            self.repairs += 1
+            # repaired history just became readable on the peer: any
+            # cached complete answer over it is stale now
+            self._bump_global_version()
+            self.invalidate_sub_memo(peer.name)
+        return all_done
+
+    def _repair_deliver(self, peer: Peer, dps: list[dict]) -> int:
+        """One repair chunk, delivered DIRECTLY (the ``_replay_one``
+        shape): a repair pass often runs as the peer's half-open
+        probe, when ``_deliver`` would divert to the spool — which
+        would both defeat the probe (nothing touches the peer) and
+        turn repair data into spool backlog. Failure raises; the
+        dirty debt stays and the data still lives on the source
+        replica, so there is no ack to protect."""
+        self._check_faults(peer)
+        self.invalidate_sub_memo(peer.name,
+                                 {dp["metric"] for dp in dps})
+        status, data = self._fetch(
+            peer, "POST", "/api/put?summary=true&details=true",
+            json.dumps(dps).encode())
+        doc = self._put_summary_doc(data)
+        if doc is None and not 200 <= status < 300:
+            raise PeerUnavailable(
+                f"peer {peer.name} answered {status} without a put "
+                f"summary during repair")
+        if doc is not None and int(doc.get("failed", 0)):
+            raise PeerUnavailable(
+                f"peer {peer.name} rejected "
+                f"{doc.get('failed')} repair point(s)")
+        return len(dps)
+
+    # ------------------------------------------------------------------
     # read path
     # ------------------------------------------------------------------
+
+    def _read_view(self, delete: bool = False
+                   ) -> tuple[HashRing, list[str]]:
+        """The ring reads scatter over, plus the peer names involved.
+        During a reshard cutover reads stay on the OLD ring — its
+        owners hold complete history AND (via dual-write) every
+        in-window write, so answers are complete without cross-ring
+        merging, the one shape where two copies of a moved series
+        could double-sum. Deletes must purge EVERY copy, so they
+        cover the union of both rings."""
+        old_ring = self.old_ring
+        if delete:
+            names = list(self.ring.names)
+            if old_ring is not None:
+                names += [n for n in old_ring.names
+                          if n not in names]
+            return self.ring, names
+        if old_ring is not None:
+            return old_ring, list(old_ring.names)
+        return self.ring, list(self.ring.names)
 
     def execute_query(self, tsq) -> tuple[list, list[str]]:
         """Scatter one validated TSQuery, merge partials. Returns
         (results, degraded shard names). Raises ``BadRequestError``
         for non-decomposable aggregators; peer failures NEVER raise —
-        they degrade."""
+        they degrade.
+
+        At RF > 1 the scatter is a replica-set READ PLAN: every
+        distinct ordered replica set is assigned to one member (the
+        first whose breaker isn't blocking), the request carries the
+        assignment as a ``replicaSel`` series filter, and a failed
+        reader's sets re-assign to the next replica in further rounds
+        — so a single shard death yields a COMPLETE marker-less 200,
+        and the ``shardsDegraded`` marker appears only when an entire
+        replica set is down."""
         self.queries += 1
         for sub in tsq.queries:
             if sub.tsuids:
@@ -742,130 +1181,212 @@ class ClusterRouter:
         # Deletes bypass the memo: a stale unknown entry must never
         # silently skip a purge.
         use_memo = not tsq.delete
+        ring, ring_names = self._read_view(tsq.delete)
+        rf = min(self.rf, len(ring.names))
+        # the replica filter is needed at RF > 1 (each series has RF
+        # live copies) and on ANY resharded cluster (epoch > 0: moved
+        # series leave stale copies on their former owners — backfill
+        # copies, it does not purge); deletes go unfiltered because
+        # they must reach every copy, stale ones included
+        use_sel = (rf > 1 or self.state.epoch > 0) and not tsq.delete
+        # read assignment: replica tuple -> reader. sel=None means
+        # "everything you own" (single-owner epoch-0 ring, and
+        # deletes)
+        if use_sel:
+            def takes_reads(n: str) -> bool:
+                # .get: a reshard finalize may pop a departed peer
+                # between the ring snapshot and here — route around
+                # it like any unhealthy replica
+                peer = self.peers.get(n)
+                return peer is not None and \
+                    not peer.breaker.blocking()
+
+            tuples = ring.replica_sets(rf)
+            pending: dict[str, list[tuple] | None] = {}
+            for t in tuples:
+                reader = next((n for n in t if takes_reads(n)), t[0])
+                pending.setdefault(reader, []).append(t)
+        else:
+            pending = {name: None for name in ring_names}
         # trace the fan-out: one cluster.scatter stage, one
         # cluster.peer leg per shard (error-tagged when degraded)
         tctx = trace_mod.current()
         sp_scatter = trace_begin("cluster.scatter", ctx=tctx,
-                                 shards=len(self.peers))
+                                 shards=len(pending))
         scatter_id = sp_scatter.span_id if sp_scatter is not None \
             else None
-        body = json.dumps(peer_obj).encode()
-        peer_sent: dict[str, list[int]] = {}
-        per_peer: dict[str, list[dict]] = {}
-        degraded: list[str] = []
-        # expanded-sub index -> 4xx bodies, one per rejecting peer
+        # expanded-sub index -> 4xx bodies, one per rejecting peer;
+        # answered/unknown peer sets drive the all-shards-agree check
         sub_400: dict[int, list[bytes]] = {}
-        futures = {}
-        for name, peer in self.peers.items():
-            skip: dict[int, bytes] = {}
-            if use_memo:
-                for k, sj in enumerate(peer_subs):
-                    cached = self._memo_lookup(
-                        name, sj.get("metric") or "")
-                    if cached is not None:
-                        skip[k] = cached
-            sent = [k for k in range(len(peer_subs)) if k not in skip]
-            peer_sent[name] = sent
-            if skip:
-                self.sub_memo_skips += len(skip)
-                for k, cached in skip.items():
-                    sub_400.setdefault(k, []).append(cached)
-            if not sent:
-                per_peer[name] = []  # nothing this shard knows
-                continue
-            pbody = body if len(sent) == len(peer_subs) \
-                else json.dumps(dict(
-                    peer_obj,
-                    queries=[peer_subs[k] for k in sent])).encode()
-            futures[name] = self.pool.submit(
-                self._query_peer_traced, tctx, scatter_id, peer,
-                pbody)
-        def mark_degraded(peer_name: str) -> None:
-            degraded.append(peer_name)
+        sub_answered: dict[int, set] = \
+            {k: set() for k in range(len(peer_subs))}
+        sub_unknown: dict[int, set] = \
+            {k: set() for k in range(len(peer_subs))}
+        partials: list[list[dict]] = []
+        failed_peers: set[str] = set()
+        degraded_set: set[str] = set()
+
+        def mark_trouble() -> None:
             if tctx is not None:
-                # force retention the moment degradation is KNOWN —
-                # before the per-sub retries stamp their headers, so
-                # those legs (header_for reads ctx.forced at call
-                # time) carry keep=1 and their shard subtrees
-                # survive sampling. Legs already dispatched with
-                # keep=0 cannot be retro-retained; full shard-side
-                # fidelity for degraded traces needs sample=1 or a
-                # slowlog (which propagates keep=1 up front).
+                # force retention the moment trouble is KNOWN —
+                # before later legs stamp their headers, so those
+                # legs (header_for reads ctx.forced at call time)
+                # carry keep=1 and their shard subtrees survive
+                # sampling. Legs already dispatched with keep=0
+                # cannot be retro-retained.
                 tctx.forced = True
 
-        for name, fut in futures.items():
-            peer = self.peers[name]
-            sent = peer_sent[name]
-            try:
-                status, data = fut.result(
-                    timeout=self.timeout_s * 2 + 5)
-            except (OSError, concurrent.futures.TimeoutError) as exc:
-                peer.query_failures += 1
-                mark_degraded(name)
-                LOG.warning("shard %s degraded for this query (%s: "
-                            "%s)", name, type(exc).__name__, exc)
-                continue
-            if status == 200:
-                try:
-                    rows = json.loads(data)
-                except ValueError:
-                    peer.query_failures += 1
-                    mark_degraded(name)
+        while pending:
+            futures = {}
+            round_req: dict[str, tuple] = {}
+            round_failed: list[str] = []
+            for name in sorted(pending):
+                sel = pending[name]
+                peer = self.peers.get(name)
+                if peer is None:
+                    # popped by a concurrent reshard finalize: fail
+                    # the leg so its sets fall back (or degrade)
+                    round_failed.append(name)
+                    mark_trouble()
                     continue
-                if len(sent) != len(peer_subs):
-                    # trimmed request: peer-local sub indexes map
-                    # back to the expanded scatter's
-                    for r in rows:
-                        q = r.get("query")
-                        if isinstance(q, dict) and \
-                                isinstance(q.get("index"), int) \
-                                and 0 <= q["index"] < len(sent):
-                            q["index"] = sent[q["index"]]
-                per_peer[name] = rows
+                req_obj = peer_obj if sel is None else dict(
+                    peer_obj, replicaSel=replica_mod.sel_doc(
+                        ring.names, ring.vnodes, rf, sel))
+                skip: dict[int, bytes] = {}
                 if use_memo:
-                    self._memo_known(
-                        name, {peer_subs[k].get("metric")
-                               for k in sent})
-                continue
-            if status != 400:
-                # 413 (scan budget), 404/405 (not a TSD query
-                # endpoint — proxy / auth wall / misroute), 5xx
-                # passed through: NOT the no-such-name empty
-                # partial. Treating it as one would silently blank
-                # this shard's series in a cacheable "complete"
-                # answer; degrade loudly instead (marker, never
-                # cached).
-                peer.query_failures += 1
-                mark_degraded(name)
-                LOG.warning("shard %s answered %d to the scatter; "
-                            "degrading it for this query", name,
-                            status)
-                continue
-            # 400 from a HEALTHY peer: a shard that owns no series of
-            # the metric 400s with "no such name" — an empty partial,
-            # not peer damage and not a client error (other shards
-            # may own it). Kept for the all-shards-agree check below.
-            if len(sent) == 1:
-                sub_400.setdefault(sent[0], []).append(data)
-                per_peer[name] = []
-                if use_memo:
-                    self._memo_unknown(
-                        name, peer_subs[sent[0]].get("metric") or "",
-                        data)
-                continue
-            # multi-sub scatter: the request-level 400 hides WHICH
-            # sub the peer rejected — and blanks subs it DOES own
-            # series for. Re-issue each still-unmemoized expanded
-            # sub alone, keep the ones that answer, and memoize
-            # every definite outcome so the NEXT query scatters once.
-            rows, died = self._per_sub_retry(
-                peer, peer_obj,
-                [(k, peer_subs[k]) for k in sent], sub_400,
-                memoize=use_memo, tctx=tctx, parent_id=scatter_id)
-            per_peer[name] = rows
-            if died:
-                peer.query_failures += 1
-                mark_degraded(name)
+                    for k, sj in enumerate(peer_subs):
+                        cached = self._memo_lookup(
+                            name, sj.get("metric") or "")
+                        if cached is not None:
+                            skip[k] = cached
+                sent = [k for k in range(len(peer_subs))
+                        if k not in skip]
+                if skip:
+                    self.sub_memo_skips += len(skip)
+                    for k, cached in skip.items():
+                        sub_400.setdefault(k, []).append(cached)
+                        sub_unknown[k].add(name)
+                        sub_answered[k].add(name)
+                round_req[name] = (peer, sel, sent, req_obj)
+                if not sent:
+                    continue  # nothing this shard knows
+                pbody = json.dumps(dict(
+                    req_obj,
+                    queries=[peer_subs[k] for k in sent])).encode()
+                futures[name] = self.pool.submit(
+                    self._query_peer_traced, tctx, scatter_id, peer,
+                    pbody)
+            for name, fut in futures.items():
+                peer, sel, sent, req_obj = round_req[name]
+                try:
+                    status, data = fut.result(
+                        timeout=self.timeout_s * 2 + 5)
+                except (OSError,
+                        concurrent.futures.TimeoutError) as exc:
+                    peer.query_failures += 1
+                    round_failed.append(name)
+                    mark_trouble()
+                    LOG.warning("shard %s failed this scatter round "
+                                "(%s: %s)", name,
+                                type(exc).__name__, exc)
+                    continue
+                if status == 200:
+                    try:
+                        rows = json.loads(data)
+                    except ValueError:
+                        peer.query_failures += 1
+                        round_failed.append(name)
+                        mark_trouble()
+                        continue
+                    if len(sent) != len(peer_subs):
+                        # trimmed request: peer-local sub indexes map
+                        # back to the expanded scatter's
+                        for r in rows:
+                            q = r.get("query")
+                            if isinstance(q, dict) and \
+                                    isinstance(q.get("index"), int) \
+                                    and 0 <= q["index"] < len(sent):
+                                q["index"] = sent[q["index"]]
+                    partials.append(rows)
+                    for k in sent:
+                        sub_answered[k].add(name)
+                    if use_memo:
+                        self._memo_known(
+                            name, {peer_subs[k].get("metric")
+                                   for k in sent})
+                    continue
+                if status != 400:
+                    # 413 (scan budget), 404/405 (not a TSD query
+                    # endpoint — proxy / auth wall / misroute), 5xx
+                    # passed through: NOT the no-such-name empty
+                    # partial. Treating it as one would silently
+                    # blank this shard's series in a cacheable
+                    # "complete" answer; fail the leg loudly instead
+                    # (fallback, else marker — never cached).
+                    peer.query_failures += 1
+                    round_failed.append(name)
+                    mark_trouble()
+                    LOG.warning("shard %s answered %d to the "
+                                "scatter; failing it for this query",
+                                name, status)
+                    continue
+                # 400 from a HEALTHY peer: a shard that owns no
+                # series of the metric 400s with "no such name" — an
+                # empty partial, not peer damage and not a client
+                # error (other shards may own it). Kept for the
+                # all-shards-agree check below.
+                if len(sent) == 1:
+                    sub_400.setdefault(sent[0], []).append(data)
+                    sub_unknown[sent[0]].add(name)
+                    sub_answered[sent[0]].add(name)
+                    partials.append([])
+                    if use_memo:
+                        self._memo_unknown(
+                            name,
+                            peer_subs[sent[0]].get("metric") or "",
+                            data)
+                    continue
+                # multi-sub scatter: the request-level 400 hides
+                # WHICH sub the peer rejected — and blanks subs it
+                # DOES own series for. Re-ask in metric-elimination
+                # rounds (one request per rejected metric, not one
+                # per sub) and memoize every definite outcome so the
+                # NEXT query scatters once.
+                rows, died = self._per_sub_retry(
+                    peer, req_obj,
+                    [(k, peer_subs[k]) for k in sent], data,
+                    sub_400, sub_answered, sub_unknown,
+                    memoize=use_memo, tctx=tctx,
+                    parent_id=scatter_id)
+                if died:
+                    peer.query_failures += 1
+                    round_failed.append(name)
+                    mark_trouble()
+                else:
+                    partials.append(rows)
+            # re-assign a failed reader's replica sets to the next
+            # member that hasn't failed this query; a set with no
+            # member left is DOWN — the only case that degrades
+            next_pending: dict[str, list] = {}
+            for name in round_failed:
+                failed_peers.add(name)
+            for name in round_failed:
+                sel = pending[name]
+                if sel is None:
+                    degraded_set.add(name)  # no replica to fall to
+                    continue
+                for t in sel:
+                    cand = next((n for n in t
+                                 if n not in failed_peers), None)
+                    if cand is None:
+                        degraded_set.update(t)
+                    else:
+                        next_pending.setdefault(cand, []).append(t)
+            if next_pending:
+                self.read_fallbacks += sum(
+                    len(v) for v in next_pending.values())
+            pending = next_pending
+        degraded = sorted(degraded_set)
         if tsq.delete:
             # the shards already purged whatever rows they own during
             # the scatter (and per-sub retries): any cached entry
@@ -879,18 +1400,24 @@ class ClusterRouter:
             self._bump_versions(metrics)
         if sp_scatter is not None:
             if degraded:
-                sp_scatter.tag(degraded=",".join(sorted(degraded)))
+                sp_scatter.tag(degraded=",".join(degraded))
             trace_end(sp_scatter)
-        for idx, errs in sorted(sub_400.items()):
-            if len(errs) == len(self.peers):
-                # every shard rejected this sub: surface the real
-                # client error (single-node parity: an unknown metric
-                # in ANY sub fails the whole query)
-                try:
-                    msg = json.loads(errs[0])["error"]["message"]
-                except Exception:  # noqa: BLE001
-                    msg = errs[0].decode("utf-8", "replace")[:200]
-                raise BadRequestError(msg)
+        if not degraded_set:
+            for idx in sorted(sub_unknown):
+                unknown = sub_unknown[idx]
+                if unknown and unknown == sub_answered[idx]:
+                    # every peer that definitively answered this sub
+                    # rejected it, and every replica set was covered
+                    # (no degradation): surface the real client error
+                    # (single-node parity: an unknown metric in ANY
+                    # sub fails the whole query)
+                    errs = sub_400.get(idx) or [b""]
+                    try:
+                        msg = json.loads(
+                            errs[0])["error"]["message"]
+                    except Exception:  # noqa: BLE001
+                        msg = errs[0].decode("utf-8", "replace")[:200]
+                    raise BadRequestError(msg)
         if degraded:
             self.degraded_queries += 1
             if tctx is not None:
@@ -908,48 +1435,134 @@ class ClusterRouter:
             # completes the purge.
             raise DegradedError(
                 "delete partially applied: shard(s) "
-                f"{', '.join(sorted(degraded))} unreachable — "
+                f"{', '.join(degraded)} unreachable — "
                 "retry to complete the purge")
-        ordered = [per_peer[n] for n in sorted(per_peer)]
         results: list = []
         with trace_mod.trace_span("cluster.merge", ctx=tctx,
-                                  shards=len(ordered)):
+                                  shards=len(partials)):
             for sub, plan, (p_idx, s_idx) in zip(tsq.queries, plans,
                                                  slots):
                 primary = [self._sub_results(r, p_idx)
-                           for r in ordered]
+                           for r in partials]
                 secondary = ([self._sub_results(r, s_idx)
-                              for r in ordered]
+                              for r in partials]
                              if s_idx is not None else None)
                 gb_keys = merge_mod.gb_tag_keys(sub)
                 results.extend(merge_mod.merge_sub(
                     sub, gb_keys, plan, primary, secondary))
             results = self._apply_pixels(tsq, results)
-        return results, sorted(degraded)
+        return results, degraded
 
-    def _per_sub_retry(self, peer: Peer, peer_obj: dict,
+    _NO_SUCH_NAME_RE = re.compile(
+        r"No such name for '[^']+': '([^']*)'")
+
+    @classmethod
+    def _unknown_metric_from_400(cls, data: bytes) -> str | None:
+        """The metric a peer's no-such-name 400 body rejects, or None
+        when the body is some other 400 shape."""
+        try:
+            msg = json.loads(data)["error"]["message"]
+        except Exception:  # noqa: BLE001 - defensive: odd peer body
+            return None
+        m = cls._NO_SUCH_NAME_RE.search(str(msg))
+        return m.group(1) if m else None
+
+    def _per_sub_retry(self, peer: Peer, req_obj: dict,
                        indexed_subs: list[tuple[int, dict]],
+                       first_400: bytes,
                        sub_400: dict[int, list[bytes]],
+                       sub_answered: dict[int, set],
+                       sub_unknown: dict[int, set],
                        memoize: bool = True, tctx=None,
                        parent_id=None) -> tuple[list[dict], bool]:
-        """Re-scatter each expanded sub alone to a peer that 400'd
-        the combined request. ``indexed_subs`` carries each sub with
-        its expanded-scatter index (memo pre-filtering may have
-        trimmed the set). Returns (result rows with their sub index
-        restored, peer-died flag). Per-sub 4xx bodies land in
-        ``sub_400`` for the all-shards-agree check, and every
-        definite outcome (200 / no-such-name 400) is memoized so the
-        next query's scatter pre-filters instead of re-asking.
+        """Re-ask a peer that 400'd the combined request in
+        METRIC-ELIMINATION rounds: a no-such-name body names the
+        rejected metric, so each 400 — starting with the scatter's
+        own (``first_400``) — drops that metric's subs (recording
+        their rejection) and re-issues the remainder as ONE request.
+        The amplification is one round trip per unknown metric, not
+        one per expanded sub (a 12-sub dashboard with one cold
+        metric used to pay 12 re-asks). A 400 the body cannot
+        attribute falls back to the one-request-per-sub sweep, so no
+        peer answer shape loses correctness.
 
-        A peer that dies partway contributes NOTHING — not the rows
-        it already answered: an avg expands to sum+count twins, and
-        merging a shard's sum partial without its count twin would
-        make every merged value WRONG (inflated), not merely
-        incomplete. Missing beats wrong; the degraded marker tells
-        the truth either way."""
+        Returns (result rows with their sub index restored,
+        peer-died flag). A peer that dies partway contributes
+        NOTHING — not the rows it already answered: an avg expands to
+        sum+count twins, and merging a shard's sum partial without
+        its count twin would make every merged value WRONG
+        (inflated), not merely incomplete."""
+        remaining = list(indexed_subs)
+        data = first_400
+        for _round in range(len(indexed_subs) + 1):
+            metric = self._unknown_metric_from_400(data)
+            hit = [(k, sj) for k, sj in remaining
+                   if (sj.get("metric") or "") == metric] \
+                if metric else []
+            if not hit:
+                # unattributable 400 (not the engine's no-such-name
+                # shape, or naming a metric we didn't send): the
+                # conservative one-request-per-sub sweep still
+                # resolves every sub individually
+                return self._per_sub_retry_singles(
+                    peer, req_obj, remaining, sub_400, sub_answered,
+                    sub_unknown, memoize=memoize, tctx=tctx,
+                    parent_id=parent_id)
+            for k, sj in hit:
+                sub_400.setdefault(k, []).append(data)
+                sub_unknown[k].add(peer.name)
+                sub_answered[k].add(peer.name)
+                if memoize:
+                    self._memo_unknown(peer.name, metric or "", data)
+            remaining = [(k, sj) for k, sj in remaining
+                         if (sj.get("metric") or "") != metric]
+            if not remaining:
+                return [], False
+            body = json.dumps(dict(
+                req_obj,
+                queries=[sj for _k, sj in remaining])).encode()
+            try:
+                status, data = self._query_peer_traced(
+                    tctx, parent_id, peer, body)
+            except OSError:
+                return [], True
+            if status == 200:
+                try:
+                    part = json.loads(data)
+                except ValueError:
+                    return [], True
+                for r in part:
+                    q = r.get("query")
+                    if isinstance(q, dict) and \
+                            isinstance(q.get("index"), int) \
+                            and 0 <= q["index"] < len(remaining):
+                        q["index"] = remaining[q["index"]][0]
+                for k, sj in remaining:
+                    sub_answered[k].add(peer.name)
+                if memoize:
+                    self._memo_known(
+                        peer.name,
+                        {sj.get("metric") for _k, sj in remaining})
+                return part, False
+            if status != 400:
+                # same rule as the combined scatter: a non-400
+                # rejection is peer damage, not an empty partial
+                return [], True
+        return [], True  # cannot converge: treat as peer damage
+
+    def _per_sub_retry_singles(self, peer: Peer, req_obj: dict,
+                               indexed_subs: list[tuple[int, dict]],
+                               sub_400: dict[int, list[bytes]],
+                               sub_answered: dict[int, set],
+                               sub_unknown: dict[int, set],
+                               memoize: bool = True, tctx=None,
+                               parent_id=None
+                               ) -> tuple[list[dict], bool]:
+        """One request per expanded sub: the fallback when a 400 body
+        cannot name the rejected metric (see ``_per_sub_retry``)."""
         futs = [(k, sj, self.pool.submit(
                     self._query_peer_traced, tctx, parent_id, peer,
-                    json.dumps(dict(peer_obj, queries=[sj])).encode()))
+                    json.dumps(dict(req_obj, queries=[sj])).encode()))
                 for k, sj in indexed_subs]
         rows: list[dict] = []
         died = False
@@ -964,6 +1577,8 @@ class ClusterRouter:
                 continue
             if status == 400:
                 sub_400.setdefault(k, []).append(data)
+                sub_unknown[k].add(peer.name)
+                sub_answered[k].add(peer.name)
                 if memoize:
                     self._memo_unknown(peer.name,
                                        sj.get("metric") or "", data)
@@ -978,6 +1593,7 @@ class ClusterRouter:
             except ValueError:
                 died = True
                 continue
+            sub_answered[k].add(peer.name)
             if memoize:
                 self._memo_known(peer.name, {sj.get("metric")})
             for r in part:
@@ -1103,9 +1719,16 @@ class ClusterRouter:
         invisible — relative-window entries stay bounded by the same
         TTL rule as single-node serving; absolute-window dashboards
         behind a multi-router deployment should disable the router
-        cache (``tsd.query.cache.enable=false``)."""
+        cache (``tsd.query.cache.enable=false``).
+
+        Every version is EPOCH-QUALIFIED (the persisted ring-change
+        epoch leads the tuple): a ring install atomically mismatches
+        every cached entry, so no router — including one restarting
+        across a reshard, the epoch survives in ``reshard.json`` —
+        can ever serve a pre-cutover answer as current."""
+        epoch = self.state.epoch
         with self._version_lock:
-            whole = (self._global_version,
+            whole = (epoch, self._global_version,
                      sum(self._metric_versions.values()))
             if tsq is None:
                 return whole
@@ -1114,7 +1737,7 @@ class ClusterRouter:
                 if not sub.metric:
                     return whole
                 metrics.add(sub.metric)
-            return (self._global_version,) + tuple(
+            return (epoch, self._global_version) + tuple(
                 self._metric_versions.get(m, 0)
                 for m in sorted(metrics))
 
@@ -1157,6 +1780,266 @@ class ClusterRouter:
             cache.store(key, version, results)
             self.cache_stores += 1
         return results, degraded
+
+    # ------------------------------------------------------------------
+    # online resharding (ring-change epochs)
+    # ------------------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self.state.epoch
+
+    @property
+    def resharding(self) -> bool:
+        return self.old_ring is not None
+
+    def begin_reshard(self, new_spec: str, vnodes: int = 0) -> dict:
+        """Install a new ring at a fenced epoch and open the cutover
+        window (``POST /api/cluster/reshard``): joining shards get
+        peers + spools, the epoch/rings persist for kill-during-
+        reshard recovery, every write starts dual-delivering to
+        old∪new owners, reads stay on the old ring, and the backfill
+        starts streaming moved keyspace. Raises ``BadRequestError``
+        on a bad spec or while a cutover is already open."""
+        specs = parse_peer_spec(new_spec)
+        if not specs:
+            raise BadRequestError(
+                "reshard needs a non-empty peers spec")
+        with self._reshard_lock:
+            if self.old_ring is not None:
+                raise BadRequestError(
+                    "a reshard is already in progress (epoch "
+                    f"{self.state.epoch}); wait for it to finalize")
+            for name, host, port in specs:
+                cur = self.peers.get(name)
+                if cur is not None and (cur.client.host != host or
+                                        cur.client.port != port):
+                    raise BadRequestError(
+                        f"shard {name!r} changes address in the new "
+                        f"ring ({cur.client.address} -> {host}:"
+                        f"{port}); rename it instead")
+            old_spec = ",".join(
+                f"{n}={self.peers[n].client.host}:"
+                f"{self.peers[n].client.port}"
+                for n in self.ring.names)
+            new_vnodes = int(vnodes) or self.ring.vnodes
+            for name, host, port in specs:
+                if name not in self.peers:
+                    self.peers[name] = Peer(name, host, port,
+                                            self.config,
+                                            self._spool_dir)
+            # order matters for racing writers (no lock on the write
+            # path): old_ring fills first, so the worst interleaving
+            # writes to the OLD owners only — which the backfill scan
+            # (running strictly later) still moves
+            prev = self.ring
+            self.old_ring = prev
+            self.ring = HashRing([n for n, _, _ in specs],
+                                 vnodes=new_vnodes)
+            epoch = self.state.begin(new_spec, new_vnodes, old_spec,
+                                     prev.vnodes)
+            self.backfiller.reset()
+            # the epoch leads every cache version: installing it
+            # atomically mismatches every cached entry
+            self._bump_global_version()
+        LOG.info("reshard installed at epoch %d: %s -> %s", epoch,
+                 old_spec, new_spec)
+        if self._started:
+            self._start_backfill()
+        return self.reshard_info()
+
+    def backfill_step(self) -> dict[str, Any]:
+        """Copy one backfill unit and finalize when the copy is
+        complete (the background loop drives this; tests/ops may call
+        it directly for deterministic cutovers)."""
+        if self.old_ring is None:
+            return {"phase": "idle"}
+        info = self.backfiller.step()
+        if info.get("phase") == "done":
+            self.finalize_reshard()
+        return info
+
+    def finalize_reshard(self) -> None:
+        """Close the cutover window: the new ring is the only ring.
+        Shards that left are dropped — dual-write already placed
+        everything they were owed on the new owners, so their
+        remaining spool backlog (if any) is void."""
+        with self._reshard_lock:
+            old_ring = self.old_ring
+            if old_ring is None:
+                return
+            self.old_ring = None
+            removed = [n for n in old_ring.names
+                       if n not in self.ring.names]
+            self.state.finish()
+            for n in removed:
+                peer = self.peers.pop(n, None)
+                if peer is not None:
+                    pending = peer.spool.pending_records
+                    if pending:
+                        LOG.warning(
+                            "dropping departed shard %s with %d "
+                            "spooled record(s): dual-write already "
+                            "delivered them to the new owners", n,
+                            pending)
+                    peer.spool.close()
+                self.dirty.drop_peer(n)
+                self.invalidate_sub_memo(n)
+            self._bump_global_version()
+        LOG.info("reshard finalized at epoch %d; ring: %s",
+                 self.state.epoch, ",".join(self.ring.names))
+
+    def _backfill_loop(self) -> None:
+        tracer = getattr(self.tsdb, "tracer", None)
+        while not self._stop.wait(self.reshard_interval_s):
+            if self.old_ring is None:
+                return
+            tctx = tracer.start_background(
+                "cluster.reshard.backfill") \
+                if tracer is not None and tracer.enabled else None
+            info: dict[str, Any] = {}
+            try:
+                with trace_mod.use(tctx):
+                    info = self.backfill_step()
+                if tctx is not None:
+                    tctx.tag(phase=str(info.get("phase", "")),
+                             metric=str(info.get("metric", "")))
+                    if info.get("phase") == "blocked":
+                        # an idle/blocked poll is not worth a
+                        # retained trace
+                        tctx.sampled = False
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                LOG.exception("backfill step failed")
+            finally:
+                if tracer is not None and tctx is not None:
+                    tracer.finish(tctx)
+            if info.get("phase") in ("done", "idle"):
+                return
+
+    def reshard_info(self) -> dict[str, Any]:
+        out = self.state.describe()
+        out["rf"] = self.rf
+        out["ring"] = {"peers": list(self.ring.names),
+                       "vnodes": self.ring.vnodes}
+        if self.old_ring is not None:
+            out["old_ring"] = {"peers": list(self.old_ring.names),
+                               "vnodes": self.old_ring.vnodes}
+            out["backfill"] = self.backfiller.health_info()
+        return out
+
+    # ------------------------------------------------------------------
+    # suggest/search scatter (the router owns no names of its own)
+    # ------------------------------------------------------------------
+
+    def _name_scatter_degraded(self, ring: HashRing,
+                               failed: set[str]) -> list[str]:
+        """A failed peer degrades a name scatter only when NO member
+        of some replica set survived: every name hangs off >= 1
+        series, and every series has a live replica otherwise."""
+        if not failed:
+            return []
+        rf = min(self.rf, len(ring.names))
+        if rf <= 1:
+            return sorted(failed)
+        degraded: set[str] = set()
+        for t in ring.replica_sets(rf):
+            if all(n in failed for n in t):
+                degraded.update(t)
+        return sorted(degraded)
+
+    def scatter_suggest(self, stype: str, q: str, max_results: int
+                        ) -> tuple[list[str], list[str]]:
+        """Union one suggest over every read-ring shard (names live
+        wherever their series landed, so the union IS the cluster's
+        answer). Returns (sorted names capped at ``max_results``,
+        degraded shard names — per the replica-coverage rule)."""
+        self.scatter_name_queries += 1
+        import urllib.parse
+        ring, names = self._read_view()
+        path = ("/api/suggest?type=" + urllib.parse.quote(stype)
+                + "&q=" + urllib.parse.quote(q or "")
+                + "&max=" + str(int(max_results)))
+        futs = {name: self.pool.submit(
+                    self.fetch_guarded, peer, "GET", path)
+                for name in names
+                if (peer := self.peers.get(name)) is not None}
+        out: set[str] = set()
+        failed: set[str] = {n for n in names if n not in futs}
+        for name, fut in futs.items():
+            try:
+                status, data = fut.result(
+                    timeout=self.timeout_s * 2 + 5)
+                if status != 200:
+                    raise PeerError(
+                        f"suggest answered {status}")
+                doc = json.loads(data)
+                if not isinstance(doc, list):
+                    raise PeerError("suggest body is not a list")
+            except (OSError, ValueError,
+                    concurrent.futures.TimeoutError):
+                peer = self.peers.get(name)
+                if peer is not None:
+                    peer.query_failures += 1
+                failed.add(name)
+                continue
+            out.update(str(x) for x in doc)
+        return (sorted(out)[:max(int(max_results), 0)],
+                self._name_scatter_degraded(ring, failed))
+
+    def scatter_lookup(self, metric: str, tags: list[tuple],
+                       limit: int, use_meta: bool
+                       ) -> tuple[dict[str, Any], list[str]]:
+        """Scatter ``/api/search/lookup`` and union the per-shard
+        results, deduplicated on (metric, tags) — at RF > 1 every
+        series answers from each replica, and per-shard TSUIDs are
+        not cluster identities. ``totalResults`` counts the deduped
+        union (shards cap their own lists at ``limit``, so it is a
+        floor, exactly as the reference's scanner-capped counts
+        are)."""
+        self.scatter_name_queries += 1
+        ring, names = self._read_view()
+        body = json.dumps({
+            "metric": metric or "",
+            "tags": [{"key": k, "value": v} for k, v in tags],
+            "limit": int(limit), "useMeta": bool(use_meta),
+        }).encode()
+        futs = {name: self.pool.submit(
+                    self.fetch_guarded, peer, "POST",
+                    "/api/search/lookup", body)
+                for name in names
+                if (peer := self.peers.get(name)) is not None}
+        rows: dict[tuple, dict] = {}
+        failed: set[str] = {n for n in names if n not in futs}
+        for name, fut in futs.items():
+            try:
+                status, data = fut.result(
+                    timeout=self.timeout_s * 2 + 5)
+                if status != 200:
+                    raise PeerError(f"lookup answered {status}")
+                doc = json.loads(data)
+                results = doc.get("results") \
+                    if isinstance(doc, dict) else None
+                if not isinstance(results, list):
+                    raise PeerError("lookup body has no results")
+            except (OSError, ValueError,
+                    concurrent.futures.TimeoutError):
+                peer = self.peers.get(name)
+                if peer is not None:
+                    peer.query_failures += 1
+                failed.add(name)
+                continue
+            for r in results:
+                if not isinstance(r, dict):
+                    continue
+                tags_doc = r.get("tags") or {}
+                key = (r.get("metric"),
+                       tuple(sorted(tags_doc.items())))
+                rows.setdefault(key, r)
+        merged = [rows[k] for k in sorted(rows)][:max(int(limit), 0)]
+        doc = {"type": "LOOKUP", "metric": metric or "*",
+               "limit": int(limit), "time": 0, "results": merged,
+               "totalResults": len(rows)}
+        return doc, self._name_scatter_degraded(ring, failed)
 
     # ------------------------------------------------------------------
     # observability
@@ -1215,6 +2098,13 @@ class ClusterRouter:
             "role": "router",
             "shards": len(self.peers),
             "vnodes": self.ring.vnodes,
+            "rf": self.rf,
+            "epoch": self.state.epoch,
+            "reshard": self.reshard_info(),
+            "replica_dirty": self.dirty.health_info(),
+            "read_fallbacks": self.read_fallbacks,
+            "repairs": self.repairs,
+            "repair_points": self.repair_points,
             "queries": self.queries,
             "degraded_queries": self.degraded_queries,
             "cache_hits": self.cache_hits,
@@ -1233,6 +2123,21 @@ class ClusterRouter:
         collector.record("cluster.queries", self.queries)
         collector.record("cluster.queries_degraded",
                          self.degraded_queries)
+        collector.record("cluster.epoch", self.state.epoch)
+        collector.record("cluster.rf", self.rf)
+        collector.record("cluster.read_fallbacks",
+                         self.read_fallbacks)
+        collector.record("cluster.replica.repairs", self.repairs)
+        collector.record("cluster.replica.repair_points",
+                         self.repair_points)
+        collector.record("cluster.replica.dirty_entries",
+                         self.dirty.total_entries)
+        collector.record("cluster.name_scatters",
+                         self.scatter_name_queries)
+        collector.record("cluster.reshard.backfilled_points",
+                         self.backfiller.backfilled_points)
+        collector.record("cluster.reshard.backfilled_series",
+                         self.backfiller.backfilled_series)
         collector.record("cluster.cache_degraded_skips",
                          self.cache_degraded_skips)
         collector.record("cluster.sub_memo.skips",

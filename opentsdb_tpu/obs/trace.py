@@ -72,6 +72,8 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "lifecycle.sweep",       # lifecycle/manager.py sweep
     "streaming.drain",       # streaming/workers.py off-path fold drain
     "cluster.spool.replay",  # cluster/router.py spool catch-up drain
+    "cluster.replica.repair",  # cluster/router.py anti-entropy pass
+    "cluster.reshard.backfill",  # cluster/reshard.py moved-key copy
     "telemetry.pump",        # obs/telemetry.py self-stats ingest
     # ingest stages
     "ingest.decode",         # body parse + validate + series grouping

@@ -673,6 +673,23 @@ class QueryEngine:
         if len(sids) == 0:
             trace_end(_h_plan)
             return []
+        if tsq.replica_sel is not None and sub.metric:
+            # replicated-router scatter: keep only series whose
+            # replica set this request was assigned (cluster/replica),
+            # so each series is read by exactly one replica
+            # cluster-wide and merged partials never double-count
+            from opentsdb_tpu.cluster import replica as replica_mod
+            keep = np.asarray(replica_mod.series_mask(
+                tsq.replica_sel, sub.metric,
+                (tag_mat.tags_of(i) for i in range(len(sids))),
+                _UidNameCache(uids.tag_names),
+                _UidNameCache(uids.tag_values)), dtype=bool)
+            if not keep.all():
+                sids = sids[keep]
+                tag_mat = tag_mat.select(keep)
+            if len(sids) == 0:
+                trace_end(_h_plan)
+                return []
         if stats:
             stats.add_stat(QueryStat.STRING_TO_UID_TIME,
                            (time.monotonic() - t0) * 1e3)

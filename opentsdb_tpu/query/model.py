@@ -212,6 +212,11 @@ class TSQuery:
     # top-level ``pixels``/``pixelFn`` JSON keys); per-sub options win
     pixels: int = 0
     pixel_fn: str = ""
+    # replicated-router scatter assignment (``replicaSel`` JSON key,
+    # normalized by cluster/replica.parse_sel): the engine keeps only
+    # series whose replica set this request was assigned, so RF > 1
+    # reads never double-count. None on every client-facing query.
+    replica_sel: dict | None = None
     # populated during validation
     start_ms: int = 0
     end_ms: int = 0
@@ -279,7 +284,13 @@ class TSQuery:
                 "queries must be an array of sub-query objects")
         queries = [TSSubQuery.from_json(q, i)
                    for i, q in enumerate(raw_queries)]
+        replica_sel = None
+        if obj.get("replicaSel") is not None:
+            # local import: cluster/replica imports this module
+            from opentsdb_tpu.cluster.replica import parse_sel
+            replica_sel = parse_sel(obj["replicaSel"])
         return cls(
+            replica_sel=replica_sel,
             start=str(obj.get("start", "")),
             end=(str(obj["end"]) if obj.get("end") not in (None, "")
                  else None),
@@ -310,6 +321,13 @@ class TSQuery:
             "showTSUIDs": self.show_tsuids,
             **({"pixels": self.pixels} if self.pixels else {}),
             **({"pixelFn": self.pixel_fn} if self.pixel_fn else {}),
+            **({"replicaSel": {
+                "peers": list(self.replica_sel["peers"]),
+                "vnodes": self.replica_sel["vnodes"],
+                "rf": self.replica_sel["rf"],
+                "sets": [list(t)
+                         for t in self.replica_sel["sets"]]}}
+               if self.replica_sel else {}),
         }
 
 

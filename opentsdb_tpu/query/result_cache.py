@@ -98,10 +98,15 @@ def cache_plan(tsq, sub, config) -> tuple[tuple, float] | None:
     # intersection happens before assembly), so it is part of the key:
     # cached and fresh answers for the same budget agree, and a
     # full-resolution entry can never serve a pixel-budgeted request
+    from opentsdb_tpu.cluster.replica import sel_cache_key
     from opentsdb_tpu.query.model import effective_pixels
+    # the replica assignment shapes the result (which series this
+    # request reads): two scatters over different assignments of the
+    # same query must never share a shard-side entry
     key = (window, tsq.timezone, tsq.use_calendar, tsq.ms_resolution,
            tsq.show_tsuids, tsq.no_annotations, tsq.global_annotations,
-           sub.identity_key(), effective_pixels(tsq, sub))
+           sub.identity_key(), effective_pixels(tsq, sub),
+           sel_cache_key(tsq.replica_sel))
     return key, ttl_ms
 
 

@@ -182,6 +182,7 @@ class HttpRpcRouter:
             self._routes["histogram"] = self._handle_histogram
         self._routes.update({
             "aggregators": self._handle_aggregators,
+            "cluster": self._handle_cluster,
             "config": self._handle_config,
             "dropcaches": self._handle_dropcaches,
             "health": self._handle_health,
@@ -366,15 +367,15 @@ class HttpRpcRouter:
         if endpoint in self.plugin_routes:
             return self.plugin_routes[endpoint](request, rest)
         if self.tsdb.cluster is not None and endpoint in (
-                "suggest", "search", "uid", "annotation",
-                "annotations", "tree", "rollup", "histogram"):
+                "uid", "annotation", "annotations", "tree", "rollup",
+                "histogram"):
             # the router owns no data: these endpoints would silently
-            # serve from (or write into) its EMPTY local store —
-            # suggest would answer [] for metrics the shards hold,
-            # an annotation put would be acked somewhere no scattered
+            # serve from (or write into) its EMPTY local store — an
+            # annotation put would be acked somewhere no scattered
             # read ever merges. Refuse loudly until they learn to
-            # scatter (ROADMAP follow-up); /api/put forwards and
-            # /api/query merges shards.
+            # scatter (ROADMAP follow-up); /api/put forwards,
+            # /api/query merges shards, and /api/suggest +
+            # /api/search/lookup scatter-union.
             raise HttpError(
                 400,
                 f"/api/{endpoint} is not supported in router mode",
@@ -917,7 +918,11 @@ class HttpRpcRouter:
                             request.serializer.format_last_points(points))
 
     def _handle_suggest(self, request: HttpRequest, rest) -> HttpResponse:
-        """(ref: SuggestRpc.java:30)"""
+        """(ref: SuggestRpc.java:30). On a cluster router the suggest
+        scatters to every read-ring shard and the union answers
+        (names live wherever their series landed); degraded shards
+        ride the ``X-OpenTSDB-Shards-Degraded`` header — the body
+        shape (a bare name array) has no room for a marker."""
         if request.method == "POST":
             obj = request.json_object(default={})
             stype = obj.get("type", "")
@@ -927,20 +932,41 @@ class HttpRpcRouter:
             stype = request.param("type", "")
             q = request.param("q", "") or ""
             max_results = int(request.param("max", "25"))
+        if stype not in ("metrics", "tagk", "tagv"):
+            raise BadRequestError(f"Invalid 'type' parameter: {stype}")
+        cluster = self.tsdb.cluster
+        if cluster is not None:
+            names, degraded = cluster.scatter_suggest(stype, q,
+                                                      max_results)
+            resp = HttpResponse(
+                200, request.serializer.format_suggest(names))
+            if degraded:
+                resp.headers["X-OpenTSDB-Shards-Degraded"] = \
+                    ",".join(degraded)
+            return resp
         if stype == "metrics":
             names = self.tsdb.suggest_metrics(q, max_results)
         elif stype == "tagk":
             names = self.tsdb.suggest_tag_names(q, max_results)
-        elif stype == "tagv":
-            names = self.tsdb.suggest_tag_values(q, max_results)
         else:
-            raise BadRequestError(f"Invalid 'type' parameter: {stype}")
+            names = self.tsdb.suggest_tag_values(q, max_results)
         return HttpResponse(200, request.serializer.format_suggest(names))
 
     def _handle_search(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: SearchRpc.java; /api/search/lookup via
-        TimeSeriesLookup.java:83)"""
+        TimeSeriesLookup.java:83). On a cluster router ``lookup``
+        scatters to every read-ring shard; the union merges deduped
+        on (metric, tags) — per-shard TSUIDs are not cluster
+        identities — and degraded shards ride the header marker.
+        Plugin search stays refused in router mode (the router has no
+        index of its own)."""
         sub = rest[0] if rest else ""
+        if self.tsdb.cluster is not None and sub != "lookup":
+            raise HttpError(
+                400,
+                f"/api/search/{sub} is not supported in router mode",
+                "point this request at a shard TSD, or use "
+                "/api/search/lookup")
         from opentsdb_tpu.search.lookup import time_series_lookup
         if sub == "lookup":
             if request.method == "POST":
@@ -965,6 +991,16 @@ class HttpRpcRouter:
                 tags = list(tag_map.items())
                 limit = int(request.param("limit", "25"))
                 use_meta = request.flag("use_meta")
+            cluster = self.tsdb.cluster
+            if cluster is not None:
+                results, degraded = cluster.scatter_lookup(
+                    metric, tags, limit, use_meta)
+                resp = HttpResponse(
+                    200, request.serializer.format_search(results))
+                if degraded:
+                    resp.headers["X-OpenTSDB-Shards-Degraded"] = \
+                        ",".join(degraded)
+                return resp
             results = time_series_lookup(self.tsdb, metric, tags, limit,
                                          use_meta)
             return HttpResponse(200, request.serializer.format_search(results))
@@ -1407,6 +1443,49 @@ class HttpRpcRouter:
         if incomplete:
             doc["stitchIncomplete"] = incomplete
         return HttpResponse(200, json.dumps(doc).encode())
+
+    def _handle_cluster(self, request: HttpRequest, rest
+                        ) -> HttpResponse:
+        """Cluster admin surface (router role only):
+
+        - ``GET /api/cluster`` — ring/replication/reshard status
+          (epoch, rf, peers, backfill progress, repair debt);
+        - ``POST /api/cluster/reshard`` — install a new ring at a
+          fenced epoch (body: ``{"peers": "[name=]host:port,...",
+          "vnodes": 64}``). The cutover window dual-writes old+new
+          owners, keeps reads on the old ring, and backfills moved
+          keyspace in the background; the epoch finalizes itself when
+          the copy completes. 400 while another reshard is open.
+        - ``GET /api/cluster/reshard`` — the same status document
+          (operators poll it to watch the window close)."""
+        cluster = self.tsdb.cluster
+        if cluster is None:
+            raise HttpError(400,
+                            "/api/cluster requires tsd.cluster.role "
+                            "= router",
+                            "this TSD is not a cluster router")
+        sub = rest[0] if rest else ""
+        if sub == "reshard":
+            if request.method == "POST":
+                obj = request.json_object(default={})
+                peers = obj.get("peers")
+                if not isinstance(peers, str) or not peers.strip():
+                    raise BadRequestError(
+                        "reshard body needs a peers spec string")
+                info = cluster.begin_reshard(
+                    peers, as_int(obj.get("vnodes"), "vnodes", 0))
+                return HttpResponse(200, json.dumps(info).encode())
+            if request.method == "GET":
+                return HttpResponse(200, json.dumps(
+                    cluster.reshard_info()).encode())
+            raise HttpError(405, "Method not allowed")
+        if rest:
+            raise HttpError(404, f"Endpoint not found: "
+                            f"/api/cluster/{sub}")
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed")
+        return HttpResponse(200, json.dumps(
+            cluster.health_info()).encode())
 
     def _handle_lifecycle(self, request: HttpRequest, rest
                           ) -> HttpResponse:

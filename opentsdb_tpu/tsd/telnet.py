@@ -131,6 +131,8 @@ class TelnetRouter:
         return self._put_lines_run(lines)
 
     def _put_lines_run(self, lines: list[str]) -> list[str]:
+        if self.tsdb.cluster is not None:
+            return self._put_lines_cluster(lines)
         failed: set[int] = set()
         bodies = []
         for i, ln in enumerate(lines):
@@ -171,6 +173,38 @@ class TelnetRouter:
                 out.append(r)
         return out
 
+    def _put_lines_cluster(self, lines: list[str]) -> list[str]:
+        """Router role: one parse pass builds the burst's datapoint
+        batch, which forwards through the consistent-hash partition
+        (one series-grouped body per shard — the peer's ``/api/put``
+        commits it as ONE WAL write + fsync) and spools durably for
+        unreachable replicas exactly like HTTP puts. Rejected lines
+        answer through the same scalar parse, so their error text is
+        byte-identical to a standalone TSD's."""
+        out: list[str] = []
+        dps: list[dict] = []
+        for line in lines:
+            words = line.split()
+            if len(words) < 5:
+                out.append("put: illegal argument: not enough "
+                           f"arguments (need least 4, got "
+                           f"{len(words) - 1})")
+                continue
+            try:
+                metric, ts, value, tags = self._parse_put_words(words)
+            except Exception as e:  # noqa: BLE001 - per-line report
+                out.append(f"put: {type(e).__name__}: {e}")
+                continue
+            dps.append({"metric": metric, "timestamp": ts,
+                        "value": value, "tags": tags})
+        if dps:
+            _ok, bad, errs = self.tsdb.cluster.forward_writes(dps)
+            if bad:
+                out.extend(
+                    f"put: {e.get('error', 'forward failed')}"
+                    for e in errs)
+        return out
+
     # ------------------------------------------------------------------
 
     def _parse_value(self, raw: str) -> int | float:
@@ -179,17 +213,45 @@ class TelnetRouter:
         # number than the client sent (e.g. "1_0" -> 10)
         return tags_mod.parse_put_value(raw, allow_special=True)
 
+    def _parse_put_words(self, words: list[str]
+                         ) -> tuple[str, int, int | float, dict]:
+        """Shared scalar parse + validation of one ``put`` line: the
+        SAME calls (and so the same exception text) whether the point
+        lands locally or forwards through a cluster router."""
+        metric = words[1]
+        ts = int(words[2])
+        value = self._parse_value(words[3])
+        tags = dict(tags_mod.parse(w) for w in words[4:])
+        cluster = self.tsdb.cluster
+        if cluster is not None:
+            # router role: mirror add_point's local validation BEFORE
+            # forwarding, so a rejected line's error text is
+            # byte-identical to what a standalone/shard TSD answers
+            self.tsdb._check_timestamp(ts)
+            tags_mod.check_metric_and_tags(metric, tags)
+        return metric, ts, value, tags
+
     def _cmd_put(self, words: list[str]) -> str:
         """``put <metric> <timestamp> <value> <tagk=tagv> [...]``
-        (ref: PutDataPointRpc.execute :129)"""
+        (ref: PutDataPointRpc.execute :129). On a cluster router the
+        point forwards to its replica owners (spooling like HTTP
+        puts); rejected lines answer the same error text either
+        way."""
         if len(words) < 5:
             return ("put: illegal argument: not enough arguments "
                     f"(need least 4, got {len(words) - 1})")
         try:
-            metric = words[1]
-            ts = int(words[2])
-            value = self._parse_value(words[3])
-            tags = dict(tags_mod.parse(w) for w in words[4:])
+            metric, ts, value, tags = self._parse_put_words(words)
+            cluster = self.tsdb.cluster
+            if cluster is not None:
+                _ok, bad, errs = cluster.forward_writes(
+                    [{"metric": metric, "timestamp": ts,
+                      "value": value, "tags": tags}])
+                if bad:
+                    detail = errs[0].get("error", "forward failed") \
+                        if errs else "forward failed"
+                    return f"put: {detail}"
+                return ""
             self.tsdb.add_point(metric, ts, value, tags)
             return ""  # silent on success
         except Exception as e:  # noqa: BLE001

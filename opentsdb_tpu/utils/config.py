@@ -192,6 +192,22 @@ _DEFAULTS: dict[str, str] = {
     "tsd.cluster.role": "",
     "tsd.cluster.peers": "",
     "tsd.cluster.vnodes": "64",
+    #   replication factor: each series lives on the next rf distinct
+    #   ring shards (Monarch replicates each target on 2-3 leaves).
+    #   Writes fan out to every replica; reads go to ONE replica per
+    #   set and fall back to the next on failure, so a single shard
+    #   death yields a COMPLETE marker-less 200. Clamped to the shard
+    #   count.
+    "tsd.cluster.rf": "1",
+    #   anti-entropy: when a replica returns, re-copy its dirty
+    #   (peer, metric) windows from a surviving replica — covers the
+    #   divergence the spool cannot (lost/refused spool records)
+    "tsd.cluster.replica.repair": "true",
+    #   online resharding: backfill pacing + per-forward batch size
+    #   (POST /api/cluster/reshard installs the new ring; the window
+    #   dual-writes old+new owners while moved history streams over)
+    "tsd.cluster.reshard.interval_ms": "250",
+    "tsd.cluster.reshard.backfill_batch": "4000",
     #   per-peer connect+read deadline; a hung shard becomes a
     #   degraded partial after this, never a stuck request
     "tsd.cluster.timeout_ms": "5000",

@@ -71,6 +71,8 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "lifecycle.demote",   # lifecycle/manager.py demotion fold
     "lifecycle.histogram",  # lifecycle/manager.py histogram demotion
     "cluster.peer",       # cluster/router.py any-peer exchange
+    "cluster.replica",    # cluster/router.py anti-entropy repair pass
+    "cluster.reshard",    # cluster/reshard.py backfill step
 })
 
 # site families with runtime-named tails (per-peer arming)

@@ -526,6 +526,16 @@ class LivePeer:
             pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=10)
+        if not self._thread.is_alive():
+            # close the loop HERE, deterministically: an abandoned
+            # loop's GC-time __del__ shuts down its default executor
+            # at whatever allocation point the collector happens to
+            # run — under the lock-order witness that reads as a
+            # phantom executor-lock inversion against live pools
+            try:
+                self.loop.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
 
 
 class LiveCluster:
@@ -779,12 +789,13 @@ class TestScatterGather:
 
     def test_unsupported_query_endpoints_refused_in_router_mode(self):
         # these would run against the router's EMPTY local store:
-        # refuse loudly instead of answering "no such name" /
-        # empty suggestions for data that exists in the cluster (or
-        # acking an annotation/rollup into a store no read merges)
+        # refuse loudly instead of answering empty streams for data
+        # that exists in the cluster (or acking an annotation/rollup
+        # into a store no read merges). /api/suggest and
+        # /api/search/lookup scatter now (TestRouterSuggestSearch).
         for path in ("/api/query/exp", "/api/query/gexp",
                      "/api/query/last", "/api/query/continuous",
-                     "/api/suggest", "/api/search/lookup",
+                     "/api/search/graph",
                      "/api/uid/assign", "/api/annotation",
                      "/api/tree", "/api/rollup", "/api/histogram"):
             resp = self.cluster.http.handle(req("GET", path))
@@ -904,9 +915,11 @@ class TestPerSubRetryPeerDeath:
                 with calls_lock:
                     calls["n"] += 1
                     n = calls["n"]
-                # call 1: combined scatter (peer 400s it); calls 2-4:
-                # the (concurrent) per-sub retries — exactly one dies
-                if n == 3:
+                # call 1: combined scatter (peer 400s it naming
+                # c.single); call 2: the metric-elimination retry
+                # carrying the c.m sum+count twins in ONE request —
+                # it dies, so neither twin can leak into the merge
+                if n == 2:
                     raise OSError("peer died mid per-sub retry")
             return orig(peer, req_body, headers=headers)
 
@@ -915,7 +928,7 @@ class TestPerSubRetryPeerDeath:
             resp, got = c.query(body)
         finally:
             router._query_peer = orig
-        assert calls["n"] >= 3, "per-sub retry never reached the kill"
+        assert calls["n"] >= 2, "per-sub retry never reached the kill"
         assert resp.status == 200, resp.body
         got, degraded = _strip_marker(got)
         assert degraded == [target]
@@ -2025,6 +2038,808 @@ class TestSubprocessPeerKill:
             proc.kill()
             for p in inproc:
                 p.stop()
+
+
+# ---------------------------------------------------------------------------
+# replicated rings (RF=2): write fan-out, read-one-fallback, anti-entropy
+# ---------------------------------------------------------------------------
+
+class TestReplicaHashRing:
+    def test_ordered_distinct_replica_sets(self):
+        r = HashRing(["a", "b", "c", "d"])
+        for i in range(60):
+            t = r.shards_for("m", {"host": f"h{i}"}, 2)
+            assert len(t) == 2 and len(set(t)) == 2
+            # primary parity: shards_for[0] IS the single-owner shard
+            assert t[0] == r.shard_for("m", {"host": f"h{i}"})
+            # growing rf EXTENDS the walk, never reorders the prefix
+            t3 = r.shards_for("m", {"host": f"h{i}"}, 3)
+            assert t3[:2] == t
+
+    def test_rf_clamped_to_shard_count(self):
+        r = HashRing(["a", "b"])
+        assert len(r.shards_for("m", {}, 5)) == 2
+        one = HashRing(["only"])
+        assert one.shards_for("m", {}, 3) == ("only",)
+
+    def test_replica_sets_cover_every_series(self):
+        r = HashRing(["a", "b", "c"])
+        sets = set(r.replica_sets(2))
+        for i in range(200):
+            assert r.shards_for("m", {"host": f"h{i}"}, 2) in sets
+
+    def test_remap_fraction_stays_small_at_rf2(self):
+        keys = [series_shard_key("sys.cpu", {"host": f"h{i}"})
+                for i in range(400)]
+        r3 = HashRing(["a", "b", "c"])
+        r4 = HashRing(["a", "b", "c", "d"])
+        moved = sum(set(r3.shards_for_key(k, 2))
+                    != set(r4.shards_for_key(k, 2)) for k in keys)
+        # each of 2 replica slots remaps ~1/4 of keys independently
+        assert 0 < moved < len(keys) * 0.75, moved
+
+
+class ReplicaChaosBase(ChaosBase):
+    """RF=2 chaos battery: every series lives on TWO of the three
+    shards, so a single death must yield COMPLETE marker-less 200s."""
+
+    RF = 2
+
+    @pytest.fixture()
+    def chaos(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.rf": str(self.RF),
+                           "tsd.cluster.timeout_ms": "3000",
+                           "tsd.cluster.breaker.reset_timeout_ms":
+                               "300"})
+        points = _mkpoints(n_hosts=self.N_HOSTS, n_sec=60)
+        assert c.put(points, summary="true").status == 200
+        for p in c.peers:
+            p.tsdb.execute_query(TSQuery.from_json(
+                _tsq(QUERIES[0])).validate())
+        resp, out = c.query(self.fresh_q(salt=0))
+        assert resp.status == 200
+        assert _strip_marker(out)[1] == []
+        self.points = points
+        yield c
+        c.close()
+
+    def owned_by(self, c, name, points):
+        return [dp for dp in points
+                if name in c.router.ring.shards_for(
+                    dp["metric"], dp["tags"], self.RF)]
+
+
+class TestReplicatedRF2(ReplicaChaosBase):
+    def test_writes_fan_out_to_both_replicas(self, chaos):
+        c = chaos
+        # every shard holds exactly the series whose replica set
+        # names it: ask each peer directly with aggregator none
+        for name in sorted(c.router.peers):
+            mine = {dp["tags"]["host"]
+                    for dp in self.owned_by(c, name, self.points)}
+            rows = c.peer(name).tsdb.execute_query(TSQuery.from_json(
+                _tsq({"aggregator": "none"})).validate())
+            assert {r.tags["host"] for r in rows} == mine
+
+    def test_single_death_reads_complete_and_markerless(self, chaos):
+        c = chaos
+        dead = "s1"
+        c.peer(dead).kill()
+        fallbacks0 = c.router.read_fallbacks
+        oracle = _oracle(self.points)
+        for i, qspec in enumerate(QUERIES):
+            body = _tsq(qspec, end=BASE_MS + 300_000 + i)
+            resp, out = c.query(body)
+            assert resp.status == 200, (qspec, resp.body)
+            rows, degraded = _strip_marker(out)
+            # the replica covers the dead shard: NO marker, and the
+            # answer is bit-identical to the no-fault oracle
+            assert degraded == [], qspec
+            assert "X-OpenTSDB-Shards-Degraded" not in resp.headers
+            want = json.loads(oracle.handle(
+                req("POST", "/api/query", body)).body)
+            assert _sorted_rows(rows) == _sorted_rows(want), qspec
+        assert c.router.read_fallbacks > fallbacks0
+        assert c.router.degraded_queries == 0
+
+    def test_both_replicas_down_degrades_with_marker(self, chaos):
+        c = chaos
+        c.peer("s0").kill()
+        c.peer("s1").kill()
+        # only s2 survives: every set containing both dead shards is
+        # uncovered -> marker; sets with s2 still answer
+        resp, out = c.query(self.fresh_q(salt=77))
+        assert resp.status == 200
+        rows, degraded = _strip_marker(out)
+        assert degraded == ["s0", "s1"]
+        survivors = [dp for dp in self.points
+                     if "s2" in c.router.ring.shards_for(
+                         dp["metric"], dp["tags"], self.RF)]
+        want = json.loads(_oracle(survivors).handle(
+            req("POST", "/api/query", self.fresh_q(salt=77))).body)
+        assert _sorted_rows(rows) == _sorted_rows(want)
+
+    def test_flap_chaos_rf2_acked_never_lost_reads_complete(
+            self, chaos):
+        c = chaos
+        sent = list(self.points)
+        statuses = []
+        for cycle in range(3):
+            victim = f"s{cycle % 3}"
+            c.peer(victim).kill()
+            extra = [{"metric": "c.m",
+                      "timestamp": BASE + 2000 + cycle * 40 + i,
+                      "value": cycle * 10 + i,
+                      "tags": {"host": f"h{h:02d}"}}
+                     for i in range(10) for h in range(self.N_HOSTS)]
+            r = c.put(extra, summary="true")
+            statuses.append(r.status)
+            assert json.loads(r.body)["failed"] == 0
+            sent.extend(extra)
+            resp, out = c.query(self.fresh_q(salt=500 + cycle))
+            statuses.append(resp.status)
+            rows, degraded = _strip_marker(out)
+            # one dead replica never degrades an RF=2 read
+            assert degraded == []
+            c.peer(victim).restart()
+            assert c.wait_spool_drained(victim)
+        assert all(s in (200, 204) for s in statuses), statuses
+        # post-heal: BOTH replicas of every series converged — each
+        # shard's direct answer equals the oracle restricted to it
+        full_oracle = _oracle(sent)
+        body = self.fresh_q(salt=999)
+        deadline = time.monotonic() + 10
+        while True:
+            resp, out = c.query(body)
+            rows, degraded = _strip_marker(out)
+            if not degraded or time.monotonic() > deadline:
+                break
+            body = self.fresh_q(salt=int(time.monotonic() * 1000))
+            time.sleep(0.2)
+        assert degraded == []
+        want = json.loads(full_oracle.handle(
+            req("POST", "/api/query", body)).body)
+        assert _sorted_rows(rows) == _sorted_rows(want)
+        for name in sorted(c.router.peers):
+            mine = self.owned_by(c, name, sent)
+            peer_want = json.loads(_oracle(mine).handle(
+                req("POST", "/api/query", body)).body)
+            rows_local = c.peer(name).tsdb.execute_query(
+                TSQuery.from_json(body).validate())
+            from opentsdb_tpu.tsd.json_serializer import \
+                HttpJsonSerializer
+            got_local = json.loads(HttpJsonSerializer().format_query(
+                TSQuery.from_json(body).validate(), rows_local))
+            assert _sorted_rows(got_local) == _sorted_rows(peer_want)
+
+
+class TestReplicaDivergenceRepair(ReplicaChaosBase):
+    """Kill one replica mid-ingest, LOSE its spool (the divergence
+    the spool cannot replay), heal: anti-entropy must re-copy the
+    dirty window from the surviving replica and converge both
+    replicas to the oracle."""
+
+    @pytest.fixture()
+    def chaos(self, tmp_path):
+        # non-durable spool: the in-memory queue is exactly the state
+        # a router restart loses — every spooled batch marks dirty
+        c = LiveCluster(tmp_path, durable=False,
+                        **{"tsd.cluster.rf": "2",
+                           "tsd.cluster.timeout_ms": "3000",
+                           "tsd.cluster.breaker.reset_timeout_ms":
+                               "300"})
+        points = _mkpoints(n_hosts=self.N_HOSTS, n_sec=30)
+        assert c.put(points, summary="true").status == 200
+        for p in c.peers:
+            p.tsdb.execute_query(TSQuery.from_json(
+                _tsq(QUERIES[0])).validate())
+        self.points = points
+        yield c
+        c.close()
+
+    def test_lost_spool_repairs_from_surviving_replica(self, chaos):
+        c = chaos
+        dead = "s1"
+        c.peer(dead).kill()
+        extra = [{"metric": "c.m", "timestamp": BASE + 400 + i,
+                  "value": 7 + i, "tags": {"host": f"h{h:02d}"}}
+                 for i in range(10) for h in range(self.N_HOSTS)]
+        r = c.put(extra, summary="true")
+        assert json.loads(r.body)["failed"] == 0
+        peer = c.router.peers[dead]
+        assert peer.spool.pending_records > 0
+        assert c.router.dirty.peek(dead), \
+            "non-durable spooling must mark the window dirty"
+        # the spool is LOST (what a router restart does to an
+        # in-memory queue): replay can never deliver these batches
+        peer.spool._queue.clear()
+        peer.spool._mem_bytes = 0
+        c.peer(dead).restart()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                c.router.dirty.peek(dead):
+            time.sleep(0.2)
+        assert not c.router.dirty.peek(dead), "repair never ran"
+        assert c.router.repairs >= 1
+        assert c.router.repair_points > 0
+        # the healed replica converged: its direct answer equals the
+        # oracle restricted to the series it owns
+        mine = self.owned_by(c, dead, self.points + extra)
+        body = self.fresh_q(salt=5)
+        want = json.loads(_oracle(mine).handle(
+            req("POST", "/api/query", body)).body)
+        rows_local = c.peer(dead).tsdb.execute_query(
+            TSQuery.from_json(body).validate())
+        from opentsdb_tpu.tsd.json_serializer import \
+            HttpJsonSerializer
+        got_local = json.loads(HttpJsonSerializer().format_query(
+            TSQuery.from_json(body).validate(), rows_local))
+        assert _sorted_rows(got_local) == _sorted_rows(want)
+        # and the cluster answer equals the no-fault oracle
+        full = _oracle(self.points + extra)
+        body2 = self.fresh_q(salt=6)
+        resp, out = c.query(body2)
+        rows, degraded = _strip_marker(out)
+        assert degraded == []
+        want2 = json.loads(full.handle(
+            req("POST", "/api/query", body2)).body)
+        assert _sorted_rows(rows) == _sorted_rows(want2)
+
+    def test_rf1_dirty_debt_is_void(self, tmp_path):
+        # with a single copy there is no replica to repair FROM: the
+        # tracker clears instead of wedging the replay loop forever
+        c = LiveCluster(tmp_path, durable=False, **{
+            "tsd.cluster.timeout_ms": "2000",
+            "tsd.cluster.breaker.reset_timeout_ms": "200"})
+        try:
+            c.router.dirty.mark("s0", {"c.m"}, BASE_MS)
+            assert c.router.repair_peer(c.router.peers["s0"]) is True
+            assert not c.router.dirty.peek("s0")
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# online resharding: fenced epochs, dual-write window, backfill
+# ---------------------------------------------------------------------------
+
+class ReshardBase:
+    N_HOSTS = 8
+
+    def make_cluster(self, tmp_path, **cfg):
+        return LiveCluster(tmp_path, durable=True, **{
+            "tsd.cluster.timeout_ms": "3000",
+            "tsd.cluster.breaker.reset_timeout_ms": "300",
+            # backfill stepped by hand: deterministic cutovers
+            "tsd.cluster.reshard.interval_ms": "3600000",
+            **cfg})
+
+    def ingest(self, c, n_sec=40):
+        points = _mkpoints(n_hosts=self.N_HOSTS, n_sec=n_sec)
+        assert c.put(points, summary="true").status == 200
+        return points
+
+    @staticmethod
+    def begin(c, extra_peer):
+        spec = c.cfg["tsd.cluster.peers"] + \
+            f",s3=127.0.0.1:{extra_peer.port}"
+        resp = c.http.handle(req("POST", "/api/cluster/reshard",
+                                 {"peers": spec}))
+        assert resp.status == 200, resp.body
+        return json.loads(resp.body)
+
+    @staticmethod
+    def run_backfill(c, max_steps=200):
+        for _ in range(max_steps):
+            info = c.router.backfill_step()
+            if info.get("phase") in ("done", "idle"):
+                return
+            assert info.get("phase") != "blocked", info
+        raise AssertionError("backfill never completed")
+
+
+class TestOnlineReshard(ReshardBase):
+    def test_grow_ring_dual_write_window_then_finalize(self, tmp_path):
+        c = self.make_cluster(tmp_path)
+        extra_peer = LivePeer("s3")
+        try:
+            points = self.ingest(c)
+            # a cached complete answer from epoch 0 must never serve
+            # post-install (epoch-qualified versions)
+            body_cached = _tsq({"aggregator": "sum",
+                                "downsample": "10s-sum"},
+                               end=BASE_MS + 800_000)
+            resp, first = c.query(body_cached)
+            assert _strip_marker(first)[1] == []
+            hits0 = c.router.cache_hits
+            resp, again = c.query(body_cached)
+            assert c.router.cache_hits == hits0 + 1
+
+            info = self.begin(c, extra_peer)
+            assert info["epoch"] == 1 and info["active"]
+            assert c.router.resharding
+            # the admin surface reports the open window
+            status = json.loads(c.http.handle(
+                req("GET", "/api/cluster/reshard")).body)
+            assert status["active"] and status["epoch"] == 1
+            # a second install while the window is open is refused
+            resp = c.http.handle(req(
+                "POST", "/api/cluster/reshard",
+                {"peers": c.cfg["tsd.cluster.peers"]}))
+            assert resp.status == 400
+
+            # epoch-qualified cache: the pre-install entry is dead
+            hits1 = c.router.cache_hits
+            resp, post = c.query(body_cached)
+            assert c.router.cache_hits == hits1  # miss, recomputed
+            assert _strip_marker(post)[1] == []
+
+            # dual-write window: new ingest is acked and readable
+            during = [{"metric": "c.m",
+                       "timestamp": BASE + 500 + i, "value": i,
+                       "tags": {"host": f"h{h:02d}"}}
+                      for i in range(10)
+                      for h in range(self.N_HOSTS)]
+            r = c.put(during, summary="true")
+            assert json.loads(r.body)["failed"] == 0
+            oracle = _oracle(points + during)
+            body = _tsq({"aggregator": "sum", "downsample": "10s-sum"},
+                        end=BASE_MS + 900_000)
+            resp, out = c.query(body)
+            rows, degraded = _strip_marker(out)
+            assert resp.status == 200 and degraded == []
+            want = json.loads(oracle.handle(
+                req("POST", "/api/query", body)).body)
+            assert _sorted_rows(rows) == _sorted_rows(want)
+
+            self.run_backfill(c)
+            assert not c.router.resharding
+            assert c.router.epoch == 1
+            assert "s3" in c.router.ring.names
+            # warm every query shape on every peer (incl. the
+            # joiner): a first compile under full-suite contention
+            # can exceed the peer deadline and falsely degrade
+            for p in c.peers + [extra_peer]:
+                for qspec in QUERIES:
+                    p.tsdb.execute_query(TSQuery.from_json(
+                        _tsq(qspec)).validate())
+            # post-finalize: every query plan still bit-identical
+            for i, qspec in enumerate(QUERIES):
+                body = _tsq(qspec, end=BASE_MS + 900_100 + i)
+                resp, out = c.query(body)
+                assert resp.status == 200, (qspec, resp.body)
+                rows, degraded = _strip_marker(out)
+                assert degraded == [], qspec
+                want = json.loads(oracle.handle(
+                    req("POST", "/api/query", body)).body)
+                assert _sorted_rows(rows) == _sorted_rows(want), qspec
+            # the joined shard genuinely owns keyspace now
+            rows = extra_peer.tsdb.execute_query(TSQuery.from_json(
+                _tsq({"aggregator": "none"},
+                     end=BASE_MS + 900_000)).validate())
+            assert len(rows) > 0
+            # and writes route to it without the old ring
+            resp = c.put([{"metric": "c.m", "timestamp": BASE + 900,
+                           "value": 1, "tags": {"host": "h00"}}],
+                         summary="true")
+            assert json.loads(resp.body)["failed"] == 0
+        finally:
+            c.close()
+            extra_peer.stop()
+
+    def test_reshard_requires_router_and_spec(self, tmp_path):
+        c = self.make_cluster(tmp_path)
+        try:
+            resp = c.http.handle(req("POST", "/api/cluster/reshard",
+                                     {}))
+            assert resp.status == 400
+            resp = c.http.handle(req("POST", "/api/cluster/reshard",
+                                     {"peers": "nonsense"}))
+            assert resp.status == 400
+            # shard peers expose no cluster admin surface
+            h = c.peers[0].server.http_router.handle(
+                req("GET", "/api/cluster"))
+            assert h.status == 400
+        finally:
+            c.close()
+
+
+class TestKillDuringReshard(ReshardBase):
+    def test_router_death_mid_backfill_recovers_and_converges(
+            self, tmp_path):
+        """The ISSUE's kill-during-reshard oracle: the router dies
+        with the cutover window open (one backfill unit copied,
+        dual-written in-window writes pending, one shard dead with a
+        spooled backlog). Recovery must resume the SAME epoch,
+        finish the copy, and answer bit-identically to a no-fault
+        single-ring oracle — zero acked-point loss."""
+        c = self.make_cluster(tmp_path)
+        extra_peer = LivePeer("s3")
+        try:
+            points = self.ingest(c)
+            self.begin(c, extra_peer)
+            info = c.router.backfill_step()
+            assert info.get("phase") in ("copied", "blocked")
+            # in-window writes WITH a dead shard: acked via the spool
+            dead = "s0"
+            c.peer(dead).kill()
+            during = [{"metric": "c.m",
+                       "timestamp": BASE + 600 + i, "value": 3 + i,
+                       "tags": {"host": f"h{h:02d}"}}
+                      for i in range(8) for h in range(self.N_HOSTS)]
+            r = c.put(during, summary="true")
+            assert json.loads(r.body)["failed"] == 0
+            epoch = c.router.epoch
+
+            # the router DIES mid-reshard and comes back: epoch, both
+            # rings and the done-markers reload from reshard.json
+            c.tsdb.shutdown()
+            c.tsdb = TSDB(Config(**c.cfg))
+            c.http = HttpRpcRouter(c.tsdb)
+            c.router = c.tsdb.cluster
+            assert c.router.epoch == epoch
+            assert c.router.resharding
+            assert set(c.router.ring.names) == {"s0", "s1", "s2",
+                                                "s3"}
+            c.router.start()
+            c.peer(dead).restart()
+            assert c.wait_spool_drained(dead, timeout=20)
+            self.run_backfill(c)
+            assert not c.router.resharding
+
+            oracle = _oracle(points + during)
+            body = _tsq({"aggregator": "sum", "downsample": "10s-sum"},
+                        end=BASE_MS + 900_000)
+            deadline = time.monotonic() + 10
+            while True:
+                resp, out = c.query(body)
+                rows, degraded = _strip_marker(out)
+                if not degraded or time.monotonic() > deadline:
+                    break
+                body = _tsq({"aggregator": "sum",
+                             "downsample": "10s-sum"},
+                            end=BASE_MS + 900_000
+                            + int(time.monotonic() * 1000) % 977)
+                time.sleep(0.2)
+            assert resp.status == 200
+            assert degraded == []
+            want = json.loads(oracle.handle(
+                req("POST", "/api/query", body)).body)
+            assert _sorted_rows(rows) == _sorted_rows(want)
+        finally:
+            c.close()
+            extra_peer.stop()
+
+
+class TestReplicaSplitMarksDirty:
+    """A per-point refusal by ONE replica while its sibling stored
+    the point is a replica split: it must mark the (peer, metric)
+    window dirty so anti-entropy can re-level it — the spool never
+    saw the point, so nothing else would."""
+
+    def test_partial_refusal_marks_dirty(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.rf": "2",
+                           "tsd.cluster.timeout_ms": "2000"})
+        try:
+            router = c.router
+            victim = sorted(router.peers)[0]
+            orig = router._deliver
+
+            def wrapper(peer, dps, headers=None):
+                ok, bad, errs = orig(peer, dps, headers=headers)
+                if peer.name == victim and ok:
+                    # the peer "refuses" the last point after its
+                    # sibling stored its copy
+                    dp = dps[-1]
+                    return ok - 1, bad + 1, errs + [
+                        {"datapoint": dict(dp),
+                         "error": "injected per-point refusal"}]
+                return ok, bad, errs
+
+            router._deliver = wrapper
+            try:
+                pts = [{"metric": "split.m", "timestamp": BASE + i,
+                        "value": i, "tags": {"host": f"h{h}"}}
+                       for i in range(5) for h in range(6)]
+                ok, bad, errs = router.forward_writes(pts)
+            finally:
+                router._deliver = orig
+            assert bad >= 1  # the refused point is NOT acked
+            assert "split.m" in router.dirty.peek(victim)
+            # repair re-levels from the sibling and clears the debt
+            assert router.repair_peer(router.peers[victim])
+            assert not router.dirty.peek(victim)
+        finally:
+            c.close()
+
+
+class TestCopyScanBisectsOn413:
+    """A scan-budgeted shard 413s a whole-history copy scan: the
+    backfill/repair scan must bisect the window into budget-sized
+    pages instead of retrying the identical over-budget query
+    forever."""
+
+    def test_413_pages_and_merges(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.timeout_ms": "2000"})
+        try:
+            pts = [{"metric": "wide.m", "timestamp": BASE + i,
+                    "value": i, "tags": {"host": "a"}}
+                   for i in range(100)]
+            assert c.put(pts, summary="true").status == 200
+            router = c.router
+            owner = c.shard_of("wide.m", {"host": "a"})
+            peer = router.peers[owner]
+            orig = router._query_peer
+            wide_413s = {"n": 0}
+
+            def wrapper(p, body, headers=None):
+                # a real scan budget trips on SCANNED points, so an
+                # empty window always passes: 413 iff this window
+                # holds more than 30 of the 100 stored points
+                obj = json.loads(body)
+                lo = max(int(str(obj["start"]).rstrip("ms")),
+                         BASE_MS)
+                hi = min(int(str(obj["end"]).rstrip("ms")),
+                         BASE_MS + 99_000)
+                in_window = max(hi - lo, -1000) // 1000 + 1
+                if p.name == owner and \
+                        obj["queries"][0]["metric"] == "wide.m" and \
+                        in_window > 30:
+                    wide_413s["n"] += 1
+                    return 413, (b'{"error":{"code":413,'
+                                 b'"message":"limit"}}')
+                return orig(p, body, headers=headers)
+
+            router._query_peer = wrapper
+            try:
+                rows = router.scan_series_rows(
+                    peer, "wide.m", 1, BASE_MS + 200_000)
+            finally:
+                router._query_peer = orig
+            assert wide_413s["n"] >= 1, "bisect never triggered"
+            got = sorted(ts for r in rows
+                         for ts, _v in (r.get("dps") or ()))
+            assert got == [BASE_MS + i * 1000 for i in range(100)]
+        finally:
+            c.close()
+
+
+class TestShrinkRingWithDeadShard(ReshardBase):
+    def test_rf2_shrink_drops_dead_shard_and_finalizes(
+            self, tmp_path):
+        """Shrinking the ring to drop a DEAD shard — the canonical
+        reason to shrink — must finalize at RF=2: the dead shard's
+        series all have an alive replica whose own backfill pass
+        copies them, so its unreachable enumeration is skipped, not
+        blocking."""
+        # LiveCluster is a fixed 3-ring: build the 4-shard RF=2 ring
+        # by hand so one member can be dropped
+        peers = [LivePeer(f"s{i}") for i in range(4)]
+        spec = ",".join(f"s{i}=127.0.0.1:{p.port}"
+                        for i, p in enumerate(peers))
+        cfg = {
+            "tsd.cluster.role": "router",
+            "tsd.cluster.peers": spec,
+            "tsd.cluster.rf": "2",
+            "tsd.cluster.spool.dir": str(tmp_path / "spool"),
+            "tsd.cluster.spool.replay_interval_ms": "100",
+            "tsd.cluster.timeout_ms": "3000",
+            "tsd.cluster.breaker.reset_timeout_ms": "300",
+            "tsd.cluster.reshard.interval_ms": "3600000",
+            "tsd.tpu.warmup": "false",
+        }
+        rt = TSDB(Config(**cfg))
+        http = HttpRpcRouter(rt)
+        rt.cluster.start()
+        try:
+            points = _mkpoints(n_hosts=self.N_HOSTS, n_sec=30)
+            resp = http.handle(req("POST", "/api/put", points,
+                                   summary="true"))
+            assert json.loads(resp.body)["failed"] == 0
+            # s3's hardware "dies"; drop it from the ring
+            peers[3].kill()
+            # a couple of failures so its breaker reflects reality
+            for _ in range(3):
+                rt.cluster.peers["s3"].breaker.record_failure()
+            resp = http.handle(req(
+                "POST", "/api/cluster/reshard",
+                {"peers": ",".join(
+                    f"s{i}=127.0.0.1:{peers[i].port}"
+                    for i in range(3))}))
+            assert resp.status == 200, resp.body
+            for _ in range(200):
+                info = rt.cluster.backfill_step()
+                if info.get("phase") in ("done", "idle"):
+                    break
+                assert info.get("phase") != "blocked", info
+            assert not rt.cluster.resharding
+            assert "s3" not in rt.cluster.peers
+            # post-finalize reads: complete, marker-less, oracle
+            oracle = _oracle(points)
+            body = _tsq({"aggregator": "sum",
+                         "downsample": "10s-sum"},
+                        end=BASE_MS + 700_000)
+            resp = http.handle(req("POST", "/api/query", body))
+            assert resp.status == 200
+            rows, degraded = _strip_marker(json.loads(resp.body))
+            assert degraded == []
+            want = json.loads(oracle.handle(
+                req("POST", "/api/query", body)).body)
+            assert _sorted_rows(rows) == _sorted_rows(want)
+        finally:
+            rt.shutdown()
+            for p in peers:
+                p.stop()
+
+
+# ---------------------------------------------------------------------------
+# router telnet ingest (carried ROADMAP follow-up)
+# ---------------------------------------------------------------------------
+
+class TestRouterTelnet:
+    def test_put_lines_forward_with_byte_identical_errors(
+            self, tmp_path):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.timeout_ms": "3000"})
+        try:
+            tr = TelnetRouter(c.tsdb)
+            good = [f"put t.m {BASE + i} {i} host=h{h}"
+                    for i in range(20) for h in range(3)]
+            bad = ["put t.m abc 1 host=a",
+                   "put t.m 1356998400 1_0 host=a",
+                   "put",
+                   "put t.m 1356998400 1",
+                   "put t.m 1356998400 1 nota-tag"]
+            resps, exc = tr.execute_lines(good + bad)
+            assert exc is None
+            # rejected lines answer EXACTLY what a standalone TSD
+            # answers (same parse, same exceptions)
+            oracle_tsdb = TSDB(Config(**PEER_CFG))
+            oresps, _ = TelnetRouter(oracle_tsdb).execute_lines(
+                good + bad)
+            assert resps == oresps
+            # the forwarded burst landed: merged read == oracle
+            body = {"start": BASE_MS - 10_000,
+                    "end": BASE_MS + 100_000,
+                    "queries": [{"metric": "t.m",
+                                 "aggregator": "sum",
+                                 "downsample": "10s-sum"}]}
+            resp, out = c.query(body)
+            rows, degraded = _strip_marker(out)
+            assert resp.status == 200 and degraded == []
+            want = json.loads(HttpRpcRouter(oracle_tsdb).handle(
+                req("POST", "/api/query", body)).body)
+            assert _sorted_rows(rows) == _sorted_rows(want)
+            # single-line path forwards too
+            r = tr.execute(f"put t.single {BASE} 5 host=only")
+            assert r == ""
+            resp, out = c.query({
+                "start": BASE_MS - 10_000, "end": BASE_MS + 100_000,
+                "queries": [{"metric": "t.single",
+                             "aggregator": "sum"}]})
+            assert resp.status == 200
+        finally:
+            c.close()
+
+    def test_put_lines_spool_when_shard_dead(self, tmp_path):
+        from opentsdb_tpu.tsd.telnet import TelnetRouter
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.timeout_ms": "2000",
+                           "tsd.cluster.breaker.reset_timeout_ms":
+                               "200"})
+        try:
+            tr = TelnetRouter(c.tsdb)
+            for p in c.peers:
+                p.kill()
+            lines = [f"put t.m {BASE + i} {i} host=h{h}"
+                     for i in range(5) for h in range(4)]
+            resps, exc = tr.execute_lines(lines)
+            # acked into the durable spool: silent success, like HTTP
+            assert resps == [] and exc is None
+            assert sum(p.spool.pending_records
+                       for p in c.router.peers.values()) > 0
+            for p in c.peers:
+                p.restart()
+            for name in c.router.peers:
+                assert c.wait_spool_drained(name)
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# suggest/search scatter on the router
+# ---------------------------------------------------------------------------
+
+class TestRouterSuggestSearch:
+    @pytest.fixture()
+    def scatter_cluster(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.timeout_ms": "3000",
+                           "tsd.cluster.breaker.reset_timeout_ms":
+                               "300"})
+        points = _mkpoints(n_hosts=10, n_sec=5)
+        points += [{"metric": "other.m", "timestamp": BASE,
+                    "value": 1, "tags": {"dc": "east"}}]
+        assert c.put(points, summary="true").status == 200
+        self.points = points
+        yield c
+        c.close()
+
+    def test_suggest_union_equals_single_node(self, scatter_cluster):
+        c = scatter_cluster
+        oracle = _oracle(self.points)
+        for stype in ("metrics", "tagk", "tagv"):
+            r = c.http.handle(req("GET", "/api/suggest", type=stype,
+                                  max=100))
+            assert r.status == 200, r.body
+            assert "X-OpenTSDB-Shards-Degraded" not in r.headers
+            want = json.loads(oracle.handle(
+                req("GET", "/api/suggest", type=stype,
+                    max=100)).body)
+            assert sorted(json.loads(r.body)) == sorted(want), stype
+        # bad type is still a clean 400 on the router
+        r = c.http.handle(req("GET", "/api/suggest", type="bogus"))
+        assert r.status == 400
+        # max caps the union, not each shard's slice
+        r = c.http.handle(req("GET", "/api/suggest", type="tagv",
+                              max=3))
+        assert len(json.loads(r.body)) == 3
+
+    def test_lookup_union_dedup_and_limit(self, scatter_cluster):
+        c = scatter_cluster
+        r = c.http.handle(req("POST", "/api/search/lookup",
+                              {"metric": "c.m", "limit": 100}))
+        assert r.status == 200
+        doc = json.loads(r.body)
+        assert doc["totalResults"] == 10
+        hosts = sorted(x["tags"]["host"] for x in doc["results"])
+        assert hosts == sorted(f"h{h:02d}" for h in range(10))
+        r = c.http.handle(req("POST", "/api/search/lookup",
+                              {"metric": "c.m", "limit": 4}))
+        assert len(json.loads(r.body)["results"]) == 4
+        # non-lookup search stays refused (no router-side index)
+        r = c.http.handle(req("GET", "/api/search/graph"))
+        assert r.status == 400
+
+    def test_dead_shard_marks_header_at_rf1(self, scatter_cluster):
+        c = scatter_cluster
+        c.peer("s1").kill()
+        r = c.http.handle(req("GET", "/api/suggest", type="metrics",
+                              max=100))
+        assert r.status == 200
+        assert r.headers.get("X-OpenTSDB-Shards-Degraded") == "s1"
+        r = c.http.handle(req("POST", "/api/search/lookup",
+                              {"metric": "c.m", "limit": 100}))
+        assert r.status == 200
+        assert r.headers.get("X-OpenTSDB-Shards-Degraded") == "s1"
+
+    def test_dead_shard_no_header_at_rf2(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.rf": "2",
+                           "tsd.cluster.timeout_ms": "3000",
+                           "tsd.cluster.breaker.reset_timeout_ms":
+                               "300"})
+        try:
+            points = _mkpoints(n_hosts=10, n_sec=5)
+            assert c.put(points, summary="true").status == 200
+            oracle = _oracle(points)
+            c.peer("s2").kill()
+            r = c.http.handle(req("GET", "/api/suggest",
+                                  type="metrics", max=100))
+            assert r.status == 200
+            # every replica set still has a live member: the union is
+            # complete and the header stays absent
+            assert "X-OpenTSDB-Shards-Degraded" not in r.headers
+            want = json.loads(oracle.handle(
+                req("GET", "/api/suggest", type="metrics",
+                    max=100)).body)
+            assert sorted(json.loads(r.body)) == sorted(want)
+        finally:
+            c.close()
 
 
 @pytest.mark.slow
