@@ -60,6 +60,8 @@ class HashRing:
         self._owners = [n for _, n in points]
         # replica tuples are pure functions of (segment start, rf):
         # memoized per rf because reads recompute them per series
+        # tsdlint: allow[unbounded-growth] keyspace is (vnode segment,
+        # rf) — at most names*vnodes*rf entries, fixed at construction
         self._sets_cache: dict[int, tuple] = {}
 
     def _walk(self, idx: int, rf: int) -> tuple[str, ...]:

@@ -68,10 +68,20 @@ def ring_for(peers: Iterable[str], vnodes: int) -> HashRing:
 
 
 def sel_doc(peers: list[str], vnodes: int, rf: int,
-            sets: Iterable[tuple[str, ...]]) -> dict[str, Any]:
-    """The wire form of one request's replica assignment."""
-    return {"peers": list(peers), "vnodes": int(vnodes),
-            "rf": int(rf), "sets": [list(t) for t in sets]}
+            sets: Iterable[tuple[str, ...]],
+            invert: bool = False) -> dict[str, Any]:
+    """The wire form of one request's replica assignment.
+
+    ``invert=True`` flips the mask: the shard keeps only series whose
+    replica set is NOT among ``sets``. The one caller is the stale-
+    copy retire pass — a delete scoped to "every series this shard no
+    longer owns" (``sets`` = all tuples containing the shard), which
+    no positive selector can express."""
+    out = {"peers": list(peers), "vnodes": int(vnodes),
+           "rf": int(rf), "sets": [list(t) for t in sets]}
+    if invert:
+        out["invert"] = True
+    return out
 
 
 def parse_sel(obj: Any) -> dict[str, Any] | None:
@@ -107,7 +117,8 @@ def parse_sel(obj: Any) -> dict[str, Any] | None:
             f"replicaSel.sets name shards not in peers: "
             f"{sorted(unknown)}")
     return {"peers": [str(p) for p in peers], "vnodes": vnodes,
-            "rf": rf, "sets": [tuple(t) for t in sets]}
+            "rf": rf, "sets": [tuple(t) for t in sets],
+            "invert": bool(obj.get("invert", False))}
 
 
 def sel_cache_key(sel: dict[str, Any] | None) -> tuple:
@@ -117,6 +128,7 @@ def sel_cache_key(sel: dict[str, Any] | None) -> tuple:
     if not sel:
         return ()
     return (tuple(sel["peers"]), sel["vnodes"], sel["rf"],
+            bool(sel.get("invert")),
             tuple(sorted(tuple(t) for t in sel["sets"])))
 
 
@@ -130,12 +142,14 @@ def series_mask(sel: dict[str, Any], metric: str, series_tags,
     ring = ring_for(sel["peers"], sel["vnodes"])
     assigned = {tuple(t) for t in sel["sets"]}
     rf = sel["rf"]
+    want = not sel.get("invert", False)
     out = []
     for pairs in series_tags:
         tags = {name_of_kid(int(k)): name_of_vid(int(v))
                 for k, v in pairs}
         key = series_shard_key(metric, tags)
-        out.append(ring.shards_for_key(key, rf) in assigned)
+        out.append((ring.shards_for_key(key, rf) in assigned)
+                   is want)
     return out
 
 

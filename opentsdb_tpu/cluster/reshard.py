@@ -68,6 +68,11 @@ class ReshardState:
         self.old_spec = ""       # non-empty => cutover window open
         self.old_vnodes = 0
         self.fence_ms = 0
+        # highest epoch whose stale-copy retire pass COMPLETED: while
+        # retired_epoch < epoch (and no cutover is open), former
+        # owners may still hold moved series that replicaSel hides —
+        # the retire pass deletes them and then marks the epoch
+        self.retired_epoch = 0
         # old-shard name -> metrics whose moved keyspace fully copied
         self.done: dict[str, list[str]] = {}
         if self.path:
@@ -81,6 +86,8 @@ class ReshardState:
                 self.epoch = int(doc.get("epoch", 0))
                 self.peers_spec = str(doc.get("peers", "") or "")
                 self.vnodes = int(doc.get("vnodes", 0) or 0)
+                self.retired_epoch = int(
+                    doc.get("retired_epoch", 0) or 0)
                 rs = doc.get("reshard") or {}
                 self.old_spec = str(rs.get("old_peers", "") or "")
                 self.old_vnodes = int(rs.get("old_vnodes", 0) or 0)
@@ -101,7 +108,8 @@ class ReshardState:
             return
         doc: dict[str, Any] = {"epoch": self.epoch,
                                "peers": self.peers_spec,
-                               "vnodes": self.vnodes}
+                               "vnodes": self.vnodes,
+                               "retired_epoch": self.retired_epoch}
         if self.old_spec:
             doc["reshard"] = {"old_peers": self.old_spec,
                               "old_vnodes": self.old_vnodes,
@@ -159,6 +167,21 @@ class ReshardState:
                 per.append(metric)
                 self._save_locked()
 
+    def mark_retired(self, epoch: int) -> None:
+        """The stale-copy retire pass that ran against ``epoch``
+        finished: no former owner still holds a moved series.
+        Compare-and-set on purpose — if a NEWER reshard began while
+        the pass was finishing, stamping the current epoch would
+        silently skip that epoch's reclaim forever; the stale mark is
+        simply dropped and the re-armed pass covers the new epoch.
+        Persisted so a router restart doesn't re-run a completed pass
+        (re-running is harmless — the deletes match nothing — just
+        wasted scans)."""
+        with self._lock:
+            if self.epoch == epoch and self.retired_epoch != epoch:
+                self.retired_epoch = epoch
+                self._save_locked()
+
     def reset_done(self) -> None:
         """Invalidate every done-marker: the responsibility snapshot
         changed (a shard was declared dead), so completed passes may
@@ -176,7 +199,8 @@ class ReshardState:
     def describe(self) -> dict[str, Any]:
         with self._lock:
             out: dict[str, Any] = {"epoch": self.epoch,
-                                   "active": bool(self.old_spec)}
+                                   "active": bool(self.old_spec),
+                                   "retired_epoch": self.retired_epoch}
             if self.old_spec:
                 out["fence_ms"] = self.fence_ms
                 out["old_peers"] = self.old_spec

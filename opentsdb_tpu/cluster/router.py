@@ -169,6 +169,16 @@ class ClusterRouter:
             "tsd.cluster.reshard.backfill_batch", 4000)
         self.reshard_interval_s = config.get_float(
             "tsd.cluster.reshard.interval_ms", 250.0) / 1000.0
+        # stale-copy retire pass (cluster/retire.py): after a
+        # finalized reshard, delete the moved series backfill left on
+        # former owners (reads already hide them via replicaSel —
+        # this reclaims the bytes). One bounded unit per wake.
+        from opentsdb_tpu.cluster.retire import StaleCopyRetirer
+        self.retirer = StaleCopyRetirer(self)
+        self.retire_enabled = config.get_bool(
+            "tsd.cluster.retire.enable", True)
+        self.retire_interval_s = config.get_float(
+            "tsd.cluster.retire.interval_ms", 1000.0) / 1000.0
         self._spool_dir = spool_dir or None
         workers = config.get_int("tsd.cluster.fanout_workers", 0) \
             or max(2 * len(self.peers), 4)
@@ -209,22 +219,37 @@ class ClusterRouter:
         # (peer, metric) -> (cached no-such-name 400 body, stamp);
         # holds ONLY unknown outcomes — absence means "known or
         # never asked", so the dict is bounded by actual negative
-        # knowledge, not by peers x all metrics
+        # knowledge, not by peers x all metrics. Negative knowledge
+        # still grows without bound under a probing workload (every
+        # typo'd dashboard metric mints an entry that nothing ever
+        # reads again — TTL eviction used to run only on a re-read of
+        # the SAME key), so the replay loop sweeps expired entries
+        # and a hard cap drops the oldest stamps first.
         self._sub_memo: dict[tuple[str, str], tuple] = {}
         self.sub_memo_ttl_s = config.get_float(
             "tsd.cluster.sub_memo.ttl_ms", 0.0) / 1000.0
+        self.sub_memo_max = max(config.get_int(
+            "tsd.cluster.sub_memo.max_entries", 4096), 1)
         self.sub_memo_skips = 0        # subs pre-filtered from scatters
         self.sub_memo_invalidations = 0
+        self.sub_memo_evictions = 0    # TTL sweeps + cap overflow
         # per-metric invalidation versions for the result cache (see
         # write_version): bumped AFTER a write/delete lands so a
         # racing query can never cache pre-write data under the
         # post-write version
         self._version_lock = threading.Lock()
+        # bounded: past max_entries the whole map folds into ONE
+        # global bump (conservative — every cached entry goes stale
+        # at once) and restarts empty, so an ever-new-metrics ingest
+        # stream cannot grow router memory without bound
         self._metric_versions: dict[str, int] = {}
+        self.metric_versions_max = max(config.get_int(
+            "tsd.cluster.metric_versions.max_entries", 100000), 1)
         self._global_version = 0
         self._stop = threading.Event()
         self._replay_thread: threading.Thread | None = None
         self._backfill_thread: threading.Thread | None = None
+        self._retire_thread: threading.Thread | None = None
         self._reshard_lock = threading.Lock()  # begin/finalize fence
         self._started = False
 
@@ -244,6 +269,9 @@ class ClusterRouter:
         t.start()
         if self.state.active:
             self._start_backfill()
+        elif self.retire_enabled and self.retirer.pending():
+            # a restart across an un-retired epoch resumes the pass
+            self._start_retire()
 
     def _start_backfill(self) -> None:
         t = self._backfill_thread
@@ -254,9 +282,19 @@ class ClusterRouter:
         self._backfill_thread = t
         t.start()
 
+    def _start_retire(self) -> None:
+        t = self._retire_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=self._retire_loop,
+                             name="cluster-retire", daemon=True)
+        self._retire_thread = t
+        t.start()
+
     def stop(self) -> None:
         self._stop.set()
-        for t in (self._replay_thread, self._backfill_thread):
+        for t in (self._replay_thread, self._backfill_thread,
+                  self._retire_thread):
             if t is not None and t.is_alive():
                 t.join(timeout=5)
         self.pool.shutdown(wait=False)
@@ -295,6 +333,9 @@ class ClusterRouter:
             except Exception as exc:  # noqa: BLE001 - carried across
                 results.put(("err", exc))
 
+        # tsdlint: allow[thread-lifecycle] hedge attempt: lifetime is
+        # bounded by the peer client's socket timeout — the request
+        # call cannot outlive timeout_s, so no join handle is kept
         threading.Thread(target=attempt, daemon=True).start()
         deadline = time.monotonic() + self.timeout_s + 1.0
         launched = 1
@@ -310,6 +351,8 @@ class ClusterRouter:
             except queue_mod.Empty:
                 if launched == 1 and time.monotonic() < deadline:
                     peer.hedges += 1
+                    # tsdlint: allow[thread-lifecycle] hedge twin —
+                    # socket-timeout-bounded like the primary above
                     threading.Thread(target=attempt,
                                      daemon=True).start()
                     launched = 2
@@ -328,6 +371,8 @@ class ClusterRouter:
                 # primary failed before the hedge fired: launch the
                 # backup immediately, it is the only hope left
                 peer.hedges += 1
+                # tsdlint: allow[thread-lifecycle] hedge backup —
+                # socket-timeout-bounded like the primary above
                 threading.Thread(target=attempt, daemon=True).start()
                 launched = 2
                 wait_s = deadline - time.monotonic()
@@ -457,6 +502,34 @@ class ClusterRouter:
         with self._sub_memo_lock:
             self._sub_memo[(peer_name, metric)] = \
                 (body, time.monotonic())
+
+    def sweep_sub_memo(self) -> int:
+        """Evict expired and over-cap memo entries (called from the
+        replay loop each wake, and directly by tests/ops). Read-time
+        eviction alone only covers keys that are probed AGAIN — a
+        typo'd metric nobody re-queries would pin its entry forever.
+        Over the cap, oldest stamps evict first (they are the least
+        likely to be re-probed). Returns entries dropped."""
+        now = time.monotonic()
+        dropped = 0
+        with self._sub_memo_lock:
+            if self.sub_memo_ttl_s > 0:
+                stale = [k for k, (_b, stamp)
+                         in self._sub_memo.items()
+                         if now - stamp > self.sub_memo_ttl_s]
+                for k in stale:
+                    del self._sub_memo[k]
+                dropped += len(stale)
+            over = len(self._sub_memo) - self.sub_memo_max
+            if over > 0:
+                oldest = sorted(self._sub_memo,
+                                key=lambda k:
+                                self._sub_memo[k][1])[:over]
+                for k in oldest:
+                    del self._sub_memo[k]
+                dropped += over
+            self.sub_memo_evictions += dropped
+        return dropped
 
     def invalidate_sub_memo(self, peer_name: str,
                             metrics=None) -> None:
@@ -788,6 +861,7 @@ class ClusterRouter:
 
     def _replay_loop(self) -> None:
         while not self._stop.wait(self.replay_interval_s):
+            self.sweep_sub_memo()
             for peer in list(self.peers.values()):
                 try:
                     self.drain_spool(peer)
@@ -1701,6 +1775,13 @@ class ClusterRouter:
             for m in set(metrics):
                 self._metric_versions[m] = \
                     self._metric_versions.get(m, 0) + 1
+            if len(self._metric_versions) > self.metric_versions_max:
+                # fold the per-metric knowledge into the global
+                # component: strictly conservative (any entry cached
+                # under the old tuple mismatches the new one), and
+                # the map restarts bounded
+                self._metric_versions.clear()
+                self._global_version += 1
 
     def _bump_global_version(self) -> None:
         with self._version_lock:
@@ -1886,8 +1967,14 @@ class ClusterRouter:
                 self.dirty.drop_peer(n)
                 self.invalidate_sub_memo(n)
             self._bump_global_version()
+            # the ownership map just changed: re-arm the stale-copy
+            # retire pass for this epoch (former owners still in the
+            # ring hold moved series replicaSel now hides)
+            self.retirer.reset()
         LOG.info("reshard finalized at epoch %d; ring: %s",
                  self.state.epoch, ",".join(self.ring.names))
+        if self._started and self.retire_enabled:
+            self._start_retire()
 
     def _backfill_loop(self) -> None:
         tracer = getattr(self.tsdb, "tracer", None)
@@ -1916,6 +2003,37 @@ class ClusterRouter:
             if info.get("phase") in ("done", "idle"):
                 return
 
+    def _retire_loop(self) -> None:
+        tracer = getattr(self.tsdb, "tracer", None)
+        while not self._stop.wait(self.retire_interval_s):
+            if self.old_ring is not None:
+                return  # a NEW cutover opened: finalize re-arms us
+            tctx = tracer.start_background("cluster.retire") \
+                if tracer is not None and tracer.enabled else None
+            info: dict[str, Any] = {}
+            try:
+                with trace_mod.use(tctx):
+                    info = self.retirer.step()
+                if tctx is not None:
+                    tctx.tag(phase=str(info.get("phase", "")),
+                             metric=str(info.get("metric", "")))
+                    if info.get("phase") in ("blocked", "idle"):
+                        # an idle/blocked poll is not worth a
+                        # retained trace
+                        tctx.sampled = False
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                LOG.exception("retire step failed")
+            finally:
+                if tracer is not None and tctx is not None:
+                    tracer.finish(tctx)
+            if info.get("phase") in ("done", "idle", "disabled"):
+                return
+
+    def retire_step(self) -> dict[str, Any]:
+        """One deterministic stale-copy retire unit (tests/ops; the
+        background loop drives the same step)."""
+        return self.retirer.step()
+
     def reshard_info(self) -> dict[str, Any]:
         out = self.state.describe()
         out["rf"] = self.rf
@@ -1925,6 +2043,7 @@ class ClusterRouter:
             out["old_ring"] = {"peers": list(self.old_ring.names),
                                "vnodes": self.old_ring.vnodes}
             out["backfill"] = self.backfiller.health_info()
+        out["retire"] = self.retirer.health_info()
         return out
 
     # ------------------------------------------------------------------
@@ -2113,6 +2232,7 @@ class ClusterRouter:
             "sub_memo_entries": len(self._sub_memo),
             "sub_memo_skips": self.sub_memo_skips,
             "sub_memo_invalidations": self.sub_memo_invalidations,
+            "sub_memo_evictions": self.sub_memo_evictions,
             "spool_backlog_records": sum(
                 p.spool.pending_records for p in self.peers.values()),
             "peers": {name: peer.health_info()
@@ -2138,12 +2258,20 @@ class ClusterRouter:
                          self.backfiller.backfilled_points)
         collector.record("cluster.reshard.backfilled_series",
                          self.backfiller.backfilled_series)
+        collector.record("cluster.retire.retired_series",
+                         self.retirer.retired_series)
+        collector.record("cluster.retire.queries",
+                         self.retirer.retire_queries)
+        collector.record("cluster.retire.failed_steps",
+                         self.retirer.failed_steps)
         collector.record("cluster.cache_degraded_skips",
                          self.cache_degraded_skips)
         collector.record("cluster.sub_memo.skips",
                          self.sub_memo_skips)
         collector.record("cluster.sub_memo.invalidations",
                          self.sub_memo_invalidations)
+        collector.record("cluster.sub_memo.evictions",
+                         self.sub_memo_evictions)
         for name, p in sorted(self.peers.items()):
             collector.record("cluster.forwarded_points",
                              p.forwarded_points, peer=name)

@@ -359,7 +359,12 @@ class MetricIndex:
 
     def __init__(self, metric_id: int):
         self.metric_id = metric_id
+        # tsdlint: allow[unbounded-growth] the store's own series
+        # index — bounded by live series cardinality (lifecycle
+        # releases the BUFFERS; index-row reclamation rides the
+        # demotion-aware UID reclamation ROADMAP item)
         self.series_ids: list[int] = []
+        # tsdlint: allow[unbounded-growth] see series_ids
         self._tag_rows: list[tuple[int, int, int]] = []  # (sid, tagk, tagv)
         self._dirty = False
         self._sid_arr = np.empty(0, dtype=np.int64)
@@ -420,8 +425,14 @@ class TimeSeriesStore:
         self.instance_id = next(STORE_INSTANCE_IDS)
         self.num_shards = num_shards or const.salt_buckets()
         self._lock = threading.Lock()
+        # tsdlint: allow[unbounded-growth] THE in-RAM store: bounded
+        # by live series cardinality; retention/demotion release and
+        # shrink the buffers, full row reclamation is the ROADMAP
+        # UID-reclamation item
         self._series: list[SeriesRecord] = []
+        # tsdlint: allow[unbounded-growth] see _series
         self._key_to_sid: dict[tuple, int] = {}
+        # tsdlint: allow[unbounded-growth] see _series
         self._metric_index: dict[int, MetricIndex] = {}
         self.points_written = 0
         # bumped on destructive ops (delete_range); together with

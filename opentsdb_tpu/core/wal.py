@@ -110,9 +110,12 @@ class _WalBatch:
     __slots__ = ("records", "nbytes", "sync_wanted", "known")
 
     def __init__(self):
+        # tsdlint: allow[unbounded-growth] request-scoped buffer: the
+        # batch object dies at WriteAheadLog.batch() scope exit
         self.records: list[tuple[int, bytes]] = []
         self.nbytes = 0
         self.sync_wanted = False
+        # tsdlint: allow[unbounded-growth] request-scoped (see records)
         self.known: set[tuple[str, int]] = set()
 
 
@@ -134,9 +137,18 @@ class WriteAheadLog:
         self._seq = 0
         self._written = 0   # bytes appended to current segment
         self._synced_seq = 0
+        # tsdlint: allow[unbounded-growth] series-identity mirror of
+        # the store index — bounded by live series cardinality, and
+        # reclaimed with it (demotion-aware UID reclamation, ROADMAP)
         self._known: set[tuple[str, int]] = set()
         self._closed = False
         self._interval_thread = None
+        # interval-mode fsync loop stop signal: close() sets it and
+        # JOINS the thread — a daemon flag alone would leave the loop
+        # (and its reference to this WAL) alive for up to a full
+        # interval after close, which the thread-lifecycle lint and
+        # the leak witness both flag on a run-forever process
+        self._interval_stop = threading.Event()
         # group commit v2: exactly one commit LEADER fsyncs at a time;
         # everyone else acknowledges by sequence (_synced_seq >= their
         # last appended record). A leader may hold a bounded commit
@@ -625,9 +637,7 @@ class WriteAheadLog:
             self.degraded = False
 
     def _interval_loop(self) -> None:
-        import time
-        while not self._closed:
-            time.sleep(self._interval_s)
+        while not self._interval_stop.wait(self._interval_s):
             try:
                 self._sync()
             except (OSError, ValueError):  # pragma: no cover
@@ -724,6 +734,13 @@ class WriteAheadLog:
 
     def close(self) -> None:
         self._closed = True
+        # stop + join the interval fsync thread FIRST, outside every
+        # lock (the loop's _sync takes them): after close() returns no
+        # thread of this WAL is alive
+        self._interval_stop.set()
+        t, self._interval_thread = self._interval_thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
         with self._commit_cond:
             # wake sync waiters so they observe _closed instead of
             # polling out their timeout

@@ -143,6 +143,9 @@ class LifecycleManager:
         self._lock = threading.Lock()
         # metric_id -> demotion boundary (ms, exclusive): raw points
         # BEFORE it have been folded into tiers and purged from raw
+        # tsdlint: allow[unbounded-growth] keyed by policied metric id
+        # (metric cardinality; persisted in lifecycle.json); reclaimed
+        # with the ROADMAP UID-reclamation item
         self._boundaries: dict[int, int] = {}
         # (metric_id, interval, agg) -> StitchedStore for the current
         # boundary; rebuilt when the boundary moves so cache keys

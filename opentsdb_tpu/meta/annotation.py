@@ -68,6 +68,9 @@ class AnnotationStore:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         # tsuid -> {start_time_sec: Annotation}
+        # tsdlint: allow[unbounded-growth] outer keys are series
+        # cardinality; entries evict through the inner-dict pops in
+        # delete()/delete_range (the alias the static pass can't see)
         self._by_tsuid: dict[str, dict[int, Annotation]] = {}
         # set by TSDB when a write-ahead log is active; edits are
         # crash-durable like the reference's HBase-backed annotations

@@ -74,6 +74,7 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "cluster.spool.replay",  # cluster/router.py spool catch-up drain
     "cluster.replica.repair",  # cluster/router.py anti-entropy pass
     "cluster.reshard.backfill",  # cluster/reshard.py moved-key copy
+    "cluster.retire",        # cluster/retire.py stale-copy delete
     "telemetry.pump",        # obs/telemetry.py self-stats ingest
     # ingest stages
     "ingest.decode",         # body parse + validate + series grouping
@@ -322,6 +323,9 @@ class TraceContext:
         self.start_epoch_ms = time.time() * 1000.0
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
+        # tsdlint: allow[unbounded-growth] capped by the tracer's
+        # tsd.trace.max_spans (overflow counted in spans_dropped),
+        # and the context dies with its request
         self.spans: list[SpanRecord] = []
         self._next_span = 0
         self.finished = False

@@ -215,6 +215,9 @@ def _agg_none(x, axis=0):
         "'none' must not be aggregated; the pipeline emits raw series")
 
 
+# tsdlint: allow[unbounded-growth] closed import-time registry:
+# populated once by the _register decorator walk below, never at
+# serve time
 _REGISTRY: dict[str, Aggregator] = {}
 
 

@@ -427,6 +427,8 @@ def detect_regular_padded(counts: np.ndarray, bucket_idx2d: np.ndarray,
     (points per bucket) or None."""
     if len(counts) == 0:
         return None
+    # tsdlint: allow[kernel-hygiene] ONE scalar probe per call (the
+    # first row's count), not a per-element pull
     p = int(counts[0])
     if p == 0 or not (counts == p).all() or \
             bucket_idx2d.shape[1] != p or p % num_buckets != 0:

@@ -50,8 +50,11 @@ class RateOptions:
         counter_max = float(2**64 - 1)
         reset = 0.0
         if len(parts) >= 2 and parts[1]:
+            # tsdlint: allow[kernel-hygiene] rate-SPEC string parse
+            # (once per query), not an array element pull
             counter_max = float(parts[1])
         if len(parts) >= 3 and parts[2]:
+            # tsdlint: allow[kernel-hygiene] spec parse, see above
             reset = float(parts[2])
         return cls(counter=counter, counter_max=counter_max,
                    reset_value=reset, drop_resets=drop)

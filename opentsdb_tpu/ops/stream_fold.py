@@ -101,6 +101,10 @@ def session_grid(sums: np.ndarray, cnts: np.ndarray, mins: np.ndarray,
     out_min = np.full_like(mins, np.inf)
     out_max = np.full_like(maxs, -np.inf)
     present = cnts > 0
+    # tsdlint: allow[kernel-hygiene] per-SERIES orchestration (the
+    # per-bucket combine inside is reduceat-vectorized); flattening
+    # the session stitch across rows is the ROADMAP item-4
+    # per-tag-session work, where S explodes to user cardinality
     for s in range(sums.shape[0]):
         idx = np.nonzero(present[s])[0]
         if not len(idx):

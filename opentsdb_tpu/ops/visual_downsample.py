@@ -77,6 +77,8 @@ def _pixel_starts(pixel_idx: np.ndarray, pixels: int, b: int
     pixels are empty by construction, identical to being invalidated.
     Consumers size their per-pixel tables off ``len(starts)``, never
     the requested pixel count."""
+    # tsdlint: allow[kernel-hygiene] one scalar probe per call (the
+    # last data-owning pixel), not a per-element pull
     n_eff = min(pixels, int(pixel_idx[-1]) + 1)
     starts = np.searchsorted(pixel_idx, np.arange(n_eff))
     occupied = np.diff(starts, append=b) > 0
@@ -241,6 +243,10 @@ def minmaxlttb_keep_mask(values2d: np.ndarray, emit2d: np.ndarray,
     arange_s = np.arange(s)
     n_eff = len(bstarts)  # trailing data-less bins are trimmed away
     for k in range(n_eff):
+        # tsdlint: allow[kernel-hygiene] O(pixel budget) LTTB bin
+        # walk — bounded by the requested pixels (<= a few thousand),
+        # never by point count; the candidate min/max preselect above
+        # already reduced per-element work vectorially
         lo, hi = int(bstarts[k]), int(bends[k])
         if hi <= lo:
             continue
@@ -301,9 +307,14 @@ def naive_m4_reference(ts_ms: np.ndarray, vals: np.ndarray,
     reproduce it exactly."""
     span = max(int(end_ms) - int(start_ms), 1)
     by_pixel: dict[int, list[int]] = {}
+    # tsdlint: allow[kernel-hygiene] DELIBERATELY scalar: this is the
+    # naive oracle the viz test battery checks the vectorized kernel
+    # against — rewriting it vectorized would test a kernel with
+    # itself; never called on the serve path
     for i in range(len(ts_ms)):
         if not emit[i]:
             continue
+        # tsdlint: allow[kernel-hygiene] naive oracle, see above
         p = (int(ts_ms[i]) - int(start_ms)) * pixels // span
         p = min(max(p, 0), pixels - 1)
         by_pixel.setdefault(p, []).append(i)

@@ -344,6 +344,8 @@ class _UidNameCache:
 
     def __init__(self, registry):
         self._reg = registry
+        # tsdlint: allow[unbounded-growth] one cache per query,
+        # garbage with the query; bounded by its result's UID count
         self._cache: dict[int, str] = {}
 
     def __call__(self, uid: int) -> str:

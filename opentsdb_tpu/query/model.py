@@ -326,7 +326,9 @@ class TSQuery:
                 "vnodes": self.replica_sel["vnodes"],
                 "rf": self.replica_sel["rf"],
                 "sets": [list(t)
-                         for t in self.replica_sel["sets"]]}}
+                         for t in self.replica_sel["sets"]],
+                **({"invert": True}
+                   if self.replica_sel.get("invert") else {})}}
                if self.replica_sel else {}),
         }
 

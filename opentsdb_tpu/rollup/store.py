@@ -35,9 +35,13 @@ class RollupStore:
         # threads snapshot the dict for the serve version
         self._tiers_lock = threading.Lock()
         # (interval, agg) -> store
+        # tsdlint: allow[unbounded-growth] keyed by configured rollup
+        # tier (interval, agg) pairs — a handful, fixed by config
         self._tiers: dict[tuple[str, str], TimeSeriesStore] = {}
         self._preagg = self._new_store()
         # (interval, agg) -> (mutation_epoch, points_written, result)
+        # tsdlint: allow[unbounded-growth] same (interval, agg)
+        # keyspace as _tiers — bounded by configured tiers
         self._has_data_cache: dict[tuple[str, str], tuple] = {}
 
     def _new_store(self) -> TimeSeriesStore:

@@ -1,27 +1,38 @@
 """tsdlint — invariant static analysis for the opentsdb_tpu tree.
 
 Eight PRs of review hardening kept finding the same defect classes by
-hand; tsdlint makes each one a checked artifact. Five AST passes over
-the package (plus the fault-arming side of the tests):
+hand; tsdlint makes each one a checked artifact. Eleven AST passes
+over the package (plus the fault-arming side of the tests):
 
-==============  ==========================================================
-pass id         invariant
-==============  ==========================================================
-lock-blocking   no blocking call (fsync/sleep/socket/subprocess/HTTP/
-                waits) while holding a lock, unless annotated
-lock-cycle      the static lock-acquisition graph has no cycles and no
-                same-lock re-entry on plain Locks
-config-keys     every ``config.get_*("tsd...")`` literal resolves to the
-                declared-key registry (utils/config.py)
-fault-sites     every fault site used in code or armed in tests resolves
-                to utils/faults.py KNOWN_SITES
-counter-export  every counter incremented is read somewhere (else it can
-                never reach /api/stats)
-swallow         no bare ``except:``; no broad ``except Exception: pass``
-trace-sites     every span name started resolves to the closed registry
-                in obs/trace.py KNOWN_SPANS; registered-but-never-started
-                names are reported stale
-==============  ==========================================================
+=================  =======================================================
+pass id            invariant
+=================  =======================================================
+lock-blocking      no blocking call (fsync/sleep/socket/subprocess/HTTP/
+                   waits) while holding a lock, unless annotated
+lock-cycle         the static lock-acquisition graph has no cycles and no
+                   same-lock re-entry on plain Locks
+config-keys        every ``config.get_*("tsd...")`` literal resolves to
+                   the declared-key registry (utils/config.py)
+fault-sites        every fault site used in code or armed in tests
+                   resolves to utils/faults.py KNOWN_SITES
+counter-export     every counter incremented is read somewhere (else it
+                   can never reach /api/stats)
+swallow            no bare ``except:``; no broad ``except Exception:
+                   pass``
+trace-sites        every span name started resolves to the closed
+                   registry in obs/trace.py KNOWN_SPANS; registered-but-
+                   never-started names are reported stale
+thread-lifecycle   every constructed Thread/Timer is provably joined on
+                   a shutdown path, or annotated with what bounds it
+                   (daemon=True alone is not a stop path)
+unbounded-growth   instance/module containers that are grown but never
+                   evicted (no pop/clear/del/maxlen/reset) are findings
+kernel-hygiene     ops/ kernels stay vectorized: no np.vectorize,
+                   .item()/float(x[...]) host syncs, or per-element
+                   range(len)-style loops
+response-contract  except-handlers in tsd//cluster/ answer structured
+                   errors: no send_error, no raw 5xx literals
+=================  =======================================================
 
 Suppression is two-level: an inline ``# tsdlint: allow[pass-id] why``
 on the offending (or enclosing ``with``/``except``) line for
@@ -39,19 +50,22 @@ import os
 from dataclasses import dataclass, field
 
 from opentsdb_tpu.tools.tsdlint import (config_keys, counters,
-                                        fault_sites, lock_discipline,
-                                        swallow, trace_sites)
+                                        fault_sites, growth, kernels,
+                                        lock_discipline, responses,
+                                        swallow, threads, trace_sites)
 from opentsdb_tpu.tools.tsdlint.base import (Finding, Source,
                                              iter_py_files)
 
 #: pass-id -> module; lock_discipline owns two ids
 PASS_MODULES = (lock_discipline, config_keys, fault_sites, counters,
-                swallow, trace_sites)
+                swallow, trace_sites, threads, growth, kernels,
+                responses)
 ALL_PASS_IDS = (lock_discipline.PASS_BLOCKING,
                 lock_discipline.PASS_CYCLE,
                 config_keys.PASS_ID, fault_sites.PASS_ID,
                 counters.PASS_ID, swallow.PASS_ID,
-                trace_sites.PASS_ID)
+                trace_sites.PASS_ID, threads.PASS_ID,
+                growth.PASS_ID, kernels.PASS_ID, responses.PASS_ID)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))          # .../opentsdb_tpu
@@ -86,12 +100,23 @@ def load_baseline(path: str | None) -> set[str]:
 
 def run_tsdlint(package_paths=None, test_paths=None,
                 baseline_path: str | None = DEFAULT_BASELINE,
-                pass_ids=None, root: str = DEFAULT_ROOT) -> Report:
+                pass_ids=None, root: str = DEFAULT_ROOT,
+                only_rels=None) -> Report:
     """Run the selected passes; returns a :class:`Report`.
 
     ``package_paths`` default to the installed ``opentsdb_tpu``
     package; ``test_paths`` default to a sibling ``tests/`` directory
     when one exists (only the fault-sites pass reads tests).
+
+    ``only_rels`` (an iterable of fingerprint-relative paths)
+    restricts *reporting* to those files while the ANALYSIS still
+    spans the whole package — the cross-file passes (counter-export
+    loads, the lock graph, trace-site staleness, growth eviction
+    evidence) need global context, so a truly file-scoped run would
+    invent findings that don't exist. This is the ``--changed-only``
+    seam: full-fidelity analysis, diff-scoped report. Stale-baseline
+    reporting is suppressed in this mode (a fingerprint outside the
+    changed set still fires on the full run).
     """
     if package_paths is None:
         package_paths = [_PKG_ROOT]
@@ -117,6 +142,11 @@ def run_tsdlint(package_paths=None, test_paths=None,
                 report.findings.append(f)
     report.findings.sort(key=lambda f: (f.rel, f.line, f.pass_id))
 
+    if only_rels is not None:
+        keep = {r.replace(os.sep, "/") for r in only_rels}
+        report.findings = [f for f in report.findings
+                           if f.rel in keep]
+
     baseline = load_baseline(baseline_path)
     seen = set()
     for f in report.findings:
@@ -125,7 +155,8 @@ def run_tsdlint(package_paths=None, test_paths=None,
             report.suppressed.append(f)
         else:
             report.unsuppressed.append(f)
-    report.stale_baseline = sorted(baseline - seen)
+    report.stale_baseline = [] if only_rels is not None \
+        else sorted(baseline - seen)
     return report
 
 

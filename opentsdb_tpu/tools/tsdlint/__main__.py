@@ -2,17 +2,54 @@
 
 Exit status: 0 = clean (no unsuppressed findings), 1 = findings,
 2 = usage error.
+
+``--format=json`` emits one machine-readable document (findings with
+fingerprints + suppression state, stale baseline entries, summary) for
+CI annotation tooling; ``--changed-only`` reports only findings in
+files the git working tree changed vs HEAD (tracked modifications +
+untracked files) while the analysis still spans the whole package —
+the fast pre-commit mode.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
+import subprocess
 import sys
 
 from opentsdb_tpu.tools.tsdlint import (ALL_PASS_IDS,
                                         DEFAULT_BASELINE,
                                         DEFAULT_ROOT, run_tsdlint,
                                         write_baseline)
+
+
+def changed_rels(root: str) -> list[str] | None:
+    """Fingerprint-relative paths of .py files the working tree
+    changed vs HEAD (staged + unstaged + untracked), or None when
+    ``root`` is not a usable git work tree (the caller errors out —
+    silently linting nothing would pass every gate)."""
+    out: list[str] = []
+    # --relative: diff paths come back relative to ``root`` like the
+    # fingerprints are, not to the git toplevel — with a sub-dir root
+    # the two would never intersect and the run would silently report
+    # nothing (ls-files --others is cwd-relative already)
+    for args in (["git", "diff", "--relative", "--name-only",
+                  "HEAD", "--"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True,
+                timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.extend(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    return sorted({p.replace(os.sep, "/") for p in out})
 
 
 def main(argv=None) -> int:
@@ -42,6 +79,14 @@ def main(argv=None) -> int:
                         help="path fingerprints are made relative to")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="only print the summary line")
+    parser.add_argument("--format", default="text",
+                        choices=("text", "json"),
+                        help="output format (json = one machine-"
+                             "readable document)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only findings in files changed "
+                             "vs git HEAD (analysis still spans the "
+                             "whole package); fast pre-commit mode")
     args = parser.parse_args(argv)
 
     pass_ids = None
@@ -52,14 +97,34 @@ def main(argv=None) -> int:
         if unknown:
             parser.error(f"unknown pass id(s): {sorted(unknown)}")
 
+    only_rels = None
+    if args.changed_only:
+        only_rels = changed_rels(args.root)
+        if only_rels is None:
+            parser.error(f"--changed-only: {args.root} is not a "
+                         f"usable git work tree")
+        if not only_rels:
+            # nothing changed: vacuously clean, and say so in the
+            # requested format
+            if args.format == "json":
+                print(json.dumps({"findings": [],
+                                  "stale_baseline": [],
+                                  "summary": {"unsuppressed": 0,
+                                              "suppressed": 0,
+                                              "stale_baseline": 0,
+                                              "changed_only": True}}))
+            else:
+                print("tsdlint: no changed .py files vs HEAD")
+            return 0
+
     report = run_tsdlint(
         package_paths=args.paths or None,
         test_paths=args.tests,
         baseline_path=None if args.no_baseline else args.baseline,
-        pass_ids=pass_ids, root=args.root)
+        pass_ids=pass_ids, root=args.root, only_rels=only_rels)
 
     if args.write_baseline:
-        if args.paths or args.tests or pass_ids:
+        if args.paths or args.tests or pass_ids or args.changed_only:
             # the baseline file is shared by every pass and path:
             # rewriting it from a subset run would silently drop all
             # the other entries and fail the next full-tree gate
@@ -70,6 +135,23 @@ def main(argv=None) -> int:
               f"{args.baseline}")
         return 0
 
+    if args.format == "json":
+        suppressed_fps = {f.fingerprint for f in report.suppressed}
+        print(json.dumps({
+            "findings": [{
+                "pass": f.pass_id, "path": f.rel, "line": f.line,
+                "message": f.message, "detail": f.detail,
+                "fingerprint": f.fingerprint,
+                "suppressed": f.fingerprint in suppressed_fps,
+            } for f in report.findings],
+            "stale_baseline": report.stale_baseline,
+            "summary": {
+                "unsuppressed": len(report.unsuppressed),
+                "suppressed": len(report.suppressed),
+                "stale_baseline": len(report.stale_baseline),
+                "changed_only": bool(args.changed_only),
+            }}, indent=2))
+        return 1 if report.unsuppressed else 0
     if not args.quiet:
         for f in report.unsuppressed:
             print(f)

@@ -1,0 +1,190 @@
+"""Pass ``unbounded-growth``: grown state must have a reachable
+eviction.
+
+The north star is "millions of users, runs forever": a single
+per-(peer, metric) dict on the ingest or query path that is inserted
+into but never evicted is a slow-motion OOM no test catches — the
+suite runs minutes, the leak needs weeks. The rule:
+
+- a **tracked container** is an instance attribute or module-level
+  name bound to an empty ``dict``/``list``/``set``/``deque``/
+  ``defaultdict``/``OrderedDict`` constructor (a ``deque(maxlen=...)``
+  is bounded at construction and never tracked);
+- a **growth site** is a subscript store (``x[k] = v``), an
+  ``append``/``add``/``appendleft``/``insert``/``extend``/
+  ``setdefault``/``update`` call, or a ``+=`` on it, *outside*
+  ``__init__`` and module level (one-time construction of static
+  tables is not growth);
+- **eviction evidence** — collected package-wide by attribute name,
+  like ``counter-export`` collects loads, because several structures
+  are evicted by their owner's parent — is a ``pop``/``popitem``/
+  ``popleft``/``clear``/``remove``/``discard`` call, a ``del x[k]``,
+  a re-assignment outside ``__init__`` (reset idiom), or a slice
+  assignment.
+
+A container with growth sites and no eviction evidence is a finding
+at its construction site. Deliberately unbounded state (the UID
+forward/reverse maps — reference parity, reclamation is a ROADMAP
+item) carries ``# tsdlint: allow[unbounded-growth] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "unbounded-growth"
+
+_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+          "OrderedDict", "Counter"}
+_GROW_METHODS = {"append", "add", "appendleft", "insert", "extend",
+                 "setdefault", "update"}
+_EVICT_METHODS = {"pop", "popitem", "popleft", "clear", "remove",
+                  "discard"}
+
+
+def _ctor_of(value: ast.AST) -> str | None:
+    """The tracked-container constructor name, or None. A ``deque``
+    (or any ctor) with a ``maxlen=`` kwarg is bounded -> None."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)) and not (
+            getattr(value, "keys", None) or
+            getattr(value, "elts", None)):
+        return type(value).__name__.lower()
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name in _CTORS:
+            if any(kw.arg == "maxlen" for kw in value.keywords):
+                return None
+            if value.args:
+                return None  # seeded copy — bounded by its source
+            return name
+    return None
+
+
+def _terminal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    # attr/name -> [(src, line, owner)] construction sites
+    tracked: dict[str, list] = {}
+    grown: set[str] = set()
+    evicted: set[str] = set()
+    for src in package_sources:
+        # enclosing-function map (innermost wins, see swallow.py)
+        func_of: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    func_of[id(sub)] = node.name
+        class_of: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    class_of[id(sub)] = node.name
+        # construction-time helpers: ``self._build()``-style methods
+        # invoked from __init__ populate static tables — growth there
+        # is one-time, not per-request (one level deep, the idiom)
+        init_helpers: set[str] = {"__init__", "__new__"}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node.name == "__init__":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            isinstance(sub.func.value, ast.Name) and \
+                            sub.func.value.id == "self":
+                        init_helpers.add(sub.func.attr)
+        for node in ast.walk(src.tree):
+            fname = func_of.get(id(node))
+            in_init = fname in init_helpers or fname is None
+            # -- construction sites
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = list(node.targets), node.value
+                # tuple swaps (`threads, self._threads = ..., []`)
+                # flatten elementwise: the attr element is a reset
+                if len(targets) == 1 and \
+                        isinstance(targets[0], ast.Tuple):
+                    targets = list(targets[0].elts)
+                    value = None  # per-element ctor pairing unsafe
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                targets, value = [node.target], node.value
+            for target in targets:
+                name = _terminal(target)
+                if name is None:
+                    continue
+                is_attr = isinstance(target, ast.Attribute)
+                ctor = _ctor_of(value) if value is not None else None
+                if ctor is not None:
+                    # canonical homes only: instance attrs built in
+                    # __init__, and true module-level globals.
+                    # Function locals die with their frame; class-body
+                    # tables are static.
+                    if is_attr and fname in ("__init__", "__new__"):
+                        owner = class_of.get(id(node), "<module>")
+                        tracked.setdefault(name, []).append(
+                            (src, node.lineno, owner, ctor))
+                    elif not is_attr and fname is None and \
+                            id(node) not in class_of:
+                        tracked.setdefault(name, []).append(
+                            (src, node.lineno, "<module>", ctor))
+                if is_attr and fname is not None and \
+                        fname not in init_helpers:
+                    evicted.add(name)  # reset idiom (self.x = ...)
+            # -- growth + eviction sites
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        name = _terminal(target.value)
+                        if name is not None:
+                            if isinstance(target.slice, ast.Slice):
+                                evicted.add(name)  # x[:] = trunc
+                            elif not in_init:
+                                grown.add(name)
+            elif isinstance(node, ast.AugAssign):
+                name = _terminal(node.target)
+                if name is not None and not in_init:
+                    grown.add(name)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        name = _terminal(t.value)
+                        if name is not None:
+                            evicted.add(name)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                name = _terminal(node.func.value)
+                if name is None:
+                    continue
+                if node.func.attr in _EVICT_METHODS:
+                    evicted.add(name)
+                elif node.func.attr in _GROW_METHODS and not in_init:
+                    grown.add(name)
+    findings: list[Finding] = []
+    for name, sites in sorted(tracked.items()):
+        if name not in grown or name in evicted:
+            continue
+        for src, line, owner, ctor in sites:
+            if src.allowed(PASS_ID, line):
+                continue
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, line,
+                f"{owner}.{name} ({ctor}) is grown outside __init__ "
+                f"but nothing in the package ever evicts it (no "
+                f"pop/clear/del/maxlen/reset) — unbounded growth on "
+                f"a run-forever process; bound it or annotate why "
+                f"its keyspace is finite",
+                detail=f"{owner}.{name}"))
+    return findings

@@ -1,0 +1,119 @@
+"""Pass ``kernel-hygiene``: hot-path kernels stay vectorized.
+
+Everything under ``opentsdb_tpu/ops/`` is hot-path kernel code — the
+vectorized folds PR 6/7/10 spent their budgets on. A per-element
+Python loop or a host-sync scalar pull quietly re-introduces the
+O(points) interpreter cost those PRs removed, and nothing fails: the
+answer is still right, just 100x slower. The vectorized-fold idiom is
+therefore a checked contract in ``ops/``:
+
+- ``np.vectorize`` / ``jnp.vectorize`` — a Python loop wearing a
+  numpy costume (the docs say so) — is flagged;
+- ``.item()`` calls and ``float(x[...])`` / ``int(x[...])`` on
+  subscripts are host syncs: on an accelerator backend each one
+  round-trips device -> host;
+- ``for ... in range(len(x))`` / ``for ... in range(x.shape[...])`` /
+  ``np.nditer(...)`` are the canonical per-element iteration shapes.
+
+Deliberate scalar tails (per-BLOCK orchestration loops, O(pixels)
+assembly over already-reduced columns) carry
+``# tsdlint: allow[kernel-hygiene] <why the trip count is small>``.
+Only files with an ``ops`` path segment are scanned.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "kernel-hygiene"
+
+
+def _in_scope(rel: str) -> bool:
+    return "ops" in rel.split("/")
+
+
+def _terminal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_range_len(call: ast.AST) -> bool:
+    """``range(len(x))`` / ``range(x.shape[i])`` (any arg position,
+    covering ``range(1, len(x))`` countdown variants too)."""
+    if not (isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Name)
+            and call.func.id == "range"):
+        return False
+    for arg in call.args:
+        if isinstance(arg, ast.Call) and \
+                isinstance(arg.func, ast.Name) and \
+                arg.func.id == "len":
+            return True
+        if isinstance(arg, ast.Subscript) and \
+                _terminal(arg.value) == "shape":
+            return True
+        if isinstance(arg, ast.Attribute) and arg.attr == "size":
+            return True
+    return False
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in package_sources:
+        if not _in_scope(src.rel):
+            continue
+        func_of: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    func_of[id(sub)] = node.name
+
+        def flag(node, kind: str, msg: str) -> None:
+            if src.allowed(PASS_ID, node.lineno):
+                return
+            where = func_of.get(id(node), "<module>")
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, node.lineno,
+                f"{msg} in kernel function {where}() — ops/ is "
+                f"hot-path vectorized code; lift it to an array op "
+                f"or annotate why the trip count/sync is bounded",
+                detail=f"{where}:{kind}"))
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr == "vectorize":
+                        flag(node, "vectorize",
+                             "np.vectorize is a per-element Python "
+                             "loop in numpy costume")
+                    elif fn.attr == "item" and not node.args:
+                        flag(node, "item",
+                             ".item() is a host-sync scalar pull")
+                    elif fn.attr == "nditer":
+                        flag(node, "loop",
+                             "np.nditer is per-element iteration")
+                elif isinstance(fn, ast.Name) and \
+                        fn.id in ("float", "int") and \
+                        len(node.args) == 1 and \
+                        isinstance(node.args[0], ast.Subscript) and \
+                        not isinstance(node.args[0].value, ast.Call):
+                    # a Call base (`float(spec.split('#')[1])`) is the
+                    # string spec-parse idiom, not an array pull
+                    flag(node, "host-scalar",
+                         f"{fn.id}(x[...]) is a host-sync scalar "
+                         f"pull per element")
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if _is_range_len(it):
+                    flag(node if isinstance(node, ast.For) else it,
+                         "loop",
+                         "for-over-range(len/shape) is per-element "
+                         "Python iteration over an array")
+    return findings

@@ -1,0 +1,132 @@
+"""Pass ``response-contract``: error answers stay structured.
+
+PR 1 established the idiom every later PR leaned on: failures on the
+serve path answer with a *structured* JSON error body (the
+serializer's ``format_error`` / the shed helpers, with
+``Retry-After`` where applicable) — never a bare ``send_error`` and
+never a hand-rolled 5xx literal. Operators alert on the structured
+shape; a raw 500 string is invisible to them and to the chaos
+batteries' "never an unstructured 5xx" oracles. The rule, scoped to
+``tsd/`` and ``cluster/`` (the HTTP-answering tiers):
+
+- any ``send_error(...)`` call is a finding (the stdlib
+  ``BaseHTTPRequestHandler`` idiom — raw HTML body, wrong shape);
+- inside an ``except`` handler, an ``HttpResponse(5xx, body)``
+  whose body is a string/bytes literal (or ``literal.encode()``)
+  is a finding: 5xx bodies must be built by ``format_error`` /
+  ``json.dumps`` of an error object, so the shape cannot drift.
+
+4xx literals are deliberately out of scope (protocol-framing
+refusals before a serializer exists legitimately hand-build them);
+a 5xx literal that is genuinely pre-serializer carries
+``# tsdlint: allow[response-contract] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "response-contract"
+
+_APPROVED_BUILDERS = {"format_error", "dumps"}
+
+
+def _in_scope(rel: str) -> bool:
+    parts = rel.split("/")
+    return "tsd" in parts or "cluster" in parts
+
+
+def _status_of(call: ast.Call) -> int | None:
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, int):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "status" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, int):
+            return kw.value.value
+    return None
+
+
+def _body_of(call: ast.Call) -> ast.AST | None:
+    if len(call.args) > 1:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "body":
+            return kw.value
+    return None
+
+
+def _literal_body(body: ast.AST) -> bool:
+    """True when the body is a raw literal shape: a str/bytes
+    constant, an f-string, or ``<literal>.encode()``."""
+    if isinstance(body, ast.Constant) and \
+            isinstance(body.value, (str, bytes)):
+        return True
+    if isinstance(body, ast.JoinedStr):
+        return True
+    if isinstance(body, ast.Call) and \
+            isinstance(body.func, ast.Attribute) and \
+            body.func.attr == "encode":
+        return _literal_body(body.func.value) or \
+            isinstance(body.func.value, ast.BinOp)
+    if isinstance(body, ast.BinOp):  # b"..." + var + b"..."
+        return _literal_body(body.left) or _literal_body(body.right)
+    return False
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in package_sources:
+        if not _in_scope(src.rel):
+            continue
+        func_of: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    func_of[id(sub)] = node.name
+        except_of: dict[int, ast.ExceptHandler] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ExceptHandler):
+                for sub in ast.walk(node):
+                    except_of[id(sub)] = node
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            where = func_of.get(id(node), "<module>")
+            if name == "send_error":
+                if src.allowed(PASS_ID, node.lineno):
+                    continue
+                findings.append(Finding(
+                    PASS_ID, src.path, src.rel, node.lineno,
+                    f"send_error() in {where}() answers a raw "
+                    f"unstructured error — route it through the "
+                    f"serializer's format_error / the shed helpers",
+                    detail=f"{where}:send_error"))
+                continue
+            if name != "HttpResponse":
+                continue
+            handler = except_of.get(id(node))
+            if handler is None:
+                continue  # only except-handler answers are in scope
+            status = _status_of(node)
+            if status is None or status < 500:
+                continue
+            body = _body_of(node)
+            if body is None or not _literal_body(body):
+                continue  # built by format_error/json.dumps/variable
+            if src.allowed(PASS_ID, node.lineno, handler.lineno):
+                continue
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, node.lineno,
+                f"except-handler in {where}() answers a raw "
+                f"{status} literal — 5xx bodies must be structured "
+                f"(format_error / json.dumps of an error object), "
+                f"the PR-1 shed idiom",
+                detail=f"{where}:{status}"))
+    return findings

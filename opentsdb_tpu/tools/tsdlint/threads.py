@@ -1,0 +1,256 @@
+"""Pass ``thread-lifecycle``: every thread must be provably stopped.
+
+The TSD is a run-forever process: a ``threading.Thread``/``Timer``
+whose stop path nobody wrote keeps its target object (and whatever the
+closure captures — a TSDB, a socket, a spool) alive after shutdown,
+and a restart-heavy test suite or an embedding process accumulates
+them without bound. The rule:
+
+- a constructed thread is **provably stopped** when a reachable
+  ``<handle>.join(...)`` exists in the same file for the local name /
+  instance attribute the thread object flows into (through the
+  codebase's alias idioms: ``t, self._thread = self._thread, None``
+  tuple swaps, ``for t in threads:`` iteration, plain
+  ``x = self._thread`` aliasing);
+- anything else — fire-and-forget ``Thread(...).start()``, a handle
+  returned to a caller, a stored-but-never-joined attribute — is a
+  finding. ``daemon=True`` alone is NOT enough: a daemon thread dies
+  with the *process*, not with the object that spawned it, so a
+  deliberate daemon needs an inline
+  ``# tsdlint: allow[thread-lifecycle] <why bounded>`` stating what
+  bounds its lifetime.
+
+The runtime complement is the thread/fd leak witness
+(:mod:`opentsdb_tpu.tools.tsdlint.witness` ``LeakWitness``), which
+catches the leaks this lexical analysis cannot see (a join() that is
+reachable but never actually runs).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "thread-lifecycle"
+
+_THREAD_CTORS = {"Thread", "Timer"}
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _THREAD_CTORS
+    if isinstance(fn, ast.Name):
+        return fn.id in _THREAD_CTORS
+    return False
+
+
+def _terminal(node: ast.AST) -> str | None:
+    """The terminal component of a Name/Attribute chain
+    (``self._threads`` -> ``_threads``, ``t`` -> ``t``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _thread_name_literal(call: ast.Call) -> str | None:
+    """The ``name=`` kwarg's literal (or f-string literal head)."""
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        if isinstance(kw.value, ast.Constant):
+            return str(kw.value.value)
+        if isinstance(kw.value, ast.JoinedStr) and kw.value.values \
+                and isinstance(kw.value.values[0], ast.Constant):
+            return str(kw.value.values[0].value)
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _collect_file_facts(tree: ast.Module, enclosing: dict):
+    """(file-wide joined ATTR names, per-function joined LOCAL names).
+
+    A join on an attribute base (``self._thread.join()``) marks the
+    attr joined for the whole file — start() and stop() live in
+    different methods by design. A join on a bare local (``t.join()``)
+    only counts inside its own function: a local named ``t`` in one
+    method must never absolve an unrelated ``t`` in another. Alias
+    pairs (plain/tuple assignments, ``for`` targets over handle
+    containers) propagate join-ness backwards to a fixed point, so
+    the codebase's swap idioms resolve::
+
+        t, self._thread = self._thread, None ; t.join()
+        threads, self._threads = self._threads, [] ;
+        for t in threads: t.join()
+    """
+    joined_attrs: set[str] = set()
+    # func id (or None at module level) -> joined local names
+    joined_local: dict = {}
+    # (func id, alias local) -> [(source terminal, source is attr)]
+    aliases: list[tuple] = []
+
+    def fid(node) -> int | None:
+        f = enclosing.get(id(node))
+        return id(f) if f is not None else None
+
+    def add_alias(scope, t_el, v_el) -> None:
+        t = _terminal(t_el)
+        if t is None or not isinstance(t_el, ast.Name):
+            return  # only locals alias; attr targets are stores
+        if isinstance(v_el, (ast.Tuple, ast.List)):
+            for el in v_el.elts:
+                add_alias(scope, t_el, el)
+            return
+        v = _terminal(v_el)
+        if v is not None:
+            aliases.append((scope, t, v,
+                            isinstance(v_el, ast.Attribute)))
+
+    for node in ast.walk(tree):
+        scope = fid(node)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            base = node.func.value
+            name = _terminal(base)
+            if name is None:
+                continue
+            if isinstance(base, ast.Attribute):
+                joined_attrs.add(name)
+            else:
+                joined_local.setdefault(scope, set()).add(name)
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Tuple) and \
+                        isinstance(node.value, ast.Tuple) and \
+                        len(target.elts) == len(node.value.elts):
+                    for t_el, v_el in zip(target.elts,
+                                          node.value.elts):
+                        add_alias(scope, t_el, v_el)
+                else:
+                    add_alias(scope, target, node.value)
+        elif isinstance(node, ast.For):
+            if isinstance(node.target, ast.Name):
+                add_alias(scope, node.target, node.iter)
+    changed = True
+    while changed:
+        changed = False
+        for scope, alias, source, src_is_attr in aliases:
+            if alias not in joined_local.get(scope, ()):
+                continue
+            if src_is_attr:
+                if source not in joined_attrs:
+                    joined_attrs.add(source)
+                    changed = True
+            elif source not in joined_local.get(scope, set()):
+                joined_local.setdefault(scope, set()).add(source)
+                changed = True
+    return joined_attrs, joined_local
+
+
+def _flow_targets(func: ast.AST, call: ast.Call
+                  ) -> tuple[set[str], set[str]]:
+    """(local names, attr names) the constructed thread object flows
+    into inside its enclosing function: the assigned local, every
+    attr that local is re-assigned to, and any container it is
+    ``append``ed to."""
+    locals_: set[str] = set()
+    attrs: set[str] = set()
+
+    def note(target: ast.AST) -> None:
+        t = _terminal(target)
+        if t is None:
+            return
+        if isinstance(target, ast.Attribute):
+            attrs.add(t)
+        else:
+            locals_.add(t)
+
+    local: str | None = None
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for target in node.targets:
+                note(target)
+                if isinstance(target, ast.Name):
+                    local = target.id
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "add") and \
+                node.args:
+            arg = node.args[0]
+            if arg is call or (local is not None
+                               and isinstance(arg, ast.Name)
+                               and arg.id == local):
+                note(node.func.value)
+    if local is not None:
+        # second pass: attrs the LOCAL flows into (self.X = t)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == local:
+                for target in node.targets:
+                    note(target)
+    return locals_, attrs
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in package_sources:
+        # map each ctor call to its innermost enclosing function
+        enclosing: dict[int, ast.AST] = {}
+        func_name: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    enclosing[id(sub)] = node
+                    func_name[id(sub)] = node.name
+        joined_attrs, joined_local = _collect_file_facts(
+            src.tree, enclosing)
+        for node in ast.walk(src.tree):
+            if not _is_thread_ctor(node):
+                continue
+            func = enclosing.get(id(node))
+            if func is not None:
+                flow_locals, flow_attrs = _flow_targets(func, node)
+            else:
+                flow_locals, flow_attrs = set(), set()
+            if flow_attrs & joined_attrs or \
+                    flow_locals & joined_local.get(
+                        id(func) if func is not None else None,
+                        set()):
+                continue  # provably joined through a local/attr alias
+            if src.allowed(PASS_ID, node.lineno):
+                continue
+            where = func_name.get(id(node), "<module>")
+            daemon = _is_daemon(node)
+            tname = _thread_name_literal(node)
+            flows = flow_locals | flow_attrs
+            handle = (f"stored in {sorted(flows)}" if flows
+                      else "never stored (fire-and-forget handle)")
+            if daemon:
+                why = ("daemon=True is not a stop path — it outlives "
+                       "the object that spawned it until process "
+                       "exit; annotate what bounds its lifetime with "
+                       "`# tsdlint: allow[thread-lifecycle] why` or "
+                       "join it on the shutdown path")
+            else:
+                why = ("no reachable .join() found for it in this "
+                       "file — a shutdown leaves it running forever")
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, node.lineno,
+                f"thread {tname or '<unnamed>'!r} constructed in "
+                f"{where}() is {handle}; {why}",
+                detail=f"{where}:{tname or 'unnamed'}"))
+    return findings

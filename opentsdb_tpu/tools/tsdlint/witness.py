@@ -1,4 +1,12 @@
-"""Runtime lock-order witness: the dynamic half of lock discipline.
+"""Runtime witnesses: lock order, and thread/fd lifecycle.
+
+Two opt-in runtime complements to the static passes live here: the
+**lock-order witness** (below) for ``lock-cycle``, and the
+**thread/fd leak witness** (:class:`LeakWitness`, the runtime half of
+``thread-lifecycle``/``unbounded-growth``) — snapshot threads + open
+fds at install, assert both converge back after server/cluster
+teardown, and name the allocation site of any leaker. Env opt-ins:
+``TSD_LOCK_WITNESS=1`` / ``TSD_LEAK_WITNESS=1``.
 
 The static ``lock-cycle`` pass only sees LEXICALLY nested
 acquisitions; an ABBA deadlock assembled across method calls (thread
@@ -27,6 +35,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 import traceback
 
 _REAL_LOCK = threading.Lock
@@ -315,6 +324,168 @@ def install(witness: LockOrderWitness | None = None) -> _Installed:
     return _Installed(witness, prev_lock, prev_rlock)
 
 
+# ---------------------------------------------------------------------------
+# thread/fd leak witness: the runtime half of thread-lifecycle /
+# unbounded-growth
+# ---------------------------------------------------------------------------
+
+_REAL_THREAD_START = threading.Thread.start
+
+
+def _fd_snapshot() -> dict[int, str] | None:
+    """Open fds as ``{fd: readlink target}``, or None where
+    ``/proc/self/fd`` doesn't exist (non-Linux — the thread half
+    still runs). The listing's own transient fd (it points back at a
+    ``/proc/*/fd`` directory) is excluded so snapshot timing can
+    never self-report."""
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:
+        return None
+    out: dict[int, str] = {}
+    for name in fds:
+        try:
+            fd = int(name)
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue  # closed between listdir and readlink
+        if "/fd" in target and target.startswith("/proc"):
+            continue
+        out[fd] = target
+    return out
+
+
+class LeakWitness:
+    """Snapshot live threads + open fds at install; assert both
+    CONVERGE back to the snapshot after teardown.
+
+    The static ``thread-lifecycle`` pass proves a join() is
+    *reachable*; this witness proves it actually *ran* — and catches
+    the classes statics cannot see: an fd opened per request and
+    closed on all but one error path, a daemon thread whose stop
+    flag nobody sets, an executor that outlives its owner. Threads
+    started while installed carry their allocation site (the
+    patched ``Thread.start`` stamps a stack summary), so a leak
+    report names WHO started the thread, not just its name. New fds
+    are named by their readlink target (file path / socket inode).
+
+    Teardown asserts with a deadline + poll, not a point check:
+    executor shutdown(wait=False) threads and asyncio selector fds
+    close asynchronously moments after their owners — only what
+    SURVIVES the deadline is a leak.
+    """
+
+    def __init__(self, max_stack: int = 12):
+        self.max_stack = max_stack
+        # STRONG references on purpose: a baseline-by-id() set would
+        # let a GC'd baseline thread's reused address mask a real
+        # leak; the objects are tiny and the witness is module-scoped
+        self.baseline_threads: set[threading.Thread] = set()
+        self.baseline_fds: dict[int, str] | None = None
+        self.fd_checks = True
+        self.snapshot()
+
+    def snapshot(self) -> None:
+        self.baseline_threads = set(threading.enumerate())
+        self.baseline_fds = _fd_snapshot()
+
+    # -- current state -------------------------------------------------
+
+    def leaked_threads(self) -> list[threading.Thread]:
+        return [t for t in threading.enumerate()
+                if t.is_alive() and t not in self.baseline_threads]
+
+    def leaked_fds(self) -> dict[int, str]:
+        if self.baseline_fds is None or not self.fd_checks:
+            return {}
+        now = _fd_snapshot()
+        if now is None:
+            return {}
+        return {fd: target for fd, target in now.items()
+                if self.baseline_fds.get(fd) != target}
+
+    @staticmethod
+    def allocation_site(thread: threading.Thread) -> str:
+        site = getattr(thread, "_tsd_leak_site", None)
+        if site is None:
+            return "<started before the leak witness installed>"
+        return "\n".join(f"  {fn}:{ln} in {name}"
+                         for fn, ln, name in site)
+
+    # -- the teardown gate ---------------------------------------------
+
+    def assert_converged(self, timeout_s: float = 10.0,
+                         poll_s: float = 0.05) -> None:
+        """Block until every thread started since install has exited
+        and every fd opened since install has closed, or raise
+        ``AssertionError`` naming each leaker and (for threads) the
+        stack that started it."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            threads = self.leaked_threads()
+            fds = self.leaked_fds()
+            if not threads and not fds:
+                return
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(poll_s)
+        lines = [f"leak witness: {len(threads)} thread(s) and "
+                 f"{len(fds)} fd(s) survived teardown by "
+                 f"{timeout_s:.0f}s:"]
+        for t in threads:
+            lines.append(f"\nthread {t.name!r} (daemon={t.daemon}) "
+                         f"started at:\n{self.allocation_site(t)}")
+        for fd, target in sorted(fds.items()):
+            lines.append(f"\nfd {fd} -> {target}")
+        raise AssertionError("\n".join(lines))
+
+
+class _LeakInstalled:
+    """Handle returned by :func:`install_leak`."""
+
+    def __init__(self, witness: LeakWitness, prev_start):
+        self.witness = witness
+        self._prev_start = prev_start
+
+    def uninstall(self) -> None:
+        threading.Thread.start = self._prev_start
+
+    def __enter__(self) -> LeakWitness:
+        return self.witness
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def _capture_site(max_stack: int) -> tuple:
+    out = []
+    f = sys._getframe(2)
+    while f is not None and len(out) < max_stack:
+        code = f.f_code
+        if "tsdlint/witness" not in code.co_filename:
+            out.append((code.co_filename, f.f_lineno, code.co_name))
+        f = f.f_back
+    return tuple(out)
+
+
+def install_leak(witness: LeakWitness | None = None) -> _LeakInstalled:
+    """Patch ``threading.Thread.start`` to stamp each started
+    thread's allocation site, and snapshot the current thread/fd
+    population as the convergence baseline. ``uninstall()`` restores
+    the previous ``start`` (stamped threads keep their sites)."""
+    witness = witness or LeakWitness()
+    prev_start = threading.Thread.start
+
+    def start(self):  # noqa: ANN001 - bound method signature
+        self._tsd_leak_site = _capture_site(witness.max_stack)
+        return prev_start(self)
+
+    threading.Thread.start = start
+    return _LeakInstalled(witness, prev_start)
+
+
 # env-gated opt-in for ad-hoc runs (the batteries install explicitly)
 if os.environ.get("TSD_LOCK_WITNESS", "") not in ("", "0", "false"):
     _AMBIENT = install()  # pragma: no cover - env-driven
+if os.environ.get("TSD_LEAK_WITNESS", "") not in ("", "0", "false"):
+    _AMBIENT_LEAK = install_leak()  # pragma: no cover - env-driven

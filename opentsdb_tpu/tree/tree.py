@@ -178,7 +178,11 @@ class Branch:
         self.tree_id = tree_id
         self.path = path
         self.display_name = display_name
+        # tsdlint: allow[unbounded-growth] the tree index itself
+        # (reference parity: Branch.java) — bounded by series
+        # cardinality via the tree's own rule set
         self.branches: dict[str, Branch] = {}
+        # tsdlint: allow[unbounded-growth] see branches
         self.leaves: dict[str, Leaf] = {}
 
     @property

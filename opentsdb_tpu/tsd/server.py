@@ -46,6 +46,18 @@ def _api_endpoint(path: str) -> str:
     return parts[0] if parts else ""
 
 
+def _structured_error(status: int, message: str,
+                      details: str = "") -> HttpResponse:
+    """A PR-1-shaped structured error body for the server framing
+    layer, which answers before any serializer is bound (the
+    serializer-owning twin is ``format_error``). Built by json.dumps
+    so the shape can never drift from what operators alert on."""
+    doc: dict = {"error": {"code": status, "message": message}}
+    if details:
+        doc["error"]["details"] = details
+    return HttpResponse(status, json.dumps(doc).encode())
+
+
 def _is_query_path(path: str) -> bool:
     """True for the endpoints ``tsd.query.timeout`` governs — the data
     query surface only (ref: the reference expires *queries*, not
@@ -681,12 +693,9 @@ class TSDServer:
                             # the worker thread finishes in the
                             # background; the client gets the
                             # reference's expiry error
-                            response = HttpResponse(
-                                504,
-                                ('{"error":{"code":504,"message":'
-                                 '"Query timeout exceeded ('
-                                 f'{self.query_timeout_ms}ms)"}}}}')
-                                .encode())
+                            response = _structured_error(
+                                504, "Query timeout exceeded "
+                                f"({self.query_timeout_ms}ms)")
                     else:
                         response = await fut
                 # request-level latency histograms (exported with

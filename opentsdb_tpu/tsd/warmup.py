@@ -348,6 +348,10 @@ def start_warmup_thread(tsdb) -> threading.Thread | None:
     if not tsdb.config.get_bool("tsd.tpu.warmup", True):
         return None
     tsdb._warmup_stop = threading.Event()
+    # tsdlint: allow[thread-lifecycle] the handle is RETURNED and
+    # joined by TSDServer.stop (which also sets tsdb._warmup_stop so
+    # the join never waits out a mid-JIT compile) — the join lives in
+    # another file, past this lexical pass's horizon
     t = threading.Thread(target=run_warmup, args=(tsdb,),
                          name="shape-warmup", daemon=True)
     t.start()

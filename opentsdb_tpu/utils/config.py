@@ -208,6 +208,12 @@ _DEFAULTS: dict[str, str] = {
     #   dual-writes old+new owners while moved history streams over)
     "tsd.cluster.reshard.interval_ms": "250",
     "tsd.cluster.reshard.backfill_batch": "4000",
+    #   stale-copy retire pass: after a finalized reshard, delete the
+    #   moved series backfill left on former owners (reads already
+    #   hide them via replicaSel — this reclaims the bytes); one
+    #   (shard, metric) delete unit per interval wake
+    "tsd.cluster.retire.enable": "true",
+    "tsd.cluster.retire.interval_ms": "1000",
     #   per-peer connect+read deadline; a hung shard becomes a
     #   degraded partial after this, never a stuck request
     "tsd.cluster.timeout_ms": "5000",
@@ -220,6 +226,13 @@ _DEFAULTS: dict[str, str] = {
     #   to it (0 = cache forever until invalidated; >0 adds a TTL for
     #   deployments where writes can bypass this router)
     "tsd.cluster.sub_memo.ttl_ms": "0",
+    #   hard cap on memoized unknown (peer, metric) entries — the
+    #   replay loop sweeps expired/over-cap entries (oldest first) so
+    #   a probing workload of ever-new metric names stays bounded
+    "tsd.cluster.sub_memo.max_entries": "4096",
+    #   per-metric result-cache version map cap: past it the map
+    #   folds into one global invalidation and restarts empty
+    "tsd.cluster.metric_versions.max_entries": "100000",
     #   write-forward retry ladder (reads never retry — they degrade)
     "tsd.cluster.retry.attempts": "2",
     "tsd.cluster.retry.base_ms": "25",
@@ -387,6 +400,8 @@ DYNAMIC_KEY_PREFIXES: tuple[str, ...] = (
 # runtime-registered families: dynamically loaded plugins own their
 # config namespaces (tsd.search.es.host, ...) which no static scan
 # can enumerate — the loader registers each enabled slot's prefix
+# tsdlint: allow[unbounded-growth] one prefix per ENABLED plugin
+# slot, registered at load time — bounded by the plugin config
 _RUNTIME_KEY_PREFIXES: set[str] = set()
 
 

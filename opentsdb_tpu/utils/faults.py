@@ -73,6 +73,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "cluster.peer",       # cluster/router.py any-peer exchange
     "cluster.replica",    # cluster/router.py anti-entropy repair pass
     "cluster.reshard",    # cluster/reshard.py backfill step
+    "cluster.retire",     # cluster/retire.py stale-copy delete step
 })
 
 # site families with runtime-named tails (per-peer arming)

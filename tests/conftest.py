@@ -92,6 +92,34 @@ def lock_witness():
         handle.witness.assert_clean()
 
 
+@pytest.fixture(scope="module")
+def leak_witness():
+    """Thread/fd leak witness (tools/tsdlint/witness.py LeakWitness):
+    snapshots live threads + open fds at module setup and asserts
+    both CONVERGE back after the module's servers/clusters tear down,
+    naming the allocation site of any thread that survives. The
+    concurrency and cluster batteries opt in via a module-level
+    autouse fixture — they build and tear down whole TSDServer
+    topologies, exactly where an unjoined loop or unclosed socket
+    would hide."""
+    import jax
+
+    from opentsdb_tpu.tools.tsdlint import witness as witness_mod
+
+    # force backend init BEFORE the baseline: jax opens fds/threads
+    # lazily on first use, and a module that happens to trigger that
+    # first use would otherwise "leak" process-wide backend state
+    jax.devices()
+    handle = witness_mod.install_leak()
+    try:
+        yield handle.witness
+    finally:
+        handle.uninstall()
+        # raises AssertionError naming each leaked thread (with the
+        # stack that started it) and each surviving fd
+        handle.witness.assert_converged()
+
+
 @pytest.fixture
 def tsdb():
     """A TSDB with auto-create enabled — the BaseTsdbTest analogue

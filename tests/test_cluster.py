@@ -44,11 +44,13 @@ pytestmark = pytest.mark.cluster
 
 
 @pytest.fixture(autouse=True, scope="module")
-def _witnessed(lock_witness):
-    """The chaos battery runs under the runtime lock-order witness:
-    router + spool + breaker + in-process shard locks all record
-    acquisition-order pairs; a cycle fails the module at teardown
-    with both stacks (see conftest)."""
+def _witnessed(lock_witness, leak_witness):
+    """The chaos battery runs under BOTH runtime witnesses: the
+    lock-order witness (acquisition-order cycles fail the module at
+    teardown with both stacks) and the thread/fd leak witness (every
+    thread started and fd opened by the module's routers, spools and
+    shard servers must be gone after teardown, else the module fails
+    naming the leaker's allocation site — see conftest)."""
     return lock_witness
 
 
@@ -519,6 +521,8 @@ class LivePeer:
         self.server.http_router.handle = self._orig_handle
 
     def stop(self):
+        if self.loop.is_closed():
+            return  # already stopped (a cluster teardown owns us)
         self.unhang()
         try:
             self._call(self.server.stop(), timeout=20)
@@ -2308,11 +2312,17 @@ class ReshardBase:
     N_HOSTS = 8
 
     def make_cluster(self, tmp_path, **cfg):
-        return LiveCluster(tmp_path, durable=True, **{
+        return LiveCluster(tmp_path, durable=True, peer_cfg={
+            # the stale-copy retire pass deletes through the shards'
+            # HTTP delete gate, like any cluster delete (PR-12)
+            "tsd.http.query.allow_delete": "true",
+        }, **{
             "tsd.cluster.timeout_ms": "3000",
             "tsd.cluster.breaker.reset_timeout_ms": "300",
-            # backfill stepped by hand: deterministic cutovers
+            # backfill + retire stepped by hand: deterministic
+            # cutovers and reclaim passes
             "tsd.cluster.reshard.interval_ms": "3600000",
+            "tsd.cluster.retire.interval_ms": "3600000",
             **cfg})
 
     def ingest(self, c, n_sec=40):
@@ -2840,6 +2850,294 @@ class TestRouterSuggestSearch:
             assert sorted(json.loads(r.body)) == sorted(want)
         finally:
             c.close()
+
+
+# ---------------------------------------------------------------------------
+# stale-copy retire pass (ROADMAP item 2(d)): former owners reclaim
+# the moved series backfill left behind
+# ---------------------------------------------------------------------------
+
+class TestInvertedReplicaSel:
+    def test_invert_is_the_exact_complement(self):
+        from opentsdb_tpu.cluster.replica import (parse_sel, sel_doc,
+                                                  series_mask)
+        names = ["s0", "s1", "s2"]
+        ring = HashRing(names, vnodes=16)
+        owned = [t for t in ring.replica_sets(2) if "s1" in t]
+        kid = {1: "host"}
+        vid = {i: f"h{i:02d}" for i in range(40)}
+        series = [[(1, i)] for i in range(40)]
+        pos = series_mask(
+            parse_sel(sel_doc(names, 16, 2, owned)), "c.m", series,
+            kid.__getitem__, vid.__getitem__)
+        neg = series_mask(
+            parse_sel(sel_doc(names, 16, 2, owned, invert=True)),
+            "c.m", series, kid.__getitem__, vid.__getitem__)
+        assert [not p for p in pos] == neg
+        assert any(pos) and any(neg)  # both sides non-trivial
+
+    def test_invert_rides_the_wire_and_cache_key(self):
+        from opentsdb_tpu.cluster.replica import sel_cache_key, \
+            sel_doc
+        sel = sel_doc(["a"], 8, 1, [("a",)], invert=True)
+        assert sel["invert"] is True
+        tsq = TSQuery.from_json({
+            "start": 1, "end": 2, "replicaSel": sel,
+            "queries": [{"metric": "c.m", "aggregator": "sum"}]})
+        assert tsq.replica_sel["invert"] is True
+        assert tsq.to_json()["replicaSel"]["invert"] is True
+        plain = sel_doc(["a"], 8, 1, [("a",)])
+        assert sel_cache_key(tsq.replica_sel) != \
+            sel_cache_key(dict(plain, sets=[("a",)]))
+
+
+class TestStaleCopyRetire(ReshardBase):
+    def stale_series_count(self, c) -> int:
+        """Series physically present on some shard whose CURRENT
+        replica set does not include it (what replicaSel hides and
+        retire deletes)."""
+        ring = c.router.ring
+        rf = min(c.router.rf, len(ring.names))
+        stale = 0
+        for name, peer_obj in c.router.peers.items():
+            lp = next((p for p in c.peers if p.name == name), None)
+            if lp is None:
+                continue
+            rows = lp.tsdb.execute_query(TSQuery.from_json(
+                _tsq({"aggregator": "none"},
+                     end=BASE_MS + 900_000)).validate())
+            for r in rows:
+                tags = {k: v for k, v in r.tags.items()}
+                if name not in ring.shards_for("c.m", tags, rf):
+                    stale += 1
+        return stale
+
+    def run_retire(self, c, max_steps=400):
+        phases = []
+        for _ in range(max_steps):
+            info = c.router.retire_step()
+            phases.append(info.get("phase"))
+            if info.get("phase") in ("done", "idle"):
+                return phases
+            assert info.get("phase") != "blocked", info
+        raise AssertionError("retire never completed")
+
+    ALLOW = {"tsd.http.query.allow_delete": "true"}
+
+    def test_retire_reclaims_former_owner_bytes(self, tmp_path):
+        c = self.make_cluster(tmp_path)
+        extra = LivePeer("s3", **self.ALLOW)
+        try:
+            points = self.ingest(c)
+            self.begin(c, extra)
+            self.run_backfill(c)
+            assert c.router.epoch == 1
+            c.peers.append(extra)  # joiner serves reads from now on
+            # backfill COPIES, it never purges: former owners still
+            # hold every moved series
+            before = self.stale_series_count(c)
+            assert before > 0
+            assert c.router.retirer.pending()
+            phases = self.run_retire(c)
+            assert phases[-1] == "done"
+            # every stale copy is gone, on every shard
+            assert self.stale_series_count(c) == 0
+            assert c.router.retirer.retired_series == before
+            # the pass is persisted: a fresh state object (the
+            # restart view) knows the epoch is clean
+            from opentsdb_tpu.cluster.reshard import ReshardState
+            assert c.router.state.retired_epoch == 1
+            st2 = ReshardState(str(tmp_path / "spool"))
+            assert st2.retired_epoch == 1
+            # and idempotent: the next step idles
+            assert c.router.retire_step()["phase"] == "idle"
+            # reads after the purge still equal the no-fault oracle
+            oracle = _oracle(points)
+            for p in c.peers:
+                for qspec in QUERIES[:3]:
+                    p.tsdb.execute_query(TSQuery.from_json(
+                        _tsq(qspec)).validate())
+            for i, qspec in enumerate(QUERIES[:3]):
+                body = _tsq(qspec, end=BASE_MS + 900_200 + i)
+                resp, out = c.query(body)
+                rows, degraded = _strip_marker(out)
+                assert resp.status == 200 and degraded == [], qspec
+                want = json.loads(oracle.handle(
+                    req("POST", "/api/query", body)).body)
+                assert _sorted_rows(rows) == _sorted_rows(want), qspec
+            # the admin surface reports the completed pass
+            status = json.loads(c.http.handle(
+                req("GET", "/api/cluster/reshard")).body)
+            assert status["retired_epoch"] == 1
+            assert status["retire"]["pending"] is False
+        finally:
+            c.close()
+            extra.stop()
+
+    def test_retire_never_touches_owned_series(self, tmp_path):
+        # epoch 0, nothing ever moved: a (forced) pass deletes zero
+        c = self.make_cluster(tmp_path)
+        try:
+            self.ingest(c, n_sec=20)
+            assert not c.router.retirer.pending()
+            assert c.router.retire_step()["phase"] == "idle"
+            # force a pass as if an epoch were pending: still zero
+            # deletions, because every series is where it belongs
+            c.router.state.epoch = 1
+            assert c.router.retirer.pending()
+            phases = self.run_retire(c)
+            assert phases[-1] == "done"
+            assert c.router.retirer.retired_series == 0
+        finally:
+            c.close()
+
+    def test_mark_retired_is_epoch_cas(self, tmp_path):
+        # a reshard that begins while the previous pass is finishing
+        # must NOT get its reclaim silently stamped done
+        from opentsdb_tpu.cluster.reshard import ReshardState
+        st = ReshardState(str(tmp_path))
+        st.begin("a=1:1", 8, "b=1:1", 8)   # epoch 1
+        st.finish()
+        st.begin("c=1:1", 8, "a=1:1", 8)   # epoch 2 mid-pass
+        st.finish()
+        st.mark_retired(1)                 # the epoch the pass ran
+        assert st.retired_epoch == 0       # dropped, not mis-stamped
+        st.mark_retired(2)
+        assert st.retired_epoch == 2
+
+    def test_retire_waits_for_spool_backlog(self, tmp_path):
+        # an undrained spool can re-materialize moved series on a
+        # former owner AFTER the pass — completion must wait
+        c = self.make_cluster(tmp_path)
+        try:
+            c.router.state.epoch = 1  # pretend a finalized reshard
+            peer = c.router.peers["s0"]
+            peer.spool.append(b"[]")
+            info = None
+            for _ in range(50):
+                info = c.router.retire_step()
+                if info["phase"] in ("blocked", "done"):
+                    break
+            assert info["phase"] == "blocked", info
+            assert "spool" in info.get("error", "")
+            assert c.router.state.retired_epoch == 0
+            peer.spool.replay(lambda body: None, 10)  # drain it
+            phases = self.run_retire(c)
+            assert phases[-1] == "done"
+            assert c.router.state.retired_epoch == 1
+        finally:
+            c.close()
+
+    def test_retire_parks_when_shard_delete_is_disabled(self,
+                                                        tmp_path):
+        # shards WITHOUT tsd.http.query.allow_delete: the pass parks
+        # loudly (phase "disabled", epoch stays pending) instead of
+        # hammering doomed deletes every wake
+        c = LiveCluster(tmp_path, durable=True, **{
+            "tsd.cluster.reshard.interval_ms": "3600000",
+            "tsd.cluster.retire.interval_ms": "3600000"})
+        try:
+            self.ingest(c, n_sec=10)
+            c.router.state.epoch = 1
+            assert c.router.retirer.pending()
+            info = c.router.retire_step()
+            assert info["phase"] == "disabled", info
+            assert "allow_delete" in info["error"]
+            assert c.router.state.retired_epoch == 0
+            assert c.router.retirer.pending()  # debt survives
+        finally:
+            c.close()
+
+    def test_retire_blocks_on_dead_shard_and_keeps_debt(self,
+                                                       tmp_path):
+        c = self.make_cluster(tmp_path, **{
+            "tsd.cluster.timeout_ms": "500",
+            "tsd.cluster.breaker.reset_timeout_ms": "100"})
+        extra = LivePeer("s3", **self.ALLOW)
+        try:
+            self.ingest(c, n_sec=20)
+            self.begin(c, extra)
+            self.run_backfill(c)
+            c.peers.append(extra)
+            c.peers[0].kill()
+            saw_blocked = False
+            for _ in range(40):
+                info = c.router.retire_step()
+                if info.get("phase") == "blocked":
+                    saw_blocked = True
+                    break
+                assert info.get("phase") != "done"
+            assert saw_blocked
+            # the pass did NOT mark the epoch clean
+            assert c.router.state.retired_epoch == 0
+            assert c.router.retirer.pending()
+            c.peers[0].restart()
+            time.sleep(0.15)  # let the breaker's reset window pass
+            phases = self.run_retire(c)
+            assert phases[-1] == "done"
+            assert c.router.state.retired_epoch == 1
+            assert self.stale_series_count(c) == 0
+        finally:
+            c.close()
+            extra.stop()
+
+
+class TestRouterMapsStayBounded:
+    """Regression tests for the unbounded-growth defects the new
+    tsdlint pass surfaced on the router (no live peers needed —
+    these exercise the in-memory maps only)."""
+
+    def _router(self, **cfg):
+        t = TSDB(Config(**{
+            "tsd.cluster.role": "router",
+            "tsd.cluster.peers": "s0=127.0.0.1:1,s1=127.0.0.1:2",
+            "tsd.tpu.warmup": "false", **cfg}))
+        return t, t.cluster
+
+    def test_metric_versions_fold_into_global_past_cap(self):
+        t, router = self._router(**{
+            "tsd.cluster.metric_versions.max_entries": "8"})
+        try:
+            v0 = router.write_version()
+            for i in range(100):
+                router._bump_versions([f"m.{i}"])
+            # bounded — the map folded instead of keeping 100 entries
+            assert len(router._metric_versions) <= 8
+            # and the fold invalidated conservatively: the global
+            # component moved, so any cached entry mismatches
+            assert router.write_version() != v0
+            tsq = TSQuery.from_json(
+                {"start": 1, "end": 2, "queries": [
+                    {"metric": "m.0", "aggregator": "sum"}]})
+            before = router.write_version(tsq)
+            router._bump_versions(["m.0"])
+            assert router.write_version(tsq) != before
+        finally:
+            t.shutdown()
+
+    def test_sub_memo_ttl_sweep_and_cap(self):
+        t, router = self._router(**{
+            "tsd.cluster.sub_memo.ttl_ms": "50",
+            "tsd.cluster.sub_memo.max_entries": "16"})
+        try:
+            body = (b'{"error":{"code":400,"message":"No such name '
+                    b'for \'metrics\': \'x\'"}}')
+            # entries NOBODY ever re-reads: read-time eviction alone
+            # would pin them forever
+            for i in range(64):
+                router._memo_unknown("s0", f"m.{i}", body)
+            assert len(router._sub_memo) == 64
+            # cap eviction (oldest first) without waiting for the TTL
+            dropped = router.sweep_sub_memo()
+            assert dropped >= 48
+            assert len(router._sub_memo) <= 16
+            time.sleep(0.06)
+            # TTL sweep clears the rest — no lookup required
+            router.sweep_sub_memo()
+            assert len(router._sub_memo) == 0
+            assert router.sub_memo_evictions >= 64
+        finally:
+            t.shutdown()
 
 
 @pytest.mark.slow

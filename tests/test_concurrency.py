@@ -16,10 +16,12 @@ BASE = 1356998400
 
 
 @pytest.fixture(autouse=True, scope="module")
-def _witnessed(lock_witness):
-    """Run the whole stress battery under the lock-order witness:
-    any inconsistent acquisition order across these threads fails the
-    module at teardown with both stacks (see conftest)."""
+def _witnessed(lock_witness, leak_witness):
+    """Run the whole stress battery under BOTH runtime witnesses:
+    any inconsistent lock-acquisition order across these threads
+    fails the module at teardown with both stacks, and any thread/fd
+    the battery's TSDBs leave behind fails it naming the allocation
+    site (see conftest)."""
     return lock_witness
 
 

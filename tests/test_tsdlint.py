@@ -116,6 +116,57 @@ class TestPassFixtures:
         # query.admission is synthesized inside trace.py itself
         assert "stale:query.admission" not in details
 
+    def test_thread_lifecycle(self):
+        rep = lint_fixture("fixture_thread_lifecycle.py")
+        assert [(f.pass_id, f.line, f.detail)
+                for f in rep.unsuppressed] == [
+            ("thread-lifecycle", 13, "start:fx-leak")]
+        f = rep.unsuppressed[0]
+        # the joined (tuple-swap idiom) and allow-annotated daemon
+        # threads stayed clean; the finding names the stored handle
+        assert "_runner" in f.message
+
+    def test_unbounded_growth(self):
+        rep = lint_fixture("fixture_unbounded_growth.py")
+        assert [(f.pass_id, f.line, f.detail)
+                for f in rep.unsuppressed] == [
+            ("unbounded-growth", 10, "Leaky.memo")]
+        # popped / maxlen-bounded / reset / annotated all stay clean
+
+    def test_kernel_hygiene(self):
+        rep = lint_fixture("ops/fixture_kernel_hygiene.py")
+        assert [(f.pass_id, f.line, f.detail)
+                for f in rep.unsuppressed] == [
+            ("kernel-hygiene", 10, "bad_kernel:vectorize"),
+            ("kernel-hygiene", 12, "bad_kernel:loop"),
+            ("kernel-hygiene", 13, "bad_kernel:host-scalar"),
+            ("kernel-hygiene", 14, "bad_kernel:item"),
+        ]
+
+    def test_kernel_hygiene_scope_is_ops_only(self):
+        # the same violations OUTSIDE an ops/ path segment are not
+        # kernel territory: copy the fixture next to the others
+        import shutil
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            dst = os.path.join(d, "serve_code.py")
+            shutil.copy(os.path.join(FIXTURES, "ops",
+                                     "fixture_kernel_hygiene.py"),
+                        dst)
+            rep = run_tsdlint(package_paths=[dst], test_paths=[],
+                              baseline_path=None, root=d,
+                              pass_ids=["kernel-hygiene"])
+        assert rep.unsuppressed == []
+
+    def test_response_contract(self):
+        rep = lint_fixture("tsd/fixture_response_contract.py")
+        assert [(f.pass_id, f.line, f.detail)
+                for f in rep.unsuppressed] == [
+            ("response-contract", 16, "handler:send_error"),
+            ("response-contract", 18, "handler:500"),
+        ]
+        # the format_error-built 500 and the 4xx literal stay clean
+
     def test_pass_selection(self):
         rep = lint_fixture("fixture_swallow.py",
                            pass_ids=["config-keys"])
@@ -186,6 +237,137 @@ class TestCleanTree:
 
     def test_default_baseline_exists(self):
         assert os.path.isfile(DEFAULT_BASELINE)
+
+
+# ---------------------------------------------------------------------------
+# CLI: machine-readable output + git-diff-scoped pre-commit mode
+# ---------------------------------------------------------------------------
+
+class TestCliModes:
+    def _run(self, *argv, cwd=REPO):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "opentsdb_tpu.tools.tsdlint",
+             *argv], capture_output=True, text=True, cwd=cwd,
+            env=env, timeout=300)
+
+    def test_json_format(self, tmp_path):
+        import json
+        proc = self._run(
+            os.path.join(FIXTURES, "fixture_swallow.py"),
+            "--tests", str(tmp_path), "--no-baseline",
+            "--format=json")
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["summary"]["unsuppressed"] == 2
+        assert doc["summary"]["changed_only"] is False
+        by_line = {f["line"]: f for f in doc["findings"]}
+        assert by_line[9]["pass"] == "swallow"
+        assert by_line[9]["suppressed"] is False
+        assert by_line[9]["fingerprint"].startswith("swallow:")
+        # suppressed findings still appear, marked, for CI tooling
+        clean = self._run("-q", "--format=json")
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        assert json.loads(clean.stdout)["summary"][
+            "unsuppressed"] == 0
+
+    def _git(self, cwd, *args):
+        subprocess.run(["git", *args], cwd=cwd, check=True,
+                       capture_output=True,
+                       env=dict(os.environ,
+                                GIT_AUTHOR_NAME="t",
+                                GIT_AUTHOR_EMAIL="t@t",
+                                GIT_COMMITTER_NAME="t",
+                                GIT_COMMITTER_EMAIL="t@t"))
+
+    def test_changed_only_scopes_the_report(self, tmp_path):
+        import json
+        # a tiny repo with one committed-clean file and one file
+        # that GAINS a violation after the commit
+        repo = tmp_path
+        with open(os.path.join(FIXTURES, "fixture_swallow.py"),
+                  encoding="utf-8") as fh:
+            bad = fh.read()
+        (repo / "clean.py").write_text("x = 1\n")
+        (repo / "dirty.py").write_text("y = 2\n")
+        self._git(repo, "init", "-q")
+        self._git(repo, "add", ".")
+        self._git(repo, "commit", "-qm", "seed")
+        (repo / "dirty.py").write_text(bad)
+        # full run on the same tree sees the violation...
+        full = self._run(str(repo / "dirty.py"),
+                         str(repo / "clean.py"),
+                         "--tests", str(repo), "--no-baseline",
+                         "--root", str(repo), "--format=json")
+        assert full.returncode == 1
+        # ...and so does --changed-only, scoped to dirty.py
+        proc = self._run(str(repo / "dirty.py"),
+                         str(repo / "clean.py"),
+                         "--tests", str(repo), "--no-baseline",
+                         "--root", str(repo), "--changed-only",
+                         "--format=json")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["summary"]["changed_only"] is True
+        assert {f["path"] for f in doc["findings"]} == {"dirty.py"}
+        # commit the fix-free state: nothing changed -> vacuously
+        # clean, exit 0
+        self._git(repo, "add", ".")
+        self._git(repo, "commit", "-qm", "accept")
+        proc = self._run(str(repo / "dirty.py"),
+                         "--tests", str(repo), "--no-baseline",
+                         "--root", str(repo), "--changed-only")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_changed_only_with_subdirectory_root(self, tmp_path):
+        # `git diff` prints toplevel-relative paths; the fingerprints
+        # are --root-relative — without --relative a sub-dir root
+        # would silently report nothing and exit 0
+        import json
+        repo = tmp_path
+        sub = repo / "pkg"
+        sub.mkdir()
+        (sub / "mod.py").write_text("x = 1\n")
+        self._git(repo, "init", "-q")
+        self._git(repo, "add", ".")
+        self._git(repo, "commit", "-qm", "seed")
+        with open(os.path.join(FIXTURES, "fixture_swallow.py"),
+                  encoding="utf-8") as fh:
+            (sub / "mod.py").write_text(fh.read())
+        proc = self._run(str(sub / "mod.py"),
+                         "--tests", str(sub), "--no-baseline",
+                         "--root", str(sub), "--changed-only",
+                         "--format=json")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert {f["path"] for f in doc["findings"]} == {"mod.py"}
+
+    def test_changed_only_outside_git_errors(self, tmp_path):
+        sub = tmp_path / "notgit"
+        sub.mkdir()
+        (sub / "a.py").write_text("x = 1\n")
+        proc = self._run(str(sub / "a.py"), "--root", str(sub),
+                         "--changed-only")
+        assert proc.returncode == 2  # usage error, not silent-clean
+
+    def test_untracked_files_count_as_changed(self, tmp_path):
+        import json
+        repo = tmp_path
+        (repo / "base.py").write_text("x = 1\n")
+        self._git(repo, "init", "-q")
+        self._git(repo, "add", ".")
+        self._git(repo, "commit", "-qm", "seed")
+        with open(os.path.join(FIXTURES, "fixture_swallow.py"),
+                  encoding="utf-8") as fh:
+            (repo / "brand_new.py").write_text(fh.read())
+        proc = self._run(str(repo / "brand_new.py"),
+                         "--tests", str(repo), "--no-baseline",
+                         "--root", str(repo), "--changed-only",
+                         "--format=json")
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert {f["path"] for f in doc["findings"]} == \
+            {"brand_new.py"}
 
 
 # ---------------------------------------------------------------------------
@@ -456,11 +638,116 @@ class TestLockWitness:
 
     def test_witnessed_batteries_run_clean(self):
         # the concurrency + cluster batteries opt in via the
-        # lock_witness fixture (their module-scoped autouse); here we
-        # just assert the wiring exists so a refactor can't silently
-        # drop it
+        # lock_witness AND leak_witness fixtures (their module-scoped
+        # autouse); here we just assert the wiring exists so a
+        # refactor can't silently drop it
         for mod in ("test_concurrency", "test_cluster"):
             with open(os.path.join(HERE, f"{mod}.py"),
                       encoding="utf-8") as fh:
-                assert "lock_witness" in fh.read(), \
-                    f"{mod} lost its lock-order witness wiring"
+                text = fh.read()
+            assert "lock_witness" in text, \
+                f"{mod} lost its lock-order witness wiring"
+            assert "leak_witness" in text, \
+                f"{mod} lost its thread/fd leak witness wiring"
+
+
+# ---------------------------------------------------------------------------
+# thread/fd leak witness (the runtime half of thread-lifecycle /
+# unbounded-growth)
+# ---------------------------------------------------------------------------
+
+class TestLeakWitness:
+    def _install(self):
+        from opentsdb_tpu.tools.tsdlint import witness as W
+        return W.install_leak()
+
+    def test_leaked_thread_is_named_with_its_allocation_site(self):
+        handle = self._install()
+        release = threading.Event()
+
+        def linger():
+            release.wait(30)
+
+        try:
+            th = threading.Thread(target=linger,
+                                  name="leaky-fixture-thread")
+            th.start()
+            with pytest.raises(AssertionError) as exc:
+                handle.witness.assert_converged(timeout_s=0.3)
+            msg = str(exc.value)
+            assert "leaky-fixture-thread" in msg
+            # the allocation site names THIS test, not just the name
+            assert "test_leaked_thread_is_named" in msg
+        finally:
+            release.set()
+            th.join(10)
+            handle.uninstall()
+        # after the join the same witness converges
+        handle.witness.assert_converged(timeout_s=5)
+
+    def test_leaked_fd_is_named_by_target(self, tmp_path):
+        handle = self._install()
+        try:
+            if handle.witness.baseline_fds is None:
+                pytest.skip("no /proc/self/fd on this platform")
+            fh = open(tmp_path / "leaked.dat", "w")
+            with pytest.raises(AssertionError) as exc:
+                handle.witness.assert_converged(timeout_s=0.3)
+            assert "leaked.dat" in str(exc.value)
+            fh.close()
+            handle.witness.assert_converged(timeout_s=5)
+        finally:
+            handle.uninstall()
+
+    def test_clean_teardown_converges(self, tmp_path):
+        handle = self._install()
+        try:
+            th = threading.Thread(target=lambda: None)
+            th.start()
+            th.join(10)
+            with open(tmp_path / "ok.dat", "w") as fh:
+                fh.write("x")
+            handle.witness.assert_converged(timeout_s=5)
+        finally:
+            handle.uninstall()
+
+    def test_pre_install_threads_are_baseline(self):
+        release = threading.Event()
+        th = threading.Thread(target=release.wait, args=(30,),
+                              name="pre-existing")
+        th.start()
+        try:
+            handle = self._install()
+            try:
+                # the long-lived pre-existing thread is NOT a leak
+                handle.witness.assert_converged(timeout_s=0.3)
+            finally:
+                handle.uninstall()
+        finally:
+            release.set()
+            th.join(10)
+
+
+class TestLeakRegressions:
+    """Defects the new gates surfaced, each failing before its fix."""
+
+    def test_wal_interval_fsync_thread_joins_on_close(self, tmp_path):
+        # before the fix: close() left the wal-fsync loop sleeping
+        # out its full interval (daemon=True hid it at process exit,
+        # but a restart-heavy embedder accumulated one live thread +
+        # one WAL reference per reopened log)
+        from opentsdb_tpu.core.wal import WriteAheadLog
+        from opentsdb_tpu.tools.tsdlint import witness as W
+        handle = W.install_leak()
+        try:
+            wal = WriteAheadLog(str(tmp_path / "wal"),
+                                fsync_mode="interval",
+                                interval_ms=60000.0)
+            assert wal._interval_thread is not None
+            assert wal._interval_thread.is_alive()
+            wal.close()
+            # converges immediately — no 60s lingering loop
+            handle.witness.assert_converged(timeout_s=5)
+        finally:
+            handle.uninstall()
+        assert wal._interval_thread is None

@@ -1,0 +1,38 @@
+"""Seeded ``thread-lifecycle`` violation: ``Leaker`` starts a loop
+thread nothing ever joins; ``Stopped`` (tuple-swap join idiom) and
+``Bounded`` (daemon + inline allow) must stay clean."""
+
+import threading
+
+
+class Leaker:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def start(self):
+        t = threading.Thread(target=self._loop, name="fx-leak")
+        self._runner = t
+        t.start()
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+
+class Stopped:
+    def start(self):
+        t = threading.Thread(target=print, name="fx-joined")
+        self._thread = t
+        t.start()
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+
+class Bounded:
+    def fire(self):
+        # tsdlint: allow[thread-lifecycle] fixture: lifetime bounded
+        # by the one print call
+        threading.Thread(target=print, daemon=True).start()

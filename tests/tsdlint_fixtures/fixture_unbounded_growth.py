@@ -1,0 +1,26 @@
+"""Seeded ``unbounded-growth`` violation: ``Leaky.memo`` is grown per
+call and never evicted; the popped dict, the maxlen deque, the reset
+list and the annotated dict must stay clean."""
+
+import collections
+
+
+class Leaky:
+    def __init__(self):
+        self.memo = {}
+        self.evicted = {}
+        self.ring = collections.deque(maxlen=8)
+        self.resettable = []
+        # tsdlint: allow[unbounded-growth] fixture: deliberate
+        self.annotated = {}
+
+    def record(self, key, value):
+        self.memo[key] = value
+        self.evicted[key] = value
+        self.ring.append(value)
+        self.resettable.append(value)
+        self.annotated[key] = value
+
+    def forget(self, key):
+        self.evicted.pop(key, None)
+        self.resettable = []

@@ -1,0 +1,20 @@
+"""Seeded ``kernel-hygiene`` violations (the ``ops`` path segment
+puts this file in scope): np.vectorize, a range(len) element loop, a
+float(x[i]) host pull and an .item() sync; the annotated scalar probe
+stays clean."""
+
+import numpy as np
+
+
+def bad_kernel(xs):
+    f = np.vectorize(lambda v: v + 1)
+    total = 0.0
+    for i in range(len(xs)):
+        total += float(xs[i])
+    return f(xs), total, xs.sum().item()
+
+
+def good_kernel(xs):
+    # tsdlint: allow[kernel-hygiene] fixture: one probe per call
+    head = float(xs[0])
+    return xs + head

@@ -1,0 +1,23 @@
+"""Seeded ``response-contract`` violations (the ``tsd`` path segment
+puts this file in scope): a send_error call and a raw-literal 500;
+the format_error-built 500 and the 4xx literal stay clean."""
+
+
+class HttpResponse:
+    def __init__(self, status, body=b"", **kw):
+        self.status = status
+        self.body = body
+
+
+def handler(request, serializer, do_work):
+    try:
+        return do_work(request)
+    except ValueError:
+        return request.send_error(500, "boom")
+    except KeyError:
+        return HttpResponse(500, b"exploded")
+    except TypeError:
+        return HttpResponse(400, b'{"error":"bad request"}')
+    except LookupError:
+        return HttpResponse(
+            500, serializer.format_error(500, "structured"))
