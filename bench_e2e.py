@@ -1077,6 +1077,178 @@ def bench_obs(repeats: int, n_points: int = 60_000,
     return out
 
 
+def bench_obs2(repeats: int, n_points: int = 40_000,
+               n_series: int = 200) -> dict:
+    """Fleet-observability overhead config: (1) ``GET /metrics``
+    render cost on a registry populated with realistic histogram +
+    counter state (what a Prometheus scrape pays), and (2) the
+    ingest/viz workloads with the continuous profiler ON at its
+    default rate (tsd.profile.hz=4) AND a concurrent /metrics
+    scraper — vs both off. Criterion: p50 overhead <= 5% on both
+    workloads (the ISSUE-15 acceptance bound)."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading as _threading
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+    # -- part 1: /metrics render cost ----------------------------------
+    t = TSDB(Config(**{
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "memory",
+        "tsd.tpu.warmup": "false",
+    }))
+    rng = np.random.default_rng(41)
+    for v in rng.gamma(2.0, 20.0, size=4000):
+        t.stats.latency_query.add(float(v))
+        t.stats.latency_put.add(float(v) / 4)
+    for stage in ("query.plan", "query.execute", "query.assemble",
+                  "query.serialize", "ingest.decode",
+                  "store.scatter", "wal.commit_wait",
+                  "query.admission"):
+        for v in rng.gamma(2.0, 8.0, size=2000):
+            t.stats.observe_stage(stage, float(v))
+    router = HttpRpcRouter(t)
+    render_times = []
+    body_bytes = 0
+    for _ in range(max(repeats * 4, 20)):
+        t0 = time.perf_counter()
+        resp = router.handle(HttpRequest("GET", "/metrics", {}))
+        render_times.append(time.perf_counter() - t0)
+        assert resp.status == 200
+        body_bytes = len(resp.body)
+    t.shutdown()
+
+    # -- part 2: profiler + scrape overhead on real workloads ----------
+    ts = BASE_S + np.arange(n_points, dtype=np.int64) % 7200
+    hosts = np.arange(n_points) % n_series
+    vals = np.round(rng.normal(100, 10, n_points), 2)
+    body_pts = 2000
+    put_dicts = [{"metric": "sys.obs2", "timestamp": int(ts[i]),
+                  "value": float(vals[i]),
+                  "tags": {"host": f"h{hosts[i]:04d}"}}
+                 for i in range(n_points)]
+    bodies = [_json.dumps(put_dicts[lo:lo + body_pts]).encode()
+              for lo in range(0, n_points, body_pts)]
+
+    def mk(obs_on: bool):
+        d = tempfile.mkdtemp(prefix="obs2bench-")
+        tt = TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.storage.backend": "memory",
+            "tsd.storage.data_dir": d,
+            "tsd.storage.wal.enable": "false",
+            "tsd.query.cache.enable": "false",
+            "tsd.tpu.warmup": "false",
+            "tsd.profile.enable": "true" if obs_on else "false",
+        }))
+        rt = HttpRpcRouter(tt)
+        stop = None
+        if obs_on:
+            tt.profiler.start()   # default 4 Hz, the always-on rate
+            stop = _threading.Event()
+
+            def scrape():
+                while not stop.wait(0.25):
+                    rt.handle(HttpRequest("GET", "/metrics", {}))
+
+            scr = _threading.Thread(target=scrape, daemon=True)
+            scr.start()
+            stop.thread = scr
+        return d, tt, rt, stop
+
+    def fin(d, tt, stop):
+        if stop is not None:
+            stop.set()
+            stop.thread.join(5)
+        tt.shutdown()
+        shutil.rmtree(d, ignore_errors=True)
+
+    def ingest_pass(obs_on: bool) -> float:
+        d, tt, rt, stop = mk(obs_on)
+        try:
+            t0 = time.perf_counter()
+            for body in bodies:
+                r = rt.handle(HttpRequest("POST", "/api/put", {},
+                                          body=body))
+                assert r.status == 204, r.body
+            return time.perf_counter() - t0
+        finally:
+            fin(d, tt, stop)
+
+    ing = {False: [], True: []}
+    for _ in range(max(repeats, 4)):
+        for mode in (False, True):
+            ing[mode].append(ingest_pass(mode))
+
+    span_s = 2 * 3600
+    ts_grid = BASE_MS + np.arange(span_s, dtype=np.int64) * 1000
+
+    def mk_viz(obs_on: bool):
+        d, tt, rt, stop = mk(obs_on)
+        mid = tt.uids.metrics.get_or_create_id("sys.viz")
+        kid = tt.uids.tag_names.get_or_create_id("host")
+        sids = np.asarray([
+            tt.store.get_or_create_series(
+                mid, [(kid, tt.uids.tag_values.get_or_create_id(
+                    f"h{j}"))])
+            for j in range(8)], dtype=np.int64)
+        tt.store.append_grid(
+            sids, ts_grid, rng.normal(100, 10, (8, span_s)),
+            np.ones((8, span_s), dtype=bool))
+        return d, tt, rt, stop
+
+    qb = _json.dumps({
+        "start": BASE_MS, "end": BASE_MS + span_s * 1000,
+        "queries": [{"metric": "sys.viz", "aggregator": "sum",
+                     "downsample": "1s-avg",
+                     "filters": [{"type": "wildcard", "tagk": "host",
+                                  "filter": "*",
+                                  "groupBy": True}]}],
+        "pixels": 1500}).encode()
+    viz = {False: mk_viz(False), True: mk_viz(True)}
+    times = {False: [], True: []}
+    try:
+        for mode in (False, True):  # warm compiles (shared cache)
+            r = viz[mode][2].handle(HttpRequest(
+                "POST", "/api/query", {}, body=qb))
+            assert r.status == 200, r.body
+        for _ in range(max(repeats, 9)):
+            for mode in (False, True):
+                t0 = time.perf_counter()
+                r = viz[mode][2].handle(HttpRequest(
+                    "POST", "/api/query", {}, body=qb))
+                times[mode].append(time.perf_counter() - t0)
+                assert r.status == 200
+        profiler_counters = viz[True][1].profiler.health_info()
+    finally:
+        for mode in (False, True):
+            fin(viz[mode][0], viz[mode][1], viz[mode][3])
+
+    out = {
+        "config": "obs2", "points": n_points,
+        "metrics_render_p50_ms": round(
+            _percentile(render_times, 50) * 1e3, 3),
+        "metrics_body_bytes": body_bytes,
+        "ingest_s_obs_off": round(min(ing[False]), 4),
+        "ingest_s_obs_on": round(min(ing[True]), 4),
+        "ingest_overhead": round(
+            min(ing[True]) / max(min(ing[False]), 1e-9), 4),
+        "viz_p50_ms_obs_off": round(
+            _percentile(times[False], 50) * 1e3, 2),
+        "viz_p50_ms_obs_on": round(
+            _percentile(times[True], 50) * 1e3, 2),
+        "viz_overhead": round(
+            _percentile(times[True], 50)
+            / max(_percentile(times[False], 50), 1e-9), 4),
+        "profiler_counters_on": profiler_counters,
+    }
+    out["criterion_pass"] = bool(out["ingest_overhead"] <= 1.05
+                                 and out["viz_overhead"] <= 1.05)
+    return out
+
+
 def bench_viz(repeats: int, n_hosts: int = 8, per_host: int = 5,
               span_s: int = 172_800) -> dict:
     """Pixel-aware serve-path downsampling config: a config2-style
@@ -1604,7 +1776,8 @@ def main() -> None:
                "ingest": bench_ingest, "viz": bench_viz,
                "cluster": bench_cluster,
                "cluster_rf": bench_cluster_rf,
-               "streamv2": bench_streamv2, "obs": bench_obs}
+               "streamv2": bench_streamv2, "obs": bench_obs,
+               "obs2": bench_obs2}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

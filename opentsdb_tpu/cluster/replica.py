@@ -41,6 +41,7 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Any, Iterable
 
 from opentsdb_tpu.cluster.hashring import HashRing, series_shard_key
@@ -243,13 +244,41 @@ class DirtyTracker:
         with self._lock:
             return sum(len(v) for v in self._dirty.values())
 
-    def health_info(self) -> dict[str, Any]:
+    def age_info(self, peer: str, now_ms: int | None = None
+                 ) -> dict[str, Any]:
+        """This peer's divergence-debt AGE: the oldest unpaired dirty
+        epoch as a staleness gauge. A week-old divergence and a
+        seconds-old blip carry the same entry COUNT — the age is what
+        distinguishes "anti-entropy is keeping up" from "this replica
+        has silently diverged for days"."""
+        now = int(now_ms if now_ms is not None
+                  else time.time() * 1000)
         with self._lock:
+            per = self._dirty.get(peer) or {}
+            oldest = min(per.values()) if per else 0
             return {
-                "entries": sum(len(v) for v in self._dirty.values()),
-                "peers": sorted(self._dirty),
-                "marks": self.marks,
+                "entries": len(per),
+                "oldest_ms": oldest,
+                "age_s": round(max(now - oldest, 0) / 1000.0, 1)
+                if oldest else 0.0,
             }
+
+    def health_info(self) -> dict[str, Any]:
+        now_ms = int(time.time() * 1000)
+        with self._lock:
+            peers = sorted(self._dirty)
+            entries = sum(len(v) for v in self._dirty.values())
+            marks = self.marks
+        ages = {p: self.age_info(p, now_ms) for p in peers}
+        return {
+            "entries": entries,
+            "peers": peers,
+            "marks": marks,
+            # per-peer staleness: oldest unpaired dirty epoch + age
+            "ages": ages,
+            "oldest_age_s": max(
+                (a["age_s"] for a in ages.values()), default=0.0),
+        }
 
 
 __all__ = ["DirtyTracker", "parse_sel", "ring_for", "sel_cache_key",

@@ -422,5 +422,23 @@ class Backfiller:
             "dead_old_shards": sorted(self.dead),
         }
 
+    def progress(self) -> dict[str, Any]:
+        """Done-marker progress for /api/cluster/status:
+        ``total_units`` counts (old shard, metric) units over the
+        metric lists enumerated SO FAR (lists are fetched lazily per
+        old shard, so early in a pass the total can still grow —
+        ``total_known`` says whether every old shard has answered)."""
+        state = self.router.state
+        with state._lock:
+            done_units = sum(len(v) for v in state.done.values())
+        old_ring = self.router.old_ring
+        names = list(old_ring.names) if old_ring is not None else []
+        total = sum(len(self._metrics.get(n, ())) for n in names)
+        return {
+            "done_units": done_units,
+            "total_units": total,
+            "total_known": all(n in self._metrics for n in names),
+        }
+
 
 __all__ = ["Backfiller", "ReshardState"]

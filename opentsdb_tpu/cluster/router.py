@@ -246,6 +246,10 @@ class ClusterRouter:
         self.metric_versions_max = max(config.get_int(
             "tsd.cluster.metric_versions.max_entries", 100000), 1)
         self._global_version = 0
+        # TTL cache for the /api/health fleet section (see
+        # fleet_health): (doc, monotonic stamp)
+        self._fleet_health_lock = threading.Lock()
+        self._fleet_health_cache: tuple = (None, 0.0)
         self._stop = threading.Event()
         self._replay_thread: threading.Thread | None = None
         self._backfill_thread: threading.Thread | None = None
@@ -2211,6 +2215,42 @@ class ClusterRouter:
                 continue
             spans.extend(doc.get("spans") or [])
         return spans, sorted(incomplete)
+
+    def fleet_stats(self) -> dict[str, Any]:
+        """Fleet-merged stats (``GET /api/stats/fleet``): counters
+        summed, gauges per-node + min/max, histograms bucket-summed
+        at full resolution so a fleet p99 is exact."""
+        from opentsdb_tpu.cluster import fleet
+        return fleet.fleet_stats(self)
+
+    def fleet_health(self) -> dict[str, Any]:
+        """Per-shard health summary for the router's ``/api/health``
+        ``fleet`` section (never raises — an unreachable shard is a
+        row, not a failure). TTL-cached
+        (``tsd.cluster.fleet_health_ttl_ms``): /api/health is a
+        probe surface polled every second or two by load balancers —
+        without the cache every poll would fan out a network scatter
+        per shard, and one hung-but-not-yet-tripped shard would
+        stall the probe long enough for the checker to eject a
+        healthy router."""
+        from opentsdb_tpu.cluster import fleet
+        ttl_s = self.config.get_float(
+            "tsd.cluster.fleet_health_ttl_ms", 5000.0) / 1000.0
+        now = time.monotonic()
+        with self._fleet_health_lock:
+            doc, stamp = self._fleet_health_cache
+            if doc is not None and now - stamp < ttl_s:
+                return doc
+        doc = fleet.fleet_health(self)
+        with self._fleet_health_lock:
+            self._fleet_health_cache = (doc, now)
+        return doc
+
+    def cluster_status(self) -> dict[str, Any]:
+        """The consolidated operator progress surface behind
+        ``GET /api/cluster/status``."""
+        from opentsdb_tpu.cluster import fleet
+        return fleet.cluster_status(self)
 
     def health_info(self) -> dict[str, Any]:
         return {

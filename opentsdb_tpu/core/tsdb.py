@@ -241,6 +241,21 @@ class TSDB:
         from opentsdb_tpu.obs.telemetry import SelfTelemetry
         self.telemetry = SelfTelemetry(self)
         self.stats.register(self.telemetry)
+        # continuous sampling profiler (obs/profiler.py): a bounded
+        # background thread folding sys._current_frames() into
+        # per-role stack counts over the last tsd.profile.ring_s
+        # seconds — GET /api/profile serves it flamegraph-ready.
+        # Started by TSDServer; stopped (joined) by shutdown().
+        from opentsdb_tpu.obs.profiler import SamplingProfiler
+        self.profiler = SamplingProfiler(self)
+        self.stats.register(self.profiler)
+        # SLO burn-rate tracker (obs/slo.py): per-endpoint
+        # latency/availability objectives from tsd.slo.*, fed by the
+        # HTTP router per served request, exported at /metrics and
+        # /api/health
+        from opentsdb_tpu.obs.slo import SloTracker
+        self.slo = SloTracker(self.config)
+        self.stats.register(self.slo)
         # persistent XLA compilation cache: every jitted query program
         # survives restarts (before this, a restarted server re-paid
         # minutes of tunnel remote_compiles the reference's warm JVM
@@ -1207,6 +1222,7 @@ class TSDB:
 
     def shutdown(self) -> None:
         self.telemetry.stop()
+        self.profiler.stop()
         if self._cluster is not None:
             self._cluster.stop()
         if self._lifecycle is not None:

@@ -12,10 +12,20 @@ self-telemetry.
   percentiles into its *own* store as ``tsd.*`` series, so dashboards,
   continuous queries, lifecycle policies and the cluster tier all
   apply to the TSD monitoring itself.
+- :mod:`opentsdb_tpu.obs.openmetrics` — the ``GET /metrics``
+  exposition renderer: the full stats registry in OpenMetrics text,
+  histograms in native cumulative ``_bucket``/``_sum``/``_count``
+  form, for the Prometheus ecosystem.
+- :mod:`opentsdb_tpu.obs.profiler` — the continuous sampling
+  profiler: per-thread-role folded stacks over a bounded ring,
+  served flamegraph-ready at ``GET /api/profile``.
+- :mod:`opentsdb_tpu.obs.slo` — per-endpoint SLO objectives and
+  multi-window burn-rate gauges (``tsd.slo.*``).
 
 Surfaces: ``GET /api/trace`` (recent roots), ``GET /api/trace/<id>``
 (full span tree, cluster-stitched on a router), per-stage latency
-percentiles at ``/api/stats`` + ``/api/health``.
+percentiles at ``/api/stats`` + ``/api/health``, ``GET /metrics``,
+``GET /api/profile``.
 """
 
 from opentsdb_tpu.obs.trace import (KNOWN_SPANS, Tracer, current,

@@ -130,10 +130,39 @@ class StatsCollectorRegistry:
         out["stages"] = stages
         return out
 
-    def collect(self, prefix: str = "tsd") -> StatsCollector:
+    def histograms(self) -> "list[tuple[str, dict[str, str], Histogram]]":
+        """Every histogram this registry owns, with its exposition
+        identity ``(family name, labels, histogram)`` — the ONE
+        enumeration the ``/metrics`` renderer and the per-node
+        ``/api/stats/raw`` fleet-merge source both walk (tsdlint's
+        ``histogram-export`` pass checks that every ``Histogram``
+        constructed in the package is reachable from here or from the
+        renderer directly)."""
+        out: list[tuple[str, dict[str, str], Histogram]] = [
+            ("tsd_request_latency_ms", {"op": "put"},
+             self.latency_put),
+            ("tsd_request_latency_ms", {"op": "query"},
+             self.latency_query),
+        ]
+        # direct load (not via _stage_snapshot): the histogram-export
+        # pass proves reachability lexically, and this method IS the
+        # reachability evidence for the stage registry
+        with self._stage_lock:
+            stages = dict(self.stage_latency)
+        for stage, h in sorted(stages.items()):
+            out.append(("tsd_stage_latency_ms", {"stage": stage}, h))
+        return out
+
+    def collect(self, prefix: str = "tsd",
+                latency_percentiles: bool = True) -> StatsCollector:
         collector = StatsCollector(prefix)
         for p in self._providers:
             p.collect_stats(collector)
+        if not latency_percentiles:
+            # the /metrics renderer serves the SAME histograms in
+            # native cumulative-bucket form — percentile records
+            # would double-export them under a second name
+            return collector
         # latency percentiles ride the same record stream so
         # /api/stats, telnet `stats` and the self-telemetry pump all
         # see them without extra plumbing
@@ -171,6 +200,9 @@ class Histogram:
             self.bounds.append(min(self.bounds[-1] * 2, max_value))
         self.buckets = [0] * (len(self.bounds) + 1)
         self.count = 0
+        # running sum of observed values: the OpenMetrics ``_sum``
+        # series — fleet merges add sums like they add bucket counts
+        self.sum = 0.0
         self._lock = threading.Lock()
 
     def add(self, value: float) -> None:
@@ -181,6 +213,17 @@ class Histogram:
         with self._lock:
             self.buckets[min(idx, len(self.buckets) - 1)] += 1
             self.count += 1
+            self.sum += value
+
+    def snapshot(self) -> dict[str, Any]:
+        """Consistent copy of the raw state — the wire form the
+        ``/metrics`` renderer and the fleet bucket-merge consume
+        (bounds are construction-time constants; counts/sum are read
+        under the lock so a snapshot is never torn mid-``add``)."""
+        with self._lock:
+            return {"bounds": list(self.bounds),
+                    "buckets": list(self.buckets),
+                    "count": self.count, "sum": self.sum}
 
     def percentile(self, pct: float) -> float:
         """(ref: Histogram.percentile)"""
@@ -197,25 +240,8 @@ class Histogram:
         with self._lock:
             count = self.count
             buckets = list(self.buckets)  # C-level copy
-        if count == 0:
-            return [0.0] * len(pcts)
-        targets = sorted((count * p / 100.0, j)
-                         for j, p in enumerate(pcts))
-        out = [0.0] * len(pcts)
-        acc = 0
-        t = 0
-        last_bound = len(self.bounds) - 1
-        for i, c in enumerate(buckets):
-            acc += c
-            while t < len(targets) and acc >= targets[t][0]:
-                out[targets[t][1]] = float(
-                    self.bounds[min(i, last_bound)])
-                t += 1
-            if t >= len(targets):
-                break
-        for k in range(t, len(targets)):
-            out[targets[k][1]] = float(self.bounds[-1])
-        return out
+        return percentiles_from_buckets(self.bounds, buckets, count,
+                                        pcts)
 
     def percentiles(self) -> dict[str, float]:
         """The standard export points + the sample count."""
@@ -232,6 +258,107 @@ class Histogram:
             lo = self.bounds[i]
         lines.append(f"[{lo}-inf): {self.buckets[-1]}")
         return "\n".join(lines)
+
+
+def percentiles_from_buckets(bounds: "list[int]", buckets: "list[int]",
+                             count: int,
+                             pcts: "list[float]") -> "list[float]":
+    """Bucket-upper-bound percentiles in one cumulative pass — shared
+    by :meth:`Histogram.percentile_many` and the fleet bucket-merge,
+    so a fleet percentile over summed buckets is BIT-identical to the
+    same observations landing in one histogram (both read the same
+    bound for the same cumulative rank)."""
+    if count == 0:
+        return [0.0] * len(pcts)
+    targets = sorted((count * p / 100.0, j) for j, p in enumerate(pcts))
+    out = [0.0] * len(pcts)
+    acc = 0
+    t = 0
+    last_bound = len(bounds) - 1
+    for i, c in enumerate(buckets):
+        acc += c
+        while t < len(targets) and acc >= targets[t][0]:
+            out[targets[t][1]] = float(bounds[min(i, last_bound)])
+            t += 1
+        if t >= len(targets):
+            break
+    for k in range(t, len(targets)):
+        out[targets[k][1]] = float(bounds[-1])
+    return out
+
+
+def merge_histogram_snapshots(snaps: "list[dict]") -> "dict | None":
+    """Element-wise bucket/count/sum merge of :meth:`Histogram.
+    snapshot` documents sharing one bound table (every histogram in
+    the package uses the same 1ms construction, so per-shard
+    snapshots of the same stage always merge). Returns None on an
+    empty list or mismatched bounds — the caller reports the node
+    instead of producing a silently wrong distribution."""
+    merged: dict | None = None
+    for s in snaps:
+        bounds = s.get("bounds")
+        buckets = s.get("buckets")
+        if not isinstance(bounds, list) or not isinstance(
+                buckets, list) or len(buckets) != len(bounds) + 1:
+            return None
+        if merged is None:
+            merged = {"bounds": list(bounds),
+                      "buckets": list(buckets),
+                      "count": int(s.get("count", 0)),
+                      "sum": float(s.get("sum", 0.0))}
+            continue
+        if bounds != merged["bounds"]:
+            return None
+        mb = merged["buckets"]
+        for i, c in enumerate(buckets):
+            mb[i] += int(c)
+        merged["count"] += int(s.get("count", 0))
+        merged["sum"] += float(s.get("sum", 0.0))
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# counter-vs-gauge classification (exposition + fleet merge)
+# ---------------------------------------------------------------------------
+# The push-style record stream carries no type information, so the
+# OpenMetrics renderer and the fleet aggregator share one advisory
+# classification: a GAUGE is a point-in-time level (summing it across
+# nodes or scrapes is meaningless); everything else is a monotonic
+# counter. Exact names first, then substring markers for the families
+# (`*_bytes`, `*pending*`, ...) the codebase consistently uses for
+# levels. Misclassification is cosmetic for Prometheus (TYPE line);
+# for fleet merges it decides sum-vs-min/max presentation only.
+
+_GAUGE_NAMES: frozenset[str] = frozenset({
+    "admission.inflight",
+    "cluster.epoch",
+    "cluster.rf",
+    "datapoints.memory",
+    "uptime.seconds",
+    "wal.sync_lag",          # records not yet fsynced: a level
+    "wal.records_per_sync",  # a ratio, not a count
+    "wal.degraded",          # 0/1 flag
+})
+
+_GAUGE_MARKERS: tuple[str, ...] = (
+    "_bytes", ".bytes", "pending", "backlog", "depth",
+    "inflight", "entries", "resident", "uptime",
+    ".lag", "_size", ".size", "open_", ".open", "_open", "queue",
+    "interval", "cache-size", "burn_rate",
+)
+
+
+def is_gauge(name: str) -> bool:
+    """Advisory: True when the record named ``name`` (without the
+    collector prefix) reads as a level rather than a monotonic
+    count. A ``*_total``/``*.total`` name is a counter no matter
+    what substring it also contains (``query.payload.bytes_total``
+    is a monotonic byte count, not a level)."""
+    if name.endswith("_total") or name.endswith(".total"):
+        return False
+    if name in _GAUGE_NAMES:
+        return True
+    return any(m in name for m in _GAUGE_MARKERS)
 
 
 class QueryStat(Enum):

@@ -1,7 +1,7 @@
 """tsdlint — invariant static analysis for the opentsdb_tpu tree.
 
 Eight PRs of review hardening kept finding the same defect classes by
-hand; tsdlint makes each one a checked artifact. Eleven AST passes
+hand; tsdlint makes each one a checked artifact. Twelve AST passes
 over the package (plus the fault-arming side of the tests):
 
 =================  =======================================================
@@ -32,6 +32,9 @@ kernel-hygiene     ops/ kernels stay vectorized: no np.vectorize,
                    range(len)-style loops
 response-contract  except-handlers in tsd//cluster/ answer structured
                    errors: no send_error, no raw 5xx literals
+histogram-export   every Histogram constructed binds to a name the
+                   /metrics renderer (or a histograms() enumeration)
+                   references — recorded-but-unscrapeable is a finding
 =================  =======================================================
 
 Suppression is two-level: an inline ``# tsdlint: allow[pass-id] why``
@@ -50,7 +53,8 @@ import os
 from dataclasses import dataclass, field
 
 from opentsdb_tpu.tools.tsdlint import (config_keys, counters,
-                                        fault_sites, growth, kernels,
+                                        fault_sites, growth,
+                                        histograms, kernels,
                                         lock_discipline, responses,
                                         swallow, threads, trace_sites)
 from opentsdb_tpu.tools.tsdlint.base import (Finding, Source,
@@ -59,13 +63,14 @@ from opentsdb_tpu.tools.tsdlint.base import (Finding, Source,
 #: pass-id -> module; lock_discipline owns two ids
 PASS_MODULES = (lock_discipline, config_keys, fault_sites, counters,
                 swallow, trace_sites, threads, growth, kernels,
-                responses)
+                responses, histograms)
 ALL_PASS_IDS = (lock_discipline.PASS_BLOCKING,
                 lock_discipline.PASS_CYCLE,
                 config_keys.PASS_ID, fault_sites.PASS_ID,
                 counters.PASS_ID, swallow.PASS_ID,
                 trace_sites.PASS_ID, threads.PASS_ID,
-                growth.PASS_ID, kernels.PASS_ID, responses.PASS_ID)
+                growth.PASS_ID, kernels.PASS_ID, responses.PASS_ID,
+                histograms.PASS_ID)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))          # .../opentsdb_tpu

@@ -1,0 +1,162 @@
+"""Pass ``histogram-export``: every ``Histogram`` must reach /metrics.
+
+The counter-export pass proves a bumped counter is readable somewhere;
+this is its sibling for distributions. A :class:`stats.Histogram`
+records observations that only become operator-visible through the
+OpenMetrics renderer (``obs/openmetrics.py``), which walks
+``StatsCollectorRegistry.histograms()`` — so a histogram constructed
+anywhere in the package whose binding is referenced by NEITHER the
+renderer module NOR a ``histograms()`` enumeration method can never be
+scraped: it is recorded-but-never-exported, the distribution-shaped
+version of a dead counter.
+
+Mechanics: every ``Histogram(...)`` construction site resolves to its
+*binding name* — the attribute (or name) the instance lands in,
+following the two idioms the codebase uses::
+
+    self.latency_put = Histogram(...)              # plain assign
+    self.stage_latency.setdefault(k, Histogram())  # keyed registry
+
+The binding must appear as a LOAD inside an export scope: the
+``obs/openmetrics.py`` module, or any function named ``histograms`` /
+``hist_snapshots`` in the package (the enumeration the renderer
+walks). A construction with no recoverable binding is also a finding —
+an anonymous histogram can't be enumerated by anything.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from opentsdb_tpu.tools.tsdlint.base import Finding
+
+PASS_ID = "histogram-export"
+
+#: module whose loads count as export evidence
+_RENDERER_SUFFIXES = ("obs/openmetrics.py",)
+#: function names whose loads count as export evidence
+_ENUM_FUNCS = ("histograms", "hist_snapshots")
+
+
+def _is_histogram_call(node: ast.Call) -> bool:
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name == "Histogram"
+
+
+def _binding_of(call: ast.Call, parents: dict) -> str | None:
+    """The attr/name the constructed instance binds to, or None."""
+    node: ast.AST = call
+    while True:
+        parent = parents.get(node)
+        if parent is None:
+            return None
+        if isinstance(parent, ast.Call) and \
+                isinstance(parent.func, ast.Attribute) and \
+                parent.func.attr == "setdefault" and \
+                node in parent.args:
+            # registry.setdefault(key, Histogram(...)) — the registry
+            # container is the binding
+            base = parent.func.value
+            if isinstance(base, ast.Attribute):
+                return base.attr
+            if isinstance(base, ast.Name):
+                return base.id
+            return None
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets \
+                if isinstance(parent, ast.Assign) else [parent.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    return t.attr
+                if isinstance(t, ast.Name):
+                    return t.id
+                if isinstance(t, ast.Tuple):
+                    # tuple targets: positional match is fragile;
+                    # treat as unrecoverable
+                    return None
+            return None
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Module)):
+            return None
+        node = parent
+
+
+def _export_loads(sources) -> set[str]:
+    loads: set[str] = set()
+    for src in sources:
+        in_renderer = any(src.rel.endswith(s)
+                          for s in _RENDERER_SUFFIXES)
+        scopes: list[ast.AST] = []
+        if in_renderer:
+            scopes.append(src.tree)
+        else:
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name in _ENUM_FUNCS:
+                    scopes.append(node)
+        for scope in scopes:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load):
+                    loads.add(node.attr)
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load):
+                    loads.add(node.id)
+    return loads
+
+
+def run(package_sources, test_sources, ctx) -> list[Finding]:
+    exported = _export_loads(package_sources)
+    findings: list[Finding] = []
+    for src in package_sources:
+        if src.rel.endswith("stats/stats.py") and \
+                "class Histogram" in src.text:
+            defines_histogram = True
+        else:
+            defines_histogram = False
+        parents: dict = {}
+        for node in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_histogram_call(node)):
+                continue
+            if defines_histogram and _inside_class_def(
+                    node, parents, "Histogram"):
+                continue  # the class's own internals
+            binding = _binding_of(node, parents)
+            if binding is None:
+                if src.allowed(PASS_ID, node.lineno):
+                    continue
+                findings.append(Finding(
+                    PASS_ID, src.path, src.rel, node.lineno,
+                    "Histogram constructed without a recoverable "
+                    "binding — nothing can enumerate it for the "
+                    "/metrics renderer",
+                    detail="<anonymous>"))
+                continue
+            if binding in exported:
+                continue
+            if src.allowed(PASS_ID, node.lineno):
+                continue
+            findings.append(Finding(
+                PASS_ID, src.path, src.rel, node.lineno,
+                f"Histogram bound to {binding!r} is never referenced "
+                f"by the /metrics renderer or a histograms() "
+                f"enumeration — recorded but unscrapeable",
+                detail=binding))
+    return findings
+
+
+def _inside_class_def(node: ast.AST, parents: dict,
+                      class_name: str) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef) and cur.name == class_name:
+            return True
+        cur = parents.get(cur)
+    return False

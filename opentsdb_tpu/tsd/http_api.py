@@ -187,6 +187,7 @@ class HttpRpcRouter:
             "dropcaches": self._handle_dropcaches,
             "health": self._handle_health,
             "lifecycle": self._handle_lifecycle,
+            "profile": self._handle_profile,
             "serializers": self._handle_serializers,
             "stats": self._handle_stats,
             "trace": self._handle_trace,
@@ -207,6 +208,7 @@ class HttpRpcRouter:
     # ------------------------------------------------------------------
 
     def handle(self, request: HttpRequest) -> HttpResponse:
+        t0 = time.monotonic()
         resp = self._apply_jsonp(request, self._handle_inner(request))
         # stamped by _trace_request when the request's trace was
         # retained — set here so ERROR responses (built by
@@ -215,7 +217,43 @@ class HttpRpcRouter:
         tid = getattr(request, "trace_id_hint", None)
         if tid:
             resp.headers.setdefault("X-TSD-Trace-Id", tid)
+        # SLO burn-rate feed (obs/slo.py): every served query/put
+        # counts toward the endpoint's latency + availability
+        # budgets; a 5xx is the availability violation, 4xx is the
+        # client's problem. Recorded here ONLY for direct-handler
+        # callers (tests, benches — received_at unset): under the
+        # real socket server the SERVER records at response time, so
+        # admission sheds (503) and query timeouts (504) — responses
+        # built without ever entering this router — still burn the
+        # budget, the latency includes the queue wait, and a
+        # timed-out query's still-running worker can't later count
+        # its abandoned answer as a good event.
+        if not request.received_at:
+            slo = getattr(self.tsdb, "slo", None)
+            if slo is not None and slo.enabled:
+                endpoint = self._slo_endpoint(request.path)
+                if endpoint is not None:
+                    slo.record(endpoint,
+                               (time.monotonic() - t0) * 1000.0,
+                               resp.status >= 500)
         return resp
+
+    @staticmethod
+    def _slo_endpoint(path: str) -> str | None:
+        parts = [p for p in path.split("?", 1)[0].split("/") if p]
+        if not parts:
+            return None
+        if parts[0] == "api":
+            parts = parts[1:]
+            if parts and re.fullmatch(r"v[0-9]+", parts[0]):
+                parts = parts[1:]
+        if not parts:
+            return None
+        if parts[0] in ("query", "q"):
+            return "query"
+        if parts[0] == "put":
+            return "put"
+        return None
 
     def _handle_inner(self, request: HttpRequest) -> HttpResponse:
         # content negotiation: ?serializer=<shortname> picks a
@@ -348,6 +386,11 @@ class HttpRpcRouter:
                                     close_connection=True)
             raise HttpError(404, "Endpoint not found: /diediedie",
                             "No server attached")
+        elif parts[0] == "metrics":
+            # OpenMetrics exposition (obs/openmetrics.py): the
+            # standard scrape surface, deliberately OUTSIDE /api —
+            # Prometheus conventionally scrapes /metrics
+            return self._handle_metrics(request)
         elif parts[0] == "logs":
             return self._handle_logs(request)
         elif parts[0] == "plugin":
@@ -1358,13 +1401,88 @@ class HttpRpcRouter:
         return HttpResponse(200, request.serializer.format_dropcaches(
             {"status": "200", "message": "Caches dropped"}))
 
+    def _handle_metrics(self, request: HttpRequest) -> HttpResponse:
+        """``GET /metrics`` — OpenMetrics exposition of the full
+        stats registry: counters, gauges, the latency ``Histogram``s
+        as native cumulative ``_bucket``/``_sum``/``_count`` series,
+        and the SLO burn-rate gauges. Prometheus scrapes this
+        directly; no self-telemetry pump required."""
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed")
+        from opentsdb_tpu.obs import openmetrics
+        return HttpResponse(
+            200, openmetrics.render(self.tsdb),
+            content_type=openmetrics.CONTENT_TYPE)
+
+    def _handle_profile(self, request: HttpRequest, rest
+                        ) -> HttpResponse:
+        """``GET /api/profile?seconds=N`` — the continuous sampling
+        profiler's trailing window (:mod:`opentsdb_tpu.obs.profiler`)
+        as flamegraph-ready collapsed text (default; pipe straight
+        into flamegraph.pl or paste into speedscope) or
+        ``?format=json``. ``?role=query`` filters one thread role."""
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed")
+        profiler = self.tsdb.profiler
+        if not profiler.enabled or profiler.hz <= 0:
+            raise HttpError(400, "Profiling is disabled",
+                            "set tsd.profile.enable = true and "
+                            "tsd.profile.hz > 0")
+        seconds = as_int(request.param("seconds"), "seconds",
+                         profiler.ring_s)
+        role = request.param("role", "") or ""
+        fmt = request.param("format", "collapsed") or "collapsed"
+        if fmt == "json":
+            return HttpResponse(200, json.dumps({
+                "seconds": min(max(seconds, 1), profiler.ring_s),
+                "hz": profiler.hz,
+                "roles": profiler.report(seconds, role),
+                "profiler": profiler.health_info(),
+            }).encode())
+        if fmt != "collapsed":
+            raise HttpError(400, "format must be collapsed or json")
+        return HttpResponse(
+            200, profiler.collapsed(seconds, role).encode(),
+            content_type="text/plain; charset=UTF-8")
+
     def _handle_stats(self, request: HttpRequest, rest) -> HttpResponse:
         """(ref: StatsRpc.java; /api/stats + /query /jvm /threads
-        /region_clients)"""
+        /region_clients; grown here: /raw — the per-node fleet-merge
+        source, /fleet — the router's cluster-wide aggregation,
+        /query_shapes — the mined query-shape summary)"""
         sub = rest[0] if rest else ""
         if sub == "query":
             return HttpResponse(200, request.serializer.format_query_stats(
                 QueryStats.running_and_completed()))
+        if sub == "raw":
+            # counters/gauges as records plus FULL-resolution
+            # histogram snapshots: what the fleet merge consumes
+            # (bucket-summing needs the real buckets — percentiles
+            # don't merge)
+            collector = self.tsdb.stats.collect(
+                latency_percentiles=False)
+            self.tsdb.collect_stats(collector)
+            return HttpResponse(200, json.dumps({
+                "ts": int(time.time()),
+                "records": [
+                    {"metric": name, "value": value, "tags": tags}
+                    for name, value, tags in collector.records],
+                "histograms": [
+                    {"name": name, "labels": labels, **hist.snapshot()}
+                    for name, labels, hist
+                    in self.tsdb.stats.histograms()],
+            }).encode())
+        if sub == "fleet":
+            cluster = self.tsdb.cluster
+            if cluster is None:
+                raise HttpError(
+                    400, "/api/stats/fleet requires tsd.cluster.role "
+                    "= router",
+                    "per-node stats live at /api/stats[/raw]")
+            return HttpResponse(200, json.dumps(
+                cluster.fleet_stats()).encode())
+        if sub == "query_shapes":
+            return self._handle_query_shapes(request)
         if sub == "jvm":
             return HttpResponse(200, json.dumps(
                 self._runtime_stats()).encode())
@@ -1385,6 +1503,94 @@ class HttpRpcRouter:
         self.tsdb.collect_stats(collector)
         return HttpResponse(200, request.serializer.format_stats(
             collector.as_json()))
+
+    def _handle_query_shapes(self, request: HttpRequest
+                             ) -> HttpResponse:
+        """``GET /api/stats/query_shapes`` — the ROADMAP item-5
+        mining input made inspectable without shell access: a top-N
+        summary over ``query_shapes.jsonl`` (current + one rotated
+        generation), grouped by shape key (metrics, aggregator,
+        downsample, filter count, pixel budget) with per-shape
+        counts, the cache-outcome mix, and p50/p95 of total duration
+        and each stage."""
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed")
+        tracer = self.tsdb.tracer
+        path = getattr(tracer, "shape_path", "")
+        if not path:
+            raise HttpError(
+                400, "Query-shape logging is disabled",
+                "needs tsd.trace.enable + tsd.trace.shapes.enable "
+                "and a tsd.storage.data_dir")
+        limit = as_int(request.param("limit"), "limit", 20)
+        import os
+        shapes: dict[tuple, dict[str, Any]] = {}
+        lines_read = 0
+        # rotated generation first so per-shape samples stay in time
+        # order (not that percentiles care)
+        for p in (path + ".1", path):
+            if not os.path.isfile(p):
+                continue
+            try:
+                with open(p, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            doc = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail of a rotation
+                        if not isinstance(doc, dict):
+                            continue
+                        lines_read += 1
+                        key = (doc.get("metrics", ""),
+                               doc.get("aggregator", ""),
+                               doc.get("downsample", ""),
+                               doc.get("filters", 0),
+                               doc.get("pixels", 0))
+                        s = shapes.get(key)
+                        if s is None:
+                            s = shapes[key] = {
+                                "count": 0, "cache": {},
+                                "durations": [], "stages": {}}
+                        s["count"] += 1
+                        outcome = str(doc.get("cache", "unknown"))
+                        s["cache"][outcome] = \
+                            s["cache"].get(outcome, 0) + 1
+                        s["durations"].append(
+                            float(doc.get("durationMs", 0.0)))
+                        for stage, ms in (doc.get("stages")
+                                          or {}).items():
+                            s["stages"].setdefault(stage, []).append(
+                                float(ms))
+            except OSError:
+                continue
+        def _pct(vals: list, q: float) -> float:
+            if not vals:
+                return 0.0
+            vs = sorted(vals)
+            return round(vs[min(int(len(vs) * q / 100.0),
+                                len(vs) - 1)], 3)
+        top = sorted(shapes.items(), key=lambda kv:
+                     (-kv[1]["count"], kv[0]))[:max(limit, 1)]
+        out = []
+        for (metrics, agg, ds, nfilters, px), s in top:
+            out.append({
+                "metrics": metrics, "aggregator": agg,
+                "downsample": ds, "filters": nfilters, "pixels": px,
+                "count": s["count"],
+                "cacheOutcomes": s["cache"],
+                "durationMs": {"p50": _pct(s["durations"], 50),
+                               "p95": _pct(s["durations"], 95)},
+                "stagesMs": {
+                    stage: {"p50": _pct(vals, 50),
+                            "p95": _pct(vals, 95)}
+                    for stage, vals in sorted(s["stages"].items())},
+            })
+        return HttpResponse(200, json.dumps({
+            "shapes": out,
+            "distinctShapes": len(shapes),
+            "linesRead": lines_read,
+            "source": path,
+        }).encode())
 
     def _handle_trace(self, request: HttpRequest, rest
                       ) -> HttpResponse:
@@ -1465,6 +1671,14 @@ class HttpRpcRouter:
                             "= router",
                             "this TSD is not a cluster router")
         sub = rest[0] if rest else ""
+        if sub == "status":
+            # consolidated operator progress surface: reshard epoch +
+            # backfill done-markers + retire progress + per-peer
+            # spool backlog and dirty-debt age, with ETA estimates
+            if request.method != "GET":
+                raise HttpError(405, "Method not allowed")
+            return HttpResponse(200, json.dumps(
+                cluster.cluster_status()).encode())
         if sub == "reshard":
             if request.method == "POST":
                 obj = request.json_object(default={})
@@ -1595,6 +1809,18 @@ class HttpRpcRouter:
         clus = getattr(t, "_cluster", None)
         if clus is not None:
             cluster_info = clus.health_info()
+            # fleet roll-up: one status row per shard (scattered
+            # /api/health, breaker-aware — an unreachable shard is a
+            # row, never a 5xx out of THIS endpoint)
+            cluster_info["fleet"] = clus.fleet_health()
+            if cluster_info["fleet"]["degraded"]:
+                causes.append("fleet_shards_degraded")
+            dirty_age = cluster_info.get("replica_dirty", {}).get(
+                "oldest_age_s", 0)
+            if dirty_age > 3600:
+                # silent week-old divergence debt must not look like
+                # a seconds-old blip
+                causes.append("replica_dirty_debt_stale")
             for _pname, peer in sorted(clus.peers.items()):
                 pb = peer.breaker
                 breakers[pb.name] = pb.health_info()
@@ -1631,6 +1857,12 @@ class HttpRpcRouter:
             # request-level + per-stage latency percentiles
             # (p50/p95/p99/p999; stages fed by the tracer)
             "latency": t.stats.latency_summary(),
+            # SLO burn rates: "are we eating the error budget" per
+            # endpoint, per window (obs/slo.py; also at /metrics)
+            "slo": t.slo.health_info(),
+            # continuous sampling profiler state (obs/profiler.py;
+            # the samples themselves serve at GET /api/profile)
+            "profiler": t.profiler.health_info(),
             # tracing subsystem state (ring depths, sampling,
             # slowlog, query-shape log)
             "trace": t.tracer.health_info(),

@@ -382,6 +382,12 @@ class TSDServer:
         # self-telemetry pump (obs/telemetry.py): no-op unless
         # tsd.stats.self_interval > 0. Stopped by TSDB.shutdown.
         self.tsdb.telemetry.start()
+        # continuous sampling profiler (obs/profiler.py): the
+        # always-on low-rate ring behind GET /api/profile — the last
+        # tsd.profile.ring_s seconds of per-role stack samples are
+        # queryable after the fact. No-op when tsd.profile.enable is
+        # off or hz <= 0. Stopped (joined) by TSDB.shutdown.
+        self.tsdb.profiler.start()
         addr = self._server.sockets[0].getsockname()
         LOG.info("Ready to serve on %s:%s", addr[0], addr[1])
 
@@ -704,10 +710,24 @@ class TSDServer:
                 # them buried put latency in the query distribution
                 # and left latency_put empty since the seed
                 elapsed_ms = (time.monotonic() - t0) * 1000
+                is_put = not is_query and _is_put_path(
+                    urllib.parse.unquote(parsed.path))
                 if is_query:
                     self.tsdb.stats.latency_query.add(elapsed_ms)
-                elif _is_put_path(urllib.parse.unquote(parsed.path)):
+                elif is_put:
                     self.tsdb.stats.latency_put.add(elapsed_ms)
+                # SLO feed at RESPONSE time, from receipt: admission
+                # sheds and query timeouts — responses built right
+                # here, never entering HttpRpcRouter.handle — burn
+                # the availability budget like any other 5xx, and
+                # the recorded latency includes the queue wait (the
+                # handler gates its own feed on received_at, so a
+                # 504'd query's still-running worker records
+                # nothing)
+                slo = self.tsdb.slo
+                if slo.enabled and (is_query or is_put):
+                    slo.record("query" if is_query else "put",
+                               elapsed_ms, response.status >= 500)
             self._apply_cors(request, response)
             await self._apply_gzip(request, response)
             if getattr(response, "close_connection", False):

@@ -253,6 +253,11 @@ _DEFAULTS: dict[str, str] = {
     "tsd.cluster.spool.replay_batch": "64",
     #   scatter/forward worker pool (0 = 2x peer count)
     "tsd.cluster.fanout_workers": "0",
+    #   TTL on the router /api/health `fleet` section (a per-shard
+    #   health scatter): health is a probe surface polled every
+    #   second or two — the cache keeps it O(local) between
+    #   refreshes (0 = scatter every call)
+    "tsd.cluster.fleet_health_ttl_ms": "5000",
     # auth
     "tsd.core.authentication.enable": "false",
     # stats
@@ -285,6 +290,28 @@ _DEFAULTS: dict[str, str] = {
     # full fidelity regardless of sampling + WARNed into /logs with
     # its trace id (0 = off)
     "tsd.query.slowlog.threshold_ms": "0",
+    # continuous sampling profiler (obs/profiler.py): a bounded
+    # background thread folds sys._current_frames() into per-role
+    # stack counts at `hz`, keeping the last `ring_s` seconds —
+    # GET /api/profile serves the window flamegraph-ready. The
+    # default rate is deliberately low enough to leave on (the obs2
+    # bench holds it to <= 5% overhead).
+    "tsd.profile.enable": "true",
+    "tsd.profile.hz": "4",
+    "tsd.profile.ring_s": "60",
+    "tsd.profile.max_depth": "48",
+    # SLO burn-rate gauges (obs/slo.py): per-endpoint latency +
+    # availability objectives; burn = bad-fraction / error budget,
+    # derived over each window and exported at /metrics +
+    # /api/health. 1.0 = consuming the budget exactly.
+    "tsd.slo.enable": "true",
+    "tsd.slo.windows": "300,3600",
+    "tsd.slo.query.latency_ms": "1000",
+    "tsd.slo.query.latency_objective": "0.99",
+    "tsd.slo.query.availability_objective": "0.999",
+    "tsd.slo.put.latency_ms": "500",
+    "tsd.slo.put.latency_objective": "0.99",
+    "tsd.slo.put.availability_objective": "0.999",
     # TPU-native keys (no reference equivalent)
     "tsd.tpu.dtype": "float32",
     "tsd.tpu.platform": "",  # force jax platform (cpu|tpu|axon); "" = auto

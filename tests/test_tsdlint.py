@@ -167,6 +167,26 @@ class TestPassFixtures:
         ]
         # the format_error-built 500 and the 4xx literal stay clean
 
+    def test_histogram_export(self):
+        rep = lint_fixture("fixture_histogram_export.py")
+        assert [(f.pass_id, f.line, f.detail)
+                for f in rep.unsuppressed] == [
+            ("histogram-export", 15, "hidden_hist"),
+            ("histogram-export", 37, "<anonymous>"),
+        ]
+        # the enumeration-referenced, setdefault-registry and
+        # inline-annotated histograms stayed clean
+        assert "hidden_hist" in rep.unsuppressed[0].message
+
+    def test_histogram_export_real_registry_is_reachable(self):
+        # the live registry's own histograms (latency_put/query +
+        # stage map) are the canonical clean case: the whole-package
+        # run must not flag stats.py
+        rep = run_tsdlint(pass_ids=["histogram-export"],
+                          baseline_path=None)
+        assert rep.unsuppressed == [], \
+            [str(f) for f in rep.unsuppressed]
+
     def test_pass_selection(self):
         rep = lint_fixture("fixture_swallow.py",
                            pass_ids=["config-keys"])

@@ -1,0 +1,37 @@
+"""Seeded violations for the ``histogram-export`` pass.
+
+``Metrics.hidden_hist`` is recorded-but-unscrapeable (nothing in the
+renderer or any ``histograms()`` enumeration references it) and
+``_orphan()`` constructs one with no recoverable binding; everything
+else demonstrates the clean idioms — enumeration-referenced, keyed
+setdefault registry, and an annotated deliberate case.
+"""
+
+from opentsdb_tpu.stats.stats import Histogram
+
+
+class Metrics:
+    def __init__(self):
+        self.hidden_hist = Histogram(1000, 2, 1)      # FINDING
+        self.ok_hist = Histogram(1000, 2, 1)          # enumerated below
+        self.keyed = {}
+        # tsdlint: allow[histogram-export] deliberately internal —
+        # this fixture proves the inline allow suppresses the finding
+        self.internal_hist = Histogram(1000, 2, 1)
+
+    def observe(self, stage, ms):
+        self.keyed.setdefault(stage, Histogram(1000, 2, 1)).add(ms)
+
+    def reset(self):
+        self.keyed.clear()   # eviction evidence for unbounded-growth
+
+    def histograms(self):
+        # export evidence: loads of ok_hist AND the keyed registry
+        out = [("fx_ok_ms", {}, self.ok_hist)]
+        for stage, h in self.keyed.items():
+            out.append(("fx_stage_ms", {"stage": stage}, h))
+        return out
+
+
+def _orphan():
+    Histogram(1000, 2, 1)                             # FINDING (anonymous)
