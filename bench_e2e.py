@@ -1749,6 +1749,230 @@ def bench_cluster_rf(repeats: int, n_hosts: int = 60,
     return out
 
 
+def bench_multirouter(repeats: int, n_hosts: int = 60,
+                      span_s: int = 300) -> dict:
+    """Multi-router front door (ISSUE 16): TWO routers on real
+    sockets over a shared 3-shard set, exchanging cache-invalidation
+    deltas on the gossip bus (cluster/gossip.py). Prices what the
+    single-router cluster config cannot: the gossip push round-trip,
+    the write-on-A-coherent-read-on-B lag (THE multi-router number),
+    the cached-read hit path with gossip healthy, and the
+    conservative cache-BYPASSED read served while the sibling is
+    unreachable (the degraded mode that replaces stale serves).
+    tests/test_multirouter.py proves the values; this config prices
+    the transport."""
+    import asyncio
+    import http.client
+    import json as _json
+    import socket
+    import threading
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    class Node:
+        def __init__(self, cfg, port=0):
+            self.tsdb = TSDB(Config(**cfg))
+            self.loop = asyncio.new_event_loop()
+            self.server = TSDServer(self.tsdb, host="127.0.0.1",
+                                    port=port)
+            started = threading.Event()
+
+            def run():
+                asyncio.set_event_loop(self.loop)
+                self.loop.run_until_complete(self.server.start())
+                started.set()
+                self.loop.run_forever()
+
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+            assert started.wait(30)
+            self.port = (self.server._server.sockets[0]
+                         .getsockname()[1])
+
+        def _call(self, coro):
+            return asyncio.run_coroutine_threadsafe(
+                coro, self.loop).result(20)
+
+        def kill(self):
+            async def _close():
+                srv = self.server._server
+                if srv is not None:
+                    srv.close()
+                    await srv.wait_closed()
+                    self.server._server = None
+            self._call(_close())
+
+        def stop(self):
+            try:
+                self._call(self.server.stop())
+            except Exception:  # noqa: BLE001
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+
+    def request(port, method, path, body=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=30)
+        try:
+            data = (_json.dumps(body).encode()
+                    if body is not None else None)
+            conn.request(method, path, body=data)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    peer_cfg = {"tsd.core.auto_create_metrics": "true",
+                "tsd.tpu.warmup": "false"}
+    shards = [Node(peer_cfg) for _ in range(3)]
+    spec = ",".join(f"s{i}=127.0.0.1:{p.port}"
+                    for i, p in enumerate(shards))
+    ports = [free_port(), free_port()]
+    routers = [Node({
+        "tsd.cluster.role": "router",
+        "tsd.cluster.peers": spec,
+        "tsd.cluster.routers": f"r{1 - i}=127.0.0.1:{ports[1 - i]}",
+        "tsd.cluster.gossip.interval_ms": "50",
+        "tsd.cluster.gossip.stale_ms": "2000",
+        "tsd.tpu.warmup": "false"}, port=ports[i])
+        for i in (0, 1)]
+
+    points = [{"metric": "bench.mr",
+               "timestamp": BASE_S + i,
+               "value": (h * 37 + i) % 1000,
+               "tags": {"host": f"h{h:03d}"}}
+              for h in range(n_hosts) for i in range(span_s)]
+    batches = [points[i:i + 4000]
+               for i in range(0, len(points), 4000)]
+
+    # LB-style alternating ingest over both front doors, then the
+    # same batches through ONE door (idempotent rewrite): the ratio
+    # prices what the second router costs/buys on the write path
+    t0 = time.perf_counter()
+    for k, b in enumerate(batches):
+        st, body = request(routers[k % 2].port,
+                           "POST", "/api/put?summary=true", b)
+        assert st == 200 and _json.loads(body)["failed"] == 0
+    lb_ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for b in batches:
+        st, body = request(routers[0].port,
+                           "POST", "/api/put?summary=true", b)
+        assert st == 200 and _json.loads(body)["failed"] == 0
+    one_ingest_s = time.perf_counter() - t0
+
+    qbody = {"start": BASE_MS - 1000,
+             "end": BASE_MS + span_s * 1000,
+             "queries": [{"metric": "bench.mr",
+                          "aggregator": "sum",
+                          "downsample": "10s-sum",
+                          "filters": [{"type": "wildcard",
+                                       "tagk": "host", "filter": "*",
+                                       "groupBy": True}]}]}
+
+    def read_p50(port, reps):
+        request(port, "POST", "/api/query", qbody)  # warm + cache
+        times, body = [], b""
+        for _ in range(max(reps, 3)):
+            t1 = time.perf_counter()
+            st, body = request(port, "POST", "/api/query", qbody)
+            times.append(time.perf_counter() - t1)
+            assert st == 200
+        return _percentile(times, 50) * 1e3, body
+
+    r0_p50, r0_body = read_p50(routers[0].port, repeats)
+    r1_p50, r1_body = read_p50(routers[1].port, repeats)
+    merged_identical = r0_body == r1_body
+
+    # gossip push round-trip (one delta round to the sibling)
+    bus0 = routers[0].tsdb.cluster.gossip
+    push_times = []
+    for _ in range(max(repeats, 5)):
+        t1 = time.perf_counter()
+        assert bus0.push_once() == 1
+        push_times.append(time.perf_counter() - t1)
+    push_p50 = _percentile(push_times, 50) * 1e3
+
+    # write-on-B / coherent-read-on-A lag: the wall-clock from an
+    # acked sibling write to the first r0 answer that contains it
+    # (wake-on-write + one gossip push; polls are 1 ms)
+    probe_q = {"start": BASE_MS - 1000,
+               "end": BASE_MS + (span_s + 100) * 1000,
+               "queries": [{"metric": "bench.mr.probe",
+                            "aggregator": "sum"}]}
+    lag_times, coherent = [], True
+    for k in range(max(repeats, 5)):
+        dp = [{"metric": "bench.mr.probe",
+               "timestamp": BASE_S + span_s + k,
+               "value": k + 1, "tags": {"host": "lb"}}]
+        st, body = request(routers[1].port,
+                           "POST", "/api/put?summary=true", dp)
+        assert st == 200 and _json.loads(body)["failed"] == 0
+        t1 = time.perf_counter()
+        deadline = t1 + 10
+        seen = False
+        while time.perf_counter() < deadline:
+            st, body = request(routers[0].port, "POST",
+                               "/api/query", probe_q)
+            if st == 200 and f'"{BASE_S + span_s + k}"' \
+                    in body.decode():
+                seen = True
+                break
+            time.sleep(0.001)
+        coherent &= seen
+        lag_times.append(time.perf_counter() - t1)
+    lag_p50 = _percentile(lag_times, 50) * 1e3
+
+    # sibling gone: the router degrades to cache-BYPASSED reads —
+    # conservative exactness, never stale, never a 5xx
+    routers[1].kill()
+    deadline = time.monotonic() + 10
+    while not bus0.degraded() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    degraded_verdict = bus0.degraded()
+    bypass_before = bus0.cache_bypasses
+    degraded_times, degraded_ok = [], True
+    for _ in range(max(repeats, 3)):
+        t1 = time.perf_counter()
+        st, body = request(routers[0].port, "POST", "/api/query",
+                           qbody)
+        degraded_times.append(time.perf_counter() - t1)
+        degraded_ok &= (st == 200 and body == r0_body)
+    degraded_p50 = _percentile(degraded_times, 50) * 1e3
+    bypassed = bus0.cache_bypasses > bypass_before
+
+    out = {"config": "multirouter", "routers": 2, "shards": 3,
+           "series": n_hosts, "points": len(points),
+           "lb_ingest_kpps":
+               round(len(points) / lb_ingest_s / 1e3, 1),
+           "single_door_ingest_kpps":
+               round(len(points) / one_ingest_s / 1e3, 1),
+           "read_p50_r0_ms": round(r0_p50, 1),
+           "read_p50_r1_ms": round(r1_p50, 1),
+           "gossip_push_p50_ms": round(push_p50, 2),
+           "sibling_write_coherence_lag_p50_ms": round(lag_p50, 1),
+           "read_p50_sibling_dead_bypassed_ms":
+               round(degraded_p50, 1),
+           "merged_identical_across_routers": merged_identical,
+           "coherent_after_sibling_write": coherent,
+           "degraded_reads_exact_200": degraded_ok,
+           "degraded_verdict_raised": degraded_verdict,
+           "cache_bypassed_while_degraded": bypassed,
+           "criterion_pass": bool(
+               merged_identical and coherent and degraded_ok
+               and degraded_verdict and bypassed)}
+    for r in routers:
+        r.stop()
+    for p in shards:
+        p.stop()
+    return out
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -1776,6 +2000,7 @@ def main() -> None:
                "ingest": bench_ingest, "viz": bench_viz,
                "cluster": bench_cluster,
                "cluster_rf": bench_cluster_rf,
+               "multirouter": bench_multirouter,
                "streamv2": bench_streamv2, "obs": bench_obs,
                "obs2": bench_obs2}
     out = []

@@ -222,6 +222,13 @@ def cluster_status(router) -> dict[str, Any]:
     doc["peers"] = peers
     doc["spool_backlog_records"] = backlog_total
     doc["dirty_oldest_age_s"] = worst_age_s
+    # -- query-path read-repair (source-side work maybe_repair does
+    # on behalf of reads — queue depth/shed/completions were
+    # invisible here before) ------------------------------------------
+    doc["read_repair"] = router.read_repair.health_info()
+    # -- sibling-router gossip bus -------------------------------------
+    if router.gossip is not None:
+        doc["gossip"] = router.gossip.health_info()
     return doc
 
 

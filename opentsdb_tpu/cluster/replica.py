@@ -281,5 +281,111 @@ class DirtyTracker:
         }
 
 
-__all__ = ["DirtyTracker", "parse_sel", "ring_for", "sel_cache_key",
-           "sel_doc", "series_mask"]
+class ReadRepairQueue:
+    """Query-path read-repair staging: divergence observed BY A READ
+    (a fallback round re-read a failed reader's sets; replica answers
+    disagreed about a metric's existence) enqueues here, and the
+    router's replay loop drains entries into :class:`DirtyTracker` /
+    ``maybe_repair`` off the read path — ``DirtyTracker.mark`` fsyncs
+    under its lock, which a serve path must never wait on.
+
+    Bounded and dedicated to staging, not truth: the queue dedupes on
+    (peer, metric) keeping the EARLIEST suspicion stamp, sheds-and-
+    counts past ``max_pending`` (a shed entry is a lost repair hint,
+    not a lost write — the next read of the same divergence re-
+    enqueues), and tracks drained-but-unrepaired keys in an inflight
+    set so ``oldest_pending_age_s`` spans the whole mark→repair
+    pipeline, not just the staging dict. False-positive enqueues are
+    harmless: repair is idempotent and a clean window clears to a
+    no-op."""
+
+    def __init__(self, max_pending: int = 1024):
+        self._lock = threading.Lock()
+        self.max_pending = max(int(max_pending), 1)
+        # (peer, metric) -> (since_ms, enqueued_monotonic)
+        self._pending: dict[tuple[str, str], tuple[int, float]] = {}
+        # drained into the DirtyTracker but not yet repaired
+        self._inflight: dict[tuple[str, str], float] = {}
+        self.enqueued = 0
+        self.shed = 0
+        self.completed = 0
+
+    def enqueue(self, peer: str, metrics: Iterable[str],
+                since_ms: int) -> int:
+        """Stage suspicion windows; returns how many were accepted
+        (the rest shed). Lock-cheap: dict ops only, no IO."""
+        accepted = 0
+        now = time.monotonic()
+        with self._lock:
+            for m in metrics:
+                key = (peer, m)
+                cur = self._pending.get(key)
+                if cur is not None:
+                    if since_ms < cur[0]:
+                        self._pending[key] = (int(since_ms), cur[1])
+                    continue
+                if key in self._inflight:
+                    continue  # already marked; repair will cover it
+                if len(self._pending) >= self.max_pending:
+                    self.shed += 1
+                    continue
+                self._pending[key] = (int(since_ms), now)
+                self.enqueued += 1
+                accepted += 1
+        return accepted
+
+    def drain(self) -> list[tuple[str, str, int]]:
+        """Move every staged entry to inflight and return
+        ``[(peer, metric, since_ms), ...]`` for the caller to mark
+        dirty (off the read path)."""
+        with self._lock:
+            out = [(p, m, s) for (p, m), (s, _) in
+                   self._pending.items()]
+            for key, (_, stamp) in self._pending.items():
+                self._inflight.setdefault(key, stamp)
+            self._pending.clear()
+        return out
+
+    def note_repaired(self, peer: str, metrics: Iterable[str]
+                      ) -> None:
+        """The repair pass cleared these dirty windows — retire their
+        inflight stamps and count completions."""
+        with self._lock:
+            for m in metrics:
+                if self._inflight.pop((peer, m), None) is not None:
+                    self.completed += 1
+
+    def drop_peer(self, peer: str) -> None:
+        """The peer left the ring; its staged/inflight debt is void."""
+        with self._lock:
+            for d in (self._pending, self._inflight):
+                for key in [k for k in d if k[0] == peer]:
+                    del d[key]
+
+    def oldest_pending_age_s(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            stamps = [t for _, t in self._pending.values()]
+            stamps.extend(self._inflight.values())
+        if not stamps:
+            return 0.0
+        return round(max(now - min(stamps), 0.0), 1)
+
+    def health_info(self) -> dict[str, Any]:
+        with self._lock:
+            depth = len(self._pending)
+            inflight = len(self._inflight)
+            enqueued, shed, completed = \
+                self.enqueued, self.shed, self.completed
+        return {
+            "depth": depth,
+            "inflight": inflight,
+            "enqueued": enqueued,
+            "shed": shed,
+            "completed": completed,
+            "oldest_pending_age_s": self.oldest_pending_age_s(),
+        }
+
+
+__all__ = ["DirtyTracker", "ReadRepairQueue", "parse_sel",
+           "ring_for", "sel_cache_key", "sel_doc", "series_mask"]

@@ -160,6 +160,49 @@ class ReshardState:
             self.done = {}
             self._save_locked()
 
+    def adopt(self, epoch: int, new_spec: str, new_vnodes: int,
+              old_spec: str, old_vnodes: int, fence_ms: int) -> bool:
+        """Adopt a sibling router's OPEN cutover window at ``epoch``
+        (gossip topology hand-off). Unlike :meth:`begin` the epoch and
+        fence come from the initiator — every router must agree on
+        them or their epoch-qualified caches diverge. Done-markers
+        start empty: this router runs its own backfill (idempotent —
+        duplicated copy units dedupe last-write-wins on the shards),
+        which is exactly what lets a sibling resume a reshard whose
+        initiator died mid-flight. Returns False when ``epoch`` is not
+        ahead of the local one."""
+        with self._lock:
+            if epoch <= self.epoch:
+                return False
+            self.epoch = int(epoch)
+            self.peers_spec = new_spec
+            self.vnodes = int(new_vnodes)
+            self.old_spec = old_spec
+            self.old_vnodes = int(old_vnodes)
+            self.fence_ms = int(fence_ms)
+            self.done = {}
+            self._save_locked()
+            return True
+
+    def adopt_final(self, epoch: int, spec: str, vnodes: int) -> bool:
+        """Adopt a sibling's FINALIZED ring: either the close of this
+        router's own open window at the same epoch, or a whole
+        already-finalized epoch this router never saw begin. Returns
+        False when nothing changed."""
+        with self._lock:
+            if epoch < self.epoch or (
+                    epoch == self.epoch and not self.old_spec):
+                return False
+            self.epoch = int(epoch)
+            self.peers_spec = spec
+            self.vnodes = int(vnodes)
+            self.old_spec = ""
+            self.old_vnodes = 0
+            self.fence_ms = 0
+            self.done = {}
+            self._save_locked()
+            return True
+
     def mark_done(self, old_peer: str, metric: str) -> None:
         with self._lock:
             per = self.done.setdefault(old_peer, [])

@@ -246,6 +246,25 @@ class ClusterRouter:
         self.metric_versions_max = max(config.get_int(
             "tsd.cluster.metric_versions.max_entries", 100000), 1)
         self._global_version = 0
+        # query-path read-repair: divergence a READ observed (failed
+        # reader re-covered by a fallback round; replicas disagreeing
+        # whether a metric exists) stages here and drains into the
+        # DirtyTracker off the read path — mark() fsyncs under its
+        # lock, which a serve path must never wait on
+        self.read_repair_enabled = config.get_bool(
+            "tsd.cluster.read_repair.enable", True)
+        self.read_repair = replica_mod.ReadRepairQueue(
+            config.get_int("tsd.cluster.read_repair.max_pending",
+                           1024))
+        # multi-router version bus (cluster/gossip.py): sibling
+        # routers named by tsd.cluster.routers exchange write-version
+        # + reshard-epoch deltas so every front door's epoch-qualified
+        # result cache invalidates on writes ANY of them forwarded
+        self.gossip = None
+        routers_spec = config.get_string("tsd.cluster.routers", "")
+        if routers_spec.strip():
+            from opentsdb_tpu.cluster.gossip import GossipBus
+            self.gossip = GossipBus(self, routers_spec)
         # TTL cache for the /api/health fleet section (see
         # fleet_health): (doc, monotonic stamp)
         self._fleet_health_lock = threading.Lock()
@@ -271,6 +290,8 @@ class ClusterRouter:
                              name="cluster-replay", daemon=True)
         self._replay_thread = t
         t.start()
+        if self.gossip is not None:
+            self.gossip.start()
         if self.state.active:
             self._start_backfill()
         elif self.retire_enabled and self.retirer.pending():
@@ -297,6 +318,8 @@ class ClusterRouter:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.gossip is not None:
+            self.gossip.stop()
         for t in (self._replay_thread, self._backfill_thread,
                   self._retire_thread):
             if t is not None and t.is_alive():
@@ -866,6 +889,10 @@ class ClusterRouter:
     def _replay_loop(self) -> None:
         while not self._stop.wait(self.replay_interval_s):
             self.sweep_sub_memo()
+            try:
+                self.drain_read_repair()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                LOG.exception("read-repair drain failed")
             for peer in list(self.peers.values()):
                 try:
                     self.drain_spool(peer)
@@ -1008,6 +1035,38 @@ class ClusterRouter:
     # anti-entropy: repair a returned replica from a surviving one
     # ------------------------------------------------------------------
 
+    def drain_read_repair(self) -> int:
+        """Move read-observed divergence hints from the bounded
+        staging queue into the :class:`DirtyTracker` (whose ``mark``
+        fsyncs — never acceptable on the read path that staged them).
+        The marked windows then heal through the normal
+        ``maybe_repair`` machinery in this same loop; completion is
+        counted back via ``read_repair.note_repaired``. Returns how
+        many hints were marked."""
+        staged = self.read_repair.drain()
+        if not staged:
+            return 0
+        tracer = getattr(self.tsdb, "tracer", None)
+        tctx = tracer.start_background("cluster.read_repair",
+                                       entries=len(staged)) \
+            if tracer is not None and tracer.enabled else None
+        marked = 0
+        try:
+            with trace_mod.use(tctx):
+                for peer_name, metric, since_ms in staged:
+                    if peer_name in self.peers:
+                        self.dirty.mark(peer_name, [metric],
+                                        since_ms)
+                        marked += 1
+                    else:
+                        # the peer left the ring between the read and
+                        # this drain: its debt is void
+                        self.read_repair.drop_peer(peer_name)
+        finally:
+            if tracer is not None and tctx is not None:
+                tracer.finish(tctx)
+        return marked
+
     def maybe_repair(self, peer: Peer) -> bool:
         """Run one anti-entropy pass for a peer with dirty windows,
         once its spool is drained (replay covers everything the spool
@@ -1087,6 +1146,7 @@ class ClusterRouter:
             # owns anything on this ring: there is nothing to repair
             # FROM (or for) — the debt is void
             self.dirty.clear(peer.name)
+            self.read_repair.drop_peer(peer.name)
             return True
         now_ms = int(time.time() * 1000)
         all_done = True
@@ -1137,6 +1197,7 @@ class ClusterRouter:
             if metric_ok:
                 self.repair_points += copied
                 self.dirty.clear(peer.name, [metric])
+                self.read_repair.note_repaired(peer.name, [metric])
             else:
                 all_done = False
         if all_done:
@@ -1300,6 +1361,11 @@ class ClusterRouter:
             {k: set() for k in range(len(peer_subs))}
         sub_unknown: dict[int, set] = \
             {k: set() for k in range(len(peer_subs))}
+        # unknown outcomes served from the memo (vs a FRESH 400 this
+        # scatter): the read-repair divergence hook ignores them, or
+        # every repeat query of a legitimately shard-unknown metric
+        # would re-stage the same no-op repair
+        sub_memo_unknown: dict[int, set] = {}
         partials: list[list[dict]] = []
         failed_peers: set[str] = set()
         degraded_set: set[str] = set()
@@ -1345,6 +1411,8 @@ class ClusterRouter:
                         sub_400.setdefault(k, []).append(cached)
                         sub_unknown[k].add(name)
                         sub_answered[k].add(name)
+                        sub_memo_unknown.setdefault(k, set()) \
+                            .add(name)
                 round_req[name] = (peer, sel, sent, req_obj)
                 if not sent:
                     continue  # nothing this shard knows
@@ -1465,6 +1533,18 @@ class ClusterRouter:
                     len(v) for v in next_pending.values())
             pending = next_pending
         degraded = sorted(degraded_set)
+        if failed_peers and self.read_repair_enabled and rf > 1 \
+                and not tsq.delete:
+            # a reader that died mid-scatter may be missing writes in
+            # the window this read wanted (a fallback round covered
+            # its sets, but the replica itself stays suspect): stage
+            # the window for repair — idempotent, so a reader that
+            # merely timed out heals to a no-op
+            metrics = {s.metric for s in tsq.queries if s.metric}
+            since = max(int(tsq.start_ms), 1)
+            for name in sorted(failed_peers):
+                if metrics and name in self.peers:
+                    self.read_repair.enqueue(name, metrics, since)
         if tsq.delete:
             # the shards already purged whatever rows they own during
             # the scatter (and per-sub retries): any cached entry
@@ -1496,6 +1576,26 @@ class ClusterRouter:
                     except Exception:  # noqa: BLE001
                         msg = errs[0].decode("utf-8", "replace")[:200]
                     raise BadRequestError(msg)
+        if self.read_repair_enabled and rf > 1 and not tsq.delete:
+            # replica-divergence detection: replicas DISAGREED about
+            # a metric's existence this scatter (some answered series,
+            # others freshly 400'd "no such name"). The unknown side
+            # may have lost the series' writes — or may legitimately
+            # be assigned none of them; staging is cheap and a clean
+            # window repairs to a no-op. Memo-served unknowns are
+            # excluded (nothing new was observed about them).
+            for idx, unknown in sub_unknown.items():
+                fresh = unknown - sub_memo_unknown.get(idx, set())
+                if not fresh or unknown == sub_answered[idx]:
+                    continue
+                metric = peer_subs[idx].get("metric") or ""
+                if not metric:
+                    continue
+                since = max(int(tsq.start_ms), 1)
+                for name in sorted(fresh):
+                    if name in self.peers:
+                        self.read_repair.enqueue(name, [metric],
+                                                 since)
         if degraded:
             self.degraded_queries += 1
             if tctx is not None:
@@ -1774,9 +1874,10 @@ class ClusterRouter:
     # result cache integration
     # ------------------------------------------------------------------
 
-    def _bump_versions(self, metrics) -> None:
+    def _bump_versions(self, metrics, announce: bool = True) -> None:
+        names = set(metrics)
         with self._version_lock:
-            for m in set(metrics):
+            for m in names:
                 self._metric_versions[m] = \
                     self._metric_versions.get(m, 0) + 1
             if len(self._metric_versions) > self.metric_versions_max:
@@ -1786,10 +1887,18 @@ class ClusterRouter:
                 # the map restarts bounded
                 self._metric_versions.clear()
                 self._global_version += 1
+        # gossip AFTER releasing the version lock (the bus has its
+        # own lock; never hold both). announce=False is the receive
+        # side applying a sibling's delta — re-logging it would
+        # bounce the same invalidation between routers forever.
+        if announce and names and self.gossip is not None:
+            self.gossip.record_writes(names)
 
-    def _bump_global_version(self) -> None:
+    def _bump_global_version(self, announce: bool = True) -> None:
         with self._version_lock:
             self._global_version += 1
+        if announce and self.gossip is not None:
+            self.gossip.record_global()
 
     def write_version(self, tsq=None) -> tuple:
         """Invalidation version of the router's view of the cluster
@@ -1847,6 +1956,17 @@ class ClusterRouter:
         partial is NEVER retained (the marker must never outlive the
         outage it reports); a later complete answer repopulates."""
         cache = self.tsdb.result_cache
+        if self.gossip is not None and self.gossip.degraded():
+            # a partitioned sibling router may be forwarding writes
+            # whose invalidations this router cannot see: any cache
+            # hit could be stale and any store could cache around an
+            # unseen write. Bypass the cache entirely — exact answers,
+            # never a stale serve, never a 5xx — until a gossip push
+            # lands again.
+            self.gossip.cache_bypasses += 1
+            if cache is not None:
+                cache.count_bypass()
+            return self.execute_query(tsq)
         plan = self.cache_plan(tsq) if cache is not None else None
         if plan is None:
             if cache is not None:
@@ -1969,6 +2089,7 @@ class ClusterRouter:
                             pending)
                     peer.spool.close()
                 self.dirty.drop_peer(n)
+                self.read_repair.drop_peer(n)
                 self.invalidate_sub_memo(n)
             self._bump_global_version()
             # the ownership map just changed: re-arm the stale-copy
@@ -1979,6 +2100,107 @@ class ClusterRouter:
                  self.state.epoch, ",".join(self.ring.names))
         if self._started and self.retire_enabled:
             self._start_retire()
+
+    def adopt_topology(self, doc: dict) -> bool:
+        """Adopt a sibling router's gossiped ring topology. Three
+        shapes: the remote epoch is BEHIND (or equal with the same
+        phase) — no-op; the remote FINALIZED the epoch this router
+        still holds open — finalize locally; the remote epoch is
+        AHEAD — install its ring, and when the cutover window is
+        still open, adopt the dual-write window and run a local
+        idempotent backfill. The last shape is what lets a sibling
+        RESUME a reshard whose initiating router was killed
+        mid-flight: duplicated copy units dedupe last-write-wins on
+        the shards. Version bumps here do not re-announce — the
+        initiator already announced the epoch change to every
+        sibling. Returns True when anything changed."""
+        try:
+            epoch = int(doc.get("epoch", 0))
+            spec = str(doc.get("peers", "") or "")
+            vnodes = int(doc.get("vnodes", 0) or 0)
+            active = bool(doc.get("active"))
+            old_spec = str(doc.get("old_peers", "") or "")
+            old_vnodes = int(doc.get("old_vnodes", 0) or 0)
+            fence_ms = int(doc.get("fence_ms", 0) or 0)
+        except (TypeError, ValueError):
+            return False
+        if epoch < self.state.epoch or not spec:
+            return False
+        if epoch == self.state.epoch:
+            if active or not self.state.active:
+                return False  # same epoch, same phase: in agreement
+            # the sibling finalized the window this router still
+            # holds open (its backfill completed first): finalize
+            # locally — idempotent under _reshard_lock
+            self.finalize_reshard()
+            return True
+        specs = parse_peer_spec(spec)
+        if not specs:
+            return False
+        resumed = False
+        with self._reshard_lock:
+            if epoch <= self.state.epoch:
+                return False  # raced with another adoption
+            vn = int(vnodes) or self.ring.vnodes
+            for name, host, port in specs:
+                cur = self.peers.get(name)
+                if cur is not None and (cur.client.host != host or
+                                        cur.client.port != port):
+                    LOG.warning(
+                        "gossiped topology renames shard %s (%s -> "
+                        "%s:%d); refusing adoption", name,
+                        cur.client.address, host, port)
+                    return False
+                if cur is None:
+                    self.peers[name] = Peer(name, host, port,
+                                            self.config,
+                                            self._spool_dir)
+            if active and old_spec:
+                old_specs = parse_peer_spec(old_spec)
+                for name, host, port in old_specs:
+                    if name not in self.peers:
+                        self.peers[name] = Peer(name, host, port,
+                                                self.config,
+                                                self._spool_dir)
+                if not self.state.adopt(epoch, spec, vn, old_spec,
+                                        old_vnodes or vn, fence_ms):
+                    return False
+                # same ordering rule as begin_reshard: old_ring
+                # fills first so a racing writer's worst case is
+                # old-owners-only — which the backfill still moves
+                self.old_ring = HashRing(
+                    [n for n, _, _ in old_specs],
+                    vnodes=old_vnodes or vn)
+                self.ring = HashRing([n for n, _, _ in specs],
+                                     vnodes=vn)
+                self.backfiller.reset()
+                resumed = True
+            else:
+                if not self.state.adopt_final(epoch, spec, vn):
+                    return False
+                self.old_ring = None
+                self.ring = HashRing([n for n, _, _ in specs],
+                                     vnodes=vn)
+                for n in [n for n in self.peers
+                          if n not in self.ring.names]:
+                    peer = self.peers.pop(n, None)
+                    if peer is not None:
+                        peer.spool.close()
+                    self.dirty.drop_peer(n)
+                    self.read_repair.drop_peer(n)
+                    self.invalidate_sub_memo(n)
+                self.retirer.reset()
+            self._bump_global_version(announce=False)
+        LOG.info("adopted gossiped topology at epoch %d (cutover "
+                 "%s); ring: %s", epoch,
+                 "open" if resumed else "final",
+                 ",".join(self.ring.names))
+        if self._started:
+            if resumed:
+                self._start_backfill()
+            elif self.retire_enabled and self.retirer.pending():
+                self._start_retire()
+        return True
 
     def _backfill_loop(self) -> None:
         tracer = getattr(self.tsdb, "tracer", None)
@@ -2164,6 +2386,82 @@ class ClusterRouter:
                "totalResults": len(rows)}
         return doc, self._name_scatter_degraded(ring, failed)
 
+    def scatter_last(self, specs: list[dict], back_scan: int,
+                     resolve: bool
+                     ) -> tuple[list[dict], list[str]]:
+        """Scatter ``/api/query/last`` over the read ring and keep
+        the NEWEST point per series (metric + tags): at RF > 1 every
+        series answers once per replica, and after a reshard a former
+        owner's stale copy may still answer — both dedupe on the
+        series key with the newest timestamp winning (a stale copy is
+        by definition not newer than the live one, which dual-write
+        kept current). Shards are always asked to resolve names — the
+        merge key must be the one cluster-wide spelling, never the
+        per-shard TSUID bytes — and metric/tags are stripped back out
+        when the client didn't ask for them. Returns (points,
+        degraded shard names per the replica-coverage rule)."""
+        self.scatter_name_queries += 1
+        ring, names = self._read_view()
+        body = json.dumps({"queries": specs,
+                           "backScan": int(back_scan),
+                           "resolveNames": True}).encode()
+        futs = {name: self.pool.submit(
+                    self.fetch_guarded, peer, "POST",
+                    "/api/query/last", body)
+                for name in names
+                if (peer := self.peers.get(name)) is not None}
+        best: dict[tuple, dict] = {}
+        failed: set[str] = {n for n in names if n not in futs}
+        refused: list[bytes] = []
+        for name, fut in futs.items():
+            try:
+                status, data = fut.result(
+                    timeout=self.timeout_s * 2 + 5)
+                if status == 400:
+                    # a shard that owns no series of a spec'd metric
+                    # 400s "no such name": an empty partial from a
+                    # healthy shard, kept for the all-shards-agree
+                    # parity check below
+                    refused.append(data)
+                    continue
+                if status != 200:
+                    raise PeerError(f"query/last answered {status}")
+                doc = json.loads(data)
+                if not isinstance(doc, list):
+                    raise PeerError("query/last body is not a list")
+            except (OSError, ValueError,
+                    concurrent.futures.TimeoutError):
+                peer = self.peers.get(name)
+                if peer is not None:
+                    peer.query_failures += 1
+                failed.add(name)
+                continue
+            for r in doc:
+                if not isinstance(r, dict) or not r.get("metric"):
+                    continue
+                tags_doc = r.get("tags") or {}
+                key = (str(r.get("metric")),
+                       tuple(sorted(tags_doc.items())))
+                cur = best.get(key)
+                if cur is None or int(r.get("timestamp", 0)) \
+                        > int(cur.get("timestamp", 0)):
+                    best[key] = r
+        if refused and not best and not failed \
+                and len(refused) == len(futs):
+            # single-node parity: every shard that answered rejected
+            # every spec — surface the real client error
+            try:
+                msg = json.loads(refused[0])["error"]["message"]
+            except Exception:  # noqa: BLE001
+                msg = refused[0].decode("utf-8", "replace")[:200]
+            raise BadRequestError(msg)
+        points = [best[k] for k in sorted(best)]
+        if not resolve:
+            points = [{k: v for k, v in r.items()
+                       if k not in ("metric", "tags")}
+                      for r in points]
+        return points, self._name_scatter_degraded(ring, failed)
+
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
@@ -2261,6 +2559,9 @@ class ClusterRouter:
             "epoch": self.state.epoch,
             "reshard": self.reshard_info(),
             "replica_dirty": self.dirty.health_info(),
+            "read_repair": self.read_repair.health_info(),
+            "gossip": self.gossip.health_info()
+            if self.gossip is not None else None,
             "read_fallbacks": self.read_fallbacks,
             "repairs": self.repairs,
             "repair_points": self.repair_points,
@@ -2292,6 +2593,15 @@ class ClusterRouter:
                          self.repair_points)
         collector.record("cluster.replica.dirty_entries",
                          self.dirty.total_entries)
+        rr = self.read_repair.health_info()
+        collector.record("cluster.read_repair.depth", rr["depth"])
+        collector.record("cluster.read_repair.enqueued",
+                         rr["enqueued"])
+        collector.record("cluster.read_repair.shed", rr["shed"])
+        collector.record("cluster.read_repair.completed",
+                         rr["completed"])
+        if self.gossip is not None:
+            self.gossip.collect_stats(collector)
         collector.record("cluster.name_scatters",
                          self.scatter_name_queries)
         collector.record("cluster.reshard.backfilled_points",

@@ -75,6 +75,8 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "cluster.replica.repair",  # cluster/router.py anti-entropy pass
     "cluster.reshard.backfill",  # cluster/reshard.py moved-key copy
     "cluster.retire",        # cluster/retire.py stale-copy delete
+    "cluster.gossip.push",   # cluster/gossip.py sibling push round
+    "cluster.read_repair",   # cluster/router.py staged-hint drain
     "telemetry.pump",        # obs/telemetry.py self-stats ingest
     # ingest stages
     "ingest.decode",         # body parse + validate + series grouping

@@ -663,13 +663,14 @@ class HttpRpcRouter:
         from opentsdb_tpu.auth.simple import Permissions
         self._check_permission(request, Permissions.HTTP_QUERY)
         sub = rest[0] if rest else ""
-        if sub in ("last", "continuous", "exp", "gexp") \
+        if sub in ("continuous", "exp", "gexp") \
                 and self.tsdb.cluster is not None:
             # the router owns no data: these endpoints would silently
             # run against its EMPTY local store and answer "no such
             # name" / empty streams for series that exist in the
             # cluster. Refuse loudly until they learn to scatter
-            # (ROADMAP follow-up); plain /api/query merges shards.
+            # (ROADMAP follow-up); plain /api/query merges shards,
+            # /api/query/last scatters per shard (newest point wins).
             raise HttpError(
                 400,
                 f"/api/query/{sub} is not supported in router mode",
@@ -933,7 +934,12 @@ class HttpRpcRouter:
         raise HttpError(405, "Method not allowed")
 
     def _handle_query_last(self, request: HttpRequest) -> HttpResponse:
-        """(ref: QueryRpc.java:346 /api/query/last via TSUIDQuery)"""
+        """(ref: QueryRpc.java:346 /api/query/last via TSUIDQuery).
+        On a cluster router the request scatters to every read-ring
+        shard and the newest point per series wins the merge; tsuid
+        specs are refused (UIDs are per shard) and degraded shards
+        ride the trailing body marker + header, the /api/query
+        idiom."""
         from opentsdb_tpu.search.lookup import last_data_points
         if request.method == "POST":
             obj = request.json_object(default={})
@@ -956,6 +962,24 @@ class HttpRpcRouter:
                 "timeseries", [])]
             back_scan = int(request.param("back_scan", "0"))
             resolve = request.flag("resolve")
+        cluster = self.tsdb.cluster
+        if cluster is not None:
+            if any(q.get("tsuids") for q in specs):
+                raise HttpError(
+                    400,
+                    "tsuid specs are not supported in router mode",
+                    "UIDs are assigned per shard — query by metric "
+                    "and tags instead")
+            points, degraded = cluster.scatter_last(
+                specs, back_scan, resolve)
+            if degraded:
+                points = points + [{"shardsDegraded": degraded}]
+            resp = HttpResponse(
+                200, request.serializer.format_last_points(points))
+            if degraded:
+                resp.headers["X-OpenTSDB-Shards-Degraded"] = \
+                    ",".join(degraded)
+            return resp
         points = last_data_points(self.tsdb, specs, back_scan, resolve)
         return HttpResponse(200,
                             request.serializer.format_last_points(points))
@@ -1679,6 +1703,23 @@ class HttpRpcRouter:
                 raise HttpError(405, "Method not allowed")
             return HttpResponse(200, json.dumps(
                 cluster.cluster_status()).encode())
+        if sub == "gossip":
+            # sibling-router version bus (cluster/gossip.py): POST
+            # applies one sibling's delta push and answers the ack —
+            # the receive half of the multi-router cache-coherence
+            # story; never exposed without tsd.cluster.routers
+            if request.method != "POST":
+                raise HttpError(405, "Method not allowed")
+            if cluster.gossip is None:
+                raise HttpError(
+                    400, "gossip is not configured on this router",
+                    "set tsd.cluster.routers to the sibling list")
+            try:
+                ack = cluster.gossip.apply_remote(
+                    request.json_object())
+            except ValueError as exc:
+                raise BadRequestError(str(exc)) from None
+            return HttpResponse(200, json.dumps(ack).encode())
         if sub == "reshard":
             if request.method == "POST":
                 obj = request.json_object(default={})
@@ -1817,10 +1858,19 @@ class HttpRpcRouter:
                 causes.append("fleet_shards_degraded")
             dirty_age = cluster_info.get("replica_dirty", {}).get(
                 "oldest_age_s", 0)
-            if dirty_age > 3600:
+            rr_age = cluster_info.get("read_repair", {}).get(
+                "oldest_pending_age_s", 0)
+            if dirty_age > 3600 or rr_age > 3600:
                 # silent week-old divergence debt must not look like
-                # a seconds-old blip
+                # a seconds-old blip — whether anti-entropy marked it
+                # or a read observed it (the staged-hint pipeline)
                 causes.append("replica_dirty_debt_stale")
+            gossip_info = cluster_info.get("gossip")
+            if gossip_info and gossip_info.get("degraded"):
+                # a sibling router is partitioned: this router is
+                # serving cache-bypassed (exact, never stale) until
+                # its gossip pushes land again
+                causes.append("cluster_gossip_degraded")
             for _pname, peer in sorted(clus.peers.items()):
                 pb = peer.breaker
                 breakers[pb.name] = pb.health_info()

@@ -258,6 +258,33 @@ _DEFAULTS: dict[str, str] = {
     #   second or two — the cache keeps it O(local) between
     #   refreshes (0 = scatter every call)
     "tsd.cluster.fleet_health_ttl_ms": "5000",
+    #   multi-router front door: sibling routers ("[name=]host:port,
+    #   ..." — the OTHER routers behind the LB, not this one) exchange
+    #   write-version + reshard-epoch deltas so every router's
+    #   epoch-qualified result cache invalidates on writes any
+    #   sibling forwarded. "" = single-router deployment, no bus.
+    "tsd.cluster.routers": "",
+    #   gossip push cadence; heartbeats flow every interval even with
+    #   no writes, so an idle fleet never looks partitioned
+    "tsd.cluster.gossip.interval_ms": "250",
+    #   a sibling that hasn't acked a push within this window is
+    #   PARTITIONED: this router serves cache-bypassed (exact, never
+    #   stale, never a 5xx) until a push lands again
+    "tsd.cluster.gossip.stale_ms": "5000",
+    #   bounded delta log: a sibling lagging past the trim re-syncs
+    #   via one conservative global bump (anti-entropy full-sync)
+    "tsd.cluster.gossip.log_max": "4096",
+    #   per-sibling push deadline (gossip bodies are tiny; a hung
+    #   sibling must age toward stale_ms, not wedge the push loop)
+    "tsd.cluster.gossip.timeout_ms": "2000",
+    #   query-path read-repair: a read that observes replica
+    #   divergence (failed reader covered by a fallback round;
+    #   replicas disagreeing whether a metric exists) stages the
+    #   window into a bounded queue the replay loop drains into the
+    #   DirtyTracker — past max_pending, hints shed-and-count (a shed
+    #   hint re-stages on the next read that observes the divergence)
+    "tsd.cluster.read_repair.enable": "true",
+    "tsd.cluster.read_repair.max_pending": "1024",
     # auth
     "tsd.core.authentication.enable": "false",
     # stats

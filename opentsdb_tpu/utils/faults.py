@@ -74,10 +74,12 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "cluster.replica",    # cluster/router.py anti-entropy repair pass
     "cluster.reshard",    # cluster/reshard.py backfill step
     "cluster.retire",     # cluster/retire.py stale-copy delete step
+    "cluster.gossip",     # cluster/gossip.py sibling-router push
 })
 
 # site families with runtime-named tails (per-peer arming)
-DYNAMIC_SITE_PREFIXES: tuple[str, ...] = ("cluster.peer.",)
+DYNAMIC_SITE_PREFIXES: tuple[str, ...] = ("cluster.peer.",
+                                          "cluster.gossip.")
 
 
 def is_known_site(site: str) -> bool:

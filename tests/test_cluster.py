@@ -457,12 +457,15 @@ class LivePeer:
     the network died) while the TSDB keeps its data, so a later
     ``restart`` models the peer coming back with its store intact."""
 
-    def __init__(self, name: str, **cfg):
+    def __init__(self, name: str, port: int = 0, **cfg):
         from opentsdb_tpu.tsd.server import TSDServer
         self.name = name
         self.tsdb = TSDB(Config(**{**PEER_CFG, **cfg}))
         self.loop = asyncio.new_event_loop()
-        self.server = TSDServer(self.tsdb, host="127.0.0.1", port=0)
+        # port=0 picks a free port; a caller that pre-reserved an
+        # address (multi-router gossip needs BOTH ports before either
+        # server exists) passes it explicitly
+        self.server = TSDServer(self.tsdb, host="127.0.0.1", port=port)
         started = threading.Event()
 
         def run():
@@ -795,10 +798,11 @@ class TestScatterGather:
         # these would run against the router's EMPTY local store:
         # refuse loudly instead of answering empty streams for data
         # that exists in the cluster (or acking an annotation/rollup
-        # into a store no read merges). /api/suggest and
-        # /api/search/lookup scatter now (TestRouterSuggestSearch).
+        # into a store no read merges). /api/suggest,
+        # /api/search/lookup and /api/query/last scatter now
+        # (TestRouterSuggestSearch, TestRouterQueryLast).
         for path in ("/api/query/exp", "/api/query/gexp",
-                     "/api/query/last", "/api/query/continuous",
+                     "/api/query/continuous",
                      "/api/search/graph",
                      "/api/uid/assign", "/api/annotation",
                      "/api/tree", "/api/rollup", "/api/histogram"):
@@ -806,6 +810,111 @@ class TestScatterGather:
             assert resp.status == 400, (path, resp.status)
             out = json.loads(resp.body)
             assert "router mode" in out["error"]["message"], path
+
+
+@pytest.mark.usefixtures("cluster3")
+class TestRouterQueryLast:
+    """/api/query/last scatters in router mode: per-shard last-point
+    scatter, newest-timestamp-wins merge keyed on cluster-wide
+    resolved names, degraded shards ride the trailing marker row +
+    header (the /api/query idiom)."""
+
+    cluster: LiveCluster
+    points: list
+
+    def _last(self, body):
+        resp = self.cluster.http.handle(
+            req("POST", "/api/query/last", body))
+        return resp, (json.loads(resp.body) if resp.body else None)
+
+    @staticmethod
+    def _named(points):
+        return sorted(
+            ({"metric": p["metric"], "tags": p["tags"],
+              "timestamp": p["timestamp"], "value": p["value"]}
+             for p in points),
+            key=lambda p: (p["metric"], sorted(p["tags"].items())))
+
+    def test_scatter_matches_single_node_oracle(self):
+        resp, got = self._last({"queries": [{"metric": "c.m"}],
+                                "resolveNames": True})
+        assert resp.status == 200, resp.body
+        assert "X-OpenTSDB-Shards-Degraded" not in resp.headers
+        oracle = _oracle(self.points)
+        want = json.loads(oracle.handle(
+            req("POST", "/api/query/last",
+                {"queries": [{"metric": "c.m"}],
+                 "resolveNames": True})).body)
+        # tsuids are per-shard UID assignments and legitimately
+        # differ; names/timestamps/values must be BIT-identical
+        assert self._named(got) == self._named(want)
+        assert len(got) == 12
+
+    def test_get_form_single_series(self):
+        resp = self.cluster.http.handle(
+            req("GET", "/api/query/last",
+                timeseries="c.m{host=h03}", resolve="true"))
+        assert resp.status == 200, resp.body
+        got = json.loads(resp.body)
+        assert len(got) == 1
+        p = got[0]
+        assert p["metric"] == "c.m"
+        assert p["tags"] == {"host": "h03"}
+        assert p["timestamp"] == (BASE + 119) * 1000
+        assert p["value"] == str((3 * 13 + (119 // 30) * 7) % 50)
+
+    def test_unresolved_strips_names_after_merge(self):
+        # the merge key must still be the cluster-wide resolved name
+        # (per-shard tsuids do not compare across shards) even when
+        # the client did not ask for names back
+        resp, got = self._last({"queries": [{"metric": "c.m"}]})
+        assert resp.status == 200, resp.body
+        assert len(got) == 12
+        for p in got:
+            assert "metric" not in p and "tags" not in p
+            assert set(p) == {"timestamp", "value", "tsuid"}
+
+    def test_back_scan_bounds_the_window(self):
+        # the data is years old: any back_scan window measured from
+        # now excludes it everywhere — empty, not an error
+        resp, got = self._last({"queries": [{"metric": "c.m"}],
+                                "backScan": 1})
+        assert resp.status == 200, resp.body
+        assert got == []
+
+    def test_unknown_metric_is_empty(self):
+        resp, got = self._last({"queries": [{"metric": "c.nope"}],
+                                "resolveNames": True})
+        assert resp.status == 200, resp.body
+        assert got == []
+
+    def test_tsuid_specs_refused(self):
+        resp, got = self._last(
+            {"queries": [{"tsuids": ["000001000001000001"]}]})
+        assert resp.status == 400
+        assert "router mode" in got["error"]["message"]
+
+    def test_dead_shard_rides_degraded_marker(self):
+        self.cluster.peer("s1").kill()
+        try:
+            resp, got = self._last({"queries": [{"metric": "c.m"}],
+                                    "resolveNames": True})
+            assert resp.status == 200, resp.body
+            marker = got[-1]
+            assert marker == {"shardsDegraded": ["s1"]}
+            assert resp.headers["X-OpenTSDB-Shards-Degraded"] == "s1"
+            # surviving shards still answer their series, and each
+            # one is the oracle's point for that series
+            oracle = _oracle(self.points)
+            want = self._named(json.loads(oracle.handle(
+                req("POST", "/api/query/last",
+                    {"queries": [{"metric": "c.m"}],
+                     "resolveNames": True})).body))
+            got_named = self._named(got[:-1])
+            assert 0 < len(got_named) < 12
+            assert all(p in want for p in got_named)
+        finally:
+            self.cluster.peer("s1").restart()
 
 
 @pytest.mark.usefixtures("cluster3")

@@ -1,0 +1,638 @@
+"""Multi-router front door: two REAL-socket routers behind an LB.
+
+The single-router cluster tests prove one front door is correct; this
+battery proves TWO are — which is a different theorem, because each
+router owns an epoch-qualified result cache whose invalidation
+signals (version bumps, reshard epochs) used to be process-local.
+The gossip bus (cluster/gossip.py) exports them; these tests prove:
+
+- **cache coherence**: a write/delete forwarded by router A is never
+  served stale by router B after one gossip push — with an explicit
+  negative control first (the stale serve DOES happen before the
+  push, so the assertion is not vacuous);
+- **degradation, not staleness**: a router whose sibling is
+  unreachable past the stale window serves cache-BYPASSED (exact
+  answers, never a 5xx, never a stale hit) and says so in
+  ``/api/health``;
+- **kill/flap chaos**: SIGKILL (subprocess) or listener-kill + flap
+  of either router mid-ingest and mid-reshard keeps every acked
+  write readable and every merged read bit-identical to a
+  single-node no-fault oracle; a sibling RESUMES and finalizes a
+  dead initiator's reshard;
+- **query-path read-repair**: a read that observes a diverged
+  replica (failed reader mid-scatter) stages the window into the
+  read-repair queue, and the replica heals bit-identical to its
+  pre-divergence state without any restart event.
+
+Routers are real TSDServers on real sockets — gossip travels over
+actual HTTP between them, so the failure modes under test are the
+transport's own.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import subprocess
+import time
+
+import pytest
+
+from test_cluster import (BASE, BASE_MS, QUERIES, LivePeer,
+                          _free_port, _mkpoints, _oracle,
+                          _sorted_rows, _strip_marker, _tsq,
+                          _wait_port, req)
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _witnessed(lock_witness, leak_witness):
+    """Both runtime witnesses watch the whole module: lock-order
+    cycles and leaked threads/fds from routers, gossip buses, spools
+    and shard servers fail the module at teardown (see conftest)."""
+    return lock_witness
+
+
+# ---------------------------------------------------------------------------
+# raw HTTP + LB simulation
+# ---------------------------------------------------------------------------
+
+def _http(port, method, path, body=None, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        data = (json.dumps(body).encode()
+                if body is not None else None)
+        conn.request(method, path, body=data,
+                     headers={"Content-Type": "application/json"}
+                     if data is not None else {})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _until(fn, timeout=20, every=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(every)
+    return False
+
+
+class LB:
+    """The load balancer in front of the routers: round-robin, with
+    connection-level failover to the next router — the standard L4
+    behavior the multi-router deployment assumes. An HTTP error
+    status is NOT failed over (the router answered; its answer is
+    the answer under test)."""
+
+    def __init__(self, ports):
+        self.ports = list(ports)
+        self._rr = 0
+
+    def request(self, method, path, body=None, timeout=30):
+        first = self._rr % len(self.ports)
+        self._rr += 1
+        last_exc = None
+        for k in range(len(self.ports)):
+            port = self.ports[(first + k) % len(self.ports)]
+            try:
+                return _http(port, method, path, body,
+                             timeout=timeout)
+            except (OSError, http.client.HTTPException) as exc:
+                last_exc = exc
+        raise AssertionError(f"no router answered {path}: {last_exc}")
+
+
+# ---------------------------------------------------------------------------
+# two-router fleet harness
+# ---------------------------------------------------------------------------
+
+class Fleet:
+    """Shared shard set + two real-socket routers + the LB. Each
+    router names the other in ``tsd.cluster.routers`` (ports are
+    pre-reserved: both addresses must exist before either server
+    does)."""
+
+    def __init__(self, tmp_path, n_shards=3, rf=1, gossip_ms=50,
+                 stale_ms=60_000, **router_cfg):
+        self.shards = [
+            LivePeer(f"s{i}",
+                     **{"tsd.http.query.allow_delete": "true"})
+            for i in range(n_shards)]
+        self.spec = ",".join(f"s{i}=127.0.0.1:{p.port}"
+                             for i, p in enumerate(self.shards))
+        ports = [_free_port(), _free_port()]
+        self.routers = []
+        for i in (0, 1):
+            cfg = {
+                "tsd.cluster.role": "router",
+                "tsd.cluster.peers": self.spec,
+                "tsd.cluster.rf": str(rf),
+                "tsd.cluster.routers":
+                    f"r{1 - i}=127.0.0.1:{ports[1 - i]}",
+                "tsd.cluster.spool.dir": str(tmp_path / f"r{i}"),
+                "tsd.cluster.spool.replay_interval_ms": "100",
+                "tsd.cluster.gossip.interval_ms": str(gossip_ms),
+                "tsd.cluster.gossip.stale_ms": str(stale_ms),
+                "tsd.cluster.timeout_ms": "2000",
+                "tsd.cluster.breaker.reset_timeout_ms": "300",
+                "tsd.http.query.allow_delete": "true",
+                **router_cfg,
+            }
+            self.routers.append(LivePeer(f"r{i}", port=ports[i],
+                                         **cfg))
+        self.lb = LB(ports)
+
+    def cluster(self, i):
+        return self.routers[i].tsdb.cluster
+
+    def put(self, points, via=None):
+        if via is None:
+            status, body, _ = self.lb.request(
+                "POST", "/api/put?summary=true", points)
+        else:
+            status, body, _ = _http(
+                self.routers[via].port, "POST",
+                "/api/put?summary=true", points)
+        return status, (json.loads(body) if body else None)
+
+    def put_ok(self, points, via=None):
+        status, out = self.put(points, via=via)
+        assert status == 200, out
+        assert out["failed"] == 0, out
+        return points
+
+    def query(self, body, via=None):
+        if via is None:
+            status, out, _ = self.lb.request("POST", "/api/query",
+                                             body)
+        else:
+            status, out, _ = _http(self.routers[via].port, "POST",
+                                   "/api/query", body)
+        return status, (json.loads(out) if out else None)
+
+    def rows(self, body, via=None):
+        status, out = self.query(body, via=via)
+        assert status == 200, out
+        rows, degraded = _strip_marker(out)
+        assert degraded == []
+        return _sorted_rows(rows)
+
+    def status_doc(self, i):
+        status, out, _ = _http(self.routers[i].port, "GET",
+                               "/api/cluster/status")
+        assert status == 200, out
+        return json.loads(out)
+
+    def health_causes(self, i):
+        status, out, _ = _http(self.routers[i].port, "GET",
+                               "/api/health")
+        return json.loads(out).get("causes") or []
+
+    def close(self):
+        for r in self.routers:
+            r.stop()
+        for p in self.shards:
+            p.stop()
+
+
+def _want(oracle, body):
+    resp = oracle.handle(req("POST", "/api/query", body))
+    assert resp.status == 200, resp.body
+    rows, _ = _strip_marker(json.loads(resp.body))
+    return _sorted_rows(rows)
+
+
+def _assert_oracle_identical(fleet, acked, via=None):
+    """Every exact-pipeline query answers 200 and BIT-identical to a
+    single-node no-fault oracle fed exactly the acked points."""
+    oracle = _oracle(acked)
+    for qs in QUERIES:
+        body = _tsq(qs)
+        assert fleet.rows(body, via=via) == _want(oracle, body), qs
+
+
+def _q(metric, qspec=None, **extra):
+    return {"start": BASE_MS - 10_000, "end": BASE_MS + 200_000,
+            "queries": [dict({"metric": metric, "aggregator": "sum",
+                              "downsample": "10s-sum"},
+                             **(qspec or {}))], **extra}
+
+
+# ---------------------------------------------------------------------------
+# gossip-coherent caches (deterministic: threads stopped, pushes
+# driven by hand so the stale negative control cannot race)
+# ---------------------------------------------------------------------------
+
+class TestGossipCacheCoherence:
+    @pytest.fixture()
+    def fleet(self, tmp_path):
+        f = Fleet(tmp_path, gossip_ms=3_600_000,
+                  stale_ms=3_600_000)
+        # stop the push loops: every propagation below is an explicit
+        # push_once(), so "before the push" is a real, stable state
+        f.cluster(0).gossip.stop()
+        f.cluster(1).gossip.stop()
+        yield f
+        f.close()
+
+    def test_sibling_write_invalidates_after_one_push(self, fleet):
+        points = _mkpoints()
+        fleet.put_ok(points, via=0)
+        body = _tsq(QUERIES[0])
+        r0 = fleet.cluster(0)
+        first = fleet.rows(body, via=0)
+        again = fleet.rows(body, via=0)
+        assert again == first
+        assert r0.cache_hits >= 1  # the cache is live, not bypassed
+        # sibling-forwarded write that changes the answer (full
+        # window span: the exact-query battery assumes every series
+        # covers every bucket)
+        extra = [{"metric": "c.m", "timestamp": BASE + i,
+                  "value": 7, "tags": {"host": "h90"}}
+                 for i in range(120)]
+        fleet.put_ok(extra, via=1)
+        # NEGATIVE CONTROL: r0 has not seen the delta — it serves its
+        # cached (now stale) answer. This is the incoherence the bus
+        # exists to close, observed on purpose.
+        stale = fleet.rows(body, via=0)
+        assert stale == first
+        assert stale != _want(_oracle(points + extra), body)
+        # one push from the writing router ...
+        applied_before = r0.gossip.deltas_applied
+        assert fleet.cluster(1).gossip.push_once() == 1
+        assert r0.gossip.deltas_applied > applied_before
+        # ... and r0 is coherent: bit-identical to the oracle of
+        # everything acked anywhere
+        oracle = _oracle(points + extra)
+        assert fleet.rows(body, via=0) == _want(oracle, body)
+        _assert_oracle_identical(fleet, points + extra, via=0)
+        _assert_oracle_identical(fleet, points + extra, via=1)
+
+    def test_sibling_delete_leaves_no_servable_stale_entry(
+            self, fleet):
+        pts = [{"metric": "c.del", "timestamp": BASE + i,
+                "value": 3, "tags": {"host": f"h{h}"}}
+               for i in range(60) for h in range(4)]
+        fleet.put_ok(pts, via=0)
+        assert fleet.cluster(0).gossip.push_once() == 1
+        body = _q("c.del")
+        cached = fleet.rows(body, via=1)  # r1 caches the rows
+        assert cached
+        # delete through the OTHER router
+        status, _out = fleet.query(_q("c.del", delete=True), via=0)
+        assert status == 200
+        # negative control: r1 still serves the purged rows
+        assert fleet.rows(body, via=1) == cached
+        # one push closes the hole: r1's answer now equals a fresh
+        # answer from the deleting router itself
+        assert fleet.cluster(0).gossip.push_once() == 1
+        s0, fresh0 = fleet.query(body, via=0)
+        s1, fresh1 = fleet.query(body, via=1)
+        assert (s1, fresh1) == (s0, fresh0)
+        if s1 == 200:
+            rows, _ = _strip_marker(fresh1)
+            assert rows != cached
+        # status surface carries the bus (satellite observability)
+        g = fleet.status_doc(1)["gossip"]
+        assert g["deltas_applied"] >= 1
+        assert g["degraded"] is False
+
+    def test_partitioned_sibling_degrades_to_cache_bypass(
+            self, tmp_path):
+        # stale window short, push loops stopped: the sibling goes
+        # stale by construction, like a partitioned peer
+        f = Fleet(tmp_path, gossip_ms=3_600_000, stale_ms=300)
+        try:
+            f.cluster(0).gossip.stop()
+            f.cluster(1).gossip.stop()
+            points = f.put_ok(_mkpoints(), via=0)
+            body = _tsq(QUERIES[0])
+            f.rows(body, via=0)  # would be the stale entry
+            extra = [{"metric": "c.m", "timestamp": BASE + i,
+                      "value": 9, "tags": {"host": "h91"}}
+                     for i in range(120)]
+            f.put_ok(extra, via=1)
+            assert _until(lambda: f.cluster(0).gossip.degraded(), 10)
+            # degraded = conservative: the unseen sibling write is in
+            # the answer because the cache is BYPASSED, never stale
+            bypasses = f.cluster(0).gossip.cache_bypasses
+            assert f.rows(body, via=0) == \
+                _want(_oracle(points + extra), body)
+            assert f.cluster(0).gossip.cache_bypasses > bypasses
+            assert "cluster_gossip_degraded" in f.health_causes(0)
+            # a push landing again clears the verdict
+            assert f.cluster(0).gossip.push_once() == 1
+            assert f.cluster(0).gossip.degraded() is False
+            assert "cluster_gossip_degraded" not in \
+                f.health_causes(0)
+        finally:
+            f.close()
+
+
+# ---------------------------------------------------------------------------
+# kill / flap chaos: listener-kill + flap of either router mid-ingest
+# ---------------------------------------------------------------------------
+
+class TestKillFlapMidIngest:
+    def test_router_kill_and_flap_zero_acked_loss(self, tmp_path):
+        """r0 dies mid-ingest (connection refused at the LB), comes
+        back, and dies are interleaved with acked batches. Every ack
+        is durable: reads through the LB, the survivor and the
+        flapped router are all bit-identical to the no-fault oracle
+        of exactly the acked points, and the survivor serves
+        cache-bypassed (never stale) while its sibling is gone."""
+        f = Fleet(tmp_path, gossip_ms=50, stale_ms=1000)
+        try:
+            pts = _mkpoints()
+            batches = [[p for p in pts
+                        if 30 * b <= p["timestamp"] - BASE < 30 * (b + 1)]
+                       for b in range(4)]
+            acked = []
+            acked += f.put_ok(batches[0])  # everyone up
+            body = _tsq(QUERIES[0])
+            f.rows(body, via=0)  # prime r0's cache pre-kill
+            f.routers[0].kill()
+            # mid-ingest: the LB fails over, every batch still acks
+            acked += f.put_ok(batches[1])
+            acked += f.put_ok(batches[2])
+            # the survivor degrades (its pushes to r0 die) and says
+            # so — its reads bypass the cache and stay exact
+            assert _until(lambda: "cluster_gossip_degraded" in
+                          f.health_causes(1), 15)
+            assert f.rows(body, via=1) == \
+                _want(_oracle(acked), body)
+            # flap back; final batch through the LB
+            f.routers[0].restart()
+            acked += f.put_ok(batches[3])
+            # r0 must not serve its pre-kill cache entry once gossip
+            # reaches it: poll to the healed answer, then assert the
+            # full exact-query battery on every path
+            want = _want(_oracle(acked), body)
+            assert _until(
+                lambda: f.rows(body, via=0) == want, 15)
+            _assert_oracle_identical(f, acked, via=0)
+            _assert_oracle_identical(f, acked, via=1)
+            _assert_oracle_identical(f, acked)  # through the LB
+            # and the degradation verdict clears
+            assert _until(lambda: "cluster_gossip_degraded" not in
+                          f.health_causes(1), 15)
+            # cross-router write-then-read-through-sibling probes,
+            # both directions: no stale serve on either router
+            probe_a = [{"metric": "c.m", "timestamp": BASE + 130,
+                        "value": 5, "tags": {"host": "h92"}}]
+            acked += f.put_ok(probe_a, via=1)
+            want = _want(_oracle(acked), body)
+            assert _until(lambda: f.rows(body, via=0) == want, 10)
+            probe_b = [{"metric": "c.m", "timestamp": BASE + 140,
+                        "value": 6, "tags": {"host": "h93"}}]
+            acked += f.put_ok(probe_b, via=0)
+            want = _want(_oracle(acked), body)
+            assert _until(lambda: f.rows(body, via=1) == want, 10)
+        finally:
+            f.close()
+
+
+# ---------------------------------------------------------------------------
+# query-path read-repair: a read heals a diverged replica
+# ---------------------------------------------------------------------------
+
+class TestReadRepair:
+    def test_read_observing_divergence_heals_replica(self, tmp_path):
+        """RF=2. One replica loses a metric's rows (a shard-local
+        purge — no restart anywhere in this test). A read whose
+        scatter leg to that replica times out answers 200 correct
+        from the surviving copy AND stages the window; the replay
+        loop drains the stage into the dirty tracker and the repair
+        pass restores the replica BIT-identical to its
+        pre-divergence local answer."""
+        f = Fleet(tmp_path, rf=2, gossip_ms=50, stale_ms=60_000)
+        try:
+            pts = [{"metric": "c.div", "timestamp": BASE + i,
+                    "value": (h * 11 + 3) % 40,
+                    "tags": {"host": f"h{h}"}}
+                   for i in range(60) for h in range(8)]
+            f.put_ok(pts, via=0)
+            local = {"start": BASE_MS - 10_000,
+                     "end": BASE_MS + 200_000,
+                     "queries": [{"metric": "c.div",
+                                  "aggregator": "none"}]}
+            s1 = f.shards[1]
+
+            def s1_rows():
+                status, out, _ = _http(s1.port, "POST",
+                                       "/api/query", local)
+                assert status == 200, out
+                return _sorted_rows(json.loads(out))
+
+            before = s1_rows()
+            assert before  # rf=2 of 3 shards: s1 holds replicas
+            # shard-local purge = real divergence, no restart
+            status, _b, _h = _http(
+                s1.port, "POST", "/api/query",
+                dict(local, delete=True))
+            assert status == 200
+            assert s1_rows() != before
+            # the read: s1 hangs, the leg times out, the fallback
+            # round answers from the surviving replica — correct and
+            # marker-free — and the window is staged for repair
+            r0 = f.cluster(0)
+            body = _q("c.div")
+            s1.hang("/api/query")
+            try:
+                assert f.rows(body, via=0) == \
+                    _want(_oracle(pts), body)
+            finally:
+                s1.unhang()
+            rr = r0.read_repair.health_info()
+            assert rr["enqueued"] >= 1, rr
+            # the queue drains through DirtyTracker -> repair in the
+            # replay loop; the replica heals with no restart event
+            assert _until(lambda: s1_rows() == before, 20), \
+                r0.read_repair.health_info()
+            assert _until(
+                lambda: r0.read_repair.health_info()["completed"]
+                >= 1, 10)
+            rr = r0.read_repair.health_info()
+            assert rr["depth"] == 0 and rr["inflight"] == 0, rr
+            assert rr["oldest_pending_age_s"] == 0.0
+            # the repair surfaces on the operator status doc
+            doc = f.status_doc(0)["read_repair"]
+            assert doc["completed"] >= 1
+            # and the healed cluster still answers oracle-identical
+            assert f.rows(body, via=0) == _want(_oracle(pts), body)
+        finally:
+            f.close()
+
+
+# ---------------------------------------------------------------------------
+# subprocess router: a REAL process SIGKILLed mid-reshard
+# ---------------------------------------------------------------------------
+
+ROUTER_SCRIPT = """
+import asyncio, sys
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.tsd.server import TSDServer
+
+port, spool_dir, shard_spec, sibling_spec = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4])
+t = TSDB(Config(**{
+    "tsd.core.auto_create_metrics": "true",
+    "tsd.tpu.warmup": "false",
+    "tsd.cluster.role": "router",
+    "tsd.cluster.peers": shard_spec,
+    "tsd.cluster.routers": sibling_spec,
+    "tsd.cluster.spool.dir": spool_dir,
+    "tsd.cluster.spool.replay_interval_ms": "100",
+    "tsd.cluster.reshard.interval_ms": "50",
+    "tsd.cluster.gossip.interval_ms": "50",
+    "tsd.cluster.gossip.stale_ms": "60000",
+    "tsd.cluster.timeout_ms": "2000",
+}))
+
+async def main():
+    server = TSDServer(t, host="127.0.0.1", port=port)
+    await server.serve_forever()
+
+asyncio.run(main())
+"""
+
+
+class TestSigkillRouterMidReshard:
+    def _spawn(self, script_path, port, spool_dir, shard_spec,
+               sibling_spec):
+        import os
+        import sys
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        proc = subprocess.Popen(
+            [sys.executable, str(script_path), str(port),
+             str(spool_dir), shard_spec, sibling_spec],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        assert _wait_port(port), "subprocess router did not come up"
+        return proc
+
+    def test_sigkill_initiator_sibling_resumes_reshard(
+            self, tmp_path):
+        """The reshard initiator is a real subprocess router. It is
+        SIGKILLed with the cutover window open; the sibling router —
+        which adopted the epoch over gossip — resumes the backfill
+        and finalizes the new ring ALONE, mid-flight ingest keeps
+        acking, and every read is bit-identical to the no-fault
+        oracle. The dead initiator then restarts and converges to
+        the finalized topology with zero acknowledged-write loss."""
+        shards = [LivePeer(f"s{i}") for i in range(3)]
+        spare = LivePeer("s3")
+        spec3 = ",".join(f"s{i}=127.0.0.1:{p.port}"
+                         for i, p in enumerate(shards))
+        spec4 = spec3 + f",s3=127.0.0.1:{spare.port}"
+        r0_port = _free_port()
+        script = tmp_path / "router.py"
+        script.write_text(ROUTER_SCRIPT)
+        r1 = LivePeer("r1", **{
+            "tsd.cluster.role": "router",
+            "tsd.cluster.peers": spec3,
+            "tsd.cluster.routers": f"r0=127.0.0.1:{r0_port}",
+            "tsd.cluster.spool.dir": str(tmp_path / "r1"),
+            "tsd.cluster.spool.replay_interval_ms": "100",
+            "tsd.cluster.reshard.interval_ms": "50",
+            "tsd.cluster.gossip.interval_ms": "50",
+            "tsd.cluster.gossip.stale_ms": "60000",
+            "tsd.cluster.timeout_ms": "2000",
+            "tsd.cluster.breaker.reset_timeout_ms": "300",
+        })
+        proc = self._spawn(script, r0_port, tmp_path / "r0", spec3,
+                           f"r1=127.0.0.1:{r1.port}")
+
+        def status_of(port):
+            st, out, _ = _http(port, "GET", "/api/cluster/status")
+            assert st == 200, out
+            return json.loads(out)
+
+        def rows_of(port, body):
+            st, out, _ = _http(port, "POST", "/api/query", body)
+            if st != 200:
+                return None
+            rows, degraded = _strip_marker(json.loads(out))
+            if degraded:
+                return None
+            return _sorted_rows(rows)
+
+        try:
+            pts = _mkpoints()
+            batch_a = [p for p in pts if p["timestamp"] - BASE < 60]
+            batch_b = [p for p in pts if p["timestamp"] - BASE >= 60]
+            st, out, _ = _http(r0_port, "POST",
+                               "/api/put?summary=true", batch_a)
+            assert st == 200 and json.loads(out)["failed"] == 0
+            # initiate the reshard (grow to 4 shards) on r0
+            st, out, _ = _http(r0_port, "POST",
+                               "/api/cluster/reshard",
+                               {"peers": spec4})
+            assert st == 200, out
+            epoch = json.loads(out)["epoch"]
+            # the sibling adopts the open window over gossip
+            assert _until(
+                lambda: status_of(r1.port)["epoch"] == epoch, 30)
+            # SIGKILL the initiator: no flush, no goodbye
+            proc.kill()
+            proc.wait(10)
+            # mid-reshard ingest through the surviving front door
+            st, out, _ = _http(r1.port, "POST",
+                               "/api/put?summary=true", batch_b)
+            assert st == 200 and json.loads(out)["failed"] == 0
+            # the sibling resumes the copy and finalizes ALONE
+            assert _until(
+                lambda: (lambda s: not s["reshard"]["active"] and
+                         "s3" in s["ring"]["peers"])(
+                             status_of(r1.port)), 60)
+            # reads through the survivor: bit-identical to oracle
+            acked = batch_a + batch_b
+            oracle = _oracle(acked)
+            for qs in QUERIES:
+                body = _tsq(qs)
+                assert rows_of(r1.port, body) == \
+                    _want(oracle, body), qs
+            # the dead initiator returns (fresh process, same spool
+            # dir) and converges to the finalized topology
+            proc = self._spawn(script, r0_port, tmp_path / "r0",
+                               spec3, f"r1=127.0.0.1:{r1.port}")
+            assert _until(
+                lambda: (lambda s: s["epoch"] == epoch and
+                         not s["reshard"]["active"] and
+                         "s3" in s["ring"]["peers"])(
+                             status_of(r0_port)), 60)
+            for qs in QUERIES:
+                body = _tsq(qs)
+                assert _until(
+                    lambda b=_tsq(qs): rows_of(r0_port, b) ==
+                    _want(oracle, b), 30), qs
+            # write-through-sibling probe: the restarted router must
+            # reflect a write it never saw (gossip, not luck)
+            probe = [{"metric": "c.m", "timestamp": BASE + 150,
+                      "value": 4, "tags": {"host": "h94"}}]
+            st, out, _ = _http(r1.port, "POST",
+                               "/api/put?summary=true", probe)
+            assert st == 200 and json.loads(out)["failed"] == 0
+            body = _tsq(QUERIES[0])
+            want = _want(_oracle(acked + probe), body)
+            assert _until(
+                lambda: rows_of(r0_port, body) == want, 20)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+            r1.stop()
+            for p in shards:
+                p.stop()
+            spare.stop()
