@@ -1374,12 +1374,34 @@ def bench_cluster(repeats: int, n_hosts: int = 120,
                   span_s: int = 600) -> dict:
     """Sharded cluster tier config: 3 shard TSDs on real sockets
     behind a consistent-hash router, vs a single-node TSD holding the
-    same points. Records router-ingest and scatter-gather read p50
-    against the single-node baseline, plus the degraded read p50 with
-    one shard killed (the answer must stay 200 + ``shardsDegraded``,
-    merged rows on survivors identical to the oracle — the chaos
-    battery in tests/test_cluster.py proves the values; this config
-    prices the transport)."""
+    same points. Runs the whole measurement TWICE — once over the
+    binary columnar wire (the default transport) and once pinned to
+    per-request JSON HTTP (``tsd.cluster.wire.enable=false``) — so the
+    record prices the transport change itself, then reports the wire
+    run as primary with the JSON run alongside."""
+    js = _bench_cluster_once(repeats, n_hosts, span_s, wire=False)
+    wired = _bench_cluster_once(repeats, n_hosts, span_s, wire=True)
+    out = dict(wired)
+    out["json_transport"] = {k: js[k] for k in (
+        "router_ingest_kpps", "read_p50_cluster_ms",
+        "scatter_gather_overhead", "read_p50_degraded_ms")}
+    out["wire_vs_json_ingest_speedup"] = round(
+        wired["router_ingest_kpps"]
+        / max(js["router_ingest_kpps"], 1e-3), 2)
+    out["wire_vs_json_read_speedup"] = round(
+        js["read_p50_cluster_ms"]
+        / max(wired["read_p50_cluster_ms"], 1e-3), 2)
+    out["router_ingest_vs_single"] = round(
+        wired["router_ingest_kpps"]
+        / max(wired["single_ingest_kpps"], 1e-3), 2)
+    return out
+
+
+def _bench_cluster_once(repeats: int, n_hosts: int, span_s: int,
+                        wire: bool) -> dict:
+    """One full cluster-vs-single measurement over one transport
+    (the chaos battery in tests/test_cluster.py proves the values;
+    this config prices the transport)."""
     import asyncio
     import json as _json
     import threading
@@ -1443,6 +1465,7 @@ def bench_cluster(repeats: int, n_hosts: int = 120,
     spec = ",".join(f"{p.name}=127.0.0.1:{p.port}" for p in peers)
     router = TSDB(Config(**{
         "tsd.cluster.role": "router", "tsd.cluster.peers": spec,
+        "tsd.cluster.wire.enable": "true" if wire else "false",
         "tsd.query.cache.enable": "false",
         "tsd.tpu.warmup": "false"}))
     http = HttpRpcRouter(router)
@@ -1519,7 +1542,11 @@ def bench_cluster(repeats: int, n_hosts: int = 120,
                         and doc[-1].get("shardsDegraded") == ["s1"])
     degraded_p50 = _percentile(degraded_times, 50) * 1e3
 
+    if wire:  # the wire must actually have carried the traffic
+        assert any(p.wire_connects > 0
+                   for p in router.cluster.peers.values())
     out = {"config": "cluster", "shards": 3,
+           "transport": "wire" if wire else "json",
            "series": n_hosts, "points": len(points),
            "router_ingest_kpps":
                round(len(points) / router_ingest_s / 1e3, 1),

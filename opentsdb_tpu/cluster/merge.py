@@ -90,14 +90,20 @@ def group_key(result: dict, gb_keys: list[str]) -> tuple:
 class MergedGroup:
     """One logical group being accumulated across shard partials."""
 
-    __slots__ = ("metric", "tags", "agg_tags", "dps", "tsuids",
-                 "annotations", "global_annotations")
+    __slots__ = ("metric", "tags", "agg_tags", "dps", "_cols",
+                 "tsuids", "annotations", "global_annotations")
 
     def __init__(self, result: dict):
         self.metric = result.get("metric", "")
         self.tags = dict(result.get("tags") or {})
         self.agg_tags = set(result.get("aggregateTags") or ())
         self.dps: dict[int, float] = {}
+        # lazy first-contribution columns (wire transport): series
+        # never span shards, so most groups see exactly one leg —
+        # its (ts, values) arrays pass straight through to the
+        # QueryResult unless a second leg collides (then they
+        # materialize into the dict fold)
+        self._cols: tuple | None = None
         self.tsuids: list[str] = list(result.get("tsuids") or ())
         self.annotations: list[dict] = list(
             result.get("annotations") or ())
@@ -135,6 +141,13 @@ class MergedGroup:
         emission), so it is the combine identity; both sides NaN
         keeps the NaN — all members absent emits a gap, exactly what
         the single-node grid does."""
+        if self._cols is not None:
+            self._materialize()
+        elif not self.dps:
+            ts_col = getattr(dps, "ts", None)
+            if ts_col is not None:
+                self._cols = (ts_col, dps.values)
+                return
         mine = self.dps
         for ts, val in dps:
             v = float(val)
@@ -146,10 +159,37 @@ class MergedGroup:
             elif not math.isnan(v):
                 mine[ts] = combine(cur, v)
 
+    def _materialize(self) -> None:
+        """Columnar first leg -> the dict fold (a second leg arrived
+        for this group, or avg finishing needs keyed lookups). Values
+        land exactly as the row-iteration path would have stored them
+        (same f8 bits; ``float(int(v)) == v`` for masked ints)."""
+        if self._cols is None:
+            return
+        ts_col, vals = self._cols
+        self._cols = None
+        mine = self.dps
+        for t, v in zip(ts_col.tolist(), vals.tolist()):
+            mine[t] = v
+
     def to_query_result(self, sub_index: int):
         import numpy as np
 
         from opentsdb_tpu.query.engine import QueryResult
+        if self._cols is not None:
+            # single-leg group: the engine's grid is already
+            # ts-ascending — pass the columns through untouched
+            ts_arr = np.asarray(self._cols[0], dtype=np.int64)
+            vals = np.asarray(self._cols[1], dtype=np.float64)
+            return QueryResult(
+                metric=self.metric, tags=self.tags,
+                aggregated_tags=sorted(self.agg_tags),
+                tsuids=self.tsuids,
+                annotations=_to_annotations(self.annotations),
+                global_annotations=_to_annotations(
+                    self.global_annotations),
+                sub_query_index=sub_index,
+                dps_arrays=(ts_arr, vals))
         ts_sorted = sorted(self.dps)
         ts_arr = np.asarray(ts_sorted, dtype=np.int64)
         vals = np.asarray([self.dps[t] for t in ts_sorted],
@@ -221,19 +261,19 @@ def merge_concat(peer_results: list[list[dict]], sub) -> list:
     return out
 
 
-def merge_avg(sum_peer_results: list[list[dict]],
-              count_peer_results: list[list[dict]], sub,
-              gb_keys: list[str]) -> list:
-    """``avg`` across shards: merged group sums / merged group counts
-    (the rollup-tier avg decomposition; engine
-    ``_avg_rollup_pipeline`` is the storage-side twin)."""
-    sums = merge_partials(sum_peer_results, gb_keys, _add)
-    counts = merge_partials(count_peer_results, gb_keys, _add)
+def _avg_results(sums: dict[tuple, MergedGroup],
+                 counts: dict[tuple, MergedGroup], sub) -> list:
+    """Finish an ``avg`` merge from its folded sum+count twins:
+    merged group sums / merged group counts (the rollup-tier avg
+    decomposition; engine ``_avg_rollup_pipeline`` is the
+    storage-side twin)."""
     out = []
     for key, gs in sums.items():
         gc = counts.get(key)
         if gc is None:
             continue
+        gs._materialize()  # keyed lookups need the dict form
+        gc._materialize()
         dps: dict[int, float] = {}
         for ts, s in gs.dps.items():
             c = gc.dps.get(ts)
@@ -245,6 +285,15 @@ def merge_avg(sum_peer_results: list[list[dict]],
         gs.dps = dps
         out.append(gs.to_query_result(sub.index))
     return out
+
+
+def merge_avg(sum_peer_results: list[list[dict]],
+              count_peer_results: list[list[dict]], sub,
+              gb_keys: list[str]) -> list:
+    """``avg`` across shards (see :func:`_avg_results`)."""
+    sums = merge_partials(sum_peer_results, gb_keys, _add)
+    counts = merge_partials(count_peer_results, gb_keys, _add)
+    return _avg_results(sums, counts, sub)
 
 
 def merge_sub(sub, gb_keys: list[str], plan: str,
@@ -263,5 +312,95 @@ def gb_tag_keys(sub) -> list[str]:
     return sorted({f.tagk for f in sub.filters if f.group_by})
 
 
+class StreamMerger:
+    """Incremental scatter merge: fold each shard's partial grids the
+    moment its leg COMPLETES instead of gather-then-merge, so router
+    merge work overlaps the slow shards' network time (the wire
+    transport additionally decodes each leg's grids as frames arrive).
+
+    Equivalence with the batch path (``merge_sub`` over a gathered
+    ``partials`` list) is exact by construction: the same dict-fold
+    ``MergedGroup`` machinery runs over the same rows in the same
+    order — leg arrival order here IS the partials-list append order
+    there, group insertion order follows the first leg reporting each
+    group, and every pairwise float combine happens in the identical
+    sequence. That bit-identity (against the single-node oracle) is
+    why this stays a dict fold rather than a vectorized scatter.
+
+    A leg must be COMPLETE and SUCCESSFUL before :meth:`add_leg` —
+    partial folding of a leg that later dies would poison the
+    accumulators, and ``avg``'s sum+count twins must land together."""
+
+    def __init__(self, subs, plans: list[str],
+                 slots: list[tuple[int, int | None]]):
+        self.subs = list(subs)
+        self.plans = plans
+        self.slots = slots
+        self.legs = 0  # completed legs folded (incl. empty 400 legs)
+        # expanded-sub index -> accumulator: a list for concat subs
+        # (every partial row is one whole series), a key->MergedGroup
+        # dict for folding subs
+        self._concat: dict[int, list[MergedGroup]] = {}
+        self._folded: dict[int, dict[tuple, MergedGroup]] = {}
+        self._combine: dict[int, Callable[[float, float], float]] = {}
+        self._gbk: dict[int, list[str]] = {}
+        for sub, plan, (p_idx, s_idx) in zip(self.subs, plans, slots):
+            gbk = gb_tag_keys(sub)
+            if plan == "concat":
+                self._concat[p_idx] = []
+            elif plan == "avg":
+                # sum+count twins both fold with _add
+                for idx in (p_idx, s_idx):
+                    self._folded[idx] = {}
+                    self._combine[idx] = _add
+                    self._gbk[idx] = gbk
+            else:
+                self._folded[p_idx] = {}
+                self._combine[p_idx] = \
+                    _COMBINE[(sub.aggregator or "").lower()]
+                self._gbk[p_idx] = gbk
+
+    def add_leg(self, rows: list[dict]) -> None:
+        """Fold one shard's complete partial list (``showQuery`` rows:
+        each names its expanded sub index)."""
+        self.legs += 1
+        for r in rows:
+            idx = (r.get("query") or {}).get("index")
+            folded = self._folded.get(idx)
+            if folded is not None:
+                key = group_key(r, self._gbk[idx])
+                g = folded.get(key)
+                if g is None:
+                    g = folded[key] = MergedGroup(r)
+                else:
+                    g.fold_tags(r)
+                g.fold_dps(r.get("dps") or (), self._combine[idx])
+                continue
+            concat = self._concat.get(idx)
+            if concat is not None:
+                g = MergedGroup(r)
+                g.fold_dps(r.get("dps") or (), _add)
+                concat.append(g)
+            # else: a row naming no known sub index — dropped, exactly
+            # as the batch path's _sub_results filter dropped it
+
+    def results(self) -> list:
+        """Finish every sub's merge, in sub order."""
+        out: list = []
+        for sub, plan, (p_idx, s_idx) in zip(self.subs, self.plans,
+                                             self.slots):
+            if plan == "concat":
+                out.extend(g.to_query_result(sub.index)
+                           for g in self._concat[p_idx])
+            elif plan == "avg":
+                out.extend(_avg_results(self._folded[p_idx],
+                                        self._folded[s_idx], sub))
+            else:
+                out.extend(g.to_query_result(sub.index)
+                           for g in self._folded[p_idx].values())
+        return out
+
+
 __all__ = ["decompose_plan", "gb_tag_keys", "group_key",
-           "merge_partials", "merge_sub", "MergedGroup"]
+           "merge_partials", "merge_sub", "MergedGroup",
+           "StreamMerger"]

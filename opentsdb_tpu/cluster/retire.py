@@ -234,7 +234,8 @@ class StaleCopyRetirer:
             raise OSError(
                 f"peer {name} answered {status} to a retire delete")
         try:
-            rows = json.loads(data)
+            # a wire leg arrives already decoded (list of rows)
+            rows = data if isinstance(data, list) else json.loads(data)
         except ValueError as exc:
             raise OSError(
                 f"peer {name} sent an unparseable retire body"

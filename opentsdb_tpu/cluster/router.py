@@ -39,6 +39,7 @@ from typing import Any
 
 from opentsdb_tpu.cluster import merge as merge_mod
 from opentsdb_tpu.cluster import replica as replica_mod
+from opentsdb_tpu.cluster import wire as wire_mod
 from opentsdb_tpu.obs import trace as trace_mod
 from opentsdb_tpu.obs.trace import (TRACE_HEADER, trace_begin,
                                     trace_end)
@@ -94,6 +95,19 @@ class Peer:
         self.replay_point_errors = 0
         self.query_failures = 0
         self.hedges = 0
+        # binary wire transport counters (cluster/wire.py): frames
+        # and bytes on the persistent links, pipelining depth, and
+        # how often this peer fell back to JSON HTTP / shed into the
+        # spool under pipeline backpressure
+        self.wire_connects = 0
+        self.wire_frames_out = 0
+        self.wire_frames_in = 0
+        self.wire_bytes_out = 0
+        self.wire_bytes_in = 0
+        self.wire_pipeline_depth = 0   # gauge: acks in flight now
+        self.wire_pipeline_max = 0
+        self.wire_fallbacks = 0        # negotiation said HTTP
+        self.wire_backpressure_sheds = 0
         # (best-effort, in-memory) trace ids of recently spooled
         # batches, FIFO-aligned with the spool: a later replay root
         # links back to the writes it finally delivered. Lost on
@@ -114,6 +128,17 @@ class Peer:
             "replay_point_errors": self.replay_point_errors,
             "query_failures": self.query_failures,
             "hedges": self.hedges,
+            "wire": {
+                "connects": self.wire_connects,
+                "frames_out": self.wire_frames_out,
+                "frames_in": self.wire_frames_in,
+                "bytes_out": self.wire_bytes_out,
+                "bytes_in": self.wire_bytes_in,
+                "pipeline_depth": self.wire_pipeline_depth,
+                "pipeline_max": self.wire_pipeline_max,
+                "fallbacks": self.wire_fallbacks,
+                "backpressure_sheds": self.wire_backpressure_sheds,
+            },
         }
 
 
@@ -195,6 +220,18 @@ class ClusterRouter:
             "tsd.cluster.spool.replay_interval_ms", 500.0) / 1000.0
         self.replay_batch = config.get_int(
             "tsd.cluster.spool.replay_batch", 64)
+        # binary columnar wire transport (cluster/wire.py): persistent
+        # framed links per peer, JSON HTTP as negotiated fallback
+        self.wire = wire_mod.WireManager(self)
+        # per-sub retry amplification bound: a multi-sub 400 re-asks
+        # per rejected metric — cap how many of those singles run
+        # concurrently against ONE peer so a wide dashboard query
+        # can't monopolize the fan-out pool on a partially-known shard
+        self.sub_retry_max_concurrent = max(config.get_int(
+            "tsd.cluster.sub_retry.max_concurrent", 4), 1)
+        self.sub_retry_rounds = 0    # metric-elimination rounds run
+        self.sub_retry_singles = 0   # single-sub re-asks dispatched
+        self.sub_retry_capped = 0    # dispatches that hit the cap
         # router-level counters
         self.queries = 0
         self.degraded_queries = 0
@@ -327,6 +364,7 @@ class ClusterRouter:
         self.pool.shutdown(wait=False)
         for peer in self.peers.values():
             peer.spool.close()
+        self.wire.close_all()
 
     # ------------------------------------------------------------------
     # shared peer dispatch (fault site + breaker + retry)
@@ -467,7 +505,7 @@ class ClusterRouter:
                 f"peer {peer.name} answered {status} to a "
                 f"{metric!r} copy scan")
         try:
-            yield json.loads(data)
+            yield data if isinstance(data, list) else json.loads(data)
         except ValueError as exc:
             raise PeerUnavailable(
                 f"peer {peer.name} sent an unparseable copy-scan "
@@ -773,26 +811,58 @@ class ClusterRouter:
         # about them from here on
         self.invalidate_sub_memo(peer.name,
                                  {dp["metric"] for dp in dps})
-        body = json.dumps(dps).encode()
+        # the wire path never materializes a JSON body at all — that
+        # deferral IS much of the ingest win. Spool records stay JSON
+        # (the durable format is transport-agnostic), built lazily
+        # only when a batch actually sheds.
+        use_wire = self.wire.usable(peer)
+        body: bytes | None = None if use_wire \
+            else json.dumps(dps).encode()
+
+        def spool_body() -> bytes:
+            nonlocal body
+            if body is None:
+                body = json.dumps(dps).encode()
+            return body
+
         with peer.lock:
             direct = (peer.spool.pending_records == 0
                       and peer.breaker.state == CircuitBreaker.CLOSED)
             if not direct:
-                return self._spool_batch(peer, body, dps)
+                return self._spool_batch(peer, spool_body(), dps)
         try:
             self._check_faults(peer)
-            status, data = call_with_retries(
-                lambda: self._fetch(
-                    peer, "POST",
-                    "/api/put?summary=true&details=true", body,
-                    headers=headers),
-                self.retry, retryable=(OSError,))
+            if use_wire:
+                try:
+                    status, data = call_with_retries(
+                        lambda: self.wire.put_batch(
+                            peer, dps=dps, headers=headers),
+                        self.retry, retryable=(OSError,))
+                except (wire_mod.WireUnsupported,
+                        wire_mod.WireEncodeError):
+                    # negotiation said HTTP, or the batch is not
+                    # canonically columnar: same delivery, JSON body
+                    use_wire = False
+            if not use_wire:
+                status, data = call_with_retries(
+                    lambda: self._fetch(
+                        peer, "POST",
+                        "/api/put?summary=true&details=true",
+                        spool_body(), headers=headers),
+                    self.retry, retryable=(OSError,))
+        except wire_mod.WireBacklogged:
+            # pipeline at max_inflight: shed to the durable spool
+            # WITHOUT touching the breaker — backpressure is not
+            # peer damage, and the spool replay drains in FIFO order
+            peer.wire_backpressure_sheds += 1
+            with peer.lock:
+                return self._spool_batch(peer, spool_body(), dps)
         except OSError as exc:
             peer.breaker.record_failure()
             LOG.warning("shard %s unreachable (%s); spooling %d "
                         "point(s)", peer.name, exc, len(dps))
             with peer.lock:
-                return self._spool_batch(peer, body, dps)
+                return self._spool_batch(peer, spool_body(), dps)
         doc = self._put_summary_doc(data)
         if doc is None and not 200 <= status < 300:
             # a 4xx with no put summary did NOT come from a TSD put
@@ -1011,8 +1081,21 @@ class ClusterRouter:
 
     def _replay_one(self, peer: Peer, body: bytes) -> None:
         self._check_faults(peer)
-        status, data = self._fetch(
-            peer, "POST", "/api/put?summary=true&details=true", body)
+        status = None
+        if self.wire.usable(peer):
+            try:
+                status, data = self.wire.put_batch(peer, body=body)
+            except (wire_mod.WireUnsupported,
+                    wire_mod.WireEncodeError,
+                    wire_mod.WireBacklogged):
+                # replay traffic never waits on pipeline room and
+                # never re-spools (it IS the spool): deliver this
+                # record over plain HTTP instead
+                status = None
+        if status is None:
+            status, data = self._fetch(
+                peer, "POST", "/api/put?summary=true&details=true",
+                body)
         doc = self._put_summary_doc(data)
         if doc is None and not 200 <= status < 300:
             # not a TSD put answer: the record was NOT applied — keep
@@ -1366,7 +1449,12 @@ class ClusterRouter:
         # every repeat query of a legitimately shard-unknown metric
         # would re-stage the same no-op repair
         sub_memo_unknown: dict[int, set] = {}
-        partials: list[list[dict]] = []
+        # incremental merge: every COMPLETE leg folds the moment its
+        # future resolves (wire legs additionally decode frame-by-
+        # frame), instead of gathering all partials and merging last.
+        # Fold order still equals the old partials-list order, so the
+        # merged result is bit-identical to the batch path.
+        merger = merge_mod.StreamMerger(tsq.queries, plans, slots)
         failed_peers: set[str] = set()
         degraded_set: set[str] = set()
 
@@ -1438,7 +1526,10 @@ class ClusterRouter:
                     continue
                 if status == 200:
                     try:
-                        rows = json.loads(data)
+                        # a wire leg arrives already decoded (list);
+                        # an HTTP leg is a JSON body
+                        rows = data if isinstance(data, list) \
+                            else json.loads(data)
                     except ValueError:
                         peer.query_failures += 1
                         round_failed.append(name)
@@ -1453,7 +1544,7 @@ class ClusterRouter:
                                     isinstance(q.get("index"), int) \
                                     and 0 <= q["index"] < len(sent):
                                 q["index"] = sent[q["index"]]
-                    partials.append(rows)
+                    merger.add_leg(rows)
                     for k in sent:
                         sub_answered[k].add(name)
                     if use_memo:
@@ -1485,7 +1576,7 @@ class ClusterRouter:
                     sub_400.setdefault(sent[0], []).append(data)
                     sub_unknown[sent[0]].add(name)
                     sub_answered[sent[0]].add(name)
-                    partials.append([])
+                    merger.add_leg([])
                     if use_memo:
                         self._memo_unknown(
                             name,
@@ -1509,7 +1600,7 @@ class ClusterRouter:
                     round_failed.append(name)
                     mark_trouble()
                 else:
-                    partials.append(rows)
+                    merger.add_leg(rows)
             # re-assign a failed reader's replica sets to the next
             # member that hasn't failed this query; a set with no
             # member left is DOWN — the only case that degrades
@@ -1615,19 +1706,12 @@ class ClusterRouter:
                 "delete partially applied: shard(s) "
                 f"{', '.join(degraded)} unreachable — "
                 "retry to complete the purge")
-        results: list = []
         with trace_mod.trace_span("cluster.merge", ctx=tctx,
-                                  shards=len(partials)):
-            for sub, plan, (p_idx, s_idx) in zip(tsq.queries, plans,
-                                                 slots):
-                primary = [self._sub_results(r, p_idx)
-                           for r in partials]
-                secondary = ([self._sub_results(r, s_idx)
-                              for r in partials]
-                             if s_idx is not None else None)
-                gb_keys = merge_mod.gb_tag_keys(sub)
-                results.extend(merge_mod.merge_sub(
-                    sub, gb_keys, plan, primary, secondary))
+                                  shards=merger.legs):
+            # per-leg folding already happened as legs completed;
+            # this finishes the accumulated groups (avg division,
+            # grid sort) and applies post-merge pixel budgets
+            results = merger.results()
             results = self._apply_pixels(tsq, results)
         return results, degraded
 
@@ -1699,6 +1783,7 @@ class ClusterRouter:
             body = json.dumps(dict(
                 req_obj,
                 queries=[sj for _k, sj in remaining])).encode()
+            self.sub_retry_rounds += 1
             try:
                 status, data = self._query_peer_traced(
                     tctx, parent_id, peer, body)
@@ -1706,7 +1791,8 @@ class ClusterRouter:
                 return [], True
             if status == 200:
                 try:
-                    part = json.loads(data)
+                    part = data if isinstance(data, list) \
+                        else json.loads(data)
                 except ValueError:
                     return [], True
                 for r in part:
@@ -1737,48 +1823,68 @@ class ClusterRouter:
                                parent_id=None
                                ) -> tuple[list[dict], bool]:
         """One request per expanded sub: the fallback when a 400 body
-        cannot name the rejected metric (see ``_per_sub_retry``)."""
-        futs = [(k, sj, self.pool.submit(
-                    self._query_peer_traced, tctx, parent_id, peer,
-                    json.dumps(dict(req_obj, queries=[sj])).encode()))
-                for k, sj in indexed_subs]
+        cannot name the rejected metric (see ``_per_sub_retry``).
+
+        Submission runs in WAVES of at most
+        ``tsd.cluster.sub_retry.max_concurrent`` against this one
+        peer: the sweep's amplification is per-sub, and uncapped it
+        could monopolize the shared fan-out pool (and the peer) on a
+        wide dashboard query. A wave that observes peer death stops
+        submitting further waves — the peer contributes nothing
+        anyway (see ``_per_sub_retry`` on avg twins)."""
+        self.sub_retry_singles += len(indexed_subs)
+        cap = self.sub_retry_max_concurrent
+        if len(indexed_subs) > cap:
+            self.sub_retry_capped += 1
         rows: list[dict] = []
         died = False
-        for k, sj, fut in futs:
-            try:
-                status, data = fut.result(
-                    timeout=self.timeout_s * 2 + 5)
-            except (OSError, concurrent.futures.TimeoutError):
-                died = True
-                continue  # keep draining the in-flight futures
+        for w0 in range(0, len(indexed_subs), cap):
             if died:
-                continue
-            if status == 400:
-                sub_400.setdefault(k, []).append(data)
-                sub_unknown[k].add(peer.name)
+                break  # don't hammer a dead peer with more waves
+            futs = [(k, sj, self.pool.submit(
+                        self._query_peer_traced, tctx, parent_id,
+                        peer,
+                        json.dumps(dict(req_obj,
+                                        queries=[sj])).encode()))
+                    for k, sj in indexed_subs[w0:w0 + cap]]
+            for k, sj, fut in futs:
+                try:
+                    status, data = fut.result(
+                        timeout=self.timeout_s * 2 + 5)
+                except (OSError, concurrent.futures.TimeoutError):
+                    died = True
+                    continue  # keep draining the in-flight futures
+                if died:
+                    continue
+                if status == 400:
+                    sub_400.setdefault(k, []).append(data)
+                    sub_unknown[k].add(peer.name)
+                    sub_answered[k].add(peer.name)
+                    if memoize:
+                        self._memo_unknown(peer.name,
+                                           sj.get("metric") or "",
+                                           data)
+                    continue
+                if status != 200:
+                    # same rule as the combined scatter: a non-400
+                    # rejection is peer damage, not an empty partial
+                    died = True
+                    continue
+                try:
+                    part = data if isinstance(data, list) \
+                        else json.loads(data)
+                except ValueError:
+                    died = True
+                    continue
                 sub_answered[k].add(peer.name)
                 if memoize:
-                    self._memo_unknown(peer.name,
-                                       sj.get("metric") or "", data)
-                continue
-            if status != 200:
-                # same rule as the combined scatter: a non-400
-                # rejection is peer damage, not an empty partial
-                died = True
-                continue
-            try:
-                part = json.loads(data)
-            except ValueError:
-                died = True
-                continue
-            sub_answered[k].add(peer.name)
-            if memoize:
-                self._memo_known(peer.name, {sj.get("metric")})
-            for r in part:
-                q = r.get("query")
-                if isinstance(q, dict):
-                    q["index"] = k  # single-sub answers say index 0
-            rows.extend(part)
+                    self._memo_known(peer.name, {sj.get("metric")})
+                for r in part:
+                    q = r.get("query")
+                    if isinstance(q, dict):
+                        # single-sub answers say index 0
+                        q["index"] = k
+                rows.extend(part)
         return ([], True) if died else (rows, False)
 
     @staticmethod
@@ -1791,11 +1897,30 @@ class ClusterRouter:
 
     def _query_peer(self, peer: Peer, body: bytes,
                     headers: dict[str, str] | None = None
-                    ) -> tuple[int, bytes]:
+                    ) -> tuple[int, Any]:
         if not peer.breaker.allow():
             raise PeerUnavailable(
                 f"breaker for {peer.name} is "
                 f"{peer.breaker.state}")
+        if self.wire.usable(peer):
+            # streamed columnar leg: partial grids decode as frames
+            # arrive. Returns decoded ROWS on 200 (callers treat a
+            # list as already-parsed) and body bytes on non-200, so
+            # the 400-body checks work identically on either
+            # transport. WireUnsupported falls through to HTTP.
+            try:
+                self._check_faults(peer)
+                status, data = self.wire.query(peer, body,
+                                               headers=headers)
+            except (wire_mod.WireUnsupported,
+                    wire_mod.WireBacklogged):
+                pass
+            except OSError:
+                peer.breaker.record_failure()
+                raise
+            else:
+                peer.breaker.record_success()
+                return status, data
         try:
             # fault site inside the recorded section: an injected
             # cluster.peer fault must trip the breaker exactly like a
@@ -1812,7 +1937,7 @@ class ClusterRouter:
         return status, data
 
     def _query_peer_traced(self, tctx, parent_id, peer: Peer,
-                           body: bytes) -> tuple[int, bytes]:
+                           body: bytes) -> tuple[int, Any]:
         """One scatter leg under its ``cluster.peer`` span (runs on a
         pool thread): the span id rides the ``X-TSD-Trace`` header so
         the shard roots its subtree under THIS leg, and a failed leg
@@ -2574,6 +2699,12 @@ class ClusterRouter:
             "sub_memo_skips": self.sub_memo_skips,
             "sub_memo_invalidations": self.sub_memo_invalidations,
             "sub_memo_evictions": self.sub_memo_evictions,
+            "sub_retry": {
+                "max_concurrent": self.sub_retry_max_concurrent,
+                "rounds": self.sub_retry_rounds,
+                "singles": self.sub_retry_singles,
+                "capped": self.sub_retry_capped,
+            },
             "spool_backlog_records": sum(
                 p.spool.pending_records for p in self.peers.values()),
             "peers": {name: peer.health_info()
@@ -2622,6 +2753,12 @@ class ClusterRouter:
                          self.sub_memo_invalidations)
         collector.record("cluster.sub_memo.evictions",
                          self.sub_memo_evictions)
+        collector.record("cluster.sub_retry.rounds",
+                         self.sub_retry_rounds)
+        collector.record("cluster.sub_retry.singles",
+                         self.sub_retry_singles)
+        collector.record("cluster.sub_retry.capped",
+                         self.sub_retry_capped)
         for name, p in sorted(self.peers.items()):
             collector.record("cluster.forwarded_points",
                              p.forwarded_points, peer=name)
@@ -2634,4 +2771,18 @@ class ClusterRouter:
             collector.record("cluster.query_failures",
                              p.query_failures, peer=name)
             collector.record("cluster.hedges", p.hedges, peer=name)
+            collector.record("cluster.wire.bytes_out",
+                             p.wire_bytes_out, peer=name)
+            collector.record("cluster.wire.bytes_in",
+                             p.wire_bytes_in, peer=name)
+            collector.record("cluster.wire.frames_out",
+                             p.wire_frames_out, peer=name)
+            collector.record("cluster.wire.frames_in",
+                             p.wire_frames_in, peer=name)
+            collector.record("cluster.wire.pipeline_depth",
+                             p.wire_pipeline_depth, peer=name)
+            collector.record("cluster.wire.fallbacks",
+                             p.wire_fallbacks, peer=name)
+            collector.record("cluster.wire.backpressure_sheds",
+                             p.wire_backpressure_sheds, peer=name)
             p.breaker.collect_stats(collector)

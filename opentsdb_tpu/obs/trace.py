@@ -96,6 +96,7 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "cluster.merge",         # cross-shard partial merge
     "cluster.forward",       # one shard's write-forward leg
     "cluster.spool.append",  # durable handoff of one write batch
+    "cluster.wire.connect",  # binary wire negotiation (cluster/wire.py)
     # background stages
     "coldstore.spill",       # lifecycle sweep's disk spill phase
 })

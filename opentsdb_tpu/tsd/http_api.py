@@ -480,7 +480,81 @@ class HttpRpcRouter:
             "ingest.put", request,
             lambda: self._handle_put_run(request))
 
+    def _put_error_sink(self, errors: list) -> Callable:
+        """Per-point error sink shared by the JSON and wire put paths:
+        record the error for the response AND hand storage-layer
+        failures to the SEH spool for replay."""
+        def spool(dp: dict, e: Exception) -> None:
+            errors.append({"datapoint": dp, "error": str(e)})
+            seh = self.tsdb.storage_exception_handler
+            from opentsdb_tpu.core.uid import FailedToAssignUniqueIdError
+            if seh is not None and not isinstance(
+                    e, (ValueError, LookupError,
+                        FailedToAssignUniqueIdError)):
+                # spool only storage-layer failures for replay; a bad
+                # datapoint (unknown UID, filter veto, bad value) fails
+                # identically on every retry
+                # (ref: PutDataPointRpc requeue via SEH plugin)
+                seh.handle_error(dp, e)
+        return spool
+
+    def _handle_put_wire(self, request: HttpRequest,
+                         groups: list) -> HttpResponse:
+        """Columnar wire delivery (``cluster/wire.py``): the batch
+        arrives as pre-decoded ``(metric, tags, refs, ts, values)``
+        groups, so it lands through ``add_point_groups`` — one WAL
+        write + one group-committed fsync — with ZERO intermediate
+        JSON. Validation still happens where it always has: inside
+        the store, reported per point through the same error/SEH sink
+        as the JSON path, so responses are byte-shaped identically."""
+        details = request.flag("details")
+        summary = request.flag("summary")
+        cluster = self.tsdb.cluster
+        if cluster is not None:
+            # a wire delivery reached a router (router→router topo):
+            # re-partition and forward, exactly like a JSON body would
+            points = [dp for g in groups for dp in g[2]]
+            success, failed, errors = cluster.forward_writes(points)
+            return HttpResponse(
+                400 if failed else 200,
+                request.serializer.format_put(success, failed, errors,
+                                              details))
+        errors: list[dict] = []
+        spool = self._put_error_sink(errors)
+        t = self.tsdb
+        use_hooks = (bool(t.write_filters) or t.rt_publisher is not None
+                     or t.meta_cache is not None)
+        _h = trace_begin("store.scatter", groups=len(groups))
+        if use_hooks:
+            # per-point hook plugins are inherently per-point: flatten
+            # the columns back to tuples for them (rare on shards)
+            parsed: list[tuple] = []
+            dps: list[dict] = []
+            for metric, tags, refs, ts_list, values in groups:
+                for dp, ts, value in zip(refs, ts_list, values):
+                    parsed.append((metric, ts, value, tags))
+                    dps.append(dp)
+            success, _ = t.add_point_batch(
+                parsed, on_error=lambda i, e: spool(dps[i], e))
+        else:
+            success, _ = t.add_point_groups(groups, on_error=spool)
+        trace_end(_h)
+        failed = len(errors)
+        if not details and not summary:
+            if failed:
+                raise HttpError(
+                    400, "One or more data points had errors",
+                    f"{failed} error(s) storing datapoints")
+            return HttpResponse(204)
+        return HttpResponse(
+            400 if failed else 200,
+            request.serializer.format_put(success, failed, errors,
+                                          details))
+
     def _handle_put_run(self, request: HttpRequest) -> HttpResponse:
+        wire_groups = getattr(request, "wire_groups", None)
+        if wire_groups is not None:
+            return self._handle_put_wire(request, wire_groups)
         # ONE decode span: body parse through validate/group (router
         # bodies end it after the parse — forwarding re-validates on
         # the shard, which records its own decode)
@@ -510,20 +584,7 @@ class HttpRpcRouter:
                 request.serializer.format_put(success, failed, errors,
                                               details))
         errors: list[dict] = []
-
-        def spool(dp: dict, e: Exception) -> None:
-            errors.append({"datapoint": dp, "error": str(e)})
-            seh = self.tsdb.storage_exception_handler
-            from opentsdb_tpu.core.uid import FailedToAssignUniqueIdError
-            if seh is not None and not isinstance(
-                    e, (ValueError, LookupError,
-                        FailedToAssignUniqueIdError)):
-                # spool only storage-layer failures for replay; a bad
-                # datapoint (unknown UID, filter veto, bad value) fails
-                # identically on every retry
-                # (ref: PutDataPointRpc requeue via SEH plugin)
-                seh.handle_error(dp, e)
-
+        spool = self._put_error_sink(errors)
         t = self.tsdb
         use_hooks = (bool(t.write_filters) or t.rt_publisher is not None
                      or t.meta_cache is not None)
@@ -731,6 +792,7 @@ class HttpRpcRouter:
                 delete=bool(tsq.delete))
         streamed = False
         cluster = self.tsdb.cluster
+        wire_sink = getattr(request, "wire_sink", None)
         degraded_shards: list[str] = []
         try:
             if cluster is not None:
@@ -776,7 +838,7 @@ class HttpRpcRouter:
             stream_after = self.tsdb.config.get_int(
                 "tsd.http.query.stream_threshold_dps", 1_000_000)
             if stream_after and total_dps > stream_after \
-                    and cluster is None \
+                    and cluster is None and wire_sink is None \
                     and not (tsq.show_summary or tsq.show_stats
                              or request.flag("show_summary")
                              or request.flag("show_stats")) \
@@ -812,13 +874,26 @@ class HttpRpcRouter:
                 streamed = True
                 return HttpResponse(200, b"", body_iter=body_iter())
             _h = trace_begin("query.serialize")
-            body = request.serializer.format_query(
-                tsq, results, as_arrays=request.flag("arrays"),
-                show_summary=tsq.show_summary
-                or request.flag("show_summary"),
-                show_stats=tsq.show_stats or request.flag("show_stats"),
-                summary_extra=stats.stats,
-                degraded_shards=degraded_shards)
+            if wire_sink is not None:
+                # columnar wire leg (cluster/wire.py): ship each sub's
+                # grids straight onto the socket as framed column
+                # blocks the moment this handler reaches them — no
+                # JSON serialization on the read path at all
+                by_sub: dict[int, list] = {}
+                for r in results:
+                    by_sub.setdefault(r.sub_query_index, []).append(r)
+                for idx, rs in sorted(by_sub.items()):
+                    wire_sink(tsq, idx, rs)
+                body = b""
+            else:
+                body = request.serializer.format_query(
+                    tsq, results, as_arrays=request.flag("arrays"),
+                    show_summary=tsq.show_summary
+                    or request.flag("show_summary"),
+                    show_stats=tsq.show_stats
+                    or request.flag("show_stats"),
+                    summary_extra=stats.stats,
+                    degraded_shards=degraded_shards)
             trace_end(_h)
             ser_ms = (time.monotonic() - t_ser) * 1e3
             stats.add_stat(QueryStat.SERIALIZATION_TIME, ser_ms)

@@ -416,6 +416,15 @@ class TSDServer:
                 LOG.warning("connections still open after 10s; "
                             "forcing shutdown")
             self._server = None
+        # cluster wire sessions poll the listener and self-terminate,
+        # but a caller that stops the loop right after this return
+        # would abandon them mid-poll (and leak their sockets):
+        # cancel deterministically instead of racing the poll
+        sessions = list(getattr(self, "_wire_sessions", ()))
+        for t in sessions:
+            t.cancel()
+        if sessions:
+            await asyncio.gather(*sessions, return_exceptions=True)
         th = getattr(self, "_warmup_thread", None)
         if th is not None and th.is_alive():
             await asyncio.get_event_loop().run_in_executor(
@@ -468,6 +477,8 @@ class TSDServer:
                 return
             if first in _HTTP_METHODS or first[:3] == b"GET":
                 await self._serve_http(first, reader, writer)
+            elif first == b"TSDW":
+                await self._serve_wire(reader, writer)
             else:
                 await self._serve_telnet(first, reader, writer)
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -492,6 +503,26 @@ class TSDServer:
                 # reset connection; the handler's real errors were
                 # logged and counted above
                 pass
+
+    # -- cluster wire --------------------------------------------------
+
+    async def _serve_wire(self, reader, writer) -> None:
+        """Binary columnar cluster wire session (router sniffed in by
+        the ``TSDW`` magic). Frames are read directly — NOT through
+        ``_on_client`` — because a persistent pipelined link is idle
+        between deliveries by design; its lifetime is bounded by the
+        session's listener watchdog (and ``stop()``'s deterministic
+        cancel) instead of the idle reaper."""
+        from opentsdb_tpu.cluster import wire as wire_mod
+        sessions = getattr(self, "_wire_sessions", None)
+        if sessions is None:
+            sessions = self._wire_sessions = set()
+        task = asyncio.current_task()
+        sessions.add(task)
+        try:
+            await wire_mod.serve_wire(self, reader, writer)
+        finally:
+            sessions.discard(task)
 
     # -- telnet --------------------------------------------------------
 

@@ -220,6 +220,27 @@ _DEFAULTS: dict[str, str] = {
     #   tail-latency hedging: duplicate a peer request that hasn't
     #   answered after this many ms, first completion wins (0 = off)
     "tsd.cluster.hedge_after_ms": "0",
+    #   binary columnar cluster wire (cluster/wire.py): persistent
+    #   framed router↔shard links with pipelined columnar writes and
+    #   streamed partial-grid reads; false = JSON HTTP only (also
+    #   honored shard-side: a disabled shard refuses the handshake
+    #   and the router falls back transparently)
+    "tsd.cluster.wire.enable": "true",
+    #   write pipelining bound per peer: past this many unacked
+    #   deliveries in flight the router sheds into the durable spool
+    #   (backpressure, not failure — the breaker is untouched)
+    "tsd.cluster.wire.max_inflight": "32",
+    #   how long a failed negotiation pins a peer to JSON HTTP before
+    #   the wire is re-tried (version-skew fallback window)
+    "tsd.cluster.wire.fallback_ttl_ms": "30000",
+    #   wire connect + handshake deadline; past it the peer is
+    #   treated as not speaking wire (HTTP fallback), while a refused
+    #   TCP connect stays a normal peer failure (breaker/spool)
+    "tsd.cluster.wire.connect_timeout_ms": "1000",
+    #   cap on concurrent single-sub re-asks against ONE peer when a
+    #   multi-sub 400 cannot be attributed to a metric (the per-sub
+    #   sweep); bounds scatter amplification on partially-known shards
+    "tsd.cluster.sub_retry.max_concurrent": "4",
     #   per-(peer, metric) known/unknown memo for the scatter path:
     #   a shard that 400'd "no such name" for a metric is not re-asked
     #   about it until a write for that metric is forwarded/replayed

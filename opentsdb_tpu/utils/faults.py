@@ -75,11 +75,13 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "cluster.reshard",    # cluster/reshard.py backfill step
     "cluster.retire",     # cluster/retire.py stale-copy delete step
     "cluster.gossip",     # cluster/gossip.py sibling-router push
+    "cluster.wire",       # cluster/wire.py router-side wire exchange
 })
 
 # site families with runtime-named tails (per-peer arming)
 DYNAMIC_SITE_PREFIXES: tuple[str, ...] = ("cluster.peer.",
-                                          "cluster.gossip.")
+                                          "cluster.gossip.",
+                                          "cluster.wire.")
 
 
 def is_known_site(site: str) -> bool:

@@ -32,6 +32,7 @@ import pytest
 
 from opentsdb_tpu import TSDB, Config
 from opentsdb_tpu.cluster import merge as merge_mod
+from opentsdb_tpu.cluster import wire as wire_mod
 from opentsdb_tpu.cluster.client import parse_peer_spec
 from opentsdb_tpu.cluster.hashring import HashRing, series_shard_key
 from opentsdb_tpu.cluster.spool import MAGIC, PeerSpool, SpoolFull
@@ -3247,6 +3248,294 @@ class TestRouterMapsStayBounded:
             assert router.sub_memo_evictions >= 64
         finally:
             t.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# binary columnar cluster wire (-m wire): codec oracle, negotiation
+# fallback, pipelined-write backpressure, chaos teardown semantics
+# ---------------------------------------------------------------------------
+
+class _QRow:
+    """Minimal QueryResult stand-in for the qres codec oracle."""
+
+    def __init__(self, metric, tags, dps, aggregated_tags=()):
+        self.metric = metric
+        self.tags = tags
+        self.aggregated_tags = list(aggregated_tags)
+        self.tsuids = None
+        self.annotations = None
+        self.global_annotations = None
+        self.dps = dps
+
+
+class _QSpec:
+    no_annotations = True
+    global_annotations = False
+
+
+class TestWireCodec:
+    pytestmark = pytest.mark.wire
+
+    def test_write_round_trip_preserves_values_and_grouping(self):
+        dps = [
+            {"metric": "w.m", "timestamp": BASE, "value": 7,
+             "tags": {"host": "a", "dc": "x"}},
+            # same series, tag insertion order flipped: must share a
+            # column block with the first point
+            {"metric": "w.m", "timestamp": BASE + 1, "value": 2.5,
+             "tags": {"dc": "x", "host": "a"}},
+            {"metric": "w.m", "timestamp": BASE, "value": -(2 ** 52),
+             "tags": {"host": "b"}},
+            {"metric": "w.other", "timestamp": -BASE, "value": 0.25,
+             "tags": None},
+        ]
+        payload = wire_mod.encode_write(dps, trace="t-abc")
+        trace, groups = wire_mod.decode_write(payload)
+        assert trace == "t-abc"
+        keys = sorted((m, tuple(sorted(t.items())))
+                      for m, t, _, _, _ in groups)
+        assert keys == [("w.m", (("dc", "x"), ("host", "a"))),
+                        ("w.m", (("host", "b"),)),
+                        ("w.other", ())]
+        flat = {}
+        for metric, tags, refs, ts_list, values in groups:
+            assert len(refs) == len(ts_list) == len(values)
+            for t, v in zip(ts_list, values):
+                flat[(metric, tuple(sorted(tags.items())), t)] = v
+        for dp in dps:
+            tags = tuple(sorted((dp["tags"] or {}).items()))
+            got = flat[(dp["metric"], tags, dp["timestamp"])]
+            # int-ness survives the f64 columns (packed mask), so the
+            # shard stores exactly what the JSON path would have
+            assert got == dp["value"]
+            assert type(got) is type(dp["value"])
+
+    def test_encode_is_strict_about_canonical_shape(self):
+        good = {"metric": "m", "timestamp": 1, "value": 1, "tags": {}}
+        for bad in (
+                dict(good, value=True),          # bool is not int
+                dict(good, value=1 << 53),       # beyond f64 precision
+                dict(good, value="7"),
+                dict(good, timestamp=1.0),
+                dict(good, metric=""),
+                dict(good, metric=7),
+                dict(good, tags={"a": 1}),
+                dict(good, extra=1),             # unknown key
+                ["not", "a", "dict"]):
+            with pytest.raises(wire_mod.WireEncodeError):
+                wire_mod.encode_write([bad])
+        # the canonical shape itself round-trips
+        wire_mod.encode_write([good])
+
+    def test_decode_rejects_torn_and_trailing_payloads(self):
+        payload = wire_mod.encode_write(
+            [{"metric": "m", "timestamp": 1, "value": 1.5,
+              "tags": {"h": "a"}}])
+        with pytest.raises(wire_mod.WireProtocolError):
+            wire_mod.decode_write(payload + b"X")
+        with pytest.raises(wire_mod.WireProtocolError):
+            wire_mod.decode_write(payload[:-1])
+        with pytest.raises(wire_mod.WireProtocolError):
+            wire_mod.decode_qres(b"\x01\x00\x00\x00")
+
+    def test_qres_round_trip_matches_json_row_iteration(self):
+        rows = [_QRow("q.m", {"host": "a"},
+                      [(1000, 3), (1010, 2.5), (1020, 2.0 ** 53)],
+                      aggregated_tags=["dc"]),
+                _QRow("q.m", {"host": "b"}, [])]
+        frames = wire_mod.qres_frames(9, 2, rows, _QSpec())
+        assert len(frames) == 1
+        ln, crc, ftype, seq = wire_mod._HDR.unpack_from(frames[0])
+        assert (ftype, seq) == (wire_mod.T_QRES, 9)
+        sub, decoded = wire_mod.decode_qres(
+            frames[0][wire_mod._HDR.size:])
+        assert sub == 2
+        assert [r["metric"] for r in decoded] == ["q.m", "q.m"]
+        assert decoded[0]["query"] == {"index": 2}
+        assert decoded[0]["aggregateTags"] == ["dc"]
+        # WireDps iterates exactly as json.loads of the HTTP arrays
+        # body would: ints where the serializer would emit ints
+        # (2**53 is integral but out of the int-emission range)
+        got = list(decoded[0]["dps"])
+        assert got == [(1000, 3), (1010, 2.5), (1020, 2.0 ** 53)]
+        assert [type(v) for _, v in got] == [int, float, float]
+        assert list(decoded[1]["dps"]) == []
+        # an empty sub emits NO frames (absence == empty partial)
+        assert wire_mod.qres_frames(9, 3, [], _QSpec()) == []
+
+
+class TestWireFallbackNegotiation:
+    pytestmark = pytest.mark.wire
+
+    def test_version_skew_shard_falls_back_to_json(self, tmp_path):
+        """Shards that do not speak the wire (gate off — the stand-in
+        for an older build) must cost one failed negotiation, then
+        serve every write and read over JSON HTTP with no loss."""
+        c = LiveCluster(
+            tmp_path,
+            peer_cfg={"tsd.cluster.wire.enable": "false"})
+        try:
+            pts = _mkpoints(n_hosts=6, n_sec=30)
+            resp = c.put(pts, summary="true")
+            assert resp.status == 200
+            assert json.loads(resp.body)["failed"] == 0
+            resp, out = c.query(_tsq(QUERIES[0]))
+            assert resp.status == 200
+            rows, degraded = _strip_marker(out)
+            assert degraded == []
+            want = json.loads(_oracle(pts).handle(req(
+                "POST", "/api/query", _tsq(QUERIES[0]))).body)
+            assert _sorted_rows(rows) == _sorted_rows(want)
+            peers = c.router.peers.values()
+            assert sum(p.wire_fallbacks for p in peers) >= 1
+            # no wire link ever came up
+            assert all(p.wire_connects == 0 for p in peers)
+            h = json.loads(c.http.handle(
+                req("GET", "/api/health")).body)
+            fb = [p["wire"]["fallbacks"]
+                  for p in h["cluster"]["peers"].values()]
+            assert sum(fb) >= 1
+        finally:
+            c.close()
+
+    def test_router_side_gate_keeps_http_wholesale(self, tmp_path):
+        c = LiveCluster(tmp_path,
+                        **{"tsd.cluster.wire.enable": "false"})
+        try:
+            pts = _mkpoints(n_hosts=4, n_sec=10)
+            assert c.put(pts, summary="true").status == 200
+            resp, out = c.query(_tsq(QUERIES[0]))
+            assert resp.status == 200
+            assert _strip_marker(out)[1] == []
+            assert all(p.wire_connects == 0 and p.wire_fallbacks == 0
+                       for p in c.router.peers.values())
+        finally:
+            c.close()
+
+
+class TestWireWriteBackpressure:
+    pytestmark = pytest.mark.wire
+
+    def test_saturated_pipeline_sheds_to_spool_no_loss(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True,
+                        **{"tsd.cluster.wire.max_inflight": "1"})
+        try:
+            pts = _mkpoints(n_hosts=6, n_sec=10)
+            assert c.put(pts, summary="true").status == 200
+            target = c.shard_of("c.m", {"host": "h00"})
+            peer = c.router.peers[target]
+            assert peer.wire_connects >= 1  # the wire is in use
+            # hold the only pipeline slot: the next delivery must be
+            # ACKNOWLEDGED into the spool (shed), never block the put
+            sem = c.router.wire._sem(target)
+            assert sem.acquire(blocking=False)
+            try:
+                extra = [{"metric": "c.m", "timestamp": BASE + 999,
+                          "value": 41, "tags": {"host": "h00"}}]
+                resp = c.put(extra, summary="true")
+                assert resp.status == 200
+                assert json.loads(resp.body)["failed"] == 0
+                assert peer.wire_backpressure_sheds >= 1
+                assert peer.spool.pending_records > 0
+            finally:
+                sem.release()
+            assert c.wait_spool_drained(target)
+            stats = json.loads(c.http.handle(
+                req("GET", "/api/stats")).body)
+            names = {s["metric"] for s in stats}
+            assert {"tsd.cluster.wire.bytes_out",
+                    "tsd.cluster.wire.frames_in",
+                    "tsd.cluster.wire.pipeline_depth",
+                    "tsd.cluster.sub_retry.rounds"} <= names
+            sheds = [s for s in stats if s["metric"] ==
+                     "tsd.cluster.wire.backpressure_sheds"
+                     and s["tags"].get("peer") == target]
+            assert sheds and sheds[0]["value"] >= 1
+            # shed-then-replay lost nothing
+            resp, out = c.query(_tsq(QUERIES[0]))
+            rows, degraded = _strip_marker(out)
+            assert degraded == []
+            want = json.loads(_oracle(pts + extra).handle(req(
+                "POST", "/api/query", _tsq(QUERIES[0]))).body)
+            assert _sorted_rows(rows) == _sorted_rows(want)
+        finally:
+            c.close()
+
+
+class TestWireChaos(ChaosBase):
+    pytestmark = pytest.mark.wire
+
+    def test_kill_mid_streamed_read_answers_degraded(self, chaos):
+        """The plug is pulled while a shard hangs mid-query with its
+        wire session streaming: the router must see a torn stream,
+        record the peer fault and answer 200 degraded — bit-identical
+        to the oracle restricted to the surviving shards."""
+        c = chaos
+        dead = "s1"
+        assert c.router.peers[dead].wire_frames_out > 0  # wire in use
+        hit = c.peer(dead).hang("query")
+        result = {}
+
+        def ask():
+            resp, out = c.query(self.fresh_q(salt=7001))
+            result["resp"], result["out"] = resp, out
+
+        th = threading.Thread(target=ask)
+        th.start()
+        assert hit.wait(10), "query never reached the peer"
+        c.peer(dead).kill()
+        th.join(timeout=30)
+        assert not th.is_alive(), "router request hung"
+        c.peer(dead).unhang()
+        assert result["resp"].status == 200
+        rows, degraded = _strip_marker(result["out"])
+        assert degraded == [dead]
+        oracle = _oracle(self.surviving_points(c, dead))
+        want, _ = _strip_marker(json.loads(oracle.handle(req(
+            "POST", "/api/query", self.fresh_q(salt=7001))).body))
+        assert _sorted_rows(rows) == _sorted_rows(want)
+        c.peer(dead).restart()
+        assert c.wait_spool_drained(dead)
+
+    def test_torn_write_frame_then_replay_reconnects_no_loss(
+            self, chaos):
+        """A write frame truncated mid-payload (header promises more
+        bytes than ever arrive) must tear the session down with
+        NOTHING applied; once the peer is back, the spool replay
+        renegotiates a fresh wire link and redelivers everything."""
+        c = chaos
+        target = "s0"
+        peer = c.router.peers[target]
+        conn = c.router.wire._conn(peer, "w")
+        connects = peer.wire_connects
+        torn = wire_mod._HDR.pack(64, 0, wire_mod.T_WRITE, 7)
+        conn.sock.sendall(torn + b"\x00" * 32)
+        conn.close()  # the stream dies mid-frame
+        c.peer(target).kill()
+        extra = [{"metric": "c.m", "timestamp": BASE + 4000 + i,
+                  "value": i, "tags": {"host": f"h{h:02d}"}}
+                 for i in range(10) for h in range(self.N_HOSTS)]
+        resp = c.put(extra, summary="true")
+        assert resp.status == 200
+        assert json.loads(resp.body)["failed"] == 0
+        assert peer.spool.pending_records > 0
+        c.peer(target).restart()
+        assert c.wait_spool_drained(target)
+        assert peer.wire_connects > connects  # fresh negotiated link
+        full_oracle = _oracle(self.points + extra)
+        body = self.fresh_q(salt=7002)
+        deadline = time.monotonic() + 10
+        while True:  # breaker may need one probe cycle to close
+            resp, out = c.query(body)
+            rows, degraded = _strip_marker(out)
+            if not degraded or time.monotonic() > deadline:
+                break
+            body = self.fresh_q(salt=int(time.monotonic() * 1000))
+            time.sleep(0.2)
+        assert degraded == []
+        want = json.loads(full_oracle.handle(req(
+            "POST", "/api/query", body)).body)
+        assert _sorted_rows(rows) == _sorted_rows(want)
 
 
 @pytest.mark.slow
