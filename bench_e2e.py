@@ -778,6 +778,238 @@ def bench_cold(repeats: int, n_series: int = 2000,
     return out
 
 
+def bench_sketch(repeats: int, n_series: int = 64,
+                 span_s: int = 7200) -> dict:
+    """Quantile-sketch config: p99 percentile queries over the three
+    storage shapes the sketch column serves — all-raw (live fold),
+    tier-demoted (persisted sketch cells), and cold-spilled (mmap
+    sketch blobs stitched with tier + raw tail) — plus a 3-shard
+    scatter/gather whose merged partials must be bit-equal to a
+    single-node oracle. Every answer is checked against the exact
+    lower order statistic of the pooled raw values per bucket;
+    criterion: worst relative error <= 1.1 * alpha for all shapes
+    and a bit-equal cluster merge."""
+    import shutil
+    import tempfile
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.query.model import TSQuery
+
+    cold_dir = tempfile.mkdtemp(prefix="sketchbench-")
+
+    def mk(shape: str):
+        cfg = {"tsd.core.auto_create_metrics": "true",
+               "tsd.storage.backend": "memory",
+               "tsd.query.cache.enable": "false",
+               "tsd.tpu.warmup": "false"}
+        if shape in ("demoted", "cold"):
+            cfg.update({"tsd.rollups.enable": "true",
+                        "tsd.lifecycle.enable": "true",
+                        "tsd.lifecycle.demote_after": "30m",
+                        "tsd.lifecycle.demote_tiers": "1m"})
+        if shape == "cold":
+            cfg.update({"tsd.lifecycle.spill_after": "60m",
+                        "tsd.coldstore.dir": cold_dir})
+        return TSDB(Config(**cfg))
+
+    stores = {s: mk(s) for s in ("raw", "demoted", "cold")}
+    alpha = stores["raw"].config.get_float("tsd.sketch.alpha", 0.01)
+    bound = 1.1 * alpha
+    ts = np.arange(BASE_S, BASE_S + span_s, dtype=np.int64)
+    rng = np.random.default_rng(23)
+    vals = rng.lognormal(3.0, 1.0, (n_series, span_s))
+    t0 = time.perf_counter()
+    for i in range(n_series):
+        for t in stores.values():
+            t.add_points("sys.lat", ts, vals[i],
+                         {"host": f"h{i:04d}"})
+    ingest_s = time.perf_counter() - t0
+    now_ms = BASE_MS + span_s * 1000
+    rep = stores["demoted"].lifecycle.sweep(now_ms=now_ms)
+    assert rep.get("demoted", 0) > 0, rep
+    rep = stores["cold"].lifecycle.sweep(now_ms=now_ms)
+    assert rep.get("spilled", 0) > 0, rep
+
+    # exact p99 per 5m bucket over the pooled raw values
+    bucket_ms = 300_000
+    slots = (ts * 1000) - (ts * 1000) % bucket_ms
+    exact = {int(s): float(np.percentile(
+        vals[:, slots == s].ravel(), 99.0, method="lower"))
+        for s in np.unique(slots)}
+
+    qobj = {"start": BASE_MS, "end": now_ms,
+            "queries": [{"metric": "sys.lat", "aggregator": "sum",
+                         "downsample": "5m-avg",
+                         "percentiles": [99.0]}]}
+
+    def p50(tsdb):
+        tsdb.execute_query(TSQuery.from_json(qobj).validate())  # warm
+        times, out = [], None
+        for _ in range(max(repeats, 3)):
+            t0 = time.perf_counter()
+            out = tsdb.execute_query(
+                TSQuery.from_json(qobj).validate())
+            times.append(time.perf_counter() - t0)
+        return _percentile(times, 50) * 1e3, out
+
+    lat, err = {}, {}
+    for shape, t in stores.items():
+        ms, out_rows = p50(t)
+        rows = [r for r in out_rows
+                if r.metric.endswith("_pct_99")]
+        got = {}
+        for r in rows:
+            got.update(r.dps)
+        assert set(got) == set(exact), (shape, "buckets differ")
+        lat[shape] = ms
+        err[shape] = max(
+            abs(got[s] - exact[s]) / max(abs(exact[s]), 1e-12)
+            for s in exact)
+
+    cluster = _bench_sketch_cluster(repeats)
+    out = {"config": "sketch", "alpha": alpha,
+           "error_bound": round(bound, 4),
+           "series": n_series, "points": n_series * span_s,
+           "ingest_mpps": round(
+               3 * n_series * span_s / ingest_s / 1e6, 2),
+           "points_spilled": rep["spilled"],
+           "p99_raw_p50_ms": round(lat["raw"], 1),
+           "p99_demoted_p50_ms": round(lat["demoted"], 1),
+           "p99_cold_p50_ms": round(lat["cold"], 1),
+           "cold_vs_raw_ratio": round(
+               lat["cold"] / max(lat["raw"], 1e-3), 2),
+           "worst_rel_err": {k: float(f"{v:.2e}")
+                             for k, v in err.items()},
+           "cluster": cluster,
+           "criterion_pass": bool(
+               all(v <= bound for v in err.values())
+               and cluster["merged_bit_equal"])}
+    for t in stores.values():
+        t.shutdown()
+    shutil.rmtree(cold_dir, ignore_errors=True)
+    return out
+
+
+def _bench_sketch_cluster(repeats: int, n_hosts: int = 24,
+                          span_s: int = 600) -> dict:
+    """3-shard percentile scatter/gather leg of the sketch config:
+    the router folds per-shard serialized sketch partials and must
+    answer bit-equal to a single node holding all the points."""
+    import asyncio
+    import json as _json
+    import threading
+
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+    from opentsdb_tpu.tsd.server import TSDServer
+
+    peer_cfg = {"tsd.core.auto_create_metrics": "true",
+                "tsd.tpu.warmup": "false"}
+
+    class Peer:
+        def __init__(self):
+            self.tsdb = TSDB(Config(**peer_cfg))
+            self.loop = asyncio.new_event_loop()
+            self.server = TSDServer(self.tsdb, host="127.0.0.1",
+                                    port=0)
+            started = threading.Event()
+
+            def run():
+                asyncio.set_event_loop(self.loop)
+                self.loop.run_until_complete(self.server.start())
+                started.set()
+                self.loop.run_forever()
+
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+            assert started.wait(30)
+            self.port = (self.server._server.sockets[0]
+                         .getsockname()[1])
+
+        def stop(self):
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.server.stop(), self.loop).result(20)
+            except Exception:  # noqa: BLE001
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+
+    def req(method, path, body=None, **params):
+        return HttpRequest(
+            method=method, path=path,
+            params={k: [str(v)] for k, v in params.items()},
+            body=_json.dumps(body).encode()
+            if body is not None else b"")
+
+    peers = [Peer() for _ in range(3)]
+    spec = ",".join(f"s{i}=127.0.0.1:{p.port}"
+                    for i, p in enumerate(peers))
+    router = TSDB(Config(**{
+        "tsd.cluster.role": "router", "tsd.cluster.peers": spec,
+        "tsd.query.cache.enable": "false",
+        "tsd.tpu.warmup": "false"}))
+    http = HttpRpcRouter(router)
+    router.cluster.start()
+    single = TSDB(Config(**{**peer_cfg,
+                            "tsd.query.cache.enable": "false"}))
+    single_http = HttpRpcRouter(single)
+
+    rng = np.random.default_rng(29)
+    points = [{"metric": "bench.sk", "timestamp": BASE_S + i,
+               "value": float(v),
+               "tags": {"host": f"h{h:03d}"}}
+              for h in range(n_hosts)
+              for i, v in enumerate(rng.lognormal(2, 1, span_s))]
+    for target in (http, single_http):
+        for i in range(0, len(points), 4000):
+            resp = target.handle(req("POST", "/api/put",
+                                     points[i:i + 4000],
+                                     summary="true"))
+            assert resp.status == 200
+            assert _json.loads(resp.body)["failed"] == 0
+
+    qbody = {"start": BASE_MS - 1000,
+             "end": BASE_MS + span_s * 1000,
+             "queries": [{"metric": "bench.sk", "aggregator": "sum",
+                          "downsample": "1m-avg",
+                          "percentiles": [99.0]}]}
+
+    def read_p50(target):
+        target.handle(req("POST", "/api/query", qbody))  # warm
+        times, body = [], b""
+        for _ in range(max(repeats, 3)):
+            t0 = time.perf_counter()
+            resp = target.handle(req("POST", "/api/query", qbody))
+            times.append(time.perf_counter() - t0)
+            assert resp.status == 200
+            body = resp.body
+        return _percentile(times, 50) * 1e3, body
+
+    scatter_p50, scatter_body = read_p50(http)
+    single_p50, single_body = read_p50(single_http)
+
+    def rows(body):
+        doc = _json.loads(body)
+        if doc and isinstance(doc[-1], dict) \
+                and "shardsDegraded" in doc[-1]:
+            doc = doc[:-1]
+        return sorted((r["metric"], sorted(r["tags"].items()),
+                       sorted(r["dps"].items())) for r in doc)
+
+    merged = rows(scatter_body)
+    bit_equal = bool(merged and merged == rows(single_body))
+    for p in peers:
+        p.stop()
+    router.shutdown()
+    single.shutdown()
+    return {"shards": 3, "series": n_hosts,
+            "points": len(points),
+            "scatter_p99_p50_ms": round(scatter_p50, 1),
+            "single_p99_p50_ms": round(single_p50, 1),
+            "scatter_gather_overhead": round(
+                scatter_p50 / max(single_p50, 1e-3), 2),
+            "merged_bit_equal": bit_equal}
+
+
 def bench_wal(repeats: int, n_series: int = 500,
               pts_per: int = 4000) -> dict:
     """Ingest throughput with the write-ahead log off / on. 'on'
@@ -2024,6 +2256,7 @@ def main() -> None:
                4: bench_config4, 5: bench_config5,
                "wal": bench_wal, "live": bench_live,
                "lifecycle": bench_lifecycle, "cold": bench_cold,
+               "sketch": bench_sketch,
                "ingest": bench_ingest, "viz": bench_viz,
                "cluster": bench_cluster,
                "cluster_rf": bench_cluster_rf,

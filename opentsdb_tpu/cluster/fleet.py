@@ -15,7 +15,14 @@ marked, never a 5xx) and merges:
   (:func:`merge_histogram_snapshots`), so a fleet p99 is computed
   from the merged distribution — exact, not an average of per-node
   percentiles (averaging percentiles is the classic observability
-  lie this module exists to avoid).
+  lie this module exists to avoid). Every snapshot also carries a
+  DDSketch companion (``sketch`` field): when bucket tables differ
+  across nodes (a mixed-build fleet) the bucket sum refuses, and the
+  sketches — whose merge is exact regardless of each node's ladder —
+  take over the percentile columns (``merge: "sketch"``). When the
+  buckets DO merge, the sketch percentiles ride along under a
+  ``sketch`` sub-document as the higher-resolution companion view
+  (relative-error buckets vs the 1ms-linear ladder's absolute bins).
 
 Also here: the consolidated operator progress surface behind
 ``GET /api/cluster/status`` — reshard epoch + backfill done-markers +
@@ -78,6 +85,32 @@ def scatter_json(router, path: str
     return docs, sorted(failed)
 
 
+def _merge_snapshot_sketches(snaps: "list[dict]"):
+    """Merge the base64 ``sketch`` companions of histogram snapshot
+    documents. Returns the merged DDSketch only when EVERY snapshot
+    carries a parseable, alpha-compatible sketch — a partial merge
+    would silently drop some nodes' observations from the fleet
+    distribution, which is exactly the lie this module refuses."""
+    from opentsdb_tpu.sketch.ddsketch import DDSketch, SketchError
+    merged = None
+    for s in snaps:
+        blob = s.get("sketch")
+        if not isinstance(blob, str):
+            return None
+        try:
+            sk = DDSketch.from_b64(blob)
+        except (SketchError, ValueError):
+            return None
+        if merged is None:
+            merged = sk
+        else:
+            try:
+                merged.merge(sk)
+            except SketchError:
+                return None
+    return merged
+
+
 def merge_fleet(docs: dict[str, dict]) -> dict[str, Any]:
     """Merge per-node raw-stats documents into the fleet view."""
     counters: dict[str, float] = {}
@@ -115,17 +148,40 @@ def merge_fleet(docs: dict[str, dict]) -> dict[str, Any]:
     hist_out: dict[str, dict[str, Any]] = {}
     for key, entry in sorted(hists.items()):
         merged = merge_histogram_snapshots(entry["snaps"])
-        if merged is None:
+        sketch = _merge_snapshot_sketches(entry["snaps"])
+        if merged is None and sketch is None:
             hist_out[key] = {"error": "bucket tables do not merge",
                              "nodes": entry["nodes"]}
             continue
-        pcts = percentiles_from_buckets(
-            merged["bounds"], merged["buckets"], merged["count"],
-            [q for _l, q in LATENCY_PCTS])
-        doc: dict[str, Any] = {
-            label: v for (label, _q), v in zip(LATENCY_PCTS, pcts)}
-        doc["count"] = merged["count"]
-        doc["sum"] = round(merged["sum"], 3)
+        sk_pcts = None
+        if sketch is not None:
+            vals = (sketch.quantiles([q for _l, q in LATENCY_PCTS])
+                    if sketch.count else [0.0] * len(LATENCY_PCTS))
+            sk_pcts = {label: float(v)
+                       for (label, _q), v in zip(LATENCY_PCTS, vals)}
+        doc: dict[str, Any]
+        if merged is not None:
+            # bucket sum is the primary path: bit-identical to the
+            # same observations landing in one histogram
+            pcts = percentiles_from_buckets(
+                merged["bounds"], merged["buckets"], merged["count"],
+                [q for _l, q in LATENCY_PCTS])
+            doc = {label: v
+                   for (label, _q), v in zip(LATENCY_PCTS, pcts)}
+            doc["count"] = merged["count"]
+            doc["sum"] = round(merged["sum"], 3)
+            doc["merge"] = "buckets"
+            if sk_pcts is not None:
+                doc["sketch"] = sk_pcts
+        else:
+            # mixed bucket ladders: the sketches still merge exactly,
+            # so the fleet percentiles come from the merged sketch —
+            # never from averaging per-node percentiles
+            doc = dict(sk_pcts)
+            doc["count"] = int(sketch.count)
+            doc["sum"] = round(sum(float(s.get("sum") or 0.0)
+                                   for s in entry["snaps"]), 3)
+            doc["merge"] = "sketch"
         doc["nodes"] = entry["nodes"]
         hist_out[key] = doc
     return {"counters": {k: counters[k] for k in sorted(counters)},

@@ -8,9 +8,19 @@ exactly when the aggregator decomposes, the same property the rollup
 tiers rely on (``rollup/job.py``): sum/count partials add, min/max
 partials min/max, and ``avg`` = merged sum / merged count (the
 ``RollupSpan`` sum+count qualifier trick lifted to the network).
-Non-decomposable aggregators (dev, median, percentiles) are a clean
-400 at the router — a silently-wrong merge would be worse than no
-answer.
+Quantile shapes merge through DDSketches instead of refusing:
+``percentiles`` sub-queries scatter as SKETCH PARTIALS (each shard
+returns its per-(group, bucket) serialized sketches, the router
+merges them — canonical sketch state is merge-order independent, so
+the merged sketch is bit-equal to a single node folding every
+shard's points — and extracts quantiles once), and the exact
+percentile aggregators (p50..p999, median) scatter as ``none``
+clones whose per-series downsampled values the router folds into
+per-(group, bucket) sketches as legs arrive, never an average of
+percentiles. ``dev`` and the estimated ``ep..r3/r7`` variants stay
+a clean 400 — a sketch answers quantiles, not variance, and the
+estimated variants promise a specific interpolation a sketch cannot
+reproduce; a silently-wrong merge would be worse than no answer.
 
 Timestamp grids: peers are queried with ``msResolution`` forced and an
 ABSOLUTE window (the router resolves relative times once), so
@@ -57,16 +67,35 @@ _COMBINE: dict[str, Callable[[float, float], float]] = {
 }
 
 
+def sketch_agg_quantile(name: str) -> float | None:
+    """The quantile a non-decomposable aggregator answers through the
+    cross-shard sketch merge, or None. Exact percentile aggregators
+    (p50..p999) and ``median`` qualify; the estimated ``ep..r3/r7``
+    variants do not (they promise a specific rank interpolation) and
+    ``dev`` is not a quantile at all."""
+    if name == "median":
+        return 50.0
+    from opentsdb_tpu.ops import aggregators as aggs_mod
+    if not aggs_mod.exists(name):
+        return None
+    agg = aggs_mod.get(name)
+    if agg.is_percentile and agg.estimation == "legacy":
+        return float(agg.percentile)
+    return None
+
+
 def decompose_plan(sub) -> str:
     """How one sub-query's partials merge across shards:
     ``"direct"`` (combine op exists), ``"concat"`` (emit-raw: groups
-    are single series, no cross-shard combining), or ``"avg"``
-    (rewritten into sum+count twins). Raises ``BadRequestError`` for
-    aggregators that do not decompose."""
+    are single series, no cross-shard combining), ``"avg"``
+    (rewritten into sum+count twins), ``"sketch"`` (``percentiles``
+    sub: shards return serialized per-bucket sketch partials), or
+    ``"sketch_agg"`` (exact percentile aggregator: shards run a
+    ``none`` clone, the router folds per-series values into
+    sketches). Raises ``BadRequestError`` for aggregators that do
+    not decompose."""
     if sub.percentiles:
-        raise BadRequestError(
-            "histogram percentile queries are not supported through "
-            "a cluster router (mergeable sketches are ROADMAP item 2)")
+        return "sketch"
     name = (sub.aggregator or "").lower()
     if name == "none":
         return "concat"
@@ -74,10 +103,12 @@ def decompose_plan(sub) -> str:
         return "direct"
     if name == "avg":
         return "avg"
+    if sketch_agg_quantile(name) is not None:
+        return "sketch_agg"
     raise BadRequestError(
         f"aggregator {sub.aggregator!r} does not decompose across "
         "shards (supported: sum, count, min, max, zimsum, mimmin, "
-        "mimmax, avg, none)")
+        "mimmax, avg, none, median, p50..p999)")
 
 
 def group_key(result: dict, gb_keys: list[str]) -> tuple:
@@ -332,7 +363,8 @@ class StreamMerger:
     accumulators, and ``avg``'s sum+count twins must land together."""
 
     def __init__(self, subs, plans: list[str],
-                 slots: list[tuple[int, int | None]]):
+                 slots: list[tuple[int, int | None]],
+                 sketch_alpha: float | None = None):
         self.subs = list(subs)
         self.plans = plans
         self.slots = slots
@@ -344,6 +376,17 @@ class StreamMerger:
         self._folded: dict[int, dict[tuple, MergedGroup]] = {}
         self._combine: dict[int, Callable[[float, float], float]] = {}
         self._gbk: dict[int, list[str]] = {}
+        # sketch plans: group identity (tags fold) lives in
+        # _sk_groups, the per-(group, bucket) quantile state in
+        # _sk_cells. "sketch_agg" subs additionally record the
+        # quantile their aggregator names (_sk_q) — their legs carry
+        # plain per-series dps that the router folds itself, with
+        # sketch_alpha as the relative-error bound (router config;
+        # "sketch" legs carry sketches built at the SHARD's alpha).
+        self._sk_groups: dict[int, dict[tuple, MergedGroup]] = {}
+        self._sk_cells: dict[int, dict[tuple, dict]] = {}
+        self._sk_q: dict[int, float] = {}
+        self._sk_alpha = sketch_alpha
         for sub, plan, (p_idx, s_idx) in zip(self.subs, plans, slots):
             gbk = gb_tag_keys(sub)
             if plan == "concat":
@@ -354,6 +397,14 @@ class StreamMerger:
                     self._folded[idx] = {}
                     self._combine[idx] = _add
                     self._gbk[idx] = gbk
+            elif plan in ("sketch", "sketch_agg"):
+                self._sk_groups[p_idx] = {}
+                self._sk_cells[p_idx] = {}
+                self._gbk[p_idx] = gbk
+                if plan == "sketch_agg":
+                    q = sketch_agg_quantile(
+                        (sub.aggregator or "").lower())
+                    self._sk_q[p_idx] = q if q is not None else 50.0
             else:
                 self._folded[p_idx] = {}
                 self._combine[p_idx] = \
@@ -366,6 +417,9 @@ class StreamMerger:
         self.legs += 1
         for r in rows:
             idx = (r.get("query") or {}).get("index")
+            if idx in self._sk_cells:
+                self._fold_sketch_row(idx, r)
+                continue
             folded = self._folded.get(idx)
             if folded is not None:
                 key = group_key(r, self._gbk[idx])
@@ -384,6 +438,80 @@ class StreamMerger:
             # else: a row naming no known sub index — dropped, exactly
             # as the batch path's _sub_results filter dropped it
 
+    def _fold_sketch_row(self, idx: int, r: dict) -> None:
+        """One sketch-plan partial row. ``"sketch"`` rows carry
+        ``sketchDps`` ([[bucket_ts, b64 sketch], ...]) — merge each
+        bucket's sketch into the group's accumulator (canonical state
+        makes the merge order-independent). ``"sketch_agg"`` rows are
+        one whole series' downsampled values (``none`` clone) — fold
+        each value into the (group, bucket) sketch; NaN is the
+        fill-policy "no data here" emission and is skipped, matching
+        the single-node percentile reduction's missing-value mask."""
+        from opentsdb_tpu.sketch.ddsketch import (DDSketch,
+                                                  SketchError)
+        key = group_key(r, self._gbk[idx])
+        groups = self._sk_groups[idx]
+        g = groups.get(key)
+        if g is None:
+            groups[key] = MergedGroup(r)
+        else:
+            g.fold_tags(r)
+        cells = self._sk_cells[idx].setdefault(key, {})
+        if idx in self._sk_q:
+            alpha = self._sk_alpha
+            for ts, val in (r.get("dps") or ()):
+                v = float(val)
+                if math.isnan(v):
+                    continue
+                sk = cells.get(ts)
+                if sk is None:
+                    sk = cells[ts] = DDSketch(alpha) \
+                        if alpha is not None else DDSketch()
+                sk.add(v)
+            return
+        for ts, blob in (r.get("sketchDps") or ()):
+            try:
+                sk = DDSketch.from_b64(blob) if isinstance(blob, str) \
+                    else DDSketch.from_bytes(blob)
+            except (SketchError, ValueError):
+                continue  # undecodable partial: serve the rest
+            cur = cells.get(int(ts))
+            if cur is None:
+                cells[int(ts)] = sk
+            else:
+                try:
+                    cur.merge(sk)
+                except SketchError:
+                    pass  # alpha mismatch across shards: config skew
+
+    def _sketch_results(self, sub, plan: str, p_idx: int) -> list:
+        """Extract quantiles from the folded sketch state. "sketch"
+        emits the single-node percentile row shape (one row per
+        (group, q), metric suffixed ``_pct_{q}``); "sketch_agg" emits
+        one row per group under the base metric, its aggregator's
+        quantile per bucket. Bucket timestamps stay in ms — the
+        serializer applies the client's second-vs-ms convention, the
+        same way every other merged plan's rows are emitted."""
+        out = []
+        for key, g in self._sk_groups[p_idx].items():
+            cells = self._sk_cells[p_idx].get(key) or {}
+            slots = sorted((t, sk) for t, sk in cells.items()
+                           if sk.count)
+            if not slots:
+                continue
+            base = g.metric
+            if plan == "sketch_agg":
+                g.dps = {t: float(sk.quantile(self._sk_q[p_idx]))
+                         for t, sk in slots}
+                out.append(g.to_query_result(sub.index))
+                continue
+            for q in (sub.percentiles or ()):
+                g.metric = f"{base}_pct_{q:g}"
+                g.dps = {t: float(sk.quantile(q)) for t, sk in slots}
+                out.append(g.to_query_result(sub.index))
+            g.metric = base
+        return out
+
     def results(self) -> list:
         """Finish every sub's merge, in sub order."""
         out: list = []
@@ -395,6 +523,8 @@ class StreamMerger:
             elif plan == "avg":
                 out.extend(_avg_results(self._folded[p_idx],
                                         self._folded[s_idx], sub))
+            elif plan in ("sketch", "sketch_agg"):
+                out.extend(self._sketch_results(sub, plan, p_idx))
             else:
                 out.extend(g.to_query_result(sub.index)
                            for g in self._folded[p_idx].values())
@@ -403,4 +533,4 @@ class StreamMerger:
 
 __all__ = ["decompose_plan", "gb_tag_keys", "group_key",
            "merge_partials", "merge_sub", "MergedGroup",
-           "StreamMerger"]
+           "sketch_agg_quantile", "StreamMerger"]

@@ -54,6 +54,8 @@ from opentsdb_tpu.query.model import BadRequestError
 from opentsdb_tpu.utils.faults import (CircuitBreaker, DegradedError,
                                        RetryPolicy, call_with_retries)
 
+import numpy as np
+
 LOG = logging.getLogger("cluster.router")
 
 
@@ -656,43 +658,124 @@ class ClusterRouter:
         owner. Returns (shard -> points, local error entries for
         unshardable dps, valid dps in input order) — at RF > 1 (or
         during a reshard window) the same dp object appears in
-        several shards' batches."""
+        several shards' batches.
+
+        The per-point validation mirrors the peer's write path BEFORE
+        acking: a bad point bound for a dead shard would be acked into
+        the spool now and rejected at replay — the same body a
+        HEALTHY shard 400s, so ack semantics would depend on peer
+        liveness. Same helpers the shard's write path calls, so the
+        accept sets cannot drift. Checks keep the scalar loop's
+        precedence per point (timestamp, then metric/tags, then
+        value), but the timestamp range check runs as ONE vectorized
+        pass over the numeric common case, and metric/tag validation
+        plus ring ownership are memoized per series within the call —
+        a bulk put of many points on few series hashes the ring once
+        per series, not once per point."""
+        n = len(points)
+        # index -> error entry; None = accepted (or still undecided).
+        # Assembling errors from this at the end preserves the scalar
+        # loop's input-order interleaving of structural and
+        # validation failures.
+        entries: list[dict | None] = [None] * n
         batches: dict[str, list[dict]] = {}
-        errors: list[dict] = []
         valid: list[dict] = []
-        for dp in points:
+
+        # pass 1 — structural shape (pure python object dispatch) +
+        # timestamp extraction for the vector check
+        cand: list[tuple[int, dict, str, dict]] = []
+        ts_idx: list[int] = []
+        ts_orig: list[Any] = []
+        for i, dp in enumerate(points):
             if not isinstance(dp, dict):
-                errors.append({"datapoint": dp,
-                               "error": "not a datapoint object"})
+                entries[i] = {"datapoint": dp,
+                              "error": "not a datapoint object"}
                 continue
             metric = dp.get("metric")
             tags = dp.get("tags") or {}
             if not isinstance(metric, str) or not metric or \
                     not isinstance(tags, dict):
-                errors.append({"datapoint": dp,
-                               "error": "missing metric or tags"})
+                entries[i] = {"datapoint": dp,
+                              "error": "missing metric or tags"}
                 continue
-            # mirror the peer's per-point validation BEFORE acking: a
-            # bad point bound for a dead shard would be acked into
-            # the spool now and rejected at replay — the same body a
-            # HEALTHY shard 400s, so ack semantics would depend on
-            # peer liveness. Same helpers the shard's write path
-            # calls, so the accept sets cannot drift.
+            cand.append((i, dp, metric, tags))
+            ts = dp.get("timestamp")
+            if isinstance(ts, (int, float)):
+                ts_idx.append(i)
+                ts_orig.append(ts)
+
+        # vectorized timestamp verdicts for numeric timestamps.
+        # int(ts) truncates toward zero — np.trunc matches. Anything
+        # past 2**47 (or non-finite) needs _check_timestamp's exact
+        # bit test and falls back to the scalar path; below that the
+        # range check is just 0 < ts <= 2**47.
+        ts_ok: set[int] = set()
+        ts_err: dict[int, str] = {}
+        if ts_idx:
+            t = np.trunc(np.asarray(ts_orig, dtype=np.float64))
+            hi = float(1 << 47)
+            ok = (t > 0.0) & (t <= hi)
+            bad = np.isfinite(t) & (t <= 0.0)
+            for j in np.nonzero(ok)[0]:
+                ts_ok.add(ts_idx[j])
+            for j in np.nonzero(bad)[0]:
+                # format from the ORIGINAL value: the float64 trunc
+                # of a huge int is approximate, int() is not
+                ts_err[ts_idx[j]] = \
+                    f"invalid timestamp {int(ts_orig[j])}"
+
+        # pass 2 — per-point verdicts in input order, series-memoized
+        series_memo: dict[Any, tuple[str, Any]] = {}
+        for i, dp, metric, tags in cand:
+            if i in ts_err:
+                entries[i] = {"datapoint": dp, "error": ts_err[i]}
+                continue
+            if i not in ts_ok:
+                # non-numeric, non-finite or >2**47: exact scalar
+                # check (missing key raises the same KeyError the
+                # scalar loop reported)
+                try:
+                    self.tsdb._check_timestamp(int(dp["timestamp"]))
+                except (KeyError, TypeError, ValueError) as exc:
+                    entries[i] = {"datapoint": dp, "error": str(exc)}
+                    continue
             try:
-                self.tsdb._check_timestamp(int(dp["timestamp"]))
-                check_metric_and_tags(metric, tags)
-                value = dp.get("value")
-                if isinstance(value, str):
+                # insertion-ordered items: validate_string reports
+                # the FIRST offending tag, so two dps with the same
+                # tag set in different orders stay distinct entries
+                skey = (metric, tuple(tags.items()))
+                cached = series_memo.get(skey)
+            except TypeError:  # unhashable tag value: no memo
+                skey = None
+                cached = None
+            if cached is None:
+                try:
+                    check_metric_and_tags(metric, tags)
+                except (KeyError, TypeError, ValueError) as exc:
+                    cached = ("err", str(exc))
+                else:
+                    cached = ("ok", self.write_owners(metric, tags))
+                if skey is not None:
+                    series_memo[skey] = cached
+            if cached[0] == "err":
+                entries[i] = {"datapoint": dp, "error": cached[1]}
+                continue
+            value = dp.get("value")
+            if isinstance(value, str):
+                try:
                     parse_put_value(value)
-                elif value is None or isinstance(value, bool) or \
-                        not isinstance(value, (int, float)):
-                    raise ValueError(f"invalid value: {value!r}")
-            except (KeyError, TypeError, ValueError) as exc:
-                errors.append({"datapoint": dp, "error": str(exc)})
+                except (KeyError, TypeError, ValueError) as exc:
+                    entries[i] = {"datapoint": dp, "error": str(exc)}
+                    continue
+            elif value is None or isinstance(value, bool) or \
+                    not isinstance(value, (int, float)):
+                entries[i] = {"datapoint": dp,
+                              "error": f"invalid value: {value!r}"}
                 continue
             valid.append(dp)
-            for shard in self.write_owners(metric, tags):
+            for shard in cached[1]:
                 batches.setdefault(shard, []).append(dp)
+        errors = [e for e in entries if e is not None]
         return batches, errors, valid
 
     def forward_writes(self, points: list[dict]
@@ -1379,6 +1462,12 @@ class ClusterRouter:
                 s2 = dict(sj, aggregator="count")
                 slots.append((len(peer_subs), len(peer_subs) + 1))
                 peer_subs.extend([s1, s2])
+            elif plan == "sketch_agg":
+                # percentile aggregator: each shard emits its raw
+                # per-series downsampled values; the router folds
+                # them into per-(group, bucket) sketches
+                slots.append((len(peer_subs), None))
+                peer_subs.append(dict(sj, aggregator="none"))
             else:
                 slots.append((len(peer_subs), None))
                 peer_subs.append(sj)
@@ -1395,6 +1484,12 @@ class ClusterRouter:
             "useCalendar": tsq.use_calendar,
             "delete": tsq.delete,
         }
+        if any(p == "sketch" for p in plans):
+            # percentile subs: shards answer with serialized
+            # per-bucket sketch partials instead of extracted
+            # quantiles (quantiles of partials don't merge; sketches
+            # do, exactly)
+            peer_obj["sketchPartials"] = True
         # per-peer scatter plan through the known/unknown memo: subs
         # whose metric a peer has already 400'd "no such name" for
         # are pre-filtered out of that peer's request (their cached
@@ -1454,7 +1549,10 @@ class ClusterRouter:
         # frame), instead of gathering all partials and merging last.
         # Fold order still equals the old partials-list order, so the
         # merged result is bit-identical to the batch path.
-        merger = merge_mod.StreamMerger(tsq.queries, plans, slots)
+        merger = merge_mod.StreamMerger(
+            tsq.queries, plans, slots,
+            sketch_alpha=self.config.get_float(
+                "tsd.sketch.alpha", 0.01))
         failed_peers: set[str] = set()
         degraded_set: set[str] = set()
 

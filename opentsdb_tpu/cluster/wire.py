@@ -307,6 +307,13 @@ def _encode_qres_row(r, tsq) -> bytes:
     if tsq.global_annotations and r.global_annotations:
         meta["globalAnnotations"] = [a.to_json()
                                      for a in r.global_annotations]
+    if getattr(r, "sketches", None):
+        # sketch partials travel in the meta (b64, same shape the
+        # HTTP serializer emits) — decode_qres restores them wholesale
+        import base64
+        meta["sketchDps"] = [
+            [int(t), base64.b64encode(b).decode("ascii")]
+            for t, b in r.sketches]
     arrs = getattr(r, "dps_arrays", None)
     if arrs is not None:
         ts_arr = np.ascontiguousarray(arrs[0], dtype="<i8")

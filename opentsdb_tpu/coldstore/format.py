@@ -13,6 +13,16 @@ build). Layout::
     ts      int32 [rows]  (or int64 when header["scale"] == 0)
     <stat>  float64 [rows]   for each stat in header["stats"]
                              (sum / count / min / max)
+    sk_off  int64 [rows+1]   (format 2 only, when header["sketch"])
+    sk_blob bytes            concatenated per-row DDSketch blobs;
+                             row i spans sk_off[i]..sk_off[i+1]
+                             (equal offsets = no sketch for the row)
+
+Format 2 adds the OPTIONAL quantile-sketch column (the fifth stat):
+a segment without sketches still writes format 1, so files this build
+produces stay readable by format-1 readers unless they actually carry
+sketches; format-2 files without corruption are read by this build
+whether or not the sketch section is present.
 
 The header json carries the series table (sorted tag NAME pairs with
 row offsets — names, not UID ids, so a segment outlives any UID
@@ -38,7 +48,9 @@ import zlib
 import numpy as np
 
 MAGIC = b"TSDBCOLD"
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+# newest version a reader of this build accepts
+SUPPORTED_VERSIONS = (1, 2)
 STATS = ("sum", "count", "min", "max")
 
 _PREAMBLE = len(MAGIC) + 4 + 4 + 4
@@ -66,11 +78,15 @@ def pack_timestamps(ts_ms: np.ndarray) -> tuple[np.ndarray, int, int]:
 
 
 def write_segment(directory: str, name: str, header: dict,
-                  ts_col: np.ndarray, cols: dict[str, np.ndarray]
+                  ts_col: np.ndarray, cols: dict[str, np.ndarray],
+                  sketch: tuple[np.ndarray, bytes] | None = None
                   ) -> dict:
     """Write one segment durably (tmpfile + fsync + atomic rename).
     ``header`` is completed in place with format/crc fields; returns
-    the manifest entry for the segment."""
+    the manifest entry for the segment. ``sketch`` is the optional
+    fifth column as ``(offsets int64[rows+1], blob bytes)`` — its
+    presence bumps the segment to format 2 (a sketch-free segment
+    stays format 1, readable by older builds)."""
     os.makedirs(directory, exist_ok=True)
     n = len(ts_col)
     data_parts = [np.ascontiguousarray(ts_col).tobytes()]
@@ -80,15 +96,30 @@ def write_segment(directory: str, name: str, header: dict,
             raise SegmentError(f"stat column {stat!r} length {len(col)}"
                                f" != {n} rows")
         data_parts.append(col.tobytes())
-    data = b"".join(data_parts)
     header = dict(header)
-    header["format"] = FORMAT_VERSION
+    version = 1
+    if sketch is not None:
+        sk_off, sk_blob = sketch
+        sk_off = np.ascontiguousarray(sk_off, dtype=np.int64)
+        if len(sk_off) != n + 1:
+            raise SegmentError(
+                f"sketch offsets length {len(sk_off)} != {n + 1}")
+        if int(sk_off[-1]) != len(sk_blob):
+            raise SegmentError(
+                f"sketch blob length {len(sk_blob)} != "
+                f"offset end {int(sk_off[-1])}")
+        data_parts.append(sk_off.tobytes())
+        data_parts.append(sk_blob)
+        header["sketch"] = {"blob_len": len(sk_blob)}
+        version = 2
+    data = b"".join(data_parts)
+    header["format"] = version
     header["rows"] = n
     header["data_crc"] = zlib.crc32(data) & 0xFFFFFFFF
     hdr_json = json.dumps(header, sort_keys=True).encode()
     hdr_crc = zlib.crc32(hdr_json) & 0xFFFFFFFF
     blob = (MAGIC
-            + FORMAT_VERSION.to_bytes(4, "little")
+            + version.to_bytes(4, "little")
             + len(hdr_json).to_bytes(4, "little")
             + hdr_crc.to_bytes(4, "little")
             + hdr_json + data)
@@ -104,10 +135,14 @@ def write_segment(directory: str, name: str, header: dict,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    return {"file": name, "interval": header["interval"],
-            "start_ms": header["start_ms"], "end_ms": header["end_ms"],
-            "rows": n, "bytes": len(blob),
-            "data_crc": header["data_crc"], "header_crc": hdr_crc}
+    entry = {"file": name, "interval": header["interval"],
+             "start_ms": header["start_ms"],
+             "end_ms": header["end_ms"],
+             "rows": n, "bytes": len(blob),
+             "data_crc": header["data_crc"], "header_crc": hdr_crc}
+    if sketch is not None:
+        entry["sketch"] = True
+    return entry
 
 
 def read_header(path: str) -> tuple[dict, int]:
@@ -119,7 +154,7 @@ def read_header(path: str) -> tuple[dict, int]:
             if len(pre) < _PREAMBLE or pre[:len(MAGIC)] != MAGIC:
                 raise SegmentError(f"{path}: bad magic")
             version = int.from_bytes(pre[8:12], "little")
-            if version != FORMAT_VERSION:
+            if version not in SUPPORTED_VERSIONS:
                 raise SegmentError(f"{path}: unsupported segment "
                                    f"format {version}")
             hdr_len = int.from_bytes(pre[12:16], "little")
@@ -142,16 +177,20 @@ class Segment:
     opened read-only. Columns are ``np.memmap`` views — touching a row
     faults in that page only."""
 
-    __slots__ = ("path", "header", "ts", "cols", "series")
+    __slots__ = ("path", "header", "ts", "cols", "series",
+                 "sk_off", "sk_blob")
 
     def __init__(self, path: str):
         header, off = read_header(path)
         n = int(header["rows"])
         ts_dtype = np.int64 if header.get("scale", 1) == 0 else np.int32
+        sk_meta = header.get("sketch")
         try:
             size = os.path.getsize(path)
             ts_bytes = n * np.dtype(ts_dtype).itemsize
             need = off + ts_bytes + 8 * n * len(header["stats"])
+            if sk_meta is not None:
+                need += 8 * (n + 1) + int(sk_meta["blob_len"])
             if size < need:
                 raise SegmentError(
                     f"{path}: truncated ({size} < {need} bytes)")
@@ -170,6 +209,18 @@ class Segment:
                 else:
                     self.cols[stat] = np.empty(0, dtype=np.float64)
                 pos += 8 * n
+            self.sk_off = None
+            self.sk_blob = None
+            if sk_meta is not None:
+                blob_len = int(sk_meta["blob_len"])
+                self.sk_off = np.memmap(path, dtype=np.int64,
+                                        mode="r", offset=pos,
+                                        shape=(n + 1,))
+                pos += 8 * (n + 1)
+                self.sk_blob = np.memmap(
+                    path, dtype=np.uint8, mode="r", offset=pos,
+                    shape=(blob_len,)) if blob_len else \
+                    np.empty(0, dtype=np.uint8)
         except OSError as exc:
             raise SegmentError(f"{path}: {exc}") from exc
         self.path = path
@@ -178,6 +229,21 @@ class Segment:
         self.series = [(tuple(tuple(p) for p in e["tags"]),
                         int(e["off"]), int(e["cnt"]))
                        for e in header["series"]]
+
+    @property
+    def has_sketches(self) -> bool:
+        return self.sk_off is not None
+
+    def sketch_blob(self, row: int) -> bytes | None:
+        """One row's serialized sketch (None when the segment or the
+        row has no sketch column — format-1 segments, or rows spilled
+        before their cells were ever folded)."""
+        if self.sk_off is None:
+            return None
+        lo, hi = int(self.sk_off[row]), int(self.sk_off[row + 1])
+        if hi <= lo:
+            return None
+        return bytes(self.sk_blob[lo:hi])
 
     def ts64(self, lo: int, hi: int) -> np.ndarray:
         """Row slice materialized as int64 ms."""

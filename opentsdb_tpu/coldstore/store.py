@@ -377,6 +377,65 @@ class ColdStore:
                   raw_store) -> ColdStatView:
         return ColdStatView(self, metric, interval, stat, raw_store)
 
+    def sketch_rows(self, metric: str, interval: str | None,
+                    start_ms: int, end_ms: int
+                    ) -> list[tuple[tuple, int, bytes]]:
+        """The fifth column's cold read: ``(tags_names, cell_ts,
+        blob)`` rows of every format-2 segment overlapping
+        [start_ms, end_ms]. ``interval=None`` reads every interval
+        that has sketch-bearing segments (the query path doesn't know
+        which tier carried the cells at fold time). Runs under
+        ``coldstore.read`` (same degrade contract as the stat views —
+        the caller converts a raise into a degraded serve)."""
+        if self.faults is not None:
+            self.faults.check("coldstore.read")
+        if interval is None:
+            with self._lock:
+                rec = self._metrics.get(metric)
+                intervals = sorted({e["interval"]
+                                    for e in rec["segments"]
+                                    if e.get("sketch")}) if rec else []
+            out: list[tuple[tuple, int, bytes]] = []
+            for iv in intervals:
+                out.extend(self._sketch_rows_one(metric, iv, start_ms,
+                                                 end_ms))
+            return out
+        return self._sketch_rows_one(metric, interval, start_ms,
+                                     end_ms)
+
+    def _sketch_rows_one(self, metric: str, interval: str,
+                         start_ms: int, end_ms: int
+                         ) -> list[tuple[tuple, int, bytes]]:
+        out: list[tuple[tuple, int, bytes]] = []
+        for h in self._handles(metric, interval):
+            if h.entry["start_ms"] > end_ms or \
+                    h.entry["end_ms"] < start_ms:
+                continue
+            seg = h.open(self.directory)
+            if not seg.has_sketches:
+                continue
+            for tags, off, cnt in seg.series:
+                lo, hi = seg.row_bounds(off, cnt, start_ms, end_ms)
+                if hi <= lo:
+                    continue
+                ts = seg.ts64(lo, hi)
+                for j in range(hi - lo):
+                    blob = seg.sketch_blob(lo + j)
+                    if blob is not None:
+                        out.append((tags, int(ts[j]), blob))
+        return out
+
+    def has_sketch_segments(self, metric: str, interval: str) -> bool:
+        """Whether any committed segment of this (metric, tier)
+        carries the sketch column (manifest-entry check, no file
+        open)."""
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return False
+            return any(e["interval"] == interval and e.get("sketch")
+                       for e in rec["segments"])
+
     # ------------------------------------------------------------------
     # spill (called by the lifecycle sweep, under coldstore.write)
     # ------------------------------------------------------------------
@@ -384,10 +443,15 @@ class ColdStore:
     def write_segment(self, metric: str, interval: str,
                       series_entries: Sequence[dict],
                       ts_ms: np.ndarray,
-                      cols: dict[str, np.ndarray]) -> dict:
+                      cols: dict[str, np.ndarray],
+                      sketch: tuple[np.ndarray, bytes] | None = None
+                      ) -> dict:
         """Write one durable segment file (NOT yet visible: the caller
         commits it to the manifest via :meth:`commit_spill` once every
-        tier's segment of the sweep is on disk)."""
+        tier's segment of the sweep is on disk). ``sketch`` is the
+        optional fifth column — ``(offsets int64[rows+1], blob)`` of
+        per-row serialized quantile sketches; its presence makes the
+        file a format-2 segment."""
         if self.faults is not None:
             self.faults.check("coldstore.write")
         ts_col, base, scale = fmt.pack_timestamps(ts_ms)
@@ -403,7 +467,7 @@ class ColdStore:
             "series": list(series_entries),
         }
         return fmt.write_segment(self.directory, name, header, ts_col,
-                                 cols)
+                                 cols, sketch=sketch)
 
     def commit_spill(self, metric: str, boundary_ms: int,
                      entries: Sequence[dict]) -> None:
@@ -524,6 +588,20 @@ class ColdStore:
                 series_entries.append({
                     "tags": [list(p) for p in tags],
                     "off": int(pos[off]), "cnt": cnt_new})
+        # the sketch column survives rewrites: kept rows keep their
+        # blobs (re-packed contiguously), dropped rows drop theirs
+        sketch = None
+        if seg.has_sketches:
+            offs = np.asarray(seg.sk_off)
+            lens = (offs[1:] - offs[:-1])[keep]
+            new_off = np.zeros(len(lens) + 1, dtype=np.int64)
+            np.cumsum(lens, out=new_off[1:])
+            blob_parts = []
+            for row in np.nonzero(keep)[0].tolist():
+                lo2, hi2 = int(offs[row]), int(offs[row + 1])
+                if hi2 > lo2:
+                    blob_parts.append(bytes(seg.sk_blob[lo2:hi2]))
+            sketch = (new_off, b"".join(blob_parts))
         ts_col, base, scale = fmt.pack_timestamps(ts64)
         header = {
             "metric": entry.get("metric", seg.header["metric"]),
@@ -543,7 +621,7 @@ class ColdStore:
         name = (f"{base}-rw{self.points_deleted + removed}"
                 f"{SEGMENT_SUFFIX}")
         new_entry = fmt.write_segment(self.directory, name, header,
-                                      ts_col, cols)
+                                      ts_col, cols, sketch=sketch)
         return removed, new_entry
 
     @staticmethod

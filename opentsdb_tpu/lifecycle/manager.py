@@ -138,6 +138,25 @@ class LifecycleManager:
             self.coldstore = ColdStore(
                 cold_dir, faults=getattr(tsdb, "faults", None),
                 uids=tsdb.uids, read_breaker=read_breaker)
+        # the fifth stat column: per-cell quantile sketches of demoted
+        # raw data (opentsdb_tpu/sketch/). Demotion folds the raw
+        # points it purges into cells here; the spill moves cells into
+        # the cold segments' sketch blob column. tsd.sketch.enable
+        # opts out — demotion then loses percentiles past the
+        # boundary, exactly the pre-sketch behavior.
+        self.sketches = None
+        if cfg.get_bool("tsd.sketch.enable", True):
+            from opentsdb_tpu.sketch.store import SketchTierStore
+            sk_path = ""
+            if getattr(tsdb, "data_dir", ""):
+                import os
+                sk_path = os.path.join(tsdb.data_dir, "sketches.bin")
+            self.sketches = SketchTierStore(
+                sk_path,
+                alpha=cfg.get_float("tsd.sketch.alpha", 0.01),
+                max_buckets=cfg.get_int("tsd.sketch.max_buckets",
+                                        4096))
+            self.sketches.load()
         # one sweep at a time (admin POST vs the interval thread)
         self._sweep_lock = threading.Lock()
         self._lock = threading.Lock()
@@ -171,6 +190,7 @@ class LifecycleManager:
         self.series_released = 0
         self.points_spilled = 0
         self.histogram_points_purged = 0
+        self.histogram_points_spilled = 0
         self.last_sweep_duration_ms = 0.0
         self.last_sweep_time = 0.0
         self.last_error = ""
@@ -308,6 +328,7 @@ class LifecycleManager:
             "purged": 0, "demoted": 0, "tierPointsWritten": 0,
             "bytesReclaimed": 0, "seriesReleased": 0, "metrics": 0,
             "spilled": 0, "histogramPurged": 0,
+            "histogramSpilled": 0,
         }
         # every sweep is a background trace root (the coldstore spill
         # records its own child span), so maintenance time shows up
@@ -400,11 +421,14 @@ class LifecycleManager:
             if pol.demote_after_ms and t.rollup_store is not None:
                 changed |= self._demote(mid, metric, sids, pol,
                                         now_ms, report)
-            if pol.spill_after_ms and t.rollup_store is not None:
+            if pol.spill_after_ms:
                 from opentsdb_tpu.obs.trace import trace_span
                 with trace_span("coldstore.spill", metric=metric):
-                    changed |= self._spill(mid, metric, pol, now_ms,
-                                           report)
+                    if t.rollup_store is not None:
+                        changed |= self._spill(mid, metric, pol,
+                                               now_ms, report)
+                    changed |= self._spill_histograms(
+                        mid, metric, pol, now_ms, report)
             # pack only COLD buffers (newest point behind the
             # metric's lifecycle horizon): packing a live tail just
             # buys an unpack copy on the next append
@@ -454,6 +478,12 @@ class LifecycleManager:
         if hist_purged:
             self.histogram_points_purged += hist_purged
             report["histogramPurged"] += hist_purged
+        # sketch cells share the metric's TTL (cell-window rule, like
+        # the tier purge below); a dropped cell re-persists at once so
+        # a restart cannot resurrect expired percentile history
+        if self.sketches is not None and \
+                self.sketches.delete_before(metric, cutoff):
+            self.sketches.save()
         # cold segments are retention-managed too: whole-expired
         # segments drop cheaply (end_ms < cutoff matches the inclusive
         # raw purge of [1, cutoff-1]), then still-live segments
@@ -538,6 +568,17 @@ class LifecycleManager:
         wrote = sum(written.values())
         self.tier_points_written += wrote
         report["tierPointsWritten"] += wrote
+        # fifth stat: fold the SAME raw window into per-cell quantile
+        # sketches (cells at the finest demote tier) BEFORE the
+        # boundary publishes and the raw purge runs — a raise here
+        # aborts the demotion with raw intact, same as a rollup
+        # failure. The sidecar save lands before the purge too
+        # (durable-first, like the spill's manifest ordering).
+        if self.sketches is not None:
+            from opentsdb_tpu.obs.trace import trace_span
+            with trace_span("sketch.fold", metric=metric):
+                self._fold_sketches(mid, metric, old_sids, tiers,
+                                    start_ms, boundary, faults)
         # tiers hold the history now: move the boundary BEFORE the raw
         # purge so stitched reads clip raw to the tail (no double
         # count while the stale raw points still exist), THEN purge.
@@ -556,6 +597,44 @@ class LifecycleManager:
                  dropped, metric,
                  "/".join(iv.interval for iv in tiers), boundary)
         return True
+
+    def _fold_sketches(self, mid: int, metric: str,
+                       old_sids: np.ndarray, tiers, start_ms: int,
+                       boundary: int, faults) -> None:
+        """Fold the demoting raw window into the sketch tier: one
+        vectorized pass over the materialized batch, cells at the
+        finest demote-tier interval keyed by the series' tag NAMES
+        (restart-stable, and the identity the cold segment's series
+        table uses)."""
+        t = self.tsdb
+        batch = t.store.materialize(old_sids, start_ms, boundary - 1)
+        if not batch.num_points:
+            return
+        from opentsdb_tpu.ops import sketch_fold
+        fine_ms = min(iv.interval_ms for iv in tiers)
+        folded = sketch_fold.fold_series_cells(
+            batch.series_idx, batch.ts_ms, batch.values, fine_ms,
+            self.sketches.alpha, self.sketches.max_buckets,
+            faults=faults)
+        uids = t.uids
+        names_of: dict[int, tuple | None] = {}
+        items = []
+        for (si, cell_ts), sk in folded.items():
+            if si not in names_of:
+                rec = t.store.series(int(batch.series_ids[si]))
+                try:
+                    names_of[si] = tuple(sorted(
+                        (uids.tag_names.get_name(k),
+                         uids.tag_values.get_name(v))
+                        for k, v in rec.tags))
+                except LookupError:
+                    names_of[si] = None  # unresolvable: skip
+            names = names_of[si]
+            if names is not None:
+                items.append((names, cell_ts, sk))
+        if items:
+            self.sketches.merge_cells(metric, fine_ms, items)
+            self.sketches.save()
 
     def _spill(self, mid: int, metric: str, pol: LifecyclePolicy,
                now_ms: int, report: dict) -> bool:
@@ -608,6 +687,11 @@ class LifecycleManager:
             if data is None:
                 continue
             series_entries, ts_ms, cols = data
+            # the sketch column rides the tier whose grid matches the
+            # sketch cells (the finest demote tier at fold time) —
+            # rows without a folded cell get a zero-length blob
+            sketch = self._gather_sketch_column(metric, iv,
+                                                series_entries, ts_ms)
             try:
                 # runs under the coldstore.write fault site; a raise
                 # here aborts the spill with the RAM copies intact
@@ -615,7 +699,7 @@ class LifecycleManager:
                 # counted by the sweep's error handler
                 entry = cold.write_segment(metric, iv.interval,
                                            series_entries, ts_ms,
-                                           cols)
+                                           cols, sketch=sketch)
             except Exception:
                 cold.spill_errors += 1
                 raise
@@ -638,10 +722,169 @@ class LifecycleManager:
         # grown capacity until compacted — and releasing that RAM is
         # the whole point of the spill
         self._compact_tiers(mid, tiers, new_b, report)
+        # the segments (and their sketch column) are committed: the
+        # RAM sketch cells below the boundary are now disk duplicates
+        if self.sketches is not None:
+            if self.sketches.delete_before(metric, new_b,
+                                           spilled=True):
+                self.sketches.save()
         self.points_spilled += spilled_rows
         report["spilled"] += spilled_rows
         LOG.info("spilled %d tier points of %s to cold segments "
                  "(spill boundary %d)", spilled_rows, metric, new_b)
+        return True
+
+    def _gather_sketch_column(self, metric: str, iv,
+                              series_entries: list, ts_ms
+                              ) -> tuple | None:
+        """The spill payload's fifth column: per-row serialized
+        sketches aligned with the gathered tier rows, or None when
+        this tier's grid is not the sketch cell grid (coarser tiers
+        spill stat columns only) or no cells exist. Rows demoted
+        before sketches were enabled blob as zero-length (readers
+        treat those cells as percentile-less)."""
+        if self.sketches is None:
+            return None
+        if iv.interval_ms != self.sketches.cell_ms(metric):
+            return None
+        blobs: list[bytes] = []
+        have = 0
+        for e in series_entries:
+            names = tuple(tuple(p) for p in e["tags"])
+            lo = int(e["off"])
+            for ts in np.asarray(ts_ms[lo:lo + int(e["cnt"])]) \
+                    .tolist():
+                blob = self.sketches.blob_for(metric, names, int(ts))
+                blobs.append(blob or b"")
+                have += blob is not None
+        if not have:
+            return None
+        off = np.zeros(len(blobs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in blobs], out=off[1:])
+        return off, b"".join(blobs)
+
+    def _spill_histograms(self, mid: int, metric: str,
+                          pol: LifecyclePolicy, now_ms: int,
+                          report: dict) -> bool:
+        """Mechanism 4b: spill live histogram arena rows older than
+        the spill horizon into cold sketch segments (interval label
+        ``"histogram"``), then purge them from the arena. Each row's
+        bucket counts fold at bucket midpoints — the same convention
+        the arena engine's percentile extraction and the cluster
+        partials path use — so a cold percentile read answers within
+        alpha of what the live arena would have said. Crash ordering
+        matches the tier spill: segment durable, manifest + boundary
+        committed atomically, THEN the RAM rows purge."""
+        cold = self.coldstore
+        t = self.tsdb
+        if cold is None or self.sketches is None:
+            return False
+        with t._histogram_lock:
+            arena = t._histogram_arenas.get(mid)
+            snaps = [(s.bounds, *s.snapshot())
+                     for s in arena.groups.values()] if arena else []
+        if not snaps:
+            return False
+        prev = cold.spill_boundary(metric)
+        target = now_ms - pol.spill_after_ms
+        rs = t.rollup_store
+        if rs is not None:
+            # a mixed metric (tier history + arenas) shares ONE spill
+            # boundary: never advance it past the demote boundary, or
+            # stitched tier reads would clip un-spilled tier RAM
+            with rs._tiers_lock:
+                tier_stores = list(rs._tiers.values())
+            if any(len(st.series_ids_for_metric(mid))
+                   for st in tier_stores):
+                target = min(target, self.demote_boundary(mid))
+        if target <= prev:
+            return False
+        # first spill of this metric's arenas takes the WHOLE history
+        # below the boundary (tier-spill rule); afterwards rows below
+        # prev are crash-window disk duplicates the purge clears
+        lo = max(prev, 1) \
+            if cold.has_segments(metric, "histogram") else 1
+        cfg = t.config
+        alpha = cfg.get_float("tsd.sketch.alpha", 0.01)
+        max_buckets = cfg.get_int("tsd.sketch.max_buckets", 4096)
+        from opentsdb_tpu.sketch.ddsketch import DDSketch
+        uids = t.uids
+        store = t.histogram_store
+        names_of: dict[int, tuple | None] = {}
+        rows_of: dict[tuple, list] = {}
+        for bounds, ts_a, sid_a, rows in snaps:
+            b = np.asarray(bounds, dtype=np.float64)
+            mids = (b[:-1] + b[1:]) / 2.0
+            m = (ts_a >= lo) & (ts_a < target)
+            if not m.any():
+                continue
+            for ts, sid, counts in zip(ts_a[m].tolist(),
+                                       sid_a[m].tolist(),
+                                       np.asarray(rows)[m]):
+                if sid not in names_of:
+                    try:
+                        rec = store.series(int(sid))
+                        names_of[sid] = tuple(sorted(
+                            (uids.tag_names.get_name(k),
+                             uids.tag_values.get_name(v))
+                            for k, v in rec.tags))
+                    except LookupError:
+                        names_of[sid] = None
+                names = names_of[sid]
+                if names is None:
+                    continue  # unresolvable identity stays in RAM
+                counts = np.asarray(counts, dtype=np.float64)
+                total = float(counts.sum())
+                if total <= 0:
+                    continue
+                sk = DDSketch(alpha)
+                sk.add_weighted(mids, counts)
+                if max_buckets:
+                    sk.collapse(max_buckets)
+                nz = np.nonzero(counts)[0]
+                rows_of.setdefault(names, []).append(
+                    (int(ts), total, float((mids * counts).sum()),
+                     float(mids[nz[0]]), float(mids[nz[-1]]),
+                     sk.to_bytes()))
+        if not rows_of:
+            return False
+        series_entries: list[dict] = []
+        ts_parts: list[int] = []
+        cols: dict[str, list] = {s: [] for s in
+                                 ("sum", "count", "min", "max")}
+        blobs: list[bytes] = []
+        off = 0
+        for names in sorted(rows_of):
+            srows = sorted(rows_of[names])
+            series_entries.append({"tags": [list(p) for p in names],
+                                   "off": off, "cnt": len(srows)})
+            off += len(srows)
+            for ts, cnt, vsum, vmin, vmax, blob in srows:
+                ts_parts.append(ts)
+                cols["count"].append(cnt)
+                cols["sum"].append(vsum)
+                cols["min"].append(vmin)
+                cols["max"].append(vmax)
+                blobs.append(blob)
+        ts_ms = np.asarray(ts_parts, dtype=np.int64)
+        col_arr = {s: np.asarray(v, dtype=np.float64)
+                   for s, v in cols.items()}
+        sk_off = np.zeros(len(blobs) + 1, dtype=np.int64)
+        np.cumsum([len(bb) for bb in blobs], out=sk_off[1:])
+        try:
+            entry = cold.write_segment(
+                metric, "histogram", series_entries, ts_ms, col_arr,
+                sketch=(sk_off, b"".join(blobs)))
+        except Exception:
+            cold.spill_errors += 1
+            raise
+        cold.commit_spill(metric, target, [entry])
+        t.purge_histograms_before(mid, target)
+        self.histogram_points_spilled += len(ts_ms)
+        report["histogramSpilled"] += len(ts_ms)
+        LOG.info("spilled %d histogram rows of %s to a cold sketch "
+                 "segment (spill boundary %d)", len(ts_ms), metric,
+                 target)
         return True
 
     def _purge_spilled_ranges(self, mid: int, metric: str,
@@ -915,6 +1158,8 @@ class LifecycleManager:
         if self.coldstore is not None:
             doc["coldstore"] = self.coldstore.health_info()
             doc["spillBoundaries"] = self.coldstore.spill_boundaries()
+        if self.sketches is not None:
+            doc["sketches"] = self.sketches.describe()
         return doc
 
     def _counters(self) -> dict[str, Any]:
@@ -928,6 +1173,7 @@ class LifecycleManager:
             "seriesReleased": self.series_released,
             "pointsSpilled": self.points_spilled,
             "histogramPointsPurged": self.histogram_points_purged,
+            "histogramPointsSpilled": self.histogram_points_spilled,
             "lastSweepDurationMs": round(self.last_sweep_duration_ms,
                                          1),
             "lastSweepTime": int(self.last_sweep_time),
@@ -958,7 +1204,16 @@ class LifecycleManager:
                          self.points_spilled)
         collector.record("lifecycle.histogram_points.purged",
                          self.histogram_points_purged)
+        collector.record("lifecycle.histogram_points.spilled",
+                         self.histogram_points_spilled)
         collector.record("lifecycle.sweep.duration_ms",
                          self.last_sweep_duration_ms)
+        if self.sketches is not None:
+            collector.record("sketch.points.folded",
+                             self.sketches.points_folded)
+            collector.record("sketch.cells.folded",
+                             self.sketches.cells_folded)
+            collector.record("sketch.cells.spilled",
+                             self.sketches.cells_spilled)
         if self.coldstore is not None:
             self.coldstore.collect_stats(collector)

@@ -64,6 +64,77 @@ from opentsdb_tpu.core.store import (PaddedBatch, PointBatch,
 _TAIL_STATS = ("sum", "count", "min", "max")
 
 
+def guarded_sketch_rows(cold, metric: str, start_ms: int, end_ms: int
+                        ) -> tuple[list, bool]:
+    """Cold sketch-column read behind the same degradation guard as
+    :meth:`StitchedStore._cold`: an open read breaker or a failed read
+    degrades to ``([], False)`` — the caller serves the remaining
+    zones (partial history, 200) and the epoch bump in the notes makes
+    the partial result stale for later cache lookups."""
+    breaker = getattr(cold, "read_breaker", None)
+    if breaker is not None and not breaker.allow():
+        cold.note_degraded_serve()
+        return [], False
+    try:
+        rows = cold.sketch_rows(metric, None, start_ms, end_ms)
+    except Exception as exc:  # noqa: BLE001 - degrade, never 500
+        if breaker is not None:
+            breaker.record_failure()
+        cold.note_read_error(exc)
+        return [], False
+    if breaker is not None:
+        breaker.record_success()
+    return rows, True
+
+
+def sketch_zone_read(tsdb, metric: str, metric_id: int,
+                     start_ms: int, end_ms: int):
+    """The sketch twin of the stitched three-way read: per-series
+    quantile sketches split at the spill and demotion boundaries.
+
+    Returns ``(items, raw_rng, cold_ok)``:
+
+    - ``items``: ``(tags_names_tuple, cell_ts, DDSketch)`` rows from
+      the cold segments' sketch column (``cell_ts < spill_b``) and the
+      in-RAM sketch tier (``spill_b <= cell_ts < demote_b``). The zone
+      split is by cell timestamp, so a RAM cell whose spilled disk
+      duplicate still lingers (crash reconciliation) is counted once.
+    - ``raw_rng``: the ``[demote_b, end]`` raw-tail window the caller
+      folds itself (None when the window ends before the boundary).
+    - ``cold_ok``: False when the cold zone degraded (breaker open,
+      read error, undecodable blob) — partial history, never a 500.
+    """
+    from opentsdb_tpu.sketch.ddsketch import DDSketch, SketchError
+    lc = tsdb.lifecycle
+    sketches = getattr(lc, "sketches", None) if lc is not None \
+        else None
+    demote_b = lc.demote_boundary(metric_id) if lc is not None else 0
+    cold = getattr(lc, "coldstore", None) if lc is not None else None
+    spill_b = 0
+    if cold is not None and sketches is not None and demote_b:
+        # same clamp as StitchedStore: cold never serves past the
+        # demotion boundary
+        spill_b = min(cold.spill_boundary(metric), demote_b)
+    items: list[tuple[tuple, int, DDSketch]] = []
+    cold_ok = True
+    if spill_b and start_ms < spill_b:
+        rows, cold_ok = guarded_sketch_rows(
+            cold, metric, start_ms, min(end_ms, spill_b - 1))
+        for tags, cts, blob in rows:
+            try:
+                items.append((tags, cts, DDSketch.from_bytes(blob)))
+            except (SketchError, ValueError):
+                cold_ok = False  # corrupt blob: serve the rest
+    if sketches is not None and demote_b:
+        lo = max(start_ms, spill_b)
+        hi = min(end_ms, demote_b - 1)
+        if lo <= hi:
+            items.extend(sketches.cells(metric, lo, hi))
+    raw_lo = max(start_ms, demote_b)
+    raw_rng = (raw_lo, end_ms) if raw_lo <= end_ms else None
+    return items, raw_rng, cold_ok
+
+
 class StitchedStore:
     """(see module docstring)"""
 

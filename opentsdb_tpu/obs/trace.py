@@ -87,6 +87,8 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "query.admission",       # admission + worker-queue wait
     "query.streaming_lookup",  # CQ registry try_serve
     "query.plan",            # store/tier selection, filters, groups
+    "sketch.fold",           # lifecycle/manager.py demote-time
+                             # quantile-sketch fold (fifth stat column)
     "query.execute",         # scan + device pipeline (parent stage)
     "query.assemble",        # result assembly incl. pixel reduce
     "query.serialize",       # response body serialization

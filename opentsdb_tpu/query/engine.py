@@ -60,7 +60,7 @@ class QueryResult:
 
     __slots__ = ("metric", "tags", "aggregated_tags", "tsuids",
                  "annotations", "global_annotations",
-                 "sub_query_index", "dps_arrays", "_dps")
+                 "sub_query_index", "dps_arrays", "_dps", "sketches")
 
     def __init__(self, metric: str, tags: dict, aggregated_tags: list,
                  dps: list | None = None, tsuids: list | None = None,
@@ -78,6 +78,9 @@ class QueryResult:
             if global_annotations is not None else []
         self.sub_query_index = sub_query_index
         self.dps_arrays = dps_arrays
+        # percentile partials (cluster scatter): [(ts_ms, sketch
+        # bytes)] per output bucket, merged exactly by the router
+        self.sketches = None
 
     @property
     def dps(self) -> list:
@@ -108,12 +111,14 @@ class QueryResult:
         requesting TSQuery (the cache key excludes the index)."""
         if self.sub_query_index == index:
             return self
-        return QueryResult(
+        twin = QueryResult(
             self.metric, self.tags, self.aggregated_tags,
             dps=self._dps, tsuids=self.tsuids,
             annotations=self.annotations,
             global_annotations=self.global_annotations,
             sub_query_index=index, dps_arrays=self.dps_arrays)
+        twin.sketches = self.sketches
+        return twin
 
     def cache_copy(self) -> "QueryResult":
         """Detached twin for the result cache: shares the immutable
@@ -123,13 +128,15 @@ class QueryResult:
         real footprint stays what ``results_nbytes`` charged against
         the byte budget. ``_dps`` is kept only when it IS the payload
         (no columnar twin)."""
-        return QueryResult(
+        twin = QueryResult(
             self.metric, self.tags, self.aggregated_tags,
             dps=self._dps if self.dps_arrays is None else None,
             tsuids=self.tsuids, annotations=self.annotations,
             global_annotations=self.global_annotations,
             sub_query_index=self.sub_query_index,
             dps_arrays=self.dps_arrays)
+        twin.sketches = self.sketches
+        return twin
 
     def __repr__(self) -> str:  # debugging/test output only
         return (f"QueryResult(metric={self.metric!r}, "
@@ -609,9 +616,24 @@ class QueryEngine:
         t = self.tsdb
         ann = getattr(t.annotations, "version", 0)
         if sub.percentiles:
-            return ("hist", t._histogram_version,
-                    t.histogram_store.points_written,
-                    t.histogram_store.mutation_epoch, ann)
+            parts = ["hist", t._histogram_version,
+                     t.histogram_store.points_written,
+                     t.histogram_store.mutation_epoch, ann]
+            # the sketch path also reads the raw tail, the sketch
+            # tier, and (through it) the cold segments
+            lc = t.lifecycle
+            if lc is not None and lc.sketches is not None:
+                cold = lc.coldstore
+                parts += [t.store.points_written,
+                          getattr(t.store, "mutation_epoch", 0),
+                          lc.sketches.cells_folded,
+                          lc.sketches.cells_spilled,
+                          cold.mutation_epoch
+                          if cold is not None else 0]
+            else:
+                parts += [t.store.points_written,
+                          getattr(t.store, "mutation_epoch", 0)]
+            return tuple(parts)
         try:
             (store, _metric, _sids, _scale, avg_count_store,
              _ds) = self._select_store(sub)
@@ -639,7 +661,27 @@ class QueryEngine:
         if sub.percentiles:
             from opentsdb_tpu.query.histogram_engine import \
                 run_histogram_subquery
-            return run_histogram_subquery(self.tsdb, tsq, sub)
+            from opentsdb_tpu.sketch.query import (merge_pct_rows,
+                                                   run_sketch_percentiles)
+            partials = bool(getattr(tsq, "sketch_partials", False))
+            sk_rows = run_sketch_percentiles(self.tsdb, tsq, sub,
+                                             partials=partials)
+            if partials:
+                # cluster scatter: the shard hands back mergeable
+                # sketch partials, never locally-extracted quantiles.
+                # Disabled sketches 400 honestly — an empty partial
+                # would make the router's merged answer silently wrong
+                if sk_rows is None:
+                    raise BadRequestError(
+                        "sketch partials requested but the sketch "
+                        "subsystem is disabled (tsd.sketch.enable)")
+                return sk_rows
+            hist_rows = run_histogram_subquery(self.tsdb, tsq, sub)
+            if sk_rows is None:  # sketch path disabled
+                return hist_rows
+            # live arena rows + spilled/demoted sketch history splice
+            # by group (disjoint time windows)
+            return merge_pct_rows(hist_rows, sk_rows)
         # planning stage span: tier selection, filter evaluation,
         # group construction (ended at every exit of the stage — an
         # unfinished handle on an error path simply isn't recorded;

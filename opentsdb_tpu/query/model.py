@@ -187,6 +187,8 @@ class TSSubQuery:
             "index": self.index,
             **({"rollupUsage": self.rollup_usage}
                if self.rollup_usage != "ROLLUP_NOFALLBACK" else {}),
+            **({"percentiles": list(self.percentiles)}
+               if self.percentiles else {}),
             **({"pixels": self.pixels} if self.pixels else {}),
             **({"pixelFn": self.pixel_fn} if self.pixel_fn else {}),
         }
@@ -217,6 +219,10 @@ class TSQuery:
     # series whose replica set this request was assigned, so RF > 1
     # reads never double-count. None on every client-facing query.
     replica_sel: dict | None = None
+    # cluster-internal (``sketchPartials`` JSON key): a router asking
+    # a shard for mergeable quantile-sketch partials instead of
+    # locally-extracted percentile values. Never set client-side.
+    sketch_partials: bool = False
     # populated during validation
     start_ms: int = 0
     end_ms: int = 0
@@ -308,6 +314,7 @@ class TSQuery:
             use_calendar=bool(obj.get("useCalendar", False)),
             pixels=obj.get("pixels") or 0,
             pixel_fn=obj.get("pixelFn") or "",
+            sketch_partials=bool(obj.get("sketchPartials", False)),
         )
 
     def to_json(self) -> dict[str, Any]:

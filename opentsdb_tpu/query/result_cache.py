@@ -103,8 +103,12 @@ def cache_plan(tsq, sub, config) -> tuple[tuple, float] | None:
     # the replica assignment shapes the result (which series this
     # request reads): two scatters over different assignments of the
     # same query must never share a shard-side entry
+    # sketch_partials flips percentile subs between extracted
+    # quantile rows and serialized sketch partials: a shard serving
+    # both router scatters and direct clients must never cross them
     key = (window, tsq.timezone, tsq.use_calendar, tsq.ms_resolution,
            tsq.show_tsuids, tsq.no_annotations, tsq.global_annotations,
+           tsq.sketch_partials,
            sub.identity_key(), effective_pixels(tsq, sub),
            sel_cache_key(tsq.replica_sel))
     return key, ttl_ms

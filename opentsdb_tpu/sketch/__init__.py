@@ -1,0 +1,14 @@
+"""Mergeable quantile sketches (ROADMAP item 1).
+
+A DDSketch-style relative-error quantile sketch that acts as the
+fifth tier stat column: lifecycle demotion folds raw points into
+per-cell sketches, cold segments persist them as a blob column,
+the stitched read merges the three zones, the cluster router merges
+per-shard partials, streaming CQs keep a sketch channel, and
+``/api/stats/fleet`` merges latency sketches instead of bucket
+ladders. See README "Quantile sketches" for the accuracy contract.
+"""
+
+from opentsdb_tpu.sketch.ddsketch import DDSketch
+
+__all__ = ["DDSketch"]

@@ -204,6 +204,12 @@ class Histogram:
         # series — fleet merges add sums like they add bucket counts
         self.sum = 0.0
         self._lock = threading.Lock()
+        # companion quantile sketch: relative-error percentiles that
+        # merge across nodes even when bucket tables differ — the
+        # fleet merge's escape hatch for mixed-build fleets (see
+        # cluster/fleet.py). Rides the snapshot as a base64 field.
+        from opentsdb_tpu.sketch.ddsketch import DDSketch
+        self._sketch = DDSketch()
 
     def add(self, value: float) -> None:
         # bisect_left: first bound >= value, i.e. the first bucket
@@ -214,6 +220,7 @@ class Histogram:
             self.buckets[min(idx, len(self.buckets) - 1)] += 1
             self.count += 1
             self.sum += value
+            self._sketch.add(value)
 
     def snapshot(self) -> dict[str, Any]:
         """Consistent copy of the raw state — the wire form the
@@ -223,7 +230,8 @@ class Histogram:
         with self._lock:
             return {"bounds": list(self.bounds),
                     "buckets": list(self.buckets),
-                    "count": self.count, "sum": self.sum}
+                    "count": self.count, "sum": self.sum,
+                    "sketch": self._sketch.to_b64()}
 
     def percentile(self, pct: float) -> float:
         """(ref: Histogram.percentile)"""

@@ -187,6 +187,14 @@ class SharedPartial:
         self._cnt = np.zeros((cap, w))
         self._min = np.full((cap, w), np.inf)
         self._max = np.full((cap, w), -np.inf)
+        # optional fifth channel: per-(row, column) quantile sketches,
+        # maintained only while a percentile view is attached
+        # (``want_sketch``). ``sketch_from_ms`` is the oldest bucket
+        # edge the channel covers exactly — serves reaching further
+        # back shed to the batch engine
+        self.want_sketch = False
+        self._sketch: dict[tuple[int, int], Any] = {}
+        self.sketch_from_ms = 0
         self.win_ts = np.full(w, -1, dtype=np.int64)
         # the oldest bucket edge every ring column still covers; a
         # request starting before it cannot be served incrementally
@@ -356,6 +364,8 @@ class SharedPartial:
                 self.pending_points = 0
             for v in self.views:
                 v.invalidate_caches()
+            self._sketch = {}
+            self.sketch_from_ms = int(start_edge)
             self.covered_from_ms = int(start_edge)
             self.max_ts_ms = int(now_ms)
             self.tier_seeded = False
@@ -422,6 +432,9 @@ class SharedPartial:
                 self._min[:s, cols] = np.where(present, mins, np.inf)
                 self._max[:s, cols] = np.where(present, maxs, -np.inf)
                 self.bootstrap_points += int(cnts.sum())
+            if self.want_sketch and len(self._sids):
+                self._seed_sketch_locked(
+                    int(start_edge), int(start_edge + w * iv - 1))
             self.member_seq += 1
             self.fold_seq += 1
 
@@ -443,6 +456,131 @@ class SharedPartial:
             self.needs_rebuild = True
             raise
         return True
+
+    # ------------------------------------------------------------------
+    # quantile sketch channel (percentile views)
+    # ------------------------------------------------------------------
+
+    def enable_sketch(self) -> None:
+        """Turn the sketch channel on for an already-live partial (a
+        percentile view attached to a ring that predates it); the next
+        rebuild seeds it."""
+        with self.lock:
+            if not self.want_sketch:
+                self.want_sketch = True
+                self.needs_rebuild = True
+
+    def _sketch_params(self) -> tuple[float, int]:
+        cfg = self.tsdb.config
+        return (cfg.get_float("tsd.sketch.alpha", 0.01),
+                cfg.get_int("tsd.sketch.max_buckets", 4096))
+
+    def _merge_sketch_cell(self, slot: int, col: int, sk) -> None:
+        from opentsdb_tpu.sketch.ddsketch import SketchError
+        cur = self._sketch.get((slot, col))
+        if cur is None:
+            self._sketch[(slot, col)] = sk
+        else:
+            try:
+                cur.merge(sk)
+            except SketchError:
+                self._sketch[(slot, col)] = sk  # alpha changed: newest wins
+
+    def _fold_sketch_points(self, slots: np.ndarray, ts: np.ndarray,
+                            vals: np.ndarray) -> None:
+        """Vectorized sketch fold of one chunk (caller holds ``lock``
+        and has already masked non-members/NaN/late points)."""
+        from opentsdb_tpu.ops import sketch_fold
+        iv, w = self.interval_ms, self.n_windows
+        alpha, maxb = self._sketch_params()
+        bucket = ts - ts % iv
+        folded = sketch_fold.fold_series_cells(slots, bucket, vals, 1,
+                                               alpha, maxb)
+        for (slot, b), sk in folded.items():
+            c = int((int(b) // iv) % w)
+            if self.win_ts[c] != b:
+                continue
+            self._merge_sketch_cell(int(slot), c, sk)
+
+    def _seed_sketch_locked(self, start_edge: int,
+                            span_end: int) -> None:
+        """Seed the sketch channel over the horizon: demoted/cold
+        history through the three-zone sketch read (exact when the
+        sketch tier's cell interval nests in the base interval), the
+        raw tail through the vectorized fold. When demoted history
+        cannot seed exactly, ``sketch_from_ms`` records the demote
+        boundary so pre-boundary percentile serves shed to the batch
+        engine instead of answering from missing data."""
+        from opentsdb_tpu.lifecycle.stitch import sketch_zone_read
+        t = self.tsdb
+        iv, w = self.interval_ms, self.n_windows
+        self._sketch = {}
+        self.sketch_from_ms = int(start_edge)
+        items, raw_rng, cold_ok = sketch_zone_read(
+            t, self.metric, self.metric_id, int(start_edge),
+            int(span_end))
+        lc = getattr(t, "lifecycle", None)
+        demote_b = lc.demote_boundary(self.metric_id) \
+            if lc is not None else 0
+        sketches = getattr(lc, "sketches", None) \
+            if lc is not None else None
+        cell_ms = sketches.cell_ms(self.metric) \
+            if sketches is not None else 0
+        nests = bool(cell_ms) and iv % cell_ms == 0
+        if demote_b > start_edge and not (nests and cold_ok):
+            self.sketch_from_ms = int(demote_b)
+            items = []
+        if items:
+            uids = t.uids
+            pos: dict[tuple, int] = {}
+            for slot, pairs in enumerate(self._tag_pairs):
+                try:
+                    pos[tuple(sorted(
+                        (uids.tag_names.get_name(k),
+                         uids.tag_values.get_name(v))
+                        for k, v in pairs))] = slot
+                except LookupError:
+                    continue
+            for names, cts, sk in items:
+                slot = pos.get(tuple(names))
+                if slot is None or cts < self.sketch_from_ms:
+                    continue
+                b = cts - cts % iv
+                c = int((b // iv) % w)
+                if self.win_ts[c] != b:
+                    continue
+                self._merge_sketch_cell(slot, c, sk.copy())
+        if raw_rng is not None:
+            lo = max(int(raw_rng[0]), self.sketch_from_ms)
+            hi = min(int(raw_rng[1]), int(span_end))
+            if lo <= hi and len(self._sids):
+                sid_arr = np.asarray(self._sids, dtype=np.int64)
+                batch = t.store.materialize(sid_arr, lo, hi)
+                if batch.num_points:
+                    self._fold_sketch_points(
+                        np.asarray(batch.series_idx, dtype=np.int64),
+                        np.asarray(batch.ts_ms, dtype=np.int64),
+                        np.asarray(batch.values, dtype=np.float64))
+
+    def sketch_items_for(self, start_ms: int, end_ms: int):
+        """Live ``(slot, bucket_ts, sketch)`` triples whose base
+        bucket falls inside [start, end], or None when the range
+        reaches behind the channel's exact coverage. Caller holds
+        ``lock``; the returned sketches are the ring's own — callers
+        must copy before merging."""
+        if not self.want_sketch:
+            return None
+        lo = max(int(start_ms), self.sketch_from_ms,
+                 self.covered_from_ms)
+        if int(start_ms) < lo:
+            return None
+        out = []
+        for (slot, c), sk in self._sketch.items():
+            b = int(self.win_ts[c])
+            if b < 0 or b < start_ms or b > end_ms:
+                continue
+            out.append((slot, b, sk))
+        return out
 
     # ------------------------------------------------------------------
     # membership
@@ -587,6 +725,10 @@ class SharedPartial:
                     self._cnt[:, c] = 0.0
                     self._min[:, c] = np.inf
                     self._max[:, c] = -np.inf
+                    if self._sketch:
+                        for key in [k for k in self._sketch
+                                    if k[1] == c]:
+                            del self._sketch[key]
                     self.win_ts[c] = nb
                     self.covered_from_ms = max(
                         self.covered_from_ms, nb - (w - 1) * iv)
@@ -598,6 +740,8 @@ class SharedPartial:
                 stream_fold.scatter_fold(self._sum, self._cnt,
                                          self._min, self._max,
                                          slots, col, vals)
+                if self.want_sketch:
+                    self._fold_sketch_points(slots, bucket, vals)
                 changed = [int(b) for b in np.unique(bucket).tolist()]
                 for view in self.views:
                     view.note_changed(changed, self.covered_from_ms)
@@ -657,6 +801,8 @@ class SharedPartial:
                 "tierSeeded": self.tier_seeded,
                 "seedBoundaryMs": self.seed_boundary_ms,
                 "needsRebuild": self.needs_rebuild,
+                "sketchChannel": self.want_sketch,
+                "sketchFromMs": self.sketch_from_ms,
             }
 
 
@@ -856,6 +1002,8 @@ class PlanView:
         placement idiom). Returns result groups, [] for
         genuinely-empty, or None when this view cannot serve the
         window."""
+        if self.sub.percentiles:
+            return self._serve_percentiles(tsq, sub)
         shared = self.shared
         with shared.lock:
             g = self.grid_for(tsq.start_ms, tsq.end_ms)
@@ -881,6 +1029,51 @@ class PlanView:
             return engine._build_results(
                 tsq, sub, shared.metric, sid_arr, tag_mat, group_ids,
                 num_groups, gb_kids, edges, result, emit)
+
+    def _serve_percentiles(self, tsq, sub) -> list | None:
+        """Answer a percentile pull from the shared sketch channel:
+        stride-merge the base buckets of each view bucket per group
+        (sketch merges are exact), extract quantiles once through the
+        batch sketch path's emitter — so a CQ pull and a batch
+        ``/api/query`` over the same aligned window extract from
+        identically-folded state."""
+        shared = self.shared
+        if self.window.kind != "tumbling":
+            return None
+        from opentsdb_tpu.sketch.ddsketch import SketchError
+        from opentsdb_tpu.sketch.query import _emit
+        iv = self.interval_ms
+        with shared.lock:
+            items = shared.sketch_items_for(tsq.start_ms, tsq.end_ms)
+            if items is None:
+                return None
+            groups = self._groups_locked()
+            if groups is None:
+                return []
+            tag_mat, group_ids, num_groups, gb_kids = groups
+            gvec = np.asarray(group_ids, dtype=np.int64)
+            acc: dict[tuple[int, int], Any] = {}
+            num_points = 0
+            first_edge = tsq.start_ms - tsq.start_ms % iv
+            for slot, b, sk in items:
+                out_b = b - b % iv
+                if out_b < first_edge or out_b > tsq.end_ms:
+                    continue
+                num_points += sk.count
+                key = (int(gvec[slot]), int(out_b))
+                cur = acc.get(key)
+                if cur is None:
+                    acc[key] = sk.copy()  # never mutate ring state
+                else:
+                    try:
+                        cur.merge(sk)
+                    except SketchError:
+                        acc[key] = sk.copy()  # alpha skew: newest wins
+            shared.tsdb.query_limits.check(shared.metric, num_points)
+            if not acc:
+                return []
+            return _emit(shared.tsdb, tsq, sub, tag_mat, group_ids,
+                         num_groups, acc, False, True)
 
     def _tail_locked(self, edges, grid, present, group_ids,
                      num_groups: int, emit_raw: bool):

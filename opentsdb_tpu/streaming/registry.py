@@ -213,9 +213,6 @@ class ContinuousQueryRegistry:
                 "downsampling")
         specs: list[tuple] = []
         for sub in tsq.queries:
-            if sub.percentiles:
-                raise BadRequestError(
-                    "continuous queries do not support percentiles")
             if sub.tsuids or not sub.metric:
                 raise BadRequestError(
                     "continuous queries require a metric (tsuids are "
@@ -235,6 +232,20 @@ class ContinuousQueryRegistry:
                     f"decomposable into streaming partials "
                     f"(supported: {', '.join(sorted(DECOMPOSABLE_DS))})")
             window = WindowSpec.from_json(window_obj, spec.interval_ms)
+            if sub.percentiles:
+                # percentile CQs serve from the shared ring's sketch
+                # channel; only tumbling windows extract exactly
+                # (sliding/session would need per-window sketch
+                # re-merges the channel does not maintain)
+                if not self.tsdb.config.get_bool(
+                        "tsd.sketch.enable", True):
+                    raise BadRequestError(
+                        "continuous percentile queries need the "
+                        "sketch subsystem (tsd.sketch.enable)")
+                if window.kind != "tumbling":
+                    raise BadRequestError(
+                        "continuous percentile queries support "
+                        "tumbling windows only")
             windows = int((tsq.end_ms - tsq.start_ms)
                           // spec.interval_ms) + 2 \
                 + window.lead_for(spec.interval_ms)
@@ -300,14 +311,23 @@ class ContinuousQueryRegistry:
                             (anchor_edge - floor) // base_iv) + 2
                         if needed > self.max_windows:
                             group = None
-                        elif group.ensure_horizon(needed, anchor_ms):
-                            if group.tier_seeded:
-                                self.tier_seeded_bootstraps += 1
+                        else:
+                            if sub.percentiles:
+                                # a ring that predates its first
+                                # percentile view seeds the sketch
+                                # channel on the rebuild below (or
+                                # lazily at first serve)
+                                group.enable_sketch()
+                            if group.ensure_horizon(needed, anchor_ms):
+                                if group.tier_seeded:
+                                    self.tier_seeded_bootstraps += 1
                     if group is None:
                         group = SharedPartial(
                             self.tsdb, sub.metric, sub.filters,
                             view_iv, need_w)
                         group.filter_key = fid
+                        if sub.percentiles:
+                            group.want_sketch = True
                         group.bootstrap(anchor_ms)
                         if group.tier_seeded:
                             self.tier_seeded_bootstraps += 1
@@ -610,8 +630,7 @@ class ContinuousQueryRegistry:
         one extra downsample interval."""
         if not self.tsdb.config.get_bool("tsd.streaming.serve", True):
             return None
-        if tsq.delete or sub.percentiles or tsq.timezone \
-                or tsq.use_calendar:
+        if tsq.delete or tsq.timezone or tsq.use_calendar:
             return None
         view = self._by_identity.get((sub.metric, sub.identity_key()))
         if view is None:
@@ -627,8 +646,11 @@ class ContinuousQueryRegistry:
         # never saw it — shed those windows to the batch engine,
         # whose stitched store serves them
         lc = getattr(self.tsdb, "lifecycle", None)
-        if lc is not None and not group.tier_seeded and \
+        if lc is not None and not sub.percentiles \
+                and not group.tier_seeded and \
                 tsq.start_ms < lc.demote_boundary_for(sub.metric):
+            # (percentile views carry their own coverage boundary —
+            # sketch_from_ms — checked inside the serve)
             self.serve_fallbacks += 1
             return None
         # deletes/repairs/sweeps bump the read-set's mutation epochs;

@@ -133,6 +133,7 @@ class HttpJsonSerializer(HttpSerializer):
     def _result_head(self, ts_query, r: QueryResult) -> bytes:
         """Everything before "dps", serialized — ends with ``b'}'``."""
         if not (ts_query.show_query or r.tsuids
+                or getattr(r, "sketches", None)
                 or (not ts_query.no_annotations and r.annotations)
                 or (ts_query.global_annotations
                     and r.global_annotations)):
@@ -166,6 +167,14 @@ class HttpJsonSerializer(HttpSerializer):
         if ts_query.global_annotations and r.global_annotations:
             obj["globalAnnotations"] = [a.to_json()
                                         for a in r.global_annotations]
+        if getattr(r, "sketches", None):
+            # cluster sketch partials: serialized per-bucket quantile
+            # sketches ride next to the (empty) dps so the router can
+            # merge them exactly
+            import base64
+            obj["sketchDps"] = [
+                [int(t), base64.b64encode(b).decode("ascii")]
+                for t, b in r.sketches]
         return self._dump(obj)
 
     @staticmethod

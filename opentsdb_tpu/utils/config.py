@@ -421,6 +421,10 @@ _DECLARED_EXTRA: frozenset[str] = frozenset({
     "tsd.query.mesh",
     "tsd.query.workers",
     "tsd.rollups.job.device",
+    # quantile-sketch subsystem (opentsdb_tpu/sketch/)
+    "tsd.sketch.enable",
+    "tsd.sketch.alpha",
+    "tsd.sketch.max_buckets",
     # WAL enable/tuning (mode default lives in core/persist.py)
     "tsd.storage.wal.enable",
     "tsd.storage.wal.fsync",

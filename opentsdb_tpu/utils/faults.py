@@ -70,6 +70,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "lifecycle.sweep",    # lifecycle/manager.py whole sweep
     "lifecycle.demote",   # lifecycle/manager.py demotion fold
     "lifecycle.histogram",  # lifecycle/manager.py histogram demotion
+    "sketch.fold",        # ops/sketch_fold.py demote-time sketch fold
     "cluster.peer",       # cluster/router.py any-peer exchange
     "cluster.replica",    # cluster/router.py anti-entropy repair pass
     "cluster.reshard",    # cluster/reshard.py backfill step

@@ -364,12 +364,17 @@ class TestMergeUnits:
         assert merge_mod.decompose_plan(_Sub("mimmax")) == "direct"
         assert merge_mod.decompose_plan(_Sub("none")) == "concat"
         assert merge_mod.decompose_plan(_Sub("avg")) == "avg"
+        # quantile shapes merge through sketches now
+        assert merge_mod.decompose_plan(_Sub("p99")) == "sketch_agg"
+        assert merge_mod.decompose_plan(_Sub("median")) == "sketch_agg"
+        assert merge_mod.decompose_plan(
+            _Sub("sum", percentiles=[99.0])) == "sketch"
+        # dev isn't a quantile; estimated variants promise a specific
+        # rank interpolation a sketch can't reproduce
         with pytest.raises(BadRequestError):
             merge_mod.decompose_plan(_Sub("dev"))
         with pytest.raises(BadRequestError):
-            merge_mod.decompose_plan(_Sub("p99"))
-        with pytest.raises(BadRequestError):
-            merge_mod.decompose_plan(_Sub("sum", percentiles=[99.0]))
+            merge_mod.decompose_plan(_Sub("ep99r3"))
 
     @staticmethod
     def _partial(dps, tags=None, agg=(), metric="m"):
@@ -3579,3 +3584,285 @@ class TestChaosSoak(ChaosBase):
         want = json.loads(full_oracle.handle(
             req("POST", "/api/query", body)).body)
         assert _sorted_rows(rows) == _sorted_rows(want)
+
+
+# ---------------------------------------------------------------------------
+# vectorized ingest partition vs the scalar validation oracle
+# ---------------------------------------------------------------------------
+
+class TestPartitionPointsOracle:
+    """partition_points runs a vectorized timestamp prepass and a
+    per-series memo — these tests pin it point-for-point to the
+    original scalar loop (same helpers, same precedence, same error
+    strings), so the router's accept set can never drift from the
+    shard write path's."""
+
+    @pytest.fixture()
+    def router(self, tmp_path):
+        t = TSDB(Config(**{
+            "tsd.cluster.role": "router",
+            "tsd.cluster.peers": ("p0=127.0.0.1:1,p1=127.0.0.1:2,"
+                                  "p2=127.0.0.1:3"),
+            "tsd.cluster.rf": "2",
+            "tsd.cluster.spool.dir": str(tmp_path),
+            "tsd.tpu.warmup": "false"}))
+        try:
+            yield t.cluster
+        finally:
+            t.shutdown()
+
+    @staticmethod
+    def _oracle(router, points):
+        """The pre-vectorization scalar loop, verbatim semantics."""
+        from opentsdb_tpu.core.tags import (check_metric_and_tags,
+                                            parse_put_value)
+        batches, errors, valid = {}, [], []
+        for dp in points:
+            if not isinstance(dp, dict):
+                errors.append({"datapoint": dp,
+                               "error": "not a datapoint object"})
+                continue
+            metric = dp.get("metric")
+            tags = dp.get("tags") or {}
+            if not isinstance(metric, str) or not metric or \
+                    not isinstance(tags, dict):
+                errors.append({"datapoint": dp,
+                               "error": "missing metric or tags"})
+                continue
+            try:
+                router.tsdb._check_timestamp(int(dp["timestamp"]))
+                check_metric_and_tags(metric, tags)
+                value = dp.get("value")
+                if isinstance(value, str):
+                    parse_put_value(value)
+                elif value is None or isinstance(value, bool) or \
+                        not isinstance(value, (int, float)):
+                    raise ValueError(f"invalid value: {value!r}")
+            except (KeyError, TypeError, ValueError) as exc:
+                errors.append({"datapoint": dp, "error": str(exc)})
+                continue
+            valid.append(dp)
+            for shard in router.write_owners(metric, tags):
+                batches.setdefault(shard, []).append(dp)
+        return batches, errors, valid
+
+    def _check(self, router, points):
+        want = self._oracle(router, points)
+        got = router.partition_points(points)
+        assert got[1] == want[1]   # error entries, input order
+        assert got[2] == want[2]   # valid dps, input order
+        assert got[0] == want[0]   # shard -> batch, append order
+
+    def test_adversarial_corpus_identical(self, router):
+        good_tags = {"host": "a"}
+        pts = [
+            # structural failures
+            42, "not-a-dp", None, ["x"],
+            {"timestamp": BASE, "value": 1, "tags": good_tags},
+            {"metric": "", "timestamp": BASE, "value": 1,
+             "tags": good_tags},
+            {"metric": 7, "timestamp": BASE, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": 1,
+             "tags": "host=a"},
+            # timestamps: zero/negative/fractional/huge/ms/string
+            {"metric": "c.m", "timestamp": 0, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": -5, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": -10 ** 20, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": 0.4, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE + 0.9, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE_MS, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": (1 << 48), "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": 10 ** 20, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": float("nan"), "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": str(BASE), "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": "abc", "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": None, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": True, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "value": 1, "tags": good_tags},
+            # metric / tag validation
+            {"metric": "bad metric!", "timestamp": BASE, "value": 1,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": 1,
+             "tags": {}},
+            {"metric": "c.m", "timestamp": BASE, "value": 1,
+             "tags": {"bad key!": "x"}},
+            {"metric": "c.m", "timestamp": BASE, "value": 1,
+             "tags": {"h": "bad val!"}},
+            {"metric": "c.m", "timestamp": BASE, "value": 1,
+             "tags": {f"t{i}": "v" for i in range(9)}},
+            # values
+            {"metric": "c.m", "timestamp": BASE, "value": "1.5",
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": "1_0",
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": " 1",
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": "nan",
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": True,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": None,
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE, "value": [1],
+             "tags": good_tags},
+            {"metric": "c.m", "timestamp": BASE,
+             "tags": good_tags},
+        ]
+        self._check(router, pts)
+
+    def test_bulk_series_memo_identical(self, router):
+        rng = np.random.default_rng(5)
+        pts = []
+        for i in range(400):
+            h = f"h{i % 7}"
+            pts.append({"metric": f"c.bulk{i % 3}",
+                        "timestamp": BASE + i,
+                        "value": float(rng.normal()),
+                        "tags": {"host": h, "dc": f"d{i % 2}"}})
+            if i % 11 == 0:   # same tag set, swapped insertion order
+                pts.append({"metric": f"c.bulk{i % 3}",
+                            "timestamp": BASE + i,
+                            "value": i,
+                            "tags": {"dc": f"d{i % 2}", "host": h}})
+            if i % 13 == 0:   # memoized rejection path
+                pts.append({"metric": "bad metric!",
+                            "timestamp": BASE + i, "value": 1,
+                            "tags": {"host": h}})
+        self._check(router, pts)
+
+    def test_empty_and_all_bad(self, router):
+        self._check(router, [])
+        self._check(router, [1, None, {"metric": "c.m"}])
+
+
+# ---------------------------------------------------------------------------
+# quantile sketches across shard boundaries
+# ---------------------------------------------------------------------------
+
+def _sk_points(n_hosts=9, n_sec=180, metric="sk.m", seed=41):
+    """Lognormal float values: per-series partials are NOT exact
+    integers, so the bit-equal guarantee here rests entirely on the
+    sketch's canonical merge-order-independent state, not on summation
+    luck."""
+    rng = np.random.default_rng(seed)
+    pts = []
+    for i in range(n_sec):
+        for h in range(n_hosts):
+            pts.append({"metric": metric, "timestamp": BASE + i,
+                        "value": float(rng.lognormal(2.0, 1.0)),
+                        "tags": {"host": f"h{h:02d}"}})
+    return pts
+
+
+@pytest.fixture(scope="class")
+def sketch_cluster(request, tmp_path_factory):
+    c = LiveCluster(tmp_path_factory.mktemp("sketch_cluster"))
+    points = _sk_points()
+    resp = c.put(points, summary="true")
+    assert resp.status == 200, resp.body
+    assert json.loads(resp.body)["failed"] == 0
+    request.cls.cluster = c
+    request.cls.points = points
+    yield c
+    c.close()
+
+
+@pytest.mark.sketch
+@pytest.mark.usefixtures("sketch_cluster")
+class TestSketchScatterGather:
+    """Router-side sketch merge vs a single node holding every point.
+
+    The ``percentiles`` sub decomposes as plan "sketch": every shard
+    folds its own series into per-bucket sketches and ships serialized
+    partials; the router merges them. Canonical sketch state makes the
+    merge order-independent, so the merged answer must be BIT-equal to
+    the single-node oracle — not merely close."""
+    cluster: LiveCluster
+    points: list
+
+    BODY = {"start": BASE_MS - 10_000, "end": BASE_MS + 200_000}
+
+    def _body(self, **qspec):
+        return {**self.BODY,
+                "queries": [dict({"metric": "sk.m"}, **qspec)]}
+
+    def test_percentiles_bit_equal_to_single_node_oracle(self):
+        body = self._body(aggregator="sum", downsample="1m-avg",
+                          percentiles=[50.0, 99.0])
+        resp, doc = self.cluster.query(body)
+        assert resp.status == 200, resp.body
+        rows, degraded = _strip_marker(doc)
+        assert degraded == []
+        oracle = _oracle(self.points)
+        want = json.loads(oracle.handle(
+            req("POST", "/api/query", body)).body)
+        assert {r["metric"] for r in rows} == \
+            {"sk.m_pct_50", "sk.m_pct_99"}
+        assert _sorted_rows(rows) == _sorted_rows(want)  # BIT-equal
+
+    def test_p99_aggregator_within_bound_of_exact(self):
+        """Exact percentile aggregators can't decompose across shards
+        (plan "sketch_agg" folds per-series ds values into router-side
+        sketches instead), so the contract downgrades from bit-equal
+        to the sketch's documented relative-error bound vs the exact
+        lower order statistic — the rank convention the sketch
+        documents — over the same per-series downsampled values the
+        single-node aggregator reduces."""
+        body = self._body(aggregator="p99", downsample="1m-avg")
+        resp, doc = self.cluster.query(body)
+        assert resp.status == 200, resp.body
+        rows, degraded = _strip_marker(doc)
+        assert degraded == []
+        assert len(rows) == 1
+        assert rows[0]["aggregateTags"] == ["host"]
+        # exact operands: per-series 1m-avg values from a single node
+        # holding every point (aggregator none = no reduction)
+        oracle = _oracle(self.points)
+        per_series = json.loads(oracle.handle(req(
+            "POST", "/api/query",
+            self._body(aggregator="none", downsample="1m-avg"))).body)
+        pool: dict[str, list] = {}
+        for r in per_series:
+            for ts, v in r["dps"].items():
+                pool.setdefault(ts, []).append(float(v))
+        alpha = self.cluster.tsdb.config.get_float(
+            "tsd.sketch.alpha", 0.01)
+        got_dps = rows[0]["dps"]
+        assert set(got_dps) == set(pool) and got_dps
+        for ts, vals in pool.items():
+            exact = float(np.percentile(vals, 99.0, method="lower"))
+            assert abs(got_dps[ts] - exact) <= \
+                1.1 * alpha * abs(exact) + 1e-9, (ts, got_dps[ts])
+
+    def test_estimated_percentile_aggregators_stay_400(self):
+        for agg in ("ep99r3", "ep50r7", "dev"):
+            resp, doc = self.cluster.query(
+                self._body(aggregator=agg, downsample="1m-avg"))
+            assert resp.status == 400, (agg, resp.status)
+
+    def test_percentiles_survive_one_killed_shard(self):
+        # LAST in the class: degrades the shared cluster for good
+        self.cluster.peer("s0").kill()
+        resp, doc = self.cluster.query(
+            self._body(aggregator="sum", downsample="1m-avg",
+                       percentiles=[99.0]))
+        assert resp.status == 200, resp.body
+        rows, degraded = _strip_marker(doc)
+        assert degraded != []
+        assert rows, "surviving shards must still answer"
+        for r in rows:
+            assert r["metric"] == "sk.m_pct_99"

@@ -1,0 +1,391 @@
+"""Mergeable quantile-sketch battery (``-m sketch``).
+
+Covers the DDSketch core (merge associativity/commutativity oracle —
+canonical state, bit-equal serialization under any merge order; the
+relative-error bound vs exact order statistics; round-trip and
+collapse), the vectorized columnar fold kernel vs per-point adds,
+percentile queries over demoted tier history and cold on-disk
+segments (within the documented alpha of an undemoted exact oracle,
+surviving a restart bit-identically), the histogram arena spill into
+cold sketch segments, and the fleet-stats sketch merge. Cluster
+router merge tests live in ``tests/test_cluster.py`` (they need live
+shards); streaming CQ percentile tests in ``tests/test_streaming.py``
+(they need the lock witness + streaming fixtures).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import TSQuery
+from opentsdb_tpu.sketch.ddsketch import (DEFAULT_ALPHA, DDSketch,
+                                          SketchError, merge_all)
+
+pytestmark = pytest.mark.sketch
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+SPAN_S = 7200
+NOW_MS = BASE_MS + SPAN_S * 1000
+
+# the error contract everywhere in this file: a sketch quantile is
+# within alpha (relative) of the exact lower order statistic; 1.1x
+# headroom absorbs the bucket-edge rounding of key reconstruction
+BOUND = 1.1
+
+
+def _within(got, exact, alpha=DEFAULT_ALPHA):
+    return abs(got - exact) <= BOUND * alpha * abs(exact) + 1e-9
+
+
+def _exact(vals, q):
+    return float(np.percentile(np.asarray(vals, dtype=np.float64), q,
+                               method="lower"))
+
+
+# ---------------------------------------------------------------------------
+# DDSketch core
+# ---------------------------------------------------------------------------
+
+class TestDDSketchCore:
+    DISTS = {
+        "lognormal": lambda rng, n: rng.lognormal(3.0, 1.2, n),
+        "normal_mixed_sign": lambda rng, n: rng.normal(0.0, 40.0, n),
+        "heavy_tail": lambda rng, n: rng.pareto(1.5, n) * 10 + 0.001,
+        "with_zeros_and_ties": lambda rng, n: np.round(
+            rng.exponential(5.0, n) - 0.5),
+    }
+
+    @pytest.mark.parametrize("dist", sorted(DISTS))
+    @pytest.mark.parametrize("alpha", [0.005, 0.01, 0.05])
+    def test_error_bound_property(self, dist, alpha):
+        rng = np.random.default_rng(hash(dist) % (2 ** 31))
+        vals = self.DISTS[dist](rng, 5000)
+        sk = DDSketch(alpha)
+        sk.add_values(vals)
+        for q in (1.0, 25.0, 50.0, 75.0, 90.0, 99.0, 99.9):
+            got = sk.quantile(q)
+            exact = _exact(vals, q)
+            assert _within(got, exact, alpha), (dist, q, got, exact)
+
+    def test_merge_associative_commutative_bit_equal(self):
+        """Canonical sparse state: ANY merge order (pairings and
+        permutations) serializes to the same bytes as folding every
+        value into one sketch — the property the cluster router's
+        bit-equal-to-oracle guarantee rests on."""
+        rng = np.random.default_rng(17)
+        vals = rng.lognormal(2.0, 1.0, 4000)
+        vals[::97] = 0.0
+        vals[::53] *= -1.0
+        oracle = DDSketch()
+        oracle.add_values(vals)
+        want = oracle.to_bytes()
+        parts = np.array_split(vals, 7)
+        for perm_seed in range(4):
+            order = np.random.default_rng(perm_seed).permutation(7)
+            # left fold
+            acc = DDSketch()
+            for j in order:
+                p = DDSketch()
+                p.add_values(parts[j])
+                acc.merge(p)
+            assert acc.to_bytes() == want
+            # tree fold ((a+b)+(c+d))+... via merge_all
+            sks = []
+            for j in order:
+                p = DDSketch()
+                p.add_values(parts[j])
+                sks.append(p)
+            assert merge_all(sks).to_bytes() == want
+
+    def test_serialization_round_trip_bit_equal(self):
+        rng = np.random.default_rng(3)
+        sk = DDSketch()
+        sk.add_values(rng.normal(0, 100, 1000))
+        blob = sk.to_bytes()
+        back = DDSketch.from_bytes(blob)
+        assert back.to_bytes() == blob
+        assert back.count == sk.count
+        assert DDSketch.from_b64(sk.to_b64()).to_bytes() == blob
+        for q in (1.0, 50.0, 99.0):
+            assert back.quantile(q) == sk.quantile(q)
+
+    def test_alpha_mismatch_refuses_merge(self):
+        a, b = DDSketch(0.01), DDSketch(0.02)
+        a.add(1.0)
+        b.add(2.0)
+        with pytest.raises(SketchError):
+            a.merge(b)
+        # empty other is a no-op even across alphas? No: empty merges
+        # are allowed only when state-compatible or count==0
+        c = DDSketch(0.02)
+        a.merge(c)  # count==0 other: no-op, never an error
+        assert a.count == 1
+
+    def test_collapse_bounds_buckets_keeps_mass(self):
+        rng = np.random.default_rng(11)
+        sk = DDSketch(0.01)
+        vals = rng.lognormal(4.0, 1.0, 20000)
+        sk.add_values(vals)
+        n0 = len(sk.pos_idx)
+        assert n0 > 256
+        sk.collapse(256)
+        assert len(sk.pos_idx) <= 256
+        assert sk.count == 20000
+        assert sk.min == float(vals.min())
+        assert sk.max == float(vals.max())
+        # collapse folds LOW buckets upward, so the surviving top
+        # buckets keep the tail within the normal alpha contract
+        for q in (90.0, 99.0, 99.9):
+            assert _within(sk.quantile(q), _exact(vals, q)), q
+
+    def test_quantile_clamps_to_observed_range(self):
+        sk = DDSketch()
+        sk.add_values(np.asarray([5.0, 7.0, 9.0]))
+        assert sk.quantile(0.0) >= 5.0 - 1e-12
+        assert sk.quantile(100.0) <= 9.0 + 1e-12
+
+
+# ---------------------------------------------------------------------------
+# vectorized fold kernel vs per-point adds
+# ---------------------------------------------------------------------------
+
+class TestFoldKernel:
+    def test_fold_series_cells_matches_pointwise(self):
+        from opentsdb_tpu.ops.sketch_fold import fold_series_cells
+        rng = np.random.default_rng(23)
+        n = 3000
+        cell_ms = 60_000
+        sids = rng.integers(0, 5, n)
+        ts = BASE_MS + rng.integers(0, 1800, n) * 1000
+        vals = rng.lognormal(2.0, 1.0, n)
+        vals[::41] = np.nan   # NaNs must be skipped, not folded
+        got = fold_series_cells(sids, ts, vals, cell_ms, 0.01)
+        want: dict[tuple[int, int], DDSketch] = {}
+        for s, t_ms, v in zip(sids.tolist(), ts.tolist(),
+                              vals.tolist()):
+            if v != v:
+                continue
+            key = (int(s), int(t_ms - t_ms % cell_ms))
+            want.setdefault(key, DDSketch(0.01)).add(v)
+        assert set(got) == set(want)
+        for key in want:
+            assert got[key].to_bytes() == want[key].to_bytes(), key
+
+
+# ---------------------------------------------------------------------------
+# demoted tier history + cold segments vs the exact oracle
+# ---------------------------------------------------------------------------
+
+def _cfg(tmp_path=None, lifecycle=True, spill=False, data_dir=False,
+         **extra):
+    cfg = {
+        "tsd.core.auto_create_metrics": "true",
+        "tsd.storage.backend": "memory",
+        "tsd.rollups.enable": "true",
+        "tsd.tpu.warmup": "false",
+    }
+    if data_dir:
+        cfg["tsd.storage.data_dir"] = str(tmp_path / "data")
+    if lifecycle:
+        cfg.update({
+            "tsd.lifecycle.enable": "true",
+            "tsd.lifecycle.demote_after": "30m",
+            "tsd.lifecycle.demote_tiers": "1m",
+        })
+        if spill:
+            cfg["tsd.lifecycle.spill_after"] = "60m"
+            if not data_dir:
+                cfg["tsd.coldstore.dir"] = str(tmp_path / "cold")
+    cfg.update(extra)
+    return Config(**cfg)
+
+
+def _ingest(t, n_series=4, seed=7, metric="sys.lat"):
+    ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+    rng = np.random.default_rng(seed)
+    per = {}
+    for i in range(n_series):
+        vals = rng.lognormal(3.0, 0.8, SPAN_S)
+        t.add_points(metric, ts, vals, {"host": f"h{i:02d}"})
+        per[f"h{i:02d}"] = (ts, vals)
+    return per
+
+
+def _pct_query(t, qs, metric="sys.lat", ds="5m-avg", start=BASE_MS,
+               end=NOW_MS):
+    tsq = TSQuery.from_json({
+        "start": start, "end": end,
+        "queries": [{"aggregator": "sum", "metric": metric,
+                     "downsample": ds, "percentiles": qs}],
+    }).validate()
+    return t.execute_query(tsq)
+
+
+def _pct_maps(results):
+    """{q: {slot_ms: value}} from _pct_{q:g} result rows."""
+    out: dict[str, dict[int, float]] = {}
+    for r in results:
+        q = r.metric.rsplit("_pct_", 1)[1]
+        assert q not in out or not out[q].keys() & dict(r.dps).keys()
+        out.setdefault(q, {}).update(r.dps)
+    return out
+
+
+def _exact_buckets(per_series, q, bucket_ms=300_000):
+    pool: dict[int, list] = {}
+    for ts, vals in per_series.values():
+        slots = (ts * 1000) - (ts * 1000) % bucket_ms
+        for s in np.unique(slots):
+            pool.setdefault(int(s), []).append(vals[slots == s])
+    return {s: _exact(np.concatenate(chunks), q)
+            for s, chunks in pool.items()}
+
+
+class TestDemotedAndColdPercentiles:
+    def test_demoted_history_within_bound_of_exact(self, tmp_path):
+        t = TSDB(_cfg(tmp_path))
+        per = _ingest(t)
+        rep = t.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["demoted"] > 0, rep
+        got = _pct_maps(_pct_query(t, [50.0, 99.0]))
+        for q in (50.0, 99.0):
+            exact = _exact_buckets(per, q)
+            m = got[f"{q:g}"]
+            assert set(m) == set(exact)
+            for s in exact:
+                assert _within(m[s], exact[s]), (q, s, m[s], exact[s])
+        t.shutdown()
+
+    def test_cold_spill_and_restart_round_trip(self, tmp_path):
+        t = TSDB(_cfg(tmp_path, spill=True, data_dir=True))
+        per = _ingest(t)
+        rep = t.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["demoted"] > 0 and rep["spilled"] > 0, rep
+        assert t.lifecycle.coldstore.spill_boundary("sys.lat") > 0
+        got = _pct_maps(_pct_query(t, [50.0, 99.0]))
+        for q in (50.0, 99.0):
+            exact = _exact_buckets(per, q)
+            m = got[f"{q:g}"]
+            assert set(m) == set(exact)
+            for s in exact:
+                assert _within(m[s], exact[s]), (q, s, m[s], exact[s])
+        t.wal.close()
+        # restart: cold segments + persisted sketch cells must answer
+        # BIT-identically to the pre-restart process
+        t2 = TSDB(_cfg(tmp_path, spill=True, data_dir=True))
+        got2 = _pct_maps(_pct_query(t2, [50.0, 99.0]))
+        assert got2 == got
+        t2.wal.close()
+
+    def test_disabled_sketch_keeps_pre_sketch_behavior(self, tmp_path):
+        t = TSDB(_cfg(tmp_path, **{"tsd.sketch.enable": "false"}))
+        _ingest(t, n_series=1)
+        assert _pct_query(t, [99.0]) == []   # scalar metric, no arenas
+        t.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# histogram arena spill -> cold sketch segments
+# ---------------------------------------------------------------------------
+
+class TestHistogramArenaSpill:
+    BOUNDS = [float(x) for x in
+              [0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024]]
+
+    def _fill(self, t, metric="req.lat"):
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        rng = np.random.default_rng(29)
+        for i in range(0, SPAN_S, 60):
+            for host in ("a", "b"):
+                h = SimpleHistogram(self.BOUNDS)
+                for v in rng.lognormal(2.5, 1.0, 40):
+                    h.add(min(v, 1023.0))
+                t.add_histogram_point(
+                    metric, BASE + i,
+                    t.histogram_manager.encode(h), {"host": host})
+
+    def test_spill_serves_cold_within_alpha_of_live(self, tmp_path):
+        t = TSDB(_cfg(tmp_path, spill=True, data_dir=True))
+        self._fill(t)
+        live = _pct_maps(_pct_query(t, [50.0, 99.0],
+                                    metric="req.lat", ds="5m-avg"))
+        assert live["99"]
+        rep = t.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep["histogramSpilled"] > 0, rep
+        cold_b = t.lifecycle.coldstore.spill_boundary("req.lat")
+        assert cold_b > 0
+        mid = t.uids.metrics.get_id("req.lat")
+        with t._histogram_lock:
+            arena = t._histogram_arenas.get(mid)
+            if arena is not None:
+                for sub in arena.groups.values():
+                    assert (sub.ts[:sub.n] >= cold_b).all()
+        after = _pct_maps(_pct_query(t, [50.0, 99.0],
+                                     metric="req.lat", ds="5m-avg"))
+        alpha = 0.01
+        for q in ("50", "99"):
+            assert set(after[q]) == set(live[q])
+            for s, v in live[q].items():
+                assert abs(after[q][s] - v) <= \
+                    BOUND * alpha * abs(v) + 1e-9, (q, s)
+        # restart: the manifest + segments answer identically
+        t.wal.close()
+        t2 = TSDB(_cfg(tmp_path, spill=True, data_dir=True))
+        assert _pct_maps(_pct_query(t2, [50.0, 99.0],
+                                    metric="req.lat",
+                                    ds="5m-avg")) == after
+        t2.wal.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet stats merging via snapshot sketch companions
+# ---------------------------------------------------------------------------
+
+class TestFleetSketchMerge:
+    def test_mixed_bucket_ladders_merge_via_sketch(self):
+        from opentsdb_tpu.cluster.fleet import merge_fleet
+        from opentsdb_tpu.stats.stats import Histogram
+        rng = np.random.default_rng(31)
+        vals = rng.gamma(2.0, 30.0, 4000)
+        a, b = Histogram(16000, 2, 1), Histogram(1000, 2, 10)
+        for v in vals[:2000]:
+            a.add(float(v))
+        for v in vals[2000:]:
+            b.add(float(v))
+        docs = {"s0": {"histograms": [
+                    {"name": "x", "labels": {}, **a.snapshot()}]},
+                "s1": {"histograms": [
+                    {"name": "x", "labels": {}, **b.snapshot()}]}}
+        h = merge_fleet(docs)["histograms"]["x"]
+        assert h["merge"] == "sketch"
+        assert h["count"] == 4000
+        for lbl, q in (("p50", 50.0), ("p95", 95.0), ("p99", 99.0),
+                       ("p999", 99.9)):
+            assert _within(h[lbl], _exact(vals, q)), (lbl, h[lbl])
+
+    def test_matching_ladders_keep_bucket_percentiles(self):
+        from opentsdb_tpu.cluster.fleet import merge_fleet
+        from opentsdb_tpu.stats.stats import (
+            Histogram, merge_histogram_snapshots,
+            percentiles_from_buckets)
+        rng = np.random.default_rng(37)
+        parts = [Histogram(16000, 2, 1) for _ in range(3)]
+        for i, v in enumerate(rng.gamma(2.0, 25.0, 1500)):
+            parts[i % 3].add(float(v))
+        docs = {f"s{i}": {"histograms": [
+                    {"name": "x", "labels": {}, **h.snapshot()}]}
+                for i, h in enumerate(parts)}
+        h = merge_fleet(docs)["histograms"]["x"]
+        merged = merge_histogram_snapshots(
+            [p.snapshot() for p in parts])
+        want = percentiles_from_buckets(
+            merged["bounds"], merged["buckets"], merged["count"],
+            [50.0, 95.0, 99.0, 99.9])
+        assert h["merge"] == "buckets"
+        # bucket path stays BIT-equal; the sketch rides along as the
+        # higher-resolution companion
+        assert [h["p50"], h["p95"], h["p99"], h["p999"]] == want
+        assert set(h["sketch"]) == {"p50", "p95", "p99", "p999"}

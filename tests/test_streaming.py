@@ -371,7 +371,10 @@ class TestContinuousHttp:
         lambda q: q["queries"][0].pop("downsample"),
         lambda q: q["queries"][0].update(downsample="0all-sum"),
         lambda q: q["queries"][0].update(downsample="1m-p95"),
-        lambda q: q["queries"][0].update(percentiles=[99.0]),
+        # percentile CQs are maintainable now (sketch channel), but
+        # only with tumbling windows
+        lambda q: (q["queries"][0].update(percentiles=[99.0]),
+                   q.update(window={"type": "sliding", "size": "5m"})),
         lambda q: q["queries"][0].update(explicitTags=True),
         lambda q: q.update(delete=True),
     ])
@@ -693,3 +696,73 @@ class TestSseResume:
         ev, _, _ = _events_with_ids(next(it))[0]
         assert ev == "snapshot"
         resp.body_iter.close()
+
+
+# ---------------------------------------------------------------------------
+# percentile continuous queries (sketch channel)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.sketch
+class TestPercentileContinuousQueries:
+    """Standing percentile CQs serve from the shared ring's sketch
+    channel. Canonical sketch state makes the incrementally-maintained
+    answer BIT-identical to the cold batch sketch path over the same
+    points — the same equivalence contract the scalar aggregators get,
+    not a weaker within-alpha one."""
+
+    def _pct_qobj(self, qs, gb=None):
+        q = _qobj(agg="sum", ds="1m-avg", gb=gb)
+        q["queries"][0]["percentiles"] = qs
+        return q
+
+    def test_pull_bit_identical_to_batch(self):
+        t = _tsdb()
+        _ingest(t, SERIES[:3], BASE, 40, seed=3)
+        qobj = self._pct_qobj([99.0])
+        _register(t, qobj)
+        # post-registration points, including a never-seen series,
+        # must flow through the sketch channel's tap
+        _ingest(t, SERIES, BASE + 900, 40, seed=4)
+        hits0 = t.streaming.serve_hits
+        streamed = _run(t, qobj)
+        assert t.streaming.serve_hits == hits0 + 1, \
+            "percentile query was not served from the standing plan"
+        batch = _run_batch(t, qobj)
+        assert streamed and {r.metric for r in streamed} == \
+            {"s.m_pct_99"}
+        _assert_value_identical(streamed, batch)
+
+    def test_multi_quantile_group_by_bit_identical(self):
+        t = _tsdb()
+        _ingest(t, SERIES, BASE, 30, seed=5)
+        qobj = self._pct_qobj([50.0, 99.0], gb="dc")
+        _register(t, qobj)
+        _ingest(t, SERIES, BASE + 700, 30, seed=6)
+        hits0 = t.streaming.serve_hits
+        streamed = _run(t, qobj)
+        assert t.streaming.serve_hits == hits0 + 1
+        batch = _run_batch(t, qobj)
+        mets = {r.metric for r in streamed}
+        assert mets == {"s.m_pct_50", "s.m_pct_99"}
+        assert {tuple(sorted(r.tags.items())) for r in streamed} \
+            == {(("dc", "east"),), (("dc", "west"),)}
+        _assert_value_identical(streamed, batch)
+
+    def test_describe_round_trips_percentiles(self):
+        """The CQ listing's query doc must round-trip: a client
+        re-registering what /api/query/continuous showed it must get
+        the SAME standing query, percentiles included (the sub
+        serializer dropped them before the sketch subsystem)."""
+        t = _tsdb()
+        cq = _register(t, self._pct_qobj([50.0, 99.0]))
+        doc = cq.describe()
+        sub = doc["query"]["queries"][0]
+        assert sub["percentiles"] == [50.0, 99.0]
+        reborn = TSQuery.from_json(doc["query"]).validate(END_MS)
+        assert tuple(reborn.queries[0].percentiles) == (50.0, 99.0)
+
+    def test_disabled_sketch_registry_400(self):
+        from opentsdb_tpu.query.model import BadRequestError
+        t = _tsdb(**{"tsd.sketch.enable": "false"})
+        with pytest.raises(BadRequestError):
+            _register(t, self._pct_qobj([99.0]))
