@@ -2076,6 +2076,13 @@ class ClusterRouter:
         for r in results:
             px, fn = by_sub.get(r.sub_query_index, (0, ""))
             arrays = getattr(r, "dps_arrays", None)
+            if px and arrays is None:
+                # percentile rows merge as plain (ts, value) lists —
+                # post-assembly they reduce like any other emitted row
+                r.dps = vd.reduce_dps(r.dps, tsq.start_ms, tsq.end_ms,
+                                      px, fn)
+                out.append(r)
+                continue
             if not px or arrays is None or not len(arrays[0]):
                 out.append(r)
                 continue

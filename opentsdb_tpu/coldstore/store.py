@@ -295,6 +295,7 @@ class ColdStore:
         self.degraded_serves = 0
         self.segments_quarantined = 0
         self.segments_dropped = 0       # retention
+        self.segments_compacted = 0     # merge-compaction
         self.points_deleted = 0         # delete=true rewrites
         self.last_error = ""
         self._load_manifest()
@@ -624,6 +625,150 @@ class ColdStore:
                                       ts_col, cols, sketch=sketch)
         return removed, new_entry
 
+    def compact_segments(self, metric: str, threshold: int) -> int:
+        """Merge-compact every (metric, tier) group that accumulated
+        MORE than ``threshold`` per-sweep segments into one segment
+        per tier. Same crash ordering as the delete rewrite: each
+        merged replacement is durable on disk BEFORE the single
+        manifest commit that swaps the entries, and the obsolete files
+        unlink only AFTER it — a crash at any point leaves fsck-visible
+        orphans, never a referenced-but-missing segment. Returns the
+        number of segments merged away."""
+        if threshold <= 0:
+            return 0
+        if self.faults is not None:
+            self.faults.check("coldstore.write")
+        removed = 0
+        with self._lock:
+            rec = self._metrics.get(metric)
+            if not rec:
+                return 0
+            by_tier: dict[str, list[dict]] = {}
+            for entry in rec["segments"]:
+                by_tier.setdefault(entry["interval"], []).append(entry)
+            keep_entries = [e for e in rec["segments"]
+                            if len(by_tier[e["interval"]]) <= threshold]
+            obsolete: list[str] = []
+            changed = False
+            for interval, entries in sorted(by_tier.items()):
+                if len(entries) <= threshold:
+                    continue
+                entries = sorted(entries,
+                                 key=lambda e: e["start_ms"])
+                new_entry = self._merge_segments_locked(
+                    metric, interval, entries)
+                if new_entry is None:   # unreadable input: leave as-is
+                    keep_entries.extend(entries)
+                    continue
+                keep_entries.append(new_entry)
+                obsolete.extend(e["file"] for e in entries)
+                removed += len(entries) - 1
+                changed = True
+            if changed:
+                rec["segments"] = keep_entries
+                self._handle_cache.clear()
+                self.segments_compacted += removed
+                self.mutation_epoch += 1
+                self._save_manifest_locked()
+                # unlink the merged inputs only AFTER the manifest
+                # commit (the delete-rewrite ordering, see above)
+                for name in obsolete:
+                    try:
+                        os.unlink(os.path.join(self.directory, name))
+                    except OSError:  # pragma: no cover
+                        pass
+        return removed
+
+    def _merge_segments_locked(self, metric: str, interval: str,
+                               entries: list[dict]) -> dict | None:
+        """Write ONE durable segment holding every row of ``entries``
+        (time-disjoint, passed sorted by start_ms), series-major like
+        any spilled segment: per identity, the per-segment runs
+        concatenate in segment order, so each series' rows stay
+        time-ascending. Returns the replacement manifest entry, or
+        None when an input segment cannot be read (checksum, missing
+        file — the group is left untouched for fsck to report).
+        Caller holds the lock."""
+        try:
+            segs = [fmt.Segment(os.path.join(self.directory,
+                                             e["file"]))
+                    for e in entries]
+        except (fmt.SegmentError, OSError) as exc:
+            self.last_error = f"compact: {exc}"
+            return None
+        stats = list(segs[0].header["stats"])
+        if any(list(s.header["stats"]) != stats for s in segs[1:]):
+            return None
+        # per-identity row runs, first-seen order (deterministic:
+        # segment order is start_ms order, series order is on-disk)
+        order: list[tuple] = []
+        runs: dict[tuple, list[tuple[int, int, int]]] = {}
+        for si, seg in enumerate(segs):
+            for tags, off, cnt in seg.series:
+                if tags not in runs:
+                    order.append(tags)
+                    runs[tags] = []
+                runs[tags].append((si, off, cnt))
+        has_sk = any(s.has_sketches for s in segs)
+        ts_parts: list[np.ndarray] = []
+        col_parts: dict[str, list[np.ndarray]] = \
+            {st: [] for st in stats}
+        sk_lens: list[np.ndarray] = []
+        sk_blobs: list[bytes] = []
+        series_entries = []
+        off_out = 0
+        for tags in order:
+            cnt_total = 0
+            for si, off, cnt in runs[tags]:
+                seg = segs[si]
+                ts_parts.append(seg.ts64(off, off + cnt))
+                for st in stats:
+                    col_parts[st].append(
+                        np.asarray(seg.cols[st])[off:off + cnt])
+                if has_sk:
+                    if seg.has_sketches:
+                        offs = np.asarray(seg.sk_off)
+                        sk_lens.append(offs[off + 1:off + cnt + 1]
+                                       - offs[off:off + cnt])
+                        lo, hi = int(offs[off]), int(offs[off + cnt])
+                        if hi > lo:
+                            sk_blobs.append(bytes(seg.sk_blob[lo:hi]))
+                    else:
+                        # format-1 input rows merge into a format-2
+                        # output as empty (offset-equal) sketch slots
+                        sk_lens.append(np.zeros(cnt, dtype=np.int64))
+                cnt_total += cnt
+            series_entries.append({"tags": [list(p) for p in tags],
+                                   "off": off_out, "cnt": cnt_total})
+            off_out += cnt_total
+        ts64 = np.concatenate(ts_parts) if ts_parts else \
+            np.zeros(0, dtype=np.int64)
+        cols = {st: np.concatenate(col_parts[st]) if col_parts[st]
+                else np.zeros(0, dtype=np.float64) for st in stats}
+        sketch = None
+        if has_sk:
+            lens = np.concatenate(sk_lens) if sk_lens else \
+                np.zeros(0, dtype=np.int64)
+            new_off = np.zeros(len(lens) + 1, dtype=np.int64)
+            np.cumsum(lens, out=new_off[1:])
+            sketch = (new_off, b"".join(sk_blobs))
+        ts_col, base_ms, scale = fmt.pack_timestamps(ts64)
+        header = {
+            "metric": metric, "interval": interval,
+            "base_ms": base_ms, "scale": scale,
+            "start_ms": int(ts64.min()) if len(ts64) else 0,
+            "end_ms": int(ts64.max()) if len(ts64) else 0,
+            "stats": stats, "series": series_entries,
+        }
+        # keeps SEGMENT_SUFFIX (fsck's orphan scan matches on it) and
+        # a monotonic nonce so repeated compactions never collide
+        name = (f"{_metric_slug(metric)}-{interval}"
+                f"-{header['start_ms']}-{header['end_ms']}"
+                f"-mc{self.segments_compacted + len(entries)}"
+                f"{SEGMENT_SUFFIX}")
+        return fmt.write_segment(self.directory, name, header, ts_col,
+                                 cols, sketch=sketch)
+
     @staticmethod
     def _entry_interval_ms(entry: dict, interval_ms_of) -> int:
         """One segment's cell-window span in ms: the shared expiry
@@ -913,6 +1058,7 @@ class ColdStore:
             "segmentsWritten": self.segments_written,
             "segmentsQuarantined": self.segments_quarantined,
             "segmentsDropped": self.segments_dropped,
+            "segmentsCompacted": self.segments_compacted,
             "pointsSpilled": self.points_spilled,
             "pointsDeleted": self.points_deleted,
             "bytesSpilled": self.bytes_spilled,
@@ -935,6 +1081,8 @@ class ColdStore:
                          self.segments_written)
         collector.record("coldstore.segments.quarantined",
                          self.segments_quarantined)
+        collector.record("coldstore.segments.compacted",
+                         self.segments_compacted)
         collector.record("coldstore.points.spilled",
                          self.points_spilled)
         collector.record("coldstore.bytes", self.cold_bytes())

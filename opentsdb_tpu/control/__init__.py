@@ -1,0 +1,5 @@
+"""Self-driving control plane: a closed loop that watches the
+workload surfaces the TSD already exports (query-shape log, SLO burn,
+per-shard load) and steers three actuators — adaptive
+materialization, multi-tenant QoS, and placement. See
+:mod:`opentsdb_tpu.control.plane`."""

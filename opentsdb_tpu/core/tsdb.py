@@ -185,6 +185,10 @@ class TSDB:
         # HTTP layer reads the `cluster` property per request; only a
         # tsd.cluster.role=router TSD instantiates the router
         self._cluster = None
+        # self-driving control plane (opentsdb_tpu/control/): lazy —
+        # the server's admission seam reads the raw attribute per
+        # request; only tsd.control.enable instantiates the loop
+        self._control = None
         # per-hook swallowed-error counters: post-write hooks (meta,
         # realtime publisher, external meta cache, stream tap) can
         # never fail an ACKNOWLEDGED write — see _run_hook
@@ -1094,6 +1098,28 @@ class TSDB:
         return self._cluster
 
     @property
+    def control(self):
+        """Self-driving control plane
+        (:mod:`opentsdb_tpu.control.plane`), or None when disabled
+        (``tsd.control.enable = false``, the default). The server's
+        admission seam reads the raw ``_control`` attribute so an
+        uncontrolled TSD pays one attribute read per request."""
+        if not self.config.get_bool("tsd.control.enable", False):
+            return None
+        if self._control is None:
+            with self._device_cache_lock:
+                if self._control is None:
+                    from opentsdb_tpu.control.plane import \
+                        ControlPlane
+                    ctl = ControlPlane(self)
+                    self.stats.register(ctl)
+                    self._control = ctl
+        # outside the lock: wire() builds the lazy result_cache, which
+        # takes the same lock
+        self._control.wire()
+        return self._control
+
+    @property
     def query_fanout_pool(self):
         """Executor independent sub-queries of one TSQuery fan out
         onto (None = serial; ``tsd.query.fanout.workers``). See the
@@ -1221,6 +1247,10 @@ class TSDB:
                 self.wal.truncate(wal_seq)
 
     def shutdown(self) -> None:
+        # the control plane steers every other subsystem, so it stops
+        # FIRST — a tick must not race a registry/router teardown
+        if self._control is not None:
+            self._control.stop()
         self.telemetry.stop()
         self.profiler.stop()
         if self._cluster is not None:

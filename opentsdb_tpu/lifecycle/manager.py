@@ -138,6 +138,11 @@ class LifecycleManager:
             self.coldstore = ColdStore(
                 cold_dir, faults=getattr(tsdb, "faults", None),
                 uids=tsdb.uids, read_breaker=read_breaker)
+        # merge-compaction threshold: a (metric, tier) holding MORE
+        # than this many per-sweep segments gets them merged into one
+        # on the next sweep (0 = off)
+        self.cold_compact_segments = cfg.get_int(
+            "tsd.coldstore.compact_segments", 0)
         # the fifth stat column: per-cell quantile sketches of demoted
         # raw data (opentsdb_tpu/sketch/). Demotion folds the raw
         # points it purges into cells here; the spill moves cells into
@@ -328,7 +333,7 @@ class LifecycleManager:
             "purged": 0, "demoted": 0, "tierPointsWritten": 0,
             "bytesReclaimed": 0, "seriesReleased": 0, "metrics": 0,
             "spilled": 0, "histogramPurged": 0,
-            "histogramSpilled": 0,
+            "histogramSpilled": 0, "coldCompacted": 0,
         }
         # every sweep is a background trace root (the coldstore spill
         # records its own child span), so maintenance time shows up
@@ -429,6 +434,16 @@ class LifecycleManager:
                                                now_ms, report)
                     changed |= self._spill_histograms(
                         mid, metric, pol, now_ms, report)
+            # merge-compaction of accumulated per-sweep cold segments
+            # (runs under coldstore.write via the store, so an armed
+            # fault degrades it like a failed spill — loud, harmless)
+            if self.cold_compact_segments > 0 and \
+                    self.coldstore is not None:
+                merged = self.coldstore.compact_segments(
+                    metric, self.cold_compact_segments)
+                if merged:
+                    report["coldCompacted"] += merged
+                    changed = True
             # pack only COLD buffers (newest point behind the
             # metric's lifecycle horizon): packing a live tail just
             # buys an unpack copy on the next append

@@ -78,6 +78,7 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "cluster.gossip.push",   # cluster/gossip.py sibling push round
     "cluster.read_repair",   # cluster/router.py staged-hint drain
     "telemetry.pump",        # obs/telemetry.py self-stats ingest
+    "control.loop",          # control/plane.py one control tick
     # ingest stages
     "ingest.decode",         # body parse + validate + series grouping
     "store.scatter",         # columnar store appends (+ inline taps)

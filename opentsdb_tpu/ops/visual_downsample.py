@@ -298,6 +298,25 @@ def keep_mask(values2d: np.ndarray, emit2d: np.ndarray,
     raise ValueError(f"unknown pixel downsample fn {fn!r}")
 
 
+def reduce_dps(dps: list, start_ms: int, end_ms: int, pixels: int,
+               fn: str = DEFAULT_PIXEL_FN) -> list:
+    """Pixel-reduce an already-assembled ``[(ts_ms, value), ...]`` row
+    (percentile rows are emitted post-assembly, outside the ``[S, B]``
+    grids the serve path reduces) by running the same kernels over a
+    one-row grid. Returns the kept dps, original list when the budget
+    keeps everything."""
+    if pixels <= 0 or len(dps) <= 1:
+        return dps
+    ts = np.asarray([int(t) for t, _ in dps], dtype=np.int64)
+    vals = np.asarray([float(v) for _, v in dps], dtype=np.float64)
+    keep = keep_mask(vals[None, :], np.ones((1, len(dps)), dtype=bool),
+                     ts, start_ms, end_ms, pixels, fn)
+    if keep is None:
+        return dps
+    row = keep[0]
+    return [dp for i, dp in enumerate(dps) if row[i]]
+
+
 def naive_m4_reference(ts_ms: np.ndarray, vals: np.ndarray,
                        emit: np.ndarray, start_ms: int, end_ms: int,
                        pixels: int) -> set[int]:

@@ -40,7 +40,10 @@ from opentsdb_tpu.ops.pipeline import (PipelineSpec, execute,
                                        flatten_padded)
 from opentsdb_tpu.query import filters as filters_mod
 from opentsdb_tpu.query.limits import QueryLimitExceeded
-from opentsdb_tpu.query.model import BadRequestError, TSQuery, TSSubQuery
+from opentsdb_tpu.query.model import (BadRequestError, TSQuery,
+                                      TSSubQuery,
+                                      effective_pixels as
+                                      model_effective_pixels)
 from opentsdb_tpu.stats.stats import QueryStat, QueryStats
 from opentsdb_tpu.utils.faults import DegradedError
 
@@ -677,11 +680,20 @@ class QueryEngine:
                         "subsystem is disabled (tsd.sketch.enable)")
                 return sk_rows
             hist_rows = run_histogram_subquery(self.tsdb, tsq, sub)
-            if sk_rows is None:  # sketch path disabled
-                return hist_rows
-            # live arena rows + spilled/demoted sketch history splice
-            # by group (disjoint time windows)
-            return merge_pct_rows(hist_rows, sk_rows)
+            if sk_rows is not None:
+                # live arena rows + spilled/demoted sketch history
+                # splice by group (disjoint time windows)
+                hist_rows = merge_pct_rows(hist_rows, sk_rows)
+            # `_pct_<q>` rows are plain emitted rows once assembled, so
+            # the pixel budget applies post-assembly like every other
+            # producer (the router reduces merged partials itself)
+            px, pfn = model_effective_pixels(tsq, sub)
+            if px and not tsq.delete:
+                from opentsdb_tpu.ops.visual_downsample import reduce_dps
+                for row in hist_rows:
+                    row.dps = reduce_dps(row.dps, tsq.start_ms,
+                                         tsq.end_ms, px, pfn)
+            return hist_rows
         # planning stage span: tier selection, filter evaluation,
         # group construction (ended at every exit of the stage — an
         # unfinished handle on an error path simply isn't recorded;

@@ -103,12 +103,6 @@ class TSSubQuery:
                 "Missing the aggregation function")
         self.pixels = _validate_pixels(self.pixels, "pixels")
         self.pixel_fn = _validate_pixel_fn(self.pixel_fn, "pixelFn")
-        if self.pixels and self.percentiles:
-            # histogram percentile results bypass the grid-shaped
-            # result assembly the pixel reduction operates on
-            raise BadRequestError(
-                "pixels is not supported on histogram percentile "
-                "queries")
         try:
             self.agg = aggs_mod.get(self.aggregator)
         except KeyError as e:
@@ -250,10 +244,6 @@ class TSQuery:
         for i, sub in enumerate(self.queries):
             sub.index = i
             sub.validate(self.timezone, self.use_calendar)
-            if self.pixels and sub.percentiles:
-                raise BadRequestError(
-                    "pixels is not supported on histogram percentile "
-                    "queries")
         return self
 
     def dedupe_queries(self) -> "TSQuery":

@@ -194,6 +194,12 @@ class QueryResultCache:
         self.coalesced = 0
         self.evicted = 0
         self.bypasses = 0
+        self.gated = 0
+        # optional per-tenant insert gate (control-plane QoS): called
+        # with the entry's byte size before insertion; False = serve
+        # the result but don't retain it. Attached by the governor —
+        # None keeps the hot path at one attribute read.
+        self.insert_gate: Callable[[int], bool] | None = None
 
     # ------------------------------------------------------------------
 
@@ -256,6 +262,15 @@ class QueryResultCache:
         nbytes = results_nbytes(value)
         if nbytes > self._shard_budget:
             return  # bigger than a whole shard: don't thrash
+        gate = self.insert_gate
+        if gate is not None:
+            try:
+                admitted = gate(nbytes)
+            except Exception:  # tsdlint: allow[swallow] a broken tenant gate must degrade to plain caching, never fail the query that computed the value
+                admitted = True
+            if not admitted:
+                self._count("gated")
+                return  # over-budget tenant: serve, don't retain
         shard = self._shard(key)
         evicted = 0
         with shard.lock:
@@ -394,6 +409,7 @@ class QueryResultCache:
                          self.coalesced)
         collector.record(f"{self.stat_prefix}.evicted", self.evicted)
         collector.record(f"{self.stat_prefix}.bypasses", self.bypasses)
+        collector.record(f"{self.stat_prefix}.gated", self.gated)
 
     def health_info(self) -> dict[str, Any]:
         return {

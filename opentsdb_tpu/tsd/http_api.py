@@ -184,6 +184,7 @@ class HttpRpcRouter:
             "aggregators": self._handle_aggregators,
             "cluster": self._handle_cluster,
             "config": self._handle_config,
+            "control": self._handle_control,
             "dropcaches": self._handle_dropcaches,
             "health": self._handle_health,
             "lifecycle": self._handle_lifecycle,
@@ -790,6 +791,16 @@ class HttpRpcRouter:
                 pixels=px,
                 start=tsq.start_ms, end=tsq.end_ms,
                 delete=bool(tsq.delete))
+            try:
+                # canonical CQ-candidate tag: the shape log line the
+                # control plane's miner groups on (control/shapes.py);
+                # None (untaggable shape) is simply not logged
+                from opentsdb_tpu.control.shapes import cq_candidate
+                cand = cq_candidate(tsq)
+                if cand:
+                    tctx.tag(cq=cand)
+            except Exception:  # tsdlint: allow[swallow] shape tagging feeds the miner; a derivation bug must not fail the query it describes
+                pass
         streamed = False
         cluster = self.tsdb.cluster
         wire_sink = getattr(request, "wire_sink", None)
@@ -945,7 +956,25 @@ class HttpRpcRouter:
                             "set tsd.streaming.enable = true")
         if not rest:
             if request.method == "POST":
+                ctl = self.tsdb._control
+                tenant = None
+                if ctl is not None and ctl.qos.enabled:
+                    # per-tenant fold-memory budget: standing rings
+                    # are the one resource a tenant holds FOREVER, so
+                    # the quota gates registration, not serving
+                    tenant = ctl.qos.tenant_of(request.headers)
+                    if not ctl.qos.fold_budget_allows(tenant,
+                                                      registry):
+                        raise HttpError(
+                            400, "tenant fold-memory budget "
+                            "exhausted",
+                            f"tenant {tenant!r} already holds "
+                            "tsd.control.qos.tenant_fold_mb of "
+                            "standing continuous-query state; "
+                            "delete one or raise the budget")
                 cq = registry.register(request.json_object())
+                if tenant is not None:
+                    cq.tenant = tenant
                 return HttpResponse(
                     200, json.dumps(cq.describe()).encode())
             if request.method == "GET":
@@ -1582,6 +1611,15 @@ class HttpRpcRouter:
                 cluster.fleet_stats()).encode())
         if sub == "query_shapes":
             return self._handle_query_shapes(request)
+        if sub == "tenants":
+            # per-tenant admission/SLO attribution (control-plane
+            # QoS); the raw attribute — stats must not instantiate
+            # the control plane just to report it absent
+            ctl = getattr(self.tsdb, "_control", None)
+            doc = ctl.qos.describe() if ctl is not None else {
+                "enabled": self.tsdb.config.get_bool(
+                    "tsd.control.qos.enable", False)}
+            return HttpResponse(200, json.dumps(doc).encode())
         if sub == "jvm":
             return HttpResponse(200, json.dumps(
                 self._runtime_stats()).encode())
@@ -1849,6 +1887,61 @@ class HttpRpcRouter:
             return HttpResponse(200, json.dumps(lc.describe()).encode())
         raise HttpError(405, "Method not allowed")
 
+    def _handle_control(self, request: HttpRequest, rest
+                        ) -> HttpResponse:
+        """Self-driving control plane
+        (:mod:`opentsdb_tpu.control`):
+
+        - ``GET /api/control`` — loop + per-actuator summary
+          (breaker state, materialization counts, tenant table,
+          placement knobs);
+        - ``GET /api/control/materialized`` — the standing
+          auto-materialized continuous queries with scores and serve
+          hits;
+        - ``GET /api/control/plan`` — the current placement
+          assessment (per-shard loads, hot shards, proposed ring
+          spec + planId);
+        - ``POST /api/control/plan`` — confirm the standing proposal
+          (body: ``{"planId": "..."}``); executes through the
+          existing reshard machinery, 400 on a stale or missing
+          planId. With ``tsd.control.placement.auto = true`` the loop
+          confirms its own plans and this endpoint is only needed for
+          out-of-band pushes;
+        - ``POST /api/control/tick`` — run one control tick
+          synchronously and return its report (operators and tests;
+          the background loop runs on ``tsd.control.interval_s``)."""
+        ctl = self.tsdb.control
+        if ctl is None:
+            raise HttpError(400, "The control plane is disabled",
+                            "set tsd.control.enable = true")
+        sub = rest[0] if rest else ""
+        if sub == "materialized":
+            if request.method != "GET":
+                raise HttpError(405, "Method not allowed")
+            return HttpResponse(200, json.dumps(
+                ctl.materialized_info()).encode())
+        if sub == "plan":
+            if request.method == "GET":
+                return HttpResponse(200, json.dumps(
+                    ctl.plan_info()).encode())
+            if request.method == "POST":
+                obj = request.json_object(default={})
+                result = ctl.apply_plan(str(obj.get("planId", "")))
+                return HttpResponse(200,
+                                    json.dumps(result).encode())
+            raise HttpError(405, "Method not allowed")
+        if sub == "tick":
+            if request.method != "POST":
+                raise HttpError(405, "Method not allowed",
+                                "POST runs one control tick")
+            return HttpResponse(200, json.dumps(ctl.tick()).encode())
+        if rest:
+            raise HttpError(404, f"Endpoint not found: "
+                            f"/api/control/{sub}")
+        if request.method != "GET":
+            raise HttpError(405, "Method not allowed")
+        return HttpResponse(200, json.dumps(ctl.describe()).encode())
+
     def _handle_health(self, request: HttpRequest, rest) -> HttpResponse:
         """Operator-facing degradation report (``/api/health``): WAL
         durability lag + degraded flag, circuit-breaker states,
@@ -1958,6 +2051,19 @@ class HttpRpcRouter:
         else:
             cluster_info = {"role": t.config.get_string(
                 "tsd.cluster.role", "") or "standalone"}
+        # the raw attribute: health must not instantiate the control
+        # plane just to report it absent
+        ctl = getattr(t, "_control", None)
+        if ctl is not None:
+            control_info = ctl.describe()
+            breakers[ctl.breaker.name] = ctl.breaker.health_info()
+            if ctl.breaker.state != ctl.breaker.CLOSED:
+                # the loop is parked; the data plane keeps serving on
+                # the last computed penalties and materializations
+                causes.append(f"breaker:{ctl.breaker.name}")
+        else:
+            control_info = {"enabled": t.config.get_bool(
+                "tsd.control.enable", False)}
         hook_errors = dict(getattr(t, "hook_errors", {}))
         doc: dict[str, Any] = {
             "status": "degraded" if causes else "ok",
@@ -1996,6 +2102,9 @@ class HttpRpcRouter:
             # sharded cluster tier: per-peer breaker/spool state,
             # degraded-query and handoff counters (router role only)
             "cluster": cluster_info,
+            # self-driving control plane: loop/breaker state, standing
+            # materializations, tenant shares, placement plan counters
+            "control": control_info,
             "hook_errors": hook_errors,
         }
         server = self.server

@@ -379,6 +379,13 @@ class TSDServer:
         streaming = self.tsdb.streaming
         if streaming is not None and streaming.workers.enabled:
             streaming.workers.start()
+        # self-driving control plane (opentsdb_tpu/control/): shape
+        # mining, tenant QoS refresh, placement assessment on one
+        # background loop. No-op unless tsd.control.enable; stopped
+        # FIRST by TSDB.shutdown (it steers the other subsystems).
+        control = self.tsdb.control
+        if control is not None:
+            control.start()
         # self-telemetry pump (obs/telemetry.py): no-op unless
         # tsd.stats.self_interval > 0. Stopped by TSDB.shutdown.
         self.tsdb.telemetry.start()
@@ -697,8 +704,28 @@ class TSDServer:
                     request.auth = auth_state
                 is_query = _is_query_path(
                     urllib.parse.unquote(parsed.path))
+                # tenant identity rides the admission seam: the raw
+                # _control read keeps the uncontrolled TSD at one
+                # attribute load per request (streaming-tap idiom)
+                ctl = self.tsdb._control
+                governor = ctl.qos if ctl is not None else None
+                tenant = None
+                if is_query and governor is not None:
+                    try:
+                        tenant = governor.tenant_of(headers)
+                    except Exception:  # tsdlint: allow[swallow] identity extraction can never refuse a query; the request rides untenanted
+                        tenant = None
                 shed_cause = self.admission.try_admit(
                     self.query_queue_depth()) if is_query else None
+                if shed_cause is None and tenant is not None:
+                    # weighted fair share of the SAME in-flight
+                    # budget: one tenant at its share sheds (cause
+                    # "tenant") while under-share tenants admit
+                    try:
+                        shed_cause = governor.try_admit(
+                            tenant, self.admission.max_inflight)
+                    except Exception:  # tsdlint: allow[swallow] QoS bookkeeping must degrade to plain global admission, never to a 500
+                        shed_cause = None
                 if shed_cause is not None:
                     response = self._overload_response(shed_cause)
                     LOG.warning("shedding query %s (%s; %d in flight)",
@@ -710,11 +737,22 @@ class TSDServer:
                         # not the response: a 504'd query still holds
                         # its thread (see AdmissionController)
                         self.admission.started()
+                        if tenant is not None:
+                            governor.started(tenant)
 
-                        def tracked(req=request):
+                        def tracked(req=request, _tenant=tenant,
+                                    _gov=governor):
+                            if _tenant is not None:
+                                # bound for the worker's duration so
+                                # the result-cache insert gate can
+                                # bill bytes to the right tenant
+                                _gov.bind(_tenant)
                             try:
                                 return self.http_router.handle(req)
                             finally:
+                                if _tenant is not None:
+                                    _gov.unbind()
+                                    _gov.finished(_tenant)
                                 self.admission.finished()
 
                         fut = asyncio.get_event_loop() \
@@ -759,6 +797,14 @@ class TSDServer:
                 if slo.enabled and (is_query or is_put):
                     slo.record("query" if is_query else "put",
                                elapsed_ms, response.status >= 500)
+                if tenant is not None:
+                    # per-tenant SLO burn attribution — the control
+                    # loop's QoS tick turns this into shed priority
+                    try:
+                        governor.record(tenant, elapsed_ms,
+                                        response.status >= 500)
+                    except Exception:  # tsdlint: allow[swallow] attribution is observability; a broken governor must not fail a served response
+                        pass
             self._apply_cors(request, response)
             await self._apply_gzip(request, response)
             if getattr(response, "close_connection", False):
@@ -784,6 +830,7 @@ class TSDServer:
         message = {
             "inflight": "too many in-flight queries",
             "queue": "query queue is full",
+            "tenant": "tenant is over its fair in-flight share",
         }.get(cause, cause)
         body = json.dumps({"error": {
             "code": 503,

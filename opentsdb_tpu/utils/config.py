@@ -393,8 +393,29 @@ _DECLARED_EXTRA: frozenset[str] = frozenset({
     # cold tier (opentsdb_tpu/coldstore/)
     "tsd.coldstore.breaker.failure_threshold",
     "tsd.coldstore.breaker.reset_timeout_ms",
+    "tsd.coldstore.compact_segments",
     "tsd.coldstore.dir",
     "tsd.coldstore.enable",
+    # control plane (opentsdb_tpu/control/)
+    "tsd.control.enable",
+    "tsd.control.interval_s",
+    "tsd.control.breaker.failure_threshold",
+    "tsd.control.breaker.reset_timeout_ms",
+    "tsd.control.materialize.enable",
+    "tsd.control.materialize.max",
+    "tsd.control.materialize.min_score",
+    "tsd.control.materialize.hysteresis",
+    "tsd.control.tenant.tag",
+    "tsd.control.tenant.header",
+    "tsd.control.qos.enable",
+    "tsd.control.qos.weights",
+    "tsd.control.qos.max_tenants",
+    "tsd.control.qos.burn_penalty",
+    "tsd.control.qos.tenant_cache_mb",
+    "tsd.control.qos.tenant_fold_mb",
+    "tsd.control.placement.enable",
+    "tsd.control.placement.auto",
+    "tsd.control.placement.hot_ratio",
     # auth / plugins / server
     "tsd.core.authentication.roles",
     "tsd.core.authentication.users",

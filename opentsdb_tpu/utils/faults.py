@@ -77,6 +77,9 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "cluster.retire",     # cluster/retire.py stale-copy delete step
     "cluster.gossip",     # cluster/gossip.py sibling-router push
     "cluster.wire",       # cluster/wire.py router-side wire exchange
+    "control.materialize",  # control/plane.py shape-miner actuator
+    "control.qos",        # control/plane.py tenant-share recompute
+    "control.placement",  # control/plane.py placement planner
 })
 
 # site families with runtime-named tails (per-peer arming)

@@ -973,3 +973,173 @@ class TestPartialSegmentTrim:
         assert [e["file"] for e in
                 cold2._metrics["sys.cpu"]["segments"]] == \
             [e["file"] for e in seg1]
+
+
+# ---------------------------------------------------------------------------
+# merge-compaction of accumulated per-sweep segments
+# ---------------------------------------------------------------------------
+
+class TestColdCompaction:
+    """`tsd.coldstore.compact_segments`: a (metric, tier) group that
+    accumulates MORE than the threshold per-sweep segments merges into
+    one under the delete-rewrite crash ordering — replacement durable
+    and manifest committed before the old files unlink, so a crash at
+    any point leaves fsck-visible orphans, never a
+    referenced-but-missing segment."""
+
+    Q = {"metric": "sys.cpu", "aggregator": "sum",
+         "downsample": "1m-sum"}
+
+    def _pair(self, tmp_path, threshold="2"):
+        t0 = TSDB(_cfg(tmp_path, lifecycle=False))
+        t1 = TSDB(_cfg(tmp_path, **{
+            "tsd.coldstore.compact_segments": threshold}))
+        ts = np.arange(BASE, BASE + SPAN_S, 1, dtype=np.int64)
+        rng = np.random.default_rng(7)
+        for i in range(3):
+            vals = rng.normal(100, 10, SPAN_S)
+            for t in (t0, t1):
+                t.add_points("sys.cpu", ts, vals,
+                             {"host": f"h{i:02d}"})
+        return t0, t1
+
+    def _segments(self, t1):
+        cold = t1.lifecycle.coldstore
+        return [e for e in cold._metrics["sys.cpu"]["segments"]
+                if e["interval"] == "1m"]
+
+    def _accumulate(self, t1, sweeps=3):
+        """Each successive sweep spills the next 30m that aged past
+        the spill boundary — one new segment per sweep."""
+        for k in range(sweeps):
+            rep = t1.lifecycle.sweep(now_ms=NOW_MS + k * 1800_000)
+            assert "error" not in rep, rep
+        return rep
+
+    def test_sweep_compacts_and_serving_is_identical(self, tmp_path):
+        t0, t1 = self._pair(tmp_path, threshold="2")
+        want = _dps(_query(t0, self.Q))
+        rep1 = t1.lifecycle.sweep(now_ms=NOW_MS)
+        assert rep1["spilled"] > 0 and rep1["coldCompacted"] == 0
+        rep2 = t1.lifecycle.sweep(now_ms=NOW_MS + 1800_000)
+        # two accumulated segments == threshold: not yet compacted
+        assert rep2["coldCompacted"] == 0
+        assert len(self._segments(t1)) == 2
+        rep3 = t1.lifecycle.sweep(now_ms=NOW_MS + 3600_000)
+        # the third per-sweep segment tips the group: 3 -> 1
+        assert rep3["coldCompacted"] == 2
+        segs = self._segments(t1)
+        assert len(segs) == 1
+        assert t1.lifecycle.coldstore.segments_compacted == 2
+        # the merged segment spans the union of its inputs
+        assert segs[0]["start_ms"] == BASE_MS
+        _assert_identical(_dps(_query(t1, self.Q)), want)
+        # windowed reads cross former segment seams
+        got = _dps(_query(t1, self.Q, start=SPILL_B - 1800_000,
+                          end=SPILL_B + 600_000))
+        sub = {k: {ts: v for ts, v in d.items()
+                   if SPILL_B - 1800_000 <= ts <= SPILL_B + 600_000}
+               for k, d in want.items()}
+        _assert_identical(got, sub)
+        from opentsdb_tpu.tools.fsck import run_fsck
+        report = run_fsck(t1)
+        assert not any("not in manifest" in ln
+                       for ln in report.lines), report.lines
+        # restart: the compacted manifest persisted
+        t2 = TSDB(_cfg(tmp_path, **{
+            "tsd.coldstore.compact_segments": "2"}))
+        assert [e["file"] for e in self._segments(t2)] \
+            == [e["file"] for e in segs]
+
+    def test_crash_before_manifest_commit_orphans_only(
+            self, tmp_path, monkeypatch):
+        """Replacement written, manifest commit dies: the on-disk
+        manifest still references every ORIGINAL segment (all
+        present), the merged replacement is an fsck-visible orphan,
+        and serving is unchanged."""
+        from opentsdb_tpu.tools.fsck import run_fsck
+        _, t1 = self._pair(tmp_path, threshold="0")
+        self._accumulate(t1)
+        cold = t1.lifecycle.coldstore
+        before = [e["file"] for e in self._segments(t1)]
+        assert len(before) == 3
+        served = _dps(_query(t1, self.Q))
+
+        def boom():
+            raise RuntimeError("injected: crash before commit")
+
+        monkeypatch.setattr(cold, "_save_manifest_locked", boom)
+        with pytest.raises(RuntimeError):
+            cold.compact_segments("sys.cpu", 2)
+        monkeypatch.undo()
+        # "restart": reload the durable manifest state
+        cold._load_manifest()
+        cold._handle_cache.clear()
+        after = [e["file"] for e in self._segments(t1)]
+        assert after == before
+        # every referenced file exists — never referenced-but-missing
+        for name in after:
+            assert os.path.exists(
+                os.path.join(cold.directory, name))
+        _assert_identical(_dps(_query(t1, self.Q)), served)
+        report = run_fsck(t1)
+        assert any("not in manifest" in ln for ln in report.lines), \
+            report.lines
+        report = run_fsck(t1, fix=True)
+        assert report.fixed > 0
+
+    def test_crash_during_unlink_orphans_only(self, tmp_path,
+                                              monkeypatch):
+        """Manifest committed, unlink dies: the old inputs linger as
+        fsck-visible orphans while reads serve the merged segment."""
+        from opentsdb_tpu.coldstore import store as store_mod
+        from opentsdb_tpu.tools.fsck import run_fsck
+        t0, t1 = self._pair(tmp_path, threshold="0")
+        want = _dps(_query(t0, self.Q))
+        self._accumulate(t1)
+        cold = t1.lifecycle.coldstore
+        before = [e["file"] for e in self._segments(t1)]
+
+        def no_unlink(path):
+            raise OSError("injected: crash during unlink")
+
+        monkeypatch.setattr(store_mod.os, "unlink", no_unlink)
+        assert cold.compact_segments("sys.cpu", 2) == 2
+        monkeypatch.undo()
+        segs = self._segments(t1)
+        assert len(segs) == 1 and segs[0]["file"] not in before
+        # the de-referenced inputs are still on disk: orphans
+        for name in before:
+            assert os.path.exists(
+                os.path.join(cold.directory, name))
+        _assert_identical(_dps(_query(t1, self.Q)), want)
+        report = run_fsck(t1)
+        orphans = [ln for ln in report.lines
+                   if "not in manifest" in ln]
+        assert len(orphans) >= len(before), report.lines
+        report = run_fsck(t1, fix=True)
+        assert report.fixed >= len(before)
+        _assert_identical(_dps(_query(t1, self.Q)), want)
+
+    def test_armed_write_fault_leaves_group_untouched(self, tmp_path):
+        from opentsdb_tpu.utils.faults import InjectedFault
+        _, t1 = self._pair(tmp_path, threshold="0")
+        self._accumulate(t1)
+        before = [e["file"] for e in self._segments(t1)]
+        served = _dps(_query(t1, self.Q))
+        t1.faults.arm("coldstore.write", error_rate=1.0)
+        with pytest.raises(InjectedFault):
+            t1.lifecycle.coldstore.compact_segments("sys.cpu", 2)
+        t1.faults.disarm()
+        assert [e["file"] for e in self._segments(t1)] == before
+        _assert_identical(_dps(_query(t1, self.Q)), served)
+
+    def test_threshold_gating(self, tmp_path):
+        _, t1 = self._pair(tmp_path, threshold="0")
+        self._accumulate(t1)
+        cold = t1.lifecycle.coldstore
+        # disabled (<=0) and not-exceeded thresholds are no-ops
+        assert cold.compact_segments("sys.cpu", 0) == 0
+        assert cold.compact_segments("sys.cpu", 3) == 0
+        assert cold.compact_segments("no.such.metric", 1) == 0
+        assert len(self._segments(t1)) == 3

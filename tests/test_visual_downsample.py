@@ -356,13 +356,16 @@ class Test400Matrix:
         with pytest.raises(BadRequestError):
             q.validate()
 
-    def test_percentiles_reject_pixels(self):
+    def test_percentiles_accept_pixels(self):
+        """The former percentiles+pixels 400 is LIFTED: ``_pct_<q>``
+        rows are plain emitted rows after assembly, so the pixel
+        budget applies post-assembly like every other producer."""
         q = TSQuery.from_json({
             "start": BASE_MS, "end": BASE_MS + 1000, "pixels": 100,
             "queries": [{"metric": "m", "aggregator": "sum",
                          "percentiles": [99.0]}]})
-        with pytest.raises(BadRequestError):
-            q.validate()
+        q.validate()
+        assert effective_pixels(q, q.queries[0])[0] == 100
 
     def test_uri_query_carries_pixels(self):
         tsq = parse_uri_query({"start": [str(BASE_MS)],
@@ -377,6 +380,79 @@ class Test400Matrix:
                                "m": ["sum:m", "sum:m"]})
         tsq.queries[1].pixels = 99
         assert len(tsq.dedupe_queries().queries) == 2
+
+
+class TestPercentilePixels:
+    """The lifted 400: percentile rows reduce post-assembly."""
+
+    def _hist_tsdb(self):
+        from opentsdb_tpu.core.histogram import SimpleHistogram
+        t = _tsdb()
+        for i in range(600):
+            h = SimpleHistogram([0.0, 10.0, 20.0, 30.0])
+            h.counts = [10 + (i % 7), i % 5, i % 3]
+            blob = t.histogram_manager.encode(h)
+            t.add_histogram_point("pp.lat", BASE + i * 10, blob,
+                                  {"host": "a"})
+        return t
+
+    def _q(self, px=0):
+        obj = {"start": BASE_MS,
+               "end": BASE_MS + 600 * 10_000,
+               "queries": [{"metric": "pp.lat", "aggregator": "sum",
+                            "percentiles": [50.0, 95.0]}]}
+        if px:
+            obj["pixels"] = px
+        return obj
+
+    def test_budget_applies_post_assembly(self):
+        t = self._hist_tsdb()
+        try:
+            full = t.execute_query(
+                TSQuery.from_json(self._q()).validate())
+            red = t.execute_query(
+                TSQuery.from_json(self._q(px=50)).validate())
+            assert {r.metric for r in full} \
+                == {"pp.lat_pct_50", "pp.lat_pct_95"}
+            fbym = {r.metric: dict(r.dps) for r in full}
+            assert all(len(d) == 600 for d in fbym.values())
+            for r in red:
+                fd = fbym[r.metric]
+                rd = dict(r.dps)
+                # M4 budget: <= 4 points per pixel column, and the
+                # kept points are a value-faithful subset
+                assert 1 < len(rd) <= 4 * 50
+                assert set(rd).issubset(fd)
+                assert all(rd[k] == fd[k] for k in rd)
+                # extremes survive reduction
+                assert max(rd.values()) == max(fd.values())
+                assert min(rd.values()) == min(fd.values())
+        finally:
+            t.shutdown()
+
+    def test_under_budget_is_identity(self):
+        t = self._hist_tsdb()
+        try:
+            full = t.execute_query(
+                TSQuery.from_json(self._q()).validate())
+            red = t.execute_query(
+                TSQuery.from_json(self._q(px=60000)).validate())
+            assert [dict(r.dps) for r in red] \
+                == [dict(r.dps) for r in full]
+        finally:
+            t.shutdown()
+
+    def test_reduce_dps_unit(self):
+        # the one-row shim over keep_mask used by the percentile path
+        dps = [(BASE_MS + i * 1000, float((i * 7) % 23))
+               for i in range(500)]
+        kept = vd.reduce_dps(dps, BASE_MS, BASE_MS + 500_000, 40)
+        assert 1 < len(kept) <= 4 * 40
+        assert set(kept).issubset(set(dps))
+        assert vd.reduce_dps(dps, BASE_MS, BASE_MS + 500_000, 0) \
+            is dps
+        assert vd.reduce_dps([dps[0]], BASE_MS, BASE_MS + 500_000,
+                             10) == [dps[0]]
 
 
 class TestStreamingPixels:
