@@ -2410,6 +2410,147 @@ def bench_control(repeats: int, n_series: int = 48,
     return out
 
 
+def bench_eventtime(repeats: int, n_users: int = 1_000_000,
+                    n_sample: int = 20_000) -> dict:
+    """Event-time layer at user scale: one session CQ keyed by a
+    ``user`` tag with 1M distinct values (1M concurrent sessions in
+    ONE columnar partial). (1) ingest tax — per-point write+fold
+    throughput with the session CQ standing vs a zero-CQ control
+    over the same 1M-series store, criterion <= 1.5x; (2) gap-close
+    throughput — the completeness marker's watermark-driven
+    open/closed sweep over all 1M session rows (one vectorized
+    pass); (3) late-refold cost — folding an in-lateness batch into
+    already-published buckets vs an equal at-the-front batch.
+
+    Folds are timed deterministically on this thread (workers off,
+    drain via the registry's own ``_drain_group``, no publish): the
+    tap+fold pair IS the write-path cost a standing CQ adds — SSE
+    publish is subscriber-driven and benched in ``live``."""
+    from opentsdb_tpu import TSDB, Config
+    from opentsdb_tpu.streaming.eventtime.watermark import (
+        completeness_marker)
+
+    end_ms = BASE_MS + 1800 * 1000
+
+    def _mk():
+        return TSDB(Config(**{
+            "tsd.core.auto_create_metrics": "true",
+            "tsd.tpu.warmup": "false",
+            "tsd.streaming.workers.count": "0",
+            "tsd.streaming.buffer_points": str(1 << 30),
+            "tsd.streaming.workers.max_pending_points":
+                str(1 << 30)}))
+
+    def _drain(t):
+        for g in t.streaming._partials:
+            t.streaming._drain_group(g)
+
+    def _preingest(t):
+        # one point per user, event time swept monotonically across
+        # 0..24m so the per-pass watermark commit never declares the
+        # bulk late; drained every 100k to bound the pending buffer
+        t0 = time.perf_counter()
+        for u in range(n_users):
+            t.add_point("evt.sess", BASE_S + (u * 1440) // n_users,
+                        1.0, {"user": f"u{u:07d}"})
+            if (u + 1) % 100_000 == 0:
+                _drain(t)
+        _drain(t)
+        return time.perf_counter() - t0
+
+    # sampled follow-up traffic: 20k distinct already-admitted users
+    # (steady-state fold, no admission cost), event times at the
+    # 25..30m front edge so nothing is late on first contact
+    stride = max(n_users // n_sample, 1)
+    sample_users = [f"u{(i * stride) % n_users:07d}"
+                    for i in range(n_sample)]
+    sample_ts = [BASE_S + 1500 + (i * 280) // n_sample
+                 for i in range(n_sample)]
+
+    def _ingest_pass(t) -> float:
+        t0 = time.perf_counter()
+        for u, ts in zip(sample_users, sample_ts):
+            t.add_point("evt.sess", ts, 2.0, {"user": u})
+        _drain(t)
+        return time.perf_counter() - t0
+
+    # --- zero-CQ control: same 1M-series store, no streaming tap
+    t = _mk()
+    setup_zero_s = _preingest(t)
+    zero_s = min(_ingest_pass(t) for _ in range(max(repeats, 3)))
+    t.shutdown()
+
+    # --- session-CQ arm: register FIRST so every pre-ingest point
+    # rides the live tap+fold path (1M admissions into user rows)
+    t = _mk()
+    cq = t.streaming.register(
+        {"start": BASE_MS, "end": end_ms, "queries": [
+            {"metric": "evt.sess", "aggregator": "none",
+             "downsample": "1m-sum"}],
+         "window": {"type": "session", "gap": "2m", "by": "user"},
+         "watermark": {"allowedLateness": "5m"}},
+        now_ms=end_ms)
+    setup_cq_s = _preingest(t)
+    cq_s = min(_ingest_pass(t) for _ in range(max(repeats, 3)))
+    tax = cq_s / max(zero_s, 1e-9)
+
+    part = t.streaming._partials[0]
+    assert len(part._sids) == n_users, len(part._sids)
+
+    # --- gap-close throughput: the marker's watermark sweep closes
+    # sessions whose last bucket the watermark passed by > gap —
+    # one vectorized pass over all 1M rows per pull
+    marker = None
+    sweep = []
+    for _ in range(max(repeats, 5)):
+        t0 = time.perf_counter()
+        marker = completeness_marker(t.streaming, cq, end_ms)
+        sweep.append(time.perf_counter() - t0)
+    sweep_p50 = _percentile(sweep, 50)
+    assert marker["sessionsClosed"] > n_users // 2, marker
+    assert marker["sessionsOpen"] > 0, marker
+
+    # --- late-refold cost: equal batches folded at the front edge
+    # vs 4.5m behind the watermark (inside the 5m lateness horizon,
+    # landing in already-published buckets)
+    def _fold_batch(off_s: int) -> float:
+        for i, u in enumerate(sample_users):
+            t.add_point("evt.sess", BASE_S + off_s + i % 60, 3.0,
+                        {"user": u})
+        t0 = time.perf_counter()
+        _drain(t)
+        return time.perf_counter() - t0
+
+    live_s = min(_fold_batch(1740) for _ in range(max(repeats, 3)))
+    refold_before = part.late_refolded
+    late_s = min(_fold_batch(1500) for _ in range(max(repeats, 3)))
+    late_refolded = part.late_refolded - refold_before
+    assert late_refolded > 0, "late batch never hit the refold path"
+    t.shutdown()
+
+    return {
+        "config": "eventtime",
+        "users": n_users,
+        "sample_points": n_sample,
+        "setup_zero_s": round(setup_zero_s, 1),
+        "setup_cq_s": round(setup_cq_s, 1),
+        "zero_cq_kpps": round(n_sample / zero_s / 1e3, 1),
+        "session_cq_kpps": round(n_sample / cq_s / 1e3, 1),
+        "ingest_tax": round(tax, 2),
+        "gap_close_p50_ms": round(sweep_p50 * 1e3, 1),
+        "gap_close_msessions_per_s": round(
+            n_users / max(sweep_p50, 1e-9) / 1e6, 1),
+        "sessions_open": marker["sessionsOpen"],
+        "sessions_closed": marker["sessionsClosed"],
+        "live_fold_us_per_point": round(live_s / n_sample * 1e6, 2),
+        "late_refold_us_per_point": round(
+            late_s / n_sample * 1e6, 2),
+        "late_refold_ratio": round(late_s / max(live_s, 1e-9), 2),
+        "late_refolded_points": int(late_refolded),
+        "criterion_pass": bool(tax <= 1.5),
+    }
+
+
 def _serializer():
     from opentsdb_tpu.tsd.json_serializer import HttpJsonSerializer
     return HttpJsonSerializer()
@@ -2440,7 +2581,8 @@ def main() -> None:
                "cluster_rf": bench_cluster_rf,
                "multirouter": bench_multirouter,
                "streamv2": bench_streamv2, "obs": bench_obs,
-               "obs2": bench_obs2, "control": bench_control}
+               "obs2": bench_obs2, "control": bench_control,
+               "eventtime": bench_eventtime}
     out = []
     for c in ((int(x) if x.isdigit() else x)
               for x in args.configs.split(",")):

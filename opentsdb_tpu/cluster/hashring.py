@@ -18,6 +18,8 @@ from __future__ import annotations
 import bisect
 import hashlib
 
+import numpy as np
+
 
 def _hash64(data: bytes) -> int:
     return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
@@ -63,6 +65,10 @@ class HashRing:
         # tsdlint: allow[unbounded-growth] keyspace is (vnode segment,
         # rf) — at most names*vnodes*rf entries, fixed at construction
         self._sets_cache: dict[int, tuple] = {}
+        self._points_arr = np.asarray(self._points, dtype=np.uint64)
+        # (rf, vnode idx) -> replica tuple, same bound as _sets_cache
+        # tsdlint: allow[unbounded-growth] keyspace fixed at construction
+        self._walk_cache: dict[tuple[int, int], tuple[str, ...]] = {}
 
     def _walk(self, idx: int, rf: int) -> tuple[str, ...]:
         """Ordered next-``rf``-distinct owners clockwise from vnode
@@ -87,6 +93,31 @@ class HashRing:
         if idx == len(self._points):
             idx = 0  # wrap: the ring is circular
         return self._walk(idx, rf)
+
+    def shards_for_keys(self, keys: list[bytes], rf: int = 1
+                        ) -> list[tuple[str, ...]]:
+        """Batched :meth:`shards_for_key`: one ``searchsorted`` over
+        the vnode array for the whole batch instead of a bisect per
+        key, with the clockwise walk memoized per (rf, segment) —
+        there are only ``names*vnodes`` segments, so a large put
+        batch's walks collapse to dict hits."""
+        rf = max(1, min(int(rf), len(self.names)))
+        if not keys:
+            return []
+        hs = np.fromiter((_hash64(k) for k in keys),
+                         dtype=np.uint64, count=len(keys))
+        idxs = np.searchsorted(self._points_arr, hs, side="right")
+        idxs[idxs == len(self._points)] = 0  # wrap: ring is circular
+        out: list[tuple[str, ...]] = []
+        cache = self._walk_cache
+        for idx in idxs.tolist():
+            ck = (rf, idx)
+            owners = cache.get(ck)
+            if owners is None:
+                owners = self._walk(idx, rf)
+                cache[ck] = owners
+            out.append(owners)
+        return out
 
     def shard_for_key(self, key: bytes) -> str:
         """Owning (primary) shard of one pre-computed series key."""

@@ -45,11 +45,13 @@ from opentsdb_tpu.obs.trace import (TRACE_HEADER, trace_begin,
                                     trace_end)
 from opentsdb_tpu.cluster.client import (PeerClient, PeerError,
                                          parse_peer_spec)
-from opentsdb_tpu.cluster.hashring import HashRing
+from opentsdb_tpu.cluster.hashring import HashRing, series_shard_key
 from opentsdb_tpu.cluster.reshard import (HORIZON_MS, Backfiller,
                                           ReshardState)
 from opentsdb_tpu.cluster.spool import PeerSpool, SpoolFull
-from opentsdb_tpu.core.tags import check_metric_and_tags, parse_put_value
+from opentsdb_tpu.core.tags import (check_metric_and_tags,
+                                    check_metric_and_tags_batch,
+                                    parse_put_value)
 from opentsdb_tpu.query.model import BadRequestError
 from opentsdb_tpu.utils.faults import (CircuitBreaker, DegradedError,
                                        RetryPolicy, call_with_retries)
@@ -225,6 +227,10 @@ class ClusterRouter:
         # binary columnar wire transport (cluster/wire.py): persistent
         # framed links per peer, JSON HTTP as negotiated fallback
         self.wire = wire_mod.WireManager(self)
+        # federated continuous queries (cluster/cq.py): per-shard
+        # shared partials, router-held merge view
+        from opentsdb_tpu.cluster.cq import FederatedCQRegistry
+        self.cqs = FederatedCQRegistry(self)
         # per-sub retry amplification bound: a multi-sub 400 re-asks
         # per rejected metric — cap how many of those singles run
         # concurrently against ONE peer so a wide dashboard query
@@ -366,6 +372,7 @@ class ClusterRouter:
         self.pool.shutdown(wait=False)
         for peer in self.peers.values():
             peer.spool.close()
+        self.cqs.close()
         self.wire.close_all()
 
     # ------------------------------------------------------------------
@@ -668,10 +675,12 @@ class ClusterRouter:
         accept sets cannot drift. Checks keep the scalar loop's
         precedence per point (timestamp, then metric/tags, then
         value), but the timestamp range check runs as ONE vectorized
-        pass over the numeric common case, and metric/tag validation
-        plus ring ownership are memoized per series within the call —
-        a bulk put of many points on few series hashes the ring once
-        per series, not once per point."""
+        pass over the numeric common case, metric/tag validation runs
+        as one columnar charset pass over the batch's distinct series
+        (``check_metric_and_tags_batch``), and ring ownership resolves
+        through one ``searchsorted`` over all series keys — a bulk put
+        of many points on few series hashes the ring once per series,
+        not once per point."""
         n = len(points)
         # index -> error entry; None = accepted (or still undecided).
         # Assembling errors from this at the end preserves the scalar
@@ -724,8 +733,41 @@ class ClusterRouter:
                 ts_err[ts_idx[j]] = \
                     f"invalid timestamp {int(ts_orig[j])}"
 
-        # pass 2 — per-point verdicts in input order, series-memoized
+        # distinct-series batch: validate every hashable series in
+        # one columnar charset pass and hash the ring once per series
+        # via searchsorted over the whole batch — pass 2 below then
+        # reduces to memo lookups for the common case. Unhashable tag
+        # values (TypeError on the key) keep the scalar path.
         series_memo: dict[Any, tuple[str, Any]] = {}
+        for i, dp, metric, tags in cand:
+            try:
+                series_memo.setdefault((metric, tuple(tags.items())),
+                                       (metric, tags))
+            except TypeError:
+                pass
+        if series_memo:
+            skeys = list(series_memo)
+            pairs = list(series_memo.values())
+            verrs = check_metric_and_tags_batch(pairs)
+            ok_pos = [j for j, e in enumerate(verrs) if e is None]
+            ring_keys = [series_shard_key(pairs[j][0], pairs[j][1])
+                         for j in ok_pos]
+            new_sets = self.ring.shards_for_keys(ring_keys, self.rf)
+            old_ring = self.old_ring
+            old_sets = old_ring.shards_for_keys(ring_keys, self.rf) \
+                if old_ring is not None else None
+            for slot, j in enumerate(ok_pos):
+                owners = list(new_sets[slot])
+                if old_sets is not None:
+                    for nm in old_sets[slot]:
+                        if nm not in owners:
+                            owners.append(nm)
+                series_memo[skeys[j]] = ("ok", tuple(owners))
+            for j, e in enumerate(verrs):
+                if e is not None:
+                    series_memo[skeys[j]] = ("err", e)
+
+        # pass 2 — per-point verdicts in input order, series-memoized
         for i, dp, metric, tags in cand:
             if i in ts_err:
                 entries[i] = {"datapoint": dp, "error": ts_err[i]}
@@ -2891,3 +2933,4 @@ class ClusterRouter:
             collector.record("cluster.wire.backpressure_sheds",
                              p.wire_backpressure_sheds, peer=name)
             p.breaker.collect_stats(collector)
+        self.cqs.collect_stats(collector)

@@ -96,6 +96,8 @@ T_WRITE_ACK = 4   # shard -> router: u16 status + put-summary body
 T_QUERY = 5       # router -> shard: trace + TSQuery JSON body
 T_QRES = 6        # shard -> router: one chunk of partial grids
 T_QDONE = 7       # shard -> router: u16 status + error body (if any)
+T_CQ = 8          # router -> shard: continuous-query control op
+T_CQ_RES = 9      # shard -> router: u16 status + JSON body
 
 _DP_KEYS = frozenset({"metric", "timestamp", "value", "tags"})
 
@@ -160,6 +162,42 @@ def decode_query(payload: bytes) -> tuple[str, bytes]:
     (tl,) = _U16.unpack_from(payload, 0)
     return payload[2:2 + tl].decode("utf-8", "replace"), \
         payload[2 + tl:]
+
+
+def encode_cq(trace: str, method: str, path: str,
+              body: bytes) -> bytes:
+    """``T_CQ`` payload: one continuous-query control op — register,
+    delete, pull, delta drain — as an HTTP-shaped (method, path,
+    body) replay. The shard routes it through the REAL HTTP handler,
+    so QoS gates, fault sites and chaos hangs cover the wire path
+    identically to the JSON path."""
+    tb = (trace or "").encode("utf-8")
+    if len(tb) > 0xFFFF:
+        tb = b""
+    mb = method.encode("ascii")
+    pb = path.encode("utf-8")
+    if len(mb) > 0xFF or len(pb) > 0xFFFF:
+        raise WireEncodeError("oversized CQ method/path")
+    return _U16.pack(len(tb)) + tb + bytes([len(mb)]) + mb + \
+        _U16.pack(len(pb)) + pb + (body or b"")
+
+
+def decode_cq(payload: bytes) -> tuple[str, str, str, bytes]:
+    try:
+        (tl,) = _U16.unpack_from(payload, 0)
+        off = 2 + tl
+        trace = payload[2:off].decode("utf-8", "replace")
+        ml = payload[off]
+        off += 1
+        method = payload[off:off + ml].decode("ascii")
+        off += ml
+        (pl,) = _U16.unpack_from(payload, off)
+        off += 2
+        path = payload[off:off + pl].decode("utf-8")
+        off += pl
+    except (struct.error, IndexError, UnicodeDecodeError) as exc:
+        raise WireProtocolError(f"torn CQ frame: {exc}") from exc
+    return trace, method, path, payload[off:]
 
 
 # -- write batches ----------------------------------------------------------
@@ -804,6 +842,30 @@ class WireManager:
             peer.wire_pipeline_depth -= 1
             sem.release()
 
+    def cq(self, peer, method: str, path: str, body: bytes = b"",
+           headers: dict[str, str] | None = None) -> tuple[int, bytes]:
+        """One continuous-query control exchange (register / delete /
+        pull / delta drain) over the persistent read connection;
+        returns the HTTP-shaped (status, body). Raises
+        :class:`WireUnsupported` when negotiation says HTTP and
+        ``OSError`` for transport failures — callers fall back to the
+        JSON path on the former and degrade the shard on the latter."""
+        trace = (headers or {}).get(TRACE_HEADER, "")
+        payload = encode_cq(trace, method, path, body)
+        self._check_faults(peer)
+        conn = self._conn(peer, "r")
+        seq, q = conn.begin(T_CQ, payload)
+        try:
+            ftype, ack = conn.wait(q, self.router.timeout_s)
+        finally:
+            conn.end(seq)
+        if ftype != T_CQ_RES:
+            conn.close()
+            raise ConnectionError(
+                f"peer {peer.name} answered frame type {ftype} "
+                f"to a CQ op")
+        return decode_status(ack)
+
     def query(self, peer, body: bytes,
               headers: dict[str, str] | None = None
               ) -> tuple[int, Any]:
@@ -1020,6 +1082,38 @@ async def serve_wire(server, reader, writer) -> None:
         outq.put_nowait(_frame(T_QDONE, seq, encode_status(
             resp.status, resp.body)))
 
+    async def handle_cq(seq: int, payload: bytes) -> None:
+        # continuous-query control op: replay as a real HTTP request
+        # (the handle_write idiom — chaos hangs, fault sites and QoS
+        # gates on the HTTP handler cover the wire path for free). No
+        # admission gate: registrations and delta drains are control
+        # traffic that must not be shed with the query load.
+        from opentsdb_tpu.tsd.server import _structured_error
+
+        def tracked() -> Any:
+            from opentsdb_tpu.tsd.http_api import HttpRequest
+            trace, method, path, qbody = decode_cq(payload)
+            if not path.startswith("/api/query/continuous"):
+                return _structured_error(
+                    400, f"path {path!r} is not a continuous-query "
+                    f"operation")
+            req = HttpRequest(
+                method=method, path=path, params={},
+                headers={TRACE_HEADER: trace} if trace else {},
+                body=qbody, remote=remote,
+                received_at=time.monotonic())
+            return server.http_router.handle(req)
+
+        try:
+            resp = await loop.run_in_executor(None, tracked)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - per-op 500
+            LOG.exception("wire CQ op failed")
+            resp = _structured_error(500, str(exc))
+        outq.put_nowait(_frame(T_CQ_RES, seq, encode_status(
+            resp.status, resp.body)))
+
     async def watchdog() -> None:
         # idle twin of listener_dead(): a session with nothing in
         # flight still follows a kill within one poll
@@ -1038,6 +1132,11 @@ async def serve_wire(server, reader, writer) -> None:
             elif ftype == T_QUERY:
                 task = asyncio.ensure_future(
                     handle_query(seq, payload))
+                qtasks.add(task)
+                task.add_done_callback(qtasks.discard)
+            elif ftype == T_CQ:
+                task = asyncio.ensure_future(
+                    handle_cq(seq, payload))
                 qtasks.add(task)
                 task.add_done_callback(qtasks.discard)
             else:
@@ -1060,7 +1159,7 @@ __all__ = [
     "MAGIC", "WIRE_VERSION", "MAX_FRAME",
     "WireBacklogged", "WireConnection", "WireDps", "WireEncodeError",
     "WireManager", "WireProtocolError", "WireUnsupported",
-    "decode_qres", "decode_query", "decode_status", "decode_write",
-    "encode_query", "encode_status", "encode_write", "qres_frames",
-    "serve_wire",
+    "decode_cq", "decode_qres", "decode_query", "decode_status",
+    "decode_write", "encode_cq", "encode_query", "encode_status",
+    "encode_write", "qres_frames", "serve_wire",
 ]

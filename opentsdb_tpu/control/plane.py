@@ -64,6 +64,15 @@ class ControlPlane:
             "tsd.control.materialize.min_score", 1.0)
         self.mat_hysteresis = max(cfg.get_int(
             "tsd.control.materialize.hysteresis", 3), 1)
+        # fold-memory pressure knob (ROADMAP 4a follow-through): a
+        # mined shape's score is divided by (1 + projected_bytes /
+        # this), so between two equally hot shapes the cheaper ring
+        # materializes first; shapes projecting past the tenant fold
+        # budget are refused outright
+        self.mat_mem_penalty_bytes = max(int(cfg.get_float(
+            "tsd.control.materialize.mem_penalty_mb", 64.0)
+            * (1 << 20)), 1)
+        self.fold_budget_skips = 0
         # actuator 2: multi-tenant QoS
         self.qos = TenantGovernor(tsdb)
         # actuator 3: placement
@@ -214,9 +223,33 @@ class ControlPlane:
         scores = mine_shapes(shape_path)
         with self._lock:
             blacklist = set(self._blacklist)
-        want = [s for s in scores
-                if s.score >= self.mat_min_score
-                and s.candidate not in blacklist][:self.mat_max]
+        # streaming partial-size accounting (ROADMAP 4a): project
+        # each candidate's standing ring cost from the live partials'
+        # membership, penalize the score by it, and refuse shapes the
+        # tenant fold budget could never admit — the miner must not
+        # materialize a ring the QoS gate would have refused a tenant
+        budget = 0
+        if self.qos.enabled and self.qos.fold_budget_bytes > 0:
+            budget = self.qos.fold_budget_bytes
+        eligible = []
+        over_budget = 0
+        for s in scores:
+            if s.candidate in blacklist:
+                continue
+            try:
+                proj = registry.projected_fold_bytes(
+                    shapes_mod.candidate_body(s.candidate))
+            except Exception:  # noqa: BLE001 - projection is advisory
+                proj = 0
+            if budget and proj > budget:
+                over_budget += 1
+                self.fold_budget_skips += 1
+                continue
+            adj = s.score / (1.0 + proj / self.mat_mem_penalty_bytes)
+            if adj >= self.mat_min_score:
+                eligible.append((adj, s))
+        eligible.sort(key=lambda p: -p[0])
+        want = [s for _adj, s in eligible[:self.mat_max]]
         want_set = {s.candidate for s in want}
         registered = retired = 0
         for s in want:
@@ -267,6 +300,7 @@ class ControlPlane:
         report["materialize"] = {
             "mined": len(scores), "standing": len(self._materialized),
             "registered": registered, "retired": retired,
+            "overBudget": over_budget,
         }
 
     # ------------------------------------------------------------------
@@ -394,6 +428,7 @@ class ControlPlane:
                 "standing": standing, "blacklisted": blacklisted,
                 "total": self.materialized_total,
                 "retired": self.retired_total,
+                "foldBudgetSkips": self.fold_budget_skips,
             },
             "qos": self.qos.describe(),
             "placement": {
@@ -413,6 +448,8 @@ class ControlPlane:
                          self.materialized_total)
         collector.record("control.retired.total", self.retired_total)
         collector.record("control.plans_applied", self.plans_applied)
+        collector.record("control.fold_budget_skips",
+                         self.fold_budget_skips)
         self.qos.collect_stats(collector)
 
 

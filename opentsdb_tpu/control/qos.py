@@ -239,24 +239,36 @@ class TenantGovernor:
             t.cache_bytes += nbytes
             return True
 
-    def fold_budget_allows(self, tenant: str | None,
-                           registry) -> bool:
+    def fold_budget_allows(self, tenant: str | None, registry,
+                           body: dict | None = None) -> bool:
         """Whether this tenant may register another continuous query
-        under its standing ring-byte budget. Auto-materialized CQs
-        (owned by the control plane) are capped by
-        ``tsd.control.materialize.max`` instead."""
+        under its standing ring-byte budget. Accounts the ACTUAL
+        resident ring bytes of the tenant's registrations
+        (``registry.tenant_fold_bytes`` — the streaming partial-size
+        surface, not the old windows-x-series guess) plus, when the
+        candidate ``body`` is given, the projected fold memory the
+        new registration would add — so one oversized shape is
+        refused up front instead of landing and starving the tenant's
+        next register. Auto-materialized CQs (owned by the control
+        plane) are capped by ``tsd.control.materialize.max`` and the
+        miner's memory penalty instead."""
         if not self.enabled or self.fold_budget_bytes <= 0 \
                 or tenant is None:
             return True
-        held = 0
-        for cq in registry.list():
-            if getattr(cq, "tenant", None) != tenant:
-                continue
-            for plan in cq.plans:
-                # standing ring estimate: windows x series x (ts +
-                # value + count accumulator)
-                held += plan.n_windows * max(len(plan._sids), 1) * 24
-        if held >= self.fold_budget_bytes:
+        held = registry.tenant_fold_bytes(tenant)
+        projected = 0
+        if body is not None and held > 0:
+            # a tenant holding nothing may always register once (the
+            # quota's first-use contract); after that the projection
+            # refuses shapes that would blow through the budget
+            # instead of letting them land first
+            try:
+                projected = registry.projected_fold_bytes(body)
+            except Exception:  # noqa: BLE001 - projection is advisory
+                projected = 0
+        if held >= self.fold_budget_bytes \
+                or (held > 0
+                    and held + projected > self.fold_budget_bytes):
             self.fold_budget_rejects += 1
             return False
         return True

@@ -100,6 +100,9 @@ KNOWN_SPANS: frozenset[str] = frozenset({
     "cluster.forward",       # one shard's write-forward leg
     "cluster.spool.append",  # durable handoff of one write batch
     "cluster.wire.connect",  # binary wire negotiation (cluster/wire.py)
+    "cluster.cq",            # one federated-CQ shard exchange
+    "cluster.cq.pump",       # one merged cross-shard delta drain
+
     # background stages
     "coldstore.spill",       # lifecycle sweep's disk spill phase
 })

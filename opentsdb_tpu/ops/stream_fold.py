@@ -20,10 +20,17 @@ mins, maxs of maxs; ``avg`` derives as sum/count at read time):
   k x interval, slide = interval). Windowed sums use an explicit
   window view (not cumsum differences) so summation order matches a
   direct per-window fold bit for bit.
+- :func:`combine_hopping` — hopping windows (slide > interval): the
+  trailing-``k`` combine of :func:`combine_sliding` subsampled to
+  the slide-aligned output columns, so a hopping bucket is bit-equal
+  to the sliding bucket at the same edge.
 - :func:`session_grid` — session-gap windows: consecutive non-empty
   buckets whose edge distance is <= ``gap_ms`` merge into one
   session; the session aggregate lands on the session's FIRST bucket
-  edge, other buckets are empty.
+  edge, other buckets are empty. The combine runs as ONE flat
+  reduceat over every (row, bucket) cell (:func:`session_grid_flat`)
+  so per-tag session partials — where rows explode to user
+  cardinality — close sessions in one pass, not S python loops.
 
 All kernels are host-side numpy by design: they run off the ingest
 path on the shared fold workers (or in a dashboard-sized serve tail),
@@ -88,6 +95,52 @@ def combine_sliding(sums: np.ndarray, cnts: np.ndarray,
             trail(mins, np.inf, np.min), trail(maxs, -np.inf, np.max))
 
 
+def combine_hopping(sums: np.ndarray, cnts: np.ndarray,
+                    mins: np.ndarray, maxs: np.ndarray, k: int,
+                    sel: np.ndarray):
+    """Hopping-window combine: output bucket ``sel[j]`` aggregates
+    the ``k`` trailing input buckets ending at it — the trailing
+    combine of :func:`combine_sliding` subsampled to the
+    slide-aligned columns ``sel``, so a hopping bucket is bit-equal
+    to the sliding bucket at the same edge (slide == interval is
+    exactly sliding; the caller enforces slide > interval)."""
+    s, c, mn, mx = combine_sliding(sums, cnts, mins, maxs, k)
+    return s[:, sel], c[:, sel], mn[:, sel], mx[:, sel]
+
+
+def session_grid_flat(sums: np.ndarray, cnts: np.ndarray,
+                      mins: np.ndarray, maxs: np.ndarray,
+                      edges: np.ndarray, gap_ms: int):
+    """Session-gap combine over EVERY row in one flat pass: the
+    non-empty (row, bucket) cells enumerate in row-major order, a
+    session break falls on every row change and every within-row
+    edge gap > ``gap_ms``, and one ``reduceat`` per stat channel
+    folds each segment onto its first bucket. Element order within a
+    segment matches the per-row walk exactly, so results are
+    bit-identical to reducing each row independently — but a
+    million-session partial closes in one kernel call."""
+    out_s = np.zeros_like(sums)
+    out_c = np.zeros_like(cnts)
+    out_min = np.full_like(mins, np.inf)
+    out_max = np.full_like(maxs, -np.inf)
+    rows, cols = np.nonzero(cnts > 0)
+    if not len(rows):
+        return out_s, out_c, out_min, out_max
+    e = edges[cols]
+    brk = np.empty(len(rows), dtype=bool)
+    brk[0] = True
+    # a new session starts on a new row or where the edge gap
+    # exceeds gap_ms (the cross-row diff is masked by the row break)
+    brk[1:] = (rows[1:] != rows[:-1]) | ((e[1:] - e[:-1]) > gap_ms)
+    starts = np.nonzero(brk)[0]
+    r0, c0 = rows[starts], cols[starts]
+    out_s[r0, c0] = np.add.reduceat(sums[rows, cols], starts)
+    out_c[r0, c0] = np.add.reduceat(cnts[rows, cols], starts)
+    out_min[r0, c0] = np.minimum.reduceat(mins[rows, cols], starts)
+    out_max[r0, c0] = np.maximum.reduceat(maxs[rows, cols], starts)
+    return out_s, out_c, out_min, out_max
+
+
 def session_grid(sums: np.ndarray, cnts: np.ndarray, mins: np.ndarray,
                  maxs: np.ndarray, edges: np.ndarray, gap_ms: int):
     """Session-gap combine: per series, runs of non-empty buckets
@@ -95,26 +148,6 @@ def session_grid(sums: np.ndarray, cnts: np.ndarray, mins: np.ndarray,
     session whose aggregate lands on the run's FIRST bucket; every
     other bucket comes back empty. Sessions are delimited within the
     supplied range (a session truncated by the range edge aggregates
-    its visible part)."""
-    out_s = np.zeros_like(sums)
-    out_c = np.zeros_like(cnts)
-    out_min = np.full_like(mins, np.inf)
-    out_max = np.full_like(maxs, -np.inf)
-    present = cnts > 0
-    # tsdlint: allow[kernel-hygiene] per-SERIES orchestration (the
-    # per-bucket combine inside is reduceat-vectorized); flattening
-    # the session stitch across rows is the ROADMAP item-4
-    # per-tag-session work, where S explodes to user cardinality
-    for s in range(sums.shape[0]):
-        idx = np.nonzero(present[s])[0]
-        if not len(idx):
-            continue
-        # a new session starts where the edge gap exceeds gap_ms
-        breaks = np.diff(edges[idx]) > gap_ms
-        starts = np.concatenate([[0], np.nonzero(breaks)[0] + 1])
-        first = idx[starts]
-        out_s[s, first] = np.add.reduceat(sums[s, idx], starts)
-        out_c[s, first] = np.add.reduceat(cnts[s, idx], starts)
-        out_min[s, first] = np.minimum.reduceat(mins[s, idx], starts)
-        out_max[s, first] = np.maximum.reduceat(maxs[s, idx], starts)
-    return out_s, out_c, out_min, out_max
+    its visible part). Thin alias of :func:`session_grid_flat` —
+    kept as the view-combine entry point."""
+    return session_grid_flat(sums, cnts, mins, maxs, edges, gap_ms)

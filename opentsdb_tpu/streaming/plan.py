@@ -66,7 +66,7 @@ _GROW = 64  # initial / doubling row capacity for the partial arrays
 # per-statistic tier stores one demoted interval spans (rollup/job.py)
 _TIER_AGGS = ("sum", "count", "min", "max")
 
-WINDOW_KINDS = ("tumbling", "sliding", "session")
+WINDOW_KINDS = ("tumbling", "sliding", "hopping", "session")
 
 
 class WindowSpec:
@@ -74,17 +74,27 @@ class WindowSpec:
     sliding (``{"type": "sliding", "size": "5m"}`` — size must be a
     multiple of the downsample interval; each emitted bucket
     aggregates the trailing ``size`` of history, sliding by one
-    interval) or session-gap (``{"type": "session", "gap": "2m"}`` —
-    gap must be a multiple of the interval; buckets closer than the
-    gap merge into one session stamped at its first bucket)."""
+    interval), hopping (``{"type": "hopping", "size": "10m",
+    "slide": "5m"}`` — the sliding combine emitting only every
+    ``slide``-aligned bucket; slide > interval generalizes the
+    sliding view's slide == interval) or session-gap
+    (``{"type": "session", "gap": "2m"}`` — gap must be a multiple
+    of the interval; buckets closer than the gap merge into one
+    session stamped at its first bucket; an optional ``"by"`` tag
+    key folds sessions PER TAG VALUE over one shared partial — the
+    millions-of-users scenario, :mod:`opentsdb_tpu.streaming.
+    eventtime.sessions`)."""
 
-    __slots__ = ("kind", "size_ms", "gap_ms")
+    __slots__ = ("kind", "size_ms", "gap_ms", "slide_ms", "by_tag")
 
     def __init__(self, kind: str = "tumbling", size_ms: int = 0,
-                 gap_ms: int = 0):
+                 gap_ms: int = 0, slide_ms: int = 0,
+                 by_tag: str | None = None):
         self.kind = kind
         self.size_ms = int(size_ms)
         self.gap_ms = int(gap_ms)
+        self.slide_ms = int(slide_ms)
+        self.by_tag = by_tag
 
     @classmethod
     def from_json(cls, obj, interval_ms: int) -> "WindowSpec":
@@ -123,15 +133,32 @@ class WindowSpec:
                     "sliding window size must exceed the downsample "
                     "interval (equal would be tumbling)")
             return cls("sliding", size_ms=size)
+        if kind == "hopping":
+            size = duration("size")
+            slide = duration("slide")
+            if slide <= interval_ms:
+                raise BadRequestError(
+                    "hopping window slide must exceed the downsample "
+                    "interval (equal would be sliding)")
+            if size <= slide:
+                raise BadRequestError(
+                    "hopping window size must exceed its slide "
+                    "(equal would be a coarser tumbling window)")
+            return cls("hopping", size_ms=size, slide_ms=slide)
         if kind == "session":
-            return cls("session", gap_ms=duration("gap"))
+            by = obj.get("by")
+            if by is not None and (not isinstance(by, str) or not by):
+                raise BadRequestError(
+                    "session window 'by' must be a non-empty tag key")
+            return cls("session", gap_ms=duration("gap"), by_tag=by)
         return cls()
 
     def lead_for(self, interval_ms: int) -> int:
         """Extra trailing-history buckets a full leading window
-        needs (sliding only)."""
+        needs (sliding/hopping: the trailing combine reaches
+        ``size`` back from each emitted bucket)."""
         return (self.size_ms // interval_ms - 1) \
-            if self.kind == "sliding" else 0
+            if self.kind in ("sliding", "hopping") else 0
 
     def to_json(self) -> dict[str, Any]:
         out: dict[str, Any] = {"type": self.kind}
@@ -139,6 +166,10 @@ class WindowSpec:
             out["sizeMs"] = self.size_ms
         if self.gap_ms:
             out["gapMs"] = self.gap_ms
+        if self.slide_ms:
+            out["slideMs"] = self.slide_ms
+        if self.by_tag:
+            out["by"] = self.by_tag
         return out
 
 
@@ -202,20 +233,47 @@ class SharedPartial:
         # newest folded timestamp: absolute-range serves past it are
         # exact (nothing newer exists to diverge on)
         self.max_ts_ms = 0
+        # newest LIVE-FOLDED event time, the watermark's sole input:
+        # unlike max_ts_ms it is never seeded from wall clock or
+        # bootstrap scans (a watermark is only emitted after the
+        # events that advanced it), so a freshly registered policy CQ
+        # finalizes nothing until real folds advance it — and is
+        # monotone across ring rebuilds (final stays final). Folds
+        # STAGE the advance; the drain loop commits it once per pass
+        # (commit_watermark), so a write batch the ingest tap chunked
+        # per series folds wholly against the PRE-batch watermark —
+        # otherwise the first series' newest point would mass-drop
+        # every later series' older half as "late"
+        self.wm_event_ms = 0
+        self._wm_staged_ms = 0
         # versions: folds invalidate view tail caches, membership
         # changes invalidate the group structures
         self.fold_seq = 0
         self.member_seq = 0
+        # event-time lateness policy (streaming/eventtime): 0 = the
+        # legacy contract (late points refold anywhere the ring still
+        # covers, drop only past the ring horizon). A positive bound
+        # FINALIZES buckets once the watermark (newest folded event
+        # time minus the bound) passes their end — later points into
+        # them drop and count, never silently mutate a final window.
+        # Set once at registration (the policy is part of the shared
+        # partial's identity, so attached views always agree).
+        self.lateness_ms = 0
         # counters (read by the registry's stats/health export)
         self.points_folded = 0
         self.folds = 0
         self.late_dropped = 0
+        self.late_refolded = 0
         self.preboundary_dropped = 0
         self.bootstrap_points = 0
         self.backpressure_dropped = 0
         # pending (sids, ts_ms, values) chunks offered by the ingest
-        # tap; folded in batches off the hot write path
+        # tap; folded in batches off the hot write path. Single
+        # points ride the scalar list — building three 1-element
+        # numpy arrays per point costs more than the rest of the tap
+        # combined, so take_pending columnarizes them in one shot
         self._pending: list[tuple] = []
+        self._pending_scalars: list[tuple] = []
         self.pending_points = 0
         self.needs_rebuild = False
         # tier-seeded bootstrap state: when the ring's horizon reaches
@@ -323,6 +381,54 @@ class SharedPartial:
             views[agg] = st
         return views, boundary, best.interval
 
+    def _reset_members_locked(self) -> None:
+        """Clear membership for a re-seed (caller holds ``lock``);
+        subclasses with extra membership maps extend this."""
+        self._slots.clear()
+        self._sids = []
+        self._tag_pairs = []
+
+    def _seed_scan(self, cols: np.ndarray, start_edge: int, iv: int,
+                   w: int, seeded) -> None:
+        """Seed the ring channels from the store for the admitted
+        members (caller holds ``lock``; membership was just rebuilt).
+        Subclasses that key rows by something other than series
+        (per-tag session partials) override the scatter."""
+        if not len(self._sids):
+            return
+        sid_arr = np.asarray(self._sids, dtype=np.int64)
+        span_end = int(start_edge + w * iv - 1)
+        if seeded is not None:
+            # channel-wise tier seed: each stitched view
+            # combines its cold + tier + raw-tail parts over
+            # the SAME bucket grid, so sums of sums / counts
+            # of counts / extremes of extremes are exact
+            views = seeded[0]
+            sums = views["sum"].bucket_reduce(
+                sid_arr, int(start_edge), span_end,
+                int(start_edge), iv, w)[0]
+            cnts = views["count"].bucket_reduce(
+                sid_arr, int(start_edge), span_end,
+                int(start_edge), iv, w)[0]
+            mins = views["min"].bucket_reduce(
+                sid_arr, int(start_edge), span_end,
+                int(start_edge), iv, w, want_minmax=True)[2]
+            maxs = views["max"].bucket_reduce(
+                sid_arr, int(start_edge), span_end,
+                int(start_edge), iv, w, want_minmax=True)[3]
+        else:
+            sums, cnts, mins, maxs = self.tsdb.store.bucket_reduce(
+                sid_arr, int(start_edge), span_end,
+                int(start_edge), iv, w, want_minmax=True)
+        s = len(sid_arr)
+        self._grow_to(s)
+        self._sum[:s, cols] = sums
+        self._cnt[:s, cols] = cnts
+        present = cnts > 0
+        self._min[:s, cols] = np.where(present, mins, np.inf)
+        self._max[:s, cols] = np.where(present, maxs, -np.inf)
+        self.bootstrap_points += int(cnts.sum())
+
     def bootstrap(self, now_ms: int,
                   n_windows: int | None = None) -> None:
         """Seed the window ring from the store: one fused
@@ -345,9 +451,7 @@ class SharedPartial:
             cols = ((edges // iv) % w).astype(np.int64)
             self.win_ts = np.full(w, -1, dtype=np.int64)
             self.win_ts[cols] = edges
-            self._slots.clear()
-            self._sids = []
-            self._tag_pairs = []
+            self._reset_members_locked()
             if self._sum.shape[1] != w:
                 cap = self._sum.shape[0]
                 self._sum = np.zeros((cap, w))
@@ -361,6 +465,7 @@ class SharedPartial:
                 self._max[:] = -np.inf
             with self._pending_lock:
                 self._pending = []
+                self._pending_scalars = []
                 self.pending_points = 0
             for v in self.views:
                 v.invalidate_caches()
@@ -399,39 +504,7 @@ class SharedPartial:
                 sids = sids[mask]
             for sid in np.asarray(sids).tolist():
                 self._admit_locked(int(sid), check_filters=False)
-            if len(self._sids):
-                sid_arr = np.asarray(self._sids, dtype=np.int64)
-                span_end = int(start_edge + w * iv - 1)
-                if seeded is not None:
-                    # channel-wise tier seed: each stitched view
-                    # combines its cold + tier + raw-tail parts over
-                    # the SAME bucket grid, so sums of sums / counts
-                    # of counts / extremes of extremes are exact
-                    views = seeded[0]
-                    sums = views["sum"].bucket_reduce(
-                        sid_arr, int(start_edge), span_end,
-                        int(start_edge), iv, w)[0]
-                    cnts = views["count"].bucket_reduce(
-                        sid_arr, int(start_edge), span_end,
-                        int(start_edge), iv, w)[0]
-                    mins = views["min"].bucket_reduce(
-                        sid_arr, int(start_edge), span_end,
-                        int(start_edge), iv, w, want_minmax=True)[2]
-                    maxs = views["max"].bucket_reduce(
-                        sid_arr, int(start_edge), span_end,
-                        int(start_edge), iv, w, want_minmax=True)[3]
-                else:
-                    sums, cnts, mins, maxs = store.bucket_reduce(
-                        sid_arr, int(start_edge), span_end,
-                        int(start_edge), iv, w, want_minmax=True)
-                s = len(sid_arr)
-                self._grow_to(s)
-                self._sum[:s, cols] = sums
-                self._cnt[:s, cols] = cnts
-                present = cnts > 0
-                self._min[:s, cols] = np.where(present, mins, np.inf)
-                self._max[:s, cols] = np.where(present, maxs, -np.inf)
-                self.bootstrap_points += int(cnts.sum())
+            self._seed_scan(cols, int(start_edge), iv, w, seeded)
             if self.want_sketch and len(self._sids):
                 self._seed_sketch_locked(
                     int(start_edge), int(start_edge + w * iv - 1))
@@ -658,11 +731,26 @@ class SharedPartial:
             self.pending_points += len(ts_ms)
             return self.pending_points
 
+    def offer_one(self, sid: int, ts_ms: int, value: float) -> int:
+        """Scalar tap: one point, no numpy on the write path (a
+        tuple append under the pending lock — ``take_pending``
+        columnarizes the accumulated scalars in one conversion)."""
+        with self._pending_lock:
+            self._pending_scalars.append((sid, ts_ms, value))
+            self.pending_points += 1
+            return self.pending_points
+
     def take_pending(self) -> list[tuple]:
         with self._pending_lock:
             out, self._pending = self._pending, []
+            sc, self._pending_scalars = self._pending_scalars, []
             self.pending_points = 0
-            return out
+        if sc:
+            # float64 carries sid and ts_ms exactly (< 2**53)
+            cols = np.asarray(sc, dtype=np.float64)
+            out.append((cols[:, 0].astype(np.int64),
+                        cols[:, 1].astype(np.int64), cols[:, 2]))
+        return out
 
     def drop_pending(self) -> int:
         """Backpressure degrade: throw the backlog away (the partial
@@ -671,6 +759,7 @@ class SharedPartial:
         with self._pending_lock:
             dropped = self.pending_points
             self._pending = []
+            self._pending_scalars = []
             self.pending_points = 0
         self.backpressure_dropped += dropped
         return dropped
@@ -716,6 +805,28 @@ class SharedPartial:
                     if not len(bucket):
                         self.folds += 1
                         return
+            if self.lateness_ms > 0:
+                # event-time watermark as it stood BEFORE this drain
+                # pass: a watermark is only emitted after the events
+                # that advanced it, so a batch's own points are never
+                # late relative to its own max (a bulk in-order
+                # backfill — or the same batch chunked per series —
+                # must not mass-drop its older half). Buckets the
+                # standing watermark has passed are FINAL — late
+                # points into them drop and count instead of silently
+                # mutating a window already surfaced as complete.
+                wm = self.wm_event_ms - self.lateness_ms
+                final = (bucket + iv) <= wm
+                if final.any():
+                    self.late_dropped += int(final.sum())
+                    keep2 = ~final
+                    slots, ts = slots[keep2], ts[keep2]
+                    vals, bucket = vals[keep2], bucket[keep2]
+                    if not len(bucket):
+                        self.max_ts_ms = max(self.max_ts_ms,
+                                             int(ts_ms[keep].max()))
+                        self.folds += 1
+                        return
             col = ((bucket // iv) % w).astype(np.int64)
             # tumble columns whose newest incoming bucket is newer
             for c in np.unique(col).tolist():
@@ -734,6 +845,11 @@ class SharedPartial:
                         self.covered_from_ms, nb - (w - 1) * iv)
             live = bucket == self.win_ts[col]
             self.late_dropped += int((~live).sum())
+            # live points landing BEHIND the ring's newest bucket are
+            # allowed-lateness refolds into already-published windows
+            # (counted so completeness markers can surface them)
+            high = int(self.win_ts.max())
+            self.late_refolded += int((live & (bucket < high)).sum())
             if live.any():
                 slots, col = slots[live], col[live]
                 vals, bucket = vals[live], bucket[live]
@@ -747,6 +863,8 @@ class SharedPartial:
                     view.note_changed(changed, self.covered_from_ms)
                 self.points_folded += len(vals)
                 self.max_ts_ms = max(self.max_ts_ms, int(ts.max()))
+                self._wm_staged_ms = max(self._wm_staged_ms,
+                                         int(ts.max()))
                 self.fold_seq += 1
             self.folds += 1
 
@@ -782,6 +900,62 @@ class SharedPartial:
             sums, cnts, mins, maxs, stride)
         return sums, cnts, mins, maxs, edges
 
+    # ------------------------------------------------------------------
+    # event-time observability (streaming/eventtime)
+    # ------------------------------------------------------------------
+
+    def commit_watermark(self) -> None:
+        """Publish the event times this drain pass folded into the
+        watermark basis (see ``wm_event_ms`` in ``__init__``). Called
+        by the registry's drain loop AFTER all of a pass's chunks
+        folded, under ``_drain_lock``."""
+        with self.lock:
+            if self._wm_staged_ms > self.wm_event_ms:
+                self.wm_event_ms = self._wm_staged_ms
+
+    def watermark_ms(self) -> int:
+        """Event-time watermark: the newest live-folded event time
+        minus the allowed lateness (without a policy the watermark
+        rides the newest point — nothing is ever final)."""
+        return max(0, self.wm_event_ms - self.lateness_ms)
+
+    def ring_bytes(self) -> int:
+        """Actual resident bytes of the ring channels (the fold-
+        memory number the control plane's miner and the QoS tenant
+        fold budget account against — capacity, not membership
+        estimate)."""
+        n = self._sum.nbytes + self._cnt.nbytes + self._min.nbytes \
+            + self._max.nbytes + self.win_ts.nbytes
+        if self._sketch:
+            # dominated by bucket maps; ~16B/bucket is the DDSketch
+            # store's observed footprint
+            n += sum(16 * len(getattr(sk, "buckets", ()))
+                     for sk in self._sketch.values())
+        return n
+
+    def session_stats(self, gap_ms: int,
+                      watermark_ms: int) -> tuple[int, int]:
+        """(open, closed) session counts for a session view at
+        ``gap_ms``: a row's session is CLOSED once the watermark has
+        passed its last active bucket's end by more than the gap —
+        no in-lateness point can extend it. One vectorized pass over
+        the ring (caller holds ``lock``)."""
+        s = len(self._sids)
+        if not s:
+            return 0, 0
+        live = self.win_ts >= 0
+        if not live.any():
+            return 0, 0
+        present = self._cnt[:s][:, live] > 0
+        edges = self.win_ts[live]
+        has_any = present.any(axis=1)
+        # newest active edge per row: argmax over edge-ranked columns
+        rank = np.where(present, edges[None, :], -1)
+        last_edge = rank.max(axis=1)
+        closed = has_any & (last_edge + self.interval_ms + gap_ms
+                            <= watermark_ms)
+        return int((has_any & ~closed).sum()), int(closed.sum())
+
     def info(self) -> dict[str, Any]:
         with self.lock:
             return {
@@ -795,6 +969,10 @@ class SharedPartial:
                 "folds": self.folds,
                 "pendingPoints": self.pending_points,
                 "lateDropped": self.late_dropped,
+                "lateRefolded": self.late_refolded,
+                "latenessMs": self.lateness_ms,
+                "watermarkMs": self.watermark_ms(),
+                "ringBytes": self.ring_bytes(),
                 "preboundaryDropped": self.preboundary_dropped,
                 "backpressureDropped": self.backpressure_dropped,
                 "bootstrapPoints": self.bootstrap_points,
@@ -850,6 +1028,10 @@ class PlanView:
         return self.shared.late_dropped
 
     @property
+    def late_refolded(self) -> int:
+        return self.shared.late_refolded
+
+    @property
     def pending_points(self) -> int:
         return self.shared.pending_points
 
@@ -893,7 +1075,8 @@ class PlanView:
     def publish_buckets(self, changed: set[int]) -> set[int] | None:
         """Map fold-dirty BASE buckets to the output buckets an SSE
         delta frame must re-emit: the enclosing view bucket for
-        tumbling, the trailing-window fan-out for sliding, None
+        tumbling, the trailing-window fan-out for sliding (hopping
+        keeps only the slide-aligned edges of that fan-out), None
         (whole frame) for session windows — a fold anywhere can move
         a session's start bucket."""
         if self.window.kind == "session":
@@ -903,6 +1086,13 @@ class PlanView:
         if self.window.kind == "sliding":
             k = self.window.size_ms // iv
             out = {c + i * iv for c in out for i in range(k)}
+        elif self.window.kind == "hopping":
+            k = self.window.size_ms // iv
+            slide = self.window.slide_ms
+            out = {e for c in out
+                   for e in range(c - c % slide,
+                                  c + (k - 1) * iv + 1, slide)
+                   if e >= c}
         return out
 
     # ------------------------------------------------------------------
@@ -918,7 +1108,7 @@ class PlanView:
         iv = self.interval_ms
         ch = None
         lead = 0
-        if self.window.kind == "sliding":
+        if self.window.kind in ("sliding", "hopping"):
             k = self.window.size_ms // iv
             ext = start_ms - (k - 1) * iv
             if ext > 0:
@@ -942,6 +1132,18 @@ class PlanView:
                 sums, cnts = sums[:, lead:], cnts[:, lead:]
                 mins, maxs = mins[:, lead:], maxs[:, lead:]
                 edges = edges[lead:]
+        elif self.window.kind == "hopping":
+            k = self.window.size_ms // iv
+            body = edges[lead:] if lead else edges
+            sel = np.nonzero(body % self.window.slide_ms == 0)[0] \
+                + lead
+            sums, cnts, mins, maxs = stream_fold.combine_hopping(
+                sums, cnts, mins, maxs, k, sel)
+            edges = edges[sel]
+            if not len(edges):
+                # no slide-aligned edge falls in the range: the view
+                # has nothing to emit (callers see a 0-bucket frame)
+                num_points = 0
         elif self.window.kind == "session":
             sums, cnts, mins, maxs = stream_fold.session_grid(
                 sums, cnts, mins, maxs, edges, self.window.gap_ms)

@@ -68,6 +68,9 @@ import numpy as np
 
 from opentsdb_tpu.query.model import BadRequestError, TSQuery
 from opentsdb_tpu.query.result_cache import _is_relative
+from opentsdb_tpu.streaming.eventtime import (SessionPartial,
+                                              WatermarkPolicy,
+                                              completeness_marker)
 from opentsdb_tpu.streaming.plan import (DECOMPOSABLE_DS, PlanView,
                                          SharedPartial, WindowSpec,
                                          filter_identity)
@@ -82,11 +85,15 @@ class ContinuousQuery:
     plan view per sub-query and the SSE subscriber set."""
 
     def __init__(self, cid: str, raw: dict, tsq: TSQuery,
-                 plans: list[PlanView]):
+                 plans: list[PlanView],
+                 policy: WatermarkPolicy | None = None):
         self.id = cid
         self.raw = raw          # original JSON body (re-resolved per emit)
         self.tsq = tsq
         self.plans = plans
+        # event-time watermark/lateness policy (None = legacy
+        # processing-time contract, no completeness markers)
+        self.policy = policy
         self.created = time.time()
         self.lock = threading.Lock()
         self.subscribers: list = []
@@ -101,6 +108,20 @@ class ContinuousQuery:
         self.history: list[tuple[int, bytes]] = []
         self.evicted_seq = 0
 
+    def fold_bytes(self) -> int:
+        """Resident ring bytes this query's views hold (distinct
+        shared partials counted once) — the per-CQ attribution the
+        tenant fold budget sums."""
+        seen: set[int] = set()
+        total = 0
+        for p in self.plans:
+            g = p.shared
+            if id(g) in seen:
+                continue
+            seen.add(id(g))
+            total += g.ring_bytes()
+        return total
+
     def describe(self, verbose: bool = False) -> dict[str, Any]:
         out: dict[str, Any] = {
             "id": self.id,
@@ -110,11 +131,14 @@ class ContinuousQuery:
             "series": sum(len(p._sids) for p in self.plans),
             "subscribers": len(self.subscribers),
             "emitSeq": self.emit_seq,
+            "foldBytes": self.fold_bytes(),
         }
         if self.plans:
             out["windowSpec"] = self.plans[0].window.to_json()
             out["sharedPlan"] = [len(p.shared.views) > 1
                                  for p in self.plans]
+        if self.policy is not None:
+            out["watermark"] = self.policy.to_json()
         if verbose:
             out["plans"] = [p.info() for p in self.plans]
         return out
@@ -202,6 +226,7 @@ class ContinuousQueryRegistry:
             raise BadRequestError("continuous query must be an object")
         cid = obj.get("id")
         window_obj = obj.get("window")
+        policy = WatermarkPolicy.from_json(obj.get("watermark"))
         body = {k: v for k, v in obj.items() if k != "id"}
         tsq = TSQuery.from_json(body).validate(now_ms)
         if tsq.delete:
@@ -232,6 +257,22 @@ class ContinuousQueryRegistry:
                     f"decomposable into streaming partials "
                     f"(supported: {', '.join(sorted(DECOMPOSABLE_DS))})")
             window = WindowSpec.from_json(window_obj, spec.interval_ms)
+            if window.by_tag:
+                # per-tag session rows ARE the tag's values: grouping
+                # by any other key has no per-row answer, and the
+                # sketch channel is per-series — both refuse loudly
+                # instead of answering wrong
+                bad_gb = sorted({f.tagk for f in sub.filters
+                                 if f.group_by} - {window.by_tag})
+                if bad_gb:
+                    raise BadRequestError(
+                        f"session window by={window.by_tag!r} cannot "
+                        f"group by other tags ({', '.join(bad_gb)})")
+                if sub.percentiles:
+                    raise BadRequestError(
+                        "per-tag session windows do not support "
+                        "percentiles (the sketch channel is "
+                        "per-series)")
             if sub.percentiles:
                 # percentile CQs serve from the shared ring's sketch
                 # channel; only tumbling windows extract exactly
@@ -246,9 +287,11 @@ class ContinuousQueryRegistry:
                     raise BadRequestError(
                         "continuous percentile queries support "
                         "tumbling windows only")
+            lat_b = policy.lateness_buckets(spec.interval_ms) \
+                if policy is not None else 0
             windows = int((tsq.end_ms - tsq.start_ms)
                           // spec.interval_ms) + 2 \
-                + window.lead_for(spec.interval_ms)
+                + window.lead_for(spec.interval_ms) + lat_b
             if windows > self.max_windows:
                 raise BadRequestError(
                     f"window range needs {windows} tumbling windows; "
@@ -276,12 +319,21 @@ class ContinuousQueryRegistry:
                 # OUTSIDE the registry lock (the ingest tap takes it —
                 # a wide bootstrap must not stall every write)
                 self._queries[cid] = cq = ContinuousQuery(
-                    cid, body, tsq, [])
+                    cid, body, tsq, [], policy=policy)
             new_groups: list[SharedPartial] = []
             views: list[PlanView] = []
             try:
                 for sub, window, need_w in specs:
                     fid = filter_identity(sub)
+                    # a lateness policy (strict drops) or per-tag
+                    # session keying (rows are tag values) changes
+                    # fold SEMANTICS, not just the view combine —
+                    # such partials only share with identical twins
+                    if policy is not None:
+                        fid = fid + (
+                            f"lateness={policy.lateness_ms}",)
+                    if window.by_tag:
+                        fid = fid + (f"session_by={window.by_tag}",)
                     view_iv = int(sub.ds_spec.interval_ms)
                     with self._lock:
                         group = self._find_group_locked(
@@ -302,9 +354,12 @@ class ContinuousQueryRegistry:
                         anchor = max(anchor_ms,
                                      newest if newest > 0 else 0)
                         anchor_edge = anchor - anchor % base_iv
+                        lat_v = policy.lateness_buckets(view_iv) \
+                            if policy is not None else 0
                         start_edge = (
                             tsq.start_ms - tsq.start_ms % view_iv
-                            - window.lead_for(view_iv) * view_iv)
+                            - (window.lead_for(view_iv) + lat_v)
+                            * view_iv)
                         floor = min(start_edge, covered) \
                             if covered else start_edge
                         needed = int(
@@ -322,10 +377,17 @@ class ContinuousQueryRegistry:
                                 if group.tier_seeded:
                                     self.tier_seeded_bootstraps += 1
                     if group is None:
-                        group = SharedPartial(
-                            self.tsdb, sub.metric, sub.filters,
-                            view_iv, need_w)
+                        if window.by_tag:
+                            group = SessionPartial(
+                                self.tsdb, sub.metric, sub.filters,
+                                view_iv, need_w, window.by_tag)
+                        else:
+                            group = SharedPartial(
+                                self.tsdb, sub.metric, sub.filters,
+                                view_iv, need_w)
                         group.filter_key = fid
+                        if policy is not None:
+                            group.lateness_ms = policy.lateness_ms
                         if sub.percentiles:
                             group.want_sketch = True
                         group.bootstrap(anchor_ms)
@@ -342,7 +404,13 @@ class ContinuousQueryRegistry:
                         self._partials.append(group)
                         self._index_group_locked(group)
                     for view in views:
-                        if view.window.kind == "tumbling":
+                        # policy views drop late points the raw store
+                        # accepted, so they can no longer answer
+                        # /api/query value-identically — pull through
+                        # .../result, where the marker says what you
+                        # got
+                        if view.window.kind == "tumbling" \
+                                and policy is None:
                             key = (view.metric,
                                    view.sub.identity_key())
                             self._by_identity.setdefault(key, view)
@@ -406,8 +474,11 @@ class ContinuousQueryRegistry:
                     del self._by_identity[key]
                     # a surviving query with the same identity takes
                     # over the pull path instead of silently falling
-                    # back to batch scans
+                    # back to batch scans (policy queries stay out of
+                    # it: strict lateness breaks batch exactness)
                     for other in self._queries.values():
+                        if other.policy is not None:
+                            continue
                         for p in other.plans:
                             if p.window.kind == "tumbling" and \
                                     (p.metric,
@@ -476,11 +547,9 @@ class ContinuousQueryRegistry:
         groups = self._groups_for(metric_id)
         if not groups:
             return
-        sid_a = np.asarray([sid], dtype=np.int64)
-        ts_a = np.asarray([ts_ms], dtype=np.int64)
-        val_a = np.asarray([value], dtype=np.float64)
         for group in groups:
-            self._post_offer(group, group.offer(sid_a, ts_a, val_a))
+            self._post_offer(group,
+                             group.offer_one(sid, ts_ms, value))
         self._notify_publish()
 
     def offer_many(self, metric_id: int, sid: int, ts_ms: np.ndarray,
@@ -552,8 +621,20 @@ class ContinuousQueryRegistry:
                 faults = getattr(self.tsdb, "faults", None)
                 if faults is not None:
                     faults.check("stream.fold")
-                for sids, ts, vals in pending:
-                    group.fold(sids, ts, vals)
+                if len(pending) > 1:
+                    # per-point ingest taps one 1-point chunk each —
+                    # folding those one at a time pays the full
+                    # lock/admit/scatter overhead per POINT. fold()
+                    # resolves sids per element, so a pass's chunks
+                    # concatenate (arrival order preserved) into one
+                    # columnar scatter; the per-pass watermark commit
+                    # already treats the pass as one batch
+                    group.fold(
+                        np.concatenate([p[0] for p in pending]),
+                        np.concatenate([p[1] for p in pending]),
+                        np.concatenate([p[2] for p in pending]))
+                else:
+                    group.fold(*pending[0])
             except Exception as exc:  # noqa: BLE001 - degrade
                 self.fold_errors += 1
                 group.needs_rebuild = True
@@ -565,6 +646,11 @@ class ContinuousQueryRegistry:
             else:
                 if br is not None and br.state != br.CLOSED:
                     br.record_success()
+            finally:
+                # event-time watermark advances once per PASS, not
+                # per chunk: a batch the tap chunked per series must
+                # fold wholly against the pre-batch watermark
+                group.commit_watermark()
 
     def worker_drain(self, group: SharedPartial) -> None:
         """One worker-pool drain: the ``stream.worker`` fault site
@@ -845,20 +931,34 @@ class ContinuousQueryRegistry:
                     "index": r.sub_query_index,
                     "dps": {str(ts): (None if v != v else v)
                             for ts, v in r.dps}})
+        if cq.policy is not None:
+            # trailing completeness marker (the shardsDegraded idiom:
+            # the row array keeps its shape for result consumers, the
+            # marker rides at the end). A failed marker build — e.g.
+            # an armed stream.watermark fault — degrades the WHOLE
+            # pull: results without their completeness contract must
+            # not pass as complete.
+            try:
+                marker = completeness_marker(self, cq, tsq.end_ms)
+            except Exception as exc:  # noqa: BLE001 - degrade to 503
+                raise DegradedError(
+                    f"continuous query {cq.id!r}: completeness "
+                    f"marker unavailable ({type(exc).__name__}); "
+                    f"retry shortly") from exc
+            rows.append({"completeness": marker})
         return rows
 
-    def _publish(self, cq: ContinuousQuery, snapshot: bool,
-                 only: list | None = None) -> bool:
-        from opentsdb_tpu.streaming import sse
-        from opentsdb_tpu.query.engine import QueryEngine
-        now_ms = int(time.time() * 1000)
-        try:
-            tsq = self._emit_tsq(cq, now_ms)
-        except BadRequestError:
-            return False
-        engine = QueryEngine(self.tsdb)
+    def _collect_updates(self, cq: ContinuousQuery, tsq: TSQuery,
+                         engine, snapshot: bool) -> list[dict]:
+        """The incremental update rows for one publish/delta pass:
+        per view, CONSUME the fold-dirty buckets, map them through
+        the window's publish fan-out, and serve only the dps that
+        changed (snapshot=True serves everything). Shared by the SSE
+        publish path and the router's delta-drain pull
+        (:meth:`delta_updates`) so federated frames carry exactly
+        what a local subscriber would have seen."""
         from opentsdb_tpu.query.model import effective_pixels
-        updates = []
+        updates: list[dict] = []
         for view, sub in zip(cq.plans, tsq.queries):
             changed = None if snapshot else set(view.take_changed())
             if changed is not None and not changed:
@@ -895,12 +995,64 @@ class ContinuousQueryRegistry:
                     "metric": r.metric, "tags": r.tags,
                     "aggregateTags": r.aggregated_tags,
                     "index": r.sub_query_index, "dps": dps})
+        return updates
+
+    def delta_updates(self, cq: ContinuousQuery,
+                      now_ms: int | None = None) -> dict:
+        """Drain + return one incremental update batch WITHOUT an SSE
+        subscriber — the router's federated pump pulls this from each
+        shard (``GET .../<id>/deltas``, HTTP or wire) and merges the
+        per-shard rows into one cross-shard frame. Consuming the
+        dirty sets here competes with nothing: a router-registered CQ
+        has no local subscribers, so the shard-local publish pass
+        never touches it."""
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        from opentsdb_tpu.query.engine import QueryEngine
+        tsq = self._emit_tsq(cq, now_ms)
+        clean = self._pump_groups(cq)
+        engine = QueryEngine(self.tsdb)
+        updates = self._collect_updates(cq, tsq, engine,
+                                        snapshot=False)
+        with cq.lock:
+            cq.emit_seq += 1
+            seq = cq.emit_seq
+        out = {"id": cq.id, "seq": seq, "ts": now_ms,
+               "updates": updates, "clean": clean}
+        if cq.policy is not None:
+            try:
+                out["completeness"] = completeness_marker(
+                    self, cq, tsq.end_ms)
+            except Exception:  # noqa: BLE001 - flag, never fail the drain
+                out["completeness"] = {"degraded": True}
+        cq.last_publish = time.monotonic()
+        return out
+
+    def _publish(self, cq: ContinuousQuery, snapshot: bool,
+                 only: list | None = None) -> bool:
+        from opentsdb_tpu.streaming import sse
+        from opentsdb_tpu.query.engine import QueryEngine
+        now_ms = int(time.time() * 1000)
+        try:
+            tsq = self._emit_tsq(cq, now_ms)
+        except BadRequestError:
+            return False
+        engine = QueryEngine(self.tsdb)
+        updates = self._collect_updates(cq, tsq, engine, snapshot)
         # ONE critical section for seq + target snapshot + history
         # append: a subscriber resuming concurrently either appears in
         # `targets` (gets the frame live) or subscribes after — and
         # then its replay reads a history that already holds this
         # frame. Split sections would let a frame slip between its
         # target snapshot and its history append, lost to both paths.
+        completeness = None
+        if cq.policy is not None:
+            try:
+                completeness = completeness_marker(self, cq,
+                                                   tsq.end_ms)
+            except Exception:  # noqa: BLE001 - push degrades, never dies
+                # the frame still ships (subscribers keep their data
+                # feed) but is FLAGGED: no silent "complete" claim
+                completeness = {"degraded": True}
         with cq.lock:
             cq.emit_seq += 1
             seq = cq.emit_seq
@@ -910,6 +1062,8 @@ class ContinuousQueryRegistry:
                 return False
             payload = {"id": cq.id, "seq": seq, "ts": now_ms,
                        "updates": updates}
+            if completeness is not None:
+                payload["completeness"] = completeness
             fr = sse.frame("snapshot" if snapshot else "windows",
                            payload, event_id=seq)
             if not snapshot and self.resume_events > 0:
@@ -939,8 +1093,9 @@ class ContinuousQueryRegistry:
 
     def _totals(self) -> dict[str, int]:
         t = {"points_folded": 0, "folds": 0, "late_dropped": 0,
-             "preboundary_dropped": 0, "pending_points": 0,
-             "series": 0, "plans": 0, "groups": 0}
+             "late_refolded": 0, "preboundary_dropped": 0,
+             "pending_points": 0, "series": 0, "plans": 0,
+             "groups": 0, "ring_bytes": 0}
         with self._lock:
             groups = list(self._partials)
             t["plans"] = sum(len(cq.plans)
@@ -949,11 +1104,71 @@ class ContinuousQueryRegistry:
             t["points_folded"] += g.points_folded
             t["folds"] += g.folds
             t["late_dropped"] += g.late_dropped
+            t["late_refolded"] += g.late_refolded
             t["preboundary_dropped"] += g.preboundary_dropped
             t["pending_points"] += g.pending_points
             t["series"] += len(g._sids)
             t["groups"] += 1
+            t["ring_bytes"] += g.ring_bytes()
         return t
+
+    def fold_bytes(self) -> int:
+        """Actual resident fold memory across every shared partial —
+        the number the control-plane miner and the per-tenant QoS
+        fold budget account against."""
+        with self._lock:
+            groups = list(self._partials)
+        return sum(g.ring_bytes() for g in groups)
+
+    def tenant_fold_bytes(self, tenant: str) -> int:
+        """Actual resident fold memory attributed to one tenant's
+        registrations (a shared partial counts once per CQ riding
+        it — deliberately conservative for a budget)."""
+        return sum(cq.fold_bytes() for cq in self.list()
+                   if getattr(cq, "tenant", None) == tenant)
+
+    def projected_fold_bytes(self, obj: dict) -> int:
+        """Projected resident ring bytes registering ``obj`` would
+        ADD: per sub-query, the window count registration would size
+        (range + pipeline lead + lateness columns) times a per-window
+        row estimate — live partials on the same metric give the row
+        count (their membership is ground truth), a cold metric
+        projects one row. Feeds the QoS fold-budget gate and the
+        control-plane miner's memory penalty; returns 0 for shapes
+        that cannot register anyway (they fail their own 400 path)."""
+        from opentsdb_tpu.streaming.eventtime import WatermarkPolicy
+        from opentsdb_tpu.streaming.plan import WindowSpec
+        try:
+            tsq = TSQuery.from_json(
+                {k: v for k, v in obj.items()
+                 if k not in ("id", "window", "watermark")})
+            tsq.validate()
+            policy = WatermarkPolicy.from_json(obj.get("watermark"))
+        except Exception:  # noqa: BLE001 - unregisterable shape
+            return 0
+        total = 0
+        for sub in tsq.queries:
+            spec = sub.ds_spec
+            if spec is None or spec.interval_ms <= 0:
+                continue
+            try:
+                window = WindowSpec.from_json(obj.get("window"),
+                                              spec.interval_ms)
+            except BadRequestError:
+                return 0
+            lat_b = policy.lateness_buckets(spec.interval_ms) \
+                if policy is not None else 0
+            windows = int((tsq.end_ms - tsq.start_ms)
+                          // spec.interval_ms) + 2 \
+                + window.lead_for(spec.interval_ms) + lat_b
+            rows = 1
+            with self._lock:
+                for g in self._partials:
+                    if g.metric == sub.metric:
+                        rows = max(rows, len(g._sids))
+            # 4 f8 channels + the shared win_ts row
+            total += windows * (rows * 32 + 8)
+        return total
 
     def collect_stats(self, collector) -> None:
         t = self._totals()
@@ -973,8 +1188,11 @@ class ContinuousQueryRegistry:
                          t["pending_points"])
         collector.record("streaming.points.late_dropped",
                          t["late_dropped"])
+        collector.record("streaming.points.late_refolded",
+                         t["late_refolded"])
         collector.record("streaming.points.preboundary_dropped",
                          t["preboundary_dropped"])
+        collector.record("streaming.fold.bytes", t["ring_bytes"])
         collector.record("streaming.serve.hits", self.serve_hits)
         collector.record("streaming.serve.fallbacks",
                          self.serve_fallbacks)
@@ -1021,7 +1239,9 @@ class ContinuousQueryRegistry:
             "points_folded": t["points_folded"],
             "pending_points": t["pending_points"],
             "late_dropped": t["late_dropped"],
+            "late_refolded": t["late_refolded"],
             "preboundary_dropped": t["preboundary_dropped"],
+            "fold_bytes": t["ring_bytes"],
             "serve_hits": self.serve_hits,
             "serve_fallbacks": self.serve_fallbacks,
             "fold_errors": self.fold_errors,

@@ -725,14 +725,14 @@ class HttpRpcRouter:
         from opentsdb_tpu.auth.simple import Permissions
         self._check_permission(request, Permissions.HTTP_QUERY)
         sub = rest[0] if rest else ""
-        if sub in ("continuous", "exp", "gexp") \
-                and self.tsdb.cluster is not None:
+        if sub in ("exp", "gexp") and self.tsdb.cluster is not None:
             # the router owns no data: these endpoints would silently
             # run against its EMPTY local store and answer "no such
             # name" / empty streams for series that exist in the
             # cluster. Refuse loudly until they learn to scatter
             # (ROADMAP follow-up); plain /api/query merges shards,
-            # /api/query/last scatters per shard (newest point wins).
+            # /api/query/last scatters per shard (newest point wins),
+            # /api/query/continuous federates per-shard partials.
             raise HttpError(
                 400,
                 f"/api/query/{sub} is not supported in router mode",
@@ -945,26 +945,41 @@ class HttpRpcRouter:
           pull surface for sliding/session windows, which a plain
           TSQuery cannot express).
         - ``DELETE /api/query/continuous/<id>`` — deregister.
+        - ``GET /api/query/continuous/<id>/deltas`` — one incremental
+          update batch (the federated router's dirty-window drain; a
+          pull twin of one SSE ``windows`` frame).
         - ``GET /api/query/continuous/<id>/stream`` — Server-Sent
           Events: an initial ``snapshot`` event, then incremental
           ``windows`` events; slow consumers are shed with a terminal
           ``shed`` event (bounded queues, never backpressure into
-          ingest)."""
-        registry = self.tsdb.streaming
+          ingest).
+
+        In router mode the same surface serves FEDERATED continuous
+        queries (:mod:`opentsdb_tpu.cluster.cq`): registrations
+        scatter to every shard, pulls merge per-shard partials, and
+        the SSE stream pushes merged cross-shard frames."""
+        if self.tsdb.cluster is not None:
+            registry = self.tsdb.cluster.cqs
+        else:
+            registry = self.tsdb.streaming
         if registry is None:
             raise HttpError(400, "Continuous queries are disabled",
                             "set tsd.streaming.enable = true")
         if not rest:
             if request.method == "POST":
+                obj = request.json_object()
                 ctl = self.tsdb._control
                 tenant = None
                 if ctl is not None and ctl.qos.enabled:
                     # per-tenant fold-memory budget: standing rings
                     # are the one resource a tenant holds FOREVER, so
-                    # the quota gates registration, not serving
+                    # the quota gates registration, not serving (and
+                    # the candidate body feeds the projected-size
+                    # refusal of never-fitting shapes)
                     tenant = ctl.qos.tenant_of(request.headers)
                     if not ctl.qos.fold_budget_allows(tenant,
-                                                      registry):
+                                                      registry,
+                                                      body=obj):
                         raise HttpError(
                             400, "tenant fold-memory budget "
                             "exhausted",
@@ -972,7 +987,7 @@ class HttpRpcRouter:
                             "tsd.control.qos.tenant_fold_mb of "
                             "standing continuous-query state; "
                             "delete one or raise the budget")
-                cq = registry.register(request.json_object())
+                cq = registry.register(obj)
                 if tenant is not None:
                     cq.tenant = tenant
                 return HttpResponse(
@@ -991,6 +1006,19 @@ class HttpRpcRouter:
                     404, f"No continuous query with id {cid!r}")
             return HttpResponse(200, json.dumps(
                 registry.current_results(cq)).encode())
+        if len(rest) > 1 and rest[1] == "deltas":
+            if request.method != "GET":
+                raise HttpError(405, "Method not allowed")
+            if not hasattr(registry, "delta_updates"):
+                raise HttpError(
+                    400, "deltas is a shard-local drain surface",
+                    "the router consumes it; use /stream or /result")
+            cq = registry.get(cid)
+            if cq is None:
+                raise HttpError(
+                    404, f"No continuous query with id {cid!r}")
+            return HttpResponse(200, json.dumps(
+                registry.delta_updates(cq)).encode())
         if len(rest) > 1 and rest[1] == "stream":
             if request.method != "GET":
                 raise HttpError(405, "Method not allowed")

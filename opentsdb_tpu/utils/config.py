@@ -405,6 +405,7 @@ _DECLARED_EXTRA: frozenset[str] = frozenset({
     "tsd.control.materialize.max",
     "tsd.control.materialize.min_score",
     "tsd.control.materialize.hysteresis",
+    "tsd.control.materialize.mem_penalty_mb",
     "tsd.control.tenant.tag",
     "tsd.control.tenant.header",
     "tsd.control.qos.enable",

@@ -67,6 +67,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "meta.store",         # meta/meta_store.py write paths
     "stream.fold",        # streaming/registry.py incremental fold
     "stream.worker",      # streaming/workers.py off-path drain
+    "stream.watermark",   # eventtime/watermark.py marker builder
     "lifecycle.sweep",    # lifecycle/manager.py whole sweep
     "lifecycle.demote",   # lifecycle/manager.py demotion fold
     "lifecycle.histogram",  # lifecycle/manager.py histogram demotion
@@ -77,6 +78,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "cluster.retire",     # cluster/retire.py stale-copy delete step
     "cluster.gossip",     # cluster/gossip.py sibling-router push
     "cluster.wire",       # cluster/wire.py router-side wire exchange
+    "cluster.cq",         # cluster/cq.py federated CQ shard exchange
     "control.materialize",  # control/plane.py shape-miner actuator
     "control.qos",        # control/plane.py tenant-share recompute
     "control.placement",  # control/plane.py placement planner
@@ -85,7 +87,8 @@ KNOWN_SITES: frozenset[str] = frozenset({
 # site families with runtime-named tails (per-peer arming)
 DYNAMIC_SITE_PREFIXES: tuple[str, ...] = ("cluster.peer.",
                                           "cluster.gossip.",
-                                          "cluster.wire.")
+                                          "cluster.wire.",
+                                          "cluster.cq.")
 
 
 def is_known_site(site: str) -> bool:

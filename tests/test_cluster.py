@@ -806,9 +806,10 @@ class TestScatterGather:
         # that exists in the cluster (or acking an annotation/rollup
         # into a store no read merges). /api/suggest,
         # /api/search/lookup and /api/query/last scatter now
-        # (TestRouterSuggestSearch, TestRouterQueryLast).
+        # (TestRouterSuggestSearch, TestRouterQueryLast), and
+        # /api/query/continuous federates (cluster/cq.py,
+        # tests/test_eventtime_cluster.py).
         for path in ("/api/query/exp", "/api/query/gexp",
-                     "/api/query/continuous",
                      "/api/search/graph",
                      "/api/uid/assign", "/api/annotation",
                      "/api/tree", "/api/rollup", "/api/histogram"):

@@ -1,0 +1,522 @@
+"""Event-time streaming battery (``-m eventtime``): watermarks,
+allowed-lateness refolds, hopping windows, session-by-tag partials.
+
+Oracle discipline mirrors the streaming-v2 battery: every windowed
+value is checked against a combine of the BATCH engine's tumbling
+grids by the same decomposition rule —
+
+- **watermark refold == cold batch within lateness**: a policy CQ fed
+  late points inside the allowed-lateness horizon answers
+  value-identical to the batch engine over the same store; a point
+  past the horizon is dropped AND counted (``lateDropped`` in the
+  completeness marker), never folded and never silent.
+- **hopping == sliding subsampled**: the hopping view's value at a
+  slide-aligned edge equals the trailing-k combine of the batch
+  tumbling grid at that edge, and ONLY slide-aligned edges emit.
+- **session-by-tag == per-user gap split**: rows are keyed by the
+  session tag's value (N member series of one user collide into one
+  row), and each row's sessions equal the gap-split of the batch
+  grid over all that user's series.
+- **markers are load-bearing**: an armed ``stream.watermark`` fault
+  503s the pull and degrades the push marker — results are never
+  silently stripped of their completeness contract.
+
+The whole module runs under BOTH runtime witnesses (lock-order +
+thread/fd leak), per the repo rule for new concurrency.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from opentsdb_tpu.query.model import BadRequestError, TSQuery
+from opentsdb_tpu.streaming.eventtime import WatermarkPolicy
+from opentsdb_tpu.tsd.http_api import HttpRequest, HttpRpcRouter
+
+pytestmark = [pytest.mark.streaming, pytest.mark.eventtime]
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _witnessed(lock_witness, leak_witness):
+    """Lock-order + leak witnesses over the whole battery (see
+    conftest): event-time adds fold/marker paths under the partial
+    lock, and the fault tests build/tear whole registries."""
+    return lock_witness
+
+
+BASE = 1356998400
+BASE_MS = BASE * 1000
+IV_MS = 60_000
+END_MS = BASE_MS + 1800 * 1000
+
+
+def _tsdb(**extra):
+    cfg = {"tsd.core.auto_create_metrics": "true",
+           "tsd.tpu.warmup": "false"}
+    cfg.update(extra)
+    return TSDB(Config(**cfg))
+
+
+def _qobj(agg="sum", ds="1m-sum", metric="e.m", window=None,
+          watermark=None, gb=None, start=BASE_MS, end=END_MS):
+    sub = {"metric": metric, "aggregator": agg, "downsample": ds}
+    if gb:
+        sub["filters"] = [{"type": "wildcard", "tagk": gb,
+                           "filter": "*", "groupBy": True}]
+    q = {"start": start, "end": end, "queries": [sub]}
+    if window:
+        q["window"] = window
+    if watermark:
+        q["watermark"] = watermark
+    return q
+
+
+def _run_batch(t, qobj):
+    t.config.override_config("tsd.streaming.serve", "false")
+    t.config.override_config("tsd.query.cache.enable", "false")
+    try:
+        return t.execute_query(TSQuery.from_json(qobj).validate())
+    finally:
+        t.config.override_config("tsd.streaming.serve", "true")
+        t.config.override_config("tsd.query.cache.enable", "true")
+
+
+def _split_marker(rows):
+    assert rows and "completeness" in rows[-1], \
+        "policy CQ answered without a completeness marker"
+    return rows[:-1], rows[-1]["completeness"]
+
+
+def _row_dps(row):
+    return {int(k): v for k, v in row["dps"].items()
+            if v is not None and v == v}
+
+
+def req(method, path, body=None, **params):
+    return HttpRequest(
+        method=method, path=path,
+        params={k: [str(v)] for k, v in params.items()},
+        body=json.dumps(body).encode() if body is not None else b"")
+
+
+# ---------------------------------------------------------------------------
+# policy / window-spec validation
+# ---------------------------------------------------------------------------
+
+class TestPolicyValidation:
+    def test_from_json_shapes(self):
+        assert WatermarkPolicy.from_json(None) is None
+        assert WatermarkPolicy.from_json({}) is None
+        p = WatermarkPolicy.from_json({"allowedLateness": "5m"})
+        assert p.lateness_ms == 300_000
+        assert p.to_json() == {"allowedLatenessMs": 300_000}
+        for bad in ("5m", {"allowedLateness": ""},
+                    {"allowedLateness": "0s"},
+                    {"allowedLateness": "nonsense"}):
+            with pytest.raises(BadRequestError):
+                WatermarkPolicy.from_json(bad)
+
+    def test_lateness_buckets_ceil(self):
+        p = WatermarkPolicy(150_000)
+        assert p.lateness_buckets(60_000) == 3  # ceil(2.5)
+        assert p.lateness_buckets(150_000) == 1
+
+    @pytest.mark.parametrize("window,needle", [
+        ({"type": "hopping", "size": "10m"}, "slide"),
+        ({"type": "hopping", "size": "10m", "slide": "1m"},
+         "exceed the downsample"),
+        ({"type": "hopping", "size": "2m", "slide": "2m"},
+         "exceed its slide"),
+        ({"type": "session", "gap": "2m", "by": 7}, "by"),
+    ])
+    def test_window_spec_refusals(self, window, needle):
+        t = _tsdb()
+        with pytest.raises(BadRequestError, match=needle):
+            t.streaming.register(_qobj(window=window), now_ms=END_MS)
+
+    def test_describe_roundtrips_policy_and_window(self):
+        t = _tsdb()
+        cq = t.streaming.register(
+            _qobj(window={"type": "hopping", "size": "10m",
+                          "slide": "2m"},
+                  watermark={"allowedLateness": "3m"}),
+            now_ms=END_MS)
+        doc = cq.describe()
+        assert doc["watermark"] == {"allowedLatenessMs": 180_000}
+        assert doc["windowSpec"]["slideMs"] == 120_000
+        assert doc["foldBytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# watermark refold / drop oracle
+# ---------------------------------------------------------------------------
+
+class TestWatermarkRefold:
+    LATENESS_S = 180
+
+    def _setup(self):
+        t = _tsdb()
+        cq = t.streaming.register(
+            _qobj(watermark={"allowedLateness":
+                             f"{self.LATENESS_S}s"}),
+            now_ms=END_MS)
+        # series-AT-A-TIME ingest on purpose: both hosts' chunks fold
+        # in one drain pass and the watermark commits per PASS
+        # (commit_watermark) — the first host's newest point must not
+        # mass-drop the second host's older half as "late"
+        for h in range(2):
+            ts = BASE + np.arange(50, dtype=np.int64) * 30 + h
+            t.add_points("e.m", ts, (np.arange(50) % 7 + h).astype(
+                float), {"host": f"h{h}"})
+        t.streaming.flush()
+        return t, cq
+
+    def _assert_matches_batch(self, t, cq):
+        rows, marker = _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+        want = {}
+        for r in _run_batch(t, _qobj()):
+            for ts, v in r.dps:
+                if v == v:
+                    want[int(ts)] = v
+        got = _row_dps(rows[0])
+        assert got == pytest.approx(want), "streamed != cold batch"
+        return marker
+
+    def test_refold_within_lateness_matches_cold_batch(self):
+        t, cq = self._setup()
+        marker = self._assert_matches_batch(t, cq)
+        assert marker["lateDropped"] == 0
+        # a late point ~2m behind the newest event time (inside the
+        # 3m horizon) refolds into its already-published bucket;
+        # off-grid by 15s so it lands on no existing raw timestamp
+        # (a same-ts write would OVERWRITE in the batch store but
+        # add in the fold — a real divergence, not the one under
+        # test here)
+        late_ts = BASE + 49 * 30 - 105
+        t.add_point("e.m", late_ts, 100.0, {"host": "h0"})
+        t.streaming.flush()
+        marker = self._assert_matches_batch(t, cq)
+        assert marker["lateRefolded"] >= 1
+        assert marker["lateDropped"] == 0
+        assert marker["latenessMs"] == self.LATENESS_S * 1000
+
+    def test_past_horizon_drop_is_counted_never_silent(self):
+        t, cq = self._setup()
+        before, _ = _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+        dead_ts = BASE  # 49*30s behind the watermark: final bucket
+        bucket = dead_ts * 1000 // IV_MS * IV_MS
+        t.add_point("e.m", dead_ts, 9999.0, {"host": "h0"})
+        t.streaming.flush()
+        rows, marker = _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+        assert marker["lateDropped"] == 1
+        # the dropped value must NOT have folded into the final
+        # bucket (the raw store still accepted the write)
+        assert _row_dps(rows[0])[bucket] == \
+            _row_dps(before[0])[bucket]
+        batch = {int(ts): v for r in _run_batch(t, _qobj())
+                 for ts, v in r.dps if v == v}
+        assert batch[bucket] == \
+            pytest.approx(_row_dps(before[0])[bucket] + 9999.0)
+
+    def test_completeness_flag_follows_watermark(self):
+        t, cq = self._setup()
+        marker = self._assert_matches_batch(t, cq)
+        # newest event time is far before END_MS: incomplete
+        assert marker["complete"] is False
+        assert marker["watermarkMs"] == \
+            (BASE + 49 * 30) * 1000 + 1000 - self.LATENESS_S * 1000
+        # advance event time past end + lateness: the emitted range
+        # is final
+        t.add_point("e.m", END_MS // 1000 + self.LATENESS_S + 60,
+                    1.0, {"host": "h0"})
+        t.streaming.flush()
+        _, marker = _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+        assert marker["complete"] is True
+
+    def test_policy_cq_excluded_from_query_fast_path(self):
+        """A strict-lateness partial drops points the raw store
+        accepted, so it can never answer a plain /api/query."""
+        t, cq = self._setup()
+        assert t.streaming.serve_hits == 0
+        res = t.execute_query(
+            TSQuery.from_json(_qobj()).validate())
+        assert res  # batch answered
+        assert t.streaming.serve_hits == 0
+
+
+# ---------------------------------------------------------------------------
+# hopping windows
+# ---------------------------------------------------------------------------
+
+class TestHoppingWindows:
+    SIZE_MS = 600_000   # 10m
+    SLIDE_MS = 120_000  # 2m
+
+    def _setup(self, fn="sum"):
+        t = _tsdb()
+        for h in range(2):
+            ts = BASE + np.arange(60, dtype=np.int64) * 25 + h
+            t.add_points("e.m", ts,
+                         np.linspace(1, 9, 60) + h, {"host": f"h{h}"})
+        # a gappy series exercises empty buckets inside windows
+        ts = np.arange(BASE, BASE + 1500, 300, dtype=np.int64)
+        t.add_points("e.m", ts, np.ones(len(ts)) * 5,
+                     {"host": "gap"})
+        cq = t.streaming.register(
+            _qobj(agg="none", ds=f"1m-{fn}",
+                  window={"type": "hopping", "size": "10m",
+                          "slide": "2m"}),
+            now_ms=END_MS)
+        return t, cq
+
+    def _channels(self, t):
+        out = {}
+        for fn in ("sum", "count", "min", "max"):
+            ch = {}
+            for r in _run_batch(t, _qobj(agg="none", ds=f"1m-{fn}")):
+                key = tuple(sorted(r.tags.items()))
+                for ts, v in r.dps:
+                    if v == v:
+                        ch[(key, int(ts))] = v
+            out[fn] = ch
+        return out
+
+    @pytest.mark.parametrize("fn", ["sum", "avg", "min", "max",
+                                    "count"])
+    def test_hopping_matches_sliding_subsample_oracle(self, fn):
+        """value at slide-aligned edge e == trailing-k combine of
+        the batch tumbling grid ending at e; no other edge emits."""
+        t, cq = self._setup(fn)
+        rows = t.streaming.current_results(cq, now_ms=END_MS)
+        assert rows, "no hopping results"
+        ch = self._channels(t)
+        k = self.SIZE_MS // IV_MS
+        checked = 0
+        for row in rows:
+            key = tuple(sorted(row["tags"].items()))
+            got = _row_dps(row)
+            assert got, key
+            assert all(e % self.SLIDE_MS == 0 for e in got), \
+                "hopping emitted a non-slide-aligned edge"
+            for e in got:
+                win = [e - j * IV_MS for j in range(k)]
+                s = sum(ch["sum"].get((key, w), 0.0) for w in win)
+                c = sum(ch["count"].get((key, w), 0.0) for w in win)
+                mn = min((ch["min"][(key, w)] for w in win
+                          if (key, w) in ch["min"]),
+                         default=float("inf"))
+                mx = max((ch["max"][(key, w)] for w in win
+                          if (key, w) in ch["max"]),
+                         default=float("-inf"))
+                want = {"sum": s, "count": c,
+                        "avg": s / c if c else None,
+                        "min": mn, "max": mx}[fn]
+                assert c, (key, e)
+                assert got[e] == pytest.approx(want, rel=1e-9), \
+                    (key, e, got[e], want)
+                checked += 1
+        assert checked > 20, "vacuous oracle"
+
+    def test_hopping_excluded_from_query_fast_path(self):
+        t, cq = self._setup()
+        t.execute_query(
+            TSQuery.from_json(_qobj(agg="none",
+                                    ds="1m-sum")).validate())
+        assert t.streaming.serve_hits == 0
+
+
+# ---------------------------------------------------------------------------
+# session-by-tag partials
+# ---------------------------------------------------------------------------
+
+class TestSessionByTag:
+    GAP_MS = 120_000
+    N_USERS = 40
+
+    def _mk(self, watermark=None):
+        t = _tsdb()
+        rng = np.random.default_rng(5)
+        # per-user bursts: two activity runs separated by > gap for
+        # even users, one run for odd
+        for u in range(self.N_USERS):
+            ts0 = BASE + (u % 7) * 30
+            for burst, n in ((0, 4), (420 + (u % 3) * 60, 3))[
+                    : 2 if u % 2 == 0 else 1]:
+                ts = ts0 + burst + np.arange(n, dtype=np.int64) * 30
+                t.add_points("e.m", ts,
+                             rng.integers(1, 9, n).astype(float),
+                             {"user": f"u{u:03d}"})
+        cq = t.streaming.register(
+            _qobj(agg="none", ds="1m-sum",
+                  window={"type": "session", "gap": "2m",
+                          "by": "user"},
+                  watermark=watermark),
+            now_ms=END_MS)
+        return t, cq
+
+    def _oracle(self, t):
+        """gap-split of the batch tumbling grid, per user."""
+        per_user = {}
+        for r in _run_batch(t, _qobj(agg="none", ds="1m-sum")):
+            user = r.tags.get("user")
+            grid = per_user.setdefault(user, {})
+            for ts, v in r.dps:
+                if v == v:
+                    grid[int(ts)] = grid.get(int(ts), 0.0) + v
+        want = {}
+        for user, grid in per_user.items():
+            edges = sorted(grid)
+            sessions = [[edges[0]]]
+            for e in edges[1:]:
+                if e - sessions[-1][-1] > self.GAP_MS:
+                    sessions.append([])
+                sessions[-1].append(e)
+            want[user] = {s[0]: sum(grid[e] for e in s)
+                          for s in sessions}
+        return want
+
+    def test_sessions_match_batch_gap_split_per_user(self):
+        t, cq = self._mk()
+        rows = t.streaming.current_results(cq, now_ms=END_MS)
+        got = {row["tags"]["user"]: _row_dps(row) for row in rows}
+        want = self._oracle(t)
+        assert set(got) == set(want)
+        for user in want:
+            assert got[user] == pytest.approx(want[user]), user
+        # even users have two bursts > gap apart: two sessions
+        assert len(got["u000"]) == 2
+        assert len(got["u001"]) == 1
+
+    def test_member_series_collide_into_one_user_row(self):
+        """N series of one user are ONE row: the per-user aggregate,
+        whether the points arrived before (bootstrap scan) or after
+        (live fold) registration."""
+        t = _tsdb()
+        t.add_point("e.m", BASE, 3.0, {"user": "u1", "host": "a"})
+        t.add_point("e.m", BASE + 10, 4.0,
+                    {"user": "u1", "host": "b"})
+        cq = t.streaming.register(
+            _qobj(agg="none", ds="1m-sum",
+                  window={"type": "session", "gap": "2m",
+                          "by": "user"}),
+            now_ms=END_MS)
+        t.add_point("e.m", BASE + 20, 5.0,
+                    {"user": "u1", "host": "c"})
+        t.streaming.flush()
+        rows = t.streaming.current_results(cq, now_ms=END_MS)
+        assert len(rows) == 1
+        assert rows[0]["tags"] == {"user": "u1"}
+        assert _row_dps(rows[0]) == {BASE_MS // IV_MS * IV_MS: 12.0}
+        g = cq.plans[0].shared
+        assert len(g._vid_rows) == 1
+        assert len(g._member_sids) == 3
+
+    def test_series_without_session_tag_never_joins(self):
+        t, cq = self._mk()
+        t.add_point("e.m", BASE + 60, 1000.0, {"host": "stray"})
+        t.streaming.flush()
+        rows = t.streaming.current_results(cq, now_ms=END_MS)
+        assert all(r["tags"].get("user") for r in rows)
+        assert not any(1000.0 in _row_dps(r).values()
+                       for r in rows)
+
+    def test_gap_close_driven_by_watermark(self):
+        """Sessions close when the watermark passes last activity by
+        more than the gap — open/closed counts ride the marker."""
+        t, cq = self._mk(watermark={"allowedLateness": "1m"})
+        rows, marker = _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+        n_sessions = sum(len(_row_dps(r)) for r in rows)
+        assert marker["sessionsOpen"] + marker["sessionsClosed"] \
+            == self.N_USERS  # open/closed counts rows, not splits
+        assert n_sessions > self.N_USERS
+        assert marker["sessionsOpen"] > 0
+        # advance event time far past every gap: everything closes
+        t.add_point("e.m", BASE + 3000, 1.0, {"user": "u000"})
+        t.streaming.flush()
+        _, marker = _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+        assert marker["sessionsOpen"] == 1      # only the fresh row
+        assert marker["sessionsClosed"] == self.N_USERS - 1
+
+    def test_session_percentile_refused(self):
+        t = _tsdb()
+        with pytest.raises(BadRequestError):
+            t.streaming.register(
+                _qobj(agg="none", ds="1m-p95",
+                      window={"type": "session", "gap": "2m",
+                              "by": "user"}),
+                now_ms=END_MS)
+
+
+# ---------------------------------------------------------------------------
+# marker fault surface: degraded, never silent
+# ---------------------------------------------------------------------------
+
+class TestWatermarkFaults:
+    def _setup(self):
+        t = _tsdb()
+        http = HttpRpcRouter(t)
+        cq = t.streaming.register(
+            _qobj(watermark={"allowedLateness": "2m"}),
+            now_ms=END_MS)
+        t.add_point("e.m", BASE, 1.0, {"host": "h0"})
+        t.streaming.flush()
+        return t, http, cq
+
+    def test_armed_fault_503s_the_pull(self):
+        t, http, cq = self._setup()
+        t.faults.arm("stream.watermark", error_count=1)
+        resp = http.handle(req(
+            "GET", f"/api/query/continuous/{cq.id}/result"))
+        assert resp.status == 503
+        assert b"marker unavailable" in resp.body
+        # fault exhausted: the next pull answers with a marker
+        resp = http.handle(req(
+            "GET", f"/api/query/continuous/{cq.id}/result"))
+        assert resp.status == 200
+        rows = json.loads(resp.body)
+        assert "completeness" in rows[-1]
+        assert "watermarkMs" in rows[-1]["completeness"]
+
+    def test_armed_fault_degrades_the_push_marker(self):
+        t, http, cq = self._setup()
+        t.faults.arm("stream.watermark", error_count=1)
+        out = t.streaming.delta_updates(cq)
+        assert out["completeness"] == {"degraded": True}
+        out = t.streaming.delta_updates(cq)
+        assert out["completeness"].get("degraded") is None
+        assert "watermarkMs" in out["completeness"]
+
+    def test_delta_updates_drain_dirty_windows(self):
+        """The deltas surface (the federated router's drain) carries
+        exactly the refreshed buckets, seq-numbered."""
+        t, http, cq = self._setup()
+        first = t.streaming.delta_updates(cq, now_ms=END_MS)
+        t.add_point("e.m", BASE + 90, 7.0, {"host": "h0"})
+        # no flush: flush() force-publishes and would CONSUME the
+        # dirty set; delta_updates drains pending folds itself
+        out = t.streaming.delta_updates(cq, now_ms=END_MS)
+        assert out["seq"] > first["seq"]
+        edges = {int(k) for u in out["updates"] for k in u["dps"]}
+        assert (BASE + 90) * 1000 // IV_MS * IV_MS in edges
+        # the pull CONSUMED the dirty set: a fold-free second drain
+        # carries nothing
+        again = t.streaming.delta_updates(cq, now_ms=END_MS)
+        assert again["updates"] == [] and again["clean"] is True
+        # the HTTP surface the federated pump drains answers 200
+        # with the same shape (wall-clock emit range, so no synthetic
+        # 2013 dps — just the envelope + completeness marker)
+        resp = http.handle(req(
+            "GET", f"/api/query/continuous/{cq.id}/deltas"))
+        assert resp.status == 200
+        body = json.loads(resp.body)
+        assert body["id"] == cq.id and "completeness" in body

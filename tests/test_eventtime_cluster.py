@@ -1,0 +1,610 @@
+"""Cross-shard federated continuous queries (``-m eventtime`` on the
+cluster): router-registered CQs over the binary wire, merged pulls,
+SSE fan-out, chaos, and lifecycle refusals.
+
+Oracle discipline: the federated answer folds per-shard partials with
+the batch scatter's dict-fold combines over INTEGER workloads, so the
+merged pull must be **bit-identical** to a single-node TSDB that
+registered the same body and ingested the same points — not
+approximately equal. Rows are indexed by (sub index, metric, tags)
+before comparison because the federated surface sorts rows
+deterministically while the single-node registry serves in view
+order.
+
+Chaos contract under test (the ISSUE's acceptance bar):
+
+- one shard's death turns into a marker-carrying 200
+  (``shardsDegraded`` + ``complete: false``), never a 5xx, and the
+  surviving rows stay bit-identical to the oracle's rows for the
+  hosts the survivors own;
+- a shard that restarts with an empty registry is transparently
+  re-registered on first contact (the 404 path) and its partial
+  re-seeds from its store, so the next merged pull is whole again;
+- a REAL subprocess shard SIGKILLed mid-standing-query degrades the
+  same way (no in-process cleanup to lean on).
+
+The whole module runs under BOTH runtime witnesses (lock-order +
+thread/fd leak), per the repo rule for new concurrency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from opentsdb_tpu import TSDB, Config
+from test_cluster import (BASE, BASE_MS, LiveCluster, LivePeer,
+                          PEER_CFG, _free_port, _wait_port, req)
+from test_cluster import PEER_SCRIPT
+
+pytestmark = [pytest.mark.cluster, pytest.mark.eventtime]
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _witnessed(lock_witness, leak_witness):
+    """Lock-order + leak witnesses over the whole battery: federated
+    CQs add a wire frame type, a scatter fan-out and an SSE pump on
+    top of the router's thread pool."""
+    return lock_witness
+
+
+IV_MS = 60_000
+END_MS = BASE_MS + 1800 * 1000
+CQ = "/api/query/continuous"
+N_HOSTS = 12
+
+
+def _cq_body(cid, agg="sum", ds="1m-sum", metric="f.m", gb=None,
+             window=None, watermark=None):
+    sub = {"metric": metric, "aggregator": agg, "downsample": ds}
+    if gb:
+        sub["filters"] = [{"type": "wildcard", "tagk": gb,
+                           "filter": "*", "groupBy": True}]
+    body = {"id": cid, "start": BASE_MS, "end": END_MS,
+            "queries": [sub]}
+    if window:
+        body["window"] = window
+    if watermark:
+        body["watermark"] = watermark
+    return body
+
+
+def _points(metric="f.m", n_hosts=N_HOSTS, n_half_min=40):
+    """Integer values CONSTANT within every 1m downsample bucket, so
+    per-series partials are exact in float64 and every summation
+    order gives the same bits — the precondition for the merged ==
+    oracle bit-identity assertions below."""
+    pts = []
+    for i in range(n_half_min):
+        for h in range(n_hosts):
+            pts.append({"metric": metric, "timestamp": BASE + i * 30,
+                        "value": (h * 13 + (i // 2) * 7) % 50,
+                        "tags": {"host": f"h{h:02d}"}})
+    return pts
+
+
+def _session_points(metric="f.s", n_users=24):
+    """The canonical user-scale session shape: the session tag is the
+    series' ONLY tag, so one user = one series = one ring position
+    and every session timeline is shard-affine by construction. Two
+    bursts per user separated by far more than the session gap."""
+    pts = []
+    for u in range(n_users):
+        for t0 in (BASE + 60 * (u % 5), BASE + 900 + 60 * (u % 7)):
+            for i in range(4):
+                pts.append({"metric": metric, "timestamp": t0 + i * 30,
+                            "value": (u * 7 + i // 2) % 31,
+                            "tags": {"user": f"u{u:02d}"}})
+    return pts
+
+
+def _index_rows(rows):
+    """Rows keyed by identity (the two surfaces order rows
+    differently); values are the raw dps dicts, compared with ``==``
+    for bit-identity."""
+    out = {}
+    for r in rows:
+        key = (int(r.get("index") or 0), r["metric"],
+               tuple(sorted(r["tags"].items())))
+        assert key not in out, f"duplicate merged row {key}"
+        out[key] = r["dps"]
+    return out
+
+
+def _split_marker(rows):
+    if rows and "completeness" in rows[-1] \
+            and "metric" not in rows[-1]:
+        return rows[:-1], rows[-1]["completeness"]
+    return rows, None
+
+
+def _oracle_rows(body, points, extra=()):
+    """Single-node oracle: same registration body, same points, one
+    registry — the federated pull must reproduce these bits."""
+    t = TSDB(Config(**PEER_CFG))
+    try:
+        cq = t.streaming.register(dict(body), now_ms=END_MS)
+        for dp in list(points) + list(extra):
+            t.add_point(dp["metric"], dp["timestamp"], dp["value"],
+                        dp["tags"])
+        return _split_marker(
+            t.streaming.current_results(cq, now_ms=END_MS))
+    finally:
+        t.shutdown()
+
+
+def _register(c, body):
+    resp = c.http.handle(req("POST", CQ, body))
+    assert resp.status == 200, resp.body
+    return json.loads(resp.body)
+
+
+def _pull(c, cid):
+    resp = c.http.handle(req("GET", f"{CQ}/{cid}/result"))
+    assert resp.status == 200, resp.body
+    return _split_marker(json.loads(resp.body))
+
+
+# ---------------------------------------------------------------------------
+# merged pull == single-node oracle (bit-identical)
+# ---------------------------------------------------------------------------
+
+class TestFederatedPullOracle:
+    def _cluster(self, tmp_path, **cfg):
+        return LiveCluster(tmp_path, n=3, **cfg)
+
+    @pytest.mark.parametrize("agg,gb", [
+        ("sum", None), ("sum", "host"), ("min", "host"),
+        ("none", None),
+    ])
+    def test_merged_pull_bit_identical(self, tmp_path, agg, gb):
+        c = self._cluster(tmp_path)
+        try:
+            body = _cq_body("fed-1", agg=agg, gb=gb,
+                            watermark={"allowedLateness": "3m"})
+            doc = _register(c, body)
+            assert doc["federated"] is True
+            assert set(doc["shards"]) == {"s0", "s1", "s2"}
+            pts = _points()
+            assert json.loads(c.put(pts, summary="true").body)[
+                "failed"] == 0
+            rows, marker = _pull(c, "fed-1")
+            want, _ = _oracle_rows(body, pts)
+            assert _index_rows(rows) == _index_rows(want)
+            assert marker is not None
+            assert marker["lateDropped"] == 0
+            assert "shardsDegraded" not in marker
+            # the exchanges rode the persistent binary wire
+            assert c.router.cqs.wire_ops > 0
+        finally:
+            c.close()
+
+    def test_completeness_spans_every_shard(self, tmp_path):
+        """The merged watermark is the MINIMUM over shards: the range
+        is only final once every shard's event time has passed
+        end + lateness."""
+        c = self._cluster(tmp_path)
+        try:
+            body = _cq_body("fed-wm",
+                            watermark={"allowedLateness": "2m"})
+            _register(c, body)
+            pts = _points()
+            assert c.put(pts, summary="true").status == 200
+            _, marker = _pull(c, "fed-wm")
+            assert marker["complete"] is False
+            # advance event time past end + lateness on EVERY series
+            # (hence every shard holding part of the metric)
+            adv = [{"metric": "f.m",
+                    "timestamp": END_MS // 1000 + 180,
+                    "value": 1, "tags": {"host": f"h{h:02d}"}}
+                   for h in range(N_HOSTS)]
+            assert c.put(adv, summary="true").status == 200
+            _, marker = _pull(c, "fed-wm")
+            assert marker["complete"] is True
+            assert marker["watermarkMs"] >= END_MS
+        finally:
+            c.close()
+
+    def test_session_windows_federate_per_user(self, tmp_path):
+        """Session rows keyed by the ``user`` tag merge across shards
+        bit-identically to the single-node oracle, and the merged
+        marker sums per-shard open/closed session counts to the
+        oracle's totals (users partition across shards)."""
+        c = self._cluster(tmp_path)
+        try:
+            body = _cq_body(
+                "fed-sess", agg="none", metric="f.s",
+                window={"type": "session", "gap": "2m",
+                        "by": "user"},
+                watermark={"allowedLateness": "2m"})
+            _register(c, body)
+            pts = _session_points()
+            assert json.loads(c.put(pts, summary="true").body)[
+                "failed"] == 0
+            rows, marker = _pull(c, "fed-sess")
+            want, om = _oracle_rows(body, pts)
+            assert _index_rows(rows) == _index_rows(want)
+            # one row per user actually present
+            users = {r["tags"].get("user") for r in rows}
+            assert len(users) == 24
+            assert marker["sessionsOpen"] == om["sessionsOpen"]
+            assert marker["sessionsClosed"] == om["sessionsClosed"]
+            assert marker["sessionsClosed"] > 0
+        finally:
+            c.close()
+
+    def test_http_fallback_when_wire_disabled(self, tmp_path):
+        """``tsd.cluster.wire.enable=false`` gates the frames off:
+        every CQ op rides JSON HTTP and the merged pull is the same
+        bits."""
+        c = self._cluster(
+            tmp_path, **{"tsd.cluster.wire.enable": "false"})
+        try:
+            body = _cq_body("fed-http",
+                            watermark={"allowedLateness": "3m"})
+            _register(c, body)
+            pts = _points()
+            assert c.put(pts, summary="true").status == 200
+            rows, _ = _pull(c, "fed-http")
+            want, _ = _oracle_rows(body, pts)
+            assert _index_rows(rows) == _index_rows(want)
+            assert c.router.cqs.wire_ops == 0
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# merged push: snapshot + dirty-window deltas over SSE
+# ---------------------------------------------------------------------------
+
+def _parse_frame(fr: bytes):
+    ev, data = None, None
+    for line in fr.decode().splitlines():
+        if line.startswith("event: "):
+            ev = line[7:]
+        elif line.startswith("data: "):
+            data = json.loads(line[6:])
+    return ev, data
+
+
+class TestFederatedPush:
+    def test_snapshot_then_merged_delta_frames(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            body = _cq_body("fed-sse",
+                            watermark={"allowedLateness": "3m"})
+            _register(c, body)
+            pts = _points(n_half_min=20)
+            assert c.put(pts, summary="true").status == 200
+            fcq = c.router.cqs.get("fed-sse")
+            sub = c.router.cqs.subscribe(fcq)
+            try:
+                ev, doc = _parse_frame(sub.queue.get(timeout=10))
+                assert ev == "snapshot"
+                want, _ = _oracle_rows(body, pts)
+                assert _index_rows(doc["updates"]) == \
+                    _index_rows(want)
+                assert doc["completeness"]["complete"] is False
+                # drain the per-shard dirty sets once; a fold-free
+                # pump then publishes nothing
+                c.router.cqs.pump(fcq)
+                while not sub.queue.empty():
+                    sub.queue.get_nowait()
+                assert c.router.cqs.pump(fcq) is False
+                # a new bucket dirties exactly its shard; the merged
+                # frame carries it to the one subscriber
+                late = [{"metric": "f.m", "timestamp": BASE + 1200,
+                         "value": 5, "tags": {"host": "h00"}}]
+                assert c.put(late, summary="true").status == 200
+                assert c.router.cqs.pump(fcq) is True
+                ev, doc = _parse_frame(sub.queue.get(timeout=10))
+                assert ev == "windows"
+                edge = str((BASE + 1200) * 1000 // IV_MS * IV_MS)
+                assert any(edge in u["dps"] for u in doc["updates"])
+            finally:
+                c.router.cqs.unsubscribe(fcq, sub)
+        finally:
+            c.close()
+
+    def test_stream_endpoint_serves_merged_snapshot(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            _register(c, _cq_body("fed-st"))
+            assert c.put(_points(n_half_min=4),
+                         summary="true").status == 200
+            resp = c.http.handle(req("GET", f"{CQ}/fed-st/stream"))
+            assert resp.status == 200
+            assert resp.content_type.startswith("text/event-stream")
+            it = iter(resp.body_iter)
+            assert next(it).startswith(b"retry:")
+            ev, doc = _parse_frame(next(it))
+            assert ev == "snapshot" and doc["id"] == "fed-st"
+            assert doc["updates"]
+            it.close()
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: shard death, restart survival, subprocess SIGKILL
+# ---------------------------------------------------------------------------
+
+class TestFederatedChaos:
+    def test_shard_death_is_a_marker_carrying_200(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            body = _cq_body("fed-chaos", gb="host",
+                            watermark={"allowedLateness": "3m"})
+            _register(c, body)
+            pts = _points()
+            assert c.put(pts, summary="true").status == 200
+            want = _index_rows(_oracle_rows(body, pts)[0])
+            dead = c.shard_of("f.m", {"host": "h00"})
+            c.peer(dead).kill()
+            rows, marker = _pull(c, "fed-chaos")
+            assert marker["shardsDegraded"] == [dead]
+            assert marker["complete"] is False
+            # surviving rows are still bit-identical to the oracle's
+            # rows for the hosts the survivors own — degradation
+            # never perturbs what CAN be answered
+            got = _index_rows(rows)
+            assert got
+            for key, dps in got.items():
+                assert dps == want[key]
+            survivors = {
+                key for key in want
+                if c.shard_of("f.m", dict(key[2])) != dead}
+            assert set(got) == survivors
+            # resurrection: the next pull is whole again
+            c.peer(dead).restart()
+            rows, marker = _pull(c, "fed-chaos")
+            assert marker.get("shardsDegraded") is None
+            assert _index_rows(rows) == want
+        finally:
+            c.close()
+
+    def test_restart_with_empty_registry_reregisters(self, tmp_path):
+        """A shard that lost its registry (restart) answers 404; the
+        router re-registers from the stored body — the partial
+        re-seeds from the shard's store — and retries, so the merged
+        pull is whole without operator action."""
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            body = _cq_body("fed-rr", gb="host",
+                            watermark={"allowedLateness": "3m"})
+            _register(c, body)
+            pts = _points()
+            assert c.put(pts, summary="true").status == 200
+            want = _index_rows(_oracle_rows(body, pts)[0])
+            victim = c.shard_of("f.m", {"host": "h00"})
+            assert c.peer(victim).tsdb.streaming.delete("fed-rr")
+            before = c.router.cqs.reregisters
+            rows, marker = _pull(c, "fed-rr")
+            assert c.router.cqs.reregisters == before + 1
+            assert marker.get("shardsDegraded") is None
+            assert _index_rows(rows) == want
+            # the CQ keeps standing: post-restart writes fold on the
+            # re-registered shard too
+            extra = [{"metric": "f.m", "timestamp": BASE + 1230,
+                      "value": 4, "tags": {"host": "h00"}}]
+            assert c.put(extra, summary="true").status == 200
+            rows, _ = _pull(c, "fed-rr")
+            want2 = _index_rows(_oracle_rows(body, pts,
+                                             extra=extra)[0])
+            assert _index_rows(rows) == want2
+        finally:
+            c.close()
+
+    def test_sigkill_subprocess_shard_degrades_not_500s(self,
+                                                        tmp_path):
+        """One of three shards is a REAL process; SIGKILL mid-standing
+        -query. The merged pull answers 200 with the dead shard in
+        ``shardsDegraded`` and the survivors' rows intact."""
+        script = tmp_path / "peer.py"
+        script.write_text(PEER_SCRIPT)
+        port = _free_port()
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(port),
+             str(tmp_path / "sub-data")],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        inproc = [LivePeer("s0"), LivePeer("s1")]
+        rt = None
+        try:
+            assert _wait_port(port), "subprocess peer did not start"
+            from opentsdb_tpu.tsd.http_api import HttpRpcRouter
+            spec = (f"s0=127.0.0.1:{inproc[0].port},"
+                    f"s1=127.0.0.1:{inproc[1].port},"
+                    f"sub=127.0.0.1:{port}")
+            rt = TSDB(Config(**{
+                "tsd.cluster.role": "router",
+                "tsd.cluster.peers": spec,
+                "tsd.cluster.timeout_ms": "4000",
+                "tsd.tpu.warmup": "false",
+            }))
+            http = HttpRpcRouter(rt)
+            rt.cluster.start()
+            body = _cq_body("fed-sk", gb="host",
+                            watermark={"allowedLateness": "3m"})
+            resp = http.handle(req("POST", CQ, body))
+            assert resp.status == 200, resp.body
+            pts = _points(n_half_min=20)
+            resp = http.handle(req("POST", "/api/put", pts,
+                                   summary="true"))
+            assert json.loads(resp.body)["failed"] == 0
+            # warm one merged pull with everyone alive
+            resp = http.handle(req("GET", f"{CQ}/fed-sk/result"))
+            assert resp.status == 200
+            proc.kill()
+            proc.wait(10)
+            resp = http.handle(req("GET", f"{CQ}/fed-sk/result"))
+            assert resp.status == 200
+            rows, marker = _split_marker(json.loads(resp.body))
+            assert marker["shardsDegraded"] == ["sub"]
+            assert marker["complete"] is False
+            dead_hosts = {
+                f"h{h:02d}" for h in range(N_HOSTS)
+                if rt.cluster.ring.shard_for(
+                    "f.m", {"host": f"h{h:02d}"}) == "sub"}
+            assert {r["tags"]["host"] for r in rows} == \
+                {f"h{h:02d}" for h in range(N_HOSTS)} - dead_hosts
+        finally:
+            if rt is not None:
+                rt.shutdown()
+            for p in inproc:
+                p.stop()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: registration refusals, rollback, delete, router surfaces
+# ---------------------------------------------------------------------------
+
+class TestFederatedLifecycle:
+    def test_rf_gt_1_refused(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3, **{"tsd.cluster.rf": "2"})
+        try:
+            resp = c.http.handle(req("POST", CQ, _cq_body("fed-rf")))
+            assert resp.status == 400
+            assert b"rf=1" in resp.body
+            for p in c.peers:
+                assert p.tsdb.streaming.list() == []
+        finally:
+            c.close()
+
+    def test_non_decomposable_aggregator_refused(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            resp = c.http.handle(req(
+                "POST", CQ, _cq_body("fed-dev", agg="dev")))
+            assert resp.status == 400
+            assert b"does not decompose" in resp.body
+            for p in c.peers:
+                assert p.tsdb.streaming.list() == []
+        finally:
+            c.close()
+
+    def test_shard_refusal_rolls_back_every_leg(self, tmp_path):
+        """The router does not duplicate shard-side window
+        validation: a body only the shards can refuse (hopping with
+        no slide) must 400 verbatim AND leave no half-registered
+        standing query on any shard."""
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            resp = c.http.handle(req(
+                "POST", CQ,
+                _cq_body("fed-half",
+                         window={"type": "hopping", "size": "10m"})))
+            assert resp.status == 400
+            assert b"shard s" in resp.body
+            assert b"slide" in resp.body
+            for p in c.peers:
+                assert p.tsdb.streaming.list() == []
+        finally:
+            c.close()
+
+    def test_register_refused_during_reshard(self, tmp_path):
+        c = LiveCluster(tmp_path, durable=True, **{
+            "tsd.cluster.reshard.interval_ms": "3600000",
+            "tsd.cluster.retire.interval_ms": "3600000"})
+        extra = LivePeer("s3")
+        try:
+            spec = c.cfg["tsd.cluster.peers"] + \
+                f",s3=127.0.0.1:{extra.port}"
+            resp = c.http.handle(req("POST", "/api/cluster/reshard",
+                                     {"peers": spec}))
+            assert resp.status == 200, resp.body
+            resp = c.http.handle(req("POST", CQ, _cq_body("fed-rs")))
+            assert resp.status == 400
+            assert b"reshard" in resp.body
+        finally:
+            c.close()
+            extra.stop()
+
+    def test_duplicate_id_refused(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            _register(c, _cq_body("fed-dup"))
+            resp = c.http.handle(req("POST", CQ, _cq_body("fed-dup")))
+            assert resp.status == 400
+            assert b"already registered" in resp.body
+        finally:
+            c.close()
+
+    def test_delete_propagates_to_every_shard(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            _register(c, _cq_body("fed-del"))
+            for p in c.peers:
+                assert [q.id for q in p.tsdb.streaming.list()] == \
+                    ["fed-del"]
+            resp = c.http.handle(req("DELETE", f"{CQ}/fed-del"))
+            assert resp.status == 204
+            for p in c.peers:
+                assert p.tsdb.streaming.list() == []
+            resp = c.http.handle(req("GET", f"{CQ}/fed-del/result"))
+            assert resp.status == 404
+        finally:
+            c.close()
+
+    def test_deltas_surface_refused_on_router(self, tmp_path):
+        """``/deltas`` is the shard-local drain the router CONSUMES;
+        exposing it on the front door would let two pumps race one
+        dirty set."""
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            _register(c, _cq_body("fed-dl"))
+            resp = c.http.handle(req("GET", f"{CQ}/fed-dl/deltas"))
+            assert resp.status == 400
+            assert b"shard-local" in resp.body
+        finally:
+            c.close()
+
+    def test_list_and_describe_surface_federation(self, tmp_path):
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            _register(c, _cq_body(
+                "fed-ls", watermark={"allowedLateness": "4m"}))
+            resp = c.http.handle(req("GET", CQ))
+            docs = json.loads(resp.body)
+            assert [d["id"] for d in docs] == ["fed-ls"]
+            assert docs[0]["federated"] is True
+            assert docs[0]["watermark"] == {
+                "allowedLatenessMs": 240_000}
+            resp = c.http.handle(req("GET", f"{CQ}/fed-ls"))
+            assert json.loads(resp.body)["shards"] == \
+                ["s0", "s1", "s2"]
+        finally:
+            c.close()
+
+    def test_armed_cluster_cq_fault_degrades_the_pull(self, tmp_path):
+        """The ``cluster.cq`` fault site covers every exchange: armed
+        on the router, one pull's legs all fail and the pull 503s
+        (DegradedError) rather than serving a silently partial
+        merge... of zero legs."""
+        c = LiveCluster(tmp_path, n=3)
+        try:
+            _register(c, _cq_body("fed-ft"))
+            assert c.put(_points(n_half_min=4),
+                         summary="true").status == 200
+            c.tsdb.faults.arm("cluster.cq", error_count=3)
+            resp = c.http.handle(req("GET", f"{CQ}/fed-ft/result"))
+            assert resp.status == 503
+            assert b"every shard leg failed" in resp.body
+            resp = c.http.handle(req("GET", f"{CQ}/fed-ft/result"))
+            assert resp.status == 200
+        finally:
+            c.close()
